@@ -1,0 +1,37 @@
+"""ACCL-TPU: a TPU-native collective communication framework.
+
+A brand-new framework with the capabilities of quetric/ACCL (MPI-like
+collectives with tag-matched two-sided messaging, communicators, segmented
+ring algorithms, wire compression, async call chaining, 3-tier testing),
+re-architected for JAX/XLA/Pallas over the ICI/DCN fabric.
+
+Layers (SURVEY.md §1 mapping):
+  * driver: :class:`ACCL` — the host API (reference L1).
+  * control plane: :mod:`accl_tpu.moveengine` — collective → micro-ops
+    (reference L3, the MicroBlaze firmware).
+  * dataplane: :mod:`accl_tpu.emulator` engines on CPU (reference L4-L6),
+    :mod:`accl_tpu.parallel` + :mod:`accl_tpu.ops` on TPU (XLA collectives
+    and Pallas kernels over ICI).
+  * backends: :mod:`accl_tpu.device` — emulator / socket daemon / TPU mesh.
+"""
+
+from .accl import ACCL
+from .arith import ArithConfig, DEFAULT_ARITH_CONFIGS, resolve_arith_config
+from .buffer import ACCLBuffer
+from .call import CallDescriptor, CallHandle, wait_all
+from .communicator import Communicator, Rank, simple_communicator
+from .constants import (ACCLError, CCLOp, CfgFunc, Compression, ErrorCode,
+                        ReduceFunc, StackType, StreamFlags, TAG_ANY,
+                        decode_error)
+from .device import Device, EmuContext, EmuDevice
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ACCL", "ACCLBuffer", "ACCLError", "ArithConfig", "CallDescriptor",
+    "CallHandle", "CCLOp", "CfgFunc", "Communicator", "Compression",
+    "DEFAULT_ARITH_CONFIGS", "Device", "EmuContext", "EmuDevice",
+    "ErrorCode", "Rank", "ReduceFunc", "StackType", "StreamFlags",
+    "TAG_ANY", "decode_error", "resolve_arith_config",
+    "simple_communicator", "wait_all",
+]

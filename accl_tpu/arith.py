@@ -1,0 +1,129 @@
+"""Arithmetic / datatype configuration registry.
+
+An :class:`ArithConfig` describes, for a pair of (uncompressed, compressed)
+datatypes, how operands are elementwise-combined and how they are
+(de)compressed for the wire. The driver resolves each call's dtype pair to a
+config and hands the device backend everything it needs — exactly the role of
+the reference's exchange-memory arithmetic config blobs.
+
+Parity: reference ``ACCLArithConfig`` (driver/pynq/accl.py:207-255) stores
+{uncompressed/compressed elem bytes, ratio, func count, arith TDEST, and
+compressor/decompressor TDESTs}; configs are written to exchange memory at
+init (accl.py:436-442) and addressed per-call (accl.py:528-592). On TPU the
+"TDEST routing to a reduce_sum_<dtype> kernel" becomes dtype dispatch into
+XLA/Pallas reductions, and the fp32<->fp16 compression lanes
+(kernels/plugins/{fp_hp,hp_fp}_stream_conv) become dtype casts fused into the
+collective program (see ops/compression kernels).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .constants import Compression, ReduceFunc
+
+
+@dataclasses.dataclass(frozen=True)
+class ArithConfig:
+    """Datatype-pair configuration for combine/compression.
+
+    Attributes:
+        uncompressed_dtype: the in-memory operand dtype.
+        compressed_dtype: the on-wire / compressed-operand dtype.
+        supported_funcs: reduction functions this pair supports.
+        arith_is_compressed: if True, reductions run in the compressed dtype
+            (reference: ``arith_is_compressed`` bit choosing which lane feeds
+            the reduce plugin).
+    """
+
+    uncompressed_dtype: np.dtype
+    compressed_dtype: np.dtype
+    supported_funcs: tuple[ReduceFunc, ...] = (
+        ReduceFunc.SUM, ReduceFunc.MAX, ReduceFunc.MIN, ReduceFunc.PROD)
+    arith_is_compressed: bool = False
+
+    @property
+    def uncompressed_elem_bytes(self) -> int:
+        return int(self.uncompressed_dtype.itemsize)
+
+    @property
+    def compressed_elem_bytes(self) -> int:
+        return int(self.compressed_dtype.itemsize)
+
+    @property
+    def elem_ratio(self) -> int:
+        """How many compressed elements per uncompressed element (always 1
+        elementwise; ratio of bytes drives wire savings)."""
+        return 1
+
+    @property
+    def is_compressing(self) -> bool:
+        return self.uncompressed_dtype != self.compressed_dtype
+
+    def wire_dtype(self, compression: Compression) -> np.dtype:
+        """Dtype that actually travels on the fabric for this call."""
+        if compression & Compression.ETH_COMPRESSED:
+            return self.compressed_dtype
+        return self.uncompressed_dtype
+
+
+def _mk(u: str, c: str, **kw) -> ArithConfig:
+    return ArithConfig(np.dtype(u), np.dtype(c), **kw)
+
+
+# Default registry keyed by (uncompressed, compressed) numpy dtype names.
+# Parity: reference ACCL_DEFAULT_ARITH_CONFIG (accl.py:227-246) covers
+# {f32,f64,i32,i64,f16} same-dtype plus (f32,f16) mixed. We add bf16 (the
+# TPU-native half type) and int8/fp8-ready entries for quantized wire lanes.
+DEFAULT_ARITH_CONFIGS: dict[tuple[str, str], ArithConfig] = {
+    ("float32", "float32"): _mk("float32", "float32"),
+    ("float64", "float64"): _mk("float64", "float64"),
+    ("int32", "int32"): _mk("int32", "int32"),
+    ("int64", "int64"): _mk("int64", "int64"),
+    ("float16", "float16"): _mk("float16", "float16"),
+    ("float32", "float16"): _mk("float32", "float16"),
+    ("int8", "int8"): _mk("int8", "int8"),
+}
+
+try:  # bfloat16 comes from ml_dtypes (always present with jax)
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+    DEFAULT_ARITH_CONFIGS[("bfloat16", "bfloat16")] = ArithConfig(_BF16, _BF16)
+    DEFAULT_ARITH_CONFIGS[("float32", "bfloat16")] = ArithConfig(
+        np.dtype("float32"), _BF16)
+except ImportError:  # pragma: no cover
+    pass
+
+
+def resolve_arith_config(
+    dtypes: set[np.dtype] | frozenset[np.dtype],
+    registry: dict[tuple[str, str], ArithConfig] | None = None,
+) -> ArithConfig:
+    """Resolve the dtype set of a call's operands to an ArithConfig.
+
+    Mirrors the reference's ``prepare_call`` resolution (accl.py:528-592):
+    a single dtype maps to the same-dtype config; a {wide, narrow} pair maps
+    to the mixed config with per-operand compression flags decided by the
+    caller.
+    """
+    registry = registry if registry is not None else DEFAULT_ARITH_CONFIGS
+    names = sorted({np.dtype(d).name for d in dtypes})
+    if len(names) == 1:
+        key = (names[0], names[0])
+    elif len(names) == 2:
+        # wider dtype is "uncompressed"; try both orders
+        a, b = names
+        if (a, b) in registry:
+            key = (a, b)
+        elif (b, a) in registry:
+            key = (b, a)
+        else:
+            raise KeyError(f"no arithmetic config for dtype pair {names}")
+    else:
+        raise ValueError(f"calls may mix at most 2 dtypes, got {names}")
+    if key not in registry:
+        raise KeyError(f"no arithmetic config for dtype pair {key}")
+    return registry[key]

@@ -1,0 +1,113 @@
+"""Device buffers: host-visible arrays bound to a backend "device memory".
+
+Parity: the reference driver wraps pynq buffers (device DDR/HBM) or
+``SimBuffer`` (numpy array + fake 4K-aligned physical address talking to the
+emulator over ZMQ, driver/pynq/accl.py:53-104). Calls pass device addresses;
+``sync_to_device``/``sync_from_device`` move data across the host/device
+boundary.
+
+TPU-native design: a buffer is either
+  * an emulator buffer — numpy array registered in the rank daemon's
+    devicemem under an integer address (4 KiB aligned, like SimBuffer), or
+  * a TPU buffer — a ``jax.Array`` (possibly sharded over the communicator's
+    mesh axis); sync_* are device_put/device_get and the "address" is a
+    handle the in-process backend resolves back to the array.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any
+
+import numpy as np
+
+_ALIGNMENT = 4096
+_next_addr = itertools.count(_ALIGNMENT)
+
+
+def _alloc_addr(nbytes: int) -> int:
+    """Fake physical address allocator, 4 KiB aligned (SimBuffer parity,
+    accl.py:61-66)."""
+    global _next_addr
+    addr = next(_next_addr) * _ALIGNMENT
+    # reserve enough aligned pages
+    pages = max(1, -(-nbytes // _ALIGNMENT))
+    for _ in range(pages - 1):
+        next(_next_addr)
+    return addr
+
+
+class ACCLBuffer:
+    """A host array registered with a device backend.
+
+    The backend (device/base.py) decides what ``sync_*`` and ``address``
+    mean. Supports slicing into sub-buffers sharing storage — the reference
+    relies on address arithmetic for strided collective operands; we expose
+    the same capability safely via numpy views.
+    """
+
+    def __init__(self, shape, dtype=np.float32, device: Any = None,
+                 data: np.ndarray | None = None, address: int | None = None,
+                 parent: "ACCLBuffer | None" = None):
+        if data is None:
+            data = np.zeros(shape, dtype=dtype)
+        self.data = data
+        self.device = device
+        self.parent = parent
+        self.address = address if address is not None else _alloc_addr(data.nbytes)
+        if device is not None and parent is None:
+            device.register_buffer(self)
+
+    # -- numpy-ish surface -------------------------------------------------
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self.data.dtype
+
+    @property
+    def size(self) -> int:
+        return self.data.size
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.nbytes
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def __getitem__(self, key) -> "ACCLBuffer":
+        """A view sub-buffer; address tracks the byte offset into the parent."""
+        view = self.data[key]
+        if view.base is None and view is not self.data:
+            raise ValueError("buffer slices must be views (no fancy indexing)")
+        offset = view.__array_interface__["data"][0] - \
+            self.data.__array_interface__["data"][0]
+        return ACCLBuffer(view.shape, view.dtype, device=self.device,
+                          data=view, address=self.address + offset, parent=self)
+
+    def __array__(self, dtype=None):
+        return np.asarray(self.data, dtype=dtype)
+
+    # -- host/device movement ---------------------------------------------
+    def sync_to_device(self):
+        """Push host contents to device memory (pynq sync_to_device parity)."""
+        if self.device is not None:
+            self.device.sync_to_device(self)
+        return self
+
+    def sync_from_device(self):
+        """Pull device memory into the host array."""
+        if self.device is not None:
+            self.device.sync_from_device(self)
+        return self
+
+    def free_buffer(self):
+        if self.device is not None and self.parent is None:
+            self.device.deregister_buffer(self)
+
+    def __repr__(self):
+        return (f"ACCLBuffer(shape={self.shape}, dtype={self.dtype.name}, "
+                f"addr=0x{self.address:x})")

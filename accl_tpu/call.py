@@ -1,0 +1,95 @@
+"""Call descriptors and asynchronous call handles.
+
+Parity: the reference host issues a 15-word call descriptor
+{scenario, count, comm, root_src_dst, function, tag, arithcfg,
+compression_flags, stream_flags, addr_0/1/2 (lo+hi)} to the CCLO
+(driver/pynq/accl.py:594-602; kernels/plugins/hostctrl/hostctrl.cpp:25-91),
+and gets back one status word. ``call_async`` returns a handle the host can
+chain via ``waitfor=`` (ap_ctrl_chain async chaining, accl.py:594-597).
+
+TPU-native design: the descriptor is a dataclass (no MMIO marshalling), and
+the handle wraps either a concurrent future (emulator backend) or JAX's
+async dispatch (TPU backend — dispatch is already asynchronous; ``wait``
+is ``jax.block_until_ready``). ``waitfor=`` chaining is preserved: a backend
+starts a call only after its dependencies complete.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Sequence
+
+from .constants import ACCLError, CCLOp, Compression, ErrorCode, ReduceFunc, StreamFlags
+
+
+@dataclasses.dataclass
+class CallDescriptor:
+    """One device call. Field-for-field capability match of the reference's
+    15-word descriptor (accl.py:594-602)."""
+
+    scenario: CCLOp
+    count: int = 0
+    comm_id: int = 0
+    root_src_dst: int = 0
+    function: ReduceFunc = ReduceFunc.SUM
+    tag: int = 0
+    arithcfg: Any = None                      # resolved ArithConfig
+    compression: Compression = Compression.NONE
+    stream_flags: StreamFlags = StreamFlags.NO_STREAM
+    addr_0: Any = None                        # op0 buffer / array
+    addr_1: Any = None                        # op1 buffer / array
+    addr_2: Any = None                        # result buffer / array
+
+
+class CallHandle:
+    """Future-like handle for an async device call.
+
+    ``wait()`` blocks until the call retires and raises :class:`ACCLError`
+    on a nonzero error word (check_return_value parity, accl.py:617-624).
+    Handles compose: pass them via ``waitfor=`` to chain calls.
+    """
+
+    def __init__(self, context: str = ""):
+        self._done = threading.Event()
+        self._error_word = 0
+        self._result: Any = None
+        self.context = context
+
+    # backend side -----------------------------------------------------
+    def complete(self, error_word: int = 0, result: Any = None):
+        self._error_word = int(error_word)
+        self._result = result
+        self._done.set()
+
+    # host side --------------------------------------------------------
+    def wait(self, timeout: float | None = None) -> Any:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"call {self.context or ''} did not complete "
+                               f"within {timeout}s")
+        if self._error_word != int(ErrorCode.COLLECTIVE_OP_SUCCESS):
+            raise ACCLError(self._error_word, self.context)
+        return self._result
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def error_word(self) -> int:
+        return self._error_word
+
+
+class CompletedHandle(CallHandle):
+    """A handle for synchronously-executed calls (already retired)."""
+
+    def __init__(self, error_word: int = 0, result: Any = None, context: str = ""):
+        super().__init__(context)
+        self.complete(error_word, result)
+
+
+def wait_all(handles: Sequence[CallHandle], timeout: float | None = None):
+    """Wait on a set of chained handles; first error wins."""
+    results = []
+    for h in handles:
+        results.append(h.wait(timeout))
+    return results

@@ -1,0 +1,123 @@
+"""Communicators: the rank group + per-peer connection/sequence state.
+
+Parity: the reference's communicator is a record in FPGA exchange memory —
+{size, local_rank, then per-rank {ip, port, inbound_seq, outbound_seq,
+session, max_segment_size}} (ccl_offload_control.h:271-298), written by
+``configure_communicator`` (driver/pynq/accl.py:677-708) and dumped by
+``dump_communicator`` (accl.py:710-735). Sequence numbers give per-sender
+ordering; sessions identify transport connections.
+
+TPU-native design: a communicator additionally binds to a ``jax.sharding``
+mesh axis, so collectives over the communicator lower to XLA collectives
+over that axis. For the emulator tier the per-rank (host, port) fields play
+the reference's (ip, port) role on a framed-TCP fabric.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, Sequence
+
+from .constants import DEFAULT_MAX_SEGMENT_SIZE
+
+
+@dataclasses.dataclass
+class Rank:
+    """Per-peer state within a communicator.
+
+    Parity: per-rank exchange-memory record (ccl_offload_control.h:280-298).
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 0
+    inbound_seq: int = 0
+    outbound_seq: int = 0
+    session: int = 0xFFFFFFFF
+    max_segment_size: int = DEFAULT_MAX_SEGMENT_SIZE
+    device: Any = None     # jax.Device when bound to a mesh
+    global_rank: int = -1  # fabric endpoint id (world rank); comm-local rank
+    #                        is this Rank's index in Communicator.ranks
+
+
+@dataclasses.dataclass
+class Communicator:
+    """A group of ranks with a distinguished local rank.
+
+    ``ranks`` order defines rank numbering. ``comm_id`` plays the role of the
+    reference's communicator exchange-memory address (the host passes it in
+    the call descriptor, accl.py:596). It is derived deterministically from
+    the membership (+ ``key`` to disambiguate same-membership comms), so
+    every member computes the same id without a handshake.
+    """
+
+    ranks: list[Rank]
+    local_rank: int
+    comm_id: int | None = None
+    mesh_axis: str | None = None  # mesh axis name when TPU-backed
+    key: int = 0                  # disambiguates same-membership comms
+
+    def __post_init__(self):
+        # default global ranks to comm-local numbering (the world comm case)
+        for i, r in enumerate(self.ranks):
+            if r.global_rank < 0:
+                r.global_rank = i
+        if self.comm_id is None:
+            members = ",".join(str(r.global_rank) for r in self.ranks)
+            self.comm_id = zlib.crc32(f"{members}#{self.key}".encode())
+
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def global_rank_of(self, local: int) -> int:
+        return self.ranks[local].global_rank
+
+    @property
+    def my_global_rank(self) -> int:
+        return self.ranks[self.local_rank].global_rank
+
+    def next_rank(self) -> int:
+        return (self.local_rank + 1) % self.size
+
+    def prev_rank(self) -> int:
+        return (self.local_rank - 1) % self.size
+
+    def split(self, members: Sequence[int], new_local: int | None = None,
+              key: int = 0) -> "Communicator":
+        """Create a sub-communicator from a subset of ranks.
+
+        Parity: the reference's driver can write multiple communicators into
+        exchange memory (split capability exercised by multi-CCLO tests).
+        """
+        sub = [dataclasses.replace(self.ranks[m]) for m in members]
+        if new_local is None:
+            if self.local_rank not in members:
+                raise ValueError("local rank not in sub-communicator")
+            new_local = list(members).index(self.local_rank)
+        return Communicator(ranks=sub, local_rank=new_local,
+                            mesh_axis=self.mesh_axis, key=key)
+
+    def describe(self) -> str:
+        """Human-readable dump. Parity: dump_communicator (accl.py:710-735)."""
+        lines = [f"Communicator {self.comm_id}: size={self.size} "
+                 f"local_rank={self.local_rank} mesh_axis={self.mesh_axis}"]
+        for i, r in enumerate(self.ranks):
+            lines.append(
+                f"  rank {i}: addr={r.host}:{r.port} session={r.session} "
+                f"in_seq={r.inbound_seq} out_seq={r.outbound_seq} "
+                f"max_seg={r.max_segment_size}"
+                + (f" device={r.device}" if r.device is not None else ""))
+        return "\n".join(lines)
+
+
+def simple_communicator(world_size: int, local_rank: int,
+                        base_port: int = 0) -> Communicator:
+    """Build a localhost communicator for the emulator tier.
+
+    Rank r listens on base_port + r (the reference's emulator binds cmd port
+    base+rank and eth port base+W+rank, test/zmq/zmq_intf.cpp:36-63).
+    """
+    ranks = [Rank(host="127.0.0.1", port=(base_port + r if base_port else 0))
+             for r in range(world_size)]
+    return Communicator(ranks=ranks, local_rank=local_rank)

@@ -1,0 +1,187 @@
+"""Core operation codes, flags and error codes for the ACCL-TPU framework.
+
+This module defines the public call surface of the framework: the operation
+codes a host issues, the configuration sub-functions, reduction functions,
+wire-compression flags, streaming flags, and the error codes every execution
+engine can raise.
+
+Capability parity: the reference exposes the same surface as Python enums in
+``driver/pynq/accl.py:162-284`` (``CCLOp``, ``CCLOCfgFunc``,
+``ACCLReduceFunctions``, ``ACCLCompressionFlags``, ``ACCLStreamFlags``,
+``ErrorCode``). The numeric values here are our own; only the *semantics* are
+preserved so a user of the reference finds every knob they had.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class CCLOp(enum.IntEnum):
+    """Primitive and collective operations accepted by a device backend.
+
+    Parity: reference ``CCLOp`` (driver/pynq/accl.py:162-177).
+    """
+
+    config = 0
+    copy = 1
+    combine = 2
+    send = 3
+    recv = 4
+    bcast = 5
+    scatter = 6
+    gather = 7
+    reduce = 8
+    allgather = 9
+    allreduce = 10
+    reduce_scatter = 11
+    barrier = 12
+    alltoall = 13
+    nop = 255
+
+
+class CfgFunc(enum.IntEnum):
+    """Sub-functions of ``CCLOp.config``.
+
+    Parity: reference ``CCLOCfgFunc`` (driver/pynq/accl.py:179-187) — reset,
+    timeout, open port/connection, stack selection, segment size. TPU-native
+    additions keep the same "runtime reconfiguration" capability over a mesh
+    fabric instead of a TCP/UDP stack.
+    """
+
+    reset_periph = 0
+    enable_pkt = 1
+    set_timeout = 2
+    open_port = 3
+    open_con = 4
+    set_stack_type = 5
+    set_max_segment_size = 6
+    close_con = 7
+    start_profiling = 8
+    end_profiling = 9
+
+
+class ReduceFunc(enum.IntEnum):
+    """Elementwise reduction functions.
+
+    Parity: reference ``ACCLReduceFunctions`` (driver/pynq/accl.py:189-191)
+    only ships SUM; the older XRT driver enumerates max as well
+    (driver/xrt/include/xlnx-consts.hpp). We support the full MPI-style set —
+    on TPU every one of these lowers to the same XLA reduction machinery.
+    """
+
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+
+
+class Compression(enum.IntFlag):
+    """Wire/operand precision-reduction flags.
+
+    Parity: reference ``ACCLCompressionFlags`` (driver/pynq/accl.py:193-199).
+    ``OP0/OP1/RES_COMPRESSED`` mark an operand already stored compressed;
+    ``ETH_COMPRESSED`` requests compression on the wire only. On TPU "the
+    wire" is ICI, and compression means running the collective in the
+    compressed dtype (bf16/fp16/fp8) with decompress-on-arrival.
+    """
+
+    NONE = 0
+    OP0_COMPRESSED = 1
+    OP1_COMPRESSED = 2
+    RES_COMPRESSED = 4
+    ETH_COMPRESSED = 8
+
+
+class StreamFlags(enum.IntFlag):
+    """Operand streaming flags.
+
+    Parity: reference ``ACCLStreamFlags`` (driver/pynq/accl.py:201-205). In
+    the reference, OP0/RES can be AXI streams wired to a user kernel; on TPU
+    the analog is fusing the producer/consumer computation into the same XLA
+    program as the collective (no materialized HBM buffer).
+    """
+
+    NO_STREAM = 0
+    OP0_STREAM = 1
+    RES_STREAM = 2
+
+
+class ErrorCode(enum.IntFlag):
+    """Errors raised by execution engines; OR-able like the reference's.
+
+    Parity: reference error codes (ccl_offload_control.h:123-151 — 27 codes
+    covering DMA/packetizer/arith/compression mismatch, timeouts, spare
+    buffer problems). Ours cover the equivalent failure surface of the
+    TPU/emulator engines.
+    """
+
+    COLLECTIVE_OP_SUCCESS = 0
+    DMA_MISMATCH_ERROR = 1 << 0
+    DMA_TRANSACTION_ERROR = 1 << 1
+    ARITH_ERROR = 1 << 2
+    PACK_TIMEOUT_STS_ERROR = 1 << 3
+    PACK_SEQ_NUMBER_ERROR = 1 << 4
+    COMPRESSION_ERROR = 1 << 5
+    KRNL_TIMEOUT_STS_ERROR = 1 << 6
+    KRNL_STS_COUNT_ERROR = 1 << 7
+    RECEIVE_TIMEOUT_ERROR = 1 << 8
+    RECEIVE_OFFCHIP_SPARE_BUFF_ID_NOT_VALID = 1 << 9
+    RECEIVE_SPARE_BUFF_STATUS_ERROR = 1 << 10
+    RECEIVE_SPARE_BUFF_DMA_TAG_MISMATCH = 1 << 11
+    DMA_SIZE_ERROR = 1 << 12
+    OPEN_PORT_NOT_SUCCEEDED = 1 << 13
+    OPEN_CON_NOT_SUCCEEDED = 1 << 14
+    COMM_NOT_CONFIGURED = 1 << 15
+    ARITHCFG_NOT_CONFIGURED = 1 << 16
+    COMPRESSION_NOT_SUPPORTED = 1 << 17
+    STREAM_NOT_SUPPORTED = 1 << 18
+    COLLECTIVE_NOT_IMPLEMENTED = 1 << 19
+    RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW = 1 << 20
+    CONNECTION_CLOSED = 1 << 21
+    DEVICE_NOT_READY = 1 << 22
+    INVALID_CALL = 1 << 23
+
+
+class StackType(enum.IntEnum):
+    """Transport fabric selector.
+
+    Parity: reference selects UDP vs TCP Vitis stacks at runtime
+    (accl.py:383-395, HOUSEKEEP_SET_STACK_TYPE). TPU-native fabrics:
+    in-process loopback, socket fabric (emulator tier), ICI mesh, DCN
+    between slices.
+    """
+
+    LOOPBACK = 0
+    SOCKET = 1  # emulator-tier framed-TCP fabric (reference: ZMQ pub/sub "wire")
+    ICI = 2     # single-slice XLA collectives
+    DCN = 3     # multi-slice / multi-host
+
+
+class ACCLError(Exception):
+    """Host-side exception carrying the OR-ed device error word.
+
+    Parity: reference ``check_return_value`` raises on nonzero retcode
+    (accl.py:617-624).
+    """
+
+    def __init__(self, error_word: int, context: str = ""):
+        self.error_word = int(error_word)
+        self.errors = decode_error(error_word)
+        names = " | ".join(e.name for e in self.errors) or hex(self.error_word)
+        super().__init__(f"ACCL call failed{' in ' + context if context else ''}: {names}")
+
+
+def decode_error(error_word: int) -> list[ErrorCode]:
+    """Split an OR-ed error word into its individual error codes."""
+    return [e for e in ErrorCode if e != ErrorCode.COLLECTIVE_OP_SUCCESS
+            and error_word & e.value]
+
+
+# Default sizing knobs; parity with reference constants
+# (ccl_offload_control.h:50-55): max pkt 1536B, 1MiB segments, 8MiB DMA BTT.
+DEFAULT_MAX_SEGMENT_SIZE = 1 << 20          # 1 MiB, like MAX_SEG_SIZE
+DEFAULT_RX_BUFFER_SIZE = 16 << 10           # spare rx buffer bytes
+DEFAULT_RX_BUFFER_COUNT = 16
+DEFAULT_TIMEOUT_S = 30.0
+TAG_ANY = 0xFFFFFFFF                        # reference uses tag=ANY sentinel

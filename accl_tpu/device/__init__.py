@@ -1,0 +1,14 @@
+"""Device backends: where calls execute.
+
+* :class:`~accl_tpu.device.emu.EmuContext` / ``EmuDevice`` — in-process
+  threaded CPU emulator (loopback fabric).
+* ``SimDevice`` (sim.py) — client to an out-of-process rank daemon over a
+  framed-TCP socket (reference: SimDevice over ZMQ, accl.py:106-159).
+* ``TpuDevice`` (tpu.py) — in-process SPMD backend over a jax Mesh; the
+  production path.
+"""
+
+from .base import Device
+from .emu import EmuContext, EmuDevice
+
+__all__ = ["Device", "EmuContext", "EmuDevice"]

@@ -1,0 +1,57 @@
+"""Abstract device backend interface.
+
+Parity: the reference driver's device abstraction is the MMIO+call transport
+pair — real hardware (pynq Overlay + hostctrl kernel) or SimDevice (ZMQ) —
+behind one ``call/start/read/write`` surface (driver/pynq/accl.py:33-159).
+Ours is a clean ABC the driver talks to; buffers and call descriptors are
+the currency.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Sequence
+
+from ..buffer import ACCLBuffer
+from ..call import CallDescriptor, CallHandle
+from ..communicator import Communicator
+
+
+class Device(abc.ABC):
+    """One rank's execution backend."""
+
+    @abc.abstractmethod
+    def register_buffer(self, buf: ACCLBuffer): ...
+
+    @abc.abstractmethod
+    def deregister_buffer(self, buf: ACCLBuffer): ...
+
+    def sync_to_device(self, buf: ACCLBuffer):
+        """Host->device copy; default no-op for host-memory backends."""
+
+    def sync_from_device(self, buf: ACCLBuffer):
+        """Device->host copy; default no-op for host-memory backends."""
+
+    @abc.abstractmethod
+    def call_async(self, desc: CallDescriptor,
+                   waitfor: Sequence[CallHandle] = ()) -> CallHandle: ...
+
+    def call_sync(self, desc: CallDescriptor,
+                  waitfor: Sequence[CallHandle] = (),
+                  timeout: float | None = None):
+        return self.call_async(desc, waitfor).wait(timeout)
+
+    @abc.abstractmethod
+    def configure_communicator(self, comm: Communicator): ...
+
+    @abc.abstractmethod
+    def set_timeout(self, timeout: float): ...
+
+    @abc.abstractmethod
+    def set_max_segment_size(self, nbytes: int): ...
+
+    def soft_reset(self):
+        """Parity: HOUSEKEEP_SWRST (ccl_offload_control.c:1244-1247)."""
+
+    def deinit(self):
+        """Release backend resources (driver deinit, accl.py:421-433)."""

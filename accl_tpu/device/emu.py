@@ -1,0 +1,171 @@
+"""In-process threaded CPU emulator backend.
+
+N ranks live in one process, each with its own device memory, RX buffer
+pool, move executor and a worker thread that retires queued calls in order.
+The fabric is the in-process loopback (emulator/fabric.py).
+
+Parity: this plays the role of the reference's single-process loopback
+builds (multi-CCLO on one board through dummy_tcp_stack) and is the fast
+tier of the 3-tier test story (§4 of SURVEY.md). The out-of-process daemon
+(emulator/daemon.py + native/) reuses exactly these engines behind a socket
+protocol, mirroring cclo_emu.cpp behind ZMQ.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Sequence
+
+from ..buffer import ACCLBuffer
+from ..call import CallDescriptor, CallHandle
+from ..communicator import Communicator
+from ..constants import (ACCLError, CCLOp, DEFAULT_MAX_SEGMENT_SIZE,
+                         DEFAULT_RX_BUFFER_COUNT, DEFAULT_RX_BUFFER_SIZE,
+                         DEFAULT_TIMEOUT_S, ErrorCode)
+from ..moveengine import MoveContext, expand_call
+from ..emulator.executor import DeviceMemory, MoveExecutor, RxBufferPool
+from ..emulator.fabric import Envelope, LocalFabric
+from .base import Device
+
+
+class EmuContext:
+    """Shared state of an N-rank in-process emulation: the fabric."""
+
+    def __init__(self, world_size: int, nbufs: int = DEFAULT_RX_BUFFER_COUNT,
+                 bufsize: int = DEFAULT_RX_BUFFER_SIZE):
+        self.world_size = world_size
+        self.fabric = LocalFabric(world_size)
+        self.nbufs, self.bufsize = nbufs, bufsize
+        self.devices: list[EmuDevice | None] = [None] * world_size
+
+    def device(self, rank: int) -> "EmuDevice":
+        if self.devices[rank] is None:
+            self.devices[rank] = EmuDevice(self, rank)
+        return self.devices[rank]
+
+    def _route(self, env: Envelope, payload: bytes):
+        dev = self.devices[env.dst]
+        if dev is None:
+            raise RuntimeError(f"rank {env.dst} not attached")
+        dev.ingest(env, payload)
+
+
+class EmuDevice(Device):
+    """One emulated rank: memory + pool + executor + call worker thread."""
+
+    def __init__(self, ctx: EmuContext, rank: int):
+        self.ctx = ctx
+        self.rank = rank
+        self.mem = DeviceMemory()
+        self.pool = RxBufferPool(ctx.nbufs, ctx.bufsize)
+        self.comms: dict[int, Communicator] = {}
+        self.comm: Communicator | None = None  # world comm (first configured)
+        self.executor = MoveExecutor(self.mem, self.pool,
+                                     send_fn=ctx._route,
+                                     timeout=DEFAULT_TIMEOUT_S)
+        self.timeout = DEFAULT_TIMEOUT_S
+        self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
+        self._calls: queue.Queue = queue.Queue()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name=f"emu-rank{rank}")
+        self._worker.start()
+
+    # -- ingress (eager, fabric thread) -----------------------------------
+    def ingest(self, env: Envelope, payload: bytes):
+        if env.strm:
+            self.executor.deliver_stream(env, payload)
+        else:
+            self.pool.ingest(env, payload)
+
+    # -- Device interface --------------------------------------------------
+    def register_buffer(self, buf: ACCLBuffer):
+        self.mem.register(buf.address, buf.data)
+
+    def deregister_buffer(self, buf: ACCLBuffer):
+        self.mem.deregister(buf.address)
+
+    def configure_communicator(self, comm: Communicator):
+        """Register a communicator (world or split); calls reference it by
+        comm_id, like the reference addressing communicator records in
+        exchange memory (accl.py:677-708)."""
+        self.comms[comm.comm_id] = comm
+        if self.comm is None:
+            self.comm = comm
+
+    def set_timeout(self, timeout: float):
+        self.timeout = timeout
+        self.executor.timeout = timeout
+
+    def set_max_segment_size(self, nbytes: int):
+        if nbytes > self.ctx.bufsize:
+            raise ValueError(
+                f"segment size {nbytes} exceeds rx buffer size "
+                f"{self.ctx.bufsize} (reference: segments must fit spare "
+                f"buffers, accl.py:660-667)")
+        self.max_segment_size = nbytes
+
+    def call_async(self, desc: CallDescriptor,
+                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+        handle = CallHandle(context=desc.scenario.name)
+        self._calls.put((desc, tuple(waitfor), handle))
+        return handle
+
+    def soft_reset(self):
+        """Drain the rx pool and zero sequence counters.
+
+        Parity: encore_soft_reset (c:1133-1136). Like the reference's reset,
+        this is rank-local state surgery: it must be performed on EVERY rank
+        of the fabric (each host resets its own CCLO) or sequence numbers
+        desynchronize from peers' outbound counters.
+        """
+        self.pool = RxBufferPool(self.ctx.nbufs, self.ctx.bufsize)
+        self.executor.pool = self.pool
+        for comm in self.comms.values():
+            for r in comm.ranks:
+                r.inbound_seq = r.outbound_seq = 0
+
+    def deinit(self):
+        self._calls.put(None)
+
+    # -- worker ------------------------------------------------------------
+    def _run(self):
+        while True:
+            item = self._calls.get()
+            if item is None:
+                return
+            desc, waitfor, handle = item
+            try:
+                for dep in waitfor:
+                    dep.wait(self.timeout)
+                err = self._execute(desc)
+                handle.complete(err)
+            except ACCLError as exc:
+                # failed waitfor dependency: propagate its error word
+                handle.complete(exc.error_word)
+            except TimeoutError:
+                handle.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR))
+            except Exception:  # noqa: BLE001 — report, don't kill worker
+                handle.complete(int(ErrorCode.INVALID_CALL))
+
+    def _execute(self, desc: CallDescriptor) -> int:
+        if desc.scenario == CCLOp.nop:
+            return 0
+        if desc.scenario == CCLOp.config:
+            return 0
+        comm = self.comms.get(desc.comm_id)
+        if comm is None:
+            return int(ErrorCode.COMM_NOT_CONFIGURED)
+        if desc.arithcfg is None:
+            return int(ErrorCode.ARITHCFG_NOT_CONFIGURED)
+        ctx = MoveContext(world_size=comm.size,
+                          local_rank=comm.local_rank,
+                          arithcfg=desc.arithcfg,
+                          max_segment_size=self.max_segment_size)
+        moves = expand_call(
+            ctx, desc.scenario, count=desc.count,
+            root_src_dst=desc.root_src_dst, func=desc.function,
+            tag=desc.tag,
+            addr_0=desc.addr_0, addr_1=desc.addr_1, addr_2=desc.addr_2,
+            compression=desc.compression, stream=desc.stream_flags)
+        return self.executor.execute(moves, desc.arithcfg, comm)

@@ -1,0 +1,14 @@
+"""CPU emulator tier: rank-local dataplane + fabrics + rank daemon.
+
+Parity: the reference's strongest test idea is a functional CPU emulator of
+the whole device (test/emulation/cclo_emu.cpp) behind the same wire protocol
+as hardware, so one test corpus drives every tier. Here the emulator executes
+the same ``Move`` micro-op programs the control plane emits, against numpy
+device memory, over an in-process or socket fabric.
+"""
+
+from .executor import DeviceMemory, RxBufferPool, MoveExecutor
+from .fabric import Envelope, LocalFabric, FabricEndpoint
+
+__all__ = ["DeviceMemory", "RxBufferPool", "MoveExecutor", "Envelope",
+           "LocalFabric", "FabricEndpoint"]

@@ -1,0 +1,303 @@
+"""Rank-local dataplane: device memory, RX buffer pool, move executor.
+
+This is the emulator-tier equivalent of the reference's dataplane:
+
+* :class:`DeviceMemory` — the rank's "HBM" (reference: ``vector<char>``
+  devicemem in cclo_emu.cpp:47-103, addressed by the fake physical addresses
+  SimBuffer hands out, accl.py:53-104).
+* :class:`RxBufferPool` — eager-ingress spare-buffer pool with MPI-envelope
+  matching on ``(src, tag, seqn)`` (reference: rxbuf_offload engines +
+  ``seek_rx_buffer``/``wait_on_rx``, ccl_offload_control.c:385-435,
+  rxbuf_seek.cpp:20-79). Ingress is asynchronous: messages are accepted into
+  the pool the moment they arrive, independent of any posted receive — the
+  property that lets a send complete before the matching recv is posted.
+* :class:`MoveExecutor` — executes ``Move`` programs: operand fetch
+  (memory / rx-match / stream), elementwise combine, local write and/or
+  remote send with wire compression (reference: dma_mover 11-stage pipeline,
+  dma_mover.cpp:716-898, plus reduce_sum / stream_conv plugin kernels).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..arith import ArithConfig
+from ..communicator import Communicator
+from ..constants import ErrorCode, ReduceFunc, TAG_ANY
+from ..moveengine import Move, MoveMode, Operand
+from .fabric import Envelope, FabricEndpoint
+
+
+class DeviceMemory:
+    """Sparse address space backed by registered numpy arrays.
+
+    Buffers register their [addr, addr+nbytes) range; reads/writes resolve
+    the containing registration and return views. Sub-buffer addresses fall
+    inside the parent's range, so only top-level buffers register.
+    """
+
+    def __init__(self):
+        self._regions: dict[int, np.ndarray] = {}  # start addr -> flat bytes view
+        self._lock = threading.Lock()  # host registers while workers resolve
+
+    def register(self, addr: int, array: np.ndarray):
+        with self._lock:
+            self._regions[addr] = array.reshape(-1).view(np.uint8)
+
+    def deregister(self, addr: int):
+        with self._lock:
+            self._regions.pop(addr, None)
+
+    def _resolve(self, addr: int, nbytes: int) -> tuple[np.ndarray, int]:
+        with self._lock:
+            items = list(self._regions.items())
+        for start, mem in items:
+            if start <= addr and addr + nbytes <= start + mem.nbytes:
+                return mem, addr - start
+        raise KeyError(f"address range [0x{addr:x}, +{nbytes}) not registered")
+
+    def read(self, addr: int, count: int, dtype: np.dtype) -> np.ndarray:
+        nbytes = count * dtype.itemsize
+        mem, off = self._resolve(addr, nbytes)
+        return mem[off:off + nbytes].view(dtype).copy()
+
+    def write(self, addr: int, data: np.ndarray):
+        flat = data.reshape(-1).view(np.uint8)
+        mem, off = self._resolve(addr, flat.nbytes)
+        mem[off:off + flat.nbytes] = flat
+
+
+class RxBuffer:
+    """One spare buffer. Parity: 8-field spare-buffer record with
+    IDLE→ENQUEUED→RESERVED→IDLE lifecycle (ccl_offload_control.h:242-270)."""
+
+    __slots__ = ("status", "env", "payload")
+    IDLE, RESERVED = 0, 2
+
+    def __init__(self):
+        self.status = RxBuffer.IDLE
+        self.env: Envelope | None = None
+        self.payload: bytes = b""
+
+
+class RxBufferPool:
+    """Eager-ingress pool + (src, tag, seqn) matcher.
+
+    ``ingest`` is called by the fabric receiver thread for every arriving
+    message; ``seek`` is called by the executor's ON_RECV path and blocks
+    with a timeout (wait_on_rx parity, ccl_offload_control.c:423-435).
+    Matching requires the exact expected sequence number per sender,
+    enforcing in-order consumption per peer (rxbuf_seek.cpp:58-59).
+    """
+
+    def __init__(self, nbufs: int, bufsize: int):
+        self.bufs = [RxBuffer() for _ in range(nbufs)]
+        self.bufsize = bufsize
+        self._cv = threading.Condition()
+        self.error_word = 0
+
+    def ingest(self, env: Envelope, payload: bytes) -> int:
+        with self._cv:
+            if len(payload) > self.bufsize:
+                self.error_word |= int(ErrorCode.DMA_SIZE_ERROR)
+                return int(ErrorCode.DMA_SIZE_ERROR)
+            for b in self.bufs:
+                if b.status == RxBuffer.IDLE:
+                    b.status = RxBuffer.RESERVED
+                    b.env, b.payload = env, payload
+                    self._cv.notify_all()
+                    return 0
+            self.error_word |= int(ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+            return int(ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+
+    def _match(self, src: int, tag: int, seqn: int,
+               comm_id: int) -> RxBuffer | None:
+        for b in self.bufs:
+            if b.status != RxBuffer.RESERVED or b.env is None:
+                continue
+            if b.env.src != src or b.env.seqn != seqn:
+                continue
+            if b.env.comm_id != comm_id:
+                continue
+            if tag != TAG_ANY and b.env.tag != tag and b.env.tag != TAG_ANY:
+                continue
+            return b
+        return None
+
+    def seek(self, src: int, tag: int, seqn: int, timeout: float,
+             comm_id: int = 0) -> tuple[Envelope, bytes] | None:
+        """Blocking match-and-release; returns None on timeout. ``src`` is
+        the sender's global rank; seqn ordering is scoped per communicator
+        (the reference scopes sequence numbers per communicator record in
+        exchange memory, ccl_offload_control.h:271-298)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                b = self._match(src, tag, seqn, comm_id)
+                if b is not None:
+                    env, payload = b.env, b.payload
+                    b.status = RxBuffer.IDLE          # release back to pool
+                    b.env, b.payload = None, b""
+                    return env, payload
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    return None
+
+    def occupancy(self) -> int:
+        with self._cv:
+            return sum(b.status == RxBuffer.RESERVED for b in self.bufs)
+
+    def describe(self) -> str:
+        """Parity: dump_rx_buffers (accl.py:482-526)."""
+        lines = [f"RX pool: {len(self.bufs)} x {self.bufsize}B, "
+                 f"{self.occupancy()} reserved"]
+        for i, b in enumerate(self.bufs):
+            st = "RESERVED" if b.status == RxBuffer.RESERVED else "IDLE"
+            e = b.env
+            lines.append(f"  buf {i}: {st}" + (
+                f" src={e.src} tag={e.tag} seqn={e.seqn} len={e.nbytes}"
+                if e else ""))
+        return "\n".join(lines)
+
+
+_REDUCERS = {
+    ReduceFunc.SUM: np.add,
+    ReduceFunc.MAX: np.maximum,
+    ReduceFunc.MIN: np.minimum,
+    ReduceFunc.PROD: np.multiply,
+}
+
+
+class MoveExecutor:
+    """Executes Move programs against one rank's memory/fabric/pool.
+
+    Streams: ``stream_in``/``stream_out`` model the external-kernel AXIS
+    ports (reference: SWITCH_M_BYPASS / loopback plugin); ``push_stream``
+    feeds OP0_STREAM operands, RES_STREAM results land in ``stream_out``,
+    and messages with ``strm != 0`` bypass the rx pool into ``stream_in``
+    (remote-stream send, dma_mover.cpp:303 / tcp_depacketizer strm routing).
+    """
+
+    def __init__(self, mem: DeviceMemory, pool: RxBufferPool, send_fn,
+                 timeout: float = 30.0):
+        self.mem = mem
+        self.pool = pool
+        self._send = send_fn  # (Envelope, payload_bytes) -> None
+        self.timeout = timeout
+        self.stream_in: list[np.ndarray] = []
+        self.stream_out: list[np.ndarray] = []
+        self._stream_cv = threading.Condition()
+
+    # -- stream ports ------------------------------------------------------
+    def push_stream(self, data: np.ndarray):
+        with self._stream_cv:
+            self.stream_in.append(np.asarray(data).reshape(-1))
+            self._stream_cv.notify_all()
+
+    def pop_stream_out(self) -> np.ndarray:
+        return self.stream_out.pop(0)
+
+    def deliver_stream(self, env: Envelope, payload: bytes):
+        data = np.frombuffer(payload, dtype=np.dtype(env.wire_dtype))
+        self.push_stream(data)
+
+    def _pop_stream_in(self, count: int, dtype: np.dtype,
+                       deadline: float) -> np.ndarray | None:
+        with self._stream_cv:
+            while not self.stream_in:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._stream_cv.wait(remaining):
+                    return None
+            data = self.stream_in.pop(0)
+        return data.astype(dtype, copy=False)[:count]
+
+    # -- operand fetch/sink ------------------------------------------------
+    def _fetch(self, op: Operand, count: int, cfg: ArithConfig,
+               comm: Communicator, deadline: float
+               ) -> tuple[np.ndarray | None, int]:
+        """Returns (array in uncompressed dtype, error_word)."""
+        u, c = cfg.uncompressed_dtype, cfg.compressed_dtype
+        if op.mode == MoveMode.NONE:
+            return None, 0
+        if op.mode == MoveMode.IMMEDIATE:
+            stored = c if op.compressed else u
+            data = self.mem.read(op.addr, count, stored)
+            return data.astype(u, copy=False), 0
+        if op.mode == MoveMode.STREAM:
+            data = self._pop_stream_in(count, u, deadline)
+            if data is None:
+                return None, int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
+            return data, 0
+        if op.mode == MoveMode.ON_RECV:
+            rank = comm.ranks[op.src_rank]
+            got = self.pool.seek(rank.global_rank, op.tag, rank.inbound_seq,
+                                 max(0.0, deadline - time.monotonic()),
+                                 comm_id=comm.comm_id)
+            if got is None:
+                return None, int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+            env, payload = got
+            rank.inbound_seq += 1      # exchange-mem seq update parity
+            wire = np.dtype(env.wire_dtype)
+            data = np.frombuffer(payload, dtype=wire)
+            if data.size != count:
+                return None, int(ErrorCode.DMA_MISMATCH_ERROR)
+            return data.astype(u, copy=False), 0
+        return None, int(ErrorCode.INVALID_CALL)
+
+    def _emit_remote(self, move: Move, data: np.ndarray, cfg: ArithConfig,
+                     comm: Communicator):
+        wire = (cfg.compressed_dtype if move.eth_compressed
+                else cfg.uncompressed_dtype)
+        payload = np.ascontiguousarray(data.astype(wire, copy=False)).tobytes()
+        rank = comm.ranks[move.dst_rank]  # comm-local -> fabric rank
+        env = Envelope(src=comm.my_global_rank, dst=rank.global_rank,
+                       tag=move.tag, seqn=rank.outbound_seq,
+                       nbytes=len(payload), wire_dtype=np.dtype(wire).name,
+                       strm=1 if move.remote_stream else 0,
+                       comm_id=comm.comm_id)
+        rank.outbound_seq += 1
+        self._send(env, payload)
+
+    # -- the engine --------------------------------------------------------
+    def execute(self, moves: list[Move], cfg: ArithConfig,
+                comm: Communicator) -> int:
+        """Run a move program; returns the OR-ed error word (0 = success).
+
+        Parity: each move maps to one trip through the dma_mover pipeline
+        (decode → fetch ops → arith → route result → retire with an error
+        word, dma_mover.cpp:343-714)."""
+        err = 0
+        for mv in moves:
+            deadline = time.monotonic() + self.timeout
+            op0, e0 = self._fetch(mv.op0, mv.count, cfg, comm, deadline)
+            op1, e1 = self._fetch(mv.op1, mv.count, cfg, comm, deadline)
+            err |= e0 | e1
+            if e0 or e1:
+                break  # like setjmp unwind to finalize_call (c:1163-1170)
+            if op0 is not None and op1 is not None:
+                if mv.func is None:
+                    err |= int(ErrorCode.INVALID_CALL)
+                    break
+                result = _REDUCERS[mv.func](op0, op1)
+            else:
+                result = op0 if op0 is not None else op1
+            if result is None:
+                err |= int(ErrorCode.INVALID_CALL)
+                break
+            if mv.res_local:
+                if mv.res.mode == MoveMode.STREAM:
+                    self.stream_out.append(result)
+                elif mv.res.mode == MoveMode.IMMEDIATE:
+                    out_dtype = (cfg.compressed_dtype if mv.res.compressed
+                                 else cfg.uncompressed_dtype)
+                    self.mem.write(mv.res.addr,
+                                   result.astype(out_dtype, copy=False))
+                else:
+                    err |= int(ErrorCode.INVALID_CALL)
+                    break
+            if mv.res_remote:
+                self._emit_remote(mv, result, cfg, comm)
+        return err

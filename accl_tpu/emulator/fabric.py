@@ -1,0 +1,80 @@
+"""Message fabrics for the emulator tier.
+
+Parity: the reference's emulation "wire" is ZMQ pub/sub JSON frames between
+rank processes (test/zmq/zmq_intf.cpp:70-164), with a dummy loopback stack
+for single-process tests (kernels/plugins/dummy_tcp_stack). Here:
+
+* :class:`LocalFabric` — N in-process endpoints with locked deques; the
+  loopback tier (fast unit tests, no sockets).
+* :class:`SocketFabric` (fabric_socket.py) — framed-TCP fabric between rank
+  daemon processes; the multi-process tier driven by the same tests.
+
+A message is a 64-byte-header-equivalent envelope {src, tag, seqn, nbytes,
+wire_dtype, strm} + payload (eth_intf.h:41-80 parity).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+
+
+@dataclasses.dataclass
+class Envelope:
+    """Wire header. Parity: eth header {count, tag, src, seqn, strm, dst}
+    (eth_intf/eth_intf.h:41-80); wire_dtype replaces the implicit arith-config
+    agreement between sender and receiver."""
+
+    src: int               # GLOBAL (fabric) rank of the sender
+    dst: int               # GLOBAL (fabric) rank of the receiver
+    tag: int
+    seqn: int
+    nbytes: int
+    wire_dtype: str
+    strm: int = 0          # nonzero = deliver to peer's stream port
+    comm_id: int = 0       # communicator scope for seqn matching
+
+
+class FabricEndpoint:
+    """One rank's attachment to a fabric: an inbound queue with notification."""
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self._queue: collections.deque[tuple[Envelope, bytes]] = collections.deque()
+        self._cv = threading.Condition()
+
+    def deliver(self, env: Envelope, payload: bytes):
+        with self._cv:
+            self._queue.append((env, payload))
+            self._cv.notify_all()
+
+    def poll(self) -> tuple[Envelope, bytes] | None:
+        with self._cv:
+            if self._queue:
+                return self._queue.popleft()
+            return None
+
+    def wait_any(self, timeout: float | None) -> bool:
+        """Block until at least one message is queued."""
+        with self._cv:
+            if self._queue:
+                return True
+            return self._cv.wait(timeout)
+
+
+class LocalFabric:
+    """In-process loopback fabric connecting N endpoints.
+
+    Parity role: dummy_tcp_stack loopback (single-device tests without a
+    network, dummy_tcp_stack.cpp:221-269).
+    """
+
+    def __init__(self, world_size: int):
+        self.endpoints = [FabricEndpoint(r) for r in range(world_size)]
+
+    def endpoint(self, rank: int) -> FabricEndpoint:
+        return self.endpoints[rank]
+
+    def send(self, env: Envelope, payload: bytes):
+        self.endpoints[env.dst].deliver(env, payload)

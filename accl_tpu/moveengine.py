@@ -1,0 +1,590 @@
+"""Control plane: expand collective calls into ``Move`` micro-operations.
+
+This is the TPU-framework equivalent of the reference's MicroBlaze firmware
+(kernels/cclo/fw/sw_apps/ccl_offload_control/src/ccl_offload_control.c):
+every primitive/collective is expressed as a short program of generic *move*
+micro-ops, each of which reads up to two operands (from memory, from the
+receive-matching engine, or from a stream), optionally combines them
+elementwise, and writes the result locally and/or sends it to a peer.
+
+Design differences from the reference (deliberate, TPU-idiomatic):
+  * The firmware resolves INCREMENT/REPEAT/STRIDE address modes *inside the
+    dataplane* with per-channel previous-address registers
+    (dma_mover.cpp:497-669). Here the engine resolves concrete byte
+    addresses at expansion time and records the mode label for parity
+    inspection — software expansion makes stateful address registers
+    pointless.
+  * Counts are elements of the call's uncompressed dtype; addresses are byte
+    offsets into the rank's device memory.
+
+Collective expansions mirror the reference algorithms one-for-one so a
+reviewer can diff them against ccl_offload_control.c:502-1098:
+ring gather/allgather/reduce/reduce_scatter, 2-phase ring allreduce
+(fused reduce-scatter + allgather), segmented broadcast, strided scatter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Iterator
+
+from .arith import ArithConfig
+from .constants import CCLOp, Compression, ReduceFunc, StreamFlags, TAG_ANY
+
+
+class MoveMode(enum.Enum):
+    """Operand sourcing/sinking modes.
+
+    Parity: MOVE_NONE/STREAM/IMMEDIATE/ON_RECV/INCREMENT/REPEAT/STRIDE
+    (ccl_offload_control.h:153-161). INCREMENT/REPEAT/STRIDE collapse to
+    IMMEDIATE at expansion time; the ``mode_label`` field on Move keeps the
+    original mode name for diffing against the firmware.
+    """
+
+    NONE = "none"
+    IMMEDIATE = "immediate"
+    ON_RECV = "on_recv"
+    STREAM = "stream"
+
+
+@dataclasses.dataclass
+class Operand:
+    mode: MoveMode = MoveMode.NONE
+    addr: int | None = None          # byte address (IMMEDIATE)
+    src_rank: int | None = None      # peer to match (ON_RECV)
+    tag: int = TAG_ANY               # envelope tag (ON_RECV)
+    compressed: bool = False         # operand stored in compressed dtype
+
+    @classmethod
+    def none(cls):
+        return cls(MoveMode.NONE)
+
+    @classmethod
+    def imm(cls, addr: int, compressed: bool = False):
+        return cls(MoveMode.IMMEDIATE, addr=addr, compressed=compressed)
+
+    @classmethod
+    def on_recv(cls, src_rank: int, tag: int = TAG_ANY):
+        return cls(MoveMode.ON_RECV, src_rank=src_rank, tag=tag)
+
+    @classmethod
+    def stream(cls):
+        return cls(MoveMode.STREAM)
+
+
+@dataclasses.dataclass
+class Move:
+    """One micro-op: res = func(op0, op1), written locally and/or sent.
+
+    Parity: ``move_instruction`` (dma_mover.h:28-74) — op0/op1/res operand
+    specs, elementwise function, remote destination {rank, tag}, compression
+    flags, count. ``blocking`` marks moves whose result must be fully
+    retired before the next move may start (the reference forces this where
+    a relay would race a concurrent write, ccl_offload_control.c:788-791).
+    """
+
+    count: int
+    op0: Operand = dataclasses.field(default_factory=Operand.none)
+    op1: Operand = dataclasses.field(default_factory=Operand.none)
+    res: Operand = dataclasses.field(default_factory=Operand.none)
+    func: ReduceFunc | None = None
+    res_remote: bool = False
+    res_local: bool = False
+    dst_rank: int | None = None      # remote destination rank
+    tag: int = 0                     # tag for the outgoing message
+    eth_compressed: bool = False     # compress on the wire
+    remote_stream: bool = False      # deliver to peer's stream, not rx pool
+    blocking: bool = True
+    mode_label: str = ""             # firmware address-mode annotation
+
+
+def _seg_elems(arithcfg: ArithConfig, max_segment_size: int,
+               eth_compressed: bool) -> int:
+    """Elements per wire segment.
+
+    Parity: the firmware computes segment element count from
+    max_segment_size / elem bytes, using the *wire* element size when the
+    message is compressed (broadcast, ccl_offload_control.c:530-535).
+    """
+    elem = (arithcfg.compressed_elem_bytes if eth_compressed
+            else arithcfg.uncompressed_elem_bytes)
+    return max(1, max_segment_size // max(1, elem))
+
+
+def _segments(count: int, seg: int) -> Iterator[tuple[int, int]]:
+    """Yield (offset_elems, nelems) chunks of a count."""
+    off = 0
+    while off < count:
+        n = min(seg, count - off)
+        yield off, n
+        off += n
+
+
+@dataclasses.dataclass
+class MoveContext:
+    """Everything an expansion needs besides the call itself."""
+
+    world_size: int
+    local_rank: int
+    arithcfg: ArithConfig
+    max_segment_size: int
+
+    def ebytes(self, compressed: bool = False) -> int:
+        return (self.arithcfg.compressed_elem_bytes if compressed
+                else self.arithcfg.uncompressed_elem_bytes)
+
+
+# ---------------------------------------------------------------------------
+# Primitives (parity: ccl_offload_control.c:301-500)
+# ---------------------------------------------------------------------------
+
+def expand_copy(ctx: MoveContext, count: int, src: int, dst: int,
+                compression: Compression = Compression.NONE,
+                stream: StreamFlags = StreamFlags.NO_STREAM) -> list[Move]:
+    """copy (c:301-315): one local move op0->res."""
+    op0 = (Operand.stream() if stream & StreamFlags.OP0_STREAM
+           else Operand.imm(src, bool(compression & Compression.OP0_COMPRESSED)))
+    res = (Operand.stream() if stream & StreamFlags.RES_STREAM
+           else Operand.imm(dst, bool(compression & Compression.RES_COMPRESSED)))
+    return [Move(count=count, op0=op0, res=res, res_local=True,
+                 mode_label="IMMEDIATE/NONE/IMMEDIATE")]
+
+
+def expand_combine(ctx: MoveContext, count: int, func: ReduceFunc,
+                   op0: int, op1: int, dst: int,
+                   compression: Compression = Compression.NONE) -> list[Move]:
+    """combine (c:319-335): res = func(op0, op1) locally."""
+    return [Move(
+        count=count,
+        op0=Operand.imm(op0, bool(compression & Compression.OP0_COMPRESSED)),
+        op1=Operand.imm(op1, bool(compression & Compression.OP1_COMPRESSED)),
+        res=Operand.imm(dst, bool(compression & Compression.RES_COMPRESSED)),
+        func=func, res_local=True,
+        mode_label="IMMEDIATE/IMMEDIATE/IMMEDIATE")]
+
+
+def expand_send(ctx: MoveContext, count: int, src: int, dst_rank: int,
+                tag: int = 0,
+                compression: Compression = Compression.NONE,
+                stream: StreamFlags = StreamFlags.NO_STREAM,
+                to_remote_stream: bool = False) -> list[Move]:
+    """send (c:339-361): segmented op0 -> remote res.
+
+    Wire compression applies when ETH_COMPRESSED is set; segmentation at
+    max_segment_size like the eth_cmd split (dma_mover.cpp:280-318).
+    """
+    eth_c = bool(compression & Compression.ETH_COMPRESSED)
+    moves = []
+    seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
+    ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    for off, n in _segments(count, seg):
+        op0 = (Operand.stream() if stream & StreamFlags.OP0_STREAM
+               else Operand.imm(src + off * ebytes,
+                                bool(compression & Compression.OP0_COMPRESSED)))
+        moves.append(Move(count=n, op0=op0, res_remote=True,
+                          dst_rank=dst_rank, tag=tag, eth_compressed=eth_c,
+                          remote_stream=to_remote_stream,
+                          mode_label="IMMEDIATE/NONE/REMOTE"))
+    return moves
+
+
+def expand_recv(ctx: MoveContext, count: int, src_rank: int, dst: int,
+                tag: int = 0,
+                compression: Compression = Compression.NONE,
+                stream: StreamFlags = StreamFlags.NO_STREAM) -> list[Move]:
+    """recv (c:365-380): segmented ON_RECV -> local res."""
+    eth_c = bool(compression & Compression.ETH_COMPRESSED)
+    moves = []
+    seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
+    ebytes = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    for off, n in _segments(count, seg):
+        res = (Operand.stream() if stream & StreamFlags.RES_STREAM
+               else Operand.imm(dst + off * ebytes,
+                                bool(compression & Compression.RES_COMPRESSED)))
+        moves.append(Move(count=n, op1=Operand.on_recv(src_rank, tag),
+                          res=res, res_local=True, eth_compressed=eth_c,
+                          mode_label="NONE/ON_RECV/IMMEDIATE"))
+    return moves
+
+
+def expand_fused_recv_reduce(ctx: MoveContext, count: int, func: ReduceFunc,
+                             src_rank: int, op0: int, dst: int, tag: int = 0,
+                             compression: Compression = Compression.NONE,
+                             ) -> list[Move]:
+    """fused_recv_reduce (c:441-467): res = func(op0, incoming)."""
+    eth_c = bool(compression & Compression.ETH_COMPRESSED)
+    seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
+    e0 = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    er = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    moves = []
+    for off, n in _segments(count, seg):
+        moves.append(Move(
+            count=n,
+            op0=Operand.imm(op0 + off * e0,
+                            bool(compression & Compression.OP0_COMPRESSED)),
+            op1=Operand.on_recv(src_rank, tag),
+            res=Operand.imm(dst + off * er,
+                            bool(compression & Compression.RES_COMPRESSED)),
+            func=func, res_local=True, eth_compressed=eth_c,
+            mode_label="IMMEDIATE/ON_RECV/IMMEDIATE"))
+    return moves
+
+
+def expand_fused_recv_reduce_send(ctx: MoveContext, count: int,
+                                  func: ReduceFunc, src_rank: int,
+                                  dst_rank: int, op0: int, tag: int = 0,
+                                  dst: int | None = None,
+                                  compression: Compression = Compression.NONE,
+                                  ) -> list[Move]:
+    """fused_recv_reduce_send (c:473-500): func(op0, incoming) -> peer
+    (and optionally also to local dst — the RES_REMOTE|RES_LOCAL form used
+    by allreduce phase 1, c:993-1023)."""
+    eth_c = bool(compression & Compression.ETH_COMPRESSED)
+    seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
+    e0 = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    er = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    moves = []
+    for off, n in _segments(count, seg):
+        res = (Operand.imm(dst + off * er,
+                           bool(compression & Compression.RES_COMPRESSED))
+               if dst is not None else Operand.none())
+        moves.append(Move(
+            count=n,
+            op0=Operand.imm(op0 + off * e0,
+                            bool(compression & Compression.OP0_COMPRESSED)),
+            op1=Operand.on_recv(src_rank, tag),
+            res=res, func=func,
+            res_remote=True, res_local=dst is not None,
+            dst_rank=dst_rank, tag=tag, eth_compressed=eth_c,
+            mode_label="IMMEDIATE/ON_RECV/REMOTE(+LOCAL)"))
+    return moves
+
+
+# ---------------------------------------------------------------------------
+# Collectives (parity: ccl_offload_control.c:502-1098)
+# ---------------------------------------------------------------------------
+
+def expand_broadcast(ctx: MoveContext, count: int, root: int, buf: int,
+                     compression: Compression = Compression.NONE) -> list[Move]:
+    """broadcast (c:507-571): root sends each segment to every peer
+    (firmware: IMMEDIATE then MOVE_REPEAT to reuse the segment); non-root
+    receives segments in order."""
+    moves: list[Move] = []
+    eth_c = bool(compression & Compression.ETH_COMPRESSED)
+    seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
+    ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    if ctx.local_rank == root:
+        for off, n in _segments(count, seg):
+            first = True
+            for r in range(ctx.world_size):
+                if r == root:
+                    continue
+                moves.append(Move(
+                    count=n,
+                    op0=Operand.imm(buf + off * ebytes,
+                                    bool(compression & Compression.OP0_COMPRESSED)),
+                    res_remote=True, dst_rank=r, tag=TAG_ANY,
+                    eth_compressed=eth_c, blocking=False,
+                    mode_label="IMMEDIATE" if first else "REPEAT"))
+                first = False
+    else:
+        moves += expand_recv(ctx, count, root, buf, tag=TAG_ANY,
+                             compression=compression)
+    return moves
+
+
+def expand_scatter(ctx: MoveContext, count: int, root: int, src: int,
+                   dst: int,
+                   compression: Compression = Compression.NONE) -> list[Move]:
+    """scatter (c:575-627): root strided round-robin sends + local copy of
+    its own chunk; non-root receives ``count`` elements. ``count`` is the
+    per-rank chunk size (reference semantics)."""
+    moves: list[Move] = []
+    ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    if ctx.local_rank == root:
+        for r in range(ctx.world_size):
+            chunk = src + r * count * ebytes
+            if r == root:
+                moves += expand_copy(ctx, count, chunk, dst, compression)
+                moves[-1].mode_label = "INCREMENT(local-copy)"
+            else:
+                sends = expand_send(ctx, count, chunk, r, tag=TAG_ANY,
+                                    compression=compression)
+                for m in sends:
+                    m.blocking = False
+                    m.mode_label = "INCREMENT(rr-send)"
+                moves += sends
+    else:
+        moves += expand_recv(ctx, count, root, dst, tag=TAG_ANY,
+                             compression=compression)
+    return moves
+
+
+def expand_gather_ring(ctx: MoveContext, count: int, root: int, src: int,
+                       dst: int,
+                       compression: Compression = Compression.NONE) -> list[Move]:
+    """gather, ring algorithm (c:632-724): non-root sends its chunk to the
+    previous ring neighbor toward root, then relays ``dist-1`` incoming
+    chunks; root receives ``world_size-1`` chunks from its next neighbor
+    into reverse-ring strided slots plus a local copy of its own."""
+    W, me = ctx.world_size, ctx.local_rank
+    ebytes = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    moves: list[Move] = []
+    # distance from root along the ring (how many hops my data travels)
+    dist = (me - root) % W
+    prev_in_ring = (me + 1) % W   # data flows decreasing-rank toward root
+    next_toward_root = (me - 1) % W
+    if me == root:
+        moves += expand_copy(ctx, count, src, dst + me * count * ebytes,
+                             compression)
+        for i in range(W - 1):
+            # chunk arriving i-th belongs to rank (root+1+i) ... relayed in
+            # arrival order from the next ring neighbor
+            owner = (root + 1 + i) % W
+            moves += expand_recv(ctx, count, prev_in_ring,
+                                 dst + owner * count * ebytes, tag=TAG_ANY,
+                                 compression=compression)
+    else:
+        moves += expand_send(ctx, count, src, next_toward_root, tag=TAG_ANY,
+                             compression=compression)
+        # relay the chunks of the (W-1-dist) ranks farther from root
+        relay_buf = dst  # non-root dst is scratch (reference reuses rx path)
+        for _ in range(W - 1 - dist):
+            moves += expand_recv(ctx, count, prev_in_ring, relay_buf,
+                                 tag=TAG_ANY, compression=compression)
+            moves += expand_send(ctx, count, relay_buf, next_toward_root,
+                                 tag=TAG_ANY, compression=compression)
+    return moves
+
+
+def expand_allgather_ring(ctx: MoveContext, count: int, src: int, dst: int,
+                          compression: Compression = Compression.NONE
+                          ) -> list[Move]:
+    """allgather, ring (c:727-828): copy own chunk into its slot, send it to
+    the next neighbor, then W-1 × {blocking recv into the originating
+    rank's slot, relay onward}. The recv must retire before the relay reads
+    the slot — the reference's explicit RAW-race note (c:788-791)."""
+    W, me = ctx.world_size, ctx.local_rank
+    ebytes = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    nxt, prv = (me + 1) % W, (me - 1) % W
+    moves: list[Move] = []
+    moves += expand_copy(ctx, count, src, dst + me * count * ebytes,
+                         compression)
+    moves += expand_send(ctx, count, src, nxt, tag=TAG_ANY,
+                         compression=compression)
+    for i in range(W - 1):
+        owner = (me - 1 - i) % W
+        slot = dst + owner * count * ebytes
+        rx = expand_recv(ctx, count, prv, slot, tag=TAG_ANY,
+                         compression=compression)
+        for m in rx:
+            m.blocking = True  # RAW hazard vs the relay below (c:788-791)
+        moves += rx
+        if i < W - 2:
+            moves += expand_send(ctx, count, slot, nxt, tag=TAG_ANY,
+                                 compression=compression)
+    return moves
+
+
+def expand_reduce_ring(ctx: MoveContext, count: int, root: int, func: ReduceFunc,
+                       src: int, dst: int,
+                       compression: Compression = Compression.NONE
+                       ) -> list[Move]:
+    """reduce, ring daisy chain (c:832-856): the rank after root plain-sends;
+    middle ranks fused-recv-reduce-send; root fused-recv-reduces into dst."""
+    W, me = ctx.world_size, ctx.local_rank
+    nxt, prv = (me - 1) % W, (me + 1) % W  # data flows toward root
+    moves: list[Move] = []
+    if W == 1:
+        return expand_copy(ctx, count, src, dst, compression)
+    if (me - root) % W == W - 1:
+        # farthest rank starts the chain
+        moves += expand_send(ctx, count, src, nxt, tag=TAG_ANY,
+                             compression=compression)
+    elif me == root:
+        moves += expand_fused_recv_reduce(ctx, count, func, prv, src, dst,
+                                          tag=TAG_ANY, compression=compression)
+    else:
+        moves += expand_fused_recv_reduce_send(ctx, count, func, prv, nxt,
+                                               src, tag=TAG_ANY,
+                                               compression=compression)
+    return moves
+
+
+def expand_reduce_scatter_ring(ctx: MoveContext, count: int, func: ReduceFunc,
+                               src: int, dst: int,
+                               compression: Compression = Compression.NONE
+                               ) -> list[Move]:
+    """reduce_scatter, ring (c:860-939): send your (me+1)'th chunk, then for
+    W-1 rounds fused recv+reduce+forward walking chunks backwards; the last
+    round reduces into local dst (your own chunk). ``count`` is the
+    per-rank chunk size."""
+    W, me = ctx.world_size, ctx.local_rank
+    ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    nxt, prv = (me - 1) % W, (me + 1) % W
+    moves: list[Move] = []
+    if W == 1:
+        return expand_copy(ctx, count, src, dst, compression)
+    first_chunk = (me + 1) % W
+    moves += expand_send(ctx, count, src + first_chunk * count * ebytes, nxt,
+                         tag=TAG_ANY, compression=compression)
+    for i in range(1, W):
+        # flow is toward decreasing rank, so at round i the partial arriving
+        # from prv=(me+1) is for chunk (me+1+i); the final round's chunk is
+        # my own (me+W = me), saved locally — matching the reference's
+        # "last iteration saves locally" (c:860-939).
+        chunk = (me + 1 + i) % W
+        op0 = src + chunk * count * ebytes
+        if i < W - 1:
+            moves += expand_fused_recv_reduce_send(
+                ctx, count, func, prv, nxt, op0, tag=TAG_ANY,
+                compression=compression)
+        else:
+            # final round: chunk == me; reduce into local dst
+            moves += expand_fused_recv_reduce(
+                ctx, count, func, prv, op0, dst, tag=TAG_ANY,
+                compression=compression)
+    return moves
+
+
+def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
+                          src: int, dst: int,
+                          compression: Compression = Compression.NONE
+                          ) -> list[Move]:
+    """allreduce = fused ring reduce-scatter phase + ring allgather phase
+    (c:942-1098). ``count`` is the *total* element count; chunking into W
+    near-equal chunks with a bulk/tail split like the firmware
+    (c:966-967)."""
+    W, me = ctx.world_size, ctx.local_rank
+    if W == 1:
+        return expand_copy(ctx, count, src, dst, compression)
+    ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    bulk = count // W
+    tail = count - bulk * (W - 1)  # last chunk absorbs the remainder
+
+    def chunk_off(c: int) -> int:
+        return c * bulk * ebytes
+
+    def chunk_len(c: int) -> int:
+        return tail if c == W - 1 else bulk
+
+    nxt, prv = (me - 1) % W, (me + 1) % W
+    moves: list[Move] = []
+
+    # --- phase 1: ring reduce-scatter over chunks (c:982-1023) ---
+    c0 = (me + 1) % W
+    if chunk_len(c0):
+        moves += expand_send(ctx, chunk_len(c0), src + chunk_off(c0), nxt,
+                             tag=TAG_ANY, compression=compression)
+    for i in range(1, W):
+        c = (me + 1 + i) % W  # decreasing-rank flow: see reduce_scatter
+        if not chunk_len(c):
+            continue
+        if i < W - 1:
+            moves += expand_fused_recv_reduce_send(
+                ctx, chunk_len(c), func, prv, nxt, src + chunk_off(c),
+                tag=TAG_ANY, compression=compression)
+        else:
+            # c == me: own fully-reduced chunk lands in dst
+            moves += expand_fused_recv_reduce(
+                ctx, chunk_len(c), func, prv, src + chunk_off(c),
+                dst + chunk_off(c), tag=TAG_ANY, compression=compression)
+
+    # --- phase 2: ring allgather of reduced chunks from dst (c:1031-1095) ---
+    if chunk_len(me):
+        moves += expand_send(ctx, chunk_len(me), dst + chunk_off(me), nxt,
+                             tag=TAG_ANY, compression=compression)
+    for i in range(1, W):
+        c = (me + i) % W  # decreasing-rank flow: chunk me+i arrives at round i
+        if not chunk_len(c):
+            continue
+        slot = dst + chunk_off(c)
+        rx = expand_recv(ctx, chunk_len(c), prv, slot, tag=TAG_ANY,
+                         compression=compression)
+        for m in rx:
+            m.blocking = True  # relay reads the slot next (c:1058-1061)
+        moves += rx
+        if i < W - 1:
+            moves += expand_send(ctx, chunk_len(c), slot, nxt, tag=TAG_ANY,
+                                 compression=compression)
+    return moves
+
+
+def expand_alltoall(ctx: MoveContext, count: int, src: int, dst: int,
+                    compression: Compression = Compression.NONE) -> list[Move]:
+    """alltoall (capability extension; the reference reserves the op in its
+    XRT enums): rank r sends chunk d to rank d and receives chunk s from
+    every s. ``count`` is the per-pair chunk size."""
+    W, me = ctx.world_size, ctx.local_rank
+    ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    moves: list[Move] = []
+    moves += expand_copy(ctx, count, src + me * count * ebytes,
+                         dst + me * count * ebytes, compression)
+    # round-robin schedule avoiding head-of-line blocking
+    for step in range(1, W):
+        to = (me + step) % W
+        frm = (me - step) % W
+        sends = expand_send(ctx, count, src + to * count * ebytes, to,
+                            tag=TAG_ANY, compression=compression)
+        for m in sends:
+            m.blocking = False
+        moves += sends
+        moves += expand_recv(ctx, count, frm, dst + frm * count * ebytes,
+                             tag=TAG_ANY, compression=compression)
+    return moves
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+
+def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
+                root_src_dst: int = 0, func: ReduceFunc = ReduceFunc.SUM,
+                tag: int = TAG_ANY, addr_0: int = 0, addr_1: int = 0,
+                addr_2: int = 0,
+                compression: Compression = Compression.NONE,
+                stream: StreamFlags = StreamFlags.NO_STREAM) -> list[Move]:
+    """Dispatch a call descriptor to its expansion.
+
+    Parity: the firmware's run_accl() switch (ccl_offload_control.c:1155-1296).
+    addr_0 = op0/src buffer, addr_1 = op1 buffer, addr_2 = result buffer.
+    """
+    if scenario == CCLOp.nop:
+        return []
+    if scenario == CCLOp.copy:
+        return expand_copy(ctx, count, addr_0, addr_2, compression, stream)
+    if scenario == CCLOp.combine:
+        return expand_combine(ctx, count, func, addr_0, addr_1, addr_2,
+                              compression)
+    if scenario == CCLOp.send:
+        # RES_STREAM on a send targets the peer's stream port instead of its
+        # rx pool (remote-stream send, dma_mover.cpp:303).
+        return expand_send(ctx, count, addr_0, root_src_dst, tag, compression,
+                           stream,
+                           to_remote_stream=bool(stream & StreamFlags.RES_STREAM))
+    if scenario == CCLOp.recv:
+        return expand_recv(ctx, count, root_src_dst, addr_2, tag, compression,
+                           stream)
+    if scenario == CCLOp.bcast:
+        return expand_broadcast(ctx, count, root_src_dst, addr_0, compression)
+    if scenario == CCLOp.scatter:
+        return expand_scatter(ctx, count, root_src_dst, addr_0, addr_2,
+                              compression)
+    if scenario == CCLOp.gather:
+        return expand_gather_ring(ctx, count, root_src_dst, addr_0, addr_2,
+                                  compression)
+    if scenario == CCLOp.reduce:
+        return expand_reduce_ring(ctx, count, root_src_dst, func, addr_0,
+                                  addr_2, compression)
+    if scenario == CCLOp.allgather:
+        return expand_allgather_ring(ctx, count, addr_0, addr_2, compression)
+    if scenario == CCLOp.allreduce:
+        return expand_allreduce_ring(ctx, count, func, addr_0, addr_2,
+                                     compression)
+    if scenario == CCLOp.reduce_scatter:
+        return expand_reduce_scatter_ring(ctx, count, func, addr_0, addr_2,
+                                          compression)
+    if scenario == CCLOp.alltoall:
+        return expand_alltoall(ctx, count, addr_0, addr_2, compression)
+    raise NotImplementedError(f"scenario {scenario!r}")

@@ -1,0 +1,44 @@
+"""Test harness helpers: spin up an N-rank emulated world in-process.
+
+Parity: the reference test story launches N emulator processes under mpirun
+and drives each from a Python test process (test/host/test_all.py). The
+in-process equivalent here gives the same multi-rank semantics with threads,
+for fast unit tests; the socket-daemon tier (emulator/daemon.py) covers the
+true multi-process story.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+from typing import Callable, Sequence
+
+from .accl import ACCL
+from .communicator import Communicator, Rank
+from .constants import DEFAULT_MAX_SEGMENT_SIZE
+from .device.emu import EmuContext
+
+
+def emu_world(world_size: int, nbufs: int = 16, bufsize: int = 1 << 16,
+              timeout: float = 20.0,
+              max_segment_size: int | None = None) -> list[ACCL]:
+    """Create ``world_size`` ACCL instances sharing an in-process fabric."""
+    ctx = EmuContext(world_size, nbufs=nbufs, bufsize=bufsize)
+    max_seg = min(bufsize, max_segment_size or DEFAULT_MAX_SEGMENT_SIZE)
+    accls = []
+    for r in range(world_size):
+        comm = Communicator(
+            ranks=[Rank() for _ in range(world_size)], local_rank=r)
+        accls.append(ACCL(ctx.device(r), comm, timeout=timeout,
+                          max_segment_size=max_seg))
+    return accls
+
+
+def run_ranks(accls: Sequence[ACCL], fn: Callable[[ACCL], object],
+              timeout: float = 60.0) -> list[object]:
+    """Run ``fn(accl)`` concurrently on every rank; propagate the first
+    exception. This is the SPMD test driver (each thread = one MPI rank of
+    the reference's mpirun world)."""
+    with concurrent.futures.ThreadPoolExecutor(len(accls)) as pool:
+        futs = [pool.submit(fn, a) for a in accls]
+        return [f.result(timeout) for f in futs]

@@ -1,0 +1,74 @@
+"""Unit tests for the core types layer (constants, arith, buffer, comm)."""
+
+import numpy as np
+import pytest
+
+from accl_tpu import (ACCLError, ArithConfig, Communicator, Compression,
+                      ErrorCode, Rank, ReduceFunc, decode_error,
+                      resolve_arith_config)
+from accl_tpu.buffer import ACCLBuffer
+
+
+def test_error_decode_roundtrip():
+    word = int(ErrorCode.DMA_MISMATCH_ERROR | ErrorCode.RECEIVE_TIMEOUT_ERROR)
+    errs = decode_error(word)
+    assert ErrorCode.DMA_MISMATCH_ERROR in errs
+    assert ErrorCode.RECEIVE_TIMEOUT_ERROR in errs
+    assert len(errs) == 2
+    exc = ACCLError(word, "allreduce")
+    assert "RECEIVE_TIMEOUT_ERROR" in str(exc)
+
+
+def test_arith_resolution_single_dtype():
+    cfg = resolve_arith_config({np.dtype("float32")})
+    assert cfg.uncompressed_dtype == np.float32
+    assert not cfg.is_compressing
+    assert cfg.wire_dtype(Compression.NONE) == np.float32
+
+
+def test_arith_resolution_pair():
+    cfg = resolve_arith_config({np.dtype("float32"), np.dtype("float16")})
+    assert cfg.uncompressed_dtype == np.float32
+    assert cfg.compressed_dtype == np.float16
+    assert cfg.wire_dtype(Compression.ETH_COMPRESSED) == np.float16
+
+
+def test_arith_resolution_bf16():
+    import ml_dtypes
+    cfg = resolve_arith_config({np.dtype("float32"),
+                                np.dtype(ml_dtypes.bfloat16)})
+    assert cfg.compressed_elem_bytes == 2
+
+
+def test_arith_unknown_pair_raises():
+    with pytest.raises(KeyError):
+        resolve_arith_config({np.dtype("float64"), np.dtype("int8")})
+
+
+def test_buffer_slicing_addresses():
+    buf = ACCLBuffer((16,), np.float32)
+    sub = buf[4:8]
+    assert sub.address == buf.address + 16
+    sub.data[:] = 7.0
+    assert np.all(buf.data[4:8] == 7.0)
+    assert buf.address % 4096 == 0
+
+
+def test_buffer_unique_addresses():
+    a = ACCLBuffer((1024,), np.float64)
+    b = ACCLBuffer((4,), np.int8)
+    assert (b.address >= a.address + a.nbytes or
+            a.address >= b.address + 1)
+
+
+def test_communicator_split():
+    comm = Communicator(ranks=[Rank() for _ in range(8)], local_rank=3)
+    sub = comm.split([1, 3, 5])
+    assert sub.size == 3
+    assert sub.local_rank == 1
+    assert comm.next_rank() == 4 and comm.prev_rank() == 2
+    assert "size=8" in comm.describe()
+
+
+def test_reduce_funcs_complete():
+    assert {f.name for f in ReduceFunc} == {"SUM", "MAX", "MIN", "PROD"}

@@ -1,0 +1,126 @@
+"""Move-engine expansion tests: structural properties of the micro-op
+programs, checked against the reference algorithms' shapes
+(ccl_offload_control.c:502-1098)."""
+
+import numpy as np
+
+from accl_tpu.arith import DEFAULT_ARITH_CONFIGS
+from accl_tpu.constants import CCLOp, Compression, ReduceFunc
+from accl_tpu.moveengine import (MoveContext, MoveMode, expand_call)
+
+
+F32 = DEFAULT_ARITH_CONFIGS[("float32", "float32")]
+F32F16 = DEFAULT_ARITH_CONFIGS[("float32", "float16")]
+
+
+def ctx(world=4, rank=0, seg=1 << 20, cfg=F32):
+    return MoveContext(world_size=world, local_rank=rank, arithcfg=cfg,
+                       max_segment_size=seg)
+
+
+def test_send_segmentation():
+    # 10 elements with a 16-byte segment => 3 moves of 4+4+2 fp32 elems
+    moves = expand_call(ctx(seg=16), CCLOp.send, count=10, root_src_dst=1,
+                        addr_0=0)
+    assert [m.count for m in moves] == [4, 4, 2]
+    assert all(m.res_remote and m.dst_rank == 1 for m in moves)
+    # segment addresses advance by segment bytes
+    assert [m.op0.addr for m in moves] == [0, 16, 32]
+
+
+def test_send_compressed_segmentation():
+    # wire dtype fp16: segment element count doubles
+    moves = expand_call(ctx(seg=16, cfg=F32F16), CCLOp.send, count=10,
+                        root_src_dst=1, addr_0=0,
+                        compression=Compression.ETH_COMPRESSED)
+    assert [m.count for m in moves] == [8, 2]
+    assert all(m.eth_compressed for m in moves)
+
+
+def test_bcast_root_sends_to_all_peers():
+    moves = expand_call(ctx(world=4, rank=2), CCLOp.bcast, count=8,
+                        root_src_dst=2, addr_0=0)
+    assert len(moves) == 3
+    assert sorted(m.dst_rank for m in moves) == [0, 1, 3]
+    # firmware reuses the segment: first IMMEDIATE then REPEAT
+    assert moves[0].mode_label == "IMMEDIATE"
+    assert all(m.mode_label == "REPEAT" for m in moves[1:])
+
+
+def test_bcast_nonroot_receives():
+    moves = expand_call(ctx(world=4, rank=1), CCLOp.bcast, count=8,
+                        root_src_dst=2, addr_0=0x100)
+    assert len(moves) == 1
+    assert moves[0].op1.mode == MoveMode.ON_RECV
+    assert moves[0].op1.src_rank == 2
+
+
+def test_scatter_root_strides():
+    moves = expand_call(ctx(world=4, rank=0), CCLOp.scatter, count=4,
+                        root_src_dst=0, addr_0=0, addr_2=0x1000)
+    # 1 local copy + 3 sends, strided by count*4 bytes
+    sends = [m for m in moves if m.res_remote]
+    assert len(sends) == 3
+    assert sorted(m.op0.addr for m in sends) == [16, 32, 48]
+
+
+def test_gather_ring_relay_counts():
+    # rank at distance d from root relays W-1-d chunks
+    for rank, relays in [(1, 2), (2, 1), (3, 0)]:
+        moves = expand_call(ctx(world=4, rank=rank), CCLOp.gather, count=4,
+                            root_src_dst=0, addr_0=0, addr_2=0x1000)
+        sends = [m for m in moves if m.res_remote]
+        assert len(sends) == 1 + relays
+
+
+def test_allreduce_phases():
+    W = 4
+    moves = expand_call(ctx(world=W, rank=1), CCLOp.allreduce, count=16,
+                        func=ReduceFunc.SUM, addr_0=0, addr_2=0x1000)
+    fused = [m for m in moves
+             if m.func is not None and m.op1.mode == MoveMode.ON_RECV]
+    # phase 1: W-1 fused recv-reduce(-send) steps
+    assert len(fused) == W - 1
+    # final fused step writes locally into dst, not remote
+    assert fused[-1].res_local and not fused[-1].res_remote
+    # phase 2 allgather: W-1 plain receives
+    plain_rx = [m for m in moves
+                if m.func is None and m.op1.mode == MoveMode.ON_RECV]
+    assert len(plain_rx) == W - 1
+    assert all(m.blocking for m in plain_rx)  # RAW hazard (c:788-791)
+
+
+def test_allreduce_uneven_tail():
+    # count=10, W=4: bulk=2, tail=4 — every element covered exactly once
+    moves = expand_call(ctx(world=4, rank=0), CCLOp.allreduce, count=10,
+                        addr_0=0, addr_2=0x1000)
+    sends = [m for m in moves if m.res_remote]
+    assert all(m.count in (2, 4) for m in sends)
+
+
+def test_reduce_roles():
+    W = 4
+    root = 1
+    for rank in range(W):
+        moves = expand_call(ctx(world=W, rank=rank), CCLOp.reduce, count=8,
+                            root_src_dst=root, addr_0=0, addr_2=0x1000)
+        if rank == root:
+            assert all(m.func is not None and not m.res_remote for m in moves)
+        elif (rank - root) % W == W - 1:
+            assert all(m.func is None and m.res_remote for m in moves)
+        else:
+            assert all(m.func is not None and m.res_remote for m in moves)
+
+
+def test_alltoall_coverage():
+    W = 4
+    moves = expand_call(ctx(world=W, rank=2), CCLOp.alltoall, count=2,
+                        addr_0=0, addr_2=0x1000)
+    sends = {m.dst_rank for m in moves if m.res_remote}
+    recvs = {m.op1.src_rank for m in moves if m.op1.mode == MoveMode.ON_RECV}
+    assert sends == {0, 1, 3}
+    assert recvs == {0, 1, 3}
+
+
+def test_nop_empty():
+    assert expand_call(ctx(), CCLOp.nop, count=0) == []
