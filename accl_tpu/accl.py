@@ -42,7 +42,7 @@ class ACCL:
 
     def __init__(self, device: Device, comm: Communicator,
                  timeout: float = 30.0,
-                 max_segment_size: int = DEFAULT_MAX_SEGMENT_SIZE,
+                 max_segment_size: int | None = None,
                  arith_registry=None):
         self.device = device
         self.arith_registry = (arith_registry if arith_registry is not None
@@ -51,6 +51,8 @@ class ACCL:
         device.set_timeout(timeout)
         device.configure_communicator(comm)
         self.communicators.append(comm)
+        if max_segment_size is None:
+            max_segment_size = device.preferred_segment_size()
         device.set_max_segment_size(max_segment_size)
         self._barrier_buf: ACCLBuffer | None = None
         self._scratch_bufs: dict[tuple[int, str], ACCLBuffer] = {}

@@ -83,6 +83,10 @@ class ACCLBuffer:
         view = self.data[key]
         if view.base is None and view is not self.data:
             raise ValueError("buffer slices must be views (no fancy indexing)")
+        if not view.flags["C_CONTIGUOUS"]:
+            raise ValueError(
+                "buffer slices must be contiguous (the device address model "
+                "transfers flat byte ranges); use a copy for strided access")
         offset = view.__array_interface__["data"][0] - \
             self.data.__array_interface__["data"][0]
         return ACCLBuffer(view.shape, view.dtype, device=self.device,

@@ -90,7 +90,11 @@ class Communicator:
         Parity: the reference's driver can write multiple communicators into
         exchange memory (split capability exercised by multi-CCLO tests).
         """
-        sub = [dataclasses.replace(self.ranks[m]) for m in members]
+        # fresh sequence counters: seqn matching is scoped per comm_id, so a
+        # sub-comm must start at 0 on every member regardless of world-comm
+        # traffic in flight at split time
+        sub = [dataclasses.replace(self.ranks[m], inbound_seq=0,
+                                   outbound_seq=0) for m in members]
         if new_local is None:
             if self.local_rank not in members:
                 raise ValueError("local rank not in sub-communicator")

@@ -181,7 +181,7 @@ def decode_error(error_word: int) -> list[ErrorCode]:
 # Default sizing knobs; parity with reference constants
 # (ccl_offload_control.h:50-55): max pkt 1536B, 1MiB segments, 8MiB DMA BTT.
 DEFAULT_MAX_SEGMENT_SIZE = 1 << 20          # 1 MiB, like MAX_SEG_SIZE
-DEFAULT_RX_BUFFER_SIZE = 16 << 10           # spare rx buffer bytes
+DEFAULT_RX_BUFFER_SIZE = 64 << 10           # spare rx buffer bytes
 DEFAULT_RX_BUFFER_COUNT = 16
 DEFAULT_TIMEOUT_S = 30.0
 TAG_ANY = 0xFFFFFFFF                        # reference uses tag=ANY sentinel

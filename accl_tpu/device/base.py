@@ -50,6 +50,13 @@ class Device(abc.ABC):
     @abc.abstractmethod
     def set_max_segment_size(self, nbytes: int): ...
 
+    def preferred_segment_size(self) -> int:
+        """Largest segment this backend can accept; the driver defaults the
+        max segment size to this at init (reference: the driver sets
+        max_segment_size = rx bufsize at bring-up, accl.py:380)."""
+        from ..constants import DEFAULT_MAX_SEGMENT_SIZE
+        return DEFAULT_MAX_SEGMENT_SIZE
+
     def soft_reset(self):
         """Parity: HOUSEKEEP_SWRST (ccl_offload_control.c:1244-1247)."""
 

@@ -41,14 +41,10 @@ class EmuContext:
 
     def device(self, rank: int) -> "EmuDevice":
         if self.devices[rank] is None:
-            self.devices[rank] = EmuDevice(self, rank)
+            dev = EmuDevice(self, rank)
+            self.devices[rank] = dev
+            self.fabric.attach(rank, dev.ingest)
         return self.devices[rank]
-
-    def _route(self, env: Envelope, payload: bytes):
-        dev = self.devices[env.dst]
-        if dev is None:
-            raise RuntimeError(f"rank {env.dst} not attached")
-        dev.ingest(env, payload)
 
 
 class EmuDevice(Device):
@@ -62,7 +58,7 @@ class EmuDevice(Device):
         self.comms: dict[int, Communicator] = {}
         self.comm: Communicator | None = None  # world comm (first configured)
         self.executor = MoveExecutor(self.mem, self.pool,
-                                     send_fn=ctx._route,
+                                     send_fn=ctx.fabric.send,
                                      timeout=DEFAULT_TIMEOUT_S)
         self.timeout = DEFAULT_TIMEOUT_S
         self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
@@ -76,7 +72,7 @@ class EmuDevice(Device):
         if env.strm:
             self.executor.deliver_stream(env, payload)
         else:
-            self.pool.ingest(env, payload)
+            self.pool.ingest(env, payload, timeout=self.timeout)
 
     # -- Device interface --------------------------------------------------
     def register_buffer(self, buf: ACCLBuffer):
@@ -96,6 +92,9 @@ class EmuDevice(Device):
     def set_timeout(self, timeout: float):
         self.timeout = timeout
         self.executor.timeout = timeout
+
+    def preferred_segment_size(self) -> int:
+        return self.ctx.bufsize
 
     def set_max_segment_size(self, nbytes: int):
         if nbytes > self.ctx.bufsize:

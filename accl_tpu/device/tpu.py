@@ -1,0 +1,387 @@
+"""TPU backend: the ACCL call surface executed on a jax device mesh.
+
+Architecture (the survey's "hard part (a)" — two-sided semantics on an SPMD
+substrate): one process is the SPMD controller of all ranks (standard JAX).
+Each rank still gets its own ``TpuDevice`` view + ``ACCL`` driver instance,
+so the same rank-parallel test corpus drives every tier. Cross-rank
+coordination happens in a host-side rendezvous:
+
+* **Collectives** rendezvous all member ranks' calls (matched in per-rank
+  program order, MPI semantics); the last arriving rank executes ONE
+  shard_map program over the mesh (MeshCollectives) and scatters results
+  into every rank's buffer.
+* **send** is eager: the payload is snapshotted and the call completes
+  (reference parity: eager ingress lets send finish before recv posts).
+  **recv** matches pending sends by ``(comm, src, dst, tag)`` + sequence
+  order, then moves the payload through the mesh with a ``ppermute``
+  exchange program.
+
+This driver-compat layer stages through host numpy mirrors, which costs
+host<->device copies per call — it exists for API parity and the test
+corpus. The *performance* path is using :class:`MeshCollectives` (or
+`accl_tpu.parallel` inside your own pjit/shard_map programs) directly on
+jax.Arrays; bench.py measures that path.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..buffer import ACCLBuffer
+from ..call import CallDescriptor, CallHandle
+from ..communicator import Communicator
+from ..constants import (CCLOp, Compression, DEFAULT_MAX_SEGMENT_SIZE,
+                         DEFAULT_TIMEOUT_S, ErrorCode)
+from ..emulator.executor import DeviceMemory
+from ..parallel.collectives import MeshCollectives
+from ..parallel.mesh import make_mesh
+from .base import Device
+
+_COLLECTIVES = {CCLOp.bcast, CCLOp.scatter, CCLOp.gather, CCLOp.reduce,
+                CCLOp.allgather, CCLOp.allreduce, CCLOp.reduce_scatter,
+                CCLOp.alltoall, CCLOp.barrier}
+
+
+class TpuContext:
+    """Shared state of an N-rank TPU-backed world (single SPMD controller)."""
+
+    def __init__(self, world_size: int | None = None, mesh=None,
+                 axis_name: str = "rank", platform: str | None = None,
+                 algorithm: str = "xla"):
+        if mesh is None:
+            mesh = make_mesh((world_size,) if world_size else None,
+                             (axis_name,), platform=platform)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.world_size = mesh.shape[axis_name]
+        self.coll = MeshCollectives(mesh, axis_name)
+        self.algorithm = algorithm
+        self.devices: list[TpuDevice | None] = [None] * self.world_size
+        # rendezvous state
+        self._lock = threading.Condition()
+        # (comm_id, op_index) -> {comm-local rank: desc}
+        self._pending: dict[tuple, dict[int, CallDescriptor]] = {}
+        # (comm_id, op_index) -> [error_word, readers_remaining]
+        self._results: dict[tuple, list[int]] = {}
+        # (comm_id, src_g, dst_g) -> deque of (tag, payload ndarray)
+        self._sends: dict[tuple, collections.deque] = \
+            collections.defaultdict(collections.deque)
+
+    def device(self, rank: int) -> "TpuDevice":
+        if self.devices[rank] is None:
+            self.devices[rank] = TpuDevice(self, rank)
+        return self.devices[rank]
+
+
+class TpuDevice(Device):
+    """One rank's view of the TPU-backed world."""
+
+    def __init__(self, ctx: TpuContext, rank: int):
+        self.ctx = ctx
+        self.rank = rank
+        self.mem = DeviceMemory()          # host mirrors of device buffers
+        self.comms: dict[int, Communicator] = {}
+        self.comm: Communicator | None = None
+        self.timeout = DEFAULT_TIMEOUT_S
+        self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
+        self._coll_index: dict[int, int] = collections.defaultdict(int)
+        self._calls: queue.Queue = queue.Queue()
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name=f"tpu-rank{rank}")
+        self._worker.start()
+
+    # -- Device interface --------------------------------------------------
+    def register_buffer(self, buf: ACCLBuffer):
+        self.mem.register(buf.address, buf.data)
+
+    def deregister_buffer(self, buf: ACCLBuffer):
+        self.mem.deregister(buf.address)
+
+    def configure_communicator(self, comm: Communicator):
+        self.comms[comm.comm_id] = comm
+        if self.comm is None:
+            self.comm = comm
+
+    def set_timeout(self, timeout: float):
+        self.timeout = timeout
+
+    def set_max_segment_size(self, nbytes: int):
+        self.max_segment_size = nbytes
+
+    def call_async(self, desc: CallDescriptor,
+                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+        handle = CallHandle(context=desc.scenario.name)
+        self._calls.put((desc, tuple(waitfor), handle))
+        return handle
+
+    def soft_reset(self):
+        with self.ctx._lock:
+            self.ctx._sends.clear()
+        self._coll_index.clear()
+
+    def deinit(self):
+        self._calls.put(None)
+
+    # -- worker ------------------------------------------------------------
+    def _run(self):
+        from ..constants import ACCLError
+        while True:
+            item = self._calls.get()
+            if item is None:
+                return
+            desc, waitfor, handle = item
+            try:
+                for dep in waitfor:
+                    dep.wait(self.timeout)
+                handle.complete(self._execute(desc))
+            except ACCLError as exc:
+                handle.complete(exc.error_word)
+            except TimeoutError:
+                handle.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR))
+            except Exception:  # noqa: BLE001
+                handle.complete(int(ErrorCode.INVALID_CALL))
+
+    # -- operand staging ---------------------------------------------------
+    def _read_operand(self, addr: int, count: int, desc, which: Compression
+                      ) -> np.ndarray:
+        cfg = desc.arithcfg
+        stored = (cfg.compressed_dtype if desc.compression & which
+                  else cfg.uncompressed_dtype)
+        return self.mem.read(addr, count, stored).astype(
+            cfg.uncompressed_dtype, copy=False)
+
+    def _write_result(self, addr: int, data: np.ndarray, desc):
+        cfg = desc.arithcfg
+        out = (cfg.compressed_dtype
+               if desc.compression & Compression.RES_COMPRESSED
+               else cfg.uncompressed_dtype)
+        self.mem.write(addr, np.asarray(data, dtype=out))
+
+    # -- execution ---------------------------------------------------------
+    def _execute(self, desc: CallDescriptor) -> int:
+        op = desc.scenario
+        if op in (CCLOp.nop, CCLOp.config):
+            return 0
+        comm = self.comms.get(desc.comm_id)
+        if comm is None:
+            return int(ErrorCode.COMM_NOT_CONFIGURED)
+        if op == CCLOp.copy:
+            data = self._read_operand(desc.addr_0, desc.count, desc,
+                                      Compression.OP0_COMPRESSED)
+            self._write_result(desc.addr_2, data, desc)
+            return 0
+        if op == CCLOp.combine:
+            from ..emulator.executor import _REDUCERS
+            a = self._read_operand(desc.addr_0, desc.count, desc,
+                                   Compression.OP0_COMPRESSED)
+            b = self._read_operand(desc.addr_1, desc.count, desc,
+                                   Compression.OP1_COMPRESSED)
+            self._write_result(desc.addr_2, _REDUCERS[desc.function](a, b),
+                               desc)
+            return 0
+        if op == CCLOp.send:
+            return self._do_send(desc, comm)
+        if op == CCLOp.recv:
+            return self._do_recv(desc, comm)
+        if op in _COLLECTIVES:
+            return self._do_collective(desc, comm)
+        return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
+
+    # -- send/recv rendezvous ---------------------------------------------
+    def _do_send(self, desc: CallDescriptor, comm: Communicator) -> int:
+        payload = self._read_operand(desc.addr_0, desc.count, desc,
+                                     Compression.OP0_COMPRESSED)
+        if desc.compression & Compression.ETH_COMPRESSED:
+            payload = payload.astype(desc.arithcfg.compressed_dtype)
+        dst_g = comm.ranks[desc.root_src_dst].global_rank
+        key = (desc.comm_id, comm.my_global_rank, dst_g)
+        with self.ctx._lock:
+            self.ctx._sends[key].append((desc.tag, payload))
+            self.ctx._lock.notify_all()
+        return 0
+
+    def _match_send(self, key: tuple, tag: int):
+        """Pop the oldest pending send matching ``tag`` (TAG_ANY semantics
+        identical to the emulator's RxBufferPool._match). Caller holds the
+        ctx lock."""
+        from ..constants import TAG_ANY
+        pending = self.ctx._sends.get(key)
+        if not pending:
+            return None
+        for i, (stag, payload) in enumerate(pending):
+            if tag == TAG_ANY or stag == tag or stag == TAG_ANY:
+                del pending[i]
+                if not pending:
+                    del self.ctx._sends[key]
+                return payload
+        return None
+
+    def _do_recv(self, desc: CallDescriptor, comm: Communicator) -> int:
+        import time
+        src_g = comm.ranks[desc.root_src_dst].global_rank
+        me_g = comm.my_global_rank
+        key = (desc.comm_id, src_g, me_g)
+        deadline = time.monotonic() + self.timeout
+        with self.ctx._lock:
+            while True:
+                payload = self._match_send(key, desc.tag)
+                if payload is not None:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self.ctx._lock.wait(remaining):
+                    return int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+        # move the payload through the mesh: src row -> dst row ppermute
+        W = self.ctx.world_size
+        x = np.zeros((W, payload.size), payload.dtype)
+        x[src_g] = payload
+        out = self.ctx.coll.exchange(self.ctx.coll.shard(list(x)),
+                                     ((src_g, me_g),))
+        received = np.asarray(out)[me_g].astype(
+            desc.arithcfg.uncompressed_dtype)
+        self._write_result(desc.addr_2, received, desc)
+        return 0
+
+    # -- collective rendezvous --------------------------------------------
+    def _do_collective(self, desc: CallDescriptor, comm: Communicator) -> int:
+        import time
+        idx = self._coll_index[desc.comm_id]
+        self._coll_index[desc.comm_id] += 1
+        key = (desc.comm_id, idx)
+        ctx = self.ctx
+        with ctx._lock:
+            group = ctx._pending.setdefault(key, {})
+            group[comm.local_rank] = desc
+            if len(group) == comm.size:
+                # last arriver executes for everyone
+                try:
+                    err = self._launch(key, comm)
+                except Exception:  # noqa: BLE001
+                    err = int(ErrorCode.INVALID_CALL)
+                del ctx._pending[key]
+                if comm.size > 1:
+                    # [error, readers remaining]; deleted when drained
+                    ctx._results[key] = [err, comm.size - 1]
+                ctx._lock.notify_all()
+                return err
+            deadline = time.monotonic() + self.timeout
+            while key not in ctx._results:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not ctx._lock.wait(remaining):
+                    group.pop(comm.local_rank, None)
+                    return int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+            entry = ctx._results[key]
+            entry[1] -= 1
+            if entry[1] <= 0:
+                del ctx._results[key]
+            return entry[0]
+
+    def _launch(self, key: tuple, comm: Communicator) -> int:
+        """Execute one collective for all ranks (caller holds ctx lock)."""
+        ctx = self.ctx
+        group = ctx._pending[key]
+        descs = [group[r] for r in range(comm.size)]
+        d0 = descs[0]
+        op = d0.scenario
+        if any(d.scenario != op or d.count != d0.count for d in descs):
+            return int(ErrorCode.INVALID_CALL)
+        count = d0.count
+        W = comm.size
+        cfg = d0.arithcfg
+        wire = (cfg.compressed_dtype
+                if d0.compression & Compression.ETH_COMPRESSED else None)
+        devs = [ctx.devices[comm.ranks[r].global_rank] for r in range(W)]
+
+        def read_all(addr_of, n):
+            rows = []
+            for r, d in enumerate(descs):
+                addr = addr_of(d)
+                if addr:
+                    rows.append(devs[r]._read_operand(
+                        addr, n, d, Compression.OP0_COMPRESSED))
+                else:
+                    rows.append(np.zeros(n, cfg.uncompressed_dtype))
+            return rows
+
+        coll, alg = ctx.coll, ctx.algorithm
+        root = d0.root_src_dst
+        if op == CCLOp.barrier:
+            return 0  # rendezvous above IS the barrier
+        if op == CCLOp.allreduce:
+            x = coll.shard(read_all(lambda d: d.addr_0, count))
+            out = np.asarray(coll.allreduce(x, func=d0.function,
+                                            algorithm=alg, wire_dtype=wire))
+            for r, d in enumerate(descs):
+                devs[r]._write_result(d.addr_2, out[r], d)
+            return 0
+        if op == CCLOp.reduce:
+            x = coll.shard(read_all(lambda d: d.addr_0, count))
+            out = np.asarray(coll.reduce(x, root=root, func=d0.function,
+                                         wire_dtype=wire))
+            devs[root]._write_result(descs[root].addr_2, out[root],
+                                     descs[root])
+            return 0
+        if op == CCLOp.reduce_scatter:
+            x = coll.shard(read_all(lambda d: d.addr_0, W * count))
+            out = np.asarray(coll.reduce_scatter(x, func=d0.function,
+                                                 algorithm=alg,
+                                                 wire_dtype=wire))
+            for r, d in enumerate(descs):
+                devs[r]._write_result(d.addr_2, out[r][:count], d)
+            return 0
+        if op == CCLOp.allgather:
+            x = coll.shard(read_all(lambda d: d.addr_0, count))
+            out = np.asarray(coll.allgather(x, algorithm=alg,
+                                            wire_dtype=wire))
+            for r, d in enumerate(descs):
+                devs[r]._write_result(d.addr_2, out[r], d)
+            return 0
+        if op == CCLOp.bcast:
+            x = coll.shard(read_all(lambda d: d.addr_0, count))
+            out = np.asarray(coll.bcast(x, root=root))
+            for r, d in enumerate(descs):
+                if r != root:
+                    devs[r]._write_result(d.addr_0, out[r], d)
+            return 0
+        if op == CCLOp.scatter:
+            x = coll.shard(read_all(lambda d: d.addr_0, W * count))
+            out = np.asarray(coll.scatter(x, root=root))
+            for r, d in enumerate(descs):
+                devs[r]._write_result(d.addr_2, out[r][:count], d)
+            return 0
+        if op == CCLOp.gather:
+            x = coll.shard(read_all(lambda d: d.addr_0, count))
+            out = np.asarray(coll.gather(x, root=root))
+            devs[root]._write_result(descs[root].addr_2, out[root],
+                                     descs[root])
+            return 0
+        if op == CCLOp.alltoall:
+            x = coll.shard(read_all(lambda d: d.addr_0, W * count))
+            out = np.asarray(coll.alltoall(x))
+            for r, d in enumerate(descs):
+                devs[r]._write_result(d.addr_2, out[r], d)
+            return 0
+        return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
+
+
+def tpu_world(world_size: int | None = None, platform: str | None = None,
+              algorithm: str = "xla", timeout: float = DEFAULT_TIMEOUT_S
+              ) -> list:
+    """Create ACCL instances backed by a device mesh (one rank per device).
+
+    The TPU-tier analog of testing.emu_world."""
+    from ..accl import ACCL
+    from ..communicator import Communicator, Rank
+    ctx = TpuContext(world_size, platform=platform, algorithm=algorithm)
+    W = ctx.world_size
+    accls = []
+    for r in range(W):
+        comm = Communicator(ranks=[Rank() for _ in range(W)], local_rank=r)
+        accls.append(ACCL(ctx.device(r), comm, timeout=timeout))
+    return accls

@@ -8,7 +8,7 @@ device memory, over an in-process or socket fabric.
 """
 
 from .executor import DeviceMemory, RxBufferPool, MoveExecutor
-from .fabric import Envelope, LocalFabric, FabricEndpoint
+from .fabric import Envelope, LocalFabric
 
 __all__ = ["DeviceMemory", "RxBufferPool", "MoveExecutor", "Envelope",
-           "LocalFabric", "FabricEndpoint"]
+           "LocalFabric"]

@@ -28,7 +28,7 @@ from ..arith import ArithConfig
 from ..communicator import Communicator
 from ..constants import ErrorCode, ReduceFunc, TAG_ANY
 from ..moveengine import Move, MoveMode, Operand
-from .fabric import Envelope, FabricEndpoint
+from .fabric import Envelope
 
 
 class DeviceMemory:
@@ -99,19 +99,34 @@ class RxBufferPool:
         self._cv = threading.Condition()
         self.error_word = 0
 
-    def ingest(self, env: Envelope, payload: bytes) -> int:
+    def ingest(self, env: Envelope, payload: bytes,
+               timeout: float = 10.0) -> int:
+        """Accept a message into a spare buffer.
+
+        Blocks while the pool is full — modeling the reference's transport
+        backpressure (ingress only DMAs into pre-posted ENQUEUED buffers;
+        TCP flow-controls the sender until rxbuf_enqueue re-posts,
+        rxbuf_enqueue.cpp:23-70). On timeout the message is dropped and the
+        overflow error is latched in ``error_word``.
+        """
+        deadline = time.monotonic() + timeout
         with self._cv:
             if len(payload) > self.bufsize:
                 self.error_word |= int(ErrorCode.DMA_SIZE_ERROR)
                 return int(ErrorCode.DMA_SIZE_ERROR)
-            for b in self.bufs:
-                if b.status == RxBuffer.IDLE:
-                    b.status = RxBuffer.RESERVED
-                    b.env, b.payload = env, payload
-                    self._cv.notify_all()
-                    return 0
-            self.error_word |= int(ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
-            return int(ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+            while True:
+                for b in self.bufs:
+                    if b.status == RxBuffer.IDLE:
+                        b.status = RxBuffer.RESERVED
+                        b.env, b.payload = env, payload
+                        self._cv.notify_all()
+                        return 0
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    self.error_word |= int(
+                        ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+                    return int(
+                        ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
 
     def _match(self, src: int, tag: int, seqn: int,
                comm_id: int) -> RxBuffer | None:
@@ -141,6 +156,7 @@ class RxBufferPool:
                     env, payload = b.env, b.payload
                     b.status = RxBuffer.IDLE          # release back to pool
                     b.env, b.payload = None, b""
+                    self._cv.notify_all()  # wake senders blocked on overflow
                     return env, payload
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cv.wait(remaining):

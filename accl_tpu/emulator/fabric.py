@@ -4,8 +4,8 @@ Parity: the reference's emulation "wire" is ZMQ pub/sub JSON frames between
 rank processes (test/zmq/zmq_intf.cpp:70-164), with a dummy loopback stack
 for single-process tests (kernels/plugins/dummy_tcp_stack). Here:
 
-* :class:`LocalFabric` — N in-process endpoints with locked deques; the
-  loopback tier (fast unit tests, no sockets).
+* :class:`LocalFabric` — in-process callback delivery; the loopback tier
+  (fast unit tests, no sockets).
 * :class:`SocketFabric` (fabric_socket.py) — framed-TCP fabric between rank
   daemon processes; the multi-process tier driven by the same tests.
 
@@ -15,9 +15,7 @@ wire_dtype, strm} + payload (eth_intf.h:41-80 parity).
 
 from __future__ import annotations
 
-import collections
 import dataclasses
-import threading
 
 
 @dataclasses.dataclass
@@ -36,45 +34,26 @@ class Envelope:
     comm_id: int = 0       # communicator scope for seqn matching
 
 
-class FabricEndpoint:
-    """One rank's attachment to a fabric: an inbound queue with notification."""
-
-    def __init__(self, rank: int):
-        self.rank = rank
-        self._queue: collections.deque[tuple[Envelope, bytes]] = collections.deque()
-        self._cv = threading.Condition()
-
-    def deliver(self, env: Envelope, payload: bytes):
-        with self._cv:
-            self._queue.append((env, payload))
-            self._cv.notify_all()
-
-    def poll(self) -> tuple[Envelope, bytes] | None:
-        with self._cv:
-            if self._queue:
-                return self._queue.popleft()
-            return None
-
-    def wait_any(self, timeout: float | None) -> bool:
-        """Block until at least one message is queued."""
-        with self._cv:
-            if self._queue:
-                return True
-            return self._cv.wait(timeout)
-
-
 class LocalFabric:
-    """In-process loopback fabric connecting N endpoints.
+    """In-process loopback fabric: rank r attaches an ingress callback and
+    ``send`` invokes the destination's callback on the sender's thread
+    (backpressure propagates naturally — a full rx pool blocks the sender,
+    like TCP flow control in the reference).
 
     Parity role: dummy_tcp_stack loopback (single-device tests without a
     network, dummy_tcp_stack.cpp:221-269).
     """
 
     def __init__(self, world_size: int):
-        self.endpoints = [FabricEndpoint(r) for r in range(world_size)]
+        self.world_size = world_size
+        self._ingress: list = [None] * world_size
 
-    def endpoint(self, rank: int) -> FabricEndpoint:
-        return self.endpoints[rank]
+    def attach(self, rank: int, ingress_fn):
+        """ingress_fn(env, payload) is the rank's eager-ingress entry."""
+        self._ingress[rank] = ingress_fn
 
     def send(self, env: Envelope, payload: bytes):
-        self.endpoints[env.dst].deliver(env, payload)
+        fn = self._ingress[env.dst]
+        if fn is None:
+            raise RuntimeError(f"rank {env.dst} not attached to fabric")
+        fn(env, payload)

@@ -1,0 +1,15 @@
+"""TPU-native parallel dataplane: XLA collectives over jax device meshes.
+
+This package is the production dataplane of the framework (reference L4-L7:
+dma_mover + streaming fabric + eth stacks → XLA collectives + Pallas over
+ICI/DCN). It is usable standalone (functional, shard_map-based) and is what
+``TpuDevice`` drives under the ACCL API.
+"""
+
+from .mesh import make_mesh, cpu_mesh, mesh_from_communicator
+from .collectives import (MeshCollectives, ring_allreduce, ring_allgather,
+                          ring_reduce_scatter, masked_bcast, send_recv)
+
+__all__ = ["make_mesh", "cpu_mesh", "mesh_from_communicator",
+           "MeshCollectives", "ring_allreduce", "ring_allgather",
+           "ring_reduce_scatter", "masked_bcast", "send_recv"]

@@ -1,0 +1,354 @@
+"""Collective algorithms over a jax mesh axis: the TPU dataplane.
+
+Two algorithm families per collective, mirroring the reference's
+sw/hw × ring/round-robin selectors (driver/xrt/include/xlnx-consts.hpp:43-66):
+
+* ``xla`` — the fused path: one XLA collective op (psum / all_gather /
+  psum_scatter / all_to_all). XLA lowers these onto ICI with its own
+  ring/tree schedules; this is the peak-bandwidth path.
+* ``ring`` — the decomposed path: explicit ``lax.ppermute`` rings with the
+  same chunk schedule as the firmware's ring collectives
+  (ccl_offload_control.c:632-1098): decreasing-rank flow, rank r starts by
+  sending chunk r+1, round i handles chunk r+1+i, ending with its own chunk.
+  This path supports wire compression per hop and is the substrate for
+  fused computation/communication (ring attention, pipelined kernels).
+
+All ``*_shard`` functions run INSIDE shard_map (per-shard views); the
+:class:`MeshCollectives` wrapper builds/jits the shard_map programs for
+global arrays sharded over the axis.
+
+Wire compression (reference: fp32↔fp16 clane plugins + ETH_COMPRESSED):
+``wire_dtype`` casts each hop's payload before the ppermute and upcasts
+after, accumulating in the uncompressed dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..constants import ReduceFunc
+
+_REDUCE_OPS: dict[ReduceFunc, Callable] = {
+    ReduceFunc.SUM: jnp.add,
+    ReduceFunc.MAX: jnp.maximum,
+    ReduceFunc.MIN: jnp.minimum,
+    ReduceFunc.PROD: jnp.multiply,
+}
+
+_PSUM_LIKE = {
+    ReduceFunc.SUM: lax.psum,
+    ReduceFunc.MAX: lax.pmax,
+    ReduceFunc.MIN: lax.pmin,
+}
+
+
+def _ring_perm(W: int) -> list[tuple[int, int]]:
+    """Decreasing-rank flow ring: rank i sends to i-1 (firmware flow)."""
+    return [(i, (i - 1) % W) for i in range(W)]
+
+
+def _hop(x: jnp.ndarray, axis_name: str, perm, wire_dtype) -> jnp.ndarray:
+    """One ring hop, optionally compressed on the wire."""
+    if wire_dtype is not None and x.dtype != jnp.dtype(wire_dtype):
+        return lax.ppermute(x.astype(wire_dtype), axis_name, perm).astype(x.dtype)
+    return lax.ppermute(x, axis_name, perm)
+
+
+# ---------------------------------------------------------------------------
+# In-shard_map ring algorithms (per-shard views)
+# ---------------------------------------------------------------------------
+
+def ring_reduce_scatter_shard(x: jnp.ndarray, axis_name: str,
+                              func: ReduceFunc = ReduceFunc.SUM,
+                              wire_dtype=None) -> jnp.ndarray:
+    """Ring reduce-scatter. ``x``: (W, chunk...) per shard — every rank holds
+    W chunks; returns this rank's fully-reduced chunk (chunk...,).
+
+    Chunk schedule parity: firmware reduce_scatter (c:860-939) — send chunk
+    me+1, round i reduces+forwards chunk me+1+i, final round keeps chunk me.
+    """
+    W = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    op = _REDUCE_OPS[func]
+    perm = _ring_perm(W)
+
+    def chunk(i):
+        return lax.dynamic_index_in_dim(x, (me + 1 + i) % W, keepdims=False)
+
+    def body(i, acc):
+        acc = _hop(acc, axis_name, perm, wire_dtype)
+        return op(acc, chunk(i))
+
+    return lax.fori_loop(1, W, body, chunk(0), unroll=True)
+
+
+def ring_allgather_shard(x: jnp.ndarray, axis_name: str,
+                         wire_dtype=None) -> jnp.ndarray:
+    """Ring allgather. ``x``: (chunk...,) per shard; returns (W, chunk...).
+
+    Parity: firmware allgather (c:727-828) — send own chunk along the ring;
+    chunk me+i arrives at round i (decreasing-rank flow).
+    """
+    W = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    perm = _ring_perm(W)
+    out = jnp.zeros((W,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, me, 0)
+
+    def body(i, carry):
+        out, buf = carry
+        buf = _hop(buf, axis_name, perm, wire_dtype)
+        out = lax.dynamic_update_index_in_dim(out, buf, (me + i) % W, 0)
+        return out, buf
+
+    out, _ = lax.fori_loop(1, W, body, (out, x), unroll=True)
+    return out
+
+
+def ring_allreduce_shard(x: jnp.ndarray, axis_name: str,
+                         func: ReduceFunc = ReduceFunc.SUM,
+                         wire_dtype=None) -> jnp.ndarray:
+    """Ring allreduce = ring reduce-scatter + ring allgather over W chunks
+    of the flattened shard (firmware allreduce, c:942-1098). ``x``: any
+    shape, same on all ranks; returns the elementwise reduction."""
+    W = lax.axis_size(axis_name)
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    pad = (-flat.size) % W
+    flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(W, -1)
+    mine = ring_reduce_scatter_shard(chunks, axis_name, func, wire_dtype)
+    full = ring_allgather_shard(mine, axis_name, wire_dtype)
+    out = full.reshape(-1)
+    if pad:
+        out = out[:flat.size - pad]
+    return out.reshape(shape).astype(dtype)
+
+
+def ring_allreduce(x, axis_name: str, func: ReduceFunc = ReduceFunc.SUM,
+                   wire_dtype=None):
+    """Alias usable directly inside shard_map/pjit programs."""
+    return ring_allreduce_shard(x, axis_name, func, wire_dtype)
+
+
+def ring_allgather(x, axis_name: str, wire_dtype=None):
+    return ring_allgather_shard(x, axis_name, wire_dtype)
+
+
+def ring_reduce_scatter(x, axis_name: str, func: ReduceFunc = ReduceFunc.SUM,
+                        wire_dtype=None):
+    return ring_reduce_scatter_shard(x, axis_name, func, wire_dtype)
+
+
+def masked_bcast(x: jnp.ndarray, root, axis_name: str) -> jnp.ndarray:
+    """Broadcast via masked reduction — XLA lowers this to its tree/ring
+    broadcast schedule. Works for any dtype (uses where+psum)."""
+    me = lax.axis_index(axis_name)
+    contrib = jnp.where(me == root, x, jnp.zeros_like(x))
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return lax.psum(contrib, axis_name)
+    return lax.psum(contrib, axis_name).astype(x.dtype)
+
+
+def send_recv(x: jnp.ndarray, pairs: list[tuple[int, int]],
+              axis_name: str) -> jnp.ndarray:
+    """Point-to-point transfer: ppermute over explicit (src, dst) pairs.
+    Ranks not named as a destination receive zeros (they ignore the
+    result). This is the SPMD substrate for tag-matched send/recv — the
+    host-side rendezvous pairs the calls (device/tpu.py)."""
+    return lax.ppermute(x, axis_name, pairs)
+
+
+def alltoall_shard(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """x: (W, chunk...) per shard -> (W, chunk...) transposed across ranks."""
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+
+
+# ---------------------------------------------------------------------------
+# Global-array wrappers: build + cache shard_map programs over a mesh
+# ---------------------------------------------------------------------------
+
+class MeshCollectives:
+    """Collectives over global jax.Arrays sharded on ``axis_name`` of a mesh.
+
+    Global layout convention (SPMD controller view): operands carry a
+    leading ``W`` axis — element [r] is rank r's operand — sharded over the
+    mesh axis. This is the TPU-backend currency the ACCL driver uses.
+
+    Programs are jitted and cached per (op, algorithm, shapes, dtypes).
+    """
+
+    def __init__(self, mesh: Mesh, axis_name: str = "rank"):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.W = mesh.shape[axis_name]
+
+    # specs: leading axis is the per-rank axis
+    def _sharded(self, extra_dims: int = 0) -> P:
+        return P(self.axis_name, *([None] * extra_dims))
+
+    def shard(self, per_rank_values) -> jax.Array:
+        """Stack host per-rank values [W, ...] and shard over the axis."""
+        import numpy as np
+        stacked = np.stack(per_rank_values)
+        sharding = NamedSharding(self.mesh, self._sharded(stacked.ndim - 1))
+        return jax.device_put(stacked, sharding)
+
+    @functools.lru_cache(maxsize=256)
+    def _program(self, op: str, algorithm: str, func: ReduceFunc,
+                 wire: str | None, root: int | None):
+        ax = self.axis_name
+        wire_dtype = jnp.dtype(wire) if wire else None
+        # XLA has no fused product-reduce collective; use the ring path
+        if func not in _PSUM_LIKE and algorithm == "xla" and op in (
+                "allreduce", "reduce", "reduce_scatter"):
+            algorithm = "ring"
+        if op == "reduce" and algorithm == "ring":
+            def f(x):
+                r = ring_allreduce_shard(x[0], ax, func, wire_dtype)
+                me = lax.axis_index(ax)
+                return jnp.where(me == root, r, jnp.zeros_like(x[0]))[None]
+            fn = jax.shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
+                               out_specs=P(ax, None))
+            return jax.jit(fn)
+
+        if op == "allreduce":
+            if algorithm == "ring":
+                def f(x):  # x per-shard: (1, n)
+                    return ring_allreduce_shard(x[0], ax, func,
+                                                wire_dtype)[None]
+            else:
+                def f(x):
+                    r = _PSUM_LIKE[func](_maybe_wire(x[0], wire_dtype), ax)
+                    return r.astype(x.dtype)[None]
+            spec_in = spec_out = P(ax, None)
+        elif op == "reduce_scatter":
+            # x: (W, W*chunk) global; out: (W, chunk)
+            if algorithm == "ring":
+                def f(x):
+                    chunks = x[0].reshape(self.W, -1)
+                    return ring_reduce_scatter_shard(chunks, ax, func,
+                                                     wire_dtype)[None]
+            else:
+                def f(x):
+                    r = lax.psum_scatter(
+                        _maybe_wire(x[0].reshape(self.W, -1), wire_dtype),
+                        ax, scatter_dimension=0, tiled=False)
+                    return r.astype(x.dtype)[None]
+            spec_in = spec_out = P(ax, None)
+        elif op == "allgather":
+            # x: (W, chunk) global; out: (W, W*chunk)
+            if algorithm == "ring":
+                def f(x):
+                    return ring_allgather_shard(x[0], ax,
+                                                wire_dtype).reshape(-1)[None]
+            else:
+                def f(x):
+                    return lax.all_gather(x[0], ax).reshape(-1)[None]
+            spec_in = spec_out = P(ax, None)
+        elif op == "bcast":
+            def f(x):
+                return masked_bcast(x[0], root, ax)[None]
+            spec_in = spec_out = P(ax, None)
+        elif op == "reduce":
+            def f(x):
+                r = _PSUM_LIKE[func](_maybe_wire(x[0], wire_dtype), ax)
+                me = lax.axis_index(ax)
+                return jnp.where(me == root, r.astype(x.dtype),
+                                 jnp.zeros_like(x[0]))[None]
+            spec_in = spec_out = P(ax, None)
+        elif op == "scatter":
+            # root's (W, chunk) rows land one per rank via masked psum_scatter
+            def f(x):
+                me = lax.axis_index(ax)
+                chunks = x[0].reshape(self.W, -1)
+                contrib = jnp.where(me == root, chunks,
+                                    jnp.zeros_like(chunks))
+                r = lax.psum_scatter(contrib, ax, scatter_dimension=0,
+                                     tiled=False)
+                return r.astype(x.dtype)[None]
+            spec_in = spec_out = P(ax, None)
+        elif op == "gather":
+            # all_gather everywhere, mask off non-root (tree-structured in XLA)
+            def f(x):
+                g = lax.all_gather(x[0], ax).reshape(-1)
+                me = lax.axis_index(ax)
+                return jnp.where(me == root, g, jnp.zeros_like(g))[None]
+            spec_in = spec_out = P(ax, None)
+        elif op == "alltoall":
+            def f(x):
+                chunks = x[0].reshape(self.W, -1)
+                return alltoall_shard(chunks, ax).reshape(-1)[None]
+            spec_in = spec_out = P(ax, None)
+        else:
+            raise NotImplementedError(op)
+
+        fn = jax.shard_map(f, mesh=self.mesh, in_specs=spec_in,
+                           out_specs=spec_out)
+        return jax.jit(fn)
+
+    # -- public ops (global arrays, leading W axis) ------------------------
+    def allreduce(self, x: jax.Array, func: ReduceFunc = ReduceFunc.SUM,
+                  algorithm: str = "xla", wire_dtype=None) -> jax.Array:
+        return self._program("allreduce", algorithm, func,
+                             _wire_name(wire_dtype), None)(x)
+
+    def reduce_scatter(self, x: jax.Array,
+                       func: ReduceFunc = ReduceFunc.SUM,
+                       algorithm: str = "xla", wire_dtype=None) -> jax.Array:
+        return self._program("reduce_scatter", algorithm, func,
+                             _wire_name(wire_dtype), None)(x)
+
+    def allgather(self, x: jax.Array, algorithm: str = "xla",
+                  wire_dtype=None) -> jax.Array:
+        return self._program("allgather", algorithm, ReduceFunc.SUM,
+                             _wire_name(wire_dtype), None)(x)
+
+    def bcast(self, x: jax.Array, root: int = 0) -> jax.Array:
+        return self._program("bcast", "xla", ReduceFunc.SUM, None, root)(x)
+
+    def reduce(self, x: jax.Array, root: int = 0,
+               func: ReduceFunc = ReduceFunc.SUM, wire_dtype=None
+               ) -> jax.Array:
+        return self._program("reduce", "xla", func,
+                             _wire_name(wire_dtype), root)(x)
+
+    def scatter(self, x: jax.Array, root: int = 0) -> jax.Array:
+        return self._program("scatter", "xla", ReduceFunc.SUM, None, root)(x)
+
+    def gather(self, x: jax.Array, root: int = 0) -> jax.Array:
+        return self._program("gather", "xla", ReduceFunc.SUM, None, root)(x)
+
+    def alltoall(self, x: jax.Array) -> jax.Array:
+        return self._program("alltoall", "xla", ReduceFunc.SUM, None, None)(x)
+
+    @functools.lru_cache(maxsize=256)
+    def _sendrecv_program(self, pairs: tuple[tuple[int, int], ...]):
+        ax = self.axis_name
+
+        def f(x):
+            return send_recv(x[0], list(pairs), ax)[None]
+
+        fn = jax.shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
+                           out_specs=P(ax, None))
+        return jax.jit(fn)
+
+    def exchange(self, x: jax.Array,
+                 pairs: tuple[tuple[int, int], ...]) -> jax.Array:
+        """Execute a batch of point-to-point transfers as one ppermute."""
+        return self._sendrecv_program(tuple(pairs))(x)
+
+
+def _maybe_wire(x, wire_dtype):
+    return x if wire_dtype is None else x.astype(wire_dtype)
+
+
+def _wire_name(wire_dtype) -> str | None:
+    return None if wire_dtype is None else jnp.dtype(wire_dtype).name

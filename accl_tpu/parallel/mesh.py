@@ -1,0 +1,67 @@
+"""Device mesh construction and communicator <-> mesh binding.
+
+Parity: the reference's communicator is a table of {ip, port, session} per
+rank (ccl_offload_control.h:271-298); on TPU the fabric is the ICI mesh and
+a communicator binds to a mesh axis. Multi-host (DCN) meshes come from
+jax.distributed + the same construction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..communicator import Communicator, Rank
+
+
+def make_mesh(shape: tuple[int, ...] | None = None,
+              axis_names: tuple[str, ...] = ("rank",),
+              devices=None, platform: str | None = None) -> Mesh:
+    """Build a Mesh over available devices (default: all of the default
+    platform; pass platform='cpu' for the virtual CPU mesh in tests)."""
+    if devices is None:
+        devices = jax.devices(platform) if platform else jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, "
+                         f"have {len(devices)}")
+    devs = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(devs, axis_names)
+
+
+def cpu_mesh(n: int = 8, shape: tuple[int, ...] | None = None,
+             axis_names: tuple[str, ...] = ("rank",)) -> Mesh:
+    """Virtual CPU mesh (requires --xla_force_host_platform_device_count)."""
+    devs = jax.devices("cpu")[:n]
+    return make_mesh(shape or (n,), axis_names, devices=devs)
+
+
+def mesh_from_communicator(comm: Communicator, axis_name: str = "rank",
+                           platform: str | None = None) -> Mesh:
+    """Bind a communicator to a 1-D mesh: rank i ↔ device i."""
+    devices = [r.device for r in comm.ranks]
+    if any(d is None for d in devices):
+        all_devs = jax.devices(platform) if platform else jax.devices()
+        if len(all_devs) < comm.size:
+            raise ValueError(f"communicator of size {comm.size} needs "
+                             f"{comm.size} devices, have {len(all_devs)}")
+        devices = all_devs[:comm.size]
+        for r, d in zip(comm.ranks, devices):
+            r.device = d
+    comm.mesh_axis = axis_name
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def communicator_from_mesh(mesh: Mesh, axis_name: str = "rank",
+                           local_rank: int = 0) -> Communicator:
+    """The inverse binding: a communicator whose ranks are the devices along
+    ``axis_name`` of an existing mesh."""
+    devs = list(np.asarray(mesh.devices).reshape(-1))
+    ranks = [Rank(device=d, global_rank=i) for i, d in enumerate(devs)]
+    comm = Communicator(ranks=ranks, local_rank=local_rank,
+                        mesh_axis=axis_name)
+    return comm

@@ -15,22 +15,23 @@ from typing import Callable, Sequence
 
 from .accl import ACCL
 from .communicator import Communicator, Rank
-from .constants import DEFAULT_MAX_SEGMENT_SIZE
 from .device.emu import EmuContext
 
 
-def emu_world(world_size: int, nbufs: int = 16, bufsize: int = 1 << 16,
+def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
               timeout: float = 20.0,
               max_segment_size: int | None = None) -> list[ACCL]:
     """Create ``world_size`` ACCL instances sharing an in-process fabric."""
-    ctx = EmuContext(world_size, nbufs=nbufs, bufsize=bufsize)
-    max_seg = min(bufsize, max_segment_size or DEFAULT_MAX_SEGMENT_SIZE)
+    kw = {"nbufs": nbufs}
+    if bufsize is not None:
+        kw["bufsize"] = bufsize
+    ctx = EmuContext(world_size, **kw)
     accls = []
     for r in range(world_size):
         comm = Communicator(
             ranks=[Rank() for _ in range(world_size)], local_rank=r)
         accls.append(ACCL(ctx.device(r), comm, timeout=timeout,
-                          max_segment_size=max_seg))
+                          max_segment_size=max_segment_size))
     return accls
 
 

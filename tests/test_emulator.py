@@ -429,3 +429,32 @@ def test_waitfor_error_propagates():
     run_ranks(accls, fn)
     for a in accls:
         a.deinit()
+
+
+def test_strided_slice_rejected(world4):
+    buf = world4[0].buffer((8,), np.float32)
+    with pytest.raises(ValueError, match="contiguous"):
+        buf[::2]
+
+
+def test_backpressure_large_transfer():
+    """A transfer with more segments than rx buffers succeeds via
+    sender backpressure (no silent drops)."""
+    accls = emu_world(2, nbufs=2, bufsize=1 << 12, timeout=10.0)
+    count = 10 * 1024  # 40 KiB = 10 segments of 4 KiB, only 2 buffers
+
+    def fn(a):
+        if a.rank == 0:
+            src = a.buffer(data=_data(count, np.float32, 990))
+            a.send(src, count, dst=1)
+        else:
+            dst = a.buffer((count,), np.float32)
+            a.recv(dst, count, src=0)
+            return dst.data.copy()
+        return None
+
+    res = run_ranks(accls, fn)
+    np.testing.assert_allclose(res[1], _data(count, np.float32, 990))
+    assert accls[1].device.pool.error_word == 0
+    for a in accls:
+        a.deinit()

@@ -1,0 +1,215 @@
+"""The ACCL driver API on the TPU backend (virtual CPU mesh): the same
+rank-parallel corpus that drives the emulator tier — the 3-tier test story.
+"""
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, ErrorCode, ReduceFunc
+from accl_tpu.device.tpu import tpu_world
+from accl_tpu.testing import run_ranks
+
+W = 8
+
+
+def _data(count, dtype, seed):
+    rng = np.random.default_rng(seed)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return rng.integers(-50, 50, size=count).astype(dtype)
+    return rng.standard_normal(count).astype(dtype)
+
+
+@pytest.fixture(scope="module")
+def world():
+    return tpu_world(W, platform="cpu")
+
+
+def test_allreduce(world):
+    count = 100
+    ins = [_data(count, np.float32, r) for r in range(W)]
+
+    def fn(a):
+        src = a.buffer(data=ins[a.rank])
+        dst = a.buffer((count,), np.float32)
+        a.allreduce(src, dst, count)
+        return dst.data.copy()
+
+    golden = sum(ins)
+    for out in run_ranks(world, fn):
+        np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_sendrecv(world):
+    def fn(a):
+        buf = a.buffer((16,), np.float32)
+        if a.rank == 2:
+            buf.data[:] = 42.0
+            a.send(buf, 16, dst=5, tag=7)
+        elif a.rank == 5:
+            a.recv(buf, 16, src=2, tag=7)
+            return buf.data.copy()
+        return None
+
+    res = run_ranks(world, fn)
+    np.testing.assert_allclose(res[5], np.full(16, 42.0))
+
+
+def test_send_completes_before_recv(world):
+    def fn(a):
+        buf = a.buffer((4,), np.float32)
+        if a.rank == 0:
+            buf.data[:] = 1.25
+            a.send(buf, 4, dst=1, tag=0)  # completes eagerly
+            return "sent"
+        if a.rank == 1:
+            import time
+            time.sleep(0.1)
+            a.recv(buf, 4, src=0, tag=0)
+            return buf.data[0]
+        return None
+
+    res = run_ranks(world, fn)
+    assert res[0] == "sent" and res[1] == 1.25
+
+
+@pytest.mark.parametrize("root", [0, 3])
+def test_bcast(world, root):
+    count = 40
+    golden = _data(count, np.float32, 77)
+
+    def fn(a):
+        buf = a.buffer((count,), np.float32)
+        if a.rank == root:
+            buf.data[:] = golden
+        a.bcast(buf, count, root=root)
+        return buf.data.copy()
+
+    for out in run_ranks(world, fn):
+        np.testing.assert_allclose(out, golden)
+
+
+def test_scatter_gather_roundtrip(world):
+    count = 8
+    golden = _data(W * count, np.float32, 88)
+
+    def fn(a):
+        dst = a.buffer((count,), np.float32)
+        if a.rank == 1:
+            src = a.buffer(data=golden)
+            a.scatter(src, dst, count, root=1)
+            back = a.buffer((W * count,), np.float32)
+            a.gather(dst, back, count, root=1)
+            return back.data.copy()
+        else:
+            a.scatter(None, dst, count, root=1)
+            a.gather(dst, None, count, root=1)
+        return None
+
+    res = run_ranks(world, fn)
+    np.testing.assert_allclose(res[1], golden)
+
+
+def test_reduce(world):
+    count = 20
+    ins = [_data(count, np.float32, 200 + r) for r in range(W)]
+
+    def fn(a):
+        src = a.buffer(data=ins[a.rank])
+        dst = a.buffer((count,), np.float32) if a.rank == 4 else None
+        a.reduce(src, dst, count, root=4, func=ReduceFunc.SUM)
+        return dst.data.copy() if dst is not None else None
+
+    res = run_ranks(world, fn)
+    np.testing.assert_allclose(res[4], sum(ins), rtol=1e-4, atol=1e-5)
+
+
+def test_allgather_reduce_scatter(world):
+    count = 4
+    ins = [_data(W * count, np.float32, 300 + r) for r in range(W)]
+
+    def fn(a):
+        src = a.buffer(data=ins[a.rank])
+        mine = a.buffer((count,), np.float32)
+        a.reduce_scatter(src, mine, count)
+        full = a.buffer((W * count,), np.float32)
+        a.allgather(mine, full, count)
+        return full.data.copy()
+
+    golden = sum(ins)
+    for out in run_ranks(world, fn):
+        np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_alltoall(world):
+    count = 3
+    ins = [_data(W * count, np.float32, 400 + r) for r in range(W)]
+
+    def fn(a):
+        src = a.buffer(data=ins[a.rank])
+        dst = a.buffer((W * count,), np.float32)
+        a.alltoall(src, dst, count)
+        return dst.data.copy()
+
+    res = run_ranks(world, fn)
+    for r in range(W):
+        for s in range(W):
+            np.testing.assert_allclose(
+                res[r][s * count:(s + 1) * count],
+                ins[s][r * count:(r + 1) * count])
+
+
+def test_barrier_and_chaining(world):
+    def fn(a):
+        x = a.buffer(data=np.full(8, 2.0, np.float32))
+        y = a.buffer((8,), np.float32)
+        h = a.copy(x, y, run_async=True)
+        a.barrier(waitfor=[h])
+        return y.data[0]
+
+    assert all(v == 2.0 for v in run_ranks(world, fn))
+
+
+def test_wire_compressed_allreduce(world):
+    count = 64
+    ins = [_data(count, np.float32, 500 + r) for r in range(W)]
+
+    def fn(a):
+        src = a.buffer(data=ins[a.rank])
+        dst = a.buffer((count,), np.float32)
+        a.allreduce(src, dst, count, compress_dtype=np.float16)
+        return dst.data.copy()
+
+    golden = sum(ins)
+    for out in run_ranks(world, fn):
+        np.testing.assert_allclose(out, golden, rtol=2e-2, atol=2e-2)
+
+
+def test_recv_timeout(world):
+    def fn(a):
+        if a.rank == 6:
+            a.set_timeout(0.3)
+            buf = a.buffer((4,), np.float32)
+            try:
+                with pytest.raises(ACCLError) as ei:
+                    a.recv(buf, 4, src=7, tag=99)
+                assert ErrorCode.RECEIVE_TIMEOUT_ERROR in ei.value.errors
+            finally:
+                a.set_timeout(30.0)
+        return None
+
+    run_ranks(world, fn)
+
+
+def test_recv_tag_any_matches_tagged_send(world):
+    """TAG_ANY wildcard semantics must match the emulator tier."""
+    def fn(a):
+        buf = a.buffer((4,), np.float32)
+        if a.rank == 0:
+            buf.data[:] = 9.0
+            a.send(buf, 4, dst=1, tag=5)
+        elif a.rank == 1:
+            a.recv(buf, 4, src=0)  # default TAG_ANY
+            return buf.data[0]
+        return None
+
+    assert run_ranks(world, fn)[1] == 9.0
