@@ -16,25 +16,25 @@ TPU-native design: a buffer is either
 
 from __future__ import annotations
 
-import itertools
+import threading
 from typing import Any
 
 import numpy as np
 
 _ALIGNMENT = 4096
-_next_addr = itertools.count(_ALIGNMENT)
+_alloc_lock = threading.Lock()
+_next_page = 1
 
 
 def _alloc_addr(nbytes: int) -> int:
     """Fake physical address allocator, 4 KiB aligned (SimBuffer parity,
-    accl.py:61-66)."""
-    global _next_addr
-    addr = next(_next_addr) * _ALIGNMENT
-    # reserve enough aligned pages
+    accl.py:61-66). Thread-safe: reserves all pages atomically."""
+    global _next_page
     pages = max(1, -(-nbytes // _ALIGNMENT))
-    for _ in range(pages - 1):
-        next(_next_addr)
-    return addr
+    with _alloc_lock:
+        page = _next_page
+        _next_page += pages
+    return page * _ALIGNMENT
 
 
 class ACCLBuffer:

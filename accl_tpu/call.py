@@ -54,12 +54,15 @@ class CallHandle:
         self._done = threading.Event()
         self._error_word = 0
         self._result: Any = None
+        self._exception: BaseException | None = None
         self.context = context
 
     # backend side -----------------------------------------------------
-    def complete(self, error_word: int = 0, result: Any = None):
+    def complete(self, error_word: int = 0, result: Any = None,
+                 exception: BaseException | None = None):
         self._error_word = int(error_word)
         self._result = result
+        self._exception = exception
         self._done.set()
 
     # host side --------------------------------------------------------
@@ -68,7 +71,8 @@ class CallHandle:
             raise TimeoutError(f"call {self.context or ''} did not complete "
                                f"within {timeout}s")
         if self._error_word != int(ErrorCode.COLLECTIVE_OP_SUCCESS):
-            raise ACCLError(self._error_word, self.context)
+            # chain the backend's underlying exception for debuggability
+            raise ACCLError(self._error_word, self.context) from self._exception
         return self._result
 
     def done(self) -> bool:

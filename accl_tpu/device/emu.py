@@ -66,13 +66,29 @@ class EmuDevice(Device):
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name=f"emu-rank{rank}")
         self._worker.start()
+        # dedicated ingress thread: the fabric enqueues without blocking the
+        # sender (the reference's emulator wire — ZMQ pub/sub — buffers the
+        # same way); only this thread blocks when the rx pool is full
+        self._inbox: queue.Queue = queue.Queue()
+        self._ingress = threading.Thread(target=self._ingress_loop,
+                                         daemon=True,
+                                         name=f"emu-ingress{rank}")
+        self._ingress.start()
 
-    # -- ingress (eager, fabric thread) -----------------------------------
+    # -- ingress (eager, never blocks the sender) --------------------------
     def ingest(self, env: Envelope, payload: bytes):
-        if env.strm:
-            self.executor.deliver_stream(env, payload)
-        else:
-            self.pool.ingest(env, payload, timeout=self.timeout)
+        self._inbox.put((env, payload))
+
+    def _ingress_loop(self):
+        while True:
+            item = self._inbox.get()
+            if item is None:
+                return
+            env, payload = item
+            if env.strm:
+                self.executor.deliver_stream(env, payload)
+            else:
+                self.pool.ingest(env, payload, timeout=self.timeout)
 
     # -- Device interface --------------------------------------------------
     def register_buffer(self, buf: ACCLBuffer):
@@ -126,6 +142,7 @@ class EmuDevice(Device):
 
     def deinit(self):
         self._calls.put(None)
+        self._inbox.put(None)
 
     # -- worker ------------------------------------------------------------
     def _run(self):
@@ -141,11 +158,12 @@ class EmuDevice(Device):
                 handle.complete(err)
             except ACCLError as exc:
                 # failed waitfor dependency: propagate its error word
-                handle.complete(exc.error_word)
-            except TimeoutError:
-                handle.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR))
-            except Exception:  # noqa: BLE001 — report, don't kill worker
-                handle.complete(int(ErrorCode.INVALID_CALL))
+                handle.complete(exc.error_word, exception=exc)
+            except TimeoutError as exc:
+                handle.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR),
+                                exception=exc)
+            except Exception as exc:  # noqa: BLE001 — report, don't kill worker
+                handle.complete(int(ErrorCode.INVALID_CALL), exception=exc)
 
     def _execute(self, desc: CallDescriptor) -> int:
         if desc.scenario == CCLOp.nop:

@@ -62,6 +62,7 @@ class TpuContext:
         self.axis_name = axis_name
         self.world_size = mesh.shape[axis_name]
         self.coll = MeshCollectives(mesh, axis_name)
+        self._subcolls: dict[int, MeshCollectives] = {}
         self.algorithm = algorithm
         self.devices: list[TpuDevice | None] = [None] * self.world_size
         # rendezvous state
@@ -78,6 +79,25 @@ class TpuContext:
         if self.devices[rank] is None:
             self.devices[rank] = TpuDevice(self, rank)
         return self.devices[rank]
+
+    def coll_for(self, comm: Communicator) -> MeshCollectives:
+        """Collectives bound to the communicator's sub-mesh: member global
+        ranks select their devices from the world mesh (a split comm runs
+        over its own axis, so axis_index == comm-local rank)."""
+        if comm.size == self.world_size:
+            return self.coll
+        key = comm.comm_id
+        cached = self._subcolls.get(key)
+        if cached is not None:
+            return cached
+        import numpy as np
+        from jax.sharding import Mesh
+        world_devs = list(np.asarray(self.mesh.devices).reshape(-1))
+        devs = [world_devs[r.global_rank] for r in comm.ranks]
+        sub = MeshCollectives(Mesh(np.asarray(devs), (self.axis_name,)),
+                              self.axis_name)
+        self._subcolls[key] = sub
+        return sub
 
 
 class TpuDevice(Device):
@@ -142,11 +162,12 @@ class TpuDevice(Device):
                     dep.wait(self.timeout)
                 handle.complete(self._execute(desc))
             except ACCLError as exc:
-                handle.complete(exc.error_word)
-            except TimeoutError:
-                handle.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR))
-            except Exception:  # noqa: BLE001
-                handle.complete(int(ErrorCode.INVALID_CALL))
+                handle.complete(exc.error_word, exception=exc)
+            except TimeoutError as exc:
+                handle.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR),
+                                exception=exc)
+            except Exception as exc:  # noqa: BLE001
+                handle.complete(int(ErrorCode.INVALID_CALL), exception=exc)
 
     # -- operand staging ---------------------------------------------------
     def _read_operand(self, addr: int, count: int, desc, which: Compression
@@ -243,6 +264,10 @@ class TpuDevice(Device):
         x[src_g] = payload
         out = self.ctx.coll.exchange(self.ctx.coll.shard(list(x)),
                                      ((src_g, me_g),))
+        if payload.size != desc.count:
+            # emulator-tier parity: envelope length must match the posted
+            # receive exactly (DMA_MISMATCH_ERROR, executor._fetch)
+            return int(ErrorCode.DMA_MISMATCH_ERROR)
         received = np.asarray(out)[me_g].astype(
             desc.arithcfg.uncompressed_dtype)
         self._write_result(desc.addr_2, received, desc)
@@ -263,6 +288,8 @@ class TpuDevice(Device):
                 try:
                     err = self._launch(key, comm)
                 except Exception:  # noqa: BLE001
+                    import traceback
+                    traceback.print_exc()  # observability: don't bury the cause
                     err = int(ErrorCode.INVALID_CALL)
                 del ctx._pending[key]
                 if comm.size > 1:
@@ -309,7 +336,7 @@ class TpuDevice(Device):
                     rows.append(np.zeros(n, cfg.uncompressed_dtype))
             return rows
 
-        coll, alg = ctx.coll, ctx.algorithm
+        coll, alg = ctx.coll_for(comm), ctx.algorithm
         root = d0.root_src_dst
         if op == CCLOp.barrier:
             return 0  # rendezvous above IS the barrier

@@ -1,0 +1,11 @@
+"""Model families exercising the framework's collectives at training scale.
+
+The reference is a collectives library with no models; BASELINE config 5
+(DP gradient all-reduce over Llama-3-8B bucketed grads) requires a real
+transformer. These models are written TPU-first: pure-jax functional,
+static shapes, sharding-annotated for dp/tp/sp meshes, bfloat16 compute.
+"""
+
+from .llama import LlamaConfig, Llama
+
+__all__ = ["LlamaConfig", "Llama"]

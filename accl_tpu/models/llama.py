@@ -1,0 +1,251 @@
+"""Llama-family transformer, TPU-first (pure jax, GSPMD-sharded).
+
+Design for the MXU/HBM/ICI (not a port of any torch code):
+  * bfloat16 activations/params option, fp32 master weights + optimizer.
+  * static shapes, no python control flow under jit; layers scanned.
+  * GSPMD sharding: params and activations carry PartitionSpecs over a
+    ('dp', 'tp') mesh (+ optional 'sp' sequence axis folded into dp for
+    data, attention over tp heads). XLA inserts the all-gathers /
+    reduce-scatters; bucketed DP gradient sync can instead be driven
+    explicitly through accl_tpu collectives (benchmarks/dp_allreduce.py)
+    to mirror the reference's ring-allreduce usage.
+
+Shapes follow the Llama-3 family (GQA, SwiGLU, RoPE, RMSNorm);
+``LlamaConfig.llama3_8b()`` reproduces the 8B geometry for BASELINE
+config 5.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14_336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16      # activation/compute dtype (MXU-friendly)
+    param_dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, vocab: int = 256, dim: int = 64, n_layers: int = 2,
+             n_heads: int = 4, n_kv_heads: int = 2, ffn_dim: int = 128,
+             max_seq_len: int = 128) -> "LlamaConfig":
+        return cls(vocab_size=vocab, dim=dim, n_layers=n_layers,
+                   n_heads=n_heads, n_kv_heads=n_kv_heads, ffn_dim=ffn_dim,
+                   max_seq_len=max_seq_len)
+
+
+def _rms_norm(x, w, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * w
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding; x: (..., seq, heads, head_dim)."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (seq, hd/2)
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Llama:
+    """Functional Llama: params are a pytree dict; methods are pure.
+
+    Layer params are stacked along a leading ``n_layers`` axis so the
+    decoder runs as one ``lax.scan`` — one compiled layer body regardless of
+    depth (fast compiles, XLA-friendly)."""
+
+    def __init__(self, config: LlamaConfig):
+        self.config = config
+
+    # -- parameters --------------------------------------------------------
+    def init(self, key: jax.Array) -> dict:
+        c = self.config
+        k_emb, k_layers, k_out = jax.random.split(key, 3)
+        hd, nh, nkv = c.head_dim, c.n_heads, c.n_kv_heads
+
+        def norm_init(*shape):
+            return jnp.ones(shape, c.param_dtype)
+
+        def dense(key, fan_in, *shape):
+            return (jax.random.normal(key, shape, c.param_dtype)
+                    * (fan_in ** -0.5))
+
+        L = c.n_layers
+        ks = jax.random.split(k_layers, 7)
+
+        def stack(key, fan_in, *shape):
+            return dense(key, fan_in, L, *shape)
+
+        params = {
+            "embed": dense(k_emb, c.dim, c.vocab_size, c.dim),
+            "layers": {
+                "attn_norm": norm_init(L, c.dim),
+                "wq": stack(ks[0], c.dim, c.dim, nh * hd),
+                "wk": stack(ks[1], c.dim, c.dim, nkv * hd),
+                "wv": stack(ks[2], c.dim, c.dim, nkv * hd),
+                "wo": stack(ks[3], nh * hd, nh * hd, c.dim),
+                "mlp_norm": norm_init(L, c.dim),
+                "w_gate": stack(ks[4], c.dim, c.dim, c.ffn_dim),
+                "w_up": stack(ks[5], c.dim, c.dim, c.ffn_dim),
+                "w_down": stack(ks[6], c.ffn_dim, c.ffn_dim, c.dim),
+            },
+            "final_norm": norm_init(c.dim),
+            "lm_head": dense(k_out, c.dim, c.dim, c.vocab_size),
+        }
+        return params
+
+    # -- sharding ----------------------------------------------------------
+    def param_specs(self, dp: str = "dp", tp: str = "tp") -> dict:
+        """PartitionSpecs for a (dp, tp) mesh: megatron-style TP — qkv/gate/
+        up column-parallel, wo/down row-parallel, embeddings sharded on
+        vocab."""
+        return {
+            "embed": P(tp, None),
+            "layers": {
+                "attn_norm": P(None, None),
+                "wq": P(None, None, tp),
+                "wk": P(None, None, tp),
+                "wv": P(None, None, tp),
+                "wo": P(None, tp, None),
+                "mlp_norm": P(None, None),
+                "w_gate": P(None, None, tp),
+                "w_up": P(None, None, tp),
+                "w_down": P(None, tp, None),
+            },
+            "final_norm": P(None),
+            "lm_head": P(None, tp),
+        }
+
+    def shard_params(self, params: dict, mesh: Mesh, dp: str = "dp",
+                     tp: str = "tp") -> dict:
+        specs = self.param_specs(dp, tp)
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs,
+            is_leaf=lambda x: isinstance(x, (jnp.ndarray, np.ndarray)))
+
+    # -- forward -----------------------------------------------------------
+    def _layer(self, x, layer_params, positions, mask):
+        c = self.config
+        p = layer_params
+        hd, nh, nkv = c.head_dim, c.n_heads, c.n_kv_heads
+        B, S, D = x.shape
+
+        h = _rms_norm(x, p["attn_norm"].astype(x.dtype), c.norm_eps)
+        q = (h @ p["wq"].astype(x.dtype)).reshape(B, S, nh, hd)
+        k = (h @ p["wk"].astype(x.dtype)).reshape(B, S, nkv, hd)
+        v = (h @ p["wv"].astype(x.dtype)).reshape(B, S, nkv, hd)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        # GQA: repeat kv heads
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        # attention (B, nh, S, hd)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * (hd ** -0.5)
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
+        x = x + attn @ p["wo"].astype(x.dtype)
+
+        h = _rms_norm(x, p["mlp_norm"].astype(x.dtype), c.norm_eps)
+        gate = jax.nn.silu(h @ p["w_gate"].astype(x.dtype))
+        up = h @ p["w_up"].astype(x.dtype)
+        x = x + (gate * up) @ p["w_down"].astype(x.dtype)
+        return x
+
+    def forward(self, params: dict, tokens: jnp.ndarray,
+                dp: str | None = None, sp: str | None = None) -> jnp.ndarray:
+        """Logits for (B, S) int32 tokens. When dp/sp axis names are given,
+        activation sharding constraints pin batch->dp and seq->sp."""
+        c = self.config
+        B, S = tokens.shape
+        x = params["embed"].astype(c.dtype)[tokens]
+        if dp is not None:
+            x = jax.lax.with_sharding_constraint(x, P(dp, sp, None))
+        positions = jnp.arange(S)
+        mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+
+        def body(x, layer_params):
+            return self._layer(x, layer_params, positions, mask), None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        x = _rms_norm(x, params["final_norm"].astype(x.dtype), c.norm_eps)
+        logits = x @ params["lm_head"].astype(c.dtype)
+        return logits.astype(jnp.float32)
+
+    def loss(self, params: dict, tokens: jnp.ndarray,
+             dp: str | None = None, sp: str | None = None) -> jnp.ndarray:
+        """Next-token cross entropy (mean over B, S-1)."""
+        logits = self.forward(params, tokens, dp, sp)[:, :-1]
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    # -- training ----------------------------------------------------------
+    def make_train_step(self, optimizer, dp: str | None = None,
+                        sp: str | None = None):
+        """Returns train_step(params, opt_state, tokens) -> (params,
+        opt_state, loss). Pure; jit/pjit outside."""
+
+        def train_step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(self.loss)(params, tokens, dp, sp)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree.map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss
+
+        return train_step
+
+    def param_count(self, params: dict) -> int:
+        return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+    def grad_buckets(self, params: dict, bucket_bytes: int = 25 << 20
+                     ) -> list[list[str]]:
+        """Group parameter leaves into ~bucket_bytes buckets (DDP-style
+        bucketed gradient all-reduce; BASELINE config 5). Returns lists of
+        pytree key-paths, in reverse layer order like bucketed DDP."""
+        leaves = jax.tree_util.tree_leaves_with_path(params)
+        buckets, cur, cur_bytes = [], [], 0
+        for path, leaf in reversed(leaves):
+            key = jax.tree_util.keystr(path)
+            nbytes = int(np.prod(leaf.shape)) * 4
+            cur.append(key)
+            cur_bytes += nbytes
+            if cur_bytes >= bucket_bytes:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            buckets.append(cur)
+        return buckets

@@ -24,7 +24,6 @@ after, accumulating in the uncompressed dtype.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable
 
 import jax
@@ -189,6 +188,9 @@ class MeshCollectives:
         self.mesh = mesh
         self.axis_name = axis_name
         self.W = mesh.shape[axis_name]
+        # per-instance program cache (an lru_cache on methods would pin the
+        # instance and its jitted executables in a process-global cache)
+        self._cache: dict[tuple, Callable] = {}
 
     # specs: leading axis is the per-rank axis
     def _sharded(self, extra_dims: int = 0) -> P:
@@ -201,9 +203,12 @@ class MeshCollectives:
         sharding = NamedSharding(self.mesh, self._sharded(stacked.ndim - 1))
         return jax.device_put(stacked, sharding)
 
-    @functools.lru_cache(maxsize=256)
     def _program(self, op: str, algorithm: str, func: ReduceFunc,
                  wire: str | None, root: int | None):
+        ck = (op, algorithm, func, wire, root)
+        cached = self._cache.get(ck)
+        if cached is not None:
+            return cached
         ax = self.axis_name
         wire_dtype = jnp.dtype(wire) if wire else None
         # XLA has no fused product-reduce collective; use the ring path
@@ -217,7 +222,8 @@ class MeshCollectives:
                 return jnp.where(me == root, r, jnp.zeros_like(x[0]))[None]
             fn = jax.shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
                                out_specs=P(ax, None))
-            return jax.jit(fn)
+            prog = self._cache[ck] = jax.jit(fn)
+            return prog
 
         if op == "allreduce":
             if algorithm == "ring":
@@ -292,7 +298,8 @@ class MeshCollectives:
 
         fn = jax.shard_map(f, mesh=self.mesh, in_specs=spec_in,
                            out_specs=spec_out)
-        return jax.jit(fn)
+        prog = self._cache[ck] = jax.jit(fn)
+        return prog
 
     # -- public ops (global arrays, leading W axis) ------------------------
     def allreduce(self, x: jax.Array, func: ReduceFunc = ReduceFunc.SUM,
@@ -329,8 +336,11 @@ class MeshCollectives:
     def alltoall(self, x: jax.Array) -> jax.Array:
         return self._program("alltoall", "xla", ReduceFunc.SUM, None, None)(x)
 
-    @functools.lru_cache(maxsize=256)
     def _sendrecv_program(self, pairs: tuple[tuple[int, int], ...]):
+        ck = ("exchange", pairs)
+        cached = self._cache.get(ck)
+        if cached is not None:
+            return cached
         ax = self.axis_name
 
         def f(x):
@@ -338,7 +348,8 @@ class MeshCollectives:
 
         fn = jax.shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
                            out_specs=P(ax, None))
-        return jax.jit(fn)
+        prog = self._cache[ck] = jax.jit(fn)
+        return prog
 
     def exchange(self, x: jax.Array,
                  pairs: tuple[tuple[int, int], ...]) -> jax.Array:

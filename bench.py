@@ -1,0 +1,115 @@
+"""Headline benchmark — prints ONE JSON line.
+
+Multi-device: N-rank ring all-reduce bus bandwidth (GB/s/chip), BASELINE
+config 2. Single chip: the dataplane combine engine (2-operand fused
+elementwise reduction — the reference's reduce_sum plugin; its 512-bit @
+250 MHz streaming bound is 16 GB/s, and the 100 Gbps wire is 12.5 GB/s).
+
+Timing method: the remote-device tunnel makes per-dispatch timing
+unreliable (dispatch returns before completion; a scalar fetch pays ~60 ms
+RPC latency), so each measurement chains K iterations inside one jitted
+fori_loop ending in a scalar fetch, and throughput comes from the slope
+between a small-K and large-K run — fixed costs cancel.
+
+vs_baseline is the ratio against the reference's corresponding ceiling:
+16 GB/s for the combine dataplane, 12.5 GB/s/chip bus-BW for collectives.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+ACCL_STREAM_BOUND_GBS = 16.0   # 512-bit @ 250 MHz CCLO datapath
+ACCL_WIRE_BOUND_GBS = 12.5     # 100 Gbps Ethernet
+
+
+def _timed_scalar(fn, args, reps=5):
+    float(fn(*args))  # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _slope_time(make_chain, args, k_lo=4, k_hi=36, reps=5):
+    """Per-iteration seconds via the (k_hi - k_lo) slope."""
+    t_lo = _timed_scalar(make_chain(k_lo), args, reps=reps)
+    t_hi = _timed_scalar(make_chain(k_hi), args, reps=reps)
+    return max(t_hi - t_lo, 1e-9) / (k_hi - k_lo)
+
+
+def bench_combine(nbytes=1 << 28):
+    """Fused 2-operand reduction throughput on one chip (reads acc + y,
+    writes acc: 3x traffic per iteration)."""
+    n = nbytes // 4
+    a = jax.random.normal(jax.random.key(0), (n,), jnp.float32)
+    b = jax.random.normal(jax.random.key(1), (n,), jnp.float32)
+
+    def make_chain(K):
+        @jax.jit
+        def f(x, y):
+            def body(i, acc):
+                return acc * 0.999 + y
+            return jax.lax.fori_loop(0, K, body, x)[0]
+        return f
+
+    t_iter = _slope_time(make_chain, (a, b))
+    gbs = 3 * nbytes / t_iter / 1e9
+    return {
+        "metric": "combine_fused_reduce_throughput_fp32_256MiB",
+        "value": round(gbs, 2),
+        "unit": "GB/s",
+        "vs_baseline": round(gbs / ACCL_STREAM_BOUND_GBS, 2),
+    }
+
+
+def bench_allreduce(devices, nbytes=1 << 28):
+    """Ring all-reduce bus bandwidth per chip over all local devices."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    W = len(devices)
+    mesh = Mesh(np.asarray(devices), ("rank",))
+    n = nbytes // 4
+    x = jax.device_put(
+        jnp.broadcast_to(jnp.float32(1.0) / W, (W, n)),
+        NamedSharding(mesh, P("rank", None)))
+
+    def make_chain(K):
+        def shard_fn(s):
+            def body(i, acc):
+                return jax.lax.psum(acc, "rank") * (1.0 / W)
+            return jax.lax.fori_loop(0, K, body, s[0])[0][None]
+
+        f = jax.shard_map(shard_fn, mesh=mesh, in_specs=P("rank", None),
+                          out_specs=P("rank", None))
+        return jax.jit(lambda v: f(v)[0, 0])
+
+    t_iter = _slope_time(make_chain, (x,))
+    # ring all-reduce bus traffic per chip: 2*(W-1)/W * nbytes
+    bus_bytes = 2 * (W - 1) / W * nbytes
+    gbs = bus_bytes / t_iter / 1e9
+    return {
+        "metric": f"allreduce_bus_bw_fp32_256MiB_{W}chip",
+        "value": round(gbs, 2),
+        "unit": "GB/s/chip",
+        "vs_baseline": round(gbs / ACCL_WIRE_BOUND_GBS, 2),
+    }
+
+
+def main():
+    devices = jax.devices()
+    if len(devices) > 1:
+        result = bench_allreduce(devices)
+    else:
+        result = bench_combine()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -360,7 +360,12 @@ def test_rx_pool_exhaustion_error():
         return None
 
     run_ranks(accls, fn)
+    # the ingress thread latches the overflow after its blocking timeout
+    import time
     pool = accls[1].device.pool
+    deadline = time.monotonic() + 10.0
+    while not pool.error_word and time.monotonic() < deadline:
+        time.sleep(0.05)
     assert pool.error_word & int(ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
     for a in accls:
         a.deinit()
@@ -458,3 +463,37 @@ def test_backpressure_large_transfer():
     assert accls[1].device.pool.error_word == 0
     for a in accls:
         a.deinit()
+
+
+def test_bidirectional_heavy_exchange_no_deadlock():
+    """Symmetric multi-segment sends with tiny pools must not deadlock the
+    rank workers (ingress is decoupled from the send path)."""
+    accls = emu_world(2, nbufs=2, bufsize=1 << 12, timeout=15.0)
+    count = 8 * 1024  # 8 segments each way, 2 spare buffers per rank
+
+    def fn(a):
+        peer = 1 - a.rank
+        src = a.buffer(data=_data(count, np.float32, 70 + a.rank))
+        dst = a.buffer((count,), np.float32)
+        a.send(src, count, dst=peer)
+        a.recv(dst, count, src=peer)
+        return dst.data.copy()
+
+    res = run_ranks(accls, fn, timeout=60.0)
+    np.testing.assert_allclose(res[0], _data(count, np.float32, 71))
+    np.testing.assert_allclose(res[1], _data(count, np.float32, 70))
+    for a in accls:
+        a.deinit()
+
+
+def test_exception_cause_preserved():
+    """Backend exceptions surface as the ACCLError's __cause__."""
+    accls = emu_world(2)
+    a = accls[0]
+    buf = a.buffer((4,), np.float32)
+    a.device.deregister_buffer(buf)  # simulate a use-after-free
+    with pytest.raises(ACCLError) as ei:
+        a.copy(buf, buf)
+    assert ei.value.__cause__ is not None
+    for x in accls:
+        x.deinit()

@@ -213,3 +213,35 @@ def test_recv_tag_any_matches_tagged_send(world):
         return None
 
     assert run_ranks(world, fn)[1] == 9.0
+
+
+def test_sub_communicator_allreduce_tpu(world):
+    """Split communicators execute over their own sub-mesh."""
+    def fn(a):
+        if a.rank in (2, 5, 7):
+            sub = a.split_communicator([2, 5, 7])
+            src = a.buffer(data=np.full(8, float(a.rank), np.float32))
+            dst = a.buffer((8,), np.float32)
+            a.allreduce(src, dst, 8, comm=sub)
+            return dst.data[0]
+        return None
+
+    res = run_ranks(world, fn)
+    assert res[2] == res[5] == res[7] == 14.0
+    assert res[0] is None
+
+
+def test_recv_count_mismatch_error(world):
+    """Short send into a longer recv must fail like the emulator tier."""
+    def fn(a):
+        if a.rank == 3:
+            buf = a.buffer((4,), np.float32)
+            a.send(buf, 4, dst=4, tag=11)
+        elif a.rank == 4:
+            dst = a.buffer((8,), np.float32)
+            with pytest.raises(ACCLError) as ei:
+                a.recv(dst, 8, src=3, tag=11)
+            assert ErrorCode.DMA_MISMATCH_ERROR in ei.value.errors
+        return None
+
+    run_ranks(world, fn)
