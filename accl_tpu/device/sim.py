@@ -1,0 +1,209 @@
+"""SimDevice: driver backend that talks to an out-of-process rank daemon.
+
+Parity: the reference's ``SimDevice``/``SimBuffer`` drive the emulator or
+RTL simulator over ZMQ with explicit host<->devicemem copies
+(driver/pynq/accl.py:33-159). Here the transport is the framed-TCP protocol
+(emulator/protocol.py) and the daemon is either the Python RankDaemon or
+the native C++ daemon — the driver cannot tell the difference, which is
+the property the reference's 3-tier test story depends on.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from typing import Sequence
+
+from ..buffer import ACCLBuffer
+from ..call import CallDescriptor, CallHandle
+from ..communicator import Communicator
+from ..constants import CCLOp, ErrorCode
+from ..emulator import protocol as P
+from .base import Device
+
+
+class SimDevice(Device):
+    """Client to one rank daemon's command socket."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=connect_timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock.settimeout(None)
+        self._lock = threading.Lock()          # one in-flight request
+        self._buffers: list[ACCLBuffer] = []   # for result-address resolve
+        self.timeout = 30.0
+        self._request(bytes([P.MSG_PING]))
+        # daemon geometry (bufsize bounds the max segment size)
+        try:
+            info = self._request(bytes([P.MSG_GET_INFO]))
+            self._daemon_bufsize = struct.unpack("<Q", info[1:9])[0]
+        except Exception:  # older daemons without MSG_GET_INFO
+            self._daemon_bufsize = None
+        # FIFO dispatch worker: waits each call's local dependencies, THEN
+        # syncs operands and submits — an operand sync must not run before a
+        # dependency that produces the operand has retired
+        self._dispatch_q: queue.Queue = queue.Queue()
+        self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                            daemon=True)
+        self._dispatcher.start()
+
+    # -- request/reply -----------------------------------------------------
+    def _request(self, body: bytes) -> bytes:
+        with self._lock:
+            P.send_frame(self.sock, body)
+            return P.recv_frame(self.sock)
+
+    def _request_status(self, body: bytes) -> int:
+        reply = self._request(body)
+        assert reply[0] == P.MSG_STATUS, reply[0]
+        return struct.unpack("<I", reply[1:5])[0]
+
+    def _check(self, body: bytes):
+        err = self._request_status(body)
+        if err:
+            from ..constants import ACCLError
+            raise ACCLError(err, "sim config")
+
+    # -- Device interface --------------------------------------------------
+    def register_buffer(self, buf: ACCLBuffer):
+        self._check(bytes([P.MSG_ALLOC]) +
+                    struct.pack("<2Q", buf.address, buf.nbytes))
+        self._buffers.append(buf)
+
+    def deregister_buffer(self, buf: ACCLBuffer):
+        self._check(bytes([P.MSG_FREE]) + struct.pack("<Q", buf.address))
+        if buf in self._buffers:
+            self._buffers.remove(buf)
+
+    def sync_to_device(self, buf: ACCLBuffer):
+        data = buf.data.reshape(-1).view("uint8").tobytes()
+        self._check(bytes([P.MSG_WRITE_MEM]) +
+                    struct.pack("<Q", buf.address) + data)
+
+    def sync_from_device(self, buf: ACCLBuffer):
+        reply = self._request(bytes([P.MSG_READ_MEM]) +
+                              struct.pack("<2Q", buf.address, buf.nbytes))
+        assert reply[0] == P.MSG_DATA
+        import numpy as np
+        flat = buf.data.reshape(-1).view(np.uint8)
+        flat[:] = np.frombuffer(reply[1:], np.uint8)
+
+    def configure_communicator(self, comm: Communicator):
+        ranks = [(r.global_rank, r.host, r.port) for r in comm.ranks]
+        self._check(P.pack_comm(comm.comm_id, comm.local_rank, ranks))
+
+    def set_timeout(self, timeout: float):
+        self.timeout = timeout
+        self._check(bytes([P.MSG_SET_TIMEOUT]) + struct.pack("<d", timeout))
+
+    def preferred_segment_size(self) -> int:
+        from ..constants import DEFAULT_MAX_SEGMENT_SIZE
+        if self._daemon_bufsize:
+            return min(self._daemon_bufsize, DEFAULT_MAX_SEGMENT_SIZE)
+        return DEFAULT_MAX_SEGMENT_SIZE
+
+    def set_max_segment_size(self, nbytes: int):
+        self._check(bytes([P.MSG_SET_SEG]) + struct.pack("<Q", nbytes))
+
+    def soft_reset(self):
+        self._check(bytes([P.MSG_RESET]))
+
+    def dump_rx_buffers(self) -> str:
+        reply = self._request(bytes([P.MSG_DUMP_RX]))
+        return reply[1:].decode()
+
+    def deinit(self):
+        self._dispatch_q.put(None)
+        try:
+            self._request(bytes([P.MSG_SHUTDOWN]))
+        except (ConnectionError, OSError):
+            pass
+        self.sock.close()
+
+    # -- calls -------------------------------------------------------------
+    def _resolve_buffer(self, addr: int) -> ACCLBuffer | None:
+        for b in self._buffers:
+            if b.address <= addr < b.address + b.nbytes:
+                return b
+        return None
+
+    def call_async(self, desc: CallDescriptor,
+                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+        handle = CallHandle(context=desc.scenario.name)
+        self._dispatch_q.put((desc, tuple(waitfor), handle))
+        return handle
+
+    def _dispatch_loop(self):
+        while True:
+            item = self._dispatch_q.get()
+            if item is None:
+                return
+            desc, waitfor, handle = item
+            try:
+                # local dependency order: operand syncs must observe the
+                # dependencies' results (reference collectives sync operands
+                # right before starting the call, accl.py:952)
+                from ..constants import ACCLError
+                try:
+                    for dep in waitfor:
+                        dep.wait(self.timeout)
+                except ACCLError as exc:
+                    handle.complete(exc.error_word, exception=exc)
+                    continue
+                for addr in (desc.addr_0, desc.addr_1):
+                    if addr:
+                        b = self._resolve_buffer(addr)
+                        if b is not None:
+                            self.sync_to_device(b)
+                call_id = self._submit(desc)
+                handle.sim_call_id = call_id
+                threading.Thread(target=self._poll_completion,
+                                 args=(desc, call_id, handle),
+                                 daemon=True).start()
+            except Exception as exc:  # noqa: BLE001
+                handle.complete(int(ErrorCode.CONNECTION_CLOSED),
+                                exception=exc)
+
+    def _submit(self, desc: CallDescriptor) -> int:
+        cfg = desc.arithcfg
+        if cfg is not None:
+            ud, cd = P.dtype_code(cfg.uncompressed_dtype), \
+                P.dtype_code(cfg.compressed_dtype)
+        else:
+            ud = cd = P.DTYPE_CODES["float32"]
+        body = P.pack_call(int(desc.scenario), int(desc.function),
+                           int(desc.compression), int(desc.stream_flags),
+                           ud, cd, desc.count, desc.comm_id,
+                           desc.root_src_dst,
+                           desc.tag & 0xFFFFFFFF,
+                           desc.addr_0 or 0, desc.addr_1 or 0,
+                           desc.addr_2 or 0, [])
+        reply = self._request(body)
+        assert reply[0] == P.MSG_CALL_ID
+        return struct.unpack("<I", reply[1:5])[0]
+
+    def _poll_completion(self, desc: CallDescriptor, call_id: int,
+                         handle: CallHandle):
+        """Poll MSG_WAIT with short budgets so the shared command socket is
+        never monopolized by one outstanding call (a blocking WAIT would
+        serialize — and deadlock symmetric recv-then-send programs)."""
+        try:
+            while True:
+                err = self._request_status(
+                    bytes([P.MSG_WAIT]) +
+                    struct.pack("<Id", call_id, 0.05))
+                if err != P.STATUS_PENDING:
+                    break
+            if not err:
+                res_addr = desc.addr_2 or (
+                    desc.addr_0 if desc.scenario == CCLOp.bcast else 0)
+                if res_addr:
+                    b = self._resolve_buffer(res_addr)
+                    if b is not None:
+                        self.sync_from_device(b)
+            handle.complete(err)
+        except Exception as exc:  # noqa: BLE001
+            handle.complete(int(ErrorCode.CONNECTION_CLOSED), exception=exc)

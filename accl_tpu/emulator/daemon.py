@@ -1,0 +1,327 @@
+"""Rank daemon: an out-of-process emulated device behind the socket protocol.
+
+This is the Python twin of the reference's CPU emulator process
+(test/emulation/cclo_emu.cpp): one process per rank, a command server for
+the driver (reference: ZMQ REQ/REP, zmq_intf.cpp:166-291), and an eth
+fabric between daemons (reference: ZMQ PUB/SUB frames, zmq_intf.cpp:70-164).
+The native C++ daemon (native/cclo_emud.cpp) implements the same protocol;
+the test corpus runs against either via ``SimDevice``.
+
+Run one rank:  python -m accl_tpu.emulator.daemon --rank R --world W \
+                      --port-base 45000
+Ports: cmd = port_base + rank, eth = port_base + world + rank.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from ..arith import ArithConfig
+from ..communicator import Communicator, Rank
+from ..constants import CCLOp, Compression, ErrorCode, ReduceFunc, StreamFlags
+from ..moveengine import MoveContext, expand_call
+from . import protocol as P
+from .executor import DeviceMemory, MoveExecutor, RxBufferPool
+from .fabric import Envelope
+
+
+class EthFabric:
+    """Daemon-to-daemon transport: one TCP connection per peer, lazily
+    dialed; an accept loop ingests inbound frames."""
+
+    def __init__(self, my_global_rank: int, eth_port: int, ingest_fn):
+        self.me = my_global_rank
+        self.ingest = ingest_fn
+        # per-peer (socket, lock): one slow peer's TCP backpressure must not
+        # stall sends to other peers
+        self._peers: dict[int, tuple[socket.socket, threading.Lock]] = {}
+        self._peer_addrs: dict[int, tuple[str, int]] = {}
+        self._lock = threading.Lock()  # guards dial/lookup only
+        self._server = socket.create_server(("0.0.0.0", eth_port))
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def learn_peers(self, ranks: list[tuple[int, str, int]], world: int):
+        """Record peers' eth endpoints from a communicator table (cmd port
+        table; eth port = cmd port + world)."""
+        with self._lock:
+            for grank, host, port in ranks:
+                if grank != self.me and port:
+                    self._peer_addrs[grank] = (host, port + world)
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._recv_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _recv_loop(self, conn: socket.socket):
+        try:
+            while True:
+                body = P.recv_frame(conn)
+                if body[0] != P.MSG_ETH:
+                    continue
+                hdr, payload = P.unpack_eth(body[1:])
+                env = Envelope(src=hdr["src"], dst=hdr["dst"],
+                               tag=hdr["tag"], seqn=hdr["seqn"],
+                               nbytes=hdr["nbytes"],
+                               wire_dtype=P.code_dtype(hdr["dtype"]).name,
+                               strm=hdr["strm"], comm_id=hdr["comm_id"])
+                self.ingest(env, payload)
+        except (ConnectionError, OSError):
+            return
+
+    def send(self, env: Envelope, payload: bytes):
+        with self._lock:
+            entry = self._peers.get(env.dst)
+            if entry is None:
+                host, port = self._peer_addrs[env.dst]
+                sock = socket.create_connection((host, port), timeout=10)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                entry = (sock, threading.Lock())
+                self._peers[env.dst] = entry
+        sock, peer_lock = entry
+        frame = P.pack_eth(env.src, env.dst, env.tag, env.seqn,
+                           env.comm_id, env.strm,
+                           P.dtype_code(env.wire_dtype), payload)
+        with peer_lock:
+            P.send_frame(sock, frame)
+
+    def close(self):
+        self._server.close()
+        for sock, _ in self._peers.values():
+            sock.close()
+
+
+class RankDaemon:
+    """One emulated rank: memory + pool + executor + async call queue."""
+
+    def __init__(self, rank: int, world: int, port_base: int,
+                 nbufs: int = 16, bufsize: int = 1 << 20,
+                 host: str = "0.0.0.0"):
+        self.rank = rank
+        self.world = world
+        self.port_base = port_base
+        self.mem = DeviceMemory()
+        self.pool = RxBufferPool(nbufs, bufsize)
+        self.bufsize = bufsize
+        self.timeout = 30.0
+        self.max_segment_size = bufsize
+        self.comms: dict[int, Communicator] = {}
+        self.eth = EthFabric(rank, port_base + world + rank, self._ingest)
+        self.executor = MoveExecutor(self.mem, self.pool, self.eth.send,
+                                     timeout=self.timeout)
+        self._arrays: dict[int, np.ndarray] = {}
+        # async call tracking (hostctrl ap_ctrl_chain parity)
+        self._next_call_id = 1
+        self._call_status: dict[int, int | None] = {}
+        self._call_cv = threading.Condition()
+        self._call_queue: list[tuple[int, dict]] = []
+        self._stop = threading.Event()
+        threading.Thread(target=self._call_worker, daemon=True).start()
+        self._server = socket.create_server((host, port_base + rank))
+
+    # -- ingress -----------------------------------------------------------
+    def _ingest(self, env: Envelope, payload: bytes):
+        if env.strm:
+            self.executor.deliver_stream(env, payload)
+        else:
+            self.pool.ingest(env, payload, timeout=self.timeout)
+
+    # -- call execution ----------------------------------------------------
+    def _call_worker(self):
+        while not self._stop.is_set():
+            with self._call_cv:
+                while not self._call_queue and not self._stop.is_set():
+                    self._call_cv.wait(0.5)
+                if self._stop.is_set():
+                    return
+                call_id, c = self._call_queue.pop(0)
+            err = self._execute(c)
+            with self._call_cv:
+                self._call_status[call_id] = err
+                self._call_cv.notify_all()
+
+    def _execute(self, c: dict) -> int:
+        try:
+            scenario = CCLOp(c["scenario"])
+            if scenario in (CCLOp.nop, CCLOp.config):
+                return 0
+            comm = self.comms.get(c["comm_id"])
+            if comm is None:
+                return int(ErrorCode.COMM_NOT_CONFIGURED)
+            cfg = ArithConfig(P.code_dtype(c["udtype"]),
+                              P.code_dtype(c["cdtype"]))
+            ctx = MoveContext(world_size=comm.size,
+                              local_rank=comm.local_rank, arithcfg=cfg,
+                              max_segment_size=self.max_segment_size)
+            moves = expand_call(
+                ctx, scenario, count=c["count"], root_src_dst=c["root"],
+                func=ReduceFunc(c["func"]), tag=c["tag"],
+                addr_0=c["addr0"], addr_1=c["addr1"], addr_2=c["addr2"],
+                compression=Compression(c["compression"]),
+                stream=StreamFlags(c["stream"]))
+            return self.executor.execute(moves, cfg, comm)
+        except Exception:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            return int(ErrorCode.INVALID_CALL)
+
+    # -- command server ----------------------------------------------------
+    def serve_forever(self):
+        """Accept driver connections (usually one) and serve requests."""
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                body = P.recv_frame(conn)
+                reply = self._handle(body)
+                P.send_frame(conn, reply)
+                if body[0] == P.MSG_SHUTDOWN:
+                    self.shutdown()
+                    return
+        except (ConnectionError, OSError):
+            return
+
+    def _handle(self, body: bytes) -> bytes:
+        kind = body[0]
+        if kind == P.MSG_PING:
+            return P.status_reply(0)
+        if kind == P.MSG_ALLOC:
+            addr, nbytes = struct.unpack("<2Q", body[1:17])
+            arr = np.zeros(nbytes, np.uint8)
+            self._arrays[addr] = arr
+            self.mem.register(addr, arr)
+            return P.status_reply(0)
+        if kind == P.MSG_FREE:
+            (addr,) = struct.unpack("<Q", body[1:9])
+            self.mem.deregister(addr)
+            self._arrays.pop(addr, None)
+            return P.status_reply(0)
+        if kind == P.MSG_WRITE_MEM:
+            (addr,) = struct.unpack("<Q", body[1:9])
+            data = np.frombuffer(body[9:], np.uint8)
+            self.mem.write(addr, data)
+            return P.status_reply(0)
+        if kind == P.MSG_READ_MEM:
+            addr, nbytes = struct.unpack("<2Q", body[1:17])
+            data = self.mem.read(addr, nbytes, np.dtype(np.uint8))
+            return P.data_reply(data.tobytes())
+        if kind == P.MSG_CONFIG_COMM:
+            comm_id, local_rank, ranks = P.unpack_comm(body[1:])
+            comm = Communicator(
+                ranks=[Rank(host=h, port=p, global_rank=g)
+                       for g, h, p in ranks],
+                local_rank=local_rank, comm_id=comm_id)
+            self.comms[comm_id] = comm
+            self.eth.learn_peers(ranks, self.world)
+            return P.status_reply(0)
+        if kind == P.MSG_SET_TIMEOUT:
+            (t,) = struct.unpack("<d", body[1:9])
+            self.timeout = t
+            self.executor.timeout = t
+            return P.status_reply(0)
+        if kind == P.MSG_SET_SEG:
+            (nbytes,) = struct.unpack("<Q", body[1:9])
+            if nbytes > self.bufsize:
+                return P.status_reply(int(ErrorCode.DMA_SIZE_ERROR))
+            self.max_segment_size = nbytes
+            return P.status_reply(0)
+        if kind == P.MSG_CALL:
+            c = P.unpack_call(body[1:])
+            with self._call_cv:
+                call_id = self._next_call_id
+                self._next_call_id += 1
+                self._call_status[call_id] = None
+                # waitfor ordering: the single worker retires in FIFO order,
+                # and waitfor ids always reference earlier calls
+                self._call_queue.append((call_id, c))
+                self._call_cv.notify_all()
+            return bytes([P.MSG_CALL_ID]) + struct.pack("<I", call_id)
+        if kind == P.MSG_WAIT:
+            (call_id,) = struct.unpack("<I", body[1:5])
+            budget = struct.unpack("<d", body[5:13])[0] if len(body) >= 13 \
+                else self.timeout
+            import time as _time
+            deadline = _time.monotonic() + budget
+            with self._call_cv:
+                while self._call_status.get(call_id) is None:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return P.status_reply(P.STATUS_PENDING)
+                    self._call_cv.wait(remaining)
+                err = self._call_status.pop(call_id)
+            return P.status_reply(err)
+        if kind == P.MSG_GET_INFO:
+            return P.data_reply(struct.pack(
+                "<Q3I", self.bufsize, len(self.pool.bufs), self.world,
+                self.rank))
+        if kind == P.MSG_RESET:
+            self.pool = RxBufferPool(len(self.pool.bufs), self.bufsize)
+            self.executor.pool = self.pool
+            for comm in self.comms.values():
+                for r in comm.ranks:
+                    r.inbound_seq = r.outbound_seq = 0
+            return P.status_reply(0)
+        if kind == P.MSG_DUMP_RX:
+            return P.data_reply(self.pool.describe().encode())
+        if kind == P.MSG_SHUTDOWN:
+            return P.status_reply(0)
+        return P.status_reply(int(ErrorCode.INVALID_CALL))
+
+    def shutdown(self):
+        self._stop.set()
+        self._server.close()
+        self.eth.close()
+
+
+def spawn_world(world: int, port_base: int = 0, nbufs: int = 16,
+                bufsize: int = 1 << 20):
+    """Spawn W in-process daemons on free ports (for tests); returns
+    (daemons, port_base). Multi-process deployments run __main__ per rank."""
+    if port_base == 0:
+        probe = socket.create_server(("127.0.0.1", 0))
+        port_base = probe.getsockname()[1] + 101
+        probe.close()
+    daemons = []
+    for r in range(world):
+        d = RankDaemon(r, world, port_base, nbufs=nbufs, bufsize=bufsize,
+                       host="127.0.0.1")
+        threading.Thread(target=d.serve_forever, daemon=True).start()
+        daemons.append(d)
+    return daemons, port_base
+
+
+def main():
+    ap = argparse.ArgumentParser(description="accl_tpu rank daemon")
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--port-base", type=int, default=45000)
+    ap.add_argument("--nbufs", type=int, default=16)
+    ap.add_argument("--bufsize", type=int, default=1 << 20)
+    args = ap.parse_args()
+    daemon = RankDaemon(args.rank, args.world, args.port_base,
+                        nbufs=args.nbufs, bufsize=args.bufsize)
+    print(f"rank {args.rank}/{args.world} serving on "
+          f"cmd={args.port_base + args.rank} "
+          f"eth={args.port_base + args.world + args.rank}", flush=True)
+    daemon.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""Framed binary wire protocol between driver, rank daemons, and the fabric.
+
+The reference's simulation tier speaks JSON over ZMQ REQ/REP (host calls,
+MMIO, memory) and PUB/SUB (the Ethernet fabric) — test/zmq/zmq_intf.cpp.
+Ours is a length-prefixed binary protocol over plain TCP, chosen so the
+same framing is trivial to implement in the C++ daemon (native/) without a
+JSON/ZMQ dependency. Capability parity is what matters: the same message
+kinds exist (call with 15-descriptor-equivalent fields, read/write device
+memory, config, and eth frames with {src, dst, tag, seqn, strm} envelopes).
+
+Frame: u32-LE body length, then body. Body: u8 message type + payload.
+All integers little-endian; dtypes are u8 codes from DTYPE_CODES.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+import numpy as np
+
+# message types (host <-> daemon)
+MSG_CALL = 1          # call descriptor -> reply MSG_CALL_ID
+MSG_WAIT = 2          # call id (+ f64 budget seconds) -> MSG_STATUS; replies
+#                       STATUS_PENDING when the call has not retired within
+#                       the budget, so clients poll without monopolizing the
+#                       command socket
+MSG_ALLOC = 3         # addr, nbytes -> MSG_STATUS
+MSG_FREE = 4          # addr -> MSG_STATUS
+MSG_WRITE_MEM = 5     # addr, bytes -> MSG_STATUS
+MSG_READ_MEM = 6      # addr, nbytes -> MSG_DATA
+MSG_CONFIG_COMM = 7   # communicator table -> MSG_STATUS
+MSG_SET_TIMEOUT = 8   # f64 seconds -> MSG_STATUS
+MSG_SET_SEG = 9       # u64 bytes -> MSG_STATUS
+MSG_PING = 10         # -> MSG_STATUS
+MSG_SHUTDOWN = 11     # -> MSG_STATUS (daemon exits after reply)
+MSG_RESET = 12        # soft reset -> MSG_STATUS
+MSG_DUMP_RX = 13      # -> MSG_DATA (utf-8 text)
+MSG_GET_INFO = 14     # -> MSG_DATA {bufsize u64, nbufs u32, world u32, rank u32}
+# replies
+MSG_STATUS = 100      # u32 error word
+MSG_CALL_ID = 101     # u32 call id
+MSG_DATA = 102        # raw bytes
+# daemon <-> daemon (eth fabric)
+MSG_ETH = 50          # envelope + payload
+
+DTYPE_CODES = {
+    "float32": 0, "float64": 1, "int32": 2, "int64": 3,
+    "float16": 4, "bfloat16": 5, "int8": 6, "uint8": 7,
+}
+CODE_DTYPES = {v: k for k, v in DTYPE_CODES.items()}
+
+
+def dtype_code(dt) -> int:
+    return DTYPE_CODES[np.dtype(dt).name]
+
+
+def code_dtype(code: int) -> np.dtype:
+    name = CODE_DTYPES[code]
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+# -- framing ----------------------------------------------------------------
+
+def send_frame(sock: socket.socket, body: bytes):
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise ConnectionError("peer closed")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (length,) = struct.unpack("<I", recv_exact(sock, 4))
+    return recv_exact(sock, length)
+
+
+# -- call descriptor --------------------------------------------------------
+# scenario u8, func u8, compression u8, stream u8, udtype u8, cdtype u8,
+# count u64, comm_id u32, root u32, tag u32, addr0 u64, addr1 u64, addr2 u64,
+# n_waitfor u16 + waitfor ids (u32 each)
+_CALL_FMT = "<6BQ3I3QH"
+
+
+def pack_call(scenario: int, func: int, compression: int, stream: int,
+              udtype: int, cdtype: int, count: int, comm_id: int, root: int,
+              tag: int, addr0: int, addr1: int, addr2: int,
+              waitfor: list[int]) -> bytes:
+    body = struct.pack(_CALL_FMT, scenario, func, compression, stream,
+                       udtype, cdtype, count, comm_id, root, tag,
+                       addr0, addr1, addr2, len(waitfor))
+    return bytes([MSG_CALL]) + body + b"".join(
+        struct.pack("<I", w) for w in waitfor)
+
+
+def unpack_call(body: bytes) -> dict:
+    size = struct.calcsize(_CALL_FMT)
+    (scenario, func, compression, stream, udtype, cdtype, count, comm_id,
+     root, tag, a0, a1, a2, nw) = struct.unpack(_CALL_FMT, body[:size])
+    waitfor = list(struct.unpack(f"<{nw}I", body[size:size + 4 * nw]))
+    return dict(scenario=scenario, func=func, compression=compression,
+                stream=stream, udtype=udtype, cdtype=cdtype, count=count,
+                comm_id=comm_id, root=root, tag=tag, addr0=a0, addr1=a1,
+                addr2=a2, waitfor=waitfor)
+
+
+# -- communicator table -----------------------------------------------------
+# comm_id u32, local_rank u32, W u32, then per rank: global_rank u32,
+# eth_port u16, host_len u16 + host utf-8
+def pack_comm(comm_id: int, local_rank: int,
+              ranks: list[tuple[int, str, int]]) -> bytes:
+    out = [bytes([MSG_CONFIG_COMM]),
+           struct.pack("<3I", comm_id, local_rank, len(ranks))]
+    for grank, host, port in ranks:
+        h = host.encode()
+        out.append(struct.pack("<IHH", grank, port, len(h)) + h)
+    return b"".join(out)
+
+
+def unpack_comm(body: bytes) -> tuple[int, int, list[tuple[int, str, int]]]:
+    comm_id, local_rank, n = struct.unpack("<3I", body[:12])
+    off = 12
+    ranks = []
+    for _ in range(n):
+        grank, port, hlen = struct.unpack("<IHH", body[off:off + 8])
+        off += 8
+        host = body[off:off + hlen].decode()
+        off += hlen
+        ranks.append((grank, host, port))
+    return comm_id, local_rank, ranks
+
+
+# -- eth frame --------------------------------------------------------------
+# src u32, dst u32, tag u32, seqn u32, comm_id u32, strm u8, dtype u8,
+# nbytes u64, payload
+_ETH_FMT = "<5I2BQ"
+
+
+def pack_eth(src: int, dst: int, tag: int, seqn: int, comm_id: int,
+             strm: int, dtype: int, payload: bytes) -> bytes:
+    return (bytes([MSG_ETH]) +
+            struct.pack(_ETH_FMT, src, dst, tag, seqn, comm_id, strm,
+                        dtype, len(payload)) + payload)
+
+
+def unpack_eth(body: bytes) -> tuple[dict, bytes]:
+    size = struct.calcsize(_ETH_FMT)
+    src, dst, tag, seqn, comm_id, strm, dtype, nbytes = struct.unpack(
+        _ETH_FMT, body[:size])
+    payload = body[size:size + nbytes]
+    return dict(src=src, dst=dst, tag=tag, seqn=seqn, comm_id=comm_id,
+                strm=strm, dtype=dtype, nbytes=nbytes), payload
+
+
+STATUS_PENDING = 0xFFFFFFFF  # MSG_WAIT: call not yet retired
+
+
+def status_reply(err: int) -> bytes:
+    return bytes([MSG_STATUS]) + struct.pack("<I", err & 0xFFFFFFFF)
+
+
+def data_reply(data: bytes) -> bytes:
+    return bytes([MSG_DATA]) + data

@@ -43,3 +43,50 @@ def run_ranks(accls: Sequence[ACCL], fn: Callable[[ACCL], object],
     with concurrent.futures.ThreadPoolExecutor(len(accls)) as pool:
         futs = [pool.submit(fn, a) for a in accls]
         return [f.result(timeout) for f in futs]
+
+
+def free_port_base(span: int = 64) -> int:
+    """Pick a base for a contiguous block of ports (cmd + eth ranges)."""
+    import socket
+    probe = socket.create_server(("127.0.0.1", 0))
+    base = probe.getsockname()[1] + span
+    probe.close()
+    return base
+
+
+def connect_world(port_base: int, world_size: int,
+                  timeout: float = 20.0, host: str = "127.0.0.1",
+                  connect_retry_s: float = 10.0) -> list[ACCL]:
+    """Connect ACCL drivers to already-running rank daemons (Python or
+    native) listening on cmd ports port_base..port_base+W-1. Retries while
+    daemons are still starting up."""
+    import time
+
+    from .device.sim import SimDevice
+    accls = []
+    for r in range(world_size):
+        comm = Communicator(
+            ranks=[Rank(host=host, port=port_base + i, global_rank=i)
+                   for i in range(world_size)],
+            local_rank=r)
+        deadline = time.monotonic() + connect_retry_s
+        while True:
+            try:
+                dev = SimDevice(host, port_base + r)
+                break
+            except (ConnectionError, OSError):
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        accls.append(ACCL(dev, comm, timeout=timeout))
+    return accls
+
+
+def sim_world(world_size: int, nbufs: int = 16, bufsize: int = 1 << 20,
+              timeout: float = 20.0) -> list[ACCL]:
+    """Create ACCL instances driving out-of-process-style rank daemons over
+    the socket protocol (daemons run in-process threads here; the same
+    protocol drives true multi-process daemons and the native C++ daemon)."""
+    from .emulator.daemon import spawn_world
+    _, port_base = spawn_world(world_size, nbufs=nbufs, bufsize=bufsize)
+    return connect_world(port_base, world_size, timeout=timeout)

@@ -1,0 +1,1235 @@
+// accl_tpu native rank daemon: a C++ emulated device behind the framed-TCP
+// protocol (accl_tpu/emulator/protocol.py).
+//
+// Role parity with the reference's CPU emulator process
+// (test/emulation/cclo_emu.cpp): one OS process per rank hosting device
+// memory, an eager-ingress spare-buffer pool with (src, tag, seqn) envelope
+// matching (rxbuf_offload engines + seek_rx_buffer), a control plane that
+// expands collectives into move micro-ops (ccl_offload_control.c:502-1098),
+// and a dataplane executor (dma_mover + reduce_sum/compression plugins).
+// The Python driver's SimDevice cannot tell this daemon from the Python one
+// (accl_tpu/emulator/daemon.py) — the property the 3-tier test story needs.
+//
+// Build: make -C native   (g++ -O2 -std=c++17 -pthread)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+
+// ---------------------------------------------------------------------------
+// protocol constants (must match accl_tpu/emulator/protocol.py)
+// ---------------------------------------------------------------------------
+enum Msg : uint8_t {
+  MSG_CALL = 1, MSG_WAIT = 2, MSG_ALLOC = 3, MSG_FREE = 4,
+  MSG_WRITE_MEM = 5, MSG_READ_MEM = 6, MSG_CONFIG_COMM = 7,
+  MSG_SET_TIMEOUT = 8, MSG_SET_SEG = 9, MSG_PING = 10, MSG_SHUTDOWN = 11,
+  MSG_RESET = 12, MSG_DUMP_RX = 13, MSG_GET_INFO = 14,
+  MSG_STATUS = 100, MSG_CALL_ID = 101, MSG_DATA = 102,
+  MSG_ETH = 50,
+};
+
+static const uint32_t STATUS_PENDING = 0xFFFFFFFFu;
+
+enum Op : uint8_t {
+  OP_CONFIG = 0, OP_COPY = 1, OP_COMBINE = 2, OP_SEND = 3, OP_RECV = 4,
+  OP_BCAST = 5, OP_SCATTER = 6, OP_GATHER = 7, OP_REDUCE = 8,
+  OP_ALLGATHER = 9, OP_ALLREDUCE = 10, OP_REDUCE_SCATTER = 11,
+  OP_BARRIER = 12, OP_ALLTOALL = 13, OP_NOP = 255,
+};
+
+enum Func : uint8_t { FN_SUM = 0, FN_MAX = 1, FN_MIN = 2, FN_PROD = 3 };
+
+enum CompFlag : uint8_t {
+  C_NONE = 0, C_OP0 = 1, C_OP1 = 2, C_RES = 4, C_ETH = 8,
+};
+
+enum Err : uint32_t {
+  E_OK = 0,
+  E_DMA_MISMATCH = 1u << 0,
+  E_RECV_TIMEOUT = 1u << 8,
+  E_DMA_SIZE = 1u << 12,
+  E_COMM_NOT_CONFIGURED = 1u << 15,
+  E_SPARE_OVERFLOW = 1u << 20,
+  E_INVALID = 1u << 23,
+};
+
+static const uint32_t TAG_ANY = 0xFFFFFFFFu;
+
+// ---------------------------------------------------------------------------
+// dtypes: codes match protocol.py DTYPE_CODES
+// ---------------------------------------------------------------------------
+enum DType : uint8_t {
+  DT_F32 = 0, DT_F64 = 1, DT_I32 = 2, DT_I64 = 3,
+  DT_F16 = 4, DT_BF16 = 5, DT_I8 = 6, DT_U8 = 7,
+};
+
+static size_t dtype_size(uint8_t dt) {
+  switch (dt) {
+    case DT_F32: case DT_I32: return 4;
+    case DT_F64: case DT_I64: return 8;
+    case DT_F16: case DT_BF16: return 2;
+    default: return 1;
+  }
+}
+
+static float half_to_float(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t man = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;
+    } else {  // subnormal: normalize
+      int shift = 0;
+      while (!(man & 0x400)) { man <<= 1; ++shift; }
+      man &= 0x3FF;
+      bits = sign | ((127 - 15 - shift) << 23) | (man << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000u | (man << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+static uint16_t float_to_half(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint16_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xFF) - 127 + 15;
+  uint32_t man = bits & 0x7FFFFFu;
+  if (((bits >> 23) & 0xFF) == 0xFF) {  // inf/nan
+    return sign | 0x7C00u | (man ? 0x200u : 0);
+  }
+  if (exp >= 31) return sign | 0x7C00u;  // overflow -> inf
+  if (exp <= 0) {                        // subnormal/underflow
+    if (exp < -10) return sign;
+    man |= 0x800000u;
+    uint32_t shift = 14 - exp;
+    uint16_t h = man >> shift;
+    if ((man >> (shift - 1)) & 1) ++h;  // round-nearest
+    return sign | h;
+  }
+  uint16_t h = sign | (exp << 10) | (man >> 13);
+  if (man & 0x1000u) ++h;  // round-nearest
+  return h;
+}
+
+static float bf16_to_float(uint16_t b) {
+  uint32_t bits = static_cast<uint32_t>(b) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+static uint16_t float_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t lsb = (bits >> 16) & 1;
+  bits += 0x7FFFu + lsb;  // round-to-nearest-even
+  return static_cast<uint16_t>(bits >> 16);
+}
+
+// read element i of a typed buffer as double
+static double load_elem(const uint8_t* p, uint8_t dt, size_t i) {
+  switch (dt) {
+    case DT_F32: { float v; std::memcpy(&v, p + 4 * i, 4); return v; }
+    case DT_F64: { double v; std::memcpy(&v, p + 8 * i, 8); return v; }
+    case DT_I32: { int32_t v; std::memcpy(&v, p + 4 * i, 4); return v; }
+    case DT_I64: { int64_t v; std::memcpy(&v, p + 8 * i, 8); return (double)v; }
+    case DT_F16: { uint16_t v; std::memcpy(&v, p + 2 * i, 2); return half_to_float(v); }
+    case DT_BF16: { uint16_t v; std::memcpy(&v, p + 2 * i, 2); return bf16_to_float(v); }
+    case DT_I8: return reinterpret_cast<const int8_t*>(p)[i];
+    default: return p[i];
+  }
+}
+
+static void store_elem(uint8_t* p, uint8_t dt, size_t i, double v) {
+  switch (dt) {
+    case DT_F32: { float f = (float)v; std::memcpy(p + 4 * i, &f, 4); break; }
+    case DT_F64: std::memcpy(p + 8 * i, &v, 8); break;
+    case DT_I32: { int32_t x = (int32_t)llround(v); std::memcpy(p + 4 * i, &x, 4); break; }
+    case DT_I64: { int64_t x = (int64_t)llround(v); std::memcpy(p + 8 * i, &x, 8); break; }
+    case DT_F16: { uint16_t h = float_to_half((float)v); std::memcpy(p + 2 * i, &h, 2); break; }
+    case DT_BF16: { uint16_t b = float_to_bf16((float)v); std::memcpy(p + 2 * i, &b, 2); break; }
+    case DT_I8: reinterpret_cast<int8_t*>(p)[i] = (int8_t)llround(v); break;
+    default: p[i] = (uint8_t)llround(v); break;
+  }
+}
+
+// 64-bit integer exactness: int64 sums beyond 2^53 lose precision through
+// double; keep a dedicated integer path when both sides are integral.
+static bool is_integral(uint8_t dt) {
+  return dt == DT_I32 || dt == DT_I64 || dt == DT_I8 || dt == DT_U8;
+}
+
+static int64_t load_int(const uint8_t* p, uint8_t dt, size_t i) {
+  switch (dt) {
+    case DT_I32: { int32_t v; std::memcpy(&v, p + 4 * i, 4); return v; }
+    case DT_I64: { int64_t v; std::memcpy(&v, p + 8 * i, 8); return v; }
+    case DT_I8: return reinterpret_cast<const int8_t*>(p)[i];
+    default: return p[i];
+  }
+}
+
+static void store_int(uint8_t* p, uint8_t dt, size_t i, int64_t v) {
+  switch (dt) {
+    case DT_I32: { int32_t x = (int32_t)v; std::memcpy(p + 4 * i, &x, 4); break; }
+    case DT_I64: std::memcpy(p + 8 * i, &v, 8); break;
+    case DT_I8: reinterpret_cast<int8_t*>(p)[i] = (int8_t)v; break;
+    default: p[i] = (uint8_t)v; break;
+  }
+}
+
+// convert n elements between dtypes (the compression-lane plugins'
+// capability: fp_hp/hp_fp_stream_conv, generalized to all dtype pairs)
+static std::vector<uint8_t> convert(const std::vector<uint8_t>& src,
+                                    uint8_t sdt, uint8_t ddt, size_t n) {
+  if (sdt == ddt) return src;
+  std::vector<uint8_t> dst(n * dtype_size(ddt));
+  if (is_integral(sdt) && is_integral(ddt)) {
+    for (size_t i = 0; i < n; ++i) store_int(dst.data(), ddt, i, load_int(src.data(), sdt, i));
+  } else {
+    for (size_t i = 0; i < n; ++i) store_elem(dst.data(), ddt, i, load_elem(src.data(), sdt, i));
+  }
+  return dst;
+}
+
+// a = func(a, b), both in dtype dt, n elements (reduce_sum plugin parity,
+// extended to max/min/prod like the XRT driver's enum set)
+static void reduce_inplace(std::vector<uint8_t>& a,
+                           const std::vector<uint8_t>& b, uint8_t dt,
+                           uint8_t func, size_t n) {
+  if (is_integral(dt)) {
+    for (size_t i = 0; i < n; ++i) {
+      int64_t x = load_int(a.data(), dt, i), y = load_int(b.data(), dt, i);
+      int64_t r = func == FN_SUM ? x + y : func == FN_MAX ? std::max(x, y)
+                  : func == FN_MIN ? std::min(x, y) : x * y;
+      store_int(a.data(), dt, i, r);
+    }
+  } else {
+    for (size_t i = 0; i < n; ++i) {
+      double x = load_elem(a.data(), dt, i), y = load_elem(b.data(), dt, i);
+      double r = func == FN_SUM ? x + y : func == FN_MAX ? std::max(x, y)
+                 : func == FN_MIN ? std::min(x, y) : x * y;
+      store_elem(a.data(), dt, i, r);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// framing
+// ---------------------------------------------------------------------------
+static bool recv_exact(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool recv_frame(int fd, std::vector<uint8_t>& body) {
+  uint32_t len;
+  if (!recv_exact(fd, &len, 4)) return false;
+  body.resize(len);
+  return len == 0 || recv_exact(fd, body.data(), len);
+}
+
+static bool send_frame(int fd, const std::vector<uint8_t>& body) {
+  uint32_t len = static_cast<uint32_t>(body.size());
+  std::vector<uint8_t> out(4 + body.size());
+  std::memcpy(out.data(), &len, 4);
+  std::memcpy(out.data() + 4, body.data(), body.size());
+  const uint8_t* p = out.data();
+  size_t n = out.size();
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+template <typename T>
+static T get_le(const uint8_t* p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  return v;
+}
+
+template <typename T>
+static void put_le(std::vector<uint8_t>& out, T v) {
+  size_t off = out.size();
+  out.resize(off + sizeof(T));
+  std::memcpy(out.data() + off, &v, sizeof(T));
+}
+
+static std::vector<uint8_t> status_reply(uint32_t err) {
+  std::vector<uint8_t> r{MSG_STATUS};
+  put_le<uint32_t>(r, err);
+  return r;
+}
+
+// ---------------------------------------------------------------------------
+// envelope + rx pool (rxbuf_offload / seek_rx_buffer / wait_on_rx parity)
+// ---------------------------------------------------------------------------
+struct Envelope {
+  uint32_t src, dst, tag, seqn, comm_id;
+  uint8_t strm, dtype;
+  uint64_t nbytes;
+};
+
+struct RxBuffer {
+  bool reserved = false;
+  Envelope env{};
+  std::vector<uint8_t> payload;
+};
+
+class RxBufferPool {
+ public:
+  RxBufferPool(size_t nbufs, size_t bufsize)
+      : bufs_(nbufs), bufsize_(bufsize) {}
+
+  uint32_t ingest(const Envelope& env, std::vector<uint8_t>&& payload,
+                  double timeout_s) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (payload.size() > bufsize_) { error_word |= E_DMA_SIZE; return E_DMA_SIZE; }
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s);
+    for (;;) {
+      for (auto& b : bufs_) {
+        if (!b.reserved) {
+          b.reserved = true;
+          b.env = env;
+          b.payload = std::move(payload);
+          cv_.notify_all();
+          return E_OK;
+        }
+      }
+      if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+        error_word |= E_SPARE_OVERFLOW;
+        return E_SPARE_OVERFLOW;
+      }
+    }
+  }
+
+  bool seek(uint32_t src, uint32_t tag, uint32_t seqn, uint32_t comm_id,
+            double timeout_s, Envelope* env_out,
+            std::vector<uint8_t>* payload_out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s);
+    for (;;) {
+      for (auto& b : bufs_) {
+        if (!b.reserved) continue;
+        if (b.env.src != src || b.env.seqn != seqn) continue;
+        if (b.env.comm_id != comm_id) continue;
+        if (tag != TAG_ANY && b.env.tag != tag && b.env.tag != TAG_ANY) continue;
+        *env_out = b.env;
+        *payload_out = std::move(b.payload);
+        b.reserved = false;
+        b.payload.clear();
+        cv_.notify_all();
+        return true;
+      }
+      if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) return false;
+    }
+  }
+
+  std::string describe() {
+    std::unique_lock<std::mutex> lk(mu_);
+    size_t occ = 0;
+    for (auto& b : bufs_) occ += b.reserved ? 1 : 0;
+    char line[128];
+    snprintf(line, sizeof line, "RX pool: %zu x %zuB, %zu reserved (native)",
+             bufs_.size(), bufsize_, occ);
+    return std::string(line);
+  }
+
+  void reset() {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (auto& b : bufs_) { b.reserved = false; b.payload.clear(); }
+    error_word = 0;
+    cv_.notify_all();
+  }
+
+  std::atomic<uint32_t> error_word{0};
+
+ private:
+  std::vector<RxBuffer> bufs_;
+  size_t bufsize_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+};
+
+// ---------------------------------------------------------------------------
+// device memory (SimBuffer fake-phys-addr model)
+// ---------------------------------------------------------------------------
+class DeviceMemory {
+ public:
+  void alloc(uint64_t addr, uint64_t nbytes) {
+    std::lock_guard<std::mutex> lk(mu_);
+    regions_[addr] = std::vector<uint8_t>(nbytes, 0);
+  }
+  void free_region(uint64_t addr) {
+    std::lock_guard<std::mutex> lk(mu_);
+    regions_.erase(addr);
+  }
+  bool write(uint64_t addr, const uint8_t* data, uint64_t nbytes) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto* r = resolve(addr, nbytes);
+    if (!r) return false;
+    std::memcpy(r->second.data() + (addr - r->first), data, nbytes);
+    return true;
+  }
+  bool read(uint64_t addr, uint8_t* out, uint64_t nbytes) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto* r = resolve(addr, nbytes);
+    if (!r) return false;
+    std::memcpy(out, r->second.data() + (addr - r->first), nbytes);
+    return true;
+  }
+
+ private:
+  std::pair<const uint64_t, std::vector<uint8_t>>* resolve(uint64_t addr,
+                                                           uint64_t nbytes) {
+    auto it = regions_.upper_bound(addr);
+    if (it == regions_.begin()) return nullptr;
+    --it;
+    if (addr >= it->first && addr + nbytes <= it->first + it->second.size())
+      return &*it;
+    return nullptr;
+  }
+  std::map<uint64_t, std::vector<uint8_t>> regions_;
+  std::mutex mu_;
+};
+
+// ---------------------------------------------------------------------------
+// communicator (exchange-memory communicator record parity)
+// ---------------------------------------------------------------------------
+struct RankInfo {
+  uint32_t global_rank;
+  std::string host;
+  uint16_t cmd_port;
+  uint32_t inbound_seq = 0, outbound_seq = 0;
+};
+
+struct Communicator {
+  uint32_t comm_id = 0;
+  uint32_t local_rank = 0;
+  std::vector<RankInfo> ranks;
+  uint32_t size() const { return static_cast<uint32_t>(ranks.size()); }
+  uint32_t my_global() const { return ranks[local_rank].global_rank; }
+};
+
+// ---------------------------------------------------------------------------
+// eth fabric: lazy peer dial + accept/ingest loops (zmq pub/sub wire parity)
+// ---------------------------------------------------------------------------
+class RankDaemon;  // fwd
+
+class EthFabric {
+ public:
+  EthFabric(uint32_t me, uint16_t listen_port, RankDaemon* daemon);
+  ~EthFabric();
+  void learn_peer(uint32_t grank, const std::string& host, uint16_t eth_port) {
+    std::lock_guard<std::mutex> lk(mu_);
+    peer_addrs_[grank] = {host, eth_port};
+  }
+  bool send_msg(const Envelope& env, const std::vector<uint8_t>& payload);
+  void stop();
+
+ private:
+  void accept_loop();
+  void recv_loop(int fd);
+  uint32_t me_;
+  int listen_fd_ = -1;
+  RankDaemon* daemon_;
+  std::map<uint32_t, int> peers_;
+  // per-peer send mutexes: one slow peer's TCP backpressure must not stall
+  // sends to other peers (mu_ guards only lookup/dial)
+  std::map<uint32_t, std::unique_ptr<std::mutex>> peer_mus_;
+  std::map<uint32_t, std::pair<std::string, uint16_t>> peer_addrs_;
+  std::mutex mu_;
+  std::vector<std::thread> threads_;
+  std::atomic<bool> stopping_{false};
+};
+
+// ---------------------------------------------------------------------------
+// move micro-ops (move_instruction parity) + control plane expansions
+// (ccl_offload_control.c:502-1098 ring algorithms, re-derived)
+// ---------------------------------------------------------------------------
+enum MoveMode : uint8_t { M_NONE = 0, M_IMM = 1, M_ON_RECV = 2, M_STREAM = 3 };
+
+struct Operand {
+  MoveMode mode = M_NONE;
+  uint64_t addr = 0;
+  uint32_t src_rank = 0;  // comm-local, for ON_RECV
+  uint32_t tag = TAG_ANY;
+  bool compressed = false;
+};
+
+struct Move {
+  uint64_t count = 0;
+  Operand op0, op1, res;
+  int func = -1;  // -1 = passthrough
+  bool res_remote = false, res_local = false;
+  uint32_t dst_rank = 0;  // comm-local
+  uint32_t tag = TAG_ANY;
+  bool eth_compressed = false;
+  bool remote_stream = false;
+};
+
+struct CallCtx {
+  uint32_t world, me;
+  uint8_t udtype, cdtype;
+  uint64_t max_seg;
+  uint8_t compression;
+  uint8_t stream = 0;  // StreamFlags: 1 = OP0_STREAM, 2 = RES_STREAM
+
+  size_t ebytes(bool compressed) const {
+    return dtype_size(compressed ? cdtype : udtype);
+  }
+  uint64_t seg_elems() const {
+    size_t e = dtype_size((compression & C_ETH) ? cdtype : udtype);
+    uint64_t s = max_seg / (e ? e : 1);
+    return s ? s : 1;
+  }
+};
+
+static void push_send(std::vector<Move>& mv, const CallCtx& c, uint64_t count,
+                      uint64_t src, uint32_t dst, uint32_t tag,
+                      bool remote_stream = false) {
+  uint64_t seg = c.seg_elems();
+  size_t eb = c.ebytes(c.compression & C_OP0);
+  bool op0_stream = (c.stream & 1) != 0;
+  for (uint64_t off = 0; off < count; off += seg) {
+    Move m;
+    m.count = std::min(seg, count - off);
+    if (op0_stream)
+      m.op0 = {M_STREAM, 0, 0, TAG_ANY, false};
+    else
+      m.op0 = {M_IMM, src + off * eb, 0, TAG_ANY,
+               (c.compression & C_OP0) != 0};
+    m.res_remote = true;
+    m.dst_rank = dst;
+    m.tag = tag;
+    m.eth_compressed = (c.compression & C_ETH) != 0;
+    m.remote_stream = remote_stream;
+    mv.push_back(m);
+  }
+}
+
+static void push_recv(std::vector<Move>& mv, const CallCtx& c, uint64_t count,
+                      uint32_t src, uint64_t dst, uint32_t tag) {
+  uint64_t seg = c.seg_elems();
+  size_t eb = c.ebytes(c.compression & C_RES);
+  for (uint64_t off = 0; off < count; off += seg) {
+    Move m;
+    m.count = std::min(seg, count - off);
+    m.op1 = {M_ON_RECV, 0, src, tag, false};
+    m.res = {M_IMM, dst + off * eb, 0, TAG_ANY, (c.compression & C_RES) != 0};
+    m.res_local = true;
+    m.eth_compressed = (c.compression & C_ETH) != 0;
+    mv.push_back(m);
+  }
+}
+
+static void push_copy(std::vector<Move>& mv, const CallCtx& c, uint64_t count,
+                      uint64_t src, uint64_t dst) {
+  Move m;
+  m.count = count;
+  m.op0 = {M_IMM, src, 0, TAG_ANY, (c.compression & C_OP0) != 0};
+  m.res = {M_IMM, dst, 0, TAG_ANY, (c.compression & C_RES) != 0};
+  m.res_local = true;
+  mv.push_back(m);
+}
+
+static void push_frr(std::vector<Move>& mv, const CallCtx& c, uint64_t count,
+                     int func, uint32_t src, uint64_t op0, uint64_t dst,
+                     uint32_t tag) {
+  // fused recv-reduce into local dst
+  uint64_t seg = c.seg_elems();
+  size_t e0 = c.ebytes(c.compression & C_OP0);
+  size_t er = c.ebytes(c.compression & C_RES);
+  for (uint64_t off = 0; off < count; off += seg) {
+    Move m;
+    m.count = std::min(seg, count - off);
+    m.op0 = {M_IMM, op0 + off * e0, 0, TAG_ANY, (c.compression & C_OP0) != 0};
+    m.op1 = {M_ON_RECV, 0, src, tag, false};
+    m.res = {M_IMM, dst + off * er, 0, TAG_ANY, (c.compression & C_RES) != 0};
+    m.func = func;
+    m.res_local = true;
+    m.eth_compressed = (c.compression & C_ETH) != 0;
+    mv.push_back(m);
+  }
+}
+
+static void push_frrs(std::vector<Move>& mv, const CallCtx& c, uint64_t count,
+                      int func, uint32_t src, uint32_t dst_rank, uint64_t op0,
+                      uint32_t tag) {
+  // fused recv-reduce-send to the next ring neighbor
+  uint64_t seg = c.seg_elems();
+  size_t e0 = c.ebytes(c.compression & C_OP0);
+  for (uint64_t off = 0; off < count; off += seg) {
+    Move m;
+    m.count = std::min(seg, count - off);
+    m.op0 = {M_IMM, op0 + off * e0, 0, TAG_ANY, (c.compression & C_OP0) != 0};
+    m.op1 = {M_ON_RECV, 0, src, tag, false};
+    m.func = func;
+    m.res_remote = true;
+    m.dst_rank = dst_rank;
+    m.tag = tag;
+    m.eth_compressed = (c.compression & C_ETH) != 0;
+    mv.push_back(m);
+  }
+}
+
+// expand one call into a move program; mirrors the ring algorithms
+// (decreasing-rank data flow: rank r forwards to r-1, receives from r+1)
+static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
+                       int func, uint64_t count, uint32_t root, uint32_t tag,
+                       uint64_t a0, uint64_t a1, uint64_t a2) {
+  const uint32_t W = c.world, me = c.me;
+  size_t eb = c.ebytes(c.compression & C_OP0);
+  size_t ebr = c.ebytes(c.compression & C_RES);
+  switch (op) {
+    case OP_NOP: case OP_CONFIG: case OP_BARRIER:
+      return E_OK;
+    case OP_COPY:
+      push_copy(mv, c, count, a0, a2);
+      return E_OK;
+    case OP_COMBINE: {
+      Move m;
+      m.count = count;
+      m.op0 = {M_IMM, a0, 0, TAG_ANY, (c.compression & C_OP0) != 0};
+      m.op1 = {M_IMM, a1, 0, TAG_ANY, (c.compression & C_OP1) != 0};
+      m.res = {M_IMM, a2, 0, TAG_ANY, (c.compression & C_RES) != 0};
+      m.func = func;
+      m.res_local = true;
+      mv.push_back(m);
+      return E_OK;
+    }
+    case OP_SEND:
+      // RES_STREAM on a send targets the peer's stream port (remote-stream
+      // send, matching moveengine.expand_call)
+      push_send(mv, c, count, a0, root, tag, (c.stream & 2) != 0);
+      return E_OK;
+    case OP_RECV:
+      push_recv(mv, c, count, root, a2, tag);
+      return E_OK;
+    case OP_BCAST:
+      if (me == root) {
+        for (uint32_t r = 0; r < W; ++r)
+          if (r != root) push_send(mv, c, count, a0, r, TAG_ANY);
+      } else {
+        push_recv(mv, c, count, root, a0, TAG_ANY);
+      }
+      return E_OK;
+    case OP_SCATTER:
+      if (me == root) {
+        for (uint32_t r = 0; r < W; ++r) {
+          uint64_t chunk = a0 + (uint64_t)r * count * eb;
+          if (r == root) push_copy(mv, c, count, chunk, a2);
+          else push_send(mv, c, count, chunk, r, TAG_ANY);
+        }
+      } else {
+        push_recv(mv, c, count, root, a2, TAG_ANY);
+      }
+      return E_OK;
+    case OP_GATHER: {
+      uint32_t dist = (me + W - root) % W;
+      uint32_t prv = (me + 1) % W, nxt = (me + W - 1) % W;
+      if (me == root) {
+        push_copy(mv, c, count, a0, a2 + (uint64_t)me * count * ebr);
+        for (uint32_t i = 0; i + 1 < W; ++i) {
+          uint32_t owner = (root + 1 + i) % W;
+          push_recv(mv, c, count, prv, a2 + (uint64_t)owner * count * ebr,
+                    TAG_ANY);
+        }
+      } else {
+        push_send(mv, c, count, a0, nxt, TAG_ANY);
+        for (uint32_t i = 0; i < W - 1 - dist; ++i) {
+          push_recv(mv, c, count, prv, a2, TAG_ANY);
+          push_send(mv, c, count, a2, nxt, TAG_ANY);
+        }
+      }
+      return E_OK;
+    }
+    case OP_ALLGATHER: {
+      uint32_t nxt = (me + 1) % W, prv = (me + W - 1) % W;
+      push_copy(mv, c, count, a0, a2 + (uint64_t)me * count * ebr);
+      push_send(mv, c, count, a0, nxt, TAG_ANY);
+      for (uint32_t i = 0; i + 1 < W; ++i) {
+        uint32_t owner = (me + W - 1 - i) % W;
+        uint64_t slot = a2 + (uint64_t)owner * count * ebr;
+        push_recv(mv, c, count, prv, slot, TAG_ANY);
+        if (i + 2 < W) push_send(mv, c, count, slot, nxt, TAG_ANY);
+      }
+      return E_OK;
+    }
+    case OP_REDUCE: {
+      uint32_t nxt = (me + W - 1) % W, prv = (me + 1) % W;
+      if (W == 1) { push_copy(mv, c, count, a0, a2); return E_OK; }
+      if ((me + W - root) % W == W - 1) {
+        push_send(mv, c, count, a0, nxt, TAG_ANY);
+      } else if (me == root) {
+        push_frr(mv, c, count, func, prv, a0, a2, TAG_ANY);
+      } else {
+        push_frrs(mv, c, count, func, prv, nxt, a0, TAG_ANY);
+      }
+      return E_OK;
+    }
+    case OP_REDUCE_SCATTER: {
+      if (W == 1) { push_copy(mv, c, count, a0, a2); return E_OK; }
+      uint32_t nxt = (me + W - 1) % W, prv = (me + 1) % W;
+      push_send(mv, c, count, a0 + (uint64_t)((me + 1) % W) * count * eb, nxt,
+                TAG_ANY);
+      for (uint32_t i = 1; i < W; ++i) {
+        uint32_t chunk = (me + 1 + i) % W;
+        uint64_t op0 = a0 + (uint64_t)chunk * count * eb;
+        if (i + 1 < W) push_frrs(mv, c, count, func, prv, nxt, op0, TAG_ANY);
+        else push_frr(mv, c, count, func, prv, op0, a2, TAG_ANY);
+      }
+      return E_OK;
+    }
+    case OP_ALLREDUCE: {
+      if (W == 1) { push_copy(mv, c, count, a0, a2); return E_OK; }
+      uint64_t bulk = count / W;
+      uint64_t tail = count - bulk * (W - 1);
+      auto clen = [&](uint32_t ch) { return ch == W - 1 ? tail : bulk; };
+      auto coff = [&](uint32_t ch) { return (uint64_t)ch * bulk; };
+      uint32_t nxt = (me + W - 1) % W, prv = (me + 1) % W;
+      // phase 1: ring reduce-scatter
+      uint32_t c0 = (me + 1) % W;
+      if (clen(c0)) push_send(mv, c, clen(c0), a0 + coff(c0) * eb, nxt, TAG_ANY);
+      for (uint32_t i = 1; i < W; ++i) {
+        uint32_t ch = (me + 1 + i) % W;
+        if (!clen(ch)) continue;
+        if (i + 1 < W)
+          push_frrs(mv, c, clen(ch), func, prv, nxt, a0 + coff(ch) * eb, TAG_ANY);
+        else
+          push_frr(mv, c, clen(ch), func, prv, a0 + coff(ch) * eb,
+                   a2 + coff(ch) * ebr, TAG_ANY);
+      }
+      // phase 2: ring allgather from dst
+      if (clen(me)) push_send(mv, c, clen(me), a2 + coff(me) * ebr, nxt, TAG_ANY);
+      for (uint32_t i = 1; i < W; ++i) {
+        uint32_t ch = (me + i) % W;
+        if (!clen(ch)) continue;
+        uint64_t slot = a2 + coff(ch) * ebr;
+        push_recv(mv, c, clen(ch), prv, slot, TAG_ANY);
+        if (i + 1 < W) push_send(mv, c, clen(ch), slot, nxt, TAG_ANY);
+      }
+      return E_OK;
+    }
+    case OP_ALLTOALL: {
+      push_copy(mv, c, count, a0 + (uint64_t)me * count * eb,
+                a2 + (uint64_t)me * count * ebr);
+      for (uint32_t step = 1; step < W; ++step) {
+        uint32_t to = (me + step) % W, frm = (me + W - step) % W;
+        push_send(mv, c, count, a0 + (uint64_t)to * count * eb, to, TAG_ANY);
+        push_recv(mv, c, count, frm, a2 + (uint64_t)frm * count * ebr, TAG_ANY);
+      }
+      return E_OK;
+    }
+    default:
+      return E_INVALID;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// the daemon
+// ---------------------------------------------------------------------------
+class RankDaemon {
+ public:
+  RankDaemon(uint32_t rank, uint32_t world, uint16_t port_base, size_t nbufs,
+             size_t bufsize)
+      : rank_(rank), world_(world), port_base_(port_base),
+        pool_(nbufs, bufsize), bufsize_(bufsize), max_seg_(bufsize),
+        nbufs_(nbufs),
+        eth_(rank, static_cast<uint16_t>(port_base + world + rank), this) {
+    worker_ = std::thread([this] { call_worker(); });
+  }
+
+  void ingest(const Envelope& env, std::vector<uint8_t>&& payload) {
+    if (env.strm) {
+      std::lock_guard<std::mutex> lk(stream_mu_);
+      stream_in_.push_back({env, std::move(payload)});
+      stream_cv_.notify_all();
+    } else {
+      pool_.ingest(env, std::move(payload), timeout_);
+    }
+  }
+
+  int serve(uint16_t cmd_port);  // blocking accept loop
+
+  std::atomic<bool> shutting_down{false};
+
+ private:
+  friend class EthFabric;
+
+  // ---- dataplane executor (dma_mover pipeline parity) ----
+  uint32_t execute_moves(const std::vector<Move>& moves, const CallCtx& c,
+                         Communicator& comm) {
+    for (const auto& m : moves) {
+      std::vector<uint8_t> op0, op1;  // in uncompressed dtype
+      uint32_t err;
+      bool have0 = false, have1 = false;
+      err = fetch(m.op0, m, c, comm, &op0, &have0);
+      if (err) return err;
+      err = fetch(m.op1, m, c, comm, &op1, &have1);
+      if (err) return err;
+      std::vector<uint8_t>* result = nullptr;
+      if (have0 && have1) {
+        if (m.func < 0) return E_INVALID;
+        reduce_inplace(op0, op1, c.udtype, (uint8_t)m.func, m.count);
+        result = &op0;
+      } else if (have0) {
+        result = &op0;
+      } else if (have1) {
+        result = &op1;
+      } else {
+        return E_INVALID;
+      }
+      if (m.res_local) {
+        uint8_t out_dt = m.res.compressed ? c.cdtype : c.udtype;
+        auto out = convert(*result, c.udtype, out_dt, m.count);
+        if (!mem_.write(m.res.addr, out.data(), out.size())) return E_INVALID;
+      }
+      if (m.res_remote) {
+        uint8_t wire_dt = m.eth_compressed ? c.cdtype : c.udtype;
+        auto wire = convert(*result, c.udtype, wire_dt, m.count);
+        RankInfo& peer = comm.ranks[m.dst_rank];
+        Envelope env;
+        env.src = comm.my_global();
+        env.dst = peer.global_rank;
+        env.tag = m.tag;
+        env.seqn = peer.outbound_seq++;
+        env.comm_id = comm.comm_id;
+        env.strm = m.remote_stream ? 1 : 0;
+        env.dtype = wire_dt;
+        env.nbytes = wire.size();
+        if (!eth_.send_msg(env, wire)) return E_INVALID;
+      }
+    }
+    return E_OK;
+  }
+
+  uint32_t fetch(const Operand& o, const Move& m, const CallCtx& c,
+                 Communicator& comm, std::vector<uint8_t>* out, bool* have) {
+    *have = false;
+    if (o.mode == M_NONE) return E_OK;
+    if (o.mode == M_IMM) {
+      uint8_t stored = o.compressed ? c.cdtype : c.udtype;
+      std::vector<uint8_t> raw(m.count * dtype_size(stored));
+      if (!mem_.read(o.addr, raw.data(), raw.size())) return E_INVALID;
+      *out = convert(raw, stored, c.udtype, m.count);
+      *have = true;
+      return E_OK;
+    }
+    if (o.mode == M_ON_RECV) {
+      RankInfo& peer = comm.ranks[o.src_rank];
+      Envelope env;
+      std::vector<uint8_t> payload;
+      if (!pool_.seek(peer.global_rank, o.tag, peer.inbound_seq, comm.comm_id,
+                      timeout_, &env, &payload))
+        return E_RECV_TIMEOUT;
+      peer.inbound_seq++;
+      size_t n = env.nbytes / dtype_size(env.dtype);
+      if (n != m.count) return E_DMA_MISMATCH;
+      *out = convert(payload, env.dtype, c.udtype, m.count);
+      *have = true;
+      return E_OK;
+    }
+    if (o.mode == M_STREAM) {
+      std::unique_lock<std::mutex> lk(stream_mu_);
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::duration<double>(timeout_);
+      while (stream_in_.empty()) {
+        if (stream_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+          return E_RECV_TIMEOUT;
+      }
+      auto item = std::move(stream_in_.front());
+      stream_in_.pop_front();
+      lk.unlock();
+      *out = convert(item.second, item.first.dtype, c.udtype, m.count);
+      *have = true;
+      return E_OK;
+    }
+    return E_INVALID;
+  }
+
+  // ---- call queue (hostctrl async chaining parity) ----
+  void call_worker() {
+    for (;;) {
+      std::pair<uint32_t, std::vector<uint8_t>> job;
+      {
+        std::unique_lock<std::mutex> lk(call_mu_);
+        call_cv_.wait(lk, [this] {
+          return !call_queue_.empty() || shutting_down.load();
+        });
+        if (shutting_down.load() && call_queue_.empty()) return;
+        job = std::move(call_queue_.front());
+        call_queue_.pop_front();
+      }
+      uint32_t err = run_call(job.second);
+      {
+        std::lock_guard<std::mutex> lk(call_mu_);
+        call_status_[job.first] = err;
+        call_cv_.notify_all();
+      }
+    }
+  }
+
+  uint32_t run_call(const std::vector<uint8_t>& b) {
+    // layout matches protocol.pack_call (after the MSG_CALL byte)
+    const uint8_t* p = b.data();
+    uint8_t scenario = p[0], func = p[1], compression = p[2], stream = p[3];
+    uint8_t udtype = p[4], cdtype = p[5];
+    uint64_t count = get_le<uint64_t>(p + 6);
+    uint32_t comm_id = get_le<uint32_t>(p + 14);
+    uint32_t root = get_le<uint32_t>(p + 18);
+    uint32_t tag = get_le<uint32_t>(p + 22);
+    uint64_t a0 = get_le<uint64_t>(p + 26);
+    uint64_t a1 = get_le<uint64_t>(p + 34);
+    uint64_t a2 = get_le<uint64_t>(p + 42);
+    if (scenario == OP_NOP || scenario == OP_CONFIG) return E_OK;
+    Communicator* comm;
+    {
+      std::lock_guard<std::mutex> lk(comm_mu_);
+      auto it = comms_.find(comm_id);
+      if (it == comms_.end()) return E_COMM_NOT_CONFIGURED;
+      comm = &it->second;
+    }
+    CallCtx c{comm->size(), comm->local_rank, udtype, cdtype, max_seg_,
+              compression, stream};
+    std::vector<Move> moves;
+    uint32_t err = expand(moves, c, scenario, func, count, root, tag, a0, a1, a2);
+    if (err) return err;
+    return execute_moves(moves, c, *comm);
+  }
+
+  // ---- command connection ----
+  void serve_conn(int fd);
+  std::vector<uint8_t> handle(const std::vector<uint8_t>& body);
+
+  uint32_t rank_, world_;
+  uint16_t port_base_;
+  DeviceMemory mem_;
+  RxBufferPool pool_;
+  size_t bufsize_, max_seg_, nbufs_;
+  double timeout_ = 30.0;
+  std::map<uint32_t, Communicator> comms_;
+  std::mutex comm_mu_;
+  EthFabric eth_;
+  // stream port
+  std::deque<std::pair<Envelope, std::vector<uint8_t>>> stream_in_;
+  std::mutex stream_mu_;
+  std::condition_variable stream_cv_;
+  // calls
+  std::deque<std::pair<uint32_t, std::vector<uint8_t>>> call_queue_;
+  std::map<uint32_t, uint32_t> call_status_;
+  uint32_t next_call_id_ = 1;
+  std::mutex call_mu_;
+  std::condition_variable call_cv_;
+  std::thread worker_;
+  std::vector<std::thread> conn_threads_;
+};
+
+// ---- EthFabric impl -------------------------------------------------------
+static int make_server(uint16_t port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0) {
+    perror("bind");
+    exit(1);
+  }
+  listen(fd, 16);
+  return fd;
+}
+
+EthFabric::EthFabric(uint32_t me, uint16_t listen_port, RankDaemon* daemon)
+    : me_(me), daemon_(daemon) {
+  listen_fd_ = make_server(listen_port);
+  threads_.emplace_back([this] { accept_loop(); });
+}
+
+EthFabric::~EthFabric() { stop(); }
+
+void EthFabric::stop() {
+  if (stopping_.exchange(true)) return;
+  ::shutdown(listen_fd_, SHUT_RDWR);
+  ::close(listen_fd_);
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& kv : peers_) ::close(kv.second);
+}
+
+void EthFabric::accept_loop() {
+  for (;;) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::thread([this, fd] { recv_loop(fd); }).detach();
+  }
+}
+
+void EthFabric::recv_loop(int fd) {
+  std::vector<uint8_t> body;
+  while (recv_frame(fd, body)) {
+    if (body.empty() || body[0] != MSG_ETH) continue;
+    const uint8_t* p = body.data() + 1;
+    Envelope env;
+    env.src = get_le<uint32_t>(p);
+    env.dst = get_le<uint32_t>(p + 4);
+    env.tag = get_le<uint32_t>(p + 8);
+    env.seqn = get_le<uint32_t>(p + 12);
+    env.comm_id = get_le<uint32_t>(p + 16);
+    env.strm = p[20];
+    env.dtype = p[21];
+    env.nbytes = get_le<uint64_t>(p + 22);
+    std::vector<uint8_t> payload(body.begin() + 31, body.end());
+    daemon_->ingest(env, std::move(payload));
+  }
+  ::close(fd);
+}
+
+bool EthFabric::send_msg(const Envelope& env,
+                         const std::vector<uint8_t>& payload) {
+  int fd;
+  std::mutex* peer_mu;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = peers_.find(env.dst);
+    if (it == peers_.end()) {
+      auto ait = peer_addrs_.find(env.dst);
+      if (ait == peer_addrs_.end()) return false;
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(ait->second.second);
+      inet_pton(AF_INET, ait->second.first.c_str(), &addr.sin_addr);
+      if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0) {
+        ::close(fd);
+        return false;
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      peers_[env.dst] = fd;
+      peer_mus_[env.dst] = std::make_unique<std::mutex>();
+    } else {
+      fd = it->second;
+    }
+    peer_mu = peer_mus_[env.dst].get();
+  }
+  std::lock_guard<std::mutex> plk(*peer_mu);
+  std::vector<uint8_t> body{MSG_ETH};
+  put_le<uint32_t>(body, env.src);
+  put_le<uint32_t>(body, env.dst);
+  put_le<uint32_t>(body, env.tag);
+  put_le<uint32_t>(body, env.seqn);
+  put_le<uint32_t>(body, env.comm_id);
+  body.push_back(env.strm);
+  body.push_back(env.dtype);
+  put_le<uint64_t>(body, env.nbytes);
+  body.insert(body.end(), payload.begin(), payload.end());
+  return send_frame(fd, body);
+}
+
+// ---- command server -------------------------------------------------------
+int RankDaemon::serve(uint16_t cmd_port) {
+  int server = make_server(cmd_port);
+  printf("native rank %u/%u serving cmd=%u eth=%u\n", rank_, world_, cmd_port,
+         port_base_ + world_ + rank_);
+  fflush(stdout);
+  while (!shutting_down.load()) {
+    int fd = ::accept(server, nullptr, nullptr);
+    if (fd < 0) break;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    conn_threads_.emplace_back([this, fd] { serve_conn(fd); });
+  }
+  ::close(server);
+  return 0;
+}
+
+void RankDaemon::serve_conn(int fd) {
+  std::vector<uint8_t> body;
+  while (recv_frame(fd, body)) {
+    if (body.empty()) break;
+    auto reply = handle(body);
+    if (!send_frame(fd, reply)) break;
+    if (body[0] == MSG_SHUTDOWN) {
+      shutting_down.store(true);
+      call_cv_.notify_all();
+      eth_.stop();
+      ::close(fd);
+      ::exit(0);
+    }
+  }
+  ::close(fd);
+}
+
+std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
+  const uint8_t kind = body[0];
+  const uint8_t* p = body.data() + 1;
+  switch (kind) {
+    case MSG_PING:
+      return status_reply(E_OK);
+    case MSG_ALLOC: {
+      mem_.alloc(get_le<uint64_t>(p), get_le<uint64_t>(p + 8));
+      return status_reply(E_OK);
+    }
+    case MSG_FREE:
+      mem_.free_region(get_le<uint64_t>(p));
+      return status_reply(E_OK);
+    case MSG_WRITE_MEM: {
+      uint64_t addr = get_le<uint64_t>(p);
+      bool ok = mem_.write(addr, p + 8, body.size() - 9);
+      return status_reply(ok ? E_OK : E_INVALID);
+    }
+    case MSG_READ_MEM: {
+      uint64_t addr = get_le<uint64_t>(p);
+      uint64_t nbytes = get_le<uint64_t>(p + 8);
+      std::vector<uint8_t> reply{MSG_DATA};
+      reply.resize(1 + nbytes);
+      if (!mem_.read(addr, reply.data() + 1, nbytes))
+        return status_reply(E_INVALID);
+      return reply;
+    }
+    case MSG_CONFIG_COMM: {
+      Communicator comm;
+      comm.comm_id = get_le<uint32_t>(p);
+      comm.local_rank = get_le<uint32_t>(p + 4);
+      uint32_t n = get_le<uint32_t>(p + 8);
+      size_t off = 12;
+      for (uint32_t i = 0; i < n; ++i) {
+        RankInfo ri;
+        ri.global_rank = get_le<uint32_t>(p + off);
+        ri.cmd_port = get_le<uint16_t>(p + off + 4);
+        uint16_t hlen = get_le<uint16_t>(p + off + 6);
+        off += 8;
+        ri.host.assign(reinterpret_cast<const char*>(p + off), hlen);
+        off += hlen;
+        comm.ranks.push_back(ri);
+        if (ri.global_rank != rank_ && ri.cmd_port)
+          eth_.learn_peer(ri.global_rank, ri.host,
+                          static_cast<uint16_t>(ri.cmd_port + world_));
+      }
+      std::lock_guard<std::mutex> lk(comm_mu_);
+      comms_[comm.comm_id] = comm;
+      return status_reply(E_OK);
+    }
+    case MSG_SET_TIMEOUT: {
+      double t;
+      std::memcpy(&t, p, 8);
+      timeout_ = t;
+      return status_reply(E_OK);
+    }
+    case MSG_SET_SEG: {
+      uint64_t s = get_le<uint64_t>(p);
+      if (s > bufsize_) return status_reply(E_DMA_SIZE);
+      max_seg_ = s;
+      return status_reply(E_OK);
+    }
+    case MSG_CALL: {
+      std::lock_guard<std::mutex> lk(call_mu_);
+      uint32_t id = next_call_id_++;
+      call_queue_.emplace_back(
+          id, std::vector<uint8_t>(body.begin() + 1, body.end()));
+      call_cv_.notify_all();
+      std::vector<uint8_t> reply{MSG_CALL_ID};
+      put_le<uint32_t>(reply, id);
+      return reply;
+    }
+    case MSG_WAIT: {
+      uint32_t id = get_le<uint32_t>(p);
+      double budget = timeout_;
+      if (body.size() >= 13) std::memcpy(&budget, p + 4, 8);
+      std::unique_lock<std::mutex> lk(call_mu_);
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::duration<double>(budget);
+      while (call_status_.find(id) == call_status_.end()) {
+        if (call_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+          return status_reply(STATUS_PENDING);
+      }
+      uint32_t err = call_status_[id];
+      call_status_.erase(id);
+      return status_reply(err);
+    }
+    case MSG_GET_INFO: {
+      std::vector<uint8_t> reply{MSG_DATA};
+      put_le<uint64_t>(reply, bufsize_);
+      put_le<uint32_t>(reply, (uint32_t)nbufs_);
+      put_le<uint32_t>(reply, world_);
+      put_le<uint32_t>(reply, rank_);
+      return reply;
+    }
+    case MSG_RESET: {
+      pool_.reset();
+      std::lock_guard<std::mutex> lk(comm_mu_);
+      for (auto& kv : comms_)
+        for (auto& r : kv.second.ranks) r.inbound_seq = r.outbound_seq = 0;
+      return status_reply(E_OK);
+    }
+    case MSG_DUMP_RX: {
+      std::string s = pool_.describe();
+      std::vector<uint8_t> reply{MSG_DATA};
+      reply.insert(reply.end(), s.begin(), s.end());
+      return reply;
+    }
+    case MSG_SHUTDOWN:
+      return status_reply(E_OK);
+    default:
+      return status_reply(E_INVALID);
+  }
+}
+
+// ---------------------------------------------------------------------------
+int main(int argc, char** argv) {
+  uint32_t rank = 0, world = 1;
+  uint16_t port_base = 45000;
+  size_t nbufs = 16, bufsize = 1 << 20;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    std::string k = argv[i];
+    const char* v = argv[i + 1];
+    if (k == "--rank") rank = atoi(v);
+    else if (k == "--world") world = atoi(v);
+    else if (k == "--port-base") port_base = atoi(v);
+    else if (k == "--nbufs") nbufs = atoi(v);
+    else if (k == "--bufsize") bufsize = atoll(v);
+  }
+  RankDaemon daemon(rank, world, port_base, nbufs, bufsize);
+  return daemon.serve(static_cast<uint16_t>(port_base + rank));
+}
