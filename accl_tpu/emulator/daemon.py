@@ -115,7 +115,14 @@ class RankDaemon:
         self.timeout = 30.0
         self.max_segment_size = bufsize
         self.comms: dict[int, Communicator] = {}
-        self.eth = EthFabric(rank, port_base + world + rank, self._ingest)
+        # bind the cmd port before the eth fabric / worker thread so a
+        # port collision fails before any resources need cleanup
+        self._server = socket.create_server((host, port_base + rank))
+        try:
+            self.eth = EthFabric(rank, port_base + world + rank, self._ingest)
+        except Exception:  # OverflowError for out-of-range ports, OSError...
+            self._server.close()
+            raise
         self.executor = MoveExecutor(self.mem, self.pool, self.eth.send,
                                      timeout=self.timeout)
         self._arrays: dict[int, np.ndarray] = {}
@@ -126,7 +133,6 @@ class RankDaemon:
         self._call_queue: list[tuple[int, dict]] = []
         self._stop = threading.Event()
         threading.Thread(target=self._call_worker, daemon=True).start()
-        self._server = socket.create_server((host, port_base + rank))
 
     # -- ingress -----------------------------------------------------------
     def _ingest(self, env: Envelope, payload: bytes):
@@ -294,17 +300,35 @@ def spawn_world(world: int, port_base: int = 0, nbufs: int = 16,
                 bufsize: int = 1 << 20):
     """Spawn W in-process daemons on free ports (for tests); returns
     (daemons, port_base). Multi-process deployments run __main__ per rank."""
-    if port_base == 0:
-        probe = socket.create_server(("127.0.0.1", 0))
-        port_base = probe.getsockname()[1] + 101
-        probe.close()
-    daemons = []
-    for r in range(world):
-        d = RankDaemon(r, world, port_base, nbufs=nbufs, bufsize=bufsize,
-                       host="127.0.0.1")
-        threading.Thread(target=d.serve_forever, daemon=True).start()
-        daemons.append(d)
-    return daemons, port_base
+    # The contiguous cmd+eth port block lands in the ephemeral range, where
+    # any outgoing connection on the host may hold a port — retry with a
+    # fresh base on collision instead of failing the world.
+    last_err: OSError | None = None
+    for _ in range(20):
+        base = port_base
+        if base == 0:
+            probe = socket.create_server(("127.0.0.1", 0))
+            base = probe.getsockname()[1] + 101
+            probe.close()
+            if base + 2 * world >= 65536:  # block must fit in port space
+                base -= 2 * world + 101
+        daemons = []
+        try:
+            for r in range(world):
+                d = RankDaemon(r, world, base, nbufs=nbufs, bufsize=bufsize,
+                               host="127.0.0.1")
+                daemons.append(d)
+        except Exception as exc:
+            for d in daemons:
+                d.shutdown()
+            if port_base != 0 or not isinstance(exc, OSError):
+                raise
+            last_err = exc
+            continue
+        for d in daemons:
+            threading.Thread(target=d.serve_forever, daemon=True).start()
+        return daemons, base
+    raise OSError(f"no free port block after 20 attempts: {last_err}")
 
 
 def main():

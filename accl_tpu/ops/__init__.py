@@ -1,0 +1,23 @@
+"""TPU compute kernels (Pallas) — the dataplane's plugin equivalents.
+
+Reference parity map (all HLS C++ plugin kernels rebuilt TPU-native):
+  * kernels/plugins/reduce_sum            -> ops.combine (fused 2-operand
+    elementwise reduction on the VPU)
+  * kernels/plugins/{fp_hp,hp_fp}_stream_conv -> ops.compression cast lanes
+    (fp32 <-> fp16/bf16) plus scaled fp8 wire codecs
+  * streaming attention fused with ring transfers -> ops.attention flash
+    kernel (the compute half of parallel.ring_attention)
+
+Every kernel runs as a real Pallas TPU kernel on TPU and in interpreter
+mode elsewhere, so one code path serves the CPU test tiers and the chip.
+"""
+
+from .combine import combine, combine_pallas
+from .compression import (cast_lane, compress_fp8, decompress_fp8,
+                          wire_compress, wire_decompress)
+from .attention import flash_attention
+
+__all__ = [
+    "combine", "combine_pallas", "cast_lane", "compress_fp8",
+    "decompress_fp8", "wire_compress", "wire_decompress", "flash_attention",
+]

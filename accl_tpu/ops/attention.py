@@ -1,0 +1,127 @@
+"""Flash attention — blockwise online-softmax attention as a Pallas kernel.
+
+This is the compute half of the long-context story: the same blockwise
+update rule (running max / normalizer / accumulator) that
+``parallel.ring_attention`` applies across ICI hops, here applied across
+KV blocks inside one chip so scores never materialize in HBM. Q/K/V tiles
+stream HBM->VMEM, the two matmuls hit the MXU in fp32 accumulation, and
+the softmax bookkeeping stays in VMEM.
+
+The reference has no attention (it is a collectives library); this kernel
+exists because the rebuild's flagship models and ring attention need a
+TPU-native fused attention. Runs in interpreter mode off-TPU so the CPU
+test tiers exercise the identical code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+                  causal: bool, block_q: int, block_k: int, kv_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+    d = q.shape[-1]
+    total_kv_blocks = pl.cdiv(kv_len, block_k)
+    padded_kv = k_ref.shape[1]
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    # causal: only kv blocks overlapping [0, (qi+1)*block_q) contribute
+    if causal:
+        nblocks = jnp.minimum((qi * block_q) // block_k + pl.cdiv(block_q,
+                                                                  block_k),
+                              total_kv_blocks)
+    else:
+        nblocks = total_kv_blocks
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nblocks, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
+    pad = (-x.shape[axis]) % multiple
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "sm_scale", "block_q",
+                                    "block_k"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, sm_scale: float | None = None,
+                    block_q: int = 128, block_k: int = 128) -> jax.Array:
+    """Fused attention. q: (B, H, Sq, D); k/v: (B, H, Skv, D) (KV heads
+    already repeated for GQA). Returns (B, H, Sq, D) in q.dtype."""
+    B, H, Sq, D = q.shape
+    Skv = k.shape[2]
+    if sm_scale is None:
+        sm_scale = float(D) ** -0.5
+    block_q = min(block_q, max(Sq, 8))
+    block_k = min(block_k, max(Skv, 8))
+
+    qp = _pad_to(q.reshape(B * H, Sq, D), 1, block_q)
+    kp = _pad_to(k.reshape(B * H, Skv, D), 1, block_k)
+    vp = _pad_to(v.reshape(B * H, Skv, D), 1, block_k)
+    Sq_p, Skv_p = qp.shape[1], kp.shape[1]
+
+    grid = (B * H, Sq_p // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, kv_len=Skv),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq_p, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Skv_p, D), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Skv_p, D), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return out[:, :Sq].reshape(B, H, Sq, D)

@@ -1,0 +1,166 @@
+"""Wire-precision compression lanes.
+
+Reference: kernels/plugins/fp_hp_stream_conv (fp32 -> fp16, 2 words in, 1
+word out) and hp_fp_stream_conv (fp16 -> fp32) are dedicated dataplane
+lanes the dma_mover routes operands through when a call carries
+OP*/RES/ETH_COMPRESSED flags (dma_mover.cpp:44-168). Here each lane is a
+Pallas cast kernel plus, beyond the reference, a *scaled fp8* codec
+(per-tensor max-abs scaling, the EQuARX-style quantized-collective lane)
+for 4x wire compression.
+
+The collectives dataplane (parallel.collectives) applies these around each
+``ppermute`` hop; the driver's flag algebra (accl.ACCL._prepare) decides
+when.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_BLOCK_ROWS = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _cast_kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:].astype(o_ref.dtype)
+
+
+def _tiled(x: jax.Array):
+    """Flatten + pad to (rows, 128) tile geometry; returns (tiles, n, pad)."""
+    flat = x.reshape(-1)
+    n = flat.size
+    pad = (-n) % _LANES
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, _LANES), n, pad
+
+
+def _untiled(tiles: jax.Array, n: int, shape) -> jax.Array:
+    out = tiles.reshape(-1)
+    if out.size != n:
+        out = out[:n]
+    return out.reshape(shape)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def _cast_tiles(x: jax.Array, dtype) -> jax.Array:
+    rows, cols = x.shape
+    block = (min(_BLOCK_ROWS, rows), cols)
+    grid = (pl.cdiv(rows, block[0]),)
+    return pl.pallas_call(
+        _cast_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, dtype),
+        grid=grid,
+        in_specs=[pl.BlockSpec(block, lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(block, lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(x)
+
+
+def cast_lane(x: jax.Array, dtype) -> jax.Array:
+    """Streamed dtype cast (both conversion directions; the down/up lanes
+    of the reference are the dtype-ordered pair of calls)."""
+    from .combine import _pallas_ok
+    dtype = jnp.dtype(dtype)
+    if x.dtype == dtype:
+        return x
+    if not _pallas_ok(x.dtype, dtype):
+        return x.astype(dtype)
+    tiles, n, _ = _tiled(x)
+    return _untiled(_cast_tiles(tiles, dtype), n, x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Scaled fp8 codec (per-tensor max-abs scale)
+# ---------------------------------------------------------------------------
+
+FP8 = jnp.float8_e4m3fn
+_FP8_MAX = 448.0  # finfo max of e4m3fn
+
+
+def _quant_kernel(x_ref, inv_ref, o_ref):
+    o_ref[:] = (x_ref[:] * inv_ref[0, 0]).astype(o_ref.dtype)
+
+
+def _dequant_kernel(q_ref, scale_ref, o_ref):
+    o_ref[:] = q_ref[:].astype(o_ref.dtype) * scale_ref[0, 0]
+
+
+@jax.jit
+def compress_fp8(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """x (float) -> (fp8 payload, fp32 scale). scale = amax/448 so the
+    payload spans the fp8 dynamic range; the (1,1) scale rides the wire
+    alongside the payload (4 bytes per message)."""
+    tiles, n, _ = _tiled(x)
+    amax = jnp.max(jnp.abs(tiles.astype(jnp.float32)))
+    scale = jnp.maximum(amax / _FP8_MAX, 1e-30)
+    inv = (1.0 / scale).reshape(1, 1)
+    rows, cols = tiles.shape
+    block = (min(_BLOCK_ROWS, rows), cols)
+    q = pl.pallas_call(
+        _quant_kernel,
+        out_shape=jax.ShapeDtypeStruct(tiles.shape, FP8),
+        grid=(pl.cdiv(rows, block[0]),),
+        in_specs=[
+            pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(block, lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(tiles.astype(jnp.float32), inv)
+    return q.reshape(-1)[:n].reshape(x.shape), scale.reshape(1, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def decompress_fp8(q: jax.Array, scale: jax.Array,
+                   dtype=jnp.float32) -> jax.Array:
+    tiles, n, _ = _tiled(q)
+    rows, cols = tiles.shape
+    block = (min(_BLOCK_ROWS, rows), cols)
+    out = pl.pallas_call(
+        _dequant_kernel,
+        out_shape=jax.ShapeDtypeStruct(tiles.shape, jnp.dtype(dtype)),
+        grid=(pl.cdiv(rows, block[0]),),
+        in_specs=[
+            pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(block, lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(tiles, scale.reshape(1, 1).astype(jnp.float32))
+    return _untiled(out, n, q.shape)
+
+
+# ---------------------------------------------------------------------------
+# Wire codec dispatch — what a collective hop calls
+# ---------------------------------------------------------------------------
+
+def wire_compress(x: jax.Array, wire_dtype):
+    """Encode a hop payload for the wire. Returns (payload, aux) where aux
+    is the fp8 scale or None. Cast lanes for fp16/bf16; scaled codec for
+    fp8 dtypes."""
+    wd = jnp.dtype(wire_dtype)
+    if wd == x.dtype:
+        return x, None
+    if wd in (jnp.dtype(jnp.float8_e4m3fn), jnp.dtype(jnp.float8_e5m2)):
+        return compress_fp8(x)
+    return cast_lane(x, wd), None
+
+
+def wire_decompress(payload: jax.Array, aux, dtype) -> jax.Array:
+    if aux is not None:
+        return decompress_fp8(payload, aux, dtype)
+    return cast_lane(payload, dtype)

@@ -1,0 +1,114 @@
+"""Pallas kernel correctness (interpreter mode on the CPU tier)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accl_tpu.constants import ReduceFunc
+from accl_tpu.ops import (cast_lane, combine, compress_fp8, decompress_fp8,
+                          flash_attention, wire_compress, wire_decompress)
+
+
+@pytest.mark.parametrize("func", list(ReduceFunc))
+@pytest.mark.parametrize("n", [1, 7, 128, 1000, 4096])
+def test_combine_matches_numpy(func, n):
+    rng = np.random.default_rng(n)
+    a = rng.standard_normal(n).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    ref = {ReduceFunc.SUM: np.add, ReduceFunc.MAX: np.maximum,
+           ReduceFunc.MIN: np.minimum, ReduceFunc.PROD: np.multiply}[func]
+    out = np.asarray(combine(jnp.asarray(a), jnp.asarray(b), func))
+    np.testing.assert_allclose(out, ref(a, b), rtol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", ["int32", "bfloat16", "float16"])
+def test_combine_dtypes(dtype):
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(-100, 100, 300), jnp.dtype(dtype))
+    b = jnp.asarray(rng.integers(-100, 100, 300), jnp.dtype(dtype))
+    out = combine(a, b, ReduceFunc.SUM)
+    assert out.dtype == jnp.dtype(dtype)
+    np.testing.assert_allclose(np.asarray(out, np.float64),
+                               np.asarray(a, np.float64)
+                               + np.asarray(b, np.float64))
+
+
+def test_combine_2d_shape_preserved():
+    a = jnp.ones((13, 5), jnp.float32)
+    b = jnp.full((13, 5), 2.0, jnp.float32)
+    out = combine(a, b)
+    assert out.shape == (13, 5)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+@pytest.mark.parametrize("wire", ["float16", "bfloat16"])
+def test_cast_lane_roundtrip(wire):
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal(513).astype(np.float32))
+    down = cast_lane(x, wire)
+    assert down.dtype == jnp.dtype(wire)
+    up = cast_lane(down, jnp.float32)
+    np.testing.assert_allclose(np.asarray(up), np.asarray(x),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_fp8_codec_roundtrip():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray((rng.standard_normal(1000) * 10).astype(np.float32))
+    q, scale = compress_fp8(x)
+    assert q.dtype == jnp.float8_e4m3fn and q.shape == x.shape
+    back = decompress_fp8(q, scale)
+    # e4m3 has ~2 decimal digits; relative error bounded by the format
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                               rtol=0.13,
+                               atol=float(np.asarray(scale).ravel()[0]) * 0.6)
+
+
+def test_wire_codec_dispatch():
+    x = jnp.linspace(-3, 3, 640, dtype=jnp.float32)
+    p, aux = wire_compress(x, jnp.float8_e4m3fn)
+    assert aux is not None
+    np.testing.assert_allclose(np.asarray(wire_decompress(p, aux, x.dtype)),
+                               np.asarray(x), rtol=0.13, atol=0.05)
+    p2, aux2 = wire_compress(x, jnp.bfloat16)
+    assert aux2 is None and p2.dtype == jnp.bfloat16
+
+
+def _dense_attention(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (d ** -0.5)
+    if causal:
+        Sq, Skv = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((Sq, Skv), bool))
+        s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("shape", [(1, 2, 64, 16), (2, 1, 130, 32)])
+def test_flash_attention_matches_dense(causal, shape):
+    B, H, S, D = shape
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], shape, jnp.float32)
+    k = jax.random.normal(ks[1], shape, jnp.float32)
+    v = jax.random.normal(ks[2], shape, jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = _dense_attention(q, k, v, causal)
+    # tolerance admits the MXU's bf16 multiply precision on real TPU
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=8e-3, atol=8e-3)
+
+
+def test_flash_attention_bf16():
+    shape = (1, 2, 96, 16)
+    ks = jax.random.split(jax.random.key(1), 3)
+    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    assert out.dtype == jnp.bfloat16
+    ref = _dense_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=5e-2, atol=5e-2)
