@@ -107,10 +107,17 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Sq_p, Skv_p = qp.shape[1], kp.shape[1]
 
     grid = (B * H, Sq_p // block_q)
+    # inside shard_map, outputs inherit the inputs' varying-mesh-axes set
+    # (check_vma requires it to be explicit on pallas_call out_shapes)
+    try:
+        vma = jax.typeof(qp).vma
+        out_sds = jax.ShapeDtypeStruct((B * H, Sq_p, D), q.dtype, vma=vma)
+    except (AttributeError, TypeError):
+        out_sds = jax.ShapeDtypeStruct((B * H, Sq_p, D), q.dtype)
     out = pl.pallas_call(
         functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, kv_len=Skv),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq_p, D), q.dtype),
+        out_shape=out_sds,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),

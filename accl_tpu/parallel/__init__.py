@@ -9,7 +9,13 @@ ICI/DCN). It is usable standalone (functional, shard_map-based) and is what
 from .mesh import make_mesh, cpu_mesh, mesh_from_communicator
 from .collectives import (MeshCollectives, ring_allreduce, ring_allgather,
                           ring_reduce_scatter, masked_bcast, send_recv)
+from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses import (ulysses_attention, ulysses_attention_sharded,
+                      seq_to_heads, heads_to_seq)
 
 __all__ = ["make_mesh", "cpu_mesh", "mesh_from_communicator",
            "MeshCollectives", "ring_allreduce", "ring_allgather",
-           "ring_reduce_scatter", "masked_bcast", "send_recv"]
+           "ring_reduce_scatter", "masked_bcast", "send_recv",
+           "ring_attention", "ring_attention_sharded",
+           "ulysses_attention", "ulysses_attention_sharded",
+           "seq_to_heads", "heads_to_seq"]

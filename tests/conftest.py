@@ -1,13 +1,26 @@
-"""Test config: force an 8-device virtual CPU platform before jax loads.
+"""Test config: force an 8-device virtual CPU platform before tests run.
 
 Multi-chip sharding tests run on a virtual CPU mesh (the driver separately
-dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip).
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip,
+and bench.py exercises the real chip). The environment may pre-select a
+TPU tunnel platform in a way that overrides JAX_PLATFORMS, so this goes
+through jax.config — set ACCL_TEST_TPU=1 to opt back into running the
+test suite against the real device.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Force the CPU platform unless the user explicitly picked one: the infra
+# pre-sets JAX_PLATFORMS=axon (TPU tunnel) in a way plain env overrides
+# can't beat, hence jax.config. An explicit JAX_PLATFORMS other than the
+# infra default is honored, as is ACCL_TEST_TPU=1.
+if (not os.environ.get("ACCL_TEST_TPU")
+        and os.environ.get("JAX_PLATFORMS", "axon") in ("axon", "cpu")):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
