@@ -7,5 +7,7 @@ static shapes, sharding-annotated for dp/tp/sp meshes, bfloat16 compute.
 """
 
 from .llama import LlamaConfig, Llama
+from .moe import MoEConfig, MoELayer, moe_apply_sharded
 
-__all__ = ["LlamaConfig", "Llama"]
+__all__ = ["LlamaConfig", "Llama", "MoEConfig", "MoELayer",
+           "moe_apply_sharded"]

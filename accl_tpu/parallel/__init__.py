@@ -12,10 +12,12 @@ from .collectives import (MeshCollectives, ring_allreduce, ring_allgather,
 from .ring_attention import ring_attention, ring_attention_sharded
 from .ulysses import (ulysses_attention, ulysses_attention_sharded,
                       seq_to_heads, heads_to_seq)
+from .pipeline import pipeline_apply, pipeline_sharded
 
 __all__ = ["make_mesh", "cpu_mesh", "mesh_from_communicator",
            "MeshCollectives", "ring_allreduce", "ring_allgather",
            "ring_reduce_scatter", "masked_bcast", "send_recv",
            "ring_attention", "ring_attention_sharded",
            "ulysses_attention", "ulysses_attention_sharded",
-           "seq_to_heads", "heads_to_seq"]
+           "seq_to_heads", "heads_to_seq",
+           "pipeline_apply", "pipeline_sharded"]

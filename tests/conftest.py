@@ -24,3 +24,20 @@ if (not os.environ.get("ACCL_TEST_TPU")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def dense_attention(q, k, v, causal):
+    """Shared golden reference for every attention test (flash, ring,
+    ulysses): fp32 softmax(QK^T/sqrt(d))V with optional causal mask."""
+    import jax
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (d ** -0.5)
+    if causal:
+        Sq, Skv = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((Sq, Skv), bool), k=Skv - Sq)
+        s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))

@@ -76,16 +76,7 @@ def test_wire_codec_dispatch():
     assert aux2 is None and p2.dtype == jnp.bfloat16
 
 
-def _dense_attention(q, k, v, causal):
-    d = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * (d ** -0.5)
-    if causal:
-        Sq, Skv = q.shape[2], k.shape[2]
-        mask = jnp.tril(jnp.ones((Sq, Skv), bool))
-        s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+from conftest import dense_attention as _dense_attention
 
 
 @pytest.mark.parametrize("causal", [True, False])

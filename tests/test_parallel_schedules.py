@@ -1,0 +1,113 @@
+"""Pipeline parallelism + MoE expert parallelism correctness."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accl_tpu.models.moe import MoEConfig, MoELayer, moe_apply_sharded
+from accl_tpu.parallel import cpu_mesh
+from accl_tpu.parallel.pipeline import pipeline_sharded
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stage_params(key, W, d):
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.normal(kw, (W, d, d)) * (d ** -0.5),
+        "b": jax.random.normal(kb, (W, d)) * 0.1,
+    }
+
+
+@pytest.mark.parametrize("W,n_micro", [(4, 4), (4, 8), (8, 8)])
+def test_pipeline_matches_sequential(W, n_micro):
+    mesh = cpu_mesh(W, axis_names=("pp",))
+    d, mb = 16, 4
+    params = _stage_params(jax.random.key(0), W, d)
+    x = jax.random.normal(jax.random.key(1), (n_micro, mb, d))
+
+    out = pipeline_sharded(_stage_fn, params, x, mesh, "pp")
+
+    # sequential reference: every microbatch through all W stages in order
+    ref = x
+    for s in range(W):
+        sp = {k: v[s] for k, v in params.items()}
+        ref = jax.vmap(lambda m: _stage_fn(sp, m))(ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_single_microbatch():
+    mesh = cpu_mesh(4, axis_names=("pp",))
+    d = 8
+    params = _stage_params(jax.random.key(2), 4, d)
+    x = jax.random.normal(jax.random.key(3), (1, 2, d))
+    out = pipeline_sharded(_stage_fn, params, x, mesh, "pp")
+    ref = x
+    for s in range(4):
+        sp = {k: v[s] for k, v in params.items()}
+        ref = jax.vmap(lambda m: _stage_fn(sp, m))(ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_ep_matches_dense(top_k):
+    """With ample capacity the EP path must reproduce the dense layer
+    exactly (same routing, same experts, different data movement)."""
+    W = 4
+    mesh = cpu_mesh(W, axis_names=("ep",))
+    cfg = MoEConfig(dim=16, ffn_dim=32, n_experts=8, top_k=top_k,
+                    capacity_factor=8.0)  # ample: nothing drops
+    layer = MoELayer(cfg)
+    params = layer.init(jax.random.key(0))
+    T_total = 64
+    x = jax.random.normal(jax.random.key(1), (T_total, cfg.dim))
+
+    C = cfg.capacity(T_total // W)
+    out, aux = moe_apply_sharded(layer, params, x, mesh, "ep", capacity=C)
+
+    # dense reference processed per-rank (routing is per-token, capacity
+    # per-rank queue order — identical when nothing exceeds capacity)
+    T_loc = T_total // W
+    refs, auxes = [], []
+    for r in range(W):
+        o, a = layer.apply_dense(params, x[r * T_loc:(r + 1) * T_loc],
+                                 capacity=C)
+        refs.append(np.asarray(o))
+        auxes.append(float(a))
+    np.testing.assert_allclose(np.asarray(out), np.concatenate(refs),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux), np.mean(auxes), rtol=1e-5)
+
+
+def test_moe_capacity_drops_tokens():
+    """Tiny capacity: outputs of dropped tokens are zero (pass-through in a
+    residual model); layer still runs with static shapes."""
+    cfg = MoEConfig(dim=8, ffn_dim=16, n_experts=4, top_k=1,
+                    capacity_factor=0.25)
+    layer = MoELayer(cfg)
+    params = layer.init(jax.random.key(4))
+    x = jax.random.normal(jax.random.key(5), (32, cfg.dim))
+    out, _ = layer.apply_dense(params, x)
+    assert out.shape == x.shape
+    # with capacity C = ceil(32*1*0.25/4) = 2 per expert, at most 8 tokens
+    # get outputs; the rest must be exactly zero
+    nonzero_rows = np.any(np.abs(np.asarray(out)) > 0, axis=1).sum()
+    assert nonzero_rows <= 4 * cfg.capacity(32)
+
+
+def test_moe_aux_loss_balanced_router():
+    """Uniform logits -> aux loss ~= 1 (perfectly balanced)."""
+    cfg = MoEConfig(dim=8, ffn_dim=16, n_experts=4, top_k=2,
+                    capacity_factor=4.0)
+    layer = MoELayer(cfg)
+    params = layer.init(jax.random.key(6))
+    params["router"] = jnp.zeros_like(params["router"])
+    x = jax.random.normal(jax.random.key(7), (128, cfg.dim))
+    _, aux = layer.apply_dense(params, x)
+    assert 0.4 < float(aux) < 1.6

@@ -9,17 +9,7 @@ import jax.numpy as jnp
 from accl_tpu.parallel import (cpu_mesh, ring_attention_sharded,
                                ulysses_attention_sharded, seq_to_heads,
                                heads_to_seq)
-
-
-def _dense(q, k, v, causal):
-    d = q.shape[-1]
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * (d ** -0.5)
-    if causal:
-        S = q.shape[2]
-        s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s,
-                      jnp.finfo(jnp.float32).min)
-    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+from conftest import dense_attention as _dense
 
 
 def _qkv(shape, seed=0, dtype=jnp.float32):
