@@ -13,6 +13,9 @@ from .ring_attention import ring_attention, ring_attention_sharded
 from .ulysses import (ulysses_attention, ulysses_attention_sharded,
                       seq_to_heads, heads_to_seq)
 from .pipeline import pipeline_apply, pipeline_sharded
+from .tree import (Tree2DCollectives, tree_bcast_shard, tree_scatter_shard,
+                   tree_gather_shard, tree_reduce_shard,
+                   tree_allreduce_shard)
 
 __all__ = ["make_mesh", "cpu_mesh", "mesh_from_communicator",
            "MeshCollectives", "ring_allreduce", "ring_allgather",
@@ -20,4 +23,7 @@ __all__ = ["make_mesh", "cpu_mesh", "mesh_from_communicator",
            "ring_attention", "ring_attention_sharded",
            "ulysses_attention", "ulysses_attention_sharded",
            "seq_to_heads", "heads_to_seq",
-           "pipeline_apply", "pipeline_sharded"]
+           "pipeline_apply", "pipeline_sharded",
+           "Tree2DCollectives", "tree_bcast_shard", "tree_scatter_shard",
+           "tree_gather_shard", "tree_reduce_shard",
+           "tree_allreduce_shard"]

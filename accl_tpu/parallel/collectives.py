@@ -47,6 +47,19 @@ _PSUM_LIKE = {
 }
 
 
+def axis_reduce(x: jnp.ndarray, axis_name: str,
+                func: ReduceFunc) -> jnp.ndarray:
+    """Reduce ``x`` elementwise across ``axis_name`` for any ReduceFunc.
+
+    SUM/MAX/MIN lower to the fused XLA collective; PROD (which has no XLA
+    collective) falls back to all_gather + local reduce."""
+    fused = _PSUM_LIKE.get(func)
+    if fused is not None:
+        return fused(x, axis_name)
+    gathered = lax.all_gather(x, axis_name)
+    return jnp.prod(gathered, axis=0)
+
+
 def _ring_perm(W: int) -> list[tuple[int, int]]:
     """Decreasing-rank flow ring: rank i sends to i-1 (firmware flow)."""
     return [(i, (i - 1) % W) for i in range(W)]
