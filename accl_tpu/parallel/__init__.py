@@ -16,6 +16,8 @@ from .pipeline import pipeline_apply, pipeline_sharded
 from .tree import (Tree2DCollectives, tree_bcast_shard, tree_scatter_shard,
                    tree_gather_shard, tree_reduce_shard,
                    tree_allreduce_shard)
+from .bucketing import (BucketPlan, make_bucket_plan, bucketed_allreduce,
+                        make_ddp_train_step)
 
 __all__ = ["make_mesh", "cpu_mesh", "mesh_from_communicator",
            "MeshCollectives", "ring_allreduce", "ring_allgather",
@@ -26,4 +28,6 @@ __all__ = ["make_mesh", "cpu_mesh", "mesh_from_communicator",
            "pipeline_apply", "pipeline_sharded",
            "Tree2DCollectives", "tree_bcast_shard", "tree_scatter_shard",
            "tree_gather_shard", "tree_reduce_shard",
-           "tree_allreduce_shard"]
+           "tree_allreduce_shard",
+           "BucketPlan", "make_bucket_plan", "bucketed_allreduce",
+           "make_ddp_train_step"]
