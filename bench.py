@@ -16,32 +16,19 @@ vs_baseline is the ratio against the reference's corresponding ceiling:
 """
 
 import json
-import time
+import os
+import sys
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from benchmarks.timing import slope_time as _slope_time  # noqa: E402
+
 ACCL_STREAM_BOUND_GBS = 16.0   # 512-bit @ 250 MHz CCLO datapath
 ACCL_WIRE_BOUND_GBS = 12.5     # 100 Gbps Ethernet
-
-
-def _timed_scalar(fn, args, reps=5):
-    float(fn(*args))  # compile + warm
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        float(fn(*args))
-        ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
-
-
-def _slope_time(make_chain, args, k_lo=4, k_hi=36, reps=5):
-    """Per-iteration seconds via the (k_hi - k_lo) slope."""
-    t_lo = _timed_scalar(make_chain(k_lo), args, reps=reps)
-    t_hi = _timed_scalar(make_chain(k_hi), args, reps=reps)
-    return max(t_hi - t_lo, 1e-9) / (k_hi - k_lo)
 
 
 def bench_combine(nbytes=1 << 28):

@@ -1,0 +1,83 @@
+"""CLI: run BASELINE configs or ad-hoc sweeps, write CSVs, aggregate.
+
+    python -m benchmarks --config 2 --out bench_out/
+    python -m benchmarks --sweep allreduce --algorithm ring
+    python -m benchmarks --elaborate bench_out/
+"""
+
+import argparse
+import os
+
+
+def main():
+    ap = argparse.ArgumentParser(description="accl_tpu benchmark harness")
+    ap.add_argument("--config", type=int, choices=range(1, 6),
+                    help="run a BASELINE config (1-5)")
+    ap.add_argument("--sweep", type=str,
+                    help="ad-hoc sweep of one collective")
+    ap.add_argument("--algorithm", type=str, default="xla",
+                    choices=["xla", "ring", "tree"])
+    ap.add_argument("--sizes", type=str,
+                    help="comma-separated payload bytes")
+    ap.add_argument("--wire-dtype", type=str, default=None)
+    ap.add_argument("--out", type=str, default="bench_out")
+    ap.add_argument("--elaborate", type=str, metavar="DIR",
+                    help="aggregate CSVs in DIR and print the table")
+    ap.add_argument("--platform", type=str, default=None,
+                    help="force a jax platform (e.g. cpu; pair with "
+                         "XLA_FLAGS=--xla_force_host_platform_device_count=8"
+                         " for a virtual mesh — the tunnel platform ignores "
+                         "a plain JAX_PLATFORMS env override)")
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    if args.elaborate:
+        from .elaborate import elaborate, format_table
+        print(format_table(elaborate(args.elaborate)))
+        return
+
+    sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes
+             else None)
+
+    if args.config:
+        from .configs import CONFIGS
+        kwargs = {}
+        if sizes:
+            if args.config == 5:
+                ap.error("--sizes does not apply to config 5 "
+                         "(fixed Llama-shaped gradients)")
+            kwargs["sizes"] = sizes
+        if args.algorithm != "xla":
+            if args.config != 2:
+                ap.error("--algorithm only applies to config 2; configs "
+                         "3-5 fix their algorithm per BASELINE")
+            kwargs["algorithm"] = args.algorithm
+        if args.wire_dtype:
+            ap.error("--wire-dtype only applies to --sweep; config 3 "
+                     "sweeps both bf16 and fp16 lanes itself")
+        result = CONFIGS[args.config](**kwargs)
+        name = f"config{args.config}.csv"
+    elif args.sweep:
+        from accl_tpu.parallel import make_mesh
+        from .sweep import sweep_collective
+        mesh = make_mesh()
+        result = sweep_collective(
+            mesh, args.sweep, sizes or [1 << 12, 1 << 16, 1 << 20],
+            algorithm=args.algorithm, wire_dtype=args.wire_dtype)
+        name = f"sweep_{args.sweep}_{args.algorithm}.csv"
+    else:
+        ap.error("pass --config, --sweep or --elaborate")
+        return
+
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, name)
+    result.to_csv(path)
+    print(result.table())
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

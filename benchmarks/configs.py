@@ -1,0 +1,205 @@
+"""The five BASELINE.json benchmark configurations.
+
+| # | Config                                               | Tier     |
+|---|------------------------------------------------------|----------|
+| 1 | 2-rank send/recv ping-pong, fp32                     | emulator |
+| 2 | 8-rank ring all-reduce, fp32, 1 KiB-256 MiB sweep    | mesh     |
+| 3 | all-gather + reduce-scatter, fp16/bf16 wire lanes    | mesh     |
+| 4 | 32-rank tree bcast/scatter/gather over a 2D mesh     | mesh     |
+| 5 | DP gradient all-reduce, Llama-3-8B bucketed grads    | mesh     |
+
+Each runner emits a SweepResult (CSV rows); the CLI writes them under an
+output directory for benchmarks.elaborate. "mesh" runs use every device
+of the default platform (virtual CPU mesh in tests, real chips on TPU) —
+sizes auto-scale down on the CPU emulation platform so CI stays fast.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from accl_tpu.parallel import make_mesh
+from .sweep import SweepResult, sweep_collective
+from .timing import wall_time
+
+
+def _size_sweep(lo: int, hi: int, stride: int = 4) -> list[int]:
+    """Geometric size ladder from lo, always ending exactly at hi."""
+    out = []
+    n = lo
+    while n < hi:
+        out.append(n)
+        n *= stride
+    out.append(hi)
+    return out
+
+
+def _is_cpu() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def config1_pingpong(sizes=None, world=2) -> SweepResult:
+    """Emulator-tier send/recv ping-pong latency (fp32)."""
+    from accl_tpu.testing import emu_world
+
+    sizes = sizes or _size_sweep(64, 1 << 20)
+    accls = emu_world(world, bufsize=max(sizes) + 64)
+    a0, a1 = accls[0], accls[1]
+    rows = []
+    import concurrent.futures
+    pool = concurrent.futures.ThreadPoolExecutor(2)
+    try:
+        return _pingpong_rows(a0, a1, pool, sizes, rows, world)
+    finally:
+        for a in accls:
+            a.deinit()
+        pool.shutdown(wait=False)
+
+
+def _pingpong_rows(a0, a1, pool, sizes, rows, world) -> SweepResult:
+    for nbytes in sizes:
+        count = nbytes // 4
+        s0 = a0.buffer(data=np.ones(count, np.float32))
+        r0 = a0.buffer((count,), np.float32)
+        s1 = a1.buffer(data=np.ones(count, np.float32))
+        r1 = a1.buffer((count,), np.float32)
+
+        def rank0():
+            a0.send(s0, count, dst=1, tag=7)
+            a0.recv(r0, count, src=1, tag=9)
+
+        def rank1():
+            a1.recv(r1, count, src=0, tag=7)
+            a1.send(s1, count, dst=0, tag=9)
+
+        def once():
+            f0 = pool.submit(rank0)
+            f1 = pool.submit(rank1)
+            f0.result(30)
+            f1.result(30)
+
+        p50, _ = wall_time(once, reps=11, warmup=2)
+        t = p50 / 2  # one-way
+        rows.append({
+            "collective": "sendrecv", "algorithm": "emu", "world": world,
+            "dtype": "float32", "wire_dtype": "", "nbytes": nbytes,
+            "seconds_per_op": t, "bus_gbps": round(nbytes / t / 1e9, 4),
+            "tier": "emulator",
+        })
+    return SweepResult(rows)
+
+
+def config2_allreduce_sweep(sizes=None, algorithm: str = "xla"
+                            ) -> SweepResult:
+    hi = (1 << 22) if _is_cpu() else (1 << 28)
+    sizes = sizes or _size_sweep(1 << 10, hi)
+    mesh = make_mesh()
+    return sweep_collective(mesh, "allreduce", sizes, algorithm=algorithm,
+                            tier="mesh")
+
+
+def config3_compressed(sizes=None) -> SweepResult:
+    hi = (1 << 22) if _is_cpu() else (1 << 27)
+    sizes = sizes or _size_sweep(1 << 12, hi)
+    mesh = make_mesh()
+    rows = []
+    for op in ("allgather", "reduce_scatter"):
+        for wire in ("bfloat16", "float16"):
+            r = sweep_collective(mesh, op, sizes, algorithm="ring",
+                                 wire_dtype=wire, tier="mesh")
+            rows.extend(r.rows)
+    return SweepResult(rows)
+
+
+def config4_tree(sizes=None) -> SweepResult:
+    hi = (1 << 22) if _is_cpu() else (1 << 26)
+    sizes = sizes or _size_sweep(1 << 12, hi)
+    ndev = len(jax.devices())
+    if ndev >= 32:
+        shape = (8, 4)
+    elif ndev >= 8:
+        shape = (4, 2)
+    else:
+        shape = (2, 2) if ndev >= 4 else (2, 1)
+    mesh = make_mesh(shape, ("outer", "inner"))
+    rows = []
+    for op in ("bcast", "scatter", "gather"):
+        r = sweep_collective(mesh, op, sizes, algorithm="tree",
+                             tier="mesh")
+        rows.extend(r.rows)
+    return SweepResult(rows)
+
+
+def config5_llama_grads(bucket_bytes: int = 25 << 20) -> SweepResult:
+    """Bucketed DP gradient all-reduce on Llama-shaped gradients.
+
+    CPU emulation uses the tiny geometry; on real multi-chip hardware the
+    full Llama-3-8B parameter set is used (32 GB of fp32 gradients spread
+    over the DP axis as replicas — per-chip memory holds one replica, as
+    in DDP).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accl_tpu.models import Llama, LlamaConfig
+    from accl_tpu.parallel import bucketed_allreduce, make_bucket_plan
+
+    from .timing import slope_time
+
+    mesh = make_mesh(axis_names=("dp",))
+    W = mesh.shape["dp"]
+    if _is_cpu():
+        config = LlamaConfig.tiny(dim=128, n_layers=4, n_heads=4,
+                                  n_kv_heads=4, ffn_dim=256)
+        bucket_bytes = 64 << 10
+    else:
+        config = (LlamaConfig.llama3_8b() if W > 1
+                  else LlamaConfig.tiny(dim=1024, n_layers=8, n_heads=16,
+                                        n_kv_heads=16, ffn_dim=4096))
+    model = Llama(config)
+    shapes = jax.eval_shape(lambda k: model.init(k), jax.random.key(0))
+    plan = make_bucket_plan(shapes, bucket_bytes)
+    total = plan.total_bytes
+
+    # grads replicated per rank: leading dp axis, same bytes per chip
+    grads = jax.tree.map(
+        lambda s: jax.device_put(
+            jnp.full((W,) + s.shape, 1e-3, s.dtype),
+            NamedSharding(mesh, P("dp"))), shapes)
+
+    def make_chain(K):
+        def shard_fn(g):
+            local = jax.tree.map(lambda x: x[0], g)
+
+            def body(i, acc):
+                return bucketed_allreduce(acc, "dp", plan=plan)
+
+            out = jax.lax.fori_loop(0, K, body, local)
+            leaf = jax.tree.leaves(out)[0]
+            return jnp.sum(leaf.reshape(-1)[:1])[None]
+
+        from jax.sharding import PartitionSpec as P2
+        f = jax.shard_map(shard_fn, mesh=mesh, in_specs=P2("dp"),
+                          out_specs=P2("dp"), check_vma=False)
+        return jax.jit(lambda v: f(v)[0])
+
+    t = slope_time(make_chain, (grads,), k_lo=2, k_hi=8, reps=3)
+    gbps = 2 * (W - 1) / W * total / t / 1e9
+    row = {
+        "collective": "bucketed_grad_allreduce", "algorithm": "xla",
+        "world": W, "dtype": "float32", "wire_dtype": "",
+        "nbytes": total, "seconds_per_op": t,
+        "bus_gbps": round(gbps, 4), "tier": "mesh",
+    }
+    return SweepResult([row])
+
+
+CONFIGS = {
+    1: config1_pingpong,
+    2: config2_allreduce_sweep,
+    3: config3_compressed,
+    4: config4_tree,
+    5: config5_llama_grads,
+}

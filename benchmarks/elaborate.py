@@ -1,0 +1,66 @@
+"""Aggregate benchmark CSVs: mean/std per (collective, algorithm, nbytes).
+
+Parity: test/host/elaborate_csv.py — walk a directory of per-run CSVs,
+aggregate throughput/latency into one res.csv + printable table.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from collections import defaultdict
+
+import numpy as np
+
+RESULT_FIELDS = ["collective", "algorithm", "world", "dtype", "wire_dtype",
+                 "nbytes", "tier", "runs",
+                 "avg_bus_gbps", "std_bus_gbps",
+                 "avg_us_per_op", "std_us_per_op"]
+
+
+def elaborate(in_dir: str, out_csv: str | None = None) -> list[dict]:
+    """Aggregate every sweep CSV under ``in_dir``; write ``res.csv``."""
+    cells = defaultdict(lambda: {"bus": [], "us": []})
+    for name in sorted(os.listdir(in_dir)):
+        if not name.endswith(".csv") or name == "res.csv":
+            continue
+        with open(os.path.join(in_dir, name), newline="") as f:
+            for row in csv.DictReader(f):
+                key = (row["collective"], row["algorithm"], row["world"],
+                       row["dtype"], row["wire_dtype"], int(row["nbytes"]),
+                       row["tier"])
+                cells[key]["bus"].append(float(row["bus_gbps"]))
+                cells[key]["us"].append(
+                    float(row["seconds_per_op"]) * 1e6)
+
+    results = []
+    for key in sorted(cells, key=lambda k: (k[0], k[1], k[5])):
+        coll, algo, world, dtype, wire, nbytes, tier = key
+        bus, us = cells[key]["bus"], cells[key]["us"]
+        results.append({
+            "collective": coll, "algorithm": algo, "world": world,
+            "dtype": dtype, "wire_dtype": wire, "nbytes": nbytes,
+            "tier": tier, "runs": len(bus),
+            "avg_bus_gbps": round(float(np.mean(bus)), 4),
+            "std_bus_gbps": round(float(np.std(bus)), 4),
+            "avg_us_per_op": round(float(np.mean(us)), 2),
+            "std_us_per_op": round(float(np.std(us)), 2),
+        })
+
+    if out_csv is None:
+        out_csv = os.path.join(in_dir, "res.csv")
+    with open(out_csv, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=RESULT_FIELDS)
+        w.writeheader()
+        w.writerows(results)
+    return results
+
+
+def format_table(results: list[dict]) -> str:
+    lines = ["{:<16} {:>6} {:>4} {:>12} {:>12} {:>12}".format(
+        "collective", "algo", "W", "nbytes", "bus GB/s", "us/op")]
+    for r in results:
+        lines.append("{:<16} {:>6} {:>4} {:>12} {:>12.3f} {:>12.1f}".format(
+            r["collective"], r["algorithm"], r["world"], r["nbytes"],
+            r["avg_bus_gbps"], r["avg_us_per_op"]))
+    return "\n".join(lines)

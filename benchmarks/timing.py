@@ -1,0 +1,62 @@
+"""Timing primitives for device benchmarks.
+
+The remote-device tunnel makes single-dispatch timing unreliable (dispatch
+returns before completion; a scalar fetch pays ~60 ms RPC latency), so the
+canonical method — same as the repo-root bench.py — chains K iterations of
+the op inside one jitted program ending in a scalar fetch and takes the
+slope between a small-K and a large-K run: fixed costs (dispatch, fetch,
+compile cache hits) cancel, leaving seconds/op.
+
+This is the TPU analog of the reference's chained-async benchmark loop
+(test/host/test.py:923-1156: queue niter chained calls, wall-clock the
+chain, divide).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def timed_scalar(fn, args, reps: int = 5) -> float:
+    """Median wall time of fn(*args) forced to a host scalar."""
+    float(fn(*args))  # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def slope_time(make_chain, args, k_lo: int = 4, k_hi: int = 36,
+               reps: int = 5) -> float:
+    """Seconds per iteration via the (k_hi - k_lo) slope.
+
+    ``make_chain(K)`` must return a jitted callable running K chained
+    iterations of the op and reducing to a scalar.
+    """
+    t_lo = timed_scalar(make_chain(k_lo), args, reps=reps)
+    t_hi = timed_scalar(make_chain(k_hi), args, reps=reps)
+    if t_hi <= t_lo:
+        import warnings
+        warnings.warn(
+            f"non-positive timing slope (t_lo={t_lo:.2e}s, "
+            f"t_hi={t_hi:.2e}s): host too noisy or op too small for "
+            f"K={k_lo}..{k_hi}; result clamped and unreliable",
+            RuntimeWarning, stacklevel=2)
+    return max(t_hi - t_lo, 1e-9) / (k_hi - k_lo)
+
+
+def wall_time(fn, reps: int = 20, warmup: int = 3) -> tuple[float, float]:
+    """(p50, std) wall-clock seconds of a blocking host-side call — the
+    emulator-tier method (no async dispatch to cancel out)."""
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)), float(np.std(ts))

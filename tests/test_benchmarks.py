@@ -1,0 +1,68 @@
+"""Benchmark harness: sweep rows, CSV round-trip, aggregation."""
+
+import csv
+
+import numpy as np
+
+from accl_tpu.parallel import cpu_mesh
+from benchmarks.elaborate import elaborate, format_table
+from benchmarks.sweep import SweepResult, bus_factor, sweep_collective
+
+
+def test_bus_factors():
+    assert bus_factor("allreduce", 8) == 2 * 7 / 8
+    assert bus_factor("allgather", 8) == 7 / 8
+    assert bus_factor("bcast", 8) == 1.0
+
+
+def test_sweep_and_elaborate_roundtrip(tmp_path):
+    mesh = cpu_mesh(8)
+    res = sweep_collective(mesh, "allreduce", [4096], algorithm="xla",
+                           reps=2)
+    assert len(res.rows) == 1
+    row = res.rows[0]
+    assert row["world"] == 8
+    assert row["nbytes"] == 4096
+    assert row["seconds_per_op"] > 0
+    assert row["bus_gbps"] > 0
+    assert "allreduce" in res.table()
+
+    res.to_csv(str(tmp_path / "a.csv"))
+    res.to_csv(str(tmp_path / "b.csv"))
+    agg = elaborate(str(tmp_path))
+    assert len(agg) == 1
+    assert agg[0]["runs"] == 2
+    np.testing.assert_allclose(agg[0]["avg_bus_gbps"], row["bus_gbps"],
+                               rtol=1e-3)
+    assert "allreduce" in format_table(agg)
+    with open(tmp_path / "res.csv", newline="") as f:
+        assert len(list(csv.DictReader(f))) == 1
+
+
+def test_sweep_ops_produce_rows():
+    mesh = cpu_mesh(8)
+    for op in ("allgather", "reduce_scatter", "alltoall"):
+        res = sweep_collective(mesh, op, [8192], reps=2)
+        assert res.rows[0]["seconds_per_op"] > 0, op
+
+
+def test_sweep_tree_2d():
+    mesh = cpu_mesh(8, shape=(4, 2), axis_names=("outer", "inner"))
+    for op in ("bcast", "scatter", "gather"):
+        res = sweep_collective(mesh, op, [8192], algorithm="tree", reps=2)
+        assert res.rows[0]["seconds_per_op"] > 0, op
+        assert res.rows[0]["algorithm"] == "tree"
+
+
+def test_sweep_scatter_requires_tree():
+    import pytest as _pytest
+    mesh = cpu_mesh(8)
+    with _pytest.raises(NotImplementedError):
+        sweep_collective(mesh, "scatter", [8192], algorithm="xla", reps=2)
+
+
+def test_sendrecv_pingpong_2rank():
+    mesh = cpu_mesh(2)
+    res = sweep_collective(mesh, "sendrecv", [4096], reps=2)
+    assert res.rows[0]["world"] == 2
+    assert res.rows[0]["seconds_per_op"] > 0
