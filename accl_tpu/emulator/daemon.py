@@ -126,6 +126,11 @@ class RankDaemon:
         self.executor = MoveExecutor(self.mem, self.pool, self.eth.send,
                                      timeout=self.timeout)
         self._arrays: dict[int, np.ndarray] = {}
+        # internal scratch for barrier (1-element allreduce rendezvous);
+        # reserved address far above the driver's 4K-aligned bump allocator
+        self._barrier_addr = 1 << 60
+        self._barrier_scratch = np.zeros(2, np.float32)
+        self.mem.register(self._barrier_addr, self._barrier_scratch)
         # async call tracking (hostctrl ap_ctrl_chain parity)
         self._next_call_id = 1
         self._call_status: dict[int, int | None] = {}
@@ -163,6 +168,17 @@ class RankDaemon:
             comm = self.comms.get(c["comm_id"])
             if comm is None:
                 return int(ErrorCode.COMM_NOT_CONFIGURED)
+            if scenario == CCLOp.barrier:
+                # rendezvous: 1-element fp32 allreduce on internal scratch;
+                # every descriptor field that could change the data movement
+                # is normalized so barrier semantics are dtype/flag-invariant
+                f32 = P.DTYPE_CODES["float32"]
+                c = dict(c, scenario=int(CCLOp.allreduce), count=1,
+                         func=int(ReduceFunc.SUM), compression=0, stream=0,
+                         udtype=f32, cdtype=f32,
+                         addr0=self._barrier_addr,
+                         addr2=self._barrier_addr + 4)
+                scenario = CCLOp.allreduce
             cfg = ArithConfig(P.code_dtype(c["udtype"]),
                               P.code_dtype(c["cdtype"]))
             ctx = MoveContext(world_size=comm.size,

@@ -69,10 +69,22 @@ class SweepResult:
         return "\n".join(lines)
 
 
+_ALLOWED_ALGOS = {
+    "allreduce": {"xla", "ring"}, "allgather": {"xla", "ring"},
+    "reduce_scatter": {"xla", "ring"}, "bcast": {"xla", "tree"},
+    "scatter": {"tree"}, "gather": {"tree"}, "alltoall": {"xla"},
+    "sendrecv": {"xla"},
+}
+
+
 def _iteration(op: str, algorithm: str, ax: str, W: int, me,
                func: ReduceFunc, wire_dtype, root: int = 0,
                axes2d: tuple[str, str] | None = None):
     """Build the shape-preserving per-iteration body x -> x."""
+    if algorithm not in _ALLOWED_ALGOS.get(op, set()):
+        raise NotImplementedError(
+            f"{op} has no '{algorithm}' algorithm "
+            f"(supported: {sorted(_ALLOWED_ALGOS.get(op, set()))})")
     scale = 1.0 / W
 
     if op == "allreduce":
@@ -110,7 +122,7 @@ def _iteration(op: str, algorithm: str, ax: str, W: int, me,
             return lambda x: tree_bcast_shard(x, root, o, i)
         return lambda x: masked_bcast(x, root, ax)
     if op == "scatter":
-        if algorithm != "tree" or axes2d is None:
+        if axes2d is None:
             raise NotImplementedError(
                 "scatter sweeps require algorithm='tree' on a 2D mesh")
         o, i = axes2d
@@ -119,7 +131,7 @@ def _iteration(op: str, algorithm: str, ax: str, W: int, me,
             return jnp.broadcast_to(mine, x.shape)
         return body
     if op == "gather":
-        if algorithm != "tree" or axes2d is None:
+        if axes2d is None:
             raise NotImplementedError(
                 "gather sweeps require algorithm='tree' on a 2D mesh")
         o, i = axes2d
@@ -192,6 +204,8 @@ def sweep_collective(mesh: Mesh, op: str, sizes: Sequence[int],
             jnp.full((W,) + shard_shape, 1.0 / W, dtype),
             NamedSharding(mesh, P(*spec)))
         t = slope_time(make_chain, (x,), reps=reps)
+        if op == "sendrecv":
+            t /= 2  # the iteration body is a 2-hop round trip; report one-way
         gbps = bus_factor(op, W) * count * itemsize / t / 1e9
         rows.append({
             "collective": op, "algorithm": algorithm, "world": W,

@@ -1,0 +1,232 @@
+// C++ driver demo/acceptance test: one process per rank, driving its rank
+// daemon through the full op surface with validation.
+//
+// Role parity with the reference XRT demo main (driver/xrt/src/main.cpp:
+// 34-100 — per-stage Timer microbenchmarks and a nop) plus the hardware
+// test program's per-collective validation style (test/host/test.py).
+//
+//   ./cclo_emud --rank R --world W --port-base P   (per rank, then)
+//   ./accl_demo --rank R --world W --port-base P
+//
+// Prints per-stage timings and "rank R: all tests succeeded" on success;
+// exits nonzero on any mismatch (greppable by the orchestrator).
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "accl_driver.hpp"
+
+using accl::ACCL;
+using accl::Buffer;
+using accl::Timer;
+using namespace accl_proto;
+
+static int failures = 0;
+
+static void expect_near(const std::vector<float>& got, float want,
+                        const char* what, size_t lo = 0,
+                        size_t hi = SIZE_MAX) {
+  if (hi == SIZE_MAX) hi = got.size();
+  for (size_t i = lo; i < hi; ++i) {
+    if (std::fabs(got[i] - want) > 1e-4f * std::fabs(want) + 1e-5f) {
+      std::fprintf(stderr, "FAIL %s: [%zu] = %g, want %g\n", what, i,
+                   got[i], want);
+      ++failures;
+      return;
+    }
+  }
+}
+
+int main(int argc, char** argv) {
+  uint32_t rank = 0, world = 2;
+  uint16_t port_base = 45000;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    std::string k = argv[i];
+    const char* v = argv[i + 1];
+    if (k == "--rank") rank = atoi(v);
+    else if (k == "--world") world = atoi(v);
+    else if (k == "--port-base") port_base = atoi(v);
+  }
+
+  Timer t_construct, t_config, t_nop, t_collectives;
+
+  t_construct.start();
+  ACCL a("127.0.0.1", static_cast<uint16_t>(port_base + rank));
+  t_construct.end();
+
+  t_config.start();
+  a.configure_communicator(
+      accl::world_communicator(0xACC1u, world, rank, port_base));
+  a.set_timeout(20.0);
+  t_config.end();
+
+  t_nop.start();
+  a.nop();
+  t_nop.end();
+
+  const uint64_t N = 64;  // elements per rank
+  t_collectives.start();
+
+  // copy + combine (local dataplane)
+  {
+    Buffer src = a.alloc(N), dst = a.alloc(N), sum = a.alloc(N);
+    std::vector<float> v(N, 3.0f + rank);
+    a.write(src, v.data());
+    a.copy(src, dst, N);
+    expect_near(a.read_vec<float>(dst), 3.0f + rank, "copy");
+    a.combine(N, FN_SUM, src, dst, sum);
+    expect_near(a.read_vec<float>(sum), 2 * (3.0f + rank), "combine");
+    a.free(src); a.free(dst); a.free(sum);
+  }
+
+  // tag-matched send/recv ping-pong rank 0 <-> 1
+  if (world >= 2 && rank < 2) {
+    Buffer buf = a.alloc(N);
+    if (rank == 0) {
+      std::vector<float> v(N, 7.5f);
+      a.write(buf, v.data());
+      a.send(buf, N, 1, 42);
+      a.recv(buf, N, 1, 43);
+      expect_near(a.read_vec<float>(buf), -2.5f, "pingpong(0)");
+    } else {
+      a.recv(buf, N, 0, 42);
+      expect_near(a.read_vec<float>(buf), 7.5f, "pingpong(1) recv");
+      std::vector<float> v(N, -2.5f);
+      a.write(buf, v.data());
+      a.send(buf, N, 0, 43);
+    }
+    a.free(buf);
+  }
+  a.barrier();
+
+  // bcast from each root in turn
+  for (uint32_t root = 0; root < world; ++root) {
+    Buffer buf = a.alloc(N);
+    std::vector<float> v(N, rank == root ? 100.0f + root : 0.0f);
+    a.write(buf, v.data());
+    a.bcast(buf, N, root);
+    expect_near(a.read_vec<float>(buf), 100.0f + root, "bcast");
+    a.free(buf);
+  }
+
+  // allreduce (sum of rank+1 = W(W+1)/2)
+  {
+    Buffer src = a.alloc(N), dst = a.alloc(N);
+    std::vector<float> v(N, static_cast<float>(rank + 1));
+    a.write(src, v.data());
+    a.allreduce(src, dst, N);
+    expect_near(a.read_vec<float>(dst),
+                world * (world + 1) / 2.0f, "allreduce");
+    // compressed wire (fp16 lanes)
+    a.allreduce(src, dst, N, FN_SUM, DT_F16);
+    expect_near(a.read_vec<float>(dst),
+                world * (world + 1) / 2.0f, "allreduce(fp16 wire)");
+    a.free(src); a.free(dst);
+  }
+
+  // reduce to root 0, max
+  {
+    Buffer src = a.alloc(N), dst = a.alloc(N);
+    std::vector<float> v(N, static_cast<float>(rank * 2));
+    a.write(src, v.data());
+    a.reduce(src, dst, N, 0, FN_MAX);
+    if (rank == 0)
+      expect_near(a.read_vec<float>(dst), 2.0f * (world - 1),
+                  "reduce(max)");
+    a.free(src); a.free(dst);
+  }
+
+  // scatter/gather round trip via root 0
+  {
+    Buffer big = a.alloc(N * world), mine = a.alloc(N),
+           back = a.alloc(N * world);
+    if (rank == 0) {
+      std::vector<float> v(N * world);
+      for (uint64_t i = 0; i < N * world; ++i)
+        v[i] = static_cast<float>(i / N);  // chunk r holds value r
+      a.write(big, v.data());
+    }
+    a.scatter(big, mine, N, 0);
+    expect_near(a.read_vec<float>(mine), static_cast<float>(rank),
+                "scatter");
+    a.gather(mine, back, N, 0);
+    if (rank == 0) {
+      auto v = a.read_vec<float>(back);
+      for (uint32_t r = 0; r < world; ++r)
+        expect_near(v, static_cast<float>(r), "gather", r * N,
+                    (r + 1) * N);
+    }
+    a.free(big); a.free(mine); a.free(back);
+  }
+
+  // allgather + reduce_scatter
+  {
+    Buffer chunk = a.alloc(N), all = a.alloc(N * world);
+    std::vector<float> v(N, static_cast<float>(10 + rank));
+    a.write(chunk, v.data());
+    a.allgather(chunk, all, N);
+    auto got = a.read_vec<float>(all);
+    for (uint32_t r = 0; r < world; ++r)
+      expect_near(got, static_cast<float>(10 + r), "allgather", r * N,
+                  (r + 1) * N);
+
+    Buffer big = a.alloc(N * world), red = a.alloc(N);
+    std::vector<float> w(N * world);
+    for (uint64_t i = 0; i < N * world; ++i)
+      w[i] = static_cast<float>(i / N + 1);  // chunk r = r+1 everywhere
+    a.write(big, w.data());
+    a.reduce_scatter(big, red, N);
+    expect_near(a.read_vec<float>(red),
+                static_cast<float>((rank + 1) * world), "reduce_scatter");
+    a.free(chunk); a.free(all); a.free(big); a.free(red);
+  }
+
+  // alltoall
+  {
+    Buffer src = a.alloc(N * world), dst = a.alloc(N * world);
+    std::vector<float> v(N * world);
+    for (uint64_t i = 0; i < N * world; ++i)
+      v[i] = static_cast<float>(rank * 1000 + i / N);  // chunk d: my row d
+    a.write(src, v.data());
+    a.alltoall(src, dst, N);
+    auto got = a.read_vec<float>(dst);
+    for (uint32_t r = 0; r < world; ++r)
+      expect_near(got, static_cast<float>(r * 1000 + rank), "alltoall",
+                  r * N, (r + 1) * N);
+    a.free(src); a.free(dst);
+  }
+
+  // error path: recv with no matching send must raise RECEIVE_TIMEOUT
+  {
+    a.set_timeout(0.2);
+    Buffer buf = a.alloc(4);
+    bool threw = false;
+    try {
+      a.recv(buf, 4, (rank + 1) % world, 777);
+    } catch (const accl::ACCLError& e) {
+      threw = (e.error_word & E_RECV_TIMEOUT) != 0;
+    }
+    if (!threw) {
+      std::fprintf(stderr, "FAIL timeout: no RECEIVE_TIMEOUT_ERROR\n");
+      ++failures;
+    }
+    a.set_timeout(20.0);
+    a.free(buf);
+  }
+  a.barrier();
+  t_collectives.end();
+
+  std::printf("rank %u: t_construct=%lu us t_config=%lu us t_nop=%lu us "
+              "t_collectives=%lu us\n", rank, t_construct.elapsed_us(),
+              t_config.elapsed_us(), t_nop.elapsed_us(),
+              t_collectives.elapsed_us());
+  if (failures) {
+    std::fprintf(stderr, "rank %u: %d FAILURES\n", rank, failures);
+    return 1;
+  }
+  std::printf("rank %u: all tests succeeded\n", rank);
+  return 0;
+}

@@ -1,0 +1,402 @@
+// accl_tpu C++ host driver.
+//
+// Role parity with the reference's XRT C++ driver (driver/xrt/: ACCL
+// class in xlnx-device.hpp:48-235, communicator in xlnx-comm.hpp:32-82,
+// Timer in timing.hpp:25-53) — but complete rather than WIP: the full
+// primitive/collective surface of the Python driver (accl_tpu/accl.py),
+// sync + async call forms, buffer management, error decode and
+// introspection, speaking the framed-TCP protocol (protocol.hpp) to a
+// rank daemon (cclo_emud or the Python daemon — they are
+// indistinguishable on the wire).
+//
+// Header-only; link only needs -pthread.
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/tcp.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "protocol.hpp"
+
+namespace accl {
+
+using namespace accl_proto;
+
+// Timer parity: driver/xrt/include/timing.hpp
+class Timer {
+ public:
+  void start() { start_ = clock_::now(); started_ = true; }
+  void end() { end_ = clock_::now(); ended_ = true; }
+  unsigned long elapsed_us() const {
+    if (!started_ || !ended_) return 0;
+    return static_cast<unsigned long>(
+        std::chrono::duration_cast<std::chrono::microseconds>(end_ - start_)
+            .count());
+  }
+
+ private:
+  using clock_ = std::chrono::steady_clock;
+  clock_::time_point start_, end_;
+  bool started_ = false, ended_ = false;
+};
+
+struct RankSpec {
+  std::string host;
+  uint16_t port;       // the rank daemon's CMD port; daemons derive the
+                       // eth port themselves as cmd port + world
+  uint32_t global_rank;
+};
+
+struct Communicator {
+  uint32_t comm_id;
+  uint32_t local_rank;
+  std::vector<RankSpec> ranks;
+  uint32_t size() const { return static_cast<uint32_t>(ranks.size()); }
+};
+
+struct Buffer {
+  uint64_t addr = 0;
+  uint64_t count = 0;
+  uint8_t dtype = DT_F32;
+  uint64_t nbytes() const { return count * dtype_size(dtype); }
+};
+
+class ACCLError : public std::runtime_error {
+ public:
+  ACCLError(uint32_t err, const std::string& what)
+      : std::runtime_error(what), error_word(err) {}
+  uint32_t error_word;
+};
+
+inline std::string decode_error(uint32_t err) {
+  if (err == E_OK) return "success";
+  std::string s;
+  auto add = [&](uint32_t bit, const char* name) {
+    if (err & bit) { if (!s.empty()) s += "|"; s += name; }
+  };
+  add(E_DMA_MISMATCH, "DMA_MISMATCH_ERROR");
+  add(E_RECV_TIMEOUT, "RECEIVE_TIMEOUT_ERROR");
+  add(E_DMA_SIZE, "DMA_SIZE_ERROR");
+  add(E_COMM_NOT_CONFIGURED, "COMM_NOT_CONFIGURED");
+  add(E_SPARE_OVERFLOW, "SPARE_BUFFER_OVERFLOW");
+  add(E_INVALID, "INVALID_CALL");
+  return s.empty() ? "error 0x" + std::to_string(err) : s;
+}
+
+// One rank's handle to its daemon: the C++ `accl` class.
+class ACCL {
+ public:
+  ACCL(const std::string& host, uint16_t cmd_port,
+       double connect_timeout_s = 10.0) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(connect_timeout_s);
+    while (true) {
+      fd_ = try_connect(host, cmd_port);
+      if (fd_ >= 0) break;
+      if (std::chrono::steady_clock::now() >= deadline)
+        throw std::runtime_error("cannot connect to rank daemon at " +
+                                 host + ":" + std::to_string(cmd_port));
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    ping();
+  }
+
+  ~ACCL() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  ACCL(const ACCL&) = delete;
+  ACCL& operator=(const ACCL&) = delete;
+
+  // -- lifecycle ----------------------------------------------------------
+  void configure_communicator(const Communicator& comm) {
+    std::vector<uint8_t> body{MSG_CONFIG_COMM};
+    put_le<uint32_t>(body, comm.comm_id);
+    put_le<uint32_t>(body, comm.local_rank);
+    put_le<uint32_t>(body, comm.size());
+    for (const auto& r : comm.ranks) {
+      put_le<uint32_t>(body, r.global_rank);
+      put_le<uint16_t>(body, r.port);
+      put_le<uint16_t>(body, static_cast<uint16_t>(r.host.size()));
+      body.insert(body.end(), r.host.begin(), r.host.end());
+    }
+    check(body);
+    comm_ = comm;
+  }
+
+  const Communicator& comm() const { return comm_; }
+  uint32_t rank() const { return comm_.local_rank; }
+  uint32_t world_size() const { return comm_.size(); }
+
+  void set_timeout(double seconds) {
+    std::vector<uint8_t> body{MSG_SET_TIMEOUT};
+    put_le<double>(body, seconds);
+    check(body);
+  }
+
+  void set_max_segment_size(uint64_t nbytes) {
+    std::vector<uint8_t> body{MSG_SET_SEG};
+    put_le<uint64_t>(body, nbytes);
+    check(body);
+  }
+
+  void ping() { check({MSG_PING}); }
+  void soft_reset() { check({MSG_RESET}); }
+
+  std::string dump_rx_buffers() {
+    auto reply = request({MSG_DUMP_RX});
+    return std::string(reply.begin() + 1, reply.end());
+  }
+
+  // -- buffers (4 KiB-aligned bump allocator, SimBuffer parity) -----------
+  Buffer alloc(uint64_t count, uint8_t dtype = DT_F32) {
+    Buffer b;
+    b.count = count;
+    b.dtype = dtype;
+    uint64_t nbytes = b.nbytes();
+    {
+      std::lock_guard<std::mutex> lk(alloc_mu_);
+      b.addr = next_addr_;
+      next_addr_ += ((nbytes + 4095) / 4096 + 1) * 4096;
+    }
+    std::vector<uint8_t> body{MSG_ALLOC};
+    put_le<uint64_t>(body, b.addr);
+    put_le<uint64_t>(body, nbytes);
+    check(body);
+    return b;
+  }
+
+  void free(const Buffer& b) {
+    std::vector<uint8_t> body{MSG_FREE};
+    put_le<uint64_t>(body, b.addr);
+    check(body);
+  }
+
+  void write(const Buffer& b, const void* data, uint64_t nbytes = 0) {
+    if (!nbytes) nbytes = b.nbytes();
+    std::vector<uint8_t> body{MSG_WRITE_MEM};
+    put_le<uint64_t>(body, b.addr);
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    body.insert(body.end(), p, p + nbytes);
+    check(body);
+  }
+
+  void read(const Buffer& b, void* data, uint64_t nbytes = 0) {
+    if (!nbytes) nbytes = b.nbytes();
+    std::vector<uint8_t> body{MSG_READ_MEM};
+    put_le<uint64_t>(body, b.addr);
+    put_le<uint64_t>(body, nbytes);
+    auto reply = request(body);
+    if (reply.empty() || reply[0] != MSG_DATA || reply.size() - 1 < nbytes)
+      throw std::runtime_error("short MSG_READ_MEM reply");
+    std::memcpy(data, reply.data() + 1, nbytes);
+  }
+
+  template <typename T>
+  std::vector<T> read_vec(const Buffer& b) {
+    std::vector<T> out(b.count);
+    read(b, out.data(), b.count * sizeof(T));
+    return out;
+  }
+
+  // -- calls --------------------------------------------------------------
+  // Async form: returns a call id; wait(id) blocks until retirement.
+  uint32_t call_async(uint8_t scenario, uint64_t count, uint32_t root,
+                      uint8_t func, uint32_t tag, uint64_t addr0,
+                      uint64_t addr1, uint64_t addr2, uint8_t udtype,
+                      uint8_t cdtype, uint8_t compression = C_NONE,
+                      uint8_t stream = 0) {
+    std::vector<uint8_t> body{MSG_CALL};
+    put_le<uint8_t>(body, scenario);
+    put_le<uint8_t>(body, func);
+    put_le<uint8_t>(body, compression);
+    put_le<uint8_t>(body, stream);
+    put_le<uint8_t>(body, udtype);
+    put_le<uint8_t>(body, cdtype);
+    put_le<uint64_t>(body, count);
+    put_le<uint32_t>(body, comm_.comm_id);
+    put_le<uint32_t>(body, root);
+    put_le<uint32_t>(body, tag);
+    put_le<uint64_t>(body, addr0);
+    put_le<uint64_t>(body, addr1);
+    put_le<uint64_t>(body, addr2);
+    put_le<uint16_t>(body, 0);  // n_waitfor (chaining is wait()-side here)
+    auto reply = request(body);
+    if (reply.empty() || reply[0] != MSG_CALL_ID)
+      throw std::runtime_error("bad MSG_CALL reply");
+    return get_le<uint32_t>(reply.data() + 1);
+  }
+
+  void wait(uint32_t call_id, double budget_s = 0.05) {
+    while (true) {
+      std::vector<uint8_t> body{MSG_WAIT};
+      put_le<uint32_t>(body, call_id);
+      put_le<double>(body, budget_s);
+      uint32_t err = request_status(body);
+      if (err == STATUS_PENDING) continue;
+      if (err != E_OK)
+        throw ACCLError(err, "call " + std::to_string(call_id) +
+                                 " failed: " + decode_error(err));
+      return;
+    }
+  }
+
+  // -- primitives (Python accl.py surface) --------------------------------
+  void nop() { wait(call_async(OP_NOP, 0, 0, 0, 0, 0, 0, 0, DT_F32, DT_F32)); }
+
+  void copy(const Buffer& src, const Buffer& dst, uint64_t count) {
+    wait(call_async(OP_COPY, count, 0, 0, 0, src.addr, 0, dst.addr,
+                    src.dtype, src.dtype));
+  }
+
+  void combine(uint64_t count, uint8_t func, const Buffer& op0,
+               const Buffer& op1, const Buffer& res) {
+    wait(call_async(OP_COMBINE, count, 0, func, 0, op0.addr, op1.addr,
+                    res.addr, op0.dtype, op0.dtype));
+  }
+
+  void send(const Buffer& src, uint64_t count, uint32_t dst, uint32_t tag,
+            uint8_t wire_dtype = 0xFF) {
+    uint8_t cd = wire_dtype == 0xFF ? src.dtype : wire_dtype;
+    uint8_t comp = cd != src.dtype ? C_ETH : C_NONE;
+    wait(call_async(OP_SEND, count, dst, 0, tag, src.addr, 0, 0, src.dtype,
+                    cd, comp));
+  }
+
+  void recv(const Buffer& dst, uint64_t count, uint32_t src, uint32_t tag,
+            uint8_t wire_dtype = 0xFF) {
+    uint8_t cd = wire_dtype == 0xFF ? dst.dtype : wire_dtype;
+    uint8_t comp = cd != dst.dtype ? C_ETH : C_NONE;
+    wait(call_async(OP_RECV, count, src, 0, tag, 0, 0, dst.addr, dst.dtype,
+                    cd, comp));
+  }
+
+  // -- collectives --------------------------------------------------------
+  void bcast(const Buffer& buf, uint64_t count, uint32_t root) {
+    wait(call_async(OP_BCAST, count, root, 0, TAG_ANY, buf.addr, 0, 0,
+                    buf.dtype, buf.dtype));
+  }
+
+  void scatter(const Buffer& src, const Buffer& dst, uint64_t count,
+               uint32_t root) {
+    wait(call_async(OP_SCATTER, count, root, 0, TAG_ANY, src.addr, 0,
+                    dst.addr, dst.dtype, dst.dtype));
+  }
+
+  void gather(const Buffer& src, const Buffer& dst, uint64_t count,
+              uint32_t root) {
+    wait(call_async(OP_GATHER, count, root, 0, TAG_ANY, src.addr, 0,
+                    dst.addr, src.dtype, src.dtype));
+  }
+
+  void reduce(const Buffer& src, const Buffer& dst, uint64_t count,
+              uint32_t root, uint8_t func = FN_SUM) {
+    wait(call_async(OP_REDUCE, count, root, func, TAG_ANY, src.addr, 0,
+                    dst.addr, src.dtype, src.dtype));
+  }
+
+  void allgather(const Buffer& src, const Buffer& dst, uint64_t count) {
+    wait(call_async(OP_ALLGATHER, count, 0, 0, TAG_ANY, src.addr, 0,
+                    dst.addr, src.dtype, src.dtype));
+  }
+
+  void allreduce(const Buffer& src, const Buffer& dst, uint64_t count,
+                 uint8_t func = FN_SUM, uint8_t wire_dtype = 0xFF) {
+    uint8_t cd = wire_dtype == 0xFF ? src.dtype : wire_dtype;
+    uint8_t comp = cd != src.dtype ? C_ETH : C_NONE;
+    wait(call_async(OP_ALLREDUCE, count, 0, func, TAG_ANY, src.addr, 0,
+                    dst.addr, src.dtype, cd, comp));
+  }
+
+  void reduce_scatter(const Buffer& src, const Buffer& dst, uint64_t count,
+                      uint8_t func = FN_SUM) {
+    wait(call_async(OP_REDUCE_SCATTER, count, 0, func, TAG_ANY, src.addr,
+                    0, dst.addr, src.dtype, src.dtype));
+  }
+
+  void alltoall(const Buffer& src, const Buffer& dst, uint64_t count) {
+    wait(call_async(OP_ALLTOALL, count, 0, 0, TAG_ANY, src.addr, 0,
+                    dst.addr, src.dtype, src.dtype));
+  }
+
+  void barrier() {
+    wait(call_async(OP_BARRIER, 1, 0, 0, TAG_ANY, 0, 0, 0, DT_F32,
+                    DT_F32));
+  }
+
+  void shutdown_daemon() { check({MSG_SHUTDOWN}); }
+
+ private:
+  static int try_connect(const std::string& host, uint16_t port) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      ::close(fd);
+      return -1;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      ::close(fd);
+      return -1;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return fd;
+  }
+
+  std::vector<uint8_t> request(const std::vector<uint8_t>& body) {
+    std::lock_guard<std::mutex> lk(io_mu_);
+    if (!send_frame(fd_, body))
+      throw std::runtime_error("daemon connection closed (send)");
+    std::vector<uint8_t> reply;
+    if (!recv_frame(fd_, reply))
+      throw std::runtime_error("daemon connection closed (recv)");
+    return reply;
+  }
+
+  uint32_t request_status(const std::vector<uint8_t>& body) {
+    auto reply = request(body);
+    if (reply.size() < 5 || reply[0] != MSG_STATUS)
+      throw std::runtime_error("bad status reply");
+    return get_le<uint32_t>(reply.data() + 1);
+  }
+
+  void check(const std::vector<uint8_t>& body) {
+    uint32_t err = request_status(body);
+    if (err != E_OK) throw ACCLError(err, decode_error(err));
+  }
+
+  int fd_ = -1;
+  std::mutex io_mu_;
+  std::mutex alloc_mu_;
+  uint64_t next_addr_ = 4096;
+  Communicator comm_;
+};
+
+// Convenience: a world communicator over daemons at port_base..+W-1, with
+// eth ports at port_base+W.. (the daemon spawn convention).
+inline Communicator world_communicator(uint32_t comm_id, uint32_t world,
+                                       uint32_t local_rank,
+                                       uint16_t port_base,
+                                       const std::string& host =
+                                           "127.0.0.1") {
+  Communicator c;
+  c.comm_id = comm_id;
+  c.local_rank = local_rank;
+  for (uint32_t r = 0; r < world; ++r)
+    c.ranks.push_back({host, static_cast<uint16_t>(port_base + r), r});
+  return c;
+}
+
+}  // namespace accl

@@ -1,0 +1,127 @@
+// Shared wire-protocol definitions for accl_tpu native components.
+//
+// Must match accl_tpu/emulator/protocol.py: length-prefixed (u32-LE)
+// binary frames over TCP; body = u8 message type + payload. Used by the
+// rank daemon (cclo_emud.cpp) and the C++ host driver (accl_driver.hpp)
+// — the C++ analog of the reference's split between the device-side ZMQ
+// bridge (test/zmq/zmq_intf.cpp) and the XRT host driver (driver/xrt/).
+
+#pragma once
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace accl_proto {
+
+enum Msg : uint8_t {
+  MSG_CALL = 1, MSG_WAIT = 2, MSG_ALLOC = 3, MSG_FREE = 4,
+  MSG_WRITE_MEM = 5, MSG_READ_MEM = 6, MSG_CONFIG_COMM = 7,
+  MSG_SET_TIMEOUT = 8, MSG_SET_SEG = 9, MSG_PING = 10, MSG_SHUTDOWN = 11,
+  MSG_RESET = 12, MSG_DUMP_RX = 13, MSG_GET_INFO = 14,
+  MSG_STATUS = 100, MSG_CALL_ID = 101, MSG_DATA = 102,
+  MSG_ETH = 50,
+};
+
+static const uint32_t STATUS_PENDING = 0xFFFFFFFFu;
+
+enum Op : uint8_t {
+  OP_CONFIG = 0, OP_COPY = 1, OP_COMBINE = 2, OP_SEND = 3, OP_RECV = 4,
+  OP_BCAST = 5, OP_SCATTER = 6, OP_GATHER = 7, OP_REDUCE = 8,
+  OP_ALLGATHER = 9, OP_ALLREDUCE = 10, OP_REDUCE_SCATTER = 11,
+  OP_BARRIER = 12, OP_ALLTOALL = 13, OP_NOP = 255,
+};
+
+enum Func : uint8_t { FN_SUM = 0, FN_MAX = 1, FN_MIN = 2, FN_PROD = 3 };
+
+enum CompFlag : uint8_t {
+  C_NONE = 0, C_OP0 = 1, C_OP1 = 2, C_RES = 4, C_ETH = 8,
+};
+
+enum Err : uint32_t {
+  E_OK = 0,
+  E_DMA_MISMATCH = 1u << 0,
+  E_RECV_TIMEOUT = 1u << 8,
+  E_DMA_SIZE = 1u << 12,
+  E_COMM_NOT_CONFIGURED = 1u << 15,
+  E_SPARE_OVERFLOW = 1u << 20,
+  E_INVALID = 1u << 23,
+};
+
+static const uint32_t TAG_ANY = 0xFFFFFFFFu;
+
+// dtype codes match protocol.py DTYPE_CODES
+enum DType : uint8_t {
+  DT_F32 = 0, DT_F64 = 1, DT_I32 = 2, DT_I64 = 3,
+  DT_F16 = 4, DT_BF16 = 5, DT_I8 = 6, DT_U8 = 7,
+};
+
+inline size_t dtype_size(uint8_t dt) {
+  switch (dt) {
+    case DT_F32: case DT_I32: return 4;
+    case DT_F64: case DT_I64: return 8;
+    case DT_F16: case DT_BF16: return 2;
+    default: return 1;
+  }
+}
+
+// -- framing ---------------------------------------------------------------
+
+inline bool recv_exact(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline bool recv_frame(int fd, std::vector<uint8_t>& body) {
+  uint32_t len;
+  if (!recv_exact(fd, &len, 4)) return false;
+  body.resize(len);
+  return len == 0 || recv_exact(fd, body.data(), len);
+}
+
+inline bool send_frame(int fd, const std::vector<uint8_t>& body) {
+  uint32_t len = static_cast<uint32_t>(body.size());
+  std::vector<uint8_t> out(4 + body.size());
+  std::memcpy(out.data(), &len, 4);
+  std::memcpy(out.data() + 4, body.data(), body.size());
+  const uint8_t* p = out.data();
+  size_t n = out.size();
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+template <typename T>
+inline T get_le(const uint8_t* p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  return v;
+}
+
+template <typename T>
+inline void put_le(std::vector<uint8_t>& out, T v) {
+  size_t off = out.size();
+  out.resize(off + sizeof(T));
+  std::memcpy(out.data() + off, &v, sizeof(T));
+}
+
+inline std::vector<uint8_t> status_reply(uint32_t err) {
+  std::vector<uint8_t> r{MSG_STATUS};
+  put_le<uint32_t>(r, err);
+  return r;
+}
+
+}  // namespace accl_proto
