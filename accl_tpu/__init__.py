@@ -24,6 +24,7 @@ from .constants import (ACCLError, CCLOp, CfgFunc, Compression, ErrorCode,
                         ReduceFunc, StackType, StreamFlags, TAG_ANY,
                         decode_error)
 from .device import Device, EmuContext, EmuDevice
+from .tracing import Profiler
 
 __version__ = "0.1.0"
 
@@ -31,7 +32,7 @@ __all__ = [
     "ACCL", "ACCLBuffer", "ACCLError", "ArithConfig", "CallDescriptor",
     "CallHandle", "CCLOp", "CfgFunc", "Communicator", "Compression",
     "DEFAULT_ARITH_CONFIGS", "Device", "EmuContext", "EmuDevice",
-    "ErrorCode", "Rank", "ReduceFunc", "StackType", "StreamFlags",
+    "ErrorCode", "Profiler", "Rank", "ReduceFunc", "StackType", "StreamFlags",
     "TAG_ANY", "decode_error", "resolve_arith_config",
     "simple_communicator", "wait_all",
 ]

@@ -28,6 +28,7 @@ from .communicator import Communicator
 from .constants import (CCLOp, CfgFunc, Compression, DEFAULT_MAX_SEGMENT_SIZE,
                         ReduceFunc, StreamFlags, TAG_ANY)
 from .device.base import Device
+from .tracing import Profiler
 
 
 class ACCL:
@@ -56,6 +57,7 @@ class ACCL:
         device.set_max_segment_size(max_segment_size)
         self._barrier_buf: ACCLBuffer | None = None
         self._scratch_bufs: dict[tuple[int, str], ACCLBuffer] = {}
+        self.profiler = Profiler()
 
     def _scratch(self, count: int, dtype) -> ACCLBuffer:
         """Reusable internal scratch buffer (e.g. gather relay)."""
@@ -99,6 +101,23 @@ class ACCL:
 
     def soft_reset(self):
         self.device.soft_reset()
+
+    # -- profiling (parity: start/end_profiling cfg calls,
+    #    xlnx-consts.hpp:27-28; SURVEY §5 tracing subsystem) ----------------
+    def start_profiling(self):
+        """Enable per-call timing capture. Issues the config call through
+        the full call path (so backends see it, like the reference's cfg
+        subfunction), then arms the host-side recorder."""
+        self._call(CallDescriptor(CCLOp.config, count=0,
+                                  tag=int(CfgFunc.start_profiling)),
+                   run_async=False, waitfor=())
+        self.profiler.start()
+
+    def end_profiling(self):
+        self._call(CallDescriptor(CCLOp.config, count=0,
+                                  tag=int(CfgFunc.end_profiling)),
+                   run_async=False, waitfor=())
+        self.profiler.stop()
 
     def deinit(self):
         self.device.deinit()
@@ -156,6 +175,13 @@ class ACCL:
     def _call(self, desc: CallDescriptor, run_async: bool,
               waitfor: Sequence[CallHandle]) -> CallHandle:
         handle = self.device.call_async(desc, waitfor)
+        if self.profiler.enabled and desc.scenario != CCLOp.config:
+            ebytes = (desc.arithcfg.uncompressed_elem_bytes
+                      if desc.arithcfg is not None else 0)
+            self.profiler.attach(handle, op=desc.scenario.name,
+                                 count=desc.count,
+                                 nbytes=desc.count * ebytes,
+                                 comm_id=desc.comm_id)
         if run_async:
             return handle
         handle.wait()

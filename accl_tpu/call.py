@@ -55,6 +55,8 @@ class CallHandle:
         self._error_word = 0
         self._result: Any = None
         self._exception: BaseException | None = None
+        self._callbacks: list = []
+        self._cb_lock = threading.Lock()
         self.context = context
 
     # backend side -----------------------------------------------------
@@ -64,6 +66,26 @@ class CallHandle:
         self._result = result
         self._exception = exception
         self._done.set()
+        with self._cb_lock:
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            try:
+                cb(self._error_word)
+            except Exception:  # noqa: BLE001 — a raising observer must not
+                pass           # re-enter the backend worker / double-complete
+
+    def add_done_callback(self, fn):
+        """Invoke ``fn(error_word)`` when the call retires (immediately if
+        already retired). Used by the tracing subsystem to attribute true
+        device-side durations to async chained calls."""
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        try:
+            fn(self._error_word)
+        except Exception:  # noqa: BLE001
+            pass
 
     # host side --------------------------------------------------------
     def wait(self, timeout: float | None = None) -> Any:

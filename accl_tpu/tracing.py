@@ -1,0 +1,217 @@
+"""Tracing / profiling subsystem.
+
+The reference has no software tracer — its profiling surface is (a) the
+``nop`` op for call-latency probes (driver/pynq/accl.py:738-745), (b) the
+chained-async benchmark harness writing CSVs (test/host/test.py:923-1156),
+(c) ``start_profiling/end_profiling`` config calls in the older XRT driver
+(driver/xrt/include/xlnx-consts.hpp:27-28), and (d) hardware ILA insertion
+scripts (kernels/cclo/tcl/debug_*.tcl). SURVEY §5 maps all four onto
+first-class software replacements for the TPU rebuild; this module is it:
+
+* :class:`Profiler` — per-call timing records captured at handle-retire
+  time, with per-op summary statistics (count/total/mean/p50/p95) and CSV
+  export in the reference benchmark's spirit.
+* :func:`annotate` — names a region in the JAX/XLA profiler timeline
+  (``jax.profiler.TraceAnnotation``), the TPU-native analog of dropping an
+  ILA probe on a subsystem.
+* :func:`trace_to` — capture an xplane trace directory
+  (``jax.profiler.start_trace``), the analog of a waveform dump
+  (test/simulation/cclo.wcfg).
+* :func:`measure_call_latency` — the ``nop`` latency probe, returning the
+  same p50-style microsecond figure the reference benchmark derives.
+
+Records are captured when the backend retires the call (the handle's done
+callback), so async chains are attributed their true device-side duration,
+not the host's dispatch time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Callable, Iterable
+
+__all__ = [
+    "CallRecord", "Profiler", "ProfilerSummary", "annotate", "trace_to",
+    "measure_call_latency",
+]
+
+
+@dataclasses.dataclass
+class CallRecord:
+    """One retired call."""
+
+    op: str                 # scenario name (allreduce, send, ...)
+    count: int              # elements
+    nbytes: int             # uncompressed payload bytes (count * elem size)
+    comm_id: int
+    t_start: float          # perf_counter seconds, host-side issue time
+    duration_s: float       # issue -> retire
+    error_word: int = 0
+
+    @property
+    def duration_us(self) -> float:
+        return self.duration_s * 1e6
+
+
+@dataclasses.dataclass
+class ProfilerSummary:
+    """Aggregate statistics for one op."""
+
+    op: str
+    n: int
+    total_us: float
+    mean_us: float
+    p50_us: float
+    p95_us: float
+    min_us: float
+    max_us: float
+    total_bytes: int
+
+    @property
+    def mean_gbps(self) -> float:
+        """Mean payload goodput in GB/s (bytes moved / time in call)."""
+        if self.total_us == 0:
+            return 0.0
+        return self.total_bytes / (self.total_us * 1e-6) / 1e9
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class Profiler:
+    """Thread-safe per-call timing recorder.
+
+    The driver owns one and feeds it from call-handle done callbacks while
+    enabled (``ACCL.start_profiling`` / ``end_profiling``). It can also be
+    used standalone via :meth:`record`.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records: list[CallRecord] = []
+        self.enabled = False
+
+    # -- control -----------------------------------------------------------
+    def start(self):
+        self.enabled = True
+
+    def stop(self):
+        self.enabled = False
+
+    def clear(self):
+        with self._lock:
+            self._records.clear()
+
+    # -- capture -----------------------------------------------------------
+    def record(self, rec: CallRecord):
+        with self._lock:
+            self._records.append(rec)
+
+    def attach(self, handle, op: str, count: int, nbytes: int, comm_id: int):
+        """Register a done callback on ``handle`` that records the call's
+        host-issue -> retire duration."""
+        t0 = time.perf_counter()
+
+        def _on_done(error_word: int):
+            self.record(CallRecord(
+                op=op, count=count, nbytes=nbytes, comm_id=comm_id,
+                t_start=t0, duration_s=time.perf_counter() - t0,
+                error_word=error_word))
+
+        handle.add_done_callback(_on_done)
+
+    # -- reporting ---------------------------------------------------------
+    @property
+    def records(self) -> list[CallRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def summary(self) -> dict[str, ProfilerSummary]:
+        by_op: dict[str, list[CallRecord]] = {}
+        for r in self.records:
+            by_op.setdefault(r.op, []).append(r)
+        out = {}
+        for op, recs in sorted(by_op.items()):
+            durs = sorted(r.duration_us for r in recs)
+            out[op] = ProfilerSummary(
+                op=op, n=len(recs), total_us=sum(durs),
+                mean_us=sum(durs) / len(durs),
+                p50_us=_percentile(durs, 0.50),
+                p95_us=_percentile(durs, 0.95),
+                min_us=durs[0], max_us=durs[-1],
+                total_bytes=sum(r.nbytes for r in recs))
+        return out
+
+    def table(self) -> str:
+        rows = [f"{'op':<16}{'n':>6}{'mean_us':>12}{'p50_us':>12}"
+                f"{'p95_us':>12}{'GB/s':>10}"]
+        for s in self.summary().values():
+            rows.append(f"{s.op:<16}{s.n:>6}{s.mean_us:>12.2f}"
+                        f"{s.p50_us:>12.2f}{s.p95_us:>12.2f}"
+                        f"{s.mean_gbps:>10.3f}")
+        return "\n".join(rows)
+
+    def to_csv(self, path: str):
+        """Raw record dump, one row per retired call — the shape the
+        reference benchmark writes (bench_*.csv, test/host/test.py:949)."""
+        with open(path, "w") as f:
+            f.write("op,count,nbytes,comm_id,t_start,duration_us,error\n")
+            for r in self.records:
+                f.write(f"{r.op},{r.count},{r.nbytes},{r.comm_id},"
+                        f"{r.t_start:.9f},{r.duration_us:.3f},"
+                        f"{r.error_word}\n")
+
+
+# -- JAX profiler bridges ---------------------------------------------------
+@contextlib.contextmanager
+def annotate(name: str):
+    """Name a region on the device timeline (xplane trace annotation)."""
+    try:
+        import jax
+        ctx = jax.profiler.TraceAnnotation(name)
+    except ImportError:  # pragma: no cover — jax is baked in
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
+
+
+@contextlib.contextmanager
+def trace_to(log_dir: str):
+    """Capture an xplane trace of the enclosed region into ``log_dir``."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def measure_call_latency(accl, n: int = 100) -> dict[str, float]:
+    """Round-trip latency of the full call path via ``nop``.
+
+    Parity: the reference warms up and times nop calls to isolate call
+    overhead from data movement (test/host/test.py:934-936).
+    """
+    for _ in range(min(n, 10)):  # warmup
+        accl.nop()
+    samples = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        accl.nop()
+        samples.append((time.perf_counter() - t0) * 1e6)
+    samples.sort()
+    return {
+        "n": float(n),
+        "p50_us": _percentile(samples, 0.50),
+        "p95_us": _percentile(samples, 0.95),
+        "mean_us": sum(samples) / len(samples),
+        "min_us": samples[0],
+    }

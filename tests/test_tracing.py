@@ -1,0 +1,117 @@
+"""Tests for the tracing/profiling subsystem (accl_tpu/tracing.py).
+
+Parity targets: nop call-latency probe (reference accl.py:738-745, warmup at
+test.py:934-936), start/end_profiling config calls (xlnx-consts.hpp:27-28),
+CSV record dumps in the benchmark harness's shape (test.py:949).
+"""
+
+import csv
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu import tracing
+from accl_tpu.testing import emu_world, run_ranks
+from accl_tpu.tracing import CallRecord, Profiler
+
+
+def test_profiler_records_and_summary():
+    p = Profiler()
+    p.start()
+    for i in range(10):
+        p.record(CallRecord(op="allreduce", count=256, nbytes=1024,
+                            comm_id=0, t_start=float(i),
+                            duration_s=1e-3 * (i + 1)))
+    p.record(CallRecord(op="send", count=1, nbytes=4, comm_id=0,
+                        t_start=0.0, duration_s=5e-4))
+    s = p.summary()
+    assert set(s) == {"allreduce", "send"}
+    ar = s["allreduce"]
+    assert ar.n == 10
+    assert ar.min_us == pytest.approx(1000.0)
+    assert ar.max_us == pytest.approx(10000.0)
+    assert ar.p50_us == pytest.approx(6000.0, rel=0.2)
+    assert ar.total_bytes == 10240
+    assert ar.mean_gbps > 0
+    assert "allreduce" in p.table()
+
+
+def test_profiler_csv(tmp_path):
+    p = Profiler()
+    p.record(CallRecord(op="bcast", count=8, nbytes=32, comm_id=3,
+                        t_start=1.25, duration_s=2e-6, error_word=0))
+    path = tmp_path / "prof.csv"
+    p.to_csv(str(path))
+    rows = list(csv.DictReader(open(path)))
+    assert len(rows) == 1
+    assert rows[0]["op"] == "bcast"
+    assert int(rows[0]["nbytes"]) == 32
+    assert float(rows[0]["duration_us"]) == pytest.approx(2.0)
+
+
+def test_driver_profiling_end_to_end():
+    """start_profiling arms capture through the real call path; records
+    carry op names, element counts and payload bytes; end_profiling
+    disarms."""
+    accls = run_ranks(emu_world(2), _profiled_allreduce)
+    for recs in accls:
+        ops = [r.op for r in recs]
+        assert ops.count("allreduce") == 3
+        assert all(r.nbytes == 64 * 4 for r in recs if r.op == "allreduce")
+        assert all(r.error_word == 0 for r in recs)
+        assert all(r.duration_s >= 0 for r in recs)
+
+
+def _profiled_allreduce(a):
+    src = a.buffer(data=np.arange(64, dtype=np.float32))
+    dst = a.buffer((64,), np.float32)
+    a.allreduce(src, dst, 64)          # before arming: not recorded
+    a.start_profiling()
+    for _ in range(3):
+        a.allreduce(src, dst, 64)
+    a.end_profiling()
+    a.allreduce(src, dst, 64)          # after disarm: not recorded
+    return a.profiler.records
+
+
+def test_async_chain_attribution():
+    """Async chained calls are recorded at retire time with their true
+    durations (done-callback path), not at dispatch."""
+    def body(a):
+        src = a.buffer(data=np.ones(32, np.float32))
+        dst = a.buffer((32,), np.float32)
+        a.start_profiling()
+        h1 = a.allreduce(src, dst, 32, run_async=True)
+        h2 = a.allreduce(dst, src, 32, run_async=True, waitfor=[h1])
+        h2.wait()
+        a.end_profiling()
+        # both retired -> both recorded even though issued async
+        assert len(a.profiler.records) == 2
+        return True
+
+    assert all(run_ranks(emu_world(2), body))
+
+
+def test_nop_latency_probe():
+    accls = emu_world(1)
+    stats = tracing.measure_call_latency(accls[0], n=20)
+    assert stats["p50_us"] > 0
+    assert stats["min_us"] <= stats["p50_us"] <= stats["p95_us"]
+
+
+def test_annotate_and_trace_smoke(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    with tracing.annotate("unit-test-region"):
+        x = jnp.ones((8,)) + 1
+    assert float(x[0]) == 2.0
+
+    # capture a tiny xplane trace (the waveform-dump analog)
+    try:
+        with tracing.trace_to(str(tmp_path / "trace")):
+            jnp.ones((8,)).block_until_ready()
+    except Exception:
+        pytest.skip("jax profiler backend unavailable in this build")
+    assert any((tmp_path / "trace").rglob("*"))
