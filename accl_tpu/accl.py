@@ -174,14 +174,17 @@ class ACCL:
 
     def _call(self, desc: CallDescriptor, run_async: bool,
               waitfor: Sequence[CallHandle]) -> CallHandle:
+        import time as _time
+        profiling = self.profiler.enabled and desc.scenario != CCLOp.config
+        t0 = _time.perf_counter() if profiling else 0.0
         handle = self.device.call_async(desc, waitfor)
-        if self.profiler.enabled and desc.scenario != CCLOp.config:
+        if profiling:
             ebytes = (desc.arithcfg.uncompressed_elem_bytes
                       if desc.arithcfg is not None else 0)
             self.profiler.attach(handle, op=desc.scenario.name,
                                  count=desc.count,
                                  nbytes=desc.count * ebytes,
-                                 comm_id=desc.comm_id)
+                                 comm_id=desc.comm_id, t0=t0)
         if run_async:
             return handle
         handle.wait()

@@ -65,7 +65,8 @@ class CallHandle:
         self._error_word = int(error_word)
         self._result = result
         self._exception = exception
-        self._done.set()
+        # run callbacks BEFORE waking waiters: a host thread returning from
+        # wait() must observe every observer effect (e.g. profiler records)
         with self._cb_lock:
             callbacks, self._callbacks = self._callbacks, []
         for cb in callbacks:
@@ -73,6 +74,7 @@ class CallHandle:
                 cb(self._error_word)
             except Exception:  # noqa: BLE001 — a raising observer must not
                 pass           # re-enter the backend worker / double-complete
+        self._done.set()
 
     def add_done_callback(self, fn):
         """Invoke ``fn(error_word)`` when the call retires (immediately if

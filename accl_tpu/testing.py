@@ -46,12 +46,35 @@ def run_ranks(accls: Sequence[ACCL], fn: Callable[[ACCL], object],
 
 
 def free_port_base(span: int = 64) -> int:
-    """Pick a base for a contiguous block of ports (cmd + eth ranges)."""
+    """Pick a base for a contiguous block of ``span`` ports (cmd + eth
+    ranges), verifying every port in the block is currently bindable —
+    repeated worlds in one session would otherwise trip over lingering
+    listeners or ephemeral client ports from the previous world."""
     import socket
-    probe = socket.create_server(("127.0.0.1", 0))
-    base = probe.getsockname()[1] + span
-    probe.close()
-    return base
+    for _ in range(50):
+        probe = socket.create_server(("127.0.0.1", 0))
+        base = probe.getsockname()[1] + span
+        probe.close()
+        if base + span >= 65536:
+            continue
+        held = []
+        try:
+            for p in range(base, base + span):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                # wildcard bind: the daemons bind 0.0.0.0, so the probe
+                # must too — a loopback-only probe misses ports held on
+                # specific non-loopback interfaces
+                s.bind(("", p))
+                held.append(s)
+        except OSError:
+            continue
+        finally:
+            for s in held:
+                s.close()
+        if len(held) == span:
+            return base
+    raise OSError(f"no free block of {span} ports found")
 
 
 def connect_world(port_base: int, world_size: int,

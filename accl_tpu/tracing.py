@@ -114,10 +114,14 @@ class Profiler:
         with self._lock:
             self._records.append(rec)
 
-    def attach(self, handle, op: str, count: int, nbytes: int, comm_id: int):
+    def attach(self, handle, op: str, count: int, nbytes: int, comm_id: int,
+               t0: float | None = None):
         """Register a done callback on ``handle`` that records the call's
-        host-issue -> retire duration."""
-        t0 = time.perf_counter()
+        host-issue -> retire duration. Pass ``t0`` captured before dispatch
+        so the record covers the full issue->retire window even when the
+        backend retires the call before the callback is registered."""
+        if t0 is None:
+            t0 = time.perf_counter()
 
         def _on_done(error_word: int):
             self.record(CallRecord(
