@@ -25,8 +25,9 @@ from .arith import DEFAULT_ARITH_CONFIGS, resolve_arith_config
 from .buffer import ACCLBuffer
 from .call import CallDescriptor, CallHandle, CompletedHandle
 from .communicator import Communicator
-from .constants import (CCLOp, CfgFunc, Compression, DEFAULT_MAX_SEGMENT_SIZE,
-                        ReduceFunc, StreamFlags, TAG_ANY)
+from .constants import (CCLOp, CfgFunc, CollectiveAlgorithm, Compression,
+                        DEFAULT_MAX_SEGMENT_SIZE, ReduceFunc, StreamFlags,
+                        TAG_ANY)
 from .device.base import Device
 from .tracing import Profiler
 
@@ -140,7 +141,9 @@ class ACCL:
                  op0: ACCLBuffer | None = None, op1: ACCLBuffer | None = None,
                  res: ACCLBuffer | None = None,
                  compress_dtype: np.dtype | str | None = None,
-                 stream_flags: StreamFlags = StreamFlags.NO_STREAM
+                 stream_flags: StreamFlags = StreamFlags.NO_STREAM,
+                 algorithm: CollectiveAlgorithm | str = (
+                     CollectiveAlgorithm.AUTO)
                  ) -> CallDescriptor:
         """Resolve dtypes to an arith config + compression flags.
 
@@ -164,10 +167,13 @@ class ACCL:
                 compression |= Compression.OP1_COMPRESSED
             if res is not None and res.dtype == cfg.compressed_dtype:
                 compression |= Compression.RES_COMPRESSED
+        if isinstance(algorithm, str):
+            algorithm = CollectiveAlgorithm[algorithm.upper()]
         return CallDescriptor(
             scenario=scenario, count=count, comm_id=comm.comm_id,
             root_src_dst=root_src_dst, function=func, tag=tag,
             arithcfg=cfg, compression=compression, stream_flags=stream_flags,
+            algorithm=CollectiveAlgorithm(algorithm),
             addr_0=op0.address if op0 is not None else 0,
             addr_1=op1.address if op1 is not None else 0,
             addr_2=res.address if res is not None else 0)
@@ -246,14 +252,17 @@ class ACCL:
 
     # -- collectives -------------------------------------------------------
     def bcast(self, buf: ACCLBuffer, count: int | None = None, root: int = 0,
-              *, comm: Communicator | None = None, compress_dtype=None,
+              *, comm: Communicator | None = None,
+                 algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
+                 compress_dtype=None,
               run_async: bool = False,
               waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
         count = count if count is not None else buf.size
         desc = self._prepare(CCLOp.bcast, count=count, comm=comm,
                              root_src_dst=root, op0=buf,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype,
+                             algorithm=algorithm)
         return self._call(desc, run_async, waitfor)
 
     def scatter(self, srcbuf: ACCLBuffer | None, dstbuf: ACCLBuffer,
@@ -271,7 +280,9 @@ class ACCL:
 
     def gather(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer | None,
                count: int, root: int = 0, *,
-               comm: Communicator | None = None, compress_dtype=None,
+               comm: Communicator | None = None,
+                 algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
+                 compress_dtype=None,
                run_async: bool = False,
                waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """count = per-rank chunk; dstbuf holds world_size*count at root.
@@ -285,12 +296,15 @@ class ACCL:
             dstbuf = self._scratch(count, srcbuf.dtype)
         desc = self._prepare(CCLOp.gather, count=count, comm=comm,
                              root_src_dst=root, op0=srcbuf, res=dstbuf,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype,
+                             algorithm=algorithm)
         return self._call(desc, run_async, waitfor)
 
     def reduce(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer | None, count: int,
                root: int = 0, func: ReduceFunc = ReduceFunc.SUM, *,
-               comm: Communicator | None = None, compress_dtype=None,
+               comm: Communicator | None = None,
+                 algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
+                 compress_dtype=None,
                run_async: bool = False,
                waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
@@ -298,28 +312,35 @@ class ACCL:
             raise ValueError("reduce root requires a destination buffer")
         desc = self._prepare(CCLOp.reduce, count=count, comm=comm,
                              root_src_dst=root, func=func, op0=srcbuf,
-                             res=dstbuf, compress_dtype=compress_dtype)
+                             res=dstbuf, compress_dtype=compress_dtype,
+                             algorithm=algorithm)
         return self._call(desc, run_async, waitfor)
 
     def allgather(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,
-                  comm: Communicator | None = None, compress_dtype=None,
+                  comm: Communicator | None = None,
+                 algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
+                 compress_dtype=None,
                   run_async: bool = False,
                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
         desc = self._prepare(CCLOp.allgather, count=count, comm=comm,
                              op0=srcbuf, res=dstbuf,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype,
+                             algorithm=algorithm)
         return self._call(desc, run_async, waitfor)
 
     def allreduce(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int,
                   func: ReduceFunc = ReduceFunc.SUM, *,
-                  comm: Communicator | None = None, compress_dtype=None,
+                  comm: Communicator | None = None,
+                 algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
+                 compress_dtype=None,
                   run_async: bool = False,
                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
         desc = self._prepare(CCLOp.allreduce, count=count, comm=comm,
                              func=func, op0=srcbuf, res=dstbuf,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype,
+                             algorithm=algorithm)
         return self._call(desc, run_async, waitfor)
 
     def reduce_scatter(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer,

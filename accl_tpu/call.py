@@ -20,7 +20,8 @@ import dataclasses
 import threading
 from typing import Any, Sequence
 
-from .constants import ACCLError, CCLOp, Compression, ErrorCode, ReduceFunc, StreamFlags
+from .constants import (ACCLError, CCLOp, CollectiveAlgorithm, Compression,
+                        ErrorCode, ReduceFunc, StreamFlags)
 
 
 @dataclasses.dataclass
@@ -37,6 +38,7 @@ class CallDescriptor:
     arithcfg: Any = None                      # resolved ArithConfig
     compression: Compression = Compression.NONE
     stream_flags: StreamFlags = StreamFlags.NO_STREAM
+    algorithm: CollectiveAlgorithm = CollectiveAlgorithm.AUTO
     addr_0: Any = None                        # op0 buffer / array
     addr_1: Any = None                        # op1 buffer / array
     addr_2: Any = None                        # result buffer / array

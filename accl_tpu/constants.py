@@ -107,6 +107,58 @@ class StreamFlags(enum.IntFlag):
     RES_STREAM = 2
 
 
+class CollectiveAlgorithm(enum.IntEnum):
+    """Per-call collective algorithm selector.
+
+    Parity: the reference's older XRT driver enumerates sw/hw, ring and
+    round-robin variants per collective as distinct opcodes —
+    ``bcast_rr``, ``gather_ring``, ``reduce_ring``, ``allreduce_fused_ring``
+    ... (driver/xrt/include/xlnx-consts.hpp:43-66). We express the same
+    design axis as an explicit selector on the call descriptor. AUTO picks
+    each backend's default (the current firmware algorithms on the
+    emulator tier; XLA's choice on the TPU tier).
+    """
+
+    AUTO = 0
+    RING = 1          # ring / daisy-chain (reference *_ring)
+    ROUND_ROBIN = 2   # direct root-centric sends (reference *_rr)
+    TREE = 3          # binomial tree (2D-mesh trees live in parallel/tree.py)
+    FUSED_RING = 4    # allreduce: fused ring reduce-scatter + allgather
+    NON_FUSED = 5     # allreduce: reduce to root 0 then bcast
+
+
+# Which algorithms each collective accepts (AUTO is always legal). Every
+# tier — move engine, python/native daemons, TPU backend — validates against
+# this one table so a program behaves identically when moved across tiers.
+VALID_ALGORITHMS: dict[str, frozenset] = {
+    "bcast": frozenset({CollectiveAlgorithm.ROUND_ROBIN,
+                        CollectiveAlgorithm.TREE}),
+    "scatter": frozenset({CollectiveAlgorithm.ROUND_ROBIN}),
+    "gather": frozenset({CollectiveAlgorithm.RING,
+                         CollectiveAlgorithm.ROUND_ROBIN}),
+    "reduce": frozenset({CollectiveAlgorithm.RING,
+                         CollectiveAlgorithm.ROUND_ROBIN}),
+    "allgather": frozenset({CollectiveAlgorithm.RING,
+                            CollectiveAlgorithm.ROUND_ROBIN}),
+    "allreduce": frozenset({CollectiveAlgorithm.RING,
+                            CollectiveAlgorithm.FUSED_RING,
+                            CollectiveAlgorithm.NON_FUSED}),
+    "reduce_scatter": frozenset({CollectiveAlgorithm.RING}),
+}
+
+
+def check_algorithm(scenario_name: str, algorithm) -> None:
+    """Raise ValueError unless (scenario, algorithm) is a legal pair."""
+    if algorithm == CollectiveAlgorithm.AUTO:
+        return
+    valid = VALID_ALGORITHMS.get(scenario_name, frozenset())
+    if algorithm not in valid:
+        raise ValueError(
+            f"{scenario_name} does not support algorithm "
+            f"{CollectiveAlgorithm(algorithm).name}; valid: "
+            f"{sorted(a.name for a in valid)}")
+
+
 class ErrorCode(enum.IntFlag):
     """Errors raised by execution engines; OR-able like the reference's.
 

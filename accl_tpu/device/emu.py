@@ -184,5 +184,6 @@ class EmuDevice(Device):
             root_src_dst=desc.root_src_dst, func=desc.function,
             tag=desc.tag,
             addr_0=desc.addr_0, addr_1=desc.addr_1, addr_2=desc.addr_2,
-            compression=desc.compression, stream=desc.stream_flags)
+            compression=desc.compression, stream=desc.stream_flags,
+            algorithm=desc.algorithm)
         return self.executor.execute(moves, desc.arithcfg, comm)

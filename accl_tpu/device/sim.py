@@ -180,7 +180,8 @@ class SimDevice(Device):
                            desc.root_src_dst,
                            desc.tag & 0xFFFFFFFF,
                            desc.addr_0 or 0, desc.addr_1 or 0,
-                           desc.addr_2 or 0, [])
+                           desc.addr_2 or 0, [],
+                           algorithm=int(desc.algorithm))
         reply = self._request(body)
         assert reply[0] == P.MSG_CALL_ID
         return struct.unpack("<I", reply[1:5])[0]

@@ -37,8 +37,9 @@ import jax.numpy as jnp
 from ..buffer import ACCLBuffer
 from ..call import CallDescriptor, CallHandle
 from ..communicator import Communicator
-from ..constants import (CCLOp, Compression, DEFAULT_MAX_SEGMENT_SIZE,
-                         DEFAULT_TIMEOUT_S, ErrorCode)
+from ..constants import (CCLOp, CollectiveAlgorithm, Compression,
+                         DEFAULT_MAX_SEGMENT_SIZE, DEFAULT_TIMEOUT_S,
+                         ErrorCode, check_algorithm)
 from ..emulator.executor import DeviceMemory
 from ..parallel.collectives import MeshCollectives
 from ..parallel.mesh import make_mesh
@@ -337,6 +338,20 @@ class TpuDevice(Device):
             return rows
 
         coll, alg = ctx.coll_for(comm), ctx.algorithm
+        # per-call selector (CollectiveAlgorithm) overrides the context
+        # default: ring variants lower to the shard_map ppermute rings,
+        # everything else to XLA's native collectives. Validation uses the
+        # same table as the emulator tiers so invalid (op, algorithm) pairs
+        # fail identically everywhere.
+        try:
+            check_algorithm(op.name, d0.algorithm)
+        except ValueError:
+            return int(ErrorCode.INVALID_CALL)
+        if d0.algorithm in (CollectiveAlgorithm.RING,
+                            CollectiveAlgorithm.FUSED_RING):
+            alg = "ring"
+        elif d0.algorithm != CollectiveAlgorithm.AUTO:
+            alg = "xla"
         root = d0.root_src_dst
         if op == CCLOp.barrier:
             return 0  # rendezvous above IS the barrier

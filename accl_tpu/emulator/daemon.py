@@ -23,7 +23,8 @@ import numpy as np
 
 from ..arith import ArithConfig
 from ..communicator import Communicator, Rank
-from ..constants import CCLOp, Compression, ErrorCode, ReduceFunc, StreamFlags
+from ..constants import (CCLOp, CollectiveAlgorithm, Compression, ErrorCode,
+                         ReduceFunc, StreamFlags)
 from ..moveengine import MoveContext, expand_call
 from . import protocol as P
 from .executor import DeviceMemory, MoveExecutor, RxBufferPool
@@ -175,7 +176,7 @@ class RankDaemon:
                 f32 = P.DTYPE_CODES["float32"]
                 c = dict(c, scenario=int(CCLOp.allreduce), count=1,
                          func=int(ReduceFunc.SUM), compression=0, stream=0,
-                         udtype=f32, cdtype=f32,
+                         algorithm=0, udtype=f32, cdtype=f32,
                          addr0=self._barrier_addr,
                          addr2=self._barrier_addr + 4)
                 scenario = CCLOp.allreduce
@@ -189,7 +190,8 @@ class RankDaemon:
                 func=ReduceFunc(c["func"]), tag=c["tag"],
                 addr_0=c["addr0"], addr_1=c["addr1"], addr_2=c["addr2"],
                 compression=Compression(c["compression"]),
-                stream=StreamFlags(c["stream"]))
+                stream=StreamFlags(c["stream"]),
+                algorithm=CollectiveAlgorithm(c.get("algorithm", 0)))
             return self.executor.execute(moves, cfg, comm)
         except Exception:  # noqa: BLE001
             import traceback

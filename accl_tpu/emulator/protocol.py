@@ -87,29 +87,31 @@ def recv_frame(sock: socket.socket) -> bytes:
 
 # -- call descriptor --------------------------------------------------------
 # scenario u8, func u8, compression u8, stream u8, udtype u8, cdtype u8,
-# count u64, comm_id u32, root u32, tag u32, addr0 u64, addr1 u64, addr2 u64,
-# n_waitfor u16 + waitfor ids (u32 each)
-_CALL_FMT = "<6BQ3I3QH"
+# algorithm u8, pad u8, count u64, comm_id u32, root u32, tag u32,
+# addr0 u64, addr1 u64, addr2 u64, n_waitfor u16 + waitfor ids (u32 each)
+_CALL_FMT = "<8BQ3I3QH"
 
 
 def pack_call(scenario: int, func: int, compression: int, stream: int,
               udtype: int, cdtype: int, count: int, comm_id: int, root: int,
               tag: int, addr0: int, addr1: int, addr2: int,
-              waitfor: list[int]) -> bytes:
+              waitfor: list[int], algorithm: int = 0) -> bytes:
     body = struct.pack(_CALL_FMT, scenario, func, compression, stream,
-                       udtype, cdtype, count, comm_id, root, tag,
-                       addr0, addr1, addr2, len(waitfor))
+                       udtype, cdtype, algorithm, 0, count, comm_id, root,
+                       tag, addr0, addr1, addr2, len(waitfor))
     return bytes([MSG_CALL]) + body + b"".join(
         struct.pack("<I", w) for w in waitfor)
 
 
 def unpack_call(body: bytes) -> dict:
     size = struct.calcsize(_CALL_FMT)
-    (scenario, func, compression, stream, udtype, cdtype, count, comm_id,
-     root, tag, a0, a1, a2, nw) = struct.unpack(_CALL_FMT, body[:size])
+    (scenario, func, compression, stream, udtype, cdtype, algorithm, _pad,
+     count, comm_id, root, tag, a0, a1, a2, nw) = struct.unpack(
+        _CALL_FMT, body[:size])
     waitfor = list(struct.unpack(f"<{nw}I", body[size:size + 4 * nw]))
     return dict(scenario=scenario, func=func, compression=compression,
-                stream=stream, udtype=udtype, cdtype=cdtype, count=count,
+                stream=stream, udtype=udtype, cdtype=cdtype,
+                algorithm=algorithm, count=count,
                 comm_id=comm_id, root=root, tag=tag, addr0=a0, addr1=a1,
                 addr2=a2, waitfor=waitfor)
 

@@ -30,7 +30,18 @@ import enum
 from typing import Iterator
 
 from .arith import ArithConfig
-from .constants import CCLOp, Compression, ReduceFunc, StreamFlags, TAG_ANY
+from .constants import (CCLOp, CollectiveAlgorithm, Compression, ReduceFunc,
+                        StreamFlags, TAG_ANY, check_algorithm)
+
+
+def res_as_op0(compression: Compression) -> Compression:
+    """Remap the RES compressed-ness onto OP0: used when a follow-on stage
+    reads the previous stage's result buffer as its operand (e.g. the
+    bcast after a non-fused reduce, or the root folding into dst)."""
+    out = compression & ~Compression.OP0_COMPRESSED
+    if compression & Compression.RES_COMPRESSED:
+        out |= Compression.OP0_COMPRESSED
+    return out
 
 
 class MoveMode(enum.Enum):
@@ -294,6 +305,37 @@ def expand_broadcast(ctx: MoveContext, count: int, root: int, buf: int,
     return moves
 
 
+def expand_broadcast_tree(ctx: MoveContext, count: int, root: int, buf: int,
+                          compression: Compression = Compression.NONE
+                          ) -> list[Move]:
+    """broadcast, binomial tree: log2(W) rounds instead of the firmware's
+    W-1 sequential sends (a TPU-native latency-optimal variant; the
+    reference reserves the algorithm axis in xlnx-consts.hpp:43-66, and its
+    2D-mesh analog is parallel/tree.py). Each rank receives once from its
+    tree parent, then forwards to progressively nearer sub-roots."""
+    W, me = ctx.world_size, ctx.local_rank
+    if W == 1:
+        return []
+    vrank = (me - root) % W
+    moves: list[Move] = []
+    mask = 1
+    while mask < W:
+        if vrank & mask:
+            parent = ((vrank ^ mask) + root) % W
+            moves += expand_recv(ctx, count, parent, buf, tag=TAG_ANY,
+                                 compression=compression)
+            break
+        mask <<= 1
+    mask >>= 1
+    while mask:
+        if vrank + mask < W:
+            child = ((vrank + mask) + root) % W
+            moves += expand_send(ctx, count, buf, child, tag=TAG_ANY,
+                                 compression=compression)
+        mask >>= 1
+    return moves
+
+
 def expand_scatter(ctx: MoveContext, count: int, root: int, src: int,
                    dst: int,
                    compression: Compression = Compression.NONE) -> list[Move]:
@@ -358,6 +400,31 @@ def expand_gather_ring(ctx: MoveContext, count: int, root: int, src: int,
     return moves
 
 
+def expand_gather_direct(ctx: MoveContext, count: int, root: int, src: int,
+                         dst: int,
+                         compression: Compression = Compression.NONE
+                         ) -> list[Move]:
+    """gather, round-robin/direct (reference ``gather_rr``,
+    xlnx-consts.hpp): every non-root sends its chunk straight to root;
+    root receives W-1 strided chunks (pool matching absorbs arrival
+    order) plus a local copy of its own."""
+    W, me = ctx.world_size, ctx.local_rank
+    ebytes = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    moves: list[Move] = []
+    if me == root:
+        moves += expand_copy(ctx, count, src, dst + me * count * ebytes,
+                             compression)
+        for r in range(W):
+            if r == root:
+                continue
+            moves += expand_recv(ctx, count, r, dst + r * count * ebytes,
+                                 tag=TAG_ANY, compression=compression)
+    else:
+        moves += expand_send(ctx, count, src, root, tag=TAG_ANY,
+                             compression=compression)
+    return moves
+
+
 def expand_allgather_ring(ctx: MoveContext, count: int, src: int, dst: int,
                           compression: Compression = Compression.NONE
                           ) -> list[Move]:
@@ -384,6 +451,58 @@ def expand_allgather_ring(ctx: MoveContext, count: int, src: int, dst: int,
         if i < W - 2:
             moves += expand_send(ctx, count, slot, nxt, tag=TAG_ANY,
                                  compression=compression)
+    return moves
+
+
+def expand_allgather_direct(ctx: MoveContext, count: int, src: int, dst: int,
+                            compression: Compression = Compression.NONE
+                            ) -> list[Move]:
+    """allgather, direct fan-out (round-robin): every rank eagerly sends
+    its chunk to all peers, then receives W-1 chunks into their slots.
+    One hop of latency vs the ring's W-1, at W× the injection rate."""
+    W, me = ctx.world_size, ctx.local_rank
+    ebytes = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    moves: list[Move] = []
+    moves += expand_copy(ctx, count, src, dst + me * count * ebytes,
+                         compression)
+    for step in range(1, W):  # rotated schedule avoids hot receivers
+        to = (me + step) % W
+        sends = expand_send(ctx, count, src, to, tag=TAG_ANY,
+                            compression=compression)
+        for m in sends:
+            m.blocking = False
+        moves += sends
+    for step in range(1, W):
+        frm = (me - step) % W
+        moves += expand_recv(ctx, count, frm, dst + frm * count * ebytes,
+                             tag=TAG_ANY, compression=compression)
+    return moves
+
+
+def expand_reduce_direct(ctx: MoveContext, count: int, root: int,
+                         func: ReduceFunc, src: int, dst: int,
+                         compression: Compression = Compression.NONE
+                         ) -> list[Move]:
+    """reduce, round-robin/direct (reference ``reduce_rr``): non-roots send
+    straight to root; root folds arrivals into dst one sender at a time
+    (first fold reads the root's own src as op0, later folds read dst)."""
+    W, me = ctx.world_size, ctx.local_rank
+    if W == 1:
+        return expand_copy(ctx, count, src, dst, compression)
+    moves: list[Move] = []
+    if me != root:
+        return expand_send(ctx, count, src, root, tag=TAG_ANY,
+                           compression=compression)
+    first = True
+    for r in range(W):
+        if r == root:
+            continue
+        # later folds read dst as op0, whose compressed-ness is the RES flag
+        op0 = src if first else dst
+        comp = compression if first else res_as_op0(compression)
+        moves += expand_fused_recv_reduce(ctx, count, func, r, op0, dst,
+                                          tag=TAG_ANY, compression=comp)
+        first = False
     return moves
 
 
@@ -511,6 +630,21 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
     return moves
 
 
+def expand_allreduce_nonfused(ctx: MoveContext, count: int, func: ReduceFunc,
+                              src: int, dst: int,
+                              compression: Compression = Compression.NONE
+                              ) -> list[Move]:
+    """allreduce, non-fused (the reference's sw-orchestrated variant axis,
+    xlnx-consts.hpp:43-66): ring reduce to rank 0, then broadcast of dst.
+    2(W-1) serial hops vs the fused ring's bandwidth-optimal schedule —
+    kept as a selectable algorithm for small messages and for diffing."""
+    moves = expand_reduce_ring(ctx, count, 0, func, src, dst, compression)
+    # the bcast reads/writes dst, whose compressed-ness is RES_COMPRESSED;
+    # bcast addresses its buffer via the OP0 flag
+    moves += expand_broadcast(ctx, count, 0, dst, res_as_op0(compression))
+    return moves
+
+
 def expand_alltoall(ctx: MoveContext, count: int, src: int, dst: int,
                     compression: Compression = Compression.NONE) -> list[Move]:
     """alltoall (capability extension; the reference reserves the op in its
@@ -544,12 +678,26 @@ def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
                 tag: int = TAG_ANY, addr_0: int = 0, addr_1: int = 0,
                 addr_2: int = 0,
                 compression: Compression = Compression.NONE,
-                stream: StreamFlags = StreamFlags.NO_STREAM) -> list[Move]:
+                stream: StreamFlags = StreamFlags.NO_STREAM,
+                algorithm: CollectiveAlgorithm = CollectiveAlgorithm.AUTO
+                ) -> list[Move]:
     """Dispatch a call descriptor to its expansion.
 
-    Parity: the firmware's run_accl() switch (ccl_offload_control.c:1155-1296).
+    Parity: the firmware's run_accl() switch (ccl_offload_control.c:1155-1296)
+    plus the XRT driver's per-collective algorithm variants
+    (xlnx-consts.hpp:43-66) expressed via ``algorithm``.
     addr_0 = op0/src buffer, addr_1 = op1 buffer, addr_2 = result buffer.
     """
+    A = CollectiveAlgorithm
+    alg = A(algorithm)
+    # one validation table for every tier (constants.VALID_ALGORITHMS):
+    # ops without an algorithm axis reject any explicit selector
+    check_algorithm(scenario.name, alg)
+
+    def pick(op_algs: dict, default):
+        """Resolve AUTO to the default algorithm."""
+        return default if alg == A.AUTO else op_algs[alg]
+
     if scenario == CCLOp.nop:
         return []
     if scenario == CCLOp.copy:
@@ -567,24 +715,35 @@ def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
         return expand_recv(ctx, count, root_src_dst, addr_2, tag, compression,
                            stream)
     if scenario == CCLOp.bcast:
-        return expand_broadcast(ctx, count, root_src_dst, addr_0, compression)
+        fn = pick({A.ROUND_ROBIN: expand_broadcast,
+                   A.TREE: expand_broadcast_tree}, expand_broadcast)
+        return fn(ctx, count, root_src_dst, addr_0, compression)
     if scenario == CCLOp.scatter:
-        return expand_scatter(ctx, count, root_src_dst, addr_0, addr_2,
-                              compression)
+        fn = pick({A.ROUND_ROBIN: expand_scatter}, expand_scatter)
+        return fn(ctx, count, root_src_dst, addr_0, addr_2, compression)
     if scenario == CCLOp.gather:
-        return expand_gather_ring(ctx, count, root_src_dst, addr_0, addr_2,
-                                  compression)
+        fn = pick({A.RING: expand_gather_ring,
+                   A.ROUND_ROBIN: expand_gather_direct}, expand_gather_ring)
+        return fn(ctx, count, root_src_dst, addr_0, addr_2, compression)
     if scenario == CCLOp.reduce:
-        return expand_reduce_ring(ctx, count, root_src_dst, func, addr_0,
-                                  addr_2, compression)
+        fn = pick({A.RING: expand_reduce_ring,
+                   A.ROUND_ROBIN: expand_reduce_direct}, expand_reduce_ring)
+        return fn(ctx, count, root_src_dst, func, addr_0, addr_2, compression)
     if scenario == CCLOp.allgather:
-        return expand_allgather_ring(ctx, count, addr_0, addr_2, compression)
+        fn = pick({A.RING: expand_allgather_ring,
+                   A.ROUND_ROBIN: expand_allgather_direct},
+                  expand_allgather_ring)
+        return fn(ctx, count, addr_0, addr_2, compression)
     if scenario == CCLOp.allreduce:
-        return expand_allreduce_ring(ctx, count, func, addr_0, addr_2,
-                                     compression)
+        fn = pick({A.RING: expand_allreduce_ring,
+                   A.FUSED_RING: expand_allreduce_ring,
+                   A.NON_FUSED: expand_allreduce_nonfused},
+                  expand_allreduce_ring)
+        return fn(ctx, count, func, addr_0, addr_2, compression)
     if scenario == CCLOp.reduce_scatter:
-        return expand_reduce_scatter_ring(ctx, count, func, addr_0, addr_2,
-                                          compression)
+        fn = pick({A.RING: expand_reduce_scatter_ring},
+                  expand_reduce_scatter_ring)
+        return fn(ctx, count, func, addr_0, addr_2, compression)
     if scenario == CCLOp.alltoall:
         return expand_alltoall(ctx, count, addr_0, addr_2, compression)
     raise NotImplementedError(f"scenario {scenario!r}")

@@ -214,7 +214,7 @@ class ACCL {
                       uint8_t func, uint32_t tag, uint64_t addr0,
                       uint64_t addr1, uint64_t addr2, uint8_t udtype,
                       uint8_t cdtype, uint8_t compression = C_NONE,
-                      uint8_t stream = 0) {
+                      uint8_t stream = 0, uint8_t algorithm = ALG_AUTO) {
     std::vector<uint8_t> body{MSG_CALL};
     put_le<uint8_t>(body, scenario);
     put_le<uint8_t>(body, func);
@@ -222,6 +222,8 @@ class ACCL {
     put_le<uint8_t>(body, stream);
     put_le<uint8_t>(body, udtype);
     put_le<uint8_t>(body, cdtype);
+    put_le<uint8_t>(body, algorithm);
+    put_le<uint8_t>(body, 0);  // pad
     put_le<uint64_t>(body, count);
     put_le<uint32_t>(body, comm_.comm_id);
     put_le<uint32_t>(body, root);

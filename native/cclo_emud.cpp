@@ -507,12 +507,30 @@ static const uint64_t BARRIER_SCRATCH_ADDR = 1ull << 60;
 
 // expand one call into a move program; mirrors the ring algorithms
 // (decreasing-rank data flow: rank r forwards to r-1, receives from r+1)
+// and the per-call algorithm variants of moveengine.expand_call
 static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
                        int func, uint64_t count, uint32_t root, uint32_t tag,
-                       uint64_t a0, uint64_t a1, uint64_t a2) {
+                       uint64_t a0, uint64_t a1, uint64_t a2,
+                       uint8_t alg = ALG_AUTO) {
   const uint32_t W = c.world, me = c.me;
   size_t eb = c.ebytes(c.compression & C_OP0);
   size_t ebr = c.ebytes(c.compression & C_RES);
+  // validate the (op, algorithm) pair; AUTO resolves to the default below
+  if (alg != ALG_AUTO) {
+    bool ok;
+    switch (op) {
+      case OP_BCAST: ok = alg == ALG_ROUND_ROBIN || alg == ALG_TREE; break;
+      case OP_SCATTER: ok = alg == ALG_ROUND_ROBIN; break;
+      case OP_GATHER: case OP_REDUCE: case OP_ALLGATHER:
+        ok = alg == ALG_RING || alg == ALG_ROUND_ROBIN; break;
+      case OP_ALLREDUCE:
+        ok = alg == ALG_RING || alg == ALG_FUSED_RING ||
+             alg == ALG_NON_FUSED; break;
+      case OP_REDUCE_SCATTER: ok = alg == ALG_RING; break;
+      default: ok = false;
+    }
+    if (!ok) return E_INVALID;
+  }
   switch (op) {
     case OP_NOP: case OP_CONFIG:
       return E_OK;
@@ -550,6 +568,24 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
       push_recv(mv, c, count, root, a2, tag);
       return E_OK;
     case OP_BCAST:
+      if (alg == ALG_TREE) {
+        // binomial tree: recv once from the parent, forward to sub-roots
+        if (W == 1) return E_OK;
+        uint32_t vrank = (me + W - root) % W;
+        uint32_t mask = 1;
+        while (mask < W) {
+          if (vrank & mask) {
+            uint32_t parent = ((vrank ^ mask) + root) % W;
+            push_recv(mv, c, count, parent, a0, TAG_ANY);
+            break;
+          }
+          mask <<= 1;
+        }
+        for (mask >>= 1; mask; mask >>= 1)
+          if (vrank + mask < W)
+            push_send(mv, c, count, a0, ((vrank + mask) + root) % W, TAG_ANY);
+        return E_OK;
+      }
       if (me == root) {
         for (uint32_t r = 0; r < W; ++r)
           if (r != root) push_send(mv, c, count, a0, r, TAG_ANY);
@@ -569,6 +605,19 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
       }
       return E_OK;
     case OP_GATHER: {
+      if (alg == ALG_ROUND_ROBIN) {
+        // direct: non-roots send straight to root
+        if (me == root) {
+          push_copy(mv, c, count, a0, a2 + (uint64_t)me * count * ebr);
+          for (uint32_t r = 0; r < W; ++r)
+            if (r != root)
+              push_recv(mv, c, count, r, a2 + (uint64_t)r * count * ebr,
+                        TAG_ANY);
+        } else {
+          push_send(mv, c, count, a0, root, TAG_ANY);
+        }
+        return E_OK;
+      }
       uint32_t dist = (me + W - root) % W;
       uint32_t prv = (me + 1) % W, nxt = (me + W - 1) % W;
       if (me == root) {
@@ -588,6 +637,18 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
       return E_OK;
     }
     case OP_ALLGATHER: {
+      if (alg == ALG_ROUND_ROBIN) {
+        // direct fan-out: send own chunk to every peer, recv W-1 chunks
+        push_copy(mv, c, count, a0, a2 + (uint64_t)me * count * ebr);
+        for (uint32_t step = 1; step < W; ++step)
+          push_send(mv, c, count, a0, (me + step) % W, TAG_ANY);
+        for (uint32_t step = 1; step < W; ++step) {
+          uint32_t frm = (me + W - step) % W;
+          push_recv(mv, c, count, frm, a2 + (uint64_t)frm * count * ebr,
+                    TAG_ANY);
+        }
+        return E_OK;
+      }
       uint32_t nxt = (me + 1) % W, prv = (me + W - 1) % W;
       push_copy(mv, c, count, a0, a2 + (uint64_t)me * count * ebr);
       push_send(mv, c, count, a0, nxt, TAG_ANY);
@@ -600,8 +661,28 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
       return E_OK;
     }
     case OP_REDUCE: {
-      uint32_t nxt = (me + W - 1) % W, prv = (me + 1) % W;
       if (W == 1) { push_copy(mv, c, count, a0, a2); return E_OK; }
+      if (alg == ALG_ROUND_ROBIN) {
+        // direct: root folds each sender's data into dst sequentially
+        if (me != root) {
+          push_send(mv, c, count, a0, root, TAG_ANY);
+          return E_OK;
+        }
+        bool first = true;
+        for (uint32_t r = 0; r < W; ++r) {
+          if (r == root) continue;
+          CallCtx rc = c;
+          if (!first) {
+            // op0 is now dst, whose compressed-ness is the RES flag
+            rc.compression = (c.compression & ~uint8_t(C_OP0)) |
+                             ((c.compression & C_RES) ? C_OP0 : 0);
+          }
+          push_frr(mv, rc, count, func, r, first ? a0 : a2, a2, TAG_ANY);
+          first = false;
+        }
+        return E_OK;
+      }
+      uint32_t nxt = (me + W - 1) % W, prv = (me + 1) % W;
       if ((me + W - root) % W == W - 1) {
         push_send(mv, c, count, a0, nxt, TAG_ANY);
       } else if (me == root) {
@@ -626,6 +707,17 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
     }
     case OP_ALLREDUCE: {
       if (W == 1) { push_copy(mv, c, count, a0, a2); return E_OK; }
+      if (alg == ALG_NON_FUSED) {
+        // ring reduce to rank 0, then broadcast of dst
+        uint32_t err = expand(mv, c, OP_REDUCE, func, count, 0, tag, a0, 0,
+                              a2, ALG_RING);
+        if (err) return err;
+        CallCtx bc = c;
+        bc.compression = (c.compression & ~uint8_t(C_OP0)) |
+                         ((c.compression & C_RES) ? C_OP0 : 0);
+        return expand(mv, bc, OP_BCAST, func, count, 0, tag, a2, 0, 0,
+                      ALG_AUTO);
+      }
       uint64_t bulk = count / W;
       uint64_t tail = count - bulk * (W - 1);
       auto clen = [&](uint32_t ch) { return ch == W - 1 ? tail : bulk; };
@@ -818,14 +910,14 @@ class RankDaemon {
     // layout matches protocol.pack_call (after the MSG_CALL byte)
     const uint8_t* p = b.data();
     uint8_t scenario = p[0], func = p[1], compression = p[2], stream = p[3];
-    uint8_t udtype = p[4], cdtype = p[5];
-    uint64_t count = get_le<uint64_t>(p + 6);
-    uint32_t comm_id = get_le<uint32_t>(p + 14);
-    uint32_t root = get_le<uint32_t>(p + 18);
-    uint32_t tag = get_le<uint32_t>(p + 22);
-    uint64_t a0 = get_le<uint64_t>(p + 26);
-    uint64_t a1 = get_le<uint64_t>(p + 34);
-    uint64_t a2 = get_le<uint64_t>(p + 42);
+    uint8_t udtype = p[4], cdtype = p[5], algorithm = p[6];  // p[7] = pad
+    uint64_t count = get_le<uint64_t>(p + 8);
+    uint32_t comm_id = get_le<uint32_t>(p + 16);
+    uint32_t root = get_le<uint32_t>(p + 20);
+    uint32_t tag = get_le<uint32_t>(p + 24);
+    uint64_t a0 = get_le<uint64_t>(p + 28);
+    uint64_t a1 = get_le<uint64_t>(p + 36);
+    uint64_t a2 = get_le<uint64_t>(p + 44);
     if (scenario == OP_NOP || scenario == OP_CONFIG) return E_OK;
     Communicator* comm;
     {
@@ -837,7 +929,8 @@ class RankDaemon {
     CallCtx c{comm->size(), comm->local_rank, udtype, cdtype, max_seg_,
               compression, stream};
     std::vector<Move> moves;
-    uint32_t err = expand(moves, c, scenario, func, count, root, tag, a0, a1, a2);
+    uint32_t err = expand(moves, c, scenario, func, count, root, tag, a0, a1,
+                          a2, algorithm);
     if (err) return err;
     return execute_moves(moves, c, *comm);
   }

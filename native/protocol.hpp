@@ -41,6 +41,14 @@ enum CompFlag : uint8_t {
   C_NONE = 0, C_OP0 = 1, C_OP1 = 2, C_RES = 4, C_ETH = 8,
 };
 
+// per-call collective algorithm selector (CollectiveAlgorithm in
+// accl_tpu/constants.py; the reference's sw/ring/rr variant axis,
+// driver/xrt/include/xlnx-consts.hpp:43-66)
+enum Alg : uint8_t {
+  ALG_AUTO = 0, ALG_RING = 1, ALG_ROUND_ROBIN = 2, ALG_TREE = 3,
+  ALG_FUSED_RING = 4, ALG_NON_FUSED = 5,
+};
+
 enum Err : uint32_t {
   E_OK = 0,
   E_DMA_MISMATCH = 1u << 0,
