@@ -18,6 +18,9 @@ from .tree import (Tree2DCollectives, tree_bcast_shard, tree_scatter_shard,
                    tree_allreduce_shard)
 from .bucketing import (BucketPlan, make_bucket_plan, bucketed_allreduce,
                         make_ddp_train_step)
+from .multislice import (hybrid_mesh, hierarchical_allreduce,
+                         hierarchical_allreduce_sharded, distributed_init,
+                         slice_count)
 
 __all__ = ["make_mesh", "cpu_mesh", "mesh_from_communicator",
            "MeshCollectives", "ring_allreduce", "ring_allgather",
@@ -30,4 +33,7 @@ __all__ = ["make_mesh", "cpu_mesh", "mesh_from_communicator",
            "tree_gather_shard", "tree_reduce_shard",
            "tree_allreduce_shard",
            "BucketPlan", "make_bucket_plan", "bucketed_allreduce",
-           "make_ddp_train_step"]
+           "make_ddp_train_step",
+           "hybrid_mesh", "hierarchical_allreduce",
+           "hierarchical_allreduce_sharded", "distributed_init",
+           "slice_count"]
