@@ -50,11 +50,10 @@ def distributed_init(coordinator_address: str | None = None,
     the standard env vars (JAX_COORDINATOR_ADDRESS etc.), like the
     reference defaults rank/size from the MPI launcher.
     """
-    try:
-        if jax.process_count() > 1:
-            return True
-    except RuntimeError:
-        pass
+    # NOTE: must not touch jax.process_count()/jax.devices() here — reading
+    # them initializes the XLA backends, after which initialize() raises.
+    if jax.distributed.is_initialized():
+        return True
     if coordinator_address is None and num_processes is None:
         import os
         if "JAX_COORDINATOR_ADDRESS" not in os.environ:
@@ -87,6 +86,10 @@ def hybrid_mesh(ici_shape: tuple[int, ...] | None = None,
     the collectives compile identically, which is what the CI tier needs.
     """
     devices = list(devices if devices is not None else jax.devices())
+    if ici_shape is not None and len(ici_axes) != len(ici_shape):
+        raise ValueError(
+            f"ici_axes {ici_axes} must name every ici_shape axis "
+            f"{ici_shape} (pass e.g. ici_axes=('x','y') for a 2-D slice)")
     real_slices = slice_count(devices)
     if n_slices is None:
         n_slices = real_slices if real_slices > 1 else 1
@@ -169,6 +172,10 @@ def hierarchical_allreduce_sharded(x: jax.Array, mesh: Mesh,
     the global reduction. The jitted shard_map program is cached per
     (mesh, axes, func, wire dtype) — jit handles shape/dtype keys — so a
     training loop pays one compile, like the sibling MeshCollectives."""
+    if x.shape[0] != mesh.devices.size:
+        raise ValueError(
+            f"x must be rank-major with shape[0] == mesh size "
+            f"({mesh.devices.size}), got {x.shape}")
     key = (mesh, ici_axis, dcn_axis, func,
            None if wire_dtype is None else jnp.dtype(wire_dtype).name)
     run = _PROGRAM_CACHE.get(key)

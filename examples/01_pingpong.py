@@ -1,0 +1,54 @@
+"""Tag-matched send/recv ping-pong with per-call profiling.
+
+Run:  python examples/01_pingpong.py
+(CPU emulator tier — no TPU needed; BASELINE config 1 shape.)
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accl_tpu import tracing
+from accl_tpu.testing import emu_world, run_ranks
+
+N_ITERS = 50
+NBYTES = 64 << 10
+
+
+def main():
+    accls = emu_world(2)
+
+    def body(a):
+        n = NBYTES // 4
+        buf = a.buffer((n,), np.float32)
+        a.start_profiling()
+        for i in range(N_ITERS):
+            if a.rank == 0:
+                buf.data[:] = i
+                a.send(buf, n, dst=1, tag=i)
+                a.recv(buf, n, src=1, tag=i)
+                assert buf.data[0] == i + 0.5
+            else:
+                a.recv(buf, n, src=0, tag=i)
+                buf.data[:] = buf.data[0] + 0.5
+                a.send(buf, n, dst=0, tag=i)
+        a.end_profiling()
+        return a.profiler.summary()
+
+    summaries = run_ranks(accls, body)
+    rtt_us = (summaries[0]["send"].mean_us + summaries[0]["recv"].mean_us)
+    print(accls[0].profiler.table())
+    print(f"\n{N_ITERS} round trips of {NBYTES >> 10} KiB: "
+          f"~{rtt_us:.0f} us RTT, "
+          f"{2 * NBYTES / (rtt_us * 1e-6) / 1e9:.2f} GB/s goodput")
+    lat = tracing.measure_call_latency(accls[0], n=100)
+    print(f"nop call latency p50 = {lat['p50_us']:.1f} us")
+    for a in accls:
+        a.deinit()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Drive native C++ rank daemons from Python — the out-of-process tier.
+
+Run:  make -C native && python examples/03_native_daemons.py
+Spawns 4 cclo_emud processes, runs collectives with algorithm selectors,
+shows the rx-pool introspection dump, and tears down.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from accl_tpu.constants import CollectiveAlgorithm as A
+from accl_tpu.testing import connect_world, free_port_base, run_ranks
+
+W = 4
+
+
+def main():
+    binary = os.path.join(REPO, "native", "cclo_emud")
+    if not os.path.exists(binary):
+        raise SystemExit("build first: make -C native")
+    port_base = free_port_base()
+    procs = [subprocess.Popen(
+        [binary, "--rank", str(r), "--world", str(W),
+         "--port-base", str(port_base)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for r in range(W)]
+    time.sleep(0.5)
+    try:
+        accls = connect_world(port_base, W)
+
+        def body(a):
+            n = 1024
+            src = a.buffer(data=np.full(n, float(a.rank + 1), np.float32))
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)                       # fused ring
+            total = dst.data[0]
+            a.allreduce(src, dst, n, algorithm=A.NON_FUSED)
+            assert dst.data[0] == total
+            a.bcast(src, n, root=0, algorithm=A.TREE)      # binomial tree
+            a.allreduce(src, dst, n, compress_dtype=np.float16)  # fp16 wire
+            return total, a.device.dump_rx_buffers().splitlines()[0]
+
+        results = run_ranks(accls, body)
+        print(f"allreduce over {W} C++ daemons: {results[0][0]}"
+              f" (expect {W * (W + 1) / 2})")
+        print("rank 0 rx pool:", results[0][1])
+        for a in accls:
+            a.deinit()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+    print("done.")
+
+
+if __name__ == "__main__":
+    main()

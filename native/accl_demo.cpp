@@ -124,7 +124,29 @@ int main(int argc, char** argv) {
     a.allreduce(src, dst, N, FN_SUM, DT_F16);
     expect_near(a.read_vec<float>(dst),
                 world * (world + 1) / 2.0f, "allreduce(fp16 wire)");
+    // algorithm variants (xlnx-consts ring/rr/fused axis)
+    a.allreduce(src, dst, N, FN_SUM, 0xFF, ALG_NON_FUSED);
+    expect_near(a.read_vec<float>(dst),
+                world * (world + 1) / 2.0f, "allreduce(non-fused)");
     a.free(src); a.free(dst);
+  }
+
+  // tree bcast + direct gather/allgather variants
+  {
+    Buffer buf = a.alloc(N);
+    std::vector<float> v(N, rank == 1 ? 77.0f : 0.0f);
+    a.write(buf, v.data());
+    a.bcast(buf, N, 1, ALG_TREE);
+    expect_near(a.read_vec<float>(buf), 77.0f, "bcast(tree)");
+    Buffer dst = a.alloc(world * N);
+    std::vector<float> mine(N, static_cast<float>(rank + 5));
+    a.write(buf, mine.data());
+    a.allgather(buf, dst, N, ALG_ROUND_ROBIN);
+    auto got = a.read_vec<float>(dst);
+    for (uint32_t r = 0; r < world; ++r)
+      expect_near(got, static_cast<float>(r + 5), "allgather(rr)",
+                  r * N, (r + 1) * N);
+    a.free(buf); a.free(dst);
   }
 
   // reduce to root 0, max

@@ -283,9 +283,12 @@ class ACCL {
   }
 
   // -- collectives --------------------------------------------------------
-  void bcast(const Buffer& buf, uint64_t count, uint32_t root) {
+  // Each takes an optional algorithm selector (Alg enum — the reference
+  // XRT driver's ring/rr/fused variant axis, xlnx-consts.hpp:43-66).
+  void bcast(const Buffer& buf, uint64_t count, uint32_t root,
+             uint8_t alg = ALG_AUTO) {
     wait(call_async(OP_BCAST, count, root, 0, TAG_ANY, buf.addr, 0, 0,
-                    buf.dtype, buf.dtype));
+                    buf.dtype, buf.dtype, C_NONE, 0, alg));
   }
 
   void scatter(const Buffer& src, const Buffer& dst, uint64_t count,
@@ -295,28 +298,31 @@ class ACCL {
   }
 
   void gather(const Buffer& src, const Buffer& dst, uint64_t count,
-              uint32_t root) {
+              uint32_t root, uint8_t alg = ALG_AUTO) {
     wait(call_async(OP_GATHER, count, root, 0, TAG_ANY, src.addr, 0,
-                    dst.addr, src.dtype, src.dtype));
+                    dst.addr, src.dtype, src.dtype, C_NONE, 0, alg));
   }
 
   void reduce(const Buffer& src, const Buffer& dst, uint64_t count,
-              uint32_t root, uint8_t func = FN_SUM) {
+              uint32_t root, uint8_t func = FN_SUM,
+              uint8_t alg = ALG_AUTO) {
     wait(call_async(OP_REDUCE, count, root, func, TAG_ANY, src.addr, 0,
-                    dst.addr, src.dtype, src.dtype));
+                    dst.addr, src.dtype, src.dtype, C_NONE, 0, alg));
   }
 
-  void allgather(const Buffer& src, const Buffer& dst, uint64_t count) {
+  void allgather(const Buffer& src, const Buffer& dst, uint64_t count,
+                 uint8_t alg = ALG_AUTO) {
     wait(call_async(OP_ALLGATHER, count, 0, 0, TAG_ANY, src.addr, 0,
-                    dst.addr, src.dtype, src.dtype));
+                    dst.addr, src.dtype, src.dtype, C_NONE, 0, alg));
   }
 
   void allreduce(const Buffer& src, const Buffer& dst, uint64_t count,
-                 uint8_t func = FN_SUM, uint8_t wire_dtype = 0xFF) {
+                 uint8_t func = FN_SUM, uint8_t wire_dtype = 0xFF,
+                 uint8_t alg = ALG_AUTO) {
     uint8_t cd = wire_dtype == 0xFF ? src.dtype : wire_dtype;
     uint8_t comp = cd != src.dtype ? C_ETH : C_NONE;
     wait(call_async(OP_ALLREDUCE, count, 0, func, TAG_ANY, src.addr, 0,
-                    dst.addr, src.dtype, cd, comp));
+                    dst.addr, src.dtype, cd, comp, 0, alg));
   }
 
   void reduce_scatter(const Buffer& src, const Buffer& dst, uint64_t count,
