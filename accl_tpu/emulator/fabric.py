@@ -47,13 +47,39 @@ class LocalFabric:
     def __init__(self, world_size: int):
         self.world_size = world_size
         self._ingress: list = [None] * world_size
+        self._fault = None
+        self.stats = {"sent": 0, "dropped": 0, "duplicated": 0,
+                      "corrupted": 0}
 
     def attach(self, rank: int, ingress_fn):
         """ingress_fn(env, payload) is the rank's eager-ingress entry."""
         self._ingress[rank] = ingress_fn
 
+    # -- fault injection (extension beyond the reference, which has none:
+    #    SURVEY §5 — its only provokable failure is a receive timeout) ------
+    def inject_fault(self, fault_fn):
+        """Install a fault hook: ``fault_fn(env, payload) -> action`` with
+        action in {"deliver", "drop", "duplicate", "corrupt_seq"}. Used to
+        prove failure detection (timeouts, seqn mismatches latched as error
+        words) and recovery (soft_reset) under a lossy/byzantine wire."""
+        self._fault = fault_fn
+
+    def clear_fault(self):
+        self._fault = None
+
     def send(self, env: Envelope, payload: bytes):
         fn = self._ingress[env.dst]
         if fn is None:
             raise RuntimeError(f"rank {env.dst} not attached to fabric")
+        self.stats["sent"] += 1
+        action = self._fault(env, payload) if self._fault else "deliver"
+        if action == "drop":
+            self.stats["dropped"] += 1
+            return
+        if action == "corrupt_seq":
+            self.stats["corrupted"] += 1
+            env = dataclasses.replace(env, seqn=env.seqn + 1_000_000)
         fn(env, payload)
+        if action == "duplicate":
+            self.stats["duplicated"] += 1
+            fn(env, payload)

@@ -205,6 +205,123 @@ class Llama:
         logits = x @ params["lm_head"].astype(c.dtype)
         return logits.astype(jnp.float32)
 
+    # -- inference: KV-cache decode ----------------------------------------
+    def init_kv_cache(self, batch: int, max_len: int, dtype=None) -> dict:
+        """Preallocated static-shape KV cache: (L, B, max_len, n_kv, hd)
+        per tensor + a scalar fill position. Static shapes keep every
+        decode step a single compiled program (no growing arrays)."""
+        c = self.config
+        dt = dtype or c.dtype
+        shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt),
+                "pos": jnp.zeros((), jnp.int32)}
+
+    def _layer_cached(self, x, layer_params, kc, vc, pos):
+        """One decoder layer over cached context: x holds S_new tokens at
+        absolute positions pos..pos+S_new-1; kc/vc are (B, max_len, nkv, hd)
+        and are updated in place (dynamic_update_slice). Returns
+        (x, kc, vc)."""
+        c = self.config
+        p = layer_params
+        hd, nh, nkv = c.head_dim, c.n_heads, c.n_kv_heads
+        B, S, D = x.shape
+        max_len = kc.shape[1]
+
+        h = _rms_norm(x, p["attn_norm"].astype(x.dtype), c.norm_eps)
+        positions = pos + jnp.arange(S)
+        q = (h @ p["wq"].astype(x.dtype)).reshape(B, S, nh, hd)
+        k = (h @ p["wk"].astype(x.dtype)).reshape(B, S, nkv, hd)
+        v = (h @ p["wv"].astype(x.dtype)).reshape(B, S, nkv, hd)
+        q = _rope(q, positions, c.rope_theta)
+        k = _rope(k, positions, c.rope_theta)
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                          (0, pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (0, pos, 0, 0))
+
+        # grouped-query attention without materializing repeated K/V over
+        # max_len (that copy is the cost GQA exists to avoid): fold the
+        # per-kv-head query group into the einsum instead
+        rep = nh // nkv
+        qg = q.reshape(B, S, nkv, rep, hd)            # (B, S, nkv, rep, hd)
+        kt = kc.astype(x.dtype)                       # (B, max, nkv, hd)
+        vt = vc.astype(x.dtype)
+        scores = jnp.einsum("bskrd,btkd->bkrst", qg, kt,
+                            preferred_element_type=jnp.float32) * (hd ** -0.5)
+        kpos = jnp.arange(max_len)
+        mask = kpos[None, :] <= positions[:, None]    # (S, max) causal
+        scores = jnp.where(mask[None, None, None], scores,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bkrst,btkd->bskrd", probs, vt)
+        attn = attn.reshape(B, S, nh * hd)
+        x = x + attn @ p["wo"].astype(x.dtype)
+
+        h = _rms_norm(x, p["mlp_norm"].astype(x.dtype), c.norm_eps)
+        gate = jax.nn.silu(h @ p["w_gate"].astype(x.dtype))
+        up = h @ p["w_up"].astype(x.dtype)
+        x = x + (gate * up) @ p["w_down"].astype(x.dtype)
+        return x, kc, vc
+
+    def forward_cached(self, params: dict, tokens: jnp.ndarray,
+                       cache: dict) -> tuple[jnp.ndarray, dict]:
+        """Logits for S_new tokens appended at cache['pos'], plus the
+        updated cache. Used for both prefill (S_new = prompt len) and
+        decode (S_new = 1); jit once per S_new."""
+        c = self.config
+        x = params["embed"].astype(c.dtype)[tokens]
+        pos = cache["pos"]
+
+        def body(xc, layer):
+            x = xc
+            lp, kc, vc = layer
+            x, kc, vc = self._layer_cached(x, lp, kc, vc, pos)
+            return x, (kc, vc)
+
+        x, (knew, vnew) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+        x = _rms_norm(x, params["final_norm"].astype(x.dtype), c.norm_eps)
+        logits = (x @ params["lm_head"].astype(c.dtype)).astype(jnp.float32)
+        new_cache = {"k": knew, "v": vnew,
+                     "pos": pos + tokens.shape[1]}
+        return logits, new_cache
+
+    def generate(self, params: dict, prompt: jnp.ndarray, max_new: int,
+                 max_len: int | None = None,
+                 temperature: float = 0.0,
+                 key: jax.Array | None = None) -> jnp.ndarray:
+        """Greedy (or temperature) decode: prefill the prompt, then one
+        jitted single-token step per new token. Returns (B, max_new)."""
+        B, S = prompt.shape
+        max_len = max_len or (S + max_new)
+        cache = self.init_kv_cache(B, max_len)
+        # one cached jit serves prefill and decode (distinct trace-cache
+        # entries per S_new); rebuilding wrappers per call would recompile
+        step = self._jit_forward_cached()
+        logits, cache = step(params, prompt, cache)
+        out = []
+        last = logits[:, -1]
+        if key is None:
+            key = jax.random.key(0)
+        for i in range(max_new):
+            if temperature > 0:
+                key, sub = jax.random.split(key)
+                tok = jax.random.categorical(sub, last / temperature, axis=-1)
+            else:
+                tok = jnp.argmax(last, axis=-1)
+            out.append(tok)
+            if i + 1 < max_new:  # the last sampled token needs no step
+                logits, cache = step(params, tok[:, None], cache)
+                last = logits[:, -1]
+        return jnp.stack(out, axis=1)
+
+    def _jit_forward_cached(self):
+        fn = getattr(self, "_fc_jit", None)
+        if fn is None:
+            fn = jax.jit(self.forward_cached)
+            self._fc_jit = fn
+        return fn
+
     def loss(self, params: dict, tokens: jnp.ndarray,
              dp: str | None = None, sp: str | None = None) -> jnp.ndarray:
         """Next-token cross entropy (mean over B, S-1)."""

@@ -86,16 +86,16 @@ def hybrid_mesh(ici_shape: tuple[int, ...] | None = None,
     the collectives compile identically, which is what the CI tier needs.
     """
     devices = list(devices if devices is not None else jax.devices())
-    if ici_shape is not None and len(ici_axes) != len(ici_shape):
-        raise ValueError(
-            f"ici_axes {ici_axes} must name every ici_shape axis "
-            f"{ici_shape} (pass e.g. ici_axes=('x','y') for a 2-D slice)")
     real_slices = slice_count(devices)
     if n_slices is None:
         n_slices = real_slices if real_slices > 1 else 1
     if ici_shape is None:
         per = len(devices) // max(n_slices, 1)
         ici_shape = (per,)
+    if len(ici_axes) != len(ici_shape):
+        raise ValueError(
+            f"ici_axes {ici_axes} must name every ici_shape axis "
+            f"{ici_shape} (pass e.g. ici_axes=('x','y') for a 2-D slice)")
     per_slice = int(np.prod(ici_shape))
     if real_slices > 1:
         from jax.experimental import mesh_utils

@@ -20,33 +20,43 @@ import numpy as np
 
 
 def timed_scalar(fn, args, reps: int = 5) -> float:
-    """Median wall time of fn(*args) forced to a host scalar."""
+    """Min-of-reps wall time of fn(*args) forced to a host scalar.
+
+    Min (not median): every timing includes the same device work plus a
+    nonnegative noise term from the tunnel/host scheduler, so the minimum
+    is the tightest unbiased estimate of the true cost — medians still
+    carry half the noise distribution and made run-to-run slope results
+    swing by 2x through the remote tunnel."""
     float(fn(*args))  # compile + warm
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
         float(fn(*args))
         ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
+    return float(np.min(ts))
 
 
 def slope_time(make_chain, args, k_lo: int = 4, k_hi: int = 36,
                reps: int = 5) -> float:
-    """Seconds per iteration via the (k_hi - k_lo) slope.
+    """Seconds per iteration via a least-squares slope over 3 K points.
 
     ``make_chain(K)`` must return a jitted callable running K chained
-    iterations of the op and reducing to a scalar.
+    iterations of the op and reducing to a scalar. Three points (lo, mid,
+    hi) with min-of-reps timings give a slope robust to a single noisy
+    measurement, which a 2-point difference is not.
     """
-    t_lo = timed_scalar(make_chain(k_lo), args, reps=reps)
-    t_hi = timed_scalar(make_chain(k_hi), args, reps=reps)
-    if t_hi <= t_lo:
+    k_mid = (k_lo + k_hi) // 2
+    ks = np.array([k_lo, k_mid, k_hi], dtype=np.float64)
+    ts = np.array([timed_scalar(make_chain(int(k)), args, reps=reps)
+                   for k in ks])
+    slope = float(np.polyfit(ks, ts, 1)[0])
+    if slope <= 0:
         import warnings
         warnings.warn(
-            f"non-positive timing slope (t_lo={t_lo:.2e}s, "
-            f"t_hi={t_hi:.2e}s): host too noisy or op too small for "
-            f"K={k_lo}..{k_hi}; result clamped and unreliable",
+            f"non-positive timing slope (t={ts}): host too noisy or op too "
+            f"small for K={k_lo}..{k_hi}; result clamped and unreliable",
             RuntimeWarning, stacklevel=2)
-    return max(t_hi - t_lo, 1e-9) / (k_hi - k_lo)
+    return max(slope, 1e-9)
 
 
 def wall_time(fn, reps: int = 20, warmup: int = 3) -> tuple[float, float]:

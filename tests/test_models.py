@@ -1,5 +1,7 @@
 """Llama model family tests (CPU)."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -102,3 +104,57 @@ def test_sharded_forward_on_mesh(tiny):
     # bf16 compute: sharded matmuls accumulate in different orders
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
                                rtol=5e-2, atol=5e-2)
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """forward_cached (prefill + per-token decode) must reproduce the full
+    forward's next-token logits exactly — the standard KV-cache
+    consistency check."""
+    import jax
+    import jax.numpy as jnp
+
+    from accl_tpu.models import Llama, LlamaConfig
+
+    config = LlamaConfig.tiny(dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                              ffn_dim=64, max_seq_len=64)
+    config = dataclasses.replace(config, dtype=jnp.float32)
+    model = Llama(config)
+    params = model.init(jax.random.key(0))
+    B, S = 2, 10
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, config.vocab_size, (B, S)),
+        jnp.int32)
+
+    full = model.forward(params, tokens)          # (B, S, V)
+
+    cache = model.init_kv_cache(B, max_len=S)
+    # prefill first 6, then decode 4 one at a time
+    logits, cache = model.forward_cached(params, tokens[:, :6], cache)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, :6]),
+                               rtol=2e-4, atol=2e-4)
+    for t in range(6, S):
+        logits, cache = model.forward_cached(params, tokens[:, t:t + 1],
+                                             cache)
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+            rtol=2e-4, atol=2e-4, err_msg=f"position {t}")
+    assert int(cache["pos"]) == S
+
+
+def test_generate_greedy_deterministic():
+    import jax
+    import jax.numpy as jnp
+
+    from accl_tpu.models import Llama, LlamaConfig
+
+    config = LlamaConfig.tiny(dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+                              ffn_dim=64, max_seq_len=64)
+    model = Llama(config)
+    params = model.init(jax.random.key(1))
+    prompt = jnp.asarray([[5, 9, 3]], jnp.int32)
+    a = model.generate(params, prompt, max_new=6)
+    b = model.generate(params, prompt, max_new=6)
+    assert a.shape == (1, 6)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert (np.asarray(a) >= 0).all() and \
+        (np.asarray(a) < config.vocab_size).all()
