@@ -31,6 +31,17 @@ from .executor import DeviceMemory, MoveExecutor, RxBufferPool
 from .fabric import Envelope
 
 
+def _env_from_eth_frame(frame: bytes) -> tuple[Envelope, bytes]:
+    """Decode an eth frame (post-MSG_ETH byte) into (Envelope, payload) —
+    shared by both fabric stacks so the header format lives in one place."""
+    hdr, payload = P.unpack_eth(frame)
+    env = Envelope(src=hdr["src"], dst=hdr["dst"], tag=hdr["tag"],
+                   seqn=hdr["seqn"], nbytes=hdr["nbytes"],
+                   wire_dtype=P.code_dtype(hdr["dtype"]).name,
+                   strm=hdr["strm"], comm_id=hdr["comm_id"])
+    return env, payload
+
+
 class EthFabric:
     """Daemon-to-daemon transport: one TCP connection per peer, lazily
     dialed; an accept loop ingests inbound frames."""
@@ -69,12 +80,7 @@ class EthFabric:
                 body = P.recv_frame(conn)
                 if body[0] != P.MSG_ETH:
                     continue
-                hdr, payload = P.unpack_eth(body[1:])
-                env = Envelope(src=hdr["src"], dst=hdr["dst"],
-                               tag=hdr["tag"], seqn=hdr["seqn"],
-                               nbytes=hdr["nbytes"],
-                               wire_dtype=P.code_dtype(hdr["dtype"]).name,
-                               strm=hdr["strm"], comm_id=hdr["comm_id"])
+                env, payload = _env_from_eth_frame(body[1:])
                 self.ingest(env, payload)
         except (ConnectionError, OSError):
             return
@@ -101,15 +107,139 @@ class EthFabric:
             sock.close()
 
 
+class UdpEthFabric:
+    """Datagram transport with explicit packetization — the UDP stack of
+    the dual-stack story (reference: VNx UDP, runtime-selectable vs TCP,
+    accl.py:383-395).
+
+    Where the TCP fabric rides stream framing, this one does what the
+    reference's hardware does in HLS:
+      * ``udp_packetizer`` parity: each eth message is chopped into
+        <=MAX_PKT-byte datagrams, each carrying {msg_id, frag_idx,
+        n_frags} ahead of the first fragment's eth header
+        (udp_packetizer.cpp:24-84 header word + max_pktsize chopping;
+        the reference's max packet is 1536B, ccl_offload_control.h:50).
+      * ``udp_depacketizer``/``rxbuf_session`` parity: fragments are
+        reassembled per (peer, msg_id) with out-of-order tolerance; only a
+        complete message is ingested (rxbuf_session.cpp fragment->buffer
+        assembly). Stale partial messages are garbage-collected, and drops
+        surface as receive timeouts upstream — UDP semantics, detected by
+        the same failure machinery the fault-injection tests exercise.
+    """
+
+    MAX_PKT = 1408          # fragment payload bytes (reference: 1536B MTU)
+    _FRAG_FMT = "<IIHH"     # sender_rank, msg_id, frag_idx, n_frags
+    PARTIAL_TTL = 30.0      # seconds before an incomplete message is GC'd
+
+    def __init__(self, my_global_rank: int, eth_port: int, ingest_fn):
+        import time as _t
+        self.me = my_global_rank
+        self.ingest = ingest_fn
+        self._time = _t
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8 << 20)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8 << 20)
+        self._sock.bind(("0.0.0.0", eth_port))
+        self._peer_addrs: dict[int, tuple[str, int]] = {}
+        self._lock = threading.Lock()
+        self._msg_id = 0
+        # (sender, msg_id) -> [deadline, n_frags, {idx: bytes}]
+        self._partial: dict = {}
+        self._queues: dict = {}  # sender -> delivery Queue (lazy workers)
+        threading.Thread(target=self._recv_loop, daemon=True).start()
+
+    def learn_peers(self, ranks: list[tuple[int, str, int]], world: int):
+        with self._lock:
+            for grank, host, port in ranks:
+                if grank != self.me and port:
+                    self._peer_addrs[grank] = (host, port + world)
+
+    def send(self, env: Envelope, payload: bytes):
+        frame = P.pack_eth(env.src, env.dst, env.tag, env.seqn,
+                           env.comm_id, env.strm,
+                           P.dtype_code(env.wire_dtype), payload)[1:]
+        with self._lock:
+            addr = self._peer_addrs[env.dst]
+            msg_id = self._msg_id
+            self._msg_id += 1
+        n_frags = max(1, -(-len(frame) // self.MAX_PKT))
+        for idx in range(n_frags):
+            chunk = frame[idx * self.MAX_PKT:(idx + 1) * self.MAX_PKT]
+            hdr = struct.pack(self._FRAG_FMT, self.me, msg_id, idx, n_frags)
+            self._sock.sendto(hdr + chunk, addr)
+
+    def _recv_loop(self):
+        hdr_len = struct.calcsize(self._FRAG_FMT)
+        while True:
+            try:
+                dgram, _ = self._sock.recvfrom(self.MAX_PKT + hdr_len + 64)
+            except OSError:
+                return
+            try:
+                self._on_datagram(dgram, hdr_len)
+            except Exception:  # noqa: BLE001 — a malformed datagram (the
+                # socket is wildcard-bound) must not kill the fabric's only
+                # receive thread; UDP semantics allow dropping it
+                import traceback
+                traceback.print_exc()
+
+    def _on_datagram(self, dgram: bytes, hdr_len: int):
+        if len(dgram) < hdr_len:
+            return
+        sender, msg_id, idx, n_frags = struct.unpack(
+            self._FRAG_FMT, dgram[:hdr_len])
+        chunk = dgram[hdr_len:]
+        key = (sender, msg_id)
+        now = self._time.monotonic()
+        entry = self._partial.setdefault(
+            key, [now + self.PARTIAL_TTL, n_frags, {}])
+        entry[2][idx] = chunk
+        if len(entry[2]) == entry[1]:           # complete
+            del self._partial[key]
+            frame = b"".join(entry[2][i] for i in range(entry[1]))
+            env, payload = _env_from_eth_frame(frame)
+            # per-sender delivery queues: ingest (which blocks while the
+            # rx pool is full) must not head-of-line-block fragments from
+            # OTHER peers behind the single recv thread
+            self._deliver_q(env.src).put((env, payload))
+        # GC stale partials (lost fragments must not leak memory)
+        stale = [k for k, e in self._partial.items() if e[0] < now]
+        for k in stale:
+            del self._partial[k]
+
+    def _deliver_q(self, sender: int):
+        q = self._queues.get(sender)
+        if q is None:
+            import queue as _queue
+            q = _queue.Queue()
+            self._queues[sender] = q
+
+            def drain():
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    self.ingest(*item)
+
+            threading.Thread(target=drain, daemon=True).start()
+        return q
+
+    def close(self):
+        self._sock.close()
+        for q in self._queues.values():
+            q.put(None)
+
+
 class RankDaemon:
     """One emulated rank: memory + pool + executor + async call queue."""
 
     def __init__(self, rank: int, world: int, port_base: int,
                  nbufs: int = 16, bufsize: int = 1 << 20,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0", stack: str = "tcp"):
         self.rank = rank
         self.world = world
         self.port_base = port_base
+        self.stack = stack
         self.mem = DeviceMemory()
         self.pool = RxBufferPool(nbufs, bufsize)
         self.bufsize = bufsize
@@ -120,7 +250,12 @@ class RankDaemon:
         # port collision fails before any resources need cleanup
         self._server = socket.create_server((host, port_base + rank))
         try:
-            self.eth = EthFabric(rank, port_base + world + rank, self._ingest)
+            # dual-stack parity: TCP (stream framing) or UDP (datagram
+            # packetizer/reassembly), runtime-selectable like the
+            # reference's use_tcp/use_udp (accl.py:383-395)
+            fabric_cls = {"tcp": EthFabric, "udp": UdpEthFabric}[stack]
+            self.eth = fabric_cls(rank, port_base + world + rank,
+                                  self._ingest)
         except Exception:  # OverflowError for out-of-range ports, OSError...
             self._server.close()
             raise
@@ -315,7 +450,7 @@ class RankDaemon:
 
 
 def spawn_world(world: int, port_base: int = 0, nbufs: int = 16,
-                bufsize: int = 1 << 20):
+                bufsize: int = 1 << 20, stack: str = "tcp"):
     """Spawn W in-process daemons on free ports (for tests); returns
     (daemons, port_base). Multi-process deployments run __main__ per rank."""
     # The contiguous cmd+eth port block lands in the ephemeral range, where
@@ -334,7 +469,7 @@ def spawn_world(world: int, port_base: int = 0, nbufs: int = 16,
         try:
             for r in range(world):
                 d = RankDaemon(r, world, base, nbufs=nbufs, bufsize=bufsize,
-                               host="127.0.0.1")
+                               host="127.0.0.1", stack=stack)
                 daemons.append(d)
         except Exception as exc:
             for d in daemons:
@@ -356,12 +491,15 @@ def main():
     ap.add_argument("--port-base", type=int, default=45000)
     ap.add_argument("--nbufs", type=int, default=16)
     ap.add_argument("--bufsize", type=int, default=1 << 20)
+    ap.add_argument("--stack", choices=["tcp", "udp"], default="tcp")
     args = ap.parse_args()
     daemon = RankDaemon(args.rank, args.world, args.port_base,
-                        nbufs=args.nbufs, bufsize=args.bufsize)
+                        nbufs=args.nbufs, bufsize=args.bufsize,
+                        stack=args.stack)
     print(f"rank {args.rank}/{args.world} serving on "
           f"cmd={args.port_base + args.rank} "
-          f"eth={args.port_base + args.world + args.rank}", flush=True)
+          f"eth={args.port_base + args.world + args.rank} "
+          f"stack={args.stack}", flush=True)
     daemon.serve_forever()
 
 

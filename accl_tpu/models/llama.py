@@ -294,6 +294,13 @@ class Llama:
         jitted single-token step per new token. Returns (B, max_new)."""
         B, S = prompt.shape
         max_len = max_len or (S + max_new)
+        # the last sampled token is never stepped, so S + max_new - 1 cache
+        # slots are written; a short cache would silently clamp
+        # dynamic_update_slice and corrupt attention instead of erroring
+        if max_len < S + max_new - 1:
+            raise ValueError(
+                f"max_len={max_len} too small for prompt {S} + "
+                f"{max_new - 1} cached decode steps")
         cache = self.init_kv_cache(B, max_len)
         # one cached jit serves prefill and decode (distinct trace-cache
         # entries per S_new); rebuilding wrappers per call would recompile

@@ -65,11 +65,29 @@ def _ring_perm(W: int) -> list[tuple[int, int]]:
     return [(i, (i - 1) % W) for i in range(W)]
 
 
+_FP8_DTYPES = ("float8_e4m3fn", "float8_e5m2")
+
+
 def _hop(x: jnp.ndarray, axis_name: str, perm, wire_dtype) -> jnp.ndarray:
-    """One ring hop, optionally compressed on the wire."""
-    if wire_dtype is not None and x.dtype != jnp.dtype(wire_dtype):
-        return lax.ppermute(x.astype(wire_dtype), axis_name, perm).astype(x.dtype)
-    return lax.ppermute(x, axis_name, perm)
+    """One ring hop, optionally compressed on the wire.
+
+    fp16/bf16 wire dtypes are straight casts (the reference's fp32<->fp16
+    clane); fp8 dtypes use the scaled codec (per-hop absmax scale travels
+    with the payload — the EQuARX-style quantized-collective extension,
+    ops/compression.compress_fp8)."""
+    if wire_dtype is None or x.dtype == jnp.dtype(wire_dtype):
+        return lax.ppermute(x, axis_name, perm)
+    if jnp.dtype(wire_dtype).name in _FP8_DTYPES:
+        # inline jnp codec (not the Pallas one in ops/compression): inside
+        # a shard_map ring loop XLA fuses the scale/cast into the permute's
+        # producers, and pallas_call would need vma plumbing here
+        xf = x.astype(jnp.float32)
+        fp8_max = float(jnp.finfo(wire_dtype).max)
+        scale = jnp.maximum(jnp.max(jnp.abs(xf)) / fp8_max, 1e-30)
+        q = lax.ppermute((xf / scale).astype(wire_dtype), axis_name, perm)
+        scale = lax.ppermute(scale, axis_name, perm)
+        return (q.astype(jnp.float32) * scale).astype(x.dtype)
+    return lax.ppermute(x.astype(wire_dtype), axis_name, perm).astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------
