@@ -130,6 +130,10 @@ class UdpEthFabric:
     MAX_PKT = 1408          # fragment payload bytes (reference: 1536B MTU)
     _FRAG_FMT = "<IIHH"     # sender_rank, msg_id, frag_idx, n_frags
     PARTIAL_TTL = 30.0      # seconds before an incomplete message is GC'd
+    QUEUE_DEPTH = 64        # per-sender delivery bound; beyond it messages
+    # are DROPPED (UDP semantics): TCP's flow control does not exist here,
+    # and an unbounded queue would grow without limit while the rx pool is
+    # full. Drops surface as receive timeouts upstream.
 
     def __init__(self, my_global_rank: int, eth_port: int, ingest_fn):
         import time as _t
@@ -146,6 +150,7 @@ class UdpEthFabric:
         # (sender, msg_id) -> [deadline, n_frags, {idx: bytes}]
         self._partial: dict = {}
         self._queues: dict = {}  # sender -> delivery Queue (lazy workers)
+        self._closing = False
         threading.Thread(target=self._recv_loop, daemon=True).start()
 
     def learn_peers(self, ranks: list[tuple[int, str, int]], world: int):
@@ -201,32 +206,44 @@ class UdpEthFabric:
             # per-sender delivery queues: ingest (which blocks while the
             # rx pool is full) must not head-of-line-block fragments from
             # OTHER peers behind the single recv thread
-            self._deliver_q(env.src).put((env, payload))
+            q = self._deliver_q(env.src)
+            if q is not None:
+                import queue as _queue
+                try:
+                    q.put_nowait((env, payload))
+                except _queue.Full:
+                    pass  # bounded queue: drop (UDP semantics)
         # GC stale partials (lost fragments must not leak memory)
         stale = [k for k, e in self._partial.items() if e[0] < now]
         for k in stale:
             del self._partial[k]
 
     def _deliver_q(self, sender: int):
-        q = self._queues.get(sender)
-        if q is None:
-            import queue as _queue
-            q = _queue.Queue()
-            self._queues[sender] = q
+        with self._lock:
+            if self._closing:
+                return None
+            q = self._queues.get(sender)
+            if q is None:
+                import queue as _queue
+                q = _queue.Queue(maxsize=self.QUEUE_DEPTH)
+                self._queues[sender] = q
 
-            def drain():
-                while True:
-                    item = q.get()
-                    if item is None:
-                        return
-                    self.ingest(*item)
+                def drain():
+                    while True:
+                        item = q.get()
+                        if item is None:
+                            return
+                        self.ingest(*item)
 
-            threading.Thread(target=drain, daemon=True).start()
+                threading.Thread(target=drain, daemon=True).start()
         return q
 
     def close(self):
+        with self._lock:
+            self._closing = True
+            queues = list(self._queues.values())
         self._sock.close()
-        for q in self._queues.values():
+        for q in queues:
             q.put(None)
 
 

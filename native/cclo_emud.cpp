@@ -346,7 +346,15 @@ class RankDaemon;  // fwd
 
 class EthFabric {
  public:
-  EthFabric(uint32_t me, uint16_t listen_port, RankDaemon* daemon);
+  // stack: "tcp" (framed stream) or "udp" (datagram packetizer/reassembly;
+  // wire-compatible with the Python UdpEthFabric — same 12B fragment
+  // header {sender u32, msg_id u32, frag u16, nfrags u16} + same 30B eth
+  // header, so mixed C++/Python worlds interoperate on either stack)
+  static constexpr size_t kMaxPkt = 1408;        // reference MTU 1536B
+  static constexpr double kPartialTtl = 30.0;    // GC for lost fragments
+
+  EthFabric(uint32_t me, uint16_t listen_port, RankDaemon* daemon,
+            bool udp = false);
   ~EthFabric();
   void learn_peer(uint32_t grank, const std::string& host, uint16_t eth_port) {
     std::lock_guard<std::mutex> lk(mu_);
@@ -358,9 +366,19 @@ class EthFabric {
  private:
   void accept_loop();
   void recv_loop(int fd);
+  void udp_recv_loop();
+  void udp_handle(const uint8_t* dgram, size_t len);
+  void deliver(uint32_t sender, Envelope&& env,
+               std::vector<uint8_t>&& payload);
+  static std::vector<uint8_t> encode_eth(const Envelope& env,
+                                         const std::vector<uint8_t>& payload,
+                                         bool with_msg_byte);
+  static bool decode_eth(const uint8_t* p, size_t len, Envelope& env,
+                         std::vector<uint8_t>& payload);
   uint32_t me_;
   int listen_fd_ = -1;
   RankDaemon* daemon_;
+  bool udp_;
   std::map<uint32_t, int> peers_;
   // per-peer send mutexes: one slow peer's TCP backpressure must not stall
   // sends to other peers (mu_ guards only lookup/dial)
@@ -369,6 +387,23 @@ class EthFabric {
   std::mutex mu_;
   std::vector<std::thread> threads_;
   std::atomic<bool> stopping_{false};
+  // udp state: message ids, reassembly, per-sender delivery workers (a
+  // blocked ingest for one peer must not head-of-line-block the others
+  // behind the single datagram recv thread)
+  uint32_t next_msg_id_ = 0;
+  struct Partial {
+    double deadline;
+    uint16_t nfrags;
+    std::map<uint16_t, std::vector<uint8_t>> frags;
+  };
+  std::map<std::pair<uint32_t, uint32_t>, Partial> partial_;
+  struct DeliverQ {
+    std::deque<std::pair<Envelope, std::vector<uint8_t>>> q;
+    std::mutex mu;
+    std::condition_variable cv;
+    bool stop = false;
+  };
+  std::map<uint32_t, std::unique_ptr<DeliverQ>> dqs_;
 };
 
 // ---------------------------------------------------------------------------
@@ -767,11 +802,12 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
 class RankDaemon {
  public:
   RankDaemon(uint32_t rank, uint32_t world, uint16_t port_base, size_t nbufs,
-             size_t bufsize)
+             size_t bufsize, bool udp = false)
       : rank_(rank), world_(world), port_base_(port_base),
         pool_(nbufs, bufsize), bufsize_(bufsize), max_seg_(bufsize),
         nbufs_(nbufs),
-        eth_(rank, static_cast<uint16_t>(port_base + world + rank), this) {
+        eth_(rank, static_cast<uint16_t>(port_base + world + rank), this,
+             udp) {
     mem_.alloc(BARRIER_SCRATCH_ADDR, 8);  // barrier rendezvous scratch
     worker_ = std::thread([this] { call_worker(); });
   }
@@ -979,10 +1015,34 @@ static int make_server(uint16_t port) {
   return fd;
 }
 
-EthFabric::EthFabric(uint32_t me, uint16_t listen_port, RankDaemon* daemon)
-    : me_(me), daemon_(daemon) {
-  listen_fd_ = make_server(listen_port);
-  threads_.emplace_back([this] { accept_loop(); });
+static int make_udp_server(uint16_t port) {
+  int fd = ::socket(AF_INET, SOCK_DGRAM, 0);
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  int buf = 8 << 20;
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof buf);
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof buf);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0) {
+    perror("bind");
+    exit(1);
+  }
+  return fd;
+}
+
+EthFabric::EthFabric(uint32_t me, uint16_t listen_port, RankDaemon* daemon,
+                     bool udp)
+    : me_(me), daemon_(daemon), udp_(udp) {
+  if (udp_) {
+    listen_fd_ = make_udp_server(listen_port);
+    threads_.emplace_back([this] { udp_recv_loop(); });
+  } else {
+    listen_fd_ = make_server(listen_port);
+    threads_.emplace_back([this] { accept_loop(); });
+  }
 }
 
 EthFabric::~EthFabric() { stop(); }
@@ -993,6 +1053,128 @@ void EthFabric::stop() {
   ::close(listen_fd_);
   std::lock_guard<std::mutex> lk(mu_);
   for (auto& kv : peers_) ::close(kv.second);
+  for (auto& kv : dqs_) {
+    {
+      std::lock_guard<std::mutex> qlk(kv.second->mu);
+      kv.second->stop = true;
+    }
+    kv.second->cv.notify_all();
+  }
+}
+
+std::vector<uint8_t> EthFabric::encode_eth(
+    const Envelope& env, const std::vector<uint8_t>& payload,
+    bool with_msg_byte) {
+  std::vector<uint8_t> body;
+  if (with_msg_byte) body.push_back(MSG_ETH);
+  put_le<uint32_t>(body, env.src);
+  put_le<uint32_t>(body, env.dst);
+  put_le<uint32_t>(body, env.tag);
+  put_le<uint32_t>(body, env.seqn);
+  put_le<uint32_t>(body, env.comm_id);
+  body.push_back(env.strm);
+  body.push_back(env.dtype);
+  put_le<uint64_t>(body, env.nbytes);
+  body.insert(body.end(), payload.begin(), payload.end());
+  return body;
+}
+
+bool EthFabric::decode_eth(const uint8_t* p, size_t len, Envelope& env,
+                           std::vector<uint8_t>& payload) {
+  if (len < 30) return false;
+  env.src = get_le<uint32_t>(p);
+  env.dst = get_le<uint32_t>(p + 4);
+  env.tag = get_le<uint32_t>(p + 8);
+  env.seqn = get_le<uint32_t>(p + 12);
+  env.comm_id = get_le<uint32_t>(p + 16);
+  env.strm = p[20];
+  env.dtype = p[21];
+  env.nbytes = get_le<uint64_t>(p + 22);
+  payload.assign(p + 30, p + len);
+  return true;
+}
+
+// ---- udp packetizer/reassembly (udp_packetizer + rxbuf_session parity) ----
+void EthFabric::udp_recv_loop() {
+  std::vector<uint8_t> dgram(kMaxPkt + 12 + 64);
+  for (;;) {
+    ssize_t n = ::recvfrom(listen_fd_, dgram.data(), dgram.size(), 0,
+                           nullptr, nullptr);
+    if (n < 0) {
+      if (errno == EINTR) continue;  // signal must not kill the fabric
+      return;                        // socket closed
+    }
+    if (static_cast<size_t>(n) < 12) continue;
+    udp_handle(dgram.data(), static_cast<size_t>(n));
+  }
+}
+
+void EthFabric::udp_handle(const uint8_t* dgram, size_t len) {
+  uint32_t sender = get_le<uint32_t>(dgram);
+  uint32_t msg_id = get_le<uint32_t>(dgram + 4);
+  uint16_t idx = get_le<uint16_t>(dgram + 8);
+  uint16_t nfrags = get_le<uint16_t>(dgram + 10);
+  if (nfrags == 0) return;
+  double now = std::chrono::duration<double>(
+      std::chrono::steady_clock::now().time_since_epoch()).count();
+  auto key = std::make_pair(sender, msg_id);
+  auto& part = partial_[key];
+  if (part.frags.empty()) {
+    part.deadline = now + kPartialTtl;
+    part.nfrags = nfrags;
+  }
+  part.frags[idx].assign(dgram + 12, dgram + len);
+  if (part.frags.size() == part.nfrags) {
+    std::vector<uint8_t> frame;
+    for (auto& kv : part.frags)
+      frame.insert(frame.end(), kv.second.begin(), kv.second.end());
+    partial_.erase(key);
+    Envelope env;
+    std::vector<uint8_t> payload;
+    if (decode_eth(frame.data(), frame.size(), env, payload))
+      deliver(env.src, std::move(env), std::move(payload));
+  }
+  // GC stale partials (lost fragments must not leak)
+  for (auto it = partial_.begin(); it != partial_.end();) {
+    if (it->second.deadline < now) it = partial_.erase(it);
+    else ++it;
+  }
+}
+
+void EthFabric::deliver(uint32_t sender, Envelope&& env,
+                        std::vector<uint8_t>&& payload) {
+  DeliverQ* dq;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto& slot = dqs_[sender];
+    if (!slot) {
+      slot = std::make_unique<DeliverQ>();
+      DeliverQ* p = slot.get();
+      threads_.emplace_back([this, p] {
+        for (;;) {
+          std::pair<Envelope, std::vector<uint8_t>> item;
+          {
+            std::unique_lock<std::mutex> qlk(p->mu);
+            p->cv.wait(qlk, [p] { return p->stop || !p->q.empty(); });
+            if (p->stop && p->q.empty()) return;
+            item = std::move(p->q.front());
+            p->q.pop_front();
+          }
+          daemon_->ingest(item.first, std::move(item.second));
+        }
+      });
+    }
+    dq = slot.get();
+  }
+  {
+    std::lock_guard<std::mutex> qlk(dq->mu);
+    // bounded queue: DROP beyond the depth limit (UDP semantics — no
+    // flow control here; unbounded growth would exhaust memory while the
+    // rx pool is full). Drops surface as receive timeouts upstream.
+    if (dq->q.size() >= 64) return;
+    dq->q.emplace_back(std::move(env), std::move(payload));
+  }
+  dq->cv.notify_one();
 }
 
 void EthFabric::accept_loop() {
@@ -1009,24 +1191,47 @@ void EthFabric::recv_loop(int fd) {
   std::vector<uint8_t> body;
   while (recv_frame(fd, body)) {
     if (body.empty() || body[0] != MSG_ETH) continue;
-    const uint8_t* p = body.data() + 1;
     Envelope env;
-    env.src = get_le<uint32_t>(p);
-    env.dst = get_le<uint32_t>(p + 4);
-    env.tag = get_le<uint32_t>(p + 8);
-    env.seqn = get_le<uint32_t>(p + 12);
-    env.comm_id = get_le<uint32_t>(p + 16);
-    env.strm = p[20];
-    env.dtype = p[21];
-    env.nbytes = get_le<uint64_t>(p + 22);
-    std::vector<uint8_t> payload(body.begin() + 31, body.end());
-    daemon_->ingest(env, std::move(payload));
+    std::vector<uint8_t> payload;
+    if (decode_eth(body.data() + 1, body.size() - 1, env, payload))
+      daemon_->ingest(env, std::move(payload));
   }
   ::close(fd);
 }
 
 bool EthFabric::send_msg(const Envelope& env,
                          const std::vector<uint8_t>& payload) {
+  if (udp_) {
+    // fragment at kMaxPkt with the shared 12B header; frame excludes the
+    // MSG_ETH type byte (datagram boundaries replace stream framing)
+    std::vector<uint8_t> frame = encode_eth(env, payload, false);
+    sockaddr_in addr{};
+    uint32_t msg_id;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto ait = peer_addrs_.find(env.dst);
+      if (ait == peer_addrs_.end()) return false;
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(ait->second.second);
+      inet_pton(AF_INET, ait->second.first.c_str(), &addr.sin_addr);
+      msg_id = next_msg_id_++;
+    }
+    size_t nfrags = frame.empty() ? 1 : (frame.size() + kMaxPkt - 1) / kMaxPkt;
+    for (size_t i = 0; i < nfrags; ++i) {
+      std::vector<uint8_t> pkt;
+      put_le<uint32_t>(pkt, me_);
+      put_le<uint32_t>(pkt, msg_id);
+      put_le<uint16_t>(pkt, static_cast<uint16_t>(i));
+      put_le<uint16_t>(pkt, static_cast<uint16_t>(nfrags));
+      size_t lo = i * kMaxPkt;
+      size_t hi = std::min(frame.size(), lo + kMaxPkt);
+      pkt.insert(pkt.end(), frame.begin() + lo, frame.begin() + hi);
+      if (::sendto(listen_fd_, pkt.data(), pkt.size(), 0,
+                   reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0)
+        return false;
+    }
+    return true;
+  }
   int fd;
   std::mutex* peer_mu;
   {
@@ -1054,16 +1259,7 @@ bool EthFabric::send_msg(const Envelope& env,
     peer_mu = peer_mus_[env.dst].get();
   }
   std::lock_guard<std::mutex> plk(*peer_mu);
-  std::vector<uint8_t> body{MSG_ETH};
-  put_le<uint32_t>(body, env.src);
-  put_le<uint32_t>(body, env.dst);
-  put_le<uint32_t>(body, env.tag);
-  put_le<uint32_t>(body, env.seqn);
-  put_le<uint32_t>(body, env.comm_id);
-  body.push_back(env.strm);
-  body.push_back(env.dtype);
-  put_le<uint64_t>(body, env.nbytes);
-  body.insert(body.end(), payload.begin(), payload.end());
+  std::vector<uint8_t> body = encode_eth(env, payload, true);
   return send_frame(fd, body);
 }
 
@@ -1221,6 +1417,7 @@ int main(int argc, char** argv) {
   uint32_t rank = 0, world = 1;
   uint16_t port_base = 45000;
   size_t nbufs = 16, bufsize = 1 << 20;
+  bool udp = false;
   for (int i = 1; i + 1 < argc; i += 2) {
     std::string k = argv[i];
     const char* v = argv[i + 1];
@@ -1229,7 +1426,8 @@ int main(int argc, char** argv) {
     else if (k == "--port-base") port_base = atoi(v);
     else if (k == "--nbufs") nbufs = atoi(v);
     else if (k == "--bufsize") bufsize = atoll(v);
+    else if (k == "--stack") udp = (std::string(v) == "udp");
   }
-  RankDaemon daemon(rank, world, port_base, nbufs, bufsize);
+  RankDaemon daemon(rank, world, port_base, nbufs, bufsize, udp);
   return daemon.serve(static_cast<uint16_t>(port_base + rank));
 }

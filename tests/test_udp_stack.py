@@ -68,18 +68,161 @@ def test_udp_tagged_sendrecv(udp_world):
     assert run_ranks(udp_world, body)[2] == (3.0, 4.0)
 
 
-def test_udp_fragment_header_roundtrip():
-    """Unit: the fragment chopping math covers exact-multiple and ragged
-    tails."""
+def test_udp_send_fragments_reassemble_exactly():
+    """Unit: drive the REAL UdpEthFabric.send against a stub socket and
+    feed its datagrams (shuffled) back through the real reassembly path —
+    header packing, chopping, and ordering are all exercised end to end."""
     import struct
 
-    fmt = UdpEthFabric._FRAG_FMT
-    for total in (1, UdpEthFabric.MAX_PKT, UdpEthFabric.MAX_PKT + 1,
-                  3 * UdpEthFabric.MAX_PKT):
-        n_frags = max(1, -(-total // UdpEthFabric.MAX_PKT))
-        sizes = [len(range(i * UdpEthFabric.MAX_PKT,
-                           min((i + 1) * UdpEthFabric.MAX_PKT, total)))
-                 for i in range(n_frags)]
-        assert sum(sizes) == total
-        hdr = struct.pack(fmt, 1, 42, 0, n_frags)
-        assert struct.unpack(fmt, hdr) == (1, 42, 0, n_frags)
+    from accl_tpu.emulator.fabric import Envelope
+
+    received = []
+    fab = UdpEthFabric.__new__(UdpEthFabric)  # no socket bind
+    import threading
+    import time as _t
+    fab.me = 0
+    fab.ingest = lambda env, payload: received.append((env, payload))
+    fab._time = _t
+    fab._peer_addrs = {1: ("127.0.0.1", 5)}
+    fab._lock = threading.Lock()
+    fab._msg_id = 7
+    fab._partial = {}
+    fab._queues = {}
+    fab._closing = False
+
+    sent = []
+
+    class StubSock:
+        def sendto(self, data, addr):
+            sent.append((bytes(data), addr))
+
+    fab._sock = StubSock()
+
+    for total in (1, UdpEthFabric.MAX_PKT - 30, UdpEthFabric.MAX_PKT,
+                  3 * UdpEthFabric.MAX_PKT + 17):
+        sent.clear()
+        received.clear()
+        payload = bytes(range(256)) * (total // 256) + bytes(total % 256)
+        env = Envelope(src=0, dst=1, tag=3, seqn=9, nbytes=len(payload),
+                       wire_dtype="float32")
+        fab.send(env, payload)
+        hdr_len = struct.calcsize(UdpEthFabric._FRAG_FMT)
+        # every datagram within MTU, total bytes conserved
+        assert all(len(d) <= UdpEthFabric.MAX_PKT + hdr_len
+                   for d, _ in sent)
+        # replay out of order through the real reassembly; delivery goes
+        # through the per-sender queue, so drain it synchronously
+        fab._deliver_q = lambda sender: None  # bypass worker thread
+        frames = [d for d, _ in sent]
+        frames.reverse()
+        for d in frames[:-1]:
+            fab._on_datagram(d, hdr_len)
+            assert not received  # incomplete -> nothing ingested
+        # last fragment completes the message; patch deliver to be direct
+        def direct(sender):
+            class Q:
+                @staticmethod
+                def put_nowait(item):
+                    received.append(item)
+            return Q
+        fab._deliver_q = direct
+        fab._on_datagram(frames[-1], hdr_len)
+        assert len(received) == 1
+        got_env, got_payload = received[0]
+        assert got_payload == payload
+        assert (got_env.src, got_env.tag, got_env.seqn) == (0, 3, 9)
+
+
+def _native_binary():
+    import os
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "cclo_emud")
+
+
+def test_udp_native_daemon():
+    """The C++ daemon's UDP stack: same fragment wire format, driven by
+    the same tests."""
+    import os
+    import subprocess
+    import time
+
+    from accl_tpu.testing import free_port_base
+
+    binary = _native_binary()
+    if not os.path.exists(binary):
+        pytest.skip("native daemon not built (make -C native)")
+    W = 3
+    port_base = free_port_base()
+    procs = [subprocess.Popen(
+        [binary, "--rank", str(r), "--world", str(W),
+         "--port-base", str(port_base), "--stack", "udp"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(W)]
+    try:
+        time.sleep(0.5)
+        accls = connect_world(port_base, W, timeout=20.0)
+        n = 32 << 10  # 128 KiB -> ~94 fragments
+        ins = [np.random.default_rng(r).standard_normal(n)
+               .astype(np.float32) for r in range(W)]
+
+        def body(a):
+            src = a.buffer(data=ins[a.rank].copy())
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)
+            np.testing.assert_allclose(dst.data, np.sum(ins, 0), atol=1e-4)
+            return True
+
+        assert all(run_ranks(accls, body, timeout=120.0))
+        for a in accls:
+            a.deinit()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+
+
+def test_udp_mixed_python_cpp_world():
+    """Wire-format interop: rank 0 = C++ daemon, ranks 1-2 = Python
+    daemons, all over UDP — the dual-implementation property the protocol
+    docs promise."""
+    import os
+    import subprocess
+    import threading
+    import time
+
+    from accl_tpu.emulator.daemon import RankDaemon
+    from accl_tpu.testing import free_port_base
+
+    binary = _native_binary()
+    if not os.path.exists(binary):
+        pytest.skip("native daemon not built (make -C native)")
+    W = 3
+    port_base = free_port_base()
+    cpp = subprocess.Popen(
+        [binary, "--rank", "0", "--world", str(W),
+         "--port-base", str(port_base), "--stack", "udp"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    py_daemons = [RankDaemon(r, W, port_base, stack="udp")
+                  for r in (1, 2)]
+    for d in py_daemons:
+        threading.Thread(target=d.serve_forever, daemon=True).start()
+    try:
+        time.sleep(0.5)
+        accls = connect_world(port_base, W, timeout=20.0)
+        n = 4096  # ~12 fragments
+        def body(a):
+            src = a.buffer(
+                data=np.full(n, float(a.rank + 1), np.float32))
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)
+            return float(dst.data[0])
+
+        assert all(r == 6.0 for r in run_ranks(accls, body, timeout=60.0))
+        for a in accls:
+            a.deinit()
+    finally:
+        cpp.terminate()
+        cpp.wait(timeout=10)
+        for d in py_daemons:
+            d.shutdown()
