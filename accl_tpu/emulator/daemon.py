@@ -239,12 +239,16 @@ class UdpEthFabric:
         return q
 
     def close(self):
+        import queue as _queue
         with self._lock:
             self._closing = True
             queues = list(self._queues.values())
         self._sock.close()
         for q in queues:
-            q.put(None)
+            try:
+                q.put_nowait(None)   # a FULL bounded queue must not hang
+            except _queue.Full:      # shutdown; its daemon worker dies
+                pass                 # with the process
 
 
 class RankDaemon:

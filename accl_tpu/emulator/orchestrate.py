@@ -268,7 +268,8 @@ def build_native(log) -> bool:
     return proc.returncode == 0
 
 
-def launch_daemons(world: int, backend: str, port_base: int, log):
+def launch_daemons(world: int, backend: str, port_base: int, log,
+                   stack: str = "tcp"):
     env = {k: v for k, v in os.environ.items()
            if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -280,7 +281,7 @@ def launch_daemons(world: int, backend: str, port_base: int, log):
     for r in range(world):
         procs.append(subprocess.Popen(
             argv0 + ["--rank", str(r), "--world", str(world),
-                     "--port-base", str(port_base)],
+                     "--port-base", str(port_base), "--stack", stack],
             env=env, stdout=log, stderr=subprocess.STDOUT))
     return procs
 
@@ -297,7 +298,7 @@ def stop_daemons(procs):
 
 
 def run_one(name: str, world: int, backend: str, timeout: float,
-            log_path: str) -> tuple[bool, float, str]:
+            log_path: str, stack: str = "tcp") -> tuple[bool, float, str]:
     """Fresh world -> connect -> run -> teardown, under a wall-clock budget.
 
     Returns (ok, seconds, detail). Parity: run_test (test_all.py:152-181).
@@ -308,7 +309,7 @@ def run_one(name: str, world: int, backend: str, timeout: float,
     with open(log_path, "w") as log:
         for attempt in range(3):
             port_base = free_port_base(span=2 * world + 8)
-            procs = launch_daemons(world, backend, port_base, log)
+            procs = launch_daemons(world, backend, port_base, log, stack)
             accls = []
             try:
                 with concurrent.futures.ThreadPoolExecutor(1) as pool:
@@ -356,6 +357,9 @@ def main(argv=None) -> int:
                     choices=sorted(TESTS))
     ap.add_argument("--timeout", type=float, default=120.0,
                     help="per-test wall-clock budget (s)")
+    ap.add_argument("--stack", choices=["tcp", "udp"], default="tcp",
+                    help="eth fabric between rank daemons (dual-stack "
+                         "parity: reference use_tcp/use_udp)")
     ap.add_argument("--log-dir", default="/tmp/accl_tpu_orchestrate")
     args = ap.parse_args(argv)
 
@@ -371,15 +375,18 @@ def main(argv=None) -> int:
                 backends = [b for b in backends if b != "native"]
 
     failures = 0
-    print(f"{'backend':<8}{'test':<24}{'result':<10}{'secs':>8}")
+    print(f"{'backend':<8}{'stack':<6}{'test':<24}{'result':<10}{'secs':>8}")
     for backend in backends:
         for name in args.tests:
-            log_path = os.path.join(args.log_dir, f"{backend}_{name}.log")
+            log_path = os.path.join(
+                args.log_dir, f"{backend}_{args.stack}_{name}.log")
             ok, secs, detail = run_one(name, args.world, backend,
-                                       args.timeout, log_path)
+                                       args.timeout, log_path,
+                                       stack=args.stack)
             failures += 0 if ok else 1
             status = "ok" if ok else f"FAIL"
-            print(f"{backend:<8}{name:<24}{status:<10}{secs:>8.2f}"
+            print(f"{backend:<8}{args.stack:<6}{name:<24}{status:<10}"
+                  f"{secs:>8.2f}"
                   + (f"  {detail} [{log_path}]" if not ok else ""))
     print(f"\n{failures} failure(s); logs in {args.log_dir}")
     return 1 if failures else 0

@@ -352,6 +352,8 @@ class EthFabric {
   // header, so mixed C++/Python worlds interoperate on either stack)
   static constexpr size_t kMaxPkt = 1408;        // reference MTU 1536B
   static constexpr double kPartialTtl = 30.0;    // GC for lost fragments
+  static constexpr size_t kQueueDepth = 64;      // per-sender delivery
+  // bound, must match Python UdpEthFabric.QUEUE_DEPTH (mixed worlds)
 
   EthFabric(uint32_t me, uint16_t listen_port, RankDaemon* daemon,
             bool udp = false);
@@ -1171,7 +1173,7 @@ void EthFabric::deliver(uint32_t sender, Envelope&& env,
     // bounded queue: DROP beyond the depth limit (UDP semantics — no
     // flow control here; unbounded growth would exhaust memory while the
     // rx pool is full). Drops surface as receive timeouts upstream.
-    if (dq->q.size() >= 64) return;
+    if (dq->q.size() >= kQueueDepth) return;
     dq->q.emplace_back(std::move(env), std::move(payload));
   }
   dq->cv.notify_one();

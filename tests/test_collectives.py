@@ -189,3 +189,40 @@ def test_ring_allreduce_fp8_wire():
         out_specs=P("r", None)))(x))
     assert (np.abs(out16[0] - golden).mean()
             <= np.abs(out[0] - golden).mean() + 1e-6)
+
+
+def test_fused_stream_collective_single_program():
+    """The TPU-tier analog of ACCL's streaming operands (OP0/RES on an AXIS
+    stream to a user kernel): producer compute, ring allreduce, and
+    consumer compute fused into ONE jitted shard_map program — no
+    materialized host buffer between stages, one XLA executable."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from accl_tpu.parallel.collectives import ring_allreduce_shard
+
+    devs = jax.devices()[:4]
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = Mesh(np.asarray(devs), ("r",))
+    W, n = 4, 128
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((W, n))
+                    .astype(np.float32))
+
+    def fused(s):
+        produced = jnp.tanh(s[0]) * 2.0               # producer "kernel"
+        summed = ring_allreduce_shard(produced, "r")  # collective
+        return jax.nn.relu(summed - 1.0)[None]        # consumer "kernel"
+
+    prog = jax.jit(jax.shard_map(fused, mesh=mesh, in_specs=P("r", None),
+                                 out_specs=P("r", None)))
+    out = np.asarray(prog(x))
+    golden = np.maximum(np.sum(np.tanh(np.asarray(x)) * 2.0, axis=0) - 1.0,
+                        0.0)
+    np.testing.assert_allclose(out[0], golden, rtol=1e-5, atol=1e-6)
+    # one compiled executable containing the whole pipeline: producer op
+    # and ring permutes live in the same module
+    hlo = prog.lower(x).compile().as_text().lower()
+    assert "tanh" in hlo
+    assert "collective-permute" in hlo or "collective_permute" in hlo

@@ -179,7 +179,11 @@ def test_udp_native_daemon():
         for p in procs:
             p.terminate()
         for p in procs:
-            p.wait(timeout=10)
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
 
 
 def test_udp_mixed_python_cpp_world():
@@ -223,6 +227,10 @@ def test_udp_mixed_python_cpp_world():
             a.deinit()
     finally:
         cpp.terminate()
-        cpp.wait(timeout=10)
+        try:
+            cpp.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            cpp.kill()
+            cpp.wait()
         for d in py_daemons:
             d.shutdown()
