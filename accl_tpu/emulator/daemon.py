@@ -245,10 +245,18 @@ class UdpEthFabric:
             queues = list(self._queues.values())
         self._sock.close()
         for q in queues:
-            try:
-                q.put_nowait(None)   # a FULL bounded queue must not hang
-            except _queue.Full:      # shutdown; its daemon worker dies
-                pass                 # with the process
+            # drain-then-sentinel: a FULL bounded queue must neither hang
+            # shutdown (blocking put) nor swallow the sentinel (which would
+            # leak the drain thread and its queued payloads forever)
+            while True:
+                try:
+                    q.put_nowait(None)
+                    break
+                except _queue.Full:
+                    try:
+                        q.get_nowait()
+                    except _queue.Empty:
+                        pass
 
 
 class RankDaemon:

@@ -1,0 +1,12 @@
+"""Utilities: checkpointing, deterministic seeding, small helpers.
+
+The reference has no checkpoint/resume (SURVEY §5 — it is a stateless
+library whose state is reconstructible config). The training-framework
+layer this rebuild adds on top (models/, parallel/) is NOT stateless, so
+checkpointing is provided here as a first-class utility over orbax.
+"""
+
+from .checkpoint import (CheckpointManager, load_checkpoint,
+                         save_checkpoint)
+
+__all__ = ["CheckpointManager", "load_checkpoint", "save_checkpoint"]

@@ -1,0 +1,105 @@
+"""Checkpoint/resume over orbax: params + optimizer state + step.
+
+Design notes (TPU-first):
+  * orbax handles sharded jax.Arrays natively — a pytree saved from a
+    dp x tp mesh restores onto the same (or a compatible) mesh without
+    gathering to host, which is what makes multi-host checkpointing
+    feasible at Llama-8B scale (BASELINE config 5).
+  * Saves are atomic (orbax writes to a temp dir and renames), so a
+    preempted save never corrupts the latest good step.
+  * The manager keeps ``max_to_keep`` steps, mirroring standard training
+    harness behavior.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+__all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint"]
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+class CheckpointManager:
+    """Step-indexed checkpoint directory with retention.
+
+    Usage::
+
+        mgr = CheckpointManager("/ckpts/run1", max_to_keep=3)
+        mgr.save(step, {"params": params, "opt_state": opt_state})
+        restored = mgr.restore(target={"params": params0,
+                                       "opt_state": opt_state0})
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        ocp = _ocp()
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+
+    def save(self, step: int, tree: Any, wait: bool = True):
+        ocp = _ocp()
+        self._mgr.save(step, args=ocp.args.StandardSave(tree))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, step: int | None = None, target: Any = None) -> Any:
+        """Restore ``step`` (default: latest). ``target`` provides the
+        pytree structure/shardings to restore into — pass the abstract or
+        concrete state so sharded arrays land on their devices."""
+        ocp = _ocp()
+        if step is None:
+            step = self._mgr.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        if target is not None:
+            import jax
+
+            abstract = jax.tree.map(_abstractify, target)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        return self._mgr.restore(step)
+
+    def close(self):
+        self._mgr.close()
+
+
+def _abstractify(x):
+    import jax
+    import numpy as np
+
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                    sharding=x.sharding)
+    if isinstance(x, np.ndarray):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return x
+
+
+def save_checkpoint(path: str, tree: Any):
+    """One-shot atomic save of a pytree to ``path``."""
+    ocp = _ocp()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), tree)
+
+
+def load_checkpoint(path: str, target: Any = None) -> Any:
+    """One-shot load; ``target`` supplies structure/shardings."""
+    ocp = _ocp()
+    import jax
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is not None:
+            abstract = jax.tree.map(_abstractify, target)
+            return ckptr.restore(os.path.abspath(path), abstract)
+        return ckptr.restore(os.path.abspath(path))

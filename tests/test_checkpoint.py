@@ -1,0 +1,91 @@
+"""Checkpoint/resume tests (accl_tpu/utils/checkpoint.py).
+
+The reference is stateless (SURVEY §5: checkpoint/resume — none); the
+training layer here is not, so save/restore of sharded train state is a
+required capability: a resumed run must be bit-identical to an unbroken
+one.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accl_tpu.utils import CheckpointManager, load_checkpoint, save_checkpoint
+
+
+def test_one_shot_roundtrip(tmp_path):
+    tree = {"w": jnp.arange(12.0).reshape(3, 4),
+            "nested": {"b": jnp.ones(5, jnp.bfloat16)},
+            "step": jnp.asarray(7)}
+    save_checkpoint(str(tmp_path / "ck"), tree)
+    out = load_checkpoint(str(tmp_path / "ck"), target=tree)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+    assert out["nested"]["b"].dtype == jnp.bfloat16
+    assert int(out["step"]) == 7
+
+
+def test_manager_retention_and_latest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "run"), max_to_keep=2)
+    tree = {"w": jnp.zeros(4)}
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": jnp.full(4, float(step))})
+    assert mgr.latest_step() == 3
+    out = mgr.restore(target=tree)
+    assert float(np.asarray(out["w"])[0]) == 3.0
+    # retention: step 1 evicted
+    with pytest.raises(Exception):
+        mgr.restore(step=1, target=tree)
+    mgr.close()
+
+
+def test_sharded_state_resume_identical(tmp_path):
+    """Train 4 steps; checkpoint at step 2; resume and confirm steps 3-4
+    reproduce the unbroken run exactly (sharded params over a dp mesh)."""
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()[:4]
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = Mesh(np.asarray(devs), ("dp",))
+
+    w0 = jax.device_put(jnp.arange(16.0).reshape(4, 4),
+                        NamedSharding(mesh, P("dp", None)))
+    opt = optax.adam(1e-1)
+
+    def loss(w, x):
+        return jnp.sum((w @ x) ** 2)
+
+    @jax.jit
+    def step(w, s, x):
+        g = jax.grad(loss)(w, x)
+        u, s = opt.update(g, s, w)
+        return optax.apply_updates(w, u), s
+
+    xs = [jnp.asarray(np.random.default_rng(i).standard_normal((4,))
+                      .astype(np.float32)) for i in range(4)]
+
+    # unbroken run
+    w, s = w0, opt.init(w0)
+    for x in xs:
+        w, s = step(w, s, x)
+    golden = np.asarray(w)
+
+    # run to step 2, checkpoint, restore into fresh state, continue
+    w, s = w0, opt.init(w0)
+    for x in xs[:2]:
+        w, s = step(w, s, x)
+    mgr = CheckpointManager(str(tmp_path / "resume"))
+    mgr.save(2, {"w": w, "opt": s})
+
+    # the target supplies structure AND shardings: use the live state (its
+    # leaves carry the jitted computation's consistent device placement)
+    restored = mgr.restore(target={"w": w, "opt": s})
+    w2, s2 = restored["w"], restored["opt"]
+    assert w2.sharding.is_equivalent_to(w0.sharding, w0.ndim)
+    for x in xs[2:]:
+        w2, s2 = step(w2, s2, x)
+    np.testing.assert_array_equal(np.asarray(w2), golden)
+    mgr.close()
