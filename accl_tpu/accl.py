@@ -50,15 +50,19 @@ class ACCL:
         self.arith_registry = (arith_registry if arith_registry is not None
                                else dict(DEFAULT_ARITH_CONFIGS))
         self.communicators: list[Communicator] = []
-        device.set_timeout(timeout)
-        device.configure_communicator(comm)
-        self.communicators.append(comm)
-        if max_segment_size is None:
-            max_segment_size = device.preferred_segment_size()
-        device.set_max_segment_size(max_segment_size)
         self._barrier_buf: ACCLBuffer | None = None
         self._scratch_bufs: dict[tuple[int, str], ACCLBuffer] = {}
         self.profiler = Profiler()
+        device.configure_communicator(comm)
+        self.communicators.append(comm)
+        # bring-up sequence through the call path, mirroring the reference
+        # driver init: set_timeout, enable_pkt, set_max_segment_size
+        # (accl.py:374-380 <-> ccl_offload_control.c:1248-1279)
+        self.set_timeout(timeout)
+        self._config_call(CfgFunc.enable_pkt, 1)
+        if max_segment_size is None:
+            max_segment_size = device.preferred_segment_size()
+        self.set_max_segment_size(max_segment_size)
 
     def _scratch(self, count: int, dtype) -> ACCLBuffer:
         """Reusable internal scratch buffer (e.g. gather relay)."""
@@ -80,11 +84,47 @@ class ACCL:
     def world_size(self) -> int:
         return self.comm.size
 
+    def _config_call(self, fn: CfgFunc, value: int, comm_id: int = 0):
+        """Issue an ACCL_CONFIG call through the full call path: the
+        backend — not just the host — sees and applies the subfunction
+        (reference: case ACCL_CONFIG, ccl_offload_control.c:1240-1283).
+        Subfunction rides in ``tag``, value in ``count``."""
+        self._call(CallDescriptor(CCLOp.config, count=int(value),
+                                  comm_id=comm_id, tag=int(fn)),
+                   run_async=False, waitfor=())
+
     def set_timeout(self, timeout: float):
-        self.device.set_timeout(timeout)
+        self._config_call(CfgFunc.set_timeout, int(round(timeout * 1000)))
+        # client-side wait-budget bookkeeping (the SimDevice poll loop and
+        # the in-process workers keep their own copy of the deadline)
+        self.device.timeout = timeout
 
     def set_max_segment_size(self, nbytes: int):
-        self.device.set_max_segment_size(nbytes)
+        self._config_call(CfgFunc.set_max_segment_size, int(nbytes))
+
+    def open_port(self):
+        """Verify/arm the fabric listener (openPort parity, c:168-181)."""
+        self._config_call(CfgFunc.open_port, 0)
+
+    def init_connection(self, comm: Communicator | None = None):
+        """Eagerly open sessions to every peer of ``comm`` (reference
+        init_connection = open_port + open_con, accl.py driver bring-up;
+        openCon c:109-165). Without it, the socket fabric dials lazily on
+        first send — this pre-establishes, like the reference's TCP stack.
+        """
+        comm = comm or self.comm
+        self._config_call(CfgFunc.open_port, 0, comm_id=comm.comm_id)
+        self._config_call(CfgFunc.open_con, 0, comm_id=comm.comm_id)
+
+    def close_connections(self):
+        self._config_call(CfgFunc.close_con, 0)
+
+    def set_stack_type(self, stack: str):
+        """Runtime transport-stack select (HOUSEKEEP_SET_STACK_TYPE parity,
+        c:1270-1272): 'tcp' or 'udp'. Every rank must switch while the
+        fabric is quiesced."""
+        code = {"tcp": 0, "udp": 1}[stack]
+        self._config_call(CfgFunc.set_stack_type, code)
 
     def split_communicator(self, members: Sequence[int],
                            key: int = 0) -> Communicator:
@@ -101,23 +141,22 @@ class ACCL:
         return sub
 
     def soft_reset(self):
-        self.device.soft_reset()
+        """Rank-local soft reset through the call path (HOUSEKEEP_SWRST
+        parity, c:1244-1247): drains the rx pool and zeroes seqnos."""
+        self._config_call(CfgFunc.reset_periph, 0)
 
     # -- profiling (parity: start/end_profiling cfg calls,
     #    xlnx-consts.hpp:27-28; SURVEY §5 tracing subsystem) ----------------
     def start_profiling(self):
         """Enable per-call timing capture. Issues the config call through
-        the full call path (so backends see it, like the reference's cfg
-        subfunction), then arms the host-side recorder."""
-        self._call(CallDescriptor(CCLOp.config, count=0,
-                                  tag=int(CfgFunc.start_profiling)),
-                   run_async=False, waitfor=())
+        the full call path (backends arm their own counters — the socket
+        daemons' profiled-call counts are visible via get_info), then arms
+        the host-side recorder."""
+        self._config_call(CfgFunc.start_profiling, 0)
         self.profiler.start()
 
     def end_profiling(self):
-        self._call(CallDescriptor(CCLOp.config, count=0,
-                                  tag=int(CfgFunc.end_profiling)),
-                   run_async=False, waitfor=())
+        self._config_call(CfgFunc.end_profiling, 0)
         self.profiler.stop()
 
     def deinit(self):

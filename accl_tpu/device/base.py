@@ -62,3 +62,47 @@ class Device(abc.ABC):
 
     def deinit(self):
         """Release backend resources (driver deinit, accl.py:421-433)."""
+
+    # -- runtime config calls ----------------------------------------------
+    def segment_size_bound(self) -> int | None:
+        """Upper bound a config call may set the segment size to; None =
+        unbounded (the emulator bounds it by its rx buffer size, mirroring
+        segments-must-fit-spare-buffers, reference accl.py:660-667)."""
+        return None
+
+    def apply_config(self, desc: CallDescriptor) -> int:
+        """Shared ACCL_CONFIG dispatch for in-process backends
+        (c:1240-1283): subfunction in ``tag``, value in ``count`` (ms for
+        timeout, bytes for segment size). The in-process fabrics have no
+        ports/sessions/stack to manage, so the connection subfunctions
+        succeed as no-ops — like the reference's loopback builds where the
+        dummy stack always accepts. The socket daemons implement the full
+        surface (emulator/daemon.py, native/cclo_emud.cpp)."""
+        from ..constants import CfgFunc, ErrorCode
+        try:
+            fn = CfgFunc(desc.tag)
+        except ValueError:
+            return int(ErrorCode.INVALID_CALL)
+        val = int(desc.count)
+        if fn == CfgFunc.reset_periph:
+            self.soft_reset()
+            return 0
+        if fn == CfgFunc.set_timeout:
+            self.set_timeout(val / 1000.0)
+            return 0
+        if fn == CfgFunc.set_max_segment_size:
+            bound = self.segment_size_bound()
+            if bound is not None and val > bound:
+                return int(ErrorCode.DMA_SIZE_ERROR)
+            self.max_segment_size = val
+            return 0
+        if fn == CfgFunc.start_profiling:
+            self.profiling = True
+            return 0
+        if fn == CfgFunc.end_profiling:
+            self.profiling = False
+            return 0
+        if fn in (CfgFunc.enable_pkt, CfgFunc.open_port, CfgFunc.open_con,
+                  CfgFunc.close_con, CfgFunc.set_stack_type):
+            return 0
+        return int(ErrorCode.INVALID_CALL)

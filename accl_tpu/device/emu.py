@@ -62,6 +62,7 @@ class EmuDevice(Device):
                                      timeout=DEFAULT_TIMEOUT_S)
         self.timeout = DEFAULT_TIMEOUT_S
         self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
+        self.profiling = False  # armed by the start_profiling config call
         self._calls: queue.Queue = queue.Queue()
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name=f"emu-rank{rank}")
@@ -169,12 +170,18 @@ class EmuDevice(Device):
         if desc.scenario == CCLOp.nop:
             return 0
         if desc.scenario == CCLOp.config:
-            return 0
+            return self.apply_config(desc)  # shared dispatch (Device base)
         comm = self.comms.get(desc.comm_id)
         if comm is None:
             return int(ErrorCode.COMM_NOT_CONFIGURED)
         if desc.arithcfg is None:
             return int(ErrorCode.ARITHCFG_NOT_CONFIGURED)
+        return self._execute_data(desc, comm)
+
+    def segment_size_bound(self) -> int | None:
+        return self.ctx.bufsize  # segments must fit rx buffers
+
+    def _execute_data(self, desc: CallDescriptor, comm: Communicator) -> int:
         ctx = MoveContext(world_size=comm.size,
                           local_rank=comm.local_rank,
                           arithcfg=desc.arithcfg,

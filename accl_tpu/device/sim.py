@@ -115,6 +115,25 @@ class SimDevice(Device):
         reply = self._request(bytes([P.MSG_DUMP_RX]))
         return reply[1:].decode()
 
+    def get_info(self) -> dict:
+        """Daemon geometry + runtime-config state — the readable effect of
+        ACCL_CONFIG calls (extended MSG_GET_INFO reply; older daemons
+        return only the 20-byte geometry prefix)."""
+        reply = self._request(bytes([P.MSG_GET_INFO]))
+        assert reply[0] == P.MSG_DATA
+        base = struct.unpack("<Q3I", reply[1:21])
+        info = {"bufsize": base[0], "nbufs": base[1], "world": base[2],
+                "rank": base[3]}
+        if len(reply) >= 21 + 18:
+            seg, tmo_ms, flags, stack, prof = struct.unpack(
+                "<QIBBI", reply[21:39])
+            info.update(max_segment_size=seg, timeout_ms=tmo_ms,
+                        pkt_enabled=bool(flags & 1),
+                        profiling=bool(flags & 2),
+                        stack="udp" if stack else "tcp",
+                        profiled_calls=prof)
+        return info
+
     def deinit(self):
         self._dispatch_q.put(None)
         try:

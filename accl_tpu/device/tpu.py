@@ -112,6 +112,7 @@ class TpuDevice(Device):
         self.comm: Communicator | None = None
         self.timeout = DEFAULT_TIMEOUT_S
         self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
+        self.profiling = False  # armed by the start_profiling config call
         self._coll_index: dict[int, int] = collections.defaultdict(int)
         self._calls: queue.Queue = queue.Queue()
         self._worker = threading.Thread(target=self._run, daemon=True,
@@ -189,8 +190,10 @@ class TpuDevice(Device):
     # -- execution ---------------------------------------------------------
     def _execute(self, desc: CallDescriptor) -> int:
         op = desc.scenario
-        if op in (CCLOp.nop, CCLOp.config):
+        if op == CCLOp.nop:
             return 0
+        if op == CCLOp.config:
+            return self.apply_config(desc)  # shared dispatch (Device base)
         comm = self.comms.get(desc.comm_id)
         if comm is None:
             return int(ErrorCode.COMM_NOT_CONFIGURED)

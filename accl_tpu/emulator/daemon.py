@@ -18,13 +18,14 @@ import argparse
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
 from ..arith import ArithConfig
 from ..communicator import Communicator, Rank
-from ..constants import (CCLOp, CollectiveAlgorithm, Compression, ErrorCode,
-                         ReduceFunc, StreamFlags)
+from ..constants import (CCLOp, CfgFunc, CollectiveAlgorithm, Compression,
+                         ErrorCode, ReduceFunc, StreamFlags)
 from ..moveengine import MoveContext, expand_call
 from . import protocol as P
 from .executor import DeviceMemory, MoveExecutor, RxBufferPool
@@ -101,7 +102,53 @@ class EthFabric:
         with peer_lock:
             P.send_frame(sock, frame)
 
+    @property
+    def listening(self) -> bool:
+        return self._server.fileno() != -1
+
+    @property
+    def n_connected(self) -> int:
+        with self._lock:
+            return len(self._peers)
+
+    def connect_all(self) -> int:
+        """Eagerly dial every known peer, replacing the lazy per-send dial.
+
+        Parity: the reference's openCon walks the communicator and opens a
+        TCP session per peer before any traffic (ccl_offload_control.c:
+        109-165). Returns an OR-able error word, 0 on success."""
+        with self._lock:
+            targets = {g: a for g, a in self._peer_addrs.items()
+                       if g not in self._peers}
+        err = 0
+        for grank, (host, port) in targets.items():
+            try:
+                sock = socket.create_connection((host, port), timeout=10)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                err |= int(ErrorCode.OPEN_CON_NOT_SUCCEEDED)
+                continue
+            with self._lock:
+                if grank in self._peers:   # lost a dial race with send()
+                    sock.close()
+                else:
+                    self._peers[grank] = (sock, threading.Lock())
+        return err
+
+    def disconnect_all(self):
+        """Close per-peer sessions; send() re-dials lazily afterwards."""
+        with self._lock:
+            peers, self._peers = self._peers, {}
+        for sock, _ in peers.values():
+            sock.close()
+
     def close(self):
+        # shutdown-before-close: a thread blocked in accept() holds a kernel
+        # reference that would keep the port bound after close() alone
+        try:
+            self._server.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         self._server.close()
         for sock, _ in self._peers.values():
             sock.close()
@@ -238,11 +285,32 @@ class UdpEthFabric:
                 threading.Thread(target=drain, daemon=True).start()
         return q
 
+    @property
+    def listening(self) -> bool:
+        return self._sock.fileno() != -1
+
+    @property
+    def n_connected(self) -> int:
+        return 0
+
+    def connect_all(self) -> int:
+        """Datagram stack: no sessions to open (VNx UDP parity — openCon is
+        a TCP-stack concept; the reference's UDP path programs a socket
+        table instead, test_vnx.py:59-77)."""
+        return 0
+
+    def disconnect_all(self):
+        pass
+
     def close(self):
         import queue as _queue
         with self._lock:
             self._closing = True
             queues = list(self._queues.values())
+        try:  # unblock the recvfrom thread so the port frees promptly
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         self._sock.close()
         for q in queues:
             # drain-then-sentinel: a FULL bounded queue must neither hang
@@ -290,6 +358,14 @@ class RankDaemon:
             raise
         self.executor = MoveExecutor(self.mem, self.pool, self.eth.send,
                                      timeout=self.timeout)
+        # runtime config-call state (ACCL_CONFIG parity, c:1240-1283):
+        # pkt engines default-armed so a daemon is usable without the
+        # driver's bring-up sequence; profiling counters are in-daemon,
+        # distinct from the host-side Profiler
+        self.pkt_enabled = True
+        self.profiling = False
+        self.profiled_calls = 0
+        self.profile_time = 0.0
         self._arrays: dict[int, np.ndarray] = {}
         # internal scratch for barrier (1-element allreduce rendezvous);
         # reserved address far above the driver's 4K-aligned bump allocator
@@ -320,7 +396,11 @@ class RankDaemon:
                 if self._stop.is_set():
                     return
                 call_id, c = self._call_queue.pop(0)
+            t0 = time.perf_counter()
             err = self._execute(c)
+            if self.profiling and c["scenario"] != int(CCLOp.config):
+                self.profiled_calls += 1
+                self.profile_time += time.perf_counter() - t0
             with self._call_cv:
                 self._call_status[call_id] = err
                 self._call_cv.notify_all()
@@ -328,8 +408,10 @@ class RankDaemon:
     def _execute(self, c: dict) -> int:
         try:
             scenario = CCLOp(c["scenario"])
-            if scenario in (CCLOp.nop, CCLOp.config):
+            if scenario == CCLOp.nop:
                 return 0
+            if scenario == CCLOp.config:
+                return self._config(c)
             comm = self.comms.get(c["comm_id"])
             if comm is None:
                 return int(ErrorCode.COMM_NOT_CONFIGURED)
@@ -361,6 +443,99 @@ class RankDaemon:
             import traceback
             traceback.print_exc()
             return int(ErrorCode.INVALID_CALL)
+
+    # -- runtime config calls ----------------------------------------------
+    def _config(self, c: dict) -> int:
+        """ACCL_CONFIG through the call path (ccl_offload_control.c:
+        1240-1283): subfunction in ``tag``, value in ``count`` (ms for
+        timeout, bytes for segment size, StackType code for stack select).
+        """
+        try:
+            fn = CfgFunc(c["tag"])
+        except ValueError:
+            return int(ErrorCode.INVALID_CALL)
+        val = int(c["count"])
+        if fn == CfgFunc.reset_periph:
+            self._soft_reset()
+            return 0
+        if fn == CfgFunc.enable_pkt:
+            self.pkt_enabled = True
+            return 0
+        if fn == CfgFunc.set_timeout:
+            self.timeout = val / 1000.0
+            self.executor.timeout = self.timeout
+            return 0
+        if fn == CfgFunc.set_max_segment_size:
+            if val > self.bufsize:  # segments must fit spare buffers
+                return int(ErrorCode.DMA_SIZE_ERROR)
+            self.max_segment_size = val
+            return 0
+        if fn == CfgFunc.open_port:
+            return (0 if self.eth.listening
+                    else int(ErrorCode.OPEN_PORT_NOT_SUCCEEDED))
+        if fn == CfgFunc.open_con:
+            return self.eth.connect_all()
+        if fn == CfgFunc.close_con:
+            self.eth.disconnect_all()
+            return 0
+        if fn == CfgFunc.set_stack_type:
+            return self._set_stack({0: "tcp", 1: "udp"}.get(val))
+        if fn == CfgFunc.start_profiling:
+            self.profiling = True
+            return 0
+        if fn == CfgFunc.end_profiling:
+            self.profiling = False
+            return 0
+        return int(ErrorCode.INVALID_CALL)
+
+    def _bind_fabric(self, kind: str, port: int):
+        """Bind a fresh fabric, retrying briefly (the kernel may take a
+        moment to release the port); None if every attempt failed."""
+        fabric_cls = {"tcp": EthFabric, "udp": UdpEthFabric}[kind]
+        for _ in range(50):
+            try:
+                return fabric_cls(self.rank, port, self._ingest)
+            except OSError:
+                time.sleep(0.05)
+        return None
+
+    def _set_stack(self, kind: str | None) -> int:
+        """Runtime fabric swap (HOUSEKEEP_SET_STACK_TYPE parity,
+        c:1270-1272). The swap is quiesced-only: in-flight eth traffic on
+        the old fabric is lost, and every rank of the world must switch
+        before new traffic flows."""
+        if kind is None:
+            return int(ErrorCode.INVALID_CALL)
+        if kind == self.stack:
+            return 0
+        old_kind = self.stack
+        port = self.port_base + self.world + self.rank
+        self.eth.close()
+        err = 0
+        fab = self._bind_fabric(kind, port)
+        if fab is None:
+            # keep a working fabric: fall back to the old stack type
+            # rather than leaving the daemon wired to a closed one
+            err = int(ErrorCode.OPEN_PORT_NOT_SUCCEEDED)
+            fab = self._bind_fabric(old_kind, port)
+            if fab is None:  # port gone entirely; daemon is degraded
+                return err
+            kind = old_kind
+        self.eth = fab
+        self.stack = kind
+        self.executor._send = self.eth.send
+        for comm in self.comms.values():
+            self.eth.learn_peers(
+                [(r.global_rank, r.host, r.port) for r in comm.ranks],
+                self.world)
+        return err
+
+    def _soft_reset(self):
+        self.pool = RxBufferPool(len(self.pool.bufs), self.bufsize)
+        self.executor.pool = self.pool
+        for comm in self.comms.values():
+            for r in comm.ranks:
+                r.inbound_seq = r.outbound_seq = 0
 
     # -- command server ----------------------------------------------------
     def serve_forever(self):
@@ -456,15 +631,19 @@ class RankDaemon:
                 err = self._call_status.pop(call_id)
             return P.status_reply(err)
         if kind == P.MSG_GET_INFO:
-            return P.data_reply(struct.pack(
-                "<Q3I", self.bufsize, len(self.pool.bufs), self.world,
-                self.rank))
+            # base geometry + config-state extension (readable effect of
+            # the runtime config calls; older clients parse a prefix)
+            flags = ((1 if self.pkt_enabled else 0)
+                     | (2 if self.profiling else 0))
+            return P.data_reply(
+                struct.pack("<Q3I", self.bufsize, len(self.pool.bufs),
+                            self.world, self.rank)
+                + struct.pack("<QIBBI", self.max_segment_size,
+                              int(self.timeout * 1000), flags,
+                              0 if self.stack == "tcp" else 1,
+                              self.profiled_calls))
         if kind == P.MSG_RESET:
-            self.pool = RxBufferPool(len(self.pool.bufs), self.bufsize)
-            self.executor.pool = self.pool
-            for comm in self.comms.values():
-                for r in comm.ranks:
-                    r.inbound_seq = r.outbound_seq = 0
+            self._soft_reset()
             return P.status_reply(0)
         if kind == P.MSG_DUMP_RX:
             return P.data_reply(self.pool.describe().encode())

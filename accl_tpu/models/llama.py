@@ -7,8 +7,9 @@ Design for the MXU/HBM/ICI (not a port of any torch code):
     ('dp', 'tp') mesh (+ optional 'sp' sequence axis folded into dp for
     data, attention over tp heads). XLA inserts the all-gathers /
     reduce-scatters; bucketed DP gradient sync can instead be driven
-    explicitly through accl_tpu collectives (benchmarks/dp_allreduce.py)
-    to mirror the reference's ring-allreduce usage.
+    explicitly through accl_tpu collectives (the BASELINE config-5 path,
+    benchmarks/configs.py:config5_llama_grads) to mirror the reference's
+    ring-allreduce usage.
 
 Shapes follow the Llama-3 family (GQA, SwiGLU, RoPE, RMSNorm);
 ``LlamaConfig.llama3_8b()`` reproduces the 8B geometry for BASELINE

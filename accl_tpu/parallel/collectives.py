@@ -201,6 +201,80 @@ def alltoall_shard(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
                           tiled=False)
 
 
+_AXIS_REDUCERS = {ReduceFunc.SUM: jnp.sum, ReduceFunc.MAX: jnp.max,
+                  ReduceFunc.MIN: jnp.min, ReduceFunc.PROD: jnp.prod}
+
+
+def xla_compressed_reduce_scatter_shard(chunks: jnp.ndarray, axis_name: str,
+                                        func: ReduceFunc,
+                                        wire_dtype) -> jnp.ndarray:
+    """Reduce-scatter with a compressed wire but UNCOMPRESSED accumulation
+    on the fused-XLA path: all_to_all moves the compressed chunks (pure
+    data movement, no arithmetic), then the W contributions are upcast and
+    reduced locally. This is the XLA analog of the reference's
+    decompress-before-arith clane routing (dma_mover.cpp:44-168) and
+    matches the ring path's numerics (``_hop`` upcasts before reducing) —
+    a plain ``psum(x.astype(wire))`` would instead accumulate W-1 rounding
+    errors in the wire dtype.
+
+    ``chunks``: (W, chunk...) per shard; returns this rank's reduced chunk.
+    fp8 wires carry a per-(rank, chunk) absmax scale alongside the payload
+    (EQuARX-style), like the ring-hop codec."""
+    dtype = chunks.dtype
+    if jnp.dtype(wire_dtype).name in _FP8_DTYPES:
+        xf = chunks.astype(jnp.float32)
+        fp8_max = float(jnp.finfo(wire_dtype).max)
+        tail = tuple(range(1, xf.ndim))
+        scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=tail) / fp8_max,
+                            1e-30)                           # (W,)
+        bshape = (-1,) + (1,) * (xf.ndim - 1)
+        q = (xf / scale.reshape(bshape)).astype(wire_dtype)
+        q = alltoall_shard(q, axis_name)
+        scale = lax.all_to_all(scale, axis_name, 0, 0)
+        up = q.astype(jnp.float32) * scale.reshape(bshape)
+        return _AXIS_REDUCERS[func](up, axis=0).astype(dtype)
+    recv = alltoall_shard(chunks.astype(wire_dtype), axis_name)
+    return _AXIS_REDUCERS[func](recv.astype(dtype), axis=0)
+
+
+def xla_compressed_allgather_shard(x: jnp.ndarray, axis_name: str,
+                                   wire_dtype) -> jnp.ndarray:
+    """All-gather with a compressed wire: a straight cast each way — no
+    arithmetic happens in the wire dtype. fp8 wires gather a per-rank
+    scale next to the payload."""
+    if jnp.dtype(wire_dtype).name in _FP8_DTYPES:
+        xf = x.astype(jnp.float32)
+        fp8_max = float(jnp.finfo(wire_dtype).max)
+        scale = jnp.maximum(jnp.max(jnp.abs(xf)) / fp8_max, 1e-30)
+        q = lax.all_gather((xf / scale).astype(wire_dtype), axis_name)
+        s = lax.all_gather(scale, axis_name)
+        return (q.astype(jnp.float32)
+                * s.reshape((-1,) + (1,) * x.ndim)).astype(x.dtype)
+    return lax.all_gather(x.astype(wire_dtype), axis_name).astype(x.dtype)
+
+
+def xla_compressed_allreduce_shard(x: jnp.ndarray, axis_name: str,
+                                   func: ReduceFunc,
+                                   wire_dtype) -> jnp.ndarray:
+    """Fused-path allreduce with compressed wire + uncompressed
+    accumulation: compressed reduce-scatter (all_to_all + local upcast
+    reduce) then compressed all-gather — the firmware's fused 2-phase
+    structure (c:942-1098) lowered to XLA's fused collectives."""
+    W = lax.axis_size(axis_name)
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    pad = (-flat.size) % W
+    flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(W, -1)
+    mine = xla_compressed_reduce_scatter_shard(chunks, axis_name, func,
+                                               wire_dtype)
+    full = xla_compressed_allgather_shard(mine, axis_name, wire_dtype)
+    out = full.reshape(-1)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape).astype(dtype)
+
+
 # ---------------------------------------------------------------------------
 # Global-array wrappers: build + cache shard_map programs over a mesh
 # ---------------------------------------------------------------------------
@@ -261,10 +335,15 @@ class MeshCollectives:
                 def f(x):  # x per-shard: (1, n)
                     return ring_allreduce_shard(x[0], ax, func,
                                                 wire_dtype)[None]
+            elif wire_dtype is not None:
+                # compressed wire, uncompressed accumulation (the clane
+                # semantics) — NOT psum in the wire dtype
+                def f(x):
+                    return xla_compressed_allreduce_shard(
+                        x[0], ax, func, wire_dtype)[None]
             else:
                 def f(x):
-                    r = _PSUM_LIKE[func](_maybe_wire(x[0], wire_dtype), ax)
-                    return r.astype(x.dtype)[None]
+                    return _PSUM_LIKE[func](x[0], ax).astype(x.dtype)[None]
             spec_in = spec_out = P(ax, None)
         elif op == "reduce_scatter":
             # x: (W, W*chunk) global; out: (W, chunk)
@@ -273,11 +352,15 @@ class MeshCollectives:
                     chunks = x[0].reshape(self.W, -1)
                     return ring_reduce_scatter_shard(chunks, ax, func,
                                                      wire_dtype)[None]
+            elif wire_dtype is not None:
+                def f(x):
+                    chunks = x[0].reshape(self.W, -1)
+                    return xla_compressed_reduce_scatter_shard(
+                        chunks, ax, func, wire_dtype)[None]
             else:
                 def f(x):
-                    r = lax.psum_scatter(
-                        _maybe_wire(x[0].reshape(self.W, -1), wire_dtype),
-                        ax, scatter_dimension=0, tiled=False)
+                    r = lax.psum_scatter(x[0].reshape(self.W, -1), ax,
+                                         scatter_dimension=0, tiled=False)
                     return r.astype(x.dtype)[None]
             spec_in = spec_out = P(ax, None)
         elif op == "allgather":
@@ -286,6 +369,10 @@ class MeshCollectives:
                 def f(x):
                     return ring_allgather_shard(x[0], ax,
                                                 wire_dtype).reshape(-1)[None]
+            elif wire_dtype is not None:
+                def f(x):
+                    return xla_compressed_allgather_shard(
+                        x[0], ax, wire_dtype).reshape(-1)[None]
             else:
                 def f(x):
                     return lax.all_gather(x[0], ax).reshape(-1)[None]
@@ -296,9 +383,14 @@ class MeshCollectives:
             spec_in = spec_out = P(ax, None)
         elif op == "reduce":
             def f(x):
-                r = _PSUM_LIKE[func](_maybe_wire(x[0], wire_dtype), ax)
+                if wire_dtype is not None:
+                    # decompress-before-arith, like the allreduce path
+                    r = xla_compressed_allreduce_shard(x[0], ax, func,
+                                                       wire_dtype)
+                else:
+                    r = _PSUM_LIKE[func](x[0], ax).astype(x.dtype)
                 me = lax.axis_index(ax)
-                return jnp.where(me == root, r.astype(x.dtype),
+                return jnp.where(me == root, r,
                                  jnp.zeros_like(x[0]))[None]
             spec_in = spec_out = P(ax, None)
         elif op == "scatter":
@@ -386,10 +478,6 @@ class MeshCollectives:
                  pairs: tuple[tuple[int, int], ...]) -> jax.Array:
         """Execute a batch of point-to-point transfers as one ppermute."""
         return self._sendrecv_program(tuple(pairs))(x)
-
-
-def _maybe_wire(x, wire_dtype):
-    return x if wire_dtype is None else x.astype(wire_dtype)
 
 
 def _wire_name(wire_dtype) -> str | None:

@@ -364,6 +364,11 @@ class EthFabric {
   }
   bool send_msg(const Envelope& env, const std::vector<uint8_t>& payload);
   void stop();
+  bool is_udp() const { return udp_; }
+  bool ok() const { return listen_fd_ >= 0; }  // bind succeeded
+  bool listening() const { return ok() && !stopping_.load(); }
+  uint32_t connect_all();   // openCon parity (eager session open)
+  void disconnect_all();    // close per-peer sessions (lazy re-dial later)
 
  private:
   void accept_loop();
@@ -381,6 +386,7 @@ class EthFabric {
   int listen_fd_ = -1;
   RankDaemon* daemon_;
   bool udp_;
+  std::vector<int> inbound_fds_;  // accepted eth connections (guarded mu_)
   std::map<uint32_t, int> peers_;
   // per-peer send mutexes: one slow peer's TCP backpressure must not stall
   // sends to other peers (mu_ guards only lookup/dial)
@@ -808,8 +814,14 @@ class RankDaemon {
       : rank_(rank), world_(world), port_base_(port_base),
         pool_(nbufs, bufsize), bufsize_(bufsize), max_seg_(bufsize),
         nbufs_(nbufs),
-        eth_(rank, static_cast<uint16_t>(port_base + world + rank), this,
-             udp) {
+        eth_(std::make_unique<EthFabric>(
+            rank, static_cast<uint16_t>(port_base + world + rank), this,
+            udp)) {
+    if (!eth_->ok()) {  // startup bind failure is fatal, like before
+      fprintf(stderr, "rank %u: eth port %u bind failed\n", rank,
+              port_base + world + rank);
+      exit(1);
+    }
     mem_.alloc(BARRIER_SCRATCH_ADDR, 8);  // barrier rendezvous scratch
     worker_ = std::thread([this] { call_worker(); });
   }
@@ -872,7 +884,7 @@ class RankDaemon {
         env.strm = m.remote_stream ? 1 : 0;
         env.dtype = wire_dt;
         env.nbytes = wire.size();
-        if (!eth_.send_msg(env, wire)) return E_INVALID;
+        if (!eth_->send_msg(env, wire)) return E_INVALID;
       }
     }
     return E_OK;
@@ -935,7 +947,9 @@ class RankDaemon {
         job = std::move(call_queue_.front());
         call_queue_.pop_front();
       }
+      uint8_t scenario = job.second.empty() ? OP_NOP : job.second[0];
       uint32_t err = run_call(job.second);
+      if (profiling_ && scenario != OP_CONFIG) profiled_calls_++;
       {
         std::lock_guard<std::mutex> lk(call_mu_);
         call_status_[job.first] = err;
@@ -956,7 +970,8 @@ class RankDaemon {
     uint64_t a0 = get_le<uint64_t>(p + 28);
     uint64_t a1 = get_le<uint64_t>(p + 36);
     uint64_t a2 = get_le<uint64_t>(p + 44);
-    if (scenario == OP_NOP || scenario == OP_CONFIG) return E_OK;
+    if (scenario == OP_NOP) return E_OK;
+    if (scenario == OP_CONFIG) return handle_config(tag, count);
     Communicator* comm;
     {
       std::lock_guard<std::mutex> lk(comm_mu_);
@@ -973,6 +988,92 @@ class RankDaemon {
     return execute_moves(moves, c, *comm);
   }
 
+  // ---- runtime config calls (ACCL_CONFIG parity, c:1240-1283) ----
+  // subfunction in tag, value in count (ms for timeout, bytes for segment
+  // size, StackType code for stack select)
+  uint32_t handle_config(uint32_t fn, uint64_t val) {
+    switch (fn) {
+      case CFG_RESET:
+        soft_reset();
+        return E_OK;
+      case CFG_ENABLE_PKT:
+        pkt_enabled_ = true;
+        return E_OK;
+      case CFG_SET_TIMEOUT:
+        timeout_ = static_cast<double>(val) / 1000.0;
+        return E_OK;
+      case CFG_SET_SEG:
+        if (val > bufsize_) return E_DMA_SIZE;
+        max_seg_ = static_cast<size_t>(val);
+        return E_OK;
+      case CFG_OPEN_PORT:
+        return eth_->listening() ? E_OK : E_OPEN_PORT;
+      case CFG_OPEN_CON:
+        return eth_->connect_all();
+      case CFG_CLOSE_CON:
+        eth_->disconnect_all();
+        return E_OK;
+      case CFG_SET_STACK:
+        if (val > 1) return E_INVALID;  // 0=tcp, 1=udp (StackType parity)
+        return set_stack(val == 1);
+      case CFG_START_PROF:
+        profiling_ = true;
+        return E_OK;
+      case CFG_END_PROF:
+        profiling_ = false;
+        return E_OK;
+      default:
+        return E_INVALID;
+    }
+  }
+
+  bool rebind_fabric(bool udp, uint16_t port) {
+    // retry briefly: the kernel may take a moment to release the port
+    for (int i = 0; i < 50; ++i) {
+      auto fab = std::make_unique<EthFabric>(rank_, port, this, udp);
+      if (fab->ok()) {
+        eth_ = std::move(fab);
+        return true;
+      }
+      usleep(50 * 1000);
+    }
+    return false;
+  }
+
+  void relearn_peers() {
+    std::lock_guard<std::mutex> lk(comm_mu_);
+    for (auto& kv : comms_)
+      for (auto& r : kv.second.ranks)
+        if (r.global_rank != rank_ && r.cmd_port)
+          eth_->learn_peer(r.global_rank, r.host,
+                           static_cast<uint16_t>(r.cmd_port + world_));
+  }
+
+  uint32_t set_stack(bool udp) {
+    // HOUSEKEEP_SET_STACK_TYPE parity (c:1270-1272): quiesced-only swap;
+    // in-flight eth traffic on the old fabric is lost and every rank must
+    // switch before new traffic flows.
+    if (udp == eth_->is_udp()) return E_OK;
+    bool old_udp = eth_->is_udp();
+    uint16_t port = static_cast<uint16_t>(port_base_ + world_ + rank_);
+    eth_->stop();  // joins fabric threads; port becomes rebindable
+    if (rebind_fabric(udp, port)) {
+      relearn_peers();
+      return E_OK;
+    }
+    // keep a working fabric: fall back to the old stack type rather than
+    // leaving the daemon wired to a stopped fabric
+    if (rebind_fabric(old_udp, port)) relearn_peers();
+    return E_OPEN_PORT;
+  }
+
+  void soft_reset() {
+    pool_.reset();
+    std::lock_guard<std::mutex> lk(comm_mu_);
+    for (auto& kv : comms_)
+      for (auto& r : kv.second.ranks) r.inbound_seq = r.outbound_seq = 0;
+  }
+
   // ---- command connection ----
   void serve_conn(int fd);
   std::vector<uint8_t> handle(const std::vector<uint8_t>& body);
@@ -985,7 +1086,13 @@ class RankDaemon {
   double timeout_ = 30.0;
   std::map<uint32_t, Communicator> comms_;
   std::mutex comm_mu_;
-  EthFabric eth_;
+  // unique_ptr so a runtime stack-type config call can swap the fabric
+  std::unique_ptr<EthFabric> eth_;
+  // runtime config-call state (ACCL_CONFIG parity): pkt engines are
+  // default-armed; profiling counters are in-daemon
+  bool pkt_enabled_ = true;
+  bool profiling_ = false;
+  uint32_t profiled_calls_ = 0;
   // stream port
   std::deque<std::pair<Envelope, std::vector<uint8_t>>> stream_in_;
   std::mutex stream_mu_;
@@ -1001,6 +1108,8 @@ class RankDaemon {
 };
 
 // ---- EthFabric impl -------------------------------------------------------
+// returns -1 on bind failure (caller decides whether that is fatal —
+// startup exits, a runtime stack swap retries and reports an error word)
 static int make_server(uint16_t port) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
@@ -1010,8 +1119,8 @@ static int make_server(uint16_t port) {
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
   addr.sin_port = htons(port);
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0) {
-    perror("bind");
-    exit(1);
+    ::close(fd);
+    return -1;
   }
   listen(fd, 16);
   return fd;
@@ -1029,8 +1138,8 @@ static int make_udp_server(uint16_t port) {
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
   addr.sin_port = htons(port);
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0) {
-    perror("bind");
-    exit(1);
+    ::close(fd);
+    return -1;
   }
   return fd;
 }
@@ -1038,13 +1147,15 @@ static int make_udp_server(uint16_t port) {
 EthFabric::EthFabric(uint32_t me, uint16_t listen_port, RankDaemon* daemon,
                      bool udp)
     : me_(me), daemon_(daemon), udp_(udp) {
-  if (udp_) {
-    listen_fd_ = make_udp_server(listen_port);
-    threads_.emplace_back([this] { udp_recv_loop(); });
-  } else {
-    listen_fd_ = make_server(listen_port);
-    threads_.emplace_back([this] { accept_loop(); });
+  listen_fd_ = udp_ ? make_udp_server(listen_port) : make_server(listen_port);
+  if (listen_fd_ < 0) {
+    stopping_.store(true);  // never usable; stop()/dtor are no-ops
+    return;
   }
+  if (udp_)
+    threads_.emplace_back([this] { udp_recv_loop(); });
+  else
+    threads_.emplace_back([this] { accept_loop(); });
 }
 
 EthFabric::~EthFabric() { stop(); }
@@ -1053,14 +1164,33 @@ void EthFabric::stop() {
   if (stopping_.exchange(true)) return;
   ::shutdown(listen_fd_, SHUT_RDWR);
   ::close(listen_fd_);
-  std::lock_guard<std::mutex> lk(mu_);
-  for (auto& kv : peers_) ::close(kv.second);
-  for (auto& kv : dqs_) {
-    {
-      std::lock_guard<std::mutex> qlk(kv.second->mu);
-      kv.second->stop = true;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : peers_) ::close(kv.second);
+    // unblock inbound recv threads too: they reference this fabric, so
+    // they must exit before the object may be destroyed (stack swap)
+    for (int fd : inbound_fds_) ::shutdown(fd, SHUT_RDWR);
+    for (auto& kv : dqs_) {
+      {
+        std::lock_guard<std::mutex> qlk(kv.second->mu);
+        kv.second->stop = true;
+      }
+      kv.second->cv.notify_all();
     }
-    kv.second->cv.notify_all();
+  }
+  // join ALL owned threads (accept/udp loop, delivery workers, inbound
+  // recv) so the fabric is destructible — a runtime stack swap replaces
+  // the object, and a surviving thread would use it after free. The
+  // index loop re-checks size under mu_ because accept_loop may append
+  // one final entry while draining.
+  for (size_t i = 0;; ++i) {
+    std::thread t;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (i >= threads_.size()) break;
+      t = std::move(threads_[i]);
+    }
+    if (t.joinable()) t.join();
   }
 }
 
@@ -1185,7 +1315,15 @@ void EthFabric::accept_loop() {
     if (fd < 0) return;
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-    std::thread([this, fd] { recv_loop(fd); }).detach();
+    // tracked, not detached: stop() must be able to shut these down and
+    // join them before the fabric is destroyed (runtime stack swap)
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stopping_.load()) {
+      ::close(fd);
+      return;
+    }
+    inbound_fds_.push_back(fd);
+    threads_.emplace_back([this, fd] { recv_loop(fd); });
   }
 }
 
@@ -1265,9 +1403,60 @@ bool EthFabric::send_msg(const Envelope& env,
   return send_frame(fd, body);
 }
 
+uint32_t EthFabric::connect_all() {
+  // openCon parity (ccl_offload_control.c:109-165): eagerly open a session
+  // to every known peer, replacing the lazy per-send dial. UDP is
+  // connectionless (the reference's VNx path programs a socket table
+  // instead), so there is nothing to open.
+  if (udp_) return E_OK;
+  std::vector<std::pair<uint32_t, std::pair<std::string, uint16_t>>> targets;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : peer_addrs_)
+      if (!peers_.count(kv.first)) targets.push_back(kv);
+  }
+  uint32_t err = E_OK;
+  for (auto& t : targets) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(t.second.second);
+    inet_pton(AF_INET, t.second.first.c_str(), &addr.sin_addr);
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0) {
+      ::close(fd);
+      err |= E_OPEN_CON;
+      continue;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::lock_guard<std::mutex> lk(mu_);
+    if (peers_.count(t.first)) {  // lost a dial race with send_msg
+      ::close(fd);
+    } else {
+      peers_[t.first] = fd;
+      peer_mus_[t.first] = std::make_unique<std::mutex>();
+    }
+  }
+  return err;
+}
+
+void EthFabric::disconnect_all() {
+  // Only safe from the call worker (the sole sender on this rank), so no
+  // send can hold a per-peer mutex we are about to destroy.
+  if (udp_) return;
+  std::lock_guard<std::mutex> lk(mu_);
+  for (auto& kv : peers_) ::close(kv.second);
+  peers_.clear();
+  peer_mus_.clear();
+}
+
 // ---- command server -------------------------------------------------------
 int RankDaemon::serve(uint16_t cmd_port) {
   int server = make_server(cmd_port);
+  if (server < 0) {
+    fprintf(stderr, "rank %u: cmd port %u bind failed\n", rank_, cmd_port);
+    return 1;
+  }
   printf("native rank %u/%u serving cmd=%u eth=%u\n", rank_, world_, cmd_port,
          port_base_ + world_ + rank_);
   fflush(stdout);
@@ -1291,7 +1480,7 @@ void RankDaemon::serve_conn(int fd) {
     if (body[0] == MSG_SHUTDOWN) {
       shutting_down.store(true);
       call_cv_.notify_all();
-      eth_.stop();
+      eth_->stop();
       ::close(fd);
       ::exit(0);
     }
@@ -1342,7 +1531,7 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
         off += hlen;
         comm.ranks.push_back(ri);
         if (ri.global_rank != rank_ && ri.cmd_port)
-          eth_.learn_peer(ri.global_rank, ri.host,
+          eth_->learn_peer(ri.global_rank, ri.host,
                           static_cast<uint16_t>(ri.cmd_port + world_));
       }
       std::lock_guard<std::mutex> lk(comm_mu_);
@@ -1387,18 +1576,22 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
       return status_reply(err);
     }
     case MSG_GET_INFO: {
+      // base geometry + config-state extension (readable effect of the
+      // runtime config calls; layout matches the Python daemon)
       std::vector<uint8_t> reply{MSG_DATA};
       put_le<uint64_t>(reply, bufsize_);
       put_le<uint32_t>(reply, (uint32_t)nbufs_);
       put_le<uint32_t>(reply, world_);
       put_le<uint32_t>(reply, rank_);
+      put_le<uint64_t>(reply, (uint64_t)max_seg_);
+      put_le<uint32_t>(reply, (uint32_t)(timeout_ * 1000.0));
+      reply.push_back((pkt_enabled_ ? 1 : 0) | (profiling_ ? 2 : 0));
+      reply.push_back(eth_->is_udp() ? 1 : 0);
+      put_le<uint32_t>(reply, profiled_calls_);
       return reply;
     }
     case MSG_RESET: {
-      pool_.reset();
-      std::lock_guard<std::mutex> lk(comm_mu_);
-      for (auto& kv : comms_)
-        for (auto& r : kv.second.ranks) r.inbound_seq = r.outbound_seq = 0;
+      soft_reset();
       return status_reply(E_OK);
     }
     case MSG_DUMP_RX: {

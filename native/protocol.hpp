@@ -37,6 +37,16 @@ enum Op : uint8_t {
 
 enum Func : uint8_t { FN_SUM = 0, FN_MAX = 1, FN_MIN = 2, FN_PROD = 3 };
 
+// config-call subfunctions, carried in the descriptor's tag with the value
+// in count (CfgFunc in accl_tpu/constants.py; reference CCLOCfgFunc,
+// driver/pynq/accl.py:179-187 <-> ccl_offload_control.c:1240-1283)
+enum Cfg : uint8_t {
+  CFG_RESET = 0, CFG_ENABLE_PKT = 1, CFG_SET_TIMEOUT = 2,
+  CFG_OPEN_PORT = 3, CFG_OPEN_CON = 4, CFG_SET_STACK = 5,
+  CFG_SET_SEG = 6, CFG_CLOSE_CON = 7, CFG_START_PROF = 8,
+  CFG_END_PROF = 9,
+};
+
 enum CompFlag : uint8_t {
   C_NONE = 0, C_OP0 = 1, C_OP1 = 2, C_RES = 4, C_ETH = 8,
 };
@@ -54,6 +64,8 @@ enum Err : uint32_t {
   E_DMA_MISMATCH = 1u << 0,
   E_RECV_TIMEOUT = 1u << 8,
   E_DMA_SIZE = 1u << 12,
+  E_OPEN_PORT = 1u << 13,
+  E_OPEN_CON = 1u << 14,
   E_COMM_NOT_CONFIGURED = 1u << 15,
   E_SPARE_OVERFLOW = 1u << 20,
   E_INVALID = 1u << 23,

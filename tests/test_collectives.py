@@ -145,6 +145,54 @@ def test_wire_compressed_allreduce(coll, algorithm):
     np.testing.assert_allclose(out[0], sum(ins), rtol=0.1, atol=0.1)
 
 
+@pytest.mark.parametrize("wire,tol,ring_tol", [
+    (jnp.float16, 5e-3, 5e-3),
+    (jnp.bfloat16, 4e-2, 4e-2),
+    # fp8 ring re-quantizes partial sums with a fresh absmax scale every
+    # hop, compounding over W-1 hops; the xla path quantizes inputs once
+    ("float8_e4m3fn", 0.2, 0.6),
+])
+def test_compressed_ring_xla_numerics_agree(coll, wire, tol, ring_tol):
+    """The xla and ring algorithms must agree numerically for
+    wire_dtype != None: both decompress before accumulating (the
+    reference's clane routing, dma_mover.cpp:44-168), so each stays
+    within the uncompressed-accumulation tolerance of the fp32 golden —
+    a psum in the wire dtype would instead drift by W-1 rounding steps.
+    """
+    tols = {"ring": ring_tol, "xla": tol}
+    ins = _inputs(256, seed=21)
+    x = coll.shard(ins)
+    golden = sum(ins)
+    scale = np.maximum(np.abs(golden), 1.0)
+    for alg in ("ring", "xla"):
+        out = np.asarray(coll.allreduce(x, algorithm=alg, wire_dtype=wire))
+        assert np.max(np.abs(out[0] - golden) / scale) < tols[alg], alg
+    # reduce_scatter: same agreement on the fused phase alone
+    chunk = 32
+    ins_rs = _inputs(W * chunk, seed=22)
+    x_rs = coll.shard(ins_rs)
+    total = sum(ins_rs)
+    scale_rs = np.maximum(np.abs(total), 1.0)
+    for alg in ("ring", "xla"):
+        out = np.asarray(coll.reduce_scatter(x_rs, algorithm=alg,
+                                             wire_dtype=wire))
+        for r in range(W):
+            err = np.abs(out[r][:chunk] - total[r * chunk:(r + 1) * chunk])
+            assert np.max(err / scale_rs[r * chunk:(r + 1) * chunk]) \
+                < tols[alg], alg
+
+
+def test_compressed_allgather_xla_wire(coll):
+    """The fused-path allgather rides the wire compressed (round-trip cast
+    only — no arithmetic in the wire dtype)."""
+    ins = _inputs(16, seed=23)
+    x = coll.shard(ins)
+    out = np.asarray(coll.allgather(x, algorithm="xla",
+                                    wire_dtype=jnp.float16))
+    golden = np.concatenate(ins).astype(np.float16).astype(np.float32)
+    np.testing.assert_allclose(out[0], golden, rtol=1e-6)
+
+
 def test_ring_uneven_padding(coll):
     # n not divisible by W exercises the pad path
     ins = _inputs(37, seed=10)

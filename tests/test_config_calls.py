@@ -1,0 +1,162 @@
+"""Runtime config-call surface: CCLOp.config subfunctions through the full
+call path, against the in-process emulator and both socket daemons.
+
+Reference bar: the firmware's ACCL_CONFIG case does real work at runtime —
+reset, pkt enable, timeout, openPort/openCon, stack select, segment size
+(ccl_offload_control.c:1240-1283, openCon :109-165, openPort :168-181).
+Here every subfunction is handled in-backend and its effect is observable
+through the extended GET_INFO reply (socket daemons) or device attributes
+(in-process backends).
+"""
+
+import os
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu import ACCLError, ErrorCode
+from accl_tpu.call import CallDescriptor
+from accl_tpu.constants import CCLOp
+from accl_tpu.testing import (connect_world, emu_world, free_port_base,
+                              run_ranks, sim_world)
+
+BINARY = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native", "cclo_emud")
+
+
+def _allreduce_ok(accls):
+    def xch(a):
+        src = a.buffer(data=np.full(8, float(a.rank + 1), np.float32))
+        dst = a.buffer((8,), np.float32)
+        a.allreduce(src, dst, 8)
+        return float(dst.data[0])
+
+    golden = float(sum(r + 1 for r in range(len(accls))))
+    assert run_ranks(accls, xch) == [golden] * len(accls)
+
+
+def _exercise_config_surface(accls):
+    """Shared corpus: drives every config subfunction through SimDevice and
+    checks the daemon-side effect (works identically on the Python and C++
+    daemons — the 3-tier property)."""
+    a0 = accls[0]
+    info = a0.device.get_info()
+    # driver bring-up already rode the call path (enable_pkt config call)
+    assert info["pkt_enabled"]
+    assert info["stack"] == "tcp"
+
+    # set_timeout: daemon-side receive deadline changes
+    a0.set_timeout(2.5)
+    assert a0.device.get_info()["timeout_ms"] == 2500
+
+    # set_max_segment_size: segmentation granularity changes; oversized
+    # segments are rejected with DMA_SIZE through the call path (segments
+    # must fit spare buffers, reference accl.py:660-667)
+    a0.set_max_segment_size(4096)
+    assert a0.device.get_info()["max_segment_size"] == 4096
+    with pytest.raises(ACCLError) as ei:
+        a0.set_max_segment_size(info["bufsize"] * 2)
+    assert ErrorCode.DMA_SIZE_ERROR in ei.value.errors
+    a0.set_max_segment_size(info["bufsize"])
+
+    # open_port + open_con: eager session establishment (openCon parity);
+    # close_con drops sessions, traffic re-dials lazily afterwards
+    for a in accls:
+        a.init_connection()
+    _allreduce_ok(accls)
+    for a in accls:
+        a.close_connections()
+    _allreduce_ok(accls)
+
+    # profiling: daemon-side counters armed/disarmed through the call path
+    for a in accls:
+        a.start_profiling()
+    assert all(a.device.get_info()["profiling"] for a in accls)
+    _allreduce_ok(accls)
+    for a in accls:
+        a.end_profiling()
+    infos = [a.device.get_info() for a in accls]
+    assert all(not i["profiling"] for i in infos)
+    assert all(i["profiled_calls"] >= 1 for i in infos)
+
+    # soft reset through the call path (HOUSEKEEP_SWRST): every rank
+    # resets, seqnos realign, traffic continues
+    for a in accls:
+        a.soft_reset()
+    _allreduce_ok(accls)
+
+    # runtime stack swap tcp->udp->tcp (HOUSEKEEP_SET_STACK_TYPE): all
+    # ranks switch while quiesced, then traffic flows on the new stack
+    for a in accls:
+        a.set_stack_type("udp")
+    assert all(a.device.get_info()["stack"] == "udp" for a in accls)
+    _allreduce_ok(accls)
+    for a in accls:
+        a.set_stack_type("tcp")
+    assert all(a.device.get_info()["stack"] == "tcp" for a in accls)
+    _allreduce_ok(accls)
+
+    # unknown subfunction -> INVALID_CALL through the call path
+    h = a0.device.call_async(CallDescriptor(CCLOp.config, count=0, tag=200))
+    with pytest.raises(ACCLError) as ei:
+        h.wait()
+    assert ErrorCode.INVALID_CALL in ei.value.errors
+
+
+def test_config_calls_python_daemon():
+    accls = sim_world(2)
+    try:
+        _exercise_config_surface(accls)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_config_calls_native_daemon():
+    if not os.path.exists(BINARY):
+        pytest.skip("native daemon not built (make -C native)")
+    port_base = free_port_base()
+    W = 2
+    procs = [subprocess.Popen(
+        [BINARY, "--rank", str(r), "--world", str(W),
+         "--port-base", str(port_base)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(W)]
+    try:
+        time.sleep(0.5)
+        accls = connect_world(port_base, W, timeout=15.0)
+        _exercise_config_surface(accls)
+        for a in accls:
+            a.deinit()
+        for p in procs:
+            assert p.wait(5) == 0
+    finally:
+        for p in procs:
+            p.kill()
+
+
+def test_config_calls_emu_backend():
+    """In-process backend: same subfunctions through the call path; the
+    loopback fabric has no ports/sessions, so connection subfunctions are
+    accepted no-ops (like the reference's dummy-stack loopback builds)."""
+    accls = emu_world(2)
+    a0 = accls[0]
+    a0.set_timeout(1.25)
+    assert a0.device.timeout == 1.25
+    a0.set_max_segment_size(2048)
+    assert a0.device.max_segment_size == 2048
+    with pytest.raises(ACCLError) as ei:
+        a0.set_max_segment_size(1 << 40)
+    assert ErrorCode.DMA_SIZE_ERROR in ei.value.errors
+    a0.start_profiling()
+    assert a0.device.profiling
+    a0.end_profiling()
+    assert not a0.device.profiling
+    a0.open_port()
+    a0.init_connection()
+    a0.close_connections()
+    for a in accls:
+        a.soft_reset()
+    _allreduce_ok(accls)
