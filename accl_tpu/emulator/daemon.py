@@ -54,7 +54,8 @@ class EthFabric:
         # stall sends to other peers
         self._peers: dict[int, tuple[socket.socket, threading.Lock]] = {}
         self._peer_addrs: dict[int, tuple[str, int]] = {}
-        self._lock = threading.Lock()  # guards dial/lookup only
+        self._inbound: list[socket.socket] = []  # accepted eth connections
+        self._lock = threading.Lock()  # guards dial/lookup/inbound only
         self._server = socket.create_server(("0.0.0.0", eth_port))
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
@@ -72,6 +73,8 @@ class EthFabric:
                 conn, _ = self._server.accept()
             except OSError:
                 return
+            with self._lock:
+                self._inbound.append(conn)
             threading.Thread(target=self._recv_loop, args=(conn,),
                              daemon=True).start()
 
@@ -85,6 +88,11 @@ class EthFabric:
                 self.ingest(env, payload)
         except (ConnectionError, OSError):
             return
+        finally:
+            with self._lock:
+                if conn in self._inbound:
+                    self._inbound.remove(conn)
+            conn.close()
 
     def send(self, env: Envelope, payload: bytes):
         with self._lock:
@@ -152,6 +160,17 @@ class EthFabric:
         self._server.close()
         for sock, _ in self._peers.values():
             sock.close()
+        # accepted inbound connections too: their recv threads reference
+        # this fabric's ingest path, and a runtime stack swap must not
+        # leave them delivering stale-stack traffic (or leak fds per swap)
+        with self._lock:
+            inbound = list(self._inbound)
+        for conn in inbound:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
 
 
 class UdpEthFabric:

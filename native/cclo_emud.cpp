@@ -18,6 +18,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -1056,6 +1057,9 @@ class RankDaemon {
     if (udp == eth_->is_udp()) return E_OK;
     bool old_udp = eth_->is_udp();
     uint16_t port = static_cast<uint16_t>(port_base_ + world_ + rank_);
+    // hold eth_mu_ for the whole swap so a concurrent conn thread can
+    // never observe (or call into) the half-destroyed old fabric
+    std::lock_guard<std::mutex> elk(eth_mu_);
     eth_->stop();  // joins fabric threads; port becomes rebindable
     if (rebind_fabric(udp, port)) {
       relearn_peers();
@@ -1086,8 +1090,13 @@ class RankDaemon {
   double timeout_ = 30.0;
   std::map<uint32_t, Communicator> comms_;
   std::mutex comm_mu_;
-  // unique_ptr so a runtime stack-type config call can swap the fabric
+  // unique_ptr so a runtime stack-type config call can swap the fabric.
+  // eth_mu_ serializes the swap (call-worker thread) against command
+  // connection threads that dereference eth_ (GET_INFO, comm config,
+  // shutdown); the call worker's own data path needs no lock — it is the
+  // only thread that reassigns the pointer.
   std::unique_ptr<EthFabric> eth_;
+  std::mutex eth_mu_;
   // runtime config-call state (ACCL_CONFIG parity): pkt engines are
   // default-armed; profiling counters are in-daemon
   bool pkt_enabled_ = true;
@@ -1336,6 +1345,14 @@ void EthFabric::recv_loop(int fd) {
     if (decode_eth(body.data() + 1, body.size() - 1, env, payload))
       daemon_->ingest(env, std::move(payload));
   }
+  // deregister BEFORE closing: once closed the fd number may be reused by
+  // the kernel, and a later stop() must not shutdown an unrelated socket
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    inbound_fds_.erase(
+        std::remove(inbound_fds_.begin(), inbound_fds_.end(), fd),
+        inbound_fds_.end());
+  }
   ::close(fd);
 }
 
@@ -1480,7 +1497,10 @@ void RankDaemon::serve_conn(int fd) {
     if (body[0] == MSG_SHUTDOWN) {
       shutting_down.store(true);
       call_cv_.notify_all();
-      eth_->stop();
+      {
+        std::lock_guard<std::mutex> elk(eth_mu_);  // vs stack swap
+        eth_->stop();
+      }
       ::close(fd);
       ::exit(0);
     }
@@ -1530,9 +1550,11 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
         ri.host.assign(reinterpret_cast<const char*>(p + off), hlen);
         off += hlen;
         comm.ranks.push_back(ri);
-        if (ri.global_rank != rank_ && ri.cmd_port)
+        if (ri.global_rank != rank_ && ri.cmd_port) {
+          std::lock_guard<std::mutex> elk(eth_mu_);  // vs stack swap
           eth_->learn_peer(ri.global_rank, ri.host,
-                          static_cast<uint16_t>(ri.cmd_port + world_));
+                           static_cast<uint16_t>(ri.cmd_port + world_));
+        }
       }
       std::lock_guard<std::mutex> lk(comm_mu_);
       comms_[comm.comm_id] = comm;
@@ -1586,7 +1608,10 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
       put_le<uint64_t>(reply, (uint64_t)max_seg_);
       put_le<uint32_t>(reply, (uint32_t)(timeout_ * 1000.0));
       reply.push_back((pkt_enabled_ ? 1 : 0) | (profiling_ ? 2 : 0));
-      reply.push_back(eth_->is_udp() ? 1 : 0);
+      {
+        std::lock_guard<std::mutex> elk(eth_mu_);  // vs stack swap
+        reply.push_back(eth_->is_udp() ? 1 : 0);
+      }
       put_le<uint32_t>(reply, profiled_calls_);
       return reply;
     }

@@ -1,11 +1,20 @@
 """Test config: force an 8-device virtual CPU platform before tests run.
 
-Multi-chip sharding tests run on a virtual CPU mesh (the driver separately
-dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip,
-and bench.py exercises the real chip). The environment may pre-select a
-TPU tunnel platform in a way that overrides JAX_PLATFORMS, so this goes
-through jax.config — set ACCL_TEST_TPU=1 to opt back into running the
-test suite against the real device.
+Three execution tiers (the reference's emulation/simulation/hardware
+story, SURVEY §4):
+
+1. default (CPU): the full corpus on the virtual 8-device CPU platform —
+   Pallas kernels run interpret=True; shard_map programs run on the
+   virtual mesh. Fast, no TPU needed.
+2. hardware (``ACCL_TEST_TPU=1``): the same corpus against the real chip —
+   Pallas kernels Mosaic-compile (interpret=False), and the gated
+   ``test_tpu_world_real_chip`` drives the driver tier on-device. The
+   last on-chip pass is recorded in ``TPU_CI_r02.json`` at the repo root.
+3. multi-chip dryrun: the driver runs ``__graft_entry__.dryrun_multichip``
+   (hermetic CPU-mesh child process) covering dp/tp/pp/ep/sp/ddp.
+
+The environment may pre-select a TPU tunnel platform in a way that
+overrides JAX_PLATFORMS, so tier 1 forces CPU through jax.config.
 """
 
 import os

@@ -245,3 +245,31 @@ def test_recv_count_mismatch_error(world):
         return None
 
     run_ranks(world, fn)
+
+
+def test_tpu_world_real_chip():
+    """Hardware tier: the driver API on the REAL TPU device (single-rank
+    world). Gated on ACCL_TEST_TPU=1 with a tpu backend — the CI marker
+    TPU_CI_r02.json records the last on-chip pass. Reference bar: the
+    hardware-tier tests (test/host/test_tcp_cmac_seq_mpi.py:29-443)."""
+    import os
+
+    import jax
+
+    if not os.environ.get("ACCL_TEST_TPU"):
+        pytest.skip("set ACCL_TEST_TPU=1 to run against the real chip")
+    if jax.default_backend() != "tpu":
+        pytest.skip("no tpu backend available")
+    accls = tpu_world(1)
+    a = accls[0]
+    src = a.buffer(data=np.arange(64, dtype=np.float32))
+    dst = a.buffer((64,), np.float32)
+    a.allreduce(src, dst, 64)
+    dst.sync_from_device()
+    np.testing.assert_allclose(dst.data, np.arange(64))
+    x = a.buffer(data=np.full(32, 2.0, np.float32))
+    y = a.buffer(data=np.full(32, 3.0, np.float32))
+    z = a.buffer((32,), np.float32)
+    a.combine(32, ReduceFunc.SUM, x, y, z)
+    z.sync_from_device()
+    np.testing.assert_allclose(z.data, 5.0)
