@@ -94,6 +94,13 @@ try:  # bfloat16 comes from ml_dtypes (always present with jax)
     DEFAULT_ARITH_CONFIGS[("bfloat16", "bfloat16")] = ArithConfig(_BF16, _BF16)
     DEFAULT_ARITH_CONFIGS[("float32", "bfloat16")] = ArithConfig(
         np.dtype("float32"), _BF16)
+    # fp8 quantized wire lane (EQuARX-style): fp32 in memory, e4m3 on the
+    # wire/compressed operands; arithmetic always in fp32
+    _F8 = np.dtype(ml_dtypes.float8_e4m3fn)
+    DEFAULT_ARITH_CONFIGS[("float8_e4m3fn", "float8_e4m3fn")] = ArithConfig(
+        _F8, _F8)
+    DEFAULT_ARITH_CONFIGS[("float32", "float8_e4m3fn")] = ArithConfig(
+        np.dtype("float32"), _F8)
 except ImportError:  # pragma: no cover
     pass
 

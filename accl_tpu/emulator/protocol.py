@@ -47,8 +47,12 @@ MSG_ETH = 50          # envelope + payload
 DTYPE_CODES = {
     "float32": 0, "float64": 1, "int32": 2, "int64": 3,
     "float16": 4, "bfloat16": 5, "int8": 6, "uint8": 7,
+    # quantized wire lanes (ml_dtypes); C++ twins in native/protocol.hpp
+    "float8_e4m3fn": 8, "float8_e5m2": 9,
 }
 CODE_DTYPES = {v: k for k, v in DTYPE_CODES.items()}
+
+_ML_DTYPE_NAMES = frozenset(("bfloat16", "float8_e4m3fn", "float8_e5m2"))
 
 
 def dtype_code(dt) -> int:
@@ -57,9 +61,10 @@ def dtype_code(dt) -> int:
 
 def code_dtype(code: int) -> np.dtype:
     name = CODE_DTYPES[code]
-    if name == "bfloat16":
-        import ml_dtypes
-        return np.dtype(ml_dtypes.bfloat16)
+    if name in _ML_DTYPE_NAMES:
+        import ml_dtypes  # registers the names with numpy
+
+        return np.dtype(getattr(ml_dtypes, name))
     return np.dtype(name)
 
 

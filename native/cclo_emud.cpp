@@ -101,6 +101,58 @@ static uint16_t float_to_bf16(float f) {
   return static_cast<uint16_t>(bits >> 16);
 }
 
+// fp8 codecs (ml_dtypes float8_e4m3fn / float8_e5m2 twins): decode is exact
+// per the bit layout; encode rounds to nearest representable (ties to the
+// even code) via a precomputed decode table, saturating at the max finite
+// value. e4m3fn: 1-4-3 bias 7, no inf, NaN = S.1111.111 (finite codes
+// 0x00..0x7E); e5m2: 1-5-2 bias 15, IEEE-style inf/NaN (finite 0x00..0x7B).
+static float fp8_decode(uint8_t v, bool e4m3) {
+  int mbits = e4m3 ? 3 : 2;
+  int ebits = e4m3 ? 4 : 5;
+  int bias = e4m3 ? 7 : 15;
+  int sign = v >> 7;
+  int exp = (v >> mbits) & ((1 << ebits) - 1);
+  int man = v & ((1 << mbits) - 1);
+  if (e4m3) {
+    if (exp == 15 && man == 7) return std::nanf("");
+  } else if (exp == 31) {
+    if (man) return std::nanf("");
+    return sign ? -INFINITY : INFINITY;
+  }
+  float val = exp == 0
+      ? std::ldexp(static_cast<float>(man), 1 - bias - mbits)
+      : std::ldexp(1.0f + man / static_cast<float>(1 << mbits), exp - bias);
+  return sign ? -val : val;
+}
+
+static uint8_t fp8_encode(float f, bool e4m3) {
+  static float dec_e4m3[0x7F], dec_e5m2[0x7C];
+  static bool init = [] {
+    for (int i = 0; i < 0x7F; ++i) dec_e4m3[i] = fp8_decode((uint8_t)i, true);
+    for (int i = 0; i < 0x7C; ++i) dec_e5m2[i] = fp8_decode((uint8_t)i, false);
+    return true;
+  }();
+  (void)init;
+  if (std::isnan(f)) return e4m3 ? 0x7F : 0x7E;
+  const float* dec = e4m3 ? dec_e4m3 : dec_e5m2;
+  int n = e4m3 ? 0x7F : 0x7C;  // finite positive codes [0, n)
+  uint8_t sign = std::signbit(f) ? 0x80 : 0;
+  float af = std::fabs(f);
+  if (!e4m3 && std::isinf(f)) return sign | 0x7C;
+  if (af >= dec[n - 1]) return sign | (uint8_t)(n - 1);  // saturate
+  // binary search the first code with dec[code] >= af, then round
+  int lo = 0, hi = n - 1;
+  while (lo < hi) {
+    int mid = (lo + hi) / 2;
+    if (dec[mid] < af) lo = mid + 1; else hi = mid;
+  }
+  if (lo == 0) return sign;
+  float up = dec[lo] - af, down = af - dec[lo - 1];
+  if (down < up) return sign | (uint8_t)(lo - 1);
+  if (up < down) return sign | (uint8_t)lo;
+  return sign | (uint8_t)((lo & 1) ? lo - 1 : lo);  // tie: even code
+}
+
 // read element i of a typed buffer as double
 static double load_elem(const uint8_t* p, uint8_t dt, size_t i) {
   switch (dt) {
@@ -110,6 +162,8 @@ static double load_elem(const uint8_t* p, uint8_t dt, size_t i) {
     case DT_I64: { int64_t v; std::memcpy(&v, p + 8 * i, 8); return (double)v; }
     case DT_F16: { uint16_t v; std::memcpy(&v, p + 2 * i, 2); return half_to_float(v); }
     case DT_BF16: { uint16_t v; std::memcpy(&v, p + 2 * i, 2); return bf16_to_float(v); }
+    case DT_F8E4M3: return fp8_decode(p[i], true);
+    case DT_F8E5M2: return fp8_decode(p[i], false);
     case DT_I8: return reinterpret_cast<const int8_t*>(p)[i];
     default: return p[i];
   }
@@ -123,6 +177,8 @@ static void store_elem(uint8_t* p, uint8_t dt, size_t i, double v) {
     case DT_I64: { int64_t x = (int64_t)llround(v); std::memcpy(p + 8 * i, &x, 8); break; }
     case DT_F16: { uint16_t h = float_to_half((float)v); std::memcpy(p + 2 * i, &h, 2); break; }
     case DT_BF16: { uint16_t b = float_to_bf16((float)v); std::memcpy(p + 2 * i, &b, 2); break; }
+    case DT_F8E4M3: p[i] = fp8_encode((float)v, true); break;
+    case DT_F8E5M2: p[i] = fp8_encode((float)v, false); break;
     case DT_I8: reinterpret_cast<int8_t*>(p)[i] = (int8_t)llround(v); break;
     default: p[i] = (uint8_t)llround(v); break;
   }

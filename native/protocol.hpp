@@ -77,6 +77,7 @@ static const uint32_t TAG_ANY = 0xFFFFFFFFu;
 enum DType : uint8_t {
   DT_F32 = 0, DT_F64 = 1, DT_I32 = 2, DT_I64 = 3,
   DT_F16 = 4, DT_BF16 = 5, DT_I8 = 6, DT_U8 = 7,
+  DT_F8E4M3 = 8, DT_F8E5M2 = 9,  // quantized wire lanes (ml_dtypes twins)
 };
 
 inline size_t dtype_size(uint8_t dt) {
@@ -84,7 +85,7 @@ inline size_t dtype_size(uint8_t dt) {
     case DT_F32: case DT_I32: return 4;
     case DT_F64: case DT_I64: return 8;
     case DT_F16: case DT_BF16: return 2;
-    default: return 1;
+    default: return 1;  // i8/u8/fp8
   }
 }
 

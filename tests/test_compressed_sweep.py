@@ -1,0 +1,317 @@
+"""Compression flag-product sweep: OP0/OP1/RES x ETH per collective per
+dtype pair, through the move engine (emu tier) and both socket daemons.
+
+Reference bar: test/host/test_compressed.py — a 1,444-line suite sweeping
+exactly this product. Flags arise the same way as the reference's
+prepare_call: operands allocated in the compressed dtype carry
+OP0/OP1/RES_COMPRESSED; ``compress_dtype=`` requests ETH (wire)
+compression. Pairs cover fp16 (the reference's clane pair), bf16 (the
+TPU-native half), and fp8-e4m3 (the quantized wire lane — codes 8/9 on
+the daemon wire, C++ codec in native/cclo_emud.cpp).
+
+Goldens are computed from the QUANTIZED inputs (storage compression is
+semantics, not error), with per-dtype tolerances absorbing wire/partial-
+sum requantization on ETH paths.
+"""
+
+import itertools
+import os
+import subprocess
+import time
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from accl_tpu import ReduceFunc
+from accl_tpu.testing import (connect_world, emu_world, free_port_base,
+                              run_ranks, sim_world)
+
+W = 3
+COUNT = 24
+
+PAIRS = [
+    pytest.param(np.dtype(np.float16), dict(atol=2e-2, rtol=1e-2),
+                 id="f32xf16"),
+    pytest.param(np.dtype(ml_dtypes.bfloat16), dict(atol=8e-2, rtol=4e-2),
+                 id="f32xbf16"),
+    pytest.param(np.dtype(ml_dtypes.float8_e4m3fn),
+                 dict(atol=0.35, rtol=0.3), id="f32xfp8"),
+]
+
+BOOLS = (False, True)
+
+
+@pytest.fixture(scope="module")
+def world():
+    accls = emu_world(W)
+    yield accls
+    for a in accls:
+        a.deinit()
+
+
+def _data(seed):
+    # uniform(-1, 1): W-rank sums stay well inside every wire dtype's range
+    return np.random.default_rng(seed).uniform(-1, 1, COUNT).astype(
+        np.float32)
+
+
+def _q(x, cdtype, compressed):
+    """Quantize through the storage dtype when the flag marks the operand
+    compressed — that is the semantic input, not an error source."""
+    return x.astype(cdtype).astype(np.float32) if compressed else x
+
+
+def _buf(a, data_f32, compressed, cdtype):
+    return a.buffer(data=data_f32.astype(cdtype) if compressed
+                    else data_f32)
+
+
+def _out(a, n, compressed, cdtype):
+    return a.buffer((n,), cdtype if compressed else np.float32)
+
+
+def _read(buf):
+    buf.sync_from_device()
+    return buf.data.astype(np.float32)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_copy_flags(world, cdtype, tol):
+    x = _data(1)
+    for c_op0, c_res in itertools.product(BOOLS, BOOLS):
+        a = world[0]
+        src = _buf(a, x, c_op0, cdtype)
+        dst = _out(a, COUNT, c_res, cdtype)
+        a.copy(src, dst)
+        np.testing.assert_allclose(_read(dst), _q(x, cdtype, c_op0), **tol)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_combine_flags(world, cdtype, tol):
+    x, y = _data(2), _data(3)
+    for c0, c1, cr in itertools.product(BOOLS, BOOLS, BOOLS):
+        a = world[0]
+        op0 = _buf(a, x, c0, cdtype)
+        op1 = _buf(a, y, c1, cdtype)
+        res = _out(a, COUNT, cr, cdtype)
+        a.combine(COUNT, ReduceFunc.SUM, op0, op1, res)
+        golden = _q(x, cdtype, c0) + _q(y, cdtype, c1)
+        np.testing.assert_allclose(_read(res), golden, **tol)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_sendrecv_flags(world, cdtype, tol):
+    x = _data(4)
+    for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
+        wire = cdtype if eth else None
+
+        def fn(a):
+            if a.rank == 0:
+                src = _buf(a, x, c_op0, cdtype)
+                a.send(src, COUNT, dst=2, tag=7, compress_dtype=wire)
+            elif a.rank == 2:
+                dst = _out(a, COUNT, c_res, cdtype)
+                a.recv(dst, COUNT, src=0, tag=7, compress_dtype=wire)
+                return _read(dst)
+            return None
+
+        out = run_ranks(world, fn)[2]
+        np.testing.assert_allclose(out, _q(x, cdtype, c_op0), **tol)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_bcast_flags(world, cdtype, tol):
+    x = _data(5)
+    for c_buf, eth in itertools.product(BOOLS, BOOLS):
+        wire = cdtype if eth else None
+
+        def fn(a):
+            if a.rank == 1:
+                buf = _buf(a, x, c_buf, cdtype)
+            else:
+                buf = _out(a, COUNT, c_buf, cdtype)
+            a.bcast(buf, COUNT, root=1, compress_dtype=wire)
+            return _read(buf)
+
+        for out in run_ranks(world, fn):
+            np.testing.assert_allclose(out, _q(x, cdtype, c_buf), **tol)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_scatter_flags(world, cdtype, tol):
+    x = _data(6)  # COUNT total; chunk = COUNT // W per rank
+    chunk = COUNT // W
+    for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
+        wire = cdtype if eth else None
+
+        def fn(a):
+            src = _buf(a, x, c_op0, cdtype) if a.rank == 0 else None
+            dst = _out(a, chunk, c_res, cdtype)
+            a.scatter(src, dst, chunk, root=0, compress_dtype=wire)
+            return _read(dst)
+
+        outs = run_ranks(world, fn)
+        golden = _q(x, cdtype, c_op0)
+        for r in range(W):
+            np.testing.assert_allclose(
+                outs[r], golden[r * chunk:(r + 1) * chunk], **tol)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_gather_flags(world, cdtype, tol):
+    ins = [_data(10 + r) for r in range(W)]
+    for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
+        wire = cdtype if eth else None
+
+        def fn(a):
+            src = _buf(a, ins[a.rank], c_op0, cdtype)
+            dst = _out(a, W * COUNT, c_res, cdtype) if a.rank == 1 else None
+            a.gather(src, dst, COUNT, root=1, compress_dtype=wire)
+            return _read(dst) if dst is not None else None
+
+        out = run_ranks(world, fn)[1]
+        for r in range(W):
+            np.testing.assert_allclose(
+                out[r * COUNT:(r + 1) * COUNT],
+                _q(ins[r], cdtype, c_op0), **tol)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_reduce_flags(world, cdtype, tol):
+    ins = [_data(20 + r) for r in range(W)]
+    for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
+        wire = cdtype if eth else None
+
+        def fn(a):
+            src = _buf(a, ins[a.rank], c_op0, cdtype)
+            dst = _out(a, COUNT, c_res, cdtype) if a.rank == 0 else None
+            a.reduce(src, dst, COUNT, root=0, compress_dtype=wire)
+            return _read(dst) if dst is not None else None
+
+        out = run_ranks(world, fn)[0]
+        golden = sum(_q(ins[r], cdtype, c_op0) for r in range(W))
+        np.testing.assert_allclose(out, golden, **tol)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_allgather_flags(world, cdtype, tol):
+    ins = [_data(30 + r) for r in range(W)]
+    for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
+        wire = cdtype if eth else None
+
+        def fn(a):
+            src = _buf(a, ins[a.rank], c_op0, cdtype)
+            dst = _out(a, W * COUNT, c_res, cdtype)
+            a.allgather(src, dst, COUNT, compress_dtype=wire)
+            return _read(dst)
+
+        for out in run_ranks(world, fn):
+            for r in range(W):
+                np.testing.assert_allclose(
+                    out[r * COUNT:(r + 1) * COUNT],
+                    _q(ins[r], cdtype, c_op0), **tol)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_allreduce_flags(world, cdtype, tol):
+    ins = [_data(40 + r) for r in range(W)]
+    for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
+        wire = cdtype if eth else None
+
+        def fn(a):
+            src = _buf(a, ins[a.rank], c_op0, cdtype)
+            dst = _out(a, COUNT, c_res, cdtype)
+            a.allreduce(src, dst, COUNT, compress_dtype=wire)
+            return _read(dst)
+
+        golden = sum(_q(ins[r], cdtype, c_op0) for r in range(W))
+        for out in run_ranks(world, fn):
+            np.testing.assert_allclose(out, golden, **tol)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_reduce_scatter_flags(world, cdtype, tol):
+    chunk = COUNT // W
+    ins = [_data(50 + r) for r in range(W)]
+    for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
+        wire = cdtype if eth else None
+
+        def fn(a):
+            src = _buf(a, ins[a.rank], c_op0, cdtype)
+            dst = _out(a, chunk, c_res, cdtype)
+            a.reduce_scatter(src, dst, chunk, compress_dtype=wire)
+            return _read(dst)
+
+        outs = run_ranks(world, fn)
+        golden = sum(_q(ins[r], cdtype, c_op0)
+                     for r in range(W))[:W * chunk].reshape(W, chunk)
+        for r in range(W):
+            np.testing.assert_allclose(outs[r][:chunk], golden[r], **tol)
+
+
+# -- daemon tiers: the same flag product through the socket protocol -------
+
+def _daemon_flag_product(accls, cdtype, tol):
+    """allreduce + send/recv across the full OP0 x RES x ETH product —
+    the daemon-tier cut of the sweep (the emu tier runs every op)."""
+    Wd = len(accls)
+    ins = [_data(60 + r) for r in range(Wd)]
+    for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
+        wire = cdtype if eth else None
+
+        def ar(a):
+            src = _buf(a, ins[a.rank], c_op0, cdtype)
+            dst = _out(a, COUNT, c_res, cdtype)
+            a.allreduce(src, dst, COUNT, compress_dtype=wire)
+            return _read(dst)
+
+        golden = sum(_q(ins[r], cdtype, c_op0) for r in range(Wd))
+        for out in run_ranks(accls, ar):
+            np.testing.assert_allclose(out, golden, **tol)
+
+        def sr(a):
+            if a.rank == 0:
+                src = _buf(a, ins[0], c_op0, cdtype)
+                a.send(src, COUNT, dst=1, tag=3, compress_dtype=wire)
+            elif a.rank == 1:
+                dst = _out(a, COUNT, c_res, cdtype)
+                a.recv(dst, COUNT, src=0, tag=3, compress_dtype=wire)
+                return _read(dst)
+            return None
+
+        np.testing.assert_allclose(run_ranks(accls, sr)[1],
+                                   _q(ins[0], cdtype, c_op0), **tol)
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_python_daemon_flag_product(cdtype, tol):
+    accls = sim_world(2)
+    try:
+        _daemon_flag_product(accls, cdtype, tol)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+@pytest.mark.parametrize("cdtype,tol", PAIRS)
+def test_native_daemon_flag_product(cdtype, tol):
+    binary = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "cclo_emud")
+    if not os.path.exists(binary):
+        pytest.skip("native daemon not built (make -C native)")
+    port_base = free_port_base()
+    procs = [subprocess.Popen(
+        [binary, "--rank", str(r), "--world", "2",
+         "--port-base", str(port_base)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    try:
+        time.sleep(0.5)
+        accls = connect_world(port_base, 2, timeout=15.0)
+        _daemon_flag_product(accls, cdtype, tol)
+        for a in accls:
+            a.deinit()
+    finally:
+        for p in procs:
+            p.kill()
