@@ -395,8 +395,10 @@ def expand_gather_ring(ctx: MoveContext, count: int, root: int, src: int,
         for _ in range(W - 1 - dist):
             moves += expand_recv(ctx, count, prev_in_ring, relay_buf,
                                  tag=TAG_ANY, compression=compression)
+            # the relay reads the RES-typed scratch the recv just wrote
             moves += expand_send(ctx, count, relay_buf, next_toward_root,
-                                 tag=TAG_ANY, compression=compression)
+                                 tag=TAG_ANY,
+                                 compression=res_as_op0(compression))
     return moves
 
 
@@ -449,8 +451,11 @@ def expand_allgather_ring(ctx: MoveContext, count: int, src: int, dst: int,
             m.blocking = True  # RAW hazard vs the relay below (c:788-791)
         moves += rx
         if i < W - 2:
+            # the relay reads the slot the recv just wrote, which is stored
+            # in the RES dtype — substitute the flag like the firmware's
+            # ETH/OP0 substitution when relaying from dst (c:739-743)
             moves += expand_send(ctx, count, slot, nxt, tag=TAG_ANY,
-                                 compression=compression)
+                                 compression=res_as_op0(compression))
     return moves
 
 
@@ -578,12 +583,19 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
     W, me = ctx.world_size, ctx.local_rank
     if W == 1:
         return expand_copy(ctx, count, src, dst, compression)
-    ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    # src chunks live in the OP0 dtype, dst chunks in the RES dtype — offsets
+    # must be computed with each buffer's own element size (the firmware's
+    # allreduce recomputes addresses per phase, c:966-979, 1031-1045)
+    e_src = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    e_dst = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
     bulk = count // W
     tail = count - bulk * (W - 1)  # last chunk absorbs the remainder
 
-    def chunk_off(c: int) -> int:
-        return c * bulk * ebytes
+    def src_off(c: int) -> int:
+        return src + c * bulk * e_src
+
+    def dst_off(c: int) -> int:
+        return dst + c * bulk * e_dst
 
     def chunk_len(c: int) -> int:
         return tail if c == W - 1 else bulk
@@ -594,7 +606,7 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
     # --- phase 1: ring reduce-scatter over chunks (c:982-1023) ---
     c0 = (me + 1) % W
     if chunk_len(c0):
-        moves += expand_send(ctx, chunk_len(c0), src + chunk_off(c0), nxt,
+        moves += expand_send(ctx, chunk_len(c0), src_off(c0), nxt,
                              tag=TAG_ANY, compression=compression)
     for i in range(1, W):
         c = (me + 1 + i) % W  # decreasing-rank flow: see reduce_scatter
@@ -602,23 +614,27 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
             continue
         if i < W - 1:
             moves += expand_fused_recv_reduce_send(
-                ctx, chunk_len(c), func, prv, nxt, src + chunk_off(c),
+                ctx, chunk_len(c), func, prv, nxt, src_off(c),
                 tag=TAG_ANY, compression=compression)
         else:
             # c == me: own fully-reduced chunk lands in dst
             moves += expand_fused_recv_reduce(
-                ctx, chunk_len(c), func, prv, src + chunk_off(c),
-                dst + chunk_off(c), tag=TAG_ANY, compression=compression)
+                ctx, chunk_len(c), func, prv, src_off(c),
+                dst_off(c), tag=TAG_ANY, compression=compression)
 
     # --- phase 2: ring allgather of reduced chunks from dst (c:1031-1095) ---
+    # every phase-2 read sources the RES-typed dst buffer, so the OP0 flag is
+    # substituted with the RES flag (the firmware reads dst with the RES
+    # compression in its allgather phase, c:1031-1095)
+    p2 = res_as_op0(compression)
     if chunk_len(me):
-        moves += expand_send(ctx, chunk_len(me), dst + chunk_off(me), nxt,
-                             tag=TAG_ANY, compression=compression)
+        moves += expand_send(ctx, chunk_len(me), dst_off(me), nxt,
+                             tag=TAG_ANY, compression=p2)
     for i in range(1, W):
         c = (me + i) % W  # decreasing-rank flow: chunk me+i arrives at round i
         if not chunk_len(c):
             continue
-        slot = dst + chunk_off(c)
+        slot = dst_off(c)
         rx = expand_recv(ctx, chunk_len(c), prv, slot, tag=TAG_ANY,
                          compression=compression)
         for m in rx:
@@ -626,7 +642,7 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
         moves += rx
         if i < W - 1:
             moves += expand_send(ctx, chunk_len(c), slot, nxt, tag=TAG_ANY,
-                                 compression=compression)
+                                 compression=p2)
     return moves
 
 

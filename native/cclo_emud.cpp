@@ -513,6 +513,16 @@ struct CallCtx {
   }
 };
 
+// remap the RES compressed-ness onto OP0: used whenever a move reads a
+// RES-typed (dst-resident) slot as its operand — relays from dst, folds
+// into dst, the bcast after a non-fused reduce (moveengine.res_as_op0)
+static CallCtx res_as_op0(const CallCtx& c) {
+  CallCtx rc = c;
+  rc.compression = (c.compression & ~uint8_t(C_OP0)) |
+                   ((c.compression & C_RES) ? C_OP0 : 0);
+  return rc;
+}
+
 static void push_send(std::vector<Move>& mv, const CallCtx& c, uint64_t count,
                       uint64_t src, uint32_t dst, uint32_t tag,
                       bool remote_stream = false) {
@@ -731,7 +741,8 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
         push_send(mv, c, count, a0, nxt, TAG_ANY);
         for (uint32_t i = 0; i < W - 1 - dist; ++i) {
           push_recv(mv, c, count, prv, a2, TAG_ANY);
-          push_send(mv, c, count, a2, nxt, TAG_ANY);
+          // relay reads the RES-typed scratch the recv just wrote
+          push_send(mv, res_as_op0(c), count, a2, nxt, TAG_ANY);
         }
       }
       return E_OK;
@@ -756,7 +767,8 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
         uint32_t owner = (me + W - 1 - i) % W;
         uint64_t slot = a2 + (uint64_t)owner * count * ebr;
         push_recv(mv, c, count, prv, slot, TAG_ANY);
-        if (i + 2 < W) push_send(mv, c, count, slot, nxt, TAG_ANY);
+        // the relay reads the RES-typed slot the recv just wrote
+        if (i + 2 < W) push_send(mv, res_as_op0(c), count, slot, nxt, TAG_ANY);
       }
       return E_OK;
     }
@@ -771,12 +783,8 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
         bool first = true;
         for (uint32_t r = 0; r < W; ++r) {
           if (r == root) continue;
-          CallCtx rc = c;
-          if (!first) {
-            // op0 is now dst, whose compressed-ness is the RES flag
-            rc.compression = (c.compression & ~uint8_t(C_OP0)) |
-                             ((c.compression & C_RES) ? C_OP0 : 0);
-          }
+          // later folds read dst as op0, whose compressed-ness is the RES flag
+          CallCtx rc = first ? c : res_as_op0(c);
           push_frr(mv, rc, count, func, r, first ? a0 : a2, a2, TAG_ANY);
           first = false;
         }
@@ -812,11 +820,8 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
         uint32_t err = expand(mv, c, OP_REDUCE, func, count, 0, tag, a0, 0,
                               a2, ALG_RING);
         if (err) return err;
-        CallCtx bc = c;
-        bc.compression = (c.compression & ~uint8_t(C_OP0)) |
-                         ((c.compression & C_RES) ? C_OP0 : 0);
-        return expand(mv, bc, OP_BCAST, func, count, 0, tag, a2, 0, 0,
-                      ALG_AUTO);
+        return expand(mv, res_as_op0(c), OP_BCAST, func, count, 0, tag, a2,
+                      0, 0, ALG_AUTO);
       }
       uint64_t bulk = count / W;
       uint64_t tail = count - bulk * (W - 1);
@@ -835,14 +840,16 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
           push_frr(mv, c, clen(ch), func, prv, a0 + coff(ch) * eb,
                    a2 + coff(ch) * ebr, TAG_ANY);
       }
-      // phase 2: ring allgather from dst
-      if (clen(me)) push_send(mv, c, clen(me), a2 + coff(me) * ebr, nxt, TAG_ANY);
+      // phase 2: ring allgather from dst — every read sources the RES-typed
+      // dst buffer, so the OP0 flag is substituted with the RES flag
+      CallCtx p2 = res_as_op0(c);
+      if (clen(me)) push_send(mv, p2, clen(me), a2 + coff(me) * ebr, nxt, TAG_ANY);
       for (uint32_t i = 1; i < W; ++i) {
         uint32_t ch = (me + i) % W;
         if (!clen(ch)) continue;
         uint64_t slot = a2 + coff(ch) * ebr;
         push_recv(mv, c, clen(ch), prv, slot, TAG_ANY);
-        if (i + 1 < W) push_send(mv, c, clen(ch), slot, nxt, TAG_ANY);
+        if (i + 1 < W) push_send(mv, p2, clen(ch), slot, nxt, TAG_ANY);
       }
       return E_OK;
     }
