@@ -667,20 +667,22 @@ def expand_alltoall(ctx: MoveContext, count: int, src: int, dst: int,
     XRT enums): rank r sends chunk d to rank d and receives chunk s from
     every s. ``count`` is the per-pair chunk size."""
     W, me = ctx.world_size, ctx.local_rank
-    ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    # src chunks are OP0-typed, dst chunks RES-typed — separate element sizes
+    e_src = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    e_dst = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
     moves: list[Move] = []
-    moves += expand_copy(ctx, count, src + me * count * ebytes,
-                         dst + me * count * ebytes, compression)
+    moves += expand_copy(ctx, count, src + me * count * e_src,
+                         dst + me * count * e_dst, compression)
     # round-robin schedule avoiding head-of-line blocking
     for step in range(1, W):
         to = (me + step) % W
         frm = (me - step) % W
-        sends = expand_send(ctx, count, src + to * count * ebytes, to,
+        sends = expand_send(ctx, count, src + to * count * e_src, to,
                             tag=TAG_ANY, compression=compression)
         for m in sends:
             m.blocking = False
         moves += sends
-        moves += expand_recv(ctx, count, frm, dst + frm * count * ebytes,
+        moves += expand_recv(ctx, count, frm, dst + frm * count * e_dst,
                              tag=TAG_ANY, compression=compression)
     return moves
 

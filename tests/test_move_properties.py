@@ -1,0 +1,269 @@
+"""Move-level executability properties of the flag algebra.
+
+For a seeded product of (op, world size, compression flags, segment size,
+algorithm, root), expand every rank's move program and *statically* execute
+the whole world against typed memories and in-order message queues — no
+fabric, no threads. A program is executable iff:
+
+  * every IMMEDIATE read stays inside a registered buffer AND every byte it
+    covers is currently typed with the dtype the read expects (a relay that
+    reads a RES-typed slot with the OP0 flag fails here — exactly the bug
+    class the round-2 compression sweep caught at runtime);
+  * every ON_RECV is eventually matched by a message whose element count
+    equals the move's count (the executor's DMA_MISMATCH check);
+  * the world quiesces — no deadlock, no undelivered messages.
+
+Reference bar: the firmware's substitution rules are the single source of
+truth for which stage reads which buffer with which compression
+(ccl_offload_control.c:533-535 bcast, :739-743 allgather ETH substitution,
+:1031-1095 allreduce phase 2 reading dst). This suite pins the same truth
+at the move level for the Python engine; the C++ daemon shares the
+schedule move-for-move (native/cclo_emud.cpp expand()), so a divergence
+there shows up as a runtime failure in test_compressed_sweep.py.
+"""
+
+import itertools
+import random
+from collections import deque
+
+import pytest
+
+from accl_tpu.arith import ArithConfig
+from accl_tpu.constants import (CCLOp, CollectiveAlgorithm, Compression,
+                                ReduceFunc, TAG_ANY)
+from accl_tpu.moveengine import MoveContext, MoveMode, expand_call
+
+U_BYTES = 4  # uncompressed elem size (fp32)
+
+
+class RankState:
+    """Typed memory + program counter for one simulated rank."""
+
+    def __init__(self, rank, moves):
+        self.rank = rank
+        self.moves = moves
+        self.pc = 0
+        self.regions = []       # (start, nbytes)
+        self.types = {}         # byte addr -> "u" | "c"
+
+    def alloc(self, addr, nelems, compressed, c_bytes):
+        esize = c_bytes if compressed else U_BYTES
+        nbytes = nelems * esize
+        self.regions.append((addr, nbytes))
+        tag = "c" if compressed else "u"
+        for b in range(addr, addr + nbytes):
+            self.types[b] = tag
+
+    def _in_region(self, addr, nbytes):
+        return any(start <= addr and addr + nbytes <= start + size
+                   for start, size in self.regions)
+
+    def check_read(self, addr, nelems, compressed, c_bytes, what):
+        esize = c_bytes if compressed else U_BYTES
+        nbytes = nelems * esize
+        assert self._in_region(addr, nbytes), (
+            f"rank {self.rank} move {self.pc}: {what} read "
+            f"[0x{addr:x}, +{nbytes}) outside any buffer")
+        tag = "c" if compressed else "u"
+        bad = [b for b in range(addr, addr + nbytes)
+               if self.types.get(b) != tag]
+        assert not bad, (
+            f"rank {self.rank} move {self.pc}: {what} reads byte "
+            f"0x{bad[0]:x} typed {self.types.get(bad[0])!r} with the "
+            f"{tag!r} flag — writer/reader dtype mismatch")
+
+    def write(self, addr, nelems, compressed, c_bytes, what):
+        esize = c_bytes if compressed else U_BYTES
+        nbytes = nelems * esize
+        assert self._in_region(addr, nbytes), (
+            f"rank {self.rank} move {self.pc}: {what} write "
+            f"[0x{addr:x}, +{nbytes}) outside any buffer")
+        tag = "c" if compressed else "u"
+        for b in range(addr, addr + nbytes):
+            self.types[b] = tag
+
+
+def run_world(states, c_bytes):
+    """Cooperative scheduler: runs every rank's program to completion,
+    enforcing typed reads, in-order matched messages, and quiescence."""
+    queues = {}  # (src, dst) -> deque of (tag, nelems)
+
+    def runnable(st):
+        mv = st.moves[st.pc]
+        for op in (mv.op0, mv.op1):
+            if op.mode == MoveMode.ON_RECV:
+                q = queues.get((op.src_rank, st.rank))
+                if not q:
+                    return False
+                tag, nelems = q[0]
+                # pool matching: exact next-seqn message must satisfy the
+                # posted tag (TAG_ANY matches anything on either side)
+                if (mv.op1.tag != TAG_ANY and tag != TAG_ANY
+                        and tag != mv.op1.tag):
+                    return False
+        return True
+
+    def step(st):
+        mv = st.moves[st.pc]
+        for name, op in (("op0", mv.op0), ("op1", mv.op1)):
+            if op.mode == MoveMode.IMMEDIATE:
+                st.check_read(op.addr, mv.count, op.compressed, c_bytes, name)
+            elif op.mode == MoveMode.ON_RECV:
+                tag, nelems = queues[(op.src_rank, st.rank)].popleft()
+                assert nelems == mv.count, (
+                    f"rank {st.rank} move {st.pc}: expects {mv.count} elems "
+                    f"from {op.src_rank}, message carries {nelems} "
+                    f"(DMA_MISMATCH)")
+        if mv.res_local and mv.res.mode == MoveMode.IMMEDIATE:
+            st.write(mv.res.addr, mv.count, mv.res.compressed, c_bytes, "res")
+        if mv.res_remote:
+            queues.setdefault((st.rank, mv.dst_rank), deque()).append(
+                (mv.tag, mv.count))
+        st.pc += 1
+
+    while any(st.pc < len(st.moves) for st in states):
+        progressed = False
+        for st in states:
+            while st.pc < len(st.moves) and runnable(st):
+                step(st)
+                progressed = True
+        if not progressed:
+            stuck = {st.rank: f"move {st.pc}/{len(st.moves)}"
+                     for st in states if st.pc < len(st.moves)}
+            raise AssertionError(f"deadlock: {stuck}, queues="
+                                 f"{ {k: list(v) for k, v in queues.items()} }")
+    leftovers = {k: list(v) for k, v in queues.items() if v}
+    assert not leftovers, f"undelivered messages: {leftovers}"
+
+
+def build_world(op, W, count, c_op0, c_op1, c_res, eth, seg_bytes, c_bytes,
+                root, algorithm):
+    """Expand per-rank programs with driver-faithful flag derivation
+    (accl.py _prepare: each operand's flag reflects its own buffer's
+    storage dtype; gather non-root scratch inherits the src dtype)."""
+    import numpy as np
+    cfg = ArithConfig(np.dtype(np.float32),
+                      np.dtype(np.float16 if c_bytes == 2 else np.int8))
+    SRC, OP1, DST = 0x1000, 0x8000, 0x10000
+
+    # per-op buffer shapes (elements), in driver semantics
+    shapes = {
+        CCLOp.copy: (count, None, count),
+        CCLOp.combine: (count, count, count),
+        CCLOp.bcast: (count, None, None),
+        CCLOp.scatter: (W * count, None, count),
+        CCLOp.gather: (count, None, W * count),
+        CCLOp.reduce: (count, None, count),
+        CCLOp.allgather: (count, None, W * count),
+        CCLOp.allreduce: (count, None, count),
+        CCLOp.reduce_scatter: (W * count, None, count),
+        CCLOp.alltoall: (W * count, None, W * count),
+    }
+    n_src, n_op1, n_dst = shapes[op]
+
+    states = []
+    for r in range(W):
+        comp = Compression.NONE
+        if eth:
+            comp |= Compression.ETH_COMPRESSED
+        src_c, res_c = c_op0, c_res
+        if op == CCLOp.bcast:
+            res_c = src_c  # one buffer: OP0 and RES flags coincide
+        if op == CCLOp.gather and r != root:
+            res_c = src_c  # scratch relay buffer inherits src dtype
+        if op == CCLOp.reduce and r != root:
+            res_c = None   # non-root passes no result buffer
+        if op == CCLOp.scatter and r != root:
+            src_c = None   # non-root passes no source buffer
+        if src_c is not None and src_c:
+            comp |= Compression.OP0_COMPRESSED
+        if c_op1 is not None and n_op1 and c_op1:
+            comp |= Compression.OP1_COMPRESSED
+        if res_c is not None and res_c:
+            comp |= Compression.RES_COMPRESSED
+
+        ctx = MoveContext(world_size=W, local_rank=r, arithcfg=cfg,
+                          max_segment_size=seg_bytes)
+        moves = expand_call(
+            ctx, op, count=count, root_src_dst=root, func=ReduceFunc.SUM,
+            tag=TAG_ANY, addr_0=SRC, addr_1=OP1, addr_2=DST,
+            compression=comp, algorithm=algorithm)
+        st = RankState(r, moves)
+        if src_c is not None:
+            st.alloc(SRC, n_src, src_c, c_bytes)
+        if n_op1:
+            st.alloc(OP1, n_op1, c_op1, c_bytes)
+        if res_c is not None and n_dst:
+            # gather non-root scratch is count elems, not W*count
+            nd = count if (op == CCLOp.gather and r != root) else n_dst
+            st.alloc(DST, nd, res_c, c_bytes)
+        states.append(st)
+    return states
+
+
+POINT_TO_POINT = {CCLOp.copy, CCLOp.combine}
+ALGS = {
+    CCLOp.copy: [CollectiveAlgorithm.AUTO],
+    CCLOp.combine: [CollectiveAlgorithm.AUTO],
+    CCLOp.bcast: [CollectiveAlgorithm.AUTO, CollectiveAlgorithm.TREE],
+    CCLOp.scatter: [CollectiveAlgorithm.AUTO],
+    CCLOp.gather: [CollectiveAlgorithm.AUTO, CollectiveAlgorithm.ROUND_ROBIN],
+    CCLOp.reduce: [CollectiveAlgorithm.AUTO, CollectiveAlgorithm.ROUND_ROBIN],
+    CCLOp.allgather: [CollectiveAlgorithm.AUTO,
+                      CollectiveAlgorithm.ROUND_ROBIN],
+    CCLOp.allreduce: [CollectiveAlgorithm.AUTO,
+                      CollectiveAlgorithm.NON_FUSED],
+    CCLOp.reduce_scatter: [CollectiveAlgorithm.AUTO],
+    CCLOp.alltoall: [CollectiveAlgorithm.AUTO],
+}
+
+
+@pytest.mark.parametrize("op", sorted(ALGS, key=lambda o: o.value),
+                         ids=lambda o: o.name)
+def test_full_flag_product_small_world(op):
+    """Exhaustive OP0 x OP1 x RES x ETH product at W=3 for every algorithm
+    — the static analog of the runtime compression sweep."""
+    W, count = 3, 7
+    for alg in ALGS[op]:
+        for c0, c1, cr, eth in itertools.product((False, True), repeat=4):
+            states = build_world(op, 1 if op in POINT_TO_POINT else W,
+                                 count, c0, c1, cr, eth,
+                                 seg_bytes=1 << 20, c_bytes=2,
+                                 root=0 if op in POINT_TO_POINT else 1,
+                                 algorithm=alg)
+            run_world(states, c_bytes=2)
+
+
+def test_seeded_random_product():
+    """Randomized sweep over (op, W, count, flags, segment size, fp8-width
+    wire, root, algorithm): 300 seeded configurations, including tail
+    chunks (count < W), forced segmentation, and 1-byte compressed
+    elements."""
+    rng = random.Random(0xACC1)
+    ops = [op for op in ALGS if op not in POINT_TO_POINT]
+    for _ in range(300):
+        op = rng.choice(ops)
+        W = rng.randint(2, 8)
+        count = rng.randint(1, 33)
+        c_bytes = rng.choice((1, 2))          # fp8 / fp16-bf16 wire widths
+        seg_bytes = rng.choice((8, 64, 1 << 20))  # force multi-segment moves
+        root = rng.randrange(W)
+        alg = rng.choice(ALGS[op])
+        c0, c1, cr, eth = (rng.random() < 0.5 for _ in range(4))
+        states = build_world(op, W, count, c0, c1, cr, eth,
+                             seg_bytes, c_bytes, root, alg)
+        run_world(states, c_bytes)
+
+
+def test_catches_the_round2_bug_class():
+    """Meta-test: a deliberately wrong relay (reading a RES-typed slot with
+    the OP0 flag) must be rejected — proving the checker has teeth."""
+    from accl_tpu.moveengine import Move, Operand
+
+    st = RankState(0, [])
+    st.alloc(0x1000, 8, True, 2)   # 8 elems stored compressed (16 bytes)
+    st.moves = [Move(count=8,
+                     op0=Operand.imm(0x1000, False),  # read as uncompressed
+                     res=Operand.imm(0x1000, True), res_local=True)]
+    with pytest.raises(AssertionError, match="dtype mismatch|outside"):
+        run_world([st], c_bytes=2)
