@@ -14,10 +14,12 @@ mode elsewhere, so one code path serves the CPU test tiers and the chip.
 
 from .combine import combine, combine_pallas
 from .compression import (cast_lane, compress_fp8, decompress_fp8,
+                          fp8_dequantize, fp8_quantize,
                           wire_compress, wire_decompress)
 from .attention import flash_attention
 
 __all__ = [
     "combine", "combine_pallas", "cast_lane", "compress_fp8",
-    "decompress_fp8", "wire_compress", "wire_decompress", "flash_attention",
+    "decompress_fp8", "fp8_quantize", "fp8_dequantize",
+    "wire_compress", "wire_decompress", "flash_attention",
 ]

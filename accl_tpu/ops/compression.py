@@ -88,6 +88,40 @@ def cast_lane(x: jax.Array, dtype) -> jax.Array:
 FP8 = jnp.float8_e4m3fn
 _FP8_MAX = 448.0  # finfo max of e4m3fn
 
+# names the collectives dataplane recognizes as scaled-codec wire dtypes
+FP8_DTYPE_NAMES = ("float8_e4m3fn", "float8_e5m2")
+
+
+def fp8_quantize(x: jax.Array, wire_dtype,
+                 axes: tuple[int, ...] | None = None
+                 ) -> tuple[jax.Array, jax.Array]:
+    """THE scaled-fp8 wire policy, as pure jnp — shard-safe (traceable
+    inside shard_map ring loops, where XLA fuses it into the ppermute's
+    producers) and bitwise-identical to the Pallas codec below (both
+    multiply by the reciprocal scale).
+
+    scale = max(amax / finfo(wire).max, 1e-30); ``axes=None`` gives one
+    per-tensor scale, a tuple gives an amax over those axes (the
+    per-(rank, chunk) scales of the fused reduce-scatter path).
+    Returns (fp8 payload, fp32 scale)."""
+    xf = x.astype(jnp.float32)
+    fp8_max = float(jnp.finfo(wire_dtype).max)
+    amax = (jnp.max(jnp.abs(xf)) if axes is None
+            else jnp.max(jnp.abs(xf), axis=axes))
+    scale = jnp.maximum(amax / fp8_max, 1e-30)
+    bshape = scale.shape + (1,) * (xf.ndim - scale.ndim)
+    q = (xf * (1.0 / scale).reshape(bshape)).astype(wire_dtype)
+    return q, scale
+
+
+def fp8_dequantize(q: jax.Array, scale: jax.Array,
+                   dtype=jnp.float32) -> jax.Array:
+    """Inverse of :func:`fp8_quantize`; broadcasts the scale over the
+    payload's trailing axes."""
+    bshape = scale.shape + (1,) * (q.ndim - scale.ndim)
+    return (q.astype(jnp.float32)
+            * scale.reshape(bshape)).astype(dtype)
+
 
 def _quant_kernel(x_ref, inv_ref, o_ref):
     o_ref[:] = (x_ref[:] * inv_ref[0, 0]).astype(o_ref.dtype)
@@ -97,20 +131,23 @@ def _dequant_kernel(q_ref, scale_ref, o_ref):
     o_ref[:] = q_ref[:].astype(o_ref.dtype) * scale_ref[0, 0]
 
 
-@jax.jit
-def compress_fp8(x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """x (float) -> (fp8 payload, fp32 scale). scale = amax/448 so the
-    payload spans the fp8 dynamic range; the (1,1) scale rides the wire
-    alongside the payload (4 bytes per message)."""
+@functools.partial(jax.jit, static_argnames=("wire_dtype",))
+def compress_fp8(x: jax.Array, wire_dtype=FP8
+                 ) -> tuple[jax.Array, jax.Array]:
+    """x (float) -> (fp8 payload, fp32 scale). Pallas-kernel form of
+    :func:`fp8_quantize` for the standalone lane (same scale policy, same
+    reciprocal-multiply rounding; the (1,1) scale rides the wire alongside
+    the payload, 4 bytes per message). ``wire_dtype`` picks the fp8
+    flavor (e4m3fn default, e5m2 for the wide-range lane)."""
     tiles, n, _ = _tiled(x)
     amax = jnp.max(jnp.abs(tiles.astype(jnp.float32)))
-    scale = jnp.maximum(amax / _FP8_MAX, 1e-30)
+    scale = jnp.maximum(amax / float(jnp.finfo(wire_dtype).max), 1e-30)
     inv = (1.0 / scale).reshape(1, 1)
     rows, cols = tiles.shape
     block = (min(_BLOCK_ROWS, rows), cols)
     q = pl.pallas_call(
         _quant_kernel,
-        out_shape=jax.ShapeDtypeStruct(tiles.shape, FP8),
+        out_shape=jax.ShapeDtypeStruct(tiles.shape, jnp.dtype(wire_dtype)),
         grid=(pl.cdiv(rows, block[0]),),
         in_specs=[
             pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
@@ -155,8 +192,8 @@ def wire_compress(x: jax.Array, wire_dtype):
     wd = jnp.dtype(wire_dtype)
     if wd == x.dtype:
         return x, None
-    if wd in (jnp.dtype(jnp.float8_e4m3fn), jnp.dtype(jnp.float8_e5m2)):
-        return compress_fp8(x)
+    if wd.name in FP8_DTYPE_NAMES:
+        return compress_fp8(x, wire_dtype=wd)
     return cast_lane(x, wd), None
 
 

@@ -32,6 +32,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..constants import ReduceFunc
+from ..ops.compression import FP8_DTYPE_NAMES, fp8_dequantize, fp8_quantize
 
 _REDUCE_OPS: dict[ReduceFunc, Callable] = {
     ReduceFunc.SUM: jnp.add,
@@ -65,28 +66,20 @@ def _ring_perm(W: int) -> list[tuple[int, int]]:
     return [(i, (i - 1) % W) for i in range(W)]
 
 
-_FP8_DTYPES = ("float8_e4m3fn", "float8_e5m2")
-
-
 def _hop(x: jnp.ndarray, axis_name: str, perm, wire_dtype) -> jnp.ndarray:
     """One ring hop, optionally compressed on the wire.
 
     fp16/bf16 wire dtypes are straight casts (the reference's fp32<->fp16
-    clane); fp8 dtypes use the scaled codec (per-hop absmax scale travels
-    with the payload — the EQuARX-style quantized-collective extension,
-    ops/compression.compress_fp8)."""
+    clane); fp8 dtypes use the shared scaled codec (per-hop absmax scale
+    travels with the payload — the EQuARX-style quantized-collective
+    extension, ops/compression.fp8_quantize)."""
     if wire_dtype is None or x.dtype == jnp.dtype(wire_dtype):
         return lax.ppermute(x, axis_name, perm)
-    if jnp.dtype(wire_dtype).name in _FP8_DTYPES:
-        # inline jnp codec (not the Pallas one in ops/compression): inside
-        # a shard_map ring loop XLA fuses the scale/cast into the permute's
-        # producers, and pallas_call would need vma plumbing here
-        xf = x.astype(jnp.float32)
-        fp8_max = float(jnp.finfo(wire_dtype).max)
-        scale = jnp.maximum(jnp.max(jnp.abs(xf)) / fp8_max, 1e-30)
-        q = lax.ppermute((xf / scale).astype(wire_dtype), axis_name, perm)
+    if jnp.dtype(wire_dtype).name in FP8_DTYPE_NAMES:
+        q, scale = fp8_quantize(x, wire_dtype)
+        q = lax.ppermute(q, axis_name, perm)
         scale = lax.ppermute(scale, axis_name, perm)
-        return (q.astype(jnp.float32) * scale).astype(x.dtype)
+        return fp8_dequantize(q, scale, x.dtype)
     return lax.ppermute(x.astype(wire_dtype), axis_name, perm).astype(x.dtype)
 
 
@@ -221,17 +214,12 @@ def xla_compressed_reduce_scatter_shard(chunks: jnp.ndarray, axis_name: str,
     fp8 wires carry a per-(rank, chunk) absmax scale alongside the payload
     (EQuARX-style), like the ring-hop codec."""
     dtype = chunks.dtype
-    if jnp.dtype(wire_dtype).name in _FP8_DTYPES:
-        xf = chunks.astype(jnp.float32)
-        fp8_max = float(jnp.finfo(wire_dtype).max)
-        tail = tuple(range(1, xf.ndim))
-        scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=tail) / fp8_max,
-                            1e-30)                           # (W,)
-        bshape = (-1,) + (1,) * (xf.ndim - 1)
-        q = (xf / scale.reshape(bshape)).astype(wire_dtype)
+    if jnp.dtype(wire_dtype).name in FP8_DTYPE_NAMES:
+        tail = tuple(range(1, chunks.ndim))
+        q, scale = fp8_quantize(chunks, wire_dtype, axes=tail)  # (W,) scales
         q = alltoall_shard(q, axis_name)
         scale = lax.all_to_all(scale, axis_name, 0, 0)
-        up = q.astype(jnp.float32) * scale.reshape(bshape)
+        up = fp8_dequantize(q, scale)
         return _AXIS_REDUCERS[func](up, axis=0).astype(dtype)
     recv = alltoall_shard(chunks.astype(wire_dtype), axis_name)
     return _AXIS_REDUCERS[func](recv.astype(dtype), axis=0)
@@ -242,14 +230,11 @@ def xla_compressed_allgather_shard(x: jnp.ndarray, axis_name: str,
     """All-gather with a compressed wire: a straight cast each way — no
     arithmetic happens in the wire dtype. fp8 wires gather a per-rank
     scale next to the payload."""
-    if jnp.dtype(wire_dtype).name in _FP8_DTYPES:
-        xf = x.astype(jnp.float32)
-        fp8_max = float(jnp.finfo(wire_dtype).max)
-        scale = jnp.maximum(jnp.max(jnp.abs(xf)) / fp8_max, 1e-30)
-        q = lax.all_gather((xf / scale).astype(wire_dtype), axis_name)
+    if jnp.dtype(wire_dtype).name in FP8_DTYPE_NAMES:
+        q, scale = fp8_quantize(x, wire_dtype)
+        q = lax.all_gather(q, axis_name)
         s = lax.all_gather(scale, axis_name)
-        return (q.astype(jnp.float32)
-                * s.reshape((-1,) + (1,) * x.ndim)).astype(x.dtype)
+        return fp8_dequantize(q, s, x.dtype)
     return lax.all_gather(x.astype(wire_dtype), axis_name).astype(x.dtype)
 
 

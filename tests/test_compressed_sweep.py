@@ -9,9 +9,15 @@ compression. Pairs cover fp16 (the reference's clane pair), bf16 (the
 TPU-native half), and fp8-e4m3 (the quantized wire lane — codes 8/9 on
 the daemon wire, C++ codec in native/cclo_emud.cpp).
 
-Goldens are computed from the QUANTIZED inputs (storage compression is
-semantics, not error), with per-dtype tolerances absorbing wire/partial-
-sum requantization on ETH paths.
+Goldens are EXACT: every path's quantization sequence is replayed in the
+test (storage casts, per-hop wire casts of ring partials, dst-store
+casts), which is possible because all quantizers in a given sweep cell
+are the same idempotent dtype cast and the emulator accumulates partials
+in f32 with the same deterministic ring order the goldens use. The emu
+and Python-daemon tiers must match bitwise; the native daemon gets a
+one-quantum allowance for its independent C++ software codecs.
+(Round-2 review flagged the previous flat fp8 tolerance, atol=0.35, as
+loose enough to hide a missing-scale bug — exact goldens close that.)
 """
 
 import itertools
@@ -29,17 +35,76 @@ from accl_tpu.testing import (connect_world, emu_world, free_port_base,
 
 W = 3
 COUNT = 24
+CHUNK = COUNT // W
 
 PAIRS = [
-    pytest.param(np.dtype(np.float16), dict(atol=2e-2, rtol=1e-2),
-                 id="f32xf16"),
-    pytest.param(np.dtype(ml_dtypes.bfloat16), dict(atol=8e-2, rtol=4e-2),
-                 id="f32xbf16"),
-    pytest.param(np.dtype(ml_dtypes.float8_e4m3fn),
-                 dict(atol=0.35, rtol=0.3), id="f32xfp8"),
+    pytest.param(np.dtype(np.float16), id="f32xf16"),
+    pytest.param(np.dtype(ml_dtypes.bfloat16), id="f32xbf16"),
+    pytest.param(np.dtype(ml_dtypes.float8_e4m3fn), id="f32xfp8"),
 ]
 
 BOOLS = (False, True)
+
+
+def _quant(cdtype):
+    """The one quantizer of a sweep cell: f32 -> cdtype -> f32."""
+    return lambda x: x.astype(cdtype).astype(np.float32)
+
+
+def golden_ring_reduce_chunk(ins_sl, ch, c_op0, c_res, eth, q):
+    """Fully-reduced chunk ``ch`` exactly as the fused ring computes it:
+    accumulation order ch-1, ch-2, ..., ch+1, finally ch (decreasing-rank
+    flow, moveengine.expand_allreduce_ring phase 1 / firmware c:982-1023).
+
+    Two quantization sources are replayed: the travelling partial is
+    wire-cast whenever the emission dtype is the compressed one (ETH
+    requested, or the rank's resolved config is same-dtype because ALL its
+    operands are compressed — then u == c and even 'uncompressed' wire
+    emissions are narrow), and each add itself rounds when the arithmetic
+    dtype is the compressed one (same-dtype config)."""
+    Wn = len(ins_sl)
+    all_c = c_op0 and c_res  # same-dtype config: arith + wire both narrow
+    p = ins_sl[(ch - 1) % Wn].astype(np.float32)
+    for k in range(2, Wn + 1):
+        if eth or all_c:
+            p = q(p)                        # wire cast of the partial
+        p = p + ins_sl[(ch - k) % Wn]
+        if all_c:
+            p = q(p)                        # add rounded in compressed arith
+    return p
+
+
+def golden_allreduce(ins_q, c_op0, c_res, eth, q):
+    """Exact per-rank expected outputs of the fused ring allreduce
+    (any world size; bulk/tail chunking like expand_allreduce_ring)."""
+    Wn, n = len(ins_q), ins_q[0].size
+    bulk = n // Wn
+    all_c = c_op0 and c_res
+    out = np.zeros((Wn, n), np.float32)
+    for ch in range(Wn):
+        end = n if ch == Wn - 1 else (ch + 1) * bulk
+        sl = slice(ch * bulk, end)
+        p = golden_ring_reduce_chunk([x[sl] for x in ins_q], ch,
+                                     c_op0, c_res, eth, q)
+        mine = q(p) if c_res else p          # stored in rank ch's dst
+        trav = mine                           # phase-2 travelled copy
+        if eth or all_c:
+            trav = q(trav)
+        if c_res:
+            trav = q(trav)
+        for r in range(Wn):
+            out[r][sl] = mine if r == ch else trav
+    return out
+
+
+def _quantum(v, cdtype):
+    """Spacing of ``cdtype`` at each |v| (one representable-value step)."""
+    try:
+        f = np.finfo(cdtype)
+    except ValueError:                     # ml_dtypes (bf16/fp8) dtypes
+        f = ml_dtypes.finfo(cdtype)
+    a = np.maximum(np.abs(v).astype(np.float32), float(f.smallest_normal))
+    return (2.0 ** np.floor(np.log2(a)) * float(f.eps)).astype(np.float32)
 
 
 @pytest.fixture(scope="module")
@@ -76,33 +141,39 @@ def _read(buf):
     return buf.data.astype(np.float32)
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_copy_flags(world, cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_copy_flags(world, cdtype):
     x = _data(1)
+    q = _quant(cdtype)
     for c_op0, c_res in itertools.product(BOOLS, BOOLS):
         a = world[0]
         src = _buf(a, x, c_op0, cdtype)
         dst = _out(a, COUNT, c_res, cdtype)
         a.copy(src, dst)
-        np.testing.assert_allclose(_read(dst), _q(x, cdtype, c_op0), **tol)
+        expect = q(x) if (c_op0 or c_res) else x
+        np.testing.assert_array_equal(_read(dst), expect)
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_combine_flags(world, cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_combine_flags(world, cdtype):
     x, y = _data(2), _data(3)
+    q = _quant(cdtype)
     for c0, c1, cr in itertools.product(BOOLS, BOOLS, BOOLS):
         a = world[0]
         op0 = _buf(a, x, c0, cdtype)
         op1 = _buf(a, y, c1, cdtype)
         res = _out(a, COUNT, cr, cdtype)
         a.combine(COUNT, ReduceFunc.SUM, op0, op1, res)
-        golden = _q(x, cdtype, c0) + _q(y, cdtype, c1)
-        np.testing.assert_allclose(_read(res), golden, **tol)
+        expect = _q(x, cdtype, c0) + _q(y, cdtype, c1)
+        if cr:
+            expect = q(expect)
+        np.testing.assert_array_equal(_read(res), expect)
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_sendrecv_flags(world, cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_sendrecv_flags(world, cdtype):
     x = _data(4)
+    q = _quant(cdtype)
     for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
         wire = cdtype if eth else None
 
@@ -117,12 +188,14 @@ def test_sendrecv_flags(world, cdtype, tol):
             return None
 
         out = run_ranks(world, fn)[2]
-        np.testing.assert_allclose(out, _q(x, cdtype, c_op0), **tol)
+        expect = q(x) if (c_op0 or eth or c_res) else x
+        np.testing.assert_array_equal(out, expect)
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_bcast_flags(world, cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_bcast_flags(world, cdtype):
     x = _data(5)
+    q = _quant(cdtype)
     for c_buf, eth in itertools.product(BOOLS, BOOLS):
         wire = cdtype if eth else None
 
@@ -134,33 +207,38 @@ def test_bcast_flags(world, cdtype, tol):
             a.bcast(buf, COUNT, root=1, compress_dtype=wire)
             return _read(buf)
 
-        for out in run_ranks(world, fn):
-            np.testing.assert_allclose(out, _q(x, cdtype, c_buf), **tol)
+        outs = run_ranks(world, fn)
+        np.testing.assert_array_equal(outs[1], _q(x, cdtype, c_buf))
+        expect = q(x) if (c_buf or eth) else x
+        for r in (0, 2):
+            np.testing.assert_array_equal(outs[r], expect)
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_scatter_flags(world, cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_scatter_flags(world, cdtype):
     x = _data(6)  # COUNT total; chunk = COUNT // W per rank
-    chunk = COUNT // W
+    q = _quant(cdtype)
     for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
         wire = cdtype if eth else None
 
         def fn(a):
             src = _buf(a, x, c_op0, cdtype) if a.rank == 0 else None
-            dst = _out(a, chunk, c_res, cdtype)
-            a.scatter(src, dst, chunk, root=0, compress_dtype=wire)
+            dst = _out(a, CHUNK, c_res, cdtype)
+            a.scatter(src, dst, CHUNK, root=0, compress_dtype=wire)
             return _read(dst)
 
         outs = run_ranks(world, fn)
-        golden = _q(x, cdtype, c_op0)
         for r in range(W):
-            np.testing.assert_allclose(
-                outs[r], golden[r * chunk:(r + 1) * chunk], **tol)
+            piece = x[r * CHUNK:(r + 1) * CHUNK]
+            on_path = (c_op0 or c_res) if r == 0 else (c_op0 or eth or c_res)
+            np.testing.assert_array_equal(outs[r],
+                                          q(piece) if on_path else piece)
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_gather_flags(world, cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_gather_flags(world, cdtype):
     ins = [_data(10 + r) for r in range(W)]
+    q = _quant(cdtype)
     for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
         wire = cdtype if eth else None
 
@@ -172,14 +250,16 @@ def test_gather_flags(world, cdtype, tol):
 
         out = run_ranks(world, fn)[1]
         for r in range(W):
-            np.testing.assert_allclose(
+            on_path = (c_op0 or c_res) if r == 1 else (c_op0 or eth or c_res)
+            np.testing.assert_array_equal(
                 out[r * COUNT:(r + 1) * COUNT],
-                _q(ins[r], cdtype, c_op0), **tol)
+                q(ins[r]) if on_path else ins[r])
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_reduce_flags(world, cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_reduce_flags(world, cdtype):
     ins = [_data(20 + r) for r in range(W)]
+    q = _quant(cdtype)
     for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
         wire = cdtype if eth else None
 
@@ -190,13 +270,29 @@ def test_reduce_flags(world, cdtype, tol):
             return _read(dst) if dst is not None else None
 
         out = run_ranks(world, fn)[0]
-        golden = sum(_q(ins[r], cdtype, c_op0) for r in range(W))
-        np.testing.assert_allclose(out, golden, **tol)
+        # ring daisy chain toward root 0 (expand_reduce_ring): farthest
+        # rank W-1 starts. Non-root ranks pass only the src buffer, so
+        # their resolved config is same-dtype whenever c_op0 — their adds
+        # round and their emissions are narrow even without ETH. The root
+        # passes src+dst: it adds in f32 unless both are compressed.
+        ins_q = [_q(x, cdtype, c_op0) for x in ins]
+        p = ins_q[W - 1].astype(np.float32)
+        for j in range(W - 2, 0, -1):       # middle ranks
+            if eth or c_op0:
+                p = q(p)                    # wire cast into rank j
+            p = p + ins_q[j]
+            if c_op0:
+                p = q(p)                    # middle adds in compressed arith
+        if eth or c_op0:
+            p = q(p)                        # last middle's emission to root
+        p = p + ins_q[0]                    # root add (f32 unless all-c)
+        np.testing.assert_array_equal(out, q(p) if c_res else p)
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_allgather_flags(world, cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_allgather_flags(world, cdtype):
     ins = [_data(30 + r) for r in range(W)]
+    q = _quant(cdtype)
     for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
         wire = cdtype if eth else None
 
@@ -206,16 +302,20 @@ def test_allgather_flags(world, cdtype, tol):
             a.allgather(src, dst, COUNT, compress_dtype=wire)
             return _read(dst)
 
-        for out in run_ranks(world, fn):
+        outs = run_ranks(world, fn)
+        for dst_r, out in enumerate(outs):
             for r in range(W):
-                np.testing.assert_allclose(
+                on_path = ((c_op0 or c_res) if r == dst_r
+                           else (c_op0 or eth or c_res))
+                np.testing.assert_array_equal(
                     out[r * COUNT:(r + 1) * COUNT],
-                    _q(ins[r], cdtype, c_op0), **tol)
+                    q(ins[r]) if on_path else ins[r])
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_allreduce_flags(world, cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_allreduce_flags(world, cdtype):
     ins = [_data(40 + r) for r in range(W)]
+    q = _quant(cdtype)
     for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
         wire = cdtype if eth else None
 
@@ -225,38 +325,54 @@ def test_allreduce_flags(world, cdtype, tol):
             a.allreduce(src, dst, COUNT, compress_dtype=wire)
             return _read(dst)
 
-        golden = sum(_q(ins[r], cdtype, c_op0) for r in range(W))
-        for out in run_ranks(world, fn):
-            np.testing.assert_allclose(out, golden, **tol)
+        ins_q = [_q(x, cdtype, c_op0) for x in ins]
+        expect = golden_allreduce(ins_q, c_op0, c_res, eth, q)
+        for r, out in enumerate(run_ranks(world, fn)):
+            np.testing.assert_array_equal(out, expect[r])
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_reduce_scatter_flags(world, cdtype, tol):
-    chunk = COUNT // W
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_reduce_scatter_flags(world, cdtype):
     ins = [_data(50 + r) for r in range(W)]
+    q = _quant(cdtype)
     for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
         wire = cdtype if eth else None
 
         def fn(a):
             src = _buf(a, ins[a.rank], c_op0, cdtype)
-            dst = _out(a, chunk, c_res, cdtype)
-            a.reduce_scatter(src, dst, chunk, compress_dtype=wire)
+            dst = _out(a, CHUNK, c_res, cdtype)
+            a.reduce_scatter(src, dst, CHUNK, compress_dtype=wire)
             return _read(dst)
 
         outs = run_ranks(world, fn)
-        golden = sum(_q(ins[r], cdtype, c_op0)
-                     for r in range(W))[:W * chunk].reshape(W, chunk)
+        ins_q = [_q(x, cdtype, c_op0) for x in ins]
         for r in range(W):
-            np.testing.assert_allclose(outs[r][:chunk], golden[r], **tol)
+            sl = slice(r * CHUNK, (r + 1) * CHUNK)
+            p = golden_ring_reduce_chunk([x[sl] for x in ins_q], r,
+                                         c_op0, c_res, eth, q)
+            np.testing.assert_array_equal(outs[r], q(p) if c_res else p)
 
 
 # -- daemon tiers: the same flag product through the socket protocol -------
 
-def _daemon_flag_product(accls, cdtype, tol):
+def _daemon_flag_product(accls, cdtype, quanta=0):
     """allreduce + send/recv across the full OP0 x RES x ETH product —
-    the daemon-tier cut of the sweep (the emu tier runs every op)."""
+    the daemon-tier cut of the sweep (the emu tier runs every op).
+    ``quanta``: allowed error in representable-value steps of ``cdtype``
+    (0 = bitwise; the native daemon's independent C++ codecs get 1)."""
     Wd = len(accls)
+    q = _quant(cdtype)
     ins = [_data(60 + r) for r in range(Wd)]
+
+    def check(out, expect):
+        if quanta == 0:
+            np.testing.assert_array_equal(out, expect)
+        else:
+            err = np.abs(out - expect)
+            tol = quanta * _quantum(expect, cdtype) + 1e-7
+            assert (err <= tol).all(), (
+                f"error {err.max()} exceeds {quanta}-quantum allowance")
+
     for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
         wire = cdtype if eth else None
 
@@ -266,9 +382,10 @@ def _daemon_flag_product(accls, cdtype, tol):
             a.allreduce(src, dst, COUNT, compress_dtype=wire)
             return _read(dst)
 
-        golden = sum(_q(ins[r], cdtype, c_op0) for r in range(Wd))
-        for out in run_ranks(accls, ar):
-            np.testing.assert_allclose(out, golden, **tol)
+        ins_q = [_q(x, cdtype, c_op0) for x in ins]
+        expect = golden_allreduce(ins_q, c_op0, c_res, eth, q)
+        for r, out in enumerate(run_ranks(accls, ar)):
+            check(out, expect[r])
 
         def sr(a):
             if a.rank == 0:
@@ -280,22 +397,22 @@ def _daemon_flag_product(accls, cdtype, tol):
                 return _read(dst)
             return None
 
-        np.testing.assert_allclose(run_ranks(accls, sr)[1],
-                                   _q(ins[0], cdtype, c_op0), **tol)
+        expect_sr = q(ins[0]) if (c_op0 or eth or c_res) else ins[0]
+        check(run_ranks(accls, sr)[1], expect_sr)
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_python_daemon_flag_product(cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_python_daemon_flag_product(cdtype):
     accls = sim_world(2)
     try:
-        _daemon_flag_product(accls, cdtype, tol)
+        _daemon_flag_product(accls, cdtype)
     finally:
         for a in accls:
             a.deinit()
 
 
-@pytest.mark.parametrize("cdtype,tol", PAIRS)
-def test_native_daemon_flag_product(cdtype, tol):
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_native_daemon_flag_product(cdtype):
     binary = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "native", "cclo_emud")
     if not os.path.exists(binary):
@@ -309,7 +426,7 @@ def test_native_daemon_flag_product(cdtype, tol):
     try:
         time.sleep(0.5)
         accls = connect_world(port_base, 2, timeout=15.0)
-        _daemon_flag_product(accls, cdtype, tol)
+        _daemon_flag_product(accls, cdtype, quanta=1)
         for a in accls:
             a.deinit()
     finally:

@@ -66,6 +66,63 @@ def test_fp8_codec_roundtrip():
                                atol=float(np.asarray(scale).ravel()[0]) * 0.6)
 
 
+def test_fp8_codecs_agree_bitwise():
+    """The shard-safe jnp codec (fp8_quantize — what the ring hops and the
+    fused xla_compressed_* paths call) and the Pallas lane (compress_fp8)
+    implement ONE scale/clamp/rounding policy: identical payload bytes and
+    identical scale on the same input."""
+    import jax
+    from accl_tpu.ops import fp8_dequantize, fp8_quantize
+    quant_jit = jax.jit(lambda v: fp8_quantize(v, jnp.float8_e4m3fn))
+    rng = np.random.default_rng(3)
+    for scale_mag in (1e-6, 1.0, 300.0):
+        x = jnp.asarray((rng.standard_normal(777) * scale_mag)
+                        .astype(np.float32))
+        qp, sp = compress_fp8(x)
+        qj, sj = quant_jit(x)
+        assert float(sp.ravel()[0]) == float(sj)
+        np.testing.assert_array_equal(
+            np.asarray(qp).view(np.uint8), np.asarray(qj).view(np.uint8))
+        np.testing.assert_array_equal(
+            np.asarray(decompress_fp8(qp, sp)),
+            np.asarray(fp8_dequantize(qj, sj)))
+
+
+def test_ring_hop_codec_is_the_shared_codec():
+    """An fp8-wire allgather over a 2-device mesh must reproduce
+    fp8_dequantize(fp8_quantize(shard)) for every shard — proving the
+    in-collective codec is the shared one, not a drifted copy. Tolerance
+    is 2 f32 ulps: separately-compiled XLA programs may round the final
+    dequant multiply differently; the fp8 payload policy itself is pinned
+    bitwise by test_fp8_codecs_agree_bitwise."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from accl_tpu.ops import fp8_dequantize, fp8_quantize
+    from accl_tpu.parallel.collectives import MeshCollectives
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("rank",))
+    mc = MeshCollectives(mesh, "rank")
+    rng = np.random.default_rng(4)
+    per_rank = [rng.standard_normal(64).astype(np.float32) for _ in range(2)]
+    x = mc.shard(per_rank)
+    for alg in ("xla", "ring"):
+        out = np.asarray(mc.allgather(x, algorithm=alg,
+                                      wire_dtype=jnp.float8_e4m3fn))
+        for r in range(2):
+            expect = fp8_dequantize(*fp8_quantize(jnp.asarray(per_rank[r]),
+                                                  jnp.float8_e4m3fn))
+            for dst in range(2):
+                if alg == "ring" and dst == r:
+                    continue  # ring keeps the local chunk unquantized
+                np.testing.assert_allclose(
+                    out[dst].reshape(2, -1)[r], np.asarray(expect),
+                    rtol=3e-7, atol=0,
+                    err_msg=f"alg={alg} dst={dst} src={r}")
+
+
 def test_wire_codec_dispatch():
     x = jnp.linspace(-3, 3, 640, dtype=jnp.float32)
     p, aux = wire_compress(x, jnp.float8_e4m3fn)
