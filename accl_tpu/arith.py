@@ -101,6 +101,12 @@ try:  # bfloat16 comes from ml_dtypes (always present with jax)
         _F8, _F8)
     DEFAULT_ARITH_CONFIGS[("float32", "float8_e4m3fn")] = ArithConfig(
         np.dtype("float32"), _F8)
+    # e5m2: the wide-dynamic-range fp8 flavor (2 mantissa bits, inf/NaN)
+    _F8W = np.dtype(ml_dtypes.float8_e5m2)
+    DEFAULT_ARITH_CONFIGS[("float8_e5m2", "float8_e5m2")] = ArithConfig(
+        _F8W, _F8W)
+    DEFAULT_ARITH_CONFIGS[("float32", "float8_e5m2")] = ArithConfig(
+        np.dtype("float32"), _F8W)
 except ImportError:  # pragma: no cover
     pass
 

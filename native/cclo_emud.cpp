@@ -139,7 +139,14 @@ static uint8_t fp8_encode(float f, bool e4m3) {
   uint8_t sign = std::signbit(f) ? 0x80 : 0;
   float af = std::fabs(f);
   if (!e4m3 && std::isinf(f)) return sign | 0x7C;
-  if (af >= dec[n - 1]) return sign | (uint8_t)(n - 1);  // saturate
+  // ml_dtypes round-to-nearest overflow semantics (matches the Python
+  // emu/daemon tiers): values whose rounding exceeds the max finite become
+  // NaN for e4m3fn (no inf in the format; the halfway point saturates) and
+  // +/-inf for e5m2 (IEEE: the halfway point already rounds to inf).
+  float maxf = dec[n - 1], half_ulp = 0.5f * (dec[n - 1] - dec[n - 2]);
+  if (e4m3 ? (af > maxf + half_ulp) : (af >= maxf + half_ulp))
+    return e4m3 ? (uint8_t)(sign | 0x7F) : (uint8_t)(sign | 0x7C);
+  if (af >= maxf) return sign | (uint8_t)(n - 1);  // saturate
   // binary search the first code with dec[code] >= af, then round
   int lo = 0, hi = n - 1;
   while (lo < hi) {
@@ -876,8 +883,8 @@ class RankDaemon {
   RankDaemon(uint32_t rank, uint32_t world, uint16_t port_base, size_t nbufs,
              size_t bufsize, bool udp = false)
       : rank_(rank), world_(world), port_base_(port_base),
-        pool_(nbufs, bufsize), bufsize_(bufsize), max_seg_(bufsize),
-        nbufs_(nbufs),
+        pool_(nbufs, bufsize), bufsize_(bufsize), nbufs_(nbufs),
+        max_seg_(bufsize),
         eth_(std::make_unique<EthFabric>(
             rank, static_cast<uint16_t>(port_base + world + rank), this,
             udp)) {
@@ -1149,8 +1156,13 @@ class RankDaemon {
   uint16_t port_base_;
   DeviceMemory mem_;
   RxBufferPool pool_;
-  size_t bufsize_, max_seg_, nbufs_;
-  double timeout_ = 30.0;
+  // max_seg_/timeout_ (and the config flags below) are written by both the
+  // call worker (ACCL_CONFIG subfunctions) and command-connection threads
+  // (MSG_SET_*), and read by GET_INFO from yet other connection threads —
+  // atomics keep that read torn-/race-free without a config mutex
+  size_t bufsize_, nbufs_;
+  std::atomic<size_t> max_seg_;
+  std::atomic<double> timeout_{30.0};
   std::map<uint32_t, Communicator> comms_;
   std::mutex comm_mu_;
   // unique_ptr so a runtime stack-type config call can swap the fabric.
@@ -1162,9 +1174,9 @@ class RankDaemon {
   std::mutex eth_mu_;
   // runtime config-call state (ACCL_CONFIG parity): pkt engines are
   // default-armed; profiling counters are in-daemon
-  bool pkt_enabled_ = true;
-  bool profiling_ = false;
-  uint32_t profiled_calls_ = 0;
+  std::atomic<bool> pkt_enabled_{true};
+  std::atomic<bool> profiling_{false};
+  std::atomic<uint32_t> profiled_calls_{0};
   // stream port
   std::deque<std::pair<Envelope, std::vector<uint8_t>>> stream_in_;
   std::mutex stream_mu_;

@@ -41,6 +41,7 @@ PAIRS = [
     pytest.param(np.dtype(np.float16), id="f32xf16"),
     pytest.param(np.dtype(ml_dtypes.bfloat16), id="f32xbf16"),
     pytest.param(np.dtype(ml_dtypes.float8_e4m3fn), id="f32xfp8"),
+    pytest.param(np.dtype(ml_dtypes.float8_e5m2), id="f32xfp8w"),
 ]
 
 BOOLS = (False, True)
@@ -411,22 +412,65 @@ def test_python_daemon_flag_product(cdtype):
             a.deinit()
 
 
-@pytest.mark.parametrize("cdtype", PAIRS)
-def test_native_daemon_flag_product(cdtype):
+def _spawn_native(world):
     binary = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "native", "cclo_emud")
     if not os.path.exists(binary):
         pytest.skip("native daemon not built (make -C native)")
     port_base = free_port_base()
     procs = [subprocess.Popen(
-        [binary, "--rank", str(r), "--world", "2",
+        [binary, "--rank", str(r), "--world", str(world),
          "--port-base", str(port_base)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        for r in range(2)]
+        for r in range(world)]
+    return procs, port_base
+
+
+@pytest.mark.parametrize("cdtype", PAIRS)
+def test_native_daemon_flag_product(cdtype):
+    procs, port_base = _spawn_native(2)
     try:
         time.sleep(0.5)
         accls = connect_world(port_base, 2, timeout=15.0)
         _daemon_flag_product(accls, cdtype, quanta=1)
+        for a in accls:
+            a.deinit()
+    finally:
+        for p in procs:
+            p.kill()
+
+
+@pytest.mark.parametrize("fdtype", [np.dtype(ml_dtypes.float8_e4m3fn),
+                                    np.dtype(ml_dtypes.float8_e5m2)],
+                         ids=["e4m3fn", "e5m2"])
+def test_native_fp8_overflow_semantics(fdtype):
+    """The native daemon's C++ fp8 wire encoder must match ml_dtypes
+    round-to-nearest overflow: e4m3fn has no inf, so values past the
+    saturation boundary become NaN (the halfway point, 464, still
+    saturates to 448); e5m2 overflows to +/-inf from its IEEE halfway
+    point (61440) upward. Exercised over the socket wire: f32 payload,
+    fp8 ETH compression, f32 destination."""
+    edge = np.array([447.9, 448.0, 464.0, 465.0, 1000.0, -464.0, -465.0,
+                     57344.0, 61439.0, 61440.0, 65536.0, -61440.0,
+                     0.0, -0.25], np.float32)
+    expect = edge.astype(fdtype).astype(np.float32)
+    procs, port_base = _spawn_native(2)
+    try:
+        time.sleep(0.5)
+        accls = connect_world(port_base, 2, timeout=15.0)
+
+        def fn(a):
+            if a.rank == 0:
+                src = a.buffer(data=edge)
+                a.send(src, edge.size, dst=1, tag=5, compress_dtype=fdtype)
+            else:
+                dst = a.buffer((edge.size,), np.float32)
+                a.recv(dst, edge.size, src=0, tag=5, compress_dtype=fdtype)
+                return _read(dst)
+            return None
+
+        out = run_ranks(accls, fn)[1]
+        np.testing.assert_array_equal(out, expect)
         for a in accls:
             a.deinit()
     finally:
