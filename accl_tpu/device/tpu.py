@@ -47,7 +47,17 @@ from ..constants import (CCLOp, CollectiveAlgorithm, Compression,
 from ..emulator.executor import DeviceMemory
 from ..parallel.collectives import MeshCollectives
 from ..parallel.mesh import make_mesh
+from ..parallel.tree import Tree2DCollectives
 from .base import Device
+
+
+def _factor_2d(w: int) -> tuple[int, int]:
+    """Largest divisor pair (outer, inner) with outer <= inner — the 2D
+    mesh shape the tree collectives ride. (1, w) means no 2D structure."""
+    o = int(w ** 0.5)
+    while o > 1 and w % o:
+        o -= 1
+    return o, w // o
 
 _COLLECTIVES = {CCLOp.bcast, CCLOp.scatter, CCLOp.gather, CCLOp.reduce,
                 CCLOp.allgather, CCLOp.allreduce, CCLOp.reduce_scatter,
@@ -68,12 +78,17 @@ class TpuContext:
         self.world_size = mesh.shape[axis_name]
         self.coll = MeshCollectives(mesh, axis_name)
         self._subcolls: dict[int, MeshCollectives] = {}
+        self._subtrees: dict[int, Tree2DCollectives | None] = {}
+        self.tree = self._make_tree(
+            list(np.asarray(mesh.devices).reshape(-1)))
         self.algorithm = algorithm
         self.devices: list[TpuDevice | None] = [None] * self.world_size
         # rendezvous state
         self._lock = threading.Condition()
         # (comm_id, op_index) -> {comm-local rank: desc}
         self._pending: dict[tuple, dict[int, CallDescriptor]] = {}
+        # keys claimed by a launcher, execution in flight (result coming)
+        self._claimed: set[tuple] = set()
         # (comm_id, op_index) -> [error_word, readers_remaining]
         self._results: dict[tuple, list[int]] = {}
         # (comm_id, src_g, dst_g) -> deque of (tag, payload ndarray)
@@ -85,24 +100,56 @@ class TpuContext:
             self.devices[rank] = TpuDevice(self, rank)
         return self.devices[rank]
 
+    @staticmethod
+    def _make_tree(devs) -> Tree2DCollectives | None:
+        """Hierarchical collectives over the same devices folded into the
+        largest 2D factorization — the bandwidth-correct path for rooted
+        ops at scale (BASELINE config 4's 32-rank (8,4) trees). None when
+        the world has no 2D structure (prime or < 4 ranks)."""
+        from jax.sharding import Mesh
+        o, i = _factor_2d(len(devs))
+        if o < 2:
+            return None
+        return Tree2DCollectives(
+            Mesh(np.asarray(devs).reshape(o, i), ("outer", "inner")))
+
+    def _comm_devices(self, comm: Communicator) -> list:
+        """The communicator's devices in comm-local rank order (one
+        rank->device convention for every sub-mesh built from the world)."""
+        world_devs = list(np.asarray(self.mesh.devices).reshape(-1))
+        return [world_devs[r.global_rank] for r in comm.ranks]
+
     def coll_for(self, comm: Communicator) -> MeshCollectives:
         """Collectives bound to the communicator's sub-mesh: member global
         ranks select their devices from the world mesh (a split comm runs
-        over its own axis, so axis_index == comm-local rank)."""
+        over its own axis, so axis_index == comm-local rank). Cache fills
+        take the ctx lock — launchers of disjoint comms run concurrently."""
         if comm.size == self.world_size:
             return self.coll
         key = comm.comm_id
-        cached = self._subcolls.get(key)
+        with self._lock:
+            cached = self._subcolls.get(key)
         if cached is not None:
             return cached
-        import numpy as np
         from jax.sharding import Mesh
-        world_devs = list(np.asarray(self.mesh.devices).reshape(-1))
-        devs = [world_devs[r.global_rank] for r in comm.ranks]
-        sub = MeshCollectives(Mesh(np.asarray(devs), (self.axis_name,)),
-                              self.axis_name)
-        self._subcolls[key] = sub
-        return sub
+        sub = MeshCollectives(
+            Mesh(np.asarray(self._comm_devices(comm)), (self.axis_name,)),
+            self.axis_name)
+        with self._lock:
+            return self._subcolls.setdefault(key, sub)
+
+    def tree_for(self, comm: Communicator) -> Tree2DCollectives | None:
+        """The communicator's 2D tree context (None when its size has no
+        2D factorization)."""
+        if comm.size == self.world_size:
+            return self.tree
+        key = comm.comm_id
+        with self._lock:
+            if key in self._subtrees:
+                return self._subtrees[key]
+        tree = self._make_tree(self._comm_devices(comm))
+        with self._lock:
+            return self._subtrees.setdefault(key, tree)
 
 
 class TpuDevice(Device):
@@ -291,37 +338,61 @@ class TpuDevice(Device):
         with ctx._lock:
             group = ctx._pending.setdefault(key, {})
             group[comm.local_rank] = desc
-            if len(group) == comm.size:
-                # last arriver executes for everyone
-                try:
-                    err = self._launch(key, comm)
-                except Exception:  # noqa: BLE001
-                    import traceback
-                    traceback.print_exc()  # observability: don't bury the cause
-                    err = int(ErrorCode.INVALID_CALL)
+            is_last = len(group) == comm.size
+            if is_last:
+                # claim the group; execution happens OUTSIDE the lock so
+                # collectives of disjoint communicators run concurrently
+                # (jit/dispatch time would otherwise serialize the world)
                 del ctx._pending[key]
-                if comm.size > 1:
-                    # [error, readers remaining]; deleted when drained
-                    ctx._results[key] = [err, comm.size - 1]
-                ctx._lock.notify_all()
-                return err
-            deadline = time.monotonic() + self.timeout
+                ctx._claimed.add(key)
+        if is_last:
+            # the publish runs in a finally so a claimed key ALWAYS resolves
+            # — waiters in the claimed state deliberately never time out, so
+            # any escape path (desc-assembly errors, BaseExceptions) that
+            # skipped publication would wedge them forever
+            err = int(ErrorCode.INVALID_CALL)
+            try:
+                descs = [group[r] for r in range(comm.size)]
+                err = self._launch(descs, comm)
+            except Exception:  # noqa: BLE001
+                import traceback
+                traceback.print_exc()  # observability: don't bury the cause
+            finally:
+                with ctx._lock:
+                    ctx._claimed.discard(key)
+                    if comm.size > 1:
+                        # [error, readers remaining]; deleted when drained
+                        ctx._results[key] = [err, comm.size - 1]
+                    ctx._lock.notify_all()
+            return err
+        deadline = time.monotonic() + self.timeout
+        with ctx._lock:
             while key not in ctx._results:
                 remaining = deadline - time.monotonic()
-                if remaining <= 0 or not ctx._lock.wait(remaining):
-                    group.pop(comm.local_rank, None)
+                if remaining <= 0:
+                    if key in ctx._claimed:
+                        # execution in flight: the launcher WILL publish
+                        # (exceptions included), so departing now would
+                        # return a bogus timeout for a call that completes
+                        # and leave an undrainable result entry behind —
+                        # keep waiting for the publication instead
+                        ctx._lock.wait(1.0)
+                        continue
+                    # group still incomplete: abandon our slot
+                    pend = ctx._pending.get(key)
+                    if pend is not None:
+                        pend.pop(comm.local_rank, None)
                     return int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+                ctx._lock.wait(remaining)
             entry = ctx._results[key]
             entry[1] -= 1
             if entry[1] <= 0:
                 del ctx._results[key]
             return entry[0]
 
-    def _launch(self, key: tuple, comm: Communicator) -> int:
-        """Execute one collective for all ranks (caller holds ctx lock)."""
+    def _launch(self, descs: list, comm: Communicator) -> int:
+        """Execute one collective for all member ranks (no locks held)."""
         ctx = self.ctx
-        group = ctx._pending[key]
-        descs = [group[r] for r in range(comm.size)]
         d0 = descs[0]
         op = d0.scenario
         if any(d.scenario != op or d.count != d0.count for d in descs):
@@ -359,6 +430,18 @@ class TpuDevice(Device):
             alg = "ring"
         elif d0.algorithm != CollectiveAlgorithm.AUTO:
             alg = "xla"
+        # rooted ops default to the hierarchical 2D-mesh tree when the comm
+        # has 2D structure — O(outer+inner) hop fan-out instead of the
+        # psum/all_gather-class traffic of the masked 1-D lowerings (which
+        # cost allreduce/allgather bandwidth regardless of root). Explicit
+        # ROUND_ROBIN/RING selectors keep the 1-D path; the TREE selector
+        # exists only for bcast (VALID_ALGORITHMS — scatter/gather reach
+        # the tree via AUTO).
+        use_tree = (op in (CCLOp.bcast, CCLOp.scatter, CCLOp.gather)
+                    and (d0.algorithm == CollectiveAlgorithm.AUTO
+                         or (op == CCLOp.bcast
+                             and d0.algorithm == CollectiveAlgorithm.TREE)))
+        tree = ctx.tree_for(comm) if use_tree else None
         root = d0.root_src_dst
         if op == CCLOp.barrier:
             return 0  # rendezvous above IS the barrier
@@ -392,21 +475,30 @@ class TpuDevice(Device):
                 devs[r]._write_result(d.addr_2, out[r], d)
             return 0
         if op == CCLOp.bcast:
-            x = coll.shard(read_all(lambda d: d.addr_0, count))
-            out = np.asarray(coll.bcast(x, root=root))
+            rows = read_all(lambda d: d.addr_0, count)
+            if tree is not None:
+                out = np.asarray(tree.bcast(tree.shard(rows), root=root))
+            else:
+                out = np.asarray(coll.bcast(coll.shard(rows), root=root))
             for r, d in enumerate(descs):
                 if r != root:
                     devs[r]._write_result(d.addr_0, out[r], d)
             return 0
         if op == CCLOp.scatter:
-            x = coll.shard(read_all(lambda d: d.addr_0, W * count))
-            out = np.asarray(coll.scatter(x, root=root))
+            rows = read_all(lambda d: d.addr_0, W * count)
+            if tree is not None:
+                out = np.asarray(tree.scatter(tree.shard(rows), root=root))
+            else:
+                out = np.asarray(coll.scatter(coll.shard(rows), root=root))
             for r, d in enumerate(descs):
                 devs[r]._write_result(d.addr_2, out[r][:count], d)
             return 0
         if op == CCLOp.gather:
-            x = coll.shard(read_all(lambda d: d.addr_0, count))
-            out = np.asarray(coll.gather(x, root=root))
+            rows = read_all(lambda d: d.addr_0, count)
+            if tree is not None:
+                out = np.asarray(tree.gather(tree.shard(rows), root=root))
+            else:
+                out = np.asarray(coll.gather(coll.shard(rows), root=root))
             devs[root]._write_result(descs[root].addr_2, out[root],
                                      descs[root])
             return 0

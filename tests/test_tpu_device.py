@@ -247,6 +247,163 @@ def test_recv_count_mismatch_error(world):
     run_ranks(world, fn)
 
 
+def test_disjoint_comms_execute_concurrently():
+    """Two split communicators must make progress simultaneously: comm A's
+    collective is artificially blocked mid-execution, and comm B's
+    collective must still complete — proving the rendezvous lock is not
+    held during execution (it used to serialize every communicator of the
+    world through one lock, including jit/dispatch time)."""
+    import threading
+
+    import numpy as np
+
+    from jax.sharding import Mesh
+    from accl_tpu.parallel.collectives import MeshCollectives
+
+    accls = tpu_world(4, platform="cpu")
+    ctx = accls[0].device.ctx
+    started = threading.Event()
+    release = threading.Event()
+    b_done = threading.Barrier(2)
+    sync = threading.Barrier(4)
+
+    class SlowColl:
+        """Delegating wrapper that parks comm A inside _launch."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def shard(self, rows):
+            return self._inner.shard(rows)
+
+        def allreduce(self, x, **kw):
+            started.set()
+            assert release.wait(30), "comm B never released comm A"
+            return self._inner.allreduce(x, **kw)
+
+    def fn(a):
+        if a.rank in (0, 1):
+            sub = a.split_communicator([0, 1])
+            if a.rank == 0:
+                devs = list(np.asarray(ctx.mesh.devices).reshape(-1))[:2]
+                inner = MeshCollectives(
+                    Mesh(np.asarray(devs), (ctx.axis_name,)), ctx.axis_name)
+                ctx._subcolls[sub.comm_id] = SlowColl(inner)
+            sync.wait()
+            src = a.buffer(data=np.full(8, 1.0 + a.rank, np.float32))
+            dst = a.buffer((8,), np.float32)
+            a.allreduce(src, dst, 8, comm=sub)
+            return dst.data[0]
+        sub = a.split_communicator([2, 3])
+        sync.wait()
+        assert started.wait(30), "comm A never reached execution"
+        # comm A is parked inside its collective right now; comm B's
+        # collective must complete anyway
+        src = a.buffer(data=np.full(8, 1.0 + a.rank, np.float32))
+        dst = a.buffer((8,), np.float32)
+        a.allreduce(src, dst, 8, comm=sub)
+        b_done.wait()
+        if a.rank == 2:
+            release.set()
+        return dst.data[0]
+
+    res = run_ranks(accls, fn)
+    assert res[0] == res[1] == 3.0   # 1 + 2
+    assert res[2] == res[3] == 7.0   # 3 + 4
+
+
+def test_waiter_survives_slow_execution():
+    """A rank whose rendezvous timeout expires while the collective is
+    already executing must wait for the publication instead of returning a
+    bogus RECEIVE_TIMEOUT (which would also leak an undrainable result
+    entry and desync the per-comm call stream)."""
+    import time
+
+    accls = tpu_world(2, platform="cpu", timeout=0.6)
+    ctx = accls[0].device.ctx
+    real = ctx.coll
+
+    class Slow:
+        def __getattr__(self, name):
+            return getattr(real, name)
+
+        def allreduce(self, x, **kw):
+            time.sleep(1.5)          # longer than the rendezvous timeout
+            return real.allreduce(x, **kw)
+
+    ctx.coll = Slow()
+    try:
+        def fn(a):
+            src = a.buffer(data=np.full(4, 1.0 + a.rank, np.float32))
+            dst = a.buffer((4,), np.float32)
+            h = a.allreduce(src, dst, 4, run_async=True)
+            h.wait(10)               # user-level wait outlives the stall
+            return dst.data[0]
+
+        res = run_ranks(accls, fn)
+        assert res == [3.0, 3.0]
+        assert not ctx._results and not ctx._claimed
+    finally:
+        ctx.coll = real
+
+
+def test_rooted_collectives_use_2d_tree(world):
+    """At W=8 the context folds the mesh to (2, 4) and routes rooted ops
+    (bcast/scatter/gather under AUTO; bcast also accepts the explicit TREE
+    selector) through the hierarchical Tree2DCollectives — correct results
+    AND the tree program cache proves the routing."""
+    ctx = world[0].device.ctx
+    assert ctx.tree is not None and (ctx.tree.O, ctx.tree.I) == (2, 4)
+    ctx.tree._cache.clear()
+    count, root = 12, 5
+    x = _data(count, np.float32, 99)
+    chunks = _data(W * count, np.float32, 98)
+    ins = [_data(count, np.float32, 90 + r) for r in range(W)]
+
+    def fn(a):
+        buf = a.buffer(data=x) if a.rank == root else a.buffer(
+            (count,), np.float32)
+        a.bcast(buf, count, root=root)
+        out_b = buf.data.copy()
+
+        src = a.buffer(data=chunks) if a.rank == root else None
+        dst = a.buffer((count,), np.float32)
+        a.scatter(src, dst, count, root=root)
+        out_s = dst.data.copy()
+
+        gsrc = a.buffer(data=ins[a.rank])
+        gdst = a.buffer((W * count,), np.float32) if a.rank == root else None
+        a.gather(gsrc, gdst, count, root=root)
+        out_g = gdst.data.copy() if gdst is not None else None
+        return out_b, out_s, out_g
+
+    res = run_ranks(world, fn)
+    for r in range(W):
+        np.testing.assert_allclose(res[r][0], x)
+        np.testing.assert_allclose(res[r][1],
+                                   chunks[r * count:(r + 1) * count])
+    np.testing.assert_allclose(res[root][2], np.concatenate(ins))
+    assert {op for (op, *_rest) in ctx.tree._cache} == {
+        "bcast", "scatter", "gather"}
+
+
+def test_bcast_round_robin_selector_skips_tree(world):
+    """An explicit ROUND_ROBIN selector pins the 1-D masked lowering even
+    when a tree context exists (algorithm parity with the move engine)."""
+    ctx = world[0].device.ctx
+    ctx.tree._cache.clear()
+    x = _data(6, np.float32, 77)
+
+    def fn(a):
+        buf = a.buffer(data=x) if a.rank == 0 else a.buffer((6,), np.float32)
+        a.bcast(buf, 6, root=0, algorithm="round_robin")
+        return buf.data.copy()
+
+    for out in run_ranks(world, fn):
+        np.testing.assert_allclose(out, x)
+    assert not ctx.tree._cache
+
+
 def test_tpu_world_real_chip():
     """Hardware tier: the driver API on the REAL TPU device (single-rank
     world). Gated on ACCL_TEST_TPU=1 with a tpu backend — the CI marker
