@@ -242,12 +242,26 @@ class ACCL:
         (accl.py:738-745)."""
         return self._call(CallDescriptor(CCLOp.nop), run_async, waitfor)
 
-    def copy(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int | None
-             = None, *, run_async: bool = False,
+    def copy(self, srcbuf: ACCLBuffer | None, dstbuf: ACCLBuffer | None,
+             count: int | None = None, *,
+             stream_flags: StreamFlags = StreamFlags.NO_STREAM,
+             run_async: bool = False,
              waitfor: Sequence[CallHandle] = ()) -> CallHandle:
-        count = count if count is not None else srcbuf.size
+        """Local copy. With OP0_STREAM the source is the rank's stream-in
+        port (srcbuf may be None); with RES_STREAM the result goes to the
+        stream-out port (dstbuf may be None) — the external-kernel data
+        paths (reference: SWITCH_M_BYPASS / loopback plugin)."""
+        if count is None:
+            if srcbuf is not None:
+                count = srcbuf.size
+            elif dstbuf is not None:
+                count = dstbuf.size
+            else:
+                raise ValueError("copy with both operands streamed "
+                                 "requires an explicit count")
         desc = self._prepare(CCLOp.copy, count=count, comm=self.comm,
-                             op0=srcbuf, res=dstbuf)
+                             op0=srcbuf, res=dstbuf,
+                             stream_flags=stream_flags)
         return self._call(desc, run_async, waitfor)
 
     def combine(self, count: int, func: ReduceFunc, op0: ACCLBuffer,
@@ -257,24 +271,34 @@ class ACCL:
                              func=func, op0=op0, op1=op1, res=res)
         return self._call(desc, run_async, waitfor)
 
-    def send(self, srcbuf: ACCLBuffer, count: int, dst: int, tag: int = TAG_ANY,
-             *, comm: Communicator | None = None,
-             compress_dtype=None, run_async: bool = False,
+    def send(self, srcbuf: ACCLBuffer | None, count: int, dst: int,
+             tag: int = TAG_ANY, *, comm: Communicator | None = None,
+             compress_dtype=None,
+             stream_flags: StreamFlags = StreamFlags.NO_STREAM,
+             run_async: bool = False,
              waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+        """With OP0_STREAM the payload is sourced from this rank's
+        stream-in port (srcbuf may be None)."""
         comm = comm or self.comm
         desc = self._prepare(CCLOp.send, count=count, comm=comm,
                              root_src_dst=dst, tag=tag, op0=srcbuf,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype,
+                             stream_flags=stream_flags)
         return self._call(desc, run_async, waitfor)
 
-    def recv(self, dstbuf: ACCLBuffer, count: int, src: int, tag: int = TAG_ANY,
-             *, comm: Communicator | None = None,
-             compress_dtype=None, run_async: bool = False,
+    def recv(self, dstbuf: ACCLBuffer | None, count: int, src: int,
+             tag: int = TAG_ANY, *, comm: Communicator | None = None,
+             compress_dtype=None,
+             stream_flags: StreamFlags = StreamFlags.NO_STREAM,
+             run_async: bool = False,
              waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+        """With RES_STREAM the received payload lands on this rank's
+        stream-out port instead of memory (dstbuf may be None)."""
         comm = comm or self.comm
         desc = self._prepare(CCLOp.recv, count=count, comm=comm,
                              root_src_dst=src, tag=tag, res=dstbuf,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype,
+                             stream_flags=stream_flags)
         return self._call(desc, run_async, waitfor)
 
     def stream_put(self, srcbuf: ACCLBuffer, count: int, dst: int,
@@ -288,6 +312,18 @@ class ACCL:
         # remote_stream is carried via tag on the move; device backends map
         # RES_STREAM on a send to strm delivery.
         return self._call(desc, run_async, waitfor)
+
+    def stream_push(self, data) -> None:
+        """Feed this rank's external-kernel stream-in port: the next call
+        with OP0_STREAM sources its operand here (reference: the user
+        kernel's AXIS port into the switch, SWITCH_S side)."""
+        self.device.push_stream(data)
+
+    def stream_pop(self, timeout: float = 0.0):
+        """Pop the oldest RES_STREAM result from this rank's stream-out
+        port, waiting up to ``timeout`` seconds (reference: the AXIS port
+        toward the user kernel). Raises IndexError when empty."""
+        return self.device.pop_stream(timeout)
 
     # -- collectives -------------------------------------------------------
     def bcast(self, buf: ACCLBuffer, count: int | None = None, root: int = 0,

@@ -57,6 +57,23 @@ class Device(abc.ABC):
         from ..constants import DEFAULT_MAX_SEGMENT_SIZE
         return DEFAULT_MAX_SEGMENT_SIZE
 
+    # -- external-kernel stream ports --------------------------------------
+    def push_stream(self, data):
+        """Feed the rank's stream-in port (OP0_STREAM operand source;
+        reference: the external-kernel AXIS port, SWITCH_M_BYPASS).
+        Backends without a stream port raise STREAM_NOT_SUPPORTED — never
+        silently ignore the flag."""
+        from ..constants import ACCLError, ErrorCode
+        raise ACCLError(int(ErrorCode.STREAM_NOT_SUPPORTED),
+                        f"{type(self).__name__} has no stream port; fuse "
+                        "producers into the device program instead")
+
+    def pop_stream(self, timeout: float = 0.0):
+        """Pop the oldest RES_STREAM result from the stream-out port."""
+        from ..constants import ACCLError, ErrorCode
+        raise ACCLError(int(ErrorCode.STREAM_NOT_SUPPORTED),
+                        f"{type(self).__name__} has no stream port")
+
     def soft_reset(self):
         """Parity: HOUSEKEEP_SWRST (ccl_offload_control.c:1244-1247)."""
 

@@ -113,6 +113,12 @@ class EmuDevice(Device):
     def preferred_segment_size(self) -> int:
         return self.ctx.bufsize
 
+    def push_stream(self, data):
+        self.executor.push_stream(data)
+
+    def pop_stream(self, timeout: float = 0.0):
+        return self.executor.pop_stream_out(timeout)
+
     def set_max_segment_size(self, nbytes: int):
         if nbytes > self.ctx.bufsize:
             raise ValueError(

@@ -111,6 +111,32 @@ class SimDevice(Device):
     def soft_reset(self):
         self._check(bytes([P.MSG_RESET]))
 
+    def push_stream(self, data):
+        import numpy as np
+        arr = np.asarray(data).reshape(-1)
+        self._check(bytes([P.MSG_STREAM_PUSH, P.dtype_code(arr.dtype)])
+                    + arr.tobytes())
+
+    def pop_stream(self, timeout: float = 0.0):
+        """Poll MSG_STREAM_POP with short budgets: a blocking request
+        would monopolize the single-in-flight command socket for the whole
+        timeout, stalling call submission (same discipline as the MSG_WAIT
+        completion polling)."""
+        import time as _time
+
+        import numpy as np
+        deadline = _time.monotonic() + timeout
+        while True:
+            budget = min(0.05, max(0.0, deadline - _time.monotonic()))
+            reply = self._request(bytes([P.MSG_STREAM_POP])
+                                  + struct.pack("<d", budget))
+            if reply[0] == P.MSG_DATA:
+                return np.frombuffer(reply[2:],
+                                     P.code_dtype(reply[1])).copy()
+            assert reply[0] == P.MSG_STATUS
+            if _time.monotonic() >= deadline:
+                raise IndexError("stream-out port empty")
+
     def dump_rx_buffers(self) -> str:
         reply = self._request(bytes([P.MSG_DUMP_RX]))
         return reply[1:].decode()

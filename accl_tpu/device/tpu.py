@@ -245,6 +245,12 @@ class TpuDevice(Device):
             return 0
         if op == CCLOp.config:
             return self.apply_config(desc)  # shared dispatch (Device base)
+        if desc.stream_flags:
+            # no host-side stream port on this tier: a streamed operand or
+            # result belongs INSIDE the jitted program (fuse the producer/
+            # consumer with the collective). Reject explicitly rather than
+            # silently executing a memory-only variant.
+            return int(ErrorCode.STREAM_NOT_SUPPORTED)
         comm = self.comms.get(desc.comm_id)
         if comm is None:
             return int(ErrorCode.COMM_NOT_CONFIGURED)

@@ -664,6 +664,18 @@ class RankDaemon:
         if kind == P.MSG_RESET:
             self._soft_reset()
             return P.status_reply(0)
+        if kind == P.MSG_STREAM_PUSH:
+            data = np.frombuffer(body[2:], P.code_dtype(body[1]))
+            self.executor.push_stream(data)
+            return P.status_reply(0)
+        if kind == P.MSG_STREAM_POP:
+            (budget,) = struct.unpack("<d", body[1:9])
+            try:
+                out = self.executor.pop_stream_out(budget)
+            except IndexError:
+                return P.status_reply(P.STATUS_PENDING)
+            return P.data_reply(bytes([P.dtype_code(out.dtype)])
+                                + np.ascontiguousarray(out).tobytes())
         if kind == P.MSG_DUMP_RX:
             return P.data_reply(self.pool.describe().encode())
         if kind == P.MSG_SHUTDOWN:

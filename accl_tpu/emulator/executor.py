@@ -213,8 +213,17 @@ class MoveExecutor:
             self.stream_in.append(np.asarray(data).reshape(-1))
             self._stream_cv.notify_all()
 
-    def pop_stream_out(self) -> np.ndarray:
-        return self.stream_out.pop(0)
+    def pop_stream_out(self, timeout: float = 0.0) -> np.ndarray:
+        """Pop the oldest RES_STREAM result, waiting up to ``timeout``
+        seconds for one to be produced (0 = immediate, the historical
+        behavior). Raises IndexError when none arrives in time."""
+        deadline = time.monotonic() + timeout
+        with self._stream_cv:
+            while not self.stream_out:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._stream_cv.wait(remaining):
+                    raise IndexError("stream-out port empty")
+            return self.stream_out.pop(0)
 
     def deliver_stream(self, env: Envelope, payload: bytes):
         data = np.frombuffer(payload, dtype=np.dtype(env.wire_dtype))
@@ -228,7 +237,7 @@ class MoveExecutor:
                 if remaining <= 0 or not self._stream_cv.wait(remaining):
                     return None
             data = self.stream_in.pop(0)
-        return data.astype(dtype, copy=False)[:count]
+        return data.astype(dtype, copy=False)
 
     # -- operand fetch/sink ------------------------------------------------
     def _fetch(self, op: Operand, count: int, cfg: ArithConfig,
@@ -246,6 +255,10 @@ class MoveExecutor:
             data = self._pop_stream_in(count, u, deadline)
             if data is None:
                 return None, int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
+            if data.size != count:
+                # envelope-length discipline matches ON_RECV: a mismatched
+                # stream payload fails instead of silently truncating
+                return None, int(ErrorCode.DMA_MISMATCH_ERROR)
             return data, 0
         if op.mode == MoveMode.ON_RECV:
             rank = comm.ranks[op.src_rank]
@@ -269,12 +282,17 @@ class MoveExecutor:
                 else cfg.uncompressed_dtype)
         payload = np.ascontiguousarray(data.astype(wire, copy=False)).tobytes()
         rank = comm.ranks[move.dst_rank]  # comm-local -> fabric rank
+        # stream deliveries bypass the rx pool, so they ride OUTSIDE the
+        # seqn-ordered channel — consuming a seqn here would desync the
+        # sender's counter from the receiver's pool expectations
+        seqn = 0 if move.remote_stream else rank.outbound_seq
         env = Envelope(src=comm.my_global_rank, dst=rank.global_rank,
-                       tag=move.tag, seqn=rank.outbound_seq,
+                       tag=move.tag, seqn=seqn,
                        nbytes=len(payload), wire_dtype=np.dtype(wire).name,
                        strm=1 if move.remote_stream else 0,
                        comm_id=comm.comm_id)
-        rank.outbound_seq += 1
+        if not move.remote_stream:
+            rank.outbound_seq += 1
         self._send(env, payload)
 
     # -- the engine --------------------------------------------------------
@@ -305,7 +323,9 @@ class MoveExecutor:
                 break
             if mv.res_local:
                 if mv.res.mode == MoveMode.STREAM:
-                    self.stream_out.append(result)
+                    with self._stream_cv:
+                        self.stream_out.append(result)
+                        self._stream_cv.notify_all()
                 elif mv.res.mode == MoveMode.IMMEDIATE:
                     out_dtype = (cfg.compressed_dtype if mv.res.compressed
                                  else cfg.uncompressed_dtype)

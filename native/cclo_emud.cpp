@@ -557,11 +557,16 @@ static void push_recv(std::vector<Move>& mv, const CallCtx& c, uint64_t count,
                       uint32_t src, uint64_t dst, uint32_t tag) {
   uint64_t seg = c.seg_elems();
   size_t eb = c.ebytes(c.compression & C_RES);
+  bool res_stream = (c.stream & 2) != 0;  // RES_STREAM: local stream sink
   for (uint64_t off = 0; off < count; off += seg) {
     Move m;
     m.count = std::min(seg, count - off);
     m.op1 = {M_ON_RECV, 0, src, tag, false};
-    m.res = {M_IMM, dst + off * eb, 0, TAG_ANY, (c.compression & C_RES) != 0};
+    if (res_stream)
+      m.res = {M_STREAM, 0, 0, TAG_ANY, false};
+    else
+      m.res = {M_IMM, dst + off * eb, 0, TAG_ANY,
+               (c.compression & C_RES) != 0};
     m.res_local = true;
     m.eth_compressed = (c.compression & C_ETH) != 0;
     mv.push_back(m);
@@ -572,8 +577,14 @@ static void push_copy(std::vector<Move>& mv, const CallCtx& c, uint64_t count,
                       uint64_t src, uint64_t dst) {
   Move m;
   m.count = count;
-  m.op0 = {M_IMM, src, 0, TAG_ANY, (c.compression & C_OP0) != 0};
-  m.res = {M_IMM, dst, 0, TAG_ANY, (c.compression & C_RES) != 0};
+  if (c.stream & 1)
+    m.op0 = {M_STREAM, 0, 0, TAG_ANY, false};
+  else
+    m.op0 = {M_IMM, src, 0, TAG_ANY, (c.compression & C_OP0) != 0};
+  if (c.stream & 2)
+    m.res = {M_STREAM, 0, 0, TAG_ANY, false};
+  else
+    m.res = {M_IMM, dst, 0, TAG_ANY, (c.compression & C_RES) != 0};
   m.res_local = true;
   mv.push_back(m);
 }
@@ -625,10 +636,15 @@ static const uint64_t BARRIER_SCRATCH_ADDR = 1ull << 60;
 // expand one call into a move program; mirrors the ring algorithms
 // (decreasing-rank data flow: rank r forwards to r-1, receives from r+1)
 // and the per-call algorithm variants of moveengine.expand_call
-static uint32_t expand(std::vector<Move>& mv, const CallCtx& c, uint8_t op,
+static uint32_t expand(std::vector<Move>& mv, const CallCtx& c_in, uint8_t op,
                        int func, uint64_t count, uint32_t root, uint32_t tag,
                        uint64_t a0, uint64_t a1, uint64_t a2,
                        uint8_t alg = ALG_AUTO) {
+  // stream flags apply only to copy/send/recv (moveengine.expand_call
+  // parity) — a collective's internal copies must never source/sink the
+  // external-kernel stream ports
+  CallCtx c = c_in;
+  if (op != OP_COPY && op != OP_SEND && op != OP_RECV) c.stream = 0;
   const uint32_t W = c.world, me = c.me;
   size_t eb = c.ebytes(c.compression & C_OP0);
   size_t ebr = c.ebytes(c.compression & C_RES);
@@ -938,9 +954,18 @@ class RankDaemon {
         return E_INVALID;
       }
       if (m.res_local) {
-        uint8_t out_dt = m.res.compressed ? c.cdtype : c.udtype;
-        auto out = convert(*result, c.udtype, out_dt, m.count);
-        if (!mem_.write(m.res.addr, out.data(), out.size())) return E_INVALID;
+        if (m.res.mode == M_STREAM) {
+          // RES_STREAM sink: result (uncompressed dtype) to the
+          // external-kernel stream-out port
+          std::lock_guard<std::mutex> lk(stream_mu_);
+          stream_out_.emplace_back(c.udtype, *result);
+          stream_cv_.notify_all();
+        } else {
+          uint8_t out_dt = m.res.compressed ? c.cdtype : c.udtype;
+          auto out = convert(*result, c.udtype, out_dt, m.count);
+          if (!mem_.write(m.res.addr, out.data(), out.size()))
+            return E_INVALID;
+        }
       }
       if (m.res_remote) {
         uint8_t wire_dt = m.eth_compressed ? c.cdtype : c.udtype;
@@ -950,7 +975,9 @@ class RankDaemon {
         env.src = comm.my_global();
         env.dst = peer.global_rank;
         env.tag = m.tag;
-        env.seqn = peer.outbound_seq++;
+        // stream deliveries bypass the rx pool and its seqn-ordered
+        // channel (matches the Python executor)
+        env.seqn = m.remote_stream ? 0 : peer.outbound_seq++;
         env.comm_id = comm.comm_id;
         env.strm = m.remote_stream ? 1 : 0;
         env.dtype = wire_dt;
@@ -998,6 +1025,10 @@ class RankDaemon {
       auto item = std::move(stream_in_.front());
       stream_in_.pop_front();
       lk.unlock();
+      // same envelope-length discipline as M_ON_RECV: a mismatched stream
+      // payload must fail, not read past the buffer / overwrite memory
+      size_t n = item.second.size() / dtype_size(item.first.dtype);
+      if (n != m.count) return E_DMA_MISMATCH;
       *out = convert(item.second, item.first.dtype, c.udtype, m.count);
       *have = true;
       return E_OK;
@@ -1177,8 +1208,10 @@ class RankDaemon {
   std::atomic<bool> pkt_enabled_{true};
   std::atomic<bool> profiling_{false};
   std::atomic<uint32_t> profiled_calls_{0};
-  // stream port
+  // stream ports (external-kernel AXIS analog): in = OP0_STREAM source,
+  // out = RES_STREAM sink; both host-accessible via MSG_STREAM_PUSH/POP
   std::deque<std::pair<Envelope, std::vector<uint8_t>>> stream_in_;
+  std::deque<std::pair<uint8_t, std::vector<uint8_t>>> stream_out_;
   std::mutex stream_mu_;
   std::condition_variable stream_cv_;
   // calls
@@ -1688,6 +1721,37 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
         reply.push_back(eth_->is_udp() ? 1 : 0);
       }
       put_le<uint32_t>(reply, profiled_calls_);
+      return reply;
+    }
+    case MSG_STREAM_PUSH: {
+      // body: dtype u8 + raw elements — synthesize an envelope so the
+      // executor's M_STREAM fetch sees the host-fed dtype
+      Envelope env;
+      env.dtype = body[1];
+      env.nbytes = body.size() - 2;
+      std::vector<uint8_t> payload(body.begin() + 2, body.end());
+      {
+        std::lock_guard<std::mutex> lk(stream_mu_);
+        stream_in_.push_back({env, std::move(payload)});
+        stream_cv_.notify_all();
+      }
+      return status_reply(E_OK);
+    }
+    case MSG_STREAM_POP: {
+      double budget;
+      std::memcpy(&budget, p, 8);
+      std::unique_lock<std::mutex> lk(stream_mu_);
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::duration<double>(budget);
+      while (stream_out_.empty()) {
+        if (stream_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+          return status_reply(STATUS_PENDING);
+      }
+      auto item = std::move(stream_out_.front());
+      stream_out_.pop_front();
+      lk.unlock();
+      std::vector<uint8_t> reply{MSG_DATA, item.first};
+      reply.insert(reply.end(), item.second.begin(), item.second.end());
       return reply;
     }
     case MSG_RESET: {

@@ -22,6 +22,7 @@ enum Msg : uint8_t {
   MSG_WRITE_MEM = 5, MSG_READ_MEM = 6, MSG_CONFIG_COMM = 7,
   MSG_SET_TIMEOUT = 8, MSG_SET_SEG = 9, MSG_PING = 10, MSG_SHUTDOWN = 11,
   MSG_RESET = 12, MSG_DUMP_RX = 13, MSG_GET_INFO = 14,
+  MSG_STREAM_PUSH = 15, MSG_STREAM_POP = 16,
   MSG_STATUS = 100, MSG_CALL_ID = 101, MSG_DATA = 102,
   MSG_ETH = 50,
 };
