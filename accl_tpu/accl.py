@@ -180,6 +180,7 @@ class ACCL:
                  op0: ACCLBuffer | None = None, op1: ACCLBuffer | None = None,
                  res: ACCLBuffer | None = None,
                  compress_dtype: np.dtype | str | None = None,
+                 stream_dtype: np.dtype | str | None = None,
                  stream_flags: StreamFlags = StreamFlags.NO_STREAM,
                  algorithm: CollectiveAlgorithm | str = (
                      CollectiveAlgorithm.AUTO)
@@ -193,6 +194,10 @@ class ACCL:
         """
         dtypes = {b.dtype for b in (op0, op1, res) if b is not None}
         compression = Compression.NONE
+        if stream_dtype is not None:
+            # streamed operands carry no buffer to resolve a dtype from —
+            # without this a fully-streamed call silently coerces to f32
+            dtypes.add(np.dtype(stream_dtype))
         if compress_dtype is not None:
             dtypes.add(np.dtype(compress_dtype))
             compression |= Compression.ETH_COMPRESSED
@@ -245,12 +250,14 @@ class ACCL:
     def copy(self, srcbuf: ACCLBuffer | None, dstbuf: ACCLBuffer | None,
              count: int | None = None, *,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
-             run_async: bool = False,
+             stream_dtype=None, run_async: bool = False,
              waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """Local copy. With OP0_STREAM the source is the rank's stream-in
         port (srcbuf may be None); with RES_STREAM the result goes to the
         stream-out port (dstbuf may be None) — the external-kernel data
-        paths (reference: SWITCH_M_BYPASS / loopback plugin)."""
+        paths (reference: SWITCH_M_BYPASS / loopback plugin). A fully
+        streamed copy takes its element type from ``stream_dtype``
+        (default float32)."""
         if count is None:
             if srcbuf is not None:
                 count = srcbuf.size
@@ -261,6 +268,7 @@ class ACCL:
                                  "requires an explicit count")
         desc = self._prepare(CCLOp.copy, count=count, comm=self.comm,
                              op0=srcbuf, res=dstbuf,
+                             stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
         return self._call(desc, run_async, waitfor)
 
@@ -273,31 +281,35 @@ class ACCL:
 
     def send(self, srcbuf: ACCLBuffer | None, count: int, dst: int,
              tag: int = TAG_ANY, *, comm: Communicator | None = None,
-             compress_dtype=None,
+             compress_dtype=None, stream_dtype=None,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
              run_async: bool = False,
              waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """With OP0_STREAM the payload is sourced from this rank's
-        stream-in port (srcbuf may be None)."""
+        stream-in port (srcbuf may be None; element type from
+        ``stream_dtype``, default float32)."""
         comm = comm or self.comm
         desc = self._prepare(CCLOp.send, count=count, comm=comm,
                              root_src_dst=dst, tag=tag, op0=srcbuf,
                              compress_dtype=compress_dtype,
+                             stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
         return self._call(desc, run_async, waitfor)
 
     def recv(self, dstbuf: ACCLBuffer | None, count: int, src: int,
              tag: int = TAG_ANY, *, comm: Communicator | None = None,
-             compress_dtype=None,
+             compress_dtype=None, stream_dtype=None,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
              run_async: bool = False,
              waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """With RES_STREAM the received payload lands on this rank's
-        stream-out port instead of memory (dstbuf may be None)."""
+        stream-out port instead of memory (dstbuf may be None; element
+        type from ``stream_dtype``, default float32)."""
         comm = comm or self.comm
         desc = self._prepare(CCLOp.recv, count=count, comm=comm,
                              root_src_dst=src, tag=tag, res=dstbuf,
                              compress_dtype=compress_dtype,
+                             stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
         return self._call(desc, run_async, waitfor)
 
@@ -319,11 +331,13 @@ class ACCL:
         kernel's AXIS port into the switch, SWITCH_S side)."""
         self.device.push_stream(data)
 
-    def stream_pop(self, timeout: float = 0.0):
-        """Pop the oldest RES_STREAM result from this rank's stream-out
-        port, waiting up to ``timeout`` seconds (reference: the AXIS port
-        toward the user kernel). Raises IndexError when empty."""
-        return self.device.pop_stream(timeout)
+    def stream_pop(self, timeout: float = 0.0, count: int | None = None):
+        """Read from this rank's stream-out port (reference: the AXIS port
+        toward the user kernel): ``count`` elements — across however many
+        RES_STREAM moves produced them, AXIS continuous-stream semantics —
+        or the next entry whole when ``count`` is None. Waits up to
+        ``timeout`` seconds; raises IndexError when it never fills."""
+        return self.device.pop_stream(timeout, count)
 
     # -- collectives -------------------------------------------------------
     def bcast(self, buf: ACCLBuffer, count: int | None = None, root: int = 0,

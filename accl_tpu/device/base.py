@@ -68,8 +68,9 @@ class Device(abc.ABC):
                         f"{type(self).__name__} has no stream port; fuse "
                         "producers into the device program instead")
 
-    def pop_stream(self, timeout: float = 0.0):
-        """Pop the oldest RES_STREAM result from the stream-out port."""
+    def pop_stream(self, timeout: float = 0.0, count: int | None = None):
+        """Read from the stream-out port: ``count`` elements, or the next
+        produced entry whole when ``count`` is None (RES_STREAM sink)."""
         from ..constants import ACCLError, ErrorCode
         raise ACCLError(int(ErrorCode.STREAM_NOT_SUPPORTED),
                         f"{type(self).__name__} has no stream port")

@@ -116,8 +116,8 @@ class EmuDevice(Device):
     def push_stream(self, data):
         self.executor.push_stream(data)
 
-    def pop_stream(self, timeout: float = 0.0):
-        return self.executor.pop_stream_out(timeout)
+    def pop_stream(self, timeout: float = 0.0, count: int | None = None):
+        return self.executor.pop_stream_out(timeout, count)
 
     def set_max_segment_size(self, nbytes: int):
         if nbytes > self.ctx.bufsize:
@@ -143,6 +143,7 @@ class EmuDevice(Device):
         """
         self.pool = RxBufferPool(self.ctx.nbufs, self.ctx.bufsize)
         self.executor.pool = self.pool
+        self.executor.reset_streams()
         for comm in self.comms.values():
             for r in comm.ranks:
                 r.inbound_seq = r.outbound_seq = 0

@@ -117,11 +117,12 @@ class SimDevice(Device):
         self._check(bytes([P.MSG_STREAM_PUSH, P.dtype_code(arr.dtype)])
                     + arr.tobytes())
 
-    def pop_stream(self, timeout: float = 0.0):
+    def pop_stream(self, timeout: float = 0.0, count: int | None = None):
         """Poll MSG_STREAM_POP with short budgets: a blocking request
         would monopolize the single-in-flight command socket for the whole
         timeout, stalling call submission (same discipline as the MSG_WAIT
-        completion polling)."""
+        completion polling). ``count`` elements, or the next entry whole
+        when None (wire encodes that as 0)."""
         import time as _time
 
         import numpy as np
@@ -129,7 +130,7 @@ class SimDevice(Device):
         while True:
             budget = min(0.05, max(0.0, deadline - _time.monotonic()))
             reply = self._request(bytes([P.MSG_STREAM_POP])
-                                  + struct.pack("<d", budget))
+                                  + struct.pack("<dQ", budget, count or 0))
             if reply[0] == P.MSG_DATA:
                 return np.frombuffer(reply[2:],
                                      P.code_dtype(reply[1])).copy()

@@ -552,6 +552,7 @@ class RankDaemon:
     def _soft_reset(self):
         self.pool = RxBufferPool(len(self.pool.bufs), self.bufsize)
         self.executor.pool = self.pool
+        self.executor.reset_streams()
         for comm in self.comms.values():
             for r in comm.ranks:
                 r.inbound_seq = r.outbound_seq = 0
@@ -670,8 +671,10 @@ class RankDaemon:
             return P.status_reply(0)
         if kind == P.MSG_STREAM_POP:
             (budget,) = struct.unpack("<d", body[1:9])
+            count = struct.unpack("<Q", body[9:17])[0] if len(body) >= 17 \
+                else 0
             try:
-                out = self.executor.pop_stream_out(budget)
+                out = self.executor.pop_stream_out(budget, count or None)
             except IndexError:
                 return P.status_reply(P.STATUS_PENDING)
             return P.data_reply(bytes([P.dtype_code(out.dtype)])

@@ -203,8 +203,15 @@ class MoveExecutor:
         self.pool = pool
         self._send = send_fn  # (Envelope, payload_bytes) -> None
         self.timeout = timeout
+        # stream ports are CONTINUOUS element streams (the reference's AXIS
+        # semantics: no message boundaries — a consumer reads exactly the
+        # word count its move asks for, across however many pushes/wire
+        # segments supplied them). Entries queue as typed arrays; reads
+        # consume elements across entry boundaries via a head offset.
         self.stream_in: list[np.ndarray] = []
+        self._stream_in_off = 0          # consumed elems of stream_in[0]
         self.stream_out: list[np.ndarray] = []
+        self._stream_out_off = 0
         self._stream_cv = threading.Condition()
 
     # -- stream ports ------------------------------------------------------
@@ -213,17 +220,73 @@ class MoveExecutor:
             self.stream_in.append(np.asarray(data).reshape(-1))
             self._stream_cv.notify_all()
 
-    def pop_stream_out(self, timeout: float = 0.0) -> np.ndarray:
-        """Pop the oldest RES_STREAM result, waiting up to ``timeout``
-        seconds for one to be produced (0 = immediate, the historical
-        behavior). Raises IndexError when none arrives in time."""
-        deadline = time.monotonic() + timeout
+    def reset_streams(self):
+        """Drain both ports (soft reset: stale cross-epoch stream data
+        must not leak to the next consumer)."""
         with self._stream_cv:
-            while not self.stream_out:
+            self.stream_in.clear()
+            self.stream_out.clear()
+            self._stream_in_off = self._stream_out_off = 0
+
+    @staticmethod
+    def _take(entries: list[np.ndarray], off: int, count: int, dtype
+              ) -> tuple[np.ndarray, int]:
+        """Consume exactly ``count`` elements from the head of ``entries``
+        (mutates the list), starting ``off`` into the first entry; returns
+        (data, new head offset). Caller guarantees availability."""
+        if count == 0:
+            head_dtype = (dtype if dtype is not None
+                          else (entries[0].dtype if entries
+                                else np.dtype(np.float32)))
+            return np.empty(0, head_dtype), off
+        parts = []
+        need = count
+        while need:
+            head = entries[0]
+            avail = head.size - off
+            take = min(avail, need)
+            part = head[off:off + take]
+            if dtype is not None:
+                part = part.astype(dtype, copy=False)
+            parts.append(part)
+            need -= take
+            off += take
+            if off == head.size:
+                entries.pop(0)
+                off = 0
+        return (parts[0] if len(parts) == 1 else np.concatenate(parts)), off
+
+    def _avail(self, entries: list[np.ndarray], off: int) -> int:
+        return sum(e.size for e in entries) - off
+
+    def pop_stream_out(self, timeout: float = 0.0,
+                       count: int | None = None) -> np.ndarray:
+        """Read from the stream-out port: ``count`` elements (waiting up
+        to ``timeout`` seconds for them), or with ``count=None`` the next
+        produced entry whole. Raises IndexError on timeout."""
+        deadline = time.monotonic() + timeout
+        if not count:
+            count = None  # 0 and None both mean "next entry whole"
+        with self._stream_cv:
+            while True:
+                if count is None:
+                    if self.stream_out:
+                        head = self.stream_out.pop(0)
+                        out = head[self._stream_out_off:]
+                        self._stream_out_off = 0
+                        return out
+                elif self._avail(self.stream_out, self._stream_out_off) \
+                        >= count:
+                    # type the result by the HEAD entry's dtype (matches
+                    # the native daemon; numpy promotion across
+                    # mixed-dtype entries would diverge per tier)
+                    out, self._stream_out_off = self._take(
+                        self.stream_out, self._stream_out_off, count,
+                        self.stream_out[0].dtype)
+                    return out
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._stream_cv.wait(remaining):
                     raise IndexError("stream-out port empty")
-            return self.stream_out.pop(0)
 
     def deliver_stream(self, env: Envelope, payload: bytes):
         data = np.frombuffer(payload, dtype=np.dtype(env.wire_dtype))
@@ -232,12 +295,13 @@ class MoveExecutor:
     def _pop_stream_in(self, count: int, dtype: np.dtype,
                        deadline: float) -> np.ndarray | None:
         with self._stream_cv:
-            while not self.stream_in:
+            while self._avail(self.stream_in, self._stream_in_off) < count:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._stream_cv.wait(remaining):
                     return None
-            data = self.stream_in.pop(0)
-        return data.astype(dtype, copy=False)
+            data, self._stream_in_off = self._take(
+                self.stream_in, self._stream_in_off, count, dtype)
+        return data
 
     # -- operand fetch/sink ------------------------------------------------
     def _fetch(self, op: Operand, count: int, cfg: ArithConfig,
@@ -252,13 +316,12 @@ class MoveExecutor:
             data = self.mem.read(op.addr, count, stored)
             return data.astype(u, copy=False), 0
         if op.mode == MoveMode.STREAM:
+            # continuous-stream semantics: block until exactly ``count``
+            # elements are available (across pushes/wire segments); a
+            # shortfall is a timeout, the AXIS analog of a stalled stream
             data = self._pop_stream_in(count, u, deadline)
             if data is None:
                 return None, int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
-            if data.size != count:
-                # envelope-length discipline matches ON_RECV: a mismatched
-                # stream payload fails instead of silently truncating
-                return None, int(ErrorCode.DMA_MISMATCH_ERROR)
             return data, 0
         if op.mode == MoveMode.ON_RECV:
             rank = comm.ranks[op.src_rank]

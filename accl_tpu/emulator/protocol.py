@@ -40,9 +40,10 @@ MSG_GET_INFO = 14     # -> MSG_DATA {bufsize u64, nbufs u32, world u32, rank u32
 MSG_STREAM_PUSH = 15  # dtype u8 + raw elements -> MSG_STATUS; feeds the
 #                       rank's external-kernel stream-in port (OP0_STREAM
 #                       operand source)
-MSG_STREAM_POP = 16   # f64 timeout-seconds -> MSG_DATA (dtype u8 + raw
-#                       elements) from the stream-out port (RES_STREAM
-#                       sink), or MSG_STATUS STATUS_PENDING when empty
+MSG_STREAM_POP = 16   # f64 timeout-seconds + u64 count (0 = next entry
+#                       whole) -> MSG_DATA (dtype u8 + raw elements) from
+#                       the stream-out port (RES_STREAM sink), or
+#                       MSG_STATUS STATUS_PENDING when not enough arrives
 # replies
 MSG_STATUS = 100      # u32 error word
 MSG_CALL_ID = 101     # u32 call id

@@ -1015,25 +1015,71 @@ class RankDaemon {
       return E_OK;
     }
     if (o.mode == M_STREAM) {
+      // continuous-stream semantics (AXIS parity, matches the Python
+      // executor): WAIT until exactly m.count elements are available
+      // across however many pushes/wire segments supplied them, THEN
+      // consume — a timeout must not destroy partial data (a retry after
+      // more pushes has to succeed, like the Python tiers)
       std::unique_lock<std::mutex> lk(stream_mu_);
       auto deadline = std::chrono::steady_clock::now() +
                       std::chrono::duration<double>(timeout_);
-      while (stream_in_.empty()) {
+      auto dtfn = [](const std::pair<Envelope, std::vector<uint8_t>>& e) {
+        return static_cast<uint8_t>(e.first.dtype);
+      };
+      while (stream_avail(stream_in_, stream_in_off_, dtfn) < m.count) {
         if (stream_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
-          return E_RECV_TIMEOUT;
+          return E_KRNL_TIMEOUT;
       }
-      auto item = std::move(stream_in_.front());
-      stream_in_.pop_front();
-      lk.unlock();
-      // same envelope-length discipline as M_ON_RECV: a mismatched stream
-      // payload must fail, not read past the buffer / overwrite memory
-      size_t n = item.second.size() / dtype_size(item.first.dtype);
-      if (n != m.count) return E_DMA_MISMATCH;
-      *out = convert(item.second, item.first.dtype, c.udtype, m.count);
+      *out = stream_take(stream_in_, stream_in_off_, m.count, c.udtype,
+                         dtfn);
       *have = true;
       return E_OK;
     }
     return E_INVALID;
+  }
+
+  // ---- continuous-stream helpers (caller holds stream_mu_) ----
+  template <typename Q, typename DtFn>
+  static size_t stream_avail(const Q& q, size_t off, DtFn dt) {
+    size_t n = 0;
+    for (size_t i = 0; i < q.size(); ++i) {
+      size_t bytes = q[i].second.size() - (i == 0 ? off : 0);
+      n += bytes / dtype_size(dt(q[i]));
+    }
+    return n;
+  }
+
+  template <typename Q, typename DtFn>
+  static std::vector<uint8_t> stream_take(Q& q, size_t& off, uint64_t count,
+                                          uint8_t out_dt, DtFn dt) {
+    // consume exactly `count` elements across entries, converting each
+    // entry from its own dtype; caller has verified availability
+    std::vector<uint8_t> out;
+    out.reserve(count * dtype_size(out_dt));
+    uint64_t need = count;
+    while (need && !q.empty()) {
+      auto& head = q.front();
+      uint8_t hdt = dt(head);
+      size_t esz = dtype_size(hdt);
+      size_t take = std::min<uint64_t>((head.second.size() - off) / esz,
+                                       need);
+      if (take == 0) {  // corrupt trailing bytes: drop the entry
+        q.pop_front();
+        off = 0;
+        continue;
+      }
+      std::vector<uint8_t> raw(head.second.begin() + off,
+                               head.second.begin() + off + take * esz);
+      auto conv = hdt == out_dt ? raw : convert(raw, hdt, out_dt, take);
+      out.insert(out.end(), conv.begin(), conv.end());
+      need -= take;
+      off += take * esz;
+      if (off >= head.second.size()) {
+        q.pop_front();
+        off = 0;
+      }
+    }
+    return out;
   }
 
   // ---- call queue (hostctrl async chaining parity) ----
@@ -1174,6 +1220,13 @@ class RankDaemon {
 
   void soft_reset() {
     pool_.reset();
+    {
+      // drain stream ports: stale cross-epoch stream data must not leak
+      std::lock_guard<std::mutex> lk(stream_mu_);
+      stream_in_.clear();
+      stream_out_.clear();
+      stream_in_off_ = stream_out_off_ = 0;
+    }
     std::lock_guard<std::mutex> lk(comm_mu_);
     for (auto& kv : comms_)
       for (auto& r : kv.second.ranks) r.inbound_seq = r.outbound_seq = 0;
@@ -1209,9 +1262,13 @@ class RankDaemon {
   std::atomic<bool> profiling_{false};
   std::atomic<uint32_t> profiled_calls_{0};
   // stream ports (external-kernel AXIS analog): in = OP0_STREAM source,
-  // out = RES_STREAM sink; both host-accessible via MSG_STREAM_PUSH/POP
+  // out = RES_STREAM sink; both host-accessible via MSG_STREAM_PUSH/POP.
+  // Continuous-stream semantics: consumers read element counts across
+  // entry boundaries via the head offsets (bytes into the front entry).
   std::deque<std::pair<Envelope, std::vector<uint8_t>>> stream_in_;
+  size_t stream_in_off_ = 0;
   std::deque<std::pair<uint8_t, std::vector<uint8_t>>> stream_out_;
+  size_t stream_out_off_ = 0;
   std::mutex stream_mu_;
   std::condition_variable stream_cv_;
   // calls
@@ -1724,6 +1781,11 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
       return reply;
     }
     case MSG_STREAM_PUSH: {
+      // the payload must be whole elements of the declared dtype — a
+      // ragged tail would leave unconsumable bytes in the port
+      if (body.size() < 2 ||
+          (body.size() - 2) % dtype_size(body[1]) != 0)
+        return status_reply(E_INVALID);
       // body: dtype u8 + raw elements — synthesize an envelope so the
       // executor's M_STREAM fetch sees the host-fed dtype
       Envelope env;
@@ -1738,20 +1800,42 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
       return status_reply(E_OK);
     }
     case MSG_STREAM_POP: {
+      if (body.size() < 9) return status_reply(E_INVALID);
       double budget;
       std::memcpy(&budget, p, 8);
+      uint64_t count = body.size() >= 17 ? get_le<uint64_t>(p + 8) : 0;
       std::unique_lock<std::mutex> lk(stream_mu_);
       auto deadline = std::chrono::steady_clock::now() +
                       std::chrono::duration<double>(budget);
-      while (stream_out_.empty()) {
+      if (count == 0) {
+        // next entry whole
+        while (stream_out_.empty()) {
+          if (stream_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+            return status_reply(STATUS_PENDING);
+        }
+        auto item = std::move(stream_out_.front());
+        stream_out_.pop_front();
+        std::vector<uint8_t> reply{MSG_DATA, item.first};
+        reply.insert(reply.end(), item.second.begin() + stream_out_off_,
+                     item.second.end());
+        stream_out_off_ = 0;
+        return reply;
+      }
+      // exactly `count` elements across entries (continuous semantics);
+      // entries are produced in the call's uncompressed dtype, so the
+      // head entry's dtype types the reply
+      auto dtfn = [](const std::pair<uint8_t, std::vector<uint8_t>>& e) {
+        return e.first;
+      };
+      while (stream_out_.empty() ||
+             stream_avail(stream_out_, stream_out_off_, dtfn) < count) {
         if (stream_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
           return status_reply(STATUS_PENDING);
       }
-      auto item = std::move(stream_out_.front());
-      stream_out_.pop_front();
-      lk.unlock();
-      std::vector<uint8_t> reply{MSG_DATA, item.first};
-      reply.insert(reply.end(), item.second.begin(), item.second.end());
+      uint8_t dt = stream_out_.front().first;
+      std::vector<uint8_t> reply{MSG_DATA, dt};
+      auto data = stream_take(stream_out_, stream_out_off_, count, dt, dtfn);
+      reply.insert(reply.end(), data.begin(), data.end());
       return reply;
     }
     case MSG_RESET: {

@@ -63,6 +63,7 @@ enum Alg : uint8_t {
 enum Err : uint32_t {
   E_OK = 0,
   E_DMA_MISMATCH = 1u << 0,
+  E_KRNL_TIMEOUT = 1u << 6,
   E_RECV_TIMEOUT = 1u << 8,
   E_DMA_SIZE = 1u << 12,
   E_OPEN_PORT = 1u << 13,

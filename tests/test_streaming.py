@@ -81,14 +81,68 @@ def _stream_suite(accls):
     h.wait(5.0)
     np.testing.assert_array_equal(got, _x(6))
 
-    # 5. payload/count mismatch fails like ON_RECV, never truncates
-    a0.stream_push(_x(1)[: N // 2])
-    with pytest.raises(ACCLError) as ei:
-        a0.copy(None, a0.buffer((N,), np.float32), N,
-                stream_flags=StreamFlags.OP0_STREAM)
-    assert ei.value.error_word & int(ErrorCode.DMA_MISMATCH_ERROR)
+    # 5. CONTINUOUS-stream semantics (AXIS parity): transfers larger than
+    #    max_segment_size span wire segments / multiple RES_STREAM moves,
+    #    and element counts are consumed across push boundaries
+    big = np.arange(5 * N, dtype=np.float32)
 
-    # 6. both-streamed copy without a count is a clear error
+    def fn5(a):
+        a.set_max_segment_size(N * 4)        # 4-byte elems: N per segment
+        try:
+            if a.rank == 0:
+                a.stream_put(a.buffer(data=big), big.size, dst=1)  # 5 segs
+                a.send(a.buffer(data=big * 2), big.size, dst=1, tag=2)
+            elif a.rank == 1:
+                dst = a.buffer((big.size,), np.float32)
+                a.copy(None, dst, big.size,
+                       stream_flags=StreamFlags.OP0_STREAM)
+                a.sync_from(dst)
+                # and the reverse: segmented recv into the stream-out port,
+                # read back as one count across the entries
+                a.recv(None, big.size, src=0, tag=2,
+                       stream_flags=StreamFlags.RES_STREAM)
+                out2 = np.asarray(a.stream_pop(5.0, count=big.size))
+                return dst.data.copy(), out2
+        finally:
+            a.set_max_segment_size(a.device.preferred_segment_size())
+        return None
+
+    d1, d2 = run_ranks(accls, fn5)[1]
+    np.testing.assert_array_equal(d1, big)
+    np.testing.assert_array_equal(d2, big * 2)
+
+    # 6. fully-streamed calls carry their dtype (no silent f32 coercion)
+    precise = np.array([2**53 + 1, -7], dtype=np.int64)
+    a0.stream_push(precise)
+    a0.copy(None, None, 2, stream_dtype=np.int64,
+            stream_flags=StreamFlags.OP0_STREAM | StreamFlags.RES_STREAM)
+    got = np.asarray(a0.stream_pop(5.0))
+    assert got.dtype == np.int64
+    np.testing.assert_array_equal(got, precise)
+
+    # 7. a shortfall blocks then times out (stalled-stream semantics, same
+    #    error word on every tier) WITHOUT consuming the partial data — a
+    #    retry after the rest arrives must succeed; soft reset drains
+    a0.set_timeout(0.4)
+    try:
+        a0.stream_push(_x(1)[: N // 2])
+        with pytest.raises(ACCLError) as ei:
+            a0.copy(None, a0.buffer((N,), np.float32), N,
+                    stream_flags=StreamFlags.OP0_STREAM)
+        assert ei.value.error_word & int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
+        a0.stream_push(_x(1)[N // 2:])
+        dst = a0.buffer((N,), np.float32)
+        a0.copy(None, dst, N, stream_flags=StreamFlags.OP0_STREAM)
+        a0.sync_from(dst)
+        np.testing.assert_array_equal(dst.data, _x(1))
+    finally:
+        a0.set_timeout(20.0)
+    a0.stream_push(_x(9))
+    a0.soft_reset()
+    with pytest.raises(IndexError):
+        a0.stream_pop(0.05)
+
+    # 8. both-streamed copy without a count is a clear error
     with pytest.raises(ValueError):
         a0.copy(None, None,
                 stream_flags=StreamFlags.OP0_STREAM | StreamFlags.RES_STREAM)
