@@ -13,6 +13,14 @@ def main():
     ap = argparse.ArgumentParser(description="accl_tpu benchmark harness")
     ap.add_argument("--config", type=int, choices=range(1, 6),
                     help="run a BASELINE config (1-5)")
+    ap.add_argument("--chip-sweep", action="store_true",
+                    help="single-device combine-dataplane size sweep "
+                         "(Pallas vs raw XLA; the curve behind bench.py)")
+    ap.add_argument("--tag", type=str, default=None,
+                    help="suffix for the output CSV NAME only — elaborate "
+                         "aggregates by CSV columns (collective/algorithm/"
+                         "...), so variants must differ in those columns "
+                         "to stay separate cells")
     ap.add_argument("--sweep", type=str,
                     help="ad-hoc sweep of one collective")
     ap.add_argument("--algorithm", type=str, default="xla",
@@ -60,6 +68,13 @@ def main():
                      "sweeps both bf16 and fp16 lanes itself")
         result = CONFIGS[args.config](**kwargs)
         name = f"config{args.config}.csv"
+    elif args.chip_sweep:
+        if args.algorithm != "xla" or args.wire_dtype:
+            ap.error("--chip-sweep measures the fixed pallas-vs-xla fp32 "
+                     "pair; --algorithm/--wire-dtype do not apply")
+        from .configs import chip_combine_sweep
+        result = chip_combine_sweep(sizes)
+        name = "chip_combine.csv"
     elif args.sweep:
         from accl_tpu.parallel import make_mesh
         from .sweep import sweep_collective
@@ -73,6 +88,8 @@ def main():
         return
 
     os.makedirs(args.out, exist_ok=True)
+    if args.tag:
+        name = name.replace(".csv", f"_{args.tag}.csv")
     path = os.path.join(args.out, name)
     result.to_csv(path)
     print(result.table())

@@ -196,6 +196,73 @@ def config5_llama_grads(bucket_bytes: int = 25 << 20) -> SweepResult:
     return SweepResult([row])
 
 
+def chip_combine_sweep(sizes=None) -> SweepResult:
+    """Single-device size sweep of the combine dataplane (the reduce_sum
+    plugin equivalent): the Pallas VPU kernel vs the raw XLA elementwise
+    op, 4 KiB - 256 MiB. This is the real-chip curve behind bench.py's
+    single 256 MiB point; traffic per iteration = 3x nbytes (read acc +
+    read y + write acc)."""
+    from accl_tpu.constants import ReduceFunc
+    from accl_tpu.ops.combine import combine_pallas
+
+    from .timing import slope_time
+
+    hi = (1 << 22) if _is_cpu() else (1 << 28)
+    sizes = sizes or _size_sweep(1 << 12, hi)
+    tier = f"{jax.default_backend()}-chip"
+    rows = []
+    for nbytes in sizes:
+        # whole 1024-lane fp32 rows; report the EFFECTIVE size so odd
+        # --sizes values cannot inflate bus_gbps via silent truncation
+        n = max(1, nbytes // 4096) * 1024
+        nbytes = n * 4
+        cols = 1024
+        a = jax.random.normal(jax.random.key(0), (n // cols, cols),
+                              jnp.float32)
+        b = jax.random.normal(jax.random.key(1), (n // cols, cols),
+                              jnp.float32)
+
+        def make_pallas(K):
+            @jax.jit
+            def f(x, y):
+                def body(i, acc):
+                    return combine_pallas(acc, y, ReduceFunc.SUM)
+                return jax.lax.fori_loop(0, K, body, x)[0, 0]
+            return f
+
+        def make_xla(K):
+            @jax.jit
+            def f(x, y):
+                def body(i, acc):
+                    return acc + y
+                return jax.lax.fori_loop(0, K, body, x)[0, 0]
+            return f
+
+        # adaptive chain length: target ~50 ms of device work so the slope
+        # rises above tunnel/host noise at every size. Working sets that
+        # fit VMEM run at multi-TB/s (no HBM trips), so the assumed rate —
+        # hence K — must scale with the regime or small ops stay flat
+        # across K and the slope is garbage.
+        assumed = 5e12 if 3 * nbytes < (100 << 20) else 1e12
+        k_hi = int(min(2_000_000, max(36, 0.05 * assumed / (3 * nbytes))))
+        k_lo = max(4, k_hi // 9)
+        for algo, mk in (("pallas", make_pallas), ("xla", make_xla)):
+            t = slope_time(mk, (a, b), k_lo=k_lo, k_hi=k_hi)
+            if t <= 2e-9:  # clamped slope (transient noise): longer chain
+                hi2 = min(2_000_000, 4 * k_hi)
+                # k points must stay distinct even at the cap, else the
+                # polyfit is rank-deficient and returns a bogus slope
+                t = slope_time(mk, (a, b), k_lo=max(4, hi2 // 9), k_hi=hi2)
+            rows.append({
+                "collective": "combine", "algorithm": algo, "world": 1,
+                "dtype": "float32", "wire_dtype": "", "nbytes": nbytes,
+                "seconds_per_op": t,
+                "bus_gbps": round(3 * nbytes / t / 1e9, 4),
+                "tier": tier,
+            })
+    return SweepResult(rows)
+
+
 CONFIGS = {
     1: config1_pingpong,
     2: config2_allreduce_sweep,
