@@ -441,12 +441,16 @@ class TpuDevice(Device):
         # psum/all_gather-class traffic of the masked 1-D lowerings (which
         # cost allreduce/allgather bandwidth regardless of root). Explicit
         # ROUND_ROBIN/RING selectors keep the 1-D path; the TREE selector
-        # exists only for bcast (VALID_ALGORITHMS — scatter/gather reach
-        # the tree via AUTO).
-        use_tree = (op in (CCLOp.bcast, CCLOp.scatter, CCLOp.gather)
+        # exists only for bcast (VALID_ALGORITHMS — scatter/gather/reduce
+        # reach the tree via AUTO). Rooted reduce rides the tree only
+        # uncompressed: the tree has no wire-compression lanes, and the
+        # compressed 1-D path's decompress-before-arith numerics must win.
+        rooted = (CCLOp.bcast, CCLOp.scatter, CCLOp.gather, CCLOp.reduce)
+        use_tree = (op in rooted
                     and (d0.algorithm == CollectiveAlgorithm.AUTO
                          or (op == CCLOp.bcast
-                             and d0.algorithm == CollectiveAlgorithm.TREE)))
+                             and d0.algorithm == CollectiveAlgorithm.TREE))
+                    and not (op == CCLOp.reduce and wire is not None))
         tree = ctx.tree_for(comm) if use_tree else None
         root = d0.root_src_dst
         if op == CCLOp.barrier:
@@ -459,9 +463,14 @@ class TpuDevice(Device):
                 devs[r]._write_result(d.addr_2, out[r], d)
             return 0
         if op == CCLOp.reduce:
-            x = coll.shard(read_all(lambda d: d.addr_0, count))
-            out = np.asarray(coll.reduce(x, root=root, func=d0.function,
-                                         wire_dtype=wire))
+            rows = read_all(lambda d: d.addr_0, count)
+            if tree is not None:
+                out = np.asarray(tree.reduce(tree.shard(rows), root=root,
+                                             func=d0.function))
+            else:
+                out = np.asarray(coll.reduce(coll.shard(rows), root=root,
+                                             func=d0.function,
+                                             wire_dtype=wire))
             devs[root]._write_result(descs[root].addr_2, out[root],
                                      descs[root])
             return 0

@@ -375,7 +375,12 @@ def test_rooted_collectives_use_2d_tree(world):
         gdst = a.buffer((W * count,), np.float32) if a.rank == root else None
         a.gather(gsrc, gdst, count, root=root)
         out_g = gdst.data.copy() if gdst is not None else None
-        return out_b, out_s, out_g
+
+        rsrc = a.buffer(data=ins[a.rank])
+        rdst = a.buffer((count,), np.float32) if a.rank == root else None
+        a.reduce(rsrc, rdst, count, root=root)
+        out_r = rdst.data.copy() if rdst is not None else None
+        return out_b, out_s, out_g, out_r
 
     res = run_ranks(world, fn)
     for r in range(W):
@@ -383,8 +388,9 @@ def test_rooted_collectives_use_2d_tree(world):
         np.testing.assert_allclose(res[r][1],
                                    chunks[r * count:(r + 1) * count])
     np.testing.assert_allclose(res[root][2], np.concatenate(ins))
+    np.testing.assert_allclose(res[root][3], sum(ins), rtol=1e-5)
     assert {op for (op, *_rest) in ctx.tree._cache} == {
-        "bcast", "scatter", "gather"}
+        "bcast", "scatter", "gather", "reduce"}
 
 
 def test_bcast_round_robin_selector_skips_tree(world):
