@@ -221,6 +221,44 @@ int main(int argc, char** argv) {
     a.free(src); a.free(dst);
   }
 
+  // stream ports: remote-stream put -> peer OP0_STREAM copy; local
+  // push -> OP0_STREAM copy; RES_STREAM copy -> stream_pop
+  if (world >= 2 && rank < 2) {
+    if (rank == 0) {
+      Buffer sbuf = a.alloc(N);
+      std::vector<float> v(N, 55.0f);
+      a.write(sbuf, v.data());
+      a.stream_put(sbuf, N, /*dst=*/1);
+      a.free(sbuf);
+    } else {
+      Buffer dbuf = a.alloc(N);
+      a.copy_from_stream(dbuf, N);
+      expect_near(a.read_vec<float>(dbuf), 55.0f, "stream_put->op0_stream");
+      a.free(dbuf);
+    }
+    // local in-port: host push -> OP0_STREAM copy
+    std::vector<float> loop(N, 9.25f + rank);
+    a.stream_push(loop.data(), N * 4, DT_F32);
+    Buffer lbuf = a.alloc(N);
+    a.copy_from_stream(lbuf, N);
+    expect_near(a.read_vec<float>(lbuf), 9.25f + rank, "stream_push->copy");
+    // out-port: RES_STREAM copy -> counted stream_pop
+    a.copy_to_stream(lbuf, N);
+    uint8_t dt = 0;
+    auto raw = a.stream_pop(10.0, N, &dt);
+    if (dt != DT_F32 || raw.size() != N * sizeof(float)) {
+      std::fprintf(stderr, "FAIL res_stream->pop: dtype %u size %zu\n",
+                   dt, raw.size());
+      ++failures;
+    } else {
+      std::vector<float> got(N);
+      std::memcpy(got.data(), raw.data(), raw.size());
+      expect_near(got, 9.25f + rank, "res_stream->pop");
+    }
+    a.free(lbuf);
+  }
+  a.barrier();
+
   // error path: recv with no matching send must raise RECEIVE_TIMEOUT
   {
     a.set_timeout(0.2);

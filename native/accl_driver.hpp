@@ -341,6 +341,64 @@ class ACCL {
                     DT_F32));
   }
 
+  // -- external-kernel stream ports ---------------------------------------
+  // stream_put: send into the PEER's stream port (remote-stream send,
+  // strm=1 on the wire); stream_push/stream_pop: this rank's local
+  // stream-in/stream-out ports (MSG_STREAM_PUSH/POP). pop polls with
+  // short budgets like wait() so the command socket is never monopolized.
+  void stream_put(const Buffer& src, uint64_t count, uint32_t dst,
+                  uint32_t tag = TAG_ANY) {
+    wait(call_async(OP_SEND, count, dst, 0, tag, src.addr, 0, 0, src.dtype,
+                    src.dtype, C_NONE, /*stream=*/2));
+  }
+
+  // OP0_STREAM copy: materialize `count` stream-in elements into dst
+  void copy_from_stream(const Buffer& dst, uint64_t count) {
+    wait(call_async(OP_COPY, count, 0, 0, 0, 0, 0, dst.addr, dst.dtype,
+                    dst.dtype, C_NONE, /*stream=*/1));
+  }
+
+  // RES_STREAM copy: src buffer onto the local stream-out port
+  void copy_to_stream(const Buffer& src, uint64_t count) {
+    wait(call_async(OP_COPY, count, 0, 0, 0, src.addr, 0, 0, src.dtype,
+                    src.dtype, C_NONE, /*stream=*/2));
+  }
+
+  void stream_push(const void* data, uint64_t nbytes, uint8_t dtype) {
+    std::vector<uint8_t> body{MSG_STREAM_PUSH, dtype};
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    body.insert(body.end(), p, p + nbytes);
+    check(body);
+  }
+
+  // returns the payload bytes and writes the element dtype to *dtype_out;
+  // count = 0 pops the next produced entry whole
+  std::vector<uint8_t> stream_pop(double timeout_s, uint64_t count = 0,
+                                  uint8_t* dtype_out = nullptr) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s);
+    for (;;) {
+      std::vector<uint8_t> body{MSG_STREAM_POP};
+      double budget = 0.05;
+      put_le<double>(body, budget);
+      put_le<uint64_t>(body, count);
+      auto reply = request(body);
+      if (reply.size() >= 2 && reply[0] == MSG_DATA) {
+        if (dtype_out) *dtype_out = reply[1];
+        return std::vector<uint8_t>(reply.begin() + 2, reply.end());
+      }
+      // decode statuses like wait(): only STATUS_PENDING means retry —
+      // a real error must surface, not be spun on until a bogus timeout
+      if (reply.size() >= 5 && reply[0] == MSG_STATUS) {
+        uint32_t err = get_le<uint32_t>(reply.data() + 1);
+        if (err != STATUS_PENDING)
+          throw ACCLError(err, "stream_pop");
+      }
+      if (std::chrono::steady_clock::now() >= deadline)
+        throw ACCLError(E_RECV_TIMEOUT, "stream-out port empty");
+    }
+  }
+
   void shutdown_daemon() { check({MSG_SHUTDOWN}); }
 
  private:

@@ -392,6 +392,20 @@ def test_rooted_collectives_use_2d_tree(world):
     assert {op for (op, *_rest) in ctx.tree._cache} == {
         "bcast", "scatter", "gather", "reduce"}
 
+    # ETH-compressed reduce must stay OFF the tree: the 1-D path's
+    # decompress-before-arith wire numerics are the contract
+    ctx.tree._cache.clear()
+
+    def fc(a):
+        src = a.buffer(data=ins[a.rank])
+        dst = a.buffer((count,), np.float32) if a.rank == root else None
+        a.reduce(src, dst, count, root=root, compress_dtype=np.float16)
+        return dst.data.copy() if dst is not None else None
+
+    out = run_ranks(world, fc)[root]
+    np.testing.assert_allclose(out, sum(ins), atol=0.05)
+    assert not ctx.tree._cache
+
 
 def test_bcast_round_robin_selector_skips_tree(world):
     """An explicit ROUND_ROBIN selector pins the 1-D masked lowering even
