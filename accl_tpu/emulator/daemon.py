@@ -573,9 +573,19 @@ class RankDaemon:
         try:
             while True:
                 body = P.recv_frame(conn)
-                reply = self._handle(body)
+                try:
+                    reply = (self._handle(body) if body
+                             else P.status_reply(int(ErrorCode.INVALID_CALL)))
+                except Exception:  # noqa: BLE001 — truncated/garbage frame
+                    # must get an error reply, not a dead connection; log
+                    # so genuine handler bugs stay diagnosable
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "rank %d: request failed (kind=%s, %d bytes)",
+                        self.rank, body[0] if body else None, len(body))
+                    reply = P.status_reply(int(ErrorCode.INVALID_CALL))
                 P.send_frame(conn, reply)
-                if body[0] == P.MSG_SHUTDOWN:
+                if body and body[0] == P.MSG_SHUTDOWN:
                     self.shutdown()
                     return
         except (ConnectionError, OSError):

@@ -148,6 +148,10 @@ def unpack_comm(body: bytes) -> tuple[int, int, list[tuple[int, str, int]]]:
     for _ in range(n):
         grank, port, hlen = struct.unpack("<IHH", body[off:off + 8])
         off += 8
+        if off + hlen > len(body):
+            # a silently-truncated host slice would ACCEPT a malformed
+            # frame the C++ daemon rejects — fail loudly instead
+            raise ValueError("truncated communicator record")
         host = body[off:off + hlen].decode()
         off += hlen
         ranks.append((grank, host, port))

@@ -1676,6 +1676,23 @@ void RankDaemon::serve_conn(int fd) {
 std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
   const uint8_t kind = body[0];
   const uint8_t* p = body.data() + 1;
+  const size_t len = body.size() - 1;  // payload bytes after the kind
+  // minimum payload per message kind: a truncated/garbage frame must get
+  // an INVALID reply, never read past the buffer (robustness parity with
+  // the Python daemon's guarded handler)
+  size_t need = 0;
+  switch (kind) {
+    case MSG_ALLOC: case MSG_READ_MEM: need = 16; break;
+    case MSG_FREE: case MSG_WRITE_MEM: case MSG_SET_TIMEOUT:
+    case MSG_SET_SEG: need = 8; break;
+    case MSG_WAIT: need = 4; break;
+    case MSG_CALL: need = 54; break;       // fixed descriptor layout
+    //   (8B flags + u64 count + 3xu32 + 3xu64 addrs + u16 n_waitfor —
+    //   matches protocol.py pack_call's struct calcsize)
+    case MSG_CONFIG_COMM: need = 12; break;
+    default: break;                        // stream msgs validate inline
+  }
+  if (len < need) return status_reply(E_INVALID);
   switch (kind) {
     case MSG_PING:
       return status_reply(E_OK);
@@ -1706,15 +1723,22 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
       comm.local_rank = get_le<uint32_t>(p + 4);
       uint32_t n = get_le<uint32_t>(p + 8);
       size_t off = 12;
+      // parse the ENTIRE table before applying any side effect: a frame
+      // rejected as truncated must not leave partially-learned peers
+      // (the Python daemon's unpack_comm raises before learn_peers too)
       for (uint32_t i = 0; i < n; ++i) {
+        if (off + 8 > len) return status_reply(E_INVALID);
         RankInfo ri;
         ri.global_rank = get_le<uint32_t>(p + off);
         ri.cmd_port = get_le<uint16_t>(p + off + 4);
         uint16_t hlen = get_le<uint16_t>(p + off + 6);
         off += 8;
+        if (off + hlen > len) return status_reply(E_INVALID);
         ri.host.assign(reinterpret_cast<const char*>(p + off), hlen);
         off += hlen;
         comm.ranks.push_back(ri);
+      }
+      for (const auto& ri : comm.ranks) {
         if (ri.global_rank != rank_ && ri.cmd_port) {
           std::lock_guard<std::mutex> elk(eth_mu_);  // vs stack swap
           eth_->learn_peer(ri.global_rank, ri.host,

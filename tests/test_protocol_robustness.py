@@ -1,0 +1,102 @@
+"""Wire-protocol robustness: malformed frames must never take a daemon
+down or wedge its command connection.
+
+The reference's daemons face only its own driver, but a rank daemon is a
+long-lived network service: truncated frames, unknown message kinds, and
+garbage payloads must produce an error reply (or at worst a closed
+connection) while the daemon keeps serving valid traffic — on both the
+Python and C++ implementations.
+"""
+
+import os
+import socket
+import struct
+import subprocess
+import time
+
+import pytest
+
+from accl_tpu.emulator import protocol as P
+from accl_tpu.testing import free_port_base
+
+MALFORMED = [
+    bytes([99]),                                   # unknown kind
+    bytes([P.MSG_ALLOC]),                          # truncated (needs 16)
+    bytes([P.MSG_ALLOC, 1, 2, 3]),                 # still truncated
+    bytes([P.MSG_FREE]),                           # truncated (needs 8)
+    bytes([P.MSG_READ_MEM]) + b"\x01" * 7,         # truncated (needs 16)
+    bytes([P.MSG_WRITE_MEM]),                      # truncated (needs 8)
+    bytes([P.MSG_WAIT]),                           # truncated (needs 4)
+    bytes([P.MSG_CALL]) + b"\x00" * 10,            # truncated descriptor
+    bytes([P.MSG_SET_TIMEOUT]) + b"\x00" * 3,      # truncated f64
+    bytes([P.MSG_SET_SEG]) + b"\x00" * 2,          # truncated u64
+    bytes([P.MSG_STREAM_PUSH]),                    # no dtype byte
+    bytes([P.MSG_STREAM_PUSH, 1]) + b"\x00" * 3,   # ragged f64 payload
+    bytes([P.MSG_STREAM_POP]) + b"\x00" * 2,       # truncated budget
+    bytes([P.MSG_CONFIG_COMM]) + b"\x00" * 5,      # truncated header
+    # comm claiming 1000 ranks with a 4-byte body
+    bytes([P.MSG_CONFIG_COMM]) + struct.pack("<3I", 1, 0, 1000) + b"\x00" * 4,
+    # one record whose hlen claims more bytes than remain (the silent-
+    # truncation case: both daemons must REJECT, not register a comm)
+    bytes([P.MSG_CONFIG_COMM]) + struct.pack("<3I", 1, 0, 1)
+    + struct.pack("<IHH", 0, 45000, 500) + b"127.0",
+    # call descriptor truncated mid n_waitfor (52 of 54 fixed bytes)
+    bytes([P.MSG_CALL]) + b"\x00" * 52,
+]
+
+
+def _probe(port: int):
+    """Throw every malformed frame at the daemon; each must yield an error
+    reply or a clean close — and afterwards a PING must still succeed."""
+    for frame in MALFORMED:
+        s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        try:
+            P.send_frame(s, frame)
+            s.settimeout(5.0)
+            try:
+                reply = P.recv_frame(s)
+            except (ConnectionError, OSError):
+                continue  # clean close is acceptable
+            assert reply[0] == P.MSG_STATUS, (frame, reply[:8])
+            err = struct.unpack("<I", reply[1:5])[0]
+            assert err != 0, f"malformed frame accepted: {frame!r}"
+        finally:
+            s.close()
+    # the daemon must still be alive and serving
+    s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    try:
+        P.send_frame(s, bytes([P.MSG_PING]))
+        reply = P.recv_frame(s)
+        assert reply[0] == P.MSG_STATUS
+        assert struct.unpack("<I", reply[1:5])[0] == 0
+    finally:
+        s.close()
+
+
+def test_python_daemon_survives_malformed_frames():
+    from accl_tpu.emulator.daemon import spawn_world
+
+    daemons, port_base = spawn_world(1)
+    try:
+        _probe(port_base)
+    finally:
+        for d in daemons:
+            d.shutdown()
+
+
+def test_native_daemon_survives_malformed_frames():
+    binary = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "cclo_emud")
+    if not os.path.exists(binary):
+        pytest.skip("native daemon not built (make -C native)")
+    port_base = free_port_base()
+    proc = subprocess.Popen(
+        [binary, "--rank", "0", "--world", "1",
+         "--port-base", str(port_base)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        time.sleep(0.5)
+        _probe(port_base)
+        assert proc.poll() is None, "daemon died on malformed input"
+    finally:
+        proc.kill()
