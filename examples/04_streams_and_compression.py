@@ -1,0 +1,72 @@
+"""External-kernel stream ports + wire-precision compression.
+
+Run:  python examples/04_streams_and_compression.py
+(CPU emulator tier — no TPU needed.)
+
+Shows the reference's external-kernel data paths (the AXIS bypass port +
+loopback plugin, rebuilt as continuous-stream ports) and the compression
+flag algebra:
+
+  * ``stream_put``    — send a buffer INTO a peer's stream port
+                        (remote-stream send: strm=1 on the wire);
+  * OP0_STREAM        — a call sources its operand from the local
+                        stream-in port, across push boundaries;
+  * RES_STREAM        — a call's result lands on the local stream-out
+                        port, read back with ``stream_pop``;
+  * ``compress_dtype``— fp32 payloads ride the wire as fp16.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accl_tpu.constants import StreamFlags
+from accl_tpu.testing import emu_world, run_ranks
+
+N = 1024
+
+
+def main():
+    accls = emu_world(2)
+
+    def body(a):
+        if a.rank == 0:
+            # produce data, stream it straight into rank 1's stream port,
+            # fp16 on the wire (half the bytes of the fp32 payload)
+            x = np.linspace(0, 1, N, dtype=np.float32)
+            a.stream_put(a.buffer(data=x), N, dst=1)
+            a.send(a.buffer(data=2 * x), N, dst=1, tag=1,
+                   compress_dtype=np.float16)
+            return None
+
+        # rank 1: an "external kernel" consumes the streamed operand —
+        # here a combine of the streamed data with a local buffer, whose
+        # result goes back out through the stream-out port
+        streamed = a.buffer((N,), np.float32)
+        a.copy(None, streamed, N, stream_flags=StreamFlags.OP0_STREAM)
+
+        wire = a.buffer((N,), np.float32)
+        a.recv(wire, N, src=0, tag=1, compress_dtype=np.float16)
+
+        a.copy(streamed, None, N, stream_flags=StreamFlags.RES_STREAM)
+        echoed = np.asarray(a.stream_pop(5.0, count=N))
+
+        return (streamed.data.copy(), wire.data.copy(), echoed)
+
+    _, (streamed, wire, echoed) = run_ranks(accls, body)
+    x = np.linspace(0, 1, N, dtype=np.float32)
+    np.testing.assert_array_equal(streamed, x)
+    np.testing.assert_allclose(wire, 2 * x, atol=2e-3)  # one fp16 wire trip
+    np.testing.assert_array_equal(echoed, x)
+    print(f"streamed {N} elems into the peer port, compressed the wire "
+          f"fp32->fp16 (max err {np.abs(wire - 2 * x).max():.2e}), and "
+          f"echoed through the stream-out port: OK")
+    for a in accls:
+        a.deinit()
+
+
+if __name__ == "__main__":
+    main()
