@@ -447,6 +447,10 @@ class RankDaemon:
                 scenario = CCLOp.allreduce
             cfg = ArithConfig(P.code_dtype(c["udtype"]),
                               P.code_dtype(c["cdtype"]))
+            if c["count"] * cfg.uncompressed_elem_bytes > P.MAX_CALL_BYTES:
+                # sanity bound BEFORE expansion: a hostile count would
+                # otherwise materialize count/segment move objects
+                return int(ErrorCode.DMA_SIZE_ERROR)
             ctx = MoveContext(world_size=comm.size,
                               local_rank=comm.local_rank, arithcfg=cfg,
                               max_segment_size=self.max_segment_size)
@@ -597,6 +601,8 @@ class RankDaemon:
             return P.status_reply(0)
         if kind == P.MSG_ALLOC:
             addr, nbytes = struct.unpack("<2Q", body[1:17])
+            if nbytes > P.MAX_ALLOC_BYTES:  # bound hostile allocations
+                return P.status_reply(int(ErrorCode.DMA_SIZE_ERROR))
             arr = np.zeros(nbytes, np.uint8)
             self._arrays[addr] = arr
             self.mem.register(addr, arr)

@@ -45,6 +45,12 @@ MSG_STREAM_POP = 16   # f64 timeout-seconds + u64 count (0 = next entry
 #                       the stream-out port (RES_STREAM sink), or
 #                       MSG_STATUS STATUS_PENDING when not enough arrives
 # replies
+# shared daemon resource bounds (hostile-descriptor protection; both
+# daemons and the robustness suite reference these — keep in sync with
+# native/protocol.hpp)
+MAX_CALL_BYTES = 1 << 40   # per-call payload ceiling (pre-expansion)
+MAX_ALLOC_BYTES = 1 << 32  # per-region allocation ceiling
+
 MSG_STATUS = 100      # u32 error word
 MSG_CALL_ID = 101     # u32 call id
 MSG_DATA = 102        # raw bytes

@@ -370,6 +370,13 @@ class DeviceMemory {
     std::memcpy(out, r->second.data() + (addr - r->first), nbytes);
     return true;
   }
+  bool valid(uint64_t addr, uint64_t nbytes) {
+    // address-range check WITHOUT touching data: callers validate before
+    // sizing scratch buffers so a bogus descriptor cannot force a huge
+    // zero-filled allocation
+    std::lock_guard<std::mutex> lk(mu_);
+    return resolve(addr, nbytes) != nullptr;
+  }
 
  private:
   std::pair<const uint64_t, std::vector<uint8_t>>* resolve(uint64_t addr,
@@ -994,8 +1001,11 @@ class RankDaemon {
     if (o.mode == M_NONE) return E_OK;
     if (o.mode == M_IMM) {
       uint8_t stored = o.compressed ? c.cdtype : c.udtype;
-      std::vector<uint8_t> raw(m.count * dtype_size(stored));
-      if (!mem_.read(o.addr, raw.data(), raw.size())) return E_INVALID;
+      uint64_t nbytes = m.count * dtype_size(stored);
+      if (!mem_.valid(o.addr, nbytes)) return E_INVALID;  // before alloc
+      std::vector<uint8_t> raw(nbytes);
+      if (!mem_.read(o.addr, raw.data(), raw.size()))
+        return E_INVALID;  // raced with a free
       *out = convert(raw, stored, c.udtype, m.count);
       *have = true;
       return E_OK;
@@ -1096,7 +1106,15 @@ class RankDaemon {
         call_queue_.pop_front();
       }
       uint8_t scenario = job.second.empty() ? OP_NOP : job.second[0];
-      uint32_t err = run_call(job.second);
+      uint32_t err;
+      try {
+        err = run_call(job.second);
+      } catch (const std::exception& e) {
+        // a hostile/buggy descriptor (absurd count -> bad_alloc, ...)
+        // must retire as an error, not terminate the daemon
+        std::fprintf(stderr, "call %u failed: %s\n", job.first, e.what());
+        err = E_INVALID;
+      }
       if (profiling_ && scenario != OP_CONFIG) profiled_calls_++;
       {
         std::lock_guard<std::mutex> lk(call_mu_);
@@ -1127,6 +1145,14 @@ class RankDaemon {
       if (it == comms_.end()) return E_COMM_NOT_CONFIGURED;
       comm = &it->second;
     }
+    // sanity bound BEFORE expansion: a hostile count would otherwise
+    // materialize count/segment move objects. Barrier is exempt — its
+    // expansion normalizes every data-movement field to a 1-element
+    // rendezvous, so barrier semantics stay descriptor-invariant
+    // (matches the Python daemon's rewrite-then-bound ordering)
+    if (scenario != OP_BARRIER &&
+        count > MAX_CALL_BYTES / dtype_size(udtype))
+      return E_DMA_SIZE;
     CallCtx c{comm->size(), comm->local_rank, udtype, cdtype, max_seg_,
               compression, stream};
     std::vector<Move> moves;
@@ -1657,7 +1683,17 @@ void RankDaemon::serve_conn(int fd) {
   std::vector<uint8_t> body;
   while (recv_frame(fd, body)) {
     if (body.empty()) break;
-    auto reply = handle(body);
+    std::vector<uint8_t> reply;
+    try {
+      reply = handle(body);
+    } catch (const std::exception& e) {
+      // any throwing handler (bad_alloc included) answers with an error
+      // instead of terminating the daemon (parity with the Python
+      // daemon's guarded _serve_conn)
+      std::fprintf(stderr, "request kind %u failed: %s\n", body[0],
+                   e.what());
+      reply = status_reply(E_INVALID);
+    }
     if (!send_frame(fd, reply)) break;
     if (body[0] == MSG_SHUTDOWN) {
       shutting_down.store(true);
@@ -1697,7 +1733,9 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
     case MSG_PING:
       return status_reply(E_OK);
     case MSG_ALLOC: {
-      mem_.alloc(get_le<uint64_t>(p), get_le<uint64_t>(p + 8));
+      uint64_t nbytes = get_le<uint64_t>(p + 8);
+      if (nbytes > MAX_ALLOC_BYTES) return status_reply(E_DMA_SIZE);
+      mem_.alloc(get_le<uint64_t>(p), nbytes);
       return status_reply(E_OK);
     }
     case MSG_FREE:
@@ -1711,6 +1749,9 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
     case MSG_READ_MEM: {
       uint64_t addr = get_le<uint64_t>(p);
       uint64_t nbytes = get_le<uint64_t>(p + 8);
+      // validate BEFORE sizing the reply: a hostile nbytes would
+      // otherwise bad_alloc (registered regions are <= MAX_ALLOC_BYTES)
+      if (!mem_.valid(addr, nbytes)) return status_reply(E_INVALID);
       std::vector<uint8_t> reply{MSG_DATA};
       reply.resize(1 + nbytes);
       if (!mem_.read(addr, reply.data() + 1, nbytes))

@@ -29,6 +29,10 @@ enum Msg : uint8_t {
 
 static const uint32_t STATUS_PENDING = 0xFFFFFFFFu;
 
+// shared daemon resource bounds (keep in sync with protocol.py)
+static const uint64_t MAX_CALL_BYTES = 1ull << 40;
+static const uint64_t MAX_ALLOC_BYTES = 1ull << 32;
+
 enum Op : uint8_t {
   OP_CONFIG = 0, OP_COPY = 1, OP_COMBINE = 2, OP_SEND = 3, OP_RECV = 4,
   OP_BCAST = 5, OP_SCATTER = 6, OP_GATHER = 7, OP_REDUCE = 8,

@@ -45,6 +45,58 @@ MALFORMED = [
 ]
 
 
+def _hostile_call(port: int):
+    """A WELL-FORMED call descriptor with an absurd element count on
+    unregistered addresses must retire with an error word — not crash,
+    hang, or exhaust memory."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    try:
+        # configure a 1-rank world so the calls reach the count bound
+        # (not just COMM_NOT_CONFIGURED)
+        P.send_frame(s, P.pack_comm(0, 0, [(0, "127.0.0.1", port)]))
+        reply = P.recv_frame(s)
+        assert struct.unpack("<I", reply[1:5])[0] == 0
+        def run_call(scenario, count):
+            body = P.pack_call(scenario=scenario, func=0, compression=0,
+                               stream=0, udtype=0, cdtype=0, count=count,
+                               comm_id=0, root=0, tag=0, addr0=0xDEAD000,
+                               addr1=0, addr2=0xBEEF000, waitfor=[])
+            P.send_frame(s, body)
+            reply = P.recv_frame(s)
+            assert reply[0] == P.MSG_CALL_ID
+            call_id = struct.unpack("<I", reply[1:5])[0]
+            P.send_frame(s, bytes([P.MSG_WAIT]) + struct.pack(
+                "<Id", call_id, 10.0))
+            reply = P.recv_frame(s)
+            assert reply[0] == P.MSG_STATUS
+            return struct.unpack("<I", reply[1:5])[0]
+
+        # copy expands to one oversized move; send would expand to
+        # count/segment moves — the pre-expansion bound must stop BOTH
+        for scenario in (1, 3):  # copy, send
+            err = run_call(scenario, 1 << 60)
+            assert err not in (0, P.STATUS_PENDING), hex(err)
+        # a mid-size count UNDER the bound on unregistered addresses must
+        # fail by address validation without materializing the buffer
+        err = run_call(1, (1 << 36) // 4)
+        assert err not in (0, P.STATUS_PENDING), hex(err)
+        # barrier semantics are descriptor-invariant: a garbage count must
+        # still rendezvous (1-rank world: immediate success)
+        assert run_call(12, 1 << 60) == 0
+        # hostile MSG_ALLOC and MSG_READ_MEM must be bounded/validated
+        P.send_frame(s, bytes([P.MSG_ALLOC])
+                     + struct.pack("<2Q", 0x1000, P.MAX_ALLOC_BYTES + 1))
+        reply = P.recv_frame(s)
+        assert struct.unpack("<I", reply[1:5])[0] != 0
+        P.send_frame(s, bytes([P.MSG_READ_MEM])
+                     + struct.pack("<2Q", 0x1000, 1 << 50))
+        reply = P.recv_frame(s)
+        assert reply[0] == P.MSG_STATUS
+        assert struct.unpack("<I", reply[1:5])[0] != 0
+    finally:
+        s.close()
+
+
 def _probe(port: int):
     """Throw every malformed frame at the daemon; each must yield an error
     reply or a clean close — and afterwards a PING must still succeed."""
@@ -62,6 +114,7 @@ def _probe(port: int):
             assert err != 0, f"malformed frame accepted: {frame!r}"
         finally:
             s.close()
+    _hostile_call(port)
     # the daemon must still be alive and serving
     s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
     try:
