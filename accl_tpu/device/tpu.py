@@ -455,6 +455,16 @@ class TpuDevice(Device):
         root = d0.root_src_dst
         if op == CCLOp.barrier:
             return 0  # rendezvous above IS the barrier
+
+        def wire_q(arr: np.ndarray) -> np.ndarray:
+            """Wire-compression semantics for rooted data movement: a
+            payload that crossed the wire was quantized through the
+            compressed dtype (emulator-tier parity — without this the
+            TPU tier would silently return MORE accurate results than
+            the other tiers for ETH-compressed bcast/scatter/gather)."""
+            if wire is None:
+                return arr
+            return arr.astype(wire).astype(cfg.uncompressed_dtype)
         if op == CCLOp.allreduce:
             x = coll.shard(read_all(lambda d: d.addr_0, count))
             out = np.asarray(coll.allreduce(x, func=d0.function,
@@ -496,8 +506,8 @@ class TpuDevice(Device):
             else:
                 out = np.asarray(coll.bcast(coll.shard(rows), root=root))
             for r, d in enumerate(descs):
-                if r != root:
-                    devs[r]._write_result(d.addr_0, out[r], d)
+                if r != root:  # root's own buffer never crossed the wire
+                    devs[r]._write_result(d.addr_0, wire_q(out[r]), d)
             return 0
         if op == CCLOp.scatter:
             rows = read_all(lambda d: d.addr_0, W * count)
@@ -506,7 +516,9 @@ class TpuDevice(Device):
             else:
                 out = np.asarray(coll.scatter(coll.shard(rows), root=root))
             for r, d in enumerate(descs):
-                devs[r]._write_result(d.addr_2, out[r][:count], d)
+                chunk = out[r][:count]
+                devs[r]._write_result(
+                    d.addr_2, chunk if r == root else wire_q(chunk), d)
             return 0
         if op == CCLOp.gather:
             rows = read_all(lambda d: d.addr_0, count)
@@ -514,7 +526,13 @@ class TpuDevice(Device):
                 out = np.asarray(tree.gather(tree.shard(rows), root=root))
             else:
                 out = np.asarray(coll.gather(coll.shard(rows), root=root))
-            devs[root]._write_result(descs[root].addr_2, out[root],
+            assembled = out[root]
+            if wire is not None:
+                # every chunk crossed the wire except the root's own
+                assembled = wire_q(assembled.reshape(W, -1))
+                assembled[root] = out[root].reshape(W, -1)[root]
+                assembled = assembled.reshape(-1)
+            devs[root]._write_result(descs[root].addr_2, assembled,
                                      descs[root])
             return 0
         if op == CCLOp.alltoall:

@@ -407,6 +407,49 @@ def test_rooted_collectives_use_2d_tree(world):
     assert not ctx.tree._cache
 
 
+def test_wire_compressed_rooted_ops_match_emulator_tier(world):
+    """ETH-compressed bcast/scatter/gather must apply the same lossy wire
+    quantization as the emulator tier (payloads that crossed the wire are
+    fp16-quantized; the root's own data is not) — bitwise cross-tier
+    agreement on identical inputs."""
+    from accl_tpu.testing import emu_world
+
+    count, root = 16, 2
+    x = _data(W * count, np.float32, 55)
+    ins = [_data(count, np.float32, 60 + r) for r in range(W)]
+
+    def fn(a):
+        buf = (a.buffer(data=x[:count]) if a.rank == root
+               else a.buffer((count,), np.float32))
+        a.bcast(buf, count, root=root, compress_dtype=np.float16)
+        out_b = buf.data.copy()
+
+        src = a.buffer(data=x) if a.rank == root else None
+        dst = a.buffer((count,), np.float32)
+        a.scatter(src, dst, count, root=root, compress_dtype=np.float16)
+        out_s = dst.data.copy()
+
+        gsrc = a.buffer(data=ins[a.rank])
+        gdst = a.buffer((W * count,), np.float32) if a.rank == root else None
+        a.gather(gsrc, gdst, count, root=root, compress_dtype=np.float16)
+        return out_b, out_s, (gdst.data.copy() if gdst is not None else None)
+
+    tpu_res = run_ranks(world, fn)
+    emu = emu_world(W)
+    try:
+        emu_res = run_ranks(emu, fn)
+    finally:
+        for a in emu:
+            a.deinit()
+    for r in range(W):
+        np.testing.assert_array_equal(tpu_res[r][0], emu_res[r][0],
+                                      err_msg=f"bcast rank {r}")
+        np.testing.assert_array_equal(tpu_res[r][1], emu_res[r][1],
+                                      err_msg=f"scatter rank {r}")
+    np.testing.assert_array_equal(tpu_res[root][2], emu_res[root][2],
+                                  err_msg="gather root")
+
+
 def test_bcast_round_robin_selector_skips_tree(world):
     """An explicit ROUND_ROBIN selector pins the 1-D masked lowering even
     when a tree context exists (algorithm parity with the move engine)."""
