@@ -445,12 +445,13 @@ class ACCL:
         return self._call(desc, run_async, waitfor)
 
     def alltoall(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,
-                 comm: Communicator | None = None,
+                 comm: Communicator | None = None, compress_dtype=None,
                  run_async: bool = False,
                  waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
         desc = self._prepare(CCLOp.alltoall, count=count, comm=comm,
-                             op0=srcbuf, res=dstbuf)
+                             op0=srcbuf, res=dstbuf,
+                             compress_dtype=compress_dtype)
         return self._call(desc, run_async, waitfor)
 
     def barrier(self, *, comm: Communicator | None = None,

@@ -465,6 +465,16 @@ class TpuDevice(Device):
             if wire is None:
                 return arr
             return arr.astype(wire).astype(cfg.uncompressed_dtype)
+
+        def wire_q_except(flat: np.ndarray, keep: int) -> np.ndarray:
+            """Quantize a (W*count,) assembly of per-rank chunks through
+            the wire, restoring chunk ``keep`` (the data that stayed
+            local: the root's own chunk / a rank's self chunk)."""
+            if wire is None:
+                return flat
+            rows = wire_q(flat.reshape(W, -1))
+            rows[keep] = flat.reshape(W, -1)[keep]
+            return rows.reshape(-1)
         if op == CCLOp.allreduce:
             x = coll.shard(read_all(lambda d: d.addr_0, count))
             out = np.asarray(coll.allreduce(x, func=d0.function,
@@ -526,20 +536,17 @@ class TpuDevice(Device):
                 out = np.asarray(tree.gather(tree.shard(rows), root=root))
             else:
                 out = np.asarray(coll.gather(coll.shard(rows), root=root))
-            assembled = out[root]
-            if wire is not None:
-                # every chunk crossed the wire except the root's own
-                assembled = wire_q(assembled.reshape(W, -1))
-                assembled[root] = out[root].reshape(W, -1)[root]
-                assembled = assembled.reshape(-1)
-            devs[root]._write_result(descs[root].addr_2, assembled,
+            devs[root]._write_result(descs[root].addr_2,
+                                     wire_q_except(out[root], root),
                                      descs[root])
             return 0
         if op == CCLOp.alltoall:
             x = coll.shard(read_all(lambda d: d.addr_0, W * count))
             out = np.asarray(coll.alltoall(x))
             for r, d in enumerate(descs):
-                devs[r]._write_result(d.addr_2, out[r], d)
+                # chunk s->r crossed the wire for every s except r's own
+                # local copy (emulator-tier parity, like the rooted ops)
+                devs[r]._write_result(d.addr_2, wire_q_except(out[r], r), d)
             return 0
         return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
 

@@ -432,7 +432,13 @@ def test_wire_compressed_rooted_ops_match_emulator_tier(world):
         gsrc = a.buffer(data=ins[a.rank])
         gdst = a.buffer((W * count,), np.float32) if a.rank == root else None
         a.gather(gsrc, gdst, count, root=root, compress_dtype=np.float16)
-        return out_b, out_s, (gdst.data.copy() if gdst is not None else None)
+        out_g = gdst.data.copy() if gdst is not None else None
+
+        # per-rank-distinct data so the self-chunk restore index is strict
+        asrc = a.buffer(data=_data(W * count, np.float32, 70 + a.rank))
+        adst = a.buffer((W * count,), np.float32)
+        a.alltoall(asrc, adst, count, compress_dtype=np.float16)
+        return out_b, out_s, out_g, adst.data.copy()
 
     tpu_res = run_ranks(world, fn)
     emu = emu_world(W)
@@ -446,6 +452,8 @@ def test_wire_compressed_rooted_ops_match_emulator_tier(world):
                                       err_msg=f"bcast rank {r}")
         np.testing.assert_array_equal(tpu_res[r][1], emu_res[r][1],
                                       err_msg=f"scatter rank {r}")
+        np.testing.assert_array_equal(tpu_res[r][3], emu_res[r][3],
+                                      err_msg=f"alltoall rank {r}")
     np.testing.assert_array_equal(tpu_res[root][2], emu_res[root][2],
                                   err_msg="gather root")
 
