@@ -23,9 +23,12 @@ from accl_tpu.testing import (connect_world, free_port_base, run_ranks,
 W16 = 16
 
 
-def _world16_suite(accls):
+def _world16_suite(accls, quanta=0):
     """Representative collectives at W=16: fused allreduce (ring),
-    allgather, rooted bcast, and the barrier rendezvous."""
+    allgather, rooted bcast, the barrier rendezvous, and compressed
+    allreduce cells. ``quanta``: allowed error in representable-value
+    steps for the compressed checks (0 = bitwise; the native daemon's
+    independent C++ codecs get 1, as in test_compressed_sweep)."""
     n = 48
     ins = [np.linspace(r, r + 1, n, dtype=np.float32)
            for r in range(len(accls))]
@@ -68,6 +71,50 @@ def _world16_suite(accls):
 
     assert all(run_ranks(accls, bar, timeout=120.0))
 
+    # Compressed fused ring allreduce at W=16, two cells against the
+    # replayed-quantization goldens: per-hop ETH wire quantization across
+    # the deep ring, and the mixed-flag substitution (bf16 src operands,
+    # f32 result — phase 2 relays from the f32 dst)
+    import ml_dtypes
+
+    from test_compressed_sweep import _quant, _quantum, golden_allreduce
+
+    cdtype = np.dtype(ml_dtypes.bfloat16)
+    q = _quant(cdtype)
+    small = [x[:16] for x in ins]
+
+    def check(out, expect):
+        if quanta == 0:
+            np.testing.assert_array_equal(out, expect)
+        else:
+            err = np.abs(out - expect)
+            tol = quanta * _quantum(expect, cdtype) + 1e-7
+            assert (err <= tol).all(), err.max()
+
+    def car_eth(a):
+        src = a.buffer(data=small[a.rank])
+        dst = a.buffer((16,), np.float32)
+        a.allreduce(src, dst, 16, compress_dtype=cdtype)
+        dst.sync_from_device()
+        return dst.data.copy()
+
+    expect = golden_allreduce(small, False, False, True, q)
+    for r, out in enumerate(run_ranks(accls, car_eth, timeout=120.0)):
+        check(out, expect[r])
+
+    small_q = [q(v) for v in small]
+
+    def car_mixed(a):
+        src = a.buffer(data=small[a.rank].astype(cdtype))  # OP0 compressed
+        dst = a.buffer((16,), np.float32)
+        a.allreduce(src, dst, 16)
+        dst.sync_from_device()
+        return dst.data.copy()
+
+    expect = golden_allreduce(small_q, True, False, False, q)
+    for r, out in enumerate(run_ranks(accls, car_mixed, timeout=120.0)):
+        check(out, expect[r])
+
 
 def test_python_daemon_world16():
     accls = sim_world(W16, nbufs=32)
@@ -92,7 +139,7 @@ def test_native_daemon_world16():
     try:
         time.sleep(1.0)
         accls = connect_world(port_base, W16, timeout=60.0)
-        _world16_suite(accls)
+        _world16_suite(accls, quanta=1)
         for a in accls:
             a.deinit()
     finally:
