@@ -134,7 +134,14 @@ class SimDevice(Device):
             if reply[0] == P.MSG_DATA:
                 return np.frombuffer(reply[2:],
                                      P.code_dtype(reply[1])).copy()
-            assert reply[0] == P.MSG_STATUS
+            assert reply[0] == P.MSG_STATUS, reply[0]
+            err = struct.unpack("<I", reply[1:5])[0]
+            if err != P.STATUS_PENDING:
+                # a real daemon-side error must surface, not be spun on
+                # until a bogus empty-port timeout (the C++ driver's
+                # stream_pop decodes the same way)
+                from ..constants import ACCLError
+                raise ACCLError(err, "stream pop")
             if _time.monotonic() >= deadline:
                 raise IndexError("stream-out port empty")
 

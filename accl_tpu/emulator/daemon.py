@@ -32,6 +32,15 @@ from .executor import DeviceMemory, MoveExecutor, RxBufferPool
 from .fabric import Envelope
 
 
+def _sane_budget(b: float) -> float:
+    """Wait budgets arrive on the wire as attacker-controlled doubles:
+    NaN/Inf/negative must not reach the wait machinery, where they would
+    wedge the serving thread (mirrors the C++ daemon's sane_budget)."""
+    if not (b >= 0.0):  # NaN and negatives
+        return 0.0
+    return min(b, 3600.0)
+
+
 def _env_from_eth_frame(frame: bytes) -> tuple[Envelope, bytes]:
     """Decode an eth frame (post-MSG_ETH byte) into (Envelope, payload) —
     shared by both fabric stacks so the header format lives in one place."""
@@ -485,7 +494,8 @@ class RankDaemon:
             self.pkt_enabled = True
             return 0
         if fn == CfgFunc.set_timeout:
-            self.timeout = val / 1000.0
+            # same clamp as MSG_SET_TIMEOUT: feeds pool wait deadlines
+            self.timeout = _sane_budget(val / 1000.0)
             self.executor.timeout = self.timeout
             return 0
         if fn == CfgFunc.set_max_segment_size:
@@ -594,6 +604,14 @@ class RankDaemon:
                     return
         except (ConnectionError, OSError):
             return
+        finally:
+            # the accept loop still references the previous conn until its
+            # next accept() returns, so without an explicit close a dropped
+            # connection's fd would linger open (peers waiting on EOF hang)
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _handle(self, body: bytes) -> bytes:
         kind = body[0]
@@ -631,7 +649,7 @@ class RankDaemon:
             self.eth.learn_peers(ranks, self.world)
             return P.status_reply(0)
         if kind == P.MSG_SET_TIMEOUT:
-            (t,) = struct.unpack("<d", body[1:9])
+            t = _sane_budget(struct.unpack("<d", body[1:9])[0])
             self.timeout = t
             self.executor.timeout = t
             return P.status_reply(0)
@@ -654,8 +672,9 @@ class RankDaemon:
             return bytes([P.MSG_CALL_ID]) + struct.pack("<I", call_id)
         if kind == P.MSG_WAIT:
             (call_id,) = struct.unpack("<I", body[1:5])
-            budget = struct.unpack("<d", body[5:13])[0] if len(body) >= 13 \
-                else self.timeout
+            budget = _sane_budget(
+                struct.unpack("<d", body[5:13])[0] if len(body) >= 13
+                else self.timeout)
             import time as _time
             deadline = _time.monotonic() + budget
             with self._call_cv:
@@ -686,7 +705,7 @@ class RankDaemon:
             self.executor.push_stream(data)
             return P.status_reply(0)
         if kind == P.MSG_STREAM_POP:
-            (budget,) = struct.unpack("<d", body[1:9])
+            budget = _sane_budget(struct.unpack("<d", body[1:9])[0])
             count = struct.unpack("<Q", body[9:17])[0] if len(body) >= 17 \
                 else 0
             try:

@@ -49,7 +49,10 @@ MSG_STREAM_POP = 16   # f64 timeout-seconds + u64 count (0 = next entry
 # daemons and the robustness suite reference these — keep in sync with
 # native/protocol.hpp)
 MAX_CALL_BYTES = 1 << 40   # per-call payload ceiling (pre-expansion)
-MAX_ALLOC_BYTES = 1 << 32  # per-region allocation ceiling
+# Per-region allocation ceiling. Must stay below MAX_FRAME_LEN: a buffer
+# round-trips one MSG_WRITE_MEM / MSG_READ_MEM frame, so an allocatable
+# region whose frame the cap rejects would be unusable.
+MAX_ALLOC_BYTES = 1 << 30
 
 MSG_STATUS = 100      # u32 error word
 MSG_CALL_ID = 101     # u32 call id
@@ -98,8 +101,17 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
+# The largest legitimate frame is a device-memory write of one maximal
+# buffer (MAX_ALLOC_BYTES) plus the message header; a hostile length
+# header beyond that must drop the connection, not admit gigabytes
+# (mirrors native/protocol.hpp MAX_FRAME_LEN).
+MAX_FRAME_LEN = MAX_ALLOC_BYTES + 64
+
+
 def recv_frame(sock: socket.socket) -> bytes:
     (length,) = struct.unpack("<I", recv_exact(sock, 4))
+    if length > MAX_FRAME_LEN:
+        raise ConnectionError(f"frame length {length} exceeds protocol max")
     return recv_exact(sock, length)
 
 

@@ -898,6 +898,15 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c_in, uint8_t op,
   }
 }
 
+// Wait budgets and timeouts arrive on the wire as attacker-controlled
+// values: NaN, Inf, negative, or absurdly large values must never reach
+// wait_until's time_point conversion (UB for non-finite, a wedged
+// serving thread for huge finite ones).
+static double sane_budget(double b) {
+  if (!(b >= 0.0)) return 0.0;  // NaN and negatives
+  return std::min(b, 3600.0);
+}
+
 // ---------------------------------------------------------------------------
 // the daemon
 // ---------------------------------------------------------------------------
@@ -1174,7 +1183,8 @@ class RankDaemon {
         pkt_enabled_ = true;
         return E_OK;
       case CFG_SET_TIMEOUT:
-        timeout_ = static_cast<double>(val) / 1000.0;
+        // same clamp as MSG_SET_TIMEOUT: this field feeds wait deadlines
+        timeout_ = sane_budget(static_cast<double>(val) / 1000.0);
         return E_OK;
       case CFG_SET_SEG:
         if (val > bufsize_) return E_DMA_SIZE;
@@ -1793,7 +1803,7 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
     case MSG_SET_TIMEOUT: {
       double t;
       std::memcpy(&t, p, 8);
-      timeout_ = t;
+      timeout_ = sane_budget(t);  // feeds wait_until deadlines later
       return status_reply(E_OK);
     }
     case MSG_SET_SEG: {
@@ -1818,7 +1828,7 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
       if (body.size() >= 13) std::memcpy(&budget, p + 4, 8);
       std::unique_lock<std::mutex> lk(call_mu_);
       auto deadline = std::chrono::steady_clock::now() +
-                      std::chrono::duration<double>(budget);
+                      std::chrono::duration<double>(sane_budget(budget));
       while (call_status_.find(id) == call_status_.end()) {
         if (call_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
           return status_reply(STATUS_PENDING);
@@ -1871,7 +1881,7 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
       uint64_t count = body.size() >= 17 ? get_le<uint64_t>(p + 8) : 0;
       std::unique_lock<std::mutex> lk(stream_mu_);
       auto deadline = std::chrono::steady_clock::now() +
-                      std::chrono::duration<double>(budget);
+                      std::chrono::duration<double>(sane_budget(budget));
       if (count == 0) {
         // next entry whole
         while (stream_out_.empty()) {

@@ -29,9 +29,11 @@ enum Msg : uint8_t {
 
 static const uint32_t STATUS_PENDING = 0xFFFFFFFFu;
 
-// shared daemon resource bounds (keep in sync with protocol.py)
+// shared daemon resource bounds (keep in sync with protocol.py); the
+// allocation ceiling stays below the frame cap so every allocatable
+// buffer round-trips one MSG_WRITE_MEM / MSG_READ_MEM frame
 static const uint64_t MAX_CALL_BYTES = 1ull << 40;
-static const uint64_t MAX_ALLOC_BYTES = 1ull << 32;
+static const uint64_t MAX_ALLOC_BYTES = 1ull << 30;
 
 enum Op : uint8_t {
   OP_CONFIG = 0, OP_COPY = 1, OP_COMBINE = 2, OP_SEND = 3, OP_RECV = 4,
@@ -108,10 +110,24 @@ inline bool recv_exact(int fd, void* buf, size_t n) {
   return true;
 }
 
+// The largest legitimate frame is a device-memory write of one maximal
+// (MAX_ALLOC_BYTES) buffer plus the message header.  The length header is
+// attacker-controlled: beyond the cap the connection is dropped before
+// any allocation is committed, and an allocation failure below the cap
+// drops the connection rather than letting bad_alloc escape the serving
+// thread.
+constexpr uint32_t MAX_FRAME_LEN =
+    static_cast<uint32_t>(MAX_ALLOC_BYTES) + 64;
+
 inline bool recv_frame(int fd, std::vector<uint8_t>& body) {
   uint32_t len;
   if (!recv_exact(fd, &len, 4)) return false;
-  body.resize(len);
+  if (len > MAX_FRAME_LEN) return false;
+  try {
+    body.resize(len);
+  } catch (const std::bad_alloc&) {
+    return false;
+  }
   return len == 0 || recv_exact(fd, body.data(), len);
 }
 
@@ -119,7 +135,8 @@ inline bool send_frame(int fd, const std::vector<uint8_t>& body) {
   uint32_t len = static_cast<uint32_t>(body.size());
   std::vector<uint8_t> out(4 + body.size());
   std::memcpy(out.data(), &len, 4);
-  std::memcpy(out.data() + 4, body.data(), body.size());
+  if (!body.empty())
+    std::memcpy(out.data() + 4, body.data(), body.size());
   const uint8_t* p = out.data();
   size_t n = out.size();
   while (n) {

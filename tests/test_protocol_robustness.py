@@ -97,6 +97,52 @@ def _hostile_call(port: int):
         s.close()
 
 
+def _hostile_framing(port: int):
+    """A hostile 4 GiB length header must drop the connection promptly
+    without committing the allocation, and non-finite/negative wait
+    budgets must be clamped to an immediate PENDING — not wedge the
+    serving thread (or, in C++, hit UB in the time_point conversion)."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    try:
+        s.sendall(struct.pack("<I", 0xFFFFFFFF))  # length header, no body
+        s.settimeout(5.0)
+        assert s.recv(1) == b"", "oversize frame length not rejected"
+    finally:
+        s.close()
+    for budget in (float("nan"), float("inf") * -1, -1e308):
+        s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        try:
+            s.settimeout(5.0)
+            P.send_frame(s, bytes([P.MSG_STREAM_POP])
+                         + struct.pack("<dQ", budget, 0))
+            reply = P.recv_frame(s)
+            assert reply[0] == P.MSG_STATUS
+            assert struct.unpack("<I", reply[1:5])[0] == P.STATUS_PENDING
+            P.send_frame(s, bytes([P.MSG_WAIT])
+                         + struct.pack("<Id", 0xFFFF, budget))
+            reply = P.recv_frame(s)
+            assert struct.unpack("<I", reply[1:5])[0] == P.STATUS_PENDING
+        finally:
+            s.close()
+    # a hostile SET_TIMEOUT (NaN) must be clamped before it feeds later
+    # wait deadlines: an unknown-id WAIT with no explicit budget falls
+    # back to the daemon timeout and must reply PENDING, not wedge
+    s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    try:
+        s.settimeout(5.0)
+        P.send_frame(s, bytes([P.MSG_SET_TIMEOUT])
+                     + struct.pack("<d", float("nan")))
+        reply = P.recv_frame(s)
+        assert struct.unpack("<I", reply[1:5])[0] == 0
+        P.send_frame(s, bytes([P.MSG_WAIT]) + struct.pack("<I", 0xFFFE))
+        reply = P.recv_frame(s)
+        assert struct.unpack("<I", reply[1:5])[0] == P.STATUS_PENDING
+        P.send_frame(s, bytes([P.MSG_SET_TIMEOUT]) + struct.pack("<d", 20.0))
+        P.recv_frame(s)  # restore a sane timeout for later probes
+    finally:
+        s.close()
+
+
 def _probe(port: int):
     """Throw every malformed frame at the daemon; each must yield an error
     reply or a clean close — and afterwards a PING must still succeed."""
@@ -115,6 +161,7 @@ def _probe(port: int):
         finally:
             s.close()
     _hostile_call(port)
+    _hostile_framing(port)
     # the daemon must still be alive and serving
     s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
     try:
