@@ -23,7 +23,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _LANES = 128
-_BLOCK_ROWS = 256
+# flat payloads reshape to (-1, _COLS) like the combine dataplane: wider
+# rows mean 8x fewer grid steps, which is the difference between a
+# grid-overhead-bound lane and an HBM-bound one at large sizes
+_COLS = 1024
+_BLOCK_ROWS = 512  # 512x1024 fp32 = 2 MiB per block
 
 
 def _interpret() -> bool:
@@ -35,13 +39,15 @@ def _cast_kernel(x_ref, o_ref):
 
 
 def _tiled(x: jax.Array):
-    """Flatten + pad to (rows, 128) tile geometry; returns (tiles, n, pad)."""
+    """Flatten + pad to (rows, cols) tile geometry (1024-wide when the
+    payload allows, 128 lanes minimum); returns (tiles, n, pad)."""
     flat = x.reshape(-1)
     n = flat.size
-    pad = (-n) % _LANES
+    cols = _COLS if n >= _COLS else _LANES
+    pad = (-n) % cols
     if pad:
         flat = jnp.pad(flat, (0, pad))
-    return flat.reshape(-1, _LANES), n, pad
+    return flat.reshape(-1, cols), n, pad
 
 
 def _untiled(tiles: jax.Array, n: int, shape) -> jax.Array:

@@ -16,6 +16,14 @@ def main():
     ap.add_argument("--chip-sweep", action="store_true",
                     help="single-device combine-dataplane size sweep "
                          "(Pallas vs raw XLA; the curve behind bench.py)")
+    ap.add_argument("--chip-attention", action="store_true",
+                    help="single-device fused-attention sequence sweep "
+                         "(flash_attention Pallas kernel vs score-"
+                         "materializing XLA attention)")
+    ap.add_argument("--chip-compression", action="store_true",
+                    help="single-device wire-compression lane sweep "
+                         "(fp16/bf16 cast lanes + scaled-fp8 codec, "
+                         "Pallas vs raw XLA)")
     ap.add_argument("--tag", type=str, default=None,
                     help="suffix for the output CSV NAME only — elaborate "
                          "aggregates by CSV columns (collective/algorithm/"
@@ -26,7 +34,8 @@ def main():
     ap.add_argument("--algorithm", type=str, default="xla",
                     choices=["xla", "ring", "tree"])
     ap.add_argument("--sizes", type=str,
-                    help="comma-separated payload bytes")
+                    help="comma-separated payload bytes (sequence "
+                         "lengths for --chip-attention)")
     ap.add_argument("--wire-dtype", type=str, default=None)
     ap.add_argument("--out", type=str, default="bench_out")
     ap.add_argument("--elaborate", type=str, metavar="DIR",
@@ -75,6 +84,20 @@ def main():
         from .configs import chip_combine_sweep
         result = chip_combine_sweep(sizes)
         name = "chip_combine.csv"
+    elif args.chip_attention:
+        if args.algorithm != "xla" or args.wire_dtype:
+            ap.error("--chip-attention measures the fixed pallas-vs-xla "
+                     "bf16 pair; --algorithm/--wire-dtype do not apply")
+        from .configs import chip_attention_sweep
+        result = chip_attention_sweep(sizes)  # sizes = sequence lengths
+        name = "chip_attention.csv"
+    elif args.chip_compression:
+        if args.algorithm != "xla" or args.wire_dtype:
+            ap.error("--chip-compression sweeps all three lanes itself; "
+                     "--algorithm/--wire-dtype do not apply")
+        from .configs import chip_compression_sweep
+        result = chip_compression_sweep(sizes)
+        name = "chip_compression.csv"
     elif args.sweep:
         from accl_tpu.parallel import make_mesh
         from .sweep import sweep_collective

@@ -196,6 +196,30 @@ def config5_llama_grads(bucket_bytes: int = 25 << 20) -> SweepResult:
     return SweepResult([row])
 
 
+def _chip_slope(mk, args, work: float, assumed_rate: float,
+                cap: int = 2_000_000, floor: int = 4,
+                cpu_k: tuple[int, int] | None = None) -> float:
+    """Shared chain-length policy + clamped-slope retry for the chip
+    sweeps. The chain targets ~50 ms of device work at ``assumed_rate``
+    (work units/s for ``work`` units/op) so the slope clears tunnel/host
+    noise; a clamped (<= 2 ns) slope means transient noise beat the
+    chain, so retry once with a 4x longer one — k points must stay
+    distinct even at the cap, else the polyfit is rank-deficient and
+    returns a bogus slope. ``cpu_k`` pins a minimal functional chain on
+    the CPU tier (interpreted Pallas: a smoke run, not a bandwidth
+    claim)."""
+    from .timing import slope_time
+
+    if cpu_k is not None and _is_cpu():
+        return slope_time(mk, args, k_lo=cpu_k[0], k_hi=cpu_k[1])
+    k_hi = int(min(cap, max(9 * floor, 0.05 * assumed_rate / work)))
+    t = slope_time(mk, args, k_lo=max(floor, k_hi // 9), k_hi=k_hi)
+    if t <= 2e-9:
+        hi2 = min(cap, 4 * k_hi)
+        t = slope_time(mk, args, k_lo=max(floor, hi2 // 9), k_hi=hi2)
+    return t
+
+
 def chip_combine_sweep(sizes=None) -> SweepResult:
     """Single-device size sweep of the combine dataplane (the reduce_sum
     plugin equivalent): the Pallas VPU kernel vs the raw XLA elementwise
@@ -204,8 +228,6 @@ def chip_combine_sweep(sizes=None) -> SweepResult:
     read y + write acc)."""
     from accl_tpu.constants import ReduceFunc
     from accl_tpu.ops.combine import combine_pallas
-
-    from .timing import slope_time
 
     hi = (1 << 22) if _is_cpu() else (1 << 28)
     sizes = sizes or _size_sweep(1 << 12, hi)
@@ -238,21 +260,12 @@ def chip_combine_sweep(sizes=None) -> SweepResult:
                 return jax.lax.fori_loop(0, K, body, x)[0, 0]
             return f
 
-        # adaptive chain length: target ~50 ms of device work so the slope
-        # rises above tunnel/host noise at every size. Working sets that
-        # fit VMEM run at multi-TB/s (no HBM trips), so the assumed rate —
-        # hence K — must scale with the regime or small ops stay flat
-        # across K and the slope is garbage.
+        # working sets that fit VMEM run at multi-TB/s (no HBM trips), so
+        # the assumed rate — hence the chain length — scales with regime
+        # or small ops stay flat across K and the slope is garbage
         assumed = 5e12 if 3 * nbytes < (100 << 20) else 1e12
-        k_hi = int(min(2_000_000, max(36, 0.05 * assumed / (3 * nbytes))))
-        k_lo = max(4, k_hi // 9)
         for algo, mk in (("pallas", make_pallas), ("xla", make_xla)):
-            t = slope_time(mk, (a, b), k_lo=k_lo, k_hi=k_hi)
-            if t <= 2e-9:  # clamped slope (transient noise): longer chain
-                hi2 = min(2_000_000, 4 * k_hi)
-                # k points must stay distinct even at the cap, else the
-                # polyfit is rank-deficient and returns a bogus slope
-                t = slope_time(mk, (a, b), k_lo=max(4, hi2 // 9), k_hi=hi2)
+            t = _chip_slope(mk, (a, b), 3 * nbytes, assumed)
             rows.append({
                 "collective": "combine", "algorithm": algo, "world": 1,
                 "dtype": "float32", "wire_dtype": "", "nbytes": nbytes,
@@ -260,6 +273,163 @@ def chip_combine_sweep(sizes=None) -> SweepResult:
                 "bus_gbps": round(3 * nbytes / t / 1e9, 4),
                 "tier": tier,
             })
+    return SweepResult(rows)
+
+
+def chip_attention_sweep(seqs=None) -> SweepResult:
+    """Single-device sequence-length sweep of the fused attention kernel
+    (ops/attention.flash_attention, the compute half of the long-context
+    story) against the same math as a plain XLA program that materializes
+    the (Sq, Skv) score matrix. Causal, bf16 activations, fp32 softmax.
+
+    nbytes = the kernel's minimum HBM traffic (Q+K+V+O); bus_gbps = that
+    traffic over the measured seconds_per_op, so rows stay comparable to
+    the other dataplane curves. The table's pallas-vs-xla gap at long
+    sequence is the win from never writing scores to HBM."""
+    from accl_tpu.ops.attention import flash_attention
+
+    H, D = 8, 128
+    seqs = seqs or ([256, 1024] if _is_cpu()
+                    else [512, 1024, 2048, 4096, 8192])
+    tier = f"{jax.default_backend()}-chip"
+    rows = []
+    for S in seqs:
+        # the XLA baseline materializes a (B, H, S, S) fp32 score tensor;
+        # shrink batch at long sequence so it stays on-chip (the per-row
+        # nbytes column reflects the actual shapes)
+        B = max(1, min(4, 8192 // S))
+        key = jax.random.key(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (B, H, S, D), jnp.bfloat16)
+        k = jax.random.normal(kk, (B, H, S, D), jnp.bfloat16)
+        v = jax.random.normal(kv, (B, H, S, D), jnp.bfloat16)
+        nbytes = 4 * B * H * S * D * 2  # Q+K+V+O in bf16
+
+        def xla_attn(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32)
+            s = s * (float(D) ** -0.5)
+            qpos = jnp.arange(S)[:, None]
+            kpos = jnp.arange(S)[None, :]
+            s = jnp.where(kpos <= qpos, s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p.astype(jnp.bfloat16), v)
+
+        def make_pallas(K):
+            @jax.jit
+            def f(q, k, v):
+                def body(i, acc):
+                    return flash_attention(acc, k, v, causal=True)
+                out = jax.lax.fori_loop(0, K, body, q)
+                return out[0, 0, 0, 0].astype(jnp.float32)
+            return f
+
+        def make_xla(K):
+            @jax.jit
+            def f(q, k, v):
+                def body(i, acc):
+                    return xla_attn(acc, k, v)
+                out = jax.lax.fori_loop(0, K, body, q)
+                return out[0, 0, 0, 0].astype(jnp.float32)
+            return f
+
+        # ~2*B*H*S^2*D useful FLOPs per op (causal halves the 4x matmul
+        # count); assume a conservative 50 TFLOP/s for the chain budget
+        flops = 2 * B * H * S * S * D
+        for algo, mk in (("pallas", make_pallas), ("xla", make_xla)):
+            t = _chip_slope(mk, (q, k, v), flops, 50e12, cap=20_000,
+                            floor=2, cpu_k=(1, 3))
+            # S in the label: batch shrinks as sequence grows, so rows
+            # at different S can share nbytes and must not aggregate
+            rows.append({
+                "collective": f"attention_causal_s{S}", "algorithm": algo,
+                "world": 1, "dtype": "bfloat16", "wire_dtype": "",
+                "nbytes": nbytes, "seconds_per_op": t,
+                "bus_gbps": round(nbytes / t / 1e9, 4), "tier": tier,
+            })
+    return SweepResult(rows)
+
+
+def chip_compression_sweep(sizes=None) -> SweepResult:
+    """Single-device size sweep of the wire-compression lanes (the
+    fp_hp/hp_fp_stream_conv plugin equivalents plus the scaled-fp8
+    codec): a full encode+decode round trip per iteration, Pallas lanes
+    vs the same math as plain XLA ops.
+
+    nbytes = the fp32 payload; bus_gbps counts the round trip's actual
+    HBM traffic (read fp32 + write wire + read wire + write fp32 =
+    (8 + 2*wire_size) bytes/element) so lanes of different wire widths
+    stay comparable."""
+    from accl_tpu.ops.compression import (cast_lane, compress_fp8,
+                                          decompress_fp8, fp8_dequantize,
+                                          fp8_quantize)
+
+    hi = (1 << 22) if _is_cpu() else (1 << 27)
+    sizes = sizes or _size_sweep(1 << 14, hi)
+    tier = f"{jax.default_backend()}-chip"
+
+    # The XLA baselines put an optimization barrier between encode and
+    # decode: without it XLA fuses the round trip into one kernel that
+    # never materializes the wire tensor — but a wire codec MUST
+    # materialize it (that is the payload that ships), so the fused form
+    # would be an apples-to-oranges baseline. Note the fp16 lane lowers
+    # to the XLA cast by design (f16 is not Mosaic-native; see
+    # ops/combine._MOSAIC_DTYPES), so its two rows measure the same code
+    # modulo the barrier.
+    def fp16_pallas(x):
+        return cast_lane(cast_lane(x, jnp.float16), jnp.float32)
+
+    def fp16_xla(x):
+        w = jax.lax.optimization_barrier(x.astype(jnp.float16))
+        return w.astype(jnp.float32)
+
+    def bf16_pallas(x):
+        return cast_lane(cast_lane(x, jnp.bfloat16), jnp.float32)
+
+    def bf16_xla(x):
+        w = jax.lax.optimization_barrier(x.astype(jnp.bfloat16))
+        return w.astype(jnp.float32)
+
+    def fp8_pallas(x):
+        q, scale = compress_fp8(x)
+        return decompress_fp8(q, scale)
+
+    def fp8_xla(x):
+        q, scale = jax.lax.optimization_barrier(
+            fp8_quantize(x, jnp.float8_e4m3fn))
+        return fp8_dequantize(q, scale)
+
+    lanes = [("clane_fp16", 2, fp16_pallas, fp16_xla),
+             ("clane_bf16", 2, bf16_pallas, bf16_xla),
+             ("clane_fp8", 1, fp8_pallas, fp8_xla)]
+    rows = []
+    for nbytes in sizes:
+        n = max(1, nbytes // 4096) * 1024
+        nbytes = n * 4
+        x = jax.random.normal(jax.random.key(0), (n // 1024, 1024),
+                              jnp.float32)
+        for name, wire_size, pallas_fn, xla_fn in lanes:
+            traffic = n * (8 + 2 * wire_size)
+
+            def make_chain(roundtrip):
+                def mk(K):
+                    @jax.jit
+                    def f(x):
+                        def body(i, acc):
+                            return roundtrip(acc)
+                        return jax.lax.fori_loop(0, K, body, x)[0, 0]
+                    return f
+                return mk
+
+            for algo, fn in (("pallas", pallas_fn), ("xla", xla_fn)):
+                t = _chip_slope(make_chain(fn), (x,), traffic, 1e12,
+                                cap=500_000, cpu_k=(2, 6))
+                rows.append({
+                    "collective": name, "algorithm": algo, "world": 1,
+                    "dtype": "float32", "wire_dtype": "",
+                    "nbytes": nbytes, "seconds_per_op": t,
+                    "bus_gbps": round(traffic / t / 1e9, 4), "tier": tier,
+                })
     return SweepResult(rows)
 
 
