@@ -62,10 +62,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
-    # causal: only kv blocks overlapping [0, (qi+1)*block_q) contribute
+    # causal: only kv blocks overlapping [0, (qi+1)*block_q) contribute —
+    # computed from the block's END so a block_q that straddles block_k
+    # boundaries cannot under-count (e.g. block_q=96, block_k=128, qi=2
+    # needs ceil(288/128)=3 blocks)
     if causal:
-        nblocks = jnp.minimum((qi * block_q) // block_k + pl.cdiv(block_q,
-                                                                  block_k),
+        nblocks = jnp.minimum(pl.cdiv((qi + 1) * block_q, block_k),
                               total_kv_blocks)
     else:
         nblocks = total_kv_blocks

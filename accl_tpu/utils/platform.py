@@ -1,0 +1,25 @@
+"""Platform selection that survives the TPU-tunnel plugin.
+
+Some environments register a TPU-tunnel jax platform plugin that
+overrides a plain ``JAX_PLATFORMS`` env var, so scripts that honestly
+request the CPU tier still initialize the tunnel backend (and every
+"8-device" collective silently becomes a 1-device no-op).
+``honor_platform_env()`` makes the env var binding again by routing it
+through ``jax.config`` before first device use. tests/conftest.py
+applies the same rule (plus a CPU default) for the test corpus.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> str | None:
+    """Apply ``JAX_PLATFORMS`` through jax.config if set; returns the
+    platform applied (or None). Must run before jax touches a backend."""
+    platform = os.environ.get("JAX_PLATFORMS")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    return platform or None

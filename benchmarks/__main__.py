@@ -33,6 +33,10 @@ def main():
                     help="ad-hoc sweep of one collective")
     ap.add_argument("--algorithm", type=str, default="xla",
                     choices=["xla", "ring", "tree"])
+    ap.add_argument("--backend", type=str, default=None,
+                    choices=["emu", "daemon", "native"],
+                    help="config-1 tier: in-process emulator (default), "
+                         "Python rank daemons, or the C++ daemons")
     ap.add_argument("--sizes", type=str,
                     help="comma-separated payload bytes (sequence "
                          "lengths for --chip-attention)")
@@ -59,9 +63,15 @@ def main():
     sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes
              else None)
 
+    if args.backend and args.config != 1:
+        ap.error("--backend only applies to config 1 (the CPU-tier "
+                 "ping-pong); configs 2-5 run on the mesh")
+
     if args.config:
         from .configs import CONFIGS
         kwargs = {}
+        if args.backend:
+            kwargs["backend"] = args.backend
         if sizes:
             if args.config == 5:
                 ap.error("--sizes does not apply to config 5 "

@@ -41,25 +41,72 @@ def _is_cpu() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def config1_pingpong(sizes=None, world=2) -> SweepResult:
-    """Emulator-tier send/recv ping-pong latency (fp32)."""
-    from accl_tpu.testing import emu_world
+def config1_pingpong(sizes=None, world=2, backend: str = "emu"
+                     ) -> SweepResult:
+    """Send/recv ping-pong latency (fp32) on a CPU tier.
+
+    ``backend``: "emu" = in-process emulated device (the reference's
+    cclo_emu analog), "daemon" = Python rank daemons over the socket
+    protocol, "native" = the C++ rank daemons (build: make -C native) —
+    the out-of-process tiers pay the wire, the native one shows the
+    C++ engine's latency floor."""
+    import concurrent.futures
 
     sizes = sizes or _size_sweep(64, 1 << 20)
-    accls = emu_world(world, bufsize=max(sizes) + 64)
+    procs = []
+    if backend == "emu":
+        from accl_tpu.testing import emu_world
+        accls = emu_world(world, bufsize=max(sizes) + 64)
+    elif backend == "daemon":
+        from accl_tpu.testing import sim_world
+        accls = sim_world(world, bufsize=max(sizes) + 64)
+    elif backend == "native":
+        import os
+        import subprocess
+
+        from accl_tpu.testing import connect_world, free_port_base
+        binary = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "native", "cclo_emud")
+        if not os.path.exists(binary):
+            raise FileNotFoundError("native daemon not built "
+                                    "(make -C native)")
+        port_base = free_port_base()
+        procs = [subprocess.Popen(
+            [binary, "--rank", str(r), "--world", str(world),
+             "--port-base", str(port_base),
+             "--bufsize", str(max(sizes) + 64)])
+            for r in range(world)]
+        try:
+            accls = connect_world(port_base, world)
+        except Exception:
+            # a daemon that failed to bind/start must not outlive the
+            # failed run holding its port block
+            for p in procs:
+                p.kill()
+                p.wait()
+            raise
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
     a0, a1 = accls[0], accls[1]
     rows = []
-    import concurrent.futures
     pool = concurrent.futures.ThreadPoolExecutor(2)
     try:
-        return _pingpong_rows(a0, a1, pool, sizes, rows, world)
+        return _pingpong_rows(a0, a1, pool, sizes, rows, world,
+                              algorithm=backend,
+                              tier="emulator" if backend == "emu"
+                              else "daemon")
     finally:
         for a in accls:
             a.deinit()
+        for p in procs:
+            p.kill()
+            p.wait()
         pool.shutdown(wait=False)
 
 
-def _pingpong_rows(a0, a1, pool, sizes, rows, world) -> SweepResult:
+def _pingpong_rows(a0, a1, pool, sizes, rows, world,
+                   algorithm: str = "emu",
+                   tier: str = "emulator") -> SweepResult:
     for nbytes in sizes:
         count = nbytes // 4
         s0 = a0.buffer(data=np.ones(count, np.float32))
@@ -84,10 +131,11 @@ def _pingpong_rows(a0, a1, pool, sizes, rows, world) -> SweepResult:
         p50, _ = wall_time(once, reps=11, warmup=2)
         t = p50 / 2  # one-way
         rows.append({
-            "collective": "sendrecv", "algorithm": "emu", "world": world,
+            "collective": "sendrecv", "algorithm": algorithm,
+            "world": world,
             "dtype": "float32", "wire_dtype": "", "nbytes": nbytes,
             "seconds_per_op": t, "bus_gbps": round(nbytes / t / 1e9, 4),
-            "tier": "emulator",
+            "tier": tier,
         })
     return SweepResult(rows)
 

@@ -12,14 +12,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from accl_tpu.utils.platform import honor_platform_env
+
+honor_platform_env()  # the tunnel plugin overrides the plain env var
+
 import jax
-
-# the TPU-tunnel platform plugin overrides a plain JAX_PLATFORMS env var;
-# honor an explicit cpu request through jax.config (tests/conftest.py
-# does the same)
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

@@ -20,14 +20,11 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from accl_tpu.utils.platform import honor_platform_env
+
+honor_platform_env()  # the tunnel plugin overrides the plain env var
+
 import jax
-
-# the TPU-tunnel platform plugin overrides a plain JAX_PLATFORMS env var;
-# honor an explicit cpu request through jax.config (tests/conftest.py
-# does the same)
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
-
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
@@ -50,7 +47,9 @@ def main():
     devs = jax.devices()
     W = len(devs)
     mesh = Mesh(np.asarray(devs), ("sp",))
-    B, H, S, D = 2, 8, 64 * W, 64
+    # ulysses shards heads over the axis, so H must divide by W
+    H = 8 if W <= 8 and 8 % W == 0 else W
+    B, S, D = 2, 64 * W, 64
     print(f"ring of {W} {devs[0].platform} devices; "
           f"sequence {S} = {S // W} per rank")
 

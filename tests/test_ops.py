@@ -151,6 +151,26 @@ def test_flash_attention_matches_dense(causal, shape):
                                rtol=8e-3, atol=8e-3)
 
 
+def test_flash_attention_misaligned_blocks():
+    """Causal coverage when block_q straddles block_k boundaries: the
+    kv-block count must come from the q block's END (block_q=24,
+    block_k=32, qi=2 covers queries 48..71 and needs ceil(72/32)=3 kv
+    blocks — an aligned-only formula silently drops keys 64..71)."""
+    shape = (1, 2, 96, 16)
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(ks[0], shape, jnp.float32)
+    k = jax.random.normal(ks[1], shape, jnp.float32)
+    v = jax.random.normal(ks[2], shape, jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=24, block_k=32)
+    ref = _dense_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=8e-3, atol=8e-3)
+    # auto-selected blocks on a ragged length take the non-padding path
+    out2 = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                               rtol=8e-3, atol=8e-3)
+
+
 def test_flash_attention_bf16():
     shape = (1, 2, 96, 16)
     ks = jax.random.split(jax.random.key(1), 3)
