@@ -35,7 +35,6 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
     q = q_ref[0].astype(jnp.float32)  # (block_q, d)
     d = q.shape[-1]
     total_kv_blocks = pl.cdiv(kv_len, block_k)
-    padded_kv = k_ref.shape[1]
 
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)

@@ -88,10 +88,9 @@ def config1_pingpong(sizes=None, world=2, backend: str = "emu"
     else:
         raise ValueError(f"unknown backend {backend!r}")
     a0, a1 = accls[0], accls[1]
-    rows = []
     pool = concurrent.futures.ThreadPoolExecutor(2)
     try:
-        return _pingpong_rows(a0, a1, pool, sizes, rows, world,
+        return _pingpong_rows(a0, a1, pool, sizes, world,
                               algorithm=backend,
                               tier="emulator" if backend == "emu"
                               else "daemon")
@@ -104,9 +103,10 @@ def config1_pingpong(sizes=None, world=2, backend: str = "emu"
         pool.shutdown(wait=False)
 
 
-def _pingpong_rows(a0, a1, pool, sizes, rows, world,
+def _pingpong_rows(a0, a1, pool, sizes, world,
                    algorithm: str = "emu",
                    tier: str = "emulator") -> SweepResult:
+    rows = []
     for nbytes in sizes:
         count = nbytes // 4
         s0 = a0.buffer(data=np.ones(count, np.float32))

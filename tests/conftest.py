@@ -27,12 +27,20 @@ if "xla_force_host_platform_device_count" not in flags:
 # Force the CPU platform unless the user explicitly picked one: the infra
 # pre-sets JAX_PLATFORMS=axon (TPU tunnel) in a way plain env overrides
 # can't beat, hence jax.config. An explicit JAX_PLATFORMS other than the
-# infra default is honored, as is ACCL_TEST_TPU=1.
-if (not os.environ.get("ACCL_TEST_TPU")
-        and os.environ.get("JAX_PLATFORMS", "axon") in ("axon", "cpu")):
-    import jax
+# infra default is honored (routed through jax.config too — the plain
+# env var alone loses to the tunnel plugin), as is ACCL_TEST_TPU=1.
+if not os.environ.get("ACCL_TEST_TPU"):
+    if os.environ.get("JAX_PLATFORMS", "axon") in ("axon", "cpu"):
+        import jax
 
-    jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from accl_tpu.utils.platform import honor_platform_env
+
+        honor_platform_env()
 
 
 def dense_attention(q, k, v, causal):
