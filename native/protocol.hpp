@@ -132,18 +132,34 @@ inline bool recv_frame(int fd, std::vector<uint8_t>& body) {
 }
 
 inline bool send_frame(int fd, const std::vector<uint8_t>& body) {
+  // scatter-gather send: the length header and the body go out in one
+  // syscall without copying the body into a fresh buffer (a per-frame
+  // MiB-scale memcpy at large messages otherwise)
   uint32_t len = static_cast<uint32_t>(body.size());
-  std::vector<uint8_t> out(4 + body.size());
-  std::memcpy(out.data(), &len, 4);
-  if (!body.empty())
-    std::memcpy(out.data() + 4, body.data(), body.size());
-  const uint8_t* p = out.data();
-  size_t n = out.size();
-  while (n) {
-    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+  struct iovec iov[2];
+  iov[0].iov_base = &len;
+  iov[0].iov_len = 4;
+  iov[1].iov_base = const_cast<uint8_t*>(body.data());
+  iov[1].iov_len = body.size();
+  struct msghdr msg = {};
+  msg.msg_iov = iov;
+  msg.msg_iovlen = body.empty() ? 1 : 2;
+  size_t sent = 0, total = 4 + body.size();
+  while (sent < total) {
+    ssize_t r = ::sendmsg(fd, &msg, MSG_NOSIGNAL);
     if (r <= 0) return false;
-    p += r;
-    n -= static_cast<size_t>(r);
+    sent += static_cast<size_t>(r);
+    // advance the iovecs past what went out (short writes happen under
+    // backpressure)
+    size_t done = static_cast<size_t>(r);
+    for (int i = 0; i < 2 && done; ++i) {
+      size_t take = done < iov[i].iov_len ? done : iov[i].iov_len;
+      iov[i].iov_base = static_cast<uint8_t*>(iov[i].iov_base) + take;
+      iov[i].iov_len -= take;
+      done -= take;
+    }
+    msg.msg_iov = iov[0].iov_len ? iov : iov + 1;
+    msg.msg_iovlen = (iov[0].iov_len ? 1 : 0) + (iov[1].iov_len ? 1 : 0);
   }
   return true;
 }
