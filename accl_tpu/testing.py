@@ -106,10 +106,18 @@ def connect_world(port_base: int, world_size: int,
 
 
 def sim_world(world_size: int, nbufs: int = 16, bufsize: int = 1 << 20,
-              timeout: float = 20.0) -> list[ACCL]:
+              timeout: float = 20.0, stack: str = "tcp") -> list[ACCL]:
     """Create ACCL instances driving out-of-process-style rank daemons over
     the socket protocol (daemons run in-process threads here; the same
-    protocol drives true multi-process daemons and the native C++ daemon)."""
+    protocol drives true multi-process daemons and the native C++ daemon).
+    ``stack`` selects the eth fabric (tcp or udp)."""
     from .emulator.daemon import spawn_world
-    _, port_base = spawn_world(world_size, nbufs=nbufs, bufsize=bufsize)
-    return connect_world(port_base, world_size, timeout=timeout)
+    daemons, port_base = spawn_world(world_size, nbufs=nbufs,
+                                     bufsize=bufsize, stack=stack)
+    try:
+        return connect_world(port_base, world_size, timeout=timeout)
+    except Exception:
+        # daemons must not outlive a failed connect holding their ports
+        for d in daemons:
+            d.shutdown()
+        raise

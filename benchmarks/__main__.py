@@ -37,6 +37,9 @@ def main():
                     choices=["emu", "daemon", "native"],
                     help="config-1 tier: in-process emulator (default), "
                          "Python rank daemons, or the C++ daemons")
+    ap.add_argument("--stack", type=str, default=None,
+                    choices=["tcp", "udp"],
+                    help="config-1 daemon eth fabric (default tcp)")
     ap.add_argument("--sizes", type=str,
                     help="comma-separated payload bytes (sequence "
                          "lengths for --chip-attention)")
@@ -66,12 +69,17 @@ def main():
     if args.backend and args.config != 1:
         ap.error("--backend only applies to config 1 (the CPU-tier "
                  "ping-pong); configs 2-5 run on the mesh")
+    if args.stack and (args.config != 1
+                       or args.backend not in ("daemon", "native")):
+        ap.error("--stack only applies to config 1 with a daemon backend")
 
     if args.config:
         from .configs import CONFIGS
         kwargs = {}
         if args.backend:
             kwargs["backend"] = args.backend
+        if args.stack:
+            kwargs["stack"] = args.stack
         if sizes:
             if args.config == 5:
                 ap.error("--sizes does not apply to config 5 "

@@ -41,15 +41,17 @@ def _is_cpu() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def config1_pingpong(sizes=None, world=2, backend: str = "emu"
-                     ) -> SweepResult:
+def config1_pingpong(sizes=None, world=2, backend: str = "emu",
+                     stack: str = "tcp") -> SweepResult:
     """Send/recv ping-pong latency (fp32) on a CPU tier.
 
     ``backend``: "emu" = in-process emulated device (the reference's
     cclo_emu analog), "daemon" = Python rank daemons over the socket
     protocol, "native" = the C++ rank daemons (build: make -C native) —
     the out-of-process tiers pay the wire, the native one shows the
-    C++ engine's latency floor."""
+    C++ engine's latency floor. ``stack`` selects the daemon eth fabric
+    (tcp or udp, the reference's dual-stack axis); the emu tier has no
+    wire and ignores it."""
     import concurrent.futures
 
     sizes = sizes or _size_sweep(64, 1 << 20)
@@ -59,7 +61,7 @@ def config1_pingpong(sizes=None, world=2, backend: str = "emu"
         accls = emu_world(world, bufsize=max(sizes) + 64)
     elif backend == "daemon":
         from accl_tpu.testing import sim_world
-        accls = sim_world(world, bufsize=max(sizes) + 64)
+        accls = sim_world(world, bufsize=max(sizes) + 64, stack=stack)
     elif backend == "native":
         import os
         import subprocess
@@ -73,7 +75,7 @@ def config1_pingpong(sizes=None, world=2, backend: str = "emu"
         port_base = free_port_base()
         procs = [subprocess.Popen(
             [binary, "--rank", str(r), "--world", str(world),
-             "--port-base", str(port_base),
+             "--port-base", str(port_base), "--stack", stack,
              "--bufsize", str(max(sizes) + 64)])
             for r in range(world)]
         try:
@@ -89,9 +91,11 @@ def config1_pingpong(sizes=None, world=2, backend: str = "emu"
         raise ValueError(f"unknown backend {backend!r}")
     a0, a1 = accls[0], accls[1]
     pool = concurrent.futures.ThreadPoolExecutor(2)
+    algo = backend if (stack == "tcp" or backend == "emu") \
+        else f"{backend}-{stack}"
     try:
         return _pingpong_rows(a0, a1, pool, sizes, world,
-                              algorithm=backend,
+                              algorithm=algo,
                               tier="emulator" if backend == "emu"
                               else "daemon")
     finally:

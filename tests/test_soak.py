@@ -1,0 +1,134 @@
+"""Sustained-load soak: many mixed calls through each tier's full stack.
+
+The robustness suite probes hostile frames one at a time; this drives
+each daemon tier with a long seeded stream of mixed collectives — varying
+counts (segment-straddling included), dtype pairs, ETH wire compression,
+algorithm selectors, and async chains — asserting every call retires
+clean and the daemons stay alive. This is where lock/CV bugs in the call
+workers, rendezvous, and fabric surface (the reference's analog is the
+threading section of test/host/test.py).
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from accl_tpu.constants import CollectiveAlgorithm as A
+from accl_tpu.testing import connect_world, free_port_base, run_ranks
+
+W = 4
+ROUNDS = 40
+SEG = 1 << 12
+
+
+def _soak(accls):
+    rng = np.random.default_rng(7)
+    # one pre-generated schedule shared by every rank: collectives are
+    # symmetric, so ranks must agree on op order per communicator
+    algos = {"allreduce": [A.AUTO, A.FUSED_RING, A.NON_FUSED],
+             "allgather": [A.AUTO, A.RING, A.ROUND_ROBIN],
+             "bcast": [A.AUTO, A.ROUND_ROBIN, A.TREE],
+             "reduce_scatter": [A.AUTO, A.RING]}
+    schedule = []
+    for _ in range(ROUNDS):
+        op = rng.choice(["allreduce", "allgather", "bcast",
+                         "reduce_scatter"])
+        count = int(rng.choice([1, 7, W * 3, SEG // 4 - 1,
+                                SEG // 4 * 2 + 5]))
+        if op == "reduce_scatter":
+            count = max(count, W)  # at least one element per rank
+        dtype = rng.choice(["float32", "float16"])
+        compressed = bool(rng.integers(0, 2)) and dtype == "float32"
+        wire = bool(rng.integers(0, 2)) and dtype == "float32"
+        root = int(rng.integers(0, W))
+        chain = bool(rng.integers(0, 2))
+        algo = algos[op][int(rng.integers(0, len(algos[op])))]
+        schedule.append((op, count, dtype, compressed, wire, root, chain,
+                         algo))
+
+    def body(a):
+        pending = []
+        for (op, count, dtype, compressed, wire, root, chain,
+             algo) in schedule:
+            dt = np.dtype(dtype)
+            out_dt = np.float16 if compressed else dt
+            # ETH_COMPRESSED wire casting on a random subset
+            cd = np.float16 if wire else None
+            data = (np.arange(count) % 13 - 6).astype(dt) + a.rank
+            waitfor = [pending[-1]] if (chain and pending) else []
+            if op == "allreduce":
+                src = a.buffer(data=data)
+                dst = a.buffer((count,), out_dt)
+                h = a.allreduce(src, dst, count, run_async=True,
+                                algorithm=algo, compress_dtype=cd,
+                                waitfor=waitfor)
+            elif op == "allgather":
+                src = a.buffer(data=data)
+                dst = a.buffer((count * W,), dt)
+                h = a.allgather(src, dst, count, run_async=True,
+                                algorithm=algo, compress_dtype=cd,
+                                waitfor=waitfor)
+            elif op == "bcast":
+                buf = (a.buffer(data=data) if a.rank == root
+                       else a.buffer((count,), dt))
+                h = a.bcast(buf, count, root=root, run_async=True,
+                            algorithm=algo, compress_dtype=cd,
+                            waitfor=waitfor)
+            else:  # reduce_scatter
+                per = count // W
+                src = a.buffer(data=(np.arange(per * W) % 9).astype(dt)
+                               + a.rank)
+                dst = a.buffer((per,), dt)
+                h = a.reduce_scatter(src, dst, per, run_async=True,
+                                     compress_dtype=cd, waitfor=waitfor)
+            pending.append(h)
+        errs = [h.wait(timeout=120.0) for h in pending]
+        # the world must still compute correctly after the storm
+        src = a.buffer(data=np.ones(16, np.float32))
+        dst = a.buffer((16,), np.float32)
+        a.allreduce(src, dst, 16)
+        dst.sync_from_device()
+        return errs, dst.data.copy()
+
+    for errs, final in run_ranks(accls, body, timeout=300.0):
+        assert all(e in (0, None) for e in errs), errs
+        np.testing.assert_allclose(final, float(W))
+
+
+def test_soak_python_daemon():
+    from accl_tpu.emulator.daemon import spawn_world
+
+    daemons, pb = spawn_world(W, nbufs=32)
+    try:
+        accls = connect_world(pb, W, timeout=60.0)
+        _soak(accls)
+        for a in accls:
+            a.deinit()
+    finally:
+        for d in daemons:
+            d.shutdown()
+
+
+def test_soak_native_daemon():
+    binary = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "cclo_emud")
+    if not os.path.exists(binary):
+        pytest.skip("native daemon not built (make -C native)")
+    pb = free_port_base()
+    procs = [subprocess.Popen(
+        [binary, "--rank", str(r), "--world", str(W),
+         "--port-base", str(pb), "--nbufs", "32"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+        for r in range(W)]
+    try:
+        accls = connect_world(pb, W, timeout=60.0)
+        _soak(accls)
+        assert all(p.poll() is None for p in procs), "a daemon died"
+        for a in accls:
+            a.deinit()
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait()
