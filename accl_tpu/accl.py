@@ -434,14 +434,17 @@ class ACCL:
 
     def reduce_scatter(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer,
                        count: int, func: ReduceFunc = ReduceFunc.SUM, *,
-                       comm: Communicator | None = None, compress_dtype=None,
+                       comm: Communicator | None = None,
+                 algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
+                       compress_dtype=None,
                        run_async: bool = False,
                        waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """count = per-rank chunk; srcbuf holds world_size*count."""
         comm = comm or self.comm
         desc = self._prepare(CCLOp.reduce_scatter, count=count, comm=comm,
                              func=func, op0=srcbuf, res=dstbuf,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype,
+                             algorithm=algorithm)
         return self._call(desc, run_async, waitfor)
 
     def alltoall(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,

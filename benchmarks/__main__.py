@@ -24,6 +24,9 @@ def main():
                     help="single-device wire-compression lane sweep "
                          "(fp16/bf16 cast lanes + scaled-fp8 codec, "
                          "Pallas vs raw XLA)")
+    ap.add_argument("--chip-llama", action="store_true",
+                    help="single-device Llama train-step + KV-cache "
+                         "decode throughput (tokens/s)")
     ap.add_argument("--tag", type=str, default=None,
                     help="suffix for the output CSV NAME only — elaborate "
                          "aggregates by CSV columns (collective/algorithm/"
@@ -116,6 +119,13 @@ def main():
         from .configs import chip_compression_sweep
         result = chip_compression_sweep(sizes)
         name = "chip_compression.csv"
+    elif args.chip_llama:
+        if args.algorithm != "xla" or args.wire_dtype or sizes:
+            ap.error("--chip-llama uses a fixed model geometry; "
+                     "--algorithm/--wire-dtype/--sizes do not apply")
+        from .configs import chip_llama_sweep
+        result = chip_llama_sweep()
+        name = "chip_llama.csv"
     elif args.sweep:
         from accl_tpu.parallel import make_mesh
         from .sweep import sweep_collective

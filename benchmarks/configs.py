@@ -485,6 +485,93 @@ def chip_compression_sweep(sizes=None) -> SweepResult:
     return SweepResult(rows)
 
 
+def chip_llama_sweep() -> SweepResult:
+    """Model-family throughput on one chip: Llama train step (fwd + bwd +
+    adamw) and KV-cache decode. The rows put tokens/s in the bus_gbps
+    column — the familiar model metric, not a bandwidth.
+
+    CPU tier runs the tiny geometry as a functional smoke."""
+    import optax
+
+    from accl_tpu.models import Llama, LlamaConfig
+
+    from .timing import slope_time
+
+    if _is_cpu():
+        config = LlamaConfig.tiny()
+        B, S = 2, 32
+        dec_prompt, dec_hi = 8, 6
+    else:
+        # ~200M-param single-chip geometry: fits fp32 train state + seq
+        # 1024 activations comfortably in one chip's HBM
+        config = LlamaConfig(vocab_size=32000, dim=1024, n_layers=12,
+                             n_heads=16, n_kv_heads=8, ffn_dim=2816,
+                             max_seq_len=2048)
+        B, S = 8, 1024
+        dec_prompt, dec_hi = 64, 72
+    model = Llama(config)
+    params = model.init(jax.random.key(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    optimizer = optax.adamw(1e-4)
+    opt_state = optimizer.init(params)
+    train = model.make_train_step(optimizer)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, config.vocab_size, (B, S)), jnp.int32)
+    tier = f"{jax.default_backend()}-chip"
+    rows = []
+
+    def mk_train(K):
+        @jax.jit
+        def f(params, opt_state, tokens):
+            def body(i, c):
+                p, o = c
+                p, o, _ = train(p, o, tokens)
+                return (p, o)
+            p, _ = jax.lax.fori_loop(0, K, body, (params, opt_state))
+            return jax.tree.leaves(p)[0].reshape(-1)[0]
+        return f
+
+    t = slope_time(mk_train, (params, opt_state, tokens),
+                   k_lo=2, k_hi=8, reps=3)
+    model_dtype = str(np.dtype(config.dtype))
+    rows.append({
+        "collective": "llama_train_step", "algorithm": "chip", "world": 1,
+        "dtype": model_dtype, "wire_dtype": "", "nbytes": B * S,
+        "seconds_per_op": t, "bus_gbps": round(B * S / t, 1), "tier": tier,
+    })
+    log_tr = (f"train: {B * S / t:.0f} tokens/s "
+              f"({6 * n_params * B * S / t / 1e12:.1f} TFLOP/s, "
+              f"{n_params / 1e6:.0f}M params)")
+
+    # decode: greedy single-token steps against a growing KV cache
+    cache = model.init_kv_cache(B, dec_prompt + dec_hi + 8)
+    logits, cache = model._jit_forward_cached()(
+        params, tokens[:, :dec_prompt], cache)
+    tok = jnp.argmax(logits[:, -1:], axis=-1)
+
+    def mk_dec(K):
+        @jax.jit
+        def f(params, tok, cache):
+            def body(i, c):
+                tk, ca = c
+                lg, ca = model.forward_cached(params, tk, ca)
+                return (jnp.argmax(lg[:, -1:], axis=-1), ca)
+            tk, _ = jax.lax.fori_loop(0, K, body, (tok, cache))
+            return tk[0, 0]
+        return f
+
+    t = slope_time(mk_dec, (params, tok, cache),
+                   k_lo=max(2, dec_hi // 9), k_hi=dec_hi, reps=3)
+    rows.append({
+        "collective": "llama_decode", "algorithm": "chip", "world": 1,
+        "dtype": model_dtype, "wire_dtype": "", "nbytes": B,
+        "seconds_per_op": t, "bus_gbps": round(B / t, 1), "tier": tier,
+    })
+    print(log_tr)
+    print(f"decode: {B / t:.0f} tokens/s at batch {B}")
+    return SweepResult(rows)
+
+
 CONFIGS = {
     1: config1_pingpong,
     2: config2_allreduce_sweep,
