@@ -151,6 +151,24 @@ def test_flash_attention_matches_dense(causal, shape):
                                rtol=8e-3, atol=8e-3)
 
 
+def test_auto_block_invariants():
+    """The adaptive block chooser must (a) never pad more than 25% of the
+    length beyond what the 128-block floor already pads, and (b) prefer
+    exact divisors when the length is short enough for padding to matter
+    (past 4x a candidate the marginal pad is accepted for MXU width)."""
+    from accl_tpu.ops.attention import _auto_block
+    for s in range(1, 4097):
+        b = _auto_block(s)
+        assert b in (128, 256, 512)
+        padded = -(-s // b) * b
+        baseline = -(-s // 128) * 128  # the old fixed-block padding
+        assert padded - baseline <= s * 0.25, (s, b)
+        if s % 512 == 0:
+            assert b == 512, (s, b)
+        elif s % 256 == 0 and s < 2048:
+            assert b == 256, (s, b)
+
+
 def test_flash_attention_misaligned_blocks():
     """Causal coverage when block_q straddles block_k boundaries: the
     kv-block count must come from the q block's END (block_q=24,

@@ -143,6 +143,35 @@ def _hostile_framing(port: int):
         s.close()
 
 
+def _fuzz(port: int, frames: int = 150):
+    """Unstructured fuzz: random frame bodies (random kinds, random
+    lengths, random bytes) must never take the daemon down. Replies are
+    drained but not interpreted — only survival is asserted (the PING in
+    _probe afterwards)."""
+    import numpy as np
+    rng = np.random.default_rng(0xACC1)
+    s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+    s.settimeout(5.0)
+    try:
+        for _ in range(frames):
+            body = rng.bytes(int(rng.integers(0, 600)))
+            if body and body[0] == P.MSG_SHUTDOWN:
+                # a shutdown is a legitimate command, not a crash — fuzz
+                # must not depend on the seed avoiding it
+                body = bytes([0]) + body[1:]
+            try:
+                P.send_frame(s, body)
+                P.recv_frame(s)
+            except (ConnectionError, OSError):
+                # a clean drop is acceptable; reconnect and keep fuzzing
+                s.close()
+                s = socket.create_connection(("127.0.0.1", port),
+                                             timeout=5.0)
+                s.settimeout(5.0)
+    finally:
+        s.close()
+
+
 def _probe(port: int):
     """Throw every malformed frame at the daemon; each must yield an error
     reply or a clean close — and afterwards a PING must still succeed."""
@@ -162,6 +191,7 @@ def _probe(port: int):
             s.close()
     _hostile_call(port)
     _hostile_framing(port)
+    _fuzz(port)
     # the daemon must still be alive and serving
     s = socket.create_connection(("127.0.0.1", port), timeout=5.0)
     try:
