@@ -314,6 +314,35 @@ def test_allgather_flags(world, cdtype):
 
 
 @pytest.mark.parametrize("cdtype", PAIRS)
+def test_alltoall_flags(world, cdtype):
+    """Flag product for alltoall: rank r's chunk j lands at rank j. The
+    self chunk never touches the wire (local copy), so ETH compression
+    must not quantize it — the same substitution discipline the rooted
+    ops prove (reference: ETH rules, ccl_offload_control.c:533-535)."""
+    ins = [np.concatenate([_data(60 + 10 * r + j) for j in range(W)])
+           for r in range(W)]
+    q = _quant(cdtype)
+    for c_op0, c_res, eth in itertools.product(BOOLS, BOOLS, BOOLS):
+        wire = cdtype if eth else None
+
+        def fn(a):
+            src = _buf(a, ins[a.rank], c_op0, cdtype)
+            dst = _out(a, W * COUNT, c_res, cdtype)
+            a.alltoall(src, dst, COUNT, compress_dtype=wire)
+            return _read(dst)
+
+        outs = run_ranks(world, fn)
+        for dst_r, out in enumerate(outs):
+            for src_r in range(W):
+                chunk = ins[src_r][dst_r * COUNT:(dst_r + 1) * COUNT]
+                on_path = ((c_op0 or c_res) if src_r == dst_r
+                           else (c_op0 or eth or c_res))
+                np.testing.assert_array_equal(
+                    out[src_r * COUNT:(src_r + 1) * COUNT],
+                    q(chunk) if on_path else chunk)
+
+
+@pytest.mark.parametrize("cdtype", PAIRS)
 def test_allreduce_flags(world, cdtype):
     ins = [_data(40 + r) for r in range(W)]
     q = _quant(cdtype)
