@@ -227,7 +227,8 @@ class ACCL:
         import time as _time
         profiling = self.profiler.enabled and desc.scenario != CCLOp.config
         t0 = _time.perf_counter() if profiling else 0.0
-        handle = self.device.call_async(desc, waitfor)
+        handle = self.device.call_async(desc, waitfor,
+                                        inline_ok=not run_async)
         if profiling:
             ebytes = (desc.arithcfg.uncompressed_elem_bytes
                       if desc.arithcfg is not None else 0)

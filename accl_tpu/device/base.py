@@ -34,12 +34,22 @@ class Device(abc.ABC):
 
     @abc.abstractmethod
     def call_async(self, desc: CallDescriptor,
-                   waitfor: Sequence[CallHandle] = ()) -> CallHandle: ...
+                   waitfor: Sequence[CallHandle] = (), *,
+                   inline_ok: bool = False) -> CallHandle:
+        """Submit a call; returns its handle.
+
+        ``inline_ok`` is a latency hint: the caller will immediately block
+        on the handle (a synchronous driver call), so a backend MAY retire
+        the call in the calling thread instead of a worker. It must never
+        be set for calls the caller treats as asynchronous — an inline
+        blocking recv would stall (or deadlock) a symmetric async program.
+        """
 
     def call_sync(self, desc: CallDescriptor,
                   waitfor: Sequence[CallHandle] = (),
                   timeout: float | None = None):
-        return self.call_async(desc, waitfor).wait(timeout)
+        return self.call_async(desc, waitfor,
+                               inline_ok=True).wait(timeout)
 
     @abc.abstractmethod
     def configure_communicator(self, comm: Communicator): ...

@@ -184,7 +184,10 @@ class SimDevice(Device):
         return None
 
     def call_async(self, desc: CallDescriptor,
-                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                   waitfor: Sequence[CallHandle] = (), *,
+                   inline_ok: bool = False) -> CallHandle:
+        # inline_ok unused: submission is a non-blocking RPC and completion
+        # polling already runs off-thread; the socket round trips dominate
         handle = CallHandle(context=desc.scenario.name)
         self._dispatch_q.put((desc, tuple(waitfor), handle))
         return handle

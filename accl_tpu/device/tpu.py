@@ -189,7 +189,10 @@ class TpuDevice(Device):
         self.max_segment_size = nbytes
 
     def call_async(self, desc: CallDescriptor,
-                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                   waitfor: Sequence[CallHandle] = (), *,
+                   inline_ok: bool = False) -> CallHandle:
+        # inline_ok unused: the rendezvous already runs the collective in
+        # whichever rank's thread completes the group (outside the lock)
         handle = CallHandle(context=desc.scenario.name)
         self._calls.put((desc, tuple(waitfor), handle))
         return handle

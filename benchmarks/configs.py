@@ -23,7 +23,6 @@ import jax.numpy as jnp
 
 from accl_tpu.parallel import make_mesh
 from .sweep import SweepResult, sweep_collective
-from .timing import wall_time
 
 
 def _size_sweep(lo: int, hi: int, stride: int = 4) -> list[int]:
@@ -110,6 +109,12 @@ def config1_pingpong(sizes=None, world=2, backend: str = "emu",
 def _pingpong_rows(a0, a1, pool, sizes, world,
                    algorithm: str = "emu",
                    tier: str = "emulator") -> SweepResult:
+    """Steady-state ping-pong: each rank loops its send/recv sequence
+    inside one long-lived thread (the reference's chained-iteration
+    method, test.py:923-1156) so per-iteration harness dispatch does not
+    pollute the latency floor."""
+    import time as _time
+
     rows = []
     for nbytes in sizes:
         count = nbytes // 4
@@ -118,22 +123,30 @@ def _pingpong_rows(a0, a1, pool, sizes, world,
         s1 = a1.buffer(data=np.ones(count, np.float32))
         r1 = a1.buffer((count,), np.float32)
 
-        def rank0():
-            a0.send(s0, count, dst=1, tag=7)
-            a0.recv(r0, count, src=1, tag=9)
+        def pair(iters):
+            def rank0():
+                for _ in range(iters):
+                    a0.send(s0, count, dst=1, tag=7)
+                    a0.recv(r0, count, src=1, tag=9)
 
-        def rank1():
-            a1.recv(r1, count, src=0, tag=7)
-            a1.send(s1, count, dst=0, tag=9)
+            def rank1():
+                for _ in range(iters):
+                    a1.recv(r1, count, src=0, tag=7)
+                    a1.send(s1, count, dst=0, tag=9)
 
-        def once():
             f0 = pool.submit(rank0)
             f1 = pool.submit(rank1)
-            f0.result(30)
-            f1.result(30)
+            f0.result(120)
+            f1.result(120)
 
-        p50, _ = wall_time(once, reps=11, warmup=2)
-        t = p50 / 2  # one-way
+        iters = max(10, min(200, (1 << 22) // max(nbytes, 1)))
+        pair(3)  # warmup
+        samples = []
+        for _ in range(5):
+            t0 = _time.perf_counter()
+            pair(iters)
+            samples.append((_time.perf_counter() - t0) / iters)
+        t = float(np.median(samples)) / 2  # one-way
         rows.append({
             "collective": "sendrecv", "algorithm": algorithm,
             "world": world,
