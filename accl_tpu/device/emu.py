@@ -76,6 +76,8 @@ class EmuDevice(Device):
         # sender (the reference's emulator wire — ZMQ pub/sub — buffers the
         # same way); only this thread blocks when the rx pool is full
         self._inbox: queue.Queue = queue.Queue()
+        self._ing_mu = threading.Lock()
+        self._inbox_pending = 0
         self._ingress = threading.Thread(target=self._ingress_loop,
                                          daemon=True,
                                          name=f"emu-ingress{rank}")
@@ -83,6 +85,20 @@ class EmuDevice(Device):
 
     # -- ingress (eager, never blocks the sender) --------------------------
     def ingest(self, env: Envelope, payload: bytes):
+        # Fast path: when nothing is queued OR still draining (the counter
+        # covers the dequeued-but-not-yet-ingested window), deliver into
+        # the pool from the sender's thread — one scheduler handoff less
+        # per message. Pool matching is exact-seqn so pool arrival order
+        # is irrelevant, and try_ingest never claims the last spare, so a
+        # racing queued message cannot be starved of its slot. Stream
+        # payloads are order-sensitive and always take the queue.
+        if not env.strm:
+            with self._ing_mu:
+                fast = self._inbox_pending == 0
+            if fast and self.pool.try_ingest(env, payload):
+                return
+        with self._ing_mu:
+            self._inbox_pending += 1
         self._inbox.put((env, payload))
 
     def _ingress_loop(self):
@@ -90,11 +106,15 @@ class EmuDevice(Device):
             item = self._inbox.get()
             if item is None:
                 return
-            env, payload = item
-            if env.strm:
-                self.executor.deliver_stream(env, payload)
-            else:
-                self.pool.ingest(env, payload, timeout=self.timeout)
+            try:
+                env, payload = item
+                if env.strm:
+                    self.executor.deliver_stream(env, payload)
+                else:
+                    self.pool.ingest(env, payload, timeout=self.timeout)
+            finally:
+                with self._ing_mu:
+                    self._inbox_pending -= 1
 
     # -- Device interface --------------------------------------------------
     def register_buffer(self, buf: ACCLBuffer):
@@ -144,7 +164,9 @@ class EmuDevice(Device):
         # submitted meanwhile serializes behind _exec_mu.
         if inline_ok and all(dep.done() for dep in waitfor):
             with self._mu:
-                idle = self._inflight == 0 and self._calls.empty()
+                # _inflight counts queued + executing calls (incremented
+                # before every put), so 0 alone means fully idle
+                idle = self._inflight == 0
                 if idle:
                     self._inflight += 1
             if idle:

@@ -99,6 +99,19 @@ class RxBufferPool:
         self._cv = threading.Condition()
         self.error_word = 0
 
+    def _claim(self, env: Envelope, payload: bytes, keep: int) -> bool:
+        """Claim an IDLE buffer, leaving at least ``keep`` spares; caller
+        holds ``self._cv``. The one shared copy of the buffer-claim
+        protocol (status transition, assignment, wakeup)."""
+        idle = [b for b in self.bufs if b.status == RxBuffer.IDLE]
+        if len(idle) <= keep:
+            return False
+        b = idle[0]
+        b.status = RxBuffer.RESERVED
+        b.env, b.payload = env, payload
+        self._cv.notify_all()
+        return True
+
     def ingest(self, env: Envelope, payload: bytes,
                timeout: float = 10.0) -> int:
         """Accept a message into a spare buffer.
@@ -115,18 +128,27 @@ class RxBufferPool:
                 self.error_word |= int(ErrorCode.DMA_SIZE_ERROR)
                 return int(ErrorCode.DMA_SIZE_ERROR)
             while True:
-                for b in self.bufs:
-                    if b.status == RxBuffer.IDLE:
-                        b.status = RxBuffer.RESERVED
-                        b.env, b.payload = env, payload
-                        self._cv.notify_all()
-                        return 0
+                if self._claim(env, payload, keep=0):
+                    return 0
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cv.wait(remaining):
                     self.error_word |= int(
                         ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
                     return int(
                         ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+
+    def try_ingest(self, env: Envelope, payload: bytes) -> bool:
+        """Non-blocking ingest: True if a spare buffer took the message,
+        False when the caller must fall back to the blocking path. Never
+        claims the LAST spare — a queued message headed for the blocking
+        path must always find a slot, or a fast-path arrival could starve
+        it into a timeout. Oversize payloads latch the error like
+        ``ingest``."""
+        with self._cv:
+            if len(payload) > self.bufsize:
+                self.error_word |= int(ErrorCode.DMA_SIZE_ERROR)
+                return True  # consumed (dropped) — retrying cannot help
+            return self._claim(env, payload, keep=1)
 
     def _match(self, src: int, tag: int, seqn: int,
                comm_id: int) -> RxBuffer | None:
