@@ -65,6 +65,47 @@ _CHILD = textwrap.dedent("""
 """)
 
 
+# ring attention with the sequence sharded across the PROCESS boundary:
+# K/V ppermute hops cross gloo between the two jax processes — the
+# long-context schedule on the DCN tier for real
+_CHILD_RING = textwrap.dedent("""
+    import sys
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    from accl_tpu.parallel.multislice import distributed_init
+    assert distributed_init(coordinator_address="127.0.0.1:" + port,
+                            num_processes=nprocs, process_id=pid)
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from jax.experimental import multihost_utils
+    from accl_tpu.parallel.ring_attention import ring_attention_sharded
+
+    W = jax.device_count()
+    mesh = Mesh(np.asarray(jax.devices()), ("sp",))
+    B, H, S, D = 1, 2, 16 * W, 16
+    ks = jax.random.split(jax.random.key(0), 3)  # same key every process
+    q, k, v = (jax.random.normal(kk, (B, H, S, D), jnp.float32)
+               for kk in ks)
+    out = ring_attention_sharded(q, k, v, mesh, "sp")
+
+    # the SHARED dense golden (conftest) — inputs replicated by seed, so
+    # every process computes the identical full-sequence reference
+    from conftest import dense_attention
+    golden = dense_attention(q, k, v, True)
+
+    for shard in out.addressable_shards:
+        idx = shard.index
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(shard.data)),
+            np.asarray(golden[idx]), atol=2e-5, rtol=2e-5)
+    multihost_utils.sync_global_devices("ring done")
+    print("MULTIHOST_OK ring", flush=True)
+""")
+
+
 def _free_port() -> int:
     s = socket.create_server(("127.0.0.1", 0))
     port = s.getsockname()[1]
@@ -72,8 +113,7 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_hierarchical_allreduce():
-    nprocs, local_devs = 2, 4
+def _run_children(child_src: str, nprocs: int = 2, local_devs: int = 4):
     port = _free_port()
     env = dict(os.environ)
     env["XLA_FLAGS"] = " ".join(
@@ -82,9 +122,11 @@ def test_two_process_hierarchical_allreduce():
         + [f"--xla_force_host_platform_device_count={local_devs}"])
     env.pop("JAX_PLATFORMS", None)  # the child pins cpu itself
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    tests_dir = os.path.join(repo, "tests")  # children import conftest
+    env["PYTHONPATH"] = (repo + os.pathsep + tests_dir + os.pathsep
+                         + env.get("PYTHONPATH", ""))
     procs = [subprocess.Popen(
-        [sys.executable, "-c", _CHILD, str(i), str(nprocs), str(port)],
+        [sys.executable, "-c", child_src, str(i), str(nprocs), str(port)],
         env=env, cwd=repo, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT) for i in range(nprocs)]
     outs = []
@@ -97,4 +139,13 @@ def test_two_process_hierarchical_allreduce():
             p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out[-2000:]}"
-        assert "MULTIHOST_OK" in out, f"process {i} missing marker:\n{out[-2000:]}"
+        assert "MULTIHOST_OK" in out, \
+            f"process {i} missing marker:\n{out[-2000:]}"
+
+
+def test_two_process_hierarchical_allreduce():
+    _run_children(_CHILD)
+
+
+def test_two_process_ring_attention():
+    _run_children(_CHILD_RING)
