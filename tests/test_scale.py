@@ -234,6 +234,29 @@ _TREE32 = textwrap.dedent("""
 
     out = np.asarray(tc.gather(x, root=root))
     np.testing.assert_array_equal(out[root], np.concatenate(ins))
+
+    # the DRIVER tier at the same rank count: 32 ACCL ranks rendezvousing
+    # over the 32-vdev mesh (allreduce + tree-routed rooted bcast)
+    from accl_tpu.device.tpu import tpu_world
+    from accl_tpu.testing import run_ranks
+    accls = tpu_world(32)
+    def ar(a):
+        src = a.buffer(data=np.full(8, 1.0 + a.rank, np.float32))
+        dst = a.buffer((8,), np.float32)
+        a.allreduce(src, dst, 8)
+        dst.sync_from_device()
+        return dst.data.copy()
+    expect = sum(1.0 + r for r in range(32))
+    assert all((o == expect).all()
+               for o in run_ranks(accls, ar, timeout=300.0))
+    def bc(a):
+        buf = (a.buffer(data=ins[root]) if a.rank == root
+               else a.buffer((n,), np.float32))
+        a.bcast(buf, n, root=root)
+        buf.sync_from_device()
+        return buf.data.copy()
+    for o in run_ranks(accls, bc, timeout=300.0):
+        np.testing.assert_array_equal(o, ins[root])
     print("TREE32_OK")
 """)
 
