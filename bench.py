@@ -112,7 +112,25 @@ def bench_allreduce(devices, nbytes=1 << 28):
 
 
 def main():
+    # A dead device tunnel makes the first jax.devices() hang forever; a
+    # watchdog turns that into a parseable error line (zero cost when the
+    # backend is healthy — no double init).
+    import threading
+
+    done = threading.Event()
+
+    def watchdog(timeout_s=240.0):
+        if not done.wait(timeout_s):
+            print(json.dumps({
+                "metric": "backend_unreachable", "value": 0,
+                "unit": "GB/s", "vs_baseline": 0,
+                "error": f"device backend init exceeded {timeout_s:.0f}s",
+            }), flush=True)
+            os._exit(1)
+
+    threading.Thread(target=watchdog, daemon=True).start()
     devices = jax.devices()
+    done.set()
     if len(devices) > 1:
         result = bench_allreduce(devices)
     else:
