@@ -10,6 +10,7 @@ the currency.
 from __future__ import annotations
 
 import abc
+import threading
 from typing import Sequence
 
 from ..buffer import ACCLBuffer
@@ -19,6 +20,40 @@ from ..communicator import Communicator
 
 class Device(abc.ABC):
     """One rank's execution backend."""
+
+    # -- shared inline fast-path gate (used by Emu/Sim backends) ----------
+    # A backend that can retire a synchronous call in the caller's thread
+    # guards the path with one counter: >0 means calls are queued or not
+    # yet past the point that fixes their submission order (each backend
+    # documents where it decrements). The gate is shared so the
+    # concurrency-sensitive pattern exists once.
+
+    def _inline_state(self):
+        mu = getattr(self, "_inline_mu", None)
+        if mu is None:
+            mu = self._inline_mu = threading.Lock()
+            self._inline_inflight = 0
+        return mu
+
+    def _inline_begin(self, waitfor: Sequence[CallHandle]) -> bool:
+        """True iff the device is idle and every dependency retired —
+        the caller may run inline and MUST call :meth:`_inflight_done`
+        when finished."""
+        if not all(dep.done() for dep in waitfor):
+            return False
+        with self._inline_state():
+            if self._inline_inflight != 0:
+                return False
+            self._inline_inflight += 1
+            return True
+
+    def _inflight_add(self):
+        with self._inline_state():
+            self._inline_inflight += 1
+
+    def _inflight_done(self):
+        with self._inline_state():
+            self._inline_inflight -= 1
 
     @abc.abstractmethod
     def register_buffer(self, buf: ACCLBuffer): ...
@@ -48,8 +83,10 @@ class Device(abc.ABC):
     def call_sync(self, desc: CallDescriptor,
                   waitfor: Sequence[CallHandle] = (),
                   timeout: float | None = None):
+        # inline retirement blocks inside call_async and would bypass a
+        # local timeout bound, so only hint inline when none is imposed
         return self.call_async(desc, waitfor,
-                               inline_ok=True).wait(timeout)
+                               inline_ok=timeout is None).wait(timeout)
 
     @abc.abstractmethod
     def configure_communicator(self, comm: Communicator): ...

@@ -64,10 +64,9 @@ class EmuDevice(Device):
         self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
         self.profiling = False  # armed by the start_profiling config call
         self._calls: queue.Queue = queue.Queue()
-        # inline fast path bookkeeping: count of calls queued or executing,
-        # and one lock serializing every execution (worker or inline)
-        self._mu = threading.Lock()
-        self._inflight = 0
+        # one lock serializes every execution (worker or inline); the
+        # inline gate itself lives on the Device base. The counter here
+        # covers a call until full RETIREMENT (decrement after _retire).
         self._exec_mu = threading.Lock()
         self._worker = threading.Thread(target=self._run, daemon=True,
                                         name=f"emu-rank{rank}")
@@ -162,22 +161,13 @@ class EmuDevice(Device):
         # small-message latency). Submission order is preserved: inline
         # runs only when nothing is queued or in flight, and any call
         # submitted meanwhile serializes behind _exec_mu.
-        if inline_ok and all(dep.done() for dep in waitfor):
-            with self._mu:
-                # _inflight counts queued + executing calls (incremented
-                # before every put), so 0 alone means fully idle
-                idle = self._inflight == 0
-                if idle:
-                    self._inflight += 1
-            if idle:
-                try:
-                    self._retire(desc, waitfor, handle)
-                finally:
-                    with self._mu:
-                        self._inflight -= 1
-                return handle
-        with self._mu:
-            self._inflight += 1
+        if inline_ok and self._inline_begin(waitfor):
+            try:
+                self._retire(desc, waitfor, handle)
+            finally:
+                self._inflight_done()
+            return handle
+        self._inflight_add()
         self._calls.put((desc, waitfor, handle))
         return handle
 
@@ -210,8 +200,7 @@ class EmuDevice(Device):
             try:
                 self._retire(desc, waitfor, handle)
             finally:
-                with self._mu:
-                    self._inflight -= 1
+                self._inflight_done()
 
     def _retire(self, desc: CallDescriptor, waitfor, handle: CallHandle):
         """Wait dependencies, execute, complete the handle — never raises

@@ -186,10 +186,24 @@ class SimDevice(Device):
     def call_async(self, desc: CallDescriptor,
                    waitfor: Sequence[CallHandle] = (), *,
                    inline_ok: bool = False) -> CallHandle:
-        # inline_ok unused: submission is a non-blocking RPC and completion
-        # polling already runs off-thread; the socket round trips dominate
         handle = CallHandle(context=desc.scenario.name)
-        self._dispatch_q.put((desc, tuple(waitfor), handle))
+        waitfor = tuple(waitfor)
+        # Inline fast path (shared gate on the Device base): a synchronous
+        # call with retired deps dispatches AND polls in the caller's
+        # thread when nothing is queued or in flight — saving the
+        # dispatch-thread and poll-thread handoffs. NOTE the counter here
+        # covers a call only through SUBMISSION (the daemon serializes
+        # execution FIFO; a queued call's completion poll may still be
+        # running when the counter hits 0) — submission order is what the
+        # gate must protect. The cmd socket has its own lock.
+        if inline_ok and self._inline_begin(waitfor):
+            try:
+                self._dispatch_one(desc, waitfor, handle, inline=True)
+            finally:
+                self._inflight_done()
+            return handle
+        self._inflight_add()
+        self._dispatch_q.put((desc, waitfor, handle))
         return handle
 
     def _dispatch_loop(self):
@@ -199,29 +213,40 @@ class SimDevice(Device):
                 return
             desc, waitfor, handle = item
             try:
-                # local dependency order: operand syncs must observe the
-                # dependencies' results (reference collectives sync operands
-                # right before starting the call, accl.py:952)
-                from ..constants import ACCLError
-                try:
-                    for dep in waitfor:
-                        dep.wait(self.timeout)
-                except ACCLError as exc:
-                    handle.complete(exc.error_word, exception=exc)
-                    continue
-                for addr in (desc.addr_0, desc.addr_1):
-                    if addr:
-                        b = self._resolve_buffer(addr)
-                        if b is not None:
-                            self.sync_to_device(b)
-                call_id = self._submit(desc)
-                handle.sim_call_id = call_id
+                self._dispatch_one(desc, waitfor, handle, inline=False)
+            finally:
+                self._inflight_done()
+
+    def _dispatch_one(self, desc: CallDescriptor, waitfor,
+                      handle: CallHandle, inline: bool):
+        """Dep wait + operand sync + submit + completion; never raises."""
+        try:
+            # local dependency order: operand syncs must observe the
+            # dependencies' results (reference collectives sync operands
+            # right before starting the call, accl.py:952)
+            from ..constants import ACCLError
+            try:
+                for dep in waitfor:
+                    dep.wait(self.timeout)
+            except ACCLError as exc:
+                handle.complete(exc.error_word, exception=exc)
+                return
+            for addr in (desc.addr_0, desc.addr_1):
+                if addr:
+                    b = self._resolve_buffer(addr)
+                    if b is not None:
+                        self.sync_to_device(b)
+            call_id = self._submit(desc)
+            handle.sim_call_id = call_id
+            if inline:  # the caller is about to block on the handle anyway
+                self._poll_completion(desc, call_id, handle)
+            else:
                 threading.Thread(target=self._poll_completion,
                                  args=(desc, call_id, handle),
                                  daemon=True).start()
-            except Exception as exc:  # noqa: BLE001
-                handle.complete(int(ErrorCode.CONNECTION_CLOSED),
-                                exception=exc)
+        except Exception as exc:  # noqa: BLE001
+            handle.complete(int(ErrorCode.CONNECTION_CLOSED),
+                            exception=exc)
 
     def _submit(self, desc: CallDescriptor) -> int:
         cfg = desc.arithcfg
