@@ -31,13 +31,11 @@ import contextlib
 import dataclasses
 import threading
 import time
-from typing import Callable, Iterable
 
 __all__ = [
     "CallRecord", "Profiler", "ProfilerSummary", "annotate", "trace_to",
     "measure_call_latency",
 ]
-
 
 @dataclasses.dataclass
 class CallRecord:
@@ -54,7 +52,6 @@ class CallRecord:
     @property
     def duration_us(self) -> float:
         return self.duration_s * 1e6
-
 
 @dataclasses.dataclass
 class ProfilerSummary:
@@ -77,13 +74,11 @@ class ProfilerSummary:
             return 0.0
         return self.total_bytes / (self.total_us * 1e-6) / 1e9
 
-
 def _percentile(sorted_vals: list[float], q: float) -> float:
     if not sorted_vals:
         return 0.0
     idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
     return sorted_vals[idx]
-
 
 class Profiler:
     """Thread-safe per-call timing recorder.
@@ -172,7 +167,6 @@ class Profiler:
                         f"{r.t_start:.9f},{r.duration_us:.3f},"
                         f"{r.error_word}\n")
 
-
 # -- JAX profiler bridges ---------------------------------------------------
 @contextlib.contextmanager
 def annotate(name: str):
@@ -185,7 +179,6 @@ def annotate(name: str):
     with ctx:
         yield
 
-
 @contextlib.contextmanager
 def trace_to(log_dir: str):
     """Capture an xplane trace of the enclosed region into ``log_dir``."""
@@ -196,7 +189,6 @@ def trace_to(log_dir: str):
         yield
     finally:
         jax.profiler.stop_trace()
-
 
 def measure_call_latency(accl, n: int = 100) -> dict[str, float]:
     """Round-trip latency of the full call path via ``nop``.

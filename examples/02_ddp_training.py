@@ -17,7 +17,6 @@ from accl_tpu.utils.platform import honor_platform_env
 honor_platform_env()  # the tunnel plugin overrides the plain env var
 
 import jax
-import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
