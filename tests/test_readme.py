@@ -1,0 +1,19 @@
+"""The README quick-start must actually run: extract its python block
+and execute it verbatim, so the first thing a new user tries can never
+silently rot."""
+
+import os
+import re
+
+
+def test_readme_quickstart_runs(capsys):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    readme = open(os.path.join(repo, "README.md")).read()
+    blocks = re.findall(r"```python\n(.*?)```", readme, re.DOTALL)
+    assert blocks, "README lost its quick-start python block"
+    ns: dict = {}
+    exec(compile(blocks[0], "README.md", "exec"), ns)  # noqa: S102
+    out = capsys.readouterr().out
+    assert "4." in out, f"quick-start output unexpected: {out!r}"
+    for a in ns.get("accls", []):
+        a.deinit()
