@@ -66,3 +66,39 @@ def test_sendrecv_pingpong_2rank():
     res = sweep_collective(mesh, "sendrecv", [4096], reps=2)
     assert res.rows[0]["world"] == 2
     assert res.rows[0]["seconds_per_op"] > 0
+
+
+def _check_rows(res, expect_collectives, tier_suffix="-chip"):
+    from benchmarks.sweep import CSV_FIELDS
+    assert res.rows, "sweep produced no rows"
+    for r in res.rows:
+        assert set(r) == set(CSV_FIELDS), r
+        assert r["seconds_per_op"] > 0
+        assert r["tier"].endswith(tier_suffix)
+    got = {r["collective"] for r in res.rows}
+    assert got >= expect_collectives, got
+
+
+def test_chip_combine_sweep_smoke():
+    from benchmarks.configs import chip_combine_sweep
+    res = chip_combine_sweep(sizes=[4096])
+    _check_rows(res, {"combine"})
+    assert {r["algorithm"] for r in res.rows} == {"pallas", "xla"}
+
+
+def test_chip_attention_sweep_smoke():
+    from benchmarks.configs import chip_attention_sweep
+    res = chip_attention_sweep(seqs=[64])
+    _check_rows(res, {"attention_causal_s64"})
+
+
+def test_chip_compression_sweep_smoke():
+    from benchmarks.configs import chip_compression_sweep
+    res = chip_compression_sweep(sizes=[16384])
+    _check_rows(res, {"clane_fp16", "clane_bf16", "clane_fp8"})
+
+
+def test_chip_llama_sweep_smoke():
+    from benchmarks.configs import chip_llama_sweep
+    res = chip_llama_sweep()
+    _check_rows(res, {"llama_train_step", "llama_decode"})
