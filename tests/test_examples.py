@@ -1,0 +1,31 @@
+"""The quick examples run as subprocesses in CI — the runnable docs
+cannot silently rot. The jax-mesh examples (02/05/06/07) are exercised
+by their own test counterparts (models/multihost/sequence-parallel/
+tpu-device suites) and skipped here for CI time."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+QUICK = [
+    ("01_pingpong.py", "us RTT"),
+    ("03_native_daemons.py", "done."),
+    ("04_streams_and_compression.py", "OK"),
+]
+
+
+@pytest.mark.parametrize("name,marker", QUICK,
+                         ids=[n for n, _ in QUICK])
+def test_example_runs(name, marker):
+    if name == "03_native_daemons.py" and not os.path.exists(
+            os.path.join(REPO, "native", "cclo_emud")):
+        pytest.skip("native daemon not built (make -C native)")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name)],
+        cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
+    assert marker in res.stdout, res.stdout[-1500:]
