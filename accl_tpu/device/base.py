@@ -28,11 +28,19 @@ class Device(abc.ABC):
     # documents where it decrements). The gate is shared so the
     # concurrency-sensitive pattern exists once.
 
+    # class-level guard: creation of the per-instance gate must itself be
+    # race-free (two first-callers racing the lazy init would each build a
+    # lock and lose an increment)
+    _inline_init_mu = threading.Lock()
+
     def _inline_state(self):
         mu = getattr(self, "_inline_mu", None)
         if mu is None:
-            mu = self._inline_mu = threading.Lock()
-            self._inline_inflight = 0
+            with Device._inline_init_mu:
+                mu = getattr(self, "_inline_mu", None)
+                if mu is None:
+                    self._inline_inflight = 0
+                    mu = self._inline_mu = threading.Lock()
         return mu
 
     def _inline_begin(self, waitfor: Sequence[CallHandle]) -> bool:

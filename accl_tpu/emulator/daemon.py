@@ -15,6 +15,7 @@ Ports: cmd = port_base + rank, eth = port_base + world + rank.
 from __future__ import annotations
 
 import argparse
+import logging
 import socket
 import struct
 import threading
@@ -31,14 +32,32 @@ from . import protocol as P
 from .executor import DeviceMemory, MoveExecutor, RxBufferPool
 from .fabric import Envelope
 
+log = logging.getLogger(__name__)
 
-def _sane_budget(b: float) -> float:
+
+def _sane_budget(b: float, *, configured: bool = False) -> float:
     """Wait budgets arrive on the wire as attacker-controlled doubles:
     NaN/Inf/negative must not reach the wait machinery, where they would
-    wedge the serving thread (mirrors the C++ daemon's sane_budget)."""
+    wedge the serving thread (mirrors the C++ daemon's sane_budget).
+    ``configured`` marks a deliberate client setting (MSG_SET_TIMEOUT /
+    CfgFunc.set_timeout): a finite value above the 1 h ceiling is then a
+    user mistake worth surfacing, so the clamp is logged instead of
+    silently shortening their waits."""
     if not (b >= 0.0):  # NaN and negatives
+        if configured:
+            # 0s means every wait times out immediately — the nastiest
+            # surprise of the three coercions, never pass it silently
+            log.warning(
+                "configured timeout %r is not a non-negative number; "
+                "coerced to 0s (immediate timeout)", b)
         return 0.0
-    return min(b, 3600.0)
+    if b > 3600.0:
+        if configured and b != float("inf"):
+            log.warning(
+                "configured timeout %.0fs exceeds the 3600s daemon "
+                "ceiling; clamped to 3600s", b)
+        return 3600.0
+    return b
 
 
 def _env_from_eth_frame(frame: bytes) -> tuple[Envelope, bytes]:
@@ -495,7 +514,7 @@ class RankDaemon:
             return 0
         if fn == CfgFunc.set_timeout:
             # same clamp as MSG_SET_TIMEOUT: feeds pool wait deadlines
-            self.timeout = _sane_budget(val / 1000.0)
+            self.timeout = _sane_budget(val / 1000.0, configured=True)
             self.executor.timeout = self.timeout
             return 0
         if fn == CfgFunc.set_max_segment_size:
@@ -593,8 +612,7 @@ class RankDaemon:
                 except Exception:  # noqa: BLE001 — truncated/garbage frame
                     # must get an error reply, not a dead connection; log
                     # so genuine handler bugs stay diagnosable
-                    import logging
-                    logging.getLogger(__name__).exception(
+                    log.exception(
                         "rank %d: request failed (kind=%s, %d bytes)",
                         self.rank, body[0] if body else None, len(body))
                     reply = P.status_reply(int(ErrorCode.INVALID_CALL))
@@ -649,7 +667,8 @@ class RankDaemon:
             self.eth.learn_peers(ranks, self.world)
             return P.status_reply(0)
         if kind == P.MSG_SET_TIMEOUT:
-            t = _sane_budget(struct.unpack("<d", body[1:9])[0])
+            t = _sane_budget(struct.unpack("<d", body[1:9])[0],
+                             configured=True)
             self.timeout = t
             self.executor.timeout = t
             return P.status_reply(0)

@@ -51,8 +51,13 @@ MSG_STREAM_POP = 16   # f64 timeout-seconds + u64 count (0 = next entry
 MAX_CALL_BYTES = 1 << 40   # per-call payload ceiling (pre-expansion)
 # Per-region allocation ceiling. Must stay below MAX_FRAME_LEN: a buffer
 # round-trips one MSG_WRITE_MEM / MSG_READ_MEM frame, so an allocatable
-# region whose frame the cap rejects would be unusable.
-MAX_ALLOC_BYTES = 1 << 30
+# region whose frame the cap rejects would be unusable.  2 GiB is the
+# largest power of two whose frame (payload + 64-byte header slack) still
+# fits the u32 length word; the previous 1 GiB cap rejected 1-2 GiB
+# buffers the framing could actually carry.  Buffers larger than 2 GiB
+# stay rejected (the size checks are strict >): their frames would
+# overflow the u32 length word.
+MAX_ALLOC_BYTES = 1 << 31
 
 MSG_STATUS = 100      # u32 error word
 MSG_CALL_ID = 101     # u32 call id

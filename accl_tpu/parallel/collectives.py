@@ -48,6 +48,22 @@ _PSUM_LIKE = {
 }
 
 
+def mark_varying(x: jnp.ndarray, axis_names) -> jnp.ndarray:
+    """Mark ``x`` as varying over mesh ``axis_names`` for check_vma.
+
+    Fresh constants (and psum-like outputs) are axis-invariant inside
+    shard_map; feeding one as a loop carry whose body output varies makes
+    the scan carry types mismatch.  One shim for the JAX API drift:
+    pcast (current) -> pvary (older) -> no-op (oldest, no vma tracking)."""
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, tuple(axis_names), to="varying")
+    if hasattr(lax, "pvary"):  # older jax
+        return lax.pvary(x, tuple(axis_names))
+    return x  # oldest jax: no varying-axes tracking, nothing to align
+
+
 def axis_reduce(x: jnp.ndarray, axis_name: str,
                 func: ReduceFunc) -> jnp.ndarray:
     """Reduce ``x`` elementwise across ``axis_name`` for any ReduceFunc.

@@ -52,11 +52,8 @@ def pipeline_apply(stage_fn, stage_params, microbatches: jnp.ndarray,
     out0 = jnp.zeros_like(microbatches)
     # fresh constants are unvarying over the mesh axis; the loop outputs
     # vary — align the carry types up front (same as ring_attention)
-    if hasattr(lax, "pcast"):
-        state0, out0 = (lax.pcast(x, (axis_name,), to="varying")
-                        for x in (state0, out0))
-    elif hasattr(lax, "pvary"):  # older jax
-        state0, out0 = (lax.pvary(x, (axis_name,)) for x in (state0, out0))
+    from .collectives import mark_varying
+    state0, out0 = (mark_varying(x, axis_name) for x in (state0, out0))
 
     def step(t, carry):
         state, outputs = carry

@@ -97,11 +97,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     acc0 = jnp.zeros((B, H, S, D), jnp.float32)
     # fresh constants are unvarying over the mesh axis; the loop outputs
     # vary (they depend on axis_index) — align the carry types up front
-    if hasattr(lax, "pcast"):
-        m0, l0, acc0 = (lax.pcast(x, (axis_name,), to="varying")
-                        for x in (m0, l0, acc0))
-    else:  # older jax
-        m0, l0, acc0 = (lax.pvary(x, (axis_name,)) for x in (m0, l0, acc0))
+    from .collectives import mark_varying
+    m0, l0, acc0 = (mark_varying(x, axis_name) for x in (m0, l0, acc0))
     _, m, l, acc = lax.fori_loop(0, W, body, ((k, v), m0, l0, acc0),
                                  unroll=True)
     out = acc / jnp.maximum(l, 1e-30)

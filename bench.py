@@ -88,12 +88,16 @@ def bench_allreduce(devices, nbytes=1 << 28):
         NamedSharding(mesh, P("rank", None)))
 
     def make_chain(K):
-        from accl_tpu.parallel.collectives import axis_reduce
+        from accl_tpu.parallel.collectives import axis_reduce, mark_varying
 
         def shard_fn(s):
             def body(i, acc):
-                return axis_reduce(acc, "rank", ReduceFunc.SUM) * (1.0 / W)
-            return jax.lax.fori_loop(0, K, body, s[0])[0][None]
+                red = axis_reduce(acc, "rank", ReduceFunc.SUM) * (1.0 / W)
+                # psum output is axis-invariant; the loop carry began
+                # varying over "rank", so mark it varying again or the
+                # scan carry types mismatch under check_vma
+                return mark_varying(red, "rank")
+            return jax.lax.fori_loop(0, K, body, s[0])[0][None, None]
 
         f = jax.shard_map(shard_fn, mesh=mesh, in_specs=P("rank", None),
                           out_specs=P("rank", None))
@@ -104,7 +108,7 @@ def bench_allreduce(devices, nbytes=1 << 28):
     bus_bytes = 2 * (W - 1) / W * nbytes
     gbs = bus_bytes / t_iter / 1e9
     return {
-        "metric": f"allreduce_bus_bw_fp32_256MiB_{W}chip",
+        "metric": f"allreduce_bus_bw_fp32_{nbytes >> 20}MiB_{W}chip",
         "value": round(gbs, 2),
         "unit": "GB/s/chip",
         "vs_baseline": round(gbs / ACCL_WIRE_BOUND_GBS, 2),

@@ -500,8 +500,9 @@ def chip_compression_sweep(sizes=None) -> SweepResult:
 
 def chip_llama_sweep() -> SweepResult:
     """Model-family throughput on one chip: Llama train step (fwd + bwd +
-    adamw) and KV-cache decode. The rows put tokens/s in the bus_gbps
-    column — the familiar model metric, not a bandwidth.
+    adamw) and KV-cache decode. The rows carry tokens/s in the bus_gbps
+    column, marked ``units=tokens/s`` so aggregators keep them apart
+    from bandwidth rows.
 
     CPU tier runs the tiny geometry as a functional smoke."""
     import optax
@@ -550,7 +551,8 @@ def chip_llama_sweep() -> SweepResult:
     rows.append({
         "collective": "llama_train_step", "algorithm": "chip", "world": 1,
         "dtype": model_dtype, "wire_dtype": "", "nbytes": B * S,
-        "seconds_per_op": t, "bus_gbps": round(B * S / t, 1), "tier": tier,
+        "seconds_per_op": t, "bus_gbps": round(B * S / t, 1),
+        "units": "tokens/s", "tier": tier,
     })
     log_tr = (f"train: {B * S / t:.0f} tokens/s "
               f"({6 * n_params * B * S / t / 1e12:.1f} TFLOP/s, "
@@ -578,7 +580,8 @@ def chip_llama_sweep() -> SweepResult:
     rows.append({
         "collective": "llama_decode", "algorithm": "chip", "world": 1,
         "dtype": model_dtype, "wire_dtype": "", "nbytes": B,
-        "seconds_per_op": t, "bus_gbps": round(B / t, 1), "tier": tier,
+        "seconds_per_op": t, "bus_gbps": round(B / t, 1),
+        "units": "tokens/s", "tier": tier,
     })
     print(log_tr)
     print(f"decode: {B / t:.0f} tokens/s at batch {B}")

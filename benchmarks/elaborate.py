@@ -14,12 +14,16 @@ import numpy as np
 
 RESULT_FIELDS = ["collective", "algorithm", "world", "dtype", "wire_dtype",
                  "nbytes", "tier", "runs",
-                 "avg_bus_gbps", "std_bus_gbps",
+                 "avg_bus_gbps", "std_bus_gbps", "units",
                  "avg_us_per_op", "std_us_per_op"]
 
 
 def elaborate(in_dir: str, out_csv: str | None = None) -> list[dict]:
-    """Aggregate every sweep CSV under ``in_dir``; write ``res.csv``."""
+    """Aggregate every sweep CSV under ``in_dir``; write ``res.csv``.
+
+    Rows are keyed on their ``units`` column too (older CSVs without one
+    default to GB/s), so model-throughput rows (tokens/s, the llama
+    sweeps) never average into bandwidth cells."""
     cells = defaultdict(lambda: {"bus": [], "us": []})
     for name in sorted(os.listdir(in_dir)):
         if not name.endswith(".csv") or name == "res.csv":
@@ -28,19 +32,19 @@ def elaborate(in_dir: str, out_csv: str | None = None) -> list[dict]:
             for row in csv.DictReader(f):
                 key = (row["collective"], row["algorithm"], row["world"],
                        row["dtype"], row["wire_dtype"], int(row["nbytes"]),
-                       row["tier"])
+                       row["tier"], row.get("units") or "GB/s")
                 cells[key]["bus"].append(float(row["bus_gbps"]))
                 cells[key]["us"].append(
                     float(row["seconds_per_op"]) * 1e6)
 
     results = []
     for key in sorted(cells, key=lambda k: (k[0], k[1], k[5])):
-        coll, algo, world, dtype, wire, nbytes, tier = key
+        coll, algo, world, dtype, wire, nbytes, tier, units = key
         bus, us = cells[key]["bus"], cells[key]["us"]
         results.append({
             "collective": coll, "algorithm": algo, "world": world,
             "dtype": dtype, "wire_dtype": wire, "nbytes": nbytes,
-            "tier": tier, "runs": len(bus),
+            "tier": tier, "runs": len(bus), "units": units,
             "avg_bus_gbps": round(float(np.mean(bus)), 4),
             "std_bus_gbps": round(float(np.std(bus)), 4),
             "avg_us_per_op": round(float(np.mean(us)), 2),
@@ -57,10 +61,13 @@ def elaborate(in_dir: str, out_csv: str | None = None) -> list[dict]:
 
 
 def format_table(results: list[dict]) -> str:
-    lines = ["{:<16} {:>6} {:>4} {:>12} {:>12} {:>12}".format(
-        "collective", "algo", "W", "nbytes", "bus GB/s", "us/op")]
+    lines = ["{:<16} {:>6} {:>4} {:>12} {:>12} {:>9} {:>12}".format(
+        "collective", "algo", "W", "nbytes", "throughput", "units",
+        "us/op")]
     for r in results:
-        lines.append("{:<16} {:>6} {:>4} {:>12} {:>12.3f} {:>12.1f}".format(
-            r["collective"], r["algorithm"], r["world"], r["nbytes"],
-            r["avg_bus_gbps"], r["avg_us_per_op"]))
+        lines.append(
+            "{:<16} {:>6} {:>4} {:>12} {:>12.3f} {:>9} {:>12.1f}".format(
+                r["collective"], r["algorithm"], r["world"], r["nbytes"],
+                r["avg_bus_gbps"], r.get("units", "GB/s"),
+                r["avg_us_per_op"]))
     return "\n".join(lines)

@@ -37,7 +37,10 @@ from accl_tpu.parallel.tree import (tree_bcast_shard, tree_gather_shard,
 from .timing import slope_time
 
 CSV_FIELDS = ["collective", "algorithm", "world", "dtype", "wire_dtype",
-              "nbytes", "seconds_per_op", "bus_gbps", "tier"]
+              "nbytes", "seconds_per_op", "bus_gbps", "units", "tier"]
+# "units" qualifies the bus_gbps column: "GB/s" (the default) for
+# bandwidth rows, "tokens/s" for model-throughput rows (llama sweeps) —
+# aggregators must not average across different units
 
 
 def bus_factor(op: str, W: int) -> float:
@@ -57,15 +60,18 @@ class SweepResult:
         with open(path, "w", newline="") as f:
             w = csv.DictWriter(f, fieldnames=CSV_FIELDS)
             w.writeheader()
-            w.writerows(self.rows)
+            w.writerows([{"units": "GB/s", **r} for r in self.rows])
 
     def table(self) -> str:
-        lines = ["{:<16} {:>6} {:>12} {:>14} {:>12}".format(
-            "collective", "algo", "nbytes", "us/op", "bus GB/s")]
+        lines = ["{:<16} {:>6} {:>12} {:>14} {:>12} {:>9}".format(
+            "collective", "algo", "nbytes", "us/op", "throughput",
+            "units")]
         for r in self.rows:
-            lines.append("{:<16} {:>6} {:>12} {:>14.1f} {:>12.3f}".format(
-                r["collective"], r["algorithm"], r["nbytes"],
-                r["seconds_per_op"] * 1e6, r["bus_gbps"]))
+            lines.append(
+                "{:<16} {:>6} {:>12} {:>14.1f} {:>12.3f} {:>9}".format(
+                    r["collective"], r["algorithm"], r["nbytes"],
+                    r["seconds_per_op"] * 1e6, r["bus_gbps"],
+                    r.get("units", "GB/s")))
         return "\n".join(lines)
 
 

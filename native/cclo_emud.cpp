@@ -902,9 +902,26 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c_in, uint8_t op,
 // values: NaN, Inf, negative, or absurdly large values must never reach
 // wait_until's time_point conversion (UB for non-finite, a wedged
 // serving thread for huge finite ones).
-static double sane_budget(double b) {
-  if (!(b >= 0.0)) return 0.0;  // NaN and negatives
-  return std::min(b, 3600.0);
+static double sane_budget(double b, bool configured = false) {
+  if (!(b >= 0.0)) {  // NaN and negatives
+    // 0s means every wait times out immediately — never coerce a
+    // deliberate setting there silently
+    if (configured)
+      std::fprintf(stderr,
+                   "[cclo_emud] configured timeout %f is not a "
+                   "non-negative number; coerced to 0s\n", b);
+    return 0.0;
+  }
+  if (b > 3600.0) {
+    // a deliberate client setting above the 1 h ceiling is a user
+    // mistake worth surfacing, not a silent truncation
+    if (configured && std::isfinite(b))
+      std::fprintf(stderr,
+                   "[cclo_emud] configured timeout %.0fs exceeds the "
+                   "3600s ceiling; clamped\n", b);
+    return 3600.0;
+  }
+  return b;
 }
 
 // ---------------------------------------------------------------------------
@@ -1184,7 +1201,7 @@ class RankDaemon {
         return E_OK;
       case CFG_SET_TIMEOUT:
         // same clamp as MSG_SET_TIMEOUT: this field feeds wait deadlines
-        timeout_ = sane_budget(static_cast<double>(val) / 1000.0);
+        timeout_ = sane_budget(static_cast<double>(val) / 1000.0, true);
         return E_OK;
       case CFG_SET_SEG:
         if (val > bufsize_) return E_DMA_SIZE;
@@ -1803,7 +1820,8 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
     case MSG_SET_TIMEOUT: {
       double t;
       std::memcpy(&t, p, 8);
-      timeout_ = sane_budget(t);  // feeds wait_until deadlines later
+      // feeds wait_until deadlines later
+      timeout_ = sane_budget(t, /*configured=*/true);
       return status_reply(E_OK);
     }
     case MSG_SET_SEG: {

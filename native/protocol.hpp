@@ -31,9 +31,12 @@ static const uint32_t STATUS_PENDING = 0xFFFFFFFFu;
 
 // shared daemon resource bounds (keep in sync with protocol.py); the
 // allocation ceiling stays below the frame cap so every allocatable
-// buffer round-trips one MSG_WRITE_MEM / MSG_READ_MEM frame
+// buffer round-trips one MSG_WRITE_MEM / MSG_READ_MEM frame.  2 GiB is
+// the largest power of two whose frame (payload + 64-byte header slack)
+// still fits the u32 length word; larger than 2 GiB stays
+// rejected (the size checks are strict >)
 static const uint64_t MAX_CALL_BYTES = 1ull << 40;
-static const uint64_t MAX_ALLOC_BYTES = 1ull << 30;
+static const uint64_t MAX_ALLOC_BYTES = 1ull << 31;
 
 enum Op : uint8_t {
   OP_CONFIG = 0, OP_COPY = 1, OP_COMBINE = 2, OP_SEND = 3, OP_RECV = 4,
