@@ -49,6 +49,7 @@ class ACCL:
         self.device = device
         self.arith_registry = (arith_registry if arith_registry is not None
                                else dict(DEFAULT_ARITH_CONFIGS))
+        self._arith_memo: dict[frozenset, object] = {}
         self.communicators: list[Communicator] = []
         self._barrier_buf: ACCLBuffer | None = None
         self._scratch_bufs: dict[tuple[int, str], ACCLBuffer] = {}
@@ -163,11 +164,24 @@ class ACCL:
         self.device.deinit()
 
     # -- buffers -----------------------------------------------------------
-    def buffer(self, shape=None, dtype=np.float32,
-               data: np.ndarray | None = None) -> ACCLBuffer:
+    def buffer(self, shape=None, dtype=np.float32, data=None,
+               device_resident: bool = False) -> ACCLBuffer:
         """Allocate a device-registered buffer (reference: accl.buffer /
-        pynq allocate)."""
-        if data is not None:
+        pynq allocate).
+
+        Pass a live ``jax.Array`` as ``data`` (or ``device_resident=True``
+        with shape/dtype) for a device-resident buffer: TPU-backend calls
+        then skip host staging entirely — the reference's
+        ``to_from_fpga=False`` fast path. Backends without device arrays
+        reject the request."""
+        from .buffer import _is_jax_array
+        if data is not None and _is_jax_array(data):
+            data = self.device.adopt_device_array(data)
+        elif device_resident:
+            if data is not None:
+                shape, dtype = np.shape(data), np.asarray(data).dtype
+            data = self.device.make_device_array(shape, dtype, data)
+        elif data is not None:
             data = np.ascontiguousarray(data)
             shape = data.shape
             dtype = data.dtype
@@ -203,7 +217,15 @@ class ACCL:
             compression |= Compression.ETH_COMPRESSED
         if not dtypes:
             dtypes = {np.dtype(np.float32)}
-        cfg = resolve_arith_config(dtypes, self.arith_registry)
+        # memoized: resolution walks name-sorted registry keys (~15us),
+        # pure in its inputs, and on the per-call hot path. Mutating
+        # arith_registry after construction requires clearing _arith_memo.
+        # np.dtype hashes/compares in C — the dtype set is its own key.
+        mk = frozenset(dtypes)
+        cfg = self._arith_memo.get(mk)
+        if cfg is None:
+            cfg = resolve_arith_config(dtypes, self.arith_registry)
+            self._arith_memo[mk] = cfg
         if cfg.is_compressing:
             if op0 is not None and op0.dtype == cfg.compressed_dtype:
                 compression |= Compression.OP0_COMPRESSED

@@ -12,10 +12,20 @@ TPU-native design: a buffer is either
   * a TPU buffer — a ``jax.Array`` (possibly sharded over the communicator's
     mesh axis); sync_* are device_put/device_get and the "address" is a
     handle the in-process backend resolves back to the array.
+
+Device-resident mode (the reference's ``to_from_fpga=False`` fast path,
+test/host/test_tcp_cmac_seq_mpi.py:29-443): pass a live ``jax.Array`` as
+``data`` and the buffer keeps it on device — no host mirror is allocated,
+and TPU-backend calls operate on the array directly instead of staging
+through host numpy. ``.data`` then returns a fresh host *snapshot* (reads
+pay one D2H transfer; in-place writes to the snapshot do NOT reach the
+device — use ``.jax`` / a new call instead). jax.Arrays are immutable, so
+the backend "writes" a result by rebinding ``.jax`` to a new array.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 from typing import Any
 
@@ -37,6 +47,11 @@ def _alloc_addr(nbytes: int) -> int:
     return page * _ALIGNMENT
 
 
+def _is_jax_array(x) -> bool:
+    """Duck-typed jax.Array check that keeps jax an optional import here."""
+    return hasattr(x, "sharding") and hasattr(x, "devices")
+
+
 class ACCLBuffer:
     """A host array registered with a device backend.
 
@@ -44,51 +59,100 @@ class ACCLBuffer:
     mean. Supports slicing into sub-buffers sharing storage — the reference
     relies on address arithmetic for strided collective operands; we expose
     the same capability safely via numpy views.
+
+    When constructed from a ``jax.Array`` the buffer is *device-resident*
+    (module docstring): no host mirror, ``.jax`` is the live array.
     """
 
     def __init__(self, shape, dtype=np.float32, device: Any = None,
-                 data: np.ndarray | None = None, address: int | None = None,
+                 data=None, address: int | None = None,
                  parent: "ACCLBuffer | None" = None):
-        if data is None:
-            data = np.zeros(shape, dtype=dtype)
-        self.data = data
+        self._jax = None
+        if data is not None and _is_jax_array(data):
+            self._jax = data
+            self._np = None
+        else:
+            if data is None:
+                data = np.zeros(shape, dtype=dtype)
+            self._np = data
+        # geometry is cached: an array's shape/dtype never change, and
+        # the properties sit on the per-call hot path (a rebind refreshes
+        # the cache)
+        src = self._jax if self._jax is not None else self._np
+        self._shape = tuple(src.shape)
+        self._dtype = np.dtype(src.dtype)
+        self._size = math.prod(self._shape)
+        nbytes = self._dtype.itemsize * self._size
         self.device = device
         self.parent = parent
-        self.address = address if address is not None else _alloc_addr(data.nbytes)
+        self.address = address if address is not None else _alloc_addr(nbytes)
         if device is not None and parent is None:
             device.register_buffer(self)
 
+    # -- device-resident surface -------------------------------------------
+    @property
+    def is_device_resident(self) -> bool:
+        return self._jax is not None
+
+    @property
+    def jax(self):
+        """The live device array (device-resident buffers only)."""
+        if self._jax is None:
+            raise ValueError("not a device-resident buffer; use .data")
+        return self._jax
+
+    def _rebind(self, arr):
+        """Backend-side result write: point the buffer at a new array
+        (jax.Arrays are immutable — there is no in-place device write)."""
+        self._jax = arr
+        self._shape = tuple(arr.shape)
+        self._dtype = np.dtype(arr.dtype)
+        self._size = math.prod(self._shape)
+
     # -- numpy-ish surface -------------------------------------------------
     @property
+    def data(self) -> np.ndarray:
+        """The host array (mirror mode) or a fresh host snapshot of the
+        device array (device-resident mode — writes to it are lost)."""
+        if self._jax is not None:
+            return np.asarray(self._jax)
+        return self._np
+
+    @property
     def shape(self):
-        return self.data.shape
+        return self._shape
 
     @property
     def dtype(self) -> np.dtype:
-        return self.data.dtype
+        return self._dtype
 
     @property
     def size(self) -> int:
-        return self.data.size
+        return self._size
 
     @property
     def nbytes(self) -> int:
-        return self.data.nbytes
+        return self._dtype.itemsize * self._size
 
     def __len__(self) -> int:
-        return len(self.data)
+        return self.shape[0]
 
     def __getitem__(self, key) -> "ACCLBuffer":
         """A view sub-buffer; address tracks the byte offset into the parent."""
-        view = self.data[key]
-        if view.base is None and view is not self.data:
+        if self._jax is not None:
+            raise ValueError(
+                "device-resident buffers do not support sub-buffer views "
+                "(jax.Arrays have no host address arithmetic); slice the "
+                "array before wrapping, or use a host-mirror buffer")
+        view = self._np[key]
+        if view.base is None and view is not self._np:
             raise ValueError("buffer slices must be views (no fancy indexing)")
         if not view.flags["C_CONTIGUOUS"]:
             raise ValueError(
                 "buffer slices must be contiguous (the device address model "
                 "transfers flat byte ranges); use a copy for strided access")
         offset = view.__array_interface__["data"][0] - \
-            self.data.__array_interface__["data"][0]
+            self._np.__array_interface__["data"][0]
         return ACCLBuffer(view.shape, view.dtype, device=self.device,
                           data=view, address=self.address + offset, parent=self)
 
@@ -113,5 +177,6 @@ class ACCLBuffer:
             self.device.deregister_buffer(self)
 
     def __repr__(self):
+        kind = "dev" if self._jax is not None else "host"
         return (f"ACCLBuffer(shape={self.shape}, dtype={self.dtype.name}, "
-                f"addr=0x{self.address:x})")
+                f"addr=0x{self.address:x}, {kind})")

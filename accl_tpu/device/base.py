@@ -130,6 +130,22 @@ class Device(abc.ABC):
         raise ACCLError(int(ErrorCode.STREAM_NOT_SUPPORTED),
                         f"{type(self).__name__} has no stream port")
 
+    # -- device-resident buffers (to_from_fpga=False fast path) ------------
+    def adopt_device_array(self, arr):
+        """Accept a live device array for a device-resident buffer.
+        Backends without device arrays reject — never silently fall back
+        to a host mirror the caller believes is zero-copy."""
+        raise ValueError(
+            f"{type(self).__name__} has no device-array storage; use a "
+            "host buffer (device-resident mode is a TPU-backend feature)")
+
+    def make_device_array(self, shape, dtype, init=None):
+        """Allocate a fresh device array on this rank's device (zeros, or
+        ``init`` contents) for a device-resident buffer."""
+        raise ValueError(
+            f"{type(self).__name__} has no device-array storage; use a "
+            "host buffer (device-resident mode is a TPU-backend feature)")
+
     def soft_reset(self):
         """Parity: HOUSEKEEP_SWRST (ccl_offload_control.c:1244-1247)."""
 

@@ -10,32 +10,43 @@ coordination happens in a host-side rendezvous:
   program order, MPI semantics); the last arriving rank executes ONE
   shard_map program over the mesh (MeshCollectives) and scatters results
   into every rank's buffer.
-* **send** is eager: the payload is snapshotted and the call completes
-  (reference parity: eager ingress lets send finish before recv posts).
-  **recv** matches pending sends by ``(comm, src, dst, tag)`` + sequence
-  order; the host rendezvous IS the transfer on this tier (tagged
-  transfers that must ride ICI belong inside a jitted program via
-  ``MeshCollectives.exchange`` / ``send_recv``).
+* **send** is eager: the payload is snapshotted onto the sender's device
+  and the call completes (reference parity: eager ingress lets send
+  finish before recv posts). **recv** matches pending sends by
+  ``(comm, src, dst, tag)`` + sequence order — that host rendezvous is
+  control plane only; the DATA then crosses the device fabric via one
+  ppermute program (``TpuContext.exchange_transfer``), riding ICI on a
+  real mesh exactly like the reference's send/recv ride its transport
+  (ccl_offload_control.c:339-380).
 
-This driver-compat layer stages through host numpy mirrors, which costs
-host<->device copies per call — it exists for API parity and the test
-corpus. The *performance* path is using :class:`MeshCollectives` (or
-`accl_tpu.parallel` inside your own pjit/shard_map programs) directly on
-jax.Arrays; bench.py measures that path, and
-``benchmarks/driver_overhead.py`` quantifies the tier gap (measured on
-the 8-vdev CPU mesh: ~5x per 64Ki-element allreduce call, ~2 ms of host
-staging vs the direct cached program).
+Buffer staging has two modes:
+
+* **Host-mirror buffers** (the default) stage through host numpy per
+  call — API parity with the emulator corpus, ~5x per-call overhead
+  (``benchmarks/driver_overhead.py``).
+* **Device-resident buffers** (``ACCL.buffer(data=<jax.Array>)`` or
+  ``device_resident=True`` — the reference's ``to_from_fpga=False``)
+  skip host staging entirely: dense collectives assemble the per-rank
+  arrays into the flat global with
+  ``jax.make_array_from_single_device_arrays``, run one cached program,
+  and rebind each rank's dst to its result shard; send snapshots are
+  zero-copy (jax.Arrays are immutable). This closes most of the tier
+  gap; ``MeshCollectives`` inside your own pjit/shard_map program
+  remains the absolute-peak path bench.py measures.
 """
 
 from __future__ import annotations
 
 import collections
+import math
 import queue
 import threading
+import time
 from typing import Sequence
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..buffer import ACCLBuffer
@@ -43,7 +54,7 @@ from ..call import CallDescriptor, CallHandle
 from ..communicator import Communicator
 from ..constants import (CCLOp, CollectiveAlgorithm, Compression,
                          DEFAULT_MAX_SEGMENT_SIZE, DEFAULT_TIMEOUT_S,
-                         ErrorCode, check_algorithm)
+                         ErrorCode, ReduceFunc, check_algorithm)
 from ..emulator.executor import DeviceMemory
 from ..parallel.collectives import MeshCollectives
 from ..parallel.mesh import make_mesh
@@ -85,20 +96,146 @@ class TpuContext:
         self.devices: list[TpuDevice | None] = [None] * self.world_size
         # rendezvous state
         self._lock = threading.Condition()
-        # (comm_id, op_index) -> {comm-local rank: desc}
-        self._pending: dict[tuple, dict[int, CallDescriptor]] = {}
-        # keys claimed by a launcher, execution in flight (result coming)
-        self._claimed: set[tuple] = set()
-        # (comm_id, op_index) -> [error_word, readers_remaining]
-        self._results: dict[tuple, list[int]] = {}
-        # (comm_id, src_g, dst_g) -> deque of (tag, payload ndarray)
+        # (comm_id, op_index) -> {comm-local rank: (desc, handle, deadline)}
+        self._pending: dict[tuple, dict] = {}
+        self._sweeper: threading.Thread | None = None
+        # (comm_id, src_g, dst_g) -> deque of (tag, payload jax.Array).
+        # Payloads now live in device memory (eager-send snapshots), so
+        # unmatched sends pin scarce HBM: like the emulator's finite
+        # spare-buffer pool, the parked-send count is bounded and an
+        # overflowing send fails with the pool-overflow error instead of
+        # leaking (emulator/executor.py RxBufferPool parity).
         self._sends: dict[tuple, collections.deque] = \
             collections.defaultdict(collections.deque)
+        self.max_parked_sends = 1024  # across the context, like nbufs
+        self._parked_sends = 0        # running count (guarded by _lock)
+        # filler shards for the exchange program: ranks that are neither
+        # src nor dst of a transfer still contribute an operand shard.
+        # Cached per (device, size, dtype) — they're constant zeros.
+        self._zeros: dict[tuple, jax.Array] = {}
+        self._zeros_mu = threading.Lock()
+
+    # cap on cached filler shards: a size sweep would otherwise pin one
+    # device array per distinct (device, size, dtype) forever
+    _MAX_ZERO_CACHE = 64
+
+    def zero_shard(self, dev, n: int, dtype) -> jax.Array:
+        key = (dev, n, np.dtype(dtype).name)
+        # fast path without the lock: dict reads are atomic, and a stale
+        # miss only costs a redundant zeros build below
+        arr = self._zeros.get(key)
+        if arr is None:
+            arr = jax.device_put(np.zeros(n, dtype), dev)
+            with self._zeros_mu:  # eviction+insert race-free (concurrent
+                if len(self._zeros) >= self._MAX_ZERO_CACHE:  # recv threads)
+                    # FIFO eviction (dict preserves insertion order): drop
+                    # the oldest size class rather than growing device
+                    # memory
+                    self._zeros.pop(next(iter(self._zeros)), None)
+                arr = self._zeros.setdefault(key, arr)
+        return arr
+
+    def assemble_flat(self, coll: MeshCollectives,
+                      shards: list) -> jax.Array:
+        """Build the flat global (W*n,) array from per-rank 1-D device
+        arrays without host staging: each shard must already live on (or
+        is moved to) its comm-local rank's device."""
+        devs = coll.device_list
+        n = shards[0].shape[0]
+        placed = []
+        for dev, arr in zip(devs, shards):
+            # arr.device is a cheap C property on single-device arrays;
+            # devices() builds a frozenset per call (~10us each)
+            if getattr(arr, "device", None) != dev:
+                arr = jax.device_put(arr, dev)
+            placed.append(arr)
+        return jax.make_array_from_single_device_arrays(
+            (len(devs) * n,), coll.flat_sharding, placed)
+
+    def exchange_transfer(self, comm: Communicator, payload: jax.Array,
+                          src_local: int, dst_local: int) -> jax.Array:
+        """Move one matched send/recv payload across the device fabric:
+        a ppermute program over the communicator's mesh (parity: the
+        reference's send/recv ride the real transport end-to-end,
+        ccl_offload_control.c:339-380). Returns the received shard (on
+        the destination rank's device)."""
+        coll = self.coll_for(comm)
+        n = payload.shape[0]
+        devs = coll.device_list
+        shards = [payload if r == src_local
+                  else self.zero_shard(d, n, payload.dtype)
+                  for r, d in enumerate(devs)]
+        x = self.assemble_flat(coll, shards)
+        out = coll.exchange_flat(x, ((src_local, dst_local),))
+        for s in out.addressable_shards:
+            if (s.index[0].start or 0) == dst_local * n:
+                return s.data
+        raise RuntimeError("destination shard missing from exchange output")
 
     def device(self, rank: int) -> "TpuDevice":
         if self.devices[rank] is None:
             self.devices[rank] = TpuDevice(self, rank)
         return self.devices[rank]
+
+    # -- deadline sweeper ---------------------------------------------------
+    def _ensure_sweeper(self):
+        """Start the (single, lazy) deadline sweeper. Caller holds _lock.
+
+        Members of an incomplete rendezvous group no longer park a thread
+        each, so their per-call timeout is enforced centrally: the sweeper
+        fails any deposit whose deadline passed with
+        RECEIVE_TIMEOUT_ERROR and removes its slot — a group missing a
+        member can then never complete, and its remaining deposits expire
+        on their own deadlines (the old per-waiter semantics)."""
+        if self._sweeper is None:
+            self._sweeper = threading.Thread(target=self._sweep_loop,
+                                             daemon=True,
+                                             name="tpu-coll-sweeper")
+            self._sweeper.start()
+
+    def _sweep_loop(self):
+        from ..constants import ACCLError
+        idle_scans = 0
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                expired = []
+                next_dl = None
+                for key, group in list(self._pending.items()):
+                    for r, (d, h, dl) in list(group.items()):
+                        if dl <= now:
+                            group.pop(r)
+                            expired.append(h)
+                        elif next_dl is None or dl < next_dl:
+                            next_dl = dl
+                    if not group:
+                        self._pending.pop(key, None)
+                if not self._pending and not expired:
+                    idle_scans += 1
+                    if idle_scans >= 10:
+                        # nothing pending for ~2s: retire rather than
+                        # polling forever (long-lived processes creating
+                        # many worlds would accumulate pollers); the next
+                        # incomplete deposit restarts it
+                        self._sweeper = None
+                        return
+                else:
+                    idle_scans = 0
+            for h in expired:
+                if h is not None:
+                    err = int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+                    h.complete(err, exception=ACCLError(
+                        err, "collective group incomplete at deadline"))
+            # Deposits never wake the sweeper (a wakeup per member per
+            # collective is pure GIL churn on the hot path, and waiting
+            # on ctx._lock would make every send's notify_all a spurious
+            # wake). It polls: 200 ms cadence when idle, the exact
+            # earliest deadline when groups are pending — a timeout may
+            # fire up to one poll late, which RECEIVE_TIMEOUT semantics
+            # tolerate.
+            now = time.monotonic()
+            time.sleep(0.2 if next_dl is None
+                       else min(max(next_dl - now, 0.001), 0.2))
 
     @staticmethod
     def _make_tree(devs) -> Tree2DCollectives | None:
@@ -159,6 +296,11 @@ class TpuDevice(Device):
         self.ctx = ctx
         self.rank = rank
         self.mem = DeviceMemory()          # host mirrors of device buffers
+        # device-resident buffers (no host mirror): address -> ACCLBuffer
+        # whose .jax is the live array on this rank's device
+        self.dev_bufs: dict[int, ACCLBuffer] = {}
+        self.my_device = list(
+            np.asarray(ctx.mesh.devices).reshape(-1))[rank]
         self.comms: dict[int, Communicator] = {}
         self.comm: Communicator | None = None
         self.timeout = DEFAULT_TIMEOUT_S
@@ -172,10 +314,35 @@ class TpuDevice(Device):
 
     # -- Device interface --------------------------------------------------
     def register_buffer(self, buf: ACCLBuffer):
-        self.mem.register(buf.address, buf.data)
+        if buf.is_device_resident:
+            self.dev_bufs[buf.address] = buf
+        else:
+            self.mem.register(buf.address, buf.data)
 
     def deregister_buffer(self, buf: ACCLBuffer):
-        self.mem.deregister(buf.address)
+        if buf.is_device_resident:
+            self.dev_bufs.pop(buf.address, None)
+        else:
+            self.mem.deregister(buf.address)
+
+    # -- device-resident storage (the to_from_fpga=False fast path) --------
+    def adopt_device_array(self, arr):
+        """Home a live jax.Array on this rank's mesh device. Committed
+        single-device arrays already there are adopted zero-copy."""
+        devs = arr.devices()
+        if len(devs) != 1:
+            raise ValueError(
+                "device-resident ACCL buffers wrap single-device arrays "
+                "(one rank, one device); got a sharded array — pass it "
+                "to MeshCollectives / your shard_map program directly")
+        if list(devs)[0] != self.my_device:
+            arr = jax.device_put(arr, self.my_device)
+        return arr
+
+    def make_device_array(self, shape, dtype, init=None):
+        host = (np.zeros(shape, dtype) if init is None
+                else np.asarray(init, dtype).reshape(shape))
+        return jax.device_put(host, self.my_device)
 
     def configure_communicator(self, comm: Communicator):
         self.comms[comm.comm_id] = comm
@@ -188,18 +355,40 @@ class TpuDevice(Device):
     def set_max_segment_size(self, nbytes: int):
         self.max_segment_size = nbytes
 
+    # Ops safe to run in the submitting thread: everything that never
+    # blocks waiting on a peer. Collectives qualify because a deposit is
+    # non-blocking and only the group-completing arrival executes — which
+    # the caller of a synchronous driver call would block on anyway.
+    # recv blocks until a matching send exists, so it inlines only when
+    # the caller declared it will immediately wait (inline_ok).
+    _INLINE_OPS = _COLLECTIVES | {CCLOp.nop, CCLOp.config, CCLOp.copy,
+                                  CCLOp.combine, CCLOp.send}
+
     def call_async(self, desc: CallDescriptor,
                    waitfor: Sequence[CallHandle] = (), *,
                    inline_ok: bool = False) -> CallHandle:
-        # inline_ok unused: the rendezvous already runs the collective in
-        # whichever rank's thread completes the group (outside the lock)
         handle = CallHandle(context=desc.scenario.name)
+        op = desc.scenario
+        # Inline fast path: skip the worker-thread hop (queue + wakeup +
+        # GIL handoff per call — the dominant per-call cost of this tier)
+        # whenever per-rank FIFO order is provable: nothing queued or
+        # running on the worker (the shared inline gate) and every
+        # dependency already retired.
+        if (op in self._INLINE_OPS or (op == CCLOp.recv and inline_ok)) \
+                and self._inline_begin(waitfor):
+            try:
+                self._run_one(desc, waitfor, handle)
+            finally:
+                self._inflight_done()
+            return handle
+        self._inflight_add()
         self._calls.put((desc, tuple(waitfor), handle))
         return handle
 
     def soft_reset(self):
         with self.ctx._lock:
             self.ctx._sends.clear()
+            self.ctx._parked_sends = 0
         self._coll_index.clear()
 
     def deinit(self):
@@ -207,28 +396,52 @@ class TpuDevice(Device):
 
     # -- worker ------------------------------------------------------------
     def _run(self):
-        from ..constants import ACCLError
         while True:
             item = self._calls.get()
             if item is None:
                 return
             desc, waitfor, handle = item
             try:
-                for dep in waitfor:
-                    dep.wait(self.timeout)
-                handle.complete(self._execute(desc))
-            except ACCLError as exc:
-                handle.complete(exc.error_word, exception=exc)
-            except TimeoutError as exc:
-                handle.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR),
-                                exception=exc)
-            except Exception as exc:  # noqa: BLE001
-                handle.complete(int(ErrorCode.INVALID_CALL), exception=exc)
+                self._run_one(desc, waitfor, handle)
+            finally:
+                self._inflight_done()
+
+    def _run_one(self, desc: CallDescriptor, waitfor, handle: CallHandle):
+        """Retire one call in the current thread. Completes ``handle``
+        unless the call parked in a rendezvous group (collective deposit:
+        the group-completing rank — or the deadline sweeper — completes
+        it)."""
+        from ..constants import ACCLError
+        try:
+            for dep in waitfor:
+                dep.wait(self.timeout)
+            err = self._execute(desc, handle)
+            if err is not None:
+                handle.complete(err)
+        except ACCLError as exc:
+            handle.complete(exc.error_word, exception=exc)
+        except TimeoutError as exc:
+            handle.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR),
+                            exception=exc)
+        except Exception as exc:  # noqa: BLE001
+            handle.complete(int(ErrorCode.INVALID_CALL), exception=exc)
 
     # -- operand staging ---------------------------------------------------
     def _read_operand(self, addr: int, count: int, desc, which: Compression
                       ) -> np.ndarray:
         cfg = desc.arithcfg
+        buf = self.dev_bufs.get(addr)
+        if buf is not None:
+            # device-resident source on a host-staged path: one D2H read.
+            # The stored dtype IS the array's dtype (no separate
+            # compressed mirror exists for device buffers).
+            arr = np.asarray(buf.jax).reshape(-1)
+            if count > arr.size:
+                from ..constants import ACCLError
+                raise ACCLError(int(ErrorCode.DMA_SIZE_ERROR),
+                                f"read past device buffer end "
+                                f"({count} > {arr.size})")
+            return arr[:count].astype(cfg.uncompressed_dtype, copy=False)
         stored = (cfg.compressed_dtype if desc.compression & which
                   else cfg.uncompressed_dtype)
         return self.mem.read(addr, count, stored).astype(
@@ -239,10 +452,36 @@ class TpuDevice(Device):
         out = (cfg.compressed_dtype
                if desc.compression & Compression.RES_COMPRESSED
                else cfg.uncompressed_dtype)
+        buf = self.dev_bufs.get(addr)
+        if buf is not None:
+            self._rebind_dev(buf, np.asarray(data, dtype=out))
+            return
         self.mem.write(addr, np.asarray(data, dtype=out))
 
+    def _rebind_dev(self, buf: ACCLBuffer, data):
+        """Land a result in a device-resident buffer. jax.Arrays are
+        immutable, so a full-size result replaces the array; a partial
+        result (segmented host paths) does read-modify-write."""
+        n = math.prod(np.shape(data))
+        if n == buf.size:
+            arr = data if isinstance(data, jax.Array) else \
+                jax.device_put(np.asarray(data), self.my_device)
+            if arr.dtype != buf.dtype:
+                arr = arr.astype(buf.dtype)
+            if arr.shape != buf.shape:
+                arr = arr.reshape(buf.shape)
+            buf._rebind(arr)
+            return
+        host = np.asarray(buf.jax).reshape(-1).copy()
+        host[:n] = np.asarray(data, dtype=buf.dtype).reshape(-1)
+        buf._rebind(jax.device_put(host.reshape(buf.shape),
+                                   self.my_device))
+
     # -- execution ---------------------------------------------------------
-    def _execute(self, desc: CallDescriptor) -> int:
+    def _execute(self, desc: CallDescriptor,
+                 handle: CallHandle) -> int | None:
+        """Returns the call's error word, or None when the call parked in
+        a rendezvous group and ``handle`` will be completed elsewhere."""
         op = desc.scenario
         if op == CCLOp.nop:
             return 0
@@ -276,20 +515,51 @@ class TpuDevice(Device):
         if op == CCLOp.recv:
             return self._do_recv(desc, comm)
         if op in _COLLECTIVES:
-            return self._do_collective(desc, comm)
+            return self._do_collective(desc, comm, handle)
         return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
 
     # -- send/recv rendezvous ---------------------------------------------
     def _do_send(self, desc: CallDescriptor, comm: Communicator) -> int:
-        payload = self._read_operand(desc.addr_0, desc.count, desc,
-                                     Compression.OP0_COMPRESSED)
-        if desc.compression & Compression.ETH_COMPRESSED:
-            payload = payload.astype(desc.arithcfg.compressed_dtype)
+        """Eager send: snapshot the payload onto THIS rank's device and
+        park it for the matching recv, which moves it across the fabric
+        with a ppermute program (``TpuContext.exchange_transfer``).
+
+        Device-resident sources snapshot zero-copy — jax.Arrays are
+        immutable, so holding the reference IS the snapshot (result
+        writes rebind, they never mutate). Host-mirror sources pay one
+        explicit host copy + H2D, preserving MPI eager semantics (the
+        source buffer is reusable the moment send returns)."""
+        wire = (desc.arithcfg.compressed_dtype
+                if desc.compression & Compression.ETH_COMPRESSED else None)
+        buf = self.dev_bufs.get(desc.addr_0)
+        if (buf is not None and buf.size == desc.count
+                and not (desc.compression & Compression.OP0_COMPRESSED)):
+            payload = buf.jax
+            if payload.ndim != 1:
+                payload = payload.reshape(-1)
+            if wire is not None and payload.dtype != jnp.dtype(wire):
+                payload = payload.astype(wire)  # on-device wire cast
+        else:
+            host = self._read_operand(desc.addr_0, desc.count, desc,
+                                      Compression.OP0_COMPRESSED)
+            if wire is not None:
+                host = host.astype(wire)
+            # np.array(copy=True): device_put may alias host memory on
+            # the CPU backend, and the caller may overwrite the source
+            # right after send returns
+            payload = jax.device_put(np.array(host, copy=True),
+                                     self.my_device)
         dst_g = comm.ranks[desc.root_src_dst].global_rank
         key = (desc.comm_id, comm.my_global_rank, dst_g)
-        with self.ctx._lock:
-            self.ctx._sends[key].append((desc.tag, payload))
-            self.ctx._lock.notify_all()
+        ctx = self.ctx
+        with ctx._lock:
+            if ctx._parked_sends >= ctx.max_parked_sends:
+                # eager-buffer exhaustion, not silent HBM retention
+                return int(
+                    ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+            ctx._parked_sends += 1
+            ctx._sends[key].append((desc.tag, payload))
+            ctx._lock.notify_all()
         return 0
 
     def _match_send(self, key: tuple, tag: int):
@@ -303,13 +573,13 @@ class TpuDevice(Device):
         for i, (stag, payload) in enumerate(pending):
             if tag == TAG_ANY or stag == tag or stag == TAG_ANY:
                 del pending[i]
+                self.ctx._parked_sends -= 1
                 if not pending:
                     del self.ctx._sends[key]
                 return payload
         return None
 
     def _do_recv(self, desc: CallDescriptor, comm: Communicator) -> int:
-        import time
         src_g = comm.ranks[desc.root_src_dst].global_rank
         me_g = comm.my_global_rank
         key = (desc.comm_id, src_g, me_g)
@@ -326,78 +596,78 @@ class TpuDevice(Device):
             # emulator-tier parity: envelope length must match the posted
             # receive exactly (DMA_MISMATCH_ERROR, executor._fetch)
             return int(ErrorCode.DMA_MISMATCH_ERROR)
-        # The transfer itself is the host-side rendezvous above: this
-        # driver tier stages per call (module docstring), so the payload
-        # is already host-visible when matched — a ppermute here would be
-        # a decorative device round-trip, not a data path. Programs that
-        # need tagged transfers to ride ICI use ``MeshCollectives.
-        # exchange`` / ``send_recv`` inside their own jitted program,
-        # where the payload genuinely lives device-side.
-        received = payload.astype(desc.arithcfg.uncompressed_dtype)
-        self._write_result(desc.addr_2, received, desc)
+        # The host rendezvous above is control plane only (tag matching,
+        # MPI ordering); the DATA crosses the device fabric: one ppermute
+        # program over the communicator's mesh moves the snapshot from
+        # the sender's device to ours (parity: reference send/recv ride
+        # the real transport, ccl_offload_control.c:339-380 + rxbuf
+        # ingress). Self-sends skip the program — there is no hop.
+        src_local = desc.root_src_dst
+        me_local = comm.local_rank
+        if src_local == me_local:
+            received = payload
+        else:
+            received = self.ctx.exchange_transfer(comm, payload,
+                                                  src_local, me_local)
+        uncomp = desc.arithcfg.uncompressed_dtype
+        if received.dtype != jnp.dtype(uncomp):
+            received = received.astype(uncomp)  # wire decompress, on device
+        dst = self.dev_bufs.get(desc.addr_2)
+        if (dst is not None and dst.size == desc.count
+                and not (desc.compression & Compression.RES_COMPRESSED)):
+            self._rebind_dev(dst, received)   # stays on device
+        else:
+            self._write_result(desc.addr_2, np.asarray(received), desc)
         return 0
 
     # -- collective rendezvous --------------------------------------------
-    def _do_collective(self, desc: CallDescriptor, comm: Communicator) -> int:
-        import time
-        idx = self._coll_index[desc.comm_id]
-        self._coll_index[desc.comm_id] += 1
-        key = (desc.comm_id, idx)
+    def _do_collective(self, desc: CallDescriptor, comm: Communicator,
+                       handle: CallHandle) -> None:
+        """Deposit this rank's call; the group-completing arrival launches
+        and completes EVERY member's handle directly. No member ever
+        blocks a thread waiting for results — once a group is claimed it
+        structurally cannot be timed out mid-execution (the round-2 waiter
+        bug class), and the only parked state is an incomplete group,
+        which the context's deadline sweeper fails with
+        RECEIVE_TIMEOUT_ERROR per member (the old per-waiter timeout
+        semantics)."""
         ctx = self.ctx
-        with ctx._lock:
-            group = ctx._pending.setdefault(key, {})
-            group[comm.local_rank] = desc
-            is_last = len(group) == comm.size
-            if is_last:
-                # claim the group; execution happens OUTSIDE the lock so
-                # collectives of disjoint communicators run concurrently
-                # (jit/dispatch time would otherwise serialize the world)
-                del ctx._pending[key]
-                ctx._claimed.add(key)
-        if is_last:
-            # the publish runs in a finally so a claimed key ALWAYS resolves
-            # — waiters in the claimed state deliberately never time out, so
-            # any escape path (desc-assembly errors, BaseExceptions) that
-            # skipped publication would wedge them forever
-            err = int(ErrorCode.INVALID_CALL)
-            try:
-                descs = [group[r] for r in range(comm.size)]
-                err = self._launch(descs, comm)
-            except Exception:  # noqa: BLE001
-                import traceback
-                traceback.print_exc()  # observability: don't bury the cause
-            finally:
-                with ctx._lock:
-                    ctx._claimed.discard(key)
-                    if comm.size > 1:
-                        # [error, readers remaining]; deleted when drained
-                        ctx._results[key] = [err, comm.size - 1]
-                    ctx._lock.notify_all()
-            return err
         deadline = time.monotonic() + self.timeout
         with ctx._lock:
-            while key not in ctx._results:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    if key in ctx._claimed:
-                        # execution in flight: the launcher WILL publish
-                        # (exceptions included), so departing now would
-                        # return a bogus timeout for a call that completes
-                        # and leave an undrainable result entry behind —
-                        # keep waiting for the publication instead
-                        ctx._lock.wait(1.0)
-                        continue
-                    # group still incomplete: abandon our slot
-                    pend = ctx._pending.get(key)
-                    if pend is not None:
-                        pend.pop(comm.local_rank, None)
-                    return int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
-                ctx._lock.wait(remaining)
-            entry = ctx._results[key]
-            entry[1] -= 1
-            if entry[1] <= 0:
-                del ctx._results[key]
-            return entry[0]
+            # index assignment under the ctx lock: deposit order IS the
+            # per-rank matching order (MPI program-order matching)
+            idx = self._coll_index[desc.comm_id]
+            self._coll_index[desc.comm_id] += 1
+            key = (desc.comm_id, idx)
+            group = ctx._pending.setdefault(key, {})
+            group[comm.local_rank] = (desc, handle, deadline)
+            is_last = len(group) == comm.size
+            if is_last:
+                # claim: execution happens OUTSIDE the lock so collectives
+                # of disjoint communicators run concurrently
+                del ctx._pending[key]
+            else:
+                ctx._ensure_sweeper()
+        if not is_last:
+            # the synchronous-call path (call_sync/_run_one's caller)
+            # blocks in handle.wait(); async callers hold the handle
+            return None
+        err = int(ErrorCode.INVALID_CALL)
+        exc_out: BaseException | None = None
+        try:
+            descs = [group[r][0] for r in range(comm.size)]
+            err = self._launch(descs, comm)
+        except Exception as exc:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()  # observability: don't bury the cause
+            exc_out = exc
+        finally:
+            # completion runs in a finally so a claimed group ALWAYS
+            # resolves — any escape path (desc-assembly errors,
+            # BaseExceptions) that skipped it would wedge every waiter
+            for _, h, _dl in group.values():
+                h.complete(err, exception=exc_out)
+        return None
 
     def _launch(self, descs: list, comm: Communicator) -> int:
         """Execute one collective for all member ranks (no locks held)."""
@@ -478,6 +748,24 @@ class TpuDevice(Device):
             rows = wire_q(flat.reshape(W, -1))
             rows[keep] = flat.reshape(W, -1)[keep]
             return rows.reshape(-1)
+        # -- device-resident fast path (to_from_fpga=False parity) --------
+        # When every member rank's src AND dst buffer is device-resident
+        # with exact geometry, the dense collectives skip host staging
+        # entirely: per-rank arrays assemble into the flat global via
+        # make_array_from_single_device_arrays, one cached program runs,
+        # and result shards rebind each rank's dst — zero host copies.
+        dense_fast = {CCLOp.allreduce: (count, count),
+                      CCLOp.allgather: (count, W * count),
+                      CCLOp.reduce_scatter: (W * count, count),
+                      CCLOp.alltoall: (W * count, W * count)}
+        if op in dense_fast and not (op == CCLOp.alltoall
+                                     and wire is not None):
+            n_in, n_out = dense_fast[op]
+            res = self._launch_device_fast(op, descs, devs, coll, alg,
+                                           wire, cfg, n_in, n_out, d0)
+            if res is not None:
+                return res
+
         if op == CCLOp.allreduce:
             x = coll.shard(read_all(lambda d: d.addr_0, count))
             out = np.asarray(coll.allreduce(x, func=d0.function,
@@ -552,6 +840,70 @@ class TpuDevice(Device):
                 devs[r]._write_result(d.addr_2, wire_q_except(out[r], r), d)
             return 0
         return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
+
+    def _launch_device_fast(self, op, descs, devs, coll, alg, wire, cfg,
+                            n_in: int, n_out: int, d0) -> int | None:
+        """Zero-host-staging dense collective. Returns None when any
+        member's operands disqualify (not device-resident, geometry or
+        dtype mismatch, host-side compression flags) — the caller then
+        takes the staged path. OP0/RES_COMPRESSED disqualify because a
+        device buffer has one storage dtype (no compressed host mirror);
+        ETH (wire) compression stays eligible — it lives inside the
+        program."""
+        bad = (Compression.OP0_COMPRESSED | Compression.OP1_COMPRESSED
+               | Compression.RES_COMPRESSED)
+        uncomp = np.dtype(cfg.uncompressed_dtype)
+        srcs, dsts = [], []
+        for r, d in enumerate(descs):
+            if d.compression & bad:
+                return None
+            sb = devs[r].dev_bufs.get(d.addr_0)
+            db = devs[r].dev_bufs.get(d.addr_2)
+            if (sb is None or db is None
+                    or sb.size != n_in or db.size != n_out
+                    or sb.dtype != uncomp or db.dtype != uncomp):
+                return None
+            srcs.append(sb.jax if sb.jax.ndim == 1 else sb.jax.reshape(-1))
+            dsts.append(db)
+        func = (d0.function if op in (CCLOp.allreduce, CCLOp.reduce_scatter)
+                else ReduceFunc.SUM)
+        x = self.ctx.assemble_flat(coll, srcs)
+        wire_name = None if wire is None else np.dtype(wire).name
+        out = coll._program_flat(op.name, alg, func, wire_name, None)(x)
+        # Shard objects are expensive to build (index/device per shard,
+        # ~15us each); the position->rank order is a pure function of the
+        # (fixed) flat sharding, so compute it once per mesh and reuse.
+        # jax.Array._arrays is private, so the first call also VERIFIES it
+        # matches addressable_shards device-for-device before trusting it
+        # on later calls — if the contract ever changes (or the attribute
+        # disappears) we stay on the public API instead of silently
+        # scattering results to the wrong ranks.
+        order = coll._cache.get("shard_order")
+        if order is None:
+            shards = list(out.addressable_shards)
+            order = [(s.index[0].start or 0) * len(shards)
+                     // out.shape[0] for s in shards]
+            coll._cache["shard_order"] = order
+            arrs = getattr(out, "_arrays", None)
+            coll._cache["shard_arrays_ok"] = bool(
+                arrs is not None and len(arrs) == len(shards)
+                and all(getattr(a, "device", None) == s.device
+                        for a, s in zip(arrs, shards)))
+            datas = [s.data for s in shards]
+        elif coll._cache.get("shard_arrays_ok"):
+            datas = out._arrays
+        else:
+            datas = [s.data for s in out.addressable_shards]
+        for pos, r in enumerate(order):
+            db = dsts[r]
+            # eligibility proved size+dtype; only a non-1-D dst needs the
+            # general rebind (reshape), so the common case is one pointer
+            # swap
+            if len(db._shape) == 1:
+                db._rebind(datas[pos])
+            else:
+                devs[r]._rebind_dev(db, datas[pos])
+        return 0
 
 
 def tpu_world(world_size: int | None = None, platform: str | None = None,

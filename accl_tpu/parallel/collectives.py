@@ -297,6 +297,12 @@ class MeshCollectives:
         # per-instance program cache (an lru_cache on methods would pin the
         # instance and its jitted executables in a process-global cache)
         self._cache: dict[tuple, Callable] = {}
+        # hot-path constants: device list in axis order + the flat 1-D
+        # sharding (rebuilding either per call costs ~100us of pure
+        # Python on the device-resident driver path)
+        import numpy as _np
+        self.device_list = list(_np.asarray(mesh.devices).reshape(-1))
+        self.flat_sharding = NamedSharding(mesh, P(axis_name))
 
     # specs: leading axis is the per-rank axis
     def _sharded(self, extra_dims: int = 0) -> P:
@@ -309,12 +315,10 @@ class MeshCollectives:
         sharding = NamedSharding(self.mesh, self._sharded(stacked.ndim - 1))
         return jax.device_put(stacked, sharding)
 
-    def _program(self, op: str, algorithm: str, func: ReduceFunc,
-                 wire: str | None, root: int | None):
-        ck = (op, algorithm, func, wire, root)
-        cached = self._cache.get(ck)
-        if cached is not None:
-            return cached
+    def _shard_fn(self, op: str, algorithm: str, func: ReduceFunc,
+                  wire: str | None, root: int | None) -> Callable:
+        """Build the per-shard body f: (1, n_in) -> (1, n_out) shared by
+        the stacked (W, n) and flat (W*n,) program layouts."""
         ax = self.axis_name
         wire_dtype = jnp.dtype(wire) if wire else None
         # XLA has no fused product-reduce collective; use the ring path
@@ -326,10 +330,7 @@ class MeshCollectives:
                 r = ring_allreduce_shard(x[0], ax, func, wire_dtype)
                 me = lax.axis_index(ax)
                 return jnp.where(me == root, r, jnp.zeros_like(x[0]))[None]
-            fn = jax.shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
-                               out_specs=P(ax, None))
-            prog = self._cache[ck] = jax.jit(fn)
-            return prog
+            return f
 
         if op == "allreduce":
             if algorithm == "ring":
@@ -345,7 +346,6 @@ class MeshCollectives:
             else:
                 def f(x):
                     return _PSUM_LIKE[func](x[0], ax).astype(x.dtype)[None]
-            spec_in = spec_out = P(ax, None)
         elif op == "reduce_scatter":
             # x: (W, W*chunk) global; out: (W, chunk)
             if algorithm == "ring":
@@ -363,7 +363,6 @@ class MeshCollectives:
                     r = lax.psum_scatter(x[0].reshape(self.W, -1), ax,
                                          scatter_dimension=0, tiled=False)
                     return r.astype(x.dtype)[None]
-            spec_in = spec_out = P(ax, None)
         elif op == "allgather":
             # x: (W, chunk) global; out: (W, W*chunk)
             if algorithm == "ring":
@@ -377,11 +376,9 @@ class MeshCollectives:
             else:
                 def f(x):
                     return lax.all_gather(x[0], ax).reshape(-1)[None]
-            spec_in = spec_out = P(ax, None)
         elif op == "bcast":
             def f(x):
                 return masked_bcast(x[0], root, ax)[None]
-            spec_in = spec_out = P(ax, None)
         elif op == "reduce":
             def f(x):
                 if wire_dtype is not None:
@@ -393,7 +390,6 @@ class MeshCollectives:
                 me = lax.axis_index(ax)
                 return jnp.where(me == root, r,
                                  jnp.zeros_like(x[0]))[None]
-            spec_in = spec_out = P(ax, None)
         elif op == "scatter":
             # root's (W, chunk) rows land one per rank via masked psum_scatter
             def f(x):
@@ -404,24 +400,54 @@ class MeshCollectives:
                 r = lax.psum_scatter(contrib, ax, scatter_dimension=0,
                                      tiled=False)
                 return r.astype(x.dtype)[None]
-            spec_in = spec_out = P(ax, None)
         elif op == "gather":
             # all_gather everywhere, mask off non-root (tree-structured in XLA)
             def f(x):
                 g = lax.all_gather(x[0], ax).reshape(-1)
                 me = lax.axis_index(ax)
                 return jnp.where(me == root, g, jnp.zeros_like(g))[None]
-            spec_in = spec_out = P(ax, None)
         elif op == "alltoall":
             def f(x):
                 chunks = x[0].reshape(self.W, -1)
                 return alltoall_shard(chunks, ax).reshape(-1)[None]
-            spec_in = spec_out = P(ax, None)
         else:
             raise NotImplementedError(op)
+        return f
 
-        fn = jax.shard_map(f, mesh=self.mesh, in_specs=spec_in,
-                           out_specs=spec_out)
+    def _program(self, op: str, algorithm: str, func: ReduceFunc,
+                 wire: str | None, root: int | None):
+        """Stacked layout: global (W, n) arrays, leading axis = rank."""
+        ck = (op, algorithm, func, wire, root)
+        cached = self._cache.get(ck)
+        if cached is not None:
+            return cached
+        ax = self.axis_name
+        f = self._shard_fn(op, algorithm, func, wire, root)
+        fn = jax.shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
+                           out_specs=P(ax, None))
+        prog = self._cache[ck] = jax.jit(fn)
+        return prog
+
+    def _program_flat(self, op: str, algorithm: str, func: ReduceFunc,
+                      wire: str | None, root: int | None):
+        """Flat layout: global (W*n,) arrays whose per-device shards are
+        rank-local 1-D operands. This is the device-resident buffer path:
+        shards assembled with jax.make_array_from_single_device_arrays
+        keep their (n,) shape, so no per-shard host reshape is needed on
+        either side of the call (the [None]/[0] axis plumbing is free
+        inside the jitted program)."""
+        ck = ("flat", op, algorithm, func, wire, root)
+        cached = self._cache.get(ck)
+        if cached is not None:
+            return cached
+        ax = self.axis_name
+        f = self._shard_fn(op, algorithm, func, wire, root)
+
+        def g(x):
+            return f(x[None])[0]
+
+        fn = jax.shard_map(g, mesh=self.mesh, in_specs=P(ax),
+                           out_specs=P(ax))
         prog = self._cache[ck] = jax.jit(fn)
         return prog
 
@@ -479,6 +505,27 @@ class MeshCollectives:
                  pairs: tuple[tuple[int, int], ...]) -> jax.Array:
         """Execute a batch of point-to-point transfers as one ppermute."""
         return self._sendrecv_program(tuple(pairs))(x)
+
+    def _sendrecv_program_flat(self, pairs: tuple[tuple[int, int], ...]):
+        ck = ("exchange_flat", pairs)
+        cached = self._cache.get(ck)
+        if cached is not None:
+            return cached
+        ax = self.axis_name
+
+        def g(x):
+            return send_recv(x, list(pairs), ax)
+
+        fn = jax.shard_map(g, mesh=self.mesh, in_specs=P(ax),
+                           out_specs=P(ax))
+        prog = self._cache[ck] = jax.jit(fn)
+        return prog
+
+    def exchange_flat(self, x: jax.Array,
+                      pairs: tuple[tuple[int, int], ...]) -> jax.Array:
+        """Flat-layout exchange: global (W*n,), per-device shards are the
+        rank-local payloads (the device-resident send/recv path)."""
+        return self._sendrecv_program_flat(tuple(pairs))(x)
 
 
 def _wire_name(wire_dtype) -> str | None:

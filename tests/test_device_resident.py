@@ -1,0 +1,294 @@
+"""Device-resident buffers (the reference's ``to_from_fpga=False`` fast
+path, test/host/test_tcp_cmac_seq_mpi.py:29-443) and the device-fabric
+send/recv path on the TPU tier.
+
+Covers: zero-staging dense collectives, fallback interop with host-mirror
+buffers, send/recv riding the ppermute exchange program (payload lives on
+device end to end, HLO contains collective-permute), rejection on
+backends without device arrays, and the collective deadline sweeper.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from accl_tpu import ACCLError, ErrorCode, ReduceFunc
+from accl_tpu.device.tpu import tpu_world
+from accl_tpu.testing import run_ranks
+
+W = 8
+
+
+def _data(count, seed):
+    return np.random.default_rng(seed).standard_normal(count).astype(
+        np.float32)
+
+
+@pytest.fixture(scope="module")
+def world():
+    return tpu_world(W, platform="cpu")
+
+
+def _dev_src(a, arr):
+    return a.buffer(data=jax.device_put(arr, a.device.my_device))
+
+
+def test_buffer_modes(world):
+    a = world[0]
+    host = a.buffer((8,), np.float32)
+    assert not host.is_device_resident
+    dev = a.buffer((8,), np.float32, device_resident=True)
+    assert dev.is_device_resident
+    assert dev.shape == (8,) and dev.dtype == np.dtype(np.float32)
+    np.testing.assert_array_equal(dev.data, np.zeros(8, np.float32))
+    with pytest.raises(ValueError):
+        host.jax
+    with pytest.raises(ValueError):
+        dev[2:4]  # no sub-buffer views on device arrays
+
+
+def test_adopt_rejected_on_emulator_backend():
+    from accl_tpu.testing import emu_world
+    accls = emu_world(2)
+    try:
+        with pytest.raises(ValueError, match="device-array storage"):
+            accls[0].buffer((4,), np.float32, device_resident=True)
+        with pytest.raises(ValueError, match="device-array storage"):
+            accls[0].buffer(data=jax.numpy.zeros(4))
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_adopt_rejects_sharded_arrays(world):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ctx = world[0].device.ctx
+    sharded = jax.device_put(
+        np.zeros((W, 4), np.float32),
+        NamedSharding(ctx.mesh, P(ctx.axis_name)))
+    with pytest.raises(ValueError, match="single-device"):
+        world[0].buffer(data=sharded)
+
+
+@pytest.mark.parametrize("count", [64, 1000])
+def test_allreduce_device_resident(world, count):
+    ins = [_data(count, 10 + r) for r in range(W)]
+
+    def fn(a):
+        src = _dev_src(a, ins[a.rank])
+        dst = a.buffer((count,), np.float32, device_resident=True)
+        a.allreduce(src, dst, count)
+        assert dst.is_device_resident  # result stayed on device
+        return dst.data.copy()
+
+    golden = sum(ins)
+    for out in run_ranks(world, fn):
+        np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_allgather_reduce_scatter_alltoall_device_resident(world):
+    count = 48
+    ins = [_data(count, 30 + r) for r in range(W)]
+    wide = [_data(W * count, 60 + r) for r in range(W)]
+
+    def fn(a):
+        r = a.rank
+        # allgather
+        src = _dev_src(a, ins[r])
+        dst = a.buffer((W * count,), np.float32, device_resident=True)
+        a.allgather(src, dst, count)
+        ag = dst.data.copy()
+        # reduce_scatter
+        src2 = _dev_src(a, wide[r])
+        dst2 = a.buffer((count,), np.float32, device_resident=True)
+        a.reduce_scatter(src2, dst2, count)
+        rs = dst2.data.copy()
+        # alltoall
+        src3 = _dev_src(a, wide[r])
+        dst3 = a.buffer((W * count,), np.float32, device_resident=True)
+        a.alltoall(src3, dst3, count)
+        return ag, rs, dst3.data.copy()
+
+    res = run_ranks(world, fn)
+    gold_ag = np.concatenate(ins)
+    gold_sum = sum(wide)
+    for r, (ag, rs, a2a) in enumerate(res):
+        np.testing.assert_allclose(ag, gold_ag, rtol=1e-5)
+        np.testing.assert_allclose(
+            rs, gold_sum[r * count:(r + 1) * count], rtol=1e-4, atol=1e-5)
+        gold_a2a = np.concatenate(
+            [wide[s][r * count:(r + 1) * count] for s in range(W)])
+        np.testing.assert_allclose(a2a, gold_a2a, rtol=1e-5)
+
+
+def test_mixed_worlds_fall_back(world):
+    """Some ranks device-resident, some host-mirror: the launch falls back
+    to staged execution and every rank still gets the right answer."""
+    count = 32
+    ins = [_data(count, 90 + r) for r in range(W)]
+
+    def fn(a):
+        if a.rank % 2 == 0:
+            src = _dev_src(a, ins[a.rank])
+            dst = a.buffer((count,), np.float32, device_resident=True)
+        else:
+            src = a.buffer(data=ins[a.rank])
+            dst = a.buffer((count,), np.float32)
+        a.allreduce(src, dst, count)
+        return dst.data.copy()
+
+    golden = sum(ins)
+    for out in run_ranks(world, fn):
+        np.testing.assert_allclose(out, golden, rtol=1e-4, atol=1e-5)
+
+
+def test_rooted_ops_on_device_buffers(world):
+    """bcast/gather aren't on the zero-staging path yet; device-resident
+    operands must still work through the staged fallback."""
+    count = 16
+    payload = _data(count, 7)
+
+    def fn(a):
+        buf = (_dev_src(a, payload) if a.rank == 3
+               else a.buffer((count,), np.float32, device_resident=True))
+        a.bcast(buf, count, root=3)
+        return buf.data.copy()
+
+    for out in run_ranks(world, fn):
+        np.testing.assert_allclose(out, payload, rtol=1e-6)
+
+
+def test_wire_compressed_allreduce_device_matches_host(world):
+    """ETH (wire) compression stays eligible for the zero-staging path —
+    and its numerics must match the host-staged tier exactly."""
+    count = 128
+    ins = [_data(count, 40 + r) for r in range(W)]
+
+    def fn_dev(a):
+        src = _dev_src(a, ins[a.rank])
+        dst = a.buffer((count,), np.float32, device_resident=True)
+        a.allreduce(src, dst, count, compress_dtype=np.float16)
+        return dst.data.copy()
+
+    def fn_host(a):
+        src = a.buffer(data=ins[a.rank])
+        dst = a.buffer((count,), np.float32)
+        a.allreduce(src, dst, count, compress_dtype=np.float16)
+        return dst.data.copy()
+
+    dev_res = run_ranks(world, fn_dev)
+    host_res = run_ranks(world, fn_host)
+    for d, h in zip(dev_res, host_res):
+        np.testing.assert_array_equal(d, h)
+
+
+# ---------------------------------------------------------------------------
+# send/recv through the device fabric
+# ---------------------------------------------------------------------------
+
+def test_send_snapshot_is_device_array(world):
+    """The host snapshot path is gone: a parked send payload is a
+    jax.Array living on the sender's device."""
+    ctx = world[0].device.ctx
+
+    def fn(a):
+        if a.rank == 1:
+            buf = a.buffer(data=np.full(8, 5.0, np.float32))
+            a.send(buf, 8, dst=6, tag=3)
+            # parked payload: device array on MY device
+            key = [k for k in ctx._sends if k[1] == 1]
+            assert key, "send not parked"
+            _tag, payload = ctx._sends[key[0]][0]
+            assert isinstance(payload, jax.Array)
+            assert payload.device == a.device.my_device
+        elif a.rank == 6:
+            buf = a.buffer((8,), np.float32)
+            a.recv(buf, 8, src=1, tag=3)
+            return buf.data.copy()
+        return None
+
+    res = run_ranks(world, fn)
+    np.testing.assert_allclose(res[6], np.full(8, 5.0))
+
+
+def test_exchange_program_contains_collective_permute(world):
+    """The transfer rides the mesh program: the lowered exchange HLO
+    contains a collective-permute op."""
+    ctx = world[0].device.ctx
+    coll = ctx.coll
+    prog = coll._sendrecv_program_flat(((1, 6),))
+    x = jax.device_put(
+        np.zeros((W * 8,), np.float32), coll.flat_sharding)
+    lowered = prog.lower(x)
+    texts = [lowered.as_text(), lowered.compile().as_text()]
+    assert any("collective_permute" in t or "collective-permute" in t
+               or "CollectivePermute" in t for t in texts)
+
+
+def test_recv_uses_exchange_transfer(world, monkeypatch):
+    """A matched recv moves the payload via TpuContext.exchange_transfer
+    (the ppermute program), not a host memcpy."""
+    ctx = world[0].device.ctx
+    calls = []
+    orig = type(ctx).exchange_transfer
+
+    def spy(self, comm, payload, src_local, dst_local):
+        calls.append((src_local, dst_local))
+        return orig(self, comm, payload, src_local, dst_local)
+
+    monkeypatch.setattr(type(ctx), "exchange_transfer", spy)
+
+    def fn(a):
+        if a.rank == 2:
+            buf = a.buffer(data=np.arange(16, dtype=np.float32))
+            a.send(buf, 16, dst=5, tag=9)
+        elif a.rank == 5:
+            buf = a.buffer((16,), np.float32)
+            a.recv(buf, 16, src=2, tag=9)
+            return buf.data.copy()
+        return None
+
+    res = run_ranks(world, fn)
+    np.testing.assert_allclose(res[5], np.arange(16, dtype=np.float32))
+    assert (2, 5) in calls
+
+
+def test_sendrecv_device_resident_end_to_end(world):
+    """Device-resident src and dst: the payload never leaves the device
+    (zero-copy snapshot; result is a rebind of the exchange output)."""
+    count = 32
+    payload = _data(count, 55)
+
+    def fn(a):
+        if a.rank == 0:
+            src = _dev_src(a, payload)
+            a.send(src, count, dst=7, tag=1)
+        elif a.rank == 7:
+            dst = a.buffer((count,), np.float32, device_resident=True)
+            a.recv(dst, count, src=0, tag=1)
+            assert dst.is_device_resident
+            return dst.data.copy()
+        return None
+
+    res = run_ranks(world, fn)
+    np.testing.assert_allclose(res[7], payload, rtol=1e-6)
+
+
+def test_collective_group_timeout_via_sweeper():
+    """A collective whose peers never arrive fails with
+    RECEIVE_TIMEOUT_ERROR (enforced by the context's deadline sweeper —
+    no waiter thread is parked per member anymore)."""
+    import time
+    accls = tpu_world(2, platform="cpu", timeout=0.4)
+    a = accls[0]
+    src = a.buffer(data=np.ones(4, np.float32))
+    dst = a.buffer((4,), np.float32)
+    t0 = time.monotonic()
+    h = a.allreduce(src, dst, 4, run_async=True)
+    with pytest.raises(ACCLError) as ei:
+        h.wait(5.0)
+    elapsed = time.monotonic() - t0
+    assert ei.value.error_word & int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+    assert elapsed < 3.0  # deadline + sweeper slack, not the wait budget
+    assert not a.device.ctx._pending
