@@ -355,14 +355,18 @@ class TpuDevice(Device):
     def set_max_segment_size(self, nbytes: int):
         self.max_segment_size = nbytes
 
-    # Ops safe to run in the submitting thread: everything that never
-    # blocks waiting on a peer. Collectives qualify because a deposit is
-    # non-blocking and only the group-completing arrival executes — which
-    # the caller of a synchronous driver call would block on anyway.
-    # recv blocks until a matching send exists, so it inlines only when
-    # the caller declared it will immediately wait (inline_ok).
-    _INLINE_OPS = _COLLECTIVES | {CCLOp.nop, CCLOp.config, CCLOp.copy,
-                                  CCLOp.combine, CCLOp.send}
+    # Inline eligibility in the submitting thread, preserving the async
+    # contract (call_async must not block an async caller on real work):
+    # - nop/config are trivial — always inline.
+    # - collectives always inline their DEPOSIT (non-blocking, ~10us);
+    #   when the deposit completes the group, the heavy launch runs
+    #   inline only for synchronous callers (inline_ok — they'd block in
+    #   wait() anyway) and hops to the worker for async ones.
+    # - send/recv/copy/combine do real work (staging, or blocking on a
+    #   peer for recv) — inline only when the caller declared it will
+    #   immediately wait (inline_ok).
+    _TRIVIAL_OPS = {CCLOp.nop, CCLOp.config}
+    _SYNC_INLINE_OPS = {CCLOp.send, CCLOp.recv, CCLOp.copy, CCLOp.combine}
 
     def call_async(self, desc: CallDescriptor,
                    waitfor: Sequence[CallHandle] = (), *,
@@ -374,10 +378,13 @@ class TpuDevice(Device):
         # whenever per-rank FIFO order is provable: nothing queued or
         # running on the worker (the shared inline gate) and every
         # dependency already retired.
-        if (op in self._INLINE_OPS or (op == CCLOp.recv and inline_ok)) \
+        if (op in self._TRIVIAL_OPS or op in _COLLECTIVES
+                or (op in self._SYNC_INLINE_OPS and inline_ok)) \
                 and self._inline_begin(waitfor):
             try:
-                self._run_one(desc, waitfor, handle)
+                self._run_one(desc, waitfor, handle,
+                              defer_launch=(op in _COLLECTIVES
+                                            and not inline_ok))
             finally:
                 self._inflight_done()
             return handle
@@ -400,22 +407,28 @@ class TpuDevice(Device):
             item = self._calls.get()
             if item is None:
                 return
-            desc, waitfor, handle = item
             try:
-                self._run_one(desc, waitfor, handle)
+                if callable(item):
+                    item()  # deferred group launch (async last arrival)
+                else:
+                    desc, waitfor, handle = item
+                    self._run_one(desc, waitfor, handle)
             finally:
                 self._inflight_done()
 
-    def _run_one(self, desc: CallDescriptor, waitfor, handle: CallHandle):
+    def _run_one(self, desc: CallDescriptor, waitfor, handle: CallHandle,
+                 defer_launch: bool = False):
         """Retire one call in the current thread. Completes ``handle``
         unless the call parked in a rendezvous group (collective deposit:
         the group-completing rank — or the deadline sweeper — completes
-        it)."""
+        it). ``defer_launch`` hops a group-completing launch to the
+        worker thread instead of running it here (async submissions must
+        not block in call_async)."""
         from ..constants import ACCLError
         try:
             for dep in waitfor:
                 dep.wait(self.timeout)
-            err = self._execute(desc, handle)
+            err = self._execute(desc, handle, defer_launch)
             if err is not None:
                 handle.complete(err)
         except ACCLError as exc:
@@ -478,8 +491,8 @@ class TpuDevice(Device):
                                    self.my_device))
 
     # -- execution ---------------------------------------------------------
-    def _execute(self, desc: CallDescriptor,
-                 handle: CallHandle) -> int | None:
+    def _execute(self, desc: CallDescriptor, handle: CallHandle,
+                 defer_launch: bool = False) -> int | None:
         """Returns the call's error word, or None when the call parked in
         a rendezvous group and ``handle`` will be completed elsewhere."""
         op = desc.scenario
@@ -515,7 +528,7 @@ class TpuDevice(Device):
         if op == CCLOp.recv:
             return self._do_recv(desc, comm)
         if op in _COLLECTIVES:
-            return self._do_collective(desc, comm, handle)
+            return self._do_collective(desc, comm, handle, defer_launch)
         return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
 
     # -- send/recv rendezvous ---------------------------------------------
@@ -622,7 +635,8 @@ class TpuDevice(Device):
 
     # -- collective rendezvous --------------------------------------------
     def _do_collective(self, desc: CallDescriptor, comm: Communicator,
-                       handle: CallHandle) -> None:
+                       handle: CallHandle,
+                       defer_launch: bool = False) -> None:
         """Deposit this rank's call; the group-completing arrival launches
         and completes EVERY member's handle directly. No member ever
         blocks a thread waiting for results — once a group is claimed it
@@ -652,6 +666,19 @@ class TpuDevice(Device):
             # the synchronous-call path (call_sync/_run_one's caller)
             # blocks in handle.wait(); async callers hold the handle
             return None
+        if defer_launch:
+            # async last arrival: the heavy launch must not run in the
+            # submitter's thread (call_async would block for the whole
+            # collective) — hop it to this rank's worker. The inflight
+            # slot keeps later same-rank calls FIFO behind it.
+            self._inflight_add()
+            self._calls.put(lambda: self._finish_group(group, comm))
+            return None
+        self._finish_group(group, comm)
+        return None
+
+    def _finish_group(self, group: dict, comm: Communicator) -> None:
+        """Launch a claimed group and complete EVERY member's handle."""
         err = int(ErrorCode.INVALID_CALL)
         exc_out: BaseException | None = None
         try:
@@ -667,7 +694,6 @@ class TpuDevice(Device):
             # BaseExceptions) that skipped it would wedge every waiter
             for _, h, _dl in group.values():
                 h.complete(err, exception=exc_out)
-        return None
 
     def _launch(self, descs: list, comm: Communicator) -> int:
         """Execute one collective for all member ranks (no locks held)."""

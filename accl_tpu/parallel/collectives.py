@@ -377,8 +377,12 @@ class MeshCollectives:
                 def f(x):
                     return lax.all_gather(x[0], ax).reshape(-1)[None]
         elif op == "bcast":
+            # binomial ppermute rounds: (W-1)|x| wire bytes; masked_bcast
+            # (psum-over-mask) costs a full allreduce (VERDICT r3 weak-3)
+            from .tree import binomial_bcast_shard
+
             def f(x):
-                return masked_bcast(x[0], root, ax)[None]
+                return binomial_bcast_shard(x[0], root, ax)[None]
         elif op == "reduce":
             def f(x):
                 if wire_dtype is not None:
@@ -391,21 +395,23 @@ class MeshCollectives:
                 return jnp.where(me == root, r,
                                  jnp.zeros_like(x[0]))[None]
         elif op == "scatter":
-            # root's (W, chunk) rows land one per rank via masked psum_scatter
+            # binomial halving tree: O(W log W / 2) chunks on the wire;
+            # the old masked psum_scatter paid reduce-scatter-class
+            # W(W-1) chunks regardless of root
+            from .tree import binomial_scatter_shard
+
             def f(x):
-                me = lax.axis_index(ax)
                 chunks = x[0].reshape(self.W, -1)
-                contrib = jnp.where(me == root, chunks,
-                                    jnp.zeros_like(chunks))
-                r = lax.psum_scatter(contrib, ax, scatter_dimension=0,
-                                     tiled=False)
-                return r.astype(x.dtype)[None]
+                return binomial_scatter_shard(chunks, root, ax)[None]
         elif op == "gather":
-            # all_gather everywhere, mask off non-root (tree-structured in XLA)
+            # binomial doubling tree: O(W log W / 2) chunks on the wire;
+            # all_gather+mask delivered W chunks to every rank, W(W-1)
+            # total, to keep one copy
+            from .tree import binomial_gather_shard
+
             def f(x):
-                g = lax.all_gather(x[0], ax).reshape(-1)
-                me = lax.axis_index(ax)
-                return jnp.where(me == root, g, jnp.zeros_like(g))[None]
+                g = binomial_gather_shard(x[0], root, ax).reshape(-1)
+                return g[None]
         elif op == "alltoall":
             def f(x):
                 chunks = x[0].reshape(self.W, -1)

@@ -113,6 +113,142 @@ def tree_gather_shard(x: jnp.ndarray, root: int, outer: str,
     return jnp.where(keep, out, jnp.zeros_like(out))
 
 
+# ---------------------------------------------------------------------------
+# 1-D binomial trees (ppermute rounds) — the traffic-proportional rooted
+# schedules for worlds WITHOUT 2D structure (prime sizes, W=2). Parity:
+# the host-tier binomial schedule moveengine.expand_broadcast_tree (same
+# vrank round structure, ccl_offload_control.c:507-724 is the reference's
+# traffic-proportional bar). Every round is one collective-permute whose
+# wire bytes equal (#pairs x block), so totals are O(message), not the
+# allreduce/allgather-class traffic of the masked-psum lowerings these
+# replace.
+# ---------------------------------------------------------------------------
+
+def _bit_rounds(W: int) -> int:
+    return max(1, (W - 1).bit_length())
+
+
+def gather_rounds(W: int) -> list[tuple[int, int, list[int]]]:
+    """Static (subtree_size, block_chunks, sender_vranks) per doubling
+    round. Blocks are uniform per round (ppermute needs one operand
+    shape): full 2^k except a single-sender round, whose block truncates
+    to the sender's real span — that removes the padding chunks of the
+    top round at non-power-of-two W. The tests compute expected wire
+    bytes from this same schedule."""
+    rounds = []
+    for k in range(_bit_rounds(W)):
+        size = 1 << k
+        vs = list(range(size, W, 2 * size))
+        if not vs:
+            break
+        block = size if len(vs) > 1 else min(size, W - vs[0])
+        rounds.append((size, block, vs))
+    return rounds
+
+
+def scatter_rounds(W: int) -> list[tuple[int, int, list[int]]]:
+    """Static (subtree_size, block_chunks, sender_vranks) per halving
+    round (consumed largest-size first)."""
+    rounds = []
+    for k in range(_bit_rounds(W)):
+        size = 1 << k
+        vs = [v for v in range(0, W, 2 * size) if v + size < W]
+        if not vs:
+            continue
+        block = size if len(vs) > 1 else min(size, W - (vs[0] + size))
+        rounds.append((size, block, vs))
+    return rounds
+
+
+def binomial_bcast_shard(x: jnp.ndarray, root: int,
+                         axis_name: str) -> jnp.ndarray:
+    """Binomial broadcast: ceil(log2 W) ppermute rounds, (W-1)|x| total
+    wire bytes (masked-psum bcast costs a full allreduce). Round k sends
+    from vranks [0, 2^k) to [2^k, 2^(k+1))."""
+    W = lax.axis_size(axis_name)
+    if W == 1:
+        return x
+    me = lax.axis_index(axis_name)
+    vrank = (me - root) % W
+    buf = x
+    for k in range(_bit_rounds(W)):
+        stride = 1 << k
+        pairs = [((v + root) % W, (v + stride + root) % W)
+                 for v in range(stride) if v + stride < W]
+        if not pairs:
+            break
+        recv = lax.ppermute(buf, axis_name, pairs)
+        is_recv = (vrank >= stride) & (vrank < 2 * stride)
+        buf = jnp.where(is_recv, recv, buf)
+    return buf
+
+
+def binomial_gather_shard(x: jnp.ndarray, root: int,
+                          axis_name: str) -> jnp.ndarray:
+    """Binomial gather: ``x`` (chunk...,) per rank -> (W, chunk...) at
+    root, zeros elsewhere. Doubling blocks: round k moves blocks of up
+    to 2^k chunks from odd-subtree roots to their parents — exactly
+    (W/2)*log2(W) chunks at power-of-two W, slightly more at other W
+    (non-final multi-sender rounds pad the last sender's block; the
+    single-sender round truncates). Either way O(W log W / 2), vs
+    all_gather+mask's W(W-1). ``gather_rounds`` is the byte-exact
+    schedule."""
+    W = lax.axis_size(axis_name)
+    if W == 1:
+        return x[None]
+    me = lax.axis_index(axis_name)
+    vrank = (me - root) % W
+    # Pad the vrank space to the next power of two: every subtree block
+    # [v, v+2^k) then stays in-bounds, so dynamic_slice never clamps.
+    # A clamped slice at non-power-of-two W shifts the sender's window
+    # below its subtree and the matching clamped update clobbers chunks
+    # the receiver already accumulated.
+    P = 1 << _bit_rounds(W)
+    acc = jnp.zeros((P,) + x.shape, x.dtype)
+    acc = lax.dynamic_update_index_in_dim(acc, x, vrank, 0)
+    for size, bs, senders in gather_rounds(W):
+        pairs = [((v + root) % W, (v - size + root) % W) for v in senders]
+        # senders' subtree occupies vrank positions [vrank, vrank+bs)
+        block = lax.dynamic_slice_in_dim(acc, vrank, bs, 0)
+        recv = lax.ppermute(block, axis_name, pairs)
+        is_recv = (vrank % (2 * size) == 0) & (vrank + size < W)
+        updated = lax.dynamic_update_slice_in_dim(acc, recv, vrank + size, 0)
+        acc = jnp.where(is_recv, updated, acc)
+    # acc is in vrank space: acc[v] = chunk of rank (v+root)%W
+    out = jnp.roll(lax.slice_in_dim(acc, 0, W, axis=0), root, axis=0)
+    return jnp.where(me == root, out, jnp.zeros_like(out))
+
+
+def binomial_scatter_shard(x: jnp.ndarray, root: int,
+                           axis_name: str) -> jnp.ndarray:
+    """Binomial scatter: ``x`` (W, chunk...) valid at root -> own
+    (chunk...,). Halving blocks from the top: round k hands each subtree
+    root the block destined for its far subtree — the mirror of
+    ``binomial_gather_shard`` with the byte-exact schedule in
+    ``scatter_rounds``; O(W log W / 2) chunks total vs masked
+    psum_scatter's reduce-scatter-class W(W-1)."""
+    W = lax.axis_size(axis_name)
+    if W == 1:
+        return x[0]
+    me = lax.axis_index(axis_name)
+    vrank = (me - root) % W
+    buf = jnp.roll(x, -root, axis=0)  # vrank space
+    # no power-of-two padding needed here (unlike gather): when a block
+    # near the top of a non-power-of-two world clamps, the sender's
+    # slice start and the receiver's update start clamp to the SAME
+    # min(v+size, W-size), so the window stays aligned, and the extra
+    # leading positions it overwrites are below the receiver's subtree,
+    # which it never reads
+    for size, bs, senders in reversed(scatter_rounds(W)):
+        pairs = [((v + root) % W, (v + size + root) % W) for v in senders]
+        block = lax.dynamic_slice_in_dim(buf, vrank + size, bs, 0)
+        recv = lax.ppermute(block, axis_name, pairs)
+        is_recv = vrank % (2 * size) == size
+        updated = lax.dynamic_update_slice_in_dim(buf, recv, vrank, 0)
+        buf = jnp.where(is_recv, updated, buf)
+    return lax.dynamic_index_in_dim(buf, vrank, 0, keepdims=False)
+
+
 class Tree2DCollectives:
     """Tree collectives over global arrays sharded on a 2D mesh.
 

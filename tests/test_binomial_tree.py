@@ -1,0 +1,153 @@
+"""1-D binomial-tree rooted collectives (ppermute rounds).
+
+VERDICT r3 weak-3: the masked-psum lowerings paid allreduce/allgather
+class traffic for rooted ops on worlds without 2D structure. These tests
+pin (a) correctness at W=2 (trivial tree), W=7 (prime — no 2D mesh
+exists) and W=8, for every root, and (b) the traffic property itself by
+inspecting the lowered HLO: rooted programs contain collective-permutes
+only — no all-reduce / all-gather / reduce-scatter — and the summed
+permute bytes stay within the binomial bound.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from accl_tpu.constants import ReduceFunc
+from accl_tpu.parallel.collectives import MeshCollectives
+
+
+def _coll(w: int) -> MeshCollectives:
+    return MeshCollectives(Mesh(np.asarray(jax.devices()[:w]), ("rank",)),
+                           "rank")
+
+
+def _rows(w, count, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal(count).astype(np.float32) for _ in range(w)]
+
+
+@pytest.mark.parametrize("w", [2, 7, 8])
+def test_binomial_bcast_every_root(w):
+    coll = _coll(w)
+    count = 24
+    for root in range(w):
+        rows = _rows(w, count, seed=root)
+        out = np.asarray(coll.bcast(coll.shard(rows), root=root))
+        for r in range(w):
+            np.testing.assert_array_equal(out[r], rows[root])
+
+
+@pytest.mark.parametrize("w", [2, 7, 8])
+def test_binomial_scatter_every_root(w):
+    coll = _coll(w)
+    count = 8
+    for root in range(w):
+        rows = _rows(w, w * count, seed=100 + root)
+        out = np.asarray(coll.scatter(coll.shard(rows), root=root))
+        for r in range(w):
+            np.testing.assert_array_equal(
+                out[r][:count], rows[root][r * count:(r + 1) * count])
+
+
+@pytest.mark.parametrize("w", [2, 7, 8])
+def test_binomial_gather_every_root(w):
+    coll = _coll(w)
+    count = 8
+    for root in range(w):
+        rows = _rows(w, count, seed=200 + root)
+        out = np.asarray(coll.gather(coll.shard(rows), root=root))
+        np.testing.assert_array_equal(out[root],
+                                      np.concatenate(rows))
+
+
+def test_binomial_gather_int_dtype():
+    """all_gather+mask worked for ints and so must the tree."""
+    w = 7
+    coll = _coll(w)
+    rows = [np.arange(4, dtype=np.int32) + 10 * r for r in range(w)]
+    out = np.asarray(coll.gather(coll.shard(rows), root=3))
+    np.testing.assert_array_equal(out[3], np.concatenate(rows))
+
+
+# ---------------------------------------------------------------------------
+# traffic property: wire bytes proportional to the message
+# ---------------------------------------------------------------------------
+
+_PERMUTE_LINE = re.compile(
+    r"f32\[([\d,]*)\]\S*\s+collective-permute\(.*?"
+    r"source_target_pairs=(\{.*?\}\})", re.DOTALL)
+
+
+def _permute_bytes(hlo: str) -> int:
+    """Sum wire bytes over every collective-permute: elements x 4 bytes x
+    number of source-target pairs (only listed pairs transfer)."""
+    total = 0
+    for m in _PERMUTE_LINE.finditer(hlo):
+        n = 1
+        for d in m.group(1).split(","):
+            if d:
+                n *= int(d)
+        npairs = m.group(2).count("{") - 1
+        total += n * 4 * max(npairs, 1)
+    return total
+
+
+def _compiled_hlo(coll, op, root, count):
+    if op == "bcast":
+        prog = coll._program("bcast", "xla", ReduceFunc.SUM, None, root)
+        x = coll.shard(_rows(coll.W, count))
+    elif op == "gather":
+        prog = coll._program("gather", "xla", ReduceFunc.SUM, None, root)
+        x = coll.shard(_rows(coll.W, count))
+    else:
+        prog = coll._program("scatter", "xla", ReduceFunc.SUM, None, root)
+        x = coll.shard(_rows(coll.W, coll.W * count))
+    return prog.lower(x).compile().as_text()
+
+
+@pytest.mark.parametrize("op", ["bcast", "scatter", "gather"])
+@pytest.mark.parametrize("w", [7, 8])
+def test_rooted_ops_lower_to_permutes_only(op, w):
+    """The rooted programs must contain no allreduce-class collectives —
+    that is exactly the masked-psum traffic bug being fixed."""
+    coll = _coll(w)
+    hlo = _compiled_hlo(coll, op, root=min(3, w - 1), count=16)
+    assert "collective-permute" in hlo
+    for banned in ("all-reduce", "all-gather", "reduce-scatter",
+                   "all-to-all"):
+        assert banned not in hlo, f"{op} at W={w} still lowers to {banned}"
+
+
+@pytest.mark.parametrize("w", [7, 8])
+def test_bcast_wire_bytes_proportional(w):
+    """Binomial bcast moves exactly (W-1) copies of the message."""
+    count = 1024
+    coll = _coll(w)
+    hlo = _compiled_hlo(coll, "bcast", root=0, count=count)
+    msg = count * 4
+    total = _permute_bytes(hlo)
+    assert 0 < total <= (w - 1) * msg * 1.01, (total, (w - 1) * msg)
+
+
+@pytest.mark.parametrize("op", ["scatter", "gather"])
+@pytest.mark.parametrize("w", [7, 8])
+def test_scatter_gather_wire_bytes_match_schedule(op, w):
+    """The compiled HLO moves EXACTLY the chunks the static schedule
+    says (byte-exact, including the non-power-of-two truncation), far
+    below the W(W-1) chunks of the masked lowerings they replaced."""
+    from accl_tpu.parallel.tree import gather_rounds, scatter_rounds
+    count = 1024
+    coll = _coll(w)
+    hlo = _compiled_hlo(coll, op, root=0, count=count)
+    chunk = count * 4
+    rounds = gather_rounds(w) if op == "gather" else scatter_rounds(w)
+    expected = sum(block * len(vs) for _sz, block, vs in rounds) * chunk
+    total = _permute_bytes(hlo)
+    masked_cost = w * (w - 1) * chunk
+    assert total == expected, (total, expected)
+    assert total < masked_cost / 4

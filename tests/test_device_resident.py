@@ -275,6 +275,46 @@ def test_sendrecv_device_resident_end_to_end(world):
     np.testing.assert_allclose(res[7], payload, rtol=1e-6)
 
 
+def test_run_async_submission_does_not_block_on_launch():
+    """call_async with run_async=True must return before the collective
+    executes, even for the group-completing rank — the heavy launch hops
+    to the worker thread (async contract)."""
+    import threading
+    import time
+    accls = tpu_world(2, platform="cpu")
+    ctx = accls[0].device.ctx
+    real = ctx.coll
+    release = threading.Event()
+
+    class Slow:
+        def __getattr__(self, name):
+            return getattr(real, name)
+
+        def allreduce(self, x, **kw):
+            assert release.wait(10), "launch never released"
+            return real.allreduce(x, **kw)
+
+    ctx.coll = Slow()
+    try:
+        bufs = []
+        for a in accls:
+            src = a.buffer(data=np.ones(4, np.float32))
+            dst = a.buffer((4,), np.float32)
+            bufs.append((src, dst))
+        t0 = time.monotonic()
+        handles = [a.allreduce(src, dst, 4, run_async=True)
+                   for a, (src, dst) in zip(accls, bufs)]
+        submit_elapsed = time.monotonic() - t0
+        # submissions returned while the launch is still parked
+        assert submit_elapsed < 5.0
+        assert not handles[1].done()
+        release.set()
+        for h in handles:
+            h.wait(10)
+    finally:
+        ctx.coll = real
+
+
 def test_collective_group_timeout_via_sweeper():
     """A collective whose peers never arrive fails with
     RECEIVE_TIMEOUT_ERROR (enforced by the context's deadline sweeper —
