@@ -28,10 +28,15 @@ class SimDevice(Device):
     """Client to one rank daemon's command socket."""
 
     def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+        self._addr = (host, port)
         self.sock = socket.create_connection((host, port),
                                              timeout=connect_timeout)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.sock.settimeout(None)
+        # buffered reader for replies: half the recv syscalls per frame,
+        # and batched submissions read many replies per syscall. ALL
+        # reads on this socket must go through it from here on.
+        self._rfile = self.sock.makefile("rb")
         self._lock = threading.Lock()          # one in-flight request
         self._buffers: list[ACCLBuffer] = []   # for result-address resolve
         self.timeout = 30.0
@@ -49,12 +54,48 @@ class SimDevice(Device):
         self._dispatcher = threading.Thread(target=self._dispatch_loop,
                                             daemon=True)
         self._dispatcher.start()
+        # Async completions ride a SECOND daemon connection consumed by
+        # one FIFO worker: MSG_WAIT holds its socket until the call
+        # retires, and on the (single-in-flight) command socket that
+        # would stall every later submission — serializing exactly the
+        # chains the wire-waitfor pipelining exists for. Lazy: sync-only
+        # clients never open it.
+        self._wait_sock: socket.socket | None = None
+        self._wait_lock = threading.Lock()
+        self._completion_q: queue.Queue | None = None
 
     # -- request/reply -----------------------------------------------------
     def _request(self, body: bytes) -> bytes:
         with self._lock:
             P.send_frame(self.sock, body)
-            return P.recv_frame(self.sock)
+            return P.recv_frame_file(self._rfile)
+
+    def _ensure_wait_sock(self):
+        if self._wait_sock is None:
+            self._wait_sock = socket.create_connection(self._addr,
+                                                       timeout=10.0)
+            self._wait_sock.setsockopt(socket.IPPROTO_TCP,
+                                       socket.TCP_NODELAY, 1)
+            self._wait_sock.settimeout(None)
+            # buffered reader: pipelined replies coalesce in one TCP
+            # segment; this turns K replies into ~one syscall
+            self._wait_rfile = self._wait_sock.makefile("rb")
+
+    def _request_wait_sock(self, body: bytes) -> bytes:
+        """Request on the dedicated completion connection."""
+        with self._wait_lock:
+            self._ensure_wait_sock()
+            P.send_frame(self._wait_sock, body)
+            return P.recv_frame_file(self._wait_rfile)
+
+    def _request_many_wait_sock(self, bodies: list[bytes]) -> list[bytes]:
+        """Pipelined request batch on the completion connection: one
+        coalesced write, replies read in order (the daemon serves a
+        connection's frames sequentially)."""
+        with self._wait_lock:
+            self._ensure_wait_sock()
+            P.send_frames(self._wait_sock, bodies)
+            return [P.recv_frame_file(self._wait_rfile) for _ in bodies]
 
     def _request_status(self, body: bytes) -> int:
         reply = self._request(body)
@@ -83,9 +124,12 @@ class SimDevice(Device):
         self._check(bytes([P.MSG_WRITE_MEM]) +
                     struct.pack("<Q", buf.address) + data)
 
-    def sync_from_device(self, buf: ACCLBuffer):
-        reply = self._request(bytes([P.MSG_READ_MEM]) +
-                              struct.pack("<2Q", buf.address, buf.nbytes))
+    def sync_from_device(self, buf: ACCLBuffer, request=None):
+        """Pull devicemem into the host mirror, optionally over a
+        specific connection (the completion worker passes its own)."""
+        reply = (request or self._request)(
+            bytes([P.MSG_READ_MEM]) +
+            struct.pack("<2Q", buf.address, buf.nbytes))
         assert reply[0] == P.MSG_DATA
         import numpy as np
         flat = buf.data.reshape(-1).view(np.uint8)
@@ -169,14 +213,26 @@ class SimDevice(Device):
         return info
 
     def deinit(self):
+        # the dispatcher forwards the completion sentinel AFTER draining
+        # its queue — a sentinel enqueued here directly would overtake
+        # completions of still-undispatched calls and strand their
+        # handles forever
         self._dispatch_q.put(None)
         try:
             self._request(bytes([P.MSG_SHUTDOWN]))
         except (ConnectionError, OSError):
             pass
         self.sock.close()
+        if self._wait_sock is not None:
+            self._wait_sock.close()
 
     # -- calls -------------------------------------------------------------
+    @staticmethod
+    def _result_addr(desc: CallDescriptor) -> int:
+        """The address a completed call wrote (bcast lands in-place)."""
+        return desc.addr_2 or (
+            desc.addr_0 if desc.scenario == CCLOp.bcast else 0)
+
     def _resolve_buffer(self, addr: int) -> ACCLBuffer | None:
         for b in self._buffers:
             if b.address <= addr < b.address + b.nbytes:
@@ -210,68 +266,265 @@ class SimDevice(Device):
         while True:
             item = self._dispatch_q.get()
             if item is None:
+                if self._completion_q is not None:
+                    self._completion_q.put(None)
                 return
-            desc, waitfor, handle = item
+            # Drain whatever else is already queued: consecutive
+            # pipeline-eligible items submit as ONE coalesced write
+            # (chain links otherwise pay a full request round-trip
+            # each — the serialization the wire-waitfor design removes).
+            # Once the batch contains a chained item (non-empty waitfor)
+            # the submitter is mid-chain, so a sub-millisecond grace get
+            # captures the links it is still enqueueing; independent
+            # single calls never wait.
+            batch = [item]
+            chaining = bool(item[1])
+            while len(batch) < 64:
+                try:
+                    nxt = (self._dispatch_q.get(timeout=0.0005)
+                           if chaining else self._dispatch_q.get_nowait())
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._dispatch_q.put(None)  # re-deliver shutdown
+                    break
+                batch.append(nxt)
+                chaining = chaining or bool(nxt[1])
             try:
-                self._dispatch_one(desc, waitfor, handle, inline=False)
+                self._dispatch_batch(batch)
             finally:
-                self._inflight_done()
+                for _ in batch:
+                    self._inflight_done()
+
+    def _dispatch_batch(self, batch: list):
+        """Submit a drained run of calls, grouping pipeline-eligible
+        stretches into single coalesced writes; non-eligible items fall
+        back to the one-at-a-time path."""
+        run: list = []
+        for item in batch:
+            desc, waitfor, handle = item
+            if self._pipeline_eligible(desc, waitfor, run):
+                handle.sim_hazard_addrs = self._hazard_footprint(desc,
+                                                                 waitfor)
+                run.append(item)
+                continue
+            self._flush_run(run)
+            run = []
+            self._dispatch_one(desc, waitfor, handle, inline=False)
+        self._flush_run(run)
+
+    def _hazard_footprint(self, desc: CallDescriptor, waitfor) -> tuple:
+        """Addresses an unretired chain rooted at this call may still
+        READ or WRITE: its own operands + result, plus every pending
+        dependency's footprint (transitively, via the footprints stored
+        on their handles at submission). Conservative — retired calls
+        leave stale entries that only cause a harmless fallback."""
+        fp = {a for a in (desc.addr_0, desc.addr_1,
+                          self._result_addr(desc)) if a}
+        for dep in waitfor:
+            if not dep.done():
+                fp.update(getattr(dep, "sim_hazard_addrs", ()))
+        return tuple(fp)
+
+    def _pipeline_eligible(self, desc: CallDescriptor, waitfor,
+                           run: list) -> bool:
+        """True iff every dependency is an already-submitted call on this
+        daemon (or the immediately preceding item of the current run) AND
+        submitting now is operand-safe."""
+        prev = run[-1] if run else None
+        for dep in waitfor:
+            if prev is not None and dep is prev[2]:
+                # footprint of the preceding in-run item (computed and
+                # stashed on its handle when it was appended)
+                dep_fp = getattr(prev[2], "sim_hazard_addrs", ())
+                dep_res = self._result_addr(prev[0])
+                dep_done = False
+            elif (getattr(dep, "sim_device", None) is self
+                    and getattr(dep, "sim_call_id", None) is not None):
+                dep_fp = getattr(dep, "sim_hazard_addrs", ())
+                dep_res = getattr(dep, "sim_result_addr", 0)
+                dep_done = dep.done()
+            else:
+                return False
+            if dep_done:
+                continue  # retired: our operand push can't clobber it
+            # Operand hazard: pipelined submission pushes THIS call's
+            # operand mirrors before the dependency chain executes. If
+            # an operand aliases ANY buffer the unretired chain still
+            # reads or writes (the dependency's transitive footprint) —
+            # other than the direct dependency's result, which we never
+            # push — the push would feed the chain data from the
+            # future; fall back to the wait-then-sync path.
+            res_buf = self._resolve_buffer(dep_res) if dep_res else None
+            for addr in (desc.addr_0, desc.addr_1):
+                if not addr:
+                    continue
+                b = self._resolve_buffer(addr)
+                if b is None or b is res_buf:
+                    continue
+                for da in dep_fp:
+                    if da and self._resolve_buffer(da) is b:
+                        return False
+        return True
+
+    def _flush_run(self, run: list):
+        """One coalesced submission for a pipeline-eligible run."""
+        if not run:
+            return
+        if len(run) == 1:
+            desc, waitfor, handle = run[0]
+            self._dispatch_one(desc, waitfor, handle, inline=False)
+            return
+        try:
+            bodies = []
+            for i, (desc, waitfor, handle) in enumerate(run):
+                prev_handle = run[i - 1][2] if i else None
+                wire_waitfor = []
+                skip_bufs = []
+                for dep in waitfor:
+                    if dep is prev_handle:
+                        wire_waitfor.append(P.WAITFOR_PREV)
+                        ra = self._result_addr(run[i - 1][0])
+                    else:
+                        wire_waitfor.append(dep.sim_call_id)
+                        # pending deps only: a retired dependency's
+                        # result mirror is authoritative again
+                        ra = (0 if dep.done()
+                              else getattr(dep, "sim_result_addr", 0))
+                    if ra:
+                        skip_bufs.append(self._resolve_buffer(ra))
+                # operand pushes go BEFORE the batched submissions (the
+                # daemon handles WRITE_MEM on arrival, before any of the
+                # batch executes); dependency-produced operands live in
+                # devicemem and must NOT be clobbered by stale mirrors
+                for addr in (desc.addr_0, desc.addr_1):
+                    if addr:
+                        b = self._resolve_buffer(addr)
+                        if b is not None and b not in skip_bufs:
+                            self.sync_to_device(b)
+                bodies.append(self._call_body(desc, wire_waitfor))
+            with self._lock:
+                P.send_frames(self.sock, bodies)
+                ids = []
+                for _ in bodies:
+                    reply = P.recv_frame_file(self._rfile)
+                    assert reply[0] == P.MSG_CALL_ID
+                    ids.append(struct.unpack("<I", reply[1:5])[0])
+            if self._completion_q is None:
+                self._completion_q = queue.Queue()
+                threading.Thread(target=self._completion_loop,
+                                 daemon=True).start()
+            for (desc, _wf, handle), call_id in zip(run, ids):
+                handle.sim_call_id = call_id
+                handle.sim_device = self
+                handle.sim_result_addr = self._result_addr(desc)
+                handle.sim_operand_addrs = (desc.addr_0, desc.addr_1)
+                self._completion_q.put((desc, call_id, handle))
+        except Exception as exc:  # noqa: BLE001
+            for _desc, _wf, handle in run:
+                if not handle.done():
+                    handle.complete(int(ErrorCode.CONNECTION_CLOSED),
+                                    exception=exc)
 
     def _dispatch_one(self, desc: CallDescriptor, waitfor,
                       handle: CallHandle, inline: bool):
         """Dep wait + operand sync + submit + completion; never raises."""
         try:
-            # local dependency order: operand syncs must observe the
-            # dependencies' results (reference collectives sync operands
-            # right before starting the call, accl.py:952)
             from ..constants import ACCLError
-            try:
+            # Pipelined chain submission (hostctrl ap_ctrl_chain parity:
+            # the reference chains async calls in hardware without host
+            # round-trips between links, hostctrl.cpp:56-90). When every
+            # dependency is an already-submitted call on THIS daemon, the
+            # chain's ordering and error propagation live daemon-side
+            # (FIFO worker + wire waitfor ids), so this link submits
+            # immediately instead of blocking on the dep's host-visible
+            # completion — an N-deep chain costs N pipelined submissions,
+            # not N serialized round-trip latencies.
+            wire_waitfor: list[int] = []
+            dep_result_bufs: list = []
+            pipelined = bool(waitfor) and self._pipeline_eligible(
+                desc, waitfor, [])
+            if pipelined:
                 for dep in waitfor:
-                    dep.wait(self.timeout)
-            except ACCLError as exc:
-                handle.complete(exc.error_word, exception=exc)
-                return
+                    wire_waitfor.append(dep.sim_call_id)
+                    ra = getattr(dep, "sim_result_addr", 0)
+                    # skip-push only applies to a PENDING dependency's
+                    # result (its value exists solely in devicemem); a
+                    # retired dependency's result was synced back, and a
+                    # host mutation made after that must be honored
+                    if ra and not dep.done():
+                        dep_result_bufs.append(self._resolve_buffer(ra))
+            if not pipelined:
+                # local dependency order: operand syncs must observe the
+                # dependencies' results (reference collectives sync
+                # operands right before starting the call, accl.py:952)
+                wire_waitfor = []
+                dep_result_bufs = []
+                try:
+                    for dep in waitfor:
+                        dep.wait(self.timeout)
+                except ACCLError as exc:
+                    handle.complete(exc.error_word, exception=exc)
+                    return
             for addr in (desc.addr_0, desc.addr_1):
                 if addr:
                     b = self._resolve_buffer(addr)
-                    if b is not None:
+                    # a pipelined dependency PRODUCES this operand in
+                    # devicemem; pushing the stale host mirror would race
+                    # the dependency's execution and clobber its result
+                    if b is not None and b not in dep_result_bufs:
                         self.sync_to_device(b)
-            call_id = self._submit(desc)
+            call_id = self._submit(desc, wire_waitfor)
             handle.sim_call_id = call_id
+            handle.sim_device = self
+            handle.sim_result_addr = self._result_addr(desc)
+            handle.sim_operand_addrs = (desc.addr_0, desc.addr_1)
+            handle.sim_hazard_addrs = self._hazard_footprint(desc, waitfor)
             if inline:  # the caller is about to block on the handle anyway
                 self._poll_completion(desc, call_id, handle)
             else:
-                threading.Thread(target=self._poll_completion,
-                                 args=(desc, call_id, handle),
-                                 daemon=True).start()
+                # single FIFO completion worker on the dedicated wait
+                # connection (daemon retirement is FIFO, so head-of-queue
+                # waiting is optimal — and per-call poller threads used
+                # to contend with submissions on the command socket)
+                if self._completion_q is None:
+                    self._completion_q = queue.Queue()
+                    threading.Thread(target=self._completion_loop,
+                                     daemon=True).start()
+                self._completion_q.put((desc, call_id, handle))
         except Exception as exc:  # noqa: BLE001
             handle.complete(int(ErrorCode.CONNECTION_CLOSED),
                             exception=exc)
 
-    def _submit(self, desc: CallDescriptor) -> int:
+    def _call_body(self, desc: CallDescriptor,
+                   waitfor_ids: Sequence[int]) -> bytes:
         cfg = desc.arithcfg
         if cfg is not None:
             ud, cd = P.dtype_code(cfg.uncompressed_dtype), \
                 P.dtype_code(cfg.compressed_dtype)
         else:
             ud = cd = P.DTYPE_CODES["float32"]
-        body = P.pack_call(int(desc.scenario), int(desc.function),
+        return P.pack_call(int(desc.scenario), int(desc.function),
                            int(desc.compression), int(desc.stream_flags),
                            ud, cd, desc.count, desc.comm_id,
                            desc.root_src_dst,
                            desc.tag & 0xFFFFFFFF,
                            desc.addr_0 or 0, desc.addr_1 or 0,
-                           desc.addr_2 or 0, [],
+                           desc.addr_2 or 0, list(waitfor_ids),
                            algorithm=int(desc.algorithm))
-        reply = self._request(body)
+
+    def _submit(self, desc: CallDescriptor,
+                waitfor_ids: Sequence[int] = ()) -> int:
+        reply = self._request(self._call_body(desc, waitfor_ids))
         assert reply[0] == P.MSG_CALL_ID
         return struct.unpack("<I", reply[1:5])[0]
 
     def _poll_completion(self, desc: CallDescriptor, call_id: int,
                          handle: CallHandle):
-        """Poll MSG_WAIT with short budgets so the shared command socket is
-        never monopolized by one outstanding call (a blocking WAIT would
-        serialize — and deadlock symmetric recv-then-send programs)."""
+        """Inline (synchronous-call) completion on the shared command
+        socket: short MSG_WAIT budgets so it is never monopolized by one
+        outstanding call (a blocking WAIT would serialize — and deadlock
+        symmetric recv-then-send programs)."""
         try:
             while True:
                 err = self._request_status(
@@ -279,13 +532,69 @@ class SimDevice(Device):
                     struct.pack("<Id", call_id, 0.05))
                 if err != P.STATUS_PENDING:
                     break
-            if not err:
-                res_addr = desc.addr_2 or (
-                    desc.addr_0 if desc.scenario == CCLOp.bcast else 0)
-                if res_addr:
-                    b = self._resolve_buffer(res_addr)
-                    if b is not None:
-                        self.sync_from_device(b)
-            handle.complete(err)
+            self._finish_call(desc, err, handle, self._request)
         except Exception as exc:  # noqa: BLE001
             handle.complete(int(ErrorCode.CONNECTION_CLOSED), exception=exc)
+
+    def _completion_loop(self):
+        """FIFO completion worker on the dedicated wait connection.
+        Drains its queue and pipelines a batch of MSG_WAITs in one write:
+        the daemon's connection thread blocks per wait until the call
+        retires and streams the replies back in retirement order — the
+        client just reads them. Long budgets are fine here (MSG_WAIT
+        returns the moment the call retires; nothing else uses this
+        socket)."""
+        while True:
+            item = self._completion_q.get()
+            if item is None:
+                return
+            batch = [item]
+            while len(batch) < 64:
+                try:
+                    nxt = self._completion_q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._completion_q.put(None)
+                    break
+                batch.append(nxt)
+            pending = batch
+            try:
+                while pending:
+                    # only the HEAD wait carries a blocking budget: FIFO
+                    # retirement means once the head retires the daemon
+                    # answers the zero-budget probes for the rest
+                    # immediately (a budget per entry would serialize a
+                    # full second per still-pending call)
+                    replies = self._request_many_wait_sock([
+                        bytes([P.MSG_WAIT]) +
+                        struct.pack("<Id", call_id,
+                                    1.0 if i == 0 else 0.0)
+                        for i, (_d, call_id, _h) in enumerate(pending)])
+                    nxt_pending = []
+                    for (desc, call_id, handle), reply in zip(pending,
+                                                              replies):
+                        assert reply[0] == P.MSG_STATUS, reply[0]
+                        err = struct.unpack("<I", reply[1:5])[0]
+                        if err == P.STATUS_PENDING:
+                            nxt_pending.append((desc, call_id, handle))
+                            continue
+                        self._finish_call(desc, err, handle,
+                                          self._request_wait_sock)
+                    pending = nxt_pending
+            except Exception as exc:  # noqa: BLE001
+                for _desc, _cid, handle in pending:
+                    if not handle.done():
+                        handle.complete(int(ErrorCode.CONNECTION_CLOSED),
+                                        exception=exc)
+
+    def _finish_call(self, desc: CallDescriptor, err: int,
+                     handle: CallHandle, request):
+        """Result readback (over the given connection) + completion."""
+        if not err:
+            res_addr = self._result_addr(desc)
+            if res_addr:
+                b = self._resolve_buffer(res_addr)
+                if b is not None:
+                    self.sync_from_device(b, request=request)
+        handle.complete(err)

@@ -422,6 +422,11 @@ class RankDaemon:
         # async call tracking (hostctrl ap_ctrl_chain parity)
         self._next_call_id = 1
         self._call_status: dict[int, int | None] = {}
+        # failed calls persist past their MSG_WAIT (which pops the
+        # status): a call chained via wire waitfor must observe its
+        # dependency's failure even after the client polled it. Bounded
+        # FIFO — ancient failures age out.
+        self._failed_calls: dict[int, int] = {}
         self._call_cv = threading.Condition()
         self._call_queue: list[tuple[int, dict]] = []
         self._stop = threading.Event()
@@ -443,13 +448,29 @@ class RankDaemon:
                 if self._stop.is_set():
                     return
                 call_id, c = self._call_queue.pop(0)
-            t0 = time.perf_counter()
-            err = self._execute(c)
-            if self.profiling and c["scenario"] != int(CCLOp.config):
-                self.profiled_calls += 1
-                self.profile_time += time.perf_counter() - t0
+            # waitfor error propagation: the single worker retires FIFO,
+            # so every wire-waitfor dependency has already retired — if
+            # one failed, this call must not execute (in-process tier
+            # parity: the worker's dep.wait raises)
+            err = None
+            for dep in c.get("waitfor", ()):
+                dep_err = self._failed_calls.get(dep)
+                if dep_err:
+                    err = dep_err
+                    break
+            if err is None:
+                t0 = time.perf_counter()
+                err = self._execute(c)
+                if self.profiling and c["scenario"] != int(CCLOp.config):
+                    self.profiled_calls += 1
+                    self.profile_time += time.perf_counter() - t0
             with self._call_cv:
                 self._call_status[call_id] = err
+                if err:
+                    self._failed_calls[call_id] = err
+                    while len(self._failed_calls) > 1024:
+                        self._failed_calls.pop(
+                            next(iter(self._failed_calls)))
                 self._call_cv.notify_all()
 
     def _execute(self, c: dict) -> int:
@@ -683,6 +704,11 @@ class RankDaemon:
             with self._call_cv:
                 call_id = self._next_call_id
                 self._next_call_id += 1
+                # WAITFOR_PREV resolves under the id-assignment lock:
+                # "the call enqueued immediately before this one"
+                if any(w == P.WAITFOR_PREV for w in c["waitfor"]):
+                    c["waitfor"] = [call_id - 1 if w == P.WAITFOR_PREV
+                                    else w for w in c["waitfor"]]
                 self._call_status[call_id] = None
                 # waitfor ordering: the single worker retires in FIFO order,
                 # and waitfor ids always reference earlier calls

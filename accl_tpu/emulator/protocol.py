@@ -134,11 +134,48 @@ def recv_frame(sock: socket.socket) -> bytes:
     return recv_exact(sock, length)
 
 
+def send_frames(sock: socket.socket, bodies: list[bytes]):
+    """Coalesce several small frames into one write — pipelined
+    request/reply: the peer serves them in order, so the sender then
+    reads ``len(bodies)`` replies. The transport trick behind batched
+    chain submission (each frame round-tripped alone costs a syscall
+    pair + GIL wakeup per link)."""
+    out = bytearray()
+    for body in bodies:
+        out += struct.pack("<I", len(body))
+        out += body
+    sock.sendall(out)
+
+
+def recv_frame_file(f) -> bytes:
+    """recv_frame over a buffered reader (``sock.makefile('rb')``) — many
+    pipelined replies arrive in one TCP segment; a buffered reader turns
+    them into ~one syscall instead of two per frame."""
+    hdr = f.read(4)
+    if len(hdr) < 4:
+        raise ConnectionError("connection closed mid-frame")
+    (length,) = struct.unpack("<I", hdr)
+    if length > MAX_FRAME_LEN:
+        raise ConnectionError(f"frame length {length} exceeds protocol max")
+    body = f.read(length)
+    if len(body) < length:
+        raise ConnectionError("connection closed mid-frame")
+    return body
+
+
 # -- call descriptor --------------------------------------------------------
 # scenario u8, func u8, compression u8, stream u8, udtype u8, cdtype u8,
 # algorithm u8, pad u8, count u64, comm_id u32, root u32, tag u32,
 # addr0 u64, addr1 u64, addr2 u64, n_waitfor u16 + waitfor ids (u32 each)
 _CALL_FMT = "<8BQ3I3QH"
+
+# Relative waitfor id: "the call enqueued immediately before this one on
+# this daemon". Lets a client pipeline a batch of chained MSG_CALLs in
+# one write — absolute ids of in-batch dependencies aren't known until
+# the replies arrive. Well-defined daemon-side (resolved at enqueue,
+# under the same lock that assigns ids); with the one-driver-per-daemon
+# deployment model the previous enqueued call IS the chain dependency.
+WAITFOR_PREV = 0xFFFFFFFF
 
 
 def pack_call(scenario: int, func: int, compression: int, stream: int,

@@ -1,0 +1,131 @@
+"""Chained-call overhead: N-deep ``waitfor`` chains vs isolated calls.
+
+Parity: the reference benchmarks chained async calls — warmup nops, then
+an N-deep ap_ctrl_chain of nops timed wall-clock/N (test/host/
+test.py:934-950; the chain itself is hostctrl.cpp:56-90). The equivalent
+here is ``run_async=True`` + ``waitfor=[prev]`` through each tier's call
+path. The number that matters is **per-link overhead**: a pipelined
+transport submits every link without waiting for the previous link's
+host-visible completion, so chained p50/link should be well under the
+isolated-call p50 (the daemon tiers got this via wire waitfor ids +
+daemon-side FIFO retirement/error propagation).
+
+Run:  python -m benchmarks.chained [--depth 256] [--reps 30]
+                                   [--out benchmarks/results]
+Writes ``chained.csv`` (CSV_FIELDS schema; seconds_per_op = per-link
+p50, nbytes = 0 for nops) and prints a table.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+
+import numpy as np
+
+from .sweep import SweepResult
+
+
+def _p50(samples: list[float]) -> float:
+    return sorted(samples)[len(samples) // 2]
+
+
+def measure_accl(a, depth: int = 256, reps: int = 30
+                 ) -> tuple[float, float]:
+    """(isolated p50, chained p50 per link) for one driver instance.
+
+    The two modes are measured INTERLEAVED (a few isolated calls, one
+    chain, repeat) so scheduler/frequency drift hits both equally —
+    back-to-back blocks made the ratio swing run to run."""
+    for _ in range(8):
+        a.nop()  # warmup (reference: warmup nops before timing)
+    iso: list[float] = []
+    chained: list[float] = []
+    for _ in range(reps):
+        for _ in range(4):
+            t0 = time.perf_counter()
+            a.nop()
+            iso.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        h = a.nop(run_async=True)
+        for _ in range(depth - 1):
+            h = a.nop(run_async=True, waitfor=[h])
+        h.wait()
+        chained.append((time.perf_counter() - t0) / depth)
+    return _p50(iso), _p50(chained)
+
+
+def _rows_for(tier: str, a, depth: int, reps: int) -> list[dict]:
+    iso, link = measure_accl(a, depth, reps)
+    mk = lambda name, t: {  # noqa: E731
+        "collective": name, "algorithm": "chain", "world": 1,
+        "dtype": "", "wire_dtype": "", "nbytes": 0,
+        "seconds_per_op": t, "bus_gbps": 0.0, "tier": tier,
+    }
+    print(f"{tier:<16} isolated {iso * 1e6:8.1f} us   "
+          f"chained/link {link * 1e6:8.1f} us   "
+          f"ratio {link / iso:.2f}")
+    return [mk("nop_isolated", iso), mk("nop_chained_link", link)]
+
+
+def run(depth: int = 256, reps: int = 30) -> SweepResult:
+    rows = []
+
+    # in-process emulator tier
+    from accl_tpu.testing import emu_world
+    accls = emu_world(1)
+    try:
+        rows += _rows_for("emu", accls[0], depth, reps)
+    finally:
+        accls[0].deinit()
+
+    # Python daemon tier
+    from accl_tpu.testing import sim_world
+    accls = sim_world(1)
+    try:
+        rows += _rows_for("daemon-python", accls[0], depth, reps)
+    finally:
+        accls[0].deinit()
+
+    # C++ daemon tier (same SimDevice client, native server)
+    native = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "cclo_emud")
+    if os.path.exists(native):
+        from accl_tpu import ACCL
+        from accl_tpu.communicator import Communicator, Rank
+        from accl_tpu.device.sim import SimDevice
+        from accl_tpu.testing import free_port_base
+        port_base = free_port_base()
+        proc = subprocess.Popen(
+            [native, "--rank", "0", "--world", "1",
+             "--port-base", str(port_base)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            time.sleep(0.3)
+            dev = SimDevice("127.0.0.1", port_base)
+            a = ACCL(dev, Communicator(
+                ranks=[Rank(host="127.0.0.1", port=port_base,
+                            global_rank=0)], local_rank=0))
+            rows += _rows_for("daemon-native", a, depth, reps)
+            a.deinit()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+    else:
+        print("daemon-native skipped (make -C native first)")
+
+    return SweepResult(rows)
+
+
+if __name__ == "__main__":
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--depth", type=int, default=256)
+    ap.add_argument("--reps", type=int, default=30)
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args()
+    res = run(args.depth, args.reps)
+    if args.out:
+        res.to_csv(os.path.join(args.out, "chained.csv"))
+        print(f"wrote {os.path.join(args.out, 'chained.csv')}")

@@ -1132,19 +1132,46 @@ class RankDaemon {
         call_queue_.pop_front();
       }
       uint8_t scenario = job.second.empty() ? OP_NOP : job.second[0];
-      uint32_t err;
-      try {
-        err = run_call(job.second);
-      } catch (const std::exception& e) {
-        // a hostile/buggy descriptor (absurd count -> bad_alloc, ...)
-        // must retire as an error, not terminate the daemon
-        std::fprintf(stderr, "call %u failed: %s\n", job.first, e.what());
-        err = E_INVALID;
+      // waitfor error propagation (FIFO retirement means every wire
+      // dependency already retired): a failed dependency fails this
+      // call without executing it. Failed ids persist in a bounded map
+      // past their MSG_WAIT (which erases call_status_), mirroring the
+      // Python daemon.
+      uint32_t err = E_OK;
+      if (job.second.size() >= 54) {
+        uint16_t nw = get_le<uint16_t>(job.second.data() + 52);
+        size_t off = 54;
+        std::lock_guard<std::mutex> lk(call_mu_);
+        for (uint16_t i = 0; i < nw && off + 4 <= job.second.size();
+             ++i, off += 4) {
+          auto it = failed_calls_.find(
+              get_le<uint32_t>(job.second.data() + off));
+          if (it != failed_calls_.end()) { err = it->second; break; }
+        }
       }
-      if (profiling_ && scenario != OP_CONFIG) profiled_calls_++;
+      if (err == E_OK) {
+        try {
+          err = run_call(job.second);
+        } catch (const std::exception& e) {
+          // a hostile/buggy descriptor (absurd count -> bad_alloc, ...)
+          // must retire as an error, not terminate the daemon
+          std::fprintf(stderr, "call %u failed: %s\n", job.first,
+                       e.what());
+          err = E_INVALID;
+        }
+        // only EXECUTED calls count (Python daemon parity): a call
+        // skipped for a failed dependency must not skew per-call
+        // profile averages
+        if (profiling_ && scenario != OP_CONFIG) profiled_calls_++;
+      }
       {
         std::lock_guard<std::mutex> lk(call_mu_);
         call_status_[job.first] = err;
+        if (err != E_OK) {
+          failed_calls_.emplace(job.first, err);
+          while (failed_calls_.size() > 1024)
+            failed_calls_.erase(failed_calls_.begin());
+        }
         call_cv_.notify_all();
       }
     }
@@ -1327,6 +1354,7 @@ class RankDaemon {
   // calls
   std::deque<std::pair<uint32_t, std::vector<uint8_t>>> call_queue_;
   std::map<uint32_t, uint32_t> call_status_;
+  std::map<uint32_t, uint32_t> failed_calls_;  // persists past MSG_WAIT
   uint32_t next_call_id_ = 1;
   std::mutex call_mu_;
   std::condition_variable call_cv_;
@@ -1833,8 +1861,24 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
     case MSG_CALL: {
       std::lock_guard<std::mutex> lk(call_mu_);
       uint32_t id = next_call_id_++;
-      call_queue_.emplace_back(
-          id, std::vector<uint8_t>(body.begin() + 1, body.end()));
+      std::vector<uint8_t> desc(body.begin() + 1, body.end());
+      // WAITFOR_PREV (0xFFFFFFFF) resolves under the id-assignment
+      // lock: "the call enqueued immediately before this one"
+      if (desc.size() >= 54) {
+        uint16_t nw = get_le<uint16_t>(desc.data() + 52);
+        size_t off = 54;
+        for (uint16_t i = 0; i < nw && off + 4 <= desc.size();
+             ++i, off += 4) {
+          if (get_le<uint32_t>(desc.data() + off) == 0xFFFFFFFFu) {
+            uint32_t prev = id - 1;  // store LE like every wire field
+            desc[off] = static_cast<uint8_t>(prev);
+            desc[off + 1] = static_cast<uint8_t>(prev >> 8);
+            desc[off + 2] = static_cast<uint8_t>(prev >> 16);
+            desc[off + 3] = static_cast<uint8_t>(prev >> 24);
+          }
+        }
+      }
+      call_queue_.emplace_back(id, std::move(desc));
       call_cv_.notify_all();
       std::vector<uint8_t> reply{MSG_CALL_ID};
       put_le<uint32_t>(reply, id);

@@ -296,3 +296,64 @@ def test_overlapped_sends_then_recvs(world):
 
     res = run_ranks(world, fn)
     assert res[0] == 2.0 and res[1] == 1.0
+
+
+def test_deep_pipelined_chain_data_dependency(world):
+    """An N-deep combine chain whose operands are all the dependency's
+    RESULT flows through the wire-waitfor pipeline (batched submission +
+    daemon-side FIFO): acc doubles every link."""
+    a = world[0]
+    depth = 16
+    acc = a.buffer(data=np.full(8, 1.0, np.float32))
+    h = None
+    for _ in range(depth):
+        kw = {"waitfor": [h]} if h is not None else {}
+        h = a.combine(8, ReduceFunc.SUM, acc, acc, acc, run_async=True,
+                      **kw)
+    h.wait()
+    acc.sync_from_device()
+    np.testing.assert_allclose(acc.data, np.full(8, float(2 ** depth)))
+
+
+def test_chain_operand_hazard_falls_back(world):
+    """A chain link whose operand aliases the pending dependency's INPUT
+    (not its result) must not push the mirror early: the dependency
+    reads its submission-time value, the dependent reads its own. The
+    classic reuse pattern: call, mutate the buffer, chained call."""
+    a = world[0]
+    import time
+    x = a.buffer(data=np.full(8, 1.0, np.float32))
+    out1 = a.buffer((8,), np.float32)
+    out2 = a.buffer((8,), np.float32)
+    h1 = a.copy(x, out1, run_async=True)
+    # wait for h1's dispatch to have pushed its operand mirror (the
+    # async dispatch itself races host mutations — pre-existing
+    # submission-time semantics); the hazard under test is ONLY h2's
+    # pipelined push overtaking h1's execution
+    deadline = time.monotonic() + 5.0
+    while getattr(h1, "sim_call_id", None) is None:
+        assert time.monotonic() < deadline, "h1 never submitted"
+        time.sleep(0.0005)
+    x.data[:] = 5.0  # mutated AFTER h1's submission
+    h2 = a.copy(x, out2, run_async=True, waitfor=[h1])
+    h2.wait(10)
+    h1.wait(10)
+    out1.sync_from_device()
+    out2.sync_from_device()
+    np.testing.assert_allclose(out1.data, np.full(8, 1.0))
+    np.testing.assert_allclose(out2.data, np.full(8, 5.0))
+
+
+def test_chain_error_propagates_through_daemon(world):
+    """A failed link fails every dependent link daemon-side (the failed-
+    call map consulted by the worker), without executing them."""
+    a = world[0]
+    x = a.buffer(data=np.ones(8, np.float32))
+    out = a.buffer((8,), np.float32)
+    # an invalid call: recv from an out-of-range rank errors daemon-side
+    h1 = a.recv(x, 8, src=3999, run_async=True)
+    h2 = a.copy(x, out, run_async=True, waitfor=[h1])
+    h3 = a.copy(out, x, run_async=True, waitfor=[h2])
+    with pytest.raises(ACCLError):
+        h3.wait(10)
+    assert h3.error_word != 0
