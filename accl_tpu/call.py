@@ -109,12 +109,41 @@ class CallHandle:
         return self._error_word
 
 
+class _AlwaysSet:
+    """Event stand-in for already-retired handles (no lock allocation:
+    a CompletedHandle is built for EVERY synchronous call, and the
+    Event+lock pair showed up in the sim-tier latency profile)."""
+
+    @staticmethod
+    def wait(timeout=None) -> bool:
+        return True
+
+    @staticmethod
+    def is_set() -> bool:
+        return True
+
+    @staticmethod
+    def set():
+        pass
+
+
+_ALWAYS_SET = _AlwaysSet()
+_SHARED_CB_LOCK = threading.Lock()  # uncontended: callbacks of retired
+#                                     handles run immediately
+
+
 class CompletedHandle(CallHandle):
     """A handle for synchronously-executed calls (already retired)."""
 
-    def __init__(self, error_word: int = 0, result: Any = None, context: str = ""):
-        super().__init__(context)
-        self.complete(error_word, result)
+    def __init__(self, error_word: int = 0, result: Any = None,
+                 context: str = ""):
+        self._done = _ALWAYS_SET
+        self._error_word = int(error_word)
+        self._result = result
+        self._exception = None
+        self._callbacks: list = []
+        self._cb_lock = _SHARED_CB_LOCK
+        self.context = context
 
 
 def wait_all(handles: Sequence[CallHandle], timeout: float | None = None):

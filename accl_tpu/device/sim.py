@@ -466,6 +466,7 @@ class SimDevice(Device):
                 except ACCLError as exc:
                     handle.complete(exc.error_word, exception=exc)
                     return
+            sync_bufs = []
             for addr in (desc.addr_0, desc.addr_1):
                 if addr:
                     b = self._resolve_buffer(addr)
@@ -473,25 +474,33 @@ class SimDevice(Device):
                     # devicemem; pushing the stale host mirror would race
                     # the dependency's execution and clobber its result
                     if b is not None and b not in dep_result_bufs:
-                        self.sync_to_device(b)
+                        sync_bufs.append(b)
+            if inline:
+                # Fully fused synchronous call: operand pushes + submit +
+                # first wait + speculative result readback go out as ONE
+                # pipelined write and the replies stream back — 1 client
+                # round trip instead of 3-4 serialized ones (the Python
+                # daemon's latency floor was dominated by exactly these).
+                self._inline_fused(desc, wire_waitfor, sync_bufs, handle,
+                                   waitfor)
+                return
+            for b in sync_bufs:
+                self.sync_to_device(b)
             call_id = self._submit(desc, wire_waitfor)
             handle.sim_call_id = call_id
             handle.sim_device = self
             handle.sim_result_addr = self._result_addr(desc)
             handle.sim_operand_addrs = (desc.addr_0, desc.addr_1)
             handle.sim_hazard_addrs = self._hazard_footprint(desc, waitfor)
-            if inline:  # the caller is about to block on the handle anyway
-                self._poll_completion(desc, call_id, handle)
-            else:
-                # single FIFO completion worker on the dedicated wait
-                # connection (daemon retirement is FIFO, so head-of-queue
-                # waiting is optimal — and per-call poller threads used
-                # to contend with submissions on the command socket)
-                if self._completion_q is None:
-                    self._completion_q = queue.Queue()
-                    threading.Thread(target=self._completion_loop,
-                                     daemon=True).start()
-                self._completion_q.put((desc, call_id, handle))
+            # single FIFO completion worker on the dedicated wait
+            # connection (daemon retirement is FIFO, so head-of-queue
+            # waiting is optimal — and per-call poller threads used
+            # to contend with submissions on the command socket)
+            if self._completion_q is None:
+                self._completion_q = queue.Queue()
+                threading.Thread(target=self._completion_loop,
+                                 daemon=True).start()
+            self._completion_q.put((desc, call_id, handle))
         except Exception as exc:  # noqa: BLE001
             handle.complete(int(ErrorCode.CONNECTION_CLOSED),
                             exception=exc)
@@ -518,6 +527,67 @@ class SimDevice(Device):
         reply = self._request(self._call_body(desc, waitfor_ids))
         assert reply[0] == P.MSG_CALL_ID
         return struct.unpack("<I", reply[1:5])[0]
+
+    def _inline_fused(self, desc: CallDescriptor, wire_waitfor,
+                      sync_bufs, handle: CallHandle, waitfor):
+        """One-round-trip synchronous call: pipeline [operand pushes,
+        MSG_CALL, MSG_WAIT(budget), MSG_READ_MEM(result)] in a single
+        write; the daemon's connection thread executes them in order
+        (the WAIT blocks it until the call retires) and streams the
+        replies. A PENDING first wait falls back to the budget-polling
+        loop; the speculative readback is discarded on error or PENDING
+        (stale bytes, never used)."""
+        res_addr = self._result_addr(desc)
+        res_buf = self._resolve_buffer(res_addr) if res_addr else None
+        frames = [bytes([P.MSG_WRITE_MEM]) + struct.pack("<Q", b.address)
+                  + b.data.reshape(-1).view("uint8").tobytes()
+                  for b in sync_bufs]
+        frames.append(self._call_body(desc, wire_waitfor))
+        # WAIT_LAST sentinel: the wait names "the call this connection
+        # just submitted", so the entire sequence ships in ONE write and
+        # the client blocks exactly once, reading the reply stream
+        frames.append(bytes([P.MSG_WAIT]) +
+                      struct.pack("<Id", P.WAIT_LAST, 0.25))
+        if res_buf is not None:
+            frames.append(bytes([P.MSG_READ_MEM]) + struct.pack(
+                "<2Q", res_buf.address, res_buf.nbytes))
+        sync_err = 0
+        with self._lock:
+            P.send_frames(self.sock, frames)
+            for _ in sync_bufs:
+                reply = P.recv_frame_file(self._rfile)
+                assert reply[0] == P.MSG_STATUS
+                sync_err |= struct.unpack("<I", reply[1:5])[0]
+            reply = P.recv_frame_file(self._rfile)
+            assert reply[0] == P.MSG_CALL_ID
+            call_id = struct.unpack("<I", reply[1:5])[0]
+            wait_reply = P.recv_frame_file(self._rfile)
+            data_reply = (P.recv_frame_file(self._rfile)
+                          if res_buf is not None else None)
+        handle.sim_call_id = call_id
+        handle.sim_device = self
+        handle.sim_result_addr = res_addr
+        handle.sim_operand_addrs = (desc.addr_0, desc.addr_1)
+        handle.sim_hazard_addrs = self._hazard_footprint(desc, waitfor)
+        if sync_err:
+            # an operand push failed after the call was already
+            # pipelined; surface the push error (the call's own result
+            # is meaningless on stale operands)
+            handle.complete(sync_err)
+            return
+        assert wait_reply[0] == P.MSG_STATUS
+        err = struct.unpack("<I", wait_reply[1:5])[0]
+        if err == P.STATUS_PENDING:
+            # slow call (blocking recv, big collective): budget-poll as
+            # before; the speculative readback is repeated post-success
+            self._poll_completion(desc, call_id, handle)
+            return
+        if not err and data_reply is not None:
+            assert data_reply[0] == P.MSG_DATA
+            import numpy as np
+            flat = res_buf.data.reshape(-1).view(np.uint8)
+            flat[:] = np.frombuffer(data_reply[1:], np.uint8)
+        handle.complete(err)
 
     def _poll_completion(self, desc: CallDescriptor, call_id: int,
                          handle: CallHandle):

@@ -421,6 +421,10 @@ class RankDaemon:
         self.mem.register(self._barrier_addr, self._barrier_scratch)
         # async call tracking (hostctrl ap_ctrl_chain parity)
         self._next_call_id = 1
+        # >0 while the worker (or an inline conn-thread execution) is
+        # running a call: the conn-thread fast path may only run when
+        # global FIFO order is provable (queue empty + nothing running)
+        self._executing = 0
         self._call_status: dict[int, int | None] = {}
         # failed calls persist past their MSG_WAIT (which pops the
         # status): a call chained via wire waitfor must observe its
@@ -443,11 +447,16 @@ class RankDaemon:
     def _call_worker(self):
         while not self._stop.is_set():
             with self._call_cv:
-                while not self._call_queue and not self._stop.is_set():
+                # also parks while a conn-thread inline execution is in
+                # flight: two calls running concurrently would break the
+                # FIFO retirement contract (and share the executor)
+                while (not self._call_queue or self._executing) \
+                        and not self._stop.is_set():
                     self._call_cv.wait(0.5)
                 if self._stop.is_set():
                     return
                 call_id, c = self._call_queue.pop(0)
+                self._executing += 1
             # waitfor error propagation: the single worker retires FIFO,
             # so every wire-waitfor dependency has already retired — if
             # one failed, this call must not execute (in-process tier
@@ -465,13 +474,17 @@ class RankDaemon:
                     self.profiled_calls += 1
                     self.profile_time += time.perf_counter() - t0
             with self._call_cv:
-                self._call_status[call_id] = err
-                if err:
-                    self._failed_calls[call_id] = err
-                    while len(self._failed_calls) > 1024:
-                        self._failed_calls.pop(
-                            next(iter(self._failed_calls)))
-                self._call_cv.notify_all()
+                self._executing -= 1
+                self._record_status(call_id, err)
+
+    def _record_status(self, call_id: int, err: int):
+        """Caller holds _call_cv."""
+        self._call_status[call_id] = err
+        if err:
+            self._failed_calls[call_id] = err
+            while len(self._failed_calls) > 1024:
+                self._failed_calls.pop(next(iter(self._failed_calls)))
+        self._call_cv.notify_all()
 
     def _execute(self, c: dict) -> int:
         try:
@@ -624,11 +637,14 @@ class RankDaemon:
 
     def _serve_conn(self, conn: socket.socket):
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # per-connection state for the WAIT_LAST sentinel: the id of the
+        # last MSG_CALL this connection submitted
+        conn_state = {"last_call_id": 0}
         try:
             while True:
                 body = P.recv_frame(conn)
                 try:
-                    reply = (self._handle(body) if body
+                    reply = (self._handle(body, conn_state) if body
                              else P.status_reply(int(ErrorCode.INVALID_CALL)))
                 except Exception:  # noqa: BLE001 — truncated/garbage frame
                     # must get an error reply, not a dead connection; log
@@ -652,7 +668,7 @@ class RankDaemon:
             except OSError:
                 pass
 
-    def _handle(self, body: bytes) -> bytes:
+    def _handle(self, body: bytes, conn_state: dict | None = None) -> bytes:
         kind = body[0]
         if kind == P.MSG_PING:
             return P.status_reply(0)
@@ -710,13 +726,42 @@ class RankDaemon:
                     c["waitfor"] = [call_id - 1 if w == P.WAITFOR_PREV
                                     else w for w in c["waitfor"]]
                 self._call_status[call_id] = None
-                # waitfor ordering: the single worker retires in FIFO order,
-                # and waitfor ids always reference earlier calls
-                self._call_queue.append((call_id, c))
-                self._call_cv.notify_all()
+                # Conn-thread fast path: retire the call right here when
+                # FIFO order is provable (nothing queued or running) —
+                # skipping two worker handoffs, and the client's
+                # MSG_WAIT answers instantly. Blocking ops (recv waiting
+                # on ingress, collectives rendezvousing peers) stall
+                # only the MSG_CALL reply — semantics-preserving, since
+                # the FIFO worker would have serialized every later call
+                # of this rank behind them anyway; ingress and the wait
+                # connection are served by other threads.
+                inline = (not c["waitfor"] and not self._call_queue
+                          and not self._executing)
+                if inline:
+                    self._executing += 1
+                else:
+                    # waitfor ordering: the single worker retires in
+                    # FIFO order; waitfor ids reference earlier calls
+                    self._call_queue.append((call_id, c))
+                    self._call_cv.notify_all()
+            if inline:
+                t0 = time.perf_counter()
+                err = self._execute(c)
+                if self.profiling and c["scenario"] != int(CCLOp.config):
+                    self.profiled_calls += 1
+                    self.profile_time += time.perf_counter() - t0
+                with self._call_cv:
+                    self._executing -= 1
+                    self._record_status(call_id, err)
+            if conn_state is not None:
+                conn_state["last_call_id"] = call_id
             return bytes([P.MSG_CALL_ID]) + struct.pack("<I", call_id)
         if kind == P.MSG_WAIT:
             (call_id,) = struct.unpack("<I", body[1:5])
+            if call_id == P.WAIT_LAST and conn_state is not None:
+                # "the last call THIS connection submitted" — lets the
+                # client pipeline call+wait in one write (protocol.py)
+                call_id = conn_state["last_call_id"]
             budget = _sane_budget(
                 struct.unpack("<d", body[5:13])[0] if len(body) >= 13
                 else self.timeout)

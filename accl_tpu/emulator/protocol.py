@@ -177,6 +177,14 @@ _CALL_FMT = "<8BQ3I3QH"
 # deployment model the previous enqueued call IS the chain dependency.
 WAITFOR_PREV = 0xFFFFFFFF
 
+# Same trick for MSG_WAIT: id 0xFFFFFFFF = "the last call id assigned on
+# THIS connection" (tracked per serving connection). A synchronous call
+# then pipelines [pushes..., MSG_CALL, MSG_WAIT, MSG_READ_MEM] in ONE
+# write and just reads the replies — the client never blocks mid-
+# sequence to learn the id, which removes a full wake/round-trip from
+# the latency floor.
+WAIT_LAST = 0xFFFFFFFF
+
 
 def pack_call(scenario: int, func: int, compression: int, stream: int,
               udtype: int, cdtype: int, count: int, comm_id: int, root: int,

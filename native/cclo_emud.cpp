@@ -1314,7 +1314,8 @@ class RankDaemon {
 
   // ---- command connection ----
   void serve_conn(int fd);
-  std::vector<uint8_t> handle(const std::vector<uint8_t>& body);
+  std::vector<uint8_t> handle(const std::vector<uint8_t>& body,
+                              uint32_t* last_call_id = nullptr);
 
   uint32_t rank_, world_;
   uint16_t port_base_;
@@ -1736,11 +1737,14 @@ int RankDaemon::serve(uint16_t cmd_port) {
 
 void RankDaemon::serve_conn(int fd) {
   std::vector<uint8_t> body;
+  // per-connection state: the id of the last MSG_CALL this connection
+  // submitted (the MSG_WAIT WAIT_LAST sentinel, protocol.py)
+  uint32_t last_call_id = 0;
   while (recv_frame(fd, body)) {
     if (body.empty()) break;
     std::vector<uint8_t> reply;
     try {
-      reply = handle(body);
+      reply = handle(body, &last_call_id);
     } catch (const std::exception& e) {
       // any throwing handler (bad_alloc included) answers with an error
       // instead of terminating the daemon (parity with the Python
@@ -1764,7 +1768,8 @@ void RankDaemon::serve_conn(int fd) {
   ::close(fd);
 }
 
-std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
+std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
+                                        uint32_t* last_call_id) {
   const uint8_t kind = body[0];
   const uint8_t* p = body.data() + 1;
   const size_t len = body.size() - 1;  // payload bytes after the kind
@@ -1880,12 +1885,15 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body) {
       }
       call_queue_.emplace_back(id, std::move(desc));
       call_cv_.notify_all();
+      if (last_call_id) *last_call_id = id;
       std::vector<uint8_t> reply{MSG_CALL_ID};
       put_le<uint32_t>(reply, id);
       return reply;
     }
     case MSG_WAIT: {
       uint32_t id = get_le<uint32_t>(p);
+      if (id == 0xFFFFFFFFu && last_call_id)  // WAIT_LAST sentinel
+        id = *last_call_id;
       double budget = timeout_;
       if (body.size() >= 13) std::memcpy(&budget, p + 4, 8);
       std::unique_lock<std::mutex> lk(call_mu_);
