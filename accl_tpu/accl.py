@@ -47,9 +47,9 @@ class ACCL:
                  max_segment_size: int | None = None,
                  arith_registry=None):
         self.device = device
+        self._arith_memo: dict[frozenset, object] = {}
         self.arith_registry = (arith_registry if arith_registry is not None
                                else dict(DEFAULT_ARITH_CONFIGS))
-        self._arith_memo: dict[frozenset, object] = {}
         self.communicators: list[Communicator] = []
         self._barrier_buf: ACCLBuffer | None = None
         self._scratch_bufs: dict[tuple[int, str], ACCLBuffer] = {}
@@ -73,6 +73,23 @@ class ACCL:
         return self._scratch_bufs[key]
 
     # -- lifecycle ---------------------------------------------------------
+    @property
+    def arith_registry(self) -> dict:
+        """Arithmetic-config registry. Rebinding it invalidates the
+        resolution memo; for IN-PLACE mutation call
+        :meth:`invalidate_arith_cache` afterwards."""
+        return self._arith_registry
+
+    @arith_registry.setter
+    def arith_registry(self, registry: dict):
+        self._arith_registry = registry
+        self._arith_memo.clear()
+
+    def invalidate_arith_cache(self):
+        """Drop memoized arith-config resolutions (call after mutating
+        ``arith_registry`` in place)."""
+        self._arith_memo.clear()
+
     @property
     def comm(self) -> Communicator:
         return self.communicators[0]
@@ -218,8 +235,9 @@ class ACCL:
         if not dtypes:
             dtypes = {np.dtype(np.float32)}
         # memoized: resolution walks name-sorted registry keys (~15us),
-        # pure in its inputs, and on the per-call hot path. Mutating
-        # arith_registry after construction requires clearing _arith_memo.
+        # pure in its inputs, and on the per-call hot path. Rebinding
+        # arith_registry clears the memo (property setter); in-place
+        # registry mutation must call invalidate_arith_cache().
         # np.dtype hashes/compares in C — the dtype set is its own key.
         mk = frozenset(dtypes)
         cfg = self._arith_memo.get(mk)

@@ -42,6 +42,14 @@ class CallDescriptor:
     addr_0: Any = None                        # op0 buffer / array
     addr_1: Any = None                        # op1 buffer / array
     addr_2: Any = None                        # result buffer / array
+    # Caller-visible ABSOLUTE deadline (time.monotonic() seconds), set by
+    # Device.call_sync at entry — so queue/dependency delay before the
+    # backend examines the call cannot extend it. Host-side only (never
+    # crosses the wire). Backends with parked rendezvous state (TPU tier
+    # deposits) bound that state's lifetime by this, so a call that timed
+    # out for the caller cannot later be completed by late peers and
+    # mutate the caller's buffers.
+    deadline: Any = None
 
 
 class CallHandle:

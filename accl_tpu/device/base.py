@@ -10,7 +10,9 @@ the currency.
 from __future__ import annotations
 
 import abc
+import dataclasses
 import threading
+import time
 from typing import Sequence
 
 from ..buffer import ACCLBuffer
@@ -93,6 +95,14 @@ class Device(abc.ABC):
                   timeout: float | None = None):
         # inline retirement blocks inside call_async and would bypass a
         # local timeout bound, so only hint inline when none is imposed
+        if timeout is not None:
+            # plumb the caller's bound into the descriptor as an ABSOLUTE
+            # deadline (from this moment — queue or dependency delay must
+            # not extend it) so backend rendezvous deadlines (TPU-tier
+            # deposits) honor it: a TimeoutError here must imply the call
+            # will not run later
+            desc = dataclasses.replace(
+                desc, deadline=time.monotonic() + timeout)
         return self.call_async(desc, waitfor,
                                inline_ok=timeout is None).wait(timeout)
 

@@ -52,7 +52,7 @@ import jax.numpy as jnp
 from ..buffer import ACCLBuffer
 from ..call import CallDescriptor, CallHandle
 from ..communicator import Communicator
-from ..constants import (CCLOp, CollectiveAlgorithm, Compression,
+from ..constants import (ACCLError, CCLOp, CollectiveAlgorithm, Compression,
                          DEFAULT_MAX_SEGMENT_SIZE, DEFAULT_TIMEOUT_S,
                          ErrorCode, ReduceFunc, check_algorithm)
 from ..emulator.executor import DeviceMemory
@@ -426,8 +426,16 @@ class TpuDevice(Device):
         not block in call_async)."""
         from ..constants import ACCLError
         try:
+            if (desc.deadline is not None
+                    and time.monotonic() >= desc.deadline):
+                # queued past the caller's bound: the caller's wait already
+                # raised, so executing now would mutate buffers it has
+                # moved on from — fail instead of running late
+                handle.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR))
+                return
             for dep in waitfor:
-                dep.wait(self.timeout)
+                dep.wait(self.timeout if desc.deadline is None
+                         else max(0.0, desc.deadline - time.monotonic()))
             err = self._execute(desc, handle, defer_launch)
             if err is not None:
                 handle.complete(err)
@@ -596,7 +604,8 @@ class TpuDevice(Device):
         src_g = comm.ranks[desc.root_src_dst].global_rank
         me_g = comm.my_global_rank
         key = (desc.comm_id, src_g, me_g)
-        deadline = time.monotonic() + self.timeout
+        deadline = (desc.deadline if desc.deadline is not None
+                    else time.monotonic() + self.timeout)
         with self.ctx._lock:
             while True:
                 payload = self._match_send(key, desc.tag)
@@ -646,7 +655,12 @@ class TpuDevice(Device):
         RECEIVE_TIMEOUT_ERROR per member (the old per-waiter timeout
         semantics)."""
         ctx = self.ctx
-        deadline = time.monotonic() + self.timeout
+        # the deposit's parked lifetime is bounded by the CALLER's absolute
+        # deadline when one was imposed (call_sync timeout plumbed via the
+        # desc, measured from call_sync entry): a collective that timed out
+        # for its caller must not be completed later by late-arriving peers
+        deadline = (desc.deadline if desc.deadline is not None
+                    else time.monotonic() + self.timeout)
         with ctx._lock:
             # index assignment under the ctx lock: deposit order IS the
             # per-rank matching order (MPI program-order matching)
@@ -654,6 +668,18 @@ class TpuDevice(Device):
             self._coll_index[desc.comm_id] += 1
             key = (desc.comm_id, idx)
             group = ctx._pending.setdefault(key, {})
+            # an expired member must not count toward completion (its
+            # caller's wait already raised): fail it here rather than
+            # racing the sweeper's next poll — otherwise a late arrival
+            # could claim the group and mutate the expired caller's
+            # buffers after its timeout
+            now = time.monotonic()
+            for r in [r for r, (_, _, dl) in group.items() if dl <= now]:
+                _, h, _ = group.pop(r)
+                h.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR),
+                           exception=ACCLError(
+                               int(ErrorCode.RECEIVE_TIMEOUT_ERROR),
+                               "collective member deadline expired"))
             group[comm.local_rank] = (desc, handle, deadline)
             is_last = len(group) == comm.size
             if is_last:

@@ -720,10 +720,14 @@ class RankDaemon:
             with self._call_cv:
                 call_id = self._next_call_id
                 self._next_call_id += 1
-                # WAITFOR_PREV resolves under the id-assignment lock:
-                # "the call enqueued immediately before this one"
+                # WAITFOR_PREV resolves to the previous call THIS
+                # connection submitted — not the globally-previous id,
+                # which another connection's interleaved MSG_CALL could
+                # claim and silently become the dependency
                 if any(w == P.WAITFOR_PREV for w in c["waitfor"]):
-                    c["waitfor"] = [call_id - 1 if w == P.WAITFOR_PREV
+                    prev = (conn_state["last_call_id"]
+                            if conn_state is not None else call_id - 1)
+                    c["waitfor"] = [prev if w == P.WAITFOR_PREV
                                     else w for w in c["waitfor"]]
                 self._call_status[call_id] = None
                 # Conn-thread fast path: retire the call right here when

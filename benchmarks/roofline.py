@@ -100,8 +100,11 @@ def allreduce_prediction(size_bytes: float = GiB, n_chips: int = 16,
     t_hbm = hbm_touches * size_bytes / (chip.hbm_gbs * 1e9)
     t = max(t_ici, t_hbm)
     bus_gbs = bus_bytes / t / 1e9
-    line_rate = b_ici  # one definition: injection bandwidth the
-    #                    schedule can use
+    # The north-star target (>=80% of line rate) is defined against the
+    # FULL-torus injection bandwidth — every row uses that denominator,
+    # so a schedule that only drives one axis cannot read as clearing
+    # the target. The per-row usable bandwidth is reported separately.
+    full_line_rate = chip.ici_link_gbs * 2 * (chip.ici_links // 2)
     return {
         "chips": n_chips,
         "size_bytes": int(size_bytes),
@@ -110,8 +113,10 @@ def allreduce_prediction(size_bytes: float = GiB, n_chips: int = 16,
         "bound": "ici" if t_ici >= t_hbm else "hbm",
         "t_pred_ms": round(t * 1e3, 3),
         "bus_gbs_per_chip": round(bus_gbs, 1),
-        "line_rate_gbs": round(line_rate, 1),
-        "fraction_of_line_rate": round(bus_gbs / line_rate, 3),
+        "usable_bw_gbs": round(b_ici, 1),
+        "line_rate_gbs": round(full_line_rate, 1),
+        "fraction_of_line_rate": round(bus_gbs / full_line_rate, 3),
+        "fraction_of_usable": round(bus_gbs / b_ici, 3),
     }
 
 
@@ -124,14 +129,15 @@ def table() -> str:
         allreduce_prediction(size_bytes=GiB / 16),   # 64 MiB
     ]
     hdr = ("chips  size        axes  eta    bound  t_pred    "
-           "GB/s/chip  frac-of-line")
+           "GB/s/chip  frac-of-line  frac-of-usable")
     lines = [hdr]
     for r in rows:
         lines.append(
             f"{r['chips']:>5}  {r['size_bytes']:>10}  {r['axes_used']:>4}"
             f"  {r['eta']:<5}  {r['bound']:<5}"
             f"  {r['t_pred_ms']:>6.2f}ms  {r['bus_gbs_per_chip']:>9}"
-            f"  {r['fraction_of_line_rate']:>10.1%}")
+            f"  {r['fraction_of_line_rate']:>10.1%}"
+            f"  {r['fraction_of_usable']:>12.1%}")
     return "\n".join(lines)
 
 

@@ -1867,15 +1867,16 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
       std::lock_guard<std::mutex> lk(call_mu_);
       uint32_t id = next_call_id_++;
       std::vector<uint8_t> desc(body.begin() + 1, body.end());
-      // WAITFOR_PREV (0xFFFFFFFF) resolves under the id-assignment
-      // lock: "the call enqueued immediately before this one"
+      // WAITFOR_PREV (0xFFFFFFFF) resolves to the previous call THIS
+      // connection submitted — not id-1, which another connection's
+      // interleaved MSG_CALL could claim as its own id
       if (desc.size() >= 54) {
         uint16_t nw = get_le<uint16_t>(desc.data() + 52);
         size_t off = 54;
         for (uint16_t i = 0; i < nw && off + 4 <= desc.size();
              ++i, off += 4) {
           if (get_le<uint32_t>(desc.data() + off) == 0xFFFFFFFFu) {
-            uint32_t prev = id - 1;  // store LE like every wire field
+            uint32_t prev = last_call_id ? *last_call_id : id - 1;
             desc[off] = static_cast<uint8_t>(prev);
             desc[off + 1] = static_cast<uint8_t>(prev >> 8);
             desc[off + 2] = static_cast<uint8_t>(prev >> 16);
