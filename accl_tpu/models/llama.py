@@ -41,6 +41,14 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16      # activation/compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32
+    # "flash": fused Pallas attention (ops.attention) — streaming KV,
+    # native GQA (no repeated-KV copy), fused decode over the cache.
+    # "dense": score-materializing einsum reference path. The GSPMD-
+    # sharded forward (dp/sp axes given) always uses dense: a pallas_call
+    # has no partitioning rule, so under pjit it would force operand
+    # all-gathers; the sharded fused path is parallel.ulysses /
+    # ring_attention (shard_map-wrapped).
+    attention: str = "flash"
 
     @property
     def head_dim(self) -> int:
@@ -154,7 +162,7 @@ class Llama:
             is_leaf=lambda x: isinstance(x, (jnp.ndarray, np.ndarray)))
 
     # -- forward -----------------------------------------------------------
-    def _layer(self, x, layer_params, positions, mask):
+    def _layer(self, x, layer_params, positions, mask, use_flash=False):
         c = self.config
         p = layer_params
         hd, nh, nkv = c.head_dim, c.n_heads, c.n_kv_heads
@@ -166,18 +174,29 @@ class Llama:
         v = (h @ p["wv"].astype(x.dtype)).reshape(B, S, nkv, hd)
         q = _rope(q, positions, c.rope_theta)
         k = _rope(k, positions, c.rope_theta)
-        # GQA: repeat kv heads
-        rep = nh // nkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-        # attention (B, nh, S, hd)
-        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                            preferred_element_type=jnp.float32) * (hd ** -0.5)
-        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-        attn = attn.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
+        if use_flash:
+            # fused path: KV heads stay un-repeated — the kernel's index
+            # maps route each Q head to its KV head (GQA without the
+            # max_len-sized repeat copy); differentiable (custom VJP)
+            from ..ops.attention import flash_attention
+            attn = flash_attention(q.transpose(0, 2, 1, 3),
+                                   k.transpose(0, 2, 1, 3),
+                                   v.transpose(0, 2, 1, 3), causal=True)
+            attn = attn.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
+        else:
+            # GQA: repeat kv heads
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+            # attention (B, nh, S, hd)
+            q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            scores = jnp.einsum(
+                "bhqd,bhkd->bhqk", q, k,
+                preferred_element_type=jnp.float32) * (hd ** -0.5)
+            scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            attn = attn.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
         x = x + attn @ p["wo"].astype(x.dtype)
 
         h = _rms_norm(x, p["mlp_norm"].astype(x.dtype), c.norm_eps)
@@ -196,10 +215,16 @@ class Llama:
         if dp is not None:
             x = jax.lax.with_sharding_constraint(x, P(dp, sp, None))
         positions = jnp.arange(S)
-        mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+        # dense needs the materialized mask; the flash kernel masks
+        # blockwise in VMEM (see LlamaConfig.attention for why the
+        # sharded path stays dense)
+        use_flash = (c.attention == "flash" and dp is None and sp is None)
+        mask = (None if use_flash
+                else jnp.tril(jnp.ones((S, S), bool))[None, None])
 
         def body(x, layer_params):
-            return self._layer(x, layer_params, positions, mask), None
+            return self._layer(x, layer_params, positions, mask,
+                               use_flash), None
 
         x, _ = jax.lax.scan(body, x, params["layers"])
         x = _rms_norm(x, params["final_norm"].astype(x.dtype), c.norm_eps)
@@ -240,22 +265,32 @@ class Llama:
         vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
                                           (0, pos, 0, 0))
 
-        # grouped-query attention without materializing repeated K/V over
-        # max_len (that copy is the cost GQA exists to avoid): fold the
-        # per-kv-head query group into the einsum instead
-        rep = nh // nkv
-        qg = q.reshape(B, S, nkv, rep, hd)            # (B, S, nkv, rep, hd)
-        kt = kc.astype(x.dtype)                       # (B, max, nkv, hd)
-        vt = vc.astype(x.dtype)
-        scores = jnp.einsum("bskrd,btkd->bkrst", qg, kt,
-                            preferred_element_type=jnp.float32) * (hd ** -0.5)
-        kpos = jnp.arange(max_len)
-        mask = kpos[None, :] <= positions[:, None]    # (S, max) causal
-        scores = jnp.where(mask[None, None, None], scores,
-                           jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bkrst,btkd->bskrd", probs, vt)
-        attn = attn.reshape(B, S, nh * hd)
+        if self.config.attention == "flash":
+            # fused decode kernel over the cache's native layout: cache
+            # blocks past the fill (pos + S) are neither fetched nor
+            # computed, so a step costs the filled prefix, not max_len
+            from ..ops.attention import flash_decode
+            attn = flash_decode(q.transpose(0, 2, 1, 3), kc, vc,
+                                kv_len=pos + S)
+            attn = attn.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
+        else:
+            # grouped-query attention without materializing repeated K/V
+            # over max_len (that copy is the cost GQA exists to avoid):
+            # fold the per-kv-head query group into the einsum instead
+            rep = nh // nkv
+            qg = q.reshape(B, S, nkv, rep, hd)        # (B, S, nkv, rep, hd)
+            kt = kc.astype(x.dtype)                   # (B, max, nkv, hd)
+            vt = vc.astype(x.dtype)
+            scores = jnp.einsum(
+                "bskrd,btkd->bkrst", qg, kt,
+                preferred_element_type=jnp.float32) * (hd ** -0.5)
+            kpos = jnp.arange(max_len)
+            mask = kpos[None, :] <= positions[:, None]  # (S, max) causal
+            scores = jnp.where(mask[None, None, None], scores,
+                               jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bkrst,btkd->bskrd", probs, vt)
+            attn = attn.reshape(B, S, nh * hd)
         x = x + attn @ p["wo"].astype(x.dtype)
 
         h = _rms_norm(x, p["mlp_norm"].astype(x.dtype), c.norm_eps)

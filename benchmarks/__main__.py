@@ -24,6 +24,11 @@ def main():
                     help="single-device wire-compression lane sweep "
                          "(fp16/bf16 cast lanes + scaled-fp8 codec, "
                          "Pallas vs raw XLA)")
+    ap.add_argument("--chip-decode", action="store_true",
+                    help="single-device KV-cache decode sweep "
+                         "(flash_decode fused kernel vs max_len-"
+                         "oblivious XLA einsum; GB/s of filled-prefix "
+                         "reads + tokens/s)")
     ap.add_argument("--chip-llama", action="store_true",
                     help="single-device Llama train-step + KV-cache "
                          "decode throughput (tokens/s)")
@@ -119,6 +124,13 @@ def main():
         from .configs import chip_compression_sweep
         result = chip_compression_sweep(sizes)
         name = "chip_compression.csv"
+    elif args.chip_decode:
+        if args.algorithm != "xla" or args.wire_dtype:
+            ap.error("--chip-decode measures the fixed pallas-vs-xla "
+                     "bf16 pair; --algorithm/--wire-dtype do not apply")
+        from .configs import chip_decode_sweep
+        result = chip_decode_sweep(sizes)  # sizes = fill lengths
+        name = "chip_decode.csv"
     elif args.chip_llama:
         if args.algorithm != "xla" or args.wire_dtype or sizes:
             ap.error("--chip-llama uses a fixed model geometry; "

@@ -40,6 +40,12 @@ def _is_cpu() -> bool:
     return jax.default_backend() == "cpu"
 
 
+# bf16 MXU peak of the local chip (v5e-class: ~197 TFLOP/s, the same
+# public spec family as roofline.LOCAL_HBM_SPEC_GBS's 819 GB/s HBM);
+# denominator of the attention sweep's MFU column
+LOCAL_BF16_PEAK_TFLOPS = 197.0
+
+
 def config1_pingpong(sizes=None, world=2, backend: str = "emu",
                      stack: str = "tcp") -> SweepResult:
     """Send/recv ping-pong latency (fp32) on a CPU tier.
@@ -406,11 +412,96 @@ def chip_attention_sweep(seqs=None) -> SweepResult:
                             floor=2, cpu_k=(1, 3))
             # S in the label: batch shrinks as sequence grows, so rows
             # at different S can share nbytes and must not aggregate
+            tfl = flops / t / 1e12
             rows.append({
                 "collective": f"attention_causal_s{S}", "algorithm": algo,
                 "world": 1, "dtype": "bfloat16", "wire_dtype": "",
                 "nbytes": nbytes, "seconds_per_op": t,
                 "bus_gbps": round(nbytes / t / 1e9, 4), "tier": tier,
+                # MFU vs bf16 peak is the headline column on chip; the
+                # CPU tier's interpreted smoke run leaves it blank
+                "tflops": round(tfl, 2),
+                "mfu": ("" if _is_cpu()
+                        else round(tfl / LOCAL_BF16_PEAK_TFLOPS, 4)),
+            })
+    return SweepResult(rows)
+
+
+def chip_decode_sweep(kvlens=None) -> SweepResult:
+    """Single-device KV-cache decode sweep: the fused ``flash_decode``
+    kernel (cache-native layout, dynamic fill length) vs an XLA einsum
+    that attends over the whole max_len cache — the cost model decode
+    pays without a length-aware kernel. Decode is HBM-bound: the floor
+    per step is reading the FILLED K/V prefix once, so bus_gbps = that
+    prefix's bytes over the measured step time, directly comparable to
+    the chip's HBM curve (chip_combine.csv). A second 'tokens/s' row per
+    fill level reports B / step for throughput readers."""
+    from accl_tpu.ops.attention import flash_decode
+
+    # CPU tier = interpreted-Pallas functional smoke, so shapes shrink
+    # hard (the real curve needs the chip)
+    B, H, Hkv, D = (2, 8, 2, 64) if _is_cpu() else (8, 32, 8, 128)
+    T = 128 if _is_cpu() else 8192
+    kvlens = kvlens or ([32, 128] if _is_cpu()
+                        else [512, 2048, 8192])
+    tier = f"{jax.default_backend()}-chip"
+    kk = jax.random.split(jax.random.key(0), 3)
+    kc = jax.random.normal(kk[0], (B, T, Hkv, D), jnp.bfloat16)
+    vc = jax.random.normal(kk[1], (B, T, Hkv, D), jnp.bfloat16)
+    q = jax.random.normal(kk[2], (B, H, 1, D), jnp.bfloat16)
+
+    def xla_decode(q, kc, vc, kvlen):
+        # length-oblivious baseline: repeated-KV einsum over max_len
+        rep = H // Hkv
+        kt = jnp.repeat(kc.transpose(0, 2, 1, 3), rep, 1)
+        vt = jnp.repeat(vc.transpose(0, 2, 1, 3), rep, 1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kt,
+                       preferred_element_type=jnp.float32)
+        s = s * (float(D) ** -0.5)
+        s = jnp.where(jnp.arange(T)[None, None, None] < kvlen, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(jnp.bfloat16), vt)
+
+    rows = []
+    for kvlen in kvlens:
+        n = jnp.int32(kvlen)
+        # HBM floor: read the filled K+V prefix once per step
+        nbytes = 2 * B * kvlen * Hkv * D * 2
+
+        def make_pallas(K):
+            @jax.jit
+            def f(q, kc, vc, n):
+                def body(i, acc):
+                    o = flash_decode(acc, kc, vc, n)
+                    return o
+                out = jax.lax.fori_loop(0, K, body, q)
+                return out[0, 0, 0, 0].astype(jnp.float32)
+            return f
+
+        def make_xla(K):
+            @jax.jit
+            def f(q, kc, vc, n):
+                def body(i, acc):
+                    return xla_decode(acc, kc, vc, n)
+                out = jax.lax.fori_loop(0, K, body, q)
+                return out[0, 0, 0, 0].astype(jnp.float32)
+            return f
+
+        for algo, mk in (("pallas", make_pallas), ("xla", make_xla)):
+            t = _chip_slope(mk, (q, kc, vc, n), nbytes, 200e9,
+                            cap=50_000, floor=2, cpu_k=(1, 3))
+            rows.append({
+                "collective": f"decode_kv{kvlen}", "algorithm": algo,
+                "world": 1, "dtype": "bfloat16", "wire_dtype": "",
+                "nbytes": nbytes, "seconds_per_op": t,
+                "bus_gbps": round(nbytes / t / 1e9, 4), "tier": tier,
+            })
+            rows.append({
+                "collective": f"decode_kv{kvlen}_tput", "algorithm": algo,
+                "world": 1, "dtype": "bfloat16", "wire_dtype": "",
+                "nbytes": nbytes, "seconds_per_op": t,
+                "bus_gbps": round(B / t, 2), "units": "tokens/s",
+                "tier": tier,
             })
     return SweepResult(rows)
 

@@ -37,7 +37,10 @@ from accl_tpu.parallel.tree import (tree_bcast_shard, tree_gather_shard,
 from .timing import slope_time
 
 CSV_FIELDS = ["collective", "algorithm", "world", "dtype", "wire_dtype",
-              "nbytes", "seconds_per_op", "bus_gbps", "units", "tier"]
+              "nbytes", "seconds_per_op", "bus_gbps", "units", "tier",
+              "tflops", "mfu"]
+# tflops/mfu are filled by the compute-bound sweeps (attention): achieved
+# TFLOP/s and its fraction of the chip's bf16 peak; blank elsewhere
 # "units" qualifies the bus_gbps column: "GB/s" (the default) for
 # bandwidth rows, "tokens/s" for model-throughput rows (llama sweeps) —
 # aggregators must not average across different units

@@ -72,8 +72,10 @@ def _check_rows(res, expect_collectives, tier_suffix="-chip"):
     from benchmarks.sweep import CSV_FIELDS
     assert res.rows, "sweep produced no rows"
     for r in res.rows:
-        # "units" is optional on rows (to_csv defaults it to GB/s)
-        assert set(CSV_FIELDS) - {"units"} <= set(r) <= set(CSV_FIELDS), r
+        # "units" is optional on rows (to_csv defaults it to GB/s);
+        # tflops/mfu only appear on compute-bound (attention) rows
+        assert (set(CSV_FIELDS) - {"units", "tflops", "mfu"}
+                <= set(r) <= set(CSV_FIELDS)), r
         assert r["seconds_per_op"] > 0
         assert r["tier"].endswith(tier_suffix)
     got = {r["collective"] for r in res.rows}
@@ -91,6 +93,13 @@ def test_chip_attention_sweep_smoke():
     from benchmarks.configs import chip_attention_sweep
     res = chip_attention_sweep(seqs=[64])
     _check_rows(res, {"attention_causal_s64"})
+
+
+def test_chip_decode_sweep_smoke():
+    from benchmarks.configs import chip_decode_sweep
+    res = chip_decode_sweep(kvlens=[32])
+    _check_rows(res, {"decode_kv32", "decode_kv32_tput"})
+    assert {r["algorithm"] for r in res.rows} == {"pallas", "xla"}
 
 
 def test_chip_compression_sweep_smoke():
