@@ -332,3 +332,145 @@ def test_collective_group_timeout_via_sweeper():
     assert ei.value.error_word & int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
     assert elapsed < 3.0  # deadline + sweeper slack, not the wait budget
     assert not a.device.ctx._pending
+
+
+# -- rooted ops on the fast path (to_from_fpga=False applies to EVERY op,
+#    reference test_tcp_cmac_seq_mpi.py:29-443) ---------------------------
+
+def _host_staging_spy(world, monkeypatch):
+    """Count host-staging crossings (operand reads / result writes) on
+    every rank's device: the rooted device-resident fast path must make
+    ZERO of either."""
+    from accl_tpu.device.tpu import TpuDevice
+    crossings = []
+    orig_read = TpuDevice._read_operand
+    orig_write = TpuDevice._write_result
+
+    def spy_read(self, *a, **k):
+        crossings.append("read")
+        return orig_read(self, *a, **k)
+
+    def spy_write(self, *a, **k):
+        crossings.append("write")
+        return orig_write(self, *a, **k)
+
+    monkeypatch.setattr(TpuDevice, "_read_operand", spy_read)
+    monkeypatch.setattr(TpuDevice, "_write_result", spy_write)
+    return crossings
+
+
+def test_bcast_device_resident_zero_host_copy(world, monkeypatch):
+    count = 48
+    payload = _data(count, 70)
+    crossings = _host_staging_spy(world, monkeypatch)
+
+    def fn(a):
+        init = payload if a.rank == 3 else np.zeros(count, np.float32)
+        buf = _dev_src(a, init)
+        a.bcast(buf, count, root=3)
+        assert buf.is_device_resident
+        return buf.data.copy()
+
+    for out in run_ranks(world, fn):
+        np.testing.assert_allclose(out, payload, rtol=1e-6)
+    assert not crossings, f"host staging on fast path: {crossings}"
+
+
+def test_scatter_device_resident_zero_host_copy(world, monkeypatch):
+    count = 32
+    flat = _data(W * count, 71)
+    crossings = _host_staging_spy(world, monkeypatch)
+
+    def fn(a):
+        src = _dev_src(a, flat) if a.rank == 2 else None
+        dst = a.buffer((count,), np.float32, device_resident=True)
+        a.scatter(src, dst, count, root=2)
+        assert dst.is_device_resident
+        return dst.data.copy()
+
+    outs = run_ranks(world, fn)
+    for r, out in enumerate(outs):
+        np.testing.assert_allclose(out, flat[r * count:(r + 1) * count],
+                                   rtol=1e-6)
+    assert not crossings, f"host staging on fast path: {crossings}"
+
+
+def test_gather_device_resident_zero_host_copy(world, monkeypatch):
+    count = 24
+    ins = [_data(count, 80 + r) for r in range(W)]
+    crossings = _host_staging_spy(world, monkeypatch)
+
+    def fn(a):
+        src = _dev_src(a, ins[a.rank])
+        dst = (a.buffer((W * count,), np.float32, device_resident=True)
+               if a.rank == 5 else None)
+        a.gather(src, dst, count, root=5)
+        if a.rank == 5:
+            assert dst.is_device_resident
+            return dst.data.copy()
+        return None
+
+    outs = run_ranks(world, fn)
+    np.testing.assert_allclose(outs[5], np.concatenate(ins), rtol=1e-6)
+    assert not crossings, f"host staging on fast path: {crossings}"
+
+
+@pytest.mark.parametrize("func", [ReduceFunc.SUM, ReduceFunc.MAX])
+def test_reduce_device_resident_zero_host_copy(world, monkeypatch, func):
+    count = 40
+    ins = [_data(count, 90 + r) for r in range(W)]
+    crossings = _host_staging_spy(world, monkeypatch)
+
+    def fn(a):
+        src = _dev_src(a, ins[a.rank])
+        dst = (a.buffer((count,), np.float32, device_resident=True)
+               if a.rank == 0 else None)
+        a.reduce(src, dst, count, root=0, func=func)
+        if a.rank == 0:
+            return dst.data.copy()
+        return None
+
+    outs = run_ranks(world, fn)
+    golden = (sum(ins) if func == ReduceFunc.SUM
+              else np.maximum.reduce(ins))
+    np.testing.assert_allclose(outs[0], golden, rtol=1e-4, atol=1e-5)
+    assert not crossings, f"host staging on fast path: {crossings}"
+
+
+def test_rooted_mixed_residency_falls_back(world):
+    """A host-mirror buffer anywhere in the group disqualifies the fast
+    path; the staged path must still produce the right answer."""
+    count = 16
+    payload = _data(count, 99)
+
+    def fn(a):
+        if a.rank == 0:  # root stays host-resident -> fallback
+            buf = a.buffer(data=payload)
+        else:
+            buf = _dev_src(a, np.zeros(count, np.float32))
+        a.bcast(buf, count, root=0)
+        return buf.data.copy()
+
+    for out in run_ranks(world, fn):
+        np.testing.assert_allclose(out, payload, rtol=1e-6)
+
+
+def test_compressed_rooted_stays_on_staged_path(world, monkeypatch):
+    """ETH-compressed rooted ops keep the staged path (host wire_q
+    numerics parity with the emulator tiers) until the rooted programs
+    carry wire lanes natively — and must still be correct."""
+    count = 64
+    payload = _data(count, 101)
+
+    def fn(a):
+        init = payload if a.rank == 1 else np.zeros(count, np.float32)
+        buf = _dev_src(a, init)
+        a.bcast(buf, count, root=1, compress_dtype=np.float16)
+        return buf.data.copy()
+
+    outs = run_ranks(world, fn)
+    np.testing.assert_allclose(outs[1], payload, rtol=1e-6)  # root exact
+    for r in (0, 2):  # others quantized through the fp16 wire
+        np.testing.assert_allclose(
+            outs[r], payload.astype(np.float16).astype(np.float32),
+            rtol=1e-6)
