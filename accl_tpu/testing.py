@@ -121,3 +121,22 @@ def sim_world(world_size: int, nbufs: int = 16, bufsize: int = 1 << 20,
         for d in daemons:
             d.shutdown()
         raise
+
+
+def hlo_permute_bytes(hlo: str) -> int:
+    """Sum wire bytes over every f32 collective-permute in a compiled HLO
+    text: elements x 4 bytes x number of source-target pairs (only listed
+    pairs transfer). Shared by the binomial-tree traffic tests (1-D tier
+    and the 32-device 2D subprocess) so the byte accounting cannot
+    desynchronize between copies."""
+    import re
+    pat = re.compile(r"f32\[([\d,]*)\]\S*\s+collective-permute\(.*?"
+                     r"source_target_pairs=(\{.*?\}\})", re.DOTALL)
+    total = 0
+    for m in pat.finditer(hlo):
+        n = 1
+        for d in m.group(1).split(","):
+            if d:
+                n *= int(d)
+        total += n * 4 * max(m.group(2).count("{") - 1, 1)
+    return total

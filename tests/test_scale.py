@@ -257,6 +257,28 @@ _TREE32 = textwrap.dedent("""
         return buf.data.copy()
     for o in run_ranks(accls, bc, timeout=300.0):
         np.testing.assert_array_equal(o, ins[root])
+
+    # wire-byte proportionality at (8,4): the flattened binomial
+    # schedules must be byte-exact at W=32 too (permutes only, (W-1)
+    # message copies for bcast, the static schedule sums for
+    # scatter/gather) — the 2D analog of test_binomial_tree's checks
+    from accl_tpu.parallel.tree import gather_rounds, scatter_rounds
+    from accl_tpu.testing import hlo_permute_bytes as permute_bytes
+    count, msg = 256, 256 * 4
+    for op, bound in (
+            ("bcast", (W - 1) * msg),
+            ("scatter", sum(b * len(v)
+                            for _s, b, v in scatter_rounds(W)) * msg),
+            ("gather", sum(b * len(v)
+                           for _s, b, v in gather_rounds(W)) * msg)):
+        xo = tc.shard([np.zeros(W * count if op == "scatter" else count,
+                                np.float32)] * W)
+        hlo = tc._program(op, 0, ReduceFunc.SUM).lower(
+            xo).compile().as_text()
+        for banned in ("all-reduce", "all-gather", "reduce-scatter"):
+            assert banned not in hlo, (op, banned)
+        got = permute_bytes(hlo)
+        assert 0 < got <= bound * 1.01, (op, got, bound)
     print("TREE32_OK")
 """)
 
