@@ -56,7 +56,7 @@ from ..constants import (ACCLError, CCLOp, CollectiveAlgorithm, Compression,
                          DEFAULT_MAX_SEGMENT_SIZE, DEFAULT_TIMEOUT_S,
                          ErrorCode, ReduceFunc, check_algorithm)
 from ..emulator.executor import DeviceMemory
-from ..parallel.collectives import MeshCollectives
+from ..parallel.collectives import MeshCollectives, _wire_name
 from ..parallel.mesh import make_mesh
 from ..parallel.tree import Tree2DCollectives
 from .base import Device
@@ -786,25 +786,6 @@ class TpuDevice(Device):
         if op == CCLOp.barrier:
             return 0  # rendezvous above IS the barrier
 
-        def wire_q(arr: np.ndarray) -> np.ndarray:
-            """Wire-compression semantics for rooted data movement: a
-            payload that crossed the wire was quantized through the
-            compressed dtype (emulator-tier parity — without this the
-            TPU tier would silently return MORE accurate results than
-            the other tiers for ETH-compressed bcast/scatter/gather)."""
-            if wire is None:
-                return arr
-            return arr.astype(wire).astype(cfg.uncompressed_dtype)
-
-        def wire_q_except(flat: np.ndarray, keep: int) -> np.ndarray:
-            """Quantize a (W*count,) assembly of per-rank chunks through
-            the wire, restoring chunk ``keep`` (the data that stayed
-            local: the root's own chunk / a rank's self chunk)."""
-            if wire is None:
-                return flat
-            rows = wire_q(flat.reshape(W, -1))
-            rows[keep] = flat.reshape(W, -1)[keep]
-            return rows.reshape(-1)
         # -- device-resident fast path (to_from_fpga=False parity) --------
         # When every member rank's src AND dst buffer is device-resident
         # with exact geometry, the dense collectives skip host staging
@@ -815,19 +796,15 @@ class TpuDevice(Device):
                       CCLOp.allgather: (count, W * count),
                       CCLOp.reduce_scatter: (W * count, count),
                       CCLOp.alltoall: (W * count, W * count)}
-        if op in dense_fast and not (op == CCLOp.alltoall
-                                     and wire is not None):
+        if op in dense_fast:
             n_in, n_out = dense_fast[op]
             res = self._launch_device_fast(op, descs, devs, coll, alg,
                                            wire, cfg, n_in, n_out, d0)
             if res is not None:
                 return res
-        # rooted ops join the fast path uncompressed; with a wire dtype
-        # the staged path's host-side wire_q keeps cross-tier numerics
-        # until the rooted programs carry wire lanes natively
-        if op in rooted and wire is None:
+        if op in rooted:
             res = self._launch_device_rooted(op, descs, devs, coll, alg,
-                                             cfg, count, root, d0)
+                                             cfg, count, root, d0, wire)
             if res is not None:
                 return res
 
@@ -856,7 +833,7 @@ class TpuDevice(Device):
                                                  algorithm=alg,
                                                  wire_dtype=wire))
             for r, d in enumerate(descs):
-                devs[r]._write_result(d.addr_2, out[r][:count], d)
+                devs[r]._write_result(d.addr_2, out[r], d)
             return 0
         if op == CCLOp.allgather:
             x = coll.shard(read_all(lambda d: d.addr_0, count))
@@ -868,41 +845,44 @@ class TpuDevice(Device):
         if op == CCLOp.bcast:
             rows = read_all(lambda d: d.addr_0, count)
             if tree is not None:
-                out = np.asarray(tree.bcast(tree.shard(rows), root=root))
+                out = np.asarray(tree.bcast(tree.shard(rows), root=root,
+                                            wire_dtype=wire))
             else:
-                out = np.asarray(coll.bcast(coll.shard(rows), root=root))
+                out = np.asarray(coll.bcast(coll.shard(rows), root=root,
+                                            wire_dtype=wire))
             for r, d in enumerate(descs):
                 if r != root:  # root's own buffer never crossed the wire
-                    devs[r]._write_result(d.addr_0, wire_q(out[r]), d)
+                    devs[r]._write_result(d.addr_0, out[r], d)
             return 0
         if op == CCLOp.scatter:
             rows = read_all(lambda d: d.addr_0, W * count)
             if tree is not None:
-                out = np.asarray(tree.scatter(tree.shard(rows), root=root))
+                out = np.asarray(tree.scatter(tree.shard(rows), root=root,
+                                              wire_dtype=wire))
             else:
-                out = np.asarray(coll.scatter(coll.shard(rows), root=root))
+                out = np.asarray(coll.scatter(coll.shard(rows), root=root,
+                                              wire_dtype=wire))
             for r, d in enumerate(descs):
-                chunk = out[r][:count]
-                devs[r]._write_result(
-                    d.addr_2, chunk if r == root else wire_q(chunk), d)
+                devs[r]._write_result(d.addr_2, out[r], d)
             return 0
         if op == CCLOp.gather:
             rows = read_all(lambda d: d.addr_0, count)
             if tree is not None:
-                out = np.asarray(tree.gather(tree.shard(rows), root=root))
+                out = np.asarray(tree.gather(tree.shard(rows), root=root,
+                                             wire_dtype=wire))
             else:
-                out = np.asarray(coll.gather(coll.shard(rows), root=root))
-            devs[root]._write_result(descs[root].addr_2,
-                                     wire_q_except(out[root], root),
+                out = np.asarray(coll.gather(coll.shard(rows), root=root,
+                                             wire_dtype=wire))
+            devs[root]._write_result(descs[root].addr_2, out[root],
                                      descs[root])
             return 0
         if op == CCLOp.alltoall:
             x = coll.shard(read_all(lambda d: d.addr_0, W * count))
-            out = np.asarray(coll.alltoall(x))
+            # the program casts chunks on the wire and restores each
+            # rank's self chunk exact (emulator-tier wire_q_except parity)
+            out = np.asarray(coll.alltoall(x, wire_dtype=wire))
             for r, d in enumerate(descs):
-                # chunk s->r crossed the wire for every s except r's own
-                # local copy (emulator-tier parity, like the rooted ops)
-                devs[r]._write_result(d.addr_2, wire_q_except(out[r], r), d)
+                devs[r]._write_result(d.addr_2, out[r], d)
             return 0
         return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
 
@@ -933,8 +913,8 @@ class TpuDevice(Device):
         func = (d0.function if op in (CCLOp.allreduce, CCLOp.reduce_scatter)
                 else ReduceFunc.SUM)
         x = self.ctx.assemble_flat(coll, srcs)
-        wire_name = None if wire is None else np.dtype(wire).name
-        out = coll._program_flat(op.name, alg, func, wire_name, None)(x)
+        out = coll._program_flat(op.name, alg, func, _wire_name(wire),
+                                 None)(x)
         self._rebind_out_shards(coll, out, dict(enumerate(dsts)), devs)
         return 0
 
@@ -981,7 +961,8 @@ class TpuDevice(Device):
                 devs[r]._rebind_dev(db, datas[pos])
 
     def _launch_device_rooted(self, op, descs, devs, coll, alg, cfg,
-                              count: int, root: int, d0) -> int | None:
+                              count: int, root: int, d0,
+                              wire=None) -> int | None:
         """Zero-host-staging ROOTED collective (bcast/scatter/gather/
         reduce) — the reference's ``to_from_fpga=False`` mode applies to
         every op, not just the dense four (VERDICT r4 item 3). Buffer
@@ -989,8 +970,8 @@ class TpuDevice(Device):
         side must be device-resident; a scatter's non-root "sources"
         don't exist and ride in as cached device zeros. Returns None
         when the involved buffers disqualify (caller takes the staged
-        path). Wire compression is gated off by the CALLER until the
-        rooted programs carry wire lanes natively."""
+        path). ETH (wire) compression rides inside the program, like
+        the dense fast path."""
         bad = (Compression.OP0_COMPRESSED | Compression.OP1_COMPRESSED
                | Compression.RES_COMPRESSED)
         if any(d.compression & bad for d in descs):
@@ -1053,7 +1034,8 @@ class TpuDevice(Device):
 
         x = self.ctx.assemble_flat(coll, srcs)
         func = d0.function if op == CCLOp.reduce else ReduceFunc.SUM
-        out = coll._program_flat(op.name, alg, func, None, root)(x)
+        out = coll._program_flat(op.name, alg, func, _wire_name(wire),
+                                 root)(x)
         self._rebind_out_shards(coll, out, dst_map, devs)
         return 0
 

@@ -204,10 +204,26 @@ def send_recv(x: jnp.ndarray, pairs: list[tuple[int, int]],
     return lax.ppermute(x, axis_name, pairs)
 
 
-def alltoall_shard(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
-    """x: (W, chunk...) per shard -> (W, chunk...) transposed across ranks."""
-    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
-                          tiled=False)
+def alltoall_shard(x: jnp.ndarray, axis_name: str,
+                   wire_dtype=None) -> jnp.ndarray:
+    """x: (W, chunk...) per shard -> (W, chunk...) transposed across ranks.
+
+    With a wire dtype, chunks cast BEFORE transit (the exchange itself
+    moves wire-width bytes) and upcast on arrival; the rank's own chunk
+    lands from itself and is restored exact (the emulator tier's
+    wire_q_except contract: only data that actually crossed the wire is
+    quantized)."""
+    if wire_dtype is None or x.dtype == jnp.dtype(wire_dtype):
+        return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)
+    out_q = lax.all_to_all(x.astype(wire_dtype), axis_name, split_axis=0,
+                           concat_axis=0, tiled=False).astype(x.dtype)
+    me = lax.axis_index(axis_name)
+    keep = lax.broadcasted_iota(jnp.int32,
+                                (x.shape[0],) + (1,) * (x.ndim - 1), 0) == me
+    # row me of the exchange output is this rank's own x[me] round-tripped
+    # through the wire; substitute the exact original
+    return jnp.where(keep, x, out_q)
 
 
 _AXIS_REDUCERS = {ReduceFunc.SUM: jnp.sum, ReduceFunc.MAX: jnp.max,
@@ -378,11 +394,15 @@ class MeshCollectives:
                     return lax.all_gather(x[0], ax).reshape(-1)[None]
         elif op == "bcast":
             # binomial ppermute rounds: (W-1)|x| wire bytes; masked_bcast
-            # (psum-over-mask) costs a full allreduce (VERDICT r3 weak-3)
+            # (psum-over-mask) costs a full allreduce (VERDICT r3 weak-3).
+            # The wire dtype rides INSIDE the program (cast per hop, cast
+            # back at the receiver — idempotent, so multi-hop relays match
+            # the emulator tier's single quantization bitwise)
             from .tree import binomial_bcast_shard
 
             def f(x):
-                return binomial_bcast_shard(x[0], root, ax)[None]
+                return binomial_bcast_shard(x[0], root, ax,
+                                            wire_dtype)[None]
         elif op == "reduce":
             def f(x):
                 if wire_dtype is not None:
@@ -402,7 +422,8 @@ class MeshCollectives:
 
             def f(x):
                 chunks = x[0].reshape(self.W, -1)
-                return binomial_scatter_shard(chunks, root, ax)[None]
+                return binomial_scatter_shard(chunks, root, ax,
+                                              wire_dtype)[None]
         elif op == "gather":
             # binomial doubling tree: O(W log W / 2) chunks on the wire;
             # all_gather+mask delivered W chunks to every rank, W(W-1)
@@ -410,12 +431,14 @@ class MeshCollectives:
             from .tree import binomial_gather_shard
 
             def f(x):
-                g = binomial_gather_shard(x[0], root, ax).reshape(-1)
+                g = binomial_gather_shard(x[0], root, ax,
+                                          wire_dtype).reshape(-1)
                 return g[None]
         elif op == "alltoall":
             def f(x):
                 chunks = x[0].reshape(self.W, -1)
-                return alltoall_shard(chunks, ax).reshape(-1)[None]
+                return alltoall_shard(chunks, ax,
+                                      wire_dtype).reshape(-1)[None]
         else:
             raise NotImplementedError(op)
         return f
@@ -474,8 +497,10 @@ class MeshCollectives:
         return self._program("allgather", algorithm, ReduceFunc.SUM,
                              _wire_name(wire_dtype), None)(x)
 
-    def bcast(self, x: jax.Array, root: int = 0) -> jax.Array:
-        return self._program("bcast", "xla", ReduceFunc.SUM, None, root)(x)
+    def bcast(self, x: jax.Array, root: int = 0,
+              wire_dtype=None) -> jax.Array:
+        return self._program("bcast", "xla", ReduceFunc.SUM,
+                             _wire_name(wire_dtype), root)(x)
 
     def reduce(self, x: jax.Array, root: int = 0,
                func: ReduceFunc = ReduceFunc.SUM, wire_dtype=None
@@ -483,14 +508,19 @@ class MeshCollectives:
         return self._program("reduce", "xla", func,
                              _wire_name(wire_dtype), root)(x)
 
-    def scatter(self, x: jax.Array, root: int = 0) -> jax.Array:
-        return self._program("scatter", "xla", ReduceFunc.SUM, None, root)(x)
+    def scatter(self, x: jax.Array, root: int = 0,
+                wire_dtype=None) -> jax.Array:
+        return self._program("scatter", "xla", ReduceFunc.SUM,
+                             _wire_name(wire_dtype), root)(x)
 
-    def gather(self, x: jax.Array, root: int = 0) -> jax.Array:
-        return self._program("gather", "xla", ReduceFunc.SUM, None, root)(x)
+    def gather(self, x: jax.Array, root: int = 0,
+               wire_dtype=None) -> jax.Array:
+        return self._program("gather", "xla", ReduceFunc.SUM,
+                             _wire_name(wire_dtype), root)(x)
 
-    def alltoall(self, x: jax.Array) -> jax.Array:
-        return self._program("alltoall", "xla", ReduceFunc.SUM, None, None)(x)
+    def alltoall(self, x: jax.Array, wire_dtype=None) -> jax.Array:
+        return self._program("alltoall", "xla", ReduceFunc.SUM,
+                             _wire_name(wire_dtype), None)(x)
 
     def _sendrecv_program(self, pairs: tuple[tuple[int, int], ...]):
         ck = ("exchange", pairs)

@@ -115,7 +115,7 @@ def test_bcast_wire_bytes_proportional(w):
     hlo = _compiled_hlo(coll, "bcast", root=0, count=count)
     msg = count * 4
     total = _permute_bytes(hlo)
-    assert 0 < total <= (w - 1) * msg * 1.01, (total, (w - 1) * msg)
+    assert total == (w - 1) * msg, (total, (w - 1) * msg)
 
 
 @pytest.mark.parametrize("op", ["scatter", "gather"])
@@ -177,8 +177,9 @@ def test_tree2d_bcast_wire_bytes_proportional(shape):
     total = _permute_bytes(hlo)
     msg = count * 4
     # flattened binomial: exactly W-1 message copies, same as the 1-D
-    # schedule (the old per-axis masked psum paid ~2x per axis)
-    assert 0 < total <= (tc.W - 1) * msg * 1.01, (total, (tc.W - 1) * msg)
+    # schedule (the old per-axis masked psum paid ~2x per axis); exact
+    # equality so a lowering the byte counter misses cannot slip through
+    assert total == (tc.W - 1) * msg, (total, (tc.W - 1) * msg)
 
 
 @pytest.mark.parametrize("op", ["scatter", "gather"])
@@ -194,3 +195,45 @@ def test_tree2d_scatter_gather_wire_bytes_match_schedule(shape, op):
     total = _permute_bytes(hlo)
     assert total == expected, (total, expected)
     assert total < tc.W * (tc.W - 1) * chunk / 4
+
+
+# ---------------------------------------------------------------------------
+# wire compression rides IN the programs: the compiled HLO's permute
+# operands carry the wire dtype (the bytes that cross the fabric are
+# compressed — ETH_COMPRESSED substitution, ccl_offload_control.c:533-556)
+# ---------------------------------------------------------------------------
+
+def _compiled_hlo_wire(coll, op, root, count, wire):
+    if op == "scatter":
+        x = coll.shard(_rows(coll.W, coll.W * count))
+    else:
+        x = coll.shard(_rows(coll.W, count))
+    prog = coll._program(op, "xla", ReduceFunc.SUM, wire, root)
+    return prog.lower(x).compile().as_text()
+
+
+@pytest.mark.parametrize("op", ["bcast", "scatter", "gather"])
+def test_rooted_wire_dtype_on_the_permutes(op):
+    """With a wire dtype, every collective-permute in the rooted program
+    must move f16 operands (no f32 permutes left), and the total permute
+    bytes must be HALF the uncompressed schedule's."""
+    w, count = 8, 1024
+    coll = _coll(w)
+    hlo = _compiled_hlo_wire(coll, op, root=0, count=count, wire="float16")
+    assert "collective-permute" in hlo
+    assert re.search(r"f32\[[\d,]*\]\S*\s+collective-permute\(", hlo) is None, \
+        f"{op}: uncompressed f32 permute in compressed program"
+    assert re.search(r"f16\[[\d,]*\]\S*\s+collective-permute\(", hlo), \
+        f"{op}: no f16 permute found"
+
+
+def test_alltoall_wire_dtype_on_the_exchange():
+    """Compressed alltoall exchanges wire-width chunks (cast BEFORE
+    transit) and restores each rank's self chunk exact."""
+    w, count = 8, 256
+    coll = _coll(w)
+    x = coll.shard(_rows(w, w * count))
+    prog = coll._program("alltoall", "xla", ReduceFunc.SUM, "float16", None)
+    hlo = prog.lower(x).compile().as_text()
+    assert re.search(r"f16\[[\d,]*\]\S*\s+all-to-all\(", hlo), \
+        "all-to-all operand is not wire-width"

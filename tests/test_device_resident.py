@@ -455,12 +455,14 @@ def test_rooted_mixed_residency_falls_back(world):
         np.testing.assert_allclose(out, payload, rtol=1e-6)
 
 
-def test_compressed_rooted_stays_on_staged_path(world, monkeypatch):
-    """ETH-compressed rooted ops keep the staged path (host wire_q
-    numerics parity with the emulator tiers) until the rooted programs
-    carry wire lanes natively — and must still be correct."""
+def test_compressed_rooted_rides_fast_path(world, monkeypatch):
+    """ETH-compressed rooted ops on device-resident buffers take the
+    zero-staging fast path too — the wire cast rides INSIDE the binomial
+    program (cast per hop, idempotent), and the numerics still match the
+    emulator tier's contract: root exact, receivers quantized once."""
     count = 64
     payload = _data(count, 101)
+    crossings = _host_staging_spy(world, monkeypatch)
 
     def fn(a):
         init = payload if a.rank == 1 else np.zeros(count, np.float32)
@@ -474,3 +476,4 @@ def test_compressed_rooted_stays_on_staged_path(world, monkeypatch):
         np.testing.assert_allclose(
             outs[r], payload.astype(np.float16).astype(np.float32),
             rtol=1e-6)
+    assert not crossings, f"host staging on fast path: {crossings}"
