@@ -107,14 +107,19 @@ class EthFabric:
                              daemon=True).start()
 
     def _recv_loop(self, conn: socket.socket):
+        # buffered framing via the protocol's shared reader: back-to-back
+        # eth frames (pipelined sends, ring schedules) arrive in ~one
+        # syscall instead of two per frame, and the framing invariants
+        # (length header, oversize guard) live in one place
+        f = conn.makefile("rb")
         try:
             while True:
-                body = P.recv_frame(conn)
+                body = P.recv_frame_file(f)
                 if body[0] != P.MSG_ETH:
                     continue
                 env, payload = _env_from_eth_frame(body[1:])
                 self.ingest(env, payload)
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, ValueError):
             return
         finally:
             with self._lock:
@@ -486,9 +491,19 @@ class RankDaemon:
                 self._failed_calls.pop(next(iter(self._failed_calls)))
         self._call_cv.notify_all()
 
+    # Direct value->member maps for the per-call hot path: EnumMeta
+    # __call__ costs ~1us each and five enums ride every descriptor —
+    # a dict hit is ~20x cheaper. Falls back to the constructor (KeyError
+    # -> ValueError parity) for values outside the map.
+    _OPS = dict(CCLOp._value2member_map_)
+    _FUNCS = dict(ReduceFunc._value2member_map_)
+    _ALGOS = dict(CollectiveAlgorithm._value2member_map_)
+
     def _execute(self, c: dict) -> int:
         try:
-            scenario = CCLOp(c["scenario"])
+            scenario = self._OPS.get(c["scenario"])
+            if scenario is None:  # zero-valued members are falsy: use `is`
+                scenario = CCLOp(c["scenario"])
             if scenario == CCLOp.nop:
                 return 0
             if scenario == CCLOp.config:
@@ -516,13 +531,18 @@ class RankDaemon:
             ctx = MoveContext(world_size=comm.size,
                               local_rank=comm.local_rank, arithcfg=cfg,
                               max_segment_size=self.max_segment_size)
+            alg = c.get("algorithm", 0)
+            func = self._FUNCS.get(c["func"])
+            algorithm = self._ALGOS.get(alg)
             moves = expand_call(
                 ctx, scenario, count=c["count"], root_src_dst=c["root"],
-                func=ReduceFunc(c["func"]), tag=c["tag"],
+                func=ReduceFunc(c["func"]) if func is None else func,
+                tag=c["tag"],
                 addr_0=c["addr0"], addr_1=c["addr1"], addr_2=c["addr2"],
                 compression=Compression(c["compression"]),
                 stream=StreamFlags(c["stream"]),
-                algorithm=CollectiveAlgorithm(c.get("algorithm", 0)))
+                algorithm=(CollectiveAlgorithm(alg) if algorithm is None
+                           else algorithm))
             return self.executor.execute(moves, cfg, comm)
         except Exception:  # noqa: BLE001
             import traceback
@@ -640,23 +660,88 @@ class RankDaemon:
         # per-connection state for the WAIT_LAST sentinel: the id of the
         # last MSG_CALL this connection submitted
         conn_state = {"last_call_id": 0}
+        # Buffered request parsing + coalesced replies: a pipelined
+        # client batch ([pushes, CALL, WAIT, READ], sim.py _inline_fused)
+        # lands in ONE recv, every frame is handled back to back, and
+        # the replies leave in ONE sendall — instead of 2 recv syscalls
+        # per frame (length + body) and a write + client wakeup per
+        # reply. This is the daemon half of the isolated-call floor.
+        # Frames/replies past _BIG_FRAME bypass the coalescing buffers:
+        # big payloads recv directly into their destination and reply
+        # via the scatter-gather send_frame (no extra full-size copies).
+        _BIG = 1 << 20
+        rbuf = bytearray()
+        replies = bytearray()
+
+        def flush():
+            nonlocal replies
+            if replies:
+                conn.sendall(replies)
+                replies = bytearray()
         try:
             while True:
-                body = P.recv_frame(conn)
-                try:
-                    reply = (self._handle(body, conn_state) if body
-                             else P.status_reply(int(ErrorCode.INVALID_CALL)))
-                except Exception:  # noqa: BLE001 — truncated/garbage frame
-                    # must get an error reply, not a dead connection; log
-                    # so genuine handler bugs stay diagnosable
-                    log.exception(
-                        "rank %d: request failed (kind=%s, %d bytes)",
-                        self.rank, body[0] if body else None, len(body))
-                    reply = P.status_reply(int(ErrorCode.INVALID_CALL))
-                P.send_frame(conn, reply)
-                if body and body[0] == P.MSG_SHUTDOWN:
-                    self.shutdown()
+                if len(rbuf) >= 4:
+                    (length,) = struct.unpack_from("<I", rbuf)
+                    if length > P.MAX_FRAME_LEN:
+                        # earlier valid requests in the batch keep their
+                        # replies even though this frame kills the conn
+                        flush()
+                        return
+                    if length > _BIG and len(rbuf) < 4 + length:
+                        # large frame (device-memory write): fill the
+                        # remainder straight into the frame buffer with
+                        # big reads — not 64K chunks through rbuf
+                        body = bytearray(length)
+                        have = len(rbuf) - 4
+                        body[:have] = rbuf[4:]
+                        del rbuf[:]
+                        view = memoryview(body)[have:]
+                        while view.nbytes:
+                            n = conn.recv_into(view, min(view.nbytes,
+                                                         1 << 20))
+                            if not n:
+                                return
+                            view = view[n:]
+                    elif len(rbuf) >= 4 + length:
+                        body = bytes(rbuf[4:4 + length])
+                        del rbuf[:4 + length]
+                    else:
+                        flush()
+                        chunk = conn.recv(1 << 16)
+                        if not chunk:
+                            return
+                        rbuf += chunk
+                        continue
+                    try:
+                        reply = (self._handle(body, conn_state)
+                                 if body else P.status_reply(
+                                     int(ErrorCode.INVALID_CALL)))
+                    except Exception:  # noqa: BLE001 — garbage frame
+                        # must get an error reply, not a dead
+                        # connection; log so genuine handler bugs
+                        # stay diagnosable
+                        log.exception(
+                            "rank %d: request failed (kind=%s, "
+                            "%d bytes)", self.rank,
+                            body[0] if body else None, len(body))
+                        reply = P.status_reply(int(ErrorCode.INVALID_CALL))
+                    if len(reply) > _BIG:
+                        # big readback: scatter-gather send, zero-copy
+                        flush()
+                        P.send_frame(conn, reply)
+                    else:
+                        replies += struct.pack("<I", len(reply))
+                        replies += reply
+                    if body and body[0] == P.MSG_SHUTDOWN:
+                        flush()
+                        self.shutdown()
+                        return
+                    continue  # drain every buffered frame first
+                flush()  # no complete frame left: flush the batch
+                chunk = conn.recv(1 << 16)
+                if not chunk:
                     return
+                rbuf += chunk
         except (ConnectionError, OSError):
             return
         finally:
@@ -687,7 +772,9 @@ class RankDaemon:
             return P.status_reply(0)
         if kind == P.MSG_WRITE_MEM:
             (addr,) = struct.unpack("<Q", body[1:9])
-            data = np.frombuffer(body[9:], np.uint8)
+            # offset view, not body[9:]: a device-memory write of a big
+            # buffer must not memcpy the payload an extra time
+            data = np.frombuffer(body, np.uint8, offset=9)
             self.mem.write(addr, data)
             return P.status_reply(0)
         if kind == P.MSG_READ_MEM:

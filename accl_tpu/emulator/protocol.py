@@ -76,8 +76,23 @@ CODE_DTYPES = {v: k for k, v in DTYPE_CODES.items()}
 _ML_DTYPE_NAMES = frozenset(("bfloat16", "float8_e4m3fn", "float8_e5m2"))
 
 
+_DTYPE_CODE_MEMO: dict = {}
+
+
 def dtype_code(dt) -> int:
-    return DTYPE_CODES[np.dtype(dt).name]
+    # np.dtype(dt).name walks numpy's name machinery (~5us); this sits on
+    # the per-call hot path (arith config resolution packs two codes per
+    # descriptor), so memoize on the raw key — dtype objects, type
+    # objects, and name strings all hash stably
+    try:
+        return _DTYPE_CODE_MEMO[dt]
+    except (KeyError, TypeError):
+        code = DTYPE_CODES[np.dtype(dt).name]
+        try:
+            _DTYPE_CODE_MEMO[dt] = code
+        except TypeError:
+            pass
+        return code
 
 
 def code_dtype(code: int) -> np.dtype:

@@ -1736,25 +1736,93 @@ int RankDaemon::serve(uint16_t cmd_port) {
 }
 
 void RankDaemon::serve_conn(int fd) {
-  std::vector<uint8_t> body;
+  // Buffered request parsing + coalesced replies (mirror of the Python
+  // daemon's _serve_conn): a pipelined client batch ([pushes, CALL,
+  // WAIT, READ]) lands in one recv, every frame is handled back to
+  // back, and the replies leave in one send — instead of two recv
+  // syscalls per frame and a write per reply.
+  // Frames/replies past kBig bypass the coalescing buffers: big payloads
+  // recv directly into the frame buffer (no 64K chunking through rbuf)
+  // and reply via the scatter-gather send_frame (no extra full-size
+  // copy); a malformed frame flushes buffered replies before dropping
+  // the connection so earlier valid requests keep their answers.
+  constexpr size_t kBig = 1 << 20;
+  std::vector<uint8_t> rbuf, replies, body;
   // per-connection state: the id of the last MSG_CALL this connection
   // submitted (the MSG_WAIT WAIT_LAST sentinel, protocol.py)
   uint32_t last_call_id = 0;
-  while (recv_frame(fd, body)) {
-    if (body.empty()) break;
+  uint8_t chunk[1 << 16];
+  auto flush = [&]() -> bool {
+    if (replies.empty()) return true;
+    bool ok = send_exact(fd, replies.data(), replies.size());
+    replies.clear();
+    return ok;
+  };
+  for (;;) {
+    bool have_frame = false;
+    if (rbuf.size() >= 4) {
+      uint32_t len;
+      std::memcpy(&len, rbuf.data(), 4);
+      if (len > MAX_FRAME_LEN) {
+        flush();
+        break;
+      }
+      if (len > kBig && rbuf.size() < 4 + static_cast<size_t>(len)) {
+        // large frame (device-memory write): fill the remainder straight
+        // into the frame buffer with one recv_exact
+        try {
+          body.resize(len);
+        } catch (const std::bad_alloc&) {
+          flush();
+          break;
+        }
+        size_t have = rbuf.size() - 4;
+        std::memcpy(body.data(), rbuf.data() + 4, have);
+        rbuf.clear();
+        if (!recv_exact(fd, body.data() + have, len - have)) {
+          flush();
+          break;
+        }
+        have_frame = true;
+      } else if (rbuf.size() >= 4 + static_cast<size_t>(len)) {
+        body.assign(rbuf.begin() + 4, rbuf.begin() + 4 + len);
+        rbuf.erase(rbuf.begin(), rbuf.begin() + 4 + len);
+        have_frame = true;
+      }
+    }
+    if (!have_frame) {
+      if (!flush()) break;  // no complete frame left: flush the batch
+      ssize_t r = ::recv(fd, chunk, sizeof chunk, 0);
+      if (r <= 0) break;
+      rbuf.insert(rbuf.end(), chunk, chunk + r);
+      continue;
+    }
+    if (body.empty()) {
+      flush();
+      break;
+    }
     std::vector<uint8_t> reply;
     try {
       reply = handle(body, &last_call_id);
     } catch (const std::exception& e) {
-      // any throwing handler (bad_alloc included) answers with an error
-      // instead of terminating the daemon (parity with the Python
-      // daemon's guarded _serve_conn)
+      // any throwing handler (bad_alloc included) answers with an
+      // error instead of terminating the daemon (parity with the
+      // Python daemon's guarded _serve_conn)
       std::fprintf(stderr, "request kind %u failed: %s\n", body[0],
                    e.what());
       reply = status_reply(E_INVALID);
     }
-    if (!send_frame(fd, reply)) break;
+    if (reply.size() > kBig) {
+      // big readback: scatter-gather send, zero extra copy
+      if (!flush() || !send_frame(fd, reply)) break;
+    } else {
+      uint32_t rlen = static_cast<uint32_t>(reply.size());
+      replies.insert(replies.end(), reinterpret_cast<uint8_t*>(&rlen),
+                     reinterpret_cast<uint8_t*>(&rlen) + 4);
+      replies.insert(replies.end(), reply.begin(), reply.end());
+    }
     if (body[0] == MSG_SHUTDOWN) {
+      flush();
       shutting_down.store(true);
       call_cv_.notify_all();
       {

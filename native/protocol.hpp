@@ -113,6 +113,17 @@ inline bool recv_exact(int fd, void* buf, size_t n) {
   return true;
 }
 
+inline bool send_exact(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
 // The largest legitimate frame is a device-memory write of one maximal
 // (MAX_ALLOC_BYTES) buffer plus the message header.  The length header is
 // attacker-controlled: beyond the cap the connection is dropped before
