@@ -313,11 +313,20 @@ class ACCL:
                              stream_flags=stream_flags)
         return self._call(desc, run_async, waitfor)
 
-    def combine(self, count: int, func: ReduceFunc, op0: ACCLBuffer,
-                op1: ACCLBuffer, res: ACCLBuffer, *, run_async: bool = False,
+    def combine(self, count: int, func: ReduceFunc, op0: ACCLBuffer | None,
+                op1: ACCLBuffer, res: ACCLBuffer | None, *,
+                stream_dtype=None,
+                stream_flags: StreamFlags = StreamFlags.NO_STREAM,
+                run_async: bool = False,
                 waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+        """With OP0_STREAM the first operand is sourced from this rank's
+        stream-in port (op0 may be None); with RES_STREAM the result
+        lands on the stream-out port (res may be None) — the
+        combine-from-stream shape of the reference's plugin datapath."""
         desc = self._prepare(CCLOp.combine, count=count, comm=self.comm,
-                             func=func, op0=op0, op1=op1, res=res)
+                             func=func, op0=op0, op1=op1, res=res,
+                             stream_dtype=stream_dtype,
+                             stream_flags=stream_flags)
         return self._call(desc, run_async, waitfor)
 
     def send(self, srcbuf: ACCLBuffer | None, count: int, dst: int,

@@ -53,6 +53,7 @@ from ..buffer import ACCLBuffer
 from ..call import CallDescriptor, CallHandle
 from ..communicator import Communicator
 from ..constants import (ACCLError, CCLOp, CollectiveAlgorithm, Compression,
+                         StreamFlags,
                          DEFAULT_MAX_SEGMENT_SIZE, DEFAULT_TIMEOUT_S,
                          ErrorCode, ReduceFunc, check_algorithm)
 from ..emulator.executor import DeviceMemory
@@ -73,6 +74,10 @@ def _factor_2d(w: int) -> tuple[int, int]:
 _COLLECTIVES = {CCLOp.bcast, CCLOp.scatter, CCLOp.gather, CCLOp.reduce,
                 CCLOp.allgather, CCLOp.allreduce, CCLOp.reduce_scatter,
                 CCLOp.alltoall, CCLOp.barrier}
+
+# on-device combine arithmetic for the streamed/fused local datapath
+_COMBINE_JNP = {ReduceFunc.SUM: jnp.add, ReduceFunc.MAX: jnp.maximum,
+                ReduceFunc.MIN: jnp.minimum, ReduceFunc.PROD: jnp.multiply}
 
 
 class TpuContext:
@@ -289,6 +294,131 @@ class TpuContext:
             return self._subtrees.setdefault(key, tree)
 
 
+class DeviceStreamPort:
+    """Device-resident external-kernel stream ports for one rank.
+
+    The TPU-native mapping of the reference's AXIS stream ports
+    (SWITCH_M_BYPASS, streamdefines.h:39): entries are 1-D jax arrays
+    living on this rank's device — a staging ring the fused ops read
+    from and write to WITHOUT the payload ever visiting the host.
+    Continuous-stream semantics mirror the emulator executor's ports:
+    a take may span entries and consume one partially; a shortfall
+    blocks to a deadline and consumes nothing on timeout (stalled-AXIS
+    parity, KRNL_TIMEOUT upstream)."""
+
+    def __init__(self, device):
+        self.dev = device                     # the rank's jax device
+        self._in: collections.deque = collections.deque()
+        self._in_off = 0                      # consumed prefix of _in[0]
+        self._out: collections.deque = collections.deque()
+        self._out_off = 0
+        self._cv = threading.Condition()
+
+    def push(self, data) -> None:
+        host = np.asarray(data).reshape(-1)
+        if jax.dtypes.canonicalize_dtype(host.dtype) == host.dtype:
+            entry = jax.device_put(host, self.dev)  # one transfer
+        else:
+            # dtype jax cannot represent with x64 off (int64/f64): keep
+            # the host array — truncating user bits on a stream port is
+            # never acceptable (the emulator tiers preserve them)
+            entry = host
+        with self._cv:
+            self._in.append(entry)
+            self._cv.notify_all()
+
+    @staticmethod
+    def _avail(q, off) -> int:
+        return sum(e.shape[0] for e in q) - off
+
+    @staticmethod
+    def _assemble(q, off, count, dtype):
+        """Pop ``count`` elements off the front of ``q`` (device slices,
+        concatenated on device; host-preserved 64-bit entries assemble
+        on host so their bits survive). Returns (array, new_off)."""
+        pieces = []
+        need = count
+        while need:
+            e = q[0]
+            take = min(need, e.shape[0] - off)
+            piece = e if (off == 0 and take == e.shape[0]) \
+                else e[off:off + take]
+            pieces.append(piece)
+            need -= take
+            off += take
+            if off == e.shape[0]:
+                q.popleft()
+                off = 0
+        if any(isinstance(p, np.ndarray) for p in pieces):
+            out = (pieces[0] if len(pieces) == 1
+                   else np.concatenate([np.asarray(p) for p in pieces]))
+            if dtype is not None and out.dtype != np.dtype(dtype):
+                out = out.astype(dtype)
+            return out, off
+        out = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+        if dtype is not None and out.dtype != jnp.dtype(dtype):
+            out = out.astype(dtype)
+        return out, off
+
+    def take(self, count: int, dtype, deadline: float):
+        """Blocking stream-in read of exactly ``count`` elements; None on
+        timeout (nothing consumed — a retry after the rest arrives must
+        succeed)."""
+        with self._cv:
+            while self._avail(self._in, self._in_off) < count:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    return None
+            out, self._in_off = self._assemble(self._in, self._in_off,
+                                               count, dtype)
+            return out
+
+    def put_out(self, arr) -> None:
+        with self._cv:
+            self._out.append(arr.reshape(-1))
+            self._cv.notify_all()
+
+    def put_in(self, arr) -> None:
+        """Remote-stream delivery (a peer's stream_put lands here)."""
+        with self._cv:
+            self._in.append(arr.reshape(-1))
+            self._cv.notify_all()
+
+    def pop(self, timeout: float = 0.0, count: int | None = None):
+        """Stream-out read: ``count`` elements across entries, or the
+        next entry whole (count None/0). IndexError when it never fills
+        (emulator pop_stream_out parity)."""
+        deadline = time.monotonic() + timeout
+        if not count:
+            count = None
+        with self._cv:
+            while True:
+                if count is None:
+                    if self._out:
+                        e = self._out[0]
+                        if self._out_off:
+                            e, _ = self._assemble(
+                                self._out, self._out_off,
+                                e.shape[0] - self._out_off, None)
+                            self._out_off = 0
+                        else:
+                            self._out.popleft()
+                        return e
+                elif self._avail(self._out, self._out_off) >= count:
+                    out, self._out_off = self._assemble(
+                        self._out, self._out_off, count, None)
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    raise IndexError("stream-out port empty")
+
+    def reset(self) -> None:
+        with self._cv:
+            self._in.clear()
+            self._out.clear()
+            self._in_off = self._out_off = 0
+
+
 class TpuDevice(Device):
     """One rank's view of the TPU-backed world."""
 
@@ -306,6 +436,7 @@ class TpuDevice(Device):
         self.timeout = DEFAULT_TIMEOUT_S
         self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
         self.profiling = False  # armed by the start_profiling config call
+        self.sport = DeviceStreamPort(self.my_device)
         self._coll_index: dict[int, int] = collections.defaultdict(int)
         self._calls: queue.Queue = queue.Queue()
         self._worker = threading.Thread(target=self._run, daemon=True,
@@ -397,6 +528,9 @@ class TpuDevice(Device):
             self.ctx._sends.clear()
             self.ctx._parked_sends = 0
         self._coll_index.clear()
+        # stale cross-epoch stream data must not leak to the next
+        # consumer (emulator reset_streams parity)
+        self.sport.reset()
 
     def deinit(self):
         self._calls.put(None)
@@ -508,21 +642,31 @@ class TpuDevice(Device):
             return 0
         if op == CCLOp.config:
             return self.apply_config(desc)  # shared dispatch (Device base)
-        if desc.stream_flags:
-            # no host-side stream port on this tier: a streamed operand or
-            # result belongs INSIDE the jitted program (fuse the producer/
-            # consumer with the collective). Reject explicitly rather than
-            # silently executing a memory-only variant.
+        if desc.stream_flags and op not in (CCLOp.copy, CCLOp.combine,
+                                            CCLOp.send, CCLOp.recv):
+            # streamed operands on the p2p/local ops ride the device-
+            # resident ports (DeviceStreamPort); for collectives a
+            # streamed operand belongs INSIDE the jitted program — reject
+            # explicitly rather than silently executing a memory-only
+            # variant (the emulator tiers silently ignore the flags
+            # there, which is the one behavior we refuse to copy)
             return int(ErrorCode.STREAM_NOT_SUPPORTED)
         comm = self.comms.get(desc.comm_id)
         if comm is None:
             return int(ErrorCode.COMM_NOT_CONFIGURED)
+        s_op0 = bool(desc.stream_flags & StreamFlags.OP0_STREAM)
+        s_res = bool(desc.stream_flags & StreamFlags.RES_STREAM)
         if op == CCLOp.copy:
+            if s_op0 or s_res:
+                return self._streamed_local(desc, s_op0, s_res, None)
             data = self._read_operand(desc.addr_0, desc.count, desc,
                                       Compression.OP0_COMPRESSED)
             self._write_result(desc.addr_2, data, desc)
             return 0
         if op == CCLOp.combine:
+            if s_op0 or s_res:
+                return self._streamed_local(desc, s_op0, s_res,
+                                            desc.function)
             from ..emulator.executor import _REDUCERS
             a = self._read_operand(desc.addr_0, desc.count, desc,
                                    Compression.OP0_COMPRESSED)
@@ -539,6 +683,69 @@ class TpuDevice(Device):
             return self._do_collective(desc, comm, handle, defer_launch)
         return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
 
+    # -- streamed local ops (device-resident port datapath) ----------------
+    def _op0_device(self, desc: CallDescriptor) -> jax.Array:
+        """First operand as a device array: zero-copy for device-resident
+        buffers, one H2D for host mirrors."""
+        buf = self.dev_bufs.get(desc.addr_0)
+        uncomp = desc.arithcfg.uncompressed_dtype
+        if buf is not None and buf.size >= desc.count:
+            arr = buf.jax.reshape(-1)[:desc.count]
+            return arr.astype(uncomp) if arr.dtype != jnp.dtype(uncomp) \
+                else arr
+        host = self._read_operand(desc.addr_0, desc.count, desc,
+                                  Compression.OP0_COMPRESSED)
+        return jax.device_put(np.array(host, copy=True), self.my_device)
+
+    def _streamed_local(self, desc: CallDescriptor, s_op0: bool,
+                        s_res: bool, func) -> int:
+        """copy/combine with streamed first operand and/or result: the
+        payload stays a device array end to end — port take, (optional)
+        on-device arithmetic against op1, port deposit or buffer rebind.
+        This is the SURVEY §2.9 mapping of MOVE_STREAM/the bypass port:
+        producer and consumer attach at the device-resident ports, and
+        the op itself is a fused device program."""
+        uncomp = desc.arithcfg.uncompressed_dtype
+        deadline = (desc.deadline if desc.deadline is not None
+                    else time.monotonic() + self.timeout)
+        if s_op0:
+            data = self.sport.take(desc.count, uncomp, deadline)
+            if data is None:
+                # stalled-stream semantics: same error word as the
+                # emulator tiers, nothing consumed
+                return int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
+        else:
+            data = self._op0_device(desc)
+        if func is not None:
+            b = self._read_operand(desc.addr_1, desc.count, desc,
+                                   Compression.OP1_COMPRESSED)
+            if isinstance(data, np.ndarray):
+                # host-preserved 64-bit entry: arithmetic stays in numpy
+                # (jnp would canonicalize both operands to 32 bits and
+                # silently corrupt exactly the bits push() preserved)
+                from ..emulator.executor import _REDUCERS
+                data = _REDUCERS[func](data, np.asarray(b, data.dtype))
+            else:
+                data = _COMBINE_JNP[func](data,
+                                          jax.device_put(b, self.my_device))
+        if s_res:
+            self.sport.put_out(data)
+            return 0
+        dst = self.dev_bufs.get(desc.addr_2)
+        if (dst is not None and dst.size == desc.count
+                and not (desc.compression & Compression.RES_COMPRESSED)):
+            self._rebind_dev(dst, data)
+        else:
+            self._write_result(desc.addr_2, np.asarray(data), desc)
+        return 0
+
+    # -- external-kernel stream ports (Device interface) -------------------
+    def push_stream(self, data):
+        self.sport.push(data)
+
+    def pop_stream(self, timeout: float = 0.0, count: int | None = None):
+        return self.sport.pop(timeout, count)
+
     # -- send/recv rendezvous ---------------------------------------------
     def _do_send(self, desc: CallDescriptor, comm: Communicator) -> int:
         """Eager send: snapshot the payload onto THIS rank's device and
@@ -552,24 +759,65 @@ class TpuDevice(Device):
         source buffer is reusable the moment send returns)."""
         wire = (desc.arithcfg.compressed_dtype
                 if desc.compression & Compression.ETH_COMPRESSED else None)
-        buf = self.dev_bufs.get(desc.addr_0)
-        if (buf is not None and buf.size == desc.count
-                and not (desc.compression & Compression.OP0_COMPRESSED)):
-            payload = buf.jax
-            if payload.ndim != 1:
-                payload = payload.reshape(-1)
+        if desc.stream_flags & StreamFlags.OP0_STREAM:
+            # send-from-stream: the payload comes off the device-resident
+            # stream-in port (no buffer, no host staging)
+            deadline = (desc.deadline if desc.deadline is not None
+                        else time.monotonic() + self.timeout)
+            uncomp = np.dtype(desc.arithcfg.uncompressed_dtype)
+            if jax.dtypes.canonicalize_dtype(uncomp) != uncomp:
+                # a 64-bit payload cannot cross the device fabric (jax
+                # x64 off would truncate it in the exchange program):
+                # refuse loudly BEFORE consuming the stream — the
+                # emulator tiers carry these, this tier keeps them
+                # local-port-only
+                return int(ErrorCode.STREAM_NOT_SUPPORTED)
+            payload = self.sport.take(desc.count, uncomp, deadline)
+            if payload is None:
+                return int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
+            if isinstance(payload, np.ndarray):
+                # host-preserved entries cast to a canonical dtype by the
+                # take land on device here (the gate above guarantees no
+                # truncation)
+                payload = jax.device_put(payload, self.my_device)
             if wire is not None and payload.dtype != jnp.dtype(wire):
-                payload = payload.astype(wire)  # on-device wire cast
+                payload = payload.astype(wire)
         else:
-            host = self._read_operand(desc.addr_0, desc.count, desc,
-                                      Compression.OP0_COMPRESSED)
-            if wire is not None:
-                host = host.astype(wire)
-            # np.array(copy=True): device_put may alias host memory on
-            # the CPU backend, and the caller may overwrite the source
-            # right after send returns
-            payload = jax.device_put(np.array(host, copy=True),
-                                     self.my_device)
+            buf = self.dev_bufs.get(desc.addr_0)
+            if (buf is not None and buf.size == desc.count
+                    and not (desc.compression & Compression.OP0_COMPRESSED)):
+                payload = buf.jax
+                if payload.ndim != 1:
+                    payload = payload.reshape(-1)
+                if wire is not None and payload.dtype != jnp.dtype(wire):
+                    payload = payload.astype(wire)  # on-device wire cast
+            else:
+                host = self._read_operand(desc.addr_0, desc.count, desc,
+                                          Compression.OP0_COMPRESSED)
+                if wire is not None:
+                    host = host.astype(wire)
+                # np.array(copy=True): device_put may alias host memory on
+                # the CPU backend, and the caller may overwrite the source
+                # right after send returns
+                payload = jax.device_put(np.array(host, copy=True),
+                                         self.my_device)
+        if desc.stream_flags & StreamFlags.RES_STREAM:
+            # remote-stream send (stream_put): the payload crosses the
+            # device fabric and lands on the PEER's stream-in port,
+            # bypassing the rx matching queue (strm=1 wire parity,
+            # dma_mover.cpp:303) — seqn is NOT consumed
+            dst_local = desc.root_src_dst
+            peer = self.ctx.devices[
+                comm.ranks[dst_local].global_rank]
+            if dst_local != comm.local_rank:
+                payload = self.ctx.exchange_transfer(
+                    comm, payload, comm.local_rank, dst_local)
+            if payload.dtype != jnp.dtype(
+                    desc.arithcfg.uncompressed_dtype):
+                payload = payload.astype(
+                    desc.arithcfg.uncompressed_dtype)  # wire decompress
+            peer.sport.put_in(payload)
+            return 0
         dst_g = comm.ranks[desc.root_src_dst].global_rank
         key = (desc.comm_id, comm.my_global_rank, dst_g)
         ctx = self.ctx
@@ -634,6 +882,11 @@ class TpuDevice(Device):
         uncomp = desc.arithcfg.uncompressed_dtype
         if received.dtype != jnp.dtype(uncomp):
             received = received.astype(uncomp)  # wire decompress, on device
+        if desc.stream_flags & StreamFlags.RES_STREAM:
+            # recv-to-stream: the received device array lands on the
+            # local stream-out port (no buffer, no host staging)
+            self.sport.put_out(received)
+            return 0
         dst = self.dev_bufs.get(desc.addr_2)
         if (dst is not None and dst.size == desc.count
                 and not (desc.compression & Compression.RES_COMPRESSED)):

@@ -164,15 +164,26 @@ def expand_copy(ctx: MoveContext, count: int, src: int, dst: int,
 
 def expand_combine(ctx: MoveContext, count: int, func: ReduceFunc,
                    op0: int, op1: int, dst: int,
-                   compression: Compression = Compression.NONE) -> list[Move]:
-    """combine (c:319-335): res = func(op0, op1) locally."""
+                   compression: Compression = Compression.NONE,
+                   stream: StreamFlags = StreamFlags.NO_STREAM) -> list[Move]:
+    """combine (c:319-335): res = func(op0, op1) locally. OP0/RES stream
+    flags source the first operand from / sink the result to the
+    external-kernel ports, like copy (the combine-from-stream shape of
+    the reference's plugin datapath)."""
+    s_op0 = bool(stream & StreamFlags.OP0_STREAM)
+    s_res = bool(stream & StreamFlags.RES_STREAM)
     return [Move(
         count=count,
-        op0=Operand.imm(op0, bool(compression & Compression.OP0_COMPRESSED)),
+        op0=(Operand.stream() if s_op0
+             else Operand.imm(op0,
+                              bool(compression & Compression.OP0_COMPRESSED))),
         op1=Operand.imm(op1, bool(compression & Compression.OP1_COMPRESSED)),
-        res=Operand.imm(dst, bool(compression & Compression.RES_COMPRESSED)),
+        res=(Operand.stream() if s_res
+             else Operand.imm(dst,
+                              bool(compression & Compression.RES_COMPRESSED))),
         func=func, res_local=True,
-        mode_label="IMMEDIATE/IMMEDIATE/IMMEDIATE")]
+        mode_label=(f"{'STREAM' if s_op0 else 'IMMEDIATE'}/IMMEDIATE/"
+                    f"{'STREAM' if s_res else 'IMMEDIATE'}"))]
 
 
 def expand_send(ctx: MoveContext, count: int, src: int, dst_rank: int,
@@ -722,7 +733,7 @@ def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
         return expand_copy(ctx, count, addr_0, addr_2, compression, stream)
     if scenario == CCLOp.combine:
         return expand_combine(ctx, count, func, addr_0, addr_1, addr_2,
-                              compression)
+                              compression, stream)
     if scenario == CCLOp.send:
         # RES_STREAM on a send targets the peer's stream port instead of its
         # rx pool (remote-stream send, dma_mover.cpp:303).

@@ -647,11 +647,12 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c_in, uint8_t op,
                        int func, uint64_t count, uint32_t root, uint32_t tag,
                        uint64_t a0, uint64_t a1, uint64_t a2,
                        uint8_t alg = ALG_AUTO) {
-  // stream flags apply only to copy/send/recv (moveengine.expand_call
-  // parity) — a collective's internal copies must never source/sink the
-  // external-kernel stream ports
+  // stream flags apply only to copy/combine/send/recv
+  // (moveengine.expand_call parity) — a collective's internal copies
+  // must never source/sink the external-kernel stream ports
   CallCtx c = c_in;
-  if (op != OP_COPY && op != OP_SEND && op != OP_RECV) c.stream = 0;
+  if (op != OP_COPY && op != OP_COMBINE && op != OP_SEND && op != OP_RECV)
+    c.stream = 0;
   const uint32_t W = c.world, me = c.me;
   size_t eb = c.ebytes(c.compression & C_OP0);
   size_t ebr = c.ebytes(c.compression & C_RES);
@@ -689,11 +690,19 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c_in, uint8_t op,
       push_copy(mv, c, count, a0, a2);
       return E_OK;
     case OP_COMBINE: {
+      // OP0/RES stream flags route through the external-kernel ports,
+      // like copy (combine-from-stream; moveengine.expand_combine twin)
       Move m;
       m.count = count;
-      m.op0 = {M_IMM, a0, 0, TAG_ANY, (c.compression & C_OP0) != 0};
+      if (c.stream & 1)
+        m.op0 = {M_STREAM, 0, 0, TAG_ANY, false};
+      else
+        m.op0 = {M_IMM, a0, 0, TAG_ANY, (c.compression & C_OP0) != 0};
       m.op1 = {M_IMM, a1, 0, TAG_ANY, (c.compression & C_OP1) != 0};
-      m.res = {M_IMM, a2, 0, TAG_ANY, (c.compression & C_RES) != 0};
+      if (c.stream & 2)
+        m.res = {M_STREAM, 0, 0, TAG_ANY, false};
+      else
+        m.res = {M_IMM, a2, 0, TAG_ANY, (c.compression & C_RES) != 0};
       m.func = func;
       m.res_local = true;
       mv.push_back(m);

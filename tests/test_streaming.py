@@ -81,6 +81,24 @@ def _stream_suite(accls):
     h.wait(5.0)
     np.testing.assert_array_equal(got, _x(6))
 
+    # 4b. combine-from-stream: op0 off the stream-in port, memory op1,
+    #     memory result (the plugin-datapath shape; expand_combine's
+    #     stream plumbing on every tier)
+    from accl_tpu.constants import ReduceFunc
+    a0.stream_push(_x(11))
+    op1 = a0.buffer(data=np.full(N, 5.0, np.float32))
+    resb = a0.buffer((N,), np.float32)
+    a0.combine(N, ReduceFunc.SUM, None, op1, resb,
+               stream_flags=StreamFlags.OP0_STREAM)
+    a0.sync_from(resb)
+    np.testing.assert_allclose(resb.data, _x(11) + 5.0, rtol=1e-6)
+    # and combine-to-stream: result on the stream-out port
+    a0.combine(N, ReduceFunc.MAX, op1, resb, None,
+               stream_flags=StreamFlags.RES_STREAM)
+    got = np.asarray(a0.stream_pop(5.0))
+    np.testing.assert_allclose(got, np.maximum(np.full(N, 5.0), resb.data),
+                               rtol=1e-6)
+
     # 5. CONTINUOUS-stream semantics (AXIS parity): transfers larger than
     #    max_segment_size span wire segments / multiple RES_STREAM moves,
     #    and element counts are consumed across push boundaries
@@ -197,28 +215,153 @@ def test_streams_native_daemon():
             p.kill()
 
 
-def test_streams_rejected_on_tpu_tier():
-    """TpuDevice must reject stream flags explicitly, not silently execute
-    a memory-only variant (round-2 review: device/tpu.py ignored
-    desc.stream_flags)."""
-    from accl_tpu.device.tpu import tpu_world
+def test_streams_tpu_tier():
+    """The TPU tier's stream ports are DEVICE-RESIDENT staging rings
+    (device/tpu.py DeviceStreamPort — the SURVEY §2.9 mapping of the
+    AXIS bypass port): streamed copy/combine/send/recv payloads stay jax
+    device arrays end to end, with the emulator suite's semantics
+    (continuous streams, stalled-stream timeout, remote-stream put)."""
+    import jax as _jax
+
+    from accl_tpu.constants import ReduceFunc
+    from accl_tpu.device.tpu import TpuDevice, tpu_world
 
     accls = tpu_world(2, platform="cpu")
-    a = accls[0]
-    src = a.buffer(data=_x(1))
-    dst = a.buffer((N,), np.float32)
-    with pytest.raises(ACCLError) as ei:
-        a.copy(src, dst, N, stream_flags=StreamFlags.RES_STREAM)
-    assert ei.value.error_word == int(ErrorCode.STREAM_NOT_SUPPORTED)
-    with pytest.raises(ACCLError) as ei:
-        a.stream_push(_x(1))
-    assert ei.value.error_word == int(ErrorCode.STREAM_NOT_SUPPORTED)
-    with pytest.raises(ACCLError) as ei:
-        a.stream_pop()
-    assert ei.value.error_word == int(ErrorCode.STREAM_NOT_SUPPORTED)
-    # memory-path calls still work on the same world
-    a2 = accls[1]
+    a0 = accls[0]
 
+    # 1. remote-stream put -> peer OP0_STREAM copy (payload crosses the
+    #    device fabric and lands on the peer's stream-in port)
+    def fn1(a):
+        if a.rank == 0:
+            a.stream_put(a.buffer(data=_x(1)), N, dst=1)
+        else:
+            dst = a.buffer((N,), np.float32)
+            a.copy(None, dst, N, stream_flags=StreamFlags.OP0_STREAM)
+            return dst.data.copy()
+
+    np.testing.assert_array_equal(run_ranks(accls, fn1)[1], _x(1))
+
+    # 2. RES_STREAM local sink -> stream_pop; the popped entry is a live
+    #    DEVICE array (fused execution, not a host staging round trip)
+    a0.copy(a0.buffer(data=_x(2)), None, N,
+            stream_flags=StreamFlags.RES_STREAM)
+    popped = a0.stream_pop(5.0)
+    assert isinstance(popped, _jax.Array)
+    np.testing.assert_array_equal(np.asarray(popped), _x(2))
+
+    # 3. send-from-stream -> recv-to-stream, zero host staging asserted
+    #    via read/write spies on both ranks' devices
+    crossings = []
+    orig_r, orig_w = TpuDevice._read_operand, TpuDevice._write_result
+    TpuDevice._read_operand = lambda self, *a, **k: (
+        crossings.append("r"), orig_r(self, *a, **k))[1]
+    TpuDevice._write_result = lambda self, *a, **k: (
+        crossings.append("w"), orig_w(self, *a, **k))[1]
+    try:
+        def fn3(a):
+            if a.rank == 0:
+                a.stream_push(_x(3))
+                a.send(None, N, dst=1, tag=7,
+                       stream_flags=StreamFlags.OP0_STREAM)
+            else:
+                a.recv(None, N, src=0, tag=7,
+                       stream_flags=StreamFlags.RES_STREAM)
+                return np.asarray(a.stream_pop(5.0)).copy()
+
+        np.testing.assert_array_equal(run_ranks(accls, fn3)[1], _x(3))
+        assert not crossings, f"host staging on stream path: {crossings}"
+    finally:
+        TpuDevice._read_operand = orig_r
+        TpuDevice._write_result = orig_w
+
+    # 4. combine-from-stream: op0 off the port, on-device arithmetic,
+    #    device-resident result
+    a0.stream_push(_x(7))
+    op1 = a0.buffer(data=np.full(N, 10.0, np.float32))
+    res = a0.buffer((N,), np.float32, device_resident=True)
+    a0.combine(N, ReduceFunc.SUM, None, op1, res,
+               stream_flags=StreamFlags.OP0_STREAM)
+    assert res.is_device_resident
+    np.testing.assert_allclose(res.data, _x(7) + 10.0, rtol=1e-6)
+
+    # 5. continuous-stream takes spanning pushed entries
+    a0.stream_push(_x(1)[:3])
+    a0.stream_push(_x(1)[3:])
+    a0.stream_push(_x(2))
+    d = a0.buffer((N,), np.float32)
+    a0.copy(None, d, N, stream_flags=StreamFlags.OP0_STREAM)
+    np.testing.assert_array_equal(d.data, _x(1))
+    d2 = a0.buffer((N,), np.float32)
+    a0.copy(None, d2, N, stream_flags=StreamFlags.OP0_STREAM)
+    np.testing.assert_array_equal(d2.data, _x(2))
+
+    # 6. 64-bit payloads survive bit-exact (host-preserved entries: jax
+    #    without x64 would truncate them)
+    precise = np.array([2**53 + 1, -7] * (N // 2), dtype=np.int64)
+    a0.stream_push(precise)
+    a0.copy(None, None, N, stream_dtype=np.int64,
+            stream_flags=StreamFlags.OP0_STREAM | StreamFlags.RES_STREAM)
+    got = np.asarray(a0.stream_pop(5.0))
+    assert got.dtype == np.int64
+    np.testing.assert_array_equal(got, precise)
+
+    # 6b. 64-bit combine stays exact (numpy arithmetic for host-
+    #     preserved entries), and a 64-bit CROSS-RANK send is refused
+    #     loudly BEFORE consuming the stream (the device fabric would
+    #     truncate it) — the data must survive for the local path
+    big = np.array([2**53 + 1, -7, 2**62, 5], dtype=np.int64)[:N]
+    pad = np.arange(max(0, N - 4), dtype=np.int64)
+    big = np.concatenate([big, pad])[:N]
+    a0.stream_push(big)
+    op1_64 = a0.buffer(data=np.ones(N, np.int64))
+    res_64 = a0.buffer((N,), np.int64)
+    a0.combine(N, ReduceFunc.SUM, None, op1_64, res_64,
+               stream_dtype=np.int64, stream_flags=StreamFlags.OP0_STREAM)
+    np.testing.assert_array_equal(res_64.data, big + 1)
+    a0.stream_push(big)
+    with pytest.raises(ACCLError) as ei:
+        a0.send(None, N, dst=1, stream_dtype=np.int64,
+                stream_flags=StreamFlags.OP0_STREAM)
+    assert ei.value.error_word == int(ErrorCode.STREAM_NOT_SUPPORTED)
+    a0.copy(None, None, N, stream_dtype=np.int64,
+            stream_flags=StreamFlags.OP0_STREAM | StreamFlags.RES_STREAM)
+    np.testing.assert_array_equal(np.asarray(a0.stream_pop(5.0)), big)
+
+    # 7. stalled-stream timeout consumes nothing; a retry succeeds
+    a0.set_timeout(0.4)
+    try:
+        a0.stream_push(_x(1)[: N // 2])
+        with pytest.raises(ACCLError) as ei:
+            a0.copy(None, a0.buffer((N,), np.float32), N,
+                    stream_flags=StreamFlags.OP0_STREAM)
+        assert ei.value.error_word & int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
+        a0.stream_push(_x(1)[N // 2:])
+        dst = a0.buffer((N,), np.float32)
+        a0.copy(None, dst, N, stream_flags=StreamFlags.OP0_STREAM)
+        np.testing.assert_array_equal(dst.data, _x(1))
+    finally:
+        a0.set_timeout(20.0)
+
+    # 8. soft reset drains the ports
+    a0.stream_push(_x(9))
+    a0.soft_reset()
+    with pytest.raises(IndexError):
+        a0.stream_pop(0.05)
+
+    # 9. streamed COLLECTIVES stay explicitly rejected (they belong
+    #    inside the jitted program, never a silent memory-only variant);
+    #    the driver API has no stream flag on collectives, so probe at
+    #    the device call layer
+    from accl_tpu.constants import CCLOp
+    desc = a0._prepare(CCLOp.allreduce, count=N, comm=a0.comm,
+                       op0=a0.buffer(data=_x(4)),
+                       res=a0.buffer((N,), np.float32))
+    desc.stream_flags = StreamFlags.OP0_STREAM
+    with pytest.raises(ACCLError) as ei:
+        a0.device.call_sync(desc, timeout=5.0)
+    assert ei.value.error_word == int(ErrorCode.STREAM_NOT_SUPPORTED)
+
+    # memory-path calls still work on the same world
     def fn(acc):
         s = acc.buffer(data=_x(4))
         d = acc.buffer((N,), np.float32)
@@ -227,4 +370,3 @@ def test_streams_rejected_on_tpu_tier():
 
     for out in run_ranks(accls, fn):
         np.testing.assert_allclose(out, 2 * _x(4))
-    del a2
