@@ -80,6 +80,20 @@ _COMBINE_JNP = {ReduceFunc.SUM: jnp.add, ReduceFunc.MAX: jnp.maximum,
                 ReduceFunc.MIN: jnp.minimum, ReduceFunc.PROD: jnp.multiply}
 
 
+class _XchgEntry:
+    """One matched p2p transfer waiting in the exchange window."""
+
+    __slots__ = ("src", "dst", "payload", "result", "error", "done")
+
+    def __init__(self, src: int, dst: int, payload):
+        self.src = src
+        self.dst = dst
+        self.payload = payload
+        self.result = None
+        self.error: BaseException | None = None
+        self.done = threading.Event()
+
+
 class TpuContext:
     """Shared state of an N-rank TPU-backed world (single SPMD controller)."""
 
@@ -119,6 +133,10 @@ class TpuContext:
         # Cached per (device, size, dtype) — they're constant zeros.
         self._zeros: dict[tuple, jax.Array] = {}
         self._zeros_mu = threading.Lock()
+        # exchange window: comm_id -> queued _XchgEntry; comm_ids with a
+        # live batch executor (guarded by _lock)
+        self._xchg_pending: dict[int, list] = collections.defaultdict(list)
+        self._xchg_running: set[int] = set()
 
     # cap on cached filler shards: a size sweep would otherwise pin one
     # device array per distinct (device, size, dtype) forever
@@ -163,19 +181,106 @@ class TpuContext:
         a ppermute program over the communicator's mesh (parity: the
         reference's send/recv ride the real transport end-to-end,
         ccl_offload_control.c:339-380). Returns the received shard (on
-        the destination rank's device)."""
+        the destination rank's device).
+
+        Matched pairs BATCH opportunistically: transfers deposited while
+        an exchange program is running ride the next program together
+        (one ppermute with per-pair payloads) instead of one full-mesh
+        program each — K concurrent sendrecvs execute in <=2 programs,
+        not K, with no added latency for a solo transfer (the first
+        arrival never waits for a window to fill)."""
+        entry = _XchgEntry(src_local, dst_local, payload)
+        cid = comm.comm_id
+        with self._lock:
+            self._xchg_pending[cid].append(entry)
+            leader = cid not in self._xchg_running
+            if leader:
+                self._xchg_running.add(cid)
+        if not leader:
+            # an executor is live and guaranteed to drain the window
+            entry.done.wait()
+            if entry.error is not None:
+                raise entry.error
+            return entry.result
+        clean = False
+        try:
+            while True:
+                with self._lock:
+                    batch = self._xchg_pending[cid]
+                    if not batch:
+                        self._xchg_running.discard(cid)
+                        clean = True
+                        break
+                    self._xchg_pending[cid] = []
+                try:
+                    self._run_exchange_batch(comm, batch)
+                except BaseException as exc:
+                    for e in batch:
+                        if not e.done.is_set():  # completed rounds stand
+                            e.error = exc
+                            e.done.set()
+        finally:
+            # abnormal exit only (a clean exit already handed leadership
+            # off under the lock — a NEW leader may own the window now,
+            # and popping here would steal its entries): fail anything
+            # still queued and clear the running flag so the next
+            # arrival can lead
+            if not clean:
+                with self._lock:
+                    leaked = self._xchg_pending[cid]
+                    self._xchg_pending[cid] = []
+                    self._xchg_running.discard(cid)
+                for e in leaked:
+                    e.error = RuntimeError("exchange executor died")
+                    e.done.set()
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    def _run_exchange_batch(self, comm: Communicator, entries: list):
+        """Execute one window of matched transfers: entries group by
+        payload geometry, each group splits greedily into permutation
+        rounds (a ppermute source/destination appears once per round),
+        and every round is ONE exchange program."""
         coll = self.coll_for(comm)
-        n = payload.shape[0]
         devs = coll.device_list
-        shards = [payload if r == src_local
-                  else self.zero_shard(d, n, payload.dtype)
-                  for r, d in enumerate(devs)]
-        x = self.assemble_flat(coll, shards)
-        out = coll.exchange_flat(x, ((src_local, dst_local),))
-        for s in out.addressable_shards:
-            if (s.index[0].start or 0) == dst_local * n:
-                return s.data
-        raise RuntimeError("destination shard missing from exchange output")
+        groups: dict[tuple, list] = collections.defaultdict(list)
+        for e in entries:
+            groups[(e.payload.shape[0], str(e.payload.dtype))].append(e)
+        for (n, _dt), group in groups.items():
+            remaining = group
+            while remaining:
+                round_entries, nxt = [], []
+                srcs, dsts = set(), set()
+                for e in remaining:
+                    if e.src in srcs or e.dst in dsts:
+                        nxt.append(e)   # conflicts ride the next round
+                    else:
+                        srcs.add(e.src)
+                        dsts.add(e.dst)
+                        round_entries.append(e)
+                remaining = nxt
+                by_src = {e.src: e for e in round_entries}
+                shards = [by_src[r].payload if r in by_src
+                          else self.zero_shard(
+                              d, n, round_entries[0].payload.dtype)
+                          for r, d in enumerate(devs)]
+                x = self.assemble_flat(coll, shards)
+                pairs = tuple(sorted((e.src, e.dst)
+                                     for e in round_entries))
+                out = coll.exchange_flat(x, pairs)
+                by_dst = {e.dst: e for e in round_entries}
+                for s in out.addressable_shards:
+                    r = (s.index[0].start or 0) // n
+                    e = by_dst.get(r)
+                    if e is not None:
+                        e.result = s.data
+                        e.done.set()
+                for e in round_entries:   # paranoia: no silent waiter
+                    if not e.done.is_set():
+                        e.error = RuntimeError(
+                            "destination shard missing from exchange")
+                        e.done.set()
 
     def device(self, rank: int) -> "TpuDevice":
         if self.devices[rank] is None:
@@ -684,17 +789,17 @@ class TpuDevice(Device):
         return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
 
     # -- streamed local ops (device-resident port datapath) ----------------
-    def _op0_device(self, desc: CallDescriptor) -> jax.Array:
-        """First operand as a device array: zero-copy for device-resident
+    def _operand_device(self, desc: CallDescriptor, addr: int,
+                        which: Compression) -> jax.Array:
+        """An operand as a device array: zero-copy for device-resident
         buffers, one H2D for host mirrors."""
-        buf = self.dev_bufs.get(desc.addr_0)
+        buf = self.dev_bufs.get(addr)
         uncomp = desc.arithcfg.uncompressed_dtype
         if buf is not None and buf.size >= desc.count:
             arr = buf.jax.reshape(-1)[:desc.count]
             return arr.astype(uncomp) if arr.dtype != jnp.dtype(uncomp) \
                 else arr
-        host = self._read_operand(desc.addr_0, desc.count, desc,
-                                  Compression.OP0_COMPRESSED)
+        host = self._read_operand(addr, desc.count, desc, which)
         return jax.device_put(np.array(host, copy=True), self.my_device)
 
     def _streamed_local(self, desc: CallDescriptor, s_op0: bool,
@@ -715,19 +820,23 @@ class TpuDevice(Device):
                 # emulator tiers, nothing consumed
                 return int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
         else:
-            data = self._op0_device(desc)
+            data = self._operand_device(desc, desc.addr_0,
+                                        Compression.OP0_COMPRESSED)
         if func is not None:
-            b = self._read_operand(desc.addr_1, desc.count, desc,
-                                   Compression.OP1_COMPRESSED)
             if isinstance(data, np.ndarray):
                 # host-preserved 64-bit entry: arithmetic stays in numpy
                 # (jnp would canonicalize both operands to 32 bits and
                 # silently corrupt exactly the bits push() preserved)
                 from ..emulator.executor import _REDUCERS
+                b = self._read_operand(desc.addr_1, desc.count, desc,
+                                       Compression.OP1_COMPRESSED)
                 data = _REDUCERS[func](data, np.asarray(b, data.dtype))
             else:
-                data = _COMBINE_JNP[func](data,
-                                          jax.device_put(b, self.my_device))
+                # zero-copy device read for device-resident op1 — the
+                # fused datapath must not round-trip it through the host
+                b = self._operand_device(desc, desc.addr_1,
+                                         Compression.OP1_COMPRESSED)
+                data = _COMBINE_JNP[func](data, b)
         if s_res:
             self.sport.put_out(data)
             return 0

@@ -477,3 +477,58 @@ def test_compressed_rooted_rides_fast_path(world, monkeypatch):
             outs[r], payload.astype(np.float16).astype(np.float32),
             rtol=1e-6)
     assert not crossings, f"host staging on fast path: {crossings}"
+
+
+def test_concurrent_sendrecv_batches_exchange_programs(world, monkeypatch):
+    """K concurrently-matched p2p transfers must ride <=2 exchange
+    programs (opportunistic window batching), not one full-mesh program
+    per pair. The spy slows each program slightly so arrivals during the
+    first program deterministically pile into the second."""
+    import time as _time
+
+    from accl_tpu.parallel.collectives import MeshCollectives
+
+    calls = []
+    orig = MeshCollectives.exchange_flat
+    ctx = world[0].device.ctx
+
+    def spy(self, x, pairs):
+        calls.append(tuple(pairs))
+        # deterministic window: hold this program until every other
+        # transfer is queued behind it (bounded), so scheduling stalls
+        # on a loaded machine cannot split the batch into >2 programs
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            with ctx._lock:
+                queued = sum(len(v) for v in ctx._xchg_pending.values())
+            done_pairs = sum(len(p) for p in calls)
+            if done_pairs + queued >= W:
+                break
+            _time.sleep(0.002)
+        return orig(self, x, pairs)
+
+    monkeypatch.setattr(MeshCollectives, "exchange_flat", spy)
+    count = 16
+    ins = [_data(count, 200 + r) for r in range(W)]
+
+    def fn(a):
+        # ring shift: rank r sends to r+1, receives from r-1 — W matched
+        # pairs with distinct sources and destinations
+        peer_to = (a.rank + 1) % W
+        peer_from = (a.rank - 1) % W
+        src = _dev_src(a, ins[a.rank])
+        dst = a.buffer((count,), np.float32, device_resident=True)
+        h = a.send(src, count, dst=peer_to, tag=3, run_async=True)
+        a.recv(dst, count, src=peer_from, tag=3)
+        h.wait()
+        return dst.data.copy()
+
+    outs = run_ranks(world, fn)
+    for r, out in enumerate(outs):
+        np.testing.assert_allclose(out, ins[(r - 1) % W], rtol=1e-6)
+    assert len(calls) <= 2, (
+        f"{W} concurrent transfers ran {len(calls)} exchange programs: "
+        f"{calls}")
+    # every pair crossed in SOME program
+    moved = {p for ps in calls for p in ps}
+    assert moved == {((r - 1) % W, r) for r in range(W)}
