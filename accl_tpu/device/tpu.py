@@ -193,46 +193,36 @@ class TpuContext:
         cid = comm.comm_id
         with self._lock:
             self._xchg_pending[cid].append(entry)
-            leader = cid not in self._xchg_running
-            if leader:
-                self._xchg_running.add(cid)
-        if not leader:
-            # an executor is live and guaranteed to drain the window
-            entry.done.wait()
-            if entry.error is not None:
-                raise entry.error
-            return entry.result
-        clean = False
-        try:
-            while True:
-                with self._lock:
+        # Cooperative leadership, ONE batch per claim: any thread whose
+        # entry is pending may claim the free executor flag, run exactly
+        # the window present at claim time, then hand off — so a leader
+        # is never captured by other ranks' sustained traffic (bounded
+        # extra work: one batch), while transfers deposited during a
+        # running program still pile into the next claim together.
+        while True:
+            with self._lock:
+                if entry.done.is_set():
+                    break
+                if cid not in self._xchg_running and self._xchg_pending[cid]:
+                    self._xchg_running.add(cid)
                     batch = self._xchg_pending[cid]
-                    if not batch:
-                        self._xchg_running.discard(cid)
-                        clean = True
-                        break
                     self._xchg_pending[cid] = []
-                try:
-                    self._run_exchange_batch(comm, batch)
-                except BaseException as exc:
-                    for e in batch:
-                        if not e.done.is_set():  # completed rounds stand
-                            e.error = exc
-                            e.done.set()
-        finally:
-            # abnormal exit only (a clean exit already handed leadership
-            # off under the lock — a NEW leader may own the window now,
-            # and popping here would steal its entries): fail anything
-            # still queued and clear the running flag so the next
-            # arrival can lead
-            if not clean:
+                else:
+                    # a leader is live (it will complete us or hand off
+                    # and notify) — the timeout is a liveness backstop
+                    self._lock.wait(0.1)
+                    continue
+            try:
+                self._run_exchange_batch(comm, batch)
+            except BaseException as exc:
+                for e in batch:
+                    if not e.done.is_set():  # completed rounds stand
+                        e.error = exc
+                        e.done.set()
+            finally:
                 with self._lock:
-                    leaked = self._xchg_pending[cid]
-                    self._xchg_pending[cid] = []
                     self._xchg_running.discard(cid)
-                for e in leaked:
-                    e.error = RuntimeError("exchange executor died")
-                    e.done.set()
+                    self._lock.notify_all()
         if entry.error is not None:
             raise entry.error
         return entry.result

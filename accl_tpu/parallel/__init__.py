@@ -7,7 +7,8 @@ ICI/DCN). It is usable standalone (functional, shard_map-based) and is what
 """
 
 from .mesh import make_mesh, cpu_mesh, mesh_from_communicator
-from .collectives import (MeshCollectives, ring_allreduce, ring_allgather,
+from .collectives import (MeshCollectives, multi_axis_ring_allreduce_shard,
+                          ring_allreduce, ring_allgather,
                           ring_reduce_scatter, masked_bcast, send_recv)
 from .ring_attention import ring_attention, ring_attention_sharded
 from .ulysses import (ulysses_attention, ulysses_attention_sharded,
@@ -23,7 +24,8 @@ from .multislice import (hybrid_mesh, hierarchical_allreduce,
                          slice_count)
 
 __all__ = ["make_mesh", "cpu_mesh", "mesh_from_communicator",
-           "MeshCollectives", "ring_allreduce", "ring_allgather",
+           "MeshCollectives", "multi_axis_ring_allreduce_shard",
+           "ring_allreduce", "ring_allgather",
            "ring_reduce_scatter", "masked_bcast", "send_recv",
            "ring_attention", "ring_attention_sharded",
            "ulysses_attention", "ulysses_attention_sharded",

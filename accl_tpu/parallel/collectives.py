@@ -185,6 +185,44 @@ def ring_reduce_scatter(x, axis_name: str, func: ReduceFunc = ReduceFunc.SUM,
     return ring_reduce_scatter_shard(x, axis_name, func, wire_dtype)
 
 
+def multi_axis_ring_allreduce_shard(x: jnp.ndarray,
+                                    axis_names: tuple[str, ...],
+                                    func: ReduceFunc = ReduceFunc.SUM,
+                                    wire_dtype=None) -> jnp.ndarray:
+    """Allreduce over an N-D torus that drives EVERY mesh axis's links
+    simultaneously — the schedule the ICI roofline's full-line-rate
+    claim assumes (docs/ROOFLINE.md assumption 2; scaling-book multi-ring
+    recipe).
+
+    The payload splits into len(axes) parts; part i runs a hierarchical
+    reduce-scatter down the axes in rotation order starting at axis i,
+    then all-gathers back up. Each part's HEAVY first phase therefore
+    rides a different physical axis, and the parts' chains are
+    independent inside one program, so the compiler can overlap them:
+    aggregate injection bandwidth = all axes at once, not one ring.
+
+    ``x``: (n,) per shard, n divisible by prod(axis sizes) * len(axes)
+    for clean splits (pad outside). Returns the fully-reduced (n,)."""
+    k = len(axis_names)
+    parts = jnp.split(x, k)
+    outs = []
+    for i, part in enumerate(parts):
+        order = axis_names[i:] + axis_names[:i]
+        y = part
+        # reduce-scatter cascade: each axis scatters its factor of the
+        # shard, so phase j moves a 1/prod(earlier sizes) fraction of
+        # the part on axis order[j] — the first (biggest) phase is axis i
+        for ax in order:
+            W = lax.axis_size(ax)
+            y = ring_reduce_scatter_shard(y.reshape(W, -1), ax, func,
+                                          wire_dtype)
+        # allgather cascade back up in reverse
+        for ax in reversed(order):
+            y = ring_allgather_shard(y, ax, wire_dtype).reshape(-1)
+        outs.append(y)
+    return jnp.concatenate(outs)
+
+
 def masked_bcast(x: jnp.ndarray, root, axis_name: str) -> jnp.ndarray:
     """Broadcast via masked reduction — XLA lowers this to its tree/ring
     broadcast schedule. Works for any dtype (uses where+psum)."""
@@ -534,6 +572,7 @@ class MeshCollectives:
 
         fn = jax.shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
                            out_specs=P(ax, None))
+        self._evict_exchange_programs()
         prog = self._cache[ck] = jax.jit(fn)
         return prog
 
@@ -541,6 +580,19 @@ class MeshCollectives:
                  pairs: tuple[tuple[int, int], ...]) -> jax.Array:
         """Execute a batch of point-to-point transfers as one ppermute."""
         return self._sendrecv_program(tuple(pairs))(x)
+
+    # Batched p2p windows make the pair-set space combinatorial (any
+    # matching can occur); cap the exchange-program entries with FIFO
+    # eviction so novel concurrency interleavings cannot pin compiled
+    # executables without bound (the other program caches have small
+    # closed key spaces and stay uncapped).
+    _MAX_EXCHANGE_PROGRAMS = 128
+
+    def _evict_exchange_programs(self):
+        keys = [k for k in self._cache
+                if k and k[0] in ("exchange", "exchange_flat")]
+        while len(keys) > self._MAX_EXCHANGE_PROGRAMS:
+            self._cache.pop(keys.pop(0), None)
 
     def _sendrecv_program_flat(self, pairs: tuple[tuple[int, int], ...]):
         ck = ("exchange_flat", pairs)
@@ -554,6 +606,7 @@ class MeshCollectives:
 
         fn = jax.shard_map(g, mesh=self.mesh, in_specs=P(ax),
                            out_specs=P(ax))
+        self._evict_exchange_programs()
         prog = self._cache[ck] = jax.jit(fn)
         return prog
 

@@ -274,3 +274,49 @@ def test_fused_stream_collective_single_program():
     hlo = prog.lower(x).compile().as_text().lower()
     assert "tanh" in hlo
     assert "collective-permute" in hlo or "collective_permute" in hlo
+
+
+def test_multi_axis_ring_allreduce_drives_every_axis():
+    """The roofline's full-line-rate claim assumes allreduce traffic
+    spreads over EVERY torus axis (docs/ROOFLINE.md assumption 2). The
+    multi-axis ring schedule demonstrates it in dryrun form: on a
+    (2,2,2) mesh, the compiled program's collective-permute pairs cross
+    links in all three axis directions (flattened strides 1, 2, 4), and
+    the result matches the sum exactly."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from accl_tpu.parallel.collectives import (
+        multi_axis_ring_allreduce_shard)
+
+    if len(jax.devices()) < 8:
+        import pytest as _pytest
+        _pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("a", "b", "c"))
+    n = 8 * 3 * 4
+
+    def f(x):
+        return multi_axis_ring_allreduce_shard(x[0], ("a", "b", "c"))[None]
+
+    g = jax.jit(jax.shard_map(f, mesh=mesh,
+                              in_specs=P(("a", "b", "c"), None),
+                              out_specs=P(("a", "b", "c"), None)))
+    rng = np.random.default_rng(0)
+    ins = rng.standard_normal((8, n)).astype(np.float32)
+    out = np.asarray(g(jnp.asarray(ins)))
+    for r in range(8):
+        np.testing.assert_allclose(out[r], ins.sum(0), rtol=1e-5)
+
+    hlo = g.lower(jnp.asarray(ins)).compile().as_text()
+    strides = set()
+    for m in re.finditer(r"source_target_pairs=\{(.*?)\}\}", hlo,
+                         re.DOTALL):
+        for p in re.finditer(r"\{(\d+),(\d+)\}", m.group(1) + "}"):
+            a, b = int(p.group(1)), int(p.group(2))
+            strides.add(min(abs(a - b), 8 - abs(a - b)))
+    assert {1, 2, 4} <= strides, (
+        f"traffic does not cross every torus axis: strides {strides}")
