@@ -203,15 +203,19 @@ class TpuContext:
             with self._lock:
                 if entry.done.is_set():
                     break
-                if cid not in self._xchg_running and self._xchg_pending[cid]:
+                claimed = (cid not in self._xchg_running
+                           and bool(self._xchg_pending[cid]))
+                if claimed:
                     self._xchg_running.add(cid)
                     batch = self._xchg_pending[cid]
                     self._xchg_pending[cid] = []
-                else:
-                    # a leader is live (it will complete us or hand off
-                    # and notify) — the timeout is a liveness backstop
-                    self._lock.wait(0.1)
-                    continue
+            if not claimed:
+                # wait on OUR completion event (set per round, so a
+                # transfer finished in round 1 of a multi-round batch
+                # wakes immediately); the short timeout doubles as the
+                # leadership re-check backstop if a leader died
+                entry.done.wait(0.05)
+                continue
             try:
                 self._run_exchange_batch(comm, batch)
             except BaseException as exc:

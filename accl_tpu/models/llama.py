@@ -44,10 +44,12 @@ class LlamaConfig:
     # "flash": fused Pallas attention (ops.attention) — streaming KV,
     # native GQA (no repeated-KV copy), fused decode over the cache.
     # "dense": score-materializing einsum reference path. The GSPMD-
-    # sharded forward (dp/sp axes given) always uses dense: a pallas_call
-    # has no partitioning rule, so under pjit it would force operand
-    # all-gathers; the sharded fused path is parallel.ulysses /
-    # ring_attention (shard_map-wrapped).
+    # sharded forward uses flash too when a ``mesh`` is passed and the
+    # head counts divide the tp axis (a shard_map over the tp head
+    # shards — attention is embarrassingly parallel across heads);
+    # without a mesh, sp-sharded sequences ride parallel.ulysses /
+    # ring_attention, and everything else falls back to dense (a bare
+    # pallas_call has no GSPMD partitioning rule).
     attention: str = "flash"
 
     @property
@@ -162,7 +164,8 @@ class Llama:
             is_leaf=lambda x: isinstance(x, (jnp.ndarray, np.ndarray)))
 
     # -- forward -----------------------------------------------------------
-    def _layer(self, x, layer_params, positions, mask, use_flash=False):
+    def _layer(self, x, layer_params, positions, mask, use_flash=False,
+               shard_ctx=None):
         c = self.config
         p = layer_params
         hd, nh, nkv = c.head_dim, c.n_heads, c.n_kv_heads
@@ -179,9 +182,25 @@ class Llama:
             # maps route each Q head to its KV head (GQA without the
             # max_len-sized repeat copy); differentiable (custom VJP)
             from ..ops.attention import flash_attention
-            attn = flash_attention(q.transpose(0, 2, 1, 3),
-                                   k.transpose(0, 2, 1, 3),
-                                   v.transpose(0, 2, 1, 3), causal=True)
+            qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+            if shard_ctx is not None:
+                # GSPMD tp path: heads are column-parallel over tp, and
+                # attention is embarrassingly parallel across heads — a
+                # shard_map runs the fused kernel per head shard instead
+                # of falling back to the score-materializing einsum
+                # (check_vma=False: the pallas interpreter's internal
+                # slices don't carry varying-axis types, ulysses parity)
+                import functools as _ft
+
+                mesh, dp_ax, tp_ax = shard_ctx
+                spec = P(dp_ax, tp_ax, None, None)
+                f = _ft.partial(flash_attention, causal=True)
+                attn = jax.shard_map(f, mesh=mesh,
+                                     in_specs=(spec, spec, spec),
+                                     out_specs=spec,
+                                     check_vma=False)(qt, kt, vt)
+            else:
+                attn = flash_attention(qt, kt, vt, causal=True)
             attn = attn.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
         else:
             # GQA: repeat kv heads
@@ -206,25 +225,42 @@ class Llama:
         return x
 
     def forward(self, params: dict, tokens: jnp.ndarray,
-                dp: str | None = None, sp: str | None = None) -> jnp.ndarray:
+                dp: str | None = None, sp: str | None = None,
+                mesh: Mesh | None = None,
+                tp: str = "tp") -> jnp.ndarray:
         """Logits for (B, S) int32 tokens. When dp/sp axis names are given,
-        activation sharding constraints pin batch->dp and seq->sp."""
+        activation sharding constraints pin batch->dp and seq->sp.
+
+        With ``mesh`` also given (and no sp sequence sharding), attention
+        runs the fused flash kernel inside a shard_map over the tp head
+        shards instead of the dense einsum — requires the head counts to
+        divide the tp axis (GQA KV heads included)."""
         c = self.config
         B, S = tokens.shape
         x = params["embed"].astype(c.dtype)[tokens]
         if dp is not None:
             x = jax.lax.with_sharding_constraint(x, P(dp, sp, None))
         positions = jnp.arange(S)
+        shard_ctx = None
+        if c.attention == "flash" and dp is None and sp is None:
+            use_flash = True
+        elif (c.attention == "flash" and mesh is not None and sp is None
+                and tp in mesh.shape
+                and c.n_heads % mesh.shape[tp] == 0
+                and c.n_kv_heads % mesh.shape[tp] == 0
+                and (dp is None or B % mesh.shape.get(dp, 1) == 0)):
+            use_flash = True
+            shard_ctx = (mesh, dp, tp)
+        else:
+            use_flash = False
         # dense needs the materialized mask; the flash kernel masks
-        # blockwise in VMEM (see LlamaConfig.attention for why the
-        # sharded path stays dense)
-        use_flash = (c.attention == "flash" and dp is None and sp is None)
+        # blockwise in VMEM
         mask = (None if use_flash
                 else jnp.tril(jnp.ones((S, S), bool))[None, None])
 
         def body(x, layer_params):
             return self._layer(x, layer_params, positions, mask,
-                               use_flash), None
+                               use_flash, shard_ctx), None
 
         x, _ = jax.lax.scan(body, x, params["layers"])
         x = _rms_norm(x, params["final_norm"].astype(x.dtype), c.norm_eps)
@@ -366,9 +402,10 @@ class Llama:
         return fn
 
     def loss(self, params: dict, tokens: jnp.ndarray,
-             dp: str | None = None, sp: str | None = None) -> jnp.ndarray:
+             dp: str | None = None, sp: str | None = None,
+             mesh: Mesh | None = None, tp: str = "tp") -> jnp.ndarray:
         """Next-token cross entropy (mean over B, S-1)."""
-        logits = self.forward(params, tokens, dp, sp)[:, :-1]
+        logits = self.forward(params, tokens, dp, sp, mesh, tp)[:, :-1]
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
@@ -376,12 +413,15 @@ class Llama:
 
     # -- training ----------------------------------------------------------
     def make_train_step(self, optimizer, dp: str | None = None,
-                        sp: str | None = None):
+                        sp: str | None = None,
+                        mesh: Mesh | None = None, tp: str = "tp"):
         """Returns train_step(params, opt_state, tokens) -> (params,
-        opt_state, loss). Pure; jit/pjit outside."""
+        opt_state, loss). Pure; jit/pjit outside. Pass ``mesh`` to run
+        attention as the fused flash kernel over tp head shards."""
 
         def train_step(params, opt_state, tokens):
-            loss, grads = jax.value_and_grad(self.loss)(params, tokens, dp, sp)
+            loss, grads = jax.value_and_grad(self.loss)(
+                params, tokens, dp, sp, mesh, tp)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = jax.tree.map(lambda p, u: p + u, params, updates)
             return params, opt_state, loss

@@ -589,7 +589,10 @@ class MeshCollectives:
     _MAX_EXCHANGE_PROGRAMS = 128
 
     def _evict_exchange_programs(self):
-        keys = [k for k in self._cache
+        # list(dict) snapshots atomically under the GIL; iterating the
+        # live dict would race concurrent _program inserts from other
+        # launcher threads ("dictionary changed size during iteration")
+        keys = [k for k in list(self._cache)
                 if k and k[0] in ("exchange", "exchange_flat")]
         while len(keys) > self._MAX_EXCHANGE_PROGRAMS:
             self._cache.pop(keys.pop(0), None)
