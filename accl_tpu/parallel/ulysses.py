@@ -49,9 +49,22 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       sm_scale: float | None = None) -> jnp.ndarray:
     """Attention over the full sequence via head-parallel re-sharding.
 
-    q/k/v: (B, H, S_local, D) per shard (KV heads already repeated for
-    GQA). Returns (B, H, S_local, D)."""
+    q: (B, H, S_local, D); k/v: (B, H_kv, S_local, D) with H_kv dividing
+    H — GQA KV heads ride the all-to-all UN-repeated whenever they split
+    over the ranks (H/H_kv times fewer wire bytes for K and V; the flash
+    kernel routes each Q head to its KV head on the other side). When
+    H_kv doesn't divide the axis size, KV repeats minimally (to one head
+    per rank if that divides, else to H). Returns (B, H, S_local, D)."""
+    W = lax.axis_size(axis_name)
+    H, Hkv = q.shape[1], k.shape[1]
     qh = seq_to_heads(q, axis_name)
+    if Hkv % W and H != Hkv:
+        # KV heads don't split evenly over the ranks: repeat minimally —
+        # up to W heads when that divides (one kv head per rank), else
+        # all the way to H (the old fully-repeated layout)
+        rep = (W // Hkv) if W % Hkv == 0 else (H // Hkv)
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
     kh = seq_to_heads(k, axis_name)
     vh = seq_to_heads(v, axis_name)
     out = flash_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
