@@ -184,17 +184,28 @@ class Llama:
             from ..ops.attention import flash_attention
             qt, kt, vt = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
             if shard_ctx is not None:
-                # GSPMD tp path: heads are column-parallel over tp, and
-                # attention is embarrassingly parallel across heads — a
-                # shard_map runs the fused kernel per head shard instead
-                # of falling back to the score-materializing einsum
+                # GSPMD sharded attention, one shard_map either way:
+                # - "tp": heads are column-parallel; attention is
+                #   embarrassingly parallel across head shards, so the
+                #   fused kernel runs per shard.
+                # - "sp": sequence sharded over the ring; ring attention
+                #   rotates the (un-repeated GQA) KV blocks over ICI
+                #   while each shard's Q accumulates — the long-context
+                #   schedule, no full-sequence gather ever.
                 # (check_vma=False: the pallas interpreter's internal
                 # slices don't carry varying-axis types, ulysses parity)
                 import functools as _ft
 
-                mesh, dp_ax, tp_ax = shard_ctx
-                spec = P(dp_ax, tp_ax, None, None)
-                f = _ft.partial(flash_attention, causal=True)
+                mode, mesh, dp_ax, ax = shard_ctx
+                if mode == "tp":
+                    spec = P(dp_ax, ax, None, None)
+                    f = _ft.partial(flash_attention, causal=True)
+                else:
+                    from ..parallel.ring_attention import ring_attention
+
+                    spec = P(dp_ax, None, ax, None)
+                    f = _ft.partial(ring_attention, axis_name=ax,
+                                    causal=True)
                 attn = jax.shard_map(f, mesh=mesh,
                                      in_specs=(spec, spec, spec),
                                      out_specs=spec,
@@ -250,7 +261,26 @@ class Llama:
                 and c.n_kv_heads % mesh.shape[tp] == 0
                 and (dp is None or B % mesh.shape.get(dp, 1) == 0)):
             use_flash = True
-            shard_ctx = (mesh, dp, tp)
+            shard_ctx = ("tp", mesh, dp, tp)
+        elif c.attention == "flash" and mesh is not None and sp is not None:
+            # sequence-parallel training: ring attention over the sp
+            # axis. A silent fallback to dense here would materialize
+            # the O(S^2) score tensor sequence parallelism exists to
+            # avoid — fail loudly when the request can't be honored.
+            if sp not in mesh.shape:
+                raise ValueError(f"sp axis {sp!r} not in mesh "
+                                 f"{tuple(mesh.shape)}")
+            if S % mesh.shape[sp]:
+                raise ValueError(
+                    f"sequence length {S} not divisible by sp axis size "
+                    f"{mesh.shape[sp]} — ring attention needs equal "
+                    "sequence shards")
+            if dp is not None and B % mesh.shape.get(dp, 1):
+                raise ValueError(
+                    f"batch {B} not divisible by dp axis size "
+                    f"{mesh.shape.get(dp, 1)}")
+            use_flash = True
+            shard_ctx = ("sp", mesh, dp, sp)
         else:
             use_flash = False
         # dense needs the materialized mask; the flash kernel masks

@@ -111,21 +111,29 @@ def _sds_for(x: jax.Array):
 # forward
 # ---------------------------------------------------------------------------
 
+def _block_mask(*, causal, q_off, k_off, bq, bk, skv, sq=None):
+    """The ONE copy of the block validity mask shared by the streaming
+    kernel, the single-block kernel, and the backward pass' probability
+    rebuild: key in bounds, (optionally) query in bounds, causal."""
+    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = k_pos < skv
+    if sq is not None:
+        mask = jnp.logical_and(mask, q_pos < sq)
+    if causal:
+        mask = jnp.logical_and(mask, k_pos <= q_pos)
+    return mask
+
+
 def _masked_scores(q, k, *, sm_scale, causal, q_off, k_off,
                    skv) -> jax.Array:
-    """scale * q @ k^T with the padding (+ causal) mask applied — the ONE
-    copy of the mask construction shared by the streaming kernel, the
-    single-block kernel, and (via lse recompute) the backward pass'
-    probability rebuild."""
+    """scale * q @ k^T with the shared block mask applied as -inf."""
     bq, bk = q.shape[0], k.shape[0]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale
-    q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    mask = k_pos < skv
-    if causal:
-        mask = jnp.logical_and(mask, k_pos <= q_pos)
+    mask = _block_mask(causal=causal, q_off=q_off, k_off=k_off,
+                       bq=bq, bk=bk, skv=skv)
     return jnp.where(mask, s, _NEG_INF)
 
 
@@ -316,13 +324,9 @@ def _recompute_p(q, k, lse_tile, *, sm_scale, causal, block_q, block_k,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale
     p = jnp.exp(s - _tile_lanes(lse_tile, block_k))
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    k_pos = kj * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
-    mask = jnp.logical_and(q_pos < sq, k_pos < skv)
-    if causal:
-        mask = jnp.logical_and(mask, k_pos <= q_pos)
+    mask = _block_mask(causal=causal, q_off=qi * block_q,
+                       k_off=kj * block_k, bq=block_q, bk=block_k,
+                       skv=skv, sq=sq)
     return jnp.where(mask, p, 0.0)
 
 

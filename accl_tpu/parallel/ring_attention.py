@@ -33,25 +33,26 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 def _block_update(q, k, v, m, l, acc, q_pos, k_pos, sm_scale, causal):
     """One online-softmax accumulation of q against a (k, v) block.
 
-    q: (B, H, Sq, D); k/v: (B, H, Skv, D); m/l: (B, H, Sq, 1);
-    acc: (B, H, Sq, D) fp32. Returns updated (m, l, acc).
+    Grouped GQA layout: q (B, Hkv, G, Sq, D) — G query heads per KV
+    head; k/v (B, Hkv, Skv, D); m/l (B, Hkv, G, Sq, 1); acc
+    (B, Hkv, G, Sq, D) fp32. Returns updated (m, l, acc).
     """
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+    s = jnp.einsum("bngqd,bnkd->bngqk", q.astype(jnp.float32),
                    k.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * sm_scale
     if causal:
         mask = k_pos[None, :] <= q_pos[:, None]          # (Sq, Skv)
-        s = jnp.where(mask[None, None], s, _NEG_INF)
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     # safe subtrahend: rows with no valid key yet keep m == -inf; exp of
     # (-inf - finite) underflows to 0 instead of producing NaN
     safe = jnp.where(m_new == _NEG_INF, 0.0, m_new)
     p = jnp.exp(s - safe)
     if causal:
-        p = jnp.where(mask[None, None], p, 0.0)
+        p = jnp.where(mask[None, None, None], p, 0.0)
     alpha = jnp.exp(jnp.where(m == _NEG_INF, _NEG_INF, m - safe))
     l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
+    acc = acc * alpha + jnp.einsum("bngqk,bnkd->bngqd", p,
                                    v.astype(jnp.float32),
                                    preferred_element_type=jnp.float32)
     return m_new, l, acc
@@ -62,13 +63,19 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    sm_scale: float | None = None) -> jnp.ndarray:
     """Exact attention with K/V ring-rotated over ``axis_name``.
 
-    q/k/v: (B, H, S_local, D) per shard — the sequence axis sharded over
-    the ring, KV heads already repeated for GQA. Returns (B, H, S_local, D)
-    in q.dtype.
+    q: (B, H, S_local, D); k/v: (B, H_kv, S_local, D) with H_kv dividing
+    H — GQA KV heads travel the ring UN-REPEATED (H/H_kv times fewer ICI
+    bytes on EVERY hop; the update rule groups each KV head's queries).
+    Returns (B, H, S_local, D) in q.dtype.
     """
     W = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+    G = H // Hkv
+    q = q.reshape(B, Hkv, G, S, D)
     if sm_scale is None:
         sm_scale = float(D) ** -0.5
     # kv travels to the previous rank each hop: at hop i, rank me holds the
@@ -92,9 +99,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             lambda kv: kv, kv)
         return kv, m, l, acc
 
-    m0 = jnp.full((B, H, S, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, S, 1), jnp.float32)
-    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    m0 = jnp.full((B, Hkv, G, S, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, S, 1), jnp.float32)
+    acc0 = jnp.zeros((B, Hkv, G, S, D), jnp.float32)
     # fresh constants are unvarying over the mesh axis; the loop outputs
     # vary (they depend on axis_index) — align the carry types up front
     from .collectives import mark_varying
@@ -102,7 +109,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     _, m, l, acc = lax.fori_loop(0, W, body, ((k, v), m0, l0, acc0),
                                  unroll=True)
     out = acc / jnp.maximum(l, 1e-30)
-    return out.astype(q.dtype)
+    return out.reshape(B, H, S, D).astype(q.dtype)
 
 
 @functools.lru_cache(maxsize=None)

@@ -198,3 +198,43 @@ def test_sharded_flash_attention_matches_unsharded(tiny, n_kv, shape):
         p, st, l0 = step(params, st, tokens)
         p, st, l1 = step(p, st, tokens)
         assert float(l1) < float(l0)
+
+
+def test_sequence_parallel_llama_via_ring_attention(tiny):
+    """With mesh + sp given, the forward runs ring attention over the
+    sequence shards (un-repeated GQA KV on every hop, no full-sequence
+    gather): in fp32 it matches the unsharded flash forward exactly, the
+    compiled program contains the ring's collective-permutes, and a
+    train step through it descends."""
+    import dataclasses
+
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cfg = dataclasses.replace(tiny[0], dtype=jnp.float32, n_heads=4,
+                              n_kv_heads=2)
+    model = Llama(cfg)
+    params_host = model.init(jax.random.key(0))
+    tokens_host = np.random.default_rng(1).integers(
+        0, cfg.vocab_size, (4, 64)).astype(np.int32)
+    ref = jax.jit(model.forward)(params_host, jnp.asarray(tokens_host))
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    with jax.set_mesh(mesh):
+        params = jax.device_put(params_host, NamedSharding(mesh, P()))
+        tokens = jax.device_put(tokens_host,
+                                NamedSharding(mesh, P("dp", "sp")))
+        fwd = jax.jit(lambda p, t: model.forward(p, t, dp="dp", sp="sp",
+                                                 mesh=mesh))
+        out = fwd(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        hlo = fwd.lower(params, tokens).compile().as_text()
+        assert "collective-permute" in hlo
+        opt = optax.adamw(1e-3)
+        step = jax.jit(model.make_train_step(opt, dp="dp", sp="sp",
+                                             mesh=mesh))
+        st = opt.init(params)
+        p, st, l0 = step(params, st, tokens)
+        p, st, l1 = step(p, st, tokens)
+        assert float(l1) < float(l0)

@@ -117,3 +117,25 @@ def test_ulysses_gqa_unrepeated_kv(hkv):
                                        hlo)}
         assert any(s[1] == hkv // 8 for s in shapes if len(s) == 4), (
             f"no small-kv all-to-all found: {shapes}")
+
+
+@pytest.mark.parametrize("hkv", [4, 2, 1])
+def test_ring_attention_gqa_unrepeated_kv(hkv):
+    """GQA KV heads travel the ring UN-repeated: H/H_kv fewer ICI bytes
+    on every hop, results identical to dense attention over repeated
+    heads (including the MQA extreme)."""
+    import jax
+
+    from conftest import dense_attention
+
+    mesh = cpu_mesh(4, axis_names=("sp",))
+    H, S, D = 8, 64, 16
+    ks = jax.random.split(jax.random.key(12), 3)
+    q = jax.random.normal(ks[0], (2, H, S, D), jnp.float32)
+    k = jax.random.normal(ks[1], (2, hkv, S, D), jnp.float32)
+    v = jax.random.normal(ks[2], (2, hkv, S, D), jnp.float32)
+    out = ring_attention_sharded(q, k, v, mesh, "sp", causal=True)
+    ref = dense_attention(q, jnp.repeat(k, H // hkv, 1),
+                          jnp.repeat(v, H // hkv, 1), True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
