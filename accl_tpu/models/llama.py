@@ -44,12 +44,13 @@ class LlamaConfig:
     # "flash": fused Pallas attention (ops.attention) — streaming KV,
     # native GQA (no repeated-KV copy), fused decode over the cache.
     # "dense": score-materializing einsum reference path. The GSPMD-
-    # sharded forward uses flash too when a ``mesh`` is passed and the
-    # head counts divide the tp axis (a shard_map over the tp head
-    # shards — attention is embarrassingly parallel across heads);
-    # without a mesh, sp-sharded sequences ride parallel.ulysses /
-    # ring_attention, and everything else falls back to dense (a bare
-    # pallas_call has no GSPMD partitioning rule).
+    # sharded forward uses flash too when a ``mesh`` is passed: a
+    # shard_map over the tp head shards (sp None, head counts dividing
+    # tp), or ring attention over the sp sequence shards (mesh + sp).
+    # Sharded decode (forward_cached/generate with mesh) runs the fused
+    # decode kernel per tp KV-head shard. Without a mesh, sharded paths
+    # fall back to dense (a bare pallas_call has no GSPMD partitioning
+    # rule).
     attention: str = "flash"
 
     @property
@@ -194,18 +195,16 @@ class Llama:
                 #   schedule, no full-sequence gather ever.
                 # (check_vma=False: the pallas interpreter's internal
                 # slices don't carry varying-axis types, ulysses parity)
-                import functools as _ft
-
                 mode, mesh, dp_ax, ax = shard_ctx
                 if mode == "tp":
                     spec = P(dp_ax, ax, None, None)
-                    f = _ft.partial(flash_attention, causal=True)
+                    f = functools.partial(flash_attention, causal=True)
                 else:
                     from ..parallel.ring_attention import ring_attention
 
                     spec = P(dp_ax, None, ax, None)
-                    f = _ft.partial(ring_attention, axis_name=ax,
-                                    causal=True)
+                    f = functools.partial(ring_attention, axis_name=ax,
+                                          causal=True)
                 attn = jax.shard_map(f, mesh=mesh,
                                      in_specs=(spec, spec, spec),
                                      out_specs=spec,
@@ -242,10 +241,11 @@ class Llama:
         """Logits for (B, S) int32 tokens. When dp/sp axis names are given,
         activation sharding constraints pin batch->dp and seq->sp.
 
-        With ``mesh`` also given (and no sp sequence sharding), attention
-        runs the fused flash kernel inside a shard_map over the tp head
-        shards instead of the dense einsum — requires the head counts to
-        divide the tp axis (GQA KV heads included)."""
+        With ``mesh`` also given, attention runs fused inside a
+        shard_map: over the tp head shards when sp is None (requires the
+        head counts — GQA KV heads included — to divide the tp axis), or
+        as RING attention over the sp sequence shards when sp is given
+        (un-repeated GQA KV on every hop, no full-sequence gather)."""
         c = self.config
         B, S = tokens.shape
         x = params["embed"].astype(c.dtype)[tokens]
@@ -275,10 +275,13 @@ class Llama:
                     f"sequence length {S} not divisible by sp axis size "
                     f"{mesh.shape[sp]} — ring attention needs equal "
                     "sequence shards")
-            if dp is not None and B % mesh.shape.get(dp, 1):
+            if dp is not None and dp not in mesh.shape:
+                raise ValueError(f"dp axis {dp!r} not in mesh "
+                                 f"{tuple(mesh.shape)}")
+            if dp is not None and B % mesh.shape[dp]:
                 raise ValueError(
                     f"batch {B} not divisible by dp axis size "
-                    f"{mesh.shape.get(dp, 1)}")
+                    f"{mesh.shape[dp]}")
             use_flash = True
             shard_ctx = ("sp", mesh, dp, sp)
         else:
@@ -308,7 +311,8 @@ class Llama:
         return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt),
                 "pos": jnp.zeros((), jnp.int32)}
 
-    def _layer_cached(self, x, layer_params, kc, vc, pos):
+    def _layer_cached(self, x, layer_params, kc, vc, pos,
+                      shard_ctx=None):
         """One decoder layer over cached context: x holds S_new tokens at
         absolute positions pos..pos+S_new-1; kc/vc are (B, max_len, nkv, hd)
         and are updated in place (dynamic_update_slice). Returns
@@ -336,8 +340,22 @@ class Llama:
             # blocks past the fill (pos + S) are neither fetched nor
             # computed, so a step costs the filled prefix, not max_len
             from ..ops.attention import flash_decode
-            attn = flash_decode(q.transpose(0, 2, 1, 3), kc, vc,
-                                kv_len=pos + S)
+            qt = q.transpose(0, 2, 1, 3)
+            if shard_ctx is not None:
+                # tp decode: KV-head shards of the cache stay put; each
+                # tp shard decodes its own head group with the fused
+                # kernel (no cache gather, no repeated-KV copy)
+                mesh, dp_ax, tp_ax = shard_ctx
+                attn = jax.shard_map(
+                    flash_decode,
+                    mesh=mesh,
+                    in_specs=(P(dp_ax, tp_ax, None, None),
+                              P(dp_ax, None, tp_ax, None),
+                              P(dp_ax, None, tp_ax, None), P()),
+                    out_specs=P(dp_ax, tp_ax, None, None),
+                    check_vma=False)(qt, kc, vc, pos + S)
+            else:
+                attn = flash_decode(qt, kc, vc, kv_len=pos + S)
             attn = attn.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
         else:
             # grouped-query attention without materializing repeated K/V
@@ -366,18 +384,43 @@ class Llama:
         return x, kc, vc
 
     def forward_cached(self, params: dict, tokens: jnp.ndarray,
-                       cache: dict) -> tuple[jnp.ndarray, dict]:
+                       cache: dict, mesh: Mesh | None = None,
+                       dp: str | None = None,
+                       tp: str = "tp") -> tuple[jnp.ndarray, dict]:
         """Logits for S_new tokens appended at cache['pos'], plus the
         updated cache. Used for both prefill (S_new = prompt len) and
-        decode (S_new = 1); jit once per S_new."""
+        decode (S_new = 1); jit once per S_new. With ``mesh`` given (and
+        head counts dividing the tp axis), decode attention runs the
+        fused kernel per tp KV-head shard — tensor-parallel inference
+        without gathering the cache."""
         c = self.config
         x = params["embed"].astype(c.dtype)[tokens]
         pos = cache["pos"]
+        shard_ctx = None
+        if mesh is not None:
+            # fail loudly (sp-path discipline): a silent fallback would
+            # trace the bare pallas decode over sharded globals and XLA
+            # would all-gather the ENTIRE cache to every device per step
+            if c.attention != "flash":
+                raise ValueError("mesh-sharded decode requires "
+                                 "attention='flash'")
+            if tp not in mesh.shape:
+                raise ValueError(f"tp axis {tp!r} not in mesh "
+                                 f"{tuple(mesh.shape)}")
+            if c.n_heads % mesh.shape[tp] or c.n_kv_heads % mesh.shape[tp]:
+                raise ValueError(
+                    f"head counts ({c.n_heads} q / {c.n_kv_heads} kv) "
+                    f"must divide the tp axis size {mesh.shape[tp]} for "
+                    "sharded decode")
+            if dp is not None and dp not in mesh.shape:
+                raise ValueError(f"dp axis {dp!r} not in mesh "
+                                 f"{tuple(mesh.shape)}")
+            shard_ctx = (mesh, dp, tp)
 
         def body(xc, layer):
             x = xc
             lp, kc, vc = layer
-            x, kc, vc = self._layer_cached(x, lp, kc, vc, pos)
+            x, kc, vc = self._layer_cached(x, lp, kc, vc, pos, shard_ctx)
             return x, (kc, vc)
 
         x, (knew, vnew) = jax.lax.scan(
@@ -391,7 +434,9 @@ class Llama:
     def generate(self, params: dict, prompt: jnp.ndarray, max_new: int,
                  max_len: int | None = None,
                  temperature: float = 0.0,
-                 key: jax.Array | None = None) -> jnp.ndarray:
+                 key: jax.Array | None = None,
+                 mesh: Mesh | None = None,
+                 dp: str | None = None, tp: str = "tp") -> jnp.ndarray:
         """Greedy (or temperature) decode: prefill the prompt, then one
         jitted single-token step per new token. Returns (B, max_new)."""
         B, S = prompt.shape
@@ -407,6 +452,8 @@ class Llama:
         # one cached jit serves prefill and decode (distinct trace-cache
         # entries per S_new); rebuilding wrappers per call would recompile
         step = self._jit_forward_cached()
+        if mesh is not None:
+            step = functools.partial(step, mesh=mesh, dp=dp, tp=tp)
         logits, cache = step(params, prompt, cache)
         out = []
         last = logits[:, -1]
@@ -427,7 +474,8 @@ class Llama:
     def _jit_forward_cached(self):
         fn = getattr(self, "_fc_jit", None)
         if fn is None:
-            fn = jax.jit(self.forward_cached)
+            fn = jax.jit(self.forward_cached,
+                         static_argnames=("mesh", "dp", "tp"))
             self._fc_jit = fn
         return fn
 
