@@ -243,7 +243,7 @@ class Llama:
 
         With ``mesh`` also given, attention runs fused inside a
         shard_map: over the tp head shards when sp is None (requires the
-        head counts — GQA KV heads included — to divide the tp axis), or
+        tp axis size to divide the head counts, GQA KV heads included), or
         as RING attention over the sp sequence shards when sp is given
         (un-repeated GQA KV on every hop, no full-sequence gather)."""
         c = self.config
@@ -390,9 +390,9 @@ class Llama:
         """Logits for S_new tokens appended at cache['pos'], plus the
         updated cache. Used for both prefill (S_new = prompt len) and
         decode (S_new = 1); jit once per S_new. With ``mesh`` given (and
-        head counts dividing the tp axis), decode attention runs the
-        fused kernel per tp KV-head shard — tensor-parallel inference
-        without gathering the cache."""
+        the tp axis size dividing the head counts), decode attention
+        runs the fused kernel per tp KV-head shard — tensor-parallel
+        inference without gathering the cache."""
         c = self.config
         x = params["embed"].astype(c.dtype)[tokens]
         pos = cache["pos"]
@@ -409,12 +409,16 @@ class Llama:
                                  f"{tuple(mesh.shape)}")
             if c.n_heads % mesh.shape[tp] or c.n_kv_heads % mesh.shape[tp]:
                 raise ValueError(
+                    f"tp axis size {mesh.shape[tp]} must divide the "
                     f"head counts ({c.n_heads} q / {c.n_kv_heads} kv) "
-                    f"must divide the tp axis size {mesh.shape[tp]} for "
-                    "sharded decode")
+                    "for sharded decode")
             if dp is not None and dp not in mesh.shape:
                 raise ValueError(f"dp axis {dp!r} not in mesh "
                                  f"{tuple(mesh.shape)}")
+            if dp is not None and tokens.shape[0] % mesh.shape[dp]:
+                raise ValueError(
+                    f"batch {tokens.shape[0]} not divisible by dp axis "
+                    f"size {mesh.shape[dp]}")
             shard_ctx = (mesh, dp, tp)
 
         def body(xc, layer):
