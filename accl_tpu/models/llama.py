@@ -52,6 +52,15 @@ class LlamaConfig:
     # fall back to dense (a bare pallas_call has no GSPMD partitioning
     # rule).
     attention: str = "flash"
+    # Mixture-of-experts FFN (Mixtral-style): n_experts > 0 replaces
+    # every layer's SwiGLU with a top-k routed expert block
+    # (models.moe.MoELayer math — static capacity, einsum dispatch);
+    # the Switch-style load-balancing aux loss is added in loss() with
+    # moe_aux_coef. 0 = dense FFN.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -111,11 +120,23 @@ class Llama:
                     * (fan_in ** -0.5))
 
         L = c.n_layers
-        ks = jax.random.split(k_layers, 7)
+        ks = jax.random.split(k_layers, 8)
 
         def stack(key, fan_in, *shape):
             return dense(key, fan_in, L, *shape)
 
+        if c.n_experts:
+            # one source of truth for the expert param layout: vmap
+            # MoELayer.init over the layer axis (hand-duplicating its
+            # shapes here would silently diverge on any MoE change)
+            ffn = jax.vmap(self._moe_layer().init)(
+                jax.random.split(ks[4], L))
+        else:
+            ffn = {
+                "w_gate": stack(ks[4], c.dim, c.dim, c.ffn_dim),
+                "w_up": stack(ks[5], c.dim, c.dim, c.ffn_dim),
+                "w_down": stack(ks[6], c.ffn_dim, c.ffn_dim, c.dim),
+            }
         params = {
             "embed": dense(k_emb, c.dim, c.vocab_size, c.dim),
             "layers": {
@@ -125,20 +146,63 @@ class Llama:
                 "wv": stack(ks[2], c.dim, c.dim, nkv * hd),
                 "wo": stack(ks[3], nh * hd, nh * hd, c.dim),
                 "mlp_norm": norm_init(L, c.dim),
-                "w_gate": stack(ks[4], c.dim, c.dim, c.ffn_dim),
-                "w_up": stack(ks[5], c.dim, c.dim, c.ffn_dim),
-                "w_down": stack(ks[6], c.ffn_dim, c.ffn_dim, c.dim),
+                **ffn,
             },
             "final_norm": norm_init(c.dim),
             "lm_head": dense(k_out, c.dim, c.dim, c.vocab_size),
         }
         return params
 
+    def _moe_layer(self):
+        from .moe import MoEConfig, MoELayer
+        c = self.config
+        return MoELayer(MoEConfig(
+            dim=c.dim, ffn_dim=c.ffn_dim, n_experts=c.n_experts,
+            top_k=c.moe_top_k, capacity_factor=c.moe_capacity_factor,
+            dtype=c.dtype, param_dtype=c.param_dtype))
+
+    def _ffn(self, h, p):
+        """The per-layer FFN on normed activations h (B, S, D): SwiGLU,
+        or the routed expert block when n_experts > 0. Returns
+        (out (B, S, D), aux scalar)."""
+        c = self.config
+        if not c.n_experts:
+            gate = jax.nn.silu(h @ p["w_gate"].astype(h.dtype))
+            up = h @ p["w_up"].astype(h.dtype)
+            return (gate * up) @ p["w_down"].astype(h.dtype), jnp.zeros(
+                (), jnp.float32)
+        B, S, D = h.shape
+        layer = self._moe_layer()
+        mparams = {k: p[k] for k in ("router", "w_gate", "w_up", "w_down")}
+        # route PER SEQUENCE (vmap over batch): dispatch/combine tensors
+        # are O(group_tokens * E * capacity), so the group must be a
+        # sequence, not the flattened global batch — at 8B-scale token
+        # counts a flat group's dispatch tensor alone would not fit in
+        # HBM. Expert-parallel sharding over an ep axis is the scale-out
+        # form (models.moe.moe_apply_sharded).
+        out, aux = jax.vmap(lambda t: layer.apply_dense(mparams, t))(h)
+        return out, jnp.mean(aux)
+
     # -- sharding ----------------------------------------------------------
     def param_specs(self, dp: str = "dp", tp: str = "tp") -> dict:
         """PartitionSpecs for a (dp, tp) mesh: megatron-style TP — qkv/gate/
         up column-parallel, wo/down row-parallel, embeddings sharded on
-        vocab."""
+        vocab. MoE expert weights (leading (L, E) axes) shard their
+        per-expert matmul dims the same column/row-parallel way; the
+        router is replicated."""
+        if self.config.n_experts:
+            ffn = {
+                "router": P(None, None, None),
+                "w_gate": P(None, None, None, tp),
+                "w_up": P(None, None, None, tp),
+                "w_down": P(None, None, tp, None),
+            }
+        else:
+            ffn = {
+                "w_gate": P(None, None, tp),
+                "w_up": P(None, None, tp),
+                "w_down": P(None, tp, None),
+            }
         return {
             "embed": P(tp, None),
             "layers": {
@@ -148,9 +212,7 @@ class Llama:
                 "wv": P(None, None, tp),
                 "wo": P(None, tp, None),
                 "mlp_norm": P(None, None),
-                "w_gate": P(None, None, tp),
-                "w_up": P(None, None, tp),
-                "w_down": P(None, tp, None),
+                **ffn,
             },
             "final_norm": P(None),
             "lm_head": P(None, tp),
@@ -229,15 +291,21 @@ class Llama:
         x = x + attn @ p["wo"].astype(x.dtype)
 
         h = _rms_norm(x, p["mlp_norm"].astype(x.dtype), c.norm_eps)
-        gate = jax.nn.silu(h @ p["w_gate"].astype(x.dtype))
-        up = h @ p["w_up"].astype(x.dtype)
-        x = x + (gate * up) @ p["w_down"].astype(x.dtype)
-        return x
+        ffn_out, aux = self._ffn(h, p)
+        x = x + ffn_out
+        return x, aux
 
     def forward(self, params: dict, tokens: jnp.ndarray,
                 dp: str | None = None, sp: str | None = None,
-                mesh: Mesh | None = None,
-                tp: str = "tp") -> jnp.ndarray:
+                mesh: Mesh | None = None, tp: str = "tp") -> jnp.ndarray:
+        """Logits for (B, S) int32 tokens (see _forward_with_aux, which
+        additionally returns the MoE load-balancing aux loss)."""
+        return self._forward_with_aux(params, tokens, dp, sp, mesh, tp)[0]
+
+    def _forward_with_aux(self, params: dict, tokens: jnp.ndarray,
+                          dp: str | None = None, sp: str | None = None,
+                          mesh: Mesh | None = None,
+                          tp: str = "tp"):
         """Logits for (B, S) int32 tokens. When dp/sp axis names are given,
         activation sharding constraints pin batch->dp and seq->sp.
 
@@ -292,13 +360,14 @@ class Llama:
                 else jnp.tril(jnp.ones((S, S), bool))[None, None])
 
         def body(x, layer_params):
-            return self._layer(x, layer_params, positions, mask,
-                               use_flash, shard_ctx), None
+            x, aux = self._layer(x, layer_params, positions, mask,
+                                 use_flash, shard_ctx)
+            return x, aux
 
-        x, _ = jax.lax.scan(body, x, params["layers"])
+        x, auxes = jax.lax.scan(body, x, params["layers"])
         x = _rms_norm(x, params["final_norm"].astype(x.dtype), c.norm_eps)
         logits = x @ params["lm_head"].astype(c.dtype)
-        return logits.astype(jnp.float32)
+        return logits.astype(jnp.float32), jnp.sum(auxes)
 
     # -- inference: KV-cache decode ----------------------------------------
     def init_kv_cache(self, batch: int, max_len: int, dtype=None) -> dict:
@@ -378,9 +447,8 @@ class Llama:
         x = x + attn @ p["wo"].astype(x.dtype)
 
         h = _rms_norm(x, p["mlp_norm"].astype(x.dtype), c.norm_eps)
-        gate = jax.nn.silu(h @ p["w_gate"].astype(x.dtype))
-        up = h @ p["w_up"].astype(x.dtype)
-        x = x + (gate * up) @ p["w_down"].astype(x.dtype)
+        ffn_out, _aux = self._ffn(h, p)  # aux is a training-time signal
+        x = x + ffn_out
         return x, kc, vc
 
     def forward_cached(self, params: dict, tokens: jnp.ndarray,
@@ -486,12 +554,19 @@ class Llama:
     def loss(self, params: dict, tokens: jnp.ndarray,
              dp: str | None = None, sp: str | None = None,
              mesh: Mesh | None = None, tp: str = "tp") -> jnp.ndarray:
-        """Next-token cross entropy (mean over B, S-1)."""
-        logits = self.forward(params, tokens, dp, sp, mesh, tp)[:, :-1]
+        """Next-token cross entropy (mean over B, S-1), plus the MoE
+        load-balancing aux term scaled by moe_aux_coef when experts are
+        enabled."""
+        logits, aux = self._forward_with_aux(params, tokens, dp, sp,
+                                             mesh, tp)
+        logits = logits[:, :-1]
         targets = tokens[:, 1:]
         logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        return jnp.mean(nll)
+        nll = jnp.mean(-jnp.take_along_axis(logp, targets[..., None],
+                                            axis=-1))
+        if self.config.n_experts:
+            nll = nll + self.config.moe_aux_coef * aux
+        return nll
 
     # -- training ----------------------------------------------------------
     def make_train_step(self, optimizer, dp: str | None = None,
