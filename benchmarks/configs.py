@@ -602,6 +602,8 @@ def chip_llama_sweep() -> SweepResult:
 
     from .timing import slope_time
 
+    import dataclasses as _dc
+
     if _is_cpu():
         config = LlamaConfig.tiny()
         B, S = 2, 32
@@ -614,6 +616,9 @@ def chip_llama_sweep() -> SweepResult:
                              max_seq_len=2048)
         B, S = 8, 1024
         dec_prompt, dec_hi = 64, 72
+    # Mixtral-style sibling: same geometry with a routed 4-expert FFN
+    # (top-2) — the second model family's train-throughput row
+    moe_config = _dc.replace(config, n_experts=4, moe_top_k=2)
     model = Llama(config)
     params = model.init(jax.random.key(0))
     n_params = sum(x.size for x in jax.tree.leaves(params))
@@ -625,18 +630,22 @@ def chip_llama_sweep() -> SweepResult:
     tier = f"{jax.default_backend()}-chip"
     rows = []
 
-    def mk_train(K):
-        @jax.jit
-        def f(params, opt_state, tokens):
-            def body(i, c):
-                p, o = c
-                p, o, _ = train(p, o, tokens)
-                return (p, o)
-            p, _ = jax.lax.fori_loop(0, K, body, (params, opt_state))
-            return jax.tree.leaves(p)[0].reshape(-1)[0]
-        return f
+    def train_chain(step_fn):
+        """Chained train-step benchmark factory (shared by the dense and
+        MoE rows so the chaining pattern cannot diverge)."""
+        def mk(K):
+            @jax.jit
+            def f(params, opt_state, tokens):
+                def body(i, c):
+                    p, o = c
+                    p, o, _ = step_fn(p, o, tokens)
+                    return (p, o)
+                p, _ = jax.lax.fori_loop(0, K, body, (params, opt_state))
+                return jax.tree.leaves(p)[0].reshape(-1)[0]
+            return f
+        return mk
 
-    t = slope_time(mk_train, (params, opt_state, tokens),
+    t = slope_time(train_chain(train), (params, opt_state, tokens),
                    k_lo=2, k_hi=8, reps=3)
     model_dtype = str(np.dtype(config.dtype))
     rows.append({
@@ -676,6 +685,30 @@ def chip_llama_sweep() -> SweepResult:
     })
     print(log_tr)
     print(f"decode: {B / t:.0f} tokens/s at batch {B}")
+
+    # Mixtral-style MoE sibling: the second model family's
+    # train-throughput row (same geometry, routed 4-expert FFN). Free
+    # the dense model's train state + cache first — holding ~GBs of
+    # dead references while the larger MoE state allocates could OOM or
+    # fragment HBM mid-benchmark on smaller chips
+    del params, opt_state, cache, tok, logits
+    moe_model = Llama(moe_config)
+    moe_params = moe_model.init(jax.random.key(1))
+    moe_opt_state = optimizer.init(moe_params)
+    moe_train = moe_model.make_train_step(optimizer)
+
+    t = slope_time(train_chain(moe_train),
+                   (moe_params, moe_opt_state, tokens),
+                   k_lo=2, k_hi=8, reps=3)
+    rows.append({
+        "collective": "moe_llama_train_step", "algorithm": "chip",
+        "world": 1, "dtype": model_dtype, "wire_dtype": "",
+        "nbytes": B * S, "seconds_per_op": t,
+        "bus_gbps": round(B * S / t, 1), "units": "tokens/s",
+        "tier": tier,
+    })
+    print(f"moe train: {B * S / t:.0f} tokens/s "
+          f"({moe_config.n_experts} experts, top-{moe_config.moe_top_k})")
     return SweepResult(rows)
 
 

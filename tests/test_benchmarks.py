@@ -111,7 +111,8 @@ def test_chip_compression_sweep_smoke():
 def test_chip_llama_sweep_smoke():
     from benchmarks.configs import chip_llama_sweep
     res = chip_llama_sweep()
-    _check_rows(res, {"llama_train_step", "llama_decode"})
+    _check_rows(res, {"llama_train_step", "llama_decode",
+                      "moe_llama_train_step"})
 
 
 def test_roofline_prediction_clears_north_star():
