@@ -11,9 +11,11 @@ isolated-call p50 (the daemon tiers got this via wire waitfor ids +
 daemon-side FIFO retirement/error propagation).
 
 Run:  python -m benchmarks.chained [--depth 256] [--reps 30]
-                                   [--out benchmarks/results]
+                                   [--out benchmarks/results] [--tpu]
 Writes ``chained.csv`` (CSV_FIELDS schema; seconds_per_op = per-link
-p50, nbytes = 0 for nops) and prints a table.
+p50, nbytes = 0 for nops) and prints a table. ``--tpu`` instead measures
+ONLY the device driver tier (TpuDevice nop chains) and writes
+``chained_tpu.csv``.
 """
 
 from __future__ import annotations
@@ -69,8 +71,29 @@ def _rows_for(tier: str, a, depth: int, reps: int) -> list[dict]:
     return [mk("nop_isolated", iso), mk("nop_chained_link", link)]
 
 
-def run(depth: int = 256, reps: int = 30) -> SweepResult:
+def run(depth: int = 256, reps: int = 30, tpu: bool = False,
+        platform: str | None = None) -> SweepResult:
     rows = []
+
+    # Device driver tier (one rank over ``platform`` or the default
+    # backend; the chain is pure control plane — nops — so this measures
+    # the SPMD-controller call path: inline trivial-op retirement + the
+    # waitfor dep walk). ONLY this tier: the CPU tiers live in
+    # chained.csv, and the elaborate aggregate must not see each tier
+    # twice. The tier label records the backend that actually ran, so a
+    # CPU fallback can't masquerade as a chip measurement.
+    if tpu:
+        import jax
+
+        from accl_tpu.device.tpu import tpu_world
+        accls = tpu_world(1, platform=platform)
+        try:
+            rows += _rows_for(
+                f"{platform or jax.default_backend()}-driver",
+                accls[0], depth, reps)
+        finally:
+            accls[0].deinit()
+        return SweepResult(rows)
 
     # in-process emulator tier
     from accl_tpu.testing import emu_world
@@ -124,8 +147,15 @@ if __name__ == "__main__":
     ap.add_argument("--depth", type=int, default=256)
     ap.add_argument("--reps", type=int, default=30)
     ap.add_argument("--out", type=str, default=None)
+    ap.add_argument("--tpu", action="store_true",
+                    help="measure ONLY the device driver tier (1 rank "
+                         "over the default jax backend — the tier column "
+                         "records which); CSV lands in chained_tpu.csv "
+                         "so the CPU-tier chained.csv stays reproducible "
+                         "without a chip")
     args = ap.parse_args()
-    res = run(args.depth, args.reps)
+    res = run(args.depth, args.reps, tpu=args.tpu)
     if args.out:
-        res.to_csv(os.path.join(args.out, "chained.csv"))
-        print(f"wrote {os.path.join(args.out, 'chained.csv')}")
+        name = "chained_tpu.csv" if args.tpu else "chained.csv"
+        res.to_csv(os.path.join(args.out, name))
+        print(f"wrote {os.path.join(args.out, name)}")

@@ -115,6 +115,19 @@ def test_chip_llama_sweep_smoke():
                       "moe_llama_train_step"})
 
 
+def test_chained_tpu_tier_smoke():
+    """--tpu measures ONLY the TPU driver tier (nop chains through the
+    SPMD controller) so its CSV can sit beside chained.csv without the
+    elaborate aggregate double-counting the CPU tiers."""
+    from benchmarks.chained import run
+    res = run(depth=8, reps=2, tpu=True, platform="cpu")
+    assert {r["tier"] for r in res.rows} == {"cpu-driver"}
+    got = {r["collective"] for r in res.rows}
+    assert got == {"nop_isolated", "nop_chained_link"}
+    for r in res.rows:
+        assert r["seconds_per_op"] > 0
+
+
 def test_roofline_prediction_clears_north_star():
     """The executable roofline model (docs/ROOFLINE.md) must keep its
     headline claim self-consistent: >= 80% of line rate under the
