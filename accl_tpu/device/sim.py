@@ -16,6 +16,8 @@ import struct
 import threading
 from typing import Sequence
 
+import numpy as np
+
 from ..buffer import ACCLBuffer
 from ..call import CallDescriptor, CallHandle
 from ..communicator import Communicator
@@ -26,6 +28,11 @@ from .base import Device
 
 class SimDevice(Device):
     """Client to one rank daemon's command socket."""
+
+    # speculative result-readback bound for async completions: a WAIT
+    # that may come back PENDING re-sends its READ on the next poll, so
+    # only results cheap enough to re-read ride the fused path
+    _SPEC_READ_MAX = 1 << 16
 
     def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
         self._addr = (host, port)
@@ -124,16 +131,22 @@ class SimDevice(Device):
         self._check(bytes([P.MSG_WRITE_MEM]) +
                     struct.pack("<Q", buf.address) + data)
 
+    @staticmethod
+    def _land_result(buf: ACCLBuffer, reply: bytes):
+        """Land a MSG_DATA reply into a host-mirror buffer — the ONE copy
+        of the landing logic (sync path, inline-fused readback, and the
+        completion worker's speculative readback all route here)."""
+        assert reply[0] == P.MSG_DATA
+        flat = buf.data.reshape(-1).view(np.uint8)
+        flat[:] = np.frombuffer(reply, np.uint8, offset=1)
+
     def sync_from_device(self, buf: ACCLBuffer, request=None):
         """Pull devicemem into the host mirror, optionally over a
         specific connection (the completion worker passes its own)."""
         reply = (request or self._request)(
             bytes([P.MSG_READ_MEM]) +
             struct.pack("<2Q", buf.address, buf.nbytes))
-        assert reply[0] == P.MSG_DATA
-        import numpy as np
-        flat = buf.data.reshape(-1).view(np.uint8)
-        flat[:] = np.frombuffer(reply[1:], np.uint8)
+        self._land_result(buf, reply)
 
     def configure_communicator(self, comm: Communicator):
         ranks = [(r.global_rank, r.host, r.port) for r in comm.ranks]
@@ -156,7 +169,6 @@ class SimDevice(Device):
         self._check(bytes([P.MSG_RESET]))
 
     def push_stream(self, data):
-        import numpy as np
         arr = np.asarray(data).reshape(-1)
         self._check(bytes([P.MSG_STREAM_PUSH, P.dtype_code(arr.dtype)])
                     + arr.tobytes())
@@ -168,8 +180,6 @@ class SimDevice(Device):
         completion polling). ``count`` elements, or the next entry whole
         when None (wire encodes that as 0)."""
         import time as _time
-
-        import numpy as np
         deadline = _time.monotonic() + timeout
         while True:
             budget = min(0.05, max(0.0, deadline - _time.monotonic()))
@@ -583,10 +593,7 @@ class SimDevice(Device):
             self._poll_completion(desc, call_id, handle)
             return
         if not err and data_reply is not None:
-            assert data_reply[0] == P.MSG_DATA
-            import numpy as np
-            flat = res_buf.data.reshape(-1).view(np.uint8)
-            flat[:] = np.frombuffer(data_reply[1:], np.uint8)
+            self._land_result(res_buf, data_reply)
         handle.complete(err)
 
     def _poll_completion(self, desc: CallDescriptor, call_id: int,
@@ -629,28 +636,73 @@ class SimDevice(Device):
                     break
                 batch.append(nxt)
             pending = batch
+            first_round = True
             try:
                 while pending:
-                    # only the HEAD wait carries a blocking budget: FIFO
+                    # Only the HEAD wait carries a blocking budget: FIFO
                     # retirement means once the head retires the daemon
                     # answers the zero-budget probes for the rest
                     # immediately (a budget per entry would serialize a
-                    # full second per still-pending call)
-                    replies = self._request_many_wait_sock([
-                        bytes([P.MSG_WAIT]) +
-                        struct.pack("<Id", call_id,
-                                    1.0 if i == 0 else 0.0)
-                        for i, (_d, call_id, _h) in enumerate(pending)])
+                    # full second per still-pending call). Each wait is
+                    # followed by a SPECULATIVE result readback in the
+                    # same pipelined write (small results only): the
+                    # retire->complete path costs one round trip instead
+                    # of wait-then-read — the data reply is discarded
+                    # when the wait comes back PENDING or failed (stale
+                    # bytes, never used; same discipline as
+                    # _inline_fused's speculative readback).
+                    frames: list[bytes] = []
+                    spec_bufs = []
+                    for i, (desc, call_id, _h) in enumerate(pending):
+                        frames.append(bytes([P.MSG_WAIT]) +
+                                      struct.pack("<Id", call_id,
+                                                  1.0 if i == 0 else 0.0))
+                        # retry rounds (the previous head probe came back
+                        # PENDING) speculate only on the head: FIFO
+                        # retirement means nothing behind a still-pending
+                        # head can have retired either, so per-entry
+                        # re-reads would ship data that is discarded by
+                        # construction
+                        if not first_round and i > 0:
+                            spec_bufs.append(None)
+                            continue
+                        res_addr = self._result_addr(desc)
+                        res_buf = (self._resolve_buffer(res_addr)
+                                   if res_addr else None)
+                        if (res_buf is not None
+                                and res_buf.nbytes <= self._SPEC_READ_MAX):
+                            frames.append(bytes([P.MSG_READ_MEM]) +
+                                          struct.pack("<2Q", res_buf.address,
+                                                      res_buf.nbytes))
+                            spec_bufs.append(res_buf)
+                        else:
+                            spec_bufs.append(None)
+                    first_round = False
+                    replies = self._request_many_wait_sock(frames)
+                    it = iter(replies)
                     nxt_pending = []
-                    for (desc, call_id, handle), reply in zip(pending,
-                                                              replies):
+                    for (desc, call_id, handle), res_buf in zip(pending,
+                                                                spec_bufs):
+                        reply = next(it)
                         assert reply[0] == P.MSG_STATUS, reply[0]
                         err = struct.unpack("<I", reply[1:5])[0]
+                        data_reply = (next(it) if res_buf is not None
+                                      else None)
                         if err == P.STATUS_PENDING:
                             nxt_pending.append((desc, call_id, handle))
                             continue
-                        self._finish_call(desc, err, handle,
-                                          self._request_wait_sock)
+                        if not err and res_buf is not None:
+                            assert data_reply[0] == P.MSG_DATA
+                            flat = res_buf.data.reshape(-1).view("uint8")
+                            flat[:] = np.frombuffer(data_reply, np.uint8,
+                                                    offset=1)
+                            handle.complete(err)
+                        else:
+                            # big/absent result, or a failed call whose
+                            # speculative bytes must not land in the
+                            # host mirror
+                            self._finish_call(desc, err, handle,
+                                              self._request_wait_sock)
                     pending = nxt_pending
             except Exception as exc:  # noqa: BLE001
                 for _desc, _cid, handle in pending:

@@ -1,0 +1,43 @@
+#!/bin/bash
+# The round's chip-evidence queue (VERDICT r4 item 1): run every
+# hardware sweep + CI record in sequence the moment the device tunnel
+# is reachable. Each step is independently timeout-bounded and logged;
+# a failing step does not block the rest. Re-runnable: every output is
+# regenerated in place.
+#
+#   bash scripts/chip_queue.sh [logdir]
+set -u
+cd "$(dirname "$0")/.."
+LOG=${1:-/tmp/chip_queue}
+mkdir -p "$LOG"
+
+step() {
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name start $(date +%H:%M:%S)" | tee -a "$LOG/queue.log"
+  timeout "$tmo" "$@" >"$LOG/$name.log" 2>&1
+  local rc=$?
+  echo "=== $name rc=$rc $(date +%H:%M:%S)" | tee -a "$LOG/queue.log"
+  return $rc
+}
+
+# (a) attention CSV — two rounds stale vs the current kernel
+step chip_attention 3000 python -m benchmarks --chip-attention --out benchmarks/results
+# (b) decode sweep — first run of the fused decode kernel on chip
+step chip_decode 3000 python -m benchmarks --chip-decode --out benchmarks/results
+# (c) llama train+decode throughput — first committed CSV
+step chip_llama 3600 python -m benchmarks --chip-llama --out benchmarks/results
+# (d) combine + compression refresh (cheap; keeps every chip CSV same-round)
+step chip_combine 1800 python -m benchmarks --chip-sweep --out benchmarks/results
+step chip_compression 1800 python -m benchmarks --chip-compression --out benchmarks/results
+# (e) TPU CI record — the on-chip test corpus
+step tpu_ci 3600 env ACCL_TEST_TPU=1 python -m pytest tests/test_tpu_device.py tests/test_ops.py -q
+# (f) headline bench line
+step bench 1200 python bench.py
+# (g) driver-tier overhead on chip (1 rank: control-plane cost)
+step driver_overhead 1200 python -m benchmarks.driver_overhead --world 1 --platform tpu
+# (h) chained nop chains through the on-chip driver tier
+step chained_tpu 1200 python -m benchmarks.chained --tpu --depth 64 --reps 10 --out benchmarks/results
+# (i) aggregate
+step elaborate 600 python -m benchmarks --elaborate benchmarks/results
+
+echo "QUEUE DONE $(date +%H:%M:%S)" | tee -a "$LOG/queue.log"
