@@ -414,7 +414,12 @@ class DeviceStreamPort:
         self._cv = threading.Condition()
 
     def push(self, data) -> None:
-        host = np.asarray(data).reshape(-1)
+        # own the bytes: device_put ALIASES host memory on some backends
+        # (cpu), and the host-preserved branch would otherwise keep a
+        # view — either way a caller mutating its array after push would
+        # corrupt the staged entry (same eager-snapshot contract as
+        # _do_send)
+        host = np.array(data, copy=True).reshape(-1)
         if jax.dtypes.canonicalize_dtype(host.dtype) == host.dtype:
             entry = jax.device_put(host, self.dev)  # one transfer
         else:
@@ -805,14 +810,31 @@ class TpuDevice(Device):
         producer and consumer attach at the device-resident ports, and
         the op itself is a fused device program."""
         uncomp = desc.arithcfg.uncompressed_dtype
+        # a dtype jax cannot represent with x64 off (int64/f64) must
+        # never touch a jnp cast or device_put — both canonicalize to 32
+        # bits and silently corrupt the value. The whole datapath stays
+        # in numpy for these: port entries host-preserve, arithmetic has
+        # a numpy branch, and put_out/_write_result accept host arrays.
+        noncanon = (jax.dtypes.canonicalize_dtype(np.dtype(uncomp))
+                    != np.dtype(uncomp))
         deadline = (desc.deadline if desc.deadline is not None
                     else time.monotonic() + self.timeout)
         if s_op0:
-            data = self.sport.take(desc.count, uncomp, deadline)
+            data = self.sport.take(desc.count,
+                                   None if noncanon else uncomp, deadline)
             if data is None:
                 # stalled-stream semantics: same error word as the
                 # emulator tiers, nothing consumed
                 return int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
+            if noncanon:
+                # cast on host from the entries' TRUE dtypes (device
+                # entries fetch their exact canonical values; host-
+                # preserved entries already carry the full 64 bits)
+                data = np.asarray(data).astype(uncomp, copy=False)
+        elif noncanon:
+            # host read keeps the exact 64-bit operand bits
+            data = self._read_operand(desc.addr_0, desc.count, desc,
+                                      Compression.OP0_COMPRESSED)
         else:
             data = self._operand_device(desc, desc.addr_0,
                                         Compression.OP0_COMPRESSED)
@@ -835,10 +857,12 @@ class TpuDevice(Device):
             self.sport.put_out(data)
             return 0
         dst = self.dev_bufs.get(desc.addr_2)
-        if (dst is not None and dst.size == desc.count
+        if (dst is not None and dst.size == desc.count and not noncanon
                 and not (desc.compression & Compression.RES_COMPRESSED)):
             self._rebind_dev(dst, data)
         else:
+            # noncanon results stay on the host write path: _rebind_dev's
+            # device_put would canonicalize the 64-bit payload
             self._write_result(desc.addr_2, np.asarray(data), desc)
         return 0
 

@@ -324,10 +324,20 @@ class Llama:
         if c.attention == "flash" and dp is None and sp is None:
             use_flash = True
         elif (c.attention == "flash" and mesh is not None and sp is None
-                and tp in mesh.shape
-                and c.n_heads % mesh.shape[tp] == 0
-                and c.n_kv_heads % mesh.shape[tp] == 0
-                and (dp is None or B % mesh.shape.get(dp, 1) == 0)):
+                and tp in mesh.shape):
+            # tensor-parallel training: fused attention over the tp head
+            # shards. Same loud-failure discipline as the sp branch
+            # below (and as forward_cached): a silent dense fallback
+            # would materialize the O(S^2) score tensor the fused path
+            # exists to avoid.
+            if (c.n_heads % mesh.shape[tp]
+                    or c.n_kv_heads % mesh.shape[tp]):
+                raise ValueError(
+                    f"tp axis size {mesh.shape[tp]} must divide the head "
+                    f"counts (n_heads={c.n_heads}, "
+                    f"n_kv_heads={c.n_kv_heads})")
+            # (an indivisible dp batch already fails loudly upstream, at
+            # the embedding's with_sharding_constraint)
             use_flash = True
             shard_ctx = ("tp", mesh, dp, tp)
         elif c.attention == "flash" and mesh is not None and sp is not None:

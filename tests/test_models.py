@@ -200,6 +200,25 @@ def test_sharded_flash_attention_matches_unsharded(tiny, n_kv, shape):
         assert float(l1) < float(l0)
 
 
+def test_tensor_parallel_train_rejects_indivisible_heads(tiny):
+    """Training with mesh given fails LOUDLY when the tp axis size does
+    not divide the head counts (forward_cached already raised here; a
+    silent dense fallback would materialize the O(S^2) scores the fused
+    path exists to avoid)."""
+    import dataclasses
+
+    from jax.sharding import Mesh
+
+    cfg = dataclasses.replace(tiny[0], n_heads=4, n_kv_heads=2)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0))
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "tp"))
+    with jax.set_mesh(mesh):
+        with pytest.raises(ValueError, match="must divide the head counts"):
+            model.forward(params, tokens, dp="dp", mesh=mesh)
+
+
 def test_sequence_parallel_llama_via_ring_attention(tiny):
     """With mesh + sp given, the forward runs ring attention over the
     sequence shards (un-repeated GQA KV on every hop, no full-sequence

@@ -298,6 +298,28 @@ def test_overlapped_sends_then_recvs(world):
     assert res[0] == 2.0 and res[1] == 1.0
 
 
+def test_async_recv_pending_past_head_budget(world):
+    """An async recv that stays unmatched past the completion worker's
+    1 s head budget exercises the PENDING retry rounds, where the
+    speculative result readback is withheld for non-retired calls and
+    the result must land via the post-retirement read instead."""
+    import threading
+    import time
+
+    a0, a1 = world[0], world[1]
+    payload = _data(64, np.float32, 77)
+    rxb = a1.buffer((64,), np.float32)
+    h = a1.recv(rxb, 64, src=0, tag=909, run_async=True)
+    time.sleep(1.4)  # past the head WAIT budget: at least one retry round
+    assert not h.done()
+    t = threading.Thread(
+        target=lambda: a0.send(a0.buffer(data=payload), 64, dst=1, tag=909))
+    t.start()
+    h.wait(20)
+    t.join()
+    np.testing.assert_array_equal(rxb.data, payload)
+
+
 def test_deep_pipelined_chain_data_dependency(world):
     """An N-deep combine chain whose operands are all the dependency's
     RESULT flows through the wire-waitfor pipeline (batched submission +

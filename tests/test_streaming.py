@@ -215,16 +215,17 @@ def test_streams_native_daemon():
             p.kill()
 
 
-def test_streams_tpu_tier():
+def test_streams_tpu_tier(monkeypatch):
     """The TPU tier's stream ports are DEVICE-RESIDENT staging rings
     (device/tpu.py DeviceStreamPort — the SURVEY §2.9 mapping of the
     AXIS bypass port): streamed copy/combine/send/recv payloads stay jax
     device arrays end to end, with the emulator suite's semantics
     (continuous streams, stalled-stream timeout, remote-stream put)."""
     import jax as _jax
+    from test_device_resident import _host_staging_spy
 
     from accl_tpu.constants import ReduceFunc
-    from accl_tpu.device.tpu import TpuDevice, tpu_world
+    from accl_tpu.device.tpu import tpu_world
 
     accls = tpu_world(2, platform="cpu")
     a0 = accls[0]
@@ -250,14 +251,11 @@ def test_streams_tpu_tier():
     np.testing.assert_array_equal(np.asarray(popped), _x(2))
 
     # 3. send-from-stream -> recv-to-stream, zero host staging asserted
-    #    via read/write spies on both ranks' devices
-    crossings = []
-    orig_r, orig_w = TpuDevice._read_operand, TpuDevice._write_result
-    TpuDevice._read_operand = lambda self, *a, **k: (
-        crossings.append("r"), orig_r(self, *a, **k))[1]
-    TpuDevice._write_result = lambda self, *a, **k: (
-        crossings.append("w"), orig_w(self, *a, **k))[1]
-    try:
+    #    via the shared read/write spy (same helper the device-resident
+    #    suite uses; monkeypatch restores on any exit path)
+    with monkeypatch.context() as mp:
+        crossings = _host_staging_spy(accls, mp)
+
         def fn3(a):
             if a.rank == 0:
                 a.stream_push(_x(3))
@@ -270,9 +268,6 @@ def test_streams_tpu_tier():
 
         np.testing.assert_array_equal(run_ranks(accls, fn3)[1], _x(3))
         assert not crossings, f"host staging on stream path: {crossings}"
-    finally:
-        TpuDevice._read_operand = orig_r
-        TpuDevice._write_result = orig_w
 
     # 4. combine-from-stream: op0 off the port, on-device arithmetic,
     #    device-resident result
@@ -326,6 +321,34 @@ def test_streams_tpu_tier():
     a0.copy(None, None, N, stream_dtype=np.int64,
             stream_flags=StreamFlags.OP0_STREAM | StreamFlags.RES_STREAM)
     np.testing.assert_array_equal(np.asarray(a0.stream_pop(5.0)), big)
+
+    # 6c. a 64-bit MEMORY operand into a streamed result stays exact
+    #     (the datapath must not device_put it — jax would canonicalize
+    #     int64 to int32 and silently corrupt)
+    src64 = a0.buffer(data=big)
+    a0.copy(src64, None, N, stream_dtype=np.int64,
+            stream_flags=StreamFlags.RES_STREAM)
+    got64 = np.asarray(a0.stream_pop(5.0))
+    assert got64.dtype == np.int64
+    np.testing.assert_array_equal(got64, big)
+
+    # 6d. push snapshots the caller's array: mutation after push must
+    #     not reach the staged entry (eager-snapshot contract; on the
+    #     cpu backend device_put ALIASES host memory)
+    vol = _x(4).copy()
+    a0.stream_push(vol)
+    expect = vol.copy()
+    vol[:] = -999.0
+    dmut = a0.buffer((N,), np.float32)
+    a0.copy(None, dmut, N, stream_flags=StreamFlags.OP0_STREAM)
+    np.testing.assert_array_equal(dmut.data, expect)
+    vol64 = np.array([2**53 + 3] * N, dtype=np.int64)
+    a0.stream_push(vol64)
+    expect64 = vol64.copy()
+    vol64[:] = 0
+    a0.copy(None, None, N, stream_dtype=np.int64,
+            stream_flags=StreamFlags.OP0_STREAM | StreamFlags.RES_STREAM)
+    np.testing.assert_array_equal(np.asarray(a0.stream_pop(5.0)), expect64)
 
     # 7. stalled-stream timeout consumes nothing; a retry succeeds
     a0.set_timeout(0.4)
