@@ -1,0 +1,36 @@
+# Convenience targets; every recipe is the same command the docs cite.
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test native bench dryrun chip-queue csv
+
+native:            ## build the C++ rank daemon + host driver demo
+	$(MAKE) -C native
+
+test:              ## full corpus on the 8-device virtual CPU mesh
+	-$(MAKE) -C native  # best effort: corpus skips native tests if absent
+	$(CPU_ENV) $(PY) -m pytest tests/ -q
+
+bench:             ## headline JSON line (real chip when the tunnel is up)
+	$(PY) bench.py
+
+dryrun:            ## multi-chip sharding dryrun on 8 virtual devices
+	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+chip-queue:        ## every hardware sweep + on-chip CI, in sequence
+	bash scripts/chip_queue.sh
+
+csv:               ## regenerate the CPU-tier BASELINE CSVs + aggregate
+	$(PY) -m benchmarks --config 1 --out benchmarks/results
+	$(PY) -m benchmarks --config 1 --backend daemon --tag daemon --platform cpu --out benchmarks/results
+	$(PY) -m benchmarks --config 1 --backend native --tag native --platform cpu --out benchmarks/results
+	$(PY) -m benchmarks --config 1 --backend daemon --stack udp --tag daemon_udp --platform cpu --out benchmarks/results
+	$(PY) -m benchmarks --config 1 --backend native --stack udp --tag native_udp --platform cpu --out benchmarks/results
+	$(CPU_ENV) $(PY) -m benchmarks --config 2 --platform cpu --tag xla --out benchmarks/results
+	$(CPU_ENV) $(PY) -m benchmarks --config 2 --platform cpu --algorithm ring --tag ring --out benchmarks/results
+	$(CPU_ENV) $(PY) -m benchmarks --config 3 --platform cpu --out benchmarks/results
+	$(CPU_ENV) $(PY) -m benchmarks --config 4 --platform cpu --out benchmarks/results
+	$(CPU_ENV) $(PY) -m benchmarks --config 5 --platform cpu --out benchmarks/results
+	$(CPU_ENV) $(PY) -m benchmarks --sweep allreduce --algorithm ring --wire-dtype float8_e4m3fn --platform cpu --sizes 4096,65536,1048576,4194304 --tag fp8 --out benchmarks/results
+	$(PY) -m benchmarks.chained --out benchmarks/results
+	$(PY) -m benchmarks --elaborate benchmarks/results

@@ -63,6 +63,16 @@ from ..parallel.tree import Tree2DCollectives
 from .base import Device
 
 
+def _noncanonical(dtype) -> bool:
+    """True for dtypes jax cannot represent with x64 off (int64/f64 →
+    canonicalized to 32 bits). Payloads of these dtypes must NEVER touch
+    jax.device_put or a jnp cast — both silently truncate — so every
+    datapath gates on this ONE predicate: stream-port staging, the
+    streamed-local ops, and the cross-rank send refusal."""
+    d = np.dtype(dtype)
+    return jax.dtypes.canonicalize_dtype(d) != d
+
+
 def _factor_2d(w: int) -> tuple[int, int]:
     """Largest divisor pair (outer, inner) with outer <= inner — the 2D
     mesh shape the tree collectives ride. (1, w) means no 2D structure."""
@@ -420,7 +430,7 @@ class DeviceStreamPort:
         # corrupt the staged entry (same eager-snapshot contract as
         # _do_send)
         host = np.array(data, copy=True).reshape(-1)
-        if jax.dtypes.canonicalize_dtype(host.dtype) == host.dtype:
+        if not _noncanonical(host.dtype):
             entry = jax.device_put(host, self.dev)  # one transfer
         else:
             # dtype jax cannot represent with x64 off (int64/f64): keep
@@ -815,8 +825,7 @@ class TpuDevice(Device):
         # bits and silently corrupt the value. The whole datapath stays
         # in numpy for these: port entries host-preserve, arithmetic has
         # a numpy branch, and put_out/_write_result accept host arrays.
-        noncanon = (jax.dtypes.canonicalize_dtype(np.dtype(uncomp))
-                    != np.dtype(uncomp))
+        noncanon = _noncanonical(uncomp)
         deadline = (desc.deadline if desc.deadline is not None
                     else time.monotonic() + self.timeout)
         if s_op0:
@@ -892,7 +901,7 @@ class TpuDevice(Device):
             deadline = (desc.deadline if desc.deadline is not None
                         else time.monotonic() + self.timeout)
             uncomp = np.dtype(desc.arithcfg.uncompressed_dtype)
-            if jax.dtypes.canonicalize_dtype(uncomp) != uncomp:
+            if _noncanonical(uncomp):
                 # a 64-bit payload cannot cross the device fabric (jax
                 # x64 off would truncate it in the exchange program):
                 # refuse loudly BEFORE consuming the stream — the

@@ -336,8 +336,14 @@ class Llama:
                     f"tp axis size {mesh.shape[tp]} must divide the head "
                     f"counts (n_heads={c.n_heads}, "
                     f"n_kv_heads={c.n_kv_heads})")
-            # (an indivisible dp batch already fails loudly upstream, at
-            # the embedding's with_sharding_constraint)
+            # (a dp name missing from the mesh already fails loudly at
+            # the embedding's with_sharding_constraint; an INDIVISIBLE
+            # batch traces through it fine and would only die later with
+            # a cryptic shard_map divisibility error — catch it here)
+            if dp is not None and dp in mesh.shape and B % mesh.shape[dp]:
+                raise ValueError(
+                    f"batch {B} not divisible by dp axis size "
+                    f"{mesh.shape[dp]}")
             use_flash = True
             shard_ctx = ("tp", mesh, dp, tp)
         elif c.attention == "flash" and mesh is not None and sp is not None:

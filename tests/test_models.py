@@ -217,6 +217,13 @@ def test_tensor_parallel_train_rejects_indivisible_heads(tiny):
     with jax.set_mesh(mesh):
         with pytest.raises(ValueError, match="must divide the head counts"):
             model.forward(params, tokens, dp="dp", mesh=mesh)
+    # batch indivisible by dp: the dispatch raises a clear ValueError at
+    # trace time instead of a cryptic shard_map divisibility error
+    mesh2 = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "tp"))
+    with jax.set_mesh(mesh2):
+        with pytest.raises(ValueError, match="not divisible by dp"):
+            jax.jit(lambda p, t: model.forward(p, t, dp="dp", mesh=mesh2)
+                    ).trace(params, jnp.zeros((3, 16), jnp.int32))
 
 
 def test_sequence_parallel_llama_via_ring_attention(tiny):
