@@ -489,6 +489,17 @@ class RankDaemon:
             self._failed_calls[call_id] = err
             while len(self._failed_calls) > 1024:
                 self._failed_calls.pop(next(iter(self._failed_calls)))
+        # bound the status map: a chain client that waits only the LAST
+        # id (call_chain's documented pattern) would otherwise leak one
+        # retired entry per unwaited link forever. Evict oldest RETIRED
+        # entries only — a None entry marks an in-flight call whose
+        # waiter has not arrived yet.
+        if len(self._call_status) > 4096:
+            for k in list(self._call_status):
+                if self._call_status[k] is not None:
+                    del self._call_status[k]
+                    if len(self._call_status) <= 4096:
+                        break
         self._call_cv.notify_all()
 
     # Direct value->member maps for the per-call hot path: EnumMeta

@@ -82,6 +82,45 @@ int main(int argc, char** argv) {
     a.free(src); a.free(dst); a.free(sum);
   }
 
+  // pipelined wire-waitfor chain (ap_ctrl_chain parity): a 16-deep
+  // combine chain whose operand is always the previous link's result —
+  // acc doubles per link, submitted in ONE coalesced write
+  {
+    const int depth = 16;
+    Buffer acc = a.alloc(N);
+    std::vector<float> v1(N, 1.0f);
+    a.write(acc, v1.data());
+    std::vector<ACCL::CallSpec> links;
+    for (int i = 0; i < depth; ++i) {
+      ACCL::CallSpec s{};
+      s.scenario = OP_COMBINE;
+      s.count = N;
+      s.func = FN_SUM;
+      s.addr0 = acc.addr;
+      s.addr1 = acc.addr;
+      s.addr2 = acc.addr;
+      links.push_back(s);
+    }
+    auto ids = a.call_chain(links);
+    a.wait(ids.back(), 20.0);
+    expect_near(a.read_vec<float>(acc),
+                static_cast<float>(1 << depth), "call_chain");
+    a.free(acc);
+
+    // deep chain crossing the CHUNK boundary (600 > 2x256): later
+    // chunks hook their first link to the previous chunk's last id by
+    // explicit waitfor — retiring the final id retires all 600 links
+    std::vector<ACCL::CallSpec> nops(600);
+    for (auto& s : nops) { s = ACCL::CallSpec{}; s.scenario = OP_NOP; }
+    auto nids = a.call_chain(nops);
+    if (nids.size() != 600) {
+      std::fprintf(stderr, "FAIL call_chain(deep): %zu ids\n",
+                   nids.size());
+      ++failures;
+    }
+    a.wait(nids.back(), 20.0);
+  }
+
   // tag-matched send/recv ping-pong rank 0 <-> 1
   if (world >= 2 && rank < 2) {
     Buffer buf = a.alloc(N);

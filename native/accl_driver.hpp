@@ -17,6 +17,7 @@
 #include <netinet/tcp.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdint>
 #include <mutex>
@@ -30,6 +31,10 @@
 namespace accl {
 
 using namespace accl_proto;
+
+// wire sentinel: "the previous MSG_CALL on this connection" (both
+// daemons resolve it per connection; protocol.py WAITFOR_PREV)
+static const uint32_t WAITFOR_PREV = 0xFFFFFFFFu;
 
 // Timer parity: driver/xrt/include/timing.hpp
 class Timer {
@@ -209,33 +214,81 @@ class ACCL {
   }
 
   // -- calls --------------------------------------------------------------
+  // One call's descriptor fields; the building block of chained
+  // submission (the Python driver's CallDescriptor analog).
+  struct CallSpec {
+    uint8_t scenario;
+    uint64_t count = 0;
+    uint32_t root = 0;
+    uint8_t func = 0;
+    uint32_t tag = TAG_ANY;
+    uint64_t addr0 = 0, addr1 = 0, addr2 = 0;
+    uint8_t udtype = DT_F32, cdtype = DT_F32;
+    uint8_t compression = C_NONE;
+    uint8_t stream = 0;
+    uint8_t algorithm = ALG_AUTO;
+  };
+
   // Async form: returns a call id; wait(id) blocks until retirement.
+  // ``waitfor`` ships wire dependency ids (earlier call ids, or
+  // WAITFOR_PREV for "the previous call on this connection") — the
+  // daemon's FIFO worker enforces ordering and error propagation.
   uint32_t call_async(uint8_t scenario, uint64_t count, uint32_t root,
                       uint8_t func, uint32_t tag, uint64_t addr0,
                       uint64_t addr1, uint64_t addr2, uint8_t udtype,
                       uint8_t cdtype, uint8_t compression = C_NONE,
-                      uint8_t stream = 0, uint8_t algorithm = ALG_AUTO) {
-    std::vector<uint8_t> body{MSG_CALL};
-    put_le<uint8_t>(body, scenario);
-    put_le<uint8_t>(body, func);
-    put_le<uint8_t>(body, compression);
-    put_le<uint8_t>(body, stream);
-    put_le<uint8_t>(body, udtype);
-    put_le<uint8_t>(body, cdtype);
-    put_le<uint8_t>(body, algorithm);
-    put_le<uint8_t>(body, 0);  // pad
-    put_le<uint64_t>(body, count);
-    put_le<uint32_t>(body, comm_.comm_id);
-    put_le<uint32_t>(body, root);
-    put_le<uint32_t>(body, tag);
-    put_le<uint64_t>(body, addr0);
-    put_le<uint64_t>(body, addr1);
-    put_le<uint64_t>(body, addr2);
-    put_le<uint16_t>(body, 0);  // n_waitfor (chaining is wait()-side here)
-    auto reply = request(body);
+                      uint8_t stream = 0, uint8_t algorithm = ALG_AUTO,
+                      const std::vector<uint32_t>& waitfor = {}) {
+    CallSpec s{scenario, count, root, func, tag, addr0, addr1, addr2,
+               udtype, cdtype, compression, stream, algorithm};
+    auto reply = request(build_call(s, waitfor));
     if (reply.empty() || reply[0] != MSG_CALL_ID)
       throw std::runtime_error("bad MSG_CALL reply");
     return get_le<uint32_t>(reply.data() + 1);
+  }
+
+  // Pipelined chain submission (hostctrl ap_ctrl_chain parity,
+  // reference hostctrl.cpp:56-90; the Python driver's batched
+  // wire-waitfor path, device/sim.py _flush_run): every link after the
+  // first carries WAITFOR_PREV, ALL the MSG_CALL frames leave in one
+  // coalesced write, and the CALL_ID replies stream back — an N-deep
+  // chain costs N pipelined submissions, not N serialized round trips.
+  // Returns the call ids; wait(ids.back()) retires the whole chain
+  // (FIFO retirement + daemon-side failed-dep propagation).
+  std::vector<uint32_t> call_chain(const std::vector<CallSpec>& links) {
+    // Chunked submission: writing an unbounded batch before reading any
+    // reply can deadlock once both TCP directions fill (the daemon
+    // blocks writing CALL_ID replies the client isn't reading). Each
+    // chunk's replies drain before the next chunk ships; the first link
+    // of a later chunk names its dependency by EXPLICIT id — its true
+    // predecessor's id is already known from the drained replies.
+    static const size_t CHUNK = 256;
+    std::vector<uint32_t> ids;
+    std::lock_guard<std::mutex> lk(io_mu_);
+    for (size_t base = 0; base < links.size(); base += CHUNK) {
+      size_t n = std::min(CHUNK, links.size() - base);
+      std::vector<std::vector<uint8_t>> frames;
+      frames.reserve(n);
+      for (size_t i = 0; i < n; ++i) {
+        std::vector<uint32_t> wf;
+        if (i)
+          wf.push_back(WAITFOR_PREV);
+        else if (base)
+          wf.push_back(ids.back());
+        frames.push_back(build_call(links[base + i], wf));
+      }
+      if (!send_frames(fd_, frames))
+        throw std::runtime_error("daemon connection closed (send)");
+      for (size_t i = 0; i < n; ++i) {
+        std::vector<uint8_t> reply;
+        if (!recv_frame(fd_, reply))
+          throw std::runtime_error("daemon connection closed (recv)");
+        if (reply.empty() || reply[0] != MSG_CALL_ID)
+          throw std::runtime_error("bad MSG_CALL reply in chain");
+        ids.push_back(get_le<uint32_t>(reply.data() + 1));
+      }
+    }
+    return ids;
   }
 
   void wait(uint32_t call_id, double budget_s = 0.05) {
@@ -419,6 +472,35 @@ class ACCL {
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     return fd;
+  }
+
+  static std::vector<uint8_t> build_call_body(
+      const CallSpec& s, const std::vector<uint32_t>& waitfor,
+      uint32_t comm_id) {
+    std::vector<uint8_t> body{MSG_CALL};
+    put_le<uint8_t>(body, s.scenario);
+    put_le<uint8_t>(body, s.func);
+    put_le<uint8_t>(body, s.compression);
+    put_le<uint8_t>(body, s.stream);
+    put_le<uint8_t>(body, s.udtype);
+    put_le<uint8_t>(body, s.cdtype);
+    put_le<uint8_t>(body, s.algorithm);
+    put_le<uint8_t>(body, 0);  // pad
+    put_le<uint64_t>(body, s.count);
+    put_le<uint32_t>(body, comm_id);
+    put_le<uint32_t>(body, s.root);
+    put_le<uint32_t>(body, s.tag);
+    put_le<uint64_t>(body, s.addr0);
+    put_le<uint64_t>(body, s.addr1);
+    put_le<uint64_t>(body, s.addr2);
+    put_le<uint16_t>(body, static_cast<uint16_t>(waitfor.size()));
+    for (uint32_t w : waitfor) put_le<uint32_t>(body, w);
+    return body;
+  }
+
+  std::vector<uint8_t> build_call(const CallSpec& s,
+                                  const std::vector<uint32_t>& waitfor) {
+    return build_call_body(s, waitfor, comm_.comm_id);
   }
 
   std::vector<uint8_t> request(const std::vector<uint8_t>& body) {

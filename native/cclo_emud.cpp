@@ -1181,6 +1181,13 @@ class RankDaemon {
           while (failed_calls_.size() > 1024)
             failed_calls_.erase(failed_calls_.begin());
         }
+        // bound the status map (Python daemon parity): a chain client
+        // waiting only the LAST id would otherwise leak one retired
+        // entry per unwaited link forever; every entry here is retired
+        // (pending calls are ABSENT until retirement), so evicting the
+        // oldest ids only affects a waiter 4096 calls behind
+        while (call_status_.size() > 4096)
+          call_status_.erase(call_status_.begin());
         call_cv_.notify_all();
       }
     }

@@ -145,6 +145,31 @@ inline bool recv_frame(int fd, std::vector<uint8_t>& body) {
   return len == 0 || recv_exact(fd, body.data(), len);
 }
 
+// Pipelined batch: every frame's length prefix + body coalesce into one
+// buffer and one send (the Python side's P.send_frames). Framing is
+// byte-identical to send_frame/recv_frame — same native-order uint32
+// prefix — because this is the ONLY other place frames are written.
+inline bool send_frames(int fd, const std::vector<std::vector<uint8_t>>& bodies) {
+  size_t total = 0;
+  for (const auto& b : bodies) total += 4 + b.size();
+  std::vector<uint8_t> wire;
+  wire.reserve(total);
+  for (const auto& b : bodies) {
+    uint32_t len = static_cast<uint32_t>(b.size());
+    const uint8_t* lp = reinterpret_cast<const uint8_t*>(&len);
+    wire.insert(wire.end(), lp, lp + 4);
+    wire.insert(wire.end(), b.begin(), b.end());
+  }
+  size_t sent = 0;
+  while (sent < wire.size()) {
+    ssize_t r = ::send(fd, wire.data() + sent, wire.size() - sent,
+                       MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
 inline bool send_frame(int fd, const std::vector<uint8_t>& body) {
   // scatter-gather send: the length header and the body go out in one
   // syscall without copying the body into a fresh buffer (a per-frame

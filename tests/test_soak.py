@@ -133,3 +133,17 @@ def test_soak_native_daemon():
         for p in procs:
             p.kill()
             p.wait()
+
+
+def test_soak_tpu_tier():
+    """The same mixed-call storm through the SPMD-controller tier: the
+    host rendezvous, collective batching, and device-resident staging
+    run the identical seeded schedule the daemon tiers survive."""
+    from accl_tpu.device.tpu import tpu_world
+
+    accls = tpu_world(W, platform="cpu")
+    try:
+        _soak(accls)
+    finally:
+        for a in accls:
+            a.deinit()
