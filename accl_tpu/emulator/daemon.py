@@ -431,6 +431,9 @@ class RankDaemon:
         # global FIFO order is provable (queue empty + nothing running)
         self._executing = 0
         self._call_status: dict[int, int | None] = {}
+        # ids a blocked MSG_WAIT is sleeping on (waiter counts): these
+        # entries are immune to the status-map eviction
+        self._wait_active: dict[int, int] = {}
         # failed calls persist past their MSG_WAIT (which pops the
         # status): a call chained via wire waitfor must observe its
         # dependency's failure even after the client polled it. Bounded
@@ -489,17 +492,21 @@ class RankDaemon:
             self._failed_calls[call_id] = err
             while len(self._failed_calls) > 1024:
                 self._failed_calls.pop(next(iter(self._failed_calls)))
-        # bound the status map: a chain client that waits only the LAST
+        # Bound the status map: a chain client that waits only the LAST
         # id (call_chain's documented pattern) would otherwise leak one
-        # retired entry per unwaited link forever. Evict oldest RETIRED
-        # entries only — a None entry marks an in-flight call whose
-        # waiter has not arrived yet.
+        # retired entry per unwaited link forever. At most ONE eviction
+        # per insert keeps it bounded without a hot-path key copy, and
+        # two classes are never evicted: None entries (in-flight calls)
+        # and ids a blocked MSG_WAIT is actively sleeping on (evicting
+        # those would turn a retired call into a spurious timeout).
         if len(self._call_status) > 4096:
-            for k in list(self._call_status):
-                if self._call_status[k] is not None:
-                    del self._call_status[k]
-                    if len(self._call_status) <= 4096:
-                        break
+            evict = None
+            for k, v in self._call_status.items():
+                if v is not None and k not in self._wait_active:
+                    evict = k
+                    break
+            if evict is not None:
+                del self._call_status[evict]
         self._call_cv.notify_all()
 
     # Direct value->member maps for the per-call hot path: EnumMeta
@@ -870,12 +877,21 @@ class RankDaemon:
             import time as _time
             deadline = _time.monotonic() + budget
             with self._call_cv:
-                while self._call_status.get(call_id) is None:
-                    remaining = deadline - _time.monotonic()
-                    if remaining <= 0:
-                        return P.status_reply(P.STATUS_PENDING)
-                    self._call_cv.wait(remaining)
-                err = self._call_status.pop(call_id)
+                self._wait_active[call_id] = \
+                    self._wait_active.get(call_id, 0) + 1
+                try:
+                    while self._call_status.get(call_id) is None:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            return P.status_reply(P.STATUS_PENDING)
+                        self._call_cv.wait(remaining)
+                    err = self._call_status.pop(call_id)
+                finally:
+                    n = self._wait_active.get(call_id, 1) - 1
+                    if n:
+                        self._wait_active[call_id] = n
+                    else:
+                        self._wait_active.pop(call_id, None)
             return P.status_reply(err)
         if kind == P.MSG_GET_INFO:
             # base geometry + config-state extension (readable effect of

@@ -138,6 +138,47 @@ def run(depth: int = 256, reps: int = 30, tpu: bool = False,
     else:
         print("daemon-native skipped (make -C native first)")
 
+    # pure-native tier: the C++ DRIVER's call_chain against the C++
+    # daemon — no Python on either side of the wire; accl_demo's
+    # --chain-bench mode prints the one line parsed here
+    demo = os.path.join(os.path.dirname(native), "accl_demo")
+    if os.path.exists(native) and os.path.exists(demo):
+        from accl_tpu.testing import free_port_base
+        port_base = free_port_base()
+        dproc = subprocess.Popen(
+            [native, "--rank", "0", "--world", "1",
+             "--port-base", str(port_base)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            time.sleep(0.3)
+            proc = subprocess.run(
+                [demo, "--rank", "0", "--world", "1",
+                 "--port-base", str(port_base),
+                 "--chain-bench", str(depth), "--reps", str(reps)],
+                capture_output=True, text=True, timeout=120)
+            # "native-driver  isolated X us  chained/link Y us  ratio Z"
+            toks = proc.stdout.split()
+            if proc.returncode != 0 or "isolated" not in toks:
+                # a failed demo run must not discard the tiers already
+                # measured above
+                print("native-driver skipped (accl_demo rc="
+                      f"{proc.returncode}): {proc.stderr.strip()[:200]}")
+            else:
+                iso = float(toks[toks.index("isolated") + 1]) * 1e-6
+                link = float(toks[toks.index("chained/link") + 1]) * 1e-6
+                mk = lambda name, t: {  # noqa: E731
+                    "collective": name, "algorithm": "chain", "world": 1,
+                    "dtype": "", "wire_dtype": "", "nbytes": 0,
+                    "seconds_per_op": t, "bus_gbps": 0.0,
+                    "tier": "native-driver",
+                }
+                print(proc.stdout.strip())
+                rows += [mk("nop_isolated", iso),
+                         mk("nop_chained_link", link)]
+        finally:
+            dproc.terminate()
+            dproc.wait(timeout=10)
+
     return SweepResult(rows)
 
 

@@ -40,15 +40,49 @@ static void expect_near(const std::vector<float>& got, float want,
   }
 }
 
+// Pure-native chained-call benchmark (reference test.py:934-950 in C++):
+// isolated nop p50 vs per-link cost of a DEPTH-deep pipelined chain,
+// interleaved like benchmarks/chained.py so drift hits both equally.
+static int chain_bench(ACCL& a, size_t depth, int reps) {
+  auto p50 = [](std::vector<double>& v) {
+    std::sort(v.begin(), v.end());
+    return v[v.size() / 2];
+  };
+  for (int i = 0; i < 8; ++i) a.nop();  // warmup
+  std::vector<double> iso, link;
+  std::vector<ACCL::CallSpec> nops(depth);
+  for (auto& s : nops) { s = ACCL::CallSpec{}; s.scenario = OP_NOP; }
+  for (int r = 0; r < reps; ++r) {
+    for (int i = 0; i < 4; ++i) {
+      Timer t; t.start(); a.nop(); t.end();
+      iso.push_back(static_cast<double>(t.elapsed_us()));
+    }
+    Timer t; t.start();
+    auto ids = a.call_chain(nops);
+    a.wait(ids.back(), 20.0);
+    t.end();
+    link.push_back(static_cast<double>(t.elapsed_us()) /
+                   static_cast<double>(depth));
+  }
+  std::printf("native-driver     isolated %8.1f us   chained/link "
+              "%8.1f us   ratio %.2f\n",
+              p50(iso), p50(link), p50(link) / p50(iso));
+  return 0;
+}
+
 int main(int argc, char** argv) {
   uint32_t rank = 0, world = 2;
   uint16_t port_base = 45000;
+  size_t bench_depth = 0;
+  int bench_reps = 30;
   for (int i = 1; i + 1 < argc; i += 2) {
     std::string k = argv[i];
     const char* v = argv[i + 1];
     if (k == "--rank") rank = atoi(v);
     else if (k == "--world") world = atoi(v);
     else if (k == "--port-base") port_base = atoi(v);
+    else if (k == "--chain-bench") bench_depth = atoi(v);
+    else if (k == "--reps") bench_reps = atoi(v);
   }
 
   Timer t_construct, t_config, t_nop, t_collectives;
@@ -66,6 +100,8 @@ int main(int argc, char** argv) {
   t_nop.start();
   a.nop();
   t_nop.end();
+
+  if (bench_depth) return chain_bench(a, bench_depth, bench_reps);
 
   const uint64_t N = 64;  // elements per rank
   t_collectives.start();

@@ -1181,13 +1181,20 @@ class RankDaemon {
           while (failed_calls_.size() > 1024)
             failed_calls_.erase(failed_calls_.begin());
         }
-        // bound the status map (Python daemon parity): a chain client
+        // Bound the status map (Python daemon parity): a chain client
         // waiting only the LAST id would otherwise leak one retired
-        // entry per unwaited link forever; every entry here is retired
-        // (pending calls are ABSENT until retirement), so evicting the
-        // oldest ids only affects a waiter 4096 calls behind
-        while (call_status_.size() > 4096)
-          call_status_.erase(call_status_.begin());
+        // entry per unwaited link forever. Entries a blocked MSG_WAIT
+        // sleeps on are immune — evicting one would turn a retired
+        // call into a spurious client timeout.
+        if (call_status_.size() > 4096) {
+          for (auto it = call_status_.begin();
+               it != call_status_.end(); ++it) {
+            if (wait_active_.find(it->first) == wait_active_.end()) {
+              call_status_.erase(it);
+              break;
+            }
+          }
+        }
         call_cv_.notify_all();
       }
     }
@@ -1371,6 +1378,9 @@ class RankDaemon {
   // calls
   std::deque<std::pair<uint32_t, std::vector<uint8_t>>> call_queue_;
   std::map<uint32_t, uint32_t> call_status_;
+  // ids a blocked MSG_WAIT sleeps on (waiter counts): immune to the
+  // status-map eviction (guarded by call_mu_)
+  std::map<uint32_t, int> wait_active_;
   std::map<uint32_t, uint32_t> failed_calls_;  // persists past MSG_WAIT
   uint32_t next_call_id_ = 1;
   std::mutex call_mu_;
@@ -1984,10 +1994,16 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
       std::unique_lock<std::mutex> lk(call_mu_);
       auto deadline = std::chrono::steady_clock::now() +
                       std::chrono::duration<double>(sane_budget(budget));
+      wait_active_[id]++;
+      bool pending = false;
       while (call_status_.find(id) == call_status_.end()) {
-        if (call_cv_.wait_until(lk, deadline) == std::cv_status::timeout)
-          return status_reply(STATUS_PENDING);
+        if (call_cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+          pending = true;
+          break;
+        }
       }
+      if (--wait_active_[id] == 0) wait_active_.erase(id);
+      if (pending) return status_reply(STATUS_PENDING);
       uint32_t err = call_status_[id];
       call_status_.erase(id);
       return status_reply(err);

@@ -298,6 +298,50 @@ def test_overlapped_sends_then_recvs(world):
     assert res[0] == 2.0 and res[1] == 1.0
 
 
+def test_status_map_bounded_under_unwaited_chains():
+    """The C++ driver's call_chain pattern — wait only the LAST id —
+    must not leak a retired-status entry per unwaited link: the daemon
+    evicts oldest retired entries past the bound, never an id a blocked
+    waiter sleeps on, and a wait for an evicted id reports PENDING."""
+    import socket
+    import struct
+
+    from accl_tpu.emulator import protocol as P
+    from accl_tpu.emulator.daemon import spawn_world
+
+    daemons, pb = spawn_world(1)
+    try:
+        sock = socket.create_connection(("127.0.0.1", pb), timeout=10)
+        rf = sock.makefile("rb")
+        NOP = P.pack_call(255, 0, 0, 0, P.DTYPE_CODES["float32"],
+                          P.DTYPE_CODES["float32"], 0, 0, 0, 0, 0, 0, 0,
+                          [])
+        first_id = last_id = None
+        for base in range(0, 5000, 250):  # chunked like call_chain
+            P.send_frames(sock, [NOP] * 250)
+            for _ in range(250):
+                reply = P.recv_frame_file(rf)
+                assert reply[0] == P.MSG_CALL_ID
+                cid = struct.unpack("<I", reply[1:5])[0]
+                first_id = cid if first_id is None else first_id
+                last_id = cid
+        # waiting the last id succeeds; the map stayed bounded
+        P.send_frame(sock, bytes([P.MSG_WAIT]) +
+                     struct.pack("<Id", last_id, 10.0))
+        reply = P.recv_frame_file(rf)
+        assert struct.unpack("<I", reply[1:5])[0] == 0
+        assert len(daemons[0]._call_status) <= 4100
+        # the first id was evicted long ago: PENDING, not a crash
+        P.send_frame(sock, bytes([P.MSG_WAIT]) +
+                     struct.pack("<Id", first_id, 0.05))
+        reply = P.recv_frame_file(rf)
+        assert struct.unpack("<I", reply[1:5])[0] == P.STATUS_PENDING
+        sock.close()
+    finally:
+        for d in daemons:
+            d.shutdown()
+
+
 def test_async_recv_pending_past_head_budget(world):
     """An async recv that stays unmatched past the completion worker's
     1 s head budget exercises the PENDING retry rounds, where the
