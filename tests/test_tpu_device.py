@@ -231,6 +231,40 @@ def test_sub_communicator_allreduce_tpu(world):
     assert res[0] is None
 
 
+def test_concurrent_world_subcomm_and_p2p(world):
+    """World + disjoint sub-communicator collectives and p2p in flight
+    simultaneously: the rendezvous keys on (comm, op_index), so the
+    three traffic streams must never cross-match."""
+    W = len(world)
+    half = W // 2
+
+    def fn(a):
+        r = a.rank
+        sub = a.split_communicator(list(range(half)) if r < half
+                                   else list(range(half, W)))
+        for it in range(8):
+            n = 32
+            d = a.buffer((n,), np.float32)
+            h1 = a.allreduce(a.buffer(data=np.full(n, r + 1.0, np.float32)),
+                             d, n, run_async=True)
+            d2 = a.buffer((n,), np.float32)
+            h2 = a.allreduce(a.buffer(data=np.full(n, 10.0 + r, np.float32)),
+                             d2, n, comm=sub, run_async=True)
+            dst = a.buffer((n,), np.float32)
+            hs = a.send(a.buffer(data=np.full(n, 100.0 + r, np.float32)),
+                        n, dst=(r + 1) % W, tag=it, run_async=True)
+            hr = a.recv(dst, n, src=(r - 1) % W, tag=it, run_async=True)
+            for h in (h1, h2, hs, hr):
+                h.wait(60)
+            assert d.data[0] == W * (W + 1) / 2, (r, it, d.data[0])
+            lo = 0 if r < half else half
+            assert d2.data[0] == sum(10.0 + x for x in range(lo, lo + half))
+            assert dst.data[0] == 100.0 + (r - 1) % W
+        return True
+
+    assert all(run_ranks(world, fn, timeout=120.0))
+
+
 def test_recv_count_mismatch_error(world):
     """Short send into a longer recv must fail like the emulator tier."""
     def fn(a):
