@@ -220,11 +220,16 @@ class TpuContext:
                     batch = self._xchg_pending[cid]
                     self._xchg_pending[cid] = []
             if not claimed:
-                # wait on OUR completion event (set per round, so a
-                # transfer finished in round 1 of a multi-round batch
-                # wakes immediately); the short timeout doubles as the
-                # leadership re-check backstop if a leader died
-                entry.done.wait(0.05)
+                # Wait on the shared Condition: the leader notifies it
+                # after every completed round AND on batch handoff, so a
+                # waiter wakes immediately both when its own transfer
+                # completes mid-batch and when leadership frees up —
+                # sleeping on the per-entry Event instead would miss the
+                # handoff notify and eat a full poll tick. The short
+                # timeout stays as the backstop if a leader died.
+                with self._lock:
+                    if not entry.done.is_set() and cid in self._xchg_running:
+                        self._lock.wait(0.05)
                 continue
             try:
                 self._run_exchange_batch(comm, batch)
@@ -285,6 +290,10 @@ class TpuContext:
                         e.error = RuntimeError(
                             "destination shard missing from exchange")
                         e.done.set()
+                with self._lock:
+                    # wake Condition sleepers whose entries just
+                    # completed (they no longer sleep on the Event)
+                    self._lock.notify_all()
 
     def device(self, rank: int) -> "TpuDevice":
         if self.devices[rank] is None:

@@ -434,6 +434,9 @@ class RankDaemon:
         # ids a blocked MSG_WAIT is sleeping on (waiter counts): these
         # entries are immune to the status-map eviction
         self._wait_active: dict[int, int] = {}
+        # highest retired-status id the eviction dropped: MSG_WAIT
+        # resolves ids at/below it from _failed_calls (FIFO retirement)
+        self._evicted_max = 0
         # failed calls persist past their MSG_WAIT (which pops the
         # status): a call chained via wire waitfor must observe its
         # dependency's failure even after the client polled it. Bounded
@@ -507,6 +510,12 @@ class RankDaemon:
                     break
             if evict is not None:
                 del self._call_status[evict]
+                # a DEFERRED wait for an evicted id must still resolve:
+                # record the high-water mark so MSG_WAIT can infer the
+                # outcome (retirement is FIFO — an id at or below the
+                # mark retired; its error, if any, is in _failed_calls)
+                if evict > self._evicted_max:
+                    self._evicted_max = evict
         self._call_cv.notify_all()
 
     # Direct value->member maps for the per-call hot path: EnumMeta
@@ -881,6 +890,12 @@ class RankDaemon:
                     self._wait_active.get(call_id, 0) + 1
                 try:
                     while self._call_status.get(call_id) is None:
+                        if (call_id not in self._call_status
+                                and call_id <= self._evicted_max):
+                            # evicted after retirement: FIFO means it DID
+                            # retire; failures survive in _failed_calls
+                            return P.status_reply(
+                                self._failed_calls.get(call_id, 0))
                         remaining = deadline - _time.monotonic()
                         if remaining <= 0:
                             return P.status_reply(P.STATUS_PENDING)

@@ -321,15 +321,23 @@ class Llama:
             x = jax.lax.with_sharding_constraint(x, P(dp, sp, None))
         positions = jnp.arange(S)
         shard_ctx = None
-        if c.attention == "flash" and dp is None and sp is None:
+        if (c.attention == "flash" and dp is None and sp is None
+                and mesh is None):
+            # unsharded: the bare pallas_call. A passed mesh must NOT
+            # land here — a bare pallas_call has no GSPMD partitioning
+            # rule, so sharded operands need the shard_map tp branch.
             use_flash = True
-        elif (c.attention == "flash" and mesh is not None and sp is None
-                and tp in mesh.shape):
+        elif c.attention == "flash" and mesh is not None and sp is None:
             # tensor-parallel training: fused attention over the tp head
             # shards. Same loud-failure discipline as the sp branch
             # below (and as forward_cached): a silent dense fallback
             # would materialize the O(S^2) score tensor the fused path
             # exists to avoid.
+            if tp not in mesh.shape:
+                raise ValueError(
+                    f"mesh given but tp axis {tp!r} is not in mesh "
+                    f"{tuple(mesh.shape)}: name the model axis via tp=, "
+                    "or omit mesh= for the unsharded fused kernel")
             if (c.n_heads % mesh.shape[tp]
                     or c.n_kv_heads % mesh.shape[tp]):
                 raise ValueError(

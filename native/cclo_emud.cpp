@@ -1190,6 +1190,7 @@ class RankDaemon {
           for (auto it = call_status_.begin();
                it != call_status_.end(); ++it) {
             if (wait_active_.find(it->first) == wait_active_.end()) {
+              if (it->first > evicted_max_) evicted_max_ = it->first;
               call_status_.erase(it);
               break;
             }
@@ -1381,6 +1382,9 @@ class RankDaemon {
   // ids a blocked MSG_WAIT sleeps on (waiter counts): immune to the
   // status-map eviction (guarded by call_mu_)
   std::map<uint32_t, int> wait_active_;
+  // highest retired-status id the eviction dropped: MSG_WAIT resolves
+  // ids at/below it from failed_calls_ (retirement is FIFO)
+  uint32_t evicted_max_ = 0;
   std::map<uint32_t, uint32_t> failed_calls_;  // persists past MSG_WAIT
   uint32_t next_call_id_ = 1;
   std::mutex call_mu_;
@@ -1997,6 +2001,13 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
       wait_active_[id]++;
       bool pending = false;
       while (call_status_.find(id) == call_status_.end()) {
+        if (id <= evicted_max_) {
+          // evicted after retirement: FIFO means it DID retire; a
+          // failure survives in failed_calls_
+          if (--wait_active_[id] == 0) wait_active_.erase(id);
+          auto f = failed_calls_.find(id);
+          return status_reply(f == failed_calls_.end() ? 0 : f->second);
+        }
         if (call_cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
           pending = true;
           break;
