@@ -7,6 +7,10 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 native:            ## build the C++ rank daemon + host driver demo
 	$(MAKE) -C native
 
+native-asan:       ## sanitizer build of the daemon (drive with the soak/demo)
+	g++ -O1 -g -fsanitize=address,undefined -std=c++17 -Wall -pthread \
+	    -o native/cclo_emud_asan native/cclo_emud.cpp
+
 test:              ## full corpus on the 8-device virtual CPU mesh
 	-$(MAKE) -C native  # best effort: corpus skips native tests if absent
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
