@@ -532,3 +532,88 @@ def test_concurrent_sendrecv_batches_exchange_programs(world, monkeypatch):
     # every pair crossed in SOME program
     moved = {p for ps in calls for p in ps}
     assert moved == {((r - 1) % W, r) for r in range(W)}
+
+
+def test_device_resident_storm_all_ops(world):
+    """Back-to-back device-resident collectives across every op family
+    (dense fast path AND the rooted tree programs) with varying counts
+    and rotating roots: results stay correct, and a result buffer REUSED
+    as the next iteration's source keeps its residency."""
+    def fn(a):
+        r = a.rank
+        prev = None  # previous allreduce dest, reused as the next source
+        for it in range(14):
+            op = ["allreduce", "bcast", "scatter", "gather", "reduce",
+                  "allgather", "alltoall"][it % 7]
+            # allreduce keeps one size so iteration 7 actually REUSES
+            # iteration 0's result buffer as its source
+            n = 8 if op == "allreduce" else (8, 256)[it % 2]
+            root = it % W
+            base = np.arange(n, dtype=np.float32)
+            if op == "allreduce":
+                if prev is not None and prev.size == n:
+                    s = prev  # result reused as source, still resident
+                    assert s.is_device_resident
+                    expect = W * float(np.asarray(prev.data)[0])
+                else:
+                    s = a.buffer(data=np.full(n, r + 1.0, np.float32),
+                                 device_resident=True)
+                    expect = W * (W + 1) / 2
+                d = a.buffer((n,), np.float32, device_resident=True)
+                a.allreduce(s, d, n)
+                np.testing.assert_allclose(
+                    d.data, np.full(n, expect, np.float32), rtol=1e-6)
+                assert d.is_device_resident
+                prev = d
+            elif op == "bcast":
+                b = (a.buffer(data=base + it, device_resident=True)
+                     if r == root else
+                     a.buffer((n,), np.float32, device_resident=True))
+                a.bcast(b, n, root=root)
+                np.testing.assert_allclose(b.data, base + it, rtol=1e-6)
+                assert b.is_device_resident
+            elif op == "scatter":
+                big = a.buffer(data=np.tile(base, W) + r,
+                               device_resident=True)
+                mine = a.buffer((n,), np.float32, device_resident=True)
+                a.scatter(big, mine, n, root=root)
+                np.testing.assert_allclose(mine.data, base + root,
+                                           rtol=1e-6)
+                assert mine.is_device_resident
+            elif op == "gather":
+                mine = a.buffer(data=base * (r + 1), device_resident=True)
+                out = a.buffer((n * W,), np.float32, device_resident=True)
+                a.gather(mine, out, n, root=root)
+                if r == root:
+                    for k in range(W):
+                        np.testing.assert_allclose(
+                            out.data[k * n:(k + 1) * n], base * (k + 1),
+                            rtol=1e-6)
+            elif op == "reduce":
+                s = a.buffer(data=base + r, device_resident=True)
+                d = a.buffer((n,), np.float32, device_resident=True)
+                a.reduce(s, d, n, root=root)
+                if r == root:
+                    np.testing.assert_allclose(
+                        d.data, base * W + sum(range(W)), rtol=1e-6)
+            elif op == "allgather":
+                mine = a.buffer(data=base + 10 * r, device_resident=True)
+                out = a.buffer((n * W,), np.float32, device_resident=True)
+                a.allgather(mine, out, n)
+                for k in range(W):
+                    np.testing.assert_allclose(
+                        out.data[k * n:(k + 1) * n], base + 10 * k,
+                        rtol=1e-6)
+            else:  # alltoall
+                s = a.buffer(
+                    data=np.repeat(np.arange(W, dtype=np.float32), n)
+                    + r * 100, device_resident=True)
+                d = a.buffer((n * W,), np.float32, device_resident=True)
+                a.alltoall(s, d, n)
+                for k in range(W):
+                    np.testing.assert_allclose(
+                        d.data[k * n:(k + 1) * n], r + k * 100, rtol=1e-6)
+                assert d.is_device_resident
+        return True
+
+    assert all(run_ranks(world, fn, timeout=240.0))
