@@ -1,0 +1,64 @@
+"""Async call chaining: waitfor dependencies pipelined through the wire.
+
+The reference chains async calls in hardware without host round trips
+between links (hostctrl ap_ctrl_chain, test/host/test.py:934-950). Here
+a chain is ``run_async=True`` + ``waitfor=[prev]``: the driver submits
+every link without waiting for the previous link's host-visible
+completion (wire waitfor ids + daemon-side FIFO retirement), so an
+N-deep chain costs N pipelined submissions rather than N serialized
+round trips. The C++ client's equivalent is ``ACCL::call_chain``
+(native/accl_driver.hpp; driven by ``accl_demo``).
+
+Run:  python examples/08_chained_calls.py
+(CPU Python-daemon tier — the full socket protocol, no TPU needed.)
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accl_tpu import ReduceFunc
+from accl_tpu.testing import sim_world
+
+DEPTH = 64
+N = 256
+
+
+def main():
+    a = sim_world(1)[0]
+
+    # a data-dependent chain: acc doubles per link (combine acc+acc->acc)
+    acc = a.buffer(data=np.ones(N, np.float32))
+    h = None
+    t0 = time.perf_counter()
+    for _ in range(DEPTH):
+        h = a.combine(N, ReduceFunc.SUM, acc, acc, acc, run_async=True,
+                      waitfor=[h] if h else [])
+    h.wait()
+    chained_s = time.perf_counter() - t0
+    acc.sync_from_device()
+    want = float(2 ** DEPTH)
+    assert np.all(acc.data == want), (acc.data[0], want)
+
+    # the same work, serialized: one sync call per link
+    acc2 = a.buffer(data=np.ones(N, np.float32))
+    t0 = time.perf_counter()
+    for _ in range(DEPTH):
+        a.combine(N, ReduceFunc.SUM, acc2, acc2, acc2)
+    serial_s = time.perf_counter() - t0
+    acc2.sync_from_device()
+    assert np.all(acc2.data == want)
+
+    print(f"{DEPTH}-deep chain: pipelined {chained_s * 1e6 / DEPTH:.1f} "
+          f"us/link vs serialized {serial_s * 1e6 / DEPTH:.1f} us/link "
+          f"(speedup {serial_s / chained_s:.1f}x)")
+    a.deinit()
+    print("chain OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,7 @@ QUICK = [
     ("01_pingpong.py", "us RTT"),
     ("03_native_daemons.py", "done."),
     ("04_streams_and_compression.py", "OK"),
+    ("08_chained_calls.py", "chain OK"),
 ]
 
 
