@@ -2,7 +2,12 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test native bench dryrun chip-queue csv
+.PHONY: all test lint native bench dryrun chip-queue csv tune
+
+all: lint native   ## default flow: syntax gate first, then the native build
+
+lint:              ## fast syntax gate over every python tree
+	$(PY) -m compileall -q accl_tpu benchmarks tests
 
 native:            ## build the C++ rank daemon + host driver demo
 	$(MAKE) -C native
@@ -11,9 +16,12 @@ native-asan:       ## sanitizer build of the daemon (drive with the soak/demo)
 	g++ -O1 -g -fsanitize=address,undefined -std=c++17 -Wall -pthread \
 	    -o native/cclo_emud_asan native/cclo_emud.cpp
 
-test:              ## full corpus on the 8-device virtual CPU mesh
+test: lint         ## full corpus on the 8-device virtual CPU mesh
 	-$(MAKE) -C native  # best effort: corpus skips native tests if absent
 	$(CPU_ENV) $(PY) -m pytest tests/ -q
+
+tune:              ## emulator-tier algorithm sweep -> bench_out/tuning.json
+	$(PY) -m benchmarks --tune --out bench_out
 
 bench:             ## headline JSON line (real chip when the tunnel is up)
 	$(PY) bench.py

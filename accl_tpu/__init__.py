@@ -25,6 +25,7 @@ from .constants import (ACCLError, CCLOp, CfgFunc, Compression, ErrorCode,
                         decode_error)
 from .device import Device, EmuContext, EmuDevice
 from .tracing import Profiler
+from .tuner import Topology, Tuner
 
 __version__ = "0.1.0"
 
@@ -33,6 +34,6 @@ __all__ = [
     "CallHandle", "CCLOp", "CfgFunc", "Communicator", "Compression",
     "DEFAULT_ARITH_CONFIGS", "Device", "EmuContext", "EmuDevice",
     "ErrorCode", "Profiler", "Rank", "ReduceFunc", "StackType", "StreamFlags",
-    "TAG_ANY", "decode_error", "resolve_arith_config",
+    "TAG_ANY", "Topology", "Tuner", "decode_error", "resolve_arith_config",
     "simple_communicator", "wait_all",
 ]

@@ -27,7 +27,7 @@ from .call import CallDescriptor, CallHandle, CompletedHandle
 from .communicator import Communicator
 from .constants import (CCLOp, CfgFunc, CollectiveAlgorithm, Compression,
                         DEFAULT_MAX_SEGMENT_SIZE, ReduceFunc, StreamFlags,
-                        TAG_ANY)
+                        TAG_ANY, VALID_ALGORITHMS)
 from .device.base import Device
 from .tracing import Profiler
 
@@ -39,13 +39,20 @@ class ACCL:
         device: the execution backend (EmuDevice / SimDevice / TpuDevice).
         comm: the world communicator for this rank.
         timeout: receive timeout in seconds (set_timeout parity).
-        max_segment_size: wire segmentation granularity.
+        max_segment_size: wire segmentation granularity. When None, the
+            attached tuner recommends one against the backend's
+            ``preferred_segment_size()`` (no tuner: the preferred size).
+        tuner: optional :class:`~accl_tpu.tuner.Tuner` resolving AUTO
+            algorithm selectors by size/topology and learning from
+            retire-time measurements. Multi-rank worlds must share ONE
+            tuner instance across their ranks (all member ranks of a
+            collective must agree on the algorithm).
     """
 
     def __init__(self, device: Device, comm: Communicator,
                  timeout: float = 30.0,
                  max_segment_size: int | None = None,
-                 arith_registry=None):
+                 arith_registry=None, tuner=None):
         self.device = device
         self._arith_memo: dict[frozenset, object] = {}
         self.arith_registry = (arith_registry if arith_registry is not None
@@ -54,6 +61,31 @@ class ACCL:
         self._barrier_buf: ACCLBuffer | None = None
         self._scratch_bufs: dict[tuple[int, str], ACCLBuffer] = {}
         self.profiler = Profiler()
+        self.tuner = tuner
+        # async calls this driver has issued that have not retired yet —
+        # tuner-training measurements only happen on a quiet device
+        # (an unrelated in-flight call would add its queue wait to the
+        # measured window)
+        import threading as _threading
+        self._async_mu = _threading.Lock()
+        self._async_inflight = 0
+        if tuner is not None:
+            if tuner.topology is None:
+                tuner.topology = device.topology()
+            # engine-level AUTO resolution for descriptors that reach the
+            # move engine still unresolved (moveengine.expand_call)
+            device.tuner = tuner
+            # fleet-shared tuning table (tuner/cache.py env override):
+            # pins load best-effort — a missing/stale cache is not an
+            # error — and once per tuner, not once per rank sharing it
+            from .tuner import cache as _tcache
+            if (_tcache.default_cache_path()
+                    and not getattr(tuner, "_env_cache_loaded", False)):
+                tuner._env_cache_loaded = True
+                try:
+                    _tcache.load_into(tuner)
+                except (OSError, ValueError):
+                    pass
         device.configure_communicator(comm)
         self.communicators.append(comm)
         # bring-up sequence through the call path, mirroring the reference
@@ -63,6 +95,9 @@ class ACCL:
         self._config_call(CfgFunc.enable_pkt, 1)
         if max_segment_size is None:
             max_segment_size = device.preferred_segment_size()
+            if tuner is not None:
+                max_segment_size = tuner.recommend_segment_size(
+                    max_segment_size)
         self.set_max_segment_size(max_segment_size)
 
     def _scratch(self, count: int, dtype) -> ACCLBuffer:
@@ -253,6 +288,19 @@ class ACCL:
                 compression |= Compression.RES_COMPRESSED
         if isinstance(algorithm, str):
             algorithm = CollectiveAlgorithm[algorithm.upper()]
+        algorithm = CollectiveAlgorithm(algorithm)
+        if (algorithm == CollectiveAlgorithm.AUTO and self.tuner is not None
+                and scenario.name in VALID_ALGORITHMS):
+            # resolve AUTO here so the concrete choice crosses the wire to
+            # daemon/TPU tiers too (the engine-level fallback in
+            # expand_call only covers in-process descriptors) — except for
+            # ops the backend keeps for its own AUTO handling (the TPU
+            # tier's 2D-tree rooted collectives, device.auto_resolvable_ops)
+            resolvable = self.device.auto_resolvable_ops()
+            if resolvable is None or scenario.name in resolvable:
+                algorithm = self.tuner.select(
+                    scenario.name, comm.size,
+                    count * cfg.uncompressed_elem_bytes)
         return CallDescriptor(
             scenario=scenario, count=count, comm_id=comm.comm_id,
             root_src_dst=root_src_dst, function=func, tag=tag,
@@ -266,20 +314,80 @@ class ACCL:
               waitfor: Sequence[CallHandle]) -> CallHandle:
         import time as _time
         profiling = self.profiler.enabled and desc.scenario != CCLOp.config
-        t0 = _time.perf_counter() if profiling else 0.0
+        tunable = (desc.scenario.name in VALID_ALGORITHMS
+                   and desc.algorithm != CollectiveAlgorithm.AUTO)
+        # only unchained SYNCHRONOUS calls on a QUIET device train the
+        # tuner: chained calls include predecessor wait time in their
+        # issue->retire window, async calls queue behind each other on
+        # the device worker, and a sync call issued while async work is
+        # still in flight queues behind it too — any of these would
+        # credit pipeline context, not algorithm speed, to the EWMA (the
+        # Profiler keeps recording them all — attribution wants the full
+        # window; training does not)
+        observing = (self.tuner is not None and tunable
+                     and not run_async and not waitfor
+                     and self._async_inflight == 0)
+        t0 = _time.perf_counter() if (profiling or observing) else 0.0
         handle = self.device.call_async(desc, waitfor,
                                         inline_ok=not run_async)
+        ebytes = (desc.arithcfg.uncompressed_elem_bytes
+                  if desc.arithcfg is not None else 0)
         if profiling:
-            ebytes = (desc.arithcfg.uncompressed_elem_bytes
-                      if desc.arithcfg is not None else 0)
-            self.profiler.attach(handle, op=desc.scenario.name,
-                                 count=desc.count,
+            op = desc.scenario.name
+            if tunable:
+                alg_label = desc.algorithm.name
+            elif op in VALID_ALGORITHMS:
+                # AUTO descriptor: when the backend resolves every op's
+                # AUTO through the shared engine path (emu/sim tiers),
+                # the concrete default it will expand is knowable here —
+                # record it so untuned-run history stays usable for
+                # Tuner.ingest_records. Backends with internal AUTO
+                # handling the enum cannot name (TPU 2D trees) get the
+                # honest "AUTO" label instead.
+                from .constants import DEFAULT_ALGORITHMS
+                alg_label = (DEFAULT_ALGORITHMS[op].name
+                             if (self.tuner is None and
+                                 self.device.auto_resolvable_ops() is None)
+                             else "AUTO")
+            else:
+                alg_label = ""
+            self.profiler.attach(handle, op=op, count=desc.count,
                                  nbytes=desc.count * ebytes,
-                                 comm_id=desc.comm_id, t0=t0)
+                                 comm_id=desc.comm_id, t0=t0,
+                                 algorithm=alg_label)
+        if observing:
+            # retire-time measurement back to the tuner (same done-callback
+            # path the profiler records through: async chains credit their
+            # true issue->retire duration, not host dispatch time)
+            tuner, op = self.tuner, desc.scenario.name
+            world, nbytes = self.comm_of(desc.comm_id).size, \
+                desc.count * ebytes
+            alg = desc.algorithm
+
+            def _feed(error_word: int, _t0=t0):
+                tuner.observe(op, world, nbytes, alg,
+                              _time.perf_counter() - _t0, error_word)
+
+            handle.add_done_callback(_feed)
         if run_async:
+            with self._async_mu:
+                self._async_inflight += 1
+
+            def _retired(_err):
+                with self._async_mu:
+                    self._async_inflight -= 1
+
+            handle.add_done_callback(_retired)
             return handle
         handle.wait()
         return CompletedHandle(context=desc.scenario.name)
+
+    def comm_of(self, comm_id: int) -> Communicator:
+        """Registered communicator by id (world or split)."""
+        for c in self.communicators:
+            if c.comm_id == comm_id:
+                return c
+        raise KeyError(f"no communicator with id {comm_id}")
 
     # -- primitives (parity: accl.py:738-985) ------------------------------
     def nop(self, run_async: bool = False,

@@ -147,11 +147,32 @@ VALID_ALGORITHMS: dict[str, frozenset] = {
 }
 
 
+# What AUTO resolves to when no tuner is attached: one table shared by the
+# move engine's dispatch and the tuner's fallback path, so the static
+# defaults cannot drift between the two resolvers.
+DEFAULT_ALGORITHMS: dict[str, CollectiveAlgorithm] = {
+    "bcast": CollectiveAlgorithm.ROUND_ROBIN,
+    "scatter": CollectiveAlgorithm.ROUND_ROBIN,
+    "gather": CollectiveAlgorithm.RING,
+    "reduce": CollectiveAlgorithm.RING,
+    "allgather": CollectiveAlgorithm.RING,
+    "allreduce": CollectiveAlgorithm.FUSED_RING,
+    "reduce_scatter": CollectiveAlgorithm.RING,
+}
+
+
 def check_algorithm(scenario_name: str, algorithm) -> None:
     """Raise ValueError unless (scenario, algorithm) is a legal pair."""
     if algorithm == CollectiveAlgorithm.AUTO:
         return
-    valid = VALID_ALGORITHMS.get(scenario_name, frozenset())
+    valid = VALID_ALGORITHMS.get(scenario_name)
+    if valid is None:
+        # ops like send/recv/copy have no algorithm axis at all — say so
+        # instead of printing a baffling "valid: []"
+        raise ValueError(
+            f"{scenario_name} has no algorithm variants; only "
+            f"CollectiveAlgorithm.AUTO is accepted, got "
+            f"{CollectiveAlgorithm(algorithm).name}")
     if algorithm not in valid:
         raise ValueError(
             f"{scenario_name} does not support algorithm "

@@ -23,6 +23,31 @@ from ..communicator import Communicator
 class Device(abc.ABC):
     """One rank's execution backend."""
 
+    # Optional attached Tuner (accl_tpu/tuner): the driver sets this when
+    # constructed with ``tuner=`` so engine-level AUTO resolution
+    # (moveengine.expand_call via MoveContext.tuner) can consult it for
+    # descriptors that still carry AUTO when they reach the engine.
+    tuner = None
+
+    def topology(self):
+        """Link-level descriptor of this backend's fabric tier, feeding
+        the tuner's cost model (tuner/cost.py). Backends override with
+        calibrated per-tier figures; this generic default only has to
+        order algorithms sanely."""
+        from ..tuner.cost import Topology
+        return Topology(world_size=0, alpha_us=50.0, beta_gbps=1.0,
+                        tier="generic")
+
+    def auto_resolvable_ops(self):
+        """Ops whose AUTO the driver may resolve through the tuner before
+        issue; None (the default) means every op with an algorithm axis.
+        A backend whose own AUTO handling beats anything the selector
+        enum can express restricts this — the TPU tier's hierarchical
+        2D-mesh tree for rooted scatter/gather/reduce has no enum value,
+        so resolving their AUTO to RING/ROUND_ROBIN would silently
+        degrade it (device/tpu.py overrides)."""
+        return None
+
     # -- shared inline fast-path gate (used by Emu/Sim backends) ----------
     # A backend that can retire a synchronous call in the caller's thread
     # guards the path with one counter: >0 means calls are queued or not

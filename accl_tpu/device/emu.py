@@ -137,6 +137,14 @@ class EmuDevice(Device):
     def preferred_segment_size(self) -> int:
         return self.ctx.bufsize
 
+    def topology(self):
+        """In-process loopback tier: a hop is a couple of thread handoffs
+        plus pool matching (tens of microseconds), bandwidth is memcpy
+        through the fabric queues."""
+        from ..tuner.cost import Topology
+        return Topology(world_size=self.ctx.world_size, alpha_us=20.0,
+                        beta_gbps=4.0, tier="emu")
+
     def push_stream(self, data):
         self.executor.push_stream(data)
 
@@ -239,7 +247,8 @@ class EmuDevice(Device):
         ctx = MoveContext(world_size=comm.size,
                           local_rank=comm.local_rank,
                           arithcfg=desc.arithcfg,
-                          max_segment_size=self.max_segment_size)
+                          max_segment_size=self.max_segment_size,
+                          tuner=self.tuner)
         moves = expand_call(
             ctx, desc.scenario, count=desc.count,
             root_src_dst=desc.root_src_dst, func=desc.function,

@@ -162,6 +162,20 @@ class SimDevice(Device):
             return min(self._daemon_bufsize, DEFAULT_MAX_SEGMENT_SIZE)
         return DEFAULT_MAX_SEGMENT_SIZE
 
+    def topology(self):
+        """Socket-daemon tier: a hop pays an RPC to the daemon plus the
+        eth-fabric socket transfer (low hundreds of microseconds);
+        bandwidth is loopback-TCP-framed. World size from the daemon's
+        geometry when it reports one."""
+        from ..tuner.cost import Topology
+        world = 0
+        try:
+            world = int(self.get_info().get("world", 0))
+        except Exception:  # pre-GET_INFO daemons: world stays unknown
+            pass
+        return Topology(world_size=world, alpha_us=150.0, beta_gbps=0.5,
+                        tier="sim")
+
     def set_max_segment_size(self, nbytes: int):
         self._check(bytes([P.MSG_SET_SEG]) + struct.pack("<Q", nbytes))
 

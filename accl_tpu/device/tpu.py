@@ -609,6 +609,28 @@ class TpuDevice(Device):
     def set_max_segment_size(self, nbytes: int):
         self.max_segment_size = nbytes
 
+    def topology(self):
+        """Mesh tier: an ICI hop is ~a microsecond and per-link bandwidth
+        is in the 100 GB/s class on real chips; on the CPU-mesh stand-in
+        the same ordering holds (host collectives, negligible per-hop
+        software cost vs the emulator tiers)."""
+        from ..tuner.cost import Topology
+        return Topology(world_size=self.ctx.world_size, alpha_us=1.0,
+                        beta_gbps=100.0, tier="tpu")
+
+    def auto_resolvable_ops(self):
+        """The rooted ops (bcast/scatter/gather/reduce) keep their AUTO:
+        on 2D meshes it lowers to the hierarchical tree (O(outer+inner)
+        fan-out), and a tuner resolving AUTO to ROUND_ROBIN/RING would
+        force the masked 1-D lowering — allreduce/allgather-class
+        traffic regardless of root — based on cost models shaped for the
+        move-engine tiers. (bcast does have a TREE selector, but the
+        tuner's small-message choice would be ROUND_ROBIN, the exact
+        degradation; callers who want the 1-D path can force it.) The
+        dense collectives map cleanly onto the xla/ring axis the tuner
+        chooses between."""
+        return frozenset({"allreduce", "allgather", "reduce_scatter"})
+
     # Inline eligibility in the submitting thread, preserving the async
     # contract (call_async must not block an async caller on real work):
     # - nop/config are trivial — always inline.
@@ -1439,11 +1461,13 @@ class TpuDevice(Device):
 
 
 def tpu_world(world_size: int | None = None, platform: str | None = None,
-              algorithm: str = "xla", timeout: float = DEFAULT_TIMEOUT_S
-              ) -> list:
+              algorithm: str = "xla", timeout: float = DEFAULT_TIMEOUT_S,
+              tuner=None) -> list:
     """Create ACCL instances backed by a device mesh (one rank per device).
 
-    The TPU-tier analog of testing.emu_world."""
+    The TPU-tier analog of testing.emu_world. ``tuner`` (one shared
+    :class:`~accl_tpu.tuner.Tuner`) resolves AUTO selectors by
+    size/topology — same rank-agreement rule as emu_world."""
     from ..accl import ACCL
     from ..communicator import Communicator, Rank
     ctx = TpuContext(world_size, platform=platform, algorithm=algorithm)
@@ -1451,5 +1475,6 @@ def tpu_world(world_size: int | None = None, platform: str | None = None,
     accls = []
     for r in range(W):
         comm = Communicator(ranks=[Rank() for _ in range(W)], local_rank=r)
-        accls.append(ACCL(ctx.device(r), comm, timeout=timeout))
+        accls.append(ACCL(ctx.device(r), comm, timeout=timeout,
+                          tuner=tuner))
     return accls

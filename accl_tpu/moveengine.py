@@ -27,11 +27,12 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Iterator
+from typing import Any, Iterator
 
 from .arith import ArithConfig
-from .constants import (CCLOp, CollectiveAlgorithm, Compression, ReduceFunc,
-                        StreamFlags, TAG_ANY, check_algorithm)
+from .constants import (CCLOp, CollectiveAlgorithm, Compression,
+                        DEFAULT_ALGORITHMS, ReduceFunc, StreamFlags,
+                        TAG_ANY, check_algorithm)
 
 
 def res_as_op0(compression: Compression) -> Compression:
@@ -140,6 +141,10 @@ class MoveContext:
     local_rank: int
     arithcfg: ArithConfig
     max_segment_size: int
+    # Optional attached Tuner (accl_tpu/tuner): consulted by expand_call
+    # when a descriptor still carries CollectiveAlgorithm.AUTO at the
+    # engine (duck-typed — anything with .select(op, world, nbytes)).
+    tuner: Any = None
 
     def ebytes(self, compressed: bool = False) -> int:
         return (self.arithcfg.compressed_elem_bytes if compressed
@@ -723,9 +728,23 @@ def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
     # ops without an algorithm axis reject any explicit selector
     check_algorithm(scenario.name, alg)
 
-    def pick(op_algs: dict, default):
-        """Resolve AUTO to the default algorithm."""
-        return default if alg == A.AUTO else op_algs[alg]
+    def pick(op_algs: dict):
+        """Resolve AUTO through the attached tuner (size/topology-aware),
+        falling back to the shared DEFAULT_ALGORITHMS table. A driver
+        with a tuner normally resolves AUTO before the descriptor is
+        issued (so the choice also crosses the wire to daemon tiers);
+        this engine-level path covers descriptors that arrive still
+        carrying AUTO."""
+        if alg != A.AUTO:
+            return op_algs[alg]
+        chosen = A.AUTO
+        if ctx.tuner is not None:
+            nbytes = count * ctx.arithcfg.uncompressed_elem_bytes
+            chosen = A(ctx.tuner.select(scenario.name, ctx.world_size,
+                                        nbytes))
+        if chosen == A.AUTO or chosen not in op_algs:
+            chosen = DEFAULT_ALGORITHMS[scenario.name]
+        return op_algs[chosen]
 
     if scenario == CCLOp.nop:
         return []
@@ -745,33 +764,30 @@ def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
                            stream)
     if scenario == CCLOp.bcast:
         fn = pick({A.ROUND_ROBIN: expand_broadcast,
-                   A.TREE: expand_broadcast_tree}, expand_broadcast)
+                   A.TREE: expand_broadcast_tree})
         return fn(ctx, count, root_src_dst, addr_0, compression)
     if scenario == CCLOp.scatter:
-        fn = pick({A.ROUND_ROBIN: expand_scatter}, expand_scatter)
+        fn = pick({A.ROUND_ROBIN: expand_scatter})
         return fn(ctx, count, root_src_dst, addr_0, addr_2, compression)
     if scenario == CCLOp.gather:
         fn = pick({A.RING: expand_gather_ring,
-                   A.ROUND_ROBIN: expand_gather_direct}, expand_gather_ring)
+                   A.ROUND_ROBIN: expand_gather_direct})
         return fn(ctx, count, root_src_dst, addr_0, addr_2, compression)
     if scenario == CCLOp.reduce:
         fn = pick({A.RING: expand_reduce_ring,
-                   A.ROUND_ROBIN: expand_reduce_direct}, expand_reduce_ring)
+                   A.ROUND_ROBIN: expand_reduce_direct})
         return fn(ctx, count, root_src_dst, func, addr_0, addr_2, compression)
     if scenario == CCLOp.allgather:
         fn = pick({A.RING: expand_allgather_ring,
-                   A.ROUND_ROBIN: expand_allgather_direct},
-                  expand_allgather_ring)
+                   A.ROUND_ROBIN: expand_allgather_direct})
         return fn(ctx, count, addr_0, addr_2, compression)
     if scenario == CCLOp.allreduce:
         fn = pick({A.RING: expand_allreduce_ring,
                    A.FUSED_RING: expand_allreduce_ring,
-                   A.NON_FUSED: expand_allreduce_nonfused},
-                  expand_allreduce_ring)
+                   A.NON_FUSED: expand_allreduce_nonfused})
         return fn(ctx, count, func, addr_0, addr_2, compression)
     if scenario == CCLOp.reduce_scatter:
-        fn = pick({A.RING: expand_reduce_scatter_ring},
-                  expand_reduce_scatter_ring)
+        fn = pick({A.RING: expand_reduce_scatter_ring})
         return fn(ctx, count, func, addr_0, addr_2, compression)
     if scenario == CCLOp.alltoall:
         return expand_alltoall(ctx, count, addr_0, addr_2, compression)

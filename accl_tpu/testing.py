@@ -20,8 +20,13 @@ from .device.emu import EmuContext
 
 def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
               timeout: float = 20.0,
-              max_segment_size: int | None = None) -> list[ACCL]:
-    """Create ``world_size`` ACCL instances sharing an in-process fabric."""
+              max_segment_size: int | None = None,
+              tuner=None) -> list[ACCL]:
+    """Create ``world_size`` ACCL instances sharing an in-process fabric.
+
+    ``tuner`` (a single :class:`~accl_tpu.tuner.Tuner`) is shared by every
+    rank — the only safe shape: all member ranks of a collective must
+    resolve AUTO to the same algorithm."""
     kw = {"nbufs": nbufs}
     if bufsize is not None:
         kw["bufsize"] = bufsize
@@ -31,7 +36,7 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
         comm = Communicator(
             ranks=[Rank() for _ in range(world_size)], local_rank=r)
         accls.append(ACCL(ctx.device(r), comm, timeout=timeout,
-                          max_segment_size=max_segment_size))
+                          max_segment_size=max_segment_size, tuner=tuner))
     return accls
 
 

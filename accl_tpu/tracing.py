@@ -48,6 +48,14 @@ class CallRecord:
     t_start: float          # perf_counter seconds, host-side issue time
     duration_s: float       # issue -> retire
     error_word: int = 0
+    algorithm: str = ""     # CollectiveAlgorithm name the call ran: a
+    #                         concrete name where the driver/engine choice
+    #                         is knowable (explicit selector, tuner pick,
+    #                         or the shared-engine default), "AUTO" when a
+    #                         backend resolved it internally (TPU trees),
+    #                         "" when the op has no algorithm axis — what
+    #                         Tuner.ingest_records keys refinement on
+    #                         (concrete names only)
 
     @property
     def duration_us(self) -> float:
@@ -110,7 +118,7 @@ class Profiler:
             self._records.append(rec)
 
     def attach(self, handle, op: str, count: int, nbytes: int, comm_id: int,
-               t0: float | None = None):
+               t0: float | None = None, algorithm: str = ""):
         """Register a done callback on ``handle`` that records the call's
         host-issue -> retire duration. Pass ``t0`` captured before dispatch
         so the record covers the full issue->retire window even when the
@@ -122,7 +130,7 @@ class Profiler:
             self.record(CallRecord(
                 op=op, count=count, nbytes=nbytes, comm_id=comm_id,
                 t_start=t0, duration_s=time.perf_counter() - t0,
-                error_word=error_word))
+                error_word=error_word, algorithm=algorithm))
 
         handle.add_done_callback(_on_done)
 
@@ -161,11 +169,33 @@ class Profiler:
         """Raw record dump, one row per retired call — the shape the
         reference benchmark writes (bench_*.csv, test/host/test.py:949)."""
         with open(path, "w") as f:
-            f.write("op,count,nbytes,comm_id,t_start,duration_us,error\n")
+            f.write("op,count,nbytes,comm_id,t_start,duration_us,error,"
+                    "algorithm\n")
             for r in self.records:
                 f.write(f"{r.op},{r.count},{r.nbytes},{r.comm_id},"
                         f"{r.t_start:.9f},{r.duration_us:.3f},"
-                        f"{r.error_word}\n")
+                        f"{r.error_word},{r.algorithm}\n")
+
+    @staticmethod
+    def read_csv(path: str) -> list[CallRecord]:
+        """Parse a :meth:`to_csv` dump back into records (export/import
+        round trip — e.g. to feed an offline run's history into a
+        ``Tuner`` via ``ingest_records``). Dumps from before the
+        ``algorithm`` column read back with it empty."""
+        import csv as _csv
+
+        out = []
+        with open(path, newline="") as f:
+            for row in _csv.DictReader(f):
+                out.append(CallRecord(
+                    op=row["op"], count=int(row["count"]),
+                    nbytes=int(row["nbytes"]),
+                    comm_id=int(row["comm_id"]),
+                    t_start=float(row["t_start"]),
+                    duration_s=float(row["duration_us"]) * 1e-6,
+                    error_word=int(row["error"]),
+                    algorithm=row.get("algorithm") or ""))
+        return out
 
 # -- JAX profiler bridges ---------------------------------------------------
 @contextlib.contextmanager

@@ -1,0 +1,25 @@
+"""Autotuner: cost-model + measurement-driven algorithm & segment-size
+selection.
+
+Three layers (docs/TUNER.md):
+
+* :mod:`~accl_tpu.tuner.cost` — alpha-beta analytic cost models per
+  (collective, algorithm) over a :class:`Topology` descriptor each device
+  backend exposes (``Device.topology()``);
+* :mod:`~accl_tpu.tuner.tuner` — the thread-safe :class:`Tuner` resolving
+  ``AUTO`` per (op, world_size, nbytes-bucket), refined online from
+  retire-time measurements, with epsilon-greedy exploration and segment-
+  size recommendation;
+* :mod:`~accl_tpu.tuner.cache` — versioned JSON tuning tables
+  (``ACCL_TPU_TUNING_CACHE``) produced by ``python -m benchmarks --tune``.
+
+Attach with ``ACCL(device, comm, tuner=Tuner())``.
+"""
+
+from . import cache
+from .cost import Topology, predict_us, rank_algorithms, \
+    recommend_segment_size
+from .tuner import Tuner, nbytes_bucket
+
+__all__ = ["Topology", "Tuner", "cache", "nbytes_bucket", "predict_us",
+           "rank_algorithms", "recommend_segment_size"]

@@ -1,0 +1,169 @@
+"""Alpha-beta analytic cost models per (collective, algorithm).
+
+The model follows the classic Hockney formulation the collective-selection
+literature keys on (NCCL's tuner, EQuARX's size/topology-keyed XLA
+decisions — PAPERS.md): a move costs ``alpha`` microseconds of fixed
+per-hop overhead (software expansion, tag matching, rendezvous) plus its
+wire bytes over a ``beta`` GB/s link. Every formula below models OUR move
+expansions (moveengine.py), not textbook ideals:
+
+* ring/daisy algorithms serialize ``W-1`` dependency hops, each paying a
+  full ``alpha`` — cheap per-hop payloads, expensive in hop count;
+* direct (round-robin) algorithms pay one ``alpha`` of critical-path
+  latency but funnel ``W-1`` payloads through one endpoint, modeled with
+  an ``incast`` congestion factor on the wire term;
+* the fused ring allreduce does ``2(W-1)`` blocking steps of ``n/W``
+  bytes; the non-fused variant is a daisy-chain reduce of the full
+  payload plus a broadcast whose root-side sends are non-blocking
+  (expand_broadcast marks them ``blocking=False``) and therefore overlap
+  down to one ``alpha`` plus serialized injection.
+
+The crossovers these shapes produce are the point of the subsystem:
+latency-bound (small ``n``) calls favor few-alpha algorithms, bandwidth-
+bound (large ``n``) calls favor low-wire-volume ones. Absolute numbers
+only need to be *ordered* correctly per topology tier; the online
+measurement path (tuner.py) refines where the model is wrong.
+
+``nbytes`` everywhere is the call's ``count * uncompressed_elem_bytes`` —
+the same convention the driver computes, so model and measurement index
+the same quantity (NOTE: for chunked ops — gather/allgather/scatter/
+reduce_scatter/alltoall — ``count`` is the per-rank chunk, so ``nbytes``
+is chunk bytes, not aggregate payload).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from ..constants import CollectiveAlgorithm, VALID_ALGORITHMS
+
+__all__ = ["Topology", "predict_us", "rank_algorithms",
+           "recommend_segment_size"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Link-level descriptor of one fabric tier.
+
+    Each :class:`~accl_tpu.device.base.Device` backend exposes its own via
+    ``Device.topology()``; the numbers are calibrated order-of-magnitude
+    figures for that tier (thread handoff vs socket RPC vs ICI hop), good
+    enough to order algorithms — measurement refines the rest.
+    """
+
+    world_size: int = 0        # ranks on the fabric (0 = not yet known)
+    alpha_us: float = 50.0     # per-hop latency + per-move software cost
+    beta_gbps: float = 1.0     # per-link bandwidth, GB/s
+    incast: float = 2.0        # fan-in congestion factor at a hot receiver
+    tier: str = "generic"
+
+    def wire_us(self, nbytes: float) -> float:
+        """Microseconds to move ``nbytes`` over one link."""
+        return float(nbytes) / (self.beta_gbps * 1e3)  # GB/s == bytes/us*1e3
+
+
+# -- per-(op, algorithm) models ---------------------------------------------
+# Each takes (topo, W, nbytes) and returns predicted microseconds.
+
+def _ring_chain(topo: Topology, w: int, nbytes: float) -> float:
+    """W-1 serialized hops of the full per-hop payload (gather/allgather
+    relays, daisy-chain reduce, ring reduce-scatter)."""
+    return (w - 1) * (topo.alpha_us + topo.wire_us(nbytes))
+
+
+def _direct_fanin(topo: Topology, w: int, nbytes: float) -> float:
+    """One hop of latency; W-1 payloads squeezed through one endpoint."""
+    return topo.alpha_us + topo.incast * (w - 1) * topo.wire_us(nbytes)
+
+
+def _bcast_rr(topo: Topology, w: int, nbytes: float) -> float:
+    """Root's sends are non-blocking (one alpha on the critical path) but
+    serialize at its injection port."""
+    return topo.alpha_us + (w - 1) * topo.wire_us(nbytes)
+
+
+def _bcast_tree(topo: Topology, w: int, nbytes: float) -> float:
+    """ceil(log2 W) dependent rounds, full payload each."""
+    rounds = max(1, math.ceil(math.log2(max(w, 2))))
+    return rounds * (topo.alpha_us + topo.wire_us(nbytes))
+
+
+def _allreduce_fused(topo: Topology, w: int, nbytes: float) -> float:
+    """2(W-1) blocking fused-recv-reduce/relay steps of n/W bytes each."""
+    return 2 * (w - 1) * (topo.alpha_us + topo.wire_us(nbytes / w))
+
+
+def _allreduce_nonfused(topo: Topology, w: int, nbytes: float) -> float:
+    """Daisy-chain reduce to rank 0 + round-robin bcast of the result."""
+    return _ring_chain(topo, w, nbytes) + _bcast_rr(topo, w, nbytes)
+
+
+_A = CollectiveAlgorithm
+_MODELS = {
+    ("bcast", _A.ROUND_ROBIN): _bcast_rr,
+    ("bcast", _A.TREE): _bcast_tree,
+    ("scatter", _A.ROUND_ROBIN): _bcast_rr,   # strided rr sends from root
+    ("gather", _A.RING): _ring_chain,
+    ("gather", _A.ROUND_ROBIN): _direct_fanin,
+    ("reduce", _A.RING): _ring_chain,
+    ("reduce", _A.ROUND_ROBIN): _direct_fanin,
+    ("allgather", _A.RING): _ring_chain,
+    ("allgather", _A.ROUND_ROBIN): _direct_fanin,
+    # RING and FUSED_RING share one expansion (expand_allreduce_ring);
+    # the epsilon nudge makes AUTO surface the canonical FUSED_RING name
+    ("allreduce", _A.RING): lambda t, w, n: 1.0001 * _allreduce_fused(
+        t, w, n),
+    ("allreduce", _A.FUSED_RING): _allreduce_fused,
+    ("allreduce", _A.NON_FUSED): _allreduce_nonfused,
+    ("reduce_scatter", _A.RING): _ring_chain,
+}
+
+
+def predict_us(op: str, algorithm: CollectiveAlgorithm, topo: Topology,
+               nbytes: int, world_size: int | None = None) -> float:
+    """Predicted call time in microseconds for one (op, algorithm) pair."""
+    w = world_size if world_size is not None else topo.world_size
+    if w <= 1:
+        return 0.0
+    model = _MODELS.get((op, _A(algorithm)))
+    if model is None:
+        raise KeyError(f"no cost model for ({op}, "
+                       f"{_A(algorithm).name})")
+    return model(topo, w, float(nbytes))
+
+
+def rank_algorithms(op: str, topo: Topology, nbytes: int,
+                    world_size: int | None = None
+                    ) -> list[tuple[CollectiveAlgorithm, float]]:
+    """Every legal algorithm of ``op`` with its predicted cost, cheapest
+    first. Ties break toward the lower enum value (deterministic across
+    runs and ranks — every rank of a collective must pick the same
+    algorithm from the same inputs)."""
+    valid = VALID_ALGORITHMS.get(op)
+    if not valid:
+        return []
+    scored = [(a, predict_us(op, a, topo, nbytes, world_size))
+              for a in sorted(valid)]
+    scored.sort(key=lambda p: (p[1], int(p[0])))
+    return scored
+
+
+def recommend_segment_size(topo: Topology, preferred: int,
+                           overhead_fraction: float = 0.1,
+                           floor: int = 4096) -> int:
+    """Smallest power-of-two segment whose per-segment ``alpha`` overhead
+    is at most ``overhead_fraction`` of its wire time, clamped to
+    ``[floor, preferred]``.
+
+    ``preferred`` is the backend's ``preferred_segment_size()`` — the
+    largest segment it can accept (rx-buffer bound on the emulator tiers).
+    High-alpha fabrics want segments as large as allowed; low-alpha/high-
+    beta fabrics can afford smaller segments (better pipelining overlap,
+    reference dma_mover segmentation) without drowning in per-segment cost.
+    """
+    if preferred <= floor:
+        return preferred
+    target = topo.alpha_us / overhead_fraction * topo.beta_gbps * 1e3
+    seg = 1 << max(1, math.ceil(math.log2(max(target, 1.0))))
+    return max(floor, min(seg, preferred))

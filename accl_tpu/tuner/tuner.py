@@ -1,0 +1,279 @@
+"""The Tuner: cost-model + measurement-driven AUTO resolution.
+
+NCCL-tuner-shaped selection for the ACCL call path: ``AUTO`` resolves per
+``(op, world_size, nbytes-bucket)`` key from the alpha-beta cost model
+(cost.py) seeded with the device's :class:`~accl_tpu.tuner.cost.Topology`,
+and is refined online from retire-time measurements — the driver feeds
+every tuned call's issue->retire duration back via :meth:`observe` (the
+same done-callback mechanism :class:`~accl_tpu.tracing.Profiler` records
+through), and :meth:`ingest_records` bulk-loads a Profiler's
+``CallRecord`` history.
+
+Selection policy per key:
+
+1. a pinned entry (loaded tuning table, cache.py) wins outright;
+2. a cached decision from an earlier ``select`` on the same key;
+3. otherwise a fresh decision is computed (under the lock) and cached:
+   with probability ``epsilon`` a uniformly random legal algorithm
+   (exploration — its measurements then land against it), else the
+   argmin over per-algorithm scores — the EWMA of measured durations
+   when an algorithm has ``min_samples`` observations, the cost-model
+   prediction when it does not. Mixing the two scales works because
+   both are microseconds of the same call.
+
+Decisions are STICKY until :meth:`refresh` drops them: every rank of a
+collective must expand the same algorithm or the move programs mismatch
+(a ring member rendezvousing with a direct sender hangs in recv), so a
+decision may not flip while calls are in flight just because a new
+measurement landed between two ranks' selects. Share ONE tuner across
+the ranks of an in-process world (``testing.emu_world(tuner=...)`` does)
+and call :meth:`refresh` at quiesced points — after a profiled phase, an
+epoch boundary — to fold the accumulated measurements (and re-roll
+exploration) for subsequent phases.
+
+Thread safety: one lock guards all mutable state; ``select`` and
+``observe`` are called concurrently from every rank's worker/callback
+threads of an in-process world.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+
+from ..constants import (CollectiveAlgorithm, DEFAULT_ALGORITHMS,
+                         VALID_ALGORITHMS)
+from .cost import Topology, predict_us, rank_algorithms, \
+    recommend_segment_size
+
+__all__ = ["Tuner", "nbytes_bucket"]
+
+
+def nbytes_bucket(nbytes: int) -> int:
+    """Power-of-two bucket index: all sizes in ``(2^(k-1), 2^k]`` share
+    bucket ``k`` (0 for empty calls). Coarse enough that one measurement
+    generalizes, fine enough to separate latency- from bandwidth-bound."""
+    return max(0, int(nbytes) - 1).bit_length()
+
+
+class _Stat:
+    """EWMA + count of one (key, algorithm)'s measured durations."""
+
+    __slots__ = ("ewma_us", "n")
+
+    def __init__(self):
+        self.ewma_us = 0.0
+        self.n = 0
+
+    def update(self, us: float, weight: float):
+        self.n += 1
+        if self.n == 1:
+            self.ewma_us = us
+        else:
+            self.ewma_us += weight * (us - self.ewma_us)
+
+
+class Tuner:
+    """Thread-safe per-(op, world, size-bucket) algorithm selector.
+
+    Args:
+        topology: link descriptor for the cost model; when ``None`` the
+            first :class:`~accl_tpu.accl.ACCL` this tuner is attached to
+            binds its device's ``topology()``.
+        epsilon: exploration probability (0 disables exploration; keep 0
+            for deterministic multi-rank programs unless every rank shares
+            ONE tuner instance — diverging per-rank choices would hang a
+            rendezvous-matched tier).
+        min_samples: measurements an algorithm needs before its EWMA
+            replaces the cost-model prediction in scoring.
+        ewma_weight: weight of the newest sample in the running average.
+        seed: exploration RNG seed (deterministic tests).
+    """
+
+    def __init__(self, topology: Topology | None = None,
+                 epsilon: float = 0.0, min_samples: int = 2,
+                 ewma_weight: float = 0.25, seed: int = 0):
+        self.topology = topology
+        self.epsilon = float(epsilon)
+        self.min_samples = int(min_samples)
+        self.ewma_weight = float(ewma_weight)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        # (op, world, bucket) -> {algorithm: _Stat}
+        self._measured: dict[tuple, dict[CollectiveAlgorithm, _Stat]] = {}
+        # (op, world, bucket) -> algorithm, from a loaded tuning table
+        self._pinned: dict[tuple, CollectiveAlgorithm] = {}
+        # (op, world, bucket) -> algorithm: sticky decisions, valid until
+        # refresh() (see module docstring: rank agreement)
+        self._decisions: dict[tuple, CollectiveAlgorithm] = {}
+
+    # -- selection ---------------------------------------------------------
+    def _topo(self, world_size: int) -> Topology:
+        base = self.topology or Topology()
+        if base.world_size != world_size:
+            base = dataclasses.replace(base, world_size=world_size)
+        return base
+
+    def select(self, op: str, world_size: int,
+               nbytes: int) -> CollectiveAlgorithm:
+        """Resolve AUTO for one call. Returns AUTO itself for ops without
+        an algorithm axis (send, recv, copy, ...) and for 1-rank worlds —
+        the caller's static default applies."""
+        valid = VALID_ALGORITHMS.get(op)
+        if not valid or world_size <= 1:
+            return CollectiveAlgorithm.AUTO
+        key = (op, int(world_size), nbytes_bucket(nbytes))
+        with self._lock:
+            pinned = self._pinned.get(key)
+            if pinned is not None:
+                return pinned
+            decided = self._decisions.get(key)
+            if decided is None:
+                decided = self._decide(key, op, world_size, nbytes, valid)
+                self._decisions[key] = decided
+            return decided
+
+    def _decide(self, key: tuple, op: str, world_size: int, nbytes: int,
+                valid) -> CollectiveAlgorithm:
+        """Compute one key's decision (lock held)."""
+        if self.epsilon > 0 and self._rng.random() < self.epsilon:
+            return self._rng.choice(sorted(valid))
+        stats = self._measured.get(key, {})
+        topo = self._topo(world_size)
+        best, best_score = None, None
+        for alg, predicted in rank_algorithms(op, topo, nbytes,
+                                              world_size):
+            st = stats.get(alg)
+            score = (st.ewma_us if st is not None
+                     and st.n >= self.min_samples else predicted)
+            if best_score is None or score < best_score:
+                best, best_score = alg, score
+        if best is None:  # no cost model either: static default
+            best = DEFAULT_ALGORITHMS.get(op, CollectiveAlgorithm.AUTO)
+        return best
+
+    def refresh(self):
+        """Drop cached decisions: the next ``select`` per key re-scores
+        with the measurements accumulated so far (and re-rolls
+        exploration). Call only at quiesced points — no collective may be
+        in flight while decisions flip (module docstring)."""
+        with self._lock:
+            self._decisions.clear()
+
+    # -- online refinement -------------------------------------------------
+    def observe(self, op: str, world_size: int, nbytes: int,
+                algorithm: CollectiveAlgorithm, duration_s: float,
+                error_word: int = 0) -> bool:
+        """Feed one retired call's measured duration. Failed calls and
+        AUTO-labeled records (nothing concrete to credit) are ignored.
+        Returns True iff the measurement was credited."""
+        alg = CollectiveAlgorithm(algorithm)
+        if (error_word or alg == CollectiveAlgorithm.AUTO
+                or op not in VALID_ALGORITHMS or world_size <= 1):
+            return False
+        key = (op, int(world_size), nbytes_bucket(nbytes))
+        with self._lock:
+            stats = self._measured.setdefault(key, {})
+            stats.setdefault(alg, _Stat()).update(duration_s * 1e6,
+                                                  self.ewma_weight)
+        return True
+
+    def ingest_records(self, records, world_size: int,
+                       world_by_comm: dict[int, int] | None = None) -> int:
+        """Bulk-load :class:`~accl_tpu.tracing.CallRecord` history (records
+        carry the concrete algorithm the call ran; "AUTO"/"" labels are
+        skipped). Returns how many records were usable.
+
+        Records only carry ``comm_id``, not the communicator's size —
+        pass ``world_by_comm`` (comm_id -> size, e.g. built from
+        ``ACCL.communicators``) when the history includes split-
+        communicator collectives, or their durations would be mis-keyed
+        under the world size. Unknown comm_ids fall back to
+        ``world_size``."""
+        world_by_comm = world_by_comm or {}
+        n = 0
+        for r in records:
+            alg_name = getattr(r, "algorithm", "")
+            try:
+                alg = CollectiveAlgorithm[alg_name]
+            except KeyError:
+                continue
+            if alg == CollectiveAlgorithm.AUTO:
+                continue  # backend-internal choice: nothing to credit
+            w = world_by_comm.get(getattr(r, "comm_id", 0), world_size)
+            if self.observe(r.op, w, r.nbytes, alg, r.duration_s,
+                            getattr(r, "error_word", 0)):
+                n += 1
+        return n
+
+    # -- segment sizing ----------------------------------------------------
+    def recommend_segment_size(self, preferred: int) -> int:
+        """Segment size for this tuner's topology, bounded by the
+        backend's ``preferred_segment_size()`` (passed as ``preferred``)."""
+        return recommend_segment_size(self.topology or Topology(),
+                                      preferred)
+
+    # -- table import/export (cache.py serializes these) -------------------
+    def pin(self, op: str, world_size: int, bucket: int,
+            algorithm: CollectiveAlgorithm):
+        """Force one key's selection (loaded tuning-table entry). The
+        (op, algorithm) pair must be legal — a pin that check_algorithm
+        would reject later must fail HERE, at load time, not on every
+        call of the op."""
+        alg = CollectiveAlgorithm(algorithm)
+        if alg not in VALID_ALGORITHMS.get(op, frozenset()):
+            raise ValueError(
+                f"cannot pin {alg.name} for {op}: not a legal algorithm")
+        with self._lock:
+            self._pinned[(op, int(world_size), int(bucket))] = alg
+
+    def clear_pins(self):
+        """Drop loaded tuning-table pins (a re-tune must measure from
+        scratch, not echo the stale table back)."""
+        with self._lock:
+            self._pinned.clear()
+
+    def entries(self) -> list[dict]:
+        """Current decisions as serializable rows: one per key that has a
+        pin or at least one measured algorithm, ``expected_us`` being the
+        winning score (pinned entries re-export with their measured EWMA
+        when one exists, else 0)."""
+        with self._lock:
+            keys = sorted(set(self._pinned) | set(self._measured))
+            out = []
+            for key in keys:
+                op, world, bucket = key
+                stats = self._measured.get(key, {})
+                pinned = self._pinned.get(key)
+                if pinned is not None:
+                    st = stats.get(pinned)
+                    choice, score = pinned, (st.ewma_us if st else 0.0)
+                    samples = st.n if st else 0
+                else:
+                    choice, score, samples = None, None, 0
+                    for alg in sorted(stats):
+                        st = stats[alg]
+                        if st.n >= self.min_samples and (
+                                score is None or st.ewma_us < score):
+                            choice, score, samples = alg, st.ewma_us, st.n
+                    if choice is None:
+                        continue  # nothing trustworthy to persist
+                out.append({"op": op, "world": world, "bucket": bucket,
+                            "algorithm": choice.name,
+                            "expected_us": round(float(score), 3),
+                            "samples": samples})
+            return out
+
+    def clear_measurements(self):
+        with self._lock:
+            self._measured.clear()
+
+    def describe(self) -> str:
+        rows = [f"{'op':<16}{'W':>4}{'bucket':>8}{'algorithm':>14}"
+                f"{'us':>12}{'n':>5}"]
+        for e in self.entries():
+            rows.append(f"{e['op']:<16}{e['world']:>4}{e['bucket']:>8}"
+                        f"{e['algorithm']:>14}{e['expected_us']:>12.1f}"
+                        f"{e['samples']:>5}")
+        return "\n".join(rows)

@@ -3,6 +3,7 @@
     python -m benchmarks --config 2 --out bench_out/
     python -m benchmarks --sweep allreduce --algorithm ring
     python -m benchmarks --elaborate bench_out/
+    python -m benchmarks --tune --tuning-cache bench_out/tuning.json
 """
 
 import argparse
@@ -37,6 +38,15 @@ def main():
                          "aggregates by CSV columns (collective/algorithm/"
                          "...), so variants must differ in those columns "
                          "to stay separate cells")
+    ap.add_argument("--tune", action="store_true",
+                    help="measure every (collective, algorithm) across a "
+                         "size ladder on the emulator tier and persist a "
+                         "tuning table (accl_tpu/tuner cache JSON)")
+    ap.add_argument("--tune-world", type=int, default=4,
+                    help="emulator world size for --tune")
+    ap.add_argument("--tuning-cache", type=str, default=None,
+                    help="tuning-table path for --tune (default "
+                         "$ACCL_TPU_TUNING_CACHE, else OUT/tuning.json)")
     ap.add_argument("--sweep", type=str,
                     help="ad-hoc sweep of one collective")
     ap.add_argument("--algorithm", type=str, default="xla",
@@ -73,6 +83,23 @@ def main():
 
     sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes
              else None)
+
+    if args.tune:
+        if args.algorithm != "xla" or args.wire_dtype or args.config:
+            ap.error("--tune measures every legal algorithm itself; "
+                     "--algorithm/--wire-dtype/--config do not apply")
+        from accl_tpu.tuner import cache as tcache
+        from .tune import format_rows, run_tune, write_rows
+        cache_path = (args.tuning_cache or tcache.default_cache_path()
+                      or os.path.join(args.out, "tuning.json"))
+        out = run_tune(world=args.tune_world, sizes=sizes,
+                       cache_path=cache_path)
+        rows_path = write_rows(out["rows"], args.out)
+        print(format_rows(out["rows"]))
+        print(out["tuner"].describe())
+        print(f"wrote {rows_path}")
+        print(f"wrote tuning table {out['cache_path']}")
+        return
 
     if args.backend and args.config != 1:
         ap.error("--backend only applies to config 1 (the CPU-tier "
@@ -155,6 +182,10 @@ def main():
         name = name.replace(".csv", f"_{args.tag}.csv")
     path = os.path.join(args.out, name)
     result.to_csv(path)
+    if args.sweep:
+        # self-describing JSON twin: each row carries algorithm +
+        # algorithm_source for tuned-vs-default comparisons
+        result.to_json(path.replace(".csv", ".json"))
     print(result.table())
     print(f"wrote {path}")
 

@@ -12,8 +12,8 @@ from collections import defaultdict
 
 import numpy as np
 
-RESULT_FIELDS = ["collective", "algorithm", "world", "dtype", "wire_dtype",
-                 "nbytes", "tier", "runs",
+RESULT_FIELDS = ["collective", "algorithm", "algorithm_source", "world",
+                 "dtype", "wire_dtype", "nbytes", "tier", "runs",
                  "avg_bus_gbps", "std_bus_gbps", "units",
                  "avg_us_per_op", "std_us_per_op"]
 
@@ -23,7 +23,9 @@ def elaborate(in_dir: str, out_csv: str | None = None) -> list[dict]:
 
     Rows are keyed on their ``units`` column too (older CSVs without one
     default to GB/s), so model-throughput rows (tokens/s, the llama
-    sweeps) never average into bandwidth cells."""
+    sweeps) never average into bandwidth cells — and on
+    ``algorithm_source`` (older CSVs default to "forced"), so
+    tuner-chosen rows never average into forced-algorithm cells."""
     cells = defaultdict(lambda: {"bus": [], "us": []})
     for name in sorted(os.listdir(in_dir)):
         if not name.endswith(".csv") or name == "res.csv":
@@ -32,17 +34,19 @@ def elaborate(in_dir: str, out_csv: str | None = None) -> list[dict]:
             for row in csv.DictReader(f):
                 key = (row["collective"], row["algorithm"], row["world"],
                        row["dtype"], row["wire_dtype"], int(row["nbytes"]),
-                       row["tier"], row.get("units") or "GB/s")
+                       row["tier"], row.get("units") or "GB/s",
+                       row.get("algorithm_source") or "forced")
                 cells[key]["bus"].append(float(row["bus_gbps"]))
                 cells[key]["us"].append(
                     float(row["seconds_per_op"]) * 1e6)
 
     results = []
     for key in sorted(cells, key=lambda k: (k[0], k[1], k[5])):
-        coll, algo, world, dtype, wire, nbytes, tier, units = key
+        coll, algo, world, dtype, wire, nbytes, tier, units, src = key
         bus, us = cells[key]["bus"], cells[key]["us"]
         results.append({
-            "collective": coll, "algorithm": algo, "world": world,
+            "collective": coll, "algorithm": algo, "algorithm_source": src,
+            "world": world,
             "dtype": dtype, "wire_dtype": wire, "nbytes": nbytes,
             "tier": tier, "runs": len(bus), "units": units,
             "avg_bus_gbps": round(float(np.mean(bus)), 4),

@@ -38,12 +38,16 @@ from .timing import slope_time
 
 CSV_FIELDS = ["collective", "algorithm", "world", "dtype", "wire_dtype",
               "nbytes", "seconds_per_op", "bus_gbps", "units", "tier",
-              "tflops", "mfu"]
+              "tflops", "mfu", "algorithm_source"]
 # tflops/mfu are filled by the compute-bound sweeps (attention): achieved
 # TFLOP/s and its fraction of the chip's bf16 peak; blank elsewhere
 # "units" qualifies the bus_gbps column: "GB/s" (the default) for
 # bandwidth rows, "tokens/s" for model-throughput rows (llama sweeps) —
 # aggregators must not average across different units
+# "algorithm_source" records HOW the algorithm column was decided:
+# "forced" (caller pinned it — the default for every explicit sweep) vs
+# "chosen" (a tuner resolved AUTO) — so tuned-vs-default comparisons
+# stay reproducible from the results file alone
 
 
 def bus_factor(op: str, W: int) -> float:
@@ -63,7 +67,20 @@ class SweepResult:
         with open(path, "w", newline="") as f:
             w = csv.DictWriter(f, fieldnames=CSV_FIELDS)
             w.writeheader()
-            w.writerows([{"units": "GB/s", **r} for r in self.rows])
+            w.writerows([{"units": "GB/s", "algorithm_source": "forced",
+                          **r} for r in self.rows])
+
+    def to_json(self, path: str):
+        """Same rows as machine-readable JSON (tuned-vs-default
+        comparison records: every row carries algorithm +
+        algorithm_source)."""
+        import json
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"rows": [{"units": "GB/s",
+                                 "algorithm_source": "forced", **r}
+                                for r in self.rows]}, f, indent=1)
+            f.write("\n")
 
     def table(self) -> str:
         lines = ["{:<16} {:>6} {:>12} {:>14} {:>12} {:>9}".format(
@@ -176,11 +193,16 @@ def sweep_collective(mesh: Mesh, op: str, sizes: Sequence[int],
                      axis_name: str | None = None,
                      func: ReduceFunc = ReduceFunc.SUM,
                      root: int = 0, tier: str = "mesh",
-                     reps: int = 5) -> SweepResult:
+                     reps: int = 5,
+                     algorithm_source: str = "forced") -> SweepResult:
     """Sweep ``op`` over total payload ``sizes`` (bytes) on ``mesh``.
 
     For 2D meshes (tree algorithms) the collective runs over both axes;
     ``axis_name`` defaults to the sole axis (1D) or is ignored (tree).
+    ``algorithm_source`` labels each result row with how ``algorithm``
+    was decided — "forced" (explicit, the default) vs "chosen" (a tuner
+    picked it) — so result files stay self-describing for
+    tuned-vs-default comparisons.
     """
     axis_names = tuple(mesh.axis_names)
     axes2d = axis_names if len(axis_names) == 2 else None
@@ -222,5 +244,6 @@ def sweep_collective(mesh: Mesh, op: str, sizes: Sequence[int],
             "wire_dtype": jnp.dtype(wire).name if wire else "",
             "nbytes": count * itemsize,
             "seconds_per_op": t, "bus_gbps": round(gbps, 4), "tier": tier,
+            "algorithm_source": algorithm_source,
         })
     return SweepResult(rows)

@@ -1,0 +1,161 @@
+"""``--tune``: measurement sweep producing a persistent tuning table.
+
+Drives the real ACCL call path on the in-process emulator tier, forcing
+every legal algorithm of every tunable collective across a size ladder,
+feeds the measured durations into a :class:`~accl_tpu.tuner.Tuner`, and
+persists the resulting table (tuner/cache.py JSON). A production run then
+points ``ACCL_TPU_TUNING_CACHE`` at the table and every ``AUTO`` call
+resolves from measurements instead of the analytic model.
+
+Results also land as JSON rows recording, for each measured point, which
+algorithm ran and whether it was ``forced`` (the sweep pinning it) or
+``chosen`` (what the refreshed tuner selects for that key) — the
+reproducibility record for tuned-vs-default comparisons.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from accl_tpu.constants import CollectiveAlgorithm, VALID_ALGORITHMS
+from accl_tpu.testing import emu_world, run_ranks
+from accl_tpu.tuner import Tuner, cache, nbytes_bucket
+
+# counts are the call's ``count`` argument; nbytes keys follow the driver
+# convention count * elem_bytes (chunk bytes for chunked ops)
+DEFAULT_SIZES = [1 << 8, 1 << 12, 1 << 16, 1 << 20]
+DEFAULT_OPS = ["allreduce", "allgather", "reduce_scatter", "gather",
+               "reduce", "bcast"]
+_ELEM = 4  # float32 sweeps
+
+
+def _rank_body(op: str, count: int, W: int, alg, reps: int):
+    """Per-rank closure: allocate per-op buffers, warm up, time ``reps``
+    synchronous calls, return every per-call duration (one independent
+    measurement per rep — the tuner is fed each, so the table's
+    ``samples`` field reflects real evidence)."""
+
+    def body(a):
+        f32 = np.float32
+        if op == "allreduce" or op == "reduce":
+            src = a.buffer(data=np.ones(count, f32))
+            dst = a.buffer((count,), f32)
+            call = {"allreduce": lambda: a.allreduce(src, dst, count,
+                                                     algorithm=alg),
+                    "reduce": lambda: a.reduce(src, dst, count,
+                                               algorithm=alg)}[op]
+        elif op == "bcast":
+            buf = a.buffer(data=np.ones(count, f32))
+            call = lambda: a.bcast(buf, count, algorithm=alg)
+        elif op == "allgather":
+            src = a.buffer(data=np.ones(count, f32))
+            dst = a.buffer((W * count,), f32)
+            call = lambda: a.allgather(src, dst, count, algorithm=alg)
+        elif op == "gather":
+            src = a.buffer(data=np.ones(count, f32))
+            dst = a.buffer((W * count,), f32)
+            call = lambda: a.gather(src, dst, count, algorithm=alg)
+        elif op == "reduce_scatter":
+            src = a.buffer(data=np.ones(W * count, f32))
+            dst = a.buffer((count,), f32)
+            call = lambda: a.reduce_scatter(src, dst, count,
+                                            algorithm=alg)
+        else:
+            raise ValueError(op)
+        call()  # warmup
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            call()
+            ts.append(time.perf_counter() - t0)
+        return ts
+
+    return body
+
+
+def run_tune(world: int = 4, sizes=None, ops=None, reps: int = 3,
+             cache_path: str | None = None,
+             nbufs: int = 16, bufsize: int = 1 << 20) -> dict:
+    """The ``--tune`` sweep. Returns ``{"tuner", "rows", "cache_path"}``;
+    ``rows`` is the forced/chosen JSON record."""
+    sizes = [int(s) for s in (sizes or DEFAULT_SIZES)]
+    ops = list(ops or DEFAULT_OPS)
+    # the tuner stays DETACHED from the measurement world: every sweep
+    # call forces its algorithm, so attaching would only add live
+    # observations (cold warmups, per-rank host timings) that drown the
+    # steady-state max-over-ranks-of-min figure this sweep computes —
+    # and driver bring-up would reload the very $ACCL_TPU_TUNING_CACHE
+    # table being regenerated
+    # sweep-sourced entries are trusted from however many reps ran (a
+    # 1-rep sweep still beats falling back to the analytic model)
+    tuner = Tuner()
+    tuner.min_samples = min(tuner.min_samples, reps)
+    accls = emu_world(world, nbufs=nbufs, bufsize=bufsize)
+    tuner.topology = accls[0].device.topology()  # persisted with the table
+    rows = []
+    try:
+        for op in ops:
+            algos = sorted(VALID_ALGORITHMS[op])
+            for nbytes in sizes:
+                count = max(1, nbytes // _ELEM)
+                for alg in algos:
+                    per_rank = run_ranks(
+                        accls, _rank_body(op, count, world, alg, reps))
+                    # the collective completes when its slowest rank
+                    # does: rep i's duration is the max over ranks; each
+                    # rep is one independent measurement fed to the tuner
+                    durs = [max(ts[i] for ts in per_rank)
+                            for i in range(reps)]
+                    for d in durs:
+                        tuner.observe(op, world, count * _ELEM, alg, d)
+                    rows.append({
+                        "op": op, "world": world, "count": count,
+                        "nbytes": count * _ELEM,
+                        "bucket": nbytes_bucket(count * _ELEM),
+                        "algorithm": alg.name, "source": "forced",
+                        "seconds_per_op": min(durs)})
+        # fold measurements, then record what AUTO now resolves to
+        tuner.refresh()
+        for op in ops:
+            for nbytes in sizes:
+                count = max(1, nbytes // _ELEM)
+                chosen = tuner.select(op, world, count * _ELEM)
+                rows.append({
+                    "op": op, "world": world, "count": count,
+                    "nbytes": count * _ELEM,
+                    "bucket": nbytes_bucket(count * _ELEM),
+                    "algorithm": CollectiveAlgorithm(chosen).name,
+                    "source": "chosen", "seconds_per_op": None})
+    finally:
+        for a in accls:
+            a.deinit()
+    path = cache_path or cache.default_cache_path()
+    if path:
+        cache.save(tuner, path)
+    return {"tuner": tuner, "rows": rows, "cache_path": path}
+
+
+def write_rows(rows: list[dict], out_dir: str,
+               name: str = "tune.json") -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, name)
+    with open(path, "w") as f:
+        json.dump({"rows": rows}, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def format_rows(rows: list[dict]) -> str:
+    lines = ["{:<16} {:>4} {:>10} {:>14} {:>8} {:>12}".format(
+        "op", "W", "nbytes", "algorithm", "source", "us/op")]
+    for r in rows:
+        us = ("" if r["seconds_per_op"] is None
+              else f"{r['seconds_per_op'] * 1e6:.1f}")
+        lines.append("{:<16} {:>4} {:>10} {:>14} {:>8} {:>12}".format(
+            r["op"], r["world"], r["nbytes"], r["algorithm"],
+            r["source"], us))
+    return "\n".join(lines)

@@ -72,9 +72,11 @@ def _check_rows(res, expect_collectives, tier_suffix="-chip"):
     from benchmarks.sweep import CSV_FIELDS
     assert res.rows, "sweep produced no rows"
     for r in res.rows:
-        # "units" is optional on rows (to_csv defaults it to GB/s);
-        # tflops/mfu only appear on compute-bound (attention) rows
-        assert (set(CSV_FIELDS) - {"units", "tflops", "mfu"}
+        # "units"/"algorithm_source" are optional on rows (to_csv
+        # defaults them to GB/s / forced); tflops/mfu only appear on
+        # compute-bound (attention) rows
+        assert (set(CSV_FIELDS) - {"units", "tflops", "mfu",
+                                   "algorithm_source"}
                 <= set(r) <= set(CSV_FIELDS)), r
         assert r["seconds_per_op"] > 0
         assert r["tier"].endswith(tier_suffix)

@@ -93,11 +93,63 @@ def test_async_chain_attribution():
     assert all(run_ranks(emu_world(2), body))
 
 
+def test_profiler_csv_roundtrip():
+    """Records survive export/import byte-faithfully enough to re-feed
+    analysis (and a Tuner): every field including the algorithm label."""
+    p = Profiler()
+    p.record(CallRecord(op="allreduce", count=256, nbytes=1024, comm_id=2,
+                        t_start=1.5, duration_s=3.25e-4,
+                        algorithm="FUSED_RING"))
+    p.record(CallRecord(op="send", count=8, nbytes=32, comm_id=0,
+                        t_start=2.0, duration_s=1e-5, error_word=4))
+    path_ = "prof_rt.csv"
+
+    def roundtrip(tmp):
+        p.to_csv(tmp)
+        return Profiler.read_csv(tmp)
+
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        back = roundtrip(os.path.join(d, path_))
+    assert len(back) == 2
+    a, s = back
+    assert (a.op, a.count, a.nbytes, a.comm_id) == ("allreduce", 256,
+                                                    1024, 2)
+    assert a.algorithm == "FUSED_RING"
+    assert a.duration_s == pytest.approx(3.25e-4, rel=1e-6)
+    assert s.error_word == 4 and s.algorithm == ""
+    # re-imported records aggregate identically
+    p2 = Profiler()
+    for r in back:
+        p2.record(r)
+    assert p2.summary()["allreduce"].total_bytes == 1024
+
+
+def test_percentile_math_known_inputs():
+    """p50/p95 on known inputs (nearest-rank on the sorted sample)."""
+    vals = sorted(float(v) for v in range(1, 101))  # 1..100
+    assert tracing._percentile(vals, 0.50) == 51.0  # idx round(49.5)=50
+    assert tracing._percentile(vals, 0.95) == 95.0  # idx round(94.05)=94
+    assert tracing._percentile(vals, 0.0) == 1.0
+    assert tracing._percentile(vals, 1.0) == 100.0
+    assert tracing._percentile([], 0.5) == 0.0
+    p = Profiler()
+    for v in vals:
+        p.record(CallRecord(op="nop", count=0, nbytes=0, comm_id=0,
+                            t_start=0.0, duration_s=v * 1e-6))
+    s = p.summary()["nop"]
+    assert s.p50_us == pytest.approx(51.0)
+    assert s.p95_us == pytest.approx(95.0)
+    assert s.mean_us == pytest.approx(50.5)
+
+
 def test_nop_latency_probe():
     accls = emu_world(1)
     stats = tracing.measure_call_latency(accls[0], n=20)
     assert stats["p50_us"] > 0
     assert stats["min_us"] <= stats["p50_us"] <= stats["p95_us"]
+    assert stats["n"] == 20.0
+    assert stats["mean_us"] >= stats["min_us"]
 
 
 def test_annotate_and_trace_smoke(tmp_path):

@@ -1,0 +1,577 @@
+"""Tests for the autotuner subsystem (accl_tpu/tuner/).
+
+Covers the acceptance surface: cost-model ordering (latency- vs
+bandwidth-bound crossovers), AUTO resolution end-to-end on the emulator
+tier, online refinement from measurements, epsilon-greedy exploration,
+tuning-table persistence (versioned JSON + env override), thread safety,
+segment-size recommendation, and the shared DEFAULT_ALGORITHMS fallback.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from accl_tpu.constants import (CollectiveAlgorithm as A,
+                                DEFAULT_ALGORITHMS, VALID_ALGORITHMS,
+                                check_algorithm)
+from accl_tpu.testing import emu_world, run_ranks
+from accl_tpu.tuner import (Topology, Tuner, cache, nbytes_bucket,
+                            predict_us, rank_algorithms,
+                            recommend_segment_size)
+
+EMU_TOPO = Topology(world_size=4, alpha_us=20.0, beta_gbps=4.0, tier="emu")
+
+
+# -- cost model --------------------------------------------------------------
+
+def test_cost_model_allreduce_small_vs_large():
+    """Latency-bound small messages favor the few-hop non-fused variant;
+    bandwidth-bound large ones the fused ring (n/W per hop)."""
+    small = rank_algorithms("allreduce", EMU_TOPO, 64)
+    large = rank_algorithms("allreduce", EMU_TOPO, 8 << 20)
+    assert small[0][0] == A.NON_FUSED
+    assert large[0][0] == A.FUSED_RING
+    assert large[-1][0] == A.NON_FUSED
+
+
+def test_cost_model_gather_crossover():
+    small = rank_algorithms("gather", EMU_TOPO, 64)
+    large = rank_algorithms("gather", EMU_TOPO, 8 << 20)
+    assert small[0][0] == A.ROUND_ROBIN   # one alpha beats W-1 alphas
+    assert large[0][0] == A.RING          # incast makes direct lose
+
+
+def test_cost_model_monotone_in_size_and_only_legal_algorithms():
+    for op, valid in VALID_ALGORITHMS.items():
+        ranked = rank_algorithms(op, EMU_TOPO, 4096)
+        assert {a for a, _ in ranked} == set(valid)
+        for alg in valid:
+            lo = predict_us(op, alg, EMU_TOPO, 1 << 10)
+            hi = predict_us(op, alg, EMU_TOPO, 1 << 24)
+            assert hi > lo > 0, (op, alg)
+
+
+def test_cost_model_trivial_world():
+    assert predict_us("allreduce", A.FUSED_RING,
+                      Topology(world_size=1), 4096) == 0.0
+    assert rank_algorithms("send", EMU_TOPO, 4096) == []
+
+
+def test_segment_size_recommendation():
+    # high-alpha fabric: take the largest allowed segment
+    assert recommend_segment_size(
+        Topology(alpha_us=500.0, beta_gbps=1.0), 1 << 20) == 1 << 20
+    # low-alpha fabric: smaller segments are affordable
+    low = recommend_segment_size(
+        Topology(alpha_us=0.5, beta_gbps=1.0), 1 << 20)
+    assert 4096 <= low < (1 << 20)
+    # power of two, clamped below by the floor and above by preferred
+    assert low & (low - 1) == 0
+    assert recommend_segment_size(Topology(), 2048) == 2048
+
+
+# -- Tuner selection / refinement --------------------------------------------
+
+def test_select_small_vs_large_from_model():
+    t = Tuner(topology=EMU_TOPO)
+    assert t.select("allreduce", 4, 64) == A.NON_FUSED
+    assert t.select("allreduce", 4, 8 << 20) == A.FUSED_RING
+    # no algorithm axis / single rank: AUTO passes through
+    assert t.select("send", 4, 64) == A.AUTO
+    assert t.select("allreduce", 1, 64) == A.AUTO
+
+
+def test_online_refinement_flips_selection_after_refresh():
+    t = Tuner(topology=EMU_TOPO, min_samples=2)
+    nbytes = 64
+    assert t.select("allreduce", 4, nbytes) == A.NON_FUSED
+    # measurements say the model's favorite is slow, fused ring fast
+    for _ in range(4):
+        t.observe("allreduce", 4, nbytes, A.NON_FUSED, 5e-3)
+        t.observe("allreduce", 4, nbytes, A.FUSED_RING, 1e-4)
+    # decisions are sticky until refresh (rank agreement: a measurement
+    # landing between two ranks' selects must not split the collective)
+    assert t.select("allreduce", 4, nbytes) == A.NON_FUSED
+    t.refresh()
+    assert t.select("allreduce", 4, nbytes) == A.FUSED_RING
+
+
+def test_observe_ignores_failures_and_auto():
+    t = Tuner(topology=EMU_TOPO, min_samples=1)
+    for _ in range(4):
+        t.observe("allreduce", 4, 64, A.FUSED_RING, 1e-6,
+                  error_word=1)            # failed call: not credited
+        t.observe("allreduce", 4, 64, A.AUTO, 1e-6)  # nothing concrete
+    t.refresh()
+    assert t.select("allreduce", 4, 64) == A.NON_FUSED  # still the model
+
+
+def test_min_samples_gate():
+    t = Tuner(topology=EMU_TOPO, min_samples=3)
+    t.observe("allreduce", 4, 64, A.FUSED_RING, 1e-7)
+    t.observe("allreduce", 4, 64, A.FUSED_RING, 1e-7)
+    t.refresh()
+    # 2 < min_samples: the EWMA is not trusted yet
+    assert t.select("allreduce", 4, 64) == A.NON_FUSED
+    t.observe("allreduce", 4, 64, A.FUSED_RING, 1e-7)
+    t.refresh()
+    assert t.select("allreduce", 4, 64) == A.FUSED_RING
+
+
+def test_epsilon_greedy_exploration_is_legal_and_reseedable():
+    picks = set()
+    for seed in range(16):
+        t = Tuner(topology=EMU_TOPO, epsilon=1.0, seed=seed)
+        alg = t.select("gather", 4, 4096)
+        assert alg in VALID_ALGORITHMS["gather"]
+        # sticky until refresh, even while exploring
+        assert t.select("gather", 4, 4096) == alg
+        picks.add(alg)
+    assert len(picks) > 1  # exploration actually varies across seeds
+
+
+def test_ingest_records_from_profiler_history():
+    from accl_tpu.tracing import CallRecord
+    t = Tuner(topology=EMU_TOPO, min_samples=2)
+    recs = [CallRecord(op="allreduce", count=16, nbytes=64, comm_id=0,
+                       t_start=0.0, duration_s=1e-5,
+                       algorithm="FUSED_RING")
+            for _ in range(3)]
+    recs.append(CallRecord(op="allreduce", count=16, nbytes=64, comm_id=0,
+                           t_start=0.0, duration_s=1e-5, algorithm=""))
+    assert t.ingest_records(recs, world_size=4) == 3
+    t.refresh()
+    assert t.select("allreduce", 4, 64) == A.FUSED_RING
+
+
+def test_thread_safety_concurrent_select_observe():
+    """Hammer one tuner from many threads; selects on one key must agree
+    within a decision epoch and nothing may race/crash."""
+    t = Tuner(topology=EMU_TOPO, min_samples=2)
+    seen = []
+    errors = []
+
+    def worker(i):
+        try:
+            for k in range(200):
+                alg = t.select("allreduce", 4, 64)
+                seen.append(alg)
+                t.observe("allreduce", 4, 64,
+                          A.FUSED_RING if k % 2 else A.NON_FUSED,
+                          1e-6 * (k + 1))
+                t.observe("gather", 4, 1 << (k % 20), A.RING, 1e-6)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    # no refresh ran: every select of the epoch returned one decision
+    assert len(set(seen)) == 1
+    assert t.entries()  # measurements landed
+
+
+# -- cache persistence -------------------------------------------------------
+
+def test_cache_roundtrip_changes_selection(tmp_path):
+    src = Tuner(topology=EMU_TOPO, min_samples=1)
+    # measurements inverting the model's large-message choice
+    big = 8 << 20
+    src.observe("allreduce", 4, big, A.NON_FUSED, 1e-4)
+    src.observe("allreduce", 4, big, A.FUSED_RING, 5e-1)
+    path = cache.save(src, str(tmp_path / "table.json"))
+
+    fresh = Tuner(topology=EMU_TOPO)
+    assert fresh.select("allreduce", 4, big) == A.FUSED_RING  # pure model
+    loaded = Tuner(topology=EMU_TOPO)
+    assert cache.load_into(loaded, path) >= 1
+    assert loaded.select("allreduce", 4, big) == A.NON_FUSED  # pinned
+
+    doc = json.load(open(path))
+    assert doc["version"] == cache.SCHEMA_VERSION
+    assert doc["topology"]["tier"] == "emu"
+
+
+def test_cache_topology_adoption(tmp_path):
+    src = Tuner(topology=EMU_TOPO, min_samples=1)
+    src.observe("gather", 4, 4096, A.RING, 1e-5)
+    path = cache.save(src, str(tmp_path / "t.json"))
+    t = Tuner()  # no topology of its own
+    cache.load_into(t, path)
+    assert t.topology is not None and t.topology.tier == "emu"
+
+
+def test_cache_version_mismatch(tmp_path):
+    path = tmp_path / "stale.json"
+    path.write_text(json.dumps({"version": 999, "entries": [
+        {"op": "allreduce", "world": 4, "bucket": 10,
+         "algorithm": "NON_FUSED"}]}))
+    t = Tuner(topology=EMU_TOPO)
+    assert cache.load_into(t, str(path)) == 0  # graceful skip
+    with pytest.raises(ValueError):
+        cache.load(str(path), strict=True)
+
+
+def test_cache_rejects_cross_tier_table(tmp_path):
+    """A table measured on one fabric tier must not pin decisions on
+    another (emu thread-handoff winners are meaningless on ICI)."""
+    src = Tuner(topology=EMU_TOPO, min_samples=1)
+    src.observe("allreduce", 4, 64, A.NON_FUSED, 1e-6)
+    path = cache.save(src, str(tmp_path / "emu.json"))
+    tpu_tuner = Tuner(topology=Topology(world_size=4, alpha_us=1.0,
+                                        beta_gbps=100.0, tier="tpu"))
+    assert cache.load_into(tpu_tuner, path) == 0
+    with pytest.raises(ValueError, match="tier"):
+        cache.load_into(tpu_tuner, path, strict=True)
+    # same tier still loads
+    emu_tuner = Tuner(topology=EMU_TOPO)
+    assert cache.load_into(emu_tuner, path) == 1
+
+
+def test_ingest_records_counts_only_credited(tmp_path):
+    from accl_tpu.tracing import CallRecord
+    t = Tuner(topology=EMU_TOPO)
+    recs = [CallRecord(op="allreduce", count=16, nbytes=64, comm_id=0,
+                       t_start=0.0, duration_s=1e-5, error_word=4,
+                       algorithm="FUSED_RING")]  # failed call
+    assert t.ingest_records(recs, 4) == 0
+
+
+def test_sweep_rows_json_and_elaborate_keep_sources_apart(tmp_path):
+    """algorithm_source survives the CSV/JSON writers and keeps chosen
+    rows out of forced cells in the aggregate (no mesh needed)."""
+    from benchmarks.elaborate import elaborate
+    from benchmarks.sweep import SweepResult
+    base = {"collective": "allreduce", "algorithm": "ring", "world": 4,
+            "dtype": "float32", "wire_dtype": "", "nbytes": 4096,
+            "seconds_per_op": 1e-4, "bus_gbps": 1.0, "tier": "mesh"}
+    res = SweepResult(rows=[
+        {**base, "algorithm_source": "forced"},
+        {**base, "algorithm_source": "chosen", "seconds_per_op": 2e-4}])
+    res.to_csv(str(tmp_path / "a.csv"))
+    res.to_json(str(tmp_path / "a.json"))
+    doc = json.load(open(tmp_path / "a.json"))
+    assert [r["algorithm_source"] for r in doc["rows"]] == ["forced",
+                                                            "chosen"]
+    agg = elaborate(str(tmp_path))
+    assert len(agg) == 2  # one cell per source, not averaged together
+    assert {r["algorithm_source"] for r in agg} == {"forced", "chosen"}
+
+
+def test_cache_env_override(tmp_path, monkeypatch):
+    src = Tuner(topology=EMU_TOPO, min_samples=1)
+    src.observe("allreduce", 4, 64, A.FUSED_RING, 1e-6)
+    env_path = str(tmp_path / "env_table.json")
+    monkeypatch.setenv(cache.ENV_VAR, env_path)
+    assert cache.default_cache_path() == env_path
+    cache.save(src)  # no explicit path: the env override
+    t = Tuner(topology=EMU_TOPO)
+    assert cache.load_into(t) >= 1
+    assert t.select("allreduce", 4, 64) == A.FUSED_RING
+    monkeypatch.delenv(cache.ENV_VAR)
+    with pytest.raises(ValueError):
+        cache.save(src)
+
+
+# -- driver integration (emulator tier) --------------------------------------
+
+def _tuned_world(world=4, **kw):
+    t = Tuner()
+    return t, emu_world(world, tuner=t, **kw)
+
+
+def test_auto_allreduce_size_dependent_end_to_end():
+    """With the tuner enabled on the emulator tier, AUTO allreduce runs
+    different algorithms for small vs large payloads — visible in the
+    profiler's per-call algorithm attribution — and both compute the
+    right answer."""
+    t, accls = _tuned_world(4)
+
+    def body(a):
+        small_s = a.buffer(data=np.ones(8, np.float32))
+        small_d = a.buffer((8,), np.float32)
+        big = 1 << 20  # 4 MiB: far past the emu-topology crossover
+        big_s = a.buffer(data=np.ones(big, np.float32))
+        big_d = a.buffer((big,), np.float32)
+        a.start_profiling()
+        a.allreduce(small_s, small_d, 8)
+        a.allreduce(big_s, big_d, big)
+        a.end_profiling()
+        assert float(small_d.data[0]) == 4.0
+        assert float(big_d.data[-1]) == 4.0
+        return [r.algorithm for r in a.profiler.records]
+
+    for algs in run_ranks(accls, body, timeout=120.0):
+        small_alg, big_alg = algs
+        assert small_alg == "NON_FUSED"
+        assert big_alg == "FUSED_RING"
+    # retire-time measurements flowed back into the tuner
+    assert any(e["op"] == "allreduce" for e in t.entries())
+
+
+def test_tuned_gather_and_bcast_correctness():
+    """Tuner-resolved algorithms stay numerically correct across the
+    rooted collectives (the small-message direct paths)."""
+    t, accls = _tuned_world(3)
+
+    def body(a):
+        src = a.buffer(data=np.full(4, a.rank + 1, np.float32))
+        dst = a.buffer((12,), np.float32) if a.rank == 1 else None
+        a.gather(src, dst, 4, root=1)
+        if a.rank == 1:
+            np.testing.assert_allclose(
+                dst.data.reshape(3, 4)[:, 0], [1, 2, 3])
+        b = a.buffer(data=(np.arange(8, dtype=np.float32)
+                           if a.rank == 0 else np.zeros(8, np.float32)))
+        a.bcast(b, 8, root=0)
+        np.testing.assert_allclose(b.data, np.arange(8))
+        return True
+
+    assert all(run_ranks(accls, body, timeout=60.0))
+
+
+def test_loaded_table_drives_emulator_selection(tmp_path):
+    """A tuning table round-trips through save/load and changes what the
+    live driver runs (pin NON_FUSED for a large bucket where the model
+    says FUSED_RING)."""
+    big = 1 << 16  # elements; * 4 bytes
+    pinner = Tuner(topology=EMU_TOPO, min_samples=1)
+    pinner.observe("allreduce", 2, big * 4, A.NON_FUSED, 1e-5)
+    pinner.observe("allreduce", 2, big * 4, A.FUSED_RING, 1e-1)
+    path = cache.save(pinner, str(tmp_path / "pins.json"))
+
+    t = Tuner()
+    assert cache.load_into(t, path) >= 1
+    accls = emu_world(2, tuner=t)
+
+    def body(a):
+        s = a.buffer(data=np.ones(big, np.float32))
+        d = a.buffer((big,), np.float32)
+        a.start_profiling()
+        a.allreduce(s, d, big)
+        a.end_profiling()
+        return a.profiler.records[0].algorithm
+
+    assert run_ranks(accls, body, timeout=60.0) == ["NON_FUSED"] * 2
+
+
+def test_tune_harness_produces_table(tmp_path):
+    """`benchmarks --tune` end to end (tiny ladder): forced measurements
+    for every legal algorithm, chosen rows, persisted versioned table
+    that a fresh tuner loads."""
+    from benchmarks.tune import run_tune
+    out = run_tune(world=2, sizes=[256], ops=["allreduce", "gather"],
+                   reps=1, cache_path=str(tmp_path / "tuning.json"))
+    forced = [r for r in out["rows"] if r["source"] == "forced"]
+    chosen = [r for r in out["rows"] if r["source"] == "chosen"]
+    assert {r["algorithm"] for r in forced
+            if r["op"] == "allreduce"} == {a.name for a in
+                                           VALID_ALGORITHMS["allreduce"]}
+    assert len(chosen) == 2
+    t = Tuner(topology=EMU_TOPO)
+    assert cache.load_into(t, out["cache_path"]) >= 2
+    assert t.select("allreduce", 2, 256) in VALID_ALGORITHMS["allreduce"]
+
+
+def test_pin_rejects_illegal_pair_and_load_skips_it(tmp_path):
+    t = Tuner(topology=EMU_TOPO)
+    with pytest.raises(ValueError, match="not a legal algorithm"):
+        t.pin("allreduce", 4, 10, A.TREE)
+    # a corrupted table entry (legal enum name, illegal for the op) is
+    # skipped on load instead of poisoning every later call of the op
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps({"version": cache.SCHEMA_VERSION,
+                                "entries": [
+        {"op": "allreduce", "world": 4, "bucket": 10,
+         "algorithm": "TREE", "expected_us": 1.0, "samples": 3},
+        {"op": "gather", "world": 4, "bucket": 10,
+         "algorithm": "RING", "expected_us": 1.0, "samples": 3}]}))
+    assert cache.load_into(t, str(path)) == 1  # only the legal entry
+    assert t.select("gather", 4, 700) == A.RING
+    with pytest.raises(ValueError):
+        cache.load_into(t, str(path), strict=True)
+
+
+def test_retune_ignores_stale_env_cache(tmp_path, monkeypatch):
+    """--tune with $ACCL_TPU_TUNING_CACHE pointing at a stale table must
+    re-measure, not echo the old pins back out."""
+    from benchmarks.tune import run_tune
+    stale = Tuner(topology=EMU_TOPO, min_samples=1)
+    stale.observe("allreduce", 2, 256, A.NON_FUSED, 1e-6)
+    env_path = str(tmp_path / "tuning.json")
+    monkeypatch.setenv(cache.ENV_VAR, env_path)
+    cache.save(stale, env_path)
+    out = run_tune(world=2, sizes=[256], ops=["allreduce"], reps=1,
+                   cache_path=env_path)
+    doc = json.load(open(env_path))
+    assert doc["entries"], "re-tune wrote an empty table"
+    # every persisted entry is freshly measured, not a 0-sample pin echo
+    assert all(e["samples"] > 0 for e in doc["entries"])
+    assert out["tuner"].entries()
+
+
+def test_async_and_chained_calls_do_not_train_tuner():
+    """Only unchained synchronous calls feed the tuner: waitfor chains
+    include predecessor wait time and async back-to-back calls queue
+    behind each other — both would credit pipeline context, not
+    algorithm speed, to the EWMA."""
+    t, accls = _tuned_world(2)
+
+    def body(a):
+        s = a.buffer(data=np.ones(8, np.float32))
+        d = a.buffer((8,), np.float32)
+        a.allreduce(s, d, 8)                       # sync: observed
+        h1 = a.allreduce(s, d, 8, run_async=True)  # async: excluded
+        h2 = a.allreduce(d, s, 8, run_async=True, waitfor=[h1])
+        h2.wait()
+        return True
+
+    assert all(run_ranks(accls, body, timeout=60.0))
+    key = ("allreduce", 2, 5)  # 32 bytes -> bucket 5
+    stats = t._measured.get(key, {})
+    # 2 ranks x 1 sync call each; async + chained links were excluded
+    assert sum(st.n for st in stats.values()) == 2
+
+
+def test_sync_call_behind_inflight_async_not_observed():
+    """A synchronous call issued while async work is still in flight
+    queues behind it — its window includes the predecessor's runtime, so
+    it must not train the tuner either; after the async work retires,
+    sync calls are observed again."""
+    t, accls = _tuned_world(2)
+
+    def body(a):
+        s = a.buffer(data=np.ones(8, np.float32))
+        d = a.buffer((8,), np.float32)
+        h = a.allreduce(s, d, 8, run_async=True)
+        a.allreduce(s, d, 8)      # device busy: excluded
+        h.wait()
+        a.allreduce(s, d, 8)      # quiet again: observed
+        return True
+
+    assert all(run_ranks(accls, body, timeout=60.0))
+    stats = t._measured.get(("allreduce", 2, 5), {})
+    assert sum(st.n for st in stats.values()) == 2  # one per rank
+
+
+def test_device_scopes_driver_auto_resolution():
+    """A backend can exclude ops from driver-level AUTO resolution (the
+    TPU tier keeps rooted scatter/gather/reduce for its 2D tree); AUTO
+    then passes through to the engine's default expansion."""
+    t, accls = _tuned_world(2)
+    for a in accls:
+        a.device.auto_resolvable_ops = lambda: frozenset({"allreduce"})
+
+    def body(a):
+        src = a.buffer(data=np.full(4, a.rank + 1, np.float32))
+        dst = a.buffer((8,), np.float32) if a.rank == 0 else None
+        a.start_profiling()
+        a.gather(src, dst, 4, root=0)
+        a.end_profiling()
+        return a.profiler.records[0].algorithm
+
+    # AUTO was not resolved for gather: the record honestly says so
+    # instead of inventing a concrete name the backend may not have run
+    assert run_ranks(accls, body, timeout=60.0) == ["AUTO", "AUTO"]
+
+
+def test_untuned_records_carry_engine_default_algorithm():
+    """Without a tuner, emu-tier AUTO deterministically expands the
+    DEFAULT_ALGORITHMS choice — records label it concretely, so untuned
+    history feeds ingest_records."""
+    accls = emu_world(2)
+
+    def body(a):
+        s = a.buffer(data=np.ones(8, np.float32))
+        d = a.buffer((8,), np.float32)
+        a.start_profiling()
+        a.allreduce(s, d, 8)
+        a.end_profiling()
+        return a.profiler.records[0].algorithm
+
+    assert run_ranks(accls, body, timeout=60.0) == ["FUSED_RING"] * 2
+    # and an ingest of such history counts only the concrete records
+    t = Tuner(topology=EMU_TOPO)
+    from accl_tpu.tracing import CallRecord
+    recs = [CallRecord(op="allreduce", count=8, nbytes=32, comm_id=0,
+                       t_start=0.0, duration_s=1e-5,
+                       algorithm="FUSED_RING"),
+            CallRecord(op="allreduce", count=8, nbytes=32, comm_id=0,
+                       t_start=0.0, duration_s=1e-5, algorithm="AUTO")]
+    assert t.ingest_records(recs, 2) == 1  # AUTO label skipped
+
+
+def test_ingest_records_world_by_comm():
+    """Split-communicator history keys under its own world size when the
+    caller provides the comm_id -> size map."""
+    from accl_tpu.tracing import CallRecord
+    t = Tuner(topology=EMU_TOPO, min_samples=1)
+    recs = [CallRecord(op="allreduce", count=16, nbytes=64, comm_id=7,
+                       t_start=0.0, duration_s=1e-5,
+                       algorithm="FUSED_RING")]
+    assert t.ingest_records(recs, 4, world_by_comm={7: 2}) == 1
+    assert t._measured.get(("allreduce", 2, 6)) is not None
+    assert t._measured.get(("allreduce", 4, 6)) is None
+
+
+def test_env_cache_loaded_once_per_tuner(tmp_path, monkeypatch):
+    loads = []
+    from accl_tpu.tuner import cache as tcache
+    src = Tuner(topology=EMU_TOPO, min_samples=1)
+    src.observe("allreduce", 4, 64, A.FUSED_RING, 1e-6)
+    env_path = str(tmp_path / "t.json")
+    monkeypatch.setenv(cache.ENV_VAR, env_path)
+    cache.save(src, env_path)
+    real = tcache.load_into
+    monkeypatch.setattr(tcache, "load_into",
+                        lambda *a, **k: loads.append(1) or real(*a, **k))
+    emu_world(4, tuner=Tuner())  # 4 ranks, one shared tuner
+    assert len(loads) == 1
+
+
+# -- satellites --------------------------------------------------------------
+
+def test_check_algorithm_no_axis_message():
+    with pytest.raises(ValueError, match="has no algorithm variants"):
+        check_algorithm("send", A.RING)
+    with pytest.raises(ValueError, match="valid:"):
+        check_algorithm("allreduce", A.TREE)
+
+
+def test_default_algorithms_cover_every_tunable_op():
+    assert set(DEFAULT_ALGORITHMS) == set(VALID_ALGORITHMS)
+    for op, alg in DEFAULT_ALGORITHMS.items():
+        assert alg in VALID_ALGORITHMS[op], op
+
+
+def test_expand_call_auto_matches_static_default():
+    """Without a tuner, AUTO expands exactly the DEFAULT_ALGORITHMS
+    choice (the pre-tuner behavior, now table-driven)."""
+    from accl_tpu.arith import DEFAULT_ARITH_CONFIGS, resolve_arith_config
+    from accl_tpu.constants import CCLOp
+    from accl_tpu.moveengine import MoveContext, expand_call
+    cfg = resolve_arith_config({np.dtype(np.float32)},
+                               DEFAULT_ARITH_CONFIGS)
+    ctx = MoveContext(world_size=4, local_rank=1, arithcfg=cfg,
+                      max_segment_size=1 << 20)
+    for op in (CCLOp.gather, CCLOp.allreduce, CCLOp.bcast):
+        auto = expand_call(ctx, op, count=16, root_src_dst=0,
+                           addr_0=0, addr_2=4096)
+        explicit = expand_call(ctx, op, count=16, root_src_dst=0,
+                               addr_0=0, addr_2=4096,
+                               algorithm=DEFAULT_ALGORITHMS[op.name])
+        assert auto == explicit, op
+
+
+def test_nbytes_bucket():
+    assert nbytes_bucket(0) == 0
+    assert nbytes_bucket(1) == 0    # (0, 1] is bucket 0
+    assert nbytes_bucket(2) == 1
+    assert nbytes_bucket(1024) == 10
+    assert nbytes_bucket(1025) == 11
