@@ -2,7 +2,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test lint native bench dryrun chip-queue csv tune
+.PHONY: all test lint native bench bench-emu dryrun chip-queue csv tune
 
 all: lint native   ## default flow: syntax gate first, then the native build
 
@@ -25,6 +25,9 @@ tune:              ## emulator-tier algorithm sweep -> bench_out/tuning.json
 
 bench:             ## headline JSON line (real chip when the tunnel is up)
 	$(PY) bench.py
+
+bench-emu:         ## emulator-tier headline (<60s): pipelined-vs-serial executor microbench via the bench.py fallback path
+	ACCL_BENCH_TIER=emu JAX_PLATFORMS=cpu $(PY) bench.py
 
 dryrun:            ## multi-chip sharding dryrun on 8 virtual devices
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -257,4 +257,9 @@ DEFAULT_MAX_SEGMENT_SIZE = 1 << 20          # 1 MiB, like MAX_SEG_SIZE
 DEFAULT_RX_BUFFER_SIZE = 64 << 10           # spare rx buffer bytes
 DEFAULT_RX_BUFFER_COUNT = 16
 DEFAULT_TIMEOUT_S = 30.0
+# In-flight window depth of the pipelined move executor (reference: the
+# dma_mover keeps multiple moves in flight across its 11 stages). 0
+# disables pipelining (strict serial retirement). Overridable per process
+# via $ACCL_TPU_PIPELINE_WINDOW.
+DEFAULT_PIPELINE_WINDOW = 8
 TAG_ANY = 0xFFFFFFFF                        # reference uses tag=ANY sentinel

@@ -30,13 +30,18 @@ from .base import Device
 
 
 class EmuContext:
-    """Shared state of an N-rank in-process emulation: the fabric."""
+    """Shared state of an N-rank in-process emulation: the fabric.
+
+    ``pipeline_window`` sets each rank's executor in-flight window depth
+    (None = the process default, 0 = strict serial reference engine)."""
 
     def __init__(self, world_size: int, nbufs: int = DEFAULT_RX_BUFFER_COUNT,
-                 bufsize: int = DEFAULT_RX_BUFFER_SIZE):
+                 bufsize: int = DEFAULT_RX_BUFFER_SIZE,
+                 pipeline_window: int | None = None):
         self.world_size = world_size
         self.fabric = LocalFabric(world_size)
         self.nbufs, self.bufsize = nbufs, bufsize
+        self.pipeline_window = pipeline_window
         self.devices: list[EmuDevice | None] = [None] * world_size
 
     def device(self, rank: int) -> "EmuDevice":
@@ -59,7 +64,8 @@ class EmuDevice(Device):
         self.comm: Communicator | None = None  # world comm (first configured)
         self.executor = MoveExecutor(self.mem, self.pool,
                                      send_fn=ctx.fabric.send,
-                                     timeout=DEFAULT_TIMEOUT_S)
+                                     timeout=DEFAULT_TIMEOUT_S,
+                                     window=ctx.pipeline_window)
         self.timeout = DEFAULT_TIMEOUT_S
         self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
         self.profiling = False  # armed by the start_profiling config call
@@ -197,6 +203,7 @@ class EmuDevice(Device):
     def deinit(self):
         self._calls.put(None)
         self._inbox.put(None)
+        self.executor.close()
 
     # -- worker ------------------------------------------------------------
     def _run(self):
@@ -217,7 +224,13 @@ class EmuDevice(Device):
             for dep in waitfor:
                 dep.wait(self.timeout)
             with self._exec_mu:
+                self._last_move_stats = None
                 err = self._execute(desc)
+                stats = self._last_move_stats
+            if stats is not None:
+                # pipeline counters for the profiler (CallRecord fields);
+                # set before complete() so done-callbacks observe them
+                handle.pipeline_stats = stats
             handle.complete(err)
         except ACCLError as exc:
             # failed waitfor dependency: propagate its error word
@@ -256,4 +269,6 @@ class EmuDevice(Device):
             addr_0=desc.addr_0, addr_1=desc.addr_1, addr_2=desc.addr_2,
             compression=desc.compression, stream=desc.stream_flags,
             algorithm=desc.algorithm)
-        return self.executor.execute(moves, desc.arithcfg, comm)
+        err = self.executor.execute(moves, desc.arithcfg, comm)
+        self._last_move_stats = dict(self.executor.last_stats)
+        return err

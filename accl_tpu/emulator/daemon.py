@@ -410,6 +410,10 @@ class RankDaemon:
             raise
         self.executor = MoveExecutor(self.mem, self.pool, self.eth.send,
                                      timeout=self.timeout)
+        # both eth fabrics serialize the payload into a frame before
+        # send() returns, so emission may hand over zero-copy views of
+        # device memory instead of paying the tobytes() copy
+        self.executor.tx_serializes = True
         # runtime config-call state (ACCL_CONFIG parity, c:1240-1283):
         # pkt engines default-armed so a daemon is usable without the
         # driver's bring-up sequence; profiling counters are in-daemon,
@@ -451,8 +455,19 @@ class RankDaemon:
     def _ingest(self, env: Envelope, payload: bytes):
         if env.strm:
             self.executor.deliver_stream(env, payload)
-        else:
-            self.pool.ingest(env, payload, timeout=self.timeout)
+            return
+        err = self.pool.ingest(env, payload, timeout=self.timeout)
+        if err:
+            # eager-ingress rejection is otherwise invisible until some
+            # recv times out much later — say WHICH message died and why
+            # (the latched word also rides into that recv's error word,
+            # RxBufferPool.consume_error)
+            log.warning(
+                "rank %d eager ingress: rejected message from rank %d "
+                "(tag=%d seqn=%d comm=%d, %d B): %s", self.rank, env.src,
+                env.tag, env.seqn, env.comm_id, len(payload),
+                " | ".join(e.name for e in ErrorCode
+                           if e.value and err & e.value) or hex(err))
 
     # -- call execution ----------------------------------------------------
     def _call_worker(self):
@@ -947,6 +962,7 @@ class RankDaemon:
         self._stop.set()
         self._server.close()
         self.eth.close()
+        self.executor.close()
 
 
 def spawn_world(world: int, port_base: int = 0, nbufs: int = 16,

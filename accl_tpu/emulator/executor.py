@@ -4,21 +4,33 @@ This is the emulator-tier equivalent of the reference's dataplane:
 
 * :class:`DeviceMemory` — the rank's "HBM" (reference: ``vector<char>``
   devicemem in cclo_emu.cpp:47-103, addressed by the fake physical addresses
-  SimBuffer hands out, accl.py:53-104).
+  SimBuffer hands out, accl.py:53-104). Registrations live in a sorted
+  interval index resolved by bisection, and reads can return zero-copy
+  views for callers that only consume the data (combine operands).
 * :class:`RxBufferPool` — eager-ingress spare-buffer pool with MPI-envelope
   matching on ``(src, tag, seqn)`` (reference: rxbuf_offload engines +
   ``seek_rx_buffer``/``wait_on_rx``, ccl_offload_control.c:385-435,
   rxbuf_seek.cpp:20-79). Ingress is asynchronous: messages are accepted into
   the pool the moment they arrive, independent of any posted receive — the
   property that lets a send complete before the matching recv is posted.
+  Matching is a dict lookup keyed on ``(src, comm_id, seqn)`` backed by an
+  idle free-list, not a linear scan over every spare.
 * :class:`MoveExecutor` — executes ``Move`` programs: operand fetch
   (memory / rx-match / stream), elementwise combine, local write and/or
   remote send with wire compression (reference: dma_mover 11-stage pipeline,
   dma_mover.cpp:716-898, plus reduce_sum / stream_conv plugin kernels).
+  Like the reference pipeline it keeps multiple moves in flight: moves
+  marked ``blocking=False`` are handed to a bounded in-flight window
+  drained by a worker thread, so a ring step's send overlaps the next
+  step's recv-match and combine. ``execute_serial`` retains the strict
+  one-move-at-a-time engine as the reference/differential-testing path.
 """
 
 from __future__ import annotations
 
+import bisect
+import os
+import queue
 import threading
 import time
 
@@ -26,9 +38,11 @@ import numpy as np
 
 from ..arith import ArithConfig
 from ..communicator import Communicator
-from ..constants import ErrorCode, ReduceFunc, TAG_ANY
+from ..constants import (DEFAULT_PIPELINE_WINDOW, ErrorCode, ReduceFunc,
+                         TAG_ANY)
 from ..moveengine import Move, MoveMode, Operand
 from .fabric import Envelope
+from .protocol import payload_nbytes
 
 
 class DeviceMemory:
@@ -36,33 +50,61 @@ class DeviceMemory:
 
     Buffers register their [addr, addr+nbytes) range; reads/writes resolve
     the containing registration and return views. Sub-buffer addresses fall
-    inside the parent's range, so only top-level buffers register.
+    inside the parent's range, so only top-level buffers register — the
+    ranges are therefore non-overlapping and a bisect over sorted start
+    addresses resolves any access in O(log n). Resolution reads an
+    immutable (starts, regions) snapshot swapped atomically on
+    register/deregister, so the hot path takes no lock at all (the host
+    registers while executor workers resolve).
     """
 
     def __init__(self):
-        self._regions: dict[int, np.ndarray] = {}  # start addr -> flat bytes view
-        self._lock = threading.Lock()  # host registers while workers resolve
+        self._regions: dict[int, np.ndarray] = {}  # start addr -> flat bytes
+        self._lock = threading.Lock()              # guards re-indexing only
+        self._index: tuple[list[int], list[np.ndarray]] = ([], [])
 
     def register(self, addr: int, array: np.ndarray):
         with self._lock:
             self._regions[addr] = array.reshape(-1).view(np.uint8)
+            self._reindex()
 
     def deregister(self, addr: int):
         with self._lock:
             self._regions.pop(addr, None)
+            self._reindex()
+
+    def _reindex(self):
+        """Caller holds ``self._lock``. Publishes a fresh snapshot in one
+        reference assignment (atomic under the GIL) so readers never see a
+        half-updated index."""
+        starts = sorted(self._regions)
+        self._index = (starts, [self._regions[s] for s in starts])
 
     def _resolve(self, addr: int, nbytes: int) -> tuple[np.ndarray, int]:
-        with self._lock:
-            items = list(self._regions.items())
-        for start, mem in items:
+        starts, mems = self._index
+        i = bisect.bisect_right(starts, addr) - 1
+        if i >= 0:
+            mem = mems[i]
+            off = addr - starts[i]
+            if off + nbytes <= mem.nbytes:
+                return mem, off
+        # tolerance fallback for (contract-violating) nested registrations:
+        # scan every region before declaring the range unmapped
+        for start, mem in zip(starts, mems):
             if start <= addr and addr + nbytes <= start + mem.nbytes:
                 return mem, addr - start
         raise KeyError(f"address range [0x{addr:x}, +{nbytes}) not registered")
 
-    def read(self, addr: int, count: int, dtype: np.dtype) -> np.ndarray:
+    def read(self, addr: int, count: int, dtype: np.dtype, *,
+             copy: bool = True) -> np.ndarray:
+        """Read ``count`` elements at ``addr``. With ``copy=False`` the
+        result is a zero-copy VIEW of device memory — only for callers that
+        never mutate it and consume it before the region is rewritten
+        (combine operands, send payloads serialized in-call)."""
         nbytes = count * dtype.itemsize
         mem, off = self._resolve(addr, nbytes)
-        return mem[off:off + nbytes].view(dtype).copy()
+        view = mem[off:off + nbytes].view(dtype)
+        return view.copy() if copy else view
 
     def write(self, addr: int, data: np.ndarray):
         flat = data.reshape(-1).view(np.uint8)
@@ -91,6 +133,12 @@ class RxBufferPool:
     with a timeout (wait_on_rx parity, ccl_offload_control.c:423-435).
     Matching requires the exact expected sequence number per sender,
     enforcing in-order consumption per peer (rxbuf_seek.cpp:58-59).
+
+    Reserved buffers are indexed by ``(src, comm_id, seqn)`` — exact-match
+    keys, so a seek is one dict probe instead of a scan over every spare —
+    and idle buffers sit on a free-list so a claim is a pop, not a scan.
+    A key can briefly hold several buffers (duplicate delivery under fault
+    injection); candidates are kept in arrival order.
     """
 
     def __init__(self, nbufs: int, bufsize: int):
@@ -98,22 +146,24 @@ class RxBufferPool:
         self.bufsize = bufsize
         self._cv = threading.Condition()
         self.error_word = 0
+        self._idle: list[RxBuffer] = list(self.bufs)
+        self._by_key: dict[tuple[int, int, int], list[RxBuffer]] = {}
 
-    def _claim(self, env: Envelope, payload: bytes, keep: int) -> bool:
+    def _claim(self, env: Envelope, payload, keep: int) -> bool:
         """Claim an IDLE buffer, leaving at least ``keep`` spares; caller
         holds ``self._cv``. The one shared copy of the buffer-claim
-        protocol (status transition, assignment, wakeup)."""
-        idle = [b for b in self.bufs if b.status == RxBuffer.IDLE]
-        if len(idle) <= keep:
+        protocol (status transition, assignment, indexing, wakeup)."""
+        if len(self._idle) <= keep:
             return False
-        b = idle[0]
+        b = self._idle.pop()
         b.status = RxBuffer.RESERVED
         b.env, b.payload = env, payload
+        self._by_key.setdefault((env.src, env.comm_id, env.seqn),
+                                []).append(b)
         self._cv.notify_all()
         return True
 
-    def ingest(self, env: Envelope, payload: bytes,
-               timeout: float = 10.0) -> int:
+    def ingest(self, env: Envelope, payload, timeout: float = 10.0) -> int:
         """Accept a message into a spare buffer.
 
         Blocks while the pool is full — modeling the reference's transport
@@ -124,7 +174,7 @@ class RxBufferPool:
         """
         deadline = time.monotonic() + timeout
         with self._cv:
-            if len(payload) > self.bufsize:
+            if payload_nbytes(payload) > self.bufsize:
                 self.error_word |= int(ErrorCode.DMA_SIZE_ERROR)
                 return int(ErrorCode.DMA_SIZE_ERROR)
             while True:
@@ -137,7 +187,7 @@ class RxBufferPool:
                     return int(
                         ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
 
-    def try_ingest(self, env: Envelope, payload: bytes) -> bool:
+    def try_ingest(self, env: Envelope, payload) -> bool:
         """Non-blocking ingest: True if a spare buffer took the message,
         False when the caller must fall back to the blocking path. Never
         claims the LAST spare — a queued message headed for the blocking
@@ -145,23 +195,25 @@ class RxBufferPool:
         it into a timeout. Oversize payloads latch the error like
         ``ingest``."""
         with self._cv:
-            if len(payload) > self.bufsize:
+            if payload_nbytes(payload) > self.bufsize:
                 self.error_word |= int(ErrorCode.DMA_SIZE_ERROR)
                 return True  # consumed (dropped) — retrying cannot help
             return self._claim(env, payload, keep=1)
 
+    def consume_error(self) -> int:
+        """Return and clear the latched ingress error word — the bridge
+        that carries an eager-ingress failure (oversize drop, overflow)
+        into the error word of the call whose receive it starved."""
+        with self._cv:
+            err, self.error_word = self.error_word, 0
+            return err
+
     def _match(self, src: int, tag: int, seqn: int,
                comm_id: int) -> RxBuffer | None:
-        for b in self.bufs:
-            if b.status != RxBuffer.RESERVED or b.env is None:
-                continue
-            if b.env.src != src or b.env.seqn != seqn:
-                continue
-            if b.env.comm_id != comm_id:
-                continue
-            if tag != TAG_ANY and b.env.tag != tag and b.env.tag != TAG_ANY:
-                continue
-            return b
+        for b in self._by_key.get((src, comm_id, seqn), ()):
+            e = b.env
+            if tag == TAG_ANY or e.tag == tag or e.tag == TAG_ANY:
+                return b
         return None
 
     def seek(self, src: int, tag: int, seqn: int, timeout: float,
@@ -176,8 +228,14 @@ class RxBufferPool:
                 b = self._match(src, tag, seqn, comm_id)
                 if b is not None:
                     env, payload = b.env, b.payload
+                    key = (env.src, env.comm_id, env.seqn)
+                    cands = self._by_key[key]
+                    cands.remove(b)
+                    if not cands:
+                        del self._by_key[key]
                     b.status = RxBuffer.IDLE          # release back to pool
                     b.env, b.payload = None, b""
+                    self._idle.append(b)
                     self._cv.notify_all()  # wake senders blocked on overflow
                     return env, payload
                 remaining = deadline - time.monotonic()
@@ -186,7 +244,7 @@ class RxBufferPool:
 
     def occupancy(self) -> int:
         with self._cv:
-            return sum(b.status == RxBuffer.RESERVED for b in self.bufs)
+            return len(self.bufs) - len(self._idle)
 
     def describe(self) -> str:
         """Parity: dump_rx_buffers (accl.py:482-526)."""
@@ -217,14 +275,50 @@ class MoveExecutor:
     feeds OP0_STREAM operands, RES_STREAM results land in ``stream_out``,
     and messages with ``strm != 0`` bypass the rx pool into ``stream_in``
     (remote-stream send, dma_mover.cpp:303 / tcp_depacketizer strm routing).
+
+    Pipelining (reference: the dma_mover keeps many moves in flight across
+    its 11 stages): ``window`` > 0 arms the in-flight window — non-blocking
+    pure sends are enqueued to a worker thread and retire asynchronously,
+    overlapping their payload serialization and fabric delivery with the
+    main thread's recv-matching and combining of subsequent moves. Every
+    other move runs inline on the main thread, and drains the window
+    before emitting remotely so per-peer wire sequence numbers are always
+    assigned AND emitted in program order. A failed in-flight move latches
+    its error; the next blocking move (or the final drain) surfaces it and
+    aborts the rest of the program — the software analog of the firmware's
+    setjmp unwind to finalize_call (ccl_offload_control.c:1163-1170).
+
+    ``window=0`` (or env ``ACCL_TPU_PIPELINE_WINDOW=0``) degrades to
+    ``execute_serial``, the strict one-move-at-a-time reference engine kept
+    for differential testing and as the before-side of the pipeline
+    microbenchmark.
+
+    ``tx_serializes``: set True by owners whose ``send_fn`` fully
+    serializes the payload before returning (socket fabrics) — emission
+    may then frame zero-copy views of device memory. The in-process
+    loopback fabric retains payload references in the peer's rx pool, so
+    it must stay False and views are copied at emission.
     """
 
     def __init__(self, mem: DeviceMemory, pool: RxBufferPool, send_fn,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, window: int | None = None):
         self.mem = mem
         self.pool = pool
-        self._send = send_fn  # (Envelope, payload_bytes) -> None
+        self._send = send_fn  # (Envelope, payload) -> None
         self.timeout = timeout
+        if window is None:
+            window = int(os.environ.get("ACCL_TPU_PIPELINE_WINDOW",
+                                        DEFAULT_PIPELINE_WINDOW))
+        self.window = max(0, int(window))
+        self.tx_serializes = False
+        # in-flight window state (lazily started worker)
+        self._wq: queue.Queue | None = None
+        self._win_cv = threading.Condition()
+        self._inflight = 0
+        self._async_err = 0
+        self._closed = False
+        # per-execute pipeline counters (tracing/CallRecord plumbing)
+        self.last_stats = {"moves": 0, "pipelined": 0, "max_inflight": 0}
         # stream ports are CONTINUOUS element streams (the reference's AXIS
         # semantics: no message boundaries — a consumer reads exactly the
         # word count its move asks for, across however many pushes/wire
@@ -310,7 +404,7 @@ class MoveExecutor:
                 if remaining <= 0 or not self._stream_cv.wait(remaining):
                     raise IndexError("stream-out port empty")
 
-    def deliver_stream(self, env: Envelope, payload: bytes):
+    def deliver_stream(self, env: Envelope, payload):
         data = np.frombuffer(payload, dtype=np.dtype(env.wire_dtype))
         self.push_stream(data)
 
@@ -327,15 +421,17 @@ class MoveExecutor:
 
     # -- operand fetch/sink ------------------------------------------------
     def _fetch(self, op: Operand, count: int, cfg: ArithConfig,
-               comm: Communicator, deadline: float
+               comm: Communicator, deadline: float, *, copy: bool = True
                ) -> tuple[np.ndarray | None, int]:
-        """Returns (array in uncompressed dtype, error_word)."""
+        """Returns (array in uncompressed dtype, error_word). With
+        ``copy=False`` IMMEDIATE operands come back as zero-copy views of
+        device memory (safe for read-only consumption within the move)."""
         u, c = cfg.uncompressed_dtype, cfg.compressed_dtype
         if op.mode == MoveMode.NONE:
             return None, 0
         if op.mode == MoveMode.IMMEDIATE:
             stored = c if op.compressed else u
-            data = self.mem.read(op.addr, count, stored)
+            data = self.mem.read(op.addr, count, stored, copy=copy)
             return data.astype(u, copy=False), 0
         if op.mode == MoveMode.STREAM:
             # continuous-stream semantics: block until exactly ``count``
@@ -351,7 +447,12 @@ class MoveExecutor:
                                  max(0.0, deadline - time.monotonic()),
                                  comm_id=comm.comm_id)
             if got is None:
-                return None, int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+                # a latched ingress error (oversize drop, pool overflow)
+                # is usually WHY the matching message never arrived —
+                # surface it alongside the timeout so the caller's error
+                # word tells the real story
+                return None, (int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+                              | self.pool.consume_error())
             env, payload = got
             rank.inbound_seq += 1      # exchange-mem seq update parity
             wire = np.dtype(env.wire_dtype)
@@ -362,10 +463,21 @@ class MoveExecutor:
         return None, int(ErrorCode.INVALID_CALL)
 
     def _emit_remote(self, move: Move, data: np.ndarray, cfg: ArithConfig,
-                     comm: Communicator):
+                     comm: Communicator, *, zero_copy: bool = False):
         wire = (cfg.compressed_dtype if move.eth_compressed
                 else cfg.uncompressed_dtype)
-        payload = np.ascontiguousarray(data.astype(wire, copy=False)).tobytes()
+        arr = np.ascontiguousarray(data.astype(wire, copy=False))
+        owns = arr.base is None and arr.flags.owndata
+        if zero_copy and (owns or self.tx_serializes):
+            # frame the array itself (as a flat byte view): a fresh combine
+            # result owns its memory and is never touched again, and a
+            # serializing fabric copies views out before send returns —
+            # either way the tobytes() copy is pure overhead
+            payload = arr.reshape(-1).view(np.uint8)
+            nbytes = arr.nbytes
+        else:
+            payload = arr.tobytes()
+            nbytes = len(payload)
         rank = comm.ranks[move.dst_rank]  # comm-local -> fabric rank
         # stream deliveries bypass the rx pool, so they ride OUTSIDE the
         # seqn-ordered channel — consuming a seqn here would desync the
@@ -373,52 +485,188 @@ class MoveExecutor:
         seqn = 0 if move.remote_stream else rank.outbound_seq
         env = Envelope(src=comm.my_global_rank, dst=rank.global_rank,
                        tag=move.tag, seqn=seqn,
-                       nbytes=len(payload), wire_dtype=np.dtype(wire).name,
+                       nbytes=nbytes, wire_dtype=np.dtype(wire).name,
                        strm=1 if move.remote_stream else 0,
                        comm_id=comm.comm_id)
         if not move.remote_stream:
             rank.outbound_seq += 1
         self._send(env, payload)
 
+    # -- single-move engine ------------------------------------------------
+    def _run_move(self, mv: Move, cfg: ArithConfig, comm: Communicator, *,
+                  pipelined: bool, in_window: bool = False) -> int:
+        """One trip through the dma_mover pipeline for one move (decode →
+        fetch ops → arith → route result → retire with an error word,
+        dma_mover.cpp:343-714). ``pipelined=True`` uses the zero-copy
+        dataplane and drains the in-flight window before any remote
+        emission (program-order seqn assignment across worker + inline
+        emitters)."""
+        deadline = time.monotonic() + self.timeout
+        copy = not pipelined
+        op0, e0 = self._fetch(mv.op0, mv.count, cfg, comm, deadline,
+                              copy=copy)
+        op1, e1 = self._fetch(mv.op1, mv.count, cfg, comm, deadline,
+                              copy=copy)
+        if e0 or e1:
+            return e0 | e1
+        if op0 is not None and op1 is not None:
+            if mv.func is None:
+                return int(ErrorCode.INVALID_CALL)
+            result = _REDUCERS[mv.func](op0, op1)
+        else:
+            result = op0 if op0 is not None else op1
+        if result is None:
+            return int(ErrorCode.INVALID_CALL)
+        if mv.res_local:
+            if mv.res.mode == MoveMode.STREAM:
+                if result.base is not None:
+                    # stream entries outlive the move: a view of device
+                    # memory could be rewritten before the consumer pops it
+                    result = result.copy()
+                with self._stream_cv:
+                    self.stream_out.append(result)
+                    self._stream_cv.notify_all()
+            elif mv.res.mode == MoveMode.IMMEDIATE:
+                out_dtype = (cfg.compressed_dtype if mv.res.compressed
+                             else cfg.uncompressed_dtype)
+                self.mem.write(mv.res.addr,
+                               result.astype(out_dtype, copy=False))
+            else:
+                return int(ErrorCode.INVALID_CALL)
+        if mv.res_remote:
+            if pipelined and not in_window and self._inflight:
+                # emission barrier: queued sends must hit the wire (and
+                # take their seqns) before this inline emission does. A
+                # window-run move skips this (it IS the window, and the
+                # single FIFO worker already emits in program order).
+                self._drain()
+            self._emit_remote(mv, result, cfg, comm, zero_copy=pipelined)
+        return 0
+
+    # -- in-flight window --------------------------------------------------
+    @staticmethod
+    def _window_eligible(mv: Move) -> bool:
+        """Only pure pool-destined sends ride the window: no local write,
+        no stream port, no recv-matching — the shape every
+        ``blocking=False`` expansion site produces. Everything else runs
+        inline even when marked non-blocking."""
+        return (not mv.blocking and mv.res_remote and not mv.res_local
+                and not mv.remote_stream and mv.func is None
+                and mv.op0.mode is MoveMode.IMMEDIATE
+                and mv.op1.mode is MoveMode.NONE)
+
+    def _window_loop(self, wq: queue.Queue):
+        while True:
+            item = wq.get()
+            if item is None:
+                return
+            mv, cfg, comm = item
+            try:
+                if not self._async_err:
+                    err = self._run_move(mv, cfg, comm, pipelined=True,
+                                         in_window=True)
+                else:
+                    err = 0  # program already failed: skip, just retire
+            except Exception:  # noqa: BLE001 — a worker death would hang
+                # every future drain; latch and keep draining instead
+                import traceback
+                traceback.print_exc()
+                err = int(ErrorCode.INVALID_CALL)
+            with self._win_cv:
+                if err:
+                    self._async_err |= err
+                self._inflight -= 1
+                self._win_cv.notify_all()
+
+    def _submit(self, mv: Move, cfg: ArithConfig, comm: Communicator):
+        with self._win_cv:
+            if self._closed:
+                raise RuntimeError("executor closed")
+            if self._wq is None:
+                self._wq = queue.Queue()
+                threading.Thread(target=self._window_loop,
+                                 args=(self._wq,), daemon=True,
+                                 name="move-window").start()
+            while self._inflight >= self.window:
+                self._win_cv.wait()
+                if self._closed:  # close() raced the backpressure wait
+                    raise RuntimeError("executor closed")
+            self._inflight += 1
+            if self._inflight > self.last_stats["max_inflight"]:
+                self.last_stats["max_inflight"] = self._inflight
+            # put under the lock: orders every submission before close()'s
+            # sentinel, so the worker always retires it (an unbounded
+            # queue's put cannot block, holding the lock is safe)
+            self._wq.put((mv, cfg, comm))
+
+    def _drain(self):
+        """Block until every in-flight window move has retired."""
+        with self._win_cv:
+            while self._inflight:
+                self._win_cv.wait()
+
+    def close(self):
+        """Stop the window worker (idempotent). Executors live as long as
+        their device; tests spin up thousands of worlds per session, so
+        leaked worker threads must not accumulate. In-lock sentinel
+        placement guarantees already-submitted moves retire first (the
+        worker holds its own queue reference), so a concurrent execute()'s
+        final drain cannot hang."""
+        with self._win_cv:
+            self._closed = True
+            wq, self._wq = self._wq, None
+            if wq is not None:
+                wq.put(None)
+            self._win_cv.notify_all()
+
     # -- the engine --------------------------------------------------------
     def execute(self, moves: list[Move], cfg: ArithConfig,
                 comm: Communicator) -> int:
         """Run a move program; returns the OR-ed error word (0 = success).
 
-        Parity: each move maps to one trip through the dma_mover pipeline
-        (decode → fetch ops → arith → route result → retire with an error
-        word, dma_mover.cpp:343-714)."""
+        With the window armed (``self.window > 0``), non-blocking pure
+        sends retire asynchronously; all other moves run inline, draining
+        the window before any remote emission. A latched in-flight error
+        aborts the remaining program at the next move boundary and is
+        OR-ed into the returned word. ``window == 0`` falls back to the
+        strict serial engine."""
+        if self.window <= 0:
+            return self.execute_serial(moves, cfg, comm)
+        self.last_stats = {"moves": len(moves), "pipelined": 0,
+                           "max_inflight": 0}
+        err = 0
+        try:
+            for mv in moves:
+                if self._async_err:
+                    break  # setjmp-unwind: a queued move failed, stop
+                if self._window_eligible(mv):
+                    self._submit(mv, cfg, comm)
+                    self.last_stats["pipelined"] += 1
+                    continue
+                err = self._run_move(mv, cfg, comm, pipelined=True)
+                if err:
+                    break  # setjmp unwind to finalize_call (c:1163-1170)
+        finally:
+            # even when an inline move raises, in-flight sends must retire
+            # before control leaves — a leftover would bleed into the next
+            # program's window (and its latched error into the wrong call)
+            self._drain()
+            with self._win_cv:
+                err |= self._async_err
+                self._async_err = 0
+        return err
+
+    def execute_serial(self, moves: list[Move], cfg: ArithConfig,
+                       comm: Communicator) -> int:
+        """The strict one-move-at-a-time reference engine: every move fully
+        retires (copying dataplane, synchronous emission) before the next
+        starts. Kept verbatim as the differential-testing golden path and
+        the before-side of the pipeline microbenchmark."""
+        self.last_stats = {"moves": len(moves), "pipelined": 0,
+                           "max_inflight": 0}
         err = 0
         for mv in moves:
-            deadline = time.monotonic() + self.timeout
-            op0, e0 = self._fetch(mv.op0, mv.count, cfg, comm, deadline)
-            op1, e1 = self._fetch(mv.op1, mv.count, cfg, comm, deadline)
-            err |= e0 | e1
-            if e0 or e1:
+            err |= self._run_move(mv, cfg, comm, pipelined=False)
+            if err:
                 break  # like setjmp unwind to finalize_call (c:1163-1170)
-            if op0 is not None and op1 is not None:
-                if mv.func is None:
-                    err |= int(ErrorCode.INVALID_CALL)
-                    break
-                result = _REDUCERS[mv.func](op0, op1)
-            else:
-                result = op0 if op0 is not None else op1
-            if result is None:
-                err |= int(ErrorCode.INVALID_CALL)
-                break
-            if mv.res_local:
-                if mv.res.mode == MoveMode.STREAM:
-                    with self._stream_cv:
-                        self.stream_out.append(result)
-                        self._stream_cv.notify_all()
-                elif mv.res.mode == MoveMode.IMMEDIATE:
-                    out_dtype = (cfg.compressed_dtype if mv.res.compressed
-                                 else cfg.uncompressed_dtype)
-                    self.mem.write(mv.res.addr,
-                                   result.astype(out_dtype, copy=False))
-                else:
-                    err |= int(ErrorCode.INVALID_CALL)
-                    break
-            if mv.res_remote:
-                self._emit_remote(mv, result, cfg, comm)
         return err

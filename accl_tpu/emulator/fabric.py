@@ -40,9 +40,18 @@ class LocalFabric:
     (backpressure propagates naturally — a full rx pool blocks the sender,
     like TCP flow control in the reference).
 
+    Payload retention: delivery hands the payload OBJECT to the peer's rx
+    pool, which holds it until the matching recv claims it — so senders
+    must not pass views of memory they may rewrite (``retains_payloads``;
+    the executor keeps ``tx_serializes=False`` for this fabric and copies
+    non-owning payloads at emission). Socket fabrics serialize into a
+    frame inside ``send`` and may be handed zero-copy views.
+
     Parity role: dummy_tcp_stack loopback (single-device tests without a
     network, dummy_tcp_stack.cpp:221-269).
     """
+
+    retains_payloads = True
 
     def __init__(self, world_size: int):
         self.world_size = world_size

@@ -106,6 +106,15 @@ def code_dtype(code: int) -> np.dtype:
 
 # -- framing ----------------------------------------------------------------
 
+def payload_nbytes(payload) -> int:
+    """Byte length of a wire payload, which may be ``bytes``/``bytearray``
+    (serial path), a ``memoryview``, or a numpy array (zero-copy path —
+    ``len()`` would count ELEMENTS there, silently under-reporting). The
+    one copy of the rule, shared by frame assembly and the rx pool."""
+    n = getattr(payload, "nbytes", None)
+    return n if n is not None else len(payload)
+
+
 def send_frame(sock: socket.socket, body: bytes):
     # Large frames go scatter-gather: header + body in one sendmsg
     # without concatenating a fresh buffer per frame (3.6x at 1 MiB —
@@ -262,10 +271,16 @@ _ETH_FMT = "<5I2BQ"
 
 
 def pack_eth(src: int, dst: int, tag: int, seqn: int, comm_id: int,
-             strm: int, dtype: int, payload: bytes) -> bytes:
-    return (bytes([MSG_ETH]) +
-            struct.pack(_ETH_FMT, src, dst, tag, seqn, comm_id, strm,
-                        dtype, len(payload)) + payload)
+             strm: int, dtype: int, payload) -> bytes:
+    # payload may be bytes OR any buffer object (memoryview / flat uint8
+    # numpy view from the executor's zero-copy emission path): the frame
+    # assembly below is the single serialization point, so views are
+    # copied exactly once, here, instead of tobytes() + concat
+    nbytes = payload_nbytes(payload)
+    return b"".join((bytes([MSG_ETH]),
+                     struct.pack(_ETH_FMT, src, dst, tag, seqn, comm_id,
+                                 strm, dtype, nbytes),
+                     payload))
 
 
 def unpack_eth(body: bytes) -> tuple[dict, bytes]:

@@ -94,6 +94,14 @@ class Move:
     flags, count. ``blocking`` marks moves whose result must be fully
     retired before the next move may start (the reference forces this where
     a relay would race a concurrent write, ccl_offload_control.c:788-791).
+
+    ``blocking=False`` invariant (what the pipelined executor relies on —
+    audit every site that clears the flag against it): the move is a pure
+    pool-destined send (no local write, no stream port) AND no later move
+    of the same program writes the memory it reads. Such a move may retire
+    asynchronously, overlapping subsequent moves; the executor keeps wire
+    sequence numbers in program order regardless. A send whose source is
+    rewritten later (gather's relay scratch, c:632-724) must stay blocking.
     """
 
     count: int
@@ -195,11 +203,15 @@ def expand_send(ctx: MoveContext, count: int, src: int, dst_rank: int,
                 tag: int = 0,
                 compression: Compression = Compression.NONE,
                 stream: StreamFlags = StreamFlags.NO_STREAM,
-                to_remote_stream: bool = False) -> list[Move]:
+                to_remote_stream: bool = False,
+                blocking: bool = True) -> list[Move]:
     """send (c:339-361): segmented op0 -> remote res.
 
     Wire compression applies when ETH_COMPRESSED is set; segmentation at
     max_segment_size like the eth_cmd split (dma_mover.cpp:280-318).
+    ``blocking=False`` is passed by callers whose source region is never
+    written later in the program (see the Move.blocking invariant) so the
+    pipelined executor can overlap the send with subsequent moves.
     """
     eth_c = bool(compression & Compression.ETH_COMPRESSED)
     moves = []
@@ -211,7 +223,7 @@ def expand_send(ctx: MoveContext, count: int, src: int, dst_rank: int,
                                 bool(compression & Compression.OP0_COMPRESSED)))
         moves.append(Move(count=n, op0=op0, res_remote=True,
                           dst_rank=dst_rank, tag=tag, eth_compressed=eth_c,
-                          remote_stream=to_remote_stream,
+                          remote_stream=to_remote_stream, blocking=blocking,
                           mode_label="IMMEDIATE/NONE/REMOTE"))
     return moves
 
@@ -346,8 +358,10 @@ def expand_broadcast_tree(ctx: MoveContext, count: int, root: int, buf: int,
     while mask:
         if vrank + mask < W:
             child = ((vrank + mask) + root) % W
+            # non-blocking: buf is never written after the (earlier) recv,
+            # so forwards to all children may overlap each other
             moves += expand_send(ctx, count, buf, child, tag=TAG_ANY,
-                                 compression=compression)
+                                 compression=compression, blocking=False)
         mask >>= 1
     return moves
 
@@ -367,10 +381,10 @@ def expand_scatter(ctx: MoveContext, count: int, root: int, src: int,
                 moves += expand_copy(ctx, count, chunk, dst, compression)
                 moves[-1].mode_label = "INCREMENT(local-copy)"
             else:
+                # non-blocking: src chunks are read-only for the whole call
                 sends = expand_send(ctx, count, chunk, r, tag=TAG_ANY,
-                                    compression=compression)
+                                    compression=compression, blocking=False)
                 for m in sends:
-                    m.blocking = False
                     m.mode_label = "INCREMENT(rr-send)"
                 moves += sends
     else:
@@ -404,14 +418,17 @@ def expand_gather_ring(ctx: MoveContext, count: int, root: int, src: int,
                                  dst + owner * count * ebytes, tag=TAG_ANY,
                                  compression=compression)
     else:
+        # non-blocking: src is never written during a gather
         moves += expand_send(ctx, count, src, next_toward_root, tag=TAG_ANY,
-                             compression=compression)
+                             compression=compression, blocking=False)
         # relay the chunks of the (W-1-dist) ranks farther from root
         relay_buf = dst  # non-root dst is scratch (reference reuses rx path)
         for _ in range(W - 1 - dist):
             moves += expand_recv(ctx, count, prev_in_ring, relay_buf,
                                  tag=TAG_ANY, compression=compression)
-            # the relay reads the RES-typed scratch the recv just wrote
+            # the relay reads the RES-typed scratch the recv just wrote —
+            # and the NEXT recv overwrites that same scratch, so this send
+            # must stay blocking (WAR hazard on relay_buf)
             moves += expand_send(ctx, count, relay_buf, next_toward_root,
                                  tag=TAG_ANY,
                                  compression=res_as_op0(compression))
@@ -438,8 +455,9 @@ def expand_gather_direct(ctx: MoveContext, count: int, root: int, src: int,
             moves += expand_recv(ctx, count, r, dst + r * count * ebytes,
                                  tag=TAG_ANY, compression=compression)
     else:
+        # non-blocking: the send is the non-root's whole program
         moves += expand_send(ctx, count, src, root, tag=TAG_ANY,
-                             compression=compression)
+                             compression=compression, blocking=False)
     return moves
 
 
@@ -456,8 +474,10 @@ def expand_allgather_ring(ctx: MoveContext, count: int, src: int, dst: int,
     moves: list[Move] = []
     moves += expand_copy(ctx, count, src, dst + me * count * ebytes,
                          compression)
+    # non-blocking: src is never written during an allgather, so the
+    # initial send overlaps the first recv's pool wait
     moves += expand_send(ctx, count, src, nxt, tag=TAG_ANY,
-                         compression=compression)
+                         compression=compression, blocking=False)
     for i in range(W - 1):
         owner = (me - 1 - i) % W
         slot = dst + owner * count * ebytes
@@ -469,9 +489,13 @@ def expand_allgather_ring(ctx: MoveContext, count: int, src: int, dst: int,
         if i < W - 2:
             # the relay reads the slot the recv just wrote, which is stored
             # in the RES dtype — substitute the flag like the firmware's
-            # ETH/OP0 substitution when relaying from dst (c:739-743)
+            # ETH/OP0 substitution when relaying from dst (c:739-743).
+            # Non-blocking: each round's slot is written exactly once, so
+            # the relay overlaps the NEXT round's recv (different slot) —
+            # the ring-step overlap the pipelined executor exploits.
             moves += expand_send(ctx, count, slot, nxt, tag=TAG_ANY,
-                                 compression=res_as_op0(compression))
+                                 compression=res_as_op0(compression),
+                                 blocking=False)
     return moves
 
 
@@ -488,11 +512,9 @@ def expand_allgather_direct(ctx: MoveContext, count: int, src: int, dst: int,
                          compression)
     for step in range(1, W):  # rotated schedule avoids hot receivers
         to = (me + step) % W
-        sends = expand_send(ctx, count, src, to, tag=TAG_ANY,
-                            compression=compression)
-        for m in sends:
-            m.blocking = False
-        moves += sends
+        # non-blocking: src is read-only; the recvs below write dst slots
+        moves += expand_send(ctx, count, src, to, tag=TAG_ANY,
+                             compression=compression, blocking=False)
     for step in range(1, W):
         frm = (me - step) % W
         moves += expand_recv(ctx, count, frm, dst + frm * count * ebytes,
@@ -539,9 +561,10 @@ def expand_reduce_ring(ctx: MoveContext, count: int, root: int, func: ReduceFunc
     if W == 1:
         return expand_copy(ctx, count, src, dst, compression)
     if (me - root) % W == W - 1:
-        # farthest rank starts the chain
+        # farthest rank starts the chain; non-blocking: src is read-only
+        # and this send is the rank's whole program
         moves += expand_send(ctx, count, src, nxt, tag=TAG_ANY,
-                             compression=compression)
+                             compression=compression, blocking=False)
     elif me == root:
         moves += expand_fused_recv_reduce(ctx, count, func, prv, src, dst,
                                           tag=TAG_ANY, compression=compression)
@@ -567,8 +590,11 @@ def expand_reduce_scatter_ring(ctx: MoveContext, count: int, func: ReduceFunc,
     if W == 1:
         return expand_copy(ctx, count, src, dst, compression)
     first_chunk = (me + 1) % W
+    # non-blocking: src chunks are read-only; the only local write of the
+    # program is the final fused reduce into dst
     moves += expand_send(ctx, count, src + first_chunk * count * ebytes, nxt,
-                         tag=TAG_ANY, compression=compression)
+                         tag=TAG_ANY, compression=compression,
+                         blocking=False)
     for i in range(1, W):
         # flow is toward decreasing rank, so at round i the partial arriving
         # from prv=(me+1) is for chunk (me+1+i); the final round's chunk is
@@ -620,10 +646,13 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
     moves: list[Move] = []
 
     # --- phase 1: ring reduce-scatter over chunks (c:982-1023) ---
+    # non-blocking: src chunks are read-only for the whole allreduce, so
+    # the phase-1 kickoff send overlaps the first fused step's pool wait
     c0 = (me + 1) % W
     if chunk_len(c0):
         moves += expand_send(ctx, chunk_len(c0), src_off(c0), nxt,
-                             tag=TAG_ANY, compression=compression)
+                             tag=TAG_ANY, compression=compression,
+                             blocking=False)
     for i in range(1, W):
         c = (me + 1 + i) % W  # decreasing-rank flow: see reduce_scatter
         if not chunk_len(c):
@@ -643,9 +672,14 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
     # substituted with the RES flag (the firmware reads dst with the RES
     # compression in its allgather phase, c:1031-1095)
     p2 = res_as_op0(compression)
+    # non-blocking sends throughout phase 2: every dst slot is written
+    # exactly once (own chunk by phase 1, each other chunk by its recv),
+    # so a relay's source is never rewritten and the relay overlaps the
+    # next round's recv — the per-step overlap the pipelined executor
+    # turns into throughput (the serial engine pays send+recv in sequence)
     if chunk_len(me):
         moves += expand_send(ctx, chunk_len(me), dst_off(me), nxt,
-                             tag=TAG_ANY, compression=p2)
+                             tag=TAG_ANY, compression=p2, blocking=False)
     for i in range(1, W):
         c = (me + i) % W  # decreasing-rank flow: chunk me+i arrives at round i
         if not chunk_len(c):
@@ -658,7 +692,7 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
         moves += rx
         if i < W - 1:
             moves += expand_send(ctx, chunk_len(c), slot, nxt, tag=TAG_ANY,
-                                 compression=p2)
+                                 compression=p2, blocking=False)
     return moves
 
 
@@ -689,15 +723,20 @@ def expand_alltoall(ctx: MoveContext, count: int, src: int, dst: int,
     moves: list[Move] = []
     moves += expand_copy(ctx, count, src + me * count * e_src,
                          dst + me * count * e_dst, compression)
-    # round-robin schedule avoiding head-of-line blocking
+    # round-robin schedule avoiding head-of-line blocking. A send may be
+    # non-blocking (overlap its round's recv) only when no LATER recv
+    # writes the chunk index it reads: step s sends chunk (me+s) and step
+    # t recvs chunk (me-t), colliding when t == W-s — an IN-PLACE
+    # alltoall (src aliasing dst) would hand the overlapped send a
+    # rewritten source. The colliding recv is later than the send
+    # exactly when W-s >= s, so the first half of the schedule stays
+    # blocking and the second half overlaps.
     for step in range(1, W):
         to = (me + step) % W
         frm = (me - step) % W
-        sends = expand_send(ctx, count, src + to * count * e_src, to,
-                            tag=TAG_ANY, compression=compression)
-        for m in sends:
-            m.blocking = False
-        moves += sends
+        moves += expand_send(ctx, count, src + to * count * e_src, to,
+                             tag=TAG_ANY, compression=compression,
+                             blocking=(W - step) >= step)
         moves += expand_recv(ctx, count, frm, dst + frm * count * e_dst,
                              tag=TAG_ANY, compression=compression)
     return moves

@@ -56,6 +56,10 @@ class CallRecord:
     #                         "" when the op has no algorithm axis — what
     #                         Tuner.ingest_records keys refinement on
     #                         (concrete names only)
+    # pipelined-executor counters (emu tier; 0 on backends without them):
+    moves: int = 0              # move program length the call expanded to
+    pipelined_moves: int = 0    # moves retired through the in-flight window
+    pipeline_depth: int = 0     # peak window occupancy during the call
 
     @property
     def duration_us(self) -> float:
@@ -127,10 +131,16 @@ class Profiler:
             t0 = time.perf_counter()
 
         def _on_done(error_word: int):
+            # pipeline counters, when the backend published them on the
+            # handle before completing it (device/emu.py _retire)
+            st = getattr(handle, "pipeline_stats", None) or {}
             self.record(CallRecord(
                 op=op, count=count, nbytes=nbytes, comm_id=comm_id,
                 t_start=t0, duration_s=time.perf_counter() - t0,
-                error_word=error_word, algorithm=algorithm))
+                error_word=error_word, algorithm=algorithm,
+                moves=st.get("moves", 0),
+                pipelined_moves=st.get("pipelined", 0),
+                pipeline_depth=st.get("max_inflight", 0)))
 
         handle.add_done_callback(_on_done)
 
@@ -170,18 +180,20 @@ class Profiler:
         reference benchmark writes (bench_*.csv, test/host/test.py:949)."""
         with open(path, "w") as f:
             f.write("op,count,nbytes,comm_id,t_start,duration_us,error,"
-                    "algorithm\n")
+                    "algorithm,moves,pipelined_moves,pipeline_depth\n")
             for r in self.records:
                 f.write(f"{r.op},{r.count},{r.nbytes},{r.comm_id},"
                         f"{r.t_start:.9f},{r.duration_us:.3f},"
-                        f"{r.error_word},{r.algorithm}\n")
+                        f"{r.error_word},{r.algorithm},{r.moves},"
+                        f"{r.pipelined_moves},{r.pipeline_depth}\n")
 
     @staticmethod
     def read_csv(path: str) -> list[CallRecord]:
         """Parse a :meth:`to_csv` dump back into records (export/import
         round trip — e.g. to feed an offline run's history into a
         ``Tuner`` via ``ingest_records``). Dumps from before the
-        ``algorithm`` column read back with it empty."""
+        ``algorithm`` / pipeline-counter columns read back with those
+        fields empty/zero."""
         import csv as _csv
 
         out = []
@@ -194,7 +206,10 @@ class Profiler:
                     t_start=float(row["t_start"]),
                     duration_s=float(row["duration_us"]) * 1e-6,
                     error_word=int(row["error"]),
-                    algorithm=row.get("algorithm") or ""))
+                    algorithm=row.get("algorithm") or "",
+                    moves=int(row.get("moves") or 0),
+                    pipelined_moves=int(row.get("pipelined_moves") or 0),
+                    pipeline_depth=int(row.get("pipeline_depth") or 0)))
         return out
 
 # -- JAX profiler bridges ---------------------------------------------------

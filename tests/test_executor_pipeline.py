@@ -1,0 +1,349 @@
+"""Pipelined move executor: ordering, hazards, error latching, and
+bit-identical differential testing against the serial reference engine.
+
+The in-flight window must be invisible at the semantics level:
+
+  * wire sequence numbers are assigned AND emitted in program order per
+    peer, even when queued sends overlap inline emissions;
+  * ``blocking=True`` barriers hold — a move after a blocking move always
+    observes its retirement (RAW hazards of the allgather/allreduce relay
+    schedules, ccl_offload_control.c:788-791);
+  * a failed in-flight move latches its error, aborts the rest of the
+    program, and the word surfaces in the returned error (the firmware's
+    setjmp unwind to finalize_call);
+  * every collective expansion produces bit-identical buffers through the
+    pipelined engine and through ``execute_serial`` — the property corpus
+    of test_move_properties.py re-run as an execution differential.
+"""
+
+import itertools
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu.arith import ArithConfig
+from accl_tpu.communicator import Communicator, Rank
+from accl_tpu.constants import (ACCLError, CCLOp, CollectiveAlgorithm,
+                                ErrorCode, ReduceFunc, TAG_ANY)
+from accl_tpu.emulator.executor import (DeviceMemory, MoveExecutor,
+                                        RxBufferPool)
+from accl_tpu.emulator.fabric import Envelope, LocalFabric
+from accl_tpu.moveengine import (Move, MoveContext, Operand, expand_call,
+                                 expand_send)
+from accl_tpu.testing import emu_world, run_ranks
+
+from test_move_properties import ALGS, POINT_TO_POINT, build_world
+
+F32 = ArithConfig(np.dtype(np.float32), np.dtype(np.float16))
+
+
+def _comm(world=2, me=0):
+    return Communicator(ranks=[Rank(global_rank=r) for r in range(world)],
+                        local_rank=me)
+
+
+def _executor(send_fn, window=4, nbufs=8, bufsize=1 << 16):
+    mem = DeviceMemory()
+    pool = RxBufferPool(nbufs, bufsize)
+    ex = MoveExecutor(mem, pool, send_fn, timeout=2.0, window=window)
+    return ex, mem, pool
+
+
+def _ctx(world, me, seg=1 << 20):
+    return MoveContext(world_size=world, local_rank=me, arithcfg=F32,
+                       max_segment_size=seg)
+
+
+# -- emission ordering across the window ------------------------------------
+
+def test_seqn_assigned_and_emitted_in_program_order():
+    """Non-blocking sends ride the window; a blocking send trails them
+    inline. Per-peer seqns and the wire order must both match program
+    order even when the first queued send is artificially slow."""
+    sent = []
+    first = threading.Event()
+
+    def slow_send(env, payload):
+        if not first.is_set():
+            first.set()
+            time.sleep(0.05)  # let the inline move catch up if it could
+        sent.append((env.dst, env.seqn, bytes(memoryview(payload))[0]))
+
+    ex, mem, _ = _executor(slow_send)
+    comm = _comm(2, 0)
+    buf = np.arange(40, dtype=np.float32)
+    mem.register(0x1000, buf)
+    ctx = _ctx(2, 0, seg=32)  # 8 elems/segment -> 5 segment moves
+    moves = expand_send(ctx, 40, 0x1000, 1, tag=TAG_ANY, blocking=False)
+    # trailing blocking send of the first segment: must drain the window
+    # before taking (and emitting) the NEXT seqn
+    moves += expand_send(ctx, 8, 0x1000, 1, tag=TAG_ANY, blocking=True)
+    assert ex.execute(moves, F32, comm) == 0
+    assert [s[1] for s in sent] == list(range(6))
+    ex.close()
+
+
+def test_window_respects_blocking_barrier_data():
+    """A blocking recv's write must be visible to the relay that follows
+    it through the window (allgather's RAW hazard, c:788-791) — end to
+    end on a 4-rank in-process world."""
+    accls = emu_world(4)
+    n = 1 << 12
+
+    def body(a):
+        src = a.buffer(data=np.full(n, float(a.rank + 1), np.float32))
+        dst = a.buffer((4 * n,), np.float32)
+        a.allgather(src, dst, n, algorithm=CollectiveAlgorithm.RING)
+        return dst.data.copy()
+
+    for out in run_ranks(accls, body):
+        for r in range(4):
+            assert np.all(out[r * n:(r + 1) * n] == r + 1)
+    for a in accls:
+        a.deinit()
+
+
+def test_per_peer_seqn_order_survives_overlapped_sends():
+    """Segmented broadcast: the root's sends to every peer are
+    non-blocking and overlap in the window; each receiver must still
+    match its segments in seqn order and reassemble the exact payload."""
+    accls = emu_world(3, max_segment_size=256)
+    n = 1 << 10  # 4 KiB -> 16 segments per peer
+
+    def body(a):
+        data = (np.arange(n, dtype=np.float32) if a.rank == 1
+                else np.zeros(n, np.float32))
+        buf = a.buffer(data=data)
+        a.bcast(buf, n, root=1)
+        return buf.data.copy()
+
+    for out in run_ranks(accls, body):
+        assert np.array_equal(out, np.arange(n, dtype=np.float32))
+    for a in accls:
+        a.deinit()
+
+
+# -- error latching ----------------------------------------------------------
+
+def test_midwindow_fault_latches_and_aborts():
+    """A queued move that faults (unregistered source region) latches its
+    error; the program aborts and the word surfaces in the returned
+    error, with moves after the failure skipped."""
+    sent = []
+    ex, mem, _ = _executor(lambda env, p: sent.append(env.seqn))
+    comm = _comm(2, 0)
+    mem.register(0x1000, np.ones(8, np.float32))
+    bad = Move(count=8, op0=Operand.imm(0xDEAD0000), res_remote=True,
+               dst_rank=1, tag=TAG_ANY, blocking=False)
+    # enough trailing non-blocking sends that some are still unissued
+    # when the fault latches (window depth 4)
+    tail = [Move(count=8, op0=Operand.imm(0x1000), res_remote=True,
+                 dst_rank=1, tag=TAG_ANY, blocking=False)
+            for _ in range(32)]
+    err = ex.execute([bad] + tail, F32, comm)
+    assert err & int(ErrorCode.INVALID_CALL)
+    assert len(sent) < 32  # the latch stopped issue before the tail ended
+    # the latch is consumed with the program: a fresh program runs clean
+    assert ex.execute(tail[:1], F32, comm) == 0
+    ex.close()
+
+
+def test_wire_fault_mid_window_aborts_program():
+    """LocalFabric fault injection: dropping one phase-2 relay of a ring
+    allreduce starves the downstream recv — the error aborts the program
+    and surfaces as RECEIVE_TIMEOUT on the caller."""
+    accls = emu_world(3, timeout=0.6)
+    fabric = accls[0].device.ctx.fabric
+    dropped = []
+
+    def fault(env, payload):
+        # drop exactly one non-kickoff message (a mid-program relay)
+        if not dropped and env.seqn >= 2:
+            dropped.append(env.seqn)
+            return "drop"
+        return "deliver"
+
+    fabric.inject_fault(fault)
+    n = 64
+
+    def body(a):
+        src = a.buffer(data=np.ones(n, np.float32))
+        dst = a.buffer((n,), np.float32)
+        try:
+            a.allreduce(src, dst, n,
+                        algorithm=CollectiveAlgorithm.FUSED_RING)
+            return 0
+        except ACCLError as exc:
+            return exc.error_word
+
+    errs = run_ranks(accls, body, timeout=30.0)
+    assert dropped, "fault hook never fired"
+    assert any(e & int(ErrorCode.RECEIVE_TIMEOUT_ERROR) for e in errs)
+    fabric.clear_fault()
+    for a in accls:
+        a.soft_reset()
+    for a in accls:
+        a.deinit()
+
+
+def test_latched_ingress_error_reaches_caller_error_word():
+    """try_ingest latches DMA_SIZE_ERROR for an oversize payload and
+    reports it consumed; the starved recv's error word must carry the
+    latched word, not just a bare timeout."""
+    ex, mem, pool = _executor(lambda env, p: None, bufsize=64)
+    comm = _comm(2, 0)
+    mem.register(0x1000, np.zeros(64, np.float32))
+    env = Envelope(src=1, dst=0, tag=TAG_ANY, seqn=0, nbytes=256,
+                   wire_dtype="float32")
+    assert pool.try_ingest(env, b"\x00" * 256) is True  # consumed (dropped)
+    ex.timeout = 0.2
+    recv = Move(count=64, op1=Operand.on_recv(1, TAG_ANY),
+                res=Operand.imm(0x1000), res_local=True)
+    err = ex.execute([recv], F32, comm)
+    assert err & int(ErrorCode.DMA_SIZE_ERROR)
+    assert err & int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+    ex.close()
+
+
+# -- differential: pipelined vs serial reference engine ----------------------
+
+def _run_differential(op, W, count, c0, c1, cr, eth, seg_bytes, c_bytes,
+                      root, alg):
+    """Execute one property-corpus configuration through real executors on
+    a LocalFabric, once serial and once pipelined; return the raw bytes of
+    every rank's memory regions for comparison."""
+    states = build_world(op, W, count, c0, c1, cr, eth, seg_bytes, c_bytes,
+                         root, alg)
+    cfg = ArithConfig(np.dtype(np.float32),
+                      np.dtype(np.float16 if c_bytes == 2 else np.int8))
+    rng = np.random.default_rng(0xD1FF)
+    seed_bytes = {}  # (rank, addr) -> initial region contents
+
+    outcomes = []
+    for window in (0, 4):
+        fabric = LocalFabric(W)
+        execs, mems = [], []
+        for st in states:
+            mem = DeviceMemory()
+            pool = RxBufferPool(16, 1 << 20)
+            ex = MoveExecutor(mem, pool, fabric.send, timeout=10.0,
+                              window=window)
+            rank = st.rank
+            fabric.attach(rank, lambda env, p, pool=pool:
+                          pool.ingest(env, p))
+            for addr, nbytes in st.regions:
+                key = (rank, addr)
+                if key not in seed_bytes:
+                    seed_bytes[key] = rng.integers(
+                        0, 128, nbytes, dtype=np.uint8)  # finite in fp16
+                mem.register(addr, seed_bytes[key].copy())
+            execs.append(ex)
+            mems.append(mem)
+        comms = [Communicator(ranks=[Rank(global_rank=r) for r in range(W)],
+                              local_rank=me) for me in range(W)]
+        errs = [None] * W
+
+        def run(i):
+            errs[i] = execs[i].execute(states[i].moves, cfg, comms[i])
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(W)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert errs == [0] * W, f"window={window} errs={errs}"
+        snapshot = []
+        for st, mem in zip(states, mems):
+            for addr, nbytes in st.regions:
+                data = mem.read(addr, nbytes, np.dtype(np.uint8))
+                snapshot.append((st.rank, addr, data.tobytes()))
+        for ex in execs:
+            ex.close()
+        outcomes.append(snapshot)
+    return outcomes
+
+
+@pytest.mark.filterwarnings("ignore::RuntimeWarning")
+def test_bit_identical_to_serial_every_collective():
+    """Exhaustive flag corners at W=3 for every (op, algorithm): serial
+    and pipelined executors must leave bit-identical memory."""
+    for op in sorted(ALGS, key=lambda o: o.value):
+        if op in POINT_TO_POINT:
+            continue  # single-rank ops have no wire to pipeline
+        for alg in ALGS[op]:
+            for c0, cr, eth in ((False, False, False), (True, True, True),
+                                (False, True, False)):
+                serial, piped = _run_differential(
+                    op, 3, 7, c0, c0, cr, eth, seg_bytes=1 << 20,
+                    c_bytes=2, root=1, alg=alg)
+                assert serial == piped, (op, alg, c0, cr, eth)
+
+
+@pytest.mark.filterwarnings("ignore::RuntimeWarning")
+def test_bit_identical_seeded_random_corpus():
+    """The seeded random slice of the test_move_properties corpus, run as
+    an execution differential (segmentation, tails, fp8-width wire)."""
+    rng = random.Random(0xACC1)
+    ops = [op for op in ALGS if op not in POINT_TO_POINT]
+    done = 0
+    while done < 20:
+        op = rng.choice(ops)
+        W = rng.randint(2, 5)
+        count = rng.randint(1, 33)
+        c_bytes = rng.choice((1, 2))
+        seg_bytes = rng.choice((8, 64, 1 << 20))
+        root = rng.randrange(W)
+        alg = rng.choice(ALGS[op])
+        c0, c1, cr, eth = (rng.random() < 0.5 for _ in range(4))
+        serial, piped = _run_differential(op, W, count, c0, c1, cr, eth,
+                                          seg_bytes, c_bytes, root, alg)
+        assert serial == piped, (op, W, count, c0, c1, cr, eth, seg_bytes,
+                                 c_bytes, root, alg)
+        done += 1
+
+
+# -- plumbing ----------------------------------------------------------------
+
+def test_pipeline_counters_reach_call_records():
+    """The profiler's CallRecord carries the executor's window counters
+    (moves expanded, moves pipelined, peak window depth)."""
+    accls = emu_world(4)
+
+    def body(a):
+        a.start_profiling()
+        src = a.buffer(data=np.ones(1 << 10, np.float32))
+        dst = a.buffer((1 << 10,), np.float32)
+        a.allreduce(src, dst, 1 << 10,
+                    algorithm=CollectiveAlgorithm.FUSED_RING)
+        a.end_profiling()
+        return a.profiler.records
+
+    recs = run_ranks(accls, body)
+    for rank_recs in recs:
+        (r,) = [x for x in rank_recs if x.op == "allreduce"]
+        assert r.moves > 0
+        assert r.pipelined_moves >= 1      # the phase-1/2 kickoff sends
+        assert r.pipeline_depth >= 1
+    for a in accls:
+        a.deinit()
+
+
+def test_serial_mode_env_and_param():
+    """window=0 (the serial reference engine) stays available for
+    debugging/differential runs and produces correct collectives."""
+    accls = emu_world(2, pipeline_window=0)
+
+    def body(a):
+        src = a.buffer(data=np.full(32, float(a.rank + 1), np.float32))
+        dst = a.buffer((32,), np.float32)
+        a.allreduce(src, dst, 32)
+        return float(dst.data[0])
+
+    assert run_ranks(accls, body) == [3.0, 3.0]
+    for a in accls:
+        assert a.device.executor.last_stats["pipelined"] == 0
+        a.deinit()
