@@ -6,8 +6,9 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 all: lint native   ## default flow: syntax gate first, then the native build
 
-lint:              ## fast syntax gate over every python tree
+lint:              ## fast syntax gate + blocking/lane invariant lint
 	$(PY) -m compileall -q accl_tpu benchmarks tests
+	$(PY) scripts/check_blocking.py
 
 native:            ## build the C++ rank daemon + host driver demo
 	$(MAKE) -C native
@@ -26,8 +27,8 @@ tune:              ## emulator-tier algorithm sweep -> bench_out/tuning.json
 bench:             ## headline JSON line (real chip when the tunnel is up)
 	$(PY) bench.py
 
-bench-emu:         ## emulator-tier headline (<60s): pipelined-vs-serial executor microbench via the bench.py fallback path
-	ACCL_BENCH_TIER=emu JAX_PLATFORMS=cpu $(PY) bench.py
+bench-emu:         ## emulator-tier headline (<90s): serial/window/segment-streamed executor ladder; asserts streamed ≥1.2x over the send-only window
+	ACCL_BENCH_TIER=emu ACCL_BENCH_MIN_STREAM_RATIO=1.2 JAX_PLATFORMS=cpu $(PY) bench.py
 
 dryrun:            ## multi-chip sharding dryrun on 8 virtual devices
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

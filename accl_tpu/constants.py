@@ -262,4 +262,11 @@ DEFAULT_TIMEOUT_S = 30.0
 # disables pipelining (strict serial retirement). Overridable per process
 # via $ACCL_TPU_PIPELINE_WINDOW.
 DEFAULT_PIPELINE_WINDOW = 8
+# Ceiling on the segment-streamed executor's EXTRA combine workers when
+# auto-sizing from cpu count: min(cap, max(0, cpus - 2)) — the scheduler
+# thread executes ready moves itself, so the pool adds lanes only when
+# cores exist beyond it. Override the pool size directly via
+# $ACCL_TPU_COMBINE_WORKERS; $ACCL_TPU_SEGMENT_STREAM=0 falls back to
+# the send-only window engine.
+DEFAULT_COMBINE_WORKERS_CAP = 4
 TAG_ANY = 0xFFFFFFFF                        # reference uses tag=ANY sentinel

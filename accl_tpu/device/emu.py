@@ -33,15 +33,19 @@ class EmuContext:
     """Shared state of an N-rank in-process emulation: the fabric.
 
     ``pipeline_window`` sets each rank's executor in-flight window depth
-    (None = the process default, 0 = strict serial reference engine)."""
+    (None = the process default, 0 = strict serial reference engine);
+    ``segment_stream`` selects the dependency-aware segment pipeline vs
+    the send-only window (None = the process default, on)."""
 
     def __init__(self, world_size: int, nbufs: int = DEFAULT_RX_BUFFER_COUNT,
                  bufsize: int = DEFAULT_RX_BUFFER_SIZE,
-                 pipeline_window: int | None = None):
+                 pipeline_window: int | None = None,
+                 segment_stream: bool | None = None):
         self.world_size = world_size
         self.fabric = LocalFabric(world_size)
         self.nbufs, self.bufsize = nbufs, bufsize
         self.pipeline_window = pipeline_window
+        self.segment_stream = segment_stream
         self.devices: list[EmuDevice | None] = [None] * world_size
 
     def device(self, rank: int) -> "EmuDevice":
@@ -65,7 +69,8 @@ class EmuDevice(Device):
         self.executor = MoveExecutor(self.mem, self.pool,
                                      send_fn=ctx.fabric.send,
                                      timeout=DEFAULT_TIMEOUT_S,
-                                     window=ctx.pipeline_window)
+                                     window=ctx.pipeline_window,
+                                     segment_stream=ctx.segment_stream)
         self.timeout = DEFAULT_TIMEOUT_S
         self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
         self.profiling = False  # armed by the start_profiling config call
@@ -146,10 +151,18 @@ class EmuDevice(Device):
     def topology(self):
         """In-process loopback tier: a hop is a couple of thread handoffs
         plus pool matching (tens of microseconds), bandwidth is memcpy
-        through the fabric queues."""
+        through the fabric queues. ``pipeline_depth`` advertises the
+        executor's segment-streaming overlap (combine-worker pool) so the
+        tuner's segment sizing can use the overlap-aware effective beta;
+        a serial/window executor reports 1 (store-and-forward sizing)."""
         from ..tuner.cost import Topology
+        ex = self.executor
+        # +1: the scheduler thread executes ready moves itself, so even a
+        # zero-extra-worker pool overlaps one combine with recv-matching
+        depth = (float(ex._n_workers + 1)
+                 if ex.window > 0 and ex.segment_stream else 1.0)
         return Topology(world_size=self.ctx.world_size, alpha_us=20.0,
-                        beta_gbps=4.0, tier="emu")
+                        beta_gbps=4.0, tier="emu", pipeline_depth=depth)
 
     def push_stream(self, data):
         self.executor.push_stream(data)

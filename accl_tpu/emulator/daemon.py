@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import socket
 import struct
 import threading
@@ -73,7 +74,17 @@ def _env_from_eth_frame(frame: bytes) -> tuple[Envelope, bytes]:
 
 class EthFabric:
     """Daemon-to-daemon transport: one TCP connection per peer, lazily
-    dialed; an accept loop ingests inbound frames."""
+    dialed; an accept loop ingests inbound frames.
+
+    Emission is scatter-gather: header and payload leave in one
+    ``sendmsg`` iovec (``protocol.send_frame_parts``) so a zero-copy
+    payload view from the executor is never concatenated into a fresh
+    frame buffer. ``$ACCL_TPU_COALESCE_BYTES`` > 0 additionally arms
+    small-segment coalescing: frames below the watermark buffer per peer
+    and flush as one write when the buffered bytes cross the watermark or
+    the executor's egress runs dry (``MoveExecutor.flush_fn``) — the
+    segment-streamed pipeline's answer to tiny-segment syscall storms.
+    """
 
     def __init__(self, my_global_rank: int, eth_port: int, ingest_fn):
         self.me = my_global_rank
@@ -84,6 +95,9 @@ class EthFabric:
         self._peer_addrs: dict[int, tuple[str, int]] = {}
         self._inbound: list[socket.socket] = []  # accepted eth connections
         self._lock = threading.Lock()  # guards dial/lookup/inbound only
+        self.coalesce = int(os.environ.get("ACCL_TPU_COALESCE_BYTES", "0"))
+        self._txbuf: dict[int, list] = {}  # dst -> [nbytes, parts...]
+        self.stats = {"sg_sends": 0, "coalesced_frames": 0, "flushes": 0}
         self._server = socket.create_server(("0.0.0.0", eth_port))
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
@@ -127,21 +141,63 @@ class EthFabric:
                     self._inbound.remove(conn)
             conn.close()
 
-    def send(self, env: Envelope, payload: bytes):
+    def _peer(self, dst: int) -> tuple[socket.socket, threading.Lock]:
         with self._lock:
-            entry = self._peers.get(env.dst)
+            entry = self._peers.get(dst)
             if entry is None:
-                host, port = self._peer_addrs[env.dst]
+                host, port = self._peer_addrs[dst]
                 sock = socket.create_connection((host, port), timeout=10)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 entry = (sock, threading.Lock())
-                self._peers[env.dst] = entry
-        sock, peer_lock = entry
-        frame = P.pack_eth(env.src, env.dst, env.tag, env.seqn,
-                           env.comm_id, env.strm,
-                           P.dtype_code(env.wire_dtype), payload)
+                self._peers[dst] = entry
+        return entry
+
+    def send(self, env: Envelope, payload: bytes):
+        sock, peer_lock = self._peer(env.dst)
+        nbytes = P.payload_nbytes(payload)
+        hdr = P.pack_eth_header(env.src, env.dst, env.tag, env.seqn,
+                                env.comm_id, env.strm,
+                                P.dtype_code(env.wire_dtype), nbytes)
         with peer_lock:
-            P.send_frame(sock, frame)
+            if self.coalesce and len(hdr) + nbytes < self.coalesce:
+                # watermark coalescing: length-prefix each frame (frames
+                # are self-delimiting on the stream) and buffer. Payload
+                # views must be snapshotted — the send() contract is
+                # "serialized before return", and the executor reuses
+                # arena scratch the moment send() comes back.
+                buf = self._txbuf.setdefault(env.dst, [0])
+                buf.append(struct.pack("<I", len(hdr) + nbytes))
+                buf.append(hdr)
+                buf.append(bytes(payload))
+                buf[0] += 4 + len(hdr) + nbytes
+                self.stats["coalesced_frames"] += 1
+                if buf[0] >= self.coalesce:
+                    self._flush_locked(sock, env.dst)
+                return
+            self._flush_locked(sock, env.dst)  # keep wire order
+            self.stats["sg_sends"] += 1
+            P.send_frame_parts(sock, (hdr, payload))
+
+    def _flush_locked(self, sock: socket.socket, dst: int):
+        """Caller holds the peer lock. The buffered parts are already
+        copies (snapshotted at coalesce time), so one join + sendall is
+        the simple, short-write- and IOV_MAX-proof flush — the syscall
+        batching was the point, not avoiding this bounded copy."""
+        buf = self._txbuf.get(dst)
+        if not buf or buf[0] == 0:
+            return
+        self.stats["flushes"] += 1
+        sock.sendall(b"".join(buf[1:]))
+        del self._txbuf[dst]
+
+    def flush(self, dst: int):
+        """Push any coalesced frames for ``dst`` onto the wire (the
+        executor's egress calls this when its reorder stage runs dry)."""
+        if not self.coalesce or dst not in self._txbuf:
+            return  # plain membership probe: GIL-atomic, no dial needed
+        sock, peer_lock = self._peer(dst)
+        with peer_lock:
+            self._flush_locked(sock, dst)
 
     @property
     def listening(self) -> bool:
@@ -232,7 +288,8 @@ class UdpEthFabric:
     QUEUE_DEPTH = 64        # per-sender delivery bound; beyond it messages
     # are DROPPED (UDP semantics): TCP's flow control does not exist here,
     # and an unbounded queue would grow without limit while the rx pool is
-    # full. Drops surface as receive timeouts upstream.
+    # full. Drops are counted in ``stats["dropped_queue_full"]`` and
+    # surface as receive timeouts upstream.
 
     def __init__(self, my_global_rank: int, eth_port: int, ingest_fn):
         import time as _t
@@ -250,6 +307,11 @@ class UdpEthFabric:
         self._partial: dict = {}
         self._queues: dict = {}  # sender -> delivery Queue (lazy workers)
         self._closing = False
+        # observable health of the lossy transport: a slow consumer shows
+        # up here (bounded-queue drops) instead of as silent unbounded
+        # memory growth
+        self.stats = {"sent": 0, "delivered": 0, "dropped_queue_full": 0,
+                      "gc_partials": 0}
         threading.Thread(target=self._recv_loop, daemon=True).start()
 
     def learn_peers(self, ranks: list[tuple[int, str, int]], world: int):
@@ -259,18 +321,38 @@ class UdpEthFabric:
                     self._peer_addrs[grank] = (host, port + world)
 
     def send(self, env: Envelope, payload: bytes):
-        frame = P.pack_eth(env.src, env.dst, env.tag, env.seqn,
-                           env.comm_id, env.strm,
-                           P.dtype_code(env.wire_dtype), payload)[1:]
+        nbytes = P.payload_nbytes(payload)
+        # scatter-gather packetization: the eth header and (memoryview
+        # slices of) the payload ride each datagram's sendmsg iovec — the
+        # old path concatenated header+payload AND re-sliced the result,
+        # two full copies per message
+        eth_hdr = memoryview(P.pack_eth_header(
+            env.src, env.dst, env.tag, env.seqn, env.comm_id, env.strm,
+            P.dtype_code(env.wire_dtype), nbytes))[1:]
+        pv = memoryview(payload).cast("B")
         with self._lock:
             addr = self._peer_addrs[env.dst]
             msg_id = self._msg_id
             self._msg_id += 1
-        n_frags = max(1, -(-len(frame) // self.MAX_PKT))
+        total = len(eth_hdr) + nbytes
+        n_frags = max(1, -(-total // self.MAX_PKT))
+        sendmsg = getattr(self._sock, "sendmsg", None)  # test stubs may
+        # expose only the classic sendto interface
         for idx in range(n_frags):
-            chunk = frame[idx * self.MAX_PKT:(idx + 1) * self.MAX_PKT]
-            hdr = struct.pack(self._FRAG_FMT, self.me, msg_id, idx, n_frags)
-            self._sock.sendto(hdr + chunk, addr)
+            start = idx * self.MAX_PKT
+            end = min(total, start + self.MAX_PKT)
+            parts = [struct.pack(self._FRAG_FMT, self.me, msg_id, idx,
+                                 n_frags)]
+            if start < len(eth_hdr):
+                parts.append(eth_hdr[start:min(end, len(eth_hdr))])
+            if end > len(eth_hdr):
+                parts.append(pv[max(0, start - len(eth_hdr)):
+                                end - len(eth_hdr)])
+            if sendmsg is not None:
+                sendmsg(parts, [], 0, addr)
+            else:
+                self._sock.sendto(b"".join(parts), addr)
+        self.stats["sent"] += 1
 
     def _recv_loop(self):
         hdr_len = struct.calcsize(self._FRAG_FMT)
@@ -311,11 +393,15 @@ class UdpEthFabric:
                 try:
                     q.put_nowait((env, payload))
                 except _queue.Full:
-                    pass  # bounded queue: drop (UDP semantics)
+                    # bounded queue: drop (UDP semantics) — but COUNT it,
+                    # so a slow consumer is diagnosable from stats
+                    # instead of only from downstream recv timeouts
+                    self.stats["dropped_queue_full"] += 1
         # GC stale partials (lost fragments must not leak memory)
         stale = [k for k, e in self._partial.items() if e[0] < now]
         for k in stale:
             del self._partial[k]
+        self.stats["gc_partials"] += len(stale)
 
     def _deliver_q(self, sender: int):
         with self._lock:
@@ -333,6 +419,7 @@ class UdpEthFabric:
                         if item is None:
                             return
                         self.ingest(*item)
+                        self.stats["delivered"] += 1
 
                 threading.Thread(target=drain, daemon=True).start()
         return q
@@ -414,6 +501,12 @@ class RankDaemon:
         # send() returns, so emission may hand over zero-copy views of
         # device memory instead of paying the tobytes() copy
         self.executor.tx_serializes = True
+        self._wire_flush()
+        # eager-ingress rejection log rate limiter: src -> [window_start,
+        # suppressed-in-window] — a starved rx pool rejects every message
+        # of a big collective; one line per second per peer keeps stderr
+        # readable while still reporting the total
+        self._rej_log: dict[int, list] = {}
         # runtime config-call state (ACCL_CONFIG parity, c:1240-1283):
         # pkt engines default-armed so a daemon is usable without the
         # driver's bring-up sequence; profiling counters are in-daemon,
@@ -451,6 +544,15 @@ class RankDaemon:
         self._stop = threading.Event()
         threading.Thread(target=self._call_worker, daemon=True).start()
 
+    def _wire_flush(self):
+        """Hand the executor's egress the fabric's coalescing flush hook
+        (TCP fabric with $ACCL_TPU_COALESCE_BYTES armed; None otherwise,
+        and on the UDP stack, which has nothing to coalesce)."""
+        flush = getattr(self.eth, "flush", None)
+        self.executor.flush_fn = (flush if flush is not None
+                                  and getattr(self.eth, "coalesce", 0)
+                                  else None)
+
     # -- ingress -----------------------------------------------------------
     def _ingest(self, env: Envelope, payload: bytes):
         if env.strm:
@@ -461,13 +563,24 @@ class RankDaemon:
             # eager-ingress rejection is otherwise invisible until some
             # recv times out much later — say WHICH message died and why
             # (the latched word also rides into that recv's error word,
-            # RxBufferPool.consume_error)
+            # RxBufferPool.consume_error). Rate-limited to one line per
+            # second per peer: a starved pool rejects EVERY segment of a
+            # collective, and an unthrottled log would flood stderr
+            # faster than the failure it reports.
+            now = time.monotonic()
+            ent = self._rej_log.setdefault(env.src, [-1e9, 0])
+            if now - ent[0] < 1.0:
+                ent[1] += 1
+                return
+            suppressed, ent[0], ent[1] = ent[1], now, 0
             log.warning(
                 "rank %d eager ingress: rejected message from rank %d "
-                "(tag=%d seqn=%d comm=%d, %d B): %s", self.rank, env.src,
-                env.tag, env.seqn, env.comm_id, len(payload),
+                "(tag=%d seqn=%d comm=%d, %d B): %s%s", self.rank, env.src,
+                env.tag, env.seqn, env.comm_id, P.payload_nbytes(payload),
                 " | ".join(e.name for e in ErrorCode
-                           if e.value and err & e.value) or hex(err))
+                           if e.value and err & e.value) or hex(err),
+                f" (+{suppressed} more in the last second)"
+                if suppressed else "")
 
     # -- call execution ----------------------------------------------------
     def _call_worker(self):
@@ -672,6 +785,7 @@ class RankDaemon:
         self.eth = fab
         self.stack = kind
         self.executor._send = self.eth.send
+        self._wire_flush()  # coalescing hook follows the fabric swap
         for comm in self.comms.values():
             self.eth.learn_peers(
                 [(r.global_rank, r.host, r.port) for r in comm.ranks],

@@ -19,11 +19,22 @@ This is the emulator-tier equivalent of the reference's dataplane:
   (memory / rx-match / stream), elementwise combine, local write and/or
   remote send with wire compression (reference: dma_mover 11-stage pipeline,
   dma_mover.cpp:716-898, plus reduce_sum / stream_conv plugin kernels).
-  Like the reference pipeline it keeps multiple moves in flight: moves
-  marked ``blocking=False`` are handed to a bounded in-flight window
-  drained by a worker thread, so a ring step's send overlaps the next
-  step's recv-match and combine. ``execute_serial`` retains the strict
-  one-move-at-a-time engine as the reference/differential-testing path.
+  Like the reference pipeline it keeps multiple moves in flight. Three
+  engines share the single-move core:
+
+  - ``execute_serial`` — strict one-move-at-a-time retirement; the
+    reference/differential-testing oracle.
+  - ``execute_window`` — the send-only in-flight window: non-blocking
+    pure sends retire through a FIFO worker (the PR-2 engine, kept as the
+    before-side of the segment-streaming benchmark).
+  - ``execute_streamed`` — the dependency-aware segment pipeline
+    (default): ``Move.lane`` tags partition the program into per-segment
+    dependency chains; recv-match, combine and relay of *different*
+    segments run concurrently on a small combine-worker pool
+    (``$ACCL_TPU_COMBINE_WORKERS``), with wire sequence numbers
+    pre-assigned in program order and a per-peer egress reorder stage
+    keeping emission order exact. Combine scratch comes from a
+    preallocated per-executor arena instead of per-segment allocations.
 """
 
 from __future__ import annotations
@@ -38,7 +49,8 @@ import numpy as np
 
 from ..arith import ArithConfig
 from ..communicator import Communicator
-from ..constants import (DEFAULT_PIPELINE_WINDOW, ErrorCode, ReduceFunc,
+from ..constants import (DEFAULT_COMBINE_WORKERS_CAP,
+                         DEFAULT_PIPELINE_WINDOW, ErrorCode, ReduceFunc,
                          TAG_ANY)
 from ..moveengine import Move, MoveMode, Operand
 from .fabric import Envelope
@@ -148,6 +160,11 @@ class RxBufferPool:
         self.error_word = 0
         self._idle: list[RxBuffer] = list(self.bufs)
         self._by_key: dict[tuple[int, int, int], list[RxBuffer]] = {}
+        # arrival listener (segment-streamed executor): called with the
+        # (src, comm_id, seqn) key AFTER a successful claim, outside the
+        # pool lock — the executor promotes the matching waiting move to
+        # its ready queue instead of parking a thread in seek()
+        self.on_ingest = None
 
     def _claim(self, env: Envelope, payload, keep: int) -> bool:
         """Claim an IDLE buffer, leaving at least ``keep`` spares; caller
@@ -179,13 +196,17 @@ class RxBufferPool:
                 return int(ErrorCode.DMA_SIZE_ERROR)
             while True:
                 if self._claim(env, payload, keep=0):
-                    return 0
+                    err = 0
+                    break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cv.wait(remaining):
                     self.error_word |= int(
                         ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
                     return int(
                         ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+        if self.on_ingest is not None:
+            self.on_ingest((env.src, env.comm_id, env.seqn))
+        return err
 
     def try_ingest(self, env: Envelope, payload) -> bool:
         """Non-blocking ingest: True if a spare buffer took the message,
@@ -198,7 +219,10 @@ class RxBufferPool:
             if payload_nbytes(payload) > self.bufsize:
                 self.error_word |= int(ErrorCode.DMA_SIZE_ERROR)
                 return True  # consumed (dropped) — retrying cannot help
-            return self._claim(env, payload, keep=1)
+            claimed = self._claim(env, payload, keep=1)
+        if claimed and self.on_ingest is not None:
+            self.on_ingest((env.src, env.comm_id, env.seqn))
+        return claimed
 
     def consume_error(self) -> int:
         """Return and clear the latched ingress error word — the bridge
@@ -215,6 +239,26 @@ class RxBufferPool:
             if tag == TAG_ANY or e.tag == tag or e.tag == TAG_ANY:
                 return b
         return None
+
+    def has_match(self, src: int, tag: int, seqn: int,
+                  comm_id: int = 0) -> bool:
+        """Non-blocking probe: would ``seek`` with these arguments return
+        immediately? (Segment-streamed readiness gate — a move waits in
+        the executor's scheduler, not in a thread parked here.)
+
+        Deliberately LOCK-FREE: a dict probe plus candidate-list scan is
+        a consistent snapshot under the GIL, and the planner owns this
+        (src, comm_id, seqn) exclusively — no other consumer can claim
+        it between the probe and the seek. A false negative (message
+        claimed mid-probe: impossible; message arriving mid-probe:
+        caught by the arrival listener) never loses a wakeup, so the
+        scheduler's per-segment gate costs no pool-lock round-trip."""
+        for b in self._by_key.get((src, comm_id, seqn), ()):
+            e = b.env
+            if e is not None and (tag == TAG_ANY or e.tag == tag
+                                  or e.tag == TAG_ANY):
+                return True
+        return False
 
     def seek(self, src: int, tag: int, seqn: int, timeout: float,
              comm_id: int = 0) -> tuple[Envelope, bytes] | None:
@@ -266,6 +310,103 @@ _REDUCERS = {
     ReduceFunc.PROD: np.multiply,
 }
 
+# one template for every engine's per-execute counters: an engine that
+# forgets a key would otherwise silently report 0 through CallRecord
+_EMPTY_STATS = {"moves": 0, "pipelined": 0, "max_inflight": 0,
+                "lanes": 0, "combine_overlap": 0}
+
+
+class _ScratchArena:
+    """Reusable combine-scratch buffers for the worker pool.
+
+    A streamed collective combines one segment per fused move; allocating
+    a fresh result array per segment costs a malloc + first-touch page
+    faults per combine. The arena keeps a small set of uint8 buffers
+    (bounded by ``slots``) that cycle through acquire/release; a slot is
+    held until its payload has actually left through the fabric (the
+    egress stage releases it), so reuse can never race a pending frame.
+    ``acquire`` returns None when every slot is busy or too small — the
+    caller then falls back to a plain allocation, so the arena is purely
+    an optimization, never a correctness dependency.
+    """
+
+    def __init__(self, slots: int):
+        self._lock = threading.Lock()
+        self._free: list[np.ndarray] = []
+        self._slots = slots
+        self._total = 0
+
+    def acquire(self, nbytes: int) -> np.ndarray | None:
+        with self._lock:
+            for i, buf in enumerate(self._free):
+                if buf.nbytes >= nbytes:
+                    return self._free.pop(i)
+            if self._total >= self._slots:
+                # drop one undersized free buffer so the arena can adapt
+                # when segment sizes grow mid-process
+                if self._free:
+                    self._free.pop(0)
+                    self._total -= 1
+                else:
+                    return None
+            self._total += 1
+        return np.empty(max(nbytes, 4096), np.uint8)
+
+    def release(self, buf: np.ndarray):
+        with self._lock:
+            self._free.append(buf)
+
+
+# _MovePlan.state lifecycle (segment-streamed engine)
+_ST_PENDING, _ST_WAITING, _ST_READY, _ST_RUNNING = 0, 1, 2, 3
+_ST_RETIRED, _ST_CANCELLED = 4, 5
+
+
+class _MovePlan:
+    """Per-move execution plan: pre-assigned wire sequence numbers plus
+    the dependency edge the streamed scheduler honors."""
+
+    __slots__ = ("idx", "mv", "eligible", "dep", "succ", "rx0", "rx1",
+                 "tx", "rx_keys", "state", "deadline", "fuse", "fused")
+
+    def __init__(self, idx: int, mv: Move):
+        self.idx = idx
+        self.mv = mv
+        self.eligible = False
+        self.dep = -1                 # move index this one waits on (-1: none)
+        self.succ: list = []          # dependent moves (lane chain + hoist guards)
+        self.rx0: int | None = None   # planned inbound seqn for op0
+        self.rx1: int | None = None   # planned inbound seqn for op1
+        self.tx: int | None = None    # planned outbound seqn
+        self.rx_keys: tuple = ()      # ((src, comm_id, seqn), tag) gates
+        self.state = _ST_PENDING
+        self.deadline = 0.0
+        self.fuse: _MovePlan | None = None  # cut-through relay this recv emits
+        self.fused = False            # this relay is emitted by its recv
+
+
+class _Prog:
+    """State of one streamed program execution."""
+
+    __slots__ = ("cfg", "comm", "waiting", "ready", "outstanding",
+                 "running", "err", "aborted", "pipelined", "max_depth",
+                 "combining", "max_combining", "lanes")
+
+    def __init__(self, cfg, comm):
+        self.cfg = cfg
+        self.comm = comm
+        self.waiting: dict = {}       # (src, comm_id, seqn) -> _MovePlan
+        self.ready: list = []         # FIFO of runnable _MovePlans
+        self.outstanding = 0          # registered, not yet retired/cancelled
+        self.running = 0
+        self.err = 0
+        self.aborted = False
+        self.pipelined = 0
+        self.max_depth = 0
+        self.combining = 0
+        self.max_combining = 0
+        self.lanes = 0
+
 
 class MoveExecutor:
     """Executes Move programs against one rank's memory/fabric/pool.
@@ -277,21 +418,38 @@ class MoveExecutor:
     (remote-stream send, dma_mover.cpp:303 / tcp_depacketizer strm routing).
 
     Pipelining (reference: the dma_mover keeps many moves in flight across
-    its 11 stages): ``window`` > 0 arms the in-flight window — non-blocking
-    pure sends are enqueued to a worker thread and retire asynchronously,
-    overlapping their payload serialization and fabric delivery with the
-    main thread's recv-matching and combining of subsequent moves. Every
-    other move runs inline on the main thread, and drains the window
-    before emitting remotely so per-peer wire sequence numbers are always
-    assigned AND emitted in program order. A failed in-flight move latches
-    its error; the next blocking move (or the final drain) surfaces it and
-    aborts the rest of the program — the software analog of the firmware's
-    setjmp unwind to finalize_call (ccl_offload_control.c:1163-1170).
+    its 11 stages). Two pipelined engines sit above the serial core:
+
+    * ``execute_window`` — the send-only in-flight window: non-blocking
+      pure sends are enqueued to a FIFO worker thread and retire
+      asynchronously; every other move runs inline, draining the window
+      before emitting remotely so per-peer wire sequence numbers are
+      assigned AND emitted in program order.
+    * ``execute_streamed`` (default when ``window > 0``) — the
+      dependency-aware segment pipeline. ``Move.lane`` tags partition the
+      program into per-segment chains (segment *s* of step *k+1* depends
+      only on segment *s* of step *k*); the plan pass pre-assigns every
+      wire sequence number in program order, a pool-arrival listener
+      promotes moves to a ready queue the moment their message lands
+      (no thread parks in ``seek``), and a small combine-worker pool
+      executes ready moves of *different* lanes concurrently — so
+      recv-match of segment *s+1* overlaps the combine of *s* while the
+      relay of *s−1* is still leaving through the per-peer egress stage,
+      which re-establishes exact program-order emission. Unlaned moves
+      that are not pure non-blocking sends act as barriers (full drain,
+      inline execution) — gather's reused relay scratch, stream ports,
+      and remote-stream sends keep their strict ordering.
+
+    A failed in-flight move latches its error; the program aborts at the
+    next move boundary and the word surfaces in the returned error — the
+    software analog of the firmware's setjmp unwind to finalize_call
+    (ccl_offload_control.c:1163-1170).
 
     ``window=0`` (or env ``ACCL_TPU_PIPELINE_WINDOW=0``) degrades to
     ``execute_serial``, the strict one-move-at-a-time reference engine kept
     for differential testing and as the before-side of the pipeline
-    microbenchmark.
+    microbenchmark. ``segment_stream=False`` (or env
+    ``ACCL_TPU_SEGMENT_STREAM=0``) selects the send-only window engine.
 
     ``tx_serializes``: set True by owners whose ``send_fn`` fully
     serializes the payload before returning (socket fabrics) — emission
@@ -301,15 +459,30 @@ class MoveExecutor:
     """
 
     def __init__(self, mem: DeviceMemory, pool: RxBufferPool, send_fn,
-                 timeout: float = 30.0, window: int | None = None):
+                 timeout: float = 30.0, window: int | None = None,
+                 segment_stream: bool | None = None,
+                 combine_workers: int | None = None):
         self.mem = mem
-        self.pool = pool
         self._send = send_fn  # (Envelope, payload) -> None
         self.timeout = timeout
         if window is None:
             window = int(os.environ.get("ACCL_TPU_PIPELINE_WINDOW",
                                         DEFAULT_PIPELINE_WINDOW))
         self.window = max(0, int(window))
+        if segment_stream is None:
+            segment_stream = os.environ.get(
+                "ACCL_TPU_SEGMENT_STREAM", "1").lower() not in (
+                    "0", "false", "off", "")
+        self.segment_stream = bool(segment_stream)
+        if combine_workers is None:
+            env_w = os.environ.get("ACCL_TPU_COMBINE_WORKERS")
+            # the scheduler thread executes ready moves itself, so the
+            # pool is EXTRA lanes: size it to the cores beyond the one
+            # the scheduler occupies (0 extra workers is a valid pool)
+            combine_workers = (int(env_w) if env_w else
+                               min(DEFAULT_COMBINE_WORKERS_CAP,
+                                   max(0, (os.cpu_count() or 2) - 2)))
+        self._n_workers = max(0, int(combine_workers))
         self.tx_serializes = False
         # in-flight window state (lazily started worker)
         self._wq: queue.Queue | None = None
@@ -317,8 +490,25 @@ class MoveExecutor:
         self._inflight = 0
         self._async_err = 0
         self._closed = False
+        # segment-streamed engine state: one lock, two wait-sets — the
+        # worker pool waits for ready moves, the scheduler thread waits
+        # for quiescence. Separate conditions keep a retire from waking
+        # every thread in the executor (notify_all on a shared cv was a
+        # measurable thundering herd at segment granularity).
+        self._sched_lock = threading.Lock()
+        self._work_cv = threading.Condition(self._sched_lock)
+        self._prog: _Prog | None = None
+        self._stream_workers_started = False
+        self._arena = _ScratchArena(slots=self._n_workers + 4)
+        self._eg_lock = threading.Lock()
+        # (dst_grank, comm_id) -> [next_seqn_to_emit, parked{seqn: frame},
+        #                          flusher_busy]
+        self._egress: dict[tuple[int, int], list] = {}
+        self._eg_busy = 0        # egress flush loops currently running
+        self.flush_fn = None     # optional fabric flush hook (coalescing)
+        self.pool = pool         # property: wires the arrival listener
         # per-execute pipeline counters (tracing/CallRecord plumbing)
-        self.last_stats = {"moves": 0, "pipelined": 0, "max_inflight": 0}
+        self.last_stats = dict(_EMPTY_STATS)
         # stream ports are CONTINUOUS element streams (the reference's AXIS
         # semantics: no message boundaries — a consumer reads exactly the
         # word count its move asks for, across however many pushes/wire
@@ -329,6 +519,18 @@ class MoveExecutor:
         self.stream_out: list[np.ndarray] = []
         self._stream_out_off = 0
         self._stream_cv = threading.Condition()
+
+    @property
+    def pool(self) -> RxBufferPool:
+        return self._pool
+
+    @pool.setter
+    def pool(self, p: RxBufferPool):
+        """Owners swap pools on soft reset; the arrival listener that
+        feeds the streamed scheduler must follow the swap."""
+        self._pool = p
+        if p is not None:
+            p.on_ingest = self._on_pool_ingest
 
     # -- stream ports ------------------------------------------------------
     def push_stream(self, data: np.ndarray):
@@ -421,11 +623,14 @@ class MoveExecutor:
 
     # -- operand fetch/sink ------------------------------------------------
     def _fetch(self, op: Operand, count: int, cfg: ArithConfig,
-               comm: Communicator, deadline: float, *, copy: bool = True
-               ) -> tuple[np.ndarray | None, int]:
+               comm: Communicator, deadline: float, *, copy: bool = True,
+               rx_seqn: int | None = None) -> tuple[np.ndarray | None, int]:
         """Returns (array in uncompressed dtype, error_word). With
         ``copy=False`` IMMEDIATE operands come back as zero-copy views of
-        device memory (safe for read-only consumption within the move)."""
+        device memory (safe for read-only consumption within the move).
+        ``rx_seqn`` overrides the live inbound counter with a seqn the
+        streamed planner pre-assigned (the counter was already advanced at
+        plan time, so the live counter is NOT touched here)."""
         u, c = cfg.uncompressed_dtype, cfg.compressed_dtype
         if op.mode == MoveMode.NONE:
             return None, 0
@@ -443,7 +648,8 @@ class MoveExecutor:
             return data, 0
         if op.mode == MoveMode.ON_RECV:
             rank = comm.ranks[op.src_rank]
-            got = self.pool.seek(rank.global_rank, op.tag, rank.inbound_seq,
+            seqn = rank.inbound_seq if rx_seqn is None else rx_seqn
+            got = self.pool.seek(rank.global_rank, op.tag, seqn,
                                  max(0.0, deadline - time.monotonic()),
                                  comm_id=comm.comm_id)
             if got is None:
@@ -454,7 +660,8 @@ class MoveExecutor:
                 return None, (int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
                               | self.pool.consume_error())
             env, payload = got
-            rank.inbound_seq += 1      # exchange-mem seq update parity
+            if rx_seqn is None:
+                rank.inbound_seq += 1  # exchange-mem seq update parity
             wire = np.dtype(env.wire_dtype)
             data = np.frombuffer(payload, dtype=wire)
             if data.size != count:
@@ -463,85 +670,175 @@ class MoveExecutor:
         return None, int(ErrorCode.INVALID_CALL)
 
     def _emit_remote(self, move: Move, data: np.ndarray, cfg: ArithConfig,
-                     comm: Communicator, *, zero_copy: bool = False):
+                     comm: Communicator, *, zero_copy: bool = False,
+                     tx_seqn: int | None = None, release=None,
+                     streamed: bool = False, immutable_src: bool = False):
+        """``tx_seqn`` carries a seqn the streamed planner pre-assigned
+        (live counter already advanced at plan time); ``streamed`` routes
+        the frame through the per-peer egress reorder stage; ``release``
+        returns the combine-scratch slot backing ``data`` to the arena
+        once the frame no longer references it; ``immutable_src`` marks
+        ``data`` as a view of a pool payload that is never rewritten
+        (cut-through relay), so retaining fabrics may keep the view."""
         wire = (cfg.compressed_dtype if move.eth_compressed
                 else cfg.uncompressed_dtype)
         arr = np.ascontiguousarray(data.astype(wire, copy=False))
         owns = arr.base is None and arr.flags.owndata
-        if zero_copy and (owns or self.tx_serializes):
+        if zero_copy and (owns or self.tx_serializes or immutable_src):
             # frame the array itself (as a flat byte view): a fresh combine
             # result owns its memory and is never touched again, and a
             # serializing fabric copies views out before send returns —
             # either way the tobytes() copy is pure overhead
             payload = arr.reshape(-1).view(np.uint8)
             nbytes = arr.nbytes
+            # the frame still references the scratch slot only when no
+            # dtype conversion copied the data out of it
+            holds_scratch = release is not None and (arr is data
+                                                     or arr.base is data)
         else:
             payload = arr.tobytes()
             nbytes = len(payload)
+            holds_scratch = False
+        if release is not None and not holds_scratch:
+            release()
+            release = None
         rank = comm.ranks[move.dst_rank]  # comm-local -> fabric rank
         # stream deliveries bypass the rx pool, so they ride OUTSIDE the
         # seqn-ordered channel — consuming a seqn here would desync the
         # sender's counter from the receiver's pool expectations
-        seqn = 0 if move.remote_stream else rank.outbound_seq
+        if move.remote_stream:
+            seqn = 0
+        elif tx_seqn is not None:
+            seqn = tx_seqn
+        else:
+            seqn = rank.outbound_seq
         env = Envelope(src=comm.my_global_rank, dst=rank.global_rank,
                        tag=move.tag, seqn=seqn,
                        nbytes=nbytes, wire_dtype=np.dtype(wire).name,
                        strm=1 if move.remote_stream else 0,
                        comm_id=comm.comm_id)
-        if not move.remote_stream:
+        if not move.remote_stream and tx_seqn is None:
             rank.outbound_seq += 1
-        self._send(env, payload)
+        if streamed and not move.remote_stream:
+            self._egress_emit((rank.global_rank, comm.comm_id), seqn, env,
+                              payload, release)
+            return
+        try:
+            self._send(env, payload)
+        finally:
+            if release is not None:
+                release()
+        if self.flush_fn is not None:
+            # serial/window engines and remote-stream sends bypass the
+            # egress stage — a coalescing fabric must still see a flush
+            # boundary or sub-watermark frames would strand in its buffer
+            self.flush_fn(rank.global_rank)
 
     # -- single-move engine ------------------------------------------------
     def _run_move(self, mv: Move, cfg: ArithConfig, comm: Communicator, *,
-                  pipelined: bool, in_window: bool = False) -> int:
+                  pipelined: bool, in_window: bool = False,
+                  plan: _MovePlan | None = None,
+                  prog: _Prog | None = None) -> int:
         """One trip through the dma_mover pipeline for one move (decode →
         fetch ops → arith → route result → retire with an error word,
         dma_mover.cpp:343-714). ``pipelined=True`` uses the zero-copy
         dataplane and drains the in-flight window before any remote
         emission (program-order seqn assignment across worker + inline
-        emitters)."""
+        emitters). ``plan``/``prog`` are set by the streamed engine:
+        pre-assigned seqns, arena combine scratch, egress-routed
+        emission, and overlap counters."""
         deadline = time.monotonic() + self.timeout
         copy = not pipelined
         op0, e0 = self._fetch(mv.op0, mv.count, cfg, comm, deadline,
-                              copy=copy)
+                              copy=copy,
+                              rx_seqn=plan.rx0 if plan is not None else None)
         op1, e1 = self._fetch(mv.op1, mv.count, cfg, comm, deadline,
-                              copy=copy)
+                              copy=copy,
+                              rx_seqn=plan.rx1 if plan is not None else None)
         if e0 or e1:
             return e0 | e1
-        if op0 is not None and op1 is not None:
-            if mv.func is None:
-                return int(ErrorCode.INVALID_CALL)
-            result = _REDUCERS[mv.func](op0, op1)
-        else:
-            result = op0 if op0 is not None else op1
-        if result is None:
-            return int(ErrorCode.INVALID_CALL)
-        if mv.res_local:
-            if mv.res.mode == MoveMode.STREAM:
-                if result.base is not None:
-                    # stream entries outlive the move: a view of device
-                    # memory could be rewritten before the consumer pops it
-                    result = result.copy()
-                with self._stream_cv:
-                    self.stream_out.append(result)
-                    self._stream_cv.notify_all()
-            elif mv.res.mode == MoveMode.IMMEDIATE:
-                out_dtype = (cfg.compressed_dtype if mv.res.compressed
-                             else cfg.uncompressed_dtype)
-                self.mem.write(mv.res.addr,
-                               result.astype(out_dtype, copy=False))
+        release = None
+        try:
+            if op0 is not None and op1 is not None:
+                if mv.func is None:
+                    return int(ErrorCode.INVALID_CALL)
+                out = None
+                if (prog is not None and plan is not None and plan.eligible
+                        and mv.res.mode is not MoveMode.STREAM
+                        and (not mv.res_remote or self.tx_serializes)):
+                    # combine-worker path: reduce into arena scratch
+                    # instead of a fresh allocation per segment. Remote
+                    # results on a payload-retaining fabric (LocalFabric)
+                    # skip the arena: emission would have to copy a
+                    # non-owning view, costing MORE than the allocation
+                    # the arena saves — a fresh result emits zero-copy.
+                    u = cfg.uncompressed_dtype
+                    slot = self._arena.acquire(mv.count * u.itemsize)
+                    if slot is not None:
+                        out = slot[:mv.count * u.itemsize].view(u)
+                        release = (lambda a=self._arena, b=slot:
+                                   a.release(b))
+                if prog is not None:
+                    # unsynchronized stat counters: a torn read can only
+                    # under-report the peak by one — not worth a lock
+                    # round-trip per combine on the hot path
+                    prog.combining += 1
+                    if prog.combining > prog.max_combining:
+                        prog.max_combining = prog.combining
+                try:
+                    if out is not None:
+                        result = _REDUCERS[mv.func](op0, op1, out=out)
+                    else:
+                        result = _REDUCERS[mv.func](op0, op1)
+                finally:
+                    if prog is not None:
+                        prog.combining -= 1
             else:
+                result = op0 if op0 is not None else op1
+            if result is None:
                 return int(ErrorCode.INVALID_CALL)
-        if mv.res_remote:
-            if pipelined and not in_window and self._inflight:
-                # emission barrier: queued sends must hit the wire (and
-                # take their seqns) before this inline emission does. A
-                # window-run move skips this (it IS the window, and the
-                # single FIFO worker already emits in program order).
-                self._drain()
-            self._emit_remote(mv, result, cfg, comm, zero_copy=pipelined)
-        return 0
+            if mv.res_local:
+                if mv.res.mode == MoveMode.STREAM:
+                    if result.base is not None:
+                        # stream entries outlive the move: a view of
+                        # device memory could be rewritten before the
+                        # consumer pops it
+                        result = result.copy()
+                    with self._stream_cv:
+                        self.stream_out.append(result)
+                        self._stream_cv.notify_all()
+                elif mv.res.mode == MoveMode.IMMEDIATE:
+                    out_dtype = (cfg.compressed_dtype if mv.res.compressed
+                                 else cfg.uncompressed_dtype)
+                    self.mem.write(mv.res.addr,
+                                   result.astype(out_dtype, copy=False))
+                else:
+                    return int(ErrorCode.INVALID_CALL)
+            if mv.res_remote:
+                if pipelined and not in_window and self._inflight:
+                    # emission barrier: queued sends must hit the wire (and
+                    # take their seqns) before this inline emission does. A
+                    # window-run move skips this (it IS the window, and the
+                    # single FIFO worker already emits in program order).
+                    self._drain()
+                self._emit_remote(
+                    mv, result, cfg, comm, zero_copy=pipelined,
+                    tx_seqn=plan.tx if plan is not None else None,
+                    release=release, streamed=prog is not None)
+                release = None  # ownership passed to emission/egress
+            if plan is not None and plan.fuse is not None:
+                # cut-through relay: forward the just-received bytes
+                # under the relay's own envelope/seqn, never re-reading
+                # the slot (the pool payload is immutable, so the frame
+                # may reference it zero-copy even on retaining fabrics)
+                self._emit_remote(
+                    plan.fuse.mv, result, cfg, comm, zero_copy=True,
+                    tx_seqn=plan.fuse.tx, streamed=prog is not None,
+                    immutable_src=True)
+            return 0
+        finally:
+            if release is not None:
+                release()
 
     # -- in-flight window --------------------------------------------------
     @staticmethod
@@ -605,35 +902,489 @@ class MoveExecutor:
             while self._inflight:
                 self._win_cv.wait()
 
+    # -- segment-streamed engine -------------------------------------------
+    #
+    # Plan pass (main thread): walk the program once, pre-assigning every
+    # inbound/outbound wire seqn in program order (advancing the live
+    # counters to their final values — matching is exact-key, so segments
+    # may then be CONSUMED out of order) and deriving each move's single
+    # dependency edge: laned moves chain behind the previous move of the
+    # same lane, unlaned window-eligible sends behind the last barrier,
+    # and everything else IS a barrier (full drain + inline execution).
+    #
+    # Scheduling (event-driven, no thread ever parks in seek): a move
+    # whose dependency retired but whose message has not arrived waits in
+    # ``prog.waiting`` keyed by its (src, comm_id, seqn); the pool's
+    # arrival listener promotes it to the ready queue. Workers batch
+    # through the ready queue — one wakeup can retire many segments,
+    # which is where the throughput over the send-only window comes from
+    # (the window engine pays one cv round-trip per recv-match).
+    #
+    # Emission: combine results deposit frames into a per-peer egress
+    # reorder stage; whichever worker supplies the next-expected seqn
+    # flushes the available prefix, so wire order per peer remains exact
+    # program order without any worker ever blocking on a peer's turn.
+
+    def _stream_eligible(self, mv: Move) -> bool:
+        """May this move run on the combine-worker pool? Laned moves ride
+        their lane chain; unlaned pure non-blocking sends float behind
+        the last barrier (the window engine's eligibility rule). Stream
+        ports and remote-stream sends are order-sensitive beyond the
+        seqn channel and always run inline."""
+        if (mv.remote_stream or mv.op0.mode is MoveMode.STREAM
+                or mv.op1.mode is MoveMode.STREAM
+                or (mv.res_local and mv.res.mode is MoveMode.STREAM)):
+            return False
+        return mv.lane is not None or self._window_eligible(mv)
+
+    def _plan_streamed(self, moves: list[Move], comm: Communicator
+                       ) -> list[_MovePlan]:
+        entries: list[_MovePlan] = []
+        last_barrier = -1
+        laned_write_since_barrier = False
+        lane_last: dict[int, int] = {}
+        with self._eg_lock:
+            # (re)sync next-emit to the live counters — not setdefault: a
+            # soft reset zeroes the counters between programs, and stale
+            # egress expectations would park every post-reset frame
+            # forever (programs are serialized, so nothing is in flight
+            # here and parked maps are empty)
+            for r in comm.ranks:
+                self._egress[(r.global_rank, comm.comm_id)] = \
+                    [r.outbound_seq, {}, False]
+        for i, mv in enumerate(moves):
+            e = _MovePlan(i, mv)
+            keys = []
+            if mv.op0.mode is MoveMode.ON_RECV:
+                rk = comm.ranks[mv.op0.src_rank]
+                e.rx0 = rk.inbound_seq
+                rk.inbound_seq += 1
+                keys.append(((rk.global_rank, comm.comm_id, e.rx0),
+                             mv.op0.tag))
+            if mv.op1.mode is MoveMode.ON_RECV:
+                rk = comm.ranks[mv.op1.src_rank]
+                e.rx1 = rk.inbound_seq
+                rk.inbound_seq += 1
+                keys.append(((rk.global_rank, comm.comm_id, e.rx1),
+                             mv.op1.tag))
+            e.rx_keys = tuple(keys)
+            if mv.res_remote and not mv.remote_stream:
+                rk = comm.ranks[mv.dst_rank]
+                e.tx = rk.outbound_seq
+                rk.outbound_seq += 1
+            e.eligible = self._stream_eligible(mv)
+            if e.eligible and mv.lane is None and laned_write_since_barrier:
+                # unlaned window send after a LANED local writer: its
+                # non-blocking invariant only covers LATER writers of its
+                # source, and lanes retire out of order — a single-edge
+                # dependency cannot prove every earlier write landed
+                # (in-place alltoall's second half reads chunks the
+                # first half's laned recvs write). Demote to a barrier:
+                # drain-all makes every earlier write visible, exactly
+                # the order the window engine's inline recvs gave it.
+                e.eligible = False
+            if e.eligible:
+                dep = last_barrier
+                if mv.lane is not None:
+                    # lane invariant: the expansion guarantees this move
+                    # touches only bytes its own lane's predecessors
+                    # wrote — the lane chain IS the hazard edge
+                    dep = max(dep, lane_last.get(mv.lane, -1))
+                    lane_last[mv.lane] = i
+                e.dep = dep
+                self._try_fuse_relay(entries, e)
+            else:
+                last_barrier = i
+                laned_write_since_barrier = False
+            if e.eligible and mv.res_local and mv.lane is not None:
+                laned_write_since_barrier = True
+            entries.append(e)
+        return entries
+
+    @staticmethod
+    def _try_fuse_relay(entries: list[_MovePlan], e: _MovePlan):
+        """Cut-through relay peephole (reference: the CCLO relays straight
+        off the rx path, never re-reading the landing slot —
+        ccl_offload_control.c:739-743 / dma_mover segment relay). When a
+        lane's recv is immediately followed by a pure send of EXACTLY the
+        bytes it wrote (same address, count, uncompressed storage), the
+        recv task emits the relay itself from the in-hand payload: the
+        slot is still written (bit-identical memory), but the relay's
+        slot re-read, its payload copy, and one full task's scheduling
+        are gone. Compressed-res lanes are skipped — re-reading the slot
+        round-trips through the compressed dtype there, and cut-through
+        must be bit-identical to the serial oracle."""
+        mv = e.mv
+        if e.dep < 0 or e.dep >= len(entries):
+            return
+        r = entries[e.dep]
+        rmv = r.mv
+        if (r.eligible and r.fuse is None
+                and rmv.op1.mode is MoveMode.ON_RECV
+                and rmv.op0.mode is MoveMode.NONE and rmv.func is None
+                and rmv.res_local and not rmv.res_remote
+                and rmv.res.mode is MoveMode.IMMEDIATE
+                and not rmv.res.compressed
+                and mv.func is None and mv.res_remote and not mv.res_local
+                and not mv.remote_stream
+                and mv.op0.mode is MoveMode.IMMEDIATE
+                and not mv.op0.compressed
+                and mv.op0.addr == rmv.res.addr and mv.count == rmv.count):
+            r.fuse = e
+            e.fused = True
+            r.succ.append(e)  # retire/cancel bookkeeping rides the chain
+
+    def _ensure_stream_workers(self):
+        with self._sched_lock:
+            if self._stream_workers_started or self._closed:
+                return
+            self._stream_workers_started = True
+            for k in range(self._n_workers):
+                threading.Thread(target=self._stream_worker_loop,
+                                 daemon=True,
+                                 name=f"combine-worker-{k}").start()
+
+    def _stream_worker_loop(self):
+        while True:
+            with self._sched_lock:
+                while not self._closed and (self._prog is None
+                                            or not self._prog.ready):
+                    self._work_cv.wait()
+                if self._closed:
+                    return
+                prog = self._prog
+                task = self._pop_task_locked(prog)
+            self._run_task(prog, task)
+
+    def _pop_task_locked(self, prog: _Prog) -> _MovePlan:
+        task = prog.ready.pop(0)
+        task.state = _ST_RUNNING
+        prog.running += 1
+        depth = prog.running + len(prog.ready)
+        if depth > prog.max_depth:
+            prog.max_depth = depth
+        return task
+
+    def _run_task(self, prog: _Prog, task: _MovePlan):
+        """Execute one popped task and retire it — shared by the worker
+        pool and the scheduler thread itself (which executes ready moves
+        while it waits for quiescence: on a small host the extra thread
+        handoff per segment costs more than it buys, and the combine
+        workers are pure ADDITIONAL lanes, not the only lanes)."""
+        err = 0
+        if not prog.aborted:
+            try:
+                err = self._run_move(task.mv, prog.cfg, prog.comm,
+                                     pipelined=True, plan=task,
+                                     prog=prog)
+            except Exception:  # noqa: BLE001 — a worker death would
+                # wedge the scheduler's drain; latch and keep retiring
+                import traceback
+                traceback.print_exc()
+                err = int(ErrorCode.INVALID_CALL)
+        with self._sched_lock:
+            task.state = _ST_RETIRED
+            prog.running -= 1
+            prog.outstanding -= 1
+            prog.pipelined += 1
+            if err:
+                prog.err |= err
+                self._abort_locked(prog)
+                # the failing task's own successors are reachable only
+                # through it — _abort_locked cannot see them, and a
+                # leaked PENDING successor would hold prog.outstanding
+                # above zero forever (quiesce would never return)
+                self._cancel_chain_locked(prog, task.succ)
+            elif prog.aborted:
+                self._cancel_chain_locked(prog, task.succ)
+            else:
+                for s in task.succ:
+                    if s.fused and s.state == _ST_PENDING:
+                        # its frame left with this task's execution
+                        s.state = _ST_RETIRED
+                        prog.pipelined += 1
+                        for s2 in s.succ:
+                            if s2.state == _ST_PENDING:
+                                self._activate_locked(prog, s2)
+                    elif s.state == _ST_PENDING:
+                        self._activate_locked(prog, s)
+            if prog.outstanding == 0:
+                # wake the scheduler thread out of its helping wait (it
+                # shares _work_cv with the pool)
+                self._work_cv.notify_all()
+
+    def _activate_locked(self, prog: _Prog, task: _MovePlan):
+        """Dependency satisfied: run now if the message (if any) arrived,
+        else park in the waiting map for the arrival listener. Caller
+        holds ``_sched_lock``."""
+        for key, tag in task.rx_keys:
+            if not self._pool.has_match(key[0], tag, key[2],
+                                        comm_id=key[1]):
+                # deadline starts when the move WOULD have started — the
+                # serial engine's per-move timeout, not per-program
+                task.deadline = time.monotonic() + self.timeout
+                task.state = _ST_WAITING
+                prog.waiting[key] = task
+                return
+        task.state = _ST_READY
+        prog.ready.append(task)
+        self._work_cv.notify()
+
+    def _on_pool_ingest(self, key: tuple[int, int, int]):
+        """Pool arrival listener (any thread): promote the move waiting on
+        this exact (src, comm_id, seqn), if one is parked."""
+        if self._prog is None:
+            # GIL-snapshot fast exit: serial/window engines (and idle
+            # executors) must not pay a scheduler lock per ingest. A
+            # program installed after this read re-probes the pool at
+            # activation, so the wakeup cannot be lost.
+            return
+        with self._sched_lock:
+            prog = self._prog
+            if prog is None:
+                return
+            task = prog.waiting.pop(key, None)
+            if task is None or task.state != _ST_WAITING:
+                return
+            # re-gate on any OTHER still-missing key (multi-recv moves)
+            for k, tag in task.rx_keys:
+                if k == key:
+                    continue
+                if not self._pool.has_match(k[0], tag, k[2], comm_id=k[1]):
+                    prog.waiting[k] = task
+                    return
+            task.state = _ST_READY
+            prog.ready.append(task)
+            self._work_cv.notify()
+
+    def _cancel_chain_locked(self, prog: _Prog, succ: list):
+        stack = list(succ)
+        while stack:
+            task = stack.pop()
+            if task.state != _ST_PENDING:
+                continue
+            task.state = _ST_CANCELLED
+            if not task.fused:  # fused relays are never registered
+                prog.outstanding -= 1
+            stack.extend(task.succ)
+
+    def _abort_locked(self, prog: _Prog):
+        """Latch-and-unwind: cancel everything not already running; the
+        running moves retire normally (their lane successors are cancelled
+        at retire time). Caller holds ``_sched_lock``."""
+        if prog.aborted:
+            return
+        prog.aborted = True
+        for t in list(prog.waiting.values()):
+            t.state = _ST_CANCELLED
+            prog.outstanding -= 1
+            self._cancel_chain_locked(prog, t.succ)
+        prog.waiting.clear()
+        while prog.ready:
+            t = prog.ready.pop()
+            t.state = _ST_CANCELLED
+            prog.outstanding -= 1
+            self._cancel_chain_locked(prog, t.succ)
+        self._work_cv.notify_all()
+
+    def _wait_quiesce(self, prog: _Prog):
+        """Drive the program until every registered move retired/cancelled
+        AND the egress stage is idle (a barrier's inline emission must
+        find the wire caught up). The scheduler thread EXECUTES ready
+        moves itself while it waits — the combine workers are additional
+        lanes, not the only ones, so a host with few cores never pays a
+        thread handoff per segment. Also enforces recv deadlines for
+        waiting moves — the streamed analog of the serial engine's
+        per-move timeout."""
+        while True:
+            task = None
+            with self._sched_lock:
+                if prog.ready:
+                    task = self._pop_task_locked(prog)
+                elif prog.outstanding == 0 and self._eg_busy == 0:
+                    return
+                else:
+                    now = time.monotonic()
+                    nearest = None
+                    expired = None
+                    for t in prog.waiting.values():
+                        if t.deadline <= now:
+                            expired = t
+                            break
+                        if nearest is None or t.deadline < nearest:
+                            nearest = t.deadline
+                    if expired is not None:
+                        prog.err |= (int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+                                     | self._pool.consume_error())
+                        self._abort_locked(prog)
+                        continue
+                    wait = (0.2 if nearest is None
+                            else min(0.2, nearest - now))
+                    self._work_cv.wait(max(0.005, wait))
+            if task is not None:
+                self._run_task(prog, task)
+
+    # -- egress reorder stage ----------------------------------------------
+    def _egress_emit(self, key: tuple[int, int], seqn: int, env: Envelope,
+                     payload, release):
+        """Deposit a frame; whichever thread supplies the next-expected
+        seqn becomes the flusher and drains the available prefix. No
+        thread ever WAITS for a peer's turn — out-of-order frames park,
+        keeping workers free for ready moves (the lock-step alternative
+        deadlocks when every worker waits on a lane that cannot get a
+        worker)."""
+        st = self._egress[key]
+        with self._eg_lock:
+            if st[0] != seqn or st[2]:
+                st[1][seqn] = (env, payload, release)
+                return  # not our turn, or a flusher is already draining
+            st[2] = True  # our frame IS next: flush without parking it
+            self._eg_busy += 1
+        item = (env, payload, release)
+        sent = 0
+        while True:
+            env, payload, release = item
+            try:
+                self._send(env, payload)
+                sent += 1
+            except Exception:  # noqa: BLE001 — a fabric failure mid-flush
+                # must not abandon the flusher role (egress would wedge);
+                # latch into the running program and keep draining
+                import traceback
+                traceback.print_exc()
+                with self._sched_lock:
+                    if self._prog is not None:
+                        self._prog.err |= int(ErrorCode.DMA_TRANSACTION_ERROR)
+            finally:
+                if release is not None:
+                    release()
+            with self._eg_lock:
+                st[0] += 1
+                item = st[1].pop(st[0], None)
+                if item is None:
+                    st[2] = False
+                    self._eg_busy -= 1
+                    idle = self._eg_busy == 0
+                    break
+        if sent and self.flush_fn is not None:
+            self.flush_fn(key[0])
+        if idle:
+            # quiesce waits on egress idle; mid-burst frames need no wakeup
+            with self._sched_lock:
+                self._work_cv.notify_all()
+
+    def _egress_resync(self, comm: Communicator):
+        """End-of-program cleanup: an aborted program leaves parked frames
+        whose predecessors never emitted — drop them (their seqns are
+        burned; receivers surface timeouts, exactly like the window
+        engine's never-issued sends) and fast-forward next-emit to the
+        live counters so the NEXT program's frames flush."""
+        with self._eg_lock:
+            for r in comm.ranks:
+                st = self._egress.get((r.global_rank, comm.comm_id))
+                if st is None:
+                    continue
+                for _env, _payload, release in st[1].values():
+                    if release is not None:
+                        release()
+                st[1].clear()
+                st[0] = r.outbound_seq
+
+    def execute_streamed(self, moves: list[Move], cfg: ArithConfig,
+                         comm: Communicator) -> int:
+        """The dependency-aware segment pipeline (see class docstring)."""
+        self._ensure_stream_workers()
+        prog = _Prog(cfg, comm)
+        entries = self._plan_streamed(moves, comm)
+        prog.lanes = len({e.mv.lane for e in entries
+                          if e.eligible and e.mv.lane is not None})
+        with self._sched_lock:
+            if self._closed:
+                raise RuntimeError("executor closed")
+            self._prog = prog
+        err = 0
+        try:
+            for e in entries:
+                if e.fused:
+                    continue  # emitted by its recv (cut-through relay)
+                if e.eligible:
+                    with self._sched_lock:
+                        if prog.aborted:
+                            break
+                        prog.outstanding += 1
+                        dep = entries[e.dep] if e.dep >= 0 else None
+                        if (dep is not None and dep.eligible
+                                and dep.state < _ST_RETIRED):
+                            dep.succ.append(e)  # activated at dep's retire
+                        else:
+                            self._activate_locked(prog, e)
+                    continue
+                # barrier: drain every in-flight segment, then run inline
+                # (stream ports, remote-stream sends, reused scratch)
+                self._wait_quiesce(prog)
+                if prog.aborted or prog.err:
+                    break
+                err = self._run_move(e.mv, cfg, comm, pipelined=True,
+                                     plan=e, prog=prog)
+                if err:
+                    break
+            self._wait_quiesce(prog)
+        finally:
+            with self._sched_lock:
+                self._abort_locked(prog)  # no-op on clean completion
+            self._wait_quiesce(prog)
+            with self._sched_lock:
+                err |= prog.err
+                self._prog = None
+            self._egress_resync(comm)
+            self.last_stats = dict(_EMPTY_STATS, moves=len(moves),
+                                   pipelined=prog.pipelined,
+                                   max_inflight=prog.max_depth,
+                                   lanes=prog.lanes,
+                                   combine_overlap=prog.max_combining)
+        return err
+
     def close(self):
-        """Stop the window worker (idempotent). Executors live as long as
-        their device; tests spin up thousands of worlds per session, so
-        leaked worker threads must not accumulate. In-lock sentinel
-        placement guarantees already-submitted moves retire first (the
-        worker holds its own queue reference), so a concurrent execute()'s
-        final drain cannot hang."""
+        """Stop the window worker and the combine-worker pool
+        (idempotent). Executors live as long as their device; tests spin
+        up thousands of worlds per session, so leaked worker threads must
+        not accumulate. In-lock sentinel placement guarantees
+        already-submitted moves retire first (the worker holds its own
+        queue reference), so a concurrent execute()'s final drain cannot
+        hang."""
         with self._win_cv:
             self._closed = True
             wq, self._wq = self._wq, None
             if wq is not None:
                 wq.put(None)
             self._win_cv.notify_all()
+        with self._sched_lock:
+            self._work_cv.notify_all()  # combine workers exit on _closed
 
     # -- the engine --------------------------------------------------------
     def execute(self, moves: list[Move], cfg: ArithConfig,
                 comm: Communicator) -> int:
         """Run a move program; returns the OR-ed error word (0 = success).
 
-        With the window armed (``self.window > 0``), non-blocking pure
-        sends retire asynchronously; all other moves run inline, draining
-        the window before any remote emission. A latched in-flight error
-        aborts the remaining program at the next move boundary and is
-        OR-ed into the returned word. ``window == 0`` falls back to the
-        strict serial engine."""
+        Dispatch: ``window == 0`` → the strict serial engine;
+        ``segment_stream`` (default) → the dependency-aware segment
+        pipeline; otherwise → the send-only in-flight window."""
         if self.window <= 0:
             return self.execute_serial(moves, cfg, comm)
-        self.last_stats = {"moves": len(moves), "pipelined": 0,
-                           "max_inflight": 0}
+        if self.segment_stream:
+            return self.execute_streamed(moves, cfg, comm)
+        return self.execute_window(moves, cfg, comm)
+
+    def execute_window(self, moves: list[Move], cfg: ArithConfig,
+                       comm: Communicator) -> int:
+        """The send-only in-flight window engine: non-blocking pure sends
+        retire asynchronously through a FIFO worker; all other moves run
+        inline, draining the window before any remote emission. A latched
+        in-flight error aborts the remaining program at the next move
+        boundary and is OR-ed into the returned word. Kept as the
+        mid-point of the serial → window → streamed benchmark ladder and
+        as the ``ACCL_TPU_SEGMENT_STREAM=0`` fallback."""
+        self.last_stats = dict(_EMPTY_STATS, moves=len(moves))
         err = 0
         try:
             for mv in moves:
@@ -662,8 +1413,7 @@ class MoveExecutor:
         retires (copying dataplane, synchronous emission) before the next
         starts. Kept verbatim as the differential-testing golden path and
         the before-side of the pipeline microbenchmark."""
-        self.last_stats = {"moves": len(moves), "pipelined": 0,
-                           "max_inflight": 0}
+        self.last_stats = dict(_EMPTY_STATS, moves=len(moves))
         err = 0
         for mv in moves:
             err |= self._run_move(mv, cfg, comm, pipelined=False)

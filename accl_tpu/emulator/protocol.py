@@ -133,6 +133,27 @@ def send_frame(sock: socket.socket, body: bytes):
         sock.sendall(view[off:])
 
 
+def send_frame_parts(sock: socket.socket, parts) -> None:
+    """Scatter-gather frame send: u32 length header plus every part in
+    ONE ``sendmsg`` — no concatenation copy of the payload. Parts may be
+    ``bytes`` or any buffer object (memoryview, flat uint8 numpy view
+    from the executor's zero-copy emission). Finishes short writes under
+    backpressure with per-part sendall."""
+    total = sum(payload_nbytes(p) for p in parts)
+    bufs = [struct.pack("<I", total)]
+    bufs.extend(parts)
+    sent = sock.sendmsg(bufs)
+    if sent >= 4 + total:
+        return
+    for b in bufs:  # short write: walk to the split point, finish plain
+        view = memoryview(b).cast("B")
+        if sent >= len(view):
+            sent -= len(view)
+            continue
+        sock.sendall(view[sent:])
+        sent = 0
+
+
 def recv_exact(sock: socket.socket, n: int) -> bytes:
     chunks = []
     while n:
@@ -268,6 +289,15 @@ def unpack_comm(body: bytes) -> tuple[int, int, list[tuple[int, str, int]]]:
 # src u32, dst u32, tag u32, seqn u32, comm_id u32, strm u8, dtype u8,
 # nbytes u64, payload
 _ETH_FMT = "<5I2BQ"
+
+
+def pack_eth_header(src: int, dst: int, tag: int, seqn: int, comm_id: int,
+                    strm: int, dtype: int, nbytes: int) -> bytes:
+    """Eth frame header alone (MSG_ETH byte + fixed fields) — the
+    scatter-gather emission path sends [header, payload] as one iovec
+    (``send_frame_parts``) instead of concatenating a frame."""
+    return bytes([MSG_ETH]) + struct.pack(_ETH_FMT, src, dst, tag, seqn,
+                                          comm_id, strm, dtype, nbytes)
 
 
 def pack_eth(src: int, dst: int, tag: int, seqn: int, comm_id: int,

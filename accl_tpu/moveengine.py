@@ -102,6 +102,20 @@ class Move:
     asynchronously, overlapping subsequent moves; the executor keeps wire
     sequence numbers in program order regardless. A send whose source is
     rewritten later (gather's relay scratch, c:632-724) must stay blocking.
+
+    ``lane`` invariant (what the segment-streamed executor relies on): a
+    move tagged with a segment lane may execute concurrently with moves of
+    OTHER lanes; within one lane, program order is preserved. The
+    expansion tagging lane ``s`` therefore asserts that every byte the
+    move reads or writes is disjoint from the bytes touched by every
+    *concurrent* move of a different lane — segment ``s`` of step ``k+1``
+    depends only on segment ``s`` of step ``k``, never on a sibling
+    segment (the reference's dual-DataMover segment interleave,
+    dma_mover.cpp:716-898). Moves whose hazards cannot be expressed that
+    way (gather's reused relay scratch, stream-port moves) carry
+    ``lane=None`` and serialize as barriers. Lane-chaining follows program
+    order, so the implied dependency graph is acyclic by construction
+    (``scripts/check_blocking.py`` lints both invariants).
     """
 
     count: int
@@ -116,6 +130,7 @@ class Move:
     eth_compressed: bool = False     # compress on the wire
     remote_stream: bool = False      # deliver to peer's stream, not rx pool
     blocking: bool = True
+    lane: int | None = None          # segment lane (see class docstring)
     mode_label: str = ""             # firmware address-mode annotation
 
 
@@ -204,7 +219,7 @@ def expand_send(ctx: MoveContext, count: int, src: int, dst_rank: int,
                 compression: Compression = Compression.NONE,
                 stream: StreamFlags = StreamFlags.NO_STREAM,
                 to_remote_stream: bool = False,
-                blocking: bool = True) -> list[Move]:
+                blocking: bool = True, laned: bool = False) -> list[Move]:
     """send (c:339-361): segmented op0 -> remote res.
 
     Wire compression applies when ETH_COMPRESSED is set; segmentation at
@@ -212,18 +227,22 @@ def expand_send(ctx: MoveContext, count: int, src: int, dst_rank: int,
     ``blocking=False`` is passed by callers whose source region is never
     written later in the program (see the Move.blocking invariant) so the
     pipelined executor can overlap the send with subsequent moves.
+    ``laned=True`` additionally tags each segment with its lane — callers
+    assert the Move.lane invariant: segment ``s`` reads only bytes written
+    by earlier moves of lane ``s`` (the relay-from-slot shape).
     """
     eth_c = bool(compression & Compression.ETH_COMPRESSED)
     moves = []
     seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
     ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
-    for off, n in _segments(count, seg):
+    for si, (off, n) in enumerate(_segments(count, seg)):
         op0 = (Operand.stream() if stream & StreamFlags.OP0_STREAM
                else Operand.imm(src + off * ebytes,
                                 bool(compression & Compression.OP0_COMPRESSED)))
         moves.append(Move(count=n, op0=op0, res_remote=True,
                           dst_rank=dst_rank, tag=tag, eth_compressed=eth_c,
                           remote_stream=to_remote_stream, blocking=blocking,
+                          lane=si if laned else None,
                           mode_label="IMMEDIATE/NONE/REMOTE"))
     return moves
 
@@ -232,17 +251,24 @@ def expand_recv(ctx: MoveContext, count: int, src_rank: int, dst: int,
                 tag: int = 0,
                 compression: Compression = Compression.NONE,
                 stream: StreamFlags = StreamFlags.NO_STREAM) -> list[Move]:
-    """recv (c:365-380): segmented ON_RECV -> local res."""
+    """recv (c:365-380): segmented ON_RECV -> local res.
+
+    Each segment carries its lane tag: segment ``s`` writes only its own
+    slice of ``dst``, so recv-matching of segment ``s+1`` may overlap the
+    consumption of segment ``s`` (Move.lane invariant; the one consumer
+    that re-reads the written slice — a relay — rides the SAME lane).
+    """
     eth_c = bool(compression & Compression.ETH_COMPRESSED)
     moves = []
     seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
     ebytes = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
-    for off, n in _segments(count, seg):
+    for si, (off, n) in enumerate(_segments(count, seg)):
         res = (Operand.stream() if stream & StreamFlags.RES_STREAM
                else Operand.imm(dst + off * ebytes,
                                 bool(compression & Compression.RES_COMPRESSED)))
         moves.append(Move(count=n, op1=Operand.on_recv(src_rank, tag),
                           res=res, res_local=True, eth_compressed=eth_c,
+                          lane=si,
                           mode_label="NONE/ON_RECV/IMMEDIATE"))
     return moves
 
@@ -251,13 +277,20 @@ def expand_fused_recv_reduce(ctx: MoveContext, count: int, func: ReduceFunc,
                              src_rank: int, op0: int, dst: int, tag: int = 0,
                              compression: Compression = Compression.NONE,
                              ) -> list[Move]:
-    """fused_recv_reduce (c:441-467): res = func(op0, incoming)."""
+    """fused_recv_reduce (c:441-467): res = func(op0, incoming).
+
+    Lane-tagged per segment: segment ``s`` reads op0 slice ``s`` and
+    writes res slice ``s`` only, so lanes are pairwise disjoint and the
+    combine of segment ``s`` overlaps the recv-match of ``s+1``
+    (Move.lane invariant). Chained folds that read the previous fold's
+    res as op0 (reduce_direct) are ordered lane-locally for free.
+    """
     eth_c = bool(compression & Compression.ETH_COMPRESSED)
     seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
     e0 = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
     er = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
     moves = []
-    for off, n in _segments(count, seg):
+    for si, (off, n) in enumerate(_segments(count, seg)):
         moves.append(Move(
             count=n,
             op0=Operand.imm(op0 + off * e0,
@@ -265,7 +298,7 @@ def expand_fused_recv_reduce(ctx: MoveContext, count: int, func: ReduceFunc,
             op1=Operand.on_recv(src_rank, tag),
             res=Operand.imm(dst + off * er,
                             bool(compression & Compression.RES_COMPRESSED)),
-            func=func, res_local=True, eth_compressed=eth_c,
+            func=func, res_local=True, eth_compressed=eth_c, lane=si,
             mode_label="IMMEDIATE/ON_RECV/IMMEDIATE"))
     return moves
 
@@ -278,13 +311,16 @@ def expand_fused_recv_reduce_send(ctx: MoveContext, count: int,
                                   ) -> list[Move]:
     """fused_recv_reduce_send (c:473-500): func(op0, incoming) -> peer
     (and optionally also to local dst — the RES_REMOTE|RES_LOCAL form used
-    by allreduce phase 1, c:993-1023)."""
+    by allreduce phase 1, c:993-1023). Lane-tagged per segment like
+    ``expand_fused_recv_reduce`` — the recv→combine→relay of segment ``s``
+    forms one lane, so the relay of ``s-1`` streams out while ``s``
+    combines and ``s+1`` recv-matches."""
     eth_c = bool(compression & Compression.ETH_COMPRESSED)
     seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
     e0 = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
     er = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
     moves = []
-    for off, n in _segments(count, seg):
+    for si, (off, n) in enumerate(_segments(count, seg)):
         res = (Operand.imm(dst + off * er,
                            bool(compression & Compression.RES_COMPRESSED))
                if dst is not None else Operand.none())
@@ -295,7 +331,7 @@ def expand_fused_recv_reduce_send(ctx: MoveContext, count: int,
             op1=Operand.on_recv(src_rank, tag),
             res=res, func=func,
             res_remote=True, res_local=dst is not None,
-            dst_rank=dst_rank, tag=tag, eth_compressed=eth_c,
+            dst_rank=dst_rank, tag=tag, eth_compressed=eth_c, lane=si,
             mode_label="IMMEDIATE/ON_RECV/REMOTE(+LOCAL)"))
     return moves
 
@@ -314,7 +350,11 @@ def expand_broadcast(ctx: MoveContext, count: int, root: int, buf: int,
     seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
     ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
     if ctx.local_rank == root:
-        for off, n in _segments(count, seg):
+        # non-blocking: buf is never written by this program's later
+        # moves; laned per segment so a caller that DID write buf earlier
+        # (the non-fused allreduce reduces into it lane-by-lane) hands
+        # each segment's fan-out a lane-local dependency on that write
+        for si, (off, n) in enumerate(_segments(count, seg)):
             first = True
             for r in range(ctx.world_size):
                 if r == root:
@@ -324,7 +364,7 @@ def expand_broadcast(ctx: MoveContext, count: int, root: int, buf: int,
                     op0=Operand.imm(buf + off * ebytes,
                                     bool(compression & Compression.OP0_COMPRESSED)),
                     res_remote=True, dst_rank=r, tag=TAG_ANY,
-                    eth_compressed=eth_c, blocking=False,
+                    eth_compressed=eth_c, blocking=False, lane=si,
                     mode_label="IMMEDIATE" if first else "REPEAT"))
                 first = False
     else:
@@ -359,9 +399,13 @@ def expand_broadcast_tree(ctx: MoveContext, count: int, root: int, buf: int,
         if vrank + mask < W:
             child = ((vrank + mask) + root) % W
             # non-blocking: buf is never written after the (earlier) recv,
-            # so forwards to all children may overlap each other
+            # so forwards to all children may overlap each other; laned:
+            # the forward of segment s reads only the slice the recv of
+            # lane s wrote, so it chains behind that recv and streams out
+            # while later segments are still arriving
             moves += expand_send(ctx, count, buf, child, tag=TAG_ANY,
-                                 compression=compression, blocking=False)
+                                 compression=compression, blocking=False,
+                                 laned=True)
         mask >>= 1
     return moves
 
@@ -475,9 +519,11 @@ def expand_allgather_ring(ctx: MoveContext, count: int, src: int, dst: int,
     moves += expand_copy(ctx, count, src, dst + me * count * ebytes,
                          compression)
     # non-blocking: src is never written during an allgather, so the
-    # initial send overlaps the first recv's pool wait
+    # initial send overlaps the first recv's pool wait; laned so segment
+    # lanes align with the per-segment recv→relay chains below
     moves += expand_send(ctx, count, src, nxt, tag=TAG_ANY,
-                         compression=compression, blocking=False)
+                         compression=compression, blocking=False,
+                         laned=True)
     for i in range(W - 1):
         owner = (me - 1 - i) % W
         slot = dst + owner * count * ebytes
@@ -493,9 +539,12 @@ def expand_allgather_ring(ctx: MoveContext, count: int, src: int, dst: int,
             # Non-blocking: each round's slot is written exactly once, so
             # the relay overlaps the NEXT round's recv (different slot) —
             # the ring-step overlap the pipelined executor exploits.
+            # Laned: relay of segment s reads exactly the slice lane s's
+            # recv wrote, so the RAW hazard is a lane-local edge and
+            # sibling segments stream independently.
             moves += expand_send(ctx, count, slot, nxt, tag=TAG_ANY,
                                  compression=res_as_op0(compression),
-                                 blocking=False)
+                                 blocking=False, laned=True)
     return moves
 
 
@@ -562,9 +611,11 @@ def expand_reduce_ring(ctx: MoveContext, count: int, root: int, func: ReduceFunc
         return expand_copy(ctx, count, src, dst, compression)
     if (me - root) % W == W - 1:
         # farthest rank starts the chain; non-blocking: src is read-only
-        # and this send is the rank's whole program
+        # and this send is the rank's whole program (laned so downstream
+        # per-segment fused chains see aligned lanes)
         moves += expand_send(ctx, count, src, nxt, tag=TAG_ANY,
-                             compression=compression, blocking=False)
+                             compression=compression, blocking=False,
+                             laned=True)
     elif me == root:
         moves += expand_fused_recv_reduce(ctx, count, func, prv, src, dst,
                                           tag=TAG_ANY, compression=compression)
@@ -591,10 +642,11 @@ def expand_reduce_scatter_ring(ctx: MoveContext, count: int, func: ReduceFunc,
         return expand_copy(ctx, count, src, dst, compression)
     first_chunk = (me + 1) % W
     # non-blocking: src chunks are read-only; the only local write of the
-    # program is the final fused reduce into dst
+    # program is the final fused reduce into dst. Laned: the kickoff of
+    # segment s feeds the downstream rank's lane-s fused chain.
     moves += expand_send(ctx, count, src + first_chunk * count * ebytes, nxt,
                          tag=TAG_ANY, compression=compression,
-                         blocking=False)
+                         blocking=False, laned=True)
     for i in range(1, W):
         # flow is toward decreasing rank, so at round i the partial arriving
         # from prv=(me+1) is for chunk (me+1+i); the final round's chunk is
@@ -650,9 +702,11 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
     # the phase-1 kickoff send overlaps the first fused step's pool wait
     c0 = (me + 1) % W
     if chunk_len(c0):
+        # laned: kickoff segment s is what the downstream lane-s fused
+        # chain consumes first
         moves += expand_send(ctx, chunk_len(c0), src_off(c0), nxt,
                              tag=TAG_ANY, compression=compression,
-                             blocking=False)
+                             blocking=False, laned=True)
     for i in range(1, W):
         c = (me + 1 + i) % W  # decreasing-rank flow: see reduce_scatter
         if not chunk_len(c):
@@ -678,8 +732,13 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
     # next round's recv — the per-step overlap the pipelined executor
     # turns into throughput (the serial engine pays send+recv in sequence)
     if chunk_len(me):
+        # laned: the phase-2 kickoff of segment s reads the dst slice the
+        # phase-1 final fused move of lane s wrote — same lane, so the
+        # cross-phase RAW hazard is a lane-local edge and the kickoff of
+        # segment s streams out while segment s+1 is still reducing
         moves += expand_send(ctx, chunk_len(me), dst_off(me), nxt,
-                             tag=TAG_ANY, compression=p2, blocking=False)
+                             tag=TAG_ANY, compression=p2, blocking=False,
+                             laned=True)
     for i in range(1, W):
         c = (me + i) % W  # decreasing-rank flow: chunk me+i arrives at round i
         if not chunk_len(c):
@@ -691,8 +750,10 @@ def expand_allreduce_ring(ctx: MoveContext, count: int, func: ReduceFunc,
             m.blocking = True  # relay reads the slot next (c:1058-1061)
         moves += rx
         if i < W - 1:
+            # laned: relay of segment s reads exactly what lane s's recv
+            # wrote (slot written once per round), sibling lanes disjoint
             moves += expand_send(ctx, chunk_len(c), slot, nxt, tag=TAG_ANY,
-                                 compression=p2, blocking=False)
+                                 compression=p2, blocking=False, laned=True)
     return moves
 
 

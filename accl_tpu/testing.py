@@ -21,14 +21,18 @@ from .device.emu import EmuContext
 def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
               timeout: float = 20.0,
               max_segment_size: int | None = None,
-              tuner=None, pipeline_window: int | None = None) -> list[ACCL]:
+              tuner=None, pipeline_window: int | None = None,
+              segment_stream: bool | None = None) -> list[ACCL]:
     """Create ``world_size`` ACCL instances sharing an in-process fabric.
 
     ``tuner`` (a single :class:`~accl_tpu.tuner.Tuner`) is shared by every
     rank — the only safe shape: all member ranks of a collective must
     resolve AUTO to the same algorithm. ``pipeline_window`` sets the
-    executors' in-flight window (0 = serial reference engine)."""
-    kw = {"nbufs": nbufs, "pipeline_window": pipeline_window}
+    executors' in-flight window (0 = serial reference engine);
+    ``segment_stream`` selects the dependency-aware segment pipeline vs
+    the send-only window (None = process default)."""
+    kw = {"nbufs": nbufs, "pipeline_window": pipeline_window,
+          "segment_stream": segment_stream}
     if bufsize is not None:
         kw["bufsize"] = bufsize
     ctx = EmuContext(world_size, **kw)

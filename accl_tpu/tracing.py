@@ -59,7 +59,10 @@ class CallRecord:
     # pipelined-executor counters (emu tier; 0 on backends without them):
     moves: int = 0              # move program length the call expanded to
     pipelined_moves: int = 0    # moves retired through the in-flight window
-    pipeline_depth: int = 0     # peak window occupancy during the call
+    pipeline_depth: int = 0     # peak window/segment-pipeline occupancy
+    combine_overlap: int = 0    # peak CONCURRENT combines (segment-streamed
+    #                             worker pool; 0 = serial/window engines,
+    #                             whose combines never overlap each other)
 
     @property
     def duration_us(self) -> float:
@@ -140,7 +143,8 @@ class Profiler:
                 error_word=error_word, algorithm=algorithm,
                 moves=st.get("moves", 0),
                 pipelined_moves=st.get("pipelined", 0),
-                pipeline_depth=st.get("max_inflight", 0)))
+                pipeline_depth=st.get("max_inflight", 0),
+                combine_overlap=st.get("combine_overlap", 0)))
 
         handle.add_done_callback(_on_done)
 
@@ -180,12 +184,14 @@ class Profiler:
         reference benchmark writes (bench_*.csv, test/host/test.py:949)."""
         with open(path, "w") as f:
             f.write("op,count,nbytes,comm_id,t_start,duration_us,error,"
-                    "algorithm,moves,pipelined_moves,pipeline_depth\n")
+                    "algorithm,moves,pipelined_moves,pipeline_depth,"
+                    "combine_overlap\n")
             for r in self.records:
                 f.write(f"{r.op},{r.count},{r.nbytes},{r.comm_id},"
                         f"{r.t_start:.9f},{r.duration_us:.3f},"
                         f"{r.error_word},{r.algorithm},{r.moves},"
-                        f"{r.pipelined_moves},{r.pipeline_depth}\n")
+                        f"{r.pipelined_moves},{r.pipeline_depth},"
+                        f"{r.combine_overlap}\n")
 
     @staticmethod
     def read_csv(path: str) -> list[CallRecord]:
@@ -209,7 +215,8 @@ class Profiler:
                     algorithm=row.get("algorithm") or "",
                     moves=int(row.get("moves") or 0),
                     pipelined_moves=int(row.get("pipelined_moves") or 0),
-                    pipeline_depth=int(row.get("pipeline_depth") or 0)))
+                    pipeline_depth=int(row.get("pipeline_depth") or 0),
+                    combine_overlap=int(row.get("combine_overlap") or 0)))
         return out
 
 # -- JAX profiler bridges ---------------------------------------------------

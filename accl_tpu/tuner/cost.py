@@ -57,6 +57,14 @@ class Topology:
     beta_gbps: float = 1.0     # per-link bandwidth, GB/s
     incast: float = 2.0        # fan-in congestion factor at a hot receiver
     tier: str = "generic"
+    # Segment-pipeline overlap depth: how many segments the dataplane
+    # keeps concurrently in flight (recv-match / combine / relay of
+    # different lanes). 1.0 = store-and-forward (no overlap). With depth
+    # d, per-segment alpha amortizes across the lanes in flight, so the
+    # *effective* overhead of choosing smaller segments shrinks by ~d —
+    # equivalently the pipeline sustains an effective beta close to the
+    # wire beta down to segments d× smaller (see recommend_segment_size).
+    pipeline_depth: float = 1.0
 
     def wire_us(self, nbytes: float) -> float:
         """Microseconds to move ``nbytes`` over one link."""
@@ -151,7 +159,8 @@ def rank_algorithms(op: str, topo: Topology, nbytes: int,
 
 def recommend_segment_size(topo: Topology, preferred: int,
                            overhead_fraction: float = 0.1,
-                           floor: int = 4096) -> int:
+                           floor: int = 4096,
+                           overlap_depth: float | None = None) -> int:
     """Smallest power-of-two segment whose per-segment ``alpha`` overhead
     is at most ``overhead_fraction`` of its wire time, clamped to
     ``[floor, preferred]``.
@@ -161,9 +170,21 @@ def recommend_segment_size(topo: Topology, preferred: int,
     High-alpha fabrics want segments as large as allowed; low-alpha/high-
     beta fabrics can afford smaller segments (better pipelining overlap,
     reference dma_mover segmentation) without drowning in per-segment cost.
+
+    Overlap-aware effective beta: with a segment-streamed dataplane
+    (``overlap_depth``, defaulting to ``topo.pipeline_depth``) the
+    per-segment alpha of ~depth lanes is paid concurrently, so the
+    *effective* per-segment overhead is ``alpha/depth`` — the pipeline
+    sustains close to wire beta down to segments depth× smaller. Smaller
+    segments in turn deepen the recv→combine→relay overlap, which is
+    exactly what the streamed executor converts into throughput; a
+    store-and-forward engine (depth 1) keeps the conservative sizing.
     """
+    depth = max(1.0, (topo.pipeline_depth if overlap_depth is None
+                      else overlap_depth))
     if preferred <= floor:
         return preferred
-    target = topo.alpha_us / overhead_fraction * topo.beta_gbps * 1e3
+    target = (topo.alpha_us / depth) / overhead_fraction \
+        * topo.beta_gbps * 1e3
     seg = 1 << max(1, math.ceil(math.log2(max(target, 1.0))))
     return max(floor, min(seg, preferred))

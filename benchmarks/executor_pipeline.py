@@ -1,15 +1,22 @@
-"""Executor-pipeline microbenchmark: serial vs pipelined move executor.
+"""Executor-pipeline microbenchmark: serial vs window vs segment-streamed.
 
-Proves the overlap the in-flight window buys on the emulator tier with the
-BASELINE config-2 shape (ring all-reduce, fp32, 8 ranks): the same move
-programs run through ``MoveExecutor.execute_serial`` (strict one-move-at-a-
-time retirement, copying dataplane — the pre-pipeline engine) and through
-the pipelined engine (bounded in-flight window + zero-copy dataplane), and
-the speedup is reported alongside absolute bus bandwidth.
+Proves the overlap each executor engine buys on the emulator tier with the
+BASELINE config-2 shape (ring all-reduce, fp32, 8 ranks). The same move
+programs run through three engines:
 
-Run directly (``python -m benchmarks.executor_pipeline`` / ``make
-bench-emu``) it prints one JSON line; ``headline()`` feeds the same payload
-to bench.py's emulator-tier fallback.
+* ``execute_serial`` — strict one-move-at-a-time retirement, copying
+  dataplane (the pre-pipeline engine);
+* ``execute_window`` — the PR-2 send-only in-flight window (non-blocking
+  sends retire async; recv-match → combine → relay still serialize on the
+  executor thread);
+* ``execute_streamed`` — the dependency-aware segment pipeline: per-lane
+  chains let recv-match of segment s+1 overlap the combine of s and the
+  relay of s−1, with combines offloaded to the worker pool.
+
+All three run the same world/segment configuration, so the ratios isolate
+the engine. Run directly (``python -m benchmarks.executor_pipeline`` /
+``make bench-emu``) it prints one JSON line; ``headline()`` feeds the same
+payload to bench.py's emulator-tier fallback.
 """
 
 from __future__ import annotations
@@ -24,17 +31,25 @@ from accl_tpu.testing import emu_world, run_ranks
 
 
 def _time_allreduce(world: int, nbytes: int, iters: int, reps: int,
-                    pipeline_window: int | None) -> float:
-    """Median seconds per ring (FUSED_RING) all-reduce across the world.
+                    pipeline_window: int | None,
+                    segment_stream: bool | None = None,
+                    segments_per_chunk: int = 4) -> tuple[float, dict]:
+    """Median seconds per ring (FUSED_RING) all-reduce across the world,
+    plus the rank-0 executor's pipeline counters from the last rep.
 
     Each rank chains ``iters`` all-reduces inside one thread (the
     chained-iteration method of the reference benchmark, test.py:923-1156)
-    so per-iteration harness dispatch stays out of the measurement."""
+    so per-iteration harness dispatch stays out of the measurement.
+    ``segments_per_chunk`` forces multi-segment chunks — the lanes the
+    streamed engine overlaps (and the window/serial engines serialize,
+    making the comparison configuration-identical)."""
     count = nbytes // 4
     chunk_bytes = max(4096, -(-nbytes // world))
+    seg_bytes = max(4096, chunk_bytes // segments_per_chunk)
     accls = emu_world(world, bufsize=2 * chunk_bytes,
-                      max_segment_size=chunk_bytes,
-                      pipeline_window=pipeline_window)
+                      max_segment_size=seg_bytes,
+                      pipeline_window=pipeline_window,
+                      segment_stream=segment_stream)
     try:
         bufs = []
         for a in accls:
@@ -60,28 +75,64 @@ def _time_allreduce(world: int, nbytes: int, iters: int, reps: int,
             t0 = time.perf_counter()
             run_ranks(accls, body, timeout=120.0)
             samples.append((time.perf_counter() - t0) / iters)
-        return float(np.median(samples))
+        stats = dict(accls[0].device.executor.last_stats)
+        return float(np.median(samples)), stats
     finally:
         for a in accls:
             a.deinit()
 
 
-def headline(world: int = 8, nbytes: int = 16 << 20, iters: int = 4,
-             reps: int = 5) -> dict:
-    """Serial-vs-pipelined comparison as a bench.py-style payload."""
-    t_serial = _time_allreduce(world, nbytes, iters, reps,
-                               pipeline_window=0)
-    t_pipe = _time_allreduce(world, nbytes, iters, reps,
-                             pipeline_window=None)
+def headline(world: int = 8, nbytes: int = 16 << 20, iters: int = 3,
+             pairs: int = 5, segments_per_chunk: int = 2) -> dict:
+    """Serial vs window vs segment-streamed comparison as a bench.py-style
+    payload. ``vs_baseline`` keeps its historical meaning (streamed over
+    the serial reference engine); ``vs_window`` is the segment-streaming
+    headline (streamed over the PR-2 send-only window).
+
+    The window/streamed comparison runs as INTERLEAVED pairs and reports
+    the median of per-pair ratios: shared-host throughput drifts on the
+    scale of one measurement, and sequential A-then-B timing attributes
+    that drift to whichever engine ran later. Pairing cancels the drift;
+    the median rejects the occasional pathological pair."""
+    t_serial, _ = _time_allreduce(world, nbytes, iters, 2,
+                                  pipeline_window=0,
+                                  segments_per_chunk=segments_per_chunk)
+    t_windows, t_streams = [], []
+    stats: dict = {}
+    for p in range(pairs):
+        order = ((False, True) if p % 2 == 0 else (True, False))
+        for stream in order:  # alternate which engine runs first: host
+            # drift within a pair would otherwise bias one side
+            t, st = _time_allreduce(world, nbytes, iters, 2,
+                                    pipeline_window=None,
+                                    segment_stream=stream,
+                                    segments_per_chunk=segments_per_chunk)
+            if stream:
+                t_streams.append(t)
+                stats = st
+            else:
+                t_windows.append(t)
+    vs_window = float(np.median([w / s for w, s in zip(t_windows,
+                                                       t_streams)]))
+    t_stream = float(np.median(t_streams))
+    t_window = float(np.median(t_windows))
     bus_bytes = 2 * (world - 1) / world * nbytes
     return {
         "metric": (f"emu_ring_allreduce_bus_bw_fp32_"
                    f"{nbytes >> 20}MiB_{world}rank"),
-        "value": round(bus_bytes / t_pipe / 1e9, 3),
+        "value": round(bus_bytes / t_stream / 1e9, 3),
         "unit": "GB/s/chip",
-        # before/after: pipelined vs the serial reference engine
-        "vs_baseline": round(t_serial / t_pipe, 3),
+        # before/after: streamed vs the serial reference engine
+        "vs_baseline": round(t_serial / t_stream, 3),
+        # the segment-streaming headline: streamed vs PR-2 window
+        # (median of interleaved-pair ratios)
+        "vs_window": round(vs_window, 3),
         "serial_gbps": round(bus_bytes / t_serial / 1e9, 3),
+        "window_gbps": round(bus_bytes / t_window / 1e9, 3),
+        "pipeline_depth": stats.get("max_inflight", 0),
+        "combine_overlap": stats.get("combine_overlap", 0),
+        "lanes": stats.get("lanes", 0),
+        "segments_per_chunk": segments_per_chunk,
         "tier": "emu",
     }
 

@@ -89,6 +89,8 @@ def test_udp_send_fragments_reassemble_exactly():
     fab._partial = {}
     fab._queues = {}
     fab._closing = False
+    fab.stats = {"sent": 0, "delivered": 0, "dropped_queue_full": 0,
+                 "gc_partials": 0}
 
     sent = []
 
