@@ -545,6 +545,16 @@ class ACCL:
                              root_src_dst=root, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
+        if (desc.algorithm == CollectiveAlgorithm.TREE
+                and comm.local_rank != root):
+            # TREE gather relays a whole SUBTREE through non-root ranks,
+            # not the ring's single chunk: upgrade an undersized scratch
+            # (same dtype, so the prepared compression flags still hold)
+            from .moveengine import tree_gather_scratch_chunks
+            need = tree_gather_scratch_chunks(comm.size, comm.local_rank,
+                                              root) * count
+            if need and dstbuf.size < need:
+                desc.addr_2 = self._scratch(need, dstbuf.dtype).address
         return self._call(desc, run_async, waitfor)
 
     def reduce(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer | None, count: int,
@@ -561,6 +571,18 @@ class ACCL:
                              root_src_dst=root, func=func, op0=srcbuf,
                              res=dstbuf, compress_dtype=compress_dtype,
                              algorithm=algorithm)
+        if (desc.algorithm == CollectiveAlgorithm.TREE
+                and comm.local_rank != root
+                and (dstbuf is None or dstbuf.size < count)):
+            # TREE reduce accumulates child partials on internal ranks:
+            # substitute an n-element accumulator scratch for an absent
+            # OR undersized non-root dst (legal under RING/ROUND_ROBIN,
+            # which never write it). Scratch is src-typed, so the RES
+            # flag re-derives from the OP0 flag.
+            desc.addr_2 = self._scratch(count, srcbuf.dtype).address
+            desc.compression &= ~Compression.RES_COMPRESSED
+            if desc.compression & Compression.OP0_COMPRESSED:
+                desc.compression |= Compression.RES_COMPRESSED
         return self._call(desc, run_async, waitfor)
 
     def allgather(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,
@@ -603,6 +625,13 @@ class ACCL:
                              func=func, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
+        if desc.algorithm == CollectiveAlgorithm.RECURSIVE_DOUBLING:
+            # the recursive-halving expansion needs a whole-vector
+            # working buffer of partial sums (uncompressed dtype),
+            # plumbed through the descriptor's otherwise-unused op1 slot
+            desc.addr_1 = self._scratch(
+                comm.size * count,
+                desc.arithcfg.uncompressed_dtype).address
         return self._call(desc, run_async, waitfor)
 
     def alltoall(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,

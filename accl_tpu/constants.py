@@ -125,6 +125,12 @@ class CollectiveAlgorithm(enum.IntEnum):
     TREE = 3          # binomial tree (2D-mesh trees live in parallel/tree.py)
     FUSED_RING = 4    # allreduce: fused ring reduce-scatter + allgather
     NON_FUSED = 5     # allreduce: reduce to root 0 then bcast
+    # log-depth family (moveengine expansions; the latency regime ACCL+
+    # arXiv:2312.11742 shows algorithm choice dominating): recursive
+    # doubling allgather, recursive halving reduce_scatter, Rabenseifner
+    # allreduce (halving reduce-scatter + doubling allgather). Non-power
+    # of-2 worlds fold to 2^floor(log2 W) vranks in pre/post phases.
+    RECURSIVE_DOUBLING = 6
 
 
 # Which algorithms each collective accepts (AUTO is always legal). Every
@@ -135,21 +141,30 @@ VALID_ALGORITHMS: dict[str, frozenset] = {
                         CollectiveAlgorithm.TREE}),
     "scatter": frozenset({CollectiveAlgorithm.ROUND_ROBIN}),
     "gather": frozenset({CollectiveAlgorithm.RING,
-                         CollectiveAlgorithm.ROUND_ROBIN}),
+                         CollectiveAlgorithm.ROUND_ROBIN,
+                         CollectiveAlgorithm.TREE}),
     "reduce": frozenset({CollectiveAlgorithm.RING,
-                         CollectiveAlgorithm.ROUND_ROBIN}),
+                         CollectiveAlgorithm.ROUND_ROBIN,
+                         CollectiveAlgorithm.TREE}),
     "allgather": frozenset({CollectiveAlgorithm.RING,
-                            CollectiveAlgorithm.ROUND_ROBIN}),
+                            CollectiveAlgorithm.ROUND_ROBIN,
+                            CollectiveAlgorithm.RECURSIVE_DOUBLING}),
     "allreduce": frozenset({CollectiveAlgorithm.RING,
                             CollectiveAlgorithm.FUSED_RING,
-                            CollectiveAlgorithm.NON_FUSED}),
-    "reduce_scatter": frozenset({CollectiveAlgorithm.RING}),
+                            CollectiveAlgorithm.NON_FUSED,
+                            CollectiveAlgorithm.RECURSIVE_DOUBLING}),
+    "reduce_scatter": frozenset({CollectiveAlgorithm.RING,
+                                 CollectiveAlgorithm.RECURSIVE_DOUBLING}),
 }
 
 
 # What AUTO resolves to when no tuner is attached: one table shared by the
 # move engine's dispatch and the tuner's fallback path, so the static
-# defaults cannot drift between the two resolvers.
+# defaults cannot drift between the two resolvers. The log-depth family
+# (RECURSIVE_DOUBLING / rooted TREE) is deliberately NOT a static default:
+# untuned AUTO keeps the size-independent ring/rr behavior every tier
+# (including the native daemon) implements, and the size-aware switch to
+# log-depth at small nbytes is the tuner's job (tuner/cost.py).
 DEFAULT_ALGORITHMS: dict[str, CollectiveAlgorithm] = {
     "bcast": CollectiveAlgorithm.ROUND_ROBIN,
     "scatter": CollectiveAlgorithm.ROUND_ROBIN,
@@ -214,6 +229,12 @@ class ErrorCode(enum.IntFlag):
     CONNECTION_CLOSED = 1 << 21
     DEVICE_NOT_READY = 1 << 22
     INVALID_CALL = 1 << 23
+    # a deferred MSG_WAIT asked about a call id so old that BOTH its
+    # status entry and (if it failed) its failed-calls record aged out of
+    # the daemons' bounded maps: FIFO retirement proves the call retired,
+    # but its outcome is genuinely unknowable — saying so beats the
+    # false-success 0 the eviction used to fabricate
+    CALL_OUTCOME_UNKNOWN = 1 << 24
 
 
 class StackType(enum.IntEnum):

@@ -166,15 +166,20 @@ class SimDevice(Device):
         """Socket-daemon tier: a hop pays an RPC to the daemon plus the
         eth-fabric socket transfer (low hundreds of microseconds);
         bandwidth is loopback-TCP-framed. World size from the daemon's
-        geometry when it reports one."""
-        from ..tuner.cost import Topology
+        geometry when it reports one. ``supported`` is the legacy
+        ring/rr set: the peer behind the socket may be the native C++
+        daemon, which validates and expands only that family — AUTO must
+        never resolve to a log-depth algorithm it would reject (explicit
+        selectors still pass through to the Python daemon, which
+        implements the full family)."""
+        from ..tuner.cost import LEGACY_ALGORITHM_PAIRS, Topology
         world = 0
         try:
             world = int(self.get_info().get("world", 0))
         except Exception:  # pre-GET_INFO daemons: world stays unknown
             pass
         return Topology(world_size=world, alpha_us=150.0, beta_gbps=0.5,
-                        tier="sim")
+                        tier="sim", supported=LEGACY_ALGORITHM_PAIRS)
 
     def set_max_segment_size(self, nbytes: int):
         self._check(bytes([P.MSG_SET_SEG]) + struct.pack("<Q", nbytes))
@@ -706,10 +711,7 @@ class SimDevice(Device):
                             nxt_pending.append((desc, call_id, handle))
                             continue
                         if not err and res_buf is not None:
-                            assert data_reply[0] == P.MSG_DATA
-                            flat = res_buf.data.reshape(-1).view("uint8")
-                            flat[:] = np.frombuffer(data_reply, np.uint8,
-                                                    offset=1)
+                            self._land_result(res_buf, data_reply)
                             handle.complete(err)
                         else:
                             # big/absent result, or a failed call whose

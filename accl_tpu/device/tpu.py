@@ -594,6 +594,16 @@ class TpuDevice(Device):
         return arr
 
     def make_device_array(self, shape, dtype, init=None):
+        if _noncanonical(dtype):
+            # with x64 off, device_put would quietly canonicalize the
+            # array to 32 bits AT CREATION — every later read of the
+            # "int64/f64 device buffer" would see truncated values.
+            # Refuse here, the root of that datapath, rather than let
+            # _write_result discover the corruption later.
+            raise ValueError(
+                f"device-resident buffers cannot hold {np.dtype(dtype).name}"
+                f" (jax x64-off canonicalizes it to 32 bits); use a "
+                f"host-mirror buffer for 64-bit dtypes")
         host = (np.zeros(shape, dtype) if init is None
                 else np.asarray(init, dtype).reshape(shape))
         return jax.device_put(host, self.my_device)
@@ -754,6 +764,19 @@ class TpuDevice(Device):
                else cfg.uncompressed_dtype)
         buf = self.dev_bufs.get(addr)
         if buf is not None:
+            if _noncanonical(np.dtype(out)):
+                # a device-resident landing re-enters _rebind_dev, whose
+                # device_put canonicalizes int64/f64 to 32 bits — the
+                # silent-truncation path every other noncanon gate in
+                # this file exists to prevent. make_device_array rejects
+                # creating such buffers, so this guards adopted/aliased
+                # corners: refuse loudly rather than corrupt the result.
+                from ..constants import ACCLError
+                raise ACCLError(
+                    int(ErrorCode.INVALID_CALL),
+                    f"{np.dtype(out).name} result cannot land in a "
+                    f"device-resident buffer (jax x64-off would truncate "
+                    f"it); use a host-mirror buffer for 64-bit dtypes")
             self._rebind_dev(buf, np.asarray(data, dtype=out))
             return
         self.mem.write(addr, np.asarray(data, dtype=out))
@@ -1190,16 +1213,18 @@ class TpuDevice(Device):
         # has 2D structure — O(outer+inner) hop fan-out instead of the
         # psum/all_gather-class traffic of the masked 1-D lowerings (which
         # cost allreduce/allgather bandwidth regardless of root). Explicit
-        # ROUND_ROBIN/RING selectors keep the 1-D path; the TREE selector
-        # exists only for bcast (VALID_ALGORITHMS — scatter/gather/reduce
-        # reach the tree via AUTO). Rooted reduce rides the tree only
-        # uncompressed: the tree has no wire-compression lanes, and the
-        # compressed 1-D path's decompress-before-arith numerics must win.
+        # ROUND_ROBIN/RING selectors keep the 1-D path; the explicit TREE
+        # selector (legal for bcast/gather/reduce, VALID_ALGORITHMS) pins
+        # the tree — scatter reaches it via AUTO only. Rooted reduce
+        # rides the tree only uncompressed: the tree has no
+        # wire-compression lanes, and the compressed 1-D path's
+        # decompress-before-arith numerics must win.
         rooted = (CCLOp.bcast, CCLOp.scatter, CCLOp.gather, CCLOp.reduce)
         use_tree = (op in rooted
                     and (d0.algorithm == CollectiveAlgorithm.AUTO
-                         or (op == CCLOp.bcast
-                             and d0.algorithm == CollectiveAlgorithm.TREE))
+                         or (d0.algorithm == CollectiveAlgorithm.TREE
+                             and op in (CCLOp.bcast, CCLOp.gather,
+                                        CCLOp.reduce)))
                     and not (op == CCLOp.reduce and wire is not None))
         tree = ctx.tree_for(comm) if use_tree else None
         root = d0.root_src_dst

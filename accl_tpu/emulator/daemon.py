@@ -539,6 +539,11 @@ class RankDaemon:
         # dependency's failure even after the client polled it. Bounded
         # FIFO — ancient failures age out.
         self._failed_calls: dict[int, int] = {}
+        # highest FAILED id the bounded FIFO above aged out: a deferred
+        # MSG_WAIT for an id at/below this mark cannot distinguish
+        # success from an evicted failure — it must answer
+        # CALL_OUTCOME_UNKNOWN, never fabricate a 0
+        self._failed_evicted_max = 0
         self._call_cv = threading.Condition()
         self._call_queue: list[tuple[int, dict]] = []
         self._stop = threading.Event()
@@ -622,7 +627,10 @@ class RankDaemon:
         if err:
             self._failed_calls[call_id] = err
             while len(self._failed_calls) > 1024:
-                self._failed_calls.pop(next(iter(self._failed_calls)))
+                aged = next(iter(self._failed_calls))
+                self._failed_calls.pop(aged)
+                if aged > self._failed_evicted_max:
+                    self._failed_evicted_max = aged
         # Bound the status map: a chain client that waits only the LAST
         # id (call_chain's documented pattern) would otherwise leak one
         # retired entry per unwaited link forever. At most ONE eviction
@@ -1022,9 +1030,17 @@ class RankDaemon:
                         if (call_id not in self._call_status
                                 and call_id <= self._evicted_max):
                             # evicted after retirement: FIFO means it DID
-                            # retire; failures survive in _failed_calls
-                            return P.status_reply(
-                                self._failed_calls.get(call_id, 0))
+                            # retire; failures survive in _failed_calls —
+                            # unless they TOO aged out of the bounded
+                            # failure FIFO, in which case the outcome is
+                            # unknowable and 0 would be a fabricated
+                            # success
+                            err = self._failed_calls.get(call_id)
+                            if err is None:
+                                err = (int(ErrorCode.CALL_OUTCOME_UNKNOWN)
+                                       if call_id <=
+                                       self._failed_evicted_max else 0)
+                            return P.status_reply(err)
                         remaining = deadline - _time.monotonic()
                         if remaining <= 0:
                             return P.status_reply(P.STATUS_PENDING)

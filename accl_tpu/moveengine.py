@@ -21,6 +21,10 @@ Collective expansions mirror the reference algorithms one-for-one so a
 reviewer can diff them against ccl_offload_control.c:502-1098:
 ring gather/allgather/reduce/reduce_scatter, 2-phase ring allreduce
 (fused reduce-scatter + allgather), segmented broadcast, strided scatter.
+Beyond the reference's ring/round-robin firmware, a log-depth family
+(recursive doubling/halving, Rabenseifner allreduce, binomial trees —
+see the section comment above expand_allgather_recursive_doubling)
+covers the small-message regime where serialized alpha hops dominate.
 """
 
 from __future__ import annotations
@@ -219,7 +223,8 @@ def expand_send(ctx: MoveContext, count: int, src: int, dst_rank: int,
                 compression: Compression = Compression.NONE,
                 stream: StreamFlags = StreamFlags.NO_STREAM,
                 to_remote_stream: bool = False,
-                blocking: bool = True, laned: bool = False) -> list[Move]:
+                blocking: bool = True, laned: bool = False,
+                lane_base: int | None = None) -> list[Move]:
     """send (c:339-361): segmented op0 -> remote res.
 
     Wire compression applies when ETH_COMPRESSED is set; segmentation at
@@ -230,11 +235,17 @@ def expand_send(ctx: MoveContext, count: int, src: int, dst_rank: int,
     ``laned=True`` additionally tags each segment with its lane — callers
     assert the Move.lane invariant: segment ``s`` reads only bytes written
     by earlier moves of lane ``s`` (the relay-from-slot shape).
+    ``lane_base`` (implies laned) offsets the lane ids — the log-depth
+    expansions lane per GLOBAL chunk (lane = chunk * segs_per_chunk + s)
+    so a chunk's reader in round k+1 chains behind the same chunk's
+    writer in round k even though the two moves cover different regions.
     """
     eth_c = bool(compression & Compression.ETH_COMPRESSED)
     moves = []
     seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
     ebytes = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    if lane_base is not None:
+        laned = True
     for si, (off, n) in enumerate(_segments(count, seg)):
         op0 = (Operand.stream() if stream & StreamFlags.OP0_STREAM
                else Operand.imm(src + off * ebytes,
@@ -242,7 +253,7 @@ def expand_send(ctx: MoveContext, count: int, src: int, dst_rank: int,
         moves.append(Move(count=n, op0=op0, res_remote=True,
                           dst_rank=dst_rank, tag=tag, eth_compressed=eth_c,
                           remote_stream=to_remote_stream, blocking=blocking,
-                          lane=si if laned else None,
+                          lane=((lane_base or 0) + si) if laned else None,
                           mode_label="IMMEDIATE/NONE/REMOTE"))
     return moves
 
@@ -250,13 +261,19 @@ def expand_send(ctx: MoveContext, count: int, src: int, dst_rank: int,
 def expand_recv(ctx: MoveContext, count: int, src_rank: int, dst: int,
                 tag: int = 0,
                 compression: Compression = Compression.NONE,
-                stream: StreamFlags = StreamFlags.NO_STREAM) -> list[Move]:
+                stream: StreamFlags = StreamFlags.NO_STREAM,
+                laned: bool = True,
+                lane_base: int | None = None) -> list[Move]:
     """recv (c:365-380): segmented ON_RECV -> local res.
 
     Each segment carries its lane tag: segment ``s`` writes only its own
     slice of ``dst``, so recv-matching of segment ``s+1`` may overlap the
     consumption of segment ``s`` (Move.lane invariant; the one consumer
     that re-reads the written slice — a relay — rides the SAME lane).
+    ``laned=False`` is for documented barrier phases (the log-depth vrank
+    fold-in/fold-out), whose whole-result transfers span regions written
+    by many lanes; ``lane_base`` offsets lane ids for global-chunk laning
+    (see expand_send).
     """
     eth_c = bool(compression & Compression.ETH_COMPRESSED)
     moves = []
@@ -268,7 +285,7 @@ def expand_recv(ctx: MoveContext, count: int, src_rank: int, dst: int,
                                 bool(compression & Compression.RES_COMPRESSED)))
         moves.append(Move(count=n, op1=Operand.on_recv(src_rank, tag),
                           res=res, res_local=True, eth_compressed=eth_c,
-                          lane=si,
+                          lane=((lane_base or 0) + si) if laned else None,
                           mode_label="NONE/ON_RECV/IMMEDIATE"))
     return moves
 
@@ -276,7 +293,8 @@ def expand_recv(ctx: MoveContext, count: int, src_rank: int, dst: int,
 def expand_fused_recv_reduce(ctx: MoveContext, count: int, func: ReduceFunc,
                              src_rank: int, op0: int, dst: int, tag: int = 0,
                              compression: Compression = Compression.NONE,
-                             ) -> list[Move]:
+                             laned: bool = True,
+                             lane_base: int | None = None) -> list[Move]:
     """fused_recv_reduce (c:441-467): res = func(op0, incoming).
 
     Lane-tagged per segment: segment ``s`` reads op0 slice ``s`` and
@@ -284,6 +302,9 @@ def expand_fused_recv_reduce(ctx: MoveContext, count: int, func: ReduceFunc,
     combine of segment ``s`` overlaps the recv-match of ``s+1``
     (Move.lane invariant). Chained folds that read the previous fold's
     res as op0 (reduce_direct) are ordered lane-locally for free.
+    ``laned=False`` marks documented barrier phases (log-depth vrank
+    fold-in over the whole vector); ``lane_base`` offsets lane ids for
+    global-chunk laning (see expand_send).
     """
     eth_c = bool(compression & Compression.ETH_COMPRESSED)
     seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
@@ -298,7 +319,8 @@ def expand_fused_recv_reduce(ctx: MoveContext, count: int, func: ReduceFunc,
             op1=Operand.on_recv(src_rank, tag),
             res=Operand.imm(dst + off * er,
                             bool(compression & Compression.RES_COMPRESSED)),
-            func=func, res_local=True, eth_compressed=eth_c, lane=si,
+            func=func, res_local=True, eth_compressed=eth_c,
+            lane=((lane_base or 0) + si) if laned else None,
             mode_label="IMMEDIATE/ON_RECV/IMMEDIATE"))
     return moves
 
@@ -308,13 +330,14 @@ def expand_fused_recv_reduce_send(ctx: MoveContext, count: int,
                                   dst_rank: int, op0: int, tag: int = 0,
                                   dst: int | None = None,
                                   compression: Compression = Compression.NONE,
-                                  ) -> list[Move]:
+                                  lane_base: int | None = None) -> list[Move]:
     """fused_recv_reduce_send (c:473-500): func(op0, incoming) -> peer
     (and optionally also to local dst — the RES_REMOTE|RES_LOCAL form used
     by allreduce phase 1, c:993-1023). Lane-tagged per segment like
     ``expand_fused_recv_reduce`` — the recv→combine→relay of segment ``s``
     forms one lane, so the relay of ``s-1`` streams out while ``s``
-    combines and ``s+1`` recv-matches."""
+    combines and ``s+1`` recv-matches. ``lane_base`` offsets lane ids for
+    the log-depth expansions' global-chunk laning (see expand_send)."""
     eth_c = bool(compression & Compression.ETH_COMPRESSED)
     seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size, eth_c)
     e0 = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
@@ -331,7 +354,8 @@ def expand_fused_recv_reduce_send(ctx: MoveContext, count: int,
             op1=Operand.on_recv(src_rank, tag),
             res=res, func=func,
             res_remote=True, res_local=dst is not None,
-            dst_rank=dst_rank, tag=tag, eth_compressed=eth_c, lane=si,
+            dst_rank=dst_rank, tag=tag, eth_compressed=eth_c,
+            lane=(lane_base or 0) + si,
             mode_label="IMMEDIATE/ON_RECV/REMOTE(+LOCAL)"))
     return moves
 
@@ -772,6 +796,613 @@ def expand_allreduce_nonfused(ctx: MoveContext, count: int, func: ReduceFunc,
     return moves
 
 
+# ---------------------------------------------------------------------------
+# Log-depth family: recursive doubling/halving + binomial trees
+# (TPU-native latency-optimal variants; the reference reserves the
+# algorithm axis in xlnx-consts.hpp:43-66 — ring/rr are its only
+# firmware expansions. ACCL+ [arXiv:2312.11742] shows algorithm choice
+# dominating in the small-message regime these target.)
+#
+# Shared conventions:
+#   * Non-power-of-2 worlds fold to p = 2^floor(log2 W) vranks: the first
+#     2r ranks (r = W - p) pair up {even participant, odd extra}; extras
+#     contribute their data in a PRE phase and receive their result in a
+#     POST phase. Fold-phase moves are documented BARRIERS (blocking,
+#     lane=None): their whole-vector transfers span regions written by
+#     many lanes, so no single lane edge can order them.
+#   * Pairwise exchange rounds are laned per GLOBAL chunk: every move
+#     touching chunk c, wire segment s carries lane c*S + s (S = wire
+#     segments per chunk), so the reader of chunk c in round k+1 chains
+#     behind the writer of chunk c in round k (a lane-local RAW edge the
+#     streamed executor pipelines), while sibling chunks/segments — whose
+#     bytes are disjoint — stream concurrently (Move.lane invariant).
+#   * No scratch region is ever REUSED for two different payloads (the
+#     gather-ring relay hazard class): each chunk slot is written exactly
+#     once per program, which is what makes the laned non-blocking
+#     relays legal.
+# ---------------------------------------------------------------------------
+
+def _vrank_fold(world: int, rank: int) -> tuple[int, int, int | None]:
+    """(p, r, vrank) of the standard 2^floor(log2 W) fold: p participants,
+    r = W - p extras. Ranks below 2r pair up — even ranks participate as
+    vrank rank/2 carrying their odd neighbor's data; odd ranks are extras
+    (vrank None). Ranks at/above 2r participate as vrank rank - r."""
+    p = 1 << (world.bit_length() - 1)
+    r = world - p
+    if rank < 2 * r:
+        return p, r, rank // 2 if rank % 2 == 0 else None
+    return p, r, rank - r
+
+
+def _vrank_to_rank(v: int, r: int) -> int:
+    """Inverse of the fold's vrank assignment."""
+    return 2 * v if v < r else v + r
+
+
+def _vchunks(v: int, r: int) -> tuple[int, ...]:
+    """Real chunk indices vrank ``v`` represents: its own rank's chunk
+    plus — for paired participants — the extra neighbor's. Ascending, and
+    contiguous across ascending vranks (the fold preserves rank order)."""
+    return (2 * v, 2 * v + 1) if v < r else (v + r,)
+
+
+def _block_chunks(base: int, n: int, r: int) -> list[int]:
+    """Chunks represented by the vrank block [base, base+n) — the unit
+    recursive doubling/halving exchanges. Sorted ascending on both sides
+    of a pairwise exchange, so per-peer wire order (and therefore seqn
+    matching) agrees between partners by construction."""
+    return [c for u in range(base, base + n) for c in _vchunks(u, r)]
+
+
+def _chunk_span(base: int, n: int, r: int) -> tuple[int, int]:
+    """[lo, hi) real-chunk range of the vrank block [base, base+n) — the
+    fold preserves rank order, so a vrank block's chunks are CONTIGUOUS.
+    This is what lets the latency-regime transfer mode below ship a
+    whole block as one wire message."""
+    lo = 2 * base if base < r else base + r
+    last = base + n - 1
+    return lo, (2 * last + 1 if last < r else last + r) + 1
+
+
+# Two transfer granularities per exchange round (selected identically on
+# every rank from world/count/segment-size, so wire order agrees):
+#   * BLOCK mode — the whole working vector fits ONE wire segment (the
+#     alpha-dominated regime the family exists for): each round moves its
+#     contiguous chunk block as a single message, so a rank pays
+#     ceil(log2 W) messages total instead of the ring's W-1. All block
+#     moves ride ONE lane (lane 0): the send of round k+1 reads bytes
+#     round k's recv wrote, and the shared lane chain IS that RAW edge —
+#     cross-round segment pipelining cannot exist at one segment anyway.
+#   * CHUNK mode — otherwise: per-chunk messages with global-chunk lanes
+#     (lane = c*S + s), so the streamed executor pipelines segments of
+#     independent chunks across rounds.
+def _block_xfer(ctx: MoveContext, total_count: int,
+                compression: Compression) -> bool:
+    seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size,
+                     bool(compression & Compression.ETH_COMPRESSED))
+    return total_count <= seg
+
+
+def _chunk_lanes(ctx: MoveContext, count: int,
+                 compression: Compression) -> int:
+    """Wire segments per chunk — the global-chunk lane stride. Constant
+    across rounds (segmentation depends only on the wire element size),
+    so lane c*S + s names the same bytes of chunk c in every round."""
+    seg = _seg_elems(ctx.arithcfg, ctx.max_segment_size,
+                     bool(compression & Compression.ETH_COMPRESSED))
+    return max(1, -(-count // seg))
+
+
+def expand_allgather_recursive_doubling(ctx: MoveContext, count: int,
+                                        src: int, dst: int,
+                                        compression: Compression =
+                                        Compression.NONE) -> list[Move]:
+    """allgather, recursive doubling: ceil(log2 W) pairwise exchange
+    rounds instead of the ring's W-1 dependency hops; round k swaps the
+    2^k chunks each side has accumulated. ``count`` is the per-rank
+    chunk size. Non-power-of-2 worlds fold (module comment above)."""
+    W, me = ctx.world_size, ctx.local_rank
+    if W == 1:
+        return expand_copy(ctx, count, src, dst, compression)
+    e_dst = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    p, r, v = _vrank_fold(W, me)
+    S = _chunk_lanes(ctx, count, compression)
+    p2 = res_as_op0(compression)
+    moves: list[Move] = []
+    if v is None:
+        partner = me - 1
+        # fold-in barrier: contribute my chunk (default blocking send —
+        # fold phases are documented barriers, not pipelined lanes)
+        moves += expand_send(ctx, count, src, partner, tag=TAG_ANY,
+                             compression=compression)
+        # fold-out barrier: the whole gathered vector lands in dst
+        moves += expand_recv(ctx, W * count, partner, dst, tag=TAG_ANY,
+                             compression=compression, laned=False)
+        return moves
+    moves += expand_copy(ctx, count, src, dst + me * count * e_dst,
+                         compression)
+    if me < 2 * r:
+        # fold-in barrier: adopt the extra partner's chunk into its slot
+        moves += expand_recv(ctx, count, me + 1,
+                             dst + (me + 1) * count * e_dst, tag=TAG_ANY,
+                             compression=compression, laned=False)
+    block = _block_xfer(ctx, W * count, compression)
+    mask = 1
+    while mask < p:
+        pv = v ^ mask
+        partner = _vrank_to_rank(pv, r)
+        if block:
+            mlo, mhi = _chunk_span(v & ~(mask - 1), mask, r)
+            tlo, thi = _chunk_span(pv & ~(mask - 1), mask, r)
+            # one message per round: the whole owned block from dst (own
+            # chunk was copied there up front; every slot is written
+            # once, and later recvs only write blocks I don't own yet —
+            # never this source). Single shared lane: the chain orders
+            # this send behind the previous round's recv (a lane-local
+            # RAW edge).
+            moves += expand_send(ctx, (mhi - mlo) * count,
+                                 dst + mlo * count * e_dst, partner,
+                                 tag=TAG_ANY, compression=p2,
+                                 blocking=False, laned=True)
+            moves += expand_recv(ctx, (thi - tlo) * count, partner,
+                                 dst + tlo * count * e_dst, tag=TAG_ANY,
+                                 compression=compression)
+            mask <<= 1
+            continue
+        mine = _block_chunks(v & ~(mask - 1), mask, r)
+        theirs = _block_chunks(pv & ~(mask - 1), mask, r)
+        for c in mine:
+            if c == me:
+                # own chunk straight from src: read-only the whole call
+                moves += expand_send(ctx, count, src, partner, tag=TAG_ANY,
+                                     compression=compression,
+                                     blocking=False, lane_base=c * S)
+            else:
+                # relay of an accumulated chunk: its dst slot is written
+                # exactly once (fold-in barrier or this chunk's lane
+                # recvs), so the RAW hazard is a lane-local edge and the
+                # send overlaps sibling chunks' recvs. Reads dst, which
+                # is RES-typed — substitute the flag like the firmware's
+                # relay-from-dst (c:739-743).
+                moves += expand_send(ctx, count, dst + c * count * e_dst,
+                                     partner, tag=TAG_ANY, compression=p2,
+                                     blocking=False, lane_base=c * S)
+        for c in theirs:
+            moves += expand_recv(ctx, count, partner,
+                                 dst + c * count * e_dst, tag=TAG_ANY,
+                                 compression=compression, lane_base=c * S)
+        mask <<= 1
+    if me < 2 * r:
+        # fold-out barrier: ship the whole gathered vector to the extra
+        # (reads every chunk slot — spans all lanes, so it must drain)
+        moves += expand_send(ctx, W * count, dst, me + 1, tag=TAG_ANY,
+                             compression=p2)
+    return moves
+
+
+def expand_reduce_scatter_recursive_halving(
+        ctx: MoveContext, count: int, func: ReduceFunc, src: int, dst: int,
+        scratch: int, compression: Compression = Compression.NONE
+        ) -> list[Move]:
+    """reduce_scatter, recursive halving: ceil(log2 W) rounds, each
+    exchanging partials for the half of the active chunk range the
+    partner's sub-block owns. ``count`` is the per-rank chunk size.
+
+    ``scratch`` (the descriptor's addr_1, driver-plumbed) must hold
+    ``W*count`` elements in the UNCOMPRESSED dtype: the working vector of
+    partial sums. Each scratch chunk is written once per round it stays
+    active (always by its own global-chunk lane), never reused for a
+    different payload."""
+    W, me = ctx.world_size, ctx.local_rank
+    if W == 1:
+        return expand_copy(ctx, count, src, dst, compression)
+    if not scratch:
+        raise ValueError(
+            "reduce_scatter RECURSIVE_DOUBLING requires a scratch buffer "
+            "of world_size*count uncompressed elements in addr_1 (the "
+            "ACCL driver allocates and plumbs one automatically)")
+    p, r, v = _vrank_fold(W, me)
+    S = _chunk_lanes(ctx, count, compression)
+    e_src = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    e_u = ctx.ebytes(False)                    # scratch is uncompressed
+    eth = compression & Compression.ETH_COMPRESSED
+    moves: list[Move] = []
+    if v is None:
+        partner = me - 1
+        # fold-in barrier: contribute the whole vector
+        moves += expand_send(ctx, W * count, src, partner, tag=TAG_ANY,
+                             compression=compression)
+        # fold-out barrier: my fully-reduced chunk
+        moves += expand_recv(ctx, count, partner, dst, tag=TAG_ANY,
+                             compression=compression, laned=False)
+        return moves
+    in_scratch: set[int] = set()
+    if me < 2 * r:
+        # fold-in barrier: reduce the extra's whole vector into scratch
+        # (op0 = src; scratch result is uncompressed, so RES clears)
+        moves += expand_fused_recv_reduce(
+            ctx, W * count, func, me + 1, src, scratch, tag=TAG_ANY,
+            compression=compression & ~Compression.RES_COMPRESSED,
+            laned=False)
+        in_scratch = set(range(W))
+    block = _block_xfer(ctx, W * count, compression)
+    half = p >> 1
+    while half:
+        pv = v ^ half
+        partner = _vrank_to_rank(pv, r)
+        if block:
+            klo, khi = _chunk_span(v & ~(half - 1), half, r)
+            glo, ghi = _chunk_span(pv & ~(half - 1), half, r)
+            folded = bool(in_scratch)   # round-1 partials may still be src
+            # one message per round: partials for the partner's whole
+            # contiguous block. Sources are src (read-only) or scratch
+            # regions written exactly once by the previous round's fused
+            # move on this same single lane (the lane chain is the RAW
+            # edge); the give block leaves the active range, never
+            # written again.
+            moves += expand_send(
+                ctx, (ghi - glo) * count,
+                (scratch + glo * count * e_u if folded
+                 else src + glo * count * e_src),
+                partner, tag=TAG_ANY,
+                compression=eth if folded else compression,
+                blocking=False, laned=True)
+            moves += expand_fused_recv_reduce(
+                ctx, (khi - klo) * count, func, partner,
+                (scratch + klo * count * e_u if folded
+                 else src + klo * count * e_src),
+                scratch + klo * count * e_u, tag=TAG_ANY,
+                compression=(eth if folded
+                             else compression
+                             & ~Compression.RES_COMPRESSED))
+            in_scratch.update(range(klo, khi))
+            half >>= 1
+            continue
+        keep = _block_chunks(v & ~(half - 1), half, r)
+        give = _block_chunks(pv & ~(half - 1), half, r)
+        for c in give:
+            if c in in_scratch:
+                # partials for the partner's half: the scratch chunk was
+                # written exactly once since (by lane c*S moves — a
+                # lane-local edge) and never again (it leaves the active
+                # range), so the send is non-blocking
+                moves += expand_send(ctx, count, scratch + c * count * e_u,
+                                     partner, tag=TAG_ANY, compression=eth,
+                                     blocking=False, lane_base=c * S)
+            else:
+                # first round, no fold: partials ARE src — read-only
+                moves += expand_send(ctx, count, src + c * count * e_src,
+                                     partner, tag=TAG_ANY,
+                                     compression=compression,
+                                     blocking=False, lane_base=c * S)
+        for c in keep:
+            op0 = (scratch + c * count * e_u if c in in_scratch
+                   else src + c * count * e_src)
+            comp = (eth if c in in_scratch
+                    else compression & ~Compression.RES_COMPRESSED)
+            moves += expand_fused_recv_reduce(
+                ctx, count, func, partner, op0, scratch + c * count * e_u,
+                tag=TAG_ANY, compression=comp, lane_base=c * S)
+        in_scratch.update(keep)
+        half >>= 1
+    # epilogue: my chunk lands in dst (local copy — scratch is
+    # uncompressed, dst carries the call's RES compression)
+    moves += expand_copy(ctx, count, scratch + me * count * e_u, dst,
+                         compression & Compression.RES_COMPRESSED)
+    if me < 2 * r:
+        # fold-out barrier: the extra's fully-reduced chunk
+        moves += expand_send(ctx, count, scratch + (me + 1) * count * e_u,
+                             me + 1, tag=TAG_ANY, compression=eth)
+    return moves
+
+
+def expand_allreduce_rd(ctx: MoveContext, count: int, func: ReduceFunc,
+                        src: int, dst: int,
+                        compression: Compression = Compression.NONE
+                        ) -> list[Move]:
+    """allreduce, Rabenseifner: recursive-halving reduce-scatter followed
+    by recursive-doubling allgather — 2*ceil(log2 W) dependency rounds
+    against the fused ring's 2(W-1), at the same ~2n(W-1)/W wire volume.
+    ``count`` is the TOTAL element count, chunked with the ring
+    expansion's bulk/tail split (c:966-967); ``dst`` doubles as the
+    working vector for the halving phase, so no scratch is needed."""
+    W, me = ctx.world_size, ctx.local_rank
+    if W == 1:
+        return expand_copy(ctx, count, src, dst, compression)
+    p, r, v = _vrank_fold(W, me)
+    e_src = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    e_dst = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    bulk = count // W
+    tail = count - bulk * (W - 1)   # last chunk absorbs the remainder
+
+    def c_off(c: int) -> int:
+        return c * bulk
+
+    def c_len(c: int) -> int:
+        return tail if c == W - 1 else bulk
+
+    S = _chunk_lanes(ctx, tail, compression)  # tail >= bulk bounds lanes
+    p2 = res_as_op0(compression)
+    moves: list[Move] = []
+    if v is None:
+        partner = me - 1
+        # fold-in barrier: whole input vector
+        moves += expand_send(ctx, count, src, partner, tag=TAG_ANY,
+                             compression=compression)
+        # fold-out barrier: whole reduced vector
+        moves += expand_recv(ctx, count, partner, dst, tag=TAG_ANY,
+                             compression=compression, laned=False)
+        return moves
+    in_dst: set[int] = set()
+    if me < 2 * r:
+        # fold-in barrier: reduce the extra's whole vector into dst
+        moves += expand_fused_recv_reduce(ctx, count, func, me + 1, src,
+                                          dst, tag=TAG_ANY,
+                                          compression=compression,
+                                          laned=False)
+        in_dst = set(range(W))
+    def span(lo: int, hi: int) -> tuple[int, int]:
+        """Chunk range [lo, hi) -> (element offset, element count)."""
+        if lo >= hi:
+            return 0, 0
+        return c_off(lo), c_off(hi - 1) + c_len(hi - 1) - c_off(lo)
+
+    block = _block_xfer(ctx, count, compression)
+    # --- phase 1: recursive-halving reduce-scatter over dst ---
+    half = p >> 1
+    while half:
+        pv = v ^ half
+        partner = _vrank_to_rank(pv, r)
+        if block:
+            goff, gn = span(*_chunk_span(pv & ~(half - 1), half, r))
+            koff, kn = span(*_chunk_span(v & ~(half - 1), half, r))
+            folded = bool(in_dst)   # round-1 partials may still be src
+            # one message per round (see _block_xfer): partials for the
+            # partner's contiguous half, from src (read-only) or from
+            # dst written exactly once by the previous round's fused
+            # move on this same single lane; the give half leaves the
+            # active range and is untouched until phase 2's recv, which
+            # the shared lane chain orders behind this send
+            if gn:
+                moves += expand_send(
+                    ctx, gn, (dst + goff * e_dst if folded
+                              else src + goff * e_src),
+                    partner, tag=TAG_ANY,
+                    compression=p2 if folded else compression,
+                    blocking=False, laned=True)
+            if kn:
+                op0 = (dst + koff * e_dst if folded
+                       else src + koff * e_src)
+                comp = p2 if folded else compression
+                if half == 1:
+                    # the last halving partner IS the first doubling
+                    # partner (v^1): fuse the final reduce with the
+                    # phase-2 kickoff — result lands in dst AND ships to
+                    # the partner in one move, saving a dependency round
+                    # (the firmware's RES_REMOTE|RES_LOCAL form,
+                    # c:993-1023). Phase 2's mask=1 send is skipped.
+                    moves += expand_fused_recv_reduce_send(
+                        ctx, kn, func, partner, partner, op0,
+                        tag=TAG_ANY, dst=dst + koff * e_dst,
+                        compression=comp)
+                else:
+                    moves += expand_fused_recv_reduce(
+                        ctx, kn, func, partner, op0, dst + koff * e_dst,
+                        tag=TAG_ANY, compression=comp)
+            in_dst.update(range(W))
+            half >>= 1
+            continue
+        keep = _block_chunks(v & ~(half - 1), half, r)
+        give = _block_chunks(pv & ~(half - 1), half, r)
+        for c in give:
+            if not c_len(c):
+                continue
+            if c in in_dst:
+                # partials of the partner's half, accumulated in dst:
+                # written exactly once since by this chunk's lane (a
+                # lane-local edge), never written again — non-blocking
+                moves += expand_send(ctx, c_len(c),
+                                     dst + c_off(c) * e_dst, partner,
+                                     tag=TAG_ANY, compression=p2,
+                                     blocking=False, lane_base=c * S)
+            else:
+                # first round without a fold: partials ARE src (read-only)
+                moves += expand_send(ctx, c_len(c),
+                                     src + c_off(c) * e_src, partner,
+                                     tag=TAG_ANY, compression=compression,
+                                     blocking=False, lane_base=c * S)
+        for c in keep:
+            if not c_len(c):
+                continue
+            op0 = (dst + c_off(c) * e_dst if c in in_dst
+                   else src + c_off(c) * e_src)
+            comp = p2 if c in in_dst else compression
+            if half == 1:
+                # last halving partner == first doubling partner (v^1):
+                # fuse the final reduce with the phase-2 kickoff (see
+                # the block-mode comment above)
+                moves += expand_fused_recv_reduce_send(
+                    ctx, c_len(c), func, partner, partner, op0,
+                    tag=TAG_ANY, dst=dst + c_off(c) * e_dst,
+                    compression=comp, lane_base=c * S)
+            else:
+                moves += expand_fused_recv_reduce(
+                    ctx, c_len(c), func, partner, op0,
+                    dst + c_off(c) * e_dst, tag=TAG_ANY,
+                    compression=comp, lane_base=c * S)
+        in_dst.update(keep)
+        half >>= 1
+    # --- phase 2: recursive-doubling allgather over dst ---
+    mask = 1
+    while mask < p:
+        pv = v ^ mask
+        partner = _vrank_to_rank(pv, r)
+        if block:
+            moff, mn = span(*_chunk_span(v & ~(mask - 1), mask, r))
+            toff, tn = span(*_chunk_span(pv & ~(mask - 1), mask, r))
+            # one message per round: my finalized contiguous block (each
+            # byte written exactly once — phase-1 fused move or an
+            # earlier phase-2 recv on this same single lane, which
+            # orders the relay behind it). The mask=1 send already left
+            # with the fused phase-1 kickoff.
+            if mn and mask != 1:
+                moves += expand_send(ctx, mn, dst + moff * e_dst, partner,
+                                     tag=TAG_ANY, compression=p2,
+                                     blocking=False, laned=True)
+            if tn:
+                moves += expand_recv(ctx, tn, partner, dst + toff * e_dst,
+                                     tag=TAG_ANY, compression=compression)
+            mask <<= 1
+            continue
+        mine = _block_chunks(v & ~(mask - 1), mask, r)
+        theirs = _block_chunks(pv & ~(mask - 1), mask, r)
+        for c in mine:
+            if not c_len(c) or mask == 1:
+                # mask=1 sends already left with the fused phase-1 kickoff
+                continue
+            # each dst chunk was finalized exactly once (phase-1 fused
+            # move or a phase-2 recv, both on lane c*S) and is never
+            # written again — the relay is a lane-local edge
+            moves += expand_send(ctx, c_len(c), dst + c_off(c) * e_dst,
+                                 partner, tag=TAG_ANY, compression=p2,
+                                 blocking=False, lane_base=c * S)
+        for c in theirs:
+            if not c_len(c):
+                continue
+            moves += expand_recv(ctx, c_len(c), partner,
+                                 dst + c_off(c) * e_dst, tag=TAG_ANY,
+                                 compression=compression, lane_base=c * S)
+        mask <<= 1
+    if me < 2 * r:
+        # fold-out barrier: whole reduced vector to the extra
+        moves += expand_send(ctx, count, dst, me + 1, tag=TAG_ANY,
+                             compression=p2)
+    return moves
+
+
+def expand_reduce_tree(ctx: MoveContext, count: int, root: int,
+                       func: ReduceFunc, src: int, dst: int,
+                       compression: Compression = Compression.NONE
+                       ) -> list[Move]:
+    """reduce, binomial tree: ceil(log2 W) dependency rounds (vs the
+    daisy chain's W-1), with the fold work spread across internal nodes
+    instead of serialized at one endpoint (reduce_direct's root). Works
+    for any W directly — no vrank fold needed.
+
+    Non-root internal nodes accumulate into ``dst`` used as an n-element
+    scratch (the gather-ring convention: non-root dst is scratch; the
+    ACCL driver allocates one when the caller passes none)."""
+    W, me = ctx.world_size, ctx.local_rank
+    if W == 1:
+        return expand_copy(ctx, count, src, dst, compression)
+    v = (me - root) % W
+    moves: list[Move] = []
+    first = True
+    mask = 1
+    while mask < W:
+        if v & mask:
+            parent = ((v ^ mask) + root) % W
+            if first:
+                # leaf: src is read-only and this send is the whole
+                # program — laned so the parent-side fused chain sees
+                # aligned per-segment lanes
+                moves += expand_send(ctx, count, src, parent, tag=TAG_ANY,
+                                     compression=compression,
+                                     blocking=False, laned=True)
+            else:
+                # internal node: the accumulator is complete — every
+                # child fold wrote segment s via lane s (lane-local RAW
+                # edges) and nothing writes it after this send
+                moves += expand_send(ctx, count, dst, parent, tag=TAG_ANY,
+                                     compression=res_as_op0(compression),
+                                     blocking=False, laned=True)
+            break
+        child_v = v + mask
+        if child_v < W:
+            if not dst:
+                raise ValueError(
+                    "reduce TREE requires an accumulator buffer on "
+                    "internal ranks (non-root dst is scratch; the ACCL "
+                    "driver allocates one automatically)")
+            op0 = src if first else dst
+            comp = compression if first else res_as_op0(compression)
+            moves += expand_fused_recv_reduce(
+                ctx, count, func, (child_v + root) % W, op0, dst,
+                tag=TAG_ANY, compression=comp)
+            first = False
+        mask <<= 1
+    return moves
+
+
+def expand_gather_tree(ctx: MoveContext, count: int, root: int, src: int,
+                       dst: int,
+                       compression: Compression = Compression.NONE
+                       ) -> list[Move]:
+    """gather, binomial tree: each rank receives its children's subtree
+    chunks, then forwards its whole subtree to its parent — ceil(log2 W)
+    dependency rounds vs the ring's W-1 relay hops, without the direct
+    algorithm's W-1 payload incast at root. Any W works directly.
+
+    Non-root ``dst`` is a subtree scratch holding
+    ``min(lowest_set_bit(vrank), W - vrank) - 1`` chunks in vrank order
+    (each written exactly once — never the ring's reused relay slot);
+    the driver sizes it via ``tree_gather_scratch_chunks``. Root lands
+    chunks straight into their owners' dst slots."""
+    W, me = ctx.world_size, ctx.local_rank
+    e = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    if W == 1:
+        return expand_copy(ctx, count, src, dst, compression)
+    v = (me - root) % W
+    S = _chunk_lanes(ctx, count, compression)
+    moves: list[Move] = []
+    if me == root:
+        moves += expand_copy(ctx, count, src, dst + root * count * e,
+                             compression)
+
+    def slot(u: int) -> int:
+        """Landing address of vrank u's chunk at this rank: the owner's
+        dst slot at root, the (u - v - 1)-th scratch slot elsewhere."""
+        if me == root:
+            return dst + ((u + root) % W) * count * e
+        return dst + (u - v - 1) * count * e
+
+    mask = 1
+    while mask < W:
+        if v & mask:
+            parent = ((v ^ mask) + root) % W
+            # own chunk first (src is read-only for the whole call),
+            # then the received subtree in vrank order
+            moves += expand_send(ctx, count, src, parent, tag=TAG_ANY,
+                                 compression=compression, blocking=False,
+                                 lane_base=((v + root) % W) * S)
+            for u in range(v + 1, min(v + mask, W)):
+                # relay of vrank u's chunk: its scratch slot was written
+                # exactly once, by this chunk's own lane recvs — a
+                # lane-local edge, so the forward is non-blocking
+                moves += expand_send(ctx, count, slot(u), parent,
+                                     tag=TAG_ANY,
+                                     compression=res_as_op0(compression),
+                                     blocking=False,
+                                     lane_base=((u + root) % W) * S)
+            break
+        child = ((v + mask) + root) % W
+        for u in range(v + mask, min(v + 2 * mask, W)):
+            moves += expand_recv(ctx, count, child, slot(u), tag=TAG_ANY,
+                                 compression=compression,
+                                 lane_base=((u + root) % W) * S)
+        mask <<= 1
+    return moves
+
+
+def tree_gather_scratch_chunks(world: int, rank: int, root: int) -> int:
+    """Chunks a non-root rank's TREE-gather scratch must hold (its
+    received subtree). The driver uses this to size the buffer it
+    substitutes when the caller passes none."""
+    v = (rank - root) % world
+    lsb = v & -v
+    return max(0, min(lsb, world - v) - 1)
+
+
 def expand_alltoall(ctx: MoveContext, count: int, src: int, dst: int,
                     compression: Compression = Compression.NONE) -> list[Move]:
     """alltoall (capability extension; the reference reserves the op in its
@@ -871,23 +1502,38 @@ def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
         return fn(ctx, count, root_src_dst, addr_0, addr_2, compression)
     if scenario == CCLOp.gather:
         fn = pick({A.RING: expand_gather_ring,
-                   A.ROUND_ROBIN: expand_gather_direct})
+                   A.ROUND_ROBIN: expand_gather_direct,
+                   A.TREE: expand_gather_tree})
         return fn(ctx, count, root_src_dst, addr_0, addr_2, compression)
     if scenario == CCLOp.reduce:
         fn = pick({A.RING: expand_reduce_ring,
-                   A.ROUND_ROBIN: expand_reduce_direct})
+                   A.ROUND_ROBIN: expand_reduce_direct,
+                   A.TREE: expand_reduce_tree})
         return fn(ctx, count, root_src_dst, func, addr_0, addr_2, compression)
     if scenario == CCLOp.allgather:
         fn = pick({A.RING: expand_allgather_ring,
-                   A.ROUND_ROBIN: expand_allgather_direct})
+                   A.ROUND_ROBIN: expand_allgather_direct,
+                   A.RECURSIVE_DOUBLING: expand_allgather_recursive_doubling})
         return fn(ctx, count, addr_0, addr_2, compression)
     if scenario == CCLOp.allreduce:
         fn = pick({A.RING: expand_allreduce_ring,
                    A.FUSED_RING: expand_allreduce_ring,
-                   A.NON_FUSED: expand_allreduce_nonfused})
+                   A.NON_FUSED: expand_allreduce_nonfused,
+                   A.RECURSIVE_DOUBLING: expand_allreduce_rd})
         return fn(ctx, count, func, addr_0, addr_2, compression)
     if scenario == CCLOp.reduce_scatter:
-        fn = pick({A.RING: expand_reduce_scatter_ring})
+        def _rs_rd(ctx, count, func, a0, a2, compression):
+            return expand_reduce_scatter_recursive_halving(
+                ctx, count, func, a0, a2, addr_1, compression)
+        table = {A.RING: expand_reduce_scatter_ring}
+        if addr_1 or alg == A.RECURSIVE_DOUBLING:
+            # the halving needs the driver-plumbed scratch (addr_1). An
+            # engine-level AUTO resolution on a raw descriptor without
+            # one must fall back to RING (table omission -> pick's
+            # DEFAULT path), while an EXPLICIT selector without scratch
+            # reaches the expansion and fails loudly there.
+            table[A.RECURSIVE_DOUBLING] = _rs_rd
+        fn = pick(table)
         return fn(ctx, count, func, addr_0, addr_2, compression)
     if scenario == CCLOp.alltoall:
         return expand_alltoall(ctx, count, addr_0, addr_2, compression)

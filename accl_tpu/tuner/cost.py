@@ -39,7 +39,27 @@ import math
 from ..constants import CollectiveAlgorithm, VALID_ALGORITHMS
 
 __all__ = ["Topology", "predict_us", "rank_algorithms",
-           "recommend_segment_size"]
+           "recommend_segment_size", "LEGACY_ALGORITHM_PAIRS"]
+
+
+# (op, algorithm) pairs every execution tier has always implemented —
+# the reference-derived ring/round-robin families plus the bcast tree.
+# A tier whose peer engine may lack the log-depth family (the socket
+# client can face the native C++ daemon, which validates and expands
+# only these) advertises this set as Topology.supported so AUTO never
+# resolves to an algorithm the peer would reject; explicit selectors
+# still pass through (and fail loudly at the peer's validation).
+_A = CollectiveAlgorithm
+LEGACY_ALGORITHM_PAIRS: frozenset = frozenset({
+    ("bcast", _A.ROUND_ROBIN), ("bcast", _A.TREE),
+    ("scatter", _A.ROUND_ROBIN),
+    ("gather", _A.RING), ("gather", _A.ROUND_ROBIN),
+    ("reduce", _A.RING), ("reduce", _A.ROUND_ROBIN),
+    ("allgather", _A.RING), ("allgather", _A.ROUND_ROBIN),
+    ("allreduce", _A.RING), ("allreduce", _A.FUSED_RING),
+    ("allreduce", _A.NON_FUSED),
+    ("reduce_scatter", _A.RING),
+})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,6 +85,12 @@ class Topology:
     # equivalently the pipeline sustains an effective beta close to the
     # wire beta down to segments d× smaller (see recommend_segment_size).
     pipeline_depth: float = 1.0
+    # (op, algorithm) pairs this tier's execution engines implement;
+    # None = everything in VALID_ALGORITHMS. AUTO resolution
+    # (rank_algorithms / Tuner) never selects outside this set — the
+    # socket tier advertises LEGACY_ALGORITHM_PAIRS because its peer may
+    # be the native daemon, which lacks the log-depth family.
+    supported: frozenset | None = None
 
     def wire_us(self, nbytes: float) -> float:
         """Microseconds to move ``nbytes`` over one link."""
@@ -107,24 +133,118 @@ def _allreduce_nonfused(topo: Topology, w: int, nbytes: float) -> float:
     return _ring_chain(topo, w, nbytes) + _bcast_rr(topo, w, nbytes)
 
 
-_A = CollectiveAlgorithm
+# -- log-depth family (modeled on OUR expansions, moveengine.py) ------------
+#
+# Alpha terms: ceil(log2 W) dependency rounds (+2 barrier phases for the
+# non-power-of-2 vrank fold). Wire terms: the same aggregate volume as
+# the ring algorithms, but paid in per-round bursts to a DIFFERENT
+# partner each round, where the ring trickles fixed-size chunks to one
+# fixed neighbor — the streamed executor's per-peer egress, the arrival
+# listener, and the fabric coalescing path all sustain a lower effective
+# beta on the bursty pattern, and the halving/doubling phases split the
+# ring's single fused recv-reduce-relay move into separate recv-reduce
+# and send moves (twice the per-byte move software cost). The factors
+# below fold that into the wire term; the emulator benchmark ladder
+# (benchmarks/algorithms.py) measures the resulting crossover, and the
+# online path (tuner.py) refines wherever a real host disagrees.
+_RD_WIRE_FACTOR = 1.3       # doubling allgather relays (recv + re-send)
+_RD_FUSE_FACTOR = 1.5       # halving phases (unfused recv-reduce + send)
+# Rabenseifner's rounds are PAIRWISE-SYNCHRONIZED: every rank wakes and
+# issues a send + a separate fused recv-reduce each round, where the
+# chain algorithms keep one active hop at a time — per-round software
+# cost runs ~1.4 alpha on the measured ladder. This keeps the few-move
+# NON_FUSED variant the small-n winner (it measures 3-4x faster than
+# Rabenseifner below ~4 KiB on the emulator tier) while Rabenseifner
+# owns the mid band up to the ring crossover.
+_RD_SYNC_FACTOR = 1.4
+
+
+def _rd_rounds(w: int) -> int:
+    """Pairwise-exchange rounds over p = 2^floor(log2 w) vranks."""
+    return max(1, (max(w, 2)).bit_length() - 1)
+
+
+def _rd_fold(w: int) -> float:
+    """1.0 when the vrank fold-in/fold-out barrier phases exist."""
+    return 0.0 if w & (w - 1) == 0 else 1.0
+
+
+def _allgather_rd(topo: Topology, w: int, nbytes: float) -> float:
+    """log2(p) exchange rounds moving (w-1)*n total; the fold ships the
+    whole w*n result to extras in the post phase."""
+    return (_rd_rounds(w) * topo.alpha_us
+            + _RD_WIRE_FACTOR * (w - 1) * topo.wire_us(nbytes)
+            + _rd_fold(w) * (2 * topo.alpha_us + w * topo.wire_us(nbytes)))
+
+
+def _reduce_scatter_rh(topo: Topology, w: int, nbytes: float) -> float:
+    """log2(p) halving rounds moving (w-1)*n total partials; the fold
+    pre-phase ships extras' whole w*n input vectors."""
+    return (_rd_rounds(w) * topo.alpha_us
+            + _RD_FUSE_FACTOR * (w - 1) * topo.wire_us(nbytes)
+            + _rd_fold(w) * (2 * topo.alpha_us
+                             + (w + 1) * topo.wire_us(nbytes)))
+
+
+def _allreduce_rd(topo: Topology, w: int, nbytes: float) -> float:
+    """Rabenseifner: halving reduce-scatter + doubling allgather —
+    2*log2(p) synchronized rounds at the fused ring's ~2n(w-1)/w wire
+    volume."""
+    return (_RD_SYNC_FACTOR * 2 * _rd_rounds(w) * topo.alpha_us
+            + _RD_FUSE_FACTOR * 2 * (w - 1) / w * topo.wire_us(nbytes)
+            + _rd_fold(w) * (2 * topo.alpha_us + 2 * topo.wire_us(nbytes)))
+
+
+def _allgather_direct(topo: Topology, w: int, nbytes: float) -> float:
+    """Direct fan-out allgather: one alpha of dependency depth, but OUR
+    expansion has every rank burst-inject w-1 eager sends before any of
+    its w-1 recvs can progress — the burst serializes in the executor
+    ahead of recv-matching (unlike gather's direct variant, where the
+    non-roots each issue a single send), modeled as a per-extra-send
+    alpha fraction on top of the incast wire term."""
+    return (topo.alpha_us * (1 + 0.4 * max(0, w - 2))
+            + topo.incast * (w - 1) * topo.wire_us(nbytes))
+
+
+def _reduce_tree(topo: Topology, w: int, nbytes: float) -> float:
+    """ceil(log2 W) dependent rounds, full payload each (the bcast-tree
+    shape run in reverse, with the folds spread across internal nodes)."""
+    return _bcast_tree(topo, w, nbytes)
+
+
+def _gather_tree(topo: Topology, w: int, nbytes: float) -> float:
+    """log-depth hop chain; the root still ingests all w-1 chunks, but
+    spread over subtree-sized messages instead of the direct algorithm's
+    w-1-way incast. Internal nodes store-and-forward their whole subtree
+    (scratch write + re-send — an extra local pass the ring relay does
+    not pay), the same re-read overhead as the doubling relays."""
+    rounds = max(1, math.ceil(math.log2(max(w, 2))))
+    return (rounds * topo.alpha_us
+            + _RD_WIRE_FACTOR * (w - 1) * topo.wire_us(nbytes))
+
+
 _MODELS = {
     ("bcast", _A.ROUND_ROBIN): _bcast_rr,
     ("bcast", _A.TREE): _bcast_tree,
     ("scatter", _A.ROUND_ROBIN): _bcast_rr,   # strided rr sends from root
     ("gather", _A.RING): _ring_chain,
     ("gather", _A.ROUND_ROBIN): _direct_fanin,
+    ("gather", _A.TREE): _gather_tree,
     ("reduce", _A.RING): _ring_chain,
     ("reduce", _A.ROUND_ROBIN): _direct_fanin,
+    ("reduce", _A.TREE): _reduce_tree,
     ("allgather", _A.RING): _ring_chain,
-    ("allgather", _A.ROUND_ROBIN): _direct_fanin,
+    ("allgather", _A.ROUND_ROBIN): _allgather_direct,
+    ("allgather", _A.RECURSIVE_DOUBLING): _allgather_rd,
     # RING and FUSED_RING share one expansion (expand_allreduce_ring);
     # the epsilon nudge makes AUTO surface the canonical FUSED_RING name
     ("allreduce", _A.RING): lambda t, w, n: 1.0001 * _allreduce_fused(
         t, w, n),
     ("allreduce", _A.FUSED_RING): _allreduce_fused,
     ("allreduce", _A.NON_FUSED): _allreduce_nonfused,
+    ("allreduce", _A.RECURSIVE_DOUBLING): _allreduce_rd,
     ("reduce_scatter", _A.RING): _ring_chain,
+    ("reduce_scatter", _A.RECURSIVE_DOUBLING): _reduce_scatter_rh,
 }
 
 
@@ -144,15 +264,16 @@ def predict_us(op: str, algorithm: CollectiveAlgorithm, topo: Topology,
 def rank_algorithms(op: str, topo: Topology, nbytes: int,
                     world_size: int | None = None
                     ) -> list[tuple[CollectiveAlgorithm, float]]:
-    """Every legal algorithm of ``op`` with its predicted cost, cheapest
-    first. Ties break toward the lower enum value (deterministic across
-    runs and ranks — every rank of a collective must pick the same
-    algorithm from the same inputs)."""
+    """Every legal algorithm of ``op`` the topology's engines implement,
+    with its predicted cost, cheapest first. Ties break toward the lower
+    enum value (deterministic across runs and ranks — every rank of a
+    collective must pick the same algorithm from the same inputs)."""
     valid = VALID_ALGORITHMS.get(op)
     if not valid:
         return []
     scored = [(a, predict_us(op, a, topo, nbytes, world_size))
-              for a in sorted(valid)]
+              for a in sorted(valid)
+              if topo.supported is None or (op, a) in topo.supported]
     scored.sort(key=lambda p: (p[1], int(p[0])))
     return scored
 

@@ -137,10 +137,17 @@ class Tuner:
     def _decide(self, key: tuple, op: str, world_size: int, nbytes: int,
                 valid) -> CollectiveAlgorithm:
         """Compute one key's decision (lock held)."""
-        if self.epsilon > 0 and self._rng.random() < self.epsilon:
-            return self._rng.choice(sorted(valid))
-        stats = self._measured.get(key, {})
         topo = self._topo(world_size)
+        if self.epsilon > 0 and self._rng.random() < self.epsilon:
+            # exploration draws only from algorithms the tier's engines
+            # implement (Topology.supported) — exploring an algorithm the
+            # peer daemon rejects would fail every call of the bucket
+            cands = sorted(a for a in valid
+                           if topo.supported is None
+                           or (op, a) in topo.supported)
+            if cands:
+                return self._rng.choice(cands)
+        stats = self._measured.get(key, {})
         best, best_score = None, None
         for alg, predicted in rank_algorithms(op, topo, nbytes,
                                               world_size):

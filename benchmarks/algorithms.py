@@ -1,0 +1,134 @@
+"""Algorithm-family microbenchmark: log-depth vs ring, small vs large.
+
+Measures the crossover the tuner's cost models assert (tuner/cost.py):
+at alpha-dominated sizes the recursive-doubling allgather and the
+Rabenseifner allreduce pay ceil(log2 W) dependency rounds (one wire
+message per round in the single-segment block-transfer mode) against
+the ring expansions' W-1/2(W-1) serialized hops, so small-message
+latency drops by roughly the hop-count ratio; at bandwidth-bound sizes
+both families move the same wire volume and the ring's steady chunk
+stream wins on this tier. Both regimes run through the segment-streamed
+executor on the emulator tier — the same engines the tuner selects
+between — so the measured ratios are evidence, not assertion.
+
+Methodology: the two algorithms are interleaved CALL BY CALL inside one
+shared world, and the reported ratio is the ratio of per-call MEDIANS.
+Shared-host throughput drifts on the scale of one measurement and
+individual calls take multi-ms scheduler-jitter outliers; call-level
+interleaving cancels the drift and the medians reject the outliers
+(sequential A-then-B means were 2-4x noisier on the 2-core CI host).
+
+Run directly (``python -m benchmarks.algorithms``) for one JSON line;
+``headline()`` feeds the same payload into bench.py's emulator-tier
+metric (``make bench-emu`` gates on ``ACCL_BENCH_MIN_RD_RATIO``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from accl_tpu.constants import CollectiveAlgorithm as A
+from accl_tpu.testing import emu_world, run_ranks
+
+
+def _paired_medians(world: int, op: str, ring_alg, rd_alg, count: int,
+                    iters: int, nbufs: int = 32,
+                    bufsize: int | None = None,
+                    max_segment_size: int | None = None
+                    ) -> tuple[float, float]:
+    """(median ring seconds, median log-depth seconds) per call, measured
+    call-interleaved at rank 0 of one shared world."""
+    accls = emu_world(world, nbufs=nbufs, bufsize=bufsize,
+                      max_segment_size=max_segment_size)
+    try:
+        bufs = []
+        for a in accls:
+            n_in = world * count if op == "reduce_scatter" else count
+            n_out = world * count if op == "allgather" else count
+            bufs.append((a.buffer(data=np.full(n_in, float(a.rank + 1),
+                                               np.float32)),
+                         a.buffer((n_out,), np.float32)))
+        t_ring: list[float] = []
+        t_rd: list[float] = []
+
+        def body(a):
+            src, dst = bufs[a.rank]
+            call = getattr(a, op)
+            for i in range(4):  # warm both algorithms' paths
+                call(src, dst, count,
+                     algorithm=ring_alg if i % 2 else rd_alg)
+            for i in range(iters):
+                alg = ring_alg if i % 2 == 0 else rd_alg
+                t0 = time.perf_counter()
+                call(src, dst, count, algorithm=alg)
+                if a.rank == 0:  # every rank runs; one rank times
+                    (t_ring if i % 2 == 0
+                     else t_rd).append(time.perf_counter() - t0)
+
+        run_ranks(accls, body, timeout=300.0)
+        if op != "allgather":
+            expect = world * (world + 1) / 2
+            for _, dst in bufs:
+                if not np.allclose(dst.data, expect):
+                    raise AssertionError(
+                        f"{op} produced {dst.data[:4]}, expected {expect}")
+        return float(np.median(t_ring)), float(np.median(t_rd))
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def headline(world: int = 8, small_nbytes: int = 4 << 10,
+             large_nbytes: int = 16 << 20, iters: int = 40) -> dict:
+    """Small-vs-large log-depth/ring sweep as a bench.py-style payload.
+
+    ``rd_small_*`` are the alpha-dominated headline ratios (>1 = the
+    log-depth algorithm is faster) at ``small_nbytes`` per call;
+    ``rd_large_allreduce`` is the bandwidth-bound sanity ratio at
+    ``large_nbytes`` — expected BELOW 1 (the ring's steady chunk stream
+    wins the large regime on this tier, which is exactly the crossover
+    the tuner's cost model encodes; the gate covers only the small
+    side)."""
+    small = small_nbytes // 4
+    out = {}
+    for op, ring_alg in (("allgather", A.RING),
+                         ("allreduce", A.FUSED_RING),
+                         ("reduce_scatter", A.RING)):
+        tr, td = _paired_medians(world, op, ring_alg,
+                                 A.RECURSIVE_DOUBLING, small, iters)
+        out[f"rd_small_{op}"] = round(tr / td, 3)
+        out[f"{op}_ring_us"] = round(tr * 1e6, 1)
+        out[f"{op}_rd_us"] = round(td * 1e6, 1)
+    # bandwidth-bound sanity point: the executor-pipeline ladder's
+    # 16 MiB shape (multi-segment chunks -> per-chunk lane pipelining)
+    chunk = max(4096, -(-large_nbytes // world))
+    tr, td = _paired_medians(world, "allreduce", A.FUSED_RING,
+                             A.RECURSIVE_DOUBLING, large_nbytes // 4,
+                             iters=6, bufsize=2 * chunk,
+                             max_segment_size=max(4096, chunk // 2))
+    out["rd_large_allreduce"] = round(tr / td, 3)
+    return {
+        "metric": (f"emu_logdepth_vs_ring_{small_nbytes >> 10}KiB_"
+                   f"{world}rank"),
+        # headline: the worst of the two gated small-message ratios
+        # (allgather recursive doubling, Rabenseifner allreduce)
+        "value": round(min(out["rd_small_allgather"],
+                           out["rd_small_allreduce"]), 3),
+        "unit": "x",
+        **out,
+        "small_nbytes": small_nbytes,
+        "large_nbytes": large_nbytes,
+        "world": world,
+        "tier": "emu",
+    }
+
+
+def main():
+    print(json.dumps(headline()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1178,8 +1178,14 @@ class RankDaemon {
         call_status_[job.first] = err;
         if (err != E_OK) {
           failed_calls_.emplace(job.first, err);
-          while (failed_calls_.size() > 1024)
+          while (failed_calls_.size() > 1024) {
+            // remember the highest FAILED id the bounded FIFO ages out:
+            // a deferred MSG_WAIT at/below this mark cannot tell
+            // success from an evicted failure (see MSG_WAIT)
+            uint32_t aged = failed_calls_.begin()->first;
+            if (aged > failed_evicted_max_) failed_evicted_max_ = aged;
             failed_calls_.erase(failed_calls_.begin());
+          }
         }
         // Bound the status map (Python daemon parity): a chain client
         // waiting only the LAST id would otherwise leak one retired
@@ -1386,6 +1392,7 @@ class RankDaemon {
   // ids at/below it from failed_calls_ (retirement is FIFO)
   uint32_t evicted_max_ = 0;
   std::map<uint32_t, uint32_t> failed_calls_;  // persists past MSG_WAIT
+  uint32_t failed_evicted_max_ = 0;  // highest failure aged out of it
   uint32_t next_call_id_ = 1;
   std::mutex call_mu_;
   std::condition_variable call_cv_;
@@ -2003,10 +2010,14 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
       while (call_status_.find(id) == call_status_.end()) {
         if (id <= evicted_max_) {
           // evicted after retirement: FIFO means it DID retire; a
-          // failure survives in failed_calls_
+          // failure survives in failed_calls_ — unless it TOO aged out
+          // of the bounded failure FIFO, in which case the outcome is
+          // unknowable and 0 would be a fabricated success
           if (--wait_active_[id] == 0) wait_active_.erase(id);
           auto f = failed_calls_.find(id);
-          return status_reply(f == failed_calls_.end() ? 0 : f->second);
+          if (f != failed_calls_.end()) return status_reply(f->second);
+          return status_reply(
+              id <= failed_evicted_max_ ? E_OUTCOME_UNKNOWN : 0);
         }
         if (call_cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
           pending = true;

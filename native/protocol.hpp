@@ -80,6 +80,10 @@ enum Err : uint32_t {
   E_COMM_NOT_CONFIGURED = 1u << 15,
   E_SPARE_OVERFLOW = 1u << 20,
   E_INVALID = 1u << 23,
+  // a deferred MSG_WAIT for an id so old that both its status and (if
+  // it failed) its failed-calls record aged out: retired, outcome
+  // unknowable (ErrorCode.CALL_OUTCOME_UNKNOWN in constants.py)
+  E_OUTCOME_UNKNOWN = 1u << 24,
 };
 
 static const uint32_t TAG_ANY = 0xFFFFFFFFu;

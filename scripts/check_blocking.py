@@ -18,6 +18,22 @@ Wired into ``make lint``. Two checks:
    the scheduler) and that no laned move smuggles in a stream port or
    remote-stream send (shapes the worker pool must never execute).
 
+3. **byte-interval hazard simulation.** Replay each corpus program's
+   IMMEDIATE operand intervals and verify the two invariants the
+   expansions ASSERT by tagging:
+   * lane disjointness — a laned move may only touch bytes last written
+     by its OWN lane since the last barrier (sibling lanes run
+     concurrently in the streamed engine, so a cross-lane overlap is a
+     race, the reference's dual-DataMover segment-interleave hazard);
+   * non-rewritten source — a ``blocking=False`` remote send's source
+     bytes must never be written later in the program except by the
+     send's own lane (which orders the writer behind it). This is the
+     executable form of the Move.blocking audit — the gather-relay-
+     scratch bug class (ccl_offload_control.c:632-724) fails it.
+   The log-depth expansions (recursive doubling/halving, binomial
+   trees) are linted by the same replay, including their vrank
+   fold-in/fold-out barrier phases.
+
 Exit code 0 = clean; nonzero prints every violation.
 """
 
@@ -72,47 +88,142 @@ def check_lane_graph() -> list[str]:
 
     errors = []
     cfg = ArithConfig(np.dtype(np.float32), np.dtype(np.float16))
+    A = CollectiveAlgorithm
     ops = {
-        CCLOp.bcast: [CollectiveAlgorithm.AUTO, CollectiveAlgorithm.TREE],
-        CCLOp.scatter: [CollectiveAlgorithm.AUTO],
-        CCLOp.gather: [CollectiveAlgorithm.AUTO,
-                       CollectiveAlgorithm.ROUND_ROBIN],
-        CCLOp.reduce: [CollectiveAlgorithm.AUTO,
-                       CollectiveAlgorithm.ROUND_ROBIN],
-        CCLOp.allgather: [CollectiveAlgorithm.AUTO,
-                          CollectiveAlgorithm.ROUND_ROBIN],
-        CCLOp.allreduce: [CollectiveAlgorithm.AUTO,
-                          CollectiveAlgorithm.NON_FUSED],
-        CCLOp.reduce_scatter: [CollectiveAlgorithm.AUTO],
-        CCLOp.alltoall: [CollectiveAlgorithm.AUTO],
+        CCLOp.bcast: [A.AUTO, A.TREE],
+        CCLOp.scatter: [A.AUTO],
+        CCLOp.gather: [A.AUTO, A.ROUND_ROBIN, A.TREE],
+        CCLOp.reduce: [A.AUTO, A.ROUND_ROBIN, A.TREE],
+        CCLOp.allgather: [A.AUTO, A.ROUND_ROBIN, A.RECURSIVE_DOUBLING],
+        CCLOp.allreduce: [A.AUTO, A.NON_FUSED, A.RECURSIVE_DOUBLING],
+        CCLOp.reduce_scatter: [A.AUTO, A.RECURSIVE_DOUBLING],
+        CCLOp.alltoall: [A.AUTO],
     }
+    # W covers: pairs, a fold with one extra (3), a fold with multiple
+    # extras (5 -> p=4, r=1; 6 -> p=4, r=2), and a power-of-2 deep tree
     for op, algs in ops.items():
         for alg in algs:
-            for W in (2, 3, 5):
+            for W in (2, 3, 5, 6, 8):
                 for seg in (16, 64, 1 << 20):
-                    for root in range(W):
-                        for me in range(W):
-                            ctx = MoveContext(world_size=W, local_rank=me,
-                                              arithcfg=cfg,
-                                              max_segment_size=seg)
-                            moves = expand_call(
-                                ctx, op, count=23, root_src_dst=root,
-                                func=ReduceFunc.SUM, tag=TAG_ANY,
-                                addr_0=0x1000, addr_1=0x8000,
-                                addr_2=0x10000,
-                                compression=Compression.NONE,
-                                algorithm=alg)
-                            errors += _lane_edges_ok(op, alg, W, me, seg,
-                                                     moves)
+                    for comp in (Compression.NONE,
+                                 Compression.ETH_COMPRESSED):
+                        for root in range(W):
+                            for me in range(W):
+                                ctx = MoveContext(world_size=W,
+                                                  local_rank=me,
+                                                  arithcfg=cfg,
+                                                  max_segment_size=seg)
+                                moves = expand_call(
+                                    ctx, op, count=23, root_src_dst=root,
+                                    func=ReduceFunc.SUM, tag=TAG_ANY,
+                                    addr_0=0x1000, addr_1=0x8000,
+                                    addr_2=0x10000,
+                                    compression=comp,
+                                    algorithm=alg)
+                                where = (f"{op.name}/{alg.name} W={W} "
+                                         f"me={me} seg={seg} "
+                                         f"comp={int(comp)}")
+                                errors += _lane_edges_ok(where, moves)
+                                errors += _hazards_ok(where, moves, cfg)
     return errors
 
 
-def _lane_edges_ok(op, alg, W, me, seg, moves) -> list[str]:
+def _move_intervals(mv, cfg):
+    """Byte intervals an executed move reads/writes in device memory
+    (IMMEDIATE operands only — ON_RECV/STREAM don't touch memory)."""
+    from accl_tpu.moveengine import MoveMode
+
+    def nbytes(compressed):
+        return mv.count * (cfg.compressed_elem_bytes if compressed
+                           else cfg.uncompressed_elem_bytes)
+
+    reads, writes = [], []
+    if mv.op0.mode is MoveMode.IMMEDIATE:
+        reads.append((mv.op0.addr, mv.op0.addr + nbytes(mv.op0.compressed)))
+    if mv.op1.mode is MoveMode.IMMEDIATE:
+        reads.append((mv.op1.addr, mv.op1.addr + nbytes(mv.op1.compressed)))
+    if mv.res_local and mv.res.mode is MoveMode.IMMEDIATE:
+        writes.append((mv.res.addr, mv.res.addr + nbytes(mv.res.compressed)))
+    return reads, writes
+
+
+def _is_stream_shape(mv):
+    from accl_tpu.moveengine import MoveMode
+    return (mv.remote_stream or mv.op0.mode is MoveMode.STREAM
+            or mv.op1.mode is MoveMode.STREAM
+            or (mv.res_local and mv.res.mode is MoveMode.STREAM))
+
+
+def _is_window_send(mv):
+    """The pure-send shape that retires asynchronously even without a
+    lane tag — the EXECUTOR'S own predicate, imported rather than
+    mirrored so the lint cannot drift from what the engine actually
+    overlaps."""
+    from accl_tpu.emulator.executor import MoveExecutor
+    return MoveExecutor._window_eligible(mv)
+
+
+def _overlap(a, b):
+    return a[0] < b[1] and b[0] < a[1]
+
+
+def _hazards_ok(where, moves, cfg) -> list[str]:
+    """Replay one program's memory intervals against the two tagging
+    invariants (module docstring, check 3)."""
+    errors = []
+    # -- lane disjointness within a barrier epoch -------------------------
+    writes_since_barrier = []  # (idx, lane, interval)
+    streamable = []
+    for i, mv in enumerate(moves):
+        eligible = (not _is_stream_shape(mv)
+                    and (mv.lane is not None or _is_window_send(mv)))
+        streamable.append(eligible)
+        if not eligible:
+            # barrier: the streamed engine drains every in-flight lane
+            # before running it inline — earlier writes are all visible,
+            # and later laned moves are registered only after it retires
+            writes_since_barrier = []
+            continue
+        reads, writes = _move_intervals(mv, cfg)
+        for iv in reads + writes:
+            for wi, wl, wiv in writes_since_barrier:
+                if _overlap(iv, wiv) and wl != mv.lane:
+                    errors.append(
+                        f"{where} move {i} (lane {mv.lane}) touches "
+                        f"bytes [{iv[0]:#x},{iv[1]:#x}) written by "
+                        f"concurrent lane {wl} (move {wi}) — cross-lane "
+                        f"race")
+        for iv in writes:
+            writes_since_barrier.append((i, mv.lane, iv))
+    # -- non-rewritten source for blocking=False remote sends -------------
+    for i, mv in enumerate(moves):
+        if mv.blocking or not mv.res_remote or _is_stream_shape(mv):
+            continue
+        reads, _ = _move_intervals(mv, cfg)
+        for j in range(i + 1, len(moves)):
+            later = moves[j]
+            if not streamable[j]:
+                # a later barrier drains this send before running; once
+                # past it, every later move is ordered behind the send
+                break
+            _, writes = _move_intervals(later, cfg)
+            for iv in reads:
+                for wiv in writes:
+                    if _overlap(iv, wiv) and (mv.lane is None
+                                              or later.lane != mv.lane):
+                        errors.append(
+                            f"{where} move {i}: blocking=False send "
+                            f"source [{iv[0]:#x},{iv[1]:#x}) is "
+                            f"rewritten by later move {j} outside its "
+                            f"lane — Move.blocking invariant violation")
+    return errors
+
+
+def _lane_edges_ok(where, moves) -> list[str]:
     from accl_tpu.moveengine import MoveMode
 
     errors = []
     lane_last: dict[int, int] = {}
-    where = f"{op.name}/{alg.name} W={W} me={me} seg={seg}"
     for i, mv in enumerate(moves):
         if mv.lane is None:
             continue
@@ -139,7 +250,8 @@ def main() -> int:
         print(f"check_blocking: {len(errors)} violation(s)",
               file=sys.stderr)
         return 1
-    print("check_blocking: OK (blocking=False citations + lane graph)")
+    print("check_blocking: OK (blocking=False citations + lane graph + "
+          "byte-interval hazards)")
     return 0
 
 

@@ -1,0 +1,465 @@
+"""Log-depth collective algorithm tests (recursive doubling/halving,
+Rabenseifner allreduce, binomial trees).
+
+Three layers, mirroring the subsystem's claims:
+  * expansion structure — vrank fold shapes, log hop counts, single-
+    message block mode vs per-chunk lane mode;
+  * streamed-engine differential — every new algorithm is BIT-IDENTICAL
+    to ``execute_serial`` across the property corpus (dtypes x counts x
+    worlds 3/6/8, in-place and compressed variants), plus fault-injection
+    latching and recovery;
+  * tuner end-to-end — AUTO resolves to a log-depth algorithm at small
+    nbytes and ring/FUSED_RING at large nbytes on the emu topology, and
+    the socket tier's capability set keeps AUTO inside the legacy family
+    (its peer may be the native daemon).
+"""
+
+import numpy as np
+import pytest
+
+from accl_tpu.constants import (ACCLError, CCLOp, CollectiveAlgorithm as A,
+                                Compression, ErrorCode, ReduceFunc, TAG_ANY)
+from accl_tpu.moveengine import (MoveContext, MoveMode, expand_call,
+                                 tree_gather_scratch_chunks)
+from accl_tpu.testing import emu_world, run_ranks
+
+WORLDS = [3, 6, 8]  # fold with one extra, fold with two extras, power of 2
+
+
+def _cfg():
+    from accl_tpu.arith import DEFAULT_ARITH_CONFIGS
+    return DEFAULT_ARITH_CONFIGS[("float32", "float32")]
+
+
+# ---------------------------------------------------------------------------
+# expansion structure
+# ---------------------------------------------------------------------------
+
+def test_allgather_rd_log_hops_block_mode():
+    """W=8, whole vector in one segment: one message per round — three
+    sends and three recvs per rank instead of the ring's seven each."""
+    ctx = MoveContext(world_size=8, local_rank=0, arithcfg=_cfg(),
+                      max_segment_size=1 << 20)
+    moves = expand_call(ctx, CCLOp.allgather, count=64, addr_0=0x100,
+                        addr_2=0x4000, algorithm=A.RECURSIVE_DOUBLING)
+    sends = [m for m in moves if m.res_remote]
+    recvs = [m for m in moves if m.op1.mode is MoveMode.ON_RECV]
+    assert len(sends) == 3 and len(recvs) == 3
+    # transfers double: 1, 2, 4 chunks
+    assert [m.count // 64 for m in sends] == [1, 2, 4]
+    ring = expand_call(ctx, CCLOp.allgather, count=64, addr_0=0x100,
+                       addr_2=0x4000, algorithm=A.RING)
+    assert len([m for m in ring if m.res_remote]) == 7
+
+
+def test_allgather_rd_chunk_mode_lanes():
+    """Small segments force per-chunk transfers with global-chunk lanes:
+    every move touching chunk c rides lane c*S + s, so cross-round RAW
+    edges are lane-local (the streamed executor pipelines them)."""
+    ctx = MoveContext(world_size=8, local_rank=0, arithcfg=_cfg(),
+                      max_segment_size=64)  # 16 elems/segment
+    count = 32  # 2 segments per chunk
+    S = 2
+    moves = expand_call(ctx, CCLOp.allgather, count=count, addr_0=0x100,
+                        addr_2=0x8000, algorithm=A.RECURSIVE_DOUBLING)
+    e = 4
+    for m in moves:
+        if m.lane is None or not m.res_remote:
+            continue
+        # the lane id names the chunk whose bytes the send reads
+        c, s = divmod(m.lane, S)
+        addr = m.op0.addr
+        if addr >= 0x8000:  # relay from a dst slot
+            assert addr == 0x8000 + (c * count + s * 16) * e
+        else:               # own chunk from src
+            assert c == 0 and addr == 0x100 + s * 16 * e
+
+
+def test_vrank_fold_extra_shape():
+    """Extras (odd ranks below 2r) run fold-in send + fold-out recv only,
+    both documented barriers (lane=None, blocking send)."""
+    for W in (3, 6):
+        ctx = MoveContext(world_size=W, local_rank=1, arithcfg=_cfg(),
+                          max_segment_size=1 << 20)
+        moves = expand_call(ctx, CCLOp.allgather, count=16, addr_0=0x100,
+                            addr_2=0x4000, algorithm=A.RECURSIVE_DOUBLING)
+        sends = [m for m in moves if m.res_remote]
+        recvs = [m for m in moves if m.op1.mode is MoveMode.ON_RECV]
+        assert len(sends) == 1 and sends[0].blocking
+        assert len(recvs) == 1 and recvs[0].count == W * 16
+        assert all(m.lane is None for m in moves)
+
+
+def test_reduce_tree_depth_and_gather_scratch():
+    """Binomial reduce: the root folds ceil(log2 W) children; gather-tree
+    scratch sizing matches each rank's received subtree."""
+    ctx = MoveContext(world_size=8, local_rank=0, arithcfg=_cfg(),
+                      max_segment_size=1 << 20)
+    moves = expand_call(ctx, CCLOp.reduce, count=16, root_src_dst=0,
+                        addr_0=0x100, addr_2=0x4000, algorithm=A.TREE)
+    folds = [m for m in moves if m.func is not None]
+    assert len(folds) == 3  # children at vrank 1, 2, 4
+    # leaf: exactly one laned non-blocking send
+    leaf = MoveContext(world_size=8, local_rank=5, arithcfg=_cfg(),
+                       max_segment_size=1 << 20)
+    lm = expand_call(leaf, CCLOp.reduce, count=16, root_src_dst=0,
+                     addr_0=0x100, addr_2=0, algorithm=A.TREE)
+    assert len(lm) == 1 and lm[0].res_remote and not lm[0].blocking
+    # gather scratch: vrank 4 of W=8 relays its 3-chunk subtree
+    assert tree_gather_scratch_chunks(8, 4, 0) == 3
+    assert tree_gather_scratch_chunks(8, 1, 0) == 0   # leaf
+    assert tree_gather_scratch_chunks(6, 4, 0) == 1   # clipped subtree
+
+
+def test_reduce_scatter_rd_requires_scratch():
+    """An explicit RECURSIVE_DOUBLING descriptor without the driver-
+    plumbed addr_1 scratch fails loudly at expansion."""
+    ctx = MoveContext(world_size=4, local_rank=0, arithcfg=_cfg(),
+                      max_segment_size=1 << 20)
+    with pytest.raises(ValueError, match="scratch"):
+        expand_call(ctx, CCLOp.reduce_scatter, count=8, addr_0=0x100,
+                    addr_1=0, addr_2=0x4000, func=ReduceFunc.SUM,
+                    algorithm=A.RECURSIVE_DOUBLING)
+
+
+# ---------------------------------------------------------------------------
+# streamed-engine differential: bit-identical to execute_serial
+# ---------------------------------------------------------------------------
+
+def _run_corpus(W, segment_stream, pipeline_window, max_segment_size):
+    """One full pass of the log-depth corpus; returns {label: bytes} of
+    every produced result, for cross-engine comparison."""
+    accls = emu_world(W, nbufs=64, pipeline_window=pipeline_window,
+                      segment_stream=segment_stream,
+                      max_segment_size=max_segment_size)
+    out: dict[str, bytes] = {}
+    N = 23
+    try:
+        ins = {}
+        for dt in (np.float32, np.int32):
+            rng = np.random.default_rng(7)
+            ins[np.dtype(dt).name] = [
+                (rng.standard_normal(W * N) * 8).astype(dt)
+                for _ in range(W)]
+
+        def body(a):
+            r = a.rank
+            for dtn, data in ins.items():
+                dt = np.dtype(dtn)
+                src = a.buffer(data=data[r].copy())
+                # allgather RD (chunk = N)
+                dst = a.buffer((W * N,), dt)
+                a.allgather(src[:N], dst, N,
+                            algorithm=A.RECURSIVE_DOUBLING)
+                out[f"ag/{dtn}/{r}"] = dst.data.tobytes()
+                # allreduce RD (total = W*N), plus in-place
+                d2 = a.buffer((W * N,), dt)
+                a.allreduce(src, d2, W * N,
+                            algorithm=A.RECURSIVE_DOUBLING)
+                out[f"ar/{dtn}/{r}"] = d2.data.tobytes()
+                ip = a.buffer(data=data[r].copy())
+                a.allreduce(ip, ip, W * N,
+                            algorithm=A.RECURSIVE_DOUBLING)
+                out[f"ar_inplace/{dtn}/{r}"] = ip.data.tobytes()
+                # reduce_scatter RD (chunk = N) + in-place destination
+                d3 = a.buffer((N,), dt)
+                a.reduce_scatter(src, d3, N,
+                                 algorithm=A.RECURSIVE_DOUBLING,
+                                 func=ReduceFunc.MAX)
+                out[f"rs/{dtn}/{r}"] = d3.data.tobytes()
+                ip2 = a.buffer(data=data[r].copy())
+                a.reduce_scatter(ip2, ip2[r * N:(r + 1) * N], N,
+                                 algorithm=A.RECURSIVE_DOUBLING)
+                out[f"rs_inplace/{dtn}/{r}"] = \
+                    ip2.data[r * N:(r + 1) * N].tobytes()
+                # binomial trees, rotated root
+                root = 1 % W
+                d4 = a.buffer((W * N,), dt) if r == root else None
+                a.reduce(src, d4, W * N, root=root, algorithm=A.TREE)
+                if r == root:
+                    out[f"rt/{dtn}"] = d4.data.tobytes()
+                d5 = a.buffer((W * N,), dt) if r == root else None
+                a.gather(src[:N], d5, N, root=root, algorithm=A.TREE)
+                if r == root:
+                    out[f"gt/{dtn}"] = d5.data.tobytes()
+            # compressed-wire variants (fp16-exact integer payloads)
+            csrc = a.buffer(
+                data=(np.arange(W * N) % 11 + r).astype(np.float32))
+            cdst = a.buffer((W * N,), np.float32)
+            a.allreduce(csrc, cdst, W * N, algorithm=A.RECURSIVE_DOUBLING,
+                        compress_dtype=np.float16)
+            out[f"ar_eth/{r}"] = cdst.data.tobytes()
+            cag = a.buffer((W * N,), np.float32)
+            a.allgather(csrc[:N], cag, N, algorithm=A.RECURSIVE_DOUBLING,
+                        compress_dtype=np.float16)
+            out[f"ag_eth/{r}"] = cag.data.tobytes()
+            return True
+
+        assert all(run_ranks(accls, body, timeout=120.0))
+        return out
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+@pytest.mark.parametrize("W", WORLDS)
+@pytest.mark.parametrize("seg", [None, 64], ids=["block", "chunk"])
+def test_streamed_differential_bit_identical(W, seg):
+    """The segment-streamed engine must produce byte-identical results to
+    the serial oracle for every log-depth algorithm — same move
+    programs, same combine order, different scheduling."""
+    golden = _run_corpus(W, segment_stream=None, pipeline_window=0,
+                         max_segment_size=seg)
+    streamed = _run_corpus(W, segment_stream=True, pipeline_window=None,
+                           max_segment_size=seg)
+    assert golden.keys() == streamed.keys()
+    for k, v in golden.items():
+        assert streamed[k] == v, f"{k} diverged from execute_serial"
+    # sanity vs numpy golden, not just engine-vs-engine
+    rng = np.random.default_rng(7)
+    f32 = [(rng.standard_normal(W * 23) * 8).astype(np.float32)
+           for _ in range(W)]
+    total = np.sum(f32, axis=0)
+    got = np.frombuffer(golden["ar/float32/0"], np.float32)
+    np.testing.assert_allclose(got, total, atol=1e-3)
+
+
+def test_fault_injection_latching_and_recovery():
+    """A dropped message inside a log-depth collective must latch a
+    receive-timeout error (never hang, never succeed silently); after
+    healing the wire, soft_reset restores a working world."""
+    accls = emu_world(6, timeout=0.5)
+    fabric = accls[0].device.ctx.fabric
+    state = {"i": 0}
+
+    def lossy(env, payload):
+        state["i"] += 1
+        return "drop" if state["i"] % 3 == 0 else "deliver"
+
+    fabric.inject_fault(lossy)
+
+    def body(a):
+        src = a.buffer(data=np.ones(48, np.float32))
+        dst = a.buffer((48,), np.float32)
+        try:
+            a.allreduce(src, dst, 48, algorithm=A.RECURSIVE_DOUBLING)
+            return "ok"
+        except ACCLError as e:
+            assert ErrorCode.RECEIVE_TIMEOUT_ERROR in e.errors
+            return "timeout"
+
+    results = run_ranks(accls, body, timeout=30.0)
+    assert "timeout" in results
+    fabric.clear_fault()
+    for a in accls:
+        a.soft_reset()
+
+    def ok(a):
+        src = a.buffer(data=np.full(8, float(a.rank + 1), np.float32))
+        dst = a.buffer((6 * 8,), np.float32)
+        a.allgather(src, dst, 8, algorithm=A.RECURSIVE_DOUBLING)
+        return float(dst.data[8])
+
+    assert all(v == 2.0 for v in run_ranks(accls, ok))
+    for a in accls:
+        a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# tuner end-to-end
+# ---------------------------------------------------------------------------
+
+def test_tuner_resolves_log_depth_small_ring_large():
+    """On the emu topology the cost model orders the families the way the
+    measured ladder does (benchmarks/algorithms.py): log-depth wins the
+    alpha-dominated sizes, ring/FUSED_RING the bandwidth-bound ones."""
+    from accl_tpu.tuner import Tuner
+    from accl_tpu.tuner.cost import Topology
+
+    from accl_tpu.tuner.cost import predict_us
+
+    emu_topo = Topology(world_size=8, alpha_us=20.0, beta_gbps=4.0,
+                        tier="emu")
+    t = Tuner(topology=emu_topo)
+    small, large = 8 << 10, 16 << 20
+    assert t.select("allreduce", 8, small) == A.RECURSIVE_DOUBLING
+    assert t.select("allreduce", 8, large) == A.FUSED_RING
+    assert t.select("allgather", 8, 4 << 10) == A.RECURSIVE_DOUBLING
+    assert t.select("allgather", 8, large) == A.RING
+    assert t.select("reduce_scatter", 8, 4 << 10) == A.RECURSIVE_DOUBLING
+    assert t.select("reduce_scatter", 8, large) == A.RING
+    # measured crossover direction (benchmarks/algorithms.py: RD beats
+    # the ring family ≥1.3x at ≤4KiB, loses at 16 MiB) matches the
+    # model's ordering at both ends
+    for op, ring in (("allreduce", A.FUSED_RING), ("allgather", A.RING),
+                     ("reduce_scatter", A.RING)):
+        assert predict_us(op, A.RECURSIVE_DOUBLING, emu_topo, 4 << 10) \
+            < predict_us(op, ring, emu_topo, 4 << 10)
+        assert predict_us(op, A.RECURSIVE_DOUBLING, emu_topo, large) \
+            > predict_us(op, ring, emu_topo, large)
+    # rooted tree family: log alphas beat the daisy chain's W-1 hops
+    assert predict_us("reduce", A.TREE, emu_topo, small) \
+        < predict_us("reduce", A.RING, emu_topo, small)
+    # tiny allreduce keeps the few-move NON_FUSED pick (measured 3-4x
+    # faster than everything else on this tier) — the log-depth family
+    # owns the mid band, not the floor
+    assert t.select("allreduce", 8, 64) == A.NON_FUSED
+
+
+def test_tuner_live_world_auto_to_log_depth():
+    """A tuner-attached emu world resolves AUTO to the log-depth family
+    at small sizes, produces correct results, and records the concrete
+    algorithm in the profiler history."""
+    from accl_tpu.tuner import Tuner
+
+    tuner = Tuner()  # topology bound from the device at attach
+    accls = emu_world(8, tuner=tuner)
+    for a in accls:
+        a.start_profiling()
+
+    def body(a):
+        n = 1024  # 4 KiB chunk: the emu topology's log-depth band
+        src = a.buffer(data=np.full(n, float(a.rank + 1), np.float32))
+        dst = a.buffer((8 * n,), np.float32)
+        a.allgather(src, dst, n)  # AUTO
+        return float(dst.data[-1])
+
+    assert all(v == 8.0 for v in run_ranks(accls, body))
+    recs = [r for r in accls[0].profiler.records if r.op == "allgather"]
+    assert recs and recs[-1].algorithm == "RECURSIVE_DOUBLING"
+    for a in accls:
+        a.end_profiling()
+        a.deinit()
+
+
+def test_sim_tier_auto_stays_in_legacy_family():
+    """The socket tier's Topology.supported keeps AUTO inside the
+    ring/rr families (its peer may be the native daemon, which rejects
+    the log-depth selectors) — at every size, including the small sizes
+    where the unrestricted emu topology flips to log-depth."""
+    from accl_tpu.tuner import Tuner
+    from accl_tpu.tuner.cost import LEGACY_ALGORITHM_PAIRS, Topology
+
+    sim_topo = Topology(world_size=8, alpha_us=150.0, beta_gbps=0.5,
+                        tier="sim", supported=LEGACY_ALGORITHM_PAIRS)
+    t = Tuner(topology=sim_topo, epsilon=1.0, seed=3)  # force exploration
+    for op in ("allreduce", "allgather", "reduce_scatter", "reduce",
+               "gather"):
+        for nbytes in (256, 4 << 10, 1 << 20, 16 << 20):
+            alg = t.select(op, 8, nbytes)
+            assert (op, alg) in LEGACY_ALGORITHM_PAIRS, (op, nbytes, alg)
+        t.refresh()
+
+
+def test_python_daemon_tier_runs_log_depth():
+    """Explicit RECURSIVE_DOUBLING across the socket protocol: the wire
+    descriptor carries the selector AND the driver-plumbed scratch
+    address; the Python daemon's engine expands and executes it."""
+    from accl_tpu.testing import sim_world
+
+    accls = sim_world(3, nbufs=32)
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(3 * 8, float(a.rank + 1),
+                                        np.float32))
+            dst = a.buffer((8,), np.float32)
+            a.reduce_scatter(src, dst, 8,
+                             algorithm=A.RECURSIVE_DOUBLING)
+            np.testing.assert_allclose(dst.data, 6.0)
+            ag = a.buffer((3 * 8,), np.float32)
+            a.allgather(src[:8], ag, 8, algorithm=A.RECURSIVE_DOUBLING)
+            np.testing.assert_allclose(
+                ag.data, np.repeat([1.0, 2.0, 3.0], 8))
+            return True
+
+        assert all(run_ranks(accls, body, timeout=60.0))
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# TPU-tier int64/f64 truncation guards (device satellite)
+# ---------------------------------------------------------------------------
+
+def test_tpu_device_resident_noncanonical_rejected():
+    """Creating an int64/f64 device-resident buffer must fail loudly:
+    with x64 off, device_put would silently canonicalize the array to 32
+    bits at creation. The gate fires before any mesh is touched."""
+    jax = pytest.importorskip("jax")
+    if jax.config.jax_enable_x64:
+        pytest.skip("x64 enabled: int64 is canonical")
+    from accl_tpu.device.tpu import TpuDevice
+
+    dev = TpuDevice.__new__(TpuDevice)  # the dtype gate precedes any state
+    with pytest.raises(ValueError, match="64-bit"):
+        dev.make_device_array((4,), np.int64)
+    with pytest.raises(ValueError, match="64-bit"):
+        dev.make_device_array((4,), np.float64, init=np.zeros(4))
+
+
+def test_tpu_write_result_noncanonical_to_device_buffer_rejected():
+    """_write_result used to re-enter _rebind_dev for device-resident
+    destinations, silently truncating int64/f64 payloads through
+    device_put — it must refuse loudly instead."""
+    jax = pytest.importorskip("jax")
+    if jax.config.jax_enable_x64:
+        pytest.skip("x64 enabled: int64 is canonical")
+    from accl_tpu.arith import ArithConfig
+    from accl_tpu.call import CallDescriptor
+    from accl_tpu.device.tpu import TpuDevice
+
+    dev = TpuDevice.__new__(TpuDevice)
+    dev.dev_bufs = {0x10: object()}  # the guard fires before buf is used
+    desc = CallDescriptor(CCLOp.copy, count=4,
+                          arithcfg=ArithConfig(np.dtype(np.int64),
+                                               np.dtype(np.int64)))
+    with pytest.raises(ACCLError) as ei:
+        dev._write_result(0x10, np.arange(4, dtype=np.int64), desc)
+    assert ErrorCode.INVALID_CALL in ei.value.errors
+
+
+# ---------------------------------------------------------------------------
+# deferred MSG_WAIT outcome-unknown watermark (daemon satellite)
+# ---------------------------------------------------------------------------
+
+def test_msg_wait_below_failed_eviction_watermark_is_unknown():
+    """A deferred MSG_WAIT for a call id whose status AND failure record
+    both aged out must answer CALL_OUTCOME_UNKNOWN — never fabricate a
+    0 (the advisor-flagged false-success path)."""
+    import struct
+
+    from accl_tpu.emulator import protocol as P
+    from accl_tpu.emulator.daemon import spawn_world
+
+    daemons, _ = spawn_world(1)
+    d = daemons[0]
+    try:
+        # age >1024 failures through _record_status so the bounded FIFO
+        # evicts the oldest and advances the failure watermark
+        with d._call_cv:
+            for i in range(1, 1101):
+                d._record_status(i, int(ErrorCode.INVALID_CALL))
+            d._call_status.clear()      # statuses were also evicted
+            d._evicted_max = 1100
+        assert d._failed_evicted_max >= 1
+
+        def wait(call_id):
+            reply = d._handle(bytes([P.MSG_WAIT])
+                              + struct.pack("<Id", call_id, 0.0))
+            assert reply[0] == P.MSG_STATUS
+            return struct.unpack("<I", reply[1:5])[0]
+
+        # below the failure watermark: outcome unknowable
+        assert wait(1) == int(ErrorCode.CALL_OUTCOME_UNKNOWN)
+        # still inside the failure FIFO: the real error survives
+        assert wait(1100) == int(ErrorCode.INVALID_CALL)
+        # retired successfully above the watermark: genuine 0
+        with d._call_cv:
+            d._record_status(1101, 0)
+            del d._call_status[1101]
+            d._evicted_max = 1101
+        assert wait(1101) == 0
+    finally:
+        for dm in daemons:
+            dm.shutdown()
