@@ -24,6 +24,7 @@ from .constants import (ACCLError, CCLOp, CfgFunc, Compression, ErrorCode,
                         ReduceFunc, StackType, StreamFlags, TAG_ANY,
                         decode_error)
 from .device import Device, EmuContext, EmuDevice
+from .plancache import CompiledPlan, PlanCache
 from .tracing import Profiler
 from .tuner import Topology, Tuner
 
@@ -31,9 +32,10 @@ __version__ = "0.1.0"
 
 __all__ = [
     "ACCL", "ACCLBuffer", "ACCLError", "ArithConfig", "CallDescriptor",
-    "CallHandle", "CCLOp", "CfgFunc", "Communicator", "Compression",
-    "DEFAULT_ARITH_CONFIGS", "Device", "EmuContext", "EmuDevice",
-    "ErrorCode", "Profiler", "Rank", "ReduceFunc", "StackType", "StreamFlags",
-    "TAG_ANY", "Topology", "Tuner", "decode_error", "resolve_arith_config",
-    "simple_communicator", "wait_all",
+    "CallHandle", "CCLOp", "CfgFunc", "Communicator", "CompiledPlan",
+    "Compression", "DEFAULT_ARITH_CONFIGS", "Device", "EmuContext",
+    "EmuDevice", "ErrorCode", "PlanCache", "Profiler", "Rank", "ReduceFunc",
+    "StackType", "StreamFlags", "TAG_ANY", "Topology", "Tuner",
+    "decode_error", "resolve_arith_config", "simple_communicator",
+    "wait_all",
 ]

@@ -75,6 +75,14 @@ class ACCL:
             # engine-level AUTO resolution for descriptors that reach the
             # move engine still unresolved (moveengine.expand_call)
             device.tuner = tuner
+            # tuner re-resolution (refresh/pin — the points where
+            # epsilon-greedy or EWMA switching can flip a decision) must
+            # invalidate the device's compiled-plan cache: a switched
+            # algorithm lands on a new key, and stale entries for the
+            # old choice are dropped rather than accumulated
+            cache = getattr(device, "plan_cache", None)
+            if cache is not None:
+                tuner.register_plan_cache(cache)
             # fleet-shared tuning table (tuner/cache.py env override):
             # pins load best-effort — a missing/stale cache is not an
             # error — and once per tuner, not once per rank sharing it
@@ -311,8 +319,14 @@ class ACCL:
             addr_2=res.address if res is not None else 0)
 
     def _call(self, desc: CallDescriptor, run_async: bool,
-              waitfor: Sequence[CallHandle]) -> CallHandle:
+              waitfor: Sequence[CallHandle],
+              chain: bool = False) -> CallHandle:
         import time as _time
+        if chain and run_async:
+            # cross-call pipelining hint (the C++ driver's call_chain
+            # analog): the backend may admit this call's move program
+            # while the predecessor drains — see CallDescriptor.chain
+            desc.chain = True
         profiling = self.profiler.enabled and desc.scenario != CCLOp.config
         tunable = (desc.scenario.name in VALID_ALGORITHMS
                    and desc.algorithm != CollectiveAlgorithm.AUTO)
@@ -390,16 +404,17 @@ class ACCL:
         raise KeyError(f"no communicator with id {comm_id}")
 
     # -- primitives (parity: accl.py:738-985) ------------------------------
-    def nop(self, run_async: bool = False,
+    def nop(self, run_async: bool = False, chain: bool = False,
             waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """No-op through the full call path; used for call-latency probes
         (accl.py:738-745)."""
-        return self._call(CallDescriptor(CCLOp.nop), run_async, waitfor)
+        return self._call(CallDescriptor(CCLOp.nop), run_async, waitfor,
+                          chain)
 
     def copy(self, srcbuf: ACCLBuffer | None, dstbuf: ACCLBuffer | None,
              count: int | None = None, *,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
-             stream_dtype=None, run_async: bool = False,
+             stream_dtype=None, run_async: bool = False, chain: bool = False,
              waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """Local copy. With OP0_STREAM the source is the rank's stream-in
         port (srcbuf may be None); with RES_STREAM the result goes to the
@@ -419,13 +434,13 @@ class ACCL:
                              op0=srcbuf, res=dstbuf,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def combine(self, count: int, func: ReduceFunc, op0: ACCLBuffer | None,
                 op1: ACCLBuffer, res: ACCLBuffer | None, *,
                 stream_dtype=None,
                 stream_flags: StreamFlags = StreamFlags.NO_STREAM,
-                run_async: bool = False,
+                run_async: bool = False, chain: bool = False,
                 waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """With OP0_STREAM the first operand is sourced from this rank's
         stream-in port (op0 may be None); with RES_STREAM the result
@@ -435,13 +450,13 @@ class ACCL:
                              func=func, op0=op0, op1=op1, res=res,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def send(self, srcbuf: ACCLBuffer | None, count: int, dst: int,
              tag: int = TAG_ANY, *, comm: Communicator | None = None,
              compress_dtype=None, stream_dtype=None,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
-             run_async: bool = False,
+             run_async: bool = False, chain: bool = False,
              waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """With OP0_STREAM the payload is sourced from this rank's
         stream-in port (srcbuf may be None; element type from
@@ -452,13 +467,13 @@ class ACCL:
                              compress_dtype=compress_dtype,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def recv(self, dstbuf: ACCLBuffer | None, count: int, src: int,
              tag: int = TAG_ANY, *, comm: Communicator | None = None,
              compress_dtype=None, stream_dtype=None,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
-             run_async: bool = False,
+             run_async: bool = False, chain: bool = False,
              waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """With RES_STREAM the received payload lands on this rank's
         stream-out port instead of memory (dstbuf may be None; element
@@ -469,10 +484,10 @@ class ACCL:
                              compress_dtype=compress_dtype,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def stream_put(self, srcbuf: ACCLBuffer, count: int, dst: int,
-                   tag: int = TAG_ANY, *, run_async: bool = False,
+                   tag: int = TAG_ANY, *, run_async: bool = False, chain: bool = False,
                    waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """Send into the remote rank's stream port instead of its rx pool
         (reference: remote-stream send, strm tag in the eth header)."""
@@ -481,7 +496,7 @@ class ACCL:
         desc.stream_flags |= StreamFlags.RES_STREAM
         # remote_stream is carried via tag on the move; device backends map
         # RES_STREAM on a send to strm delivery.
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def stream_push(self, data) -> None:
         """Feed this rank's external-kernel stream-in port: the next call
@@ -502,7 +517,7 @@ class ACCL:
               *, comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                  compress_dtype=None,
-              run_async: bool = False,
+              run_async: bool = False, chain: bool = False,
               waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
         count = count if count is not None else buf.size
@@ -510,12 +525,12 @@ class ACCL:
                              root_src_dst=root, op0=buf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def scatter(self, srcbuf: ACCLBuffer | None, dstbuf: ACCLBuffer,
                 count: int, root: int = 0, *,
                 comm: Communicator | None = None, compress_dtype=None,
-                run_async: bool = False,
+                run_async: bool = False, chain: bool = False,
                 waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """count = per-rank chunk size; srcbuf holds world_size*count at
         root."""
@@ -523,14 +538,14 @@ class ACCL:
         desc = self._prepare(CCLOp.scatter, count=count, comm=comm,
                              root_src_dst=root, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype)
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def gather(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer | None,
                count: int, root: int = 0, *,
                comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                  compress_dtype=None,
-               run_async: bool = False,
+               run_async: bool = False, chain: bool = False,
                waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """count = per-rank chunk; dstbuf holds world_size*count at root.
         Non-root ranks may pass None — a scratch relay buffer (the ring
@@ -555,14 +570,14 @@ class ACCL:
                                               root) * count
             if need and dstbuf.size < need:
                 desc.addr_2 = self._scratch(need, dstbuf.dtype).address
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def reduce(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer | None, count: int,
                root: int = 0, func: ReduceFunc = ReduceFunc.SUM, *,
                comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                  compress_dtype=None,
-               run_async: bool = False,
+               run_async: bool = False, chain: bool = False,
                waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
         if comm.local_rank == root and dstbuf is None:
@@ -583,41 +598,41 @@ class ACCL:
             desc.compression &= ~Compression.RES_COMPRESSED
             if desc.compression & Compression.OP0_COMPRESSED:
                 desc.compression |= Compression.RES_COMPRESSED
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def allgather(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,
                   comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                  compress_dtype=None,
-                  run_async: bool = False,
+                  run_async: bool = False, chain: bool = False,
                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
         desc = self._prepare(CCLOp.allgather, count=count, comm=comm,
                              op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def allreduce(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int,
                   func: ReduceFunc = ReduceFunc.SUM, *,
                   comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                  compress_dtype=None,
-                  run_async: bool = False,
+                  run_async: bool = False, chain: bool = False,
                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
         desc = self._prepare(CCLOp.allreduce, count=count, comm=comm,
                              func=func, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def reduce_scatter(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer,
                        count: int, func: ReduceFunc = ReduceFunc.SUM, *,
                        comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                        compress_dtype=None,
-                       run_async: bool = False,
+                       run_async: bool = False, chain: bool = False,
                        waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """count = per-rank chunk; srcbuf holds world_size*count."""
         comm = comm or self.comm
@@ -632,17 +647,17 @@ class ACCL:
             desc.addr_1 = self._scratch(
                 comm.size * count,
                 desc.arithcfg.uncompressed_dtype).address
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def alltoall(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,
                  comm: Communicator | None = None, compress_dtype=None,
-                 run_async: bool = False,
+                 run_async: bool = False, chain: bool = False,
                  waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
         desc = self._prepare(CCLOp.alltoall, count=count, comm=comm,
                              op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype)
-        return self._call(desc, run_async, waitfor)
+        return self._call(desc, run_async, waitfor, chain)
 
     def barrier(self, *, comm: Communicator | None = None,
                 waitfor: Sequence[CallHandle] = ()) -> CallHandle:
@@ -658,6 +673,18 @@ class ACCL:
         return self._call(desc, False, waitfor)
 
     # -- introspection (parity: accl.py:412-526, 710-735) ------------------
+    def plan_cache_stats(self) -> dict:
+        """Compiled-plan cache counters of this rank's backend (hits,
+        misses, bypasses, evictions, per-reason invalidations), or an
+        ``{"enabled": False}`` stub on backends without a plan cache.
+        Per-call hit/miss/bypass is also on every profiled
+        :class:`~accl_tpu.tracing.CallRecord` (``plan_cache`` field)."""
+        cache = getattr(self.device, "plan_cache", None)
+        if cache is None:
+            return {"enabled": False, "entries": 0, "hits": 0, "misses": 0,
+                    "bypasses": 0, "evictions": 0, "invalidations": {}}
+        return cache.stats()
+
     def dump_communicator(self) -> str:
         return self.comm.describe()
 

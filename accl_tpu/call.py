@@ -50,6 +50,15 @@ class CallDescriptor:
     # out for the caller cannot later be completed by late peers and
     # mutate the caller's buffers.
     deadline: Any = None
+    # Cross-call pipelining hint (the C++ driver's call_chain analog): the
+    # caller asserts this async call's buffers are disjoint from the
+    # still-draining predecessor's, so a backend MAY admit its move
+    # program into the streamed executor while the predecessor drains.
+    # Per-peer wire emission stays in global program order (the egress
+    # ordering domain extends across the chain) and handles still
+    # complete in submission order; a failed link aborts its successors.
+    # Backends without cross-call pipelining ignore the hint.
+    chain: bool = False
 
 
 class CallHandle:

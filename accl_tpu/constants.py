@@ -290,4 +290,12 @@ DEFAULT_PIPELINE_WINDOW = 8
 # $ACCL_TPU_COMBINE_WORKERS; $ACCL_TPU_SEGMENT_STREAM=0 falls back to
 # the send-only window engine.
 DEFAULT_COMBINE_WORKERS_CAP = 4
+# Cross-call pipelining: how many chained streamed programs may be
+# admitted to the executor concurrently (the call being drained plus the
+# successors overlapping it). Bounded because every in-flight program
+# parks its not-yet-consumed inbound messages in the finite rx buffer
+# pool — deep chains on large worlds would overflow eager ingress.
+# $ACCL_TPU_CALL_CHAIN_DEPTH overrides; devices read the env at
+# CONSTRUCTION time (not import), so it can be set after importing.
+DEFAULT_CALL_CHAIN_DEPTH = 2
 TAG_ANY = 0xFFFFFFFF                        # reference uses tag=ANY sentinel

@@ -13,6 +13,7 @@ protocol, mirroring cclo_emu.cpp behind ZMQ.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Sequence
@@ -20,10 +21,11 @@ from typing import Sequence
 from ..buffer import ACCLBuffer
 from ..call import CallDescriptor, CallHandle
 from ..communicator import Communicator
-from ..constants import (ACCLError, CCLOp, DEFAULT_MAX_SEGMENT_SIZE,
-                         DEFAULT_RX_BUFFER_COUNT, DEFAULT_RX_BUFFER_SIZE,
-                         DEFAULT_TIMEOUT_S, ErrorCode)
-from ..moveengine import MoveContext, expand_call
+from ..constants import (ACCLError, CCLOp, DEFAULT_CALL_CHAIN_DEPTH,
+                         DEFAULT_MAX_SEGMENT_SIZE, DEFAULT_RX_BUFFER_COUNT,
+                         DEFAULT_RX_BUFFER_SIZE, DEFAULT_TIMEOUT_S,
+                         ErrorCode)
+from ..plancache import PlanCache, cached_program
 from ..emulator.executor import DeviceMemory, MoveExecutor, RxBufferPool
 from ..emulator.fabric import Envelope, LocalFabric
 from .base import Device
@@ -35,17 +37,21 @@ class EmuContext:
     ``pipeline_window`` sets each rank's executor in-flight window depth
     (None = the process default, 0 = strict serial reference engine);
     ``segment_stream`` selects the dependency-aware segment pipeline vs
-    the send-only window (None = the process default, on)."""
+    the send-only window (None = the process default, on); ``plan_cache``
+    enables/disables the compiled-plan cache (None = the process default,
+    ``$ACCL_TPU_PLAN_CACHE``)."""
 
     def __init__(self, world_size: int, nbufs: int = DEFAULT_RX_BUFFER_COUNT,
                  bufsize: int = DEFAULT_RX_BUFFER_SIZE,
                  pipeline_window: int | None = None,
-                 segment_stream: bool | None = None):
+                 segment_stream: bool | None = None,
+                 plan_cache: bool | None = None):
         self.world_size = world_size
         self.fabric = LocalFabric(world_size)
         self.nbufs, self.bufsize = nbufs, bufsize
         self.pipeline_window = pipeline_window
         self.segment_stream = segment_stream
+        self.plan_cache = plan_cache
         self.devices: list[EmuDevice | None] = [None] * world_size
 
     def device(self, rank: int) -> "EmuDevice":
@@ -71,9 +77,29 @@ class EmuDevice(Device):
                                      timeout=DEFAULT_TIMEOUT_S,
                                      window=ctx.pipeline_window,
                                      segment_stream=ctx.segment_stream)
+        # ingest cut-through execution: safe here because LocalFabric's
+        # send path enqueues without blocking (a jammed receiver falls to
+        # its inbox queue), so an inline hop chain can never deadlock
+        self.executor.ingest_inline = True
         self.timeout = DEFAULT_TIMEOUT_S
         self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
         self.profiling = False  # armed by the start_profiling config call
+        # compiled-plan cache (accl_tpu/plancache.py): relocatable move
+        # programs + streamed plan skeletons, keyed per call shape.
+        # comm_epoch rides in every key so a reconfigured communicator
+        # can never be served a plan built for the old membership.
+        self.plan_cache = PlanCache(enabled=ctx.plan_cache)
+        self.comm_epoch = 0
+        # env read at construction (not import) so tests/embedders can
+        # set it after importing the package
+        self.chain_depth = max(1, int(os.environ.get(
+            "ACCL_TPU_CALL_CHAIN_DEPTH", DEFAULT_CALL_CHAIN_DEPTH)))
+        # cross-call pipelining (chained calls): finishes retire on a
+        # dedicated FIFO thread so the call worker can admit the next
+        # chained program while the previous one drains
+        self._chain_q: queue.Queue | None = None
+        self._chain_cv = threading.Condition()
+        self._chain_pending = 0
         self._calls: queue.Queue = queue.Queue()
         # one lock serializes every execution (worker or inline); the
         # inline gate itself lives on the Device base. The counter here
@@ -136,10 +162,14 @@ class EmuDevice(Device):
     def configure_communicator(self, comm: Communicator):
         """Register a communicator (world or split); calls reference it by
         comm_id, like the reference addressing communicator records in
-        exchange memory (accl.py:677-708)."""
+        exchange memory (accl.py:677-708). Reconfiguration invalidates the
+        compiled-plan cache (and bumps the epoch its keys carry): plans
+        bind comm size/rank numbering at expansion time."""
         self.comms[comm.comm_id] = comm
         if self.comm is None:
             self.comm = comm
+        self.comm_epoch += 1
+        self.plan_cache.invalidate("comm")
 
     def set_timeout(self, timeout: float):
         self.timeout = timeout
@@ -189,10 +219,12 @@ class EmuDevice(Device):
         # runs only when nothing is queued or in flight, and any call
         # submitted meanwhile serializes behind _exec_mu.
         if inline_ok and self._inline_begin(waitfor):
+            deferred = False
             try:
-                self._retire(desc, waitfor, handle)
+                deferred = self._retire(desc, waitfor, handle)
             finally:
-                self._inflight_done()
+                if not deferred:
+                    self._inflight_done()
             return handle
         self._inflight_add()
         self._calls.put((desc, waitfor, handle))
@@ -216,6 +248,9 @@ class EmuDevice(Device):
     def deinit(self):
         self._calls.put(None)
         self._inbox.put(None)
+        with self._chain_cv:
+            if self._chain_q is not None:
+                self._chain_q.put(None)
         self.executor.close()
 
     # -- worker ------------------------------------------------------------
@@ -225,18 +260,30 @@ class EmuDevice(Device):
             if item is None:
                 return
             desc, waitfor, handle = item
+            deferred = False
             try:
-                self._retire(desc, waitfor, handle)
+                deferred = self._retire(desc, waitfor, handle)
             finally:
-                self._inflight_done()
+                if not deferred:
+                    self._inflight_done()
 
-    def _retire(self, desc: CallDescriptor, waitfor, handle: CallHandle):
+    def _retire(self, desc: CallDescriptor, waitfor,
+                handle: CallHandle) -> bool:
         """Wait dependencies, execute, complete the handle — never raises
-        (errors land in the handle)."""
+        (errors land in the handle). Returns True when the call was
+        ADMITTED as a chained program: the handle (and this device's
+        in-flight accounting) then retires on the chain-finish thread,
+        after the program drains."""
         try:
             for dep in waitfor:
                 dep.wait(self.timeout)
             with self._exec_mu:
+                if self._try_chain(desc, handle):
+                    return True
+                # a non-chained call must observe every chained
+                # predecessor fully retired (execution serialization and
+                # handle-completion order are the existing contract)
+                self._drain_chain()
                 self._last_move_stats = None
                 err = self._execute(desc)
                 stats = self._last_move_stats
@@ -253,6 +300,82 @@ class EmuDevice(Device):
                             exception=exc)
         except Exception as exc:  # noqa: BLE001 — report, don't kill worker
             handle.complete(int(ErrorCode.INVALID_CALL), exception=exc)
+        return False
+
+    # -- cross-call pipelining (chained calls) -----------------------------
+    def _try_chain(self, desc: CallDescriptor, handle: CallHandle) -> bool:
+        """Admit a chain-hinted call into the streamed executor WITHOUT
+        waiting for it (or its predecessors) to drain. Only a compiled-
+        plan cache HIT qualifies — a miss pays expansion anyway, so it
+        takes the ordinary path (which populates the cache for the next
+        link). Caller holds ``_exec_mu``."""
+        if not desc.chain or desc.scenario in (CCLOp.config, CCLOp.nop):
+            return False
+        ex = self.executor
+        if not (ex.window > 0 and ex.segment_stream
+                and self.plan_cache.enabled):
+            return False
+        comm = self.comms.get(desc.comm_id)
+        if comm is None or desc.arithcfg is None:
+            return False
+        got = cached_program(self.plan_cache, compile_missing=False,
+                             tuner=self.tuner, streamed=True,
+                             **self._cache_args(desc, comm))
+        if got is None or got[1] is None:
+            return False  # miss (or no skeleton): ordinary path
+        moves, skeleton, _state, expand_us, _plan_us = got
+        # bound admission depth: each in-flight program parks its inbound
+        # messages in the (finite) rx pool until consumed, so an unbounded
+        # chain would overflow eager ingress
+        with self._chain_cv:
+            while self._chain_pending >= self.chain_depth:
+                self._chain_cv.wait()
+            if self._chain_q is None:
+                self._chain_q = queue.Queue()
+                threading.Thread(target=self._chain_loop, daemon=True,
+                                 name=f"emu-chain{self.rank}").start()
+            self._chain_pending += 1
+        try:
+            meta = {"expand_us": round(expand_us, 1),
+                    "plan_us": 0.0, "plan_cache": "hit"}
+            prog = ex.begin_streamed(moves, desc.arithcfg, comm,
+                                     skeleton=skeleton)
+            self._chain_q.put((prog, handle, meta))
+        except BaseException:
+            # admission failed (executor closing, ...): the pending slot
+            # must be returned or _drain_chain deadlocks the call worker
+            with self._chain_cv:
+                self._chain_pending -= 1
+                self._chain_cv.notify_all()
+            raise
+        return True
+
+    def _chain_loop(self):
+        """FIFO retirement of chained programs: completion order follows
+        admission order, so chained handles observe the same ordering
+        contract as queued calls."""
+        while True:
+            item = self._chain_q.get()
+            if item is None:
+                return
+            prog, handle, meta = item
+            try:
+                err, stats = self.executor.finish_streamed(prog)
+                handle.pipeline_stats = dict(stats, **meta)
+                handle.complete(err)
+            except Exception as exc:  # noqa: BLE001 — keep retiring
+                handle.complete(int(ErrorCode.INVALID_CALL), exception=exc)
+            finally:
+                self._inflight_done()
+                with self._chain_cv:
+                    self._chain_pending -= 1
+                    self._chain_cv.notify_all()
+
+    def _drain_chain(self):
+        """Block until every admitted chained program has retired."""
+        with self._chain_cv:
+            while self._chain_pending:
+                self._chain_cv.wait()
 
     def _execute(self, desc: CallDescriptor) -> int:
         if desc.scenario == CCLOp.nop:
@@ -269,19 +392,43 @@ class EmuDevice(Device):
     def segment_size_bound(self) -> int | None:
         return self.ctx.bufsize  # segments must fit rx buffers
 
-    def _execute_data(self, desc: CallDescriptor, comm: Communicator) -> int:
-        ctx = MoveContext(world_size=comm.size,
-                          local_rank=comm.local_rank,
-                          arithcfg=desc.arithcfg,
-                          max_segment_size=self.max_segment_size,
-                          tuner=self.tuner)
-        moves = expand_call(
-            ctx, desc.scenario, count=desc.count,
+    def _streamed_engine(self) -> bool:
+        ex = self.executor
+        return ex.window > 0 and ex.segment_stream
+
+    def _cache_args(self, desc: CallDescriptor, comm: Communicator) -> dict:
+        """The :func:`~accl_tpu.plancache.cached_program` arguments this
+        descriptor maps to (shared by the execute and chained-admission
+        paths so their keys can never drift)."""
+        return dict(
+            scenario=desc.scenario, count=desc.count,
+            world_size=comm.size, local_rank=comm.local_rank,
+            arithcfg=desc.arithcfg,
+            max_segment_size=self.max_segment_size,
+            comm_id=desc.comm_id, comm_epoch=self.comm_epoch,
             root_src_dst=desc.root_src_dst, func=desc.function,
-            tag=desc.tag,
-            addr_0=desc.addr_0, addr_1=desc.addr_1, addr_2=desc.addr_2,
+            tag=desc.tag, bases=(desc.addr_0, desc.addr_1, desc.addr_2),
             compression=desc.compression, stream=desc.stream_flags,
             algorithm=desc.algorithm)
-        err = self.executor.execute(moves, desc.arithcfg, comm)
-        self._last_move_stats = dict(self.executor.last_stats)
+
+    def _prepare_program(self, desc: CallDescriptor, comm: Communicator):
+        """Produce this call's move program through the one shared
+        preparation path (plancache.cached_program): a cache hit only
+        rebinds addresses (and the executor rebases wire seqns); a miss
+        expands once against symbolic bases and caches the result;
+        cache-disabled runs expand fresh. Returns
+        (moves, skeleton-or-None, CallRecord plan-cache meta)."""
+        moves, skeleton, state, expand_us, plan_us = cached_program(
+            self.plan_cache, tuner=self.tuner,
+            streamed=self._streamed_engine(),
+            **self._cache_args(desc, comm))
+        return moves, skeleton, {
+            "expand_us": round(expand_us, 1),
+            "plan_us": round(plan_us, 1), "plan_cache": state}
+
+    def _execute_data(self, desc: CallDescriptor, comm: Communicator) -> int:
+        moves, skeleton, meta = self._prepare_program(desc, comm)
+        err = self.executor.execute(moves, desc.arithcfg, comm,
+                                    skeleton=skeleton)
+        self._last_move_stats = dict(self.executor.last_stats, **meta)
         return err

@@ -28,7 +28,7 @@ from ..arith import ArithConfig
 from ..communicator import Communicator, Rank
 from ..constants import (CCLOp, CfgFunc, CollectiveAlgorithm, Compression,
                          ErrorCode, ReduceFunc, StreamFlags)
-from ..moveengine import MoveContext, expand_call
+from ..plancache import PlanCache, cached_program
 from . import protocol as P
 from .executor import DeviceMemory, MoveExecutor, RxBufferPool
 from .fabric import Envelope
@@ -482,6 +482,12 @@ class RankDaemon:
         self.timeout = 30.0
         self.max_segment_size = bufsize
         self.comms: dict[int, Communicator] = {}
+        # compiled-plan cache (accl_tpu/plancache.py): the Python daemon
+        # pays the same per-call expand+plan control-plane floor the
+        # in-process tier does — ~230us/call at small sizes — and the
+        # same (shape-keyed, epoch-invalidated) cache removes it
+        self.plan_cache = PlanCache()
+        self.comm_epoch = 0
         # bind the cmd port before the eth fabric / worker thread so a
         # port collision fails before any resources need cleanup
         self._server = socket.create_server((host, port_base + rank))
@@ -691,22 +697,32 @@ class RankDaemon:
                 # sanity bound BEFORE expansion: a hostile count would
                 # otherwise materialize count/segment move objects
                 return int(ErrorCode.DMA_SIZE_ERROR)
-            ctx = MoveContext(world_size=comm.size,
-                              local_rank=comm.local_rank, arithcfg=cfg,
-                              max_segment_size=self.max_segment_size)
             alg = c.get("algorithm", 0)
             func = self._FUNCS.get(c["func"])
             algorithm = self._ALGOS.get(alg)
-            moves = expand_call(
-                ctx, scenario, count=c["count"], root_src_dst=c["root"],
-                func=ReduceFunc(c["func"]) if func is None else func,
-                tag=c["tag"],
-                addr_0=c["addr0"], addr_1=c["addr1"], addr_2=c["addr2"],
-                compression=Compression(c["compression"]),
-                stream=StreamFlags(c["stream"]),
-                algorithm=(CollectiveAlgorithm(alg) if algorithm is None
-                           else algorithm))
-            return self.executor.execute(moves, cfg, comm)
+            func = ReduceFunc(c["func"]) if func is None else func
+            algorithm = (CollectiveAlgorithm(alg) if algorithm is None
+                         else algorithm)
+            compression = Compression(c["compression"])
+            stream = StreamFlags(c["stream"])
+            bases = (c["addr0"], c["addr1"], c["addr2"])
+            # the one shared preparation path (plancache.cached_program):
+            # resolves AUTO before keying, handles hit/miss/bypass (no
+            # tuner daemon-side — descriptors normally arrive
+            # pre-resolved; AUTO falls to the shared defaults)
+            moves, skeleton, _state, _expand_us, _plan_us = \
+                cached_program(
+                    self.plan_cache, scenario=scenario, count=c["count"],
+                    world_size=comm.size, local_rank=comm.local_rank,
+                    arithcfg=cfg, max_segment_size=self.max_segment_size,
+                    comm_id=c["comm_id"], comm_epoch=self.comm_epoch,
+                    root_src_dst=c["root"], func=func, tag=c["tag"],
+                    bases=bases, compression=compression, stream=stream,
+                    algorithm=algorithm,
+                    streamed=(self.executor.window > 0
+                              and self.executor.segment_stream))
+            return self.executor.execute(moves, cfg, comm,
+                                         skeleton=skeleton)
         except Exception:  # noqa: BLE001
             import traceback
             traceback.print_exc()
@@ -952,6 +968,10 @@ class RankDaemon:
                        for g, h, p in ranks],
                 local_rank=local_rank, comm_id=comm_id)
             self.comms[comm_id] = comm
+            # reconfiguration invalidates compiled plans (membership /
+            # rank numbering is baked into an expansion)
+            self.comm_epoch += 1
+            self.plan_cache.invalidate("comm")
             self.eth.learn_peers(ranks, self.world)
             return P.status_reply(0)
         if kind == P.MSG_SET_TIMEOUT:

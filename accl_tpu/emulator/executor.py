@@ -361,6 +361,15 @@ class _ScratchArena:
 _ST_PENDING, _ST_WAITING, _ST_READY, _ST_RUNNING = 0, 1, 2, 3
 _ST_RETIRED, _ST_CANCELLED = 4, 5
 
+# Ingest cut-through execution (small-message latency): nesting depth of
+# inline task execution per THREAD — a task run in the ingesting thread
+# may emit a message whose receiver runs ITS task inline too, chaining
+# whole dependency hops through one thread with zero wakeups. The cap
+# bounds the Python stack (each hop is a handful of frames) and hands the
+# tail back to the worker/cv path.
+_INLINE = threading.local()
+_INLINE_CAP = 20
+
 
 class _MovePlan:
     """Per-move execution plan: pre-assigned wire sequence numbers plus
@@ -390,7 +399,7 @@ class _Prog:
 
     __slots__ = ("cfg", "comm", "waiting", "ready", "outstanding",
                  "running", "err", "aborted", "pipelined", "max_depth",
-                 "combining", "max_combining", "lanes")
+                 "combining", "max_combining", "lanes", "nmoves", "exc")
 
     def __init__(self, cfg, comm):
         self.cfg = cfg
@@ -406,6 +415,168 @@ class _Prog:
         self.combining = 0
         self.max_combining = 0
         self.lanes = 0
+        self.nmoves = 0
+        self.exc: BaseException | None = None  # feed-time barrier raise
+
+
+# ---------------------------------------------------------------------------
+# Plan skeleton: the RELOCATABLE part of the streamed plan pass.
+#
+# ``plan_skeleton`` is a pure function of the move program — dependency
+# edges, cut-through fusion, per-peer sequence-number DELTAS (position of
+# each recv/send in its peer's per-call stream) and per-peer totals. It
+# contains no live counter values and no concrete communicator state, so a
+# compiled-plan cache (accl_tpu/plancache.py) can keep it alongside the
+# symbolic move program and skip the whole derivation on a cache hit:
+# instantiation then only rebases the deltas onto the live per-peer
+# counters and builds fresh per-execution ``_MovePlan`` state.
+# ---------------------------------------------------------------------------
+
+def _move_window_eligible(mv: Move) -> bool:
+    """Only pure pool-destined sends ride the window: no local write,
+    no stream port, no recv-matching — the shape every ``blocking=False``
+    expansion site produces. Everything else runs inline even when marked
+    non-blocking."""
+    return (not mv.blocking and mv.res_remote and not mv.res_local
+            and not mv.remote_stream and mv.func is None
+            and mv.op0.mode is MoveMode.IMMEDIATE
+            and mv.op1.mode is MoveMode.NONE)
+
+
+def _move_stream_eligible(mv: Move) -> bool:
+    """May this move run on the combine-worker pool? Laned moves ride
+    their lane chain; unlaned pure non-blocking sends float behind the
+    last barrier (the window engine's eligibility rule). Stream ports and
+    remote-stream sends are order-sensitive beyond the seqn channel and
+    always run inline."""
+    if (mv.remote_stream or mv.op0.mode is MoveMode.STREAM
+            or mv.op1.mode is MoveMode.STREAM
+            or (mv.res_local and mv.res.mode is MoveMode.STREAM)):
+        return False
+    return mv.lane is not None or _move_window_eligible(mv)
+
+
+class _PlanStep:
+    """Relocatable per-move plan entry (no live counters, no comm)."""
+
+    __slots__ = ("eligible", "dep", "fuse", "fused", "rx0", "rx1", "tx")
+
+    def __init__(self):
+        self.eligible = False
+        self.dep = -1                # move index this one waits on (-1: none)
+        self.fuse = -1               # cut-through relay index (-1: none)
+        self.fused = False           # this relay is emitted by its recv
+        self.rx0: tuple | None = None  # (src comm-local rank, seqn delta)
+        self.rx1: tuple | None = None
+        self.tx: tuple | None = None   # (dst comm-local rank, seqn delta)
+
+
+class PlanSkeleton:
+    """Derived plan for one move program, relative to call entry: per-move
+    steps plus the per-peer inbound/outbound seqn totals the instantiation
+    advances the live counters by."""
+
+    __slots__ = ("steps", "in_totals", "out_totals", "nlanes")
+
+    def __init__(self, steps, in_totals, out_totals, nlanes):
+        self.steps = steps
+        self.in_totals = in_totals    # comm-local rank -> ON_RECV count
+        self.out_totals = out_totals  # comm-local rank -> send count
+        self.nlanes = nlanes
+
+
+def _skeleton_fuse(moves: list[Move], steps: list[_PlanStep], i: int):
+    """Cut-through relay peephole (reference: the CCLO relays straight
+    off the rx path, never re-reading the landing slot —
+    ccl_offload_control.c:739-743 / dma_mover segment relay). When a
+    lane's recv is immediately followed by a pure send of EXACTLY the
+    bytes it wrote (same address, count, uncompressed storage), the recv
+    task emits the relay itself from the in-hand payload: the slot is
+    still written (bit-identical memory), but the relay's slot re-read,
+    its payload copy, and one full task's scheduling are gone.
+    Compressed-res lanes are skipped — re-reading the slot round-trips
+    through the compressed dtype there, and cut-through must be
+    bit-identical to the serial oracle."""
+    e = steps[i]
+    mv = moves[i]
+    if e.dep < 0 or e.dep >= i:
+        return
+    r = steps[e.dep]
+    rmv = moves[e.dep]
+    if (r.eligible and r.fuse < 0
+            and rmv.op1.mode is MoveMode.ON_RECV
+            and rmv.op0.mode is MoveMode.NONE and rmv.func is None
+            and rmv.res_local and not rmv.res_remote
+            and rmv.res.mode is MoveMode.IMMEDIATE
+            and not rmv.res.compressed
+            and mv.func is None and mv.res_remote and not mv.res_local
+            and not mv.remote_stream
+            and mv.op0.mode is MoveMode.IMMEDIATE
+            and not mv.op0.compressed
+            and mv.op0.addr == rmv.res.addr and mv.count == rmv.count):
+        r.fuse = i
+        e.fused = True
+
+
+def plan_skeleton(moves: list[Move]) -> PlanSkeleton:
+    """Walk a program once, deriving every move's dependency edge, fusion
+    and per-peer seqn DELTA in program order: laned moves chain behind the
+    previous move of the same lane, unlaned window-eligible sends behind
+    the last barrier, and everything else IS a barrier (full drain +
+    inline execution). Pure in the move program — relocation (rebasing
+    operand addresses onto different buffers) does not change the
+    skeleton, which is what makes it cacheable."""
+    steps: list[_PlanStep] = []
+    in_totals: dict[int, int] = {}
+    out_totals: dict[int, int] = {}
+    lanes: set[int] = set()
+    last_barrier = -1
+    laned_write_since_barrier = False
+    lane_last: dict[int, int] = {}
+    for i, mv in enumerate(moves):
+        st = _PlanStep()
+        if mv.op0.mode is MoveMode.ON_RECV:
+            d = in_totals.get(mv.op0.src_rank, 0)
+            st.rx0 = (mv.op0.src_rank, d)
+            in_totals[mv.op0.src_rank] = d + 1
+        if mv.op1.mode is MoveMode.ON_RECV:
+            d = in_totals.get(mv.op1.src_rank, 0)
+            st.rx1 = (mv.op1.src_rank, d)
+            in_totals[mv.op1.src_rank] = d + 1
+        if mv.res_remote and not mv.remote_stream:
+            d = out_totals.get(mv.dst_rank, 0)
+            st.tx = (mv.dst_rank, d)
+            out_totals[mv.dst_rank] = d + 1
+        st.eligible = _move_stream_eligible(mv)
+        if st.eligible and mv.lane is None and laned_write_since_barrier:
+            # unlaned window send after a LANED local writer: its
+            # non-blocking invariant only covers LATER writers of its
+            # source, and lanes retire out of order — a single-edge
+            # dependency cannot prove every earlier write landed
+            # (in-place alltoall's second half reads chunks the
+            # first half's laned recvs write). Demote to a barrier:
+            # drain-all makes every earlier write visible, exactly
+            # the order the window engine's inline recvs gave it.
+            st.eligible = False
+        if st.eligible:
+            dep = last_barrier
+            if mv.lane is not None:
+                # lane invariant: the expansion guarantees this move
+                # touches only bytes its own lane's predecessors
+                # wrote — the lane chain IS the hazard edge
+                dep = max(dep, lane_last.get(mv.lane, -1))
+                lane_last[mv.lane] = i
+                lanes.add(mv.lane)
+            st.dep = dep
+            steps.append(st)
+            _skeleton_fuse(moves, steps, i)
+        else:
+            last_barrier = i
+            laned_write_since_barrier = False
+            steps.append(st)
+        if st.eligible and mv.res_local and mv.lane is not None:
+            laned_write_since_barrier = True
+    return PlanSkeleton(steps, in_totals, out_totals, len(lanes))
 
 
 class MoveExecutor:
@@ -484,6 +655,16 @@ class MoveExecutor:
                                    max(0, (os.cpu_count() or 2) - 2)))
         self._n_workers = max(0, int(combine_workers))
         self.tx_serializes = False
+        # Ingest cut-through execution: run a just-promoted waiting move
+        # INLINE in the ingesting thread instead of waking a worker — on
+        # small messages the cross-thread wakeup (~a scheduler quantum on
+        # a loaded host) dominates the hop, and the chain "send → peer
+        # combine → relay → next peer" then executes synchronously
+        # through one thread. Only safe when the fabric's send path can
+        # never block (the in-process LocalFabric enqueues; socket
+        # fabrics could jam their reader thread against a full send
+        # buffer) — owners opt in (device/emu.py sets True).
+        self.ingest_inline = False
         # in-flight window state (lazily started worker)
         self._wq: queue.Queue | None = None
         self._win_cv = threading.Condition()
@@ -497,7 +678,15 @@ class MoveExecutor:
         # measurable thundering herd at segment granularity).
         self._sched_lock = threading.Lock()
         self._work_cv = threading.Condition(self._sched_lock)
-        self._prog: _Prog | None = None
+        # active streamed programs, admission order. More than one is live
+        # only during cross-call pipelining (a chained call admitted while
+        # its predecessor drains); admission and finish keep the list
+        # consistent under _sched_lock.
+        self._progs: list[_Prog] = []
+        # comms of finished programs whose egress resync is deferred until
+        # the executor goes idle (resyncing while a later chained program
+        # is active would skip its un-emitted frames)
+        self._pending_resync: list[Communicator] = []
         self._stream_workers_started = False
         self._arena = _ScratchArena(slots=self._n_workers + 4)
         self._eg_lock = threading.Lock()
@@ -843,14 +1032,10 @@ class MoveExecutor:
     # -- in-flight window --------------------------------------------------
     @staticmethod
     def _window_eligible(mv: Move) -> bool:
-        """Only pure pool-destined sends ride the window: no local write,
-        no stream port, no recv-matching — the shape every
-        ``blocking=False`` expansion site produces. Everything else runs
-        inline even when marked non-blocking."""
-        return (not mv.blocking and mv.res_remote and not mv.res_local
-                and not mv.remote_stream and mv.func is None
-                and mv.op0.mode is MoveMode.IMMEDIATE
-                and mv.op1.mode is MoveMode.NONE)
+        """See :func:`_move_window_eligible` (module level so the plan
+        skeleton derivation and scripts/check_blocking.py share the one
+        predicate the engine actually overlaps)."""
+        return _move_window_eligible(mv)
 
     def _window_loop(self, wq: queue.Queue):
         while True:
@@ -926,113 +1111,81 @@ class MoveExecutor:
     # program order without any worker ever blocking on a peer's turn.
 
     def _stream_eligible(self, mv: Move) -> bool:
-        """May this move run on the combine-worker pool? Laned moves ride
-        their lane chain; unlaned pure non-blocking sends float behind
-        the last barrier (the window engine's eligibility rule). Stream
-        ports and remote-stream sends are order-sensitive beyond the
-        seqn channel and always run inline."""
-        if (mv.remote_stream or mv.op0.mode is MoveMode.STREAM
-                or mv.op1.mode is MoveMode.STREAM
-                or (mv.res_local and mv.res.mode is MoveMode.STREAM)):
-            return False
-        return mv.lane is not None or self._window_eligible(mv)
+        """See :func:`_move_stream_eligible` (module level so the plan
+        skeleton derivation shares the engine's own predicate)."""
+        return _move_stream_eligible(mv)
 
-    def _plan_streamed(self, moves: list[Move], comm: Communicator
-                       ) -> list[_MovePlan]:
+    def _instantiate_locked(self, skeleton: PlanSkeleton, moves: list[Move],
+                            comm: Communicator) -> list[_MovePlan]:
+        """Bind one skeleton to the live communicator: rebase every seqn
+        delta onto the current per-peer counters (advancing them to their
+        final values — matching is exact-key, so segments may then be
+        CONSUMED out of order) and build fresh per-execution _MovePlan
+        state. Caller holds ``_sched_lock`` — counter advance, egress sync
+        and program registration must be atomic against a concurrent
+        finish of an earlier chained program."""
+        if not any(p.comm.comm_id == comm.comm_id for p in self._progs):
+            with self._eg_lock:
+                # (re)sync next-emit to the live counters — not
+                # setdefault: a soft reset zeroes the counters between
+                # programs, and stale egress expectations would park
+                # every post-reset frame forever. Skipped when an active
+                # program shares the communicator: cross-call pipelining
+                # EXTENDS the egress ordering domain across calls, and
+                # the predecessor's un-emitted frames sit below the
+                # already-advanced counters.
+                for r in comm.ranks:
+                    key = (r.global_rank, comm.comm_id)
+                    old = self._egress.get(key)
+                    if old is not None:
+                        # an aborted predecessor whose deferred resync
+                        # never ran (another comm kept the executor
+                        # busy) may have parked frames here — their
+                        # release() callbacks pin arena slots and must
+                        # fire before the entry is replaced
+                        for _env, _payload, release in old[1].values():
+                            if release is not None:
+                                release()
+                    self._egress[key] = [r.outbound_seq, {}, False]
+        base_in: dict[int, int] = {}
+        base_out: dict[int, int] = {}
+        for local, n in skeleton.in_totals.items():
+            rk = comm.ranks[local]
+            base_in[local] = rk.inbound_seq
+            rk.inbound_seq += n  # exchange-mem seq update parity
+        for local, n in skeleton.out_totals.items():
+            rk = comm.ranks[local]
+            base_out[local] = rk.outbound_seq
+            rk.outbound_seq += n
         entries: list[_MovePlan] = []
-        last_barrier = -1
-        laned_write_since_barrier = False
-        lane_last: dict[int, int] = {}
-        with self._eg_lock:
-            # (re)sync next-emit to the live counters — not setdefault: a
-            # soft reset zeroes the counters between programs, and stale
-            # egress expectations would park every post-reset frame
-            # forever (programs are serialized, so nothing is in flight
-            # here and parked maps are empty)
-            for r in comm.ranks:
-                self._egress[(r.global_rank, comm.comm_id)] = \
-                    [r.outbound_seq, {}, False]
         for i, mv in enumerate(moves):
+            st = skeleton.steps[i]
             e = _MovePlan(i, mv)
+            e.eligible = st.eligible
+            e.dep = st.dep
+            e.fused = st.fused
             keys = []
-            if mv.op0.mode is MoveMode.ON_RECV:
-                rk = comm.ranks[mv.op0.src_rank]
-                e.rx0 = rk.inbound_seq
-                rk.inbound_seq += 1
-                keys.append(((rk.global_rank, comm.comm_id, e.rx0),
-                             mv.op0.tag))
-            if mv.op1.mode is MoveMode.ON_RECV:
-                rk = comm.ranks[mv.op1.src_rank]
-                e.rx1 = rk.inbound_seq
-                rk.inbound_seq += 1
-                keys.append(((rk.global_rank, comm.comm_id, e.rx1),
-                             mv.op1.tag))
+            if st.rx0 is not None:
+                src, d = st.rx0
+                e.rx0 = base_in[src] + d
+                keys.append(((comm.ranks[src].global_rank, comm.comm_id,
+                              e.rx0), mv.op0.tag))
+            if st.rx1 is not None:
+                src, d = st.rx1
+                e.rx1 = base_in[src] + d
+                keys.append(((comm.ranks[src].global_rank, comm.comm_id,
+                              e.rx1), mv.op1.tag))
+            if st.tx is not None:
+                dst, d = st.tx
+                e.tx = base_out[dst] + d
             e.rx_keys = tuple(keys)
-            if mv.res_remote and not mv.remote_stream:
-                rk = comm.ranks[mv.dst_rank]
-                e.tx = rk.outbound_seq
-                rk.outbound_seq += 1
-            e.eligible = self._stream_eligible(mv)
-            if e.eligible and mv.lane is None and laned_write_since_barrier:
-                # unlaned window send after a LANED local writer: its
-                # non-blocking invariant only covers LATER writers of its
-                # source, and lanes retire out of order — a single-edge
-                # dependency cannot prove every earlier write landed
-                # (in-place alltoall's second half reads chunks the
-                # first half's laned recvs write). Demote to a barrier:
-                # drain-all makes every earlier write visible, exactly
-                # the order the window engine's inline recvs gave it.
-                e.eligible = False
-            if e.eligible:
-                dep = last_barrier
-                if mv.lane is not None:
-                    # lane invariant: the expansion guarantees this move
-                    # touches only bytes its own lane's predecessors
-                    # wrote — the lane chain IS the hazard edge
-                    dep = max(dep, lane_last.get(mv.lane, -1))
-                    lane_last[mv.lane] = i
-                e.dep = dep
-                self._try_fuse_relay(entries, e)
-            else:
-                last_barrier = i
-                laned_write_since_barrier = False
-            if e.eligible and mv.res_local and mv.lane is not None:
-                laned_write_since_barrier = True
             entries.append(e)
+        for i, st in enumerate(skeleton.steps):
+            if st.fuse >= 0:
+                r = entries[i]
+                r.fuse = entries[st.fuse]
+                r.succ.append(entries[st.fuse])  # retire/cancel bookkeeping
         return entries
-
-    @staticmethod
-    def _try_fuse_relay(entries: list[_MovePlan], e: _MovePlan):
-        """Cut-through relay peephole (reference: the CCLO relays straight
-        off the rx path, never re-reading the landing slot —
-        ccl_offload_control.c:739-743 / dma_mover segment relay). When a
-        lane's recv is immediately followed by a pure send of EXACTLY the
-        bytes it wrote (same address, count, uncompressed storage), the
-        recv task emits the relay itself from the in-hand payload: the
-        slot is still written (bit-identical memory), but the relay's
-        slot re-read, its payload copy, and one full task's scheduling
-        are gone. Compressed-res lanes are skipped — re-reading the slot
-        round-trips through the compressed dtype there, and cut-through
-        must be bit-identical to the serial oracle."""
-        mv = e.mv
-        if e.dep < 0 or e.dep >= len(entries):
-            return
-        r = entries[e.dep]
-        rmv = r.mv
-        if (r.eligible and r.fuse is None
-                and rmv.op1.mode is MoveMode.ON_RECV
-                and rmv.op0.mode is MoveMode.NONE and rmv.func is None
-                and rmv.res_local and not rmv.res_remote
-                and rmv.res.mode is MoveMode.IMMEDIATE
-                and not rmv.res.compressed
-                and mv.func is None and mv.res_remote and not mv.res_local
-                and not mv.remote_stream
-                and mv.op0.mode is MoveMode.IMMEDIATE
-                and not mv.op0.compressed
-                and mv.op0.addr == rmv.res.addr and mv.count == rmv.count):
-            r.fuse = e
-            e.fused = True
-            r.succ.append(e)  # retire/cancel bookkeeping rides the chain
 
     def _ensure_stream_workers(self):
         with self._sched_lock:
@@ -1047,14 +1200,22 @@ class MoveExecutor:
     def _stream_worker_loop(self):
         while True:
             with self._sched_lock:
-                while not self._closed and (self._prog is None
-                                            or not self._prog.ready):
+                while not self._closed and self._pick_prog_locked() is None:
                     self._work_cv.wait()
                 if self._closed:
                     return
-                prog = self._prog
+                prog = self._pick_prog_locked()
                 task = self._pop_task_locked(prog)
             self._run_task(prog, task)
+
+    def _pick_prog_locked(self) -> _Prog | None:
+        """Earliest active program with runnable work (admission order —
+        draining the predecessor first keeps chained programs' wire
+        emission flowing)."""
+        for p in self._progs:
+            if p.ready:
+                return p
+        return None
 
     def _pop_task_locked(self, prog: _Prog) -> _MovePlan:
         task = prog.ready.pop(0)
@@ -1132,30 +1293,52 @@ class MoveExecutor:
 
     def _on_pool_ingest(self, key: tuple[int, int, int]):
         """Pool arrival listener (any thread): promote the move waiting on
-        this exact (src, comm_id, seqn), if one is parked."""
-        if self._prog is None:
+        this exact (src, comm_id, seqn), if one is parked. Seqns are
+        unique per (peer, comm) across ALL active programs, so at most one
+        program can be waiting on the key."""
+        if not self._progs:
             # GIL-snapshot fast exit: serial/window engines (and idle
             # executors) must not pay a scheduler lock per ingest. A
             # program installed after this read re-probes the pool at
             # activation, so the wakeup cannot be lost.
             return
+        run = None
         with self._sched_lock:
-            prog = self._prog
-            if prog is None:
-                return
-            task = prog.waiting.pop(key, None)
-            if task is None or task.state != _ST_WAITING:
-                return
-            # re-gate on any OTHER still-missing key (multi-recv moves)
-            for k, tag in task.rx_keys:
-                if k == key:
+            for prog in self._progs:
+                task = prog.waiting.pop(key, None)
+                if task is None:
                     continue
-                if not self._pool.has_match(k[0], tag, k[2], comm_id=k[1]):
-                    prog.waiting[k] = task
+                if task.state != _ST_WAITING:
                     return
-            task.state = _ST_READY
-            prog.ready.append(task)
-            self._work_cv.notify()
+                # re-gate on any OTHER still-missing key (multi-recv moves)
+                for k, tag in task.rx_keys:
+                    if k == key:
+                        continue
+                    if not self._pool.has_match(k[0], tag, k[2],
+                                                comm_id=k[1]):
+                        prog.waiting[k] = task
+                        return
+                task.state = _ST_READY
+                prog.ready.append(task)
+                if (self.ingest_inline
+                        and getattr(_INLINE, "depth", 0) < _INLINE_CAP):
+                    # cut-through: execute a ready task (FIFO head — any
+                    # ready task keeps the pipe moving) in THIS thread
+                    # instead of paying a worker wakeup per hop. The pool
+                    # lock is not held here (listeners fire outside it)
+                    # and the emu fabric's send path never blocks, so the
+                    # nested emit → peer-ingest → peer-inline chain is
+                    # deadlock-free; the depth cap bounds the stack.
+                    run = (prog, self._pop_task_locked(prog))
+                else:
+                    self._work_cv.notify()
+                break
+        if run is not None:
+            _INLINE.depth = getattr(_INLINE, "depth", 0) + 1
+            try:
+                self._run_task(*run)
+            finally:
+                _INLINE.depth -= 1
 
     def _cancel_chain_locked(self, prog: _Prog, succ: list):
         stack = list(succ)
@@ -1198,31 +1381,40 @@ class MoveExecutor:
         per-move timeout."""
         while True:
             task = None
+            run_prog = None
             with self._sched_lock:
-                if prog.ready:
-                    task = self._pop_task_locked(prog)
+                run_prog = self._pick_prog_locked()
+                if run_prog is not None:
+                    # help ANY active program — draining an earlier
+                    # chained program is what unblocks this one's wire
+                    task = self._pop_task_locked(run_prog)
                 elif prog.outstanding == 0 and self._eg_busy == 0:
                     return
                 else:
                     now = time.monotonic()
                     nearest = None
                     expired = None
-                    for t in prog.waiting.values():
-                        if t.deadline <= now:
-                            expired = t
+                    exp_prog = None
+                    for p in self._progs:
+                        for t in p.waiting.values():
+                            if t.deadline <= now:
+                                expired, exp_prog = t, p
+                                break
+                            if nearest is None or t.deadline < nearest:
+                                nearest = t.deadline
+                        if expired is not None:
                             break
-                        if nearest is None or t.deadline < nearest:
-                            nearest = t.deadline
                     if expired is not None:
-                        prog.err |= (int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
-                                     | self._pool.consume_error())
-                        self._abort_locked(prog)
+                        exp_prog.err |= (
+                            int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+                            | self._pool.consume_error())
+                        self._abort_locked(exp_prog)
                         continue
                     wait = (0.2 if nearest is None
                             else min(0.2, nearest - now))
                     self._work_cv.wait(max(0.005, wait))
             if task is not None:
-                self._run_task(prog, task)
+                self._run_task(run_prog, task)
 
     # -- egress reorder stage ----------------------------------------------
     def _egress_emit(self, key: tuple[int, int], seqn: int, env: Envelope,
@@ -1253,8 +1445,8 @@ class MoveExecutor:
                 import traceback
                 traceback.print_exc()
                 with self._sched_lock:
-                    if self._prog is not None:
-                        self._prog.err |= int(ErrorCode.DMA_TRANSACTION_ERROR)
+                    for p in self._progs:
+                        p.err |= int(ErrorCode.DMA_TRANSACTION_ERROR)
             finally:
                 if release is not None:
                     release()
@@ -1290,19 +1482,32 @@ class MoveExecutor:
                 st[1].clear()
                 st[0] = r.outbound_seq
 
-    def execute_streamed(self, moves: list[Move], cfg: ArithConfig,
-                         comm: Communicator) -> int:
-        """The dependency-aware segment pipeline (see class docstring)."""
+    def begin_streamed(self, moves: list[Move], cfg: ArithConfig,
+                       comm: Communicator,
+                       skeleton: PlanSkeleton | None = None) -> _Prog:
+        """Admit one program into the segment pipeline: instantiate the
+        plan (``skeleton`` may come from a compiled-plan cache — derived
+        fresh otherwise), register every eligible move, and execute
+        barriers inline. Returns once the whole program has been FED;
+        in-flight segments keep draining until :meth:`finish_streamed`.
+
+        Cross-call pipelining: a second program may be admitted while the
+        previous one drains (the chained-call path). Admissions must come
+        from ONE thread (the device's call worker) in program order — the
+        per-peer seqn pre-assignment and the egress ordering domain extend
+        across the calls, so per-peer wire emission stays in global
+        program order."""
         self._ensure_stream_workers()
+        if skeleton is None:
+            skeleton = plan_skeleton(moves)
         prog = _Prog(cfg, comm)
-        entries = self._plan_streamed(moves, comm)
-        prog.lanes = len({e.mv.lane for e in entries
-                          if e.eligible and e.mv.lane is not None})
+        prog.nmoves = len(moves)
+        prog.lanes = skeleton.nlanes
         with self._sched_lock:
             if self._closed:
                 raise RuntimeError("executor closed")
-            self._prog = prog
-        err = 0
+            entries = self._instantiate_locked(skeleton, moves, comm)
+            self._progs.append(prog)
         try:
             for e in entries:
                 if e.fused:
@@ -1319,29 +1524,83 @@ class MoveExecutor:
                         else:
                             self._activate_locked(prog, e)
                     continue
-                # barrier: drain every in-flight segment, then run inline
-                # (stream ports, remote-stream sends, reused scratch)
+                # barrier: drain every in-flight segment of THIS program,
+                # then run inline (stream ports, remote-stream sends,
+                # reused scratch)
                 self._wait_quiesce(prog)
                 if prog.aborted or prog.err:
                     break
                 err = self._run_move(e.mv, cfg, comm, pipelined=True,
                                      plan=e, prog=prog)
                 if err:
+                    with self._sched_lock:
+                        prog.err |= err
                     break
+        except Exception as exc:  # noqa: BLE001 — a raising feed must not
+            # leak a half-registered program (finish would hang on its
+            # outstanding count); latch, abort, and let finish_streamed
+            # re-raise after cleanup so callers see the original cause
+            with self._sched_lock:
+                prog.err |= int(ErrorCode.INVALID_CALL)
+                prog.exc = exc
+                self._abort_locked(prog)
+        return prog
+
+    def finish_streamed(self, prog: _Prog) -> tuple[int, dict]:
+        """Drain one admitted program to quiescence and retire it:
+        returns (error word, pipeline stats). A nonzero error word poisons
+        every program admitted after this one (chain semantics — a failed
+        link aborts its successors, mirroring ``waitfor`` propagation) and
+        the deferred egress resyncs run once the executor is idle."""
+        err = 0
+        try:
             self._wait_quiesce(prog)
         finally:
             with self._sched_lock:
                 self._abort_locked(prog)  # no-op on clean completion
             self._wait_quiesce(prog)
             with self._sched_lock:
-                err |= prog.err
-                self._prog = None
-            self._egress_resync(comm)
-            self.last_stats = dict(_EMPTY_STATS, moves=len(moves),
-                                   pipelined=prog.pipelined,
-                                   max_inflight=prog.max_depth,
-                                   lanes=prog.lanes,
-                                   combine_overlap=prog.max_combining)
+                err = prog.err
+                if prog in self._progs:
+                    self._progs.remove(prog)
+                if err:
+                    for p in self._progs:
+                        p.err |= err
+                        self._abort_locked(p)
+                if not any(c.comm_id == prog.comm.comm_id
+                           for c in self._pending_resync):
+                    # dedupe: sustained chaining can keep the executor
+                    # non-idle for millions of calls — one pending entry
+                    # per comm is all the idle-time resync needs
+                    self._pending_resync.append(prog.comm)
+                if not self._progs:
+                    # idle: fast-forward egress past any seqns burned by
+                    # aborted programs (parked frames drop; receivers
+                    # surface timeouts, like never-issued window sends).
+                    # Deferred until idle so an active chained successor's
+                    # un-emitted frames are never skipped. _eg_lock nests
+                    # under _sched_lock here; no path takes them in the
+                    # reverse order while holding _eg_lock.
+                    for c in self._pending_resync:
+                        self._egress_resync(c)
+                    self._pending_resync.clear()
+            stats = dict(_EMPTY_STATS, moves=prog.nmoves,
+                         pipelined=prog.pipelined,
+                         max_inflight=prog.max_depth,
+                         lanes=prog.lanes,
+                         combine_overlap=prog.max_combining)
+            self.last_stats = stats
+        if prog.exc is not None:
+            raise prog.exc  # the feed-time barrier's original exception
+        return err, stats
+
+    def execute_streamed(self, moves: list[Move], cfg: ArithConfig,
+                         comm: Communicator,
+                         skeleton: PlanSkeleton | None = None) -> int:
+        """The dependency-aware segment pipeline (see class docstring):
+        admit + drain in one synchronous call."""
+        prog = self.begin_streamed(moves, cfg, comm, skeleton)
+        err, _ = self.finish_streamed(prog)
         return err
 
     def close(self):
@@ -1363,16 +1622,19 @@ class MoveExecutor:
 
     # -- the engine --------------------------------------------------------
     def execute(self, moves: list[Move], cfg: ArithConfig,
-                comm: Communicator) -> int:
+                comm: Communicator,
+                skeleton: PlanSkeleton | None = None) -> int:
         """Run a move program; returns the OR-ed error word (0 = success).
 
         Dispatch: ``window == 0`` → the strict serial engine;
         ``segment_stream`` (default) → the dependency-aware segment
-        pipeline; otherwise → the send-only in-flight window."""
+        pipeline; otherwise → the send-only in-flight window.
+        ``skeleton`` is an optional pre-derived (cached) streamed plan —
+        ignored by the serial/window engines, which need none."""
         if self.window <= 0:
             return self.execute_serial(moves, cfg, comm)
         if self.segment_stream:
-            return self.execute_streamed(moves, cfg, comm)
+            return self.execute_streamed(moves, cfg, comm, skeleton)
         return self.execute_window(moves, cfg, comm)
 
     def execute_window(self, moves: list[Move], cfg: ArithConfig,

@@ -36,7 +36,7 @@ from typing import Any, Iterator
 from .arith import ArithConfig
 from .constants import (CCLOp, CollectiveAlgorithm, Compression,
                         DEFAULT_ALGORITHMS, ReduceFunc, StreamFlags,
-                        TAG_ANY, check_algorithm)
+                        TAG_ANY, VALID_ALGORITHMS, check_algorithm)
 
 
 def res_as_op0(compression: Compression) -> Compression:
@@ -1437,6 +1437,41 @@ def expand_alltoall(ctx: MoveContext, count: int, src: int, dst: int,
 # ---------------------------------------------------------------------------
 # Dispatch
 # ---------------------------------------------------------------------------
+
+def resolve_algorithm(scenario: CCLOp, algorithm, *, world_size: int,
+                      count: int, elem_bytes: int, tuner: Any = None,
+                      addr_1: int = 0) -> CollectiveAlgorithm:
+    """The concrete algorithm ``expand_call`` will expand for a descriptor.
+
+    Mirrors the ``pick`` resolution inside :func:`expand_call` — AUTO goes
+    through the tuner (size/topology-aware) and falls back to the shared
+    ``DEFAULT_ALGORITHMS`` table, including the reduce_scatter
+    no-scratch-buffer fallback to RING. The compiled-plan cache keys
+    entries on this value, so a tuner re-resolution (epsilon-greedy
+    exploration, EWMA switching) lands on a DIFFERENT cache key and can
+    never be served a stale plan expanded for the old algorithm. An
+    explicit selector passes through unchanged (expansion-level errors,
+    e.g. RECURSIVE_DOUBLING reduce_scatter without scratch, still fail
+    loudly there)."""
+    A = CollectiveAlgorithm
+    alg = A(algorithm)
+    valid = VALID_ALGORITHMS.get(scenario.name)
+    if valid is None or alg != A.AUTO:
+        return alg
+    chosen = A.AUTO
+    if tuner is not None:
+        chosen = A(tuner.select(scenario.name, world_size,
+                                count * elem_bytes))
+    if chosen == A.AUTO or chosen not in valid:
+        chosen = DEFAULT_ALGORITHMS[scenario.name]
+    if (scenario == CCLOp.reduce_scatter
+            and chosen == A.RECURSIVE_DOUBLING and not addr_1):
+        # an engine-level AUTO resolution without the driver-plumbed
+        # scratch (addr_1) must fall back to RING, exactly like
+        # expand_call's table omission does
+        chosen = A.RING
+    return chosen
+
 
 def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
                 root_src_dst: int = 0, func: ReduceFunc = ReduceFunc.SUM,

@@ -1,0 +1,356 @@
+"""Compiled-plan cache: relocatable move programs + cached streamed plans.
+
+PRs 2-4 made the dataplane fast; what remains on small, repeated
+collectives is pure Python control-plane work re-done on EVERY call:
+``expand_call`` rebuilds the whole move program (segment loops, operand
+dataclasses, compression flag logic) and the streamed executor re-derives
+the dependency/fusion plan. A training step loop issues the *same call
+shape* thousands of times — ACCL+ (arXiv:2312.11742) amortizes exactly
+this with host-side ``call_chain`` pipelining over a firmware that
+re-decodes nothing it doesn't have to, and NCCL-style stacks cache
+compiled plans per (op, comm, size) for the same reason.
+
+This module provides both halves of the fix:
+
+* :class:`CompiledPlan` — a move program expanded ONCE against symbolic
+  base addresses (widely-separated sentinel bases for addr_0/1/2), plus
+  the streamed executor's :class:`~.emulator.executor.PlanSkeleton`
+  (dependency edges, cut-through fusion, per-peer seqn DELTAS). Every
+  address an expansion produces is affine in exactly one buffer base
+  (``base + offset``), so :meth:`CompiledPlan.bind` relocates the whole
+  program onto concrete buffers by rebasing each operand — bit-identical
+  to a fresh expansion at those addresses (scripts/check_blocking.py and
+  tests/test_plan_cache.py enforce this differentially).
+* :class:`PlanCache` — a bounded LRU keyed on every descriptor field that
+  shapes the expansion: (scenario, CONCRETE algorithm, count, dtype pair,
+  communicator identity + epoch, compression/stream flags, root, func,
+  tag, the zero/aliasing pattern of the three addresses, segment size).
+  A hit only rebinds addresses and rebases wire seqns — no re-expansion,
+  no re-planning. Entries are invalidated on communicator
+  reconfiguration (the owner bumps its comm epoch AND clears) and on
+  tuner re-resolution (``Tuner.refresh``/``pin`` notify registered
+  caches — an epsilon-greedy or EWMA algorithm switch must never serve a
+  stale plan; the concrete-algorithm key already separates entries, the
+  clear keeps the table from accumulating dead ones).
+
+``$ACCL_TPU_PLAN_CACHE=0`` disables caching process-wide (every call
+takes the fresh-expansion path — the before-side of the
+``benchmarks/driver_overhead.py`` plan-cache ladder);
+``$ACCL_TPU_PLAN_CACHE_CAPACITY`` bounds entries per cache (default 256).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import OrderedDict
+
+from .arith import ArithConfig
+from .constants import (CCLOp, CollectiveAlgorithm, Compression, ReduceFunc,
+                        StreamFlags, TAG_ANY)
+from .moveengine import (Move, MoveContext, MoveMode, expand_call,
+                         resolve_algorithm)
+
+__all__ = ["CompiledPlan", "PlanCache", "cached_program", "compile_plan",
+           "plan_key"]
+
+# Symbolic base addresses: bases live at multiples of 2^44, offsets below.
+# Any real expansion offset (bounded by buffer sizes — terabytes at most)
+# decodes unambiguously to (which base, byte delta).
+_SHIFT = 44
+_BASE = 1 << _SHIFT
+
+
+def _sentinel_bases(bases: tuple[int, int, int]) -> tuple[int, int, int]:
+    """Symbolic stand-ins for (addr_0, addr_1, addr_2). Zero bases stay
+    zero — expansions branch on address ZERO-ness (reduce_scatter's
+    scratch presence, reduce TREE's accumulator check), so the symbolic
+    expansion must see the same pattern the concrete one would."""
+    return tuple((i + 1) << _SHIFT if b else 0
+                 for i, b in enumerate(bases))  # type: ignore[return-value]
+
+
+class CompiledPlan:
+    """One relocatable compiled call: symbolic move program + streamed
+    plan skeleton + precomputed rebinding table.
+
+    ``bind(bases)`` returns the program relocated onto concrete buffer
+    bases. Moves with no symbolic operand are shared (Move objects are
+    read-only during execution); rebindings construct fresh Operand/Move
+    objects, never mutating cached state — so a freed-and-reallocated
+    buffer can never alias a stale address through the cache. A small
+    per-plan memo makes the steady state (same buffers every step) a
+    dictionary hit."""
+
+    __slots__ = ("skeleton", "plan_us", "_moves_sym", "_rebinds", "_memo")
+
+    _MEMO_SLOTS = 4  # double-buffered training loops alternate 2 bindings
+
+    def __init__(self, moves_sym: list[Move], skeleton, plan_us: float):
+        self.skeleton = skeleton
+        self.plan_us = plan_us
+        self._moves_sym = moves_sym
+        self._rebinds: list[tuple | None] = []
+        for mv in moves_sym:
+            rb = []
+            for slot in ("op0", "op1", "res"):
+                op = getattr(mv, slot)
+                if op.mode is MoveMode.IMMEDIATE and op.addr >= _BASE:
+                    idx = (op.addr >> _SHIFT) - 1
+                    delta = op.addr - ((idx + 1) << _SHIFT)
+                    if idx not in (0, 1, 2):
+                        # only reachable for an offset overflowing the
+                        # LAST sentinel's decode range; overflow from an
+                        # earlier base is excluded by compile_plan's
+                        # extent bound, which is the real guard
+                        raise ValueError(
+                            f"unrelocatable operand address {op.addr:#x}")
+                    rb.append((slot, idx, delta))
+            self._rebinds.append(tuple(rb) if rb else None)
+        self._memo: OrderedDict[tuple, list[Move]] = OrderedDict()
+
+    def bind(self, bases: tuple[int, int, int]) -> list[Move]:
+        """Relocate the program onto concrete (addr_0, addr_1, addr_2)."""
+        key = tuple(bases)
+        got = self._memo.get(key)
+        if got is not None:
+            self._memo.move_to_end(key)
+            return got
+        moves: list[Move] = []
+        for mv, rb in zip(self._moves_sym, self._rebinds):
+            if rb is None:
+                moves.append(mv)
+                continue
+            kw = {}
+            for slot, idx, delta in rb:
+                op = getattr(mv, slot)
+                kw[slot] = dataclasses.replace(op, addr=bases[idx] + delta)
+            moves.append(dataclasses.replace(mv, **kw))
+        if len(self._memo) >= self._MEMO_SLOTS:
+            self._memo.popitem(last=False)
+        self._memo[key] = moves
+        return moves
+
+
+def compile_plan(*, scenario: CCLOp, count: int, world_size: int,
+                 local_rank: int, arithcfg: ArithConfig,
+                 max_segment_size: int, root_src_dst: int = 0,
+                 func: ReduceFunc = ReduceFunc.SUM, tag: int = TAG_ANY,
+                 bases: tuple[int, int, int] = (0, 0, 0),
+                 compression: Compression = Compression.NONE,
+                 stream: StreamFlags = StreamFlags.NO_STREAM,
+                 algorithm: CollectiveAlgorithm = CollectiveAlgorithm.AUTO,
+                 streamed: bool = True) -> CompiledPlan:
+    """Expand one call against symbolic bases and derive its streamed plan
+    skeleton. ``algorithm`` must already be CONCRETE for ops with an
+    algorithm axis (see :func:`~.moveengine.resolve_algorithm`) — the
+    symbolic context carries no tuner. ``streamed=False`` (serial/window
+    executors) skips the skeleton."""
+    # relocation-safety bound: no expansion addresses beyond
+    # (world_size + 2) x count elements past any base (the widest layout
+    # is a W-chunk vector plus tail slack), so requiring that extent to
+    # fit the 2^44 sentinel spacing guarantees every symbolic address
+    # decodes to the base it came from — an offset can never cross into
+    # the next sentinel's range
+    extent = (world_size + 2) * count * arithcfg.uncompressed_elem_bytes
+    if extent >= _BASE:
+        raise ValueError(
+            f"call too large for symbolic relocation "
+            f"({extent} bytes per base vs {_BASE} spacing); "
+            f"disable the plan cache ($ACCL_TPU_PLAN_CACHE=0)")
+    ctx = MoveContext(world_size=world_size, local_rank=local_rank,
+                      arithcfg=arithcfg, max_segment_size=max_segment_size)
+    sym = _sentinel_bases(bases)
+    moves = expand_call(ctx, scenario, count=count,
+                        root_src_dst=root_src_dst, func=func, tag=tag,
+                        addr_0=sym[0], addr_1=sym[1], addr_2=sym[2],
+                        compression=compression, stream=stream,
+                        algorithm=algorithm)
+    t0 = time.perf_counter()
+    skeleton = None
+    if streamed:
+        from .emulator.executor import plan_skeleton
+        skeleton = plan_skeleton(moves)
+    plan_us = (time.perf_counter() - t0) * 1e6
+    return CompiledPlan(moves, skeleton, plan_us)
+
+
+def plan_key(*, scenario: CCLOp, algorithm: CollectiveAlgorithm, count: int,
+             arithcfg: ArithConfig, comm_id: int, world_size: int,
+             local_rank: int, comm_epoch: int, compression: Compression,
+             stream: StreamFlags, root_src_dst: int, func: ReduceFunc,
+             tag: int, bases: tuple[int, int, int], max_segment_size: int,
+             streamed: bool) -> tuple:
+    """Cache key: every input that shapes the expansion or its plan.
+    ``algorithm`` must be the CONCRETE algorithm the call will run (tuner
+    re-resolution then lands on a different key). The three addresses
+    enter only through their zero-ness (expansions branch on it) and
+    aliasing pattern — concrete values are relocation inputs, not plan
+    shape."""
+    a0, a1, a2 = bases
+    return (int(scenario), int(algorithm), int(count),
+            arithcfg.uncompressed_dtype.name, arithcfg.compressed_dtype.name,
+            int(comm_id), int(world_size), int(local_rank), int(comm_epoch),
+            int(compression), int(stream), int(root_src_dst), int(func),
+            int(tag),
+            bool(a0), bool(a1), bool(a2),          # zero pattern
+            a1 == a0, a2 == a0, a2 == a1,          # in-place aliasing
+            int(max_segment_size), bool(streamed))
+
+
+def cached_program(cache: "PlanCache", *, scenario: CCLOp, count: int,
+                  world_size: int, local_rank: int, arithcfg: ArithConfig,
+                  max_segment_size: int, comm_id: int, comm_epoch: int,
+                  root_src_dst: int = 0,
+                  func: ReduceFunc = ReduceFunc.SUM, tag: int = TAG_ANY,
+                  bases: tuple[int, int, int] = (0, 0, 0),
+                  compression: Compression = Compression.NONE,
+                  stream: StreamFlags = StreamFlags.NO_STREAM,
+                  algorithm: CollectiveAlgorithm = CollectiveAlgorithm.AUTO,
+                  tuner=None, streamed: bool = True,
+                  compile_missing: bool = True):
+    """The one program-preparation path shared by every tier (emu device,
+    rank daemon, chained admission): resolve AUTO to the CONCRETE
+    algorithm BEFORE building the key (the invariant that makes tuner
+    re-resolution staleness-proof), look up, optionally compile+store on
+    a miss, and relocate onto ``bases``. A disabled cache takes the
+    fresh-expansion path here too, so cache-on and cache-off runs can
+    never expand through drifting argument lists.
+
+    Returns ``(moves, skeleton, state, expand_us, plan_us)`` — state
+    "hit"|"miss"|"bypass"; ``expand_us`` covers expansion + relocation
+    (relocation only on a hit), ``plan_us`` the streamed-skeleton
+    derivation (0.0 on a hit — the cached skeleton is reused); the two
+    are disjoint. With ``compile_missing=False`` a miss returns ``None``
+    instead of compiling (the chained-admission gate: a miss pays
+    expansion anyway, so it takes the ordinary path — which accounts its
+    own lookup, so a chained miss counts twice in ``misses``). The
+    lookup is a single atomic cache access: a concurrent invalidation
+    can only turn a would-be hit into an honest miss."""
+    t0 = time.perf_counter()
+    if not cache.enabled:
+        cache.note_bypass()
+        ctx = MoveContext(world_size=world_size, local_rank=local_rank,
+                          arithcfg=arithcfg,
+                          max_segment_size=max_segment_size, tuner=tuner)
+        moves = expand_call(ctx, scenario, count=count,
+                            root_src_dst=root_src_dst, func=func, tag=tag,
+                            addr_0=bases[0], addr_1=bases[1],
+                            addr_2=bases[2], compression=compression,
+                            stream=stream, algorithm=algorithm)
+        t1 = time.perf_counter()
+        skeleton = None
+        if streamed:
+            from .emulator.executor import plan_skeleton
+            skeleton = plan_skeleton(moves)
+        return (moves, skeleton, "bypass", (t1 - t0) * 1e6,
+                (time.perf_counter() - t1) * 1e6)
+    alg = resolve_algorithm(scenario, algorithm, world_size=world_size,
+                            count=count,
+                            elem_bytes=arithcfg.uncompressed_elem_bytes,
+                            tuner=tuner, addr_1=bases[1])
+    key = plan_key(scenario=scenario, algorithm=alg, count=count,
+                   arithcfg=arithcfg, comm_id=comm_id,
+                   world_size=world_size, local_rank=local_rank,
+                   comm_epoch=comm_epoch, compression=compression,
+                   stream=stream, root_src_dst=root_src_dst, func=func,
+                   tag=tag, bases=bases,
+                   max_segment_size=max_segment_size, streamed=streamed)
+    plan = cache.lookup(key)
+    state, plan_us = "hit", 0.0
+    if plan is None:
+        if not compile_missing:
+            return None
+        state = "miss"
+        plan = compile_plan(scenario=scenario, count=count,
+                            world_size=world_size, local_rank=local_rank,
+                            arithcfg=arithcfg,
+                            max_segment_size=max_segment_size,
+                            root_src_dst=root_src_dst, func=func, tag=tag,
+                            bases=bases, compression=compression,
+                            stream=stream, algorithm=alg,
+                            streamed=streamed)
+        plan_us = plan.plan_us
+        cache.store(key, plan)
+    moves = plan.bind(bases)
+    expand_us = max(0.0, (time.perf_counter() - t0) * 1e6 - plan_us)
+    return moves, plan.skeleton, state, expand_us, plan_us
+
+
+class PlanCache:
+    """Bounded LRU of :class:`CompiledPlan` with observability counters.
+
+    Thread-safe (the owning device's call worker is the main user, but
+    tuner invalidation listeners fire from arbitrary threads). Counters:
+    ``hits``/``misses``/``bypasses`` per lookup outcome, ``evictions``
+    for capacity pressure, ``invalidations`` per reason ("comm", "tuner",
+    ...) — surfaced through the driver (``ACCL.plan_cache_stats``) and
+    the tuner (``Tuner.plan_cache_stats``) so epsilon-greedy exploration
+    cost is observable."""
+
+    def __init__(self, enabled: bool | None = None,
+                 capacity: int | None = None):
+        if enabled is None:
+            enabled = os.environ.get("ACCL_TPU_PLAN_CACHE", "1").lower() \
+                not in ("0", "false", "off", "")
+        if capacity is None:
+            capacity = int(os.environ.get("ACCL_TPU_PLAN_CACHE_CAPACITY",
+                                          256))
+        self.enabled = bool(enabled)
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[tuple, CompiledPlan] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.bypasses = 0
+        self.evictions = 0
+        self.invalidations: dict[str, int] = {}
+
+    def lookup(self, key: tuple) -> CompiledPlan | None:
+        with self._lock:
+            plan = self._entries.get(key)
+            if plan is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return plan
+
+    def store(self, key: tuple, plan: CompiledPlan):
+        with self._lock:
+            self._entries[key] = plan
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def note_bypass(self):
+        with self._lock:
+            self.bypasses += 1
+
+    def invalidate(self, reason: str = "explicit"):
+        """Drop every entry (communicator reconfiguration, tuner
+        re-resolution, explicit reset)."""
+        with self._lock:
+            self._entries.clear()
+            self.invalidations[reason] = \
+                self.invalidations.get(reason, 0) + 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "bypasses": self.bypasses,
+                "evictions": self.evictions,
+                "invalidations": dict(self.invalidations),
+            }

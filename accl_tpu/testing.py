@@ -22,7 +22,8 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
               timeout: float = 20.0,
               max_segment_size: int | None = None,
               tuner=None, pipeline_window: int | None = None,
-              segment_stream: bool | None = None) -> list[ACCL]:
+              segment_stream: bool | None = None,
+              plan_cache: bool | None = None) -> list[ACCL]:
     """Create ``world_size`` ACCL instances sharing an in-process fabric.
 
     ``tuner`` (a single :class:`~accl_tpu.tuner.Tuner`) is shared by every
@@ -30,9 +31,11 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
     resolve AUTO to the same algorithm. ``pipeline_window`` sets the
     executors' in-flight window (0 = serial reference engine);
     ``segment_stream`` selects the dependency-aware segment pipeline vs
-    the send-only window (None = process default)."""
+    the send-only window (None = process default); ``plan_cache``
+    enables/disables the compiled-plan cache (None = process default,
+    ``$ACCL_TPU_PLAN_CACHE``)."""
     kw = {"nbufs": nbufs, "pipeline_window": pipeline_window,
-          "segment_stream": segment_stream}
+          "segment_stream": segment_stream, "plan_cache": plan_cache}
     if bufsize is not None:
         kw["bufsize"] = bufsize
     ctx = EmuContext(world_size, **kw)

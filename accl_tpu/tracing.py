@@ -63,6 +63,14 @@ class CallRecord:
     combine_overlap: int = 0    # peak CONCURRENT combines (segment-streamed
     #                             worker pool; 0 = serial/window engines,
     #                             whose combines never overlap each other)
+    # compiled-plan cache counters (emu/daemon control plane):
+    expand_us: float = 0.0      # host us producing the move program
+    #                             (expansion + relocation on miss/bypass;
+    #                             relocation only on a hit)
+    plan_us: float = 0.0        # host us deriving the streamed plan
+    #                             skeleton (0 on a hit — skeleton reused)
+    plan_cache: str = ""        # "hit" | "miss" | "bypass" (cache
+    #                             disabled) | "" (backend without a cache)
 
     @property
     def duration_us(self) -> float:
@@ -144,7 +152,10 @@ class Profiler:
                 moves=st.get("moves", 0),
                 pipelined_moves=st.get("pipelined", 0),
                 pipeline_depth=st.get("max_inflight", 0),
-                combine_overlap=st.get("combine_overlap", 0)))
+                combine_overlap=st.get("combine_overlap", 0),
+                expand_us=st.get("expand_us", 0.0),
+                plan_us=st.get("plan_us", 0.0),
+                plan_cache=st.get("plan_cache", "")))
 
         handle.add_done_callback(_on_done)
 
@@ -185,13 +196,14 @@ class Profiler:
         with open(path, "w") as f:
             f.write("op,count,nbytes,comm_id,t_start,duration_us,error,"
                     "algorithm,moves,pipelined_moves,pipeline_depth,"
-                    "combine_overlap\n")
+                    "combine_overlap,expand_us,plan_us,plan_cache\n")
             for r in self.records:
                 f.write(f"{r.op},{r.count},{r.nbytes},{r.comm_id},"
                         f"{r.t_start:.9f},{r.duration_us:.3f},"
                         f"{r.error_word},{r.algorithm},{r.moves},"
                         f"{r.pipelined_moves},{r.pipeline_depth},"
-                        f"{r.combine_overlap}\n")
+                        f"{r.combine_overlap},{r.expand_us:.1f},"
+                        f"{r.plan_us:.1f},{r.plan_cache}\n")
 
     @staticmethod
     def read_csv(path: str) -> list[CallRecord]:
@@ -216,7 +228,10 @@ class Profiler:
                     moves=int(row.get("moves") or 0),
                     pipelined_moves=int(row.get("pipelined_moves") or 0),
                     pipeline_depth=int(row.get("pipeline_depth") or 0),
-                    combine_overlap=int(row.get("combine_overlap") or 0)))
+                    combine_overlap=int(row.get("combine_overlap") or 0),
+                    expand_us=float(row.get("expand_us") or 0.0),
+                    plan_us=float(row.get("plan_us") or 0.0),
+                    plan_cache=row.get("plan_cache") or ""))
         return out
 
 # -- JAX profiler bridges ---------------------------------------------------

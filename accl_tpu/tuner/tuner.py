@@ -41,6 +41,7 @@ from __future__ import annotations
 import dataclasses
 import random
 import threading
+import weakref
 
 from ..constants import (CollectiveAlgorithm, DEFAULT_ALGORITHMS,
                          VALID_ALGORITHMS)
@@ -107,6 +108,10 @@ class Tuner:
         # (op, world, bucket) -> algorithm: sticky decisions, valid until
         # refresh() (see module docstring: rank agreement)
         self._decisions: dict[tuple, CollectiveAlgorithm] = {}
+        # compiled-plan caches to invalidate when decisions may flip
+        # (refresh / pin / clear_pins). Weak refs: a tuner can outlive
+        # the worlds whose device caches registered with it.
+        self._plan_caches: list = []
 
     # -- selection ---------------------------------------------------------
     def _topo(self, world_size: int) -> Topology:
@@ -164,9 +169,61 @@ class Tuner:
         """Drop cached decisions: the next ``select`` per key re-scores
         with the measurements accumulated so far (and re-rolls
         exploration). Call only at quiesced points — no collective may be
-        in flight while decisions flip (module docstring)."""
+        in flight while decisions flip (module docstring). Registered
+        compiled-plan caches are invalidated: a flipped decision expands
+        a different program, and stale entries for the old algorithm
+        must not accumulate (they can never be SERVED stale — plan keys
+        carry the concrete algorithm — but observability wants the
+        re-resolution counted)."""
         with self._lock:
             self._decisions.clear()
+        self._invalidate_plan_caches("tuner")
+
+    # -- compiled-plan cache coupling --------------------------------------
+    def register_plan_cache(self, cache):
+        """Attach a device's :class:`~accl_tpu.plancache.PlanCache`: it is
+        invalidated whenever this tuner's decisions may change
+        (``refresh``, ``pin``, ``clear_pins``), and its counters surface
+        through :meth:`plan_cache_stats`. Held weakly — caches die with
+        their worlds."""
+        ref = weakref.ref(cache)
+        with self._lock:
+            if any(r() is cache for r in self._plan_caches):
+                return
+            self._plan_caches = [r for r in self._plan_caches
+                                 if r() is not None]
+            self._plan_caches.append(ref)
+
+    def _invalidate_plan_caches(self, reason: str):
+        with self._lock:
+            refs = list(self._plan_caches)
+        for r in refs:
+            cache = r()
+            if cache is not None:
+                cache.invalidate(reason)
+
+    def plan_cache_stats(self) -> dict:
+        """Aggregate counters over every live registered plan cache —
+        the tuner-side observability of exploration cost (each
+        epsilon-greedy re-roll that flips an algorithm shows up as an
+        invalidation plus a run of misses)."""
+        agg = {"caches": 0, "entries": 0, "hits": 0, "misses": 0,
+               "bypasses": 0, "evictions": 0, "invalidations": {}}
+        with self._lock:
+            refs = list(self._plan_caches)
+        for r in refs:
+            cache = r()
+            if cache is None:
+                continue
+            st = cache.stats()
+            agg["caches"] += 1
+            for k in ("entries", "hits", "misses", "bypasses",
+                      "evictions"):
+                agg[k] += st[k]
+            for reason, n in st["invalidations"].items():
+                agg["invalidations"][reason] = \
+                    agg["invalidations"].get(reason, 0) + n
+        return agg
 
     # -- online refinement -------------------------------------------------
     def observe(self, op: str, world_size: int, nbytes: int,
@@ -234,12 +291,14 @@ class Tuner:
                 f"cannot pin {alg.name} for {op}: not a legal algorithm")
         with self._lock:
             self._pinned[(op, int(world_size), int(bucket))] = alg
+        self._invalidate_plan_caches("tuner")
 
     def clear_pins(self):
         """Drop loaded tuning-table pins (a re-tune must measure from
         scratch, not echo the stale table back)."""
         with self._lock:
             self._pinned.clear()
+        self._invalidate_plan_caches("tuner")
 
     def entries(self) -> list[dict]:
         """Current decisions as serializable rows: one per key that has a

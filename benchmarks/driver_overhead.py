@@ -1,18 +1,31 @@
-"""Driver-tier overhead: ACCL/TpuDevice call path vs direct MeshCollectives.
+"""Driver-tier overhead: control-plane cost of the ACCL call path.
 
-The TpuDevice tier stages each call host-side (buffer sync + rendezvous +
-one jitted collective program per call — device/tpu.py docstring), which
-buys API parity with the emulator corpus but costs host work per call.
-The performance path is calling :class:`MeshCollectives` (or the shard
-functions) from inside a jitted program. This benchmark puts a number on
-that claim (VERDICT r1 weak-5): per-call wall time of the same allreduce
-through both paths, on the same mesh.
+Two ladders:
+
+* ``measure`` — ACCL/TpuDevice call path vs direct MeshCollectives. The
+  TpuDevice tier stages each call host-side (buffer sync + rendezvous +
+  one jitted collective program per call — device/tpu.py docstring),
+  which buys API parity with the emulator corpus but costs host work per
+  call. The performance path is calling :class:`MeshCollectives` (or the
+  shard functions) from inside a jitted program. This puts a number on
+  that claim (VERDICT r1 weak-5).
+
+* ``plancache_headline`` — the compiled-plan cache ladder on the emu
+  tier: per-call p50 of repeated SAME-SHAPE small collectives with the
+  cache on (hit = relocate + rebase only) vs off (fresh ``expand_call``
+  + streamed plan pass every call), plus the cross-call chained variant
+  (``chain=True`` async links admitted while the predecessor drains).
+  This is the regression gate for the per-call control-plane floor
+  (``make bench-emu`` asserts ``$ACCL_BENCH_MIN_PLANCACHE_RATIO``).
 
 Run:  python -m benchmarks.driver_overhead [--world 8] [--count 65536]
 (CPU virtual mesh by default; pass --platform tpu on hardware.)
+Run:  python -m benchmarks.driver_overhead --plancache   (emu tier only)
 """
 
 from __future__ import annotations
+
+import time
 
 import numpy as np
 
@@ -83,6 +96,164 @@ def measure(world: int = 8, count: int = 65536, platform: str | None = "cpu",
     }
 
 
+# -- compiled-plan cache ladder (emu tier) ----------------------------------
+
+def _plancache_pairs(world: int, count: int, iters: int,
+                     rounds: int) -> tuple[list[float], float, float]:
+    """Paired fresh/cached per-call blocks for one shape, in ONE world.
+
+    Both sides run on the same world object (threads, buffers, pools):
+    the cache is toggled per block via ``PlanCache.enabled``, so
+    shared-host drift can only bias a pair by what changes within ~one
+    block (~0.1 s), not across separate world setups. Blocks alternate
+    which side runs first; the first pair is dropped (world warmup).
+    Returns (per-pair fresh/cached ratios, fresh p50 s, cached p50 s).
+    Every block re-verifies the allreduce result — a cached plan that
+    relocated wrong would fail loudly, not score fast."""
+    import concurrent.futures
+    import threading
+
+    from accl_tpu.testing import emu_world
+
+    accls = emu_world(world, plan_cache=True)
+    caches = [a.device.plan_cache for a in accls]
+    try:
+        bufs = []
+        for a in accls:
+            src = a.buffer(data=np.full(count, float(a.rank + 1),
+                                        np.float32))
+            dst = a.buffer((count,), np.float32)
+            bufs.append((src, dst))
+        bar = threading.Barrier(world)
+        results: dict[bool, list[float]] = {True: [], False: []}
+        expect = world * (world + 1) / 2
+
+        def body(a):
+            src, dst = bufs[a.rank]
+            for _ in range(6):  # warmup (populates the cache)
+                a.allreduce(src, dst, count)
+            for r in range(rounds):
+                order = (True, False) if r % 2 == 0 else (False, True)
+                for cached in order:
+                    bar.wait()
+                    if a.rank == 0:
+                        for c in caches:
+                            c.enabled = cached
+                    bar.wait()
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        a.allreduce(src, dst, count)
+                    dt = (time.perf_counter() - t0) / iters
+                    if a.rank == 0:
+                        results[cached].append(dt)
+                        if not np.allclose(dst.data, expect):
+                            raise AssertionError(
+                                f"allreduce produced {dst.data[:4]}, "
+                                f"expected {expect}")
+
+        with concurrent.futures.ThreadPoolExecutor(world) as pool:
+            futs = [pool.submit(body, a) for a in accls]
+            for f in futs:
+                f.result(timeout=300.0)
+        fresh, cached = results[False][1:], results[True][1:]
+        ratios = [f / c for f, c in zip(fresh, cached)]
+        return ratios, float(np.median(fresh)), float(np.median(cached))
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def _chain_percall(world: int, count: int, iters: int,
+                   chain: bool) -> float:
+    """Per-link seconds of an async call stream (``run_async=True``),
+    with or without the ``chain=`` cross-call pipelining hint. Every
+    link gets its OWN src/dst pair — the chain hint asserts in-flight
+    links touch disjoint buffers (CallDescriptor.chain contract), and
+    the unchained side uses the same buffers so the comparison is
+    configuration-identical. Results are verified after the batch."""
+    import concurrent.futures
+
+    from accl_tpu.testing import emu_world
+
+    accls = emu_world(world, plan_cache=True)
+    try:
+        all_bufs = []
+        for a in accls:
+            pairs = []
+            for k in range(iters):
+                src = a.buffer(data=np.full(count, float(a.rank + 1 + k),
+                                            np.float32))
+                dst = a.buffer((count,), np.float32)
+                pairs.append((src, dst))
+            all_bufs.append(pairs)
+        out: list[float] = []
+
+        def body(a):
+            warm_src, warm_dst = all_bufs[a.rank][0]
+            for _ in range(6):  # warmup primes the cache
+                a.allreduce(warm_src, warm_dst, count)
+            t0 = time.perf_counter()
+            hs = [a.allreduce(src, dst, count, run_async=True, chain=chain)
+                  for src, dst in all_bufs[a.rank]]
+            for h in hs:
+                h.wait()
+            if a.rank == 0:
+                out.append((time.perf_counter() - t0) / iters)
+
+        with concurrent.futures.ThreadPoolExecutor(world) as pool:
+            for f in [pool.submit(body, a) for a in accls]:
+                f.result(timeout=300.0)
+        for pairs in all_bufs:
+            for k, (_, dst) in enumerate(pairs):
+                want = sum(r + 1 + k for r in range(world))
+                if not np.allclose(dst.data, want):
+                    raise AssertionError(
+                        f"link {k} produced {dst.data[:4]}, "
+                        f"expected {want}")
+        return out[0]
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def plancache_headline(world: int = 4, iters: int = 25,
+                       rounds: int = 10) -> dict:
+    """Plan-cache ladder payload for bench.py's emu tier: fresh-vs-cached
+    per-call p50 ratio for repeated same-shape small allreduces (1 KiB
+    and 4 KiB fp32) — the latency-dominated regime where the Python
+    control plane (expand_call + the streamed plan pass, re-run per call
+    before this cache) set the per-call floor. Pair-ratios from both
+    shapes pool into one median: each pair is a same-world cache-toggled
+    A/B block, so only intra-pair drift can bias it, and pooling ~18
+    pairs tightens the median against shared-host noise.
+
+    ``plancache_chain`` compares cross-call pipelining against its true
+    baseline — the same cached async links WITHOUT the chain hint (both
+    pay the worker-queue path). Informational, not gated: with cores to
+    spare the admitted-while-draining overlap wins; on a 2-core box the
+    extra handoffs can eat it."""
+    ratios: list[float] = []
+    stats = {}
+    for count in (256, 1024):
+        rs, fresh, cached = _plancache_pairs(world, count, iters, rounds)
+        ratios += rs
+        stats[count] = (fresh, cached)
+    ratio = float(np.median(ratios))
+    t_async = _chain_percall(world, 1024, 30, chain=False)
+    t_chain = _chain_percall(world, 1024, 30, chain=True)
+    return {
+        "plancache_ratio": round(ratio, 3),
+        "plancache_fresh_p50_us": round(stats[1024][0] * 1e6, 1),
+        "plancache_hit_p50_us": round(stats[1024][1] * 1e6, 1),
+        "plancache_fresh_1k_p50_us": round(stats[256][0] * 1e6, 1),
+        "plancache_hit_1k_p50_us": round(stats[256][1] * 1e6, 1),
+        "plancache_async_p50_us": round(t_async * 1e6, 1),
+        "plancache_chain_p50_us": round(t_chain * 1e6, 1),
+        "plancache_chain": round(t_async / max(t_chain, 1e-9), 3),
+        "plancache_shape": f"allreduce_fp32_1KiB+4KiB_{world}rank",
+    }
+
+
 if __name__ == "__main__":
     import argparse
     import json
@@ -91,7 +262,13 @@ if __name__ == "__main__":
     ap.add_argument("--world", type=int, default=8)
     ap.add_argument("--count", type=int, default=65536)
     ap.add_argument("--platform", type=str, default="cpu")
+    ap.add_argument("--plancache", action="store_true",
+                    help="run the emu-tier compiled-plan cache ladder "
+                         "instead of the TPU-tier overhead comparison")
     args = ap.parse_args()
+    if args.plancache:
+        print(json.dumps(plancache_headline(world=min(args.world, 4))))
+        raise SystemExit(0)
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)

@@ -19,7 +19,8 @@ Wired into ``make lint``. Two checks:
    remote-stream send (shapes the worker pool must never execute).
 
 3. **byte-interval hazard simulation.** Replay each corpus program's
-   IMMEDIATE operand intervals and verify the two invariants the
+   IMMEDIATE operand intervals (fresh expansions AND compiled-plan-cache
+   relocations — check 4) and verify the two invariants the
    expansions ASSERT by tagging:
    * lane disjointness — a laned move may only touch bytes last written
      by its OWN lane since the last barrier (sibling lanes run
@@ -33,6 +34,13 @@ Wired into ``make lint``. Two checks:
    The log-depth expansions (recursive doubling/halving, binomial
    trees) are linted by the same replay, including their vrank
    fold-in/fold-out barrier phases.
+
+4. **relocated compiled plans.** For every corpus program, compile a
+   :class:`~accl_tpu.plancache.CompiledPlan` (symbolic-base expansion),
+   relocate it onto SHIFTED buffer bases, assert bit-identity with a
+   fresh expansion at those bases, and run the relocated program through
+   the same lane/hazard replay as check 2/3 — a cached plan must satisfy
+   every invariant a fresh plan does, at any binding.
 
 Exit code 0 = clean; nonzero prints every violation.
 """
@@ -84,7 +92,9 @@ def check_lane_graph() -> list[str]:
     from accl_tpu.arith import ArithConfig
     from accl_tpu.constants import (CCLOp, CollectiveAlgorithm, Compression,
                                     ReduceFunc, TAG_ANY)
-    from accl_tpu.moveengine import MoveContext, MoveMode, expand_call
+    from accl_tpu.moveengine import (MoveContext, MoveMode, expand_call,
+                                     resolve_algorithm)
+    from accl_tpu.plancache import compile_plan
 
     errors = []
     cfg = ArithConfig(np.dtype(np.float32), np.dtype(np.float16))
@@ -99,6 +109,10 @@ def check_lane_graph() -> list[str]:
         CCLOp.reduce_scatter: [A.AUTO, A.RECURSIVE_DOUBLING],
         CCLOp.alltoall: [A.AUTO],
     }
+    bases = (0x1000, 0x8000, 0x10000)
+    # relocation target: disjoint from the compile bases, so a stale
+    # (unrebased) address in a relocated plan cannot hide by collision
+    shifted = (0x400000, 0x480000, 0x500000)
     # W covers: pairs, a fold with one extra (3), a fold with multiple
     # extras (5 -> p=4, r=1; 6 -> p=4, r=2), and a power-of-2 deep tree
     for op, algs in ops.items():
@@ -116,8 +130,8 @@ def check_lane_graph() -> list[str]:
                                 moves = expand_call(
                                     ctx, op, count=23, root_src_dst=root,
                                     func=ReduceFunc.SUM, tag=TAG_ANY,
-                                    addr_0=0x1000, addr_1=0x8000,
-                                    addr_2=0x10000,
+                                    addr_0=bases[0], addr_1=bases[1],
+                                    addr_2=bases[2],
                                     compression=comp,
                                     algorithm=alg)
                                 where = (f"{op.name}/{alg.name} W={W} "
@@ -125,6 +139,48 @@ def check_lane_graph() -> list[str]:
                                          f"comp={int(comp)}")
                                 errors += _lane_edges_ok(where, moves)
                                 errors += _hazards_ok(where, moves, cfg)
+                                errors += _relocated_ok(
+                                    where, op, alg, W, me, root, seg,
+                                    comp, cfg, bases, shifted, moves,
+                                    resolve_algorithm, compile_plan,
+                                    MoveContext, expand_call)
+    return errors
+
+
+def _relocated_ok(where, op, alg, W, me, root, seg, comp, cfg, bases,
+                  shifted, fresh_moves, resolve_algorithm, compile_plan,
+                  MoveContext, expand_call) -> list[str]:
+    """Check 4: the compiled-plan relocation of this corpus entry must be
+    bit-identical to fresh expansion (at the compile bases AND at shifted
+    bases) and must pass the same lane/hazard replay."""
+    from accl_tpu.constants import ReduceFunc, TAG_ANY
+
+    errors = []
+    resolved = resolve_algorithm(op, alg, world_size=W, count=23,
+                                 elem_bytes=cfg.uncompressed_elem_bytes,
+                                 addr_1=bases[1])
+    plan = compile_plan(scenario=op, count=23, world_size=W, local_rank=me,
+                        arithcfg=cfg, max_segment_size=seg,
+                        root_src_dst=root, func=ReduceFunc.SUM,
+                        tag=TAG_ANY, bases=bases, compression=comp,
+                        algorithm=resolved, streamed=False)
+    if plan.bind(bases) != fresh_moves:
+        errors.append(f"{where}: compiled plan bound at its compile bases "
+                      f"differs from fresh expansion")
+    reloc = plan.bind(shifted)
+    ctx = MoveContext(world_size=W, local_rank=me, arithcfg=cfg,
+                      max_segment_size=seg)
+    fresh_shifted = expand_call(ctx, op, count=23, root_src_dst=root,
+                                func=ReduceFunc.SUM, tag=TAG_ANY,
+                                addr_0=shifted[0], addr_1=shifted[1],
+                                addr_2=shifted[2], compression=comp,
+                                algorithm=resolved)
+    if reloc != fresh_shifted:
+        errors.append(f"{where}: relocated plan differs from fresh "
+                      f"expansion at the shifted bases")
+    rwhere = f"{where} [relocated]"
+    errors += _lane_edges_ok(rwhere, reloc)
+    errors += _hazards_ok(rwhere, reloc, cfg)
     return errors
 
 
@@ -251,7 +307,7 @@ def main() -> int:
               file=sys.stderr)
         return 1
     print("check_blocking: OK (blocking=False citations + lane graph + "
-          "byte-interval hazards)")
+          "byte-interval hazards + relocated compiled plans)")
     return 0
 
 
