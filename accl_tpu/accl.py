@@ -25,11 +25,11 @@ from .arith import DEFAULT_ARITH_CONFIGS, resolve_arith_config
 from .buffer import ACCLBuffer
 from .call import CallDescriptor, CallHandle, CompletedHandle
 from .communicator import Communicator
-from .constants import (CCLOp, CfgFunc, CollectiveAlgorithm, Compression,
-                        DEFAULT_MAX_SEGMENT_SIZE, ReduceFunc, StreamFlags,
-                        TAG_ANY, VALID_ALGORITHMS)
+from .constants import (ACCLError, CCLOp, CfgFunc, CollectiveAlgorithm,
+                        Compression, DEFAULT_MAX_SEGMENT_SIZE, ReduceFunc,
+                        StreamFlags, TAG_ANY, VALID_ALGORITHMS)
 from .device.base import Device
-from .tracing import Profiler
+from .tracing import METRICS, Profiler, TRACE
 
 
 class ACCL:
@@ -69,6 +69,14 @@ class ACCL:
         import threading as _threading
         self._async_mu = _threading.Lock()
         self._async_inflight = 0
+        # per-communicator call/byte accounting (QoS attribution
+        # foundation, ROADMAP item 3). Kept as plain driver-local dicts —
+        # the per-call hot path is GIL-cheap dict arithmetic, no
+        # process-wide lock — and folded into the registry by a WEAK
+        # collector only when someone snapshots. (op, comm_id) -> n.
+        self._call_counts: dict[tuple, int] = {}
+        self._byte_counts: dict[tuple, int] = {}
+        METRICS.register_collector(self, ACCL._metrics_rows)
         if tuner is not None:
             if tuner.topology is None:
                 tuner.topology = device.topology()
@@ -220,6 +228,57 @@ class ACCL:
         self._config_call(CfgFunc.end_profiling, 0)
         self.profiler.stop()
 
+    # -- observability (SURVEY §5: the ILA-probe/waveform-dump analogs) ----
+    def start_trace(self):
+        """Arm the process-wide flight recorder
+        (:data:`~accl_tpu.tracing.TRACE`): the streamed executor, egress
+        stage, combine workers, RX pools and fabrics start emitting
+        structured stage events into per-thread ring buffers. Also armed
+        by ``ACCL_TPU_TRACE=1``. Near-free for everyone else: disarmed
+        emit sites are a single attribute test."""
+        TRACE.start()
+
+    def stop_trace(self):
+        TRACE.stop()
+
+    def export_trace(self, path: str) -> int:
+        """Write the flight recorder's current ring as Chrome/Perfetto
+        trace-event JSON (open in chrome://tracing or ui.perfetto.dev;
+        one track per lane/worker per rank). Returns the event count."""
+        return TRACE.export_chrome(path)
+
+    def metrics_snapshot(self) -> dict:
+        """One process-wide health surface: every counter/gauge/histogram
+        of :data:`~accl_tpu.tracing.METRICS` — per-call accounting
+        (labeled op/comm_id), fabric counters (per communicator), RX-pool
+        occupancy, executor pipeline gauges, plan-cache counters, daemon
+        ingress rejections, tuner exploration picks — merged with every
+        live registered collector's rows."""
+        return METRICS.snapshot()
+
+    def metrics_text(self) -> str:
+        """Prometheus-style text exposition of :meth:`metrics_snapshot`
+        (scrape-ready for the multi-tenant service story, ROADMAP 3)."""
+        return METRICS.to_prometheus()
+
+    def _metrics_rows(self):
+        """Registry-collector rows for this driver's per-communicator
+        call accounting (polled at snapshot time only). ``rank`` keeps
+        one world's drivers apart; ``ctx`` (the emu fabric's instance
+        tag, when the backend has one) keeps concurrently live same-shape
+        worlds apart — their membership-CRC comm_ids collide."""
+        labels = {"rank": self.rank}
+        fab = getattr(getattr(self.device, "ctx", None), "fabric", None)
+        ctx_seq = getattr(fab, "ctx_seq", None)
+        if ctx_seq is not None:
+            labels["ctx"] = ctx_seq
+        for (op, comm_id), n in list(self._call_counts.items()):
+            yield ("counter", "accl_calls_total",
+                   dict(labels, op=op, comm_id=comm_id), n)
+        for (op, comm_id), n in list(self._byte_counts.items()):
+            yield ("counter", "accl_bytes_total",
+                   dict(labels, op=op, comm_id=comm_id), n)
+
     def deinit(self):
         self.device.deinit()
 
@@ -346,8 +405,18 @@ class ACCL:
                                         inline_ok=not run_async)
         ebytes = (desc.arithcfg.uncompressed_elem_bytes
                   if desc.arithcfg is not None else 0)
+        op = desc.scenario.name
+        if desc.scenario != CCLOp.config:
+            # per-communicator attribution: driver-local counters (see
+            # __init__) — a registry lock here measurably skewed the
+            # small-message algorithm ladder under 8 rank threads
+            key = (op, desc.comm_id)
+            self._call_counts[key] = self._call_counts.get(key, 0) + 1
+            nb = desc.count * ebytes
+            if nb:
+                self._byte_counts[key] = \
+                    self._byte_counts.get(key, 0) + nb
         if profiling:
-            op = desc.scenario.name
             if tunable:
                 alg_label = desc.algorithm.name
             elif op in VALID_ALGORITHMS:
@@ -386,14 +455,23 @@ class ACCL:
         if run_async:
             with self._async_mu:
                 self._async_inflight += 1
+            comm_id = desc.comm_id
 
-            def _retired(_err):
+            def _retired(err):
                 with self._async_mu:
                     self._async_inflight -= 1
+                if err:
+                    METRICS.inc("accl_call_errors_total", op=op,
+                                comm_id=comm_id)
 
             handle.add_done_callback(_retired)
             return handle
-        handle.wait()
+        try:
+            handle.wait()
+        except ACCLError:
+            METRICS.inc("accl_call_errors_total", op=op,
+                        comm_id=desc.comm_id)
+            raise
         return CompletedHandle(context=desc.scenario.name)
 
     def comm_of(self, comm_id: int) -> Communicator:

@@ -18,6 +18,30 @@ from typing import Sequence
 from ..buffer import ACCLBuffer
 from ..call import CallDescriptor, CallHandle
 from ..communicator import Communicator
+from ..tracing import health_rows
+
+
+def _device_metrics_rows(dev: "Device"):
+    """Shared metrics collector for one rank's backend: reports whichever
+    health surfaces the backend actually has (rx pool, move executor,
+    plan cache) as registry rows — one mapping (:func:`tracing.health_rows`,
+    shared with the daemon collector) for every Device subclass so
+    backends can never drift in how they report. Polled only at snapshot
+    time (:meth:`~accl_tpu.tracing.MetricsRegistry.snapshot`)."""
+    # "tier" disambiguates from _daemon_metrics_rows' identical families:
+    # one process can host an in-process device world AND spawn_world
+    # daemons, and {rank} alone would merge their series (last-write-wins
+    # gauges, summed counters) into one indistinguishable key
+    labels = {"rank": getattr(dev, "_metrics_rank", -1), "tier": "device"}
+    # world tag (emu backends): rank+tier alone would merge two
+    # concurrently live same-shape worlds' series — counters would sum,
+    # gauges would last-write-win. Shares the fabric's ctx_seq so device,
+    # driver and fabric rows of one world carry the same tag.
+    ctx_seq = getattr(getattr(getattr(dev, "ctx", None), "fabric", None),
+                      "ctx_seq", None)
+    if ctx_seq is not None:
+        labels["ctx"] = ctx_seq
+    yield from health_rows(dev, labels)
 
 
 class Device(abc.ABC):
@@ -28,6 +52,14 @@ class Device(abc.ABC):
     # (moveengine.expand_call via MoveContext.tuner) can consult it for
     # descriptors that still carry AUTO when they reach the engine.
     tuner = None
+
+    def register_metrics(self, rank: int):
+        """Attach this backend to the process-wide metrics registry
+        (weakly — the collector dies with the device). Backends call it
+        once they own their pool/executor/plan-cache surfaces."""
+        from ..tracing import METRICS
+        self._metrics_rank = rank
+        METRICS.register_collector(self, _device_metrics_rows)
 
     def topology(self):
         """Link-level descriptor of this backend's fabric tier, feeding

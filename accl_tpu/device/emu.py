@@ -48,6 +48,11 @@ class EmuContext:
                  plan_cache: bool | None = None):
         self.world_size = world_size
         self.fabric = LocalFabric(world_size)
+        # unified metrics: the shared fabric reports once per CONTEXT
+        # (per-rank collectors would multiply its counters by W); weak
+        # registration, so a torn-down world stops reporting
+        from ..tracing import METRICS
+        METRICS.register_collector(self.fabric, LocalFabric.metrics_rows)
         self.nbufs, self.bufsize = nbufs, bufsize
         self.pipeline_window = pipeline_window
         self.segment_stream = segment_stream
@@ -81,6 +86,11 @@ class EmuDevice(Device):
         # send path enqueues without blocking (a jammed receiver falls to
         # its inbox queue), so an inline hop chain can never deadlock
         self.executor.ingest_inline = True
+        # observability: tag log lines / flight-recorder dumps with the
+        # owning rank, and report pool/executor/plan-cache health through
+        # the process-wide registry (Device.register_metrics)
+        self.executor.owner_rank = rank
+        self.register_metrics(rank)
         self.timeout = DEFAULT_TIMEOUT_S
         self.max_segment_size = DEFAULT_MAX_SEGMENT_SIZE
         self.profiling = False  # armed by the start_profiling config call

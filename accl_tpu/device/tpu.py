@@ -57,10 +57,13 @@ from ..constants import (ACCLError, CCLOp, CollectiveAlgorithm, Compression,
                          DEFAULT_MAX_SEGMENT_SIZE, DEFAULT_TIMEOUT_S,
                          ErrorCode, ReduceFunc, check_algorithm)
 from ..emulator.executor import DeviceMemory
+from ..log import get_logger
 from ..parallel.collectives import MeshCollectives, _wire_name
 from ..parallel.mesh import make_mesh
 from ..parallel.tree import Tree2DCollectives
 from .base import Device
+
+log = get_logger(__name__)
 
 
 def _noncanonical(dtype) -> bool:
@@ -1159,8 +1162,11 @@ class TpuDevice(Device):
             descs = [group[r][0] for r in range(comm.size)]
             err = self._launch(descs, comm)
         except Exception as exc:  # noqa: BLE001
-            import traceback
-            traceback.print_exc()  # observability: don't bury the cause
+            # observability: don't bury the cause — attributable to the
+            # launching rank, capturable via the accl_tpu logger
+            log.error("rank %s: collective group launch failed",
+                      getattr(self, "rank", "-"), exc_info=True,
+                      extra={"rank": getattr(self, "rank", "-")})
             exc_out = exc
         finally:
             # completion runs in a finally so a claimed group ALWAYS

@@ -15,7 +15,7 @@ Ports: cmd = port_base + rank, eth = port_base + world + rank.
 from __future__ import annotations
 
 import argparse
-import logging
+import itertools
 import os
 import socket
 import struct
@@ -28,12 +28,17 @@ from ..arith import ArithConfig
 from ..communicator import Communicator, Rank
 from ..constants import (CCLOp, CfgFunc, CollectiveAlgorithm, Compression,
                          ErrorCode, ReduceFunc, StreamFlags)
+from ..log import basic_config, get_logger
 from ..plancache import PlanCache, cached_program
+from ..tracing import METRICS, TRACE as _TRACE, health_rows
+
+# daemon-instance tags for registry rows (cf. fabric._CTX_SEQ)
+_DAEMON_CTX_SEQ = itertools.count(1)
 from . import protocol as P
 from .executor import DeviceMemory, MoveExecutor, RxBufferPool
 from .fabric import Envelope
 
-log = logging.getLogger(__name__)
+log = get_logger(__name__)
 
 
 def _sane_budget(b: float, *, configured: bool = False) -> float:
@@ -158,6 +163,9 @@ class EthFabric:
         hdr = P.pack_eth_header(env.src, env.dst, env.tag, env.seqn,
                                 env.comm_id, env.strm,
                                 P.dtype_code(env.wire_dtype), nbytes)
+        if _TRACE.enabled:
+            _TRACE.emit("wire_send", rank=env.src, seqn=env.seqn,
+                        peer=env.dst, nbytes=nbytes)
         with peer_lock:
             if self.coalesce and len(hdr) + nbytes < self.coalesce:
                 # watermark coalescing: length-prefix each frame (frames
@@ -312,7 +320,22 @@ class UdpEthFabric:
         # memory growth
         self.stats = {"sent": 0, "delivered": 0, "dropped_queue_full": 0,
                       "gc_partials": 0}
+        # deliver-queue drops fold through a collector, not a per-event
+        # registry inc: a slow consumer rejects EVERY frame of a large
+        # collective, and taking the process-wide registry lock per drop
+        # on the sole datagram thread is the same storm-shaped cost that
+        # RankDaemon._rejections avoids. Single-writer per key (one
+        # datagram RX thread); close() flushes the totals into the
+        # registry so a torn-down fabric's drops stay diagnosable.
+        self._drops: dict[tuple, int] = {}
+        METRICS.register_collector(self, UdpEthFabric._drop_rows)
         threading.Thread(target=self._recv_loop, daemon=True).start()
+
+    def _drop_rows(self):
+        for (comm_id, src, dst), n in list(self._drops.items()):
+            yield ("counter", "fabric_dropped_total",
+                   {"fabric": "udp", "comm_id": comm_id, "src": src,
+                    "dst": dst}, n)
 
     def learn_peers(self, ranks: list[tuple[int, str, int]], world: int):
         with self._lock:
@@ -353,6 +376,9 @@ class UdpEthFabric:
             else:
                 self._sock.sendto(b"".join(parts), addr)
         self.stats["sent"] += 1
+        if _TRACE.enabled:
+            _TRACE.emit("wire_send", rank=env.src, seqn=env.seqn,
+                        peer=env.dst, nbytes=nbytes)
 
     def _recv_loop(self):
         hdr_len = struct.calcsize(self._FRAG_FMT)
@@ -366,8 +392,9 @@ class UdpEthFabric:
             except Exception:  # noqa: BLE001 — a malformed datagram (the
                 # socket is wildcard-bound) must not kill the fabric's only
                 # receive thread; UDP semantics allow dropping it
-                import traceback
-                traceback.print_exc()
+                log.error("rank %d udp fabric: malformed datagram dropped",
+                          self.me, exc_info=True,
+                          extra={"rank": self.me})
 
     def _on_datagram(self, dgram: bytes, hdr_len: int):
         if len(dgram) < hdr_len:
@@ -396,7 +423,15 @@ class UdpEthFabric:
                     # bounded queue: drop (UDP semantics) — but COUNT it,
                     # so a slow consumer is diagnosable from stats
                     # instead of only from downstream recv timeouts
+                    # (collector-folded, see __init__)
                     self.stats["dropped_queue_full"] += 1
+                    k = (env.comm_id, env.src, env.dst)
+                    # fabric-local lock (NOT the registry's process-wide
+                    # one): close() swaps _drops out under the same lock,
+                    # so a racing drop can neither be flushed twice nor
+                    # lost between the flush and the collector
+                    with self._lock:
+                        self._drops[k] = self._drops.get(k, 0) + 1
         # GC stale partials (lost fragments must not leak memory)
         stale = [k for k, e in self._partial.items() if e[0] < now]
         for k in stale:
@@ -444,8 +479,22 @@ class UdpEthFabric:
     def close(self):
         import queue as _queue
         with self._lock:
+            flush = not self._closing
             self._closing = True
             queues = list(self._queues.values())
+            # swap under the same lock the RX thread increments under:
+            # a drop racing close() lands wholly in the old dict (flushed
+            # once below) or wholly in the new one (collector-reported)
+            drops: dict[tuple, int] = {}
+            if flush:
+                drops, self._drops = self._drops, {}
+        if flush:
+            # hand the folded drop totals to the registry directly: the
+            # collector vanishes with this (weakly-held) fabric, but its
+            # drops must stay diagnosable after world teardown
+            for (comm_id, src, dst), n in drops.items():
+                METRICS.inc("fabric_dropped_total", n, fabric="udp",
+                            comm_id=comm_id, src=src, dst=dst)
         try:  # unblock the recvfrom thread so the port frees promptly
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -507,7 +556,24 @@ class RankDaemon:
         # send() returns, so emission may hand over zero-copy views of
         # device memory instead of paying the tobytes() copy
         self.executor.tx_serializes = True
+        self.executor.owner_rank = rank
         self._wire_flush()
+        # unified metrics: this daemon's health surfaces (eth fabric
+        # stats, rx-pool occupancy, executor pipeline counters, plan
+        # cache) polled only at snapshot time; the weak registration
+        # dies with the daemon. ctx_seq keeps two in-process daemon
+        # worlds' rank+tier series apart (cf. LocalFabric.ctx_seq)
+        self.ctx_seq = next(_DAEMON_CTX_SEQ)
+        METRICS.register_collector(self, _daemon_metrics_rows)
+        # eager-ingress rejection counts, (peer, comm_id) -> n, folded in
+        # by the collector above. Daemon-local on purpose: a starved pool
+        # rejects EVERY segment of a collective, and a process-wide
+        # registry lock on that path is the same per-event cost that
+        # measurably skewed the small-message ladder in the driver (see
+        # ACCL._metrics_rows). Single-writer per key — each peer's frames
+        # arrive on that peer's RX thread (TCP) or the one datagram
+        # thread (UDP), and the key leads with the peer.
+        self._rejections: dict[tuple, int] = {}
         # eager-ingress rejection log rate limiter: src -> [window_start,
         # suppressed-in-window] — a starved rx pool rejects every message
         # of a big collective; one line per second per peer keeps stderr
@@ -571,6 +637,11 @@ class RankDaemon:
             return
         err = self.pool.ingest(env, payload, timeout=self.timeout)
         if err:
+            # every rejection counts (the LOG below is rate-limited; the
+            # collector-folded counter is the accurate total, per
+            # peer/comm — see __init__ for why not a direct registry inc)
+            key = (env.src, env.comm_id)
+            self._rejections[key] = self._rejections.get(key, 0) + 1
             # eager-ingress rejection is otherwise invisible until some
             # recv times out much later — say WHICH message died and why
             # (the latched word also rides into that recv's error word,
@@ -591,7 +662,7 @@ class RankDaemon:
                 " | ".join(e.name for e in ErrorCode
                            if e.value and err & e.value) or hex(err),
                 f" (+{suppressed} more in the last second)"
-                if suppressed else "")
+                if suppressed else "", extra={"rank": self.rank})
 
     # -- call execution ----------------------------------------------------
     def _call_worker(self):
@@ -724,8 +795,10 @@ class RankDaemon:
             return self.executor.execute(moves, cfg, comm,
                                          skeleton=skeleton)
         except Exception:  # noqa: BLE001
-            import traceback
-            traceback.print_exc()
+            log.error("rank %d: call execution failed (scenario=%s "
+                      "comm=%s)", self.rank, c.get("scenario"),
+                      c.get("comm_id"), exc_info=True,
+                      extra={"rank": self.rank})
             return int(ErrorCode.INVALID_CALL)
 
     # -- runtime config calls ----------------------------------------------
@@ -903,7 +976,8 @@ class RankDaemon:
                         log.exception(
                             "rank %d: request failed (kind=%s, "
                             "%d bytes)", self.rank,
-                            body[0] if body else None, len(body))
+                            body[0] if body else None, len(body),
+                            extra={"rank": self.rank})
                         reply = P.status_reply(int(ErrorCode.INVALID_CALL))
                     if len(reply) > _BIG:
                         # big readback: scatter-gather send, zero-copy
@@ -1115,6 +1189,30 @@ class RankDaemon:
         self.executor.close()
 
 
+def _daemon_metrics_rows(d: "RankDaemon"):
+    """Metrics collector for one rank daemon (polled at snapshot time):
+    eth-fabric counters, rx-pool occupancy (+ high-water mark), executor
+    pipeline counters of the last retired call, plan-cache counters."""
+    labels = {"rank": d.rank, "tier": "daemon", "ctx": d.ctx_seq}
+    for k, v in d.eth.stats.items():
+        if k == "dropped_queue_full":
+            # already folded into fabric_dropped_total (per comm/src/dst)
+            # by the UDP fabric's own collector — re-yielding it as its
+            # own family would show two drops for one event to any
+            # consumer summing "dropped"
+            continue
+        yield ("counter", f"fabric_{k}_total",
+               dict(labels, fabric=d.stack), v)
+    # pool / executor / plan-cache rows: the same mapping the device
+    # collector uses (tracing.health_rows), so the tiers cannot drift
+    yield from health_rows(d, labels)
+    for (peer, comm_id), n in list(d._rejections.items()):
+        yield ("counter", "daemon_ingress_rejected_total",
+               dict(labels, peer=peer, comm_id=comm_id), n)
+    yield ("counter", "daemon_profiled_calls_total", labels,
+           d.profiled_calls)
+
+
 def spawn_world(world: int, port_base: int = 0, nbufs: int = 16,
                 bufsize: int = 1 << 20, stack: str = "tcp"):
     """Spawn W in-process daemons on free ports (for tests); returns
@@ -1159,6 +1257,7 @@ def main():
     ap.add_argument("--bufsize", type=int, default=1 << 20)
     ap.add_argument("--stack", choices=["tcp", "udp"], default="tcp")
     args = ap.parse_args()
+    basic_config()  # rank-tagged stderr logging for standalone daemons
     daemon = RankDaemon(args.rank, args.world, args.port_base,
                         nbufs=args.nbufs, bufsize=args.bufsize,
                         stack=args.stack)

@@ -52,9 +52,13 @@ from ..communicator import Communicator
 from ..constants import (DEFAULT_COMBINE_WORKERS_CAP,
                          DEFAULT_PIPELINE_WINDOW, ErrorCode, ReduceFunc,
                          TAG_ANY)
+from ..log import get_logger
 from ..moveengine import Move, MoveMode, Operand
+from ..tracing import TRACE as _TRACE
 from .fabric import Envelope
 from .protocol import payload_nbytes
+
+log = get_logger(__name__)
 
 
 class DeviceMemory:
@@ -158,6 +162,7 @@ class RxBufferPool:
         self.bufsize = bufsize
         self._cv = threading.Condition()
         self.error_word = 0
+        self.hwm = 0               # occupancy high-water mark (metrics)
         self._idle: list[RxBuffer] = list(self.bufs)
         self._by_key: dict[tuple[int, int, int], list[RxBuffer]] = {}
         # arrival listener (segment-streamed executor): called with the
@@ -175,6 +180,9 @@ class RxBufferPool:
         b = self._idle.pop()
         b.status = RxBuffer.RESERVED
         b.env, b.payload = env, payload
+        occ = len(self.bufs) - len(self._idle)
+        if occ > self.hwm:
+            self.hwm = occ
         self._by_key.setdefault((env.src, env.comm_id, env.seqn),
                                 []).append(b)
         self._cv.notify_all()
@@ -204,6 +212,9 @@ class RxBufferPool:
                         ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
                     return int(
                         ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+        if _TRACE.enabled:
+            _TRACE.emit("ingest", rank=env.dst, seqn=env.seqn, peer=env.src,
+                        nbytes=env.nbytes)
         if self.on_ingest is not None:
             self.on_ingest((env.src, env.comm_id, env.seqn))
         return err
@@ -220,8 +231,12 @@ class RxBufferPool:
                 self.error_word |= int(ErrorCode.DMA_SIZE_ERROR)
                 return True  # consumed (dropped) — retrying cannot help
             claimed = self._claim(env, payload, keep=1)
-        if claimed and self.on_ingest is not None:
-            self.on_ingest((env.src, env.comm_id, env.seqn))
+        if claimed:
+            if _TRACE.enabled:
+                _TRACE.emit("ingest", rank=env.dst, seqn=env.seqn,
+                            peer=env.src, nbytes=env.nbytes)
+            if self.on_ingest is not None:
+                self.on_ingest((env.src, env.comm_id, env.seqn))
         return claimed
 
     def consume_error(self) -> int:
@@ -313,7 +328,7 @@ _REDUCERS = {
 # one template for every engine's per-execute counters: an engine that
 # forgets a key would otherwise silently report 0 through CallRecord
 _EMPTY_STATS = {"moves": 0, "pipelined": 0, "max_inflight": 0,
-                "lanes": 0, "combine_overlap": 0}
+                "lanes": 0, "combine_overlap": 0, "overlap_frac": 0.0}
 
 
 class _ScratchArena:
@@ -399,11 +414,13 @@ class _Prog:
 
     __slots__ = ("cfg", "comm", "waiting", "ready", "outstanding",
                  "running", "err", "aborted", "pipelined", "max_depth",
-                 "combining", "max_combining", "lanes", "nmoves", "exc")
+                 "combining", "max_combining", "lanes", "nmoves", "exc",
+                 "call_seq")
 
     def __init__(self, cfg, comm):
         self.cfg = cfg
         self.comm = comm
+        self.call_seq = 0             # flight-recorder call id (0: unarmed)
         self.waiting: dict = {}       # (src, comm_id, seqn) -> _MovePlan
         self.ready: list = []         # FIFO of runnable _MovePlans
         self.outstanding = 0          # registered, not yet retired/cancelled
@@ -655,6 +672,10 @@ class MoveExecutor:
                                    max(0, (os.cpu_count() or 2) - 2)))
         self._n_workers = max(0, int(combine_workers))
         self.tx_serializes = False
+        # owning rank's GLOBAL id, set by the device/daemon that built
+        # this executor — tags log lines and flight-recorder dumps so
+        # multi-rank (multi-thread) failure output is attributable
+        self.owner_rank = -1
         # Ingest cut-through execution: run a just-promoted waiting move
         # INLINE in the ingesting thread instead of waking a worker — on
         # small messages the cross-thread wakeup (~a scheduler quantum on
@@ -861,14 +882,16 @@ class MoveExecutor:
     def _emit_remote(self, move: Move, data: np.ndarray, cfg: ArithConfig,
                      comm: Communicator, *, zero_copy: bool = False,
                      tx_seqn: int | None = None, release=None,
-                     streamed: bool = False, immutable_src: bool = False):
+                     streamed: bool = False, immutable_src: bool = False,
+                     call_seq: int = 0):
         """``tx_seqn`` carries a seqn the streamed planner pre-assigned
         (live counter already advanced at plan time); ``streamed`` routes
         the frame through the per-peer egress reorder stage; ``release``
         returns the combine-scratch slot backing ``data`` to the arena
         once the frame no longer references it; ``immutable_src`` marks
         ``data`` as a view of a pool payload that is never rewritten
-        (cut-through relay), so retaining fabrics may keep the view."""
+        (cut-through relay), so retaining fabrics may keep the view;
+        ``call_seq`` tags the frame's flight-recorder events."""
         wire = (cfg.compressed_dtype if move.eth_compressed
                 else cfg.uncompressed_dtype)
         arr = np.ascontiguousarray(data.astype(wire, copy=False))
@@ -908,12 +931,21 @@ class MoveExecutor:
                        comm_id=comm.comm_id)
         if not move.remote_stream and tx_seqn is None:
             rank.outbound_seq += 1
+        lane = -1 if move.lane is None else move.lane
         if streamed and not move.remote_stream:
             self._egress_emit((rank.global_rank, comm.comm_id), seqn, env,
-                              payload, release)
+                              payload, release, lane, call_seq)
             return
         try:
+            t0 = time.monotonic_ns() if _TRACE.enabled else 0
             self._send(env, payload)
+            if t0:  # not _TRACE.enabled: arming mid-send would emit a
+                # t_ns=0 event whose epoch-long duration wrecks the
+                # exported timeline's time base
+                _TRACE.emit("egress", rank=env.src, call_seq=call_seq,
+                            lane=lane, seqn=seqn, peer=env.dst,
+                            nbytes=env.nbytes, t_ns=t0,
+                            dur_ns=time.monotonic_ns() - t0)
         finally:
             if release is not None:
                 release()
@@ -938,12 +970,32 @@ class MoveExecutor:
         emission, and overlap counters."""
         deadline = time.monotonic() + self.timeout
         copy = not pipelined
+        # flight recorder: label fields computed once per move when armed
+        # (the disarmed cost of this whole block is one attribute test)
+        tr = _TRACE.enabled
+        if tr:
+            _cs = prog.call_seq if prog is not None else 0
+            _lane = -1 if mv.lane is None else mv.lane
+            _step = plan.idx if plan is not None else -1
+            _rank = comm.my_global_rank
+            _nb = mv.count * cfg.uncompressed_dtype.itemsize
+            t_f0 = time.monotonic_ns()
         op0, e0 = self._fetch(mv.op0, mv.count, cfg, comm, deadline,
                               copy=copy,
                               rx_seqn=plan.rx0 if plan is not None else None)
         op1, e1 = self._fetch(mv.op1, mv.count, cfg, comm, deadline,
                               copy=copy,
                               rx_seqn=plan.rx1 if plan is not None else None)
+        if tr:
+            for op, rx in ((mv.op0, plan.rx0 if plan else None),
+                           (mv.op1, plan.rx1 if plan else None)):
+                if op.mode is MoveMode.ON_RECV:
+                    _TRACE.emit(
+                        "recv", rank=_rank, call_seq=_cs, lane=_lane,
+                        step=_step, seqn=-1 if rx is None else rx,
+                        peer=comm.ranks[op.src_rank].global_rank,
+                        nbytes=_nb, t_ns=t_f0,
+                        dur_ns=time.monotonic_ns() - t_f0)
         if e0 or e1:
             return e0 | e1
         release = None
@@ -975,10 +1027,16 @@ class MoveExecutor:
                     if prog.combining > prog.max_combining:
                         prog.max_combining = prog.combining
                 try:
+                    t_c0 = time.monotonic_ns() if tr else 0
                     if out is not None:
                         result = _REDUCERS[mv.func](op0, op1, out=out)
                     else:
                         result = _REDUCERS[mv.func](op0, op1)
+                    if tr:
+                        _TRACE.emit("combine", rank=_rank, call_seq=_cs,
+                                    lane=_lane, step=_step, nbytes=_nb,
+                                    t_ns=t_c0,
+                                    dur_ns=time.monotonic_ns() - t_c0)
                 finally:
                     if prog is not None:
                         prog.combining -= 1
@@ -1010,20 +1068,41 @@ class MoveExecutor:
                     # window-run move skips this (it IS the window, and the
                     # single FIFO worker already emits in program order).
                     self._drain()
+                t_r0 = time.monotonic_ns() if tr else 0
                 self._emit_remote(
                     mv, result, cfg, comm, zero_copy=pipelined,
                     tx_seqn=plan.tx if plan is not None else None,
-                    release=release, streamed=prog is not None)
+                    release=release, streamed=prog is not None,
+                    call_seq=_cs if tr else 0)
+                if tr:
+                    _TRACE.emit("relay", rank=_rank, call_seq=_cs,
+                                lane=_lane, step=_step,
+                                seqn=-1 if plan is None or plan.tx is None
+                                else plan.tx,
+                                peer=comm.ranks[mv.dst_rank].global_rank,
+                                nbytes=_nb, t_ns=t_r0,
+                                dur_ns=time.monotonic_ns() - t_r0)
                 release = None  # ownership passed to emission/egress
             if plan is not None and plan.fuse is not None:
                 # cut-through relay: forward the just-received bytes
                 # under the relay's own envelope/seqn, never re-reading
                 # the slot (the pool payload is immutable, so the frame
                 # may reference it zero-copy even on retaining fabrics)
+                t_r0 = time.monotonic_ns() if tr else 0
                 self._emit_remote(
                     plan.fuse.mv, result, cfg, comm, zero_copy=True,
                     tx_seqn=plan.fuse.tx, streamed=prog is not None,
-                    immutable_src=True)
+                    immutable_src=True, call_seq=_cs if tr else 0)
+                if tr:
+                    fmv = plan.fuse.mv
+                    _TRACE.emit(
+                        "cut_through", rank=_rank, call_seq=_cs,
+                        lane=-1 if fmv.lane is None else fmv.lane,
+                        step=plan.fuse.idx,
+                        seqn=-1 if plan.fuse.tx is None else plan.fuse.tx,
+                        peer=comm.ranks[fmv.dst_rank].global_rank,
+                        nbytes=_nb, t_ns=t_r0,
+                        dur_ns=time.monotonic_ns() - t_r0)
             return 0
         finally:
             if release is not None:
@@ -1051,8 +1130,9 @@ class MoveExecutor:
                     err = 0  # program already failed: skip, just retire
             except Exception:  # noqa: BLE001 — a worker death would hang
                 # every future drain; latch and keep draining instead
-                import traceback
-                traceback.print_exc()
+                log.error("rank %s: in-flight window move failed",
+                          self.owner_rank, exc_info=True,
+                          extra={"rank": self.owner_rank})
                 err = int(ErrorCode.INVALID_CALL)
             with self._win_cv:
                 if err:
@@ -1143,7 +1223,8 @@ class MoveExecutor:
                         # busy) may have parked frames here — their
                         # release() callbacks pin arena slots and must
                         # fire before the entry is replaced
-                        for _env, _payload, release in old[1].values():
+                        for _env, _payload, release, _l, _c \
+                                in old[1].values():
                             if release is not None:
                                 release()
                     self._egress[key] = [r.outbound_seq, {}, False]
@@ -1240,9 +1321,16 @@ class MoveExecutor:
                                      prog=prog)
             except Exception:  # noqa: BLE001 — a worker death would
                 # wedge the scheduler's drain; latch and keep retiring
-                import traceback
-                traceback.print_exc()
+                log.error("rank %s: streamed move %d failed",
+                          self.owner_rank, task.idx, exc_info=True,
+                          extra={"rank": self.owner_rank})
                 err = int(ErrorCode.INVALID_CALL)
+        if err and _TRACE.enabled:
+            # the waveform at the trigger: dump the flight recorder
+            # BEFORE the abort cancels the rest of the program (and
+            # outside the scheduler lock — dumping does file I/O)
+            _TRACE.trigger_dump(f"error_latch_0x{err:x}",
+                                rank=self.owner_rank)
         with self._sched_lock:
             task.state = _ST_RETIRED
             prog.running -= 1
@@ -1382,6 +1470,7 @@ class MoveExecutor:
         while True:
             task = None
             run_prog = None
+            deadline_abort = False
             with self._sched_lock:
                 run_prog = self._pick_prog_locked()
                 if run_prog is not None:
@@ -1409,16 +1498,21 @@ class MoveExecutor:
                             int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
                             | self._pool.consume_error())
                         self._abort_locked(exp_prog)
-                        continue
-                    wait = (0.2 if nearest is None
-                            else min(0.2, nearest - now))
-                    self._work_cv.wait(max(0.005, wait))
+                        deadline_abort = True  # dump outside the lock
+                    else:
+                        wait = (0.2 if nearest is None
+                                else min(0.2, nearest - now))
+                        self._work_cv.wait(max(0.005, wait))
+            if deadline_abort and _TRACE.enabled:
+                # recv-deadline abort: the other flight-recorder trigger
+                _TRACE.trigger_dump("recv_deadline_abort",
+                                    rank=self.owner_rank)
             if task is not None:
                 self._run_task(run_prog, task)
 
     # -- egress reorder stage ----------------------------------------------
     def _egress_emit(self, key: tuple[int, int], seqn: int, env: Envelope,
-                     payload, release):
+                     payload, release, lane: int = -1, call_seq: int = 0):
         """Deposit a frame; whichever thread supplies the next-expected
         seqn becomes the flusher and drains the available prefix. No
         thread ever WAITS for a peer's turn — out-of-order frames park,
@@ -1428,22 +1522,30 @@ class MoveExecutor:
         st = self._egress[key]
         with self._eg_lock:
             if st[0] != seqn or st[2]:
-                st[1][seqn] = (env, payload, release)
+                st[1][seqn] = (env, payload, release, lane, call_seq)
                 return  # not our turn, or a flusher is already draining
             st[2] = True  # our frame IS next: flush without parking it
             self._eg_busy += 1
-        item = (env, payload, release)
+        item = (env, payload, release, lane, call_seq)
         sent = 0
         while True:
-            env, payload, release = item
+            env, payload, release, lane, call_seq = item
             try:
+                t0 = time.monotonic_ns() if _TRACE.enabled else 0
                 self._send(env, payload)
                 sent += 1
+                if t0:  # see _emit_remote: no t_ns=0 events on mid-send
+                    # arming
+                    _TRACE.emit("egress", rank=env.src, call_seq=call_seq,
+                                lane=lane, seqn=env.seqn, peer=env.dst,
+                                nbytes=env.nbytes, t_ns=t0,
+                                dur_ns=time.monotonic_ns() - t0)
             except Exception:  # noqa: BLE001 — a fabric failure mid-flush
                 # must not abandon the flusher role (egress would wedge);
                 # latch into the running program and keep draining
-                import traceback
-                traceback.print_exc()
+                log.error("rank %s: egress flush to rank %s failed",
+                          self.owner_rank, env.dst, exc_info=True,
+                          extra={"rank": self.owner_rank})
                 with self._sched_lock:
                     for p in self._progs:
                         p.err |= int(ErrorCode.DMA_TRANSACTION_ERROR)
@@ -1476,7 +1578,7 @@ class MoveExecutor:
                 st = self._egress.get((r.global_rank, comm.comm_id))
                 if st is None:
                     continue
-                for _env, _payload, release in st[1].values():
+                for _env, _payload, release, _l, _c in st[1].values():
                     if release is not None:
                         release()
                 st[1].clear()
@@ -1503,6 +1605,8 @@ class MoveExecutor:
         prog = _Prog(cfg, comm)
         prog.nmoves = len(moves)
         prog.lanes = skeleton.nlanes
+        if _TRACE.enabled:
+            prog.call_seq = _TRACE.next_call_seq()
         with self._sched_lock:
             if self._closed:
                 raise RuntimeError("executor closed")
@@ -1533,6 +1637,10 @@ class MoveExecutor:
                 err = self._run_move(e.mv, cfg, comm, pipelined=True,
                                      plan=e, prog=prog)
                 if err:
+                    if _TRACE.enabled:
+                        _TRACE.trigger_dump(
+                            f"barrier_error_0x{err:x}",
+                            rank=self.owner_rank)
                     with self._sched_lock:
                         prog.err |= err
                     break
@@ -1589,6 +1697,30 @@ class MoveExecutor:
                          max_inflight=prog.max_depth,
                          lanes=prog.lanes,
                          combine_overlap=prog.max_combining)
+            # overlap_frac (ROADMAP item 5): measured from the flight
+            # recorder when armed (combine time under the union of the
+            # call's wire intervals), with the pipeline-counter estimate
+            # standing in when the recorder saw none — sub-microsecond
+            # segments under-resolve, and inline ingest chains attribute
+            # wire time to the peer's events — and when disarmed: with
+            # depth-D concurrent segments, all but roughly one segment's
+            # worth of combine time is hidden behind another segment's
+            # wire activity. Serial/window engines report 0: their
+            # combines never overlap anything.
+            of = None
+            if _TRACE.enabled and prog.call_seq:
+                of = _TRACE.overlap_frac(prog.call_seq)
+            if of is None:  # a MEASURED 0.0 must not fall back to the
+                # counter estimate — zero achieved overlap is exactly
+                # the pathology this metric exists to expose. Combine-free
+                # programs (segmented allgather/bcast) report 0 too: the
+                # metric's denominator is combine time, and fabricating
+                # a depth estimate for it would make cross-op comparisons
+                # meaningless.
+                of = (1.0 - 1.0 / prog.max_depth
+                      if prog.pipelined and prog.max_depth > 1
+                      and prog.max_combining > 0 else 0.0)
+            stats["overlap_frac"] = round(of, 4)
             self.last_stats = stats
         if prog.exc is not None:
             raise prog.exc  # the feed-time barrier's original exception

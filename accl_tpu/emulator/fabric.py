@@ -11,11 +11,24 @@ for single-process tests (kernels/plugins/dummy_tcp_stack). Here:
 
 A message is a 64-byte-header-equivalent envelope {src, tag, seqn, nbytes,
 wire_dtype, strm} + payload (eth_intf.h:41-80 parity).
+
+Observability (PR 6): ``stats`` stays the cheap always-on counter surface
+(absorbed into :data:`~accl_tpu.tracing.METRICS` by the owning context's
+collector), per-communicator attribution rides ``stats_by_comm``, fault
+events additionally count into the process-wide registry directly (they
+are rare by construction), and an armed flight recorder sees every frame
+as a ``wire_send`` event.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import itertools
+
+from ..tracing import METRICS, TRACE as _TRACE
+
+# fabric-instance tags for registry rows (see LocalFabric.__init__)
+_CTX_SEQ = itertools.count(1)
 
 
 @dataclasses.dataclass
@@ -55,10 +68,18 @@ class LocalFabric:
 
     def __init__(self, world_size: int):
         self.world_size = world_size
+        # process-unique instance tag on every registry row this fabric
+        # produces: comm_id is a deterministic membership CRC, so two
+        # concurrently live same-shape worlds would otherwise merge their
+        # per-comm series into one indistinguishable key
+        self.ctx_seq = next(_CTX_SEQ)
         self._ingress: list = [None] * world_size
         self._fault = None
         self.stats = {"sent": 0, "dropped": 0, "duplicated": 0,
                       "corrupted": 0}
+        # per-communicator attribution of the same four counters (QoS
+        # accounting foundation, ROADMAP item 3): comm_id -> counter dict
+        self.stats_by_comm: dict[int, dict[str, int]] = {}
 
     def attach(self, rank: int, ingress_fn):
         """ingress_fn(env, payload) is the rank's eager-ingress entry."""
@@ -76,19 +97,68 @@ class LocalFabric:
     def clear_fault(self):
         self._fault = None
 
+    def _comm_stats(self, comm_id: int) -> dict[str, int]:
+        st = self.stats_by_comm.get(comm_id)
+        if st is None:
+            st = self.stats_by_comm[comm_id] = {
+                "sent": 0, "dropped": 0, "duplicated": 0, "corrupted": 0}
+        return st
+
     def send(self, env: Envelope, payload: bytes):
         fn = self._ingress[env.dst]
         if fn is None:
             raise RuntimeError(f"rank {env.dst} not attached to fabric")
         self.stats["sent"] += 1
+        cst = self._comm_stats(env.comm_id)
+        cst["sent"] += 1
+        if _TRACE.enabled:
+            _TRACE.emit("wire_send", rank=env.src, seqn=env.seqn,
+                        peer=env.dst, nbytes=env.nbytes)
         action = self._fault(env, payload) if self._fault else "deliver"
         if action == "drop":
+            # fault events are rare by construction (injection/test-only
+            # on this fabric): count them straight into the process-wide
+            # registry so a torn-down world's faults stay diagnosable
             self.stats["dropped"] += 1
+            cst["dropped"] += 1
+            METRICS.inc("fabric_dropped_total", fabric="local",
+                        ctx=self.ctx_seq, comm_id=env.comm_id,
+                        src=env.src, dst=env.dst)
             return
         if action == "corrupt_seq":
             self.stats["corrupted"] += 1
+            cst["corrupted"] += 1
+            METRICS.inc("fabric_corrupted_total", fabric="local",
+                        ctx=self.ctx_seq, comm_id=env.comm_id,
+                        src=env.src, dst=env.dst)
             env = dataclasses.replace(env, seqn=env.seqn + 1_000_000)
         fn(env, payload)
         if action == "duplicate":
             self.stats["duplicated"] += 1
+            cst["duplicated"] += 1
+            METRICS.inc("fabric_duplicated_total", fabric="local",
+                        ctx=self.ctx_seq, comm_id=env.comm_id,
+                        src=env.src, dst=env.dst)
             fn(env, payload)
+
+    # fault keys are written straight into the registry at the fault site
+    # (send() above) so they survive world teardown — the collector must
+    # NOT re-yield them under the same family or every fault would count
+    # twice (aggregate row) or three times (per-comm row) in any consumer
+    # that sums the series
+    _DIRECT_FAULT_KEYS = frozenset({"dropped", "duplicated", "corrupted"})
+
+    def metrics_rows(self):
+        """Collector rows for :class:`~accl_tpu.tracing.MetricsRegistry`:
+        the per-communicator non-fault stats (fault counters live as
+        direct registry writes, see above). No ``comm_id=all`` aggregate
+        row: every envelope carries a comm_id, so the per-comm series sum
+        to the aggregate already — an extra total row would double every
+        frame for consumers that sum the family."""
+        for comm_id, st in list(self.stats_by_comm.items()):
+            for k, v in st.items():
+                if k in self._DIRECT_FAULT_KEYS:
+                    continue
+                yield ("counter", f"fabric_{k}_total",
+                       {"fabric": "local", "ctx": self.ctx_seq,
+                        "comm_id": comm_id}, v)

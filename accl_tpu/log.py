@@ -1,0 +1,65 @@
+"""Package-level logging: one ``accl_tpu`` logger hierarchy, rank-tagged.
+
+The reference's crash story is a process per rank whose stderr mpirun
+prefixes with the rank — a bare ``traceback.print_exc()`` there is
+attributable for free. The TPU rebuild runs many ranks as THREADS of one
+process (the in-process emu world, ``spawn_world`` daemons), so unowned
+stderr tracebacks interleave into soup. Every library log site therefore
+goes through ``get_logger(...)`` (a child of the ``accl_tpu`` logger) and
+carries the owning rank in the message; embedders capture or silence the
+whole package with one ``logging.getLogger("accl_tpu")`` handle.
+
+No handler is installed at import (library etiquette): Python's
+last-resort handler prints WARNING+ to stderr out of the box, and pytest's
+logging capture sees everything. ``basic_config()`` opts into a
+rank-tagged stderr handler for standalone processes (the daemon's
+``__main__`` calls it).
+"""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_logger", "basic_config", "RankTagFilter"]
+
+ROOT_NAME = "accl_tpu"
+
+
+def get_logger(subname: str | None = None) -> logging.Logger:
+    """The package logger, or the ``accl_tpu.<subname>`` child. Accepts a
+    ``__name__`` already under the package unchanged."""
+    if not subname:
+        return logging.getLogger(ROOT_NAME)
+    if subname.startswith(ROOT_NAME):
+        return logging.getLogger(subname)
+    return logging.getLogger(f"{ROOT_NAME}.{subname}")
+
+
+class RankTagFilter(logging.Filter):
+    """Guarantees every record has a ``rank`` attribute so the tagged
+    format string never KeyErrors: sites that know their rank pass
+    ``extra={"rank": r}``; everything else renders as ``-``."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not hasattr(record, "rank"):
+            record.rank = "-"
+        return True
+
+
+def basic_config(level: int = logging.INFO) -> logging.Logger:
+    """Install a rank/comm-tagged stderr handler on the package logger
+    (idempotent). For standalone processes — the rank daemon's __main__,
+    benchmark drivers — where nobody else configures logging."""
+    logger = logging.getLogger(ROOT_NAME)
+    if not any(getattr(h, "_accl_tpu_tagged", False)
+               for h in logger.handlers):
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "[%(asctime)s accl_tpu r%(rank)s] %(levelname)s "
+            "%(name)s: %(message)s"))
+        handler.addFilter(RankTagFilter())
+        handler._accl_tpu_tagged = True
+        logger.addHandler(handler)
+        logger.propagate = False  # the tagged handler owns the output
+    logger.setLevel(level)
+    return logger

@@ -354,3 +354,17 @@ class PlanCache:
                 "evictions": self.evictions,
                 "invalidations": dict(self.invalidations),
             }
+
+    def metrics_rows(self, labels: dict):
+        """This cache's counters as registry-collector rows
+        (:meth:`~accl_tpu.tracing.MetricsRegistry.register_collector`
+        format) — one shared mapping so the emu device and the rank
+        daemon can never drift in how they report the cache."""
+        st = self.stats()
+        for k in ("hits", "misses", "bypasses", "evictions"):
+            yield ("counter", f"plan_cache_{k}_total", labels, st[k])
+        yield ("gauge", "plan_cache_entries", labels, st["entries"])
+        yield ("gauge", "plan_cache_enabled", labels, int(st["enabled"]))
+        for reason, n in st["invalidations"].items():
+            yield ("counter", "plan_cache_invalidations_total",
+                   dict(labels, reason=reason), n)

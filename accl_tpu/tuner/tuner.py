@@ -151,7 +151,14 @@ class Tuner:
                            if topo.supported is None
                            or (op, a) in topo.supported)
             if cands:
-                return self._rng.choice(cands)
+                pick = self._rng.choice(cands)
+                # exploration cost is observable process-wide: each pick
+                # shows up in ACCL.metrics_snapshot() next to the plan
+                # cache invalidations/misses it may trigger at refresh
+                from ..tracing import METRICS
+                METRICS.inc("tuner_exploration_picks_total", op=op,
+                            world=world_size, algorithm=pick.name)
+                return pick
         stats = self._measured.get(key, {})
         best, best_score = None, None
         for alg, predicted in rank_algorithms(op, topo, nbytes,

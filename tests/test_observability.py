@@ -1,0 +1,444 @@
+"""Flight-recorder + unified-metrics tests (PR 6 observability layer).
+
+Covers: Chrome-trace export golden properties (valid JSON, per-track
+monotonic timestamps, per-lane stage coverage), fault auto-dump ("the
+waveform at the trigger"), fabric fault accounting through
+``ACCL.metrics_snapshot()``, disarmed-overhead bound (the recorder is
+compiled in but must cost one branch when off), the ``Profiler.record``
+armed-flag regression, and the CallRecord ``lanes``/``overlap_frac``
+promotion with old-CSV compatibility.
+"""
+
+import json
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu.call import CallHandle
+from accl_tpu.testing import emu_world, run_ranks
+from accl_tpu.tracing import (CallRecord, EventTrace, METRICS,
+                              MetricsRegistry, Profiler, TRACE)
+
+
+@pytest.fixture
+def armed_trace(tmp_path):
+    """Arm the process-wide recorder for one test, restore after."""
+    TRACE.clear()
+    TRACE.dump_dir = str(tmp_path)
+    TRACE.start()
+    yield TRACE
+    TRACE.stop()
+    TRACE.clear()
+    TRACE.dump_dir = ""
+
+
+def _allreduce_body(n=1024):
+    def body(a):
+        a.start_profiling()
+        src = a.buffer(data=np.arange(n, dtype=np.float32))
+        dst = a.buffer((n,), np.float32)
+        a.allreduce(src, dst, n)
+        a.end_profiling()
+        return a.profiler.records[-1]
+    return body
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_chrome_trace_export_golden(armed_trace, tmp_path):
+    """An armed streamed allreduce exports valid Chrome trace-event JSON:
+    per-lane tracks, non-decreasing ts per track, and at least one event
+    per segment lane for each dataplane stage."""
+    accls = emu_world(4, max_segment_size=512)
+    recs = run_ranks(accls, _allreduce_body(1024))
+    nlanes = recs[0].lanes
+    assert nlanes >= 2  # the call segmented: per-lane coverage is testable
+    path = tmp_path / "trace.json"
+    assert accls[0].export_trace(str(path)) > 0
+    doc = json.load(open(path))  # valid JSON by construction of the test
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    stages = {e["name"] for e in evs}
+    assert {"recv", "combine", "relay", "egress"} <= stages
+    # per-track monotonically non-decreasing timestamps
+    by_track = {}
+    for e in evs:
+        by_track.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+    for ts in by_track.values():
+        assert all(a <= b for a, b in zip(ts, ts[1:]))
+    # >=1 event per segment lane per compute/ingress stage (relay may be
+    # cut-through-fused into the recv, so it is asserted globally above)
+    thread_names = {}
+    for e in doc["traceEvents"]:
+        if e["ph"] == "M" and e["name"] == "thread_name":
+            thread_names[(e["pid"], e["tid"])] = e["args"]["name"]
+    for lane in range(nlanes):
+        for stage in ("recv", "combine"):
+            assert any(
+                e["name"] == stage
+                and thread_names[(e["pid"], e["tid"])] == f"lane {lane}"
+                for e in evs), f"no {stage} event on lane {lane}"
+    # metadata names every rank's process
+    procs = {e["pid"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert len(procs) == 4
+    for a in accls:
+        a.deinit()
+
+
+def test_trace_auto_dump_on_recv_deadline(armed_trace, tmp_path):
+    """A recv-deadline abort dumps the flight recorder: the waveform at
+    the trigger."""
+    accls = emu_world(2, timeout=0.3)
+    fabric = accls[0].device.ctx.fabric
+    fabric.inject_fault(lambda env, payload: "drop")
+
+    def body(a):
+        buf = a.buffer(data=np.ones(8, np.float32))
+        if a.rank == 0:
+            a.send(buf, 8, dst=1, tag=5)
+            return None
+        with pytest.raises(Exception):
+            a.recv(buf, 8, src=0, tag=5)
+        return True
+
+    assert run_ranks(accls, body)[1]
+    fabric.clear_fault()
+    dumps = list(tmp_path.glob("accl_tpu_trace_*.json"))
+    assert dumps, "no auto-dump written on recv-deadline abort"
+    doc = json.load(open(dumps[0]))
+    assert "traceEvents" in doc
+    for a in accls:
+        a.deinit()
+
+
+def test_trace_error_latch_dump_bounded(armed_trace):
+    """Dumps are bounded per arming (an abort storm must not spray disk)."""
+    assert TRACE.max_dumps >= 1
+    paths = [TRACE.trigger_dump("unit_test") for _ in range(TRACE.max_dumps
+                                                            + 3)]
+    assert sum(p is not None for p in paths) == TRACE.max_dumps
+
+
+def test_disarmed_emit_sites_are_noop_guard():
+    """Tier-1 overhead bound: with the recorder disarmed, the emit-site
+    pattern (one attribute test) costs essentially nothing — timed as a
+    1k-iteration micro-loop against an empty loop, generous bound."""
+    tr = EventTrace()
+    assert not tr.enabled  # off by default
+
+    def guarded():
+        t0 = time.perf_counter()
+        for _ in range(1000):
+            if tr.enabled:
+                tr.emit("combine")
+        return time.perf_counter() - t0
+
+    def empty():
+        t0 = time.perf_counter()
+        for _ in range(1000):
+            pass
+        return time.perf_counter() - t0
+
+    g = min(guarded() for _ in range(5))
+    e = min(empty() for _ in range(5))
+    # generous: the guard may cost a few ns/iteration; scheduler noise is
+    # absorbed by min-of-5 plus an absolute floor
+    assert g <= e * 50 + 1e-3, (g, e)
+    # and nothing was recorded
+    assert tr.events() == []
+
+
+def test_disarmed_emit_records_nothing_even_if_called():
+    tr = EventTrace()
+    tr.emit("recv", rank=0)  # tolerated, dropped
+    assert tr.events() == []
+    assert tr.trigger_dump("x") is None  # dumps need an armed recorder
+
+
+def test_overlap_frac_streamed_vs_serial():
+    """CallRecord promotion: the streamed engine reports lanes>0 and
+    overlap_frac>0 (counters-estimated when disarmed); the serial oracle
+    reports 0 for both."""
+    accls = emu_world(4, max_segment_size=512)
+    recs = run_ranks(accls, _allreduce_body(4096))
+    assert all(r.lanes > 0 for r in recs)
+    assert all(r.overlap_frac > 0 for r in recs)
+    for a in accls:
+        a.deinit()
+    serial = emu_world(4, pipeline_window=0)
+    recs = run_ranks(serial, _allreduce_body(4096))
+    assert all(r.lanes == 0 and r.overlap_frac == 0.0 for r in recs)
+    for a in serial:
+        a.deinit()
+
+
+def test_overlap_frac_zero_for_combine_free_streamed_call():
+    """A streamed call with NO combine work (segmented allgather) must
+    report overlap_frac 0: the metric's denominator is combine time, and
+    the depth estimate must not fabricate a value for it."""
+    accls = emu_world(4, max_segment_size=512)
+
+    def body(a):
+        a.start_profiling()
+        src = a.buffer(data=np.arange(1024, dtype=np.float32))
+        dst = a.buffer((4096,), np.float32)
+        a.allgather(src, dst, 1024)
+        a.end_profiling()
+        return a.profiler.records[-1]
+
+    recs = run_ranks(accls, body)
+    assert all(r.lanes > 0 for r in recs)          # it did stream...
+    assert all(r.overlap_frac == 0.0 for r in recs)  # ...with no combines
+    for a in accls:
+        a.deinit()
+
+
+# -- profiler armed-flag regression ------------------------------------------
+
+def test_profiler_record_honors_enabled_at_record_time():
+    p = Profiler()
+    rec = CallRecord(op="nop", count=0, nbytes=0, comm_id=0, t_start=0.0,
+                     duration_s=1e-6)
+    p.record(rec)                  # never armed: dropped
+    assert p.records == []
+    p.start()
+    p.record(rec)
+    p.stop()
+    p.record(rec)                  # stopped: dropped again
+    assert len(p.records) == 1
+
+
+def test_profiler_stop_then_retire_async_handle():
+    """A done callback attached while profiling was armed must not append
+    after stop(): async handles retire late (the regression this pins)."""
+    p = Profiler()
+    p.start()
+    h = CallHandle(context="allreduce")
+    p.attach(h, op="allreduce", count=8, nbytes=32, comm_id=0)
+    p.stop()
+    h.complete(0)                  # retires AFTER end_profiling
+    assert p.records == []
+    # and the inverse: retire while armed does record
+    h2 = CallHandle(context="allreduce")
+    p.start()
+    p.attach(h2, op="allreduce", count=8, nbytes=32, comm_id=0)
+    h2.complete(0)
+    assert len(p.records) == 1
+
+
+def test_old_csv_dump_still_parses(tmp_path):
+    """Pre-PR-6 dumps (no lanes/overlap_frac columns) read back with the
+    new fields zero — and even older pre-plan-cache dumps still parse."""
+    old = tmp_path / "old.csv"
+    old.write_text(
+        "op,count,nbytes,comm_id,t_start,duration_us,error,algorithm,"
+        "moves,pipelined_moves,pipeline_depth,combine_overlap,expand_us,"
+        "plan_us,plan_cache\n"
+        "allreduce,256,1024,0,1.5,325.0,0,FUSED_RING,10,8,4,2,12.0,3.0,"
+        "hit\n")
+    (rec,) = Profiler.read_csv(str(old))
+    assert rec.op == "allreduce" and rec.moves == 10
+    assert rec.lanes == 0 and rec.overlap_frac == 0.0
+
+
+# -- unified metrics registry ------------------------------------------------
+
+def _counter_sum(snap, name):
+    return sum(snap["counters"].get(name, {}).values())
+
+
+def test_fault_accounting_in_metrics_snapshot():
+    """Injected drops/corruption surface in ACCL.metrics_snapshot() with
+    per-communicator labels — and survive the world's teardown (the
+    registry counter is process-wide)."""
+    before = METRICS.snapshot()
+    accls = emu_world(2, timeout=0.3)
+    fabric = accls[0].device.ctx.fabric
+    comm_id = accls[0].comm.comm_id
+    fabric.inject_fault(lambda env, payload: "drop")
+
+    def body(a):
+        buf = a.buffer(data=np.ones(4, np.float32))
+        if a.rank == 0:
+            a.send(buf, 4, dst=1, tag=3)
+            return None
+        with pytest.raises(Exception):
+            a.recv(buf, 4, src=0, tag=3)
+        return True
+
+    assert run_ranks(accls, body)[1]
+    fabric.clear_fault()
+    snap = accls[0].metrics_snapshot()
+    dropped = snap["counters"]["fabric_dropped_total"]
+    assert (_counter_sum(snap, "fabric_dropped_total")
+            > _counter_sum(before, "fabric_dropped_total"))
+    # per-communicator attribution on the direct fault counter
+    assert any(f"comm_id={comm_id}" in labels for labels in dropped)
+    # collector-backed surfaces are present while the world lives
+    assert _counter_sum(snap, "fabric_sent_total") > 0
+    assert "rx_pool_size" in snap["gauges"]
+    assert "plan_cache_hits_total" in snap["counters"]
+    assert _counter_sum(snap, "accl_calls_total") > 0
+    for a in accls:
+        a.deinit()
+
+
+def test_corrupt_seq_counted():
+    accls = emu_world(2, timeout=0.3)
+    fabric = accls[0].device.ctx.fabric
+    before = METRICS.snapshot()
+    fabric.inject_fault(lambda env, payload: "corrupt_seq")
+
+    def body(a):
+        buf = a.buffer(data=np.ones(4, np.float32))
+        if a.rank == 0:
+            a.send(buf, 4, dst=1, tag=3)
+            return None
+        with pytest.raises(Exception):
+            a.recv(buf, 4, src=0, tag=3)
+        return True
+
+    assert run_ranks(accls, body)[1]
+    fabric.clear_fault()
+    snap = accls[0].metrics_snapshot()
+    assert (_counter_sum(snap, "fabric_corrupted_total")
+            > _counter_sum(before, "fabric_corrupted_total"))
+    assert fabric.stats["corrupted"] == 1
+    assert fabric.stats_by_comm[accls[0].comm.comm_id]["corrupted"] == 1
+    for a in accls:
+        a.deinit()
+
+
+def test_udp_deliver_queue_drop_counted():
+    """The UDP fabric's bounded-queue drop counts into the registry (with
+    the envelope's communicator) — the deliver queue is force-filled so
+    the next completed message takes the Full branch."""
+    import queue as _q
+
+    from accl_tpu.emulator import protocol as P
+    from accl_tpu.emulator.daemon import UdpEthFabric
+
+    fab = UdpEthFabric(0, 0, ingest_fn=lambda e, p: None)  # ephemeral port
+    try:
+        full = _q.Queue(maxsize=1)
+        full.put_nowait(("x", b""))
+        fab._queues[1] = full  # sender 1's queue is jammed
+        payload = b"\x00\x00\x80\x3f"
+        hdr = P.pack_eth_header(1, 0, 0, 0, 9, 0,
+                                P.dtype_code("float32"), len(payload))[1:]
+        frag = struct.pack(UdpEthFabric._FRAG_FMT, 1, 0, 0, 1)
+        before = METRICS.snapshot()
+        fab._on_datagram(frag + bytes(hdr) + payload,
+                         struct.calcsize(UdpEthFabric._FRAG_FMT))
+        assert fab.stats["dropped_queue_full"] == 1
+        snap = METRICS.snapshot()
+        assert (_counter_sum(snap, "fabric_dropped_total")
+                > _counter_sum(before, "fabric_dropped_total"))
+        assert any("comm_id=9" in labels for labels in
+                   snap["counters"]["fabric_dropped_total"])
+    finally:
+        fab.close()
+
+
+def test_registry_prometheus_text_and_histogram():
+    reg = MetricsRegistry()
+    reg.inc("demo_total", op="allreduce", comm_id=1)
+    reg.inc("demo_total", 2, op="allreduce", comm_id=1)
+    reg.set_gauge("demo_gauge", 7, rank=0)
+    for v in (0.5, 3.0, 100.0):
+        reg.observe("demo_us", v, op="send")
+    snap = reg.snapshot()
+    assert snap["counters"]["demo_total"]["comm_id=1,op=allreduce"] == 3
+    assert snap["gauges"]["demo_gauge"]["rank=0"] == 7
+    h = snap["histograms"]["demo_us"]["op=send"]
+    assert h["count"] == 3 and h["sum"] == pytest.approx(103.5)
+    text = reg.to_prometheus()
+    assert '# TYPE demo_total counter' in text
+    assert 'demo_total{comm_id="1",op="allreduce"} 3' in text
+    assert 'demo_us_count{op="send"} 3' in text
+    # Cumulative, properly-quoted bucket lines (0.5→le=1, 3→le=4, 100→le=256).
+    assert 'demo_us_bucket{op="send",le="1.0"} 1' in text
+    assert 'demo_us_bucket{op="send",le="4.0"} 2' in text
+    assert 'demo_us_bucket{op="send",le="+Inf"} 3' in text
+    assert '""' not in text  # no double-quoted label values anywhere
+
+
+def test_registry_collector_weakly_held():
+    reg = MetricsRegistry()
+
+    class Src:
+        pass
+
+    s = Src()
+    reg.register_collector(s, lambda o: [("counter", "c_total", {}, 5)])
+    assert reg.snapshot()["counters"]["c_total"][""] == 5
+    del s
+    import gc
+    gc.collect()
+    assert "c_total" not in reg.snapshot()["counters"]
+
+
+def test_daemon_world_metrics_and_rejection_counter():
+    """The socket-daemon tier reports through the same registry: fabric +
+    plan-cache collectors are visible, and ingress rejections count."""
+    from accl_tpu.testing import sim_world
+
+    accls = sim_world(2)
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(8, float(a.rank + 1), np.float32))
+            dst = a.buffer((8,), np.float32)
+            a.allreduce(src, dst, 8)
+            return float(dst.data[0])
+
+        assert all(r == 3.0 for r in run_ranks(accls, body))
+        snap = accls[0].metrics_snapshot()
+        sent = snap["counters"]["fabric_sg_sends_total"]
+        assert any("fabric=tcp" in labels for labels in sent)
+        assert _counter_sum(snap, "fabric_sg_sends_total") > 0
+        assert "rx_pool_occupancy_hwm" in snap["gauges"]
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_tuner_exploration_pick_counted():
+    from accl_tpu.tuner import Tuner
+    from accl_tpu.tuner.cost import Topology
+
+    before = METRICS.snapshot()
+    t = Tuner(topology=Topology(world_size=4, alpha_us=20.0, beta_gbps=4.0,
+                                tier="emu"),
+              epsilon=1.0, seed=1)  # always explore
+    t.select("allreduce", 4, 4096)
+    snap = METRICS.snapshot()
+    assert (_counter_sum(snap, "tuner_exploration_picks_total")
+            > _counter_sum(before, "tuner_exploration_picks_total"))
+
+
+# -- package logger ----------------------------------------------------------
+
+def test_package_logger_rank_tagged(capsys):
+    import logging
+
+    from accl_tpu.log import basic_config, get_logger
+
+    logger = basic_config(logging.INFO)
+    try:
+        get_logger("unit").warning("hello from rank %d", 3,
+                                   extra={"rank": 3})
+        get_logger("unit").warning("no rank known")
+        err = capsys.readouterr().err
+        assert "accl_tpu r3" in err and "hello from rank 3" in err
+        assert "accl_tpu r-" in err  # missing rank renders as '-'
+        # idempotent: a second basic_config adds no second handler
+        n = len(logger.handlers)
+        basic_config(logging.INFO)
+        assert len(logger.handlers) == n
+    finally:
+        for h in list(logger.handlers):
+            if getattr(h, "_accl_tpu_tagged", False):
+                logger.removeHandler(h)
+        logger.propagate = True

@@ -39,6 +39,7 @@ def test_profiler_records_and_summary():
 
 def test_profiler_csv(tmp_path):
     p = Profiler()
+    p.start()  # record() honors the armed flag
     p.record(CallRecord(op="bcast", count=8, nbytes=32, comm_id=3,
                         t_start=1.25, duration_s=2e-6, error_word=0))
     path = tmp_path / "prof.csv"
@@ -97,9 +98,11 @@ def test_profiler_csv_roundtrip():
     """Records survive export/import byte-faithfully enough to re-feed
     analysis (and a Tuner): every field including the algorithm label."""
     p = Profiler()
+    p.start()
     p.record(CallRecord(op="allreduce", count=256, nbytes=1024, comm_id=2,
                         t_start=1.5, duration_s=3.25e-4,
-                        algorithm="FUSED_RING"))
+                        algorithm="FUSED_RING", lanes=4,
+                        overlap_frac=0.75))
     p.record(CallRecord(op="send", count=8, nbytes=32, comm_id=0,
                         t_start=2.0, duration_s=1e-5, error_word=4))
     path_ = "prof_rt.csv"
@@ -117,9 +120,12 @@ def test_profiler_csv_roundtrip():
                                                     1024, 2)
     assert a.algorithm == "FUSED_RING"
     assert a.duration_s == pytest.approx(3.25e-4, rel=1e-6)
+    assert (a.lanes, a.overlap_frac) == (4, pytest.approx(0.75))
     assert s.error_word == 4 and s.algorithm == ""
+    assert (s.lanes, s.overlap_frac) == (0, 0.0)
     # re-imported records aggregate identically
     p2 = Profiler()
+    p2.start()
     for r in back:
         p2.record(r)
     assert p2.summary()["allreduce"].total_bytes == 1024
@@ -134,6 +140,7 @@ def test_percentile_math_known_inputs():
     assert tracing._percentile(vals, 1.0) == 100.0
     assert tracing._percentile([], 0.5) == 0.0
     p = Profiler()
+    p.start()
     for v in vals:
         p.record(CallRecord(op="nop", count=0, nbytes=0, comm_id=0,
                             t_start=0.0, duration_s=v * 1e-6))
