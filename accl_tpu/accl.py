@@ -47,13 +47,24 @@ class ACCL:
             retire-time measurements. Multi-rank worlds must share ONE
             tuner instance across their ranks (all member ranks of a
             collective must agree on the algorithm).
+        tenant: optional multi-tenant service label (accl_tpu/service):
+            every communicator this driver registers is grouped under it
+            for admission scheduling, resource quotas and per-tenant
+            metrics/trace attribution. Default: each communicator is its
+            own tenant.
     """
 
     def __init__(self, device: Device, comm: Communicator,
                  timeout: float = 30.0,
                  max_segment_size: int | None = None,
-                 arith_registry=None, tuner=None):
+                 arith_registry=None, tuner=None,
+                 tenant: str | None = None):
         self.device = device
+        if tenant is not None:
+            from .service import validate_tenant
+            validate_tenant(tenant)  # label is spliced into CSV/metrics/
+            # trace encodings — reject unsafe charsets at the API edge
+        self.tenant = tenant
         self._arith_memo: dict[frozenset, object] = {}
         self.arith_registry = (arith_registry if arith_registry is not None
                                else dict(DEFAULT_ARITH_CONFIGS))
@@ -102,7 +113,7 @@ class ACCL:
                     _tcache.load_into(tuner)
                 except (OSError, ValueError):
                     pass
-        device.configure_communicator(comm)
+        device.configure_communicator(comm, tenant=tenant)
         self.communicators.append(comm)
         # bring-up sequence through the call path, mirroring the reference
         # driver init: set_timeout, enable_pkt, set_max_segment_size
@@ -205,7 +216,10 @@ class ACCL:
         multiple communicators over the same member set).
         """
         sub = self.comm.split(members, key=key)
-        self.device.configure_communicator(sub)
+        # splits inherit the driver's tenant grouping: a tenant's data-
+        # parallel replicas and its sub-groups schedule/quota as ONE
+        # tenant (accl_tpu/service)
+        self.device.configure_communicator(sub, tenant=self.tenant)
         self.communicators.append(sub)
         return sub
 
@@ -396,13 +410,36 @@ class ACCL:
         # still in flight queues behind it too — any of these would
         # credit pipeline context, not algorithm speed, to the EWMA (the
         # Profiler keeps recording them all — attribution wants the full
-        # window; training does not)
+        # window; training does not). Quiescence is checked across EVERY
+        # driver sharing this tuner (tuner.quiescent()), not just this
+        # one: multi-tenant worlds share one tuner, and another tenant's
+        # concurrent storm inflating this call's window must not
+        # cross-contaminate the EWMA stream.
         observing = (self.tuner is not None and tunable
                      and not run_async and not waitfor
-                     and self._async_inflight == 0)
+                     and self._async_inflight == 0
+                     and self.tuner.quiescent())
         t0 = _time.perf_counter() if (profiling or observing) else 0.0
-        handle = self.device.call_async(desc, waitfor,
-                                        inline_ok=not run_async)
+        if run_async:
+            # count the async call in flight BEFORE it launches: from the
+            # moment call_async returns (or even mid-submission, on the
+            # driver-bypass path) the storm is executing, and a sibling
+            # driver checking tuner.quiescent() in that window must not
+            # train on a wall clock this call is already inflating
+            with self._async_mu:
+                self._async_inflight += 1
+            if self.tuner is not None:
+                self.tuner.note_async_issue()
+        try:
+            handle = self.device.call_async(desc, waitfor,
+                                            inline_ok=not run_async)
+        except BaseException:
+            if run_async:
+                with self._async_mu:
+                    self._async_inflight -= 1
+                if self.tuner is not None:
+                    self.tuner.note_async_retire()
+            raise
         ebytes = (desc.arithcfg.uncompressed_elem_bytes
                   if desc.arithcfg is not None else 0)
         op = desc.scenario.name
@@ -437,7 +474,9 @@ class ACCL:
             self.profiler.attach(handle, op=op, count=desc.count,
                                  nbytes=desc.count * ebytes,
                                  comm_id=desc.comm_id, t0=t0,
-                                 algorithm=alg_label)
+                                 algorithm=alg_label,
+                                 tenant=self.tenant
+                                 or f"comm-{desc.comm_id}")
         if observing:
             # retire-time measurement back to the tuner (same done-callback
             # path the profiler records through: async chains credit their
@@ -453,13 +492,16 @@ class ACCL:
 
             handle.add_done_callback(_feed)
         if run_async:
-            with self._async_mu:
-                self._async_inflight += 1
+            # (in-flight counters were bumped BEFORE call_async above —
+            # cross-driver visibility via tuner.quiescent() must cover
+            # the launch window itself)
             comm_id = desc.comm_id
 
             def _retired(err):
                 with self._async_mu:
                     self._async_inflight -= 1
+                if self.tuner is not None:
+                    self.tuner.note_async_retire()
                 if err:
                     METRICS.inc("accl_call_errors_total", op=op,
                                 comm_id=comm_id)

@@ -235,6 +235,13 @@ class ErrorCode(enum.IntFlag):
     # but its outcome is genuinely unknowable — saying so beats the
     # false-success 0 the eviction used to fabricate
     CALL_OUTCOME_UNKNOWN = 1 << 24
+    # multi-tenant service (accl_tpu/service): an eager-ingress message
+    # was dropped because its TENANT's rx-pool reservation (plus the
+    # shared overflow pool) was exhausted — typed backpressure, distinct
+    # from the pool-physically-full overflow above so a noisy neighbor
+    # hitting its quota is diagnosable from the error word alone, and
+    # never misread as a deadline/DMA failure
+    TENANT_QUOTA_EXCEEDED = 1 << 25
 
 
 class StackType(enum.IntEnum):
@@ -298,4 +305,10 @@ DEFAULT_COMBINE_WORKERS_CAP = 4
 # $ACCL_TPU_CALL_CHAIN_DEPTH overrides; devices read the env at
 # CONSTRUCTION time (not import), so it can be set after importing.
 DEFAULT_CALL_CHAIN_DEPTH = 2
+# Multi-tenant service (accl_tpu/service): per-tenant admitted-program
+# depth — same rx-pool-pressure rationale as the chain depth above, but
+# scoped per tenant so one tenant's deep pipeline cannot consume every
+# in-flight slot. $ACCL_TPU_TENANT_DEPTH overrides per process;
+# ServiceConfig.tenant(depth=...) overrides per tenant.
+DEFAULT_TENANT_DEPTH = 2
 TAG_ANY = 0xFFFFFFFF                        # reference uses tag=ANY sentinel

@@ -164,7 +164,11 @@ class Device(abc.ABC):
                                inline_ok=timeout is None).wait(timeout)
 
     @abc.abstractmethod
-    def configure_communicator(self, comm: Communicator): ...
+    def configure_communicator(self, comm: Communicator,
+                               tenant: str | None = None):
+        """Register a communicator. ``tenant`` optionally groups it under
+        a multi-tenant service tenant (accl_tpu/service) — backends
+        without a service layer may ignore it, but must accept it."""
 
     @abc.abstractmethod
     def set_timeout(self, timeout: float): ...

@@ -13,9 +13,11 @@ protocol, mirroring cclo_emu.cpp behind ZMQ.
 
 from __future__ import annotations
 
+import collections
 import os
 import queue
 import threading
+import time
 from typing import Sequence
 
 from ..buffer import ACCLBuffer
@@ -24,11 +26,16 @@ from ..communicator import Communicator
 from ..constants import (ACCLError, CCLOp, DEFAULT_CALL_CHAIN_DEPTH,
                          DEFAULT_MAX_SEGMENT_SIZE, DEFAULT_RX_BUFFER_COUNT,
                          DEFAULT_RX_BUFFER_SIZE, DEFAULT_TIMEOUT_S,
-                         ErrorCode)
+                         ErrorCode, StreamFlags)
 from ..plancache import PlanCache, cached_program
 from ..emulator.executor import DeviceMemory, MoveExecutor, RxBufferPool
 from ..emulator.fabric import Envelope, LocalFabric
+from ..service import RankService, ServiceConfig, service_enabled, \
+    tenant_label
 from .base import Device
+
+# inbox token waking the ingress loop's deferred retry (pool release)
+_RETRY = object()
 
 
 class EmuContext:
@@ -45,9 +52,23 @@ class EmuContext:
                  bufsize: int = DEFAULT_RX_BUFFER_SIZE,
                  pipeline_window: int | None = None,
                  segment_stream: bool | None = None,
-                 plan_cache: bool | None = None):
+                 plan_cache: bool | None = None,
+                 service: "ServiceConfig | bool | None" = None):
         self.world_size = world_size
         self.fabric = LocalFabric(world_size)
+        # multi-tenant service config shared by every rank of this world
+        # (policy only; per-rank controllers/quotas live on the devices).
+        # None = process default ($ACCL_TPU_SERVICE, on); False = off;
+        # True = default config; a ServiceConfig = explicit policy.
+        if service is None:
+            service = ServiceConfig() if service_enabled() else None
+        elif service is True:
+            service = ServiceConfig(enabled=True)
+        elif service is False:
+            service = None
+        if service is not None and not service.enabled:
+            service = None
+        self.service_config = service
         # unified metrics: the shared fabric reports once per CONTEXT
         # (per-rank collectors would multiply its counters by W); weak
         # registration, so a torn-down world stops reporting
@@ -104,6 +125,18 @@ class EmuDevice(Device):
         # set it after importing the package
         self.chain_depth = max(1, int(os.environ.get(
             "ACCL_TPU_CALL_CHAIN_DEPTH", DEFAULT_CALL_CHAIN_DEPTH)))
+        # multi-tenant service (accl_tpu/service): comm -> tenant mapping
+        # (fed by configure_communicator) plus this rank's admission
+        # controller and resource quotas. The mapping dict is shared BY
+        # REFERENCE with the rx pool and the RankService so a late
+        # tenant registration is visible everywhere at once.
+        self.comm_tenants: dict[int, str] = {}
+        self.service = None
+        if ctx.service_config is not None:
+            self.service = RankService(
+                ctx.service_config, rank=rank,
+                tenant_of=self.comm_tenants, pool=self.pool,
+                arena=self.executor._arena)
         # cross-call pipelining (chained calls): finishes retire on a
         # dedicated FIFO thread so the call worker can admit the next
         # chained program while the previous one drains
@@ -111,6 +144,12 @@ class EmuDevice(Device):
         self._chain_cv = threading.Condition()
         self._chain_pending = 0
         self._calls: queue.Queue = queue.Queue()
+        # submitted-not-yet-retired calls per communicator: the preempt
+        # driver bypass may only run a call in the submitting thread
+        # when NOTHING of its comm is queued or in flight (program order
+        # within a comm is the contract; across comms there is none)
+        self._cp_mu = threading.Lock()
+        self._comm_pending: dict[int, int] = {}
         # one lock serializes every execution (worker or inline); the
         # inline gate itself lives on the Device base. The counter here
         # covers a call until full RETIREMENT (decrement after _retire).
@@ -123,7 +162,13 @@ class EmuDevice(Device):
         # same way); only this thread blocks when the rx pool is full
         self._inbox: queue.Queue = queue.Queue()
         self._ing_mu = threading.Lock()
-        self._inbox_pending = 0
+        # deferred-retry wakeup state: _deferred_waiting is set while the
+        # ingress loop holds parked messages; a pool release then posts
+        # ONE retry token (collapsed while outstanding) so parked
+        # messages retry the instant a slot frees, not on a poll tick
+        self._deferred_waiting = False
+        self._retry_posted = False
+        self.pool.on_release = self._on_pool_release
         self._ingress = threading.Thread(target=self._ingress_loop,
                                          daemon=True,
                                          name=f"emu-ingress{rank}")
@@ -131,36 +176,77 @@ class EmuDevice(Device):
 
     # -- ingress (eager, never blocks the sender) --------------------------
     def ingest(self, env: Envelope, payload: bytes):
-        # Fast path: when nothing is queued OR still draining (the counter
-        # covers the dequeued-but-not-yet-ingested window), deliver into
-        # the pool from the sender's thread — one scheduler handoff less
-        # per message. Pool matching is exact-seqn so pool arrival order
-        # is irrelevant, and try_ingest never claims the last spare, so a
-        # racing queued message cannot be starved of its slot. Stream
-        # payloads are order-sensitive and always take the queue.
-        if not env.strm:
-            with self._ing_mu:
-                fast = self._inbox_pending == 0
-            if fast and self.pool.try_ingest(env, payload):
-                return
-        with self._ing_mu:
-            self._inbox_pending += 1
+        # Fast path: deliver into the pool from the sender's thread — one
+        # scheduler handoff less per message, and the ingest-inline
+        # cut-through then runs the waiting move right here. Taken even
+        # while the inbox holds a backlog: pool matching is exact-seqn so
+        # arrival order is irrelevant, try_ingest never claims the LAST
+        # spare, and a parked (deferred) message retries the moment a
+        # buffer frees — routing a latency tenant's 4 KiB message behind
+        # a storm's inbox backlog was a measured millisecond-scale stall.
+        # Stream payloads are order-sensitive and always take the queue.
+        if not env.strm and self.pool.try_ingest(env, payload):
+            return
         self._inbox.put((env, payload))
 
     def _ingress_loop(self):
+        # Deferred delivery: a message that cannot claim a buffer (pool
+        # physically full, or its tenant over quota) parks here instead
+        # of blocking the loop — one tenant's storm backpressure must
+        # never head-of-line-block another tenant's 4 KiB message sitting
+        # behind it in the inbox (pool matching is exact-seqn, so
+        # out-of-order delivery is safe). Parked messages retry as the
+        # pool churns and drop with the typed error word (overflow or
+        # TENANT_QUOTA_EXCEEDED) once their deadline expires. The daemon
+        # tier keeps blocking ingest: there backpressure rides each
+        # peer's own TCP connection, which is real per-peer flow control.
+        deferred: collections.deque = collections.deque()
         while True:
-            item = self._inbox.get()
+            try:
+                # coarse timeout only expires parked deadlines; the fast
+                # retry wakeup is the pool-release token (_RETRY)
+                item = self._inbox.get(timeout=0.05 if deferred else None)
+            except queue.Empty:
+                item = False
             if item is None:
                 return
-            try:
+            if item is _RETRY:
+                with self._ing_mu:
+                    self._retry_posted = False
+            elif item is not False:
                 env, payload = item
                 if env.strm:
                     self.executor.deliver_stream(env, payload)
                 else:
-                    self.pool.ingest(env, payload, timeout=self.timeout)
-            finally:
-                with self._ing_mu:
-                    self._inbox_pending -= 1
+                    got = self.pool.ingest_nowait(env, payload)
+                    if got <= 0:
+                        deferred.append(
+                            (env, payload,
+                             time.monotonic() + self.timeout))
+            if deferred:
+                now = time.monotonic()
+                for _ in range(len(deferred)):
+                    env, payload, deadline = deferred.popleft()
+                    got = self.pool.ingest_nowait(env, payload)
+                    if got > 0:
+                        continue
+                    if now >= deadline:
+                        self.pool.latch_ingest_drop(env, got < 0)
+                    else:
+                        deferred.append((env, payload, deadline))
+            with self._ing_mu:
+                self._deferred_waiting = bool(deferred)
+
+    def _on_pool_release(self):
+        """Pool release listener (consumer threads): wake the ingress
+        loop's deferred retry. One token is collapsed while outstanding —
+        a release burst costs one queue put, and an idle pool costs
+        nothing."""
+        with self._ing_mu:
+            if not self._deferred_waiting or self._retry_posted:
+                return
+            self._retry_posted = True
+        self._inbox.put(_RETRY)
 
     # -- Device interface --------------------------------------------------
     def register_buffer(self, buf: ACCLBuffer):
@@ -169,17 +255,25 @@ class EmuDevice(Device):
     def deregister_buffer(self, buf: ACCLBuffer):
         self.mem.deregister(buf.address)
 
-    def configure_communicator(self, comm: Communicator):
+    def configure_communicator(self, comm: Communicator,
+                               tenant: str | None = None):
         """Register a communicator (world or split); calls reference it by
         comm_id, like the reference addressing communicator records in
-        exchange memory (accl.py:677-708). Reconfiguration invalidates the
-        compiled-plan cache (and bumps the epoch its keys carry): plans
-        bind comm size/rank numbering at expansion time."""
+        exchange memory (accl.py:677-708). ``tenant`` groups the comm
+        under a service tenant (default: the comm is its own tenant).
+        Reconfiguration invalidates the compiled-plan cache (and bumps
+        the epoch its keys carry): plans bind comm size/rank numbering at
+        expansion time."""
         self.comms[comm.comm_id] = comm
+        if tenant:
+            self.comm_tenants[comm.comm_id] = tenant
         if self.comm is None:
             self.comm = comm
         self.comm_epoch += 1
         self.plan_cache.invalidate("comm")
+
+    def tenant_of_comm(self, comm_id: int) -> str:
+        return tenant_label(comm_id, self.comm_tenants)
 
     def set_timeout(self, timeout: float):
         self.timeout = timeout
@@ -223,22 +317,61 @@ class EmuDevice(Device):
                    inline_ok: bool = False) -> CallHandle:
         handle = CallHandle(context=desc.scenario.name)
         waitfor = tuple(waitfor)
-        # Inline fast path: a synchronous call on an idle device retires in
-        # the caller's thread, skipping two scheduler handoffs (~2x lower
-        # small-message latency). Submission order is preserved: inline
-        # runs only when nothing is queued or in flight, and any call
-        # submitted meanwhile serializes behind _exec_mu.
+        first = self._comm_add(desc.comm_id)
+        # Inline fast path: a synchronous call on an idle device retires
+        # in the caller's thread, skipping two scheduler handoffs (~2x
+        # lower small-message latency). Service-eligible data calls still
+        # ROUTE THROUGH the service here (admission accounting + no
+        # _exec_mu hold across the collective — see _retire); with an
+        # idle controller the express grant keeps the one-thread shape.
         if inline_ok and self._inline_begin(waitfor):
             deferred = False
             try:
-                deferred = self._retire(desc, waitfor, handle)
+                deferred = self._retire(desc, waitfor, handle,
+                                        sync_express=True)
             finally:
                 if not deferred:
+                    self._comm_done(desc.comm_id)
                     self._inflight_done()
             return handle
         self._inflight_add()
+        if first and not waitfor and self._service_eligible(desc):
+            # driver bypass: a service call with nothing of its comm in
+            # flight submits from THIS thread — the call-worker queue
+            # handoff is an OS wake per call; per-comm program order is
+            # safe because nothing of this comm is queued or in flight.
+            # The controller decides express (admit+finish here, bounded
+            # by the call; sync callers only) vs queued (returns
+            # immediately, the handle completes on the tenant's finish
+            # worker).
+            deferred = False
+            try:
+                deferred = self._retire(desc, waitfor, handle,
+                                        sync_express=inline_ok)
+            finally:
+                if not deferred:
+                    self._comm_done(desc.comm_id)
+                    self._inflight_done()
+            return handle
         self._calls.put((desc, waitfor, handle))
         return handle
+
+    def _comm_add(self, comm_id: int) -> bool:
+        """Count one submitted call against its comm; True = it is the
+        only one in flight for that comm."""
+        with self._cp_mu:
+            n = self._comm_pending.get(comm_id, 0)
+            self._comm_pending[comm_id] = n + 1
+            return n == 0
+
+    def _comm_done(self, comm_id: int):
+        with self._cp_mu:
+            n = self._comm_pending.get(comm_id, 1) - 1
+            if n > 0:
+                self._comm_pending[comm_id] = n
+            else:
+                self._comm_pending.pop(comm_id, None)
+
 
     def soft_reset(self):
         """Drain the rx pool and zero sequence counters.
@@ -249,8 +382,11 @@ class EmuDevice(Device):
         desynchronize from peers' outbound counters.
         """
         self.pool = RxBufferPool(self.ctx.nbufs, self.ctx.bufsize)
+        self.pool.on_release = self._on_pool_release
         self.executor.pool = self.pool
         self.executor.reset_streams()
+        if self.service is not None:
+            self.service.wire_pool(self.pool)
         for comm in self.comms.values():
             for r in comm.ranks:
                 r.inbound_seq = r.outbound_seq = 0
@@ -261,6 +397,8 @@ class EmuDevice(Device):
         with self._chain_cv:
             if self._chain_q is not None:
                 self._chain_q.put(None)
+        if self.service is not None:
+            self.service.close()
         self.executor.close()
 
     # -- worker ------------------------------------------------------------
@@ -275,24 +413,57 @@ class EmuDevice(Device):
                 deferred = self._retire(desc, waitfor, handle)
             finally:
                 if not deferred:
+                    self._comm_done(desc.comm_id)
                     self._inflight_done()
 
     def _retire(self, desc: CallDescriptor, waitfor,
-                handle: CallHandle) -> bool:
+                handle: CallHandle, allow_service: bool = True,
+                sync_express: bool = False) -> bool:
         """Wait dependencies, execute, complete the handle — never raises
         (errors land in the handle). Returns True when the call was
-        ADMITTED as a chained program: the handle (and this device's
-        in-flight accounting) then retires on the chain-finish thread,
-        after the program drains."""
+        DEFERRED — admitted through the service layer or as a chained
+        program: the handle (and this device's in-flight accounting)
+        then retires on the service/chain finish thread, after the
+        program drains. ``sync_express`` marks a synchronous caller
+        running in its own (driver) thread: the service may then grant
+        express admission, running the whole call here — an async
+        submitter (or the shared call worker) must never block through a
+        collective, so only sync driver-thread calls opt in."""
         try:
             for dep in waitfor:
                 dep.wait(self.timeout)
+            if allow_service and self._service_eligible(desc):
+                # The service path runs ENTIRELY outside _exec_mu: the
+                # controller has its own lock, per-comm program order is
+                # fixed by the submitting thread (worker FIFO, or the
+                # driver bypass gated on nothing-of-this-comm-in-flight),
+                # and an express grant may BLOCK this thread until the
+                # collective drains. Holding _exec_mu across that wait
+                # deadlocks multi-tenant worlds: rank A's tenant-X call
+                # holds the device exclusive while waiting on rank B,
+                # whose tenant-X call queues behind rank B's exclusive
+                # held by tenant Y, waiting back on rank A's tenant-Y —
+                # a cycle of the legacy serialization the service layer
+                # exists to break. (Also: plan preparation is
+                # milliseconds for storm-sized programs — off the lock.)
+                comm = self.comms[desc.comm_id]
+                prep = (comm, self._prepare_program(desc, comm))
+                self._try_service(desc, handle, prep, sync_express)
+                return True
             with self._exec_mu:
                 if self._try_chain(desc, handle):
                     return True
-                # a non-chained call must observe every chained
-                # predecessor fully retired (execution serialization and
-                # handle-completion order are the existing contract)
+                # a non-service, non-chained call must observe every
+                # deferred predecessor fully retired (execution
+                # serialization and handle-completion order are the
+                # existing per-comm contract). Data-shaped calls (e.g.
+                # stream-flagged) drain THEIR comm only — a global drain
+                # would park them behind an unrelated tenant's endless
+                # storm; config/reset calls apply to a quiesced device
+                # and keep the conservative full drain.
+                self._drain_service(
+                    None if desc.scenario in (CCLOp.config, CCLOp.nop)
+                    else desc.comm_id)
                 self._drain_chain()
                 self._last_move_stats = None
                 err = self._execute(desc)
@@ -311,6 +482,99 @@ class EmuDevice(Device):
         except Exception as exc:  # noqa: BLE001 — report, don't kill worker
             handle.complete(int(ErrorCode.INVALID_CALL), exception=exc)
         return False
+
+    # -- multi-tenant service admission (accl_tpu/service) -----------------
+    def _service_eligible(self, desc: CallDescriptor) -> bool:
+        """Data calls the admission layer can route: streamed executor,
+        non-stream shape (stream ports are executor-global state — two
+        tenants' concurrent programs would interleave entries), known
+        communicator."""
+        svc = self.service
+        ex = self.executor
+        if svc is None or not (ex.window > 0 and ex.segment_stream):
+            return False
+        if desc.scenario in (CCLOp.config, CCLOp.nop):
+            return False
+        if desc.stream_flags != StreamFlags.NO_STREAM:
+            return False
+        return (self.comms.get(desc.comm_id) is not None
+                and desc.arithcfg is not None)
+
+    def _try_service(self, desc: CallDescriptor, handle: CallHandle,
+                     prep, sync_express: bool = False) -> bool:
+        """Route a data call through the tenant-aware admission layer:
+        the program was prepared by the submitting thread (per-comm
+        program order is fixed by the tenant queue) and is admitted to
+        the streamed executor when the DWRR scheduler grants it —
+        programs of independent communicators drain concurrently;
+        same-comm programs keep the serialize-unless-chained contract.
+        Runs WITHOUT ``_exec_mu`` (see _retire: an express grant blocks
+        this thread until the collective drains, and a device-exclusive
+        hold across that wait deadlocks multi-tenant worlds). The handle
+        completes on the tenant's finish worker (FIFO per tenant), or in
+        this thread on an express grant."""
+        svc = self.service
+        ex = self.executor
+        comm, (moves, skeleton, meta) = prep
+        tenant = self.tenant_of_comm(desc.comm_id)
+        nbytes = desc.count * desc.arithcfg.uncompressed_elem_bytes
+        # admission cost in rx-buffer-sized units: weighted fairness is
+        # byte-weighted, so a 16 MiB storm program spends ~256 units of
+        # deficit where a 4 KiB call spends 1 — the small-call tenant's
+        # queue drains hundreds of calls per storm grant
+        cost = max(1.0, nbytes / max(1, self.ctx.bufsize))
+        # a preempt tenant jumps the queue at ADMISSION and at worker
+        # DISPATCH (executor._pick_prog_locked) — both under the same
+        # knob; nothing is ever preempted mid-move
+        priority = 1 if (svc.config.preempt_admission
+                         and svc.config.spec_of(tenant).preempt) else 0
+
+        # trace tracks carry only EXPLICIT tenant groupings (the per-comm
+        # default would rename every single-app trace's lanes)
+        trace_tenant = self.comm_tenants.get(desc.comm_id, "")
+
+        def admit():
+            return ex.begin_streamed(moves, desc.arithcfg, comm,
+                                     skeleton=skeleton, tenant=tenant,
+                                     priority=priority,
+                                     trace_tenant=trace_tenant)
+
+        def finish(prog, exc):
+            try:
+                if exc is None:
+                    try:
+                        err, stats = ex.finish_streamed(prog)
+                        handle.pipeline_stats = dict(stats, **meta)
+                        handle.complete(err)
+                        return
+                    except Exception as e:  # noqa: BLE001 — surface
+                        exc = e
+                handle.complete(
+                    int(ErrorCode.INVALID_CALL),
+                    exception=exc if isinstance(exc, Exception) else None)
+            finally:
+                self._comm_done(desc.comm_id)
+                self._inflight_done()
+
+        # express only for a synchronous driver-thread caller AND a fully
+        # streamed program: a barrier move would park the admitting
+        # thread mid-feed until the program drains
+        express_ok = sync_express and all(
+            st.eligible or st.fused for st in skeleton.steps)
+        svc.controller.submit(tenant, cost, admit, finish,
+                              comm_id=desc.comm_id, chain=desc.chain,
+                              express_ok=express_ok)
+        return True
+
+    def _drain_service(self, comm_id: int | None = None):
+        """Block until service-admitted programs retired — of ONE comm
+        when given (the per-comm ordering contract's bounded wait), of
+        every tenant otherwise (config/reset quiescence)."""
+        if self.service is not None:
+            if comm_id is None:
+                self.service.controller.drain()
+            else:
+                self.service.controller.drain_comm(comm_id)
 
     # -- cross-call pipelining (chained calls) -----------------------------
     def _try_chain(self, desc: CallDescriptor, handle: CallHandle) -> bool:
@@ -350,7 +614,7 @@ class EmuDevice(Device):
                     "plan_us": 0.0, "plan_cache": "hit"}
             prog = ex.begin_streamed(moves, desc.arithcfg, comm,
                                      skeleton=skeleton)
-            self._chain_q.put((prog, handle, meta))
+            self._chain_q.put((prog, handle, meta, desc.comm_id))
         except BaseException:
             # admission failed (executor closing, ...): the pending slot
             # must be returned or _drain_chain deadlocks the call worker
@@ -368,7 +632,7 @@ class EmuDevice(Device):
             item = self._chain_q.get()
             if item is None:
                 return
-            prog, handle, meta = item
+            prog, handle, meta, comm_id = item
             try:
                 err, stats = self.executor.finish_streamed(prog)
                 handle.pipeline_stats = dict(stats, **meta)
@@ -376,6 +640,7 @@ class EmuDevice(Device):
             except Exception as exc:  # noqa: BLE001 — keep retiring
                 handle.complete(int(ErrorCode.INVALID_CALL), exception=exc)
             finally:
+                self._comm_done(comm_id)
                 self._inflight_done()
                 with self._chain_cv:
                     self._chain_pending -= 1
@@ -419,7 +684,8 @@ class EmuDevice(Device):
             root_src_dst=desc.root_src_dst, func=desc.function,
             tag=desc.tag, bases=(desc.addr_0, desc.addr_1, desc.addr_2),
             compression=desc.compression, stream=desc.stream_flags,
-            algorithm=desc.algorithm)
+            algorithm=desc.algorithm,
+            tenant=self.tenant_of_comm(desc.comm_id))
 
     def _prepare_program(self, desc: CallDescriptor, comm: Communicator):
         """Produce this call's move program through the one shared
@@ -438,7 +704,9 @@ class EmuDevice(Device):
 
     def _execute_data(self, desc: CallDescriptor, comm: Communicator) -> int:
         moves, skeleton, meta = self._prepare_program(desc, comm)
-        err = self.executor.execute(moves, desc.arithcfg, comm,
-                                    skeleton=skeleton)
+        err = self.executor.execute(
+            moves, desc.arithcfg, comm, skeleton=skeleton,
+            tenant=self.tenant_of_comm(desc.comm_id),
+            trace_tenant=self.comm_tenants.get(desc.comm_id, ""))
         self._last_move_stats = dict(self.executor.last_stats, **meta)
         return err

@@ -148,9 +148,11 @@ class SimDevice(Device):
             struct.pack("<2Q", buf.address, buf.nbytes))
         self._land_result(buf, reply)
 
-    def configure_communicator(self, comm: Communicator):
+    def configure_communicator(self, comm: Communicator,
+                               tenant: str | None = None):
         ranks = [(r.global_rank, r.host, r.port) for r in comm.ranks]
-        self._check(P.pack_comm(comm.comm_id, comm.local_rank, ranks))
+        self._check(P.pack_comm(comm.comm_id, comm.local_rank, ranks,
+                                tenant=tenant or ""))
 
     def set_timeout(self, timeout: float):
         self.timeout = timeout

@@ -611,7 +611,10 @@ class TpuDevice(Device):
                 else np.asarray(init, dtype).reshape(shape))
         return jax.device_put(host, self.my_device)
 
-    def configure_communicator(self, comm: Communicator):
+    def configure_communicator(self, comm: Communicator,
+                               tenant: str | None = None):
+        # tenant grouping accepted for interface parity; the TPU tier's
+        # per-tenant scheduling lives in the service layer upstream
         self.comms[comm.comm_id] = comm
         if self.comm is None:
             self.comm = comm

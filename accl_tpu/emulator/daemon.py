@@ -527,6 +527,20 @@ class RankDaemon:
         self.stack = stack
         self.mem = DeviceMemory()
         self.pool = RxBufferPool(nbufs, bufsize)
+        # multi-tenant service attribution: comm -> tenant from the
+        # MSG_CONFIG_COMM tenant field; shared BY REFERENCE with the rx
+        # pool. Per-tenant rx reservations come from $ACCL_TPU_RX_RESERVE
+        # ("tenantA:4,tenantB:2") — daemons have no in-process
+        # ServiceConfig to read, so the knob is environmental.
+        self.comm_tenants: dict[int, str] = {}
+        self.pool.tenant_of = self.comm_tenants
+        self.rx_quota = None
+        reserve = os.environ.get("ACCL_TPU_RX_RESERVE", "")
+        if reserve:
+            from ..service import QuotaManager, parse_reservations
+            self.rx_quota = QuotaManager(nbufs,
+                                         parse_reservations(reserve))
+            self.pool.quota = self.rx_quota
         self.bufsize = bufsize
         self.timeout = 30.0
         self.max_segment_size = bufsize
@@ -639,8 +653,12 @@ class RankDaemon:
         if err:
             # every rejection counts (the LOG below is rate-limited; the
             # collector-folded counter is the accurate total, per
-            # peer/comm — see __init__ for why not a direct registry inc)
-            key = (env.src, env.comm_id)
+            # peer/comm/TENANT — so a noisy neighbor is identifiable
+            # from metrics_text() alone; see __init__ for why not a
+            # direct registry inc)
+            tenant = (self.comm_tenants.get(env.comm_id)
+                      or f"comm-{env.comm_id}")
+            key = (env.src, env.comm_id, tenant)
             self._rejections[key] = self._rejections.get(key, 0) + 1
             # eager-ingress rejection is otherwise invisible until some
             # recv times out much later — say WHICH message died and why
@@ -657,8 +675,9 @@ class RankDaemon:
             suppressed, ent[0], ent[1] = ent[1], now, 0
             log.warning(
                 "rank %d eager ingress: rejected message from rank %d "
-                "(tag=%d seqn=%d comm=%d, %d B): %s%s", self.rank, env.src,
-                env.tag, env.seqn, env.comm_id, P.payload_nbytes(payload),
+                "(tag=%d seqn=%d comm=%d tenant=%s, %d B): %s%s",
+                self.rank, env.src, env.tag, env.seqn, env.comm_id,
+                tenant, P.payload_nbytes(payload),
                 " | ".join(e.name for e in ErrorCode
                            if e.value and err & e.value) or hex(err),
                 f" (+{suppressed} more in the last second)"
@@ -791,9 +810,14 @@ class RankDaemon:
                     bases=bases, compression=compression, stream=stream,
                     algorithm=algorithm,
                     streamed=(self.executor.window > 0
-                              and self.executor.segment_stream))
-            return self.executor.execute(moves, cfg, comm,
-                                         skeleton=skeleton)
+                              and self.executor.segment_stream),
+                    tenant=(self.comm_tenants.get(c["comm_id"])
+                            or f"comm-{c['comm_id']}"))
+            return self.executor.execute(
+                moves, cfg, comm, skeleton=skeleton,
+                tenant=(self.comm_tenants.get(c["comm_id"])
+                        or f"comm-{c['comm_id']}"),
+                trace_tenant=self.comm_tenants.get(c["comm_id"], ""))
         except Exception:  # noqa: BLE001
             log.error("rank %d: call execution failed (scenario=%s "
                       "comm=%s)", self.rank, c.get("scenario"),
@@ -891,6 +915,10 @@ class RankDaemon:
 
     def _soft_reset(self):
         self.pool = RxBufferPool(len(self.pool.bufs), self.bufsize)
+        self.pool.tenant_of = self.comm_tenants
+        if self.rx_quota is not None:
+            self.rx_quota.reset_usage()  # held buffers died with the pool
+            self.pool.quota = self.rx_quota
         self.executor.pool = self.pool
         self.executor.reset_streams()
         for comm in self.comms.values():
@@ -1036,12 +1064,28 @@ class RankDaemon:
             data = self.mem.read(addr, nbytes, np.dtype(np.uint8))
             return P.data_reply(data.tobytes())
         if kind == P.MSG_CONFIG_COMM:
-            comm_id, local_rank, ranks = P.unpack_comm(body[1:])
+            comm_id, local_rank, ranks, tenant = P.unpack_comm(body[1:])
             comm = Communicator(
                 ranks=[Rank(host=h, port=p, global_rank=g)
                        for g, h, p in ranks],
                 local_rank=local_rank, comm_id=comm_id)
             self.comms[comm_id] = comm
+            if tenant:
+                # wire input: the label lands verbatim in Prometheus
+                # label values and rejection-log lines — refuse unsafe
+                # bytes (the client-side ACCL() validation does not
+                # protect the daemon from other clients)
+                from ..service import validate_tenant
+                try:
+                    validate_tenant(tenant)
+                except ValueError:
+                    log.warning(
+                        "rank %d: ignoring invalid tenant label %r on "
+                        "comm %d", self.rank, tenant, comm_id,
+                        extra={"rank": self.rank})
+                    tenant = ""
+            if tenant:
+                self.comm_tenants[comm_id] = tenant
             # reconfiguration invalidates compiled plans (membership /
             # rank numbering is baked into an expansion)
             self.comm_epoch += 1
@@ -1206,9 +1250,18 @@ def _daemon_metrics_rows(d: "RankDaemon"):
     # pool / executor / plan-cache rows: the same mapping the device
     # collector uses (tracing.health_rows), so the tiers cannot drift
     yield from health_rows(d, labels)
-    for (peer, comm_id), n in list(d._rejections.items()):
+    for (peer, comm_id, tenant), n in list(d._rejections.items()):
         yield ("counter", "daemon_ingress_rejected_total",
-               dict(labels, peer=peer, comm_id=comm_id), n)
+               dict(labels, peer=peer, comm_id=comm_id, tenant=tenant), n)
+    if d.rx_quota is not None:
+        for tenant, n in d.rx_quota.in_use().items():
+            yield ("gauge", "rx_pool_tenant_in_use",
+                   dict(labels, tenant=tenant), n)
+        for tenant, n in list(d.rx_quota.rejections.items()):
+            # same family name as the device tier's RankService collector
+            # (docs/OBSERVABILITY.md): one semantic counter, one key
+            yield ("counter", "rx_pool_quota_rejected_total",
+                   dict(labels, tenant=tenant), n)
     yield ("counter", "daemon_profiled_calls_total", labels,
            d.profiled_calls)
 

@@ -132,13 +132,14 @@ class RxBuffer:
     """One spare buffer. Parity: 8-field spare-buffer record with
     IDLE→ENQUEUED→RESERVED→IDLE lifecycle (ccl_offload_control.h:242-270)."""
 
-    __slots__ = ("status", "env", "payload")
+    __slots__ = ("status", "env", "payload", "tenant")
     IDLE, RESERVED = 0, 2
 
     def __init__(self):
         self.status = RxBuffer.IDLE
         self.env: Envelope | None = None
         self.payload: bytes = b""
+        self.tenant: str | None = None  # quota charge to return on release
 
 
 class RxBufferPool:
@@ -161,7 +162,12 @@ class RxBufferPool:
         self.bufs = [RxBuffer() for _ in range(nbufs)]
         self.bufsize = bufsize
         self._cv = threading.Condition()
-        self.error_word = 0
+        self.error_word = 0        # aggregate OR of every latched word
+        # per-communicator latches behind the aggregate: a quota drop on
+        # tenant A's comm must surface in A's recv error word, never ride
+        # into an unrelated tenant's timeout (multi-tenant fault
+        # isolation); consume_error(comm_id) pops one comm's word
+        self._err_by_comm: dict[int, int] = {}
         self.hwm = 0               # occupancy high-water mark (metrics)
         self._idle: list[RxBuffer] = list(self.bufs)
         self._by_key: dict[tuple[int, int, int], list[RxBuffer]] = {}
@@ -170,23 +176,57 @@ class RxBufferPool:
         # pool lock — the executor promotes the matching waiting move to
         # its ready queue instead of parking a thread in seek()
         self.on_ingest = None
+        # release listener (device tier): called AFTER a buffer returns
+        # to the pool, outside the lock — the deferred-delivery ingress
+        # loop retries parked messages the instant a slot frees instead
+        # of on a poll interval (a parked small-tenant message must not
+        # pay milliseconds per retry under another tenant's storm)
+        self.on_release = None
+        # multi-tenant quotas (accl_tpu/service): when a QuotaManager is
+        # installed, every claim charges the message's tenant — reserved
+        # buffers are guaranteed, the rest comes from shared overflow, so
+        # one communicator's storm cannot starve another's recv matching.
+        # ``tenant_of`` maps comm_id -> tenant label (dict-like get).
+        self.quota = None
+        self.tenant_of: dict[int, str] | None = None
 
-    def _claim(self, env: Envelope, payload, keep: int) -> bool:
+    def _tenant(self, comm_id: int) -> str:
+        m = self.tenant_of
+        t = m.get(comm_id) if m is not None else None
+        return t or f"comm-{comm_id}"
+
+    def _latch_locked(self, comm_id: int, err: int):
+        self.error_word |= err
+        self._err_by_comm[comm_id] = \
+            self._err_by_comm.get(comm_id, 0) | err
+
+    def _claim(self, env: Envelope, payload, keep: int) -> int:
         """Claim an IDLE buffer, leaving at least ``keep`` spares; caller
-        holds ``self._cv``. The one shared copy of the buffer-claim
-        protocol (status transition, assignment, indexing, wakeup)."""
+        holds ``self._cv``. Returns 1 on success, 0 when the pool is
+        physically full, -1 when the message's TENANT quota denied the
+        claim (typed backpressure — the blocking path waits for the
+        tenant's own usage to drop, and a timeout latches the quota error
+        word instead of the generic overflow). The one shared copy of the
+        buffer-claim protocol (status transition, assignment, indexing,
+        wakeup)."""
         if len(self._idle) <= keep:
-            return False
+            return 0
+        tenant = None
+        if self.quota is not None:
+            tenant = self._tenant(env.comm_id)
+            if not self.quota.try_acquire(tenant):
+                return -1
         b = self._idle.pop()
         b.status = RxBuffer.RESERVED
         b.env, b.payload = env, payload
+        b.tenant = tenant
         occ = len(self.bufs) - len(self._idle)
         if occ > self.hwm:
             self.hwm = occ
         self._by_key.setdefault((env.src, env.comm_id, env.seqn),
                                 []).append(b)
         self._cv.notify_all()
-        return True
+        return 1
 
     def ingest(self, env: Envelope, payload, timeout: float = 10.0) -> int:
         """Accept a message into a spare buffer.
@@ -200,18 +240,29 @@ class RxBufferPool:
         deadline = time.monotonic() + timeout
         with self._cv:
             if payload_nbytes(payload) > self.bufsize:
-                self.error_word |= int(ErrorCode.DMA_SIZE_ERROR)
+                self._latch_locked(env.comm_id,
+                                   int(ErrorCode.DMA_SIZE_ERROR))
                 return int(ErrorCode.DMA_SIZE_ERROR)
             while True:
-                if self._claim(env, payload, keep=0):
+                got = self._claim(env, payload, keep=0)
+                if got > 0:
                     err = 0
                     break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cv.wait(remaining):
-                    self.error_word |= int(
-                        ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
-                    return int(
-                        ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+                    if got < 0:
+                        # the TENANT's quota never freed: typed
+                        # backpressure error, counted per tenant — a
+                        # noisy neighbor is identifiable from metrics
+                        # alone, and the victim comm's recv never sees it
+                        err = int(ErrorCode.TENANT_QUOTA_EXCEEDED)
+                        self.quota.note_rejection(
+                            self._tenant(env.comm_id))
+                    else:
+                        err = int(
+                            ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+                    self._latch_locked(env.comm_id, err)
+                    return err
         if _TRACE.enabled:
             _TRACE.emit("ingest", rank=env.dst, seqn=env.seqn, peer=env.src,
                         nbytes=env.nbytes)
@@ -228,9 +279,10 @@ class RxBufferPool:
         ``ingest``."""
         with self._cv:
             if payload_nbytes(payload) > self.bufsize:
-                self.error_word |= int(ErrorCode.DMA_SIZE_ERROR)
+                self._latch_locked(env.comm_id,
+                                   int(ErrorCode.DMA_SIZE_ERROR))
                 return True  # consumed (dropped) — retrying cannot help
-            claimed = self._claim(env, payload, keep=1)
+            claimed = self._claim(env, payload, keep=1) > 0
         if claimed:
             if _TRACE.enabled:
                 _TRACE.emit("ingest", rank=env.dst, seqn=env.seqn,
@@ -239,12 +291,64 @@ class RxBufferPool:
                 self.on_ingest((env.src, env.comm_id, env.seqn))
         return claimed
 
-    def consume_error(self) -> int:
-        """Return and clear the latched ingress error word — the bridge
-        that carries an eager-ingress failure (oversize drop, overflow)
-        into the error word of the call whose receive it starved."""
+    def ingest_nowait(self, env: Envelope, payload) -> int:
+        """Single non-blocking ingest attempt for a deferred-delivery
+        loop (the device tier's ingress thread): 1 = consumed (claimed,
+        or oversize → latched drop: retrying cannot help), 0 = pool
+        physically full, -1 = the message's tenant quota denied the
+        claim. Unlike ``try_ingest`` this may take the LAST spare — the
+        caller IS the deferred path the spare is kept for."""
         with self._cv:
-            err, self.error_word = self.error_word, 0
+            if payload_nbytes(payload) > self.bufsize:
+                self._latch_locked(env.comm_id,
+                                   int(ErrorCode.DMA_SIZE_ERROR))
+                return 1
+            got = self._claim(env, payload, keep=0)
+        if got > 0:
+            if _TRACE.enabled:
+                _TRACE.emit("ingest", rank=env.dst, seqn=env.seqn,
+                            peer=env.src, nbytes=env.nbytes)
+            if self.on_ingest is not None:
+                self.on_ingest((env.src, env.comm_id, env.seqn))
+            return 1
+        return got
+
+    def latch_ingest_drop(self, env: Envelope, quota_denied: bool) -> int:
+        """Latch the typed error for a deferred message finally dropped
+        (deadline expired with the pool still full / the tenant still
+        over quota) — the deferred-path mirror of blocking ``ingest``'s
+        timeout arm, same error words, same per-tenant rejection count."""
+        if quota_denied and self.quota is not None:
+            err = int(ErrorCode.TENANT_QUOTA_EXCEEDED)
+            self.quota.note_rejection(self._tenant(env.comm_id))
+        else:
+            err = int(ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+        with self._cv:
+            self._latch_locked(env.comm_id, err)
+        return err
+
+    def consume_error(self, comm_id: int | None = None) -> int:
+        """Return and clear the latched ingress error word — the bridge
+        that carries an eager-ingress failure (oversize drop, overflow,
+        tenant-quota rejection) into the error word of the call whose
+        receive it starved. With ``comm_id`` only THAT communicator's
+        latch is consumed (multi-tenant isolation: one tenant's quota
+        drop must never surface in another tenant's timeout) — plus the
+        UNSCOPED bucket (envelopes carrying the default comm_id 0, which
+        no real communicator owns: real comm ids are membership CRCs);
+        without it, every latch is consumed (legacy aggregate)."""
+        with self._cv:
+            if comm_id is None:
+                err, self.error_word = self.error_word, 0
+                self._err_by_comm.clear()
+                return err
+            err = self._err_by_comm.pop(comm_id, 0)
+            if comm_id != 0:
+                err |= self._err_by_comm.pop(0, 0)
+            agg = 0
+            for v in self._err_by_comm.values():
+                agg |= v
+            self.error_word = agg
             return err
 
     def _match(self, src: int, tag: int, seqn: int,
@@ -294,12 +398,18 @@ class RxBufferPool:
                         del self._by_key[key]
                     b.status = RxBuffer.IDLE          # release back to pool
                     b.env, b.payload = None, b""
+                    if b.tenant is not None and self.quota is not None:
+                        self.quota.release(b.tenant)
+                    b.tenant = None
                     self._idle.append(b)
                     self._cv.notify_all()  # wake senders blocked on overflow
-                    return env, payload
+                    break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0 or not self._cv.wait(remaining):
                     return None
+        if self.on_release is not None:  # outside the lock (it enqueues)
+            self.on_release()
+        return env, payload
 
     def occupancy(self) -> int:
         with self._cv:
@@ -350,26 +460,46 @@ class _ScratchArena:
         self._free: list[np.ndarray] = []
         self._slots = slots
         self._total = 0
+        # multi-tenant quota (accl_tpu/service): when installed, each
+        # held slot charges its program's tenant — an over-quota tenant
+        # falls back to plain allocation (the arena is an optimization,
+        # so "quota exceeded" costs a malloc, never correctness), which
+        # keeps a storm tenant from monopolizing every scratch slot
+        self.quota = None
 
-    def acquire(self, nbytes: int) -> np.ndarray | None:
+    def acquire(self, nbytes: int, tenant: str = "") -> np.ndarray | None:
+        if self.quota is not None and tenant \
+                and not self.quota.try_acquire(tenant):
+            # over quota: caller allocates fresh. Unlike the rx pool
+            # there is no backpressure retry — the denial IS the final
+            # outcome, so it counts (arena_quota_rejected_total)
+            self.quota.note_rejection(tenant)
+            return None
         with self._lock:
+            got = None
             for i, buf in enumerate(self._free):
                 if buf.nbytes >= nbytes:
-                    return self._free.pop(i)
-            if self._total >= self._slots:
-                # drop one undersized free buffer so the arena can adapt
-                # when segment sizes grow mid-process
-                if self._free:
-                    self._free.pop(0)
-                    self._total -= 1
-                else:
-                    return None
-            self._total += 1
-        return np.empty(max(nbytes, 4096), np.uint8)
+                    got = self._free.pop(i)
+                    break
+            if got is None:
+                if self._total >= self._slots:
+                    # drop one undersized free buffer so the arena can
+                    # adapt when segment sizes grow mid-process
+                    if self._free:
+                        self._free.pop(0)
+                        self._total -= 1
+                if self._total < self._slots:
+                    self._total += 1
+                    got = np.empty(max(nbytes, 4096), np.uint8)
+        if got is None and self.quota is not None and tenant:
+            self.quota.release(tenant)  # charged but no slot available
+        return got
 
-    def release(self, buf: np.ndarray):
+    def release(self, buf: np.ndarray, tenant: str = ""):
         with self._lock:
             self._free.append(buf)
+        if self.quota is not None and tenant:
+            self.quota.release(tenant)
 
 
 # _MovePlan.state lifecycle (segment-streamed engine)
@@ -415,11 +545,18 @@ class _Prog:
     __slots__ = ("cfg", "comm", "waiting", "ready", "outstanding",
                  "running", "err", "aborted", "pipelined", "max_depth",
                  "combining", "max_combining", "lanes", "nmoves", "exc",
-                 "call_seq")
+                 "call_seq", "tenant", "priority", "trace_tenant")
 
-    def __init__(self, cfg, comm):
+    def __init__(self, cfg, comm, tenant: str = "", priority: int = 0,
+                 trace_tenant: str | None = None):
         self.cfg = cfg
         self.comm = comm
+        self.tenant = tenant          # service attribution (quotas/sched)
+        # trace track prefix: only EXPLICIT tenant groupings rename the
+        # Perfetto tracks — the per-comm default label would turn every
+        # single-app trace's "lane N" into "comm-<crc> lane N"
+        self.trace_tenant = tenant if trace_tenant is None else trace_tenant
+        self.priority = priority      # >0: preempt tenant, dispatch first
         self.call_seq = 0             # flight-recorder call id (0: unarmed)
         self.waiting: dict = {}       # (src, comm_id, seqn) -> _MovePlan
         self.ready: list = []         # FIFO of runnable _MovePlans
@@ -699,15 +836,14 @@ class MoveExecutor:
         # measurable thundering herd at segment granularity).
         self._sched_lock = threading.Lock()
         self._work_cv = threading.Condition(self._sched_lock)
-        # active streamed programs, admission order. More than one is live
-        # only during cross-call pipelining (a chained call admitted while
-        # its predecessor drains); admission and finish keep the list
-        # consistent under _sched_lock.
+        # active streamed programs, admission order. More than one is
+        # live during cross-call pipelining (a chained call admitted
+        # while its predecessor drains) and under the multi-tenant
+        # service (programs of DISTINCT communicators run concurrently —
+        # they share no lanes, RX keys or egress domains); admission and
+        # finish keep the list consistent under _sched_lock.
         self._progs: list[_Prog] = []
-        # comms of finished programs whose egress resync is deferred until
-        # the executor goes idle (resyncing while a later chained program
-        # is active would skip its un-emitted frames)
-        self._pending_resync: list[Communicator] = []
+        self._disp_last = ""     # worker-dispatch tenant RR cursor
         self._stream_workers_started = False
         self._arena = _ScratchArena(slots=self._n_workers + 4)
         self._eg_lock = threading.Lock()
@@ -715,6 +851,11 @@ class MoveExecutor:
         #                          flusher_busy]
         self._egress: dict[tuple[int, int], list] = {}
         self._eg_busy = 0        # egress flush loops currently running
+        # per-communicator flush-loop counts: a program's barrier waits
+        # for ITS comm's wire to catch up — under the multi-tenant
+        # service, gating on the global count would park a small tenant's
+        # barrier behind another tenant's storm flusher indefinitely
+        self._eg_busy_comm: dict[int, int] = {}
         self.flush_fn = None     # optional fabric flush hook (coalescing)
         self.pool = pool         # property: wires the arrival listener
         # per-execute pipeline counters (tracing/CallRecord plumbing)
@@ -863,12 +1004,14 @@ class MoveExecutor:
                                  max(0.0, deadline - time.monotonic()),
                                  comm_id=comm.comm_id)
             if got is None:
-                # a latched ingress error (oversize drop, pool overflow)
-                # is usually WHY the matching message never arrived —
-                # surface it alongside the timeout so the caller's error
-                # word tells the real story
+                # a latched ingress error (oversize drop, pool overflow,
+                # tenant-quota rejection) is usually WHY the matching
+                # message never arrived — surface it alongside the
+                # timeout. Scoped to THIS call's communicator so another
+                # tenant's latched failure never rides into this error
+                # word (multi-tenant fault isolation).
                 return None, (int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
-                              | self.pool.consume_error())
+                              | self.pool.consume_error(comm.comm_id))
             env, payload = got
             if rx_seqn is None:
                 rank.inbound_seq += 1  # exchange-mem seq update parity
@@ -883,7 +1026,7 @@ class MoveExecutor:
                      comm: Communicator, *, zero_copy: bool = False,
                      tx_seqn: int | None = None, release=None,
                      streamed: bool = False, immutable_src: bool = False,
-                     call_seq: int = 0):
+                     call_seq: int = 0, tenant: str = ""):
         """``tx_seqn`` carries a seqn the streamed planner pre-assigned
         (live counter already advanced at plan time); ``streamed`` routes
         the frame through the per-peer egress reorder stage; ``release``
@@ -934,7 +1077,7 @@ class MoveExecutor:
         lane = -1 if move.lane is None else move.lane
         if streamed and not move.remote_stream:
             self._egress_emit((rank.global_rank, comm.comm_id), seqn, env,
-                              payload, release, lane, call_seq)
+                              payload, release, lane, call_seq, tenant)
             return
         try:
             t0 = time.monotonic_ns() if _TRACE.enabled else 0
@@ -945,7 +1088,8 @@ class MoveExecutor:
                 _TRACE.emit("egress", rank=env.src, call_seq=call_seq,
                             lane=lane, seqn=seqn, peer=env.dst,
                             nbytes=env.nbytes, t_ns=t0,
-                            dur_ns=time.monotonic_ns() - t0)
+                            dur_ns=time.monotonic_ns() - t0,
+                            tenant=tenant)
         finally:
             if release is not None:
                 release()
@@ -973,6 +1117,8 @@ class MoveExecutor:
         # flight recorder: label fields computed once per move when armed
         # (the disarmed cost of this whole block is one attribute test)
         tr = _TRACE.enabled
+        _ten = prog.tenant if prog is not None else ""       # quota charge
+        _tten = prog.trace_tenant if prog is not None else ""  # trace label
         if tr:
             _cs = prog.call_seq if prog is not None else 0
             _lane = -1 if mv.lane is None else mv.lane
@@ -995,7 +1141,7 @@ class MoveExecutor:
                         step=_step, seqn=-1 if rx is None else rx,
                         peer=comm.ranks[op.src_rank].global_rank,
                         nbytes=_nb, t_ns=t_f0,
-                        dur_ns=time.monotonic_ns() - t_f0)
+                        dur_ns=time.monotonic_ns() - t_f0, tenant=_tten)
         if e0 or e1:
             return e0 | e1
         release = None
@@ -1014,11 +1160,12 @@ class MoveExecutor:
                     # non-owning view, costing MORE than the allocation
                     # the arena saves — a fresh result emits zero-copy.
                     u = cfg.uncompressed_dtype
-                    slot = self._arena.acquire(mv.count * u.itemsize)
+                    slot = self._arena.acquire(mv.count * u.itemsize,
+                                               tenant=_ten)
                     if slot is not None:
                         out = slot[:mv.count * u.itemsize].view(u)
-                        release = (lambda a=self._arena, b=slot:
-                                   a.release(b))
+                        release = (lambda a=self._arena, b=slot,
+                                   t=_ten: a.release(b, tenant=t))
                 if prog is not None:
                     # unsynchronized stat counters: a torn read can only
                     # under-report the peak by one — not worth a lock
@@ -1036,7 +1183,8 @@ class MoveExecutor:
                         _TRACE.emit("combine", rank=_rank, call_seq=_cs,
                                     lane=_lane, step=_step, nbytes=_nb,
                                     t_ns=t_c0,
-                                    dur_ns=time.monotonic_ns() - t_c0)
+                                    dur_ns=time.monotonic_ns() - t_c0,
+                                    tenant=_tten)
                 finally:
                     if prog is not None:
                         prog.combining -= 1
@@ -1073,7 +1221,7 @@ class MoveExecutor:
                     mv, result, cfg, comm, zero_copy=pipelined,
                     tx_seqn=plan.tx if plan is not None else None,
                     release=release, streamed=prog is not None,
-                    call_seq=_cs if tr else 0)
+                    call_seq=_cs if tr else 0, tenant=_tten)
                 if tr:
                     _TRACE.emit("relay", rank=_rank, call_seq=_cs,
                                 lane=_lane, step=_step,
@@ -1081,7 +1229,8 @@ class MoveExecutor:
                                 else plan.tx,
                                 peer=comm.ranks[mv.dst_rank].global_rank,
                                 nbytes=_nb, t_ns=t_r0,
-                                dur_ns=time.monotonic_ns() - t_r0)
+                                dur_ns=time.monotonic_ns() - t_r0,
+                                tenant=_tten)
                 release = None  # ownership passed to emission/egress
             if plan is not None and plan.fuse is not None:
                 # cut-through relay: forward the just-received bytes
@@ -1092,7 +1241,8 @@ class MoveExecutor:
                 self._emit_remote(
                     plan.fuse.mv, result, cfg, comm, zero_copy=True,
                     tx_seqn=plan.fuse.tx, streamed=prog is not None,
-                    immutable_src=True, call_seq=_cs if tr else 0)
+                    immutable_src=True, call_seq=_cs if tr else 0,
+                    tenant=_tten)
                 if tr:
                     fmv = plan.fuse.mv
                     _TRACE.emit(
@@ -1102,7 +1252,7 @@ class MoveExecutor:
                         seqn=-1 if plan.fuse.tx is None else plan.fuse.tx,
                         peer=comm.ranks[fmv.dst_rank].global_rank,
                         nbytes=_nb, t_ns=t_r0,
-                        dur_ns=time.monotonic_ns() - t_r0)
+                        dur_ns=time.monotonic_ns() - t_r0, tenant=_tten)
             return 0
         finally:
             if release is not None:
@@ -1195,15 +1345,20 @@ class MoveExecutor:
         skeleton derivation shares the engine's own predicate)."""
         return _move_stream_eligible(mv)
 
-    def _instantiate_locked(self, skeleton: PlanSkeleton, moves: list[Move],
-                            comm: Communicator) -> list[_MovePlan]:
-        """Bind one skeleton to the live communicator: rebase every seqn
-        delta onto the current per-peer counters (advancing them to their
-        final values — matching is exact-key, so segments may then be
-        CONSUMED out of order) and build fresh per-execution _MovePlan
-        state. Caller holds ``_sched_lock`` — counter advance, egress sync
-        and program registration must be atomic against a concurrent
-        finish of an earlier chained program."""
+    def _register_locked(self, skeleton: PlanSkeleton, comm: Communicator,
+                         prog: _Prog) -> tuple[dict, dict]:
+        """The LOCKED half of binding a skeleton to the live
+        communicator: sync egress expectations, advance the per-peer seqn
+        counters to their final values (matching is exact-key, so
+        segments may then be CONSUMED out of order), and register the
+        program — these three must be atomic against a concurrent finish
+        of an earlier chained program (its comm-idle egress resync must
+        either see this program registered or none of its counter
+        advances). Returns the (base_in, base_out) counter snapshots;
+        the O(moves) ``_build_entries`` construction happens OUTSIDE the
+        scheduler lock — a storm-sized program held it for tens of
+        milliseconds here, stalling every other tenant's dispatch and
+        ingest promotion. Caller holds ``_sched_lock``."""
         if not any(p.comm.comm_id == comm.comm_id for p in self._progs):
             with self._eg_lock:
                 # (re)sync next-emit to the live counters — not
@@ -1218,12 +1373,13 @@ class MoveExecutor:
                     key = (r.global_rank, comm.comm_id)
                     old = self._egress.get(key)
                     if old is not None:
-                        # an aborted predecessor whose deferred resync
-                        # never ran (another comm kept the executor
-                        # busy) may have parked frames here — their
-                        # release() callbacks pin arena slots and must
-                        # fire before the entry is replaced
-                        for _env, _payload, release, _l, _c \
+                        # belt-and-suspenders: finish_streamed resyncs a
+                        # comm the moment its last program retires, but
+                        # an entry replaced here may still hold parked
+                        # frames from an aborted epoch — their release()
+                        # callbacks pin arena slots and must fire before
+                        # the entry is replaced
+                        for _env, _payload, release, _l, _c, _t \
                                 in old[1].values():
                             if release is not None:
                                 release()
@@ -1238,6 +1394,15 @@ class MoveExecutor:
             rk = comm.ranks[local]
             base_out[local] = rk.outbound_seq
             rk.outbound_seq += n
+        self._progs.append(prog)
+        return base_in, base_out
+
+    @staticmethod
+    def _build_entries(skeleton: PlanSkeleton, moves: list[Move],
+                       comm: Communicator, base_in: dict,
+                       base_out: dict) -> list[_MovePlan]:
+        """The UNLOCKED half: pure per-move ``_MovePlan`` construction
+        from the counter snapshots ``_register_locked`` took."""
         entries: list[_MovePlan] = []
         for i, mv in enumerate(moves):
             st = skeleton.steps[i]
@@ -1281,7 +1446,7 @@ class MoveExecutor:
     def _stream_worker_loop(self):
         while True:
             with self._sched_lock:
-                while not self._closed and self._pick_prog_locked() is None:
+                while not self._closed and not self._has_ready_locked():
                     self._work_cv.wait()
                 if self._closed:
                     return
@@ -1289,12 +1454,35 @@ class MoveExecutor:
                 task = self._pop_task_locked(prog)
             self._run_task(prog, task)
 
+    def _has_ready_locked(self) -> bool:
+        return any(p.ready for p in self._progs)
+
     def _pick_prog_locked(self) -> _Prog | None:
-        """Earliest active program with runnable work (admission order —
-        draining the predecessor first keeps chained programs' wire
-        emission flowing)."""
+        """Next program to hand a worker to. Preempt-priority programs
+        (latency-critical tenants, admission.TenantSpec.preempt) always
+        win; the rest ROUND-ROBIN across tenants, admission order within
+        a tenant (draining a chained predecessor first keeps its wire
+        emission flowing). Plain admission order across tenants would
+        end QoS at the admission decision: a long storm program, once
+        admitted, would hold every worker while it has ready segments,
+        and a later tenant's one-segment call would wait out the whole
+        storm — dispatch is where the share is actually paid out."""
         for p in self._progs:
-            if p.ready:
+            if p.ready and p.priority > 0:
+                return p
+        tenants: list[str] = []
+        for p in self._progs:
+            if p.ready and p.tenant not in tenants:
+                tenants.append(p.tenant)
+        if not tenants:
+            return None
+        if self._disp_last in tenants:
+            t = tenants[(tenants.index(self._disp_last) + 1) % len(tenants)]
+        else:
+            t = tenants[0]
+        self._disp_last = t
+        for p in self._progs:
+            if p.ready and p.tenant == t:
                 return p
         return None
 
@@ -1312,7 +1500,21 @@ class MoveExecutor:
         pool and the scheduler thread itself (which executes ready moves
         while it waits for quiescence: on a small host the extra thread
         handoff per segment costs more than it buys, and the combine
-        workers are pure ADDITIONAL lanes, not the only lanes)."""
+        workers are pure ADDITIONAL lanes, not the only lanes). While a
+        PRIORITY program's task runs, the thread is marked so the ingest
+        cut-through won't splice another tenant's (storm-sized) move
+        into its critical path — measured: a preempt call's 2 KiB relay
+        grew a 14 ms tail executing a 256 KiB storm segment inline."""
+        if prog.priority > 0:
+            _INLINE.prio = getattr(_INLINE, "prio", 0) + 1
+            try:
+                self._run_task_inner(prog, task)
+            finally:
+                _INLINE.prio -= 1
+            return
+        self._run_task_inner(prog, task)
+
+    def _run_task_inner(self, prog: _Prog, task: _MovePlan):
         err = 0
         if not prog.aborted:
             try:
@@ -1409,7 +1611,9 @@ class MoveExecutor:
                 task.state = _ST_READY
                 prog.ready.append(task)
                 if (self.ingest_inline
-                        and getattr(_INLINE, "depth", 0) < _INLINE_CAP):
+                        and getattr(_INLINE, "depth", 0) < _INLINE_CAP
+                        and (prog.priority > 0
+                             or not getattr(_INLINE, "prio", 0))):
                     # cut-through: execute a ready task (FIFO head — any
                     # ready task keeps the pipe moving) in THIS thread
                     # instead of paying a worker wakeup per hop. The pool
@@ -1472,13 +1676,29 @@ class MoveExecutor:
             run_prog = None
             deadline_abort = False
             with self._sched_lock:
-                run_prog = self._pick_prog_locked()
+                # own quiescence FIRST: under the multi-tenant service
+                # another tenant's storm always has ready work, and the
+                # old help-first order kept this thread running storm
+                # segments long after its own program drained — the
+                # caller's handle completion (a sync small call's
+                # latency!) was held hostage to a gap in the storm
+                if (prog.outstanding == 0
+                        and self._eg_busy_comm.get(
+                            prog.comm.comm_id, 0) == 0):
+                    return
+                if prog.priority > 0:
+                    # a preempt program's driving thread is its express
+                    # lane: it runs ONLY its own tasks and otherwise
+                    # parks on the cv — helping another tenant could
+                    # trap it in a storm-length flush chain exactly when
+                    # its own one-segment move becomes ready
+                    run_prog = prog if prog.ready else None
+                else:
+                    run_prog = self._pick_prog_locked()
                 if run_prog is not None:
                     # help ANY active program — draining an earlier
                     # chained program is what unblocks this one's wire
                     task = self._pop_task_locked(run_prog)
-                elif prog.outstanding == 0 and self._eg_busy == 0:
-                    return
                 else:
                     now = time.monotonic()
                     nearest = None
@@ -1496,7 +1716,8 @@ class MoveExecutor:
                     if expired is not None:
                         exp_prog.err |= (
                             int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
-                            | self._pool.consume_error())
+                            | self._pool.consume_error(
+                                exp_prog.comm.comm_id))
                         self._abort_locked(exp_prog)
                         deadline_abort = True  # dump outside the lock
                     else:
@@ -1512,7 +1733,8 @@ class MoveExecutor:
 
     # -- egress reorder stage ----------------------------------------------
     def _egress_emit(self, key: tuple[int, int], seqn: int, env: Envelope,
-                     payload, release, lane: int = -1, call_seq: int = 0):
+                     payload, release, lane: int = -1, call_seq: int = 0,
+                     tenant: str = ""):
         """Deposit a frame; whichever thread supplies the next-expected
         seqn becomes the flusher and drains the available prefix. No
         thread ever WAITS for a peer's turn — out-of-order frames park,
@@ -1522,14 +1744,17 @@ class MoveExecutor:
         st = self._egress[key]
         with self._eg_lock:
             if st[0] != seqn or st[2]:
-                st[1][seqn] = (env, payload, release, lane, call_seq)
+                st[1][seqn] = (env, payload, release, lane, call_seq,
+                               tenant)
                 return  # not our turn, or a flusher is already draining
             st[2] = True  # our frame IS next: flush without parking it
             self._eg_busy += 1
-        item = (env, payload, release, lane, call_seq)
+            self._eg_busy_comm[key[1]] = \
+                self._eg_busy_comm.get(key[1], 0) + 1
+        item = (env, payload, release, lane, call_seq, tenant)
         sent = 0
         while True:
-            env, payload, release, lane, call_seq = item
+            env, payload, release, lane, call_seq, tenant = item
             try:
                 t0 = time.monotonic_ns() if _TRACE.enabled else 0
                 self._send(env, payload)
@@ -1539,16 +1764,20 @@ class MoveExecutor:
                     _TRACE.emit("egress", rank=env.src, call_seq=call_seq,
                                 lane=lane, seqn=env.seqn, peer=env.dst,
                                 nbytes=env.nbytes, t_ns=t0,
-                                dur_ns=time.monotonic_ns() - t0)
+                                dur_ns=time.monotonic_ns() - t0,
+                                tenant=tenant)
             except Exception:  # noqa: BLE001 — a fabric failure mid-flush
                 # must not abandon the flusher role (egress would wedge);
-                # latch into the running program and keep draining
+                # latch into the owning COMM's programs and keep draining
+                # (multi-tenant fault isolation: another tenant's healthy
+                # program on an unrelated comm must not see this error)
                 log.error("rank %s: egress flush to rank %s failed",
                           self.owner_rank, env.dst, exc_info=True,
                           extra={"rank": self.owner_rank})
                 with self._sched_lock:
                     for p in self._progs:
-                        p.err |= int(ErrorCode.DMA_TRANSACTION_ERROR)
+                        if p.comm.comm_id == key[1]:
+                            p.err |= int(ErrorCode.DMA_TRANSACTION_ERROR)
             finally:
                 if release is not None:
                     release()
@@ -1558,7 +1787,12 @@ class MoveExecutor:
                 if item is None:
                     st[2] = False
                     self._eg_busy -= 1
-                    idle = self._eg_busy == 0
+                    n = self._eg_busy_comm.get(key[1], 1) - 1
+                    if n > 0:
+                        self._eg_busy_comm[key[1]] = n
+                    else:
+                        self._eg_busy_comm.pop(key[1], None)
+                    idle = n <= 0  # this COMM's wire caught up
                     break
         if sent and self.flush_fn is not None:
             self.flush_fn(key[0])
@@ -1578,7 +1812,7 @@ class MoveExecutor:
                 st = self._egress.get((r.global_rank, comm.comm_id))
                 if st is None:
                     continue
-                for _env, _payload, release, _l, _c in st[1].values():
+                for _env, _payload, release, _l, _c, _t in st[1].values():
                     if release is not None:
                         release()
                 st[1].clear()
@@ -1586,23 +1820,30 @@ class MoveExecutor:
 
     def begin_streamed(self, moves: list[Move], cfg: ArithConfig,
                        comm: Communicator,
-                       skeleton: PlanSkeleton | None = None) -> _Prog:
+                       skeleton: PlanSkeleton | None = None,
+                       tenant: str = "", priority: int = 0,
+                       trace_tenant: str | None = None) -> _Prog:
         """Admit one program into the segment pipeline: instantiate the
         plan (``skeleton`` may come from a compiled-plan cache — derived
         fresh otherwise), register every eligible move, and execute
         barriers inline. Returns once the whole program has been FED;
         in-flight segments keep draining until :meth:`finish_streamed`.
+        ``tenant`` attributes the program for trace/quota purposes.
 
         Cross-call pipelining: a second program may be admitted while the
-        previous one drains (the chained-call path). Admissions must come
-        from ONE thread (the device's call worker) in program order — the
-        per-peer seqn pre-assignment and the egress ordering domain extend
-        across the calls, so per-peer wire emission stays in global
-        program order."""
+        previous one drains (the chained-call path). Per COMMUNICATOR,
+        admissions must come from one thread in program order — the
+        per-peer seqn pre-assignment and the egress ordering domain
+        extend across the calls, so per-peer wire emission stays in
+        global program order. Programs on DISTINCT communicators may be
+        admitted concurrently from different threads (the multi-tenant
+        service does): they share no seqn counters, RX match keys or
+        egress domains, so the per-comm ordering argument is unaffected
+        — every shared structure below is touched under ``_sched_lock``."""
         self._ensure_stream_workers()
         if skeleton is None:
             skeleton = plan_skeleton(moves)
-        prog = _Prog(cfg, comm)
+        prog = _Prog(cfg, comm, tenant, priority, trace_tenant)
         prog.nmoves = len(moves)
         prog.lanes = skeleton.nlanes
         if _TRACE.enabled:
@@ -1610,8 +1851,9 @@ class MoveExecutor:
         with self._sched_lock:
             if self._closed:
                 raise RuntimeError("executor closed")
-            entries = self._instantiate_locked(skeleton, moves, comm)
-            self._progs.append(prog)
+            base_in, base_out = self._register_locked(skeleton, comm, prog)
+        entries = self._build_entries(skeleton, moves, comm,
+                                      base_in, base_out)
         try:
             for e in entries:
                 if e.fused:
@@ -1652,14 +1894,30 @@ class MoveExecutor:
                 prog.err |= int(ErrorCode.INVALID_CALL)
                 prog.exc = exc
                 self._abort_locked(prog)
+        if prog.priority > 0 and not prog.err:
+            # express lane, part 2: run the program's already-runnable
+            # moves (kickoff sends) in the admitting thread — zero
+            # handoffs to the first wire byte; the replies then ride the
+            # ingest cut-through, so a small preempt call never waits
+            # for a worker that may be deep in another tenant's storm
+            while True:
+                with self._sched_lock:
+                    if prog.aborted or not prog.ready:
+                        break
+                    task = self._pop_task_locked(prog)
+                self._run_task(prog, task)
         return prog
 
     def finish_streamed(self, prog: _Prog) -> tuple[int, dict]:
         """Drain one admitted program to quiescence and retire it:
-        returns (error word, pipeline stats). A nonzero error word poisons
-        every program admitted after this one (chain semantics — a failed
-        link aborts its successors, mirroring ``waitfor`` propagation) and
-        the deferred egress resyncs run once the executor is idle."""
+        returns (error word, pipeline stats). A nonzero error word
+        poisons every program of the SAME communicator admitted after
+        this one (chain semantics — a failed link aborts its successors,
+        mirroring ``waitfor`` propagation) and ONLY those: programs on
+        other communicators share no lanes, RX keys or egress domains
+        with the failed one, so a tenant's error latch never crosses the
+        comm boundary (multi-tenant fault isolation). The comm's egress
+        resync runs the moment its last program retires."""
         err = 0
         try:
             self._wait_quiesce(prog)
@@ -1673,25 +1931,22 @@ class MoveExecutor:
                     self._progs.remove(prog)
                 if err:
                     for p in self._progs:
-                        p.err |= err
-                        self._abort_locked(p)
-                if not any(c.comm_id == prog.comm.comm_id
-                           for c in self._pending_resync):
-                    # dedupe: sustained chaining can keep the executor
-                    # non-idle for millions of calls — one pending entry
-                    # per comm is all the idle-time resync needs
-                    self._pending_resync.append(prog.comm)
-                if not self._progs:
-                    # idle: fast-forward egress past any seqns burned by
-                    # aborted programs (parked frames drop; receivers
-                    # surface timeouts, like never-issued window sends).
-                    # Deferred until idle so an active chained successor's
-                    # un-emitted frames are never skipped. _eg_lock nests
-                    # under _sched_lock here; no path takes them in the
-                    # reverse order while holding _eg_lock.
-                    for c in self._pending_resync:
-                        self._egress_resync(c)
-                    self._pending_resync.clear()
+                        if p.comm.comm_id == prog.comm.comm_id:
+                            p.err |= err
+                            self._abort_locked(p)
+                if not any(p.comm.comm_id == prog.comm.comm_id
+                           for p in self._progs):
+                    # the comm went idle: fast-forward its egress past
+                    # any seqns burned by aborted programs (parked frames
+                    # drop; receivers surface timeouts, like never-issued
+                    # window sends). Per-comm egress domains make this
+                    # safe while OTHER comms' programs stay active —
+                    # deferring only while a same-comm chained successor
+                    # holds un-emitted frames below the counters.
+                    # _eg_lock nests under _sched_lock here; no path
+                    # takes them in the reverse order while holding
+                    # _eg_lock.
+                    self._egress_resync(prog.comm)
             stats = dict(_EMPTY_STATS, moves=prog.nmoves,
                          pipelined=prog.pipelined,
                          max_inflight=prog.max_depth,
@@ -1728,10 +1983,13 @@ class MoveExecutor:
 
     def execute_streamed(self, moves: list[Move], cfg: ArithConfig,
                          comm: Communicator,
-                         skeleton: PlanSkeleton | None = None) -> int:
+                         skeleton: PlanSkeleton | None = None,
+                         tenant: str = "",
+                         trace_tenant: str | None = None) -> int:
         """The dependency-aware segment pipeline (see class docstring):
         admit + drain in one synchronous call."""
-        prog = self.begin_streamed(moves, cfg, comm, skeleton)
+        prog = self.begin_streamed(moves, cfg, comm, skeleton, tenant,
+                                   trace_tenant=trace_tenant)
         err, _ = self.finish_streamed(prog)
         return err
 
@@ -1755,18 +2013,24 @@ class MoveExecutor:
     # -- the engine --------------------------------------------------------
     def execute(self, moves: list[Move], cfg: ArithConfig,
                 comm: Communicator,
-                skeleton: PlanSkeleton | None = None) -> int:
+                skeleton: PlanSkeleton | None = None,
+                tenant: str = "",
+                trace_tenant: str | None = None) -> int:
         """Run a move program; returns the OR-ed error word (0 = success).
 
         Dispatch: ``window == 0`` → the strict serial engine;
         ``segment_stream`` (default) → the dependency-aware segment
         pipeline; otherwise → the send-only in-flight window.
         ``skeleton`` is an optional pre-derived (cached) streamed plan —
-        ignored by the serial/window engines, which need none."""
+        ignored by the serial/window engines, which need none; ``tenant``
+        attributes the streamed execution (quotas/scheduling), and
+        ``trace_tenant`` the flight-recorder tracks (explicit groupings
+        only — None defaults it to ``tenant``)."""
         if self.window <= 0:
             return self.execute_serial(moves, cfg, comm)
         if self.segment_stream:
-            return self.execute_streamed(moves, cfg, comm, skeleton)
+            return self.execute_streamed(moves, cfg, comm, skeleton,
+                                         tenant, trace_tenant=trace_tenant)
         return self.execute_window(moves, cfg, comm)
 
     def execute_window(self, moves: list[Move], cfg: ArithConfig,

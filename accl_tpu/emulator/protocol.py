@@ -257,18 +257,26 @@ def unpack_call(body: bytes) -> dict:
 
 # -- communicator table -----------------------------------------------------
 # comm_id u32, local_rank u32, W u32, then per rank: global_rank u32,
-# eth_port u16, host_len u16 + host utf-8
+# eth_port u16, host_len u16 + host utf-8; OPTIONAL trailing tenant
+# record: tenant_len u16 + tenant utf-8 (multi-tenant service grouping —
+# absent in frames from older clients, and both daemons tolerate the
+# absence, so the extension is wire-compatible in both directions)
 def pack_comm(comm_id: int, local_rank: int,
-              ranks: list[tuple[int, str, int]]) -> bytes:
+              ranks: list[tuple[int, str, int]],
+              tenant: str = "") -> bytes:
     out = [bytes([MSG_CONFIG_COMM]),
            struct.pack("<3I", comm_id, local_rank, len(ranks))]
     for grank, host, port in ranks:
         h = host.encode()
         out.append(struct.pack("<IHH", grank, port, len(h)) + h)
+    if tenant:
+        t = tenant.encode()
+        out.append(struct.pack("<H", len(t)) + t)
     return b"".join(out)
 
 
-def unpack_comm(body: bytes) -> tuple[int, int, list[tuple[int, str, int]]]:
+def unpack_comm(body: bytes
+                ) -> tuple[int, int, list[tuple[int, str, int]], str]:
     comm_id, local_rank, n = struct.unpack("<3I", body[:12])
     off = 12
     ranks = []
@@ -282,7 +290,14 @@ def unpack_comm(body: bytes) -> tuple[int, int, list[tuple[int, str, int]]]:
         host = body[off:off + hlen].decode()
         off += hlen
         ranks.append((grank, host, port))
-    return comm_id, local_rank, ranks
+    tenant = ""
+    if off + 2 <= len(body):
+        (tlen,) = struct.unpack("<H", body[off:off + 2])
+        off += 2
+        if off + tlen > len(body):
+            raise ValueError("truncated tenant record")
+        tenant = body[off:off + tlen].decode()
+    return comm_id, local_rank, ranks, tenant
 
 
 # -- eth frame --------------------------------------------------------------

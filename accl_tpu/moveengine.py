@@ -102,10 +102,14 @@ class Move:
     ``blocking=False`` invariant (what the pipelined executor relies on —
     audit every site that clears the flag against it): the move is a pure
     pool-destined send (no local write, no stream port) AND no later move
-    of the same program writes the memory it reads. Such a move may retire
-    asynchronously, overlapping subsequent moves; the executor keeps wire
-    sequence numbers in program order regardless. A send whose source is
-    rewritten later (gather's relay scratch, c:632-724) must stay blocking.
+    of the same program writes the memory it reads — except moves of the
+    send's OWN lane, whose lane chain orders the writer behind the send
+    (in-place alltoall's paired exchange and the Rabenseifner rounds'
+    chunk reuse rely on this lane-local exception). Such a move may
+    retire asynchronously, overlapping subsequent moves; the executor
+    keeps wire sequence numbers in program order regardless. A send whose
+    source is rewritten later OUTSIDE its lane (gather's relay scratch,
+    c:632-724) must stay blocking.
 
     ``lane`` invariant (what the segment-streamed executor relies on): a
     move tagged with a segment lane may execute concurrently with moves of
@@ -1412,25 +1416,38 @@ def expand_alltoall(ctx: MoveContext, count: int, src: int, dst: int,
     # src chunks are OP0-typed, dst chunks RES-typed — separate element sizes
     e_src = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
     e_dst = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    S = _chunk_lanes(ctx, count, compression)
     moves: list[Move] = []
-    moves += expand_copy(ctx, count, src + me * count * e_src,
-                         dst + me * count * e_dst, compression)
-    # round-robin schedule avoiding head-of-line blocking. A send may be
-    # non-blocking (overlap its round's recv) only when no LATER recv
-    # writes the chunk index it reads: step s sends chunk (me+s) and step
-    # t recvs chunk (me-t), colliding when t == W-s — an IN-PLACE
-    # alltoall (src aliasing dst) would hand the overlapped send a
-    # rewritten source. The colliding recv is later than the send
-    # exactly when W-s >= s, so the first half of the schedule stays
-    # blocking and the second half overlaps.
+    # self-exchange: a LANED local copy on chunk ``me``'s global lane
+    # instead of a barrier — no other move of the program touches chunk
+    # me (sends read chunks (me+s)%W, recvs write chunks (me-t)%W, s,t >=
+    # 1), so the lane carries no concurrent toucher and the whole program
+    # joins the streamed pipeline (the barrier used to drain every lane
+    # before the first remote byte moved)
+    self_mv = expand_copy(ctx, count, src + me * count * e_src,
+                          dst + me * count * e_dst, compression)
+    for m in self_mv:
+        m.lane = me * S
+    moves += self_mv
+    # round-robin schedule on GLOBAL-CHUNK lanes (lane = chunk * S + seg,
+    # the log-depth convention): step s sends chunk (me+s) and step t
+    # recvs chunk (me-t), which collide IN-PLACE (src aliasing dst)
+    # exactly when t == W-s — both moves then carry the same chunk's
+    # lanes, so the hazard is an explicit lane-local edge (the later move
+    # chains behind the earlier, preserving serial program order per
+    # chunk) instead of the blocking barrier the first half of the
+    # schedule used to pay. Sends are therefore non-blocking throughout:
+    # the only later writer of a send's source is its own lane's recv
+    # (Move.blocking lane-local exception).
     for step in range(1, W):
         to = (me + step) % W
         frm = (me - step) % W
         moves += expand_send(ctx, count, src + to * count * e_src, to,
                              tag=TAG_ANY, compression=compression,
-                             blocking=(W - step) >= step)
+                             blocking=False, lane_base=to * S)
         moves += expand_recv(ctx, count, frm, dst + frm * count * e_dst,
-                             tag=TAG_ANY, compression=compression)
+                             tag=TAG_ANY, compression=compression,
+                             lane_base=frm * S)
     return moves
 
 

@@ -210,7 +210,7 @@ def cached_program(cache: "PlanCache", *, scenario: CCLOp, count: int,
                   stream: StreamFlags = StreamFlags.NO_STREAM,
                   algorithm: CollectiveAlgorithm = CollectiveAlgorithm.AUTO,
                   tuner=None, streamed: bool = True,
-                  compile_missing: bool = True):
+                  compile_missing: bool = True, tenant: str = ""):
     """The one program-preparation path shared by every tier (emu device,
     rank daemon, chained admission): resolve AUTO to the CONCRETE
     algorithm BEFORE building the key (the invariant that makes tuner
@@ -273,7 +273,7 @@ def cached_program(cache: "PlanCache", *, scenario: CCLOp, count: int,
                             stream=stream, algorithm=alg,
                             streamed=streamed)
         plan_us = plan.plan_us
-        cache.store(key, plan)
+        cache.store(key, plan, tenant=tenant)
     moves = plan.bind(bases)
     expand_us = max(0.0, (time.perf_counter() - t0) * 1e6 - plan_us)
     return moves, plan.skeleton, state, expand_us, plan_us
@@ -302,6 +302,15 @@ class PlanCache:
         self.capacity = max(1, int(capacity))
         self._lock = threading.Lock()
         self._entries: OrderedDict[tuple, CompiledPlan] = OrderedDict()
+        # multi-tenant fairness: entry -> tenant attribution plus live
+        # per-tenant entry counts. The LRU is shared per device/daemon,
+        # so N tenants' shapes would evict each other blindly; eviction
+        # skips tenants at/below their MINIMUM SHARE (capacity / live
+        # tenants) while any tenant sits above its share — a shape-heavy
+        # tenant evicts its own coldest entries before touching a small
+        # tenant's working set.
+        self._tenant_of: dict[tuple, str] = {}
+        self.tenant_entries: dict[str, int] = {}
         self.hits = 0
         self.misses = 0
         self.bypasses = 0
@@ -318,13 +327,57 @@ class PlanCache:
             self.hits += 1
             return plan
 
-    def store(self, key: tuple, plan: CompiledPlan):
+    def _account_locked(self, key: tuple, tenant: str):
+        old = self._tenant_of.get(key)
+        if old == tenant:
+            return
+        if old is not None:
+            self._dec_tenant_locked(key)
+        self._tenant_of[key] = tenant
+        self.tenant_entries[tenant] = \
+            self.tenant_entries.get(tenant, 0) + 1
+
+    def _dec_tenant_locked(self, key: tuple):
+        t = self._tenant_of.pop(key, None)
+        if t is None:
+            return
+        n = self.tenant_entries.get(t, 0) - 1
+        if n > 0:
+            self.tenant_entries[t] = n
+        else:
+            self.tenant_entries.pop(t, None)
+
+    def _evict_one_locked(self):
+        """Capacity eviction with a minimum-share floor: walk from the
+        LRU end, skipping entries whose tenant holds no more than
+        capacity / live-tenants entries — as long as SOME tenant is over
+        its share (there always is when the cache is over capacity with
+        a protected tenant skipped). Falls back to plain LRU when every
+        tenant is within share (single-tenant caches take this branch
+        with zero extra work)."""
+        n_tenants = max(1, len(self.tenant_entries))
+        min_share = self.capacity // n_tenants
+        victim = None
+        if n_tenants > 1:
+            for key in self._entries:          # LRU -> MRU order
+                t = self._tenant_of.get(key, "")
+                if self.tenant_entries.get(t, 0) > min_share:
+                    victim = key
+                    break
+        if victim is None:
+            victim, _ = self._entries.popitem(last=False)
+        else:
+            del self._entries[victim]
+        self._dec_tenant_locked(victim)
+        self.evictions += 1
+
+    def store(self, key: tuple, plan: CompiledPlan, tenant: str = ""):
         with self._lock:
             self._entries[key] = plan
             self._entries.move_to_end(key)
+            self._account_locked(key, tenant)
             while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
-                self.evictions += 1
+                self._evict_one_locked()
 
     def note_bypass(self):
         with self._lock:
@@ -335,6 +388,8 @@ class PlanCache:
         re-resolution, explicit reset)."""
         with self._lock:
             self._entries.clear()
+            self._tenant_of.clear()
+            self.tenant_entries.clear()
             self.invalidations[reason] = \
                 self.invalidations.get(reason, 0) + 1
 
@@ -353,6 +408,7 @@ class PlanCache:
                 "bypasses": self.bypasses,
                 "evictions": self.evictions,
                 "invalidations": dict(self.invalidations),
+                "tenant_entries": dict(self.tenant_entries),
             }
 
     def metrics_rows(self, labels: dict):
@@ -368,3 +424,7 @@ class PlanCache:
         for reason, n in st["invalidations"].items():
             yield ("counter", "plan_cache_invalidations_total",
                    dict(labels, reason=reason), n)
+        for tenant, n in st["tenant_entries"].items():
+            if tenant:  # unattributed entries have no tenant series
+                yield ("gauge", "plan_cache_tenant_entries",
+                       dict(labels, tenant=tenant), n)

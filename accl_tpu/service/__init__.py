@@ -1,0 +1,26 @@
+"""Multi-tenant collective service: admission, QoS scheduling, quotas.
+
+The service layer (ROADMAP item 3, after ACCL+'s evolution of ACCL into
+a shared collective service for many client applications) sits in front
+of the streamed executor: programs from *independent* communicators are
+admitted concurrently (they share no lanes, RX match keys or egress
+domains — the executor's dependency machinery already isolates them),
+per-tenant queues are drained by a deficit-weighted round-robin
+scheduler, and rank-local resources (rx-pool spare buffers, combine-
+scratch arena slots) carry per-tenant reservations with a shared
+overflow pool. See docs/ARCHITECTURE.md "The service layer".
+
+``$ACCL_TPU_SERVICE=0`` disables the layer process-wide (every call
+takes the legacy serialized path).
+"""
+
+from .admission import (AdmissionController, ServiceConfig, TenantSpec,
+                        service_enabled, tenant_label, validate_tenant)
+from .quota import QuotaManager, parse_reservations
+from .rank import RankService
+
+__all__ = [
+    "AdmissionController", "RankService", "ServiceConfig", "TenantSpec",
+    "QuotaManager", "parse_reservations", "service_enabled",
+    "tenant_label", "validate_tenant",
+]

@@ -1,0 +1,74 @@
+"""Per-rank service instance: controller + quota managers + metrics.
+
+One :class:`RankService` lives on each rank's execution backend (the emu
+device today; the rank daemons wire the quota half directly — their
+call path stays a single FIFO worker). It owns:
+
+* the rank's :class:`~accl_tpu.service.admission.AdmissionController`
+  (per-tenant queues, DWRR, depth bounds);
+* the rank's resource :class:`~accl_tpu.service.quota.QuotaManager`\\ s,
+  installed onto the rx buffer pool and the combine-scratch arena;
+* the metrics collector folding per-tenant admission counters, queue-
+  wait histograms and RX/arena occupancy into the process registry.
+"""
+
+from __future__ import annotations
+
+from ..tracing import METRICS
+from .admission import AdmissionController, ServiceConfig
+from .quota import QuotaManager
+
+__all__ = ["RankService"]
+
+
+class RankService:
+    def __init__(self, config: ServiceConfig, *, rank: int,
+                 tenant_of: dict[int, str], pool=None, arena=None,
+                 tier: str = "device"):
+        self.config = config
+        self.rank = rank
+        self.tier = tier
+        self.tenant_of = tenant_of  # live comm_id -> tenant mapping
+        self.controller = AdmissionController(config, name=f"-r{rank}")
+        self.rx_quota: QuotaManager | None = None
+        self.arena_quota: QuotaManager | None = None
+        if pool is not None:
+            self.rx_quota = QuotaManager(
+                len(pool.bufs),
+                {n: s.rx_buffers for n, s in config.tenants.items()
+                 if s.rx_buffers})
+            self.wire_pool(pool)
+        if arena is not None:
+            self.arena_quota = QuotaManager(
+                arena._slots,
+                {n: s.arena_slots for n, s in config.tenants.items()
+                 if s.arena_slots})
+            arena.quota = self.arena_quota
+        METRICS.register_collector(self, RankService._metrics_rows)
+
+    def wire_pool(self, pool):
+        """(Re)attach the rx quota to ``pool`` — soft reset builds a
+        fresh pool, dropping every held buffer, so usage restarts from
+        zero while cumulative rejection counts survive."""
+        if self.rx_quota is None:
+            return
+        self.rx_quota.reset_usage()
+        pool.quota = self.rx_quota
+        pool.tenant_of = self.tenant_of
+
+    def _metrics_rows(self):
+        labels = {"rank": self.rank, "tier": self.tier}
+        yield from self.controller.metrics_rows(labels)
+        for qm, family in ((self.rx_quota, "rx_pool"),
+                           (self.arena_quota, "arena")):
+            if qm is None:
+                continue
+            for tenant, n in qm.in_use().items():
+                yield ("gauge", f"{family}_tenant_in_use",
+                       dict(labels, tenant=tenant), n)
+            for tenant, n in list(qm.rejections.items()):
+                yield ("counter", f"{family}_quota_rejected_total",
+                       dict(labels, tenant=tenant), n)
+
+    def close(self):
+        self.controller.close()

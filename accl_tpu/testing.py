@@ -23,7 +23,8 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
               max_segment_size: int | None = None,
               tuner=None, pipeline_window: int | None = None,
               segment_stream: bool | None = None,
-              plan_cache: bool | None = None) -> list[ACCL]:
+              plan_cache: bool | None = None,
+              service=None, tenant: str | None = None) -> list[ACCL]:
     """Create ``world_size`` ACCL instances sharing an in-process fabric.
 
     ``tuner`` (a single :class:`~accl_tpu.tuner.Tuner`) is shared by every
@@ -33,9 +34,14 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
     ``segment_stream`` selects the dependency-aware segment pipeline vs
     the send-only window (None = process default); ``plan_cache``
     enables/disables the compiled-plan cache (None = process default,
-    ``$ACCL_TPU_PLAN_CACHE``)."""
+    ``$ACCL_TPU_PLAN_CACHE``). ``service`` configures the multi-tenant
+    service layer (a :class:`~accl_tpu.service.ServiceConfig`, True/False,
+    or None = process default, ``$ACCL_TPU_SERVICE``); ``tenant`` groups
+    this driver set's communicators under one service tenant (see
+    :func:`add_tenant` for attaching further tenants to the same world)."""
     kw = {"nbufs": nbufs, "pipeline_window": pipeline_window,
-          "segment_stream": segment_stream, "plan_cache": plan_cache}
+          "segment_stream": segment_stream, "plan_cache": plan_cache,
+          "service": service}
     if bufsize is not None:
         kw["bufsize"] = bufsize
     ctx = EmuContext(world_size, **kw)
@@ -44,8 +50,31 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
         comm = Communicator(
             ranks=[Rank() for _ in range(world_size)], local_rank=r)
         accls.append(ACCL(ctx.device(r), comm, timeout=timeout,
-                          max_segment_size=max_segment_size, tuner=tuner))
+                          max_segment_size=max_segment_size, tuner=tuner,
+                          tenant=tenant))
     return accls
+
+
+def add_tenant(accls: Sequence[ACCL], tenant: str, key: int = 1,
+               timeout: float = 20.0,
+               max_segment_size: int | None = None,
+               tuner=None) -> list[ACCL]:
+    """Attach another tenant's driver set to an existing emu world: one
+    new ACCL per rank SHARING that rank's device, talking over its own
+    same-membership communicator (``key`` disambiguates the comm_id —
+    each attached tenant must use a distinct key). This is the
+    multi-application shape of the service layer: independent clients,
+    one collective engine per rank."""
+    ctx = accls[0].device.ctx
+    W = ctx.world_size
+    out = []
+    for r in range(W):
+        comm = Communicator(
+            ranks=[Rank() for _ in range(W)], local_rank=r, key=key)
+        out.append(ACCL(ctx.device(r), comm, timeout=timeout,
+                        max_segment_size=max_segment_size, tuner=tuner,
+                        tenant=tenant))
+    return out
 
 
 def run_ranks(accls: Sequence[ACCL], fn: Callable[[ACCL], object],

@@ -112,6 +112,11 @@ class CallRecord:
     #                             recorder when armed, estimated from the
     #                             pipeline counters when not; 0 for the
     #                             serial oracle (nothing ever overlaps)
+    # multi-tenant service attribution (accl_tpu/service):
+    tenant: str = ""            # the service tenant the call's comm
+    #                             belongs to ("" on drivers without a
+    #                             tenant label AND no comm grouping —
+    #                             the driver defaults to "comm-<id>")
 
     @property
     def duration_us(self) -> float:
@@ -181,7 +186,8 @@ class Profiler:
             self._records.append(rec)
 
     def attach(self, handle, op: str, count: int, nbytes: int, comm_id: int,
-               t0: float | None = None, algorithm: str = ""):
+               t0: float | None = None, algorithm: str = "",
+               tenant: str = ""):
         """Register a done callback on ``handle`` that records the call's
         host-issue -> retire duration. Pass ``t0`` captured before dispatch
         so the record covers the full issue->retire window even when the
@@ -205,7 +211,8 @@ class Profiler:
                 plan_us=st.get("plan_us", 0.0),
                 plan_cache=st.get("plan_cache", ""),
                 lanes=st.get("lanes", 0),
-                overlap_frac=st.get("overlap_frac", 0.0)))
+                overlap_frac=st.get("overlap_frac", 0.0),
+                tenant=tenant))
 
         handle.add_done_callback(_on_done)
 
@@ -247,7 +254,7 @@ class Profiler:
             f.write("op,count,nbytes,comm_id,t_start,duration_us,error,"
                     "algorithm,moves,pipelined_moves,pipeline_depth,"
                     "combine_overlap,expand_us,plan_us,plan_cache,"
-                    "lanes,overlap_frac\n")
+                    "lanes,overlap_frac,tenant\n")
             for r in self.records:
                 f.write(f"{r.op},{r.count},{r.nbytes},{r.comm_id},"
                         f"{r.t_start:.9f},{r.duration_us:.3f},"
@@ -255,7 +262,7 @@ class Profiler:
                         f"{r.pipelined_moves},{r.pipeline_depth},"
                         f"{r.combine_overlap},{r.expand_us:.1f},"
                         f"{r.plan_us:.1f},{r.plan_cache},"
-                        f"{r.lanes},{r.overlap_frac:.4f}\n")
+                        f"{r.lanes},{r.overlap_frac:.4f},{r.tenant}\n")
 
     @staticmethod
     def read_csv(path: str) -> list[CallRecord]:
@@ -285,7 +292,8 @@ class Profiler:
                     plan_us=float(row.get("plan_us") or 0.0),
                     plan_cache=row.get("plan_cache") or "",
                     lanes=int(row.get("lanes") or 0),
-                    overlap_frac=float(row.get("overlap_frac") or 0.0)))
+                    overlap_frac=float(row.get("overlap_frac") or 0.0),
+                    tenant=row.get("tenant") or ""))
         return out
 
 # -- flight recorder --------------------------------------------------------
@@ -293,9 +301,12 @@ class Profiler:
 # Event tuple layout (kept a plain tuple — an emit is one monotonic clock
 # read plus a deque append, no object construction beyond the tuple):
 #   (t_ns, dur_ns, stage, rank, call_seq, lane, step, seqn, peer, nbytes,
-#    thread_name)
+#    thread_name, tenant)
+# ``tenant`` ("" when unattributed) was APPENDED so every positional
+# consumer of the earlier 11-field layout (overlap_frac's raw-ring scan)
+# reads unchanged indices.
 _EV_FIELDS = ("t_ns", "dur_ns", "stage", "rank", "call_seq", "lane",
-              "step", "seqn", "peer", "nbytes", "thread")
+              "step", "seqn", "peer", "nbytes", "thread", "tenant")
 
 # wire-activity stages (what combine time can hide behind) vs compute.
 # "wire_send" is NOT here: fabric send events are instants (dur_ns=0, no
@@ -399,11 +410,13 @@ class EventTrace:
     def emit(self, stage: str, *, rank: int = -1, call_seq: int = 0,
              lane: int = -1, step: int = -1, seqn: int = -1,
              peer: int = -1, nbytes: int = 0, t_ns: int | None = None,
-             dur_ns: int = 0):
+             dur_ns: int = 0, tenant: str = ""):
         """Record one structured event. ``t_ns`` is the event START
         (monotonic ns; now when omitted), ``dur_ns`` its duration (0 for
-        instantaneous events). Callers on the hot path must pre-check
-        ``enabled`` — this method rechecks only to tolerate a disarm race.
+        instantaneous events); ``tenant`` attributes the event to a
+        service tenant (multi-tenant Perfetto tracks). Callers on the hot
+        path must pre-check ``enabled`` — this method rechecks only to
+        tolerate a disarm race.
         """
         if not self.enabled:
             return
@@ -411,7 +424,7 @@ class EventTrace:
             t_ns = time.monotonic_ns()
         self._buffer().append(
             (t_ns, dur_ns, stage, rank, call_seq, lane, step, seqn, peer,
-             nbytes, threading.current_thread().name))
+             nbytes, threading.current_thread().name, tenant))
 
     # -- reporting ----------------------------------------------------------
     def events(self) -> list[dict]:
@@ -438,6 +451,12 @@ class EventTrace:
             pid = e["rank"] if e["rank"] >= 0 else 0
             label = (f"lane {e['lane']}" if e["lane"] >= 0
                      else str(e["thread"]))
+            tenant = e.get("tenant", "")
+            if tenant:
+                # tenant-prefixed tracks: two tenants' same-numbered
+                # lanes render as separate interleaved tracks instead of
+                # merging into one indistinguishable timeline
+                label = f"{tenant} {label}"
             key = (pid, label)
             tid = tids.get(key)
             if tid is None:
@@ -447,6 +466,8 @@ class EventTrace:
             args = {k: e[k] for k in ("call_seq", "step", "seqn", "peer",
                                       "nbytes") if e[k] not in (-1,)}
             args["thread"] = e["thread"]
+            if tenant:
+                args["tenant"] = tenant
             out.append({"ph": "X", "name": e["stage"], "cat": "accl_tpu",
                         "pid": pid, "tid": tid,
                         "ts": (e["t_ns"] - t0) / 1e3,
@@ -681,8 +702,11 @@ class MetricsRegistry:
     # -- collectors --------------------------------------------------------
     def register_collector(self, owner, fn):
         """``fn(owner) -> iterable of (kind, name, labels_dict, value)``
-        with kind "counter" | "gauge". ``owner`` is held weakly — the
-        collector vanishes with it."""
+        with kind "counter" | "gauge" | "histogram" (histogram value:
+        ``[count, sum, bucket-count list]`` over ``_HIST_BUCKETS`` edges
+        — the service layer folds its locally-kept queue-wait histograms
+        through this). ``owner`` is held weakly — the collector vanishes
+        with it."""
         with self._lock:
             self._collectors = [(r, f) for r, f in self._collectors
                                 if r() is not None]
@@ -722,6 +746,15 @@ class MetricsRegistry:
             key = self._key(name, labels)
             if kind == "counter":
                 counters[key] = counters.get(key, 0) + value
+            elif kind == "histogram":
+                # value: [count, sum, bucket list] over _HIST_BUCKETS
+                h = hists.get(key)
+                if h is None:
+                    hists[key] = [value[0], value[1], list(value[2])]
+                else:
+                    h[0] += value[0]
+                    h[1] += value[1]
+                    h[2] = [a + b for a, b in zip(h[2], value[2])]
             else:
                 gauges[key] = value
         out = {"counters": {}, "gauges": {}, "histograms": {}}

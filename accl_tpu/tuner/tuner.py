@@ -112,6 +112,29 @@ class Tuner:
         # (refresh / pin / clear_pins). Weak refs: a tuner can outlive
         # the worlds whose device caches registered with it.
         self._plan_caches: list = []
+        # async calls in flight across EVERY driver sharing this tuner:
+        # multi-tenant worlds share one tuner across tenants, and one
+        # tenant's async storm inflating another tenant's synchronous
+        # issue->retire window must not be credited to the algorithm
+        # (cross-tenant EWMA contamination). Drivers bump the counter on
+        # async issue/retire; training requires quiescent() — the
+        # driver-local check alone only sees its OWN calls.
+        self._async_inflight = 0
+
+    # -- cross-driver quiescence (multi-tenant measurement hygiene) --------
+    def note_async_issue(self):
+        with self._lock:
+            self._async_inflight += 1
+
+    def note_async_retire(self):
+        with self._lock:
+            self._async_inflight -= 1
+
+    def quiescent(self) -> bool:
+        """True when no driver sharing this tuner has an async call in
+        flight — the only state in which a synchronous call's measured
+        window is attributable to its algorithm alone."""
+        return self._async_inflight == 0
 
     # -- selection ---------------------------------------------------------
     def _topo(self, world_size: int) -> Topology:

@@ -1,0 +1,287 @@
+"""Multi-tenant saturation benchmark: N communicators, concurrent storms.
+
+Quantifies what the service layer (accl_tpu/service) buys and costs:
+
+* **aggregate throughput** — N tenants' allreduce storms submitted
+  concurrently through the tenant-aware admission layer vs the SAME work
+  through the legacy serialized path (``service=False``, tenants run
+  back-to-back). The concurrent/serialized ratio is the headline. Gate:
+  ``$ACCL_BENCH_MIN_AGG_RATIO`` (default 1.0 — overlap must not lose
+  throughput; ``make bench-emu`` sets 0.6). The 1.0 target needs
+  somewhere for the overlap to come FROM: on the in-process emulator
+  every microsecond — "wire", combine, scheduling — is CPU, so on a
+  small fully-saturated host the serialized baseline already uses every
+  core and concurrency can only add scheduling/GIL overhead (measured
+  ~0.7x on the 2-core CI box, stable across message sizes and world
+  sizes). The emu-tier gate therefore asserts the meaningful property
+  at this tier — concurrency must not COLLAPSE (pre-service, concurrent
+  multi-tenant submission cross-rank-DEADLOCKED; that is the 0.0x this
+  guards against) — while hosts with real idle (spare cores, a real
+  wire, compute-overlapped callers) should run the 1.0 default;
+* **Jain's fairness index** over the equal-weight tenants' individual
+  throughputs in the concurrent run — (Σx)² / (N·Σx²), 1.0 = perfectly
+  even, 1/N = one tenant hogged everything (gate:
+  ``$ACCL_BENCH_MIN_FAIRNESS``);
+* **small-call p99 under a bandwidth hog** — a 4 KiB-allreduce tenant's
+  per-call p99, solo vs alongside a 16 MiB-storm tenant. The admission
+  layer (byte-weighted DWRR + ``preempt`` express admission/dispatch for
+  the latency tenant) keeps the storm from head-of-line-blocking the
+  small calls. Gate: contended p99 <= max(3x solo p99,
+  ``$ACCL_BENCH_P99_FLOOR_US``). The floor (default 50 ms) encodes the
+  OS-noise ceiling of a small shared host: with every core saturated by
+  the storm's combines, a handful of calls per hundred eat a
+  timeslice-scale preemption wherever they park (the SOLO leg's own p99
+  swings 2-20 ms run to run on the 2-core CI box), and a sub-floor p99
+  is indistinguishable from that noise. The regression class this gate
+  exists for — admission or dispatch head-of-line, where the small call
+  waits out storm segments or whole programs — measured a 65 ms MEDIAN
+  and ~150 ms p99 before the express path existed, far above the floor.
+  On a host with spare cores, set the floor to 0 for the pure 3x
+  criterion;
+* **per-tenant plan-cache occupancy** — the minimum-share eviction
+  policy's view after the concurrent run (asserted in the saturation
+  test: every tenant retains entries).
+
+Run directly (``python -m benchmarks.saturation``) for one JSON line;
+``headline()`` feeds the same payload into bench.py's emu-tier line,
+gated in ``make bench-emu`` with best-of-three retries.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from accl_tpu.constants import CollectiveAlgorithm
+from accl_tpu.service import ServiceConfig
+from accl_tpu.testing import add_tenant, emu_world, run_ranks
+
+
+def _tenant_worlds(world: int, tenants: int, service, bufsize: int,
+                   seg: int, timeout: float = 60.0,
+                   nbufs_per_tenant: int = 12):
+    """One emu world, ``tenants`` driver sets sharing its devices — each
+    on its own same-membership communicator, each its own tenant. The rx
+    pool is provisioned per tenant (a service sized for one application
+    thrashes when N share it — deferred-ingress retries, not a fair
+    comparison of scheduling)."""
+    names = [f"t{i}" for i in range(tenants)]
+    base = emu_world(world, service=service, tenant=names[0],
+                     nbufs=nbufs_per_tenant * tenants,
+                     bufsize=bufsize, max_segment_size=seg,
+                     timeout=timeout)
+    worlds = [base]
+    for k in range(1, tenants):
+        worlds.append(add_tenant(base, names[k], key=k, timeout=timeout,
+                                 max_segment_size=seg))
+    return worlds
+
+
+def _teardown(worlds):
+    for accl in worlds[0]:
+        accl.device.deinit()
+
+
+def _storm_all(worlds, count: int, iters: int,
+               concurrent: bool = True) -> tuple[float, list[float]]:
+    """Every tenant submits ``iters`` ring allreduces. ``concurrent``
+    overlaps the tenants (the service-layer shape); False runs the
+    storms back-to-back — the serialized baseline. The baseline MUST be
+    sequential: without the admission layer each rank's device worker
+    blocks on whichever tenant's program it dequeued first, and two
+    ranks picking different tenants deadlock until the recv timeout
+    (the head-of-line failure mode ROADMAP item 3 calls out) — so
+    "independent communicators serialize behind each other" is modeled
+    as tenant-after-tenant, not as a racy concurrent submission.
+    Returns (wall seconds, per-tenant seconds)."""
+    bufs = []
+    for w in worlds:
+        per = []
+        for a in w:
+            src = a.buffer(data=np.full(count, float(a.rank + 1),
+                                        np.float32))
+            per.append((src, a.buffer((count,), np.float32)))
+        bufs.append(per)
+
+    per_tenant = [0.0] * len(worlds)
+    errs: list[BaseException] = []
+
+    def tenant_run(ti):
+        def body(a):
+            src, dst = bufs[ti][a.rank]
+            for _ in range(iters):
+                a.allreduce(src, dst, count,
+                            algorithm=CollectiveAlgorithm.FUSED_RING)
+        t0 = time.perf_counter()
+        try:
+            run_ranks(worlds[ti], body, timeout=180.0)
+        except BaseException as exc:  # noqa: BLE001 — surfaced below
+            errs.append(exc)
+        per_tenant[ti] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    if concurrent:
+        threads = [threading.Thread(target=tenant_run, args=(ti,))
+                   for ti in range(len(worlds))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(240.0)
+    else:
+        for ti in range(len(worlds)):
+            tenant_run(ti)
+    wall = time.perf_counter() - t0
+    if errs:
+        raise errs[0]
+    expect = len(worlds[0]) * (len(worlds[0]) + 1) / 2
+    for per in bufs:
+        for _, dst in per:
+            if not np.allclose(dst.data, expect):
+                raise AssertionError("saturation allreduce mismatch")
+    return wall, per_tenant
+
+
+def jain_index(xs) -> float:
+    xs = [float(x) for x in xs if x > 0]
+    if not xs:
+        return 0.0
+    return (sum(xs) ** 2) / (len(xs) * sum(x * x for x in xs))
+
+
+def measure_throughput(world: int = 4, tenants: int = 4,
+                       nbytes: int = 256 << 10, iters: int = 4) -> dict:
+    """Concurrent-vs-serialized aggregate throughput + Jain fairness."""
+    count = nbytes // 4
+    seg = max(4096, nbytes // world // 2)
+    bufsize = 2 * max(4096, -(-nbytes // world))
+    svc = ServiceConfig(enabled=True)
+    concurrent = _tenant_worlds(world, tenants, svc, bufsize, seg)
+    try:
+        _storm_all(concurrent, count, 1)            # warmup
+        t_conc, per_tenant = _storm_all(concurrent, count, iters)
+        plan_tenants = dict(
+            concurrent[0][0].device.plan_cache.stats()["tenant_entries"])
+    finally:
+        _teardown(concurrent)
+    serial = _tenant_worlds(world, tenants, False, bufsize, seg)
+    try:
+        _storm_all(serial, count, 1, concurrent=False)   # warmup
+        t_serial, _ = _storm_all(serial, count, iters, concurrent=False)
+    finally:
+        _teardown(serial)
+    total_bytes = tenants * iters * nbytes
+    thru = [iters * nbytes / t for t in per_tenant]
+    return {
+        "saturation_tenants": tenants,
+        "saturation_world": world,
+        "saturation_agg_gbs": round(total_bytes / t_conc / 1e9, 4),
+        "saturation_serialized_gbs": round(total_bytes / t_serial / 1e9,
+                                           4),
+        "saturation_agg_ratio": round(t_serial / t_conc, 3),
+        "saturation_jain": round(jain_index(thru), 3),
+        "saturation_plan_cache_tenants": plan_tenants,
+    }
+
+
+def measure_small_call_p99(world: int = 2, small_nbytes: int = 4 << 10,
+                           storm_nbytes: int = 16 << 20,
+                           calls: int = 100, storm_iters: int = 3) -> dict:
+    """Small-call p99 solo vs alongside a 16 MiB-storm tenant. The small
+    tenant is marked ``preempt`` (the latency-critical shape the
+    preempt_admission knob exists for); the storm tenant runs plain."""
+    count_small = small_nbytes // 4
+    count_storm = storm_nbytes // 4
+    seg = 256 << 10
+    # messages are segment-sized (the storm is forced onto the segmented
+    # ring): buffers hold a segment, with headroom for the small calls
+    bufsize = 2 * seg
+    svc = ServiceConfig(enabled=True)
+    # the latency tenant: preempt admission/dispatch + a guaranteed rx
+    # reservation, so the storm can exhaust overflow but never its slots
+    svc.tenant("t0", preempt=True, rx_buffers=4)
+    worlds = _tenant_worlds(world, 2, svc, bufsize, seg, timeout=120.0,
+                            nbufs_per_tenant=20)
+    small_w, storm_w = worlds
+    try:
+        lat_solo = _timed_small_calls(small_w, count_small, calls)
+        stop = threading.Event()
+        storm_err: list[BaseException] = []
+
+        def storm():
+            def body(a):
+                src = a.buffer(data=np.ones(count_storm, np.float32))
+                dst = a.buffer((count_storm,), np.float32)
+                while not stop.is_set():
+                    hs = [a.allreduce(src, dst, count_storm,
+                                      algorithm=CollectiveAlgorithm
+                                      .FUSED_RING, run_async=True)
+                          for _ in range(storm_iters)]
+                    for h in hs:
+                        h.wait(120)
+            try:
+                run_ranks(storm_w, body, timeout=240.0)
+            except BaseException as exc:  # noqa: BLE001
+                storm_err.append(exc)
+
+        th = threading.Thread(target=storm)
+        th.start()
+        time.sleep(0.3)                              # storm in flight
+        try:
+            lat_storm = _timed_small_calls(small_w, count_small, calls)
+        finally:
+            stop.set()
+            th.join(240.0)
+        if storm_err:
+            raise storm_err[0]
+    finally:
+        _teardown(worlds)
+    p99_solo = float(np.percentile(lat_solo, 99))
+    p99_storm = float(np.percentile(lat_storm, 99))
+    return {
+        "small_p99_solo_us": round(p99_solo * 1e6, 1),
+        "small_p99_storm_us": round(p99_storm * 1e6, 1),
+        "small_p99_ratio": round(p99_storm / max(p99_solo, 1e-9), 2),
+    }
+
+
+def _timed_small_calls(world_accls, count: int, calls: int) -> list[float]:
+    """Per-call latencies of ``calls`` sync small allreduces, measured on
+    rank 0 (every rank participates; rank 0's window is the collective's).
+    """
+    bufs = []
+    for a in world_accls:
+        src = a.buffer(data=np.full(count, 1.0, np.float32))
+        bufs.append((src, a.buffer((count,), np.float32)))
+    lats: list[float] = []
+
+    def body(a):
+        src, dst = bufs[a.rank]
+        for _ in range(calls):
+            t0 = time.perf_counter()
+            a.allreduce(src, dst, count)
+            if a.rank == 0:
+                lats.append(time.perf_counter() - t0)
+
+    run_ranks(world_accls, body, timeout=240.0)
+    return lats
+
+
+def headline(world: int = 4, tenants: int = 4) -> dict:
+    """The bench.py-style saturation payload (see module docstring)."""
+    out = measure_throughput(world=world, tenants=tenants)
+    out.update(measure_small_call_p99())
+    return out
+
+
+SATURATION_KEYS = ("saturation_tenants", "saturation_world",
+                   "saturation_agg_gbs", "saturation_serialized_gbs",
+                   "saturation_agg_ratio", "saturation_jain",
+                   "saturation_plan_cache_tenants", "small_p99_solo_us",
+                   "small_p99_storm_us", "small_p99_ratio")
+
+
+if __name__ == "__main__":
+    print(json.dumps(headline()))

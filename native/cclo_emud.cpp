@@ -406,6 +406,11 @@ struct Communicator {
   uint32_t comm_id = 0;
   uint32_t local_rank = 0;
   std::vector<RankInfo> ranks;
+  // multi-tenant service grouping (optional trailing MSG_CONFIG_COMM
+  // record; empty for older clients and ungrouped comms). The native
+  // tier carries the label for attribution parity with the Python
+  // daemon — per-tenant quotas live on the service layer upstream.
+  std::string tenant;
   uint32_t size() const { return static_cast<uint32_t>(ranks.size()); }
   uint32_t my_global() const { return ranks[local_rank].global_rank; }
 };
@@ -1943,6 +1948,17 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
         ri.host.assign(reinterpret_cast<const char*>(p + off), hlen);
         off += hlen;
         comm.ranks.push_back(ri);
+      }
+      // optional trailing tenant record (tenant_len u16 + utf-8): the
+      // multi-tenant service grouping. Absent in frames from older
+      // clients — and tolerated absent, so the extension is
+      // wire-compatible in both directions (protocol.py pack_comm).
+      if (off + 2 <= len) {
+        uint16_t tlen = get_le<uint16_t>(p + off);
+        off += 2;
+        if (off + tlen > len) return status_reply(E_INVALID);
+        comm.tenant.assign(reinterpret_cast<const char*>(p + off), tlen);
+        off += tlen;
       }
       for (const auto& ri : comm.ranks) {
         if (ri.global_rank != rank_ && ri.cmd_port) {

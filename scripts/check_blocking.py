@@ -144,6 +144,34 @@ def check_lane_graph() -> list[str]:
                                     comp, cfg, bases, shifted, moves,
                                     resolve_algorithm, compile_plan,
                                     MoveContext, expand_call)
+    # IN-PLACE alltoall (src aliasing dst), odd AND even worlds: the
+    # paired-exchange hazard (step s's send source is the byte range step
+    # W-s's recv rewrites) is expressed as lane-local edges since the
+    # un-blocked self-step change — the replay must prove every
+    # cross-lane touch stays ordered, at compile AND shifted bases
+    aliased = (0x2000, 0x8000, 0x2000)
+    ali_shift = (0x600000, 0x680000, 0x600000)
+    for W in (2, 3, 5, 6, 8):
+        for seg in (16, 64, 1 << 20):
+            for comp in (Compression.NONE, Compression.ETH_COMPRESSED):
+                for me in range(W):
+                    ctx = MoveContext(world_size=W, local_rank=me,
+                                      arithcfg=cfg, max_segment_size=seg)
+                    moves = expand_call(
+                        ctx, CCLOp.alltoall, count=23, root_src_dst=0,
+                        func=ReduceFunc.SUM, tag=TAG_ANY,
+                        addr_0=aliased[0], addr_1=aliased[1],
+                        addr_2=aliased[2], compression=comp,
+                        algorithm=A.AUTO)
+                    where = (f"alltoall/inplace W={W} me={me} "
+                             f"seg={seg} comp={int(comp)}")
+                    errors += _lane_edges_ok(where, moves)
+                    errors += _hazards_ok(where, moves, cfg)
+                    errors += _relocated_ok(
+                        where, CCLOp.alltoall, A.AUTO, W, me, 0, seg,
+                        comp, cfg, aliased, ali_shift, moves,
+                        resolve_algorithm, compile_plan, MoveContext,
+                        expand_call)
     return errors
 
 
