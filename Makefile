@@ -27,8 +27,8 @@ tune:              ## emulator-tier algorithm sweep -> bench_out/tuning.json
 bench:             ## headline JSON line (real chip when the tunnel is up)
 	$(PY) bench.py
 
-bench-emu:         ## emulator-tier headline (<300s): executor + algorithm + plan-cache + multi-tenant saturation ladders; asserts streamed ≥1.2x over the window, log-depth ≥1.3x over ring at small messages, plan-cache ≥1.3x per-call on repeated small collectives, 4-tenant Jain fairness ≥0.8 with concurrent aggregate ≥0.6x serialized (no-collapse floor — a fully CPU-bound 2-core emulator has no idle for overlap to reclaim; see benchmarks/saturation.py) and bounded small-call p99 under a 16 MiB storm, AND zero fabric drop/corruption counters (metrics_snapshot block rides the JSON line)
-	ACCL_BENCH_TIER=emu ACCL_BENCH_MIN_STREAM_RATIO=1.2 ACCL_BENCH_MIN_RD_RATIO=1.3 ACCL_BENCH_MIN_PLANCACHE_RATIO=1.3 ACCL_BENCH_MIN_FAIRNESS=0.8 ACCL_BENCH_MIN_AGG_RATIO=0.6 ACCL_BENCH_REQUIRE_CLEAN_FABRIC=1 JAX_PLATFORMS=cpu $(PY) bench.py
+bench-emu:         ## emulator-tier headline (<300s): executor + algorithm + plan-cache + hierarchical + multi-tenant saturation ladders; asserts streamed ≥1.2x over the window, log-depth ≥1.3x over ring at small messages, plan-cache ≥1.3x per-call on repeated small collectives, hierarchical ≥1.3x over flat ring on the slow-inter-tier 4 MiB allreduce (benchmarks/hierarchy.py), 4-tenant Jain fairness ≥0.8 with concurrent aggregate ≥0.6x serialized (no-collapse floor — a fully CPU-bound 2-core emulator has no idle for overlap to reclaim; see benchmarks/saturation.py) and bounded small-call p99 under a 16 MiB storm, AND zero fabric drop/corruption counters (metrics_snapshot block rides the JSON line)
+	ACCL_BENCH_TIER=emu ACCL_BENCH_MIN_STREAM_RATIO=1.2 ACCL_BENCH_MIN_RD_RATIO=1.3 ACCL_BENCH_MIN_PLANCACHE_RATIO=1.3 ACCL_BENCH_MIN_HIER_RATIO=1.3 ACCL_BENCH_MIN_FAIRNESS=0.8 ACCL_BENCH_MIN_AGG_RATIO=0.6 ACCL_BENCH_REQUIRE_CLEAN_FABRIC=1 JAX_PLATFORMS=cpu $(PY) bench.py
 
 dryrun:            ## multi-chip sharding dryrun on 8 virtual devices
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

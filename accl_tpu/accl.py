@@ -17,6 +17,8 @@ flags computed automatically (accl.py:528-592).
 
 from __future__ import annotations
 
+import contextlib
+import itertools
 from typing import Any, Sequence
 
 import numpy as np
@@ -26,8 +28,9 @@ from .buffer import ACCLBuffer
 from .call import CallDescriptor, CallHandle, CompletedHandle
 from .communicator import Communicator
 from .constants import (ACCLError, CCLOp, CfgFunc, CollectiveAlgorithm,
-                        Compression, DEFAULT_MAX_SEGMENT_SIZE, ReduceFunc,
-                        StreamFlags, TAG_ANY, VALID_ALGORITHMS)
+                        Compression, DEFAULT_ALGORITHMS,
+                        DEFAULT_MAX_SEGMENT_SIZE, HIERARCHICAL_OPS,
+                        ReduceFunc, StreamFlags, TAG_ANY, VALID_ALGORITHMS)
 from .device.base import Device
 from .tracing import METRICS, Profiler, TRACE
 
@@ -73,6 +76,24 @@ class ACCL:
         self._scratch_bufs: dict[tuple[int, str], ACCLBuffer] = {}
         self.profiler = Profiler()
         self.tuner = tuner
+        # two-tier hierarchy (accl_tpu/hier): configured explicitly via
+        # configure_hierarchy() or auto-derived once from a tuner's
+        # MeshTopology on the first AUTO-resolved collective
+        self._hier = None
+        self._hier_autoprobe = True
+        # logical-call attribution: phases of a hierarchical/redistribute
+        # program record this tag as CallRecord.parent (one driver is
+        # used from one thread at a time — the established driver
+        # threading contract)
+        self._parent_tag = ""
+        # redistribution engine state: memoized plans (pure geometry),
+        # cached member-subset sub-communicators, and recycled async
+        # staging buffers (popped at issue by the driver thread,
+        # appended back by the completion callback — GIL-atomic ops)
+        self._redist_plans: dict = {}
+        self._redist_comms: dict = {}
+        self._redist_stage_pool: dict = {}
+        self._redist_seq = itertools.count(1)
         # async calls this driver has issued that have not retired yet —
         # tuner-training measurements only happen on a quiet device
         # (an unrelated in-flight call would add its queue wait to the
@@ -222,6 +243,89 @@ class ACCL:
         self.device.configure_communicator(sub, tenant=self.tenant)
         self.communicators.append(sub)
         return sub
+
+    # -- two-tier hierarchy (accl_tpu/hier) --------------------------------
+    def configure_hierarchy(self, hosts: Sequence[int]):
+        """Declare the world's two-tier structure: ``hosts[r]`` is the
+        host id of world rank ``r`` (each host's ranks contiguous).
+        Builds the intra-host / inter-host sub-communicators the
+        HIERARCHICAL phase programs run over; every rank must configure
+        the same mapping (sub-comm ids derive deterministically from
+        membership, like :meth:`split_communicator`). Returns the
+        :class:`~accl_tpu.hier.Hierarchy`."""
+        from .hier import Hierarchy
+        self._hier = Hierarchy(self, hosts)
+        return self._hier
+
+    @property
+    def hierarchy(self):
+        return self._hier
+
+    def _ensure_hier(self):
+        """Auto-configure the hierarchy once from an attached tuner's
+        two-tier MeshTopology (the emu ``hosts=`` wiring and real
+        deployments both land here) — deterministic across ranks, since
+        every rank binds the same device topology."""
+        if self._hier is not None or not self._hier_autoprobe:
+            return self._hier
+        self._hier_autoprobe = False
+        topo = getattr(self.tuner, "topology", None)
+        groups = getattr(topo, "groups", None)
+        if groups and len(groups) > 1 \
+                and sum(len(g) for g in groups) == self.comm.size:
+            from .hier import Hierarchy
+            self._hier = Hierarchy(self, topo.hosts_list())
+        return self._hier
+
+    def _hier_route(self, op: str, comm: Communicator, count: int,
+                    elem_bytes: int, algorithm) -> bool:
+        """True when this collective must lower to a hierarchical phase
+        program instead of a flat descriptor. Explicit HIERARCHICAL
+        demands a configured hierarchy over the world communicator;
+        AUTO routes when the shared tuner's two-tier cost model says
+        the phase program beats every flat schedule."""
+        if isinstance(algorithm, str):
+            algorithm = CollectiveAlgorithm[algorithm.upper()]
+        alg = CollectiveAlgorithm(algorithm)
+        H = CollectiveAlgorithm.HIERARCHICAL
+        if alg == H:
+            if self._ensure_hier() is None:
+                raise ValueError(
+                    "HIERARCHICAL requires a configured two-tier "
+                    "hierarchy: call configure_hierarchy(hosts) on every "
+                    "rank (or attach a tuner whose topology is a "
+                    "MeshTopology)")
+            if comm is not self.comm:
+                raise ValueError(
+                    "hierarchical collectives run over the WORLD "
+                    "communicator (the hierarchy's sub-communicators are "
+                    "derived from it); got a split communicator")
+            return True
+        if (alg != CollectiveAlgorithm.AUTO or self.tuner is None
+                or comm is not self.comm or op not in HIERARCHICAL_OPS):
+            return False
+        if self._parent_tag:
+            # already inside a logical program (a redistribute's
+            # internal allgather/alltoall, a hierarchy phase): stay
+            # flat — nested hierarchical lowering would overwrite the
+            # parent attribution tag and re-chain phases under a
+            # different logical call
+            return False
+        if self._ensure_hier() is None:
+            return False
+        return self.tuner.select(op, comm.size,
+                                 count * elem_bytes) == H
+
+    @contextlib.contextmanager
+    def _attributed(self, tag: str):
+        """Scope marking every call issued inside it as a phase of one
+        logical call: their CallRecords carry ``parent=tag``."""
+        prev = self._parent_tag
+        self._parent_tag = tag
+        try:
+            yield
+        finally:
+            self._parent_tag = prev
 
     def soft_reset(self):
         """Rank-local soft reset through the call path (HOUSEKEEP_SWRST
@@ -382,6 +486,13 @@ class ACCL:
                 algorithm = self.tuner.select(
                     scenario.name, comm.size,
                     count * cfg.uncompressed_elem_bytes)
+                if algorithm == CollectiveAlgorithm.HIERARCHICAL:
+                    # safety net for paths that do not intercept the
+                    # hierarchical route (barrier's internal allreduce,
+                    # hierarchy phase calls): a flat descriptor carries
+                    # a flat algorithm (accl_tpu/hier lowers
+                    # HIERARCHICAL before a descriptor exists)
+                    algorithm = DEFAULT_ALGORITHMS[scenario.name]
         return CallDescriptor(
             scenario=scenario, count=count, comm_id=comm.comm_id,
             root_src_dst=root_src_dst, function=func, tag=tag,
@@ -476,7 +587,8 @@ class ACCL:
                                  comm_id=desc.comm_id, t0=t0,
                                  algorithm=alg_label,
                                  tenant=self.tenant
-                                 or f"comm-{desc.comm_id}")
+                                 or f"comm-{desc.comm_id}",
+                                 parent=self._parent_tag)
         if observing:
             # retire-time measurement back to the tuner (same done-callback
             # path the profiler records through: async chains credit their
@@ -641,6 +753,12 @@ class ACCL:
               waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
         count = count if count is not None else buf.size
+        if self._hier_route("bcast", comm, count, buf.dtype.itemsize,
+                            algorithm):
+            return self._hier.run("bcast", count=count, src=buf,
+                                  root=root,
+                                  compress_dtype=compress_dtype,
+                                  run_async=run_async, waitfor=waitfor)
         desc = self._prepare(CCLOp.bcast, count=count, comm=comm,
                              root_src_dst=root, op0=buf,
                              compress_dtype=compress_dtype,
@@ -727,6 +845,14 @@ class ACCL:
                   run_async: bool = False, chain: bool = False,
                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
+        if self._hier_route(
+                "allgather", comm, count,
+                max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
+                algorithm):
+            return self._hier.run("allgather", count=count, src=srcbuf,
+                                  dst=dstbuf,
+                                  compress_dtype=compress_dtype,
+                                  run_async=run_async, waitfor=waitfor)
         desc = self._prepare(CCLOp.allgather, count=count, comm=comm,
                              op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
@@ -741,6 +867,14 @@ class ACCL:
                   run_async: bool = False, chain: bool = False,
                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         comm = comm or self.comm
+        if self._hier_route(
+                "allreduce", comm, count,
+                max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
+                algorithm):
+            return self._hier.run("allreduce", count=count, src=srcbuf,
+                                  dst=dstbuf, func=func,
+                                  compress_dtype=compress_dtype,
+                                  run_async=run_async, waitfor=waitfor)
         desc = self._prepare(CCLOp.allreduce, count=count, comm=comm,
                              func=func, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
@@ -756,6 +890,14 @@ class ACCL:
                        waitfor: Sequence[CallHandle] = ()) -> CallHandle:
         """count = per-rank chunk; srcbuf holds world_size*count."""
         comm = comm or self.comm
+        if self._hier_route(
+                "reduce_scatter", comm, count,
+                max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
+                algorithm):
+            return self._hier.run("reduce_scatter", count=count,
+                                  src=srcbuf, dst=dstbuf, func=func,
+                                  compress_dtype=compress_dtype,
+                                  run_async=run_async, waitfor=waitfor)
         desc = self._prepare(CCLOp.reduce_scatter, count=count, comm=comm,
                              func=func, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
@@ -778,6 +920,220 @@ class ACCL:
                              op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype)
         return self._call(desc, run_async, waitfor, chain)
+
+    def redistribute(self, srcbuf: ACCLBuffer, src_spec,
+                     dstbuf: ACCLBuffer, dst_spec, *,
+                     comm: Communicator | None = None,
+                     members: Sequence[int] | None = None,
+                     compress_dtype=None, run_async: bool = False,
+                     waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+        """Change an array's sharding: ``srcbuf`` holds this rank's
+        shard under ``src_spec`` (:class:`~accl_tpu.hier.ShardSpec`),
+        and on completion ``dstbuf`` holds its shard under ``dst_spec``.
+
+        The compiler (accl_tpu/hier/redistribute.py) lowers the spec
+        pair to the minimal program the change admits — local slice
+        copies, one allgather, one alltoall, or rotated point-to-point
+        sends — and this driver executes it over ``comm`` (default: the
+        world). ``members`` restricts the exchange to a world-rank
+        subset: the driver derives (and caches) the sub-communicator,
+        and both specs must span ``len(members)`` ranks. Overlapping
+        src/dst buffers (in-place resharding) are staged through a
+        scratch copy of the source shard. Every issued sub-call's
+        CallRecord carries this logical call's tag as ``parent``."""
+        import time as _time
+
+        from .hier import plan_redistribute
+        if members is not None:
+            if comm is not None:
+                # mutually exclusive in effect: members derives its own
+                # sub-communicator, which would silently bypass the
+                # passed comm (and any tenant/QoS state on it)
+                raise ValueError(
+                    "pass either comm= or members=, not both (members "
+                    "derives its own sub-communicator of those world "
+                    "ranks)")
+            members = tuple(int(m) for m in members)
+            comm = self._redist_comms.get(members)
+            if comm is None:
+                comm = self.split_communicator(list(members), key=0x52ED)
+                self._redist_comms[members] = comm
+        else:
+            comm = comm or self.comm
+        if src_spec.world != comm.size or dst_spec.world != comm.size:
+            raise ValueError(
+                f"spec worlds ({src_spec.world}, {dst_spec.world}) do "
+                f"not match the communicator size {comm.size}")
+        if srcbuf.dtype != dstbuf.dtype:
+            raise ValueError(
+                f"redistribute moves bytes, not values: src dtype "
+                f"{srcbuf.dtype.name} != dst dtype {dstbuf.dtype.name} "
+                f"(use compress_dtype for wire compression)")
+        me = comm.local_rank
+        src_count = src_spec.local_count(me)
+        dst_count = dst_spec.local_count(me)
+        if srcbuf.size < src_count or dstbuf.size < dst_count:
+            raise ValueError(
+                f"shard does not fit its buffer: src needs {src_count} "
+                f"elems (buffer {srcbuf.size}), dst needs {dst_count} "
+                f"(buffer {dstbuf.size})")
+        pk = (src_spec, dst_spec, me)
+        plan = self._redist_plans.get(pk)
+        if plan is None:
+            plan = plan_redistribute(src_spec, dst_spec, me)
+            self._redist_plans[pk] = plan
+        tag = f"redist#{next(self._redist_seq)}"
+        key = ("redistribute", comm.comm_id)
+        self._call_counts[key] = self._call_counts.get(key, 0) + 1
+        t0 = _time.perf_counter()
+
+        def _slice(buf, off, n):
+            if off == 0 and n == buf.size:
+                return buf
+            return buf[off:off + n]
+
+        # validate shapes BEFORE issuing anything, and UNIFORMLY across
+        # ranks: plans differ per rank (one rank's slices, another's
+        # whole-buffer transfers), so a slicing-aware rank-local check
+        # would raise on some ranks while their peers sail into recvs
+        # that only fail by timeout — and the p2p program's eager sends
+        # complete into peer rx pools, where a mid-program abort would
+        # strand frames for a later TAG_ANY transfer to mis-match.
+        # Hence the blanket contract: shard buffers are 1-D (flat
+        # element layout).
+        if plan.kind != "noop" and (len(srcbuf.shape) != 1
+                                    or len(dstbuf.shape) != 1):
+            raise ValueError(
+                "redistribute addresses sub-ranges of the shard "
+                "buffers; pass 1-D buffers (flat element layout)")
+
+        # in-place resharding: stage the source shard so no transfer
+        # reads bytes another transfer of the same program rewrites
+        src_arena = srcbuf
+        a0, a1 = srcbuf.address, srcbuf.address + srcbuf.nbytes
+        b0, b1 = dstbuf.address, dstbuf.address + dstbuf.nbytes
+        stage_pool = None
+        if plan.kind != "noop" and a0 < b1 and b0 < a1:
+            if run_async:
+                # a cached stage would be shared by a second async
+                # redistribute of the same shard size whose staging copy
+                # could overwrite bytes the first call's sends (on a
+                # DIFFERENT communicator — no FIFO ordering between
+                # them) are still reading; async in-place reshards draw
+                # a private buffer from a recycled pool (a fresh alloc
+                # per call would grow the device-registered memory
+                # without bound — buffers are returned by the program's
+                # completion callback below)
+                pk2 = (srcbuf.size, srcbuf.dtype.name)
+                stage_pool = self._redist_stage_pool.setdefault(pk2, [])
+                stage = stage_pool.pop() if stage_pool else \
+                    self.buffer((srcbuf.size,), srcbuf.dtype)
+            else:
+                sk = ("redist-stage", srcbuf.size, srcbuf.dtype.name)
+                stage = self._scratch_bufs.get(sk)
+                if stage is None:
+                    stage = self.buffer((srcbuf.size,), srcbuf.dtype)
+                    self._scratch_bufs[sk] = stage
+            src_arena = stage
+        handles: list[CallHandle] = []
+        with self._attributed(tag):
+            if src_arena is not srcbuf and src_count:
+                handles.append(self.copy(
+                    _slice(srcbuf, 0, src_count),
+                    _slice(src_arena, 0, src_count), src_count,
+                    run_async=True, waitfor=waitfor))
+                waitfor = (handles[-1],)
+            if plan.kind == "allgather":
+                handles.append(self.allgather(
+                    _slice(src_arena, 0, src_count), dstbuf,
+                    plan.coll_count, comm=comm,
+                    compress_dtype=compress_dtype, run_async=True,
+                    waitfor=waitfor))
+            elif plan.kind == "alltoall":
+                handles.append(self.alltoall(
+                    _slice(src_arena, 0, src_count),
+                    _slice(dstbuf, 0, dst_count), plan.coll_count,
+                    comm=comm, compress_dtype=compress_dtype,
+                    run_async=True, waitfor=waitfor))
+            else:
+                for st in plan.steps:
+                    if st.kind == "send":
+                        handles.append(self.send(
+                            _slice(src_arena, st.src_off, st.count),
+                            st.count, dst=st.peer, comm=comm,
+                            compress_dtype=compress_dtype,
+                            run_async=True, waitfor=waitfor))
+                    elif st.kind == "recv":
+                        handles.append(self.recv(
+                            _slice(dstbuf, st.dst_off, st.count),
+                            st.count, src=st.peer, comm=comm,
+                            compress_dtype=compress_dtype,
+                            run_async=True, waitfor=waitfor))
+                    else:
+                        handles.append(self.copy(
+                            _slice(src_arena, st.src_off, st.count),
+                            _slice(dstbuf, st.dst_off, st.count),
+                            st.count, run_async=True, waitfor=waitfor))
+        if run_async:
+            if not handles:
+                # nothing to issue (noop plan) — but the returned handle
+                # must still carry the caller's waitfor ordering, like
+                # the sync path's wait_all(waitfor) does
+                handles = list(waitfor)
+            if not handles:
+                return CompletedHandle(context="redistribute")
+            if len(handles) == 1:
+                ret = handles[0]
+            else:
+                # the program spans TWO communicators (local copies on
+                # the driver's comm, transfers on the possibly-split
+                # exchange comm), and the device's FIFO retirement
+                # contract is per-comm only — no single sub-call handle
+                # is guaranteed last. Aggregate: complete when EVERY
+                # sub-call has, with the OR of their error words (first
+                # exception kept).
+                import threading as _threading
+                agg = CallHandle(context="redistribute")
+                mu = _threading.Lock()
+                state = {"left": len(handles), "err": 0, "exc": None}
+
+                def _one_done(h):
+                    def cb(err):
+                        with mu:
+                            state["err"] |= int(err)
+                            if state["exc"] is None \
+                                    and h._exception is not None:
+                                state["exc"] = h._exception
+                            state["left"] -= 1
+                            done = state["left"] == 0
+                        if done:
+                            agg.complete(state["err"],
+                                         exception=state["exc"])
+                    return cb
+
+                for h in handles:
+                    h.add_done_callback(_one_done(h))
+                ret = agg
+            if stage_pool is not None:
+                # recycle the private stage only when the WHOLE program
+                # has retired (the aggregate — a single sub-call handle
+                # could complete while a transfer on the other
+                # communicator still reads the stage)
+                pool, buf = stage_pool, src_arena
+                ret.add_done_callback(lambda _err: pool.append(buf))
+            return ret
+        from .call import wait_all
+        wait_all(handles if handles else list(waitfor))
+        if self.profiler.enabled:
+            from .tracing import CallRecord
+            self.profiler.record(CallRecord(
+                op="redistribute", count=src_spec.n,
+                nbytes=src_spec.n * srcbuf.dtype.itemsize,
+                comm_id=comm.comm_id, t_start=t0,
+                duration_s=_time.perf_counter() - t0,
+                algorithm=plan.kind.upper(), parent=tag,
+                tenant=self.tenant or f"comm-{comm.comm_id}"))
+        return CompletedHandle(context="redistribute")
 
     def barrier(self, *, comm: Communicator | None = None,
                 waitfor: Sequence[CallHandle] = ()) -> CallHandle:

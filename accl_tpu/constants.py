@@ -131,6 +131,16 @@ class CollectiveAlgorithm(enum.IntEnum):
     # allreduce (halving reduce-scatter + doubling allgather). Non-power
     # of-2 worlds fold to 2^floor(log2 W) vranks in pre/post phases.
     RECURSIVE_DOUBLING = 6
+    # two-tier hierarchical program (accl_tpu/hier): NOT a moveengine
+    # expansion — the DRIVER lowers the call to a waitfor-chained phase
+    # program of flat collectives over intra-host / inter-host
+    # sub-communicators (reduce-scatter inner -> allreduce outer ->
+    # allgather inner for allreduce; see hier/engine.py for the other
+    # shapes). Descriptors therefore never carry this value to a
+    # backend; the tuner selects it from a two-tier MeshTopology
+    # (hier/topology.py) exactly when the inter-tier link is the
+    # bottleneck ("Memory-efficient array redistribution", PAPERS.md).
+    HIERARCHICAL = 7
 
 
 # Which algorithms each collective accepts (AUTO is always legal). Every
@@ -138,7 +148,8 @@ class CollectiveAlgorithm(enum.IntEnum):
 # this one table so a program behaves identically when moved across tiers.
 VALID_ALGORITHMS: dict[str, frozenset] = {
     "bcast": frozenset({CollectiveAlgorithm.ROUND_ROBIN,
-                        CollectiveAlgorithm.TREE}),
+                        CollectiveAlgorithm.TREE,
+                        CollectiveAlgorithm.HIERARCHICAL}),
     "scatter": frozenset({CollectiveAlgorithm.ROUND_ROBIN}),
     "gather": frozenset({CollectiveAlgorithm.RING,
                          CollectiveAlgorithm.ROUND_ROBIN,
@@ -148,14 +159,24 @@ VALID_ALGORITHMS: dict[str, frozenset] = {
                          CollectiveAlgorithm.TREE}),
     "allgather": frozenset({CollectiveAlgorithm.RING,
                             CollectiveAlgorithm.ROUND_ROBIN,
-                            CollectiveAlgorithm.RECURSIVE_DOUBLING}),
+                            CollectiveAlgorithm.RECURSIVE_DOUBLING,
+                            CollectiveAlgorithm.HIERARCHICAL}),
     "allreduce": frozenset({CollectiveAlgorithm.RING,
                             CollectiveAlgorithm.FUSED_RING,
                             CollectiveAlgorithm.NON_FUSED,
-                            CollectiveAlgorithm.RECURSIVE_DOUBLING}),
+                            CollectiveAlgorithm.RECURSIVE_DOUBLING,
+                            CollectiveAlgorithm.HIERARCHICAL}),
     "reduce_scatter": frozenset({CollectiveAlgorithm.RING,
-                                 CollectiveAlgorithm.RECURSIVE_DOUBLING}),
+                                 CollectiveAlgorithm.RECURSIVE_DOUBLING,
+                                 CollectiveAlgorithm.HIERARCHICAL}),
 }
+
+# Ops the driver can lower to a hierarchical two-tier phase program
+# (accl_tpu/hier). HIERARCHICAL appears in VALID_ALGORITHMS only for
+# these; it is never a static default and never reaches a backend in a
+# descriptor (the driver intercepts it before issue).
+HIERARCHICAL_OPS = frozenset({"bcast", "allgather", "allreduce",
+                              "reduce_scatter"})
 
 
 # What AUTO resolves to when no tuner is attached: one table shared by the

@@ -53,9 +53,59 @@ class EmuContext:
                  pipeline_window: int | None = None,
                  segment_stream: bool | None = None,
                  plan_cache: bool | None = None,
-                 service: "ServiceConfig | bool | None" = None):
+                 service: "ServiceConfig | bool | None" = None,
+                 hosts=None, inter_alpha_us: float | None = None,
+                 inter_beta_gbps: float | None = None):
         self.world_size = world_size
         self.fabric = LocalFabric(world_size)
+        # two-tier emulation (accl_tpu/hier): ``hosts`` maps rank->host
+        # id (contiguous runs). Devices then report a MeshTopology so an
+        # attached tuner prices hierarchical phase programs, and — when
+        # inter-tier figures are given — the fabric emulates the slow
+        # tier on every cross-host link (set_tier_profile), so measured
+        # crossovers are real, not just modeled.
+        self.hosts = list(hosts) if hosts is not None else None
+        # normalize ONCE so the emulated fabric and the reported
+        # MeshTopology can never disagree about the slow tier: a
+        # partially-specified profile fills the other figure from the
+        # same defaults topology() reports
+        self.throttle_inter = (inter_alpha_us is not None
+                               or inter_beta_gbps is not None)
+        self.inter_alpha_us = (200.0 if inter_alpha_us is None
+                               else float(inter_alpha_us))
+        self.inter_beta_gbps = (0.4 if inter_beta_gbps is None
+                                else float(inter_beta_gbps))
+        if self.hosts is None:
+            if self.throttle_inter:
+                # a slow-tier profile with no grouping would be
+                # silently ignored — a test believing it emulates DCN
+                # would measure the unthrottled loopback with no error
+                raise ValueError(
+                    "inter_alpha_us/inter_beta_gbps require hosts= "
+                    "(the rank->host grouping names the cross-host "
+                    "links to throttle)")
+        else:
+            if len(self.hosts) != world_size:
+                raise ValueError(f"hosts maps {len(self.hosts)} ranks, "
+                                 f"world is {world_size}")
+            # fail at the misconfiguration site, not later from inside a
+            # tuner's topology() query: the hierarchy machinery requires
+            # contiguous host runs (groups_from_hosts validates)
+            from ..hier import groups_from_hosts
+            groups_from_hosts(self.hosts)
+            if self.throttle_inter and len(set(self.hosts)) < 2:
+                # same silent-failure class the hosts=None guard
+                # catches: one distinct host has no cross-host link for
+                # the profile to throttle
+                raise ValueError(
+                    "inter_alpha_us/inter_beta_gbps need at least two "
+                    "distinct hosts — a one-host grouping has no "
+                    "cross-host links to throttle")
+            if self.throttle_inter:
+                # set_link_profile validates beta > 0
+                self.fabric.set_tier_profile(
+                    self.hosts, self.inter_alpha_us,
+                    self.inter_beta_gbps)
         # multi-tenant service config shared by every rank of this world
         # (policy only; per-rank controllers/quotas live on the devices).
         # None = process default ($ACCL_TPU_SERVICE, on); False = off;
@@ -295,6 +345,19 @@ class EmuDevice(Device):
         # zero-extra-worker pool overlaps one combine with recv-matching
         depth = (float(ex._n_workers + 1)
                  if ex.window > 0 and ex.segment_stream else 1.0)
+        if self.ctx.hosts is not None and len(set(self.ctx.hosts)) > 1:
+            # two-tier world: intra figures are this tier's loopback
+            # numbers; inter figures are the context's NORMALIZED
+            # profile — identical to what the fabric emulates when
+            # throttling is armed (a nominally-slower default tier when
+            # only the grouping was given: the tuner needs SOME
+            # ordering)
+            from ..hier import MeshTopology
+            return MeshTopology.from_hosts(
+                self.ctx.hosts, alpha_us=20.0, beta_gbps=4.0,
+                inter_alpha_us=self.ctx.inter_alpha_us,
+                inter_beta_gbps=self.ctx.inter_beta_gbps,
+                tier="emu-two-tier", pipeline_depth=depth)
         return Topology(world_size=self.ctx.world_size, alpha_us=20.0,
                         beta_gbps=4.0, tier="emu", pipeline_depth=depth)
 

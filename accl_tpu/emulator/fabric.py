@@ -76,10 +76,20 @@ class LocalFabric:
         self._ingress: list = [None] * world_size
         self._fault = None
         self.stats = {"sent": 0, "dropped": 0, "duplicated": 0,
-                      "corrupted": 0}
-        # per-communicator attribution of the same four counters (QoS
+                      "corrupted": 0, "throttled": 0}
+        # per-communicator attribution of the same counters (QoS
         # accounting foundation, ROADMAP item 3): comm_id -> counter dict
         self.stats_by_comm: dict[int, dict[str, int]] = {}
+        # per-link emulated profiles: (src, dst) -> (alpha_us, beta_gbps)
+        # — a frame on a profiled link sleeps alpha + nbytes/beta on the
+        # sender's thread (backpressure semantics preserved), so a
+        # LocalFabric world can emulate a slow inter-host tier for
+        # hierarchical-collective tests and the bench-emu ladder.
+        # Programmatic: set_link_profile / set_tier_profile; env:
+        # $ACCL_TPU_LINK_PROFILE="src-dst:alpha_us:beta_gbps;..."
+        self.link_profiles: dict[tuple[int, int],
+                                 tuple[float, float]] = {}
+        self._apply_env_profile()
 
     def attach(self, rank: int, ingress_fn):
         """ingress_fn(env, payload) is the rank's eager-ingress entry."""
@@ -97,11 +107,55 @@ class LocalFabric:
     def clear_fault(self):
         self._fault = None
 
+    # -- per-link profiles (slow-tier emulation) ---------------------------
+    def set_link_profile(self, src: int, dst: int, alpha_us: float,
+                         beta_gbps: float):
+        """Emulate link characteristics on the (src, dst) direction:
+        every frame pays ``alpha_us + nbytes / beta_gbps`` of sender-
+        thread delay (the LocalFabric's natural backpressure shape).
+        Pass ``alpha_us=0, beta_gbps=inf``-ish values to clear."""
+        if beta_gbps <= 0:
+            raise ValueError(f"beta_gbps must be positive, got {beta_gbps}")
+        self.link_profiles[(int(src), int(dst))] = (float(alpha_us),
+                                                    float(beta_gbps))
+
+    def clear_link_profiles(self):
+        self.link_profiles.clear()
+
+    def set_tier_profile(self, hosts, alpha_us: float, beta_gbps: float):
+        """Profile every CROSS-HOST link pair from a rank->host mapping
+        (both directions): the one-call way to emulate a two-tier mesh
+        (fast intra-host loopback, slow inter-host tier) for
+        hierarchical-collective tests and benchmarks."""
+        hosts = list(hosts)
+        for s in range(self.world_size):
+            for d in range(self.world_size):
+                if s != d and hosts[s] != hosts[d]:
+                    self.set_link_profile(s, d, alpha_us, beta_gbps)
+
+    def _apply_env_profile(self):
+        """$ACCL_TPU_LINK_PROFILE: ';'-separated "src-dst:alpha_us:
+        beta_gbps" entries (env-driven alternative to the programmatic
+        knobs, e.g. for daemon-spawned worlds)."""
+        import os
+        spec = os.environ.get("ACCL_TPU_LINK_PROFILE", "")
+        for entry in filter(None, (e.strip() for e in spec.split(";"))):
+            try:
+                pair, alpha, beta = entry.split(":")
+                s, d = pair.split("-")
+                self.set_link_profile(int(s), int(d), float(alpha),
+                                      float(beta))
+            except (ValueError, KeyError):
+                raise ValueError(
+                    f"malformed $ACCL_TPU_LINK_PROFILE entry {entry!r} "
+                    f"(want 'src-dst:alpha_us:beta_gbps')") from None
+
     def _comm_stats(self, comm_id: int) -> dict[str, int]:
         st = self.stats_by_comm.get(comm_id)
         if st is None:
             st = self.stats_by_comm[comm_id] = {
-                "sent": 0, "dropped": 0, "duplicated": 0, "corrupted": 0}
+                "sent": 0, "dropped": 0, "duplicated": 0,
+                "corrupted": 0, "throttled": 0}
         return st
 
     def send(self, env: Envelope, payload: bytes):
@@ -111,6 +165,19 @@ class LocalFabric:
         self.stats["sent"] += 1
         cst = self._comm_stats(env.comm_id)
         cst["sent"] += 1
+        prof = self.link_profiles.get((env.src, env.dst))
+        if prof is not None:
+            # emulated slow link: the sender's thread pays the wire time
+            # (alpha + bytes/beta) before delivery — same backpressure
+            # shape as the unprofiled fabric, just slower. Counted like
+            # the fault counters so a bench/test can assert the slow
+            # tier was actually exercised (stats + per-comm + registry
+            # via the collector row, key "throttled").
+            import time as _t
+            alpha_us, beta_gbps = prof
+            _t.sleep((alpha_us + env.nbytes / (beta_gbps * 1e3)) / 1e6)
+            self.stats["throttled"] += 1
+            cst["throttled"] += 1
         if _TRACE.enabled:
             _TRACE.emit("wire_send", rank=env.src, seqn=env.seqn,
                         peer=env.dst, nbytes=env.nbytes)

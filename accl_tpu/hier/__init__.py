@@ -1,0 +1,35 @@
+"""Two-tier hierarchical collectives + the array-redistribution engine.
+
+Production meshes are two-tier: fast intra-host ICI links inside a host
+(or slice), a slower DCN tier between hosts. This package turns that
+structure into first-class machinery ("Memory-efficient array
+redistribution through portable collective communication", PAPERS.md):
+
+* :class:`~accl_tpu.hier.topology.MeshTopology` — a two-tier link
+  descriptor (per-tier alpha/beta derived from a rank->host mapping)
+  the tuner's cost models price against;
+* :class:`~accl_tpu.hier.engine.Hierarchy` — driver-level lowering of
+  ``CollectiveAlgorithm.HIERARCHICAL`` to waitfor-chained phase
+  programs of flat collectives over intra-host / inter-host
+  sub-communicators (reduce-scatter inner -> allreduce outer ->
+  allgather inner for allreduce, plus bcast / allgather /
+  reduce_scatter shapes);
+* :class:`~accl_tpu.hier.sharding.ShardSpec` +
+  :func:`~accl_tpu.hier.redistribute.plan_redistribute` — a sharding
+  spec and a compiler lowering any sharding change to a minimal program
+  of allgather / alltoall / slice / point-to-point sends, executed by
+  ``ACCL.redistribute`` and differential-tested against a serial
+  gather-reshard-scatter oracle.
+"""
+
+from .topology import MeshTopology, groups_from_hosts
+from .engine import Hierarchy, plan_phases, Phase
+from .sharding import ShardSpec
+from .redistribute import plan_redistribute, redistribute_oracle, \
+    RedistPlan, RedistStep
+
+__all__ = [
+    "MeshTopology", "groups_from_hosts", "Hierarchy", "plan_phases",
+    "Phase", "ShardSpec", "plan_redistribute", "redistribute_oracle",
+    "RedistPlan", "RedistStep",
+]

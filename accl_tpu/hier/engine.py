@@ -1,0 +1,512 @@
+"""Hierarchical two-tier collectives: driver-level phase programs.
+
+``CollectiveAlgorithm.HIERARCHICAL`` is not a move expansion — it is a
+short program of FLAT collectives over sub-communicators, chained
+through the existing async ``waitfor=`` path (each phase is admitted as
+an ordinary call, so every phase rides the compiled-plan cache and the
+streamed executor exactly like a user call):
+
+* **allreduce**, index-aligned hosts (equal group size ``L`` dividing
+  the count): ``reduce_scatter(inner) -> allreduce(outer_j) ->
+  allgather(inner)`` — only ``n/L`` bytes cross the slow tier, and the
+  ``L`` outer communicators (one per intra-host index ``j``) cross it
+  CONCURRENTLY on disjoint host-pair links. Uneven hosts fall back to
+  the leader shape ``reduce(inner) -> allreduce(leaders) ->
+  bcast(inner)``.
+* **bcast**: ``bcast(one representative per host) -> bcast(inner)`` —
+  the payload crosses the slow tier ``H-1`` times instead of up to
+  ``W-1`` (the representative of the root's host is the root itself).
+* **allgather**: ``gather(inner->leader) -> leaders exchange host
+  blocks (allgather when equal, rotated point-to-point otherwise) ->
+  bcast(inner)``.
+* **reduce_scatter**: ``reduce(inner->leader) ->
+  reduce_scatter(leaders) [uneven: allreduce(leaders)] ->
+  scatter(inner)``.
+
+The planner (:func:`plan_phases`) is pure — (op, groups, rank, count,
+root) in, the rank's :class:`Phase` list out — so
+``scripts/check_blocking.py`` replays the exact programs the engine
+issues through the lane/hazard checkers, and the engine itself stays a
+thin buffer-binding loop.
+
+Phase ALGORITHM selection: with a two-tier
+:class:`~accl_tpu.hier.topology.MeshTopology` available (the attached
+tuner's), each phase gets an explicit flat algorithm ranked against its
+OWN tier's link figures (``rank_algorithms`` on the intra/inter
+one-tier Topology) — deterministic across ranks, because every member
+computes it from the same inputs. Without one, phases carry AUTO (the
+static defaults; a tuner can never resolve a phase back to HIERARCHICAL
+— the cost models price sub-mesh calls flat, and the engine/driver
+guards besides).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Sequence
+
+import numpy as np
+
+from ..constants import (CollectiveAlgorithm, HIERARCHICAL_OPS, ReduceFunc,
+                         VALID_ALGORITHMS)
+from ..tuner.cost import rank_algorithms
+from .topology import MeshTopology, groups_from_hosts
+
+__all__ = ["Phase", "HierPlan", "plan_phases", "Hierarchy"]
+
+# split keys reserved for hierarchy sub-communicators (disambiguates
+# their comm_ids from user splits over the same memberships)
+KEY_INNER = 0x48E50
+KEY_OUTER = 0x48E51
+KEY_LEADERS = 0x48E52
+KEY_REPS = 0x48E53
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One flat sub-call of a hierarchical program, for ONE rank.
+
+    ``members`` is the sub-communicator membership in comm-rank order
+    (world ranks); ``root`` is comm-LOCAL — for send/recv it is the
+    comm-local PEER instead. ``src``/``dst`` are ``(role, elem_offset,
+    elem_len)`` buffer bindings (len 0 = the whole role buffer): roles
+    ``op0``/``res`` are the user call's buffers, everything else is an
+    engine scratch sized by :attr:`HierPlan.scratch`.
+    """
+
+    scenario: str               # driver method name: "reduce_scatter", ...
+    members: tuple[int, ...]
+    count: int
+    key: int
+    root: int = 0
+    src: tuple | None = None    # (role, off, len)
+    dst: tuple | None = None
+    uses_func: bool = False     # carries the call's ReduceFunc
+    label: str = ""             # attribution tag ("inner-rs", "outer-ar")
+
+
+@dataclasses.dataclass(frozen=True)
+class HierPlan:
+    mode: str                        # "aligned" | "leader" | op-specific
+    phases: tuple[Phase, ...]        # THIS rank's phases, program order
+    scratch: dict                    # role -> elem count (engine-allocated)
+
+
+def _hostmap(groups) -> dict[int, int]:
+    return {r: h for h, g in enumerate(groups) for r in g}
+
+
+def plan_phases(op: str, groups, me: int, count: int,
+                root: int = 0) -> HierPlan | None:
+    """Compile one rank's hierarchical phase program.
+
+    ``groups``: contiguous host groups (:func:`groups_from_hosts`).
+    ``count`` follows the driver's per-op convention (total elements for
+    allreduce/bcast, per-rank chunk for allgather/reduce_scatter).
+    Returns ``None`` when the hierarchy is degenerate (fewer than two
+    hosts) — the caller should fall back to a flat call.
+    """
+    groups = tuple(tuple(g) for g in groups)
+    H = len(groups)
+    if H < 2:
+        return None
+    if op not in HIERARCHICAL_OPS:
+        raise ValueError(f"{op} has no hierarchical lowering "
+                         f"(HIERARCHICAL_OPS: {sorted(HIERARCHICAL_OPS)})")
+    W = sum(len(g) for g in groups)
+    host = _hostmap(groups)
+    h = host[me]
+    g = groups[h]
+    j = g.index(me)
+    L_h = len(g)
+    leaders = tuple(grp[0] for grp in groups)
+    sizes = {len(grp) for grp in groups}
+    aligned = len(sizes) == 1
+    L = max(sizes)
+
+    if op == "allreduce":
+        if aligned and L > 1 and count % L == 0:
+            m = count // L
+            outer_j = tuple(grp[j] for grp in groups)
+            phases = (
+                Phase("reduce_scatter", g, m, KEY_INNER,
+                      src=("op0", 0, 0), dst=("s1", 0, 0), uses_func=True,
+                      label="inner-rs"),
+                Phase("allreduce", outer_j, m, KEY_OUTER,
+                      src=("s1", 0, 0), dst=("s2", 0, 0), uses_func=True,
+                      label="outer-ar"),
+                Phase("allgather", g, m, KEY_INNER,
+                      src=("s2", 0, 0), dst=("res", 0, 0),
+                      label="inner-ag"),
+            )
+            return HierPlan("aligned", phases, {"s1": m, "s2": m})
+        phases = [Phase("reduce", g, count, KEY_INNER, root=0,
+                        src=("op0", 0, 0),
+                        dst=("sn", 0, 0) if me == g[0] else None,
+                        uses_func=True, label="inner-reduce")]
+        if me == g[0]:
+            phases.append(Phase("allreduce", leaders, count, KEY_LEADERS,
+                                src=("sn", 0, 0), dst=("res", 0, 0),
+                                uses_func=True, label="leader-ar"))
+        if L_h > 1:
+            phases.append(Phase("bcast", g, count, KEY_INNER, root=0,
+                                src=("res", 0, 0), label="inner-bcast"))
+        return HierPlan("leader", tuple(phases),
+                        {"sn": count} if me == g[0] else {})
+
+    if op == "bcast":
+        rh = host[root]
+        reps = tuple(root if hh == rh else groups[hh][0]
+                     for hh in range(H))
+        phases = []
+        if me in reps:
+            phases.append(Phase("bcast", reps, count, KEY_REPS, root=rh,
+                                src=("op0", 0, 0), label="outer-bcast"))
+        if L_h > 1:
+            rep = root if h == rh else g[0]
+            phases.append(Phase("bcast", g, count, KEY_INNER,
+                                root=g.index(rep), src=("op0", 0, 0),
+                                label="inner-bcast"))
+        return HierPlan("reps", tuple(phases), {})
+
+    if op == "allgather":
+        # host h's block of the result: its ranks' chunks, contiguous at
+        # element offset groups[h][0] * count (contiguity convention)
+        def block_off(hh: int) -> int:
+            return groups[hh][0] * count
+
+        def block_len(hh: int) -> int:
+            return len(groups[hh]) * count
+
+        phases = [Phase("gather", g, count, KEY_INNER, root=0,
+                        src=("op0", 0, 0),
+                        dst=(("res", block_off(h), block_len(h))
+                             if me == g[0] else None),
+                        label="inner-gather")]
+        if me == g[0]:
+            if aligned:
+                phases.append(Phase(
+                    "allgather", leaders, L * count, KEY_LEADERS,
+                    src=("res", block_off(h), block_len(h)),
+                    dst=("res", 0, 0), label="leader-ag"))
+            else:
+                # rotated point-to-point block exchange: eager sends
+                # first (they complete on emission — no rendezvous), the
+                # matching recvs after
+                my = leaders.index(me)
+                for step in range(1, H):
+                    to = (my + step) % H
+                    phases.append(Phase(
+                        "send", leaders, block_len(h), KEY_LEADERS,
+                        root=to, src=("res", block_off(h), block_len(h)),
+                        label="leader-send"))
+                for step in range(1, H):
+                    frm = (my - step) % H
+                    fh = frm
+                    phases.append(Phase(
+                        "recv", leaders, block_len(fh), KEY_LEADERS,
+                        root=frm, dst=("res", block_off(fh),
+                                       block_len(fh)),
+                        label="leader-recv"))
+        if L_h > 1:
+            phases.append(Phase("bcast", g, W * count, KEY_INNER, root=0,
+                                src=("res", 0, 0), label="inner-bcast"))
+        return HierPlan("aligned" if aligned else "p2p", tuple(phases),
+                        {})
+
+    if op == "reduce_scatter":
+        def block_off(hh: int) -> int:
+            return groups[hh][0] * count
+
+        phases = [Phase("reduce", g, W * count, KEY_INNER, root=0,
+                        src=("op0", 0, 0),
+                        dst=("sn", 0, 0) if me == g[0] else None,
+                        uses_func=True, label="inner-reduce")]
+        scratch = {"sn": W * count} if me == g[0] else {}
+        if me == g[0]:
+            if aligned:
+                phases.append(Phase(
+                    "reduce_scatter", leaders, L * count, KEY_LEADERS,
+                    src=("sn", 0, 0), dst=("sb", 0, 0), uses_func=True,
+                    label="leader-rs"))
+                scratch["sb"] = L * count
+                src3 = ("sb", 0, 0)
+            else:
+                phases.append(Phase(
+                    "allreduce", leaders, W * count, KEY_LEADERS,
+                    src=("sn", 0, 0), dst=("sn2", 0, 0), uses_func=True,
+                    label="leader-ar"))
+                scratch["sn2"] = W * count
+                src3 = ("sn2", block_off(h), L_h * count)
+        else:
+            src3 = None
+        phases.append(Phase("scatter", g, count, KEY_INNER, root=0,
+                            src=src3, dst=("res", 0, 0),
+                            label="inner-scatter"))
+        return HierPlan("aligned" if aligned else "leader",
+                        tuple(phases), scratch)
+
+    raise AssertionError(op)
+
+
+class Hierarchy:
+    """One driver's two-tier structure: host groups + cached sub-comms.
+
+    Built by ``ACCL.configure_hierarchy(hosts)`` (or auto-configured
+    from an attached tuner's MeshTopology). All ranks of the world must
+    configure the SAME mapping — sub-communicator ids are derived
+    deterministically from membership, so members agree without a
+    handshake, exactly like ``split_communicator``.
+    """
+
+    def __init__(self, accl, hosts):
+        self.accl = accl
+        self.hosts = list(hosts)
+        self.groups = groups_from_hosts(self.hosts)
+        if len(self.hosts) != accl.comm.size:
+            raise ValueError(
+                f"hierarchy maps {len(self.hosts)} ranks but the world "
+                f"communicator has {accl.comm.size}")
+        if len(self.groups) < 2:
+            raise ValueError(
+                "hierarchy needs at least two hosts — a one-host world "
+                "is the flat (degenerate one-tier) case")
+        self._subcomms: dict = {}
+        self._scratch: dict = {}
+        # recycled private scratch SETS for async programs (see
+        # _scratch_buf): popped by the (single) driver thread at issue,
+        # appended back by the completion callback — GIL-atomic ops, no
+        # unbounded registered-buffer growth across async calls
+        self._async_scratch_pool: list = []
+        self._seq = itertools.count(1)
+        self._alg_memo: dict = {}
+
+    # -- wiring -------------------------------------------------------------
+    def _comm(self, members: tuple, key: int):
+        c = self._subcomms.get((members, key))
+        if c is None:
+            if len(members) == self.accl.comm.size:
+                c = self.accl.comm  # full-world phase: no split needed
+            else:
+                c = self.accl.split_communicator(list(members), key=key)
+            self._subcomms[(members, key)] = c
+        return c
+
+    def _mesh_topology(self) -> MeshTopology | None:
+        t = getattr(self.accl.tuner, "topology", None)
+        if isinstance(t, MeshTopology) and t.two_tier:
+            return t
+        return None
+
+    def _phase_algorithm(self, ph: Phase, elem_bytes: int):
+        """Explicit flat algorithm for one phase, ranked against the
+        phase's OWN tier (inner phases run on the intra tier, phases
+        whose members span hosts on the inter tier). Deterministic
+        across ranks: every member computes from the same inputs."""
+        if ph.scenario not in VALID_ALGORITHMS:
+            return CollectiveAlgorithm.AUTO
+        mesh = self._mesh_topology()
+        if mesh is None:
+            return CollectiveAlgorithm.AUTO
+        key = (ph.scenario, ph.members, ph.count * elem_bytes)
+        got = self._alg_memo.get(key)
+        if got is not None:
+            return got
+        host = _hostmap(self.groups)
+        spans = len({host[r] for r in ph.members}) > 1
+        topo = (mesh.inter_topology(len(ph.members)) if spans
+                else mesh.intra_topology(len(ph.members)))
+        ranked = [(a, c) for a, c in rank_algorithms(
+            ph.scenario, topo, ph.count * elem_bytes, len(ph.members))
+            if a != CollectiveAlgorithm.HIERARCHICAL]
+        alg = ranked[0][0] if ranked else CollectiveAlgorithm.AUTO
+        self._alg_memo[key] = alg
+        return alg
+
+    def _scratch_buf(self, role: str, elems: int, dtype, private: dict
+                     | None):
+        """Scratch for one role: cached across calls for SYNC programs
+        (each sync call fully drains before the next can touch it), but
+        PRIVATE per call for async ones — two concurrent async programs
+        run their same-comm phases FIFO, yet a phase pair on DISTINCT
+        comms (call 2's inner write vs call 1's still-draining outer
+        read — reachable with singleton-host leader plans) has no
+        ordering, so a shared buffer would race. Same hazard class
+        ACCL.redistribute stages privately for."""
+        key = (role, elems, np.dtype(dtype).name)
+        if private is not None:
+            b = private.get(key)
+            if b is None:
+                b = private[key] = self.accl.buffer((elems,), dtype)
+            return b
+        b = self._scratch.get(key)
+        if b is None:
+            b = self.accl.buffer((elems,), dtype)
+            self._scratch[key] = b
+        return b
+
+    def _bind(self, spec, src, dst, scratch_sizes, dtype,
+              private: dict | None = None):
+        """Resolve a (role, off, len) binding to an ACCLBuffer."""
+        if spec is None:
+            return None
+        role, off, length = spec
+        if role == "op0":
+            b = src
+        elif role == "res":
+            b = dst
+        else:
+            b = self._scratch_buf(role, scratch_sizes[role], dtype,
+                                  private)
+        if off or (length and length < b.size):
+            if len(b.shape) != 1:
+                raise ValueError(
+                    "hierarchical collectives address sub-ranges of the "
+                    "result buffer; pass 1-D buffers (flat element "
+                    "layout) for hierarchical calls")
+            return b[off:off + length] if length else b[off:]
+        return b
+
+    # -- execution ----------------------------------------------------------
+    def run(self, op: str, *, count: int, src=None, dst=None,
+            func: ReduceFunc = ReduceFunc.SUM, root: int = 0,
+            compress_dtype=None, run_async: bool = False,
+            waitfor: Sequence = ()):
+        """Issue one hierarchical collective as a waitfor-chained phase
+        program; returns the final phase's handle (async) or a completed
+        handle (sync). Falls back to ``None`` only never — a configured
+        hierarchy always has >= 2 hosts (ctor contract)."""
+        accl = self.accl
+        me = accl.comm.local_rank
+        plan = plan_phases(op, self.groups, me, count, root)
+        assert plan is not None  # ctor guarantees >= 2 hosts
+        dtype = (np.promote_types(src.dtype, dst.dtype)
+                 if (src is not None and dst is not None)
+                 else (src if src is not None else dst).dtype)
+        ebytes = np.dtype(dtype).itemsize
+        tag = f"hier:{op}#{next(self._seq)}"
+        nbytes = count * ebytes
+        # tuner-training hygiene, mirroring ACCL._call: only a sync,
+        # dependency-free call issued on a quiet device measures the
+        # algorithm rather than its queueing context — a waitfor dep or
+        # concurrent async work would inflate the window (the check
+        # must happen at ISSUE time; by retirement the storm that
+        # inflated us may itself have drained)
+        observing = (accl.tuner is not None and not run_async
+                     and not waitfor and accl._async_inflight == 0
+                     and accl.tuner.quiescent())
+        t0 = time.perf_counter()
+        key = (op, accl.comm.comm_id)
+        accl._call_counts[key] = accl._call_counts.get(key, 0) + 1
+        # validate buffer shapes BEFORE issuing anything: a mid-program
+        # shape error after phase 1 left async would orphan an in-flight
+        # inner collective (peers block to timeout) and strand eager
+        # frames in sub-communicator rx pools for later calls to
+        # mis-match. The rule must also be UNIFORM across ranks — only
+        # LEADER plans slice the result buffer, so a rank-local check
+        # would raise on leaders while non-leaders sail into a recv
+        # that times out waiting for them.
+        if op == "allgather" and dst is not None \
+                and len(dst.shape) != 1:
+            raise ValueError(
+                "hierarchical allgather addresses host-block "
+                "sub-ranges of the result buffer; pass a 1-D result "
+                "buffer (flat element layout)")
+        for ph in plan.phases:
+            for spec in (ph.src, ph.dst):
+                if spec is None:
+                    continue
+                role, off, length = spec
+                b = (src if role == "op0"
+                     else dst if role == "res" else None)
+                if b is None:
+                    continue  # engine scratch is always flat
+                if (off or (length and length < b.size)) \
+                        and len(b.shape) != 1:
+                    raise ValueError(
+                        "hierarchical collectives address sub-ranges "
+                        "of the user buffers; pass 1-D buffers (flat "
+                        "element layout) for hierarchical calls")
+        prev = list(waitfor)
+        last = None
+        private = None
+        if run_async:
+            private = (self._async_scratch_pool.pop()
+                       if self._async_scratch_pool else {})
+        with accl._attributed(tag):
+            for ph in plan.phases:
+                comm = self._comm(ph.members, ph.key)
+                sb = self._bind(ph.src, src, dst, plan.scratch, dtype,
+                                private)
+                db = self._bind(ph.dst, src, dst, plan.scratch, dtype,
+                                private)
+                alg = self._phase_algorithm(ph, ebytes)
+                kw = dict(run_async=True, waitfor=prev, comm=comm)
+                if ph.scenario == "reduce_scatter":
+                    h = accl.reduce_scatter(sb, db, ph.count, func,
+                                            algorithm=alg,
+                                            compress_dtype=compress_dtype,
+                                            **kw)
+                elif ph.scenario == "allreduce":
+                    h = accl.allreduce(sb, db, ph.count, func,
+                                       algorithm=alg,
+                                       compress_dtype=compress_dtype, **kw)
+                elif ph.scenario == "allgather":
+                    h = accl.allgather(sb, db, ph.count, algorithm=alg,
+                                       compress_dtype=compress_dtype, **kw)
+                elif ph.scenario == "gather":
+                    h = accl.gather(sb, db, ph.count, root=ph.root,
+                                    algorithm=alg,
+                                    compress_dtype=compress_dtype, **kw)
+                elif ph.scenario == "reduce":
+                    h = accl.reduce(sb, db, ph.count, root=ph.root,
+                                    func=func, algorithm=alg,
+                                    compress_dtype=compress_dtype, **kw)
+                elif ph.scenario == "scatter":
+                    h = accl.scatter(sb, db, ph.count, root=ph.root,
+                                     compress_dtype=compress_dtype, **kw)
+                elif ph.scenario == "bcast":
+                    h = accl.bcast(sb, ph.count, root=ph.root,
+                                   algorithm=alg,
+                                   compress_dtype=compress_dtype, **kw)
+                elif ph.scenario == "send":
+                    h = accl.send(sb, ph.count, dst=ph.root,
+                                  compress_dtype=compress_dtype, **kw)
+                elif ph.scenario == "recv":
+                    h = accl.recv(db, ph.count, src=ph.root,
+                                  compress_dtype=compress_dtype, **kw)
+                else:
+                    raise AssertionError(ph.scenario)
+                prev = [h]
+                last = h
+        if last is None:  # rank participates in no phase (cannot happen
+            from ..call import CompletedHandle  # today; defensive)
+            return CompletedHandle(context=op)
+        if run_async:
+            if private is not None:
+                # recycle the private scratch set once the LAST phase
+                # retires (every earlier phase is waitfor-ordered
+                # before it, so nothing reads the set afterwards)
+                pool = self._async_scratch_pool
+
+                def _recycle(_err, _p=private):
+                    pool.append(_p)
+
+                last.add_done_callback(_recycle)
+            return last
+        last.wait()
+        dt = time.perf_counter() - t0
+        if accl.profiler.enabled:
+            from ..tracing import CallRecord
+            accl.profiler.record(CallRecord(
+                op=op, count=count, nbytes=nbytes,
+                comm_id=accl.comm.comm_id, t_start=t0, duration_s=dt,
+                algorithm="HIERARCHICAL", parent=tag,
+                tenant=accl.tenant or f"comm-{accl.comm.comm_id}"))
+        if observing:
+            accl.tuner.observe(op, accl.comm.size, nbytes,
+                               CollectiveAlgorithm.HIERARCHICAL, dt)
+        from ..call import CompletedHandle
+        return CompletedHandle(context=op)

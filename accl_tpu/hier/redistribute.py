@@ -1,0 +1,196 @@
+"""The redistribution compiler: sharding change -> minimal program.
+
+``ACCL.redistribute(srcbuf, src_spec, dstbuf, dst_spec)`` lowers an
+arbitrary :class:`~accl_tpu.hier.sharding.ShardSpec` change to the
+cheapest program the spec pair admits ("Memory-efficient array
+redistribution through portable collective communication", PAPERS.md):
+
+* identical specs, or a replicated source -> pure local **slice**
+  copies (every byte is already on-rank; nothing crosses the wire);
+* even blocks -> replicated -> one **allgather**;
+* even blocks <-> uniform block-cyclic of matching grain -> one
+  **alltoall** (both directions reduce to exactly the alltoall op's
+  send-chunk-j-to-rank-j / chunk-from-i-lands-at-i*c layout — proved in
+  the plan tests);
+* anything else (uneven blocks, permutations, subsets, grain changes)
+  -> **point-to-point** sends/recvs computed from interval ownership,
+  rotated by peer distance to spread incast, eager sends before recvs
+  so no rendezvous cycle exists.
+
+The planner is pure geometry (specs + rank in, steps out), so the
+differential suite and ``scripts/check_blocking.py`` replay exactly
+what the driver issues; :func:`redistribute_oracle` is the serial
+gather-reshard-scatter reference every execution must match
+bit-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .sharding import ShardSpec
+
+__all__ = ["RedistStep", "RedistPlan", "plan_redistribute",
+           "redistribute_oracle"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RedistStep:
+    """One action of rank-local program order.
+
+    ``peer`` is the comm-local counterpart for send/recv; offsets are
+    ELEMENTS into the rank's local src/dst shard buffers.
+    """
+
+    kind: str                # "copy" | "send" | "recv"
+    count: int
+    src_off: int = 0         # copy/send: offset into the local src shard
+    dst_off: int = 0         # copy/recv: offset into the local dst shard
+    peer: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class RedistPlan:
+    """One rank's compiled program.
+
+    ``kind`` names the fast path taken: "noop" (nothing to do),
+    "local" (slice copies only), "allgather" / "alltoall" (one
+    collective, ``coll_count`` elements per chunk), or "p2p" (the
+    generic ``steps`` program)."""
+
+    kind: str
+    steps: tuple[RedistStep, ...] = ()
+    coll_count: int = 0      # allgather/alltoall per-chunk elements
+
+    @property
+    def wire_transfers(self) -> int:
+        """Cross-rank transfers this rank issues/receives (p2p only)."""
+        return sum(1 for s in self.steps if s.kind in ("send", "recv"))
+
+
+def _check_pair(src: ShardSpec, dst: ShardSpec):
+    if src.n != dst.n:
+        raise ValueError(f"sharding change alters the global size: "
+                         f"{src.n} -> {dst.n} elements")
+    if src.world != dst.world:
+        raise ValueError(f"src and dst specs span different worlds: "
+                         f"{src.world} vs {dst.world}")
+
+
+def _owner_pieces(src: ShardSpec, j: int, g0: int, cnt: int):
+    """Split dst-interval [g0, g0+cnt) by SOURCE ownership: yields
+    ``(owner_rank, gstart, count, src_local_off)`` pieces. Replicated
+    sources prefer the local replica (rank j) — the minimality rule
+    that turns replicated->anything into pure slices."""
+    if src.kind == "replicated":
+        yield (j, g0, cnt, g0)
+        return
+    if src.kind == "block":
+        off = 0
+        for r, c in enumerate(src.counts):
+            lo, hi = max(g0, off), min(g0 + cnt, off + c)
+            if lo < hi:
+                yield (r, lo, hi - lo, lo - off)
+            off += c
+        return
+    # cyclic: walk chunk-aligned subpieces
+    ch, W = src.chunk, src.world
+    g = g0
+    end = g0 + cnt
+    while g < end:
+        k = g // ch                       # global chunk index
+        take = min(end, (k + 1) * ch) - g
+        owner = k % W
+        src_loc = (k // W) * ch + (g - k * ch)
+        yield (owner, g, take, src_loc)
+        g += take
+
+
+def _is_even_block(spec: ShardSpec) -> bool:
+    return (spec.kind == "block" and len(set(spec.counts)) == 1
+            and spec.counts[0] > 0)
+
+
+def plan_redistribute(src: ShardSpec, dst: ShardSpec,
+                      me: int) -> RedistPlan:
+    """Compile rank ``me``'s program for the sharding change."""
+    _check_pair(src, dst)
+    W = src.world
+    # -- collective fast paths (spec-shape keyed; the plan tests prove
+    #    each reduces to exactly the op's data movement) ------------------
+    if src == dst:
+        c = src.local_count(me)
+        if not c:
+            return RedistPlan("noop")
+        return RedistPlan("local",
+                          (RedistStep("copy", c, src_off=0, dst_off=0),))
+    if src.kind == "replicated":
+        steps = tuple(
+            RedistStep("copy", cnt, src_off=g0, dst_off=l0)
+            for g0, cnt, l0 in dst.intervals(me))
+        return RedistPlan("local" if steps else "noop", steps)
+    if dst.kind == "replicated" and _is_even_block(src):
+        return RedistPlan("allgather", coll_count=src.counts[0])
+    if (_is_even_block(src) and dst.kind == "cyclic"
+            and src.counts[0] == W * dst.chunk):
+        return RedistPlan("alltoall", coll_count=dst.chunk)
+    if (_is_even_block(dst) and src.kind == "cyclic"
+            and dst.counts[0] == W * src.chunk):
+        return RedistPlan("alltoall", coll_count=src.chunk)
+    # -- generic point-to-point program ----------------------------------
+    copies: list[RedistStep] = []
+    recvs: list[tuple] = []
+    sends: list[tuple] = []
+    for j in range(W):
+        for g0, cnt, l0 in dst.intervals(j):
+            for owner, gs, c, src_loc in _owner_pieces(src, j, g0, cnt):
+                dst_loc = l0 + (gs - g0)
+                if j == me and owner == me:
+                    copies.append(RedistStep("copy", c, src_off=src_loc,
+                                             dst_off=dst_loc))
+                elif owner == me:
+                    sends.append(((j - me) % W, gs,
+                                  RedistStep("send", c, src_off=src_loc,
+                                             peer=j)))
+                elif j == me:
+                    recvs.append(((me - owner) % W, gs,
+                                  RedistStep("recv", c, dst_off=dst_loc,
+                                             peer=owner)))
+    # rotated peer order spreads incast; per-pair order is ascending
+    # global offset on BOTH sides, so seqn matching pairs up by
+    # construction. All sends precede all recvs: sends are eager (they
+    # complete on emission into the peer's rx pool), so no rendezvous
+    # cycle exists for the pool to deadlock on.
+    sends.sort(key=lambda t: (t[0], t[1]))
+    recvs.sort(key=lambda t: (t[0], t[1]))
+    steps = tuple([s for _, _, s in sends] + [r for _, _, r in recvs]
+                  + copies)
+    if not steps:
+        return RedistPlan("noop")
+    if all(s.kind == "copy" for s in steps):
+        return RedistPlan("local", steps)
+    return RedistPlan("p2p", steps)
+
+
+def redistribute_oracle(src_shards, src: ShardSpec,
+                        dst: ShardSpec) -> list[np.ndarray]:
+    """Serial gather-reshard-scatter reference: assemble the global
+    vector from every rank's source shard, then slice each rank's
+    destination shard out of it. Pure numpy — the differential suite
+    requires every engine execution to match this bit-identically."""
+    _check_pair(src, dst)
+    dtype = np.asarray(src_shards[0]).dtype
+    glob = np.zeros(src.n, dtype=dtype)
+    for r in range(src.world):
+        arr = np.asarray(src_shards[r])
+        for g0, cnt, l0 in src.intervals(r):
+            glob[g0:g0 + cnt] = arr[l0:l0 + cnt]
+    out = []
+    for r in range(dst.world):
+        buf = np.zeros(dst.local_count(r), dtype=dtype)
+        for g0, cnt, l0 in dst.intervals(r):
+            buf[l0:l0 + cnt] = glob[g0:g0 + cnt]
+        out.append(buf)
+    return out

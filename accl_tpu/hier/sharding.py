@@ -1,0 +1,112 @@
+"""Sharding specs: how a 1-D global vector is laid out across ranks.
+
+The redistribution engine ("Memory-efficient array redistribution
+through portable collective communication", PAPERS.md) needs only a
+small spec algebra: every layout a jax_graft serving/resharding layer
+asks for is some combination of
+
+* **block** — contiguous per-rank blocks (possibly uneven, possibly
+  zero on non-participating ranks);
+* **cyclic** — equal chunks dealt round-robin (rank r holds chunks
+  r, r+W, ...), the block-cyclic family's degenerate case;
+* **replicated** — every participating rank holds the full vector.
+
+A spec is hashable and pure-geometry: :meth:`intervals` maps a rank to
+its ``(global_offset, count, local_offset)`` triples, which is all the
+compiler (redistribute.py) consumes. Specs are independent of dtype and
+of the communicator object — they bind at plan time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["ShardSpec"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """Layout of an ``n``-element vector over ``world`` comm ranks."""
+
+    kind: str                       # "block" | "cyclic" | "replicated"
+    world: int
+    n: int
+    counts: tuple[int, ...] = ()    # block: per-rank elements (sum == n)
+    chunk: int = 0                  # cyclic: elements per dealt chunk
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def block(cls, counts) -> "ShardSpec":
+        """Contiguous blocks, ``counts[r]`` elements on rank r (0 = rank
+        holds nothing)."""
+        counts = tuple(int(c) for c in counts)
+        if any(c < 0 for c in counts):
+            raise ValueError(f"negative block count in {counts}")
+        return cls(kind="block", world=len(counts), n=sum(counts),
+                   counts=counts)
+
+    @classmethod
+    def even(cls, n: int, world: int) -> "ShardSpec":
+        """Equal blocks (n must divide evenly)."""
+        if n % world:
+            raise ValueError(f"{n} elements do not split evenly over "
+                             f"{world} ranks — use ShardSpec.block")
+        return cls.block((n // world,) * world)
+
+    @classmethod
+    def cyclic(cls, n: int, world: int, chunk: int) -> "ShardSpec":
+        """Round-robin deal of ``chunk``-element pieces: rank r holds
+        chunks r, r+world, ... . ``n`` must be a whole number of chunks
+        and each rank must get the same number of them (the uniform
+        block-cyclic case the alltoall fast path keys on)."""
+        if chunk <= 0 or n % chunk:
+            raise ValueError(f"{n} elements are not a whole number of "
+                             f"{chunk}-element chunks")
+        if (n // chunk) % world:
+            raise ValueError(
+                f"{n // chunk} chunks do not deal evenly over {world} "
+                f"ranks")
+        return cls(kind="cyclic", world=world, n=n, chunk=chunk)
+
+    @classmethod
+    def replicated(cls, n: int, world: int) -> "ShardSpec":
+        return cls(kind="replicated", world=world, n=n)
+
+    # -- geometry -----------------------------------------------------------
+    def local_count(self, rank: int) -> int:
+        """Elements rank ``rank`` stores (its buffer must hold these)."""
+        if self.kind == "block":
+            return self.counts[rank]
+        if self.kind == "cyclic":
+            return self.n // self.world
+        return self.n
+
+    def intervals(self, rank: int) -> list[tuple[int, int, int]]:
+        """``(global_offset, count, local_offset)`` runs of rank's shard,
+        ascending in both global and local offset (the invariant the
+        per-pair transfer ordering relies on)."""
+        if self.kind == "replicated":
+            return [(0, self.n, 0)] if self.n else []
+        if self.kind == "block":
+            off = sum(self.counts[:rank])
+            c = self.counts[rank]
+            return [(off, c, 0)] if c else []
+        out = []
+        loc = 0
+        for g in range(rank * self.chunk, self.n,
+                       self.world * self.chunk):
+            out.append((g, self.chunk, loc))
+            loc += self.chunk
+        return out
+
+    def participants(self) -> tuple[int, ...]:
+        """Ranks that hold at least one element."""
+        return tuple(r for r in range(self.world)
+                     if self.local_count(r) > 0)
+
+    def describe(self) -> str:
+        if self.kind == "block":
+            return f"block{list(self.counts)}"
+        if self.kind == "cyclic":
+            return f"cyclic(n={self.n}, chunk={self.chunk}, W={self.world})"
+        return f"replicated(n={self.n}, W={self.world})"

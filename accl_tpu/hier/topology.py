@@ -1,0 +1,157 @@
+"""Two-tier mesh topology: per-tier alpha/beta from a rank->host mapping.
+
+:class:`~accl_tpu.tuner.cost.Topology` describes ONE fabric tier; a
+production mesh has two — fast intra-host links (ICI, shared memory,
+in-process handoff) and a slower inter-host tier (DCN, TCP). This module
+keeps ``Topology`` as the degenerate one-tier case and extends it with
+the second tier plus the host grouping, so every existing consumer
+(tuner cost models, ``recommend_segment_size``, ``Tuner._topo``'s
+``dataclasses.replace``) keeps working unchanged on either kind.
+
+The grouping convention every hierarchical expansion relies on: ranks of
+one host are CONTIGUOUS in world-rank order (host ids non-decreasing
+along ranks). That is the production mapping (process launchers number
+ranks host-major), and it is what makes a host's chunk block a single
+contiguous byte range in gather/scatter phases.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..tuner.cost import Topology
+
+__all__ = ["MeshTopology", "groups_from_hosts"]
+
+
+def groups_from_hosts(hosts) -> tuple[tuple[int, ...], ...]:
+    """Host groups (tuples of world ranks) from a rank->host-id list.
+
+    Validates the contiguity convention (module docstring): a host's
+    ranks must form one contiguous run. Host ids are opaque labels; only
+    run boundaries matter.
+    """
+    hosts = list(hosts)
+    if not hosts:
+        raise ValueError("empty rank->host mapping")
+    groups: list[list[int]] = []
+    seen: set = set()
+    cur = None
+    for rank, h in enumerate(hosts):
+        if h != cur:
+            if h in seen:
+                raise ValueError(
+                    f"host {h!r} appears in two separate rank runs — "
+                    f"hierarchical collectives require each host's ranks "
+                    f"to be contiguous in world-rank order (got hosts="
+                    f"{hosts})")
+            seen.add(h)
+            groups.append([])
+            cur = h
+        groups[-1].append(rank)
+    return tuple(tuple(g) for g in groups)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshTopology(Topology):
+    """Two-tier link descriptor.
+
+    The INHERITED fields (``alpha_us``, ``beta_gbps``, ``incast``,
+    ``pipeline_depth``, ``supported``) describe the fast INTRA-host
+    tier; the ``inter_*`` fields describe the slow inter-host tier.
+    ``groups`` is the host grouping (contiguous world ranks per host —
+    :func:`groups_from_hosts`). With one group (or none) everything
+    degenerates to the base one-tier ``Topology`` semantics and the
+    hierarchical cost models price themselves out (infinite).
+    """
+
+    groups: tuple[tuple[int, ...], ...] = ()
+    inter_alpha_us: float = 500.0   # per-hop latency on the slow tier
+    inter_beta_gbps: float = 0.1    # per-link bandwidth on the slow tier
+    inter_incast: float = 2.0       # fan-in congestion at a hot host NIC
+
+    @classmethod
+    def from_hosts(cls, hosts, *, alpha_us: float = 50.0,
+                   beta_gbps: float = 1.0,
+                   inter_alpha_us: float = 500.0,
+                   inter_beta_gbps: float = 0.1,
+                   tier: str = "two-tier", **kw) -> "MeshTopology":
+        """Build from a rank->host-id list (the usual entry point)."""
+        groups = groups_from_hosts(hosts)
+        return cls(world_size=len(list(hosts)), alpha_us=alpha_us,
+                   beta_gbps=beta_gbps, tier=tier, groups=groups,
+                   inter_alpha_us=inter_alpha_us,
+                   inter_beta_gbps=inter_beta_gbps, **kw)
+
+    # -- structure ---------------------------------------------------------
+    @property
+    def n_hosts(self) -> int:
+        return len(self.groups)
+
+    @property
+    def two_tier(self) -> bool:
+        """More than one host => the inter tier actually exists."""
+        return self.n_hosts > 1
+
+    @property
+    def aligned(self) -> bool:
+        """All hosts hold the same number of ranks (the index-aligned
+        outer-communicator decomposition applies)."""
+        sizes = {len(g) for g in self.groups}
+        return len(sizes) == 1
+
+    @property
+    def mesh_world(self) -> int:
+        return sum(len(g) for g in self.groups)
+
+    def hosts_list(self) -> list[int]:
+        """rank -> host index (inverse of ``groups``)."""
+        out = [0] * self.mesh_world
+        for h, g in enumerate(self.groups):
+            for r in g:
+                out[r] = h
+        return out
+
+    # -- per-tier views (what the phase cost models price against) ---------
+    def intra_topology(self, world_size: int | None = None) -> Topology:
+        """The fast tier as a flat one-tier Topology."""
+        return Topology(world_size=(world_size if world_size is not None
+                                    else max(len(g) for g in self.groups)),
+                        alpha_us=self.alpha_us, beta_gbps=self.beta_gbps,
+                        incast=self.incast, tier=f"{self.tier}/intra",
+                        pipeline_depth=self.pipeline_depth,
+                        supported=self.supported)
+
+    def inter_topology(self, world_size: int | None = None) -> Topology:
+        """The slow tier as a flat one-tier Topology (one endpoint per
+        host — leaders, or the index-aligned outer groups)."""
+        return Topology(world_size=(world_size if world_size is not None
+                                    else self.n_hosts),
+                        alpha_us=self.inter_alpha_us,
+                        beta_gbps=self.inter_beta_gbps,
+                        incast=self.inter_incast,
+                        tier=f"{self.tier}/inter",
+                        pipeline_depth=self.pipeline_depth,
+                        supported=self.supported)
+
+    def flat_equivalent(self) -> Topology:
+        """What a FLAT (tier-blind) algorithm effectively sees on this
+        mesh: ring-schedule weighted link figures. Of a full ring's W
+        hops, ``n_hosts`` cross the slow tier (one boundary per
+        contiguous host run, wrapping), so alpha mixes linearly by hop
+        fraction and beta mixes harmonically (per-byte times add). Only
+        the ORDERING against the hierarchical models needs to be right —
+        measurement refines the rest (tuner.py).
+        """
+        if not self.two_tier:
+            return self.intra_topology(self.world_size or self.mesh_world)
+        w = self.mesh_world
+        p = self.n_hosts / w     # fraction of ring hops crossing hosts
+        alpha = (1 - p) * self.alpha_us + p * self.inter_alpha_us
+        inv_beta = (1 - p) / self.beta_gbps + p / self.inter_beta_gbps
+        return Topology(world_size=self.world_size or w, alpha_us=alpha,
+                        beta_gbps=1.0 / inv_beta,
+                        incast=max(self.incast, self.inter_incast),
+                        tier=f"{self.tier}/flat-equivalent",
+                        pipeline_depth=self.pipeline_depth,
+                        supported=self.supported)

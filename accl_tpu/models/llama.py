@@ -24,6 +24,8 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.compat import shard_map as _shard_map
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -267,7 +269,7 @@ class Llama:
                     spec = P(dp_ax, None, ax, None)
                     f = functools.partial(ring_attention, axis_name=ax,
                                           causal=True)
-                attn = jax.shard_map(f, mesh=mesh,
+                attn = _shard_map(f, mesh=mesh,
                                      in_specs=(spec, spec, spec),
                                      out_specs=spec,
                                      check_vma=False)(qt, kt, vt)
@@ -439,7 +441,7 @@ class Llama:
                 # tp shard decodes its own head group with the fused
                 # kernel (no cache gather, no repeated-KV copy)
                 mesh, dp_ax, tp_ax = shard_ctx
-                attn = jax.shard_map(
+                attn = _shard_map(
                     flash_decode,
                     mesh=mesh,
                     in_specs=(P(dp_ax, tp_ax, None, None),

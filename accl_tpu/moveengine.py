@@ -1479,7 +1479,13 @@ def resolve_algorithm(scenario: CCLOp, algorithm, *, world_size: int,
     if tuner is not None:
         chosen = A(tuner.select(scenario.name, world_size,
                                 count * elem_bytes))
-    if chosen == A.AUTO or chosen not in valid:
+    if chosen == A.AUTO or chosen == A.HIERARCHICAL \
+            or chosen not in valid:
+        # HIERARCHICAL is a driver-level phase program (accl_tpu/hier):
+        # a descriptor that reached the ENGINE still carrying AUTO is by
+        # definition a flat single-communicator call, so a tuner leaning
+        # hierarchical falls back to the flat default here — same as
+        # expand_call's pick() table omission.
         chosen = DEFAULT_ALGORITHMS[scenario.name]
     if (scenario == CCLOp.reduce_scatter
             and chosen == A.RECURSIVE_DOUBLING and not addr_1):
@@ -1510,6 +1516,16 @@ def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
     # one validation table for every tier (constants.VALID_ALGORITHMS):
     # ops without an algorithm axis reject any explicit selector
     check_algorithm(scenario.name, alg)
+    if alg == A.HIERARCHICAL:
+        # driver-level program (accl_tpu/hier): a descriptor carrying it
+        # should have been intercepted before issue — there is no
+        # single-communicator move expansion to produce here
+        raise ValueError(
+            "HIERARCHICAL is a driver-level multi-communicator phase "
+            "program (accl_tpu/hier); issue the collective through an "
+            "ACCL driver with a configured hierarchy "
+            "(ACCL.configure_hierarchy) instead of expanding it as a "
+            "flat move program")
 
     def pick(op_algs: dict):
         """Resolve AUTO through the attached tuner (size/topology-aware),

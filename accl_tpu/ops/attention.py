@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.compat import tpu_compiler_params as _tpu_compiler_params
+
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 _LANES = 128  # min lane tile; lse/delta ride in lane-broadcast layout
 
@@ -52,7 +54,7 @@ def _interpret() -> bool:
 def _compiler_params(ndims: int):
     """Last grid dim is the streamed (revisiting) one; the rest are
     embarrassingly parallel."""
-    return pltpu.CompilerParams(
+    return _tpu_compiler_params()(
         dimension_semantics=("parallel",) * (ndims - 1) + ("arbitrary",))
 
 
@@ -275,7 +277,7 @@ def _fwd(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
             ),
             # no scratch, no revisiting: both grid dims are
             # embarrassingly parallel (megacore-partitionable)
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_tpu_compiler_params()(
                 dimension_semantics=("parallel", "parallel")),
             interpret=_interpret(),
         )(qp, kp, vp)

@@ -28,6 +28,9 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.compat import axis_size as _axis_size
+from ..utils.compat import shard_map as _shard_map
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -112,7 +115,7 @@ def ring_reduce_scatter_shard(x: jnp.ndarray, axis_name: str,
     Chunk schedule parity: firmware reduce_scatter (c:860-939) — send chunk
     me+1, round i reduces+forwards chunk me+1+i, final round keeps chunk me.
     """
-    W = lax.axis_size(axis_name)
+    W = _axis_size(axis_name)
     me = lax.axis_index(axis_name)
     op = _REDUCE_OPS[func]
     perm = _ring_perm(W)
@@ -134,7 +137,7 @@ def ring_allgather_shard(x: jnp.ndarray, axis_name: str,
     Parity: firmware allgather (c:727-828) — send own chunk along the ring;
     chunk me+i arrives at round i (decreasing-rank flow).
     """
-    W = lax.axis_size(axis_name)
+    W = _axis_size(axis_name)
     me = lax.axis_index(axis_name)
     perm = _ring_perm(W)
     out = jnp.zeros((W,) + x.shape, x.dtype)
@@ -156,7 +159,7 @@ def ring_allreduce_shard(x: jnp.ndarray, axis_name: str,
     """Ring allreduce = ring reduce-scatter + ring allgather over W chunks
     of the flattened shard (firmware allreduce, c:942-1098). ``x``: any
     shape, same on all ranks; returns the elementwise reduction."""
-    W = lax.axis_size(axis_name)
+    W = _axis_size(axis_name)
     shape, dtype = x.shape, x.dtype
     flat = x.reshape(-1)
     pad = (-flat.size) % W
@@ -213,7 +216,7 @@ def multi_axis_ring_allreduce_shard(x: jnp.ndarray,
         # shard, so phase j moves a 1/prod(earlier sizes) fraction of
         # the part on axis order[j] — the first (biggest) phase is axis i
         for ax in order:
-            W = lax.axis_size(ax)
+            W = _axis_size(ax)
             y = ring_reduce_scatter_shard(y.reshape(W, -1), ax, func,
                                           wire_dtype)
         # allgather cascade back up in reverse
@@ -315,7 +318,7 @@ def xla_compressed_allreduce_shard(x: jnp.ndarray, axis_name: str,
     accumulation: compressed reduce-scatter (all_to_all + local upcast
     reduce) then compressed all-gather — the firmware's fused 2-phase
     structure (c:942-1098) lowered to XLA's fused collectives."""
-    W = lax.axis_size(axis_name)
+    W = _axis_size(axis_name)
     shape, dtype = x.shape, x.dtype
     flat = x.reshape(-1)
     pad = (-flat.size) % W
@@ -490,7 +493,7 @@ class MeshCollectives:
             return cached
         ax = self.axis_name
         f = self._shard_fn(op, algorithm, func, wire, root)
-        fn = jax.shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
+        fn = _shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
                            out_specs=P(ax, None))
         prog = self._cache[ck] = jax.jit(fn)
         return prog
@@ -513,7 +516,7 @@ class MeshCollectives:
         def g(x):
             return f(x[None])[0]
 
-        fn = jax.shard_map(g, mesh=self.mesh, in_specs=P(ax),
+        fn = _shard_map(g, mesh=self.mesh, in_specs=P(ax),
                            out_specs=P(ax))
         prog = self._cache[ck] = jax.jit(fn)
         return prog
@@ -570,7 +573,7 @@ class MeshCollectives:
         def f(x):
             return send_recv(x[0], list(pairs), ax)[None]
 
-        fn = jax.shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
+        fn = _shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
                            out_specs=P(ax, None))
         self._evict_exchange_programs()
         prog = self._cache[ck] = jax.jit(fn)
@@ -607,7 +610,7 @@ class MeshCollectives:
         def g(x):
             return send_recv(x, list(pairs), ax)
 
-        fn = jax.shard_map(g, mesh=self.mesh, in_specs=P(ax),
+        fn = _shard_map(g, mesh=self.mesh, in_specs=P(ax),
                            out_specs=P(ax))
         self._evict_exchange_programs()
         prog = self._cache[ck] = jax.jit(fn)

@@ -24,7 +24,9 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
               tuner=None, pipeline_window: int | None = None,
               segment_stream: bool | None = None,
               plan_cache: bool | None = None,
-              service=None, tenant: str | None = None) -> list[ACCL]:
+              service=None, tenant: str | None = None,
+              hosts=None, inter_alpha_us: float | None = None,
+              inter_beta_gbps: float | None = None) -> list[ACCL]:
     """Create ``world_size`` ACCL instances sharing an in-process fabric.
 
     ``tuner`` (a single :class:`~accl_tpu.tuner.Tuner`) is shared by every
@@ -38,10 +40,17 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
     service layer (a :class:`~accl_tpu.service.ServiceConfig`, True/False,
     or None = process default, ``$ACCL_TPU_SERVICE``); ``tenant`` groups
     this driver set's communicators under one service tenant (see
-    :func:`add_tenant` for attaching further tenants to the same world)."""
+    :func:`add_tenant` for attaching further tenants to the same world).
+    ``hosts`` declares a two-tier grouping (rank->host id, contiguous
+    runs): devices then report a MeshTopology (so a shared tuner can
+    select HIERARCHICAL, accl_tpu/hier) and — with ``inter_alpha_us``/
+    ``inter_beta_gbps`` — the fabric emulates the slow inter-host tier
+    on every cross-host link."""
     kw = {"nbufs": nbufs, "pipeline_window": pipeline_window,
           "segment_stream": segment_stream, "plan_cache": plan_cache,
-          "service": service}
+          "service": service, "hosts": hosts,
+          "inter_alpha_us": inter_alpha_us,
+          "inter_beta_gbps": inter_beta_gbps}
     if bufsize is not None:
         kw["bufsize"] = bufsize
     ctx = EmuContext(world_size, **kw)

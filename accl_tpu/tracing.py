@@ -117,6 +117,13 @@ class CallRecord:
     #                             belongs to ("" on drivers without a
     #                             tenant label AND no comm grouping —
     #                             the driver defaults to "comm-<id>")
+    # logical-call grouping (accl_tpu/hier): phases of one hierarchical
+    # collective or redistribute program all carry the logical call's
+    # tag (e.g. "hier:allreduce#3"), and the logical record itself
+    # (algorithm=HIERARCHICAL / op=redistribute) carries the SAME tag —
+    # group by ``parent`` and a 3-phase hierarchical allreduce reads as
+    # one call in traces and metrics. "" = standalone call.
+    parent: str = ""
 
     @property
     def duration_us(self) -> float:
@@ -187,7 +194,7 @@ class Profiler:
 
     def attach(self, handle, op: str, count: int, nbytes: int, comm_id: int,
                t0: float | None = None, algorithm: str = "",
-               tenant: str = ""):
+               tenant: str = "", parent: str = ""):
         """Register a done callback on ``handle`` that records the call's
         host-issue -> retire duration. Pass ``t0`` captured before dispatch
         so the record covers the full issue->retire window even when the
@@ -212,7 +219,7 @@ class Profiler:
                 plan_cache=st.get("plan_cache", ""),
                 lanes=st.get("lanes", 0),
                 overlap_frac=st.get("overlap_frac", 0.0),
-                tenant=tenant))
+                tenant=tenant, parent=parent))
 
         handle.add_done_callback(_on_done)
 
@@ -254,7 +261,7 @@ class Profiler:
             f.write("op,count,nbytes,comm_id,t_start,duration_us,error,"
                     "algorithm,moves,pipelined_moves,pipeline_depth,"
                     "combine_overlap,expand_us,plan_us,plan_cache,"
-                    "lanes,overlap_frac,tenant\n")
+                    "lanes,overlap_frac,tenant,parent\n")
             for r in self.records:
                 f.write(f"{r.op},{r.count},{r.nbytes},{r.comm_id},"
                         f"{r.t_start:.9f},{r.duration_us:.3f},"
@@ -262,7 +269,8 @@ class Profiler:
                         f"{r.pipelined_moves},{r.pipeline_depth},"
                         f"{r.combine_overlap},{r.expand_us:.1f},"
                         f"{r.plan_us:.1f},{r.plan_cache},"
-                        f"{r.lanes},{r.overlap_frac:.4f},{r.tenant}\n")
+                        f"{r.lanes},{r.overlap_frac:.4f},{r.tenant},"
+                        f"{r.parent}\n")
 
     @staticmethod
     def read_csv(path: str) -> list[CallRecord]:
@@ -293,7 +301,8 @@ class Profiler:
                     plan_cache=row.get("plan_cache") or "",
                     lanes=int(row.get("lanes") or 0),
                     overlap_frac=float(row.get("overlap_frac") or 0.0),
-                    tenant=row.get("tenant") or ""))
+                    tenant=row.get("tenant") or "",
+                    parent=row.get("parent") or ""))
         return out
 
 # -- flight recorder --------------------------------------------------------

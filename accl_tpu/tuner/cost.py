@@ -223,6 +223,135 @@ def _gather_tree(topo: Topology, w: int, nbytes: float) -> float:
             + _RD_WIRE_FACTOR * (w - 1) * topo.wire_us(nbytes))
 
 
+# -- two-tier hierarchical family (accl_tpu/hier) ---------------------------
+#
+# HIERARCHICAL is a DRIVER-level phase program over sub-communicators
+# (hier/engine.py): e.g. allreduce = reduce-scatter(inner) ->
+# allreduce(outer) -> allgather(inner). Its cost is the sum of the
+# cheapest FLAT phase costs on each tier's own Topology — the same
+# per-tier selection the engine performs — plus a small per-phase
+# driver-chaining overhead. On a one-tier Topology (no ``groups``
+# attribute, or a single host) the models price themselves out
+# (infinite), so AUTO picks hierarchical exactly when a two-tier
+# MeshTopology says the inter-tier link is worth avoiding. Flat
+# algorithms on a MeshTopology are priced against its
+# ``flat_equivalent()`` (ring-hop weighted alpha / harmonic beta), so
+# the crossover the selection produces is inter-vs-intra beta ratio —
+# the point of the subsystem.
+
+_HIER_PHASE_ALPHAS = 3.0   # driver-side phase chaining (waitfor hops)
+
+
+def _hier_mesh(topo: Topology, w: int):
+    """The MeshTopology behind ``topo`` IF the call spans its full mesh
+    (duck-typed — cost.py must not import accl_tpu.hier). Sub-communicator
+    calls (w != mesh world) are flat by definition."""
+    groups = getattr(topo, "groups", None)
+    if not groups or len(groups) < 2:
+        return None
+    if sum(len(g) for g in groups) != w:
+        return None
+    return topo
+
+
+def _best_flat(op: str, topo: Topology, nbytes: float, w: int) -> float:
+    """Cheapest FLAT algorithm's predicted cost for one phase on one
+    tier — mirrors the engine's per-phase selection (hier/engine.py)."""
+    if w <= 1:
+        return 0.0
+    best = math.inf
+    for a in VALID_ALGORITHMS.get(op, ()):  # noqa: B007
+        if a == _A.HIERARCHICAL:
+            continue
+        if topo.supported is not None and (op, a) not in topo.supported:
+            continue
+        model = _MODELS.get((op, a))
+        if model is None:
+            continue
+        best = min(best, model(topo, w, float(nbytes)))
+    return best
+
+
+def _hier_tiers(mesh):
+    intra = mesh.intra_topology()
+    inter = mesh.inter_topology()
+    L = max(len(g) for g in mesh.groups)
+    return intra, inter, L, mesh.n_hosts
+
+
+def _allreduce_hier(topo: Topology, w: int, nbytes: float) -> float:
+    """reduce-scatter(inner) -> allreduce(outer) -> allgather(inner)
+    when hosts are index-aligned (only n/L bytes ever cross the slow
+    tier, concurrently per inner index); reduce(inner) ->
+    allreduce(leaders) -> bcast(inner) otherwise (full n over the slow
+    tier, but still once instead of the flat ring's repeated
+    crossings)."""
+    mesh = _hier_mesh(topo, w)
+    if mesh is None:
+        return math.inf
+    intra, inter, L, H = _hier_tiers(mesh)
+    over = _HIER_PHASE_ALPHAS * intra.alpha_us
+    # the cheap aligned shape additionally needs the ELEMENT count to
+    # divide by L (plan_phases falls back to the leader shape
+    # otherwise). The model only sees bytes; nbytes % L == 0 is the
+    # necessary-condition proxy (count % L == 0 implies it), so
+    # byte-indivisible sizes are priced at the leader cost they will
+    # actually pay. A byte-divisible but element-indivisible size still
+    # mispredicts toward the aligned cost — a bounded misprediction the
+    # EWMA refinement corrects from real retire times.
+    if mesh.aligned and L > 1 and nbytes % L == 0:
+        m = nbytes / L
+        return (over + _best_flat("reduce_scatter", intra, m, L)
+                + _best_flat("allreduce", inter, m, H)
+                + _best_flat("allgather", intra, m, L))
+    return (over + _best_flat("reduce", intra, nbytes, L)
+            + _best_flat("allreduce", inter, nbytes, H)
+            + _best_flat("bcast", intra, nbytes, L))
+
+
+def _allgather_hier(topo: Topology, w: int, nbytes: float) -> float:
+    """gather(inner->leader) -> allgather(leaders, host blocks) ->
+    bcast(inner, whole vector). ``nbytes`` is the per-rank chunk (the
+    chunked-op convention, module docstring)."""
+    mesh = _hier_mesh(topo, w)
+    if mesh is None:
+        return math.inf
+    intra, inter, L, H = _hier_tiers(mesh)
+    over = _HIER_PHASE_ALPHAS * intra.alpha_us
+    return (over + _best_flat("gather", intra, nbytes, L)
+            + _best_flat("allgather", inter, L * nbytes, H)
+            + _best_flat("bcast", intra, w * nbytes, L))
+
+
+def _reduce_scatter_hier(topo: Topology, w: int, nbytes: float) -> float:
+    """reduce(inner->leader, whole vector) -> reduce_scatter(leaders,
+    host blocks) [uneven hosts: allreduce(leaders)] -> scatter(inner).
+    ``nbytes`` is the per-rank chunk."""
+    mesh = _hier_mesh(topo, w)
+    if mesh is None:
+        return math.inf
+    intra, inter, L, H = _hier_tiers(mesh)
+    over = _HIER_PHASE_ALPHAS * intra.alpha_us
+    outer = (_best_flat("reduce_scatter", inter, L * nbytes, H)
+             if mesh.aligned
+             else _best_flat("allreduce", inter, w * nbytes, H))
+    return (over + _best_flat("reduce", intra, w * nbytes, L) + outer
+            + _best_flat("scatter", intra, nbytes, L))
+
+
+def _bcast_hier(topo: Topology, w: int, nbytes: float) -> float:
+    """bcast(root -> one representative per host over the slow tier) ->
+    bcast(inner): the payload crosses the slow tier H-1 times instead of
+    up to W-1."""
+    mesh = _hier_mesh(topo, w)
+    if mesh is None:
+        return math.inf
+    intra, inter, L, H = _hier_tiers(mesh)
+    over = _HIER_PHASE_ALPHAS * intra.alpha_us
+    return (over + _best_flat("bcast", inter, nbytes, H)
+            + _best_flat("bcast", intra, nbytes, L))
+
+
 _MODELS = {
     ("bcast", _A.ROUND_ROBIN): _bcast_rr,
     ("bcast", _A.TREE): _bcast_tree,
@@ -245,19 +374,37 @@ _MODELS = {
     ("allreduce", _A.RECURSIVE_DOUBLING): _allreduce_rd,
     ("reduce_scatter", _A.RING): _ring_chain,
     ("reduce_scatter", _A.RECURSIVE_DOUBLING): _reduce_scatter_rh,
+    ("bcast", _A.HIERARCHICAL): _bcast_hier,
+    ("allgather", _A.HIERARCHICAL): _allgather_hier,
+    ("allreduce", _A.HIERARCHICAL): _allreduce_hier,
+    ("reduce_scatter", _A.HIERARCHICAL): _reduce_scatter_hier,
 }
 
 
 def predict_us(op: str, algorithm: CollectiveAlgorithm, topo: Topology,
                nbytes: int, world_size: int | None = None) -> float:
-    """Predicted call time in microseconds for one (op, algorithm) pair."""
+    """Predicted call time in microseconds for one (op, algorithm) pair.
+
+    On a two-tier MeshTopology, FLAT algorithms are priced against the
+    mesh's ``flat_equivalent()`` link figures when the call spans the
+    full mesh (a tier-blind schedule pays the slow tier on the hops
+    that cross hosts), and against the intra tier for sub-communicator
+    calls (the hierarchical engine's phases run inside one tier; the
+    outer phase is priced explicitly by the hierarchical models).
+    HIERARCHICAL itself sees the raw mesh."""
     w = world_size if world_size is not None else topo.world_size
     if w <= 1:
         return 0.0
-    model = _MODELS.get((op, _A(algorithm)))
+    alg = _A(algorithm)
+    model = _MODELS.get((op, alg))
     if model is None:
         raise KeyError(f"no cost model for ({op}, "
                        f"{_A(algorithm).name})")
+    groups = getattr(topo, "groups", None)
+    if groups and len(groups) > 1 and alg != _A.HIERARCHICAL:
+        topo = (topo.flat_equivalent()
+                if sum(len(g) for g in groups) == w
+                else topo.intra_topology(w))
     return model(topo, w, float(nbytes))
 
 

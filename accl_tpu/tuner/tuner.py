@@ -169,10 +169,19 @@ class Tuner:
         if self.epsilon > 0 and self._rng.random() < self.epsilon:
             # exploration draws only from algorithms the tier's engines
             # implement (Topology.supported) — exploring an algorithm the
-            # peer daemon rejects would fail every call of the bucket
+            # peer daemon rejects would fail every call of the bucket —
+            # AND whose predicted cost is finite: an infinite price
+            # marks an algorithm no execution path can honor here
+            # (HIERARCHICAL on a one-tier topology / sub-communicator),
+            # which the driver would silently substitute with the flat
+            # default, wasting the exploration epoch on a mislabeled
+            # measurement stream
+            import math as _math
             cands = sorted(a for a in valid
-                           if topo.supported is None
-                           or (op, a) in topo.supported)
+                           if (topo.supported is None
+                               or (op, a) in topo.supported)
+                           and _math.isfinite(predict_us(
+                               op, a, topo, nbytes, world_size)))
             if cands:
                 pick = self._rng.choice(cands)
                 # exploration cost is observable process-wide: each pick

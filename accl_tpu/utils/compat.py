@@ -1,0 +1,103 @@
+"""jax API compatibility shims.
+
+One resolution point for jax surface drift so call sites never probe the
+installed version themselves. Current shim:
+
+* ``shard_map`` — promoted to ``jax.shard_map`` in newer releases; older
+  installs (<= 0.4.x) only ship ``jax.experimental.shard_map.shard_map``
+  (whose ``check_rep`` kwarg the shim accepts as the modern
+  ``check_vma`` spelling). Every shard_map call site in the package
+  (models/, parallel/, benchmarks, bench.py) routes through this name,
+  so a container image pinned to either side of the move runs the same
+  code.
+* ``axis_size`` — ``jax.lax.axis_size`` is newer than 0.4.x; the
+  fallback is the classic ``psum(1, axis)`` idiom (statically folded to
+  a constant under tracing, so it costs no collective).
+* ``tpu_compiler_params`` — pallas renamed ``TPUCompilerParams`` to
+  ``CompilerParams``; resolved lazily so importing this module never
+  drags pallas in.
+* ``set_mesh`` — ``jax.set_mesh`` (the sharding-in-types current-mesh
+  context) is newer than 0.4.x; the fallback enters the ``Mesh``
+  itself, which is the classic way to make a mesh current.
+* ``distributed_is_initialized`` — ``jax.distributed.is_initialized``
+  is newer than 0.4.x; the fallback inspects the distributed client's
+  global state.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _resolve_shard_map():
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    import functools
+    import inspect
+
+    from jax.experimental.shard_map import shard_map as legacy
+
+    accepted = set(inspect.signature(legacy).parameters)
+
+    @functools.wraps(legacy)
+    def shim(f, *args, **kwargs):
+        # the promoted API renamed check_rep -> check_vma; translate so
+        # call sites can use the modern spelling on either install
+        if "check_vma" in kwargs and "check_vma" not in accepted:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return legacy(f, *args, **kwargs)
+
+    return shim
+
+
+shard_map = _resolve_shard_map()
+
+
+def _resolve_axis_size():
+    sz = getattr(jax.lax, "axis_size", None)
+    if sz is not None:
+        return sz
+
+    def axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+
+    return axis_size
+
+
+axis_size = _resolve_axis_size()
+
+
+def tpu_compiler_params():
+    """The pallas TPU compiler-params class under its current name
+    (``CompilerParams``, formerly ``TPUCompilerParams``)."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls
+
+
+def set_mesh(mesh):
+    """Context manager making ``mesh`` the current mesh (modern
+    ``jax.set_mesh``; on older installs, entering the Mesh itself)."""
+    sm = getattr(jax, "set_mesh", None)
+    if sm is not None:
+        return sm(mesh)
+    return mesh
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized()`` across the API move."""
+    isi = getattr(jax.distributed, "is_initialized", None)
+    if isi is not None:
+        return bool(isi())
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
+    except Exception:  # noqa: BLE001 — private-path probe only
+        return False
+
+
+__all__ = ["shard_map", "axis_size", "tpu_compiler_params", "set_mesh",
+           "distributed_is_initialized"]

@@ -21,6 +21,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from accl_tpu.utils.compat import shard_map as _shard_map
+
 from accl_tpu.parallel import make_mesh
 from .sweep import SweepResult, sweep_collective
 
@@ -252,7 +254,7 @@ def config5_llama_grads(bucket_bytes: int = 25 << 20) -> SweepResult:
             return jnp.sum(leaf.reshape(-1)[:1])[None]
 
         from jax.sharding import PartitionSpec as P2
-        f = jax.shard_map(shard_fn, mesh=mesh, in_specs=P2("dp"),
+        f = _shard_map(shard_fn, mesh=mesh, in_specs=P2("dp"),
                           out_specs=P2("dp"), check_vma=False)
         return jax.jit(lambda v: f(v)[0])
 

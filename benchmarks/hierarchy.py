@@ -1,0 +1,116 @@
+"""Hierarchical-vs-flat ladder on a slow-inter-tier LocalFabric.
+
+Measures the crossover the two-tier cost models assert (tuner/cost.py +
+accl_tpu/hier): on a 2-host x 2-rank emu world whose cross-host links
+are throttled (LocalFabric ``set_tier_profile``), a 4 MiB allreduce
+through the hierarchical phase program — reduce-scatter(inner) ->
+allreduce(outer, concurrent per inner index) -> allgather(inner) —
+crosses the slow tier with ~n/L bytes per outer communicator, where the
+flat fused ring drags chunks across the host boundary on 2 of its 4
+hops in every one of its 2(W-1) steps. The ratio is real wall-clock
+through the same streamed executor, not a model.
+
+Methodology matches benchmarks/algorithms.py: the two algorithms are
+interleaved CALL BY CALL in one shared world and the ratio is a ratio
+of per-call MEDIANS (cancels shared-host drift, rejects scheduler
+outliers).
+
+Run directly (``python -m benchmarks.hierarchy``) for one JSON line;
+``headline()`` feeds bench.py's emulator-tier metric (``make
+bench-emu`` gates on ``ACCL_BENCH_MIN_HIER_RATIO``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from accl_tpu.constants import CollectiveAlgorithm as A
+from accl_tpu.testing import emu_world, run_ranks
+
+HOSTS = [0, 0, 1, 1]
+# slow-inter-tier profile: per-frame 200us + bytes at 0.02 GB/s on
+# every cross-host link. The gap must leave the emulated WIRE time (a
+# sender-thread sleep, which yields the CPU) dominant over the 2-core
+# host's CPU-bound dataplane work, or the ladder measures memcpy
+# throughput instead of tier crossings: at 0.02 GB/s a 1 MiB chunk
+# costs ~52 ms of wire where the whole 4 MiB flat allreduce's compute
+# is ~30 ms — the regime the hierarchical family exists for (DCN
+# between hosts vs in-package ICI is a 10-100x beta gap in production).
+INTER_ALPHA_US = 200.0
+INTER_BETA_GBPS = 0.02
+
+
+def headline(nbytes: int = 4 << 20, iters: int = 5) -> dict:
+    world = len(HOSTS)
+    count = nbytes // 4
+    chunk = count // world * 4
+    accls = emu_world(world, hosts=HOSTS,
+                      inter_alpha_us=INTER_ALPHA_US,
+                      inter_beta_gbps=INTER_BETA_GBPS,
+                      nbufs=64, bufsize=max(64 << 10, chunk // 2),
+                      timeout=120.0)
+    for a in accls:
+        a.configure_hierarchy(HOSTS)
+    try:
+        bufs = [(a.buffer(data=np.full(count, float(a.rank + 1),
+                                       np.float32)),
+                 a.buffer((count,), np.float32)) for a in accls]
+        t_flat: list[float] = []
+        t_hier: list[float] = []
+
+        def body(a):
+            src, dst = bufs[a.rank]
+            for i in range(2):  # warm both paths (plan cache, subcomms)
+                a.allreduce(src, dst, count,
+                            algorithm=A.FUSED_RING if i % 2
+                            else A.HIERARCHICAL)
+            for i in range(iters * 2):
+                alg = A.FUSED_RING if i % 2 == 0 else A.HIERARCHICAL
+                t0 = time.perf_counter()
+                a.allreduce(src, dst, count, algorithm=alg)
+                if a.rank == 0:
+                    (t_flat if i % 2 == 0
+                     else t_hier).append(time.perf_counter() - t0)
+
+        run_ranks(accls, body, timeout=600.0)
+        expect = world * (world + 1) / 2
+        for _, dst in bufs:
+            if not np.allclose(dst.data, expect):
+                raise AssertionError(
+                    f"allreduce produced {dst.data[:4]}, "
+                    f"expected {expect}")
+        throttled = accls[0].device.ctx.fabric.stats["throttled"]
+        if not throttled:
+            raise AssertionError(
+                "slow-tier profile never fired — the ladder measured "
+                "nothing hierarchical routing could improve")
+        flat = float(np.median(t_flat))
+        hier = float(np.median(t_hier))
+    finally:
+        for a in accls:
+            a.deinit()
+    return {
+        "metric": f"emu_hier_vs_flat_allreduce_{nbytes >> 20}MiB_"
+                  f"{world}rank_2host",
+        "value": round(flat / hier, 3),
+        "unit": "x",
+        "hier_ratio": round(flat / hier, 3),
+        "hier_flat_us": round(flat * 1e6, 1),
+        "hier_hier_us": round(hier * 1e6, 1),
+        "hier_throttled_frames": throttled,
+        "nbytes": nbytes,
+        "world": world,
+        "inter_beta_gbps": INTER_BETA_GBPS,
+        "tier": "emu",
+    }
+
+
+def main():
+    print(json.dumps(headline()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -99,7 +99,11 @@ def run_tune(world: int = 4, sizes=None, ops=None, reps: int = 3,
     rows = []
     try:
         for op in ops:
-            algos = sorted(VALID_ALGORITHMS[op])
+            # HIERARCHICAL is a driver-level phase program needing a
+            # configured two-tier hierarchy — not a flat algorithm the
+            # one-tier sweep world can force (accl_tpu/hier)
+            algos = sorted(a for a in VALID_ALGORITHMS[op]
+                           if a != CollectiveAlgorithm.HIERARCHICAL)
             for nbytes in sizes:
                 count = max(1, nbytes // _ELEM)
                 for alg in algos:

@@ -17,6 +17,7 @@ from accl_tpu.utils.platform import honor_platform_env
 honor_platform_env()  # the tunnel plugin overrides the plain env var
 
 import jax
+from accl_tpu.utils.compat import set_mesh as _set_mesh
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -39,7 +40,7 @@ def main():
 
     optimizer = optax.adamw(3e-4)
     opt_state = optimizer.init(params)
-    with jax.set_mesh(mesh):
+    with _set_mesh(mesh):
         step = jax.jit(model.make_train_step(optimizer, dp="dp"))
         rng = np.random.default_rng(0)
         for it in range(5):

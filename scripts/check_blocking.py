@@ -42,6 +42,16 @@ Wired into ``make lint``. Two checks:
    the same lane/hazard replay as check 2/3 — a cached plan must satisfy
    every invariant a fresh plan does, at any binding.
 
+5. **hierarchical + redistribute programs (accl_tpu/hier).** The
+   driver-level phase programs are multi-communicator: every phase of
+   every rank of a two-tier corpus (W in {4, 6, 8}, aligned AND uneven
+   host groupings) expands through the same lane/hazard replay,
+   including the aliased shapes (allgather's leaders exchange host
+   blocks of the result buffer in place). Redistribute plans replay as
+   the concatenated per-rank program the driver issues — staging copy,
+   eager sends, recvs, local copies — for block/cyclic/replicated spec
+   pairs including uneven splits and in-place resharding.
+
 Exit code 0 = clean; nonzero prints every violation.
 """
 
@@ -325,9 +335,161 @@ def _lane_edges_ok(where, moves) -> list[str]:
     return errors
 
 
+def _phase_addrs(spec, bases, ebytes):
+    """(role, off, len) binding -> byte address against the role bases."""
+    if spec is None:
+        return 0
+    role, off, _length = spec
+    return bases[role] + off * ebytes
+
+
+def check_hier_programs() -> list[str]:
+    """Check 5 (hierarchical half): expand every phase of every rank of
+    the two-tier corpus and replay it through the lane/hazard
+    checkers. Phases are separate waitfor-chained CALLS, so each phase
+    replays as its own program (the driver serializes them)."""
+    import numpy as np
+
+    from accl_tpu.arith import ArithConfig
+    from accl_tpu.constants import CCLOp, Compression, ReduceFunc, TAG_ANY
+    from accl_tpu.hier import groups_from_hosts, plan_phases
+    from accl_tpu.moveengine import MoveContext, expand_call
+
+    errors = []
+    cfg = ArithConfig(np.dtype(np.float32), np.dtype(np.float16))
+    E = cfg.uncompressed_elem_bytes
+    # role base table: disjoint regions except where the real engine
+    # genuinely aliases (phases offset into "res" — the leaders' block
+    # exchange reads/writes the SAME buffer, replayed as such)
+    bases = {"op0": 0x100000, "res": 0x200000, "s1": 0x300000,
+             "s2": 0x340000, "sn": 0x380000, "sn2": 0x3C0000,
+             "sb": 0x400000, "relay": 0x440000}
+    scen = {"reduce_scatter": CCLOp.reduce_scatter,
+            "allreduce": CCLOp.allreduce, "allgather": CCLOp.allgather,
+            "gather": CCLOp.gather, "reduce": CCLOp.reduce,
+            "scatter": CCLOp.scatter, "bcast": CCLOp.bcast,
+            "send": CCLOp.send, "recv": CCLOp.recv}
+    groupings = ([0, 0, 1, 1], [0, 0, 0, 1, 1, 1], [0, 0, 0, 0, 1, 1],
+                 [0, 0, 1, 1, 1, 2, 2, 2], [0, 0, 0, 0, 1, 1, 1, 1])
+    for hosts in groupings:
+        groups = groups_from_hosts(hosts)
+        W = len(hosts)
+        for op in ("allreduce", "allgather", "reduce_scatter", "bcast"):
+            # 24 divides every corpus group size (2, 3, 4): the aligned
+            # planner modes are exercised alongside the leader modes
+            count = 24 if op in ("allreduce", "bcast") else 6
+            for comp in (Compression.NONE, Compression.ETH_COMPRESSED):
+                for seg in (16, 1 << 20):
+                    for me in range(W):
+                        plan = plan_phases(op, groups, me, count,
+                                           root=1 if op == "bcast"
+                                           else 0)
+                        for pi, ph in enumerate(plan.phases):
+                            ctx = MoveContext(
+                                world_size=len(ph.members),
+                                local_rank=ph.members.index(me),
+                                arithcfg=cfg, max_segment_size=seg)
+                            a0 = (_phase_addrs(ph.src, bases, E)
+                                  or bases["relay"])
+                            a2 = (_phase_addrs(ph.dst, bases, E)
+                                  or bases["relay"])
+                            moves = expand_call(
+                                ctx, scen[ph.scenario], count=ph.count,
+                                root_src_dst=ph.root,
+                                func=ReduceFunc.SUM, tag=TAG_ANY,
+                                addr_0=a0, addr_1=0, addr_2=a2,
+                                compression=comp)
+                            where = (f"hier/{op}[{plan.mode}] "
+                                     f"hosts={hosts} me={me} "
+                                     f"phase{pi}={ph.label} seg={seg} "
+                                     f"comp={int(comp)}")
+                            errors += _lane_edges_ok(where, moves)
+                            errors += _hazards_ok(where, moves, cfg)
+    return errors
+
+
+def check_redistribute_programs() -> list[str]:
+    """Check 5 (redistribute half): replay each rank's CONCATENATED
+    program — staging copy when in place, eager sends, recvs, local
+    copies — exactly as the driver issues it, through the lane/hazard
+    checkers. The concatenation is stricter than the driver's per-call
+    serialization, so a pass proves the plan's transfer regions are
+    pairwise safe even if the calls ever overlap."""
+    import numpy as np
+
+    from accl_tpu.arith import ArithConfig
+    from accl_tpu.constants import CCLOp, Compression, ReduceFunc, TAG_ANY
+    from accl_tpu.hier import ShardSpec, plan_redistribute
+    from accl_tpu.moveengine import MoveContext, expand_call
+
+    errors = []
+    cfg = ArithConfig(np.dtype(np.float32), np.dtype(np.float16))
+    E = cfg.uncompressed_elem_bytes
+    pairs = [
+        ("W4-uneven-even", ShardSpec.block((10, 30, 4, 20)),
+         ShardSpec.even(64, 4)),
+        ("W4-block-cyclic", ShardSpec.even(64, 4),
+         ShardSpec.cyclic(64, 4, 4)),
+        ("W6-subset", ShardSpec.block((30, 0, 6, 0, 12, 12)),
+         ShardSpec.block((0, 0, 60, 0, 0, 0))),
+        ("W6-uneven-cyclic", ShardSpec.block((11, 7, 20, 2, 14, 6)),
+         ShardSpec.cyclic(60, 6, 2)),
+        ("W8-cyclic-uneven", ShardSpec.cyclic(128, 8, 2),
+         ShardSpec.block((8, 24, 16, 16, 8, 24, 16, 16))),
+        ("W8-grain", ShardSpec.cyclic(128, 8, 2),
+         ShardSpec.cyclic(128, 8, 8)),
+    ]
+    SRC, DST, STAGE = 0x100000, 0x200000, 0x300000
+    for label, src_spec, dst_spec in pairs:
+        W = src_spec.world
+        for inplace in (False, True):
+            for comp in (Compression.NONE, Compression.ETH_COMPRESSED):
+                for me in range(W):
+                    plan = plan_redistribute(src_spec, dst_spec, me)
+                    if plan.kind in ("noop", "allgather", "alltoall"):
+                        continue  # collectives ride the main corpus
+                    ctx = MoveContext(world_size=W, local_rank=me,
+                                      arithcfg=cfg,
+                                      max_segment_size=64)
+                    dst_base = SRC if inplace else DST
+                    arena = STAGE if inplace else SRC
+                    moves = []
+                    sc = src_spec.local_count(me)
+                    if inplace and sc:
+                        moves += expand_call(
+                            ctx, CCLOp.copy, count=sc, addr_0=SRC,
+                            addr_2=STAGE, compression=comp)
+                    for st in plan.steps:
+                        if st.kind == "send":
+                            moves += expand_call(
+                                ctx, CCLOp.send, count=st.count,
+                                root_src_dst=st.peer, tag=TAG_ANY,
+                                addr_0=arena + st.src_off * E,
+                                compression=comp)
+                        elif st.kind == "recv":
+                            moves += expand_call(
+                                ctx, CCLOp.recv, count=st.count,
+                                root_src_dst=st.peer, tag=TAG_ANY,
+                                addr_2=dst_base + st.dst_off * E,
+                                compression=comp)
+                        else:
+                            moves += expand_call(
+                                ctx, CCLOp.copy, count=st.count,
+                                addr_0=arena + st.src_off * E,
+                                addr_2=dst_base + st.dst_off * E,
+                                compression=comp)
+                    where = (f"redist/{label}[{plan.kind}] me={me} "
+                             f"inplace={int(inplace)} comp={int(comp)}")
+                    errors += _lane_edges_ok(where, moves)
+                    errors += _hazards_ok(where, moves, cfg)
+    return errors
+
+
 def main() -> int:
     errors = check_blocking_citations()
     errors += check_lane_graph()
+    errors += check_hier_programs()
+    errors += check_redistribute_programs()
     for e in errors:
         print(e, file=sys.stderr)
     if errors:
@@ -335,7 +497,8 @@ def main() -> int:
               file=sys.stderr)
         return 1
     print("check_blocking: OK (blocking=False citations + lane graph + "
-          "byte-interval hazards + relocated compiled plans)")
+          "byte-interval hazards + relocated compiled plans + "
+          "hierarchical/redistribute programs)")
     return 0
 
 

@@ -1,0 +1,514 @@
+"""Hierarchical two-tier collectives (accl_tpu/hier).
+
+Covers the MeshTopology cost plumbing (tuner AUTO must pick
+HIERARCHICAL exactly on a two-tier topology and flat ring on a uniform
+one — the acceptance unit test), the phase planner's shapes, engine
+end-to-end correctness across aligned and uneven host groupings on
+W in {4, 6, 8}, compressed variants, attribution (CallRecord.parent +
+CSV round-trip), and the LocalFabric per-link profile knob.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu.constants import CollectiveAlgorithm as A
+from accl_tpu.hier import (Hierarchy, MeshTopology, groups_from_hosts,
+                           plan_phases)
+from accl_tpu.testing import emu_world, run_ranks
+from accl_tpu.tuner import Tuner
+from accl_tpu.tuner.cost import Topology, rank_algorithms, predict_us
+
+TWO_TIER = dict(alpha_us=20.0, beta_gbps=4.0, inter_alpha_us=200.0,
+                inter_beta_gbps=0.2)
+
+
+def _mesh(hosts, **kw):
+    return MeshTopology.from_hosts(hosts, **{**TWO_TIER, **kw})
+
+
+# ---------------------------------------------------------------------------
+# topology
+# ---------------------------------------------------------------------------
+
+def test_groups_from_hosts():
+    assert groups_from_hosts([0, 0, 1, 1]) == ((0, 1), (2, 3))
+    assert groups_from_hosts(["a", "a", "b"]) == ((0, 1), (2,))
+    with pytest.raises(ValueError, match="contiguous"):
+        groups_from_hosts([0, 1, 0])
+    with pytest.raises(ValueError, match="empty"):
+        groups_from_hosts([])
+
+
+def test_mesh_topology_structure():
+    m = _mesh([0, 0, 1, 1])
+    assert m.two_tier and m.aligned and m.n_hosts == 2
+    assert m.mesh_world == 4 and m.hosts_list() == [0, 0, 1, 1]
+    assert not _mesh([0, 0, 0, 1]).aligned
+    assert not MeshTopology.from_hosts([0, 0, 0, 0]).two_tier
+    intra, inter = m.intra_topology(), m.inter_topology()
+    assert intra.alpha_us == 20.0 and intra.beta_gbps == 4.0
+    assert inter.alpha_us == 200.0 and inter.beta_gbps == 0.2
+
+
+def test_flat_equivalent_mixes_tiers():
+    m = _mesh([0, 0, 1, 1])
+    eff = m.flat_equivalent()
+    # half the ring hops cross hosts: alpha is the linear mix, beta the
+    # harmonic mix — strictly between the tiers, nearer the slow one
+    assert 20.0 < eff.alpha_us < 200.0
+    assert 0.2 < eff.beta_gbps < 4.0
+    assert eff.beta_gbps < 1.0  # harmonic mean leans slow
+    # one-tier degenerate case: intact intra figures
+    flat = MeshTopology.from_hosts([0, 0, 0]).flat_equivalent()
+    assert flat.alpha_us == 50.0  # from_hosts default intra alpha
+
+
+# ---------------------------------------------------------------------------
+# cost model + tuner selection (acceptance unit test)
+# ---------------------------------------------------------------------------
+
+def test_cost_two_tier_selects_hierarchical_large():
+    m = _mesh([0, 0, 1, 1])
+    ranked = rank_algorithms("allreduce", m, 4 << 20, 4)
+    assert ranked[0][0] == A.HIERARCHICAL
+    # and for every hierarchical-capable op the model at least exists
+    for op in ("bcast", "allgather", "reduce_scatter"):
+        costs = dict(rank_algorithms(op, m, 1 << 20, 4))
+        assert A.HIERARCHICAL in costs
+        assert np.isfinite(costs[A.HIERARCHICAL])
+
+
+def test_cost_uniform_topology_prices_hier_out():
+    flat = Topology(world_size=4, alpha_us=20.0, beta_gbps=4.0)
+    ranked = rank_algorithms("allreduce", flat, 4 << 20, 4)
+    assert ranked[0][0] == A.FUSED_RING
+    assert predict_us("allreduce", A.HIERARCHICAL, flat, 4 << 20,
+                      4) == float("inf")
+
+
+def test_cost_subcomm_never_hierarchical():
+    # a sub-communicator call (w != mesh world) prices hierarchical out
+    # — this is what makes the engine's inner/outer phases loop-free
+    m = _mesh([0, 0, 1, 1])
+    assert predict_us("allreduce", A.HIERARCHICAL, m, 1 << 20,
+                      2) == float("inf")
+
+
+def test_tuner_auto_selection_by_topology():
+    """Acceptance: AUTO -> HIERARCHICAL on two-tier, flat ring on
+    uniform — straight through Tuner.select."""
+    t2 = Tuner(topology=_mesh([0, 0, 1, 1]))
+    assert t2.select("allreduce", 4, 4 << 20) == A.HIERARCHICAL
+    t1 = Tuner(topology=Topology(world_size=4, alpha_us=20.0,
+                                 beta_gbps=4.0))
+    assert t1.select("allreduce", 4, 4 << 20) == A.FUSED_RING
+
+
+# ---------------------------------------------------------------------------
+# planner shapes
+# ---------------------------------------------------------------------------
+
+def test_plan_aligned_allreduce_three_phases():
+    g = groups_from_hosts([0, 0, 1, 1])
+    plan = plan_phases("allreduce", g, me=0, count=64)
+    assert plan.mode == "aligned"
+    assert [p.scenario for p in plan.phases] == \
+        ["reduce_scatter", "allreduce", "allgather"]
+    assert plan.phases[0].members == (0, 1)       # inner
+    assert plan.phases[1].members == (0, 2)       # outer index 0
+    assert plan.scratch == {"s1": 32, "s2": 32}
+    # rank 1's outer communicator is the other index pair
+    assert plan_phases("allreduce", g, 1, 64).phases[1].members == (1, 3)
+
+
+def test_plan_leader_mode_on_uneven_groups():
+    g = groups_from_hosts([0, 0, 0, 0, 1, 1])
+    lead = plan_phases("allreduce", g, me=0, count=64)
+    assert lead.mode == "leader"
+    assert [p.scenario for p in lead.phases] == \
+        ["reduce", "allreduce", "bcast"]
+    assert lead.phases[1].members == (0, 4)       # leaders
+    # non-leader: no outer phase
+    non = plan_phases("allreduce", g, me=2, count=64)
+    assert [p.scenario for p in non.phases] == ["reduce", "bcast"]
+    # aligned but indivisible count also falls back to leader mode
+    g2 = groups_from_hosts([0, 0, 1, 1])
+    assert plan_phases("allreduce", g2, 0, 63).mode == "leader"
+
+
+def test_plan_degenerate_and_invalid():
+    assert plan_phases("allreduce", ((0, 1, 2),), 0, 8) is None
+    with pytest.raises(ValueError, match="hierarchical lowering"):
+        plan_phases("gather", ((0,), (1,)), 0, 8)
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end (explicit HIERARCHICAL): W in {4, 6, 8},
+# aligned + uneven groupings, all four ops
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("hosts", [
+    [0, 0, 1, 1],                    # W=4 aligned
+    [0, 0, 0, 1, 1, 1],              # W=6 aligned, 2 hosts
+    [0, 0, 0, 0, 1, 1],              # W=6 uneven
+    [0, 0, 0, 1, 1, 2, 2, 2],        # W=8 uneven, 3 hosts
+    [0, 0, 0, 0, 1, 1, 1, 1],        # W=8 aligned
+], ids=lambda h: f"W{len(h)}-" + "".join(map(str, h)))
+def test_hier_collectives_correct(hosts):
+    W = len(hosts)
+    n, c = 64, 8
+    accls = emu_world(W, hosts=hosts, nbufs=32)
+    for a in accls:
+        a.configure_hierarchy(hosts)
+
+    def body(a):
+        out = {}
+        src = a.buffer(data=np.arange(n, dtype=np.float32) + a.rank)
+        dst = a.buffer((n,), np.float32)
+        a.allreduce(src, dst, n, algorithm="HIERARCHICAL")
+        out["allreduce"] = dst.data.copy()
+        b = a.buffer(data=(np.arange(n, dtype=np.float32) * 3
+                           if a.rank == 2 else np.zeros(n, np.float32)))
+        a.bcast(b, n, root=2, algorithm="HIERARCHICAL")
+        out["bcast"] = b.data.copy()
+        s = a.buffer(data=np.full(c, float(a.rank + 1), np.float32))
+        d = a.buffer((W * c,), np.float32)
+        a.allgather(s, d, c, algorithm="HIERARCHICAL")
+        out["allgather"] = d.data.copy()
+        s2 = a.buffer(data=np.arange(W * c, dtype=np.float32) + a.rank)
+        d2 = a.buffer((c,), np.float32)
+        a.reduce_scatter(s2, d2, c, algorithm="HIERARCHICAL")
+        out["reduce_scatter"] = d2.data.copy()
+        return out
+
+    try:
+        outs = run_ranks(accls, body, timeout=120.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    base = np.arange(n, dtype=np.float32)
+    exp_ar = sum(base + r for r in range(W))
+    exp_ag = np.concatenate(
+        [np.full(c, float(r + 1), np.float32) for r in range(W)])
+    full = np.arange(W * c, dtype=np.float32)
+    exp_rs = sum(full + r for r in range(W))
+    for r, o in enumerate(outs):
+        assert np.array_equal(o["allreduce"], exp_ar)
+        assert np.array_equal(o["bcast"], base * 3)
+        assert np.array_equal(o["allgather"], exp_ag)
+        assert np.array_equal(o["reduce_scatter"], exp_rs[r*c:(r+1)*c])
+
+
+def test_hier_allreduce_compressed_wire():
+    """eth-compressed phases stay exact on compressed-representable
+    data (integer-valued floats fit float16 exactly)."""
+    hosts = [0, 0, 1, 1]
+    W, n = 4, 64
+    accls = emu_world(W, hosts=hosts, nbufs=32)
+    for a in accls:
+        a.configure_hierarchy(hosts)
+
+    def body(a):
+        src = a.buffer(data=np.arange(n, dtype=np.float32) % 7 + a.rank)
+        dst = a.buffer((n,), np.float32)
+        a.allreduce(src, dst, n, algorithm="HIERARCHICAL",
+                    compress_dtype=np.float16)
+        return dst.data.copy()
+
+    try:
+        outs = run_ranks(accls, body, timeout=60.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    expect = sum(np.arange(n, dtype=np.float32) % 7 + r
+                 for r in range(W))
+    for o in outs:
+        assert np.array_equal(o, expect)
+
+
+def test_hier_auto_end_to_end():
+    """Tuner AUTO routes a large allreduce hierarchically on a two-tier
+    emu world; phase records carry the logical call's parent tag."""
+    hosts = [0, 0, 1, 1]
+    tuner = Tuner()
+    accls = emu_world(4, hosts=hosts, tuner=tuner, nbufs=64,
+                      bufsize=256 << 10, timeout=60.0)
+    assert isinstance(accls[0].device.topology(), MeshTopology)
+    n = 1 << 18   # 1 MiB f32: still hierarchical territory
+    assert tuner.select("allreduce", 4, n * 4) == A.HIERARCHICAL
+
+    def body(a):
+        src = a.buffer(data=np.ones(n, np.float32))
+        dst = a.buffer((n,), np.float32)
+        a.start_profiling()
+        a.allreduce(src, dst, n)     # AUTO
+        a.end_profiling()
+        return dst.data[0], a.profiler.records
+
+    try:
+        outs = run_ranks(accls, body, timeout=120.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    val, recs = outs[0]
+    assert val == 4.0
+    logical = [r for r in recs if r.algorithm == "HIERARCHICAL"]
+    assert len(logical) == 1 and logical[0].op == "allreduce"
+    tag = logical[0].parent
+    assert tag.startswith("hier:allreduce#")
+    phases = [r for r in recs if r is not logical[0]]
+    assert phases and all(r.parent == tag for r in phases)
+
+
+def test_hier_explicit_requires_configuration():
+    accls = emu_world(2)
+    try:
+        src = accls[0].buffer((8,), np.float32)
+        dst = accls[0].buffer((8,), np.float32)
+        with pytest.raises(ValueError, match="configure_hierarchy"):
+            accls[0].allreduce(src, dst, 8, algorithm="HIERARCHICAL")
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_hier_rejects_split_comm():
+    hosts = [0, 0, 1, 1]
+    accls = emu_world(4, hosts=hosts)
+    for a in accls:
+        a.configure_hierarchy(hosts)
+
+    def body(a):
+        sub = a.split_communicator([0, 1], key=7) \
+            if a.rank in (0, 1) else None
+        if sub is not None:
+            src = a.buffer((8,), np.float32)
+            dst = a.buffer((8,), np.float32)
+            with pytest.raises(ValueError, match="WORLD"):
+                a.allreduce(src, dst, 8, comm=sub,
+                            algorithm="HIERARCHICAL")
+
+    try:
+        run_ranks(accls, body, timeout=30.0)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_hier_rejects_multidim_buffers_before_issuing():
+    """Sub-range-addressed phases require 1-D buffers, and the shape
+    error must fire BEFORE phase 1 is issued — a mid-program failure
+    would leave an inner collective in flight on peer ranks."""
+    hosts = [0, 0, 1, 1]
+    accls = emu_world(4, hosts=hosts)
+    for a in accls:
+        a.configure_hierarchy(hosts)
+
+    def body(a):
+        s = a.buffer((8,), np.float32)
+        d2 = a.buffer((4, 8), np.float32)
+        with pytest.raises(ValueError, match="1-D"):
+            a.allgather(s, d2, 8, algorithm="HIERARCHICAL")
+
+    try:
+        run_ranks(accls, body, timeout=30.0)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_hierarchy_ctor_validation():
+    accls = emu_world(2)
+    try:
+        with pytest.raises(ValueError, match="at least two hosts"):
+            Hierarchy(accls[0], [0, 0])
+        with pytest.raises(ValueError, match="maps"):
+            Hierarchy(accls[0], [0, 1, 1])
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_moveengine_rejects_hierarchical():
+    from accl_tpu.arith import ArithConfig
+    from accl_tpu.constants import CCLOp
+    from accl_tpu.moveengine import MoveContext, expand_call, \
+        resolve_algorithm
+    cfg = ArithConfig(np.dtype(np.float32), np.dtype(np.float16))
+    ctx = MoveContext(world_size=4, local_rank=0, arithcfg=cfg,
+                      max_segment_size=1 << 20)
+    with pytest.raises(ValueError, match="driver-level"):
+        expand_call(ctx, CCLOp.allreduce, count=8, addr_0=0x1000,
+                    addr_2=0x2000, algorithm=A.HIERARCHICAL)
+
+    class HierTuner:
+        def select(self, op, world, nbytes):
+            return A.HIERARCHICAL
+
+    # an engine-level AUTO resolution leaning hierarchical falls back to
+    # the flat default (plan-cache key consistency)
+    got = resolve_algorithm(CCLOp.allreduce, A.AUTO, world_size=4,
+                            count=8, elem_bytes=4, tuner=HierTuner())
+    assert got == A.FUSED_RING
+
+
+def test_barrier_immune_to_hierarchical_tuner():
+    """The barrier's internal 1-element allreduce must stay flat even
+    when the tuner would pick HIERARCHICAL (the _prepare safety net)."""
+    hosts = [0, 0, 1, 1]
+    tuner = Tuner()
+    accls = emu_world(4, hosts=hosts, tuner=tuner)
+    # force every bucket hierarchical via a pin
+    tuner.pin("allreduce", 4, 5, A.HIERARCHICAL)
+
+    def body(a):
+        a.barrier()
+
+    try:
+        run_ranks(accls, body, timeout=30.0)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_parent_csv_round_trip(tmp_path):
+    from accl_tpu.tracing import CallRecord, Profiler
+    p = Profiler()
+    p.start()
+    p.record(CallRecord(op="allreduce", count=8, nbytes=32, comm_id=1,
+                        t_start=0.0, duration_s=1e-6,
+                        algorithm="HIERARCHICAL",
+                        parent="hier:allreduce#3"))
+    p.record(CallRecord(op="reduce_scatter", count=4, nbytes=16,
+                        comm_id=2, t_start=0.0, duration_s=1e-6,
+                        algorithm="RING", parent="hier:allreduce#3"))
+    path = str(tmp_path / "recs.csv")
+    p.to_csv(path)
+    back = Profiler.read_csv(path)
+    assert [r.parent for r in back] == ["hier:allreduce#3"] * 2
+    # grouping by parent reconstructs the logical call
+    group = {r.parent for r in back}
+    assert group == {"hier:allreduce#3"}
+
+
+def test_async_hier_private_scratch_on_singleton_host():
+    """Back-to-back ASYNC hierarchical allreduces with a singleton host:
+    call 2's inner phase (comm of one rank) has no FIFO ordering
+    against call 1's still-draining leader phase (a different comm), so
+    the engine must give each async program private scratch — a shared
+    'sn' buffer would corrupt call 1's outer read."""
+    hosts = [0, 1, 1]
+    W, n = 3, 512
+    accls = emu_world(W, hosts=hosts, nbufs=32)
+    for a in accls:
+        a.configure_hierarchy(hosts)
+
+    def body(a):
+        s1 = a.buffer(data=np.full(n, 1.0 + a.rank, np.float32))
+        d1 = a.buffer((n,), np.float32)
+        s2 = a.buffer(data=np.full(n, 10.0 + a.rank, np.float32))
+        d2 = a.buffer((n,), np.float32)
+        h1 = a.allreduce(s1, d1, n, algorithm="HIERARCHICAL",
+                         run_async=True)
+        h2 = a.allreduce(s2, d2, n, algorithm="HIERARCHICAL",
+                         run_async=True, waitfor=[h1])
+        h2.wait(60.0)
+        h1.wait(60.0)
+        return d1.data[0], d2.data[0]
+
+    try:
+        outs = run_ranks(accls, body, timeout=60.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    for v1, v2 in outs:
+        assert v1 == 6.0 and v2 == 33.0, (v1, v2)
+
+
+def test_exploration_never_draws_unpayable_hierarchical():
+    """Epsilon-greedy exploration must skip algorithms priced infinite
+    on the current topology (HIERARCHICAL on a one-tier world) — the
+    driver would silently substitute the default and the bucket's
+    exploration epoch would measure a mislabeled stream."""
+    flat = Topology(world_size=4, alpha_us=20.0, beta_gbps=4.0)
+    for seed in range(12):
+        t = Tuner(topology=flat, epsilon=1.0, seed=seed)
+        assert t.select("allreduce", 4, 4 << 20) != A.HIERARCHICAL
+
+
+def test_inter_profile_requires_hosts():
+    with pytest.raises(ValueError, match="require hosts"):
+        emu_world(2, inter_beta_gbps=0.1)
+
+
+def test_partial_inter_profile_fabric_topology_agree():
+    """A half-specified slow-tier profile must give the fabric and the
+    reported MeshTopology the SAME normalized figures."""
+    accls = emu_world(4, hosts=[0, 0, 1, 1], inter_alpha_us=50.0)
+    try:
+        topo = accls[0].device.topology()
+        ctx = accls[0].device.ctx
+        assert topo.inter_alpha_us == ctx.inter_alpha_us == 50.0
+        assert topo.inter_beta_gbps == ctx.inter_beta_gbps
+        assert ctx.fabric.link_profiles[(0, 2)] == (
+            ctx.inter_alpha_us, ctx.inter_beta_gbps)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# LocalFabric per-link profiles
+# ---------------------------------------------------------------------------
+
+def test_link_profile_throttles_and_counts():
+    from accl_tpu.emulator.fabric import Envelope, LocalFabric
+    fab = LocalFabric(2)
+    got = []
+    fab.attach(0, lambda e, p: got.append(e))
+    fab.attach(1, lambda e, p: got.append(e))
+    fab.set_link_profile(0, 1, alpha_us=20_000, beta_gbps=1.0)
+    env = Envelope(src=0, dst=1, tag=0, seqn=0, nbytes=64,
+                   wire_dtype="float32", comm_id=9)
+    t0 = time.perf_counter()
+    fab.send(env, b"x" * 64)
+    dt = time.perf_counter() - t0
+    assert dt >= 0.015   # ~20ms alpha paid on the sender thread
+    # reverse direction unprofiled: fast
+    t0 = time.perf_counter()
+    fab.send(Envelope(src=1, dst=0, tag=0, seqn=0, nbytes=64,
+                      wire_dtype="float32", comm_id=9), b"x" * 64)
+    assert time.perf_counter() - t0 < 0.010
+    assert fab.stats["throttled"] == 1
+    assert fab.stats_by_comm[9]["throttled"] == 1
+    # collector surfaces it as a fabric_throttled_total row
+    rows = list(fab.metrics_rows())
+    assert ("counter", "fabric_throttled_total",
+            {"fabric": "local", "ctx": fab.ctx_seq, "comm_id": 9},
+            1) in rows
+
+
+def test_tier_profile_covers_cross_host_pairs_only():
+    from accl_tpu.emulator.fabric import LocalFabric
+    fab = LocalFabric(4)
+    fab.set_tier_profile([0, 0, 1, 1], alpha_us=5.0, beta_gbps=0.5)
+    assert (0, 2) in fab.link_profiles and (3, 1) in fab.link_profiles
+    assert (0, 1) not in fab.link_profiles
+    assert len(fab.link_profiles) == 8  # 2*2 cross pairs, both ways
+    with pytest.raises(ValueError, match="positive"):
+        fab.set_link_profile(0, 1, 1.0, 0.0)
+
+
+def test_link_profile_env(monkeypatch):
+    from accl_tpu.emulator.fabric import LocalFabric
+    monkeypatch.setenv("ACCL_TPU_LINK_PROFILE", "0-1:50:0.5;1-0:60:0.25")
+    fab = LocalFabric(2)
+    assert fab.link_profiles[(0, 1)] == (50.0, 0.5)
+    assert fab.link_profiles[(1, 0)] == (60.0, 0.25)
+    monkeypatch.setenv("ACCL_TPU_LINK_PROFILE", "garbage")
+    with pytest.raises(ValueError, match="malformed"):
+        LocalFabric(2)

@@ -1,0 +1,370 @@
+"""Redistribution engine (accl_tpu/hier): spec algebra, plan
+minimality, and the differential suite vs the serial
+gather-reshard-scatter oracle — bit-identical across W in {4, 6, 8},
+uneven splits, subsets, in-place and eth-compressed variants.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from accl_tpu.hier import (RedistPlan, ShardSpec, plan_redistribute,
+                           redistribute_oracle)
+from accl_tpu.testing import emu_world, run_ranks
+
+
+# ---------------------------------------------------------------------------
+# spec algebra
+# ---------------------------------------------------------------------------
+
+def test_shard_spec_constructors():
+    assert ShardSpec.even(64, 4).counts == (16,) * 4
+    with pytest.raises(ValueError, match="evenly"):
+        ShardSpec.even(63, 4)
+    with pytest.raises(ValueError, match="negative"):
+        ShardSpec.block((8, -1))
+    with pytest.raises(ValueError, match="whole number"):
+        ShardSpec.cyclic(63, 4, 4)
+    with pytest.raises(ValueError, match="deal evenly"):
+        ShardSpec.cyclic(12, 4, 2)  # 6 chunks do not deal over 4 ranks
+    assert ShardSpec.cyclic(64, 4, 4).local_count(0) == 16
+
+
+def test_shard_spec_intervals():
+    b = ShardSpec.block((4, 0, 8))
+    assert b.intervals(0) == [(0, 4, 0)]
+    assert b.intervals(1) == []
+    assert b.intervals(2) == [(4, 8, 0)]
+    assert b.participants() == (0, 2)
+    c = ShardSpec.cyclic(24, 3, 4)
+    assert c.intervals(1) == [(4, 4, 0), (16, 4, 4)]
+    r = ShardSpec.replicated(10, 2)
+    assert r.intervals(1) == [(0, 10, 0)]
+
+
+# ---------------------------------------------------------------------------
+# plan minimality: the compiler must find the cheap shapes
+# ---------------------------------------------------------------------------
+
+def test_plan_fast_paths():
+    W = 4
+    even = ShardSpec.even(64, W)
+    assert plan_redistribute(even, even, 0).kind == "local"
+    assert plan_redistribute(ShardSpec.replicated(64, W), even,
+                             1).kind == "local"
+    assert plan_redistribute(even, ShardSpec.replicated(64, W),
+                             0).kind == "allgather"
+    a2a = plan_redistribute(even, ShardSpec.cyclic(64, W, 4), 0)
+    assert a2a.kind == "alltoall" and a2a.coll_count == 4
+    a2a_back = plan_redistribute(ShardSpec.cyclic(64, W, 4), even, 2)
+    assert a2a_back.kind == "alltoall" and a2a_back.coll_count == 4
+
+
+def test_plan_p2p_is_interval_minimal():
+    # shifting one boundary by k elements moves exactly k elements
+    # between neighbors — the plan must carry ONE transfer, not a
+    # full reshuffle
+    src = ShardSpec.block((16, 16))
+    dst = ShardSpec.block((12, 20))
+    p0 = plan_redistribute(src, dst, 0)
+    p1 = plan_redistribute(src, dst, 1)
+    assert p0.kind == "p2p" and p1.kind == "p2p"
+    assert p0.wire_transfers == 1 and p1.wire_transfers == 1
+    send = [s for s in p0.steps if s.kind == "send"][0]
+    assert send.count == 4 and send.peer == 1 and send.src_off == 12
+    recv = [s for s in p1.steps if s.kind == "recv"][0]
+    assert recv.count == 4 and recv.peer == 0 and recv.dst_off == 0
+
+
+def test_plan_uninvolved_rank_is_noop():
+    src = ShardSpec.block((32, 0, 32, 0))
+    dst = ShardSpec.block((0, 32, 32, 0))
+    assert plan_redistribute(src, dst, 3).kind == "noop"
+    # rank 2's shard doesn't move: pure local copy
+    assert plan_redistribute(src, dst, 2).kind == "local"
+
+
+def test_plan_validation():
+    with pytest.raises(ValueError, match="global size"):
+        plan_redistribute(ShardSpec.even(64, 4), ShardSpec.even(60, 4), 0)
+    with pytest.raises(ValueError, match="worlds"):
+        plan_redistribute(ShardSpec.even(64, 4), ShardSpec.even(64, 8), 0)
+
+
+def test_oracle_shape():
+    src = ShardSpec.block((4, 8))
+    dst = ShardSpec.replicated(12, 2)
+    out = redistribute_oracle(
+        [np.arange(4, dtype=np.int32),
+         np.arange(4, 12, dtype=np.int32)], src, dst)
+    assert all(np.array_equal(o, np.arange(12, dtype=np.int32))
+               for o in out)
+
+
+# ---------------------------------------------------------------------------
+# differential suite vs the oracle (bit-identical)
+# ---------------------------------------------------------------------------
+
+def _shards_for(spec: ShardSpec, glob: np.ndarray):
+    out = []
+    for r in range(spec.world):
+        s = np.zeros(spec.local_count(r), glob.dtype)
+        for g0, c, l0 in spec.intervals(r):
+            s[l0:l0 + c] = glob[g0:g0 + c]
+        out.append(s)
+    return out
+
+
+def _run_redistribute(src_spec, dst_spec, *, compress=None,
+                      inplace=False, dtype=np.float32, nbufs=32):
+    W = src_spec.world
+    rng = np.random.default_rng(src_spec.n * 31 + W)
+    # integer-valued floats: exactly representable in float16, so the
+    # eth-compressed wire stays bit-identical to the oracle
+    glob = rng.integers(-128, 128, src_spec.n).astype(dtype)
+    shards = _shards_for(src_spec, glob)
+    oracle = redistribute_oracle(shards, src_spec, dst_spec)
+    accls = emu_world(W, nbufs=nbufs)
+
+    def body(a):
+        r = a.rank
+        sc, dc = src_spec.local_count(r), dst_spec.local_count(r)
+        if inplace:
+            buf = a.buffer((max(sc, dc, 1),), dtype)
+            buf.data[:sc] = shards[r]
+            a.redistribute(buf, src_spec, buf, dst_spec,
+                           compress_dtype=compress)
+            return buf.data[:dc].copy()
+        src = (a.buffer(data=shards[r].copy()) if sc
+               else a.buffer((1,), dtype))
+        dst = a.buffer((max(dc, 1),), dtype)
+        a.redistribute(src, src_spec, dst, dst_spec,
+                       compress_dtype=compress)
+        return dst.data[:dc].copy()
+
+    try:
+        outs = run_ranks(accls, body, timeout=120.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    for r in range(W):
+        assert outs[r].tobytes() == oracle[r].tobytes(), \
+            f"rank {r}: {outs[r][:8]} != oracle {oracle[r][:8]}"
+
+
+CASES = {
+    "W4-block-to-replicated": (ShardSpec.even(64, 4),
+                               ShardSpec.replicated(64, 4)),
+    "W4-block-to-cyclic": (ShardSpec.even(64, 4),
+                           ShardSpec.cyclic(64, 4, 4)),
+    "W4-cyclic-to-block": (ShardSpec.cyclic(64, 4, 4),
+                           ShardSpec.even(64, 4)),
+    "W4-replicated-to-block": (ShardSpec.replicated(64, 4),
+                               ShardSpec.even(64, 4)),
+    "W4-uneven-to-even": (ShardSpec.block((10, 30, 4, 20)),
+                          ShardSpec.even(64, 4)),
+    "W6-subset-to-one": (ShardSpec.block((30, 0, 6, 0, 12, 12)),
+                         ShardSpec.block((0, 0, 60, 0, 0, 0))),
+    "W6-uneven-to-cyclic": (ShardSpec.block((11, 7, 20, 2, 14, 6)),
+                            ShardSpec.cyclic(60, 6, 2)),
+    "W8-cyclic-to-uneven": (ShardSpec.cyclic(128, 8, 2),
+                            ShardSpec.block((8, 24, 16, 16, 8, 24,
+                                             16, 16))),
+    "W8-grain-change": (ShardSpec.cyclic(128, 8, 2),
+                        ShardSpec.cyclic(128, 8, 8)),
+}
+
+
+@pytest.mark.parametrize("case", sorted(CASES), ids=sorted(CASES))
+def test_redistribute_matches_oracle(case):
+    src, dst = CASES[case]
+    _run_redistribute(src, dst)
+
+
+@pytest.mark.parametrize("case", ["W4-block-to-cyclic",
+                                  "W4-uneven-to-even",
+                                  "W8-cyclic-to-uneven"])
+def test_redistribute_in_place(case):
+    src, dst = CASES[case]
+    _run_redistribute(src, dst, inplace=True)
+
+
+@pytest.mark.parametrize("case", ["W4-block-to-replicated",
+                                  "W4-uneven-to-even",
+                                  "W6-uneven-to-cyclic"])
+def test_redistribute_eth_compressed(case):
+    src, dst = CASES[case]
+    _run_redistribute(src, dst, compress=np.float16)
+
+
+def test_redistribute_members_subset():
+    """Redistribution among a world-rank subset runs over a derived
+    (and cached) sub-communicator while other ranks stay idle."""
+    W, k = 6, 3
+    members = (1, 3, 5)
+    src_spec = ShardSpec.block((24, 12, 12))
+    dst_spec = ShardSpec.even(48, k)
+    glob = np.arange(48, dtype=np.float32)
+    shards = _shards_for(src_spec, glob)
+    oracle = redistribute_oracle(shards, src_spec, dst_spec)
+    accls = emu_world(W, nbufs=32)
+
+    def body(a):
+        if a.rank not in members:
+            return None
+        i = members.index(a.rank)
+        src = a.buffer(data=shards[i].copy())
+        dst = a.buffer((dst_spec.local_count(i),), np.float32)
+        n_comms = len(a.communicators)
+        a.redistribute(src, src_spec, dst, dst_spec, members=members)
+        a.redistribute(src, src_spec, dst, dst_spec, members=members)
+        # the sub-communicator is cached: only ONE new registration
+        assert len(a.communicators) == n_comms + 1
+        return dst.data.copy()
+
+    try:
+        outs = run_ranks(accls, body, timeout=60.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    for i, r in enumerate(members):
+        assert outs[r].tobytes() == oracle[i].tobytes()
+
+
+def test_redistribute_validation_and_attribution():
+    accls = emu_world(4, nbufs=32)
+    try:
+        a = accls[0]
+        src = a.buffer((16,), np.float32)
+        dst16 = a.buffer((16,), np.float16)
+        with pytest.raises(ValueError, match="spec worlds"):
+            a.redistribute(src, ShardSpec.even(16, 2), src,
+                           ShardSpec.even(16, 2))
+        with pytest.raises(ValueError, match="not both"):
+            a.redistribute(src, ShardSpec.even(16, 2), src,
+                           ShardSpec.even(16, 2), comm=a.comm,
+                           members=(0, 1))
+        with pytest.raises(ValueError, match="dtype"):
+            a.redistribute(src, ShardSpec.even(64, 4), dst16,
+                           ShardSpec.even(64, 4))
+        with pytest.raises(ValueError, match="fit"):
+            a.redistribute(src, ShardSpec.block((64, 0, 0, 0)), src,
+                           ShardSpec.even(64, 4))
+        # shape errors surface BEFORE any sub-call is issued — a
+        # mid-program failure would strand eager frames in peer pools
+        src2d = a.buffer((4, 4), np.float32)
+        with pytest.raises(ValueError, match="1-D"):
+            a.redistribute(src2d, ShardSpec.block((16, 16, 16, 16)),
+                           src2d, ShardSpec.block((8, 24, 16, 16)))
+
+        # local-only plan needs no peers: attribution is observable on
+        # one rank without spinning the others
+        def body(b):
+            s = b.buffer(data=np.arange(16, dtype=np.float32))
+            d = b.buffer((4,), np.float32)
+            b.start_profiling()
+            b.redistribute(s, ShardSpec.replicated(16, 4), d,
+                           ShardSpec.even(16, 4))
+            b.end_profiling()
+            recs = b.profiler.records
+            logical = [r for r in recs if r.op == "redistribute"]
+            assert len(logical) == 1
+            assert logical[0].algorithm == "LOCAL"
+            tag = logical[0].parent
+            assert tag.startswith("redist#")
+            phases = [r for r in recs if r.op == "copy"]
+            assert phases and all(r.parent == tag for r in phases)
+            assert np.array_equal(d.data,
+                                  np.arange(16, dtype=np.float32)
+                                  [b.rank * 4:(b.rank + 1) * 4])
+
+        run_ranks(accls, body, timeout=30.0)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_redistribute_run_async_aggregate_handle():
+    """An async redistribute spans two communicators (local copies on
+    the world comm, transfers on the exchange comm), so the returned
+    handle must aggregate EVERY sub-call — waiting it alone must imply
+    the destination shard is complete."""
+    W = 4
+    src_spec = ShardSpec.block((10, 30, 4, 20))
+    dst_spec = ShardSpec.even(64, W)
+    glob = np.arange(64, dtype=np.float32)
+    shards = _shards_for(src_spec, glob)
+    oracle = redistribute_oracle(shards, src_spec, dst_spec)
+    accls = emu_world(W, nbufs=32)
+
+    def body(a):
+        src = a.buffer(data=shards[a.rank].copy())
+        dst = a.buffer((16,), np.float32)
+        h = a.redistribute(src, src_spec, dst, dst_spec,
+                           run_async=True)
+        h.wait(60.0)
+        return dst.data.copy()
+
+    try:
+        outs = run_ranks(accls, body, timeout=60.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    for r in range(W):
+        assert outs[r].tobytes() == oracle[r].tobytes()
+
+
+def test_redistribute_async_inplace_stage_recycled():
+    """Async in-place reshards draw their staging buffer from a
+    recycled pool — repeated calls must not grow device-registered
+    memory without bound, and the stage returns only after the WHOLE
+    program retires."""
+    W = 4
+    src_spec = ShardSpec.even(64, W)
+    dst_spec = ShardSpec.cyclic(64, W, 2)
+    glob = np.arange(64, dtype=np.float32)
+    shards = [glob[r * 16:(r + 1) * 16].copy() for r in range(W)]
+    oracle = redistribute_oracle(shards, src_spec, dst_spec)
+    accls = emu_world(W, nbufs=32)
+
+    def body(a):
+        buf = a.buffer((16,), np.float32)
+        for _ in range(3):
+            buf.data[:] = shards[a.rank]  # re-arm the block layout
+            h = a.redistribute(buf, src_spec, buf, dst_spec,
+                               run_async=True)
+            h.wait(60.0)
+            assert buf.data.tobytes() == oracle[a.rank].tobytes()
+        # pool holds exactly ONE recycled stage per (size, dtype) —
+        # repeated async reshards reuse it instead of allocating
+        pool = a._redist_stage_pool[(16, "float32")]
+        assert len(pool) == 1
+
+    try:
+        run_ranks(accls, body, timeout=60.0)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_one_distinct_host_throttle_rejected():
+    with pytest.raises(ValueError, match="two.*distinct hosts"):
+        emu_world(2, hosts=[0, 0], inter_beta_gbps=0.1)
+
+
+def test_redistribute_metrics_counter():
+    accls = emu_world(4, nbufs=32)
+    try:
+        def body(a):
+            s = a.buffer(data=np.arange(16, dtype=np.float32))
+            d = a.buffer((4,), np.float32)
+            a.redistribute(s, ShardSpec.replicated(16, 4), d,
+                           ShardSpec.even(16, 4))
+            key = ("redistribute", a.comm.comm_id)
+            assert a._call_counts.get(key) == 1
+
+        run_ranks(accls, body, timeout=30.0)
+    finally:
+        for a in accls:
+            a.deinit()

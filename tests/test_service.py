@@ -341,14 +341,17 @@ def test_callrecord_tenant_csv_roundtrip(tmp_path):
     prof.to_csv(path)
     back = Profiler.read_csv(path)
     assert [r.tenant for r in back] == ["teamA", ""]
-    # pre-tenant dumps still parse (field defaults empty)
+    # pre-tenant dumps still parse (field defaults empty) — strip the
+    # trailing tenant AND parent columns (parent was appended after
+    # tenant by the hier attribution work)
     legacy = str(tmp_path / "legacy.csv")
     with open(path) as f:
         lines = f.read().splitlines()
     with open(legacy, "w") as f:
         f.write("\n".join(
-            ",".join(ln.split(",")[:-1]) for ln in lines) + "\n")
+            ",".join(ln.split(",")[:-2]) for ln in lines) + "\n")
     assert [r.tenant for r in Profiler.read_csv(legacy)] == ["", ""]
+    assert [r.parent for r in Profiler.read_csv(legacy)] == ["", ""]
 
 
 # ---------------------------------------------------------------------------
