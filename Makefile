@@ -2,7 +2,7 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test lint native bench bench-emu dryrun chip-queue csv tune
+.PHONY: all test lint native bench bench-emu chaos dryrun chip-queue csv tune
 
 all: lint native   ## default flow: syntax gate first, then the native build
 
@@ -27,8 +27,11 @@ tune:              ## emulator-tier algorithm sweep -> bench_out/tuning.json
 bench:             ## headline JSON line (real chip when the tunnel is up)
 	$(PY) bench.py
 
-bench-emu:         ## emulator-tier headline (<300s): executor + algorithm + plan-cache + hierarchical + multi-tenant saturation ladders; asserts streamed ≥1.2x over the window, log-depth ≥1.3x over ring at small messages, plan-cache ≥1.3x per-call on repeated small collectives, hierarchical ≥1.3x over flat ring on the slow-inter-tier 4 MiB allreduce (benchmarks/hierarchy.py), 4-tenant Jain fairness ≥0.8 with concurrent aggregate ≥0.6x serialized (no-collapse floor — a fully CPU-bound 2-core emulator has no idle for overlap to reclaim; see benchmarks/saturation.py) and bounded small-call p99 under a 16 MiB storm, AND zero fabric drop/corruption counters (metrics_snapshot block rides the JSON line)
-	ACCL_BENCH_TIER=emu ACCL_BENCH_MIN_STREAM_RATIO=1.2 ACCL_BENCH_MIN_RD_RATIO=1.3 ACCL_BENCH_MIN_PLANCACHE_RATIO=1.3 ACCL_BENCH_MIN_HIER_RATIO=1.3 ACCL_BENCH_MIN_FAIRNESS=0.8 ACCL_BENCH_MIN_AGG_RATIO=0.6 ACCL_BENCH_REQUIRE_CLEAN_FABRIC=1 JAX_PLATFORMS=cpu $(PY) bench.py
+bench-emu:         ## emulator-tier headline (<300s): executor + algorithm + plan-cache + hierarchical + multi-tenant saturation + chaos-goodput ladders; asserts streamed ≥1.2x over the window, log-depth ≥1.3x over ring at small messages, plan-cache ≥1.3x per-call on repeated small collectives, hierarchical ≥1.3x over flat ring on the slow-inter-tier 4 MiB allreduce (benchmarks/hierarchy.py), 4-tenant Jain fairness ≥0.8 with concurrent aggregate ≥0.6x serialized (no-collapse floor — a fully CPU-bound 2-core emulator has no idle for overlap to reclaim; see benchmarks/saturation.py) and bounded small-call p99 under a 16 MiB storm, goodput ≥0.4x clean under seeded 1% frame loss with ZERO call errors (benchmarks/chaos.py — the reliability layer's recovery gate), AND zero fabric drop/corruption counters beyond the chaos ladder's declared injections (metrics_snapshot block rides the JSON line)
+	ACCL_BENCH_TIER=emu ACCL_BENCH_MIN_STREAM_RATIO=1.2 ACCL_BENCH_MIN_RD_RATIO=1.3 ACCL_BENCH_MIN_PLANCACHE_RATIO=1.3 ACCL_BENCH_MIN_HIER_RATIO=1.3 ACCL_BENCH_MIN_FAIRNESS=0.8 ACCL_BENCH_MIN_AGG_RATIO=0.6 ACCL_BENCH_MIN_CHAOS_GOODPUT=0.4 ACCL_BENCH_REQUIRE_CLEAN_FABRIC=1 JAX_PLATFORMS=cpu $(PY) bench.py
+
+chaos:             ## seeded deterministic chaos sweep: every fault kind x algorithm x world through the reliability layer, bit-identical to the serial oracle (scripts/chaos_sweep.py; $ACCL_TPU_CHAOS_SEED reproduces a run)
+	JAX_PLATFORMS=cpu $(PY) scripts/chaos_sweep.py
 
 dryrun:            ## multi-chip sharding dryrun on 8 virtual devices
 	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

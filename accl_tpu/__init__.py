@@ -19,12 +19,14 @@ from .accl import ACCL
 from .arith import ArithConfig, DEFAULT_ARITH_CONFIGS, resolve_arith_config
 from .buffer import ACCLBuffer
 from .call import CallDescriptor, CallHandle, wait_all
+from .chaos import FaultPlan, FaultRule
 from .communicator import Communicator, Rank, simple_communicator
 from .constants import (ACCLError, CCLOp, CfgFunc, Compression, ErrorCode,
                         ReduceFunc, StackType, StreamFlags, TAG_ANY,
                         decode_error)
 from .device import Device, EmuContext, EmuDevice
 from .plancache import CompiledPlan, PlanCache
+from .retry import RetryPolicy
 from .tracing import Profiler
 from .tuner import Topology, Tuner
 
@@ -34,8 +36,8 @@ __all__ = [
     "ACCL", "ACCLBuffer", "ACCLError", "ArithConfig", "CallDescriptor",
     "CallHandle", "CCLOp", "CfgFunc", "Communicator", "CompiledPlan",
     "Compression", "DEFAULT_ARITH_CONFIGS", "Device", "EmuContext",
-    "EmuDevice", "ErrorCode", "PlanCache", "Profiler", "Rank", "ReduceFunc",
-    "StackType", "StreamFlags", "TAG_ANY", "Topology", "Tuner",
-    "decode_error", "resolve_arith_config", "simple_communicator",
-    "wait_all",
+    "EmuDevice", "ErrorCode", "FaultPlan", "FaultRule", "PlanCache",
+    "Profiler", "Rank", "ReduceFunc", "RetryPolicy", "StackType",
+    "StreamFlags", "TAG_ANY", "Topology", "Tuner", "decode_error",
+    "resolve_arith_config", "simple_communicator", "wait_all",
 ]

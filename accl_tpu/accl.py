@@ -29,10 +29,15 @@ from .call import CallDescriptor, CallHandle, CompletedHandle
 from .communicator import Communicator
 from .constants import (ACCLError, CCLOp, CfgFunc, CollectiveAlgorithm,
                         Compression, DEFAULT_ALGORITHMS,
-                        DEFAULT_MAX_SEGMENT_SIZE, HIERARCHICAL_OPS,
-                        ReduceFunc, StreamFlags, TAG_ANY, VALID_ALGORITHMS)
+                        DEFAULT_MAX_SEGMENT_SIZE, ErrorCode,
+                        HIERARCHICAL_OPS, ReduceFunc, StreamFlags, TAG_ANY,
+                        VALID_ALGORITHMS)
 from .device.base import Device
+from .log import get_logger
+from .retry import RetryPolicy, resolve_policy
 from .tracing import METRICS, Profiler, TRACE
+
+log = get_logger(__name__)
 
 
 class ACCL:
@@ -61,8 +66,15 @@ class ACCL:
                  timeout: float = 30.0,
                  max_segment_size: int | None = None,
                  arith_registry=None, tuner=None,
-                 tenant: str | None = None):
+                 tenant: str | None = None,
+                 retry_policy: "RetryPolicy | None" = None):
         self.device = device
+        # driver-wide default retry policy (accl_tpu/retry.py): applied
+        # to every data call unless a per-call retries=/retry_policy=
+        # overrides it. Must be UNIFORM across the ranks of a
+        # communicator, like the collectives themselves.
+        self.retry_policy = retry_policy
+        self._preflight_warned: set = set()
         if tenant is not None:
             from .service import validate_tenant
             validate_tenant(tenant)  # label is spliced into CSV/metrics/
@@ -244,6 +256,76 @@ class ACCL:
         self.communicators.append(sub)
         return sub
 
+    # -- failure containment (ULFM-style revoke/shrink) --------------------
+    def revoke(self, comm: Communicator | None = None):
+        """Mark a communicator revoked: every later call on it raises
+        ``PEER_FAILED`` immediately instead of rendezvousing with ranks
+        that may be dead. The application then rebuilds on the survivors
+        via :meth:`shrink_communicator`. Rank-local (like the failure
+        observation itself) — every surviving rank revokes when it
+        observes ``ErrorCode.PEER_FAILED``; other communicators keep
+        flowing untouched."""
+        (comm or self.comm).revoked = True
+
+    def shrink_communicator(self, dead_ranks: Sequence[int],
+                            comm: Communicator | None = None,
+                            key: int = 0x5A1D) -> Communicator:
+        """Build and register the survivor communicator of ``comm``
+        minus ``dead_ranks`` (GLOBAL ranks). Every surviving rank must
+        call this with the same ``dead_ranks`` (the new comm_id derives
+        deterministically from the survivor membership, like
+        :meth:`split_communicator`); the dead ranks' channel state never
+        carries over — the shrunken comm has fresh sequence spaces."""
+        comm = comm or self.comm
+        dead = {int(d) for d in dead_ranks}
+        if comm.my_global_rank in dead:
+            raise ValueError("cannot shrink away the local rank")
+        survivors = [i for i, r in enumerate(comm.ranks)
+                     if r.global_rank not in dead]
+        if len(survivors) == len(comm.ranks):
+            raise ValueError(f"no member of comm {comm.comm_id} is in "
+                             f"dead_ranks {sorted(dead)}")
+        sub = comm.split(survivors, key=key)
+        self.device.configure_communicator(sub, tenant=self.tenant)
+        self.communicators.append(sub)
+        return sub
+
+    def preflight(self, count: int, dtype=np.float32,
+                  op: str = "allreduce",
+                  comm: Communicator | None = None) -> list[str]:
+        """Resource preflight for a planned collective: returns human-
+        readable warnings (empty = clear). Today's one check is the
+        PR-8 known issue: a hierarchical lowering of a multi-MiB call
+        parks phase chunks in the finite rx pool, and ``nbufs*bufsize``
+        below ~2 chunks degrades into timeout-shaped backpressure —
+        surfaced here (and logged once per shape at hierarchical
+        issue time) instead of discovered as a mystery deadline."""
+        comm = comm or self.comm
+        nbytes = int(count) * np.dtype(dtype).itemsize
+        if comm is not self.comm or op not in HIERARCHICAL_OPS:
+            return []
+        return self._preflight_hier(op, nbytes)
+
+    def _preflight_hier(self, op: str, nbytes: int) -> list[str]:
+        cap_fn = getattr(self.device, "rx_capacity", None)
+        hier = self._hier
+        if cap_fn is None or hier is None:
+            return []
+        try:
+            nbufs, bufsize = cap_fn()
+        except Exception:  # noqa: BLE001 — preflight must never break
+            return []      # the call it is trying to protect
+        pool_bytes = nbufs * bufsize
+        n_hosts = max(1, len(hier.groups))
+        chunk = -(-nbytes // n_hosts)
+        if pool_bytes >= 2 * chunk:
+            return []
+        return [
+            f"rx pool ({nbufs} x {bufsize} B = {pool_bytes} B) cannot "
+            f"hold 2 chunks ({2 * chunk} B) of a hierarchical {op} of "
+            f"{nbytes} B across {n_hosts} hosts: expect timeout-shaped "
+            f"backpressure — raise nbufs/bufsize or split the call"]
+
     # -- two-tier hierarchy (accl_tpu/hier) --------------------------------
     def configure_hierarchy(self, hosts: Sequence[int]):
         """Declare the world's two-tier structure: ``hosts[r]`` is the
@@ -300,6 +382,7 @@ class ACCL:
                     "hierarchical collectives run over the WORLD "
                     "communicator (the hierarchy's sub-communicators are "
                     "derived from it); got a split communicator")
+            self._warn_preflight(op, count * elem_bytes)
             return True
         if (alg != CollectiveAlgorithm.AUTO or self.tuner is None
                 or comm is not self.comm or op not in HIERARCHICAL_OPS):
@@ -313,8 +396,37 @@ class ACCL:
             return False
         if self._ensure_hier() is None:
             return False
-        return self.tuner.select(op, comm.size,
-                                 count * elem_bytes) == H
+        routed = self.tuner.select(op, comm.size,
+                                   count * elem_bytes) == H
+        if routed:
+            self._warn_preflight(op, count * elem_bytes)
+        return routed
+
+    def _warn_preflight(self, op: str, nbytes: int):
+        """Log the rx-pool preflight warnings once per (op, size) shape
+        at hierarchical issue time (ACCL.preflight is the query form)."""
+        key = (op, nbytes)
+        if key in self._preflight_warned:
+            return
+        self._preflight_warned.add(key)
+        for w in self._preflight_hier(op, nbytes):
+            log.warning("rank %d preflight: %s", self.rank, w,
+                        extra={"rank": self.rank})
+
+    @contextlib.contextmanager
+    def _retry_scope(self, retries, retry_policy):
+        """Per-call ``retries=``/``retry_policy=`` for COMPOSITE calls
+        (redistribute, hierarchical lowerings): their sub-calls are
+        issued internally, so the per-call override becomes the driver
+        default for the issuing scope (one driver is used from one
+        thread at a time — the established driver threading contract)."""
+        policy = resolve_policy(retries, retry_policy, self.retry_policy)
+        prev = self.retry_policy
+        self.retry_policy = policy
+        try:
+            yield
+        finally:
+            self.retry_policy = prev
 
     @contextlib.contextmanager
     def _attributed(self, tag: str):
@@ -443,6 +555,13 @@ class ACCL:
         operand OP{0,1}/RES_COMPRESSED, and request ETH_COMPRESSED when the
         caller asks for wire compression.
         """
+        if getattr(comm, "revoked", False):
+            # ULFM-style containment: a revoked communicator accepts no
+            # further calls — the application shrinks to the survivors
+            # (shrink_communicator) and rebuilds there
+            raise ACCLError(int(ErrorCode.PEER_FAILED),
+                            f"{scenario.name} on revoked communicator "
+                            f"{comm.comm_id}")
         dtypes = {b.dtype for b in (op0, op1, res) if b is not None}
         compression = Compression.NONE
         if stream_dtype is not None:
@@ -503,8 +622,106 @@ class ACCL:
             addr_2=res.address if res is not None else 0)
 
     def _call(self, desc: CallDescriptor, run_async: bool,
-              waitfor: Sequence[CallHandle],
-              chain: bool = False) -> CallHandle:
+              waitfor: Sequence[CallHandle], chain: bool = False,
+              retries: int | None = None,
+              retry_policy: "RetryPolicy | None" = None) -> CallHandle:
+        """Issue a call, applying the resolved retry policy (per-call
+        ``retries=``/``retry_policy=`` over the driver default). A retry
+        is an epoch-scoped idempotent re-execution: the failed attempt
+        advanced every per-peer seqn counter to its final value at
+        admission, so the re-execution's frames live in a fresh seqn
+        range stale traffic cannot satisfy; ``device.prepare_retry``
+        purges the dead attempt's stranded rx frames; and the plan cache
+        makes re-expansion free. Policies must be uniform across the
+        ranks of a communicator (docs/ARCHITECTURE.md, Failure model)."""
+        policy = resolve_policy(retries, retry_policy, self.retry_policy)
+        if (policy is None or policy.retries <= 0
+                or desc.scenario == CCLOp.config):
+            return self._call_once(desc, run_async, waitfor, chain)
+        if run_async:
+            return self._call_async_retry(desc, waitfor, chain, policy)
+        import time as _time
+        attempt = 0
+        while True:
+            try:
+                return self._call_once(desc, run_async, waitfor, chain)
+            except ACCLError as exc:
+                if policy.should_retry(exc.error_word, attempt):
+                    self._note_retry(desc, attempt, exc.error_word)
+                    _time.sleep(policy.backoff(attempt, desc.comm_id))
+                    attempt += 1
+                    continue
+                if attempt and policy.should_retry(exc.error_word, 0):
+                    # retryable failure class, attempts exhausted: say so
+                    raise ACCLError(
+                        exc.error_word
+                        | int(ErrorCode.CALL_RETRIES_EXHAUSTED),
+                        desc.scenario.name) from exc
+                raise
+
+    def _note_retry(self, desc: CallDescriptor, attempt: int, word: int):
+        METRICS.inc("call_retries_total", op=desc.scenario.name,
+                    comm_id=desc.comm_id, rank=self.rank)
+        if TRACE.enabled:
+            TRACE.emit("call_retry", rank=self.rank, seqn=attempt,
+                       nbytes=desc.count, peer=-1)
+        log.warning(
+            "rank %d: %s on comm %d failed (0x%x) — retry %d (fresh "
+            "seqn epoch)", self.rank, desc.scenario.name, desc.comm_id,
+            word, attempt + 1, extra={"rank": self.rank})
+        prep = getattr(self.device, "prepare_retry", None)
+        if prep is not None:
+            try:
+                prep(desc.comm_id)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort;
+                pass           # the retry itself decides success
+
+    def _call_async_retry(self, desc: CallDescriptor, waitfor,
+                          chain: bool, policy: "RetryPolicy"
+                          ) -> CallHandle:
+        """Async form of the retry loop: the outer handle completes only
+        when an attempt succeeds or the policy gives up; re-issues run
+        off a timer thread (never on the backend's finish worker, whose
+        sleep would stall other tenants' retirements)."""
+        outer = CallHandle(context=desc.scenario.name)
+        state = {"attempt": 0}
+
+        def issue():
+            try:
+                inner = self._call_once(desc, True, waitfor, chain)
+            except ACCLError as exc:
+                # preserve the true error word: callers branch on it
+                # (PEER_FAILED -> shrink, retryable -> their own backoff)
+                outer.complete(exc.error_word, exception=exc)
+                return
+            except Exception as exc:  # noqa: BLE001 — surface, not hang
+                outer.complete(int(ErrorCode.INVALID_CALL), exception=exc)
+                return
+            inner.add_done_callback(
+                lambda err, h=inner: on_done(err, h))
+
+        def on_done(err, inner):
+            err = int(err)
+            if err and policy.should_retry(err, state["attempt"]):
+                a = state["attempt"]
+                state["attempt"] = a + 1
+                self._note_retry(desc, a, err)
+                import threading as _threading
+                t = _threading.Timer(policy.backoff(a, desc.comm_id),
+                                     issue)
+                t.daemon = True
+                t.start()
+                return
+            if err and state["attempt"] and policy.should_retry(err, 0):
+                err |= int(ErrorCode.CALL_RETRIES_EXHAUSTED)
+            outer.complete(err, exception=inner._exception)
+
+        issue()
+        return outer
+
+    def _call_once(self, desc: CallDescriptor, run_async: bool,
+                   waitfor: Sequence[CallHandle],
+                   chain: bool = False) -> CallHandle:
         import time as _time
         if chain and run_async:
             # cross-call pipelining hint (the C++ driver's call_chain
@@ -637,17 +854,23 @@ class ACCL:
 
     # -- primitives (parity: accl.py:738-985) ------------------------------
     def nop(self, run_async: bool = False, chain: bool = False,
-            waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+            waitfor: Sequence[CallHandle] = (),
+            retries: int | None = None,
+            retry_policy: "RetryPolicy | None" = None
+            ) -> CallHandle:
         """No-op through the full call path; used for call-latency probes
         (accl.py:738-745)."""
         return self._call(CallDescriptor(CCLOp.nop), run_async, waitfor,
-                          chain)
+                          chain, retries, retry_policy)
 
     def copy(self, srcbuf: ACCLBuffer | None, dstbuf: ACCLBuffer | None,
              count: int | None = None, *,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
              stream_dtype=None, run_async: bool = False, chain: bool = False,
-             waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+             waitfor: Sequence[CallHandle] = (),
+             retries: int | None = None,
+             retry_policy: "RetryPolicy | None" = None
+             ) -> CallHandle:
         """Local copy. With OP0_STREAM the source is the rank's stream-in
         port (srcbuf may be None); with RES_STREAM the result goes to the
         stream-out port (dstbuf may be None) — the external-kernel data
@@ -666,14 +889,18 @@ class ACCL:
                              op0=srcbuf, res=dstbuf,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def combine(self, count: int, func: ReduceFunc, op0: ACCLBuffer | None,
                 op1: ACCLBuffer, res: ACCLBuffer | None, *,
                 stream_dtype=None,
                 stream_flags: StreamFlags = StreamFlags.NO_STREAM,
                 run_async: bool = False, chain: bool = False,
-                waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                waitfor: Sequence[CallHandle] = (),
+                retries: int | None = None,
+                retry_policy: "RetryPolicy | None" = None
+                ) -> CallHandle:
         """With OP0_STREAM the first operand is sourced from this rank's
         stream-in port (op0 may be None); with RES_STREAM the result
         lands on the stream-out port (res may be None) — the
@@ -682,14 +909,18 @@ class ACCL:
                              func=func, op0=op0, op1=op1, res=res,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def send(self, srcbuf: ACCLBuffer | None, count: int, dst: int,
              tag: int = TAG_ANY, *, comm: Communicator | None = None,
              compress_dtype=None, stream_dtype=None,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
              run_async: bool = False, chain: bool = False,
-             waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+             waitfor: Sequence[CallHandle] = (),
+             retries: int | None = None,
+             retry_policy: "RetryPolicy | None" = None
+             ) -> CallHandle:
         """With OP0_STREAM the payload is sourced from this rank's
         stream-in port (srcbuf may be None; element type from
         ``stream_dtype``, default float32)."""
@@ -699,14 +930,18 @@ class ACCL:
                              compress_dtype=compress_dtype,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def recv(self, dstbuf: ACCLBuffer | None, count: int, src: int,
              tag: int = TAG_ANY, *, comm: Communicator | None = None,
              compress_dtype=None, stream_dtype=None,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
              run_async: bool = False, chain: bool = False,
-             waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+             waitfor: Sequence[CallHandle] = (),
+             retries: int | None = None,
+             retry_policy: "RetryPolicy | None" = None
+             ) -> CallHandle:
         """With RES_STREAM the received payload lands on this rank's
         stream-out port instead of memory (dstbuf may be None; element
         type from ``stream_dtype``, default float32)."""
@@ -716,11 +951,15 @@ class ACCL:
                              compress_dtype=compress_dtype,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def stream_put(self, srcbuf: ACCLBuffer, count: int, dst: int,
                    tag: int = TAG_ANY, *, run_async: bool = False, chain: bool = False,
-                   waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                   waitfor: Sequence[CallHandle] = (),
+                   retries: int | None = None,
+                   retry_policy: "RetryPolicy | None" = None
+                   ) -> CallHandle:
         """Send into the remote rank's stream port instead of its rx pool
         (reference: remote-stream send, strm tag in the eth header)."""
         desc = self._prepare(CCLOp.send, count=count, comm=self.comm,
@@ -728,7 +967,8 @@ class ACCL:
         desc.stream_flags |= StreamFlags.RES_STREAM
         # remote_stream is carried via tag on the move; device backends map
         # RES_STREAM on a send to strm delivery.
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def stream_push(self, data) -> None:
         """Feed this rank's external-kernel stream-in port: the next call
@@ -750,33 +990,42 @@ class ACCL:
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                  compress_dtype=None,
               run_async: bool = False, chain: bool = False,
-              waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+              waitfor: Sequence[CallHandle] = (),
+              retries: int | None = None,
+              retry_policy: "RetryPolicy | None" = None
+              ) -> CallHandle:
         comm = comm or self.comm
         count = count if count is not None else buf.size
         if self._hier_route("bcast", comm, count, buf.dtype.itemsize,
                             algorithm):
-            return self._hier.run("bcast", count=count, src=buf,
-                                  root=root,
-                                  compress_dtype=compress_dtype,
-                                  run_async=run_async, waitfor=waitfor)
+            with self._retry_scope(retries, retry_policy):
+                return self._hier.run("bcast", count=count, src=buf,
+                                      root=root,
+                                      compress_dtype=compress_dtype,
+                                      run_async=run_async, waitfor=waitfor)
         desc = self._prepare(CCLOp.bcast, count=count, comm=comm,
                              root_src_dst=root, op0=buf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def scatter(self, srcbuf: ACCLBuffer | None, dstbuf: ACCLBuffer,
                 count: int, root: int = 0, *,
                 comm: Communicator | None = None, compress_dtype=None,
                 run_async: bool = False, chain: bool = False,
-                waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                waitfor: Sequence[CallHandle] = (),
+                retries: int | None = None,
+                retry_policy: "RetryPolicy | None" = None
+                ) -> CallHandle:
         """count = per-rank chunk size; srcbuf holds world_size*count at
         root."""
         comm = comm or self.comm
         desc = self._prepare(CCLOp.scatter, count=count, comm=comm,
                              root_src_dst=root, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype)
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def gather(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer | None,
                count: int, root: int = 0, *,
@@ -784,7 +1033,10 @@ class ACCL:
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                  compress_dtype=None,
                run_async: bool = False, chain: bool = False,
-               waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+               waitfor: Sequence[CallHandle] = (),
+               retries: int | None = None,
+               retry_policy: "RetryPolicy | None" = None
+               ) -> CallHandle:
         """count = per-rank chunk; dstbuf holds world_size*count at root.
         Non-root ranks may pass None — a scratch relay buffer (the ring
         relay path, reference gather c:632-724) is allocated internally."""
@@ -808,7 +1060,8 @@ class ACCL:
                                               root) * count
             if need and dstbuf.size < need:
                 desc.addr_2 = self._scratch(need, dstbuf.dtype).address
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def reduce(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer | None, count: int,
                root: int = 0, func: ReduceFunc = ReduceFunc.SUM, *,
@@ -816,7 +1069,10 @@ class ACCL:
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                  compress_dtype=None,
                run_async: bool = False, chain: bool = False,
-               waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+               waitfor: Sequence[CallHandle] = (),
+               retries: int | None = None,
+               retry_policy: "RetryPolicy | None" = None
+               ) -> CallHandle:
         comm = comm or self.comm
         if comm.local_rank == root and dstbuf is None:
             raise ValueError("reduce root requires a destination buffer")
@@ -836,28 +1092,34 @@ class ACCL:
             desc.compression &= ~Compression.RES_COMPRESSED
             if desc.compression & Compression.OP0_COMPRESSED:
                 desc.compression |= Compression.RES_COMPRESSED
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def allgather(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,
                   comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                  compress_dtype=None,
                   run_async: bool = False, chain: bool = False,
-                  waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                  waitfor: Sequence[CallHandle] = (),
+                  retries: int | None = None,
+                  retry_policy: "RetryPolicy | None" = None
+                  ) -> CallHandle:
         comm = comm or self.comm
         if self._hier_route(
                 "allgather", comm, count,
                 max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
                 algorithm):
-            return self._hier.run("allgather", count=count, src=srcbuf,
-                                  dst=dstbuf,
-                                  compress_dtype=compress_dtype,
-                                  run_async=run_async, waitfor=waitfor)
+            with self._retry_scope(retries, retry_policy):
+                return self._hier.run("allgather", count=count, src=srcbuf,
+                                      dst=dstbuf,
+                                      compress_dtype=compress_dtype,
+                                      run_async=run_async, waitfor=waitfor)
         desc = self._prepare(CCLOp.allgather, count=count, comm=comm,
                              op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def allreduce(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int,
                   func: ReduceFunc = ReduceFunc.SUM, *,
@@ -865,21 +1127,26 @@ class ACCL:
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                  compress_dtype=None,
                   run_async: bool = False, chain: bool = False,
-                  waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                  waitfor: Sequence[CallHandle] = (),
+                  retries: int | None = None,
+                  retry_policy: "RetryPolicy | None" = None
+                  ) -> CallHandle:
         comm = comm or self.comm
         if self._hier_route(
                 "allreduce", comm, count,
                 max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
                 algorithm):
-            return self._hier.run("allreduce", count=count, src=srcbuf,
-                                  dst=dstbuf, func=func,
-                                  compress_dtype=compress_dtype,
-                                  run_async=run_async, waitfor=waitfor)
+            with self._retry_scope(retries, retry_policy):
+                return self._hier.run("allreduce", count=count, src=srcbuf,
+                                      dst=dstbuf, func=func,
+                                      compress_dtype=compress_dtype,
+                                      run_async=run_async, waitfor=waitfor)
         desc = self._prepare(CCLOp.allreduce, count=count, comm=comm,
                              func=func, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def reduce_scatter(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer,
                        count: int, func: ReduceFunc = ReduceFunc.SUM, *,
@@ -887,17 +1154,21 @@ class ACCL:
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
                        compress_dtype=None,
                        run_async: bool = False, chain: bool = False,
-                       waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                       waitfor: Sequence[CallHandle] = (),
+                       retries: int | None = None,
+                       retry_policy: "RetryPolicy | None" = None
+                       ) -> CallHandle:
         """count = per-rank chunk; srcbuf holds world_size*count."""
         comm = comm or self.comm
         if self._hier_route(
                 "reduce_scatter", comm, count,
                 max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
                 algorithm):
-            return self._hier.run("reduce_scatter", count=count,
-                                  src=srcbuf, dst=dstbuf, func=func,
-                                  compress_dtype=compress_dtype,
-                                  run_async=run_async, waitfor=waitfor)
+            with self._retry_scope(retries, retry_policy):
+                return self._hier.run("reduce_scatter", count=count,
+                                      src=srcbuf, dst=dstbuf, func=func,
+                                      compress_dtype=compress_dtype,
+                                      run_async=run_async, waitfor=waitfor)
         desc = self._prepare(CCLOp.reduce_scatter, count=count, comm=comm,
                              func=func, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
@@ -909,24 +1180,32 @@ class ACCL:
             desc.addr_1 = self._scratch(
                 comm.size * count,
                 desc.arithcfg.uncompressed_dtype).address
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def alltoall(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,
                  comm: Communicator | None = None, compress_dtype=None,
                  run_async: bool = False, chain: bool = False,
-                 waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                 waitfor: Sequence[CallHandle] = (),
+                 retries: int | None = None,
+                 retry_policy: "RetryPolicy | None" = None
+                 ) -> CallHandle:
         comm = comm or self.comm
         desc = self._prepare(CCLOp.alltoall, count=count, comm=comm,
                              op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype)
-        return self._call(desc, run_async, waitfor, chain)
+        return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
 
     def redistribute(self, srcbuf: ACCLBuffer, src_spec,
                      dstbuf: ACCLBuffer, dst_spec, *,
                      comm: Communicator | None = None,
                      members: Sequence[int] | None = None,
                      compress_dtype=None, run_async: bool = False,
-                     waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                     waitfor: Sequence[CallHandle] = (),
+                     retries: int | None = None,
+                     retry_policy: "RetryPolicy | None" = None
+                     ) -> CallHandle:
         """Change an array's sharding: ``srcbuf`` holds this rank's
         shard under ``src_spec`` (:class:`~accl_tpu.hier.ShardSpec`),
         and on completion ``dstbuf`` holds its shard under ``dst_spec``.
@@ -1036,7 +1315,8 @@ class ACCL:
                     self._scratch_bufs[sk] = stage
             src_arena = stage
         handles: list[CallHandle] = []
-        with self._attributed(tag):
+        with self._retry_scope(retries, retry_policy), \
+                self._attributed(tag):
             if src_arena is not srcbuf and src_count:
                 handles.append(self.copy(
                     _slice(srcbuf, 0, src_count),
@@ -1136,7 +1416,10 @@ class ACCL:
         return CompletedHandle(context="redistribute")
 
     def barrier(self, *, comm: Communicator | None = None,
-                waitfor: Sequence[CallHandle] = ()) -> CallHandle:
+                waitfor: Sequence[CallHandle] = (),
+                retries: int | None = None,
+                retry_policy: "RetryPolicy | None" = None
+                ) -> CallHandle:
         """Rendezvous of all ranks: a 1-element allreduce on a scratch
         buffer (the reference leans on host-side MPI barriers; we make it a
         first-class op)."""
@@ -1146,7 +1429,8 @@ class ACCL:
         buf = self._barrier_buf
         desc = self._prepare(CCLOp.allreduce, count=1, comm=comm,
                              op0=buf[0:1], res=buf[1:2])
-        return self._call(desc, False, waitfor)
+        return self._call(desc, False, waitfor, False, retries,
+                          retry_policy)
 
     # -- introspection (parity: accl.py:412-526, 710-735) ------------------
     def plan_cache_stats(self) -> dict:

@@ -1,0 +1,198 @@
+"""Seeded, deterministic fault plans — the chaos harness.
+
+The original ``inject_fault(fault_fn)`` hook takes an arbitrary callable,
+which makes fault schedules ad-hoc and (when the callable keeps state
+across concurrently-sending threads) irreproducible. A :class:`FaultPlan`
+is the structured replacement: a list of :class:`FaultRule` entries, each
+keyed on the frame's *identity* — ``(src, dst, comm_id, seqn)`` plus a
+per-frame ATTEMPT counter — and decided by a pure hash of that identity
+with the plan seed (:func:`~accl_tpu.emulator.reliability.mix_unit`).
+Identity-keyed decisions are reproducible from ``$ACCL_TPU_CHAOS_SEED``
+alone, regardless of how sender threads interleave; the attempt counter
+makes a retransmission of a dropped frame a FRESH coin flip, so a lossy
+schedule converges instead of dropping the same seqn forever.
+
+A plan is itself a valid ``inject_fault`` hook (callable ``(env, payload)
+-> action``), so every existing fault-injection surface accepts it:
+``LocalFabric.inject_fault(plan)``, ``UdpEthFabric.inject_fault(plan)``,
+tests, ``scripts/chaos_sweep.py`` and ``benchmarks/chaos.py``.
+
+Actions: ``drop`` | ``corrupt`` (seqn corruption — the receiver-side
+retransmit tracker rejects it at the horizon) | ``duplicate`` | ``delay``
+(the fabric sleeps ``delay_s`` on the sender thread before delivering) |
+``partition`` (drop every frame crossing the rule's two rank groups).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Sequence
+
+from .emulator.reliability import mix_unit
+
+KINDS = ("drop", "corrupt", "duplicate", "delay", "partition")
+
+_ACTION_OF = {"drop": "drop", "corrupt": "corrupt_seq",
+              "duplicate": "duplicate", "partition": "drop"}
+
+
+def chaos_seed_from_env(default: int = 0) -> int:
+    return int(os.environ.get("ACCL_TPU_CHAOS_SEED", default))
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    """One fault schedule entry. Every filter is optional (None = any):
+    a rule applies to frames matching ALL its filters, then fires either
+    probabilistically (``prob``, seeded per frame identity+attempt),
+    periodically (``every``/``offset`` over the channel seqn — seqn IS
+    the per-channel frame index, so "the nth frame" needs no shared
+    counter), or unconditionally when neither is given. ``limit`` bounds
+    total applications (first-N in identity-hash order is meaningless
+    under concurrency, so the limit is a plain atomic count)."""
+
+    kind: str
+    src: int | None = None
+    dst: int | None = None
+    comm_id: int | None = None
+    seqn_lo: int | None = None
+    seqn_hi: int | None = None        # exclusive
+    every: int | None = None          # fire when seqn % every == offset
+    offset: int = 0
+    # A deterministic every= rule applies only while the frame's
+    # delivery ATTEMPT is <= max_attempt (default: first attempt only):
+    # without this, a scheduled drop would deterministically re-drop its
+    # own retransmission forever and recovery could never converge. Set
+    # it high to test the retransmit give-up path. Probabilistic rules
+    # re-flip per attempt instead (fresh seeded coin).
+    max_attempt: int = 0
+    prob: float | None = None         # seeded per-(identity, attempt)
+    limit: int | None = None          # max applications
+    delay_s: float = 0.0              # for kind="delay"
+    group_a: tuple = ()               # for kind="partition": frames
+    group_b: tuple = ()               # crossing a<->b (either way) drop
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"valid: {KINDS}")
+        if self.kind == "partition" and not (self.group_a and self.group_b):
+            raise ValueError("partition rules need group_a and group_b")
+
+    def matches(self, env) -> bool:
+        if self.src is not None and env.src != self.src:
+            return False
+        if self.dst is not None and env.dst != self.dst:
+            return False
+        if self.comm_id is not None and env.comm_id != self.comm_id:
+            return False
+        if self.seqn_lo is not None and env.seqn < self.seqn_lo:
+            return False
+        if self.seqn_hi is not None and env.seqn >= self.seqn_hi:
+            return False
+        if self.kind == "partition":
+            if not ((env.src in self.group_a and env.dst in self.group_b)
+                    or (env.src in self.group_b
+                        and env.dst in self.group_a)):
+                return False
+        if self.every is not None and env.seqn % self.every != self.offset:
+            return False
+        return True
+
+
+class FaultPlan:
+    """A seeded schedule of faults; callable as an ``inject_fault`` hook.
+
+    Returns the fabric action string for the first firing rule
+    (``"deliver"`` when none fires); ``delay`` rules return the tuple
+    ``("delay", seconds)`` the fabrics understand. Per-frame attempt
+    counts (for the probabilistic re-flip on retransmission) are the only
+    shared state, guarded by a small lock and pruned against each
+    channel's seqn high-water mark so long chaos soaks stay bounded.
+    """
+
+    def __init__(self, rules: Sequence[FaultRule], seed: int | None = None):
+        self.rules = list(rules)
+        self.seed = chaos_seed_from_env() if seed is None else int(seed)
+        self._mu = threading.Lock()
+        self._attempts: dict[tuple, int] = {}
+        self._chan_hwm: dict[tuple, int] = {}
+        self.applied: dict[str, int] = {k: 0 for k in KINDS}
+        self._rule_applied = [0] * len(self.rules)
+        self.frames_seen = 0
+
+    # -- convenience constructors -----------------------------------------
+    @classmethod
+    def loss(cls, prob: float, seed: int | None = None,
+             kind: str = "drop", **filters) -> "FaultPlan":
+        """Uniform seeded loss (or corrupt/duplicate/delay) at ``prob``."""
+        return cls([FaultRule(kind=kind, prob=prob, **filters)], seed=seed)
+
+    @classmethod
+    def partition(cls, group_a, group_b, seed: int | None = None,
+                  **filters) -> "FaultPlan":
+        """Full bidirectional partition between two rank groups."""
+        return cls([FaultRule(kind="partition", group_a=tuple(group_a),
+                              group_b=tuple(group_b), **filters)],
+                   seed=seed)
+
+    def _attempt(self, env) -> int:
+        """0-based delivery attempt for this frame identity (a
+        retransmission of seqn s is attempt 1, 2, ...)."""
+        key = (env.src, env.dst, env.comm_id, env.seqn)
+        chan = key[:3]
+        with self._mu:
+            n = self._attempts.get(key, 0)
+            self._attempts[key] = n + 1
+            hwm = self._chan_hwm.get(chan, 0)
+            if env.seqn > hwm:
+                self._chan_hwm[chan] = env.seqn
+            if len(self._attempts) > (1 << 16):
+                # prune identities far below their channel frontier:
+                # retransmissions target recent seqns only
+                for k in [k for k in self._attempts
+                          if k[3] < self._chan_hwm.get(k[:3], 0) - 4096]:
+                    del self._attempts[k]
+        return n
+
+    def __call__(self, env, payload=None):
+        self.frames_seen += 1
+        attempt = None
+        for i, rule in enumerate(self.rules):
+            if not rule.matches(env):
+                continue
+            if rule.prob is not None:
+                if attempt is None:
+                    attempt = self._attempt(env)
+                u = mix_unit(self.seed, i, env.src, env.dst,
+                             env.comm_id, env.seqn, attempt)
+                if u >= rule.prob:
+                    continue
+            elif rule.every is not None:
+                if attempt is None:
+                    attempt = self._attempt(env)
+                if attempt > rule.max_attempt:
+                    continue
+            if rule.limit is not None:
+                with self._mu:
+                    if self._rule_applied[i] >= rule.limit:
+                        continue
+                    self._rule_applied[i] += 1
+            else:
+                with self._mu:
+                    self._rule_applied[i] += 1
+            self.applied[rule.kind] += 1
+            if rule.kind == "delay":
+                return ("delay", rule.delay_s)
+            return _ACTION_OF[rule.kind]
+        return "deliver"
+
+    def describe(self) -> str:
+        lines = [f"FaultPlan(seed={self.seed}, "
+                 f"frames_seen={self.frames_seen})"]
+        for i, rule in enumerate(self.rules):
+            lines.append(f"  rule {i}: {rule.kind} applied="
+                         f"{self._rule_applied[i]} {rule}")
+        return "\n".join(lines)

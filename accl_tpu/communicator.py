@@ -56,6 +56,16 @@ class Communicator:
     comm_id: int | None = None
     mesh_axis: str | None = None  # mesh axis name when TPU-backed
     key: int = 0                  # disambiguates same-membership comms
+    # ULFM-style revocation (failure containment): once revoked — the
+    # application's reaction to observing ErrorCode.PEER_FAILED — the
+    # driver refuses further calls on this communicator; survivors
+    # rebuild via ACCL.shrink_communicator. Rank-local, like the
+    # failure observation itself. Splits never inherit it (a shrunken
+    # survivor comm starts healthy).
+    revoked: bool = False
+
+    def revoke(self):
+        self.revoked = True
 
     def __post_init__(self):
         # default global ranks to comm-local numbering (the world comm case)

@@ -263,6 +263,22 @@ class ErrorCode(enum.IntFlag):
     # hitting its quota is diagnosable from the error word alone, and
     # never misread as a deadline/DMA failure
     TENANT_QUOTA_EXCEEDED = 1 << 25
+    # reliability layer (emulator/reliability.py): a lossy transport
+    # (UDP deliver-queue overflow with retransmission disabled) dropped a
+    # frame AFTER it left the wire — latched per comm AT DROP TIME so the
+    # failure surfaces as itself instead of as the receiver's generic
+    # recv deadline much later
+    FABRIC_QUEUE_OVERFLOW = 1 << 26
+    # membership (heartbeats / retransmit give-up): a connected peer
+    # stopped answering — missed-heartbeat budget exhausted, or every
+    # retransmission of a frame toward it went unacknowledged. Latched
+    # per comm (never across tenants); the application rebuilds with
+    # comm.revoke() + ACCL.shrink_communicator(dead_ranks)
+    PEER_FAILED = 1 << 27
+    # driver call-level retry: the retry policy re-executed the call and
+    # every attempt failed — OR-ed over the final attempt's word so the
+    # caller sees both WHAT kept failing and THAT retries ran out
+    CALL_RETRIES_EXHAUSTED = 1 << 28
 
 
 class StackType(enum.IntEnum):
@@ -332,4 +348,23 @@ DEFAULT_CALL_CHAIN_DEPTH = 2
 # in-flight slot. $ACCL_TPU_TENANT_DEPTH overrides per process;
 # ServiceConfig.tenant(depth=...) overrides per tenant.
 DEFAULT_TENANT_DEPTH = 2
+# Reliability layer (emulator/reliability.py): per-link selective-
+# retransmission in-flight window, in frames. The sender keeps at most
+# this many unacknowledged frames per (dst, comm) channel and
+# retransmits on RTO with exponential backoff + jitter; receivers dedup
+# by exact seqn and acknowledge cumulatively+selectively. 0 disables
+# retransmission entirely (the pre-retransmit behavior: a lost frame
+# surfaces as a typed drop latch or a recv deadline downstream).
+# $ACCL_TPU_RETX_WINDOW overrides per process, read at fabric
+# CONSTRUCTION time.
+DEFAULT_RETX_WINDOW = 64
+DEFAULT_RETX_RTO_S = 0.05      # base retransmit timeout (doubles per try)
+DEFAULT_RETX_RTO_MAX_S = 1.0   # backoff ceiling
+DEFAULT_RETX_MAX_TRIES = 10    # give-up bound -> PEER_FAILED latch
+# Heartbeat-based peer-failure detection: interval in ms (0 = off, the
+# default — heartbeats are armed explicitly per world or via
+# $ACCL_TPU_HEARTBEAT_MS for daemons) and the missed-beat budget after
+# which a silent peer is declared dead (PEER_FAILED latched per comm).
+DEFAULT_HEARTBEAT_MS = 0
+DEFAULT_HEARTBEAT_BUDGET = 3
 TAG_ANY = 0xFFFFFFFF                        # reference uses tag=ANY sentinel

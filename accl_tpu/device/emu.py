@@ -55,9 +55,18 @@ class EmuContext:
                  plan_cache: bool | None = None,
                  service: "ServiceConfig | bool | None" = None,
                  hosts=None, inter_alpha_us: float | None = None,
-                 inter_beta_gbps: float | None = None):
+                 inter_beta_gbps: float | None = None,
+                 retx_window: int | None = None):
         self.world_size = world_size
-        self.fabric = LocalFabric(world_size)
+        # ``retx_window`` sets the fabric's selective-retransmission
+        # in-flight window (None = $ACCL_TPU_RETX_WINDOW / process
+        # default, 0 = pre-retransmit fault-surfacing behavior)
+        self.fabric = LocalFabric(world_size, retx_window=retx_window)
+        # membership: heartbeat thread state (armed via start_heartbeats)
+        self._hb_stop: threading.Event | None = None
+        self._hb_killed: set[int] = set()
+        self.hb_interval = 0.0
+        self.hb_budget = 3
         # two-tier emulation (accl_tpu/hier): ``hosts`` maps rank->host
         # id (contiguous runs). Devices then report a MeshTopology so an
         # attached tuner prices hierarchical phase programs, and — when
@@ -129,13 +138,82 @@ class EmuContext:
         self.segment_stream = segment_stream
         self.plan_cache = plan_cache
         self.devices: list[EmuDevice | None] = [None] * world_size
+        self._deinit_count = 0
+
+    def note_device_deinit(self):
+        """Called by each EmuDevice.deinit: once the whole world has
+        torn down, an armed heartbeat thread must die with it (it holds
+        the context alive through its references and would spin
+        forever — worlds are created by the thousands per session)."""
+        self._deinit_count += 1
+        if self._deinit_count >= self.world_size:
+            self.stop_heartbeats()
 
     def device(self, rank: int) -> "EmuDevice":
         if self.devices[rank] is None:
             dev = EmuDevice(self, rank)
             self.devices[rank] = dev
             self.fabric.attach(rank, dev.ingest)
+            # retransmit give-up latches PEER_FAILED into the rank's
+            # CURRENT pool (closure — soft reset swaps the pool object)
+            self.fabric.set_latch(
+                rank, lambda cid, err, d=dev: d.pool.latch_error(cid, err))
         return self.devices[rank]
+
+    # -- membership (heartbeats) -------------------------------------------
+    def start_heartbeats(self, interval_s: float = 0.05, budget: int = 3):
+        """Arm heartbeat-based peer-failure detection for this world: one
+        context thread emits per-rank heartbeat frames through the fabric
+        (so a chaos partition or :meth:`kill_rank` silences them exactly
+        like data), and each device tracks its peers' last-heard times.
+        A peer silent past ``budget`` intervals is declared dead:
+        PEER_FAILED latches on every comm containing it, waiting programs
+        abort immediately, and new calls on those comms fail fast — other
+        communicators keep flowing. Off by default (tests/worlds opt in;
+        steady-state cost is W^2 tiny frames per interval)."""
+        if self._hb_stop is not None:
+            return
+        self.hb_interval = float(interval_s)
+        self.hb_budget = max(1, int(budget))
+        self._hb_stop = threading.Event()
+        threading.Thread(target=self._hb_loop, daemon=True,
+                         name="emu-heartbeat").start()
+
+    def stop_heartbeats(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_stop = None
+
+    def kill_rank(self, rank: int):
+        """Inject a rank death: the rank stops heartbeating (its device
+        threads stay up — in-process ranks share fate — but to its peers
+        it is indistinguishable from a crashed host). Combine with a
+        chaos partition to also silence its data frames."""
+        self._hb_killed.add(rank)
+
+    def revive_rank(self, rank: int):
+        self._hb_killed.discard(rank)
+
+    def _hb_loop(self):
+        from ..emulator.protocol import HB_STRM
+        stop = self._hb_stop
+        while stop is not None and not stop.wait(self.hb_interval):
+            for r, dev in enumerate(self.devices):
+                if dev is None or r in self._hb_killed:
+                    continue
+                for q in range(self.world_size):
+                    if q == r or self.devices[q] is None:
+                        continue
+                    env = Envelope(src=r, dst=q, tag=0, seqn=0, nbytes=0,
+                                   wire_dtype="uint8", strm=HB_STRM)
+                    try:
+                        self.fabric.send(env, b"")
+                    except RuntimeError:
+                        pass  # peer detached mid-teardown
+            now = time.monotonic()
+            for dev in self.devices:
+                if dev is not None:
+                    dev.check_peers(now, self.hb_interval, self.hb_budget)
 
 
 class EmuDevice(Device):
@@ -181,6 +259,11 @@ class EmuDevice(Device):
         # REFERENCE with the rx pool and the RankService so a late
         # tenant registration is visible everywhere at once.
         self.comm_tenants: dict[int, str] = {}
+        # membership state (armed via ctx.start_heartbeats): peers are
+        # tracked once heard from; a dead peer fail-fasts calls on every
+        # comm containing it until shrink_communicator rebuilds
+        self._peer_last: dict[int, float] = {}
+        self._dead_peers: set[int] = set()
         self.service = None
         if ctx.service_config is not None:
             self.service = RankService(
@@ -224,8 +307,72 @@ class EmuDevice(Device):
                                          name=f"emu-ingress{rank}")
         self._ingress.start()
 
+    # -- membership (heartbeats; fed by EmuContext._hb_loop) ---------------
+    def note_heartbeat(self, grank: int):
+        if grank in self._dead_peers:
+            self._dead_peers.discard(grank)
+        self._peer_last[grank] = time.monotonic()
+
+    def check_peers(self, now: float, interval: float, budget: int):
+        for g, last in list(self._peer_last.items()):
+            if g in self._dead_peers:
+                continue
+            age = now - last
+            if age > interval:
+                from ..tracing import METRICS
+                METRICS.inc("heartbeat_missed_total", rank=self.rank,
+                            peer=g, tier="emu")
+            if age > interval * budget:
+                self.note_peer_failed(g)
+
+    def note_peer_failed(self, grank: int):
+        """Containment: latch PEER_FAILED on every communicator
+        containing the dead peer (per-comm latches — never across
+        tenants), fast-abort programs waiting on it, and fail-fast new
+        calls on those comms. Communicators excluding the peer (e.g. a
+        shrunken survivor comm) are untouched."""
+        if grank in self._dead_peers:
+            return
+        self._dead_peers.add(grank)
+        from ..log import get_logger
+        from ..tracing import METRICS
+        get_logger(__name__).warning(
+            "rank %d: peer %d declared dead (missed-heartbeat budget) — "
+            "latching PEER_FAILED on its communicators", self.rank, grank,
+            extra={"rank": self.rank})
+        METRICS.inc("peer_failed_total", rank=self.rank, peer=grank,
+                    tier="emu")
+        for cid, comm in list(self.comms.items()):
+            if any(r.global_rank == grank for r in comm.ranks):
+                self.pool.latch_error(cid, int(ErrorCode.PEER_FAILED))
+        self.executor.fail_peer(grank, int(ErrorCode.PEER_FAILED))
+
+    # -- reliability / retry hooks -----------------------------------------
+    def prepare_retry(self, comm_id: int) -> int:
+        """Pre-retry cleanup (driver retry policy): purge the failed
+        attempt's stale frames from the rx pool and clear the comm's
+        error latch. The retry epoch itself is free — per-peer seqn
+        counters advanced fully when the failed attempt was admitted, so
+        the re-execution's frames live in a fresh seqn range that stale
+        attempt-N traffic can never satisfy."""
+        return self.pool.purge_comm(comm_id)
+
+    def rx_capacity(self) -> tuple[int, int]:
+        """(nbufs, bufsize) of this rank's rx pool — the preflight
+        surface (hierarchical multi-MiB calls want nbufs*bufsize to hold
+        at least 2 chunks, see ACCL.preflight)."""
+        return (self.ctx.nbufs, self.ctx.bufsize)
+
     # -- ingress (eager, never blocks the sender) --------------------------
     def ingest(self, env: Envelope, payload: bytes):
+        if env.strm >= 2:
+            # reliability control frames: heartbeats feed the membership
+            # tracker; anything else (stray ACKs — LocalFabric acks are
+            # internal calls) is dropped, never stream-delivered
+            from ..emulator.protocol import HB_STRM
+            if env.strm == HB_STRM:
+                self.note_heartbeat(env.src)
+            return
         # Fast path: deliver into the pool from the sender's thread — one
         # scheduler handoff less per message, and the ingest-inline
         # cut-through then runs the waiting move right here. Taken even
@@ -314,6 +461,13 @@ class EmuDevice(Device):
         Reconfiguration invalidates the compiled-plan cache (and bumps
         the epoch its keys carry): plans bind comm size/rank numbering at
         expansion time."""
+        if comm.comm_id in self.comms:
+            # true RE-configuration: its per-peer seqn spaces restart,
+            # so retransmission channel state keyed on the old space
+            # must not dedup the new one away (fresh comm ids need no
+            # reset — and get none, so a racing split can never wipe a
+            # sibling rank's in-flight ring)
+            self.ctx.fabric.reset_comm(comm.comm_id)
         self.comms[comm.comm_id] = comm
         if tenant:
             self.comm_tenants[comm.comm_id] = tenant
@@ -450,6 +604,9 @@ class EmuDevice(Device):
         self.executor.reset_streams()
         if self.service is not None:
             self.service.wire_pool(self.pool)
+        # retransmission channels keyed on the zeroed seqn spaces reset
+        # with them (the fabric latch closure reads self.pool — current)
+        self.ctx.fabric.reset_rank(self.rank)
         for comm in self.comms.values():
             for r in comm.ranks:
                 r.inbound_seq = r.outbound_seq = 0
@@ -463,6 +620,7 @@ class EmuDevice(Device):
         if self.service is not None:
             self.service.close()
         self.executor.close()
+        self.ctx.note_device_deinit()
 
     # -- worker ------------------------------------------------------------
     def _run(self):
@@ -495,6 +653,17 @@ class EmuDevice(Device):
         try:
             for dep in waitfor:
                 dep.wait(self.timeout)
+            if self._dead_peers \
+                    and desc.scenario not in (CCLOp.config, CCLOp.nop):
+                comm = self.comms.get(desc.comm_id)
+                if comm is not None and any(
+                        r.global_rank in self._dead_peers
+                        for r in comm.ranks):
+                    # fail-fast BEFORE service admission too: an admitted
+                    # program over a dead member would only burn workers
+                    # until its recv deadline
+                    handle.complete(int(ErrorCode.PEER_FAILED))
+                    return False
             if allow_service and self._service_eligible(desc):
                 # The service path runs ENTIRELY outside _exec_mu: the
                 # controller has its own lock, per-comm program order is
@@ -766,6 +935,12 @@ class EmuDevice(Device):
             "plan_us": round(plan_us, 1), "plan_cache": state}
 
     def _execute_data(self, desc: CallDescriptor, comm: Communicator) -> int:
+        if self._dead_peers and any(r.global_rank in self._dead_peers
+                                    for r in comm.ranks):
+            # fail-fast containment: a collective over a dead member can
+            # only burn its deadline — surface PEER_FAILED immediately;
+            # comms excluding the peer (shrunken survivors) run normally
+            return int(ErrorCode.PEER_FAILED)
         moves, skeleton, meta = self._prepare_program(desc, comm)
         err = self.executor.execute(
             moves, desc.arithcfg, comm, skeleton=skeleton,

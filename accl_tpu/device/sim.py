@@ -224,6 +224,12 @@ class SimDevice(Device):
         reply = self._request(bytes([P.MSG_DUMP_RX]))
         return reply[1:].decode()
 
+    def rx_capacity(self) -> tuple[int, int]:
+        """(nbufs, bufsize) of the daemon's rx pool — the preflight
+        surface (ACCL.preflight / hierarchical rx-pool sizing check)."""
+        info = self.get_info()
+        return (int(info["nbufs"]), int(info["bufsize"]))
+
     def get_info(self) -> dict:
         """Daemon geometry + runtime-config state — the readable effect of
         ACCL_CONFIG calls (extended MSG_GET_INFO reply; older daemons

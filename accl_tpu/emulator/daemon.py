@@ -296,11 +296,18 @@ class UdpEthFabric:
     QUEUE_DEPTH = 64        # per-sender delivery bound; beyond it messages
     # are DROPPED (UDP semantics): TCP's flow control does not exist here,
     # and an unbounded queue would grow without limit while the rx pool is
-    # full. Drops are counted in ``stats["dropped_queue_full"]`` and
-    # surface as receive timeouts upstream.
+    # full. Drops are counted in ``stats["dropped_queue_full"]``; with the
+    # reliability layer armed (default) the dropped message is simply not
+    # acknowledged — the sender's RTO recovers it once the queue drains —
+    # and with $ACCL_TPU_RETX_WINDOW=0 a typed FABRIC_QUEUE_OVERFLOW is
+    # latched per comm AT DROP TIME (``latch_fn``), so the failure
+    # surfaces as itself instead of as a generic recv timeout.
 
-    def __init__(self, my_global_rank: int, eth_port: int, ingest_fn):
+    def __init__(self, my_global_rank: int, eth_port: int, ingest_fn,
+                 retx_window: int | None = None):
         import time as _t
+
+        from .reliability import RetxEndpoint, retx_window_from_env
         self.me = my_global_rank
         self.ingest = ingest_fn
         self._time = _t
@@ -315,11 +322,29 @@ class UdpEthFabric:
         self._partial: dict = {}
         self._queues: dict = {}  # sender -> delivery Queue (lazy workers)
         self._closing = False
+        self._fault = None       # chaos hook (message-level, like Local)
+        # typed drop latch (daemon wires the rx pool's latch_error):
+        # surfaces deliver-queue drops per comm on the no-retx path
+        self.latch_fn = None
+        # selective retransmission over the genuinely lossy stack: the
+        # sender's in-flight ring snapshots each eth message (the socket
+        # path reuses caller scratch after send) and unacknowledged
+        # messages retransmit on RTO. ACKs ride strm=ACK_STRM frames.
+        window = (retx_window_from_env() if retx_window is None
+                  else max(0, int(retx_window)))
+        self.retx = None
+        if window > 0:
+            self.retx = RetxEndpoint(
+                my_global_rank, resend_fn=self._resend,
+                ack_fn=self._send_ack, window=window,
+                latch_fn=lambda cid, err: (self.latch_fn(cid, err)
+                                           if self.latch_fn else None),
+                fabric="udp", copy_payloads=True)
         # observable health of the lossy transport: a slow consumer shows
         # up here (bounded-queue drops) instead of as silent unbounded
         # memory growth
         self.stats = {"sent": 0, "delivered": 0, "dropped_queue_full": 0,
-                      "gc_partials": 0}
+                      "gc_partials": 0, "fault_dropped": 0}
         # deliver-queue drops fold through a collector, not a per-event
         # registry inc: a slow consumer rejects EVERY frame of a large
         # collective, and taking the process-wide registry lock per drop
@@ -343,7 +368,74 @@ class UdpEthFabric:
                 if grank != self.me and port:
                     self._peer_addrs[grank] = (host, port + world)
 
+    # -- reliability / chaos ----------------------------------------------
+    def inject_fault(self, fault_fn):
+        """Message-level fault hook (``fault_fn(env, payload) -> action``,
+        a :class:`~accl_tpu.chaos.FaultPlan` qualifies): applied on the
+        send side to whole eth messages — drop / corrupt_seq / duplicate /
+        ("delay", s) — so a seeded chaos schedule exercises the UDP
+        stack's retransmission exactly like the in-process fabric's."""
+        self._fault = fault_fn
+
+    def clear_fault(self):
+        self._fault = None
+
+    def reset_reliability(self):
+        if self.retx is not None:
+            self.retx.reset()
+
+    def reset_comm(self, comm_id: int):
+        if self.retx is not None:
+            self.retx.reset_comm(comm_id)
+
+    def _send_ack(self, dst_grank: int, comm_id: int, cum: int, sel):
+        env = Envelope(src=self.me, dst=dst_grank, tag=0, seqn=cum,
+                       nbytes=0, wire_dtype="uint8", strm=P.ACK_STRM,
+                       comm_id=comm_id)
+        try:
+            self._wire_send(env, P.pack_ack(cum, sel))
+        except (KeyError, OSError):
+            pass  # peer unknown / socket closing: the sender's RTO covers
+
+    def _resend(self, env: Envelope, payload):
+        """Retransmission path: re-packetize the stored message (fresh
+        msg_id — reassembly is per (sender, msg_id); dedup is by envelope
+        seqn at the receiver's reliability tracker)."""
+        self._wire_send(env, payload)
+
     def send(self, env: Envelope, payload: bytes):
+        if self.retx is not None and not env.strm:
+            self.retx.track(env, payload)
+        self._wire_send(env, payload)
+
+    def _wire_send(self, env: Envelope, payload):
+        # the fault hook sees data AND heartbeat frames (a partition
+        # must silence membership exactly like data — the documented
+        # contract); only ACK control frames are exempt, so a chaos
+        # schedule can never turn recovery against itself
+        if self._fault is not None and env.strm != P.ACK_STRM:
+            action = self._fault(env, payload)
+            if isinstance(action, tuple) and action \
+                    and action[0] == "delay":
+                self._time.sleep(float(action[1]))
+                action = "deliver"
+            if action == "drop":
+                self.stats["fault_dropped"] += 1
+                METRICS.inc("fabric_dropped_total", fabric="udp",
+                            comm_id=env.comm_id, src=env.src, dst=env.dst)
+                return
+            if action == "corrupt_seq":
+                import dataclasses as _dc
+                METRICS.inc("fabric_corrupted_total", fabric="udp",
+                            comm_id=env.comm_id, src=env.src, dst=env.dst)
+                env = _dc.replace(env, seqn=env.seqn + 1_000_000)
+            elif action == "duplicate":
+                METRICS.inc("fabric_duplicated_total", fabric="udp",
+                            comm_id=env.comm_id, src=env.src, dst=env.dst)
+                self._wire_frags(env, payload)
+        self._wire_frags(env, payload)
+
+    def _wire_frags(self, env: Envelope, payload):
         nbytes = P.payload_nbytes(payload)
         # scatter-gather packetization: the eth header and (memoryview
         # slices of) the payload ride each datagram's sendmsg iovec — the
@@ -411,6 +503,17 @@ class UdpEthFabric:
             del self._partial[key]
             frame = b"".join(entry[2][i] for i in range(entry[1]))
             env, payload = _env_from_eth_frame(frame)
+            if env.strm == P.ACK_STRM:
+                # reliability control plane: never reaches the pool
+                if self.retx is not None:
+                    cum, sel = P.unpack_ack(payload)
+                    self.retx.on_ack(env.src, env.comm_id, cum, sel)
+                return
+            if self.retx is not None and not env.strm \
+                    and not self.retx.fresh(env):
+                # duplicate (raced its own ACK) or out-of-horizon
+                # garbage: filtered before it can occupy an rx buffer
+                return
             # per-sender delivery queues: ingest (which blocks while the
             # rx pool is full) must not head-of-line-block fragments from
             # OTHER peers behind the single recv thread
@@ -419,6 +522,11 @@ class UdpEthFabric:
                 import queue as _queue
                 try:
                     q.put_nowait((env, payload))
+                    if self.retx is not None and not env.strm:
+                        # acknowledge only what was actually delivered:
+                        # a queue-full drop below stays unacked so the
+                        # sender's RTO recovers it
+                        self.retx.record(env)
                 except _queue.Full:
                     # bounded queue: drop (UDP semantics) — but COUNT it,
                     # so a slow consumer is diagnosable from stats
@@ -432,6 +540,13 @@ class UdpEthFabric:
                     # lost between the flush and the collector
                     with self._lock:
                         self._drops[k] = self._drops.get(k, 0) + 1
+                    if self.retx is None and self.latch_fn is not None:
+                        # pre-retransmit fallback ($ACCL_TPU_RETX_WINDOW
+                        # =0): the receiver used to just hang to its
+                        # deadline — latch the typed per-comm error AT
+                        # DROP TIME so the failure surfaces as itself
+                        self.latch_fn(env.comm_id,
+                                      int(ErrorCode.FABRIC_QUEUE_OVERFLOW))
         # GC stale partials (lost fragments must not leak memory)
         stale = [k for k, e in self._partial.items() if e[0] < now]
         for k in stale:
@@ -572,6 +687,18 @@ class RankDaemon:
         self.executor.tx_serializes = True
         self.executor.owner_rank = rank
         self._wire_flush()
+        self._wire_latch()
+        # membership: heartbeat-based peer-failure detection, armed via
+        # $ACCL_TPU_HEARTBEAT_MS (0 = off, the default). Peers are only
+        # tracked once heard from (no false deaths during bring-up);
+        # a silent peer past the missed-beat budget latches PEER_FAILED
+        # per comm containing it and fast-aborts waiting programs.
+        self.hb_interval = max(
+            0.0, int(os.environ.get("ACCL_TPU_HEARTBEAT_MS", "0")) / 1e3)
+        self.hb_budget = max(1, int(os.environ.get(
+            "ACCL_TPU_HEARTBEAT_BUDGET", "3")))
+        self._peer_last: dict[int, float] = {}
+        self.dead_peers: set[int] = set()
         # unified metrics: this daemon's health surfaces (eth fabric
         # stats, rx-pool occupancy, executor pipeline counters, plan
         # cache) polled only at snapshot time; the weak registration
@@ -634,6 +761,67 @@ class RankDaemon:
         self._call_queue: list[tuple[int, dict]] = []
         self._stop = threading.Event()
         threading.Thread(target=self._call_worker, daemon=True).start()
+        if self.hb_interval > 0:
+            threading.Thread(target=self._heartbeat_loop, daemon=True,
+                             name=f"hb-rank{rank}").start()
+
+    def _wire_latch(self):
+        """Give the fabric a typed per-comm error latch into the CURRENT
+        rx pool (a closure over ``self.pool``: soft reset swaps the pool
+        object, and a bound method of the old one would latch into the
+        corpse)."""
+        self.eth.latch_fn = lambda cid, err: self.pool.latch_error(cid,
+                                                                   err)
+
+    # -- membership (heartbeats) -------------------------------------------
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.hb_interval):
+            peers: set[int] = set()
+            for comm in list(self.comms.values()):
+                for r in comm.ranks:
+                    if r.global_rank != self.rank and r.port:
+                        peers.add(r.global_rank)
+            for g in peers:
+                env = Envelope(src=self.rank, dst=g, tag=0, seqn=0,
+                               nbytes=0, wire_dtype="uint8",
+                               strm=P.HB_STRM, comm_id=0)
+                try:
+                    self.eth.send(env, b"")
+                except (KeyError, OSError, ConnectionError):
+                    pass  # unreachable peer: exactly what the missed-
+                    # beat budget is counting
+            now = time.monotonic()
+            for g, last in list(self._peer_last.items()):
+                if g in self.dead_peers:
+                    continue
+                age = now - last
+                if age > self.hb_interval:
+                    METRICS.inc("heartbeat_missed_total", rank=self.rank,
+                                peer=g, tier="daemon")
+                if age > self.hb_interval * self.hb_budget:
+                    self._peer_dead(g)
+
+    def _note_heartbeat(self, grank: int):
+        if grank in self.dead_peers:
+            self.dead_peers.discard(grank)
+            log.warning("rank %d: peer %d resumed heartbeats", self.rank,
+                        grank, extra={"rank": self.rank})
+        self._peer_last[grank] = time.monotonic()
+
+    def _peer_dead(self, grank: int):
+        self.dead_peers.add(grank)
+        log.warning(
+            "rank %d: peer %d missed %d heartbeats (%.0f ms budget) — "
+            "declaring it dead, latching PEER_FAILED on its comms",
+            self.rank, grank, self.hb_budget,
+            self.hb_interval * self.hb_budget * 1e3,
+            extra={"rank": self.rank})
+        METRICS.inc("peer_failed_total", rank=self.rank, peer=grank,
+                    tier="daemon")
+        for cid, comm in list(self.comms.items()):
+            if any(r.global_rank == grank for r in comm.ranks):
+                self.pool.latch_error(cid, int(ErrorCode.PEER_FAILED))
+        self.executor.fail_peer(grank, int(ErrorCode.PEER_FAILED))
 
     def _wire_flush(self):
         """Hand the executor's egress the fabric's coalescing flush hook
@@ -646,6 +834,14 @@ class RankDaemon:
 
     # -- ingress -----------------------------------------------------------
     def _ingest(self, env: Envelope, payload: bytes):
+        if env.strm == P.HB_STRM:
+            self._note_heartbeat(env.src)
+            return
+        if env.strm >= 2:
+            # reliability control frames never reach the stream ports
+            # (the UDP fabric consumes its own ACKs; the TCP stack has
+            # no retransmission — a stray ACK is dropped, not streamed)
+            return
         if env.strm:
             self.executor.deliver_stream(env, payload)
             return
@@ -770,6 +966,12 @@ class RankDaemon:
             comm = self.comms.get(c["comm_id"])
             if comm is None:
                 return int(ErrorCode.COMM_NOT_CONFIGURED)
+            if self.dead_peers and any(r.global_rank in self.dead_peers
+                                       for r in comm.ranks):
+                # fail-fast containment (heartbeat membership): a
+                # collective over a dead member can only burn its
+                # deadline; comms excluding the peer run normally
+                return int(ErrorCode.PEER_FAILED)
             if scenario == CCLOp.barrier:
                 # rendezvous: 1-element fp32 allreduce on internal scratch;
                 # every descriptor field that could change the data movement
@@ -907,6 +1109,7 @@ class RankDaemon:
         self.stack = kind
         self.executor._send = self.eth.send
         self._wire_flush()  # coalescing hook follows the fabric swap
+        self._wire_latch()  # so does the typed drop latch
         for comm in self.comms.values():
             self.eth.learn_peers(
                 [(r.global_rank, r.host, r.port) for r in comm.ranks],
@@ -921,6 +1124,13 @@ class RankDaemon:
             self.pool.quota = self.rx_quota
         self.executor.pool = self.pool
         self.executor.reset_streams()
+        self._wire_latch()  # the latch closure reads self.pool — rebound
+        reset = getattr(self.eth, "reset_reliability", None)
+        if reset is not None:
+            # seqn spaces restart: channel state keyed on the old space
+            # must go with them (every rank of the world resets, per the
+            # soft-reset contract, so both ends clear)
+            reset()
         for comm in self.comms.values():
             for r in comm.ranks:
                 r.inbound_seq = r.outbound_seq = 0
@@ -1069,6 +1279,13 @@ class RankDaemon:
                 ranks=[Rank(host=h, port=p, global_rank=g)
                        for g, h, p in ranks],
                 local_rank=local_rank, comm_id=comm_id)
+            if comm_id in self.comms:
+                # true RE-configuration: the comm's per-peer seqn spaces
+                # restart at 0 — retransmission channel state keyed on
+                # the old space must not dedup the new one away
+                reset = getattr(self.eth, "reset_comm", None)
+                if reset is not None:
+                    reset(comm_id)
             self.comms[comm_id] = comm
             if tenant:
                 # wire input: the label lands verbatim in Prometheus
@@ -1239,14 +1456,18 @@ def _daemon_metrics_rows(d: "RankDaemon"):
     pipeline counters of the last retired call, plan-cache counters."""
     labels = {"rank": d.rank, "tier": "daemon", "ctx": d.ctx_seq}
     for k, v in d.eth.stats.items():
-        if k == "dropped_queue_full":
+        if k in ("dropped_queue_full", "fault_dropped"):
             # already folded into fabric_dropped_total (per comm/src/dst)
-            # by the UDP fabric's own collector — re-yielding it as its
-            # own family would show two drops for one event to any
-            # consumer summing "dropped"
+            # by the UDP fabric's own collector / the direct fault-site
+            # write — re-yielding either as its own family would show two
+            # drops for one event to any consumer summing "dropped"
             continue
         yield ("counter", f"fabric_{k}_total",
                dict(labels, fabric=d.stack), v)
+    retx = getattr(d.eth, "retx", None)
+    if retx is not None:
+        for kind, name, lbl, v in retx.metrics_rows():
+            yield (kind, name, dict(lbl, tier="daemon", ctx=d.ctx_seq), v)
     # pool / executor / plan-cache rows: the same mapping the device
     # collector uses (tracing.health_rows), so the tiers cannot drift
     yield from health_rows(d, labels)

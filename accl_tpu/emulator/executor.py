@@ -200,6 +200,43 @@ class RxBufferPool:
         self._err_by_comm[comm_id] = \
             self._err_by_comm.get(comm_id, 0) | err
 
+    def latch_error(self, comm_id: int, err: int):
+        """Latch a typed per-comm error from OUTSIDE the pool (the
+        reliability layer's drop-time and give-up paths, the membership
+        layer's PEER_FAILED): it surfaces in the next recv error word of
+        THAT communicator only, riding the same consume_error bridge the
+        ingress failures use."""
+        with self._cv:
+            self._latch_locked(comm_id, int(err))
+
+    def purge_comm(self, comm_id: int) -> int:
+        """Release every reserved buffer holding a frame of ``comm_id``
+        and clear its error latch — the pre-retry cleanup (a failed
+        attempt's stale frames occupy spares that nothing will ever
+        match: the retry epoch's seqn space starts above them). Returns
+        the number of buffers freed."""
+        freed = 0
+        with self._cv:
+            for key in [k for k in self._by_key if k[1] == comm_id]:
+                for b in self._by_key.pop(key):
+                    b.status = RxBuffer.IDLE
+                    b.env, b.payload = None, b""
+                    if b.tenant is not None and self.quota is not None:
+                        self.quota.release(b.tenant)
+                    b.tenant = None
+                    self._idle.append(b)
+                    freed += 1
+            self._err_by_comm.pop(comm_id, None)
+            agg = 0
+            for v in self._err_by_comm.values():
+                agg |= v
+            self.error_word = agg
+            if freed:
+                self._cv.notify_all()
+        if freed and self.on_release is not None:
+            self.on_release()
+        return freed
+
     def _claim(self, env: Envelope, payload, keep: int) -> int:
         """Claim an IDLE buffer, leaving at least ``keep`` spares; caller
         holds ``self._cv``. Returns 1 on success, 0 when the pool is
@@ -1631,6 +1668,28 @@ class MoveExecutor:
                 self._run_task(*run)
             finally:
                 _INLINE.depth -= 1
+
+    def fail_peer(self, grank: int, err: int):
+        """Membership containment: a peer was declared dead — abort every
+        ACTIVE program whose communicator contains it with the typed
+        error, NOW, instead of letting each waiting recv burn its full
+        deadline. Programs on communicators that do not include the peer
+        are untouched (the per-comm isolation contract: a failure never
+        crosses the comm — and therefore never the tenant — boundary)."""
+        dumped = False
+        with self._sched_lock:
+            for p in self._progs:
+                if p.aborted:
+                    continue
+                if any(r.global_rank == grank for r in p.comm.ranks):
+                    p.err |= int(err)
+                    self._abort_locked(p)
+                    dumped = True
+            if dumped:
+                self._work_cv.notify_all()
+        if dumped and _TRACE.enabled:
+            _TRACE.trigger_dump(f"peer_failed_rank{grank}",
+                                rank=self.owner_rank)
 
     def _cancel_chain_locked(self, prog: _Prog, succ: list):
         stack = list(succ)

@@ -26,6 +26,7 @@ import dataclasses
 import itertools
 
 from ..tracing import METRICS, TRACE as _TRACE
+from .reliability import RTO_MIN_S, RetxEndpoint, retx_window_from_env
 
 # fabric-instance tags for registry rows (see LocalFabric.__init__)
 _CTX_SEQ = itertools.count(1)
@@ -66,7 +67,7 @@ class LocalFabric:
 
     retains_payloads = True
 
-    def __init__(self, world_size: int):
+    def __init__(self, world_size: int, retx_window: int | None = None):
         self.world_size = world_size
         # process-unique instance tag on every registry row this fabric
         # produces: comm_id is a deterministic membership CRC, so two
@@ -75,8 +76,17 @@ class LocalFabric:
         self.ctx_seq = next(_CTX_SEQ)
         self._ingress: list = [None] * world_size
         self._fault = None
+        # selective retransmission (emulator/reliability.py): one
+        # endpoint per attached rank; injected drops/corrupts/duplicates
+        # become recoverable instead of fatal. 0 disables (the
+        # pre-retransmit fault-surfacing behavior); None reads
+        # $ACCL_TPU_RETX_WINDOW (default on).
+        self.retx_window = (retx_window_from_env() if retx_window is None
+                            else max(0, int(retx_window)))
+        self._retx: list[RetxEndpoint | None] = [None] * world_size
+        self._latch_fns: list = [None] * world_size
         self.stats = {"sent": 0, "dropped": 0, "duplicated": 0,
-                      "corrupted": 0, "throttled": 0}
+                      "corrupted": 0, "throttled": 0, "delayed": 0}
         # per-communicator attribution of the same counters (QoS
         # accounting foundation, ROADMAP item 3): comm_id -> counter dict
         self.stats_by_comm: dict[int, dict[str, int]] = {}
@@ -94,6 +104,59 @@ class LocalFabric:
     def attach(self, rank: int, ingress_fn):
         """ingress_fn(env, payload) is the rank's eager-ingress entry."""
         self._ingress[rank] = ingress_fn
+        if self.retx_window > 0 and self._retx[rank] is None:
+            # the in-process "wire" is a function call, so acknowledgement
+            # is a direct method call into the data sender's endpoint —
+            # the LocalFabric analog of the UDP stack's ACK frames
+            self._retx[rank] = RetxEndpoint(
+                rank,
+                resend_fn=lambda env, p: self._deliver(env, p, retx=True),
+                ack_fn=lambda sender, cid, cum, sel, me=rank:
+                    self._peer_ack(sender, me, cid, cum, sel),
+                window=self.retx_window,
+                latch_fn=lambda cid, err, r=rank: self._latch(r, cid, err),
+                fabric="local",
+                # delivery is a synchronous call: the true RTT is
+                # microseconds by construction, and lazy tracking means
+                # clean frames never feed the adaptive estimator — pin
+                # the base RTO at the floor instead of the wire default
+                rto_s=RTO_MIN_S)
+
+    def _peer_ack(self, sender: int, me: int, comm_id: int, cum: int, sel):
+        ep = self._retx[sender]
+        if ep is not None:
+            ep.on_ack(me, comm_id, cum, sel)
+
+    def set_latch(self, rank: int, latch_fn):
+        """Wire a typed per-comm error latch for ``rank`` (the owning
+        device's rx pool): retransmit give-up surfaces as PEER_FAILED in
+        that rank's next recv error word instead of a bare timeout."""
+        self._latch_fns[rank] = latch_fn
+
+    def _latch(self, rank: int, comm_id: int, err: int):
+        fn = self._latch_fns[rank]
+        if fn is not None:
+            fn(comm_id, err)
+
+    def reset_rank(self, rank: int):
+        """Rank-local soft reset: the rank's seqn spaces restart, so every
+        retransmission channel touching it must forget its state (each
+        rank of the world resets itself — the documented soft-reset
+        contract — so all endpoints clear)."""
+        for i, ep in enumerate(self._retx):
+            if ep is None:
+                continue
+            if i == rank:
+                ep.reset()
+            else:
+                ep.reset_peer(rank)
+
+    def reset_comm(self, comm_id: int):
+        """A communicator was (re)configured: its per-peer seqn spaces
+        restart at 0 — drop the matching retransmission channels."""
+        for ep in self._retx:
+            if ep is not None:
+                ep.reset_comm(comm_id)
 
     # -- fault injection (extension beyond the reference, which has none:
     #    SURVEY §5 — its only provokable failure is a receive timeout) ------
@@ -155,7 +218,7 @@ class LocalFabric:
         if st is None:
             st = self.stats_by_comm[comm_id] = {
                 "sent": 0, "dropped": 0, "duplicated": 0,
-                "corrupted": 0, "throttled": 0}
+                "corrupted": 0, "throttled": 0, "delayed": 0}
         return st
 
     def send(self, env: Envelope, payload: bytes):
@@ -181,7 +244,41 @@ class LocalFabric:
         if _TRACE.enabled:
             _TRACE.emit("wire_send", rank=env.src, seqn=env.seqn,
                         peer=env.dst, nbytes=env.nbytes)
-        action = self._fault(env, payload) if self._fault else "deliver"
+        self._deliver(env, payload)
+
+    def _deliver(self, env: Envelope, payload, retx: bool = False):
+        """Fault hook + actual handoff — shared by ``send`` and the
+        retransmission path (a resend passes the hook again, so a chaos
+        schedule applies to retransmitted frames too, with a fresh
+        per-attempt coin flip for seeded plans).
+
+        Lazy tracking: the in-process "wire" is a synchronous function
+        call whose ONLY loss modes are this hook's own drop/corrupt
+        actions — the sender learns the frame's fate before send()
+        returns. So clean frames never enter the in-flight ring at all
+        (no ring insert, no ACK, no removal: the whole sender-side cost
+        in the fault-free hot path is one fault-hook branch), and only
+        an actually-lost frame is tracked for RTO recovery. A resend
+        that gets dropped AGAIN is already in the ring (``retx=True``)."""
+        fn = self._ingress[env.dst]
+        if fn is None:
+            return  # resend after detach: the world is tearing down
+        if self._fault is None:
+            # production-default fast path: no hook, no fault-branch
+            # bookkeeping — one branch per frame, as the hot-path
+            # budget promises
+            self._hand(env, payload, retx)
+            return
+        cst = self._comm_stats(env.comm_id)
+        action = self._fault(env, payload)
+        if isinstance(action, tuple) and action and action[0] == "delay":
+            # chaos delay: the sender's thread pays it, like a link
+            # profile — backpressure-shaped latency, not reordering
+            import time as _t
+            self.stats["delayed"] += 1
+            cst["delayed"] += 1
+            _t.sleep(float(action[1]))
+            action = "deliver"
         if action == "drop":
             # fault events are rare by construction (injection/test-only
             # on this fabric): count them straight into the process-wide
@@ -191,6 +288,7 @@ class LocalFabric:
             METRICS.inc("fabric_dropped_total", fabric="local",
                         ctx=self.ctx_seq, comm_id=env.comm_id,
                         src=env.src, dst=env.dst)
+            self._track_lost(env, payload, retx)
             return
         if action == "corrupt_seq":
             self.stats["corrupted"] += 1
@@ -198,15 +296,56 @@ class LocalFabric:
             METRICS.inc("fabric_corrupted_total", fabric="local",
                         ctx=self.ctx_seq, comm_id=env.comm_id,
                         src=env.src, dst=env.dst)
+            # the ORIGINAL frame is what recovery must resend; the
+            # corrupted copy below is horizon-filtered at the receiver
+            self._track_lost(env, payload, retx)
             env = dataclasses.replace(env, seqn=env.seqn + 1_000_000)
-        fn(env, payload)
+        self._hand(env, payload, retx)
         if action == "duplicate":
             self.stats["duplicated"] += 1
             cst["duplicated"] += 1
             METRICS.inc("fabric_duplicated_total", fabric="local",
                         ctx=self.ctx_seq, comm_id=env.comm_id,
                         src=env.src, dst=env.dst)
-            fn(env, payload)
+            self._hand(env, payload, retx)
+
+    def _track_lost(self, env: Envelope, payload, retx: bool):
+        if retx or self.retx_window <= 0 or env.strm:
+            return  # a lost RESEND is already in the ring
+        ep = self._retx[env.src]
+        if ep is not None:
+            ep.track(env, payload)
+
+    def _hand(self, env: Envelope, payload, retx: bool = False):
+        """Receiver-side handoff: with retransmission armed, duplicates
+        and out-of-horizon (seqn-corrupted) frames are filtered BEFORE
+        the rx pool — the exact-seqn pool matching remains the second,
+        independent dedup line for the rare race of a delayed original
+        against its own retransmission. ACKs are emitted only when the
+        sender could be holding a ring entry: on a resend delivery, on a
+        duplicate, or when the receiver sees a GAP (out-of-order set
+        non-empty — the NACK that triggers fast retransmit of the hole);
+        clean in-order traffic pays no ack round-trip at all."""
+        rep = self._retx[env.dst] if self.retx_window > 0 else None
+        if rep is None or env.strm:
+            self._ingress[env.dst](env, payload)
+            return
+        deliver, cum, sel = rep.accept(env)
+        if not deliver:
+            if cum >= 0:
+                # duplicate: re-ack so the sender stops resending
+                self._peer_ack(env.src, env.dst, env.comm_id, cum, ())
+            return
+        if retx or sel:
+            # Ack BEFORE the handoff: accept() recorded the frame and
+            # the in-process ingress cannot fail (a full pool parks it
+            # on the device inbox), so "received" is already true here —
+            # while the handoff itself may run a deep ingest-inline
+            # chain for milliseconds under a storm. Acking after it
+            # would let delivered-but-unacked frames fill the sender
+            # windows and convoy senders through track() stalls.
+            self._peer_ack(env.src, env.dst, env.comm_id, cum, sel)
+        self._ingress[env.dst](env, payload)
 
     # fault keys are written straight into the registry at the fault site
     # (send() above) so they survive world teardown — the collector must
@@ -229,3 +368,8 @@ class LocalFabric:
                 yield ("counter", f"fabric_{k}_total",
                        {"fabric": "local", "ctx": self.ctx_seq,
                         "comm_id": comm_id}, v)
+        for ep in self._retx:
+            if ep is None:
+                continue
+            for kind, name, labels, v in ep.metrics_rows():
+                yield (kind, name, dict(labels, ctx=self.ctx_seq), v)

@@ -65,6 +65,31 @@ MSG_DATA = 102        # raw bytes
 # daemon <-> daemon (eth fabric)
 MSG_ETH = 50          # envelope + payload
 
+# Envelope ``strm`` codes beyond the reference's 0/1 (0 = pool-destined
+# data, nonzero = peer stream port): control frames of the reliability
+# layer. They never reach the rx pool or the stream ports — the fabric /
+# daemon ingress routes them before delivery; implementations that
+# predate them (or the native daemon) must IGNORE strm >= 2 rather than
+# stream-deliver garbage.
+ACK_STRM = 2          # retransmission acknowledgement (pack_ack payload)
+HB_STRM = 3           # membership heartbeat (empty payload)
+
+
+# -- retransmission ACK (rides an eth frame with strm=ACK_STRM) -------------
+# cumulative frontier u32 (also mirrored in the envelope seqn), selective
+# count u16, then the out-of-order received seqns u32 each. comm_id rides
+# the envelope.
+def pack_ack(cum: int, sel=()) -> bytes:
+    out = [struct.pack("<IH", cum, len(sel))]
+    out.extend(struct.pack("<I", s) for s in sel)
+    return b"".join(out)
+
+
+def unpack_ack(payload: bytes) -> tuple[int, tuple]:
+    cum, n = struct.unpack("<IH", payload[:6])
+    sel = struct.unpack(f"<{n}I", payload[6:6 + 4 * n])
+    return cum, sel
+
 DTYPE_CODES = {
     "float32": 0, "float64": 1, "int32": 2, "int64": 3,
     "float16": 4, "bfloat16": 5, "int8": 6, "uint8": 7,

@@ -1,0 +1,548 @@
+"""Selective retransmission for the emulator-tier fabrics.
+
+The reference leans on the FPGA TCP stack for reliability and simply
+latches an error word when anything goes wrong (RECEIVE_TIMEOUT_ERROR on a
+burned recv deadline, SURVEY §5); once the engine is a shared *service*
+(ACCL+, PAPERS.md) a single lost frame must not kill a whole collective.
+This module makes frame loss recoverable UNDER the call:
+
+* The sender keeps an in-flight ring per ``(dst, comm_id)`` channel —
+  zero-copy references to the frames it emitted (the LocalFabric contract
+  already forbids rewriting an emitted payload; the UDP fabric snapshots,
+  see :meth:`RetxEndpoint.track`) — bounded by ``$ACCL_TPU_RETX_WINDOW``
+  frames, and retransmits unacknowledged frames on RTO with exponential
+  backoff + seeded jitter.
+* The receiver tracks, per ``(src, comm_id)`` channel, the cumulative
+  frontier plus the out-of-order set, drops duplicates (a retransmitted
+  frame that raced its ACK) and out-of-horizon garbage (seqn-corrupted
+  frames) before they can pollute the rx pool, and acknowledges
+  cumulative+selective state back to the sender.
+* A single process-wide reaper thread drives every live endpoint's RTO
+  scan through weak references — worlds come and go by the thousands in a
+  test session, and a timer thread per fabric would accumulate.
+
+The envelope's existing ``(src, comm_id, seqn)`` identity IS the
+retransmission key: per directed channel the seqn stream is dense and
+monotone (``Rank.outbound_seq``), so cumulative acknowledgement needs no
+new wire field. Exact-seqn pool matching upstream provides a second,
+independent dedup line.
+
+What this layer does NOT cover: pool backpressure. A frame that reached
+the receiving endpoint but was then dropped for want of an rx buffer is a
+*resource* failure with its own typed error word (overflow / tenant
+quota), acknowledged like any delivery — retransmitting it would just melt
+the same full pool. The exception is the UDP deliver-queue: with
+retransmission armed a queue-full drop is simply NOT acknowledged, so the
+RTO recovers it (the queue drains in milliseconds); with
+``$ACCL_TPU_RETX_WINDOW=0`` the drop latches
+``ErrorCode.FABRIC_QUEUE_OVERFLOW`` at drop time instead (the
+pre-retransmit behavior, surfaced as itself rather than as a generic
+timeout).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+
+from ..constants import (DEFAULT_RETX_MAX_TRIES, DEFAULT_RETX_RTO_MAX_S,
+                         DEFAULT_RETX_RTO_S, DEFAULT_RETX_WINDOW, ErrorCode)
+from ..log import get_logger
+from ..tracing import METRICS, TRACE as _TRACE
+
+log = get_logger(__name__)
+
+# Seqn-corruption horizon: a frame whose seqn is this far beyond the
+# channel's cumulative frontier cannot be legitimate in-flight traffic
+# (the window is orders of magnitude smaller) — treat it as corrupt and
+# drop it unacknowledged, so the RTO resends the original instead of the
+# garbage occupying an rx buffer until some recv burns its deadline.
+SEQN_HORIZON = 1 << 18
+
+# RTT histogram sampling: observing every acked frame into the
+# process-wide registry is a lock round-trip per frame on the hot path
+# (the same cost class the per-call driver counters avoid) — sample.
+_RTT_SAMPLE = 32
+
+# Adaptive-RTO floor: the emulator's ack RTT is microseconds (delivery is
+# a function call / a localhost datagram), so Jacobson's srtt + 4*rttvar
+# alone would retransmit on any GIL scheduling hiccup; 5 ms is ~50x the
+# typical emu RTT and still 10x faster recovery than the static base.
+RTO_MIN_S = 0.005
+
+
+def retx_window_from_env() -> int:
+    """Window in frames; 0 disables retransmission (read at fabric
+    construction time, like the executor's env knobs)."""
+    return max(0, int(os.environ.get("ACCL_TPU_RETX_WINDOW",
+                                     DEFAULT_RETX_WINDOW)))
+
+
+def _mix(*parts: int) -> int:
+    """Deterministic 64-bit mix of the frame identity — the chaos plan
+    and the retransmit jitter both need decisions that are reproducible
+    from a seed regardless of thread interleaving, which a shared
+    stateful RNG cannot give."""
+    h = 0x9E3779B97F4A7C15
+    for p in parts:
+        h ^= (p & 0xFFFFFFFFFFFFFFFF) + 0x9E3779B97F4A7C15 + (h << 6) + (h >> 2)
+        h &= 0xFFFFFFFFFFFFFFFF
+        h = (h ^ (h >> 30)) * 0xBF58476D1CE4E5B9 & 0xFFFFFFFFFFFFFFFF
+        h = (h ^ (h >> 27)) * 0x94D049BB133111EB & 0xFFFFFFFFFFFFFFFF
+        h ^= h >> 31
+    return h
+
+
+def mix_unit(*parts: int) -> float:
+    """Deterministic uniform in [0, 1) from the mixed identity."""
+    return _mix(*parts) / float(1 << 64)
+
+
+class _Flight:
+    """One unacknowledged frame."""
+
+    __slots__ = ("env", "payload", "deadline", "tries", "t0", "fast")
+
+    def __init__(self, env, payload, deadline, t0):
+        self.env = env
+        self.payload = payload
+        self.deadline = deadline
+        self.tries = 0
+        self.t0 = t0
+        self.fast = False   # consumed its one NACK fast-retransmit
+
+
+class RetxEndpoint:
+    """Sender ring + receiver tracker for ONE fabric endpoint (one rank).
+
+    ``resend_fn(env, payload)`` re-emits a frame onto the raw wire (it
+    passes the fault hook again, so an injected-loss schedule applies to
+    retransmissions too); ``ack_fn(dst_grank, comm_id, cum, sel)``
+    carries acknowledgement state toward a data sender (a direct peer
+    call on the in-process fabric, an ACK control frame on the UDP
+    stack). ``latch_fn(comm_id, err)``, when wired, latches a typed
+    per-comm error on give-up (the sender-side PEER_FAILED path).
+    """
+
+    def __init__(self, rank: int, resend_fn, ack_fn, *,
+                 window: int | None = None, latch_fn=None,
+                 fabric: str = "local", copy_payloads: bool = False,
+                 rto_s: float = DEFAULT_RETX_RTO_S,
+                 rto_max_s: float = DEFAULT_RETX_RTO_MAX_S,
+                 max_tries: int = DEFAULT_RETX_MAX_TRIES):
+        self.rank = rank
+        self.window = retx_window_from_env() if window is None else window
+        self._resend = resend_fn
+        self._ack = ack_fn
+        self._latch = latch_fn
+        self.fabric = fabric
+        self.copy_payloads = copy_payloads
+        self.rto_s = rto_s
+        self.rto_max_s = rto_max_s
+        self.max_tries = max_tries
+        self._mu = threading.Lock()
+        self._space = threading.Condition(self._mu)
+        # sender: (dst_grank, comm_id) -> {seqn: _Flight}
+        self._ring: dict[tuple[int, int], dict[int, _Flight]] = {}
+        self._inflight = 0
+        # receiver: (src_grank, comm_id) -> [cum_next, out_of_order_set]
+        self._rcv: dict[tuple[int, int], list] = {}
+        self.stats = {"tracked": 0, "retransmits": 0, "rto_fires": 0,
+                      "fast_retransmits": 0, "acked": 0,
+                      "dedup_dropped": 0, "horizon_dropped": 0,
+                      "gave_up": 0, "window_stalls": 0}
+        self._rtt_n = 0
+        # adaptive RTO (Jacobson): smoothed rtt + variance from clean
+        # (never-retransmitted) acks; the static rto_s stands in until
+        # the first measurement
+        self._srtt: float | None = None
+        self._rttvar = 0.0
+        if self.window > 0:
+            _reaper().register(self)
+
+    # -- sender side -------------------------------------------------------
+    def track(self, env, payload):
+        """Record an outgoing data frame in the in-flight ring. Blocks
+        (bounded) while the channel's window is full — the retransmission
+        analog of the fabric's natural backpressure; on stall-timeout the
+        frame is tracked anyway (a soft cap: wedging the sender forever
+        on a dead peer is the membership layer's job to diagnose, not
+        this one's to cause)."""
+        if self.window <= 0 or env.strm:
+            return
+        if self.copy_payloads:
+            # socket fabrics serialize before send() returns and reuse
+            # the caller's scratch — the ring must own its bytes there.
+            # The in-process fabric retains payload objects in the rx
+            # pool already (senders must not rewrite), so a reference is
+            # a zero-copy view with the same contract.
+            payload = bytes(payload)
+        now = time.monotonic()
+        key = (env.dst, env.comm_id)
+        with self._mu:
+            chan = self._ring.get(key)
+            if chan is None:
+                chan = self._ring[key] = {}
+            if len(chan) >= self.window:
+                self.stats["window_stalls"] += 1
+                deadline = now + self.rto_max_s * 4
+                while len(chan) >= self.window:
+                    if not self._space.wait(deadline - time.monotonic()) \
+                            or time.monotonic() >= deadline:
+                        break
+            # first deadline takes the plain adaptive RTO (jitter costs
+            # a Python hash mix per frame — worth it only for RETRANSMIT
+            # scheduling, where synchronized bursts are the failure mode)
+            chan[env.seqn] = _Flight(env, payload, now + self._cur_rto(),
+                                     now)
+            self._inflight += 1
+            self.stats["tracked"] += 1
+
+    def _cur_rto(self) -> float:
+        """Adaptive base RTO: srtt + 4*rttvar clamped to
+        [RTO_MIN_S, rto_max_s]; the configured ``rto_s`` until the
+        first clean ack measures the link."""
+        if self._srtt is None:
+            return self.rto_s
+        return min(max(self._srtt + 4.0 * self._rttvar, RTO_MIN_S),
+                   self.rto_max_s)
+
+    def _rto(self, env, tries: int) -> float:
+        """Exponential backoff from the adaptive base with deterministic
+        per-frame jitter (±25%, keyed on the frame identity so
+        concurrent channels don't synchronize their retransmit
+        bursts)."""
+        base = min(self._cur_rto() * (2 ** tries), self.rto_max_s)
+        return base * (0.75 + 0.5 * mix_unit(env.dst, env.comm_id,
+                                             env.seqn, tries))
+
+    def on_ack(self, src_grank: int, comm_id: int, cum: int,
+               sel=()) -> None:
+        """Acknowledgement from ``src_grank``: every seqn < ``cum`` plus
+        each selectively-listed seqn has arrived — drop them from the
+        ring. A non-empty selective list is also a NACK: every still-
+        in-flight seqn BELOW its highest entry was overtaken by later
+        traffic — the receiver has a hole — so it fast-retransmits once,
+        immediately, instead of stalling a full RTO (TCP dup-ack
+        analog; subsequent losses of the same frame fall back to the
+        RTO/backoff schedule)."""
+        key = (src_grank, comm_id)
+        freed = 0
+        fast: list[_Flight] = []
+        with self._mu:
+            chan = self._ring.get(key)
+            if not chan:
+                return
+            for seqn in [s for s in chan if s < cum]:
+                fl = chan.pop(seqn)
+                freed += 1
+                self._note_rtt(fl)
+            for seqn in sel:
+                fl = chan.pop(seqn, None)
+                if fl is not None:
+                    freed += 1
+                    self._note_rtt(fl)
+            if sel and chan:
+                gap_hi = max(sel)
+                now = time.monotonic()
+                for seqn, fl in chan.items():
+                    if seqn < gap_hi and not fl.fast:
+                        fl.fast = True
+                        fl.tries += 1
+                        fl.deadline = now + self._rto(fl.env, fl.tries)
+                        fast.append(fl)
+            if freed:
+                self._inflight -= freed
+                self.stats["acked"] += freed
+                self._space.notify_all()
+            if not chan:
+                del self._ring[key]
+        for fl in fast:
+            self.stats["retransmits"] += 1
+            self.stats["fast_retransmits"] = \
+                self.stats.get("fast_retransmits", 0) + 1
+            METRICS.inc("fabric_retransmits_total", fabric=self.fabric,
+                        comm_id=fl.env.comm_id, src=fl.env.src,
+                        dst=fl.env.dst)
+            if _TRACE.enabled:
+                _TRACE.emit("retransmit", rank=self.rank,
+                            seqn=fl.env.seqn, peer=fl.env.dst,
+                            nbytes=fl.env.nbytes)
+            try:
+                self._resend(fl.env, fl.payload)
+            except Exception:  # noqa: BLE001 — RTO still covers it
+                log.error("rank %s retx: fast resend to %s failed",
+                          self.rank, fl.env.dst, exc_info=True,
+                          extra={"rank": self.rank})
+
+    def _note_rtt(self, fl: _Flight):
+        """Caller holds ``self._mu``. Clean (never-retransmitted) frames
+        feed the adaptive RTO (Jacobson EWMA) and sample into the rtt
+        histogram — retransmitted frames' ack time measures the RTO
+        schedule, not the wire (Karn's rule)."""
+        if fl.tries:
+            return
+        rtt = time.monotonic() - fl.t0
+        if self._srtt is None:
+            self._srtt = rtt
+            self._rttvar = rtt / 2.0
+        else:
+            self._rttvar += 0.25 * (abs(self._srtt - rtt) - self._rttvar)
+            self._srtt += 0.125 * (rtt - self._srtt)
+        self._rtt_n += 1
+        if self._rtt_n % _RTT_SAMPLE == 0:
+            METRICS.observe("fabric_rtt_us", rtt * 1e6,
+                            fabric=self.fabric)
+
+    # -- receiver side -----------------------------------------------------
+    def accept(self, env) -> tuple[bool, int, tuple]:
+        """Fused dedup-check + record under ONE lock, for transports
+        whose delivery cannot fail once accepted (the in-process fabric:
+        ingest enqueues at worst). Returns (deliver?, cum, sel) — the
+        caller delivers when True and then acks with the returned state
+        (outside the lock; the UDP path keeps the split
+        :meth:`fresh`/:meth:`record` because its deliver-queue can still
+        drop after the check)."""
+        key = (env.src, env.comm_id)
+        with self._mu:
+            st = self._rcv.get(key)
+            if st is None:
+                st = self._rcv[key] = [0, set()]
+            seqn = env.seqn
+            cum = st[0]
+            if seqn >= cum + SEQN_HORIZON:
+                self.stats["horizon_dropped"] += 1
+                return (False, -1, ())
+            if seqn < cum or seqn in st[1]:
+                self.stats["dedup_dropped"] += 1
+                return (False, cum, ())
+            if seqn == cum:
+                cum += 1
+                while cum in st[1]:
+                    st[1].discard(cum)
+                    cum += 1
+                st[0] = cum
+            else:
+                st[1].add(seqn)
+            return (True, st[0], tuple(st[1]) if st[1] else ())
+
+    def fresh(self, env) -> bool:
+        """Would this inbound data frame be NEW to the receiver tracker?
+        False = duplicate (re-acked so the sender stops resending) or
+        out-of-horizon garbage (dropped unacknowledged so the RTO
+        recovers the original). Does NOT record — callers that may still
+        drop the frame (UDP deliver-queue full) call :meth:`record` only
+        once delivery actually succeeded."""
+        if self.window <= 0 or env.strm:
+            return True
+        key = (env.src, env.comm_id)
+        ack_cum = None
+        with self._mu:
+            st = self._rcv.get(key)
+            if st is None:
+                st = self._rcv[key] = [0, set()]
+            if env.seqn >= st[0] + SEQN_HORIZON:
+                self.stats["horizon_dropped"] += 1
+                return False
+            if env.seqn < st[0] or env.seqn in st[1]:
+                self.stats["dedup_dropped"] += 1
+                ack_cum = st[0]
+        if ack_cum is not None:
+            # re-ack: the original ack may have been lost/raced — without
+            # this the sender retransmits to the give-up bound
+            self._ack(env.src, env.comm_id, ack_cum, ())
+            return False
+        return True
+
+    def record(self, env) -> None:
+        """The frame was delivered: advance the channel frontier and
+        acknowledge (cumulative + the out-of-order set as the selective
+        list)."""
+        if self.window <= 0 or env.strm:
+            return
+        key = (env.src, env.comm_id)
+        with self._mu:
+            st = self._rcv.get(key)
+            if st is None:
+                st = self._rcv[key] = [0, set()]
+            if env.seqn == st[0]:
+                st[0] += 1
+                while st[0] in st[1]:
+                    st[1].discard(st[0])
+                    st[0] += 1
+            elif env.seqn > st[0]:
+                st[1].add(env.seqn)
+            cum, sel = st[0], tuple(st[1])
+        self._ack(env.src, env.comm_id, cum, sel)
+
+    # -- maintenance -------------------------------------------------------
+    def tick(self, now: float) -> int:
+        """RTO scan (reaper thread): retransmit every expired in-flight
+        frame; give up past ``max_tries`` with a typed PEER_FAILED latch.
+        Returns the number of frames still in flight."""
+        if not self._inflight:
+            # unsynchronized fast path (GIL-atomic int read): sessions
+            # accumulate thousands of idle endpoints across torn-down
+            # worlds, and the reaper must not pay a lock round-trip per
+            # endpoint per tick for them. A racing track() is caught on
+            # the next tick — 10 ms of added worst-case RTO latency.
+            return 0
+        expired = []
+        gave_up = []
+        with self._mu:
+            if not self._inflight:
+                return 0
+            for key, chan in list(self._ring.items()):
+                for seqn, fl in list(chan.items()):
+                    if fl.deadline > now:
+                        continue
+                    if fl.tries >= self.max_tries:
+                        del chan[seqn]
+                        self._inflight -= 1
+                        gave_up.append(fl)
+                        continue
+                    fl.tries += 1
+                    fl.deadline = now + self._rto(fl.env, fl.tries)
+                    expired.append(fl)
+                if not chan:
+                    del self._ring[key]
+            if gave_up:
+                self._space.notify_all()
+            inflight = self._inflight
+        for fl in expired:
+            self.stats["retransmits"] += 1
+            self.stats["rto_fires"] += 1
+            METRICS.inc("fabric_retransmits_total", fabric=self.fabric,
+                        comm_id=fl.env.comm_id, src=fl.env.src,
+                        dst=fl.env.dst)
+            METRICS.inc("retx_rto_total", fabric=self.fabric,
+                        src=fl.env.src, dst=fl.env.dst)
+            if _TRACE.enabled:
+                _TRACE.emit("retransmit", rank=self.rank, seqn=fl.env.seqn,
+                            peer=fl.env.dst, nbytes=fl.env.nbytes)
+            try:
+                self._resend(fl.env, fl.payload)
+            except Exception:  # noqa: BLE001 — a resend failure must not
+                # kill the shared reaper; the frame stays scheduled
+                log.error("rank %s retx: resend to %s failed", self.rank,
+                          fl.env.dst, exc_info=True,
+                          extra={"rank": self.rank})
+        for fl in gave_up:
+            self.stats["gave_up"] += 1
+            METRICS.inc("retx_gave_up_total", fabric=self.fabric,
+                        comm_id=fl.env.comm_id, src=fl.env.src,
+                        dst=fl.env.dst)
+            log.warning(
+                "rank %s retx: giving up on seqn %d to rank %d (comm %d) "
+                "after %d tries — latching PEER_FAILED", self.rank,
+                fl.env.seqn, fl.env.dst, fl.env.comm_id, fl.tries,
+                extra={"rank": self.rank})
+            if self._latch is not None:
+                self._latch(fl.env.comm_id, int(ErrorCode.PEER_FAILED))
+        return inflight
+
+    def reset(self):
+        """Drop ALL channel state (both roles) — the endpoint's seqn
+        spaces are restarting (soft reset)."""
+        with self._mu:
+            self._ring.clear()
+            self._rcv.clear()
+            self._inflight = 0
+            self._space.notify_all()
+
+    def reset_comm(self, comm_id: int):
+        """Drop state for one communicator (its membership — and with it
+        the per-peer seqn spaces — was reconfigured)."""
+        with self._mu:
+            for key in [k for k in self._ring if k[1] == comm_id]:
+                self._inflight -= len(self._ring.pop(key))
+            for key in [k for k in self._rcv if k[1] == comm_id]:
+                del self._rcv[key]
+            self._space.notify_all()
+
+    def reset_peer(self, grank: int):
+        """Drop state touching one peer (its rank soft-reset: both its
+        inbound expectations toward us and our ring toward it restart)."""
+        with self._mu:
+            for key in [k for k in self._ring if k[0] == grank]:
+                self._inflight -= len(self._ring.pop(key))
+            for key in [k for k in self._rcv if k[0] == grank]:
+                del self._rcv[key]
+            self._space.notify_all()
+
+    def metrics_rows(self):
+        for k, v in self.stats.items():
+            yield ("counter", f"retx_{k}_total",
+                   {"fabric": self.fabric, "rank": self.rank}, v)
+
+
+class _Reaper:
+    """One process-wide daemon thread scanning every live endpoint's RTO
+    ring through weakrefs. Worlds are created by the thousands per test
+    session; per-fabric timer threads would accumulate (fabrics have no
+    reliable close point in the in-process tier), so the reaper follows
+    the registry-collector pattern: weak registration, dead endpoints
+    vanish, one thread total."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._endpoints: "weakref.WeakSet[RetxEndpoint]" = weakref.WeakSet()
+        self._thread: threading.Thread | None = None
+
+    def register(self, ep: RetxEndpoint):
+        with self._mu:
+            self._endpoints.add(ep)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="retx-reaper")
+                self._thread.start()
+
+    def _loop(self):
+        # Scan granularity bounds RTO-path recovery latency: the busy
+        # cadence (frames known in flight) tracks the RTO floor, the
+        # armed cadence (endpoints exist, rings empty — a loss could
+        # strand a frame any moment) bounds the detection tail, and the
+        # bare cadence (no live endpoints) is a near-free idle tick.
+        busy_sleep = RTO_MIN_S / 2
+        armed_sleep = 0.02
+        bare_sleep = 0.25
+        while True:
+            now = time.monotonic()
+            inflight = 0
+            with self._mu:
+                eps = list(self._endpoints)
+            for ep in eps:
+                try:
+                    inflight += ep.tick(now)
+                except Exception:  # noqa: BLE001 — one endpoint's bug
+                    # must not starve every other endpoint's RTO
+                    log.error("retx reaper: endpoint tick failed",
+                              exc_info=True)
+            time.sleep(busy_sleep if inflight
+                       else (armed_sleep if eps else bare_sleep))
+
+
+_REAPER: _Reaper | None = None
+_REAPER_MU = threading.Lock()
+
+
+def _reaper() -> _Reaper:
+    global _REAPER
+    with _REAPER_MU:
+        if _REAPER is None:
+            _REAPER = _Reaper()
+        return _REAPER
+
+
+def _drop_reaper_after_fork():
+    """A forked child inherits the singleton OBJECT but not its thread —
+    endpoints registered there would never get an RTO scan. Reset so the
+    child's first endpoint registration starts a fresh thread."""
+    global _REAPER
+    _REAPER = None
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_drop_reaper_after_fork)

@@ -1,0 +1,102 @@
+"""Call-level retry policies — the driver's second line of defense.
+
+The reliability layer (emulator/reliability.py) recovers individual lost
+frames UNDER a call; this module re-executes whole calls when a failure
+still surfaces (retransmission disabled or exhausted, pool overflow
+storms, chaos schedules past the give-up bound). A retried call is an
+epoch-scoped idempotent re-execution: the streamed executor advances
+every per-peer seqn counter to its final value when an attempt is
+ADMITTED — aborted or not — so attempt N+1's frames live in a fresh seqn
+range that stale attempt-N traffic can never satisfy; the compiled-plan
+cache makes re-expansion free; and the device's ``prepare_retry`` hook
+purges the dead attempt's stranded frames from the rx pool.
+
+The contract mirrors collectives themselves: retry policies must be
+UNIFORM across the ranks of a communicator (a lost frame eventually fails
+every rank of the collective — each one's timeout fires, each one
+retries, and the fresh seqn epochs line up because every rank advanced
+its counters by the same per-attempt totals). Hierarchical programs issue
+each phase as an ordinary driver call, so a driver-level policy retries
+exactly the failed phase, never the already-completed ones.
+
+``CALL_OUTCOME_UNKNOWN`` is deliberately NOT retryable by default: it
+means a daemon's bounded status maps aged out before the outcome was
+read — the call may have SUCCEEDED, and blind re-execution of a
+non-idempotent program (reductions into the destination of a compressed
+in-place call, stream-port consumers) on top of a completed one is the
+exact corruption class the code exists to name. ``retry_unknown=True``
+opts in for calls the caller knows are idempotent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .constants import ErrorCode
+from .emulator.reliability import mix_unit
+
+# What a policy retries by default: failures whose cause is plausibly
+# transient wire/backpressure state. PEER_FAILED is excluded (a dead
+# peer does not come back because we ask again — shrink instead), as is
+# CALL_OUTCOME_UNKNOWN (see module docstring).
+DEFAULT_RETRYABLE = (int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+                     | int(ErrorCode.FABRIC_QUEUE_OVERFLOW)
+                     | int(ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
+                     | int(ErrorCode.PACK_TIMEOUT_STS_ERROR))
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Immutable retry policy, shareable across calls and ranks.
+
+    ``retries`` is the number of RE-executions (0 = never retry);
+    backoff is exponential from ``backoff_s`` with deterministic jitter
+    (seeded per (comm, attempt) — every rank of a communicator computes
+    the SAME backoff, so retry epochs stay roughly aligned instead of
+    thundering at randomized offsets)."""
+
+    retries: int = 0
+    backoff_s: float = 0.05
+    backoff_mult: float = 2.0
+    backoff_max_s: float = 2.0
+    jitter: float = 0.25           # +/- fraction of the computed backoff
+    retryable: int = DEFAULT_RETRYABLE
+    retry_unknown: bool = False    # opt-in for CALL_OUTCOME_UNKNOWN
+
+    def should_retry(self, error_word: int, attempt: int) -> bool:
+        """``attempt`` is 0-based (the attempt that just failed)."""
+        if attempt >= self.retries:
+            return False
+        word = int(error_word)
+        if word & int(ErrorCode.CALL_OUTCOME_UNKNOWN) \
+                and not self.retry_unknown:
+            # unsafe to blind-retry: the call may have SUCCEEDED (see
+            # module docstring and docs/ARCHITECTURE.md "Failure model")
+            return False
+        if word & int(ErrorCode.PEER_FAILED):
+            return False
+        mask = self.retryable | (int(ErrorCode.CALL_OUTCOME_UNKNOWN)
+                                 if self.retry_unknown else 0)
+        return bool(word & mask)
+
+    def backoff(self, attempt: int, comm_id: int = 0) -> float:
+        """Delay before re-executing attempt ``attempt + 1``."""
+        base = min(self.backoff_s * (self.backoff_mult ** attempt),
+                   self.backoff_max_s)
+        if not self.jitter:
+            return base
+        u = mix_unit(comm_id, attempt, 0x52E7)  # same on every rank
+        return base * (1.0 - self.jitter + 2.0 * self.jitter * u)
+
+
+def resolve_policy(retries, retry_policy,
+                   default: "RetryPolicy | None") -> "RetryPolicy | None":
+    """The precedence rule every call site shares: an explicit
+    ``retry_policy=`` wins, a bare ``retries=N`` wraps the driver default
+    (or a fresh policy) with that count, else the driver default."""
+    if retry_policy is not None:
+        return retry_policy
+    if retries is not None:
+        base = default if default is not None else RetryPolicy()
+        return dataclasses.replace(base, retries=int(retries))
+    return default

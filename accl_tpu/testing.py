@@ -26,7 +26,9 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
               plan_cache: bool | None = None,
               service=None, tenant: str | None = None,
               hosts=None, inter_alpha_us: float | None = None,
-              inter_beta_gbps: float | None = None) -> list[ACCL]:
+              inter_beta_gbps: float | None = None,
+              retx_window: int | None = None,
+              retry_policy=None) -> list[ACCL]:
     """Create ``world_size`` ACCL instances sharing an in-process fabric.
 
     ``tuner`` (a single :class:`~accl_tpu.tuner.Tuner`) is shared by every
@@ -50,7 +52,8 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
           "segment_stream": segment_stream, "plan_cache": plan_cache,
           "service": service, "hosts": hosts,
           "inter_alpha_us": inter_alpha_us,
-          "inter_beta_gbps": inter_beta_gbps}
+          "inter_beta_gbps": inter_beta_gbps,
+          "retx_window": retx_window}
     if bufsize is not None:
         kw["bufsize"] = bufsize
     ctx = EmuContext(world_size, **kw)
@@ -60,7 +63,7 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
             ranks=[Rank() for _ in range(world_size)], local_rank=r)
         accls.append(ACCL(ctx.device(r), comm, timeout=timeout,
                           max_segment_size=max_segment_size, tuner=tuner,
-                          tenant=tenant))
+                          tenant=tenant, retry_policy=retry_policy))
     return accls
 
 

@@ -1,0 +1,129 @@
+"""Goodput-under-loss ladder: the reliability layer's regression gate.
+
+Runs the same allreduce stream twice through one emu world — a clean leg,
+then a seeded-chaos leg (1% frame drop + corrupt + duplicate schedules,
+reproducible from the plan seed / $ACCL_TPU_CHAOS_SEED) — and reports the
+goodput ratio. The chaos leg must (a) complete every call bit-identically
+to the clean leg's result (which a zero-fault differential already pins
+to the serial oracle elsewhere — tests/test_fault_injection.py), (b)
+actually retransmit (``fabric_retransmits_total > 0``: a schedule that
+never fired gates nothing), and (c) surface ZERO call errors — under
+retransmission a lossy wire costs goodput, never correctness.
+
+``headline()`` feeds bench.py's emulator-tier metric; ``make bench-emu``
+gates ``chaos_goodput_ratio >= $ACCL_BENCH_MIN_CHAOS_GOODPUT`` with the
+existing best-of-three retry convention. The floor is deliberately
+modest: at 1% loss each dropped frame costs ~one RTO (50 ms base) and
+the 2-core shared host adds scheduler noise on top — the gate guards
+against recovery REGRESSIONS (goodput collapse, retransmit storms,
+lost-wakeup stalls), not against the physics of lossy links.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from accl_tpu.chaos import FaultPlan, FaultRule
+from accl_tpu.testing import emu_world, run_ranks
+from accl_tpu.tracing import METRICS
+
+WORLD = 4
+LOSS = 0.01
+
+
+def _snapshot_total(name: str) -> float:
+    snap = METRICS.snapshot()
+    return float(sum(snap["counters"].get(name, {}).values()))
+
+
+def _leg(accls, count: int, iters: int, golden) -> float:
+    """One measured leg: per-rank wall clock over ``iters`` allreduces,
+    result checked against ``golden`` (bit-identity)."""
+    bufs = [(a.buffer(data=np.full(count, float(a.rank + 1), np.float32)),
+             a.buffer((count,), np.float32)) for a in accls]
+
+    def body(a):
+        src, dst = bufs[a.rank]
+        a.allreduce(src, dst, count)  # warm (plan cache)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            a.allreduce(src, dst, count)
+        return time.perf_counter() - t0
+
+    times = run_ranks(accls, body, timeout=600.0)
+    for _, dst in bufs:
+        if not (dst.data == golden).all():
+            raise AssertionError("chaos leg diverged from the clean "
+                                 "result — recovery corrupted data")
+    return float(np.median(times))
+
+
+def headline(nbytes: int = 1 << 20, iters: int = 8) -> dict:
+    count = nbytes // 4
+    accls = emu_world(WORLD, nbufs=64, bufsize=128 << 10, timeout=60.0)
+    fabric = accls[0].device.ctx.fabric
+    if fabric.retx_window <= 0:
+        for a in accls:
+            a.deinit()
+        raise AssertionError(
+            "chaos ladder needs retransmission armed "
+            "($ACCL_TPU_RETX_WINDOW > 0)")
+    golden = np.full(count, WORLD * (WORLD + 1) / 2, np.float32)
+    retx_before = _snapshot_total("fabric_retransmits_total")
+    err_before = _snapshot_total("accl_call_errors_total")
+    # injected-fault accounting: bench.py's clean-fabric gate subtracts
+    # what THIS ladder deliberately injected from the process totals
+    fault_fams = ("fabric_dropped_total", "fabric_corrupted_total",
+                  "fabric_duplicated_total")
+    faults_before = {f: _snapshot_total(f) for f in fault_fams}
+    try:
+        clean_s = _leg(accls, count, iters, golden)
+        plan = FaultPlan([
+            FaultRule(kind="drop", prob=LOSS),
+            FaultRule(kind="corrupt", prob=LOSS / 4),
+            FaultRule(kind="duplicate", prob=LOSS / 4),
+        ], seed=20260804)
+        fabric.inject_fault(plan)
+        chaos_s = _leg(accls, count, iters, golden)
+        fabric.clear_fault()
+    finally:
+        for a in accls:
+            a.deinit()
+    retransmits = _snapshot_total("fabric_retransmits_total") - retx_before
+    call_errors = _snapshot_total("accl_call_errors_total") - err_before
+    # NO raises past this point: the bench contract is one JSON line no
+    # matter what — a dead schedule / missing retransmits / surfaced
+    # call errors are reported IN the line and failed by bench.py's
+    # check_chaos_goodput gate (which also gets its best-of-three retry
+    # that way; raising here would crash the whole headline instead)
+    ratio = clean_s / chaos_s if chaos_s > 0 else 0.0
+    return {
+        "metric": f"emu_chaos_goodput_{nbytes >> 20}MiB_{WORLD}rank_"
+                  f"loss{LOSS}",
+        "value": round(ratio, 3),
+        "unit": "x",
+        "chaos_goodput_ratio": round(ratio, 3),
+        "chaos_clean_us": round(clean_s * 1e6, 1),
+        "chaos_lossy_us": round(chaos_s * 1e6, 1),
+        "chaos_retransmits": int(retransmits),
+        "chaos_faults_applied": {k: v for k, v in plan.applied.items()
+                                 if v},
+        "chaos_injected": {f: int(_snapshot_total(f) - faults_before[f])
+                           for f in fault_fams},
+        "chaos_call_errors": int(call_errors),
+        "nbytes": nbytes,
+        "world": WORLD,
+        "loss": LOSS,
+        "tier": "emu",
+    }
+
+
+def main():
+    print(json.dumps(headline()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

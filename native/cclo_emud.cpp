@@ -961,6 +961,10 @@ class RankDaemon {
   }
 
   void ingest(const Envelope& env, std::vector<uint8_t>&& payload) {
+    if (env.strm >= 2) return;  // reliability-layer control frames
+    // (retransmission ACK strm=2, heartbeat strm=3, emulator/protocol.py):
+    // the native daemon implements neither — ignore them rather than
+    // stream-deliver garbage into the kernel ports
     if (env.strm) {
       std::lock_guard<std::mutex> lk(stream_mu_);
       stream_in_.push_back({env, std::move(payload)});

@@ -58,3 +58,10 @@ def dense_attention(q, k, v, causal):
         s = jnp.where(mask, s, jnp.finfo(jnp.float32).min)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak/chaos tests (tier-1 deselects them "
+        "with -m 'not slow'; run explicitly or via the full corpus)")

@@ -154,8 +154,10 @@ def test_midwindow_fault_latches_and_aborts():
 def test_wire_fault_mid_window_aborts_program():
     """LocalFabric fault injection: dropping one phase-2 relay of a ring
     allreduce starves the downstream recv — the error aborts the program
-    and surfaces as RECEIVE_TIMEOUT on the caller."""
-    accls = emu_world(3, timeout=0.6)
+    and surfaces as RECEIVE_TIMEOUT on the caller. Retransmission is
+    disabled: this pins the DETECTION path (the reliability layer's
+    recovery of the same drop is tests/test_fault_injection.py)."""
+    accls = emu_world(3, timeout=0.6, retx_window=0)
     fabric = accls[0].device.ctx.fabric
     dropped = []
 

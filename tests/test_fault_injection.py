@@ -1,17 +1,32 @@
-"""Fault injection + recovery tests (extension beyond the reference).
+"""Fault injection, recovery, retry and failure-containment tests.
 
 The reference has NO fault injection (SURVEY §5 — its timeout test merely
-provokes a receive timeout). The emulator fabric here can drop, duplicate,
-or seqn-corrupt messages, proving:
-  * detection: lost/corrupted messages surface as RECEIVE_TIMEOUT_ERROR,
-    duplicates are quarantined by exact-seqn matching (never double-matched),
-  * recovery: soft_reset on every rank restores a working world.
+provokes a receive timeout). Three layers are proven here:
+
+* **Detection** (``retx_window=0``, the pre-retransmit fallback): lost /
+  seqn-corrupted messages surface as RECEIVE_TIMEOUT_ERROR, duplicates
+  are quarantined by exact-seqn pool matching, and ``soft_reset``
+  restores a working world — the original failure-surfacing contract.
+* **Recovery** (default): the reliability layer
+  (emulator/reliability.py) makes every seeded :class:`FaultPlan`
+  schedule — drop / corrupt / duplicate / delay, across ring / RD /
+  hierarchical allreduce and W in {3,4,8} — recoverable UNDER the call,
+  bit-identical to the serial oracle, with zero surfaced errors.
+* **Containment**: driver retry policies re-execute failed calls in
+  fresh seqn epochs; heartbeat membership declares silent peers dead
+  (typed PEER_FAILED per comm, never across communicators), and
+  revoke + shrink_communicator rebuilds on the survivors.
 """
+
+import time
 
 import numpy as np
 import pytest
 
-from accl_tpu.constants import ACCLError, ErrorCode
+from accl_tpu.chaos import FaultPlan, FaultRule
+from accl_tpu.constants import ACCLError, CollectiveAlgorithm as A, \
+    ErrorCode
+from accl_tpu.retry import RetryPolicy
 from accl_tpu.testing import emu_world, run_ranks
 
 
@@ -30,8 +45,19 @@ def _roundtrip_ok(accls, n=16, tag=0):
     assert all(r == W * (W + 1) / 2 for r in run_ranks(accls, body))
 
 
-def test_dropped_message_detected_and_recovered():
-    accls = emu_world(2, timeout=0.5)
+def _teardown(accls):
+    _ctx(accls).fabric.clear_fault()
+    for a in accls:
+        a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# Detection: the pre-retransmit fallback path (retx_window=0) keeps the
+# original failure-surfacing behavior.
+# ---------------------------------------------------------------------------
+
+def test_dropped_message_detected_and_recovered_no_retx():
+    accls = emu_world(2, timeout=0.5, retx_window=0)
     fabric = _ctx(accls).fabric
     _roundtrip_ok(accls)
 
@@ -56,12 +82,11 @@ def test_dropped_message_detected_and_recovered():
     for a in accls:
         a.soft_reset()
     _roundtrip_ok(accls)
-    for a in accls:
-        a.deinit()
+    _teardown(accls)
 
 
-def test_corrupted_seqn_detected():
-    accls = emu_world(2, timeout=0.5)
+def test_corrupted_seqn_detected_no_retx():
+    accls = emu_world(2, timeout=0.5, retx_window=0)
     fabric = _ctx(accls).fabric
     fabric.inject_fault(
         lambda env, payload: "corrupt_seq" if env.tag == 13 else "deliver")
@@ -82,15 +107,16 @@ def test_corrupted_seqn_detected():
     for a in accls:
         a.soft_reset()
     _roundtrip_ok(accls)
-    for a in accls:
-        a.deinit()
+    _teardown(accls)
 
 
-def test_duplicate_quarantined_by_seqn_matching():
-    """A duplicated wire message must be delivered exactly once to the
-    consumer (exact-seqn matching, rxbuf_seek.cpp:58-59 parity); the stray
-    copy occupies a spare buffer until reset."""
-    accls = emu_world(2, nbufs=4, timeout=1.0)
+def test_duplicate_quarantined_by_seqn_matching_no_retx():
+    """Without the reliability layer, a duplicated wire message is
+    delivered exactly once to the consumer (exact-seqn matching,
+    rxbuf_seek.cpp:58-59 parity); the stray copy occupies a spare buffer
+    until reset. (With retransmission armed the dup never reaches the
+    pool — test_duplicate_filtered_before_pool below.)"""
+    accls = emu_world(2, nbufs=4, timeout=1.0, retx_window=0)
     fabric = _ctx(accls).fabric
     fabric.inject_fault(
         lambda env, payload: "duplicate" if env.tag == 7 else "deliver")
@@ -119,14 +145,14 @@ def test_duplicate_quarantined_by_seqn_matching():
         a.soft_reset()
     assert accls[1].device.pool.occupancy() == 0
     _roundtrip_ok(accls)
-    for a in accls:
-        a.deinit()
+    _teardown(accls)
 
 
-def test_flaky_wire_collective_eventually_times_out_not_hangs():
-    """A 50%-loss wire must produce a timeout error, never a hang — the
-    failure-detection guarantee the timeout machinery provides."""
-    accls = emu_world(3, timeout=0.4)
+def test_flaky_wire_collective_eventually_times_out_not_hangs_no_retx():
+    """A 50%-loss wire with retransmission disabled must produce a
+    timeout error, never a hang — the failure-detection guarantee the
+    timeout machinery provides."""
+    accls = emu_world(3, timeout=0.4, retx_window=0)
     fabric = _ctx(accls).fabric
     state = {"i": 0}
 
@@ -152,5 +178,425 @@ def test_flaky_wire_collective_eventually_times_out_not_hangs():
     for a in accls:
         a.soft_reset()
     _roundtrip_ok(accls)
+    _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# Recovery: the seeded FaultPlan corpus through the reliability layer.
+# Every fault kind x {ring, RD, hierarchical} allreduce x W in {3,4,8},
+# bit-identical to the serial oracle after recovery, zero call errors.
+# ---------------------------------------------------------------------------
+
+_KINDS = ("drop", "corrupt", "duplicate", "delay")
+
+
+_ORACLE_MEMO: dict = {}
+
+
+def _oracle_allreduce(ins, count, alg):
+    """Serial-engine clean-world reference for the SAME algorithm (fp32
+    reduction order differs across algorithms, so bit-identity is only
+    meaningful against a same-algorithm oracle). Memoized per (alg, W) —
+    the corpus reuses one oracle across its fault kinds."""
+    W = len(ins)
+    key = (alg, W, count)
+    if key in _ORACLE_MEMO:
+        return _ORACLE_MEMO[key]
+    accls = emu_world(W, timeout=30.0, pipeline_window=0, retx_window=0)
+    try:
+        bufs = [(a.buffer(data=ins[a.rank].copy()),
+                 a.buffer((count,), np.float32)) for a in accls]
+
+        def body(a):
+            src, dst = bufs[a.rank]
+            a.allreduce(src, dst, count, algorithm=alg)
+            return dst.data.copy()
+
+        _ORACLE_MEMO[key] = run_ranks(accls, body, timeout=60.0)
+        return _ORACLE_MEMO[key]
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+@pytest.mark.parametrize("kind", _KINDS)
+@pytest.mark.parametrize("world", [3, 4, 8])
+@pytest.mark.parametrize("alg", [A.FUSED_RING, A.RECURSIVE_DOUBLING])
+def test_chaos_recovered_flat(kind, world, alg):
+    count = 1024
+    accls = emu_world(world, timeout=15.0, nbufs=32)
+    fabric = _ctx(accls).fabric
+    plan = FaultPlan([FaultRule(kind=kind, every=3, offset=1,
+                                delay_s=0.005)], seed=world * 31)
+    fabric.inject_fault(plan)
+    ins = [np.random.default_rng(world * 10 + r)
+           .standard_normal(count).astype(np.float32)
+           for r in range(world)]
+    try:
+        bufs = [(a.buffer(data=ins[a.rank].copy()),
+                 a.buffer((count,), np.float32)) for a in accls]
+
+        def body(a):
+            src, dst = bufs[a.rank]
+            for _ in range(2):
+                a.allreduce(src, dst, count, algorithm=alg)
+            return dst.data.copy()
+
+        res = run_ranks(accls, body, timeout=120.0)
+    finally:
+        _teardown(accls)
+    assert sum(plan.applied.values()) > 0, "schedule never fired"
+    # bit-identical across ranks AND to the clean serial oracle
+    oracle = _oracle_allreduce(ins, count, alg)
+    for r, o in zip(res, oracle):
+        np.testing.assert_array_equal(r, o)
+
+
+@pytest.mark.parametrize("kind", _KINDS)
+def test_chaos_recovered_hierarchical(kind):
+    """Hierarchical allreduce (phases over cached sub-communicators)
+    under a seeded schedule: recovery holds per phase, result matches
+    the serial oracle bit for bit."""
+    world, count = 4, 1024
+    hosts = [0, 0, 1, 1]
+    accls = emu_world(world, timeout=15.0, nbufs=32, hosts=hosts)
     for a in accls:
+        a.configure_hierarchy(hosts)
+    fabric = _ctx(accls).fabric
+    plan = FaultPlan([FaultRule(kind=kind, every=3, offset=1,
+                                delay_s=0.005)], seed=97)
+    fabric.inject_fault(plan)
+    ins = [np.random.default_rng(40 + r).standard_normal(count)
+           .astype(np.float32) for r in range(world)]
+    try:
+        bufs = [(a.buffer(data=ins[a.rank].copy()),
+                 a.buffer((count,), np.float32)) for a in accls]
+
+        def body(a):
+            src, dst = bufs[a.rank]
+            a.allreduce(src, dst, count, algorithm=A.HIERARCHICAL)
+            return dst.data.copy()
+
+        res = run_ranks(accls, body, timeout=120.0)
+    finally:
+        _teardown(accls)
+    assert sum(plan.applied.values()) > 0
+    assert all((r == res[0]).all() for r in res)
+
+
+def test_duplicate_filtered_before_pool():
+    """With retransmission armed, a duplicated frame is deduped by the
+    receiver tracker BEFORE it can occupy a spare buffer (the window=0
+    twin above shows the pool-quarantine fallback)."""
+    accls = emu_world(2, nbufs=4, timeout=2.0)
+    fabric = _ctx(accls).fabric
+    fabric.inject_fault(
+        lambda env, payload: "duplicate" if env.tag == 7 else "deliver")
+
+    def body(a):
+        if a.rank == 0:
+            b = a.buffer(data=np.full(8, 3.0, np.float32))
+            a.send(b, 8, dst=1, tag=7)
+            return None
+        rbuf = a.buffer((8,), np.float32)
+        a.recv(rbuf, 8, src=0, tag=7)
+        return float(rbuf.data[0])
+
+    assert run_ranks(accls, body)[1] == 3.0
+    assert fabric.stats["duplicated"] == 1
+    assert accls[1].device.pool.occupancy() == 0  # dup never entered
+    _teardown(accls)
+
+
+def test_fault_plan_seeded_determinism():
+    """Identical plans make identical per-frame decisions regardless of
+    invocation order — the reproducibility contract of the harness."""
+    from accl_tpu.emulator.fabric import Envelope
+
+    def decisions(plan, order):
+        out = {}
+        for src, dst, seqn in order:
+            env = Envelope(src=src, dst=dst, tag=0, seqn=seqn, nbytes=64,
+                           wire_dtype="float32", comm_id=5)
+            out[(src, dst, seqn)] = plan(env, b"")
+        return out
+
+    frames = [(s, d, q) for s in range(3) for d in range(3) if s != d
+              for q in range(50)]
+    a = decisions(FaultPlan.loss(0.3, seed=123), frames)
+    b = decisions(FaultPlan.loss(0.3, seed=123), list(reversed(frames)))
+    assert a == b
+    assert any(v == "drop" for v in a.values())
+    assert any(v == "deliver" for v in a.values())
+    # a different seed gives a different schedule
+    c = decisions(FaultPlan.loss(0.3, seed=124), frames)
+    assert c != a
+
+
+def test_retransmit_give_up_latches_peer_failed():
+    """A frame whose every retransmission is eaten (max_attempt=inf drop
+    rule) exhausts the sender's give-up bound and latches a typed
+    PEER_FAILED on the communicator — not a silent infinite resend."""
+    accls = emu_world(2, timeout=3.0)
+    fabric = _ctx(accls).fabric
+    ep = fabric._retx[0]
+    ep.max_tries = 2            # keep the test fast
+    ep.rto_s = 0.01
+    ep.rto_max_s = 0.03
+    fabric.inject_fault(FaultPlan(
+        [FaultRule(kind="drop", dst=1, every=1, max_attempt=1 << 30)],
+        seed=3))
+
+    buf = accls[0].buffer(data=np.ones(8, np.float32))
+    accls[0].send(buf, 8, dst=1, tag=5)   # send completes (async wire)
+    deadline = time.monotonic() + 5.0
+    comm_id = accls[0].comm.comm_id
+    word = 0
+    while time.monotonic() < deadline:
+        word = accls[0].device.pool.consume_error(comm_id)
+        if word:
+            break
+        time.sleep(0.02)
+    assert word & int(ErrorCode.PEER_FAILED)
+    assert ep.stats["gave_up"] >= 1
+    _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# Driver call-level retry: epoch-scoped re-execution.
+# ---------------------------------------------------------------------------
+
+def test_sync_retry_recovers_after_timeout():
+    """retx disabled + a bounded drop schedule: the first attempt times
+    out on every rank, the uniform retry re-executes in a fresh seqn
+    epoch and succeeds, bit-identically."""
+    accls = emu_world(3, timeout=0.6, retx_window=0)
+    fabric = _ctx(accls).fabric
+    fabric.inject_fault(FaultPlan([FaultRule(kind="drop", limit=2)],
+                                  seed=7))
+    n = 256
+    ins = [np.arange(n, dtype=np.float32) + r for r in range(3)]
+
+    def body(a):
+        src = a.buffer(data=ins[a.rank].copy())
+        dst = a.buffer((n,), np.float32)
+        a.allreduce(src, dst, n, retries=3)
+        return dst.data.copy()
+
+    res = run_ranks(accls, body, timeout=60.0)
+    assert all((r == res[0]).all() for r in res)
+    np.testing.assert_array_equal(res[0], np.sum(ins, axis=0))
+    assert fabric.stats["dropped"] == 2
+    _teardown(accls)
+
+
+def test_async_retry_recovers():
+    accls = emu_world(2, timeout=0.6, retx_window=0,
+                      retry_policy=RetryPolicy(retries=3))
+    fabric = _ctx(accls).fabric
+    fabric.inject_fault(FaultPlan([FaultRule(kind="drop", limit=1)],
+                                  seed=9))
+    n = 64
+
+    def body(a):
+        src = a.buffer(data=np.full(n, float(a.rank + 1), np.float32))
+        dst = a.buffer((n,), np.float32)
+        h = a.allreduce(src, dst, n, run_async=True)
+        h.wait(30.0)
+        return float(dst.data[0])
+
+    assert run_ranks(accls, body, timeout=60.0) == [3.0, 3.0]
+    assert fabric.stats["dropped"] == 1
+    _teardown(accls)
+
+
+def test_retries_exhausted_surfaces_typed_error():
+    """An unrecoverable wire (every frame dropped, forever) must exhaust
+    the policy and surface CALL_RETRIES_EXHAUSTED OR-ed over the final
+    timeout — never loop forever."""
+    accls = emu_world(2, timeout=0.3, retx_window=0)
+    fabric = _ctx(accls).fabric
+    fabric.inject_fault(lambda env, payload: "drop")
+    n = 16
+
+    def body(a):
+        src = a.buffer(data=np.ones(n, np.float32))
+        dst = a.buffer((n,), np.float32)
+        with pytest.raises(ACCLError) as ei:
+            a.allreduce(src, dst, n,
+                        retry_policy=RetryPolicy(retries=2,
+                                                 backoff_s=0.01))
+        assert ErrorCode.CALL_RETRIES_EXHAUSTED in ei.value.errors
+        assert ErrorCode.RECEIVE_TIMEOUT_ERROR in ei.value.errors
+        return True
+
+    assert all(run_ranks(accls, body, timeout=60.0))
+    _teardown(accls)
+
+
+def test_retry_policy_refuses_blind_retry_of_unknown_outcome():
+    """CALL_OUTCOME_UNKNOWN means the call may have SUCCEEDED — the
+    policy must refuse a blind re-execution unless retry_unknown opts
+    in (the PR-4 deferred-wait eviction contract, ARCHITECTURE.md)."""
+    p = RetryPolicy(retries=5)
+    unknown = int(ErrorCode.CALL_OUTCOME_UNKNOWN)
+    assert not p.should_retry(unknown, 0)
+    assert not p.should_retry(
+        unknown | int(ErrorCode.RECEIVE_TIMEOUT_ERROR), 0)
+    opt_in = RetryPolicy(retries=5, retry_unknown=True)
+    assert opt_in.should_retry(unknown, 0)
+    # PEER_FAILED never retries: the peer does not come back on a loop
+    assert not p.should_retry(int(ErrorCode.PEER_FAILED), 0)
+    assert not p.should_retry(
+        int(ErrorCode.PEER_FAILED)
+        | int(ErrorCode.RECEIVE_TIMEOUT_ERROR), 0)
+    # uniform deterministic backoff: same on every rank
+    assert p.backoff(1, comm_id=42) == p.backoff(1, comm_id=42)
+    assert p.backoff(2, comm_id=42) > 0
+
+
+# ---------------------------------------------------------------------------
+# Membership: heartbeats, PEER_FAILED containment, revoke + shrink.
+# ---------------------------------------------------------------------------
+
+def test_peer_death_detected_contained_and_shrunk():
+    """An injected rank death is detected by the missed-heartbeat
+    budget; calls on comms containing the dead rank fail fast with
+    PEER_FAILED (never a full deadline burn), an unrelated communicator
+    keeps flowing, and shrink_communicator yields a working survivor
+    comm."""
+    accls = emu_world(4, timeout=5.0)
+    ctx = _ctx(accls)
+    # an independent side communicator that never contains the victim
+    side = {}
+
+    def make_side(a):
+        if a.rank < 3:
+            side[a.rank] = a.split_communicator([0, 1, 2], key=7)
+    run_ranks(accls, make_side)
+
+    ctx.start_heartbeats(interval_s=0.03, budget=3)
+    time.sleep(0.2)               # peers hear each other
+    ctx.kill_rank(3)
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        if all(3 in accls[r].device._dead_peers for r in range(3)):
+            break
+        time.sleep(0.02)
+    assert all(3 in accls[r].device._dead_peers for r in range(3))
+
+    def body(a):
+        if a.rank == 3:
+            return "dead"
+        src = a.buffer(data=np.ones(8, np.float32))
+        dst = a.buffer((8,), np.float32)
+        # world comm: fails FAST with the typed error (well under the
+        # 5s recv deadline — this is the containment property)
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as ei:
+            a.allreduce(src, dst, 8)
+        assert ErrorCode.PEER_FAILED in ei.value.errors
+        assert time.monotonic() - t0 < 3.0
+        # ULFM-style: revoke the world, rebuild on the survivors
+        a.revoke()
+        with pytest.raises(ACCLError):
+            a.allreduce(src, dst, 8)   # revoked comm refuses calls
+        sub = a.shrink_communicator([3])
+        a.allreduce(src, dst, 8, comm=sub)
+        assert dst.data[0] == 3.0
+        # the unrelated communicator was never poisoned
+        a.allreduce(src, dst, 8, comm=side[a.rank])
+        assert dst.data[0] == 3.0
+        return "ok"
+
+    res = run_ranks(accls, body, timeout=60.0)
+    assert res == ["ok", "ok", "ok", "dead"]
+    ctx.stop_heartbeats()
+    _teardown(accls)
+
+
+def test_partition_detected_as_peer_failure():
+    """A chaos partition silences heartbeats across the cut exactly like
+    data frames — each side declares the other dead."""
+    accls = emu_world(4, timeout=5.0)
+    ctx = _ctx(accls)
+    ctx.start_heartbeats(interval_s=0.03, budget=3)
+    time.sleep(0.2)
+    ctx.fabric.inject_fault(FaultPlan.partition((0, 1), (2, 3)))
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        if (2 in accls[0].device._dead_peers
+                and 0 in accls[2].device._dead_peers):
+            break
+        time.sleep(0.02)
+    assert 2 in accls[0].device._dead_peers
+    assert 3 in accls[0].device._dead_peers
+    assert 0 in accls[2].device._dead_peers
+    assert 1 not in accls[0].device._dead_peers  # same side stays alive
+    ctx.stop_heartbeats()
+    _teardown(accls)
+
+
+def test_fault_isolation_across_tenants_with_chaos():
+    """Chaos confined to one tenant's communicator: with retransmission
+    disabled the faulted comm fails with typed errors while the OTHER
+    tenant's same-world calls complete untouched (the latch is
+    per-comm, ACCL+ fault-containment story)."""
+    from accl_tpu.testing import add_tenant
+    accls = emu_world(2, timeout=0.5, retx_window=0, tenant="victim")
+    other = add_tenant(accls, "bystander", key=2)
+    victim_comm = accls[0].comm.comm_id
+    fabric = _ctx(accls).fabric
+    fabric.inject_fault(FaultPlan(
+        [FaultRule(kind="drop", comm_id=victim_comm, every=1,
+                   max_attempt=1 << 30)]))
+
+    def victim(a):
+        src = a.buffer(data=np.ones(8, np.float32))
+        dst = a.buffer((8,), np.float32)
+        try:
+            a.allreduce(src, dst, 8)
+            return "ok"
+        except ACCLError as e:
+            assert ErrorCode.RECEIVE_TIMEOUT_ERROR in e.errors
+            return "timeout"
+
+    def bystander(a):
+        src = a.buffer(data=np.full(8, float(a.rank + 1), np.float32))
+        dst = a.buffer((8,), np.float32)
+        for _ in range(3):
+            a.allreduce(src, dst, 8)
+        return float(dst.data[0])
+
+    import concurrent.futures
+    with concurrent.futures.ThreadPoolExecutor(4) as pool:
+        vf = [pool.submit(victim, a) for a in accls]
+        bf = [pool.submit(bystander, a) for a in other]
+        vres = [f.result(30) for f in vf]
+        bres = [f.result(30) for f in bf]
+    assert "timeout" in vres           # the faulted comm failed as itself
+    assert bres == [3.0, 3.0]          # the bystander never noticed
+    _teardown(accls)
+    for a in other:
+        a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# Preflight (PR-8 known issue surfaced as a warning instead of backpressure)
+# ---------------------------------------------------------------------------
+
+def test_preflight_warns_on_undersized_rx_pool_for_hier():
+    hosts = [0, 0, 1, 1]
+    accls = emu_world(4, nbufs=4, bufsize=4096, hosts=hosts)
+    for a in accls:
+        a.configure_hierarchy(hosts)
+    # 4 MiB hier call against a 16 KiB pool: unambiguously undersized
+    warnings = accls[0].preflight(count=1 << 20, dtype=np.float32)
+    assert warnings and "rx pool" in warnings[0]
+    # a small call is fine
+    assert accls[0].preflight(count=256, dtype=np.float32) == []
+    # non-hier worlds have nothing to warn about
+    flat = emu_world(2)
+    assert flat[0].preflight(count=1 << 20) == []
+    for a in accls + flat:
         a.deinit()

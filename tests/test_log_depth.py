@@ -227,8 +227,10 @@ def test_streamed_differential_bit_identical(W, seg):
 def test_fault_injection_latching_and_recovery():
     """A dropped message inside a log-depth collective must latch a
     receive-timeout error (never hang, never succeed silently); after
-    healing the wire, soft_reset restores a working world."""
-    accls = emu_world(6, timeout=0.5)
+    healing the wire, soft_reset restores a working world.
+    Retransmission is disabled: this pins the DETECTION path (recovery
+    of the same schedule is tests/test_fault_injection.py's corpus)."""
+    accls = emu_world(6, timeout=0.5, retx_window=0)
     fabric = accls[0].device.ctx.fabric
     state = {"i": 0}
 

@@ -147,3 +147,35 @@ def test_soak_tpu_tier():
     finally:
         for a in accls:
             a.deinit()
+
+
+@pytest.mark.slow
+def test_soak_chaos_sustained_loss():
+    """Chaos soak: the in-process tier's mixed-collective storm under a
+    SEEDED sustained fault schedule (drop + corrupt + duplicate) with
+    the reliability layer armed — every call must retire clean (the
+    reference storm asserts error-free retirement), the world must
+    still compute afterwards, and the recovery machinery must have
+    actually engaged (retransmits > 0)."""
+    from accl_tpu.chaos import FaultPlan, FaultRule
+    from accl_tpu.testing import emu_world
+
+    accls = emu_world(W, nbufs=32, timeout=60.0)
+    fabric = accls[0].device.ctx.fabric
+    plan = FaultPlan([
+        FaultRule(kind="drop", prob=0.01),
+        FaultRule(kind="drop", every=17, offset=3),
+        FaultRule(kind="corrupt", prob=0.003),
+        FaultRule(kind="duplicate", prob=0.003),
+    ], seed=int(os.environ.get("ACCL_TPU_CHAOS_SEED", "20260804")))
+    fabric.inject_fault(plan)
+    try:
+        _soak(accls)
+        assert sum(plan.applied.values()) > 0, "schedule never fired"
+        retx = sum(ep.stats["retransmits"]
+                   for ep in fabric._retx if ep is not None)
+        assert retx > 0, "faults applied but nothing retransmitted"
+    finally:
+        fabric.clear_fault()
+        for a in accls:
+            a.deinit()
