@@ -106,6 +106,12 @@ class ACCL:
         self._redist_comms: dict = {}
         self._redist_stage_pool: dict = {}
         self._redist_seq = itertools.count(1)
+        # one-sided RMA windows (accl_tpu/rma): ids handed out from a
+        # per-driver counter, so symmetric registration order yields
+        # agreeing ids across ranks without a handshake — the same
+        # determinism contract split_communicator uses for comm ids
+        self._next_window = itertools.count(1)
+        self._windows: dict[int, ACCLBuffer] = {}
         # async calls this driver has issued that have not retired yet —
         # tuner-training measurements only happen on a quiet device
         # (an unrelated in-flight call would add its queue wait to the
@@ -502,12 +508,17 @@ class ACCL:
         ctx_seq = getattr(fab, "ctx_seq", None)
         if ctx_seq is not None:
             labels["ctx"] = ctx_seq
+        # tenant attribution on the driver rows (PR 11): serving traffic
+        # (put/get) is separable from collectives per tenant straight
+        # from the exposition, without joining against CallRecords
         for (op, comm_id), n in list(self._call_counts.items()):
             yield ("counter", "accl_calls_total",
-                   dict(labels, op=op, comm_id=comm_id), n)
+                   dict(labels, op=op, comm_id=comm_id,
+                        tenant=self.tenant or f"comm-{comm_id}"), n)
         for (op, comm_id), n in list(self._byte_counts.items()):
             yield ("counter", "accl_bytes_total",
-                   dict(labels, op=op, comm_id=comm_id), n)
+                   dict(labels, op=op, comm_id=comm_id,
+                        tenant=self.tenant or f"comm-{comm_id}"), n)
 
     def deinit(self):
         self.device.deinit()
@@ -968,6 +979,80 @@ class ACCL:
         # remote_stream is carried via tag on the move; device backends map
         # RES_STREAM on a send to strm delivery.
         return self._call(desc, run_async, waitfor, chain,
+                          retries, retry_policy)
+
+    # -- one-sided RMA (accl_tpu/rma) --------------------------------------
+    def register_window(self, buf: ACCLBuffer,
+                        window: int | None = None) -> int:
+        """Expose ``buf`` as a one-sided window peers can put/get
+        against; returns the window id. Ids are the RMA address
+        namespace and are exchanged at configure time: when every rank
+        registers its windows in the same order, the auto-assigned ids
+        agree across ranks without a handshake (pass ``window=`` to pin
+        an explicit id instead). The buffer stays usable locally; a
+        remote put lands in it with no local call posted."""
+        if window is None:
+            # counter skips ids pinned explicitly: an auto registration
+            # silently stealing a pinned window would redirect every
+            # later peer put/get at it into the wrong buffer
+            wid = next(self._next_window)
+            while wid in self._windows:
+                wid = next(self._next_window)
+        else:
+            wid = int(window)
+        self.device.register_window(wid, buf.address, buf.nbytes)
+        self._windows[wid] = buf
+        return wid
+
+    def deregister_window(self, window: int):
+        """Withdraw a window registration: later puts/gets against it
+        fail typed (``RMA_WINDOW_ERROR``) at the initiator."""
+        self.device.deregister_window(int(window))
+        self._windows.pop(int(window), None)
+
+    def put(self, srcbuf: ACCLBuffer, count: int, dst: int, window: int,
+            offset: int = 0, *, comm: Communicator | None = None,
+            compress_dtype=None, run_async: bool = False,
+            waitfor: Sequence[CallHandle] = (),
+            retries: int | None = None,
+            retry_policy: "RetryPolicy | None" = None) -> CallHandle:
+        """One-sided write: ``count`` elements of ``srcbuf`` land at byte
+        ``offset`` inside window ``window`` on rank ``dst`` (comm-local
+        index), which posts NO matching call. Small payloads go eager
+        (one frame riding the target's rx pool and tenant quotas); large
+        ones rendezvous — RTS/CTS, then segments streamed directly into
+        the window, never consuming the target's rx-pool buffers, so a
+        multi-MiB KV-cache push cannot starve the pool its
+        latency-critical collectives depend on. ``compress_dtype``
+        narrows the wire dtype (decompress-on-landing). Completion (the
+        data IS in the window) surfaces on the returned handle; chain
+        behind compute with ``waitfor=``/``run_async=True``."""
+        comm = comm or self.comm
+        desc = self._prepare(CCLOp.put, count=count, comm=comm,
+                             root_src_dst=dst, tag=int(window), op0=srcbuf,
+                             compress_dtype=compress_dtype)
+        desc.addr_1 = int(offset)  # byte offset INTO the window (no
+        # operand buffer rides addr_1 on one-sided calls)
+        return self._call(desc, run_async, waitfor, False,
+                          retries, retry_policy)
+
+    def get(self, dstbuf: ACCLBuffer, count: int, src: int, window: int,
+            offset: int = 0, *, comm: Communicator | None = None,
+            compress_dtype=None, run_async: bool = False,
+            waitfor: Sequence[CallHandle] = (),
+            retries: int | None = None,
+            retry_policy: "RetryPolicy | None" = None) -> CallHandle:
+        """One-sided read: ``count`` elements from byte ``offset`` of
+        window ``window`` on rank ``src`` land in ``dstbuf``; the target
+        posts no matching call. Same delivery machinery as :meth:`put`
+        (the payload streams directly into ``dstbuf`` — requester-pulled
+        transfers never buffer in either side's rx pool)."""
+        comm = comm or self.comm
+        desc = self._prepare(CCLOp.get, count=count, comm=comm,
+                             root_src_dst=src, tag=int(window), res=dstbuf,
+                             compress_dtype=compress_dtype)
+        desc.addr_1 = int(offset)  # byte offset INTO the window
+        return self._call(desc, run_async, waitfor, False,
                           retries, retry_policy)
 
     def stream_push(self, data) -> None:

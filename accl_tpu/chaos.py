@@ -57,6 +57,12 @@ class FaultRule:
     src: int | None = None
     dst: int | None = None
     comm_id: int | None = None
+    # envelope strm lane filter: 0 = pool-destined data, ACK_STRM/HB_STRM
+    # reliability control, RMA_STRM/RMA_DATA_STRM one-sided control and
+    # payload lanes — a rendezvous chaos test targets "the RTS/CTS
+    # handshake" or "a mid-stream payload segment" with this plus a seqn
+    # range, without catching unrelated collective traffic
+    strm: int | None = None
     seqn_lo: int | None = None
     seqn_hi: int | None = None        # exclusive
     every: int | None = None          # fire when seqn % every == offset
@@ -87,6 +93,8 @@ class FaultRule:
         if self.dst is not None and env.dst != self.dst:
             return False
         if self.comm_id is not None and env.comm_id != self.comm_id:
+            return False
+        if self.strm is not None and env.strm != self.strm:
             return False
         if self.seqn_lo is not None and env.seqn < self.seqn_lo:
             return False

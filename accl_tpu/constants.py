@@ -37,6 +37,13 @@ class CCLOp(enum.IntEnum):
     reduce_scatter = 11
     barrier = 12
     alltoall = 13
+    # one-sided RMA (accl_tpu/rma): data lands in / is read from a
+    # REGISTERED WINDOW on the target rank, which posts no matching call.
+    # root_src_dst carries the target rank, tag the window id, addr_1 the
+    # byte offset into the window — the descriptor shape rides the
+    # existing 15-word wire format unchanged.
+    put = 14
+    get = 15
     nop = 255
 
 
@@ -279,6 +286,11 @@ class ErrorCode(enum.IntFlag):
     # every attempt failed — OR-ed over the final attempt's word so the
     # caller sees both WHAT kept failing and THAT retries ran out
     CALL_RETRIES_EXHAUSTED = 1 << 28
+    # one-sided RMA (accl_tpu/rma): the put/get targeted a window id the
+    # target rank has not registered, or the (offset, count) range falls
+    # outside the registered region — typed so a mis-exchanged window id
+    # fails fast at the initiator instead of as a receive timeout
+    RMA_WINDOW_ERROR = 1 << 29
 
 
 class StackType(enum.IntEnum):
@@ -367,4 +379,19 @@ DEFAULT_RETX_MAX_TRIES = 10    # give-up bound -> PEER_FAILED latch
 # which a silent peer is declared dead (PEER_FAILED latched per comm).
 DEFAULT_HEARTBEAT_MS = 0
 DEFAULT_HEARTBEAT_BUDGET = 3
+# One-sided RMA (accl_tpu/rma): wire-size threshold below which a put
+# takes the EAGER path (one control+payload frame riding the target's rx
+# pool and tenant quotas, like any eager-ingress message); at or above
+# it the transfer rendezvouses — RTS/CTS control frames, then payload
+# segments streamed DIRECTLY into the registered window, never consuming
+# rx-pool buffers (the tested invariant: a multi-MiB KV-cache push must
+# not starve the pool that collectives depend on). Clamped to the
+# target's rx buffer size at use. $ACCL_TPU_RMA_EAGER_MAX overrides.
+DEFAULT_RMA_EAGER_MAX = 16 << 10
+# control-retry cadence of the RMA engine (RTS awaiting CTS, DONE
+# awaiting FIN, GET awaiting segments): base timeout doubles per try up
+# to the give-up bound, then the transfer fails typed
+# (RECEIVE_TIMEOUT_ERROR) instead of hanging
+DEFAULT_RMA_RTO_S = 0.05
+DEFAULT_RMA_MAX_TRIES = 10
 TAG_ANY = 0xFFFFFFFF                        # reference uses tag=ANY sentinel

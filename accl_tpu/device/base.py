@@ -217,6 +217,20 @@ class Device(abc.ABC):
             f"{type(self).__name__} has no device-array storage; use a "
             "host buffer (device-resident mode is a TPU-backend feature)")
 
+    # -- one-sided RMA windows (accl_tpu/rma) ------------------------------
+    def register_window(self, wid: int, addr: int, nbytes: int):
+        """Register ``[addr, addr+nbytes)`` as one-sided window ``wid``
+        so peers can put/get against it. Backends without an RMA engine
+        reject — a put toward an unregistered tier must fail at
+        registration time, not as a mystery timeout."""
+        from ..constants import ACCLError, ErrorCode
+        raise ACCLError(int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED),
+                        f"{type(self).__name__} has no one-sided RMA "
+                        "engine (emulator/daemon tiers only)")
+
+    def deregister_window(self, wid: int):
+        """Remove a window registration (no-op when absent)."""
+
     def soft_reset(self):
         """Parity: HOUSEKEEP_SWRST (ccl_offload_control.c:1244-1247)."""
 

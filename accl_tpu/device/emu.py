@@ -23,7 +23,8 @@ from typing import Sequence
 from ..buffer import ACCLBuffer
 from ..call import CallDescriptor, CallHandle
 from ..communicator import Communicator
-from ..constants import (ACCLError, CCLOp, DEFAULT_CALL_CHAIN_DEPTH,
+from ..constants import (ACCLError, CCLOp, Compression,
+                         DEFAULT_CALL_CHAIN_DEPTH,
                          DEFAULT_MAX_SEGMENT_SIZE, DEFAULT_RX_BUFFER_COUNT,
                          DEFAULT_RX_BUFFER_SIZE, DEFAULT_TIMEOUT_S,
                          ErrorCode, StreamFlags)
@@ -36,6 +37,7 @@ from .base import Device
 
 # inbox token waking the ingress loop's deferred retry (pool release)
 _RETRY = object()
+_ETH_C = Compression.ETH_COMPRESSED
 
 
 class EmuContext:
@@ -259,6 +261,17 @@ class EmuDevice(Device):
         # REFERENCE with the rx pool and the RankService so a late
         # tenant registration is visible everywhere at once.
         self.comm_tenants: dict[int, str] = {}
+        # one-sided RMA (accl_tpu/rma): registered windows + the put/get
+        # engine. Late-bound getters because soft reset swaps the pool
+        # object and config calls change segment size / timeout.
+        from ..rma import RmaEngine, WindowRegistry
+        self.windows = WindowRegistry()
+        self.rma = RmaEngine(
+            rank, self.mem, self.windows, ctx.fabric.send,
+            pool_fn=lambda: self.pool, comm_of=self.comms.get,
+            tenant_of=self.tenant_of_comm,
+            timeout_fn=lambda: self.timeout,
+            seg_fn=lambda: self.max_segment_size, tier="emu")
         # membership state (armed via ctx.start_heartbeats): peers are
         # tracked once heard from; a dead peer fail-fasts calls on every
         # comm containing it until shrink_communicator rebuilds
@@ -366,11 +379,17 @@ class EmuDevice(Device):
     # -- ingress (eager, never blocks the sender) --------------------------
     def ingest(self, env: Envelope, payload: bytes):
         if env.strm >= 2:
-            # reliability control frames: heartbeats feed the membership
-            # tracker; anything else (stray ACKs — LocalFabric acks are
-            # internal calls) is dropped, never stream-delivered
-            from ..emulator.protocol import HB_STRM
-            if env.strm == HB_STRM:
+            # reliability / one-sided control lanes: heartbeats feed the
+            # membership tracker, RMA frames feed the put/get engine
+            # (rendezvous payload segments land DIRECTLY in their
+            # registered window here — never in the rx pool); anything
+            # else (stray ACKs — LocalFabric acks are internal calls) is
+            # dropped, never stream-delivered
+            from ..emulator.protocol import (HB_STRM, RMA_DATA_STRM,
+                                             RMA_STRM)
+            if env.strm in (RMA_STRM, RMA_DATA_STRM):
+                self.rma.on_frame(env, payload)
+            elif env.strm == HB_STRM:
                 self.note_heartbeat(env.src)
             return
         # Fast path: deliver into the pool from the sender's thread — one
@@ -529,9 +548,79 @@ class EmuDevice(Device):
                 f"buffers, accl.py:660-667)")
         self.max_segment_size = nbytes
 
+    # -- one-sided RMA (accl_tpu/rma) --------------------------------------
+    def register_window(self, wid: int, addr: int, nbytes: int):
+        self.windows.register(wid, addr, nbytes)
+
+    def deregister_window(self, wid: int):
+        self.windows.deregister(wid)
+
+    def _rma_call(self, desc: CallDescriptor,
+                  waitfor: Sequence[CallHandle]) -> CallHandle:
+        """Launch a put/get: completion is driven by the RMA engine's
+        FIN/landing events, not a worker thread — the engine's TX worker
+        streams the payload, so an async put overlaps the issuing
+        thread's compute. ``waitfor`` chains through done-callbacks."""
+        handle = CallHandle(context=desc.scenario.name)
+        self._comm_add(desc.comm_id)
+        self._inflight_add()
+        handle.add_done_callback(
+            lambda _err, cid=desc.comm_id: (self._comm_done(cid),
+                                            self._inflight_done()))
+
+        def launch():
+            comm = self.comms.get(desc.comm_id)
+            if comm is None:
+                handle.complete(int(ErrorCode.COMM_NOT_CONFIGURED))
+                return
+            if desc.arithcfg is None:
+                handle.complete(int(ErrorCode.ARITHCFG_NOT_CONFIGURED))
+                return
+            if self._dead_peers and any(r.global_rank in self._dead_peers
+                                        for r in comm.ranks):
+                handle.complete(int(ErrorCode.PEER_FAILED))
+                return
+            if desc.scenario == CCLOp.put:
+                local = desc.addr_0
+                local_c = bool(desc.compression
+                               & Compression.OP0_COMPRESSED)
+            else:
+                local = desc.addr_2
+                local_c = bool(desc.compression
+                               & Compression.RES_COMPRESSED)
+            self.rma.start(
+                desc.scenario, comm, desc.root_src_dst, desc.tag,
+                desc.addr_1, desc.count, desc.arithcfg,
+                bool(desc.compression & _ETH_C), local, handle,
+                tenant=self.tenant_of_comm(desc.comm_id),
+                local_compressed=local_c)
+
+        waitfor = tuple(waitfor)
+        if not waitfor:
+            launch()
+            return handle
+        remaining = [len(waitfor)]
+        mu = threading.Lock()
+
+        def dep_done(err):
+            if err and not handle.done():
+                handle.complete(int(err))
+                return
+            with mu:
+                remaining[0] -= 1
+                fire = remaining[0] == 0
+            if fire and not handle.done():
+                launch()
+
+        for dep in waitfor:
+            dep.add_done_callback(dep_done)
+        return handle
+
     def call_async(self, desc: CallDescriptor,
                    waitfor: Sequence[CallHandle] = (), *,
                    inline_ok: bool = False) -> CallHandle:
+        if desc.scenario in (CCLOp.put, CCLOp.get):
+            return self._rma_call(desc, waitfor)
         handle = CallHandle(context=desc.scenario.name)
         waitfor = tuple(waitfor)
         first = self._comm_add(desc.comm_id)
@@ -602,6 +691,10 @@ class EmuDevice(Device):
         self.pool.on_release = self._on_pool_release
         self.executor.pool = self.pool
         self.executor.reset_streams()
+        # in-flight one-sided transfer state dies with the seqn spaces
+        # (window REGISTRATIONS survive — they are configuration, like
+        # communicators)
+        self.rma.reset()
         if self.service is not None:
             self.service.wire_pool(self.pool)
         # retransmission channels keyed on the zeroed seqn spaces reset
@@ -619,6 +712,7 @@ class EmuDevice(Device):
                 self._chain_q.put(None)
         if self.service is not None:
             self.service.close()
+        self.rma.close()
         self.executor.close()
         self.ctx.note_device_deinit()
 

@@ -230,6 +230,23 @@ class SimDevice(Device):
         info = self.get_info()
         return (int(info["nbufs"]), int(info["bufsize"]))
 
+    # -- one-sided RMA windows (accl_tpu/rma) ------------------------------
+    def register_window(self, wid: int, addr: int, nbytes: int):
+        """Register a window on the daemon (MSG_REG_WINDOW). The backing
+        buffer's host mirror is pushed first: a peer's get against a
+        freshly registered window must see the buffer's current
+        contents, and remote puts land daemon-side only (sync the buffer
+        from the device to observe them, as with collective results)."""
+        buf = self._resolve_buffer(addr)
+        if buf is not None:
+            self.sync_to_device(buf)
+        self._check(bytes([P.MSG_REG_WINDOW])
+                    + struct.pack("<IQQ", wid, addr, nbytes))
+
+    def deregister_window(self, wid: int):
+        self._check(bytes([P.MSG_REG_WINDOW])
+                    + struct.pack("<IQQ", wid, 0, 0))
+
     def get_info(self) -> dict:
         """Daemon geometry + runtime-config state — the readable effect of
         ACCL_CONFIG calls (extended MSG_GET_INFO reply; older daemons
@@ -247,6 +264,12 @@ class SimDevice(Device):
                         profiling=bool(flags & 2),
                         stack="udp" if stack else "tcp",
                         profiled_calls=prof)
+        if len(reply) >= 21 + 22:
+            # capability word (absent on native/older daemons -> 0):
+            # bit 0 retx-ACK responder, bit 1 one-sided RMA
+            info["caps"] = struct.unpack("<I", reply[39:43])[0]
+        else:
+            info["caps"] = 0
         return info
 
     def deinit(self):
@@ -269,6 +292,16 @@ class SimDevice(Device):
         """The address a completed call wrote (bcast lands in-place)."""
         return desc.addr_2 or (
             desc.addr_0 if desc.scenario == CCLOp.bcast else 0)
+
+    @staticmethod
+    def _operand_addrs(desc: CallDescriptor) -> tuple:
+        """Operand addresses whose host mirrors must be pushed before
+        submission. One-sided calls carry the WINDOW OFFSET in addr_1 —
+        a small integer that could alias an unrelated buffer's address
+        range, so it must never be resolved as an operand."""
+        if desc.scenario in (CCLOp.put, CCLOp.get):
+            return (desc.addr_0,)
+        return (desc.addr_0, desc.addr_1)
 
     def _resolve_buffer(self, addr: int) -> ACCLBuffer | None:
         for b in self._buffers:
@@ -356,7 +389,7 @@ class SimDevice(Device):
         dependency's footprint (transitively, via the footprints stored
         on their handles at submission). Conservative — retired calls
         leave stale entries that only cause a harmless fallback."""
-        fp = {a for a in (desc.addr_0, desc.addr_1,
+        fp = {a for a in (*self._operand_addrs(desc),
                           self._result_addr(desc)) if a}
         for dep in waitfor:
             if not dep.done():
@@ -393,7 +426,7 @@ class SimDevice(Device):
             # push — the push would feed the chain data from the
             # future; fall back to the wait-then-sync path.
             res_buf = self._resolve_buffer(dep_res) if dep_res else None
-            for addr in (desc.addr_0, desc.addr_1):
+            for addr in self._operand_addrs(desc):
                 if not addr:
                     continue
                 b = self._resolve_buffer(addr)
@@ -434,7 +467,7 @@ class SimDevice(Device):
                 # daemon handles WRITE_MEM on arrival, before any of the
                 # batch executes); dependency-produced operands live in
                 # devicemem and must NOT be clobbered by stale mirrors
-                for addr in (desc.addr_0, desc.addr_1):
+                for addr in self._operand_addrs(desc):
                     if addr:
                         b = self._resolve_buffer(addr)
                         if b is not None and b not in skip_bufs:
@@ -455,7 +488,7 @@ class SimDevice(Device):
                 handle.sim_call_id = call_id
                 handle.sim_device = self
                 handle.sim_result_addr = self._result_addr(desc)
-                handle.sim_operand_addrs = (desc.addr_0, desc.addr_1)
+                handle.sim_operand_addrs = self._operand_addrs(desc)
                 self._completion_q.put((desc, call_id, handle))
         except Exception as exc:  # noqa: BLE001
             for _desc, _wf, handle in run:
@@ -504,7 +537,7 @@ class SimDevice(Device):
                     handle.complete(exc.error_word, exception=exc)
                     return
             sync_bufs = []
-            for addr in (desc.addr_0, desc.addr_1):
+            for addr in self._operand_addrs(desc):
                 if addr:
                     b = self._resolve_buffer(addr)
                     # a pipelined dependency PRODUCES this operand in
@@ -527,7 +560,7 @@ class SimDevice(Device):
             handle.sim_call_id = call_id
             handle.sim_device = self
             handle.sim_result_addr = self._result_addr(desc)
-            handle.sim_operand_addrs = (desc.addr_0, desc.addr_1)
+            handle.sim_operand_addrs = self._operand_addrs(desc)
             handle.sim_hazard_addrs = self._hazard_footprint(desc, waitfor)
             # single FIFO completion worker on the dedicated wait
             # connection (daemon retirement is FIFO, so head-of-queue
@@ -604,7 +637,7 @@ class SimDevice(Device):
         handle.sim_call_id = call_id
         handle.sim_device = self
         handle.sim_result_addr = res_addr
-        handle.sim_operand_addrs = (desc.addr_0, desc.addr_1)
+        handle.sim_operand_addrs = self._operand_addrs(desc)
         handle.sim_hazard_addrs = self._hazard_footprint(desc, waitfor)
         if sync_err:
             # an operand push failed after the call was already

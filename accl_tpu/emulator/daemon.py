@@ -66,6 +66,30 @@ def _sane_budget(b: float, *, configured: bool = False) -> float:
     return b
 
 
+def probe_peer_caps(host: str, port: int,
+                    timeout: float = 0.3) -> int | None:
+    """Best-effort capability probe of a peer daemon's COMMAND port: one
+    MSG_GET_INFO round trip, returning the trailing caps word (0 for
+    daemons predating it — the native ``cclo_emud`` and older Python
+    daemons — whose replies are 38 payload bytes), or None when the peer
+    was unreachable within the budget (unknown, NOT zero: an
+    still-starting daemon must not be mistaken for a native one)."""
+    try:
+        with socket.create_connection((host, port),
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            P.send_frame(sock, bytes([P.MSG_GET_INFO]))
+            reply = P.recv_frame(sock)
+    except (OSError, ConnectionError, struct.error):
+        return None
+    if not reply or reply[0] != P.MSG_DATA:
+        return None
+    payload = reply[1:]
+    if len(payload) >= 42:
+        return struct.unpack("<I", payload[38:42])[0]
+    return 0
+
+
 def _env_from_eth_frame(frame: bytes) -> tuple[Envelope, bytes]:
     """Decode an eth frame (post-MSG_ETH byte) into (Envelope, payload) —
     shared by both fabric stacks so the header format lives in one place."""
@@ -679,6 +703,21 @@ class RankDaemon:
         except Exception:  # OverflowError for out-of-range ports, OSError...
             self._server.close()
             raise
+        # one-sided RMA (accl_tpu/rma): window registry + put/get engine.
+        # send_fn late-binds self.eth — a runtime stack swap
+        # (set_stack_type) must route later frames through the new fabric
+        from ..call import CallHandle as _CallHandle
+        from ..rma import RmaEngine, WindowRegistry
+        self._CallHandle = _CallHandle
+        self.windows = WindowRegistry()
+        self.rma = RmaEngine(
+            rank, self.mem, self.windows,
+            lambda env, p: self.eth.send(env, p),
+            pool_fn=lambda: self.pool, comm_of=self.comms.get,
+            tenant_of=lambda cid: (self.comm_tenants.get(cid)
+                                   or f"comm-{cid}"),
+            timeout_fn=lambda: self.timeout,
+            seg_fn=lambda: self.max_segment_size, tier="daemon")
         self.executor = MoveExecutor(self.mem, self.pool, self.eth.send,
                                      timeout=self.timeout)
         # both eth fabrics serialize the payload into a frame before
@@ -773,6 +812,47 @@ class RankDaemon:
         self.eth.latch_fn = lambda cid, err: self.pool.latch_error(cid,
                                                                    err)
 
+    def _maybe_pin_retx(self, ranks):
+        """Auto-pin the retransmission window to 0 in mixed py/native
+        worlds (PR-9 known issue): the native ``cclo_emud`` has no ACK
+        responder, so a UDP-stack Python daemon retransmitting toward it
+        RTO-storms to the give-up bound and latches false PEER_FAILED.
+        At configure time — the moment peers become known — each peer's
+        cmd port is probed once (MSG_GET_INFO caps word, see
+        :func:`probe_peer_caps`); any peer without CAP_RETX_ACK disables
+        this daemon's retransmission with a one-time warning, instead of
+        requiring operators to know ``ACCL_TPU_RETX_WINDOW=0``.
+        Unreachable peers stay unprobed (retried on the next configure) —
+        a still-starting Python daemon must not be mistaken for native."""
+        if self.stack != "udp" or getattr(self.eth, "retx", None) is None:
+            return
+        if not hasattr(self, "_peer_caps"):
+            self._peer_caps: dict[tuple, int] = {}
+        for grank, host, port in ranks:
+            if grank == self.rank or not port:
+                continue
+            key = (host, port)
+            caps = self._peer_caps.get(key)
+            if caps is None:
+                caps = probe_peer_caps(host, port)
+                if caps is None:
+                    continue  # unknown — do not cache, do not pin
+                self._peer_caps[key] = caps
+            if not caps & P.CAP_RETX_ACK:
+                log.warning(
+                    "rank %d: peer rank %d at %s:%d has no "
+                    "retransmission ACK responder (native cclo_emud or "
+                    "an older daemon) — pinning this daemon's retx "
+                    "window to 0 so retransmits toward it cannot "
+                    "RTO-storm into a false PEER_FAILED "
+                    "(set ACCL_TPU_RETX_WINDOW=0 to silence)",
+                    self.rank, grank, host, port,
+                    extra={"rank": self.rank})
+                METRICS.inc("retx_pinned_total", rank=self.rank,
+                            tier="daemon")
+                self.eth.retx = None
+                return
+
     # -- membership (heartbeats) -------------------------------------------
     def _heartbeat_loop(self):
         while not self._stop.wait(self.hb_interval):
@@ -836,6 +916,13 @@ class RankDaemon:
     def _ingest(self, env: Envelope, payload: bytes):
         if env.strm == P.HB_STRM:
             self._note_heartbeat(env.src)
+            return
+        if env.strm in (P.RMA_STRM, P.RMA_DATA_STRM):
+            # one-sided lanes: control frames + rendezvous segments (the
+            # latter land directly in their registered window — never in
+            # the rx pool; eager puts ride pool.ingest from inside the
+            # engine, charging tenant quotas like any eager message)
+            self.rma.on_frame(env, payload)
             return
         if env.strm >= 2:
             # reliability control frames never reach the stream ports
@@ -989,6 +1076,33 @@ class RankDaemon:
                 # sanity bound BEFORE expansion: a hostile count would
                 # otherwise materialize count/segment move objects
                 return int(ErrorCode.DMA_SIZE_ERROR)
+            if scenario in (CCLOp.put, CCLOp.get):
+                # one-sided: the RMA engine owns delivery + completion;
+                # the FIFO call worker blocks until the transfer FINs
+                # (the daemon call contract is synchronous retirement)
+                handle = self._CallHandle(context=scenario.name)
+                comp = Compression(c["compression"])
+                if scenario == CCLOp.put:
+                    local = c["addr0"]
+                    local_c = bool(comp & Compression.OP0_COMPRESSED)
+                else:
+                    local = c["addr2"]
+                    local_c = bool(comp & Compression.RES_COMPRESSED)
+                self.rma.start(
+                    scenario, comm, c["root"], c["tag"], c["addr1"],
+                    c["count"], cfg,
+                    bool(comp & Compression.ETH_COMPRESSED),
+                    local, handle,
+                    tenant=self.comm_tenants.get(c["comm_id"], ""),
+                    local_compressed=local_c)
+                try:
+                    handle.wait(self.timeout)
+                    return 0
+                except TimeoutError:
+                    return int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+                except Exception as exc:  # noqa: BLE001 — typed word out
+                    word = getattr(exc, "error_word", 0)
+                    return word or int(ErrorCode.INVALID_CALL)
             alg = c.get("algorithm", 0)
             func = self._FUNCS.get(c["func"])
             algorithm = self._ALGOS.get(alg)
@@ -1131,6 +1245,9 @@ class RankDaemon:
             # must go with them (every rank of the world resets, per the
             # soft-reset contract, so both ends clear)
             reset()
+        # in-flight one-sided transfers die with the seqn spaces; window
+        # registrations survive (configuration, like communicators)
+        self.rma.reset()
         for comm in self.comms.values():
             for r in comm.ranks:
                 r.inbound_seq = r.outbound_seq = 0
@@ -1308,6 +1425,22 @@ class RankDaemon:
             self.comm_epoch += 1
             self.plan_cache.invalidate("comm")
             self.eth.learn_peers(ranks, self.world)
+            self._maybe_pin_retx(ranks)
+            return P.status_reply(0)
+        if kind == P.MSG_REG_WINDOW:
+            wid, addr, nbytes = struct.unpack("<IQQ", body[1:21])
+            if nbytes == 0:
+                self.windows.deregister(wid)
+                return P.status_reply(0)
+            try:
+                # the whole window must lie inside registered device
+                # memory, or the first inbound put would die on an
+                # ingress thread (zero-copy view: validation, no copy)
+                self.mem.read(addr, int(nbytes), np.dtype(np.uint8),
+                              copy=False)
+                self.windows.register(wid, addr, nbytes)
+            except (KeyError, ValueError):
+                return P.status_reply(int(ErrorCode.RMA_WINDOW_ERROR))
             return P.status_reply(0)
         if kind == P.MSG_SET_TIMEOUT:
             t = _sane_budget(struct.unpack("<d", body[1:9])[0],
@@ -1419,7 +1552,13 @@ class RankDaemon:
                 + struct.pack("<QIBBI", self.max_segment_size,
                               int(self.timeout * 1000), flags,
                               0 if self.stack == "tcp" else 1,
-                              self.profiled_calls))
+                              self.profiled_calls)
+                # capability word (PR 11): this daemon answers retx ACKs
+                # and serves one-sided RMA. The native cclo_emud reports
+                # caps WITHOUT bit 0 (no ACK responder) — which is what
+                # _maybe_pin_retx probes for at configure time; replies
+                # from daemons predating the field parse as caps=0.
+                + struct.pack("<I", P.CAP_RETX_ACK | P.CAP_RMA))
         if kind == P.MSG_RESET:
             self._soft_reset()
             return P.status_reply(0)
@@ -1446,6 +1585,7 @@ class RankDaemon:
     def shutdown(self):
         self._stop.set()
         self._server.close()
+        self.rma.close()
         self.eth.close()
         self.executor.close()
 

@@ -44,6 +44,12 @@ MSG_STREAM_POP = 16   # f64 timeout-seconds + u64 count (0 = next entry
 #                       whole) -> MSG_DATA (dtype u8 + raw elements) from
 #                       the stream-out port (RES_STREAM sink), or
 #                       MSG_STATUS STATUS_PENDING when not enough arrives
+MSG_REG_WINDOW = 17   # window u32 + addr u64 + nbytes u64 -> MSG_STATUS;
+#                       registers a one-sided RMA window over an already
+#                       allocated device-memory range (nbytes=0
+#                       deregisters). Window ids are the put/get address
+#                       namespace peers target — exchanged at configure
+#                       time by the application (accl_tpu/rma).
 # replies
 # shared daemon resource bounds (hostile-descriptor protection; both
 # daemons and the robustness suite reference these — keep in sync with
@@ -73,6 +79,24 @@ MSG_ETH = 50          # envelope + payload
 # stream-deliver garbage.
 ACK_STRM = 2          # retransmission acknowledgement (pack_ack payload)
 HB_STRM = 3           # membership heartbeat (empty payload)
+# One-sided RMA lanes (accl_tpu/rma): control frames (RTS/CTS/GET/DONE/
+# FIN/NACK + the eager put, pack_rma_ctl payload) and rendezvous payload
+# segments (tag = transfer id, seqn = segment index, payload lands
+# DIRECTLY in the target's registered window — never in the rx pool).
+# Like ACK/HB these never enter the seqn-ordered channel, so the
+# retransmission layer ignores them; the RMA engine runs its own
+# RTS-retry / NACK-resend recovery on top.
+RMA_STRM = 4          # one-sided control frames (pack_rma_ctl payload)
+RMA_DATA_STRM = 5     # rendezvous payload segments (direct-to-window)
+
+# daemon capability bits (MSG_GET_INFO trailing caps u32; absent on
+# replies from daemons predating it — treat as 0). Bit 0: the daemon
+# answers retransmission ACKs (strm=ACK_STRM) — the native cclo_emud
+# does NOT, which is why mixed py/native UDP worlds must pin
+# $ACCL_TPU_RETX_WINDOW=0 (auto-detected at configure time since PR 11).
+# Bit 1: the daemon serves one-sided RMA frames (accl_tpu/rma).
+CAP_RETX_ACK = 1
+CAP_RMA = 2
 
 
 # -- retransmission ACK (rides an eth frame with strm=ACK_STRM) -------------
@@ -89,6 +113,57 @@ def unpack_ack(payload: bytes) -> tuple[int, tuple]:
     cum, n = struct.unpack("<IH", payload[:6])
     sel = struct.unpack(f"<{n}I", payload[6:6 + 4 * n])
     return cum, sel
+
+# -- one-sided RMA control frames (ride strm=RMA_STRM) ----------------------
+# kind u8, udtype u8, cdtype u8, flags u8 (bit0 = eth-compressed wire),
+# xfer u32, window u32, nsegs u32, err u32, offset u64, count u64,
+# then kind-specific trailing u32s (RMA_NACK: the missing segment
+# indices) or raw payload bytes (RMA_EAGER: the eager put's data).
+# The transfer id also rides the envelope tag; comm_id the envelope.
+RMA_RTS = 1     # put rendezvous request  -> CTS (or FIN(err))
+RMA_CTS = 2     # clear to send: target allocated receive state
+RMA_GET = 3     # one-sided read request  -> payload segments + DONE
+RMA_DONE = 4    # all segments emitted (count of segments in nsegs)
+RMA_FIN = 5     # transfer complete at the target / typed failure (err)
+RMA_NACK = 6    # missing segments after DONE (selective resend request)
+RMA_EAGER = 7   # small put: control header + payload in ONE frame;
+#                 rides the target's rx pool (quota-charged) like any
+#                 eager-ingress message before landing in the window
+
+_RMA_CTL_FMT = "<4B4I2Q"
+_RMA_CTL_SIZE = struct.calcsize(_RMA_CTL_FMT)
+
+
+def pack_rma_ctl(kind: int, xfer: int, *, window: int = 0, offset: int = 0,
+                 count: int = 0, udtype: int = 0, cdtype: int = 0,
+                 eth_compressed: bool = False, nsegs: int = 0,
+                 err: int = 0, extra=(), payload: bytes = b"") -> bytes:
+    body = struct.pack(_RMA_CTL_FMT, kind, udtype, cdtype,
+                       1 if eth_compressed else 0, xfer, window, nsegs,
+                       err & 0xFFFFFFFF, offset, count)
+    if extra:
+        body += struct.pack(f"<{len(extra)}I", *extra)
+    if payload:
+        body = b"".join((body, payload))
+    return body
+
+
+def unpack_rma_ctl(body) -> tuple[dict, memoryview]:
+    """Returns (fields, trailing bytes). The trailing view is the NACK's
+    packed missing-segment list or the EAGER frame's raw payload."""
+    view = memoryview(body)
+    (kind, udtype, cdtype, flags, xfer, window, nsegs, err, offset,
+     count) = struct.unpack(_RMA_CTL_FMT, view[:_RMA_CTL_SIZE])
+    return dict(kind=kind, udtype=udtype, cdtype=cdtype,
+                eth_compressed=bool(flags & 1), xfer=xfer, window=window,
+                nsegs=nsegs, err=err, offset=offset,
+                count=count), view[_RMA_CTL_SIZE:]
+
+
+def unpack_rma_nack(trailing) -> tuple:
+    n = len(trailing) // 4
+    return struct.unpack(f"<{n}I", trailing[:4 * n])
+
 
 DTYPE_CODES = {
     "float32": 0, "float64": 1, "int32": 2, "int64": 3,

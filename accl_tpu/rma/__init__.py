@@ -1,0 +1,23 @@
+"""One-sided RMA: registered memory windows + put/get with rendezvous.
+
+The missing primitive for the inference-serving dataplane (ROADMAP item
+5, ACCL+'s "collective engine for distributed applications" end-state):
+a prefill rank streams multi-MiB KV-cache blocks into a decode rank's
+registered window WITHOUT posting matching receives and — the tested
+invariant — without consuming the rx-buffer pool that the decode rank's
+latency-critical collectives depend on. See
+:mod:`accl_tpu.rma.engine` for the delivery paths and reliability story,
+:mod:`accl_tpu.rma.plan` for the (pure, lint-replayed) segmentation, and
+docs/ARCHITECTURE.md "One-sided operations".
+"""
+
+from .engine import RmaEngine
+from .plan import (EAGER, RENDEZVOUS, TransferPlan, eager_max_from_env,
+                   plan_transfer, segment_bounds)
+from .window import Window, WindowRegistry
+
+__all__ = [
+    "RmaEngine", "Window", "WindowRegistry", "TransferPlan",
+    "plan_transfer", "segment_bounds", "eager_max_from_env",
+    "EAGER", "RENDEZVOUS",
+]

@@ -1,0 +1,798 @@
+"""The one-sided RMA engine: put/get with eager and rendezvous delivery.
+
+One engine per rank, attached to whatever fabric that rank's tier speaks
+(the in-process LocalFabric, the daemon's TCP/UDP eth fabrics — the
+engine only needs ``send_fn(env, payload)`` and an ingress hook). Two
+delivery paths, chosen per transfer by :func:`~accl_tpu.rma.plan.
+plan_transfer`:
+
+* **eager** (small wire payloads): ONE control+payload frame
+  (``RMA_EAGER``). The target routes the payload through its rx-buffer
+  pool exactly like an eager-ingress collective message — claiming a
+  spare buffer, charging the comm's TENANT quota (accl_tpu/service),
+  honoring the oversize latch — before landing it in the window. Small
+  puts therefore obey the same backpressure/quota regime as everything
+  else.
+
+* **rendezvous** (large payloads): ``RTS -> CTS`` handshake on the
+  ``RMA_STRM`` control lane, then payload segments streamed on
+  ``RMA_DATA_STRM`` directly into the registered window. **No segment
+  ever touches the rx pool** — the tested invariant: a multi-MiB
+  KV-cache push must not consume the spare buffers the target's
+  latency-critical collectives depend on.
+
+Reliability is the engine's own (the PR-9 retransmission layer
+deliberately ignores ``strm >= 2`` control lanes): initiator-driven
+control retries with exponential backoff (RTS awaiting CTS, DONE
+awaiting FIN, GET awaiting data), receiver-side segment dedup by index,
+and selective ``NACK``-driven resend of exactly the missing segments
+after ``DONE`` — so a seeded :class:`~accl_tpu.chaos.FaultPlan`
+dropping/duplicating/delaying any control frame or a mid-stream segment
+still converges to a bit-identical landing. Completion surfaces as the
+ordinary :class:`~accl_tpu.call.CallHandle` the driver hands out, so
+puts chain behind compute (``waitfor=``), driver-level retry policies
+apply, and per-tenant attribution rides CallRecords/metrics/traces
+unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..call import CallHandle
+from ..constants import (ACCLError, CCLOp, DEFAULT_RMA_MAX_TRIES,
+                         DEFAULT_RMA_RTO_S, ErrorCode)
+from ..emulator import protocol as P
+from ..emulator.fabric import Envelope
+from ..log import get_logger
+from ..tracing import METRICS, TRACE
+from .plan import EAGER, plan_transfer, segment_bounds
+from .window import WindowRegistry
+
+log = get_logger(__name__)
+
+# synthetic rx-pool seqn space for eager frames: far above any collective
+# channel's dense per-peer counters, and unique per transfer (xfer ids
+# carry the initiator's rank bits). Never crosses the fabric — it is only
+# the pool-matching key on the target.
+_POOL_SEQ_BASE = 0x80000000
+
+_DONE_MEMO_CAP = 1024
+
+
+class _Tx:
+    """Initiator-side transfer state (one put or get)."""
+
+    __slots__ = ("kind", "xfer", "comm", "comm_id", "dst", "window",
+                 "offset", "count", "u_dtype", "w_dtype", "l_dtype",
+                 "eth_c", "addr", "plan", "handle", "tenant", "phase",
+                 "tries", "deadline", "got", "done_seen", "t0")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class _Rx:
+    """Target-side state of one inbound rendezvous put."""
+
+    __slots__ = ("base", "count", "u_dtype", "w_dtype", "eth_c", "nsegs",
+                 "bounds", "got", "comm_id", "tenant", "expires")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class _Srv:
+    """Target-side state of one outbound get serve (kept until FIN or
+    TTL so a NACK can re-read exactly the missing segments from the
+    window)."""
+
+    __slots__ = ("base", "count", "u_dtype", "w_dtype", "eth_c", "nsegs",
+                 "bounds", "comm_id", "dst", "tenant", "expires")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+class RmaEngine:
+    """Per-rank one-sided engine. ``pool_fn``/``seg_fn``/``timeout_fn``
+    are late-bound getters (soft reset swaps the pool object; config
+    calls change segment size and timeout); ``comm_of`` maps comm_id ->
+    Communicator; ``tenant_of`` maps comm_id -> tenant label for
+    attribution."""
+
+    def __init__(self, rank: int, mem, windows: WindowRegistry, send_fn, *,
+                 pool_fn, comm_of, tenant_of=None, timeout_fn=None,
+                 seg_fn=None, eager_max: int | None = None,
+                 rto_s: float = DEFAULT_RMA_RTO_S,
+                 max_tries: int = DEFAULT_RMA_MAX_TRIES, tier: str = "emu"):
+        self.rank = rank
+        self.mem = mem
+        self.windows = windows
+        self._send = send_fn
+        self.pool_fn = pool_fn
+        self.comm_of = comm_of
+        self.tenant_of = tenant_of or (lambda cid: f"comm-{cid}")
+        self.timeout_fn = timeout_fn or (lambda: 30.0)
+        self.seg_fn = seg_fn or (lambda: 1 << 20)
+        self.eager_max = eager_max
+        self.rto_s = float(rto_s)
+        self.max_tries = int(max_tries)
+        self.tier = tier
+        self._mu = threading.Lock()
+        self._tx: dict[int, _Tx] = {}
+        self._rx: dict[tuple[int, int], _Rx] = {}
+        self._srv: dict[tuple[int, int], _Srv] = {}
+        # completed inbound transfers: duplicate RTS/DONE/EAGER after
+        # completion re-FIN from here instead of re-running (bounded)
+        self._done_memo: dict[tuple[int, int], int] = {}
+        # xfer ids carry the initiator's rank so two ranks' concurrent
+        # transfers over the same pair can never collide at either end
+        self._next = itertools.count(1)
+        self._jobs: queue.Queue | None = None
+        self._worker: threading.Thread | None = None
+        self._closed = False
+        # engine-local counters, folded into the registry by a weak
+        # collector (per-segment registry incs would pay the process-wide
+        # lock on every frame — the storm-shaped cost the daemon/driver
+        # collectors exist to avoid)
+        self.counters: dict[str, int] = {}
+        METRICS.register_collector(self, RmaEngine.metrics_rows)
+
+    # -- lifecycle ---------------------------------------------------------
+    def _ensure_worker(self):
+        if self._jobs is None:
+            with self._mu:
+                if self._jobs is None:
+                    self._jobs = queue.Queue()
+                    self._worker = threading.Thread(
+                        target=self._run, daemon=True,
+                        name=f"rma-tx{self.rank}")
+                    self._worker.start()
+
+    def close(self):
+        self._closed = True
+        if self._jobs is not None:
+            self._jobs.put(None)
+        with self._mu:
+            pending = list(self._tx.values())
+            self._tx.clear()
+            self._rx.clear()
+            self._srv.clear()
+        for st in pending:
+            st.handle.complete(int(ErrorCode.CONNECTION_CLOSED))
+
+    def reset(self):
+        """Rank-local soft reset: in-flight transfer state dies with the
+        seqn spaces (initiator handles fail typed — a reset mid-transfer
+        is the existing soft-reset contract, rank-local surgery)."""
+        with self._mu:
+            pending = list(self._tx.values())
+            self._tx.clear()
+            self._rx.clear()
+            self._srv.clear()
+            self._done_memo.clear()
+        for st in pending:
+            st.handle.complete(int(ErrorCode.CONNECTION_CLOSED))
+
+    def _count(self, key: str, n: int = 1):
+        self.counters[key] = self.counters.get(key, 0) + n
+
+    def metrics_rows(self):
+        labels = {"rank": self.rank, "tier": self.tier}
+        for k, v in list(self.counters.items()):
+            yield ("counter", k, labels, v)
+        yield ("gauge", "rma_inflight", labels, len(self._tx))
+
+    # -- initiator ---------------------------------------------------------
+    def start(self, scenario: CCLOp, comm, target: int, window: int,
+              offset: int, count: int, arithcfg, eth_compressed: bool,
+              local_addr: int, handle: CallHandle, tenant: str = "",
+              local_compressed: bool = False):
+        """Begin one put/get. ``target`` is the comm-local rank index (the
+        descriptor's root_src_dst), ``local_addr`` the initiator's source
+        (put) / destination (get) byte address — stored in the COMPRESSED
+        dtype when ``local_compressed`` (the descriptor's OP0/RES
+        compression flag; the window side always holds the uncompressed
+        dtype). Returns immediately; the handle completes when the target
+        FINs (put) or every segment landed (get)."""
+        if self._closed:
+            handle.complete(int(ErrorCode.CONNECTION_CLOSED))
+            return
+        if not (0 <= target < comm.size):
+            handle.complete(int(ErrorCode.INVALID_CALL))
+            return
+        u_dt = arithcfg.uncompressed_dtype
+        l_dt = (arithcfg.compressed_dtype if local_compressed else u_dt)
+        if target == comm.local_rank:
+            # local shortcut: a self-put/get is a window-checked memcpy
+            self._local_copy(scenario, window, offset, count, arithcfg,
+                             local_addr, l_dt, handle)
+            return
+        w_dt = (arithcfg.compressed_dtype if eth_compressed
+                else arithcfg.uncompressed_dtype)
+        plan = plan_transfer(count, u_dt.itemsize, w_dt.itemsize,
+                             self.seg_fn(), self.eager_max)
+        xfer = ((self.rank & 0x7FF) << 20) | (next(self._next) & 0xFFFFF)
+        st = _Tx(kind=scenario, xfer=xfer, comm=comm,
+                 comm_id=comm.comm_id,
+                 dst=comm.ranks[target].global_rank, window=int(window),
+                 offset=int(offset), count=int(count), u_dtype=u_dt,
+                 w_dtype=w_dt, l_dtype=l_dt, eth_c=bool(eth_compressed),
+                 addr=int(local_addr), plan=plan, handle=handle,
+                 tenant=tenant or self.tenant_of(comm.comm_id),
+                 phase="", tries=0,
+                 # a real (not 0) deadline from the outset: the retry
+                 # tick must not race the queued initial emission into a
+                 # spurious duplicate
+                 deadline=time.monotonic() + self._rto(0), got=set(),
+                 done_seen=False, t0=time.perf_counter())
+        with self._mu:
+            self._tx[xfer] = st
+        self._ensure_worker()
+        if scenario == CCLOp.get:
+            self._count("rma_gets_total")
+            st.phase = "get"
+            self._enqueue(("get", xfer))
+        elif plan.kind == EAGER:
+            self._count("rma_puts_total")
+            self._count("rma_eager_total")
+            st.phase = "eager"
+            self._enqueue(("eager", xfer))
+        else:
+            self._count("rma_puts_total")
+            self._count("rma_rendezvous_total")
+            st.phase = "rts"
+            self._enqueue(("rts", xfer))
+
+    def _local_copy(self, scenario, window, offset, count, arithcfg,
+                    local_addr, l_dt, handle):
+        try:
+            dt = arithcfg.uncompressed_dtype
+            base = self.windows.resolve(window, offset, count * dt.itemsize)
+            if scenario == CCLOp.put:
+                data = self.mem.read(local_addr, count, l_dt)
+                self.mem.write(base, np.ascontiguousarray(
+                    data.astype(dt, copy=False)))
+            else:
+                data = self.mem.read(base, count, dt)
+                self.mem.write(local_addr, np.ascontiguousarray(
+                    data.astype(l_dt, copy=False)))
+            handle.complete(0)
+        except ACCLError as exc:
+            self._count("rma_window_errors_total")
+            handle.complete(exc.error_word, exception=exc)
+        except Exception as exc:  # noqa: BLE001 — surface, never hang
+            handle.complete(int(ErrorCode.INVALID_CALL), exception=exc)
+
+    def _enqueue(self, job):
+        self._jobs.put(job)
+
+    # -- TX worker (streaming + control emission + retry ticks) ------------
+    def _run(self):
+        tick = max(0.005, self.rto_s / 2)
+        while True:
+            try:
+                job = self._jobs.get(timeout=tick)
+            except queue.Empty:
+                self._tick()
+                continue
+            if job is None:
+                return
+            try:
+                self._run_job(job)
+            except Exception:  # noqa: BLE001 — a failed job must not
+                # kill the engine's only worker; the transfer's retry
+                # tick (or give-up) owns the outcome
+                log.error("rank %d rma: job %s failed", self.rank, job[0],
+                          exc_info=True, extra={"rank": self.rank})
+            self._tick()
+
+    def _run_job(self, job):
+        kind = job[0]
+        if kind in ("rts", "eager", "get"):
+            with self._mu:
+                st = self._tx.get(job[1])
+            if st is not None:
+                self._send_initial(st)
+        elif kind == "stream":
+            with self._mu:
+                st = self._tx.get(job[1])
+            if st is not None:
+                self._stream_put(st, job[2])
+        elif kind == "serve":
+            with self._mu:
+                sv = self._srv.get(job[1])
+            if sv is not None:
+                self._stream_serve(job[1], sv, job[2])
+
+    def _ctl(self, dst: int, comm_id: int, xfer: int, body: bytes):
+        env = Envelope(src=self._my_global(comm_id), dst=dst, tag=xfer,
+                       seqn=0, nbytes=len(body), wire_dtype="uint8",
+                       strm=P.RMA_STRM, comm_id=comm_id)
+        self._send(env, body)
+
+    def _my_global(self, comm_id: int) -> int:
+        comm = self.comm_of(comm_id)
+        return comm.my_global_rank if comm is not None else self.rank
+
+    def _send_initial(self, st: _Tx):
+        """Emit (or re-emit) the transfer's opening frame."""
+        kind = {"rts": P.RMA_RTS, "get": P.RMA_GET,
+                "eager": P.RMA_EAGER}[st.phase] if st.phase in (
+                    "rts", "get", "eager") else None
+        if kind is None:
+            return  # phase advanced while the job sat queued
+        payload = b""
+        if kind == P.RMA_EAGER:
+            data = self.mem.read(st.addr, st.count, st.l_dtype, copy=False)
+            payload = np.ascontiguousarray(
+                data.astype(st.w_dtype, copy=False)).tobytes()
+        body = P.pack_rma_ctl(
+            kind, st.xfer, window=st.window, offset=st.offset,
+            count=st.count, udtype=P.dtype_code(st.u_dtype),
+            cdtype=P.dtype_code(st.w_dtype), eth_compressed=st.eth_c,
+            nsegs=st.plan.nsegs, payload=payload)
+        st.deadline = time.monotonic() + self._rto(st.tries)
+        if TRACE.enabled:
+            TRACE.emit("rma_" + st.phase, rank=self.rank, seqn=st.xfer,
+                       peer=st.dst, nbytes=st.plan.wire_bytes,
+                       tenant=st.tenant)
+        try:
+            self._ctl(st.dst, st.comm_id, st.xfer, body)
+        except (RuntimeError, KeyError, OSError, ConnectionError):
+            pass  # unreachable peer: the retry tick (and give-up) own it
+
+    def _rto(self, tries: int) -> float:
+        return min(self.rto_s * (1 << min(tries, 6)), 2.0)
+
+    def _stream_put(self, st: _Tx, indices):
+        """Stream (all, or the NACKed subset of) a put's segments into
+        the wire, then DONE. Runs on the TX worker so async puts overlap
+        the issuing thread's compute."""
+        segs = (range(st.plan.nsegs) if indices is None else indices)
+        my = self._my_global(st.comm_id)
+        resend = indices is not None
+        try:
+            for si in segs:
+                off, n = st.plan.segments[si]
+                # local source in ITS stored dtype (OP0_COMPRESSED puts
+                # store the compressed form); the window side is always
+                # the uncompressed dtype
+                data = self.mem.read(st.addr + off * st.l_dtype.itemsize,
+                                     n, st.l_dtype, copy=False)
+                wire = np.ascontiguousarray(
+                    data.astype(st.w_dtype, copy=False))
+                payload = wire.reshape(-1).view(np.uint8)
+                env = Envelope(src=my, dst=st.dst, tag=st.xfer, seqn=si,
+                               nbytes=payload.nbytes,
+                               wire_dtype=st.w_dtype.name,
+                               strm=P.RMA_DATA_STRM, comm_id=st.comm_id)
+                self._send(env, payload)
+                self._count("rma_segments_total")
+                if resend:
+                    self._count("rma_retransmits_total")
+                # progress refreshes the stall deadline: _tick only
+                # intervenes in a stream that stopped emitting
+                st.deadline = time.monotonic() + max(
+                    1.0, self._rto(st.tries))
+                if TRACE.enabled:
+                    TRACE.emit("rma_seg", rank=self.rank, seqn=si,
+                               peer=st.dst, nbytes=payload.nbytes,
+                               tenant=st.tenant)
+            st.phase = "done"
+            st.deadline = time.monotonic() + self._rto(st.tries)
+            self._ctl(st.dst, st.comm_id, st.xfer, P.pack_rma_ctl(
+                P.RMA_DONE, st.xfer, count=st.count,
+                nsegs=st.plan.nsegs))
+        except (RuntimeError, KeyError, OSError, ConnectionError):
+            # mid-stream failure (fabric tearing down, peer gone, bad
+            # local range): hand recovery to the DONE/NACK machinery —
+            # the receiver NACKs whatever is missing, and the retry
+            # tick's give-up bound turns a dead peer into a typed
+            # timeout instead of a hung handle
+            st.phase = "done"
+            st.deadline = time.monotonic()
+
+    def _stream_serve(self, key, sv: _Srv, indices):
+        """Target side of a get: stream the requested window region back
+        to the requester, then DONE."""
+        src, xfer = key
+        segs = (range(sv.nsegs) if indices is None else indices)
+        my = self._my_global(sv.comm_id)
+        try:
+            for si in segs:
+                off, n = sv.bounds[si]
+                data = self.mem.read(sv.base + off * sv.u_dtype.itemsize,
+                                     n, sv.u_dtype, copy=False)
+                wire = np.ascontiguousarray(
+                    data.astype(sv.w_dtype, copy=False))
+                payload = wire.reshape(-1).view(np.uint8)
+                env = Envelope(src=my, dst=src, tag=xfer, seqn=si,
+                               nbytes=payload.nbytes,
+                               wire_dtype=sv.w_dtype.name,
+                               strm=P.RMA_DATA_STRM, comm_id=sv.comm_id)
+                self._send(env, payload)
+                self._count("rma_segments_total")
+                if indices is not None:
+                    self._count("rma_retransmits_total")
+            self._ctl(src, sv.comm_id, xfer, P.pack_rma_ctl(
+                P.RMA_DONE, xfer, count=sv.count, nsegs=sv.nsegs))
+            # a served (or re-served) transfer stays NACKable for a
+            # fresh TTL — the GC guards abandoned serves, not live ones
+            sv.expires = time.monotonic() + self.timeout_fn()
+        except (RuntimeError, KeyError, OSError, ConnectionError):
+            pass  # requester's own retry (re-GET / NACK) recovers
+
+    # -- retry ticks -------------------------------------------------------
+    def _tick(self):
+        now = time.monotonic()
+        expired: list[_Tx] = []
+        gave_up: list[_Tx] = []
+        with self._mu:
+            for st in self._tx.values():
+                if st.deadline > now:
+                    continue
+                if st.phase == "stream":
+                    # the streaming job stalled (its per-segment deadline
+                    # refresh stopped): fall to the DONE path — the
+                    # receiver NACKs whatever is missing, and the tries
+                    # bound below still owns give-up
+                    st.phase = "done"
+                st.tries += 1
+                if st.tries > self.max_tries:
+                    gave_up.append(st)
+                else:
+                    expired.append(st)
+            for st in gave_up:
+                self._tx.pop(st.xfer, None)
+            for key in [k for k, rx in self._rx.items()
+                        if rx.expires < now]:
+                del self._rx[key]
+            for key in [k for k, sv in self._srv.items()
+                        if sv.expires < now]:
+                del self._srv[key]
+        for st in gave_up:
+            self._count("rma_gave_up_total")
+            log.warning(
+                "rank %d rma: %s xfer %#x to rank %d gave up after %d "
+                "tries (phase %s)", self.rank, st.kind.name, st.xfer,
+                st.dst, self.max_tries, st.phase,
+                extra={"rank": self.rank})
+            st.handle.complete(int(ErrorCode.RECEIVE_TIMEOUT_ERROR))
+        for st in expired:
+            self._count("rma_retransmits_total")
+            st.deadline = now + self._rto(st.tries)
+            if st.phase in ("rts", "eager"):
+                self._send_initial(st)
+            elif st.phase == "done":
+                try:
+                    self._ctl(st.dst, st.comm_id, st.xfer, P.pack_rma_ctl(
+                        P.RMA_DONE, st.xfer, count=st.count,
+                        nsegs=st.plan.nsegs))
+                except (RuntimeError, KeyError, OSError, ConnectionError):
+                    pass
+            elif st.phase == "get":
+                if not st.got:
+                    self._send_initial(st)
+                else:
+                    missing = [i for i in range(st.plan.nsegs)
+                               if i not in st.got]
+                    try:
+                        self._ctl(st.dst, st.comm_id, st.xfer,
+                                  P.pack_rma_ctl(P.RMA_NACK, st.xfer,
+                                                 extra=missing))
+                    except (RuntimeError, KeyError, OSError,
+                            ConnectionError):
+                        pass
+
+    # -- ingress (both RMA strm lanes route here) --------------------------
+    def on_frame(self, env: Envelope, payload):
+        if env.strm == P.RMA_DATA_STRM:
+            self._on_data(env, payload)
+            return
+        ctl, trailing = P.unpack_rma_ctl(payload)
+        kind = ctl["kind"]
+        if kind == P.RMA_RTS:
+            self._on_rts(env, ctl)
+        elif kind == P.RMA_CTS:
+            self._on_cts(env, ctl)
+        elif kind == P.RMA_GET:
+            self._on_get(env, ctl)
+        elif kind == P.RMA_DONE:
+            self._on_done(env, ctl)
+        elif kind == P.RMA_FIN:
+            self._on_fin(env, ctl)
+        elif kind == P.RMA_NACK:
+            self._on_nack(env, P.unpack_rma_nack(trailing))
+        elif kind == P.RMA_EAGER:
+            self._on_eager(env, ctl, trailing)
+        else:
+            self._count("rma_orphan_frames_total")
+
+    def _resolve_target(self, ctl) -> tuple[int, np.dtype, np.dtype]:
+        u_dt = P.code_dtype(ctl["udtype"])
+        w_dt = P.code_dtype(ctl["cdtype"]) if ctl["eth_compressed"] \
+            else u_dt
+        base = self.windows.resolve(ctl["window"], ctl["offset"],
+                                    ctl["count"] * u_dt.itemsize)
+        return base, u_dt, w_dt
+
+    def _fin(self, dst: int, comm_id: int, xfer: int, err: int = 0):
+        try:
+            self._ctl(dst, comm_id, xfer, P.pack_rma_ctl(
+                P.RMA_FIN, xfer, err=err))
+        except (RuntimeError, KeyError, OSError, ConnectionError):
+            pass  # initiator's DONE/RTS retry re-elicits the FIN
+
+    def _memo_done(self, key, err: int):
+        self._done_memo[key] = err
+        while len(self._done_memo) > _DONE_MEMO_CAP:
+            self._done_memo.pop(next(iter(self._done_memo)))
+
+    # target side of a put rendezvous
+    def _on_rts(self, env: Envelope, ctl):
+        key = (env.src, ctl["xfer"])
+        with self._mu:
+            memo = self._done_memo.get(key)
+            rx = self._rx.get(key)
+        if memo is not None:
+            self._fin(env.src, env.comm_id, ctl["xfer"], memo)
+            return
+        if rx is None:
+            try:
+                base, u_dt, w_dt = self._resolve_target(ctl)
+            except ACCLError as exc:
+                self._count("rma_window_errors_total")
+                self._fin(env.src, env.comm_id, ctl["xfer"],
+                          exc.error_word)
+                return
+            rx = _Rx(base=base, count=ctl["count"], u_dtype=u_dt,
+                     w_dtype=w_dt, eth_c=ctl["eth_compressed"],
+                     nsegs=ctl["nsegs"],
+                     bounds=segment_bounds(ctl["count"], ctl["nsegs"]),
+                     got=set(), comm_id=env.comm_id,
+                     tenant=self.tenant_of(env.comm_id),
+                     expires=time.monotonic() + self.timeout_fn())
+            with self._mu:
+                self._rx.setdefault(key, rx)
+        # (duplicate RTS for a live transfer re-CTSes — the CTS may have
+        # been the dropped frame)
+        self._ctl(env.src, env.comm_id, ctl["xfer"],
+                  P.pack_rma_ctl(P.RMA_CTS, ctl["xfer"]))
+        if TRACE.enabled:
+            TRACE.emit("rma_cts", rank=self.rank, seqn=ctl["xfer"],
+                       peer=env.src, nbytes=0, tenant=rx.tenant)
+
+    # initiator side: CTS arrived, stream the payload
+    def _on_cts(self, env: Envelope, ctl):
+        with self._mu:
+            st = self._tx.get(ctl["xfer"])
+            if st is None or st.phase != "rts":
+                return  # duplicate CTS / already streaming
+            st.phase = "stream"
+        self._enqueue(("stream", st.xfer, None))
+
+    # target side of a get
+    def _on_get(self, env: Envelope, ctl):
+        key = (env.src, ctl["xfer"])
+        with self._mu:
+            sv = self._srv.get(key)
+        if sv is None:
+            try:
+                base, u_dt, w_dt = self._resolve_target(ctl)
+            except ACCLError as exc:
+                self._count("rma_window_errors_total")
+                self._fin(env.src, env.comm_id, ctl["xfer"],
+                          exc.error_word)
+                return
+            sv = _Srv(base=base, count=ctl["count"], u_dtype=u_dt,
+                      w_dtype=w_dt, eth_c=ctl["eth_compressed"],
+                      nsegs=ctl["nsegs"],
+                      bounds=segment_bounds(ctl["count"], ctl["nsegs"]),
+                      comm_id=env.comm_id, dst=env.src,
+                      tenant=self.tenant_of(env.comm_id),
+                      expires=time.monotonic() + self.timeout_fn())
+            with self._mu:
+                self._srv.setdefault(key, sv)
+        self._ensure_worker()
+        self._enqueue(("serve", key, None))
+
+    # payload segment: target of a put, or initiator of a get
+    def _on_data(self, env: Envelope, payload):
+        key = (env.src, env.tag)
+        with self._mu:
+            rx = self._rx.get(key)
+            st = self._tx.get(env.tag) if rx is None else None
+        if rx is not None:
+            si = env.seqn
+            if si >= rx.nsegs or si in rx.got:
+                return  # corrupt index / duplicate: idempotent-drop
+            off, n = rx.bounds[si]
+            self._land(rx.base, off, n, rx.u_dtype, rx.w_dtype, payload)
+            with self._mu:
+                rx.got.add(si)
+                # a live stream keeps its state alive: the TTL guards
+                # ABANDONED transfers, not slow (throttled-link) ones
+                rx.expires = time.monotonic() + self.timeout_fn()
+            return
+        if st is not None and st.kind == CCLOp.get \
+                and env.src == st.dst:
+            si = env.seqn
+            if si >= st.plan.nsegs or si in st.got:
+                return
+            off, n = st.plan.segments[si]
+            self._land(st.addr, off, n, st.l_dtype, st.w_dtype, payload)
+            with self._mu:
+                st.got.add(si)
+                # progress resets the give-up clock: the timeout bound
+                # guards ABANDONED transfers, not slow/large ones (a
+                # throttled-link get must not die of its own duration)
+                st.tries = 0
+                st.deadline = time.monotonic() + self._rto(0)
+            self._maybe_finish_get(st)
+            return
+        self._count("rma_orphan_frames_total")
+
+    def _land(self, base: int, elem_off: int, n: int, u_dt, w_dt, payload):
+        """Decode a wire segment and write it at its landing offset —
+        directly into registered memory, no intermediate buffering."""
+        arr = np.frombuffer(payload, dtype=w_dt, count=n)
+        self.mem.write(base + elem_off * u_dt.itemsize,
+                       np.ascontiguousarray(arr.astype(u_dt, copy=False)))
+
+    def _on_done(self, env: Envelope, ctl):
+        key = (env.src, ctl["xfer"])
+        with self._mu:
+            rx = self._rx.get(key)
+            st = self._tx.get(ctl["xfer"]) if rx is None else None
+            memo = self._done_memo.get(key) if rx is None else None
+        if rx is not None:
+            missing = [i for i in range(rx.nsegs) if i not in rx.got]
+            if missing:
+                self._ctl(env.src, env.comm_id, ctl["xfer"],
+                          P.pack_rma_ctl(P.RMA_NACK, ctl["xfer"],
+                                         extra=missing))
+                return
+            with self._mu:
+                self._rx.pop(key, None)
+                self._memo_done(key, 0)
+            self._count("rma_bytes_total",
+                        rx.count * rx.u_dtype.itemsize)
+            self._fin(env.src, env.comm_id, ctl["xfer"], 0)
+            if TRACE.enabled:
+                TRACE.emit("rma_fin", rank=self.rank, seqn=ctl["xfer"],
+                           peer=env.src,
+                           nbytes=rx.count * rx.u_dtype.itemsize,
+                           tenant=rx.tenant)
+            return
+        if st is not None and st.kind == CCLOp.get:
+            st.done_seen = True
+            self._maybe_finish_get(st, nack_now=True)
+            return
+        if memo is not None:
+            # FIN was lost and the initiator re-DONEd: re-answer
+            self._fin(env.src, env.comm_id, ctl["xfer"], memo)
+
+    def _maybe_finish_get(self, st: _Tx, nack_now: bool = False):
+        missing = None
+        with self._mu:
+            if st.xfer not in self._tx:
+                return
+            if len(st.got) >= st.plan.nsegs:
+                self._tx.pop(st.xfer, None)
+            elif st.done_seen and nack_now:
+                missing = [i for i in range(st.plan.nsegs)
+                           if i not in st.got]
+            else:
+                return
+        if missing is not None:
+            try:
+                self._ctl(st.dst, st.comm_id, st.xfer, P.pack_rma_ctl(
+                    P.RMA_NACK, st.xfer, extra=missing))
+            except (RuntimeError, KeyError, OSError, ConnectionError):
+                pass
+            return
+        self._count("rma_bytes_total", st.count * st.u_dtype.itemsize)
+        self._fin(st.dst, st.comm_id, st.xfer, 0)  # releases _srv state
+        self._complete(st, 0)
+
+    def _on_fin(self, env: Envelope, ctl):
+        # a FIN addressed to a get-serve releases the serve state; one
+        # addressed to a put initiator completes the put
+        key = (env.src, ctl["xfer"])
+        with self._mu:
+            if key in self._srv:
+                del self._srv[key]
+                return
+            st = self._tx.pop(ctl["xfer"], None)
+        if st is None:
+            return
+        self._complete(st, ctl["err"])
+
+    def _complete(self, st: _Tx, err: int):
+        if err:
+            self._count("rma_window_errors_total" if err
+                        & int(ErrorCode.RMA_WINDOW_ERROR)
+                        else "rma_failed_total")
+        if TRACE.enabled:
+            t0_ns = time.monotonic_ns() - int(
+                (time.perf_counter() - st.t0) * 1e9)
+            TRACE.emit(st.kind.name, rank=self.rank, seqn=st.xfer,
+                       peer=st.dst, nbytes=st.count * st.u_dtype.itemsize,
+                       t_ns=t0_ns,
+                       dur_ns=int((time.perf_counter() - st.t0) * 1e9),
+                       tenant=st.tenant)
+        st.handle.complete(err)
+
+    def _on_nack(self, env: Envelope, missing):
+        with self._mu:
+            st = self._tx.get(env.tag)
+            sv = self._srv.get((env.src, env.tag)) if st is None else None
+        if st is not None and st.kind == CCLOp.put:
+            self._enqueue(("stream", st.xfer,
+                           [i for i in missing
+                            if i < st.plan.nsegs]))
+        elif sv is not None:
+            self._ensure_worker()
+            self._enqueue(("serve", (env.src, env.tag),
+                           [i for i in missing if i < sv.nsegs]))
+
+    # target side of an eager put: ride the rx pool, then land
+    def _on_eager(self, env: Envelope, ctl, payload):
+        key = (env.src, ctl["xfer"])
+        with self._mu:
+            memo = self._done_memo.get(key)
+        if memo is not None:
+            # the FIN was lost and the initiator retried: re-answer from
+            # the memo instead of re-running the pool ingest (which
+            # would charge the tenant quota a second time — and rewrite
+            # a window region the application may have moved on from)
+            self._fin(env.src, env.comm_id, ctl["xfer"], memo)
+            return
+        try:
+            base, u_dt, w_dt = self._resolve_target(ctl)
+        except ACCLError as exc:
+            self._count("rma_window_errors_total")
+            self._fin(env.src, env.comm_id, ctl["xfer"], exc.error_word)
+            return
+        pool = self.pool_fn()
+        if pool is not None:
+            # The eager path's defining property: the payload claims a
+            # spare rx buffer first — charging the comm's tenant quota,
+            # obeying the oversize latch, backpressuring when the pool
+            # is full — exactly like an eager-ingress collective
+            # message, then is consumed straight back out and landed.
+            # (Rendezvous transfers, by contrast, never touch the pool.)
+            syn = Envelope(src=env.src, dst=env.dst, tag=ctl["xfer"],
+                           seqn=_POOL_SEQ_BASE | (ctl["xfer"] & 0xFFFFFF),
+                           nbytes=P.payload_nbytes(payload),
+                           wire_dtype=w_dt.name, strm=0,
+                           comm_id=env.comm_id)
+            err = pool.ingest(syn, payload, timeout=self.timeout_fn())
+            if err:
+                self._count("rma_eager_rejected_total")
+                if err & int(ErrorCode.DMA_SIZE_ERROR):
+                    # oversize for THIS target's buffers: retrying the
+                    # same frame cannot help — fail the put typed
+                    self._fin(env.src, env.comm_id, ctl["xfer"], err)
+                return  # overflow/quota: unFINed — the sender retries
+            got = pool.seek(syn.src, syn.tag, syn.seqn,
+                            timeout=self.timeout_fn(),
+                            comm_id=syn.comm_id)
+            if got is None:  # claimed by a duplicate's seek: that
+                return       # duplicate lands and FINs for both
+            payload = got[1]
+        self._land(base, 0, ctl["count"], u_dt, w_dt, payload)
+        self._count("rma_bytes_total", ctl["count"] * u_dt.itemsize)
+        with self._mu:
+            self._memo_done(key, 0)
+        self._fin(env.src, env.comm_id, ctl["xfer"], 0)

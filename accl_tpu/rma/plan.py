@@ -1,0 +1,88 @@
+"""Pure transfer planning for one-sided put/get.
+
+The delivery-path decision (eager vs rendezvous) and the rendezvous
+segmentation live here as pure functions of the call shape, so the
+initiator's emission plan and the target's landing arithmetic can never
+disagree: the RTS/GET control frame carries ``(count, nsegs)`` and BOTH
+sides derive segment boundaries from them alone
+(:func:`segment_bounds`). ``scripts/check_blocking.py`` check 6 replays a
+corpus of these plans — full coverage, disjointness, in-order segment
+indices, sender/receiver boundary agreement — the same way it replays
+move programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from ..constants import DEFAULT_RMA_EAGER_MAX
+
+EAGER = "eager"
+RENDEZVOUS = "rendezvous"
+
+
+def eager_max_from_env() -> int:
+    return max(0, int(os.environ.get("ACCL_TPU_RMA_EAGER_MAX",
+                                     DEFAULT_RMA_EAGER_MAX)))
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferPlan:
+    """One put/get transfer, fully determined by the call shape."""
+
+    kind: str                               # EAGER | RENDEZVOUS
+    count: int                              # elements
+    elem_bytes: int                         # in-window element size
+    wire_elem_bytes: int                    # on-the-wire element size
+    segments: tuple[tuple[int, int], ...]   # (elem_off, elems) per segment
+
+    @property
+    def nsegs(self) -> int:
+        return len(self.segments)
+
+    @property
+    def nbytes(self) -> int:
+        return self.count * self.elem_bytes
+
+    @property
+    def wire_bytes(self) -> int:
+        return self.count * self.wire_elem_bytes
+
+
+def segment_bounds(count: int, nsegs: int) -> tuple[tuple[int, int], ...]:
+    """Uniform segmentation shared by initiator and target: given only
+    the RTS/GET fields ``(count, nsegs)``, segment ``i`` covers elements
+    ``[i*seg, min(count, (i+1)*seg))`` with ``seg = ceil(count/nsegs)``.
+    The one copy of the landing arithmetic — a target must never guess
+    boundaries from its own segment-size config, which may differ from
+    the initiator's."""
+    if count <= 0 or nsegs <= 0:
+        return ()
+    seg = -(-count // nsegs)
+    out = []
+    off = 0
+    while off < count:
+        n = min(seg, count - off)
+        out.append((off, n))
+        off += n
+    return tuple(out)
+
+
+def plan_transfer(count: int, elem_bytes: int, wire_elem_bytes: int,
+                  max_segment_size: int,
+                  eager_max: int | None = None) -> TransferPlan:
+    """Plan one transfer: eager when the whole wire payload fits the
+    eager threshold (one frame, rides the rx pool), rendezvous otherwise
+    (segments of at most ``max_segment_size`` wire bytes, streamed
+    directly into the window)."""
+    if eager_max is None:
+        eager_max = eager_max_from_env()
+    wire_bytes = count * wire_elem_bytes
+    if wire_bytes <= eager_max:
+        return TransferPlan(EAGER, count, elem_bytes, wire_elem_bytes,
+                            ((0, count),) if count else ())
+    seg_elems = max(1, max_segment_size // max(1, wire_elem_bytes))
+    nsegs = -(-count // seg_elems)
+    return TransferPlan(RENDEZVOUS, count, elem_bytes, wire_elem_bytes,
+                        segment_bounds(count, nsegs))

@@ -1,0 +1,71 @@
+"""Registered memory windows — the address namespace of one-sided ops.
+
+A window is a ``(window_id -> [addr, addr+nbytes))`` registration on ONE
+rank; a peer's put/get names ``(target_rank, window_id, byte offset)``
+and the target resolves it locally. Ids are exchanged at configure time
+by the application — the driver's :meth:`~accl_tpu.accl.ACCL.
+register_window` hands them out from a per-driver counter, so symmetric
+registration order yields agreeing ids without a handshake (the same
+determinism contract ``split_communicator`` uses for comm ids).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from ..constants import ACCLError, ErrorCode
+
+
+@dataclasses.dataclass(frozen=True)
+class Window:
+    wid: int
+    addr: int
+    nbytes: int
+
+
+class WindowRegistry:
+    """Per-rank window table. Registration happens at configure time from
+    the host; resolution happens on ingress threads for every RTS/GET —
+    a lock-guarded dict keeps both safe."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._windows: dict[int, Window] = {}
+
+    def register(self, wid: int, addr: int, nbytes: int):
+        if nbytes <= 0:
+            raise ValueError(f"window {wid}: nbytes must be positive, "
+                             f"got {nbytes}")
+        with self._mu:
+            self._windows[int(wid)] = Window(int(wid), int(addr),
+                                             int(nbytes))
+
+    def deregister(self, wid: int):
+        with self._mu:
+            self._windows.pop(int(wid), None)
+
+    def get(self, wid: int) -> Window | None:
+        with self._mu:
+            return self._windows.get(int(wid))
+
+    def resolve(self, wid: int, offset: int, nbytes: int) -> int:
+        """Byte address of ``[offset, offset+nbytes)`` inside window
+        ``wid``; raises the typed window error when the id is unknown or
+        the range falls outside the registration — the failure an RTS/GET
+        handler FINs back to the initiator."""
+        with self._mu:
+            w = self._windows.get(int(wid))
+        if w is None:
+            raise ACCLError(int(ErrorCode.RMA_WINDOW_ERROR),
+                            f"window {wid} not registered")
+        if offset < 0 or offset + nbytes > w.nbytes:
+            raise ACCLError(
+                int(ErrorCode.RMA_WINDOW_ERROR),
+                f"range [{offset}, +{nbytes}) outside window {wid} "
+                f"({w.nbytes} B)")
+        return w.addr + int(offset)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._windows)

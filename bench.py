@@ -91,6 +91,15 @@ def bench_emu_fallback(reason: str) -> dict:
         # armed (make bench-emu), keeping ungated runs fast
         from benchmarks.saturation import headline as sat_headline
         result.update(sat_headline())
+    if os.environ.get("ACCL_BENCH_MAX_DECODE_P99_MS"):
+        # disaggregated prefill/decode serving ladder (~20s): one-sided
+        # rendezvous KV puts under latency-gated decode collectives —
+        # only when its gate is armed (make bench-emu), same
+        # keep-ungated-runs-fast rule as the other ladders
+        from benchmarks.serving import SERVING_KEYS, headline as srv
+        sv = srv()
+        for k in SERVING_KEYS:
+            result[k] = sv[k]
     if os.environ.get("ACCL_BENCH_MIN_CHAOS_GOODPUT"):
         # goodput-under-loss ladder (~2s): seeded 1% chaos vs clean
         # through the retransmission layer, gated when armed (make
@@ -107,7 +116,15 @@ def check_stream_ratio(result: dict) -> int:
     """Regression gate for the segment-streamed dataplane: with
     $ACCL_BENCH_MIN_STREAM_RATIO set (make bench-emu sets 1.2), the
     streamed-vs-window ratio must clear it. Returns a process exit code
-    so the JSON line is always printed first."""
+    so the JSON line is always printed first.
+
+    Both sides of this ratio ride LocalFabric.send, so its per-frame
+    cost is part of what the gate measures. PR-9's reliability layer
+    added ~8%/frame there; PR 11 hoisted the fault/profile/trace
+    branches out of the clean path (one _slow flag + per-comm dict hit,
+    fused accept only when retx is armed): 64B frames measured
+    1.69us -> 1.20us/frame with retx armed and 0.87us -> 0.50us with
+    retx off on the 2-core CI host."""
     want = os.environ.get("ACCL_BENCH_MIN_STREAM_RATIO")
     if not want or "vs_window" not in result:
         return 0
@@ -242,6 +259,51 @@ def check_saturation(result: dict) -> int:
     fails = _saturation_failures(result)
     for f in fails:
         print(f"FAIL: saturation: {f}", file=sys.stderr)
+    return 1 if fails else 0
+
+
+def _serving_failures(result: dict) -> list[str]:
+    """The disaggregated-serving gates, evaluated together (armed by
+    $ACCL_BENCH_MAX_DECODE_P99_MS; make bench-emu sets 75):
+
+    * decode-step p99 under the prefill storm <= max(the gate,
+      solo p99 + $ACCL_BENCH_P99_FLOOR_US) — decode on a preempt lane
+      must not regress vs solo by more than the documented OS-noise
+      floor of the saturated 2-core host (benchmarks/saturation.py;
+      measured ~8 ms storm p99 vs ~4 ms solo — the regression class
+      this guards is a KV push consuming the rx pool or admission lanes
+      decode depends on, which measures in the hundreds of ms);
+    * aggregate landed KV bytes/s >= $ACCL_BENCH_MIN_KV_GBPS (measured
+      ~0.5 GB/s on the 2-core host; gate 0.05 leaves shared-host room).
+    """
+    fails: list[str] = []
+    want = os.environ.get("ACCL_BENCH_MAX_DECODE_P99_MS")
+    if not want or "decode_p99_storm_ms" not in result:
+        return fails
+    floor_ms = float(os.environ.get("ACCL_BENCH_P99_FLOOR_US",
+                                    "50000")) / 1e3
+    allowed = max(float(want),
+                  result.get("decode_p99_solo_ms", 0) + floor_ms)
+    if result["decode_p99_storm_ms"] > allowed:
+        fails.append(
+            f"decode-step p99 under prefill storm "
+            f"{result['decode_p99_storm_ms']}ms > allowed "
+            f"{round(allowed, 1)}ms (max(gate {want}ms, solo "
+            f"{result.get('decode_p99_solo_ms')}ms + {floor_ms}ms "
+            f"OS-noise floor))")
+    kv_want = os.environ.get("ACCL_BENCH_MIN_KV_GBPS")
+    if kv_want and result.get("serving_kv_gbps", 0) < float(kv_want):
+        fails.append(f"aggregate KV throughput "
+                     f"{result.get('serving_kv_gbps')} GB/s < required "
+                     f"{kv_want}")
+    return fails
+
+
+def check_serving(result: dict) -> int:
+    """Regression gate for the one-sided serving dataplane."""
+    fails = _serving_failures(result)
+    for f in fails:
+        print(f"FAIL: serving: {f}", file=sys.stderr)
     return 1 if fails else 0
 
 
@@ -557,6 +619,26 @@ def main():
                     result[k] = retry_sat[k]
             result["saturation_retry"] = \
                 result.get("saturation_retry", 0) + 1
+        for _ in range(_GATE_RETRIES):
+            # best-of-three for the serving gates too: only its ladder
+            # re-runs, each sub-metric keeps its best observation (a
+            # genuine rendezvous/pool regression fails every attempt)
+            if not _serving_failures(result):
+                break
+            from benchmarks.serving import SERVING_KEYS, \
+                headline as srv_headline
+            retry_sv = srv_headline()
+            if retry_sv.get("decode_p99_storm_ms", float("inf")) < \
+                    result.get("decode_p99_storm_ms", float("inf")):
+                for k in ("decode_p99_storm_ms", "decode_p50_storm_ms",
+                          "decode_p99_solo_ms", "decode_p50_solo_ms"):
+                    result[k] = retry_sv[k]
+            if retry_sv.get("serving_kv_gbps", 0) > \
+                    result.get("serving_kv_gbps", 0):
+                for k in ("serving_kv_gbps", "serving_kv_blocks",
+                          "serving_jain"):
+                    result[k] = retry_sv[k]
+            result["serving_retry"] = result.get("serving_retry", 0) + 1
         chaos_want = os.environ.get("ACCL_BENCH_MIN_CHAOS_GOODPUT")
         for _ in range(_GATE_RETRIES):
             # best-of-three for the chaos-goodput gate too: only its
@@ -586,6 +668,7 @@ def main():
                  or check_plancache_ratio(result)
                  or check_hier_ratio(result)
                  or check_saturation(result)
+                 or check_serving(result)
                  or check_chaos_goodput(result)
                  or check_fabric_clean(result))
     if not _probe_backend():

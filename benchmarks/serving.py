@@ -1,0 +1,188 @@
+"""Disaggregated prefill/decode serving ladder — the one-sided RMA
+subsystem's request-level benchmark (ROADMAP item 5, ACCL+'s "collective
+engine for distributed applications" end-state).
+
+The modeled serving pattern: PREFILL ranks stream per-request KV-cache
+blocks into DECODE ranks' registered windows with one-sided rendezvous
+puts (accl_tpu/rma — payload segments land directly in the window,
+never consuming the rx-buffer pool), while the decode side runs
+latency-critical small collectives every step on a ``preempt`` service
+lane (accl_tpu/service). What the ladder measures:
+
+* **decode-step p99, solo vs under a prefill storm** — the whole point
+  of the rendezvous path: a multi-MiB/s KV push must not starve the rx
+  pool (or the admission lanes) that decode's 4 KiB collectives depend
+  on. Gate: storm p99 <= max($ACCL_BENCH_MAX_DECODE_P99_MS,
+  solo p99 + $ACCL_BENCH_P99_FLOOR_US) — the floor is the documented
+  OS-noise ceiling of a fully saturated small host (see
+  benchmarks/saturation.py: even the solo leg's p99 swings 2-20 ms run
+  to run on the 2-core CI box, and the storm keeps every core busy).
+* **aggregate KV bytes/s** landed in decode windows (completed-put
+  accounting — a put counts only once the target FINs). Gate:
+  ``$ACCL_BENCH_MIN_KV_GBPS``.
+* **Jain fairness** across the prefill tenants' landed-byte rates.
+* a **bit-identity spot check**: the last block each prefill stream
+  landed is compared against its source (direct-copy oracle).
+
+Run directly (``python -m benchmarks.serving``) for one JSON line;
+``headline()`` feeds the same payload into bench.py's emu-tier line,
+gated in ``make bench-emu`` with best-of-three retries.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from accl_tpu.service import ServiceConfig
+from accl_tpu.testing import add_tenant, emu_world, run_ranks
+
+from .saturation import jain_index
+
+# window ids pinned explicitly (both prefill tenants register on every
+# rank, so counter-assigned ids would collide on shared devices)
+_WIN_A, _WIN_B = 101, 102
+
+
+def _percentile(xs, q):
+    return float(np.percentile(np.asarray(xs, dtype=np.float64), q))
+
+
+def _decode_steps(decode_accls, count: int, steps: int) -> list[float]:
+    """``steps`` sync small allreduces on every rank; rank-0 latencies."""
+    bufs = []
+    for a in decode_accls:
+        src = a.buffer(data=np.full(count, 1.0, np.float32))
+        bufs.append((src, a.buffer((count,), np.float32)))
+    lats: list[float] = []
+
+    def body(a):
+        src, dst = bufs[a.rank]
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            a.allreduce(src, dst, count)
+            if a.rank == 0:
+                lats.append(time.perf_counter() - t0)
+
+    run_ranks(decode_accls, body, timeout=240.0)
+    return lats
+
+
+def measure_serving(world: int = 4, block_elems: int = 64 << 10,
+                    decode_nbytes: int = 4 << 10, steps: int = 150,
+                    depth: int = 2) -> dict:
+    """One serving cell: ranks 0/1 are prefill (tenants A/B), ranks 2/3
+    decode. Prefill rank r streams ``block_elems``-float KV blocks into
+    rank (r+2)'s window while every rank participates in the decode
+    tenant's small allreduce steps."""
+    svc = ServiceConfig(enabled=True)
+    svc.tenant("decode", preempt=True, rx_buffers=4)
+    decode = emu_world(world, service=svc, tenant="decode", nbufs=24,
+                       timeout=60.0)
+    prefills = [add_tenant(decode, "prefillA", key=11, timeout=60.0),
+                add_tenant(decode, "prefillB", key=12, timeout=60.0)]
+    wins = [_WIN_A, _WIN_B]
+    streams = [(0, 2), (1, 3)]          # (prefill rank, decode rank)
+    try:
+        # per-request KV block buffers + decode-side windows (every rank
+        # registers so window ids agree; only the decode ranks' windows
+        # receive traffic). Window holds `depth + 1` block slots so
+        # pipelined puts land disjointly.
+        slots = depth + 1
+        win_bufs = []
+        for ti, tset in enumerate(prefills):
+            per = []
+            for a in tset:
+                wb = a.buffer((slots * block_elems,), np.float32)
+                a.register_window(wb, window=wins[ti])
+                per.append(wb)
+            win_bufs.append(per)
+        rng = np.random.default_rng(7)
+        blocks = [rng.standard_normal(block_elems).astype(np.float32)
+                  for _ in range(4)]
+
+        count = decode_nbytes // 4
+        solo = _decode_steps(decode, count, steps)
+
+        stop = threading.Event()
+        landed = [0, 0]                  # bytes per prefill tenant
+        errs: list[BaseException] = []
+
+        def prefill(ti: int):
+            src_rank, dst_rank = streams[ti]
+            a = prefills[ti][src_rank]
+            srcs = [a.buffer(data=b) for b in blocks]
+            block_bytes = block_elems * 4
+            slot = 0
+            inflight = []
+            try:
+                while not stop.is_set():
+                    h = a.put(srcs[slot % len(srcs)], block_elems,
+                              dst=dst_rank, window=wins[ti],
+                              offset=(slot % slots) * block_bytes,
+                              run_async=True)
+                    inflight.append(h)
+                    slot += 1
+                    while len(inflight) >= depth:
+                        inflight.pop(0).wait(60.0)
+                        landed[ti] += block_bytes
+                for h in inflight:
+                    h.wait(60.0)
+                    landed[ti] += block_bytes
+                # bit-identity spot check vs the direct-copy oracle
+                last = slot - 1
+                got = win_bufs[ti][dst_rank].data[
+                    (last % slots) * block_elems:
+                    (last % slots + 1) * block_elems]
+                if not np.array_equal(got, blocks[last % len(blocks)]):
+                    raise AssertionError(
+                        f"prefill stream {ti}: landed block differs "
+                        f"from its source")
+            except BaseException as exc:  # noqa: BLE001 — surfaced below
+                errs.append(exc)
+
+        threads = [threading.Thread(target=prefill, args=(ti,))
+                   for ti in range(len(prefills))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(0.2)                  # storm in flight
+        storm = _decode_steps(decode, count, steps)
+        stop.set()
+        for t in threads:
+            t.join(240.0)
+        storm_s = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+    finally:
+        for a in decode:
+            a.device.deinit()
+    total = sum(landed)
+    return {
+        "serving_world": world,
+        "serving_block_kib": block_elems * 4 >> 10,
+        "decode_p99_solo_ms": round(_percentile(solo, 99) * 1e3, 2),
+        "decode_p50_solo_ms": round(_percentile(solo, 50) * 1e3, 2),
+        "decode_p99_storm_ms": round(_percentile(storm, 99) * 1e3, 2),
+        "decode_p50_storm_ms": round(_percentile(storm, 50) * 1e3, 2),
+        "serving_kv_gbps": round(total / storm_s / 1e9, 4),
+        "serving_kv_blocks": total // (block_elems * 4),
+        "serving_jain": round(jain_index(landed), 3),
+    }
+
+
+SERVING_KEYS = ("serving_world", "serving_block_kib",
+                "decode_p99_solo_ms", "decode_p50_solo_ms",
+                "decode_p99_storm_ms", "decode_p50_storm_ms",
+                "serving_kv_gbps", "serving_kv_blocks", "serving_jain")
+
+
+def headline() -> dict:
+    return measure_serving()
+
+
+if __name__ == "__main__":
+    print(json.dumps(headline()))

@@ -1,0 +1,105 @@
+"""Disaggregated prefill/decode serving with one-sided KV-cache puts.
+
+The inference-serving pattern (ROADMAP item 5): PREFILL ranks compute a
+request's KV cache once, then stream it into the DECODE rank's
+registered window with one-sided rendezvous puts — no matching recv is
+posted, and (the accl_tpu/rma invariant) no rx-pool buffer is consumed,
+so the decode rank's latency-critical small collectives keep their
+spare buffers while multi-MiB KV blocks land. Decode rides a
+``preempt`` service lane (accl_tpu/service) so its steps also jump the
+admission queue.
+
+Run:  python examples/09_disaggregated_serving.py
+(in-process emulator tier — no TPU, no daemons needed.)
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accl_tpu.service import ServiceConfig
+from accl_tpu.testing import add_tenant, emu_world, run_ranks
+
+KV_BLOCK = 64 << 10       # f32 elements per request's KV block (256 KiB)
+REQUESTS = 16
+DECODE_STEPS = 40
+WIN = 7
+
+
+def main():
+    # ranks 0..1 = prefill, ranks 2..3 = decode
+    svc = ServiceConfig(enabled=True)
+    svc.tenant("decode", preempt=True, rx_buffers=4)
+    decode = emu_world(4, service=svc, tenant="decode", nbufs=24)
+    prefill = add_tenant(decode, "prefill", key=3)
+
+    # decode ranks expose a KV window; every rank registers so ids agree
+    win_bufs = [a.buffer((REQUESTS * KV_BLOCK,), np.float32)
+                for a in prefill]
+    for a, wb in zip(prefill, win_bufs):
+        a.register_window(wb, window=WIN)
+
+    rng = np.random.default_rng(0)
+    kv = [rng.standard_normal(KV_BLOCK).astype(np.float32)
+          for _ in range(REQUESTS)]
+
+    def prefill_stream(src_rank: int, dst_rank: int):
+        """One prefill rank pushes its half of the requests."""
+        a = prefill[src_rank]
+        handles = []
+        for req in range(src_rank, REQUESTS, 2):
+            src = a.buffer(data=kv[req])
+            handles.append(a.put(src, KV_BLOCK, dst=dst_rank, window=WIN,
+                                 offset=req * KV_BLOCK * 4,
+                                 run_async=True))
+        for h in handles:
+            h.wait(60.0)
+
+    def decode_loop(a):
+        """Every rank joins the decode tenant's small per-step
+        collective (the latency-critical path)."""
+        src = a.buffer(data=np.full(1024, 1.0, np.float32))
+        dst = a.buffer((1024,), np.float32)
+        lats = []
+        for _ in range(DECODE_STEPS):
+            t0 = time.perf_counter()
+            a.allreduce(src, dst, 1024)
+            lats.append(time.perf_counter() - t0)
+        return lats
+
+    import threading
+    threads = [threading.Thread(target=prefill_stream, args=(r, r + 2))
+               for r in (0, 1)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    lat = run_ranks(decode, decode_loop)[0]
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    # every request's KV block landed bit-identically, split across the
+    # two decode ranks' windows
+    for req in range(REQUESTS):
+        dst_rank = 2 + req % 2
+        got = win_bufs[dst_rank].data[req * KV_BLOCK:(req + 1) * KV_BLOCK]
+        assert np.array_equal(got, kv[req]), f"request {req} KV mismatch"
+
+    kv_bytes = REQUESTS * KV_BLOCK * 4
+    print(f"{REQUESTS} KV blocks ({kv_bytes >> 20} MiB) landed in "
+          f"{wall * 1e3:.0f} ms ({kv_bytes / wall / 1e9:.2f} GB/s) while "
+          f"decode stepped at p50 "
+          f"{sorted(lat)[len(lat) // 2] * 1e3:.2f} ms")
+    print(f"decode-rank rx-pool high-water mark during the storm: "
+          f"{decode[2].device.pool.hwm} buffers "
+          f"(rendezvous puts never touch the pool)")
+    for a in decode:
+        a.device.deinit()
+
+
+if __name__ == "__main__":
+    main()

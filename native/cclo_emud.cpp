@@ -2066,6 +2066,13 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
         reply.push_back(eth_->is_udp() ? 1 : 0);
       }
       put_le<uint32_t>(reply, profiled_calls_);
+      // capability word (keep in sync with protocol.py CAP_*): this
+      // daemon has NO retransmission ACK responder (bit 0 clear — the
+      // Python daemons probe exactly this at configure time and pin
+      // their retx window to 0 for mixed worlds) and no one-sided RMA
+      // engine (bit 1 clear — RMA strm lanes are ignored like any
+      // strm >= 2 control frame)
+      put_le<uint32_t>(reply, 0);
       return reply;
     }
     case MSG_STREAM_PUSH: {

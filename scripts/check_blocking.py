@@ -485,11 +485,81 @@ def check_redistribute_programs() -> list[str]:
     return errors
 
 
+def check_rendezvous_programs() -> list[str]:
+    """Check 6: one-sided transfer plans (accl_tpu/rma/plan.py). For a
+    corpus of (count, elem/wire sizes, segment size, eager threshold)
+    shapes — including uneven tails, compressed wire dtypes and
+    byte-offset landings — replay the initiator's plan and the target's
+    independent derivation (``segment_bounds`` from the RTS/GET fields
+    alone) and verify:
+    * segments PARTITION [0, count): full coverage, no overlap, ascending
+      in-order segment indices (the landing offset arithmetic both sides
+      run is a pure function of (count, nsegs));
+    * every segment's wire payload fits the initiator's segment size;
+    * the eager/rendezvous decision is consistent: eager plans are ONE
+      frame at or under the threshold, rendezvous plans exceed it;
+    * landing byte intervals at an uneven window offset stay disjoint
+      and cover exactly [offset, offset + count*elem_bytes).
+    """
+    from accl_tpu.rma.plan import (EAGER, plan_transfer, segment_bounds)
+
+    errors = []
+    corpus = []
+    for count in (1, 7, 100, 4096, 4097, 65536, 131071, 1 << 20,
+                  (1 << 20) + 3):
+        for elem, wire in ((4, 4), (4, 2), (8, 8), (2, 1)):
+            for seg in (4096, 65536, 1 << 20):
+                corpus.append((count, elem, wire, seg, 16 << 10))
+    corpus.append((5, 4, 4, 4096, 0))          # zero eager threshold
+    corpus.append((0, 4, 4, 4096, 16 << 10))   # empty transfer
+    for count, elem, wire, seg, eager_max in corpus:
+        tag = (f"rma plan(count={count}, elem={elem}, wire={wire}, "
+               f"seg={seg}, eager_max={eager_max})")
+        plan = plan_transfer(count, elem, wire, seg, eager_max)
+        if plan.kind == EAGER:
+            if plan.wire_bytes > eager_max:
+                errors.append(f"{tag}: eager above threshold")
+            if count and plan.nsegs != 1:
+                errors.append(f"{tag}: eager must be one frame")
+        elif plan.wire_bytes <= eager_max:
+            errors.append(f"{tag}: rendezvous at/under eager threshold")
+        # target-side independent derivation from the wire fields only
+        if segment_bounds(count, plan.nsegs) != plan.segments:
+            errors.append(f"{tag}: target derivation disagrees with the "
+                          f"initiator's plan")
+        covered = 0
+        for i, (off, n) in enumerate(plan.segments):
+            if off != covered or n <= 0:
+                errors.append(f"{tag}: segment {i} at {off} breaks the "
+                              f"partition (expected {covered})")
+                break
+            if plan.kind != EAGER and n * wire > seg:
+                errors.append(f"{tag}: segment {i} wire bytes "
+                              f"{n * wire} exceed segment size {seg}")
+            covered += n
+        if covered != count:
+            errors.append(f"{tag}: segments cover {covered} of {count}")
+        # landing intervals at an uneven byte offset
+        for offset in (0, 12):
+            ivals = sorted((offset + off * elem, offset + (off + n) * elem)
+                           for off, n in plan.segments)
+            for (a0, a1), (b0, _b1) in zip(ivals, ivals[1:]):
+                if a1 != b0:
+                    errors.append(f"{tag}: landing gap/overlap at "
+                                  f"offset {offset}")
+                    break
+            if ivals and (ivals[0][0] != offset
+                          or ivals[-1][1] != offset + count * elem):
+                errors.append(f"{tag}: landing span wrong at {offset}")
+    return errors
+
+
 def main() -> int:
     errors = check_blocking_citations()
     errors += check_lane_graph()
     errors += check_hier_programs()
     errors += check_redistribute_programs()
+    errors += check_rendezvous_programs()
     for e in errors:
         print(e, file=sys.stderr)
     if errors:
@@ -498,7 +568,7 @@ def main() -> int:
         return 1
     print("check_blocking: OK (blocking=False citations + lane graph + "
           "byte-interval hazards + relocated compiled plans + "
-          "hierarchical/redistribute programs)")
+          "hierarchical/redistribute programs + rendezvous plans)")
     return 0
 
 

@@ -16,6 +16,7 @@ QUICK = [
     ("03_native_daemons.py", "done."),
     ("04_streams_and_compression.py", "OK"),
     ("08_chained_calls.py", "chain OK"),
+    ("09_disaggregated_serving.py", "KV blocks"),
 ]
 
 

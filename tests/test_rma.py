@@ -1,0 +1,660 @@
+"""One-sided RMA subsystem (accl_tpu/rma): windows, put/get, rendezvous.
+
+Covers the PR-11 acceptance criteria:
+
+* bit-identity to a direct-copy oracle across W in {2, 4, 8}, uneven
+  byte offsets, and eth-compressed variants (f16-representable corpus,
+  so compression is lossless and the comparison stays exact);
+* the rx-pool invariant: a rendezvous (large) transfer NEVER claims a
+  pool buffer — occupancy counters stay at zero while a multi-MiB put
+  is in flight — while the eager path demonstrably rides the pool
+  (occupancy observed, tenant quota charged);
+* rendezvous under the seeded FaultPlan: drop/duplicate/delay the
+  RTS/CTS control frames and mid-stream payload segments; bit-identical
+  landing and zero pool occupancy throughout;
+* completion as ordinary async handles (waitfor chaining);
+* per-op driver attribution: put/get CallRecords (tenant + CSV round
+  trip), accl_calls_total rows, flight-recorder events;
+* the daemon tier (socket protocol, MSG_REG_WINDOW) on both stacks;
+* configure-time native-peer detection pinning the retx window to 0.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu.chaos import FaultPlan, FaultRule
+from accl_tpu.constants import ACCLError, ErrorCode
+from accl_tpu.emulator import protocol as P
+from accl_tpu.rma import (EAGER, RENDEZVOUS, WindowRegistry, plan_transfer,
+                          segment_bounds)
+from accl_tpu.testing import emu_world, run_ranks, sim_world
+
+WIN = 1
+
+
+def _world(w=2, win_elems=1 << 18, **kw):
+    accls = emu_world(w, timeout=15.0, **kw)
+    for a in accls:
+        a._win_buf = a.buffer((win_elems,), np.float32)
+        assert a.register_window(a._win_buf) == WIN
+    return accls
+
+
+def _teardown(accls):
+    for a in accls:
+        a.device.deinit()
+
+
+def _payload(n, seed=0):
+    return np.random.default_rng(seed).standard_normal(n).astype(
+        np.float32)
+
+
+def _f16_payload(n):
+    """f16-representable values: the eth-compressed round trip is then
+    lossless, keeping the oracle comparison exact."""
+    return ((np.arange(n) % 251) / 8.0).astype(np.float32)
+
+
+# -- pure plan ---------------------------------------------------------------
+
+def test_plan_eager_vs_rendezvous_threshold():
+    p = plan_transfer(100, 4, 4, 1 << 16, eager_max=400)
+    assert p.kind == EAGER and p.nsegs == 1
+    p = plan_transfer(101, 4, 4, 1 << 16, eager_max=400)
+    assert p.kind == RENDEZVOUS
+    # compressed wire bytes decide, not in-memory bytes
+    p = plan_transfer(200, 4, 2, 1 << 16, eager_max=400)
+    assert p.kind == EAGER
+
+
+def test_plan_partition_and_target_derivation():
+    for count in (1, 7, 4097, 100000):
+        p = plan_transfer(count, 4, 4, 4096, eager_max=0)
+        assert segment_bounds(count, p.nsegs) == p.segments
+        covered = 0
+        for off, n in p.segments:
+            assert off == covered and n > 0 and n * 4 <= 4096
+            covered += n
+        assert covered == count
+
+
+def test_window_registry_resolve_and_errors():
+    reg = WindowRegistry()
+    reg.register(3, 0x1000, 256)
+    assert reg.resolve(3, 0, 256) == 0x1000
+    assert reg.resolve(3, 16, 240) == 0x1010
+    with pytest.raises(ACCLError):
+        reg.resolve(3, 16, 256)          # range overflow
+    with pytest.raises(ACCLError):
+        reg.resolve(9, 0, 1)             # unknown window
+    reg.deregister(3)
+    with pytest.raises(ACCLError):
+        reg.resolve(3, 0, 1)
+
+
+# -- eager path --------------------------------------------------------------
+
+def test_eager_put_rides_rx_pool():
+    accls = _world(2)
+    try:
+        pool = accls[1].device.pool
+        assert pool.hwm == 0
+        src = accls[0].buffer(data=_payload(256, 1))
+        accls[0].put(src, 256, dst=1, window=WIN)
+        assert np.array_equal(accls[1]._win_buf.data[:256], src.data)
+        # the eager frame claimed (and released) a pool buffer
+        assert pool.hwm >= 1
+        assert pool.occupancy() == 0
+    finally:
+        _teardown(accls)
+
+
+def test_eager_put_charges_tenant_quota():
+    from accl_tpu.service import QuotaManager
+    accls = _world(2)
+    try:
+        pool = accls[1].device.pool
+        quota = QuotaManager(1, {"elsewhere": 1})  # zero for everyone else
+        pool.quota = quota
+        eng = accls[0].device.rma
+        eng.rto_s, eng.max_tries = 0.02, 2  # fast give-up for the test
+        src = accls[0].buffer(data=_payload(64, 2))
+        with pytest.raises(ACCLError):
+            accls[0].put(src, 64, dst=1, window=WIN)
+        assert quota.stats()["rejections"]
+    finally:
+        pool.quota = None
+        _teardown(accls)
+
+
+# -- rendezvous bit-identity + pool invariant --------------------------------
+
+@pytest.mark.parametrize("w", [2, 4, 8])
+def test_rendezvous_put_bit_identical(w):
+    accls = _world(w, win_elems=1 << 18)
+    try:
+        data = _payload(1 << 18, seed=w)  # 1 MiB
+        src = accls[0].buffer(data=data)
+        dst_rank = w - 1
+        pool = accls[dst_rank].device.pool
+        h = accls[0].put(src, 1 << 18, dst=dst_rank, window=WIN,
+                         run_async=True)
+        h.wait(30)
+        assert np.array_equal(accls[dst_rank]._win_buf.data, data)
+        # the invariant: no rendezvous byte ever claimed a pool buffer
+        assert pool.hwm == 0
+    finally:
+        _teardown(accls)
+
+
+def test_rendezvous_uneven_offsets_and_tail():
+    accls = _world(2, win_elems=1 << 18)
+    try:
+        n = (1 << 16) + 13                 # uneven element count
+        data = _payload(n, seed=5)
+        src = accls[0].buffer(data=data)
+        for off_elems in (1, 77, 1001):
+            accls[0].put(src, n, dst=1, window=WIN, offset=4 * off_elems)
+            got = accls[1]._win_buf.data[off_elems:off_elems + n]
+            assert np.array_equal(got, data)
+        assert accls[1].device.pool.hwm == 0
+    finally:
+        _teardown(accls)
+
+
+def test_put_compressed_wire_matches_oracle():
+    accls = _world(2, win_elems=1 << 17)
+    try:
+        n = 1 << 17
+        data = _f16_payload(n)
+        src = accls[0].buffer(data=data)
+        accls[0].put(src, n, dst=1, window=WIN,
+                     compress_dtype=np.float16)
+        oracle = data.astype(np.float16).astype(np.float32)
+        assert np.array_equal(accls[1]._win_buf.data, oracle)
+        assert accls[1].device.pool.hwm == 0
+    finally:
+        _teardown(accls)
+
+
+def test_compressed_local_operand_put_get():
+    """The local buffer stored in the COMPRESSED dtype (descriptor
+    OP0/RES_COMPRESSED): the engine must read/write it as f16, not
+    over-read it as the window's uncompressed dtype (review finding)."""
+    accls = _world(2, win_elems=1 << 15)
+    try:
+        n = 1 << 14
+        f16 = _f16_payload(n).astype(np.float16)
+        src = accls[0].buffer(data=f16)           # f16-STORED source
+        accls[0].put(src, n, dst=1, window=WIN,
+                     compress_dtype=np.float32)   # logical f32 window
+        assert np.array_equal(accls[1]._win_buf.data[:n],
+                              f16.astype(np.float32))
+        # and the reverse: a get landing into an f16-stored destination
+        dst = accls[0].buffer(data=np.zeros(n, np.float16))
+        accls[0].get(dst, n, src=1, window=WIN,
+                     compress_dtype=np.float32)
+        assert np.array_equal(dst.data, f16)
+        # eager-path twin (small payload, same flags)
+        small = accls[0].buffer(data=f16[:64].copy())
+        accls[0].put(small, 64, dst=1, window=WIN,
+                     offset=4 * (1 << 14), compress_dtype=np.float32)
+        assert np.array_equal(
+            accls[1]._win_buf.data[1 << 14:(1 << 14) + 64],
+            f16[:64].astype(np.float32))
+    finally:
+        _teardown(accls)
+
+
+def test_get_bit_identical_and_compressed():
+    accls = _world(2, win_elems=1 << 17)
+    try:
+        n = 1 << 17
+        data = _f16_payload(n)
+        accls[1]._win_buf.data[:] = data
+        dst = accls[0].buffer((n,), np.float32)
+        accls[0].get(dst, n, src=1, window=WIN)
+        assert np.array_equal(dst.data, data)
+        dst.data[:] = 0
+        accls[0].get(dst, n, src=1, window=WIN,
+                     compress_dtype=np.float16)
+        assert np.array_equal(
+            dst.data, data.astype(np.float16).astype(np.float32))
+        # gets stream directly into the destination buffer: no pool use
+        # at either end
+        assert accls[0].device.pool.hwm == 0
+        assert accls[1].device.pool.hwm == 0
+    finally:
+        _teardown(accls)
+
+
+def test_pool_occupancy_zero_while_rendezvous_in_flight():
+    """Sample occupancy DURING a throttled multi-MiB transfer, not just
+    after it: the slow-link profile keeps the stream in flight long
+    enough for the sampler to observe mid-transfer state."""
+    accls = _world(2, win_elems=1 << 19)
+    try:
+        fab = accls[0].device.ctx.fabric
+        fab.set_link_profile(0, 1, alpha_us=50.0, beta_gbps=0.05)
+        pool = accls[1].device.pool
+        samples = []
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                samples.append(pool.occupancy())
+                time.sleep(0.002)
+
+        th = threading.Thread(target=sampler)
+        th.start()
+        data = _payload(1 << 19, seed=9)   # 2 MiB
+        src = accls[0].buffer(data=data)
+        h = accls[0].put(src, 1 << 19, dst=1, window=WIN, run_async=True)
+        h.wait(60)
+        stop.set()
+        th.join(10)
+        assert np.array_equal(accls[1]._win_buf.data, data)
+        assert len(samples) > 5            # the transfer was observable
+        assert max(samples) == 0 and pool.hwm == 0
+    finally:
+        _teardown(accls)
+
+
+# -- async handles / chaining / errors ---------------------------------------
+
+def test_put_chains_behind_waitfor():
+    accls = _world(2, win_elems=1 << 16)
+    try:
+        a = accls[0]
+        first = a.buffer(data=np.full(1 << 15, 1.0, np.float32))
+        second = a.buffer(data=np.full(1 << 15, 2.0, np.float32))
+        h1 = a.put(first, 1 << 15, dst=1, window=WIN, run_async=True)
+        h2 = a.put(second, 1 << 15, dst=1, window=WIN,
+                   offset=4 * (1 << 15), run_async=True, waitfor=(h1,))
+        h2.wait(30)
+        h1.wait(30)
+        assert np.array_equal(accls[1]._win_buf.data[:1 << 15], first.data)
+        assert np.array_equal(accls[1]._win_buf.data[1 << 15:],
+                              second.data)
+    finally:
+        _teardown(accls)
+
+
+def test_window_errors_are_typed():
+    accls = _world(2, win_elems=1024)
+    try:
+        src = accls[0].buffer(data=_payload(512, 3))
+        with pytest.raises(ACCLError) as ei:
+            accls[0].put(src, 512, dst=1, window=99)
+        assert ErrorCode.RMA_WINDOW_ERROR in ei.value.errors
+        with pytest.raises(ACCLError) as ei:
+            accls[0].put(src, 512, dst=1, window=WIN, offset=4 * 600)
+        assert ErrorCode.RMA_WINDOW_ERROR in ei.value.errors
+        # deregistration makes later puts fail typed too
+        accls[1].deregister_window(WIN)
+        with pytest.raises(ACCLError) as ei:
+            accls[0].put(src, 512, dst=1, window=WIN)
+        assert ErrorCode.RMA_WINDOW_ERROR in ei.value.errors
+    finally:
+        _teardown(accls)
+
+
+def test_window_auto_ids_skip_pinned():
+    """An auto-assigned id must never silently steal an explicitly
+    pinned window (review finding)."""
+    accls = emu_world(1, timeout=10.0)
+    try:
+        a = accls[0]
+        pinned = a.buffer((64,), np.float32)
+        other = a.buffer((64,), np.float32)
+        assert a.register_window(pinned, window=1) == 1
+        assert a.register_window(other) == 2      # skipped the pinned 1
+        src = a.buffer(data=_payload(64, 13))
+        a.put(src, 64, dst=0, window=1)
+        assert np.array_equal(pinned.data, src.data)
+        assert not np.array_equal(other.data, src.data)
+    finally:
+        _teardown(accls)
+
+
+def test_unreachable_peer_gives_up_typed():
+    """A put whose every frame is dropped must complete TYPED
+    (RECEIVE_TIMEOUT_ERROR) after the give-up bound, never hang — the
+    mid-stream-failure path falls to the DONE/NACK machinery and the
+    retry tick owns the bound (review finding)."""
+    accls = _world(2, win_elems=1 << 15)
+    try:
+        eng = accls[0].device.rma
+        eng.rto_s, eng.max_tries = 0.02, 3
+        fab = accls[0].device.ctx.fabric
+        fab.inject_fault(FaultPlan.partition((0,), (1,), seed=1))
+        src = accls[0].buffer(data=_payload(1 << 14, 17))
+        h = accls[0].put(src, 1 << 14, dst=1, window=WIN,
+                         run_async=True)
+        with pytest.raises(ACCLError) as ei:
+            h.wait(20)
+        assert ErrorCode.RECEIVE_TIMEOUT_ERROR in ei.value.errors
+        fab.clear_fault()
+    finally:
+        _teardown(accls)
+
+
+def test_eager_fin_drop_reanswered_from_memo():
+    """A lost FIN makes the initiator retry the eager frame; the target
+    re-answers from its completed-transfer memo instead of re-running
+    the pool ingest (review finding)."""
+    accls = _world(2, win_elems=1 << 12)
+    try:
+        eng = accls[0].device.rma
+        eng.rto_s = 0.02                  # quick retry of the eager
+        fab = accls[0].device.ctx.fabric
+        # drop the first ctl frame FROM the target (the FIN)
+        fab.inject_fault(FaultPlan(
+            [FaultRule(kind="drop", strm=P.RMA_STRM, src=1, limit=1)],
+            seed=5))
+        src = accls[0].buffer(data=_payload(128, 19))
+        h = accls[0].put(src, 128, dst=1, window=WIN, run_async=True)
+        h.wait(20)
+        fab.clear_fault()
+        assert np.array_equal(accls[1]._win_buf.data[:128], src.data)
+        # the retry was answered from the memo: the payload LANDED (and
+        # rode the pool) exactly once — a re-run would double the
+        # target's landed-byte accounting
+        assert accls[1].device.rma.counters.get("rma_bytes_total", 0) \
+            == 128 * 4
+        assert accls[1].device.pool.hwm == 1
+    finally:
+        _teardown(accls)
+
+
+def test_self_put_and_get():
+    accls = _world(1, win_elems=4096)
+    try:
+        a = accls[0]
+        src = a.buffer(data=_payload(1024, 4))
+        a.put(src, 1024, dst=0, window=WIN, offset=4 * 100)
+        assert np.array_equal(a._win_buf.data[100:1124], src.data)
+        dst = a.buffer((1024,), np.float32)
+        a.get(dst, 1024, src=0, window=WIN, offset=4 * 100)
+        assert np.array_equal(dst.data, src.data)
+    finally:
+        _teardown(accls)
+
+
+def test_concurrent_puts_both_directions():
+    accls = _world(2, win_elems=1 << 17)
+    try:
+        d0, d1 = _payload(1 << 17, 11), _payload(1 << 17, 12)
+        bufs = [accls[0].buffer(data=d0), accls[1].buffer(data=d1)]
+
+        def body(a):
+            a.put(bufs[a.rank], 1 << 17, dst=1 - a.rank, window=WIN)
+            return True
+
+        assert all(run_ranks(accls, body))
+        assert np.array_equal(accls[1]._win_buf.data, d0)
+        assert np.array_equal(accls[0]._win_buf.data, d1)
+        assert accls[0].device.pool.hwm == 0
+        assert accls[1].device.pool.hwm == 0
+    finally:
+        _teardown(accls)
+
+
+# -- rendezvous under the seeded FaultPlan (PR-11 satellite) -----------------
+
+_CHAOS_CASES = {
+    "drop_rts_cts": [FaultRule(kind="drop", strm=P.RMA_STRM, limit=2)],
+    "drop_mid_stream_seg": [FaultRule(kind="drop", strm=P.RMA_DATA_STRM,
+                                      seqn_lo=2, seqn_hi=3, limit=1)],
+    "duplicate_ctl_and_seg": [
+        FaultRule(kind="duplicate", strm=P.RMA_STRM, limit=3),
+        FaultRule(kind="duplicate", strm=P.RMA_DATA_STRM, limit=3)],
+    "delay_ctl": [FaultRule(kind="delay", strm=P.RMA_STRM,
+                            delay_s=0.06, limit=2)],
+    "seeded_seg_loss": [FaultRule(kind="drop", strm=P.RMA_DATA_STRM,
+                                  prob=0.2)],
+}
+
+
+@pytest.mark.parametrize("case", sorted(_CHAOS_CASES))
+def test_rendezvous_under_fault_plan(case):
+    accls = _world(2, win_elems=1 << 17)
+    try:
+        fab = accls[0].device.ctx.fabric
+        data = _payload(1 << 17, seed=21)   # 512 KiB
+        pool = accls[1].device.pool
+        plan = FaultPlan(_CHAOS_CASES[case], seed=42)
+        fab.inject_fault(plan)
+        src = accls[0].buffer(data=data)
+        h = accls[0].put(src, 1 << 17, dst=1, window=WIN, run_async=True)
+        h.wait(60)
+        fab.clear_fault()
+        assert np.array_equal(accls[1]._win_buf.data, data)
+        assert pool.hwm == 0                # invariant holds under chaos
+        assert sum(plan.applied.values()) > 0
+
+        # the same schedule against a get (requester-pulled recovery)
+        accls[0]._win_buf.data[:] = data
+        fab.inject_fault(FaultPlan(_CHAOS_CASES[case], seed=43))
+        gdst = accls[1].buffer((1 << 17,), np.float32)
+        hg = accls[1].get(gdst, 1 << 17, src=0, window=WIN,
+                          run_async=True)
+        hg.wait(60)
+        fab.clear_fault()
+        assert np.array_equal(gdst.data, data)
+        assert pool.hwm == 0
+    finally:
+        _teardown(accls)
+
+
+def test_fault_rule_strm_filter():
+    from accl_tpu.emulator.fabric import Envelope
+    rule = FaultRule(kind="drop", strm=P.RMA_STRM)
+    ctl = Envelope(src=0, dst=1, tag=0, seqn=0, nbytes=0,
+                   wire_dtype="uint8", strm=P.RMA_STRM)
+    dat = Envelope(src=0, dst=1, tag=0, seqn=0, nbytes=0,
+                   wire_dtype="uint8", strm=0)
+    assert rule.matches(ctl) and not rule.matches(dat)
+
+
+# -- attribution: metrics, CallRecords, traces (PR-11 satellite) -------------
+
+def test_put_get_call_records_and_metrics(tmp_path):
+    from accl_tpu.tracing import METRICS
+    accls = _world(2, win_elems=1 << 16, tenant="serving")
+    try:
+        a = accls[0]
+        a.start_profiling()
+        src = a.buffer(data=_payload(1 << 15, 6))
+        a.put(src, 1 << 15, dst=1, window=WIN)          # rendezvous
+        a.put(src, 128, dst=1, window=WIN)              # eager
+        dst = a.buffer((128,), np.float32)
+        a.get(dst, 128, src=1, window=WIN)
+        a.end_profiling()
+        recs = a.profiler.records
+        ops = [r.op for r in recs]
+        assert ops.count("put") == 2 and ops.count("get") == 1
+        put_rec = next(r for r in recs if r.op == "put")
+        assert put_rec.tenant == "serving"
+        assert put_rec.nbytes == (1 << 15) * 4
+        # CSV round trip keeps the one-sided rows
+        path = tmp_path / "records.csv"
+        a.profiler.to_csv(str(path))
+        back = a.profiler.read_csv(str(path))
+        assert [r.op for r in back] == ops
+        assert back[0].tenant == "serving"
+        # driver metrics rows carry op + tenant labels
+        snap = METRICS.snapshot()
+        calls = snap["counters"]["accl_calls_total"]
+        put_rows = [k for k in calls
+                    if "op=put" in str(k) and "tenant=serving" in str(k)]
+        assert put_rows and sum(calls[k] for k in put_rows) >= 2
+        # engine counters made it to the registry
+        assert sum(snap["counters"].get("rma_puts_total", {}).values()) \
+            >= 2
+        assert sum(snap["counters"].get(
+            "rma_rendezvous_total", {}).values()) >= 1
+    finally:
+        _teardown(accls)
+
+
+def test_put_trace_events(tmp_path):
+    from accl_tpu.tracing import TRACE
+    accls = _world(2, win_elems=1 << 16, tenant="svc")
+    try:
+        a = accls[0]
+        a.start_trace()
+        src = a.buffer(data=_payload(1 << 15, 8))
+        a.put(src, 1 << 15, dst=1, window=WIN)
+        a.stop_trace()
+        stages = {e["stage"] for e in TRACE.events()}
+        assert "put" in stages            # completion interval event
+        assert "rma_rts" in stages and "rma_seg" in stages
+        out = tmp_path / "trace.json"
+        n = TRACE.export_chrome(str(out))
+        assert n > 0 and out.exists()
+        TRACE.clear()
+    finally:
+        _teardown(accls)
+
+
+# -- daemon tier -------------------------------------------------------------
+
+@pytest.mark.parametrize("stack", ["tcp", "udp"])
+def test_daemon_tier_put_get(stack):
+    accls = sim_world(2, stack=stack, timeout=20.0)
+    try:
+        wins = []
+        for a in accls:
+            wb = a.buffer((1 << 16,), np.float32)
+            wins.append(wb)
+            assert a.register_window(wb) == 1
+        data = _payload(1 << 16, seed=31)   # 256 KiB: rendezvous
+        src = accls[0].buffer(data=data)
+        accls[0].put(src, 1 << 16, dst=1, window=1)
+        accls[1].device.sync_from_device(wins[1])
+        assert np.array_equal(wins[1].data, data)
+        # eager at an offset
+        small = accls[0].buffer(data=_payload(64, 32))
+        accls[0].put(small, 64, dst=1, window=1, offset=4 * 500)
+        accls[1].device.sync_from_device(wins[1])
+        assert np.array_equal(wins[1].data[500:564], small.data)
+        # one-sided read back from the peer's window
+        gdst = accls[1].buffer((1 << 16,), np.float32)
+        accls[1].get(gdst, 1 << 16, src=0, window=1)
+        wins[0].data[:] = data
+        accls[0].device.sync_to_device(wins[0])
+        accls[1].get(gdst, 1 << 16, src=0, window=1)
+        assert np.array_equal(gdst.data, data)
+        # the daemons advertise the RMA + retx-ACK capability bits
+        assert accls[0].device.get_info()["caps"] \
+            == P.CAP_RETX_ACK | P.CAP_RMA
+        # unknown window fails typed across the wire
+        with pytest.raises(ACCLError):
+            accls[0].put(src, 16, dst=1, window=77)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+# -- native-peer autodetect (PR-11 satellite) --------------------------------
+
+def _stub_capless_daemon(port):
+    """A cmd-port server whose MSG_GET_INFO reply predates the caps word
+    — indistinguishable from the native cclo_emud's."""
+    srv = socket.create_server(("127.0.0.1", port))
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                body = P.recv_frame(conn)
+                if body and body[0] == P.MSG_GET_INFO:
+                    payload = (struct.pack("<Q3I", 1 << 20, 16, 2, 1)
+                               + struct.pack("<QIBBI", 1 << 20, 30000,
+                                             1, 1, 0))
+                    P.send_frame(conn, bytes([P.MSG_DATA]) + payload)
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                conn.close()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return srv
+
+
+def test_native_peer_probe_and_retx_pin():
+    from accl_tpu.emulator.daemon import RankDaemon, probe_peer_caps
+    from accl_tpu.testing import free_port_base
+    base = free_port_base(span=8)
+    stub = _stub_capless_daemon(base + 1)
+    daemon = None
+    try:
+        assert probe_peer_caps("127.0.0.1", base + 1) == 0
+        assert probe_peer_caps("127.0.0.1", base + 7) is None  # nobody
+        daemon = RankDaemon(0, 2, base, stack="udp")
+        assert daemon.eth.retx is not None
+        body = P.pack_comm(1234, 0, [(0, "127.0.0.1", base),
+                                     (1, "127.0.0.1", base + 1)])
+        assert daemon._handle(body)[0] == P.MSG_STATUS
+        # the capless (native-shaped) peer pinned retransmission off
+        assert daemon.eth.retx is None
+    finally:
+        if daemon is not None:
+            daemon.shutdown()
+        stub.close()
+
+
+def test_python_peers_keep_retx():
+    from accl_tpu.emulator.daemon import RankDaemon
+    from accl_tpu.testing import free_port_base
+    base = free_port_base(span=8)
+    d0 = d1 = None
+    try:
+        d0 = RankDaemon(0, 2, base, stack="udp")
+        d1 = RankDaemon(1, 2, base, stack="udp")
+        threading.Thread(target=d1.serve_forever, daemon=True).start()
+        body = P.pack_comm(99, 0, [(0, "127.0.0.1", base),
+                                   (1, "127.0.0.1", base + 1)])
+        d0._handle(body)
+        assert d0.eth.retx is not None   # full-caps peer: no pin
+    finally:
+        for d in (d0, d1):
+            if d is not None:
+                d.shutdown()
+
+
+# -- serving scenario smoke --------------------------------------------------
+
+def test_serving_ladder_smoke():
+    """Scaled-down benchmarks/serving.py cell: decode steps stay
+    correct and KV blocks land bit-identically while prefill streams."""
+    from benchmarks.serving import measure_serving
+    out = measure_serving(block_elems=16 << 10, steps=30)
+    assert out["serving_kv_blocks"] > 0
+    assert out["serving_jain"] > 0.5
+    assert out["decode_p99_storm_ms"] > 0
+
+
+def test_soft_reset_clears_inflight_keeps_windows():
+    accls = _world(2, win_elems=1 << 16)
+    try:
+        # registrations survive a soft reset (configuration, like comms)
+        for a in accls:
+            a.soft_reset()
+        src = accls[0].buffer(data=_payload(1 << 15, 44))
+        accls[0].put(src, 1 << 15, dst=1, window=WIN)
+        assert np.array_equal(accls[1]._win_buf.data[:1 << 15], src.data)
+    finally:
+        _teardown(accls)
