@@ -84,6 +84,12 @@ class ACCL:
         self.arith_registry = (arith_registry if arith_registry is not None
                                else dict(DEFAULT_ARITH_CONFIGS))
         self.communicators: list[Communicator] = []
+        # per-global-rank address book: the most recently registered
+        # Rank record for each global rank this driver has ever seen
+        # (grow_communicator's member-record resolution source — see
+        # _register_comm for why the comm registry's order is not
+        # recency)
+        self._rank_book: dict[int, "Rank"] = {}
         self._barrier_buf: ACCLBuffer | None = None
         self._scratch_bufs: dict[tuple[int, str], ACCLBuffer] = {}
         self.profiler = Profiler()
@@ -153,7 +159,7 @@ class ACCL:
                 except (OSError, ValueError):
                     pass
         device.configure_communicator(comm, tenant=tenant)
-        self.communicators.append(comm)
+        self._register_comm(comm)
         # bring-up sequence through the call path, mirroring the reference
         # driver init: set_timeout, enable_pkt, set_max_segment_size
         # (accl.py:374-380 <-> ccl_offload_control.c:1248-1279)
@@ -259,19 +265,24 @@ class ACCL:
         # parallel replicas and its sub-groups schedule/quota as ONE
         # tenant (accl_tpu/service)
         self.device.configure_communicator(sub, tenant=self.tenant)
-        self.communicators.append(sub)
+        self._register_comm(sub)
         return sub
 
     # -- failure containment (ULFM-style revoke/shrink) --------------------
     def revoke(self, comm: Communicator | None = None):
         """Mark a communicator revoked: every later call on it raises
         ``PEER_FAILED`` immediately instead of rendezvousing with ranks
-        that may be dead. The application then rebuilds on the survivors
-        via :meth:`shrink_communicator`. Rank-local (like the failure
-        observation itself) — every surviving rank revokes when it
-        observes ``ErrorCode.PEER_FAILED``; other communicators keep
-        flowing untouched."""
-        (comm or self.comm).revoked = True
+        that may be dead, and async handles ALREADY in flight on it
+        abort with the typed error now (``device.abort_comm``) instead
+        of riding out their full receive deadline. The application then
+        rebuilds on the survivors via :meth:`shrink_communicator`.
+        Rank-local (like the failure observation itself) — every
+        surviving rank revokes when it observes
+        ``ErrorCode.PEER_FAILED``; other communicators keep flowing
+        untouched."""
+        comm = comm or self.comm
+        comm.revoked = True
+        self.device.abort_comm(comm.comm_id, int(ErrorCode.PEER_FAILED))
 
     def shrink_communicator(self, dead_ranks: Sequence[int],
                             comm: Communicator | None = None,
@@ -293,8 +304,159 @@ class ACCL:
                              f"dead_ranks {sorted(dead)}")
         sub = comm.split(survivors, key=key)
         self.device.configure_communicator(sub, tenant=self.tenant)
-        self.communicators.append(sub)
+        self._register_comm(sub)
+        METRICS.inc("membership_shrink_total", rank=self.rank)
+        if TRACE.enabled:
+            TRACE.emit("membership_shrink", rank=self.rank,
+                       nbytes=len(survivors), peer=-1)
         return sub
+
+    def grow_communicator(self, new_ranks: Sequence,
+                          comm: Communicator | None = None,
+                          base_members: Sequence[int] | None = None,
+                          key: int = 0,
+                          handshake_timeout: float | None = None,
+                          retries: int | None = None,
+                          retry_policy: "RetryPolicy | None" = None
+                          ) -> Communicator:
+        """Build, register, and bootstrap the grown communicator of
+        ``comm`` plus ``new_ranks`` — the dual of
+        :meth:`shrink_communicator`, and the recovery half of the
+        elastic-membership story (the failure half is heartbeat
+        detection + revoke + shrink).
+
+        Every member of the NEW communicator — survivors and joiners —
+        must call this with the same membership (SPMD, like every
+        membership operation). Survivors pass their current (shrunken)
+        communicator as ``comm``; a JOINER, which is not a member of
+        that comm, instead passes ``base_members`` (the GLOBAL ranks of
+        the communicator it is joining). ``new_ranks`` entries are
+        global rank ints (addresses resolved from any registered
+        communicator — the world comm knows everyone) or explicit
+        :class:`~accl_tpu.communicator.Rank` records for ranks this
+        driver has never seen.
+
+        The grown membership is ordered by global rank and its comm_id
+        derives deterministically from (membership, key), so all members
+        agree without negotiation. When the grown membership+key matches
+        an existing communicator (the canonical grow-back-to-the-world
+        after a shrink), registration is a RE-configuration riding the
+        existing epoch machinery: the device bumps its comm epoch (so no
+        compiled plan of the old membership survives), the fabric drops
+        the comm's retransmission channel state, and every member's seqn
+        spaces restart at zero — stale ring/retx state is invalidated,
+        never inherited.
+
+        After configuring, a bootstrap JOIN handshake runs: every member
+        announces itself (strm=JOIN hello frames carrying the membership
+        signature) and waits for every peer, so no member can issue a
+        collective on the grown comm before all members exist and agree
+        — and a joiner that died (or never started) surfaces as a typed
+        ``JOIN_FAILED`` instead of a first-collective deadline. The
+        handshake is a retryable phase (``retries=``/``retry_policy=``,
+        driver default otherwise): a slow joiner gets fresh attempts
+        with the policy's uniform backoff. On final failure the grown
+        comm is revoked (later calls on it refuse typed) and the error
+        raises."""
+        import time as _time
+        if base_members is not None:
+            if comm is not None:
+                raise ValueError(
+                    "pass either comm= or base_members=, not both (a "
+                    "joiner names the membership it joins with "
+                    "base_members; members pass their communicator)")
+            base = [int(g) for g in base_members]
+        else:
+            comm = comm or self.comm
+            base = [r.global_rank for r in comm.ranks]
+        from .communicator import Rank, grown_communicator
+        explicit: dict[int, Rank] = {}
+        new_globals: list[int] = []
+        for entry in new_ranks:
+            if isinstance(entry, Rank):
+                explicit[entry.global_rank] = entry
+                new_globals.append(entry.global_rank)
+            else:
+                new_globals.append(int(entry))
+        members = sorted(set(base) | set(new_globals))
+        joiners = sorted(set(new_globals) - set(base))
+        me = self.comm.my_global_rank
+        if me not in members:
+            raise ValueError(
+                f"local rank (global {me}) is not a member of the grown "
+                f"communicator {members} — joiners list themselves in "
+                f"new_ranks or base_members")
+        if not joiners:
+            raise ValueError(
+                f"nothing to grow: {sorted(set(new_globals))} are all "
+                f"members of the base {sorted(set(base))} already")
+        records = []
+        for g in members:
+            # explicit Rank records win; otherwise the driver's address
+            # book — updated on EVERY registration, so the most recently
+            # learned (host, port) for a global rank is authoritative
+            # regardless of where its comm sits in the registry (a
+            # reversed scan of self.communicators is NOT recency:
+            # _register_comm replaces same-id comms in place, so a fresh
+            # re-addressed record can live at an EARLIER index than a
+            # stale one)
+            rec = explicit.get(g) or self._rank_book.get(g)
+            records.append(rec if rec is not None
+                           else Rank(global_rank=g))
+        grown = grown_communicator(records, me,
+                                   mesh_axis=self.comm.mesh_axis,
+                                   key=key)
+        # register FIRST (riding the reconfiguration epoch machinery),
+        # THEN handshake: each member sends its hello only after its own
+        # seqn spaces and plan-cache epoch are fresh, so a peer that
+        # completes the handshake and immediately issues a collective
+        # can never race a member still carrying old-membership state
+        self.device.configure_communicator(grown, tenant=self.tenant)
+        policy = resolve_policy(retries, retry_policy, self.retry_policy)
+        timeout = (handshake_timeout if handshake_timeout is not None
+                   else getattr(self.device, "timeout", 5.0))
+        attempt = 0
+        while True:
+            err = int(self.device.join_handshake(grown, timeout))
+            if not err:
+                break
+            if policy is not None and policy.should_retry(err, attempt):
+                METRICS.inc("membership_join_retries_total",
+                            rank=self.rank)
+                log.warning(
+                    "rank %d: join handshake for grown comm %d failed "
+                    "(0x%x) — retry %d", self.rank, grown.comm_id, err,
+                    attempt + 1, extra={"rank": self.rank})
+                _time.sleep(policy.backoff(attempt, grown.comm_id))
+                attempt += 1
+                continue
+            grown.revoked = True
+            METRICS.inc("membership_join_fail_total", rank=self.rank)
+            raise ACCLError(err, f"grow_communicator{members}")
+        self._register_comm(grown)
+        METRICS.inc("membership_grow_total", rank=self.rank,
+                    joiners=len(joiners))
+        if TRACE.enabled:
+            TRACE.emit("membership_grow", rank=self.rank,
+                       nbytes=len(members), peer=-1)
+        return grown
+
+    def _register_comm(self, comm: Communicator):
+        """Track a (re)built communicator, REPLACING any registered comm
+        of the same id: after a grow-back the old-membership object (and
+        its revoked flag) must not shadow the fresh one in comm_of().
+        Every registration also refreshes the driver's per-global-rank
+        address book — the recency source grow_communicator resolves
+        member records from (list position is not recency: replacement
+        happens in place)."""
+        for r in comm.ranks:
+            if r.global_rank >= 0:
+                self._rank_book[r.global_rank] = r
+        for i, c in enumerate(self.communicators):
+            if c.comm_id == comm.comm_id:
+                self.communicators[i] = comm
+                return
+        self.communicators.append(comm)
 
     def preflight(self, count: int, dtype=np.float32,
                   op: str = "allreduce",
@@ -876,6 +1038,7 @@ class ACCL:
 
     def copy(self, srcbuf: ACCLBuffer | None, dstbuf: ACCLBuffer | None,
              count: int | None = None, *,
+             comm: Communicator | None = None,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
              stream_dtype=None, run_async: bool = False, chain: bool = False,
              waitfor: Sequence[CallHandle] = (),
@@ -887,7 +1050,11 @@ class ACCL:
         stream-out port (dstbuf may be None) — the external-kernel data
         paths (reference: SWITCH_M_BYPASS / loopback plugin). A fully
         streamed copy takes its element type from ``stream_dtype``
-        (default float32)."""
+        (default float32). ``comm`` scopes attribution/ordering only —
+        no bytes cross the wire — and matters when the default comm is
+        revoked: a reshard's local slice copies ride the EXCHANGE
+        communicator, so elastic recovery works while the world comm is
+        down (the whole point of revoke + shrink)."""
         if count is None:
             if srcbuf is not None:
                 count = srcbuf.size
@@ -896,7 +1063,8 @@ class ACCL:
             else:
                 raise ValueError("copy with both operands streamed "
                                  "requires an explicit count")
-        desc = self._prepare(CCLOp.copy, count=count, comm=self.comm,
+        desc = self._prepare(CCLOp.copy, count=count,
+                             comm=comm or self.comm,
                              op0=srcbuf, res=dstbuf,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
@@ -1349,6 +1517,16 @@ class ACCL:
         tag = f"redist#{next(self._redist_seq)}"
         key = ("redistribute", comm.comm_id)
         self._call_counts[key] = self._call_counts.get(key, 0) + 1
+        # reshard observability (elastic membership rides on these):
+        # rare-by-construction direct registry writes, like the fabric
+        # fault counters — a reshard is a membership-scale event, not a
+        # per-frame hot path
+        nbytes_global = src_spec.n * srcbuf.dtype.itemsize
+        METRICS.inc("reshard_total", rank=self.rank, kind=plan.kind)
+        METRICS.inc("reshard_bytes_total", nbytes_global, rank=self.rank)
+        if TRACE.enabled:
+            TRACE.emit("reshard", rank=self.rank, nbytes=nbytes_global,
+                       peer=-1)
         t0 = _time.perf_counter()
 
         def _slice(buf, off, n):
@@ -1406,7 +1584,7 @@ class ACCL:
                 handles.append(self.copy(
                     _slice(srcbuf, 0, src_count),
                     _slice(src_arena, 0, src_count), src_count,
-                    run_async=True, waitfor=waitfor))
+                    comm=comm, run_async=True, waitfor=waitfor))
                 waitfor = (handles[-1],)
             if plan.kind == "allgather":
                 handles.append(self.allgather(
@@ -1438,7 +1616,8 @@ class ACCL:
                         handles.append(self.copy(
                             _slice(src_arena, st.src_off, st.count),
                             _slice(dstbuf, st.dst_off, st.count),
-                            st.count, run_async=True, waitfor=waitfor))
+                            st.count, comm=comm, run_async=True,
+                            waitfor=waitfor))
         if run_async:
             if not handles:
                 # nothing to issue (noop plan) — but the returned handle
@@ -1450,13 +1629,12 @@ class ACCL:
             if len(handles) == 1:
                 ret = handles[0]
             else:
-                # the program spans TWO communicators (local copies on
-                # the driver's comm, transfers on the possibly-split
-                # exchange comm), and the device's FIFO retirement
-                # contract is per-comm only — no single sub-call handle
-                # is guaranteed last. Aggregate: complete when EVERY
-                # sub-call has, with the OR of their error words (first
-                # exception kept).
+                # no single sub-call handle is guaranteed last: the
+                # device's FIFO retirement contract is per-comm
+                # SUBMISSION order, but local copies may retire inline
+                # while transfers drain on workers. Aggregate: complete
+                # when EVERY sub-call has, with the OR of their error
+                # words (first exception kept).
                 import threading as _threading
                 agg = CallHandle(context="redistribute")
                 mu = _threading.Lock()

@@ -76,6 +76,21 @@ class FaultRule:
     max_attempt: int = 0
     prob: float | None = None         # seeded per-(identity, attempt)
     limit: int | None = None          # max applications
+    # TRANSIENT faults that heal: the rule deactivates once it has SEEN
+    # this many frames matching its static filters — counted whether or
+    # not it fired on them, which is what distinguishes it from
+    # ``limit`` (a prob rule with heal_after=100 flips coins over the
+    # first 100 matching frames then delivers everything; limit=100
+    # would keep flipping forever until it had FIRED 100 times). The
+    # canonical use
+    # is a flapping partition: kind="partition" + heal_after=N drops N
+    # crossing frames and then heals, after which retransmission/RTO
+    # recovers everything lost during the flap — the flap-then-recover
+    # shape a permanent partition cannot express. Seqn-scoped healing
+    # (deactivate past a known point of each channel's traffic) is the
+    # existing ``seqn_hi`` filter; heal_after is the frame-COUNT form
+    # for schedules where per-channel seqns are not known in advance.
+    heal_after: int | None = None
     delay_s: float = 0.0              # for kind="delay"
     group_a: tuple = ()               # for kind="partition": frames
     group_b: tuple = ()               # crossing a<->b (either way) drop
@@ -129,6 +144,9 @@ class FaultPlan:
         self._chan_hwm: dict[tuple, int] = {}
         self.applied: dict[str, int] = {k: 0 for k in KINDS}
         self._rule_applied = [0] * len(self.rules)
+        # per-rule matched-frame counts (heal_after accounting): bumped
+        # for every frame passing a rule's static filters, fired or not
+        self._rule_seen = [0] * len(self.rules)
         self.frames_seen = 0
 
     # -- convenience constructors -----------------------------------------
@@ -171,6 +189,16 @@ class FaultPlan:
         for i, rule in enumerate(self.rules):
             if not rule.matches(env):
                 continue
+            if rule.heal_after is not None:
+                # transient fault: seen-count the matching frame, then
+                # stop applying once the flap window has passed — the
+                # healed wire delivers, and recovery converges on
+                # whatever the flap ate
+                with self._mu:
+                    seen = self._rule_seen[i]
+                    self._rule_seen[i] = seen + 1
+                if seen >= rule.heal_after:
+                    continue
             if rule.prob is not None:
                 if attempt is None:
                     attempt = self._attempt(env)
@@ -201,6 +229,13 @@ class FaultPlan:
         lines = [f"FaultPlan(seed={self.seed}, "
                  f"frames_seen={self.frames_seen})"]
         for i, rule in enumerate(self.rules):
+            # deactivation happens once the SEEN count reaches the
+            # window (the 0-based pre-increment check in __call__), so
+            # >= here — a fully-consumed window is healed even before
+            # the first post-window frame arrives
+            healed = (rule.heal_after is not None
+                      and self._rule_seen[i] >= rule.heal_after)
             lines.append(f"  rule {i}: {rule.kind} applied="
-                         f"{self._rule_applied[i]} {rule}")
+                         f"{self._rule_applied[i]}"
+                         f"{' HEALED' if healed else ''} {rule}")
         return "\n".join(lines)

@@ -93,6 +93,20 @@ class Communicator:
     def prev_rank(self) -> int:
         return (self.local_rank - 1) % self.size
 
+    def membership_signature(self) -> int:
+        """Deterministic digest of (membership, ADDRESS TABLE, key) —
+        the value every member's join hello carries (elastic
+        membership, ACCL.grow_communicator). Deliberately covers MORE
+        than the comm_id derivation (which is membership+key alone):
+        two members growing the same comm id but disagreeing on a
+        member's (host, port) — e.g. a re-addressed rejoiner one
+        survivor learned about and another did not — mismatch here and
+        fail the handshake typed (JOIN_FAILED), instead of completing
+        a bootstrap whose first collective dials a stale address."""
+        table = ",".join(f"{r.global_rank}:{r.host}:{r.port}"
+                         for r in self.ranks)
+        return zlib.crc32(f"{table}#{self.key}".encode())
+
     def split(self, members: Sequence[int], new_local: int | None = None,
               key: int = 0) -> "Communicator":
         """Create a sub-communicator from a subset of ranks.
@@ -123,6 +137,33 @@ class Communicator:
                 f"max_seg={r.max_segment_size}"
                 + (f" device={r.device}" if r.device is not None else ""))
         return "\n".join(lines)
+
+
+def grown_communicator(rank_records: Sequence[Rank], my_global_rank: int,
+                       mesh_axis: str | None = None,
+                       key: int = 0) -> Communicator:
+    """Build a grown communicator from per-member Rank records (elastic
+    membership, ACCL.grow_communicator): members are ordered by GLOBAL
+    rank so every participant — survivor or joiner — derives the
+    identical rank numbering (and therefore comm_id) without a
+    handshake, the split_communicator determinism contract. Fresh
+    sequence counters on every member: a grown membership is a new (or
+    restarted) seqn space, never an inheritance of the old one."""
+    by_g: dict[int, Rank] = {}
+    for r in rank_records:
+        if r.global_rank < 0:
+            raise ValueError("grown members need explicit global ranks")
+        by_g.setdefault(r.global_rank, r)
+    ranks = [dataclasses.replace(by_g[g], inbound_seq=0, outbound_seq=0)
+             for g in sorted(by_g)]
+    local = next((i for i, r in enumerate(ranks)
+                  if r.global_rank == my_global_rank), None)
+    if local is None:
+        raise ValueError(f"local global rank {my_global_rank} is not a "
+                         f"member of the grown communicator "
+                         f"{sorted(by_g)}")
+    return Communicator(ranks=ranks, local_rank=local,
+                        mesh_axis=mesh_axis, key=key)
 
 
 def simple_communicator(world_size: int, local_rank: int,

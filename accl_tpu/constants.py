@@ -291,6 +291,13 @@ class ErrorCode(enum.IntFlag):
     # outside the registered region — typed so a mis-exchanged window id
     # fails fast at the initiator instead of as a receive timeout
     RMA_WINDOW_ERROR = 1 << 29
+    # elastic membership (ACCL.grow_communicator): the join handshake
+    # did not complete — a joiner died (or never started) mid-handshake,
+    # or a peer is growing a DIFFERENT membership for the same comm id.
+    # Transient by nature (a joiner may still be booting), so retry
+    # policies treat it as retryable — unlike PEER_FAILED, which names a
+    # peer that was alive and stopped answering
+    JOIN_FAILED = 1 << 30
 
 
 class StackType(enum.IntEnum):

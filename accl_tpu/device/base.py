@@ -231,6 +231,26 @@ class Device(abc.ABC):
     def deregister_window(self, wid: int):
         """Remove a window registration (no-op when absent)."""
 
+    # -- elastic membership (ACCL.grow_communicator) -----------------------
+    def join_handshake(self, comm: Communicator, timeout: float) -> int:
+        """Bootstrap handshake of a grown communicator: block until every
+        member of ``comm`` has announced itself alive and agreeing on the
+        membership, or ``timeout`` expires. Returns 0 on success or a
+        typed error word (JOIN_FAILED, OR-ed with RECEIVE_TIMEOUT_ERROR
+        on a plain timeout). Single-controller backends (TPU mesh tier)
+        have no independent peers to synchronize with — membership is a
+        host-side fact there — so the default is immediate success; the
+        emulator and daemon tiers exchange JOIN_STRM hello frames."""
+        return 0
+
+    def abort_comm(self, comm_id: int, err: int):
+        """Containment hook for an application-driven revoke: abort
+        in-flight programs on ``comm_id`` with the typed error NOW and
+        latch it for pending recvs, instead of letting async handles
+        ride out their full receive deadline. Default no-op (backends
+        without an abortable executor surface the revocation at the
+        next call through the driver's revoked-comm check)."""
+
     def soft_reset(self):
         """Parity: HOUSEKEEP_SWRST (ccl_offload_control.c:1244-1247)."""
 

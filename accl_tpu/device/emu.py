@@ -277,6 +277,12 @@ class EmuDevice(Device):
         # comm containing it until shrink_communicator rebuilds
         self._peer_last: dict[int, float] = {}
         self._dead_peers: set[int] = set()
+        # elastic-membership join handshake (ACCL.grow_communicator):
+        # hellos heard per grown comm — {comm_id: {src_grank: signature}}
+        # — cleared at configure time (configure_communicator), so the
+        # evidence's lifetime is exactly one membership generation
+        self._join_cv = threading.Condition()
+        self._join_heard: dict[int, dict[int, int]] = {}
         self.service = None
         if ctx.service_config is not None:
             self.service = RankService(
@@ -360,6 +366,112 @@ class EmuDevice(Device):
                 self.pool.latch_error(cid, int(ErrorCode.PEER_FAILED))
         self.executor.fail_peer(grank, int(ErrorCode.PEER_FAILED))
 
+    # -- elastic membership: join handshake (ACCL.grow_communicator) -------
+    def _on_join_frame(self, env):
+        """A peer's join hello (strm=JOIN_STRM): tag carries the
+        membership signature. Hellos are only ever sent by a rank
+        actively inside (or completing) a handshake that FOLLOWED its
+        own configuration of the comm — there is deliberately no echo
+        from stored state, so stale pre-configure state can never
+        satisfy a fresh liveness proof (a completed member's echo for a
+        same-signature RE-grow would let a peer finish the bootstrap
+        before this rank re-configured, and this rank's configure would
+        then wipe the peer's first collective's frames). Receipt is
+        liveness evidence — a rejoining rank clears itself from the
+        dead set exactly like a resumed heartbeat."""
+        if self.rank in self.ctx._hb_killed:
+            # a killed rank is a crashed host: it does not process join
+            # traffic (kill_rank silences heartbeats; the join lane is
+            # liveness-bearing and dies with them)
+            return
+        self.note_heartbeat(env.src)
+        with self._join_cv:
+            self._join_heard.setdefault(env.comm_id, {})[env.src] = env.tag
+            self._join_cv.notify_all()
+
+    def _send_join(self, comm_id: int, dst_grank: int, sig: int):
+        if self.rank in self.ctx._hb_killed:
+            return  # crashed hosts send nothing (see _on_join_frame)
+        from ..emulator.protocol import JOIN_STRM
+        env = Envelope(src=self.rank, dst=dst_grank, tag=sig,
+                       seqn=0, nbytes=0, wire_dtype="uint8",
+                       strm=JOIN_STRM, comm_id=comm_id)
+        try:
+            self.ctx.fabric.send(env, b"")
+        except (RuntimeError, IndexError):
+            # peer not attached (yet), or a global rank outside this
+            # fabric's world entirely (the fabric indexes by rank) —
+            # either way the resend loop retries and the handshake
+            # deadline types the failure as JOIN_FAILED, never a raw
+            # fabric exception out of grow_communicator
+            pass
+
+    def join_handshake(self, comm: Communicator, timeout: float) -> int:
+        """Full-mesh bootstrap barrier of a grown communicator: announce
+        ourselves to every peer and wait until every peer has announced
+        a MATCHING membership signature. Hellos resend periodically, so
+        members may enter at different times; the heard-table restarts
+        at CONFIGURE time (configure_communicator), not here, so driver
+        retry attempts within one grow share their evidence while a
+        re-grow of the same membership must prove liveness afresh. On
+        success we broadcast one final COMPLETION hello before
+        returning: a peer X could have entered (clearing its table at
+        its configure) after our last periodic resend — we only
+        complete after hearing X, so the completion hello necessarily
+        postdates X's entry and closes that window. A joiner that never
+        answers times out with a typed JOIN_FAILED; a peer announcing a
+        different membership signature fails fast."""
+        sig = comm.membership_signature()
+        cid = comm.comm_id
+        peers = [r.global_rank for r in comm.ranks
+                 if r.global_rank != self.rank]
+        if not peers:
+            return 0
+        deadline = time.monotonic() + max(0.05, timeout)
+        tick = min(0.02, max(0.002, timeout / 20.0))
+        while True:
+            for g in peers:
+                self._send_join(cid, g, sig)
+            with self._join_cv:
+                heard = self._join_heard.get(cid, {})
+                if any(g in heard and heard[g] != sig for g in peers):
+                    return int(ErrorCode.JOIN_FAILED)
+                if all(g in heard for g in peers):
+                    break
+                self._join_cv.wait(tick)
+            if time.monotonic() >= deadline:
+                with self._join_cv:
+                    heard = self._join_heard.get(cid, {})
+                    if any(g in heard and heard[g] != sig
+                           for g in peers):
+                        return int(ErrorCode.JOIN_FAILED)
+                    if not all(g in heard for g in peers):
+                        return int(ErrorCode.JOIN_FAILED
+                                   | ErrorCode.RECEIVE_TIMEOUT_ERROR)
+                break  # complete at the buzzer
+        # completion hello, sent 3x: the window-closing message rides an
+        # unreliable lane (JOIN frames bypass the retx layer by design),
+        # and seeded chaos plans flip a FRESH coin per delivery attempt
+        # of the same identity — three sends are three independent loss
+        # coins. The residual (every post-peer-configure hello to one
+        # peer dropped) is a LIVENESS bound, not a safety hole: the
+        # starved peer exhausts its retries and raises typed
+        # JOIN_FAILED while this rank's first collective times out —
+        # both sides surface, and re-growing recovers (ARCHITECTURE,
+        # "Elastic membership").
+        for _ in range(3):
+            for g in peers:
+                self._send_join(cid, g, sig)
+        return 0
+
+    def abort_comm(self, comm_id: int, err: int):
+        """Revocation containment (ACCL.revoke): async handles already
+        in flight on the revoked comm abort with the typed error now —
+        never riding out the full recv deadline — and the latched word
+        surfaces in any already-posted recv's error path."""
+        self.pool.latch_error(comm_id, int(err))
+        self.executor.fail_comm(comm_id, int(err))
+
     # -- reliability / retry hooks -----------------------------------------
     def prepare_retry(self, comm_id: int) -> int:
         """Pre-retry cleanup (driver retry policy): purge the failed
@@ -385,12 +497,14 @@ class EmuDevice(Device):
             # registered window here — never in the rx pool); anything
             # else (stray ACKs — LocalFabric acks are internal calls) is
             # dropped, never stream-delivered
-            from ..emulator.protocol import (HB_STRM, RMA_DATA_STRM,
-                                             RMA_STRM)
+            from ..emulator.protocol import (HB_STRM, JOIN_STRM,
+                                             RMA_DATA_STRM, RMA_STRM)
             if env.strm in (RMA_STRM, RMA_DATA_STRM):
                 self.rma.on_frame(env, payload)
             elif env.strm == HB_STRM:
                 self.note_heartbeat(env.src)
+            elif env.strm == JOIN_STRM:
+                self._on_join_frame(env)
             return
         # Fast path: deliver into the pool from the sender's thread — one
         # scheduler handoff less per message, and the ingest-inline
@@ -485,8 +599,19 @@ class EmuDevice(Device):
             # so retransmission channel state keyed on the old space
             # must not dedup the new one away (fresh comm ids need no
             # reset — and get none, so a racing split can never wipe a
-            # sibling rank's in-flight ring)
+            # sibling rank's in-flight ring). Stranded rx frames and
+            # latched error words of the OLD membership die with it too:
+            # a grown-back comm must not inherit a stale PEER_FAILED
+            # latch (or old-epoch frames) from before the shrink
             self.ctx.fabric.reset_comm(comm.comm_id)
+            self.pool.purge_comm(comm.comm_id)
+        # join-handshake evidence restarts with the comm's configuration
+        # (one membership generation): a RE-grow of the same membership
+        # + signature must prove liveness afresh, never inherit the
+        # previous handshake's heard-table. Driver retry attempts within
+        # ONE grow share the table — they follow one configure.
+        with self._join_cv:
+            self._join_heard.pop(comm.comm_id, None)
         self.comms[comm.comm_id] = comm
         if tenant:
             self.comm_tenants[comm.comm_id] = tenant
@@ -1029,6 +1154,12 @@ class EmuDevice(Device):
             "plan_us": round(plan_us, 1), "plan_cache": state}
 
     def _execute_data(self, desc: CallDescriptor, comm: Communicator) -> int:
+        if getattr(comm, "revoked", False):
+            # a call that was queued before the application revoked the
+            # comm must fail fast and typed, like the in-flight programs
+            # abort_comm unwound — not discover the revocation by
+            # burning its recv deadline
+            return int(ErrorCode.PEER_FAILED)
         if self._dead_peers and any(r.global_rank in self._dead_peers
                                     for r in comm.ranks):
             # fail-fast containment: a collective over a dead member can

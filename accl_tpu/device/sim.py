@@ -189,6 +189,30 @@ class SimDevice(Device):
     def soft_reset(self):
         self._check(bytes([P.MSG_RESET]))
 
+    def join_handshake(self, comm: Communicator, timeout: float) -> int:
+        """Drive the daemon's elastic-membership join handshake
+        (MSG_JOIN) with short poll budgets — a long blocking request
+        would monopolize the command socket (MSG_STREAM_POP discipline).
+        The daemon answers 0 (complete), STATUS_PENDING (peers still
+        missing — re-poll until OUR deadline types the failure), or a
+        typed error word. A native daemon predating MSG_JOIN answers
+        INVALID_CALL, which surfaces as-is: grown communicators are a
+        python-daemon/emulator feature until cclo_emud learns the
+        message."""
+        import time
+        sig = comm.membership_signature()
+        deadline = time.monotonic() + max(0.05, timeout)
+        while True:
+            budget = min(0.2, max(0.01, deadline - time.monotonic()))
+            reply = self._request(P.pack_join(comm.comm_id, sig, budget))
+            assert reply[0] == P.MSG_STATUS, reply[0]
+            (err,) = struct.unpack("<I", reply[1:5])
+            if err != P.STATUS_PENDING:
+                return int(err)
+            if time.monotonic() >= deadline:
+                return int(ErrorCode.JOIN_FAILED
+                           | ErrorCode.RECEIVE_TIMEOUT_ERROR)
+
     def push_stream(self, data):
         arr = np.asarray(data).reshape(-1)
         self._check(bytes([P.MSG_STREAM_PUSH, P.dtype_code(arr.dtype)])

@@ -738,6 +738,12 @@ class RankDaemon:
             "ACCL_TPU_HEARTBEAT_BUDGET", "3")))
         self._peer_last: dict[int, float] = {}
         self.dead_peers: set[int] = set()
+        # elastic-membership join handshake (MSG_JOIN, the daemon twin
+        # of EmuDevice.join_handshake): hellos heard per grown comm —
+        # cleared at MSG_CONFIG_COMM, so the evidence's lifetime is
+        # exactly one membership generation
+        self._join_cv = threading.Condition()
+        self._join_heard: dict[int, dict[int, int]] = {}
         # unified metrics: this daemon's health surfaces (eth fabric
         # stats, rx-pool occupancy, executor pipeline counters, plan
         # cache) polled only at snapshot time; the weak registration
@@ -912,10 +918,74 @@ class RankDaemon:
                                   and getattr(self.eth, "coalesce", 0)
                                   else None)
 
+    # -- elastic membership: join handshake (MSG_JOIN) ---------------------
+    def _send_join(self, comm_id: int, dst: int, sig: int):
+        env = Envelope(src=self.rank, dst=dst, tag=sig, seqn=0,
+                       nbytes=0, wire_dtype="uint8", strm=P.JOIN_STRM,
+                       comm_id=comm_id)
+        try:
+            self.eth.send(env, b"")
+        except (KeyError, OSError, ConnectionError):
+            pass  # unreachable joiner: the poll loop keeps trying and
+            # the client's deadline types the failure
+
+    def _join_step(self, comm_id: int, sig: int, budget: float) -> bytes:
+        """One client-driven poll step of the join handshake: (re)send
+        hellos to every peer of the grown comm, wait up to ``budget``
+        for matching hellos from all of them. Replies 0 when complete
+        (after broadcasting one final COMPLETION hello — a peer that
+        configured, clearing its heard-table, after our last resend
+        necessarily entered before we heard it, so the completion hello
+        postdates its clear and closes that window), STATUS_PENDING
+        while peers are missing (the client re-polls until ITS deadline
+        types the failure), JOIN_FAILED on a membership-signature
+        mismatch. Hellos are only ever sent from inside a handshake —
+        never echoed from stored state — so a member that has not
+        (re)entered the handshake for the current membership generation
+        stays silent and a stale generation can never prove liveness."""
+        comm = self.comms.get(comm_id)
+        if comm is None:
+            return P.status_reply(int(ErrorCode.COMM_NOT_CONFIGURED))
+        peers = [r.global_rank for r in comm.ranks
+                 if r.global_rank != self.rank]
+        for g in peers:
+            self._send_join(comm_id, g, sig)
+        deadline = time.monotonic() + max(0.0, budget)
+        while True:
+            with self._join_cv:
+                heard = self._join_heard.get(comm_id, {})
+                if any(g in heard and heard[g] != sig for g in peers):
+                    return P.status_reply(int(ErrorCode.JOIN_FAILED))
+                if all(g in heard for g in peers):
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return P.status_reply(P.STATUS_PENDING)
+                self._join_cv.wait(min(remaining, 0.02))
+        # completion hello, sent 3x (independent loss coins on a lossy
+        # wire — the emu tier's rationale in EmuDevice.join_handshake)
+        for _ in range(3):
+            for g in peers:
+                self._send_join(comm_id, g, sig)
+        return P.status_reply(0)
+
     # -- ingress -----------------------------------------------------------
     def _ingest(self, env: Envelope, payload: bytes):
         if env.strm == P.HB_STRM:
             self._note_heartbeat(env.src)
+            return
+        if env.strm == P.JOIN_STRM:
+            # membership join hello: liveness-bearing (a rejoining peer
+            # clears itself from the dead set) and stored for the
+            # handshake poll. Deliberately NO echo from stored state —
+            # only a member actively inside (or completing) a handshake
+            # sends hellos, so stale pre-configure state can never
+            # satisfy a fresh liveness proof (see _join_step)
+            self._note_heartbeat(env.src)
+            with self._join_cv:
+                self._join_heard.setdefault(env.comm_id,
+                                            {})[env.src] = env.tag
+                self._join_cv.notify_all()
             return
         if env.strm in (P.RMA_STRM, P.RMA_DATA_STRM):
             # one-sided lanes: control frames + rendezvous segments (the
@@ -1399,10 +1469,23 @@ class RankDaemon:
             if comm_id in self.comms:
                 # true RE-configuration: the comm's per-peer seqn spaces
                 # restart at 0 — retransmission channel state keyed on
-                # the old space must not dedup the new one away
+                # the old space must not dedup the new one away, and
+                # stranded frames / latched error words of the old
+                # membership (a grown-back comm's stale PEER_FAILED)
+                # die with it
                 reset = getattr(self.eth, "reset_comm", None)
                 if reset is not None:
                     reset(comm_id)
+                self.pool.purge_comm(comm_id)
+            # join-handshake evidence restarts with the comm: a RE-grow
+            # of the same membership (same comm id AND signature — e.g.
+            # grow-back, shrink, grow-back again) must prove liveness
+            # afresh, not inherit the previous handshake's heard-table.
+            # A hello wiped by a late configure is recovered by the
+            # sender's resend loop, and the sender's COMPLETION hello
+            # covers the sender-already-finished case (_join_step).
+            with self._join_cv:
+                self._join_heard.pop(comm_id, None)
             self.comms[comm_id] = comm
             if tenant:
                 # wire input: the label lands verbatim in Prometheus
@@ -1442,6 +1525,12 @@ class RankDaemon:
             except (KeyError, ValueError):
                 return P.status_reply(int(ErrorCode.RMA_WINDOW_ERROR))
             return P.status_reply(0)
+        if kind == P.MSG_JOIN:
+            comm_id, sig, budget = P.unpack_join(body[1:])
+            # short per-poll budget (MSG_STREAM_POP discipline): a long
+            # blocking wait here would monopolize the command socket
+            return self._join_step(comm_id, sig,
+                                   min(max(0.0, budget), 0.5))
         if kind == P.MSG_SET_TIMEOUT:
             t = _sane_budget(struct.unpack("<d", body[1:9])[0],
                              configured=True)

@@ -1691,6 +1691,24 @@ class MoveExecutor:
             _TRACE.trigger_dump(f"peer_failed_rank{grank}",
                                 rank=self.owner_rank)
 
+    def fail_comm(self, comm_id: int, err: int):
+        """Revocation containment (the per-COMM twin of
+        :meth:`fail_peer`): abort every active program of the revoked
+        communicator with the typed error immediately — an async handle
+        already in flight when the application revokes must surface
+        promptly, never ride out its full recv deadline. Programs on
+        every other communicator are untouched."""
+        with self._sched_lock:
+            aborted = False
+            for p in self._progs:
+                if p.aborted or p.comm.comm_id != comm_id:
+                    continue
+                p.err |= int(err)
+                self._abort_locked(p)
+                aborted = True
+            if aborted:
+                self._work_cv.notify_all()
+
     def _cancel_chain_locked(self, prog: _Prog, succ: list):
         stack = list(succ)
         while stack:

@@ -50,6 +50,19 @@ MSG_REG_WINDOW = 17   # window u32 + addr u64 + nbytes u64 -> MSG_STATUS;
 #                       deregisters). Window ids are the put/get address
 #                       namespace peers target — exchanged at configure
 #                       time by the application (accl_tpu/rma).
+MSG_JOIN = 18         # comm_id u32 + membership-signature u32 + budget
+#                       f64 -> MSG_STATUS: drive one poll step of the
+#                       elastic-membership join handshake for an
+#                       already-configured (grown) communicator. The
+#                       daemon (re)sends hello frames (strm=JOIN_STRM)
+#                       to every peer of the comm and waits up to the
+#                       budget for hellos from all of them; replies 0 on
+#                       completion, STATUS_PENDING while peers are still
+#                       missing (the client polls, MSG_STREAM_POP
+#                       discipline), or JOIN_FAILED on a membership-
+#                       signature mismatch. The native daemon predates
+#                       this message and replies INVALID_CALL — grown
+#                       communicators are a python-daemon/emu feature.
 # replies
 # shared daemon resource bounds (hostile-descriptor protection; both
 # daemons and the robustness suite reference these — keep in sync with
@@ -88,6 +101,18 @@ HB_STRM = 3           # membership heartbeat (empty payload)
 # RTS-retry / NACK-resend recovery on top.
 RMA_STRM = 4          # one-sided control frames (pack_rma_ctl payload)
 RMA_DATA_STRM = 5     # rendezvous payload segments (direct-to-window)
+# Elastic-membership join hellos (ACCL.grow_communicator): tag carries
+# the membership signature (crc32 of the per-rank global:host:port
+# table + key — deliberately covering the ADDRESS table the comm_id
+# derivation omits, so peers disagreeing on a member's address fail the
+# handshake typed). Hellos are only ever emitted from INSIDE a
+# handshake (periodic resends while waiting, plus one final completion
+# hello) — never echoed from stored state, so a member that has not
+# entered the current membership generation's handshake stays silent
+# and stale state can never prove liveness. Empty payload; comm_id
+# scopes the handshake. Liveness-bearing like heartbeats: receipt
+# clears the sender from the dead set.
+JOIN_STRM = 6         # membership join hello (empty payload)
 
 # daemon capability bits (MSG_GET_INFO trailing caps u32; absent on
 # replies from daemons predating it — treat as 0). Bit 0: the daemon
@@ -398,6 +423,18 @@ def unpack_comm(body: bytes
             raise ValueError("truncated tenant record")
         tenant = body[off:off + tlen].decode()
     return comm_id, local_rank, ranks, tenant
+
+
+# -- membership join (MSG_JOIN poll step) -----------------------------------
+def pack_join(comm_id: int, signature: int, budget_s: float) -> bytes:
+    return bytes([MSG_JOIN]) + struct.pack("<IId", comm_id & 0xFFFFFFFF,
+                                           signature & 0xFFFFFFFF,
+                                           budget_s)
+
+
+def unpack_join(body: bytes) -> tuple[int, int, float]:
+    comm_id, signature, budget = struct.unpack("<IId", body[:16])
+    return comm_id, signature, budget
 
 
 # -- eth frame --------------------------------------------------------------

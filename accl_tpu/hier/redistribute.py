@@ -113,6 +113,58 @@ def _is_even_block(spec: ShardSpec) -> bool:
             and spec.counts[0] > 0)
 
 
+def _plan_block_block(src: ShardSpec, dst: ShardSpec,
+                      me: int) -> RedistPlan:
+    """Direct overlap walk for a block->block sharding change: rank
+    ``me``'s single contiguous src/dst intervals against the peer
+    boundaries. Emits steps in exactly the generic path's order (sends
+    then recvs then copies, rotated-peer-sorted), so the two planners
+    are interchangeable — the differential test holds them identical."""
+    W = src.world
+    soff = [0] * (W + 1)
+    doff = [0] * (W + 1)
+    for r in range(W):
+        soff[r + 1] = soff[r] + src.counts[r]
+        doff[r + 1] = doff[r] + dst.counts[r]
+    s0, s1 = soff[me], soff[me + 1]
+    d0, d1 = doff[me], doff[me + 1]
+    sends: list[tuple] = []
+    recvs: list[tuple] = []
+    copies: list[RedistStep] = []
+    if s1 > s0:
+        for j in range(W):
+            lo, hi = max(s0, doff[j]), min(s1, doff[j + 1])
+            if lo >= hi:
+                continue
+            if j == me:
+                copies.append(RedistStep("copy", hi - lo,
+                                         src_off=lo - s0,
+                                         dst_off=lo - d0))
+            else:
+                sends.append(((j - me) % W, lo,
+                              RedistStep("send", hi - lo,
+                                         src_off=lo - s0, peer=j)))
+    if d1 > d0:
+        for r in range(W):
+            if r == me:
+                continue
+            lo, hi = max(d0, soff[r]), min(d1, soff[r + 1])
+            if lo >= hi:
+                continue
+            recvs.append(((me - r) % W, lo,
+                          RedistStep("recv", hi - lo,
+                                     dst_off=lo - d0, peer=r)))
+    sends.sort(key=lambda t: (t[0], t[1]))
+    recvs.sort(key=lambda t: (t[0], t[1]))
+    steps = tuple([s for _, _, s in sends] + [r for _, _, r in recvs]
+                  + copies)
+    if not steps:
+        return RedistPlan("noop")
+    if all(s.kind == "copy" for s in steps):
+        return RedistPlan("local", steps)
+    return RedistPlan("p2p", steps)
+
+
 def plan_redistribute(src: ShardSpec, dst: ShardSpec,
                       me: int) -> RedistPlan:
     """Compile rank ``me``'s program for the sharding change."""
@@ -139,6 +191,27 @@ def plan_redistribute(src: ShardSpec, dst: ShardSpec,
     if (_is_even_block(dst) and src.kind == "cyclic"
             and dst.counts[0] == W * src.chunk):
         return RedistPlan("alltoall", coll_count=src.chunk)
+    if src.kind == "block" and dst.kind == "block":
+        # block->block boundary shift — the membership grow/shrink
+        # reshard shape (elastic world: ShardSpec.balanced over the old
+        # and new member counts): computed from THIS rank's own
+        # boundaries in O(W) instead of the generic whole-world
+        # interval-ownership walk below (O(W^2) per rank — a real cost
+        # when a 1024-way reshard plans on every rank). The emitted
+        # program is bit-identical to the generic path's (differential-
+        # tested), so plan minimality facts carry over: a boundary shift
+        # of k elements stays exactly one k-element transfer per
+        # affected pair.
+        return _plan_block_block(src, dst, me)
+    return _plan_generic_p2p(src, dst, me)
+
+
+def _plan_generic_p2p(src: ShardSpec, dst: ShardSpec,
+                      me: int) -> RedistPlan:
+    """The generic interval-ownership program (any spec pair). Kept
+    callable on block pairs too so the fast-path differential test can
+    hold `_plan_block_block` identical to it."""
+    W = src.world
     # -- generic point-to-point program ----------------------------------
     copies: list[RedistStep] = []
     recvs: list[tuple] = []

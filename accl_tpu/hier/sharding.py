@@ -50,8 +50,24 @@ class ShardSpec:
         """Equal blocks (n must divide evenly)."""
         if n % world:
             raise ValueError(f"{n} elements do not split evenly over "
-                             f"{world} ranks — use ShardSpec.block")
+                             f"{world} ranks — use ShardSpec.block or "
+                             f"ShardSpec.balanced")
         return cls.block((n // world,) * world)
+
+    @classmethod
+    def balanced(cls, n: int, world: int) -> "ShardSpec":
+        """Near-even blocks of a NON-divisible vector: explicit per-rank
+        counts differing by at most one element (the first ``n % world``
+        ranks carry the extra). This is the canonical membership-driven
+        layout for elastic grow/shrink reshards — a world-size change of
+        arbitrary state compiles to the block->block boundary-shift
+        program (a handful of minimal transfers) instead of requiring
+        divisibility or padding."""
+        if world <= 0:
+            raise ValueError(f"world must be positive, got {world}")
+        q, r = divmod(int(n), world)
+        return cls.block(tuple(q + 1 if i < r else q
+                               for i in range(world)))
 
     @classmethod
     def cyclic(cls, n: int, world: int, chunk: int) -> "ShardSpec":

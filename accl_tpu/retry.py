@@ -38,11 +38,16 @@ from .emulator.reliability import mix_unit
 # What a policy retries by default: failures whose cause is plausibly
 # transient wire/backpressure state. PEER_FAILED is excluded (a dead
 # peer does not come back because we ask again — shrink instead), as is
-# CALL_OUTCOME_UNKNOWN (see module docstring).
+# CALL_OUTCOME_UNKNOWN (see module docstring). JOIN_FAILED is INCLUDED:
+# membership joins and reshards are retryable phases of the elastic
+# story — a joiner may still be booting when the first handshake times
+# out (ACCL.grow_communicator re-runs the handshake under the policy;
+# redistribute's sub-calls retry like any driver call via _retry_scope).
 DEFAULT_RETRYABLE = (int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
                      | int(ErrorCode.FABRIC_QUEUE_OVERFLOW)
                      | int(ErrorCode.RECEIVE_OFFCHIP_SPARE_BUFF_OVERFLOW)
-                     | int(ErrorCode.PACK_TIMEOUT_STS_ERROR))
+                     | int(ErrorCode.PACK_TIMEOUT_STS_ERROR)
+                     | int(ErrorCode.JOIN_FAILED))
 
 
 @dataclasses.dataclass(frozen=True)

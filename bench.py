@@ -109,6 +109,15 @@ def bench_emu_fallback(reason: str) -> dict:
         ch = chaos_headline()
         for k in _CHAOS_KEYS:
             result[k] = ch[k]
+    if os.environ.get("ACCL_BENCH_MAX_RESHARD_MS"):
+        # reshard-under-traffic ladder (~5s): elastic-membership
+        # boundary-shift reshards of a 4 MiB state while a bystander
+        # tenant's latency is measured — only when its gate is armed
+        # (make bench-emu), same keep-ungated-runs-fast rule
+        from benchmarks.reshard import RESHARD_KEYS, headline as rsh
+        rs = rsh()
+        for k in RESHARD_KEYS:
+            result[k] = rs[k]
     return result
 
 
@@ -304,6 +313,52 @@ def check_serving(result: dict) -> int:
     fails = _serving_failures(result)
     for f in fails:
         print(f"FAIL: serving: {f}", file=sys.stderr)
+    return 1 if fails else 0
+
+
+def _reshard_failures(result: dict) -> list[str]:
+    """The reshard-under-traffic gates, evaluated together (armed by
+    $ACCL_BENCH_MAX_RESHARD_MS; make bench-emu sets 500):
+
+    * reshard completion p50 <= the gate — a multi-MiB membership
+      reshard is a handful of boundary transfers, never a gather-shaped
+      stall (measured ~8 ms for 4 MiB on the 2-core host);
+    * the BYSTANDER tenant's small-allreduce p99 under reshard <=
+      max($ACCL_BENCH_MAX_RESHARD_BYST_P99_MS, solo p99 +
+      $ACCL_BENCH_P99_FLOOR_US) — other tenants never blink during a
+      membership change (measured ~11 ms vs ~4 ms solo), with zero
+      errors (benchmarks/reshard.py hard-raises on any)."""
+    want = os.environ.get("ACCL_BENCH_MAX_RESHARD_MS")
+    if not want or "reshard_p50_ms" not in result:
+        return []
+    fails = []
+    if result["reshard_p50_ms"] > float(want):
+        fails.append(f"reshard p50 {result['reshard_p50_ms']} ms > "
+                     f"allowed {want} ms")
+    byst_want = os.environ.get("ACCL_BENCH_MAX_RESHARD_BYST_P99_MS")
+    if byst_want:
+        floor_ms = float(os.environ.get("ACCL_BENCH_P99_FLOOR_US",
+                                        "50000")) / 1e3
+        allowed = max(float(byst_want),
+                      result.get("reshard_byst_p99_solo_ms", 0)
+                      + floor_ms)
+        if result.get("reshard_byst_p99_ms", 0) > allowed:
+            fails.append(
+                f"bystander p99 under reshard "
+                f"{result.get('reshard_byst_p99_ms')} ms > allowed "
+                f"{allowed:.1f} ms (solo "
+                f"{result.get('reshard_byst_p99_solo_ms')} ms)")
+    if result.get("reshard_byst_calls", 1) <= 0:
+        fails.append("bystander tenant completed zero calls — the "
+                     "isolation leg measured nothing")
+    return fails
+
+
+def check_reshard(result: dict) -> int:
+    """Regression gate for the elastic-membership reshard dataplane."""
+    fails = _reshard_failures(result)
+    for f in fails:
+        print(f"FAIL: reshard: {f}", file=sys.stderr)
     return 1 if fails else 0
 
 
@@ -662,6 +717,31 @@ def main():
                 k: prev_inj.get(k, 0) + retry_ch["chaos_injected"][k]
                 for k in retry_ch["chaos_injected"]}
             result["chaos_retry"] = result.get("chaos_retry", 0) + 1
+        for _ in range(_GATE_RETRIES):
+            # best-of-three for the reshard gates too: only its ladder
+            # re-runs (a genuine dataplane regression — gather-shaped
+            # reshards, bystander starvation — fails every attempt)
+            if not _reshard_failures(result):
+                break
+            from benchmarks.reshard import headline as rsh
+            retry_rs = rsh()
+            # keep the best observation PER SUB-METRIC GROUP (the
+            # saturation/serving convention): a retry that improves one
+            # group must not replace the other group's passing value
+            # with a noisy failing one
+            if retry_rs["reshard_p50_ms"] < \
+                    result.get("reshard_p50_ms", float("inf")):
+                for k in ("reshard_p50_ms", "reshard_max_ms",
+                          "reshard_count", "reshard_moved_mib",
+                          "reshard_world", "reshard_state_mib"):
+                    result[k] = retry_rs[k]
+            if retry_rs["reshard_byst_p99_ms"] < \
+                    result.get("reshard_byst_p99_ms", float("inf")):
+                for k in ("reshard_byst_p99_ms",
+                          "reshard_byst_p99_solo_ms",
+                          "reshard_byst_calls"):
+                    result[k] = retry_rs[k]
+            result["reshard_retry"] = result.get("reshard_retry", 0) + 1
         attach_metrics_snapshot(result)
         print(json.dumps(result), flush=True)
         sys.exit(check_stream_ratio(result) or check_rd_ratio(result)
@@ -670,6 +750,7 @@ def main():
                  or check_saturation(result)
                  or check_serving(result)
                  or check_chaos_goodput(result)
+                 or check_reshard(result)
                  or check_fabric_clean(result))
     if not _probe_backend():
         # the bench contract is ONE valid JSON line with a real metric:

@@ -1,0 +1,170 @@
+"""Reshard-under-traffic ladder: the elastic-membership regression gate.
+
+A membership change's data-plane cost is a live reshard
+(``ACCL.redistribute`` between the old and new ShardSpec) executed
+while OTHER tenants keep flowing. This ladder measures both sides of
+that contract on one emu world:
+
+* **reshard completion time** — round-trip boundary-shift reshards of a
+  multi-MiB state vector (the balanced-block grow/shrink shape, uneven
+  on purpose), gated by ``$ACCL_BENCH_MAX_RESHARD_MS`` against the p50;
+* **bystander p99** — a second tenant's small allreduces run
+  continuously through every reshard; its p99 under reshard is gated by
+  ``$ACCL_BENCH_MAX_RESHARD_BYST_P99_MS``, with the saturation-bench
+  floor convention (allowed = max(gate, solo p99 +
+  ``$ACCL_BENCH_P99_FLOOR_US``) — the documented OS-noise floor of the
+  shared 2-core host), and must complete with ZERO errors.
+
+``headline()`` feeds bench.py's emulator-tier line; ``make bench-emu``
+arms both gates with the existing best-of-three retry convention.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from accl_tpu.hier import ShardSpec
+from accl_tpu.testing import add_tenant, emu_world, run_ranks
+
+WORLD = 4
+STATE_ELEMS = (1 << 20) + 5        # ~4 MiB f32, odd => uneven specs
+SHIFT = STATE_ELEMS // 8           # boundary shift per reshard
+RESHARDS = 6
+BYST_COUNT = 1024                  # 4 KiB bystander allreduce
+
+
+def _percentile(samples, p):
+    xs = sorted(samples)
+    if not xs:
+        return 0.0
+    k = min(len(xs) - 1, max(0, int(round(p / 100.0 * (len(xs) - 1)))))
+    return xs[k]
+
+
+def _shifted(spec: ShardSpec, shift: int) -> ShardSpec:
+    """Move every even boundary forward by ``shift`` — the grow/shrink-
+    shaped uneven block pair whose plan is a handful of boundary
+    transfers per rank (never a gather)."""
+    counts = list(spec.counts)
+    for i in range(0, len(counts) - 1, 2):
+        counts[i] += shift
+        counts[i + 1] -= shift
+    return ShardSpec.block(counts)
+
+
+def measure_reshard() -> dict:
+    accls = emu_world(WORLD, nbufs=64, bufsize=64 << 10, timeout=60.0,
+                      tenant="reshard")
+    bystanders = add_tenant(accls, "bystander", key=2, timeout=60.0)
+    try:
+        spec_a = ShardSpec.balanced(STATE_ELEMS, WORLD)
+        spec_b = _shifted(spec_a, SHIFT)
+        bufs = [(a.buffer((STATE_ELEMS,), np.float32),
+                 a.buffer((STATE_ELEMS,), np.float32)) for a in accls]
+        for a, (src, _dst) in zip(accls, bufs):
+            src.data[:spec_a.counts[a.rank]] = float(a.rank + 1)
+
+        # -- bystander solo leg (the p99 baseline) -----------------------
+        lat: dict[str, list] = {"solo": [], "reshard": []}
+        leg = {"name": "solo"}
+        stop = threading.Event()
+        errs: list[BaseException] = []
+        calls = [0] * WORLD
+
+        def bystander(b):
+            # the stop flag rides THROUGH the collective so every rank
+            # exits after the same round (no stranded peers mid-call)
+            src = b.buffer((BYST_COUNT,), np.float32)
+            dst = b.buffer((BYST_COUNT,), np.float32)
+            try:
+                while True:
+                    src.data[:] = 1e9 if stop.is_set() else 1.0
+                    t0 = time.perf_counter()
+                    b.allreduce(src, dst, BYST_COUNT)
+                    if b.rank == 0:
+                        lat[leg["name"]].append(time.perf_counter() - t0)
+                    if dst.data[0] >= 1e9:
+                        return
+                    calls[b.rank] += 1
+            except BaseException as exc:  # noqa: BLE001 — surfaced below
+                errs.append(exc)
+
+        threads = [threading.Thread(target=bystander, args=(b,))
+                   for b in bystanders]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)                # solo baseline window
+
+        # -- reshards under way ------------------------------------------
+        leg["name"] = "reshard"
+        time.sleep(0.05)
+        durations = []
+        moved = 0
+        for i in range(RESHARDS):
+            src_spec, dst_spec = ((spec_a, spec_b) if i % 2 == 0
+                                  else (spec_b, spec_a))
+
+            def one(a, s=src_spec, d=dst_spec):
+                src, dst = bufs[a.rank]
+                a.redistribute(src, s, dst, d)
+
+            t0 = time.perf_counter()
+            run_ranks(accls, one, timeout=120.0)
+            durations.append(time.perf_counter() - t0)
+            for a in accls:
+                bufs[a.rank] = (bufs[a.rank][1], bufs[a.rank][0])
+            moved += 2 * SHIFT * 4     # two boundaries shift per pass
+        stop.set()
+        for t in threads:
+            t.join(120.0)
+        if any(t.is_alive() for t in threads):
+            raise AssertionError(
+                "bystander thread hung past the join deadline — total "
+                "starvation must fail the ladder, not score p99=0")
+        if errs:
+            raise errs[0]
+        if not lat["solo"] or not lat["reshard"]:
+            # an empty sample list would make _percentile report a
+            # degenerate 0.0 that sails under any gate
+            raise AssertionError(
+                f"bystander produced no latency samples "
+                f"(solo={len(lat['solo'])}, "
+                f"reshard={len(lat['reshard'])})")
+    finally:
+        for a in accls:
+            a.device.deinit()
+    return {
+        "metric": f"emu_reshard_{STATE_ELEMS * 4 >> 20}MiB_{WORLD}rank",
+        "value": round(_percentile(durations, 50) * 1e3, 2),
+        "unit": "ms",
+        "reshard_world": WORLD,
+        "reshard_state_mib": round(STATE_ELEMS * 4 / (1 << 20), 2),
+        "reshard_p50_ms": round(_percentile(durations, 50) * 1e3, 2),
+        "reshard_max_ms": round(max(durations) * 1e3, 2),
+        "reshard_count": RESHARDS,
+        "reshard_moved_mib": round(moved / (1 << 20), 2),
+        "reshard_byst_p99_solo_ms": round(
+            _percentile(lat["solo"], 99) * 1e3, 2),
+        "reshard_byst_p99_ms": round(
+            _percentile(lat["reshard"], 99) * 1e3, 2),
+        "reshard_byst_calls": sum(calls),
+        "tier": "emu",
+    }
+
+
+RESHARD_KEYS = ("reshard_world", "reshard_state_mib", "reshard_p50_ms",
+                "reshard_max_ms", "reshard_count", "reshard_moved_mib",
+                "reshard_byst_p99_solo_ms", "reshard_byst_p99_ms",
+                "reshard_byst_calls")
+
+
+def headline() -> dict:
+    return measure_reshard()
+
+
+if __name__ == "__main__":
+    print(json.dumps(headline()))

@@ -26,6 +26,7 @@ import numpy as np  # noqa: E402
 
 from accl_tpu.chaos import FaultPlan, FaultRule, chaos_seed_from_env  # noqa: E402
 from accl_tpu.constants import CollectiveAlgorithm as A  # noqa: E402
+from accl_tpu.hier import ShardSpec  # noqa: E402
 from accl_tpu.testing import emu_world, run_ranks  # noqa: E402
 from accl_tpu.tracing import METRICS  # noqa: E402
 
@@ -65,6 +66,120 @@ def _oracle(algorithm):
     finally:
         for a in accls:
             a.deinit()
+
+
+# Elastic-loop cells: every fault kind (plus a heal_after flap
+# partition) through the full membership cycle — kill a rank
+# mid-training-loop -> detect -> revoke+shrink -> restore-from-replica +
+# reshard survivors -> keep training -> grow the rank back -> reshard
+# again — with the final sharded state BIT-IDENTICAL to a fault-free
+# numpy oracle on every rank.
+ELASTIC_KINDS = ("drop", "corrupt", "duplicate", "delay", "flap")
+
+
+def elastic_cell(kind: str, seed: int) -> tuple[bool, int]:
+    import time as _t
+
+    n = 8197                       # odd: every balanced spec is uneven
+    if kind == "flap":
+        rules = [FaultRule(kind="partition", group_a=(0, 1),
+                           group_b=(2, 3), heal_after=20)]
+    else:
+        rules = [FaultRule(kind=kind, prob=0.02, delay_s=0.003)]
+    plan = FaultPlan(rules, seed=seed)
+    accls = emu_world(4, timeout=20.0, nbufs=32)
+    ctx = accls[0].device.ctx
+    ctx.fabric.inject_fault(plan)
+    ctx.start_heartbeats(interval_s=0.04, budget=6)
+    # peers are only tracked once HEARD: wait until every rank has heard
+    # every other before injecting the death, or a kill landing before
+    # the victim's first beat would never be detected
+    deadline = _t.monotonic() + 5.0
+    while _t.monotonic() < deadline:
+        if all(len(a.device._peer_last) >= 3 for a in accls):
+            break
+        _t.sleep(0.02)
+
+    def grad(t):
+        i = np.arange(n, dtype=np.int64)
+        return (((i * 13 + t * 7) % 5) - 2).astype(np.float32)
+
+    o_mom = np.zeros(n, np.float32)
+    for t in range(3):
+        o_mom = np.float32(0.5) * o_mom + grad(t)
+
+    mom_a = {r: accls[r].buffer((n,), np.float32) for r in range(4)}
+    mom_b = {r: accls[r].buffer((n,), np.float32) for r in range(4)}
+    full = {r: accls[r].buffer((n,), np.float32) for r in range(4)}
+
+    def step(a, t, comm, spec, shard):
+        me = comm.local_rank
+        lo, cnt = sum(spec.counts[:me]), spec.counts[me]
+        g = grad(t)
+        shard.data[:cnt] = np.float32(0.5) * shard.data[:cnt] \
+            + g[lo:lo + cnt]
+        a.redistribute(shard, spec, full[a.rank],
+                       ShardSpec.replicated(n, spec.world), comm=comm)
+
+    try:
+        spec4 = ShardSpec.balanced(n, 4)
+
+        def phase1(a):
+            mom_a[a.rank].data[:spec4.counts[a.rank]] = 0.0
+            step(a, 0, a.comm, spec4, mom_a[a.rank])
+        run_ranks(accls, phase1, timeout=120.0)
+
+        ctx.kill_rank(3)
+        deadline = _t.monotonic() + 8.0
+        while _t.monotonic() < deadline:
+            if all(3 in accls[r].device._dead_peers for r in range(3)):
+                break
+            _t.sleep(0.02)
+        assert all(3 in accls[r].device._dead_peers for r in range(3))
+
+        c4 = spec4.counts
+        src3 = ShardSpec.block((c4[0], c4[1], c4[2] + c4[3]))
+        dst3 = ShardSpec.balanced(n, 3)
+        subs = {}
+
+        def shrink_reshard(a):
+            if a.rank == 3:
+                return
+            a.revoke()
+            subs[a.rank] = a.shrink_communicator([3])
+            if a.rank == 2:
+                lost = sum(c4[:3])
+                mom_a[2].data[c4[2]:c4[2] + c4[3]] = \
+                    full[2].data[lost:lost + c4[3]]
+            a.redistribute(mom_a[a.rank], src3, mom_b[a.rank], dst3,
+                           comm=subs[a.rank])
+            step(a, 1, subs[a.rank], dst3, mom_b[a.rank])
+        run_ranks(accls, shrink_reshard, timeout=120.0)
+
+        ctx.revive_rank(3)
+        src4 = ShardSpec.block(dst3.counts + (0,))
+        dst4 = ShardSpec.balanced(n, 4)
+        grown = {}
+
+        def grow_reshard(a):
+            if a.rank == 3:
+                grown[a.rank] = a.grow_communicator(
+                    [3], base_members=[0, 1, 2], handshake_timeout=10.0)
+            else:
+                grown[a.rank] = a.grow_communicator(
+                    [3], comm=subs[a.rank], handshake_timeout=10.0)
+            a.redistribute(mom_b[a.rank], src4, mom_a[a.rank], dst4,
+                           comm=grown[a.rank])
+            step(a, 2, grown[a.rank], dst4, mom_a[a.rank])
+        run_ranks(accls, grow_reshard, timeout=120.0)
+
+        ok = all((full[r].data == o_mom).all() for r in range(4))
+    finally:
+        ctx.stop_heartbeats()
+        ctx.fabric.clear_fault()
+        for a in accls:
+            a.deinit()
+    return ok, sum(plan.applied.values())
 
 
 def sweep(seed: int, hier: bool = True) -> int:
@@ -135,6 +250,20 @@ def sweep(seed: int, hier: bool = True) -> int:
             failures += 1
         rows.append((4, "hier", "drop", status,
                      sum(plan.applied.values()),
+                     round((time.perf_counter() - t0) * 1e3)))
+    # elastic-world cells: kill -> shrink -> reshard -> train -> grow ->
+    # reshard under each fault kind (+ the transient-partition flap)
+    for kind in ELASTIC_KINDS:
+        t0 = time.perf_counter()
+        try:
+            ok, applied = elastic_cell(kind, seed)
+            status = "ok" if ok else "DIVERGED"
+        except Exception as exc:  # noqa: BLE001 — report cell
+            ok, applied = False, 0
+            status = f"FAILED ({type(exc).__name__})"
+        if not ok:
+            failures += 1
+        rows.append((4, "elastic", kind, status, applied,
                      round((time.perf_counter() - t0) * 1e3)))
     print(f"{'W':>2} {'algorithm':>9} {'fault':>9} {'status':>18} "
           f"{'applied':>7} {'ms':>6}")

@@ -1,0 +1,1058 @@
+"""Elastic membership: grow/rejoin communicators + live resharding.
+
+PR 9 shipped the FAILURE half of elasticity (heartbeat detection,
+revoke + shrink_communicator); this suite proves the RECOVERY half:
+
+* ``ACCL.grow_communicator`` — the dual of shrink: a join protocol with
+  a bootstrap handshake (JOIN hello frames both tiers speak), seqn-epoch
+  alignment riding the existing reconfiguration machinery, and a typed
+  ``JOIN_FAILED`` when a joiner dies mid-handshake;
+* **online resharding** — a membership change drives
+  ``ACCL.redistribute`` from the old ShardSpec to the new one while
+  OTHER tenants' communicators keep flowing, holding the portable-
+  redistribution paper's memory bound (never materialize more than
+  shard + one chunk per rank) as a measured property;
+* the headline end-to-end chaos scenario: kill a rank mid-training-loop
+  -> shrink -> reshard survivors -> keep training -> grow it back ->
+  reshard again, all under a seeded FaultPlan, with final model state
+  BIT-IDENTICAL to a fault-free numpy oracle and a concurrent bystander
+  tenant completing with zero errors throughout.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu.chaos import FaultPlan, FaultRule
+from accl_tpu.communicator import Rank
+from accl_tpu.constants import ACCLError, ErrorCode, ReduceFunc
+from accl_tpu.hier import ShardSpec, plan_redistribute
+from accl_tpu.hier.redistribute import _plan_block_block, _plan_generic_p2p
+from accl_tpu.retry import RetryPolicy
+from accl_tpu.testing import add_tenant, emu_world, run_ranks
+from accl_tpu.tracing import METRICS
+
+
+def _ctx(accls):
+    return accls[0].device.ctx
+
+
+def _teardown(accls):
+    _ctx(accls).fabric.clear_fault()
+    for a in accls:
+        a.deinit()
+
+
+def _allreduce_ok(a, comm, expect):
+    src = a.buffer(data=np.ones(8, np.float32))
+    dst = a.buffer((8,), np.float32)
+    a.allreduce(src, dst, 8, comm=comm)
+    assert dst.data[0] == expect, (dst.data[0], expect)
+
+
+# ---------------------------------------------------------------------------
+# Grow: the join protocol.
+# ---------------------------------------------------------------------------
+
+def test_grow_split_to_full_world():
+    """Members of a split communicator grow it by a joiner: all three
+    drivers (two members + the joiner) call grow_communicator with the
+    same target membership, agree on the comm id without negotiation,
+    and the first collective on the grown comm works."""
+    accls = emu_world(3, timeout=5.0)
+    subs = {}
+
+    def make_sub(a):
+        if a.rank < 2:
+            subs[a.rank] = a.split_communicator([0, 1], key=5)
+    run_ranks(accls, make_sub)
+
+    grown = {}
+
+    def grow(a):
+        if a.rank == 2:
+            grown[a.rank] = a.grow_communicator(
+                [2], base_members=[0, 1], key=5)
+        else:
+            grown[a.rank] = a.grow_communicator([2], comm=subs[a.rank],
+                                                key=5)
+    run_ranks(accls, grow, timeout=30.0)
+    ids = {c.comm_id for c in grown.values()}
+    assert len(ids) == 1
+    # rank numbering is global-rank order on every member
+    assert all(c.ranks[i].global_rank == i for c in grown.values()
+               for i in range(3))
+    run_ranks(accls, lambda a: _allreduce_ok(a, grown[a.rank], 3.0))
+    _teardown(accls)
+
+
+def test_grow_back_after_shrink_rides_epoch_machinery():
+    """The canonical elastic loop: kill -> detect -> revoke -> shrink ->
+    survivors work -> revive -> grow back. The grown membership equals
+    the world comm's, so registration is a RE-configuration: the comm
+    epoch bumps (plan-cache invalidation), retx channel state resets,
+    seqn spaces restart — and the stale PEER_FAILED latch from the death
+    is purged, so the first collective on the grown comm is clean."""
+    accls = emu_world(4, timeout=5.0)
+    ctx = _ctx(accls)
+    ctx.start_heartbeats(interval_s=0.03, budget=3)
+    time.sleep(0.15)
+    epochs0 = [a.device.comm_epoch for a in accls]
+    ctx.kill_rank(3)
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        if all(3 in accls[r].device._dead_peers for r in range(3)):
+            break
+        time.sleep(0.02)
+    assert all(3 in accls[r].device._dead_peers for r in range(3))
+
+    subs = {}
+
+    def shrink(a):
+        if a.rank == 3:
+            return
+        a.revoke()
+        subs[a.rank] = a.shrink_communicator([3])
+        _allreduce_ok(a, subs[a.rank], 3.0)
+    run_ranks(accls, shrink, timeout=30.0)
+
+    ctx.revive_rank(3)
+    grown = {}
+
+    def grow(a):
+        if a.rank == 3:
+            grown[a.rank] = a.grow_communicator([3],
+                                                base_members=[0, 1, 2])
+        else:
+            grown[a.rank] = a.grow_communicator([3], comm=subs[a.rank])
+    run_ranks(accls, grow, timeout=30.0)
+
+    # same membership + key as the original world comm -> same id; the
+    # driver's registry returns the FRESH (unrevoked) object, and the
+    # default comm is the grown world again
+    for a in accls:
+        assert grown[a.rank].comm_id == a.comm.comm_id
+        assert a.comm is grown[a.rank]
+        assert not a.comm.revoked
+        # seqn-epoch alignment: fresh seqn spaces on every member
+        assert all(r.inbound_seq == 0 and r.outbound_seq == 0
+                   for r in grown[a.rank].ranks)
+    # epoch machinery: every rank's device bumped its comm epoch (the
+    # plan-cache key component) at least twice past the baseline
+    # (shrink registration + grow re-registration); no dead peers left
+    for e0, a in zip(epochs0, accls):
+        assert a.device.comm_epoch > e0
+        assert not a.device._dead_peers
+    run_ranks(accls, lambda a: _allreduce_ok(a, grown[a.rank], 4.0))
+    # metrics families exist
+    snap = METRICS.snapshot()
+    assert sum(snap["counters"].get("membership_grow_total",
+                                    {}).values()) >= 4
+    assert sum(snap["counters"].get("membership_shrink_total",
+                                    {}).values()) >= 3
+    ctx.stop_heartbeats()
+    _teardown(accls)
+
+
+def test_grow_joiner_dead_mid_handshake_is_typed_and_fast():
+    """A joiner that never enters the handshake must surface a typed
+    JOIN_FAILED on every waiting member — promptly (the handshake
+    deadline), never a collective's recv-deadline burn, and never a
+    hang. The grown comm is left revoked, so later calls refuse fast."""
+    accls = emu_world(3, timeout=10.0)
+    subs = {}
+
+    def make_sub(a):
+        if a.rank < 2:
+            subs[a.rank] = a.split_communicator([0, 1], key=5)
+    run_ranks(accls, make_sub)
+
+    def grow(a):
+        if a.rank == 2:
+            return None  # the joiner is "dead": it never calls grow
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as ei:
+            a.grow_communicator([2], comm=subs[a.rank], key=5,
+                                handshake_timeout=0.4)
+        assert ErrorCode.JOIN_FAILED in ei.value.errors
+        assert time.monotonic() - t0 < 5.0
+        return True
+
+    res = run_ranks(accls, grow, timeout=30.0)
+    assert res[:2] == [True, True]
+    snap = METRICS.snapshot()
+    assert sum(snap["counters"].get("membership_join_fail_total",
+                                    {}).values()) >= 2
+    _teardown(accls)
+
+
+def test_grow_address_table_mismatch_fails_fast_typed():
+    """The membership signature covers the ADDRESS table the comm id
+    omits: a member that learned a different (host, port) for the
+    joiner — same membership, same comm id — mismatches the handshake
+    and fails typed WITHOUT waiting out the deadline (a completed
+    bootstrap would dial the stale address as a mystery timeout)."""
+    accls = emu_world(3, timeout=10.0)
+    subs = {}
+
+    def make_sub(a):
+        if a.rank < 2:
+            subs[a.rank] = a.split_communicator([0, 1], key=5)
+    run_ranks(accls, make_sub)
+
+    def grow(a):
+        t0 = time.monotonic()
+        with pytest.raises(ACCLError) as ei:
+            if a.rank == 2:
+                a.grow_communicator([2], base_members=[0, 1], key=5,
+                                    handshake_timeout=8.0)
+            elif a.rank == 1:
+                # rank 1 believes the joiner lives elsewhere
+                a.grow_communicator(
+                    [Rank(global_rank=2, host="10.0.0.9", port=7777)],
+                    comm=subs[a.rank], key=5, handshake_timeout=8.0)
+            else:
+                a.grow_communicator([2], comm=subs[a.rank], key=5,
+                                    handshake_timeout=8.0)
+        assert ErrorCode.JOIN_FAILED in ei.value.errors
+        # mismatch is detected from the peer's hello, well under the
+        # 8 s handshake deadline
+        assert time.monotonic() - t0 < 6.0
+        return True
+
+    assert all(run_ranks(accls, grow, timeout=60.0))
+    _teardown(accls)
+
+
+def test_grow_handshake_is_a_retryable_phase():
+    """A SLOW joiner (arrives after the first handshake attempt timed
+    out) succeeds under a retry policy: JOIN_FAILED is retryable by
+    default — joins are phases, like reshard sub-calls."""
+    accls = emu_world(3, timeout=10.0)
+    subs = {}
+
+    def make_sub(a):
+        if a.rank < 2:
+            subs[a.rank] = a.split_communicator([0, 1], key=5)
+    run_ranks(accls, make_sub)
+    grown = {}
+
+    def grow(a):
+        if a.rank == 2:
+            time.sleep(0.6)  # boots late: first attempt times out
+            grown[a.rank] = a.grow_communicator(
+                [2], base_members=[0, 1], key=5, handshake_timeout=5.0)
+            return
+        grown[a.rank] = a.grow_communicator(
+            [2], comm=subs[a.rank], key=5, handshake_timeout=0.2,
+            retry_policy=RetryPolicy(retries=8, backoff_s=0.05,
+                                     backoff_max_s=0.2))
+    run_ranks(accls, grow, timeout=60.0)
+    run_ranks(accls, lambda a: _allreduce_ok(a, grown[a.rank], 3.0))
+    assert RetryPolicy(retries=1).should_retry(
+        int(ErrorCode.JOIN_FAILED), 0)
+    _teardown(accls)
+
+
+def test_rank_record_recency_survives_in_place_replacement():
+    """grow_communicator resolves member records from the driver's
+    address book (most recently REGISTERED record per global rank), not
+    from the comm registry's order: _register_comm replaces same-id
+    comms in place, so a fresh re-addressed record can live at an
+    EARLIER registry index than a stale one. Regression: a later
+    default-resolution grow must use the re-addressed record on every
+    rank — a stale-address pick on some ranks mismatches the membership
+    signature (which covers the address table) and spuriously
+    JOIN_FAILs."""
+    accls = emu_world(4, timeout=5.0)
+    # a LATER-registered comm holds rank 3's original (stale) record
+    for r in (0, 3):
+        accls[r].split_communicator([0, 3], key=11)
+
+    subs, grown = {}, {}
+
+    def shrink(a):
+        if a.rank != 3:
+            subs[a.rank] = a.shrink_communicator([3])
+    run_ranks(accls, shrink, timeout=30.0)
+
+    newrec = Rank(global_rank=3, host="127.0.0.1", port=4242)
+
+    def grow_readdressed(a):
+        if a.rank == 3:
+            grown[a.rank] = a.grow_communicator(
+                [newrec], base_members=[0, 1, 2])
+        else:
+            grown[a.rank] = a.grow_communicator([newrec],
+                                                comm=subs[a.rank])
+    run_ranks(accls, grow_readdressed, timeout=30.0)
+    # the book learned the new address on every driver, even though the
+    # replaced world comm sits earlier in the registry than the [0,3]
+    # split still holding the stale record
+    for a in accls:
+        assert a._rank_book[3].port == 4242
+
+    def shrink2(a):
+        if a.rank != 3:
+            subs[a.rank] = a.shrink_communicator([3], key=0x5A1E)
+    run_ranks(accls, shrink2, timeout=30.0)
+
+    def grow_default(a):
+        # NO explicit record: resolution must find port 4242 everywhere
+        if a.rank == 3:
+            grown[a.rank] = a.grow_communicator([3],
+                                                base_members=[0, 1, 2])
+        else:
+            grown[a.rank] = a.grow_communicator([3], comm=subs[a.rank])
+        assert grown[a.rank].ranks[3].port == 4242
+    run_ranks(accls, grow_default, timeout=30.0)
+    run_ranks(accls, lambda a: _allreduce_ok(a, grown[a.rank], 4.0))
+    _teardown(accls)
+
+
+def test_regrow_toward_still_dead_rank_fails_typed():
+    """The second kill of the same rank: after a successful grow-back,
+    the rank dies AGAIN and survivors re-grow the same membership (same
+    comm id AND signature). The handshake must prove liveness AFRESH —
+    a killed rank neither sends nor echoes join hellos, so the re-grow
+    fails typed instead of false-succeeding on the corpse's pre-death
+    handshake state. After revive, the same grow succeeds."""
+    accls = emu_world(4, timeout=5.0)
+    ctx = _ctx(accls)
+    subs, grown = {}, {}
+
+    def cycle(fn):
+        run_ranks(accls, fn, timeout=60.0)
+
+    def shrink(a):
+        if a.rank != 3:
+            subs[a.rank] = a.shrink_communicator([3])
+    cycle(shrink)
+
+    def grow_ok(a):
+        if a.rank == 3:
+            grown[a.rank] = a.grow_communicator([3],
+                                                base_members=[0, 1, 2])
+        else:
+            grown[a.rank] = a.grow_communicator([3], comm=subs[a.rank])
+    cycle(grow_ok)
+    run_ranks(accls, lambda a: _allreduce_ok(a, grown[a.rank], 4.0))
+
+    ctx.kill_rank(3)                 # dies again — no revive this time
+
+    def regrow_dead(a):
+        if a.rank == 3:
+            return None
+        with pytest.raises(ACCLError) as ei:
+            a.grow_communicator([3], comm=subs[a.rank],
+                                handshake_timeout=0.5)
+        assert ErrorCode.JOIN_FAILED in ei.value.errors
+        return True
+    assert run_ranks(accls, regrow_dead, timeout=60.0)[:3] == [True] * 3
+
+    ctx.revive_rank(3)
+    cycle(grow_ok)
+    run_ranks(accls, lambda a: _allreduce_ok(a, grown[a.rank], 4.0))
+    _teardown(accls)
+
+
+def test_grow_toward_out_of_world_rank_fails_typed():
+    """A global rank outside the fabric's world entirely (a
+    misconfigured autoscaler handing out a rank id that does not
+    exist): the handshake times out typed JOIN_FAILED — never a raw
+    fabric IndexError escaping grow_communicator."""
+    accls = emu_world(2, timeout=5.0)
+    a = accls[0]
+    with pytest.raises(ACCLError) as ei:
+        a.grow_communicator([7], handshake_timeout=0.3)
+    assert ErrorCode.JOIN_FAILED in ei.value.errors
+    _teardown(accls)
+
+
+def test_grow_argument_validation():
+    accls = emu_world(2, timeout=2.0)
+    a = accls[0]
+    with pytest.raises(ValueError):
+        a.grow_communicator([0, 1])  # nothing to grow
+    with pytest.raises(ValueError):
+        a.grow_communicator([1], comm=a.comm, base_members=[0, 1])
+    with pytest.raises(ValueError):
+        # local rank not a member of the grown comm
+        a.grow_communicator([3], base_members=[1, 3])
+    # explicit Rank records are accepted for never-seen global ranks
+    with pytest.raises(ValueError):
+        Rank(global_rank=-1), a.grow_communicator(
+            [Rank(global_rank=-1)], base_members=[0, 1])
+    _teardown(accls)
+
+
+def test_daemon_tier_grow_over_msg_join():
+    """The daemon tier speaks the same join protocol: MSG_JOIN drives
+    the handshake, hellos ride JOIN_STRM eth frames between daemons,
+    and the grown (re-configured) comm serves collectives."""
+    from accl_tpu.testing import sim_world
+    accls = sim_world(3, nbufs=16, bufsize=1 << 16)
+    try:
+        subs = {}
+
+        def make_sub(a):
+            if a.rank < 2:
+                subs[a.rank] = a.split_communicator([0, 1], key=5)
+        run_ranks(accls, make_sub)
+        grown = {}
+
+        def grow(a):
+            if a.rank == 2:
+                grown[a.rank] = a.grow_communicator(
+                    [2], base_members=[0, 1], key=5,
+                    handshake_timeout=10.0)
+            else:
+                grown[a.rank] = a.grow_communicator(
+                    [2], comm=subs[a.rank], key=5,
+                    handshake_timeout=10.0)
+        run_ranks(accls, grow, timeout=60.0)
+        assert len({c.comm_id for c in grown.values()}) == 1
+        run_ranks(accls, lambda a: _allreduce_ok(a, grown[a.rank], 3.0))
+
+        # RE-grow of the SAME membership (same comm id AND signature)
+        # after the joiner DIED must prove liveness afresh: the
+        # survivors' handshake fails typed — never satisfied by the
+        # previous handshake's stale heard-table on their daemons
+        accls[2].deinit()            # rank 2's daemon shuts down
+
+        def regrow_toward_dead_joiner(a):
+            if a.rank == 2:
+                return None
+            with pytest.raises(ACCLError) as ei:
+                a.grow_communicator([2], comm=subs[a.rank], key=5,
+                                    handshake_timeout=0.6)
+            assert ErrorCode.JOIN_FAILED in ei.value.errors
+            return True
+        assert run_ranks(accls[:2], regrow_toward_dead_joiner,
+                         timeout=60.0) == [True, True]
+    finally:
+        for a in accls[:2]:
+            a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# Churn: shrink -> grow -> shrink with seqn-epoch assertions.
+# ---------------------------------------------------------------------------
+
+def test_shrink_grow_churn_epochs_and_plan_cache():
+    """Two full shrink->grow cycles: every transition bumps the comm
+    epoch (so no compiled plan of the old membership can be served),
+    registers per-reason plan-cache invalidations, and lands on a comm
+    whose seqn spaces start at zero. Collectives work after every
+    transition."""
+    accls = emu_world(4, timeout=5.0, plan_cache=True)
+    cur = {a.rank: a.comm for a in accls}
+
+    def inval_comm(a):
+        return a.plan_cache_stats()["invalidations"].get("comm", 0)
+
+    for cycle in range(2):
+        epochs = [a.device.comm_epoch for a in accls]
+        invals = [inval_comm(a) for a in accls]
+
+        subs = {}
+
+        def shrink(a):
+            if a.rank == 3:
+                return
+            subs[a.rank] = a.shrink_communicator([3], comm=cur[a.rank],
+                                                 key=0x5A1D + cycle)
+            _allreduce_ok(a, subs[a.rank], 3.0)
+        run_ranks(accls, shrink, timeout=30.0)
+
+        grown, fresh = {}, {}
+
+        def grow(a):
+            if a.rank == 3:
+                grown[a.rank] = a.grow_communicator(
+                    [3], base_members=[0, 1, 2])
+            else:
+                grown[a.rank] = a.grow_communicator([3],
+                                                    comm=subs[a.rank])
+            # seqn-epoch alignment AT registration (traffic advances
+            # the counters immediately after)
+            fresh[a.rank] = all(r.inbound_seq == 0 and r.outbound_seq == 0
+                                for r in grown[a.rank].ranks)
+        run_ranks(accls, grow, timeout=30.0)
+        run_ranks(accls, lambda a: _allreduce_ok(a, grown[a.rank], 4.0))
+        cur = grown
+
+        for i, a in enumerate(accls):
+            # every registration bumps the epoch; the grow-back is a
+            # true RE-configuration of the world comm id
+            bumps = a.device.comm_epoch - epochs[i]
+            assert bumps >= (1 if a.rank == 3 else 2)
+            assert inval_comm(a) > invals[i]
+            assert fresh[a.rank]
+    _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# Revoke: typed fast-failure for handles already in flight.
+# ---------------------------------------------------------------------------
+
+def test_revoke_aborts_inflight_async_handle_fast():
+    """An async handle already in flight when the application revokes
+    the comm must surface PEER_FAILED promptly — never ride out the
+    full receive deadline. The latency is pinned well under the 8 s
+    deadline (regression gate for the containment property)."""
+    accls = emu_world(2, timeout=8.0)
+    a = accls[1]
+    buf = a.buffer((64,), np.float32)
+    t0 = time.monotonic()
+    h = a.recv(buf, 64, src=0, tag=77, run_async=True)  # nothing sent
+    time.sleep(0.2)
+    assert not h.done()
+    a.revoke()
+    with pytest.raises(ACCLError) as ei:
+        h.wait(6.0)
+    elapsed = time.monotonic() - t0
+    assert ErrorCode.PEER_FAILED in ei.value.errors
+    assert elapsed < 4.0, f"revoked handle took {elapsed:.1f}s"
+    # a call queued on the revoked comm fails fast and typed too
+    with pytest.raises(ACCLError) as ei2:
+        a.recv(buf, 64, src=0, tag=78)
+    assert ErrorCode.PEER_FAILED in ei2.value.errors
+    _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# Transient partitions (heal_after) — flap, then recover.
+# ---------------------------------------------------------------------------
+
+def test_heal_after_unit_semantics():
+    """heal_after counts frames MATCHING the rule's static filters and
+    deactivates the rule past the window — distinct from limit, which
+    counts firings."""
+    from accl_tpu.emulator.fabric import Envelope
+    plan = FaultPlan([FaultRule(kind="partition", group_a=(0,),
+                                group_b=(1,), heal_after=3)], seed=1)
+
+    def env(src, dst, seqn):
+        return Envelope(src=src, dst=dst, tag=0, seqn=seqn, nbytes=8,
+                        wire_dtype="float32", comm_id=9)
+
+    out = [plan(env(0, 1, q)) for q in range(6)]
+    assert out[:3] == ["drop", "drop", "drop"]
+    assert out[3:] == ["deliver"] * 3          # healed
+    assert plan(env(1, 0, 0)) == "deliver"     # still healed (shared)
+    assert "HEALED" in plan.describe()
+    # frames that do NOT match the filters never consume the window
+    plan2 = FaultPlan([FaultRule(kind="drop", dst=1, heal_after=2)],
+                      seed=1)
+    assert plan2(env(0, 2, 0)) == "deliver"    # filter miss: not seen
+    assert [plan2(env(0, 1, q)) for q in range(4)] == \
+        ["drop", "drop", "deliver", "deliver"]
+
+
+def test_transient_partition_heals_and_recovers():
+    """A flapping partition (heal_after-bounded) eats a window of
+    frames, then heals; the retransmission layer recovers everything
+    lost during the flap — the collective completes bit-identically
+    with ZERO surfaced errors. The permanent form of the same rule is
+    what PR 9's death tests use; this is the flap-then-recover shape it
+    could not express."""
+    accls = emu_world(4, timeout=20.0, nbufs=32)
+    fabric = _ctx(accls).fabric
+    plan = FaultPlan([FaultRule(kind="partition", group_a=(0, 1),
+                                group_b=(2, 3), heal_after=25)], seed=3)
+    fabric.inject_fault(plan)
+    n = 512
+    # integer-valued inputs: f32 sums are exact, so the expectation is
+    # reduction-order-independent (the differential-vs-oracle form for
+    # float data lives in test_fault_injection's chaos corpus)
+    ins = [np.random.default_rng(60 + r).integers(-8, 8, n)
+           .astype(np.float32) for r in range(4)]
+    bufs = [(a.buffer(data=ins[a.rank].copy()),
+             a.buffer((n,), np.float32)) for a in accls]
+
+    def body(a):
+        src, dst = bufs[a.rank]
+        a.allreduce(src, dst, n)
+        return dst.data.copy()
+
+    res = run_ranks(accls, body, timeout=120.0)
+    assert plan.applied["partition"] > 0, "flap never fired"
+    assert "HEALED" in plan.describe()
+    expect = np.sum(ins, axis=0, dtype=np.float32)
+    for r in res:
+        np.testing.assert_array_equal(r, res[0])
+    np.testing.assert_array_equal(res[0], expect)
+    _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# ShardSpec.balanced + the block->block planner fast path.
+# ---------------------------------------------------------------------------
+
+def test_balanced_spec_counts():
+    assert ShardSpec.balanced(10, 4).counts == (3, 3, 2, 2)
+    assert ShardSpec.balanced(8, 4).counts == (2, 2, 2, 2)
+    assert ShardSpec.balanced(3, 5).counts == (1, 1, 1, 0, 0)
+    with pytest.raises(ValueError):
+        ShardSpec.balanced(4, 0)
+
+
+def test_block_fast_path_plans_identical_to_generic():
+    """The O(W) boundary-walk planner emits bit-identical programs to
+    the generic interval-ownership walk over a randomized block-pair
+    corpus (incl. zero counts), so every existing minimality and
+    differential fact carries over to the fast path."""
+    import random
+    rng = random.Random(11)
+    for W in (2, 3, 5, 8):
+        for _ in range(60):
+            n = rng.randint(1, 48)
+
+            def counts():
+                cuts = sorted(rng.randint(0, n) for _ in range(W - 1))
+                prev, out = 0, []
+                for c in cuts + [n]:
+                    out.append(c - prev)
+                    prev = c
+                return out
+
+            src = ShardSpec.block(counts())
+            dst = ShardSpec.block(counts())
+            for me in range(W):
+                assert _plan_block_block(src, dst, me) == \
+                    _plan_generic_p2p(src, dst, me)
+
+
+def test_grow_shrink_reshard_is_minimal_boundary_shift():
+    """The membership reshard shape: balanced over the old member count
+    -> balanced over the new one compiles to a handful of boundary
+    transfers per rank (never an all-to-all of the state)."""
+    n = 65541
+    src = ShardSpec.block(ShardSpec.balanced(n, 3).counts + (0,))
+    dst = ShardSpec.balanced(n, 4)
+    total_wire = 0
+    for me in range(4):
+        p = plan_redistribute(src, dst, me)
+        assert p.kind in ("p2p", "local")
+        total_wire += sum(s.count for s in p.steps if s.kind == "send")
+    # each rank keeps the overlap of its old and new interval: the wire
+    # total is exactly the sum of ownership changes, ~= one new shard
+    # plus the boundary shifts — far below the n a gather would move
+    assert total_wire < n // 2
+
+
+def test_elastic_reshard_execution_matches_oracle():
+    """Execute the grow- and shrink-shaped reshards through the engine
+    (members= derived sub-comm for the shrink) and hold the landed
+    shards bit-identical to the serial oracle."""
+    from accl_tpu.hier import redistribute_oracle
+    n = 1013
+    accls = emu_world(4, timeout=10.0)
+    rng = np.random.default_rng(5)
+    glob = rng.standard_normal(n).astype(np.float32)
+
+    # shrink reshard: rank 2 adopted rank 3's interval, members=[0,1,2]
+    spec4 = ShardSpec.balanced(n, 4)
+    c = spec4.counts
+    src3 = ShardSpec.block((c[0], c[1], c[2] + c[3]))
+    dst3 = ShardSpec.balanced(n, 3)
+    oracle = redistribute_oracle(
+        [glob[sum(src3.counts[:r]):sum(src3.counts[:r + 1])]
+         for r in range(3)], src3, dst3)
+
+    out = {}
+
+    def body(a):
+        if a.rank == 3:
+            return
+        off = sum(src3.counts[:a.rank])
+        src = a.buffer((n,), np.float32)
+        src.data[:src3.counts[a.rank]] = \
+            glob[off:off + src3.counts[a.rank]]
+        dst = a.buffer((n,), np.float32)
+        a.redistribute(src, src3, dst, dst3, members=[0, 1, 2])
+        out[a.rank] = dst.data[:dst3.counts[a.rank]].copy()
+    run_ranks(accls, body, timeout=60.0)
+    for r in range(3):
+        np.testing.assert_array_equal(out[r], oracle[r])
+    _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# The memory-bound invariant, sampled mid-transfer.
+# ---------------------------------------------------------------------------
+
+def test_reshard_memory_bound_invariant_sampled():
+    """The paper's bound, as a measured property: during a membership
+    reshard no rank materializes more than its shard plus ~one chunk of
+    in-flight state. The fabric is throttled so the transfer takes long
+    enough to sample; both the sampled peak AND the pool's high-water
+    mark stay within the chunk bound — a gather-shaped implementation
+    (materialize the global vector, reslice) would blow it by W x."""
+    n = 1 << 16                      # 256 KiB of f32 state
+    bufsize = 16 << 10
+    accls = emu_world(4, timeout=30.0, nbufs=32, bufsize=bufsize)
+    fabric = _ctx(accls).fabric
+    # slow every link (5 ms/frame + 0.05 GB/s) so the reshard runs long
+    # enough for the sampler to observe it mid-transfer
+    for s in range(4):
+        for d in range(4):
+            if s != d:
+                fabric.set_link_profile(s, d, 5000.0, 0.05)
+    src = ShardSpec.block(ShardSpec.balanced(n, 3).counts + (0,))
+    dst = ShardSpec.balanced(n, 4)
+    shard_bytes = max(dst.counts) * 4
+    # largest single transfer any rank's plan moves (the "chunk")
+    chunk_bytes = max(s.count for me in range(4)
+                      for s in plan_redistribute(src, dst, me).steps
+                      if s.kind != "copy") * 4
+
+    stop = threading.Event()
+    peak = {"bytes": 0, "samples": 0}
+
+    def sampler():
+        while not stop.is_set():
+            occ = max(a.device.pool.occupancy() for a in accls)
+            peak["bytes"] = max(peak["bytes"], occ * bufsize)
+            peak["samples"] += 1
+            time.sleep(0.002)
+
+    th = threading.Thread(target=sampler, daemon=True)
+    th.start()
+
+    def body(a):
+        sb = a.buffer((n,), np.float32)
+        sb.data[:src.counts[a.rank]] = float(a.rank + 1)
+        db = a.buffer((n,), np.float32)
+        a.redistribute(sb, src, db, dst)
+        return db.data[:dst.counts[a.rank]].copy()
+
+    t0 = time.monotonic()
+    res = run_ranks(accls, body, timeout=120.0)
+    took = time.monotonic() - t0
+    stop.set()
+    th.join(2.0)
+    hwm_bytes = max(a.device.pool.hwm for a in accls) * bufsize
+    bound = chunk_bytes + 2 * bufsize   # one chunk + segmentation slack
+    assert peak["samples"] > 10, f"sampler starved ({took:.2f}s run)"
+    assert hwm_bytes <= bound, \
+        f"pool hwm {hwm_bytes} B blew the shard+chunk bound {bound} B"
+    assert peak["bytes"] <= bound
+    # the bound is meaningfully BELOW materializing the global vector
+    assert bound < n * 4 // 2
+    # and the data landed correctly
+    for r in range(4):
+        assert res[r].shape[0] == dst.counts[r]
+    fabric.clear_link_profiles()
+    _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# Cross-tenant isolation: tenant B never blinks during A's membership ops.
+# ---------------------------------------------------------------------------
+
+def test_bystander_tenant_flows_through_membership_churn():
+    """Tenant A churns its membership (shrink -> reshard -> grow ->
+    reshard) while tenant B's communicator on the SAME devices runs a
+    continuous stream of collectives: B completes every call with zero
+    errors — membership state is per-comm, never per-device."""
+    n = 4096
+    accls = emu_world(4, timeout=15.0, tenant="elastic", nbufs=32)
+    other = add_tenant(accls, "bystander", key=2)
+    stop = threading.Event()
+    errors = []
+    counts = [0] * 4
+
+    def bystander(b):
+        # the stop signal rides THROUGH the collective (a stopping rank
+        # contributes a sentinel value): every rank exits after the SAME
+        # round, so shutdown can never strand peers inside a collective
+        # mid-round waiting for a rank that already left
+        src = b.buffer((256,), np.float32)
+        dst = b.buffer((256,), np.float32)
+        while True:
+            leaving = stop.is_set()
+            src.data[:] = 1000.0 if leaving else float(b.rank + 1)
+            try:
+                b.allreduce(src, dst, 256)
+                if dst.data[0] >= 1000.0:
+                    return           # some rank is leaving: all leave
+                assert dst.data[0] == 10.0
+                counts[b.rank] += 1
+            except Exception as exc:  # noqa: BLE001 — collected
+                errors.append(exc)
+                return
+
+    bys = [threading.Thread(target=bystander, args=(b,), daemon=True)
+           for b in other]
+    for t in bys:
+        t.start()
+
+    try:
+        spec4 = ShardSpec.balanced(n, 4)
+        c = spec4.counts
+        src3 = ShardSpec.block((c[0], c[1], c[2] + c[3]))
+        dst3 = ShardSpec.balanced(n, 3)
+        subs, grown = {}, {}
+        state = {r: accls[r].buffer((n,), np.float32) for r in range(4)}
+        scratch = {r: accls[r].buffer((n,), np.float32)
+                   for r in range(4)}
+
+        def shrink_and_reshard(a):
+            if a.rank == 3:
+                return
+            subs[a.rank] = a.shrink_communicator([3])
+            state[a.rank].data[:src3.counts[a.rank]] = float(a.rank)
+            a.redistribute(state[a.rank], src3, scratch[a.rank], dst3,
+                           comm=subs[a.rank])
+        run_ranks(accls, shrink_and_reshard, timeout=60.0)
+
+        src4 = ShardSpec.block(dst3.counts + (0,))
+        dst4 = ShardSpec.balanced(n, 4)
+
+        def grow_and_reshard(a):
+            if a.rank == 3:
+                grown[a.rank] = a.grow_communicator(
+                    [3], base_members=[0, 1, 2])
+            else:
+                grown[a.rank] = a.grow_communicator([3],
+                                                    comm=subs[a.rank])
+            a.redistribute(scratch[a.rank], src4, state[a.rank], dst4,
+                           comm=grown[a.rank])
+        run_ranks(accls, grow_and_reshard, timeout=60.0)
+    finally:
+        stop.set()
+        for t in bys:
+            t.join(10.0)
+
+    assert not errors, f"bystander tenant saw errors: {errors!r}"
+    assert all(cnt > 0 for cnt in counts), counts
+    _teardown(accls)
+    for b in other:
+        b.deinit()
+
+
+# ---------------------------------------------------------------------------
+# THE headline: kill mid-training -> shrink -> reshard -> train -> grow
+# back -> reshard, chaos-gated, bit-identical to the fault-free oracle.
+# ---------------------------------------------------------------------------
+
+def test_e2e_elastic_training_loop_under_chaos_bit_identical():
+    n = 131077                      # odd size: every spec is UNEVEN
+    probe_n = 64
+    beta, lr = np.float32(0.5), np.float32(0.5)
+
+    def grad(t):
+        # deterministic, membership-independent integer-valued grads:
+        # exact in f32, so the oracle replay is bit-identical
+        i = np.arange(n, dtype=np.int64)
+        return (((i * 13 + t * 7) % 5) - 2).astype(np.float32)
+
+    def pulse(t):
+        return np.float32(t % 11 + 1)
+
+    # ---- fault-free numpy oracle ---------------------------------------
+    o_param = np.zeros(n, np.float32)
+    o_mom = np.zeros(n, np.float32)
+    for t in range(6):              # 2 steps x 3 membership phases
+        o_mom = beta * o_mom + grad(t)
+        o_param = o_param + lr * o_mom  # probe term is exactly 0
+
+    # ---- the elastic world under seeded chaos --------------------------
+    bufsize = 16 << 10
+    accls = emu_world(4, timeout=20.0, nbufs=64, bufsize=bufsize,
+                      tenant="trainer")
+    ctx = _ctx(accls)
+    plan = FaultPlan([
+        FaultRule(kind="drop", prob=0.02),
+        FaultRule(kind="delay", prob=0.02, delay_s=0.002),
+    ], seed=20260804)
+    ctx.fabric.inject_fault(plan)
+    ctx.start_heartbeats(interval_s=0.05, budget=6)
+
+    # bystander tenant on a survivor-only communicator, flowing through
+    # the WHOLE scenario (kill included) with zero errors
+    other = add_tenant(accls, "bystander", key=2)
+    stop = threading.Event()
+    bys_errors, bys_counts = [], [0] * 4
+    bys_subs = {}
+
+    def make_bys_sub(b):
+        if b.rank < 3:
+            bys_subs[b.rank] = b.split_communicator([0, 1, 2], key=9)
+    run_ranks(other, make_bys_sub)
+
+    def bystander(b):
+        if b.rank == 3:
+            return
+        # collective-carried stop flag (see the churn test): all three
+        # ranks exit after the same round
+        src = b.buffer((128,), np.float32)
+        dst = b.buffer((128,), np.float32)
+        while True:
+            src.data[:] = 1000.0 if stop.is_set() else 1.0
+            try:
+                b.allreduce(src, dst, 128, comm=bys_subs[b.rank])
+                if dst.data[0] >= 1000.0:
+                    return
+                assert dst.data[0] == 3.0
+                bys_counts[b.rank] += 1
+            except Exception as exc:  # noqa: BLE001
+                bys_errors.append(exc)
+                return
+
+    bys = [threading.Thread(target=bystander, args=(b,), daemon=True)
+           for b in other[:3]]
+    for th in bys:
+        th.start()
+
+    # per-rank training state
+    param = {r: accls[r].buffer((n,), np.float32) for r in range(4)}
+    mom_a = {r: accls[r].buffer((n,), np.float32) for r in range(4)}
+    mom_b = {r: accls[r].buffer((n,), np.float32) for r in range(4)}
+    mom_full = {r: accls[r].buffer((n,), np.float32) for r in range(4)}
+    probe = {r: (accls[r].buffer((probe_n,), np.float32),
+                 accls[r].buffer((probe_n,), np.float32))
+             for r in range(4)}
+
+    def step(a, t, comm, spec, shard):
+        """One training step on membership `comm` with momentum sharded
+        as `spec` in buffer `shard`: a chaos-exercised MAX-allreduce
+        probe (membership-invariant result, folded into the update so a
+        corrupted collective would corrupt the state), elementwise
+        momentum update on the local shard, reshard-to-replicated
+        gather, parameter update."""
+        ps, pd = probe[a.rank]
+        ps.data[:] = pulse(t)
+        a.allreduce(ps, pd, probe_n, func=ReduceFunc.MAX, comm=comm)
+        r_val = np.float32(pd.data[0])
+        me = comm.local_rank
+        lo = sum(spec.counts[:me])
+        cnt = spec.counts[me]
+        g = grad(t)
+        shard.data[:cnt] = beta * shard.data[:cnt] + g[lo:lo + cnt]
+        a.redistribute(shard, spec, mom_full[a.rank],
+                       ShardSpec.replicated(n, spec.world), comm=comm)
+        param[a.rank].data[:] = (param[a.rank].data
+                                 + lr * mom_full[a.rank].data
+                                 + (r_val - pulse(t)))
+
+    spec4 = ShardSpec.balanced(n, 4)
+
+    def phase1(a):
+        lo = sum(spec4.counts[:a.rank])
+        cnt = spec4.counts[a.rank]
+        mom_a[a.rank].data[:cnt] = 0.0
+        for t in (0, 1):
+            step(a, t, a.comm, spec4, mom_a[a.rank])
+    run_ranks(accls, phase1, timeout=120.0)
+
+    # ---- kill mid-loop -> detect -> shrink -> reshard survivors --------
+    ctx.kill_rank(3)
+    deadline = time.monotonic() + 6.0
+    while time.monotonic() < deadline:
+        if all(3 in accls[r].device._dead_peers for r in range(3)):
+            break
+        time.sleep(0.02)
+    assert all(3 in accls[r].device._dead_peers for r in range(3))
+
+    c4 = spec4.counts
+    src3 = ShardSpec.block((c4[0], c4[1], c4[2] + c4[3]))
+    dst3 = ShardSpec.balanced(n, 3)
+    subs = {}
+
+    def shrink_reshard(a):
+        if a.rank == 3:
+            return
+        a.revoke()
+        subs[a.rank] = a.shrink_communicator([3])
+        if a.rank == 2:
+            # adopt the dead rank's momentum interval from the
+            # replicated copy (the per-step gather doubles as a live
+            # replica — the restore-from-replica half of recovery)
+            lo = sum(c4[:2])
+            lost_lo = sum(c4[:3])
+            mom_a[2].data[c4[2]:c4[2] + c4[3]] = \
+                mom_full[2].data[lost_lo:lost_lo + c4[3]]
+        a.redistribute(mom_a[a.rank], src3, mom_b[a.rank], dst3,
+                       comm=subs[a.rank])
+    run_ranks(accls, shrink_reshard, timeout=120.0)
+
+    def phase2(a):
+        if a.rank == 3:
+            return
+        for t in (2, 3):
+            step(a, t, subs[a.rank], dst3, mom_b[a.rank])
+    run_ranks(accls, phase2, timeout=120.0)
+
+    # ---- grow the rank back -> reshard again ---------------------------
+    ctx.revive_rank(3)
+    src4 = ShardSpec.block(dst3.counts + (0,))
+    dst4 = ShardSpec.balanced(n, 4)
+    grown = {}
+
+    def grow_and_bootstrap(a):
+        if a.rank == 3:
+            grown[a.rank] = a.grow_communicator(
+                [3], base_members=[0, 1, 2], handshake_timeout=10.0)
+        else:
+            grown[a.rank] = a.grow_communicator(
+                [3], comm=subs[a.rank], handshake_timeout=10.0)
+        # rejoining rank bootstraps params from rank 0 (chaos-exercised
+        # bcast); the reshard below deals it its momentum shard
+        a.bcast(param[a.rank], n, root=0, comm=grown[a.rank])
+    run_ranks(accls, grow_and_bootstrap, timeout=120.0)
+
+    # the shard+chunk memory bound, asserted MID-RESHARD: sampled pool
+    # bytes during the grow reshard never approach a full-state gather
+    # (bystander frames ride the same pools — the slack term covers
+    # their 512 B segments)
+    peak = {"bytes": 0}
+    sampling = threading.Event()
+    sampling.set()
+
+    def sampler():
+        while sampling.is_set():
+            occ = max(a.device.pool.occupancy() for a in accls)
+            peak["bytes"] = max(peak["bytes"], occ * bufsize)
+            time.sleep(0.001)
+    sth = threading.Thread(target=sampler, daemon=True)
+    sth.start()
+
+    def grow_reshard(a):
+        a.redistribute(mom_b[a.rank], src4, mom_a[a.rank], dst4,
+                       comm=grown[a.rank])
+    run_ranks(accls, grow_reshard, timeout=120.0)
+    sampling.clear()
+    sth.join(2.0)
+    chunk_bytes = max(s.count for me in range(4)
+                      for s in plan_redistribute(src4, dst4, me).steps
+                      if s.kind != "copy") * 4
+    bound = chunk_bytes + 6 * bufsize
+    assert peak["bytes"] <= bound, \
+        f"mid-reshard pool peak {peak['bytes']} B > bound {bound} B"
+    assert bound < n * 4, "bound must be below a full-state gather"
+
+    def phase3(a):
+        for t in (4, 5):
+            step(a, t, grown[a.rank], dst4, mom_a[a.rank])
+    run_ranks(accls, phase3, timeout=120.0)
+
+    stop.set()
+    for th in bys:
+        th.join(15.0)
+    ctx.stop_heartbeats()
+
+    # ---- verdicts ------------------------------------------------------
+    assert sum(plan.applied.values()) > 0, "chaos schedule never fired"
+    assert not bys_errors, f"bystander saw errors: {bys_errors!r}"
+    assert all(cnt > 0 for cnt in bys_counts[:3]), bys_counts
+    for r in range(4):
+        np.testing.assert_array_equal(param[r].data, o_param)
+        np.testing.assert_array_equal(mom_full[r].data, o_mom)
+    _teardown(accls)
+    for b in other:
+        b.deinit()
