@@ -67,8 +67,22 @@ class ACCL:
                  max_segment_size: int | None = None,
                  arith_registry=None, tuner=None,
                  tenant: str | None = None,
-                 retry_policy: "RetryPolicy | None" = None):
+                 retry_policy: "RetryPolicy | None" = None,
+                 verify_integrity: bool = False):
         self.device = device
+        # Tier-2 integrity (PR 13): verify replicated-result collectives
+        # (allreduce / allgather / bcast) by fingerprinting the result
+        # buffer (crc32 — cheap, and exact because the engines hold
+        # results bit-identical across ranks) and cross-checking the
+        # fingerprints in a small follow-up allgather. Catches what
+        # retransmission cannot: LOCAL combine/scratch/memory corruption
+        # that lands a wrong result with a clean wire. A mismatch raises
+        # typed DATA_INTEGRITY_ERROR naming the disagreeing rank(s) —
+        # never blind-retried (retry.py). Must be UNIFORM across the
+        # ranks of a communicator (the exchange is itself a collective),
+        # like retry policies. Sync calls only; per-call
+        # ``verify_integrity=`` overrides either way.
+        self.verify_integrity = bool(verify_integrity)
         # driver-wide default retry policy (accl_tpu/retry.py): applied
         # to every data call unless a per-call retries=/retry_policy=
         # overrides it. Must be UNIFORM across the ranks of a
@@ -1025,6 +1039,110 @@ class ACCL:
                 return c
         raise KeyError(f"no communicator with id {comm_id}")
 
+    # -- tier-2 integrity: cross-rank result fingerprinting ----------------
+    def _want_verify(self, explicit: bool | None, run_async: bool,
+                     compressing: bool = False) -> bool:
+        """Per-call ``verify_integrity=`` over the driver default. Sync
+        calls only: verification is a follow-up collective issued from
+        the calling thread — an explicit request on an async call is an
+        error (silently skipping it would fake coverage), the driver
+        default just doesn't apply there. Wire-compressed calls are
+        likewise excluded: lossy dtype narrowing legitimately
+        desynchronizes result BYTES across roles (a bcast root keeps
+        its original-precision buffer while receivers hold the
+        narrowed-then-widened values), so a byte fingerprint would
+        raise a false DATA_INTEGRITY_ERROR on a perfectly healthy
+        wire — the driver default skips them, an explicit request
+        raises."""
+        if explicit is None and self._parent_tag:
+            # phases of a hierarchical/redistribute lowering: the
+            # LOGICAL call verifies its final result once — per-phase
+            # exchanges would multiply the cost without adding coverage
+            return False
+        want = self.verify_integrity if explicit is None else bool(explicit)
+        if not want:
+            return False
+        if compressing:
+            if explicit:
+                raise ValueError(
+                    "verify_integrity cannot cover a compress_dtype "
+                    "call: lossy wire narrowing makes result bytes "
+                    "legitimately differ across ranks (the root/owner "
+                    "keeps original precision), so a fingerprint "
+                    "mismatch would not mean corruption")
+            return False
+        if run_async:
+            if explicit:
+                raise ValueError(
+                    "verify_integrity requires a synchronous call (the "
+                    "fingerprint exchange is a follow-up collective on "
+                    "the calling thread); wait the handle and verify "
+                    "via a sync call, or use the driver-wide default")
+            return False
+        return True
+
+    def fingerprint_of(self, buf: ACCLBuffer, nelems: int | None = None
+                       ) -> int:
+        """Cheap content fingerprint of a result buffer: crc32 over the
+        first ``nelems`` elements' raw bytes. Exact across ranks because
+        the execution engines hold collective results BIT-identical (the
+        differential-test invariant) — equal data, equal fingerprint."""
+        import zlib
+        flat = np.ascontiguousarray(buf.data).reshape(-1)
+        if nelems is not None:
+            flat = flat[:nelems]
+        return zlib.crc32(flat.view(np.uint8)) & 0xFFFFFFFF
+
+    def _verify_result(self, op: str, buf: ACCLBuffer, nelems: int,
+                       comm: Communicator):
+        """The tier-2 cross-check: allgather every rank's result
+        fingerprint (one int64 — the exchange rides the now-self-healing
+        wire like any small collective) and compare. A disagreement
+        means some rank's RESULT bytes differ — local combine/scratch/
+        memory corruption, the class neither retransmission nor the wire
+        checksum can see — and raises typed DATA_INTEGRITY_ERROR naming
+        the minority rank(s)."""
+        fp = self.fingerprint_of(buf, nelems)
+        W = comm.size
+        src = self._scratch(1, np.int64)
+        dst = self._scratch(W, np.int64)
+        src.data[0] = fp
+        self.allgather(src, dst, 1, comm=comm, verify_integrity=False)
+        fps = dst.data[:W].copy()
+        if TRACE.enabled:
+            TRACE.emit("fingerprint", rank=self.rank, seqn=comm.comm_id,
+                       peer=-1, nbytes=int(fp))
+        if (fps == fp).all():
+            METRICS.inc("integrity_verified_total", op=op,
+                        comm_id=comm.comm_id, rank=self.rank)
+            return
+        vals, counts = np.unique(fps, return_counts=True)
+        if counts.max() * 2 > W:
+            majority = vals[counts.argmax()]
+            bad = [r for r in range(W) if fps[r] != majority]
+            what = f"rank(s) {bad} disagree"
+        else:
+            # no STRICT majority (always the case at W=2, or an even
+            # split): attributing the corruption to either side would
+            # be a coin flip that steers an operator at the wrong host
+            # half the time — name every rank and say so
+            bad = list(range(W))
+            what = (f"no majority fingerprint — the split is "
+                    f"undecidable, any of rank(s) {bad} may hold the "
+                    f"corrupt result")
+        METRICS.inc("integrity_mismatch_total", op=op,
+                    comm_id=comm.comm_id, rank=self.rank)
+        log.error(
+            "rank %d: %s result fingerprint mismatch on comm %d — "
+            "%s (fingerprints %s). Local data "
+            "corruption: NOT retried (a re-execution could mask it).",
+            self.rank, op, comm.comm_id, what, [int(f) for f in fps],
+            extra={"rank": self.rank})
+        raise ACCLError(
+            int(ErrorCode.DATA_INTEGRITY_ERROR),
+            f"{op} on comm {comm.comm_id}: result fingerprint "
+            f"mismatch — {what}")
+
     # -- primitives (parity: accl.py:738-985) ------------------------------
     def nop(self, run_async: bool = False, chain: bool = False,
             waitfor: Sequence[CallHandle] = (),
@@ -1245,23 +1363,33 @@ class ACCL:
               run_async: bool = False, chain: bool = False,
               waitfor: Sequence[CallHandle] = (),
               retries: int | None = None,
-              retry_policy: "RetryPolicy | None" = None
+              retry_policy: "RetryPolicy | None" = None,
+              verify_integrity: bool | None = None
               ) -> CallHandle:
         comm = comm or self.comm
         count = count if count is not None else buf.size
+        verify = self._want_verify(verify_integrity, run_async,
+                                   compress_dtype is not None)
         if self._hier_route("bcast", comm, count, buf.dtype.itemsize,
                             algorithm):
             with self._retry_scope(retries, retry_policy):
-                return self._hier.run("bcast", count=count, src=buf,
-                                      root=root,
-                                      compress_dtype=compress_dtype,
-                                      run_async=run_async, waitfor=waitfor)
+                handle = self._hier.run("bcast", count=count, src=buf,
+                                        root=root,
+                                        compress_dtype=compress_dtype,
+                                        run_async=run_async,
+                                        waitfor=waitfor)
+            if verify:
+                self._verify_result("bcast", buf, count, comm)
+            return handle
         desc = self._prepare(CCLOp.bcast, count=count, comm=comm,
                              root_src_dst=root, op0=buf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
-        return self._call(desc, run_async, waitfor, chain,
-                          retries, retry_policy)
+        handle = self._call(desc, run_async, waitfor, chain,
+                            retries, retry_policy)
+        if verify:
+            self._verify_result("bcast", buf, count, comm)
+        return handle
 
     def scatter(self, srcbuf: ACCLBuffer | None, dstbuf: ACCLBuffer,
                 count: int, root: int = 0, *,
@@ -1355,24 +1483,37 @@ class ACCL:
                   run_async: bool = False, chain: bool = False,
                   waitfor: Sequence[CallHandle] = (),
                   retries: int | None = None,
-                  retry_policy: "RetryPolicy | None" = None
+                  retry_policy: "RetryPolicy | None" = None,
+                  verify_integrity: bool | None = None
                   ) -> CallHandle:
         comm = comm or self.comm
+        verify = self._want_verify(verify_integrity, run_async,
+                                   compress_dtype is not None)
         if self._hier_route(
                 "allgather", comm, count,
                 max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
                 algorithm):
             with self._retry_scope(retries, retry_policy):
-                return self._hier.run("allgather", count=count, src=srcbuf,
-                                      dst=dstbuf,
-                                      compress_dtype=compress_dtype,
-                                      run_async=run_async, waitfor=waitfor)
+                handle = self._hier.run("allgather", count=count,
+                                        src=srcbuf, dst=dstbuf,
+                                        compress_dtype=compress_dtype,
+                                        run_async=run_async,
+                                        waitfor=waitfor)
+            if verify:
+                self._verify_result("allgather", dstbuf,
+                                    count * comm.size, comm)
+            return handle
         desc = self._prepare(CCLOp.allgather, count=count, comm=comm,
                              op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
-        return self._call(desc, run_async, waitfor, chain,
-                          retries, retry_policy)
+        handle = self._call(desc, run_async, waitfor, chain,
+                            retries, retry_policy)
+        if verify:
+            # the replicated result is the whole gathered vector
+            self._verify_result("allgather", dstbuf, count * comm.size,
+                                comm)
+        return handle
 
     def allreduce(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int,
                   func: ReduceFunc = ReduceFunc.SUM, *,
@@ -1382,24 +1523,34 @@ class ACCL:
                   run_async: bool = False, chain: bool = False,
                   waitfor: Sequence[CallHandle] = (),
                   retries: int | None = None,
-                  retry_policy: "RetryPolicy | None" = None
+                  retry_policy: "RetryPolicy | None" = None,
+                  verify_integrity: bool | None = None
                   ) -> CallHandle:
         comm = comm or self.comm
+        verify = self._want_verify(verify_integrity, run_async,
+                                   compress_dtype is not None)
         if self._hier_route(
                 "allreduce", comm, count,
                 max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
                 algorithm):
             with self._retry_scope(retries, retry_policy):
-                return self._hier.run("allreduce", count=count, src=srcbuf,
-                                      dst=dstbuf, func=func,
-                                      compress_dtype=compress_dtype,
-                                      run_async=run_async, waitfor=waitfor)
+                handle = self._hier.run("allreduce", count=count,
+                                        src=srcbuf, dst=dstbuf, func=func,
+                                        compress_dtype=compress_dtype,
+                                        run_async=run_async,
+                                        waitfor=waitfor)
+            if verify:
+                self._verify_result("allreduce", dstbuf, count, comm)
+            return handle
         desc = self._prepare(CCLOp.allreduce, count=count, comm=comm,
                              func=func, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
                              algorithm=algorithm)
-        return self._call(desc, run_async, waitfor, chain,
-                          retries, retry_policy)
+        handle = self._call(desc, run_async, waitfor, chain,
+                            retries, retry_policy)
+        if verify:
+            self._verify_result("allreduce", dstbuf, count, comm)
+        return handle
 
     def reduce_scatter(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer,
                        count: int, func: ReduceFunc = ReduceFunc.SUM, *,

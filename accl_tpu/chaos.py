@@ -17,10 +17,14 @@ A plan is itself a valid ``inject_fault`` hook (callable ``(env, payload)
 ``LocalFabric.inject_fault(plan)``, ``UdpEthFabric.inject_fault(plan)``,
 tests, ``scripts/chaos_sweep.py`` and ``benchmarks/chaos.py``.
 
-Actions: ``drop`` | ``corrupt`` (seqn corruption — the receiver-side
-retransmit tracker rejects it at the horizon) | ``duplicate`` | ``delay``
-(the fabric sleeps ``delay_s`` on the sender thread before delivering) |
-``partition`` (drop every frame crossing the rule's two rank groups).
+Actions: ``drop`` | ``corrupt_seq`` (seqn corruption, ``corrupt`` kept
+as a back-compat alias — the receiver-side retransmit tracker rejects
+it at the horizon) | ``corrupt_payload`` (a payload bit-flip with the
+header intact — invisible to the seqn horizon, caught only by the
+payload-checksum tier, accl_tpu/emulator/protocol.py ``csum_of``) |
+``duplicate`` | ``delay`` (the fabric sleeps ``delay_s`` on the sender
+thread before delivering) | ``partition`` (drop every frame crossing
+the rule's two rank groups).
 """
 
 from __future__ import annotations
@@ -32,9 +36,17 @@ from typing import Sequence
 
 from .emulator.reliability import mix_unit
 
-KINDS = ("drop", "corrupt", "duplicate", "delay", "partition")
+KINDS = ("drop", "corrupt_seq", "corrupt_payload", "duplicate", "delay",
+         "partition")
 
-_ACTION_OF = {"drop": "drop", "corrupt": "corrupt_seq",
+# back-compat: "corrupt" predates the payload-corruption kind and always
+# meant seqn corruption — existing FaultPlans (and the chaos sweep's
+# saved seeds) keep working, normalized at rule construction so
+# ``describe()`` and the ``applied`` accounting speak the new name
+_KIND_ALIASES = {"corrupt": "corrupt_seq"}
+
+_ACTION_OF = {"drop": "drop", "corrupt_seq": "corrupt_seq",
+              "corrupt_payload": "corrupt_payload",
               "duplicate": "duplicate", "partition": "drop"}
 
 
@@ -96,6 +108,8 @@ class FaultRule:
     group_b: tuple = ()               # crossing a<->b (either way) drop
 
     def __post_init__(self):
+        if self.kind in _KIND_ALIASES:  # frozen dataclass: object.__setattr__
+            object.__setattr__(self, "kind", _KIND_ALIASES[self.kind])
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; "
                              f"valid: {KINDS}")

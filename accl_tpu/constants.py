@@ -298,6 +298,17 @@ class ErrorCode(enum.IntFlag):
     # policies treat it as retryable — unlike PEER_FAILED, which names a
     # peer that was alive and stopped answering
     JOIN_FAILED = 1 << 30
+    # end-to-end data integrity (PR 13): a payload failed its checksum
+    # with RECOVERY disabled (wire corruption surfacing as itself at
+    # retx_window=0 instead of as a silent wrong result or a generic
+    # recv deadline), a cross-rank result-fingerprint exchange
+    # disagreed (ACCL(verify_integrity=...) — local combine/scratch/
+    # memory corruption retransmission cannot catch), or a checkpoint's
+    # content checksum failed at load (utils/checkpoint.py). NEVER
+    # blind-retryable: with retransmission armed, wire corruption
+    # self-heals invisibly, so this word reaching the application means
+    # the data itself — not the transport — is suspect
+    DATA_INTEGRITY_ERROR = 1 << 31
 
 
 class StackType(enum.IntEnum):

@@ -58,12 +58,16 @@ class EmuContext:
                  service: "ServiceConfig | bool | None" = None,
                  hosts=None, inter_alpha_us: float | None = None,
                  inter_beta_gbps: float | None = None,
-                 retx_window: int | None = None):
+                 retx_window: int | None = None,
+                 csum: bool | None = None):
         self.world_size = world_size
         # ``retx_window`` sets the fabric's selective-retransmission
         # in-flight window (None = $ACCL_TPU_RETX_WINDOW / process
-        # default, 0 = pre-retransmit fault-surfacing behavior)
-        self.fabric = LocalFabric(world_size, retx_window=retx_window)
+        # default, 0 = pre-retransmit fault-surfacing behavior);
+        # ``csum`` arms/disarms payload checksums (None = $ACCL_TPU_CSUM,
+        # default on — the corrupt-as-loss integrity tier)
+        self.fabric = LocalFabric(world_size, retx_window=retx_window,
+                                  csum=csum)
         # membership: heartbeat thread state (armed via start_heartbeats)
         self._hb_stop: threading.Event | None = None
         self._hb_killed: set[int] = set()
@@ -271,7 +275,8 @@ class EmuDevice(Device):
             pool_fn=lambda: self.pool, comm_of=self.comms.get,
             tenant_of=self.tenant_of_comm,
             timeout_fn=lambda: self.timeout,
-            seg_fn=lambda: self.max_segment_size, tier="emu")
+            seg_fn=lambda: self.max_segment_size, tier="emu",
+            csum_fn=lambda: ctx.fabric.csum)
         # membership state (armed via ctx.start_heartbeats): peers are
         # tracked once heard from; a dead peer fail-fasts calls on every
         # comm containing it until shrink_communicator rebuilds

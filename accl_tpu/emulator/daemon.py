@@ -92,12 +92,97 @@ def probe_peer_caps(host: str, port: int,
 
 def _env_from_eth_frame(frame: bytes) -> tuple[Envelope, bytes]:
     """Decode an eth frame (post-MSG_ETH byte) into (Envelope, payload) —
-    shared by both fabric stacks so the header format lives in one place."""
+    shared by both fabric stacks so the header format lives in one place.
+    A trailing integrity word (checksummed sender) rides into
+    ``env.csum`` for the landing verify; frames from unchecksummed
+    senders decode with ``csum=None`` and skip verification."""
     hdr, payload = P.unpack_eth(frame)
     env = Envelope(src=hdr["src"], dst=hdr["dst"], tag=hdr["tag"],
                    seqn=hdr["seqn"], nbytes=hdr["nbytes"],
                    wire_dtype=P.code_dtype(hdr["dtype"]).name,
-                   strm=hdr["strm"], comm_id=hdr["comm_id"])
+                   strm=hdr["strm"], comm_id=hdr["comm_id"],
+                   csum=hdr["csum"])
+    return env, payload
+
+
+def _verify_frame(env: Envelope, payload, fabric: str, stats: dict,
+                  retx, latch_fn, enabled: bool = True,
+                  stats_lock=None) -> bool:
+    """Shared landing check for the socket fabrics (the LocalFabric
+    twin lives on the fabric itself), covering pool-destined (strm=0)
+    AND stream-port (strm=1) payloads — RMA lanes (4/5) are verified by
+    the engine against its own NACK machinery, and the remaining lanes
+    (ACK/heartbeat/join) are control frames the checksum tier does not
+    cover. False = the payload failed its checksum and must be treated
+    exactly like a drop. With a retransmission layer armed (UDP, strm=0)
+    the frame stays UNACKED so the sender's RTO re-fetches the original;
+    without one (TCP, retx_window=0, or the never-retransmitted stream
+    lane) the typed DATA_INTEGRITY_ERROR latches per comm at verify
+    time, surfacing in the pending recv's error word.
+
+    ``enabled`` mirrors the fabric's own csum flag: a daemon with
+    checksums off ($ACCL_TPU_CSUM=0) or pinned off at configure time
+    (variant-mismatched peer) must stop VERIFYING too, not just stop
+    emitting — its CRC variant may be the very thing that disagrees."""
+    if not enabled or env.csum is None or env.strm > 1 \
+            or P.csum_of(payload) == env.csum:
+        return True
+    if stats_lock is not None:
+        # TCP runs one receive loop PER inbound connection: the
+        # read-modify-write below would lose increments under
+        # concurrent corruption drops (the UDP fabric's single recv
+        # thread needs no lock). Failure path only — the clean path
+        # returned above.
+        with stats_lock:
+            stats["integrity_failed"] = \
+                stats.get("integrity_failed", 0) + 1
+    else:
+        stats["integrity_failed"] = stats.get("integrity_failed", 0) + 1
+    METRICS.inc("integrity_failed_total", fabric=fabric,
+                comm_id=env.comm_id, src=env.src, dst=env.dst)
+    if _TRACE.enabled:
+        _TRACE.emit("integrity_drop", rank=env.dst, seqn=env.seqn,
+                    peer=env.src, nbytes=env.nbytes)
+    if (retx is None or env.strm) and latch_fn is not None:
+        latch_fn(env.comm_id, int(ErrorCode.DATA_INTEGRITY_ERROR))
+    return False
+
+
+def _apply_fault(fault_fn, env: Envelope, payload, fabric: str,
+                 stats: dict, emit, sleep):
+    """Shared chaos-action interpreter for the socket fabrics (the
+    LocalFabric twin stays on the fabric: its zero-copy retransmission
+    ring needs _track_lost interleaved with the actions). Returns the
+    possibly-rewritten ``(env, payload)`` to emit, or ``None`` for a
+    dropped frame; a ``duplicate`` emits the extra copy itself via
+    ``emit``."""
+    action = fault_fn(env, payload)
+    if isinstance(action, tuple) and action and action[0] == "delay":
+        sleep(float(action[1]))
+        action = "deliver"
+    if action == "drop":
+        stats["fault_dropped"] = stats.get("fault_dropped", 0) + 1
+        METRICS.inc("fabric_dropped_total", fabric=fabric,
+                    comm_id=env.comm_id, src=env.src, dst=env.dst)
+        return None
+    if action == "corrupt_payload":
+        # bit-flip AFTER the csum was computed (send()) — wire
+        # corruption with an intact header; the receiver's landing
+        # verify drops it, and on UDP the ring's retained ORIGINAL
+        # payload rides the RTO resend
+        from .fabric import flip_payload_bit
+        METRICS.inc("fabric_corrupted_total", fabric=fabric,
+                    comm_id=env.comm_id, src=env.src, dst=env.dst)
+        payload = flip_payload_bit(payload)
+    elif action == "corrupt_seq":
+        import dataclasses as _dc
+        METRICS.inc("fabric_corrupted_total", fabric=fabric,
+                    comm_id=env.comm_id, src=env.src, dst=env.dst)
+        env = _dc.replace(env, seqn=env.seqn + 1_000_000)
+    elif action == "duplicate":
+        METRICS.inc("fabric_duplicated_total", fabric=fabric,
+                    comm_id=env.comm_id, src=env.src, dst=env.dst)
+        emit(env, payload)
     return env, payload
 
 
@@ -126,9 +211,34 @@ class EthFabric:
         self._lock = threading.Lock()  # guards dial/lookup/inbound only
         self.coalesce = int(os.environ.get("ACCL_TPU_COALESCE_BYTES", "0"))
         self._txbuf: dict[int, list] = {}  # dst -> [nbytes, parts...]
-        self.stats = {"sg_sends": 0, "coalesced_frames": 0, "flushes": 0}
+        self.stats = {"sg_sends": 0, "coalesced_frames": 0, "flushes": 0,
+                      "integrity_failed": 0, "fault_dropped": 0}
+        # payload checksums ($ACCL_TPU_CSUM, default on): TCP is a
+        # reliable stream but not an END-TO-END integrity proof — the
+        # daemon process boundary, a buggy zero-copy emission, or a
+        # chaos hook can still corrupt payload bytes between the two
+        # rx pools. No retransmission layer exists on this stack, so a
+        # failed landing verify latches typed DATA_INTEGRITY_ERROR per
+        # comm (never a silent wrong result). Pinned off at configure
+        # time when any peer lacks CAP_CSUM (RankDaemon._maybe_pin_caps).
+        self.csum = P.csum_enabled_from_env()
+        # chaos hook (message level, mirrors UdpEthFabric.inject_fault)
+        self._fault = None
+        self.latch_fn = None
         self._server = socket.create_server(("0.0.0.0", eth_port))
         threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def inject_fault(self, fault_fn):
+        """Message-level fault hook (a :class:`~accl_tpu.chaos.FaultPlan`
+        qualifies), applied on the send side to whole eth messages. The
+        interesting kind on a reliable stream is ``corrupt_payload``:
+        TCP re-delivers what it was handed, so corruption here proves
+        the checksum tier's typed surfacing (no retransmission layer
+        exists to heal it)."""
+        self._fault = fault_fn
+
+    def clear_fault(self):
+        self._fault = None
 
     def learn_peers(self, ranks: list[tuple[int, str, int]], world: int):
         """Record peers' eth endpoints from a communicator table (cmd port
@@ -161,6 +271,10 @@ class EthFabric:
                 if body[0] != P.MSG_ETH:
                     continue
                 env, payload = _env_from_eth_frame(body[1:])
+                if not _verify_frame(env, payload, "tcp", self.stats,
+                                     None, self.latch_fn, self.csum,
+                                     stats_lock=self._lock):
+                    continue  # corrupt-as-loss: typed latch, no pool
                 self.ingest(env, payload)
         except (ConnectionError, OSError, ValueError):
             return
@@ -182,11 +296,30 @@ class EthFabric:
         return entry
 
     def send(self, env: Envelope, payload: bytes):
+        if self.csum and env.csum is None and env.nbytes:
+            env.csum = P.csum_of(payload)
+        if self._fault is not None:
+            # chaos hook BETWEEN csum computation and emission — wire
+            # corruption by construction: the trailing word still
+            # describes the original payload, so the receiver's verify
+            # catches the flip
+            faulted = _apply_fault(self._fault, env, payload, "tcp",
+                                   self.stats, self._emit, time.sleep)
+            if faulted is None:
+                return
+            env, payload = faulted
+        self._emit(env, payload)
+
+    def _emit(self, env: Envelope, payload):
         sock, peer_lock = self._peer(env.dst)
         nbytes = P.payload_nbytes(payload)
         hdr = P.pack_eth_header(env.src, env.dst, env.tag, env.seqn,
                                 env.comm_id, env.strm,
                                 P.dtype_code(env.wire_dtype), nbytes)
+        # trailing integrity word (protocol.py): decoders predating it
+        # slice the payload by nbytes and never see the extra 4 bytes
+        tail = (struct.pack("<I", env.csum) if env.csum is not None
+                else b"")
         if _TRACE.enabled:
             _TRACE.emit("wire_send", rank=env.src, seqn=env.seqn,
                         peer=env.dst, nbytes=nbytes)
@@ -198,17 +331,21 @@ class EthFabric:
                 # "serialized before return", and the executor reuses
                 # arena scratch the moment send() comes back.
                 buf = self._txbuf.setdefault(env.dst, [0])
-                buf.append(struct.pack("<I", len(hdr) + nbytes))
+                buf.append(struct.pack("<I",
+                                       len(hdr) + nbytes + len(tail)))
                 buf.append(hdr)
                 buf.append(bytes(payload))
-                buf[0] += 4 + len(hdr) + nbytes
+                if tail:
+                    buf.append(tail)
+                buf[0] += 4 + len(hdr) + nbytes + len(tail)
                 self.stats["coalesced_frames"] += 1
                 if buf[0] >= self.coalesce:
                     self._flush_locked(sock, env.dst)
                 return
             self._flush_locked(sock, env.dst)  # keep wire order
             self.stats["sg_sends"] += 1
-            P.send_frame_parts(sock, (hdr, payload))
+            parts = (hdr, payload, tail) if tail else (hdr, payload)
+            P.send_frame_parts(sock, parts)
 
     def _flush_locked(self, sock: socket.socket, dst: int):
         """Caller holds the peer lock. The buffered parts are already
@@ -364,11 +501,20 @@ class UdpEthFabric:
                 latch_fn=lambda cid, err: (self.latch_fn(cid, err)
                                            if self.latch_fn else None),
                 fabric="udp", copy_payloads=True)
+        # payload checksums ($ACCL_TPU_CSUM, default on; pinned off at
+        # configure time when a peer lacks CAP_CSUM — see
+        # RankDaemon._maybe_pin_caps): a reassembled message whose
+        # payload fails its trailing crc32 is dropped UNACKED, so the
+        # sender's RTO re-fetches the original (corrupt-as-loss); at
+        # retx_window=0 the drop latches typed DATA_INTEGRITY_ERROR
+        # instead, mirroring the queue-overflow latch below
+        self.csum = P.csum_enabled_from_env()
         # observable health of the lossy transport: a slow consumer shows
         # up here (bounded-queue drops) instead of as silent unbounded
         # memory growth
         self.stats = {"sent": 0, "delivered": 0, "dropped_queue_full": 0,
-                      "gc_partials": 0, "fault_dropped": 0}
+                      "gc_partials": 0, "fault_dropped": 0,
+                      "integrity_failed": 0}
         # deliver-queue drops fold through a collector, not a per-event
         # registry inc: a slow consumer rejects EVERY frame of a large
         # collective, and taking the process-wide registry lock per drop
@@ -428,6 +574,11 @@ class UdpEthFabric:
         self._wire_send(env, payload)
 
     def send(self, env: Envelope, payload: bytes):
+        if self.csum and env.csum is None and P.payload_nbytes(payload):
+            # before track(): the ring stores this envelope, so an RTO
+            # resend re-emits the SAME valid integrity word over the
+            # retained original payload
+            env.csum = P.csum_of(payload)
         if self.retx is not None and not env.strm:
             self.retx.track(env, payload)
         self._wire_send(env, payload)
@@ -438,42 +589,33 @@ class UdpEthFabric:
         # contract); only ACK control frames are exempt, so a chaos
         # schedule can never turn recovery against itself
         if self._fault is not None and env.strm != P.ACK_STRM:
-            action = self._fault(env, payload)
-            if isinstance(action, tuple) and action \
-                    and action[0] == "delay":
-                self._time.sleep(float(action[1]))
-                action = "deliver"
-            if action == "drop":
-                self.stats["fault_dropped"] += 1
-                METRICS.inc("fabric_dropped_total", fabric="udp",
-                            comm_id=env.comm_id, src=env.src, dst=env.dst)
+            faulted = _apply_fault(self._fault, env, payload, "udp",
+                                   self.stats, self._wire_frags,
+                                   self._time.sleep)
+            if faulted is None:
                 return
-            if action == "corrupt_seq":
-                import dataclasses as _dc
-                METRICS.inc("fabric_corrupted_total", fabric="udp",
-                            comm_id=env.comm_id, src=env.src, dst=env.dst)
-                env = _dc.replace(env, seqn=env.seqn + 1_000_000)
-            elif action == "duplicate":
-                METRICS.inc("fabric_duplicated_total", fabric="udp",
-                            comm_id=env.comm_id, src=env.src, dst=env.dst)
-                self._wire_frags(env, payload)
+            env, payload = faulted
         self._wire_frags(env, payload)
 
     def _wire_frags(self, env: Envelope, payload):
         nbytes = P.payload_nbytes(payload)
-        # scatter-gather packetization: the eth header and (memoryview
-        # slices of) the payload ride each datagram's sendmsg iovec — the
-        # old path concatenated header+payload AND re-sliced the result,
-        # two full copies per message
+        # scatter-gather packetization: the eth header, (memoryview
+        # slices of) the payload, and the optional trailing integrity
+        # word ride each datagram's sendmsg iovec — the old path
+        # concatenated header+payload AND re-sliced the result, two full
+        # copies per message
         eth_hdr = memoryview(P.pack_eth_header(
             env.src, env.dst, env.tag, env.seqn, env.comm_id, env.strm,
             P.dtype_code(env.wire_dtype), nbytes))[1:]
-        pv = memoryview(payload).cast("B")
+        regions = [eth_hdr, memoryview(payload).cast("B")]
+        if env.csum is not None:
+            regions.append(memoryview(
+                struct.pack("<I", env.csum & 0xFFFFFFFF)))
         with self._lock:
             addr = self._peer_addrs[env.dst]
             msg_id = self._msg_id
             self._msg_id += 1
-        total = len(eth_hdr) + nbytes
+        total = sum(len(r) for r in regions)
         n_frags = max(1, -(-total // self.MAX_PKT))
         sendmsg = getattr(self._sock, "sendmsg", None)  # test stubs may
         # expose only the classic sendto interface
@@ -482,11 +624,12 @@ class UdpEthFabric:
             end = min(total, start + self.MAX_PKT)
             parts = [struct.pack(self._FRAG_FMT, self.me, msg_id, idx,
                                  n_frags)]
-            if start < len(eth_hdr):
-                parts.append(eth_hdr[start:min(end, len(eth_hdr))])
-            if end > len(eth_hdr):
-                parts.append(pv[max(0, start - len(eth_hdr)):
-                                end - len(eth_hdr)])
+            off = 0
+            for r in regions:
+                lo, hi = max(start, off), min(end, off + len(r))
+                if lo < hi:
+                    parts.append(r[lo - off:hi - off])
+                off += len(r)
             if sendmsg is not None:
                 sendmsg(parts, [], 0, addr)
             else:
@@ -532,6 +675,14 @@ class UdpEthFabric:
                 if self.retx is not None:
                     cum, sel = P.unpack_ack(payload)
                     self.retx.on_ack(env.src, env.comm_id, cum, sel)
+                return
+            if not _verify_frame(env, payload, "udp", self.stats,
+                                 self.retx, self.latch_fn, self.csum):
+                # corrupt-as-loss, BEFORE the freshness check: the
+                # tracker must never record a corrupt frame's seqn (it
+                # would dedup-drop the retransmission of the original).
+                # Unacked with retx armed -> the sender's RTO recovers;
+                # typed latch at retx_window=0.
                 return
             if self.retx is not None and not env.strm \
                     and not self.retx.fresh(env):
@@ -717,7 +868,8 @@ class RankDaemon:
             tenant_of=lambda cid: (self.comm_tenants.get(cid)
                                    or f"comm-{cid}"),
             timeout_fn=lambda: self.timeout,
-            seg_fn=lambda: self.max_segment_size, tier="daemon")
+            seg_fn=lambda: self.max_segment_size, tier="daemon",
+            csum_fn=lambda: getattr(self.eth, "csum", False))
         self.executor = MoveExecutor(self.mem, self.pool, self.eth.send,
                                      timeout=self.timeout)
         # both eth fabrics serialize the payload into a frame before
@@ -818,19 +970,32 @@ class RankDaemon:
         self.eth.latch_fn = lambda cid, err: self.pool.latch_error(cid,
                                                                    err)
 
-    def _maybe_pin_retx(self, ranks):
-        """Auto-pin the retransmission window to 0 in mixed py/native
-        worlds (PR-9 known issue): the native ``cclo_emud`` has no ACK
-        responder, so a UDP-stack Python daemon retransmitting toward it
-        RTO-storms to the give-up bound and latches false PEER_FAILED.
-        At configure time — the moment peers become known — each peer's
-        cmd port is probed once (MSG_GET_INFO caps word, see
-        :func:`probe_peer_caps`); any peer without CAP_RETX_ACK disables
-        this daemon's retransmission with a one-time warning, instead of
-        requiring operators to know ``ACCL_TPU_RETX_WINDOW=0``.
-        Unreachable peers stay unprobed (retried on the next configure) —
-        a still-starting Python daemon must not be mistaken for native."""
-        if self.stack != "udp" or getattr(self.eth, "retx", None) is None:
+    def _maybe_pin_caps(self, ranks):
+        """Auto-pin capabilities down to the world's least capable peer
+        at configure time — the moment peers become known — so mixed
+        py/native worlds degrade gracefully with no operator env var:
+
+        * retransmission (UDP stack, PR-9 known issue): the native
+          ``cclo_emud`` has no ACK responder, so retransmitting toward
+          it RTO-storms to the give-up bound and latches false
+          PEER_FAILED — a peer without CAP_RETX_ACK pins this daemon's
+          retx window to 0 (``ACCL_TPU_RETX_WINDOW=0`` silences).
+        * payload checksums (both stacks, PR 13): a peer without
+          CAP_CSUM neither appends nor verifies the trailing integrity
+          word; sending checksummed frames AT it is harmless (old
+          decoders ignore trailing bytes) but its own frames arrive
+          unverifiable — the world degrades to unchecksummed frames,
+          with a one-time warning + ``csum_pinned_total``
+          (``ACCL_TPU_CSUM=0`` silences).
+
+        Each peer's cmd port is probed once (MSG_GET_INFO caps word,
+        :func:`probe_peer_caps`). Unreachable peers stay unprobed
+        (retried on the next configure) — a still-starting Python
+        daemon must not be mistaken for native."""
+        need_retx = (self.stack == "udp"
+                     and getattr(self.eth, "retx", None) is not None)
+        need_csum = getattr(self.eth, "csum", False)
+        if not (need_retx or need_csum):
             return
         if not hasattr(self, "_peer_caps"):
             self._peer_caps: dict[tuple, int] = {}
@@ -844,7 +1009,7 @@ class RankDaemon:
                 if caps is None:
                     continue  # unknown — do not cache, do not pin
                 self._peer_caps[key] = caps
-            if not caps & P.CAP_RETX_ACK:
+            if need_retx and not caps & P.CAP_RETX_ACK:
                 log.warning(
                     "rank %d: peer rank %d at %s:%d has no "
                     "retransmission ACK responder (native cclo_emud or "
@@ -857,6 +1022,28 @@ class RankDaemon:
                 METRICS.inc("retx_pinned_total", rank=self.rank,
                             tier="daemon")
                 self.eth.retx = None
+                need_retx = False
+            if need_csum and \
+                    caps & (P.CAP_CSUM | P.CAP_CSUM_C) != P.csum_caps():
+                # no checksums at all (native cclo_emud, older daemons)
+                # OR a different CRC variant (mixed installs: one side
+                # has the hardware crc32c binding, the other does not) —
+                # either way this daemon must stop emitting/verifying,
+                # or a variant mismatch would reject every frame
+                log.warning(
+                    "rank %d: peer rank %d at %s:%d does not speak "
+                    "this daemon's payload-checksum variant (%s; "
+                    "native cclo_emud, an older daemon, or a mixed "
+                    "install) — pinning checksums off so the world "
+                    "degrades to unchecksummed frames "
+                    "(set ACCL_TPU_CSUM=0 to silence)",
+                    self.rank, grank, host, port, P.CSUM_VARIANT,
+                    extra={"rank": self.rank})
+                METRICS.inc("csum_pinned_total", rank=self.rank,
+                            tier="daemon")
+                self.eth.csum = False
+                need_csum = False
+            if not (need_retx or need_csum):
                 return
 
     # -- membership (heartbeats) -------------------------------------------
@@ -1508,7 +1695,7 @@ class RankDaemon:
             self.comm_epoch += 1
             self.plan_cache.invalidate("comm")
             self.eth.learn_peers(ranks, self.world)
-            self._maybe_pin_retx(ranks)
+            self._maybe_pin_caps(ranks)
             return P.status_reply(0)
         if kind == P.MSG_REG_WINDOW:
             wid, addr, nbytes = struct.unpack("<IQQ", body[1:21])
@@ -1642,12 +1829,21 @@ class RankDaemon:
                               int(self.timeout * 1000), flags,
                               0 if self.stack == "tcp" else 1,
                               self.profiled_calls)
-                # capability word (PR 11): this daemon answers retx ACKs
-                # and serves one-sided RMA. The native cclo_emud reports
-                # caps WITHOUT bit 0 (no ACK responder) — which is what
-                # _maybe_pin_retx probes for at configure time; replies
-                # from daemons predating the field parse as caps=0.
-                + struct.pack("<I", P.CAP_RETX_ACK | P.CAP_RMA))
+                # capability word (PR 11/13): this daemon answers retx
+                # ACKs, serves one-sided RMA, and speaks payload
+                # checksums. The native cclo_emud reports caps WITHOUT
+                # these bits — which is what _maybe_pin_caps probes for
+                # at configure time; replies from daemons predating the
+                # field parse as caps=0. Csum caps track the LIVE eth
+                # flag, not just the env var: a daemon with checksums
+                # off (env-disabled or pinned) must not advertise them,
+                # or peers would never pin and the wire would look
+                # protected while this rank neither emits nor verifies.
+                + struct.pack("<I",
+                              P.CAP_RETX_ACK | P.CAP_RMA
+                              | (P.csum_caps()
+                                 if getattr(self.eth, "csum", False)
+                                 else 0)))
         if kind == P.MSG_RESET:
             self._soft_reset()
             return P.status_reply(0)
@@ -1685,11 +1881,13 @@ def _daemon_metrics_rows(d: "RankDaemon"):
     pipeline counters of the last retired call, plan-cache counters."""
     labels = {"rank": d.rank, "tier": "daemon", "ctx": d.ctx_seq}
     for k, v in d.eth.stats.items():
-        if k in ("dropped_queue_full", "fault_dropped"):
+        if k in ("dropped_queue_full", "fault_dropped",
+                 "integrity_failed"):
             # already folded into fabric_dropped_total (per comm/src/dst)
             # by the UDP fabric's own collector / the direct fault-site
-            # write — re-yielding either as its own family would show two
-            # drops for one event to any consumer summing "dropped"
+            # write (integrity failures: integrity_failed_total at the
+            # landing verify) — re-yielding either as its own family
+            # would show two events for one to any consumer summing it
             continue
         yield ("counter", f"fabric_{k}_total",
                dict(labels, fabric=d.stack), v)

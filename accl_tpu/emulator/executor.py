@@ -1029,10 +1029,16 @@ class MoveExecutor:
         if op.mode == MoveMode.STREAM:
             # continuous-stream semantics: block until exactly ``count``
             # elements are available (across pushes/wire segments); a
-            # shortfall is a timeout, the AXIS analog of a stalled stream
+            # shortfall is a timeout, the AXIS analog of a stalled stream.
+            # A latched ingress error (e.g. a stream-lane frame dropped
+            # by the integrity verify — strm=1 has no retransmission, so
+            # the drop is final) is usually WHY the stream stalled:
+            # surface it alongside the timeout, scoped to this call's
+            # communicator like the ON_RECV path below.
             data = self._pop_stream_in(count, u, deadline)
             if data is None:
-                return None, int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
+                return None, (int(ErrorCode.KRNL_TIMEOUT_STS_ERROR)
+                              | self.pool.consume_error(comm.comm_id))
             return data, 0
         if op.mode == MoveMode.ON_RECV:
             rank = comm.ranks[op.src_rank]

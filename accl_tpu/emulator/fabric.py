@@ -25,8 +25,24 @@ from __future__ import annotations
 import dataclasses
 import itertools
 
+from ..constants import ErrorCode
 from ..tracing import METRICS, TRACE as _TRACE
+from .protocol import csum_enabled_from_env, csum_of
 from .reliability import RTO_MIN_S, RetxEndpoint, retx_window_from_env
+
+
+def flip_payload_bit(payload) -> bytes:
+    """A seeded-chaos payload corruption: copy the payload and flip one
+    bit in the middle byte — header (and any precomputed envelope csum)
+    intact, which is exactly the failure the checksum tier exists to
+    catch. Never mutates the original (the retransmission ring may hold
+    a zero-copy reference to it)."""
+    buf = bytearray(memoryview(payload).cast("B")) \
+        if not isinstance(payload, (bytes, bytearray)) \
+        else bytearray(payload)
+    if buf:
+        buf[len(buf) // 2] ^= 0x10
+    return bytes(buf)
 
 # fabric-instance tags for registry rows (see LocalFabric.__init__)
 _CTX_SEQ = itertools.count(1)
@@ -46,6 +62,12 @@ class Envelope:
     wire_dtype: str
     strm: int = 0          # nonzero = deliver to peer's stream port
     comm_id: int = 0       # communicator scope for seqn matching
+    # payload integrity word (PR 13): crc32 of the payload, filled by
+    # the sending fabric when checksums are armed (protocol.csum_of; on
+    # the wire it rides as the trailing u32 of the eth frame) and
+    # verified at landing — None = unchecksummed frame (csum disabled,
+    # pinned off against a capless native peer, or an old sender)
+    csum: int | None = None
 
 
 class LocalFabric:
@@ -67,8 +89,27 @@ class LocalFabric:
 
     retains_payloads = True
 
-    def __init__(self, world_size: int, retx_window: int | None = None):
+    def __init__(self, world_size: int, retx_window: int | None = None,
+                 csum: bool | None = None):
         self.world_size = world_size
+        # payload checksums (PR 13): when armed (default; None reads
+        # $ACCL_TPU_CSUM) payload-bearing frames carry a payload CRC in
+        # the envelope, verified at landing — a failed verify is treated
+        # exactly like a drop (the retransmission layer re-fetches the
+        # original; at retx_window=0 it latches typed
+        # DATA_INTEGRITY_ERROR instead). LAZY like the retx tracking
+        # (PR-9's documented principle): the in-process "wire" is a
+        # synchronous call handing a payload REFERENCE — no bytes cross
+        # any medium that could rot, and the ONLY way a landing payload
+        # can differ from what was sent is the chaos hook itself — so
+        # the CRC is computed only while a fault hook is installed
+        # (_csum_armed, recomputed with _slow) and the clean production
+        # path pays nothing. The socket fabrics, whose bytes really do
+        # cross process/kernel/wire boundaries, checksum ALWAYS. The
+        # in-process tier needs no capability pinning: every rank
+        # speaks this fabric.
+        self.csum = csum_enabled_from_env() if csum is None else bool(csum)
+        self._csum_armed = False
         # process-unique instance tag on every registry row this fabric
         # produces: comm_id is a deterministic membership CRC, so two
         # concurrently live same-shape worlds would otherwise merge their
@@ -86,7 +127,8 @@ class LocalFabric:
         self._retx: list[RetxEndpoint | None] = [None] * world_size
         self._latch_fns: list = [None] * world_size
         self.stats = {"sent": 0, "dropped": 0, "duplicated": 0,
-                      "corrupted": 0, "throttled": 0, "delayed": 0}
+                      "corrupted": 0, "throttled": 0, "delayed": 0,
+                      "integrity_failed": 0}
         # per-communicator attribution of the same counters (QoS
         # accounting foundation, ROADMAP item 3): comm_id -> counter dict
         self.stats_by_comm: dict[int, dict[str, int]] = {}
@@ -180,6 +222,7 @@ class LocalFabric:
 
     def _recompute_slow(self):
         self._slow = self._fault is not None or bool(self.link_profiles)
+        self._csum_armed = self.csum and self._fault is not None
 
     # -- per-link profiles (slow-tier emulation) ---------------------------
     def set_link_profile(self, src: int, dst: int, alpha_us: float,
@@ -231,7 +274,8 @@ class LocalFabric:
         if st is None:
             st = self.stats_by_comm[comm_id] = {
                 "sent": 0, "dropped": 0, "duplicated": 0,
-                "corrupted": 0, "throttled": 0, "delayed": 0}
+                "corrupted": 0, "throttled": 0, "delayed": 0,
+                "integrity_failed": 0}
         return st
 
     def send(self, env: Envelope, payload: bytes):
@@ -252,6 +296,16 @@ class LocalFabric:
             cst = self._comm_stats(env.comm_id)
         cst["sent"] += 1
         self.stats["sent"] += 1
+        if self._csum_armed and env.nbytes and env.csum is None:
+            # integrity word travels in the envelope (the in-process
+            # "wire" never serializes a frame): computed ONCE here, so a
+            # later retransmission of the ring's original payload
+            # carries the valid csum while a chaos-corrupted copy fails
+            # verification at landing. Armed only while a fault hook is
+            # installed — the lazy-tracking rationale (see __init__);
+            # frames sent BEFORE the hook was installed carry no csum,
+            # so arm chaos before traffic (the harness does).
+            env.csum = csum_of(payload)
         if self._slow or _TRACE.enabled:
             self._send_slow(env, payload)
             return
@@ -347,6 +401,18 @@ class LocalFabric:
             # corrupted copy below is horizon-filtered at the receiver
             self._track_lost(env, payload, retx)
             env = dataclasses.replace(env, seqn=env.seqn + 1_000_000)
+        elif action == "corrupt_payload":
+            # payload bit-flip, header (and precomputed csum) intact:
+            # the landing verify in _hand (or the RMA engine, for
+            # one-sided lanes) rejects the copy; the original stays in
+            # the ring for RTO recovery exactly like a drop
+            self.stats["corrupted"] += 1
+            cst["corrupted"] += 1
+            METRICS.inc("fabric_corrupted_total", fabric="local",
+                        ctx=self.ctx_seq, comm_id=env.comm_id,
+                        src=env.src, dst=env.dst)
+            self._track_lost(env, payload, retx)
+            payload = flip_payload_bit(payload)
         self._hand(env, payload, retx)
         if action == "duplicate":
             self.stats["duplicated"] += 1
@@ -375,7 +441,17 @@ class LocalFabric:
         clean in-order traffic pays no ack round-trip at all."""
         rep = self._retx[env.dst] if self.retx_window > 0 else None
         if rep is None or env.strm:
+            # pool (strm=0) and stream-port (strm=1) payloads both
+            # verify here; RMA lanes (4/5) verify in the engine, the
+            # rest are control frames
+            if env.strm <= 1 and not self._verify_landing(env, payload):
+                return  # corrupt-as-loss, typed latch when no retx
             self._ingress[env.dst](env, payload)
+            return
+        # verify BEFORE accept(): recording a corrupt frame's seqn in
+        # the receiver tracker would dedup-drop the retransmission of
+        # the original — the corrupt copy must be invisible to it
+        if not self._verify_landing(env, payload):
             return
         deliver, cum, sel = rep.accept(env)
         if not deliver:
@@ -394,12 +470,44 @@ class LocalFabric:
             self._peer_ack(env.src, env.dst, env.comm_id, cum, sel)
         self._ingress[env.dst](env, payload)
 
+    def _verify_landing(self, env: Envelope, payload) -> bool:
+        """Pool- and stream-port-destined landing check (the
+        corrupt-as-loss contract):
+        a payload whose crc32 disagrees with the envelope's integrity
+        word is dropped HERE — it never enters the receiver tracker or
+        the rx pool — so with retransmission armed the sender's ring
+        re-fetches the original invisibly, and at retx_window=0 the
+        typed DATA_INTEGRITY_ERROR latches per comm at verify time (the
+        FABRIC_QUEUE_OVERFLOW precedent: the failure surfaces as itself,
+        not as a generic recv deadline). One-sided lanes (strm>=4) are
+        verified by the RMA engine against its per-index dedup + NACK
+        resend machinery instead."""
+        if env.csum is None or csum_of(payload) == env.csum:
+            return True
+        self.stats["integrity_failed"] += 1
+        self._comm_stats(env.comm_id)["integrity_failed"] += 1
+        METRICS.inc("integrity_failed_total", fabric="local",
+                    ctx=self.ctx_seq, comm_id=env.comm_id,
+                    src=env.src, dst=env.dst)
+        if _TRACE.enabled:
+            _TRACE.emit("integrity_drop", rank=env.dst, seqn=env.seqn,
+                        peer=env.src, nbytes=env.nbytes)
+        if self.retx_window <= 0 or env.strm:
+            # no recovery exists for this frame (retx off, or the
+            # stream-port lane, which the retx layer never tracks):
+            # surface typed instead of as a recv deadline
+            self._latch(env.dst, env.comm_id,
+                        int(ErrorCode.DATA_INTEGRITY_ERROR))
+        return False
+
     # fault keys are written straight into the registry at the fault site
     # (send() above) so they survive world teardown — the collector must
     # NOT re-yield them under the same family or every fault would count
     # twice (aggregate row) or three times (per-comm row) in any consumer
-    # that sums the series
-    _DIRECT_FAULT_KEYS = frozenset({"dropped", "duplicated", "corrupted"})
+    # that sums the series. integrity_failed is direct-written too
+    # (integrity_failed_total, at the landing check).
+    _DIRECT_FAULT_KEYS = frozenset({"dropped", "duplicated", "corrupted",
+                                    "integrity_failed"})
 
     def metrics_rows(self):
         """Collector rows for :class:`~accl_tpu.tracing.MetricsRegistry`:

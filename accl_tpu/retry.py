@@ -38,7 +38,15 @@ from .emulator.reliability import mix_unit
 # What a policy retries by default: failures whose cause is plausibly
 # transient wire/backpressure state. PEER_FAILED is excluded (a dead
 # peer does not come back because we ask again — shrink instead), as is
-# CALL_OUTCOME_UNKNOWN (see module docstring). JOIN_FAILED is INCLUDED:
+# CALL_OUTCOME_UNKNOWN (see module docstring) and DATA_INTEGRITY_ERROR
+# (the CALL_OUTCOME_UNKNOWN precedent: WIRE corruption self-heals
+# invisibly under the checksum tier's corrupt-as-loss retransmission —
+# by the time this word surfaces, either recovery was deliberately
+# disabled (retx_window=0, where the operator wants failures typed, not
+# papered over) or a cross-rank result fingerprint disagreed, meaning a
+# LOCAL combine/scratch/memory corrupted the data — a blind re-execution
+# may "succeed" while masking exactly the fault the word exists to
+# surface). JOIN_FAILED is INCLUDED:
 # membership joins and reshards are retryable phases of the elastic
 # story — a joiner may still be booting when the first handshake times
 # out (ACCL.grow_communicator re-runs the handshake under the policy;
@@ -79,6 +87,10 @@ class RetryPolicy:
             # module docstring and docs/ARCHITECTURE.md "Failure model")
             return False
         if word & int(ErrorCode.PEER_FAILED):
+            return False
+        if word & int(ErrorCode.DATA_INTEGRITY_ERROR):
+            # never blind-retryable, no opt-in: see DEFAULT_RETRYABLE —
+            # the data, not the transport, is what failed
             return False
         mask = self.retryable | (int(ErrorCode.CALL_OUTCOME_UNKNOWN)
                                  if self.retry_unknown else 0)

@@ -113,11 +113,19 @@ class RmaEngine:
                  pool_fn, comm_of, tenant_of=None, timeout_fn=None,
                  seg_fn=None, eager_max: int | None = None,
                  rto_s: float = DEFAULT_RMA_RTO_S,
-                 max_tries: int = DEFAULT_RMA_MAX_TRIES, tier: str = "emu"):
+                 max_tries: int = DEFAULT_RMA_MAX_TRIES, tier: str = "emu",
+                 csum_fn=None):
         self.rank = rank
         self.mem = mem
         self.windows = windows
         self._send = send_fn
+        # live checksum flag of the owning fabric (late-bound: configure
+        # time can PIN checksums off against a variant-mismatched peer,
+        # and a pinned/disabled rank must stop VERIFYING too — its own
+        # CRC variant may be the very thing that disagrees, and the
+        # engine's NACK re-fetch would re-reject the same healthy frame
+        # forever). Mirrors daemon._verify_frame's ``enabled`` gate.
+        self.csum_fn = csum_fn or (lambda: True)
         self.pool_fn = pool_fn
         self.comm_of = comm_of
         self.tenant_of = tenant_of or (lambda cid: f"comm-{cid}")
@@ -496,6 +504,23 @@ class RmaEngine:
 
     # -- ingress (both RMA strm lanes route here) --------------------------
     def on_frame(self, env: Envelope, payload):
+        if env.csum is not None and self.csum_fn() \
+                and P.csum_of(payload) != env.csum:
+            # One-sided lanes bypass the rx pool (rendezvous segments
+            # land DIRECTLY in windows), so they get their own landing
+            # verify, against the engine's own recovery machinery: a
+            # corrupt segment is simply never recorded in the per-index
+            # ``got`` set — the post-DONE NACK path re-fetches exactly
+            # it — and a corrupt control frame is dropped like a lost
+            # one (initiator RTS/GET/DONE retries re-elicit it).
+            self._count("rma_integrity_failed_total")
+            METRICS.inc("integrity_failed_total", fabric="rma",
+                        comm_id=env.comm_id, src=env.src, dst=env.dst)
+            if TRACE.enabled:
+                TRACE.emit("integrity_drop", rank=self.rank,
+                           seqn=env.seqn, peer=env.src,
+                           nbytes=env.nbytes)
+            return
         if env.strm == P.RMA_DATA_STRM:
             self._on_data(env, payload)
             return

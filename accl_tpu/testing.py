@@ -28,7 +28,9 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
               hosts=None, inter_alpha_us: float | None = None,
               inter_beta_gbps: float | None = None,
               retx_window: int | None = None,
-              retry_policy=None) -> list[ACCL]:
+              csum: bool | None = None,
+              retry_policy=None, verify_integrity: bool = False
+              ) -> list[ACCL]:
     """Create ``world_size`` ACCL instances sharing an in-process fabric.
 
     ``tuner`` (a single :class:`~accl_tpu.tuner.Tuner`) is shared by every
@@ -53,7 +55,7 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
           "service": service, "hosts": hosts,
           "inter_alpha_us": inter_alpha_us,
           "inter_beta_gbps": inter_beta_gbps,
-          "retx_window": retx_window}
+          "retx_window": retx_window, "csum": csum}
     if bufsize is not None:
         kw["bufsize"] = bufsize
     ctx = EmuContext(world_size, **kw)
@@ -63,7 +65,8 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
             ranks=[Rank() for _ in range(world_size)], local_rank=r)
         accls.append(ACCL(ctx.device(r), comm, timeout=timeout,
                           max_segment_size=max_segment_size, tuner=tuner,
-                          tenant=tenant, retry_policy=retry_policy))
+                          tenant=tenant, retry_policy=retry_policy,
+                          verify_integrity=verify_integrity))
     return accls
 
 
@@ -175,6 +178,40 @@ def sim_world(world_size: int, nbufs: int = 16, bufsize: int = 1 << 20,
         for d in daemons:
             d.shutdown()
         raise
+
+
+def rma_put_under_faults(plan, n: int = 1 << 16, data_seed: int = 3,
+                         timeout: float = 30.0) -> bool:
+    """Shared body for the RMA payload-corruption scenario (the chaos
+    sweep's rma cell and tests/test_integrity.py's rendezvous twin, so
+    the two cannot drift): 2-rank emu world, symmetric n-float32 window
+    registration, arm ``plan`` (a FaultPlan / inject_fault hook), put a
+    seeded random vector rank0 -> rank1's window, and report whether the
+    landed window is bit-identical to what was sent. Counter/applied
+    assertions stay at the call sites (the sweep checks
+    integrity_failed_total moved; the test additionally pins
+    plan.applied)."""
+    import numpy as np
+
+    accls = emu_world(2, timeout=timeout, nbufs=32)
+    fabric = accls[0].device.ctx.fabric
+    try:
+        wins = {}
+
+        def reg(a):
+            buf = a.buffer((n,), np.float32)
+            wins[a.rank] = (a.register_window(buf), buf)
+        run_ranks(accls, reg, timeout=60.0)
+        fabric.inject_fault(plan)
+        data = np.random.default_rng(data_seed).standard_normal(n) \
+            .astype(np.float32)
+        src = accls[0].buffer(data=data.copy())
+        accls[0].put(src, n, dst=1, window=wins[1][0])
+        return bool((wins[1][1].data == data).all())
+    finally:
+        fabric.clear_fault()
+        for a in accls:
+            a.deinit()
 
 
 def hlo_permute_bytes(hlo: str) -> int:

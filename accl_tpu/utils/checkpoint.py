@@ -9,19 +9,124 @@ Design notes (TPU-first):
     preempted save never corrupts the latest good step.
   * The manager keeps ``max_to_keep`` steps, mirroring standard training
     harness behavior.
+  * Content integrity (PR 13): every save writes a manifest of per-file
+    crc32 checksums next to the checkpoint, and every restore verifies
+    it FIRST — a torn, truncated or bit-rotted checkpoint raises typed
+    ``DATA_INTEGRITY_ERROR`` instead of restoring garbage (the
+    restore-from-replica recovery flow depends on a replica's restore
+    being trustworthy). Checkpoints written before the manifest existed
+    restore as before (nothing to verify against).
 """
 
 from __future__ import annotations
 
+import json
 import os
+import zlib
 from typing import Any
 
-__all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint"]
+__all__ = ["CheckpointManager", "save_checkpoint", "load_checkpoint",
+           "write_integrity_manifest", "verify_integrity_manifest"]
+
+_MANIFEST_DIRNAME = ".integrity"  # non-numeric: invisible to orbax's
+#                                   step-directory scan
+_MANIFEST_VERSION = 1
+_CRC_CHUNK = 1 << 20
 
 
 def _ocp():
     import orbax.checkpoint as ocp
     return ocp
+
+
+# -- content-integrity manifests --------------------------------------------
+
+def _file_crc(path: str) -> tuple[int, int]:
+    """(crc32, size) of one file, streamed (checkpoint shards can be
+    GBs; never materialize one whole)."""
+    crc = 0
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(_CRC_CHUNK)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return crc & 0xFFFFFFFF, size
+
+
+def _tree_files(root: str) -> list[str]:
+    """Every regular file under ``root``, as sorted relative paths —
+    the deterministic enumeration both the writer and the verifier use."""
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            out.append(os.path.relpath(os.path.join(dirpath, name), root))
+    return sorted(out)
+
+
+def write_integrity_manifest(ckpt_dir: str, manifest_path: str) -> dict:
+    """Checksum every file of a written checkpoint directory into a
+    manifest JSON (written atomically: tmp + rename, like the
+    checkpoint itself — a torn manifest must not condemn a good
+    checkpoint)."""
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    files = {}
+    for rel in _tree_files(ckpt_dir):
+        crc, size = _file_crc(os.path.join(ckpt_dir, rel))
+        files[rel] = [crc, size]
+    manifest = {"version": _MANIFEST_VERSION, "files": files}
+    os.makedirs(os.path.dirname(manifest_path), exist_ok=True)
+    tmp = manifest_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp, manifest_path)
+    return manifest
+
+
+def verify_integrity_manifest(ckpt_dir: str, manifest_path: str) -> None:
+    """Verify a checkpoint directory against its manifest BEFORE any
+    restore touches it. Raises typed ``ACCLError(DATA_INTEGRITY_ERROR)``
+    naming the first offending file on any mismatch: a missing file
+    (torn checkpoint), a size change (truncation), or a crc change
+    (bit rot). A missing MANIFEST is not an error — checkpoints predate
+    the manifest, and refusing to restore them would turn the upgrade
+    itself into data loss."""
+    if not os.path.exists(manifest_path):
+        return
+    from ..constants import ACCLError, ErrorCode
+
+    def _fail(detail: str):
+        raise ACCLError(
+            int(ErrorCode.DATA_INTEGRITY_ERROR),
+            f"checkpoint integrity check failed for {ckpt_dir}: {detail}")
+
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (OSError, ValueError, KeyError) as exc:
+        _fail(f"unreadable integrity manifest {manifest_path} ({exc})")
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    for rel, (want_crc, want_size) in sorted(files.items()):
+        path = os.path.join(ckpt_dir, rel)
+        if not os.path.exists(path):
+            _fail(f"missing file {rel} (torn checkpoint)")
+        got_crc, got_size = _file_crc(path)
+        if got_size != want_size:
+            _fail(f"{rel}: size {got_size} != manifest {want_size} "
+                  f"(truncated)")
+        if got_crc != want_crc:
+            _fail(f"{rel}: crc32 {got_crc:#x} != manifest "
+                  f"{want_crc:#x} (bit rot)")
+
+
+def _oneshot_manifest_path(path: str) -> str:
+    """Manifest location for a one-shot checkpoint: a sibling file, so
+    the checkpoint directory itself stays exactly what orbax wrote."""
+    path = os.path.abspath(path).rstrip(os.sep)
+    return path + ".integrity.json"
 
 
 class CheckpointManager:
@@ -43,11 +148,50 @@ class CheckpointManager:
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
 
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, _MANIFEST_DIRNAME,
+                            f"{int(step)}.json")
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(int(step)))
+
     def save(self, step: int, tree: Any, wait: bool = True):
+        if not wait:
+            # kept for signature compatibility, but saves always wait
+            # now: the integrity manifest can only checksum FINALIZED
+            # on-disk bytes. Loud, not silent — a training loop that
+            # overlapped async saves would otherwise just mysteriously
+            # lose throughput with nothing pointing at the cause.
+            import warnings
+            warnings.warn(
+                "CheckpointManager.save(wait=False) now blocks until "
+                "the write finishes: the content-integrity manifest "
+                "(PR 13) must checksum finalized bytes",
+                RuntimeWarning, stacklevel=2)
         ocp = _ocp()
         self._mgr.save(step, args=ocp.args.StandardSave(tree))
-        if wait:
-            self._mgr.wait_until_finished()
+        # the manifest requires the finalized on-disk bytes — and
+        # retention may have evicted older steps, whose manifests must
+        # go with them (a stale manifest for a recycled step number
+        # would fail a future good save)
+        self._mgr.wait_until_finished()
+        if os.path.isdir(self._step_dir(step)):
+            write_integrity_manifest(self._step_dir(step),
+                                     self._manifest_path(step))
+        self._prune_manifests()
+
+    def _prune_manifests(self):
+        mdir = os.path.join(self.directory, _MANIFEST_DIRNAME)
+        if not os.path.isdir(mdir):
+            return
+        for name in os.listdir(mdir):
+            step_name, ext = os.path.splitext(name)
+            if ext == ".json" and step_name.isdigit() \
+                    and not os.path.isdir(self._step_dir(int(step_name))):
+                try:
+                    os.remove(os.path.join(mdir, name))
+                except OSError:
+                    pass
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -55,13 +199,18 @@ class CheckpointManager:
     def restore(self, step: int | None = None, target: Any = None) -> Any:
         """Restore ``step`` (default: latest). ``target`` provides the
         pytree structure/shardings to restore into — pass the abstract or
-        concrete state so sharded arrays land on their devices."""
+        concrete state so sharded arrays land on their devices. The
+        step's content checksums are verified first: a torn/bit-rotted
+        checkpoint raises typed DATA_INTEGRITY_ERROR instead of
+        restoring garbage."""
         ocp = _ocp()
         if step is None:
             step = self._mgr.latest_step()
             if step is None:
                 raise FileNotFoundError(
                     f"no checkpoints under {self.directory}")
+        verify_integrity_manifest(self._step_dir(step),
+                                  self._manifest_path(step))
         if target is not None:
             import jax
 
@@ -87,17 +236,25 @@ def _abstractify(x):
 
 
 def save_checkpoint(path: str, tree: Any):
-    """One-shot atomic save of a pytree to ``path``."""
+    """One-shot atomic save of a pytree to ``path`` (+ sibling
+    integrity manifest, verified by :func:`load_checkpoint`)."""
     ocp = _ocp()
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(os.path.abspath(path), tree)
+        ckptr.wait_until_finished()
+    write_integrity_manifest(os.path.abspath(path),
+                             _oneshot_manifest_path(path))
 
 
 def load_checkpoint(path: str, target: Any = None) -> Any:
-    """One-shot load; ``target`` supplies structure/shardings."""
+    """One-shot load; ``target`` supplies structure/shardings. Verifies
+    the sibling integrity manifest first (see
+    :func:`verify_integrity_manifest`)."""
     ocp = _ocp()
     import jax
 
+    verify_integrity_manifest(os.path.abspath(path),
+                              _oneshot_manifest_path(path))
     with ocp.StandardCheckpointer() as ckptr:
         if target is not None:
             abstract = jax.tree.map(_abstractify, target)

@@ -118,7 +118,36 @@ def bench_emu_fallback(reason: str) -> dict:
         rs = rsh()
         for k in RESHARD_KEYS:
             result[k] = rs[k]
+    if os.environ.get("ACCL_BENCH_MAX_CSUM_OVERHEAD"):
+        # checksum-overhead ladder (~2s): 16 MiB allreduce with payload
+        # checksums armed vs disarmed — the Tier-1 integrity layer must
+        # stay cheap enough to be ON by default (make bench-emu gates
+        # the on/off ratio; only when armed, keep-ungated-runs-fast)
+        from benchmarks.integrity import CSUM_KEYS, headline as csum
+        cs = csum()
+        for k in CSUM_KEYS:
+            result[k] = cs[k]
     return result
+
+
+def check_csum_overhead(result: dict) -> int:
+    """Regression gate for wire-integrity cost: with
+    $ACCL_BENCH_MAX_CSUM_OVERHEAD set (make bench-emu sets 1.6), the
+    csum-on vs csum-off 16 MiB TCP-daemon allreduce ratio must stay
+    UNDER it — a blowout means the crc rides the wrong path (double
+    verify, per-fragment recompute, the zlib fallback displacing the
+    hardware crc32c binding, a copy snuck into csum_of) and the
+    on-by-default posture of the integrity tier is no longer honest.
+    Measured ~1.15x on the 2-core CI host with hardware crc32c."""
+    want = os.environ.get("ACCL_BENCH_MAX_CSUM_OVERHEAD")
+    if not want or "csum_overhead_ratio" not in result:
+        return 0
+    if result["csum_overhead_ratio"] <= float(want):
+        return 0
+    print(f"FAIL: checksum overhead ratio "
+          f"{result['csum_overhead_ratio']} > allowed {want}",
+          file=sys.stderr)
+    return 1
 
 
 def check_stream_ratio(result: dict) -> int:
@@ -742,6 +771,23 @@ def main():
                           "reshard_byst_calls"):
                     result[k] = retry_rs[k]
             result["reshard_retry"] = result.get("reshard_retry", 0) + 1
+        csum_want = os.environ.get("ACCL_BENCH_MAX_CSUM_OVERHEAD")
+        for _ in range(_GATE_RETRIES):
+            # best-of-three for the checksum-overhead gate too: only
+            # its ladder re-runs, keeping the LOWEST observed overhead
+            # (a genuine cost regression fails every attempt)
+            if not (csum_want and
+                    result.get("csum_overhead_ratio", 0)
+                    > float(csum_want)):
+                break
+            from benchmarks.integrity import CSUM_KEYS, \
+                headline as csum_headline
+            retry_cs = csum_headline()
+            if retry_cs["csum_overhead_ratio"] < \
+                    result.get("csum_overhead_ratio", float("inf")):
+                for k in CSUM_KEYS:
+                    result[k] = retry_cs[k]
+            result["csum_retry"] = result.get("csum_retry", 0) + 1
         attach_metrics_snapshot(result)
         print(json.dumps(result), flush=True)
         sys.exit(check_stream_ratio(result) or check_rd_ratio(result)
@@ -751,6 +797,7 @@ def main():
                  or check_serving(result)
                  or check_chaos_goodput(result)
                  or check_reshard(result)
+                 or check_csum_overhead(result)
                  or check_fabric_clean(result))
     if not _probe_backend():
         # the bench contract is ONE valid JSON line with a real metric:

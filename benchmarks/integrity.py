@@ -1,0 +1,148 @@
+"""Checksum-overhead ladder: the price of Tier-1 wire integrity.
+
+Runs the same 16 MiB allreduce through two in-process TCP-daemon worlds
+— payload checksums armed (the default) and disarmed — and reports the
+overhead ratio ``csum_on / csum_off``. The SOCKET tier is where the
+cost is real: its fabrics checksum every frame always (bytes cross
+process/kernel/wire boundaries there), whereas the in-process
+LocalFabric follows the PR-9 lazy-tracking principle and only
+checksums while a chaos hook is installed — its clean path pays
+nothing, so measuring it would gate theater.
+
+``make bench-emu`` holds the ratio under
+``$ACCL_BENCH_MAX_CSUM_OVERHEAD`` so the corrupt-as-loss integrity
+tier (accl_tpu/emulator/protocol.py ``csum_of`` + the fabrics' landing
+verify) stays cheap enough to be ON by default: a regression that
+makes the CRC ride the wrong path (per-fragment recompute, double
+verify, the zlib fallback silently displacing the hardware crc32c
+binding, a copy snuck into ``csum_of``) shows up here as a ratio
+blowout long before anyone profiles it.
+
+Methodology: the two worlds can't share a fabric (csum is a
+construction-time property, ``$ACCL_TPU_CSUM`` read at fabric
+construction), so iterations are interleaved WORLD BY WORLD — A/B/A/B
+— and the ratio is a ratio of per-iteration medians, the same
+shared-host drift cancellation the other ladders use. Both legs assert
+the result, and the csum leg asserts zero ``integrity_failed`` (a
+clean wire must never trip the verify).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from accl_tpu.emulator.daemon import spawn_world
+from accl_tpu.testing import connect_world, run_ranks
+
+WORLD = 4
+
+CSUM_KEYS = ("csum_overhead_ratio", "csum_on_us", "csum_off_us",
+             "csum_variant")
+
+
+def _mk_world(csum: bool):
+    prev = os.environ.get("ACCL_TPU_CSUM")
+    os.environ["ACCL_TPU_CSUM"] = "1" if csum else "0"
+    try:
+        daemons, base = spawn_world(WORLD, nbufs=64, bufsize=1 << 20,
+                                    stack="tcp")
+    finally:
+        if prev is None:
+            os.environ.pop("ACCL_TPU_CSUM", None)
+        else:
+            os.environ["ACCL_TPU_CSUM"] = prev
+    try:
+        assert all(d.eth.csum is csum for d in daemons)
+        accls = connect_world(base, WORLD, timeout=120.0)
+    except Exception:
+        # a failed connect (busy host, port collision) must not leak
+        # the spawned daemons' listener threads into the rest of the
+        # bench process — this gate retries, and later ladders share
+        # the host (the sim_world convention)
+        for d in daemons:
+            d.shutdown()
+        raise
+    return daemons, accls
+
+
+def headline(nbytes: int = 16 << 20, iters: int = 4) -> dict:
+    from accl_tpu.emulator.protocol import CSUM_VARIANT
+
+    count = nbytes // 4
+    worlds = {}
+    try:
+        # built inside the try: if the SECOND world's construction
+        # fails, the first world's daemons still get the finally's
+        # shutdown instead of leaking into the rest of the bench run
+        for k in (True, False):
+            worlds[k] = _mk_world(k)
+        bufs = {k: [(a.buffer(data=np.full(count, float(a.rank + 1),
+                                           np.float32)),
+                     a.buffer((count,), np.float32)) for a in accls]
+                for k, (_, accls) in worlds.items()}
+        times: dict[bool, list[float]] = {True: [], False: []}
+
+        def leg(csum: bool, measure: bool):
+            def body(a):
+                src, dst = bufs[csum][a.comm.local_rank]
+                t0 = time.perf_counter()
+                a.allreduce(src, dst, count)
+                if measure and a.comm.local_rank == 0:
+                    times[csum].append(time.perf_counter() - t0)
+            run_ranks(worlds[csum][1], body, timeout=600.0)
+
+        for csum in (True, False):   # warm (plan cache, pools, dials)
+            leg(csum, measure=False)
+        for _ in range(iters):       # interleaved: drift hits both legs
+            for csum in (True, False):
+                leg(csum, measure=True)
+        expect = WORLD * (WORLD + 1) / 2
+        for k, (_, accls) in worlds.items():
+            for _, dst in bufs[k]:
+                dst.sync_from_device()
+                if not np.allclose(dst.data, expect):
+                    raise AssertionError(
+                        f"csum={k} leg produced {dst.data[:4]}, "
+                        f"expected {expect}")
+        clean_fails = sum(d.eth.stats["integrity_failed"]
+                          for d in worlds[True][0])
+        if clean_fails:
+            raise AssertionError(
+                f"{clean_fails} integrity drops on a CLEAN wire — the "
+                f"landing verify is rejecting valid frames")
+        on = float(np.median(times[True]))
+        off = float(np.median(times[False]))
+    finally:
+        for daemons, accls in worlds.values():
+            for a in accls:
+                try:
+                    a.deinit()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            for d in daemons:
+                d.shutdown()
+    return {
+        "metric": f"daemon_csum_overhead_allreduce_{nbytes >> 20}MiB_"
+                  f"{WORLD}rank",
+        "value": round(on / off, 3),
+        "unit": "x",
+        "csum_overhead_ratio": round(on / off, 3),
+        "csum_on_us": round(on * 1e6, 1),
+        "csum_off_us": round(off * 1e6, 1),
+        "csum_variant": CSUM_VARIANT,
+        "nbytes": nbytes,
+        "world": WORLD,
+        "tier": "daemon-tcp",
+    }
+
+
+def main():
+    print(json.dumps(headline()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1524,7 +1524,15 @@ bool EthFabric::decode_eth(const uint8_t* p, size_t len, Envelope& env,
   env.strm = p[20];
   env.dtype = p[21];
   env.nbytes = get_le<uint64_t>(p + 22);
-  payload.assign(p + 30, p + len);
+  // Slice the payload by the header's nbytes, NOT the frame length:
+  // checksummed senders (protocol.py, the trailing integrity word this
+  // daemon does not speak — it advertises no CAP_CSUM) append 4 bytes
+  // after the payload, and the documented wire-compat contract is that
+  // decoders predating the field never see them. Taking the trailing
+  // word as payload bytes would mis-size every frame from such a
+  // sender during the pre-probe window.
+  if (env.nbytes > len - 30) return false;  // truncated frame
+  payload.assign(p + 30, p + 30 + env.nbytes);
   return true;
 }
 

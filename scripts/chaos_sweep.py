@@ -30,7 +30,13 @@ from accl_tpu.hier import ShardSpec  # noqa: E402
 from accl_tpu.testing import emu_world, run_ranks  # noqa: E402
 from accl_tpu.tracing import METRICS  # noqa: E402
 
-KINDS = ("drop", "corrupt", "duplicate", "delay")
+# corrupt_seq was historically spelled "corrupt" (still accepted as an
+# alias); corrupt_payload is the PR-13 integrity tier — bit-flips with
+# intact headers that only the payload checksum can catch, recovered
+# corrupt-as-loss by the same retransmission machinery. Payload-corrupt
+# cells additionally assert integrity_failed_total moved: a cell that
+# "passes" without the checksum tier engaging gates nothing.
+KINDS = ("drop", "corrupt_seq", "corrupt_payload", "duplicate", "delay")
 ALGOS = {"ring": A.FUSED_RING, "rd": A.RECURSIVE_DOUBLING}
 WORLDS = (3, 4, 8)
 COUNT = 2048
@@ -74,7 +80,8 @@ def _oracle(algorithm):
 # reshard survivors -> keep training -> grow the rank back -> reshard
 # again — with the final sharded state BIT-IDENTICAL to a fault-free
 # numpy oracle on every rank.
-ELASTIC_KINDS = ("drop", "corrupt", "duplicate", "delay", "flap")
+ELASTIC_KINDS = ("drop", "corrupt_seq", "corrupt_payload", "duplicate",
+                 "delay", "flap")
 
 
 def elastic_cell(kind: str, seed: int) -> tuple[bool, int]:
@@ -182,6 +189,33 @@ def elastic_cell(kind: str, seed: int) -> tuple[bool, int]:
     return ok, sum(plan.applied.values())
 
 
+def _integrity_total() -> float:
+    snap = METRICS.snapshot()
+    return float(sum(snap["counters"].get("integrity_failed_total",
+                                          {}).values()))
+
+
+def rma_cell(seed: int) -> tuple[bool, int]:
+    """One-sided put under payload corruption of the rendezvous segment
+    lane (strm=5, which bypasses the rx pool entirely): the engine's
+    per-segment verify + post-DONE NACK resend must land the window
+    bit-identically, with the integrity counter proving the checksum
+    tier actually rejected frames. Body shared with the test twin via
+    testing.rma_put_under_faults."""
+    from accl_tpu.emulator.protocol import RMA_DATA_STRM
+    from accl_tpu.testing import rma_put_under_faults
+
+    plan = FaultPlan(
+        [FaultRule(kind="corrupt_payload", strm=RMA_DATA_STRM, every=3,
+                   offset=1),
+         FaultRule(kind="corrupt_payload", strm=RMA_DATA_STRM,
+                   prob=0.1)], seed=seed)
+    before = _integrity_total()
+    ok = rma_put_under_faults(plan, data_seed=seed & 0xFFFF)
+    ok = ok and _integrity_total() > before  # the tier engaged
+    return ok, sum(plan.applied.values())
+
+
 def sweep(seed: int, hier: bool = True) -> int:
     failures = 0
     oracles = {name: _oracle(alg) for name, alg in ALGOS.items()}
@@ -201,6 +235,7 @@ def sweep(seed: int, hier: bool = True) -> int:
                                delay_s=0.01),
                      FaultRule(kind=kind, prob=PROB, delay_s=0.01)],
                     seed=seed)
+                integ_before = _integrity_total()
                 fabric.inject_fault(plan)
                 try:
                     res = _schedule(accls, alg, COUNT)
@@ -210,7 +245,12 @@ def sweep(seed: int, hier: bool = True) -> int:
                             (a == b).all() for r, o in
                             zip(res, oracles[alg_name]) for a, b in
                             zip(r, o))
-                    status = "ok" if ok else "DIVERGED"
+                    if kind == "corrupt_payload" and ok \
+                            and _integrity_total() <= integ_before:
+                        ok = False
+                        status = "NO-INTEGRITY-DROPS"
+                    else:
+                        status = "ok" if ok else "DIVERGED"
                 except Exception as exc:  # noqa: BLE001 — report cell
                     ok = False
                     status = f"FAILED ({type(exc).__name__})"
@@ -223,34 +263,55 @@ def sweep(seed: int, hier: bool = True) -> int:
                 rows.append((W, alg_name, kind, status,
                              sum(plan.applied.values()),
                              round((time.perf_counter() - t0) * 1e3)))
+    # one-sided RMA payload-corrupt cell (rendezvous lane)
+    t0 = time.perf_counter()
+    try:
+        ok, applied = rma_cell(seed)
+        status = "ok" if ok else "DIVERGED"
+    except Exception as exc:  # noqa: BLE001 — report cell
+        ok, applied = False, 0
+        status = f"FAILED ({type(exc).__name__})"
+    if not ok:
+        failures += 1
+    rows.append((2, "rma-put", "corrupt_payload", status, applied,
+                 round((time.perf_counter() - t0) * 1e3)))
     if hier:
-        # hierarchical allreduce under loss: two-host world, phases ride
-        # cached sub-communicators; recovery must hold per phase
-        t0 = time.perf_counter()
-        hosts = [0, 0, 1, 1]
-        accls = emu_world(4, timeout=30.0, nbufs=32, hosts=hosts)
-        for a in accls:
-            a.configure_hierarchy(hosts)
-        fabric = accls[0].device.ctx.fabric
-        plan = FaultPlan([FaultRule(kind="drop", every=3, offset=1),
-                          FaultRule(kind="drop", prob=PROB)], seed=seed)
-        fabric.inject_fault(plan)
-        try:
-            res = _schedule(accls, A.HIERARCHICAL, COUNT, iters=2)
-            ok = all((r[0] == res[0][0]).all() for r in res)
-            status = "ok" if ok else "DIVERGED"
-        except Exception as exc:  # noqa: BLE001
-            ok = False
-            status = f"FAILED ({type(exc).__name__})"
-        finally:
-            fabric.clear_fault()
+        # hierarchical allreduce under loss AND payload corruption:
+        # two-host world, phases ride cached sub-communicators; recovery
+        # (and the checksum tier) must hold per phase
+        for hkind in ("drop", "corrupt_payload"):
+            t0 = time.perf_counter()
+            hosts = [0, 0, 1, 1]
+            accls = emu_world(4, timeout=30.0, nbufs=32, hosts=hosts)
             for a in accls:
-                a.deinit()
-        if not ok:
-            failures += 1
-        rows.append((4, "hier", "drop", status,
-                     sum(plan.applied.values()),
-                     round((time.perf_counter() - t0) * 1e3)))
+                a.configure_hierarchy(hosts)
+            fabric = accls[0].device.ctx.fabric
+            plan = FaultPlan([FaultRule(kind=hkind, every=3, offset=1),
+                              FaultRule(kind=hkind, prob=PROB)],
+                             seed=seed)
+            integ_before = _integrity_total()
+            fabric.inject_fault(plan)
+            try:
+                res = _schedule(accls, A.HIERARCHICAL, COUNT, iters=2)
+                ok = all((r[0] == res[0][0]).all() for r in res)
+                if hkind == "corrupt_payload" and ok \
+                        and _integrity_total() <= integ_before:
+                    ok = False
+                    status = "NO-INTEGRITY-DROPS"
+                else:
+                    status = "ok" if ok else "DIVERGED"
+            except Exception as exc:  # noqa: BLE001
+                ok = False
+                status = f"FAILED ({type(exc).__name__})"
+            finally:
+                fabric.clear_fault()
+                for a in accls:
+                    a.deinit()
+            if not ok:
+                failures += 1
+            rows.append((4, "hier", hkind, status,
+                         sum(plan.applied.values()),
+                         round((time.perf_counter() - t0) * 1e3)))
     # elastic-world cells: kill -> shrink -> reshard -> train -> grow ->
     # reshard under each fault kind (+ the transient-partition flap)
     for kind in ELASTIC_KINDS:

@@ -6,12 +6,15 @@ required capability: a resumed run must be bit-identical to an unbroken
 one.
 """
 
+import os
+
 import numpy as np
 import pytest
 
 import jax
 import jax.numpy as jnp
 
+from accl_tpu.constants import ACCLError, ErrorCode
 from accl_tpu.utils import CheckpointManager, load_checkpoint, save_checkpoint
 
 
@@ -37,6 +40,93 @@ def test_manager_retention_and_latest(tmp_path):
     # retention: step 1 evicted
     with pytest.raises(Exception):
         mgr.restore(step=1, target=tree)
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Content integrity (PR 13): a torn or bit-rotted checkpoint must raise
+# typed DATA_INTEGRITY_ERROR at load, never restore garbage — the
+# restore-from-replica recovery flow trusts what restore() returns.
+# ---------------------------------------------------------------------------
+
+def _largest_payload_file(root):
+    """The biggest file of a checkpoint tree — where the array bytes
+    live, the interesting place to corrupt."""
+    best, best_size = None, -1
+    for dirpath, _, names in os.walk(root):
+        for n in names:
+            p = os.path.join(dirpath, n)
+            s = os.path.getsize(p)
+            if s > best_size:
+                best, best_size = p, s
+    return best
+
+
+def _assert_integrity_error(exc: ACCLError):
+    assert exc.error_word & int(ErrorCode.DATA_INTEGRITY_ERROR)
+
+
+def test_bit_rot_detected_at_load(tmp_path):
+    tree = {"w": jnp.arange(64.0), "step": jnp.asarray(3)}
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, tree)
+    victim = _largest_payload_file(path)
+    data = bytearray(open(victim, "rb").read())
+    data[len(data) // 2] ^= 0x01  # single-bit rot, size unchanged
+    open(victim, "wb").write(bytes(data))
+    with pytest.raises(ACCLError) as ei:
+        load_checkpoint(path, target=tree)
+    _assert_integrity_error(ei.value)
+    assert "crc32" in str(ei.value.__cause__ or ei.value) \
+        or "crc32" in str(ei.value)
+
+
+def test_truncation_and_torn_checkpoint_detected(tmp_path):
+    tree = {"w": jnp.arange(64.0)}
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, tree)
+    victim = _largest_payload_file(path)
+    raw = open(victim, "rb").read()
+    open(victim, "wb").write(raw[:-1])          # truncated
+    with pytest.raises(ACCLError) as ei:
+        load_checkpoint(path, target=tree)
+    _assert_integrity_error(ei.value)
+    os.remove(victim)                            # torn (file missing)
+    with pytest.raises(ACCLError) as ei:
+        load_checkpoint(path, target=tree)
+    _assert_integrity_error(ei.value)
+
+
+def test_legacy_checkpoint_without_manifest_still_loads(tmp_path):
+    """Checkpoints predating the manifest restore unchanged — the
+    integrity upgrade must not turn old good data into a load error."""
+    tree = {"w": jnp.arange(8.0)}
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, tree)
+    os.remove(path + ".integrity.json")
+    out = load_checkpoint(path, target=tree)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(tree["w"]))
+
+
+def test_manager_verifies_step_and_prunes_manifests(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "run"), max_to_keep=2)
+    tree = {"w": jnp.zeros(16)}
+    for step in (1, 2, 3):
+        mgr.save(step, {"w": jnp.full(16, float(step))})
+    mdir = tmp_path / "run" / ".integrity"
+    # retention evicted step 1; its manifest must be pruned with it
+    assert sorted(p.name for p in mdir.iterdir()) == ["2.json", "3.json"]
+    victim = _largest_payload_file(str(tmp_path / "run" / "3"))
+    data = bytearray(open(victim, "rb").read())
+    data[len(data) // 2] ^= 0x40
+    open(victim, "wb").write(bytes(data))
+    with pytest.raises(ACCLError) as ei:
+        mgr.restore(step=3, target=tree)
+    _assert_integrity_error(ei.value)
+    # the intact step 2 restores fine (recovery falls back a step)
+    out = mgr.restore(step=2, target=tree)
+    assert float(np.asarray(out["w"])[0]) == 2.0
     mgr.close()
 
 
@@ -88,4 +178,17 @@ def test_sharded_state_resume_identical(tmp_path):
     for x in xs[2:]:
         w2, s2 = step(w2, s2, x)
     np.testing.assert_array_equal(np.asarray(w2), golden)
+    mgr.close()
+
+
+def test_manager_save_wait_false_warns(tmp_path):
+    """save(wait=False) now always blocks (the integrity manifest can
+    only checksum finalized bytes) — loudly, so a training loop that
+    counted on overlapping async saves learns why its step time grew."""
+    mgr = CheckpointManager(str(tmp_path / "warn"))
+    with pytest.warns(RuntimeWarning, match="wait=False"):
+        mgr.save(0, {"w": np.zeros(4, np.float32)}, wait=False)
+    # the save itself completed (and verifies) despite the warning
+    out = mgr.restore(0, target={"w": np.zeros(4, np.float32)})
+    np.testing.assert_array_equal(out["w"], np.zeros(4, np.float32))
     mgr.close()

@@ -187,7 +187,11 @@ def test_flaky_wire_collective_eventually_times_out_not_hangs_no_retx():
 # bit-identical to the serial oracle after recovery, zero call errors.
 # ---------------------------------------------------------------------------
 
-_KINDS = ("drop", "corrupt", "duplicate", "delay")
+# "corrupt" exercises the back-compat alias for corrupt_seq;
+# "corrupt_payload" is the PR-13 integrity tier (bit-flip with an intact
+# header — only the payload checksum can catch it, recovered
+# corrupt-as-loss by the same retransmission machinery)
+_KINDS = ("drop", "corrupt", "corrupt_payload", "duplicate", "delay")
 
 
 _ORACLE_MEMO: dict = {}

@@ -1,0 +1,630 @@
+"""End-to-end data integrity (PR 13): payload checksums with
+corrupt-as-loss recovery (Tier 1) + cross-rank result fingerprinting
+(Tier 2).
+
+The failure class under test is the one PR-9's machinery CANNOT see: a
+payload bit-flip with an intact header sails past the seqn horizon and
+the exact-seqn pool matching, and would silently poison a reduction.
+Tier 1 makes it behave exactly like a drop (retransmission re-fetches
+the original; at retx_window=0 it latches typed DATA_INTEGRITY_ERROR);
+Tier 2 catches what no wire checksum can — a locally corrupted RESULT —
+by cross-checking result fingerprints across ranks.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from accl_tpu.chaos import FaultPlan, FaultRule
+from accl_tpu.constants import ACCLError, ErrorCode
+from accl_tpu.emulator import protocol as P
+from accl_tpu.retry import RetryPolicy
+from accl_tpu.testing import emu_world, run_ranks
+from accl_tpu.tracing import METRICS
+
+
+def _tot(name: str) -> float:
+    snap = METRICS.snapshot()
+    return float(sum(snap["counters"].get(name, {}).values()))
+
+
+def _teardown(accls):
+    for a in accls:
+        a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# Wire format: the trailing integrity word
+# ---------------------------------------------------------------------------
+
+def test_eth_frame_csum_roundtrip():
+    payload = bytes(range(256))
+    csum = P.csum_of(payload)
+    frame = P.pack_eth(0, 1, 3, 9, 77, 0, P.dtype_code("float32"),
+                       payload, csum=csum)
+    hdr, got = P.unpack_eth(frame[1:])
+    assert got == payload
+    assert hdr["csum"] == csum
+    # unchecksummed frames (old senders) parse with csum=None
+    frame = P.pack_eth(0, 1, 3, 9, 77, 0, P.dtype_code("float32"),
+                       payload)
+    hdr, got = P.unpack_eth(frame[1:])
+    assert got == payload and hdr["csum"] is None
+
+
+def test_csum_of_accepts_zero_copy_views():
+    arr = np.arange(1024, dtype=np.float32)
+    want = P.csum_of(arr.tobytes())
+    assert P.csum_of(arr) == want
+    assert P.csum_of(memoryview(arr.tobytes())) == want
+    assert P.csum_of(arr.view(np.uint8)) == want
+
+
+def test_caps_word_advertises_csum_variant():
+    caps = P.csum_caps()
+    assert caps & P.CAP_CSUM
+    if P.CSUM_VARIANT == "crc32c":
+        assert caps & P.CAP_CSUM_C
+
+
+# ---------------------------------------------------------------------------
+# Chaos kinds: corrupt_seq rename (alias) + corrupt_payload
+# ---------------------------------------------------------------------------
+
+def test_corrupt_alias_normalizes_to_corrupt_seq():
+    rule = FaultRule(kind="corrupt")
+    assert rule.kind == "corrupt_seq"
+    plan = FaultPlan([rule], seed=1)
+
+    class Env:
+        src, dst, comm_id, seqn, strm = 0, 1, 0, 0, 0
+
+    assert plan(Env()) == "corrupt_seq"
+    assert plan.applied["corrupt_seq"] == 1
+    assert "corrupt_seq" in plan.describe()
+
+
+def test_corrupt_payload_kind_maps_to_fabric_action():
+    plan = FaultPlan([FaultRule(kind="corrupt_payload")], seed=1)
+
+    class Env:
+        src, dst, comm_id, seqn, strm = 0, 1, 0, 0, 0
+
+    assert plan(Env()) == "corrupt_payload"
+
+
+def test_flip_payload_bit_never_mutates_original():
+    from accl_tpu.emulator.fabric import flip_payload_bit
+
+    arr = np.zeros(64, np.uint8)
+    flipped = flip_payload_bit(arr)
+    assert (arr == 0).all()
+    assert flipped != arr.tobytes()
+    view = memoryview(b"\x00" * 64)
+    assert flip_payload_bit(view) != bytes(view)
+
+
+# ---------------------------------------------------------------------------
+# Tier 1 on the in-process fabric: corrupt-as-loss
+# ---------------------------------------------------------------------------
+
+def test_payload_corruption_recovered_bit_identical():
+    """With retransmission armed, seeded payload bit-flips cost
+    retransmits, never correctness — and the integrity counter proves
+    the checksum tier (not luck) did the rejecting."""
+    accls = emu_world(3, timeout=20.0, nbufs=32)
+    fabric = accls[0].device.ctx.fabric
+    assert fabric.csum  # on by default
+    plan = FaultPlan([FaultRule(kind="corrupt_payload", every=3,
+                                offset=1)], seed=13)
+    before = _tot("integrity_failed_total")
+    fabric.inject_fault(plan)
+    n = 4096
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(n, float(a.rank + 1),
+                                        np.float32))
+            dst = a.buffer((n,), np.float32)
+            for _ in range(2):
+                a.allreduce(src, dst, n)
+            return dst.data.copy()
+
+        res = run_ranks(accls, body, timeout=120.0)
+    finally:
+        fabric.clear_fault()
+        _teardown(accls)
+    assert plan.applied["corrupt_payload"] > 0
+    assert _tot("integrity_failed_total") > before
+    golden = np.full(n, 6.0, np.float32)
+    for r in res:
+        np.testing.assert_array_equal(r, golden)
+
+
+def test_payload_corruption_without_retx_fails_typed():
+    """retx_window=0 (recovery deliberately off): a corrupt payload
+    must surface as DATA_INTEGRITY_ERROR — never as a silently wrong
+    result, and as itself rather than a bare recv deadline."""
+    accls = emu_world(3, timeout=5.0, retx_window=0)
+    fabric = accls[0].device.ctx.fabric
+    fabric.inject_fault(FaultPlan(
+        [FaultRule(kind="corrupt_payload", every=2, offset=1)], seed=13))
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(256, 1.0, np.float32))
+            dst = a.buffer((256,), np.float32)
+            with pytest.raises(ACCLError) as ei:
+                a.allreduce(src, dst, 256)
+            return ei.value.error_word
+
+        words = run_ranks(accls, body, timeout=60.0)
+    finally:
+        fabric.clear_fault()
+        _teardown(accls)
+    assert any(w & int(ErrorCode.DATA_INTEGRITY_ERROR) for w in words)
+
+
+def test_data_integrity_error_never_blind_retried():
+    policy = RetryPolicy(retries=5, retry_unknown=True)
+    assert not policy.should_retry(
+        int(ErrorCode.DATA_INTEGRITY_ERROR), 0)
+    assert not policy.should_retry(
+        int(ErrorCode.DATA_INTEGRITY_ERROR)
+        | int(ErrorCode.RECEIVE_TIMEOUT_ERROR), 0)
+    # sanity: the same policy does retry a plain timeout
+    assert policy.should_retry(int(ErrorCode.RECEIVE_TIMEOUT_ERROR), 0)
+
+
+def test_csum_disabled_world_still_works():
+    """csum=False (env off / pinned against a native peer): clean
+    traffic flows exactly as before — no trailing words, no verify."""
+    accls = emu_world(2, timeout=10.0, csum=False)
+    assert not accls[0].device.ctx.fabric.csum
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(128, float(a.rank + 1),
+                                        np.float32))
+            dst = a.buffer((128,), np.float32)
+            a.allreduce(src, dst, 128)
+            return float(dst.data[0])
+
+        assert all(r == 3.0 for r in run_ranks(accls, body,
+                                               timeout=60.0))
+    finally:
+        _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# Tier 1 on the socket tiers
+# ---------------------------------------------------------------------------
+
+def test_udp_payload_corruption_recovered():
+    """UDP daemons: the corrupt message is dropped UNACKED at datagram
+    decode, so the sender's RTO re-fetches the ring's retained
+    original — bit-identical result, integrity counter moved."""
+    from accl_tpu.emulator.daemon import spawn_world
+    from accl_tpu.testing import connect_world
+
+    daemons, base = spawn_world(3, nbufs=32, bufsize=1 << 20,
+                                stack="udp")
+    try:
+        accls = connect_world(base, 3, timeout=30.0)
+        assert all(d.eth.csum for d in daemons)
+        plans = []
+        for d in daemons:
+            p = FaultPlan([FaultRule(kind="corrupt_payload", every=4,
+                                     offset=1)], seed=11)
+            d.eth.inject_fault(p)
+            plans.append(p)
+        n = 4096
+
+        def body(a):
+            src = a.buffer(data=np.full(n, float(a.rank + 1),
+                                        np.float32))
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)
+            return float(dst.data[0])
+
+        assert all(r == 6.0 for r in run_ranks(accls, body,
+                                               timeout=120.0))
+        assert sum(p.applied["corrupt_payload"] for p in plans) > 0
+        assert sum(d.eth.stats["integrity_failed"] for d in daemons) > 0
+        for d in daemons:
+            d.eth.clear_fault()
+        for a in accls:
+            a.deinit()
+    finally:
+        for d in daemons:
+            d.shutdown()
+
+
+def test_tcp_payload_corruption_fails_typed():
+    """The TCP stack has no retransmission layer to re-fetch from, so
+    corrupt-as-loss degenerates to the typed latch: the pending recv
+    fails with DATA_INTEGRITY_ERROR instead of returning wrong bytes
+    or burning its generic deadline."""
+    from accl_tpu.emulator.daemon import spawn_world
+    from accl_tpu.testing import connect_world
+
+    daemons, base = spawn_world(3, nbufs=32, bufsize=1 << 20,
+                                stack="tcp")
+    try:
+        accls = connect_world(base, 3, timeout=5.0)
+        for d in daemons:
+            d.eth.inject_fault(FaultPlan(
+                [FaultRule(kind="corrupt_payload", every=2, offset=1)],
+                seed=11))
+
+        def body(a):
+            src = a.buffer(data=np.full(512, 1.0, np.float32))
+            dst = a.buffer((512,), np.float32)
+            with pytest.raises(ACCLError) as ei:
+                a.allreduce(src, dst, 512)
+            return ei.value.error_word
+
+        words = run_ranks(accls, body, timeout=120.0)
+        assert any(w & int(ErrorCode.DATA_INTEGRITY_ERROR)
+                   for w in words)
+        assert sum(d.eth.stats["integrity_failed"] for d in daemons) > 0
+        for d in daemons:
+            d.eth.clear_fault()
+        for a in accls:
+            a.deinit()
+    finally:
+        for d in daemons:
+            d.shutdown()
+
+
+def test_udp_fragments_carry_trailing_csum():
+    """Unit: the UDP packetizer's region walk puts the integrity word
+    after the payload, and reassembly + decode hand it back in
+    ``env.csum`` (the multi-fragment case exercises a tail region that
+    starts mid-fragment)."""
+    import threading
+    import time as _t
+
+    from accl_tpu.emulator.daemon import UdpEthFabric
+    from accl_tpu.emulator.fabric import Envelope
+
+    received = []
+    fab = UdpEthFabric.__new__(UdpEthFabric)
+    fab.me = 0
+    fab.ingest = lambda env, payload: received.append((env, payload))
+    fab._time = _t
+    fab._peer_addrs = {1: ("127.0.0.1", 5)}
+    fab._lock = threading.Lock()
+    fab._msg_id = 0
+    fab._partial = {}
+    fab._queues = {}
+    fab._closing = False
+    fab._fault = None
+    fab.latch_fn = None
+    fab.retx = None
+    fab.csum = True
+    fab.stats = {"sent": 0, "delivered": 0, "dropped_queue_full": 0,
+                 "gc_partials": 0, "fault_dropped": 0,
+                 "integrity_failed": 0}
+    sent = []
+
+    class StubSock:
+        def sendto(self, data, addr):
+            sent.append(bytes(data))
+
+    fab._sock = StubSock()
+    hdr_len = struct.calcsize(UdpEthFabric._FRAG_FMT)
+
+    def direct(sender):
+        class Q:
+            @staticmethod
+            def put_nowait(item):
+                received.append(item)
+        return Q
+
+    fab._deliver_q = direct
+    for total in (64, 3 * UdpEthFabric.MAX_PKT + 2):
+        sent.clear()
+        received.clear()
+        payload = bytes(range(256)) * (total // 256) \
+            + bytes(total % 256)
+        env = Envelope(src=0, dst=1, tag=3, seqn=9, nbytes=len(payload),
+                       wire_dtype="uint8")
+        fab.send(env, payload)
+        assert env.csum == P.csum_of(payload)
+        for d in sent:
+            fab._on_datagram(d, hdr_len)
+        assert len(received) == 1
+        got_env, got_payload = received[0]
+        assert bytes(got_payload) == payload
+        assert got_env.csum == P.csum_of(payload)
+        # a corrupted reassembly fails the shared verify
+        from accl_tpu.emulator.daemon import _verify_frame
+        assert _verify_frame(got_env, got_payload, "udp", fab.stats,
+                             fab.retx, None)
+        bad = bytearray(got_payload)
+        bad[0] ^= 0xFF
+        assert not _verify_frame(got_env, bytes(bad), "udp", fab.stats,
+                                 fab.retx, None)
+
+
+# ---------------------------------------------------------------------------
+# Tier 1 on the one-sided lanes (rx-pool bypass)
+# ---------------------------------------------------------------------------
+
+def test_rma_rendezvous_segment_corruption_recovered():
+    """strm=5 segments land directly in windows, bypassing the pool and
+    the retx layer — the engine's per-index dedup + post-DONE NACK
+    resend is the recovery path the per-segment verify must feed. Body
+    shared with the chaos sweep's rma cell (testing.rma_put_under_faults)
+    so the two scenarios cannot drift."""
+    from accl_tpu.emulator.protocol import RMA_DATA_STRM
+    from accl_tpu.testing import rma_put_under_faults
+
+    before = _tot("integrity_failed_total")
+    plan = FaultPlan([FaultRule(kind="corrupt_payload",
+                                strm=RMA_DATA_STRM, every=3,
+                                offset=1)], seed=5)
+    assert rma_put_under_faults(plan)
+    assert plan.applied["corrupt_payload"] > 0
+    assert _tot("integrity_failed_total") > before
+
+
+def test_rma_eager_corruption_recovered():
+    """Eager puts (one ctl+payload frame on strm=4): a corrupt frame is
+    dropped whole and the initiator's RTO re-emits it."""
+    from accl_tpu.emulator.protocol import RMA_STRM
+
+    accls = emu_world(2, timeout=30.0, nbufs=32)
+    fabric = accls[0].device.ctx.fabric
+    try:
+        wins = {}
+
+        def reg(a):
+            buf = a.buffer((256,), np.float32)
+            wins[a.rank] = (a.register_window(buf), buf)
+        run_ranks(accls, reg, timeout=60.0)
+        plan = FaultPlan([FaultRule(kind="corrupt_payload",
+                                    strm=RMA_STRM, every=2, offset=0,
+                                    max_attempt=0)], seed=5)
+        fabric.inject_fault(plan)
+        data = np.arange(256, dtype=np.float32)
+        src = accls[0].buffer(data=data.copy())
+        accls[0].put(src, 256, dst=1, window=wins[1][0])
+        np.testing.assert_array_equal(wins[1][1].data, data)
+    finally:
+        fabric.clear_fault()
+        _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: cross-rank result fingerprinting
+# ---------------------------------------------------------------------------
+
+def test_verify_integrity_happy_path_all_ops():
+    accls = emu_world(3, timeout=20.0, verify_integrity=True)
+    before = _tot("integrity_verified_total")
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(64, float(a.rank + 1),
+                                        np.float32))
+            dst = a.buffer((64,), np.float32)
+            a.allreduce(src, dst, 64)
+            g = a.buffer((64 * 3,), np.float32)
+            a.allgather(src, g, 64)
+            a.bcast(dst, 64, root=0)
+            return True
+
+        assert all(run_ranks(accls, body, timeout=60.0))
+    finally:
+        _teardown(accls)
+    # 3 ops x 3 ranks
+    assert _tot("integrity_verified_total") >= before + 9
+
+
+def test_fingerprint_mismatch_names_disagreeing_rank():
+    """A seeded local corruption (one rank's fingerprint forced wrong —
+    the local-SDC stand-in) fails EVERY rank typed, naming the minority
+    rank; never returns silently diverged results."""
+    accls = emu_world(3, timeout=20.0, verify_integrity=True)
+    before = _tot("integrity_mismatch_total")
+    accls[1].fingerprint_of = lambda buf, nelems=None: 0xDEAD
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(64, 1.0, np.float32))
+            dst = a.buffer((64,), np.float32)
+            with pytest.raises(ACCLError) as ei:
+                a.allreduce(src, dst, 64)
+            assert ei.value.error_word \
+                & int(ErrorCode.DATA_INTEGRITY_ERROR)
+            return str(ei.value)
+
+        msgs = run_ranks(accls, body, timeout=60.0)
+    finally:
+        _teardown(accls)
+    assert all("[1]" in m for m in msgs)     # the disagreeing rank
+    assert _tot("integrity_mismatch_total") >= before + 3
+
+
+def test_fingerprint_tie_names_both_ranks():
+    """W=2 (or any even split) has NO strict majority: picking one side
+    as 'the corrupt one' would misdirect an operator half the time, so
+    the error must name BOTH ranks and say the split is undecidable."""
+    accls = emu_world(2, timeout=20.0, verify_integrity=True)
+    accls[1].fingerprint_of = lambda buf, nelems=None: 0xDEAD
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(32, 1.0, np.float32))
+            dst = a.buffer((32,), np.float32)
+            with pytest.raises(ACCLError) as ei:
+                a.allreduce(src, dst, 32)
+            return str(ei.value)
+
+        msgs = run_ranks(accls, body, timeout=60.0)
+    finally:
+        _teardown(accls)
+    for m in msgs:
+        assert "undecidable" in m and "[0, 1]" in m
+
+
+def test_verify_integrity_per_call_kwarg():
+    """Per-call kwarg: verification runs only where asked (driver
+    default off), and an explicit request on an async call raises —
+    silently skipping it would fake coverage."""
+    accls = emu_world(2, timeout=20.0)
+    before = _tot("integrity_verified_total")
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(32, 1.0, np.float32))
+            dst = a.buffer((32,), np.float32)
+            a.allreduce(src, dst, 32)                    # not verified
+            a.allreduce(src, dst, 32, verify_integrity=True)
+            with pytest.raises(ValueError):
+                a.allreduce(src, dst, 32, run_async=True,
+                            verify_integrity=True)
+            return True
+
+        assert all(run_ranks(accls, body, timeout=60.0))
+    finally:
+        _teardown(accls)
+    assert _tot("integrity_verified_total") == before + 2
+
+
+def test_hierarchical_call_verified_once():
+    """A hierarchical lowering verifies the LOGICAL result exactly once
+    per rank — its internal phase calls (issued under the `_attributed`
+    scope) must not each run their own fingerprint exchange."""
+    from accl_tpu.constants import CollectiveAlgorithm as A
+
+    hosts = [0, 0, 1, 1]
+    accls = emu_world(4, timeout=20.0, nbufs=32, hosts=hosts,
+                      verify_integrity=True)
+    for a in accls:
+        a.configure_hierarchy(hosts)
+    before = _tot("integrity_verified_total")
+    n = 1024
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(n, float(a.rank + 1),
+                                        np.float32))
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n, algorithm=A.HIERARCHICAL)
+            return float(dst.data[0])
+
+        assert all(r == 10.0 for r in run_ranks(accls, body,
+                                                timeout=120.0))
+    finally:
+        _teardown(accls)
+    assert _tot("integrity_verified_total") == before + 4
+
+
+def test_verified_collectives_survive_payload_chaos():
+    """Both tiers together: under seeded payload corruption the wire
+    tier self-heals (retransmits) and the fingerprint tier then
+    CONFIRMS cross-rank agreement — the full belt-and-suspenders
+    path of the acceptance criteria."""
+    accls = emu_world(3, timeout=20.0, nbufs=32, verify_integrity=True)
+    fabric = accls[0].device.ctx.fabric
+    plan = FaultPlan([FaultRule(kind="corrupt_payload", prob=0.05)],
+                     seed=29)
+    fabric.inject_fault(plan)
+    n = 2048
+    try:
+        def body(a):
+            src = a.buffer(data=np.full(n, float(a.rank + 1),
+                                        np.float32))
+            dst = a.buffer((n,), np.float32)
+            for _ in range(3):
+                a.allreduce(src, dst, n)
+            return float(dst.data[0])
+
+        assert all(r == 6.0 for r in run_ranks(accls, body,
+                                               timeout=120.0))
+    finally:
+        fabric.clear_fault()
+        _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# Stream-port lane (strm=1) coverage + csum kill-switch gating
+# ---------------------------------------------------------------------------
+
+def test_stream_lane_corruption_fails_typed():
+    """Remote-stream sends (strm=1) are payload-bearing user data the
+    retx layer never tracks, so a corrupt frame cannot self-heal: the
+    landing verify must drop it AND latch typed DATA_INTEGRITY_ERROR,
+    surfacing in the receiver's stalled stream pop instead of as a
+    bare timeout — never as silently flipped bytes."""
+    from accl_tpu.moveengine import StreamFlags
+
+    accls = emu_world(2, timeout=3.0)
+    fabric = accls[0].device.ctx.fabric
+    fabric.inject_fault(FaultPlan(
+        [FaultRule(kind="corrupt_payload", strm=1)], seed=3))
+    before = _tot("integrity_failed_total")
+    try:
+        def body(a):
+            if a.rank == 0:
+                a.stream_put(a.buffer(data=np.arange(8,
+                                                     dtype=np.float32)),
+                             8, dst=1)
+                return None
+            dst = a.buffer((8,), np.float32)
+            with pytest.raises(ACCLError) as ei:
+                a.copy(None, dst, 8,
+                       stream_flags=StreamFlags.OP0_STREAM)
+            return ei.value.error_word
+
+        words = run_ranks(accls, body, timeout=60.0)
+    finally:
+        fabric.clear_fault()
+        _teardown(accls)
+    assert words[1] & int(ErrorCode.DATA_INTEGRITY_ERROR)
+    assert _tot("integrity_failed_total") > before
+
+
+def test_verify_frame_covers_stream_lane_and_latches():
+    """_verify_frame unit: a corrupt strm=1 frame is rejected and
+    latches typed even when a retransmission tracker EXISTS — the retx
+    layer never tracks stream frames, so there is no recovery to wait
+    for."""
+    from accl_tpu.emulator.daemon import _verify_frame
+    from accl_tpu.emulator.fabric import Envelope
+
+    payload = b"\x01\x02\x03\x04"
+    env = Envelope(src=0, dst=1, tag=0, seqn=7, nbytes=4,
+                   wire_dtype="float32", strm=1, comm_id=99,
+                   csum=P.csum_of(payload))
+    latched = []
+    stats = {}
+    ok = _verify_frame(env, b"\x01\x02\x03\xFF", "udp", stats,
+                       object(), lambda cid, err: latched.append(
+                           (cid, err)))
+    assert not ok
+    assert latched == [(99, int(ErrorCode.DATA_INTEGRITY_ERROR))]
+    # disabled fabrics skip verification entirely (the kill switch /
+    # variant pin must stop VERIFYING, not just emitting)
+    assert _verify_frame(env, b"\x01\x02\x03\xFF", "udp", {}, None,
+                         None, enabled=False)
+    # control lanes beyond the stream port stay uncovered
+    env_hb = Envelope(src=0, dst=1, tag=0, seqn=7, nbytes=4,
+                      wire_dtype="float32", strm=3, comm_id=99,
+                      csum=env.csum)
+    assert _verify_frame(env_hb, b"\x01\x02\x03\xFF", "udp", {},
+                         None, None)
+
+
+def test_csum_disabled_daemon_stops_advertising(monkeypatch):
+    """$ACCL_TPU_CSUM=0: the daemon must stop ADVERTISING the csum caps
+    bits too — otherwise peers never pin and keep sending checksummed
+    frames that nobody verifies, a wire that merely looks protected."""
+    monkeypatch.setenv("ACCL_TPU_CSUM", "0")
+    from accl_tpu.emulator.daemon import probe_peer_caps, spawn_world
+
+    daemons, port_base = spawn_world(2, nbufs=8, bufsize=1 << 16)
+    try:
+        caps = probe_peer_caps("127.0.0.1", port_base, timeout=5.0)
+        assert caps is not None
+        assert not caps & (P.CAP_CSUM | P.CAP_CSUM_C)
+        assert caps & P.CAP_RETX_ACK  # the rest of the word intact
+    finally:
+        for d in daemons:
+            d.shutdown()

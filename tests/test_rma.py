@@ -554,9 +554,9 @@ def test_daemon_tier_put_get(stack):
         accls[0].device.sync_to_device(wins[0])
         accls[1].get(gdst, 1 << 16, src=0, window=1)
         assert np.array_equal(gdst.data, data)
-        # the daemons advertise the RMA + retx-ACK capability bits
+        # the daemons advertise the RMA + retx-ACK + checksum bits
         assert accls[0].device.get_info()["caps"] \
-            == P.CAP_RETX_ACK | P.CAP_RMA
+            == P.CAP_RETX_ACK | P.CAP_RMA | P.csum_caps()
         # unknown window fails typed across the wire
         with pytest.raises(ACCLError):
             accls[0].put(src, 16, dst=1, window=77)

@@ -92,8 +92,10 @@ def test_udp_send_fragments_reassemble_exactly():
     fab._fault = None
     fab.latch_fn = None
     fab.retx = None  # reliability off: this unit probes raw framing
+    fab.csum = False  # checksums off: raw framing only
     fab.stats = {"sent": 0, "delivered": 0, "dropped_queue_full": 0,
-                 "gc_partials": 0, "fault_dropped": 0}
+                 "gc_partials": 0, "fault_dropped": 0,
+                 "integrity_failed": 0}
 
     sent = []
 
@@ -212,9 +214,11 @@ def test_udp_queue_full_drop_latches_typed_error_without_retx():
     fab._fault = None
     fab._drops = {}
     fab.retx = None                      # the window=0 fallback path
+    fab.csum = False
     fab.latch_fn = lambda cid, err: latched.append((cid, err))
     fab.stats = {"sent": 0, "delivered": 0, "dropped_queue_full": 0,
-                 "gc_partials": 0, "fault_dropped": 0}
+                 "gc_partials": 0, "fault_dropped": 0,
+                 "integrity_failed": 0}
     fab._deliver_q = lambda sender: FullQ
 
     import struct
@@ -241,6 +245,102 @@ def test_udp_ack_frame_roundtrip():
     cum, sel = P.unpack_ack(payload)
     assert (cum, sel) == (9, (11, 13))
     assert P.unpack_ack(P.pack_ack(0, ())) == (0, ())
+
+
+def test_mixed_native_world_pins_checksums_off():
+    """Wire-compat (PR-13 satellite): a capless peer (the native
+    cclo_emud's GET_INFO reply predates the caps word — stubbed here so
+    the test needs no native build) pins BOTH retransmission and payload
+    checksums off at configure time, with ``csum_pinned_total``
+    counting the degradation — no operator env var required, mirroring
+    the PR-11 retx auto-pin. A second python daemon keeps both."""
+    import socket
+    import struct
+    import threading
+
+    from accl_tpu.emulator import protocol as P
+    from accl_tpu.emulator.daemon import RankDaemon
+    from accl_tpu.testing import free_port_base
+    from accl_tpu.tracing import METRICS
+
+    def _stub_capless_daemon(port):
+        srv = socket.create_server(("127.0.0.1", port))
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                try:
+                    body = P.recv_frame(conn)
+                    if body and body[0] == P.MSG_GET_INFO:
+                        payload = (struct.pack("<Q3I", 1 << 20, 16, 2, 1)
+                                   + struct.pack("<QIBBI", 1 << 20,
+                                                 30000, 1, 1, 0))
+                        P.send_frame(conn,
+                                     bytes([P.MSG_DATA]) + payload)
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    conn.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        return srv
+
+    def _pin_total():
+        snap = METRICS.snapshot()
+        return sum(snap["counters"].get("csum_pinned_total",
+                                        {}).values())
+
+    base = free_port_base(span=8)
+    stub = _stub_capless_daemon(base + 1)
+    daemon = None
+    before = _pin_total()
+    try:
+        daemon = RankDaemon(0, 2, base, stack="udp")
+        assert daemon.eth.csum          # default-armed
+        assert daemon.eth.retx is not None
+        body = P.pack_comm(4321, 0, [(0, "127.0.0.1", base),
+                                     (1, "127.0.0.1", base + 1)])
+        assert daemon._handle(body)[0] == P.MSG_STATUS
+        # the capless (native-shaped) peer pinned checksums AND retx off
+        assert daemon.eth.csum is False
+        assert daemon.eth.retx is None
+        assert _pin_total() == before + 1
+        # re-configuring the same world does not re-pin (caps cached,
+        # csum already off) — the warning stays one-time
+        assert daemon._handle(body)[0] == P.MSG_STATUS
+        assert _pin_total() == before + 1
+    finally:
+        if daemon is not None:
+            daemon.shutdown()
+        stub.close()
+
+
+def test_python_peers_keep_checksums():
+    """Full-caps python peers: no pin, frames carry the trailing crc."""
+    import threading
+
+    from accl_tpu.emulator import protocol as P
+    from accl_tpu.emulator.daemon import RankDaemon
+    from accl_tpu.testing import free_port_base
+
+    base = free_port_base(span=8)
+    d0 = d1 = None
+    try:
+        d0 = RankDaemon(0, 2, base, stack="udp")
+        d1 = RankDaemon(1, 2, base, stack="udp")
+        threading.Thread(target=d1.serve_forever, daemon=True).start()
+        body = P.pack_comm(77, 0, [(0, "127.0.0.1", base),
+                                   (1, "127.0.0.1", base + 1)])
+        d0._handle(body)
+        assert d0.eth.csum              # no pin
+        assert d0.eth.retx is not None
+    finally:
+        for d in (d0, d1):
+            if d is not None:
+                d.shutdown()
 
 
 def _native_binary():
