@@ -25,6 +25,18 @@ import numpy as np
 from .constants import Compression, ReduceFunc
 
 
+def combine_reducer(func: ReduceFunc, dtype):
+    """The combine kernel for (func, dtype): compiled contiguous-span
+    loops from ``native/combine_kernels.c`` when the extension is
+    available, else the numpy ufunc — bit-identical either way (the
+    differential corpora hold both). This is the arithmetic-dispatch
+    half of the reference's TDEST routing into the per-dtype
+    ``reduce_sum`` plugins: the executor resolves once per move and the
+    per-segment call is one compiled loop, not a ufunc dispatch."""
+    from . import native_combine
+    return native_combine.reducer(func, dtype)
+
+
 @dataclasses.dataclass(frozen=True)
 class ArithConfig:
     """Datatype-pair configuration for combine/compression.

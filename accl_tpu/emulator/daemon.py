@@ -66,6 +66,25 @@ def _sane_budget(b: float, *, configured: bool = False) -> float:
     return b
 
 
+def stack_from_env(default: str = "tcp") -> str:
+    """Eth-fabric selection for daemon worlds: ``$ACCL_TPU_FABRIC`` in
+    {tcp, udp, shm} — shm is the shared-memory dataplane for co-located
+    ranks (emulator/shm.py). Explicit ``stack=`` arguments win; the env
+    var only fills defaults, so a test that pins a stack stays pinned."""
+    stack = os.environ.get("ACCL_TPU_FABRIC", "") or default
+    if stack not in ("tcp", "udp", "shm"):
+        raise ValueError(
+            f"$ACCL_TPU_FABRIC={stack!r}: want tcp, udp or shm")
+    return stack
+
+
+def _fabric_classes() -> dict:
+    """stack name -> fabric class (lazy: shm.py imports back into this
+    module for the embedded EthFabric and the shared landing verify)."""
+    from .shm import ShmFabric
+    return {"tcp": EthFabric, "udp": UdpEthFabric, "shm": ShmFabric}
+
+
 def probe_peer_caps(host: str, port: int,
                     timeout: float = 0.3) -> int | None:
     """Best-effort capability probe of a peer daemon's COMMAND port: one
@@ -200,6 +219,11 @@ class EthFabric:
     segment-streamed pipeline's answer to tiny-segment syscall storms.
     """
 
+    # late caps re-probe hook (RankDaemon._presend_probe) — a CLASS
+    # default so partially-constructed fabrics (unit-test stubs that
+    # skip __init__) still send
+    presend = None
+
     def __init__(self, my_global_rank: int, eth_port: int, ingest_fn):
         self.me = my_global_rank
         self.ingest = ingest_fn
@@ -296,6 +320,8 @@ class EthFabric:
         return entry
 
     def send(self, env: Envelope, payload: bytes):
+        if self.presend is not None:
+            self.presend(env)
         if self.csum and env.csum is None and env.nbytes:
             env.csum = P.csum_of(payload)
         if self._fault is not None:
@@ -464,6 +490,9 @@ class UdpEthFabric:
     # latched per comm AT DROP TIME (``latch_fn``), so the failure
     # surfaces as itself instead of as a generic recv timeout.
 
+    # late caps re-probe hook (class default: see EthFabric.presend)
+    presend = None
+
     def __init__(self, my_global_rank: int, eth_port: int, ingest_fn,
                  retx_window: int | None = None):
         import time as _t
@@ -574,6 +603,8 @@ class UdpEthFabric:
         self._wire_send(env, payload)
 
     def send(self, env: Envelope, payload: bytes):
+        if self.presend is not None:
+            self.presend(env)
         if self.csum and env.csum is None and P.payload_nbytes(payload):
             # before track(): the ring stores this envelope, so an RTO
             # resend re-emits the SAME valid integrity word over the
@@ -810,11 +841,11 @@ class RankDaemon:
 
     def __init__(self, rank: int, world: int, port_base: int,
                  nbufs: int = 16, bufsize: int = 1 << 20,
-                 host: str = "0.0.0.0", stack: str = "tcp"):
+                 host: str = "0.0.0.0", stack: str | None = None):
         self.rank = rank
         self.world = world
         self.port_base = port_base
-        self.stack = stack
+        self.stack = stack = stack or stack_from_env()
         self.mem = DeviceMemory()
         self.pool = RxBufferPool(nbufs, bufsize)
         # multi-tenant service attribution: comm -> tenant from the
@@ -845,10 +876,12 @@ class RankDaemon:
         # port collision fails before any resources need cleanup
         self._server = socket.create_server((host, port_base + rank))
         try:
-            # dual-stack parity: TCP (stream framing) or UDP (datagram
-            # packetizer/reassembly), runtime-selectable like the
-            # reference's use_tcp/use_udp (accl.py:383-395)
-            fabric_cls = {"tcp": EthFabric, "udp": UdpEthFabric}[stack]
+            # multi-stack parity: TCP (stream framing), UDP (datagram
+            # packetizer/reassembly) — runtime-selectable like the
+            # reference's use_tcp/use_udp (accl.py:383-395) — or SHM
+            # (shared-memory ring buffers between co-located ranks,
+            # with the TCP fabric embedded for per-link degradation)
+            fabric_cls = _fabric_classes()[stack]
             self.eth = fabric_cls(rank, port_base + world + rank,
                                   self._ingest)
         except Exception:  # OverflowError for out-of-range ports, OSError...
@@ -879,6 +912,16 @@ class RankDaemon:
         self.executor.owner_rank = rank
         self._wire_flush()
         self._wire_latch()
+        # capability probing (PR 11/13/14): per-(host, cmd-port) caps
+        # cache, peers whose configure-time probe FAILED (unknown — re-
+        # probed lazily at first send toward them via the fabric presend
+        # hook, closing the pre-probe window where a slow-starting
+        # native peer could receive checksummed frames forever), and the
+        # per-peer re-probe cooldown so a genuinely dead peer costs at
+        # most one short probe per second on the send path
+        self._peer_caps: dict[tuple, int] = {}
+        self._unprobed: dict[int, tuple[str, int]] = {}
+        self._probe_retry_at: dict[int, float] = {}
         # membership: heartbeat-based peer-failure detection, armed via
         # $ACCL_TPU_HEARTBEAT_MS (0 = off, the default). Peers are only
         # tracked once heard from (no false deaths during bring-up);
@@ -970,35 +1013,48 @@ class RankDaemon:
         self.eth.latch_fn = lambda cid, err: self.pool.latch_error(cid,
                                                                    err)
 
+    def _caps_wanted(self) -> bool:
+        """Does this daemon's live fabric state still depend on peer
+        capabilities? Retransmission pinning (UDP), checksum pinning
+        (any stack still emitting), and shm link upgrades (ShmFabric:
+        every un-upgraded link is a candidate)."""
+        return ((self.stack == "udp"
+                 and getattr(self.eth, "retx", None) is not None)
+                or getattr(self.eth, "csum", False)
+                or bool(getattr(self.eth, "shm", False)))
+
     def _maybe_pin_caps(self, ranks):
-        """Auto-pin capabilities down to the world's least capable peer
-        at configure time — the moment peers become known — so mixed
-        py/native worlds degrade gracefully with no operator env var:
+        """Auto-pin capabilities down to each peer's answer at configure
+        time — the moment peers become known — so mixed worlds degrade
+        gracefully with no operator env var:
 
         * retransmission (UDP stack, PR-9 known issue): the native
           ``cclo_emud`` has no ACK responder, so retransmitting toward
           it RTO-storms to the give-up bound and latches false
           PEER_FAILED — a peer without CAP_RETX_ACK pins this daemon's
           retx window to 0 (``ACCL_TPU_RETX_WINDOW=0`` silences).
-        * payload checksums (both stacks, PR 13): a peer without
+        * payload checksums (every stack, PR 13): a peer without
           CAP_CSUM neither appends nor verifies the trailing integrity
           word; sending checksummed frames AT it is harmless (old
           decoders ignore trailing bytes) but its own frames arrive
           unverifiable — the world degrades to unchecksummed frames,
           with a one-time warning + ``csum_pinned_total``
           (``ACCL_TPU_CSUM=0`` silences).
+        * shm links (PR 14): a SAME-HOST peer advertising CAP_SHM
+          upgrades its one link to the shared-memory ring; every other
+          peer stays on the embedded TCP fabric, per link
+          (``shm_link_pinned_total`` counts the degradations).
 
         Each peer's cmd port is probed once (MSG_GET_INFO caps word,
-        :func:`probe_peer_caps`). Unreachable peers stay unprobed
-        (retried on the next configure) — a still-starting Python
-        daemon must not be mistaken for native."""
-        need_retx = (self.stack == "udp"
-                     and getattr(self.eth, "retx", None) is not None)
-        need_csum = getattr(self.eth, "csum", False)
-        if not (need_retx or need_csum):
+        :func:`probe_peer_caps`), cached per (host, port). A peer
+        UNREACHABLE at configure time is unknown, NOT zero (a still-
+        starting Python daemon must not be mistaken for native) — it is
+        recorded in ``_unprobed`` and re-probed at the FIRST SEND toward
+        it (the fabric ``presend`` hook), so the pre-probe window closes
+        at first traffic instead of waiting for a reconfigure that may
+        never come."""
+        if not self._caps_wanted():
             return
-        if not hasattr(self, "_peer_caps"):
-            self._peer_caps: dict[tuple, int] = {}
         for grank, host, port in ranks:
             if grank == self.rank or not port:
                 continue
@@ -1007,44 +1063,102 @@ class RankDaemon:
             if caps is None:
                 caps = probe_peer_caps(host, port)
                 if caps is None:
-                    continue  # unknown — do not cache, do not pin
+                    # unknown — cache the FAILURE and arm the late
+                    # first-send re-probe; never pin on a guess
+                    self._unprobed[grank] = key
+                    self._arm_presend()
+                    continue
                 self._peer_caps[key] = caps
-            if need_retx and not caps & P.CAP_RETX_ACK:
-                log.warning(
-                    "rank %d: peer rank %d at %s:%d has no "
-                    "retransmission ACK responder (native cclo_emud or "
-                    "an older daemon) — pinning this daemon's retx "
-                    "window to 0 so retransmits toward it cannot "
-                    "RTO-storm into a false PEER_FAILED "
-                    "(set ACCL_TPU_RETX_WINDOW=0 to silence)",
-                    self.rank, grank, host, port,
+            self._unprobed.pop(grank, None)
+            self._apply_peer_caps(grank, host, port, caps)
+
+    def _apply_peer_caps(self, grank: int, host: str, port: int,
+                         caps: int):
+        """Fold one peer's probed caps word into this daemon's live
+        fabric state (shared by the configure-time walk and the
+        first-send late probe)."""
+        if self.stack == "udp" \
+                and getattr(self.eth, "retx", None) is not None \
+                and not caps & P.CAP_RETX_ACK:
+            log.warning(
+                "rank %d: peer rank %d at %s:%d has no "
+                "retransmission ACK responder (native cclo_emud or "
+                "an older daemon) — pinning this daemon's retx "
+                "window to 0 so retransmits toward it cannot "
+                "RTO-storm into a false PEER_FAILED "
+                "(set ACCL_TPU_RETX_WINDOW=0 to silence)",
+                self.rank, grank, host, port,
+                extra={"rank": self.rank})
+            METRICS.inc("retx_pinned_total", rank=self.rank,
+                        tier="daemon")
+            self.eth.retx = None
+        if getattr(self.eth, "csum", False) and \
+                caps & (P.CAP_CSUM | P.CAP_CSUM_C) != P.csum_caps():
+            # no checksums at all (native cclo_emud, older daemons)
+            # OR a different CRC variant (mixed installs: one side
+            # has the hardware crc32c binding, the other does not) —
+            # either way this daemon must stop emitting/verifying,
+            # or a variant mismatch would reject every frame
+            log.warning(
+                "rank %d: peer rank %d at %s:%d does not speak "
+                "this daemon's payload-checksum variant (%s; "
+                "native cclo_emud, an older daemon, or a mixed "
+                "install) — pinning checksums off so the world "
+                "degrades to unchecksummed frames "
+                "(set ACCL_TPU_CSUM=0 to silence)",
+                self.rank, grank, host, port, P.CSUM_VARIANT,
+                extra={"rank": self.rank})
+            METRICS.inc("csum_pinned_total", rank=self.rank,
+                        tier="daemon")
+            self.eth.csum = False
+        if getattr(self.eth, "shm", False) \
+                and self.eth.link_of(grank) != "shm":
+            if caps & P.CAP_SHM:
+                if not self.eth.set_link(grank, "shm"):
+                    # CAP_SHM but a different host: the segment name
+                    # space does not span machines — socket path
+                    METRICS.inc("shm_link_pinned_total", rank=self.rank,
+                                peer=grank, reason="cross_host")
+            else:
+                METRICS.inc("shm_link_pinned_total", rank=self.rank,
+                            peer=grank, reason="caps")
+                log.info(
+                    "rank %d shm: peer rank %d at %s:%d does not serve "
+                    "the shared-memory dataplane — that link rides the "
+                    "embedded TCP fabric", self.rank, grank, host, port,
                     extra={"rank": self.rank})
-                METRICS.inc("retx_pinned_total", rank=self.rank,
-                            tier="daemon")
-                self.eth.retx = None
-                need_retx = False
-            if need_csum and \
-                    caps & (P.CAP_CSUM | P.CAP_CSUM_C) != P.csum_caps():
-                # no checksums at all (native cclo_emud, older daemons)
-                # OR a different CRC variant (mixed installs: one side
-                # has the hardware crc32c binding, the other does not) —
-                # either way this daemon must stop emitting/verifying,
-                # or a variant mismatch would reject every frame
-                log.warning(
-                    "rank %d: peer rank %d at %s:%d does not speak "
-                    "this daemon's payload-checksum variant (%s; "
-                    "native cclo_emud, an older daemon, or a mixed "
-                    "install) — pinning checksums off so the world "
-                    "degrades to unchecksummed frames "
-                    "(set ACCL_TPU_CSUM=0 to silence)",
-                    self.rank, grank, host, port, P.CSUM_VARIANT,
-                    extra={"rank": self.rank})
-                METRICS.inc("csum_pinned_total", rank=self.rank,
-                            tier="daemon")
-                self.eth.csum = False
-                need_csum = False
-            if not (need_retx or need_csum):
-                return
+
+    def _arm_presend(self):
+        """Install the first-send late caps probe on the current fabric
+        (idempotent; _set_stack re-arms on the replacement fabric)."""
+        if getattr(self.eth, "presend", None) is None:
+            self.eth.presend = self._presend_probe
+
+    def _presend_probe(self, env):
+        """Fabric presend hook: a peer that was unreachable at configure
+        time (unknown, NOT pinned) is re-probed here, on the first frame
+        actually sent toward it — the PR-13 pre-probe window, where such
+        a peer could receive checksummed frames forever, now closes at
+        first traffic. Cooldown-bounded: a still-dead peer costs one
+        short probe per second, on a send that is doomed anyway."""
+        key = self._unprobed.get(env.dst)
+        if key is None:
+            return
+        now = time.monotonic()
+        if now < self._probe_retry_at.get(env.dst, 0.0):
+            return
+        self._probe_retry_at[env.dst] = now + 1.0
+        caps = probe_peer_caps(key[0], key[1], timeout=0.2)
+        if caps is None:
+            return  # still unreachable; the next send past the cooldown
+            # retries — never pin on a guess
+        self._peer_caps[key] = caps
+        self._unprobed.pop(env.dst, None)
+        METRICS.inc("caps_probe_late_total", rank=self.rank,
+                    peer=env.dst, tier="daemon")
+        self._apply_peer_caps(env.dst, key[0], key[1], caps)
+        if not self._unprobed:
+            self.eth.presend = None  # hot path back to one branch
 
     # -- membership (heartbeats) -------------------------------------------
     def _heartbeat_loop(self):
@@ -1434,7 +1548,8 @@ class RankDaemon:
             self.eth.disconnect_all()
             return 0
         if fn == CfgFunc.set_stack_type:
-            return self._set_stack({0: "tcp", 1: "udp"}.get(val))
+            return self._set_stack({0: "tcp", 1: "udp",
+                                    2: "shm"}.get(val))
         if fn == CfgFunc.start_profiling:
             self.profiling = True
             return 0
@@ -1446,7 +1561,7 @@ class RankDaemon:
     def _bind_fabric(self, kind: str, port: int):
         """Bind a fresh fabric, retrying briefly (the kernel may take a
         moment to release the port); None if every attempt failed."""
-        fabric_cls = {"tcp": EthFabric, "udp": UdpEthFabric}[kind]
+        fabric_cls = _fabric_classes()[kind]
         for _ in range(50):
             try:
                 return fabric_cls(self.rank, port, self._ingest)
@@ -1481,6 +1596,8 @@ class RankDaemon:
         self.executor._send = self.eth.send
         self._wire_flush()  # coalescing hook follows the fabric swap
         self._wire_latch()  # so does the typed drop latch
+        if self._unprobed:
+            self._arm_presend()  # and the late caps re-probe
         for comm in self.comms.values():
             self.eth.learn_peers(
                 [(r.global_rank, r.host, r.port) for r in comm.ranks],
@@ -1599,8 +1716,15 @@ class RankDaemon:
                         replies += struct.pack("<I", len(reply))
                         replies += reply
                     if body and body[0] == P.MSG_SHUTDOWN:
-                        flush()
+                        # teardown BEFORE the reply flush: the client's
+                        # deinit blocks on this reply, which makes "the
+                        # reply arrived" mean "resources are gone" — in
+                        # particular the shm fabric's /dev/shm segments
+                        # are unlinked before the client (often a test
+                        # about to sweep /dev/shm, or an exiting
+                        # process) proceeds
                         self.shutdown()
+                        flush()
                         return
                     continue  # drain every buffered frame first
                 flush()  # no complete frame left: flush the batch
@@ -1827,7 +1951,8 @@ class RankDaemon:
                             self.world, self.rank)
                 + struct.pack("<QIBBI", self.max_segment_size,
                               int(self.timeout * 1000), flags,
-                              0 if self.stack == "tcp" else 1,
+                              {"tcp": 0, "udp": 1,
+                               "shm": 2}.get(self.stack, 0),
                               self.profiled_calls)
                 # capability word (PR 11/13): this daemon answers retx
                 # ACKs, serves one-sided RMA, and speaks payload
@@ -1843,6 +1968,12 @@ class RankDaemon:
                               P.CAP_RETX_ACK | P.CAP_RMA
                               | (P.csum_caps()
                                  if getattr(self.eth, "csum", False)
+                                 else 0)
+                              # CAP_SHM tracks the LIVE fabric: only a
+                              # daemon whose eth IS the shm dataplane
+                              # can serve ring-buffer peers
+                              | (P.CAP_SHM
+                                 if getattr(self.eth, "shm", False)
                                  else 0)))
         if kind == P.MSG_RESET:
             self._soft_reset()
@@ -1895,6 +2026,12 @@ def _daemon_metrics_rows(d: "RankDaemon"):
     if retx is not None:
         for kind, name, lbl, v in retx.metrics_rows():
             yield (kind, name, dict(lbl, tier="daemon", ctx=d.ctx_seq), v)
+    fabric_rows = getattr(d.eth, "metrics_rows", None)
+    if fabric_rows is not None:
+        # fabric-specific gauges (ShmFabric: per-link shm_link_up,
+        # per-channel pinned arena bytes)
+        for kind, name, lbl, v in fabric_rows():
+            yield (kind, name, dict(lbl, tier="daemon", ctx=d.ctx_seq), v)
     # pool / executor / plan-cache rows: the same mapping the device
     # collector uses (tracing.health_rows), so the tiers cannot drift
     yield from health_rows(d, labels)
@@ -1915,7 +2052,7 @@ def _daemon_metrics_rows(d: "RankDaemon"):
 
 
 def spawn_world(world: int, port_base: int = 0, nbufs: int = 16,
-                bufsize: int = 1 << 20, stack: str = "tcp"):
+                bufsize: int = 1 << 20, stack: str | None = None):
     """Spawn W in-process daemons on free ports (for tests); returns
     (daemons, port_base). Multi-process deployments run __main__ per rank."""
     # The contiguous cmd+eth port block lands in the ephemeral range, where
@@ -1956,7 +2093,9 @@ def main():
     ap.add_argument("--port-base", type=int, default=45000)
     ap.add_argument("--nbufs", type=int, default=16)
     ap.add_argument("--bufsize", type=int, default=1 << 20)
-    ap.add_argument("--stack", choices=["tcp", "udp"], default="tcp")
+    ap.add_argument("--stack", choices=["tcp", "udp", "shm"],
+                    default=None,
+                    help="eth fabric (default: $ACCL_TPU_FABRIC or tcp)")
     args = ap.parse_args()
     basic_config()  # rank-tagged stderr logging for standalone daemons
     daemon = RankDaemon(args.rank, args.world, args.port_base,
@@ -1965,7 +2104,7 @@ def main():
     print(f"rank {args.rank}/{args.world} serving on "
           f"cmd={args.port_base + args.rank} "
           f"eth={args.port_base + args.world + args.rank} "
-          f"stack={args.stack}", flush=True)
+          f"stack={daemon.stack}", flush=True)
     daemon.serve_forever()
 
 

@@ -47,7 +47,7 @@ import time
 
 import numpy as np
 
-from ..arith import ArithConfig
+from ..arith import ArithConfig, combine_reducer
 from ..communicator import Communicator
 from ..constants import (DEFAULT_COMBINE_WORKERS_CAP,
                          DEFAULT_PIPELINE_WINDOW, ErrorCode, ReduceFunc,
@@ -465,12 +465,45 @@ class RxBufferPool:
         return "\n".join(lines)
 
 
+def _wrap_payload(payload, wire: np.dtype) -> np.ndarray:
+    """Reinterpret a landed payload as ``wire``-typed elements WITHOUT
+    breaking the Python object chain. ``np.frombuffer`` holds only the
+    underlying MEMORY (PEP-3118 exports resolve to the root exporter),
+    not the payload object itself — so on a fabric whose payloads are
+    views with a lifetime finalizer (ShmFabric: a view's death releases
+    its shm-arena slot for reuse), a frombuffer rewrap lets the slot be
+    recycled while parked downstream readers (egress-parked cut-through
+    relays, stream-port entries) still read the bytes. ``ndarray.view``
+    keeps ``payload`` in the result's base chain, deferring the
+    finalizer until the LAST derived view dies."""
+    if isinstance(payload, np.ndarray):
+        return payload.reshape(-1).view(wire)
+    return np.frombuffer(payload, dtype=wire)
+
+
+# Plain numpy ufunc table — kept for the TPU tier's host-side reductions
+# (device/tpu.py imports it) and as the reference the compiled kernels
+# are held bit-identical to. The emulator's combine path resolves through
+# arith.combine_reducer instead (native/combine_kernels.c when built,
+# numpy otherwise), so per-segment reduction stops paying ufunc dispatch.
 _REDUCERS = {
     ReduceFunc.SUM: np.add,
     ReduceFunc.MAX: np.maximum,
     ReduceFunc.MIN: np.minimum,
     ReduceFunc.PROD: np.multiply,
 }
+
+# per-(func, dtype) memo of resolved combine kernels: the per-segment
+# hot path must pay ONE tuple-key dict hit (the _REDUCERS cost class),
+# not arith.combine_reducer's import + ReduceFunc/np.dtype constructions
+_COMBINE_MEMO: dict = {}
+
+
+def _combine_fn(func, dtype):
+    k = _COMBINE_MEMO.get((func, dtype))
+    if k is None:
+        k = _COMBINE_MEMO[(func, dtype)] = combine_reducer(func, dtype)
+    return k
 
 # one template for every engine's per-execute counters: an engine that
 # forgets a key would otherwise silently report 0 through CallRecord
@@ -995,7 +1028,7 @@ class MoveExecutor:
                     raise IndexError("stream-out port empty")
 
     def deliver_stream(self, env: Envelope, payload):
-        data = np.frombuffer(payload, dtype=np.dtype(env.wire_dtype))
+        data = _wrap_payload(payload, np.dtype(env.wire_dtype))
         self.push_stream(data)
 
     def _pop_stream_in(self, count: int, dtype: np.dtype,
@@ -1059,7 +1092,7 @@ class MoveExecutor:
             if rx_seqn is None:
                 rank.inbound_seq += 1  # exchange-mem seq update parity
             wire = np.dtype(env.wire_dtype)
-            data = np.frombuffer(payload, dtype=wire)
+            data = _wrap_payload(payload, wire)
             if data.size != count:
                 return None, int(ErrorCode.DMA_MISMATCH_ERROR)
             return data.astype(u, copy=False), 0
@@ -1218,10 +1251,11 @@ class MoveExecutor:
                         prog.max_combining = prog.combining
                 try:
                     t_c0 = time.monotonic_ns() if tr else 0
-                    if out is not None:
-                        result = _REDUCERS[mv.func](op0, op1, out=out)
-                    else:
-                        result = _REDUCERS[mv.func](op0, op1)
+                    # compiled combine lane: one memo-dict hit, then a
+                    # single compiled-loop call per segment instead of
+                    # a ufunc dispatch
+                    result = _combine_fn(
+                        mv.func, cfg.uncompressed_dtype)(op0, op1, out)
                     if tr:
                         _TRACE.emit("combine", rank=_rank, call_seq=_cs,
                                     lane=_lane, step=_step, nbytes=_nb,

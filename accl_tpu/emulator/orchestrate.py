@@ -357,9 +357,12 @@ def main(argv=None) -> int:
                     choices=sorted(TESTS))
     ap.add_argument("--timeout", type=float, default=120.0,
                     help="per-test wall-clock budget (s)")
-    ap.add_argument("--stack", choices=["tcp", "udp"], default="tcp",
-                    help="eth fabric between rank daemons (dual-stack "
-                         "parity: reference use_tcp/use_udp)")
+    ap.add_argument("--stack", choices=["tcp", "udp", "shm"],
+                    default="tcp",
+                    help="eth fabric between rank daemons (tcp/udp: "
+                         "dual-stack parity, reference use_tcp/use_udp; "
+                         "shm: the shared-memory dataplane for "
+                         "co-located ranks)")
     ap.add_argument("--log-dir", default="/tmp/accl_tpu_orchestrate")
     args = ap.parse_args(argv)
 

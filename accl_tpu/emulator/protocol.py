@@ -133,6 +133,14 @@ CAP_RETX_ACK = 1
 CAP_RMA = 2
 CAP_CSUM = 4
 CAP_CSUM_C = 8
+# Bit 4: the daemon's eth fabric is the shared-memory dataplane
+# (emulator/shm.py ShmFabric) — it serves per-directed-channel shm ring
+# buffers AND still listens on the ordinary TCP eth port through its
+# embedded fabric. A peer that sees this bit on a SAME-HOST daemon
+# upgrades that one link to shm at configure time; everything else
+# (cross-host peers, tcp/udp/native daemons) keeps the socket path, so
+# mixed worlds degrade per link exactly like the csum/retx pins.
+CAP_SHM = 16
 
 
 # -- payload integrity (end-to-end wire checksum) ---------------------------

@@ -1,0 +1,937 @@
+"""Shared-memory dataplane: the ShmFabric for co-located rank daemons.
+
+ROADMAP item 2's first half. Same-host "network" hops on the socket
+stacks serialize every frame through kernel socket buffers — a header
+pack, a payload copy in, a copy out, and two syscalls per frame, all
+under the GIL — so emu-tier throughput between co-located daemons is a
+fraction of what one memcpy could carry. Here the frame itself becomes a
+shared-memory handoff:
+
+* One **single-producer/single-consumer ring per directed channel**
+  (src rank -> dst rank), living in a ``multiprocessing.shared_memory``
+  segment the RECEIVER creates when it learns the peer (names derive
+  from the two eth ports, so both sides agree without a handshake).
+  A slot carries the eth-frame header **word-compatible with**
+  ``protocol.pack_eth`` (the exact ``pack_eth_header`` bytes — a socket
+  decoder could parse it unchanged) plus the PR-13 trailing-crc32c word
+  and an (offset, length) record into the segment's payload arena.
+* A **send** is: copy the payload straight from the caller's buffer
+  into the arena (no header/payload serialization, no syscall), write
+  the slot, publish-index bump, doorbell. A **recv** polls the slot and
+  copies the payload out into an owned array released to the rx pool,
+  reclaiming the arena region immediately (ring-order frontier bump —
+  no per-frame GC bookkeeping). One copy in, one copy out: the socket
+  fabrics pay the same two copies PLUS a frame serialization, two
+  syscalls and the kernel's own socket-buffer copies per hop.
+
+  (A zero-copy landing — handing arena VIEWS to the rx pool pinned by
+  ``weakref.finalize`` — was tried and rejected: consumers throughout
+  the executor rewrap payloads via the buffer protocol, and
+  ``np.frombuffer`` holds only the root exporter's MEMORY, not the
+  intermediate view object, so the finalizer fires while parked
+  cut-through relays still read the region and the producer recycles
+  it under them — a seeded differential corpus caught torn combines.
+  Landed payloads must be OWNED bytes, like every other fabric's.)
+* The three contracts the socket fabrics satisfy carry over:
+
+  - **retransmission**: a :class:`RetxEndpoint` with the LocalFabric's
+    lazy-tracking rationale (the shm "wire" is a memcpy — its only loss
+    modes are the chaos hook's own actions, observed synchronously at
+    send, so clean frames never enter the ring), ACKs riding the
+    REVERSE channel as ``strm=ACK_STRM`` control frames, retransmitted
+    frames flagged on the wire so the receiver re-acks them,
+    ``CAP_RETX_ACK`` advertised as usual;
+  - **chaos**: ``inject_fault`` at message level, every FaultRule kind
+    incl. ``corrupt_payload``, applied between csum computation and
+    publication exactly like the socket fabrics (seeded plans decide
+    identically — the hook sees the same envelopes);
+  - **integrity**: landing-time checksum verify with corrupt-as-loss
+    semantics through the shared ``_verify_frame`` (unacked with retx
+    armed so the RTO re-fetches the original; typed
+    DATA_INTEGRITY_ERROR latch at retx_window=0).
+
+* **Mixed shm/socket worlds degrade per link** (the csum/retx-pin
+  precedent): the fabric embeds a plain :class:`EthFabric` on the same
+  eth port — socket peers still reach this rank — and each link rides
+  shm only once the configure-time caps probe confirmed ``CAP_SHM`` on
+  a same-host peer; everything else (cross-host peers, native daemons,
+  unprobeable peers) stays on TCP, counted in ``shm_link_pinned_total``.
+
+Doorbells: co-located daemons in ONE process (the test/bench tier)
+share a process-global condition per channel, so a publish wakes the
+consumer immediately; true multi-process worlds fall back to a bounded
+poll (<= ~20 ms idle latency — an emulator tradeoff, documented in
+ARCHITECTURE "Fabrics").
+
+Teardown: the receiver ALWAYS unlinks its inbound segments at close
+(landed payloads are owned copies, so nothing pins the mapping), and a
+torn-down world leaves nothing behind for the conftest /dev/shm sweep
+to find.
+"""
+
+from __future__ import annotations
+
+import os
+import socket as _socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from ..constants import ErrorCode
+from ..log import get_logger
+from ..tracing import METRICS, TRACE as _TRACE
+from . import protocol as P
+from .daemon import EthFabric, _verify_frame
+from .fabric import Envelope, flip_payload_bit
+from .reliability import RetxEndpoint, retx_window_from_env
+
+log = get_logger(__name__)
+
+# /dev/shm name prefix — the conftest leak sweep and
+# scripts/check_shm_leaks.py key on it
+SHM_PREFIX = "accl_shm_"
+
+_ETH_SIZE = struct.calcsize(P._ETH_FMT)          # 30
+_HDR_LEN = 1 + _ETH_SIZE                         # MSG_ETH byte + header
+# slot: eth header bytes, flags u8 (bit0 csum valid, bit1 retransmit),
+# csum u32, arena offset u64, arena allocation u32 (incl. wrap padding)
+_SLOT_FMT = f"<{_HDR_LEN}sBIQI"
+_SLOT_SIZE = struct.calcsize(_SLOT_FMT)          # 48
+_FLAG_CSUM = 1
+_FLAG_RETX = 2
+# pad-only slot: claims the arena's ring tail so a payload that cannot
+# wrap in one allocation (n > off would make alloc exceed the arena —
+# permanently unsatisfiable) restarts at offset 0; carries no payload
+# and is consumed invisibly by poll()
+_FLAG_PAD = 4
+
+# payload size from which arena copies go through the segment FD
+# (os.pread/pwrite: kernel memcpy with the GIL released) instead of the
+# mapping (numpy slice copy under the GIL) — the syscall pair costs
+# ~1-2 us, worth paying once the copy itself is the bigger cost
+_FD_COPY_MIN = 1 << 15
+
+# channel header (64B): widx u64, ridx u64, arena_head u64, arena_tail
+# u64, nslots u32, magic u32, arena_bytes u64
+_CH_FMT = "<4Q2IQ"
+_CH_MAGIC = 0xACC15 + 1
+_CH_HDR = 64
+_SLOT0 = _CH_HDR
+
+
+def shm_slots_from_env() -> int:
+    return max(8, int(os.environ.get("ACCL_TPU_SHM_SLOTS", "256")))
+
+
+def shm_arena_from_env() -> int:
+    # Per-directed-channel payload arena. Sized well above the default
+    # 1 MiB max segment so steady-state collective flow never fills the
+    # ring: a frame's region is live from publish until the consumer's
+    # poll copies it out, so the arena must hold the publish-ahead
+    # window (rx thread lag, pool backpressure) or the tx spool (one
+    # extra copy) engages. tmpfs pages are allocated on first touch,
+    # so an idle channel's arena costs address space, not RAM.
+    return max(1 << 16, int(os.environ.get("ACCL_TPU_SHM_ARENA",
+                                           str(8 << 20))))
+
+
+def channel_name(src_eth_port: int, dst_eth_port: int) -> str:
+    """Segment name for the directed channel src->dst, derived from the
+    two eth ports (the rank-addressing namespace both sides already
+    share via the communicator table) — no extra handshake needed."""
+    return f"{SHM_PREFIX}{src_eth_port}_{dst_eth_port}"
+
+
+def _local_host(host: str) -> bool:
+    """Same-host test for the shm auto-detect: loopback names always; a
+    concrete address only when it is one of ours (best-effort, cached)."""
+    if host in ("127.0.0.1", "localhost", "0.0.0.0", "::1", ""):
+        return True
+    return host in _local_addrs()
+
+
+_LOCAL_ADDRS: set | None = None
+
+
+def _local_addrs() -> set:
+    global _LOCAL_ADDRS
+    if _LOCAL_ADDRS is None:
+        addrs = set()
+        try:
+            name = _socket.gethostname()
+            addrs.add(name)
+            addrs.update(i[4][0] for i in _socket.getaddrinfo(name, None))
+        except OSError:
+            pass
+        _LOCAL_ADDRS = addrs
+    return _LOCAL_ADDRS
+
+
+# -- in-process doorbells ---------------------------------------------------
+# Co-located daemons in one process share a Condition per channel so a
+# publish/consume/release wakes the other side immediately; across real
+# processes the poll timeouts below bound the latency instead.
+_DOORBELLS: dict[str, list] = {}     # name -> [Condition, refcount]
+_DB_LOCK = threading.Lock()
+
+# segment names THIS process created (resource-tracker hygiene): 3.10's
+# SharedMemory registers with the tracker on attach as well as create,
+# but the tracker's cache is a SET — an in-process attach's register is
+# a dedup no-op against the creator's entry, so unregistering it would
+# double-remove the one entry (tracker KeyError noise at exit) and lose
+# crash cleanup. Attaches only unregister for names created elsewhere.
+_CREATED_NAMES: set[str] = set()
+
+
+def _doorbell(name: str) -> threading.Condition:
+    with _DB_LOCK:
+        ent = _DOORBELLS.get(name)
+        if ent is None:
+            ent = _DOORBELLS[name] = [threading.Condition(), 0]
+        ent[1] += 1
+        return ent[0]
+
+
+def _doorbell_drop(name: str):
+    with _DB_LOCK:
+        ent = _DOORBELLS.get(name)
+        if ent is not None:
+            ent[1] -= 1
+            if ent[1] <= 0:
+                del _DOORBELLS[name]
+
+
+class _ShmChannel:
+    """One directed SPSC ring: slot table + payload arena in one shared
+    segment. The RECEIVER creates (and at close unlinks) the segment;
+    the sender attaches by name. Publication order: payload bytes ->
+    slot record -> widx bump (the consumer reads in reverse), which is
+    sufficient under the GIL in-process and under x86-TSO across
+    processes — the documented scope of this emulator fabric."""
+
+    def __init__(self, name: str, *, create: bool,
+                 nslots: int | None = None, arena_bytes: int | None = None):
+        from multiprocessing import shared_memory
+        self.name = name
+        self._closed = False
+        self._mu = threading.Lock()
+        if create:
+            nslots = nslots or shm_slots_from_env()
+            arena_bytes = arena_bytes or shm_arena_from_env()
+            total = _SLOT0 + nslots * _SLOT_SIZE + arena_bytes
+            self._shm = shared_memory.SharedMemory(name=name, create=True,
+                                                   size=total)
+            with _DB_LOCK:
+                _CREATED_NAMES.add(name)
+            struct.pack_into(_CH_FMT, self._shm.buf, 0, 0, 0, 0, 0,
+                             nslots, _CH_MAGIC, arena_bytes)
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+            with _DB_LOCK:
+                ours = name in _CREATED_NAMES
+            if not ours:
+                try:
+                    # the resource tracker would try to unlink attached
+                    # segments again at process exit (3.10 has no
+                    # track=False) — the RECEIVER owns unlinking. Skipped
+                    # when the creator lives in this process: its register
+                    # deduped against the creator's tracker entry, and
+                    # removing that one entry would lose crash cleanup
+                    # (see _CREATED_NAMES)
+                    from multiprocessing import resource_tracker
+                    resource_tracker.unregister(self._shm._name,
+                                                "shared_memory")
+                except Exception:  # noqa: BLE001 — tracker internals moved
+                    pass
+            (_w, _r, _h, _t, nslots, magic,
+             arena_bytes) = struct.unpack_from(_CH_FMT, self._shm.buf, 0)
+            if magic != _CH_MAGIC:
+                self._shm.close()
+                raise ValueError(f"shm channel {name}: bad magic")
+        self.nslots = int(nslots)
+        self.arena_bytes = int(arena_bytes)
+        self._arena0 = _SLOT0 + self.nslots * _SLOT_SIZE
+        self._np = np.frombuffer(self._shm.buf, np.uint8)
+        self._arena = self._np[self._arena0:self._arena0 + self.arena_bytes]
+        # fd twin of the mapping for LARGE payload copies: os.pread /
+        # os.pwrite on the tmpfs segment move the bytes in the KERNEL
+        # with the GIL released (coherent with the mapping — same page
+        # cache), so a big copy no longer serializes every other Python
+        # thread the way a numpy slice assignment does. Small payloads
+        # keep the mapped copy (a syscall costs more than the memcpy).
+        self._fd = -1
+        try:
+            self._fd = os.open(f"/dev/shm/{name}", os.O_RDWR)
+        except OSError:
+            pass  # non-tmpfs platform: mapped copies only
+        self.cv = _doorbell(name)
+        # serializes PRODUCERS without touching the doorbell, so payload
+        # copies run with the cv released (see publish)
+        self._pub_lock = threading.Lock()
+
+    # header field accessors (offsets match _CH_FMT)
+    def _u64(self, off: int) -> int:
+        return struct.unpack_from("<Q", self._shm.buf, off)[0]
+
+    def _set_u64(self, off: int, v: int):
+        struct.pack_into("<Q", self._shm.buf, off, v)
+
+    # -- producer ----------------------------------------------------------
+    def publish(self, hdr: bytes, payload_u8, csum: int | None,
+                retx: bool, timeout: float | None = None) -> bool:
+        """Copy the payload into the arena and publish one slot. Blocks
+        on backpressure (slot table or arena full) like TCP flow control
+        — unless ``timeout`` is given (the ACK lane uses a short one so
+        a full reverse ring can never deadlock two rx threads against
+        each other; a dropped ack is re-elicited by the sender's RTO).
+        Returns False only on timeout.
+
+        Producers serialize on ``_pub_lock`` (a plain mutex distinct
+        from the doorbell Condition) so the PAYLOAD COPY can run with
+        the doorbell released: once space is reserved under the cv, the
+        only concurrent actor is the consumer, who only FREES space —
+        the reservation cannot be invalidated. Holding the cv across a
+        big memcpy would serialize the consumer's poll (and reverse-
+        channel acks) behind every producer copy, the same cost poll()
+        hoists on its side.
+
+        When a payload cannot extend past the ring edge AND the
+        single-slot wrap allocation (pad + n) would exceed the whole
+        arena (n > off), a PAD-ONLY slot claims the ring tail first —
+        without it the space condition ``head + alloc - tail <= arena``
+        is unsatisfiable FOREVER (off only moves when head moves) and
+        the channel wedges with an empty arena."""
+        n = int(payload_u8.nbytes)
+        if n > self.arena_bytes:
+            raise ValueError(
+                f"payload of {n} B exceeds the shm arena "
+                f"({self.arena_bytes} B); raise $ACCL_TPU_SHM_ARENA")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._pub_lock:
+            with self.cv:
+                while True:
+                    if self._closed:
+                        raise OSError(f"shm channel {self.name} closed")
+                    widx = self._u64(0)
+                    ridx = self._u64(8)
+                    head = self._u64(16)
+                    tail = self._u64(24)
+                    off = head % self.arena_bytes if self.arena_bytes \
+                        else 0
+                    pad = 0
+                    if n and off + n > self.arena_bytes:
+                        pad = self.arena_bytes - off
+                    if n == 0:
+                        alloc, data_off = 0, 0
+                    elif pad and pad + n > self.arena_bytes:
+                        # wedge case (see docstring): claim the ring
+                        # tail with a pad slot, then re-derive at off=0
+                        if (widx - ridx < self.nslots
+                                and head + pad - tail
+                                <= self.arena_bytes):
+                            struct.pack_into(
+                                _SLOT_FMT, self._shm.buf,
+                                _SLOT0 + (widx % self.nslots)
+                                * _SLOT_SIZE,
+                                hdr, _FLAG_PAD, 0, 0, pad)
+                            self._set_u64(16, head + pad)
+                            self._set_u64(0, widx + 1)
+                            self.cv.notify_all()
+                            continue
+                        alloc = data_off = None  # wait for pad space
+                    else:
+                        alloc, data_off = pad + n, 0 if pad else off
+                    if alloc is not None \
+                            and widx - ridx < self.nslots \
+                            and head + alloc - tail <= self.arena_bytes:
+                        break
+                    remaining = None if deadline is None \
+                        else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        return False
+                    # in-process: the consumer's release notifies this
+                    # cv; cross-process: the timeout is the poll cadence
+                    self.cv.wait(0.02 if remaining is None
+                                 else min(0.02, remaining))
+            # copy OUTSIDE the doorbell (reservation stable: producers
+            # hold _pub_lock, the consumer only frees)
+            if n:
+                if self._fd >= 0 and n >= _FD_COPY_MIN:
+                    # kernel-side copy, GIL released (see __init__)
+                    os.pwrite(self._fd, payload_u8,
+                              self._arena0 + data_off)
+                else:
+                    self._arena[data_off:data_off + n] = payload_u8
+            flags = (_FLAG_CSUM if csum is not None else 0) \
+                | (_FLAG_RETX if retx else 0)
+            with self.cv:
+                struct.pack_into(_SLOT_FMT, self._shm.buf,
+                                 _SLOT0 + (widx % self.nslots)
+                                 * _SLOT_SIZE,
+                                 hdr, flags,
+                                 (csum or 0) & 0xFFFFFFFF, data_off,
+                                 alloc)
+                self._set_u64(16, head + alloc)
+                self._set_u64(0, widx + 1)
+                self.cv.notify_all()
+        return True
+
+    # -- consumer ----------------------------------------------------------
+    def poll(self):
+        """Consume the next published frame, or None. Returns
+        ``(env, payload, flags)`` — payload is an OWNED uint8 array
+        (copied out of the arena; the frame's allocation is released
+        before returning, see the module docstring for why landed
+        payloads must own their bytes).
+
+        The payload copy runs OUTSIDE the doorbell lock: until ridx and
+        the release frontier bump below, the producer still counts this
+        frame's region as live and cannot touch it — while it CAN keep
+        publishing into genuinely free space concurrently. Holding the
+        lock across a 64 KiB memcpy serialized producer and consumer
+        (~20 us of producer lock-wait per frame at 16 KiB, measured)."""
+        while True:
+            with self.cv:
+                if self._closed:
+                    return None
+                widx = self._u64(0)
+                ridx = self._u64(8)
+                if ridx >= widx:
+                    return None
+                (hdr, flags, csum, data_off, alloc) = struct.unpack_from(
+                    _SLOT_FMT, self._shm.buf,
+                    _SLOT0 + (ridx % self.nslots) * _SLOT_SIZE)
+                if flags & _FLAG_PAD:
+                    # arena-wrap pad slot: release its tail claim and
+                    # keep looking — it never carried a frame
+                    self._set_u64(8, ridx + 1)
+                    self._set_u64(24, self._u64(24) + alloc)
+                    self.cv.notify_all()
+                    continue
+            break
+        (src, dst, tag, seqn, comm_id, strm, dtype,
+         nbytes) = struct.unpack_from(P._ETH_FMT, hdr, 1)
+        env = Envelope(
+            src=src, dst=dst, tag=tag, seqn=seqn, nbytes=nbytes,
+            wire_dtype=P.code_dtype(dtype).name, strm=strm,
+            comm_id=comm_id,
+            csum=csum if flags & _FLAG_CSUM else None)
+        # single consumer (this channel's rx thread): the slot/region
+        # stay reserved until the index bumps below
+        if not nbytes:
+            payload = b""
+        elif self._fd >= 0 and nbytes >= _FD_COPY_MIN:
+            # kernel-side copy straight into owned bytes, GIL released
+            payload = np.frombuffer(
+                os.pread(self._fd, nbytes, self._arena0 + data_off),
+                np.uint8)
+        else:
+            payload = self._arena[data_off:data_off + nbytes].copy()
+        with self.cv:
+            # slot AND arena region free the moment the indices bump:
+            # the payload owns its bytes now (ring-order frontier, no
+            # per-frame bookkeeping)
+            self._set_u64(8, ridx + 1)
+            self._set_u64(24, self._u64(24) + alloc)
+            self.cv.notify_all()
+        return env, payload, flags
+
+    def wait_frames(self, timeout: float):
+        with self.cv:
+            if self._closed:
+                return
+            if self._u64(8) >= self._u64(0):
+                self.cv.wait(timeout)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, unlink: bool):
+        with self.cv:
+            if self._closed:
+                return
+            self._closed = True
+            self.cv.notify_all()
+        # drop our numpy exports so the mapping can close (landed
+        # payloads are owned copies — nothing else pins it)
+        self._arena = None
+        self._np = None
+        if self._fd >= 0:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = -1
+        try:
+            self._shm.close()
+        except BufferError:  # a racing poll's transient export
+            pass
+        if unlink:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+            with _DB_LOCK:
+                _CREATED_NAMES.discard(self.name)
+        _doorbell_drop(self.name)
+
+
+def _as_u8(payload) -> np.ndarray:
+    if isinstance(payload, np.ndarray):
+        return payload.reshape(-1).view(np.uint8)
+    return np.frombuffer(payload, np.uint8)
+
+
+class ShmFabric:
+    """Shared-memory fabric between co-located rank daemons, with an
+    embedded TCP fabric for per-link degradation (see module docstring).
+
+    The daemon selects it via ``stack="shm"`` / ``$ACCL_TPU_FABRIC=shm``;
+    links toward peers ride shm only after :meth:`set_link` upgrades
+    them (the daemon's configure-time caps probe — ``CAP_SHM`` + same
+    host), so a world mixing shm-, tcp- and native daemons keeps every
+    pair talking over the best transport both ends speak.
+    """
+
+    shm = True           # GET_INFO advertises CAP_SHM off this marker
+    presend = None       # late caps re-probe hook (class default: see
+    # EthFabric.presend)
+
+    def __init__(self, my_global_rank: int, eth_port: int, ingest_fn,
+                 retx_window: int | None = None):
+        self.me = my_global_rank
+        self.eth_port = eth_port
+        self.ingest = ingest_fn
+        # socket fallback + the listener socket peers reach us through
+        self.inner = EthFabric(my_global_rank, eth_port, ingest_fn)
+        self._csum = P.csum_enabled_from_env()
+        self.inner.csum = self._csum
+        self._latch = None
+        self._fault = None
+        self._lock = threading.Lock()
+        self._closing = False
+        self.stats = {"sent": 0, "delivered": 0, "integrity_failed": 0,
+                      "fault_dropped": 0, "acks_shed": 0,
+                      "attach_fallbacks": 0, "tx_spooled": 0}
+        # per-destination TX overflow spool: a full ring must NEVER
+        # block the sending thread — on the ring topologies the
+        # executor runs, that thread is also the CONSUMER of its own
+        # inbound ring (recv → combine → relay in one move), and
+        # blocking it closes a store-and-forward credit cycle around
+        # the ring: every rank's relay parked on its downstream arena,
+        # every arena pinned by frames whose consumer is parked. The
+        # socket fabrics escape it through unbounded kernel/heap
+        # buffering; here the overflow frame is COPIED into a
+        # per-destination deque and a flusher thread publishes it once
+        # the ring drains (per-channel order preserved: once spooling,
+        # every later frame spools behind it until the deque empties).
+        self._spool: dict[int, object] = {}
+        self._spooling: set[int] = set()
+        self._spool_threads: dict[int, threading.Thread] = {}
+        # grank -> "shm" once upgraded; anything else rides self.inner
+        self._links: dict[int, str] = {}
+        self._peer_eth: dict[int, tuple[str, int]] = {}
+        self._chan_in: dict[int, _ShmChannel] = {}
+        self._chan_out: dict[int, tuple[_ShmChannel, threading.Lock]] = {}
+        self._rx_threads: dict[int, threading.Thread] = {}
+        window = (retx_window_from_env() if retx_window is None
+                  else max(0, int(retx_window)))
+        # Lazy tracking (the LocalFabric principle, documented in the
+        # module docstring): the ring holds SNAPSHOTS of exactly the
+        # frames the chaos hook killed — a clean publish is never
+        # tracked, never acked. copy_payloads because the executor
+        # reuses its scratch once send() returns (tx_serializes).
+        self.retx = None
+        if window > 0:
+            self.retx = RetxEndpoint(
+                my_global_rank, resend_fn=self._resend,
+                ack_fn=self._send_ack, window=window,
+                latch_fn=lambda cid, err: (self._latch(cid, err)
+                                           if self._latch else None),
+                fabric="shm", copy_payloads=True)
+
+    # -- properties the daemon pokes (kept in sync with the inner
+    #    fabric so degraded links behave identically) ----------------------
+    @property
+    def csum(self) -> bool:
+        return self._csum
+
+    @csum.setter
+    def csum(self, v: bool):
+        self._csum = bool(v)
+        self.inner.csum = bool(v)
+
+    @property
+    def latch_fn(self):
+        return self._latch
+
+    @latch_fn.setter
+    def latch_fn(self, fn):
+        self._latch = fn
+        self.inner.latch_fn = fn
+
+    # -- peers / links -----------------------------------------------------
+    def learn_peers(self, ranks: list[tuple[int, str, int]], world: int):
+        self.inner.learn_peers(ranks, world)
+        for grank, host, port in ranks:
+            if grank == self.me or not port:
+                continue
+            self._peer_eth[grank] = (host, port + world)
+            if _local_host(host):
+                # pre-create the INBOUND channel (we are the receiver)
+                # so a same-host peer's first shm send finds it
+                self._ensure_inbound(grank, port + world)
+
+    def _ensure_inbound(self, grank: int, peer_eth: int):
+        with self._lock:
+            if grank in self._chan_in or self._closing:
+                return
+            name = channel_name(peer_eth, self.eth_port)
+            try:
+                ch = _ShmChannel(name, create=True)
+            except FileExistsError:
+                # stale segment from a crashed world on the same ports:
+                # reclaim it — the namespace is ours by construction
+                try:
+                    _ShmChannel(name, create=False).close(unlink=True)
+                except (OSError, ValueError):
+                    pass
+                ch = _ShmChannel(name, create=True)
+            self._chan_in[grank] = ch
+            t = threading.Thread(target=self._rx_loop, args=(grank, ch),
+                                 daemon=True,
+                                 name=f"shm-rx-{self.me}-from-{grank}")
+            self._rx_threads[grank] = t
+            t.start()
+
+    def set_link(self, grank: int, kind: str) -> bool:
+        """Upgrade/pin the transport toward ``grank``. "shm" succeeds
+        only for a same-host peer with a known eth port; "tcp" always.
+        Called by the daemon at configure time (caps probe) — never
+        mid-traffic, so a channel's frames never straddle transports
+        within one seqn epoch."""
+        if kind == "shm":
+            ent = self._peer_eth.get(grank)
+            if ent is None or not _local_host(ent[0]):
+                return False
+            self._links[grank] = "shm"
+            return True
+        self._links.pop(grank, None)
+        return True
+
+    def link_of(self, grank: int) -> str:
+        return self._links.get(grank, "tcp")
+
+    def _outbound(self, dst: int):
+        """Attach (lazily) the outbound channel toward ``dst``; None
+        when attaching failed — the caller degrades the link."""
+        ent = self._chan_out.get(dst)
+        if ent is not None:
+            return ent
+        with self._lock:
+            ent = self._chan_out.get(dst)
+            if ent is not None or self._closing:
+                return ent
+        host_port = self._peer_eth.get(dst)
+        if host_port is None:
+            return None
+        name = channel_name(self.eth_port, host_port[1])
+        deadline = time.monotonic() + 10.0
+        ch = None
+        while time.monotonic() < deadline:
+            try:
+                ch = _ShmChannel(name, create=False)
+                break
+            except (FileNotFoundError, ValueError):
+                time.sleep(0.05)   # peer has not configured yet
+        if ch is None:
+            return None
+        with self._lock:
+            if self._closing:
+                ch.close(unlink=False)
+                return None
+            ent = self._chan_out.get(dst)
+            if ent is None:
+                ent = self._chan_out[dst] = (ch, threading.Lock())
+            else:
+                ch.close(unlink=False)
+        return ent
+
+    # -- reliability / chaos ----------------------------------------------
+    def inject_fault(self, fault_fn):
+        """Message-level fault hook (a FaultPlan qualifies), applied on
+        the send side between csum computation and publication — wire
+        corruption by construction, exactly the socket fabrics' shape.
+        Also installed on the embedded TCP fabric so degraded links see
+        the same schedule."""
+        self._fault = fault_fn
+        self.inner.inject_fault(fault_fn)
+
+    def clear_fault(self):
+        self._fault = None
+        self.inner.clear_fault()
+
+    def reset_reliability(self):
+        if self.retx is not None:
+            self.retx.reset()
+
+    def reset_comm(self, comm_id: int):
+        if self.retx is not None:
+            self.retx.reset_comm(comm_id)
+
+    def _send_ack(self, dst_grank: int, comm_id: int, cum: int, sel):
+        env = Envelope(src=self.me, dst=dst_grank, tag=0, seqn=cum,
+                       nbytes=0, wire_dtype="uint8", strm=P.ACK_STRM,
+                       comm_id=comm_id)
+        payload = P.pack_ack(cum, sel)
+        try:
+            if self._links.get(dst_grank) != "shm":
+                self.inner.send(env, payload)
+                return
+            ent = self._outbound(dst_grank)
+            if ent is None:
+                return
+            ch, tx = ent
+            hdr = P.pack_eth_header(env.src, env.dst, env.tag, env.seqn,
+                                    env.comm_id, env.strm,
+                                    P.dtype_code("uint8"), len(payload))
+            with tx:
+                # short budget: an ACK must never wedge the rx thread
+                # that emits it against a full reverse ring (the
+                # sender's RTO re-elicits a shed ack)
+                if not ch.publish(hdr, _as_u8(payload), None, False,
+                                  timeout=0.05):
+                    self.stats["acks_shed"] += 1
+        except (KeyError, OSError, ConnectionError):
+            pass  # closing / unreachable: the RTO covers
+
+    def _resend(self, env: Envelope, payload):
+        """RetxEndpoint resend path: passes the fault hook again (fresh
+        per-attempt chaos coin) and publishes flagged as a retransmit so
+        the receiver re-acks it."""
+        if self._links.get(env.dst) != "shm":
+            self.inner.send(env, payload)
+            return
+        self._emit(env, payload, retx=True)
+
+    # -- send path ---------------------------------------------------------
+    def send(self, env: Envelope, payload):
+        if self.presend is not None:
+            self.presend(env)
+        if self._links.get(env.dst) != "shm":
+            self.inner.send(env, payload)
+            return
+        if self._csum and env.csum is None \
+                and P.payload_nbytes(payload):
+            env.csum = P.csum_of(payload)
+        self.stats["sent"] += 1
+        self._emit(env, payload, retx=False)
+
+    def _emit(self, env: Envelope, payload, retx: bool):
+        """Fault interpretation + publication (the LocalFabric shape:
+        the zero-copy retransmission bookkeeping must interleave with
+        the actions, so the shared socket interpreter does not fit)."""
+        if self._fault is not None and env.strm != P.ACK_STRM:
+            action = self._fault(env, payload)
+            if isinstance(action, tuple) and action \
+                    and action[0] == "delay":
+                time.sleep(float(action[1]))
+                action = "deliver"
+            if action == "drop":
+                self.stats["fault_dropped"] += 1
+                METRICS.inc("fabric_dropped_total", fabric="shm",
+                            comm_id=env.comm_id, src=env.src, dst=env.dst)
+                self._track_lost(env, payload, retx)
+                return
+            if action == "corrupt_seq":
+                import dataclasses as _dc
+                METRICS.inc("fabric_corrupted_total", fabric="shm",
+                            comm_id=env.comm_id, src=env.src, dst=env.dst)
+                self._track_lost(env, payload, retx)
+                env = _dc.replace(env, seqn=env.seqn + 1_000_000)
+            elif action == "corrupt_payload":
+                # bit-flip AFTER the csum was computed: the landing
+                # verify rejects the copy; the tracked ORIGINAL rides
+                # the RTO resend (corrupt-as-loss)
+                METRICS.inc("fabric_corrupted_total", fabric="shm",
+                            comm_id=env.comm_id, src=env.src, dst=env.dst)
+                self._track_lost(env, payload, retx)
+                payload = flip_payload_bit(payload)
+            elif action == "duplicate":
+                METRICS.inc("fabric_duplicated_total", fabric="shm",
+                            comm_id=env.comm_id, src=env.src, dst=env.dst)
+                self._publish(env, payload, retx)
+        self._publish(env, payload, retx)
+
+    def _track_lost(self, env: Envelope, payload, retx: bool):
+        if retx or self.retx is None or env.strm:
+            return  # a lost RESEND is already in the ring
+        self.retx.track(env, payload)
+
+    def _publish(self, env: Envelope, payload, retx: bool):
+        ent = self._outbound(env.dst)
+        if ent is None:
+            # peer's channel never appeared (died / misprobed): degrade
+            # the link and fall back — the socket path carries the frame
+            self.stats["attach_fallbacks"] += 1
+            METRICS.inc("shm_link_pinned_total", rank=self.me,
+                        peer=env.dst, reason="attach_failed")
+            log.warning(
+                "rank %d shm: outbound channel toward rank %d never "
+                "appeared — degrading the link to tcp", self.me, env.dst,
+                extra={"rank": self.me})
+            self._links.pop(env.dst, None)
+            self.inner.send(env, payload)
+            return
+        ch, tx = ent
+        nbytes = P.payload_nbytes(payload)
+        hdr = P.pack_eth_header(env.src, env.dst, env.tag, env.seqn,
+                                env.comm_id, env.strm,
+                                P.dtype_code(env.wire_dtype), nbytes)
+        if _TRACE.enabled:
+            _TRACE.emit("wire_send", rank=env.src, seqn=env.seqn,
+                        peer=env.dst, nbytes=nbytes)
+        payload_u8 = _as_u8(payload)
+        with tx:
+            if env.dst in self._spooling:
+                # order: frames behind a spooled frame must spool too
+                self._spool[env.dst].append(
+                    (hdr, payload_u8.tobytes(), env.csum, retx))
+                self.stats["tx_spooled"] += 1
+                return
+            if ch.publish(hdr, payload_u8, env.csum, retx, timeout=0.0):
+                return
+            # ring/arena full: copy into the overflow spool instead of
+            # blocking this (possibly consumer) thread — see __init__
+            import collections
+            dq = self._spool.setdefault(env.dst, collections.deque())
+            dq.append((hdr, payload_u8.tobytes(), env.csum, retx))
+            self._spooling.add(env.dst)
+            self.stats["tx_spooled"] += 1
+            t = threading.Thread(
+                target=self._spool_flush, args=(env.dst, ch, tx),
+                daemon=True, name=f"shm-spool-{self.me}-to-{env.dst}")
+            self._spool_threads[env.dst] = t
+            t.start()
+
+    def _spool_flush(self, dst: int, ch: _ShmChannel, tx: threading.Lock):
+        """Drain the overflow spool toward ``dst`` in FIFO order. This
+        dedicated thread is the only place a full ring is allowed to
+        block; it exits once the deque empties (direct publishing
+        resumes under the same tx lock, so no frame can slip between)."""
+        while True:
+            with tx:
+                dq = self._spool.get(dst)
+                if not dq:
+                    self._spooling.discard(dst)
+                    self._spool_threads.pop(dst, None)
+                    return
+                hdr, payload, csum, retx = dq[0]
+            try:
+                ch.publish(hdr, _as_u8(payload), csum, retx)
+            except (OSError, ValueError):
+                with tx:  # channel closed / torn down: drop the spool
+                    self._spool.pop(dst, None)
+                    self._spooling.discard(dst)
+                    self._spool_threads.pop(dst, None)
+                return
+            with tx:
+                dq.popleft()
+
+    # -- receive path ------------------------------------------------------
+    def _rx_loop(self, src_grank: int, ch: _ShmChannel):
+        while not self._closing:
+            try:
+                got = ch.poll()
+            except (OSError, struct.error):
+                return
+            if got is None:
+                ch.wait_frames(0.02)
+                continue
+            env, payload, flags = got
+            try:
+                self._on_frame(env, payload, bool(flags & _FLAG_RETX))
+            except Exception:  # noqa: BLE001 — one bad frame must not
+                # kill the channel's only receive thread
+                log.error("rank %d shm: frame handling failed", self.me,
+                          exc_info=True, extra={"rank": self.me})
+
+    def _on_frame(self, env: Envelope, payload, is_retx: bool):
+        if env.strm == P.ACK_STRM:
+            if self.retx is not None:
+                cum, sel = P.unpack_ack(bytes(payload))
+                self.retx.on_ack(env.src, env.comm_id, cum, sel)
+            return
+        if not _verify_frame(env, payload, "shm", self.stats,
+                             self.retx, self._latch, self._csum,
+                             stats_lock=self._lock):
+            return  # corrupt-as-loss: unacked (RTO re-fetches) / typed
+        rep = self.retx
+        if rep is not None and not env.strm:
+            # verify BEFORE accept() (the PR-13 ordering invariant:
+            # recording a corrupt frame's seqn would dedup-drop the
+            # original's retransmission); ack only when the sender could
+            # hold a ring entry — on a resend, a duplicate, or a gap
+            deliver, cum, sel = rep.accept(env)
+            if not deliver:
+                if cum >= 0:
+                    self._send_ack(env.src, env.comm_id, cum, ())
+                return
+            if is_retx or sel:
+                self._send_ack(env.src, env.comm_id, cum, sel)
+        self.stats["delivered"] += 1
+        self.ingest(env, payload)
+
+    # -- surface parity with the socket fabrics ----------------------------
+    @property
+    def listening(self) -> bool:
+        return self.inner.listening
+
+    @property
+    def n_connected(self) -> int:
+        return self.inner.n_connected + len(self._chan_out)
+
+    def connect_all(self) -> int:
+        """Eagerly attach every shm-linked peer's channel; socket-linked
+        peers dial through the embedded fabric (openCon parity)."""
+        err = 0
+        for grank, kind in list(self._links.items()):
+            if kind == "shm" and self._outbound(grank) is None:
+                err |= int(ErrorCode.OPEN_CON_NOT_SUCCEEDED)
+        return err | self.inner.connect_all()
+
+    def disconnect_all(self):
+        self.inner.disconnect_all()
+
+    def metrics_rows(self):
+        # snapshot both maps: send threads mutate _links concurrently
+        # (attach-failure degrades, late-probe upgrades) and a mutating
+        # dict mid-iteration would truncate this fabric's rows
+        for grank in list(self._links):
+            yield ("gauge", "shm_link_up",
+                   {"rank": self.me, "peer": grank}, 1)
+        for ch in list(self._chan_in.values()):
+            try:
+                pinned = ch._u64(16) - ch._u64(24)
+            except (OSError, struct.error, TypeError):
+                continue
+            yield ("gauge", "shm_arena_pinned_bytes",
+                   {"rank": self.me, "chan": ch.name}, pinned)
+
+    def close(self):
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+            chan_in = dict(self._chan_in)
+            chan_out = dict(self._chan_out)
+            self._chan_in.clear()
+            self._chan_out.clear()
+        # inbound segments are OURS: always unlink (the /dev/shm sweep
+        # contract — even when landed views are still alive, the NAME
+        # must go; the mapping follows the last view)
+        for ch in chan_in.values():
+            ch.close(unlink=True)
+        for ch, _tx in chan_out.values():
+            ch.close(unlink=False)
+        self.inner.close()

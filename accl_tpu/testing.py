@@ -163,11 +163,13 @@ def connect_world(port_base: int, world_size: int,
 
 
 def sim_world(world_size: int, nbufs: int = 16, bufsize: int = 1 << 20,
-              timeout: float = 20.0, stack: str = "tcp") -> list[ACCL]:
+              timeout: float = 20.0, stack: str | None = None
+              ) -> list[ACCL]:
     """Create ACCL instances driving out-of-process-style rank daemons over
     the socket protocol (daemons run in-process threads here; the same
     protocol drives true multi-process daemons and the native C++ daemon).
-    ``stack`` selects the eth fabric (tcp or udp)."""
+    ``stack`` selects the eth fabric (tcp, udp, or shm — the shared-
+    memory dataplane; None reads ``$ACCL_TPU_FABRIC``, default tcp)."""
     from .emulator.daemon import spawn_world
     daemons, port_base = spawn_world(world_size, nbufs=nbufs,
                                      bufsize=bufsize, stack=stack)

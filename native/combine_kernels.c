@@ -1,0 +1,300 @@
+/* _accl_combine: contiguous two-operand elementwise reduction kernels.
+ *
+ * The CPU-native twin of the reference's per-dtype reduce_sum plugins
+ * (kernels/plugins/reduce_sum): one compiled loop per (func, dtype) over
+ * contiguous spans, exposed to Python through one METH_FASTCALL entry so
+ * the emulator's combine workers stop paying numpy's per-segment ufunc
+ * dispatch (~0.5-1us per call — comparable to the whole memory op at the
+ * 4-64 KiB segment sizes the streamed executor feeds them).
+ *
+ * Contract (enforced by accl_tpu/native_combine.py, the loader):
+ *   - results are BIT-IDENTICAL to the numpy fallback for every
+ *     supported (func, dtype): float ops use the same IEEE single/double
+ *     arithmetic; f16/bf16 compute in float32 (both operands are exactly
+ *     representable there, so the sum/product is exact) and round back
+ *     with the same round-to-nearest-even numpy/ml_dtypes use; integer
+ *     SUM/PROD wrap modulo 2^n via unsigned arithmetic (signed overflow
+ *     is UB in C, defined wraparound in numpy); MAX/MIN mirror numpy's
+ *     `(a > b || isnan(a)) ? a : b` (strict compare: the SECOND operand
+ *     wins ties, visible on signed zeros; NaN in either propagates).
+ *   - dtype codes are accl_tpu/emulator/protocol.py DTYPE_CODES; func
+ *     codes are accl_tpu.constants.ReduceFunc values. The loader pins
+ *     both at resolution time, so this module only validates lengths
+ *     and contiguity (PyBUF_SIMPLE refuses strided exports).
+ *
+ * Build: `make -C native` (the _accl_combine.so target), or lazily by
+ * the loader with the same flags. No numpy C API — plain buffer
+ * protocol, so the .so survives numpy upgrades.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <math.h>
+#include <stdint.h>
+#include <string.h>
+
+/* func codes (accl_tpu.constants.ReduceFunc) */
+#define F_SUM 0
+#define F_MAX 1
+#define F_MIN 2
+#define F_PROD 3
+
+/* dtype codes (accl_tpu/emulator/protocol.py DTYPE_CODES) */
+#define DT_F32 0
+#define DT_F64 1
+#define DT_I32 2
+#define DT_I64 3
+#define DT_F16 4
+#define DT_BF16 5
+#define DT_I8 6
+#define DT_U8 7
+
+/* ---- half / bfloat16 conversion (numpy/ml_dtypes round-to-nearest-even
+ * parity; the float32 intermediate is exact for any two-operand sum or
+ * product of 11-bit/8-bit significands, so rounding the exact result is
+ * the correctly-rounded half/bf16 operation numpy produces) ---- */
+
+static inline float half_to_float(uint16_t h) {
+    uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+    uint32_t exp = (h >> 10) & 0x1Fu;
+    uint32_t man = h & 0x3FFu;
+    uint32_t f;
+    if (exp == 0) {
+        if (man == 0) {
+            f = sign;
+        } else { /* subnormal: renormalize into f32 */
+            uint32_t e = 113; /* 127 - 15 + 1 */
+            while (!(man & 0x400u)) { man <<= 1; e--; }
+            man &= 0x3FFu;
+            f = sign | (e << 23) | (man << 13);
+        }
+    } else if (exp == 31) {
+        f = sign | 0x7F800000u | (man << 13);
+    } else {
+        f = sign | ((exp + 112u) << 23) | (man << 13);
+    }
+    float out;
+    memcpy(&out, &f, 4);
+    return out;
+}
+
+static inline uint16_t float_to_half(float v) {
+    uint32_t x;
+    memcpy(&x, &v, 4);
+    uint32_t sign = (x >> 16) & 0x8000u;
+    uint32_t fexp = (x >> 23) & 0xFFu;
+    uint32_t man = x & 0x7FFFFFu;
+    int32_t exp = (int32_t)fexp - 127 + 15;
+    if (fexp == 0xFFu) /* inf / nan */
+        return (uint16_t)(sign | 0x7C00u
+                          | (man ? (0x200u | (man >> 13)) : 0));
+    if (exp >= 31) /* overflow -> inf */
+        return (uint16_t)(sign | 0x7C00u);
+    if (exp <= 0) { /* subnormal half (or zero) */
+        if (exp < -10)
+            return (uint16_t)sign;
+        man |= 0x800000u; /* implicit bit */
+        uint32_t shift = (uint32_t)(14 - exp);
+        uint32_t hman = man >> shift;
+        uint32_t rem = man & ((1u << shift) - 1u);
+        uint32_t halfway = 1u << (shift - 1);
+        if (rem > halfway || (rem == halfway && (hman & 1u)))
+            hman++;
+        return (uint16_t)(sign | hman);
+    }
+    uint32_t rem = man & 0x1FFFu;
+    uint16_t out = (uint16_t)(sign | ((uint32_t)exp << 10) | (man >> 13));
+    if (rem > 0x1000u || (rem == 0x1000u && (out & 1u)))
+        out++;
+    return out;
+}
+
+static inline float bf16_to_float(uint16_t h) {
+    uint32_t x = (uint32_t)h << 16;
+    float f;
+    memcpy(&f, &x, 4);
+    return f;
+}
+
+static inline uint16_t float_to_bf16(float v) {
+    uint32_t x;
+    memcpy(&x, &v, 4);
+    if ((x & 0x7FFFFFFFu) > 0x7F800000u) /* nan: quiet, keep payload top */
+        return (uint16_t)((x >> 16) | 0x0040u);
+    uint32_t lsb = (x >> 16) & 1u;
+    x += 0x7FFFu + lsb; /* round to nearest even */
+    return (uint16_t)(x >> 16);
+}
+
+/* numpy maximum/minimum semantics: `(a OP b || isnan(a)) ? a : b` with
+ * a STRICT comparison — the second operand wins ties, which is visible
+ * on signed zeros (`maximum(+0., -0.) == -0.`), and NaN in either
+ * operand propagates (isnan(a) picks a; a NaN b falls through the
+ * false comparison to b). */
+#define FMAX_NP(a, b) (((a) > (b) || isnan(a)) ? (a) : (b))
+#define FMIN_NP(a, b) (((a) < (b) || isnan(a)) ? (a) : (b))
+#define IMAX_NP(a, b) (((a) >= (b)) ? (a) : (b))
+#define IMIN_NP(a, b) (((a) <= (b)) ? (a) : (b))
+
+#define LOOP(expr)                                                        \
+    do {                                                                  \
+        for (Py_ssize_t i = 0; i < n; i++)                                \
+            o[i] = (expr);                                                \
+    } while (0)
+
+/* float/double: plain IEEE ops (identical to numpy's loops) */
+#define FLOAT_BODY(T)                                                     \
+    do {                                                                  \
+        const T *a = (const T *)abuf;                                     \
+        const T *b = (const T *)bbuf;                                     \
+        T *o = (T *)obuf;                                                 \
+        switch (func) {                                                   \
+        case F_SUM: LOOP(a[i] + b[i]); break;                             \
+        case F_PROD: LOOP(a[i] * b[i]); break;                            \
+        case F_MAX: LOOP(FMAX_NP(a[i], b[i])); break;                     \
+        case F_MIN: LOOP(FMIN_NP(a[i], b[i])); break;                     \
+        default: return -1;                                               \
+        }                                                                 \
+    } while (0)
+
+/* ints: SUM/PROD wrap via the unsigned twin (numpy wraparound parity) */
+#define INT_BODY(T, U)                                                    \
+    do {                                                                  \
+        const T *a = (const T *)abuf;                                     \
+        const T *b = (const T *)bbuf;                                     \
+        T *o = (T *)obuf;                                                 \
+        switch (func) {                                                   \
+        case F_SUM: LOOP((T)((U)a[i] + (U)b[i])); break;                  \
+        case F_PROD: LOOP((T)((U)a[i] * (U)b[i])); break;                 \
+        case F_MAX: LOOP(IMAX_NP(a[i], b[i])); break;                     \
+        case F_MIN: LOOP(IMIN_NP(a[i], b[i])); break;                     \
+        default: return -1;                                               \
+        }                                                                 \
+    } while (0)
+
+/* 16-bit floats: widen, combine in f32, round back (see header note).
+ * MAXCMP/MINCMP are the comparison tokens because numpy's tie rule is
+ * DTYPE-INCONSISTENT: the float16 loops (npy_half_ge) keep the FIRST
+ * operand on ties (>= / <=), while ml_dtypes' bfloat16 follows the
+ * f32/f64 strict rule and keeps the SECOND — visible on signed zeros
+ * (`np.maximum(np.float16(+0.), np.float16(-0.))` is +0, the same call
+ * on bfloat16 is -0), pinned by tests/test_combine_native.py. */
+#define HALFLIKE_BODY(TO_F, FROM_F, MAXCMP, MINCMP)                       \
+    do {                                                                  \
+        const uint16_t *a = (const uint16_t *)abuf;                       \
+        const uint16_t *b = (const uint16_t *)bbuf;                       \
+        uint16_t *o = (uint16_t *)obuf;                                   \
+        switch (func) {                                                   \
+        case F_SUM: LOOP(FROM_F(TO_F(a[i]) + TO_F(b[i]))); break;         \
+        case F_PROD: LOOP(FROM_F(TO_F(a[i]) * TO_F(b[i]))); break;        \
+        case F_MAX:                                                       \
+            LOOP((TO_F(a[i]) MAXCMP TO_F(b[i]) || isnan(TO_F(a[i])))      \
+                     ? a[i] : b[i]);                                      \
+            break;                                                        \
+        case F_MIN:                                                       \
+            LOOP((TO_F(a[i]) MINCMP TO_F(b[i]) || isnan(TO_F(a[i])))      \
+                     ? a[i] : b[i]);                                      \
+            break;                                                        \
+        default: return -1;                                               \
+        }                                                                 \
+    } while (0)
+
+static int run_reduce(int func, int dt, const void *abuf, const void *bbuf,
+                      void *obuf, Py_ssize_t n) {
+    switch (dt) {
+    case DT_F32: FLOAT_BODY(float); return 0;
+    case DT_F64: FLOAT_BODY(double); return 0;
+    case DT_I32: INT_BODY(int32_t, uint32_t); return 0;
+    case DT_I64: INT_BODY(int64_t, uint64_t); return 0;
+    case DT_I8: INT_BODY(int8_t, uint8_t); return 0;
+    case DT_U8: INT_BODY(uint8_t, uint8_t); return 0;
+    case DT_F16: HALFLIKE_BODY(half_to_float, float_to_half,
+                               >=, <=); return 0;
+    case DT_BF16: HALFLIKE_BODY(bf16_to_float, float_to_bf16,
+                                >, <); return 0;
+    default: return -1;
+    }
+}
+
+static const Py_ssize_t ITEMSIZE[] = {4, 8, 4, 8, 2, 2, 1, 1};
+
+/* Release the GIL only past this span size: the acquire/release pair
+ * costs ~100ns, which at small segments would eat the dispatch win this
+ * module exists to provide. */
+#define GIL_RELEASE_BYTES (1 << 14)
+
+static PyObject *reduce_into(PyObject *self, PyObject *const *args,
+                             Py_ssize_t nargs) {
+    (void)self;
+    if (nargs != 5) {
+        PyErr_SetString(PyExc_TypeError,
+                        "reduce_into(func, dtype_code, a, b, out)");
+        return NULL;
+    }
+    int func = (int)PyLong_AsLong(args[0]);
+    int dt = (int)PyLong_AsLong(args[1]);
+    if ((func == -1 || dt == -1) && PyErr_Occurred())
+        return NULL;
+    if (dt < 0 || dt > DT_U8) {
+        PyErr_SetString(PyExc_ValueError, "unsupported dtype code");
+        return NULL;
+    }
+    Py_buffer a, b, o;
+    /* PyBUF_SIMPLE demands C-contiguity — strided views fail here and
+     * the Python loader falls back to numpy */
+    if (PyObject_GetBuffer(args[2], &a, PyBUF_SIMPLE) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(args[3], &b, PyBUF_SIMPLE) < 0) {
+        PyBuffer_Release(&a);
+        return NULL;
+    }
+    if (PyObject_GetBuffer(args[4], &o, PyBUF_WRITABLE) < 0) {
+        PyBuffer_Release(&a);
+        PyBuffer_Release(&b);
+        return NULL;
+    }
+    Py_ssize_t isz = ITEMSIZE[dt];
+    int bad = (a.len != b.len || a.len != o.len || a.len % isz != 0);
+    int rc = 0;
+    if (!bad) {
+        Py_ssize_t n = a.len / isz;
+        if (a.len >= GIL_RELEASE_BYTES) {
+            Py_BEGIN_ALLOW_THREADS
+            rc = run_reduce(func, dt, a.buf, b.buf, o.buf, n);
+            Py_END_ALLOW_THREADS
+        } else {
+            rc = run_reduce(func, dt, a.buf, b.buf, o.buf, n);
+        }
+    }
+    PyBuffer_Release(&a);
+    PyBuffer_Release(&b);
+    PyBuffer_Release(&o);
+    if (bad) {
+        PyErr_SetString(PyExc_ValueError,
+                        "operand/result byte lengths disagree");
+        return NULL;
+    }
+    if (rc) {
+        PyErr_SetString(PyExc_ValueError, "unsupported func code");
+        return NULL;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"reduce_into", (PyCFunction)(void (*)(void))reduce_into,
+     METH_FASTCALL,
+     "reduce_into(func, dtype_code, a, b, out): out[i] = func(a[i], b[i]) "
+     "over contiguous same-length buffers; bit-identical to the numpy "
+     "ufunc for every supported (func, dtype)."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_accl_combine",
+    "Compiled contiguous-span combine kernels for the emulator dataplane.",
+    -1, methods, NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit__accl_combine(void) {
+    return PyModule_Create(&module);
+}

@@ -45,7 +45,9 @@ PROB = 0.02
 
 def _schedule(accls, algorithm, count, iters=3):
     """The measured body: ``iters`` allreduces + one allgather, returning
-    every rank's final buffers (the differential surface)."""
+    every rank's final buffers (the differential surface). Results are
+    synced from the device first, so the same body drives in-process AND
+    daemon-tier (socket/shm) worlds."""
     W = len(accls)
     ins = [np.random.default_rng(100 + r).standard_normal(count)
            .astype(np.float32) for r in range(W)]
@@ -58,6 +60,8 @@ def _schedule(accls, algorithm, count, iters=3):
         for _ in range(iters):
             a.allreduce(src, dst, count, algorithm=algorithm)
         a.allgather(gsrc, gdst, count // W)
+        dst.sync_from_device()
+        gdst.sync_from_device()
         return dst.data.copy(), gdst.data.copy()
 
     return run_ranks(accls, body, timeout=300.0)
@@ -195,6 +199,53 @@ def _integrity_total() -> float:
                                           {}).values()))
 
 
+def shm_cell(kind: str, seed: int, oracle) -> tuple[bool, int, str]:
+    """One fault kind through a 3-rank shared-memory daemon world
+    (emulator/shm.py ShmFabric): the seeded plan rides every daemon's
+    ``inject_fault`` hook exactly like the socket fabrics', the result
+    is held BIT-IDENTICAL to the in-process serial oracle, and the cell
+    additionally proves the machinery ENGAGED — drops must move the
+    retransmission counters (the ring's payload-retention + lazy-track
+    contract), payload corruption must move ``integrity_failed_total``
+    (corrupt-as-loss through the landing verify), and teardown must
+    leave /dev/shm clean (checked by the sweep's caller via the lint
+    contract; a leak would fail the next ``make lint``)."""
+    from accl_tpu.emulator.daemon import spawn_world
+    from accl_tpu.testing import connect_world
+    plan = FaultPlan([FaultRule(kind=kind, every=3, offset=1,
+                                delay_s=0.01),
+                      FaultRule(kind=kind, prob=PROB, delay_s=0.01)],
+                     seed=seed)
+    daemons, base = spawn_world(WORLDS[0], nbufs=32, stack="shm")
+    try:
+        accls = connect_world(base, WORLDS[0], timeout=30.0)
+    except Exception:
+        for d in daemons:
+            d.shutdown()
+        raise
+    try:
+        integ_before = _integrity_total()
+        for d in daemons:
+            d.eth.inject_fault(plan)
+        res = _schedule(accls, A.FUSED_RING, COUNT)
+        ok = all((a == b).all() for r, o in zip(res, oracle)
+                 for a, b in zip(r, o))
+        status = "ok" if ok else "DIVERGED"
+        retx = sum(d.eth.retx.stats["retransmits"] for d in daemons
+                   if d.eth.retx is not None)
+        if kind == "drop" and ok and retx <= 0:
+            ok, status = False, "NO-RETRANSMITS"
+        if kind == "corrupt_payload" and ok \
+                and _integrity_total() <= integ_before:
+            ok, status = False, "NO-INTEGRITY-DROPS"
+    finally:
+        for d in daemons:
+            d.eth.clear_fault()
+        for a in accls:
+            a.deinit()
+    return ok, sum(plan.applied.values()), status
+
+
 def rma_cell(seed: int) -> tuple[bool, int]:
     """One-sided put under payload corruption of the rendezvous segment
     lane (strm=5, which bypasses the rx pool entirely): the engine's
@@ -263,6 +314,20 @@ def sweep(seed: int, hier: bool = True) -> int:
                 rows.append((W, alg_name, kind, status,
                              sum(plan.applied.values()),
                              round((time.perf_counter() - t0) * 1e3)))
+    # shared-memory fabric cells: every kind through a shm daemon world,
+    # bit-identical to the same serial oracle (the cross-fabric
+    # differential contract), with engagement proofs per kind
+    for kind in KINDS:
+        t0 = time.perf_counter()
+        try:
+            ok, applied, status = shm_cell(kind, seed, oracles["ring"])
+        except Exception as exc:  # noqa: BLE001 — report cell
+            ok, applied = False, 0
+            status = f"FAILED ({type(exc).__name__})"
+        if not ok:
+            failures += 1
+        rows.append((WORLDS[0], "shm", kind, status, applied,
+                     round((time.perf_counter() - t0) * 1e3)))
     # one-sided RMA payload-corrupt cell (rendezvous lane)
     t0 = time.perf_counter()
     try:

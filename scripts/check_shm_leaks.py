@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Shared-memory segment leak lint (``make lint``).
+
+The ShmFabric contract (emulator/shm.py): every ``accl_shm_*`` segment
+a world creates is unlinked at teardown — the receiver owns its inbound
+segments and ``close()`` always removes the /dev/shm names, and the
+daemon answers MSG_SHUTDOWN only AFTER teardown completed, so "the
+client's deinit returned" means "the names are gone". This lint enforces
+the contract two ways:
+
+1. **pre-existing leaks** — any ``accl_shm_*`` name already in /dev/shm
+   is a leak from an earlier crashed/killed run (or a regression in the
+   teardown path). Reported and REMOVED (a stale name would otherwise
+   make the next same-port world pay the reclaim path), and the lint
+   fails so CI surfaces where it came from.
+2. **live check** — spins a minimal 2-rank shm daemon world, runs one
+   small allreduce over the rings, tears it down through the ordinary
+   client path, and asserts /dev/shm is clean afterwards.
+
+tests/conftest.py runs the same sweep as an autouse fixture after every
+test, so a leaking test fails ITSELF, not some later victim.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def stale_segments() -> list[str]:
+    try:
+        return sorted(f for f in os.listdir("/dev/shm")
+                      if f.startswith("accl_shm_"))
+    except FileNotFoundError:  # non-tmpfs platform: nothing to check
+        return []
+
+
+def main() -> int:
+    rc = 0
+    stale = stale_segments()
+    if stale:
+        print(f"FAIL: {len(stale)} stale shm segment(s) leaked by an "
+              f"earlier run: {stale[:8]}{' ...' if len(stale) > 8 else ''}")
+        for name in stale:
+            try:
+                os.unlink(os.path.join("/dev/shm", name))
+            except OSError:
+                pass
+        rc = 1
+
+    import numpy as np
+
+    from accl_tpu.testing import run_ranks, sim_world
+
+    accls = sim_world(2, stack="shm")
+    try:
+        n = 256
+        def body(a):
+            src = a.buffer(data=np.full(n, float(a.rank + 1), np.float32))
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)
+            dst.sync_from_device()
+            assert (dst.data == 3.0).all()
+        run_ranks(accls, body, timeout=60.0)
+    finally:
+        for a in accls:
+            try:
+                a.deinit()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+    left = stale_segments()
+    if left:
+        print(f"FAIL: shm world teardown leaked segment(s): {left}")
+        for name in left:
+            try:
+                os.unlink(os.path.join("/dev/shm", name))
+            except OSError:
+                pass
+        rc = 1
+    if rc == 0:
+        print("shm leak check: clean")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

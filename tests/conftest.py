@@ -65,3 +65,38 @@ def pytest_configure(config):
         "markers",
         "slow: long-running soak/chaos tests (tier-1 deselects them "
         "with -m 'not slow'; run explicitly or via the full corpus)")
+
+
+def _shm_leftovers():
+    try:
+        return sorted(f for f in os.listdir("/dev/shm")
+                      if f.startswith("accl_shm_"))
+    except FileNotFoundError:  # non-tmpfs platform
+        return []
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _shm_leak_sweep():
+    """Post-test /dev/shm sweep (the ShmFabric teardown contract,
+    emulator/shm.py): every ``accl_shm_*`` segment must be unlinked by
+    world teardown. Sweeping after EVERY test makes the leaking test
+    fail itself instead of poisoning a later victim; leaked names are
+    removed so the rest of the run is not double-punished. Listing
+    /dev/shm is one getdents call — noise-free for the 99% of tests
+    that never touch the fabric."""
+    pre = _shm_leftovers()
+    yield
+    leaked = [f for f in _shm_leftovers() if f not in pre]
+    if leaked:
+        for name in leaked:
+            try:
+                os.unlink(os.path.join("/dev/shm", name))
+            except OSError:
+                pass
+        pytest.fail(
+            f"test leaked {len(leaked)} shm segment(s): {leaked} — "
+            f"ShmFabric worlds must be torn down (a.deinit() / "
+            f"daemon.shutdown()) before the test returns")

@@ -1,0 +1,561 @@
+"""Shared-memory dataplane (emulator/shm.py): ring units, fabric e2e,
+cross-fabric differential corpus, chaos/retx/integrity contracts, mixed
+worlds, the PR-14 late caps probe, and teardown hygiene.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu.chaos import FaultPlan, FaultRule
+from accl_tpu.constants import ACCLError, CollectiveAlgorithm, ErrorCode
+from accl_tpu.emulator import protocol as P
+from accl_tpu.emulator.daemon import RankDaemon, probe_peer_caps, \
+    spawn_world
+from accl_tpu.emulator.shm import _ShmChannel, channel_name
+from accl_tpu.testing import connect_world, emu_world, free_port_base, \
+    run_ranks, sim_world
+from accl_tpu.tracing import METRICS
+
+
+def _counter_total(name: str) -> float:
+    snap = METRICS.snapshot()
+    return float(sum(snap["counters"].get(name, {}).values()))
+
+
+def _env(overrides: dict):
+    class _Ctx:
+        def __enter__(self):
+            self.prev = {k: os.environ.get(k) for k in overrides}
+            for k, v in overrides.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        def __exit__(self, *exc):
+            for k, v in self.prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    return _Ctx()
+
+
+# -- ring unit tier ----------------------------------------------------------
+
+def test_channel_roundtrip_wrap_and_release():
+    """SPSC ring: header/payload fidelity across thousands of frames of
+    mixed sizes (incl. empty — a stalled frontier on those deadlocked an
+    early version), arena wrap-around, ring-order release."""
+    import random
+    name = channel_name(61000, 61001)
+    rx = _ShmChannel(name, create=True, nslots=8, arena_bytes=1 << 16)
+    tx = _ShmChannel(name, create=False)
+    try:
+        random.seed(5)
+        for i in range(3000):
+            n = random.choice([0, 1, 16, 1000, 7000])
+            hdr = P.pack_eth_header(0, 1, i % 7, i, 42, 0,
+                                    P.dtype_code("uint8"), n)
+            data = np.full(n, i % 251, np.uint8)
+            assert tx.publish(hdr, data, 0xABC if n else None, False,
+                              timeout=5.0), i
+            got = rx.poll()
+            assert got is not None
+            env, payload, flags = got
+            assert (env.src, env.dst, env.tag, env.seqn, env.comm_id) \
+                == (0, 1, i % 7, i, 42)
+            assert env.nbytes == n
+            if n:
+                arr = np.frombuffer(payload, np.uint8) \
+                    if not isinstance(payload, np.ndarray) else payload
+                assert (arr == i % 251).all()
+                assert env.csum == 0xABC
+    finally:
+        tx.close(unlink=False)
+        rx.close(unlink=True)
+
+
+def test_channel_backpressure_returns_false_on_timeout():
+    name = channel_name(61010, 61011)
+    rx = _ShmChannel(name, create=True, nslots=4, arena_bytes=1 << 14)
+    tx = _ShmChannel(name, create=False)
+    try:
+        hdr = P.pack_eth_header(0, 1, 0, 0, 1, 0, 7, 8192)
+        data = np.zeros(8192, np.uint8)
+        # arena 16 KiB, frames 8 KiB: the third unconsumed publish is
+        # backpressured and must report, not wedge
+        assert tx.publish(hdr, data, None, False, timeout=1.0)
+        assert tx.publish(hdr, data, None, False, timeout=1.0)
+        t0 = time.monotonic()
+        assert not tx.publish(hdr, data, None, False, timeout=0.05)
+        assert time.monotonic() - t0 < 1.0
+        assert rx.poll() is not None  # consuming frees arena in order
+        assert tx.publish(hdr, data, None, False, timeout=1.0)
+    finally:
+        tx.close(unlink=False)
+        rx.close(unlink=True)
+
+
+def test_wrap_pad_slot_unwedges_large_payload():
+    """Review regression (PR 14): a payload that cannot extend past the
+    ring edge AND whose single-slot wrap allocation (pad + n) exceeds
+    the whole arena (n > off) must publish via a PAD slot — without it
+    the space condition is unsatisfiable FOREVER (off only moves when
+    head moves) and the channel wedges with an EMPTY arena."""
+    name = channel_name(61040, 61041)
+    rx = _ShmChannel(name, create=True, nslots=8, arena_bytes=65536)
+    tx = _ShmChannel(name, create=False)
+    try:
+        # drive head to offset 30000, drain fully
+        hdr = P.pack_eth_header(0, 1, 0, 0, 1, 0, 7, 30000)
+        assert tx.publish(hdr, np.zeros(30000, np.uint8), None, False,
+                          timeout=1.0)
+        assert rx.poll() is not None
+        # 40000 > off-to-edge complement: old code computed
+        # alloc = 35536 + 40000 > arena and could never publish. The
+        # pad slot is RELEASED by the consumer, so poll concurrently
+        # (the rx-thread shape; the old code times out here forever
+        # regardless of polling)
+        hdr2 = P.pack_eth_header(0, 1, 0, 1, 1, 0, 7, 40000)
+        data = np.arange(40000, dtype=np.uint8) % 251
+        got_frames = []
+
+        def drain():
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not got_frames:
+                got = rx.poll()
+                if got is not None:
+                    got_frames.append(got)
+                else:
+                    rx.wait_frames(0.01)
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        assert tx.publish(hdr2, data, None, False, timeout=4.0)
+        t.join(6.0)
+        assert got_frames
+        env, payload, _ = got_frames[0]
+        assert env.seqn == 1 and env.nbytes == 40000
+        arr = np.frombuffer(payload, np.uint8) \
+            if not isinstance(payload, np.ndarray) else payload
+        assert (arr == data).all()
+    finally:
+        tx.close(unlink=False)
+        rx.close(unlink=True)
+
+
+def test_oversize_payload_raises_with_guidance():
+    name = channel_name(61020, 61021)
+    rx = _ShmChannel(name, create=True, nslots=4, arena_bytes=1 << 14)
+    try:
+        hdr = P.pack_eth_header(0, 1, 0, 0, 1, 0, 7, 1 << 15)
+        with pytest.raises(ValueError, match="ACCL_TPU_SHM_ARENA"):
+            rx.publish(hdr, np.zeros(1 << 15, np.uint8), None, False)
+    finally:
+        rx.close(unlink=True)
+
+
+def test_stale_segment_reclaimed_on_world_restart():
+    """A crashed world's leftover segment on the same ports must be
+    reclaimed by the next world's receiver, not crash it."""
+    name = channel_name(61030, 61031)
+    stale = _ShmChannel(name, create=True, nslots=8,
+                        arena_bytes=1 << 16)
+    stale.close(unlink=False)  # abandon WITHOUT unlink (the crash shape)
+    try:
+        from accl_tpu.emulator.shm import ShmFabric
+        fab = ShmFabric(1, 61031, lambda e, p: None)
+        try:
+            # peer rank 0's eth port is 61030 -> inbound name collides
+            # with the stale segment; learn_peers must reclaim it
+            fab.learn_peers([(0, "127.0.0.1", 61030 - 2),
+                             (1, "127.0.0.1", 61031 - 2)], 2)
+            assert 0 in fab._chan_in
+        finally:
+            fab.close()
+    finally:
+        try:
+            os.unlink(f"/dev/shm/{name}")
+        except OSError:
+            pass
+
+
+# -- daemon-world e2e --------------------------------------------------------
+
+def test_shm_world_collectives_and_caps():
+    """4-rank shm daemon world: links upgraded via the CAP_SHM probe,
+    GET_INFO advertises CAP_SHM + the shm stack byte, collectives land
+    exact results, frames actually rode the rings."""
+    daemons, base = spawn_world(4, nbufs=32, stack="shm")
+    accls = connect_world(base, 4)
+    try:
+        for d in daemons:
+            for g in range(4):
+                if g != d.rank:
+                    assert d.eth.link_of(g) == "shm"
+        caps = probe_peer_caps("127.0.0.1", base)
+        assert caps is not None and caps & P.CAP_SHM
+        info = daemons[0]._handle(bytes([P.MSG_GET_INFO]))
+        # stack byte: MSG_DATA(1) + Q3I(20) + Q(8) + I(4) + flags(1)
+        assert info[34] == 2
+        n = 512
+        ins = [np.random.default_rng(r).standard_normal(n)
+               .astype(np.float32) for r in range(4)]
+
+        def body(a):
+            src = a.buffer(data=ins[a.comm.local_rank].copy())
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)
+            g = a.buffer((4 * n,), np.float32)
+            a.allgather(src, g, n)
+            dst.sync_from_device()
+            g.sync_from_device()
+            return dst.data.copy(), g.data.copy()
+
+        res = run_ranks(accls, body, timeout=60.0)
+        golden = np.sum(ins, axis=0, dtype=np.float32)
+        for dst, g in res:
+            assert np.allclose(dst, golden, atol=1e-4)
+            assert (g == np.concatenate(ins)).all()
+        assert sum(d.eth.stats["sent"] for d in daemons) > 0
+        assert sum(d.eth.stats["integrity_failed"] for d in daemons) == 0
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def _differential_schedule(accls, algorithm, count, compressed=False):
+    W = len(accls)
+    if compressed:
+        # f16-representable integer corpus: eth compression stays exact
+        ins = [((np.arange(count) + 13 * r) % 31).astype(np.float32)
+               for r in range(W)]
+    else:
+        ins = [np.random.default_rng(50 + r).standard_normal(count)
+               .astype(np.float32) for r in range(W)]
+
+    def body(a):
+        src = a.buffer(data=ins[a.comm.local_rank].copy())
+        dst = a.buffer((count,), np.float32)
+        kw = {"compress_dtype": np.float16} if compressed else {}
+        a.allreduce(src, dst, count, algorithm=algorithm, **kw)
+        dst.sync_from_device()
+        return dst.data.copy()
+
+    return run_ranks(accls, body, timeout=120.0)
+
+
+def test_cross_fabric_differential_corpus():
+    """The PR-14 coverage satellite: the same seeded schedule over
+    LocalFabric (serial reference = the oracle), TCP, UDP and shm daemon
+    worlds, ring and recursive-doubling, W in {3, 4, 8}, held
+    BIT-IDENTICAL across fabrics — plus one eth-compressed cell per
+    fabric. A fabric whose landing path re-encodes, tears or reorders
+    payload bytes diverges here."""
+    count = 768
+    algos = {"ring": CollectiveAlgorithm.FUSED_RING,
+             "rd": CollectiveAlgorithm.RECURSIVE_DOUBLING}
+    for W in (3, 4, 8):
+        oracles = {}
+        accls = emu_world(W, pipeline_window=0, retx_window=0)
+        try:
+            for name, alg in algos.items():
+                oracles[name] = _differential_schedule(accls, alg, count)
+        finally:
+            for a in accls:
+                a.deinit()
+        for stack in ("tcp", "udp", "shm"):
+            accls = sim_world(W, nbufs=32, stack=stack)
+            try:
+                for name, alg in algos.items():
+                    res = _differential_schedule(accls, alg, count)
+                    for r, o in zip(res, oracles[name]):
+                        assert (r == o).all(), (stack, name, W)
+            finally:
+                for a in accls:
+                    a.deinit()
+    # compressed cell (W=4 ring): exact for the f16-representable corpus
+    accls = emu_world(4, pipeline_window=0, retx_window=0)
+    try:
+        oracle_c = _differential_schedule(
+            accls, CollectiveAlgorithm.FUSED_RING, count, compressed=True)
+    finally:
+        for a in accls:
+            a.deinit()
+    for stack in ("tcp", "udp", "shm"):
+        accls = sim_world(4, nbufs=32, stack=stack)
+        try:
+            res = _differential_schedule(
+                accls, CollectiveAlgorithm.FUSED_RING, count,
+                compressed=True)
+            for r, o in zip(res, oracle_c):
+                assert (r == o).all(), ("compressed", stack)
+        finally:
+            for a in accls:
+                a.deinit()
+
+
+# -- chaos / reliability / integrity ----------------------------------------
+
+def _shm_chaos_world():
+    daemons, base = spawn_world(3, nbufs=32, stack="shm")
+    accls = connect_world(base, 3)
+    return daemons, accls
+
+
+def test_chaos_drop_recovered_by_retransmission():
+    daemons, accls = _shm_chaos_world()
+    try:
+        n = 1024
+        def body(a):
+            src = a.buffer(data=np.full(n, float(a.comm.local_rank + 1),
+                                        np.float32))
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)
+            dst.sync_from_device()
+            return dst.data.copy()
+        clean = run_ranks(accls, body, timeout=60.0)
+        plan = FaultPlan([FaultRule(kind="drop", every=3, offset=1),
+                          FaultRule(kind="drop", prob=0.05)], seed=11)
+        for d in daemons:
+            d.eth.inject_fault(plan)
+        lossy = run_ranks(accls, body, timeout=120.0)
+        assert all((a == b).all() for a, b in zip(lossy, clean))
+        assert sum(plan.applied.values()) > 0
+        assert sum(d.eth.stats["fault_dropped"] for d in daemons) > 0
+        assert sum(d.eth.retx.stats["retransmits"] for d in daemons) > 0
+    finally:
+        for d in daemons:
+            d.eth.clear_fault()
+        for a in accls:
+            a.deinit()
+
+
+def test_corrupt_payload_is_loss_and_counted():
+    """corrupt-as-loss on the ring: the flip lands, the landing verify
+    rejects it (integrity_failed moves), the retained original rides the
+    RTO resend, and the result stays exact."""
+    daemons, accls = _shm_chaos_world()
+    before = _counter_total("integrity_failed_total")
+    try:
+        n = 1024
+        def body(a):
+            src = a.buffer(data=np.full(n, float(a.comm.local_rank + 1),
+                                        np.float32))
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)
+            dst.sync_from_device()
+            return dst.data.copy()
+        plan = FaultPlan([FaultRule(kind="corrupt_payload", every=4,
+                                    offset=1)], seed=13)
+        for d in daemons:
+            d.eth.inject_fault(plan)
+        res = run_ranks(accls, body, timeout=120.0)
+        assert all((r == np.float32(6.0)).all() for r in res)
+        assert sum(d.eth.stats["integrity_failed"] for d in daemons) > 0
+        assert _counter_total("integrity_failed_total") > before
+    finally:
+        for d in daemons:
+            d.eth.clear_fault()
+        for a in accls:
+            a.deinit()
+
+
+def test_retx0_corrupt_latches_typed_integrity_error():
+    """With the retransmission window pinned to 0 there is no recovery:
+    a corrupt frame must surface as typed DATA_INTEGRITY_ERROR, never a
+    silent wrong result (the FABRIC_QUEUE_OVERFLOW precedent)."""
+    with _env({"ACCL_TPU_RETX_WINDOW": "0"}):
+        daemons, base = spawn_world(2, nbufs=16, stack="shm")
+        accls = connect_world(base, 2, timeout=8.0)
+    try:
+        assert all(d.eth.retx is None for d in daemons)
+        plan = FaultPlan([FaultRule(kind="corrupt_payload", every=1,
+                                    max_attempt=99)], seed=7)
+        for d in daemons:
+            d.eth.inject_fault(plan)
+        n = 256
+        def body(a):
+            src = a.buffer(data=np.ones(n, np.float32))
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)
+        with pytest.raises(ACCLError) as ei:
+            run_ranks(accls, body, timeout=60.0)
+        assert ei.value.error_word & int(ErrorCode.DATA_INTEGRITY_ERROR)
+    finally:
+        for d in daemons:
+            d.eth.clear_fault()
+        for a in accls:
+            a.deinit()
+
+
+def test_spool_absorbs_tiny_arena_without_deadlock():
+    """Regression for the store-and-forward credit cycle: with the arena
+    far below the in-flight demand the TX overflow spool must engage
+    (tx_spooled > 0) and the collective must stay exact — an early
+    zero-copy design deadlocked or tore frames here."""
+    with _env({"ACCL_TPU_SHM_ARENA": str(1 << 17)}):
+        daemons, base = spawn_world(4, nbufs=64, bufsize=1 << 16,
+                                    stack="shm")
+        accls = connect_world(base, 4)
+    try:
+        count = (2 << 20) // 4
+        bufs = [(a.buffer(data=np.full(count,
+                                       float(a.comm.local_rank + 1),
+                                       np.float32)),
+                 a.buffer((count,), np.float32)) for a in accls]
+        def body(a):
+            src, dst = bufs[a.comm.local_rank]
+            a.allreduce(src, dst, count)
+        for _ in range(2):
+            run_ranks(accls, body, timeout=60.0)
+        for _, dst in bufs:
+            dst.sync_from_device()
+            assert (dst.data == np.float32(10.0)).all()
+        assert sum(d.eth.stats["tx_spooled"] for d in daemons) > 0
+        assert sum(d.eth.stats["integrity_failed"] for d in daemons) == 0
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+# -- mixed worlds / degradation ----------------------------------------------
+
+def test_mixed_stack_world_degrades_per_link():
+    """shm daemon + tcp daemon in one world: the caps probe sees no
+    CAP_SHM on the tcp peer, the link stays on the embedded TCP fabric
+    (shm_link_pinned_total counts it), and traffic flows."""
+    base = free_port_base(span=8)
+    before = _counter_total("shm_link_pinned_total")
+    d0 = RankDaemon(0, 2, base, host="127.0.0.1", stack="shm")
+    d1 = RankDaemon(1, 2, base, host="127.0.0.1", stack="tcp")
+    for d in (d0, d1):
+        threading.Thread(target=d.serve_forever, daemon=True).start()
+    accls = connect_world(base, 2)
+    try:
+        assert d0.eth.link_of(1) == "tcp"
+        assert _counter_total("shm_link_pinned_total") > before
+        n = 256
+        def body(a):
+            src = a.buffer(data=np.full(n, float(a.comm.local_rank + 1),
+                                        np.float32))
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)
+            dst.sync_from_device()
+            assert (dst.data == np.float32(3.0)).all()
+        run_ranks(accls, body, timeout=60.0)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_late_pin_first_send_probe():
+    """PR-14 satellite: a peer UNREACHABLE at configure time is cached
+    as unknown (never pinned on a guess) and re-probed at the first
+    send toward it via the fabric presend hook — the PR-13 pre-probe
+    window, closed. Stubbing a capless (native-shaped) GET_INFO
+    responder that only appears AFTER configure proves the late pin."""
+    base = free_port_base(span=8)
+    daemon = None
+    stub = None
+    before = _counter_total("caps_probe_late_total")
+    try:
+        daemon = RankDaemon(0, 2, base, host="127.0.0.1", stack="tcp")
+        assert daemon.eth.csum
+        body = P.pack_comm(991, 0, [(0, "127.0.0.1", base),
+                                    (1, "127.0.0.1", base + 1)])
+        assert daemon._handle(body)[0] == P.MSG_STATUS
+        # nothing listens on base+1 yet: unknown, not pinned — and the
+        # late-probe hook is armed on the fabric
+        assert daemon.eth.csum
+        assert 1 in daemon._unprobed
+        assert daemon.eth.presend is not None
+        # the capless peer comes up AFTER configure (the slow-starting
+        # native daemon shape)
+        srv = socket.create_server(("127.0.0.1", base + 1))
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                try:
+                    req = P.recv_frame(conn)
+                    if req and req[0] == P.MSG_GET_INFO:
+                        payload = (struct.pack("<Q3I", 1 << 20, 16, 2, 1)
+                                   + struct.pack("<QIBBI", 1 << 20,
+                                                 30000, 1, 0, 0))
+                        P.send_frame(conn, bytes([P.MSG_DATA]) + payload)
+                except (ConnectionError, OSError):
+                    pass
+                finally:
+                    conn.close()
+
+        stub = srv
+        threading.Thread(target=serve, daemon=True).start()
+        # first send toward the peer re-probes and pins (the hook runs
+        # exactly where EthFabric.send would invoke it)
+        from accl_tpu.emulator.fabric import Envelope
+        env = Envelope(src=0, dst=1, tag=0, seqn=0, nbytes=4,
+                       wire_dtype="uint8", comm_id=991)
+        daemon.eth.presend(env)
+        assert daemon.eth.csum is False       # pinned: capless peer
+        assert 1 not in daemon._unprobed
+        assert daemon.eth.presend is None     # hot path restored
+        assert _counter_total("caps_probe_late_total") > before
+    finally:
+        if daemon is not None:
+            daemon.shutdown()
+        if stub is not None:
+            stub.close()
+
+
+def test_late_probe_cooldown_while_peer_stays_dead():
+    """A still-unreachable peer costs at most one short probe per
+    cooldown window on the send path — never a pin, never a wedge."""
+    base = free_port_base(span=8)
+    daemon = None
+    try:
+        daemon = RankDaemon(0, 2, base, host="127.0.0.1", stack="tcp")
+        body = P.pack_comm(992, 0, [(0, "127.0.0.1", base),
+                                    (1, "127.0.0.1", base + 1)])
+        daemon._handle(body)
+        assert 1 in daemon._unprobed
+        from accl_tpu.emulator.fabric import Envelope
+        env = Envelope(src=0, dst=1, tag=0, seqn=0, nbytes=4,
+                       wire_dtype="uint8", comm_id=992)
+        daemon.eth.presend(env)               # probe fails fast
+        assert 1 in daemon._unprobed          # still unknown, unpinned
+        assert daemon.eth.csum                # never pinned on a guess
+        t0 = time.monotonic()
+        daemon.eth.presend(env)               # inside the cooldown
+        assert time.monotonic() - t0 < 0.1    # no second probe paid
+    finally:
+        if daemon is not None:
+            daemon.shutdown()
+
+
+def test_world_teardown_unlinks_all_segments():
+    accls = sim_world(3, stack="shm")
+    try:
+        n = 128
+        def body(a):
+            src = a.buffer(data=np.ones(n, np.float32))
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n)
+        run_ranks(accls, body, timeout=60.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    left = [f for f in os.listdir("/dev/shm")
+            if f.startswith("accl_shm_")]
+    assert not left, left
