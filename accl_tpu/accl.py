@@ -40,6 +40,21 @@ from .tracing import METRICS, Profiler, TRACE
 log = get_logger(__name__)
 
 
+def _phases_strip_flat(compress_phases: str | None) -> bool:
+    """Validate a per-phase compression selector and answer whether a
+    FLAT (non-hierarchical) execution should drop the wire compression:
+    "inter" compresses only inter-host hierarchy phases, and a flat call
+    has none — EQuARX semantics, where intra-host traffic always stays
+    full precision."""
+    if compress_phases in (None, "all"):
+        return False
+    if compress_phases == "inter":
+        return True
+    raise ValueError(
+        f"compress_phases must be None, 'all' or 'inter', got "
+        f"{compress_phases!r}")
+
+
 class ACCL:
     """One rank's handle to the collective engine.
 
@@ -724,12 +739,58 @@ class ACCL:
         return ACCLBuffer(shape, dtype=dtype, device=self.device, data=data)
 
     # -- call plumbing -----------------------------------------------------
+    def _resolve_wire(self, op: str, comm: Communicator, count: int,
+                      operand_dtype, compress_dtype, block_scale):
+        """Resolve ``compress_dtype="auto"``: the tuner prices the
+        quantized wire variant (beta scaled by the wire-byte ratio plus
+        the quant/dequant gamma term, tuner/cost.py) against the
+        full-precision one and picks per (op, world, size) — fp8-e4m3
+        block-scaled wire exactly in the bandwidth-bound band, no
+        compression for latency-bound calls. Opt-in by the literal
+        "auto": AUTO algorithm selection alone never changes numerics,
+        and "auto" on a non-f32 call quietly stays uncompressed (the
+        block-scaled lane is f32-only — crashing a call that runs fine
+        uncompressed would make "auto" unsafe to sprinkle)."""
+        if block_scale and compress_dtype is None:
+            # the flat path raises this from _prepare; raising HERE too
+            # keeps hierarchical lowerings (which never reach _prepare
+            # with the caller's kwargs) from silently dropping the ask
+            raise ValueError(
+                "block_scale needs a compress_dtype naming the quantized "
+                "wire dtype (int8 / float8_e4m3fn / float8_e5m2)")
+        if not (isinstance(compress_dtype, str)
+                and compress_dtype == "auto"):
+            return compress_dtype, block_scale
+        dt = None if operand_dtype is None else np.dtype(operand_dtype)
+        if dt == np.dtype(np.float32) and self.tuner is not None \
+                and self.tuner.select_wire(op, comm.size,
+                                           count * dt.itemsize):
+            import ml_dtypes
+            return np.dtype(ml_dtypes.float8_e4m3fn), \
+                (block_scale if block_scale else True)
+        return None, False
+
+    def _quant_block_for(self, count: int, elem_bytes: int,
+                         block_scale) -> int:
+        """The call's scale-block size: an explicit int is clamped into
+        the legal envelope; ``True`` asks the tuner (falling back to the
+        default) — larger blocks amortize the scale header, smaller ones
+        track local dynamic range."""
+        from . import quant
+        if block_scale is True:
+            if self.tuner is not None:
+                return self.tuner.recommend_quant_block(
+                    count * elem_bytes)
+            return quant.DEFAULT_BLOCK
+        return quant.clamp_block(int(block_scale))
+
     def _prepare(self, scenario: CCLOp, *, count: int, comm: Communicator,
                  root_src_dst: int = 0, func: ReduceFunc = ReduceFunc.SUM,
                  tag: int = TAG_ANY,
                  op0: ACCLBuffer | None = None, op1: ACCLBuffer | None = None,
                  res: ACCLBuffer | None = None,
                  compress_dtype: np.dtype | str | None = None,
+                 block_scale: bool | int = False,
                  stream_dtype: np.dtype | str | None = None,
                  stream_flags: StreamFlags = StreamFlags.NO_STREAM,
                  algorithm: CollectiveAlgorithm | str = (
@@ -740,7 +801,10 @@ class ACCL:
         Parity: prepare_call (accl.py:528-592) — collect operand dtypes,
         find the matching arithmetic config, mark each narrower-typed
         operand OP{0,1}/RES_COMPRESSED, and request ETH_COMPRESSED when the
-        caller asks for wire compression.
+        caller asks for wire compression. ``block_scale`` (with
+        ``compress_dtype``) upgrades the wire from plain narrowing to
+        block-scaled quantization (accl_tpu/quant.py): True = tuner-
+        recommended block size, an int = explicit block.
         """
         if getattr(comm, "revoked", False):
             # ULFM-style containment: a revoked communicator accepts no
@@ -758,6 +822,12 @@ class ACCL:
         if compress_dtype is not None:
             dtypes.add(np.dtype(compress_dtype))
             compression |= Compression.ETH_COMPRESSED
+            if block_scale:
+                compression |= Compression.BLOCK_SCALED
+        elif block_scale:
+            raise ValueError(
+                "block_scale needs a compress_dtype naming the quantized "
+                "wire dtype (int8 / float8_e4m3fn / float8_e5m2)")
         if not dtypes:
             dtypes = {np.dtype(np.float32)}
         # memoized: resolution walks name-sorted registry keys (~15us),
@@ -770,6 +840,32 @@ class ACCL:
         if cfg is None:
             cfg = resolve_arith_config(dtypes, self.arith_registry)
             self._arith_memo[mk] = cfg
+        if compression & Compression.BLOCK_SCALED:
+            # derive the block-scaled config (quant_block > 0 drives the
+            # scale-header segmentation reserve + the executor's fused
+            # dequant->accumulate->requant lane); memoized per (dtype
+            # set, block) like the plain configs
+            import dataclasses as _dc
+            qblock = self._quant_block_for(
+                count, cfg.uncompressed_elem_bytes, block_scale)
+            bk = (mk, qblock)
+            bcfg = self._arith_memo.get(bk)
+            if bcfg is None:
+                bcfg = _dc.replace(cfg, quant_block=qblock)
+                self._arith_memo[bk] = bcfg
+            cfg = bcfg
+        elif (compression & Compression.ETH_COMPRESSED
+                and cfg.is_compressing
+                and cfg.compressed_dtype.kind in "iu"
+                and cfg.uncompressed_dtype.kind == "f"):
+            # fail at the call site, not deep in expansion: the
+            # (float, int8) pair exists FOR the block-scaled lane —
+            # plain astype narrowing truncates/wraps floats silently
+            raise ValueError(
+                f"compress_dtype={cfg.compressed_dtype.name} on "
+                f"{cfg.uncompressed_dtype.name} operands requires "
+                f"block-scaled quantization (pass block_scale=): plain "
+                f"dtype narrowing to an integer wire would truncate")
         if cfg.is_compressing:
             if op0 is not None and op0.dtype == cfg.compressed_dtype:
                 compression |= Compression.OP0_COMPRESSED
@@ -1001,10 +1097,16 @@ class ACCL:
             world, nbytes = self.comm_of(desc.comm_id).size, \
                 desc.count * ebytes
             alg = desc.algorithm
+            quantized = bool(desc.compression & Compression.BLOCK_SCALED)
 
             def _feed(error_word: int, _t0=t0):
-                tuner.observe(op, world, nbytes, alg,
-                              _time.perf_counter() - _t0, error_word)
+                dt = _time.perf_counter() - _t0
+                tuner.observe(op, world, nbytes, alg, dt, error_word)
+                # wire-variant refinement: measured quantized/plain
+                # durations sharpen select_wire's cost-model crossover
+                # (benchmarks/tune.py sweeps both legs deliberately)
+                tuner.observe_wire(op, world, nbytes, quantized, dt,
+                                   error_word)
 
             handle.add_done_callback(_feed)
         if run_async:
@@ -1211,7 +1313,8 @@ class ACCL:
 
     def send(self, srcbuf: ACCLBuffer | None, count: int, dst: int,
              tag: int = TAG_ANY, *, comm: Communicator | None = None,
-             compress_dtype=None, stream_dtype=None,
+             compress_dtype=None, block_scale: bool | int = False,
+             stream_dtype=None,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
              run_async: bool = False, chain: bool = False,
              waitfor: Sequence[CallHandle] = (),
@@ -1220,11 +1323,14 @@ class ACCL:
              ) -> CallHandle:
         """With OP0_STREAM the payload is sourced from this rank's
         stream-in port (srcbuf may be None; element type from
-        ``stream_dtype``, default float32)."""
+        ``stream_dtype``, default float32). ``block_scale`` (with
+        ``compress_dtype``) sends block-scaled quantized wire segments
+        — the receiver must post a matching block-scaled recv."""
         comm = comm or self.comm
         desc = self._prepare(CCLOp.send, count=count, comm=comm,
                              root_src_dst=dst, tag=tag, op0=srcbuf,
                              compress_dtype=compress_dtype,
+                             block_scale=block_scale,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
         return self._call(desc, run_async, waitfor, chain,
@@ -1232,7 +1338,8 @@ class ACCL:
 
     def recv(self, dstbuf: ACCLBuffer | None, count: int, src: int,
              tag: int = TAG_ANY, *, comm: Communicator | None = None,
-             compress_dtype=None, stream_dtype=None,
+             compress_dtype=None, block_scale: bool | int = False,
+             stream_dtype=None,
              stream_flags: StreamFlags = StreamFlags.NO_STREAM,
              run_async: bool = False, chain: bool = False,
              waitfor: Sequence[CallHandle] = (),
@@ -1246,6 +1353,7 @@ class ACCL:
         desc = self._prepare(CCLOp.recv, count=count, comm=comm,
                              root_src_dst=src, tag=tag, res=dstbuf,
                              compress_dtype=compress_dtype,
+                             block_scale=block_scale,
                              stream_dtype=stream_dtype,
                              stream_flags=stream_flags)
         return self._call(desc, run_async, waitfor, chain,
@@ -1359,7 +1467,8 @@ class ACCL:
     def bcast(self, buf: ACCLBuffer, count: int | None = None, root: int = 0,
               *, comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
-                 compress_dtype=None,
+                 compress_dtype=None, block_scale: bool | int = False,
+                 compress_phases: str | None = None,
               run_async: bool = False, chain: bool = False,
               waitfor: Sequence[CallHandle] = (),
               retries: int | None = None,
@@ -1368,14 +1477,23 @@ class ACCL:
               ) -> CallHandle:
         comm = comm or self.comm
         count = count if count is not None else buf.size
+        compress_dtype, block_scale = self._resolve_wire(
+            "bcast", comm, count, buf.dtype, compress_dtype,
+            block_scale)
+        routed = self._hier_route("bcast", comm, count,
+                                  buf.dtype.itemsize, algorithm)
+        if not routed and _phases_strip_flat(compress_phases):
+            # strip BEFORE the verify decision (see allreduce)
+            compress_dtype, block_scale = None, False
         verify = self._want_verify(verify_integrity, run_async,
                                    compress_dtype is not None)
-        if self._hier_route("bcast", comm, count, buf.dtype.itemsize,
-                            algorithm):
+        if routed:
             with self._retry_scope(retries, retry_policy):
                 handle = self._hier.run("bcast", count=count, src=buf,
                                         root=root,
                                         compress_dtype=compress_dtype,
+                                        block_scale=block_scale,
+                                        compress_phases=compress_phases,
                                         run_async=run_async,
                                         waitfor=waitfor)
             if verify:
@@ -1384,6 +1502,7 @@ class ACCL:
         desc = self._prepare(CCLOp.bcast, count=count, comm=comm,
                              root_src_dst=root, op0=buf,
                              compress_dtype=compress_dtype,
+                             block_scale=block_scale,
                              algorithm=algorithm)
         handle = self._call(desc, run_async, waitfor, chain,
                             retries, retry_policy)
@@ -1394,6 +1513,7 @@ class ACCL:
     def scatter(self, srcbuf: ACCLBuffer | None, dstbuf: ACCLBuffer,
                 count: int, root: int = 0, *,
                 comm: Communicator | None = None, compress_dtype=None,
+                block_scale: bool | int = False,
                 run_async: bool = False, chain: bool = False,
                 waitfor: Sequence[CallHandle] = (),
                 retries: int | None = None,
@@ -1404,7 +1524,8 @@ class ACCL:
         comm = comm or self.comm
         desc = self._prepare(CCLOp.scatter, count=count, comm=comm,
                              root_src_dst=root, op0=srcbuf, res=dstbuf,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype,
+                             block_scale=block_scale)
         return self._call(desc, run_async, waitfor, chain,
                           retries, retry_policy)
 
@@ -1412,7 +1533,7 @@ class ACCL:
                count: int, root: int = 0, *,
                comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
-                 compress_dtype=None,
+                 compress_dtype=None, block_scale: bool | int = False,
                run_async: bool = False, chain: bool = False,
                waitfor: Sequence[CallHandle] = (),
                retries: int | None = None,
@@ -1430,6 +1551,7 @@ class ACCL:
         desc = self._prepare(CCLOp.gather, count=count, comm=comm,
                              root_src_dst=root, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
+                             block_scale=block_scale,
                              algorithm=algorithm)
         if (desc.algorithm == CollectiveAlgorithm.TREE
                 and comm.local_rank != root):
@@ -1448,7 +1570,7 @@ class ACCL:
                root: int = 0, func: ReduceFunc = ReduceFunc.SUM, *,
                comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
-                 compress_dtype=None,
+                 compress_dtype=None, block_scale: bool | int = False,
                run_async: bool = False, chain: bool = False,
                waitfor: Sequence[CallHandle] = (),
                retries: int | None = None,
@@ -1460,6 +1582,7 @@ class ACCL:
         desc = self._prepare(CCLOp.reduce, count=count, comm=comm,
                              root_src_dst=root, func=func, op0=srcbuf,
                              res=dstbuf, compress_dtype=compress_dtype,
+                             block_scale=block_scale,
                              algorithm=algorithm)
         if (desc.algorithm == CollectiveAlgorithm.TREE
                 and comm.local_rank != root
@@ -1479,7 +1602,8 @@ class ACCL:
     def allgather(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,
                   comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
-                 compress_dtype=None,
+                 compress_dtype=None, block_scale: bool | int = False,
+                 compress_phases: str | None = None,
                   run_async: bool = False, chain: bool = False,
                   waitfor: Sequence[CallHandle] = (),
                   retries: int | None = None,
@@ -1487,16 +1611,26 @@ class ACCL:
                   verify_integrity: bool | None = None
                   ) -> CallHandle:
         comm = comm or self.comm
+        compress_dtype, block_scale = self._resolve_wire(
+            "allgather", comm, count,
+            srcbuf.dtype if srcbuf.dtype == dstbuf.dtype else None,
+            compress_dtype, block_scale)
+        routed = self._hier_route(
+            "allgather", comm, count,
+            max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
+            algorithm)
+        if not routed and _phases_strip_flat(compress_phases):
+            # strip BEFORE the verify decision (see allreduce)
+            compress_dtype, block_scale = None, False
         verify = self._want_verify(verify_integrity, run_async,
                                    compress_dtype is not None)
-        if self._hier_route(
-                "allgather", comm, count,
-                max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
-                algorithm):
+        if routed:
             with self._retry_scope(retries, retry_policy):
                 handle = self._hier.run("allgather", count=count,
                                         src=srcbuf, dst=dstbuf,
                                         compress_dtype=compress_dtype,
+                                        block_scale=block_scale,
+                                        compress_phases=compress_phases,
                                         run_async=run_async,
                                         waitfor=waitfor)
             if verify:
@@ -1506,6 +1640,7 @@ class ACCL:
         desc = self._prepare(CCLOp.allgather, count=count, comm=comm,
                              op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
+                             block_scale=block_scale,
                              algorithm=algorithm)
         handle = self._call(desc, run_async, waitfor, chain,
                             retries, retry_policy)
@@ -1519,24 +1654,46 @@ class ACCL:
                   func: ReduceFunc = ReduceFunc.SUM, *,
                   comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
-                 compress_dtype=None,
+                 compress_dtype=None, block_scale: bool | int = False,
+                 compress_phases: str | None = None,
                   run_async: bool = False, chain: bool = False,
                   waitfor: Sequence[CallHandle] = (),
                   retries: int | None = None,
                   retry_policy: "RetryPolicy | None" = None,
                   verify_integrity: bool | None = None
                   ) -> CallHandle:
+        """``compress_dtype`` narrows the wire; with ``block_scale``
+        (True = tuner-recommended block, int = explicit) the wire is
+        block-scale QUANTIZED instead — per-segment scale headers, f32
+        accumulation, per-hop-bounded error (accl_tpu/quant.py).
+        ``compress_dtype="auto"`` lets the tuner pick quantized wire in
+        the bandwidth-bound band. ``compress_phases="inter"`` applies
+        the wire compression only to phases that cross the slow
+        inter-host tier of a HIERARCHICAL lowering (EQuARX's headline
+        trick); intra-host phases stay full precision, and a flat call
+        with "inter" is simply uncompressed."""
         comm = comm or self.comm
+        compress_dtype, block_scale = self._resolve_wire(
+            "allreduce", comm, count,
+            srcbuf.dtype if srcbuf.dtype == dstbuf.dtype else None,
+            compress_dtype, block_scale)
+        routed = self._hier_route(
+            "allreduce", comm, count,
+            max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
+            algorithm)
+        if not routed and _phases_strip_flat(compress_phases):
+            # strip BEFORE the verify decision: a flat "inter" call
+            # executes fully uncompressed, where verification is valid
+            compress_dtype, block_scale = None, False
         verify = self._want_verify(verify_integrity, run_async,
                                    compress_dtype is not None)
-        if self._hier_route(
-                "allreduce", comm, count,
-                max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
-                algorithm):
+        if routed:
             with self._retry_scope(retries, retry_policy):
                 handle = self._hier.run("allreduce", count=count,
                                         src=srcbuf, dst=dstbuf, func=func,
                                         compress_dtype=compress_dtype,
+                                        block_scale=block_scale,
+                                        compress_phases=compress_phases,
                                         run_async=run_async,
                                         waitfor=waitfor)
             if verify:
@@ -1545,6 +1702,7 @@ class ACCL:
         desc = self._prepare(CCLOp.allreduce, count=count, comm=comm,
                              func=func, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
+                             block_scale=block_scale,
                              algorithm=algorithm)
         handle = self._call(desc, run_async, waitfor, chain,
                             retries, retry_policy)
@@ -1556,7 +1714,8 @@ class ACCL:
                        count: int, func: ReduceFunc = ReduceFunc.SUM, *,
                        comm: Communicator | None = None,
                  algorithm: CollectiveAlgorithm | str = CollectiveAlgorithm.AUTO,
-                       compress_dtype=None,
+                       compress_dtype=None, block_scale: bool | int = False,
+                       compress_phases: str | None = None,
                        run_async: bool = False, chain: bool = False,
                        waitfor: Sequence[CallHandle] = (),
                        retries: int | None = None,
@@ -1564,6 +1723,10 @@ class ACCL:
                        ) -> CallHandle:
         """count = per-rank chunk; srcbuf holds world_size*count."""
         comm = comm or self.comm
+        compress_dtype, block_scale = self._resolve_wire(
+            "reduce_scatter", comm, count,
+            srcbuf.dtype if srcbuf.dtype == dstbuf.dtype else None,
+            compress_dtype, block_scale)
         if self._hier_route(
                 "reduce_scatter", comm, count,
                 max(srcbuf.dtype.itemsize, dstbuf.dtype.itemsize),
@@ -1572,10 +1735,15 @@ class ACCL:
                 return self._hier.run("reduce_scatter", count=count,
                                       src=srcbuf, dst=dstbuf, func=func,
                                       compress_dtype=compress_dtype,
+                                      block_scale=block_scale,
+                                      compress_phases=compress_phases,
                                       run_async=run_async, waitfor=waitfor)
+        if _phases_strip_flat(compress_phases):
+            compress_dtype, block_scale = None, False
         desc = self._prepare(CCLOp.reduce_scatter, count=count, comm=comm,
                              func=func, op0=srcbuf, res=dstbuf,
                              compress_dtype=compress_dtype,
+                             block_scale=block_scale,
                              algorithm=algorithm)
         if desc.algorithm == CollectiveAlgorithm.RECURSIVE_DOUBLING:
             # the recursive-halving expansion needs a whole-vector
@@ -1589,6 +1757,7 @@ class ACCL:
 
     def alltoall(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer, count: int, *,
                  comm: Communicator | None = None, compress_dtype=None,
+                 block_scale: bool | int = False,
                  run_async: bool = False, chain: bool = False,
                  waitfor: Sequence[CallHandle] = (),
                  retries: int | None = None,
@@ -1597,7 +1766,8 @@ class ACCL:
         comm = comm or self.comm
         desc = self._prepare(CCLOp.alltoall, count=count, comm=comm,
                              op0=srcbuf, res=dstbuf,
-                             compress_dtype=compress_dtype)
+                             compress_dtype=compress_dtype,
+                             block_scale=block_scale)
         return self._call(desc, run_async, waitfor, chain,
                           retries, retry_policy)
 

@@ -55,6 +55,13 @@ class ArithConfig:
     supported_funcs: tuple[ReduceFunc, ...] = (
         ReduceFunc.SUM, ReduceFunc.MAX, ReduceFunc.MIN, ReduceFunc.PROD)
     arith_is_compressed: bool = False
+    # Block-scaled quantized wire (accl_tpu/quant.py): >0 = the wire
+    # carries per-block scale headers with this many elements per scale
+    # block, and ETH_COMPRESSED emissions quantize/dequantize instead of
+    # casting. 0 = plain dtype narrowing (the default). The driver
+    # derives a block-scaled config per call (dataclasses.replace), so
+    # the registry entries stay plain.
+    quant_block: int = 0
 
     @property
     def uncompressed_elem_bytes(self) -> int:
@@ -73,6 +80,12 @@ class ArithConfig:
     @property
     def is_compressing(self) -> bool:
         return self.uncompressed_dtype != self.compressed_dtype
+
+    @property
+    def block_scaled(self) -> bool:
+        """True when ETH_COMPRESSED wire traffic under this config is
+        block-scale quantized rather than plainly narrowed."""
+        return self.quant_block > 0
 
     def wire_dtype(self, compression: Compression) -> np.dtype:
         """Dtype that actually travels on the fabric for this call."""
@@ -97,6 +110,11 @@ DEFAULT_ARITH_CONFIGS: dict[tuple[str, str], ArithConfig] = {
     ("float16", "float16"): _mk("float16", "float16"),
     ("float32", "float16"): _mk("float32", "float16"),
     ("int8", "int8"): _mk("int8", "int8"),
+    # int8 quantized wire lane: f32 in memory, int8 on the wire —
+    # intended for the BLOCK_SCALED path (per-block absmax scales make
+    # int8 wire numerically meaningful; a plain astype narrowing to
+    # int8 truncates and is almost never what a caller wants)
+    ("float32", "int8"): _mk("float32", "int8"),
 }
 
 try:  # bfloat16 comes from ml_dtypes (always present with jax)

@@ -106,6 +106,14 @@ class FaultRule:
     delay_s: float = 0.0              # for kind="delay"
     group_a: tuple = ()               # for kind="partition": frames
     group_b: tuple = ()               # crossing a<->b (either way) drop
+    # corrupt_payload only: flip a bit in THIS payload byte offset
+    # instead of the default middle byte. The block-scaled chaos cells
+    # target the SCALE-HEADER region of a quantized segment with it
+    # (quant.HDR_BYTES puts the first scale at offset 8), proving a
+    # corrupt scale recovers through the checksum/retx contract exactly
+    # like a corrupt data byte — never landing as a silently mis-scaled
+    # block. Clamped to the payload length by the fabrics.
+    flip_at: int | None = None
 
     def __post_init__(self):
         if self.kind in _KIND_ALIASES:  # frozen dataclass: object.__setattr__
@@ -236,6 +244,10 @@ class FaultPlan:
             self.applied[rule.kind] += 1
             if rule.kind == "delay":
                 return ("delay", rule.delay_s)
+            if rule.kind == "corrupt_payload" and rule.flip_at is not None:
+                # targeted bit-flip (e.g. inside a scale header): the
+                # fabrics understand the tuple form like delay's
+                return ("corrupt_payload", rule.flip_at)
             return _ACTION_OF[rule.kind]
         return "deliver"
 

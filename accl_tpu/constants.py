@@ -98,6 +98,16 @@ class Compression(enum.IntFlag):
     OP1_COMPRESSED = 2
     RES_COMPRESSED = 4
     ETH_COMPRESSED = 8
+    # Block-scaled quantized wire (accl_tpu/quant.py, EQuARX-style):
+    # only meaningful WITH ETH_COMPRESSED — each wire segment carries a
+    # per-block absmax-derived f32 scale header ahead of the fp8/int8
+    # payload, and the executor's combine lane runs the fused
+    # dequant -> f32-accumulate -> requant step per hop. Operand storage
+    # stays the uncompressed dtype (OP*/RES_COMPRESSED are rejected in
+    # combination); the block size is a runtime, tuner-recommended
+    # choice carried OUTSIDE this flag (descriptor qblock field /
+    # ArithConfig.quant_block) because the payload is self-describing.
+    BLOCK_SCALED = 16
 
 
 class StreamFlags(enum.IntFlag):

@@ -614,7 +614,9 @@ class SimDevice(Device):
                            desc.tag & 0xFFFFFFFF,
                            desc.addr_0 or 0, desc.addr_1 or 0,
                            desc.addr_2 or 0, list(waitfor_ids),
-                           algorithm=int(desc.algorithm))
+                           algorithm=int(desc.algorithm),
+                           qblock=(cfg.quant_block
+                                   if cfg is not None else 0))
 
     def _submit(self, desc: CallDescriptor,
                 waitfor_ids: Sequence[int] = ()) -> int:

@@ -176,9 +176,15 @@ def _apply_fault(fault_fn, env: Envelope, payload, fabric: str,
     dropped frame; a ``duplicate`` emits the extra copy itself via
     ``emit``."""
     action = fault_fn(env, payload)
-    if isinstance(action, tuple) and action and action[0] == "delay":
-        sleep(float(action[1]))
-        action = "deliver"
+    flip_at = None
+    if isinstance(action, tuple) and action:
+        if action[0] == "delay":
+            sleep(float(action[1]))
+            action = "deliver"
+        elif action[0] == "corrupt_payload":
+            # targeted bit-flip (FaultRule.flip_at — e.g. a scale byte)
+            flip_at = int(action[1])
+            action = "corrupt_payload"
     if action == "drop":
         stats["fault_dropped"] = stats.get("fault_dropped", 0) + 1
         METRICS.inc("fabric_dropped_total", fabric=fabric,
@@ -192,7 +198,7 @@ def _apply_fault(fault_fn, env: Envelope, payload, fabric: str,
         from .fabric import flip_payload_bit
         METRICS.inc("fabric_corrupted_total", fabric=fabric,
                     comm_id=env.comm_id, src=env.src, dst=env.dst)
-        payload = flip_payload_bit(payload)
+        payload = flip_payload_bit(payload, flip_at)
     elif action == "corrupt_seq":
         import dataclasses as _dc
         METRICS.inc("fabric_corrupted_total", fabric=fabric,
@@ -1443,6 +1449,16 @@ class RankDaemon:
                 scenario = CCLOp.allreduce
             cfg = ArithConfig(P.code_dtype(c["udtype"]),
                               P.code_dtype(c["cdtype"]))
+            if c["compression"] & int(Compression.BLOCK_SCALED):
+                # block-scaled wire: rebuild the quantized config from
+                # the descriptor's qblock byte (0 = default), the same
+                # derivation the driver ran — segmentation and the
+                # executor's quantize/dequant lanes key off quant_block
+                import dataclasses as _dc
+
+                from ..quant import DEFAULT_BLOCK
+                cfg = _dc.replace(cfg, quant_block=(c.get("qblock")
+                                                    or DEFAULT_BLOCK))
             if c["count"] * cfg.uncompressed_elem_bytes > P.MAX_CALL_BYTES:
                 # sanity bound BEFORE expansion: a hostile count would
                 # otherwise materialize count/segment move objects

@@ -720,7 +720,11 @@ def _skeleton_fuse(moves: list[Move], steps: list[_PlanStep], i: int):
     its payload copy, and one full task's scheduling are gone.
     Compressed-res lanes are skipped — re-reading the slot round-trips
     through the compressed dtype there, and cut-through must be
-    bit-identical to the serial oracle."""
+    bit-identical to the serial oracle. Block-scaled lanes are skipped
+    for the same contract from the other direction: the serial oracle's
+    relay REQUANTIZES the dequantized slot with fresh per-block scales,
+    so forwarding the in-hand packed payload (bit-preserving as it
+    sounds) would diverge from what the serial engine actually sends."""
     e = steps[i]
     mv = moves[i]
     if e.dep < 0 or e.dep >= i:
@@ -733,6 +737,7 @@ def _skeleton_fuse(moves: list[Move], steps: list[_PlanStep], i: int):
             and rmv.res_local and not rmv.res_remote
             and rmv.res.mode is MoveMode.IMMEDIATE
             and not rmv.res.compressed
+            and not rmv.block_scaled and not mv.block_scaled
             and mv.func is None and mv.res_remote and not mv.res_local
             and not mv.remote_stream
             and mv.op0.mode is MoveMode.IMMEDIATE
@@ -1043,15 +1048,45 @@ class MoveExecutor:
         return data
 
     # -- operand fetch/sink ------------------------------------------------
+    def _fetch_raw(self, op: Operand, comm: Communicator, deadline: float,
+                   rx_seqn: int | None):
+        """ON_RECV fetch WITHOUT dtype wrapping: ((env, payload) | None,
+        error_word). The one copy of the receive plumbing — pool seek,
+        timeout + latched-ingress error composition, pre-assigned-vs-
+        live seqn accounting — shared by :meth:`_fetch` (which wraps
+        the payload by dtype) and the fused block-scaled combine path
+        (which hands the raw payload to the compiled kernel).
+
+        A latched ingress error (oversize drop, pool overflow, tenant-
+        quota rejection) is usually WHY the matching message never
+        arrived — surfaced alongside the timeout, scoped to THIS call's
+        communicator so another tenant's latched failure never rides
+        into this error word (multi-tenant fault isolation)."""
+        rank = comm.ranks[op.src_rank]
+        seqn = rank.inbound_seq if rx_seqn is None else rx_seqn
+        got = self.pool.seek(rank.global_rank, op.tag, seqn,
+                             max(0.0, deadline - time.monotonic()),
+                             comm_id=comm.comm_id)
+        if got is None:
+            return None, (int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
+                          | self.pool.consume_error(comm.comm_id))
+        if rx_seqn is None:
+            rank.inbound_seq += 1  # exchange-mem seq update parity
+        return got, 0
+
     def _fetch(self, op: Operand, count: int, cfg: ArithConfig,
                comm: Communicator, deadline: float, *, copy: bool = True,
-               rx_seqn: int | None = None) -> tuple[np.ndarray | None, int]:
+               rx_seqn: int | None = None,
+               block_scaled: bool = False
+               ) -> tuple[np.ndarray | None, int]:
         """Returns (array in uncompressed dtype, error_word). With
         ``copy=False`` IMMEDIATE operands come back as zero-copy views of
         device memory (safe for read-only consumption within the move).
         ``rx_seqn`` overrides the live inbound counter with a seqn the
         streamed planner pre-assigned (the counter was already advanced at
-        plan time, so the live counter is NOT touched here)."""
+        plan time, so the live counter is NOT touched here).
+        ``block_scaled`` marks ON_RECV payloads as scale-block quantized
+        (accl_tpu/quant.py): the dequantized f32 array comes back."""
         u, c = cfg.uncompressed_dtype, cfg.compressed_dtype
         if op.mode == MoveMode.NONE:
             return None, 0
@@ -1074,23 +1109,20 @@ class MoveExecutor:
                               | self.pool.consume_error(comm.comm_id))
             return data, 0
         if op.mode == MoveMode.ON_RECV:
-            rank = comm.ranks[op.src_rank]
-            seqn = rank.inbound_seq if rx_seqn is None else rx_seqn
-            got = self.pool.seek(rank.global_rank, op.tag, seqn,
-                                 max(0.0, deadline - time.monotonic()),
-                                 comm_id=comm.comm_id)
+            got, err = self._fetch_raw(op, comm, deadline, rx_seqn)
             if got is None:
-                # a latched ingress error (oversize drop, pool overflow,
-                # tenant-quota rejection) is usually WHY the matching
-                # message never arrived — surface it alongside the
-                # timeout. Scoped to THIS call's communicator so another
-                # tenant's latched failure never rides into this error
-                # word (multi-tenant fault isolation).
-                return None, (int(ErrorCode.RECEIVE_TIMEOUT_ERROR)
-                              | self.pool.consume_error(comm.comm_id))
+                return None, err
             env, payload = got
-            if rx_seqn is None:
-                rank.inbound_seq += 1  # exchange-mem seq update parity
+            if block_scaled:
+                # scale-block payload: self-describing layout, validated
+                # against the move's count. A malformed payload here got
+                # past the frame checksum (or runs in a csum-off world):
+                # typed COMPRESSION_ERROR, the arith-config failure class
+                from ..quant import QuantFormatError, dequantize_packed
+                try:
+                    return dequantize_packed(payload, count), 0
+                except QuantFormatError:
+                    return None, int(ErrorCode.COMPRESSION_ERROR)
             wire = np.dtype(env.wire_dtype)
             data = _wrap_payload(payload, wire)
             if data.size != count:
@@ -1113,23 +1145,39 @@ class MoveExecutor:
         ``call_seq`` tags the frame's flight-recorder events."""
         wire = (cfg.compressed_dtype if move.eth_compressed
                 else cfg.uncompressed_dtype)
-        arr = np.ascontiguousarray(data.astype(wire, copy=False))
-        owns = arr.base is None and arr.flags.owndata
-        if zero_copy and (owns or self.tx_serializes or immutable_src):
-            # frame the array itself (as a flat byte view): a fresh combine
-            # result owns its memory and is never touched again, and a
-            # serializing fabric copies views out before send returns —
-            # either way the tobytes() copy is pure overhead
-            payload = arr.reshape(-1).view(np.uint8)
-            nbytes = arr.nbytes
-            # the frame still references the scratch slot only when no
-            # dtype conversion copied the data out of it
-            holds_scratch = release is not None and (arr is data
-                                                     or arr.base is data)
-        else:
-            payload = arr.tobytes()
-            nbytes = len(payload)
+        if move.block_scaled:
+            # block-scaled wire: requantize the (f32) result into one
+            # self-describing [header | scales | payload] segment — the
+            # fused step's requant half. The packed array owns fresh
+            # memory, so the scratch slot (if any) releases immediately
+            # and every fabric may keep the payload zero-copy.
+            from ..quant import quantize_packed
+            src = np.ascontiguousarray(
+                data.astype(cfg.uncompressed_dtype, copy=False)
+            ).reshape(-1)
+            payload = quantize_packed(src, cfg.compressed_dtype,
+                                      cfg.quant_block)
+            nbytes = payload.nbytes
             holds_scratch = False
+        else:
+            arr = np.ascontiguousarray(data.astype(wire, copy=False))
+            owns = arr.base is None and arr.flags.owndata
+            if zero_copy and (owns or self.tx_serializes or immutable_src):
+                # frame the array itself (as a flat byte view): a fresh
+                # combine result owns its memory and is never touched
+                # again, and a serializing fabric copies views out before
+                # send returns — either way the tobytes() copy is pure
+                # overhead
+                payload = arr.reshape(-1).view(np.uint8)
+                nbytes = arr.nbytes
+                # the frame still references the scratch slot only when
+                # no dtype conversion copied the data out of it
+                holds_scratch = release is not None and (arr is data
+                                                         or arr.base is data)
+            else:
+                payload = arr.tobytes()
+                nbytes = len(payload)
+                holds_scratch = False
         if release is not None and not holds_scratch:
             release()
             release = None
@@ -1202,12 +1250,34 @@ class MoveExecutor:
             _rank = comm.my_global_rank
             _nb = mv.count * cfg.uncompressed_dtype.itemsize
             t_f0 = time.monotonic_ns()
+        bs = mv.block_scaled
+        # fused dequant->accumulate (the block-scaled combine contract):
+        # the canonical fused_recv_reduce_send shape hands the RAW
+        # scale-block payload plus the local f32 operand to one compiled
+        # pass (quant.dequant_combine_packed -> native bs_combine,
+        # GIL-released at segment sizes) instead of materializing a
+        # dequantized temporary per segment. Arithmetic is identical to
+        # the unfused fetch-then-combine path (one f32 rounding per
+        # step, held bit-identical by the native/numpy contract).
+        bs_fuse = (bs and mv.func is not None
+                   and mv.op1.mode is MoveMode.ON_RECV
+                   and mv.op0.mode is MoveMode.IMMEDIATE)
+        bs_pay = None
         op0, e0 = self._fetch(mv.op0, mv.count, cfg, comm, deadline,
                               copy=copy,
-                              rx_seqn=plan.rx0 if plan is not None else None)
-        op1, e1 = self._fetch(mv.op1, mv.count, cfg, comm, deadline,
-                              copy=copy,
-                              rx_seqn=plan.rx1 if plan is not None else None)
+                              rx_seqn=plan.rx0 if plan is not None else None,
+                              block_scaled=bs)
+        if bs_fuse:
+            op1 = None
+            got1, e1 = self._fetch_raw(
+                mv.op1, comm, deadline,
+                plan.rx1 if plan is not None else None)
+            bs_pay = got1[1] if got1 is not None else None
+        else:
+            op1, e1 = self._fetch(
+                mv.op1, mv.count, cfg, comm, deadline, copy=copy,
+                rx_seqn=plan.rx1 if plan is not None else None,
+                block_scaled=bs)
         if tr:
             for op, rx in ((mv.op0, plan.rx0 if plan else None),
                            (mv.op1, plan.rx1 if plan else None)):
@@ -1222,7 +1292,7 @@ class MoveExecutor:
             return e0 | e1
         release = None
         try:
-            if op0 is not None and op1 is not None:
+            if op0 is not None and (op1 is not None or bs_pay is not None):
                 if mv.func is None:
                     return int(ErrorCode.INVALID_CALL)
                 out = None
@@ -1251,11 +1321,22 @@ class MoveExecutor:
                         prog.max_combining = prog.combining
                 try:
                     t_c0 = time.monotonic_ns() if tr else 0
-                    # compiled combine lane: one memo-dict hit, then a
-                    # single compiled-loop call per segment instead of
-                    # a ufunc dispatch
-                    result = _combine_fn(
-                        mv.func, cfg.uncompressed_dtype)(op0, op1, out)
+                    if bs_pay is not None:
+                        # fused dequant+combine in one compiled pass
+                        from ..quant import (QuantFormatError,
+                                             dequant_combine_packed)
+                        try:
+                            result = dequant_combine_packed(
+                                bs_pay, op0, mv.func, out=out,
+                                expect_count=mv.count)
+                        except QuantFormatError:
+                            return int(ErrorCode.COMPRESSION_ERROR)
+                    else:
+                        # compiled combine lane: one memo-dict hit, then
+                        # a single compiled-loop call per segment instead
+                        # of a ufunc dispatch
+                        result = _combine_fn(
+                            mv.func, cfg.uncompressed_dtype)(op0, op1, out)
                     if tr:
                         _TRACE.emit("combine", rank=_rank, call_seq=_cs,
                                     lane=_lane, step=_step, nbytes=_nb,

@@ -31,17 +31,21 @@ from .protocol import csum_enabled_from_env, csum_of
 from .reliability import RTO_MIN_S, RetxEndpoint, retx_window_from_env
 
 
-def flip_payload_bit(payload) -> bytes:
+def flip_payload_bit(payload, at: int | None = None) -> bytes:
     """A seeded-chaos payload corruption: copy the payload and flip one
     bit in the middle byte — header (and any precomputed envelope csum)
     intact, which is exactly the failure the checksum tier exists to
-    catch. Never mutates the original (the retransmission ring may hold
-    a zero-copy reference to it)."""
+    catch. ``at`` targets a specific byte offset instead (clamped): the
+    block-scaled chaos cells aim it at the scale-header region of a
+    quantized segment. Never mutates the original (the retransmission
+    ring may hold a zero-copy reference to it)."""
     buf = bytearray(memoryview(payload).cast("B")) \
         if not isinstance(payload, (bytes, bytearray)) \
         else bytearray(payload)
     if buf:
-        buf[len(buf) // 2] ^= 0x10
+        i = len(buf) // 2 if at is None else min(max(0, int(at)),
+                                                 len(buf) - 1)
+        buf[i] ^= 0x10
     return bytes(buf)
 
 # fabric-instance tags for registry rows (see LocalFabric.__init__)
@@ -126,9 +130,13 @@ class LocalFabric:
                             else max(0, int(retx_window)))
         self._retx: list[RetxEndpoint | None] = [None] * world_size
         self._latch_fns: list = [None] * world_size
-        self.stats = {"sent": 0, "dropped": 0, "duplicated": 0,
-                      "corrupted": 0, "throttled": 0, "delayed": 0,
-                      "integrity_failed": 0}
+        # tx_bytes counts payload bytes handed to the wire (data +
+        # control frames alike): the bytes-on-wire surface the quantized
+        # bench ladder (benchmarks/quantize.py) measures its >=3x wire
+        # reduction against
+        self.stats = {"sent": 0, "tx_bytes": 0, "dropped": 0,
+                      "duplicated": 0, "corrupted": 0, "throttled": 0,
+                      "delayed": 0, "integrity_failed": 0}
         # per-communicator attribution of the same counters (QoS
         # accounting foundation, ROADMAP item 3): comm_id -> counter dict
         self.stats_by_comm: dict[int, dict[str, int]] = {}
@@ -273,7 +281,7 @@ class LocalFabric:
         st = self.stats_by_comm.get(comm_id)
         if st is None:
             st = self.stats_by_comm[comm_id] = {
-                "sent": 0, "dropped": 0, "duplicated": 0,
+                "sent": 0, "tx_bytes": 0, "dropped": 0, "duplicated": 0,
                 "corrupted": 0, "throttled": 0, "delayed": 0,
                 "integrity_failed": 0}
         return st
@@ -296,6 +304,8 @@ class LocalFabric:
             cst = self._comm_stats(env.comm_id)
         cst["sent"] += 1
         self.stats["sent"] += 1
+        cst["tx_bytes"] += env.nbytes
+        self.stats["tx_bytes"] += env.nbytes
         if self._csum_armed and env.nbytes and env.csum is None:
             # integrity word travels in the envelope (the in-process
             # "wire" never serializes a frame): computed ONCE here, so a
@@ -372,14 +382,21 @@ class LocalFabric:
             return
         cst = self._comm_stats(env.comm_id)
         action = self._fault(env, payload)
-        if isinstance(action, tuple) and action and action[0] == "delay":
-            # chaos delay: the sender's thread pays it, like a link
-            # profile — backpressure-shaped latency, not reordering
-            import time as _t
-            self.stats["delayed"] += 1
-            cst["delayed"] += 1
-            _t.sleep(float(action[1]))
-            action = "deliver"
+        flip_at = None
+        if isinstance(action, tuple) and action:
+            if action[0] == "delay":
+                # chaos delay: the sender's thread pays it, like a link
+                # profile — backpressure-shaped latency, not reordering
+                import time as _t
+                self.stats["delayed"] += 1
+                cst["delayed"] += 1
+                _t.sleep(float(action[1]))
+                action = "deliver"
+            elif action[0] == "corrupt_payload":
+                # targeted bit-flip (FaultRule.flip_at — e.g. a scale
+                # header byte of a block-scaled segment)
+                flip_at = int(action[1])
+                action = "corrupt_payload"
         if action == "drop":
             # fault events are rare by construction (injection/test-only
             # on this fabric): count them straight into the process-wide
@@ -412,7 +429,7 @@ class LocalFabric:
                         ctx=self.ctx_seq, comm_id=env.comm_id,
                         src=env.src, dst=env.dst)
             self._track_lost(env, payload, retx)
-            payload = flip_payload_bit(payload)
+            payload = flip_payload_bit(payload, flip_at)
         self._hand(env, payload, retx)
         if action == "duplicate":
             self.stats["duplicated"] += 1

@@ -411,8 +411,16 @@ def recv_frame_file(f) -> bytes:
 
 # -- call descriptor --------------------------------------------------------
 # scenario u8, func u8, compression u8, stream u8, udtype u8, cdtype u8,
-# algorithm u8, pad u8, count u64, comm_id u32, root u32, tag u32,
+# algorithm u8, qblock u8, count u64, comm_id u32, root u32, tag u32,
 # addr0 u64, addr1 u64, addr2 u64, n_waitfor u16 + waitfor ids (u32 each)
+#
+# qblock (formerly the pad byte — zero from every older client, so the
+# extension is wire-compatible in both directions): log2 of the
+# block-scaled quantization block size, meaningful only when the
+# compression byte carries Compression.BLOCK_SCALED (bit 4). 0 with the
+# flag set means "receiver default" (quant.DEFAULT_BLOCK). Blocks are
+# powers of two by construction (quant.clamp_block), so the log2 nibble
+# reconstructs the exact value on every tier.
 _CALL_FMT = "<8BQ3I3QH"
 
 # Relative waitfor id: "the call enqueued immediately before this one on
@@ -435,23 +443,26 @@ WAIT_LAST = 0xFFFFFFFF
 def pack_call(scenario: int, func: int, compression: int, stream: int,
               udtype: int, cdtype: int, count: int, comm_id: int, root: int,
               tag: int, addr0: int, addr1: int, addr2: int,
-              waitfor: list[int], algorithm: int = 0) -> bytes:
+              waitfor: list[int], algorithm: int = 0,
+              qblock: int = 0) -> bytes:
+    qlog = qblock.bit_length() - 1 if qblock > 0 else 0
     body = struct.pack(_CALL_FMT, scenario, func, compression, stream,
-                       udtype, cdtype, algorithm, 0, count, comm_id, root,
-                       tag, addr0, addr1, addr2, len(waitfor))
+                       udtype, cdtype, algorithm, qlog, count, comm_id,
+                       root, tag, addr0, addr1, addr2, len(waitfor))
     return bytes([MSG_CALL]) + body + b"".join(
         struct.pack("<I", w) for w in waitfor)
 
 
 def unpack_call(body: bytes) -> dict:
     size = struct.calcsize(_CALL_FMT)
-    (scenario, func, compression, stream, udtype, cdtype, algorithm, _pad,
+    (scenario, func, compression, stream, udtype, cdtype, algorithm, qlog,
      count, comm_id, root, tag, a0, a1, a2, nw) = struct.unpack(
         _CALL_FMT, body[:size])
     waitfor = list(struct.unpack(f"<{nw}I", body[size:size + 4 * nw]))
     return dict(scenario=scenario, func=func, compression=compression,
                 stream=stream, udtype=udtype, cdtype=cdtype,
-                algorithm=algorithm, count=count,
+                algorithm=algorithm, qblock=(1 << qlog) if qlog else 0,
+                count=count,
                 comm_id=comm_id, root=root, tag=tag, addr0=a0, addr1=a1,
                 addr2=a2, waitfor=waitfor)
 

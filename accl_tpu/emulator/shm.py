@@ -733,10 +733,15 @@ class ShmFabric:
         the actions, so the shared socket interpreter does not fit)."""
         if self._fault is not None and env.strm != P.ACK_STRM:
             action = self._fault(env, payload)
-            if isinstance(action, tuple) and action \
-                    and action[0] == "delay":
-                time.sleep(float(action[1]))
-                action = "deliver"
+            flip_at = None
+            if isinstance(action, tuple) and action:
+                if action[0] == "delay":
+                    time.sleep(float(action[1]))
+                    action = "deliver"
+                elif action[0] == "corrupt_payload":
+                    # targeted bit-flip (FaultRule.flip_at)
+                    flip_at = int(action[1])
+                    action = "corrupt_payload"
             if action == "drop":
                 self.stats["fault_dropped"] += 1
                 METRICS.inc("fabric_dropped_total", fabric="shm",
@@ -756,7 +761,7 @@ class ShmFabric:
                 METRICS.inc("fabric_corrupted_total", fabric="shm",
                             comm_id=env.comm_id, src=env.src, dst=env.dst)
                 self._track_lost(env, payload, retx)
-                payload = flip_payload_bit(payload)
+                payload = flip_payload_bit(payload, flip_at)
             elif action == "duplicate":
                 METRICS.inc("fabric_duplicated_total", fabric="shm",
                             comm_id=env.comm_id, src=env.src, dst=env.dst)

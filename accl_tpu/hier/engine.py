@@ -51,6 +51,7 @@ import numpy as np
 
 from ..constants import (CollectiveAlgorithm, HIERARCHICAL_OPS, ReduceFunc,
                          VALID_ALGORITHMS)
+from ..tracing import METRICS
 from ..tuner.cost import rank_algorithms
 from .topology import MeshTopology, groups_from_hosts
 
@@ -369,15 +370,34 @@ class Hierarchy:
             return b[off:off + length] if length else b[off:]
         return b
 
+    def _phase_tier(self, ph: Phase) -> str:
+        """"inter" when the phase's members span hosts (its wire rides
+        the slow tier), else "intra". Pure in the grouping, so every
+        rank of the phase derives the same tier."""
+        host = _hostmap(self.groups)
+        return ("inter" if len({host[r] for r in ph.members}) > 1
+                else "intra")
+
     # -- execution ----------------------------------------------------------
     def run(self, op: str, *, count: int, src=None, dst=None,
             func: ReduceFunc = ReduceFunc.SUM, root: int = 0,
-            compress_dtype=None, run_async: bool = False,
+            compress_dtype=None, block_scale: bool | int = False,
+            compress_phases: str | None = None, run_async: bool = False,
             waitfor: Sequence = ()):
         """Issue one hierarchical collective as a waitfor-chained phase
         program; returns the final phase's handle (async) or a completed
         handle (sync). Falls back to ``None`` only never — a configured
-        hierarchy always has >= 2 hosts (ctor contract)."""
+        hierarchy always has >= 2 hosts (ctor contract).
+
+        Per-phase compression (EQuARX's headline trick, arXiv
+        2506.17615): ``compress_phases="inter"`` applies
+        ``compress_dtype``/``block_scale`` ONLY to phases whose
+        sub-communicator spans hosts — the slow DCN tier rides fp8/int8
+        scale-block wire while intra-host phases run full precision and
+        stay bit-identical to the uncompressed program. ``"all"``/None
+        compresses every phase (the pre-existing uniform behavior).
+        Tier choice is pure in (groups, members), so all ranks agree
+        without a handshake."""
         accl = self.accl
         me = accl.comm.local_rank
         plan = plan_phases(op, self.groups, me, count, root)
@@ -435,6 +455,11 @@ class Hierarchy:
         if run_async:
             private = (self._async_scratch_pool.pop()
                        if self._async_scratch_pool else {})
+        if compress_phases not in (None, "all", "inter"):
+            raise ValueError(
+                f"compress_phases must be None, 'all' or 'inter', got "
+                f"{compress_phases!r}")
+        inter_only = compress_phases == "inter"
         with accl._attributed(tag):
             for ph in plan.phases:
                 comm = self._comm(ph.members, ph.key)
@@ -443,40 +468,44 @@ class Hierarchy:
                 db = self._bind(ph.dst, src, dst, plan.scratch, dtype,
                                 private)
                 alg = self._phase_algorithm(ph, ebytes)
-                kw = dict(run_async=True, waitfor=prev, comm=comm)
+                tier = self._phase_tier(ph)
+                # phase-selective wire: the slow tier compresses, the
+                # intra tier stays full-precision bit-identical
+                cd = (compress_dtype
+                      if not inter_only or tier == "inter" else None)
+                bsc = block_scale if cd is not None else False
+                if compress_dtype is not None:
+                    METRICS.inc(
+                        "hier_phase_wire_total", tier=tier,
+                        wire=("quantized" if bsc
+                              else "narrowed" if cd is not None
+                              else "full"))
+                kw = dict(run_async=True, waitfor=prev, comm=comm,
+                          compress_dtype=cd, block_scale=bsc)
                 if ph.scenario == "reduce_scatter":
                     h = accl.reduce_scatter(sb, db, ph.count, func,
-                                            algorithm=alg,
-                                            compress_dtype=compress_dtype,
-                                            **kw)
+                                            algorithm=alg, **kw)
                 elif ph.scenario == "allreduce":
                     h = accl.allreduce(sb, db, ph.count, func,
-                                       algorithm=alg,
-                                       compress_dtype=compress_dtype, **kw)
+                                       algorithm=alg, **kw)
                 elif ph.scenario == "allgather":
                     h = accl.allgather(sb, db, ph.count, algorithm=alg,
-                                       compress_dtype=compress_dtype, **kw)
+                                       **kw)
                 elif ph.scenario == "gather":
                     h = accl.gather(sb, db, ph.count, root=ph.root,
-                                    algorithm=alg,
-                                    compress_dtype=compress_dtype, **kw)
+                                    algorithm=alg, **kw)
                 elif ph.scenario == "reduce":
                     h = accl.reduce(sb, db, ph.count, root=ph.root,
-                                    func=func, algorithm=alg,
-                                    compress_dtype=compress_dtype, **kw)
+                                    func=func, algorithm=alg, **kw)
                 elif ph.scenario == "scatter":
-                    h = accl.scatter(sb, db, ph.count, root=ph.root,
-                                     compress_dtype=compress_dtype, **kw)
+                    h = accl.scatter(sb, db, ph.count, root=ph.root, **kw)
                 elif ph.scenario == "bcast":
                     h = accl.bcast(sb, ph.count, root=ph.root,
-                                   algorithm=alg,
-                                   compress_dtype=compress_dtype, **kw)
+                                   algorithm=alg, **kw)
                 elif ph.scenario == "send":
-                    h = accl.send(sb, ph.count, dst=ph.root,
-                                  compress_dtype=compress_dtype, **kw)
+                    h = accl.send(sb, ph.count, dst=ph.root, **kw)
                 elif ph.scenario == "recv":
-                    h = accl.recv(db, ph.count, src=ph.root,
-                                  compress_dtype=compress_dtype, **kw)
+                    h = accl.recv(db, ph.count, src=ph.root, **kw)
                 else:
                     raise AssertionError(ph.scenario)
                 prev = [h]

@@ -136,6 +136,14 @@ class Move:
     dst_rank: int | None = None      # remote destination rank
     tag: int = 0                     # tag for the outgoing message
     eth_compressed: bool = False     # compress on the wire
+    # block-scaled quantized wire (accl_tpu/quant.py): this move's wire
+    # traffic carries scale-block payloads — emission quantizes, ON_RECV
+    # operands dequantize, and cut-through fusion must NOT forward the
+    # in-hand payload (a re-read requantizes with fresh scales, so the
+    # serial oracle's relay bytes differ from the forwarded original).
+    # Set by expand_call's post-pass from Compression.BLOCK_SCALED, so
+    # per-site expansion code cannot drift.
+    block_scaled: bool = False
     remote_stream: bool = False      # deliver to peer's stream, not rx pool
     blocking: bool = True
     lane: int | None = None          # segment lane (see class docstring)
@@ -149,7 +157,15 @@ def _seg_elems(arithcfg: ArithConfig, max_segment_size: int,
     Parity: the firmware computes segment element count from
     max_segment_size / elem bytes, using the *wire* element size when the
     message is compressed (broadcast, ccl_offload_control.c:530-535).
+    Block-scaled wire (arithcfg.quant_block > 0) additionally reserves
+    the scale-header overhead so the PACKED payload still fits the
+    segment (and thus the rx buffer) — via quant.seg_elems, whose
+    reservation is block-size-independent so compiled plans never key on
+    the runtime block choice.
     """
+    if eth_compressed and arithcfg.quant_block > 0:
+        from .quant import seg_elems
+        return seg_elems(max_segment_size, arithcfg.compressed_elem_bytes)
     elem = (arithcfg.compressed_elem_bytes if eth_compressed
             else arithcfg.uncompressed_elem_bytes)
     return max(1, max_segment_size // max(1, elem))
@@ -1504,6 +1520,74 @@ def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
                 stream: StreamFlags = StreamFlags.NO_STREAM,
                 algorithm: CollectiveAlgorithm = CollectiveAlgorithm.AUTO
                 ) -> list[Move]:
+    """Dispatch a call descriptor to its expansion (see
+    :func:`_expand_call_moves`), then apply the block-scaled wire
+    post-pass: with ``Compression.BLOCK_SCALED`` every eth-compressed
+    move is tagged ``Move.block_scaled`` in ONE place — per-site tagging
+    across ~20 expansion functions would be one audit away from a relay
+    that silently forwards unquantized bytes. Validation lives here too,
+    so every tier (driver, python daemon, plan cache) rejects malformed
+    block-scaled descriptors identically."""
+    if compression & Compression.BLOCK_SCALED:
+        from .quant import is_quantizable
+        if not compression & Compression.ETH_COMPRESSED:
+            raise ValueError(
+                "BLOCK_SCALED is a wire-compression refinement: it "
+                "requires ETH_COMPRESSED (the flag quantizes frames, "
+                "not operand storage)")
+        if compression & (Compression.OP0_COMPRESSED
+                          | Compression.OP1_COMPRESSED
+                          | Compression.RES_COMPRESSED):
+            raise ValueError(
+                "BLOCK_SCALED requires uncompressed operand storage: "
+                "the combine lane dequantizes into (and requantizes "
+                "from) the f32 accumulator, so compressed-stored "
+                "operands cannot ride the block-scaled wire")
+        if stream != StreamFlags.NO_STREAM:
+            raise ValueError(
+                "BLOCK_SCALED cannot combine with stream-port operands "
+                "(stream lanes carry raw elements, not scale-block "
+                "payloads)")
+        if ctx.arithcfg.uncompressed_dtype.name != "float32" \
+                or not is_quantizable(ctx.arithcfg.compressed_dtype):
+            raise ValueError(
+                f"BLOCK_SCALED supports float32 operands over an "
+                f"int8/fp8 wire dtype; got "
+                f"{ctx.arithcfg.uncompressed_dtype.name} over "
+                f"{ctx.arithcfg.compressed_dtype.name}")
+        if ctx.arithcfg.quant_block <= 0:
+            raise ValueError(
+                "BLOCK_SCALED descriptor reached expansion with an "
+                "arith config carrying no quant_block — the driver/"
+                "daemon must derive a block-scaled ArithConfig "
+                "(segmentation depends on the scale-header reservation)")
+    # NOTE deliberately NO engine-level rejection of plain float->int
+    # narrowing: the move engine's astype semantics for hand-built
+    # (f32, int8) configs long predate the quantized lane (the
+    # property corpora pin them as the 1-byte compressed-dtype case).
+    # The DRIVER rejects the user-facing path instead (_prepare): its
+    # registry's (float32, int8) pair exists only for block_scale=.
+    moves = _expand_call_moves(
+        ctx, scenario, count=count, root_src_dst=root_src_dst, func=func,
+        tag=tag, addr_0=addr_0, addr_1=addr_1, addr_2=addr_2,
+        compression=compression, stream=stream, algorithm=algorithm)
+    if compression & Compression.BLOCK_SCALED:
+        for mv in moves:
+            if mv.eth_compressed:
+                mv.block_scaled = True
+    return moves
+
+
+def _expand_call_moves(ctx: MoveContext, scenario: CCLOp, *, count: int,
+                       root_src_dst: int = 0,
+                       func: ReduceFunc = ReduceFunc.SUM,
+                       tag: int = TAG_ANY, addr_0: int = 0, addr_1: int = 0,
+                       addr_2: int = 0,
+                       compression: Compression = Compression.NONE,
+                       stream: StreamFlags = StreamFlags.NO_STREAM,
+                       algorithm: CollectiveAlgorithm = (
+                           CollectiveAlgorithm.AUTO)
+                       ) -> list[Move]:
     """Dispatch a call descriptor to its expansion.
 
     Parity: the firmware's run_accl() switch (ccl_offload_control.c:1155-1296)

@@ -56,9 +56,13 @@ _SRC_PATH = os.path.join(_NATIVE_DIR, "combine_kernels.c")
 # kernel speaks these codes — listed here literally so importing this
 # module never touches the emulator package, which imports back into
 # arith). test_combine_native pins this table against protocol's.
+# fp8 entries (codes 8/9) widen-accumulate in f32 inside the kernel and
+# round back with the ml_dtypes cast semantics, so same-dtype fp8 calls
+# (the plain-narrowing compression path) ride the compiled lane too.
 _DTYPE_CODES = {
     "float32": 0, "float64": 1, "int32": 2, "int64": 3,
     "float16": 4, "bfloat16": 5, "int8": 6, "uint8": 7,
+    "float8_e4m3fn": 8, "float8_e5m2": 9,
 }
 
 _lock = threading.Lock()
@@ -159,6 +163,13 @@ def _load():
 def available() -> bool:
     """True when the compiled kernels back :func:`reducer`."""
     return _load() is not None
+
+
+def module():
+    """The loaded extension module itself (or None): the block-scaled
+    quantization codec (accl_tpu/quant.py) dispatches its bs_* entries
+    through the same .so, loader, and $ACCL_TPU_NATIVE_COMBINE knob."""
+    return _load()
 
 
 def call_counts() -> tuple[int, int]:

@@ -39,7 +39,8 @@ import math
 from ..constants import CollectiveAlgorithm, VALID_ALGORITHMS
 
 __all__ = ["Topology", "predict_us", "rank_algorithms",
-           "recommend_segment_size", "LEGACY_ALGORITHM_PAIRS"]
+           "recommend_segment_size", "LEGACY_ALGORITHM_PAIRS",
+           "predict_quantized_us", "rank_wire", "wire_byte_ratio"]
 
 
 # (op, algorithm) pairs every execution tier has always implemented —
@@ -91,6 +92,15 @@ class Topology:
     # socket tier advertises LEGACY_ALGORITHM_PAIRS because its peer may
     # be the native daemon, which lacks the log-depth family.
     supported: frozenset | None = None
+    # Quantized-wire pricing (accl_tpu/quant.py; ACCL+ arXiv 2312.11742
+    # frames compression plugins exactly this way — a beta multiplier
+    # bought with compute): throughput of the tier's quantize/dequantize
+    # passes in GB/s of UNCOMPRESSED payload (the gamma term's
+    # denominator) and the fixed per-call cost of arming the quantized
+    # lane (scale/header bookkeeping — what keeps small latency-bound
+    # calls on the full-precision wire).
+    quant_gbps: float = 6.0
+    quant_alpha_us: float = 15.0
 
     def wire_us(self, nbytes: float) -> float:
         """Microseconds to move ``nbytes`` over one link."""
@@ -406,6 +416,82 @@ def predict_us(op: str, algorithm: CollectiveAlgorithm, topo: Topology,
                 if sum(len(g) for g in groups) == w
                 else topo.intra_topology(w))
     return model(topo, w, float(nbytes))
+
+
+# -- quantized-wire variants (accl_tpu/quant.py, EQuARX arXiv 2506.17615) --
+#
+# A quantized variant of any algorithm moves ``1/wire_ratio`` of the
+# bytes (beta scales UP by the ratio — the ACCL+ framing of compression
+# as bandwidth) and pays a gamma term: the quantize/dequantize passes
+# over the uncompressed payload at ``quant_gbps`` plus a fixed
+# ``quant_alpha_us``. On a two-tier mesh only the INTER tier's beta
+# scales — the per-phase "inter" mode is the only quantized hierarchical
+# variant (intra phases stay full precision by contract), so its model
+# prices exactly what the engine runs. The resulting crossover is the
+# point: quantized wire wins exactly where wire bytes dominate, never
+# in the alpha-dominated small-call band (pinned by tests/test_quantize).
+
+def wire_byte_ratio(u_bytes: int = 4, q_bytes: int = 1,
+                    block: int = 128) -> float:
+    """Uncompressed-to-quantized wire byte ratio including the per-block
+    f32 scale overhead (~3.87x for f32 -> fp8 at block 128)."""
+    return float(u_bytes) / (float(q_bytes) + 4.0 / float(block))
+
+
+def predict_quantized_us(op: str, algorithm: CollectiveAlgorithm,
+                         topo: Topology, nbytes: int,
+                         world_size: int | None = None,
+                         ratio: float | None = None) -> float:
+    """Predicted microseconds for the BLOCK_SCALED variant of one
+    (op, algorithm) pair."""
+    r = wire_byte_ratio() if ratio is None else float(ratio)
+    w = world_size if world_size is not None else topo.world_size
+    if w <= 1:
+        return 0.0
+    groups = getattr(topo, "groups", None)
+    if _A(algorithm) == _A.HIERARCHICAL and groups and len(groups) > 1:
+        # per-phase "inter" mode: only the slow tier's wire quantizes,
+        # and only the outer phase's payload pays the codec
+        topo_q = dataclasses.replace(
+            topo, inter_beta_gbps=getattr(topo, "inter_beta_gbps", 0.1) * r)
+        L = max(len(g) for g in groups)
+        outer_bytes = (float(nbytes) / L
+                       if getattr(topo, "aligned", False) and L > 1
+                       else float(nbytes))
+        gamma = 2.0 * outer_bytes / (topo.quant_gbps * 1e3)
+    else:
+        topo_q = dataclasses.replace(topo, beta_gbps=topo.beta_gbps * r)
+        if groups:
+            topo_q = dataclasses.replace(
+                topo_q,
+                inter_beta_gbps=getattr(topo, "inter_beta_gbps", 0.1) * r)
+        gamma = 2.0 * float(nbytes) / (topo.quant_gbps * 1e3)
+    return (predict_us(op, algorithm, topo_q, nbytes, world_size)
+            + topo.quant_alpha_us + gamma)
+
+
+def rank_wire(op: str, topo: Topology, nbytes: int,
+              world_size: int | None = None, ratio: float | None = None
+              ) -> tuple[bool, CollectiveAlgorithm | None]:
+    """(quantize?, best algorithm under that wire): True exactly when
+    the cheapest quantized variant beats the cheapest full-precision
+    one. Deterministic in its inputs — every rank of a collective must
+    agree."""
+    plain = rank_algorithms(op, topo, nbytes, world_size)
+    if not plain:
+        return False, None
+    scored = []
+    for a, _c in plain:
+        q = predict_quantized_us(op, a, topo, nbytes, world_size, ratio)
+        if math.isfinite(q):
+            scored.append((q, int(a), a))
+    if not scored:
+        return False, plain[0][0]
+    scored.sort()
+    best_q = scored[0]
+    if best_q[0] < plain[0][1]:
+        return True, best_q[2]
+    return False, plain[0][0]
 
 
 def rank_algorithms(op: str, topo: Topology, nbytes: int,

@@ -204,6 +204,64 @@ class Tuner:
             best = DEFAULT_ALGORITHMS.get(op, CollectiveAlgorithm.AUTO)
         return best
 
+    # -- quantized-wire selection (accl_tpu/quant.py) ----------------------
+    def select_wire(self, op: str, world_size: int, nbytes: int,
+                    ratio: float | None = None) -> bool:
+        """True when the block-scaled quantized wire variant wins for
+        this (op, world, size): measured wire EWMAs (both variants
+        sampled >= min_samples, fed by :meth:`observe_wire` /
+        benchmarks/tune.py's wire sweep) beat the cost model, which
+        otherwise prices the variants analytically (rank_wire, cost.py)
+        — bandwidth-bound calls quantize, latency-bound calls never do.
+        Sticky per bucket like algorithm decisions (every rank of a
+        collective must agree), dropped by :meth:`refresh`."""
+        from .cost import rank_wire
+        if op not in VALID_ALGORITHMS or world_size <= 1:
+            return False
+        key = ("wire", op, int(world_size), nbytes_bucket(nbytes))
+        with self._lock:
+            decided = self._decisions.get(key)
+            if decided is None:
+                stats = self._measured.get(key, {})
+                qs, ps = stats.get(True), stats.get(False)
+                if (qs is not None and ps is not None
+                        and qs.n >= self.min_samples
+                        and ps.n >= self.min_samples):
+                    decided = qs.ewma_us < ps.ewma_us
+                else:
+                    decided = rank_wire(op, self._topo(world_size),
+                                        nbytes, world_size, ratio)[0]
+                self._decisions[key] = decided
+            return bool(decided)
+
+    def observe_wire(self, op: str, world_size: int, nbytes: int,
+                     quantized: bool, duration_s: float,
+                     error_word: int = 0) -> bool:
+        """Feed one retired call's duration under its wire variant
+        (quantized = BLOCK_SCALED ran). The per-bucket EWMA pair
+        replaces the analytic crossover once both variants have
+        evidence. Failed calls are ignored, like :meth:`observe`."""
+        if (error_word or op not in VALID_ALGORITHMS or world_size <= 1):
+            return False
+        key = ("wire", op, int(world_size), nbytes_bucket(nbytes))
+        with self._lock:
+            stats = self._measured.setdefault(key, {})
+            stats.setdefault(bool(quantized), _Stat()).update(
+                duration_s * 1e6, self.ewma_weight)
+        return True
+
+    def recommend_quant_block(self, nbytes: int) -> int:
+        """Scale-block size for a block-scaled call of ``nbytes``
+        (uncompressed payload): larger payloads amortize toward larger
+        blocks (the 4-byte scale per block is pure overhead), small
+        ones keep fine-grained scales for dynamic-range tracking.
+        Deterministic in nbytes, so every rank derives the same block."""
+        if nbytes >= 8 << 20:
+            return 256
+        if nbytes >= 128 << 10:
+            return 128
+        return 64
+
     def refresh(self):
         """Drop cached decisions: the next ``select`` per key re-scores
         with the measurements accumulated so far (and re-rolls
@@ -345,9 +403,15 @@ class Tuner:
         winning score (pinned entries re-export with their measured EWMA
         when one exists, else 0)."""
         with self._lock:
+            # 3-tuple algorithm keys and 4-tuple ("wire", ...) keys sort
+            # together safely: position 0 is a string either way and no
+            # op is named "wire"
             keys = sorted(set(self._pinned) | set(self._measured))
             out = []
             for key in keys:
+                if len(key) != 3:
+                    continue  # ("wire", ...) variant stats: not a table
+                    # row (select_wire reads them directly)
                 op, world, bucket = key
                 stats = self._measured.get(key, {})
                 pinned = self._pinned.get(key)

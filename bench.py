@@ -141,6 +141,17 @@ def bench_emu_fallback(reason: str) -> dict:
         sh = shm_headline()
         for k in _SHM_KEYS:
             result[k] = sh[k]
+    if os.environ.get("ACCL_BENCH_MIN_QUANT_WIRE_RATIO"):
+        # quantized-wire ladder (~8s of emulated wire sleeps): fp8
+        # block-scaled vs f32 16 MiB allreduce on a wire-dominated link
+        # profile — bytes-on-wire ratio AND wall-clock win, with the f32
+        # leg bit-exact and the fp8 leg inside its typed error bound
+        # (the ladder hard-raises otherwise). Only when the gate is
+        # armed (make bench-emu), keep-ungated-runs-fast rule.
+        from benchmarks.quantize import QUANT_KEYS, headline as q_headline
+        qh = q_headline()
+        for k in QUANT_KEYS:
+            result[k] = qh[k]
     return result
 
 
@@ -218,6 +229,35 @@ def check_shm_ratio(result: dict) -> int:
     print(f"FAIL: shm vs TCP allreduce ratio {result['shm_ratio']} < "
           f"required {want}", file=sys.stderr)
     return 1
+
+
+def check_quant_ratios(result: dict) -> int:
+    """Regression gates for the quantized wire (accl_tpu/quant.py):
+    with $ACCL_BENCH_MIN_QUANT_WIRE_RATIO set (make bench-emu sets
+    3.0), the fp8-block-scaled 16 MiB allreduce must move that many
+    times FEWER wire bytes than the f32 leg (measured from the fabric's
+    tx_bytes counter — scale headers, retx/ACK traffic and all); with
+    $ACCL_BENCH_MIN_QUANT_TIME_RATIO set (1.2), the quantized leg must
+    also WIN wall-clock on the wire-dominated link profile (measured
+    ~1.7-2x; the floor is no-collapse headroom for a busy host). The
+    ladder itself hard-raises when either leg's numerics are off, so a
+    passing ratio is also a correctness statement."""
+    wire_want = os.environ.get("ACCL_BENCH_MIN_QUANT_WIRE_RATIO")
+    if not wire_want or "quant_wire_ratio" not in result:
+        return 0
+    rc = 0
+    if result["quant_wire_ratio"] < float(wire_want):
+        print(f"FAIL: quantized wire-byte ratio "
+              f"{result['quant_wire_ratio']} < required {wire_want}",
+              file=sys.stderr)
+        rc = 1
+    t_want = os.environ.get("ACCL_BENCH_MIN_QUANT_TIME_RATIO")
+    if t_want and result.get("quant_time_ratio", 0) < float(t_want):
+        print(f"FAIL: quantized time ratio "
+              f"{result.get('quant_time_ratio')} < required {t_want}",
+              file=sys.stderr)
+        rc = 1
+    return rc
 
 
 def check_combine_ratio(result: dict) -> int:
@@ -863,6 +903,33 @@ def main():
                           "combine_numpy_us", "combine_ratio_by_size"):
                     result[k] = retry_sh[k]
             result["shm_retry"] = result.get("shm_retry", 0) + 1
+        qwire_want = os.environ.get("ACCL_BENCH_MIN_QUANT_WIRE_RATIO")
+        qtime_want = os.environ.get("ACCL_BENCH_MIN_QUANT_TIME_RATIO")
+        for _ in range(_GATE_RETRIES):
+            # best-of-three for the quantized-wire gates too: only its
+            # ladder re-runs, each sub-metric keeping its best
+            # observation (the wire-byte ratio is deterministic; the
+            # time ratio is the one exposed to host noise)
+            low = ((qwire_want and result.get("quant_wire_ratio", 0)
+                    < float(qwire_want))
+                   or (qtime_want and result.get("quant_time_ratio", 0)
+                       < float(qtime_want)))
+            if not (qwire_want and low):
+                break
+            from benchmarks.quantize import headline as q_headline
+            retry_q = q_headline()
+            if retry_q.get("quant_wire_ratio", 0) > \
+                    result.get("quant_wire_ratio", 0):
+                for k in ("quant_wire_ratio", "quant_wire_mib",
+                          "quant_f32_wire_mib", "quant_blocks"):
+                    result[k] = retry_q[k]
+            if retry_q.get("quant_time_ratio", 0) > \
+                    result.get("quant_time_ratio", 0):
+                for k in ("quant_time_ratio", "quant_us",
+                          "quant_f32_us", "quant_err_rel",
+                          "quant_throttled"):
+                    result[k] = retry_q[k]
+            result["quant_retry"] = result.get("quant_retry", 0) + 1
         csum_want = os.environ.get("ACCL_BENCH_MAX_CSUM_OVERHEAD")
         for _ in range(_GATE_RETRIES):
             # best-of-three for the checksum-overhead gate too: only
@@ -892,6 +959,7 @@ def main():
                  or check_csum_overhead(result)
                  or check_shm_ratio(result)
                  or check_combine_ratio(result)
+                 or check_quant_ratios(result)
                  or check_fabric_clean(result))
     if not _probe_backend():
         # the bench contract is ONE valid JSON line with a real metric:

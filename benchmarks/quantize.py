@@ -1,0 +1,168 @@
+"""Quantized-wire ladder: fp8 block-scaled vs f32 16 MiB allreduce.
+
+Two interleaved legs through ONE in-process emulator world (same
+executor, same fabric — only the wire differs), per the interleaved-pair
+convention so host drift hits both legs:
+
+* **f32 leg** — plain full-precision allreduce; integer-valued inputs
+  make the expected sum exact, so the leg asserts bit-exactness.
+* **fp8 leg** — ``compress_dtype=float8_e4m3fn, block_scale=True``: the
+  wire carries scale-block segments (accl_tpu/quant.py) and the combine
+  lane runs the fused dequant->f32-accumulate->requant step. The leg
+  asserts the typed per-hop error bound (2W * eps * partial-magnitude,
+  the test corpus's bound) — a ladder that only measured speed would
+  happily gate a wire that ships garbage.
+
+The world rides an emulated slow wire (LocalFabric link profile at
+0.02 GB/s, the hierarchy ladder's convention): on the raw in-process
+fabric the "wire" is a memcpy and the codec's extra passes dominate
+(measured ~0.1x — quantizing a loopback buys nothing, which is also
+the tuner cost model's answer for the emu tier), while the profiled
+wire makes byte volume the bottleneck — the regime block-scaled
+quantization exists for, and the regime AUTO selects it in.
+
+Gated quantities (make bench-emu):
+
+* ``quant_wire_ratio`` — f32-leg wire bytes / fp8-leg wire bytes from
+  the fabric's ``tx_bytes`` counter (REAL bytes handed to the wire,
+  scale headers and control frames included), gate
+  ``$ACCL_BENCH_MIN_QUANT_WIRE_RATIO`` (default 3.0: a 4x dtype ratio
+  minus scale-header overhead and ACK traffic).
+* ``quant_time_ratio`` — t_f32 / t_fp8 on the wire-dominated profile,
+  gate ``$ACCL_BENCH_MIN_QUANT_TIME_RATIO`` (default 1.2, a
+  no-collapse floor under the ~1.7-2x measured win: wire sleeps shrink
+  by the byte ratio while the codec's CPU cost pushes back — a
+  regression in either direction collapses the ratio).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import ml_dtypes
+import numpy as np
+
+from accl_tpu import quant
+from accl_tpu.testing import emu_world, run_ranks
+
+WORLD = 4
+# emulated wire figures (the hierarchy ladder's convention): slow enough
+# that wire time dominates the 2-core host's codec/memcpy cost, so the
+# time ratio measures bytes-on-wire, not Python (at 0.015 GB/s the f32
+# leg sleeps ~1.6 s/iter vs the codec's ~0.35 s — a busy-host codec
+# blip cannot push the ~2x measured ratio under the 1.2 gate)
+WIRE_ALPHA_US = 50.0
+WIRE_BETA_GBPS = 0.015
+QUANT_KEYS = ("quant_wire_ratio", "quant_time_ratio", "quant_us",
+              "quant_f32_us", "quant_err_rel", "quant_blocks",
+              "quant_wire_mib", "quant_f32_wire_mib", "quant_throttled")
+
+
+def quantize_headline(nbytes: int = 16 << 20, iters: int = 3) -> dict:
+    count = nbytes // 4
+    f8 = np.dtype(ml_dtypes.float8_e4m3fn)
+    eps = 2.0 ** -3
+    rng = np.random.default_rng(5)
+    # integer-valued f32 in [-8, 8]: f32 sums exact at any order, fp8
+    # partials well inside range
+    ins = [rng.integers(-8, 9, count).astype(np.float32)
+           for _ in range(WORLD)]
+    exact = np.sum(ins, axis=0, dtype=np.float64).astype(np.float32)
+    part_max = np.abs(np.stack(ins)).sum(axis=0)
+    bound = 2 * WORLD * eps * np.maximum(part_max, 1e-6)
+
+    accls = emu_world(WORLD, timeout=120.0, nbufs=64, bufsize=1 << 20)
+    fab = accls[0].device.ctx.fabric
+    for s in range(WORLD):
+        for d in range(WORLD):
+            if s != d:
+                fab.set_link_profile(s, d, WIRE_ALPHA_US, WIRE_BETA_GBPS)
+    legs = {"f32": {}, "fp8": dict(compress_dtype=f8, block_scale=True)}
+    bufs = {k: [(a.buffer(data=ins[a.comm.local_rank].copy()),
+                 a.buffer((count,), np.float32)) for a in accls]
+            for k in legs}
+    times = {k: [] for k in legs}
+    wire = {k: 0 for k in legs}
+    blocks0 = quant.counters()["tx_blocks"]
+    try:
+        def leg(k: str, measure: bool):
+            def body(a):
+                src, dst = bufs[k][a.comm.local_rank]
+                a.allreduce(src, dst, count, **legs[k])
+            b0 = fab.stats["tx_bytes"]
+            t0 = time.perf_counter()
+            run_ranks(accls, body, timeout=600.0)
+            if measure:
+                times[k].append(time.perf_counter() - t0)
+                wire[k] += fab.stats["tx_bytes"] - b0
+
+        for k in legs:                  # warm (plan cache, pools)
+            leg(k, measure=False)
+        for i in range(iters):          # interleaved: drift hits both
+            for k in (("f32", "fp8") if i % 2 == 0 else ("fp8", "f32")):
+                leg(k, measure=True)
+        # correctness before any ratio is believed
+        err_rel = 0.0
+        for k, bl in bufs.items():
+            for _, dst in bl:
+                dst.sync_from_device()
+                err = np.abs(dst.data - exact)
+                if k == "f32":
+                    if err.max() != 0.0:
+                        raise AssertionError(
+                            f"f32 leg diverged from the exact sum by "
+                            f"{err.max()}")
+                else:
+                    if not (err <= bound).all():
+                        raise AssertionError(
+                            f"fp8 leg exceeded the typed error bound: "
+                            f"max err {err.max()}")
+                    # normalized against the travelling-partial
+                    # magnitude (the quantity the per-hop bound scales
+                    # with): near-zero SUMS of large operands rightly
+                    # carry absolute error, so |exact| is the wrong
+                    # denominator
+                    err_rel = max(err_rel, float(
+                        (err / np.maximum(part_max, 1.0)).max()))
+    finally:
+        for a in accls:
+            a.deinit()
+    t_f32 = float(np.median(times["f32"]))
+    t_fp8 = float(np.median(times["fp8"]))
+    throttled = fab.stats["throttled"]
+    if not throttled:
+        raise AssertionError(
+            "the emulated slow wire never engaged — the time ratio "
+            "would measure host CPU, not bytes on the wire")
+    return {
+        "metric": f"quantized_wire_allreduce_{nbytes >> 20}MiB_"
+                  f"{WORLD}rank",
+        "value": round(wire["f32"] / max(1, wire["fp8"]), 3),
+        "unit": "x",
+        "quant_wire_ratio": round(wire["f32"] / max(1, wire["fp8"]), 3),
+        "quant_time_ratio": round(t_f32 / t_fp8, 3),
+        "quant_us": round(t_fp8 * 1e6, 1),
+        "quant_f32_us": round(t_f32 * 1e6, 1),
+        "quant_err_rel": round(err_rel, 6),
+        "quant_blocks": quant.counters()["tx_blocks"] - blocks0,
+        "quant_wire_mib": round(wire["fp8"] / iters / (1 << 20), 3),
+        "quant_f32_wire_mib": round(wire["f32"] / iters / (1 << 20), 3),
+        "quant_throttled": int(throttled),
+        "nbytes": nbytes,
+        "world": WORLD,
+        "tier": "emu",
+    }
+
+
+def headline() -> dict:
+    return quantize_headline()
+
+
+def main():
+    print(json.dumps(headline()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,11 +33,12 @@ DEFAULT_OPS = ["allreduce", "allgather", "reduce_scatter", "gather",
 _ELEM = 4  # float32 sweeps
 
 
-def _rank_body(op: str, count: int, W: int, alg, reps: int):
+def _rank_body(op: str, count: int, W: int, alg, reps: int, **callkw):
     """Per-rank closure: allocate per-op buffers, warm up, time ``reps``
     synchronous calls, return every per-call duration (one independent
     measurement per rep — the tuner is fed each, so the table's
-    ``samples`` field reflects real evidence)."""
+    ``samples`` field reflects real evidence). ``callkw`` forwards wire
+    options (compress_dtype/block_scale — the quantized wire sweep)."""
 
     def body(a):
         f32 = np.float32
@@ -45,7 +46,8 @@ def _rank_body(op: str, count: int, W: int, alg, reps: int):
             src = a.buffer(data=np.ones(count, f32))
             dst = a.buffer((count,), f32)
             call = {"allreduce": lambda: a.allreduce(src, dst, count,
-                                                     algorithm=alg),
+                                                     algorithm=alg,
+                                                     **callkw),
                     "reduce": lambda: a.reduce(src, dst, count,
                                                algorithm=alg)}[op]
         elif op == "bcast":
@@ -54,7 +56,8 @@ def _rank_body(op: str, count: int, W: int, alg, reps: int):
         elif op == "allgather":
             src = a.buffer(data=np.ones(count, f32))
             dst = a.buffer((W * count,), f32)
-            call = lambda: a.allgather(src, dst, count, algorithm=alg)
+            call = lambda: a.allgather(src, dst, count, algorithm=alg,
+                                       **callkw)
         elif op == "gather":
             src = a.buffer(data=np.ones(count, f32))
             dst = a.buffer((W * count,), f32)
@@ -63,7 +66,7 @@ def _rank_body(op: str, count: int, W: int, alg, reps: int):
             src = a.buffer(data=np.ones(W * count, f32))
             dst = a.buffer((count,), f32)
             call = lambda: a.reduce_scatter(src, dst, count,
-                                            algorithm=alg)
+                                            algorithm=alg, **callkw)
         else:
             raise ValueError(op)
         call()  # warmup
@@ -122,17 +125,52 @@ def run_tune(world: int = 4, sizes=None, ops=None, reps: int = 3,
                         "bucket": nbytes_bucket(count * _ELEM),
                         "algorithm": alg.name, "source": "forced",
                         "seconds_per_op": min(durs)})
+        # quantized-wire sweep (accl_tpu/quant.py): measure the fp8
+        # block-scaled variant beside the plain wire for the bandwidth-
+        # heavy ops and feed the tuner's wire EWMAs — select_wire then
+        # resolves the quantized/full crossover from MEASUREMENTS on
+        # this host instead of the analytic ratio alone
+        import ml_dtypes
+        f8 = np.dtype(ml_dtypes.float8_e4m3fn)
+        for op in [o for o in ops
+                   if o in ("allreduce", "allgather", "reduce_scatter")]:
+            for nbytes in sizes:
+                count = max(1, nbytes // _ELEM)
+                for quantized in (False, True):
+                    kw = ({"compress_dtype": f8, "block_scale": True}
+                          if quantized else {})
+                    per_rank = run_ranks(
+                        accls, _rank_body(op, count, world,
+                                          CollectiveAlgorithm.AUTO, reps,
+                                          **kw))
+                    durs = [max(ts[i] for ts in per_rank)
+                            for i in range(reps)]
+                    for d in durs:
+                        tuner.observe_wire(op, world, count * _ELEM,
+                                           quantized, d)
+                    rows.append({
+                        "op": op, "world": world, "count": count,
+                        "nbytes": count * _ELEM,
+                        "bucket": nbytes_bucket(count * _ELEM),
+                        "algorithm": ("AUTO+fp8-bs" if quantized
+                                      else "AUTO"),
+                        "source": "forced",
+                        "seconds_per_op": min(durs)})
         # fold measurements, then record what AUTO now resolves to
         tuner.refresh()
         for op in ops:
             for nbytes in sizes:
                 count = max(1, nbytes // _ELEM)
                 chosen = tuner.select(op, world, count * _ELEM)
+                wire = (tuner.select_wire(op, world, count * _ELEM)
+                        if op in ("allreduce", "allgather",
+                                  "reduce_scatter") else False)
                 rows.append({
                     "op": op, "world": world, "count": count,
                     "nbytes": count * _ELEM,
                     "bucket": nbytes_bucket(count * _ELEM),
-                    "algorithm": CollectiveAlgorithm(chosen).name,
+                    "algorithm": CollectiveAlgorithm(chosen).name
+                    + ("+fp8-bs" if wire else ""),
                     "source": "chosen", "seconds_per_op": None})
     finally:
         for a in accls:

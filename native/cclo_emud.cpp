@@ -658,6 +658,8 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c_in, uint8_t op,
   CallCtx c = c_in;
   if (op != OP_COPY && op != OP_COMBINE && op != OP_SEND && op != OP_RECV)
     c.stream = 0;
+  if (c.compression & C_BLOCK_SCALED)
+    return E_COMPRESSION;  // no scale-block codec on this tier
   const uint32_t W = c.world, me = c.me;
   size_t eb = c.ebytes(c.compression & C_OP0);
   size_t ebr = c.ebytes(c.compression & C_RES);

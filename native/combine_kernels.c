@@ -28,6 +28,7 @@
  */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <float.h>
 #include <math.h>
 #include <stdint.h>
 #include <string.h>
@@ -47,6 +48,8 @@
 #define DT_BF16 5
 #define DT_I8 6
 #define DT_U8 7
+#define DT_F8E4M3 8
+#define DT_F8E5M2 9
 
 /* ---- half / bfloat16 conversion (numpy/ml_dtypes round-to-nearest-even
  * parity; the float32 intermediate is exact for any two-operand sum or
@@ -107,6 +110,102 @@ static inline uint16_t float_to_half(float v) {
         out++;
     return out;
 }
+
+/* ---- fp8 conversion (ml_dtypes parity, pinned empirically by
+ * tests/test_combine_native.py over all 256 codes + a dense f32 corpus):
+ * e4m3fn — 4 exp / 3 man, bias 7, NO inf: the all-ones-exponent codes
+ * are ordinary values except mantissa 111 (0x7F/0xFF = NaN); rounding
+ * past 448+ulp/2 (exclusive) and every inf/NaN input map to sign|0x7F.
+ * e5m2 — 5 exp / 2 man, bias 15, IEEE-shaped: overflow rounds to inf
+ * (sign|0x7C), NaN canonicalizes to sign|0x7E. Both round-to-nearest-
+ * even including the subnormal range, like the half conversion above. */
+
+static inline float f8_to_float(uint8_t h, int man_bits, int bias,
+                                int has_inf) {
+    uint32_t sign = (uint32_t)(h & 0x80u) << 24;
+    int exp_bits = 7 - man_bits;
+    uint32_t man_mask = (1u << man_bits) - 1u;
+    uint32_t exp = ((uint32_t)h >> man_bits) & ((1u << exp_bits) - 1u);
+    uint32_t man = h & man_mask;
+    uint32_t emax = (1u << exp_bits) - 1u;
+    uint32_t f;
+    if (exp == emax && (has_inf || man == man_mask)) {
+        /* specials (ml_dtypes decodes pinned by test): e5m2 all-ones
+         * exponent is inf (man 0) / canonical quiet NaN; e4m3fn has no
+         * inf and only mantissa-all-ones is NaN — every other all-ones-
+         * exponent code is an ordinary value (falls through below) */
+        f = sign | (man ? 0x7FC00000u : (has_inf ? 0x7F800000u
+                                                 : 0x7FC00000u));
+    } else if (exp == 0) {
+        if (man == 0) {
+            f = sign;
+        } else { /* subnormal: renormalize into f32 */
+            uint32_t e = 127 - bias + 1;
+            while (!(man & (1u << man_bits))) { man <<= 1; e--; }
+            man &= man_mask;
+            f = sign | (e << 23) | (man << (23 - man_bits));
+        }
+    } else {
+        f = sign | ((exp - bias + 127u) << 23) | (man << (23 - man_bits));
+    }
+    float out;
+    memcpy(&out, &f, 4);
+    return out;
+}
+
+static inline uint8_t float_to_f8(float v, int man_bits, int bias,
+                                  int has_inf) {
+    uint32_t x;
+    memcpy(&x, &v, 4);
+    uint8_t sign = (uint8_t)((x >> 24) & 0x80u);
+    uint32_t fexp = (x >> 23) & 0xFFu;
+    uint32_t man = x & 0x7FFFFFu;
+    int exp_bits = 7 - man_bits;
+    uint32_t emax = (1u << exp_bits) - 1u;
+    /* largest finite code magnitude: e5m2 0x7B, e4m3fn 0x7E */
+    uint8_t max_code = (uint8_t)(has_inf ? ((emax << man_bits) - 1u)
+                                         : ((emax << man_bits)
+                                            | ((1u << man_bits) - 2u)));
+    uint8_t inf_code = (uint8_t)(emax << man_bits);         /* e5m2 only */
+    uint8_t nan_code = (uint8_t)(has_inf ? (inf_code | 0x02u)
+                                         : ((emax << man_bits)
+                                            | ((1u << man_bits) - 1u)));
+    if (fexp == 0xFFu) {
+        if (man)                            /* NaN: canonical quiet code */
+            return sign | nan_code;
+        return sign | (has_inf ? inf_code : nan_code);  /* inf */
+    }
+    int exp = (int)fexp - 127 + bias;
+    int shift = 23 - man_bits;
+    uint32_t out;
+    if (exp <= 0) { /* subnormal target (or underflow to zero) */
+        if (exp < -man_bits)
+            return sign;
+        man |= 0x800000u;                   /* implicit bit */
+        uint32_t s = (uint32_t)(shift + 1 - exp);
+        uint32_t hman = man >> s;
+        uint32_t rem = man & ((1u << s) - 1u);
+        uint32_t halfway = 1u << (s - 1);
+        if (rem > halfway || (rem == halfway && (hman & 1u)))
+            hman++;
+        out = hman;                         /* may carry into exp 1: fine */
+    } else {
+        uint32_t rem = man & ((1u << shift) - 1u);
+        uint32_t hman = man >> shift;
+        out = ((uint32_t)exp << man_bits) | hman;
+        uint32_t halfway = 1u << (shift - 1);
+        if (rem > halfway || (rem == halfway && (hman & 1u)))
+            out++;                          /* carry may bump the exp */
+    }
+    if (out > max_code)                     /* overflow past max finite */
+        return sign | (has_inf ? inf_code : nan_code);
+    return sign | (uint8_t)out;
+}
+
+static inline float e4m3_to_float(uint8_t h) { return f8_to_float(h, 3, 7, 0); }
+static inline uint8_t float_to_e4m3(float v) { return float_to_f8(v, 3, 7, 0); }
+static inline float e5m2_to_float(uint8_t h) { return f8_to_float(h, 2, 15, 1); }
+static inline uint8_t float_to_e5m2(float v) { return float_to_f8(v, 2, 15, 1); }
 
 static inline float bf16_to_float(uint16_t h) {
     uint32_t x = (uint32_t)h << 16;
@@ -198,6 +297,44 @@ static inline uint16_t float_to_bf16(float v) {
         }                                                                 \
     } while (0)
 
+/* fp8 quantized lanes: widen to f32, combine, round back — the exact
+ * arithmetic ml_dtypes' ufunc loops run (both operands are exactly
+ * representable in f32, so the f32 op is the correctly-rounded fp8 op).
+ * MAX/MIN follow the ml_dtypes strict-compare rule (SECOND operand wins
+ * ties — like bf16/f32, pinned on signed zeros by the test corpus).
+ * NaN results carry ml_dtypes' empirically-pinned sign rule (the test
+ * corpus seeds both NaN codes): add keeps the FIRST operand's NaN sign
+ * and canonicalizes a second-operand NaN to the positive code; mul
+ * prefers the SECOND operand's NaN sign, then the first's. NANC is the
+ * canonical positive NaN code of the dtype. */
+#define F8LIKE_BODY(TO_F, FROM_F, NANC)                                   \
+    do {                                                                  \
+        const uint8_t *a = (const uint8_t *)abuf;                         \
+        const uint8_t *b = (const uint8_t *)bbuf;                         \
+        uint8_t *o = (uint8_t *)obuf;                                     \
+        switch (func) {                                                   \
+        case F_SUM:                                                       \
+            LOOP(isnan(TO_F(a[i])) ? (uint8_t)((a[i] & 0x80u) | (NANC))   \
+                 : isnan(TO_F(b[i])) ? (uint8_t)(NANC)                    \
+                 : FROM_F(TO_F(a[i]) + TO_F(b[i])));                      \
+            break;                                                        \
+        case F_PROD:                                                      \
+            LOOP(isnan(TO_F(b[i])) ? (uint8_t)((b[i] & 0x80u) | (NANC))   \
+                 : isnan(TO_F(a[i])) ? (uint8_t)((a[i] & 0x80u) | (NANC)) \
+                 : FROM_F(TO_F(a[i]) * TO_F(b[i])));                      \
+            break;                                                        \
+        case F_MAX:                                                       \
+            LOOP((TO_F(a[i]) > TO_F(b[i]) || isnan(TO_F(a[i])))           \
+                     ? a[i] : b[i]);                                      \
+            break;                                                        \
+        case F_MIN:                                                       \
+            LOOP((TO_F(a[i]) < TO_F(b[i]) || isnan(TO_F(a[i])))           \
+                     ? a[i] : b[i]);                                      \
+            break;                                                        \
+        default: return -1;                                               \
+        }                                                                 \
+    } while (0)
+
 static int run_reduce(int func, int dt, const void *abuf, const void *bbuf,
                       void *obuf, Py_ssize_t n) {
     switch (dt) {
@@ -211,11 +348,15 @@ static int run_reduce(int func, int dt, const void *abuf, const void *bbuf,
                                >=, <=); return 0;
     case DT_BF16: HALFLIKE_BODY(bf16_to_float, float_to_bf16,
                                 >, <); return 0;
+    case DT_F8E4M3: F8LIKE_BODY(e4m3_to_float, float_to_e4m3,
+                                0x7Fu); return 0;
+    case DT_F8E5M2: F8LIKE_BODY(e5m2_to_float, float_to_e5m2,
+                                0x7Eu); return 0;
     default: return -1;
     }
 }
 
-static const Py_ssize_t ITEMSIZE[] = {4, 8, 4, 8, 2, 2, 1, 1};
+static const Py_ssize_t ITEMSIZE[] = {4, 8, 4, 8, 2, 2, 1, 1, 1, 1};
 
 /* Release the GIL only past this span size: the acquire/release pair
  * costs ~100ns, which at small segments would eat the dispatch win this
@@ -234,7 +375,7 @@ static PyObject *reduce_into(PyObject *self, PyObject *const *args,
     int dt = (int)PyLong_AsLong(args[1]);
     if ((func == -1 || dt == -1) && PyErr_Occurred())
         return NULL;
-    if (dt < 0 || dt > DT_U8) {
+    if (dt < 0 || dt > DT_F8E5M2) {
         PyErr_SetString(PyExc_ValueError, "unsupported dtype code");
         return NULL;
     }
@@ -280,12 +421,310 @@ static PyObject *reduce_into(PyObject *self, PyObject *const *args,
     Py_RETURN_NONE;
 }
 
+/* ---- block-scaled quantized wire kernels (accl_tpu/quant.py) ----------
+ * One f32 scale per `block` elements (absmax / qmax, clamped to a sane
+ * positive-finite value), fp8/int8 payload. Contract: BIT-IDENTICAL to
+ * the numpy reference in accl_tpu/quant.py — every float step below is
+ * a single f32 rounding in the same order the vectorized numpy performs
+ * (multiply by the reciprocal, rintf = round-half-even, clip, cast), so
+ * serial/streamed/native-vs-numpy differentials all agree. The baseline
+ * -O3 build has no FMA contraction (SSE2 target), which the reference
+ * corpus would catch if a toolchain ever fused the combine's mul+add. */
+
+#define QK_I8 0
+#define QK_E4M3 1
+#define QK_E5M2 2
+
+static int qkind_of(int dt) {
+    switch (dt) {
+    case DT_I8: return QK_I8;
+    case DT_F8E4M3: return QK_E4M3;
+    case DT_F8E5M2: return QK_E5M2;
+    default: return -1;
+    }
+}
+
+static float qmax_of(int qk) {
+    return qk == QK_I8 ? 127.0f : (qk == QK_E4M3 ? 448.0f : 57344.0f);
+}
+
+static inline float q_decode(int qk, uint8_t raw) {
+    switch (qk) {
+    case QK_I8: return (float)(int8_t)raw;
+    case QK_E4M3: return e4m3_to_float(raw);
+    default: return e5m2_to_float(raw);
+    }
+}
+
+static inline uint8_t q_encode(int qk, float v) {
+    if (qk == QK_I8) {
+        if (!isfinite(v))
+            return 0;               /* NaN/inf quantize to 0 (reference) */
+        float r = rintf(v);         /* round half to even, like np.rint */
+        if (r > 127.0f) r = 127.0f;
+        if (r < -127.0f) r = -127.0f;
+        return (uint8_t)(int8_t)r;
+    }
+    return qk == QK_E4M3 ? float_to_e4m3(v) : float_to_e5m2(v);
+}
+
+static void run_bs_quantize(int qk, Py_ssize_t block, const float *x,
+                            float *scales, uint8_t *q, Py_ssize_t n) {
+    float qmax = qmax_of(qk);
+    Py_ssize_t nb = (n + block - 1) / block;
+    for (Py_ssize_t b = 0; b < nb; b++) {
+        Py_ssize_t lo = b * block;
+        Py_ssize_t hi = lo + block < n ? lo + block : n;
+        float m = 0.0f;
+        for (Py_ssize_t i = lo; i < hi; i++) {
+            float av = fabsf(x[i]);
+            if (isnan(av) || av > m)    /* NaN-propagating max (np.max) */
+                m = av;
+        }
+        float s = m / qmax;
+        if (!(s >= FLT_MIN && s < INFINITY))
+            s = 1.0f;     /* zero/subnormal/NaN/inf absmax: identity scale */
+        scales[b] = s;
+        float inv = 1.0f / s;
+        for (Py_ssize_t i = lo; i < hi; i++)
+            q[i] = q_encode(qk, x[i] * inv);
+    }
+}
+
+static void run_bs_dequant(int qk, Py_ssize_t block, const float *scales,
+                           const uint8_t *q, float *out, Py_ssize_t n) {
+    for (Py_ssize_t b = 0; b * block < n; b++) {
+        Py_ssize_t lo = b * block;
+        Py_ssize_t hi = lo + block < n ? lo + block : n;
+        float s = scales[b];
+        for (Py_ssize_t i = lo; i < hi; i++)
+            out[i] = q_decode(qk, q[i]) * s;
+    }
+}
+
+static int run_bs_combine(int func, int qk, Py_ssize_t block,
+                          const float *scales, const uint8_t *q,
+                          const float *other, float *out, Py_ssize_t n) {
+    for (Py_ssize_t b = 0; b * block < n; b++) {
+        Py_ssize_t lo = b * block;
+        Py_ssize_t hi = lo + block < n ? lo + block : n;
+        float s = scales[b];
+        switch (func) {
+        case F_SUM:
+            for (Py_ssize_t i = lo; i < hi; i++) {
+                float v = q_decode(qk, q[i]) * s;
+                out[i] = other[i] + v;
+            }
+            break;
+        case F_PROD:
+            for (Py_ssize_t i = lo; i < hi; i++) {
+                float v = q_decode(qk, q[i]) * s;
+                out[i] = other[i] * v;
+            }
+            break;
+        case F_MAX:
+            for (Py_ssize_t i = lo; i < hi; i++) {
+                float v = q_decode(qk, q[i]) * s;
+                out[i] = FMAX_NP(other[i], v);
+            }
+            break;
+        case F_MIN:
+            for (Py_ssize_t i = lo; i < hi; i++) {
+                float v = q_decode(qk, q[i]) * s;
+                out[i] = FMIN_NP(other[i], v);
+            }
+            break;
+        default:
+            return -1;
+        }
+    }
+    return 0;
+}
+
+/* shared arg plumbing: (ints..., buffers...) with n derived from the q
+ * buffer (1 byte/elem for every supported quantized dtype) */
+static int bs_get_buffers(PyObject *const *args, Py_ssize_t first,
+                          Py_ssize_t nbufs, Py_buffer *bufs, int writable_last) {
+    for (Py_ssize_t i = 0; i < nbufs; i++) {
+        int flags = (i == nbufs - 1 && writable_last) ? PyBUF_WRITABLE
+                                                      : PyBUF_SIMPLE;
+        if (PyObject_GetBuffer(args[first + i], &bufs[i], flags) < 0) {
+            while (i--)
+                PyBuffer_Release(&bufs[i]);
+            return -1;
+        }
+    }
+    return 0;
+}
+
+static PyObject *bs_quantize(PyObject *self, PyObject *const *args,
+                             Py_ssize_t nargs) {
+    (void)self;
+    if (nargs != 5) {
+        PyErr_SetString(PyExc_TypeError,
+                        "bs_quantize(dtype_code, block, src, scales, q)");
+        return NULL;
+    }
+    int qk = qkind_of((int)PyLong_AsLong(args[0]));
+    Py_ssize_t block = PyLong_AsSsize_t(args[1]);
+    if (PyErr_Occurred())
+        return NULL;
+    if (qk < 0 || block <= 0) {
+        PyErr_SetString(PyExc_ValueError, "unsupported qdtype/block");
+        return NULL;
+    }
+    Py_buffer b[3];
+    /* src read-only, scales + q written: grab scales/q writable */
+    if (PyObject_GetBuffer(args[2], &b[0], PyBUF_SIMPLE) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(args[3], &b[1], PyBUF_WRITABLE) < 0) {
+        PyBuffer_Release(&b[0]);
+        return NULL;
+    }
+    if (PyObject_GetBuffer(args[4], &b[2], PyBUF_WRITABLE) < 0) {
+        PyBuffer_Release(&b[0]);
+        PyBuffer_Release(&b[1]);
+        return NULL;
+    }
+    Py_ssize_t n = b[2].len;
+    Py_ssize_t nb = (n + block - 1) / block;
+    int bad = (b[0].len != 4 * n || b[1].len != 4 * nb);
+    if (!bad) {
+        if (n * 4 >= GIL_RELEASE_BYTES) {
+            Py_BEGIN_ALLOW_THREADS
+            run_bs_quantize(qk, block, (const float *)b[0].buf,
+                            (float *)b[1].buf, (uint8_t *)b[2].buf, n);
+            Py_END_ALLOW_THREADS
+        } else {
+            run_bs_quantize(qk, block, (const float *)b[0].buf,
+                            (float *)b[1].buf, (uint8_t *)b[2].buf, n);
+        }
+    }
+    PyBuffer_Release(&b[0]);
+    PyBuffer_Release(&b[1]);
+    PyBuffer_Release(&b[2]);
+    if (bad) {
+        PyErr_SetString(PyExc_ValueError, "buffer lengths disagree");
+        return NULL;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *bs_dequant(PyObject *self, PyObject *const *args,
+                            Py_ssize_t nargs) {
+    (void)self;
+    if (nargs != 5) {
+        PyErr_SetString(PyExc_TypeError,
+                        "bs_dequant(dtype_code, block, scales, q, out)");
+        return NULL;
+    }
+    int qk = qkind_of((int)PyLong_AsLong(args[0]));
+    Py_ssize_t block = PyLong_AsSsize_t(args[1]);
+    if (PyErr_Occurred())
+        return NULL;
+    if (qk < 0 || block <= 0) {
+        PyErr_SetString(PyExc_ValueError, "unsupported qdtype/block");
+        return NULL;
+    }
+    Py_buffer b[3];
+    if (bs_get_buffers(args, 2, 3, b, 1) < 0)
+        return NULL;
+    Py_ssize_t n = b[1].len;
+    Py_ssize_t nb = (n + block - 1) / block;
+    int bad = (b[0].len != 4 * nb || b[2].len != 4 * n);
+    if (!bad) {
+        if (n * 4 >= GIL_RELEASE_BYTES) {
+            Py_BEGIN_ALLOW_THREADS
+            run_bs_dequant(qk, block, (const float *)b[0].buf,
+                           (const uint8_t *)b[1].buf, (float *)b[2].buf, n);
+            Py_END_ALLOW_THREADS
+        } else {
+            run_bs_dequant(qk, block, (const float *)b[0].buf,
+                           (const uint8_t *)b[1].buf, (float *)b[2].buf, n);
+        }
+    }
+    for (int i = 0; i < 3; i++)
+        PyBuffer_Release(&b[i]);
+    if (bad) {
+        PyErr_SetString(PyExc_ValueError, "buffer lengths disagree");
+        return NULL;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *bs_combine(PyObject *self, PyObject *const *args,
+                            Py_ssize_t nargs) {
+    (void)self;
+    if (nargs != 7) {
+        PyErr_SetString(PyExc_TypeError,
+                        "bs_combine(func, dtype_code, block, scales, q, "
+                        "other, out)");
+        return NULL;
+    }
+    int func = (int)PyLong_AsLong(args[0]);
+    int qk = qkind_of((int)PyLong_AsLong(args[1]));
+    Py_ssize_t block = PyLong_AsSsize_t(args[2]);
+    if (PyErr_Occurred())
+        return NULL;
+    if (qk < 0 || block <= 0) {
+        PyErr_SetString(PyExc_ValueError, "unsupported qdtype/block");
+        return NULL;
+    }
+    Py_buffer b[4];
+    if (bs_get_buffers(args, 3, 4, b, 1) < 0)
+        return NULL;
+    Py_ssize_t n = b[1].len;
+    Py_ssize_t nb = (n + block - 1) / block;
+    int bad = (b[0].len != 4 * nb || b[2].len != 4 * n || b[3].len != 4 * n);
+    int rc = 0;
+    if (!bad) {
+        if (n * 4 >= GIL_RELEASE_BYTES) {
+            Py_BEGIN_ALLOW_THREADS
+            rc = run_bs_combine(func, qk, block, (const float *)b[0].buf,
+                                (const uint8_t *)b[1].buf,
+                                (const float *)b[2].buf,
+                                (float *)b[3].buf, n);
+            Py_END_ALLOW_THREADS
+        } else {
+            rc = run_bs_combine(func, qk, block, (const float *)b[0].buf,
+                                (const uint8_t *)b[1].buf,
+                                (const float *)b[2].buf,
+                                (float *)b[3].buf, n);
+        }
+    }
+    for (int i = 0; i < 4; i++)
+        PyBuffer_Release(&b[i]);
+    if (bad) {
+        PyErr_SetString(PyExc_ValueError, "buffer lengths disagree");
+        return NULL;
+    }
+    if (rc) {
+        PyErr_SetString(PyExc_ValueError, "unsupported func code");
+        return NULL;
+    }
+    Py_RETURN_NONE;
+}
+
 static PyMethodDef methods[] = {
     {"reduce_into", (PyCFunction)(void (*)(void))reduce_into,
      METH_FASTCALL,
      "reduce_into(func, dtype_code, a, b, out): out[i] = func(a[i], b[i]) "
      "over contiguous same-length buffers; bit-identical to the numpy "
      "ufunc for every supported (func, dtype)."},
+    {"bs_quantize", (PyCFunction)(void (*)(void))bs_quantize,
+     METH_FASTCALL,
+     "bs_quantize(dtype_code, block, src_f32, scales_f32, q_out): "
+     "per-block absmax scales + quantized payload (accl_tpu/quant.py "
+     "reference parity)."},
+    {"bs_dequant", (PyCFunction)(void (*)(void))bs_dequant,
+     METH_FASTCALL,
+     "bs_dequant(dtype_code, block, scales_f32, q, out_f32): "
+     "out[i] = decode(q[i]) * scales[i/block]."},
+    {"bs_combine", (PyCFunction)(void (*)(void))bs_combine,
+     METH_FASTCALL,
+     "bs_combine(func, dtype_code, block, scales_f32, q, other_f32, "
+     "out_f32): fused dequant+combine — out[i] = func(other[i], "
+     "decode(q[i]) * scales[i/block]) with f32 accumulation."},
     {NULL, NULL, 0, NULL},
 };
 

@@ -59,6 +59,12 @@ enum Cfg : uint8_t {
 
 enum CompFlag : uint8_t {
   C_NONE = 0, C_OP0 = 1, C_OP1 = 2, C_RES = 4, C_ETH = 8,
+  // block-scaled quantized wire (accl_tpu/quant.py): the python tiers
+  // carry per-block scale headers ahead of the fp8/int8 payload. This
+  // daemon has no scale-block codec — it REJECTS the flag typed
+  // (E_COMPRESSION) instead of narrowing frames the peers would then
+  // misparse as scale-block layouts.
+  C_BLOCK_SCALED = 16,
 };
 
 // per-call collective algorithm selector (CollectiveAlgorithm in
@@ -72,6 +78,7 @@ enum Alg : uint8_t {
 enum Err : uint32_t {
   E_OK = 0,
   E_DMA_MISMATCH = 1u << 0,
+  E_COMPRESSION = 1u << 5,
   E_KRNL_TIMEOUT = 1u << 6,
   E_RECV_TIMEOUT = 1u << 8,
   E_DMA_SIZE = 1u << 12,

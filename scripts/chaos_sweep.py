@@ -199,6 +199,139 @@ def _integrity_total() -> float:
                                           {}).values()))
 
 
+def _retx_total() -> float:
+    snap = METRICS.snapshot()
+    return float(sum(snap["counters"].get("fabric_retransmits_total",
+                                          {}).values()))
+
+
+def _schedule_quant(accls, algorithm, count, iters=3):
+    """Quantized twin of _schedule: fp8 block-scaled allreduces (+ one
+    block-scaled allgather). Per-rank results legitimately DIFFER under
+    a lossy wire's requantization (the owner keeps unquantized chunks),
+    so quant cells compare rank-for-rank against a clean same-shape
+    world instead of asserting cross-rank equality."""
+    import ml_dtypes
+    W = len(accls)
+    f8 = np.dtype(ml_dtypes.float8_e4m3fn)
+    ins = [np.random.default_rng(300 + r).standard_normal(count)
+           .astype(np.float32) for r in range(W)]
+
+    def body(a):
+        src = a.buffer(data=ins[a.rank].copy())
+        dst = a.buffer((count,), np.float32)
+        gsrc = a.buffer(data=ins[a.rank][:count // W].copy())
+        gdst = a.buffer((count // W * W,), np.float32)
+        for _ in range(iters):
+            a.allreduce(src, dst, count, algorithm=algorithm,
+                        compress_dtype=f8, block_scale=32)
+        a.allgather(gsrc, gdst, count // W, compress_dtype=f8,
+                    block_scale=32)
+        dst.sync_from_device()
+        gdst.sync_from_device()
+        return dst.data.copy(), gdst.data.copy()
+
+    return run_ranks(accls, body, timeout=300.0)
+
+
+def quant_cell(kind: str, alg, W: int, seed: int) -> tuple[bool, int, str]:
+    """Block-scaled wire under faults: drop and payload corruption — the
+    latter TARGETING the scale-header region (FaultRule.flip_at inside
+    the first scale word) on top of the default mid-payload flips — must
+    recover rank-for-rank bit-identically to a clean same-shape world.
+    Engagement proofs: drops must move the retransmission counters,
+    scale corruption must move integrity_failed_total (a corrupt scale
+    recovering like a corrupt payload IS the contract under test; a
+    cell passing without the tier engaging gates nothing)."""
+    from accl_tpu.quant import HDR_BYTES
+    rules = [FaultRule(kind=kind, every=3, offset=1, delay_s=0.01),
+             FaultRule(kind=kind, prob=PROB, delay_s=0.01)]
+    if kind == "corrupt_payload":
+        # aim a deterministic schedule at the scale header itself
+        rules.insert(0, FaultRule(kind=kind, every=5, offset=2,
+                                  flip_at=HDR_BYTES + 1))
+    plan = FaultPlan(rules, seed=seed)
+    accls = emu_world(W, timeout=20.0, nbufs=32)
+    fabric = accls[0].device.ctx.fabric
+    try:
+        oracle = _schedule_quant(accls, alg, COUNT)  # clean pass first
+        integ0, retx0 = _integrity_total(), _retx_total()
+        fabric.inject_fault(plan)
+        res = _schedule_quant(accls, alg, COUNT)
+        ok = all((a == b).all() for r, o in zip(res, oracle)
+                 for a, b in zip(r, o))
+        status = "ok" if ok else "DIVERGED"
+        if kind == "corrupt_payload" and ok \
+                and _integrity_total() <= integ0:
+            ok, status = False, "NO-INTEGRITY-DROPS"
+        if kind == "drop" and ok and _retx_total() <= retx0:
+            ok, status = False, "NO-RETRANSMITS"
+    finally:
+        fabric.clear_fault()
+        for a in accls:
+            a.deinit()
+    return ok, sum(plan.applied.values()), status
+
+
+def hier_quant_cell(kind: str, seed: int) -> tuple[bool, int, str]:
+    """Per-phase quantized hierarchical allreduce (inter tier fp8
+    block-scaled, intra full precision) under drop / scale-corruption:
+    recovery must hold per phase, rank-for-rank vs a clean world."""
+    import ml_dtypes
+    from accl_tpu.quant import HDR_BYTES
+    f8 = np.dtype(ml_dtypes.float8_e4m3fn)
+    hosts = [0, 0, 1, 1]
+    rules = [FaultRule(kind=kind, every=3, offset=1),
+             FaultRule(kind=kind, prob=PROB)]
+    if kind == "corrupt_payload":
+        rules.insert(0, FaultRule(kind=kind, every=5, offset=2,
+                                  flip_at=HDR_BYTES))
+    plan = FaultPlan(rules, seed=seed)
+    ins = [np.random.default_rng(400 + r).standard_normal(COUNT)
+           .astype(np.float32) for r in range(4)]
+
+    def world():
+        accls = emu_world(4, timeout=30.0, nbufs=32, hosts=hosts)
+        for a in accls:
+            a.configure_hierarchy(hosts)
+        return accls
+
+    def schedule(accls):
+        def body(a):
+            src = a.buffer(data=ins[a.rank].copy())
+            dst = a.buffer((COUNT,), np.float32)
+            for _ in range(2):
+                a.allreduce(src, dst, COUNT, algorithm=A.HIERARCHICAL,
+                            compress_dtype=f8, block_scale=32,
+                            compress_phases="inter")
+            dst.sync_from_device()
+            return dst.data.copy()
+        return run_ranks(accls, body, timeout=300.0)
+
+    accls = world()
+    try:
+        oracle = schedule(accls)
+    finally:
+        for a in accls:
+            a.deinit()
+    accls = world()
+    fabric = accls[0].device.ctx.fabric
+    integ0 = _integrity_total()
+    fabric.inject_fault(plan)
+    try:
+        res = schedule(accls)
+        ok = all((r == o).all() for r, o in zip(res, oracle))
+        status = "ok" if ok else "DIVERGED"
+        if kind == "corrupt_payload" and ok \
+                and _integrity_total() <= integ0:
+            ok, status = False, "NO-INTEGRITY-DROPS"
+    finally:
+        fabric.clear_fault()
+        for a in accls:
+            a.deinit()
+    return ok, sum(plan.applied.values()), status
+
+
 def shm_cell(kind: str, seed: int, oracle) -> tuple[bool, int, str]:
     """One fault kind through a 3-rank shared-memory daemon world
     (emulator/shm.py ShmFabric): the seeded plan rides every daemon's
@@ -327,6 +460,35 @@ def sweep(seed: int, hier: bool = True) -> int:
         if not ok:
             failures += 1
         rows.append((WORLDS[0], "shm", kind, status, applied,
+                     round((time.perf_counter() - t0) * 1e3)))
+    # block-scaled quantized wire cells (accl_tpu/quant.py): drop +
+    # payload/scale corruption across ring/RD x W, proving the scale
+    # headers ride the checksum/retx contract — a corrupt scale must
+    # recover like a corrupt payload, never land as a silently
+    # mis-scaled block
+    for W in WORLDS:
+        for alg_name, alg in ALGOS.items():
+            for kind in ("drop", "corrupt_payload"):
+                t0 = time.perf_counter()
+                try:
+                    ok, applied, status = quant_cell(kind, alg, W, seed)
+                except Exception as exc:  # noqa: BLE001 — report cell
+                    ok, applied = False, 0
+                    status = f"FAILED ({type(exc).__name__})"
+                if not ok:
+                    failures += 1
+                rows.append((W, f"q-{alg_name}", kind, status, applied,
+                             round((time.perf_counter() - t0) * 1e3)))
+    for kind in ("drop", "corrupt_payload"):
+        t0 = time.perf_counter()
+        try:
+            ok, applied, status = hier_quant_cell(kind, seed)
+        except Exception as exc:  # noqa: BLE001 — report cell
+            ok, applied = False, 0
+            status = f"FAILED ({type(exc).__name__})"
+        if not ok:
+            failures += 1
+        rows.append((4, "q-hier", kind, status, applied,
                      round((time.perf_counter() - t0) * 1e3)))
     # one-sided RMA payload-corrupt cell (rendezvous lane)
     t0 = time.perf_counter()

@@ -106,8 +106,17 @@ def check_lane_graph() -> list[str]:
                                      resolve_algorithm)
     from accl_tpu.plancache import compile_plan
 
+    import ml_dtypes
+
     errors = []
     cfg = ArithConfig(np.dtype(np.float32), np.dtype(np.float16))
+    # block-scaled quantized wire (accl_tpu/quant.py): scale-carrying
+    # expansions replay through the same lane/hazard checkers, plus the
+    # fusion-skip check (_bs_fusion_ok) — cut-through must never forward
+    # a packed payload a requantizing relay would have re-encoded
+    cfg_bs = ArithConfig(np.dtype(np.float32),
+                         np.dtype(ml_dtypes.float8_e4m3fn),
+                         quant_block=64)
     A = CollectiveAlgorithm
     ops = {
         CCLOp.bcast: [A.AUTO, A.TREE],
@@ -125,17 +134,20 @@ def check_lane_graph() -> list[str]:
     shifted = (0x400000, 0x480000, 0x500000)
     # W covers: pairs, a fold with one extra (3), a fold with multiple
     # extras (5 -> p=4, r=1; 6 -> p=4, r=2), and a power-of-2 deep tree
+    comps = [(Compression.NONE, cfg),
+             (Compression.ETH_COMPRESSED, cfg),
+             (Compression.ETH_COMPRESSED | Compression.BLOCK_SCALED,
+              cfg_bs)]
     for op, algs in ops.items():
         for alg in algs:
             for W in (2, 3, 5, 6, 8):
                 for seg in (16, 64, 1 << 20):
-                    for comp in (Compression.NONE,
-                                 Compression.ETH_COMPRESSED):
+                    for comp, ccfg in comps:
                         for root in range(W):
                             for me in range(W):
                                 ctx = MoveContext(world_size=W,
                                                   local_rank=me,
-                                                  arithcfg=cfg,
+                                                  arithcfg=ccfg,
                                                   max_segment_size=seg)
                                 moves = expand_call(
                                     ctx, op, count=23, root_src_dst=root,
@@ -148,10 +160,11 @@ def check_lane_graph() -> list[str]:
                                          f"me={me} seg={seg} "
                                          f"comp={int(comp)}")
                                 errors += _lane_edges_ok(where, moves)
-                                errors += _hazards_ok(where, moves, cfg)
+                                errors += _hazards_ok(where, moves, ccfg)
+                                errors += _bs_fusion_ok(where, moves)
                                 errors += _relocated_ok(
                                     where, op, alg, W, me, root, seg,
-                                    comp, cfg, bases, shifted, moves,
+                                    comp, ccfg, bases, shifted, moves,
                                     resolve_algorithm, compile_plan,
                                     MoveContext, expand_call)
     # IN-PLACE alltoall (src aliasing dst), odd AND even worlds: the
@@ -163,10 +176,10 @@ def check_lane_graph() -> list[str]:
     ali_shift = (0x600000, 0x680000, 0x600000)
     for W in (2, 3, 5, 6, 8):
         for seg in (16, 64, 1 << 20):
-            for comp in (Compression.NONE, Compression.ETH_COMPRESSED):
+            for comp, ccfg in comps:
                 for me in range(W):
                     ctx = MoveContext(world_size=W, local_rank=me,
-                                      arithcfg=cfg, max_segment_size=seg)
+                                      arithcfg=ccfg, max_segment_size=seg)
                     moves = expand_call(
                         ctx, CCLOp.alltoall, count=23, root_src_dst=0,
                         func=ReduceFunc.SUM, tag=TAG_ANY,
@@ -176,12 +189,36 @@ def check_lane_graph() -> list[str]:
                     where = (f"alltoall/inplace W={W} me={me} "
                              f"seg={seg} comp={int(comp)}")
                     errors += _lane_edges_ok(where, moves)
-                    errors += _hazards_ok(where, moves, cfg)
+                    errors += _hazards_ok(where, moves, ccfg)
+                    errors += _bs_fusion_ok(where, moves)
                     errors += _relocated_ok(
                         where, CCLOp.alltoall, A.AUTO, W, me, 0, seg,
-                        comp, cfg, aliased, ali_shift, moves,
+                        comp, ccfg, aliased, ali_shift, moves,
                         resolve_algorithm, compile_plan, MoveContext,
                         expand_call)
+    return errors
+
+
+def _bs_fusion_ok(where, moves) -> list[str]:
+    """Block-scaled fusion-skip invariant: the streamed planner must
+    never cut-through-fuse a relay whose wire is scale-block quantized —
+    the serial oracle REQUANTIZES the dequantized slot with fresh
+    scales, so forwarding the in-hand packed payload would diverge from
+    what the serial engine sends (executor._skeleton_fuse documents the
+    contract; this replays it over every corpus program)."""
+    if not any(mv.block_scaled for mv in moves):
+        return []
+    from accl_tpu.emulator.executor import plan_skeleton
+
+    errors = []
+    sk = plan_skeleton(moves)
+    for i, st in enumerate(sk.steps):
+        if st.fuse >= 0 and (moves[i].block_scaled
+                             or moves[st.fuse].block_scaled):
+            errors.append(
+                f"{where} move {i}: cut-through fusion engaged on a "
+                f"block-scaled recv->relay pair (move {st.fuse}) — "
+                f"requantized relays must stay unfused")
     return errors
 
 
@@ -355,8 +392,13 @@ def check_hier_programs() -> list[str]:
     from accl_tpu.hier import groups_from_hosts, plan_phases
     from accl_tpu.moveengine import MoveContext, expand_call
 
+    import ml_dtypes
+
     errors = []
     cfg = ArithConfig(np.dtype(np.float32), np.dtype(np.float16))
+    cfg_bs = ArithConfig(np.dtype(np.float32),
+                         np.dtype(ml_dtypes.float8_e4m3fn),
+                         quant_block=64)
     E = cfg.uncompressed_elem_bytes
     # role base table: disjoint regions except where the real engine
     # genuinely aliases (phases offset into "res" — the leaders' block
@@ -378,7 +420,11 @@ def check_hier_programs() -> list[str]:
             # 24 divides every corpus group size (2, 3, 4): the aligned
             # planner modes are exercised alongside the leader modes
             count = 24 if op in ("allreduce", "bcast") else 6
-            for comp in (Compression.NONE, Compression.ETH_COMPRESSED):
+            for comp, ccfg in (
+                    (Compression.NONE, cfg),
+                    (Compression.ETH_COMPRESSED, cfg),
+                    (Compression.ETH_COMPRESSED
+                     | Compression.BLOCK_SCALED, cfg_bs)):
                 for seg in (16, 1 << 20):
                     for me in range(W):
                         plan = plan_phases(op, groups, me, count,
@@ -388,7 +434,7 @@ def check_hier_programs() -> list[str]:
                             ctx = MoveContext(
                                 world_size=len(ph.members),
                                 local_rank=ph.members.index(me),
-                                arithcfg=cfg, max_segment_size=seg)
+                                arithcfg=ccfg, max_segment_size=seg)
                             a0 = (_phase_addrs(ph.src, bases, E)
                                   or bases["relay"])
                             a2 = (_phase_addrs(ph.dst, bases, E)
@@ -404,7 +450,8 @@ def check_hier_programs() -> list[str]:
                                      f"phase{pi}={ph.label} seg={seg} "
                                      f"comp={int(comp)}")
                             errors += _lane_edges_ok(where, moves)
-                            errors += _hazards_ok(where, moves, cfg)
+                            errors += _hazards_ok(where, moves, ccfg)
+                            errors += _bs_fusion_ok(where, moves)
     return errors
 
 
@@ -568,7 +615,8 @@ def main() -> int:
         return 1
     print("check_blocking: OK (blocking=False citations + lane graph + "
           "byte-interval hazards + relocated compiled plans + "
-          "hierarchical/redistribute programs + rendezvous plans)")
+          "hierarchical/redistribute programs + rendezvous plans + "
+          "block-scaled cells w/ fusion-skip)")
     return 0
 
 

@@ -1,0 +1,690 @@
+"""Block-scaled quantized collectives (accl_tpu/quant.py + the full
+vertical slice: moveengine BLOCK_SCALED expansion, executor fused
+dequant->accumulate->requant lane, hier per-phase compression, tuner
+quantized cost models, protocol qblock byte).
+
+Differential contracts (the ISSUE's typed error bounds):
+
+* **int8 exact vs the quantized serial oracle** — the streamed engine's
+  result is BIT-IDENTICAL to the serial reference engine running the
+  same quantized schedule (and the daemon/socket tiers match both).
+* **fp8 bounded vs the f32 oracle** — end-to-end error of a W-rank
+  block-scaled ring allreduce is bounded by ``hops * eps_q`` relative
+  to the travelling partial's block absmax: accumulation stays f32, so
+  error is per-hop bounded, never compounding (quant.py's error model).
+* **hier per-phase** — with ``compress_phases="inter"`` the intra-host
+  phases are bit-identical to a pure-numpy exact composition; only the
+  leader/outer phase quantizes (proved by composing the oracle from
+  exact numpy intra phases + an engine-run quantized outer phase).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from accl_tpu import quant
+from accl_tpu.constants import (ACCLError, CollectiveAlgorithm as A,
+                                Compression, ErrorCode, ReduceFunc)
+from accl_tpu.testing import emu_world, run_ranks, sim_world
+
+F8 = np.dtype(ml_dtypes.float8_e4m3fn)
+F8W = np.dtype(ml_dtypes.float8_e5m2)
+EPS_Q = {"int8": 1.0 / 253, "float8_e4m3fn": 2.0 ** -3,
+         "float8_e5m2": 2.0 ** -2}   # half-ulp-at-amax per quantization
+
+
+def _ins(W, n, scale_mix=True, seed=0):
+    """Per-rank inputs mixing magnitudes across blocks — the shape that
+    makes block scaling matter (a global cast would crush the small
+    blocks to zero)."""
+    out = []
+    for r in range(W):
+        rng = np.random.default_rng(seed + r)
+        x = rng.standard_normal(n).astype(np.float32)
+        if scale_mix:
+            x *= np.repeat(rng.choice([0.01, 1.0, 100.0], -(-n // 64)),
+                           64)[:n].astype(np.float32)
+        out.append(x)
+    return out
+
+
+def _allreduce(accls, ins, n, **kw):
+    outs = {}
+
+    def body(a):
+        src = a.buffer(data=ins[a.rank].copy())
+        dst = a.buffer((n,), np.float32)
+        a.allreduce(src, dst, n, **kw)
+        dst.sync_from_device()
+        outs[a.rank] = dst.data.copy()
+
+    run_ranks(accls, body, timeout=120.0)
+    return outs
+
+
+def _world_pair(W, **kw):
+    """(streamed world, serial-oracle world) context pairs."""
+    return (emu_world(W, timeout=30.0, nbufs=32, **kw),
+            emu_world(W, timeout=30.0, nbufs=32, pipeline_window=0,
+                      retx_window=0, **kw))
+
+
+# -- codec units ------------------------------------------------------------
+
+def test_qcode_table_pinned_to_protocol():
+    from accl_tpu.emulator.protocol import DTYPE_CODES
+    for name, code in quant._QCODES.items():
+        assert DTYPE_CODES[name] == code
+
+
+def test_clamp_block_pow2_envelope():
+    assert quant.clamp_block(1) == quant.MIN_BLOCK
+    assert quant.clamp_block(100) == 64           # round down to pow2
+    assert quant.clamp_block(128) == 128
+    assert quant.clamp_block(1 << 20) == quant.MAX_BLOCK
+
+
+def test_packed_roundtrip_and_layout():
+    rng = np.random.default_rng(7)
+    for qd in (np.dtype(np.int8), F8, F8W):
+        for n in (1, 31, 32, 33, 4097):
+            x = (rng.standard_normal(n) * 10).astype(np.float32)
+            p = quant.quantize_packed(x, qd, 32)
+            assert p.nbytes == quant.packed_nbytes(n, 32)
+            y = quant.dequantize_packed(p, n)
+            eps = EPS_Q[qd.name]
+            nb = quant.n_blocks(n, 32)
+            amax = np.concatenate(
+                [np.abs(x), np.zeros(nb * 32 - n, np.float32)]
+            ).reshape(nb, 32).max(1)
+            bound = np.repeat(amax * eps, 32)[:n] + 1e-30
+            assert (np.abs(x - y) <= bound).all(), qd.name
+
+
+def test_seg_elems_packed_fits_for_every_block():
+    """The planner's block-independent reservation: the packed segment
+    must fit max_segment_size for EVERY legal block size."""
+    for seg in (16, 256, 4096, 1 << 20):
+        n = quant.seg_elems(seg)
+        assert n >= 1
+        for block in (quant.MIN_BLOCK, 64, 128, quant.MAX_BLOCK):
+            if seg >= 16:
+                assert quant.packed_nbytes(n, block) <= max(seg, 13), \
+                    (seg, block)
+
+
+def test_malformed_payload_raises_typed():
+    x = np.ones(64, np.float32)
+    p = quant.quantize_packed(x, F8, 32)
+    bad = p.copy()
+    bad[0] ^= 0xFF                      # magic
+    with pytest.raises(quant.QuantFormatError):
+        quant.dequantize_packed(bad, 64)
+    with pytest.raises(quant.QuantFormatError):
+        quant.dequantize_packed(p, 63)  # count mismatch
+    with pytest.raises(quant.QuantFormatError):
+        quant.dequantize_packed(p[:-1], 64)  # truncated
+
+
+def test_native_numpy_bit_identity():
+    """The compiled codec is bit-identical to the numpy reference over a
+    corpus seeding +-0/NaN/inf (the PR-14 convention)."""
+    rng = np.random.default_rng(3)
+    x = np.concatenate([
+        (rng.standard_normal(9000) * rng.choice([1e-3, 1, 1e3], 9000))
+        .astype(np.float32),
+        np.array([np.inf, -np.inf, np.nan, 0.0, -0.0] * 8, np.float32)])
+    for qd in (np.dtype(np.int8), F8, F8W):
+        for block in (32, 128):
+            p = quant.quantize_packed(x, qd, block)     # native (if built)
+            s, q = quant._np_quantize(x, qd, block)     # reference
+            nb = s.size
+            assert p[8:8 + 4 * nb].view(np.float32).tobytes() == s.tobytes()
+            assert p[8 + 4 * nb:].tobytes() == q.view(np.uint8).tobytes()
+            y = quant.dequantize_packed(p)
+            assert y.tobytes() == quant._np_dequant(s, q, block).tobytes()
+            for f in ReduceFunc:
+                other = rng.standard_normal(x.size).astype(np.float32)
+                got = quant.dequant_combine_packed(p, other, f)
+                ref = quant._NP_FUNCS[f](other,
+                                         quant._np_dequant(s, q, block))
+                assert got.tobytes() == ref.tobytes(), (qd.name, f)
+
+
+# -- differential corpus: serial oracle vs streamed vs fabrics --------------
+
+@pytest.mark.parametrize("W", [3, 4, 8])
+@pytest.mark.parametrize("alg", [A.FUSED_RING, A.RECURSIVE_DOUBLING])
+def test_int8_streamed_exact_vs_quantized_serial_oracle(W, alg):
+    n = 1536
+    ins = _ins(W, n)
+    kw = dict(compress_dtype=np.int8, block_scale=64, algorithm=alg)
+    streamed, serial = _world_pair(W)
+    try:
+        got = _allreduce(streamed, ins, n, **kw)
+        oracle = _allreduce(serial, ins, n, **kw)
+    finally:
+        for a in streamed + serial:
+            a.deinit()
+    for r in range(W):
+        assert got[r].tobytes() == oracle[r].tobytes(), (W, alg, r)
+
+
+@pytest.mark.parametrize("qd", [F8, F8W], ids=["e4m3", "e5m2"])
+@pytest.mark.parametrize("W", [3, 4, 8])
+def test_fp8_error_bounded_vs_f32_oracle(W, qd):
+    """Typed bound: every hop requantizes the travelling partial once,
+    and accumulation is f32, so the end-to-end error of the fused ring
+    is <= (2W) * eps_q * max|running partial| per element (the
+    worst-case partial magnitude bounds every block's absmax)."""
+    n = 1024
+    ins = _ins(W, n)
+    streamed, serial = _world_pair(W)
+    try:
+        got = _allreduce(streamed, ins, n, compress_dtype=qd,
+                         block_scale=True)
+        oracle = _allreduce(serial, ins, n, compress_dtype=qd,
+                            block_scale=True)
+        exact = _allreduce(serial, ins, n)
+    finally:
+        for a in streamed + serial:
+            a.deinit()
+    for r in range(W):  # streamed == serial stays BIT-identical
+        assert got[r].tobytes() == oracle[r].tobytes(), (W, r)
+    del exact  # the f32 engine result; the bound compares against the
+    ex = np.sum(ins, axis=0)  # plain numpy sum (same up to f32 ordering
+    # noise, orders of magnitude under the fp8 bound)
+    # worst partial magnitude: running prefix sums in any rotation are
+    # bounded by the sum of per-rank magnitudes
+    part_max = np.sum(np.abs(np.stack(ins)), axis=0)
+    bound = 2 * W * EPS_Q[qd.name] * np.maximum(part_max, 1e-6)
+    err = np.abs(got[0] - ex)
+    assert (err <= bound).all(), (W, qd.name, float(err.max()))
+
+
+@pytest.mark.parametrize("stack", ["tcp", "udp", "shm"])
+def test_cross_fabric_bit_identity(stack):
+    """Local/TCP/UDP/Shm all land the identical block-scaled result —
+    the cross-fabric differential contract (PR-14 convention), now with
+    scale-block payloads riding each fabric's framing."""
+    W, n = 3, 640
+    ins = _ins(W, n)
+    kw = dict(compress_dtype=F8, block_scale=64)
+    accls = emu_world(W, timeout=30.0, nbufs=32)
+    try:
+        local = _allreduce(accls, ins, n, **kw)
+    finally:
+        for a in accls:
+            a.deinit()
+    accls = sim_world(W, nbufs=32, stack=stack)
+    try:
+        got = _allreduce(accls, ins, n, **kw)
+    finally:
+        for a in accls:
+            a.deinit()
+    for r in range(W):
+        assert got[r].tobytes() == local[r].tobytes(), (stack, r)
+
+
+def test_plan_cache_relocation_bit_identity():
+    """A quantized call served from the compiled-plan cache (second
+    issue, different buffers) lands bit-identically to the first."""
+    W, n = 4, 768
+    ins = _ins(W, n)
+    accls = emu_world(W, timeout=30.0, nbufs=32)
+    try:
+        first = _allreduce(accls, ins, n, compress_dtype=F8,
+                           block_scale=64)
+        stats0 = accls[0].plan_cache_stats()
+        second = _allreduce(accls, ins, n, compress_dtype=F8,
+                            block_scale=64)
+        stats1 = accls[0].plan_cache_stats()
+    finally:
+        for a in accls:
+            a.deinit()
+    for r in range(W):
+        assert first[r].tobytes() == second[r].tobytes()
+    assert stats1["hits"] > stats0["hits"]  # the relocation actually ran
+
+
+# -- validation -------------------------------------------------------------
+
+def test_block_scale_without_compress_dtype_raises():
+    accls = emu_world(2, timeout=10.0)
+    try:
+        src = accls[0].buffer(data=np.ones(8, np.float32))
+        dst = accls[0].buffer((8,), np.float32)
+        with pytest.raises(ValueError, match="block_scale"):
+            accls[0].allreduce(src, dst, 8, block_scale=True)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_block_scale_rejects_unquantizable_wire_dtype():
+    from accl_tpu.arith import ArithConfig
+    from accl_tpu.constants import CCLOp, StreamFlags
+    from accl_tpu.moveengine import MoveContext, expand_call
+    cfg = ArithConfig(np.dtype(np.float32), np.dtype(np.float16),
+                      quant_block=64)
+    ctx = MoveContext(world_size=2, local_rank=0, arithcfg=cfg,
+                      max_segment_size=1 << 20)
+    with pytest.raises(ValueError, match="int8/fp8"):
+        expand_call(ctx, CCLOp.allreduce, count=8,
+                    compression=(Compression.ETH_COMPRESSED
+                                 | Compression.BLOCK_SCALED))
+    # BLOCK_SCALED without ETH is malformed at every tier
+    cfg8 = ArithConfig(np.dtype(np.float32), F8, quant_block=64)
+    ctx8 = MoveContext(world_size=2, local_rank=0, arithcfg=cfg8,
+                       max_segment_size=1 << 20)
+    with pytest.raises(ValueError, match="ETH_COMPRESSED"):
+        expand_call(ctx8, CCLOp.allreduce, count=8,
+                    compression=Compression.BLOCK_SCALED)
+    with pytest.raises(ValueError, match="stream"):
+        expand_call(ctx8, CCLOp.send, count=8,
+                    compression=(Compression.ETH_COMPRESSED
+                                 | Compression.BLOCK_SCALED),
+                    stream=StreamFlags.OP0_STREAM)
+
+
+def test_compress_phases_validation_and_flat_strip():
+    """compress_phases="inter" on a FLAT call strips the compression
+    (no inter tier exists); a bogus selector raises."""
+    W, n = 2, 256
+    ins = _ins(W, n, scale_mix=False)
+    accls = emu_world(W, timeout=10.0)
+    try:
+        exact = _allreduce(accls, ins, n)
+        stripped = _allreduce(accls, ins, n, compress_dtype=F8,
+                              block_scale=True, compress_phases="inter")
+        for r in range(W):
+            assert stripped[r].tobytes() == exact[r].tobytes()
+        src = accls[0].buffer(data=ins[0].copy())
+        dst = accls[0].buffer((n,), np.float32)
+        with pytest.raises(ValueError, match="compress_phases"):
+            accls[0].allreduce(src, dst, n, compress_dtype=F8,
+                               compress_phases="outer")
+        # a stripped flat call is fully uncompressed, so explicit
+        # verify_integrity is VALID on it (the strip must run before
+        # the verify decision)
+        def body(a):
+            s = a.buffer(data=ins[a.rank].copy())
+            d = a.buffer((n,), np.float32)
+            a.allreduce(s, d, n, compress_dtype=F8, block_scale=True,
+                        compress_phases="inter", verify_integrity=True)
+        run_ranks(accls, body, timeout=60.0)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_plain_int8_narrowing_rejected_at_driver():
+    """The driver registry's (f32, int8) pair exists FOR the
+    block-scaled lane: a plain astype narrowing would truncate floats
+    silently, so `compress_dtype=int8` without `block_scale=` is
+    rejected at the call site. (The move ENGINE keeps its long-standing
+    astype semantics for hand-built configs — the property corpora pin
+    them — so the guard lives where the new registry entry made the
+    path reachable.)"""
+    accls = emu_world(2, timeout=10.0)
+    try:
+        src = accls[0].buffer(data=np.ones(8, np.float32))
+        dst = accls[0].buffer((8,), np.float32)
+        with pytest.raises(ValueError, match="block"):
+            accls[0].allreduce(src, dst, 8, compress_dtype=np.int8)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+# -- fusion + wire accounting ----------------------------------------------
+
+def test_cut_through_fusion_skipped_for_block_scaled():
+    """A block-scaled allgather's recv->relay pairs must NOT fuse (the
+    serial oracle requantizes the relay with fresh scales); the plain
+    program keeps its fusions."""
+    from accl_tpu.arith import ArithConfig
+    from accl_tpu.constants import CCLOp
+    from accl_tpu.emulator.executor import plan_skeleton
+    from accl_tpu.moveengine import MoveContext, expand_call
+
+    def fused_count(compression, cfg):
+        ctx = MoveContext(world_size=4, local_rank=1, arithcfg=cfg,
+                          max_segment_size=1 << 20)
+        moves = expand_call(ctx, CCLOp.allgather, count=64,
+                            addr_0=0x1000, addr_2=0x8000,
+                            compression=compression)
+        sk = plan_skeleton(moves)
+        return sum(1 for st in sk.steps if st.fuse >= 0)
+
+    plain = ArithConfig(np.dtype(np.float32), np.dtype(np.float32))
+    bs = ArithConfig(np.dtype(np.float32), F8, quant_block=64)
+    assert fused_count(Compression.NONE, plain) > 0
+    assert fused_count(Compression.ETH_COMPRESSED
+                       | Compression.BLOCK_SCALED, bs) == 0
+
+
+def test_wire_bytes_reduced_on_fabric():
+    """The fabric's tx_bytes counter proves the >=3x wire reduction the
+    bench ladder gates (small-scale twin of benchmarks/quantize.py)."""
+    W, n = 4, 64 << 10
+    ins = _ins(W, n, scale_mix=False)
+    accls = emu_world(W, timeout=30.0, nbufs=64, bufsize=1 << 20)
+    fab = accls[0].device.ctx.fabric
+    try:
+        b0 = fab.stats["tx_bytes"]
+        _allreduce(accls, ins, n)
+        full = fab.stats["tx_bytes"] - b0
+        b1 = fab.stats["tx_bytes"]
+        _allreduce(accls, ins, n, compress_dtype=F8, block_scale=128)
+        packed = fab.stats["tx_bytes"] - b1
+    finally:
+        for a in accls:
+            a.deinit()
+    assert full / packed >= 3.0, (full, packed)
+
+
+# -- chaos: scale headers ride the checksum/retx contract -------------------
+
+def test_corrupt_scale_recovers_like_corrupt_payload():
+    """A bit-flip INSIDE the scale header region (flip_at targets the
+    first scale word) must recover bit-identically through the
+    corrupt-as-loss machinery — never land as a silently mis-scaled
+    block."""
+    from accl_tpu.chaos import FaultPlan, FaultRule
+    from accl_tpu.tracing import METRICS
+
+    def integ():
+        snap = METRICS.snapshot()
+        return sum(snap["counters"].get("integrity_failed_total",
+                                        {}).values())
+
+    W, n = 3, 1024
+    ins = _ins(W, n)
+    kw = dict(compress_dtype=F8, block_scale=32)
+    accls = emu_world(W, timeout=30.0, nbufs=32)
+    try:
+        clean = _allreduce(accls, ins, n, **kw)
+    finally:
+        for a in accls:
+            a.deinit()
+    accls = emu_world(W, timeout=30.0, nbufs=32)
+    fab = accls[0].device.ctx.fabric
+    plan = FaultPlan([FaultRule(kind="corrupt_payload", every=3, offset=1,
+                                flip_at=quant.HDR_BYTES + 1)], seed=5)
+    fab.inject_fault(plan)
+    before = integ()
+    try:
+        got = _allreduce(accls, ins, n, **kw)
+    finally:
+        fab.clear_fault()
+        for a in accls:
+            a.deinit()
+    assert sum(plan.applied.values()) > 0
+    assert integ() > before       # the checksum tier actually engaged
+    for r in range(W):
+        assert got[r].tobytes() == clean[r].tobytes(), r
+
+
+def test_corrupt_scale_typed_at_retx_off():
+    """With recovery disabled (retx_window=0) a corrupted scale surfaces
+    as typed DATA_INTEGRITY_ERROR — never a silent wrong result."""
+    from accl_tpu.chaos import FaultPlan, FaultRule
+    W, n = 2, 512
+    ins = _ins(W, n, scale_mix=False)
+    accls = emu_world(W, timeout=3.0, nbufs=32, retx_window=0)
+    fab = accls[0].device.ctx.fabric
+    plan = FaultPlan([FaultRule(kind="corrupt_payload", every=1,
+                                flip_at=quant.HDR_BYTES)], seed=6)
+    fab.inject_fault(plan)
+    try:
+        with pytest.raises(ACCLError) as ei:
+            _allreduce(accls, ins, n, compress_dtype=F8, block_scale=32)
+        assert ei.value.error_word & int(ErrorCode.DATA_INTEGRITY_ERROR)
+    finally:
+        fab.clear_fault()
+        for a in accls:
+            a.deinit()
+
+
+# -- hierarchical per-phase compression -------------------------------------
+
+def _outer_oracle(host_sums, n, qd, block):
+    """Engine-run oracle for the quantized OUTER allreduce phase: a
+    2-rank serial-engine world reduces the per-host partial sums over
+    the block-scaled wire, exactly as the hier program's outer phase
+    does (aligned mode splits by inner index; we reproduce the aligned
+    plan's outer_j comms by running per-index vectors whole — each
+    outer phase is an ordinary 2-rank allreduce of its slice)."""
+    accls = emu_world(2, timeout=30.0, nbufs=32, pipeline_window=0,
+                      retx_window=0)
+    try:
+        outs = {}
+
+        def body(a):
+            src = a.buffer(data=host_sums[a.rank].copy())
+            dst = a.buffer((host_sums[a.rank].size,), np.float32)
+            a.allreduce(src, dst, host_sums[a.rank].size,
+                        compress_dtype=qd, block_scale=block)
+            dst.sync_from_device()
+            outs[a.rank] = dst.data.copy()
+
+        run_ranks(accls, body, timeout=60.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    return outs
+
+
+def test_hier_inter_only_intra_phases_exact():
+    """compress_phases="inter": composing EXACT numpy intra phases with
+    an engine-run quantized outer phase reproduces the full hier result
+    BIT-identically — the intra tier added no quantization error.
+    Integer-valued inputs make the f32 intra sums exact regardless of
+    reduction order, so any intra-phase quantization would be visible."""
+    hosts = [0, 0, 1, 1]
+    W, n, block = 4, 512, 32
+    rng = np.random.default_rng(9)
+    ins = [rng.integers(-8, 9, n).astype(np.float32) for _ in range(W)]
+    accls = emu_world(W, timeout=30.0, nbufs=32, hosts=hosts,
+                      pipeline_window=0, retx_window=0)
+    for a in accls:
+        a.configure_hierarchy(hosts)
+    try:
+        outs = {}
+
+        def body(a):
+            src = a.buffer(data=ins[a.rank].copy())
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n, algorithm=A.HIERARCHICAL,
+                        compress_dtype=F8, block_scale=block,
+                        compress_phases="inter")
+            dst.sync_from_device()
+            outs[a.rank] = dst.data.copy()
+
+        run_ranks(accls, body, timeout=120.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    # composed oracle: exact intra reduce_scatter -> quantized outer
+    # allreduce (per inner index j, over slice j) -> exact allgather.
+    # The aligned plan gives inner rank j the chunk [j*m:(j+1)*m] of its
+    # host's sum; outer comm j reduces that chunk across hosts. A
+    # quantized 2-rank allreduce's members legitimately hold DIFFERENT
+    # bytes (the owner keeps its unquantized chunk, the peer lands the
+    # requantized travel copy), so the composition is per HOST: host h's
+    # final vector gathers its members' outer-phase views.
+    m = n // 2
+    host_sum = [ins[0] + ins[1], ins[2] + ins[3]]  # exact in f32 (ints)
+    expect = [np.empty(n, np.float32) for _ in range(2)]
+    for j in range(2):
+        sl = slice(j * m, (j + 1) * m)
+        outer = _outer_oracle([host_sum[0][sl], host_sum[1][sl]], m, F8,
+                              block)
+        for h in range(2):
+            expect[h][sl] = outer[h]
+    for r, hosts_r in enumerate(hosts):
+        assert outs[r].tobytes() == expect[hosts_r].tobytes(), r
+
+
+def test_hier_quantized_streamed_matches_serial():
+    hosts = [0, 0, 1, 1]
+    W, n = 4, 1024
+    ins = _ins(W, n)
+
+    def run_world(**kw):
+        accls = emu_world(W, timeout=30.0, nbufs=32, hosts=hosts, **kw)
+        for a in accls:
+            a.configure_hierarchy(hosts)
+        try:
+            outs = {}
+
+            def body(a):
+                src = a.buffer(data=ins[a.rank].copy())
+                dst = a.buffer((n,), np.float32)
+                a.allreduce(src, dst, n, algorithm=A.HIERARCHICAL,
+                            compress_dtype=F8, block_scale=64,
+                            compress_phases="inter")
+                dst.sync_from_device()
+                outs[a.rank] = dst.data.copy()
+
+            run_ranks(accls, body, timeout=120.0)
+            return outs
+        finally:
+            for a in accls:
+                a.deinit()
+
+    streamed = run_world()
+    serial = run_world(pipeline_window=0, retx_window=0)
+    for r in range(W):
+        assert streamed[r].tobytes() == serial[r].tobytes(), r
+
+
+def test_hier_phase_wire_metrics():
+    from accl_tpu.tracing import METRICS
+    hosts = [0, 0, 1, 1]
+    W, n = 4, 256
+    ins = _ins(W, n, scale_mix=False)
+    accls = emu_world(W, timeout=30.0, nbufs=32, hosts=hosts)
+    for a in accls:
+        a.configure_hierarchy(hosts)
+
+    def rows():
+        snap = METRICS.snapshot()
+        return dict(snap["counters"].get("hier_phase_wire_total", {}))
+
+    before = rows()
+    try:
+        outs = {}
+
+        def body(a):
+            src = a.buffer(data=ins[a.rank].copy())
+            dst = a.buffer((n,), np.float32)
+            a.allreduce(src, dst, n, algorithm=A.HIERARCHICAL,
+                        compress_dtype=F8, block_scale=64,
+                        compress_phases="inter")
+            dst.sync_from_device()
+            outs[a.rank] = dst.data.copy()
+
+        run_ranks(accls, body, timeout=120.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    after = rows()
+
+    def delta(key):
+        return after.get(key, 0) - before.get(key, 0)
+
+    # 4 ranks x (inner-rs + inner-ag) full precision, 4 x outer quantized
+    assert delta("tier=intra,wire=full") == 8
+    assert delta("tier=inter,wire=quantized") == 4
+    assert delta("tier=intra,wire=quantized") == 0
+
+
+# -- tuner: quantized cost models + AUTO wire selection ---------------------
+
+def test_cost_quantized_crossover_pins():
+    """AUTO picks the quantized wire exactly in the bandwidth-bound band
+    and never for latency-bound calls (the acceptance pin)."""
+    from accl_tpu.tuner import Tuner
+    from accl_tpu.tuner.cost import (Topology, predict_quantized_us,
+                                     predict_us, rank_wire,
+                                     wire_byte_ratio)
+    t = Tuner()
+    for op in ("allreduce", "allgather", "reduce_scatter"):
+        assert t.select_wire(op, 4, 16 << 20) is True, op
+        assert t.select_wire(op, 4, 1 << 10) is False, op
+    assert t.select_wire("allreduce", 1, 16 << 20) is False  # 1-rank
+    # ratio includes the scale overhead
+    assert 3.5 < wire_byte_ratio(4, 1, 128) < 4.0
+    topo = Topology(world_size=8)
+    big, small = 16 << 20, 2 << 10
+    for alg in (A.FUSED_RING, A.RECURSIVE_DOUBLING):
+        q_big = predict_quantized_us("allreduce", alg, topo, big, 8)
+        p_big = predict_us("allreduce", alg, topo, big, 8)
+        assert q_big < p_big, alg
+        q_small = predict_quantized_us("allreduce", alg, topo, small, 8)
+        p_small = predict_us("allreduce", alg, topo, small, 8)
+        assert q_small > p_small, alg
+    quantize, alg = rank_wire("allreduce", topo, big, 8)
+    assert quantize and alg is not None
+    assert rank_wire("allreduce", topo, 1 << 10, 8)[0] is False
+
+
+def test_cost_quantized_hier_prices_inter_tier():
+    """On a two-tier mesh the quantized HIERARCHICAL variant scales only
+    the INTER beta (per-phase 'inter' mode is what the engine runs) —
+    and wins exactly when the inter tier is the bottleneck."""
+    from accl_tpu.hier.topology import MeshTopology
+    from accl_tpu.tuner.cost import predict_quantized_us, predict_us
+    mesh = MeshTopology.from_hosts([0, 0, 1, 1], inter_beta_gbps=0.05)
+    big = 16 << 20
+    q = predict_quantized_us("allreduce", A.HIERARCHICAL, mesh, big, 4)
+    p = predict_us("allreduce", A.HIERARCHICAL, mesh, big, 4)
+    assert q < p
+    # latency-bound: quantization only adds alpha/gamma
+    assert predict_quantized_us("allreduce", A.HIERARCHICAL, mesh,
+                                1 << 10, 4) \
+        > predict_us("allreduce", A.HIERARCHICAL, mesh, 1 << 10, 4)
+
+
+def test_driver_auto_wire_resolution():
+    """compress_dtype="auto": bandwidth-bound calls resolve to fp8
+    block-scaled, small calls to no compression — visible on the
+    prepared descriptor. A wire-bound Topology is pinned explicitly:
+    the emu device would otherwise bind its own in-process figures,
+    whose memcpy-speed beta correctly prices the codec out (quantizing
+    an in-process loopback buys nothing — also the model's answer)."""
+    from accl_tpu.tuner import Tuner
+    from accl_tpu.tuner.cost import Topology
+    accls = emu_world(2, timeout=10.0,
+                      tuner=Tuner(topology=Topology(beta_gbps=1.0)))
+    try:
+        a = accls[0]
+        small = a._resolve_wire("allreduce", a.comm, 256, np.float32,
+                                "auto", False)
+        assert small == (None, False)
+        big = a._resolve_wire("allreduce", a.comm, (16 << 20) // 4,
+                              np.float32, "auto", False)
+        assert big[0] == F8 and big[1] is True
+        # "auto" on a non-f32 call stays uncompressed instead of
+        # crashing a call that runs fine without compression
+        nonf32 = a._resolve_wire("allreduce", a.comm, (16 << 20) // 8,
+                                 np.float64, "auto", False)
+        assert nonf32 == (None, False)
+    finally:
+        for a in accls:
+            a.deinit()
+
+
+def test_recommend_quant_block_monotone():
+    from accl_tpu.tuner import Tuner
+    t = Tuner()
+    small = t.recommend_quant_block(32 << 10)
+    mid = t.recommend_quant_block(1 << 20)
+    big = t.recommend_quant_block(16 << 20)
+    assert small <= mid <= big
+    assert all(quant.clamp_block(b) == b for b in (small, mid, big))

@@ -379,11 +379,18 @@ def test_tune_harness_produces_table(tmp_path):
                    reps=1, cache_path=str(tmp_path / "tuning.json"))
     forced = [r for r in out["rows"] if r["source"] == "forced"]
     chosen = [r for r in out["rows"] if r["source"] == "chosen"]
+    # algorithm-sweep rows (the quantized-WIRE sweep's AUTO/AUTO+fp8-bs
+    # legs ride separate rows — filtered by the "+"/AUTO labels)
     assert {r["algorithm"] for r in forced
-            if r["op"] == "allreduce"} == {
+            if r["op"] == "allreduce"
+            and not r["algorithm"].startswith("AUTO")} == {
                 a.name for a in VALID_ALGORITHMS["allreduce"]
                 if a != A.HIERARCHICAL}  # driver-level program: the
     #             flat sweep world cannot force it (accl_tpu/hier)
+    # the quantized-wire sweep measured BOTH legs for the wire-capable op
+    assert {r["algorithm"] for r in forced if r["op"] == "allreduce"
+            and r["algorithm"].startswith("AUTO")} == {
+                "AUTO", "AUTO+fp8-bs"}
     assert len(chosen) == 2
     t = Tuner(topology=EMU_TOPO)
     assert cache.load_into(t, out["cache_path"]) >= 2
