@@ -1771,6 +1771,105 @@ class ACCL:
         return self._call(desc, run_async, waitfor, chain,
                           retries, retry_policy)
 
+    def alltoallv(self, srcbuf: ACCLBuffer, dstbuf: ACCLBuffer,
+                  send_counts: Sequence[int], recv_counts: Sequence[int], *,
+                  comm: Communicator | None = None, compress_dtype=None,
+                  block_scale: bool | int = False,
+                  run_async: bool = False, chain: bool = False,
+                  waitfor: Sequence[CallHandle] = (),
+                  retries: int | None = None,
+                  retry_policy: "RetryPolicy | None" = None
+                  ) -> CallHandle:
+        """Variable-count all-to-all (MPI_Alltoallv, contiguous
+        displacements): this rank sends ``send_counts[d]`` elements to
+        rank d from the d-th interval of ``srcbuf`` (intervals tile the
+        buffer in rank order) and receives ``recv_counts[s]`` elements
+        from rank s into the s-th interval of ``dstbuf``. Count vectors
+        must be pairwise consistent across ranks (rank i's
+        ``send_counts[j]`` == rank j's ``recv_counts[i]``) — that is a
+        cross-rank contract this driver cannot check locally; a mismatch
+        surfaces as a recv deadline or a DMA size error on the shorter
+        side. Zero-count peers exchange nothing (skewed MoE routing
+        routinely zeroes most of the vector). ``compress_dtype=``/
+        ``block_scale=`` ride the fp8 block-scaled wire exactly like the
+        fixed-count collectives ("auto" prices the quantized wire via
+        the tuner); ``chain=``/``waitfor=`` compose with the plan cache
+        so repeated uneven exchanges pipeline behind compute.
+
+        Overlapping ``srcbuf``/``dstbuf`` (in-place) are staged through
+        a scratch copy of the send region: uneven intervals can alias
+        across DIFFERENT peers' chunks, which no lane-local hazard edge
+        can order, so the engine is only ever given disjoint regions."""
+        comm = comm or self.comm
+        W = comm.size
+        send_counts = tuple(int(c) for c in send_counts)
+        recv_counts = tuple(int(c) for c in recv_counts)
+        if len(send_counts) != W or len(recv_counts) != W:
+            raise ValueError(
+                f"alltoallv count vectors must have comm.size={W} "
+                f"entries; got {len(send_counts)} send / "
+                f"{len(recv_counts)} recv")
+        if min(send_counts + recv_counts) < 0:
+            raise ValueError("alltoallv counts must be non-negative")
+        n_send, n_recv = sum(send_counts), sum(recv_counts)
+        if srcbuf.size < n_send or dstbuf.size < n_recv:
+            raise ValueError(
+                f"count vectors overflow their buffers: send needs "
+                f"{n_send} elems (srcbuf {srcbuf.size}), recv needs "
+                f"{n_recv} (dstbuf {dstbuf.size})")
+        count = max(n_send, n_recv)
+        compress_dtype, block_scale = self._resolve_wire(
+            "alltoallv", comm, count,
+            srcbuf.dtype if srcbuf.dtype == dstbuf.dtype else None,
+            compress_dtype, block_scale)
+        # uneven-exchange observability (docs/OBSERVABILITY.md): the
+        # count-vector shape is what distinguishes this op — record the
+        # port bytes and the skew (largest peer chunk over the even
+        # share) so a routing collapse (all tokens to one expert rank)
+        # is visible without a trace
+        METRICS.inc("alltoallv_total", rank=self.rank)
+        METRICS.inc("alltoallv_bytes_total",
+                    count * srcbuf.dtype.itemsize, rank=self.rank)
+        zero_peers = (sum(1 for c in send_counts if not c)
+                      + sum(1 for c in recv_counts if not c))
+        if zero_peers:
+            METRICS.inc("alltoallv_zero_peers_total", zero_peers,
+                        rank=self.rank)
+        if count:
+            cmax = max(max(send_counts), max(recv_counts))
+            METRICS.set_gauge("alltoallv_skew",
+                              round(cmax * W / count, 3), rank=self.rank)
+        src_arena = srcbuf
+        stage_pool = None
+        a0, a1 = srcbuf.address, srcbuf.address + srcbuf.nbytes
+        b0, b1 = dstbuf.address, dstbuf.address + dstbuf.nbytes
+        if n_send and a0 < b1 and b0 < a1:
+            if run_async:
+                # private recycled stage (the redistribute pool): a
+                # cached scratch would be shared by a second in-flight
+                # exchange whose staging copy could overwrite bytes this
+                # call's sends are still reading
+                pk = (srcbuf.size, srcbuf.dtype.name)
+                stage_pool = self._redist_stage_pool.setdefault(pk, [])
+                src_arena = stage_pool.pop() if stage_pool else \
+                    self.buffer((srcbuf.size,), srcbuf.dtype)
+            else:
+                src_arena = self._scratch(srcbuf.size, srcbuf.dtype)
+            cp = self.copy(srcbuf[0:n_send], src_arena[0:n_send], n_send,
+                           comm=comm, run_async=True, waitfor=waitfor)
+            waitfor = (cp,)
+        desc = self._prepare(CCLOp.alltoallv, count=count, comm=comm,
+                             op0=src_arena, res=dstbuf,
+                             compress_dtype=compress_dtype,
+                             block_scale=block_scale)
+        desc.counts = (send_counts, recv_counts)
+        ret = self._call(desc, run_async, waitfor, chain,
+                         retries, retry_policy)
+        if stage_pool is not None:
+            pool, buf = stage_pool, src_arena
+            ret.add_done_callback(lambda _err: pool.append(buf))
+        return ret
+
     def redistribute(self, srcbuf: ACCLBuffer, src_spec,
                      dstbuf: ACCLBuffer, dst_spec, *,
                      comm: Communicator | None = None,
@@ -1786,8 +1885,9 @@ class ACCL:
 
         The compiler (accl_tpu/hier/redistribute.py) lowers the spec
         pair to the minimal program the change admits — local slice
-        copies, one allgather, one alltoall, or rotated point-to-point
-        sends — and this driver executes it over ``comm`` (default: the
+        copies, one allgather, one alltoall, one alltoallv (dense
+        uneven block exchanges), or rotated point-to-point sends — and
+        this driver executes it over ``comm`` (default: the
         world). ``members`` restricts the exchange to a world-rank
         subset: the driver derives (and caches) the sub-communicator,
         and both specs must span ``len(members)`` ranks. Overlapping
@@ -1917,6 +2017,18 @@ class ACCL:
                 handles.append(self.alltoall(
                     _slice(src_arena, 0, src_count),
                     _slice(dstbuf, 0, dst_count), plan.coll_count,
+                    comm=comm, compress_dtype=compress_dtype,
+                    run_async=True, waitfor=waitfor))
+            elif plan.kind == "alltoallv":
+                # dense uneven reshard: the whole interval-ownership
+                # program is one variable-count collective (the plan's
+                # vectors tile the shards by construction, and src was
+                # staged above if in-place, so the collective never
+                # sees aliasing buffers)
+                handles.append(self.alltoallv(
+                    _slice(src_arena, 0, src_count),
+                    _slice(dstbuf, 0, dst_count),
+                    plan.send_counts, plan.recv_counts,
                     comm=comm, compress_dtype=compress_dtype,
                     run_async=True, waitfor=waitfor))
             else:

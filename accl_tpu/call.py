@@ -50,6 +50,11 @@ class CallDescriptor:
     # out for the caller cannot later be completed by late peers and
     # mutate the caller's buffers.
     deadline: Any = None
+    # alltoallv count vectors: (tuple(send_counts), tuple(recv_counts)),
+    # world_size elements each, in ELEMENTS of the uncompressed dtype.
+    # None for every fixed-count scenario. ``count`` is set to
+    # max(sum(send), sum(recv)) so size bounds hold without special cases.
+    counts: Any = None
     # Cross-call pipelining hint (the C++ driver's call_chain analog): the
     # caller asserts this async call's buffers are disjoint from the
     # still-draining predecessor's, so a backend MAY admit its move

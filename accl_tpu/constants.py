@@ -44,6 +44,13 @@ class CCLOp(enum.IntEnum):
     # existing 15-word wire format unchanged.
     put = 14
     get = 15
+    # variable-count all-to-all (MPI_Alltoallv shape): per-peer send/recv
+    # element counts ride OUTSIDE the fixed descriptor words as a count
+    # vector (CallDescriptor.counts; an optional trailing record on the
+    # socket wire). ``count`` still carries max(sum(send), sum(recv)) so
+    # every byte-bound check (MAX_CALL_BYTES, plan relocation extent)
+    # keeps working unchanged.
+    alltoallv = 16
     nop = 255
 
 

@@ -1140,7 +1140,7 @@ class EmuDevice(Device):
             root_src_dst=desc.root_src_dst, func=desc.function,
             tag=desc.tag, bases=(desc.addr_0, desc.addr_1, desc.addr_2),
             compression=desc.compression, stream=desc.stream_flags,
-            algorithm=desc.algorithm,
+            algorithm=desc.algorithm, counts=desc.counts,
             tenant=self.tenant_of_comm(desc.comm_id))
 
     def _prepare_program(self, desc: CallDescriptor, comm: Communicator):

@@ -616,7 +616,8 @@ class SimDevice(Device):
                            desc.addr_2 or 0, list(waitfor_ids),
                            algorithm=int(desc.algorithm),
                            qblock=(cfg.quant_block
-                                   if cfg is not None else 0))
+                                   if cfg is not None else 0),
+                           counts=desc.counts)
 
     def _submit(self, desc: CallDescriptor,
                 waitfor_ids: Sequence[int] = ()) -> int:

@@ -1511,7 +1511,7 @@ class RankDaemon:
                     comm_id=c["comm_id"], comm_epoch=self.comm_epoch,
                     root_src_dst=c["root"], func=func, tag=c["tag"],
                     bases=bases, compression=compression, stream=stream,
-                    algorithm=algorithm,
+                    algorithm=algorithm, counts=c.get("counts"),
                     streamed=(self.executor.window > 0
                               and self.executor.segment_stream),
                     tenant=(self.comm_tenants.get(c["comm_id"])

@@ -444,13 +444,28 @@ def pack_call(scenario: int, func: int, compression: int, stream: int,
               udtype: int, cdtype: int, count: int, comm_id: int, root: int,
               tag: int, addr0: int, addr1: int, addr2: int,
               waitfor: list[int], algorithm: int = 0,
-              qblock: int = 0) -> bytes:
+              qblock: int = 0, counts=None) -> bytes:
+    """``counts`` (alltoallv): an OPTIONAL trailing count-vector record
+    after the waitfor words — n u16, then n u64 send counts, then n u64
+    recv counts (element counts of the uncompressed dtype). Absent from
+    every fixed-count call, so older peers never see it; a peer that
+    doesn't understand the scenario rejects it typed by opcode, never by
+    frame shape (the pack_comm tenant-record convention)."""
     qlog = qblock.bit_length() - 1 if qblock > 0 else 0
     body = struct.pack(_CALL_FMT, scenario, func, compression, stream,
                        udtype, cdtype, algorithm, qlog, count, comm_id,
                        root, tag, addr0, addr1, addr2, len(waitfor))
-    return bytes([MSG_CALL]) + body + b"".join(
+    out = bytes([MSG_CALL]) + body + b"".join(
         struct.pack("<I", w) for w in waitfor)
+    if counts is not None:
+        send_counts, recv_counts = counts
+        n = len(send_counts)
+        if len(recv_counts) != n:
+            raise ValueError("send/recv count vectors must have equal length")
+        out += struct.pack("<H", n)
+        out += struct.pack(f"<{n}Q", *[int(c) for c in send_counts])
+        out += struct.pack(f"<{n}Q", *[int(c) for c in recv_counts])
+    return out
 
 
 def unpack_call(body: bytes) -> dict:
@@ -459,12 +474,25 @@ def unpack_call(body: bytes) -> dict:
      count, comm_id, root, tag, a0, a1, a2, nw) = struct.unpack(
         _CALL_FMT, body[:size])
     waitfor = list(struct.unpack(f"<{nw}I", body[size:size + 4 * nw]))
+    off = size + 4 * nw
+    counts = None
+    if off + 2 <= len(body):
+        (n,) = struct.unpack("<H", body[off:off + 2])
+        off += 2
+        if off + 16 * n > len(body):
+            # same loud-failure stance as unpack_comm: a truncated count
+            # vector must not silently become a shorter exchange
+            raise ValueError("truncated alltoallv count-vector record")
+        send_counts = struct.unpack(f"<{n}Q", body[off:off + 8 * n])
+        off += 8 * n
+        recv_counts = struct.unpack(f"<{n}Q", body[off:off + 8 * n])
+        counts = (send_counts, recv_counts)
     return dict(scenario=scenario, func=func, compression=compression,
                 stream=stream, udtype=udtype, cdtype=cdtype,
                 algorithm=algorithm, qblock=(1 << qlog) if qlog else 0,
                 count=count,
                 comm_id=comm_id, root=root, tag=tag, addr0=a0, addr1=a1,
-                addr2=a2, waitfor=waitfor)
+                addr2=a2, waitfor=waitfor, counts=counts)
 
 
 # -- communicator table -----------------------------------------------------

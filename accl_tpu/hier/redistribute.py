@@ -12,10 +12,19 @@ redistribution through portable collective communication", PAPERS.md):
   **alltoall** (both directions reduce to exactly the alltoall op's
   send-chunk-j-to-rank-j / chunk-from-i-lands-at-i*c layout — proved in
   the plan tests);
-* anything else (uneven blocks, permutations, subsets, grain changes)
+* uneven blocks whose exchange is DENSE (off-diagonal overlap pairs
+  >= W across the whole world) -> one **alltoallv**: for block->block
+  every rank's per-peer pieces tile its local shard contiguously in
+  ascending peer order — exactly the alltoallv count-vector layout —
+  so the whole interval-ownership p2p program collapses onto a single
+  laned collective (pipelined segment streaming, one plan-cache entry
+  keyed on the count signature, fp8 wire eligible);
+* anything else (sparse shifts, permutations, subsets, grain changes)
   -> **point-to-point** sends/recvs computed from interval ownership,
   rotated by peer distance to spread incast, eager sends before recvs
-  so no rendezvous cycle exists.
+  so no rendezvous cycle exists.  The density rule is computed from
+  the spec pair alone, so every rank lowers identically; a single
+  boundary shift stays exactly one p2p transfer (minimality pinned).
 
 The planner is pure geometry (specs + rank in, steps out), so the
 differential suite and ``scripts/check_blocking.py`` replay exactly
@@ -57,16 +66,27 @@ class RedistPlan:
 
     ``kind`` names the fast path taken: "noop" (nothing to do),
     "local" (slice copies only), "allgather" / "alltoall" (one
-    collective, ``coll_count`` elements per chunk), or "p2p" (the
-    generic ``steps`` program)."""
+    collective, ``coll_count`` elements per chunk), "alltoallv" (one
+    variable-count collective; ``send_counts`` / ``recv_counts`` are
+    this rank's per-peer element vectors, prefix-sums of which tile
+    the local src/dst shards), or "p2p" (the generic ``steps``
+    program)."""
 
     kind: str
     steps: tuple[RedistStep, ...] = ()
     coll_count: int = 0      # allgather/alltoall per-chunk elements
+    send_counts: tuple[int, ...] = ()   # alltoallv per-peer vectors
+    recv_counts: tuple[int, ...] = ()
+    rank: int = -1           # alltoallv: whose vectors (self chunk = rank)
 
     @property
     def wire_transfers(self) -> int:
-        """Cross-rank transfers this rank issues/receives (p2p only)."""
+        """Cross-rank transfers this rank issues/receives."""
+        if self.kind == "alltoallv":
+            return (sum(1 for j, c in enumerate(self.send_counts)
+                        if c and j != self.rank)
+                    + sum(1 for j, c in enumerate(self.recv_counts)
+                          if c and j != self.rank))
         return sum(1 for s in self.steps if s.kind in ("send", "recv"))
 
 
@@ -111,6 +131,57 @@ def _owner_pieces(src: ShardSpec, j: int, g0: int, cnt: int):
 def _is_even_block(spec: ShardSpec) -> bool:
     return (spec.kind == "block" and len(set(spec.counts)) == 1
             and spec.counts[0] > 0)
+
+
+def _block_offdiag_pairs(src: ShardSpec, dst: ShardSpec) -> int:
+    """Number of (src rank r, dst rank j), r != j, whose intervals
+    overlap — the whole exchange's cross-rank transfer count. A merge
+    walk over the two sorted boundary lists (O(W)); pure geometry of
+    the spec pair, so every rank computes the same number and the
+    dense-lowering decision below is world-uniform by construction."""
+    W = src.world
+    soff = [0]
+    doff = [0]
+    for r in range(W):
+        soff.append(soff[-1] + src.counts[r])
+        doff.append(doff[-1] + dst.counts[r])
+    pairs = 0
+    j = 0
+    for r in range(W):
+        if soff[r + 1] == soff[r]:
+            continue
+        # advance to the first dst interval reaching into src's
+        while doff[j + 1] <= soff[r]:
+            j += 1
+        k = j
+        while k < W and doff[k] < soff[r + 1]:
+            if doff[k + 1] > doff[k] and k != r:
+                pairs += 1
+            k += 1
+    return pairs
+
+
+def _alltoallv_vectors(src: ShardSpec, dst: ShardSpec, me: int):
+    """Rank ``me``'s per-peer (send_counts, recv_counts) for a
+    block->block change. Valid because each rank's src/dst shard is one
+    contiguous global interval: the pieces bound for ascending peers
+    tile the local shard contiguously in ascending order — exactly the
+    prefix-sum layout ``expand_alltoallv`` addresses. Pairwise
+    consistency (my send_counts[j] == j's recv_counts[me]) holds by
+    construction: both sides are |src_me ∩ dst_j|."""
+    W = src.world
+    soff = [0] * (W + 1)
+    doff = [0] * (W + 1)
+    for r in range(W):
+        soff[r + 1] = soff[r] + src.counts[r]
+        doff[r + 1] = doff[r] + dst.counts[r]
+    s0, s1 = soff[me], soff[me + 1]
+    d0, d1 = doff[me], doff[me + 1]
+    send = tuple(max(0, min(s1, doff[j + 1]) - max(s0, doff[j]))
+                 for j in range(W))
+    recv = tuple(max(0, min(d1, soff[r + 1]) - max(d0, soff[r]))
+                 for r in range(W))
+    return send, recv
 
 
 def _plan_block_block(src: ShardSpec, dst: ShardSpec,
@@ -192,6 +263,23 @@ def plan_redistribute(src: ShardSpec, dst: ShardSpec,
             and dst.counts[0] == W * src.chunk):
         return RedistPlan("alltoall", coll_count=src.chunk)
     if src.kind == "block" and dst.kind == "block":
+        # dense uneven exchange -> one alltoallv: when at least W
+        # off-diagonal interval pairs overlap (i.e. on average every
+        # rank owns a cross-rank transfer), the rotated p2p program is
+        # just an alltoallv spelled out move-by-move — lower it onto
+        # the collective so the engine lanes and pipelines the uneven
+        # segments like a fixed-size alltoall (and the wire gets one
+        # plan-cache entry keyed on the count signature instead of W
+        # p2p programs). BELOW the threshold the p2p path is kept: a
+        # boundary shift of k elements must stay exactly one k-element
+        # transfer per affected pair (minimality tests pin this), and
+        # a W-wide collective admission would be pure overhead for it.
+        if _block_offdiag_pairs(src, dst) >= W:
+            send, recv = _alltoallv_vectors(src, dst, me)
+            if not (any(send) or any(recv)):
+                return RedistPlan("noop")
+            return RedistPlan("alltoallv", send_counts=send,
+                              recv_counts=recv, rank=me)
         # block->block boundary shift — the membership grow/shrink
         # reshard shape (elastic world: ShardSpec.balanced over the old
         # and new member counts): computed from THIS rank's own

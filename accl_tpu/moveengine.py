@@ -1467,6 +1467,80 @@ def expand_alltoall(ctx: MoveContext, count: int, src: int, dst: int,
     return moves
 
 
+def expand_alltoallv(ctx: MoveContext, send_counts, recv_counts,
+                     src: int, dst: int,
+                     compression: Compression = Compression.NONE
+                     ) -> list[Move]:
+    """Variable-count all-to-all (MPI_Alltoallv shape): rank r sends
+    ``send_counts[d]`` elements to rank d from the d-th send interval and
+    receives ``recv_counts[s]`` elements from rank s into the s-th recv
+    interval; intervals are the prefix-sum tilings of the two count
+    vectors (the MPI contiguous-displacement special case — the only
+    layout the uneven-reshard fast path needs, and the one a wire count
+    vector can describe without a displacement vector).
+
+    Laning follows :func:`expand_alltoall`'s global-chunk convention —
+    lane = peer * S + seg — except S derives from the MAX per-peer count,
+    so the widest chunk's segments still get distinct lanes and no two
+    peers' lanes collide. Zero-count peers contribute no moves at all
+    (skewed MoE routing routinely zeroes most of the vector). Sends stay
+    non-blocking: no later move writes a send's source interval — recvs
+    write ``dst``, and the engine never sees ``src`` alias ``dst`` (the
+    DRIVER stages overlapping/in-place exchanges through scratch, because
+    uneven intervals can alias across DIFFERENT peers' chunks, which no
+    lane-local edge can order).
+    """
+    W, me = ctx.world_size, ctx.local_rank
+    if len(send_counts) != W or len(recv_counts) != W:
+        raise ValueError(
+            f"alltoallv count vectors must have world_size={W} entries; "
+            f"got {len(send_counts)} send / {len(recv_counts)} recv")
+    if min(send_counts, default=0) < 0 or min(recv_counts, default=0) < 0:
+        raise ValueError("alltoallv counts must be non-negative")
+    e_src = ctx.ebytes(bool(compression & Compression.OP0_COMPRESSED))
+    e_dst = ctx.ebytes(bool(compression & Compression.RES_COMPRESSED))
+    cmax = max(max(send_counts), max(recv_counts))
+    S = _chunk_lanes(ctx, cmax, compression)
+    # prefix sums: element offset of peer j's interval on each side
+    soff = [0] * (W + 1)
+    doff = [0] * (W + 1)
+    for j in range(W):
+        soff[j + 1] = soff[j] + int(send_counts[j])
+        doff[j + 1] = doff[j] + int(recv_counts[j])
+    moves: list[Move] = []
+    # self-exchange: laned local copy on peer ``me``'s lane block (same
+    # no-barrier rationale as expand_alltoall — nothing else touches the
+    # me-interval on either side)
+    if send_counts[me]:
+        self_mv = expand_copy(ctx, int(send_counts[me]),
+                              src + soff[me] * e_src,
+                              dst + doff[me] * e_dst, compression)
+        for m in self_mv:
+            m.lane = me * S
+        moves += self_mv
+    # round-robin step schedule (step s: send to me+s, recv from me-s) so
+    # uneven exchanges pipeline like the fixed-size alltoall: every rank
+    # pairs sender/receiver the same step, and per-peer lane blocks let
+    # the streamed executor interleave segments of different peers
+    for step in range(1, W):
+        to = (me + step) % W
+        frm = (me - step) % W
+        if send_counts[to]:
+            # non-rewritten source (Move.blocking): sends read src only,
+            # recvs write dst only, and the driver guarantees src never
+            # aliases dst (in-place exchanges are staged through scratch)
+            moves += expand_send(ctx, int(send_counts[to]),
+                                 src + soff[to] * e_src, to,
+                                 tag=TAG_ANY, compression=compression,
+                                 blocking=False, lane_base=to * S)
+        if recv_counts[frm]:
+            moves += expand_recv(ctx, int(recv_counts[frm]), frm,
+                                 dst + doff[frm] * e_dst,
+                                 tag=TAG_ANY, compression=compression,
+                                 lane_base=frm * S)
+    return moves
+
+
 # ---------------------------------------------------------------------------
 # Dispatch
 # ---------------------------------------------------------------------------
@@ -1518,8 +1592,8 @@ def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
                 addr_2: int = 0,
                 compression: Compression = Compression.NONE,
                 stream: StreamFlags = StreamFlags.NO_STREAM,
-                algorithm: CollectiveAlgorithm = CollectiveAlgorithm.AUTO
-                ) -> list[Move]:
+                algorithm: CollectiveAlgorithm = CollectiveAlgorithm.AUTO,
+                counts=None) -> list[Move]:
     """Dispatch a call descriptor to its expansion (see
     :func:`_expand_call_moves`), then apply the block-scaled wire
     post-pass: with ``Compression.BLOCK_SCALED`` every eth-compressed
@@ -1570,7 +1644,8 @@ def expand_call(ctx: MoveContext, scenario: CCLOp, *, count: int,
     moves = _expand_call_moves(
         ctx, scenario, count=count, root_src_dst=root_src_dst, func=func,
         tag=tag, addr_0=addr_0, addr_1=addr_1, addr_2=addr_2,
-        compression=compression, stream=stream, algorithm=algorithm)
+        compression=compression, stream=stream, algorithm=algorithm,
+        counts=counts)
     if compression & Compression.BLOCK_SCALED:
         for mv in moves:
             if mv.eth_compressed:
@@ -1586,8 +1661,8 @@ def _expand_call_moves(ctx: MoveContext, scenario: CCLOp, *, count: int,
                        compression: Compression = Compression.NONE,
                        stream: StreamFlags = StreamFlags.NO_STREAM,
                        algorithm: CollectiveAlgorithm = (
-                           CollectiveAlgorithm.AUTO)
-                       ) -> list[Move]:
+                           CollectiveAlgorithm.AUTO),
+                       counts=None) -> list[Move]:
     """Dispatch a call descriptor to its expansion.
 
     Parity: the firmware's run_accl() switch (ccl_offload_control.c:1155-1296)
@@ -1689,4 +1764,12 @@ def _expand_call_moves(ctx: MoveContext, scenario: CCLOp, *, count: int,
         return fn(ctx, count, func, addr_0, addr_2, compression)
     if scenario == CCLOp.alltoall:
         return expand_alltoall(ctx, count, addr_0, addr_2, compression)
+    if scenario == CCLOp.alltoallv:
+        if counts is None:
+            raise ValueError(
+                "alltoallv requires a (send_counts, recv_counts) pair "
+                "(CallDescriptor.counts / expand_call(counts=...))")
+        send_counts, recv_counts = counts
+        return expand_alltoallv(ctx, send_counts, recv_counts,
+                                addr_0, addr_2, compression)
     raise NotImplementedError(f"scenario {scenario!r}")

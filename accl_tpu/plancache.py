@@ -142,7 +142,7 @@ def compile_plan(*, scenario: CCLOp, count: int, world_size: int,
                  compression: Compression = Compression.NONE,
                  stream: StreamFlags = StreamFlags.NO_STREAM,
                  algorithm: CollectiveAlgorithm = CollectiveAlgorithm.AUTO,
-                 streamed: bool = True) -> CompiledPlan:
+                 streamed: bool = True, counts=None) -> CompiledPlan:
     """Expand one call against symbolic bases and derive its streamed plan
     skeleton. ``algorithm`` must already be CONCRETE for ops with an
     algorithm axis (see :func:`~.moveengine.resolve_algorithm`) — the
@@ -167,7 +167,7 @@ def compile_plan(*, scenario: CCLOp, count: int, world_size: int,
                         root_src_dst=root_src_dst, func=func, tag=tag,
                         addr_0=sym[0], addr_1=sym[1], addr_2=sym[2],
                         compression=compression, stream=stream,
-                        algorithm=algorithm)
+                        algorithm=algorithm, counts=counts)
     t0 = time.perf_counter()
     skeleton = None
     if streamed:
@@ -182,14 +182,19 @@ def plan_key(*, scenario: CCLOp, algorithm: CollectiveAlgorithm, count: int,
              local_rank: int, comm_epoch: int, compression: Compression,
              stream: StreamFlags, root_src_dst: int, func: ReduceFunc,
              tag: int, bases: tuple[int, int, int], max_segment_size: int,
-             streamed: bool) -> tuple:
+             streamed: bool, counts=None) -> tuple:
     """Cache key: every input that shapes the expansion or its plan.
     ``algorithm`` must be the CONCRETE algorithm the call will run (tuner
     re-resolution then lands on a different key). The three addresses
     enter only through their zero-ness (expansions branch on it) and
     aliasing pattern — concrete values are relocation inputs, not plan
-    shape."""
+    shape. ``counts`` (alltoallv) is the count-vector SIGNATURE: every
+    entry shapes offsets, lanes and zero-peer skipping, so the full pair
+    of tuples enters the key — two uneven exchanges share a plan exactly
+    when their vectors match element-for-element."""
     a0, a1, a2 = bases
+    csig = None if counts is None else (tuple(int(c) for c in counts[0]),
+                                        tuple(int(c) for c in counts[1]))
     return (int(scenario), int(algorithm), int(count),
             arithcfg.uncompressed_dtype.name, arithcfg.compressed_dtype.name,
             int(comm_id), int(world_size), int(local_rank), int(comm_epoch),
@@ -197,7 +202,7 @@ def plan_key(*, scenario: CCLOp, algorithm: CollectiveAlgorithm, count: int,
             int(tag),
             bool(a0), bool(a1), bool(a2),          # zero pattern
             a1 == a0, a2 == a0, a2 == a1,          # in-place aliasing
-            int(max_segment_size), bool(streamed))
+            int(max_segment_size), bool(streamed), csig)
 
 
 def cached_program(cache: "PlanCache", *, scenario: CCLOp, count: int,
@@ -210,7 +215,8 @@ def cached_program(cache: "PlanCache", *, scenario: CCLOp, count: int,
                   stream: StreamFlags = StreamFlags.NO_STREAM,
                   algorithm: CollectiveAlgorithm = CollectiveAlgorithm.AUTO,
                   tuner=None, streamed: bool = True,
-                  compile_missing: bool = True, tenant: str = ""):
+                  compile_missing: bool = True, tenant: str = "",
+                  counts=None):
     """The one program-preparation path shared by every tier (emu device,
     rank daemon, chained admission): resolve AUTO to the CONCRETE
     algorithm BEFORE building the key (the invariant that makes tuner
@@ -239,7 +245,8 @@ def cached_program(cache: "PlanCache", *, scenario: CCLOp, count: int,
                             root_src_dst=root_src_dst, func=func, tag=tag,
                             addr_0=bases[0], addr_1=bases[1],
                             addr_2=bases[2], compression=compression,
-                            stream=stream, algorithm=algorithm)
+                            stream=stream, algorithm=algorithm,
+                            counts=counts)
         t1 = time.perf_counter()
         skeleton = None
         if streamed:
@@ -257,7 +264,8 @@ def cached_program(cache: "PlanCache", *, scenario: CCLOp, count: int,
                    comm_epoch=comm_epoch, compression=compression,
                    stream=stream, root_src_dst=root_src_dst, func=func,
                    tag=tag, bases=bases,
-                   max_segment_size=max_segment_size, streamed=streamed)
+                   max_segment_size=max_segment_size, streamed=streamed,
+                   counts=counts)
     plan = cache.lookup(key)
     state, plan_us = "hit", 0.0
     if plan is None:
@@ -271,7 +279,7 @@ def cached_program(cache: "PlanCache", *, scenario: CCLOp, count: int,
                             root_src_dst=root_src_dst, func=func, tag=tag,
                             bases=bases, compression=compression,
                             stream=stream, algorithm=alg,
-                            streamed=streamed)
+                            streamed=streamed, counts=counts)
         plan_us = plan.plan_us
         cache.store(key, plan, tenant=tenant)
     moves = plan.bind(bases)

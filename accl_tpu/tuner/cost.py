@@ -40,7 +40,8 @@ from ..constants import CollectiveAlgorithm, VALID_ALGORITHMS
 
 __all__ = ["Topology", "predict_us", "rank_algorithms",
            "recommend_segment_size", "LEGACY_ALGORITHM_PAIRS",
-           "predict_quantized_us", "rank_wire", "wire_byte_ratio"]
+           "predict_quantized_us", "rank_wire", "wire_byte_ratio",
+           "predict_alltoallv_us", "WIRE_PRICED_OPS"]
 
 
 # (op, algorithm) pairs every execution tier has always implemented —
@@ -216,6 +217,55 @@ def _allgather_direct(topo: Topology, w: int, nbytes: float) -> float:
             + topo.incast * (w - 1) * topo.wire_us(nbytes))
 
 
+# -- algorithm-less wire-priced ops (alltoall / alltoallv) ------------------
+#
+# Neither op has an algorithm axis (VALID_ALGORITHMS omits them; only
+# AUTO is legal), but both still need a price so the WIRE decision
+# ("auto" compress_dtype -> fp8 block-scaled vs full precision) can rank
+# the quantized variant. The exchange is balanced across endpoints, so
+# no incast factor applies; the round-robin step schedule pipelines in
+# the streamed executor, so per-step software cost amortizes like the
+# allgather burst model (0.4 alpha per extra step).
+
+WIRE_PRICED_OPS = frozenset({"alltoall", "alltoallv"})
+
+
+def _alltoall_us(topo: Topology, w: int, nbytes: float) -> float:
+    """Balanced exchange, ``nbytes`` = per-pair chunk (the chunked-op
+    convention): W-1 pipelined steps, W-1 chunks through this rank's
+    injection port."""
+    return (topo.alpha_us * (1 + 0.4 * max(0, w - 2))
+            + (w - 1) * topo.wire_us(nbytes))
+
+
+def _alltoallv_us(topo: Topology, w: int, nbytes: float) -> float:
+    """Uneven exchange, ``nbytes`` = this rank's PORT bytes — the driver
+    keys the wire decision on max(sum(send), sum(recv)) elements (the
+    descriptor's ``count``), which is already the aggregate through the
+    port, not a per-pair chunk. Vector-aware pricing (zero-peer alpha
+    skipping) lives in :func:`predict_alltoallv_us`."""
+    return (topo.alpha_us * (1 + 0.4 * max(0, w - 2))
+            + topo.wire_us(nbytes))
+
+
+def predict_alltoallv_us(topo: Topology, send_counts, recv_counts,
+                         elem_bytes: int) -> float:
+    """Per-rank price of one uneven exchange given its count vectors:
+    one pipelined alpha per NONZERO peer interval (zero-count peers
+    expand to no moves at all — the skew case this op exists for) plus
+    this rank's port bytes (send and recv directions overlap on a
+    full-duplex port, so the max of the two totals bounds the wire
+    term). Deterministic in its inputs; the uneven-reshard fast path
+    and the tuner's wire ranking share this one formula."""
+    peers = (sum(1 for c in send_counts if c)
+             + sum(1 for c in recv_counts if c))
+    if peers == 0:
+        return 0.0
+    port_bytes = max(sum(send_counts), sum(recv_counts)) * elem_bytes
+    return (topo.alpha_us * (1 + 0.4 * max(0, peers - 1))
+            + topo.wire_us(port_bytes))
+
+
 def _reduce_tree(topo: Topology, w: int, nbytes: float) -> float:
     """ceil(log2 W) dependent rounds, full payload each (the bcast-tree
     shape run in reverse, with the folds spread across internal nodes)."""
@@ -384,6 +434,10 @@ _MODELS = {
     ("allreduce", _A.RECURSIVE_DOUBLING): _allreduce_rd,
     ("reduce_scatter", _A.RING): _ring_chain,
     ("reduce_scatter", _A.RECURSIVE_DOUBLING): _reduce_scatter_rh,
+    # algorithm-less ops carry AUTO on every tier; keyed so predict_us /
+    # predict_quantized_us / rank_wire price them without special cases
+    ("alltoall", _A.AUTO): _alltoall_us,
+    ("alltoallv", _A.AUTO): _alltoallv_us,
     ("bcast", _A.HIERARCHICAL): _bcast_hier,
     ("allgather", _A.HIERARCHICAL): _allgather_hier,
     ("allreduce", _A.HIERARCHICAL): _allreduce_hier,
@@ -477,7 +531,12 @@ def rank_wire(op: str, topo: Topology, nbytes: int,
     the cheapest quantized variant beats the cheapest full-precision
     one. Deterministic in its inputs — every rank of a collective must
     agree."""
-    plain = rank_algorithms(op, topo, nbytes, world_size)
+    if op in WIRE_PRICED_OPS:
+        # no algorithm axis: rank the one AUTO-priced variant directly
+        plain = [(_A.AUTO, predict_us(op, _A.AUTO, topo, nbytes,
+                                      world_size))]
+    else:
+        plain = rank_algorithms(op, topo, nbytes, world_size)
     if not plain:
         return False, None
     scored = []

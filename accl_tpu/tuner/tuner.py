@@ -215,8 +215,11 @@ class Tuner:
         — bandwidth-bound calls quantize, latency-bound calls never do.
         Sticky per bucket like algorithm decisions (every rank of a
         collective must agree), dropped by :meth:`refresh`."""
-        from .cost import rank_wire
-        if op not in VALID_ALGORITHMS or world_size <= 1:
+        from .cost import WIRE_PRICED_OPS, rank_wire
+        if (op not in VALID_ALGORITHMS and op not in WIRE_PRICED_OPS) \
+                or world_size <= 1:
+            # algorithm-less exchanges (alltoall/alltoallv) have no
+            # VALID_ALGORITHMS row but still carry a wire decision
             return False
         key = ("wire", op, int(world_size), nbytes_bucket(nbytes))
         with self._lock:
@@ -241,7 +244,10 @@ class Tuner:
         (quantized = BLOCK_SCALED ran). The per-bucket EWMA pair
         replaces the analytic crossover once both variants have
         evidence. Failed calls are ignored, like :meth:`observe`."""
-        if (error_word or op not in VALID_ALGORITHMS or world_size <= 1):
+        from .cost import WIRE_PRICED_OPS
+        if (error_word or world_size <= 1
+                or (op not in VALID_ALGORITHMS
+                    and op not in WIRE_PRICED_OPS)):
             return False
         key = ("wire", op, int(world_size), nbytes_bucket(nbytes))
         with self._lock:

@@ -1,0 +1,119 @@
+"""Compute-overlapped end-to-end workloads (ROADMAP item: workload
+scenarios gated on achieved overlap).
+
+Collective microbenchmarks measure the wire in isolation; what the
+paper's deployments care about is whether communication HIDES behind
+model compute. This package drives two real workload shapes through
+the driver's async/chained call path with host-side compute between
+the calls, and measures the overlap it actually achieved:
+
+* :mod:`~accl_tpu.workloads.ring_attention` — long-context attention
+  over a ring: block k's KV rotation (send + chained recv) is in
+  flight while block k-1's attention matmul runs;
+* :mod:`~accl_tpu.workloads.moe` — expert-parallel MoE: skewed top-1
+  routing lowered onto ``alltoallv`` dispatch/combine (the dispatch
+  leg optionally fp8 block-scaled), microbatched so chunk c+1's
+  dispatch and chunk c's combine ride under chunk c's expert matmul.
+
+The measurement is the :class:`OverlapMeter`: every issued
+communication handle is stamped at issue and at completion (done
+callback), and the time the workload then actually BLOCKS in
+``wait()`` is its exposed communication. ``overlap_frac`` = hidden /
+total in-flight time — 1.0 when every transfer retired under compute,
+0.0 for a fully serial issue-wait-compute loop. This is the workload-
+level complement of the per-call ``CallRecord.overlap_frac`` (combine
+time hidden behind wire activity, docs/OBSERVABILITY.md): that metric
+sees inside one streamed collective; this one sees across the
+compute/communication boundary the engine cannot observe.
+
+``make bench-emu`` runs both workloads (benchmarks/workloads.py) and
+gates on the measured overlap via ``$ACCL_BENCH_MIN_OVERLAP_FRAC``.
+
+Metric families (registry: accl_tpu.tracing.METRICS):
+
+* ``workload_overlap_frac`` (gauge; rank, workload) — last run's
+  achieved overlap;
+* ``workload_steps_total`` (counter; rank, workload) — compute steps
+  driven;
+* ``workload_comm_us_total`` / ``workload_exposed_us_total``
+  (counters; rank, workload) — in-flight vs exposed-blocking
+  communication time, the overlap ratio's raw numerator inputs.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..tracing import METRICS
+
+__all__ = ["OverlapMeter", "ring_attention", "moe"]
+
+
+class OverlapMeter:
+    """Ledger of issued communication vs time spent blocked on it.
+
+    Usage: ``meter.issue(handle)`` right after an async call is
+    issued; ``meter.wait(handle)`` instead of ``handle.wait()`` when
+    the workload needs the result. Completion instants come from the
+    handle's done callback, so a transfer that retires mid-compute is
+    credited its true in-flight span even though the workload only
+    looks at it later."""
+
+    def __init__(self):
+        self._recs: dict[int, dict] = {}
+        self.exposed_s = 0.0
+
+    def issue(self, handle):
+        rec = {"t0": time.perf_counter(), "t1": None}
+        self._recs[id(handle)] = rec
+
+        def _done(_err, r=rec):
+            r["t1"] = time.perf_counter()
+        handle.add_done_callback(_done)
+        return handle
+
+    def wait(self, handle):
+        t0 = time.perf_counter()
+        handle.wait()
+        dt = time.perf_counter() - t0
+        self.exposed_s += dt
+        rec = self._recs.get(id(handle))
+        if rec is not None and rec["t1"] is None:
+            # callback raced the waiter: the wait return IS completion
+            rec["t1"] = time.perf_counter()
+        return dt
+
+    @property
+    def comm_s(self) -> float:
+        now = time.perf_counter()
+        return sum((r["t1"] if r["t1"] is not None else now) - r["t0"]
+                   for r in self._recs.values())
+
+    @property
+    def overlap_frac(self) -> float:
+        """Fraction of total in-flight communication hidden behind the
+        workload's own compute: 1 - exposed/in-flight, clamped to
+        [0, 1]. 1.0 when nothing was issued (no comm to expose)."""
+        total = self.comm_s
+        if total <= 0.0:
+            return 1.0
+        return max(0.0, min(1.0, 1.0 - self.exposed_s / total))
+
+    def publish(self, rank: int, workload: str, steps: int) -> dict:
+        """Push this run's ledger into the metrics registry and return
+        the stats dict the workload hands back to its caller."""
+        of = round(self.overlap_frac, 4)
+        METRICS.set_gauge("workload_overlap_frac", of, rank=rank,
+                          workload=workload)
+        METRICS.inc("workload_steps_total", steps, rank=rank,
+                    workload=workload)
+        METRICS.inc("workload_comm_us_total",
+                    round(self.comm_s * 1e6), rank=rank, workload=workload)
+        METRICS.inc("workload_exposed_us_total",
+                    round(self.exposed_s * 1e6), rank=rank,
+                    workload=workload)
+        return {"overlap_frac": of, "comm_s": self.comm_s,
+                "exposed_s": self.exposed_s, "steps": steps}
+
+
+from . import moe, ring_attention  # noqa: E402  (public submodules)

@@ -1,0 +1,139 @@
+"""Ring attention: long-context attention with KV rotation hidden
+behind the attention matmul.
+
+The sequence is sharded over the ring: rank r holds a query block Q_r
+and a KV block (K_r, V_r). Every rank computes attention of its
+queries against ALL KV blocks by rotating the KV pair one hop per
+step — and because softmax admits an online (streaming) formulation,
+each rotated block folds into a running (max, denominator, numerator)
+accumulator without ever materializing the full score matrix
+("Ring Attention with Blockwise Transformers", PAPERS.md).
+
+The overlap structure is the point: at step k the NEXT block's
+rotation (async send + chained recv, double-buffered) is already in
+flight while THIS block's matmuls run, so the wire time disappears
+under compute for any sequence long enough that the matmul dominates.
+``overlap=False`` degrades to the serial rotate-then-compute loop —
+the bench's baseline leg.
+
+Accumulation runs in float64 regardless of the buffer dtype, so the
+result matches :func:`ring_attention_reference` to float32 rtol even
+though the blocks arrive in ring order rather than sequence order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ring_attention_forward", "ring_attention_reference"]
+
+
+def ring_attention_reference(q: np.ndarray, k: np.ndarray,
+                             v: np.ndarray) -> np.ndarray:
+    """Serial oracle: plain softmax(Q K^T / sqrt(d)) V over the FULL
+    key/value sequence, float64 internally."""
+    q64 = q.astype(np.float64)
+    k64 = k.astype(np.float64)
+    v64 = v.astype(np.float64)
+    s = (q64 @ k64.T) / np.sqrt(q.shape[-1])
+    s -= s.max(axis=-1, keepdims=True)
+    p = np.exp(s)
+    return ((p @ v64) / p.sum(axis=-1, keepdims=True)).astype(q.dtype)
+
+
+def _fold_block(q64, kblk, vblk, m, l, acc, scale):
+    """One online-softmax update: fold a KV block into the running
+    (row max ``m``, denominator ``l``, numerator ``acc``)."""
+    s = (q64 @ kblk.astype(np.float64).T) * scale
+    m_new = np.maximum(m, s.max(axis=-1))
+    corr = np.exp(m - m_new)
+    p = np.exp(s - m_new[:, None])
+    l[:] = l * corr + p.sum(axis=-1)
+    acc[:] = acc * corr[:, None] + p @ vblk.astype(np.float64)
+    m[:] = m_new
+
+
+def ring_attention_forward(a, q: np.ndarray, k: np.ndarray,
+                           v: np.ndarray, *, comm=None,
+                           compress_dtype=None,
+                           block_scale: bool | int = False,
+                           overlap: bool = True, use_chain: bool = True,
+                           meter=None):
+    """Forward pass of ring attention on driver ``a``.
+
+    ``q``/``k``/``v`` are this rank's blocks, shape (block_len, d) —
+    every rank's KV block must have the SAME shape (the rotation is a
+    fixed-size exchange; uneven sequence shards belong to
+    :func:`accl_tpu.workloads.moe`-style alltoallv routing). Returns
+    ``(out, stats)``: the attention output for the local queries and
+    the meter's stats dict (``overlap_frac`` et al.).
+
+    Rotation protocol per step: pack (K, V) in one buffer, async-send
+    it to the next ring neighbour and post the paired recv CHAINED
+    behind it (``chain=True`` — the device admits the recv while the
+    send drains, no host round trip on the rotation's critical path),
+    then run the attention matmul on the CURRENT block. The sends are
+    eager, so the W-cycle cannot rendezvous-deadlock. Double
+    buffering makes the in-flight recv land in the buffer compute is
+    NOT reading."""
+    from . import OverlapMeter
+    comm = comm or a.comm
+    W, me = comm.size, comm.local_rank
+    if k.shape != v.shape or k.ndim != 2 or q.ndim != 2 \
+            or q.shape[1] != k.shape[1]:
+        raise ValueError(
+            f"q/k/v must be (block_len, d) with one d: got q "
+            f"{q.shape}, k {k.shape}, v {v.shape}")
+    lkv, d = k.shape
+    scale = 1.0 / np.sqrt(d)
+    meter = meter if meter is not None else OverlapMeter()
+
+    q64 = q.astype(np.float64)
+    m = np.full(q.shape[0], -np.inf)
+    l = np.zeros(q.shape[0])
+    acc = np.zeros((q.shape[0], d))
+
+    if W == 1:
+        _fold_block(q64, k, v, m, l, acc, scale)
+        stats = meter.publish(a.rank, "ring_attention", steps=1)
+        return (acc / l[:, None]).astype(q.dtype), stats
+
+    n = 2 * lkv * d
+    cur = a.buffer((n,), np.float32)
+    nxt = a.buffer((n,), np.float32)
+    cur.data[:lkv * d] = k.astype(np.float32).ravel()
+    cur.data[lkv * d:] = v.astype(np.float32).ravel()
+    nxt_rank = (me + 1) % W
+    prv_rank = (me - 1) % W
+
+    for step in range(W):
+        inflight = None
+        if step < W - 1:
+            # rotate BEFORE computing: the pair is on the wire for the
+            # whole matmul below. Tag by step so a slow rank's frame
+            # cannot be claimed by the next step's TAG_ANY recv.
+            hs = a.send(cur, n, nxt_rank, tag=step, comm=comm,
+                        compress_dtype=compress_dtype,
+                        block_scale=block_scale, run_async=True)
+            hr = a.recv(nxt, n, prv_rank, tag=step, comm=comm,
+                        compress_dtype=compress_dtype,
+                        block_scale=block_scale, run_async=True,
+                        chain=use_chain)
+            meter.issue(hs)
+            meter.issue(hr)
+            inflight = (hs, hr)
+            if not overlap:
+                # serial baseline: expose the whole rotation
+                meter.wait(hs)
+                meter.wait(hr)
+        kblk = cur.data[:lkv * d].reshape(lkv, d)
+        vblk = cur.data[lkv * d:].reshape(lkv, d)
+        _fold_block(q64, kblk, vblk, m, l, acc, scale)
+        if inflight is not None:
+            if overlap:
+                for h in inflight:
+                    meter.wait(h)
+            cur, nxt = nxt, cur
+
+    stats = meter.publish(a.rank, "ring_attention", steps=W)
+    return (acc / l[:, None]).astype(q.dtype), stats

@@ -141,6 +141,17 @@ def bench_emu_fallback(reason: str) -> dict:
         sh = shm_headline()
         for k in _SHM_KEYS:
             result[k] = sh[k]
+    if os.environ.get("ACCL_BENCH_MIN_OVERLAP_FRAC"):
+        # compute-overlapped workload ladder (~30s): ring attention +
+        # MoE alltoallv dispatch/combine on the throttled wire, serial
+        # legs interleaved for contrast, both hard-raising on oracle
+        # divergence. Only when the gate is armed (make bench-emu),
+        # keep-ungated-runs-fast rule.
+        from benchmarks.workloads import WORKLOAD_KEYS, \
+            headline as wl_headline
+        wl = wl_headline()
+        for k in WORKLOAD_KEYS:
+            result[k] = wl[k]
     if os.environ.get("ACCL_BENCH_MIN_QUANT_WIRE_RATIO"):
         # quantized-wire ladder (~8s of emulated wire sleeps): fp8
         # block-scaled vs f32 16 MiB allreduce on a wire-dominated link
@@ -258,6 +269,36 @@ def check_quant_ratios(result: dict) -> int:
               file=sys.stderr)
         rc = 1
     return rc
+
+
+def _workload_gate_value(result: dict) -> float:
+    """The gated quantity: the WORSE of the two workloads' pooled
+    overlap fractions (a workload that stopped hiding its wire must
+    fail the gate even if the other still does)."""
+    return min(result.get("ring_attn_overlap_frac", float("inf")),
+               result.get("moe_overlap_frac", float("inf")))
+
+
+def check_overlap_frac(result: dict) -> int:
+    """Regression gate for compute/communication overlap: with
+    $ACCL_BENCH_MIN_OVERLAP_FRAC set (make bench-emu sets 0.45), both
+    workload scenarios (ring attention's KV rotation, MoE's alltoallv
+    dispatch/combine pipeline) must hide at least that fraction of
+    their in-flight communication behind their own matmuls — measured
+    ~0.7 on the CI host (benchmarks/workloads.py documents the GIL
+    ceiling), so the floor only fails when the async path genuinely
+    serialized. The ladder hard-raises on oracle divergence, so a
+    passing fraction is also a correctness statement."""
+    want = os.environ.get("ACCL_BENCH_MIN_OVERLAP_FRAC")
+    if not want or "ring_attn_overlap_frac" not in result:
+        return 0
+    got = _workload_gate_value(result)
+    if got >= float(want):
+        return 0
+    print(f"FAIL: workload overlap fraction {got} < required {want} "
+          f"(ring {result.get('ring_attn_overlap_frac')}, moe "
+          f"{result.get('moe_overlap_frac')})", file=sys.stderr)
+    return 1
 
 
 def check_combine_ratio(result: dict) -> int:
@@ -930,6 +971,29 @@ def main():
                           "quant_throttled"):
                     result[k] = retry_q[k]
             result["quant_retry"] = result.get("quant_retry", 0) + 1
+        wl_want = os.environ.get("ACCL_BENCH_MIN_OVERLAP_FRAC")
+        for _ in range(_GATE_RETRIES):
+            # best-of-three for the workload-overlap gate too: only
+            # its ladder re-runs, each workload keeping its best
+            # observed fraction (the overlap measurement is the one
+            # most exposed to host scheduling noise — a genuinely
+            # serialized async path fails every attempt)
+            if not (wl_want
+                    and _workload_gate_value(result) < float(wl_want)):
+                break
+            from benchmarks.workloads import headline as wl_headline
+            retry_wl = wl_headline()
+            improved = [k for k in ("ring_attn_overlap_frac",
+                                    "moe_overlap_frac")
+                        if retry_wl.get(k, 0) > result.get(k, 0)]
+            for k in improved:
+                result[k] = retry_wl[k]
+            if improved:
+                for k in ("ring_attn_serial_frac", "ring_attn_speedup",
+                          "moe_serial_frac", "moe_speedup",
+                          "moe_fp8_err", "workload_throttled"):
+                    result[k] = retry_wl[k]
+            result["workload_retry"] = result.get("workload_retry", 0) + 1
         csum_want = os.environ.get("ACCL_BENCH_MAX_CSUM_OVERHEAD")
         for _ in range(_GATE_RETRIES):
             # best-of-three for the checksum-overhead gate too: only
@@ -960,6 +1024,7 @@ def main():
                  or check_shm_ratio(result)
                  or check_combine_ratio(result)
                  or check_quant_ratios(result)
+                 or check_overlap_frac(result)
                  or check_fabric_clean(result))
     if not _probe_backend():
         # the bench contract is ONE valid JSON line with a real metric:

@@ -1,0 +1,217 @@
+"""Compute-overlapped workload ladder: ring attention + MoE on the
+emulated slow wire, gated on achieved overlap.
+
+Two end-to-end scenarios from accl_tpu/workloads/ run through ONE
+in-process emulator world with a throttled fabric (the quantize
+ladder's convention — wire time must be real or "overlap" measures
+nothing). Each runs an OVERLAPPED leg (rotation/dispatch in flight
+under the attention/expert matmuls) and a SERIAL leg (same calls,
+waited at issue), interleaved so host drift hits both:
+
+* **ring attention** — W sequence blocks, KV pair rotated per step
+  (async send + chained recv, double-buffered) while the online-
+  softmax matmul folds the current block;
+* **MoE dispatch/combine** — skewed top-1 routing onto ``alltoallv``,
+  microbatched so chunk c+1's dispatch and chunk c's combine hide
+  under chunk c's expert matmul; one extra dispatch-leg run on the
+  fp8 block-scaled wire checks the quantized path stays in bounds.
+
+Both legs hard-raise on divergence from their serial numpy oracles
+(`ring_attention_reference` / `moe_reference`) before any ratio is
+believed.
+
+Gated quantity (make bench-emu): the WORSE of the two overlapped
+legs' pooled overlap fractions (sum of hidden in-flight time over sum
+of in-flight time, across ranks and iterations) must clear
+``$ACCL_BENCH_MIN_OVERLAP_FRAC``. make bench-emu sets 0.45 — a
+no-collapse floor under the ~0.7 measured: the numpy matmuls and the
+executor threads share the CI host's two cores (the GIL hands the
+wire its cycles only between BLAS calls), so the ceiling is well
+below the ideal 1.0, and the floor must only fail when communication
+genuinely stopped hiding — a serialized driver, a rotation waiting
+at issue, a dead chunk pipeline. The serial legs measure ~0.0-0.3
+for contrast."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import ml_dtypes
+import numpy as np
+
+from accl_tpu.testing import emu_world, run_ranks
+from accl_tpu.workloads import OverlapMeter
+from accl_tpu.workloads.moe import moe_dispatch_combine, moe_reference
+from accl_tpu.workloads.ring_attention import (ring_attention_forward,
+                                               ring_attention_reference)
+
+WORLD = 4
+# slow-wire figures: a few hundred us per KV rotation / dispatch
+# chunk at 0.5 GB/s — large enough that a serial leg visibly stalls,
+# small enough that the matmuls (~5-20 ms each on the CI host)
+# dominate the overlapped leg
+WIRE_ALPHA_US = 100.0
+WIRE_BETA_GBPS = 0.5
+RING_L, RING_D = 320, 64
+MOE_T, MOE_D, MOE_HIDDEN, MOE_CHUNKS = 256, 64, 256, 4
+WORKLOAD_KEYS = ("ring_attn_overlap_frac", "ring_attn_serial_frac",
+                 "ring_attn_speedup", "moe_overlap_frac",
+                 "moe_serial_frac", "moe_speedup", "moe_fp8_err",
+                 "moe_skew", "workload_throttled", "workload_world")
+
+
+def _bench_expert(rank: int, d: int, hidden: int):
+    """A heavier expert than the workload default — a real MLP block
+    (d -> hidden -> d), so per-chunk compute is milliseconds and the
+    overlap leg has something to hide the dispatch under."""
+    rng = np.random.default_rng(2000 + rank)
+    w1 = rng.standard_normal((d, hidden)).astype(np.float32) / np.sqrt(d)
+    w2 = rng.standard_normal((hidden, d)).astype(np.float32) \
+        / np.sqrt(hidden)
+
+    def f(x: np.ndarray) -> np.ndarray:
+        return np.tanh(x @ w1) @ w2
+    return f
+
+
+def _pooled(meters: list[OverlapMeter]) -> float:
+    comm = sum(m.comm_s for m in meters)
+    exposed = sum(m.exposed_s for m in meters)
+    if comm <= 0.0:
+        return 1.0
+    return max(0.0, min(1.0, 1.0 - exposed / comm))
+
+
+def workloads_headline(iters: int = 3) -> dict:
+    rng = np.random.default_rng(17)
+    q = [rng.standard_normal((RING_L, RING_D)).astype(np.float32)
+         for _ in range(WORLD)]
+    k = [rng.standard_normal((RING_L, RING_D)).astype(np.float32)
+         for _ in range(WORLD)]
+    v = [rng.standard_normal((RING_L, RING_D)).astype(np.float32)
+         for _ in range(WORLD)]
+    ring_oracle = [ring_attention_reference(q[r], np.concatenate(k),
+                                            np.concatenate(v))
+                   for r in range(WORLD)]
+    toks = [rng.standard_normal((MOE_T, MOE_D)).astype(np.float32)
+            for _ in range(WORLD)]
+    # skewed top-1 routing, hot expert rotated per rank so every
+    # expert rank sees load and every vector is genuinely uneven
+    dest = [rng.choice(WORLD, size=MOE_T,
+                       p=np.roll([0.55, 0.25, 0.15, 0.05], r))
+            for r in range(WORLD)]
+    experts = [_bench_expert(r, MOE_D, MOE_HIDDEN) for r in range(WORLD)]
+    moe_oracle = moe_reference(toks, dest, experts)
+
+    accls = emu_world(WORLD, timeout=120.0, nbufs=64)
+    fab = accls[0].device.ctx.fabric
+    for s in range(WORLD):
+        for d in range(WORLD):
+            if s != d:
+                fab.set_link_profile(s, d, WIRE_ALPHA_US, WIRE_BETA_GBPS)
+
+    meters = {("ring", True): [], ("ring", False): [],
+              ("moe", True): [], ("moe", False): []}
+    times = {key: [] for key in meters}
+    fp8_err = {"max": 0.0}
+
+    def ring_leg(ov: bool, measure: bool):
+        ms = [OverlapMeter() for _ in range(WORLD)]
+
+        def body(a):
+            out, _ = ring_attention_forward(
+                a, q[a.rank], k[a.rank], v[a.rank], overlap=ov,
+                meter=ms[a.rank])
+            np.testing.assert_allclose(out, ring_oracle[a.rank],
+                                       rtol=2e-5, atol=2e-6)
+        t0 = time.perf_counter()
+        run_ranks(accls, body, timeout=600.0)
+        if measure:
+            times[("ring", ov)].append(time.perf_counter() - t0)
+            meters[("ring", ov)] += ms
+
+    def moe_leg(ov: bool, measure: bool, fp8: bool = False):
+        ms = [OverlapMeter() for _ in range(WORLD)]
+        wire = dict(compress_dtype=np.dtype(ml_dtypes.float8_e4m3fn),
+                    block_scale=True) if fp8 else {}
+
+        def body(a):
+            out, _ = moe_dispatch_combine(
+                a, toks[a.rank], dest[a.rank], n_chunks=MOE_CHUNKS,
+                expert_fn=experts[a.rank], overlap=ov,
+                meter=ms[a.rank], **wire)
+            err = float(np.abs(out - moe_oracle[a.rank]).max())
+            if fp8:
+                # dispatch activations crossed the fp8 block-scaled
+                # wire: bounded error through the expert (measured
+                # ~1e-2; tanh keeps outputs in [-1, 1]), hard-raise
+                # well above it
+                if err > 0.25:
+                    raise AssertionError(
+                        f"fp8 dispatch leg exceeded error bound: {err}")
+                fp8_err["max"] = max(fp8_err["max"], err)
+            elif err != 0.0 and not np.allclose(
+                    out, moe_oracle[a.rank], rtol=1e-5, atol=1e-6):
+                raise AssertionError(
+                    f"moe leg diverged from the oracle by {err}")
+        t0 = time.perf_counter()
+        run_ranks(accls, body, timeout=600.0)
+        if measure and not fp8:
+            times[("moe", ov)].append(time.perf_counter() - t0)
+            meters[("moe", ov)] += ms
+
+    try:
+        ring_leg(True, measure=False)       # warm plan cache + pools
+        moe_leg(True, measure=False)
+        for i in range(iters):              # interleaved legs
+            order = (True, False) if i % 2 == 0 else (False, True)
+            for ov in order:
+                ring_leg(ov, measure=True)
+                moe_leg(ov, measure=True)
+        moe_leg(True, measure=True, fp8=True)
+    finally:
+        for a in accls:
+            a.deinit()
+
+    throttled = fab.stats["throttled"]
+    if not throttled:
+        raise AssertionError(
+            "the emulated slow wire never engaged — overlap would be "
+            "measured against a memcpy, not a wire")
+    skew = max(max(np.bincount(d, minlength=WORLD)) for d in dest) \
+        * WORLD / MOE_T
+    ring_of = _pooled(meters[("ring", True)])
+    moe_of = _pooled(meters[("moe", True)])
+    return {
+        "metric": f"workload_overlap_{WORLD}rank",
+        "value": round(min(ring_of, moe_of), 4),
+        "unit": "frac",
+        "ring_attn_overlap_frac": round(ring_of, 4),
+        "ring_attn_serial_frac": round(_pooled(meters[("ring", False)]), 4),
+        "ring_attn_speedup": round(
+            float(np.median(times[("ring", False)]))
+            / float(np.median(times[("ring", True)])), 3),
+        "moe_overlap_frac": round(moe_of, 4),
+        "moe_serial_frac": round(_pooled(meters[("moe", False)]), 4),
+        "moe_speedup": round(
+            float(np.median(times[("moe", False)]))
+            / float(np.median(times[("moe", True)])), 3),
+        "moe_fp8_err": round(fp8_err["max"], 5),
+        "moe_skew": round(float(skew), 2),
+        "workload_throttled": int(throttled),
+        "workload_world": WORLD,
+        "tier": "emu",
+    }
+
+
+def headline() -> dict:
+    return workloads_headline()
+
+
+def main():
+    print(json.dumps(headline()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

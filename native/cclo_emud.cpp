@@ -909,6 +909,12 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c_in, uint8_t op,
       }
       return E_OK;
     }
+    case OP_ALLTOALLV:
+      // count vectors arrive in a trailing record this daemon does not
+      // parse; reject typed (the C_BLOCK_SCALED convention above) so
+      // the gap surfaces as a capability error, never as a hung or
+      // mismatched fixed-count exchange against Python-tier peers
+      return E_NOT_IMPLEMENTED;
     default:
       return E_INVALID;
   }

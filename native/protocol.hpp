@@ -42,7 +42,13 @@ enum Op : uint8_t {
   OP_CONFIG = 0, OP_COPY = 1, OP_COMBINE = 2, OP_SEND = 3, OP_RECV = 4,
   OP_BCAST = 5, OP_SCATTER = 6, OP_GATHER = 7, OP_REDUCE = 8,
   OP_ALLGATHER = 9, OP_ALLREDUCE = 10, OP_REDUCE_SCATTER = 11,
-  OP_BARRIER = 12, OP_ALLTOALL = 13, OP_NOP = 255,
+  OP_BARRIER = 12, OP_ALLTOALL = 13, OP_PUT = 14, OP_GET = 15,
+  // variable-count all-to-all: per-peer count vectors ride an optional
+  // trailing record on the MSG_CALL frame (protocol.py pack_call). This
+  // daemon has no vector-exchange expansion — it rejects the opcode
+  // typed (E_NOT_IMPLEMENTED) rather than running a fixed-count program
+  // the peers would mismatch.
+  OP_ALLTOALLV = 16, OP_NOP = 255,
 };
 
 enum Func : uint8_t { FN_SUM = 0, FN_MAX = 1, FN_MIN = 2, FN_PROD = 3 };
@@ -85,6 +91,10 @@ enum Err : uint32_t {
   E_OPEN_PORT = 1u << 13,
   E_OPEN_CON = 1u << 14,
   E_COMM_NOT_CONFIGURED = 1u << 15,
+  // scenario valid on other tiers but not implemented by this daemon
+  // (ErrorCode.COLLECTIVE_NOT_IMPLEMENTED in constants.py) — distinct
+  // from E_INVALID so a capability gap is diagnosable from the word
+  E_NOT_IMPLEMENTED = 1u << 19,
   E_SPARE_OVERFLOW = 1u << 20,
   E_INVALID = 1u << 23,
   // a deferred MSG_WAIT for an id so old that both its status and (if

@@ -379,6 +379,65 @@ def shm_cell(kind: str, seed: int, oracle) -> tuple[bool, int, str]:
     return ok, sum(plan.applied.values()), status
 
 
+def alltoallv_cell(kind: str, seed: int) -> tuple[bool, int, str]:
+    """Uneven variable-count exchange (the MoE dispatch shape) under
+    drop / payload corruption: a skewed count matrix with zero-count
+    peers, run repeatedly through the reliability layer, must land
+    BIT-IDENTICALLY to the numpy matrix oracle on every rank — the
+    uneven segment streams ride the same retransmission/checksum
+    machinery as the fixed-count collectives. Engagement proofs as
+    elsewhere: drops must move the retransmission counters, payload
+    corruption must move integrity_failed_total."""
+    W = 4
+    rng = np.random.default_rng(seed & 0xFFFF)
+    m = rng.integers(0, 600, size=(W, W))
+    m[rng.random((W, W)) < 0.25] = 0         # zero-count peers
+    m[0, :] *= 3                              # a hot sender
+    n_send = [int(m[r].sum()) for r in range(W)]
+    n_recv = [int(m[:, r].sum()) for r in range(W)]
+    ins = [np.random.default_rng(500 + r)
+           .standard_normal(max(1, n_send[r])).astype(np.float32)
+           [:n_send[r]] for r in range(W)]
+    oracle = []
+    for j in range(W):
+        oracle.append(np.concatenate(
+            [ins[s][int(m[s, :j].sum()):int(m[s, :j].sum() + m[s, j])]
+             for s in range(W)]) if n_recv[j] else
+            np.empty(0, np.float32))
+    plan = FaultPlan([FaultRule(kind=kind, every=3, offset=1,
+                                delay_s=0.01),
+                      FaultRule(kind=kind, prob=PROB, delay_s=0.01)],
+                     seed=seed)
+    accls = emu_world(W, timeout=20.0, nbufs=32)
+    fabric = accls[0].device.ctx.fabric
+    integ0, retx0 = _integrity_total(), _retx_total()
+    fabric.inject_fault(plan)
+    try:
+        def body(a):
+            r = a.rank
+            src = a.buffer((max(1, n_send[r]),), np.float32)
+            dst = a.buffer((max(1, n_recv[r]),), np.float32)
+            src.data[:n_send[r]] = ins[r]
+            for _ in range(3):
+                a.alltoallv(src, dst, tuple(m[r]), tuple(m[:, r]))
+            dst.sync_from_device()
+            return dst.data[:n_recv[r]].copy()
+
+        res = run_ranks(accls, body, timeout=300.0)
+        ok = all((r == o).all() for r, o in zip(res, oracle))
+        status = "ok" if ok else "DIVERGED"
+        if kind == "drop" and ok and _retx_total() <= retx0:
+            ok, status = False, "NO-RETRANSMITS"
+        if kind == "corrupt_payload" and ok \
+                and _integrity_total() <= integ0:
+            ok, status = False, "NO-INTEGRITY-DROPS"
+    finally:
+        fabric.clear_fault()
+        for a in accls:
+            a.deinit()
+    return ok, sum(plan.applied.values()), status
+
+
 def rma_cell(seed: int) -> tuple[bool, int]:
     """One-sided put under payload corruption of the rendezvous segment
     lane (strm=5, which bypasses the rx pool entirely): the engine's
@@ -489,6 +548,20 @@ def sweep(seed: int, hier: bool = True) -> int:
         if not ok:
             failures += 1
         rows.append((4, "q-hier", kind, status, applied,
+                     round((time.perf_counter() - t0) * 1e3)))
+    # uneven-exchange cells: the skewed alltoallv (zero-count peers,
+    # one hot sender) under loss and payload corruption, bit-identical
+    # to the matrix oracle with the machinery proven engaged
+    for kind in ("drop", "corrupt_payload"):
+        t0 = time.perf_counter()
+        try:
+            ok, applied, status = alltoallv_cell(kind, seed)
+        except Exception as exc:  # noqa: BLE001 — report cell
+            ok, applied = False, 0
+            status = f"FAILED ({type(exc).__name__})"
+        if not ok:
+            failures += 1
+        rows.append((4, "alltoallv", kind, status, applied,
                      round((time.perf_counter() - t0) * 1e3)))
     # one-sided RMA payload-corrupt cell (rendezvous lane)
     t0 = time.perf_counter()

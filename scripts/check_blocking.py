@@ -532,6 +532,107 @@ def check_redistribute_programs() -> list[str]:
     return errors
 
 
+def check_alltoallv_programs() -> list[str]:
+    """Check 5b: variable-count exchanges (moveengine.expand_alltoallv).
+    A seeded corpus of pairwise-consistent count MATRICES (M[i][j] =
+    elements i sends j), skewed and with zero rows/columns, expands
+    every rank's program — uneven lane strides, zero-count peer
+    skipping, the laned self chunk — through the same lane/hazard/
+    fusion replay, fresh AND as a relocated compiled plan (the plan
+    cache keys on the count signature; a relocation must preserve every
+    invariant at any binding). The dense uneven-reshard shapes the
+    redistribute planner lowers onto this op are included via their
+    ``_alltoallv_vectors``."""
+    import numpy as np
+
+    from accl_tpu.arith import ArithConfig
+    from accl_tpu.constants import (CCLOp, CollectiveAlgorithm, Compression,
+                                    ReduceFunc, TAG_ANY)
+    from accl_tpu.hier import ShardSpec
+    from accl_tpu.hier.redistribute import _alltoallv_vectors
+    from accl_tpu.moveengine import MoveContext, expand_call
+    from accl_tpu.plancache import compile_plan
+
+    import ml_dtypes
+
+    errors = []
+    cfg = ArithConfig(np.dtype(np.float32), np.dtype(np.float16))
+    cfg_bs = ArithConfig(np.dtype(np.float32),
+                         np.dtype(ml_dtypes.float8_e4m3fn),
+                         quant_block=64)
+    comps = [(Compression.NONE, cfg),
+             (Compression.ETH_COMPRESSED, cfg),
+             (Compression.ETH_COMPRESSED | Compression.BLOCK_SCALED,
+              cfg_bs)]
+    bases = (0x100000, 0, 0x200000)
+    shifted = (0x400000, 0, 0x500000)
+    rng = np.random.default_rng(23)
+    cells = []
+    for W in (2, 3, 5, 8):
+        for trial in range(3):
+            m = rng.integers(0, 40, size=(W, W))
+            m[rng.random((W, W)) < 0.3] = 0
+            if trial == 1:
+                m[trial % W, :] = 0            # a silent sender
+            if trial == 2:
+                m[:, (trial + 1) % W] = 0      # a silent receiver
+            cells.append((f"W{W}t{trial}", m))
+    # dense reshard vectors exactly as plan_redistribute emits them
+    src = ShardSpec.block((20, 4, 4, 4))
+    dst = ShardSpec.block((4, 4, 4, 20))
+    md = np.zeros((4, 4), np.int64)
+    for r in range(4):
+        md[r] = _alltoallv_vectors(src, dst, r)[0]
+    cells.append(("dense-reshard", md))
+    for label, m in cells:
+        W = len(m)
+        for seg in (16, 64, 1 << 20):
+            for comp, ccfg in comps:
+                for me in range(W):
+                    send = tuple(int(c) for c in m[me])
+                    recv = tuple(int(c) for c in m[:, me])
+                    cnt = max(sum(send), sum(recv))
+                    ctx = MoveContext(world_size=W, local_rank=me,
+                                      arithcfg=ccfg,
+                                      max_segment_size=seg)
+                    moves = expand_call(
+                        ctx, CCLOp.alltoallv, count=cnt,
+                        func=ReduceFunc.SUM, tag=TAG_ANY,
+                        addr_0=bases[0], addr_2=bases[2],
+                        compression=comp, counts=(send, recv))
+                    where = (f"alltoallv/{label} me={me} seg={seg} "
+                             f"comp={int(comp)}")
+                    errors += _lane_edges_ok(where, moves)
+                    errors += _hazards_ok(where, moves, ccfg)
+                    errors += _bs_fusion_ok(where, moves)
+                    # relocated compiled plan (count-signature keyed)
+                    plan = compile_plan(
+                        scenario=CCLOp.alltoallv, count=cnt,
+                        world_size=W, local_rank=me, arithcfg=ccfg,
+                        max_segment_size=seg, func=ReduceFunc.SUM,
+                        tag=TAG_ANY, bases=bases, compression=comp,
+                        algorithm=CollectiveAlgorithm.AUTO,
+                        streamed=False, counts=(send, recv))
+                    if plan.bind(bases) != moves:
+                        errors.append(
+                            f"{where}: compiled plan at its compile "
+                            f"bases differs from fresh expansion")
+                    reloc = plan.bind(shifted)
+                    fresh = expand_call(
+                        ctx, CCLOp.alltoallv, count=cnt,
+                        func=ReduceFunc.SUM, tag=TAG_ANY,
+                        addr_0=shifted[0], addr_2=shifted[2],
+                        compression=comp, counts=(send, recv))
+                    if reloc != fresh:
+                        errors.append(
+                            f"{where}: relocated plan differs from "
+                            f"fresh expansion at the shifted bases")
+                    rwhere = f"{where} [relocated]"
+                    errors += _lane_edges_ok(rwhere, reloc)
+                    errors += _hazards_ok(rwhere, reloc, ccfg)
+    return errors
+
+
 def check_rendezvous_programs() -> list[str]:
     """Check 6: one-sided transfer plans (accl_tpu/rma/plan.py). For a
     corpus of (count, elem/wire sizes, segment size, eager threshold)
@@ -606,6 +707,7 @@ def main() -> int:
     errors += check_lane_graph()
     errors += check_hier_programs()
     errors += check_redistribute_programs()
+    errors += check_alltoallv_programs()
     errors += check_rendezvous_programs()
     for e in errors:
         print(e, file=sys.stderr)
@@ -615,8 +717,9 @@ def main() -> int:
         return 1
     print("check_blocking: OK (blocking=False citations + lane graph + "
           "byte-interval hazards + relocated compiled plans + "
-          "hierarchical/redistribute programs + rendezvous plans + "
-          "block-scaled cells w/ fusion-skip)")
+          "hierarchical/redistribute programs + alltoallv count-vector "
+          "corpus + rendezvous plans + block-scaled cells w/ "
+          "fusion-skip)")
     return 0
 
 

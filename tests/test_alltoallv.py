@@ -1,0 +1,390 @@
+"""First-class alltoallv: differential corpus vs the serial oracle,
+count-vector plan keying, the dense uneven-reshard lowering, and the
+sim-tier wire record.
+
+The oracle for every exchange is the count MATRIX M (M[i][j] =
+elements rank i sends rank j): rank i's send vector is row i, its recv
+vector column i — pairwise consistency by construction, exactly the
+contract real callers (MoE routing, redistribute) satisfy. Rank j's
+landed buffer is the concatenation over s of M[s][j] elements cut from
+rank s's j-th send interval, which the tests compute in numpy and
+require BIT-IDENTICAL on the uncompressed wire (fp8 legs get the typed
+per-block quantization bound instead)."""
+
+from __future__ import annotations
+
+import itertools
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from accl_tpu.arith import ArithConfig
+from accl_tpu.constants import (CCLOp, CollectiveAlgorithm, Compression,
+                                ReduceFunc, TAG_ANY)
+from accl_tpu.hier import ShardSpec, plan_redistribute, redistribute_oracle
+from accl_tpu.hier.redistribute import (_alltoallv_vectors,
+                                        _block_offdiag_pairs)
+from accl_tpu.moveengine import MoveContext, expand_call
+from accl_tpu.plancache import plan_key
+from accl_tpu.testing import emu_world, run_ranks, sim_world
+
+F8 = np.dtype(ml_dtypes.float8_e4m3fn)
+EPS_F8 = 2.0 ** -3
+
+
+def _teardown(accls):
+    for a in accls:
+        a.deinit()
+
+
+def _matrix(W: int, seed: int, zero_frac: float = 0.3,
+            cmax: int = 40) -> np.ndarray:
+    """Seeded random count matrix with genuine skew and zero-count
+    peers (including, at higher seeds, whole zero rows/columns)."""
+    rng = np.random.default_rng(seed)
+    m = rng.integers(1, cmax, size=(W, W))
+    m[rng.random((W, W)) < zero_frac] = 0
+    if seed % 3 == 0 and W > 2:
+        m[seed % W, :] = 0          # a rank that sends nothing
+    if seed % 4 == 0 and W > 2:
+        m[:, (seed + 1) % W] = 0    # a rank that receives nothing
+    return m.astype(np.int64)
+
+
+def _run_matrix(accls, m: np.ndarray, *, dtype=np.float32,
+                in_place: bool = False, run_async: bool = False,
+                compress_dtype=None, block_scale=False):
+    """Drive one alltoallv described by count matrix ``m`` and return
+    (inputs, outputs): per-rank send arrays and landed dst arrays."""
+    W = len(accls)
+    n_send = [int(m[r].sum()) for r in range(W)]
+    n_recv = [int(m[:, r].sum()) for r in range(W)]
+    ins = [np.random.default_rng(100 + r)
+           .standard_normal(max(1, n_send[r])).astype(dtype)[:n_send[r]]
+           for r in range(W)]
+
+    def body(a):
+        r = a.rank
+        cap = max(1, max(n_send[r], n_recv[r]))
+        if in_place:
+            buf = a.buffer((cap,), dtype)
+            buf.data[:n_send[r]] = ins[r]
+            src = dst = buf
+        else:
+            src = a.buffer((max(1, n_send[r]),), dtype)
+            dst = a.buffer((max(1, n_recv[r]),), dtype)
+            src.data[:n_send[r]] = ins[r]
+            dst.data[:] = -7.0
+        h = a.alltoallv(src, dst, tuple(m[r]), tuple(m[:, r]),
+                        compress_dtype=compress_dtype,
+                        block_scale=block_scale, run_async=run_async)
+        if run_async:
+            h.wait()
+        return dst.data[:n_recv[r]].copy()
+
+    outs = run_ranks(accls, body, timeout=90.0)
+    return ins, outs
+
+
+def _expected(m: np.ndarray, ins, dst_rank: int) -> np.ndarray:
+    """Serial oracle: concatenate each source's dst_rank-th interval."""
+    W = len(m)
+    pieces = []
+    for s in range(W):
+        off = int(m[s, :dst_rank].sum())
+        pieces.append(ins[s][off:off + int(m[s, dst_rank])])
+    return np.concatenate(pieces) if pieces else np.empty(0)
+
+
+# ---------------------------------------------------------------------------
+# differential corpus: emu tier vs the matrix oracle
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("W", [3, 4, 8])
+def test_alltoallv_matches_oracle_uneven(W):
+    """Seeded uneven corpus (zero-count peers included): bit-identical
+    to the matrix oracle on every rank, sync and async."""
+    accls = emu_world(W, timeout=30.0, nbufs=32)
+    try:
+        for seed, run_async in itertools.product((1, 3, 4, 8), (False, True)):
+            m = _matrix(W, seed * 7 + W)
+            ins, outs = _run_matrix(accls, m, run_async=run_async)
+            for r in range(W):
+                np.testing.assert_array_equal(
+                    outs[r], _expected(m, ins, r),
+                    err_msg=f"rank {r} seed {seed} async {run_async}")
+    finally:
+        _teardown(accls)
+
+
+def test_alltoallv_zero_count_world():
+    """Degenerate vectors: a wholly zero matrix completes (no wire
+    traffic, dst untouched beyond its zero-length intervals)."""
+    W = 4
+    accls = emu_world(W, timeout=15.0)
+    try:
+        m = np.zeros((W, W), np.int64)
+        _, outs = _run_matrix(accls, m)
+        assert all(o.size == 0 for o in outs)
+    finally:
+        _teardown(accls)
+
+
+def test_alltoallv_in_place_staged():
+    """Overlapping src/dst stage through scratch: uneven intervals
+    alias across DIFFERENT peers' chunks, so correctness here proves
+    the staging copy, not just hazard edges."""
+    W = 4
+    accls = emu_world(W, timeout=30.0, nbufs=32)
+    try:
+        for seed in (2, 5):
+            m = _matrix(W, seed)
+            for run_async in (False, True):
+                ins, outs = _run_matrix(accls, m, in_place=True,
+                                        run_async=run_async)
+                for r in range(W):
+                    np.testing.assert_array_equal(
+                        outs[r], _expected(m, ins, r))
+    finally:
+        _teardown(accls)
+
+
+def test_alltoallv_fp8_block_scaled_bounded():
+    """fp8 block-scaled wire: every landed element within the typed
+    per-block quantization bound of the oracle (one hop = one
+    requantization); the self chunk never touches the wire, so it
+    stays bit-exact."""
+    W = 4
+    accls = emu_world(W, timeout=30.0, nbufs=32)
+    try:
+        m = _matrix(W, 11)
+        ins, outs = _run_matrix(accls, m, compress_dtype=F8,
+                                block_scale=True)
+        for r in range(W):
+            exp = _expected(m, ins, r)
+            got = outs[r]
+            # global-absmax bound is a superset of the per-block bound
+            bound = EPS_F8 * max(1e-6, float(np.abs(exp).max(initial=0.0)))
+            off = 0
+            for s in range(W):
+                c = int(m[s, r])
+                seg_exp, seg_got = exp[off:off + c], got[off:off + c]
+                if s == r:
+                    np.testing.assert_array_equal(seg_got, seg_exp)
+                elif c:
+                    assert np.abs(seg_got - seg_exp).max() <= bound, \
+                        (r, s, float(np.abs(seg_got - seg_exp).max()))
+                off += c
+    finally:
+        _teardown(accls)
+
+
+def test_alltoallv_sim_tier_wire():
+    """The count vectors survive the socket wire (pack_call's trailing
+    record) and the daemon executes the same program: bit-identical to
+    the oracle through SimDevice + RankDaemon."""
+    W = 3
+    accls = sim_world(W, nbufs=32)
+    try:
+        m = _matrix(W, 9)
+        ins, outs = _run_matrix(accls, m)
+        for r in range(W):
+            np.testing.assert_array_equal(outs[r], _expected(m, ins, r))
+    finally:
+        _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# driver validation + expansion contract
+# ---------------------------------------------------------------------------
+
+def test_alltoallv_validation_errors():
+    W = 3
+    accls = emu_world(W, timeout=10.0)
+    try:
+        a = accls[0]
+        src = a.buffer((8,), np.float32)
+        dst = a.buffer((8,), np.float32)
+        with pytest.raises(ValueError, match="comm.size"):
+            a.alltoallv(src, dst, (1, 1), (1, 1, 1))
+        with pytest.raises(ValueError, match="non-negative"):
+            a.alltoallv(src, dst, (1, -1, 1), (1, 1, 1))
+        with pytest.raises(ValueError, match="overflow"):
+            a.alltoallv(src, dst, (8, 8, 8), (0, 0, 0))
+    finally:
+        _teardown(accls)
+
+
+def test_expand_alltoallv_requires_counts():
+    """The engine refuses an alltoallv descriptor without its count
+    vectors — a truncated wire record must fail loudly, not expand a
+    garbage program."""
+    ctx = MoveContext(
+        world_size=4, local_rank=0,
+        arithcfg=ArithConfig(np.dtype(np.float32), np.dtype(np.float32)),
+        max_segment_size=1 << 20)
+    with pytest.raises(ValueError, match="count"):
+        expand_call(ctx, CCLOp.alltoallv, count=16, addr_0=1 << 20,
+                    addr_2=2 << 20, counts=None)
+
+
+def test_plan_key_carries_count_signature():
+    """Two uneven exchanges share a cached plan exactly when their
+    count vectors match element-for-element."""
+    kw = dict(scenario=CCLOp.alltoallv, algorithm=CollectiveAlgorithm.AUTO,
+              count=12, arithcfg=ArithConfig(np.dtype(np.float32),
+                                             np.dtype(np.float32)),
+              comm_id=0, world_size=4, local_rank=0, comm_epoch=0,
+              compression=Compression.NONE, stream=0, root_src_dst=0,
+              func=ReduceFunc.SUM, tag=TAG_ANY, bases=(1, 2, 3),
+              max_segment_size=1 << 20, streamed=True)
+    va = ((3, 0, 5, 4), (2, 2, 2, 6))
+    vb = ((3, 0, 5, 4), (2, 2, 6, 2))
+    assert plan_key(**kw, counts=va) == plan_key(**kw, counts=va)
+    assert plan_key(**kw, counts=va) != plan_key(**kw, counts=vb)
+    assert plan_key(**kw, counts=None) != plan_key(**kw, counts=va)
+
+
+def test_alltoallv_plan_cache_hit_on_repeat():
+    """Same vectors -> plan-cache hit; changed vectors -> miss (the
+    count signature is IN the key, so a stale even-split plan can never
+    serve a skewed exchange)."""
+    W = 4
+    accls = emu_world(W, timeout=30.0, nbufs=32, plan_cache=True)
+    try:
+        m1 = _matrix(W, 21)
+        m2 = _matrix(W, 22)
+        assert not np.array_equal(m1, m2)
+        _run_matrix(accls, m1)
+        stats0 = accls[0].plan_cache_stats()
+        _run_matrix(accls, m1)          # same vectors: all hits
+        stats1 = accls[0].plan_cache_stats()
+        assert stats1["hits"] > stats0["hits"]
+        assert stats1["misses"] == stats0["misses"]
+        _run_matrix(accls, m2)          # new vectors: compiles fresh
+        stats2 = accls[0].plan_cache_stats()
+        assert stats2["misses"] > stats1["misses"]
+    finally:
+        _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# dense uneven-reshard lowering (hier/redistribute.py)
+# ---------------------------------------------------------------------------
+
+def _brute_offdiag_pairs(src: ShardSpec, dst: ShardSpec) -> int:
+    W = src.world
+    soff = np.concatenate(([0], np.cumsum(src.counts)))
+    doff = np.concatenate(([0], np.cumsum(dst.counts)))
+    return sum(1 for r in range(W) for j in range(W)
+               if r != j and min(soff[r + 1], doff[j + 1])
+               > max(soff[r], doff[j]))
+
+
+def test_offdiag_pairs_matches_brute_force():
+    """The O(W) merge walk equals the O(W^2) definition on a seeded
+    corpus, and the per-rank vectors are pairwise consistent and tile
+    each rank's shard."""
+    rng = np.random.default_rng(5)
+    for W in (3, 4, 8):
+        for trial in range(20):
+            n = int(rng.integers(W, 200))
+            cuts = np.sort(rng.integers(0, n + 1, W - 1))
+            src = ShardSpec.block(tuple(np.diff(
+                np.concatenate(([0], cuts, [n])))))
+            cuts = np.sort(rng.integers(0, n + 1, W - 1))
+            dst = ShardSpec.block(tuple(np.diff(
+                np.concatenate(([0], cuts, [n])))))
+            assert (_block_offdiag_pairs(src, dst)
+                    == _brute_offdiag_pairs(src, dst)), (W, trial)
+            vecs = [_alltoallv_vectors(src, dst, r) for r in range(W)]
+            for i in range(W):
+                send, recv = vecs[i]
+                assert sum(send) == src.counts[i]
+                assert sum(recv) == dst.counts[i]
+                for j in range(W):
+                    assert send[j] == vecs[j][1][i], (i, j)
+
+
+def test_dense_reshard_lowers_to_alltoallv():
+    """A skewed dense block->block change plans one alltoallv on every
+    participating rank; vectors agree with the interval geometry."""
+    src = ShardSpec.block((20, 4, 4, 4))
+    dst = ShardSpec.block((4, 4, 4, 20))
+    assert _block_offdiag_pairs(src, dst) >= 4
+    plans = [plan_redistribute(src, dst, r) for r in range(4)]
+    kinds = {p.kind for p in plans}
+    assert kinds <= {"alltoallv", "noop"} and "alltoallv" in kinds
+    for r, p in enumerate(plans):
+        if p.kind != "alltoallv":
+            continue
+        assert p.rank == r
+        assert sum(p.send_counts) == src.counts[r]
+        assert sum(p.recv_counts) == dst.counts[r]
+
+
+def test_sparse_reshard_stays_p2p_minimal():
+    """BELOW the density threshold the p2p path keeps its pinned
+    minimality: a single boundary shift is exactly one wire transfer,
+    and the grow-membership reshard shape never pays collective
+    admission."""
+    # single boundary shift: 1 off-diag pair < W=2... use W=4
+    src = ShardSpec.block((16, 16, 16, 16))
+    dst = ShardSpec.block((12, 20, 16, 16))
+    assert _block_offdiag_pairs(src, dst) == 1
+    for r in range(4):
+        p = plan_redistribute(src, dst, r)
+        assert p.kind in ("p2p", "local", "noop")
+        assert p.wire_transfers <= 1
+    # the elastic grow shape: balanced W-1 (+idle) -> balanced W
+    src = ShardSpec.block((22, 21, 21, 0))
+    dst = ShardSpec.block((16, 16, 16, 16))
+    assert _block_offdiag_pairs(src, dst) == 3  # W-1 < W
+    kinds = {plan_redistribute(src, dst, r).kind for r in range(4)}
+    assert "alltoallv" not in kinds
+
+
+def test_alltoallv_wire_transfers_counts_off_self():
+    from accl_tpu.hier.redistribute import RedistPlan
+    p = RedistPlan("alltoallv", send_counts=(5, 0, 3, 2),
+                   recv_counts=(0, 4, 3, 0), rank=2)
+    # sends to 0 and 3 (self chunk at 2 excluded), recvs from 1 and 2->
+    # recv[2] is the self chunk: 2 sends + 1 recv
+    assert p.wire_transfers == 3
+
+
+def test_redistribute_dense_end_to_end():
+    """Driver-level: the dense reshard (which the planner lowers onto
+    alltoallv) lands bit-identically to redistribute_oracle, including
+    in-place."""
+    W = 4
+    src = ShardSpec.block((613, 100, 100, 200))
+    dst = ShardSpec.block((100, 100, 100, 713))
+    assert plan_redistribute(src, dst, 0).kind == "alltoallv"
+    rng = np.random.default_rng(31)
+    shards = [rng.standard_normal(src.counts[r]).astype(np.float32)
+              for r in range(W)]
+    golden = redistribute_oracle(shards, src, dst)
+    accls = emu_world(W, timeout=30.0, nbufs=32)
+    try:
+        def body(a):
+            r = a.rank
+            cap = max(1, max(src.counts[r], dst.counts[r]))
+            sbuf = a.buffer((cap,), np.float32)
+            dbuf = a.buffer((max(1, dst.counts[r]),), np.float32)
+            sbuf.data[:src.counts[r]] = shards[r]
+            a.redistribute(sbuf, src, dbuf, dst)
+            out = dbuf.data[:dst.counts[r]].copy()
+            # in-place: same arena holds the src shard, then the dst
+            sbuf.data[:src.counts[r]] = shards[r]
+            a.redistribute(sbuf, src, sbuf, dst)
+            out_ip = sbuf.data[:dst.counts[r]].copy()
+            return out, out_ip
+
+        for r, (out, out_ip) in enumerate(run_ranks(accls, body,
+                                                    timeout=90.0)):
+            np.testing.assert_array_equal(out, golden[r])
+            np.testing.assert_array_equal(out_ip, golden[r])
+    finally:
+        _teardown(accls)

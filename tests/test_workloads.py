@@ -1,0 +1,249 @@
+"""Compute-overlapped workloads (accl_tpu/workloads): ring attention
+and MoE dispatch/combine vs their serial numpy oracles, plus the
+OverlapMeter ledger the bench gate trusts."""
+
+from __future__ import annotations
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from accl_tpu.testing import emu_world, run_ranks
+from accl_tpu.tracing import METRICS
+from accl_tpu.workloads import OverlapMeter
+from accl_tpu.workloads.moe import (default_expert, moe_dispatch_combine,
+                                    moe_reference)
+from accl_tpu.workloads.ring_attention import (ring_attention_forward,
+                                               ring_attention_reference)
+
+F8 = np.dtype(ml_dtypes.float8_e4m3fn)
+
+
+def _teardown(accls):
+    for a in accls:
+        a.deinit()
+
+
+# ---------------------------------------------------------------------------
+# ring attention
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("overlap", [True, False])
+def test_ring_attention_matches_reference(overlap):
+    """Blocks arrive in ring order, accumulate online — the result must
+    still match plain softmax over the FULL sequence."""
+    W, L, D = 4, 24, 16
+    rng = np.random.default_rng(3)
+    q = [rng.standard_normal((L, D)).astype(np.float32) for _ in range(W)]
+    k = [rng.standard_normal((L, D)).astype(np.float32) for _ in range(W)]
+    v = [rng.standard_normal((L, D)).astype(np.float32) for _ in range(W)]
+    golden = [ring_attention_reference(q[r], np.concatenate(k),
+                                       np.concatenate(v))
+              for r in range(W)]
+    accls = emu_world(W, timeout=30.0, nbufs=32)
+    try:
+        def body(a):
+            out, stats = ring_attention_forward(
+                a, q[a.rank], k[a.rank], v[a.rank], overlap=overlap)
+            assert stats["steps"] == W
+            return out
+
+        for r, out in enumerate(run_ranks(accls, body, timeout=90.0)):
+            np.testing.assert_allclose(out, golden[r], rtol=2e-5,
+                                       atol=2e-6)
+    finally:
+        _teardown(accls)
+
+
+def test_ring_attention_single_rank_shortcut():
+    accls = emu_world(1, timeout=10.0)
+    try:
+        rng = np.random.default_rng(4)
+        q = rng.standard_normal((8, 4)).astype(np.float32)
+        k = rng.standard_normal((8, 4)).astype(np.float32)
+        v = rng.standard_normal((8, 4)).astype(np.float32)
+        out, stats = ring_attention_forward(accls[0], q, k, v)
+        np.testing.assert_allclose(out, ring_attention_reference(q, k, v),
+                                   rtol=2e-5, atol=2e-6)
+        assert stats["steps"] == 1 and stats["overlap_frac"] == 1.0
+    finally:
+        _teardown(accls)
+
+
+def test_ring_attention_rejects_bad_shapes():
+    accls = emu_world(2, timeout=10.0)
+    try:
+        q = np.zeros((4, 8), np.float32)
+        with pytest.raises(ValueError, match="block_len"):
+            run_ranks(accls, lambda a: ring_attention_forward(
+                a, q, np.zeros((4, 6), np.float32),
+                np.zeros((4, 6), np.float32)))
+    finally:
+        _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# MoE dispatch/combine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n_chunks", [1, 2, 3])
+@pytest.mark.parametrize("overlap", [True, False])
+def test_moe_matches_reference(n_chunks, overlap):
+    """Skewed routing, microbatched: outputs land in ORIGINAL token
+    order, bit-close to the per-rank serial oracle."""
+    W, T, D = 4, 48, 8
+    rng = np.random.default_rng(7)
+    toks = [rng.standard_normal((T, D)).astype(np.float32)
+            for _ in range(W)]
+    dest = [rng.choice(W, size=T, p=np.roll([0.55, 0.25, 0.15, 0.05], r))
+            for r in range(W)]
+    experts = [default_expert(r, D) for r in range(W)]
+    golden = moe_reference(toks, dest, experts)
+    accls = emu_world(W, timeout=30.0, nbufs=64)
+    try:
+        def body(a):
+            out, stats = moe_dispatch_combine(
+                a, toks[a.rank], dest[a.rank], n_chunks=n_chunks,
+                overlap=overlap)
+            assert stats["tokens"] == T
+            assert sum(stats["send_counts"]) == T
+            return out
+
+        for r, out in enumerate(run_ranks(accls, body, timeout=90.0)):
+            np.testing.assert_allclose(out, golden[r], rtol=1e-5,
+                                       atol=1e-6)
+    finally:
+        _teardown(accls)
+
+
+def test_moe_zero_count_destinations():
+    """Routing collapse: every rank sends ALL tokens to rank 0 — the
+    other vector entries are zero, rank 0 computes everything, and the
+    combine still un-permutes correctly."""
+    W, T, D = 4, 20, 6
+    rng = np.random.default_rng(9)
+    toks = [rng.standard_normal((T, D)).astype(np.float32)
+            for _ in range(W)]
+    dest = [np.zeros(T, np.int64) for _ in range(W)]
+    experts = [default_expert(r, D) for r in range(W)]
+    golden = moe_reference(toks, dest, experts)
+    accls = emu_world(W, timeout=30.0, nbufs=64)
+    try:
+        def body(a):
+            out, stats = moe_dispatch_combine(
+                a, toks[a.rank], dest[a.rank], n_chunks=3)
+            if a.rank == 0:
+                assert stats["recv_tokens"] == W * T
+            else:
+                assert stats["recv_tokens"] == 0
+            return out
+
+        for r, out in enumerate(run_ranks(accls, body, timeout=90.0)):
+            np.testing.assert_allclose(out, golden[r], rtol=1e-5,
+                                       atol=1e-6)
+    finally:
+        _teardown(accls)
+
+
+def test_moe_fp8_dispatch_leg_bounded():
+    """Dispatch activations cross the fp8 block-scaled wire; the expert
+    (tanh, bounded) keeps the end-to-end error well inside the bench
+    leg's 0.25 hard bound. The combine leg stays full precision."""
+    W, T, D = 4, 32, 8
+    rng = np.random.default_rng(13)
+    toks = [rng.standard_normal((T, D)).astype(np.float32)
+            for _ in range(W)]
+    dest = [rng.integers(0, W, T) for _ in range(W)]
+    experts = [default_expert(r, D) for r in range(W)]
+    golden = moe_reference(toks, dest, experts)
+    accls = emu_world(W, timeout=30.0, nbufs=64)
+    try:
+        def body(a):
+            out, _ = moe_dispatch_combine(
+                a, toks[a.rank], dest[a.rank], n_chunks=2,
+                compress_dtype=F8, block_scale=True)
+            return out
+
+        for r, out in enumerate(run_ranks(accls, body, timeout=90.0)):
+            assert float(np.abs(out - golden[r]).max()) <= 0.25
+    finally:
+        _teardown(accls)
+
+
+def test_moe_rejects_bad_dest():
+    accls = emu_world(2, timeout=10.0)
+    try:
+        toks = np.zeros((4, 2), np.float32)
+        with pytest.raises(ValueError, match="out of range"):
+            run_ranks(accls, lambda a: moe_dispatch_combine(
+                a, toks, np.array([0, 1, 2, 0])))
+        with pytest.raises(ValueError, match="one rank per token"):
+            run_ranks(accls, lambda a: moe_dispatch_combine(
+                a, toks, np.array([0, 1])))
+    finally:
+        _teardown(accls)
+
+
+# ---------------------------------------------------------------------------
+# the meter
+# ---------------------------------------------------------------------------
+
+class _FakeHandle:
+    """Handle double with a controllable completion instant."""
+
+    def __init__(self):
+        self._cbs = []
+
+    def add_done_callback(self, cb):
+        self._cbs.append(cb)
+
+    def complete(self):
+        for cb in self._cbs:
+            cb(None)
+
+    def wait(self):
+        self.complete()
+
+
+def test_overlap_meter_empty_is_one():
+    assert OverlapMeter().overlap_frac == 1.0
+
+
+def test_overlap_meter_hidden_vs_exposed():
+    """A handle that completes BEFORE the wait is hidden (frac -> 1);
+    a wait that blocks for the whole in-flight span is exposed
+    (frac -> 0)."""
+    import time
+
+    m = OverlapMeter()
+    h = _FakeHandle()
+    m.issue(h)
+    time.sleep(0.02)        # "compute" while the transfer is in flight
+    h.complete()            # retired under compute
+    m.wait(h)
+    assert m.overlap_frac > 0.9
+
+    m2 = OverlapMeter()
+    h2 = _FakeHandle()
+    m2.issue(h2)
+
+    class _Blocking(_FakeHandle):
+        pass
+
+    def slow_wait():
+        time.sleep(0.02)
+        h2.complete()
+    h2.wait = slow_wait     # the wait IS the in-flight time: fully exposed
+    m2.wait(h2)
+    assert m2.overlap_frac < 0.3
+
+
+def test_overlap_meter_publish_sets_metrics():
+    m = OverlapMeter()
+    stats = m.publish(rank=0, workload="unit", steps=5)
+    assert stats["overlap_frac"] == 1.0 and stats["steps"] == 5
+    snap = METRICS.snapshot()
+    assert snap["gauges"]["workload_overlap_frac"][
+        "rank=0,workload=unit"] == 1.0
+    assert snap["counters"]["workload_steps_total"][
+        "rank=0,workload=unit"] >= 5
