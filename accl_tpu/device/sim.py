@@ -104,16 +104,38 @@ class SimDevice(Device):
             P.send_frames(self._wait_sock, bodies)
             return [P.recv_frame_file(self._wait_rfile) for _ in bodies]
 
-    def _request_status(self, body: bytes) -> int:
+    @staticmethod
+    def _status_detail(reply: bytes) -> str:
+        """Feature name a caps-aware daemon appends (utf-8, after the
+        error word) to a failed MSG_STATUS reply — names WHICH capability
+        a typed reject is about (e.g. ``alltoallv``, ``block-scaled wire
+        dtype``). Legacy daemons reply with exactly 5 bytes -> ``""``."""
+        return reply[5:].decode("utf-8", "replace") if len(reply) > 5 else ""
+
+    def _request_status_ex(self, body: bytes) -> "tuple[int, str]":
         reply = self._request(body)
         assert reply[0] == P.MSG_STATUS, reply[0]
-        return struct.unpack("<I", reply[1:5])[0]
+        return (struct.unpack("<I", reply[1:5])[0],
+                self._status_detail(reply))
+
+    def _request_status(self, body: bytes) -> int:
+        return self._request_status_ex(body)[0]
 
     def _check(self, body: bytes):
-        err = self._request_status(body)
+        err, detail = self._request_status_ex(body)
         if err:
             from ..constants import ACCLError
-            raise ACCLError(err, "sim config")
+            raise ACCLError(err, "sim config"
+                            + (f" ({detail})" if detail else ""))
+
+    @staticmethod
+    def _tag_feature(handle: CallHandle, detail: str):
+        """Fold the daemon's feature name into the handle's context so
+        the eventual ``ACCLError`` (raised in ``CallHandle.wait``) says
+        *which* feature the daemon rejected, not just the error word."""
+        if detail:
+            handle.context = ((handle.context + " " if handle.context
+                               else "") + f"(daemon rejected: {detail})")
 
     # -- Device interface --------------------------------------------------
     def register_buffer(self, buf: ACCLBuffer):
@@ -681,6 +703,8 @@ class SimDevice(Device):
             return
         if not err and data_reply is not None:
             self._land_result(res_buf, data_reply)
+        if err:
+            self._tag_feature(handle, self._status_detail(wait_reply))
         handle.complete(err)
 
     def _poll_completion(self, desc: CallDescriptor, call_id: int,
@@ -691,11 +715,13 @@ class SimDevice(Device):
         symmetric recv-then-send programs)."""
         try:
             while True:
-                err = self._request_status(
+                err, detail = self._request_status_ex(
                     bytes([P.MSG_WAIT]) +
                     struct.pack("<Id", call_id, 0.05))
                 if err != P.STATUS_PENDING:
                     break
+            if err:
+                self._tag_feature(handle, detail)
             self._finish_call(desc, err, handle, self._request)
         except Exception as exc:  # noqa: BLE001
             handle.complete(int(ErrorCode.CONNECTION_CLOSED), exception=exc)
@@ -778,6 +804,9 @@ class SimDevice(Device):
                         if err == P.STATUS_PENDING:
                             nxt_pending.append((desc, call_id, handle))
                             continue
+                        if err:
+                            self._tag_feature(
+                                handle, self._status_detail(reply))
                         if not err and res_buf is not None:
                             self._land_result(res_buf, data_reply)
                             handle.complete(err)

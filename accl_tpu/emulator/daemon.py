@@ -89,10 +89,12 @@ def probe_peer_caps(host: str, port: int,
                     timeout: float = 0.3) -> int | None:
     """Best-effort capability probe of a peer daemon's COMMAND port: one
     MSG_GET_INFO round trip, returning the trailing caps word (0 for
-    daemons predating it — the native ``cclo_emud`` and older Python
-    daemons — whose replies are 38 payload bytes), or None when the peer
-    was unreachable within the budget (unknown, NOT zero: an
-    still-starting daemon must not be mistaken for a native one)."""
+    LEGACY daemons predating it — pre-caps builds whose replies are 38
+    payload bytes; the current native ``cclo_emud`` advertises
+    CAP_RETX_ACK and the crc32c csum bits like the python daemons), or
+    None when the peer was unreachable within the budget (unknown, NOT
+    zero: a still-starting daemon must not be mistaken for a legacy
+    one)."""
     try:
         with socket.create_connection((host, port),
                                       timeout=timeout) as sock:
@@ -537,7 +539,8 @@ class UdpEthFabric:
                                            if self.latch_fn else None),
                 fabric="udp", copy_payloads=True)
         # payload checksums ($ACCL_TPU_CSUM, default on; pinned off at
-        # configure time when a peer lacks CAP_CSUM — see
+        # configure time only when a LEGACY peer lacks CAP_CSUM — the
+        # current native cclo_emud speaks crc32c, see
         # RankDaemon._maybe_pin_caps): a reassembled message whose
         # payload fails its trailing crc32 is dropped UNACKED, so the
         # sender's RTO re-fetches the original (corrupt-as-loss); at
@@ -1034,18 +1037,23 @@ class RankDaemon:
         time — the moment peers become known — so mixed worlds degrade
         gracefully with no operator env var:
 
-        * retransmission (UDP stack, PR-9 known issue): the native
-          ``cclo_emud`` has no ACK responder, so retransmitting toward
-          it RTO-storms to the give-up bound and latches false
+        * retransmission (UDP stack): a LEGACY peer with no ACK
+          responder (pre-caps daemon builds) would RTO-storm
+          retransmits to the give-up bound and latch false
           PEER_FAILED — a peer without CAP_RETX_ACK pins this daemon's
-          retx window to 0 (``ACCL_TPU_RETX_WINDOW=0`` silences).
-        * payload checksums (every stack, PR 13): a peer without
-          CAP_CSUM neither appends nor verifies the trailing integrity
-          word; sending checksummed frames AT it is harmless (old
-          decoders ignore trailing bytes) but its own frames arrive
+          retx window to 0 (``ACCL_TPU_RETX_WINDOW=0`` silences). The
+          current native ``cclo_emud`` advertises CAP_RETX_ACK (full
+          cum+selective ack responder), so mixed py/native worlds keep
+          retransmitting end-to-end.
+        * payload checksums (every stack): a peer without CAP_CSUM
+          neither appends nor verifies the trailing integrity word;
+          sending checksummed frames AT it is harmless (old decoders
+          ignore trailing bytes) but its own frames arrive
           unverifiable — the world degrades to unchecksummed frames,
           with a one-time warning + ``csum_pinned_total``
-          (``ACCL_TPU_CSUM=0`` silences).
+          (``ACCL_TPU_CSUM=0`` silences). The current native daemon
+          advertises CAP_CSUM | CAP_CSUM_C (crc32c, bit-identical to
+          google-crc32c), so only genuinely legacy peers pin this.
         * shm links (PR 14): a SAME-HOST peer advertising CAP_SHM
           upgrades its one link to the shared-memory ring; every other
           peer stays on the embedded TCP fabric, per link
@@ -1088,8 +1096,8 @@ class RankDaemon:
                 and not caps & P.CAP_RETX_ACK:
             log.warning(
                 "rank %d: peer rank %d at %s:%d has no "
-                "retransmission ACK responder (native cclo_emud or "
-                "an older daemon) — pinning this daemon's retx "
+                "retransmission ACK responder (a legacy pre-caps "
+                "daemon build) — pinning this daemon's retx "
                 "window to 0 so retransmits toward it cannot "
                 "RTO-storm into a false PEER_FAILED "
                 "(set ACCL_TPU_RETX_WINDOW=0 to silence)",
@@ -1100,7 +1108,7 @@ class RankDaemon:
             self.eth.retx = None
         if getattr(self.eth, "csum", False) and \
                 caps & (P.CAP_CSUM | P.CAP_CSUM_C) != P.csum_caps():
-            # no checksums at all (native cclo_emud, older daemons)
+            # no checksums at all (legacy pre-caps daemon builds)
             # OR a different CRC variant (mixed installs: one side
             # has the hardware crc32c binding, the other does not) —
             # either way this daemon must stop emitting/verifying,
@@ -1108,8 +1116,8 @@ class RankDaemon:
             log.warning(
                 "rank %d: peer rank %d at %s:%d does not speak "
                 "this daemon's payload-checksum variant (%s; "
-                "native cclo_emud, an older daemon, or a mixed "
-                "install) — pinning checksums off so the world "
+                "a legacy daemon build or a mixed install) — "
+                "pinning checksums off so the world "
                 "degrades to unchecksummed frames "
                 "(set ACCL_TPU_CSUM=0 to silence)",
                 self.rank, grank, host, port, P.CSUM_VARIANT,

@@ -116,14 +116,17 @@ JOIN_STRM = 6         # membership join hello (empty payload)
 
 # daemon capability bits (MSG_GET_INFO trailing caps u32; absent on
 # replies from daemons predating it — treat as 0). Bit 0: the daemon
-# answers retransmission ACKs (strm=ACK_STRM) — the native cclo_emud
-# does NOT, which is why mixed py/native UDP worlds must pin
-# $ACCL_TPU_RETX_WINDOW=0 (auto-detected at configure time since PR 11).
-# Bit 1: the daemon serves one-sided RMA frames (accl_tpu/rma).
+# answers retransmission ACKs (strm=ACK_STRM) — both the python daemons
+# and the current native cclo_emud advertise it (full cum+selective
+# responder), so only LEGACY pre-caps builds still trigger the
+# configure-time retx pin (auto-detected since PR 11).
+# Bit 1: the daemon serves one-sided RMA frames (accl_tpu/rma) —
+# python-tier only; the native daemon keeps this bit clear.
 # Bit 2: the daemon emits AND verifies payload checksums on eth frames
-# (the trailing crc word below) — peers without it (the native
-# cclo_emud, older daemons) make the world degrade gracefully to
-# unchecksummed frames, pinned at configure time like the retx window.
+# (the trailing crc word below) — the native cclo_emud advertises it
+# too (crc32c, bit-identical to google-crc32c); peers without it
+# (legacy builds) make the world degrade gracefully to unchecksummed
+# frames, pinned at configure time like the retx window.
 # Bit 3: the checksum variant is hardware crc32c (google-crc32c binding;
 # absent = plain zlib crc32). Sender and receiver MUST agree on the
 # variant, so _maybe_pin_caps pins checksums off when a peer's variant

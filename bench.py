@@ -163,6 +163,16 @@ def bench_emu_fallback(reason: str) -> dict:
         qh = q_headline()
         for k in QUANT_KEYS:
             result[k] = qh[k]
+    if os.environ.get("ACCL_BENCH_MIN_CODEC_RATIO"):
+        # vectorized-vs-scalar codec microladder (~2s, pure CPU): e4m3
+        # encode/decode through the compiled bs codec with dispatch
+        # pinned to scalar vs the host's best SIMD tier, bit-identity
+        # checked per rung. Only when the gate is armed (make
+        # bench-emu), keep-ungated-runs-fast rule.
+        from benchmarks.quantize import CODEC_KEYS, codec_headline
+        ch = codec_headline()
+        for k in CODEC_KEYS:
+            result[k] = ch[k]
     return result
 
 
@@ -269,6 +279,25 @@ def check_quant_ratios(result: dict) -> int:
               file=sys.stderr)
         rc = 1
     return rc
+
+
+def check_codec_ratio(result: dict) -> int:
+    """Regression gate for the vectorized block-scale codec
+    (native/bs_codec.h runtime dispatch): with
+    $ACCL_BENCH_MIN_CODEC_RATIO set (make bench-emu sets 1.0), the
+    SIMD path's worse direction (encode or decode, 16 MiB rung) must
+    beat the scalar path by at least that factor. The 1.0 floor is the
+    never-lose contract on any host (the ladder hard-raises if the two
+    paths stop landing bit-identical bytes); measured ~13x per
+    direction on the AVX2 CI host, ~3-5x on SSE2-only."""
+    want = os.environ.get("ACCL_BENCH_MIN_CODEC_RATIO")
+    if not want or "codec_ratio" not in result:
+        return 0
+    if result["codec_ratio"] >= float(want):
+        return 0
+    print(f"FAIL: vectorized codec ratio {result['codec_ratio']} < "
+          f"required {want}", file=sys.stderr)
+    return 1
 
 
 def _workload_gate_value(result: dict) -> float:
@@ -1024,6 +1053,7 @@ def main():
                  or check_shm_ratio(result)
                  or check_combine_ratio(result)
                  or check_quant_ratios(result)
+                 or check_codec_ratio(result)
                  or check_overlap_frac(result)
                  or check_fabric_clean(result))
     if not _probe_backend():

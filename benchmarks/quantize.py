@@ -156,6 +156,84 @@ def quantize_headline(nbytes: int = 16 << 20, iters: int = 3) -> dict:
     }
 
 
+CODEC_KEYS = ("codec_ratio", "codec_enc_ratio", "codec_dec_ratio",
+              "codec_enc_gbps", "codec_dec_gbps",
+              "codec_enc_scalar_gbps", "codec_dec_scalar_gbps",
+              "codec_simd_level", "codec_rungs")
+
+CODEC_SIZES = (64 << 10, 1 << 20, 16 << 20)   # f32 bytes per rung
+
+
+def codec_headline(block: int = 128) -> dict:
+    """Vectorized-vs-scalar block-scale codec microladder: e4m3
+    encode/decode through the SAME compiled entry points
+    (combine_kernels.c bs_quantize/bs_dequantize) with the runtime
+    dispatch pinned to scalar (level 0) vs the host's best SIMD tier,
+    64 KiB - 16 MiB, best-of-three per rung. The two paths must land
+    BIT-IDENTICAL packed bytes (the corpus contract) before any ratio
+    is believed. Headline ``codec_ratio`` = min(enc, dec) scalar/simd
+    wall-clock at the 16 MiB rung — floor 1.0 on any host (vectorized
+    must never LOSE), measured ~3-12x per direction on AVX2."""
+    from accl_tpu import native_combine
+
+    lib = native_combine.module()
+    if lib is None or not hasattr(lib, "codec_set_level"):
+        raise AssertionError(
+            "native block-scale codec unavailable (build with "
+            "`make -C native combine`) — the codec gate has nothing "
+            "to measure")
+    f8 = np.dtype(ml_dtypes.float8_e4m3fn)
+    full = lib.codec_level()
+
+    def best_of(fn, reps=3):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    rng = np.random.default_rng(11)
+    rungs = {}
+    try:
+        for nbytes in CODEC_SIZES:
+            count = nbytes // 4
+            x = rng.standard_normal(count).astype(np.float32)
+            t = {}
+            packed = {}
+            for lvl, tag in ((0, "scalar"), (full, "simd")):
+                lib.codec_set_level(lvl)
+                packed[tag] = quant.quantize_packed(x, f8, block)
+                t["enc_" + tag] = best_of(
+                    lambda: quant.quantize_packed(x, f8, block))
+                t["dec_" + tag] = best_of(
+                    lambda: quant.dequantize_packed(packed[tag], count))
+            if packed["scalar"].tobytes() != packed["simd"].tobytes():
+                raise AssertionError(
+                    f"scalar and SIMD codec paths diverged at "
+                    f"{nbytes >> 10} KiB — bit-identity broken")
+            rungs[nbytes >> 10] = {
+                "enc_x": round(t["enc_scalar"] / t["enc_simd"], 2),
+                "dec_x": round(t["dec_scalar"] / t["dec_simd"], 2),
+                "enc_gbps": round(nbytes / t["enc_simd"] / 1e9, 2),
+                "dec_gbps": round(nbytes / t["dec_simd"] / 1e9, 2),
+            }
+    finally:
+        lib.codec_set_level(full)
+    top = rungs[CODEC_SIZES[-1] >> 10]
+    return {
+        "codec_ratio": round(min(top["enc_x"], top["dec_x"]), 3),
+        "codec_enc_ratio": top["enc_x"],
+        "codec_dec_ratio": top["dec_x"],
+        "codec_enc_gbps": top["enc_gbps"],
+        "codec_dec_gbps": top["dec_gbps"],
+        "codec_enc_scalar_gbps": round(top["enc_gbps"] / top["enc_x"], 2),
+        "codec_dec_scalar_gbps": round(top["dec_gbps"] / top["dec_x"], 2),
+        "codec_simd_level": full,
+        "codec_rungs": rungs,
+    }
+
+
 def headline() -> dict:
     return quantize_headline()
 

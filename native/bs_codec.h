@@ -1,0 +1,739 @@
+/* bs_codec.h: shared block-scaled quantization codec (scalar + SIMD).
+ *
+ * Single source of truth for the fp8/int8 encode/decode/combine kernels
+ * used by BOTH native components: _accl_combine (the Python emulator's
+ * compiled combine library, combine_kernels.c) and cclo_emud (the C++
+ * rank daemon's C_BLOCK_SCALED wire lanes).  Header-only, all-static,
+ * compiles as C11 and C++17.
+ *
+ * Contract: every path — scalar, SSE2, AVX2 — is BIT-IDENTICAL to the
+ * numpy reference in accl_tpu/quant.py (and therefore to ml_dtypes'
+ * float8 casts), pinned by tests/test_combine_native.py over the full
+ * 256-code product and a dense f32 corpus including +-0/NaN/inf.  The
+ * vector paths achieve this by construction, not by luck:
+ *
+ *   - fp8 ENCODE rides an integer fast path that is exact
+ *     round-to-nearest-even on the f32 bit pattern:
+ *         rounded = (A + (1<<(shift-1)) - 1 + ((A>>shift)&1)) >> shift
+ *         code    = rounded - ((127-bias) << man_bits)
+ *     valid whenever the pre-round target exponent is >= 1.  The hard
+ *     lanes — subnormal/underflow targets (A < min_norm), inf/NaN
+ *     inputs (A >= 0x7F800000) and overflow past the largest finite
+ *     code — are detected with integer compares and patched through
+ *     the scalar bsc_float_to_f8 (the mulps product equals the scalar
+ *     multiply bit-for-bit, so the patch input is identical).
+ *   - int8 ENCODE clamps to [-127, 127] in float and converts with
+ *     cvtps2dq under the default MXCSR round-to-nearest-even — provably
+ *     equal to the scalar rintf-then-clip for every input (ties like
+ *     127.5 round to 128 then clip; clamp-first yields 127 as well);
+ *     non-finite lanes are masked to 0 afterwards.
+ *   - DECODE goes through a 256-entry f32 LUT built once from the
+ *     scalar converters (exact by construction), then one mulps by the
+ *     block scale — the same single rounding the scalar performs.
+ *   - ABSMAX tracks NaN with a separate accumulated cmpunord mask
+ *     (maxps quietly drops NaNs depending on operand order); any NaN,
+ *     like the scalar NaN-propagating max, forces the identity scale.
+ *   - MAX/MIN combine is a pure blend on cmpgt|cmpunord — selection,
+ *     never arithmetic, so numpy's strict-compare tie rule survives.
+ *
+ * Dispatch: runtime-selected level 0=scalar / 1=SSE2 / 2=AVX2 via
+ * __builtin_cpu_supports, overridable with ACCL_TPU_CODEC_SIMD (clamped
+ * to what the host supports) or programmatically via bsc_set_level —
+ * the hook the bit-identity tests use to prove every path on one host.
+ * Non-x86 builds compile the scalar path only.
+ */
+#ifndef ACCL_BS_CODEC_H
+#define ACCL_BS_CODEC_H
+
+#include <float.h>
+#include <math.h>
+#include <stddef.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#if defined(__x86_64__) && (defined(__GNUC__) || defined(__clang__))
+#define BSC_X86 1
+#include <immintrin.h>
+#else
+#define BSC_X86 0
+#endif
+
+/* quantized-kind codes (independent of the wire dtype codes) */
+#define BSC_QK_I8 0
+#define BSC_QK_E4M3 1
+#define BSC_QK_E5M2 2
+
+/* func codes (accl_tpu.constants.ReduceFunc) */
+#define BSC_F_SUM 0
+#define BSC_F_MAX 1
+#define BSC_F_MIN 2
+#define BSC_F_PROD 3
+
+/* ---- scalar fp8 conversion (ml_dtypes parity; the former
+ * combine_kernels.c implementation, verbatim).  e4m3fn: 4 exp / 3 man,
+ * bias 7, NO inf — all-ones exponent codes are ordinary values except
+ * mantissa 111 (0x7F/0xFF = NaN).  e5m2: 5 exp / 2 man, bias 15,
+ * IEEE-shaped (overflow -> inf 0x7C, NaN -> 0x7E).  Round-to-nearest-
+ * even everywhere including the subnormal range. ---- */
+
+static inline float bsc_f8_to_float(uint8_t h, int man_bits, int bias,
+                                    int has_inf) {
+    uint32_t sign = (uint32_t)(h & 0x80u) << 24;
+    int exp_bits = 7 - man_bits;
+    uint32_t man_mask = (1u << man_bits) - 1u;
+    uint32_t exp = ((uint32_t)h >> man_bits) & ((1u << exp_bits) - 1u);
+    uint32_t man = h & man_mask;
+    uint32_t emax = (1u << exp_bits) - 1u;
+    uint32_t f;
+    if (exp == emax && (has_inf || man == man_mask)) {
+        f = sign | (man ? 0x7FC00000u : (has_inf ? 0x7F800000u
+                                                 : 0x7FC00000u));
+    } else if (exp == 0) {
+        if (man == 0) {
+            f = sign;
+        } else { /* subnormal: renormalize into f32 */
+            uint32_t e = 127u - (uint32_t)bias + 1u;
+            while (!(man & (1u << man_bits))) { man <<= 1; e--; }
+            man &= man_mask;
+            f = sign | (e << 23) | (man << (23 - man_bits));
+        }
+    } else {
+        f = sign | ((exp - (uint32_t)bias + 127u) << 23)
+            | (man << (23 - man_bits));
+    }
+    float out;
+    memcpy(&out, &f, 4);
+    return out;
+}
+
+static inline uint8_t bsc_float_to_f8(float v, int man_bits, int bias,
+                                      int has_inf) {
+    uint32_t x;
+    memcpy(&x, &v, 4);
+    uint8_t sign = (uint8_t)((x >> 24) & 0x80u);
+    uint32_t fexp = (x >> 23) & 0xFFu;
+    uint32_t man = x & 0x7FFFFFu;
+    int exp_bits = 7 - man_bits;
+    uint32_t emax = (1u << exp_bits) - 1u;
+    /* largest finite code magnitude: e5m2 0x7B, e4m3fn 0x7E */
+    uint8_t max_code = (uint8_t)(has_inf ? ((emax << man_bits) - 1u)
+                                         : ((emax << man_bits)
+                                            | ((1u << man_bits) - 2u)));
+    uint8_t inf_code = (uint8_t)(emax << man_bits);         /* e5m2 only */
+    uint8_t nan_code = (uint8_t)(has_inf ? (inf_code | 0x02u)
+                                         : ((emax << man_bits)
+                                            | ((1u << man_bits) - 1u)));
+    if (fexp == 0xFFu) {
+        if (man)                            /* NaN: canonical quiet code */
+            return sign | nan_code;
+        return sign | (has_inf ? inf_code : nan_code);  /* inf */
+    }
+    int exp = (int)fexp - 127 + bias;
+    int shift = 23 - man_bits;
+    uint32_t out;
+    if (exp <= 0) { /* subnormal target (or underflow to zero) */
+        if (exp < -man_bits)
+            return sign;
+        man |= 0x800000u;                   /* implicit bit */
+        uint32_t s = (uint32_t)(shift + 1 - exp);
+        uint32_t hman = man >> s;
+        uint32_t rem = man & ((1u << s) - 1u);
+        uint32_t halfway = 1u << (s - 1);
+        if (rem > halfway || (rem == halfway && (hman & 1u)))
+            hman++;
+        out = hman;                         /* may carry into exp 1: fine */
+    } else {
+        uint32_t rem = man & ((1u << shift) - 1u);
+        uint32_t hman = man >> shift;
+        out = ((uint32_t)exp << man_bits) | hman;
+        uint32_t halfway = 1u << (shift - 1);
+        if (rem > halfway || (rem == halfway && (hman & 1u)))
+            out++;                          /* carry may bump the exp */
+    }
+    if (out > max_code)                     /* overflow past max finite */
+        return sign | (has_inf ? inf_code : nan_code);
+    return sign | (uint8_t)out;
+}
+
+static inline float bsc_qmax_of(int qk) {
+    return qk == BSC_QK_I8 ? 127.0f
+                           : (qk == BSC_QK_E4M3 ? 448.0f : 57344.0f);
+}
+
+static inline float bsc_q_decode(int qk, uint8_t raw) {
+    switch (qk) {
+    case BSC_QK_I8: return (float)(int8_t)raw;
+    case BSC_QK_E4M3: return bsc_f8_to_float(raw, 3, 7, 0);
+    default: return bsc_f8_to_float(raw, 2, 15, 1);
+    }
+}
+
+static inline uint8_t bsc_q_encode(int qk, float v) {
+    if (qk == BSC_QK_I8) {
+        if (!isfinite(v))
+            return 0;               /* NaN/inf quantize to 0 (reference) */
+        float r = rintf(v);         /* round half to even, like np.rint */
+        if (r > 127.0f) r = 127.0f;
+        if (r < -127.0f) r = -127.0f;
+        return (uint8_t)(int8_t)r;
+    }
+    return qk == BSC_QK_E4M3 ? bsc_float_to_f8(v, 3, 7, 0)
+                             : bsc_float_to_f8(v, 2, 15, 1);
+}
+
+/* ---- decode LUTs (one f32 per code, built from the scalar converters
+ * so they are exact by definition).  bsc_init() populates them before
+ * any thread can race; the lazy fallback writes are idempotent (every
+ * writer stores identical bytes) with the ready flag set last. ---- */
+
+static float bsc_lut_[3][256];
+static volatile int bsc_lut_ready_ = 0;
+
+static inline void bsc_build_luts(void) {
+    for (int c = 0; c < 256; c++) {
+        bsc_lut_[BSC_QK_I8][c] = (float)(int8_t)(uint8_t)c;
+        bsc_lut_[BSC_QK_E4M3][c] = bsc_f8_to_float((uint8_t)c, 3, 7, 0);
+        bsc_lut_[BSC_QK_E5M2][c] = bsc_f8_to_float((uint8_t)c, 2, 15, 1);
+    }
+    bsc_lut_ready_ = 1;
+}
+
+static inline const float *bsc_lut(int qk) {
+    if (!bsc_lut_ready_) bsc_build_luts();
+    return bsc_lut_[qk];
+}
+
+/* ---- runtime dispatch level ------------------------------------------- */
+
+static int bsc_level_ = -1;      /* resolved level: 0 scalar, 1 SSE2, 2 AVX2 */
+static int bsc_max_level_ = 0;   /* what this host supports */
+
+static inline int bsc_detect_max(void) {
+#if BSC_X86
+    return __builtin_cpu_supports("avx2") ? 2 : 1;
+#else
+    return 0;
+#endif
+}
+
+static inline void bsc_init(void) {
+    bsc_build_luts();
+    bsc_max_level_ = bsc_detect_max();
+    int lvl = bsc_max_level_;
+    const char *env = getenv("ACCL_TPU_CODEC_SIMD");
+    if (env && *env) {
+        int want = atoi(env);
+        if (want < 0) want = 0;
+        if (want < lvl) lvl = want;
+    }
+    bsc_level_ = lvl;
+}
+
+static inline int bsc_level(void) {
+    if (bsc_level_ < 0) bsc_init();
+    return bsc_level_;
+}
+
+/* clamp to host support; returns the level actually in effect */
+static inline int bsc_set_level(int lvl) {
+    if (bsc_level_ < 0) bsc_init();
+    if (lvl < 0) lvl = 0;
+    if (lvl > bsc_max_level_) lvl = bsc_max_level_;
+    bsc_level_ = lvl;
+    return bsc_level_;
+}
+
+/* ---- SIMD kernels ------------------------------------------------------ */
+#if BSC_X86
+
+/* fp8 encode, 16 floats/iter: integer RNE fast path + scalar patch of
+ * the hard lanes (subnormal target / inf / NaN / overflow). */
+static inline void bsc_enc_f8_sse2(int man_bits, int bias, int has_inf,
+                                   const float *x, float inv, uint8_t *q,
+                                   ptrdiff_t bn) {
+    const int shift = 23 - man_bits;
+    const int emax = (1 << (7 - man_bits)) - 1;
+    const int max_code = has_inf ? ((emax << man_bits) - 1)
+                                 : ((emax << man_bits)
+                                    | ((1 << man_bits) - 2));
+    const __m128 vinv = _mm_set1_ps(inv);
+    const __m128i vabs = _mm_set1_epi32(0x7FFFFFFF);
+    const __m128i vone = _mm_set1_epi32(1);
+    const __m128i vhalfm1 = _mm_set1_epi32((1 << (shift - 1)) - 1);
+    const __m128i vrebias = _mm_set1_epi32((127 - bias) << man_bits);
+    const __m128i vminnorm = _mm_set1_epi32((127 - bias + 1) << 23);
+    const __m128i vinfm1 = _mm_set1_epi32(0x7F7FFFFF);
+    const __m128i vmaxcode = _mm_set1_epi32(max_code);
+    const __m128i vsignb = _mm_set1_epi32(0x80);
+    const __m128i vbyte = _mm_set1_epi32(0xFF);
+    ptrdiff_t i = 0;
+    for (; i + 16 <= bn; i += 16) {
+        __m128i c[4];
+        uint32_t hard = 0;
+        for (int k = 0; k < 4; k++) {
+            __m128 p = _mm_mul_ps(_mm_loadu_ps(x + i + 4 * k), vinv);
+            __m128i bits = _mm_castps_si128(p);
+            __m128i A = _mm_and_si128(bits, vabs);
+            __m128i lsb = _mm_and_si128(_mm_srli_epi32(A, shift), vone);
+            __m128i rounded = _mm_srli_epi32(
+                _mm_add_epi32(_mm_add_epi32(A, vhalfm1), lsb), shift);
+            __m128i code = _mm_sub_epi32(rounded, vrebias);
+            __m128i sign = _mm_and_si128(_mm_srli_epi32(bits, 24), vsignb);
+            __m128i hm = _mm_or_si128(
+                _mm_or_si128(_mm_cmplt_epi32(A, vminnorm),
+                             _mm_cmpgt_epi32(A, vinfm1)),
+                _mm_cmpgt_epi32(code, vmaxcode));
+            hard |= (uint32_t)_mm_movemask_ps(_mm_castsi128_ps(hm))
+                    << (4 * k);
+            c[k] = _mm_and_si128(_mm_or_si128(code, sign), vbyte);
+        }
+        __m128i w0 = _mm_packs_epi32(c[0], c[1]);
+        __m128i w1 = _mm_packs_epi32(c[2], c[3]);
+        _mm_storeu_si128((__m128i *)(q + i), _mm_packus_epi16(w0, w1));
+        while (hard) {
+            int j = __builtin_ctz(hard);
+            hard &= hard - 1;
+            q[i + j] = bsc_float_to_f8(x[i + j] * inv, man_bits, bias,
+                                       has_inf);
+        }
+    }
+    for (; i < bn; i++)
+        q[i] = bsc_float_to_f8(x[i] * inv, man_bits, bias, has_inf);
+}
+
+/* int8 encode, 16 floats/iter: clamp to +-127 in float, cvtps2dq under
+ * the default round-to-nearest-even MXCSR, non-finite masked to 0. */
+static inline void bsc_enc_i8_sse2(const float *x, float inv, uint8_t *q,
+                                   ptrdiff_t bn) {
+    const __m128 vinv = _mm_set1_ps(inv);
+    const __m128 vlo = _mm_set1_ps(-127.0f);
+    const __m128 vhi = _mm_set1_ps(127.0f);
+    const __m128i vabs = _mm_set1_epi32(0x7FFFFFFF);
+    const __m128i vinf = _mm_set1_epi32(0x7F800000);
+    ptrdiff_t i = 0;
+    for (; i + 16 <= bn; i += 16) {
+        __m128i c[4];
+        for (int k = 0; k < 4; k++) {
+            __m128 p = _mm_mul_ps(_mm_loadu_ps(x + i + 4 * k), vinv);
+            __m128i A = _mm_and_si128(_mm_castps_si128(p), vabs);
+            __m128i finite = _mm_cmplt_epi32(A, vinf);
+            __m128 cl = _mm_min_ps(_mm_max_ps(p, vlo), vhi);
+            c[k] = _mm_and_si128(_mm_cvtps_epi32(cl), finite);
+        }
+        __m128i w0 = _mm_packs_epi32(c[0], c[1]);
+        __m128i w1 = _mm_packs_epi32(c[2], c[3]);
+        _mm_storeu_si128((__m128i *)(q + i), _mm_packs_epi16(w0, w1));
+    }
+    for (; i < bn; i++)
+        q[i] = bsc_q_encode(BSC_QK_I8, x[i] * inv);
+}
+
+/* LUT decode + scale multiply, 4/iter */
+static inline void bsc_dec_sse2(const float *lut, const uint8_t *q,
+                                float s, float *out, ptrdiff_t bn) {
+    const __m128 vs = _mm_set1_ps(s);
+    ptrdiff_t i = 0;
+    for (; i + 4 <= bn; i += 4) {
+        __m128 v = _mm_setr_ps(lut[q[i]], lut[q[i + 1]], lut[q[i + 2]],
+                               lut[q[i + 3]]);
+        _mm_storeu_ps(out + i, _mm_mul_ps(v, vs));
+    }
+    for (; i < bn; i++)
+        out[i] = lut[q[i]] * s;
+}
+
+/* fused dequant+combine.  MAX/MIN are pure blends on cmpgt|cmpunord so
+ * numpy's strict-compare/second-wins-ties/NaN-propagates rule holds
+ * bit-for-bit (FMAX_NP semantics). */
+static inline void bsc_comb_sse2(int func, const float *lut,
+                                 const uint8_t *q, float s,
+                                 const float *other, float *out,
+                                 ptrdiff_t bn) {
+    const __m128 vs = _mm_set1_ps(s);
+    ptrdiff_t i = 0;
+    for (; i + 4 <= bn; i += 4) {
+        __m128 v = _mm_mul_ps(
+            _mm_setr_ps(lut[q[i]], lut[q[i + 1]], lut[q[i + 2]],
+                        lut[q[i + 3]]),
+            vs);
+        __m128 o = _mm_loadu_ps(other + i);
+        __m128 r;
+        switch (func) {
+        case BSC_F_SUM: r = _mm_add_ps(o, v); break;
+        case BSC_F_PROD: r = _mm_mul_ps(o, v); break;
+        case BSC_F_MAX: {
+            __m128 m = _mm_or_ps(_mm_cmpgt_ps(o, v),
+                                 _mm_cmpunord_ps(o, o));
+            r = _mm_or_ps(_mm_and_ps(m, o), _mm_andnot_ps(m, v));
+            break;
+        }
+        default: { /* BSC_F_MIN */
+            __m128 m = _mm_or_ps(_mm_cmplt_ps(o, v),
+                                 _mm_cmpunord_ps(o, o));
+            r = _mm_or_ps(_mm_and_ps(m, o), _mm_andnot_ps(m, v));
+            break;
+        }
+        }
+        _mm_storeu_ps(out + i, r);
+    }
+    for (; i < bn; i++) {
+        float v = lut[q[i]] * s;
+        float o = other[i];
+        switch (func) {
+        case BSC_F_SUM: out[i] = o + v; break;
+        case BSC_F_PROD: out[i] = o * v; break;
+        case BSC_F_MAX: out[i] = (o > v || isnan(o)) ? o : v; break;
+        default: out[i] = (o < v || isnan(o)) ? o : v; break;
+        }
+    }
+}
+
+/* blockwise absmax with the NaN flag tracked separately — maxps drops
+ * NaNs (it returns the second operand on unordered compares), so the
+ * scalar's NaN-propagating max is reproduced via an accumulated
+ * cmpunord mask instead. */
+static inline float bsc_absmax_sse2(const float *x, ptrdiff_t bn) {
+    const __m128 vabs = _mm_castsi128_ps(_mm_set1_epi32(0x7FFFFFFF));
+    __m128 vm = _mm_setzero_ps();
+    __m128 vnan = _mm_setzero_ps();
+    ptrdiff_t i = 0;
+    for (; i + 4 <= bn; i += 4) {
+        __m128 v = _mm_loadu_ps(x + i);
+        vnan = _mm_or_ps(vnan, _mm_cmpunord_ps(v, v));
+        vm = _mm_max_ps(vm, _mm_and_ps(v, vabs));
+    }
+    if (_mm_movemask_ps(vnan))
+        return NAN;
+    float lanes[4];
+    _mm_storeu_ps(lanes, vm);
+    float m = lanes[0];
+    for (int k = 1; k < 4; k++)
+        if (lanes[k] > m) m = lanes[k];
+    for (; i < bn; i++) {
+        float av = fabsf(x[i]);
+        if (isnan(av) || av > m) m = av;
+    }
+    return m;
+}
+
+/* ---- AVX2 twins (compiled with a per-function target so the baseline
+ * build stays SSE2-portable; entered only when cpuid says avx2) ---- */
+
+__attribute__((target("avx2"))) static inline void bsc_enc_f8_avx2(
+    int man_bits, int bias, int has_inf, const float *x, float inv,
+    uint8_t *q, ptrdiff_t bn) {
+    const int shift = 23 - man_bits;
+    const int emax = (1 << (7 - man_bits)) - 1;
+    const int max_code = has_inf ? ((emax << man_bits) - 1)
+                                 : ((emax << man_bits)
+                                    | ((1 << man_bits) - 2));
+    const __m256 vinv = _mm256_set1_ps(inv);
+    const __m256i vabs = _mm256_set1_epi32(0x7FFFFFFF);
+    const __m256i vone = _mm256_set1_epi32(1);
+    const __m256i vhalfm1 = _mm256_set1_epi32((1 << (shift - 1)) - 1);
+    const __m256i vrebias = _mm256_set1_epi32((127 - bias) << man_bits);
+    const __m256i vminnorm = _mm256_set1_epi32((127 - bias + 1) << 23);
+    const __m256i vinf = _mm256_set1_epi32(0x7F800000);
+    const __m256i vmaxcode = _mm256_set1_epi32(max_code);
+    const __m256i vsignb = _mm256_set1_epi32(0x80);
+    const __m256i vbyte = _mm256_set1_epi32(0xFF);
+    /* packs/packus interleave the two 128-bit lanes; this permute
+     * restores sequential byte order (dwords 0,4,1,5,2,6,3,7) */
+    const __m256i vperm = _mm256_setr_epi32(0, 4, 1, 5, 2, 6, 3, 7);
+    ptrdiff_t i = 0;
+    for (; i + 32 <= bn; i += 32) {
+        __m256i c[4];
+        uint32_t hard = 0;
+        for (int k = 0; k < 4; k++) {
+            __m256 p = _mm256_mul_ps(_mm256_loadu_ps(x + i + 8 * k), vinv);
+            __m256i bits = _mm256_castps_si256(p);
+            __m256i A = _mm256_and_si256(bits, vabs);
+            __m256i lsb = _mm256_and_si256(_mm256_srli_epi32(A, shift),
+                                           vone);
+            __m256i rounded = _mm256_srli_epi32(
+                _mm256_add_epi32(_mm256_add_epi32(A, vhalfm1), lsb),
+                shift);
+            __m256i code = _mm256_sub_epi32(rounded, vrebias);
+            __m256i sign = _mm256_and_si256(_mm256_srli_epi32(bits, 24),
+                                            vsignb);
+            /* A >= inf == !(A < inf): cmpgt(vinf, A) inverted via the
+             * or-of-three shape below needs A > inf-1; keep the SSE2
+             * formulation with a cmpgt against 0x7F7FFFFF */
+            __m256i hm = _mm256_or_si256(
+                _mm256_or_si256(
+                    _mm256_cmpgt_epi32(vminnorm, A),
+                    _mm256_cmpgt_epi32(A,
+                                       _mm256_sub_epi32(vinf, vone))),
+                _mm256_cmpgt_epi32(code, vmaxcode));
+            hard |= (uint32_t)_mm256_movemask_ps(_mm256_castsi256_ps(hm))
+                    << (8 * k);
+            c[k] = _mm256_and_si256(_mm256_or_si256(code, sign), vbyte);
+        }
+        __m256i w0 = _mm256_packs_epi32(c[0], c[1]);
+        __m256i w1 = _mm256_packs_epi32(c[2], c[3]);
+        __m256i bytes = _mm256_permutevar8x32_epi32(
+            _mm256_packus_epi16(w0, w1), vperm);
+        _mm256_storeu_si256((__m256i *)(q + i), bytes);
+        while (hard) {
+            int j = __builtin_ctz(hard);
+            hard &= hard - 1;
+            q[i + j] = bsc_float_to_f8(x[i + j] * inv, man_bits, bias,
+                                       has_inf);
+        }
+    }
+    if (i < bn)
+        bsc_enc_f8_sse2(man_bits, bias, has_inf, x + i, inv, q + i,
+                        bn - i);
+}
+
+__attribute__((target("avx2"))) static inline void bsc_enc_i8_avx2(
+    const float *x, float inv, uint8_t *q, ptrdiff_t bn) {
+    const __m256 vinv = _mm256_set1_ps(inv);
+    const __m256 vlo = _mm256_set1_ps(-127.0f);
+    const __m256 vhi = _mm256_set1_ps(127.0f);
+    const __m256i vabs = _mm256_set1_epi32(0x7FFFFFFF);
+    const __m256i vinf = _mm256_set1_epi32(0x7F800000);
+    const __m256i vperm = _mm256_setr_epi32(0, 4, 1, 5, 2, 6, 3, 7);
+    ptrdiff_t i = 0;
+    for (; i + 32 <= bn; i += 32) {
+        __m256i c[4];
+        for (int k = 0; k < 4; k++) {
+            __m256 p = _mm256_mul_ps(_mm256_loadu_ps(x + i + 8 * k), vinv);
+            __m256i A = _mm256_and_si256(_mm256_castps_si256(p), vabs);
+            __m256i finite = _mm256_cmpgt_epi32(vinf, A);
+            __m256 cl = _mm256_min_ps(_mm256_max_ps(p, vlo), vhi);
+            c[k] = _mm256_and_si256(_mm256_cvtps_epi32(cl), finite);
+        }
+        __m256i w0 = _mm256_packs_epi32(c[0], c[1]);
+        __m256i w1 = _mm256_packs_epi32(c[2], c[3]);
+        __m256i bytes = _mm256_permutevar8x32_epi32(
+            _mm256_packs_epi16(w0, w1), vperm);
+        _mm256_storeu_si256((__m256i *)(q + i), bytes);
+    }
+    if (i < bn)
+        bsc_enc_i8_sse2(x + i, inv, q + i, bn - i);
+}
+
+__attribute__((target("avx2"))) static inline void bsc_dec_avx2(
+    const float *lut, const uint8_t *q, float s, float *out,
+    ptrdiff_t bn) {
+    const __m256 vs = _mm256_set1_ps(s);
+    ptrdiff_t i = 0;
+    for (; i + 8 <= bn; i += 8) {
+        __m256i idx = _mm256_cvtepu8_epi32(
+            _mm_loadl_epi64((const __m128i *)(q + i)));
+        __m256 v = _mm256_i32gather_ps(lut, idx, 4);
+        _mm256_storeu_ps(out + i, _mm256_mul_ps(v, vs));
+    }
+    for (; i < bn; i++)
+        out[i] = lut[q[i]] * s;
+}
+
+__attribute__((target("avx2"))) static inline void bsc_comb_avx2(
+    int func, const float *lut, const uint8_t *q, float s,
+    const float *other, float *out, ptrdiff_t bn) {
+    const __m256 vs = _mm256_set1_ps(s);
+    ptrdiff_t i = 0;
+    for (; i + 8 <= bn; i += 8) {
+        __m256i idx = _mm256_cvtepu8_epi32(
+            _mm_loadl_epi64((const __m128i *)(q + i)));
+        __m256 v = _mm256_mul_ps(_mm256_i32gather_ps(lut, idx, 4), vs);
+        __m256 o = _mm256_loadu_ps(other + i);
+        __m256 r;
+        switch (func) {
+        case BSC_F_SUM: r = _mm256_add_ps(o, v); break;
+        case BSC_F_PROD: r = _mm256_mul_ps(o, v); break;
+        case BSC_F_MAX: {
+            __m256 m = _mm256_or_ps(_mm256_cmp_ps(o, v, _CMP_GT_OQ),
+                                    _mm256_cmp_ps(o, o, _CMP_UNORD_Q));
+            r = _mm256_or_ps(_mm256_and_ps(m, o),
+                             _mm256_andnot_ps(m, v));
+            break;
+        }
+        default: {
+            __m256 m = _mm256_or_ps(_mm256_cmp_ps(o, v, _CMP_LT_OQ),
+                                    _mm256_cmp_ps(o, o, _CMP_UNORD_Q));
+            r = _mm256_or_ps(_mm256_and_ps(m, o),
+                             _mm256_andnot_ps(m, v));
+            break;
+        }
+        }
+        _mm256_storeu_ps(out + i, r);
+    }
+    if (i < bn)
+        bsc_comb_sse2(func, lut, q + i, s, other + i, out + i, bn - i);
+}
+
+__attribute__((target("avx2"))) static inline float bsc_absmax_avx2(
+    const float *x, ptrdiff_t bn) {
+    const __m256 vabs = _mm256_castsi256_ps(
+        _mm256_set1_epi32(0x7FFFFFFF));
+    __m256 vm = _mm256_setzero_ps();
+    __m256 vnan = _mm256_setzero_ps();
+    ptrdiff_t i = 0;
+    for (; i + 8 <= bn; i += 8) {
+        __m256 v = _mm256_loadu_ps(x + i);
+        vnan = _mm256_or_ps(vnan, _mm256_cmp_ps(v, v, _CMP_UNORD_Q));
+        vm = _mm256_max_ps(vm, _mm256_and_ps(v, vabs));
+    }
+    if (_mm256_movemask_ps(vnan))
+        return NAN;
+    float lanes[8];
+    _mm256_storeu_ps(lanes, vm);
+    float m = lanes[0];
+    for (int k = 1; k < 8; k++)
+        if (lanes[k] > m) m = lanes[k];
+    for (; i < bn; i++) {
+        float av = fabsf(x[i]);
+        if (isnan(av) || av > m) m = av;
+    }
+    return m;
+}
+
+#endif /* BSC_X86 */
+
+/* ---- scalar reference loops (the portable fallback, and the baseline
+ * the SIMD paths are tested bit-identical against) ---- */
+
+static inline float bsc_absmax_scalar(const float *x, ptrdiff_t bn) {
+    float m = 0.0f;
+    for (ptrdiff_t i = 0; i < bn; i++) {
+        float av = fabsf(x[i]);
+        if (isnan(av) || av > m)    /* NaN-propagating max (np.max) */
+            m = av;
+    }
+    return m;
+}
+
+static inline void bsc_enc_scalar(int qk, const float *x, float inv,
+                                  uint8_t *q, ptrdiff_t bn) {
+    for (ptrdiff_t i = 0; i < bn; i++)
+        q[i] = bsc_q_encode(qk, x[i] * inv);
+}
+
+static inline void bsc_dec_scalar(int qk, const uint8_t *q, float s,
+                                  float *out, ptrdiff_t bn) {
+    for (ptrdiff_t i = 0; i < bn; i++)
+        out[i] = bsc_q_decode(qk, q[i]) * s;
+}
+
+static inline int bsc_comb_scalar(int func, int qk, const uint8_t *q,
+                                  float s, const float *other, float *out,
+                                  ptrdiff_t bn) {
+    for (ptrdiff_t i = 0; i < bn; i++) {
+        float v = bsc_q_decode(qk, q[i]) * s;
+        float o = other[i];
+        switch (func) {
+        case BSC_F_SUM: out[i] = o + v; break;
+        case BSC_F_PROD: out[i] = o * v; break;
+        case BSC_F_MAX: out[i] = (o > v || isnan(o)) ? o : v; break;
+        case BSC_F_MIN: out[i] = (o < v || isnan(o)) ? o : v; break;
+        default: return -1;
+        }
+    }
+    return 0;
+}
+
+/* ---- public blockwise entry points ------------------------------------ */
+
+static inline void bsc_quantize(int qk, ptrdiff_t block, const float *x,
+                                float *scales, uint8_t *q, ptrdiff_t n) {
+    int lvl = bsc_level();
+    float qmax = bsc_qmax_of(qk);
+    ptrdiff_t nb = (n + block - 1) / block;
+    for (ptrdiff_t b = 0; b < nb; b++) {
+        ptrdiff_t lo = b * block;
+        ptrdiff_t hi = lo + block < n ? lo + block : n;
+        ptrdiff_t bn = hi - lo;
+        float m;
+#if BSC_X86
+        if (lvl == 2 && bn >= 8)
+            m = bsc_absmax_avx2(x + lo, bn);
+        else if (lvl >= 1 && bn >= 4)
+            m = bsc_absmax_sse2(x + lo, bn);
+        else
+#endif
+            m = bsc_absmax_scalar(x + lo, bn);
+        float s = m / qmax;
+        if (!(s >= FLT_MIN && s < INFINITY))
+            s = 1.0f;     /* zero/subnormal/NaN/inf absmax: identity scale */
+        scales[b] = s;
+        float inv = 1.0f / s;
+#if BSC_X86
+        if (lvl >= 1 && bn >= 16) {
+            if (qk == BSC_QK_I8) {
+                if (lvl == 2)
+                    bsc_enc_i8_avx2(x + lo, inv, q + lo, bn);
+                else
+                    bsc_enc_i8_sse2(x + lo, inv, q + lo, bn);
+            } else {
+                int mb = qk == BSC_QK_E4M3 ? 3 : 2;
+                int bias = qk == BSC_QK_E4M3 ? 7 : 15;
+                int hi8 = qk == BSC_QK_E5M2;
+                if (lvl == 2)
+                    bsc_enc_f8_avx2(mb, bias, hi8, x + lo, inv, q + lo, bn);
+                else
+                    bsc_enc_f8_sse2(mb, bias, hi8, x + lo, inv, q + lo, bn);
+            }
+            continue;
+        }
+#endif
+        bsc_enc_scalar(qk, x + lo, inv, q + lo, bn);
+    }
+}
+
+static inline void bsc_dequant(int qk, ptrdiff_t block, const float *scales,
+                               const uint8_t *q, float *out, ptrdiff_t n) {
+    int lvl = bsc_level();
+    const float *lut = bsc_lut(qk);
+    (void)lut;
+    for (ptrdiff_t b = 0; b * block < n; b++) {
+        ptrdiff_t lo = b * block;
+        ptrdiff_t hi = lo + block < n ? lo + block : n;
+        ptrdiff_t bn = hi - lo;
+        float s = scales[b];
+#if BSC_X86
+        if (lvl == 2 && bn >= 8) {
+            bsc_dec_avx2(lut, q + lo, s, out + lo, bn);
+            continue;
+        }
+        if (lvl >= 1 && bn >= 4) {
+            bsc_dec_sse2(lut, q + lo, s, out + lo, bn);
+            continue;
+        }
+#endif
+        bsc_dec_scalar(qk, q + lo, s, out + lo, bn);
+    }
+}
+
+static inline int bsc_combine(int func, int qk, ptrdiff_t block,
+                              const float *scales, const uint8_t *q,
+                              const float *other, float *out, ptrdiff_t n) {
+    if (func < BSC_F_SUM || func > BSC_F_PROD)
+        return -1;
+    int lvl = bsc_level();
+    const float *lut = bsc_lut(qk);
+    (void)lut;
+    for (ptrdiff_t b = 0; b * block < n; b++) {
+        ptrdiff_t lo = b * block;
+        ptrdiff_t hi = lo + block < n ? lo + block : n;
+        ptrdiff_t bn = hi - lo;
+        float s = scales[b];
+#if BSC_X86
+        if (lvl == 2 && bn >= 8) {
+            bsc_comb_avx2(func, lut, q + lo, s, other + lo, out + lo, bn);
+            continue;
+        }
+        if (lvl >= 1 && bn >= 4) {
+            bsc_comb_sse2(func, lut, q + lo, s, other + lo, out + lo, bn);
+            continue;
+        }
+#endif
+        if (bsc_comb_scalar(func, qk, q + lo, s, other + lo, out + lo, bn))
+            return -1;
+    }
+    return 0;
+}
+
+#endif /* ACCL_BS_CODEC_H */

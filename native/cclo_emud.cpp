@@ -34,7 +34,9 @@
 #include <cmath>
 #include <cstdio>
 #include <cstdlib>
+#include <set>
 
+#include "bs_codec.h"
 #include "protocol.hpp"
 
 using namespace accl_proto;
@@ -101,63 +103,28 @@ static uint16_t float_to_bf16(float f) {
   return static_cast<uint16_t>(bits >> 16);
 }
 
-// fp8 codecs (ml_dtypes float8_e4m3fn / float8_e5m2 twins): decode is exact
-// per the bit layout; encode rounds to nearest representable (ties to the
-// even code) via a precomputed decode table, saturating at the max finite
-// value. e4m3fn: 1-4-3 bias 7, no inf, NaN = S.1111.111 (finite codes
-// 0x00..0x7E); e5m2: 1-5-2 bias 15, IEEE-style inf/NaN (finite 0x00..0x7B).
+// fp8 codecs (ml_dtypes float8_e4m3fn / float8_e5m2 twins): shared with
+// the compiled combine kernels via bs_codec.h — ONE implementation, held
+// bit-identical to the ml_dtypes parity corpus (full 256-code product,
+// ±0/NaN/inf) by tests/test_combine_native.py. e4m3fn: 1-4-3 bias 7, no
+// inf; e5m2: 1-5-2 bias 15, IEEE-style inf/NaN.
 static float fp8_decode(uint8_t v, bool e4m3) {
-  int mbits = e4m3 ? 3 : 2;
-  int ebits = e4m3 ? 4 : 5;
-  int bias = e4m3 ? 7 : 15;
-  int sign = v >> 7;
-  int exp = (v >> mbits) & ((1 << ebits) - 1);
-  int man = v & ((1 << mbits) - 1);
-  if (e4m3) {
-    if (exp == 15 && man == 7) return std::nanf("");
-  } else if (exp == 31) {
-    if (man) return std::nanf("");
-    return sign ? -INFINITY : INFINITY;
-  }
-  float val = exp == 0
-      ? std::ldexp(static_cast<float>(man), 1 - bias - mbits)
-      : std::ldexp(1.0f + man / static_cast<float>(1 << mbits), exp - bias);
-  return sign ? -val : val;
+  return e4m3 ? bsc_f8_to_float(v, 3, 7, 0) : bsc_f8_to_float(v, 2, 15, 1);
 }
 
 static uint8_t fp8_encode(float f, bool e4m3) {
-  static float dec_e4m3[0x7F], dec_e5m2[0x7C];
-  static bool init = [] {
-    for (int i = 0; i < 0x7F; ++i) dec_e4m3[i] = fp8_decode((uint8_t)i, true);
-    for (int i = 0; i < 0x7C; ++i) dec_e5m2[i] = fp8_decode((uint8_t)i, false);
-    return true;
-  }();
-  (void)init;
-  if (std::isnan(f)) return e4m3 ? 0x7F : 0x7E;
-  const float* dec = e4m3 ? dec_e4m3 : dec_e5m2;
-  int n = e4m3 ? 0x7F : 0x7C;  // finite positive codes [0, n)
-  uint8_t sign = std::signbit(f) ? 0x80 : 0;
-  float af = std::fabs(f);
-  if (!e4m3 && std::isinf(f)) return sign | 0x7C;
-  // ml_dtypes round-to-nearest overflow semantics (matches the Python
-  // emu/daemon tiers): values whose rounding exceeds the max finite become
-  // NaN for e4m3fn (no inf in the format; the halfway point saturates) and
-  // +/-inf for e5m2 (IEEE: the halfway point already rounds to inf).
-  float maxf = dec[n - 1], half_ulp = 0.5f * (dec[n - 1] - dec[n - 2]);
-  if (e4m3 ? (af > maxf + half_ulp) : (af >= maxf + half_ulp))
-    return e4m3 ? (uint8_t)(sign | 0x7F) : (uint8_t)(sign | 0x7C);
-  if (af >= maxf) return sign | (uint8_t)(n - 1);  // saturate
-  // binary search the first code with dec[code] >= af, then round
-  int lo = 0, hi = n - 1;
-  while (lo < hi) {
-    int mid = (lo + hi) / 2;
-    if (dec[mid] < af) lo = mid + 1; else hi = mid;
+  return e4m3 ? bsc_float_to_f8(f, 3, 7, 0) : bsc_float_to_f8(f, 2, 15, 1);
+}
+
+// scale-block wire dtype -> bs_codec quantizer kind (quant._QCODES twin:
+// the wire qcode IS the dtype code, 6 = int8 / 8 = e4m3fn / 9 = e5m2)
+static int bs_qk_of(uint8_t dt) {
+  switch (dt) {
+    case DT_I8: return BSC_QK_I8;
+    case DT_F8E4M3: return BSC_QK_E4M3;
+    case DT_F8E5M2: return BSC_QK_E5M2;
+    default: return -1;
   }
-  if (lo == 0) return sign;
-  float up = dec[lo] - af, down = af - dec[lo - 1];
-  if (down < up) return sign | (uint8_t)(lo - 1);
-  if (up < down) return sign | (uint8_t)lo;
-  return sign | (uint8_t)((lo & 1) ? lo - 1 : lo);  // tie: even code
 }
 
 // read element i of a typed buffer as double
@@ -252,12 +219,102 @@ static void reduce_inplace(std::vector<uint8_t>& a,
 }
 
 // ---------------------------------------------------------------------------
+// scale-block packed wire segments (accl_tpu/quant.py twins): the
+// self-describing [magic 0xB5 | qcode u8 | block u16 | count u32 |
+// f32 scales | q payload] layout both tiers emit and parse, quantized
+// and dequantized through the shared bs_codec entry points
+// ---------------------------------------------------------------------------
+static void bs_to_f32(const std::vector<uint8_t>& in, uint8_t dt,
+                      std::vector<float>& out) {
+  if (dt == DT_F32) {
+    std::memcpy(out.data(), in.data(), out.size() * 4);
+    return;
+  }
+  for (size_t i = 0; i < out.size(); ++i)
+    out[i] = (float)load_elem(in.data(), dt, i);
+}
+
+static std::vector<uint8_t> bs_from_f32(const std::vector<float>& f,
+                                        uint8_t dt) {
+  std::vector<uint8_t> out(f.size() * dtype_size(dt));
+  if (dt == DT_F32) {
+    std::memcpy(out.data(), f.data(), out.size());
+    return out;
+  }
+  for (size_t i = 0; i < f.size(); ++i) store_elem(out.data(), dt, i, f[i]);
+  return out;
+}
+
+// quantize `count` elements of `data` (stored as udtype) into one packed
+// segment (quant.quantize_packed parity: wire qcode IS the dtype code)
+static std::vector<uint8_t> bs_pack(const std::vector<uint8_t>& data,
+                                    uint8_t udtype, uint8_t cdtype,
+                                    uint32_t block, uint64_t count) {
+  std::vector<float> f(count);
+  bs_to_f32(data, udtype, f);
+  int qk = bs_qk_of(cdtype);
+  uint64_t nb = (count + block - 1) / block;
+  std::vector<uint8_t> out(8 + 4 * nb + count);
+  out[0] = 0xB5;
+  out[1] = cdtype;
+  out[2] = (uint8_t)block;
+  out[3] = (uint8_t)(block >> 8);
+  out[4] = (uint8_t)count;
+  out[5] = (uint8_t)(count >> 8);
+  out[6] = (uint8_t)(count >> 16);
+  out[7] = (uint8_t)(count >> 24);
+  bsc_quantize(qk, (ptrdiff_t)block,
+               f.data(), reinterpret_cast<float*>(out.data() + 8),
+               out.data() + 8 + 4 * nb, (ptrdiff_t)count);
+  return out;
+}
+
+// parsed packed segment, held raw so the fused path can bsc_combine
+// straight off the wire bytes (quant.dequant_combine_packed parity)
+struct BsSeg {
+  bool valid = false;
+  int qk = -1;
+  uint32_t block = 0;
+  uint64_t count = 0;
+  std::vector<uint8_t> seg;
+  const float* scales() const {
+    return reinterpret_cast<const float*>(seg.data() + 8);
+  }
+  const uint8_t* q() const {
+    return seg.data() + 8 + 4 * ((count + block - 1) / block);
+  }
+};
+
+static bool bs_parse(std::vector<uint8_t>&& payload, BsSeg* out) {
+  if (payload.size() < 8 || payload[0] != 0xB5) return false;
+  int qk = bs_qk_of(payload[1]);
+  uint32_t block = (uint32_t)payload[2] | ((uint32_t)payload[3] << 8);
+  uint64_t count = (uint64_t)payload[4] | ((uint64_t)payload[5] << 8) |
+                   ((uint64_t)payload[6] << 16) | ((uint64_t)payload[7] << 24);
+  if (qk < 0 || block < 32 || block > 4096 || (block & (block - 1)))
+    return false;
+  uint64_t nb = (count + block - 1) / block;
+  if (payload.size() != 8 + 4 * nb + count) return false;
+  out->qk = qk;
+  out->block = block;
+  out->count = count;
+  out->seg = std::move(payload);
+  out->valid = true;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
 // envelope + rx pool (rxbuf_offload / seek_rx_buffer / wait_on_rx parity)
 // ---------------------------------------------------------------------------
 struct Envelope {
   uint32_t src, dst, tag, seqn, comm_id;
   uint8_t strm, dtype;
   uint64_t nbytes;
+  // trailing integrity word (crc32c over the payload bytes): present only
+  // when the sender appended one — frames from unchecksummed senders
+  // parse with has_csum false and skip verification (protocol.py twins)
+  bool has_csum = false;
+  uint32_t csum = 0;
 };
 
 struct RxBuffer {
@@ -445,6 +502,9 @@ class EthFabric {
   bool listening() const { return ok() && !stopping_.load(); }
   uint32_t connect_all();   // openCon parity (eager session open)
   void disconnect_all();    // close per-peer sessions (lazy re-dial later)
+  bool csum_enabled() const { return csum_; }
+  int retx_window() const { return retx_window_; }
+  void reset_retx();  // soft reset: retx ring + trackers restart at zero
 
  private:
   void accept_loop();
@@ -488,6 +548,57 @@ class EthFabric {
     bool stop = false;
   };
   std::map<uint32_t, std::unique_ptr<DeliverQ>> dqs_;
+
+  // ---- reliability endpoint (emulator/reliability.RetxEndpoint twin) ----
+  // Sender side: every strm=0 data frame is kept in a per-(dst, comm)
+  // in-flight ring (the fully-encoded eth frame, so a retransmission is
+  // one sendto) until acked; an RTO scan thread re-fires expired flights
+  // with exponential backoff, and selective-ack gaps trigger a one-shot
+  // NACK fast-retransmit. Receiver side: a per-(src, comm) cum+ooo
+  // tracker dedups/horizon-bounds arrivals and acks every enqueued frame
+  // on the strm=2 control lane. Engaged only on the UDP stack with a
+  // nonzero window ($ACCL_TPU_RETX_WINDOW), like the python fabrics.
+  static constexpr double kRtoS = 0.05;       // pre-sample default
+  static constexpr double kRtoMinS = 0.005;
+  static constexpr double kRtoMaxS = 1.0;
+  static constexpr int kMaxTries = 10;
+  static constexpr uint32_t kSeqnHorizon = 1u << 18;
+  struct Flight {
+    std::vector<uint8_t> frame;  // encoded eth frame (header+payload+csum)
+    double deadline = 0.0;
+    double t0 = 0.0;
+    int tries = 0;
+    bool fast = false;  // one-shot NACK fast-retransmit already fired
+  };
+  bool udp_send_frame(uint32_t dst, const std::vector<uint8_t>& frame);
+  void track(const Envelope& env, const std::vector<uint8_t>& frame);
+  void on_ack(uint32_t src, uint32_t comm_id, uint32_t cum,
+              const std::vector<uint32_t>& sel);
+  void send_ack(uint32_t dst, uint32_t comm_id, uint32_t cum,
+                const std::vector<uint32_t>& sel);
+  void retx_tick_loop();
+  double cur_rto_locked() const;
+  double rto_of_locked(int tries, uint32_t dst, uint32_t comm_id,
+                       uint32_t seqn) const;
+  void note_rtt_locked(const Flight& fl);
+  bool csum_ = false;
+  int retx_window_ = 0;
+  // deterministic TX chaos for the mixed-world sweep
+  // ($ACCL_TPU_CHAOS_TX_DROP / $ACCL_TPU_CHAOS_TX_CORRUPT = N: every
+  // Nth outgoing DATA frame is dropped / payload-bit-flipped before the
+  // socket). ACK frames are exempt (recovery must never turn against
+  // itself); the in-flight ring keeps the intact original, so an RTO
+  // resend — a fresh counter draw — eventually gets through.
+  int chaos_drop_every_ = 0, chaos_corrupt_every_ = 0;
+  std::atomic<uint64_t> chaos_tx_n_{0};
+  std::mutex retx_mu_;
+  std::condition_variable retx_space_;
+  std::map<std::pair<uint32_t, uint32_t>, std::map<uint32_t, Flight>> ring_;
+  size_t inflight_ = 0;
+  // receiver tracker: (src, comm) -> (cum expected seqn, out-of-order set)
+  std::map<std::pair<uint32_t, uint32_t>,
+           std::pair<uint32_t, std::set<uint32_t>>> rcv_;
+  double srtt_ = -1.0, rttvar_ = 0.0;  // Jacobson/Karels, Karn-filtered
 };
 
 // ---------------------------------------------------------------------------
@@ -521,12 +632,27 @@ struct CallCtx {
   uint64_t max_seg;
   uint8_t compression;
   uint8_t stream = 0;  // StreamFlags: 1 = OP0_STREAM, 2 = RES_STREAM
+  // scale-block size for C_BLOCK_SCALED calls (elements per f32 scale,
+  // pow2 in [32, 4096]); 0 when the call is not block-scaled
+  uint32_t qblock = 0;
 
+  bool block_scaled() const {
+    return qblock != 0 && (compression & C_BLOCK_SCALED) != 0;
+  }
   size_t ebytes(bool compressed) const {
     return dtype_size(compressed ? cdtype : udtype);
   }
   uint64_t seg_elems() const {
-    size_t e = dtype_size((compression & C_ETH) ? cdtype : udtype);
+    bool ethc = (compression & C_ETH) != 0;
+    size_t e = dtype_size(ethc ? cdtype : udtype);
+    if (ethc && block_scaled()) {
+      // packed-segment budget (quant.seg_elems twin): 8B header + one
+      // f32 scale per block (worst case 1 bit/elem at the 32-elem
+      // minimum) + partial-block slack must fit max_seg
+      if (max_seg <= 12) return 1;
+      uint64_t s = 8 * (max_seg - 12) / (8 * (uint64_t)e + 1);
+      return s ? s : 1;
+    }
     uint64_t s = max_seg / (e ? e : 1);
     return s ? s : 1;
   }
@@ -651,15 +777,23 @@ static const uint64_t BARRIER_SCRATCH_ADDR = 1ull << 60;
 static uint32_t expand(std::vector<Move>& mv, const CallCtx& c_in, uint8_t op,
                        int func, uint64_t count, uint32_t root, uint32_t tag,
                        uint64_t a0, uint64_t a1, uint64_t a2,
-                       uint8_t alg = ALG_AUTO) {
+                       uint8_t alg = ALG_AUTO,
+                       std::string* feature = nullptr) {
   // stream flags apply only to copy/combine/send/recv
   // (moveengine.expand_call parity) — a collective's internal copies
   // must never source/sink the external-kernel stream ports
   CallCtx c = c_in;
   if (op != OP_COPY && op != OP_COMBINE && op != OP_SEND && op != OP_RECV)
     c.stream = 0;
-  if (c.compression & C_BLOCK_SCALED)
-    return E_COMPRESSION;  // no scale-block codec on this tier
+  if (c.compression & C_BLOCK_SCALED) {
+    // scale-block wire executes natively (bs_codec twins of quant.py) —
+    // but only onto quantizable wire dtypes; anything else is a typed,
+    // NAMED config error so the driver surfaces the gap precisely
+    if (bs_qk_of(c.cdtype) < 0) {
+      if (feature) *feature = "block-scaled wire dtype";
+      return E_COMPRESSION;
+    }
+  }
   const uint32_t W = c.world, me = c.me;
   size_t eb = c.ebytes(c.compression & C_OP0);
   size_t ebr = c.ebytes(c.compression & C_RES);
@@ -911,9 +1045,11 @@ static uint32_t expand(std::vector<Move>& mv, const CallCtx& c_in, uint8_t op,
     }
     case OP_ALLTOALLV:
       // count vectors arrive in a trailing record this daemon does not
-      // parse; reject typed (the C_BLOCK_SCALED convention above) so
-      // the gap surfaces as a capability error, never as a hung or
-      // mismatched fixed-count exchange against Python-tier peers
+      // parse; reject typed AND named (the feature name rides in the
+      // status-reply payload) so the gap surfaces as a capability error,
+      // never as a hung or mismatched fixed-count exchange against
+      // Python-tier peers
+      if (feature) *feature = "alltoallv";
       return E_NOT_IMPLEMENTED;
     default:
       return E_INVALID;
@@ -969,10 +1105,11 @@ class RankDaemon {
   }
 
   void ingest(const Envelope& env, std::vector<uint8_t>&& payload) {
-    if (env.strm >= 2) return;  // reliability-layer control frames
-    // (retransmission ACK strm=2, heartbeat strm=3, emulator/protocol.py):
-    // the native daemon implements neither — ignore them rather than
-    // stream-deliver garbage into the kernel ports
+    if (env.strm >= 2) return;  // control lanes (emulator/protocol.py):
+    // retransmission ACKs (strm=2) are consumed by the UDP fabric's
+    // deliver() before this point; heartbeat/RMA lanes (strm>=3) stay
+    // python-tier features — ignore them rather than stream-deliver
+    // garbage into the kernel ports
     if (env.strm) {
       std::lock_guard<std::mutex> lk(stream_mu_);
       stream_in_.push_back({env, std::move(payload)});
@@ -994,20 +1131,41 @@ class RankDaemon {
                          Communicator& comm) {
     for (const auto& m : moves) {
       std::vector<uint8_t> op0, op1;  // in uncompressed dtype
+      BsSeg ps1;  // op1's raw packed segment when it arrived block-scaled
       uint32_t err;
       bool have0 = false, have1 = false;
       err = fetch(m.op0, m, c, comm, &op0, &have0);
       if (err) return err;
-      err = fetch(m.op1, m, c, comm, &op1, &have1);
+      err = fetch(m.op1, m, c, comm, &op1, &have1, &ps1);
       if (err) return err;
       std::vector<uint8_t>* result = nullptr;
       if (have0 && have1) {
         if (m.func < 0) return E_INVALID;
-        reduce_inplace(op0, op1, c.udtype, (uint8_t)m.func, m.count);
+        if (ps1.valid) {
+          // fused dequant->combine straight off the wire bytes
+          // (quant.dequant_combine_packed twin): f32 accumulation,
+          // bit-identical to dequantize-then-reduce in f32
+          std::vector<float> a(m.count), r(m.count);
+          bs_to_f32(op0, c.udtype, a);
+          if (bsc_combine(m.func, ps1.qk, (ptrdiff_t)ps1.block,
+                          ps1.scales(), ps1.q(), a.data(), r.data(),
+                          (ptrdiff_t)m.count))
+            return E_INVALID;
+          op0 = bs_from_f32(r, c.udtype);
+        } else {
+          reduce_inplace(op0, op1, c.udtype, (uint8_t)m.func, m.count);
+        }
         result = &op0;
       } else if (have0) {
         result = &op0;
       } else if (have1) {
+        if (ps1.valid) {
+          // plain packed recv: dequantize to the uncompressed dtype
+          std::vector<float> f(m.count);
+          bsc_dequant(ps1.qk, (ptrdiff_t)ps1.block, ps1.scales(), ps1.q(),
+                      f.data(), (ptrdiff_t)m.count);
+          op1 = bs_from_f32(f, c.udtype);
+        }
         result = &op1;
       } else {
         return E_INVALID;
@@ -1027,8 +1185,20 @@ class RankDaemon {
         }
       }
       if (m.res_remote) {
-        uint8_t wire_dt = m.eth_compressed ? c.cdtype : c.udtype;
-        auto wire = convert(*result, c.udtype, wire_dt, m.count);
+        std::vector<uint8_t> wire;
+        uint8_t wire_dt;
+        if (m.eth_compressed && c.block_scaled()) {
+          // block-scaled wire: requantize the result into one packed
+          // [header | f32 scales | q] segment (quantize_packed twin) —
+          // in-flight requantization at every reduce hop, like the
+          // python tiers
+          wire = bs_pack(*result, c.udtype, c.cdtype, c.qblock, m.count);
+          wire_dt = c.cdtype;
+          bs_encoded_segs_++;
+        } else {
+          wire_dt = m.eth_compressed ? c.cdtype : c.udtype;
+          wire = convert(*result, c.udtype, wire_dt, m.count);
+        }
         RankInfo& peer = comm.ranks[m.dst_rank];
         Envelope env;
         env.src = comm.my_global();
@@ -1048,7 +1218,8 @@ class RankDaemon {
   }
 
   uint32_t fetch(const Operand& o, const Move& m, const CallCtx& c,
-                 Communicator& comm, std::vector<uint8_t>* out, bool* have) {
+                 Communicator& comm, std::vector<uint8_t>* out, bool* have,
+                 BsSeg* ps = nullptr) {
     *have = false;
     if (o.mode == M_NONE) return E_OK;
     if (o.mode == M_IMM) {
@@ -1070,6 +1241,17 @@ class RankDaemon {
                       timeout_, &env, &payload))
         return E_RECV_TIMEOUT;
       peer.inbound_seq++;
+      if (ps && m.eth_compressed && c.block_scaled()) {
+        // self-describing packed segment: validated against its own
+        // header AND the move's count (executor._fetch twin) — malformed
+        // or mismatched segments are typed compression errors, and the
+        // raw bytes stay packed for the caller's fused combine
+        if (!bs_parse(std::move(payload), ps) || ps->count != m.count)
+          return E_COMPRESSION;
+        bs_decoded_segs_++;
+        *have = true;
+        return E_OK;
+      }
       size_t n = env.nbytes / dtype_size(env.dtype);
       if (n != m.count) return E_DMA_MISMATCH;
       *out = convert(payload, env.dtype, c.udtype, m.count);
@@ -1157,7 +1339,8 @@ class RankDaemon {
         job = std::move(call_queue_.front());
         call_queue_.pop_front();
       }
-      uint8_t scenario = job.second.empty() ? OP_NOP : job.second[0];
+      uint8_t scenario =
+          job.second.empty() ? (uint8_t)OP_NOP : job.second[0];
       // waitfor error propagation (FIFO retirement means every wire
       // dependency already retired): a failed dependency fails this
       // call without executing it. Failed ids persist in a bounded map
@@ -1175,9 +1358,10 @@ class RankDaemon {
           if (it != failed_calls_.end()) { err = it->second; break; }
         }
       }
+      std::string feature;
       if (err == E_OK) {
         try {
-          err = run_call(job.second);
+          err = run_call(job.second, &feature);
         } catch (const std::exception& e) {
           // a hostile/buggy descriptor (absurd count -> bad_alloc, ...)
           // must retire as an error, not terminate the daemon
@@ -1195,12 +1379,17 @@ class RankDaemon {
         call_status_[job.first] = err;
         if (err != E_OK) {
           failed_calls_.emplace(job.first, err);
+          // unsupported-feature names ride alongside the error word (a
+          // strict subset of failed_calls_, aged out with it) so MSG_WAIT
+          // can name the gap in the status-reply payload
+          if (!feature.empty()) failed_feature_[job.first] = feature;
           while (failed_calls_.size() > 1024) {
             // remember the highest FAILED id the bounded FIFO ages out:
             // a deferred MSG_WAIT at/below this mark cannot tell
             // success from an evicted failure (see MSG_WAIT)
             uint32_t aged = failed_calls_.begin()->first;
             if (aged > failed_evicted_max_) failed_evicted_max_ = aged;
+            failed_feature_.erase(aged);
             failed_calls_.erase(failed_calls_.begin());
           }
         }
@@ -1224,11 +1413,13 @@ class RankDaemon {
     }
   }
 
-  uint32_t run_call(const std::vector<uint8_t>& b) {
+  uint32_t run_call(const std::vector<uint8_t>& b, std::string* feature) {
     // layout matches protocol.pack_call (after the MSG_CALL byte)
     const uint8_t* p = b.data();
     uint8_t scenario = p[0], func = p[1], compression = p[2], stream = p[3];
-    uint8_t udtype = p[4], cdtype = p[5], algorithm = p[6];  // p[7] = pad
+    // p[7]: log2 of the scale-block size for C_BLOCK_SCALED calls
+    // (0 = receiver default of 128, protocol.py pack_call); pad otherwise
+    uint8_t udtype = p[4], cdtype = p[5], algorithm = p[6], qlog = p[7];
     uint64_t count = get_le<uint64_t>(p + 8);
     uint32_t comm_id = get_le<uint32_t>(p + 16);
     uint32_t root = get_le<uint32_t>(p + 20);
@@ -1253,11 +1444,17 @@ class RankDaemon {
     if (scenario != OP_BARRIER &&
         count > MAX_CALL_BYTES / dtype_size(udtype))
       return E_DMA_SIZE;
+    uint32_t qblock = 0;
+    if (compression & C_BLOCK_SCALED) {
+      // clamp to the python quant.clamp_block envelope: pow2 in [32, 4096]
+      qblock = qlog ? (qlog >= 12 ? 4096u : (1u << qlog)) : 128u;
+      if (qblock < 32) qblock = 32;
+    }
     CallCtx c{comm->size(), comm->local_rank, udtype, cdtype, max_seg_,
-              compression, stream};
+              compression, stream, qblock};
     std::vector<Move> moves;
     uint32_t err = expand(moves, c, scenario, func, count, root, tag, a0, a1,
-                          a2, algorithm);
+                          a2, algorithm, feature);
     if (err) return err;
     return execute_moves(moves, c, *comm);
   }
@@ -1348,6 +1545,11 @@ class RankDaemon {
   void soft_reset() {
     pool_.reset();
     {
+      // retx rings/trackers restart with the seqn spaces (vs stack swap)
+      std::lock_guard<std::mutex> elk(eth_mu_);
+      eth_->reset_retx();
+    }
+    {
       // drain stream ports: stale cross-epoch stream data must not leak
       std::lock_guard<std::mutex> lk(stream_mu_);
       stream_in_.clear();
@@ -1409,12 +1611,33 @@ class RankDaemon {
   // ids at/below it from failed_calls_ (retirement is FIFO)
   uint32_t evicted_max_ = 0;
   std::map<uint32_t, uint32_t> failed_calls_;  // persists past MSG_WAIT
+  // unsupported-feature names for failed calls (guarded by call_mu_;
+  // strict subset of failed_calls_, evicted with it)
+  std::map<uint32_t, std::string> failed_feature_;
   uint32_t failed_evicted_max_ = 0;  // highest failure aged out of it
   uint32_t next_call_id_ = 1;
   std::mutex call_mu_;
   std::condition_variable call_cv_;
   std::thread worker_;
   std::vector<std::thread> conn_threads_;
+  // failed-call reply with the feature name riding after the error word
+  // (old drivers slice reply[1:5] and never see it); caller holds call_mu_
+  std::vector<uint8_t> fail_reply(uint32_t id, uint32_t err) {
+    auto it = failed_feature_.find(id);
+    return it == failed_feature_.end()
+               ? status_reply(err)
+               : status_reply(err, it->second.c_str());
+  }
+  // native observability counters (surfaced as text lines in the
+  // MSG_DUMP_RX reply; the chaos harness asserts ENGAGEMENT on them).
+  // They live on the daemon, not the fabric, so a runtime stack swap
+  // cannot zero them mid-experiment.
+  std::atomic<uint64_t> retx_tracked_{0}, retx_retransmits_{0},
+      retx_rto_fires_{0}, retx_fast_retransmits_{0}, retx_acked_{0},
+      retx_dedup_dropped_{0}, retx_horizon_dropped_{0}, retx_gave_up_{0},
+      retx_window_stalls_{0}, retx_acks_sent_{0};
+  std::atomic<uint64_t> integrity_failed_{0};
+  std::atomic<uint64_t> bs_encoded_segs_{0}, bs_decoded_segs_{0};
 };
 
 // ---- EthFabric impl -------------------------------------------------------
@@ -1454,24 +1677,53 @@ static int make_udp_server(uint16_t port) {
   return fd;
 }
 
+// monotonic seconds (retx deadlines; matches time.monotonic usage in the
+// python reliability endpoint)
+static double mono_now() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// splitmix64 finalizer: deterministic retransmission jitter (the python
+// endpoint's _mix analog — desynchronizes RTO herds without an RNG)
+static uint64_t mix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
 EthFabric::EthFabric(uint32_t me, uint16_t listen_port, RankDaemon* daemon,
                      bool udp)
-    : me_(me), daemon_(daemon), udp_(udp) {
+    : me_(me), daemon_(daemon), udp_(udp),
+      csum_(csum_enabled_from_env()),
+      retx_window_(retx_window_from_env()) {
+  if (const char* v = getenv("ACCL_TPU_CHAOS_TX_DROP"))
+    chaos_drop_every_ = atoi(v);
+  if (const char* v = getenv("ACCL_TPU_CHAOS_TX_CORRUPT"))
+    chaos_corrupt_every_ = atoi(v);
   listen_fd_ = udp_ ? make_udp_server(listen_port) : make_server(listen_port);
   if (listen_fd_ < 0) {
     stopping_.store(true);  // never usable; stop()/dtor are no-ops
     return;
   }
-  if (udp_)
+  if (udp_) {
     threads_.emplace_back([this] { udp_recv_loop(); });
-  else
+    // RTO scan thread: only the UDP stack retransmits (TCP recovers in
+    // the kernel); a zero window means nothing is ever tracked
+    if (retx_window_ > 0)
+      threads_.emplace_back([this] { retx_tick_loop(); });
+  } else {
     threads_.emplace_back([this] { accept_loop(); });
+  }
 }
 
 EthFabric::~EthFabric() { stop(); }
 
 void EthFabric::stop() {
   if (stopping_.exchange(true)) return;
+  retx_space_.notify_all();  // unblock window-stalled senders
   ::shutdown(listen_fd_, SHUT_RDWR);
   ::close(listen_fd_);
   {
@@ -1518,6 +1770,9 @@ std::vector<uint8_t> EthFabric::encode_eth(
   body.push_back(env.dtype);
   put_le<uint64_t>(body, env.nbytes);
   body.insert(body.end(), payload.begin(), payload.end());
+  // trailing integrity word: after the payload, outside the header's
+  // nbytes — decoders predating the field never see it (protocol.py)
+  if (env.has_csum) put_le<uint32_t>(body, env.csum);
   return body;
 }
 
@@ -1532,15 +1787,16 @@ bool EthFabric::decode_eth(const uint8_t* p, size_t len, Envelope& env,
   env.strm = p[20];
   env.dtype = p[21];
   env.nbytes = get_le<uint64_t>(p + 22);
-  // Slice the payload by the header's nbytes, NOT the frame length:
-  // checksummed senders (protocol.py, the trailing integrity word this
-  // daemon does not speak — it advertises no CAP_CSUM) append 4 bytes
-  // after the payload, and the documented wire-compat contract is that
-  // decoders predating the field never see them. Taking the trailing
-  // word as payload bytes would mis-size every frame from such a
-  // sender during the pre-probe window.
+  // Slice the payload by the header's nbytes, NOT the frame length: the
+  // trailing integrity word (when the sender appended one) lives after
+  // the payload, outside nbytes, so decoders predating the field never
+  // take it as payload bytes (protocol.py unpack_eth twin).
   if (env.nbytes > len - 30) return false;  // truncated frame
   payload.assign(p + 30, p + 30 + env.nbytes);
+  if (len - 30 >= env.nbytes + 4) {
+    env.csum = get_le<uint32_t>(p + 30 + env.nbytes);
+    env.has_csum = true;
+  }
   return true;
 }
 
@@ -1593,6 +1849,52 @@ void EthFabric::udp_handle(const uint8_t* dgram, size_t len) {
 
 void EthFabric::deliver(uint32_t sender, Envelope&& env,
                         std::vector<uint8_t>&& payload) {
+  // ACK control lane: consumed here, never reaches the rx pool
+  if (env.strm == ACK_STRM) {
+    uint32_t cum;
+    std::vector<uint32_t> sel;
+    if (retx_window_ > 0 &&
+        unpack_ack(payload.data(), payload.size(), &cum, &sel))
+      on_ack(env.src, env.comm_id, cum, sel);
+    return;
+  }
+  // landing integrity check, BEFORE the freshness check (corrupt-as-loss,
+  // daemon._verify_frame twin): the tracker must never record a corrupt
+  // frame's seqn — it would dedup-drop the retransmission of the
+  // original. The frame stays unacked, so the sender's RTO re-fetches it.
+  if (csum_ && env.has_csum && env.strm <= 1 &&
+      crc32c(payload.data(), payload.size()) != env.csum) {
+    daemon_->integrity_failed_++;
+    return;
+  }
+  // receiver freshness tracker (RetxEndpoint.fresh twin); stream frames
+  // (strm=1) bypass seqn ordering like the python endpoint
+  bool tracked = retx_window_ > 0 && env.strm == 0;
+  if (tracked) {
+    auto key = std::make_pair(env.src, env.comm_id);
+    uint32_t ack_cum = 0;
+    bool dup = false;
+    {
+      std::lock_guard<std::mutex> lk(retx_mu_);
+      auto& st = rcv_[key];
+      if (env.seqn >= st.first + kSeqnHorizon) {
+        // far-future frame: dropped UNACKED (a hostile/raced seqn must
+        // not inflate the ooo set; the sender's RTO recovers real ones)
+        daemon_->retx_horizon_dropped_++;
+        return;
+      }
+      if (env.seqn < st.first || st.second.count(env.seqn)) {
+        daemon_->retx_dedup_dropped_++;
+        dup = true;
+        ack_cum = st.first;
+      }
+    }
+    if (dup) {
+      // duplicate: re-ack cumulative state (the original ack was lost)
+      send_ack(env.src, env.comm_id, ack_cum, {});
+      return;
+    }
+  }
   DeliverQ* dq;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -1620,11 +1922,35 @@ void EthFabric::deliver(uint32_t sender, Envelope&& env,
     std::lock_guard<std::mutex> qlk(dq->mu);
     // bounded queue: DROP beyond the depth limit (UDP semantics — no
     // flow control here; unbounded growth would exhaust memory while the
-    // rx pool is full). Drops surface as receive timeouts upstream.
+    // rx pool is full). Dropped frames stay UNACKED so a retransmitting
+    // sender recovers them; otherwise they surface as receive timeouts.
     if (dq->q.size() >= kQueueDepth) return;
-    dq->q.emplace_back(std::move(env), std::move(payload));
+    dq->q.emplace_back(env, std::move(payload));
   }
   dq->cv.notify_one();
+  if (tracked) {
+    // acknowledge only what was actually enqueued (RetxEndpoint.record
+    // twin): advance cum / absorb out-of-order, then cum+selective ack
+    uint32_t cum;
+    std::vector<uint32_t> sel;
+    auto key = std::make_pair(env.src, env.comm_id);
+    {
+      std::lock_guard<std::mutex> lk(retx_mu_);
+      auto& st = rcv_[key];
+      if (env.seqn == st.first) {
+        st.first++;
+        while (st.second.count(st.first)) {
+          st.second.erase(st.first);
+          st.first++;
+        }
+      } else if (env.seqn > st.first) {
+        st.second.insert(env.seqn);
+      }
+      cum = st.first;
+      sel.assign(st.second.begin(), st.second.end());
+    }
+    send_ack(env.src, env.comm_id, cum, sel);
+  }
 }
 
 void EthFabric::accept_loop() {
@@ -1651,8 +1977,16 @@ void EthFabric::recv_loop(int fd) {
     if (body.empty() || body[0] != MSG_ETH) continue;
     Envelope env;
     std::vector<uint8_t> payload;
-    if (decode_eth(body.data() + 1, body.size() - 1, env, payload))
+    if (decode_eth(body.data() + 1, body.size() - 1, env, payload)) {
+      // landing integrity check (corrupt-as-loss; no retx on TCP — the
+      // kernel already guarantees delivery, this guards the app layer)
+      if (csum_ && env.has_csum && env.strm <= 1 &&
+          crc32c(payload.data(), payload.size()) != env.csum) {
+        daemon_->integrity_failed_++;
+        continue;
+      }
       daemon_->ingest(env, std::move(payload));
+    }
   }
   // deregister BEFORE closing: once closed the fd number may be reused by
   // the kernel, and a later stop() must not shutdown an unrelated socket
@@ -1665,46 +1999,262 @@ void EthFabric::recv_loop(int fd) {
   ::close(fd);
 }
 
+// fragment at kMaxPkt with the shared 12B header and sendto each piece;
+// frame excludes the MSG_ETH type byte (datagram boundaries replace
+// stream framing). Shared by fresh sends, ACKs, and retransmissions —
+// a resend re-fragments the stored frame under a fresh msg_id.
+bool EthFabric::udp_send_frame(uint32_t dst,
+                               const std::vector<uint8_t>& frame) {
+  // TX chaos (mixed-world sweep knobs, see member comment): applied to
+  // strm=0 data frames only, on a COPY for corruption so the in-flight
+  // ring always retains the intact original for the RTO resend
+  const std::vector<uint8_t>* out = &frame;
+  std::vector<uint8_t> mangled;
+  if ((chaos_drop_every_ || chaos_corrupt_every_) && frame.size() >= 30 &&
+      frame[20] == 0) {
+    uint64_t n = ++chaos_tx_n_;
+    if (chaos_drop_every_ && n % chaos_drop_every_ == 0)
+      return true;  // vanished on the wire; the RTO scan re-fires it
+    if (chaos_corrupt_every_ && n % chaos_corrupt_every_ == 0) {
+      uint64_t nb = get_le<uint64_t>(frame.data() + 22);
+      if (nb > 0 && 30 + nb <= frame.size()) {
+        mangled = frame;
+        mangled[30 + nb / 2] ^= 0x10;  // header intact: the receiver's
+        out = &mangled;                // csum verify treats it as loss
+      }
+    }
+  }
+  sockaddr_in addr{};
+  uint32_t msg_id;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto ait = peer_addrs_.find(dst);
+    if (ait == peer_addrs_.end()) return false;
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(ait->second.second);
+    inet_pton(AF_INET, ait->second.first.c_str(), &addr.sin_addr);
+    msg_id = next_msg_id_++;
+  }
+  size_t nfrags = out->empty() ? 1 : (out->size() + kMaxPkt - 1) / kMaxPkt;
+  for (size_t i = 0; i < nfrags; ++i) {
+    std::vector<uint8_t> pkt;
+    put_le<uint32_t>(pkt, me_);
+    put_le<uint32_t>(pkt, msg_id);
+    put_le<uint16_t>(pkt, static_cast<uint16_t>(i));
+    put_le<uint16_t>(pkt, static_cast<uint16_t>(nfrags));
+    size_t lo = i * kMaxPkt;
+    size_t hi = std::min(out->size(), lo + kMaxPkt);
+    pkt.insert(pkt.end(), out->begin() + lo, out->begin() + hi);
+    if (::sendto(listen_fd_, pkt.data(), pkt.size(), 0,
+                 reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0)
+      return false;
+  }
+  return true;
+}
+
+// sender in-flight tracking (RetxEndpoint.track twin): bounded per-channel
+// window with a soft cap — a stall-timeout tracks anyway rather than
+// wedging the call worker forever on a dead peer
+void EthFabric::track(const Envelope& env, const std::vector<uint8_t>& frame) {
+  auto key = std::make_pair(env.dst, env.comm_id);
+  std::unique_lock<std::mutex> lk(retx_mu_);
+  auto full = [&] {
+    auto it = ring_.find(key);
+    return it != ring_.end() &&
+           (int)it->second.size() >= retx_window_;
+  };
+  if (full()) {
+    daemon_->retx_window_stalls_++;
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(kRtoMaxS * 4);
+    retx_space_.wait_until(lk, deadline,
+                           [&] { return !full() || stopping_.load(); });
+  }
+  Flight fl;
+  fl.frame = frame;
+  fl.t0 = mono_now();
+  fl.deadline = fl.t0 + cur_rto_locked();
+  ring_[key][env.seqn] = std::move(fl);
+  inflight_++;
+  daemon_->retx_tracked_++;
+}
+
+double EthFabric::cur_rto_locked() const {
+  if (srtt_ < 0.0) return kRtoS;
+  double rto = srtt_ + 4.0 * rttvar_;
+  return rto < kRtoMinS ? kRtoMinS : (rto > kRtoMaxS ? kRtoMaxS : rto);
+}
+
+double EthFabric::rto_of_locked(int tries, uint32_t dst, uint32_t comm_id,
+                                uint32_t seqn) const {
+  double rto = cur_rto_locked() * (double)(1u << (tries > 10 ? 10 : tries));
+  if (rto > kRtoMaxS) rto = kRtoMaxS;
+  uint64_t h = mix64(mix64(((uint64_t)dst << 32) | comm_id) ^
+                     (((uint64_t)tries << 32) | seqn));
+  return rto * (0.75 + 0.5 * (double)(h >> 11) / 9007199254740992.0);
+}
+
+void EthFabric::note_rtt_locked(const Flight& fl) {
+  if (fl.tries) return;  // Karn's rule: retransmitted samples are ambiguous
+  double rtt = mono_now() - fl.t0;
+  if (srtt_ < 0.0) {
+    srtt_ = rtt;
+    rttvar_ = rtt / 2.0;
+  } else {
+    double d = srtt_ - rtt;
+    rttvar_ += 0.25 * ((d < 0 ? -d : d) - rttvar_);
+    srtt_ += 0.125 * (rtt - srtt_);
+  }
+}
+
+// RetxEndpoint.on_ack twin: free everything below cum plus the selective
+// set (RTT samples per Karn), then one-shot fast-retransmit the gap
+// below the highest selective ack — resends happen OUTSIDE the lock
+void EthFabric::on_ack(uint32_t src, uint32_t comm_id, uint32_t cum,
+                       const std::vector<uint32_t>& sel) {
+  auto key = std::make_pair(src, comm_id);
+  std::vector<std::vector<uint8_t>> resend;
+  {
+    std::lock_guard<std::mutex> lk(retx_mu_);
+    auto it = ring_.find(key);
+    if (it == ring_.end()) return;
+    auto& chan = it->second;
+    size_t freed = 0;
+    for (auto fit = chan.begin(); fit != chan.end() && fit->first < cum;) {
+      note_rtt_locked(fit->second);
+      fit = chan.erase(fit);
+      freed++;
+    }
+    for (uint32_t s : sel) {
+      auto fit = chan.find(s);
+      if (fit != chan.end()) {
+        note_rtt_locked(fit->second);
+        chan.erase(fit);
+        freed++;
+      }
+    }
+    if (!sel.empty() && !chan.empty()) {
+      uint32_t gap_hi = *std::max_element(sel.begin(), sel.end());
+      double now = mono_now();
+      for (auto& kv : chan) {
+        if (kv.first < gap_hi && !kv.second.fast) {
+          kv.second.fast = true;
+          kv.second.tries++;
+          kv.second.deadline =
+              now + rto_of_locked(kv.second.tries, src, comm_id, kv.first);
+          resend.push_back(kv.second.frame);
+        }
+      }
+    }
+    if (freed) {
+      inflight_ -= freed;
+      daemon_->retx_acked_ += freed;
+      retx_space_.notify_all();
+    }
+    if (chan.empty()) ring_.erase(it);
+  }
+  for (auto& f : resend) {
+    daemon_->retx_retransmits_++;
+    daemon_->retx_fast_retransmits_++;
+    udp_send_frame(src, f);
+  }
+}
+
+void EthFabric::send_ack(uint32_t dst, uint32_t comm_id, uint32_t cum,
+                         const std::vector<uint32_t>& sel) {
+  // acks are never checksummed, tracked, or counted as data — recovery
+  // must not turn against itself (daemon._send_ack twin)
+  std::vector<uint8_t> payload = pack_ack(cum, sel);
+  Envelope env{};
+  env.src = me_;
+  env.dst = dst;
+  env.tag = 0;
+  env.seqn = cum;
+  env.comm_id = comm_id;
+  env.strm = ACK_STRM;
+  env.dtype = DT_U8;
+  env.nbytes = payload.size();
+  daemon_->retx_acks_sent_++;
+  udp_send_frame(dst, encode_eth(env, payload, false));
+}
+
+// ~10ms RTO scan (the python endpoint's reaper cadence): expired flights
+// retransmit with exponential backoff until the try budget gives up
+void EthFabric::retx_tick_loop() {
+  while (!stopping_.load()) {
+    usleep(10 * 1000);
+    std::vector<std::pair<uint32_t, std::vector<uint8_t>>> resend;
+    double now = mono_now();
+    {
+      std::lock_guard<std::mutex> lk(retx_mu_);
+      if (!inflight_) continue;
+      size_t freed = 0;
+      for (auto it = ring_.begin(); it != ring_.end();) {
+        auto& chan = it->second;
+        for (auto fit = chan.begin(); fit != chan.end();) {
+          Flight& fl = fit->second;
+          if (fl.deadline > now) {
+            ++fit;
+            continue;
+          }
+          if (fl.tries >= kMaxTries) {
+            daemon_->retx_gave_up_++;
+            inflight_--;
+            freed++;
+            fit = chan.erase(fit);
+            continue;
+          }
+          fl.tries++;
+          fl.deadline = now + rto_of_locked(fl.tries, it->first.first,
+                                            it->first.second, fit->first);
+          resend.emplace_back(it->first.first, fl.frame);
+          ++fit;
+        }
+        if (chan.empty()) it = ring_.erase(it);
+        else ++it;
+      }
+      if (freed) retx_space_.notify_all();
+    }
+    for (auto& r : resend) {
+      daemon_->retx_retransmits_++;
+      daemon_->retx_rto_fires_++;
+      udp_send_frame(r.first, r.second);
+    }
+  }
+}
+
+void EthFabric::reset_retx() {
+  std::lock_guard<std::mutex> lk(retx_mu_);
+  ring_.clear();
+  rcv_.clear();
+  inflight_ = 0;
+  srtt_ = -1.0;
+  rttvar_ = 0.0;
+  retx_space_.notify_all();
+}
+
 bool EthFabric::send_msg(const Envelope& env,
                          const std::vector<uint8_t>& payload) {
+  // data/stream frames get the trailing integrity word when checksums
+  // are enabled; computed BEFORE tracking so the in-flight ring stores
+  // the verified frame and a retransmission carries the same word
+  Envelope e = env;
+  if (csum_ && e.strm <= 1 && !payload.empty()) {
+    e.csum = crc32c(payload.data(), payload.size());
+    e.has_csum = true;
+  }
   if (udp_) {
-    // fragment at kMaxPkt with the shared 12B header; frame excludes the
-    // MSG_ETH type byte (datagram boundaries replace stream framing)
-    std::vector<uint8_t> frame = encode_eth(env, payload, false);
-    sockaddr_in addr{};
-    uint32_t msg_id;
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      auto ait = peer_addrs_.find(env.dst);
-      if (ait == peer_addrs_.end()) return false;
-      addr.sin_family = AF_INET;
-      addr.sin_port = htons(ait->second.second);
-      inet_pton(AF_INET, ait->second.first.c_str(), &addr.sin_addr);
-      msg_id = next_msg_id_++;
-    }
-    size_t nfrags = frame.empty() ? 1 : (frame.size() + kMaxPkt - 1) / kMaxPkt;
-    for (size_t i = 0; i < nfrags; ++i) {
-      std::vector<uint8_t> pkt;
-      put_le<uint32_t>(pkt, me_);
-      put_le<uint32_t>(pkt, msg_id);
-      put_le<uint16_t>(pkt, static_cast<uint16_t>(i));
-      put_le<uint16_t>(pkt, static_cast<uint16_t>(nfrags));
-      size_t lo = i * kMaxPkt;
-      size_t hi = std::min(frame.size(), lo + kMaxPkt);
-      pkt.insert(pkt.end(), frame.begin() + lo, frame.begin() + hi);
-      if (::sendto(listen_fd_, pkt.data(), pkt.size(), 0,
-                   reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0)
-        return false;
-    }
-    return true;
+    std::vector<uint8_t> frame = encode_eth(e, payload, false);
+    if (retx_window_ > 0 && e.strm == 0) track(e, frame);
+    return udp_send_frame(e.dst, frame);
   }
   int fd;
   std::mutex* peer_mu;
   {
     std::lock_guard<std::mutex> lk(mu_);
-    auto it = peers_.find(env.dst);
+    auto it = peers_.find(e.dst);
     if (it == peers_.end()) {
-      auto ait = peer_addrs_.find(env.dst);
+      auto ait = peer_addrs_.find(e.dst);
       if (ait == peer_addrs_.end()) return false;
       fd = ::socket(AF_INET, SOCK_STREAM, 0);
       sockaddr_in addr{};
@@ -1717,15 +2267,15 @@ bool EthFabric::send_msg(const Envelope& env,
       }
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-      peers_[env.dst] = fd;
-      peer_mus_[env.dst] = std::make_unique<std::mutex>();
+      peers_[e.dst] = fd;
+      peer_mus_[e.dst] = std::make_unique<std::mutex>();
     } else {
       fd = it->second;
     }
-    peer_mu = peer_mus_[env.dst].get();
+    peer_mu = peer_mus_[e.dst].get();
   }
   std::lock_guard<std::mutex> plk(*peer_mu);
-  std::vector<uint8_t> body = encode_eth(env, payload, true);
+  std::vector<uint8_t> body = encode_eth(e, payload, true);
   return send_frame(fd, body);
 }
 
@@ -2051,9 +2601,9 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
           // unknowable and 0 would be a fabricated success
           if (--wait_active_[id] == 0) wait_active_.erase(id);
           auto f = failed_calls_.find(id);
-          if (f != failed_calls_.end()) return status_reply(f->second);
+          if (f != failed_calls_.end()) return fail_reply(id, f->second);
           return status_reply(
-              id <= failed_evicted_max_ ? E_OUTCOME_UNKNOWN : 0);
+              id <= failed_evicted_max_ ? (uint32_t)E_OUTCOME_UNKNOWN : 0u);
         }
         if (call_cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
           pending = true;
@@ -2064,7 +2614,7 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
       if (pending) return status_reply(STATUS_PENDING);
       uint32_t err = call_status_[id];
       call_status_.erase(id);
-      return status_reply(err);
+      return err ? fail_reply(id, err) : status_reply(err);
     }
     case MSG_GET_INFO: {
       // base geometry + config-state extension (readable effect of the
@@ -2083,12 +2633,18 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
       }
       put_le<uint32_t>(reply, profiled_calls_);
       // capability word (keep in sync with protocol.py CAP_*): this
-      // daemon has NO retransmission ACK responder (bit 0 clear — the
-      // Python daemons probe exactly this at configure time and pin
-      // their retx window to 0 for mixed worlds) and no one-sided RMA
-      // engine (bit 1 clear — RMA strm lanes are ignored like any
-      // strm >= 2 control frame)
-      put_le<uint32_t>(reply, 0);
+      // daemon speaks the UDP selective-retransmission ACK lane
+      // (CAP_RETX_ACK — python peers stop pinning their retx window to
+      // 0) and, unless $ACCL_TPU_CSUM disables it, trailing-crc32c
+      // payload integrity (CAP_CSUM | CAP_CSUM_C, bit-identical to
+      // google-crc32c). CAP_RMA and CAP_SHM stay clear: the one-sided
+      // RMA engine and the shm dataplane remain python-tier lanes.
+      {
+        std::lock_guard<std::mutex> elk(eth_mu_);  // vs stack swap
+        uint32_t caps = CAP_RETX_ACK;
+        if (eth_->csum_enabled()) caps |= CAP_CSUM | CAP_CSUM_C;
+        put_le<uint32_t>(reply, caps);
+      }
       return reply;
     }
     case MSG_STREAM_PUSH: {
@@ -2154,7 +2710,35 @@ std::vector<uint8_t> RankDaemon::handle(const std::vector<uint8_t>& body,
       return status_reply(E_OK);
     }
     case MSG_DUMP_RX: {
+      // pool geometry + the native counter families as text lines
+      // (chaos/observability harnesses parse `name=value` pairs here,
+      // like the python daemons' counter dumps)
       std::string s = pool_.describe();
+      char line[512];
+      snprintf(line, sizeof line,
+               "\nretx: tracked=%llu retransmits=%llu rto_fires=%llu "
+               "fast_retransmits=%llu acked=%llu dedup_dropped=%llu "
+               "horizon_dropped=%llu gave_up=%llu window_stalls=%llu "
+               "acks_sent=%llu",
+               (unsigned long long)retx_tracked_.load(),
+               (unsigned long long)retx_retransmits_.load(),
+               (unsigned long long)retx_rto_fires_.load(),
+               (unsigned long long)retx_fast_retransmits_.load(),
+               (unsigned long long)retx_acked_.load(),
+               (unsigned long long)retx_dedup_dropped_.load(),
+               (unsigned long long)retx_horizon_dropped_.load(),
+               (unsigned long long)retx_gave_up_.load(),
+               (unsigned long long)retx_window_stalls_.load(),
+               (unsigned long long)retx_acks_sent_.load());
+      s += line;
+      snprintf(line, sizeof line, "\nintegrity: failed=%llu",
+               (unsigned long long)integrity_failed_.load());
+      s += line;
+      snprintf(line, sizeof line,
+               "\ncodec: bs_encoded=%llu bs_decoded=%llu simd_level=%d",
+               (unsigned long long)bs_encoded_segs_.load(),
+               (unsigned long long)bs_decoded_segs_.load(), bsc_level());
+      s += line;
       std::vector<uint8_t> reply{MSG_DATA};
       reply.insert(reply.end(), s.begin(), s.end());
       return reply;
@@ -2182,6 +2766,7 @@ int main(int argc, char** argv) {
     else if (k == "--bufsize") bufsize = atoll(v);
     else if (k == "--stack") udp = (std::string(v) == "udp");
   }
+  bsc_init();  // resolve the codec SIMD level once, before any traffic
   RankDaemon daemon(rank, world, port_base, nbufs, bufsize, udp);
   return daemon.serve(static_cast<uint16_t>(port_base + rank));
 }

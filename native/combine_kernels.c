@@ -33,6 +33,8 @@
 #include <stdint.h>
 #include <string.h>
 
+#include "bs_codec.h"
+
 /* func codes (accl_tpu.constants.ReduceFunc) */
 #define F_SUM 0
 #define F_MAX 1
@@ -111,101 +113,15 @@ static inline uint16_t float_to_half(float v) {
     return out;
 }
 
-/* ---- fp8 conversion (ml_dtypes parity, pinned empirically by
- * tests/test_combine_native.py over all 256 codes + a dense f32 corpus):
- * e4m3fn — 4 exp / 3 man, bias 7, NO inf: the all-ones-exponent codes
- * are ordinary values except mantissa 111 (0x7F/0xFF = NaN); rounding
- * past 448+ulp/2 (exclusive) and every inf/NaN input map to sign|0x7F.
- * e5m2 — 5 exp / 2 man, bias 15, IEEE-shaped: overflow rounds to inf
- * (sign|0x7C), NaN canonicalizes to sign|0x7E. Both round-to-nearest-
- * even including the subnormal range, like the half conversion above. */
+/* ---- fp8 conversion: shared with the native daemon via bs_codec.h
+ * (ml_dtypes parity, pinned empirically by tests/test_combine_native.py
+ * over all 256 codes + a dense f32 corpus).  The thin wrappers keep the
+ * reduce bodies below readable. ---- */
 
-static inline float f8_to_float(uint8_t h, int man_bits, int bias,
-                                int has_inf) {
-    uint32_t sign = (uint32_t)(h & 0x80u) << 24;
-    int exp_bits = 7 - man_bits;
-    uint32_t man_mask = (1u << man_bits) - 1u;
-    uint32_t exp = ((uint32_t)h >> man_bits) & ((1u << exp_bits) - 1u);
-    uint32_t man = h & man_mask;
-    uint32_t emax = (1u << exp_bits) - 1u;
-    uint32_t f;
-    if (exp == emax && (has_inf || man == man_mask)) {
-        /* specials (ml_dtypes decodes pinned by test): e5m2 all-ones
-         * exponent is inf (man 0) / canonical quiet NaN; e4m3fn has no
-         * inf and only mantissa-all-ones is NaN — every other all-ones-
-         * exponent code is an ordinary value (falls through below) */
-        f = sign | (man ? 0x7FC00000u : (has_inf ? 0x7F800000u
-                                                 : 0x7FC00000u));
-    } else if (exp == 0) {
-        if (man == 0) {
-            f = sign;
-        } else { /* subnormal: renormalize into f32 */
-            uint32_t e = 127 - bias + 1;
-            while (!(man & (1u << man_bits))) { man <<= 1; e--; }
-            man &= man_mask;
-            f = sign | (e << 23) | (man << (23 - man_bits));
-        }
-    } else {
-        f = sign | ((exp - bias + 127u) << 23) | (man << (23 - man_bits));
-    }
-    float out;
-    memcpy(&out, &f, 4);
-    return out;
-}
-
-static inline uint8_t float_to_f8(float v, int man_bits, int bias,
-                                  int has_inf) {
-    uint32_t x;
-    memcpy(&x, &v, 4);
-    uint8_t sign = (uint8_t)((x >> 24) & 0x80u);
-    uint32_t fexp = (x >> 23) & 0xFFu;
-    uint32_t man = x & 0x7FFFFFu;
-    int exp_bits = 7 - man_bits;
-    uint32_t emax = (1u << exp_bits) - 1u;
-    /* largest finite code magnitude: e5m2 0x7B, e4m3fn 0x7E */
-    uint8_t max_code = (uint8_t)(has_inf ? ((emax << man_bits) - 1u)
-                                         : ((emax << man_bits)
-                                            | ((1u << man_bits) - 2u)));
-    uint8_t inf_code = (uint8_t)(emax << man_bits);         /* e5m2 only */
-    uint8_t nan_code = (uint8_t)(has_inf ? (inf_code | 0x02u)
-                                         : ((emax << man_bits)
-                                            | ((1u << man_bits) - 1u)));
-    if (fexp == 0xFFu) {
-        if (man)                            /* NaN: canonical quiet code */
-            return sign | nan_code;
-        return sign | (has_inf ? inf_code : nan_code);  /* inf */
-    }
-    int exp = (int)fexp - 127 + bias;
-    int shift = 23 - man_bits;
-    uint32_t out;
-    if (exp <= 0) { /* subnormal target (or underflow to zero) */
-        if (exp < -man_bits)
-            return sign;
-        man |= 0x800000u;                   /* implicit bit */
-        uint32_t s = (uint32_t)(shift + 1 - exp);
-        uint32_t hman = man >> s;
-        uint32_t rem = man & ((1u << s) - 1u);
-        uint32_t halfway = 1u << (s - 1);
-        if (rem > halfway || (rem == halfway && (hman & 1u)))
-            hman++;
-        out = hman;                         /* may carry into exp 1: fine */
-    } else {
-        uint32_t rem = man & ((1u << shift) - 1u);
-        uint32_t hman = man >> shift;
-        out = ((uint32_t)exp << man_bits) | hman;
-        uint32_t halfway = 1u << (shift - 1);
-        if (rem > halfway || (rem == halfway && (hman & 1u)))
-            out++;                          /* carry may bump the exp */
-    }
-    if (out > max_code)                     /* overflow past max finite */
-        return sign | (has_inf ? inf_code : nan_code);
-    return sign | (uint8_t)out;
-}
-
-static inline float e4m3_to_float(uint8_t h) { return f8_to_float(h, 3, 7, 0); }
-static inline uint8_t float_to_e4m3(float v) { return float_to_f8(v, 3, 7, 0); }
-static inline float e5m2_to_float(uint8_t h) { return f8_to_float(h, 2, 15, 1); }
-static inline uint8_t float_to_e5m2(float v) { return float_to_f8(v, 2, 15, 1); }
+static inline float e4m3_to_float(uint8_t h) { return bsc_f8_to_float(h, 3, 7, 0); }
+static inline uint8_t float_to_e4m3(float v) { return bsc_float_to_f8(v, 3, 7, 0); }
+static inline float e5m2_to_float(uint8_t h) { return bsc_f8_to_float(h, 2, 15, 1); }
+static inline uint8_t float_to_e5m2(float v) { return bsc_float_to_f8(v, 2, 15, 1); }
 
 static inline float bf16_to_float(uint16_t h) {
     uint32_t x = (uint32_t)h << 16;
@@ -423,122 +339,37 @@ static PyObject *reduce_into(PyObject *self, PyObject *const *args,
 
 /* ---- block-scaled quantized wire kernels (accl_tpu/quant.py) ----------
  * One f32 scale per `block` elements (absmax / qmax, clamped to a sane
- * positive-finite value), fp8/int8 payload. Contract: BIT-IDENTICAL to
- * the numpy reference in accl_tpu/quant.py — every float step below is
- * a single f32 rounding in the same order the vectorized numpy performs
- * (multiply by the reciprocal, rintf = round-half-even, clip, cast), so
- * serial/streamed/native-vs-numpy differentials all agree. The baseline
- * -O3 build has no FMA contraction (SSE2 target), which the reference
- * corpus would catch if a toolchain ever fused the combine's mul+add. */
-
-#define QK_I8 0
-#define QK_E4M3 1
-#define QK_E5M2 2
+ * positive-finite value), fp8/int8 payload.  The loops live in
+ * bs_codec.h (shared with cclo_emud's wire lanes) with SSE2/AVX2 fast
+ * paths behind a runtime dispatch; every path stays BIT-IDENTICAL to
+ * the numpy reference in accl_tpu/quant.py — same single f32 roundings
+ * in the same order (multiply by the reciprocal, rintf/RNE, clip,
+ * cast), so serial/streamed/native-vs-numpy differentials all agree. */
 
 static int qkind_of(int dt) {
     switch (dt) {
-    case DT_I8: return QK_I8;
-    case DT_F8E4M3: return QK_E4M3;
-    case DT_F8E5M2: return QK_E5M2;
+    case DT_I8: return BSC_QK_I8;
+    case DT_F8E4M3: return BSC_QK_E4M3;
+    case DT_F8E5M2: return BSC_QK_E5M2;
     default: return -1;
     }
 }
 
-static float qmax_of(int qk) {
-    return qk == QK_I8 ? 127.0f : (qk == QK_E4M3 ? 448.0f : 57344.0f);
-}
-
-static inline float q_decode(int qk, uint8_t raw) {
-    switch (qk) {
-    case QK_I8: return (float)(int8_t)raw;
-    case QK_E4M3: return e4m3_to_float(raw);
-    default: return e5m2_to_float(raw);
-    }
-}
-
-static inline uint8_t q_encode(int qk, float v) {
-    if (qk == QK_I8) {
-        if (!isfinite(v))
-            return 0;               /* NaN/inf quantize to 0 (reference) */
-        float r = rintf(v);         /* round half to even, like np.rint */
-        if (r > 127.0f) r = 127.0f;
-        if (r < -127.0f) r = -127.0f;
-        return (uint8_t)(int8_t)r;
-    }
-    return qk == QK_E4M3 ? float_to_e4m3(v) : float_to_e5m2(v);
-}
-
 static void run_bs_quantize(int qk, Py_ssize_t block, const float *x,
                             float *scales, uint8_t *q, Py_ssize_t n) {
-    float qmax = qmax_of(qk);
-    Py_ssize_t nb = (n + block - 1) / block;
-    for (Py_ssize_t b = 0; b < nb; b++) {
-        Py_ssize_t lo = b * block;
-        Py_ssize_t hi = lo + block < n ? lo + block : n;
-        float m = 0.0f;
-        for (Py_ssize_t i = lo; i < hi; i++) {
-            float av = fabsf(x[i]);
-            if (isnan(av) || av > m)    /* NaN-propagating max (np.max) */
-                m = av;
-        }
-        float s = m / qmax;
-        if (!(s >= FLT_MIN && s < INFINITY))
-            s = 1.0f;     /* zero/subnormal/NaN/inf absmax: identity scale */
-        scales[b] = s;
-        float inv = 1.0f / s;
-        for (Py_ssize_t i = lo; i < hi; i++)
-            q[i] = q_encode(qk, x[i] * inv);
-    }
+    bsc_quantize(qk, (ptrdiff_t)block, x, scales, q, (ptrdiff_t)n);
 }
 
 static void run_bs_dequant(int qk, Py_ssize_t block, const float *scales,
                            const uint8_t *q, float *out, Py_ssize_t n) {
-    for (Py_ssize_t b = 0; b * block < n; b++) {
-        Py_ssize_t lo = b * block;
-        Py_ssize_t hi = lo + block < n ? lo + block : n;
-        float s = scales[b];
-        for (Py_ssize_t i = lo; i < hi; i++)
-            out[i] = q_decode(qk, q[i]) * s;
-    }
+    bsc_dequant(qk, (ptrdiff_t)block, scales, q, out, (ptrdiff_t)n);
 }
 
 static int run_bs_combine(int func, int qk, Py_ssize_t block,
                           const float *scales, const uint8_t *q,
                           const float *other, float *out, Py_ssize_t n) {
-    for (Py_ssize_t b = 0; b * block < n; b++) {
-        Py_ssize_t lo = b * block;
-        Py_ssize_t hi = lo + block < n ? lo + block : n;
-        float s = scales[b];
-        switch (func) {
-        case F_SUM:
-            for (Py_ssize_t i = lo; i < hi; i++) {
-                float v = q_decode(qk, q[i]) * s;
-                out[i] = other[i] + v;
-            }
-            break;
-        case F_PROD:
-            for (Py_ssize_t i = lo; i < hi; i++) {
-                float v = q_decode(qk, q[i]) * s;
-                out[i] = other[i] * v;
-            }
-            break;
-        case F_MAX:
-            for (Py_ssize_t i = lo; i < hi; i++) {
-                float v = q_decode(qk, q[i]) * s;
-                out[i] = FMAX_NP(other[i], v);
-            }
-            break;
-        case F_MIN:
-            for (Py_ssize_t i = lo; i < hi; i++) {
-                float v = q_decode(qk, q[i]) * s;
-                out[i] = FMIN_NP(other[i], v);
-            }
-            break;
-        default:
-            return -1;
-        }
-    }
-    return 0;
+    return bsc_combine(func, qk, (ptrdiff_t)block, scales, q, other, out,
+                       (ptrdiff_t)n);
 }
 
 /* shared arg plumbing: (ints..., buffers...) with n derived from the q
@@ -705,6 +536,30 @@ static PyObject *bs_combine(PyObject *self, PyObject *const *args,
     Py_RETURN_NONE;
 }
 
+/* ---- codec dispatch introspection: the bit-identity tests drive both
+ * the vectorized and the scalar path in-process through these (no
+ * subprocess/env round trip), and the benchmarks label their ladders
+ * with the level actually measured. ---- */
+
+static PyObject *codec_level(PyObject *self, PyObject *args) {
+    (void)self;
+    (void)args;
+    return PyLong_FromLong(bsc_level());
+}
+
+static PyObject *codec_set_level(PyObject *self, PyObject *const *args,
+                                 Py_ssize_t nargs) {
+    (void)self;
+    if (nargs != 1) {
+        PyErr_SetString(PyExc_TypeError, "codec_set_level(level)");
+        return NULL;
+    }
+    int lvl = (int)PyLong_AsLong(args[0]);
+    if (lvl == -1 && PyErr_Occurred())
+        return NULL;
+    return PyLong_FromLong(bsc_set_level(lvl));
+}
+
 static PyMethodDef methods[] = {
     {"reduce_into", (PyCFunction)(void (*)(void))reduce_into,
      METH_FASTCALL,
@@ -725,6 +580,13 @@ static PyMethodDef methods[] = {
      "bs_combine(func, dtype_code, block, scales_f32, q, other_f32, "
      "out_f32): fused dequant+combine — out[i] = func(other[i], "
      "decode(q[i]) * scales[i/block]) with f32 accumulation."},
+    {"codec_level", (PyCFunction)codec_level, METH_NOARGS,
+     "codec_level(): active block-scale codec dispatch level "
+     "(0=scalar, 1=SSE2, 2=AVX2)."},
+    {"codec_set_level", (PyCFunction)(void (*)(void))codec_set_level,
+     METH_FASTCALL,
+     "codec_set_level(level): force the codec dispatch level (clamped "
+     "to host support); returns the level in effect."},
     {NULL, NULL, 0, NULL},
 };
 
@@ -735,5 +597,8 @@ static struct PyModuleDef module = {
 };
 
 PyMODINIT_FUNC PyInit__accl_combine(void) {
+    /* resolve the SIMD dispatch level and build the decode LUTs while
+     * still single-threaded (import lock held) */
+    bsc_init();
     return PyModule_Create(&module);
 }

@@ -12,7 +12,9 @@
 #include <sys/socket.h>
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <string>
 #include <vector>
 
 namespace accl_proto {
@@ -28,6 +30,142 @@ enum Msg : uint8_t {
 };
 
 static const uint32_t STATUS_PENDING = 0xFFFFFFFFu;
+
+// eth-frame strm lane codes (emulator/protocol.py): 0 = pool-destined
+// data, 1 = stream-port delivery, >= 2 are control lanes. The native
+// daemon speaks the retransmission ACK lane; the remaining control
+// lanes (heartbeat / RMA / join) stay python-tier features and are
+// ignored on ingest.
+enum Strm : uint8_t {
+  ACK_STRM = 2,       // retransmission acknowledgement (pack_ack payload)
+  HB_STRM = 3,        // membership heartbeat
+  RMA_STRM = 4,       // one-sided RMA control
+  RMA_DATA_STRM = 5,  // one-sided RMA payload segments
+  JOIN_STRM = 6,      // membership join poll
+};
+
+// capability bits advertised in the MSG_GET_INFO caps word (keep in sync
+// with protocol.py CAP_*). This daemon advertises CAP_RETX_ACK (UDP
+// selective-retransmission responder) and, when payload checksums are
+// enabled ($ACCL_TPU_CSUM, default on), CAP_CSUM | CAP_CSUM_C (trailing
+// crc32c integrity word). CAP_RMA and CAP_SHM stay clear: the one-sided
+// RMA engine and the shared-memory dataplane remain python-tier lanes.
+enum Cap : uint32_t {
+  CAP_RETX_ACK = 1,
+  CAP_RMA = 2,
+  CAP_CSUM = 4,
+  CAP_CSUM_C = 8,
+  CAP_SHM = 16,
+};
+
+// -- payload checksums (crc32c, Castagnoli) ---------------------------------
+// Must produce the SAME value as the python tiers' google-crc32c binding
+// (protocol.py csum_of): reflected polynomial 0x82F63B78, init and final
+// xor 0xFFFFFFFF. Hardware SSE4.2 path when the host has it (the same
+// instruction google-crc32c uses), software table otherwise — both
+// variants are bit-identical, so CAP_CSUM_C is always truthful.
+
+inline const uint32_t* crc32c_table() {
+  static uint32_t table[256];
+  static bool init = [] {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c >> 1) ^ (0x82F63B78u & (~(c & 1) + 1));
+      table[i] = c;
+    }
+    return true;
+  }();
+  (void)init;
+  return table;
+}
+
+#if defined(__x86_64__) && (defined(__GNUC__) || defined(__clang__))
+__attribute__((target("sse4.2")))
+inline uint32_t crc32c_hw(uint32_t crc, const uint8_t* p, size_t n) {
+  uint64_t c = crc;
+  while (n >= 8) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    c = __builtin_ia32_crc32di(c, v);
+    p += 8;
+    n -= 8;
+  }
+  uint32_t c32 = static_cast<uint32_t>(c);
+  while (n--) c32 = __builtin_ia32_crc32qi(c32, *p++);
+  return c32;
+}
+
+inline bool crc32c_have_hw() {
+  static const bool have = __builtin_cpu_supports("sse4.2");
+  return have;
+}
+#else
+inline bool crc32c_have_hw() { return false; }
+#endif
+
+inline uint32_t crc32c(const uint8_t* p, size_t n) {
+  uint32_t crc = 0xFFFFFFFFu;
+#if defined(__x86_64__) && (defined(__GNUC__) || defined(__clang__))
+  if (crc32c_have_hw()) return crc32c_hw(crc, p, n) ^ 0xFFFFFFFFu;
+#endif
+  const uint32_t* table = crc32c_table();
+  for (size_t i = 0; i < n; ++i)
+    crc = table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+// $ACCL_TPU_CSUM: default on; "0"/""/"false"/"off" disable (the python
+// tiers' csum_enabled_from_env twin — read once at fabric construction)
+inline bool csum_enabled_from_env() {
+  const char* v = std::getenv("ACCL_TPU_CSUM");
+  if (!v) return true;
+  std::string s(v);
+  return !(s == "0" || s.empty() || s == "false" || s == "off");
+}
+
+// $ACCL_TPU_RETX_WINDOW: in-flight frames per (dst, comm) channel; 0
+// disables retransmission (python reliability.retx_window_from_env twin)
+static const int DEFAULT_RETX_WINDOW = 64;
+inline int retx_window_from_env() {
+  const char* v = std::getenv("ACCL_TPU_RETX_WINDOW");
+  if (!v || !*v) return DEFAULT_RETX_WINDOW;
+  int w = std::atoi(v);
+  return w < 0 ? 0 : w;
+}
+
+// -- retransmission ACK payload (rides strm=ACK_STRM eth frames) ------------
+// cumulative frontier u32, selective count u16, then each out-of-order
+// received seqn u32 (protocol.py pack_ack/unpack_ack). comm_id rides the
+// envelope; the cumulative value is mirrored in the envelope seqn.
+inline std::vector<uint8_t> pack_ack(uint32_t cum,
+                                     const std::vector<uint32_t>& sel) {
+  std::vector<uint8_t> out;
+  out.reserve(6 + 4 * sel.size());
+  out.resize(6);
+  std::memcpy(out.data(), &cum, 4);
+  uint16_t n = static_cast<uint16_t>(sel.size());
+  std::memcpy(out.data() + 4, &n, 2);
+  for (uint32_t s : sel) {
+    size_t off = out.size();
+    out.resize(off + 4);
+    std::memcpy(out.data() + off, &s, 4);
+  }
+  return out;
+}
+
+inline bool unpack_ack(const uint8_t* p, size_t len, uint32_t* cum,
+                       std::vector<uint32_t>* sel) {
+  if (len < 6) return false;
+  std::memcpy(cum, p, 4);
+  uint16_t n;
+  std::memcpy(&n, p + 4, 2);
+  if (len < 6 + 4u * n) return false;
+  sel->resize(n);
+  for (uint16_t i = 0; i < n; ++i)
+    std::memcpy(&(*sel)[i], p + 6 + 4 * i, 4);
+  return true;
+}
 
 // shared daemon resource bounds (keep in sync with protocol.py); the
 // allocation ceiling stays below the frame cap so every allocatable
@@ -46,8 +184,9 @@ enum Op : uint8_t {
   // variable-count all-to-all: per-peer count vectors ride an optional
   // trailing record on the MSG_CALL frame (protocol.py pack_call). This
   // daemon has no vector-exchange expansion — it rejects the opcode
-  // typed (E_NOT_IMPLEMENTED) rather than running a fixed-count program
-  // the peers would mismatch.
+  // typed (E_NOT_IMPLEMENTED, with the feature NAME in the status-reply
+  // payload so the python driver can surface it) rather than running a
+  // fixed-count program the peers would mismatch.
   OP_ALLTOALLV = 16, OP_NOP = 255,
 };
 
@@ -65,11 +204,11 @@ enum Cfg : uint8_t {
 
 enum CompFlag : uint8_t {
   C_NONE = 0, C_OP0 = 1, C_OP1 = 2, C_RES = 4, C_ETH = 8,
-  // block-scaled quantized wire (accl_tpu/quant.py): the python tiers
-  // carry per-block scale headers ahead of the fp8/int8 payload. This
-  // daemon has no scale-block codec — it REJECTS the flag typed
-  // (E_COMPRESSION) instead of narrowing frames the peers would then
-  // misparse as scale-block layouts.
+  // block-scaled quantized wire (accl_tpu/quant.py): per-block f32 scale
+  // headers ahead of the fp8/int8 payload. The daemon executes this lane
+  // natively via the bs_codec twins (bsc_quantize/bsc_dequant), emitting
+  // and parsing the same packed segment layout as the python tiers
+  // ([0xB5 | qcode | block u16 | count u32 | scales | q]).
   C_BLOCK_SCALED = 16,
 };
 
@@ -241,6 +380,16 @@ inline void put_le(std::vector<uint8_t>& out, T v) {
 inline std::vector<uint8_t> status_reply(uint32_t err) {
   std::vector<uint8_t> r{MSG_STATUS};
   put_le<uint32_t>(r, err);
+  return r;
+}
+
+// typed reject with the unsupported feature's NAME riding after the
+// error word — old drivers slice reply[1:5] and never see it; the
+// python driver decodes reply[5:] into the raised ACCLError's context
+inline std::vector<uint8_t> status_reply(uint32_t err, const char* feature) {
+  std::vector<uint8_t> r = status_reply(err);
+  if (feature && *feature)
+    r.insert(r.end(), feature, feature + std::strlen(feature));
   return r;
 }
 

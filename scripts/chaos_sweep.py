@@ -379,6 +379,109 @@ def shm_cell(kind: str, seed: int, oracle) -> tuple[bool, int, str]:
     return ok, sum(plan.applied.values()), status
 
 
+def _native_binary() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "cclo_emud")
+
+
+def _mixed_world(W: int, chaos_env: dict | None = None):
+    """Rank 0 = C++ ``cclo_emud`` subprocess (optionally with its
+    deterministic TX-chaos env knobs), ranks 1..W-1 = in-process python
+    daemons. Returns (popen, python_daemons, accls)."""
+    import subprocess
+    import threading
+    import time as _t
+
+    from accl_tpu.emulator.daemon import RankDaemon
+    from accl_tpu.testing import connect_world, free_port_base
+
+    port_base = free_port_base()
+    env = dict(os.environ)
+    env.update(chaos_env or {})
+    cpp = subprocess.Popen(
+        [_native_binary(), "--rank", "0", "--world", str(W),
+         "--port-base", str(port_base), "--stack", "udp"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    pys = [RankDaemon(r, W, port_base, stack="udp")
+           for r in range(1, W)]
+    for d in pys:
+        threading.Thread(target=d.serve_forever, daemon=True).start()
+    _t.sleep(0.5)
+    accls = connect_world(port_base, W, timeout=30.0)
+    return cpp, pys, accls
+
+
+def _mixed_teardown(cpp, pys, accls):
+    import subprocess
+    for a in accls:
+        try:
+            a.deinit()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+    cpp.terminate()
+    try:
+        cpp.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        cpp.kill()
+        cpp.wait()
+    for d in pys:
+        d.shutdown()
+
+
+def mixed_native_cell(kind: str, alg, seed: int) -> tuple[bool, int, str]:
+    """Mixed py/native cell at FULL default protocol (csum on, retx
+    armed, no pins): rank 0 is the C++ daemon spawned with its
+    deterministic TX-chaos knob so frames in BOTH directions fault —
+    the python senders carry the seeded FaultPlan, the native sender
+    drops/corrupts every 5th outgoing data frame. The schedule must
+    land bit-identically to a clean mixed world, and ENGAGEMENT is
+    asserted on the NATIVE daemon's MSG_DUMP_RX counter lines: drops
+    must move ``retx: ... retransmits=``, payload corruption must move
+    ``integrity: failed=`` (the python peers rejected+re-fetched its
+    corrupt frames via ITS retransmit path, and it rejected theirs)."""
+    import re
+
+    W = 3
+    knob = {"drop": "ACCL_TPU_CHAOS_TX_DROP",
+            "corrupt_payload": "ACCL_TPU_CHAOS_TX_CORRUPT"}[kind]
+    cpp, pys, accls = _mixed_world(W)           # clean twin first
+    try:
+        oracle = _schedule(accls, alg, COUNT)
+    finally:
+        _mixed_teardown(cpp, pys, accls)
+    plan = FaultPlan([FaultRule(kind=kind, every=5, offset=1,
+                                delay_s=0.01),
+                      FaultRule(kind=kind, prob=PROB, delay_s=0.01)],
+                     seed=seed)
+    cpp, pys, accls = _mixed_world(W, {knob: "5"})
+    try:
+        for d in pys:
+            d.eth.inject_fault(plan)
+        res = _schedule(accls, alg, COUNT)
+        ok = all((a == b).all() for r, o in zip(res, oracle)
+                 for a, b in zip(r, o))
+        status = "ok" if ok else "DIVERGED"
+        if ok:
+            for d in pys:       # the full protocol stayed unpinned
+                assert d.eth.csum and d.eth.retx is not None
+            dump = accls[0].device.dump_rx_buffers()
+            retx = re.search(r"\bretransmits=(\d+)", dump)
+            integ = re.search(r"integrity: failed=(\d+)", dump)
+            if kind == "drop" and (not retx or int(retx.group(1)) <= 0):
+                ok, status = False, "NO-NATIVE-RETX"
+            if kind == "corrupt_payload" and (
+                    not integ or int(integ.group(1)) <= 0):
+                ok, status = False, "NO-NATIVE-INTEGRITY"
+            if kind == "corrupt_payload" and ok and (
+                    not retx or int(retx.group(1)) <= 0):
+                ok, status = False, "NO-NATIVE-RETX"
+    finally:
+        for d in pys:
+            d.eth.clear_fault()
+        _mixed_teardown(cpp, pys, accls)
+    return ok, sum(plan.applied.values()), status
+
+
 def alltoallv_cell(kind: str, seed: int) -> tuple[bool, int, str]:
     """Uneven variable-count exchange (the MoE dispatch shape) under
     drop / payload corruption: a skewed count matrix with zero-count
@@ -549,6 +652,32 @@ def sweep(seed: int, hier: bool = True) -> int:
             failures += 1
         rows.append((4, "q-hier", kind, status, applied,
                      round((time.perf_counter() - t0) * 1e3)))
+    # mixed py/native cells: C++ rank 0 + python ranks over UDP at full
+    # protocol, faults in both directions (seeded FaultPlan on the
+    # python senders, deterministic TX-chaos knobs on the native one),
+    # engagement asserted on the native daemon's own counter dump
+    # the native daemon validates/expands the legacy ring family only
+    # (LEGACY_ALGORITHM_PAIRS) — RD would be typed-rejected at submit, so
+    # the mixed cells sweep the two ring expansions it implements
+    mixed_algos = {"ring": A.FUSED_RING, "nonfused": A.NON_FUSED}
+    if os.path.exists(_native_binary()):
+        for alg_name, alg in mixed_algos.items():
+            for kind in ("drop", "corrupt_payload"):
+                t0 = time.perf_counter()
+                try:
+                    ok, applied, status = mixed_native_cell(kind, alg,
+                                                            seed)
+                except Exception as exc:  # noqa: BLE001 — report cell
+                    ok, applied = False, 0
+                    status = f"FAILED ({type(exc).__name__})"
+                if not ok:
+                    failures += 1
+                rows.append((WORLDS[0], f"mx-{alg_name}", kind, status,
+                             applied,
+                             round((time.perf_counter() - t0) * 1e3)))
+    else:
+        print("native cclo_emud not built; skipping mixed py/native "
+              "cells (make -C native)")
     # uneven-exchange cells: the skewed alltoallv (zero-count peers,
     # one hot sender) under loss and payload corruption, bit-identical
     # to the matrix oracle with the machinery proven engaged

@@ -129,29 +129,48 @@ def test_malformed_payload_raises_typed():
         quant.dequantize_packed(p[:-1], 64)  # truncated
 
 
-def test_native_numpy_bit_identity():
+@pytest.mark.parametrize("simd", ["scalar", "best"])
+def test_native_numpy_bit_identity(simd):
     """The compiled codec is bit-identical to the numpy reference over a
-    corpus seeding +-0/NaN/inf (the PR-14 convention)."""
+    corpus seeding +-0/NaN/inf (the PR-14 convention) — at BOTH dispatch
+    levels: the vectorized encode/decode twins (bs_codec.h SSE2/AVX2)
+    must land the same bytes as the scalar path, which must match the
+    numpy/ml_dtypes reference. ``scalar`` pins level 0; ``best`` runs
+    whatever the host dispatches to."""
+    lib = quant._native()
+    prev = None
+    if simd == "scalar":
+        if lib is None or not hasattr(lib, "codec_set_level"):
+            pytest.skip("native codec not built; no level to pin")
+        prev = lib.codec_level()
+        lib.codec_set_level(0)
     rng = np.random.default_rng(3)
     x = np.concatenate([
         (rng.standard_normal(9000) * rng.choice([1e-3, 1, 1e3], 9000))
         .astype(np.float32),
         np.array([np.inf, -np.inf, np.nan, 0.0, -0.0] * 8, np.float32)])
-    for qd in (np.dtype(np.int8), F8, F8W):
-        for block in (32, 128):
-            p = quant.quantize_packed(x, qd, block)     # native (if built)
-            s, q = quant._np_quantize(x, qd, block)     # reference
-            nb = s.size
-            assert p[8:8 + 4 * nb].view(np.float32).tobytes() == s.tobytes()
-            assert p[8 + 4 * nb:].tobytes() == q.view(np.uint8).tobytes()
-            y = quant.dequantize_packed(p)
-            assert y.tobytes() == quant._np_dequant(s, q, block).tobytes()
-            for f in ReduceFunc:
-                other = rng.standard_normal(x.size).astype(np.float32)
-                got = quant.dequant_combine_packed(p, other, f)
-                ref = quant._NP_FUNCS[f](other,
-                                         quant._np_dequant(s, q, block))
-                assert got.tobytes() == ref.tobytes(), (qd.name, f)
+    try:
+        for qd in (np.dtype(np.int8), F8, F8W):
+            for block in (32, 128):
+                p = quant.quantize_packed(x, qd, block)  # native (if built)
+                s, q = quant._np_quantize(x, qd, block)  # reference
+                nb = s.size
+                assert p[8:8 + 4 * nb].view(np.float32).tobytes() \
+                    == s.tobytes()
+                assert p[8 + 4 * nb:].tobytes() \
+                    == q.view(np.uint8).tobytes()
+                y = quant.dequantize_packed(p)
+                assert y.tobytes() \
+                    == quant._np_dequant(s, q, block).tobytes()
+                for f in ReduceFunc:
+                    other = rng.standard_normal(x.size).astype(np.float32)
+                    got = quant.dequant_combine_packed(p, other, f)
+                    ref = quant._NP_FUNCS[f](
+                        other, quant._np_dequant(s, q, block))
+                    assert got.tobytes() == ref.tobytes(), (qd.name, f)
+    finally:
+        if prev is not None:
+            lib.codec_set_level(prev)
 
 
 # -- differential corpus: serial oracle vs streamed vs fabrics --------------

@@ -399,7 +399,9 @@ def test_udp_native_daemon():
 def test_udp_mixed_python_cpp_world():
     """Wire-format interop: rank 0 = C++ daemon, ranks 1-2 = Python
     daemons, all over UDP — the dual-implementation property the protocol
-    docs promise."""
+    docs promise. Runs FULL protocol: the native daemon advertises
+    CAP_RETX_ACK + CAP_CSUM|CAP_CSUM_C, so the python peers keep both
+    retransmission and payload checksums armed (no configure-time pin)."""
     import os
     import subprocess
     import threading
@@ -417,15 +419,8 @@ def test_udp_mixed_python_cpp_world():
         [binary, "--rank", "0", "--world", str(W),
          "--port-base", str(port_base), "--stack", "udp"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-    # mixed worlds disable retransmission: the native daemon has no ACK
-    # responder, so a python sender would retransmit to the give-up
-    # bound against it (documented limitation, docs/ARCHITECTURE.md)
-    os.environ["ACCL_TPU_RETX_WINDOW"] = "0"
-    try:
-        py_daemons = [RankDaemon(r, W, port_base, stack="udp")
-                      for r in (1, 2)]
-    finally:
-        del os.environ["ACCL_TPU_RETX_WINDOW"]
+    py_daemons = [RankDaemon(r, W, port_base, stack="udp")
+                  for r in (1, 2)]
     for d in py_daemons:
         threading.Thread(target=d.serve_forever, daemon=True).start()
     try:
@@ -440,6 +435,12 @@ def test_udp_mixed_python_cpp_world():
             return float(dst.data[0])
 
         assert all(r == 6.0 for r in run_ranks(accls, body, timeout=60.0))
+        # the caps probe saw a full-protocol native peer: neither the
+        # csum nor the retx pin fired on the python side
+        for d in py_daemons:
+            assert d.eth.csum, "csum pinned off against caps-ful daemon"
+            assert d.eth.retx is not None, \
+                "retx pinned off against caps-ful daemon"
         for a in accls:
             a.deinit()
     finally:
@@ -451,3 +452,119 @@ def test_udp_mixed_python_cpp_world():
             cpp.wait()
         for d in py_daemons:
             d.shutdown()
+
+
+def test_native_daemon_advertises_full_caps():
+    """The built ``cclo_emud`` answers the GET_INFO caps probe with
+    CAP_RETX_ACK (full cum+selective ACK responder) and CAP_CSUM |
+    CAP_CSUM_C (trailing crc32c) — the capless-legacy twin above stubs
+    a pre-caps build; this one pins the CURRENT binary's word so a caps
+    regression cannot silently re-enter the pinned-degraded world."""
+    import os
+    import subprocess
+    import time
+
+    from accl_tpu.emulator import protocol as P
+    from accl_tpu.emulator.daemon import probe_peer_caps
+    from accl_tpu.testing import free_port_base
+
+    binary = _native_binary()
+    if not os.path.exists(binary):
+        pytest.skip("native daemon not built (make -C native)")
+    port_base = free_port_base()
+    cpp = subprocess.Popen(
+        [binary, "--rank", "0", "--world", "1",
+         "--port-base", str(port_base), "--stack", "udp"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        caps = None
+        deadline = time.monotonic() + 10.0
+        while caps is None and time.monotonic() < deadline:
+            caps = probe_peer_caps("127.0.0.1", port_base, timeout=1.0)
+            if caps is None:
+                time.sleep(0.1)
+        assert caps is not None, "native daemon never answered GET_INFO"
+        assert caps & P.CAP_RETX_ACK
+        assert caps & P.CAP_CSUM
+        assert caps & P.CAP_CSUM_C      # crc32c, same variant as python
+        # python-tier-only lanes stay clear: a native peer must NOT
+        # claim RMA or shm it does not implement
+        assert not caps & P.CAP_RMA
+        assert not caps & P.CAP_SHM
+    finally:
+        cpp.terminate()
+        try:
+            cpp.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            cpp.kill()
+            cpp.wait()
+
+
+def test_native_daemon_typed_rejects_name_the_feature():
+    """Typed rejects carry the FEATURE NAME after the error word in the
+    MSG_STATUS reply (protocol.hpp ``status_reply(err, feature)``) —
+    wire-compatible with legacy drivers, which slice ``reply[1:5]`` and
+    never see the tail — and the python driver folds it into the raised
+    ``ACCLError``: an OP the native daemon has not implemented
+    (alltoallv) and a non-quantizable block-scaled wire dtype both name
+    themselves instead of surfacing a bare error word."""
+    import os
+    import struct
+    import subprocess
+    import time
+
+    from accl_tpu.constants import ACCLError, CCLOp, Compression
+    from accl_tpu.emulator import protocol as P
+    from accl_tpu.testing import free_port_base
+
+    binary = _native_binary()
+    if not os.path.exists(binary):
+        pytest.skip("native daemon not built (make -C native)")
+    W = 2
+    port_base = free_port_base()
+    procs = [subprocess.Popen(
+        [binary, "--rank", str(r), "--world", str(W),
+         "--port-base", str(port_base), "--stack", "udp"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(W)]
+    try:
+        time.sleep(0.5)
+        accls = connect_world(port_base, W, timeout=20.0)
+        a = accls[0]
+        src = a.buffer(data=np.ones(8, np.float32))
+        dst = a.buffer((8,), np.float32)
+
+        # driver-level: the reject is typed AND named in the exception
+        with pytest.raises(ACCLError, match="alltoallv"):
+            a.alltoallv(src, dst, (4, 4), (4, 4))
+
+        # wire-level: a C_BLOCK_SCALED call whose wire dtype has no
+        # quantized lane (f16) — legal nowhere, so the python driver
+        # never emits it; hand-packed to pin the daemon's own naming
+        dev = a.device
+        body = P.pack_call(
+            int(CCLOp.allreduce), 0,
+            int(Compression.ETH_COMPRESSED | Compression.BLOCK_SCALED),
+            0, P.DTYPE_CODES["float32"], P.DTYPE_CODES["float16"],
+            8, a.comm.comm_id, 0, 0,
+            src.address, 0, dst.address, [], qblock=64)
+        reply = dev._request(body)
+        assert reply[0] == P.MSG_CALL_ID
+        call_id = struct.unpack("<I", reply[1:5])[0]
+        reply = dev._request(bytes([P.MSG_WAIT]) +
+                             struct.pack("<Id", call_id, 5.0))
+        assert reply[0] == P.MSG_STATUS
+        err = struct.unpack("<I", reply[1:5])[0]
+        assert err and err != P.STATUS_PENDING
+        assert b"block-scaled wire dtype" in reply[5:]
+        for x in accls:
+            x.deinit()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
