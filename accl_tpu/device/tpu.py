@@ -61,6 +61,7 @@ from ..log import get_logger
 from ..parallel.collectives import MeshCollectives, _wire_name
 from ..parallel.mesh import make_mesh
 from ..parallel.tree import Tree2DCollectives
+from ..rma.window import WindowRegistry
 from .base import Device
 
 log = get_logger(__name__)
@@ -91,6 +92,19 @@ _COLLECTIVES = {CCLOp.bcast, CCLOp.scatter, CCLOp.gather, CCLOp.reduce,
 # on-device combine arithmetic for the streamed/fused local datapath
 _COMBINE_JNP = {ReduceFunc.SUM: jnp.add, ReduceFunc.MAX: jnp.maximum,
                 ReduceFunc.MIN: jnp.minimum, ReduceFunc.PROD: jnp.multiply}
+
+
+def _window_land(dst, payload, off):
+    flat = jax.lax.dynamic_update_slice(dst.reshape(-1), payload, (off,))
+    return flat.reshape(dst.shape)
+
+
+# RMA put landing: one donated program updates the window buffer in place
+# (XLA reuses the donated allocation), so a put into a device-resident
+# window never materializes a second full-size copy, let alone a host
+# round-trip. `off` is a traced element offset — one compile per window
+# geometry, not per offset.
+_window_put_prog = jax.jit(_window_land, donate_argnums=(0,))
 
 
 class _XchgEntry:
@@ -557,6 +571,7 @@ class TpuDevice(Device):
         self.dev_bufs: dict[int, ACCLBuffer] = {}
         self.my_device = list(
             np.asarray(ctx.mesh.devices).reshape(-1))[rank]
+        self.windows = WindowRegistry()    # one-sided RMA address space
         self.comms: dict[int, Communicator] = {}
         self.comm: Communicator | None = None
         self.timeout = DEFAULT_TIMEOUT_S
@@ -581,6 +596,13 @@ class TpuDevice(Device):
             self.dev_bufs.pop(buf.address, None)
         else:
             self.mem.deregister(buf.address)
+
+    # -- one-sided RMA windows (accl_tpu/rma) ------------------------------
+    def register_window(self, wid: int, addr: int, nbytes: int):
+        self.windows.register(wid, addr, nbytes)
+
+    def deregister_window(self, wid: int):
+        self.windows.deregister(wid)
 
     # -- device-resident storage (the to_from_fpga=False fast path) --------
     def adopt_device_array(self, arr):
@@ -853,6 +875,10 @@ class TpuDevice(Device):
             return self._do_send(desc, comm)
         if op == CCLOp.recv:
             return self._do_recv(desc, comm)
+        if op == CCLOp.put:
+            return self._do_put(desc, comm)
+        if op == CCLOp.get:
+            return self._do_get(desc, comm)
         if op in _COLLECTIVES:
             return self._do_collective(desc, comm, handle, defer_launch)
         return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
@@ -1091,6 +1117,111 @@ class TpuDevice(Device):
             self._write_result(desc.addr_2, np.asarray(received), desc)
         return 0
 
+    # -- one-sided RMA (put/get against registered windows) ----------------
+    def _rma_peer(self, desc: CallDescriptor,
+                  comm: Communicator) -> "TpuDevice":
+        peer = self.ctx.devices[comm.ranks[desc.root_src_dst].global_rank]
+        if peer is None:
+            raise ACCLError(int(ErrorCode.COMM_NOT_CONFIGURED),
+                            "RMA peer rank has no device configured")
+        return peer
+
+    def _do_put(self, desc: CallDescriptor, comm: Communicator) -> int:
+        """One-sided write: resolve ``(window, byte offset)`` on the
+        TARGET rank — which posts no matching call — move the payload
+        across, land it. A device-resident window lands through the
+        donated ``_window_put_prog`` (in-place update on the target's
+        device, no host staging and no second full-size window copy);
+        host-mirror windows and byte-misaligned/mixed-dtype ranges take
+        the host read-modify-write path."""
+        tgt = self._rma_peer(desc, comm)
+        uncomp = np.dtype(desc.arithcfg.uncompressed_dtype)
+        nbytes = desc.count * uncomp.itemsize
+        base = tgt.windows.resolve(desc.tag, desc.addr_1, nbytes)
+        wire = (desc.arithcfg.compressed_dtype
+                if desc.compression & Compression.ETH_COMPRESSED else None)
+        w = tgt.windows.get(desc.tag)
+        wbuf = tgt.dev_bufs.get(w.addr)
+        boff = base - w.addr   # byte offset inside the window buffer
+        if (wbuf is not None and not _noncanonical(uncomp)
+                and np.dtype(wbuf.dtype) == uncomp
+                and boff % uncomp.itemsize == 0):
+            src = self.dev_bufs.get(desc.addr_0)
+            if (src is not None and src.size >= desc.count
+                    and np.dtype(src.dtype) == uncomp
+                    and not (desc.compression
+                             & Compression.OP0_COMPRESSED)):
+                payload = src.jax.reshape(-1)[:desc.count]  # zero-copy
+            else:
+                host = self._read_operand(desc.addr_0, desc.count, desc,
+                                          Compression.OP0_COMPRESSED)
+                payload = jax.device_put(np.array(host, copy=True),
+                                         self.my_device)
+            if wire is not None:
+                payload = payload.astype(wire)   # narrow BEFORE the hop
+            if tgt.my_device != self.my_device:
+                payload = jax.device_put(payload, tgt.my_device)
+            if payload.dtype != jnp.dtype(uncomp):
+                payload = payload.astype(uncomp)  # decompress on landing
+            wbuf._rebind(_window_put_prog(wbuf.jax, payload,
+                                          boff // uncomp.itemsize))
+            return 0
+        host = self._read_operand(desc.addr_0, desc.count, desc,
+                                  Compression.OP0_COMPRESSED)
+        if wire is not None:
+            host = host.astype(wire).astype(uncomp)  # wire round-trip
+        data = np.ascontiguousarray(host, dtype=uncomp).view(np.uint8)
+        if wbuf is not None:
+            raw = np.asarray(wbuf.jax).reshape(-1).view(np.uint8).copy()
+            raw[boff:boff + nbytes] = data
+            tgt._rebind_dev(wbuf, raw.view(np.dtype(wbuf.dtype)))
+            return 0
+        tgt.mem.write(base, host.astype(uncomp, copy=False))
+        return 0
+
+    def _do_get(self, desc: CallDescriptor, comm: Communicator) -> int:
+        """One-sided read: pull ``count`` elements from byte ``offset``
+        of a window on the source rank into the local result buffer (the
+        source posts no matching call). Device-resident windows read
+        zero-copy and the payload crosses device-to-device."""
+        src_dev = self._rma_peer(desc, comm)
+        uncomp = np.dtype(desc.arithcfg.uncompressed_dtype)
+        nbytes = desc.count * uncomp.itemsize
+        base = src_dev.windows.resolve(desc.tag, desc.addr_1, nbytes)
+        wire = (desc.arithcfg.compressed_dtype
+                if desc.compression & Compression.ETH_COMPRESSED else None)
+        w = src_dev.windows.get(desc.tag)
+        wbuf = src_dev.dev_bufs.get(w.addr)
+        boff = base - w.addr
+        if (wbuf is not None and not _noncanonical(uncomp)
+                and np.dtype(wbuf.dtype) == uncomp
+                and boff % uncomp.itemsize == 0):
+            off = boff // uncomp.itemsize
+            payload = wbuf.jax.reshape(-1)[off:off + desc.count]
+            if wire is not None:
+                payload = payload.astype(wire)   # narrow BEFORE the hop
+            if src_dev.my_device != self.my_device:
+                payload = jax.device_put(payload, self.my_device)
+            if payload.dtype != jnp.dtype(uncomp):
+                payload = payload.astype(uncomp)
+            dst = self.dev_bufs.get(desc.addr_2)
+            if (dst is not None and dst.size == desc.count
+                    and not (desc.compression
+                             & Compression.RES_COMPRESSED)):
+                self._rebind_dev(dst, payload)   # stays on device
+            else:
+                self._write_result(desc.addr_2, np.asarray(payload), desc)
+            return 0
+        if wbuf is not None:
+            raw = np.asarray(wbuf.jax).reshape(-1).view(np.uint8)
+            host = np.frombuffer(raw[boff:boff + nbytes].tobytes(), uncomp)
+        else:
+            host = src_dev.mem.read(base, desc.count, uncomp)
+        if wire is not None:
+            host = host.astype(wire).astype(uncomp)
+        self._write_result(desc.addr_2, host, desc)
+        return 0
+
     # -- collective rendezvous --------------------------------------------
     def _do_collective(self, desc: CallDescriptor, comm: Communicator,
                        handle: CallHandle,
@@ -1218,6 +1349,25 @@ class TpuDevice(Device):
             alg = "ring"
         elif d0.algorithm != CollectiveAlgorithm.AUTO:
             alg = "xla"
+        # block-scaled quantized wire (compress_dtype=..., block_scale=True
+        # at the driver): the dense ring collectives take the fused Pallas
+        # quantize->combine->requant lane — qblock selects it and pins the
+        # ppermute ring (the only shape the fused codec hops ride). Other
+        # ops fall back to the FULL-PRECISION wire: their per-tensor cast
+        # lanes would silently truncate (int8) or re-scale per tensor
+        # (fp8), neither of which is block-scaled semantics.
+        qblock = 0
+        if wire is not None and d0.compression & Compression.BLOCK_SCALED:
+            from ..quant import DEFAULT_BLOCK
+            from ..parallel.collectives import BS_WIRE_DTYPE_NAMES
+            if (op in (CCLOp.allreduce, CCLOp.reduce_scatter,
+                       CCLOp.allgather)
+                    and _wire_name(wire) in BS_WIRE_DTYPE_NAMES):
+                qblock = int(getattr(cfg, "quant_block", 0)
+                             or DEFAULT_BLOCK)
+                alg = "ring"
+            else:
+                wire = None
         # rooted ops default to the hierarchical 2D-mesh tree when the comm
         # has 2D structure — O(outer+inner) hop fan-out instead of the
         # psum/all_gather-class traffic of the masked 1-D lowerings (which
@@ -1253,7 +1403,8 @@ class TpuDevice(Device):
         if op in dense_fast:
             n_in, n_out = dense_fast[op]
             res = self._launch_device_fast(op, descs, devs, coll, alg,
-                                           wire, cfg, n_in, n_out, d0)
+                                           wire, cfg, n_in, n_out, d0,
+                                           qblock)
             if res is not None:
                 return res
         if op in rooted:
@@ -1265,7 +1416,8 @@ class TpuDevice(Device):
         if op == CCLOp.allreduce:
             x = coll.shard(read_all(lambda d: d.addr_0, count))
             out = np.asarray(coll.allreduce(x, func=d0.function,
-                                            algorithm=alg, wire_dtype=wire))
+                                            algorithm=alg, wire_dtype=wire,
+                                            qblock=qblock))
             for r, d in enumerate(descs):
                 devs[r]._write_result(d.addr_2, out[r], d)
             return 0
@@ -1285,14 +1437,15 @@ class TpuDevice(Device):
             x = coll.shard(read_all(lambda d: d.addr_0, W * count))
             out = np.asarray(coll.reduce_scatter(x, func=d0.function,
                                                  algorithm=alg,
-                                                 wire_dtype=wire))
+                                                 wire_dtype=wire,
+                                                 qblock=qblock))
             for r, d in enumerate(descs):
                 devs[r]._write_result(d.addr_2, out[r], d)
             return 0
         if op == CCLOp.allgather:
             x = coll.shard(read_all(lambda d: d.addr_0, count))
             out = np.asarray(coll.allgather(x, algorithm=alg,
-                                            wire_dtype=wire))
+                                            wire_dtype=wire, qblock=qblock))
             for r, d in enumerate(descs):
                 devs[r]._write_result(d.addr_2, out[r], d)
             return 0
@@ -1341,7 +1494,8 @@ class TpuDevice(Device):
         return int(ErrorCode.COLLECTIVE_NOT_IMPLEMENTED)
 
     def _launch_device_fast(self, op, descs, devs, coll, alg, wire, cfg,
-                            n_in: int, n_out: int, d0) -> int | None:
+                            n_in: int, n_out: int, d0,
+                            qblock: int = 0) -> int | None:
         """Zero-host-staging dense collective. Returns None when any
         member's operands disqualify (not device-resident, geometry or
         dtype mismatch, host-side compression flags) — the caller then
@@ -1368,7 +1522,7 @@ class TpuDevice(Device):
                 else ReduceFunc.SUM)
         x = self.ctx.assemble_flat(coll, srcs)
         out = coll._program_flat(op.name, alg, func, _wire_name(wire),
-                                 None)(x)
+                                 None, qblock)(x)
         self._rebind_out_shards(coll, out, dict(enumerate(dsts)), devs)
         return 0
 

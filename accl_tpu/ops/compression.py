@@ -188,6 +188,340 @@ def decompress_fp8(q: jax.Array, scale: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Block-scaled quantized codec (the device twin of accl_tpu/quant.py)
+# ---------------------------------------------------------------------------
+#
+# Per-block absmax scaling, bit-identical to the numpy reference
+# (quant._np_quantize/_np_dequant — the contract the native codec is
+# also held to, tests/test_pallas_quant.py pins this twin the same way):
+#
+#   amax  = max(|x|) per block (NaN-propagating)
+#   scale = amax / qmax, clamped to 1.0 unless positive-normal-finite
+#   q     = cast(x * (1/scale))  — RNE; e4m3fn overflows to NaN, e5m2
+#           to inf, int8 rounds half-to-even / clips / zeros non-finite
+#   x'    = float32(q) * scale   — one f32 rounding
+#
+# The fused combine kernel runs dequant -> f32 accumulate -> requant in
+# ONE VMEM pass per row block: the f32 partial exists only inside the
+# kernel, never as a materialized wire buffer. Fresh scales come out of
+# every hop, so per-hop error stays bounded and never compounds through
+# the accumulator (the PR 15 quantized-wire contract).
+
+from ..constants import ReduceFunc as _RF
+
+# the quantizable wire dtypes + their reference constants, from THE
+# numpy reference module so the two lanes cannot drift
+from .. import quant as _quant
+
+BS_WIRE_DTYPE_NAMES = tuple(_quant._QCODES)   # int8 + e4m3fn + e5m2
+
+# smallest normal f32, as a python float so kernels inline it as a
+# literal (a jnp scalar would be a captured constant pallas rejects)
+_BS_FLT_MIN = float(_quant._FLT_MIN)
+
+_BS_COMBINE = {
+    _RF.SUM: jnp.add,
+    _RF.MAX: jnp.maximum,
+    _RF.MIN: jnp.minimum,
+    _RF.PROD: jnp.multiply,
+}
+
+
+# f32 -> fp8 cast parameters, empirically pinned against ml_dtypes
+# (quant.py's reference cast). XLA's own f32->f8 convert double-rounds
+# through f16 on CPU (e.g. -367.993 -> f16 -368 -> RNE tie -> -384 where
+# ml_dtypes' single rounding gives -352), so the kernels encode in
+# integer bit-math instead. Per dtype:
+#   (mantissa shift, exponent rebias in code units, min-normal f32 bits,
+#    clamp code, denormal scale 2^(bias+mant-1), NaN code or None)
+# e4m3fn needs no NaN case: rounding overflow, inf and NaN all clamp
+# into 0x7f — exactly ml_dtypes' inf->NaN saturation. e5m2 overflow
+# clamps to inf 0x7c while true NaNs take the canonical 0x7e, sign kept.
+_BS_FP8 = {
+    "float8_e4m3fn": (20, 960, 0x3C800000, 0x7F, 512.0, None),
+    "float8_e5m2": (21, 448, 0x38800000, 0x7C, 65536.0, 0x7E),
+}
+
+
+def _bs_fp8_cast(v: jax.Array, qname: str) -> jax.Array:
+    """Bit-exact ml_dtypes RNE f32 -> fp8 encode (see _BS_FP8)."""
+    shift, rebias, nmin, clamp, dscale, nan_code = _BS_FP8[qname]
+    u = jax.lax.bitcast_convert_type(v, jnp.uint32)
+    sign = (u >> 31).astype(jnp.uint8) << 7
+    a = u & jnp.uint32(0x7FFFFFFF)
+    # normals/overflow: integer round-nearest-even of the top mantissa
+    # bits, exponent rebiasing folded into the code arithmetic; rounding
+    # carries ripple into the exponent field for free
+    lsb = (a >> shift) & jnp.uint32(1)
+    rne = (a + jnp.uint32((1 << (shift - 1)) - 1) + lsb) >> shift
+    code = jnp.minimum(rne - jnp.uint32(rebias), jnp.uint32(clamp))
+    # target denormals: scale into code units (exact, power of two) and
+    # RNE in f32 — jnp.round is half-to-even
+    code_d = jnp.round(jnp.abs(v) * jnp.float32(dscale)).astype(jnp.uint32)
+    code = jnp.where(a < jnp.uint32(nmin), code_d, code)
+    if nan_code is not None:
+        code = jnp.where(a > jnp.uint32(0x7F800000),
+                         jnp.uint32(nan_code), code)
+    bits = sign | code.astype(jnp.uint8)
+    return jax.lax.bitcast_convert_type(bits, jnp.dtype(qname))
+
+
+def _bs_encode(v: jax.Array, qdtype) -> jax.Array:
+    """f32 -> wire cast with the reference's saturation rules. fp8 rides
+    the bit-exact encoder above (RNE; e4m3fn overflow -> NaN, e5m2 ->
+    inf, the ml_dtypes semantics); int8 rounds half-to-even, clips to
+    +-127 and zeroes non-finite values."""
+    if jnp.dtype(qdtype) == jnp.int8:
+        return jnp.where(jnp.isfinite(v),
+                         jnp.clip(jnp.round(v), -127.0, 127.0),
+                         jnp.float32(0.0)).astype(jnp.int8)
+    return _bs_fp8_cast(v, jnp.dtype(qdtype).name)
+
+
+def _bs_quant_rows(x: jax.Array, qdtype, one: jax.Array,
+                   qmax: jax.Array):
+    """Shared quantize body: x (R, block) f32 -> (q, scales (R, 1)).
+
+    ``one``/``qmax`` are RUNTIME scalars (SMEM operands), not literals:
+    XLA strength-reduces division by a constant into multiplication by
+    its reciprocal (1 ULP off IEEE), which would break bit-identity with
+    the numpy reference — a division by a runtime operand stays a true
+    division."""
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)  # NaN-propagating
+    s = amax / qmax
+    good = (s >= _BS_FLT_MIN) & (s < jnp.inf)
+    s = jnp.where(good, s, jnp.float32(1.0))
+    v = x * (one / s)                   # reciprocal-multiply, like numpy
+    return _bs_encode(v, qdtype), s
+
+
+def _bs_geometry(n: int, block: int) -> tuple[int, int, int]:
+    """(nb, row_block, padded_rows): blocks-per-payload, grid row chunk
+    (~2 MiB of f32 per VMEM block), and nb padded up to a multiple of
+    the chunk so every grid step sees a full block (padded rows are
+    zeros -> scale 1.0, payload 0; sliced off after the call)."""
+    nb = -(-n // block)
+    rows = max(8, (1 << 21) // (4 * block))
+    rows = min(rows, nb) if nb >= 8 else nb
+    return nb, rows, nb + ((-nb) % rows)
+
+
+def _bs_pad_rows(tiles: jax.Array, nb: int, rows_padded: int,
+                 fill: float = 0.0) -> jax.Array:
+    if rows_padded != nb:
+        tiles = jnp.pad(tiles, ((0, rows_padded - nb), (0, 0)),
+                        constant_values=fill)
+    return tiles
+
+
+def _bs_tiles(x: jax.Array, block: int, nb: int,
+              rows_padded: int) -> jax.Array:
+    """Flatten + zero-pad a payload to (rows_padded, block) f32 rows."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = nb * block - flat.size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return _bs_pad_rows(flat.reshape(nb, block), nb, rows_padded)
+
+
+def _bs_scalars(qname: str) -> tuple[jax.Array, jax.Array]:
+    """(one, qmax) as (1, 1) f32 runtime operands (see _bs_quant_rows).
+
+    These must be built EAGERLY (outside any trace) and enter every
+    jitted program as ARGUMENTS: created inside a trace they become
+    compile-time constants, and then either XLA strength-reduces the
+    divisions into reciprocal multiplies or LLVM folds the ``* one``
+    guard and contracts dequant-multiply + accumulate into an fma —
+    both 1 ULP off the numpy reference. (optimization_barrier does not
+    help: constants still reach LLVM as immediates.) The bs_* wrappers
+    build them eagerly per call; the ring collective programs thread
+    them through shard_map as replicated inputs."""
+    return (jnp.float32(1.0).reshape(1, 1),
+            jnp.float32(_quant._QMAX[qname]).reshape(1, 1))
+
+
+@functools.partial(jax.jit, static_argnames=("qname", "block"))
+def _bs_quant_call(tiles: jax.Array, one: jax.Array, qmax: jax.Array,
+                   qname: str, block: int):
+    """tiles: (rows_padded, block) f32 -> (q tiles, scales (rows, 1))."""
+    qdtype = jnp.dtype(qname)
+    rows = tiles.shape[0]
+
+    def kernel(x_ref, one_ref, qmax_ref, q_ref, s_ref):
+        q, s = _bs_quant_rows(x_ref[:], qdtype, one_ref[0, 0],
+                              qmax_ref[0, 0])
+        q_ref[:] = q
+        s_ref[:] = s
+
+    R = min(max(8, (1 << 21) // (4 * block)), rows)
+    smem = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct(tiles.shape, qdtype),
+                   jax.ShapeDtypeStruct((rows, 1), jnp.float32)),
+        grid=(pl.cdiv(rows, R),),
+        in_specs=[pl.BlockSpec((R, block), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM), smem, smem],
+        out_specs=(pl.BlockSpec((R, block), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((R, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)),
+        interpret=_interpret(),
+    )(tiles, one, qmax)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def _bs_dequant_call(qtiles: jax.Array, scales: jax.Array, block: int):
+    """(rows, block) wire tiles + (rows, 1) scales -> f32 tiles."""
+    rows = qtiles.shape[0]
+
+    def kernel(q_ref, s_ref, o_ref):
+        o_ref[:] = q_ref[:].astype(jnp.float32) * s_ref[:]
+
+    R = min(max(8, (1 << 21) // (4 * block)), rows)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qtiles.shape, jnp.float32),
+        grid=(pl.cdiv(rows, R),),
+        in_specs=[pl.BlockSpec((R, block), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((R, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((R, block), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(qtiles, scales)
+
+
+@functools.partial(jax.jit, static_argnames=("func", "qname", "block",
+                                             "requant"))
+def _bs_combine_call(qtiles: jax.Array, scales: jax.Array,
+                     other: jax.Array, one: jax.Array, qmax: jax.Array,
+                     func: _RF, qname: str, block: int,
+                     requant: bool):
+    """The fused dequant -> f32-accumulate [-> requant] kernel: one VMEM
+    pass per row block, accumulation entirely in f32 registers — the
+    partial is never written back at full width. ``requant=True``
+    returns fresh (q', scales') for the next hop; False returns the f32
+    result (the final hop of a ring round)."""
+    qdtype = jnp.dtype(qname)
+    rows = qtiles.shape[0]
+    op = _BS_COMBINE[func]
+
+    def kernel(q_ref, s_ref, x_ref, one_ref, qmax_ref, *out_refs):
+        # the extra `* one` pins the dequant product to its own rounding:
+        # XLA contracts `x + q*s` into an fma (single rounding, 1 ULP off
+        # the reference's dequant-then-add); `x + (q*s)*one` can only
+        # contract the exact *1.0 step, so `q*s` stays materialized
+        deq = (q_ref[:].astype(jnp.float32) * s_ref[:]) * one_ref[0, 0]
+        acc = op(x_ref[:], deq)
+        if requant:
+            q2, s2 = _bs_quant_rows(acc, qdtype, one_ref[0, 0],
+                                    qmax_ref[0, 0])
+            out_refs[0][:] = q2
+            out_refs[1][:] = s2
+        else:
+            out_refs[0][:] = acc
+
+    R = min(max(8, (1 << 21) // (4 * block)), rows)
+    row_spec = pl.BlockSpec((R, block), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    s_spec = pl.BlockSpec((R, 1), lambda i: (i, 0),
+                          memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    if requant:
+        out_shape = (jax.ShapeDtypeStruct(qtiles.shape, qdtype),
+                     jax.ShapeDtypeStruct((rows, 1), jnp.float32))
+        out_specs = (row_spec, s_spec)
+    else:
+        out_shape = jax.ShapeDtypeStruct(qtiles.shape, jnp.float32)
+        out_specs = row_spec
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        grid=(pl.cdiv(rows, R),),
+        in_specs=[row_spec, s_spec, row_spec, smem, smem],
+        out_specs=out_specs,
+        interpret=_interpret(),
+    )(qtiles, scales, other, one, qmax)
+
+
+def bs_quantize(x: jax.Array, wire_dtype, block: int, scalars=None
+                ) -> tuple[jax.Array, jax.Array]:
+    """Block-scale quantize a payload: (q ``x.shape`` in the wire dtype,
+    scales (nb,) f32), nb = ceil(n / block). The on-wire footprint is
+    exactly the packed segment's scales+data region (quant.packed_nbytes
+    minus the header — the header is host-tier framing). ``scalars``:
+    optional eager (one, qmax) pair from :func:`_bs_scalars` — callers
+    tracing this under their own jit must pass it through as program
+    arguments to keep bit-identity (see _bs_scalars)."""
+    n = int(jnp.size(x))
+    nb, _, rows_padded = _bs_geometry(n, block)
+    tiles = _bs_tiles(x, block, nb, rows_padded)
+    qname = jnp.dtype(wire_dtype).name
+    one, qmax = scalars if scalars is not None else _bs_scalars(qname)
+    q, s = _bs_quant_call(tiles, one, qmax, qname, block)
+    return (q.reshape(-1)[:n].reshape(x.shape), s.reshape(-1)[:nb])
+
+
+def bs_dequantize(q: jax.Array, scales: jax.Array, block: int
+                  ) -> jax.Array:
+    """Inverse of :func:`bs_quantize`: f32, one rounding per element."""
+    n = int(jnp.size(q))
+    nb, _, rows_padded = _bs_geometry(n, block)
+    flat = q.reshape(-1)
+    pad = nb * block - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    qtiles = _bs_pad_rows(flat.reshape(nb, block), nb, rows_padded)
+    s = _bs_pad_rows(scales.reshape(nb, 1), nb, rows_padded, fill=1.0)
+    out = _bs_dequant_call(qtiles, s, block)
+    return out.reshape(-1)[:n].reshape(q.shape)
+
+
+def _bs_combine_tiles(q: jax.Array, scales: jax.Array, other: jax.Array,
+                      block: int):
+    n = int(jnp.size(q))
+    nb, _, rows_padded = _bs_geometry(n, block)
+    flat = q.reshape(-1)
+    pad = nb * block - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    qtiles = _bs_pad_rows(flat.reshape(nb, block), nb, rows_padded)
+    s = _bs_pad_rows(scales.reshape(nb, 1), nb, rows_padded, fill=1.0)
+    x = _bs_tiles(other, block, nb, rows_padded)
+    return qtiles, s, x, n, nb
+
+
+def bs_combine_requant(q: jax.Array, scales: jax.Array, other: jax.Array,
+                       func: _RF, wire_dtype, block: int, scalars=None
+                       ) -> tuple[jax.Array, jax.Array]:
+    """One quantized ring hop: ``func(other, dequant(q, scales))`` in f32
+    and requantized against FRESH per-block scales, fused in one VMEM
+    pass (matches quant.dequant_combine_packed + quantize_packed run
+    back to back, bit-identically). Returns (q', scales')."""
+    qtiles, s, x, n, nb = _bs_combine_tiles(q, scales, other, block)
+    qname = jnp.dtype(wire_dtype).name
+    one, qmax = scalars if scalars is not None else _bs_scalars(qname)
+    q2, s2 = _bs_combine_call(qtiles, s, x, one, qmax, _RF(func),
+                              qname, block, True)
+    return (q2.reshape(-1)[:n].reshape(q.shape), s2.reshape(-1)[:nb])
+
+
+def bs_dequant_combine(q: jax.Array, scales: jax.Array, other: jax.Array,
+                       func: _RF, block: int, scalars=None) -> jax.Array:
+    """The final hop's fused step: ``func(other, dequant(q, scales))``
+    in f32, no requantization (the ring's round-closing combine —
+    quant.dequant_combine_packed's numerics)."""
+    qtiles, s, x, n, _ = _bs_combine_tiles(q, scales, other, block)
+    wd = q.dtype.name if q.dtype.name in BS_WIRE_DTYPE_NAMES else "int8"
+    one, qmax = scalars if scalars is not None else _bs_scalars(wd)
+    out = _bs_combine_call(qtiles, s, x, one, qmax, _RF(func),
+                           wd, block, False)
+    return out.reshape(-1)[:n].reshape(other.shape)
+
+
+# ---------------------------------------------------------------------------
 # Wire codec dispatch — what a collective hop calls
 # ---------------------------------------------------------------------------
 

@@ -35,7 +35,10 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..constants import ReduceFunc
-from ..ops.compression import FP8_DTYPE_NAMES, fp8_dequantize, fp8_quantize
+from ..ops.compression import (BS_WIRE_DTYPE_NAMES, FP8_DTYPE_NAMES,
+                               _bs_scalars, bs_combine_requant,
+                               bs_dequant_combine, bs_dequantize,
+                               bs_quantize, fp8_dequantize, fp8_quantize)
 
 _REDUCE_OPS: dict[ReduceFunc, Callable] = {
     ReduceFunc.SUM: jnp.add,
@@ -167,6 +170,96 @@ def ring_allreduce_shard(x: jnp.ndarray, axis_name: str,
     chunks = flat.reshape(W, -1)
     mine = ring_reduce_scatter_shard(chunks, axis_name, func, wire_dtype)
     full = ring_allgather_shard(mine, axis_name, wire_dtype)
+    out = full.reshape(-1)
+    if pad:
+        out = out[:flat.size - pad]
+    return out.reshape(shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Device-tier block-scaled quantized rings (Pallas fused codec per hop)
+# ---------------------------------------------------------------------------
+# Same chunk schedules as the plain rings above, but each hop's payload
+# travels as (wire-dtype codes, per-block f32 scales) and the receive
+# side runs the fused dequant -> f32-accumulate -> requant Pallas kernel
+# (ops/compression.bs_combine_requant): the f32 partial never exists as
+# a wire buffer. Every reduce-scatter hop requantizes against FRESH
+# scales, so per-hop error stays bounded and never compounds (the PR 15
+# quantized-wire contract); the allgather relays forward the SAME
+# (q, scales) bytes unchanged — a single quantization, bit-stable
+# through any number of relays (the bcast idempotence convention).
+#
+# ``scalars`` is the eager (one, qmax) pair from compression._bs_scalars
+# threaded through as program arguments — see its docstring for why
+# building it inside a trace breaks bit-identity with quant.py.
+
+def ring_reduce_scatter_bs_shard(x: jnp.ndarray, axis_name: str,
+                                 func: ReduceFunc, wire_dtype,
+                                 qblock: int, scalars=None) -> jnp.ndarray:
+    """Block-scaled ring reduce-scatter. ``x``: (W, chunk...) per shard;
+    returns this rank's reduced chunk in f32 accumulation semantics,
+    cast back to ``x.dtype``."""
+    W = _axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    perm = _ring_perm(W)
+
+    def chunk(i):
+        c = lax.dynamic_index_in_dim(x, (me + 1 + i) % W, keepdims=False)
+        return c.astype(jnp.float32)
+
+    if W == 1:
+        return chunk(0).astype(x.dtype)
+    q, s = bs_quantize(chunk(0), wire_dtype, qblock, scalars)
+    out = None
+    for i in range(1, W):           # python-unrolled: (q, s) carry
+        q = lax.ppermute(q, axis_name, perm)
+        s = lax.ppermute(s, axis_name, perm)
+        if i < W - 1:
+            q, s = bs_combine_requant(q, s, chunk(i), func, wire_dtype,
+                                      qblock, scalars)
+        else:                       # round-closing hop: no requant
+            out = bs_dequant_combine(q, s, chunk(i), func, qblock,
+                                     scalars)
+    return out.astype(x.dtype)
+
+
+def ring_allgather_bs_shard(x: jnp.ndarray, axis_name: str, wire_dtype,
+                            qblock: int, scalars=None) -> jnp.ndarray:
+    """Block-scaled ring allgather. ``x``: (chunk...,) per shard; returns
+    (W, chunk...). The own chunk lands exact; remote chunks carry one
+    quantization regardless of relay distance (bytes forwarded as-is)."""
+    W = _axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    perm = _ring_perm(W)
+    out = jnp.zeros((W,) + x.shape, x.dtype)
+    out = lax.dynamic_update_index_in_dim(out, x, me, 0)
+    if W == 1:
+        return out
+    q, s = bs_quantize(x.astype(jnp.float32), wire_dtype, qblock, scalars)
+    for i in range(1, W):
+        q = lax.ppermute(q, axis_name, perm)
+        s = lax.ppermute(s, axis_name, perm)
+        landed = bs_dequantize(q, s, qblock).astype(x.dtype)
+        out = lax.dynamic_update_index_in_dim(out, landed, (me + i) % W, 0)
+    return out
+
+
+def ring_allreduce_bs_shard(x: jnp.ndarray, axis_name: str,
+                            func: ReduceFunc, wire_dtype,
+                            qblock: int, scalars=None) -> jnp.ndarray:
+    """Block-scaled ring allreduce = quantized reduce-scatter + quantized
+    allgather over W chunks of the flattened shard (the EQuARX-style
+    fused quantized collective)."""
+    W = _axis_size(axis_name)
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    pad = (-flat.size) % W
+    flat = jnp.pad(flat, (0, pad))
+    chunks = flat.reshape(W, -1)
+    mine = ring_reduce_scatter_bs_shard(chunks, axis_name, func,
+                                        wire_dtype, qblock, scalars)
+    full = ring_allgather_bs_shard(mine, axis_name, wire_dtype, qblock,
+                                   scalars)
     out = full.reshape(-1)
     if pad:
         out = out[:flat.size - pad]
@@ -372,6 +465,35 @@ class MeshCollectives:
         sharding = NamedSharding(self.mesh, self._sharded(stacked.ndim - 1))
         return jax.device_put(stacked, sharding)
 
+    @staticmethod
+    def _bs_eligible(op: str, wire: str | None, qblock: int) -> bool:
+        """The fused block-scaled ring lane exists for the ring-shaped
+        reduction collectives and the quantizable wire dtypes only."""
+        return bool(qblock) and wire in BS_WIRE_DTYPE_NAMES and op in (
+            "allreduce", "reduce_scatter", "allgather")
+
+    def _bs_shard_fn(self, op: str, func: ReduceFunc, wire: str,
+                     qblock: int) -> Callable:
+        """Per-shard body for the block-scaled quantized rings:
+        f(x, one, qmax) with x (1, n) and the eager runtime scalars
+        threaded through as replicated program arguments."""
+        ax = self.axis_name
+        wdt = jnp.dtype(wire)
+        if op == "allreduce":
+            def f(x, one, qmax):
+                return ring_allreduce_bs_shard(
+                    x[0], ax, func, wdt, qblock, (one, qmax))[None]
+        elif op == "reduce_scatter":
+            def f(x, one, qmax):
+                chunks = x[0].reshape(self.W, -1)
+                return ring_reduce_scatter_bs_shard(
+                    chunks, ax, func, wdt, qblock, (one, qmax))[None]
+        else:  # allgather
+            def f(x, one, qmax):
+                return ring_allgather_bs_shard(
+                    x[0], ax, wdt, qblock, (one, qmax)).reshape(-1)[None]
+        return f
+
     def _shard_fn(self, op: str, algorithm: str, func: ReduceFunc,
                   wire: str | None, root: int | None) -> Callable:
         """Build the per-shard body f: (1, n_in) -> (1, n_out) shared by
@@ -484,14 +606,38 @@ class MeshCollectives:
             raise NotImplementedError(op)
         return f
 
+    def _bs_wrap(self, fn: Callable, wire: str) -> Callable:
+        """Jit a block-scaled program and close over its eager runtime
+        scalars: the returned callable keeps the plain prog(x) signature
+        while (one, qmax) enter the XLA computation as real arguments —
+        the only placement that survives constant folding bit-exactly
+        (compression._bs_scalars)."""
+        one, qmax = _bs_scalars(wire)
+        raw = jax.jit(fn)
+
+        def prog(x):
+            return raw(x, one, qmax)
+
+        return prog
+
     def _program(self, op: str, algorithm: str, func: ReduceFunc,
-                 wire: str | None, root: int | None):
+                 wire: str | None, root: int | None, qblock: int = 0):
         """Stacked layout: global (W, n) arrays, leading axis = rank."""
-        ck = (op, algorithm, func, wire, root)
+        ck = (op, algorithm, func, wire, root, qblock)
         cached = self._cache.get(ck)
         if cached is not None:
             return cached
         ax = self.axis_name
+        if self._bs_eligible(op, wire, qblock):
+            # check_vma off: shard_map has no replication rule for
+            # pallas_call; every bs program output is rank-varying anyway
+            f = self._bs_shard_fn(op, func, wire, qblock)
+            fn = _shard_map(f, mesh=self.mesh,
+                            in_specs=(P(ax, None), P(None, None),
+                                      P(None, None)),
+                            out_specs=P(ax, None), check_vma=False)
+            prog = self._cache[ck] = self._bs_wrap(fn, wire)
+            return prog
         f = self._shard_fn(op, algorithm, func, wire, root)
         fn = _shard_map(f, mesh=self.mesh, in_specs=P(ax, None),
                            out_specs=P(ax, None))
@@ -499,18 +645,29 @@ class MeshCollectives:
         return prog
 
     def _program_flat(self, op: str, algorithm: str, func: ReduceFunc,
-                      wire: str | None, root: int | None):
+                      wire: str | None, root: int | None, qblock: int = 0):
         """Flat layout: global (W*n,) arrays whose per-device shards are
         rank-local 1-D operands. This is the device-resident buffer path:
         shards assembled with jax.make_array_from_single_device_arrays
         keep their (n,) shape, so no per-shard host reshape is needed on
         either side of the call (the [None]/[0] axis plumbing is free
         inside the jitted program)."""
-        ck = ("flat", op, algorithm, func, wire, root)
+        ck = ("flat", op, algorithm, func, wire, root, qblock)
         cached = self._cache.get(ck)
         if cached is not None:
             return cached
         ax = self.axis_name
+        if self._bs_eligible(op, wire, qblock):
+            f = self._bs_shard_fn(op, func, wire, qblock)
+
+            def g(x, one, qmax):
+                return f(x[None], one, qmax)[0]
+
+            fn = _shard_map(g, mesh=self.mesh,
+                            in_specs=(P(ax), P(None, None), P(None, None)),
+                            out_specs=P(ax), check_vma=False)
+            prog = self._cache[ck] = self._bs_wrap(fn, wire)
+            return prog
         f = self._shard_fn(op, algorithm, func, wire, root)
 
         def g(x):
@@ -522,21 +679,26 @@ class MeshCollectives:
         return prog
 
     # -- public ops (global arrays, leading W axis) ------------------------
+    # qblock > 0 with a quantizable wire dtype selects the fused
+    # block-scaled Pallas ring (device tier of the quantized wire);
+    # qblock == 0 keeps the per-tensor compression paths.
     def allreduce(self, x: jax.Array, func: ReduceFunc = ReduceFunc.SUM,
-                  algorithm: str = "xla", wire_dtype=None) -> jax.Array:
+                  algorithm: str = "xla", wire_dtype=None,
+                  qblock: int = 0) -> jax.Array:
         return self._program("allreduce", algorithm, func,
-                             _wire_name(wire_dtype), None)(x)
+                             _wire_name(wire_dtype), None, qblock)(x)
 
     def reduce_scatter(self, x: jax.Array,
                        func: ReduceFunc = ReduceFunc.SUM,
-                       algorithm: str = "xla", wire_dtype=None) -> jax.Array:
+                       algorithm: str = "xla", wire_dtype=None,
+                       qblock: int = 0) -> jax.Array:
         return self._program("reduce_scatter", algorithm, func,
-                             _wire_name(wire_dtype), None)(x)
+                             _wire_name(wire_dtype), None, qblock)(x)
 
     def allgather(self, x: jax.Array, algorithm: str = "xla",
-                  wire_dtype=None) -> jax.Array:
+                  wire_dtype=None, qblock: int = 0) -> jax.Array:
         return self._program("allgather", algorithm, ReduceFunc.SUM,
-                             _wire_name(wire_dtype), None)(x)
+                             _wire_name(wire_dtype), None, qblock)(x)
 
     def bcast(self, x: jax.Array, root: int = 0,
               wire_dtype=None) -> jax.Array:

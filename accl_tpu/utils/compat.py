@@ -30,23 +30,25 @@ import jax
 
 
 def _resolve_shard_map():
-    sm = getattr(jax, "shard_map", None)
-    if sm is not None:
-        return sm
     import functools
     import inspect
 
-    from jax.experimental.shard_map import shard_map as legacy
+    resolved = getattr(jax, "shard_map", None)
+    if resolved is None:
+        from jax.experimental.shard_map import shard_map as resolved
 
-    accepted = set(inspect.signature(legacy).parameters)
+    accepted = set(inspect.signature(resolved).parameters)
 
-    @functools.wraps(legacy)
+    @functools.wraps(resolved)
     def shim(f, *args, **kwargs):
-        # the promoted API renamed check_rep -> check_vma; translate so
-        # call sites can use the modern spelling on either install
+        # the promoted API renamed check_rep -> check_vma; translate in
+        # whichever direction the resolved function wants so call sites
+        # can use either spelling on either install
         if "check_vma" in kwargs and "check_vma" not in accepted:
             kwargs["check_rep"] = kwargs.pop("check_vma")
-        return legacy(f, *args, **kwargs)
+        elif "check_rep" in kwargs and "check_rep" not in accepted:
+            kwargs["check_vma"] = kwargs.pop("check_rep")
+        return resolved(f, *args, **kwargs)
 
     return shim
 

@@ -173,6 +173,18 @@ def bench_emu_fallback(reason: str) -> dict:
         ch = codec_headline()
         for k in CODEC_KEYS:
             result[k] = ch[k]
+    if os.environ.get("ACCL_BENCH_MIN_DEVICE_QUANT_WIRE_RATIO"):
+        # device-tier fused-codec microladder (~30s, Pallas interpret
+        # mode on CPU — the hardware path rides the chip queue, never
+        # CI): bit-identity to the quant.py reference hard-raises
+        # before the ring-numerics check and the wire-byte ratio are
+        # believed. Only when the gate is armed (make bench-emu),
+        # keep-ungated-runs-fast rule.
+        from benchmarks.quantize import DEVICE_QUANT_KEYS, \
+            device_quant_headline
+        dq = device_quant_headline()
+        for k in DEVICE_QUANT_KEYS:
+            result[k] = dq[k]
     return result
 
 
@@ -279,6 +291,29 @@ def check_quant_ratios(result: dict) -> int:
               file=sys.stderr)
         rc = 1
     return rc
+
+
+def check_device_quant_ratio(result: dict) -> int:
+    """Regression gate for the device-tier fused quantized ring
+    (accl_tpu/ops/compression.py Pallas kernels): with
+    $ACCL_BENCH_MIN_DEVICE_QUANT_WIRE_RATIO set (make bench-emu sets
+    3.0), the per-hop wire payload of the fused codec (packed codes +
+    scale sidecar — the arrays the device ring actually ppermutes)
+    must stay that many times smaller than the f32 payload. fp8 at the
+    default block 128 lands ~3.88x, so the gate only fails if the
+    scale sidecar bloats or the wire silently widens back to f32. The
+    ladder itself hard-raises on any codec bit mismatch vs the
+    quant.py reference and on ring numerics outside the typed bound,
+    so a passing ratio is also a correctness statement."""
+    want = os.environ.get("ACCL_BENCH_MIN_DEVICE_QUANT_WIRE_RATIO")
+    if not want or "device_quant_wire_ratio" not in result:
+        return 0
+    if result["device_quant_wire_ratio"] >= float(want):
+        return 0
+    print(f"FAIL: device-tier quantized wire-byte ratio "
+          f"{result['device_quant_wire_ratio']} < required {want}",
+          file=sys.stderr)
+    return 1
 
 
 def check_codec_ratio(result: dict) -> int:
@@ -1054,6 +1089,7 @@ def main():
                  or check_combine_ratio(result)
                  or check_quant_ratios(result)
                  or check_codec_ratio(result)
+                 or check_device_quant_ratio(result)
                  or check_overlap_frac(result)
                  or check_fabric_clean(result))
     if not _probe_backend():

@@ -234,6 +234,124 @@ def codec_headline(block: int = 128) -> dict:
     }
 
 
+DEVICE_QUANT_KEYS = ("device_quant_wire_ratio",
+                     "device_quant_int8_wire_ratio", "device_quant_block",
+                     "device_quant_ring_err_rel", "device_quant_us",
+                     "device_quant_codec_elems")
+
+
+def device_quant_headline(n: int = 1 << 18, block: int = 128,
+                          world: int = 4) -> dict:
+    """Device-tier fused-codec microladder (Pallas, interpret mode —
+    pure CPU, no TPU backend and no multi-device mesh needed, so it
+    runs in the stock bench process; the hardware path rides the chip
+    queue behind ``$ACCL_BENCH_TPU`` and never gates CI).
+
+    Order of belief, per the ladder convention:
+
+    1. **bit-identity** — ``bs_quantize`` / fused
+       ``bs_combine_requant`` (SUM) against the quant.py numpy
+       reference over a scale-mixed +-0/NaN/inf-seeded corpus, both
+       wire dtypes — HARD-raise on any bit mismatch, ratios from a
+       wrong codec are worthless;
+    2. **ring numerics** — a ``world``-rank quantized ring driven hop
+       by hop through the REAL fused kernels (Python routing only —
+       the exact hop schedule of ring_reduce_scatter_bs_shard), final
+       output inside the typed per-hop error bound of the exact sum;
+    3. **wire ratio** — f32 bytes per hop over quantized bytes per hop
+       (codes + scale sidecar, the actual arrays the device ring
+       ppermutes), gate ``$ACCL_BENCH_MIN_DEVICE_QUANT_WIRE_RATIO``
+       (make bench-emu sets 3.0; fp8 at block 128 lands 4/(1+4/128)
+       ~= 3.88, so the gate fails only if the sidecar bloats or the
+       wire silently widens).
+    """
+    import jax.numpy as jnp
+
+    from accl_tpu.constants import ReduceFunc
+    from accl_tpu.ops import compression as comp
+
+    f8 = np.dtype(ml_dtypes.float8_e4m3fn)
+    i8 = np.dtype(np.int8)
+    rng = np.random.default_rng(17)
+    corpus = (rng.standard_normal(n).astype(np.float32)
+              * np.float32(10.0)
+              ** rng.integers(-20, 20, n).astype(np.float32))
+    corpus[:40] = np.array([np.inf, -np.inf, np.nan, 0.0, -0.0] * 8,
+                           np.float32)
+    other = rng.standard_normal(n).astype(np.float32)
+
+    nbytes_q = {}
+    for qd in (f8, i8):
+        ref_s, ref_q = quant._np_quantize(corpus, qd, block)
+        q, s = comp.bs_quantize(jnp.asarray(corpus), qd, block)
+        if (np.asarray(s).tobytes() != ref_s.tobytes()
+                or np.asarray(q).tobytes() != ref_q.tobytes()):
+            raise AssertionError(
+                f"device codec diverged from the quant.py reference "
+                f"({qd.name}, block {block}) — bit-identity broken")
+        acc = np.add(other, quant._np_dequant(ref_s, ref_q, block))
+        ref_s2, ref_q2 = quant._np_quantize(acc, qd, block)
+        q2, s2 = comp.bs_combine_requant(q, s, jnp.asarray(other),
+                                         ReduceFunc.SUM, qd, block)
+        if (np.asarray(s2).tobytes() != ref_s2.tobytes()
+                or np.asarray(q2).tobytes() != ref_q2.tobytes()):
+            raise AssertionError(
+                f"fused combine->requant diverged from the reference "
+                f"({qd.name}, block {block}) — bit-identity broken")
+        nbytes_q[qd.name] = np.asarray(q).nbytes + np.asarray(s).nbytes
+
+    # python-routed quantized ring through the real fused kernels: the
+    # hop schedule of ring_reduce_scatter_bs_shard with jnp.roll played
+    # by list rotation
+    count = 4096
+    ins = [(rng.standard_normal(world * count).astype(np.float32)
+            * np.float32(10.0)
+            ** rng.integers(-2, 3, world * count).astype(np.float32))
+           for _ in range(world)]
+    chunks = [x.reshape(world, count) for x in ins]
+    t0 = time.perf_counter()
+    state = {r: comp.bs_quantize(
+        jnp.asarray(chunks[r][(r + 1) % world]), f8, block)
+        for r in range(world)}
+    out = {}
+    for i in range(1, world):
+        nxt = {}
+        for r in range(world):
+            q, s = state[(r + 1) % world]
+            mine = jnp.asarray(chunks[r][(r + 1 + i) % world])
+            if i < world - 1:
+                nxt[r] = comp.bs_combine_requant(q, s, mine,
+                                                 ReduceFunc.SUM, f8,
+                                                 block)
+            else:
+                out[r] = comp.bs_dequant_combine(q, s, mine,
+                                                 ReduceFunc.SUM, block)
+        state = nxt
+    elapsed = time.perf_counter() - t0
+    exact = np.sum(chunks, axis=0, dtype=np.float64).astype(np.float32)
+    part = np.abs(np.stack(chunks)).sum(axis=0)
+    err_rel = 0.0
+    for r in range(world):
+        err = np.abs(np.asarray(out[r]) - exact[r])
+        bound = 2 * world * (2.0 ** -3) * np.maximum(part[r], 1e-6)
+        if not (err <= bound).all():
+            raise AssertionError(
+                f"device quantized ring rank {r} exceeded the typed "
+                f"error bound: max err {err.max()}")
+        err_rel = max(err_rel, float(
+            (err / np.maximum(part[r], 1.0)).max()))
+
+    return {
+        "device_quant_wire_ratio": round(4 * n / nbytes_q[f8.name], 3),
+        "device_quant_int8_wire_ratio": round(4 * n / nbytes_q[i8.name],
+                                              3),
+        "device_quant_block": block,
+        "device_quant_ring_err_rel": round(err_rel, 6),
+        "device_quant_us": round(elapsed * 1e6, 1),
+        "device_quant_codec_elems": n,
+    }
+
+
 def headline() -> dict:
     return quantize_headline()
 

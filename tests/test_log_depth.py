@@ -465,3 +465,89 @@ def test_msg_wait_below_failed_eviction_watermark_is_unknown():
     finally:
         for dm in daemons:
             dm.shutdown()
+
+
+def test_native_msg_wait_below_failed_eviction_watermark_is_unknown():
+    """Native-daemon twin of the watermark regression, driven through
+    the real socket protocol: >1024 failures age the bounded failure
+    FIFO, so a deferred MSG_WAIT below the watermark must answer
+    CALL_OUTCOME_UNKNOWN (never a fabricated 0); a failure still inside
+    the FIFO keeps its real error even after its STATUS entry ages out
+    of the 4096-entry map; and an evicted SUCCESS above the watermark
+    stays a genuine 0."""
+    import os
+    import socket
+    import struct
+    import subprocess
+    import time
+
+    from accl_tpu.emulator import protocol as P
+    from accl_tpu.testing import free_port_base
+
+    binary = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "cclo_emud")
+    if not os.path.exists(binary):
+        pytest.skip("native daemon not built (make -C native)")
+    port_base = free_port_base()
+    proc = subprocess.Popen(
+        [binary, "--rank", "0", "--world", "1",
+         "--port-base", str(port_base)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    s = None
+    try:
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                s = socket.create_connection(("127.0.0.1", port_base),
+                                             timeout=5.0)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+        s.settimeout(30.0)
+        f32 = P.DTYPE_CODES["float32"]
+
+        def submit(scenario, comm_id, n):
+            # pipeline in bounded batches, draining one MSG_CALL_ID per
+            # frame — an unbounded one-way push would fill both TCP
+            # windows and deadlock against the daemon's reply stream
+            ids = []
+            frame = P.pack_call(scenario, 0, 0, 0, f32, f32, 1,
+                                comm_id, 0, 0, 0, 0, 0, [])
+            while len(ids) < n:
+                batch = min(256, n - len(ids))
+                P.send_frames(s, [frame] * batch)
+                for _ in range(batch):
+                    reply = P.recv_frame(s)
+                    assert reply[0] == P.MSG_CALL_ID
+                    ids.append(struct.unpack("<I", reply[1:5])[0])
+            return ids
+
+        def wait(call_id, budget=20.0):
+            P.send_frame(s, bytes([P.MSG_WAIT])
+                         + struct.pack("<Id", call_id, budget))
+            reply = P.recv_frame(s)
+            assert reply[0] == P.MSG_STATUS
+            return struct.unpack("<I", reply[1:5])[0]
+
+        # phase A: fast-failing calls (unconfigured comm) overflow BOTH
+        # bounds — the 4096-entry status map and the 1024-entry failure
+        # FIFO — advancing the failure watermark past the oldest ids
+        fail_ids = submit(int(CCLOp.copy), 0xDEAD, 4200)
+        assert wait(fail_ids[-1]) == int(ErrorCode.COMM_NOT_CONFIGURED)
+        # below the failure watermark: outcome unknowable, never 0
+        assert wait(fail_ids[0]) == int(ErrorCode.CALL_OUTCOME_UNKNOWN)
+        # phase B: succeeding nops age the STATUS map past the retained
+        # failures without touching the failure FIFO
+        nop_ids = submit(int(CCLOp.nop), 0, 5000)
+        assert wait(nop_ids[-1]) == 0
+        # status evicted but failure retained: the real error survives
+        assert wait(fail_ids[-2]) == int(ErrorCode.COMM_NOT_CONFIGURED)
+        # evicted SUCCESS above the failure watermark: genuine 0
+        assert wait(nop_ids[100]) == 0
+    finally:
+        if s is not None:
+            s.close()
+        proc.kill()
+        proc.wait(timeout=10)
