@@ -504,6 +504,11 @@ class ACCL:
         return self._preflight_hier(op, nbytes)
 
     def _preflight_hier(self, op: str, nbytes: int) -> list[str]:
+        """Price the FULL N-tier phase chain against the rx pool: each
+        boundary tier's exchange parks blocks of roughly
+        ``nbytes / groups(tier)`` in the finite pool, so coarser tiers
+        (fewer groups, bigger blocks) are the first to breach the
+        2-chunk rule — the warning names the offending tier."""
         cap_fn = getattr(self.device, "rx_capacity", None)
         hier = self._hier
         if cap_fn is None or hier is None:
@@ -513,27 +518,37 @@ class ACCL:
         except Exception:  # noqa: BLE001 — preflight must never break
             return []      # the call it is trying to protect
         pool_bytes = nbufs * bufsize
-        n_hosts = max(1, len(hier.groups))
-        chunk = -(-nbytes // n_hosts)
-        if pool_bytes >= 2 * chunk:
-            return []
-        return [
-            f"rx pool ({nbufs} x {bufsize} B = {pool_bytes} B) cannot "
-            f"hold 2 chunks ({2 * chunk} B) of a hierarchical {op} of "
-            f"{nbytes} B across {n_hosts} hosts: expect timeout-shaped "
-            f"backpressure — raise nbufs/bufsize or split the call"]
+        nest = getattr(hier, "nest", None) or (hier.groups,)
+        warnings = []
+        for k, grouping in enumerate(nest):
+            n_groups = max(1, len(grouping))
+            chunk = -(-nbytes // n_groups)
+            if pool_bytes >= 2 * chunk:
+                continue
+            tier = "inter" if k == 0 else f"inter{k + 1}"
+            unit = "hosts" if k == 0 else "groups"
+            warnings.append(
+                f"rx pool ({nbufs} x {bufsize} B = {pool_bytes} B) cannot "
+                f"hold 2 chunks ({2 * chunk} B) of a hierarchical {op} of "
+                f"{nbytes} B on tier {tier} ({n_groups} {unit}): expect "
+                f"timeout-shaped backpressure — raise nbufs/bufsize or "
+                f"split the call")
+        return warnings
 
-    # -- two-tier hierarchy (accl_tpu/hier) --------------------------------
-    def configure_hierarchy(self, hosts: Sequence[int]):
-        """Declare the world's two-tier structure: ``hosts[r]`` is the
-        host id of world rank ``r`` (each host's ranks contiguous).
-        Builds the intra-host / inter-host sub-communicators the
-        HIERARCHICAL phase programs run over; every rank must configure
-        the same mapping (sub-comm ids derive deterministically from
-        membership, like :meth:`split_communicator`). Returns the
+    # -- N-tier hierarchy (accl_tpu/hier) ----------------------------------
+    def configure_hierarchy(self, hosts: Sequence[int],
+                            levels: Sequence[Sequence[int]] = ()):
+        """Declare the world's tier structure: ``hosts[r]`` is the host
+        id of world rank ``r`` (each host's ranks contiguous), and each
+        entry of ``levels`` adds one coarser boundary (rack, pod, ...)
+        as another rank->group-id map, innermost-first. Builds the
+        per-tier sub-communicators the HIERARCHICAL phase programs run
+        over; every rank must configure the same mapping (sub-comm ids
+        derive deterministically from membership, like
+        :meth:`split_communicator`). Returns the
         :class:`~accl_tpu.hier.Hierarchy`."""
         from .hier import Hierarchy
-        self._hier = Hierarchy(self, hosts)
+        self._hier = Hierarchy(self, hosts, levels=levels)
         return self._hier
 
     @property
@@ -542,9 +557,10 @@ class ACCL:
 
     def _ensure_hier(self):
         """Auto-configure the hierarchy once from an attached tuner's
-        two-tier MeshTopology (the emu ``hosts=`` wiring and real
-        deployments both land here) — deterministic across ranks, since
-        every rank binds the same device topology."""
+        MeshTopology (the emu ``hosts=``/``outer_tiers=`` wiring and
+        real deployments both land here) — deterministic across ranks,
+        since every rank binds the same device topology. A mesh with
+        coarser ``outer`` boundaries configures the full N-tier nest."""
         if self._hier is not None or not self._hier_autoprobe:
             return self._hier
         self._hier_autoprobe = False
@@ -553,7 +569,12 @@ class ACCL:
         if groups and len(groups) > 1 \
                 and sum(len(g) for g in groups) == self.comm.size:
             from .hier import Hierarchy
-            self._hier = Hierarchy(self, topo.hosts_list())
+            levels_fn = getattr(topo, "hosts_levels", None)
+            if callable(levels_fn) and getattr(topo, "outer", ()):
+                maps = levels_fn()
+                self._hier = Hierarchy(self, maps[0], levels=maps[1:])
+            else:
+                self._hier = Hierarchy(self, topo.hosts_list())
         return self._hier
 
     def _hier_route(self, op: str, comm: Communicator, count: int,
@@ -570,10 +591,9 @@ class ACCL:
         if alg == H:
             if self._ensure_hier() is None:
                 raise ValueError(
-                    "HIERARCHICAL requires a configured two-tier "
-                    "hierarchy: call configure_hierarchy(hosts) on every "
-                    "rank (or attach a tuner whose topology is a "
-                    "MeshTopology)")
+                    "HIERARCHICAL requires a configured hierarchy: call "
+                    "configure_hierarchy(hosts) on every rank (or attach "
+                    "a tuner whose topology is a MeshTopology)")
             if comm is not self.comm:
                 raise ValueError(
                     "hierarchical collectives run over the WORLD "

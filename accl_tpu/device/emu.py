@@ -58,6 +58,7 @@ class EmuContext:
                  service: "ServiceConfig | bool | None" = None,
                  hosts=None, inter_alpha_us: float | None = None,
                  inter_beta_gbps: float | None = None,
+                 outer_tiers=None,
                  retx_window: int | None = None,
                  csum: bool | None = None):
         self.world_size = world_size
@@ -121,6 +122,30 @@ class EmuContext:
                 self.fabric.set_tier_profile(
                     self.hosts, self.inter_alpha_us,
                     self.inter_beta_gbps)
+        # N-tier emulation: each ``outer_tiers`` entry is a coarser
+        # ``(hosts_map, alpha_us, beta_gbps)`` boundary innermost-first
+        # (rack, pod, ...). Profiles apply in->out so a coarser (slower)
+        # boundary overwrites the cross-group pairs of the finer one —
+        # a cross-rack link ends up with rack figures, a cross-host
+        # same-rack link keeps host figures.
+        self.outer_tiers = ([(list(h), float(a), float(b))
+                             for h, a, b in outer_tiers]
+                            if outer_tiers else [])
+        if self.outer_tiers:
+            if self.hosts is None:
+                raise ValueError(
+                    "outer_tiers require hosts= (coarser boundaries "
+                    "must enclose the host grouping)")
+            from ..hier import groups_from_hosts as _gfh
+            from ..hier.topology import validate_nest
+            for h, _a, _b in self.outer_tiers:
+                if len(h) != world_size:
+                    raise ValueError(f"outer tier maps {len(h)} ranks, "
+                                     f"world is {world_size}")
+            validate_nest((_gfh(self.hosts),)
+                          + tuple(_gfh(h) for h, _a, _b in self.outer_tiers))
+            for h, a, b in self.outer_tiers:
+                self.fabric.set_tier_profile(h, a, b)
         # multi-tenant service config shared by every rank of this world
         # (policy only; per-rank controllers/quotas live on the devices).
         # None = process default ($ACCL_TPU_SERVICE, on); False = off;
@@ -655,12 +680,15 @@ class EmuDevice(Device):
             # throttling is armed (a nominally-slower default tier when
             # only the grouping was given: the tuner needs SOME
             # ordering)
-            from ..hier import MeshTopology
+            from ..hier import MeshTopology, TierSpec
+            outer = tuple(TierSpec(hosts=tuple(h), alpha_us=a, beta_gbps=b)
+                          for h, a, b in self.ctx.outer_tiers)
             return MeshTopology.from_hosts(
                 self.ctx.hosts, alpha_us=20.0, beta_gbps=4.0,
                 inter_alpha_us=self.ctx.inter_alpha_us,
                 inter_beta_gbps=self.ctx.inter_beta_gbps,
-                tier="emu-two-tier", pipeline_depth=depth)
+                tier="emu-n-tier" if outer else "emu-two-tier",
+                outer=outer, pipeline_depth=depth)
         return Topology(world_size=self.ctx.world_size, alpha_us=20.0,
                         beta_gbps=4.0, tier="emu", pipeline_depth=depth)
 

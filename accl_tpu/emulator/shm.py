@@ -176,6 +176,12 @@ def _local_addrs() -> set:
 _DOORBELLS: dict[str, list] = {}     # name -> [Condition, refcount]
 _DB_LOCK = threading.Lock()
 
+# rx idle-poll backoff bounds (_rx_loop): first empty poll waits the
+# minimum, consecutive empties double up to the maximum, any frame
+# resets — tests/test_shm_fabric.py pins the cross-process idle latency
+_RX_IDLE_MIN_S = 0.001
+_RX_IDLE_MAX_S = 0.02
+
 # segment names THIS process created (resource-tracker hygiene): 3.10's
 # SharedMemory registers with the tracker on attach as well as create,
 # but the tracker's cache is a SET — an in-process attach's register is
@@ -845,14 +851,24 @@ class ShmFabric:
 
     # -- receive path ------------------------------------------------------
     def _rx_loop(self, src_grank: int, ch: _ShmChannel):
+        # Cross-process idle doorbell: in-process peers ring the shared
+        # Condition and wake us immediately, but a REAL remote process
+        # only has the wait timeout as its wakeup bound. Exponential
+        # backoff from 1 ms keeps a busy channel's worst-case cross-
+        # process latency ~1 ms (the first empty poll after traffic
+        # waits the minimum) while an idle channel decays to the old
+        # 20 ms cadence instead of burning it forever.
+        idle = _RX_IDLE_MIN_S
         while not self._closing:
             try:
                 got = ch.poll()
             except (OSError, struct.error):
                 return
             if got is None:
-                ch.wait_frames(0.02)
+                ch.wait_frames(idle)
+                idle = min(idle * 2.0, _RX_IDLE_MAX_S)
                 continue
+            idle = _RX_IDLE_MIN_S
             env, payload, flags = got
             try:
                 self._on_frame(env, payload, bool(flags & _FLAG_RETX))

@@ -1,19 +1,24 @@
-"""Two-tier hierarchical collectives + the array-redistribution engine.
+"""N-tier hierarchical collectives + the array-redistribution engine.
 
-Production meshes are two-tier: fast intra-host ICI links inside a host
-(or slice), a slower DCN tier between hosts. This package turns that
-structure into first-class machinery ("Memory-efficient array
-redistribution through portable collective communication", PAPERS.md):
+Production meshes are nests: fast intra-host ICI links inside a host
+(or slice), a slower DCN tier between hosts, and an order of magnitude
+of bandwidth lost again at each coarser boundary (rack, pod). This
+package turns that structure into first-class machinery ("Memory-
+efficient array redistribution through portable collective
+communication", PAPERS.md):
 
-* :class:`~accl_tpu.hier.topology.MeshTopology` — a two-tier link
-  descriptor (per-tier alpha/beta derived from a rank->host mapping)
-  the tuner's cost models price against;
+* :class:`~accl_tpu.hier.topology.MeshTopology` — a nested-tier link
+  descriptor (per-tier alpha/beta derived from a rank->host mapping
+  plus optional coarser :class:`~accl_tpu.hier.topology.TierSpec`
+  boundaries) the tuner's cost models price against; a mesh with no
+  ``outer`` entries is exactly the historical two-tier shape;
 * :class:`~accl_tpu.hier.engine.Hierarchy` — driver-level lowering of
   ``CollectiveAlgorithm.HIERARCHICAL`` to waitfor-chained phase
-  programs of flat collectives over intra-host / inter-host
-  sub-communicators (reduce-scatter inner -> allreduce outer ->
-  allgather inner for allreduce, plus bcast / allgather /
-  reduce_scatter shapes);
+  programs of flat collectives over per-tier sub-communicators,
+  RECURSIVELY over the nest (reduce-scatter descending -> top-tier
+  allreduce -> allgather ascending for allreduce, plus bcast /
+  allgather / reduce_scatter shapes), with a per-tier quantize
+  predicate picking which boundaries pay the compressed wire;
 * :class:`~accl_tpu.hier.sharding.ShardSpec` +
   :func:`~accl_tpu.hier.redistribute.plan_redistribute` — a sharding
   spec and a compiler lowering any sharding change to a minimal program
@@ -22,14 +27,16 @@ redistribution through portable collective communication", PAPERS.md):
   gather-reshard-scatter oracle.
 """
 
-from .topology import MeshTopology, groups_from_hosts
-from .engine import Hierarchy, plan_phases, Phase
+from .topology import MeshTopology, TierSpec, groups_from_hosts, \
+    validate_nest
+from .engine import Hierarchy, plan_phases, Phase, phase_tier_level
 from .sharding import ShardSpec
 from .redistribute import plan_redistribute, redistribute_oracle, \
     RedistPlan, RedistStep
 
 __all__ = [
-    "MeshTopology", "groups_from_hosts", "Hierarchy", "plan_phases",
-    "Phase", "ShardSpec", "plan_redistribute", "redistribute_oracle",
+    "MeshTopology", "TierSpec", "groups_from_hosts", "validate_nest",
+    "Hierarchy", "plan_phases", "Phase", "phase_tier_level",
+    "ShardSpec", "plan_redistribute", "redistribute_oracle",
     "RedistPlan", "RedistStep",
 ]

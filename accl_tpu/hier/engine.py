@@ -1,39 +1,48 @@
-"""Hierarchical two-tier collectives: driver-level phase programs.
+"""Hierarchical N-tier collectives: driver-level phase programs.
 
 ``CollectiveAlgorithm.HIERARCHICAL`` is not a move expansion — it is a
 short program of FLAT collectives over sub-communicators, chained
 through the existing async ``waitfor=`` path (each phase is admitted as
 an ordinary call, so every phase rides the compiled-plan cache and the
-streamed executor exactly like a user call):
+streamed executor exactly like a user call). The lowering RECURSES over
+a nest of contiguous groupings (host / rack / pod, innermost-first);
+with a single grouping it reproduces the historical two-tier programs
+byte-for-byte:
 
-* **allreduce**, index-aligned hosts (equal group size ``L`` dividing
+* **allreduce**, index-aligned groups (equal group size ``L`` dividing
   the count): ``reduce_scatter(inner) -> allreduce(outer_j) ->
   allgather(inner)`` — only ``n/L`` bytes cross the slow tier, and the
-  ``L`` outer communicators (one per intra-host index ``j``) cross it
-  CONCURRENTLY on disjoint host-pair links. Uneven hosts fall back to
+  ``L`` outer communicators (one per intra-group index ``j``) cross it
+  CONCURRENTLY on disjoint pair links. The ``outer_j`` exchange is
+  itself lowered recursively against the next coarser grouping, so an
+  N-tier nest descends with reduce_scatter, exchanges once at the top
+  tier, and ascends with allgather — each level moving ``1/L_level`` of
+  the bytes of the one below. Uneven groups fall back (per level) to
   the leader shape ``reduce(inner) -> allreduce(leaders) ->
   bcast(inner)``.
-* **bcast**: ``bcast(one representative per host) -> bcast(inner)`` —
-  the payload crosses the slow tier ``H-1`` times instead of up to
-  ``W-1`` (the representative of the root's host is the root itself).
-* **allgather**: ``gather(inner->leader) -> leaders exchange host
-  blocks (allgather when equal, rotated point-to-point otherwise) ->
-  bcast(inner)``.
-* **reduce_scatter**: ``reduce(inner->leader) ->
-  reduce_scatter(leaders) [uneven: allreduce(leaders)] ->
-  scatter(inner)``.
+* **bcast**: ``bcast(one representative per group) -> bcast(inner)``,
+  the representative exchange again lowered recursively — the payload
+  crosses each boundary once per group instead of once per rank.
+* **allgather**: ``gather(inner->leader)`` ascending the nest, a top
+  exchange of subtree blocks (allgather when equal, rotated
+  point-to-point otherwise), then full-vector ``bcast(inner)``
+  descending.
+* **reduce_scatter**: ``reduce(inner->leader)`` ascending, a top
+  ``reduce_scatter(leaders)`` (uneven: ``allreduce(leaders)``), then
+  ``scatter(inner)`` descending.
 
-The planner (:func:`plan_phases`) is pure — (op, groups, rank, count,
+The planner (:func:`plan_phases`) is pure — (op, nest, rank, count,
 root) in, the rank's :class:`Phase` list out — so
 ``scripts/check_blocking.py`` replays the exact programs the engine
 issues through the lane/hazard checkers, and the engine itself stays a
 thin buffer-binding loop.
 
-Phase ALGORITHM selection: with a two-tier
+Phase ALGORITHM selection: with a
 :class:`~accl_tpu.hier.topology.MeshTopology` available (the attached
 tuner's), each phase gets an explicit flat algorithm ranked against its
-OWN tier's link figures (``rank_algorithms`` on the intra/inter
-one-tier Topology) — deterministic across ranks, because every member
+OWN tier's link figures (``rank_algorithms`` on the tier's one-tier
+Topology — the tier is the number of nest boundaries the phase's
+members span) — deterministic across ranks, because every member
 computes it from the same inputs. Without one, phases carry AUTO (the
 static defaults; a tuner can never resolve a phase back to HIERARCHICAL
 — the cost models price sub-mesh calls flat, and the engine/driver
@@ -53,9 +62,10 @@ from ..constants import (CollectiveAlgorithm, HIERARCHICAL_OPS, ReduceFunc,
                          VALID_ALGORITHMS)
 from ..tracing import METRICS
 from ..tuner.cost import rank_algorithms
-from .topology import MeshTopology, groups_from_hosts
+from .topology import MeshTopology, groups_from_hosts, validate_nest
 
-__all__ = ["Phase", "HierPlan", "plan_phases", "Hierarchy"]
+__all__ = ["Phase", "HierPlan", "plan_phases", "phase_tier_level",
+           "Hierarchy"]
 
 # split keys reserved for hierarchy sub-communicators (disambiguates
 # their comm_ids from user splits over the same memberships)
@@ -63,6 +73,10 @@ KEY_INNER = 0x48E50
 KEY_OUTER = 0x48E51
 KEY_LEADERS = 0x48E52
 KEY_REPS = 0x48E53
+
+# default threshold for compress_phases="slow": tiers whose per-link
+# beta falls below this quantize, faster tiers stay full-precision
+SLOW_TIER_BETA_GBPS = 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,11 +113,278 @@ def _hostmap(groups) -> dict[int, int]:
     return {r: h for h, g in enumerate(groups) for r in g}
 
 
+def _level_key(base: int, level: int) -> int:
+    """Per-level split key: coarser levels shift to a fresh key block,
+    so a deep nest's sub-communicators never collide with the two-tier
+    ids (or each other) over equal memberships."""
+    return base + level * 0x10000
+
+
+def _role(name: str, level: int) -> str:
+    """Scratch role name: level 0 keeps the historical bare names (the
+    check_blocking role/address corpus and the scratch-size pins);
+    coarser frames suffix with their level."""
+    return name if level == 0 else f"{name}_{level}"
+
+
+def phase_tier_level(members, nest) -> int:
+    """Tier index of a phase: the number of nest boundaries its members
+    span (0 = intra-group, 1 = the host boundary, 2 = rack, ...). Pure
+    in (members, nest), so every rank of the phase derives the same
+    tier without a handshake."""
+    lvl = 0
+    for grouping in nest:
+        gm = _hostmap(grouping)
+        if len({gm[r] for r in members}) > 1:
+            lvl += 1
+    return lvl
+
+
+class _Planner:
+    """One rank's recursive lowering. Pure state driven by
+    :func:`plan_phases`: appends :class:`Phase` entries in program
+    order and accumulates scratch sizes."""
+
+    def __init__(self, nest, me: int, total: int):
+        self.nest = nest            # groupings innermost-first
+        self.me = me
+        self.total = total          # full result length in elements
+        self.phases: list[Phase] = []
+        self.scratch: dict = {}
+
+    def restrict(self, level: int, members):
+        """``members`` split by ``nest[level]`` (member order kept —
+        contiguity of the groupings keeps each part a consecutive run).
+        ``None`` when the level does not exist or does not split them
+        (a strictly-coarsening nest cannot re-split deeper)."""
+        if level >= len(self.nest):
+            return None
+        gm = _hostmap(self.nest[level])
+        out: list[list[int]] = []
+        cur = None
+        for r in members:
+            gid = gm[r]
+            if gid != cur:
+                out.append([])
+                cur = gid
+            out[-1].append(r)
+        if len(out) < 2:
+            return None
+        return tuple(tuple(g) for g in out)
+
+    # -- allreduce ----------------------------------------------------------
+    def ar(self, M, count, level, src, dst, base_key, base_label) -> str:
+        """Lower allreduce over ``M`` (inputs bound at ``src``, result
+        at ``dst``); returns the shape taken at THIS level."""
+        me = self.me
+        G = self.restrict(level, M)
+        if G is None:
+            self.phases.append(Phase("allreduce", M, count, base_key,
+                                     src=src, dst=dst, uses_func=True,
+                                     label=base_label))
+            return "flat"
+        g = next(grp for grp in G if me in grp)
+        sizes = {len(grp) for grp in G}
+        aligned = len(sizes) == 1
+        L = max(sizes)
+        pre = "inner" if level == 0 else f"l{level}"
+        if aligned and L > 1 and count % L == 0:
+            j = g.index(me)
+            m = count // L
+            outer_j = tuple(grp[j] for grp in G)
+            s1, s2 = _role("s1", level), _role("s2", level)
+            self.phases.append(Phase("reduce_scatter", g, m,
+                                     _level_key(KEY_INNER, level),
+                                     src=src, dst=(s1, 0, 0),
+                                     uses_func=True, label=f"{pre}-rs"))
+            self.scratch[s1] = m
+            self.scratch[s2] = m
+            self.ar(outer_j, m, level + 1, (s1, 0, 0), (s2, 0, 0),
+                    _level_key(KEY_OUTER, level), "outer-ar")
+            self.phases.append(Phase("allgather", g, m,
+                                     _level_key(KEY_INNER, level),
+                                     src=(s2, 0, 0), dst=dst,
+                                     label=f"{pre}-ag"))
+            return "aligned"
+        sn = _role("sn", level)
+        self.phases.append(Phase("reduce", g, count,
+                                 _level_key(KEY_INNER, level), root=0,
+                                 src=src,
+                                 dst=(sn, 0, 0) if me == g[0] else None,
+                                 uses_func=True, label=f"{pre}-reduce"))
+        if me == g[0]:
+            self.scratch[sn] = count
+            leaders = tuple(grp[0] for grp in G)
+            self.ar(leaders, count, level + 1, (sn, 0, 0), dst,
+                    _level_key(KEY_LEADERS, level), "leader-ar")
+        if len(g) > 1:
+            self.phases.append(Phase("bcast", g, count,
+                                     _level_key(KEY_INNER, level), root=0,
+                                     src=dst, label=f"{pre}-bcast"))
+        return "leader"
+
+    # -- bcast --------------------------------------------------------------
+    def bc(self, M, count, level, root_rank, base_key, base_label):
+        me = self.me
+        G = self.restrict(level, M)
+        if G is None:
+            if len(M) > 1:
+                self.phases.append(Phase("bcast", M, count, base_key,
+                                         root=M.index(root_rank),
+                                         src=("op0", 0, 0),
+                                         label=base_label))
+            return
+        g = next(grp for grp in G if me in grp)
+        # a group's representative is the root itself when the root is
+        # inside it (so the root is ALWAYS its own subtree's rep, at
+        # every level of the nest), else the group's first rank
+        reps = tuple(root_rank if root_rank in grp else grp[0]
+                     for grp in G)
+        pre = "inner" if level == 0 else f"l{level}"
+        if me in reps:
+            self.bc(reps, count, level + 1, root_rank,
+                    _level_key(KEY_REPS, level), "outer-bcast")
+        if len(g) > 1:
+            rep = root_rank if root_rank in g else g[0]
+            self.phases.append(Phase("bcast", g, count,
+                                     _level_key(KEY_INNER, level),
+                                     root=g.index(rep), src=("op0", 0, 0),
+                                     label=f"{pre}-bcast"))
+
+    # -- allgather ----------------------------------------------------------
+    def ag(self, M, level, blocks, base_key):
+        """Each member of ``M`` owns one contiguous block of the result
+        (``blocks``: (elem_off, elem_len) parallel to ``M``, ascending
+        and gapless over the full vector); afterwards every rank below
+        ``M`` holds the full vector in ``res``."""
+        me = self.me
+        i = M.index(me)
+        off, ln = blocks[i]
+        G = self.restrict(level, M)
+        # descending a level needs an in-group gather, which needs equal
+        # member blocks within each group; otherwise exchange the blocks
+        # of M directly here (the remaining structure treated flat —
+        # exactly the two-tier uneven fallback, generalized)
+        feasible = G is not None and all(
+            len({blocks[M.index(r)][1] for r in grp}) == 1 for grp in G)
+        if not feasible:
+            if len({b[1] for b in blocks}) == 1:
+                self.phases.append(Phase("allgather", M, ln, base_key,
+                                         src=("res", off, ln),
+                                         dst=("res", 0, 0),
+                                         label="leader-ag"))
+            else:
+                # rotated point-to-point block exchange: eager sends
+                # first (they complete on emission — no rendezvous),
+                # the matching recvs after
+                n = len(M)
+                for step in range(1, n):
+                    to = (i + step) % n
+                    self.phases.append(Phase("send", M, ln, base_key,
+                                             root=to,
+                                             src=("res", off, ln),
+                                             label="leader-send"))
+                for step in range(1, n):
+                    frm = (i - step) % n
+                    foff, fln = blocks[frm]
+                    self.phases.append(Phase("recv", M, fln, base_key,
+                                             root=frm,
+                                             dst=("res", foff, fln),
+                                             label="leader-recv"))
+            return
+        g = next(grp for grp in G if me in grp)
+        pre = "inner" if level == 0 else f"l{level}"
+        goff = blocks[M.index(g[0])][0]
+        glen = sum(blocks[M.index(r)][1] for r in g)
+        self.phases.append(Phase(
+            "gather", g, ln, _level_key(KEY_INNER, level), root=0,
+            src=(("op0", 0, 0) if level == 0 else ("res", off, ln)),
+            dst=(("res", goff, glen) if me == g[0] else None),
+            label=f"{pre}-gather"))
+        if me == g[0]:
+            leaders = tuple(grp[0] for grp in G)
+            gblocks = tuple(
+                (blocks[M.index(grp[0])][0],
+                 sum(blocks[M.index(r)][1] for r in grp))
+                for grp in G)
+            self.ag(leaders, level + 1, gblocks,
+                    _level_key(KEY_LEADERS, level))
+        if len(g) > 1:
+            self.phases.append(Phase("bcast", g, self.total,
+                                     _level_key(KEY_INNER, level), root=0,
+                                     src=("res", 0, 0),
+                                     label=f"{pre}-bcast"))
+
+    # -- reduce_scatter -----------------------------------------------------
+    def rs(self, M, level, src, blocks, out, base_key):
+        """Each member of ``M`` holds the full partial vector in its
+        ``src`` binding; afterwards member r's block is reduced and
+        delivered to its return binding (``out`` at the user-facing
+        level, a scratch at coarser frames). Returns MY block's
+        binding."""
+        me = self.me
+        i = M.index(me)
+        off, ln = blocks[i]
+        G = self.restrict(level, M)
+        # the descending scatter needs equal member blocks within each
+        # group; otherwise exchange here over M, treated flat
+        feasible = G is not None and all(
+            len({blocks[M.index(r)][1] for r in grp}) == 1 for grp in G)
+        if not feasible:
+            if len({b[1] for b in blocks}) == 1:
+                sb = _role("sb", max(level - 1, 0))
+                self.phases.append(Phase("reduce_scatter", M, ln,
+                                         base_key, src=src,
+                                         dst=(sb, 0, 0), uses_func=True,
+                                         label="leader-rs"))
+                self.scratch[sb] = ln
+                return (sb, 0, 0)
+            sn2 = _role("sn2", max(level - 1, 0))
+            self.phases.append(Phase("allreduce", M, self.total,
+                                     base_key, src=src, dst=(sn2, 0, 0),
+                                     uses_func=True, label="leader-ar"))
+            self.scratch[sn2] = self.total
+            return (sn2, off, ln)
+        g = next(grp for grp in G if me in grp)
+        pre = "inner" if level == 0 else f"l{level}"
+        sn = _role("sn", level)
+        self.phases.append(Phase("reduce", g, self.total,
+                                 _level_key(KEY_INNER, level), root=0,
+                                 src=src,
+                                 dst=(sn, 0, 0) if me == g[0] else None,
+                                 uses_func=True, label=f"{pre}-reduce"))
+        blk = None
+        if me == g[0]:
+            self.scratch[sn] = self.total
+            leaders = tuple(grp[0] for grp in G)
+            gblocks = tuple(
+                (blocks[M.index(grp[0])][0],
+                 sum(blocks[M.index(r)][1] for r in grp))
+                for grp in G)
+            blk = self.rs(leaders, level + 1, (sn, 0, 0), gblocks, None,
+                          _level_key(KEY_LEADERS, level))
+        if out is not None:
+            dstb = out
+        else:
+            sc = _role("sc", level)
+            self.scratch[sc] = ln
+            dstb = (sc, 0, 0)
+        self.phases.append(Phase("scatter", g, ln,
+                                 _level_key(KEY_INNER, level), root=0,
+                                 src=blk, dst=dstb,
+                                 label=f"{pre}-scatter"))
+        return dstb
+
+
 def plan_phases(op: str, groups, me: int, count: int,
-                root: int = 0) -> HierPlan | None:
+                root: int = 0, nest=()) -> HierPlan | None:
     """Compile one rank's hierarchical phase program.
 
-    ``groups``: contiguous host groups (:func:`groups_from_hosts`).
+    ``groups``: contiguous host groups (:func:`groups_from_hosts`);
+    ``nest``: optional COARSER groupings above it, innermost-first
+    (each a tuple of rank tuples — rack, pod, ...), validated as a
+    strict contiguous coarsening chain. With ``nest=()`` the lowering
+    is the historical two-tier program, byte-for-byte.
     ``count`` follows the driver's per-op convention (total elements for
     allreduce/bcast, per-rank chunk for allgather/reduce_scatter).
     Returns ``None`` when the hierarchy is degenerate (fewer than two
@@ -116,153 +397,54 @@ def plan_phases(op: str, groups, me: int, count: int,
     if op not in HIERARCHICAL_OPS:
         raise ValueError(f"{op} has no hierarchical lowering "
                          f"(HIERARCHICAL_OPS: {sorted(HIERARCHICAL_OPS)})")
+    full_nest = (groups,) + tuple(
+        tuple(tuple(g) for g in grouping) for grouping in nest)
+    if len(full_nest) > 1:
+        validate_nest(full_nest)
     W = sum(len(g) for g in groups)
-    host = _hostmap(groups)
-    h = host[me]
-    g = groups[h]
-    j = g.index(me)
-    L_h = len(g)
-    leaders = tuple(grp[0] for grp in groups)
-    sizes = {len(grp) for grp in groups}
-    aligned = len(sizes) == 1
-    L = max(sizes)
+    ranks = tuple(range(W))
+    top_spans = {len(g) for g in full_nest[-1]}
 
     if op == "allreduce":
-        if aligned and L > 1 and count % L == 0:
-            m = count // L
-            outer_j = tuple(grp[j] for grp in groups)
-            phases = (
-                Phase("reduce_scatter", g, m, KEY_INNER,
-                      src=("op0", 0, 0), dst=("s1", 0, 0), uses_func=True,
-                      label="inner-rs"),
-                Phase("allreduce", outer_j, m, KEY_OUTER,
-                      src=("s1", 0, 0), dst=("s2", 0, 0), uses_func=True,
-                      label="outer-ar"),
-                Phase("allgather", g, m, KEY_INNER,
-                      src=("s2", 0, 0), dst=("res", 0, 0),
-                      label="inner-ag"),
-            )
-            return HierPlan("aligned", phases, {"s1": m, "s2": m})
-        phases = [Phase("reduce", g, count, KEY_INNER, root=0,
-                        src=("op0", 0, 0),
-                        dst=("sn", 0, 0) if me == g[0] else None,
-                        uses_func=True, label="inner-reduce")]
-        if me == g[0]:
-            phases.append(Phase("allreduce", leaders, count, KEY_LEADERS,
-                                src=("sn", 0, 0), dst=("res", 0, 0),
-                                uses_func=True, label="leader-ar"))
-        if L_h > 1:
-            phases.append(Phase("bcast", g, count, KEY_INNER, root=0,
-                                src=("res", 0, 0), label="inner-bcast"))
-        return HierPlan("leader", tuple(phases),
-                        {"sn": count} if me == g[0] else {})
+        p = _Planner(full_nest, me, count)
+        mode = p.ar(ranks, count, 0, ("op0", 0, 0), ("res", 0, 0),
+                    KEY_OUTER, "outer-ar")
+        return HierPlan(mode, tuple(p.phases), p.scratch)
 
     if op == "bcast":
-        rh = host[root]
-        reps = tuple(root if hh == rh else groups[hh][0]
-                     for hh in range(H))
-        phases = []
-        if me in reps:
-            phases.append(Phase("bcast", reps, count, KEY_REPS, root=rh,
-                                src=("op0", 0, 0), label="outer-bcast"))
-        if L_h > 1:
-            rep = root if h == rh else g[0]
-            phases.append(Phase("bcast", g, count, KEY_INNER,
-                                root=g.index(rep), src=("op0", 0, 0),
-                                label="inner-bcast"))
-        return HierPlan("reps", tuple(phases), {})
+        p = _Planner(full_nest, me, count)
+        p.bc(ranks, count, 0, root, KEY_REPS, "outer-bcast")
+        return HierPlan("reps", tuple(p.phases), p.scratch)
 
     if op == "allgather":
-        # host h's block of the result: its ranks' chunks, contiguous at
-        # element offset groups[h][0] * count (contiguity convention)
-        def block_off(hh: int) -> int:
-            return groups[hh][0] * count
-
-        def block_len(hh: int) -> int:
-            return len(groups[hh]) * count
-
-        phases = [Phase("gather", g, count, KEY_INNER, root=0,
-                        src=("op0", 0, 0),
-                        dst=(("res", block_off(h), block_len(h))
-                             if me == g[0] else None),
-                        label="inner-gather")]
-        if me == g[0]:
-            if aligned:
-                phases.append(Phase(
-                    "allgather", leaders, L * count, KEY_LEADERS,
-                    src=("res", block_off(h), block_len(h)),
-                    dst=("res", 0, 0), label="leader-ag"))
-            else:
-                # rotated point-to-point block exchange: eager sends
-                # first (they complete on emission — no rendezvous), the
-                # matching recvs after
-                my = leaders.index(me)
-                for step in range(1, H):
-                    to = (my + step) % H
-                    phases.append(Phase(
-                        "send", leaders, block_len(h), KEY_LEADERS,
-                        root=to, src=("res", block_off(h), block_len(h)),
-                        label="leader-send"))
-                for step in range(1, H):
-                    frm = (my - step) % H
-                    fh = frm
-                    phases.append(Phase(
-                        "recv", leaders, block_len(fh), KEY_LEADERS,
-                        root=frm, dst=("res", block_off(fh),
-                                       block_len(fh)),
-                        label="leader-recv"))
-        if L_h > 1:
-            phases.append(Phase("bcast", g, W * count, KEY_INNER, root=0,
-                                src=("res", 0, 0), label="inner-bcast"))
-        return HierPlan("aligned" if aligned else "p2p", tuple(phases),
-                        {})
+        p = _Planner(full_nest, me, W * count)
+        blocks = tuple((r * count, count) for r in ranks)
+        p.ag(ranks, 0, blocks, KEY_LEADERS)
+        return HierPlan("aligned" if len(top_spans) == 1 else "p2p",
+                        tuple(p.phases), p.scratch)
 
     if op == "reduce_scatter":
-        def block_off(hh: int) -> int:
-            return groups[hh][0] * count
-
-        phases = [Phase("reduce", g, W * count, KEY_INNER, root=0,
-                        src=("op0", 0, 0),
-                        dst=("sn", 0, 0) if me == g[0] else None,
-                        uses_func=True, label="inner-reduce")]
-        scratch = {"sn": W * count} if me == g[0] else {}
-        if me == g[0]:
-            if aligned:
-                phases.append(Phase(
-                    "reduce_scatter", leaders, L * count, KEY_LEADERS,
-                    src=("sn", 0, 0), dst=("sb", 0, 0), uses_func=True,
-                    label="leader-rs"))
-                scratch["sb"] = L * count
-                src3 = ("sb", 0, 0)
-            else:
-                phases.append(Phase(
-                    "allreduce", leaders, W * count, KEY_LEADERS,
-                    src=("sn", 0, 0), dst=("sn2", 0, 0), uses_func=True,
-                    label="leader-ar"))
-                scratch["sn2"] = W * count
-                src3 = ("sn2", block_off(h), L_h * count)
-        else:
-            src3 = None
-        phases.append(Phase("scatter", g, count, KEY_INNER, root=0,
-                            src=src3, dst=("res", 0, 0),
-                            label="inner-scatter"))
-        return HierPlan("aligned" if aligned else "leader",
-                        tuple(phases), scratch)
+        p = _Planner(full_nest, me, W * count)
+        blocks = tuple((r * count, count) for r in ranks)
+        p.rs(ranks, 0, ("op0", 0, 0), blocks, ("res", 0, 0), KEY_LEADERS)
+        return HierPlan("aligned" if len(top_spans) == 1 else "leader",
+                        tuple(p.phases), p.scratch)
 
     raise AssertionError(op)
 
 
 class Hierarchy:
-    """One driver's two-tier structure: host groups + cached sub-comms.
+    """One driver's tier structure: nested groups + cached sub-comms.
 
-    Built by ``ACCL.configure_hierarchy(hosts)`` (or auto-configured
-    from an attached tuner's MeshTopology). All ranks of the world must
+    Built by ``ACCL.configure_hierarchy(hosts, levels=...)`` (or
+    auto-configured from an attached tuner's MeshTopology — including
+    its coarser ``outer`` boundaries). All ranks of the world must
     configure the SAME mapping — sub-communicator ids are derived
     deterministically from membership, so members agree without a
     handshake, exactly like ``split_communicator``.
     """
 
-    def __init__(self, accl, hosts):
+    def __init__(self, accl, hosts, levels=()):
         self.accl = accl
         self.hosts = list(hosts)
         self.groups = groups_from_hosts(self.hosts)
@@ -274,6 +456,16 @@ class Hierarchy:
             raise ValueError(
                 "hierarchy needs at least two hosts — a one-host world "
                 "is the flat (degenerate one-tier) case")
+        self.levels = [list(lv) for lv in levels]
+        for lv in self.levels:
+            if len(lv) != accl.comm.size:
+                raise ValueError(
+                    f"hierarchy level maps {len(lv)} ranks but the "
+                    f"world communicator has {accl.comm.size}")
+        self.nest = (self.groups,) + tuple(
+            groups_from_hosts(lv) for lv in self.levels)
+        if len(self.nest) > 1:
+            validate_nest(self.nest)
         self._subcomms: dict = {}
         self._scratch: dict = {}
         # recycled private scratch SETS for async programs (see
@@ -303,9 +495,9 @@ class Hierarchy:
 
     def _phase_algorithm(self, ph: Phase, elem_bytes: int):
         """Explicit flat algorithm for one phase, ranked against the
-        phase's OWN tier (inner phases run on the intra tier, phases
-        whose members span hosts on the inter tier). Deterministic
-        across ranks: every member computes from the same inputs."""
+        phase's OWN tier (the slowest boundary its members span).
+        Deterministic across ranks: every member computes from the same
+        inputs."""
         if ph.scenario not in VALID_ALGORITHMS:
             return CollectiveAlgorithm.AUTO
         mesh = self._mesh_topology()
@@ -315,10 +507,9 @@ class Hierarchy:
         got = self._alg_memo.get(key)
         if got is not None:
             return got
-        host = _hostmap(self.groups)
-        spans = len({host[r] for r in ph.members}) > 1
-        topo = (mesh.inter_topology(len(ph.members)) if spans
-                else mesh.intra_topology(len(ph.members)))
+        lvl = phase_tier_level(ph.members, self.nest)
+        topo = mesh.tier_topology(min(lvl, mesh.n_tiers - 1),
+                                  len(ph.members))
         ranked = [(a, c) for a, c in rank_algorithms(
             ph.scenario, topo, ph.count * elem_bytes, len(ph.members))
             if a != CollectiveAlgorithm.HIERARCHICAL]
@@ -370,19 +561,66 @@ class Hierarchy:
             return b[off:off + length] if length else b[off:]
         return b
 
+    def _phase_level(self, ph: Phase) -> int:
+        """Numeric tier of a phase: boundaries its members span (0 =
+        intra). Pure in the nest, so every rank of the phase derives
+        the same tier."""
+        return phase_tier_level(ph.members, self.nest)
+
     def _phase_tier(self, ph: Phase) -> str:
-        """"inter" when the phase's members span hosts (its wire rides
-        the slow tier), else "intra". Pure in the grouping, so every
-        rank of the phase derives the same tier."""
-        host = _hostmap(self.groups)
-        return ("inter" if len({host[r] for r in ph.members}) > 1
-                else "intra")
+        """Metric label for a phase's tier: "intra", "inter" (the host
+        boundary — the historical two-tier name), "inter2"+ beyond."""
+        lvl = self._phase_level(ph)
+        return ("intra" if lvl == 0
+                else "inter" if lvl == 1 else f"inter{lvl}")
+
+    def _compress_predicate(self, compress_phases):
+        """Per-tier quantize predicate from the ``compress_phases``
+        argument: None/"all" = every phase (the pre-existing uniform
+        behavior), "inter" = every phase above the intra tier, "slow" =
+        tiers whose beta is below ``SLOW_TIER_BETA_GBPS``, a number =
+        that beta threshold in GB/s, a callable = ``pred(level,
+        beta_gbps) -> bool``. Threshold forms never quantize the intra
+        tier (level 0), keeping in-group phases bit-identical."""
+        if compress_phases is None or compress_phases == "all":
+            return lambda lvl: True
+        if compress_phases == "inter":
+            return lambda lvl: lvl >= 1
+        mesh = self._mesh_topology()
+        n = mesh.n_tiers if mesh is not None else None
+
+        def beta_of(lvl):
+            if mesh is None:
+                return None
+            return mesh.tier_beta_gbps(min(lvl, n - 1))
+
+        if compress_phases == "slow" or (
+                isinstance(compress_phases, (int, float))
+                and not isinstance(compress_phases, bool)):
+            thresh = (SLOW_TIER_BETA_GBPS if compress_phases == "slow"
+                      else float(compress_phases))
+
+            def slow(lvl):
+                if lvl < 1:
+                    return False
+                b = beta_of(lvl)
+                # no mesh figures: every boundary tier is presumed slow
+                # (the "inter" semantics)
+                return True if b is None else b < thresh
+
+            return slow
+        if callable(compress_phases):
+            return lambda lvl: bool(compress_phases(lvl, beta_of(lvl)))
+        raise ValueError(
+            f"compress_phases must be None, 'all', 'inter', 'slow', a "
+            f"beta threshold in GB/s or a callable(level, beta_gbps) -> "
+            f"bool, got {compress_phases!r}")
 
     # -- execution ----------------------------------------------------------
     def run(self, op: str, *, count: int, src=None, dst=None,
             func: ReduceFunc = ReduceFunc.SUM, root: int = 0,
             compress_dtype=None, block_scale: bool | int = False,
-            compress_phases: str | None = None, run_async: bool = False,
+            compress_phases=None, run_async: bool = False,
             waitfor: Sequence = ()):
         """Issue one hierarchical collective as a waitfor-chained phase
         program; returns the final phase's handle (async) or a completed
@@ -390,17 +628,18 @@ class Hierarchy:
         hierarchy always has >= 2 hosts (ctor contract).
 
         Per-phase compression (EQuARX's headline trick, arXiv
-        2506.17615): ``compress_phases="inter"`` applies
-        ``compress_dtype``/``block_scale`` ONLY to phases whose
-        sub-communicator spans hosts — the slow DCN tier rides fp8/int8
-        scale-block wire while intra-host phases run full precision and
-        stay bit-identical to the uncompressed program. ``"all"``/None
+        2506.17615): ``compress_phases`` selects WHICH tiers apply
+        ``compress_dtype``/``block_scale`` (see
+        :meth:`_compress_predicate`) — slow tiers ride fp8/int8
+        scale-block wire while fast phases run full precision and stay
+        bit-identical to the uncompressed program. ``"all"``/None
         compresses every phase (the pre-existing uniform behavior).
-        Tier choice is pure in (groups, members), so all ranks agree
+        Tier choice is pure in (nest, members), so all ranks agree
         without a handshake."""
         accl = self.accl
         me = accl.comm.local_rank
-        plan = plan_phases(op, self.groups, me, count, root)
+        plan = plan_phases(op, self.groups, me, count, root,
+                           nest=self.nest[1:])
         assert plan is not None  # ctor guarantees >= 2 hosts
         dtype = (np.promote_types(src.dtype, dst.dtype)
                  if (src is not None and dst is not None)
@@ -455,11 +694,7 @@ class Hierarchy:
         if run_async:
             private = (self._async_scratch_pool.pop()
                        if self._async_scratch_pool else {})
-        if compress_phases not in (None, "all", "inter"):
-            raise ValueError(
-                f"compress_phases must be None, 'all' or 'inter', got "
-                f"{compress_phases!r}")
-        inter_only = compress_phases == "inter"
+        quantize_tier = self._compress_predicate(compress_phases)
         with accl._attributed(tag):
             for ph in plan.phases:
                 comm = self._comm(ph.members, ph.key)
@@ -468,11 +703,12 @@ class Hierarchy:
                 db = self._bind(ph.dst, src, dst, plan.scratch, dtype,
                                 private)
                 alg = self._phase_algorithm(ph, ebytes)
-                tier = self._phase_tier(ph)
-                # phase-selective wire: the slow tier compresses, the
-                # intra tier stays full-precision bit-identical
-                cd = (compress_dtype
-                      if not inter_only or tier == "inter" else None)
+                lvl = self._phase_level(ph)
+                tier = ("intra" if lvl == 0
+                        else "inter" if lvl == 1 else f"inter{lvl}")
+                # phase-selective wire: slow tiers compress, fast tiers
+                # stay full-precision bit-identical
+                cd = compress_dtype if quantize_tier(lvl) else None
                 bsc = block_scale if cd is not None else False
                 if compress_dtype is not None:
                     METRICS.inc(

@@ -27,6 +27,7 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
               service=None, tenant: str | None = None,
               hosts=None, inter_alpha_us: float | None = None,
               inter_beta_gbps: float | None = None,
+              outer_tiers=None,
               retx_window: int | None = None,
               csum: bool | None = None,
               retry_policy=None, verify_integrity: bool = False
@@ -49,12 +50,17 @@ def emu_world(world_size: int, nbufs: int = 16, bufsize: int | None = None,
     runs): devices then report a MeshTopology (so a shared tuner can
     select HIERARCHICAL, accl_tpu/hier) and — with ``inter_alpha_us``/
     ``inter_beta_gbps`` — the fabric emulates the slow inter-host tier
-    on every cross-host link."""
+    on every cross-host link. ``outer_tiers`` adds coarser boundaries
+    (rack, pod, ...) as ``(hosts_map, alpha_us, beta_gbps)`` triples
+    innermost-first: the fabric profiles them in->out (a cross-rack
+    link gets the rack figures) and devices report the full N-tier
+    MeshTopology."""
     kw = {"nbufs": nbufs, "pipeline_window": pipeline_window,
           "segment_stream": segment_stream, "plan_cache": plan_cache,
           "service": service, "hosts": hosts,
           "inter_alpha_us": inter_alpha_us,
           "inter_beta_gbps": inter_beta_gbps,
+          "outer_tiers": outer_tiers,
           "retx_window": retx_window, "csum": csum}
     if bufsize is not None:
         kw["bufsize"] = bufsize

@@ -283,21 +283,23 @@ def _gather_tree(topo: Topology, w: int, nbytes: float) -> float:
             + _RD_WIRE_FACTOR * (w - 1) * topo.wire_us(nbytes))
 
 
-# -- two-tier hierarchical family (accl_tpu/hier) ---------------------------
+# -- N-tier hierarchical family (accl_tpu/hier) -----------------------------
 #
 # HIERARCHICAL is a DRIVER-level phase program over sub-communicators
-# (hier/engine.py): e.g. allreduce = reduce-scatter(inner) ->
-# allreduce(outer) -> allgather(inner). Its cost is the sum of the
-# cheapest FLAT phase costs on each tier's own Topology — the same
-# per-tier selection the engine performs — plus a small per-phase
-# driver-chaining overhead. On a one-tier Topology (no ``groups``
-# attribute, or a single host) the models price themselves out
-# (infinite), so AUTO picks hierarchical exactly when a two-tier
-# MeshTopology says the inter-tier link is worth avoiding. Flat
-# algorithms on a MeshTopology are priced against its
-# ``flat_equivalent()`` (ring-hop weighted alpha / harmonic beta), so
-# the crossover the selection produces is inter-vs-intra beta ratio —
-# the point of the subsystem.
+# (hier/engine.py): e.g. allreduce = reduce-scatter descending the
+# nest -> allreduce(top tier) -> allgather ascending. Its cost is the
+# sum over nest levels of the cheapest FLAT phase cost on each level's
+# own tier Topology — the same per-tier selection the engine performs —
+# plus a small per-phase driver-chaining overhead that grows with nest
+# depth. On a one-tier Topology (no ``groups`` attribute, or a single
+# host) the models price themselves out (infinite), so AUTO picks
+# hierarchical exactly when a MeshTopology says a boundary tier's link
+# is worth avoiding; a two-tier mesh (no ``outer`` entries) prices to
+# the same number as before the nest generalization. Flat algorithms
+# on a MeshTopology are priced against its ``flat_equivalent()``
+# (per-tier ring-hop weighted alpha / harmonic beta), so the crossover
+# the selection produces is the boundary-vs-intra beta ratio — the
+# point of the subsystem.
 
 _HIER_PHASE_ALPHAS = 3.0   # driver-side phase chaining (waitfor hops)
 
@@ -339,77 +341,138 @@ def _hier_tiers(mesh):
     return intra, inter, L, mesh.n_hosts
 
 
+def _hier_ladder(mesh):
+    """The pricing skeleton of the recursive lowering: per grouping
+    level a ``(fanout, tier Topology, aligned)`` triple innermost-first,
+    plus the top-tier exchange's ``(group count, tier Topology)``.
+    Fanout at the innermost level is the largest group size; deeper it
+    is the largest number of sub-groups merged per group. Duck-typed:
+    a mesh without ``nest()`` prices as the historical intra/inter
+    pair."""
+    nest_fn = getattr(mesh, "nest", None)
+    tier_fn = getattr(mesh, "tier_topology", None)
+    if not (callable(nest_fn) and callable(tier_fn)):
+        intra, inter, L, H = _hier_tiers(mesh)
+        return ([(L, intra, bool(getattr(mesh, "aligned", False)))],
+                (H, inter))
+    nest = nest_fn()
+    levels = []
+    prev = None
+    for lvl, grouping in enumerate(nest):
+        if prev is None:
+            sizes = [len(g) for g in grouping]
+        else:
+            owner = {r: gi for gi, g in enumerate(grouping) for r in g}
+            sizes = [0] * len(grouping)
+            for p in prev:
+                sizes[owner[p[0]]] += 1
+        levels.append((max(sizes), tier_fn(lvl), len(set(sizes)) == 1))
+        prev = grouping
+    return levels, (len(nest[-1]), tier_fn(len(nest)))
+
+
 def _allreduce_hier(topo: Topology, w: int, nbytes: float) -> float:
-    """reduce-scatter(inner) -> allreduce(outer) -> allgather(inner)
-    when hosts are index-aligned (only n/L bytes ever cross the slow
-    tier, concurrently per inner index); reduce(inner) ->
-    allreduce(leaders) -> bcast(inner) otherwise (full n over the slow
-    tier, but still once instead of the flat ring's repeated
-    crossings)."""
+    """Aligned nests: reduce-scatter descending every level ->
+    allreduce(top tier) -> allgather ascending (each boundary tier only
+    ever carries its subtree's shrunk chunk, concurrently per inner
+    index); otherwise reduce-to-leader descending -> allreduce(top
+    leaders) -> bcast ascending (full n over each slow boundary, but
+    once instead of the flat ring's repeated crossings)."""
     mesh = _hier_mesh(topo, w)
     if mesh is None:
         return math.inf
-    intra, inter, L, H = _hier_tiers(mesh)
-    over = _HIER_PHASE_ALPHAS * intra.alpha_us
+    levels, (H, top) = _hier_ladder(mesh)
+    over = levels[0][1].alpha_us * (
+        _HIER_PHASE_ALPHAS + 2.0 * (len(levels) - 1))
+    fans = [f for f, _t, _a in levels]
+    prod = 1
+    for f in fans:
+        prod *= f
     # the cheap aligned shape additionally needs the ELEMENT count to
-    # divide by L (plan_phases falls back to the leader shape
-    # otherwise). The model only sees bytes; nbytes % L == 0 is the
-    # necessary-condition proxy (count % L == 0 implies it), so
-    # byte-indivisible sizes are priced at the leader cost they will
-    # actually pay. A byte-divisible but element-indivisible size still
-    # mispredicts toward the aligned cost — a bounded misprediction the
-    # EWMA refinement corrects from real retire times.
-    if mesh.aligned and L > 1 and nbytes % L == 0:
-        m = nbytes / L
-        return (over + _best_flat("reduce_scatter", intra, m, L)
-                + _best_flat("allreduce", inter, m, H)
-                + _best_flat("allgather", intra, m, L))
-    return (over + _best_flat("reduce", intra, nbytes, L)
-            + _best_flat("allreduce", inter, nbytes, H)
-            + _best_flat("bcast", intra, nbytes, L))
+    # divide by the fanout product (plan_phases falls back to the
+    # leader shape per level otherwise). The model only sees bytes;
+    # byte divisibility is the necessary-condition proxy (element
+    # divisibility implies it), so byte-indivisible sizes are priced at
+    # the leader cost they will actually pay. A byte-divisible but
+    # element-indivisible size still mispredicts toward the aligned
+    # cost — a bounded misprediction the EWMA refinement corrects from
+    # real retire times.
+    if (all(a for _f, _t, a in levels) and all(f > 1 for f in fans)
+            and nbytes % prod == 0):
+        cost = over
+        m = float(nbytes)
+        for f, tp, _a in levels:
+            m = m / f
+            cost += (_best_flat("reduce_scatter", tp, m, f)
+                     + _best_flat("allgather", tp, m, f))
+        return cost + _best_flat("allreduce", top, m, H)
+    cost = over
+    for f, tp, _a in levels:
+        cost += (_best_flat("reduce", tp, nbytes, f)
+                 + _best_flat("bcast", tp, nbytes, f))
+    return cost + _best_flat("allreduce", top, nbytes, H)
 
 
 def _allgather_hier(topo: Topology, w: int, nbytes: float) -> float:
-    """gather(inner->leader) -> allgather(leaders, host blocks) ->
-    bcast(inner, whole vector). ``nbytes`` is the per-rank chunk (the
-    chunked-op convention, module docstring)."""
+    """gather ascending (leader chunks grow by the fanout per level) ->
+    allgather(top tier, subtree blocks) -> bcast of the whole vector
+    descending. ``nbytes`` is the per-rank chunk (the chunked-op
+    convention, module docstring)."""
     mesh = _hier_mesh(topo, w)
     if mesh is None:
         return math.inf
-    intra, inter, L, H = _hier_tiers(mesh)
-    over = _HIER_PHASE_ALPHAS * intra.alpha_us
-    return (over + _best_flat("gather", intra, nbytes, L)
-            + _best_flat("allgather", inter, L * nbytes, H)
-            + _best_flat("bcast", intra, w * nbytes, L))
+    levels, (H, top) = _hier_ladder(mesh)
+    cost = levels[0][1].alpha_us * (
+        _HIER_PHASE_ALPHAS + 2.0 * (len(levels) - 1))
+    m = float(nbytes)
+    for f, tp, _a in levels:
+        cost += _best_flat("gather", tp, m, f)
+        m *= f
+    cost += _best_flat("allgather", top, m, H)
+    for f, tp, _a in levels:
+        cost += _best_flat("bcast", tp, w * float(nbytes), f)
+    return cost
 
 
 def _reduce_scatter_hier(topo: Topology, w: int, nbytes: float) -> float:
-    """reduce(inner->leader, whole vector) -> reduce_scatter(leaders,
-    host blocks) [uneven hosts: allreduce(leaders)] -> scatter(inner).
+    """reduce of the whole vector ascending -> reduce_scatter(top tier,
+    subtree blocks) [uneven nests: allreduce(top leaders)] -> scatter
+    descending (leader chunks shrink by the fanout per level).
     ``nbytes`` is the per-rank chunk."""
     mesh = _hier_mesh(topo, w)
     if mesh is None:
         return math.inf
-    intra, inter, L, H = _hier_tiers(mesh)
-    over = _HIER_PHASE_ALPHAS * intra.alpha_us
-    outer = (_best_flat("reduce_scatter", inter, L * nbytes, H)
-             if mesh.aligned
-             else _best_flat("allreduce", inter, w * nbytes, H))
-    return (over + _best_flat("reduce", intra, w * nbytes, L) + outer
-            + _best_flat("scatter", intra, nbytes, L))
+    levels, (H, top) = _hier_ladder(mesh)
+    cost = levels[0][1].alpha_us * (
+        _HIER_PHASE_ALPHAS + 2.0 * (len(levels) - 1))
+    total = w * float(nbytes)
+    for f, tp, _a in levels:
+        cost += _best_flat("reduce", tp, total, f)
+    if all(a for _f, _t, a in levels):
+        cost += _best_flat("reduce_scatter", top, total / H, H)
+    else:
+        cost += _best_flat("allreduce", top, total, H)
+    m = float(nbytes)
+    for f, tp, _a in levels:
+        cost += _best_flat("scatter", tp, m, f)
+        m *= f
+    return cost
 
 
 def _bcast_hier(topo: Topology, w: int, nbytes: float) -> float:
-    """bcast(root -> one representative per host over the slow tier) ->
-    bcast(inner): the payload crosses the slow tier H-1 times instead of
-    up to W-1."""
+    """bcast(root -> one representative per top-tier group over the
+    slowest tier) -> bcast descending the nest: the payload crosses
+    each boundary tier (groups - 1) times instead of up to W - 1."""
     mesh = _hier_mesh(topo, w)
     if mesh is None:
         return math.inf
-    intra, inter, L, H = _hier_tiers(mesh)
-    over = _HIER_PHASE_ALPHAS * intra.alpha_us
-    return (over + _best_flat("bcast", inter, nbytes, H)
-            + _best_flat("bcast", intra, nbytes, L))
+    levels, (H, top) = _hier_ladder(mesh)
+    cost = levels[0][1].alpha_us * (
+        _HIER_PHASE_ALPHAS + (len(levels) - 1))
+    cost += _best_flat("bcast", top, nbytes, H)
+    for f, tp, _a in levels:
+        cost += _best_flat("bcast", tp, nbytes, f)
+    return cost
 
 
 _MODELS = {
@@ -478,10 +541,11 @@ def predict_us(op: str, algorithm: CollectiveAlgorithm, topo: Topology,
 # bytes (beta scales UP by the ratio — the ACCL+ framing of compression
 # as bandwidth) and pays a gamma term: the quantize/dequantize passes
 # over the uncompressed payload at ``quant_gbps`` plus a fixed
-# ``quant_alpha_us``. On a two-tier mesh only the INTER tier's beta
-# scales — the per-phase "inter" mode is the only quantized hierarchical
-# variant (intra phases stay full precision by contract), so its model
-# prices exactly what the engine runs. The resulting crossover is the
+# ``quant_alpha_us``. On a mesh only the BOUNDARY tiers' betas scale
+# (the host boundary plus any coarser ``outer`` levels) — the per-tier
+# quantize predicate never compresses intra phases (full precision by
+# contract), so the model prices what the engine runs. The resulting
+# crossover is the
 # point: quantized wire wins exactly where wire bytes dominate, never
 # in the alpha-dominated small-call band (pinned by tests/test_quantize).
 
@@ -490,6 +554,21 @@ def wire_byte_ratio(u_bytes: int = 4, q_bytes: int = 1,
     """Uncompressed-to-quantized wire byte ratio including the per-block
     f32 scale overhead (~3.87x for f32 -> fp8 at block 128)."""
     return float(u_bytes) / (float(q_bytes) + 4.0 / float(block))
+
+
+def _scale_boundary_betas(topo: Topology, r: float) -> Topology:
+    """Every boundary tier's beta scaled by the wire ratio — the
+    ``inter_*`` host boundary plus any coarser ``outer`` TierSpec
+    levels (duck-typed; plain two-tier meshes have ``outer == ()``)."""
+    topo = dataclasses.replace(
+        topo, inter_beta_gbps=getattr(topo, "inter_beta_gbps", 0.1) * r)
+    outer = getattr(topo, "outer", ())
+    if outer:
+        topo = dataclasses.replace(
+            topo, outer=tuple(
+                dataclasses.replace(s, beta_gbps=s.beta_gbps * r)
+                for s in outer))
+    return topo
 
 
 def predict_quantized_us(op: str, algorithm: CollectiveAlgorithm,
@@ -504,10 +583,10 @@ def predict_quantized_us(op: str, algorithm: CollectiveAlgorithm,
         return 0.0
     groups = getattr(topo, "groups", None)
     if _A(algorithm) == _A.HIERARCHICAL and groups and len(groups) > 1:
-        # per-phase "inter" mode: only the slow tier's wire quantizes,
-        # and only the outer phase's payload pays the codec
-        topo_q = dataclasses.replace(
-            topo, inter_beta_gbps=getattr(topo, "inter_beta_gbps", 0.1) * r)
+        # per-tier quantized mode: only the boundary tiers' wires
+        # quantize (intra phases stay full precision by contract), and
+        # only the boundary phases' payload pays the codec
+        topo_q = _scale_boundary_betas(topo, r)
         L = max(len(g) for g in groups)
         outer_bytes = (float(nbytes) / L
                        if getattr(topo, "aligned", False) and L > 1
@@ -516,9 +595,7 @@ def predict_quantized_us(op: str, algorithm: CollectiveAlgorithm,
     else:
         topo_q = dataclasses.replace(topo, beta_gbps=topo.beta_gbps * r)
         if groups:
-            topo_q = dataclasses.replace(
-                topo_q,
-                inter_beta_gbps=getattr(topo, "inter_beta_gbps", 0.1) * r)
+            topo_q = _scale_boundary_betas(topo_q, r)
         gamma = 2.0 * float(nbytes) / (topo.quant_gbps * 1e3)
     return (predict_us(op, algorithm, topo_q, nbytes, world_size)
             + topo.quant_alpha_us + gamma)

@@ -50,6 +50,10 @@ _PLANCACHE_KEYS = ("plancache_ratio", "plancache_fresh_p50_us",
                    "plancache_shape")
 _HIER_KEYS = ("hier_ratio", "hier_flat_us", "hier_hier_us",
               "hier_throttled_frames")
+_HIER3_KEYS = ("hier3_ratio", "hier3_vs_2tier", "hier3_us",
+               "hier3_flat_us", "hier3_2tier_us",
+               "hier3_throttled_frames", "hier3_quant_max_err",
+               "hier3_reshard_peak_bytes", "hier3_reshard_bound_bytes")
 _CHAOS_KEYS = ("chaos_goodput_ratio", "chaos_clean_us", "chaos_lossy_us",
                "chaos_retransmits", "chaos_call_errors",
                "chaos_faults_applied", "chaos_injected")
@@ -90,6 +94,15 @@ def bench_emu_fallback(reason: str) -> dict:
         hier = hier_headline()
         for k in _HIER_KEYS:
             result[k] = hier[k]
+    if os.environ.get("ACCL_BENCH_MIN_HIER3_RATIO"):
+        # N-tier ladder (~5s): flat vs 3-tier vs forced-2-tier on a
+        # 3-tier beta gradient, plus the per-tier-quantized bound and
+        # the sampled 3-tier reshard memory bound — only when its gate
+        # is armed (make bench-emu), keep-ungated-runs-fast rule
+        from benchmarks.hierarchy import headline3 as hier3_headline
+        h3 = hier3_headline()
+        for k in _HIER3_KEYS:
+            result[k] = h3[k]
     if os.environ.get("ACCL_BENCH_MIN_FAIRNESS"):
         # multi-tenant saturation ladder (~1 min): only when its gate is
         # armed (make bench-emu), keeping ungated runs fast
@@ -660,6 +673,34 @@ def check_hier_ratio(result: dict) -> int:
     return 1
 
 
+def check_hier3_ratio(result: dict) -> int:
+    """Regression gate for the N-tier recursive lowering: with
+    $ACCL_BENCH_MIN_HIER3_RATIO set (make bench-emu sets 1.8), the
+    3-tier-vs-flat-ring 4 MiB allreduce ratio on the 3-tier beta
+    gradient must clear it AND the 3-tier program must beat the forced
+    two-tier lowering of the same call (the no-collapse floor: if the
+    recursion degenerated to the historical inner/outer split, the
+    second ratio drops to ~1.0). Correctness (oracle bit-identity, the
+    quantized bound, the reshard memory bound) hard-raises inside the
+    ladder itself."""
+    want = os.environ.get("ACCL_BENCH_MIN_HIER3_RATIO")
+    if not want or "hier3_ratio" not in result:
+        return 0
+    fails = 0
+    if result["hier3_ratio"] < float(want):
+        print(f"FAIL: 3-tier vs flat-ring gradient ratio "
+              f"{result['hier3_ratio']} < required {want}",
+              file=sys.stderr)
+        fails = 1
+    if result.get("hier3_vs_2tier", 0) <= 1.0:
+        print(f"FAIL: 3-tier program no faster than the forced "
+              f"two-tier lowering ({result.get('hier3_vs_2tier')}x) — "
+              f"the recursive descent is not paying for itself",
+              file=sys.stderr)
+        fails = 1
+    return fails
+
+
 def bench_combine(nbytes=1 << 28):
     """Fused 2-operand reduction throughput on one chip through the
     framework's OWN dataplane: ``ops/combine.combine_pallas``, the Pallas
@@ -875,6 +916,21 @@ def main():
                 for k in _HIER_KEYS:
                     result[k] = retry_h[k]
             result["hier_retry"] = result.get("hier_retry", 0) + 1
+        h3_want = os.environ.get("ACCL_BENCH_MIN_HIER3_RATIO")
+        for _ in range(_GATE_RETRIES):
+            # best-of-three for the N-tier gate too: only its ladder
+            # re-runs (a genuinely regressed recursion fails every
+            # attempt on either sub-gate)
+            if not (h3_want and
+                    (result.get("hier3_ratio", 0) < float(h3_want)
+                     or result.get("hier3_vs_2tier", 0) <= 1.0)):
+                break
+            from benchmarks.hierarchy import headline3 as hier3_headline
+            retry_h3 = hier3_headline()
+            if retry_h3["hier3_ratio"] > result.get("hier3_ratio", 0):
+                for k in _HIER3_KEYS:
+                    result[k] = retry_h3[k]
+            result["hier3_retry"] = result.get("hier3_retry", 0) + 1
         pc_want = os.environ.get("ACCL_BENCH_MIN_PLANCACHE_RATIO")
         for _ in range(_GATE_RETRIES):
             # retry policy for the plan-cache gate too: only its ladder
@@ -1080,6 +1136,7 @@ def main():
         sys.exit(check_stream_ratio(result) or check_rd_ratio(result)
                  or check_plancache_ratio(result)
                  or check_hier_ratio(result)
+                 or check_hier3_ratio(result)
                  or check_saturation(result)
                  or check_serving(result)
                  or check_chaos_goodput(result)

@@ -89,7 +89,8 @@ def main():
             ap.error("--tune measures every legal algorithm itself; "
                      "--algorithm/--wire-dtype/--config do not apply")
         from accl_tpu.tuner import cache as tcache
-        from .tune import format_rows, run_tune, write_rows
+        from .tune import (format_capacity, format_rows, run_capacity,
+                           run_tune, write_rows)
         cache_path = (args.tuning_cache or tcache.default_cache_path()
                       or os.path.join(args.out, "tuning.json"))
         out = run_tune(world=args.tune_world, sizes=sizes,
@@ -97,7 +98,14 @@ def main():
         rows_path = write_rows(out["rows"], args.out)
         print(format_rows(out["rows"]))
         print(out["tuner"].describe())
+        # capacity planning: predicted-vs-measured hierarchical
+        # crossover over the N-tier topology grid (tune.py)
+        cap = run_capacity(sizes=sizes)
+        cap_path = write_rows(cap["rows"] + cap["summary"], args.out,
+                              name="capacity.json")
+        print(format_capacity(cap))
         print(f"wrote {rows_path}")
+        print(f"wrote {cap_path}")
         print(f"wrote tuning table {out['cache_path']}")
         return
 

@@ -23,12 +23,13 @@ bench-emu`` gates on ``ACCL_BENCH_MIN_HIER_RATIO``).
 from __future__ import annotations
 
 import json
+import threading
 import time
 
 import numpy as np
 
 from accl_tpu.constants import CollectiveAlgorithm as A
-from accl_tpu.testing import emu_world, run_ranks
+from accl_tpu.testing import add_tenant, emu_world, run_ranks
 
 HOSTS = [0, 0, 1, 1]
 # slow-inter-tier profile: per-frame 200us + bytes at 0.02 GB/s on
@@ -108,8 +109,231 @@ def headline(nbytes: int = 4 << 20, iters: int = 5) -> dict:
     }
 
 
+# -- 3-tier ladder (N-tier nest vs flat vs forced two-tier) ----------------
+# 8 ranks, 4 chips of 2, 2 racks of 2 chips: a 3-tier beta GRADIENT
+# (in-package 4 GB/s >> cross-chip 0.2 >> cross-rack 0.02 — each
+# boundary an order of magnitude down, the production DCN shape). The
+# recursive ladder crosses the rack boundary with n/4 bytes where the
+# forced two-tier program drags n/2 through its mixed outer ring and
+# the flat ring drags full chunks over every boundary each step.
+CHIPS3 = [0, 0, 1, 1, 2, 2, 3, 3]
+RACKS3 = [0, 0, 0, 0, 1, 1, 1, 1]
+TIER1_ALPHA_US = 100.0
+TIER1_BETA_GBPS = 0.2
+TIER2_ALPHA_US = 300.0
+TIER2_BETA_GBPS = 0.02
+
+
+def headline3(nbytes: int = 4 << 20, iters: int = 5) -> dict:
+    """The N-tier acceptance ladder: flat FUSED_RING vs the 3-tier
+    recursive program vs a FORCED two-tier lowering (chips-only nest on
+    a second tenant sharing the same devices), interleaved call by call.
+    Full-precision legs are checked bit-identical to the serial oracle;
+    a per-tier-quantized leg (compress_phases="slow": both boundary
+    tiers ride fp8 block-scale wire, intra stays exact) must land
+    inside the typed requantization bound; a throttled 3-tier reshard
+    samples the pool mid-transfer and must hold the shard+chunk memory
+    bound."""
+    import ml_dtypes
+
+    world = len(CHIPS3)
+    count = nbytes // 4
+    chunk = count // world * 4
+    accls = emu_world(world, hosts=CHIPS3,
+                      inter_alpha_us=TIER1_ALPHA_US,
+                      inter_beta_gbps=TIER1_BETA_GBPS,
+                      outer_tiers=[(RACKS3, TIER2_ALPHA_US,
+                                    TIER2_BETA_GBPS)],
+                      nbufs=64, bufsize=max(64 << 10, chunk // 2),
+                      timeout=240.0)
+    for a in accls:
+        a.configure_hierarchy(CHIPS3, levels=[RACKS3])
+    # the forced-2-tier leg: a second tenant on the SAME devices (same
+    # wire profiles, same pools) whose hierarchy stops at the chip
+    # boundary — its outer exchange must drag n/2 bytes over the mixed
+    # chip/rack ring the 3-tier ladder descends past
+    tens = add_tenant(accls, "hier2", key=1, timeout=240.0)
+    for t in tens:
+        t.configure_hierarchy(CHIPS3)
+    f8 = np.dtype(ml_dtypes.float8_e4m3fn)
+    eps = 2.0 ** -3
+    rng = np.random.default_rng(7)
+    qins = [rng.integers(-8, 9, count).astype(np.float32)
+            for _ in range(world)]
+    q_exact = np.sum(qins, axis=0, dtype=np.float64).astype(np.float32)
+    q_bound = 2 * world * eps * np.maximum(
+        np.abs(np.stack(qins)).sum(axis=0), 1e-6)
+    try:
+        bufs = [(a.buffer(data=np.full(count, float(a.rank + 1),
+                                       np.float32)),
+                 a.buffer((count,), np.float32)) for a in accls]
+        tbufs = [(t.buffer(data=np.full(count,
+                                        float(t.comm.local_rank + 1),
+                                        np.float32)),
+                  t.buffer((count,), np.float32)) for t in tens]
+        qbufs = [(a.buffer(data=qins[a.rank].copy()),
+                  a.buffer((count,), np.float32)) for a in accls]
+        t_flat: list[float] = []
+        t_h3: list[float] = []
+        t_h2: list[float] = []
+
+        def leg(a, i):
+            r = a.rank
+            if i % 3 == 0:
+                src, dst = bufs[r]
+                a.allreduce(src, dst, count, algorithm=A.FUSED_RING)
+            elif i % 3 == 1:
+                src, dst = bufs[r]
+                a.allreduce(src, dst, count, algorithm=A.HIERARCHICAL)
+            else:
+                t = tens[r]
+                src, dst = tbufs[r]
+                t.allreduce(src, dst, count, algorithm=A.HIERARCHICAL)
+
+        def body(a):
+            for i in range(3):       # warm all three paths
+                leg(a, i)
+            for i in range(iters * 3):
+                t0 = time.perf_counter()
+                leg(a, i)
+                if a.rank == 0:
+                    [t_flat, t_h3, t_h2][i % 3].append(
+                        time.perf_counter() - t0)
+            # per-tier quantized leg: slow boundary tiers fp8
+            # block-scaled, intra full precision
+            qsrc, qdst = qbufs[a.rank]
+            a.allreduce(qsrc, qdst, count, algorithm=A.HIERARCHICAL,
+                        compress_dtype=f8, block_scale=32,
+                        compress_phases="slow")
+
+        run_ranks(accls, body, timeout=900.0)
+        # full-precision legs: bit-identical to the serial oracle
+        # (integer-valued f32 sums are order-independent)
+        expect = world * (world + 1) / 2
+        for (_, dst), (_, tdst) in zip(bufs, tbufs):
+            for leg_name, d in (("3-tier", dst), ("2-tier", tdst)):
+                if not np.array_equal(d.data,
+                                      np.full(count, expect,
+                                              np.float32)):
+                    raise AssertionError(
+                        f"{leg_name} hierarchical allreduce diverged "
+                        f"from the serial oracle: {d.data[:4]} != "
+                        f"{expect}")
+        q_err = max(float(np.abs(qdst.data - q_exact).max())
+                    for _, qdst in qbufs)
+        if not all(np.all(np.abs(qdst.data - q_exact) <= q_bound)
+                   for _, qdst in qbufs):
+            raise AssertionError(
+                f"per-tier quantized ladder left the typed "
+                f"requantization bound (max err {q_err})")
+        throttled = accls[0].device.ctx.fabric.stats["throttled"]
+        if not throttled:
+            raise AssertionError(
+                "tier profiles never fired — the 3-tier ladder "
+                "measured nothing hierarchical routing could improve")
+        flat = float(np.median(t_flat))
+        h3 = float(np.median(t_h3))
+        h2 = float(np.median(t_h2))
+    finally:
+        for x in accls + tens:
+            x.deinit()
+    peak, bound = _reshard3_memory_bound()
+    return {
+        "metric": f"emu_hier3_vs_flat_allreduce_{nbytes >> 20}MiB_"
+                  f"{world}rank_4chip_2rack",
+        "value": round(flat / h3, 3),
+        "unit": "x",
+        "hier3_ratio": round(flat / h3, 3),
+        "hier3_vs_2tier": round(h2 / h3, 3),
+        "hier3_us": round(h3 * 1e6, 1),
+        "hier3_flat_us": round(flat * 1e6, 1),
+        "hier3_2tier_us": round(h2 * 1e6, 1),
+        "hier3_throttled_frames": throttled,
+        "hier3_quant_max_err": round(q_err, 4),
+        "hier3_reshard_peak_bytes": peak,
+        "hier3_reshard_bound_bytes": bound,
+        "nbytes": nbytes,
+        "world": world,
+        "tier2_beta_gbps": TIER2_BETA_GBPS,
+        "tier": "emu",
+    }
+
+
+def _reshard3_memory_bound(n: int = 1 << 17,
+                           bufsize: int = 16 << 10) -> tuple[int, int]:
+    """Throttled 3-tier reshard with the pool sampled mid-transfer:
+    returns (observed peak bytes, shard+chunk bound). Raises if the
+    bound is breached or the sampler starved — a gather-shaped
+    implementation (materialize the global vector, reslice) would blow
+    the bound by W x."""
+    from accl_tpu.hier import ShardSpec, plan_redistribute
+
+    world = len(CHIPS3)
+    accls = emu_world(world, hosts=CHIPS3, inter_alpha_us=3000.0,
+                      inter_beta_gbps=0.05,
+                      outer_tiers=[(RACKS3, 5000.0, 0.02)],
+                      nbufs=32, bufsize=bufsize, timeout=120.0)
+    src = ShardSpec.block(ShardSpec.balanced(n, world - 2).counts
+                          + (0, 0))
+    dst = ShardSpec.balanced(n, world)
+    # largest single transfer any rank's plan moves (the "chunk"):
+    # p2p step counts or, when the planner lowers the dense exchange
+    # onto one alltoallv, its per-peer count vectors
+    def plan_chunk(plan):
+        vals = [s.count for s in plan.steps if s.kind != "copy"]
+        vals += list(plan.send_counts) + list(plan.recv_counts)
+        vals.append(plan.coll_count)
+        return max(vals)
+
+    chunk_bytes = max(plan_chunk(plan_redistribute(src, dst, me))
+                      for me in range(world)) * 4
+    bound = chunk_bytes + 2 * bufsize
+    stop = threading.Event()
+    peak = {"bytes": 0, "samples": 0}
+
+    def sampler():
+        while not stop.is_set():
+            occ = max(a.device.pool.occupancy() for a in accls)
+            peak["bytes"] = max(peak["bytes"], occ * bufsize)
+            peak["samples"] += 1
+            time.sleep(0.002)
+
+    th = threading.Thread(target=sampler, daemon=True)
+    th.start()
+
+    def body(a):
+        sb = a.buffer((n,), np.float32)
+        sb.data[:src.counts[a.rank]] = float(a.rank + 1)
+        db = a.buffer((n,), np.float32)
+        a.redistribute(sb, src, db, dst)
+        return db.data[:dst.counts[a.rank]].copy()
+
+    try:
+        res = run_ranks(accls, body, timeout=300.0)
+        stop.set()
+        th.join(2.0)
+        hwm = max(a.device.pool.hwm for a in accls) * bufsize
+    finally:
+        stop.set()
+        for a in accls:
+            a.deinit()
+    if peak["samples"] <= 10:
+        raise AssertionError("reshard sampler starved — nothing held "
+                             "the memory bound mid-transfer")
+    if hwm > bound or peak["bytes"] > bound:
+        raise AssertionError(
+            f"3-tier reshard blew the shard+chunk bound: hwm {hwm} B, "
+            f"sampled peak {peak['bytes']} B, bound {bound} B")
+    for r in range(world):
+        if res[r].shape[0] != dst.counts[r] or not np.all(
+                res[r][:dst.counts[r]] > 0):
+            raise AssertionError("3-tier reshard landed wrong data")
+    return peak["bytes"], bound
+
+
 def main():
     print(json.dumps(headline()), flush=True)
+    print(json.dumps(headline3()), flush=True)
 
 
 if __name__ == "__main__":

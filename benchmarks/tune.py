@@ -181,6 +181,163 @@ def run_tune(world: int = 4, sizes=None, ops=None, reps: int = 3,
     return {"tuner": tuner, "rows": rows, "cache_path": path}
 
 
+# -- capacity planning: predicted-vs-measured hierarchical crossover -------
+# Grid of N-tier topologies (fan-out x per-tier beta) priced purely by
+# the cost ladder, plus a couple of emulator-hostable shapes measured
+# for real — the artifact (capacity.json) is the table an operator
+# reads to answer "at which message size does the hierarchical program
+# start paying on MY tier gradient, and does the model's crossover
+# match the wire?".
+CAPACITY_SIZES = [1 << 12, 1 << 16, 1 << 20, 4 << 20]
+# emulator-hostable shapes (W <= 8 on the 2-core CI host); the
+# predicted-only grid below extends the same shapes to betas/fan-outs
+# the emulator cannot time in CI budget
+_CAP_2TIER = dict(name="2tier-4h", hosts=[0, 0, 1, 1],
+                  inter=(200.0, 0.02), outer=[])
+_CAP_3TIER = dict(name="3tier-4c2r", hosts=[0, 0, 1, 1, 2, 2, 3, 3],
+                  inter=(100.0, 0.2),
+                  outer=[([0, 0, 0, 0, 1, 1, 1, 1], 300.0, 0.02)])
+
+
+def _capacity_mesh(cfg):
+    from accl_tpu.hier import MeshTopology
+    tiers = [(cfg["hosts"],) + cfg["inter"]] + list(cfg["outer"])
+    return MeshTopology.from_nest(tiers, alpha_us=20.0, beta_gbps=4.0)
+
+
+def _predict_row(cfg, mesh, nbytes):
+    from accl_tpu.tuner.cost import rank_algorithms
+    W = mesh.mesh_world
+    ranked = rank_algorithms("allreduce", mesh, nbytes, W)
+    costs = dict(ranked)
+    hier = costs.get(CollectiveAlgorithm.HIERARCHICAL, float("inf"))
+    flat = min(c for a, c in ranked
+               if a != CollectiveAlgorithm.HIERARCHICAL)
+    return {
+        "config": cfg["name"], "world": W, "tiers": mesh.n_tiers,
+        "betas_gbps": [mesh.tier_beta_gbps(lv)
+                       for lv in range(mesh.n_tiers)],
+        "nbytes": nbytes,
+        "predicted_winner": ranked[0][0].name,
+        "predicted_hier_us": (None if not np.isfinite(hier)
+                              else round(hier, 1)),
+        "predicted_flat_us": round(flat, 1),
+        "measured_winner": None, "measured_hier_us": None,
+        "measured_flat_us": None,
+    }
+
+
+def run_capacity(sizes=None, reps: int = 2,
+                 nbufs: int = 64, bufsize: int = 512 << 10) -> dict:
+    """The capacity-planning sweep: price the full N-tier ladder over a
+    topology grid, measure the emulator-hostable shapes, and report the
+    predicted and measured flat->hierarchical crossover per config."""
+    sizes = [int(s) for s in (sizes or CAPACITY_SIZES)]
+    rows = []
+    # predicted-only grid: sweep the boundary betas and fan-outs around
+    # the measured shapes (an operator's what-if table)
+    grid = [_CAP_2TIER, _CAP_3TIER]
+    for b1 in (0.05, 0.5):
+        grid.append(dict(name=f"2tier-4h-b{b1}", hosts=[0, 0, 1, 1],
+                         inter=(200.0, b1), outer=[]))
+    for b2 in (0.002, 0.1):
+        grid.append(dict(
+            name=f"3tier-4c2r-b{b2}",
+            hosts=[0, 0, 1, 1, 2, 2, 3, 3], inter=(100.0, 0.2),
+            outer=[([0, 0, 0, 0, 1, 1, 1, 1], 300.0, b2)]))
+    # a wider fan-out the CI emulator cannot host: 16 ranks, 3 tiers
+    grid.append(dict(
+        name="3tier-8c2r-w16",
+        hosts=[r // 2 for r in range(16)], inter=(100.0, 0.2),
+        outer=[([r // 8 for r in range(16)], 300.0, 0.02)]))
+    for cfg in grid:
+        mesh = _capacity_mesh(cfg)
+        for nbytes in sizes:
+            rows.append(_predict_row(cfg, mesh, nbytes))
+    # measured legs on the hostable shapes: flat ring vs the
+    # hierarchical program, same interleaved-median discipline as
+    # benchmarks/hierarchy.py
+    for cfg in (_CAP_2TIER, _CAP_3TIER):
+        hosts = cfg["hosts"]
+        W = len(hosts)
+        a1, b1 = cfg["inter"]
+        accls = emu_world(W, hosts=hosts, inter_alpha_us=a1,
+                          inter_beta_gbps=b1,
+                          outer_tiers=[tuple(o) for o in cfg["outer"]]
+                          or None,
+                          nbufs=nbufs, bufsize=bufsize, timeout=240.0)
+        levels = [o[0] for o in cfg["outer"]]
+        for a in accls:
+            a.configure_hierarchy(hosts, levels=levels)
+        try:
+            for nbytes in sizes:
+                count = max(1, nbytes // _ELEM)
+                meas = {}
+                for alg in (CollectiveAlgorithm.FUSED_RING,
+                            CollectiveAlgorithm.HIERARCHICAL):
+                    per_rank = run_ranks(
+                        accls, _rank_body("allreduce", count, W, alg,
+                                          reps), timeout=600.0)
+                    durs = [max(ts[i] for ts in per_rank)
+                            for i in range(reps)]
+                    meas[alg] = min(durs)
+                flat_s = meas[CollectiveAlgorithm.FUSED_RING]
+                hier_s = meas[CollectiveAlgorithm.HIERARCHICAL]
+                row = next(r for r in rows
+                           if r["config"] == cfg["name"]
+                           and r["nbytes"] == nbytes)
+                row["measured_winner"] = (
+                    "HIERARCHICAL" if hier_s < flat_s else "FUSED_RING")
+                row["measured_hier_us"] = round(hier_s * 1e6, 1)
+                row["measured_flat_us"] = round(flat_s * 1e6, 1)
+        finally:
+            for a in accls:
+                a.deinit()
+    # per-config crossover summary: the smallest size where the
+    # hierarchical program wins, predicted and (where timed) measured
+    summary = []
+    for cfg in grid:
+        name = cfg["name"]
+        mine = [r for r in rows if r["config"] == name]
+        pred = next((r["nbytes"] for r in mine
+                     if r["predicted_winner"] == "HIERARCHICAL"), None)
+        msrd = next((r["nbytes"] for r in mine
+                     if r["measured_winner"] == "HIERARCHICAL"), None)
+        timed = any(r["measured_winner"] for r in mine)
+        summary.append({
+            "config": name, "world": mine[0]["world"],
+            "tiers": mine[0]["tiers"],
+            "betas_gbps": mine[0]["betas_gbps"],
+            "predicted_crossover_nbytes": pred,
+            "measured_crossover_nbytes": msrd if timed else None,
+            "timed": timed,
+            "agree": (pred == msrd) if timed else None,
+        })
+    return {"rows": rows, "summary": summary}
+
+
+def format_capacity(cap: dict) -> str:
+    lines = ["{:<16} {:>2} {:>5} {:>10} {:>13} {:>13} {:>9}".format(
+        "config", "W", "tiers", "nbytes", "predicted", "measured",
+        "hier_us")]
+    for r in cap["rows"]:
+        us = ("" if r["measured_hier_us"] is None
+              else f"{r['measured_hier_us']:.0f}")
+        lines.append(
+            "{:<16} {:>2} {:>5} {:>10} {:>13} {:>13} {:>9}".format(
+                r["config"], r["world"], r["tiers"], r["nbytes"],
+                r["predicted_winner"], r["measured_winner"] or "-", us))
+    lines.append("crossover (first hierarchical win, bytes):")
+    for s in cap["summary"]:
+        lines.append(
+            f"  {s['config']:<16} predicted="
+            f"{s['predicted_crossover_nbytes']} "
+            f"measured={s['measured_crossover_nbytes']}"
+            + ("" if not s["timed"]
+               else f" agree={s['agree']}"))
+    return "\n".join(lines)
+
+
 def write_rows(rows: list[dict], out_dir: str,
                name: str = "tune.json") -> str:
     os.makedirs(out_dir, exist_ok=True)

@@ -332,6 +332,49 @@ def hier_quant_cell(kind: str, seed: int) -> tuple[bool, int, str]:
     return ok, sum(plan.applied.values()), status
 
 
+def hier3_cell(kind: str, seed: int) -> tuple[bool, int, str]:
+    """3-tier hierarchical allreduce with faults CONFINED to the
+    slowest tier: one rule per cross-rack directed pair, so only the
+    top-tier exchange of the recursive ladder ever sees a fault while
+    the chip/host phases run clean. Engagement proofs: drops must move
+    the retransmission counters, corruption must move
+    integrity_failed_total — a cell recovering without the reliability
+    tier demonstrably firing on the slow links gates nothing."""
+    chips = [0, 0, 1, 1, 2, 2, 3, 3]
+    racks = [0, 0, 0, 0, 1, 1, 1, 1]
+    rack0 = [r for r in range(8) if racks[r] == 0]
+    rack1 = [r for r in range(8) if racks[r] == 1]
+    rules = []
+    for s in rack0:
+        for d in rack1:
+            rules.append(FaultRule(kind=kind, src=s, dst=d,
+                                   every=3, offset=1))
+            rules.append(FaultRule(kind=kind, src=d, dst=s,
+                                   every=3, offset=1))
+    plan = FaultPlan(rules, seed=seed)
+    accls = emu_world(8, timeout=30.0, nbufs=32, hosts=chips,
+                      outer_tiers=[(racks, 10.0, 1.0)])
+    for a in accls:
+        a.configure_hierarchy(chips, levels=[racks])
+    fabric = accls[0].device.ctx.fabric
+    integ0, retx0 = _integrity_total(), _retx_total()
+    fabric.inject_fault(plan)
+    try:
+        res = _schedule(accls, A.HIERARCHICAL, COUNT, iters=2)
+        ok = all((r[0] == res[0][0]).all() for r in res)
+        status = "ok" if ok else "DIVERGED"
+        if kind == "corrupt_payload" and ok \
+                and _integrity_total() <= integ0:
+            ok, status = False, "NO-INTEGRITY-DROPS"
+        if kind == "drop" and ok and _retx_total() <= retx0:
+            ok, status = False, "NO-RETRANSMITS"
+    finally:
+        fabric.clear_fault()
+        for a in accls:
+            a.deinit()
+    return ok, sum(plan.applied.values()), status
+
+
 def shm_cell(kind: str, seed: int, oracle) -> tuple[bool, int, str]:
     """One fault kind through a 3-rank shared-memory daemon world
     (emulator/shm.py ShmFabric): the seeded plan rides every daemon's
@@ -740,6 +783,19 @@ def sweep(seed: int, hier: bool = True) -> int:
                 failures += 1
             rows.append((4, "hier", hkind, status,
                          sum(plan.applied.values()),
+                         round((time.perf_counter() - t0) * 1e3)))
+        # N-tier: the same contract on a 3-tier nest, faults confined
+        # to the slowest (cross-rack) links
+        for hkind in ("drop", "corrupt_payload"):
+            t0 = time.perf_counter()
+            try:
+                ok, applied, status = hier3_cell(hkind, seed)
+            except Exception as exc:  # noqa: BLE001 — report cell
+                ok, applied = False, 0
+                status = f"FAILED ({type(exc).__name__})"
+            if not ok:
+                failures += 1
+            rows.append((8, "hier3", hkind, status, applied,
                          round((time.perf_counter() - t0) * 1e3)))
     # elastic-world cells: kill -> shrink -> reshard -> train -> grow ->
     # reshard under each fault kind (+ the transient-partition flap)

@@ -382,14 +382,20 @@ def _phase_addrs(spec, bases, ebytes):
 
 def check_hier_programs() -> list[str]:
     """Check 5 (hierarchical half): expand every phase of every rank of
-    the two-tier corpus and replay it through the lane/hazard
-    checkers. Phases are separate waitfor-chained CALLS, so each phase
-    replays as its own program (the driver serializes them)."""
+    the N-tier corpus (two-tier splits, 3-/4-tier nests, uneven
+    groups) and replay it through the lane/hazard checkers. Phases are
+    separate waitfor-chained CALLS, so each phase replays as its own
+    program (the driver serializes them). The "tiered" comp mode
+    mirrors the per-tier quantize predicate: boundary phases
+    (phase_tier_level >= 1) ride the block-scaled wire while intra
+    phases replay uncompressed — both wires of one plan through
+    _bs_fusion_ok."""
     import numpy as np
 
     from accl_tpu.arith import ArithConfig
     from accl_tpu.constants import CCLOp, Compression, ReduceFunc, TAG_ANY
-    from accl_tpu.hier import groups_from_hosts, plan_phases
+    from accl_tpu.hier import groups_from_hosts, phase_tier_level, \
+        plan_phases
     from accl_tpu.moveengine import MoveContext, expand_call
 
     import ml_dtypes
@@ -402,39 +408,78 @@ def check_hier_programs() -> list[str]:
     E = cfg.uncompressed_elem_bytes
     # role base table: disjoint regions except where the real engine
     # genuinely aliases (phases offset into "res" — the leaders' block
-    # exchange reads/writes the SAME buffer, replayed as such)
+    # exchange reads/writes the SAME buffer, replayed as such). Deeper
+    # nest levels suffix their scratch roles (s1_1, sn_2, ...); those
+    # get fresh disjoint regions on first sight.
     bases = {"op0": 0x100000, "res": 0x200000, "s1": 0x300000,
              "s2": 0x340000, "sn": 0x380000, "sn2": 0x3C0000,
              "sb": 0x400000, "relay": 0x440000}
+
+    def base_of(role):
+        if role not in bases:
+            bases[role] = 0x500000 + len(bases) * 0x40000
+        return bases[role]
+
     scen = {"reduce_scatter": CCLOp.reduce_scatter,
             "allreduce": CCLOp.allreduce, "allgather": CCLOp.allgather,
             "gather": CCLOp.gather, "reduce": CCLOp.reduce,
             "scatter": CCLOp.scatter, "bcast": CCLOp.bcast,
             "send": CCLOp.send, "recv": CCLOp.recv}
-    groupings = ([0, 0, 1, 1], [0, 0, 0, 1, 1, 1], [0, 0, 0, 0, 1, 1],
-                 [0, 0, 1, 1, 1, 2, 2, 2], [0, 0, 0, 0, 1, 1, 1, 1])
-    for hosts in groupings:
+    # (hosts, coarser levels): two-tier splits plus 3-/4-tier nests
+    # (aligned + uneven at both W=8 and W=12, and a depth-3 W=16)
+    groupings = (
+        ([0, 0, 1, 1], ()),
+        ([0, 0, 0, 1, 1, 1], ()),
+        ([0, 0, 0, 0, 1, 1], ()),
+        ([0, 0, 1, 1, 1, 2, 2, 2], ()),
+        ([0, 0, 0, 0, 1, 1, 1, 1], ()),
+        ([0, 0, 1, 1, 2, 2, 3, 3],
+         ([0, 0, 0, 0, 1, 1, 1, 1],)),                     # 3-tier aligned
+        ([0, 0, 0, 1, 1, 2, 2, 2],
+         ([0, 0, 0, 0, 0, 1, 1, 1],)),                     # 3-tier uneven
+        ([0, 0, 0, 1, 1, 1, 2, 2, 2, 3, 3, 3],
+         ([0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 1],)),         # 3-tier W=12
+        ([0, 0, 1, 1, 2, 2, 3, 3, 4, 4, 5, 5, 6, 6, 7, 7],
+         ([0, 0, 0, 0, 1, 1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 3],
+          [0, 0, 0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 1, 1, 1])),  # 4-tier
+    )
+    BS = Compression.ETH_COMPRESSED | Compression.BLOCK_SCALED
+    for hosts, levels in groupings:
         groups = groups_from_hosts(hosts)
+        nest = tuple(groups_from_hosts(lv) for lv in levels)
+        full_nest = (groups,) + nest
         W = len(hosts)
         for op in ("allreduce", "allgather", "reduce_scatter", "bcast"):
-            # 24 divides every corpus group size (2, 3, 4): the aligned
-            # planner modes are exercised alongside the leader modes
+            # 24 divides every corpus fanout product (2, 3, 4, 6, 8):
+            # the aligned planner modes are exercised alongside the
+            # leader modes
             count = 24 if op in ("allreduce", "bcast") else 6
-            for comp, ccfg in (
-                    (Compression.NONE, cfg),
-                    (Compression.ETH_COMPRESSED, cfg),
-                    (Compression.ETH_COMPRESSED
-                     | Compression.BLOCK_SCALED, cfg_bs)):
+            for mode in ("none", "eth", "bs", "tiered"):
                 for seg in (16, 1 << 20):
                     for me in range(W):
                         plan = plan_phases(op, groups, me, count,
                                            root=1 if op == "bcast"
-                                           else 0)
+                                           else 0, nest=nest)
                         for pi, ph in enumerate(plan.phases):
+                            if mode == "none":
+                                comp, ccfg = Compression.NONE, cfg
+                            elif mode == "eth":
+                                comp, ccfg = \
+                                    Compression.ETH_COMPRESSED, cfg
+                            elif mode == "bs":
+                                comp, ccfg = BS, cfg_bs
+                            elif phase_tier_level(ph.members,
+                                                  full_nest) >= 1:
+                                comp, ccfg = BS, cfg_bs
+                            else:
+                                comp, ccfg = Compression.NONE, cfg
                             ctx = MoveContext(
                                 world_size=len(ph.members),
                                 local_rank=ph.members.index(me),
                                 arithcfg=ccfg, max_segment_size=seg)
+                            for spec in (ph.src, ph.dst):
+                                if spec is not None:
+                                    base_of(spec[0])
                             a0 = (_phase_addrs(ph.src, bases, E)
                                   or bases["relay"])
                             a2 = (_phase_addrs(ph.dst, bases, E)
@@ -446,9 +491,10 @@ def check_hier_programs() -> list[str]:
                                 addr_0=a0, addr_1=0, addr_2=a2,
                                 compression=comp)
                             where = (f"hier/{op}[{plan.mode}] "
-                                     f"hosts={hosts} me={me} "
+                                     f"hosts={hosts} tiers="
+                                     f"{2 + len(nest)} me={me} "
                                      f"phase{pi}={ph.label} seg={seg} "
-                                     f"comp={int(comp)}")
+                                     f"comp={mode}")
                             errors += _lane_edges_ok(where, moves)
                             errors += _hazards_ok(where, moves, ccfg)
                             errors += _bs_fusion_ok(where, moves)

@@ -16,18 +16,32 @@ import numpy as np
 import pytest
 
 from accl_tpu.constants import CollectiveAlgorithm as A
-from accl_tpu.hier import (Hierarchy, MeshTopology, groups_from_hosts,
-                           plan_phases)
+from accl_tpu.hier import (Hierarchy, MeshTopology, TierSpec,
+                           groups_from_hosts, phase_tier_level,
+                           plan_phases, validate_nest)
 from accl_tpu.testing import emu_world, run_ranks
 from accl_tpu.tuner import Tuner
-from accl_tpu.tuner.cost import Topology, rank_algorithms, predict_us
+from accl_tpu.tuner.cost import (Topology, predict_quantized_us,
+                                 predict_us, rank_algorithms)
 
 TWO_TIER = dict(alpha_us=20.0, beta_gbps=4.0, inter_alpha_us=200.0,
                 inter_beta_gbps=0.2)
 
+# a 3-tier beta gradient: fast chips, slower hosts, slowest racks
+CHIPS8 = [0, 0, 1, 1, 2, 2, 3, 3]
+RACKS8 = [0, 0, 0, 0, 1, 1, 1, 1]
+CHIPS12 = [0, 0, 0, 1, 1, 1, 2, 2, 2, 3, 3, 3]
+RACKS12 = [0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 1]
+
 
 def _mesh(hosts, **kw):
     return MeshTopology.from_hosts(hosts, **{**TWO_TIER, **kw})
+
+
+def _mesh3(chips=CHIPS8, racks=RACKS8):
+    return MeshTopology.from_nest(
+        [(chips, 100.0, 0.2), (racks, 300.0, 0.02)],
+        alpha_us=20.0, beta_gbps=4.0)
 
 
 # ---------------------------------------------------------------------------
@@ -512,3 +526,261 @@ def test_link_profile_env(monkeypatch):
     monkeypatch.setenv("ACCL_TPU_LINK_PROFILE", "garbage")
     with pytest.raises(ValueError, match="malformed"):
         LocalFabric(2)
+
+# ---------------------------------------------------------------------------
+# N-tier nests: topology, cost ladder, recursive planner, end-to-end
+# differential vs the serial oracle
+# ---------------------------------------------------------------------------
+
+def test_validate_nest_rejects_bad_chains():
+    g8 = groups_from_hosts(CHIPS8)
+    with pytest.raises(ValueError, match="different world"):
+        validate_nest((g8, groups_from_hosts([0, 0, 0, 1, 1, 1])))
+    with pytest.raises(ValueError, match="not coarser"):
+        validate_nest((g8, g8))
+    with pytest.raises(ValueError, match="splits inner group"):
+        validate_nest((g8, groups_from_hosts([0, 0, 0, 1, 1, 1, 1, 1])))
+    # MeshTopology construction enforces the same contract
+    with pytest.raises(ValueError, match="splits inner group"):
+        MeshTopology.from_nest(
+            [(CHIPS8, 100.0, 0.2), ([0, 0, 0, 1, 1, 1, 1, 1], 300.0, 0.02)])
+
+
+def test_from_nest_structure():
+    m = _mesh3()
+    assert m.n_tiers == 3 and m.aligned and m.n_hosts == 4
+    assert m.alpha_us == 20.0 and m.beta_gbps == 4.0
+    assert m.inter_alpha_us == 100.0 and m.inter_beta_gbps == 0.2
+    assert len(m.outer) == 1 and isinstance(m.outer[0], TierSpec)
+    assert m.nest() == (groups_from_hosts(CHIPS8),
+                        groups_from_hosts(RACKS8))
+    assert m.hosts_levels() == [CHIPS8, RACKS8]
+    assert [m.tier_beta_gbps(lv) for lv in range(3)] == [4.0, 0.2, 0.02]
+    t2 = m.tier_topology(2)
+    assert t2.alpha_us == 300.0 and t2.beta_gbps == 0.02
+    assert t2.tier.endswith("/tier2") and t2.world_size == 2
+    assert m.tier_topology(0).tier.endswith("/intra")
+    assert m.tier_topology(1).tier.endswith("/inter")
+    with pytest.raises(ValueError, match="at least one boundary"):
+        MeshTopology.from_nest([])
+    # one boundary tier == the historical two-tier mesh, field for field
+    a = MeshTopology.from_nest([([0, 0, 1, 1], 200.0, 0.2)],
+                               alpha_us=20.0, beta_gbps=4.0,
+                               tier="two-tier")
+    assert a == _mesh([0, 0, 1, 1])
+
+
+def test_three_tier_flat_equivalent():
+    m = _mesh3()
+    eff = m.flat_equivalent()
+    # an 8-hop ring crosses 4 intra, 2 host-boundary, 2 rack-boundary
+    # links: alpha mixes linearly by hop share, beta harmonically
+    assert eff.alpha_us == pytest.approx(
+        (4 * 20.0 + 2 * 100.0 + 2 * 300.0) / 8)
+    assert 1.0 / eff.beta_gbps == pytest.approx(
+        (4 / 4.0 + 2 / 0.2 + 2 / 0.02) / 8)
+    assert 0.02 < eff.beta_gbps < 4.0 and 20.0 < eff.alpha_us < 300.0
+
+
+def test_phase_tier_level_counts_spanned_boundaries():
+    nest = (groups_from_hosts(CHIPS8), groups_from_hosts(RACKS8))
+    assert phase_tier_level((0, 1), nest) == 0      # inside one chip pair
+    assert phase_tier_level((0, 2), nest) == 1      # crosses chips only
+    assert phase_tier_level((0, 4), nest) == 2      # crosses the rack too
+    assert phase_tier_level((0, 2, 4, 6), nest) == 2
+
+
+def test_plan_three_tier_aligned_allreduce():
+    nest = (groups_from_hosts(RACKS8),)
+    g = groups_from_hosts(CHIPS8)
+    plan = plan_phases("allreduce", g, me=0, count=24, nest=nest)
+    assert plan.mode == "aligned"
+    assert plan.scratch == {"s1": 12, "s2": 12, "s1_1": 6, "s2_1": 6}
+    assert [(p.scenario, p.members, p.count, p.label)
+            for p in plan.phases] == [
+        ("reduce_scatter", (0, 1), 12, "inner-rs"),
+        ("reduce_scatter", (0, 2), 6, "l1-rs"),
+        ("allreduce", (0, 4), 6, "outer-ar"),
+        ("allgather", (0, 2), 6, "l1-ag"),
+        ("allgather", (0, 1), 12, "inner-ag"),
+    ]
+    # the descent reads the user src and the ascent writes the user dst
+    assert plan.phases[0].src == ("op0", 0, 0)
+    assert plan.phases[-1].dst == ("res", 0, 0)
+    # rank 1 rides its own index-aligned ladder communicators
+    p1 = plan_phases("allreduce", g, me=1, count=24, nest=nest).phases
+    assert [p.members for p in p1] == [
+        (0, 1), (1, 3), (1, 5), (1, 3), (0, 1)]
+
+
+def test_plan_three_tier_allgather_and_uneven_fallback():
+    nest = (groups_from_hosts(RACKS8),)
+    g = groups_from_hosts(CHIPS8)
+    ag = plan_phases("allgather", g, me=0, count=3, nest=nest)
+    assert [(p.scenario, p.members, p.label) for p in ag.phases] == [
+        ("gather", (0, 1), "inner-gather"),
+        ("gather", (0, 2), "l1-gather"),
+        ("allgather", (0, 4), "leader-ag"),
+        ("bcast", (0, 2), "l1-bcast"),
+        ("bcast", (0, 1), "inner-bcast"),
+    ]
+    # uneven groups at the bottom push every level to the leader shape
+    gu = groups_from_hosts([0, 0, 0, 1, 1, 2, 2, 2])
+    nestu = (groups_from_hosts([0, 0, 0, 0, 0, 1, 1, 1]),)
+    ar = plan_phases("allreduce", gu, me=0, count=24, nest=nestu)
+    assert ar.mode == "leader"
+    assert [(p.scenario, p.members, p.label) for p in ar.phases] == [
+        ("reduce", (0, 1, 2), "inner-reduce"),
+        ("reduce", (0, 3), "l1-reduce"),
+        ("allreduce", (0, 5), "leader-ar"),
+        ("bcast", (0, 3), "l1-bcast"),
+        ("bcast", (0, 1, 2), "inner-bcast"),
+    ]
+
+
+def test_cost_three_tier_gradient():
+    """On a 3-tier beta gradient the recursive ladder beats every flat
+    algorithm for a large allreduce, the per-tier quantized variant
+    beats the full-precision ladder, and the degenerate cases hold."""
+    m = _mesh3()
+    nbytes = 4 << 20
+    ranked = rank_algorithms("allreduce", m, nbytes, 8)
+    assert ranked[0][0] == A.HIERARCHICAL
+    costs = dict(ranked)
+    flat_best = min(c for alg, c in ranked if alg != A.HIERARCHICAL)
+    assert costs[A.HIERARCHICAL] < flat_best
+    assert Tuner(topology=m).select("allreduce", 8, nbytes) == \
+        A.HIERARCHICAL
+    q = predict_quantized_us("allreduce", A.HIERARCHICAL, m, nbytes, 8)
+    assert q < costs[A.HIERARCHICAL]
+    # every hierarchical-capable op prices finite on the 3-tier mesh
+    for op in ("bcast", "allgather", "reduce_scatter"):
+        assert np.isfinite(
+            predict_us(op, A.HIERARCHICAL, m, 1 << 20, 8))
+    # one-tier worlds price the ladder out entirely
+    assert predict_us("allreduce", A.HIERARCHICAL,
+                      MeshTopology.from_hosts([0] * 8),
+                      nbytes, 8) == float("inf")
+    # a single-boundary nest prices EXACTLY like the two-tier model
+    m2a = _mesh([0, 0, 1, 1])
+    m2b = MeshTopology.from_nest([([0, 0, 1, 1], 200.0, 0.2)],
+                                 alpha_us=20.0, beta_gbps=4.0,
+                                 tier="two-tier")
+    for op in ("allreduce", "bcast", "allgather", "reduce_scatter"):
+        for nb in (1 << 12, 1 << 20, 4 << 20):
+            assert predict_us(op, A.HIERARCHICAL, m2a, nb, 4) == \
+                predict_us(op, A.HIERARCHICAL, m2b, nb, 4)
+
+
+def test_compress_predicate_forms():
+    """The per-tier quantize predicate resolves every documented form
+    against the mesh's tier betas (threshold forms never touch the
+    intra tier)."""
+    class _Comm:
+        size = 8
+        local_rank = 0
+
+    class _Tuner:
+        topology = _mesh3()
+
+    class _Accl:
+        comm = _Comm()
+        tuner = _Tuner()
+
+    h = Hierarchy(_Accl(), CHIPS8, levels=[RACKS8])
+    assert [h._compress_predicate(None)(lv) for lv in range(3)] == \
+        [True, True, True]
+    assert [h._compress_predicate("all")(lv) for lv in range(3)] == \
+        [True, True, True]
+    assert [h._compress_predicate("inter")(lv) for lv in range(3)] == \
+        [False, True, True]
+    # both boundary betas (0.2, 0.02) sit under SLOW_TIER_BETA_GBPS
+    assert [h._compress_predicate("slow")(lv) for lv in range(3)] == \
+        [False, True, True]
+    # a numeric threshold: only the rack tier is slower than 0.1 GB/s
+    assert [h._compress_predicate(0.1)(lv) for lv in range(3)] == \
+        [False, False, True]
+    seen = []
+    fn = h._compress_predicate(
+        lambda lvl, beta: seen.append((lvl, beta)) or lvl == 2)
+    assert [fn(lv) for lv in range(3)] == [False, False, True]
+    assert seen == [(0, 4.0), (1, 0.2), (2, 0.02)]
+    with pytest.raises(ValueError, match="compress_phases"):
+        h._compress_predicate("sometimes")
+
+
+@pytest.mark.parametrize("chips,racks,n,c", [
+    (CHIPS8, RACKS8, 64, 8),
+    (CHIPS12, RACKS12, 72, 6),
+], ids=["W8-3tier", "W12-3tier"])
+def test_three_tier_collectives_match_oracle(chips, racks, n, c):
+    """3-tier differential: every op on a chips<racks nest is exactly
+    the serial oracle's answer on every rank (integer-valued float32
+    data makes the sums order-independent)."""
+    W = len(chips)
+    accls = emu_world(W, hosts=chips, nbufs=32,
+                      outer_tiers=[(racks, 10.0, 1.0)])
+    for a in accls:
+        a.configure_hierarchy(chips, levels=[racks])
+
+    def body(a):
+        out = {}
+        src = a.buffer(data=np.arange(n, dtype=np.float32) + a.rank)
+        dst = a.buffer((n,), np.float32)
+        a.allreduce(src, dst, n, algorithm="HIERARCHICAL")
+        out["allreduce"] = dst.data.copy()
+        b = a.buffer(data=(np.arange(n, dtype=np.float32) * 3
+                           if a.rank == 2 else np.zeros(n, np.float32)))
+        a.bcast(b, n, root=2, algorithm="HIERARCHICAL")
+        out["bcast"] = b.data.copy()
+        s = a.buffer(data=np.full(c, float(a.rank + 1), np.float32))
+        d = a.buffer((W * c,), np.float32)
+        a.allgather(s, d, c, algorithm="HIERARCHICAL")
+        out["allgather"] = d.data.copy()
+        s2 = a.buffer(data=np.arange(W * c, dtype=np.float32) + a.rank)
+        d2 = a.buffer((c,), np.float32)
+        a.reduce_scatter(s2, d2, c, algorithm="HIERARCHICAL")
+        out["reduce_scatter"] = d2.data.copy()
+        return out
+
+    try:
+        outs = run_ranks(accls, body, timeout=180.0)
+    finally:
+        for a in accls:
+            a.deinit()
+    base = np.arange(n, dtype=np.float32)
+    exp_ar = sum(base + r for r in range(W))
+    exp_ag = np.concatenate(
+        [np.full(c, float(r + 1), np.float32) for r in range(W)])
+    full = np.arange(W * c, dtype=np.float32)
+    exp_rs = sum(full + r for r in range(W))
+    for r, o in enumerate(outs):
+        assert np.array_equal(o["allreduce"], exp_ar)
+        assert np.array_equal(o["bcast"], base * 3)
+        assert np.array_equal(o["allgather"], exp_ag)
+        assert np.array_equal(o["reduce_scatter"], exp_rs[r*c:(r+1)*c])
+
+
+def test_three_tier_autoprobe_and_preflight_tier_names():
+    """A device advertising an N-tier mesh autoconfigures the full nest
+    through the tuner topology, and the rx-pool preflight names each
+    offending boundary tier."""
+    accls = emu_world(8, hosts=CHIPS8, nbufs=4, bufsize=4096,
+                      outer_tiers=[(RACKS8, 10.0, 1.0)])
+    try:
+        topo = accls[0].device.topology()
+        assert isinstance(topo, MeshTopology) and topo.n_tiers == 3
+        assert topo.tier == "emu-n-tier"
+        for a in accls:
+            a.configure_hierarchy(CHIPS8, levels=[RACKS8])
+        assert accls[0]._hier.nest == topo.nest()
+        # 4 MiB against a 16 KiB pool: both boundary tiers breach the
+        # 2-chunk rule, and each warning names its tier
+        warns = accls[0].preflight(count=1 << 20, dtype=np.float32)
+        assert len(warns) == 2
+        assert "tier inter (4 hosts)" in warns[0]
+        assert "tier inter2 (2 groups)" in warns[1]
+        assert accls[0].preflight(count=64, dtype=np.float32) == []
+    finally:
+        for a in accls:
+            a.deinit()

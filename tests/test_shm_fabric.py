@@ -544,6 +544,85 @@ def test_late_probe_cooldown_while_peer_stays_dead():
             daemon.shutdown()
 
 
+def test_two_process_ping_idle_latency():
+    """Cross-process doorbell regression pin: two REAL processes share
+    a ring (no in-process Condition to wake the receiver — the rx idle
+    wait IS the latency bound). With the exponential backoff a busy
+    channel's wait resets to 1 ms on every frame, so back-to-back pings
+    round-trip in a few ms; the old fixed 20 ms cadence put the RTT
+    median at ~20-40 ms. Pinned with wide margin for loaded CI hosts."""
+    import json
+    import subprocess
+    import sys
+
+    base = free_port_base()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    child_src = f"""
+import sys, time
+sys.path.insert(0, {repo!r})
+from accl_tpu.emulator.fabric import Envelope
+from accl_tpu.emulator.shm import ShmFabric
+
+base = {base}
+fab = None
+def echo(env, payload):
+    fab.send(Envelope(src=1, dst=0, tag=env.tag, seqn=env.seqn,
+                      nbytes=env.nbytes, wire_dtype="uint8", comm_id=7),
+             bytes(payload))
+fab = ShmFabric(1, base + 1, echo, retx_window=0)
+fab.learn_peers([(0, "127.0.0.1", base - 2),
+                 (1, "127.0.0.1", base + 1 - 2)], 2)
+fab.set_link(0, "shm")
+print("ready", flush=True)
+sys.stdin.readline()   # parent closes stdin to tear us down
+fab.close()
+"""
+    from accl_tpu.emulator.fabric import Envelope
+    from accl_tpu.emulator.shm import ShmFabric
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child_src], stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    fab = None
+    try:
+        assert proc.stdout.readline().strip() == b"ready"
+        pong = threading.Event()
+
+        def on_pong(env, payload):
+            pong.set()
+
+        fab = ShmFabric(0, base, on_pong, retx_window=0)
+        fab.learn_peers([(0, "127.0.0.1", base - 2),
+                         (1, "127.0.0.1", base + 1 - 2)], 2)
+        assert fab.set_link(1, "shm")
+
+        def ping(seqn, timeout=10.0):
+            pong.clear()
+            t0 = time.perf_counter()
+            fab.send(Envelope(src=0, dst=1, tag=0, seqn=seqn, nbytes=8,
+                              wire_dtype="uint8", comm_id=7), b"x" * 8)
+            assert pong.wait(timeout), f"ping {seqn} lost"
+            return time.perf_counter() - t0
+
+        ping(0)                      # warmup: lazy channel attach
+        rtts = sorted(ping(1 + i) for i in range(30))
+        median = rtts[len(rtts) // 2]
+        # busy-channel pin: each leg's idle wait reset to 1 ms by the
+        # previous frame -> RTT well under the old 20 ms poll cadence
+        assert median < 0.015, f"busy ping RTT median {median * 1e3:.1f} ms"
+        # idle decay still bounds a cold wakeup by the 20 ms cap
+        time.sleep(0.3)              # let both rx loops back off fully
+        cold = ping(99)
+        assert cold < 0.2, f"cold ping RTT {cold * 1e3:.1f} ms"
+        assert fab.stats["delivered"] >= 32
+    finally:
+        if proc.stdin:
+            proc.stdin.close()
+        proc.wait(timeout=10)
+        if fab is not None:
+            fab.close()
+
+
 def test_world_teardown_unlinks_all_segments():
     accls = sim_world(3, stack="shm")
     try:
