@@ -732,6 +732,13 @@ class ACCL:
                         tenant=self.tenant or f"comm-{comm_id}"), n)
 
     def deinit(self):
+        # withdraw THIS driver's windows only — on a shared device
+        # (multi-tenant) other tenants' registrations must survive
+        for wid in list(self._windows):
+            try:
+                self.deregister_window(wid)
+            except Exception:
+                pass
         self.device.deinit()
 
     # -- buffers -----------------------------------------------------------
@@ -1426,7 +1433,8 @@ class ACCL:
 
     def put(self, srcbuf: ACCLBuffer, count: int, dst: int, window: int,
             offset: int = 0, *, comm: Communicator | None = None,
-            compress_dtype=None, run_async: bool = False,
+            compress_dtype=None, notify: int | None = None,
+            run_async: bool = False,
             waitfor: Sequence[CallHandle] = (),
             retries: int | None = None,
             retry_policy: "RetryPolicy | None" = None) -> CallHandle:
@@ -1440,15 +1448,37 @@ class ACCL:
         latency-critical collectives depend on. ``compress_dtype``
         narrows the wire dtype (decompress-on-landing). Completion (the
         data IS in the window) surfaces on the returned handle; chain
-        behind compute with ``waitfor=``/``run_async=True``."""
+        behind compute with ``waitfor=``/``run_async=True``.
+
+        ``notify=token`` (u64) makes the TARGET enqueue one completion
+        record on its local notify queue when the put lands (or a typed
+        error record when it fails there); the target discovers it with
+        :meth:`poll_notifications` — one local dequeue, no collective.
+        """
         comm = comm or self.comm
         desc = self._prepare(CCLOp.put, count=count, comm=comm,
                              root_src_dst=dst, tag=int(window), op0=srcbuf,
                              compress_dtype=compress_dtype)
         desc.addr_1 = int(offset)  # byte offset INTO the window (no
         # operand buffer rides addr_1 on one-sided calls)
+        if notify is not None:
+            # no result buffer rides addr_2 on a put, so it carries the
+            # notify token to the device tier (0 = no notification)
+            desc.addr_2 = int(notify) & 0xFFFFFFFFFFFFFFFF
         return self._call(desc, run_async, waitfor, False,
                           retries, retry_policy)
+
+    def poll_notifications(self, window: int | None = None,
+                           max_records: int = 64):
+        """Drain this rank's put-with-notify completion queue: up to
+        ``max_records`` :class:`~accl_tpu.rma.NotifyRecord` for
+        ``window`` (all windows when None). Purely local — a direct
+        device dequeue, not a descriptor call, so it issues NO
+        collective and adds no ``accl_calls_total`` rows; a serving loop
+        can poll it per decode step at zero wire cost."""
+        from .rma.notify import ANY_WINDOW
+        wid = ANY_WINDOW if window is None else int(window)
+        return self.device.poll_notifications(wid, int(max_records))
 
     def get(self, dstbuf: ACCLBuffer, count: int, src: int, window: int,
             offset: int = 0, *, comm: Communicator | None = None,

@@ -231,6 +231,13 @@ class Device(abc.ABC):
     def deregister_window(self, wid: int):
         """Remove a window registration (no-op when absent)."""
 
+    def poll_notifications(self, window: int, max_records: int = 64):
+        """Drain put-with-notify completion records for ``window``
+        (``rma.notify.ANY_WINDOW`` = all). Must be purely local — no
+        wire traffic, no collective. Backends without an RMA engine
+        simply have nothing pending."""
+        return []
+
     # -- elastic membership (ACCL.grow_communicator) -----------------------
     def join_handshake(self, comm: Communicator, timeout: float) -> int:
         """Bootstrap handshake of a grown communicator: block until every

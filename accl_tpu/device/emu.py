@@ -294,14 +294,15 @@ class EmuDevice(Device):
         # engine. Late-bound getters because soft reset swaps the pool
         # object and config calls change segment size / timeout.
         from ..rma import RmaEngine, WindowRegistry
-        self.windows = WindowRegistry()
+        self.windows = WindowRegistry(owner=f"emu rank {rank}")
         self.rma = RmaEngine(
             rank, self.mem, self.windows, ctx.fabric.send,
             pool_fn=lambda: self.pool, comm_of=self.comms.get,
             tenant_of=self.tenant_of_comm,
             timeout_fn=lambda: self.timeout,
             seg_fn=lambda: self.max_segment_size, tier="emu",
-            csum_fn=lambda: ctx.fabric.csum)
+            csum_fn=lambda: ctx.fabric.csum,
+            tuner_fn=lambda: getattr(self, "tuner", None))
         # membership state (armed via ctx.start_heartbeats): peers are
         # tracked once heard from; a dead peer fail-fasts calls on every
         # comm containing it until shrink_communicator rebuilds
@@ -713,6 +714,11 @@ class EmuDevice(Device):
     def deregister_window(self, wid: int):
         self.windows.deregister(wid)
 
+    def poll_notifications(self, window: int, max_records: int = 64):
+        """Drain put-with-notify completions — a rank-local dequeue off
+        the engine's queue; issues nothing on the wire."""
+        return self.rma.notify.poll(window, max_records)
+
     def _rma_call(self, desc: CallDescriptor,
                   waitfor: Sequence[CallHandle]) -> CallHandle:
         """Launch a put/get: completion is driven by the RMA engine's
@@ -742,16 +748,20 @@ class EmuDevice(Device):
                 local = desc.addr_0
                 local_c = bool(desc.compression
                                & Compression.OP0_COMPRESSED)
+                # addr_2 is free on a put (no result buffer) and carries
+                # the notify token; 0 means "no notification requested"
+                notify = desc.addr_2 or None
             else:
                 local = desc.addr_2
                 local_c = bool(desc.compression
                                & Compression.RES_COMPRESSED)
+                notify = None
             self.rma.start(
                 desc.scenario, comm, desc.root_src_dst, desc.tag,
                 desc.addr_1, desc.count, desc.arithcfg,
                 bool(desc.compression & _ETH_C), local, handle,
                 tenant=self.tenant_of_comm(desc.comm_id),
-                local_compressed=local_c)
+                local_compressed=local_c, notify=notify)
 
         waitfor = tuple(waitfor)
         if not waitfor:
@@ -871,6 +881,7 @@ class EmuDevice(Device):
         if self.service is not None:
             self.service.close()
         self.rma.close()
+        self.windows.close()
         self.executor.close()
         self.ctx.note_device_deinit()
 

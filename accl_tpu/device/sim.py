@@ -293,6 +293,21 @@ class SimDevice(Device):
         self._check(bytes([P.MSG_REG_WINDOW])
                     + struct.pack("<IQQ", wid, 0, 0))
 
+    def poll_notifications(self, window: int, max_records: int = 64):
+        """Drain put-with-notify completions from the daemon
+        (MSG_RMA_NOTIFY): one cmd-port round trip to THIS rank's daemon,
+        nothing on the data fabric. Native daemons without the notify
+        lane answer INVALID_CALL — surfaced typed, never spun on."""
+        from ..rma.notify import NotifyRecord
+        reply = self._request(P.pack_notify_poll(window, max_records))
+        if reply[0] == P.MSG_STATUS:
+            err = struct.unpack("<I", reply[1:5])[0]
+            from ..constants import ACCLError
+            raise ACCLError(err, "notify poll")
+        assert reply[0] == P.MSG_DATA, reply[0]
+        return [NotifyRecord(*rec)
+                for rec in P.unpack_notify_records(reply[1:])]
+
     def get_info(self) -> dict:
         """Daemon geometry + runtime-config state — the readable effect of
         ACCL_CONFIG calls (extended MSG_GET_INFO reply; older daemons
@@ -335,7 +350,11 @@ class SimDevice(Device):
     # -- calls -------------------------------------------------------------
     @staticmethod
     def _result_addr(desc: CallDescriptor) -> int:
-        """The address a completed call wrote (bcast lands in-place)."""
+        """The address a completed call wrote (bcast lands in-place). A
+        put writes nothing locally — and its addr_2 carries the notify
+        token, which must never be resolved as a result address."""
+        if desc.scenario == CCLOp.put:
+            return 0
         return desc.addr_2 or (
             desc.addr_0 if desc.scenario == CCLOp.bcast else 0)
 

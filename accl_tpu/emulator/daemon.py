@@ -902,7 +902,7 @@ class RankDaemon:
         from ..call import CallHandle as _CallHandle
         from ..rma import RmaEngine, WindowRegistry
         self._CallHandle = _CallHandle
-        self.windows = WindowRegistry()
+        self.windows = WindowRegistry(owner=f"daemon rank {rank}")
         self.rma = RmaEngine(
             rank, self.mem, self.windows,
             lambda env, p: self.eth.send(env, p),
@@ -1480,16 +1480,20 @@ class RankDaemon:
                 if scenario == CCLOp.put:
                     local = c["addr0"]
                     local_c = bool(comp & Compression.OP0_COMPRESSED)
+                    # addr2 is free on a put (no result buffer) and
+                    # carries the notify token; 0 = none requested
+                    notify = c["addr2"] or None
                 else:
                     local = c["addr2"]
                     local_c = bool(comp & Compression.RES_COMPRESSED)
+                    notify = None
                 self.rma.start(
                     scenario, comm, c["root"], c["tag"], c["addr1"],
                     c["count"], cfg,
                     bool(comp & Compression.ETH_COMPRESSED),
                     local, handle,
                     tenant=self.comm_tenants.get(c["comm_id"], ""),
-                    local_compressed=local_c)
+                    local_compressed=local_c, notify=notify)
                 try:
                     handle.wait(self.timeout)
                     return 0
@@ -1860,6 +1864,13 @@ class RankDaemon:
             except (KeyError, ValueError):
                 return P.status_reply(int(ErrorCode.RMA_WINDOW_ERROR))
             return P.status_reply(0)
+        if kind == P.MSG_RMA_NOTIFY:
+            # drain put-with-notify completions: rank-local dequeue off
+            # the engine's queue — the daemon-tier leg of the driver's
+            # poll_notifications (no wire traffic, no collective)
+            wid, mx = struct.unpack("<2I", body[1:9])
+            recs = self.rma.notify.poll(wid, mx)
+            return bytes([P.MSG_DATA]) + P.pack_notify_records(recs)
         if kind == P.MSG_JOIN:
             comm_id, sig, budget = P.unpack_join(body[1:])
             # short per-poll budget (MSG_STREAM_POP discipline): a long
@@ -2026,6 +2037,7 @@ class RankDaemon:
         self._stop.set()
         self._server.close()
         self.rma.close()
+        self.windows.close()
         self.eth.close()
         self.executor.close()
 

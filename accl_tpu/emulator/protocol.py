@@ -63,6 +63,14 @@ MSG_JOIN = 18         # comm_id u32 + membership-signature u32 + budget
 #                       signature mismatch. The native daemon predates
 #                       this message and replies INVALID_CALL — grown
 #                       communicators are a python-daemon/emu feature.
+MSG_RMA_NOTIFY = 19   # window u32 (0xFFFFFFFF = any) + max u32 ->
+#                       MSG_DATA: drain up to max completion records from
+#                       the rank's put-with-notify queue (pack_notify /
+#                       unpack_notify). One LOCAL dequeue — never a
+#                       collective, never a per-buffer scan; the daemon
+#                       twin of the emu tier's direct queue poll. A
+#                       daemon predating this message replies
+#                       INVALID_CALL typed (the MSG_JOIN convention).
 # replies
 # shared daemon resource bounds (hostile-descriptor protection; both
 # daemons and the robustness suite reference these — keep in sync with
@@ -219,10 +227,13 @@ def unpack_ack(payload: bytes) -> tuple[int, tuple]:
     return cum, sel
 
 # -- one-sided RMA control frames (ride strm=RMA_STRM) ----------------------
-# kind u8, udtype u8, cdtype u8, flags u8 (bit0 = eth-compressed wire),
-# xfer u32, window u32, nsegs u32, err u32, offset u64, count u64,
-# then kind-specific trailing u32s (RMA_NACK: the missing segment
-# indices) or raw payload bytes (RMA_EAGER: the eager put's data).
+# kind u8, udtype u8, cdtype u8, flags u8 (bit0 = eth-compressed wire,
+# bit1 = a notify token u64 follows the fixed header), xfer u32,
+# window u32, nsegs u32, err u32, offset u64, count u64, then the
+# OPTIONAL notify token (flag-gated — a decoder that doesn't know the
+# flag never sees it set, the trailing-record convention), then
+# kind-specific trailing u32s (RMA_NACK: the missing segment indices)
+# or raw payload bytes (RMA_EAGER: the eager put's data).
 # The transfer id also rides the envelope tag; comm_id the envelope.
 RMA_RTS = 1     # put rendezvous request  -> CTS (or FIN(err))
 RMA_CTS = 2     # clear to send: target allocated receive state
@@ -236,15 +247,27 @@ RMA_EAGER = 7   # small put: control header + payload in ONE frame;
 
 _RMA_CTL_FMT = "<4B4I2Q"
 _RMA_CTL_SIZE = struct.calcsize(_RMA_CTL_FMT)
+_RMA_FLAG_ETH_C = 1
+_RMA_FLAG_NOTIFY = 2
 
 
 def pack_rma_ctl(kind: int, xfer: int, *, window: int = 0, offset: int = 0,
                  count: int = 0, udtype: int = 0, cdtype: int = 0,
                  eth_compressed: bool = False, nsegs: int = 0,
-                 err: int = 0, extra=(), payload: bytes = b"") -> bytes:
+                 err: int = 0, notify: int | None = None, extra=(),
+                 payload: bytes = b"") -> bytes:
+    """``notify`` (put-with-notify, accl_tpu/rma/notify.py): a request
+    token the target enqueues on its per-window completion queue when
+    the transfer lands (or fails typed). Rides RTS/EAGER only — DONE
+    retries don't need it; the target keeps it with its receive state."""
+    flags = _RMA_FLAG_ETH_C if eth_compressed else 0
+    if notify is not None:
+        flags |= _RMA_FLAG_NOTIFY
     body = struct.pack(_RMA_CTL_FMT, kind, udtype, cdtype,
-                       1 if eth_compressed else 0, xfer, window, nsegs,
+                       flags, xfer, window, nsegs,
                        err & 0xFFFFFFFF, offset, count)
+    if notify is not None:
+        body += struct.pack("<Q", notify & 0xFFFFFFFFFFFFFFFF)
     if extra:
         body += struct.pack(f"<{len(extra)}I", *extra)
     if payload:
@@ -254,19 +277,65 @@ def pack_rma_ctl(kind: int, xfer: int, *, window: int = 0, offset: int = 0,
 
 def unpack_rma_ctl(body) -> tuple[dict, memoryview]:
     """Returns (fields, trailing bytes). The trailing view is the NACK's
-    packed missing-segment list or the EAGER frame's raw payload."""
+    packed missing-segment list or the EAGER frame's raw payload (the
+    flag-gated notify token, when present, is consumed into fields)."""
     view = memoryview(body)
     (kind, udtype, cdtype, flags, xfer, window, nsegs, err, offset,
      count) = struct.unpack(_RMA_CTL_FMT, view[:_RMA_CTL_SIZE])
+    off = _RMA_CTL_SIZE
+    notify = None
+    if flags & _RMA_FLAG_NOTIFY:
+        (notify,) = struct.unpack("<Q", view[off:off + 8])
+        off += 8
     return dict(kind=kind, udtype=udtype, cdtype=cdtype,
-                eth_compressed=bool(flags & 1), xfer=xfer, window=window,
-                nsegs=nsegs, err=err, offset=offset,
-                count=count), view[_RMA_CTL_SIZE:]
+                eth_compressed=bool(flags & _RMA_FLAG_ETH_C), xfer=xfer,
+                window=window, nsegs=nsegs, err=err, offset=offset,
+                count=count, notify=notify), view[off:]
 
 
 def unpack_rma_nack(trailing) -> tuple:
     n = len(trailing) // 4
     return struct.unpack(f"<{n}I", trailing[:4 * n])
+
+
+# -- put-with-notify completion records (MSG_RMA_NOTIFY reply body) ---------
+# n u32, then per record: token u64, window u32, src u32, err u32,
+# offset u64, nbytes u64 — the fields a serving poll loop needs to mark
+# "this request's KV arrived" (or fail it typed) without touching the
+# payload. Records are tuples in this order; the dataclass twin lives in
+# accl_tpu/rma/notify.py.
+_NOTIFY_REC_FMT = "<Q3I2Q"
+_NOTIFY_REC_SIZE = struct.calcsize(_NOTIFY_REC_FMT)
+NOTIFY_ANY_WINDOW = 0xFFFFFFFF
+
+
+def pack_notify_poll(window: int, max_records: int) -> bytes:
+    return bytes([MSG_RMA_NOTIFY]) + struct.pack(
+        "<2I", window & 0xFFFFFFFF, max_records)
+
+
+def pack_notify_records(records) -> bytes:
+    out = [struct.pack("<I", len(records))]
+    for r in records:
+        out.append(struct.pack(_NOTIFY_REC_FMT, r.token & (2**64 - 1),
+                               r.window, r.src, r.err & 0xFFFFFFFF,
+                               r.offset, r.nbytes))
+    return b"".join(out)
+
+
+def unpack_notify_records(body) -> list[tuple]:
+    """Returns (token, window, src, err, offset, nbytes) tuples."""
+    view = memoryview(body)
+    (n,) = struct.unpack("<I", view[:4])
+    off = 4
+    if off + n * _NOTIFY_REC_SIZE > len(view):
+        raise ValueError("truncated notify-record reply")
+    out = []
+    for _ in range(n):
+        out.append(struct.unpack(_NOTIFY_REC_FMT,
+                                 view[off:off + _NOTIFY_REC_SIZE]))
+        off += _NOTIFY_REC_SIZE
+    return out
 
 
 DTYPE_CODES = {

@@ -115,15 +115,26 @@ def _owner_pieces(src: ShardSpec, j: int, g0: int, cnt: int):
                 yield (r, lo, hi - lo, lo - off)
             off += c
         return
-    # cyclic: walk chunk-aligned subpieces
-    ch, W = src.chunk, src.world
+    if src.kind not in ("cyclic", "block_cyclic"):
+        # an unhandled kind must fail loud: the arithmetic below would
+        # silently misattribute ownership (data corruption, not a crash)
+        raise ValueError(f"unknown shard kind {src.kind!r}")
+    # (block-)cyclic: walk chunk-aligned subpieces. Chunk k's owner is
+    # k % W (cyclic) or order[k % len(order)] (block_cyclic's deal
+    # sequence — possibly a strict subset of the world); the local
+    # offset is whole preceding owned chunks either way, since only the
+    # LAST global chunk can be partial and every chunk before k is
+    # therefore full.
+    ch = src.chunk
+    order = src.order if src.kind == "block_cyclic" else None
+    period = len(order) if order is not None else src.world
     g = g0
     end = g0 + cnt
     while g < end:
         k = g // ch                       # global chunk index
         take = min(end, (k + 1) * ch) - g
-        owner = k % W
-        src_loc = (k // W) * ch + (g - k * ch)
+        owner = order[k % period] if order is not None else k % period
+        src_loc = (k // period) * ch + (g - k * ch)
         yield (owner, g, take, src_loc)
         g += take
 

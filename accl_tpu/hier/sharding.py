@@ -9,6 +9,13 @@ asks for is some combination of
   zero on non-participating ranks);
 * **cyclic** — equal chunks dealt round-robin (rank r holds chunks
   r, r+W, ...), the block-cyclic family's degenerate case;
+* **block_cyclic** — chunks dealt round-robin over a rank PERMUTATION
+  (``order``), dropping cyclic's divisibility constraints: the chunk
+  count need not divide evenly over ranks and the last chunk may be
+  partial, so per-rank element counts are UNEVEN. The serving layer's
+  KV-block layout: blocks deal across decode ranks in placement-
+  preference order, and an elastic grow/shrink reshards block->
+  block_cyclic without padding;
 * **replicated** — every participating rank holds the full vector.
 
 A spec is hashable and pure-geometry: :meth:`intervals` maps a rank to
@@ -28,11 +35,13 @@ __all__ = ["ShardSpec"]
 class ShardSpec:
     """Layout of an ``n``-element vector over ``world`` comm ranks."""
 
-    kind: str                       # "block" | "cyclic" | "replicated"
+    kind: str        # "block" | "cyclic" | "block_cyclic" | "replicated"
     world: int
     n: int
     counts: tuple[int, ...] = ()    # block: per-rank elements (sum == n)
-    chunk: int = 0                  # cyclic: elements per dealt chunk
+    chunk: int = 0                  # (block_)cyclic: elements per chunk
+    order: tuple[int, ...] = ()     # block_cyclic: deal permutation —
+    # chunk k lands on rank order[k % world]
 
     # -- constructors -------------------------------------------------------
     @classmethod
@@ -85,6 +94,34 @@ class ShardSpec:
         return cls(kind="cyclic", world=world, n=n, chunk=chunk)
 
     @classmethod
+    def block_cyclic(cls, n: int, world: int, chunk: int,
+                     order=None) -> "ShardSpec":
+        """Round-robin deal of ``chunk``-element pieces over a rank
+        SEQUENCE: chunk k (global elements ``[k*chunk, (k+1)*chunk)``)
+        lands on rank ``order[k % len(order)]``. Unlike :meth:`cyclic`
+        there are NO divisibility constraints — the last chunk may be
+        partial and ranks early in ``order`` may own one chunk more
+        than ranks late in it (uneven per-rank counts). ``order`` may
+        also be a strict SUBSET of the world (distinct ranks; the rest
+        own nothing) — how an elastic reshard expresses the old pool's
+        layout inside the grown communicator. ``order=None`` deals over
+        every rank in index order (cyclic's placement with cyclic's
+        constraints dropped)."""
+        if chunk <= 0:
+            raise ValueError(f"chunk must be positive, got {chunk}")
+        if n < 0 or world <= 0:
+            raise ValueError(f"bad geometry: n={n}, world={world}")
+        order = (tuple(range(world)) if order is None
+                 else tuple(int(r) for r in order))
+        if not order or len(set(order)) != len(order) \
+                or any(r < 0 or r >= world for r in order):
+            raise ValueError(
+                f"order {order} must be distinct ranks within "
+                f"world {world}")
+        return cls(kind="block_cyclic", world=world, n=n, chunk=chunk,
+                   order=order)
+
+    @classmethod
     def replicated(cls, n: int, world: int) -> "ShardSpec":
         return cls(kind="replicated", world=world, n=n)
 
@@ -95,6 +132,8 @@ class ShardSpec:
             return self.counts[rank]
         if self.kind == "cyclic":
             return self.n // self.world
+        if self.kind == "block_cyclic":
+            return sum(c for _, c, _ in self.intervals(rank))
         return self.n
 
     def intervals(self, rank: int) -> list[tuple[int, int, int]]:
@@ -107,6 +146,22 @@ class ShardSpec:
             off = sum(self.counts[:rank])
             c = self.counts[rank]
             return [(off, c, 0)] if c else []
+        if self.kind == "block_cyclic":
+            # chunk k -> rank order[k % len(order)]; only the LAST
+            # global chunk can be partial, so local offsets are whole
+            # chunks. Ranks outside the deal sequence own nothing.
+            if rank not in self.order:
+                return []
+            pos = self.order.index(rank)
+            period = len(self.order)
+            out = []
+            loc = 0
+            for g in range(pos * self.chunk, self.n,
+                           period * self.chunk):
+                c = min(self.chunk, self.n - g)
+                out.append((g, c, loc))
+                loc += c
+            return out
         out = []
         loc = 0
         for g in range(rank * self.chunk, self.n,
@@ -125,4 +180,7 @@ class ShardSpec:
             return f"block{list(self.counts)}"
         if self.kind == "cyclic":
             return f"cyclic(n={self.n}, chunk={self.chunk}, W={self.world})"
+        if self.kind == "block_cyclic":
+            return (f"block_cyclic(n={self.n}, chunk={self.chunk}, "
+                    f"W={self.world}, order={list(self.order)})")
         return f"replicated(n={self.n}, W={self.world})"

@@ -12,6 +12,7 @@ docs/ARCHITECTURE.md "One-sided operations".
 """
 
 from .engine import RmaEngine
+from .notify import ANY_WINDOW, NotifyQueue, NotifyRecord
 from .plan import (EAGER, RENDEZVOUS, TransferPlan, eager_max_from_env,
                    plan_transfer, segment_bounds)
 from .window import Window, WindowRegistry
@@ -19,5 +20,5 @@ from .window import Window, WindowRegistry
 __all__ = [
     "RmaEngine", "Window", "WindowRegistry", "TransferPlan",
     "plan_transfer", "segment_bounds", "eager_max_from_env",
-    "EAGER", "RENDEZVOUS",
+    "EAGER", "RENDEZVOUS", "NotifyQueue", "NotifyRecord", "ANY_WINDOW",
 ]

@@ -38,6 +38,7 @@ unchanged.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -45,13 +46,15 @@ import time
 import numpy as np
 
 from ..call import CallHandle
-from ..constants import (ACCLError, CCLOp, DEFAULT_RMA_MAX_TRIES,
-                         DEFAULT_RMA_RTO_S, ErrorCode)
+from ..constants import (ACCLError, CCLOp, DEFAULT_RMA_EAGER_MAX,
+                         DEFAULT_RMA_MAX_TRIES, DEFAULT_RMA_RTO_S,
+                         ErrorCode)
 from ..emulator import protocol as P
 from ..emulator.fabric import Envelope
 from ..log import get_logger
 from ..tracing import METRICS, TRACE
-from .plan import EAGER, plan_transfer, segment_bounds
+from .notify import NotifyQueue, NotifyRecord
+from .plan import EAGER, eager_max_from_env, plan_transfer, segment_bounds
 from .window import WindowRegistry
 
 log = get_logger(__name__)
@@ -71,7 +74,7 @@ class _Tx:
     __slots__ = ("kind", "xfer", "comm", "comm_id", "dst", "window",
                  "offset", "count", "u_dtype", "w_dtype", "l_dtype",
                  "eth_c", "addr", "plan", "handle", "tenant", "phase",
-                 "tries", "deadline", "got", "done_seen", "t0")
+                 "tries", "deadline", "got", "done_seen", "t0", "notify")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -82,7 +85,8 @@ class _Rx:
     """Target-side state of one inbound rendezvous put."""
 
     __slots__ = ("base", "count", "u_dtype", "w_dtype", "eth_c", "nsegs",
-                 "bounds", "got", "comm_id", "tenant", "expires")
+                 "bounds", "got", "comm_id", "tenant", "expires",
+                 "notify", "window", "offset")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -114,7 +118,7 @@ class RmaEngine:
                  seg_fn=None, eager_max: int | None = None,
                  rto_s: float = DEFAULT_RMA_RTO_S,
                  max_tries: int = DEFAULT_RMA_MAX_TRIES, tier: str = "emu",
-                 csum_fn=None):
+                 csum_fn=None, tuner_fn=None):
         self.rank = rank
         self.mem = mem
         self.windows = windows
@@ -132,6 +136,14 @@ class RmaEngine:
         self.timeout_fn = timeout_fn or (lambda: 30.0)
         self.seg_fn = seg_fn or (lambda: 1 << 20)
         self.eager_max = eager_max
+        # late-bound tuner getter (the driver attaches its tuner to the
+        # device AFTER device construction): prices the eager/rendezvous
+        # crossover when no explicit threshold/env override exists
+        self.tuner_fn = tuner_fn
+        # put-with-notify completion queue (accl_tpu/rma/notify.py):
+        # the target-side landing points push; the serving loop polls —
+        # a rank-LOCAL dequeue, never a collective
+        self.notify = NotifyQueue()
         self.rto_s = float(rto_s)
         self.max_tries = int(max_tries)
         self.tier = tier
@@ -188,6 +200,7 @@ class RmaEngine:
             self._rx.clear()
             self._srv.clear()
             self._done_memo.clear()
+        self.notify.clear()
         for st in pending:
             st.handle.complete(int(ErrorCode.CONNECTION_CLOSED))
 
@@ -199,19 +212,53 @@ class RmaEngine:
         for k, v in list(self.counters.items()):
             yield ("counter", k, labels, v)
         yield ("gauge", "rma_inflight", labels, len(self._tx))
+        nq = self.notify
+        if nq.enqueued:
+            yield ("counter", "notify_enqueued_total", labels, nq.enqueued)
+        if nq.polled:
+            yield ("counter", "notify_polled_total", labels, nq.polled)
+        if nq.dropped:
+            yield ("counter", "notify_dropped_total", labels, nq.dropped)
+        pend = nq.pending()
+        if pend:
+            yield ("gauge", "notify_pending", labels, pend)
+
+    # -- eager/rendezvous crossover ----------------------------------------
+    def effective_eager_max(self) -> int:
+        """The live eager threshold. Precedence: explicit constructor
+        value > ``$ACCL_TPU_RMA_EAGER_MAX`` (the operator override always
+        wins when set) > the attached tuner's alpha-beta-priced,
+        measurement-refined recommendation > the static default."""
+        if self.eager_max is not None:
+            return self.eager_max
+        if os.environ.get("ACCL_TPU_RMA_EAGER_MAX") is not None:
+            return eager_max_from_env()
+        tuner = self.tuner_fn() if self.tuner_fn is not None else None
+        if tuner is not None:
+            rec = getattr(tuner, "recommend_rma_eager_max", None)
+            if rec is not None:
+                try:
+                    got = rec()
+                    if got:
+                        return int(got)
+                except Exception:  # noqa: BLE001 — a broken tuner must
+                    pass           # not take the put path down with it
+        return DEFAULT_RMA_EAGER_MAX
 
     # -- initiator ---------------------------------------------------------
     def start(self, scenario: CCLOp, comm, target: int, window: int,
               offset: int, count: int, arithcfg, eth_compressed: bool,
               local_addr: int, handle: CallHandle, tenant: str = "",
-              local_compressed: bool = False):
+              local_compressed: bool = False, notify: int | None = None):
         """Begin one put/get. ``target`` is the comm-local rank index (the
         descriptor's root_src_dst), ``local_addr`` the initiator's source
         (put) / destination (get) byte address — stored in the COMPRESSED
         dtype when ``local_compressed`` (the descriptor's OP0/RES
         compression flag; the window side always holds the uncompressed
-        dtype). Returns immediately; the handle completes when the target
-        FINs (put) or every segment landed (get)."""
+        dtype). ``notify`` (puts only) is a request token the TARGET
+        enqueues on its completion queue when the data lands. Returns
+        immediately; the handle completes when the target FINs (put) or
+        every segment landed (get)."""
         if self._closed:
             handle.complete(int(ErrorCode.CONNECTION_CLOSED))
             return
@@ -223,12 +270,12 @@ class RmaEngine:
         if target == comm.local_rank:
             # local shortcut: a self-put/get is a window-checked memcpy
             self._local_copy(scenario, window, offset, count, arithcfg,
-                             local_addr, l_dt, handle)
+                             local_addr, l_dt, handle, notify=notify)
             return
         w_dt = (arithcfg.compressed_dtype if eth_compressed
                 else arithcfg.uncompressed_dtype)
         plan = plan_transfer(count, u_dt.itemsize, w_dt.itemsize,
-                             self.seg_fn(), self.eager_max)
+                             self.seg_fn(), self.effective_eager_max())
         xfer = ((self.rank & 0x7FF) << 20) | (next(self._next) & 0xFFFFF)
         st = _Tx(kind=scenario, xfer=xfer, comm=comm,
                  comm_id=comm.comm_id,
@@ -242,7 +289,8 @@ class RmaEngine:
                  # tick must not race the queued initial emission into a
                  # spurious duplicate
                  deadline=time.monotonic() + self._rto(0), got=set(),
-                 done_seen=False, t0=time.perf_counter())
+                 done_seen=False, t0=time.perf_counter(),
+                 notify=(notify if scenario == CCLOp.put else None))
         with self._mu:
             self._tx[xfer] = st
         self._ensure_worker()
@@ -262,7 +310,7 @@ class RmaEngine:
             self._enqueue(("rts", xfer))
 
     def _local_copy(self, scenario, window, offset, count, arithcfg,
-                    local_addr, l_dt, handle):
+                    local_addr, l_dt, handle, notify=None):
         try:
             dt = arithcfg.uncompressed_dtype
             base = self.windows.resolve(window, offset, count * dt.itemsize)
@@ -270,6 +318,9 @@ class RmaEngine:
                 data = self.mem.read(local_addr, count, l_dt)
                 self.mem.write(base, np.ascontiguousarray(
                     data.astype(dt, copy=False)))
+                if notify is not None:
+                    self._notify_push(notify, window, self.rank, 0,
+                                      offset, count * dt.itemsize)
             else:
                 data = self.mem.read(base, count, dt)
                 self.mem.write(local_addr, np.ascontiguousarray(
@@ -277,9 +328,19 @@ class RmaEngine:
             handle.complete(0)
         except ACCLError as exc:
             self._count("rma_window_errors_total")
+            if scenario == CCLOp.put and notify is not None:
+                self._notify_push(notify, window, self.rank,
+                                  exc.error_word, offset, 0)
             handle.complete(exc.error_word, exception=exc)
         except Exception as exc:  # noqa: BLE001 — surface, never hang
             handle.complete(int(ErrorCode.INVALID_CALL), exception=exc)
+
+    def _notify_push(self, token: int, window: int, src: int, err: int,
+                     offset: int, nbytes: int):
+        self.notify.push(NotifyRecord(token=int(token), window=int(window),
+                                      src=int(src), err=int(err),
+                                      offset=int(offset),
+                                      nbytes=int(nbytes)))
 
     def _enqueue(self, job):
         self._jobs.put(job)
@@ -348,7 +409,7 @@ class RmaEngine:
             kind, st.xfer, window=st.window, offset=st.offset,
             count=st.count, udtype=P.dtype_code(st.u_dtype),
             cdtype=P.dtype_code(st.w_dtype), eth_compressed=st.eth_c,
-            nsegs=st.plan.nsegs, payload=payload)
+            nsegs=st.plan.nsegs, notify=st.notify, payload=payload)
         st.deadline = time.monotonic() + self._rto(st.tries)
         if TRACE.enabled:
             TRACE.emit("rma_" + st.phase, rank=self.rank, seqn=st.xfer,
@@ -576,7 +637,17 @@ class RmaEngine:
             try:
                 base, u_dt, w_dt = self._resolve_target(ctl)
             except ACCLError as exc:
+                # memoize the typed failure so a retried RTS re-FINs
+                # idempotently — and so the error notify (below) is
+                # delivered exactly once, like a success notify
                 self._count("rma_window_errors_total")
+                with self._mu:
+                    already = key in self._done_memo
+                    self._memo_done(key, exc.error_word)
+                if not already and ctl["notify"] is not None:
+                    self._notify_push(ctl["notify"], ctl["window"],
+                                      env.src, exc.error_word,
+                                      ctl["offset"], 0)
                 self._fin(env.src, env.comm_id, ctl["xfer"],
                           exc.error_word)
                 return
@@ -586,7 +657,9 @@ class RmaEngine:
                      bounds=segment_bounds(ctl["count"], ctl["nsegs"]),
                      got=set(), comm_id=env.comm_id,
                      tenant=self.tenant_of(env.comm_id),
-                     expires=time.monotonic() + self.timeout_fn())
+                     expires=time.monotonic() + self.timeout_fn(),
+                     notify=ctl["notify"], window=ctl["window"],
+                     offset=ctl["offset"])
             with self._mu:
                 self._rx.setdefault(key, rx)
         # (duplicate RTS for a live transfer re-CTSes — the CTS may have
@@ -688,10 +761,16 @@ class RmaEngine:
                                          extra=missing))
                 return
             with self._mu:
-                self._rx.pop(key, None)
+                popped = self._rx.pop(key, None)
                 self._memo_done(key, 0)
-            self._count("rma_bytes_total",
-                        rx.count * rx.u_dtype.itemsize)
+            nbytes = rx.count * rx.u_dtype.itemsize
+            if popped is not None and rx.notify is not None:
+                # exactly-once boundary: only the DONE that transitions
+                # the transfer into the memo enqueues — a duplicate DONE
+                # racing here finds _rx already popped and only re-FINs
+                self._notify_push(rx.notify, rx.window, env.src, 0,
+                                  rx.offset, nbytes)
+            self._count("rma_bytes_total", nbytes)
             self._fin(env.src, env.comm_id, ctl["xfer"], 0)
             if TRACE.enabled:
                 TRACE.emit("rma_fin", rank=self.rank, seqn=ctl["xfer"],
@@ -748,6 +827,19 @@ class RmaEngine:
             self._count("rma_window_errors_total" if err
                         & int(ErrorCode.RMA_WINDOW_ERROR)
                         else "rma_failed_total")
+        elif st.kind == CCLOp.put and self.tuner_fn is not None:
+            # feed the measured put latency back into the tuner's
+            # eager/rendezvous crossover (clean completions only — a
+            # retry-storm duration says nothing about the path's cost)
+            tuner = self.tuner_fn()
+            obs = getattr(tuner, "observe_rma_put", None)
+            if obs is not None and st.tries == 0:
+                try:
+                    obs(st.count * st.u_dtype.itemsize,
+                        st.plan.kind == EAGER,
+                        time.perf_counter() - st.t0)
+                except Exception:  # noqa: BLE001 — observability must
+                    pass           # never fail the data path
         if TRACE.enabled:
             t0_ns = time.monotonic_ns() - int(
                 (time.perf_counter() - st.t0) * 1e9)
@@ -787,6 +879,15 @@ class RmaEngine:
             base, u_dt, w_dt = self._resolve_target(ctl)
         except ACCLError as exc:
             self._count("rma_window_errors_total")
+            with self._mu:
+                already = key in self._done_memo
+                self._memo_done(key, exc.error_word)
+            if not already and ctl["notify"] is not None:
+                # typed error delivery rides the same queue as success:
+                # the serving poll loop learns of a failed put exactly
+                # once (the memo absorbs retried EAGERs)
+                self._notify_push(ctl["notify"], ctl["window"], env.src,
+                                  exc.error_word, ctl["offset"], 0)
             self._fin(env.src, env.comm_id, ctl["xfer"], exc.error_word)
             return
         pool = self.pool_fn()
@@ -817,7 +918,15 @@ class RmaEngine:
                 return       # duplicate lands and FINs for both
             payload = got[1]
         self._land(base, 0, ctl["count"], u_dt, w_dt, payload)
-        self._count("rma_bytes_total", ctl["count"] * u_dt.itemsize)
+        nbytes = ctl["count"] * u_dt.itemsize
+        self._count("rma_bytes_total", nbytes)
         with self._mu:
+            already = key in self._done_memo
             self._memo_done(key, 0)
+        if not already and ctl["notify"] is not None:
+            # same exactly-once transition as the rendezvous DONE: the
+            # memo write IS the completion event; duplicates that raced
+            # past the top-of-handler memo check stop here
+            self._notify_push(ctl["notify"], ctl["window"], env.src, 0,
+                              ctl["offset"], nbytes)
         self._fin(env.src, env.comm_id, ctl["xfer"], 0)

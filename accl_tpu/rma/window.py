@@ -13,8 +13,32 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import weakref
 
 from ..constants import ACCLError, ErrorCode
+
+# every live registry, weakly (dies with its world): the conftest
+# window-leak sweep walks this after each test to assert the repo-wide
+# convention that a deregistered (closed) world leaves an EMPTY registry
+# — the /dev/shm-sweep convention applied to the RMA address namespace
+_LIVE: "weakref.WeakSet[WindowRegistry]" = weakref.WeakSet()
+
+
+def sweep_leaked() -> list[str]:
+    """Find (and clean) window registrations that outlived their world:
+    any CLOSED registry still holding entries — a use-after-deinit
+    register, or a close path that forgot to purge. Returns one
+    description per leaking registry; leftovers are cleared so one
+    test's leak cannot cascade into the next test's failure."""
+    leaked: list[str] = []
+    for reg in list(_LIVE):
+        n = len(reg)
+        if reg.closed and n:
+            leaked.append(f"{reg.owner or 'registry'}: {n} window(s) "
+                          f"registered after close")
+            with reg._mu:
+                reg._windows.clear()
+    return leaked
 
 
 @dataclasses.dataclass(frozen=True)
@@ -27,11 +51,17 @@ class Window:
 class WindowRegistry:
     """Per-rank window table. Registration happens at configure time from
     the host; resolution happens on ingress threads for every RTS/GET —
-    a lock-guarded dict keeps both safe."""
+    a lock-guarded dict keeps both safe. :meth:`close` (device deinit)
+    marks the registry dead and purges every registration: stale windows
+    on a torn-down rank would otherwise keep accepting peer puts into
+    memory the application has moved on from."""
 
-    def __init__(self):
+    def __init__(self, owner: str = ""):
         self._mu = threading.Lock()
         self._windows: dict[int, Window] = {}
+        self.owner = owner
+        self.closed = False
+        _LIVE.add(self)
 
     def register(self, wid: int, addr: int, nbytes: int):
         if nbytes <= 0:
@@ -44,6 +74,14 @@ class WindowRegistry:
     def deregister(self, wid: int):
         with self._mu:
             self._windows.pop(int(wid), None)
+
+    def close(self):
+        """Tear down at device deinit: purge every registration and mark
+        the registry dead. Registrations that appear AFTER close are the
+        leak class the conftest sweep (:func:`sweep_leaked`) reports."""
+        with self._mu:
+            self._windows.clear()
+            self.closed = True
 
     def get(self, wid: int) -> Window | None:
         with self._mu:

@@ -1,0 +1,38 @@
+"""Request-level serving control plane (ROADMAP item 5: the
+inference-serving dataplane, request side).
+
+The RMA layer gives serving its dataplane verbs — registered windows,
+put/get, and put-with-notify (:mod:`accl_tpu.rma.notify`). This package
+adds the CONTROL plane a disaggregated prefill/decode deployment needs
+on top of them, in three pieces:
+
+* :class:`KVBlockManager` (kvcache.py) — fixed-size KV-block placement
+  and eviction over the decode ranks' registered windows, with
+  ref-counted prefix sharing keyed by token-prefix hash: a shared
+  prompt's blocks cross the wire once, every later request's hit is
+  ZERO wire bytes (the tested invariant), and eviction is LRU over
+  refcount-0 blocks only.
+* :class:`ContinuousBatcher` (batcher.py) — per-step request admission
+  and retirement against an in-flight token budget: the decode batch is
+  rebuilt EVERY step (no drain barrier), riding the tenant service's
+  preempt lane so decode admission bypasses prefill's deficit round.
+* elastic.py — decode-pool scale-out helpers: the
+  ``ShardSpec.block_cyclic`` KV layouts whose grow/shrink reshard the
+  redistribute engine compiles to minimal transfers under the
+  shard+chunk memory bound.
+
+All three are host-side and transport-free: they decide WHAT moves
+(which blocks, which ranks, which requests) and the caller executes the
+puts — which keeps every policy differential-testable without a world.
+See docs/ARCHITECTURE.md "Serving control plane".
+"""
+
+from .batcher import ContinuousBatcher, Request
+from .elastic import kv_shard_spec, reshard_plan_counts
+from .kvcache import BlockRef, KVBlockManager, prefix_hashes
+
+__all__ = [
+    "KVBlockManager", "BlockRef", "prefix_hashes",
+    "ContinuousBatcher", "Request",
+    "kv_shard_spec", "reshard_plan_counts",
+]

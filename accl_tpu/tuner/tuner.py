@@ -268,6 +268,75 @@ class Tuner:
             return 128
         return 64
 
+    # -- RMA eager/rendezvous crossover (accl_tpu/rma) ---------------------
+    RMA_EAGER_MIN_B = 4 << 10
+    RMA_EAGER_MAX_B = 256 << 10
+
+    def recommend_rma_eager_max(self) -> int:
+        """Byte threshold below which a one-sided put should go EAGER
+        (single frame through the target's rx pool) instead of
+        RENDEZVOUS (RTS/CTS, then segments landing directly in the
+        window). Priced analytically from the topology — rendezvous
+        pays one extra control round trip (~2*alpha_us) before any
+        payload moves, eager pays the rx-pool staging copy (~nbytes at
+        the link's beta) — and refined by measured put latencies fed
+        through :meth:`observe_rma_put`: once both variants of a size
+        bucket have ``min_samples`` observations, the measured winner
+        moves the crossover. Clamped to [4 KiB, 256 KiB], floored to a
+        power of two, sticky until :meth:`refresh` (the engine reads it
+        per transfer; a mid-flight flip is harmless — the plan kind is
+        carried in the opening frame — but determinism helps tests).
+        ``$ACCL_TPU_RMA_EAGER_MAX`` still wins when set: the engine
+        consults the tuner only when neither the constructor nor the
+        environment pinned a threshold."""
+        key = ("rma_eager_max",)
+        with self._lock:
+            decided = self._decisions.get(key)
+            if decided is not None:
+                return int(decided)
+            topo = self.topology or Topology()
+            cross = 2.0 * topo.alpha_us * topo.beta_gbps * 1e3
+            eager_win, rdv_win = [], []
+            for k, stats in self._measured.items():
+                if not (len(k) == 2 and k[0] == "rma_eager"):
+                    continue
+                e, r = stats.get(True), stats.get(False)
+                if (e is None or r is None or e.n < self.min_samples
+                        or r.n < self.min_samples):
+                    continue
+                size = 1 << int(k[1])  # bucket upper bound, bytes
+                (eager_win if e.ewma_us <= r.ewma_us
+                 else rdv_win).append(size)
+            if rdv_win:
+                # conservative: stay below the smallest size where
+                # rendezvous measurably wins, whatever the model says
+                cross = min(cross, min(rdv_win) / 2)
+            clean = [s for s in eager_win
+                     if not rdv_win or s < min(rdv_win)]
+            if clean:
+                cross = max(cross, max(clean))
+            cross = max(self.RMA_EAGER_MIN_B,
+                        min(self.RMA_EAGER_MAX_B, int(cross)))
+            cross = 1 << (cross.bit_length() - 1)  # power-of-two floor
+            self._decisions[key] = cross
+            return cross
+
+    def observe_rma_put(self, nbytes: int, eager: bool,
+                        duration_s: float, error_word: int = 0) -> bool:
+        """Feed one retired put's issue->land latency under the variant
+        it actually ran (True = eager). The engine feeds only CLEAN
+        zero-retry puts — a retried transfer's latency measures the
+        fault, not the variant. Evidence moves the crossover at the
+        next quiesced :meth:`refresh`, not mid-decision."""
+        if error_word or nbytes <= 0 or duration_s < 0:
+            return False
+        key = ("rma_eager", nbytes_bucket(nbytes))
+        with self._lock:
+            stats = self._measured.setdefault(key, {})
+            stats.setdefault(bool(eager), _Stat()).update(
+                duration_s * 1e6, self.ewma_weight)
+        return True
+
     def refresh(self):
         """Drop cached decisions: the next ``select`` per key re-scores
         with the measurements accumulated so far (and re-rolls

@@ -24,27 +24,71 @@ lane (accl_tpu/service). What the ladder measures:
 * a **bit-identity spot check**: the last block each prefill stream
   landed is compared against its source (direct-copy oracle).
 
+On top of the dataplane cell rides the REQUEST-LEVEL ladder
+(``measure_request_serving``): the serving control plane
+(accl_tpu/serving — KV-block cache with prefix reuse, continuous
+batching, put-with-notify) driven against a live emu world:
+
+* **TTFT p99, solo vs at saturation** — time-to-first-token of real
+  requests through admission + KV transfer + first decode step, alone
+  and under sustained churn (queue held non-empty against the in-flight
+  token budget). Gate: storm p99 <= max($ACCL_BENCH_MAX_TTFT_P99_MS,
+  solo p99 + $ACCL_BENCH_P99_FLOOR_US) — the saturation convention.
+* **prefix-cache hits with ZERO wire bytes** — repeated prompts share
+  KV blocks by refcount; the ladder accounts every put byte and pins
+  ``put bytes == misses x block bytes`` exactly (a hit never touches
+  the wire). Gate: hit ratio > 0, hit wire bytes == 0.
+* **put-with-notify KV-ready discovery with NO collective** — decode
+  discovers landed blocks by polling its local notify queue; the
+  ``accl_calls_total`` snapshot pair around the poll loop must not
+  move (gate: zero delta), and every landed block is compared
+  bit-exact against its source before the step may touch it.
+* **chaos cell** (``measure_serving_chaos``) — a decode rank dies
+  mid-stream (heartbeat kill + partition): the next step fails TYPED
+  (PEER_FAILED, fast), survivors revoke + shrink, the dead rank's
+  requests requeue and re-acquire on survivors, and every request
+  completes with its read-back KV digest bit-identical to the
+  fault-free oracle.
+* **elastic grow cell** (inside the storm) — ``grow_communicator``
+  admits a joiner mid-traffic, the KV arena reshards via a
+  block_cyclic -> block_cyclic spec pair (every staged piece <= one
+  KV block — the shard+chunk memory bound; moved elements a fraction
+  of the gather-reshard-scatter oracle's), and fresh prompts place on
+  the joiner.
+
 Run directly (``python -m benchmarks.serving``) for one JSON line;
 ``headline()`` feeds the same payload into bench.py's emu-tier line,
-gated in ``make bench-emu`` with best-of-three retries.
+gated in ``make bench-emu`` with best-of-three retries, and
+``request_headline(full=False)`` rides EVERY emu line (a ~3 s quick
+cell) so each BENCH_*.json captures a serving trajectory.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import threading
 import time
 
 import numpy as np
 
+from accl_tpu.chaos import FaultPlan
+from accl_tpu.constants import ACCLError, ErrorCode
+from accl_tpu.hier import plan_redistribute
+from accl_tpu.serving import (ContinuousBatcher, KVBlockManager, Request,
+                              kv_shard_spec, prefix_hashes,
+                              reshard_plan_counts)
 from accl_tpu.service import ServiceConfig
 from accl_tpu.testing import add_tenant, emu_world, run_ranks
+from accl_tpu.tracing import METRICS
 
 from .saturation import jain_index
 
 # window ids pinned explicitly (both prefill tenants register on every
 # rank, so counter-assigned ids would collide on shared devices)
 _WIN_A, _WIN_B = 101, 102
+_WIN_KV = 103                     # request-ladder KV arena window
+_BLOCK_TOKENS = 16                # tokens per KV block (hash-chain step)
 
 
 def _percentile(xs, q):
@@ -180,9 +224,531 @@ SERVING_KEYS = ("serving_world", "serving_block_kib",
                 "serving_kv_gbps", "serving_kv_blocks", "serving_jain")
 
 
+# ---------------------------------------------------------------------------
+# Request-level serving control plane (accl_tpu/serving) over a live
+# emu world: KV-block cache + continuous batching + put-with-notify.
+# ---------------------------------------------------------------------------
+
+_content_cache: dict[tuple[int, int], np.ndarray] = {}
+
+
+def _block_content(h: int, elems: int) -> np.ndarray:
+    """The model's KV bytes for block-hash ``h`` — deterministic, so
+    the fault-free oracle digest is pure arithmetic over the hash
+    chain and any correct transfer is bit-identical to it."""
+    key = (h, elems)
+    arr = _content_cache.get(key)
+    if arr is None:
+        rng = np.random.default_rng(h & 0xFFFFFFFF)
+        arr = rng.standard_normal(elems).astype(np.float32)
+        arr.flags.writeable = False
+        _content_cache[key] = arr
+    return arr
+
+
+def _prompt(pid: int, blocks: int = 4) -> list[int]:
+    """A distinct prompt per id: repeated requests of the SAME prompt
+    share every block (the prefix-cache hit path); different prompts
+    share nothing (placement spreads by load)."""
+    return [pid * 100_000 + i for i in range(blocks * _BLOCK_TOKENS)]
+
+
+def _oracle_digest(hashes, elems: int) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    for hh in hashes:
+        h.update(_block_content(hh, elems).tobytes())
+    return h.digest()
+
+
+def _accl_calls_total() -> int:
+    """Global sum of every driver's ``accl_calls_total`` rows — the
+    notify poll loop's zero-collective pin takes this before/after."""
+    snap = METRICS.snapshot()
+    return sum(snap["counters"].get("accl_calls_total", {}).values())
+
+
+class _Srv:
+    """Driver-side serving harness: admission (ContinuousBatcher) +
+    placement (KVBlockManager) + transport (put-with-notify from the
+    prefill driver) + one small decode collective per step.
+
+    ``members``/``comms``/``put_comm`` are mutable on purpose — the
+    chaos cell swaps in the shrunken communicator mid-stream and the
+    grow cell swaps in the grown one."""
+
+    def __init__(self, accls, prefill, kv, bat, winbufs, block_elems,
+                 decode_count, members=None, comms=None, put_comm=None):
+        self.accls = accls
+        self.prefill = prefill
+        self.kv = kv
+        self.bat = bat
+        self.winbufs = winbufs
+        self.block_elems = int(block_elems)
+        self.block_nbytes = self.block_elems * 4
+        self.decode_count = int(decode_count)
+        self.members = list(members if members is not None else accls)
+        self.comms: dict = dict(comms or {})
+        self.put_comm = put_comm
+        self._bufs = {}
+        for a in accls:
+            src = a.buffer(data=np.full(decode_count, 1.0, np.float32))
+            self._bufs[a.rank] = (src, a.buffer((decode_count,),
+                                                np.float32))
+        self._staged: dict = {}
+        self._token = 0x51_0000
+        self.pending: dict = {}       # notify token -> BlockRef
+        self.inflight: list = []
+        self.polls = 0
+        self.notify_coll_calls = 0
+        self.landed_bytes = 0
+        self.put_bytes = 0
+        self.steps = 0
+        self.digests: dict = {}
+        self.oracle: dict = {}
+
+    # -- transport ---------------------------------------------------------
+    def _staging(self, h):
+        buf = self._staged.get(h)
+        if buf is None:
+            buf = self.prefill.buffer(
+                data=_block_content(h, self.block_elems).copy())
+            self._staged[h] = buf
+        return buf
+
+    def issue_puts(self, misses):
+        """One put-with-notify per missed block, fully async — the
+        notify record (not the handle) is how decode learns the block
+        landed."""
+        for ref in misses:
+            tok = self._token
+            self._token += 1
+            hdl = self.prefill.put(
+                self._staging(ref.key), self.block_elems, dst=ref.rank,
+                window=_WIN_KV, offset=ref.offset, comm=self.put_comm,
+                notify=tok, run_async=True)
+            self.inflight.append(hdl)
+            self.pending[tok] = ref
+            self.put_bytes += self.block_nbytes
+
+    def wait_kv(self, timeout: float = 60.0):
+        """Decode-side KV-ready discovery: LOCAL notify dequeues only,
+        exactly-once per token. The accl_calls_total snapshot pair pins
+        that the loop issued NO collective, and every landed block is
+        compared bit-exact to its source before use."""
+        if not self.pending:
+            return
+        calls0 = _accl_calls_total()
+        deadline = time.monotonic() + timeout
+        while self.pending:
+            progress = 0
+            for r in sorted({ref.rank for ref in self.pending.values()}):
+                recs = self.accls[r].poll_notifications(window=_WIN_KV)
+                self.polls += 1
+                for rec in recs:
+                    ref = self.pending.pop(rec.token, None)
+                    if ref is None:
+                        raise AssertionError(
+                            f"duplicate or unknown notify token "
+                            f"{rec.token:#x} (exactly-once violated)")
+                    if rec.err:
+                        raise AssertionError(
+                            f"notify carried typed error {rec.err:#x} "
+                            f"for block {ref.key:#x} on rank {ref.rank}")
+                    lo = ref.offset // 4
+                    got = self.winbufs[ref.rank].data[
+                        lo:lo + self.block_elems]
+                    if not np.array_equal(
+                            got, _block_content(ref.key,
+                                                self.block_elems)):
+                        raise AssertionError(
+                            f"landed KV block {ref.key:#x} differs "
+                            f"from its source")
+                    self.landed_bytes += self.block_nbytes
+                    progress += 1
+            if not self.pending:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"KV transfer stalled: {len(self.pending)} blocks "
+                    f"never notified")
+            if not progress:
+                time.sleep(0.0005)
+        delta = _accl_calls_total() - calls0
+        self.notify_coll_calls += delta
+        if delta:
+            raise AssertionError(
+                f"notify poll loop issued {delta} collective calls "
+                f"(the put-with-notify contract is one local dequeue)")
+
+    def drain_puts(self, timeout: float = 60.0):
+        for hdl in self.inflight:
+            hdl.wait(timeout)
+        self.inflight = []
+
+    # -- the per-step loop -------------------------------------------------
+    def _read_digest(self, req) -> bytes:
+        refs = self.kv.lookup(req.prefix_hashes, req.kv_rank)
+        h = hashlib.blake2b(digest_size=16)
+        for ref in refs:
+            lo = ref.offset // 4
+            h.update(self.winbufs[ref.rank].data[
+                lo:lo + self.block_elems].tobytes())
+        return h.digest()
+
+    def step_once(self):
+        """One continuous-batching step: admit + transfer missed KV +
+        discover via notify + decode collective + retire."""
+        batch, misses = self.bat.step_begin(time.monotonic())
+        if misses:
+            self.issue_puts(misses)
+            self.wait_kv()
+        if not batch:
+            raise AssertionError(
+                "serving wedged: pending requests but an empty batch")
+        for r in batch:
+            if r.remaining == 1:
+                # last step: read the request's KV back from the decode
+                # window (held blocks are never evicted) — bit-identity
+                # evidence against the content oracle
+                self.digests[r.rid] = self._read_digest(r)
+                self.oracle.setdefault(
+                    r.rid, _oracle_digest(r.prefix_hashes,
+                                          self.block_elems))
+
+        def body(a):
+            s, d = self._bufs[a.rank]
+            a.allreduce(s, d, self.decode_count,
+                        comm=self.comms.get(a.rank))
+        run_ranks(self.members, body, timeout=60.0)
+        self.steps += 1
+        return self.bat.step_end(time.monotonic())
+
+    def serve(self, hook=None, max_steps: int = 4000):
+        while self.bat.pending_count() or self.bat.active():
+            self.step_once()
+            if hook is not None:
+                hook(self)
+            if self.steps > max_steps:
+                raise AssertionError("serving ladder exceeded its step "
+                                     "budget — admission wedged")
+        self.drain_puts()
+
+    def check_bit_identity(self, reqs=None):
+        reqs = self.bat.done() if reqs is None else reqs
+        for r in reqs:
+            if self.digests.get(r.rid) != self.oracle.get(r.rid):
+                raise AssertionError(
+                    f"request {r.rid}: read-back KV digest differs "
+                    f"from the fault-free oracle")
+        return len(reqs)
+
+
+def _submit_wave(srv, rids, pids, blocks: int, decode_tokens: int):
+    for rid, pid in zip(rids, pids):
+        toks = _prompt(pid, blocks)
+        srv.bat.submit(Request(
+            rid=rid, prompt_tokens=len(toks),
+            decode_tokens=decode_tokens,
+            prefix_hashes=prefix_hashes(toks, _BLOCK_TOKENS)),
+            now=time.monotonic())
+
+
+def measure_request_serving(full: bool = True) -> dict:
+    """The request-level saturation ladder. ``full`` adds the elastic
+    grow cell (world 5, rank 4 joins mid-storm) and bigger request
+    counts; the quick profile (world 4, ~3 s) rides EVERY bench.py emu
+    line so BENCH_*.json always captures a serving trajectory."""
+    world = 5 if full else 4
+    block_elems = 4 << 10                 # 16 KiB KV blocks
+    blocks_per_rank = 24
+    blocks = 4                            # KV blocks per prompt
+    decode_count = 512                    # 2 KiB decode collective
+    pool = (1, 2, 3)
+    n_prompts = 6 if full else 3
+    solo_n = 8 if full else 4
+    storm_n = 24 if full else 8
+    svc = ServiceConfig(enabled=True)
+    svc.tenant("decode", preempt=True, rx_buffers=4)
+    accls = emu_world(world, service=svc, tenant="decode", nbufs=24,
+                      timeout=60.0)
+    prefill = add_tenant(accls, "prefill", key=13, timeout=60.0)
+    try:
+        winbufs = {}
+        for a in accls:
+            wb = a.buffer((blocks_per_rank * block_elems,), np.float32)
+            a.register_window(wb, window=_WIN_KV)
+            winbufs[a.rank] = wb
+        kv = KVBlockManager(block_elems * 4, blocks_per_rank, pool,
+                            name="kv")
+        bat = ContinuousBatcher(kv=kv, max_inflight_tokens=700,
+                                max_batch=10, name="serving")
+        # full profile: decode steps run on a SPLIT serving comm so the
+        # grow cell has a communicator to grow (rank world-1 sits out
+        # until it joins); quick profile decodes on the world comm
+        sub = {}
+        if full:
+            def mk(a):
+                sub[a.rank] = a.split_communicator(
+                    list(range(world - 1)), key=21)
+            run_ranks(accls[:world - 1], mk)
+            members = accls[:world - 1]
+        else:
+            members = accls
+        srv = _Srv(accls, prefill[0], kv, bat, winbufs, block_elems,
+                   decode_count, members=members, comms=sub,
+                   put_comm=None)
+
+        # -- solo: one request at a time (TTFT floor + cache seeding) --
+        rid = 0
+        for i in range(solo_n):
+            _submit_wave(srv, [rid], [i % n_prompts], blocks, 4)
+            rid += 1
+            srv.serve()
+        solo_done = bat.drain_done()
+        solo_ttft = [r.ttft_s for r in solo_done]
+
+        # -- storm: sustained churn at saturation ----------------------
+        grown_state = {"done": not full, "placed": 0, "moved_frac": 1.0}
+        _submit_wave(srv, range(rid, rid + storm_n),
+                     [i % n_prompts for i in range(storm_n)], blocks, 5)
+        rid += storm_n
+
+        def grow_hook(s):
+            if grown_state["done"] or s.bat.retired_total < solo_n + 8:
+                return
+            grown_state["done"] = True
+            _grow_cell(s, accls, sub, kv, blocks_per_rank, block_elems,
+                       grown_state)
+            # fresh prompts: nothing cached anywhere, so least-loaded
+            # placement favors the joiner's empty arena
+            _submit_wave(s, range(10_000, 10_008),
+                         [100 + i % 4 for i in range(8)], blocks, 5)
+
+        t0 = time.perf_counter()
+        srv.serve(hook=grow_hook)
+        storm_s = time.perf_counter() - t0
+        storm_done = bat.drain_done()
+        storm_ttft = [r.ttft_s for r in storm_done]
+        if full:
+            grown_state["placed"] = sum(
+                1 for r in storm_done if r.rid >= 10_000
+                and r.kv_rank == world - 1)
+
+        # every retired request's read-back KV == the content oracle
+        n_done = srv.check_bit_identity(solo_done + storm_done)
+        if n_done != solo_n + storm_n + (8 if full else 0):
+            raise AssertionError(f"requests lost: {n_done} retired")
+        # zero wire bytes on hits: every put byte is a miss byte
+        hit_wire = srv.put_bytes - kv.misses * srv.block_nbytes
+        if hit_wire or srv.landed_bytes != srv.put_bytes:
+            raise AssertionError(
+                f"prefix-cache hits moved wire bytes: {hit_wire} B "
+                f"beyond the {kv.misses} misses")
+        out = {
+            "serving_requests": n_done,
+            "serving_ttft_p99_solo_ms":
+                round(_percentile(solo_ttft, 99) * 1e3, 2),
+            "serving_ttft_p50_solo_ms":
+                round(_percentile(solo_ttft, 50) * 1e3, 2),
+            "serving_ttft_p99_storm_ms":
+                round(_percentile(storm_ttft, 99) * 1e3, 2),
+            "serving_ttft_p50_storm_ms":
+                round(_percentile(storm_ttft, 50) * 1e3, 2),
+            "serving_hit_ratio": round(kv.hit_ratio(), 3),
+            "serving_hit_wire_bytes": hit_wire,
+            "serving_req_kv_gbps":
+                round(srv.landed_bytes / storm_s / 1e9, 4),
+            "serving_notify_polls": srv.polls,
+            "serving_notify_coll_calls": srv.notify_coll_calls,
+            "serving_deferred": bat.deferred_total,
+        }
+        if full:
+            out["serving_grow_ok"] = int(grown_state["done"])
+            out["serving_grow_world"] = world
+            out["serving_grow_placed"] = grown_state["placed"]
+            out["serving_reshard_moved_frac"] = grown_state["moved_frac"]
+        return out
+    finally:
+        for a in accls:
+            a.device.deinit()
+
+
+def _grow_cell(srv, accls, sub, kv, blocks_per_rank, block_elems,
+               state):
+    """Mid-storm decode-pool scale-out: grow the serving comm by the
+    joiner, reshard the KV arena block_cyclic -> block_cyclic on the
+    grown comm (bit-exact, every staged piece <= one KV block — the
+    shard+chunk memory bound), then open the joiner for placement."""
+    world = len(accls)
+    joiner = world - 1
+    grown = {}
+
+    def g(a):
+        if a.rank == joiner:
+            grown[a.rank] = a.grow_communicator(
+                [joiner], base_members=list(range(world - 1)), key=21)
+        else:
+            grown[a.rank] = a.grow_communicator(
+                [joiner], comm=sub[a.rank], key=21)
+    run_ranks(accls, g, timeout=60.0)
+
+    old_pool = tuple(kv.ranks)
+    new_pool = old_pool + (joiner,)
+    src = kv_shard_spec(blocks_per_rank * len(old_pool), block_elems,
+                        world, order=old_pool)
+    dst = kv_shard_spec(blocks_per_rank * len(old_pool), block_elems,
+                        world, order=new_pool)
+    counts = reshard_plan_counts(src, dst)
+    state["moved_frac"] = round(
+        counts["moved_elems"] / counts["oracle_moved_elems"], 3)
+    if counts["moved_elems"] >= counts["oracle_moved_elems"]:
+        raise AssertionError(
+            "KV reshard moved no fewer elements than the gather-"
+            "reshard-scatter oracle")
+    for me in range(world):
+        plan = plan_redistribute(src, dst, me)
+        big = [s.count for s in plan.steps
+               if s.kind in ("send", "recv") and s.count > block_elems]
+        if big:
+            raise AssertionError(
+                f"KV reshard stages a piece larger than one block "
+                f"({max(big)} > {block_elems} elems) — shard+chunk "
+                f"memory bound broken")
+
+    def body(a):
+        sn = max(1, src.local_count(a.rank))
+        dn = max(1, dst.local_count(a.rank))
+        sb = a.buffer((sn,), np.float32)
+        for g0, c, l in src.intervals(a.rank):
+            sb.data[l:l + c] = np.arange(g0, g0 + c, dtype=np.float32)
+        db = a.buffer((dn,), np.float32)
+        a.redistribute(sb, src, db, dst, comm=grown[a.rank])
+        for g0, c, l in dst.intervals(a.rank):
+            if not np.array_equal(db.data[l:l + c],
+                                  np.arange(g0, g0 + c,
+                                            dtype=np.float32)):
+                raise AssertionError(
+                    "KV arena reshard landed wrong bytes")
+    run_ranks(accls, body, timeout=120.0)
+
+    kv.add_rank(joiner)
+    srv.members = list(accls)
+    srv.comms = grown
+
+
+def measure_serving_chaos() -> dict:
+    """Decode-rank death mid-stream: heartbeats detect the kill, the
+    next step fails TYPED (PEER_FAILED — never a deadline burn),
+    survivors revoke + shrink, the dead rank's requests requeue and
+    re-acquire on survivors, and EVERY request completes bit-identical
+    to the fault-free oracle."""
+    world = 4
+    block_elems = 4 << 10
+    blocks_per_rank = 24
+    blocks = 4
+    decode_count = 512
+    accls = emu_world(world, nbufs=24, timeout=15.0)
+    ctx = accls[0].device.ctx
+    try:
+        ctx.start_heartbeats(interval_s=0.03, budget=3)
+        winbufs = {}
+        for a in accls:
+            wb = a.buffer((blocks_per_rank * block_elems,), np.float32)
+            a.register_window(wb, window=_WIN_KV)
+            winbufs[a.rank] = wb
+        kv = KVBlockManager(block_elems * 4, blocks_per_rank, (1, 2, 3),
+                            name="kv-chaos")
+        bat = ContinuousBatcher(kv=kv, max_inflight_tokens=500,
+                                max_batch=6, name="serving-chaos")
+        srv = _Srv(accls, accls[0], kv, bat, winbufs, block_elems,
+                   decode_count)
+        time.sleep(0.15)            # peers hear each other's heartbeats
+        _submit_wave(srv, range(12), [i % 4 for i in range(12)],
+                     blocks, 6)
+        for _ in range(3):          # mid-stream: nobody retired yet
+            srv.step_once()
+        srv.drain_puts()
+
+        # the kill: silence rank 3's heartbeats AND its data frames
+        ctx.fabric.inject_fault(FaultPlan.partition((0, 1, 2), (3,)))
+        ctx.kill_rank(3)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(3 in accls[r].device._dead_peers for r in range(3)):
+                break
+            time.sleep(0.02)
+
+        subs = {}
+
+        def fail_and_shrink(a):
+            if a.rank == 3:
+                return "dead"
+            s, d = srv._bufs[a.rank]
+            try:
+                a.allreduce(s, d, decode_count)
+            except ACCLError as exc:
+                if ErrorCode.PEER_FAILED not in exc.errors:
+                    raise
+                a.revoke()
+                subs[a.rank] = a.shrink_communicator([3])
+                return "typed"
+            return "untyped"
+        res = run_ranks(accls, fail_and_shrink, timeout=60.0)
+        if res[:3] != ["typed"] * 3:
+            raise AssertionError(
+                f"survivors did not fail typed-clean: {res[:3]}")
+
+        # control plane: drop the dead arena, requeue its requests
+        orphans = kv.drop_rank(3)
+        requeued = 0
+        for r in bat.active():
+            if r.kv_rank == 3:
+                bat.requeue(r)
+                requeued += 1
+        if not requeued and not orphans:
+            raise AssertionError(
+                "chaos cell killed a rank nothing was placed on — "
+                "the cell proved nothing")
+        srv.members = accls[:3]
+        srv.comms = dict(subs)
+        srv.put_comm = subs[0]
+        srv.serve()
+        if srv.check_bit_identity() != 12:
+            raise AssertionError("chaos cell lost requests")
+        return {"serving_chaos_clean": 1,
+                "serving_chaos_requeued": requeued}
+    finally:
+        ctx.stop_heartbeats()
+        for a in accls:
+            a.device.deinit()
+
+
+REQUEST_KEYS = (
+    "serving_requests", "serving_ttft_p99_solo_ms",
+    "serving_ttft_p50_solo_ms", "serving_ttft_p99_storm_ms",
+    "serving_ttft_p50_storm_ms", "serving_hit_ratio",
+    "serving_hit_wire_bytes", "serving_req_kv_gbps",
+    "serving_notify_polls", "serving_notify_coll_calls",
+    "serving_deferred", "serving_grow_ok", "serving_grow_world",
+    "serving_grow_placed", "serving_reshard_moved_frac",
+    "serving_chaos_clean", "serving_chaos_requeued")
+
+
+def request_headline(full: bool = False) -> dict:
+    """The request-level trajectory for bench.py's emu line. Quick
+    profile ungated (~3 s, no grow/chaos); full ladder + chaos cell
+    when the serving gates are armed (make bench-emu)."""
+    out = measure_request_serving(full=full)
+    if full:
+        out.update(measure_serving_chaos())
+    return out
+
+
 def headline() -> dict:
     return measure_serving()
 
 
 if __name__ == "__main__":
-    print(json.dumps(headline()))
+    out = measure_serving()
+    out.update(request_headline(full=True))
+    print(json.dumps(out))

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Lint: every metric the library emits is documented (``make lint``).
+
+docs/OBSERVABILITY.md's catalog is the contract dashboards and the
+bench gates are built against — an undocumented series is invisible to
+operators and an easy place for a renamed key to silently orphan a
+dashboard. This gate statically scans ``accl_tpu/`` for every metric
+name handed to the registry:
+
+* direct writes — ``METRICS.inc("...")`` / ``set_gauge`` / ``observe``;
+* collector rows — ``yield ("counter"|"gauge"|"histogram", "...")``,
+  including f-string families (``f"retx_{k}_total"`` is checked as the
+  pattern ``retx_*_total`` against the catalog text, which spells such
+  families ``retx_{tracked,acked,...}_total``).
+
+Any emitted name missing from the catalog fails the lint with the
+emitting ``file:line``. Purely textual — no imports, no world — so it
+runs in milliseconds and cannot flake.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(ROOT, "docs", "OBSERVABILITY.md")
+
+# direct registry writes and collector-yielded rows; group 1 = the
+# (possibly f-string) metric name
+_EMIT = re.compile(
+    r"""(?:\.(?:inc|set_gauge|observe)\(\s*
+         |yield\s*\(\s*"(?:counter|gauge|histogram)"\s*,\s*)
+        f?"([a-z][a-z0-9_{}]*)"
+    """, re.VERBOSE)
+
+
+def emitted_metrics() -> dict[str, str]:
+    """name (or f-string template) -> first emitting file:line."""
+    out: dict[str, str] = {}
+    pkg = os.path.join(ROOT, "accl_tpu")
+    for dirpath, _dirs, files in sorted(os.walk(pkg)):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    for m in _EMIT.finditer(line):
+                        rel = os.path.relpath(path, ROOT)
+                        out.setdefault(m.group(1), f"{rel}:{lineno}")
+    return out
+
+
+def documented(name: str, doc_text: str) -> bool:
+    if "{" not in name:
+        return name in doc_text
+    # f-string family: each placeholder may appear in the catalog as a
+    # concrete key ("fabric_sent_total"), a brace-enumerated list
+    # ("retx_{tracked,acked,...}_total"), or a wildcard
+    # ("executor_last_*") — any of those documents the family
+    filler = r"(?:[a-z0-9_*]+|\{[a-z0-9_,.]+\})"
+    parts = re.split(r"\{[^}]*\}", name)
+    pat = re.compile(filler.join(re.escape(p) for p in parts))
+    return bool(pat.search(doc_text))
+
+
+def main() -> int:
+    with open(DOC, encoding="utf-8") as f:
+        doc_text = f.read()
+    missing = {n: loc for n, loc in emitted_metrics().items()
+               if not documented(n, doc_text)}
+    if missing:
+        print(f"FAIL: {len(missing)} emitted metric(s) missing from "
+              f"docs/OBSERVABILITY.md's catalog:")
+        for name, loc in sorted(missing.items()):
+            print(f"  {name:40s} emitted at {loc}")
+        return 1
+    n = len(emitted_metrics())
+    print(f"OK: all {n} emitted metric names documented in "
+          f"docs/OBSERVABILITY.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,3 +100,22 @@ def _shm_leak_sweep():
             f"test leaked {len(leaked)} shm segment(s): {leaked} — "
             f"ShmFabric worlds must be torn down (a.deinit() / "
             f"daemon.shutdown()) before the test returns")
+
+
+@pytest.fixture(autouse=True)
+def _window_leak_sweep():
+    """Post-test RMA-window sweep (the shm-sweep convention applied to
+    the one-sided address namespace, rma/window.py): a CLOSED registry
+    still holding registrations means a test registered windows after
+    deinit, or a teardown path forgot to purge — stale windows would
+    keep accepting peer puts into reclaimed memory. Leftovers are
+    cleared so the leaking test fails itself instead of poisoning a
+    later victim."""
+    from accl_tpu.rma.window import sweep_leaked
+    sweep_leaked()                 # pre-clean prior crashes' leftovers
+    yield
+    leaked = sweep_leaked()
+    if leaked:
+        pytest.fail(
+            f"test leaked RMA window registrations: {leaked} — a "
+            f"deinitialized world's registry must be empty")

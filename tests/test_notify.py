@@ -1,0 +1,232 @@
+"""Put-with-notify completion lane (accl_tpu/rma/notify.py).
+
+The serving control plane's completion primitive: ``put(...,
+notify=token)`` makes the TARGET enqueue one record on its local
+notify queue when the put lands (or a typed error record when it fails
+there), and ``poll_notifications`` is ONE local dequeue — no
+collective, no handshake. What must hold:
+
+* records carry (token, window, src, err, offset, nbytes) and appear
+  only for notified puts — a plain put enqueues nothing;
+* the DONE-memo transition is the enqueue boundary, so delivery is
+  EXACTLY-ONCE even when the chaos plan drops or duplicates the
+  control frames that carry the token (retransmission re-delivers the
+  frame; the memo dedups the enqueue);
+* a put that fails AT THE TARGET (unknown window) delivers a typed
+  error record through the same queue — the decode side learns of
+  transfer failures from its poll loop, not from a collective;
+* the lane is differential across tiers: the emu fast path and the
+  daemon tier (tcp AND udp socket stacks, MSG_RMA_NOTIFY poll
+  round-trip) expose identical record semantics.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from accl_tpu.chaos import FaultPlan, FaultRule
+from accl_tpu.constants import ACCLError, ErrorCode
+from accl_tpu.emulator.protocol import RMA_DATA_STRM, RMA_STRM
+from accl_tpu.rma import ANY_WINDOW, NotifyQueue, NotifyRecord
+from accl_tpu.testing import emu_world, run_ranks, sim_world
+
+WIN = 1
+
+
+def _world(w=2, win_elems=1 << 16, **kw):
+    accls = emu_world(w, timeout=15.0, **kw)
+    for a in accls:
+        a._win_buf = a.buffer((win_elems,), np.float32)
+        assert a.register_window(a._win_buf) == WIN
+    return accls
+
+
+def _teardown(accls):
+    for a in accls:
+        a.device.deinit()
+
+
+def _payload(n, seed=0):
+    return np.random.default_rng(seed).standard_normal(n).astype(
+        np.float32)
+
+
+def _poll_until(accl, n, window=None, timeout=10.0):
+    """Drain ``accl``'s notify queue until ``n`` records arrived."""
+    out = []
+    deadline = time.monotonic() + timeout
+    while len(out) < n:
+        out.extend(accl.poll_notifications(window=window))
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"only {len(out)}/{n} notify records arrived: {out}")
+        if len(out) < n:
+            time.sleep(0.002)
+    return out
+
+
+# -- queue unit ---------------------------------------------------------------
+
+def test_notify_queue_per_window_and_any():
+    q = NotifyQueue(cap=8)
+    for i in range(3):
+        q.push(NotifyRecord(token=i, window=1, src=0, err=0,
+                            offset=0, nbytes=4))
+    q.push(NotifyRecord(token=99, window=2, src=0, err=0,
+                        offset=0, nbytes=4))
+    assert q.pending(1) == 3 and q.pending(2) == 1
+    assert [r.token for r in q.poll(1, 2)] == [0, 1]
+    # ANY_WINDOW drains across windows; order within a window holds
+    rest = q.poll(ANY_WINDOW, 8)
+    assert sorted(r.token for r in rest) == [2, 99]
+    assert q.poll(ANY_WINDOW, 8) == []
+    assert q.polled == 4 and q.enqueued == 4
+
+
+def test_notify_queue_capacity_drops_oldest():
+    q = NotifyQueue(cap=2)
+    for i in range(4):
+        q.push(NotifyRecord(token=i, window=1, src=0, err=0,
+                            offset=0, nbytes=4))
+    assert [r.token for r in q.poll(1, 8)] == [2, 3]
+    assert q.dropped == 2
+
+
+# -- emu tier -----------------------------------------------------------------
+
+def test_notify_eager_and_rendezvous_records():
+    accls = _world()
+    try:
+        # eager (small) and rendezvous (large) both notify with the
+        # landed geometry; an un-notified put enqueues NOTHING
+        small = accls[0].buffer(data=_payload(64, 1))
+        accls[0].put(small, 64, dst=1, window=WIN, offset=256,
+                     notify=0xAB)
+        big = accls[0].buffer(data=_payload(1 << 15, 2))
+        accls[0].put(big, 1 << 15, dst=1, window=WIN, offset=4096,
+                     notify=0xCD)
+        accls[0].put(small, 64, dst=1, window=WIN)      # no notify
+        recs = _poll_until(accls[1], 2, window=WIN)
+        by_tok = {r.token: r for r in recs}
+        assert set(by_tok) == {0xAB, 0xCD}
+        assert by_tok[0xAB].err == 0
+        assert by_tok[0xAB].offset == 256
+        assert by_tok[0xAB].nbytes == 64 * 4
+        assert by_tok[0xCD].nbytes == (1 << 15) * 4
+        assert all(r.src == 0 and r.window == WIN for r in recs)
+        time.sleep(0.05)
+        assert accls[1].poll_notifications(window=WIN) == []
+        # the notified data actually landed where the record says
+        assert np.array_equal(accls[1]._win_buf.data[1024:1024 + (1 << 15)],
+                              big.data)
+    finally:
+        _teardown(accls)
+
+
+def test_notify_typed_error_for_unknown_window():
+    accls = _world()
+    try:
+        src = accls[0].buffer(data=_payload(64, 3))
+        with pytest.raises(ACCLError):
+            accls[0].put(src, 64, dst=1, window=77, notify=0xBEEF)
+        recs = _poll_until(accls[1], 1, window=None)
+        assert recs[0].token == 0xBEEF
+        assert ErrorCode.RMA_WINDOW_ERROR in ErrorCode(recs[0].err)
+    finally:
+        _teardown(accls)
+
+
+@pytest.mark.parametrize("kind,strm", [
+    ("drop", RMA_STRM), ("drop", RMA_DATA_STRM),
+    ("duplicate", RMA_STRM)])
+def test_notify_exactly_once_under_chaos(kind, strm):
+    """Lost-DONE and duplicated-ctl chaos: retransmission re-delivers
+    the token-carrying frames, the done-memo dedups the enqueue —
+    every token exactly once, every landing bit-identical."""
+    accls = _world(nbufs=32)
+    fabric = accls[0].device.ctx.fabric
+    try:
+        fabric.inject_fault(FaultPlan(
+            [FaultRule(kind=kind, prob=0.3, strm=strm)], seed=11))
+        n = 1 << 12
+        datas = []
+        for i in range(12):
+            data = _payload(n, seed=100 + i)
+            datas.append(data)
+            src = accls[0].buffer(data=data.copy())
+            accls[0].put(src, n, dst=1, window=WIN, offset=i * n * 4,
+                         notify=0x9000 + i)
+        recs = _poll_until(accls[1], 12, window=WIN, timeout=30.0)
+        tokens = [r.token for r in recs]
+        assert sorted(tokens) == [0x9000 + i for i in range(12)]
+        assert len(set(tokens)) == 12, "duplicate notify delivered"
+        assert all(r.err == 0 for r in recs)
+        time.sleep(0.1)
+        assert accls[1].poll_notifications(window=WIN) == [], \
+            "late duplicate notify"
+        for i, data in enumerate(datas):
+            assert np.array_equal(
+                accls[1]._win_buf.data[i * n:(i + 1) * n], data)
+    finally:
+        fabric.clear_fault()
+        _teardown(accls)
+
+
+def test_notify_poll_is_not_a_collective():
+    """The serving gate's pinned property at unit scale: a poll loop
+    adds no accl_calls_total rows."""
+    accls = _world()
+    try:
+        src = accls[0].buffer(data=_payload(64, 7))
+        accls[0].put(src, 64, dst=1, window=WIN, notify=1)
+        _poll_until(accls[1], 1, window=WIN)
+        calls0 = {r: dict(a._call_counts)
+                  for r, a in enumerate(accls)}
+        for _ in range(50):
+            accls[1].poll_notifications(window=WIN)
+            accls[1].poll_notifications()          # ANY_WINDOW too
+        assert {r: dict(a._call_counts)
+                for r, a in enumerate(accls)} == calls0
+    finally:
+        _teardown(accls)
+
+
+# -- daemon tier (socket protocol, MSG_RMA_NOTIFY) ---------------------------
+
+@pytest.mark.parametrize("stack", ["tcp", "udp"])
+def test_daemon_tier_notify(stack):
+    accls = sim_world(2, stack=stack, timeout=20.0)
+    try:
+        wins = []
+        for a in accls:
+            wb = a.buffer((1 << 16,), np.float32)
+            wins.append(wb)
+            assert a.register_window(wb) == 1
+        # rendezvous + eager, both notified, polled over the wire
+        big = _payload(1 << 15, seed=41)
+        src = accls[0].buffer(data=big.copy())
+        accls[0].put(src, 1 << 15, dst=1, window=1, notify=0x51)
+        small = accls[0].buffer(data=_payload(32, 42))
+        accls[0].put(small, 32, dst=1, window=1, offset=4 * (1 << 15),
+                     notify=0x52)
+        recs = _poll_until(accls[1], 2, window=1, timeout=20.0)
+        by_tok = {r.token: r for r in recs}
+        assert set(by_tok) == {0x51, 0x52}
+        assert by_tok[0x51].nbytes == (1 << 15) * 4
+        assert by_tok[0x51].src == 0 and by_tok[0x51].err == 0
+        assert by_tok[0x52].offset == 4 * (1 << 15)
+        accls[1].device.sync_from_device(wins[1])
+        assert np.array_equal(wins[1].data[:1 << 15], big)
+        # drained: the wire poll round-trips an empty batch
+        assert accls[1].poll_notifications(window=1) == []
+        assert accls[1].poll_notifications() == []   # ANY_WINDOW
+        # un-notified puts stay silent on this tier too
+        accls[0].put(small, 32, dst=1, window=1)
+        time.sleep(0.1)
+        assert accls[1].poll_notifications(window=1) == []
+    finally:
+        for a in accls:
+            a.deinit()

@@ -1,0 +1,275 @@
+"""Serving control plane units (accl_tpu/serving/).
+
+Pure data-structure tests — no world, no transport. The three layers:
+
+* ``prefix_hashes`` / ``KVBlockManager`` — the chained block table:
+  sharing is only legal between identical whole prefixes, hits are
+  refcount bumps (zero wire bytes), eviction touches refcount-0 blocks
+  only, admission is all-or-nothing with ``MemoryError`` backpressure;
+* ``ContinuousBatcher`` — per-step admission against in-flight budgets,
+  immediate KV release at retirement, defer-on-backpressure, requeue
+  after a decode-rank death;
+* ``kv_shard_spec`` / ``reshard_plan_counts`` — the elastic layouts:
+  uneven block-cyclic deals over a (possibly subset) rank order, and
+  grow/shrink reshard plans that move a fraction of what the
+  gather-reshard-scatter oracle would.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from accl_tpu.hier.sharding import ShardSpec
+from accl_tpu.serving import (
+    ContinuousBatcher,
+    KVBlockManager,
+    Request,
+    kv_shard_spec,
+    prefix_hashes,
+    reshard_plan_counts,
+)
+
+
+# -- prefix hash chain --------------------------------------------------------
+
+def test_prefix_hashes_share_until_divergence():
+    a = prefix_hashes(range(64), block_tokens=16)
+    b = prefix_hashes(list(range(48)) + [999] * 16, block_tokens=16)
+    assert len(a) == len(b) == 4
+    assert a[:3] == b[:3]          # identical prefix -> identical chain
+    assert a[3] != b[3]            # divergent block differs...
+    c = prefix_hashes([999] * 16 + list(range(16, 64)), block_tokens=16)
+    # ...and the chain is POSITIONAL: same tokens after a different
+    # history never collide (what makes sharing-by-hash safe)
+    assert not set(a[1:]) & set(c[1:])
+
+
+def test_prefix_hashes_partial_last_block_and_validation():
+    assert len(prefix_hashes(range(17), block_tokens=16)) == 2
+    assert prefix_hashes([], block_tokens=16) == ()
+    with pytest.raises(ValueError):
+        prefix_hashes(range(4), block_tokens=0)
+
+
+# -- KV block manager ---------------------------------------------------------
+
+def test_kv_hit_is_refcount_bump_zero_wire_bytes():
+    kv = KVBlockManager(block_nbytes=64, blocks_per_rank=8, ranks=(0, 1))
+    h = prefix_hashes(range(48), 16)
+    rank, hits, misses = kv.acquire(h)
+    assert (len(hits), len(misses)) == (0, 3)
+    assert [m.offset for m in misses] == [m.slot * 64 for m in misses]
+    r2, hits2, misses2 = kv.acquire(h)        # same prompt again
+    assert r2 == rank                         # prefix affinity
+    assert (len(hits2), len(misses2)) == (3, 0)
+    assert kv.wire_bytes_saved == 3 * 64
+    assert kv.hit_ratio() == 0.5
+    # shared by reference: same slots both times
+    assert [b.slot for b in hits2] == [m.slot for m in misses]
+
+
+def test_kv_placement_prefix_affinity_beats_load():
+    kv = KVBlockManager(block_nbytes=64, blocks_per_rank=8, ranks=(0, 1))
+    h = prefix_hashes(range(32), 16)
+    rank, _, _ = kv.acquire(h)
+    # pile unrelated load onto the affinity rank's competitor is not
+    # needed: rank already holds 2 blocks, the other 0 — yet the shared
+    # prefix still lands on the warm rank
+    other = [r for r in (0, 1) if r != rank][0]
+    kv.acquire(prefix_hashes(range(1000, 1016), 16))   # fills `other`
+    assert kv.blocks_in_use(other) == 1
+    r2, hits, _ = kv.acquire(h)
+    assert r2 == rank and len(hits) == 2
+
+
+def test_kv_fresh_traffic_spreads_by_load():
+    kv = KVBlockManager(block_nbytes=64, blocks_per_rank=8, ranks=(0, 1))
+    seen = {kv.acquire(prefix_hashes(range(p, p + 16), 16))[0]
+            for p in (0, 1000, 2000, 3000)}
+    assert seen == {0, 1}
+
+
+def test_kv_lru_eviction_only_at_refcount_zero():
+    kv = KVBlockManager(block_nbytes=64, blocks_per_rank=2, ranks=(0,))
+    h12 = prefix_hashes(range(32), 16)
+    kv.acquire(h12)
+    # both blocks in use -> a new request cannot be admitted
+    with pytest.raises(MemoryError):
+        kv.acquire(prefix_hashes(range(100, 116), 16))
+    assert kv.evictions == 0
+    kv.release(h12, 0)
+    assert kv.blocks_in_use(0) == 0 and kv.cached_blocks(0) == 2
+    # refcount-0 blocks stay cached: re-acquire is a pure hit
+    _, hits, misses = kv.acquire(h12)
+    assert (len(hits), len(misses)) == (2, 0)
+    kv.release(h12, 0)
+    # now pressure evicts them oldest-first
+    h_new = prefix_hashes(range(200, 232), 16)
+    _, _, m = kv.acquire(h_new)
+    assert len(m) == 2 and kv.evictions == 2
+    kv.release(h_new, 0)
+    # h12 was evicted: acquiring it again is a miss, not a hit
+    _, hits, m2 = kv.acquire(h12[:1])
+    assert (len(hits), len(m2)) == (0, 1)
+
+
+def test_kv_admission_rollback_is_all_or_nothing():
+    kv = KVBlockManager(block_nbytes=64, blocks_per_rank=3, ranks=(0,))
+    hx, hy = prefix_hashes(range(32), 16)
+    kv.acquire((hx, hy))
+    kv.release((hx, hy), 0)
+    big = (hx,) + tuple(prefix_hashes(range(500, 548), 16))
+    with pytest.raises(MemoryError):
+        kv.acquire(big)                      # 4 blocks into 3 slots
+    # rollback restored the world: hx still cached at refcount 0,
+    # the fresh misses vanished (not lingering as evictable entries)
+    assert kv.blocks_in_use(0) == 0
+    _, hits, _ = kv.acquire((hx,))
+    assert len(hits) == 1
+    _, _, m = kv.acquire(prefix_hashes(range(500, 516), 16))
+    assert len(m) == 1                       # was rolled back -> miss
+
+
+def test_kv_lookup_and_drop_add_rank():
+    kv = KVBlockManager(block_nbytes=64, blocks_per_rank=8, ranks=(0, 1))
+    h = prefix_hashes(range(32), 16)
+    rank, _, misses = kv.acquire(h)
+    refs = kv.lookup(h, rank)
+    assert [(b.key, b.rank, b.slot, b.offset) for b in refs] == \
+        [(m.key, m.rank, m.slot, m.offset) for m in misses]
+    with pytest.raises(KeyError):
+        kv.lookup((0xDEAD,), rank)
+    orphans = kv.drop_rank(rank)
+    assert sorted(orphans) == sorted(h)
+    assert rank not in kv.ranks
+    with pytest.raises(KeyError):
+        kv.lookup(h, rank)
+    # the survivor takes re-acquired traffic; the rank can rejoin empty
+    r2, _, m2 = kv.acquire(h)
+    assert r2 != rank and len(m2) == 2
+    kv.add_rank(rank)
+    assert rank in kv.ranks and kv.blocks_in_use(rank) == 0
+
+
+# -- continuous batcher -------------------------------------------------------
+
+def _req(rid, prompt=40, decode=2, hashes=()):
+    return Request(rid=rid, prompt_tokens=prompt, decode_tokens=decode,
+                   prefix_hashes=tuple(hashes))
+
+
+def test_batcher_inflight_budget_and_fifo():
+    b = ContinuousBatcher(max_inflight_tokens=100, max_batch=8)
+    for i in range(3):
+        b.submit(_req(i), now=0.0)
+    batch, misses = b.step_begin(now=1.0)
+    assert [r.rid for r in batch] == [0, 1] and misses == []
+    assert b.pending_count() == 1            # FIFO: no overtaking
+    b.step_end(now=2.0)
+    batch, _ = b.step_begin(now=3.0)         # still over budget (41*2)
+    assert [r.rid for r in batch] == [0, 1]
+    retired = b.step_end(now=4.0)
+    assert [r.rid for r in retired] == [0, 1]
+    batch, _ = b.step_begin(now=5.0)         # retirement freed budget
+    assert [r.rid for r in batch] == [2]
+    assert b.admitted_total == 3 and b.retired_total == 2
+
+
+def test_batcher_max_batch_cap():
+    b = ContinuousBatcher(max_inflight_tokens=1 << 20, max_batch=2)
+    for i in range(5):
+        b.submit(_req(i), now=0.0)
+    batch, _ = b.step_begin(now=1.0)
+    assert len(batch) == 2
+
+
+def test_batcher_ttft_and_done():
+    b = ContinuousBatcher()
+    b.submit(_req(7, decode=2), now=10.0)
+    b.step_begin(now=11.0)
+    b.step_end(now=11.5)
+    (req,) = b.active()
+    assert req.ttft_s == 1.5                 # admission wait + 1 step
+    b.step_begin(now=12.0)
+    (done,) = b.step_end(now=12.5)
+    assert done.rid == 7 and done.t_done == 12.5
+    assert b.done() == [done]
+    assert b.drain_done() == [done] and b.done() == []
+
+
+def test_batcher_kv_defer_then_admit_after_retirement():
+    kv = KVBlockManager(block_nbytes=64, blocks_per_rank=2, ranks=(0,))
+    b = ContinuousBatcher(kv=kv)
+    h1 = prefix_hashes(range(32), 16)
+    h2 = prefix_hashes(range(100, 132), 16)
+    b.submit(_req(1, decode=1, hashes=h1), now=0.0)
+    b.submit(_req(2, decode=1, hashes=h2), now=0.0)
+    batch, misses = b.step_begin(now=1.0)
+    assert [r.rid for r in batch] == [1] and len(misses) == 2
+    assert b.deferred_total == 1             # rid 2 hit backpressure
+    b.step_end(now=2.0)                      # rid 1 retires, KV released
+    batch, misses = b.step_begin(now=3.0)
+    assert [r.rid for r in batch] == [2] and len(misses) == 2
+    assert kv.evictions == 2                 # rid 1's blocks made room
+
+
+def test_batcher_requeue_resets_lifecycle():
+    kv = KVBlockManager(block_nbytes=64, blocks_per_rank=8, ranks=(0,))
+    b = ContinuousBatcher(kv=kv)
+    b.submit(_req(1, decode=5, hashes=prefix_hashes(range(16), 16)),
+             now=0.0)
+    b.submit(_req(2, decode=5), now=0.0)
+    b.step_begin(now=1.0)
+    b.step_end(now=2.0)
+    (req, req2) = b.active()
+    assert req.decoded == 1
+    b.requeue(req)
+    assert [r.rid for r in b.active()] == [2]
+    assert req.kv_rank == -1 and req.decoded == 0 and req.remaining == 5
+    assert req.t_first_token == 0.0
+    batch, _ = b.step_begin(now=3.0)         # re-admitted from the head
+    assert {r.rid for r in batch} == {1, 2}
+
+
+# -- elastic KV layouts -------------------------------------------------------
+
+def test_kv_shard_spec_uneven_deal():
+    s = kv_shard_spec(10, 4, world=4)        # 10 blocks of 4 elems
+    assert s.kind == "block_cyclic" and s.n == 40 and s.chunk == 4
+    assert [s.local_count(r) for r in range(4)] == [12, 12, 8, 8]
+    # chunk k lands on order[k % len(order)], whole blocks, ascending
+    assert s.intervals(2) == [(8, 4, 0), (24, 4, 4)]
+
+
+def test_kv_shard_spec_subset_order_and_partial_chunk():
+    s = kv_shard_spec(6, 4, world=4, order=(0, 2))
+    assert s.intervals(1) == [] and s.local_count(3) == 0
+    assert s.participants() == (0, 2)
+    p = ShardSpec.block_cyclic(10, 2, 4)     # last chunk partial
+    assert [p.local_count(r) for r in range(2)] == [6, 4]
+    assert p.intervals(0) == [(0, 4, 0), (8, 2, 4)]
+    with pytest.raises(ValueError):
+        ShardSpec.block_cyclic(8, 2, 4, order=(0, 0))
+    with pytest.raises(ValueError):
+        kv_shard_spec(0, 4, world=2)
+
+
+def test_reshard_grow_moves_fraction_of_oracle():
+    # 24 blocks over (0,1,2) grow to (0,1,2,3): per 12-chunk period
+    # only chunks 0..2 keep their rank -> 18/24 blocks move
+    src = kv_shard_spec(24, 4, world=4, order=(0, 1, 2))
+    dst = kv_shard_spec(24, 4, world=4, order=(0, 1, 2, 3))
+    c = reshard_plan_counts(src, dst)
+    assert c["moved_elems"] == 18 * 4
+    assert c["moved_elems"] % 4 == 0         # whole blocks move
+    assert c["oracle_moved_elems"] == 2 * src.n
+    assert c["moved_elems"] < c["oracle_moved_elems"]
+    # shrink runs the mirror image, still a fraction of the oracle
+    back = reshard_plan_counts(dst, src)
+    assert 0 < back["moved_elems"] < back["oracle_moved_elems"]
+
+
+def test_reshard_identity_moves_nothing():
+    s = kv_shard_spec(24, 4, world=4, order=(1, 2, 3))
+    c = reshard_plan_counts(s, s)
+    assert c["moved_elems"] == 0

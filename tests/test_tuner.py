@@ -593,3 +593,82 @@ def test_nbytes_bucket():
     assert nbytes_bucket(2) == 1
     assert nbytes_bucket(1024) == 10
     assert nbytes_bucket(1025) == 11
+
+
+# -- RMA eager/rendezvous crossover (accl_tpu/rma) ---------------------------
+
+def test_rma_eager_crossover_priced_from_topology():
+    """No measurements: the crossover is the alpha-beta break-even
+    (rendezvous's extra ctl round trip vs eager's staging copy),
+    clamped and floored to a power of two."""
+    # emu topo: 2 * 20us * 4 GB/s = 160 KB -> floor to 128 KiB
+    assert Tuner(topology=EMU_TOPO).recommend_rma_eager_max() == 128 << 10
+    # default topo: 2 * 50us * 1 GB/s = 100 KB -> floor to 64 KiB
+    assert Tuner().recommend_rma_eager_max() == 64 << 10
+
+
+def test_rma_eager_crossover_follows_measured_winner():
+    t = Tuner(topology=EMU_TOPO, min_samples=2)
+    assert t.recommend_rma_eager_max() == 128 << 10
+    # rendezvous measurably wins 32 KiB puts: the crossover must drop
+    # below that size — but only after refresh() (decisions are sticky;
+    # the engine must not see a mid-decision flip)
+    for _ in range(2):
+        assert t.observe_rma_put(32 << 10, eager=True, duration_s=900e-6)
+        assert t.observe_rma_put(32 << 10, eager=False, duration_s=300e-6)
+    assert t.recommend_rma_eager_max() == 128 << 10   # sticky
+    t.refresh()
+    assert t.recommend_rma_eager_max() == 16 << 10    # (32 KiB)/2
+
+
+def test_rma_eager_crossover_raises_on_eager_evidence():
+    t = Tuner(topology=EMU_TOPO, min_samples=2)
+    # eager wins even at the clamp ceiling: crossover rises to it
+    for _ in range(2):
+        t.observe_rma_put(256 << 10, eager=True, duration_s=200e-6)
+        t.observe_rma_put(256 << 10, eager=False, duration_s=800e-6)
+    t.refresh()
+    assert t.recommend_rma_eager_max() == 256 << 10
+
+
+def test_rma_observe_gating():
+    t = Tuner(topology=EMU_TOPO, min_samples=1)
+    # errored / nonsense observations are rejected, not averaged in —
+    # a retried transfer's latency measures the fault, not the variant
+    assert not t.observe_rma_put(4096, eager=True, duration_s=1e-3,
+                                 error_word=1 << 3)
+    assert not t.observe_rma_put(0, eager=True, duration_s=1e-3)
+    assert not t.observe_rma_put(4096, eager=False, duration_s=-1.0)
+    # one-sided evidence (only rendezvous sampled) moves nothing
+    t.observe_rma_put(32 << 10, eager=False, duration_s=100e-6)
+    assert t.recommend_rma_eager_max() == 128 << 10
+
+
+def test_engine_eager_max_precedence(monkeypatch):
+    """effective_eager_max: constructor > env > tuner > default."""
+    from accl_tpu.constants import DEFAULT_RMA_EAGER_MAX
+    from accl_tpu.rma import RmaEngine, WindowRegistry
+
+    def _engine(**kw):
+        return RmaEngine(0, None, WindowRegistry(owner="t"),
+                         lambda *a: None, pool_fn=lambda: None,
+                         comm_of=lambda cid: None, **kw)
+
+    monkeypatch.delenv("ACCL_TPU_RMA_EAGER_MAX", raising=False)
+    tuner = Tuner(topology=EMU_TOPO)
+    e = _engine(tuner_fn=lambda: tuner)
+    assert e.effective_eager_max() == 128 << 10       # tuner-priced
+    monkeypatch.setenv("ACCL_TPU_RMA_EAGER_MAX", str(24 << 10))
+    assert e.effective_eager_max() == 24 << 10        # env beats tuner
+    e2 = _engine(eager_max=8 << 10, tuner_fn=lambda: tuner)
+    assert e2.effective_eager_max() == 8 << 10        # ctor beats env
+    monkeypatch.delenv("ACCL_TPU_RMA_EAGER_MAX", raising=False)
+    assert _engine().effective_eager_max() == DEFAULT_RMA_EAGER_MAX
+
+    class Broken:
+        def recommend_rma_eager_max(self):
+            raise RuntimeError("tuner fell over")
+
+    # a broken tuner must not take the put path down with it
+    assert _engine(tuner_fn=Broken).effective_eager_max() == \
+        DEFAULT_RMA_EAGER_MAX
